@@ -1,0 +1,21 @@
+"""kueue_tpu — a TPU-native job-queueing and quota-admission framework.
+
+Re-implements the capabilities of Kubernetes Kueue (reference:
+/root/reference, kerthcet/kueue) with the per-cycle admission hot path —
+cache snapshot -> flavor assignment -> preemption / fair-share victim
+search -> topology-aware placement — expressed as batched JAX/XLA
+computations over dense (workload x flavor x resource) tensors.
+
+Package layout:
+  models/      API object model (ClusterQueue, LocalQueue, Workload, ...)
+  core/        queue manager, cache, snapshot, scheduler driver
+  ops/         JAX kernels (quota math, flavor assign, preemption, TAS)
+  parallel/    device-mesh sharding of the solver
+  controllers/ workload lifecycle, jobframework, admission checks
+  utils/       heaps, backoff, priority helpers
+  metrics/     prometheus-style counters/histograms
+  visibility/  pending-workloads API
+  cli/         kueuectl-equivalent command line
+"""
+
+__version__ = "0.1.0"

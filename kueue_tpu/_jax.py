@@ -1,0 +1,22 @@
+"""Central JAX import for kueue_tpu.
+
+Quota quantities are canonical int64 (milli-CPU / bytes) — values like
+64Gi overflow int32 — so x64 mode is enabled here, before any kernel
+builds arrays. All ops/core modules must import jax/jnp from this module
+rather than directly, so the flag is set exactly once, first.
+
+On TPU, int64 arithmetic is emulated by XLA; the solver tensors are tiny
+relative to MXU workloads so this costs little, and exact integer math
+is required for decision parity with the reference
+(pkg/resources/requests.go keeps everything in int64 for the same
+reason).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+__all__ = ["jax", "jnp", "lax"]

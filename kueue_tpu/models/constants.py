@@ -1,0 +1,92 @@
+"""Enums shared across the API model.
+
+Values track the reference's string constants so configs and recorded
+decisions diff cleanly against the Go implementation.
+"""
+
+from enum import Enum
+
+
+class QueueingStrategy(str, Enum):
+    """apis/kueue/v1beta1/clusterqueue_types.go:74-87."""
+
+    STRICT_FIFO = "StrictFIFO"
+    BEST_EFFORT_FIFO = "BestEffortFIFO"
+
+
+class StopPolicy(str, Enum):
+    """apis/kueue/v1beta1/clusterqueue_types.go:114-126."""
+
+    NONE = "None"
+    HOLD = "Hold"
+    HOLD_AND_DRAIN = "HoldAndDrain"
+
+
+class PreemptionPolicy(str, Enum):
+    """withinClusterQueue policy (clusterqueue_types.go:424-495)."""
+
+    NEVER = "Never"
+    LOWER_PRIORITY = "LowerPriority"
+    LOWER_OR_NEWER_EQUAL_PRIORITY = "LowerOrNewerEqualPriority"
+
+
+class ReclaimWithinCohortPolicy(str, Enum):
+    """reclaimWithinCohort policy."""
+
+    NEVER = "Never"
+    LOWER_PRIORITY = "LowerPriority"
+    ANY = "Any"
+
+
+class BorrowWithinCohortPolicy(str, Enum):
+    NEVER = "Never"
+    LOWER_PRIORITY = "LowerPriority"
+
+
+class FlavorFungibilityPolicy(str, Enum):
+    """clusterqueue_types.go:379-401."""
+
+    BORROW = "Borrow"
+    PREEMPT = "Preempt"
+    TRY_NEXT_FLAVOR = "TryNextFlavor"
+
+
+class AdmissionCheckStateType(str, Enum):
+    """apis/kueue/v1beta1/admissioncheck_types.go:23-45."""
+
+    PENDING = "Pending"
+    READY = "Ready"
+    RETRY = "Retry"
+    REJECTED = "Rejected"
+
+
+class WorkloadConditionType(str, Enum):
+    """apis/kueue/v1beta1/workload_types.go:477-612."""
+
+    QUOTA_RESERVED = "QuotaReserved"
+    ADMITTED = "Admitted"
+    PODS_READY = "PodsReady"
+    EVICTED = "Evicted"
+    PREEMPTED = "Preempted"
+    REQUEUED = "Requeued"
+    FINISHED = "Finished"
+    DEACTIVATION_TARGET = "DeactivationTarget"
+
+
+# Eviction reasons (workload_types.go).
+EVICTED_BY_PREEMPTION = "Preempted"
+EVICTED_BY_PODS_READY_TIMEOUT = "PodsReadyTimeout"
+EVICTED_BY_ADMISSION_CHECK = "AdmissionCheck"
+EVICTED_BY_CLUSTER_QUEUE_STOPPED = "ClusterQueueStopped"
+EVICTED_BY_LOCAL_QUEUE_STOPPED = "LocalQueueStopped"
+EVICTED_BY_DEACTIVATION = "Deactivated"
+EVICTED_BY_MAXIMUM_EXECUTION_TIME = "MaximumExecutionTimeExceeded"
+
+# TAS podset annotation equivalents (apis/kueue/v1alpha1/topology_types.go:24-79).
+TOPOLOGY_MODE_REQUIRED = "Required"
+TOPOLOGY_MODE_PREFERRED = "Preferred"
+TOPOLOGY_MODE_UNCONSTRAINED = "Unconstrained"
+
+MAX_PODSETS = 8          # workload_types.go podSets max
+MAX_RESOURCE_GROUPS = 16  # clusterqueue_types.go resourceGroups max
+DEFAULT_PODSET_NAME = "main"

@@ -1,0 +1,62 @@
+"""ResourceFlavor — a hardware variant of a resource.
+
+Mirrors apis/kueue/v1beta1/resourceflavor_types.go:46-104: node labels
+for flavor<->node matching, taints the flavor's nodes carry, extra
+tolerations injected into admitted pods, and an optional topologyName
+that opts the flavor into Topology-Aware Scheduling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclass
+class ResourceFlavor:
+    name: str
+    node_labels: Dict[str, str] = field(default_factory=dict)
+    node_taints: Tuple[Taint, ...] = ()
+    tolerations: Tuple[Toleration, ...] = ()
+    topology_name: Optional[str] = None
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("ResourceFlavor.name is required")
+
+
+def taints_tolerated(taints, tolerations) -> bool:
+    """True when every NoSchedule/NoExecute taint is tolerated.
+
+    PreferNoSchedule taints never block placement (matches
+    k8s.io/component-helpers semantics used by the reference's flavor
+    selector, pkg/scheduler/flavorassigner/flavorassigner.go:640-684).
+    """
+    for taint in taints:
+        if taint.effect == "PreferNoSchedule":
+            continue
+        if not any(tol.tolerates(taint) for tol in tolerations):
+            return False
+    return True

@@ -1,0 +1,43 @@
+"""Backoff helpers.
+
+- requeue_backoff_seconds: the eviction requeue exponential backoff
+  (b * 2^(n-1) capped, reference pkg/controller/core/workload_controller.go:169-188
+  and apis/config/v1beta1 requeuingStrategy).
+- AdaptiveBackoff: the scheduler's 1..100 ms adaptive sleep between
+  cycles (pkg/util/wait/backoff.go:30-60) — doubles while cycles are
+  idle, resets on activity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+def requeue_backoff_seconds(
+    requeue_count: int, base_seconds: float = 60.0, max_seconds: float = 3600.0,
+    jitter: float = 0.0,
+) -> float:
+    if requeue_count <= 0:
+        return 0.0
+    backoff = base_seconds * (2.0 ** (requeue_count - 1))
+    backoff = min(backoff, max_seconds)
+    return backoff * (1.0 + jitter)
+
+
+@dataclass
+class AdaptiveBackoff:
+    min_ms: float = 1.0
+    max_ms: float = 100.0
+    _current_ms: float = 0.0
+
+    def __post_init__(self):
+        self._current_ms = self.min_ms
+
+    def next_idle(self) -> float:
+        """Sleep duration after an idle cycle; doubles up to max."""
+        cur = self._current_ms
+        self._current_ms = min(self._current_ms * 2.0, self.max_ms)
+        return cur / 1000.0
+
+    def reset(self) -> None:
+        self._current_ms = self.min_ms

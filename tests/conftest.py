@@ -1,0 +1,17 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX import.
+
+Multi-chip hardware is unavailable in CI; all sharding tests run against
+``--xla_force_host_platform_device_count=8`` on the CPU backend, the
+same mechanism the driver's ``dryrun_multichip`` uses.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

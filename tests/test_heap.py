@@ -1,0 +1,60 @@
+"""Keyed heap semantics (pkg/util/heap parity)."""
+
+from dataclasses import dataclass
+
+from kueue_tpu.utils.heap import Heap
+
+
+@dataclass
+class Item:
+    name: str
+    prio: int
+
+
+def make_heap():
+    return Heap(key_fn=lambda it: it.name, less=lambda a, b: a.prio > b.prio)
+
+
+def test_push_pop_order():
+    h = make_heap()
+    for name, p in [("a", 1), ("b", 5), ("c", 3)]:
+        assert h.push_if_not_present(Item(name, p))
+    assert h.pop().name == "b"
+    assert h.pop().name == "c"
+    assert h.pop().name == "a"
+    assert h.pop() is None
+
+
+def test_push_if_not_present_rejects_dup():
+    h = make_heap()
+    assert h.push_if_not_present(Item("a", 1))
+    assert not h.push_if_not_present(Item("a", 99))
+    assert h.peek().prio == 1
+
+
+def test_push_or_update_reorders():
+    h = make_heap()
+    h.push_or_update(Item("a", 1))
+    h.push_or_update(Item("b", 2))
+    h.push_or_update(Item("a", 10))
+    assert len(h) == 2
+    assert h.pop().name == "a"
+
+
+def test_delete_and_get():
+    h = make_heap()
+    h.push_or_update(Item("a", 1))
+    h.push_or_update(Item("b", 2))
+    assert h.get_by_key("a").prio == 1
+    assert h.delete("b")
+    assert not h.delete("b")
+    assert h.pop().name == "a"
+    assert len(h) == 0
+
+
+def test_fifo_tiebreak():
+    h = make_heap()
+    h.push_or_update(Item("first", 5))
+    h.push_or_update(Item("second", 5))
+    assert h.pop().name == "first"
+    assert h.pop().name == "second"

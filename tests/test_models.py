@@ -1,0 +1,100 @@
+"""API model validation/defaulting parity with the reference CRD rules."""
+
+import pytest
+
+from kueue_tpu.models import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    Taint,
+    Toleration,
+    Topology,
+    TopologyLevel,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_tpu.models.resource_flavor import taints_tolerated
+
+
+def make_cq(**kw):
+    rg = ResourceGroup(
+        covered_resources=("cpu",),
+        flavors=(FlavorQuotas.build("default", {"cpu": "10"}),),
+    )
+    kw.setdefault("resource_groups", (rg,))
+    return ClusterQueue(name="cq", **kw)
+
+
+def test_cluster_queue_quota_parsing():
+    cq = make_cq()
+    q = cq.resource_groups[0].flavors[0].resources["cpu"]
+    assert q.nominal == 10_000
+    assert q.borrowing_limit is None
+
+
+def test_borrowing_limit_requires_cohort():
+    rg = ResourceGroup(
+        covered_resources=("cpu",),
+        flavors=(FlavorQuotas.build("default", {"cpu": ("10", "5", None)}),),
+    )
+    with pytest.raises(ValueError, match="requires cohort"):
+        ClusterQueue(name="cq", resource_groups=(rg,))
+    # with a cohort it's fine
+    ClusterQueue(name="cq", resource_groups=(rg,), cohort="team")
+
+
+def test_resource_group_flavor_consistency():
+    with pytest.raises(ValueError, match="coveredResources"):
+        ResourceGroup(
+            covered_resources=("cpu", "memory"),
+            flavors=(FlavorQuotas.build("default", {"cpu": "10"}),),
+        )
+
+
+def test_duplicate_flavor_across_groups():
+    rg1 = ResourceGroup(("cpu",), (FlavorQuotas.build("f", {"cpu": "1"}),))
+    rg2 = ResourceGroup(("memory",), (FlavorQuotas.build("f", {"memory": "1Gi"}),))
+    with pytest.raises(ValueError, match="more than one resourceGroup"):
+        ClusterQueue(name="cq", resource_groups=(rg1, rg2))
+
+
+def test_workload_podset_validation():
+    with pytest.raises(ValueError):
+        Workload(namespace="ns", name="w", pod_sets=tuple(PodSet(name=f"p{i}") for i in range(9)))
+    with pytest.raises(ValueError, match="minCount"):
+        PodSet(name="a", count=2, min_count=5)
+
+
+def test_workload_conditions():
+    wl = Workload(namespace="ns", name="w")
+    assert not wl.has_quota_reservation
+    wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True, reason="QuotaReserved")
+    assert wl.has_quota_reservation
+    assert not wl.is_admitted
+
+
+def test_local_queue_key():
+    lq = LocalQueue(namespace="team-a", name="main", cluster_queue="cq")
+    assert lq.key == "team-a/main"
+
+
+def test_topology_levels():
+    topo = Topology(
+        name="default",
+        levels=(TopologyLevel("block"), TopologyLevel("rack"), TopologyLevel("host")),
+    )
+    assert topo.level_keys() == ("block", "rack", "host")
+    with pytest.raises(ValueError):
+        Topology(name="dup", levels=(TopologyLevel("a"), TopologyLevel("a")))
+
+
+def test_taints_and_tolerations():
+    spot_taint = Taint(key="spot", effect="NoSchedule")
+    assert not taints_tolerated([spot_taint], [])
+    assert taints_tolerated([spot_taint], [Toleration(key="spot", operator="Exists")])
+    assert taints_tolerated([Taint(key="x", effect="PreferNoSchedule")], [])
+    flavor = ResourceFlavor(name="spot", node_taints=(spot_taint,))
+    assert flavor.topology_name is None

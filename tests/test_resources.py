@@ -1,0 +1,69 @@
+"""Resource quantity parsing and arithmetic (pkg/resources parity)."""
+
+import pytest
+
+from kueue_tpu.resources import (
+    COUNT_IN_UNBOUNDED,
+    FlavorResource,
+    add_requests,
+    count_in,
+    flavor_resources,
+    quantity_to_int,
+    requests_from_spec,
+    scale_requests,
+)
+
+
+def test_cpu_milli():
+    assert quantity_to_int("cpu", "1") == 1000
+    assert quantity_to_int("cpu", "300m") == 300
+    assert quantity_to_int("cpu", "2.5") == 2500
+    assert quantity_to_int("cpu", 4) == 4000
+
+
+def test_memory_bytes():
+    assert quantity_to_int("memory", "1Ki") == 1024
+    assert quantity_to_int("memory", "1Gi") == 2**30
+    assert quantity_to_int("memory", "1G") == 10**9
+    assert quantity_to_int("memory", "512") == 512
+    assert quantity_to_int("memory", "100m") == 1  # rounds up sub-unit
+
+
+def test_extended_resources_plain():
+    assert quantity_to_int("google.com/tpu", "8") == 8
+    assert quantity_to_int("pods", 3) == 3
+
+
+def test_invalid_quantity():
+    with pytest.raises(ValueError):
+        quantity_to_int("cpu", "abc")
+
+
+def test_requests_arithmetic():
+    a = requests_from_spec({"cpu": "1", "memory": "1Gi"})
+    b = requests_from_spec({"cpu": "500m"})
+    add_requests(a, b)
+    assert a["cpu"] == 1500
+    assert scale_requests(b, 3)["cpu"] == 1500
+
+
+def test_count_in():
+    per_unit = requests_from_spec({"cpu": "1", "memory": "1Gi"})
+    capacity = requests_from_spec({"cpu": "10", "memory": "4Gi"})
+    assert count_in(per_unit, capacity) == 4
+    # zero-valued requests fit unboundedly (reference CountIn -> MaxInt32)
+    assert count_in({}, capacity) == COUNT_IN_UNBOUNDED
+    assert count_in({"cpu": 0}, capacity) == COUNT_IN_UNBOUNDED
+
+
+def test_int64_precision_preserved():
+    big = 2**53 + 1  # first integer float64 cannot represent
+    assert quantity_to_int("memory", big) == big
+    assert quantity_to_int("memory", str(big)) == big
+
+
+def test_flavor_resource_keys():
+    frs = flavor_resources(["on-demand", "spot"], ["cpu", "memory"])
+    assert len(frs) == 4
+    assert FlavorResource("spot", "cpu") in frs
+    assert sorted(frs)[0] == FlavorResource("on-demand", "cpu")
