@@ -165,12 +165,13 @@ class Workload:
         self, ctype: WorkloadConditionType, status: bool, reason: str = "",
         message: str = "", now: float = 0.0,
     ) -> None:
+        """apimeta.SetStatusCondition semantics: reason/message always
+        refresh, but lastTransitionTime only moves on a status flip."""
         prev = self.conditions.get(ctype)
-        if prev is not None and prev.status == status and prev.reason == reason:
-            return
+        transition = prev is None or prev.status != status
         self.conditions[ctype] = Condition(
             type=ctype, status=status, reason=reason, message=message,
-            last_transition_time=now,
+            last_transition_time=now if transition else prev.last_transition_time,
         )
 
     @property

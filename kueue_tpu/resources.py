@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass
+from fractions import Fraction
 from typing import Dict, Iterable, Mapping, Tuple
 
 # Canonical well-known resource names (subset of corev1).
@@ -46,7 +47,10 @@ def parse_quantity(value) -> Tuple[object, int]:
     if not m:
         raise ValueError(f"invalid quantity: {value!r}")
     digits = m.group(1)
-    num = int(digits) if "." not in digits else float(digits)
+    # Fraction keeps decimal strings exact ("1.07" stays 107/100), so no
+    # float rounding noise can leak into the ceil below — required for
+    # decision parity with k8s resource.Quantity's exact decimal math.
+    num = int(digits) if "." not in digits else Fraction(digits)
     suffix = m.group(2)
     if suffix is None:
         return num, 1
@@ -65,6 +69,8 @@ def quantity_to_int(resource_name: str, value) -> int:
     resource in base units, rounding up fractional values.
     """
     num, scale = parse_quantity(value)
+    if isinstance(num, float):
+        num = Fraction(num)
     if resource_name == CPU:
         if scale == -1:  # already milli
             raw = num
@@ -72,18 +78,13 @@ def quantity_to_int(resource_name: str, value) -> int:
             raw = num * scale * 1000
     else:
         if scale == -1:
-            if isinstance(num, int):
-                # exact ceil-division keeps int64 precision
-                return -((-num) // 1000)
-            raw = num / 1000.0
+            raw = Fraction(num, 1000)
         else:
             raw = num * scale
     if isinstance(raw, int):
         return raw
-    out = int(raw)
-    if raw > out:  # ceil for positive fractional remainders
-        out += 1
-    return out
+    # exact ceil on the rational value (k8s rounds partial units up)
+    return -((-raw.numerator) // raw.denominator)
 
 
 def int_to_display(resource_name: str, value: int) -> str:

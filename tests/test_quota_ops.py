@@ -1,0 +1,296 @@
+"""Golden tests: tensorized quota math vs a recursive oracle.
+
+The oracle is a direct transcription of the reference's recursive
+definitions (pkg/cache/resource_node.go, fair_sharing.go) over a toy
+node graph; the kernels in kueue_tpu.ops.quota must agree cell-for-cell
+on randomized forests.
+"""
+
+import numpy as np
+import pytest
+
+from kueue_tpu._jax import jnp
+from kueue_tpu.ops.quota import (
+    DRS_MAX,
+    NO_LIMIT,
+    QuotaTree,
+    available_all,
+    dominant_resource_share,
+    potential_available_all,
+    subtree_quota,
+    usage_tree,
+)
+
+ROOT = -1
+
+
+# ---------------------------------------------------------------- oracle
+class Node:
+    def __init__(self, nominal, lending=None, borrowing=None):
+        self.nominal = dict(nominal)  # fr -> int
+        self.lending = dict(lending or {})  # fr -> int or absent
+        self.borrowing = dict(borrowing or {})
+        self.parent = None
+        self.children = []
+        self.subtree = {}
+        self.usage = {}
+
+    def guaranteed(self, fr):
+        if fr in self.lending:
+            return max(0, self.subtree.get(fr, 0) - self.lending[fr])
+        return 0
+
+
+def update_tree(root, frs):
+    """updateCohortResourceNode semantics."""
+    for child in root.children:
+        update_tree(child, frs)
+    root.subtree = {fr: root.nominal.get(fr, 0) for fr in frs}
+    root.usage = {fr: root.usage.get(fr, 0) if not root.children else 0 for fr in frs}
+    for child in root.children:
+        for fr in frs:
+            root.subtree[fr] += child.subtree.get(fr, 0) - child.guaranteed(fr)
+            root.usage[fr] = root.usage.get(fr, 0) + max(
+                0, child.usage.get(fr, 0) - child.guaranteed(fr)
+            )
+
+
+def oracle_available(node, fr):
+    if node.parent is None:
+        return node.subtree.get(fr, 0) - node.usage.get(fr, 0)
+    local = max(0, node.guaranteed(fr) - node.usage.get(fr, 0))
+    parent_avail = oracle_available(node.parent, fr)
+    if fr in node.borrowing:
+        stored = node.subtree.get(fr, 0) - node.guaranteed(fr)
+        used = max(0, node.usage.get(fr, 0) - node.guaranteed(fr))
+        parent_avail = min(stored - used + node.borrowing[fr], parent_avail)
+    return local + parent_avail
+
+
+def oracle_potential(node, fr):
+    if node.parent is None:
+        return node.subtree.get(fr, 0)
+    avail = node.guaranteed(fr) + oracle_potential(node.parent, fr)
+    if fr in node.borrowing:
+        avail = min(node.subtree.get(fr, 0) + node.borrowing[fr], avail)
+    return avail
+
+
+# ------------------------------------------------------------- flattening
+def build_tree_arrays(nodes, parents, frs):
+    """nodes: list of Node; parents: list of parent indices (-1 root)."""
+    n = len(nodes)
+    for i, p in enumerate(parents):
+        if p != ROOT:
+            nodes[i].parent = nodes[p]
+            nodes[p].children.append(nodes[i])
+    depth = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        d, cur = 0, parents[i]
+        while cur != ROOT:
+            d += 1
+            cur = parents[cur]
+        depth[i] = d
+    max_depth = int(depth.max()) if n else 0
+    level_mask = np.stack([depth == d for d in range(max_depth + 1)])
+
+    fr_list = sorted(frs)
+    nominal = np.zeros((n, len(fr_list)), dtype=np.int64)
+    lend = np.full((n, len(fr_list)), NO_LIMIT, dtype=np.int64)
+    borrow = np.full((n, len(fr_list)), NO_LIMIT, dtype=np.int64)
+    for i, node in enumerate(nodes):
+        for j, fr in enumerate(fr_list):
+            nominal[i, j] = node.nominal.get(fr, 0)
+            if fr in node.lending:
+                lend[i, j] = node.lending[fr]
+            if fr in node.borrowing:
+                borrow[i, j] = node.borrowing[fr]
+    tree = QuotaTree(
+        parent=jnp.asarray(parents, dtype=jnp.int32),
+        level_mask=jnp.asarray(level_mask),
+        nominal=jnp.asarray(nominal),
+        lending_limit=jnp.asarray(lend),
+        borrowing_limit=jnp.asarray(borrow),
+    )
+    return tree, fr_list
+
+
+def run_kernels(nodes, parents, frs, usages):
+    tree, fr_list = build_tree_arrays(nodes, parents, frs)
+    local_usage = np.zeros((len(nodes), len(fr_list)), dtype=np.int64)
+    for i, u in usages.items():
+        for fr, v in u.items():
+            local_usage[i, fr_list.index(fr)] = v
+            nodes[i].usage[fr] = v
+    subtree, guaranteed = subtree_quota(tree)
+    usage = usage_tree(tree, guaranteed, jnp.asarray(local_usage))
+    avail = available_all(tree, subtree, guaranteed, usage)
+    pot = potential_available_all(tree, subtree, guaranteed)
+
+    roots = [nodes[i] for i, p in enumerate(parents) if p == ROOT]
+    for r in roots:
+        update_tree(r, frs)
+    return tree, fr_list, subtree, guaranteed, usage, avail, pot
+
+
+# ------------------------------------------------------------------ tests
+def test_flat_cq_no_cohort():
+    nodes = [Node({"f/cpu": 1000})]
+    _, fr_list, subtree, _, usage, avail, pot = run_kernels(
+        nodes, [ROOT], {"f/cpu"}, {0: {"f/cpu": 300}}
+    )
+    assert subtree[0, 0] == 1000
+    assert avail[0, 0] == 700
+    assert pot[0, 0] == 1000
+
+
+def test_two_cqs_borrowing():
+    # cq0, cq1 under cohort2; cq0 may borrow everything cq1 lends
+    nodes = [Node({"f/cpu": 10}), Node({"f/cpu": 20}), Node({})]
+    _, fr, subtree, g, usage, avail, pot = run_kernels(
+        nodes, [2, 2, ROOT], {"f/cpu"}, {0: {"f/cpu": 5}}
+    )
+    # cohort subtree = 10+20 = 30 (no lending limits -> all lendable)
+    assert subtree[2, 0] == 30
+    # cq0 guaranteed 0 (no lending limit set -> fully lendable)
+    assert g[0, 0] == 0
+    # cq0 available = 0 local + parent (30 - 5 usage bubbled) = 25
+    assert avail[0, 0] == 25
+    assert avail[1, 0] == 25
+    assert pot[0, 0] == 30
+
+
+def test_lending_limit_guarantees_local():
+    # cq1 lends at most 5 of its 20
+    nodes = [Node({"f/cpu": 10}), Node({"f/cpu": 20}, lending={"f/cpu": 5}), Node({})]
+    _, fr, subtree, g, usage, avail, pot = run_kernels(
+        nodes, [2, 2, ROOT], {"f/cpu"}, {}
+    )
+    assert g[1, 0] == 15
+    # cohort sees 10 + 5 = 15
+    assert subtree[2, 0] == 15
+    assert avail[0, 0] == 15
+    # cq1 keeps guaranteed 15 + full cohort availability 15 = 30
+    assert avail[1, 0] == 30
+
+
+def test_borrowing_limit_clamps():
+    nodes = [
+        Node({"f/cpu": 10}, borrowing={"f/cpu": 3}),
+        Node({"f/cpu": 20}),
+        Node({}),
+    ]
+    _, fr, subtree, g, usage, avail, pot = run_kernels(
+        nodes, [2, 2, ROOT], {"f/cpu"}, {}
+    )
+    # cq0 can use its 10 (stored in parent) + borrow at most 3
+    assert avail[0, 0] == 13
+    assert pot[0, 0] == 13
+
+
+def test_overadmission_negative_available():
+    nodes = [Node({"f/cpu": 10})]
+    _, _, _, _, _, avail, _ = run_kernels(nodes, [ROOT], {"f/cpu"}, {0: {"f/cpu": 15}})
+    assert avail[0, 0] == -5
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_against_oracle(seed):
+    rng = np.random.default_rng(seed)
+    n_cohorts = rng.integers(1, 5)
+    n_cqs = rng.integers(1, 8)
+    frs = {f"f{k}/cpu" for k in range(rng.integers(1, 4))}
+
+    nodes = []
+    parents = []
+    # cohorts first as a chain/tree among themselves
+    for c in range(n_cohorts):
+        nominal = {fr: int(rng.integers(0, 50)) for fr in frs if rng.random() < 0.5}
+        lending = {fr: int(rng.integers(0, 30)) for fr in nominal if rng.random() < 0.4}
+        node = Node(nominal, lending=lending)
+        nodes.append(node)
+        parents.append(ROOT if c == 0 else int(rng.integers(0, c)))
+    for q in range(n_cqs):
+        nominal = {fr: int(rng.integers(0, 50)) for fr in frs}
+        lending = {fr: int(rng.integers(0, 30)) for fr in nominal if rng.random() < 0.4}
+        borrowing = {fr: int(rng.integers(0, 40)) for fr in nominal if rng.random() < 0.4}
+        nodes.append(Node(nominal, lending=lending, borrowing=borrowing))
+        parents.append(int(rng.integers(0, n_cohorts)))
+
+    usages = {
+        n_cohorts + q: {fr: int(rng.integers(0, 60)) for fr in frs}
+        for q in range(n_cqs)
+    }
+    _, fr_list, subtree, g, usage, avail, pot = run_kernels(
+        nodes, parents, frs, usages
+    )
+
+    for i, node in enumerate(nodes):
+        for j, fr in enumerate(fr_list):
+            assert subtree[i, j] == node.subtree.get(fr, 0), (i, fr, "subtree")
+            assert usage[i, j] == node.usage.get(fr, 0), (i, fr, "usage")
+            assert avail[i, j] == oracle_available(node, fr), (i, fr, "avail")
+            assert pot[i, j] == oracle_potential(node, fr), (i, fr, "potential")
+
+
+def test_drs_basic():
+    # cq0 borrows 5 cpu above its subtree quota; cohort lends 30 total
+    nodes = [Node({"f/cpu": 10}), Node({"f/cpu": 20}), Node({})]
+    tree, fr_list = build_tree_arrays(nodes, [2, 2, ROOT], {"f/cpu"})
+    subtree, guaranteed = subtree_quota(tree)
+    local_usage = jnp.asarray(np.array([[15], [0], [0]], dtype=np.int64))
+    usage = usage_tree(tree, guaranteed, local_usage)
+    resource_index = jnp.zeros(1, dtype=jnp.int32)
+    weight = jnp.asarray([1000, 1000, 1000], dtype=jnp.int64)
+    wl_req = jnp.zeros((3, 1), dtype=jnp.int64)
+    dws, dom = dominant_resource_share(
+        tree, subtree, guaranteed, usage, wl_req, weight, resource_index, 1
+    )
+    # borrowed = 15-10 = 5; lendable(parent) = potentialAvailable(cohort)=30
+    # drs = 5*1000/30 = 166; weight 1 -> 166
+    assert dws[0] == 166
+    assert dom[0] == 0
+    assert dws[1] == 0 and dom[1] == -1
+
+
+def test_drs_zero_weight_borrowing_is_max():
+    nodes = [Node({"f/cpu": 10}), Node({"f/cpu": 20}), Node({})]
+    tree, _ = build_tree_arrays(nodes, [2, 2, ROOT], {"f/cpu"})
+    subtree, guaranteed = subtree_quota(tree)
+    usage = usage_tree(
+        tree, guaranteed, jnp.asarray(np.array([[15], [0], [0]], dtype=np.int64))
+    )
+    dws, _ = dominant_resource_share(
+        tree,
+        subtree,
+        guaranteed,
+        usage,
+        jnp.zeros((3, 1), dtype=jnp.int64),
+        jnp.asarray([0, 1000, 1000], dtype=jnp.int64),
+        jnp.zeros(1, dtype=jnp.int32),
+        1,
+    )
+    assert dws[0] == DRS_MAX
+
+
+def test_drs_with_workload_request():
+    # not borrowing now, but would borrow if wl admitted
+    nodes = [Node({"f/cpu": 10}), Node({"f/cpu": 20}), Node({})]
+    tree, _ = build_tree_arrays(nodes, [2, 2, ROOT], {"f/cpu"})
+    subtree, guaranteed = subtree_quota(tree)
+    usage = usage_tree(
+        tree, guaranteed, jnp.asarray(np.array([[8], [0], [0]], dtype=np.int64))
+    )
+    wl_req = jnp.asarray(np.array([[8], [0], [0]], dtype=np.int64))
+    dws, _ = dominant_resource_share(
+        tree,
+        subtree,
+        guaranteed,
+        usage,
+        wl_req,
+        jnp.asarray([1000, 1000, 1000], dtype=jnp.int64),
+        jnp.zeros(1, dtype=jnp.int32),
+        1,
+    )
+    # borrowed = 8+8-10 = 6 -> 6*1000/30 = 200
+    assert dws[0] == 200
