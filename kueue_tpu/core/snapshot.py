@@ -1,0 +1,308 @@
+"""Per-cycle snapshot: cache state flattened into dense tensors.
+
+Equivalent of the reference's ``pkg/cache/snapshot.go`` +
+``clusterqueue_snapshot.go``, redesigned struct-of-arrays: instead of a
+cloned object forest with simulate/undo closures, the snapshot is a set
+of flat arrays (cohort-parent indices, per-node quota cells, a single
+mutable [node x flavor-resource] local-usage matrix) over which
+
+- availability queries evaluate the whole forest at once
+  (ops/quota_np for host-side loops, ops/quota for the jit solver), and
+- preemption simulation is add/subtract on one usage row — no object
+  graph mutation, trivially undoable, and directly shippable to the
+  TPU solver as one contiguous buffer.
+
+Workload usage vectors are dense int64[FR] rows, so Fits/Simulate*
+(clusterqueue_snapshot.go:75-150) become vector compares.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from kueue_tpu.models import ClusterQueue, Workload
+from kueue_tpu.models.cluster_queue import ResourceQuota
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.hierarchy import FlatHierarchy
+from kueue_tpu.core.workload_info import admission_usage
+from kueue_tpu.ops.quota import NO_LIMIT
+from kueue_tpu.ops.quota_np import (
+    available_all_np,
+    dominant_resource_share_np,
+    potential_available_all_np,
+    subtree_quota_np,
+    usage_tree_np,
+)
+from kueue_tpu.resources import FlavorResource, FlavorResourceQuantities
+
+
+@dataclass
+class WorkloadSnapshot:
+    workload: Workload
+    cq_name: str
+    cq_row: int
+    usage_vec: np.ndarray  # int64[FR]
+    priority: int
+    quota_reserved_time: float
+
+
+@dataclass
+class Snapshot:
+    flat: FlatHierarchy
+    fr_list: Tuple[FlavorResource, ...]
+    fr_index: Dict[FlavorResource, int]
+    resource_names: Tuple[str, ...]
+    resource_index: np.ndarray  # int32[FR] -> resource id (sorted names)
+    # quota arrays [N, FR]
+    nominal: np.ndarray
+    lending_limit: np.ndarray
+    borrowing_limit: np.ndarray
+    subtree: np.ndarray
+    guaranteed: np.ndarray
+    # mutable during the cycle
+    local_usage: np.ndarray  # int64[N, FR]; nonzero only on CQ rows
+    weight_milli: np.ndarray  # int64[N]
+    cq_models: Dict[str, ClusterQueue]
+    workloads: Dict[str, WorkloadSnapshot] = field(default_factory=dict)
+    inactive_cqs: Tuple[str, ...] = ()
+
+    # ---- derived state ----
+    def usage(self) -> np.ndarray:
+        return usage_tree_np(
+            self.flat.parent, self._lm(), self.guaranteed, self.local_usage
+        )
+
+    def available(self) -> np.ndarray:
+        return available_all_np(
+            self.flat.parent, self._lm(), self.subtree, self.guaranteed,
+            self.borrowing_limit, self.usage(),
+        )
+
+    def potential_available(self) -> np.ndarray:
+        return potential_available_all_np(
+            self.flat.parent, self._lm(), self.subtree, self.guaranteed,
+            self.borrowing_limit,
+        )
+
+    def _lm(self) -> np.ndarray:
+        return self.flat.level_masks()
+
+    def row(self, cq_name: str) -> int:
+        return self.flat.index[cq_name]
+
+    # ---- queries (ClusterQueueSnapshot equivalents) ----
+    def fits(self, cq_name: str, usage_vec: np.ndarray) -> bool:
+        """FitInCohort/Fits: every requested cell within available."""
+        avail = self.available()[self.row(cq_name)]
+        need = usage_vec > 0
+        return bool(np.all(avail[need] >= usage_vec[need]))
+
+    def available_for(self, cq_name: str) -> np.ndarray:
+        return self.available()[self.row(cq_name)]
+
+    def borrowing_after(self, cq_name: str, usage_vec: np.ndarray) -> bool:
+        """Would admitting usage_vec push the CQ above its nominal
+        subtree quota in any cell (i.e. require borrowing)?"""
+        r = self.row(cq_name)
+        after = self.local_usage[r] + usage_vec
+        return bool(np.any(after > self.subtree[r]))
+
+    def is_borrowing(self, cq_name: str) -> bool:
+        r = self.row(cq_name)
+        return bool(np.any(self.local_usage[r] > self.subtree[r]))
+
+    # ---- simulation (SimulateUsageAddition/Removal, RemoveWorkload) ----
+    def add_usage(self, cq_name: str, usage_vec: np.ndarray) -> None:
+        self.local_usage[self.row(cq_name)] += usage_vec
+
+    def remove_usage(self, cq_name: str, usage_vec: np.ndarray) -> None:
+        self.local_usage[self.row(cq_name)] -= usage_vec
+
+    def add_workload(self, ws: WorkloadSnapshot) -> None:
+        self.workloads[ws.workload.key] = ws
+        self.local_usage[ws.cq_row] += ws.usage_vec
+
+    def remove_workload(self, wl_key: str) -> Optional[WorkloadSnapshot]:
+        ws = self.workloads.pop(wl_key, None)
+        if ws is not None:
+            self.local_usage[ws.cq_row] -= ws.usage_vec
+        return ws
+
+    def workloads_in_cq(self, cq_name: str) -> List[WorkloadSnapshot]:
+        return [ws for ws in self.workloads.values() if ws.cq_name == cq_name]
+
+    def workloads_in_cohort_of(self, cq_name: str) -> List[WorkloadSnapshot]:
+        members = self.cohort_members(cq_name)
+        return [ws for ws in self.workloads.values() if ws.cq_name in members]
+
+    def cohort_members(self, cq_name: str) -> Set[str]:
+        """All CQ names in the same cohort tree (incl. cq_name)."""
+        parent = self.flat.parent
+        roots: Dict[int, int] = {}
+
+        def root_of(i: int) -> int:
+            if i in roots:
+                return roots[i]
+            r = i
+            while parent[r] >= 0:
+                r = int(parent[r])
+            roots[i] = r
+            return r
+
+        me = root_of(self.row(cq_name))
+        return {
+            name
+            for name in self.flat.cq_names
+            if root_of(self.flat.index[name]) == me
+        }
+
+    def has_cohort(self, cq_name: str) -> bool:
+        return self.flat.parent[self.row(cq_name)] >= 0
+
+    # ---- fair sharing ----
+    def dominant_resource_share(
+        self, cq_name: str, wl_req: Optional[np.ndarray] = None
+    ) -> int:
+        n, fr = self.local_usage.shape
+        wl = np.zeros((n, fr), dtype=np.int64)
+        if wl_req is not None:
+            wl[self.row(cq_name)] = wl_req
+        dws, _ = dominant_resource_share_np(
+            self.flat.parent, self._lm(), self.subtree, self.guaranteed,
+            self.borrowing_limit, self.usage(), wl, self.weight_milli,
+            self.resource_index, len(self.resource_names),
+        )
+        return int(dws[self.row(cq_name)])
+
+    def vector_of(self, usage: FlavorResourceQuantities) -> np.ndarray:
+        vec = np.zeros(len(self.fr_list), dtype=np.int64)
+        for fr, qty in usage.items():
+            j = self.fr_index.get(fr)
+            if j is not None:
+                vec[j] += qty
+        return vec
+
+
+def _quota_cells(
+    node_quotas: Dict[FlavorResource, ResourceQuota],
+    fr_index: Dict[FlavorResource, int],
+    nominal: np.ndarray,
+    lend: np.ndarray,
+    borrow: np.ndarray,
+    row: int,
+) -> None:
+    for fr, q in node_quotas.items():
+        j = fr_index[fr]
+        nominal[row, j] = q.nominal
+        if q.lending_limit is not None:
+            lend[row, j] = q.lending_limit
+        if q.borrowing_limit is not None:
+            borrow[row, j] = q.borrowing_limit
+
+
+def _collect_quotas(resource_groups) -> Dict[FlavorResource, ResourceQuota]:
+    out: Dict[FlavorResource, ResourceQuota] = {}
+    for rg in resource_groups:
+        for fq in rg.flavors:
+            for rname, q in fq.resources.items():
+                out[FlavorResource(fq.name, rname)] = q
+    return out
+
+
+def take_snapshot(cache: Cache) -> Snapshot:
+    """Flatten the cache into a Snapshot (pkg/cache/snapshot.go:104-158).
+
+    Inactive ClusterQueues (stopped, missing flavors/checks/topologies,
+    cyclic cohorts) are excluded and reported, mirroring
+    InactiveClusterQueueSets.
+    """
+    active_names: List[str] = []
+    inactive: List[str] = []
+    for name in sorted(cache.cluster_queues):
+        if cache.cluster_queue_status(name).active:
+            active_names.append(name)
+        else:
+            inactive.append(name)
+
+    flat = cache.forest.flatten(active_names)
+    inactive.extend(flat.inactive_cqs)
+
+    # FR universe: every (flavor, resource) cell defined by any active CQ
+    # or cohort resource group.
+    frs: Set[FlavorResource] = set()
+    for name in flat.cq_names:
+        frs |= set(_collect_quotas(cache.cluster_queues[name].model.resource_groups))
+    for cname in flat.cohort_names:
+        cohort = cache.cohorts.get(cname)
+        if cohort is not None:
+            frs |= set(_collect_quotas(cohort.resource_groups))
+    fr_list = tuple(sorted(frs))
+    fr_index = {fr: j for j, fr in enumerate(fr_list)}
+    resource_names = tuple(sorted({fr.resource for fr in fr_list}))
+    rname_index = {r: i for i, r in enumerate(resource_names)}
+    resource_index = np.array(
+        [rname_index[fr.resource] for fr in fr_list], dtype=np.int32
+    )
+
+    n = flat.n_nodes
+    nominal = np.zeros((n, len(fr_list)), dtype=np.int64)
+    lend = np.full((n, len(fr_list)), NO_LIMIT, dtype=np.int64)
+    borrow = np.full((n, len(fr_list)), NO_LIMIT, dtype=np.int64)
+    weight = np.full(n, 1000, dtype=np.int64)
+
+    cq_models: Dict[str, ClusterQueue] = {}
+    for name in flat.cq_names:
+        model = cache.cluster_queues[name].model
+        cq_models[name] = model
+        row = flat.index[name]
+        _quota_cells(_collect_quotas(model.resource_groups), fr_index, nominal, lend, borrow, row)
+        weight[row] = model.fair_sharing.weight_milli
+    for cname in flat.cohort_names:
+        cohort = cache.cohorts.get(cname)
+        if cohort is not None:
+            row = flat.index[cname]
+            _quota_cells(_collect_quotas(cohort.resource_groups), fr_index, nominal, lend, borrow, row)
+            weight[row] = cohort.fair_sharing.weight_milli
+
+    level_mask = flat.level_masks()
+    subtree, guaranteed = subtree_quota_np(flat.parent, level_mask, nominal, lend)
+
+    snap = Snapshot(
+        flat=flat,
+        fr_list=fr_list,
+        fr_index=fr_index,
+        resource_names=resource_names,
+        resource_index=resource_index,
+        nominal=nominal,
+        lending_limit=lend,
+        borrowing_limit=borrow,
+        subtree=subtree,
+        guaranteed=guaranteed,
+        local_usage=np.zeros((n, len(fr_list)), dtype=np.int64),
+        weight_milli=weight,
+        cq_models=cq_models,
+        inactive_cqs=tuple(inactive),
+    )
+
+    from kueue_tpu.models.constants import WorkloadConditionType
+    from kueue_tpu.utils.priority import priority_of
+
+    for name in flat.cq_names:
+        cached = cache.cluster_queues[name]
+        for wl in cached.workloads.values():
+            usage = admission_usage(wl)
+            qr = wl.conditions.get(WorkloadConditionType.QUOTA_RESERVED)
+            snap.add_workload(
+                WorkloadSnapshot(
+                    workload=wl,
+                    cq_name=name,
+                    cq_row=flat.index[name],
+                    usage_vec=snap.vector_of(usage),
+                    priority=priority_of(wl),
+                    quota_reserved_time=qr.last_transition_time if qr else wl.creation_time,
+                )
+            )
+    return snap

@@ -70,7 +70,10 @@ def quantity_to_int(resource_name: str, value) -> int:
     """
     num, scale = parse_quantity(value)
     if isinstance(num, float):
-        num = Fraction(num)
+        # Parse via the decimal string form: Fraction(0.1) would expand
+        # the binary approximation (0.1000...055) and the exact ceil
+        # below would inflate by one unit.
+        num = Fraction(str(num))
     if resource_name == CPU:
         if scale == -1:  # already milli
             raw = num
@@ -119,10 +122,14 @@ Requests = Dict[str, int]
 FlavorResourceQuantities = Dict[FlavorResource, int]
 
 
-def add_requests(a: Requests, b: Mapping[str, int]) -> Requests:
+def _accumulate(a, b):
     for k, v in b.items():
         a[k] = a.get(k, 0) + v
     return a
+
+
+def add_requests(a: Requests, b: Mapping[str, int]) -> Requests:
+    return _accumulate(a, b)
 
 
 def sub_requests(a: Requests, b: Mapping[str, int]) -> Requests:
@@ -165,9 +172,7 @@ def count_in(requests: Requests, capacity: Mapping[str, int]) -> int:
 def add_flavor_quantities(
     a: FlavorResourceQuantities, b: Mapping[FlavorResource, int]
 ) -> FlavorResourceQuantities:
-    for k, v in b.items():
-        a[k] = a.get(k, 0) + v
-    return a
+    return _accumulate(a, b)
 
 
 def flavor_resources(

@@ -10,7 +10,7 @@
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 
 def requeue_backoff_seconds(
@@ -28,7 +28,7 @@ def requeue_backoff_seconds(
 class AdaptiveBackoff:
     min_ms: float = 1.0
     max_ms: float = 100.0
-    _current_ms: float = 0.0
+    _current_ms: float = field(init=False, default=0.0)
 
     def __post_init__(self):
         self._current_ms = self.min_ms

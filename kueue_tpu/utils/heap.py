@@ -31,6 +31,7 @@ class Heap(Generic[T]):
         self._items: Dict[str, "_Entry[T]"] = {}
         self._heap: List["_Entry[T]"] = []
         self._seq = itertools.count()
+        self._dead = 0
 
     def __len__(self) -> int:
         return len(self._items)
@@ -54,14 +55,14 @@ class Heap(Generic[T]):
     def push_or_update(self, item: T) -> None:
         key = self._key_fn(item)
         if key in self._items:
-            self._items[key].alive = False
+            self._kill(self._items[key])
         self._push(key, item)
 
     def delete(self, key: str) -> bool:
         entry = self._items.pop(key, None)
         if entry is None:
             return False
-        entry.alive = False
+        self._kill(entry)
         return True
 
     def get_by_key(self, key: str) -> Optional[T]:
@@ -86,9 +87,20 @@ class Heap(Generic[T]):
         self._items[key] = entry
         heapq.heappush(self._heap, entry)
 
+    def _kill(self, entry: "_Entry[T]") -> None:
+        entry.alive = False
+        self._dead += 1
+        # Compact when dead entries dominate so repeated updates between
+        # pops can't grow the backing list unboundedly.
+        if self._dead > len(self._items) and self._dead > 64:
+            self._heap = [e for e in self._heap if e.alive]
+            heapq.heapify(self._heap)
+            self._dead = 0
+
     def _drop_dead(self) -> None:
         while self._heap and not self._heap[0].alive:
             heapq.heappop(self._heap)
+            self._dead -= 1
 
 
 class _Entry(Generic[T]):

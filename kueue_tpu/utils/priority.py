@@ -21,6 +21,11 @@ def priority_of(
     when the workload's priorityClassSource names the workload-priority
     domain (matches the reference's source-gated resolution; a pod
     PriorityClass of the same name must not override the copied value).
+    An empty source is deliberately treated as the workload-priority
+    domain: objects built directly against this API (no webhook
+    defaulting pass) reference a WorkloadPriorityClass by name alone;
+    callers importing pod-PriorityClass-derived priorities must set
+    source=POD_PRIORITY_CLASS_SOURCE to opt out of the override.
     """
     if (
         priority_classes

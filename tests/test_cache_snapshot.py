@@ -1,0 +1,255 @@
+"""Cache + snapshot behavior (pkg/cache parity) and np/JAX kernel parity."""
+
+import numpy as np
+
+from kueue_tpu.models import (
+    AdmissionCheck,
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    ResourceFlavor,
+    ResourceGroup,
+    Topology,
+    TopologyLevel,
+    Workload,
+)
+from kueue_tpu.models.constants import StopPolicy
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.snapshot import take_snapshot
+from kueue_tpu.core.workload_info import admission_usage, make_admission
+from kueue_tpu.resources import FlavorResource
+
+
+def build_cache():
+    cache = Cache()
+    cache.add_or_update_flavor(ResourceFlavor(name="on-demand"))
+    cache.add_or_update_flavor(ResourceFlavor(name="spot"))
+    cq_a = ClusterQueue(
+        name="cq-a",
+        cohort="team",
+        resource_groups=(
+            ResourceGroup(
+                ("cpu", "memory"),
+                (
+                    FlavorQuotas.build("on-demand", {"cpu": "10", "memory": "10Gi"}),
+                    FlavorQuotas.build("spot", {"cpu": "20", "memory": "20Gi"}),
+                ),
+            ),
+        ),
+    )
+    cq_b = ClusterQueue(
+        name="cq-b",
+        cohort="team",
+        resource_groups=(
+            ResourceGroup(
+                ("cpu", "memory"),
+                (FlavorQuotas.build("on-demand", {"cpu": "5", "memory": "5Gi"}),),
+            ),
+        ),
+    )
+    cache.add_or_update_cluster_queue(cq_a)
+    cache.add_or_update_cluster_queue(cq_b)
+    return cache
+
+
+def admitted_wl(name, cq, cpu_per_pod="1", count=2):
+    wl = Workload(
+        namespace="ns",
+        name=name,
+        queue_name="lq",
+        pod_sets=(PodSet.build("main", count, {"cpu": cpu_per_pod, "memory": "1Gi"}),),
+    )
+    wl.admission = make_admission(
+        cq, {"main": {"cpu": "on-demand", "memory": "on-demand"}}, wl
+    )
+    return wl
+
+
+def test_admission_usage_vector():
+    wl = admitted_wl("w", "cq-a")
+    usage = admission_usage(wl)
+    assert usage[FlavorResource("on-demand", "cpu")] == 2000
+    assert usage[FlavorResource("on-demand", "memory")] == 2 * 2**30
+
+
+def test_reclaimable_pods_discount_usage():
+    wl = admitted_wl("w", "cq-a", count=4)
+    wl.reclaimable_pods["main"] = 1
+    usage = admission_usage(wl)
+    assert usage[FlavorResource("on-demand", "cpu")] == 3000
+
+
+def test_cache_usage_tracking():
+    cache = build_cache()
+    wl = admitted_wl("w1", "cq-a")
+    assert cache.add_or_update_workload(wl)
+    assert cache.usage_for("cq-a")[FlavorResource("on-demand", "cpu")] == 2000
+    assert cache.delete_workload(wl)
+    assert cache.usage_for("cq-a")[FlavorResource("on-demand", "cpu")] == 0
+
+
+def test_assume_and_forget():
+    cache = build_cache()
+    wl = admitted_wl("w1", "cq-a")
+    assert cache.assume_workload(wl)
+    assert not cache.assume_workload(wl)  # double assume rejected
+    assert cache.usage_for("cq-a")[FlavorResource("on-demand", "cpu")] == 2000
+    assert cache.forget_workload(wl)
+    assert cache.usage_for("cq-a")[FlavorResource("on-demand", "cpu")] == 0
+    assert not cache.forget_workload(wl)
+
+
+def test_cq_status_reasons():
+    cache = Cache()
+    cq = ClusterQueue(
+        name="cq",
+        resource_groups=(
+            ResourceGroup(("cpu",), (FlavorQuotas.build("missing", {"cpu": "1"}),)),
+        ),
+        admission_checks=("nonexistent",),
+    )
+    cache.add_or_update_cluster_queue(cq)
+    st = cache.cluster_queue_status("cq")
+    assert not st.active
+    assert "FlavorNotFound" in st.reasons
+    assert "AdmissionCheckNotFound" in st.reasons
+    cache.add_or_update_flavor(ResourceFlavor(name="missing"))
+    cache.add_or_update_admission_check(
+        AdmissionCheck(name="nonexistent", controller_name="ctrl")
+    )
+    assert cache.cluster_queue_status("cq").active
+
+
+def test_cq_status_tas_misconfig():
+    cache = Cache()
+    cache.add_or_update_flavor(ResourceFlavor(name="tpu", topology_name="default"))
+    cq = ClusterQueue(
+        name="cq",
+        resource_groups=(
+            ResourceGroup(("cpu",), (FlavorQuotas.build("tpu", {"cpu": "1"}),)),
+        ),
+    )
+    cache.add_or_update_cluster_queue(cq)
+    assert "TopologyNotFound" in cache.cluster_queue_status("cq").reasons
+    cache.add_or_update_topology(
+        Topology(name="default", levels=(TopologyLevel("rack"), TopologyLevel("host")))
+    )
+    assert cache.cluster_queue_status("cq").active
+
+
+def test_stopped_cq_inactive():
+    cache = build_cache()
+    model = cache.cluster_queues["cq-a"].model
+    import dataclasses
+
+    stopped = dataclasses.replace(model, stop_policy=StopPolicy.HOLD)
+    cache.add_or_update_cluster_queue(stopped)
+    assert "Stopped" in cache.cluster_queue_status("cq-a").reasons
+    snap = take_snapshot(cache)
+    assert "cq-a" in snap.inactive_cqs
+    assert "cq-b" in snap.flat.cq_names
+
+
+def test_snapshot_quota_and_fits():
+    cache = build_cache()
+    cache.add_or_update_workload(admitted_wl("w1", "cq-a", count=8))  # 8 cpu
+    snap = take_snapshot(cache)
+    od_cpu = snap.fr_index[FlavorResource("on-demand", "cpu")]
+    # cohort subtree: 10+20 (cq-a) + 5 (cq-b) = 35 cpu across flavors;
+    # on-demand cpu cell: 10 + 5 = 15
+    team_row = snap.flat.index["team"]
+    assert snap.subtree[team_row, od_cpu] == 15_000
+    # cq-b can use on-demand cpu: 15 - 8 used = 7
+    assert snap.available_for("cq-b")[od_cpu] == 7_000
+    vec = np.zeros(len(snap.fr_list), dtype=np.int64)
+    vec[od_cpu] = 7_000
+    assert snap.fits("cq-b", vec)
+    vec[od_cpu] = 7_001
+    assert not snap.fits("cq-b", vec)
+
+
+def test_snapshot_simulate_remove_workload():
+    cache = build_cache()
+    cache.add_or_update_workload(admitted_wl("w1", "cq-a", count=8))
+    snap = take_snapshot(cache)
+    od_cpu = snap.fr_index[FlavorResource("on-demand", "cpu")]
+    ws = snap.remove_workload("ns/w1")
+    assert ws is not None
+    assert snap.available_for("cq-b")[od_cpu] == 15_000
+    snap.add_workload(ws)  # undo
+    assert snap.available_for("cq-b")[od_cpu] == 7_000
+
+
+def test_snapshot_cohort_members():
+    cache = build_cache()
+    lone = ClusterQueue(
+        name="lone",
+        resource_groups=(
+            ResourceGroup(("cpu",), (FlavorQuotas.build("on-demand", {"cpu": "1"}),)),
+        ),
+    )
+    cache.add_or_update_cluster_queue(lone)
+    snap = take_snapshot(cache)
+    assert snap.cohort_members("cq-a") == {"cq-a", "cq-b"}
+    assert snap.cohort_members("lone") == {"lone"}
+    assert not snap.has_cohort("lone")
+
+
+def test_np_jax_kernel_parity():
+    """The host-side numpy mirrors must agree with the jit kernels."""
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.ops import quota as qj
+    from kueue_tpu.ops import quota_np as qn
+
+    rng = np.random.default_rng(7)
+    cache = build_cache()
+    cache.add_or_update_cohort(Cohort(name="team", parent="org"))
+    cache.add_or_update_cohort(
+        Cohort(
+            name="org",
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu",), (FlavorQuotas.build("on-demand", {"cpu": "100"}),)
+                ),
+            ),
+        )
+    )
+    snap = take_snapshot(cache)
+    n, fr = snap.local_usage.shape
+    local = rng.integers(0, 30_000, size=(n, fr)).astype(np.int64)
+    local[snap.flat.n_cq :] = 0
+    lm = snap.flat.level_masks()
+
+    st_np, g_np = qn.subtree_quota_np(snap.flat.parent, lm, snap.nominal, snap.lending_limit)
+    u_np = qn.usage_tree_np(snap.flat.parent, lm, g_np, local)
+    a_np = qn.available_all_np(snap.flat.parent, lm, st_np, g_np, snap.borrowing_limit, u_np)
+
+    tree = qj.QuotaTree(
+        parent=jnp.asarray(snap.flat.parent),
+        level_mask=jnp.asarray(lm),
+        nominal=jnp.asarray(snap.nominal),
+        lending_limit=jnp.asarray(snap.lending_limit),
+        borrowing_limit=jnp.asarray(snap.borrowing_limit),
+    )
+    st_j, g_j = qj.subtree_quota(tree)
+    u_j = qj.usage_tree(tree, g_j, jnp.asarray(local))
+    a_j = qj.available_all(tree, st_j, g_j, u_j)
+
+    np.testing.assert_array_equal(st_np, np.asarray(st_j))
+    np.testing.assert_array_equal(g_np, np.asarray(g_j))
+    np.testing.assert_array_equal(u_np, np.asarray(u_j))
+    np.testing.assert_array_equal(a_np, np.asarray(a_j))
+
+    wl_req = rng.integers(0, 10_000, size=(n, fr)).astype(np.int64)
+    weight = np.where(rng.random(n) < 0.2, 0, 1000).astype(np.int64)
+    d_np, dom_np = qn.dominant_resource_share_np(
+        snap.flat.parent, lm, st_np, g_np, snap.borrowing_limit, u_np,
+        wl_req, weight, snap.resource_index, len(snap.resource_names),
+    )
+    d_j, dom_j = qj.dominant_resource_share(
+        tree, st_j, g_j, u_j, jnp.asarray(wl_req), jnp.asarray(weight),
+        jnp.asarray(snap.resource_index), len(snap.resource_names),
+    )
+    np.testing.assert_array_equal(d_np, np.asarray(d_j))
+    np.testing.assert_array_equal(dom_np, np.asarray(dom_j))

@@ -1,0 +1,183 @@
+"""Queue manager semantics (pkg/queue parity)."""
+
+from kueue_tpu.models import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    QueueingStrategy,
+    ResourceGroup,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_tpu.models.constants import StopPolicy
+from kueue_tpu.models.workload import RequeueState
+from kueue_tpu.core.queue_manager import (
+    QueueManager,
+    RequeueReason,
+    RequeueTimestamp,
+    queue_order_timestamp,
+)
+from kueue_tpu.utils.clock import FakeClock
+
+
+def make_cq(name, cohort=None, strategy=QueueingStrategy.BEST_EFFORT_FIFO):
+    rg = ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": "10"}),))
+    return ClusterQueue(
+        name=name, resource_groups=(rg,), cohort=cohort, queueing_strategy=strategy
+    )
+
+
+def make_mgr(*cqs):
+    clock = FakeClock(start=1000.0)
+    mgr = QueueManager(clock=clock)
+    for cq in cqs:
+        mgr.add_cluster_queue(cq)
+        mgr.add_local_queue(
+            LocalQueue(namespace="ns", name=f"lq-{cq.name}", cluster_queue=cq.name)
+        )
+    return mgr, clock
+
+
+def wl(name, queue="lq-cq", prio=0, t=0.0):
+    return Workload(
+        namespace="ns", name=name, queue_name=queue, priority=prio, creation_time=t
+    )
+
+
+def test_heads_priority_then_fifo():
+    mgr, _ = make_mgr(make_cq("cq"))
+    mgr.add_or_update_workload(wl("low", prio=1, t=1))
+    mgr.add_or_update_workload(wl("high", prio=10, t=5))
+    mgr.add_or_update_workload(wl("mid", prio=5, t=2))
+    heads = mgr.heads()
+    assert [w.name for w in heads] == ["high"]
+    assert [w.name for w in mgr.heads()] == ["mid"]
+    assert [w.name for w in mgr.heads()] == ["low"]
+    assert mgr.heads() == []
+
+
+def test_heads_across_cluster_queues():
+    mgr, _ = make_mgr(make_cq("cq-a"), make_cq("cq-b"))
+    mgr.add_or_update_workload(wl("a1", queue="lq-cq-a"))
+    mgr.add_or_update_workload(wl("b1", queue="lq-cq-b"))
+    heads = mgr.heads()
+    assert sorted(w.name for w in heads) == ["a1", "b1"]
+
+
+def test_besteffort_generic_requeue_parks():
+    mgr, _ = make_mgr(make_cq("cq"))
+    mgr.add_or_update_workload(wl("w1"))
+    [head] = mgr.heads()
+    assert mgr.requeue_workload(head, RequeueReason.GENERIC)
+    pending = mgr.cluster_queues["cq"]
+    assert pending.pending_inadmissible() == 1
+    assert pending.pending_active() == 0
+    # cohort-wide event reactivates it
+    mgr.queue_associated_inadmissible_workloads_after("cq")
+    assert pending.pending_active() == 1
+    assert pending.pending_inadmissible() == 0
+
+
+def test_strictfifo_generic_requeue_goes_back_to_heap():
+    mgr, _ = make_mgr(make_cq("cq", strategy=QueueingStrategy.STRICT_FIFO))
+    mgr.add_or_update_workload(wl("w1"))
+    [head] = mgr.heads()
+    assert mgr.requeue_workload(head, RequeueReason.GENERIC)
+    assert mgr.cluster_queues["cq"].pending_active() == 1
+
+
+def test_failed_after_nomination_immediate():
+    mgr, _ = make_mgr(make_cq("cq"))
+    mgr.add_or_update_workload(wl("w1"))
+    [head] = mgr.heads()
+    assert mgr.requeue_workload(head, RequeueReason.FAILED_AFTER_NOMINATION)
+    assert mgr.cluster_queues["cq"].pending_active() == 1
+
+
+def test_queue_inadmissible_cycle_race():
+    """A cohort-wide reactivation between Pop and requeue must push the
+    workload back to the heap instead of parking it (popCycle race)."""
+    mgr, _ = make_mgr(make_cq("cq"))
+    mgr.add_or_update_workload(wl("w1"))
+    [head] = mgr.heads()
+    # another controller frees capacity while w1 is inflight:
+    mgr.queue_associated_inadmissible_workloads_after("cq")
+    assert mgr.requeue_workload(head, RequeueReason.GENERIC)
+    assert mgr.cluster_queues["cq"].pending_active() == 1
+    assert mgr.cluster_queues["cq"].pending_inadmissible() == 0
+
+
+def test_cohort_wide_reactivation():
+    mgr, _ = make_mgr(make_cq("cq-a", cohort="team"), make_cq("cq-b", cohort="team"))
+    mgr.add_or_update_workload(wl("a1", queue="lq-cq-a"))
+    for h in mgr.heads():
+        mgr.requeue_workload(h, RequeueReason.GENERIC)
+    assert mgr.cluster_queues["cq-a"].pending_inadmissible() == 1
+    # freeing capacity in cq-b reactivates cq-a's parked workload
+    mgr.queue_associated_inadmissible_workloads_after("cq-b")
+    assert mgr.cluster_queues["cq-a"].pending_active() == 1
+
+
+def test_backoff_gating():
+    mgr, clock = make_mgr(make_cq("cq"))
+    w = wl("w1")
+    w.requeue_state = RequeueState(count=1, requeue_at=clock.now() + 60)
+    mgr.add_or_update_workload(w)
+    pending = mgr.cluster_queues["cq"]
+    # backoff not expired -> parked
+    assert pending.pending_inadmissible() == 1
+    mgr.queue_associated_inadmissible_workloads_after("cq")
+    assert pending.pending_inadmissible() == 1  # still parked
+    clock.advance(61)
+    mgr.queue_associated_inadmissible_workloads_after("cq")
+    assert pending.pending_active() == 1
+
+
+def test_requeued_condition_false_blocks():
+    mgr, _ = make_mgr(make_cq("cq"))
+    w = wl("w1")
+    w.set_condition(WorkloadConditionType.REQUEUED, False, reason="PodsReadyTimeout")
+    mgr.add_or_update_workload(w)
+    assert mgr.cluster_queues["cq"].pending_inadmissible() == 1
+
+
+def test_eviction_timestamp_ordering():
+    w1 = wl("older", t=10.0)
+    w2 = wl("evicted-newer", t=5.0)
+    w2.set_condition(
+        WorkloadConditionType.EVICTED, True, reason="Preempted", now=50.0
+    )
+    assert queue_order_timestamp(w1, RequeueTimestamp.EVICTION) == 10.0
+    assert queue_order_timestamp(w2, RequeueTimestamp.EVICTION) == 50.0
+    assert queue_order_timestamp(w2, RequeueTimestamp.CREATION) == 5.0
+
+
+def test_stopped_local_queue_blocks_submission():
+    mgr, _ = make_mgr(make_cq("cq"))
+    mgr.add_local_queue(
+        LocalQueue(
+            namespace="ns", name="stopped", cluster_queue="cq",
+            stop_policy=StopPolicy.HOLD,
+        )
+    )
+    assert not mgr.add_or_update_workload(wl("w1", queue="stopped"))
+
+
+def test_delete_workload():
+    mgr, _ = make_mgr(make_cq("cq"))
+    w = wl("w1")
+    mgr.add_or_update_workload(w)
+    mgr.delete_workload(w)
+    assert mgr.heads() == []
+
+
+def test_adoption_on_late_cq_add():
+    """LocalQueue + workloads exist before the CQ (manager.go:173-199)."""
+    clock = FakeClock()
+    mgr = QueueManager(clock=clock)
+    mgr.add_local_queue(
+        LocalQueue(namespace="ns", name="lq-cq", cluster_queue="cq"),
+        workloads=[wl("early")],
+    )
+    mgr.add_cluster_queue(make_cq("cq"))
+    assert [w.name for w in mgr.heads()] == ["early"]
