@@ -57,6 +57,8 @@ class Cache:
         self.local_queues: Dict[str, LocalQueue] = {}
         self.forest = CohortForest()
         self.assumed_workloads: Dict[str, str] = {}  # wl key -> cq name
+        # reverse index: which CQ currently tracks each workload
+        self._wl_cq: Dict[str, str] = {}
         # workloads admitted but whose pods aren't ready yet
         # (WaitForPodsReady blockAdmission support, cache.go:160-205)
         self.workloads_not_ready: Set[str] = set()
@@ -172,16 +174,29 @@ class Cache:
         if cached is None:
             return False
         self._forget_if_assumed(wl.key)
+        # If the workload was tracked under a different CQ (admission
+        # moved, coalesced events), release the old tracking first so
+        # its usage doesn't leak (reference UpdateWorkload(old, new)).
+        prev_cq = self._wl_cq.get(wl.key)
+        if prev_cq is not None and prev_cq != wl.admission.cluster_queue:
+            prev = self.cluster_queues.get(prev_cq)
+            if prev is not None:
+                old = prev.workloads.pop(wl.key, None)
+                if old is not None:
+                    self._apply_usage(prev, admission_usage(old), -1)
         old = cached.workloads.get(wl.key)
         if old is not None:
             self._apply_usage(cached, admission_usage(old), -1)
         cached.workloads[wl.key] = wl
         self._apply_usage(cached, admission_usage(wl), +1)
+        self._wl_cq[wl.key] = wl.admission.cluster_queue
         return True
 
     def delete_workload(self, wl: Workload) -> bool:
-        cq_name = self.assumed_workloads.get(wl.key) or (
-            wl.admission.cluster_queue if wl.admission else None
+        cq_name = (
+            self._wl_cq.get(wl.key)
+            or self.assumed_workloads.get(wl.key)
+            or (wl.admission.cluster_queue if wl.admission else None)
         )
         if cq_name is None:
             return False
@@ -192,6 +207,7 @@ class Cache:
         if tracked is not None:
             self._apply_usage(cached, admission_usage(tracked), -1)
         self.assumed_workloads.pop(wl.key, None)
+        self._wl_cq.pop(wl.key, None)
         self.workloads_not_ready.discard(wl.key)
         return tracked is not None
 
@@ -207,6 +223,7 @@ class Cache:
         cached.workloads[wl.key] = wl
         self._apply_usage(cached, admission_usage(wl), +1)
         self.assumed_workloads[wl.key] = wl.admission.cluster_queue
+        self._wl_cq[wl.key] = wl.admission.cluster_queue
         return True
 
     def forget_workload(self, wl: Workload) -> bool:
@@ -220,6 +237,7 @@ class Cache:
         tracked = cached.workloads.pop(wl.key, None)
         if tracked is not None:
             self._apply_usage(cached, admission_usage(tracked), -1)
+        self._wl_cq.pop(wl.key, None)
         return True
 
     def _forget_if_assumed(self, key: str) -> None:

@@ -1,0 +1,31 @@
+"""Fair-sharing admission ordering.
+
+Equivalent of ``pkg/scheduler/fair_sharing_iterator.go``: when fair
+sharing is enabled, entries are ordered by the DominantResourceShare
+their ClusterQueue would have *after* admitting them, so capacity flows
+to the least-served tenant first. Ties fall back to the classical key
+(non-borrowing first, priority, FIFO).
+
+The snapshot's usage doesn't change while ordering (admission happens
+afterwards, with per-entry fit re-checks), so each entry's key is
+computed exactly once and sorted — equivalent to the reference's
+tournament over an unchanged snapshot without the O(n^2) re-evaluation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+from kueue_tpu.core.snapshot import Snapshot
+
+
+def fair_sharing_order(entries: List, snapshot: Snapshot, base_key: Callable) -> List:
+    def key(e):
+        if e.cq_name in snapshot.cq_models and e.assignment is not None:
+            wl_vec = snapshot.vector_of(e.assignment.usage)
+            drs = snapshot.dominant_resource_share(e.cq_name, wl_vec)
+        else:
+            drs = 0
+        return (drs,) + tuple(base_key(e))
+
+    return sorted(entries, key=key)

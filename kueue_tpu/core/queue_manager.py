@@ -266,14 +266,15 @@ class QueueManager:
         if pending is None:
             self.add_cluster_queue(cq)
             return
-        old_strategy = pending.strategy
         pending.strategy = cq.queueing_strategy
         pending.namespace_selector = cq.namespace_selector
         pending.active = cq.stop_policy == StopPolicy.NONE
         self._cq_models[cq.name] = cq
         self.forest.update_cluster_queue(cq.name, cq.cohort)
-        if old_strategy != cq.queueing_strategy:
-            pending.queue_inadmissible(self.namespace_labels)
+        # Any spec change can make parked workloads admissible (new
+        # quota, selector, strategy) — reactivate them all, mirroring
+        # manager.UpdateClusterQueue's unconditional requeue.
+        pending.queue_inadmissible(self.namespace_labels)
         self._broadcast()
 
     def delete_cluster_queue(self, name: str) -> None:

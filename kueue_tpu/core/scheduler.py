@@ -1,0 +1,467 @@
+"""The admission scheduler — one cycle of the hot path.
+
+Behavioral equivalent of ``pkg/scheduler/scheduler.go``: pop the head of
+every ClusterQueue, snapshot the cache, nominate (validate + flavor
+assignment + preemption target search + partial-admission reduction),
+order entries (non-borrowing first, then priority, then FIFO — or the
+fair-sharing tournament), then admit one-by-one with usage re-checks so
+parallel nominations can't double-book quota; leftovers are requeued
+with the right reason and a Pending status.
+
+The flavor assignment and quota math run over the dense Snapshot; the
+batched solver (ops/assign_kernel.py) accelerates nomination for large
+head counts while this driver remains the decision authority.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kueue_tpu.models import Workload
+from kueue_tpu.models.constants import (
+    ReclaimWithinCohortPolicy,
+    WorkloadConditionType,
+)
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.flavor_assigner import (
+    AssignmentResult,
+    FlavorAssigner,
+    Mode,
+    find_max_counts,
+)
+from kueue_tpu.core.queue_manager import QueueManager, RequeueReason, queue_order_timestamp
+from kueue_tpu.core.snapshot import Snapshot, WorkloadSnapshot, take_snapshot
+from kueue_tpu.core.workload_info import total_requests
+from kueue_tpu.utils.clock import Clock
+from kueue_tpu.utils.priority import priority_of
+
+
+class EntryStatus(str, Enum):
+    NOT_NOMINATED = ""
+    NOMINATED = "nominated"
+    SKIPPED = "skipped"
+    ASSUMED = "assumed"
+
+
+@dataclass
+class PreemptionTarget:
+    workload: WorkloadSnapshot
+    reason: str = "InClusterQueue"
+
+
+@dataclass
+class Entry:
+    workload: Workload
+    cq_name: str
+    assignment: Optional[AssignmentResult] = None
+    status: EntryStatus = EntryStatus.NOT_NOMINATED
+    inadmissible_msg: str = ""
+    requeue_reason: RequeueReason = RequeueReason.GENERIC
+    preemption_targets: List[PreemptionTarget] = field(default_factory=list)
+    counts: Optional[List[int]] = None
+
+
+class Preemptor:
+    """Interface the scheduler drives; ops implementation in
+    core/preemption.py (classic + fair sharing)."""
+
+    def get_targets(
+        self, wl: Workload, cq_name: str, assignment: AssignmentResult, snapshot: Snapshot
+    ) -> List[PreemptionTarget]:
+        return []
+
+    def issue_preemptions(
+        self, preemptor: Workload, targets: List[PreemptionTarget]
+    ) -> int:
+        return 0
+
+    def is_reclaim_possible(
+        self, snapshot: Snapshot, cq_name: str, wl: Workload, fr, quantity: int
+    ) -> bool:
+        return False
+
+
+@dataclass
+class CycleResult:
+    admitted: List[Entry] = field(default_factory=list)
+    preempting: List[Entry] = field(default_factory=list)
+    requeued: List[Entry] = field(default_factory=list)
+    skipped_preemptions: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def success(self) -> bool:
+        return bool(self.admitted)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        queues: QueueManager,
+        cache: Cache,
+        clock: Clock,
+        preemptor: Optional[Preemptor] = None,
+        fair_sharing: bool = False,
+        partial_admission: bool = True,
+        apply_admission: Optional[Callable[[Workload], bool]] = None,
+        wait_for_pods_ready_block: bool = False,
+        tas_check=None,
+        tas_assign=None,
+        events: Optional[Callable[[str, Workload, str], None]] = None,
+        limit_range_validate: Optional[Callable[[Workload], Optional[str]]] = None,
+    ):
+        self.queues = queues
+        self.cache = cache
+        self.clock = clock
+        self.preemptor = preemptor or Preemptor()
+        self.fair_sharing = fair_sharing
+        self.partial_admission = partial_admission
+        # durable-write hook; returning False simulates API failure
+        self.apply_admission = apply_admission or (lambda wl: True)
+        self.wait_for_pods_ready_block = wait_for_pods_ready_block
+        self.tas_check = tas_check
+        self.tas_assign = tas_assign
+        self.events = events or (lambda kind, wl, msg: None)
+        self.limit_range_validate = limit_range_validate
+        self.scheduling_cycle = 0
+
+    # ---- the cycle (scheduler.go:176-310) ----
+    def schedule(self) -> CycleResult:
+        self.scheduling_cycle += 1
+        result = CycleResult()
+
+        heads = self.queues.heads()
+        if not heads:
+            return result
+
+        snapshot = take_snapshot(self.cache)
+        entries = self._nominate(heads, snapshot)
+        ordered = self._iterate(entries, snapshot)
+
+        preempted_keys: Dict[str, WorkloadSnapshot] = {}
+        for e in ordered:
+            if e.assignment is None:
+                continue
+            mode = e.assignment.representative_mode()
+            if mode == Mode.NO_FIT:
+                continue
+
+            if mode == Mode.PREEMPT and not e.preemption_targets:
+                # Nobody to preempt. Reserve capacity unless reclaim is
+                # always possible later (scheduler.go:228-242).
+                cq = snapshot.cq_models[e.cq_name]
+                if cq.preemption.reclaim_within_cohort != ReclaimWithinCohortPolicy.ANY:
+                    snapshot.add_usage(
+                        e.cq_name, self._reserve_vector(e, snapshot)
+                    )
+                continue
+
+            if any(
+                t.workload.workload.key in preempted_keys
+                for t in e.preemption_targets
+            ):
+                e.status = EntryStatus.SKIPPED
+                e.inadmissible_msg = (
+                    "Workload has overlapping preemption targets with another workload"
+                )
+                result.skipped_preemptions[e.cq_name] = (
+                    result.skipped_preemptions.get(e.cq_name, 0) + 1
+                )
+                continue
+
+            usage_vec = snapshot.vector_of(e.assignment.usage)
+            if not self._fits_after_removals(
+                snapshot, e, usage_vec, preempted_keys
+            ):
+                e.status = EntryStatus.SKIPPED
+                e.inadmissible_msg = (
+                    "Workload no longer fits after processing another workload"
+                )
+                if mode == Mode.PREEMPT:
+                    result.skipped_preemptions[e.cq_name] = (
+                        result.skipped_preemptions.get(e.cq_name, 0) + 1
+                    )
+                continue
+
+            for t in e.preemption_targets:
+                preempted_keys[t.workload.workload.key] = t.workload
+            snapshot.add_usage(e.cq_name, usage_vec)
+
+            if mode == Mode.PREEMPT:
+                e.workload.last_assignment = None
+                n = self.preemptor.issue_preemptions(e.workload, e.preemption_targets)
+                if n:
+                    e.inadmissible_msg += (
+                        f". Pending the preemption of {n} workload(s)"
+                    )
+                    e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+                result.preempting.append(e)
+                continue
+
+            if self.wait_for_pods_ready_block and self.cache.workloads_not_ready:
+                e.status = EntryStatus.SKIPPED
+                e.inadmissible_msg = (
+                    "waiting for all admitted workloads to be in PodsReady condition"
+                )
+                continue
+
+            e.status = EntryStatus.NOMINATED
+            if self._admit(e, snapshot):
+                result.admitted.append(e)
+
+        for e in entries:
+            if e.status != EntryStatus.ASSUMED:
+                self._requeue_and_update(e)
+                result.requeued.append(e)
+        return result
+
+    # ---- nomination (scheduler.go:344-378) ----
+    def _nominate(self, heads: List[Workload], snapshot: Snapshot) -> List[Entry]:
+        entries: List[Entry] = []
+        flavors = self.cache.flavors
+        assigner = FlavorAssigner(
+            snapshot,
+            flavors,
+            enable_fair_sharing=self.fair_sharing,
+            reclaim_oracle=functools.partial(self._reclaim_oracle, snapshot),
+            tas_check=self.tas_check,
+        )
+        for wl in heads:
+            cq_name = self.queues.cluster_queue_for_workload(wl) or ""
+            e = Entry(workload=wl, cq_name=cq_name)
+            entries.append(e)
+            if wl.key in self.cache.assumed_workloads or self._is_admitted(wl):
+                entries.pop()  # already assumed/admitted: drop silently
+                continue
+            if wl.has_retry_check() or wl.has_rejected_check():
+                e.inadmissible_msg = "The workload has failed admission checks"
+                continue
+            if cq_name in snapshot.inactive_cqs:
+                e.inadmissible_msg = f"ClusterQueue {cq_name} is inactive"
+                continue
+            if cq_name not in snapshot.cq_models:
+                e.inadmissible_msg = f"ClusterQueue {cq_name} not found"
+                continue
+            cq = snapshot.cq_models[cq_name]
+            ns_labels = self.queues.namespace_labels(wl.namespace)
+            if not cq.selects_namespace(ns_labels):
+                e.inadmissible_msg = (
+                    "Workload namespace doesn't match ClusterQueue selector"
+                )
+                e.requeue_reason = RequeueReason.NAMESPACE_MISMATCH
+                continue
+            if self.limit_range_validate is not None:
+                err = self.limit_range_validate(wl)
+                if err:
+                    e.inadmissible_msg = err
+                    continue
+            assignment, targets, counts = self._get_assignments(
+                assigner, wl, cq_name, snapshot
+            )
+            e.assignment = assignment
+            e.preemption_targets = targets
+            e.counts = counts
+            e.inadmissible_msg = assignment.message()
+            wl.last_assignment = assignment.last_state
+        return entries
+
+    def _is_admitted(self, wl: Workload) -> bool:
+        cached = self.cache.cluster_queues.get(
+            wl.admission.cluster_queue if wl.admission else ""
+        )
+        return cached is not None and wl.key in cached.workloads
+
+    def _reclaim_oracle(self, snapshot: Snapshot, cq_name: str, fr, quantity: int) -> bool:
+        return self.preemptor.is_reclaim_possible(snapshot, cq_name, None, fr, quantity)
+
+    # ---- assignment + preemption + partial admission (scheduler.go:423-468) ----
+    def _get_assignments(
+        self,
+        assigner: FlavorAssigner,
+        wl: Workload,
+        cq_name: str,
+        snapshot: Snapshot,
+    ) -> Tuple[AssignmentResult, List[PreemptionTarget], Optional[List[int]]]:
+        full = assigner.assign(wl, cq_name)
+        mode = full.representative_mode()
+        if mode == Mode.FIT:
+            full = self._with_tas(wl, cq_name, full, snapshot)
+            return full, [], None
+        if mode == Mode.PREEMPT:
+            targets = self.preemptor.get_targets(wl, cq_name, full, snapshot)
+            if targets:
+                return full, targets, None
+        if self.partial_admission and any(
+            ps.min_count is not None for ps in wl.pod_sets
+        ):
+            best: Optional[Tuple[AssignmentResult, List[PreemptionTarget], List[int]]] = None
+
+            def try_counts(counts: Sequence[int]) -> AssignmentResult:
+                nonlocal best
+                a = assigner.assign(wl, cq_name, counts=counts)
+                if a.representative_mode() == Mode.FIT:
+                    best = (a, [], list(counts))
+                return a
+
+            found = find_max_counts(try_counts, wl)
+            if found is not None and best is not None:
+                a, t, c = best
+                a = self._with_tas(wl, cq_name, a, snapshot)
+                return a, t, c
+        return full, [], None
+
+    def _with_tas(
+        self, wl: Workload, cq_name: str, assignment: AssignmentResult, snapshot: Snapshot
+    ) -> AssignmentResult:
+        if self.tas_assign is not None:
+            return self.tas_assign(wl, cq_name, assignment, snapshot)
+        return assignment
+
+    # ---- ordering (scheduler.go:561-642) ----
+    def _iterate(self, entries: List[Entry], snapshot: Snapshot) -> List[Entry]:
+        if self.fair_sharing:
+            from kueue_tpu.core.fair_sharing_iterator import fair_sharing_order
+
+            return fair_sharing_order(entries, snapshot, self._entry_sort_key)
+        return sorted(entries, key=self._entry_sort_key)
+
+    def _entry_sort_key(self, e: Entry):
+        borrows = e.assignment.borrowing if e.assignment else False
+        prio = priority_of(e.workload, self.queues.priority_classes)
+        ts = queue_order_timestamp(e.workload, self.queues._ts_policy)
+        return (1 if borrows else 0, -prio, ts)
+
+    # ---- usage re-check (scheduler.go:380-388) ----
+    def _fits_after_removals(
+        self,
+        snapshot: Snapshot,
+        e: Entry,
+        usage_vec: np.ndarray,
+        preempted: Dict[str, WorkloadSnapshot],
+    ) -> bool:
+        removed: List[WorkloadSnapshot] = []
+        for ws in list(preempted.values()):
+            if snapshot.remove_workload(ws.workload.key) is not None:
+                removed.append(ws)
+        for t in e.preemption_targets:
+            ws = snapshot.remove_workload(t.workload.workload.key)
+            if ws is not None:
+                removed.append(ws)
+        ok = snapshot.fits(e.cq_name, usage_vec)
+        for ws in removed:
+            snapshot.add_workload(ws)
+        return ok
+
+    # ---- capacity reservation on blocked preemption (scheduler.go:391-416) ----
+    def _reserve_vector(self, e: Entry, snapshot: Snapshot) -> np.ndarray:
+        usage_vec = snapshot.vector_of(e.assignment.usage)
+        r = snapshot.row(e.cq_name)
+        if e.assignment.representative_mode() != Mode.PREEMPT:
+            return usage_vec
+        reserved = np.zeros_like(usage_vec)
+        from kueue_tpu.ops.quota import NO_LIMIT
+
+        for j in range(len(usage_vec)):
+            u = int(usage_vec[j])
+            if u == 0:
+                continue
+            if e.assignment.borrowing:
+                bl = int(snapshot.borrowing_limit[r, j])
+                if bl >= NO_LIMIT:
+                    reserved[j] = u
+                else:
+                    reserved[j] = min(
+                        u,
+                        int(snapshot.nominal[r, j]) + bl - int(snapshot.local_usage[r, j]),
+                    )
+            else:
+                reserved[j] = max(
+                    0, min(u, int(snapshot.nominal[r, j]) - int(snapshot.local_usage[r, j]))
+                )
+        return reserved
+
+    # ---- admission (scheduler.go:498-555) ----
+    def _admit(self, e: Entry, snapshot: Snapshot) -> bool:
+        wl = e.workload
+        now = self.clock.now()
+        admission = e.assignment.to_admission(e.cq_name, wl)
+        wl.admission = admission
+        wl.set_condition(
+            WorkloadConditionType.QUOTA_RESERVED, True, reason="QuotaReserved", now=now
+        )
+        # initialize admission-check states for checks applying to the
+        # assigned flavors (two-phase admission)
+        cq = snapshot.cq_models[e.cq_name]
+        flavors_used = {
+            c.name for ps in e.assignment.pod_sets for c in ps.flavors.values()
+        }
+        from kueue_tpu.models.admission_check import AdmissionCheckState
+
+        required = self.cache.admission_checks_for_workload(cq, flavors_used)
+        for name in required:
+            if name not in wl.admission_check_states:
+                wl.admission_check_states[name] = AdmissionCheckState(name=name)
+        if wl.all_checks_ready(required):
+            wl.set_condition(
+                WorkloadConditionType.ADMITTED, True, reason="Admitted", now=now
+            )
+
+        if not self.cache.assume_workload(wl):
+            e.inadmissible_msg = "Failed to assume workload"
+            self._rollback_admission(wl, e.inadmissible_msg)
+            return False
+        e.status = EntryStatus.ASSUMED
+        # Workload leaves the pending queue: drop the flavor cursor so a
+        # later eviction restarts the search from the first flavor.
+        wl.last_assignment = None
+
+        ok = self.apply_admission(wl)
+        if not ok:
+            self.cache.forget_workload(wl)
+            e.inadmissible_msg = "Failed to admit workload: durable write failed"
+            self._rollback_admission(wl, e.inadmissible_msg)
+            e.status = EntryStatus.NOMINATED
+            self._requeue_and_update(e)
+            return False
+        self.events(
+            "QuotaReserved", wl, f"Quota reserved in ClusterQueue {e.cq_name}"
+        )
+        if wl.is_admitted:
+            self.events("Admitted", wl, f"Admitted by ClusterQueue {e.cq_name}")
+        return True
+
+    def _rollback_admission(self, wl: Workload, msg: str) -> None:
+        """Undo the optimistic condition writes of a failed admission
+        (reference: UnsetQuotaReservationWithCondition on this path)."""
+        wl.admission = None
+        now = self.clock.now()
+        wl.set_condition(
+            WorkloadConditionType.QUOTA_RESERVED, False, reason="Pending",
+            message=msg, now=now,
+        )
+        if wl.conditions.get(WorkloadConditionType.ADMITTED) is not None:
+            wl.set_condition(
+                WorkloadConditionType.ADMITTED, False, reason="NoReservation", now=now
+            )
+
+    # ---- requeue path (scheduler.go:644-665) ----
+    def _requeue_and_update(self, e: Entry) -> None:
+        if (
+            e.status != EntryStatus.NOT_NOMINATED
+            and e.requeue_reason == RequeueReason.GENERIC
+        ):
+            e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
+        self.queues.requeue_workload(e.workload, e.requeue_reason)
+        if e.status in (EntryStatus.NOT_NOMINATED, EntryStatus.SKIPPED):
+            e.workload.set_condition(
+                WorkloadConditionType.QUOTA_RESERVED,
+                False,
+                reason="Pending",
+                message=e.inadmissible_msg,
+                now=self.clock.now(),
+            )
+            self.events("Pending", e.workload, e.inadmissible_msg)

@@ -68,6 +68,8 @@ class Snapshot:
     cq_models: Dict[str, ClusterQueue]
     workloads: Dict[str, WorkloadSnapshot] = field(default_factory=dict)
     inactive_cqs: Tuple[str, ...] = ()
+    # AllocatableResourceGeneration per CQ (invalidates LastAssignment)
+    generations: Dict[str, int] = field(default_factory=dict)
 
     # ---- derived state ----
     def usage(self) -> np.ndarray:
@@ -285,6 +287,10 @@ def take_snapshot(cache: Cache) -> Snapshot:
         weight_milli=weight,
         cq_models=cq_models,
         inactive_cqs=tuple(inactive),
+        generations={
+            name: cache.cluster_queues[name].allocatable_generation
+            for name in flat.cq_names
+        },
     )
 
     from kueue_tpu.models.constants import WorkloadConditionType

@@ -71,6 +71,10 @@ class ResourceGroup:
     flavors: Tuple[FlavorQuotas, ...]
 
     def __post_init__(self):
+        if not self.flavors:
+            raise ValueError("ResourceGroup requires at least one flavor")
+        if not self.covered_resources:
+            raise ValueError("ResourceGroup requires coveredResources")
         cov = set(self.covered_resources)
         for fq in self.flavors:
             if set(fq.resources) != cov:

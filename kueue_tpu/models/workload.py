@@ -139,6 +139,9 @@ class Workload:
     reclaimable_pods: Dict[str, int] = field(default_factory=dict)
     # bookkeeping mirrored from the scheduler (LastAssignment analog)
     scheduling_stats_evictions: List[str] = field(default_factory=list)
+    # In-memory flavor-assignment resume state (never serialized):
+    # reference keeps this on queue workload.Info as LastAssignment.
+    last_assignment: Optional[object] = None
 
     def __post_init__(self):
         if not (self.namespace and self.name):

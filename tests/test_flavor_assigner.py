@@ -1,0 +1,323 @@
+"""Flavor assigner semantics (pkg/scheduler/flavorassigner parity)."""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.models import (
+    ClusterQueue,
+    FlavorFungibility,
+    FlavorQuotas,
+    Preemption,
+    ResourceFlavor,
+    ResourceGroup,
+    Taint,
+    Toleration,
+    Workload,
+)
+from kueue_tpu.models.constants import (
+    BorrowWithinCohortPolicy,
+    FlavorFungibilityPolicy,
+    PreemptionPolicy,
+)
+from kueue_tpu.models.cluster_queue import BorrowWithinCohort
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.flavor_assigner import (
+    FlavorAssigner,
+    GranularMode,
+    Mode,
+    find_max_counts,
+)
+from kueue_tpu.core.snapshot import take_snapshot
+from kueue_tpu.core.workload_info import make_admission
+from kueue_tpu.resources import FlavorResource
+
+
+def build(cq_specs, flavors=None, admitted=None):
+    """cq_specs: list of ClusterQueue; admitted: [(name, cq, flavor, cpu_total)]"""
+    cache = Cache()
+    for f in flavors or [ResourceFlavor(name="on-demand"), ResourceFlavor(name="spot")]:
+        cache.add_or_update_flavor(f)
+    for cq in cq_specs:
+        cache.add_or_update_cluster_queue(cq)
+    for name, cq_name, flavor, cpu in admitted or []:
+        wl = Workload(
+            namespace="ns", name=name, queue_name="lq",
+            pod_sets=(PodSet.build("main", 1, {"cpu": cpu}),),
+        )
+        wl.admission = make_admission(cq_name, {"main": {"cpu": flavor}}, wl)
+        cache.add_or_update_workload(wl)
+    snap = take_snapshot(cache)
+    return cache, snap
+
+
+def two_flavor_cq(name="cq", cohort=None, fungibility=None, preemption=None):
+    return ClusterQueue(
+        name=name,
+        cohort=cohort,
+        resource_groups=(
+            ResourceGroup(
+                ("cpu",),
+                (
+                    FlavorQuotas.build("on-demand", {"cpu": "4"}),
+                    FlavorQuotas.build("spot", {"cpu": "10"}),
+                ),
+            ),
+        ),
+        flavor_fungibility=fungibility or FlavorFungibility(),
+        preemption=preemption or Preemption(),
+    )
+
+
+def wl_cpu(name, cpu, count=1, **kw):
+    return Workload(
+        namespace="ns", name=name, queue_name="lq",
+        pod_sets=(PodSet.build("main", count, {"cpu": cpu}, **kw),),
+    )
+
+
+def flavors_dict(cache):
+    return cache.flavors
+
+
+def test_fit_first_flavor():
+    cache, snap = build([two_flavor_cq()])
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    res = a.assign(wl_cpu("w", "3"), "cq")
+    assert res.representative_mode() == Mode.FIT
+    assert res.pod_sets[0].flavors["cpu"].name == "on-demand"
+    assert res.usage[FlavorResource("on-demand", "cpu")] == 3000
+
+
+def test_falls_to_second_flavor_when_first_full():
+    cache, snap = build(
+        [two_flavor_cq()], admitted=[("used", "cq", "on-demand", "3")]
+    )
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    res = a.assign(wl_cpu("w", "2"), "cq")
+    assert res.representative_mode() == Mode.FIT
+    assert res.pod_sets[0].flavors["cpu"].name == "spot"
+
+
+def test_no_fit_exceeds_all():
+    cache, snap = build([two_flavor_cq()])
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    res = a.assign(wl_cpu("w", "11"), "cq")
+    assert res.representative_mode() == Mode.NO_FIT
+    assert "insufficient quota" in res.message()
+
+
+def test_preempt_mode_within_nominal():
+    # first flavor fully used by another workload; request fits nominal
+    cache, snap = build(
+        [two_flavor_cq()], admitted=[("used", "cq", "on-demand", "4")]
+    )
+    # make spot full too so no Fit anywhere
+    wl2 = wl_cpu("used2", "10")
+    wl2.admission = make_admission("cq", {"main": {"cpu": "spot"}}, wl2)
+    cache.add_or_update_workload(wl2)
+    snap = take_snapshot(cache)
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    res = a.assign(wl_cpu("w", "2"), "cq")
+    assert res.representative_mode() == Mode.PREEMPT
+    # whenCanPreempt=TryNextFlavor (default): both flavors attempted,
+    # best (first Preempt) kept
+    assert res.pod_sets[0].flavors["cpu"].name == "on-demand"
+
+
+def test_untolerated_taint_skips_flavor():
+    flavors = [
+        ResourceFlavor(name="on-demand", node_taints=(Taint(key="reserved"),)),
+        ResourceFlavor(name="spot"),
+    ]
+    cache, snap = build([two_flavor_cq()], flavors=flavors)
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    res = a.assign(wl_cpu("w", "2"), "cq")
+    assert res.pod_sets[0].flavors["cpu"].name == "spot"
+    # with a toleration the first flavor is usable again
+    res2 = a.assign(
+        wl_cpu("w2", "2", tolerations=(Toleration(key="reserved", operator="Exists"),)),
+        "cq",
+    )
+    assert res2.pod_sets[0].flavors["cpu"].name == "on-demand"
+
+
+def test_node_selector_filters_flavor():
+    flavors = [
+        ResourceFlavor(name="on-demand", node_labels={"type": "on-demand"}),
+        ResourceFlavor(name="spot", node_labels={"type": "spot"}),
+    ]
+    cache, snap = build([two_flavor_cq()], flavors=flavors)
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    res = a.assign(wl_cpu("w", "2", node_selector={"type": "spot"}), "cq")
+    assert res.pod_sets[0].flavors["cpu"].name == "spot"
+    # selector key not among flavor label keys is ignored
+    res2 = a.assign(wl_cpu("w2", "2", node_selector={"zone": "z1"}), "cq")
+    assert res2.pod_sets[0].flavors["cpu"].name == "on-demand"
+
+
+def test_borrowing_within_cohort():
+    cq_a = two_flavor_cq("cq-a", cohort="team")
+    cq_b = two_flavor_cq("cq-b", cohort="team")
+    cache, snap = build([cq_a, cq_b])
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    # 6 cpu > cq-a nominal 4 on-demand, but cohort has 8 on-demand total
+    res = a.assign(wl_cpu("w", "6"), "cq-a")
+    assert res.representative_mode() == Mode.FIT
+    assert res.borrowing
+    assert res.pod_sets[0].flavors["cpu"].name == "on-demand"
+
+
+def test_fungibility_borrow_vs_next_flavor():
+    # whenCanBorrow=TryNextFlavor: prefer spot (no borrowing) over
+    # borrowing on-demand from the cohort
+    fung = FlavorFungibility(
+        when_can_borrow=FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+    )
+    cq_a = two_flavor_cq("cq-a", cohort="team", fungibility=fung)
+    cq_b = two_flavor_cq("cq-b", cohort="team")
+    cache, snap = build([cq_a, cq_b])
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    res = a.assign(wl_cpu("w", "6"), "cq-a")
+    assert res.representative_mode() == Mode.FIT
+    assert not res.borrowing
+    assert res.pod_sets[0].flavors["cpu"].name == "spot"
+
+
+def test_fungibility_preempt_stops_search():
+    # whenCanPreempt=Preempt: stop at first preemptable flavor
+    fung = FlavorFungibility(when_can_preempt=FlavorFungibilityPolicy.PREEMPT)
+    cache, snap = build(
+        [two_flavor_cq(fungibility=fung)],
+        admitted=[("used", "cq", "on-demand", "4")],
+    )
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    res = a.assign(wl_cpu("w", "3"), "cq")
+    # on-demand is preemptable (3 <= nominal 4); search stops there even
+    # though spot would Fit
+    assert res.representative_mode() == Mode.PREEMPT
+    assert res.pod_sets[0].flavors["cpu"].name == "on-demand"
+
+
+def test_resume_cursor_last_assignment():
+    cache, snap = build(
+        [two_flavor_cq()], admitted=[("used", "cq", "on-demand", "4")]
+    )
+    wl2 = wl_cpu("used2", "10")
+    wl2.admission = make_admission("cq", {"main": {"cpu": "spot"}}, wl2)
+    cache.add_or_update_workload(wl2)
+    snap = take_snapshot(cache)
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    w = wl_cpu("w", "2")
+    res = a.assign(w, "cq")
+    assert res.representative_mode() == Mode.PREEMPT
+    w.last_assignment = res.last_state
+    # cursor recorded: on-demand (idx 0) tried, spot (idx 1) is last =>
+    # stored as -1 (wrap to start next time)
+    assert res.last_state.last_tried_flavor_idx[0]["cpu"] == -1
+
+
+def test_reclaim_oracle_upgrades_mode():
+    cache, snap = build(
+        [two_flavor_cq()], admitted=[("used", "cq", "on-demand", "4")]
+    )
+    wl2 = wl_cpu("used2", "10")
+    wl2.admission = make_admission("cq", {"main": {"cpu": "spot"}}, wl2)
+    cache.add_or_update_workload(wl2)
+    snap = take_snapshot(cache)
+    a = FlavorAssigner(
+        snap, flavors_dict(cache), reclaim_oracle=lambda cq, fr, q: True
+    )
+    res = a.assign(wl_cpu("w", "2"), "cq")
+    assert res.pod_sets[0].flavors["cpu"].mode == GranularMode.RECLAIM
+    assert res.representative_mode() == Mode.PREEMPT  # public mode
+
+
+def one_flavor_cq(name, cohort=None, preemption=None):
+    return ClusterQueue(
+        name=name,
+        cohort=cohort,
+        resource_groups=(
+            ResourceGroup(
+                ("cpu",), (FlavorQuotas.build("on-demand", {"cpu": "4"}),)
+            ),
+        ),
+        preemption=preemption or Preemption(),
+    )
+
+
+def test_preempt_while_borrowing_policy():
+    # request above nominal: mode NoFit unless borrowWithinCohort allows
+    # preempting while borrowing (flavorassigner.go:713-731)
+    cache, snap = build(
+        [one_flavor_cq("cq-a", cohort="team"), one_flavor_cq("cq-b", cohort="team")],
+        admitted=[("used-a", "cq-a", "on-demand", "4"),
+                  ("used-b", "cq-b", "on-demand", "4")],
+    )
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    res = a.assign(wl_cpu("w", "6"), "cq-a")
+    assert res.representative_mode() == Mode.NO_FIT
+
+    borrow_preempt = Preemption(
+        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+        borrow_within_cohort=BorrowWithinCohort(
+            policy=BorrowWithinCohortPolicy.LOWER_PRIORITY
+        ),
+    )
+    cache.add_or_update_cluster_queue(
+        one_flavor_cq("cq-a", cohort="team", preemption=borrow_preempt)
+    )
+    snap2 = take_snapshot(cache)
+    a2 = FlavorAssigner(snap2, flavors_dict(cache))
+    res2 = a2.assign(wl_cpu("w", "6"), "cq-a")
+    assert res2.representative_mode() == Mode.PREEMPT
+
+
+def test_multiple_podsets_share_usage():
+    cache, snap = build([two_flavor_cq()])
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    wl = Workload(
+        namespace="ns", name="w", queue_name="lq",
+        pod_sets=(
+            PodSet.build("driver", 1, {"cpu": "3"}),
+            PodSet.build("workers", 1, {"cpu": "3"}),
+        ),
+    )
+    res = a.assign(wl, "cq")
+    assert res.representative_mode() == Mode.FIT
+    # driver takes on-demand (4), workers must spill to spot (3+3 > 4)
+    assert res.pod_sets[0].flavors["cpu"].name == "on-demand"
+    assert res.pod_sets[1].flavors["cpu"].name == "spot"
+
+
+def test_partial_admission_reducer():
+    cache, snap = build([two_flavor_cq()])
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    # 14 pods x 1cpu > 14 total quota; minCount 2
+    wl = Workload(
+        namespace="ns", name="w", queue_name="lq",
+        pod_sets=(PodSet.build("main", 20, {"cpu": "1"}, min_count=2),),
+    )
+    counts = find_max_counts(lambda c: a.assign(wl, "cq", counts=c), wl)
+    assert counts is not None
+    # one flavor per (podset, resource): best single flavor is spot (10)
+    assert counts[0] == 10
+    res = a.assign(wl, "cq", counts=counts)
+    assert res.representative_mode() == Mode.FIT
+
+
+def test_pods_resource_implicit():
+    cq = ClusterQueue(
+        name="cq",
+        resource_groups=(
+            ResourceGroup(
+                ("cpu", "pods"),
+                (FlavorQuotas.build("on-demand", {"cpu": "100", "pods": "3"}),),
+            ),
+        ),
+    )
+    cache, snap = build([cq])
+    a = FlavorAssigner(snap, flavors_dict(cache))
+    res = a.assign(wl_cpu("w", "1", count=5), "cq")
+    # 5 pods > pods quota 3
+    assert res.representative_mode() != Mode.FIT

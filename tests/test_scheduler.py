@@ -1,0 +1,236 @@
+"""Scheduler cycle end-to-end (pkg/scheduler/scheduler.go parity).
+
+This is the minimum end-to-end slice of SURVEY.md §7 step 3 and beyond:
+queues + cache + snapshot + flavor assigner driven by the cycle loop.
+"""
+
+import pytest
+
+from kueue_tpu.models import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    QueueingStrategy,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_tpu.models.admission_check import AdmissionCheck, AdmissionCheckState
+from kueue_tpu.models.constants import AdmissionCheckStateType
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.queue_manager import QueueManager
+from kueue_tpu.core.scheduler import Scheduler
+from kueue_tpu.utils.clock import FakeClock
+
+
+def setup(cq_specs=None, **sched_kw):
+    clock = FakeClock(1000.0)
+    cache = Cache()
+    cache.add_or_update_flavor(ResourceFlavor(name="default"))
+    mgr = QueueManager(clock=clock)
+    cqs = cq_specs or [
+        ClusterQueue(
+            name="cq",
+            namespace_selector={},
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu",), (FlavorQuotas.build("default", {"cpu": "10"}),)
+                ),
+            ),
+        )
+    ]
+    for cq in cqs:
+        cache.add_or_update_cluster_queue(cq)
+        mgr.add_cluster_queue(cq)
+        mgr.add_local_queue(
+            LocalQueue(namespace="ns", name=f"lq-{cq.name}", cluster_queue=cq.name)
+        )
+    sched = Scheduler(queues=mgr, cache=cache, clock=clock, **sched_kw)
+    return sched, mgr, cache, clock
+
+
+def submit(mgr, name, cpu="1", count=1, queue="lq-cq", prio=0, t=0.0, **kw):
+    wl = Workload(
+        namespace="ns", name=name, queue_name=queue, priority=prio,
+        creation_time=t,
+        pod_sets=(PodSet.build("main", count, {"cpu": cpu}, **kw),),
+    )
+    mgr.add_or_update_workload(wl)
+    return wl
+
+
+def test_admit_single_workload():
+    sched, mgr, cache, _ = setup()
+    wl = submit(mgr, "job-1", cpu="3")
+    res = sched.schedule()
+    assert [e.workload.name for e in res.admitted] == ["job-1"]
+    assert wl.has_quota_reservation
+    assert wl.is_admitted  # no admission checks -> admitted immediately
+    assert wl.admission.cluster_queue == "cq"
+    psa = wl.admission.pod_set_assignments[0]
+    assert psa.flavors["cpu"] == "default"
+    assert psa.resource_usage["cpu"] == 3000
+
+
+def test_admits_until_full_then_parks():
+    sched, mgr, cache, _ = setup()
+    for i in range(4):
+        submit(mgr, f"job-{i}", cpu="4", t=float(i))
+    admitted = []
+    for _ in range(6):
+        res = sched.schedule()
+        admitted += [e.workload.name for e in res.admitted]
+    # 10 cpu / 4 -> 2 fit; rest parked inadmissible
+    assert admitted == ["job-0", "job-1"]
+    assert mgr.cluster_queues["cq"].pending_inadmissible() == 2
+    assert cache.admitted_count("cq") == 2
+
+
+def test_freeing_capacity_reactivates():
+    sched, mgr, cache, _ = setup()
+    w0 = submit(mgr, "big", cpu="8")
+    submit(mgr, "next", cpu="8", t=1.0)
+    r1 = sched.schedule()
+    assert [e.workload.name for e in r1.admitted] == ["big"]
+    sched.schedule()  # next doesn't fit -> parked
+    assert mgr.cluster_queues["cq"].pending_inadmissible() == 1
+    # finish big: cache frees usage, cohort requeue fires
+    cache.delete_workload(w0)
+    mgr.queue_associated_inadmissible_workloads_after("cq")
+    r3 = sched.schedule()
+    assert [e.workload.name for e in r3.admitted] == ["next"]
+
+
+def test_priority_order_within_cycle():
+    sched, mgr, _, _ = setup()
+    submit(mgr, "low", cpu="6", prio=1, t=0.0)
+    submit(mgr, "high", cpu="6", prio=10, t=5.0)
+    # same CQ: only one head per cycle; high pops first
+    r1 = sched.schedule()
+    assert [e.workload.name for e in r1.admitted] == ["high"]
+    r2 = sched.schedule()
+    assert r2.admitted == []  # low doesn't fit
+
+
+def test_non_borrowing_entry_goes_first():
+    cqs = [
+        ClusterQueue(
+            name="cq-a", cohort="team", namespace_selector={},
+            resource_groups=(
+                ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": "4"}),)),
+            ),
+        ),
+        ClusterQueue(
+            name="cq-b", cohort="team", namespace_selector={},
+            resource_groups=(
+                ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": "4"}),)),
+            ),
+        ),
+    ]
+    sched, mgr, cache, _ = setup(cq_specs=cqs)
+    # a borrows (6 > 4 nominal), b doesn't (3 <= 4)
+    submit(mgr, "borrower", cpu="6", queue="lq-cq-a", t=0.0)
+    submit(mgr, "local", cpu="3", queue="lq-cq-b", t=5.0)
+    res = sched.schedule()
+    names = [e.workload.name for e in res.admitted]
+    # non-borrowing first; borrower then no longer fits (8 total quota - 3 = 5 < 6)
+    assert names == ["local"]
+    assert res.requeued and res.requeued[0].workload.name == "borrower"
+    assert (
+        res.requeued[0].inadmissible_msg
+        == "Workload no longer fits after processing another workload"
+    )
+
+
+def test_namespace_selector_mismatch():
+    cq = ClusterQueue(
+        name="cq",
+        namespace_selector={"team": "ml"},
+        resource_groups=(
+            ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": "10"}),)),
+        ),
+    )
+    sched, mgr, cache, _ = setup(cq_specs=[cq])
+    wl = submit(mgr, "job")
+    res = sched.schedule()
+    assert res.admitted == []
+    assert not wl.has_quota_reservation
+    cond = wl.conditions[WorkloadConditionType.QUOTA_RESERVED]
+    assert "doesn't match ClusterQueue selector" in cond.message
+
+
+def test_admission_checks_defer_admitted():
+    cq = ClusterQueue(
+        name="cq",
+        namespace_selector={},
+        admission_checks=("prov",),
+        resource_groups=(
+            ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": "10"}),)),
+        ),
+    )
+    sched, mgr, cache, _ = setup(cq_specs=[cq])
+    cache.add_or_update_admission_check(
+        AdmissionCheck(name="prov", controller_name="ctrl")
+    )
+    wl = submit(mgr, "job")
+    res = sched.schedule()
+    assert [e.workload.name for e in res.admitted] == ["job"]
+    assert wl.has_quota_reservation
+    assert not wl.is_admitted  # phase 2 pending
+    assert wl.admission_check_states["prov"].state == AdmissionCheckStateType.PENDING
+
+
+def test_failed_apply_forgets_and_requeues():
+    sched, mgr, cache, _ = setup(apply_admission=lambda wl: False)
+    wl = submit(mgr, "job")
+    res = sched.schedule()
+    assert res.admitted == []
+    assert wl.key not in cache.assumed_workloads
+    assert cache.admitted_count("cq") == 0
+    # requeued immediately (FailedAfterNomination)
+    assert mgr.cluster_queues["cq"].pending_active() == 1
+
+
+def test_partial_admission_scales_down():
+    sched, mgr, cache, _ = setup()
+    wl = submit(mgr, "elastic", cpu="1", count=20, min_count=2)
+    res = sched.schedule()
+    assert [e.workload.name for e in res.admitted] == ["elastic"]
+    assert wl.admission.pod_set_assignments[0].count == 10
+
+
+def test_inactive_cq_workloads_stay_pending():
+    cq = ClusterQueue(
+        name="cq",
+        namespace_selector={},
+        admission_checks=("missing-check",),
+        resource_groups=(
+            ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": "10"}),)),
+        ),
+    )
+    sched, mgr, cache, _ = setup(cq_specs=[cq])
+    wl = submit(mgr, "job")
+    res = sched.schedule()
+    assert res.admitted == []
+    assert "inactive" in res.requeued[0].inadmissible_msg
+
+
+def test_borrowing_cohort_single_admission_per_cycle():
+    cqs = [
+        ClusterQueue(
+            name=f"cq-{x}", cohort="team", namespace_selector={},
+            resource_groups=(
+                ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": "4"}),)),
+            ),
+        )
+        for x in ("a", "b")
+    ]
+    sched, mgr, cache, _ = setup(cq_specs=cqs)
+    # both want to borrow: 6 > 4 nominal each; cohort total 8
+    submit(mgr, "borrow-a", cpu="6", queue="lq-cq-a", t=0.0)
+    submit(mgr, "borrow-b", cpu="6", queue="lq-cq-b", t=1.0)
+    res = sched.schedule()
+    # only the first (FIFO) borrows; second no longer fits
+    assert [e.workload.name for e in res.admitted] == ["borrow-a"]
