@@ -22,6 +22,7 @@ from kueue_tpu.models import (
     Topology,
     Workload,
 )
+from kueue_tpu.models.priority_class import WorkloadPriorityClass
 from kueue_tpu.models.constants import StopPolicy
 from kueue_tpu.core.hierarchy import CohortForest
 from kueue_tpu.core.workload_info import admission_usage
@@ -55,6 +56,7 @@ class Cache:
         self.admission_checks: Dict[str, AdmissionCheck] = {}
         self.topologies: Dict[str, Topology] = {}
         self.local_queues: Dict[str, LocalQueue] = {}
+        self.priority_classes: Dict[str, WorkloadPriorityClass] = {}
         self.forest = CohortForest()
         self.assumed_workloads: Dict[str, str] = {}  # wl key -> cq name
         # reverse index: which CQ currently tracks each workload
@@ -109,6 +111,12 @@ class Cache:
     def delete_topology(self, name: str) -> None:
         self.topologies.pop(name, None)
         self._bump_generations()
+
+    def add_or_update_priority_class(self, pc: WorkloadPriorityClass) -> None:
+        self.priority_classes[pc.name] = pc
+
+    def delete_priority_class(self, name: str) -> None:
+        self.priority_classes.pop(name, None)
 
     def add_or_update_local_queue(self, lq: LocalQueue) -> None:
         self.local_queues[lq.key] = lq
@@ -198,6 +206,9 @@ class Cache:
             or self.assumed_workloads.get(wl.key)
             or (wl.admission.cluster_queue if wl.admission else None)
         )
+        self.assumed_workloads.pop(wl.key, None)
+        self._wl_cq.pop(wl.key, None)
+        self.workloads_not_ready.discard(wl.key)
         if cq_name is None:
             return False
         cached = self.cluster_queues.get(cq_name)
@@ -206,9 +217,6 @@ class Cache:
         tracked = cached.workloads.pop(wl.key, None)
         if tracked is not None:
             self._apply_usage(cached, admission_usage(tracked), -1)
-        self.assumed_workloads.pop(wl.key, None)
-        self._wl_cq.pop(wl.key, None)
-        self.workloads_not_ready.discard(wl.key)
         return tracked is not None
 
     def assume_workload(self, wl: Workload) -> bool:

@@ -144,6 +144,9 @@ class AssignmentResult:
         for psr in self.pod_sets:
             ps = podsets[psr.name]
             scaled = _scaled_requests(wl, ps, psr.count)
+            if PODS in psr.flavors:
+                # the implicit pods resource is charged too
+                scaled[PODS] = psr.count
             psas.append(
                 PodSetAssignment(
                     name=psr.name,
@@ -162,8 +165,8 @@ def _scaled_requests(wl: Workload, ps: PodSet, count: int) -> Requests:
 
 # TAS compatibility hook: (cq, podset, flavor) -> error message or None.
 TASCheck = Callable[[ClusterQueue, PodSet, ResourceFlavor], Optional[str]]
-# Preemption oracle: (cq_name, fr, quantity) -> reclaim possible?
-ReclaimOracle = Callable[[str, FlavorResource, int], bool]
+# Preemption oracle: (cq_name, wl, fr, quantity) -> reclaim possible?
+ReclaimOracle = Callable[[str, Workload, FlavorResource, int], bool]
 
 
 class FlavorAssigner:
@@ -179,7 +182,7 @@ class FlavorAssigner:
         self.snapshot = snapshot
         self.flavors = flavors
         self.enable_fair_sharing = enable_fair_sharing
-        self.reclaim_oracle = reclaim_oracle or (lambda cq, fr, q: False)
+        self.reclaim_oracle = reclaim_oracle or (lambda cq, wl, fr, q: False)
         self.tas_check = tas_check
         self.fungibility_enabled = flavor_fungibility_enabled
 
@@ -418,7 +421,7 @@ class FlavorAssigner:
         mode = GranularMode.NO_FIT
         if val <= int(nominal_row[j]):
             mode = GranularMode.PREEMPT
-            if self.reclaim_oracle(cq_name, fr, val):
+            if self.reclaim_oracle(cq_name, wl, fr, val):
                 mode = GranularMode.RECLAIM
         elif self._can_preempt_while_borrowing(cq):
             mode = GranularMode.PREEMPT

@@ -143,8 +143,16 @@ class PendingClusterQueue:
     def _requeue(self, wl: Workload, immediate: bool) -> bool:
         key = wl.key
         self._forget_inflight(key)
+        # A workload with untried flavors left in its fungibility cursor
+        # retries immediately (cluster_queue.go:231 PendingFlavors).
+        pending_flavors = (
+            wl.last_assignment is not None
+            and getattr(wl.last_assignment, "pending_flavors", lambda: False)()
+        )
         if self._backoff_expired(wl) and (
-            immediate or self.queue_inadmissible_cycle >= self.pop_cycle
+            immediate
+            or self.queue_inadmissible_cycle >= self.pop_cycle
+            or pending_flavors
         ):
             parked = self.inadmissible.pop(key, None)
             if parked is not None:

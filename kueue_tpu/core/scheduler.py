@@ -36,7 +36,6 @@ from kueue_tpu.core.flavor_assigner import (
 )
 from kueue_tpu.core.queue_manager import QueueManager, RequeueReason, queue_order_timestamp
 from kueue_tpu.core.snapshot import Snapshot, WorkloadSnapshot, take_snapshot
-from kueue_tpu.core.workload_info import total_requests
 from kueue_tpu.utils.clock import Clock
 from kueue_tpu.utils.priority import priority_of
 
@@ -63,7 +62,6 @@ class Entry:
     inadmissible_msg: str = ""
     requeue_reason: RequeueReason = RequeueReason.GENERIC
     preemption_targets: List[PreemptionTarget] = field(default_factory=list)
-    counts: Optional[List[int]] = None
 
 
 class Preemptor:
@@ -92,10 +90,6 @@ class CycleResult:
     preempting: List[Entry] = field(default_factory=list)
     requeued: List[Entry] = field(default_factory=list)
     skipped_preemptions: Dict[str, int] = field(default_factory=dict)
-
-    @property
-    def success(self) -> bool:
-        return bool(self.admitted)
 
 
 class Scheduler:
@@ -259,12 +253,11 @@ class Scheduler:
                 if err:
                     e.inadmissible_msg = err
                     continue
-            assignment, targets, counts = self._get_assignments(
+            assignment, targets = self._get_assignments(
                 assigner, wl, cq_name, snapshot
             )
             e.assignment = assignment
             e.preemption_targets = targets
-            e.counts = counts
             e.inadmissible_msg = assignment.message()
             wl.last_assignment = assignment.last_state
         return entries
@@ -275,8 +268,10 @@ class Scheduler:
         )
         return cached is not None and wl.key in cached.workloads
 
-    def _reclaim_oracle(self, snapshot: Snapshot, cq_name: str, fr, quantity: int) -> bool:
-        return self.preemptor.is_reclaim_possible(snapshot, cq_name, None, fr, quantity)
+    def _reclaim_oracle(
+        self, snapshot: Snapshot, cq_name: str, wl: Workload, fr, quantity: int
+    ) -> bool:
+        return self.preemptor.is_reclaim_possible(snapshot, cq_name, wl, fr, quantity)
 
     # ---- assignment + preemption + partial admission (scheduler.go:423-468) ----
     def _get_assignments(
@@ -285,34 +280,32 @@ class Scheduler:
         wl: Workload,
         cq_name: str,
         snapshot: Snapshot,
-    ) -> Tuple[AssignmentResult, List[PreemptionTarget], Optional[List[int]]]:
+    ) -> Tuple[AssignmentResult, List[PreemptionTarget]]:
         full = assigner.assign(wl, cq_name)
         mode = full.representative_mode()
         if mode == Mode.FIT:
             full = self._with_tas(wl, cq_name, full, snapshot)
-            return full, [], None
+            return full, []
         if mode == Mode.PREEMPT:
             targets = self.preemptor.get_targets(wl, cq_name, full, snapshot)
             if targets:
-                return full, targets, None
+                return full, targets
         if self.partial_admission and any(
             ps.min_count is not None for ps in wl.pod_sets
         ):
-            best: Optional[Tuple[AssignmentResult, List[PreemptionTarget], List[int]]] = None
+            best: Optional[AssignmentResult] = None
 
             def try_counts(counts: Sequence[int]) -> AssignmentResult:
                 nonlocal best
                 a = assigner.assign(wl, cq_name, counts=counts)
                 if a.representative_mode() == Mode.FIT:
-                    best = (a, [], list(counts))
+                    best = a
                 return a
 
             found = find_max_counts(try_counts, wl)
             if found is not None and best is not None:
-                a, t, c = best
-                a = self._with_tas(wl, cq_name, a, snapshot)
-                return a, t, c
-        return full, [], None
+                return self._with_tas(wl, cq_name, best, snapshot), []
+        return full, []
 
     def _with_tas(
         self, wl: Workload, cq_name: str, assignment: AssignmentResult, snapshot: Snapshot
@@ -424,8 +417,8 @@ class Scheduler:
             self.cache.forget_workload(wl)
             e.inadmissible_msg = "Failed to admit workload: durable write failed"
             self._rollback_admission(wl, e.inadmissible_msg)
+            # end-of-cycle loop requeues every non-assumed entry
             e.status = EntryStatus.NOMINATED
-            self._requeue_and_update(e)
             return False
         self.events(
             "QuotaReserved", wl, f"Quota reserved in ClusterQueue {e.cq_name}"

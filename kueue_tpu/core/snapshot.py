@@ -70,6 +70,8 @@ class Snapshot:
     inactive_cqs: Tuple[str, ...] = ()
     # AllocatableResourceGeneration per CQ (invalidates LastAssignment)
     generations: Dict[str, int] = field(default_factory=dict)
+    # WorkloadPriorityClass map for consistent priority resolution
+    priority_classes: Dict[str, object] = field(default_factory=dict)
 
     # ---- derived state ----
     def usage(self) -> np.ndarray:
@@ -178,6 +180,36 @@ class Snapshot:
             self.resource_index, len(self.resource_names),
         )
         return int(dws[self.row(cq_name)])
+
+    def all_node_drs(self) -> np.ndarray:
+        """DominantResourceShare of every node (CQs and cohorts) against
+        current usage — used by the fair-sharing preemption tournament."""
+        n, fr = self.local_usage.shape
+        dws, _ = dominant_resource_share_np(
+            self.flat.parent, self._lm(), self.subtree, self.guaranteed,
+            self.borrowing_limit, self.usage(),
+            np.zeros((n, fr), dtype=np.int64), self.weight_milli,
+            self.resource_index, len(self.resource_names),
+        )
+        return dws
+
+    def path_to_root(self, row: int) -> List[int]:
+        """Node rows from `row`'s parent up to (and including) the root."""
+        out: List[int] = []
+        cur = int(self.flat.parent[row])
+        while cur >= 0:
+            out.append(cur)
+            cur = int(self.flat.parent[cur])
+        return out
+
+    def children_of(self, row: int) -> Tuple[List[int], List[int]]:
+        """(cq_children, cohort_children) rows of a cohort node."""
+        cqs, cohorts = [], []
+        n_cq = self.flat.n_cq
+        for i, p in enumerate(self.flat.parent):
+            if int(p) == row:
+                (cqs if i < n_cq else cohorts).append(i)
+        return cqs, cohorts
 
     def vector_of(self, usage: FlavorResourceQuantities) -> np.ndarray:
         vec = np.zeros(len(self.fr_list), dtype=np.int64)
@@ -291,6 +323,7 @@ def take_snapshot(cache: Cache) -> Snapshot:
             name: cache.cluster_queues[name].allocatable_generation
             for name in flat.cq_names
         },
+        priority_classes=dict(cache.priority_classes),
     )
 
     from kueue_tpu.models.constants import WorkloadConditionType
@@ -307,7 +340,7 @@ def take_snapshot(cache: Cache) -> Snapshot:
                     cq_name=name,
                     cq_row=flat.index[name],
                     usage_vec=snap.vector_of(usage),
-                    priority=priority_of(wl),
+                    priority=priority_of(wl, cache.priority_classes),
                     quota_reserved_time=qr.last_transition_time if qr else wl.creation_time,
                 )
             )

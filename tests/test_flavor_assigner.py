@@ -226,7 +226,7 @@ def test_reclaim_oracle_upgrades_mode():
     cache.add_or_update_workload(wl2)
     snap = take_snapshot(cache)
     a = FlavorAssigner(
-        snap, flavors_dict(cache), reclaim_oracle=lambda cq, fr, q: True
+        snap, flavors_dict(cache), reclaim_oracle=lambda cq, wl, fr, q: True
     )
     res = a.assign(wl_cpu("w", "2"), "cq")
     assert res.pod_sets[0].flavors["cpu"].mode == GranularMode.RECLAIM
