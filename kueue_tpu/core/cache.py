@@ -49,14 +49,21 @@ class CachedClusterQueue:
 class Cache:
     """Tracks every admitted workload's usage per ClusterQueue."""
 
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        priority_classes: Optional[Dict[str, WorkloadPriorityClass]] = None,
+    ) -> None:
         self.cluster_queues: Dict[str, CachedClusterQueue] = {}
         self.cohorts: Dict[str, Cohort] = {}
         self.flavors: Dict[str, ResourceFlavor] = {}
         self.admission_checks: Dict[str, AdmissionCheck] = {}
         self.topologies: Dict[str, Topology] = {}
         self.local_queues: Dict[str, LocalQueue] = {}
-        self.priority_classes: Dict[str, WorkloadPriorityClass] = {}
+        # WorkloadPriorityClass registry. Pass the same dict to the
+        # QueueManager so heap ordering, entry sorting and preemption
+        # all resolve one consistent priority per workload (the
+        # reference reads one informer cache for the same reason).
+        self.priority_classes = priority_classes if priority_classes is not None else {}
         self.forest = CohortForest()
         self.assumed_workloads: Dict[str, str] = {}  # wl key -> cq name
         # reverse index: which CQ currently tracks each workload

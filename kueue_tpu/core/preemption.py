@@ -482,9 +482,9 @@ class _CohortTournament:
 
     Walks from the root picking the child subtree with the highest
     DominantResourceShare until reaching a ClusterQueue with remaining
-    candidates. DRS values are recomputed from the live snapshot on
-    every query because removals during simulation shift usage at every
-    ancestor.
+    candidates. DRS values are recomputed per ``next_target`` call
+    because removals during simulation shift usage at every ancestor —
+    but only once per call: pruning between picks doesn't change usage.
     """
 
     def __init__(self, ctx: _Ctx, candidates: List[WorkloadSnapshot]):
@@ -495,6 +495,14 @@ class _CohortTournament:
             self.per_cq.setdefault(ws.cq_row, []).append(ws)
         self.pruned: Set[int] = set()
         self.preemptor_ancestors = set(self.snapshot.path_to_root(ctx.cq_row))
+        # children adjacency, built once: O(N) instead of O(N) per query
+        self.children: Dict[int, Tuple[List[int], List[int]]] = {}
+        n_cq = self.snapshot.flat.n_cq
+        for i, p in enumerate(self.snapshot.flat.parent):
+            p = int(p)
+            if p >= 0:
+                entry = self.children.setdefault(p, ([], []))
+                entry[0 if i < n_cq else 1].append(i)
 
     def has_workload(self, row: int) -> bool:
         return bool(self.per_cq.get(row))
@@ -510,15 +518,15 @@ class _CohortTournament:
         if not self.snapshot.has_cohort(ctx.cq_name):
             return ctx.cq_row if self.has_workload(ctx.cq_row) else None
         root = self.snapshot.path_to_root(ctx.cq_row)[-1]
+        drs = self.snapshot.all_node_drs()
         while root not in self.pruned:
-            drs = self.snapshot.all_node_drs()
             pick = self._next_in(root, drs)
             if pick is not None:
                 return pick
         return None
 
     def _next_in(self, cohort_row: int, drs: np.ndarray) -> Optional[int]:
-        cq_children, cohort_children = self.snapshot.children_of(cohort_row)
+        cq_children, cohort_children = self.children.get(cohort_row, ([], []))
         best_cq, best_cq_drs = None, -1
         for row in cq_children:
             if row in self.pruned:

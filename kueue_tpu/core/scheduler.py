@@ -23,10 +23,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from kueue_tpu.models import Workload
-from kueue_tpu.models.constants import (
-    ReclaimWithinCohortPolicy,
-    WorkloadConditionType,
-)
+from kueue_tpu.models.constants import WorkloadConditionType
 from kueue_tpu.core.cache import Cache
 from kueue_tpu.core.flavor_assigner import (
     AssignmentResult,
@@ -147,8 +144,10 @@ class Scheduler:
             if mode == Mode.PREEMPT and not e.preemption_targets:
                 # Nobody to preempt. Reserve capacity unless reclaim is
                 # always possible later (scheduler.go:228-242).
+                from kueue_tpu.core.preemption import can_always_reclaim
+
                 cq = snapshot.cq_models[e.cq_name]
-                if cq.preemption.reclaim_within_cohort != ReclaimWithinCohortPolicy.ANY:
+                if not can_always_reclaim(cq):
                     snapshot.add_usage(
                         e.cq_name, self._reserve_vector(e, snapshot)
                     )
@@ -324,7 +323,7 @@ class Scheduler:
 
     def _entry_sort_key(self, e: Entry):
         borrows = e.assignment.borrowing if e.assignment else False
-        prio = priority_of(e.workload, self.queues.priority_classes)
+        prio = priority_of(e.workload, self.cache.priority_classes)
         ts = queue_order_timestamp(e.workload, self.queues._ts_policy)
         return (1 if borrows else 0, -prio, ts)
 

@@ -1,0 +1,250 @@
+"""Host glue lowering a Snapshot + cycle heads into the batched solver.
+
+This is the boundary between the object-model world (core/) and the
+dense-tensor world (ops/assign_kernel.py). It mirrors the candidate
+enumeration the host FlavorAssigner performs sequentially — flavor
+eligibility (taints, node-selector labels), resume-from-cursor
+(LastAssignment), default-fungibility ordering — but emits it as a
+padded (W x K x C) tensor batch the TPU consumes in one dispatch.
+
+Heads the dense formulation cannot represent exactly fall back to the
+host authority path and are reported in ``Lowered.fallback``:
+  - multi-podset workloads (the reference assigns flavors per podset;
+    aggregation would force one flavor for all podsets),
+  - non-default flavorFungibility (changes the stop rule away from
+    "first Fit wins"),
+  - candidate fan-out beyond the static K.
+This matches the design stance in SURVEY.md §7: the batched solver
+resolves the Fit/NoFit majority; preemption-mode nomination stays host.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kueue_tpu.models import ResourceFlavor, Workload
+from kueue_tpu.models.cluster_queue import ClusterQueue
+from kueue_tpu.models.constants import FlavorFungibilityPolicy
+from kueue_tpu.models.resource_flavor import taints_tolerated
+from kueue_tpu.core.snapshot import Snapshot
+from kueue_tpu.core.workload_info import effective_podset_count
+from kueue_tpu.resources import PODS, FlavorResource
+from kueue_tpu.utils.priority import priority_of
+
+
+@dataclass
+class Lowered:
+    """Dense batch + bookkeeping to map results back to workloads."""
+
+    cq_row: np.ndarray  # int32[W]
+    cells: np.ndarray  # int32[W,K,C]
+    qty: np.ndarray  # int64[W,K,C]
+    valid: np.ndarray  # bool[W,K]
+    priority: np.ndarray  # int64[W]
+    timestamp: np.ndarray  # int64[W] (ns)
+    # per head: candidate k -> flavor name chosen per resource group
+    candidate_flavors: List[List[Dict[str, str]]] = field(default_factory=list)
+    heads: List[Workload] = field(default_factory=list)
+    cq_names: List[str] = field(default_factory=list)
+    fallback: List[int] = field(default_factory=list)  # indices into input heads
+
+
+def _default_fungibility(cq: ClusterQueue) -> bool:
+    ff = cq.flavor_fungibility
+    return (
+        ff.when_can_borrow == FlavorFungibilityPolicy.BORROW
+        and ff.when_can_preempt == FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+    )
+
+
+def _eligible_flavor(
+    flavor: Optional[ResourceFlavor], ps, label_keys: set
+) -> bool:
+    if flavor is None:
+        return False
+    if not taints_tolerated(
+        flavor.node_taints, tuple(ps.tolerations) + tuple(flavor.tolerations)
+    ):
+        return False
+    for k, v in ps.node_selector.items():
+        if k in label_keys and flavor.node_labels.get(k) != v:
+            return False
+    return True
+
+
+def lower_heads(
+    snapshot: Snapshot,
+    heads: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    max_candidates: int = 8,
+    max_cells: int = 16,
+    timestamp_fn=None,
+) -> Lowered:
+    """Build the dense head batch; route inexpressible heads to
+    ``fallback`` (handled by the host FlavorAssigner)."""
+    w = len(heads)
+    k, c = max_candidates, max_cells
+    out = Lowered(
+        cq_row=np.full(w, -1, dtype=np.int32),
+        cells=np.full((w, k, c), -1, dtype=np.int32),
+        qty=np.zeros((w, k, c), dtype=np.int64),
+        valid=np.zeros((w, k), dtype=bool),
+        priority=np.zeros(w, dtype=np.int64),
+        timestamp=np.zeros(w, dtype=np.int64),
+    )
+
+    for i, (wl, cq_name) in enumerate(heads):
+        out.heads.append(wl)
+        out.cq_names.append(cq_name)
+        out.candidate_flavors.append([])
+        if cq_name not in snapshot.cq_models:
+            out.fallback.append(i)
+            continue
+        cq = snapshot.cq_models[cq_name]
+        if len(wl.pod_sets) != 1 or not _default_fungibility(cq):
+            out.fallback.append(i)
+            continue
+        ps = wl.pod_sets[0]
+        count = effective_podset_count(wl, ps)
+        requests = {r: v * count for r, v in ps.requests.items()}
+
+        # resource groups touched by this workload, in CQ order
+        touched = []
+        for rg in cq.resource_groups:
+            rg_req = {
+                r: requests[r] for r in rg.covered_resources if r in requests
+            }
+            if PODS in rg.covered_resources:
+                rg_req[PODS] = count
+            if rg_req:
+                touched.append((rg, rg_req))
+        covered = {r for rg, _ in touched for r in rg.covered_resources}
+        if any(r not in covered for r in requests):
+            out.fallback.append(i)  # resource not covered: host reports it
+            continue
+
+        # per-RG eligible flavor lists (order preserved, cursor applied)
+        state = wl.last_assignment
+        gen = snapshot.generations.get(cq_name, 0)
+        if state is not None and gen > state.cluster_queue_generation:
+            state = None
+        per_rg: List[List[Tuple[str, Dict[str, int]]]] = []
+        representable = True
+        for rg, rg_req in touched:
+            label_keys = {
+                key
+                for fq in rg.flavors
+                if flavors.get(fq.name) is not None
+                for key in flavors[fq.name].node_labels
+            }
+            start = 0
+            if state is not None:
+                first_res = sorted(rg_req)[0]
+                start = state.next_flavor_to_try(0, first_res)
+            options: List[Tuple[str, Dict[str, int]]] = []
+            for fq in rg.flavors[start:]:
+                if _eligible_flavor(flavors.get(fq.name), ps, label_keys):
+                    options.append((fq.name, rg_req))
+            if not options:
+                representable = False
+                break
+            per_rg.append(options)
+        if not representable:
+            out.fallback.append(i)
+            continue
+
+        n_cand = 1
+        for options in per_rg:
+            n_cand *= len(options)
+        n_cells = sum(len(rg_req) for _, rg_req in touched)
+        if n_cand > k or n_cells > c:
+            out.fallback.append(i)
+            continue
+
+        # cartesian product across RGs in reference order (first RG's
+        # flavor walk is the outer loop — matches the sequential search
+        # trying RG1 flavors fully per RG0 choice)
+        combos: List[List[Tuple[str, Dict[str, int]]]] = [[]]
+        for options in per_rg:
+            combos = [prev + [opt] for prev in combos for opt in options]
+
+        out.cq_row[i] = snapshot.row(cq_name)
+        out.priority[i] = priority_of(wl, snapshot.priority_classes)
+        ts = timestamp_fn(wl) if timestamp_fn else wl.creation_time
+        out.timestamp[i] = int(ts * 1e9)
+        for ki, combo in enumerate(combos):
+            flavor_map: Dict[str, str] = {}
+            ci = 0
+            ok = True
+            for fname, rg_req in combo:
+                for r, q in sorted(rg_req.items()):
+                    j = snapshot.fr_index.get(FlavorResource(fname, r))
+                    if j is None:
+                        ok = False
+                        break
+                    out.cells[i, ki, ci] = j
+                    out.qty[i, ki, ci] = q
+                    flavor_map[r] = fname
+                    ci += 1
+                if not ok:
+                    break
+            if ok:
+                out.valid[i, ki] = True
+                out.candidate_flavors[i].append(flavor_map)
+            else:
+                out.cells[i, ki, :] = -1
+                out.qty[i, ki, :] = 0
+                out.candidate_flavors[i].append({})
+        if not out.valid[i].any():
+            out.cq_row[i] = -1
+            out.fallback.append(i)
+    return out
+
+
+def tree_arrays(snapshot: Snapshot):
+    """(QuotaTree, paths) device inputs from a Snapshot."""
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.ops.assign_kernel import build_paths
+    from kueue_tpu.ops.quota import QuotaTree
+
+    flat = snapshot.flat
+    tree = QuotaTree(
+        parent=jnp.asarray(flat.parent),
+        level_mask=jnp.asarray(flat.level_masks()),
+        nominal=jnp.asarray(snapshot.nominal),
+        lending_limit=jnp.asarray(snapshot.lending_limit),
+        borrowing_limit=jnp.asarray(snapshot.borrowing_limit),
+    )
+    paths = jnp.asarray(build_paths(flat.parent, flat.max_depth))
+    return tree, paths
+
+
+def solve_heads(
+    snapshot: Snapshot,
+    heads: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    max_candidates: int = 8,
+    max_cells: int = 16,
+    timestamp_fn=None,
+):
+    """One-call convenience: lower, dispatch, return (Lowered, SolveResult)."""
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.ops.assign_kernel import HeadsBatch, solve_cycle_jit
+
+    lowered = lower_heads(
+        snapshot, heads, flavors, max_candidates, max_cells, timestamp_fn
+    )
+    tree, paths = tree_arrays(snapshot)
+    batch = HeadsBatch(
+        cq_row=jnp.asarray(lowered.cq_row),
+        cells=jnp.asarray(lowered.cells),
+        qty=jnp.asarray(lowered.qty),
+        valid=jnp.asarray(lowered.valid),
+        priority=jnp.asarray(lowered.priority),
+        timestamp=jnp.asarray(lowered.timestamp),
+    )
+    result = solve_cycle_jit(tree, jnp.asarray(snapshot.local_usage), batch, paths)
+    return lowered, result

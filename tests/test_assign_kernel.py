@@ -1,0 +1,208 @@
+"""Batched solver (ops/assign_kernel.py) decision parity.
+
+The kernel must reproduce the host scheduler's Fit-mode admission
+decisions exactly: same flavor choice (first-fit walk), same entry
+order, same conflict resolution against mutating cohort usage. Parity
+is asserted both on hand-built scenarios and randomized cohort forests.
+"""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.models import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import Preemption
+from kueue_tpu.models.constants import ReclaimWithinCohortPolicy
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.queue_manager import QueueManager, queue_order_timestamp
+from kueue_tpu.core.scheduler import Scheduler
+from kueue_tpu.core.snapshot import take_snapshot
+from kueue_tpu.core.solver import lower_heads, solve_heads
+from kueue_tpu.utils.clock import FakeClock
+
+
+def build_env(cq_specs, flavors=("default",)):
+    clock = FakeClock(1000.0)
+    cache = Cache()
+    for f in flavors:
+        cache.add_or_update_flavor(
+            f if isinstance(f, ResourceFlavor) else ResourceFlavor(name=f)
+        )
+    mgr = QueueManager(clock=clock)
+    for cq in cq_specs:
+        cache.add_or_update_cluster_queue(cq)
+        mgr.add_cluster_queue(cq)
+        mgr.add_local_queue(
+            LocalQueue(namespace="ns", name=f"lq-{cq.name}", cluster_queue=cq.name)
+        )
+    sched = Scheduler(queues=mgr, cache=cache, clock=clock)
+    return sched, mgr, cache, clock
+
+
+def cq_single(name, quota, cohort=None, flavors_quotas=None, borrowing=None):
+    fqs = flavors_quotas or (FlavorQuotas.build("default", {"cpu": quota}),)
+    return ClusterQueue(
+        name=name,
+        cohort=cohort,
+        namespace_selector={},
+        resource_groups=(ResourceGroup(("cpu",), tuple(fqs)),),
+        preemption=Preemption(reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY),
+    )
+
+
+def submit(mgr, name, queue, cpu="1", count=1, prio=0, t=0.0):
+    wl = Workload(
+        namespace="ns", name=name, queue_name=queue, priority=prio,
+        creation_time=t,
+        pod_sets=(PodSet.build("main", count, {"cpu": cpu}),),
+    )
+    mgr.add_or_update_workload(wl)
+    return wl
+
+
+def kernel_decisions(mgr, cache, heads):
+    """Run the batched solver on the same heads the host cycle sees."""
+    snapshot = take_snapshot(cache)
+    pairs = [(wl, mgr.cluster_queue_for_workload(wl) or "") for wl in heads]
+    lowered, result = solve_heads(
+        snapshot, pairs, cache.flavors,
+        timestamp_fn=lambda wl: queue_order_timestamp(wl, mgr._ts_policy),
+    )
+    admitted = {}
+    for i, wl in enumerate(lowered.heads):
+        if bool(np.asarray(result.admitted)[i]):
+            k = int(np.asarray(result.chosen)[i])
+            admitted[wl.name] = lowered.candidate_flavors[i][k]
+    return admitted, lowered, result
+
+
+def host_decisions(sched):
+    res = sched.schedule()
+    out = {}
+    for e in res.admitted:
+        psa = e.workload.admission.pod_set_assignments[0]
+        out[e.workload.name] = dict(psa.flavors)
+    return out
+
+
+def run_parity(sched, mgr, cache):
+    heads = [cq.heap.peek() for cq in mgr.cluster_queues.values() if cq.heap.peek()]
+    kernel_admitted, lowered, _ = kernel_decisions(mgr, cache, heads)
+    assert not lowered.fallback, "scenario should be fully batchable"
+    host_admitted = host_decisions(sched)
+    assert kernel_admitted == host_admitted
+    return kernel_admitted
+
+
+def test_single_cq_fit_and_nofit():
+    sched, mgr, cache, _ = build_env([cq_single("cq-a", "10"), cq_single("cq-b", "2")])
+    submit(mgr, "fits", "lq-cq-a", cpu="8")
+    submit(mgr, "too-big", "lq-cq-b", cpu="4")
+    admitted = run_parity(sched, mgr, cache)
+    assert admitted == {"fits": {"cpu": "default"}}
+
+
+def test_second_flavor_chosen_when_first_full():
+    fqs = (
+        FlavorQuotas.build("on-demand", {"cpu": "2"}),
+        FlavorQuotas.build("spot", {"cpu": "10"}),
+    )
+    sched, mgr, cache, _ = build_env(
+        [cq_single("cq", None, flavors_quotas=fqs)],
+        flavors=("on-demand", "spot"),
+    )
+    submit(mgr, "wide", "lq-cq", cpu="6")
+    admitted = run_parity(sched, mgr, cache)
+    assert admitted == {"wide": {"cpu": "spot"}}
+
+
+def test_cohort_borrowing_conflict_resolution():
+    # two CQs in one cohort; both heads want to borrow the same slack.
+    sched, mgr, cache, _ = build_env(
+        [
+            cq_single("lender", "10", cohort="co"),
+            cq_single("b1", "2", cohort="co"),
+            cq_single("b2", "2", cohort="co"),
+        ]
+    )
+    submit(mgr, "w1", "lq-b1", cpu="8", t=1.0)
+    submit(mgr, "w2", "lq-b2", cpu="8", t=2.0)
+    admitted = run_parity(sched, mgr, cache)
+    # only one can borrow the cohort slack; earlier timestamp wins
+    assert admitted == {"w1": {"cpu": "default"}}
+
+
+def test_nonborrowing_ordered_before_borrowing():
+    sched, mgr, cache, _ = build_env(
+        [
+            cq_single("small", "4", cohort="co"),
+            cq_single("big", "10", cohort="co"),
+        ]
+    )
+    # borrower submitted earlier but must yield to the in-quota head
+    submit(mgr, "borrower", "lq-small", cpu="8", t=0.0)
+    submit(mgr, "local", "lq-big", cpu="10", t=5.0)
+    admitted = run_parity(sched, mgr, cache)
+    assert "local" in admitted
+
+
+def test_priority_orders_heads_across_cqs():
+    sched, mgr, cache, _ = build_env(
+        [
+            cq_single("a", "0", cohort="co"),
+            cq_single("b", "0", cohort="co"),
+            cq_single("lender", "6", cohort="co"),
+        ]
+    )
+    submit(mgr, "low", "lq-a", cpu="6", prio=1, t=0.0)
+    submit(mgr, "high", "lq-b", cpu="6", prio=10, t=5.0)
+    admitted = run_parity(sched, mgr, cache)
+    assert admitted == {"high": {"cpu": "default"}}
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_parity(seed):
+    rng = np.random.default_rng(seed)
+    n_cohorts = int(rng.integers(1, 4))
+    cqs = []
+    idx = 0
+    for c in range(n_cohorts):
+        cohort = f"co-{c}" if rng.random() < 0.8 else None
+        for _ in range(int(rng.integers(1, 5))):
+            quota = str(int(rng.integers(0, 12)))
+            borrowing = None
+            cqs.append(cq_single(f"cq-{idx}", quota, cohort=cohort))
+            idx += 1
+    sched, mgr, cache, _ = build_env(cqs)
+    for i, cq in enumerate(cqs):
+        submit(
+            mgr,
+            f"wl-{i}",
+            f"lq-{cq.name}",
+            cpu=str(int(rng.integers(1, 10))),
+            prio=int(rng.integers(0, 5)),
+            t=float(rng.integers(0, 100)),
+        )
+    run_parity(sched, mgr, cache)
+
+
+def test_lower_heads_fallback_routes():
+    sched, mgr, cache, _ = build_env([cq_single("cq", "10")])
+    wl = Workload(
+        namespace="ns", name="multi", queue_name="lq-cq", creation_time=0.0,
+        pod_sets=(
+            PodSet.build("a", 1, {"cpu": "1"}),
+            PodSet.build("b", 1, {"cpu": "1"}),
+        ),
+    )
+    mgr.add_or_update_workload(wl)
+    snapshot = take_snapshot(cache)
+    lowered = lower_heads(snapshot, [(wl, "cq")], cache.flavors)
+    assert lowered.fallback == [0]
