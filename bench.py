@@ -1,0 +1,117 @@
+"""Benchmark: one batched admission cycle on the accelerator.
+
+Scenario sized to the north star in BASELINE.json — 1k ClusterQueues in
+a 2-level cohort forest, a full cycle of nominated heads (one per CQ,
+padded to 1024), 4 flavor candidates x 4 requested cells each — and
+measures end-to-end device latency of ``solve_cycle`` (phase-1 vmapped
+flavor classification + phase-2 scan conflict resolution), the TPU
+re-expression of the reference hot path
+``pkg/scheduler/scheduler.go:176-310``.
+
+Baseline: the north-star budget of 100 ms per scheduling cycle
+(BASELINE.json "north_star"; the Go reference's measured cycle
+histogram is `admission_attempt_duration_seconds`). vs_baseline is the
+speedup factor: baseline_ms / measured_ms (>1 = faster than budget).
+
+Prints exactly ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_CQ = 1000
+N_COHORT = 50
+FR = 32
+W = 1024  # heads per cycle (padded); reference admits <= one head per CQ
+K = 4  # flavor candidates per head
+C = 4  # requested (flavor,resource) cells per candidate
+BASELINE_MS = 100.0
+REPS = 30
+
+
+def build_problem(seed: int = 0):
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.ops.assign_kernel import HeadsBatch, build_paths
+    from kueue_tpu.ops.quota import NO_LIMIT, QuotaTree
+
+    rng = np.random.default_rng(seed)
+    n = N_CQ + N_COHORT
+    parent = np.full(n, -1, dtype=np.int32)
+    parent[:N_CQ] = N_CQ + rng.integers(0, N_COHORT, size=N_CQ)
+    level_mask = np.zeros((2, n), dtype=bool)
+    level_mask[0, N_CQ:] = True  # cohort roots at depth 0
+    level_mask[1, :N_CQ] = True  # ClusterQueues at depth 1
+
+    nominal = np.zeros((n, FR), dtype=np.int64)
+    nominal[:N_CQ] = rng.integers(50, 500, size=(N_CQ, FR))
+    limits = np.full((n, FR), NO_LIMIT, dtype=np.int64)
+
+    tree = QuotaTree(
+        parent=jnp.asarray(parent),
+        level_mask=jnp.asarray(level_mask),
+        nominal=jnp.asarray(nominal),
+        lending_limit=jnp.asarray(limits),
+        borrowing_limit=jnp.asarray(limits),
+    )
+    paths = jnp.asarray(build_paths(parent, 1))
+
+    local_usage = np.zeros((n, FR), dtype=np.int64)
+    local_usage[:N_CQ] = rng.integers(0, 200, size=(N_CQ, FR))
+
+    cq_row = np.full(W, -1, dtype=np.int32)
+    cq_row[:N_CQ] = np.arange(N_CQ)
+    cells = np.full((W, K, C), -1, dtype=np.int32)
+    qty = np.zeros((W, K, C), dtype=np.int64)
+    valid = np.zeros((W, K), dtype=bool)
+    cells[:N_CQ] = rng.integers(0, FR, size=(N_CQ, K, C))
+    qty[:N_CQ] = rng.integers(1, 60, size=(N_CQ, K, C))
+    valid[:N_CQ] = True
+    batch = HeadsBatch(
+        cq_row=jnp.asarray(cq_row),
+        cells=jnp.asarray(cells),
+        qty=jnp.asarray(qty),
+        valid=jnp.asarray(valid),
+        priority=jnp.asarray(rng.integers(0, 100, size=W).astype(np.int64)),
+        timestamp=jnp.asarray(np.arange(W, dtype=np.int64)),
+    )
+    return tree, jnp.asarray(local_usage), batch, paths
+
+
+def main():
+    import jax
+
+    from kueue_tpu.ops.assign_kernel import solve_cycle_jit
+
+    tree, local_usage, batch, paths = build_problem()
+
+    # warmup / compile (host fetch forces real completion — on some
+    # experimental platforms block_until_ready returns at enqueue time)
+    out = solve_cycle_jit(tree, local_usage, batch, paths)
+    np.asarray(out.admitted)
+
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = solve_cycle_jit(tree, local_usage, batch, paths)
+        np.asarray(out.admitted)  # device->host sync
+        times.append((time.perf_counter() - t0) * 1e3)
+    ms = float(np.median(times))
+
+    print(
+        json.dumps(
+            {
+                "metric": f"admission_cycle_latency ({W} heads x {N_CQ} CQs, K={K}, FR={FR})",
+                "value": round(ms, 3),
+                "unit": "ms/cycle",
+                "vs_baseline": round(BASELINE_MS / ms, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,11 @@ from kueue_tpu.models.constants import (
     FlavorFungibilityPolicy,
     ReclaimWithinCohortPolicy,
 )
-from kueue_tpu.models.resource_flavor import taints_tolerated
+from kueue_tpu.models.resource_flavor import (
+    group_label_keys,
+    selector_matches,
+    taints_tolerated,
+)
 from kueue_tpu.models.workload import (
     Admission,
     PodSet,
@@ -288,10 +292,7 @@ class FlavorAssigner:
         best: Dict[str, FlavorChoice] = {}
         best_mode = GranularMode.NO_FIT
 
-        label_keys = {
-            k for fq in rg.flavors
-            for k in (self.flavors.get(fq.name).node_labels if self.flavors.get(fq.name) else {})
-        }
+        label_keys = group_label_keys(rg.flavors, self.flavors)
 
         start = state.next_flavor_to_try(ps_idx, res_name) if state else 0
         attempted_idx = -1
@@ -313,7 +314,7 @@ class FlavorAssigner:
             ):
                 reasons.append(f"untolerated taint in flavor {f_name}")
                 continue
-            if not self._selector_matches(ps, flavor, label_keys):
+            if not selector_matches(ps.node_selector, flavor, label_keys):
                 reasons.append(f"flavor {f_name} doesn't match node affinity")
                 continue
 
@@ -373,16 +374,6 @@ class FlavorAssigner:
                 f"no flavor of resource group for {res_name} could be attempted"
             )
         return best, reasons
-
-    def _selector_matches(
-        self, ps: PodSet, flavor: ResourceFlavor, allowed_keys: set
-    ) -> bool:
-        """Node-selector match restricted to the group's flavor label
-        keys (flavorassigner.go:640-684)."""
-        for k, v in ps.node_selector.items():
-            if k in allowed_keys and flavor.node_labels.get(k) != v:
-                return False
-        return True
 
     # ---- quota fit classification (flavorassigner.go:692-726) ----
     def _fits_resource_quota(

@@ -107,6 +107,12 @@ class Scheduler:
     ):
         self.queues = queues
         self.cache = cache
+        # One authoritative priority-class store: heap head ordering
+        # (queues) and entry ordering / snapshots (cache) must resolve
+        # priorities identically.
+        if queues.priority_classes is not cache.priority_classes:
+            cache.priority_classes.update(queues.priority_classes)
+            queues.priority_classes = cache.priority_classes
         self.clock = clock
         self.preemptor = preemptor or Preemptor()
         self.fair_sharing = fair_sharing

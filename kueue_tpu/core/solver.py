@@ -28,7 +28,7 @@ import numpy as np
 from kueue_tpu.models import ResourceFlavor, Workload
 from kueue_tpu.models.cluster_queue import ClusterQueue
 from kueue_tpu.models.constants import FlavorFungibilityPolicy
-from kueue_tpu.models.resource_flavor import taints_tolerated
+from kueue_tpu.models.resource_flavor import flavor_eligible, group_label_keys
 from kueue_tpu.core.snapshot import Snapshot
 from kueue_tpu.core.workload_info import effective_podset_count
 from kueue_tpu.resources import PODS, FlavorResource
@@ -58,21 +58,6 @@ def _default_fungibility(cq: ClusterQueue) -> bool:
         ff.when_can_borrow == FlavorFungibilityPolicy.BORROW
         and ff.when_can_preempt == FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
     )
-
-
-def _eligible_flavor(
-    flavor: Optional[ResourceFlavor], ps, label_keys: set
-) -> bool:
-    if flavor is None:
-        return False
-    if not taints_tolerated(
-        flavor.node_taints, tuple(ps.tolerations) + tuple(flavor.tolerations)
-    ):
-        return False
-    for k, v in ps.node_selector.items():
-        if k in label_keys and flavor.node_labels.get(k) != v:
-            return False
-    return True
 
 
 def lower_heads(
@@ -108,6 +93,9 @@ def lower_heads(
             out.fallback.append(i)
             continue
         ps = wl.pod_sets[0]
+        if ps.topology_request is not None:
+            out.fallback.append(i)  # TAS placement stays on the host path
+            continue
         count = effective_podset_count(wl, ps)
         requests = {r: v * count for r, v in ps.requests.items()}
 
@@ -134,19 +122,14 @@ def lower_heads(
         per_rg: List[List[Tuple[str, Dict[str, int]]]] = []
         representable = True
         for rg, rg_req in touched:
-            label_keys = {
-                key
-                for fq in rg.flavors
-                if flavors.get(fq.name) is not None
-                for key in flavors[fq.name].node_labels
-            }
+            label_keys = group_label_keys(rg.flavors, flavors)
             start = 0
             if state is not None:
                 first_res = sorted(rg_req)[0]
                 start = state.next_flavor_to_try(0, first_res)
             options: List[Tuple[str, Dict[str, int]]] = []
             for fq in rg.flavors[start:]:
-                if _eligible_flavor(flavors.get(fq.name), ps, label_keys):
+                if flavor_eligible(flavors.get(fq.name), ps, label_keys):
                     options.append((fq.name, rg_req))
             if not options:
                 representable = False

@@ -47,6 +47,42 @@ class ResourceFlavor:
             raise ValueError("ResourceFlavor.name is required")
 
 
+def group_label_keys(group_flavors, flavors_by_name) -> set:
+    """Label keys known to any flavor in a resource group — the only
+    keys the flavor node-selector match considers
+    (flavorassigner.go:640-684)."""
+    keys = set()
+    for fq in group_flavors:
+        flavor = flavors_by_name.get(fq.name)
+        if flavor is not None:
+            keys.update(flavor.node_labels)
+    return keys
+
+
+def selector_matches(node_selector, flavor: "ResourceFlavor", allowed_keys) -> bool:
+    """Node-selector match restricted to the group's flavor label keys."""
+    for k, v in node_selector.items():
+        if k in allowed_keys and flavor.node_labels.get(k) != v:
+            return False
+    return True
+
+
+def flavor_eligible(flavor: Optional["ResourceFlavor"], ps, allowed_keys) -> bool:
+    """Shared taint + node-selector eligibility for a podset on a flavor.
+
+    The single source of truth for both the host FlavorAssigner walk and
+    the dense-solver candidate lowering (core/solver.py) — the two paths
+    must agree or the batched kernel emits candidates the host authority
+    would reject."""
+    if flavor is None:
+        return False
+    if not taints_tolerated(
+        flavor.node_taints, tuple(ps.tolerations) + tuple(flavor.tolerations)
+    ):
+        return False
+    return selector_matches(ps.node_selector, flavor, allowed_keys)
+
+
 def taints_tolerated(taints, tolerations) -> bool:
     """True when every NoSchedule/NoExecute taint is tolerated.
 

@@ -120,7 +120,10 @@ def phase1_classify(
     avail = available_all(tree, subtree, guaranteed, usage)  # [N, FR]
 
     cq = jnp.maximum(heads.cq_row, 0)  # [W]
-    cell_valid = heads.cells >= 0  # [W,K,C]
+    # Zero-quantity cells never constrain the fit: the host path masks
+    # usage_vec > 0 and clamps available() to >= 0, so a request of 0
+    # fits even when availability is negative (over-admitted root).
+    cell_need = (heads.cells >= 0) & (heads.qty > 0)  # [W,K,C]
     cells = jnp.maximum(heads.cells, 0)
 
     # avail/subtree/local rows per head, gathered at candidate cells
@@ -129,18 +132,17 @@ def phase1_classify(
     local_wkc = local_usage[cq[:, None, None], cells]
 
     fits = jnp.all(
-        jnp.where(cell_valid, avail_wkc >= heads.qty, True), axis=-1
+        jnp.where(cell_need, avail_wkc >= heads.qty, True), axis=-1
     )  # [W,K]
     has_cohort = (tree.parent[cq] >= 0)[:, None]  # [W,1]
     borrows = (
         jnp.any(
-            jnp.where(cell_valid, local_wkc + heads.qty > subtree_wkc, False),
+            jnp.where(cell_need, local_wkc + heads.qty > subtree_wkc, False),
             axis=-1,
         )
         & has_cohort
     )  # [W,K]
 
-    k = heads.valid.shape[1]
     fit_ok = fits & heads.valid
     first_fit = jnp.argmax(fit_ok, axis=1)  # first True (argmax on bool)
     any_fit = jnp.any(fit_ok, axis=1)
@@ -227,7 +229,6 @@ def solve_cycle(
     chosen, borrows_wk = phase1_classify(tree, subtree, guaranteed, local_usage, heads)
 
     w = heads.cq_row.shape[0]
-    k = heads.valid.shape[1]
     chosen_safe = jnp.maximum(chosen, 0)
     head_borrow = jnp.take_along_axis(borrows_wk, chosen_safe[:, None], axis=1)[:, 0]
     head_borrow = head_borrow & (chosen >= 0)
@@ -254,7 +255,7 @@ def solve_cycle(
         path = paths[cqs]  # [D+1]
         cells = cells_chosen[wi]
         qty = qty_chosen[wi]
-        cell_valid = cells >= 0
+        cell_valid = (cells >= 0) & (qty > 0)
 
         avail = _avail_along_path(
             path, cells, usage, subtree, guaranteed, tree.borrowing_limit, max_depth

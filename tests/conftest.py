@@ -14,4 +14,8 @@ if "--xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# The env vars alone are not enough here: the image's sitecustomize
+# registers an experimental TPU plugin and pins jax_platforms, so the
+# config must be forced back to cpu after import.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

@@ -47,7 +47,9 @@ def build_env(cq_specs, flavors=("default",)):
 
 
 def cq_single(name, quota, cohort=None, flavors_quotas=None, borrowing=None):
-    fqs = flavors_quotas or (FlavorQuotas.build("default", {"cpu": quota}),)
+    fqs = flavors_quotas or (
+        FlavorQuotas.build("default", {"cpu": (quota, borrowing, None)}),
+    )
     return ClusterQueue(
         name=name,
         cohort=cohort,
@@ -177,8 +179,12 @@ def test_randomized_parity(seed):
         cohort = f"co-{c}" if rng.random() < 0.8 else None
         for _ in range(int(rng.integers(1, 5))):
             quota = str(int(rng.integers(0, 12)))
-            borrowing = None
-            cqs.append(cq_single(f"cq-{idx}", quota, cohort=cohort))
+            borrowing = (
+                str(int(rng.integers(0, 8)))
+                if cohort is not None and rng.random() < 0.5
+                else None
+            )
+            cqs.append(cq_single(f"cq-{idx}", quota, cohort=cohort, borrowing=borrowing))
             idx += 1
     sched, mgr, cache, _ = build_env(cqs)
     for i, cq in enumerate(cqs):
