@@ -71,6 +71,10 @@ class Cache:
         # workloads admitted but whose pods aren't ready yet
         # (WaitForPodsReady blockAdmission support, cache.go:160-205)
         self.workloads_not_ready: Set[str] = set()
+        # Optional TAS cache: charged/released alongside quota usage so
+        # later entries in a cycle see earlier TAS admissions (the
+        # reference's snapshot.AddWorkload updates TAS usage in place).
+        self.tas_cache = None  # kueue_tpu.tas.TASCache
 
     # ---- object lifecycle ----
     def add_or_update_cluster_queue(self, cq: ClusterQueue) -> None:
@@ -199,11 +203,17 @@ class Cache:
                 old = prev.workloads.pop(wl.key, None)
                 if old is not None:
                     self._apply_usage(prev, admission_usage(old), -1)
+                    if self.tas_cache is not None:
+                        self.tas_cache.remove_usage(old)
         old = cached.workloads.get(wl.key)
         if old is not None:
             self._apply_usage(cached, admission_usage(old), -1)
+            if self.tas_cache is not None:
+                self.tas_cache.remove_usage(old)
         cached.workloads[wl.key] = wl
         self._apply_usage(cached, admission_usage(wl), +1)
+        if self.tas_cache is not None:
+            self.tas_cache.add_usage(wl)
         self._wl_cq[wl.key] = wl.admission.cluster_queue
         return True
 
@@ -220,10 +230,17 @@ class Cache:
             return False
         cached = self.cluster_queues.get(cq_name)
         if cached is None:
+            # The CQ is gone but TAS usage is keyed per flavor, not per
+            # CQ — release it from the passed workload (idempotent in
+            # the TAS cache) so domains don't stay charged forever.
+            if self.tas_cache is not None:
+                self.tas_cache.remove_usage(wl)
             return False
         tracked = cached.workloads.pop(wl.key, None)
         if tracked is not None:
             self._apply_usage(cached, admission_usage(tracked), -1)
+            if self.tas_cache is not None:
+                self.tas_cache.remove_usage(tracked)
         return tracked is not None
 
     def assume_workload(self, wl: Workload) -> bool:
@@ -237,6 +254,8 @@ class Cache:
             return False
         cached.workloads[wl.key] = wl
         self._apply_usage(cached, admission_usage(wl), +1)
+        if self.tas_cache is not None:
+            self.tas_cache.add_usage(wl)
         self.assumed_workloads[wl.key] = wl.admission.cluster_queue
         self._wl_cq[wl.key] = wl.admission.cluster_queue
         return True
@@ -252,6 +271,8 @@ class Cache:
         tracked = cached.workloads.pop(wl.key, None)
         if tracked is not None:
             self._apply_usage(cached, admission_usage(tracked), -1)
+            if self.tas_cache is not None:
+                self.tas_cache.remove_usage(tracked)
         self._wl_cq.pop(wl.key, None)
         return True
 

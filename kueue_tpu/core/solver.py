@@ -129,8 +129,17 @@ def lower_heads(
                 start = state.next_flavor_to_try(0, first_res)
             options: List[Tuple[str, Dict[str, int]]] = []
             for fq in rg.flavors[start:]:
-                if flavor_eligible(flavors.get(fq.name), ps, label_keys):
+                flavor = flavors.get(fq.name)
+                if flavor is not None and flavor.topology_name is not None:
+                    # TAS flavors (incl. implied TAS on TAS-only CQs)
+                    # need topology placement — host path only
+                    options = []
+                    representable = False
+                    break
+                if flavor_eligible(flavor, ps, label_keys):
                     options.append((fq.name, rg_req))
+            if not representable:
+                break
             if not options:
                 representable = False
                 break

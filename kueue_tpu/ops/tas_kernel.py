@@ -1,0 +1,128 @@
+"""TAS phase-1 pod counting as a batched JAX kernel.
+
+Re-expresses fillInCounts (pkg/cache/tas_flavor_snapshot.go:647-690) as
+dense tensor ops: per-leaf CountIn is a masked floor-divide min-reduce
+over the resource axis, and per-level domain totals are segment sums
+over leaf->domain index vectors. Batched over B podset requests at once
+(vmap) — the reference recomputes counts per podset sequentially; here
+one dispatch prices every pending TAS podset against the same topology.
+
+Phase 2 (domain selection) stays host-side: after phase 1 the per-level
+count vectors are tiny (|domains| << |leaves|) and the greedy is
+sequential by construction.
+
+Integer semantics: Go's ``int32(capacity / value)`` truncates toward
+zero, and jnp floor-division rounds toward -inf — negative remaining
+capacity is corrected explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from kueue_tpu._jax import jax, jnp  # must precede flax: sets x64 first
+from flax import struct
+
+MAX_COUNT = (1 << 31) - 1
+
+
+@struct.dataclass
+class TASTopology:
+    """Dense topology-forest view.
+
+    free:      int64[L, R] leaf free capacity (alloc - non-TAS usage)
+    tas_usage: int64[L, R] usage of admitted TAS workloads
+    seg_ids:   int32[D, L] leaf -> domain index at each level d
+                (level D-1 is the leaf level: seg_ids[D-1] = arange(L))
+    n_domains: per-level domain counts (static: part of the jit key)
+    """
+
+    free: jnp.ndarray
+    tas_usage: jnp.ndarray
+    seg_ids: jnp.ndarray
+    n_domains: Tuple[int, ...] = struct.field(pytree_node=False)
+
+
+def _trunc_div(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:
+    """Go-style integer division truncating toward zero."""
+    q = jnp.abs(num) // jnp.maximum(den, 1)
+    return jnp.sign(num) * q
+
+
+def leaf_counts(
+    topo: TASTopology,
+    req: jnp.ndarray,  # int64[B, R] per-pod requests (incl. pods=1)
+    assumed: jnp.ndarray,  # int64[B, L, R] assumed usage per request
+    taint_ok: jnp.ndarray,  # bool[B, L] leaf tolerated by request B
+    simulate_empty: jnp.ndarray,  # bool[B]
+) -> jnp.ndarray:
+    """CountIn for every (request, leaf) pair. Returns int64[B, L]."""
+    remaining = topo.free[None, :, :] - jnp.where(
+        simulate_empty[:, None, None], 0, topo.tas_usage[None, :, :]
+    )
+    remaining = remaining - assumed  # [B, L, R]
+
+    need = req > 0  # [B, R]
+    per_res = _trunc_div(remaining, req[:, None, :])  # [B, L, R]
+    per_res = jnp.where(need[:, None, :], per_res, MAX_COUNT)
+    counts = jnp.min(per_res, axis=-1)  # [B, L]
+    counts = jnp.clip(counts, None, MAX_COUNT)
+    return jnp.where(taint_ok, counts, 0)
+
+
+def level_counts(topo: TASTopology, counts: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """Bubble leaf counts into every level's domain totals.
+
+    counts: int64[B, L] -> tuple over levels d of int64[B, n_domains[d]].
+    One segment-sum per level (fillInCountsHelper's recursion flattened).
+    """
+    out = []
+    for d, nd in enumerate(topo.n_domains):
+        seg = topo.seg_ids[d]
+        out.append(
+            jax.vmap(
+                lambda row, seg=seg, nd=nd: jax.ops.segment_sum(
+                    row, seg, num_segments=nd
+                )
+            )(counts)
+        )
+    return tuple(out)
+
+
+@jax.jit
+def fill_in_counts(
+    topo: TASTopology,
+    req: jnp.ndarray,
+    assumed: jnp.ndarray,
+    taint_ok: jnp.ndarray,
+    simulate_empty: jnp.ndarray,
+):
+    """Batched phase 1: per-leaf counts + per-level domain totals."""
+    counts = leaf_counts(topo, req, assumed, taint_ok, simulate_empty)
+    return counts, level_counts(topo, counts)
+
+
+def topology_from_snapshot(snap) -> TASTopology:
+    """Build the dense view from a host TASFlavorSnapshot (frozen)."""
+    import numpy as np
+
+    snap.freeze()
+    leaves = snap._leaf_order
+    n_l = len(leaves)
+    depth = len(snap.level_keys)
+    seg_ids = np.zeros((depth, n_l), dtype=np.int32)
+    n_domains = []
+    for d in range(depth):
+        # domain order: sorted by level_values prefix (stable, matches
+        # host _sorted_domains tie-break order)
+        prefixes = sorted({leaf.level_values[: d + 1] for leaf in leaves})
+        index = {p: i for i, p in enumerate(prefixes)}
+        for i, leaf in enumerate(leaves):
+            seg_ids[d, i] = index[leaf.level_values[: d + 1]]
+        n_domains.append(len(prefixes))
+    return TASTopology(
+        free=jnp.asarray(snap._free),
+        tas_usage=jnp.asarray(snap._tas_usage),
+        seg_ids=jnp.asarray(seg_ids),
+        n_domains=tuple(n_domains),
+    )

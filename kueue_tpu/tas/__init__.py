@@ -1,0 +1,28 @@
+"""Topology-Aware Scheduling (TAS).
+
+Reference: pkg/cache/tas_cache.go, tas_flavor.go, tas_flavor_snapshot.go
+and pkg/scheduler/flavorassigner/tas_flavorassigner.go. TPU-native
+re-expression: the domain forest is flattened to dense leaf arrays
+(capacity/usage per resource) with per-level segment ids; phase-1 pod
+counting is one vectorized min-reduce + per-level segment sums (JAX
+kernel in ops/tas_kernel.py), and phase-2 domain selection is the
+reference's greedy over the (tiny) per-level count vectors.
+"""
+
+from kueue_tpu.tas.cache import Node, TASCache, TASFlavorCache
+from kueue_tpu.tas.snapshot import (
+    TASAssignmentResult,
+    TASFlavorSnapshot,
+    TASPodSetRequest,
+)
+from kueue_tpu.tas.manager import TASManager
+
+__all__ = [
+    "Node",
+    "TASCache",
+    "TASFlavorCache",
+    "TASAssignmentResult",
+    "TASFlavorSnapshot",
+    "TASPodSetRequest",
+    "TASManager",
+]

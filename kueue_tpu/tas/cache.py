@@ -1,0 +1,180 @@
+"""TASCache / TASFlavorCache — node & usage tracking per TAS flavor.
+
+Reference: pkg/cache/tas_cache.go:64, tas_flavor.go. Nodes are ingested
+(scraped in the reference by pkg/controller/tas/resource_flavor.go) and
+filtered by the flavor's nodeLabels/taints; admitted TAS workloads'
+topology assignments charge usage against leaf domains; ``snapshot()``
+produces the immutable per-cycle TASFlavorSnapshot.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu.models import ResourceFlavor, Workload
+from kueue_tpu.models.topology import Topology
+from kueue_tpu.tas.snapshot import TASFlavorSnapshot, domain_id
+
+
+@dataclass
+class Node:
+    """The slice of corev1.Node that TAS consumes."""
+
+    name: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    allocatable: Dict[str, int] = field(default_factory=dict)
+    taints: Tuple = ()
+    ready: bool = True
+    # usage by pods not managed via TAS (static pods, daemonsets...)
+    non_tas_usage: Dict[str, int] = field(default_factory=dict)
+
+
+class TASFlavorCache:
+    """Per-flavor node set + admitted TAS usage (tas_flavor.go)."""
+
+    def __init__(self, flavor: ResourceFlavor, topology: Topology):
+        self.flavor = flavor
+        self.topology = topology
+        self.level_keys: Tuple[str, ...] = topology.level_keys()
+        self.nodes: Dict[str, Node] = {}
+        # leaf domain id -> accumulated usage / pod count
+        self._usage: Dict[str, Dict[str, int]] = {}
+        self._usage_counts: Dict[str, int] = {}
+
+    def node_matches(self, node: Node) -> bool:
+        """Flavor nodeLabels must be a subset of the node's labels."""
+        return all(node.labels.get(k) == v for k, v in self.flavor.node_labels.items())
+
+    def add_or_update_node(self, node: Node) -> None:
+        if self.node_matches(node):
+            self.nodes[node.name] = node
+        else:
+            self.nodes.pop(node.name, None)
+
+    def delete_node(self, name: str) -> None:
+        self.nodes.pop(name, None)
+
+    # ---- usage lifecycle (cache.AddOrUpdateWorkload TAS side) ----
+    def add_usage(self, wl: Workload) -> None:
+        self._apply_usage(wl, +1)
+
+    def remove_usage(self, wl: Workload) -> None:
+        self._apply_usage(wl, -1)
+
+    def _apply_usage(self, wl: Workload, sign: int) -> None:
+        if wl.admission is None:
+            return
+        podsets = {ps.name: ps for ps in wl.pod_sets}
+        for psa in wl.admission.pod_set_assignments:
+            ta = psa.topology_assignment
+            if ta is None:
+                continue
+            if self.flavor.name not in set(psa.flavors.values()):
+                continue
+            ps = podsets.get(psa.name)
+            if ps is None:
+                continue
+            for dom in ta.domains:
+                did = domain_id(dom.values)
+                acc = self._usage.setdefault(did, {})
+                for r, v in ps.requests.items():
+                    acc[r] = acc.get(r, 0) + sign * v * dom.count
+                self._usage_counts[did] = (
+                    self._usage_counts.get(did, 0) + sign * dom.count
+                )
+
+    # ---- snapshot (tas_flavor.go snapshot build) ----
+    def snapshot(self) -> TASFlavorSnapshot:
+        snap = TASFlavorSnapshot(
+            topology_name=self.topology.name,
+            level_keys=self.level_keys,
+            tolerations=tuple(self.flavor.tolerations),
+        )
+        for node in self.nodes.values():
+            if not node.ready:
+                continue
+            did = snap.add_node(node.labels, node.allocatable, node.taints)
+            if node.non_tas_usage:
+                snap.add_non_tas_usage(did, node.non_tas_usage)
+        for did, usage in self._usage.items():
+            snap.add_tas_usage(did, usage, 0)
+            # pod counts are carried inside usage via PODS accumulation
+        for did, count in self._usage_counts.items():
+            if count:
+                snap.add_tas_usage(did, {}, count)
+        snap.freeze()
+        return snap
+
+
+class TASCache:
+    """All TAS flavors (pkg/cache/tas_cache.go:64)."""
+
+    def __init__(self):
+        self.flavors: Dict[str, TASFlavorCache] = {}
+        self.topologies: Dict[str, Topology] = {}
+        self._nodes: Dict[str, Node] = {}
+        # Charged workload keys — makes add/remove idempotent so event
+        # replays or CQ-gone teardown paths can't double-charge/release.
+        self._charged: set = set()
+
+    def add_or_update_topology(self, topo: Topology) -> None:
+        self.topologies[topo.name] = topo
+        # (re)bind any flavor referencing this topology
+        for fc in list(self.flavors.values()):
+            if fc.flavor.topology_name == topo.name:
+                self.add_or_update_flavor(fc.flavor)
+
+    def delete_topology(self, name: str) -> None:
+        self.topologies.pop(name, None)
+
+    def add_or_update_flavor(self, flavor: ResourceFlavor) -> Optional[str]:
+        """Track a TAS flavor; returns an error string when the
+        referenced Topology is missing (CQ goes inactive with that
+        reason in the reference)."""
+        if flavor.topology_name is None:
+            self.flavors.pop(flavor.name, None)
+            return None
+        topo = self.topologies.get(flavor.topology_name)
+        if topo is None:
+            self.flavors.pop(flavor.name, None)
+            return f"topology {flavor.topology_name} not found"
+        old = self.flavors.get(flavor.name)
+        fc = TASFlavorCache(flavor, topo)
+        if old is not None:
+            fc._usage = old._usage
+            fc._usage_counts = old._usage_counts
+        self.flavors[flavor.name] = fc
+        for node in self._nodes.values():
+            fc.add_or_update_node(node)
+        return None
+
+    def delete_flavor(self, name: str) -> None:
+        self.flavors.pop(name, None)
+
+    def add_or_update_node(self, node: Node) -> None:
+        self._nodes[node.name] = node
+        for fc in self.flavors.values():
+            fc.add_or_update_node(node)
+
+    def delete_node(self, name: str) -> None:
+        self._nodes.pop(name, None)
+        for fc in self.flavors.values():
+            fc.delete_node(name)
+
+    def add_usage(self, wl: Workload) -> None:
+        if wl.key in self._charged:
+            return
+        self._charged.add(wl.key)
+        for fc in self.flavors.values():
+            fc.add_usage(wl)
+
+    def remove_usage(self, wl: Workload) -> None:
+        if wl.key not in self._charged:
+            return
+        self._charged.discard(wl.key)
+        for fc in self.flavors.values():
+            fc.remove_usage(wl)
+
+    def snapshots(self) -> Dict[str, TASFlavorSnapshot]:
+        return {name: fc.snapshot() for name, fc in self.flavors.items()}

@@ -1,0 +1,147 @@
+"""TASManager — glue between the scheduler and the TAS cache.
+
+Implements the two hook points Scheduler exposes:
+
+- ``check``  <- checkPodSetAndFlavorMatchForTAS
+  (pkg/scheduler/flavorassigner/tas_flavorassigner.go:95-122): flavor /
+  podset TAS compatibility during flavor assignment.
+- ``assign`` <- Assignment.WorkloadsTopologyRequests (:31-50) +
+  ClusterQueueSnapshot.FindTopologyAssignmentsForWorkload
+  (pkg/cache/clusterqueue_snapshot.go:206-221): computes topology
+  assignments for every TAS podset of a nominated workload and attaches
+  them to the AssignmentResult, or degrades the mode to NO_FIT with the
+  failure reason.
+
+In-cycle usage visibility: the TASCache is charged on admission via the
+core Cache's tas hook (assume/add -> add_usage, delete/forget ->
+remove_usage), so later entries in the same cycle see earlier entries'
+TAS usage — equivalent to the reference's snapshot.AddWorkload updating
+the TAS snapshot in place.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from kueue_tpu.models import ClusterQueue, ResourceFlavor, Workload
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.core.flavor_assigner import AssignmentResult, GranularMode
+from kueue_tpu.tas.cache import TASCache
+from kueue_tpu.tas.snapshot import TASPodSetRequest
+
+
+class TASManager:
+    def __init__(self, tas_cache: TASCache, flavors: Dict[str, ResourceFlavor]):
+        self.tas_cache = tas_cache
+        self.flavors = flavors
+
+    # ---- helpers ----
+    def _is_tas_flavor(self, name: str) -> bool:
+        return name in self.tas_cache.flavors
+
+    def cq_tas_only(self, cq: ClusterQueue) -> bool:
+        """True when every flavor of the CQ is a TAS flavor (cq.tasOnly)."""
+        names = [
+            fq.name for rg in cq.resource_groups for fq in rg.flavors
+        ]
+        return bool(names) and all(self._is_tas_flavor(n) for n in names)
+
+    def _is_tas_implied(self, ps: PodSet, cq: ClusterQueue) -> bool:
+        return ps.topology_request is None and self.cq_tas_only(cq)
+
+    def _is_tas_requested(self, ps: PodSet, cq: ClusterQueue) -> bool:
+        return ps.topology_request is not None or self._is_tas_implied(ps, cq)
+
+    # ---- hook 1: flavor compatibility (tas_flavorassigner.go:95-122) ----
+    def check(
+        self, cq: ClusterQueue, ps: PodSet, flavor: ResourceFlavor
+    ) -> Optional[str]:
+        if ps.topology_request is not None:
+            if flavor.topology_name is None:
+                return (
+                    f'Flavor "{flavor.name}" does not support '
+                    "TopologyAwareScheduling"
+                )
+            fc = self.tas_cache.flavors.get(flavor.name)
+            if fc is None:
+                return f'Flavor "{flavor.name}" information missing in TAS cache'
+            # level check reads only the topology's level keys — no
+            # snapshot build on the flavor-walk hot path
+            tr = ps.topology_request
+            level = tr.level if tr.level is not None else fc.level_keys[-1]
+            if level not in fc.level_keys:
+                return (
+                    f'Flavor "{flavor.name}" does not contain the requested level'
+                )
+        if self._is_tas_implied(ps, cq):
+            return None
+        if ps.topology_request is None and flavor.topology_name is not None:
+            return f'Flavor "{flavor.name}" supports only TopologyAwareScheduling'
+        return None
+
+    # ---- hook 2: workload assignment ----
+    def assign(
+        self,
+        wl: Workload,
+        cq_name: str,
+        assignment: AssignmentResult,
+        snapshot,
+        cq: Optional[ClusterQueue] = None,
+        simulate_empty: bool = False,
+    ) -> AssignmentResult:
+        cq = cq or snapshot.cq_models.get(cq_name)
+        if cq is None:
+            return assignment
+        podsets = {ps.name: ps for ps in wl.pod_sets}
+
+        # group requests per TAS flavor, reference order
+        by_flavor: Dict[str, list] = {}
+        for psr in assignment.pod_sets:
+            ps = podsets.get(psr.name)
+            if ps is None or not self._is_tas_requested(ps, cq):
+                continue
+            if psr.reasons:  # no quota assignment for the podset
+                continue
+            flavor_names = {c.name for c in psr.flavors.values()}
+            if len(flavor_names) != 1:
+                psr.reasons.append(
+                    "more than one flavor assigned to a TAS pod set"
+                )
+                psr.update_mode(GranularMode.NO_FIT)
+                continue
+            flavor_name = next(iter(flavor_names))
+            if not self._is_tas_flavor(flavor_name):
+                psr.reasons.append(
+                    "workload requires Topology, but there is no TAS cache "
+                    "information for the assigned flavor"
+                )
+                psr.update_mode(GranularMode.NO_FIT)
+                continue
+            by_flavor.setdefault(flavor_name, []).append(
+                TASPodSetRequest(
+                    podset_name=psr.name,
+                    count=psr.count,
+                    single_pod_requests=dict(ps.requests),
+                    topology_request=ps.topology_request,
+                    tolerations=tuple(ps.tolerations),
+                    implied=self._is_tas_implied(ps, cq),
+                    flavor=flavor_name,
+                )
+            )
+
+        if not by_flavor:
+            return assignment
+
+        by_name = {psr.name: psr for psr in assignment.pod_sets}
+        for flavor_name, reqs in by_flavor.items():
+            snap = self.tas_cache.flavors[flavor_name].snapshot()
+            result = snap.find_topology_assignments(reqs, simulate_empty)
+            for ps_name, ta in result.assignments.items():
+                psr = by_name[ps_name]
+                if ta is not None:
+                    psr.topology_assignment = ta
+            if result.failure_reason:
+                psr = by_name[result.failed_podset]
+                psr.reasons.append(result.failure_reason)
+                psr.update_mode(GranularMode.NO_FIT)
+        return assignment

@@ -1,0 +1,402 @@
+"""Topology-Aware Scheduling tests.
+
+Behavior mirrored from pkg/cache/tas_flavor_snapshot_test.go scenarios:
+two-phase fit (bottom-up counts, level search, minimize-domains),
+required/preferred/unconstrained modes, BestFit vs LeastFree profiles,
+taint filtering, hostname-lowest assignments, multi-podset assumed
+usage, and the scheduler integration path.
+"""
+
+import numpy as np
+import pytest
+
+from kueue_tpu import features
+from kueue_tpu.models import ClusterQueue, LocalQueue, ResourceFlavor, Workload
+from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+from kueue_tpu.models.resource_flavor import Taint, Toleration
+from kueue_tpu.models.topology import Topology, TopologyLevel
+from kueue_tpu.models.workload import PodSet, PodSetTopologyRequest
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.queue_manager import QueueManager
+from kueue_tpu.core.scheduler import Scheduler
+from kueue_tpu.tas import Node, TASCache, TASFlavorSnapshot, TASManager, TASPodSetRequest
+from kueue_tpu.utils.clock import Clock
+
+BLOCK, RACK, HOST = "cloud/block", "cloud/rack", "kubernetes.io/hostname"
+
+
+def make_snapshot(levels=(BLOCK, RACK, HOST), nodes=None, tolerations=()):
+    snap = TASFlavorSnapshot("default", levels, tolerations=tolerations)
+    for labels, alloc, *rest in nodes or []:
+        taints = rest[0] if rest else ()
+        snap.add_node(labels, alloc, taints)
+    snap.freeze()
+    return snap
+
+
+def node(block, rack, host, cpu=4, pods=110):
+    return (
+        {BLOCK: block, RACK: rack, HOST: host},
+        {"cpu": cpu * 1000, "memory": 16 << 30, "pods": pods},
+    )
+
+
+def req(count, cpu=1000, mode="Required", level=RACK, name="main", implied=False):
+    tr = None
+    if mode is not None:
+        tr = PodSetTopologyRequest(
+            mode=mode, level=None if mode == "Unconstrained" else level
+        )
+    return TASPodSetRequest(
+        podset_name=name,
+        count=count,
+        single_pod_requests={"cpu": cpu},
+        topology_request=tr,
+        implied=implied,
+    )
+
+
+DEFAULT_NODES = [
+    node("b1", "r1", "h1"),
+    node("b1", "r1", "h2"),
+    node("b1", "r2", "h3"),
+    node("b2", "r3", "h4"),
+    node("b2", "r3", "h5"),
+    node("b2", "r3", "h6"),
+]
+
+
+class TestFindTopologyAssignment:
+    def test_required_rack_fits(self):
+        snap = make_snapshot(nodes=DEFAULT_NODES)
+        ta, reason = snap.find_topology_assignment(req(8, mode="Required"), {})
+        assert reason == ""
+        # r1 has 2 hosts x 4cpu = 8 pods of 1cpu; BestFit picks the
+        # smallest fitting rack: r1 (8) over r3 (12)
+        assert ta.levels == (HOST,)
+        assert sorted(d.values[0] for d in ta.domains) == ["h1", "h2"]
+        assert sum(d.count for d in ta.domains) == 8
+
+    def test_required_rack_no_fit(self):
+        snap = make_snapshot(nodes=DEFAULT_NODES)
+        ta, reason = snap.find_topology_assignment(req(13, mode="Required"), {})
+        assert ta is None
+        assert "allows to fit only 12 out of 13" in reason
+
+    def test_required_block_fits_two_racks(self):
+        snap = make_snapshot(nodes=DEFAULT_NODES)
+        ta, reason = snap.find_topology_assignment(
+            req(12, mode="Required", level=BLOCK), {}
+        )
+        assert reason == ""
+        # b1 and b2 tie at 12; the tie-break is level-values order -> b1,
+        # whose racks r1 (8) + r2 (4) are consumed largest-first
+        assert sorted(d.values[0] for d in ta.domains) == ["h1", "h2", "h3"]
+
+    def test_preferred_falls_back_up_a_level(self):
+        snap = make_snapshot(nodes=DEFAULT_NODES)
+        # no rack fits 13, but block b2 can't either (12); falls to
+        # multi-domain at block level (b1=12 + b2=12 >= 13)
+        ta, reason = snap.find_topology_assignment(
+            req(16, mode="Preferred", level=RACK), {}
+        )
+        assert reason == ""
+        assert sum(d.count for d in ta.domains) == 16
+
+    def test_preferred_too_big_fails(self):
+        snap = make_snapshot(nodes=DEFAULT_NODES)
+        ta, reason = snap.find_topology_assignment(
+            req(25, mode="Preferred", level=RACK), {}
+        )
+        assert ta is None
+        assert "allows to fit only 24 out of 25" in reason
+
+    def test_unconstrained_picks_hosts_directly(self):
+        snap = make_snapshot(nodes=DEFAULT_NODES)
+        ta, reason = snap.find_topology_assignment(req(2, mode="Unconstrained"), {})
+        assert reason == ""
+        assert sum(d.count for d in ta.domains) == 2
+
+    def test_best_fit_prefers_smallest_fitting_domain(self):
+        snap = make_snapshot(
+            nodes=[node("b1", "r1", "h1", cpu=16), node("b1", "r2", "h2", cpu=4)]
+        )
+        ta, reason = snap.find_topology_assignment(req(3, mode="Required"), {})
+        assert reason == ""
+        # r2 fits exactly-ish (4 >= 3) and is smaller than r1 (16)
+        assert ta.domains[0].values == ("h2",)
+
+    def test_least_free_profile(self):
+        with features.override("TASProfileLeastFreeCapacity", True):
+            snap = make_snapshot(
+                nodes=[node("b1", "r1", "h1", cpu=16), node("b1", "r2", "h2", cpu=4)]
+            )
+            ta, reason = snap.find_topology_assignment(req(3, mode="Required"), {})
+            assert reason == ""
+            assert ta.domains[0].values == ("h2",)
+            # least-free also changes multi-domain packing order
+            ta2, _ = snap.find_topology_assignment(
+                req(18, mode="Required", level=BLOCK), {}
+            )
+            counts = {d.values[0]: d.count for d in ta2.domains}
+            assert counts["h2"] == 4  # least-free host exhausted first
+
+    def test_most_free_profile_takes_biggest(self):
+        with features.override("TASProfileMostFreeCapacity", True):
+            snap = make_snapshot(
+                nodes=[node("b1", "r1", "h1", cpu=16), node("b1", "r2", "h2", cpu=4)]
+            )
+            ta, reason = snap.find_topology_assignment(req(3, mode="Required"), {})
+            assert reason == ""
+            assert ta.domains[0].values == ("h1",)
+
+    def test_taint_excludes_node(self):
+        taint = Taint(key="gpu", value="true", effect="NoSchedule")
+        nodes = [
+            node("b1", "r1", "h1") + ((taint,),),
+            node("b1", "r1", "h2"),
+        ]
+        snap = make_snapshot(nodes=nodes)
+        ta, reason = snap.find_topology_assignment(req(8, mode="Required"), {})
+        assert ta is None  # only h2 usable -> 4 pods max
+        r = req(8, mode="Required")
+        r.tolerations = (Toleration(key="gpu", operator="Exists"),)
+        ta, reason = snap.find_topology_assignment(r, {})
+        assert reason == ""
+
+    def test_hostname_lowest_level_emits_host_only_values(self):
+        snap = make_snapshot(nodes=DEFAULT_NODES)
+        ta, _ = snap.find_topology_assignment(req(1, mode="Required"), {})
+        assert ta.levels == (HOST,)
+        assert all(len(d.values) == 1 for d in ta.domains)
+
+    def test_non_hostname_lowest_emits_full_values(self):
+        snap = make_snapshot(
+            levels=(BLOCK, RACK),
+            nodes=[node("b1", "r1", "hX"), node("b1", "r2", "hY")],
+        )
+        ta, reason = snap.find_topology_assignment(
+            req(4, mode="Required", level=RACK), {}
+        )
+        assert reason == ""
+        assert ta.levels == (BLOCK, RACK)
+        assert ta.domains[0].values == ("b1", "r1") or ta.domains[0].values == ("b1", "r2")
+
+    def test_pods_capacity_limits(self):
+        snap = make_snapshot(nodes=[node("b1", "r1", "h1", cpu=1000, pods=3)])
+        ta, reason = snap.find_topology_assignment(req(4, mode="Required"), {})
+        assert ta is None
+        ta, reason = snap.find_topology_assignment(req(3, mode="Required"), {})
+        assert reason == ""
+
+    def test_multi_podset_assumed_usage(self):
+        snap = make_snapshot(nodes=[node("b1", "r1", "h1", cpu=8)])
+        res = snap.find_topology_assignments(
+            [req(4, name="a"), req(4, name="b")]
+        )
+        assert res.failure_reason == ""
+        assert set(res.assignments) == {"a", "b"}
+        # a third podset cannot fit: 8 cpus consumed
+        res = snap.find_topology_assignments(
+            [req(4, name="a"), req(4, name="b"), req(1, name="c")]
+        )
+        assert res.failed_podset == "c"
+
+    def test_simulate_empty_ignores_tas_usage(self):
+        snap = make_snapshot(nodes=[node("b1", "r1", "h1", cpu=4)])
+        snap.add_tas_usage("h1", {"cpu": 4000}, 4)
+        ta, reason = snap.find_topology_assignment(req(4, mode="Required"), {})
+        assert ta is None
+        ta, reason = snap.find_topology_assignment(
+            req(4, mode="Required"), {}, simulate_empty=True
+        )
+        assert reason == ""
+
+    def test_missing_level_reported(self):
+        snap = make_snapshot(nodes=DEFAULT_NODES)
+        r = req(1, mode="Required", level="no/such-level")
+        ta, reason = snap.find_topology_assignment(r, {})
+        assert "no requested topology level" in reason
+
+    def test_non_tas_usage_reduces_capacity(self):
+        snap = TASFlavorSnapshot("default", (BLOCK, RACK, HOST))
+        did = snap.add_node(*node("b1", "r1", "h1", cpu=4))
+        snap.add_non_tas_usage(did, {"cpu": 2000})
+        snap.freeze()
+        ta, reason = snap.find_topology_assignment(req(3, mode="Required"), {})
+        assert ta is None
+        ta, reason = snap.find_topology_assignment(req(2, mode="Required"), {})
+        assert reason == ""
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_leaf_counts_match_host(self, seed):
+        from kueue_tpu._jax import jnp
+        from kueue_tpu.ops.tas_kernel import fill_in_counts, topology_from_snapshot
+
+        rng = np.random.default_rng(seed)
+        nodes = []
+        for b in range(2):
+            for r in range(3):
+                for h in range(rng.integers(1, 4)):
+                    nodes.append(
+                        node(f"b{b}", f"r{b}-{r}", f"h{b}{r}{h}", cpu=int(rng.integers(1, 9)))
+                    )
+        snap = make_snapshot(nodes=nodes)
+        topo = topology_from_snapshot(snap)
+
+        reqs, assumed, taint_ok, sim = [], [], [], []
+        host_counts = []
+        n_l = len(snap._leaf_order)
+        for _ in range(3):
+            cpu = int(rng.integers(500, 3000))
+            request = {"cpu": cpu, "pods": 1}
+            host_counts.append(
+                snap._leaf_counts(request, {}, False, ())
+            )
+            vec = np.zeros(len(snap._resources), dtype=np.int64)
+            for rname, v in request.items():
+                vec[snap._resources.index(rname)] = v
+            reqs.append(vec)
+            assumed.append(np.zeros((n_l, len(snap._resources)), dtype=np.int64))
+            taint_ok.append(np.ones(n_l, dtype=bool))
+            sim.append(False)
+
+        counts, levels = fill_in_counts(
+            topo,
+            jnp.asarray(np.stack(reqs)),
+            jnp.asarray(np.stack(assumed)),
+            jnp.asarray(np.stack(taint_ok)),
+            jnp.asarray(np.array(sim)),
+        )
+        np.testing.assert_array_equal(np.asarray(counts), np.stack(host_counts))
+        # level sums must equal host bubble-up states
+        for d, lc in enumerate(levels):
+            total = np.asarray(lc).sum(axis=1)
+            np.testing.assert_array_equal(total, np.stack(host_counts).sum(axis=1))
+
+
+def build_tas_env(nodes, quota_cpu="24"):
+    cache = Cache()
+    qm = QueueManager(Clock())
+    topo = Topology(
+        name="default",
+        levels=(TopologyLevel(BLOCK), TopologyLevel(RACK), TopologyLevel(HOST)),
+    )
+    flavor = ResourceFlavor(name="tas-flavor", topology_name="default")
+    tas = TASCache()
+    tas.add_or_update_topology(topo)
+    cache.add_or_update_topology(topo)
+    cache.add_or_update_flavor(flavor)
+    tas.add_or_update_flavor(flavor)
+    for i, (labels, alloc, *rest) in enumerate(nodes):
+        tas.add_or_update_node(
+            Node(name=f"n{i}", labels=labels, allocatable=alloc, taints=rest[0] if rest else ())
+        )
+    cache.tas_cache = tas
+    cq = ClusterQueue(
+        name="cq",
+        namespace_selector={},
+        resource_groups=(
+            ResourceGroup(
+                ("cpu",), (FlavorQuotas.build("tas-flavor", {"cpu": quota_cpu}),)
+            ),
+        ),
+    )
+    cache.add_or_update_cluster_queue(cq)
+    qm.add_cluster_queue(cq)
+    cache.add_or_update_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+    qm.add_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+    manager = TASManager(tas, cache.flavors)
+    sched = Scheduler(
+        queues=qm, cache=cache, clock=Clock(),
+        tas_check=manager.check, tas_assign=manager.assign,
+    )
+    return sched, qm, cache, tas, manager
+
+
+def tas_workload(name, count, cpu="1", mode="Required", level=RACK, t=0.0):
+    tr = PodSetTopologyRequest(mode=mode, level=None if mode == "Unconstrained" else level)
+    return Workload(
+        namespace="ns", name=name, queue_name="lq", creation_time=t,
+        pod_sets=(PodSet.build("main", count, {"cpu": cpu}, topology_request=tr),),
+    )
+
+
+class TestSchedulerIntegration:
+    def test_admission_carries_topology_assignment(self):
+        sched, qm, cache, tas, _ = build_tas_env(DEFAULT_NODES)
+        qm.add_or_update_workload(tas_workload("w1", 8))
+        res = sched.schedule()
+        assert len(res.admitted) == 1
+        adm = res.admitted[0].workload.admission
+        ta = adm.pod_set_assignments[0].topology_assignment
+        assert ta is not None
+        assert sum(d.count for d in ta.domains) == 8
+
+    def test_second_workload_sees_first_usage(self):
+        sched, qm, cache, tas, _ = build_tas_env(DEFAULT_NODES)
+        qm.add_or_update_workload(tas_workload("w1", 12, t=0.0))  # fills r3
+        res = sched.schedule()
+        assert [e.workload.name for e in res.admitted] == ["w1"]
+        # w2 requires a rack with 8 free: only r1 remains (r3 full)
+        qm.add_or_update_workload(tas_workload("w2", 8, t=1.0))
+        res = sched.schedule()
+        assert [e.workload.name for e in res.admitted] == ["w2"]
+        hosts = {
+            d.values[0]
+            for e in res.admitted
+            for d in e.workload.admission.pod_set_assignments[0].topology_assignment.domains
+        }
+        assert hosts == {"h1", "h2"}
+
+    def test_tas_capacity_exhausted_requeues(self):
+        sched, qm, cache, tas, _ = build_tas_env(DEFAULT_NODES, quota_cpu="100")
+        qm.add_or_update_workload(tas_workload("w1", 12, t=0.0))
+        sched.schedule()
+        qm.add_or_update_workload(tas_workload("w2", 12, t=1.0))
+        res = sched.schedule()
+        assert res.admitted == []
+        assert any("fit" in (e.inadmissible_msg or "") for e in res.requeued)
+
+    def test_workload_removal_frees_tas_capacity(self):
+        sched, qm, cache, tas, _ = build_tas_env(DEFAULT_NODES, quota_cpu="100")
+        wl = tas_workload("w1", 12, t=0.0)
+        qm.add_or_update_workload(wl)
+        res = sched.schedule()
+        admitted_wl = res.admitted[0].workload
+        qm.add_or_update_workload(tas_workload("w2", 12, t=1.0))
+        assert sched.schedule().admitted == []
+        cache.delete_workload(admitted_wl)
+        qm.queue_associated_inadmissible_workloads_after("cq")
+        res = sched.schedule()
+        assert [e.workload.name for e in res.admitted] == ["w2"]
+
+    def test_non_tas_podset_rejected_on_tas_flavor(self):
+        sched, qm, cache, tas, manager = build_tas_env(DEFAULT_NODES)
+        wl = Workload(
+            namespace="ns", name="plain", queue_name="lq", creation_time=0.0,
+            pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+        )
+        # CQ is TAS-only -> TAS is implied, so this is admitted with an
+        # implied assignment at the lowest level
+        qm.add_or_update_workload(wl)
+        res = sched.schedule()
+        assert len(res.admitted) == 1
+        ta = res.admitted[0].workload.admission.pod_set_assignments[0].topology_assignment
+        assert ta is not None
+
+    def test_check_rejects_topology_request_on_plain_flavor(self):
+        _, _, cache, tas, manager = build_tas_env(DEFAULT_NODES)
+        plain = ResourceFlavor(name="plain")
+        cq = ClusterQueue(
+            name="cq2", namespace_selector={},
+            resource_groups=(ResourceGroup(("cpu",), (FlavorQuotas.build("plain", {"cpu": "8"}),)),),
+        )
+        ps = PodSet.build(
+            "main", 1, {"cpu": "1"},
+            topology_request=PodSetTopologyRequest(mode="Required", level=RACK),
+        )
+        msg = manager.check(cq, ps, plain)
+        assert "does not support TopologyAwareScheduling" in msg
