@@ -1,0 +1,263 @@
+"""ClusterRuntime — the in-process control plane.
+
+The analog of cmd/kueue/main.go:106-253 wiring plus the API-server
+substrate the reference controllers react to: object stores for jobs and
+workloads, the queue manager + cache pair, the scheduler, and the
+reconcilers, driven deterministically by ``run_until_idle`` (event ->
+reconcile -> schedule -> reconcile ... until quiescent), which is what
+the reference achieves asynchronously with informers + workqueues.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from kueue_tpu.models import (
+    AdmissionCheck,
+    ClusterQueue,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+    WorkloadPriorityClass,
+)
+from kueue_tpu.models.cohort import Cohort
+from kueue_tpu.models.constants import WorkloadConditionType
+from kueue_tpu.models.topology import Topology
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.queue_manager import QueueManager, RequeueReason
+from kueue_tpu.core.scheduler import Scheduler
+from kueue_tpu.controllers.jobframework import GenericJob, JobReconciler
+from kueue_tpu.controllers.workload_controller import (
+    WaitForPodsReadyConfig,
+    WorkloadReconciler,
+)
+from kueue_tpu.utils.clock import Clock
+
+
+@dataclass
+class Event:
+    kind: str
+    object_key: str
+    message: str = ""
+
+
+class ClusterRuntime:
+    def __init__(
+        self,
+        clock: Optional[Clock] = None,
+        wait_for_pods_ready: Optional[WaitForPodsReadyConfig] = None,
+        manage_jobs_without_queue_name: bool = False,
+        fair_sharing: bool = False,
+        tas_cache=None,
+    ):
+        self.clock = clock or Clock()
+        self.cache = Cache()
+        self.queues = QueueManager(self.clock)
+        self.workloads: Dict[str, Workload] = {}
+        self.jobs: Dict[str, GenericJob] = {}
+        self.events: List[Event] = []
+        self.pods_ready_cfg = wait_for_pods_ready or WaitForPodsReadyConfig()
+
+        tas_check = tas_assign = None
+        self.tas_manager = None
+        if tas_cache is not None:
+            from kueue_tpu.tas import TASManager
+
+            self.cache.tas_cache = tas_cache
+            self.tas_manager = TASManager(tas_cache, self.cache.flavors)
+            tas_check = self.tas_manager.check
+            tas_assign = self.tas_manager.assign
+
+        from kueue_tpu.core.preemption import Preemptor
+
+        self.scheduler = Scheduler(
+            queues=self.queues,
+            cache=self.cache,
+            clock=self.clock,
+            preemptor=Preemptor(
+                self.clock,
+                enable_fair_sharing=fair_sharing,
+                events=lambda kind, wl, msg: self.event(kind, wl, msg),
+            ),
+            fair_sharing=fair_sharing,
+            wait_for_pods_ready_block=self.pods_ready_cfg.enable
+            and self.pods_ready_cfg.block_admission,
+            tas_check=tas_check,
+            tas_assign=tas_assign,
+            events=lambda kind, wl, msg: self.event(kind, wl, msg),
+        )
+        self.job_reconciler = JobReconciler(
+            self,
+            manage_jobs_without_queue_name=manage_jobs_without_queue_name,
+            wait_for_pods_ready=self.pods_ready_cfg.enable,
+        )
+        self.workload_reconciler = WorkloadReconciler(
+            self, wait_for_pods_ready=self.pods_ready_cfg
+        )
+        # AdmissionCheck controllers (provisioning, multikueue, custom):
+        # name -> callable(workload) run during reconcile loops
+        self.admission_check_controllers: List[Callable[[Workload], None]] = []
+
+    # ---- events ----
+    def event(self, kind: str, wl: Workload, message: str = "") -> None:
+        self.events.append(Event(kind=kind, object_key=wl.key, message=message))
+
+    # ---- API-object lifecycle (delegates, main.go setupControllers) ----
+    def add_cluster_queue(self, cq: ClusterQueue) -> None:
+        self.cache.add_or_update_cluster_queue(cq)
+        self.queues.add_cluster_queue(cq)
+
+    def delete_cluster_queue(self, name: str) -> None:
+        self.cache.delete_cluster_queue(name)
+        self.queues.delete_cluster_queue(name)
+
+    def add_local_queue(self, lq: LocalQueue) -> None:
+        self.cache.add_or_update_local_queue(lq)
+        self.queues.add_local_queue(lq)
+
+    def add_flavor(self, flavor: ResourceFlavor) -> None:
+        self.cache.add_or_update_flavor(flavor)
+        if self.cache.tas_cache is not None:
+            self.cache.tas_cache.add_or_update_flavor(flavor)
+
+    def add_topology(self, topo: Topology) -> None:
+        self.cache.add_or_update_topology(topo)
+        if self.cache.tas_cache is not None:
+            self.cache.tas_cache.add_or_update_topology(topo)
+
+    def add_cohort(self, cohort: Cohort) -> None:
+        self.cache.add_or_update_cohort(cohort)
+        self.queues.forest.add_cohort(cohort.name, cohort.parent)
+
+    def add_admission_check(self, ac: AdmissionCheck) -> None:
+        self.cache.add_or_update_admission_check(ac)
+
+    def add_priority_class(self, pc: WorkloadPriorityClass) -> None:
+        self.cache.add_or_update_priority_class(pc)
+
+    # ---- jobs ----
+    def add_job(self, job: GenericJob) -> None:
+        self.jobs[job.key] = job
+
+    def delete_job(self, key: str) -> None:
+        job = self.jobs.pop(key, None)
+        if job is None:
+            return
+        # job deletion releases its workload (reconciler dropFinalizers)
+        wl = self.workloads.get(
+            f"{job.namespace}/{self.job_reconciler.workload_name_for(job)}"
+        )
+        if wl is not None:
+            self.delete_workload(wl)
+
+    # ---- workload store, used by reconcilers ----
+    def add_workload(self, wl: Workload) -> None:
+        self.workloads[wl.key] = wl
+        if wl.admission is not None and wl.has_quota_reservation:
+            self.cache.add_or_update_workload(wl)
+        else:
+            self.queues.add_or_update_workload(wl)
+
+    def delete_workload(self, wl: Workload) -> None:
+        self.workloads.pop(wl.key, None)
+        self.queues.delete_workload(wl)
+        if self.cache.delete_workload(wl):
+            self.queues.queue_associated_inadmissible_workloads_after(
+                wl.admission.cluster_queue if wl.admission else ""
+            )
+
+    def on_workload_finished(self, wl: Workload) -> None:
+        cq_name = wl.admission.cluster_queue if wl.admission else ""
+        self.queues.delete_workload(wl)
+        if self.cache.delete_workload(wl):
+            self.queues.queue_associated_inadmissible_workloads_after(cq_name)
+
+    def unset_quota_reservation(self, wl: Workload, reason: str, message: str) -> None:
+        """workload.UnsetQuotaReservationWithCondition + requeue."""
+        now = self.clock.now()
+        cq_name = wl.admission.cluster_queue if wl.admission else ""
+        if self.cache.delete_workload(wl):
+            self.queues.queue_associated_inadmissible_workloads_after(cq_name)
+        wl.admission = None
+        wl.set_condition(
+            WorkloadConditionType.QUOTA_RESERVED, False, reason, message, now=now
+        )
+        if WorkloadConditionType.ADMITTED in wl.conditions:
+            wl.set_condition(
+                WorkloadConditionType.ADMITTED, False, "NoReservation",
+                "The workload has no reservation", now=now,
+            )
+        wl.conditions.pop(WorkloadConditionType.EVICTED, None)
+        if wl.active:
+            self.queues.requeue_workload(wl, RequeueReason.GENERIC)
+
+    def requeue_after_backoff(self, wl: Workload) -> None:
+        # The Requeued-condition flip is a workload update event: the
+        # queue's push_or_update unparks it (manager.go UpdateWorkload).
+        self.queues.add_or_update_workload(wl)
+
+    def on_pods_ready_changed(self, wl: Workload, ready: bool) -> None:
+        if ready:
+            self.cache.workloads_not_ready.discard(wl.key)
+        elif wl.is_admitted:
+            self.cache.workloads_not_ready.add(wl.key)
+
+    def on_workload_queue_changed(self, wl: Workload) -> None:
+        self.queues.delete_workload(wl)
+        self.queues.add_or_update_workload(wl)
+
+    def update_reclaimable_pods(self, wl: Workload, recl: Dict[str, int]) -> None:
+        wl.reclaimable_pods = dict(recl)
+        # dynamic reclaim frees quota for admitted workloads: re-track
+        if wl.admission is not None:
+            self.cache.add_or_update_workload(wl)
+            self.queues.queue_associated_inadmissible_workloads_after(
+                wl.admission.cluster_queue
+            )
+
+    # ---- the loop ----
+    def reconcile_once(self) -> None:
+        for job in list(self.jobs.values()):
+            self.job_reconciler.reconcile(job)
+        for wl in list(self.workloads.values()):
+            self.workload_reconciler.reconcile(wl)
+            for ctrl in self.admission_check_controllers:
+                ctrl(wl)
+
+    def _state_fingerprint(self):
+        parts = []
+        for key in sorted(self.workloads):
+            wl = self.workloads[key]
+            parts.append(
+                (
+                    key,
+                    wl.active,
+                    wl.admission.cluster_queue if wl.admission else None,
+                    tuple(
+                        (t.value, c.status, c.reason)
+                        for t, c in sorted(wl.conditions.items())
+                    ),
+                    tuple(
+                        (n, s.state.value)
+                        for n, s in sorted(wl.admission_check_states.items())
+                    ),
+                )
+            )
+        for key in sorted(self.jobs):
+            job = self.jobs[key]
+            parts.append((key, job.is_suspended()))
+        return tuple(parts), len(self.events)
+
+    def run_until_idle(self, max_iterations: int = 50) -> int:
+        """Reconcile + schedule until nothing changes. Returns cycles."""
+        cycles = 0
+        for _ in range(max_iterations):
+            before = self._state_fingerprint()
+            self.reconcile_once()
+            self.scheduler.schedule()
+            self.reconcile_once()
+            cycles += 1
+            if self._state_fingerprint() == before:
+                break
+        return cycles

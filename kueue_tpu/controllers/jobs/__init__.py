@@ -1,0 +1,6 @@
+"""Built-in job integrations (pkg/controller/jobs/*)."""
+
+from kueue_tpu.controllers.jobs.batch_job import BatchJob
+from kueue_tpu.controllers.jobs.jobset import JobSet, ReplicatedJob
+
+__all__ = ["BatchJob", "JobSet", "ReplicatedJob"]

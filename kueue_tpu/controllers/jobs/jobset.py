@@ -1,0 +1,128 @@
+"""JobSet integration.
+
+Reference: pkg/controller/jobs/jobset/jobset_controller.go (244 LoC).
+Each ReplicatedJob becomes one podset with count = replicas x
+per-replica parallelism; suspend semantics mirror batch/Job.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+from kueue_tpu.controllers.jobframework import GenericJob
+from kueue_tpu.controllers.podset_info import PodSetInfo
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.resources import Requests, requests_from_spec
+
+
+@dataclass
+class ReplicatedJob:
+    name: str
+    replicas: int = 1
+    parallelism: int = 1
+    requests: Requests = field(default_factory=dict)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: Tuple = ()
+
+    @staticmethod
+    def build(name, replicas=1, parallelism=1, requests=None, **kw) -> "ReplicatedJob":
+        return ReplicatedJob(
+            name=name, replicas=replicas, parallelism=parallelism,
+            requests=requests_from_spec(requests or {}), **kw,
+        )
+
+    @property
+    def pod_count(self) -> int:
+        return self.replicas * self.parallelism
+
+
+@dataclass
+class JobSet(GenericJob):
+    kind = "JobSet"
+    namespace: str = ""
+    name: str = ""
+    queue: str = ""
+    priority_class: str = ""
+    suspended: bool = True
+    replicated_jobs: Tuple[ReplicatedJob, ...] = ()
+
+    # simulated status
+    active_pods: int = 0
+    ready_pods: int = 0
+    terminal_state: str = ""  # "" | Completed | Failed
+
+    _original_selectors: Optional[Dict[str, Dict[str, str]]] = None
+
+    def queue_name(self) -> str:
+        return self.queue
+
+    def workload_priority_class(self) -> str:
+        return self.priority_class
+
+    def is_suspended(self) -> bool:
+        return self.suspended
+
+    def suspend(self) -> None:
+        self.suspended = True
+        self.active_pods = 0
+        self.ready_pods = 0
+
+    def pod_sets(self) -> Tuple[PodSet, ...]:
+        return tuple(
+            PodSet(
+                name=rj.name,
+                count=rj.pod_count,
+                requests=dict(rj.requests),
+                node_selector=dict(rj.node_selector),
+                tolerations=tuple(rj.tolerations),
+            )
+            for rj in self.replicated_jobs
+        )
+
+    def run_with_podsets_info(self, infos: Sequence[PodSetInfo]) -> None:
+        by_name = {i.name: i for i in infos}
+        self._original_selectors = {
+            rj.name: dict(rj.node_selector) for rj in self.replicated_jobs
+        }
+        for rj in self.replicated_jobs:
+            info = by_name.get(rj.name)
+            if info is not None:
+                merged = dict(rj.node_selector)
+                merged.update(info.node_selector)
+                rj.node_selector = merged
+        self.suspended = False
+        self.active_pods = sum(rj.pod_count for rj in self.replicated_jobs)
+
+    def restore_podsets_info(self, infos: Sequence[PodSetInfo]) -> bool:
+        changed = False
+        if self._original_selectors is not None:
+            for rj in self.replicated_jobs:
+                orig = self._original_selectors.get(rj.name)
+                if orig is not None and rj.node_selector != orig:
+                    rj.node_selector = orig
+                    changed = True
+            self._original_selectors = None
+        return changed
+
+    def is_active(self) -> bool:
+        return self.active_pods > 0
+
+    def finished(self) -> Tuple[str, bool, bool]:
+        if self.terminal_state == "Completed":
+            return "JobSet finished successfully", True, True
+        if self.terminal_state == "Failed":
+            return "JobSet failed", False, True
+        return "", False, False
+
+    def pods_ready(self) -> bool:
+        total = sum(rj.pod_count for rj in self.replicated_jobs)
+        return not self.suspended and self.ready_pods >= total
+
+    # simulation helpers
+    def mark_pods_ready(self) -> None:
+        self.ready_pods = sum(rj.pod_count for rj in self.replicated_jobs)
+
+    def complete(self, success: bool = True) -> None:
+        self.terminal_state = "Completed" if success else "Failed"
+        self.active_pods = 0

@@ -106,9 +106,16 @@ class PendingClusterQueue:
         self._forget_inflight(key)
         old = self.inadmissible.get(key)
         if old is not None:
-            # Stay parked if nothing admission-relevant changed
-            # (spec / reclaimable pods / Evicted / Requeued conditions).
-            if (
+            if old is wl:
+                # In-place mutation (no API-server copies here): the
+                # change test below can't fire — re-evaluate only the
+                # backoff gate so a finished backoff unparks while
+                # irrelevant updates stay parked.
+                if not self._backoff_expired(wl):
+                    return
+            elif (
+                # Stay parked if nothing admission-relevant changed
+                # (spec / reclaimable pods / Evicted / Requeued conditions).
                 old.pod_sets == wl.pod_sets
                 and old.reclaimable_pods == wl.reclaimable_pods
                 and old.priority == wl.priority

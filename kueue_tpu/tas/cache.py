@@ -117,24 +117,35 @@ class TASCache:
         # Charged workload keys — makes add/remove idempotent so event
         # replays or CQ-gone teardown paths can't double-charge/release.
         self._charged: set = set()
+        # Every TAS-intent flavor ever seen, so a Topology arriving late
+        # rebinds flavors added before it.
+        self._flavor_objs: Dict[str, ResourceFlavor] = {}
+        # Bumped on any mutation; consumers cache snapshots per generation.
+        self.generation = 0
 
     def add_or_update_topology(self, topo: Topology) -> None:
         self.topologies[topo.name] = topo
-        # (re)bind any flavor referencing this topology
-        for fc in list(self.flavors.values()):
-            if fc.flavor.topology_name == topo.name:
-                self.add_or_update_flavor(fc.flavor)
+        self.generation += 1
+        # (re)bind any flavor referencing this topology — including ones
+        # added before the topology existed
+        for flavor in list(self._flavor_objs.values()):
+            if flavor.topology_name == topo.name:
+                self.add_or_update_flavor(flavor)
 
     def delete_topology(self, name: str) -> None:
         self.topologies.pop(name, None)
+        self.generation += 1
 
     def add_or_update_flavor(self, flavor: ResourceFlavor) -> Optional[str]:
         """Track a TAS flavor; returns an error string when the
         referenced Topology is missing (CQ goes inactive with that
         reason in the reference)."""
+        self.generation += 1
         if flavor.topology_name is None:
             self.flavors.pop(flavor.name, None)
+            self._flavor_objs.pop(flavor.name, None)
             return None
+        self._flavor_objs[flavor.name] = flavor
         topo = self.topologies.get(flavor.topology_name)
         if topo is None:
             self.flavors.pop(flavor.name, None)
@@ -151,16 +162,20 @@ class TASCache:
 
     def delete_flavor(self, name: str) -> None:
         self.flavors.pop(name, None)
+        self._flavor_objs.pop(name, None)
+        self.generation += 1
 
     def add_or_update_node(self, node: Node) -> None:
         self._nodes[node.name] = node
         for fc in self.flavors.values():
             fc.add_or_update_node(node)
+        self.generation += 1
 
     def delete_node(self, name: str) -> None:
         self._nodes.pop(name, None)
         for fc in self.flavors.values():
             fc.delete_node(name)
+        self.generation += 1
 
     def add_usage(self, wl: Workload) -> None:
         if wl.key in self._charged:
@@ -168,6 +183,7 @@ class TASCache:
         self._charged.add(wl.key)
         for fc in self.flavors.values():
             fc.add_usage(wl)
+        self.generation += 1
 
     def remove_usage(self, wl: Workload) -> None:
         if wl.key not in self._charged:
@@ -175,6 +191,7 @@ class TASCache:
         self._charged.discard(wl.key)
         for fc in self.flavors.values():
             fc.remove_usage(wl)
+        self.generation += 1
 
     def snapshots(self) -> Dict[str, TASFlavorSnapshot]:
         return {name: fc.snapshot() for name, fc in self.flavors.items()}

@@ -34,6 +34,21 @@ class TASManager:
     def __init__(self, tas_cache: TASCache, flavors: Dict[str, ResourceFlavor]):
         self.tas_cache = tas_cache
         self.flavors = flavors
+        # snapshots cached per TASCache generation: one build per state
+        # change instead of one per nominated workload
+        self._snapshots = {}
+        self._snap_gen = -1
+
+    def _snapshot_for(self, flavor_name: str):
+        gen = self.tas_cache.generation
+        if gen != self._snap_gen:
+            self._snapshots = {}
+            self._snap_gen = gen
+        snap = self._snapshots.get(flavor_name)
+        if snap is None:
+            snap = self.tas_cache.flavors[flavor_name].snapshot()
+            self._snapshots[flavor_name] = snap
+        return snap
 
     # ---- helpers ----
     def _is_tas_flavor(self, name: str) -> bool:
@@ -134,7 +149,7 @@ class TASManager:
 
         by_name = {psr.name: psr for psr in assignment.pod_sets}
         for flavor_name, reqs in by_flavor.items():
-            snap = self.tas_cache.flavors[flavor_name].snapshot()
+            snap = self._snapshot_for(flavor_name)
             result = snap.find_topology_assignments(reqs, simulate_empty)
             for ps_name, ta in result.assignments.items():
                 psr = by_name[ps_name]

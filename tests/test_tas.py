@@ -271,10 +271,25 @@ class TestKernelParity:
             jnp.asarray(np.array(sim)),
         )
         np.testing.assert_array_equal(np.asarray(counts), np.stack(host_counts))
-        # level sums must equal host bubble-up states
-        for d, lc in enumerate(levels):
-            total = np.asarray(lc).sum(axis=1)
-            np.testing.assert_array_equal(total, np.stack(host_counts).sum(axis=1))
+        # per-domain level vectors must equal the host bubble-up states
+        # (kernel domain order at level d = sorted level-value prefixes)
+        for b, host_leaf in enumerate(host_counts):
+            # replay the host bubble-up for this request
+            snap.fill_in_counts(
+                {"cpu": int(reqs[b][snap._resources.index("cpu")]),
+                 "pods": 1},
+                {}, False, (),
+            )
+            for d, lc in enumerate(levels):
+                doms = sorted(
+                    snap.domains_per_level[d].values(),
+                    key=lambda dm: dm.level_values[: d + 1],
+                )
+                host_states = np.array([dm.state for dm in doms], dtype=np.int64)
+                np.testing.assert_array_equal(
+                    np.asarray(lc)[b], host_states,
+                    err_msg=f"request {b} level {d}",
+                )
 
 
 def build_tas_env(nodes, quota_cpu="24"):
