@@ -1,0 +1,32 @@
+"""AdmissionCheck controllers — the two-phase-admission plugin boundary.
+
+Reference: pkg/controller/admissionchecks/{provisioning,multikueue}.
+Phase 1 (quota reservation) happens in the scheduler; these controllers
+flip per-workload check states to Ready (phase 2) before the workload
+becomes Admitted, exactly the boundary BASELINE.json keeps intact for
+the `jax-assign` solver plugin.
+"""
+
+from kueue_tpu.admissionchecks.provisioning import (
+    PROVISIONING_CONTROLLER_NAME,
+    ProvisioningController,
+    ProvisioningRequest,
+    ProvisioningRequestConfig,
+)
+from kueue_tpu.admissionchecks.multikueue import (
+    MULTIKUEUE_CONTROLLER_NAME,
+    MultiKueueCluster,
+    MultiKueueConfig,
+    MultiKueueController,
+)
+
+__all__ = [
+    "PROVISIONING_CONTROLLER_NAME",
+    "ProvisioningController",
+    "ProvisioningRequest",
+    "ProvisioningRequestConfig",
+    "MULTIKUEUE_CONTROLLER_NAME",
+    "MultiKueueCluster",
+    "MultiKueueConfig",
+    "MultiKueueController",
+]

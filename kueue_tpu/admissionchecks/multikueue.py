@@ -1,0 +1,302 @@
+"""MultiKueue — multi-cluster dispatch admission-check controller.
+
+Reference: pkg/controller/admissionchecks/multikueue (≈1.7k LoC):
+multikueuecluster.go:76-187 (remote clients + reconnect backoff),
+workload.go:159-425 (remote copies, first-reserving wins, status
+sync-back, finish propagation, workerLostTimeout, GC).
+
+TPU-native shape: a "remote cluster" is another ClusterRuntime (the
+in-process analog of a kubeconfig-built client; in a deployment this
+boundary is the gRPC/DCN link between control planes). The controller:
+
+1. creates remote Workload copies on every configured cluster,
+2. the first remote to reserve quota wins — copies elsewhere are
+   deleted,
+3. syncs the job to the winner via a MultiKueueAdapter and flips the
+   local check Ready (local job stays suspended under managedBy),
+4. copies Finished back to the local workload and GCs remote objects,
+5. on cluster loss past worker_lost_timeout, requeues the workload
+   (check -> Retry).
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu.models import Workload
+from kueue_tpu.models.constants import (
+    MULTIKUEUE_CONTROLLER_NAME,
+    AdmissionCheckStateType,
+    WorkloadConditionType,
+)
+
+
+@dataclass
+class MultiKueueCluster:
+    """multikueue_types.go:61-137 — one worker cluster."""
+
+    name: str
+    runtime: object  # the remote ClusterRuntime ("kubeconfig client")
+    active: bool = True  # connectivity (remoteClient reconnect state)
+    lost_since: Optional[float] = None
+
+    def mark_lost(self, now: float) -> None:
+        if self.active:
+            self.active = False
+            self.lost_since = now
+
+    def mark_connected(self) -> None:
+        self.active = True
+        self.lost_since = None
+
+
+@dataclass
+class MultiKueueConfig:
+    name: str
+    clusters: Tuple[str, ...] = ()
+
+
+class MultiKueueAdapter:
+    """MultiKueueAdapter SPI (jobframework/interface.go:235-252)."""
+
+    def sync_job(self, local_job, remote_runtime, wl: Workload) -> None:
+        """Create/update the job object on the remote cluster."""
+        raise NotImplementedError
+
+    def delete_remote_job(self, local_job, remote_runtime) -> None:
+        raise NotImplementedError
+
+    def copy_status(self, local_job, remote_runtime) -> None:
+        """Copy remote job status back into the local job."""
+        raise NotImplementedError
+
+
+class BatchJobAdapter(MultiKueueAdapter):
+    """MultiKueue adapter for batch/Job (jobs/job/job_multikueue_adapter)."""
+
+    def _remote_key(self, local_job):
+        return local_job.key
+
+    def sync_job(self, local_job, remote_runtime, wl: Workload) -> None:
+        if local_job.key in remote_runtime.jobs:
+            return
+        remote_job = deepcopy(local_job)
+        remote_job.managed_by = None  # remote kueue manages its copy
+        remote_job.suspended = True
+        remote_job.active_pods = 0
+        remote_runtime.add_job(remote_job)
+
+    def delete_remote_job(self, local_job, remote_runtime) -> None:
+        remote_runtime.delete_job(local_job.key)
+
+    def copy_status(self, local_job, remote_runtime) -> None:
+        remote_job = remote_runtime.jobs.get(local_job.key)
+        if remote_job is None:
+            return
+        local_job.succeeded = remote_job.succeeded
+        local_job.failed = remote_job.failed
+        local_job.ready_pods = remote_job.ready_pods
+
+
+class MultiKueueController:
+    def __init__(
+        self,
+        runtime,
+        clusters: Optional[Dict[str, MultiKueueCluster]] = None,
+        configs: Optional[Dict[str, MultiKueueConfig]] = None,
+        adapters: Optional[Dict[str, MultiKueueAdapter]] = None,
+        worker_lost_timeout: float = 900.0,  # config multiKueue.workerLostTimeout
+        origin: str = "local",
+    ):
+        self.runtime = runtime
+        self.clusters = clusters or {}
+        self.configs = configs or {}
+        self.adapters = adapters or {"Job": BatchJobAdapter()}
+        self.worker_lost_timeout = worker_lost_timeout
+        self.origin = origin
+        # workload key -> winning cluster name
+        self._reserving: Dict[str, str] = {}
+        # workload key -> clusters that ever received copies; non-winner
+        # members are cleaned up as soon as they are reachable (covers a
+        # lost winner reconnecting after the workload moved elsewhere)
+        self._dispatched: Dict[str, set] = {}
+
+    # ---- wiring ----
+    def add_cluster(self, cluster: MultiKueueCluster) -> None:
+        self.clusters[cluster.name] = cluster
+
+    def add_config(self, cfg: MultiKueueConfig) -> None:
+        self.configs[cfg.name] = cfg
+
+    def _relevant_checks(self, wl: Workload) -> List[str]:
+        out = []
+        for name in wl.admission_check_states:
+            ac = self.runtime.cache.admission_checks.get(name)
+            if ac is not None and ac.controller_name == MULTIKUEUE_CONTROLLER_NAME:
+                out.append(name)
+        return out
+
+    def _clusters_for_check(self, check_name: str) -> List[MultiKueueCluster]:
+        ac = self.runtime.cache.admission_checks.get(check_name)
+        cfg = self.configs.get(ac.parameters or "") if ac else None
+        if cfg is None:
+            return []
+        return [self.clusters[c] for c in cfg.clusters if c in self.clusters]
+
+    def _local_job_for(self, wl: Workload):
+        for job in self.runtime.jobs.values():
+            if (
+                job.namespace == wl.namespace
+                and self.runtime.job_reconciler.workload_name_for(job) == wl.name
+            ):
+                return job
+        return None
+
+    @staticmethod
+    def _remote_copy(wl: Workload) -> Workload:
+        return Workload(
+            namespace=wl.namespace,
+            name=wl.name,
+            queue_name=wl.queue_name,
+            pod_sets=deepcopy(wl.pod_sets),
+            priority=wl.priority,
+            priority_class_name=wl.priority_class_name,
+            priority_class_source=wl.priority_class_source,
+            creation_time=wl.creation_time,
+        )
+
+    # ---- reconcile (workload.go:159-425) ----
+    def reconcile(self, wl: Workload) -> None:
+        checks = self._relevant_checks(wl)
+        if not checks:
+            return
+        now = self.runtime.clock.now()
+        check = checks[0]
+        state = wl.admission_check_states[check]
+        clusters = self._clusters_for_check(check)
+        job = self._local_job_for(wl)
+        adapter = self.adapters.get(job.kind if job is not None else "Job")
+
+        if wl.is_finished:
+            self._gc_remotes(wl, clusters, job, adapter)
+            return
+        if not wl.has_quota_reservation:
+            # reservation lost locally: drop remote copies
+            self._gc_remotes(wl, clusters, job, adapter)
+            self._reserving.pop(wl.key, None)
+            return
+
+        self._cleanup_stale_dispatches(wl, job, adapter)
+
+        winner_name = self._reserving.get(wl.key)
+        if winner_name is not None:
+            cluster = self.clusters.get(winner_name)
+            if cluster is None or not cluster.active:
+                lost_for = (
+                    now - cluster.lost_since
+                    if cluster is not None and cluster.lost_since is not None
+                    else self.worker_lost_timeout
+                )
+                if lost_for >= self.worker_lost_timeout:
+                    # worker lost: requeue locally (workload.go:421-425)
+                    self._reserving.pop(wl.key, None)
+                    state.state = AdmissionCheckStateType.RETRY
+                    state.message = f"Worker cluster {winner_name} lost"
+                    self.runtime.event("MultiKueueClusterLost", wl, winner_name)
+                return
+            self._sync_winner(wl, state, cluster, job, adapter)
+            return
+
+        # no winner yet: ensure remote copies exist, look for a reserver
+        for cluster in clusters:
+            if not cluster.active:
+                continue
+            remote = cluster.runtime
+            rwl = remote.workloads.get(wl.key)
+            if rwl is None:
+                remote.add_workload(self._remote_copy(wl))
+            self._dispatched.setdefault(wl.key, set()).add(cluster.name)
+
+        reserving = [
+            c for c in clusters
+            if c.active
+            and (rwl := c.runtime.workloads.get(wl.key)) is not None
+            and rwl.has_quota_reservation
+        ]
+        if not reserving:
+            state.state = AdmissionCheckStateType.PENDING
+            state.message = "The workload is pending reservation in the worker clusters"
+            return
+
+        winner = reserving[0]  # FirstReserving wins (workload.go:381)
+        self._reserving[wl.key] = winner.name
+        for cluster in clusters:
+            if cluster.name != winner.name and cluster.active:
+                self._delete_remote(cluster.runtime, wl.key)
+        self.runtime.event("MultiKueueReserved", wl, winner.name)
+        self._sync_winner(wl, state, winner, job, adapter)
+
+    def _sync_winner(self, wl, state, cluster, job, adapter) -> None:
+        remote = cluster.runtime
+        rwl = remote.workloads.get(wl.key)
+        if rwl is None:
+            # remote copy disappeared: retry from scratch
+            self._reserving.pop(wl.key, None)
+            state.state = AdmissionCheckStateType.PENDING
+            state.message = "Remote workload lost; recreating"
+            return
+        if job is not None and adapter is not None:
+            adapter.sync_job(job, remote, wl)
+            adapter.copy_status(job, remote)
+        if rwl.is_finished:
+            fin = rwl.conditions[WorkloadConditionType.FINISHED]
+            wl.set_condition(
+                WorkloadConditionType.FINISHED, True, fin.reason, fin.message,
+                now=self.runtime.clock.now(),
+            )
+            self.runtime.on_workload_finished(wl)
+            self._gc_remotes(
+                wl, self._clusters_for_check(state.name), job, adapter
+            )
+            return
+        if state.state != AdmissionCheckStateType.READY:
+            state.state = AdmissionCheckStateType.READY
+            state.message = f'The workload got reservation on "{cluster.name}"'
+
+    def _cleanup_stale_dispatches(self, wl, job, adapter) -> None:
+        """Delete copies on any reachable cluster that is not the
+        current winner (workload.go:381-421 drop-others + GC of orphan
+        remotes after reconnect)."""
+        winner = self._reserving.get(wl.key)
+        dispatched = self._dispatched.get(wl.key, set())
+        for name in list(dispatched):
+            if name == winner:
+                continue
+            cluster = self.clusters.get(name)
+            if cluster is None or not cluster.active:
+                continue  # retried next reconcile once reachable
+            if winner is not None:
+                if job is not None and adapter is not None:
+                    adapter.delete_remote_job(job, cluster.runtime)
+                self._delete_remote(cluster.runtime, wl.key)
+                dispatched.discard(name)
+
+    def _delete_remote(self, remote, wl_key: str) -> None:
+        rwl = remote.workloads.get(wl_key)
+        if rwl is not None:
+            remote.delete_workload(rwl)
+
+    def _gc_remotes(self, wl, clusters, job, adapter) -> None:
+        dispatched = self._dispatched.get(wl.key, set())
+        for cluster in clusters:
+            if not cluster.active:
+                continue  # stays in _dispatched; cleaned on reconnect
+            if job is not None and adapter is not None:
+                adapter.delete_remote_job(job, cluster.runtime)
+            self._delete_remote(cluster.runtime, wl.key)
+            dispatched.discard(cluster.name)
+        self._reserving.pop(wl.key, None)
+        if not dispatched:
+            self._dispatched.pop(wl.key, None)

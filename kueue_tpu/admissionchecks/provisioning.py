@@ -1,0 +1,242 @@
+"""ProvisioningRequest admission-check controller.
+
+Reference: pkg/controller/admissionchecks/provisioning/controller.go
+:116-660. Bridges quota-reserved workloads to the cluster autoscaler's
+``autoscaling.x-k8s.io ProvisioningRequest``: creates one PR per
+(workload, check) attempt, watches its conditions, retries with
+exponential backoff ``b*2^(n-1)`` (provisioningrequestconfig_types.go
+:75-96), and on Provisioned flips the check Ready with podSetUpdates
+injecting the consume-provisioning-request annotations.
+
+The autoscaler itself is external: tests (or a real bridge) flip
+``ProvisioningRequest.state``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu.models import Workload
+from kueue_tpu.models.constants import (
+    PROVISIONING_CONTROLLER_NAME,
+    AdmissionCheckStateType,
+)
+from kueue_tpu.models.admission_check import AdmissionCheckState
+
+CONSUME_PR_ANNOTATION = "cluster-autoscaler.kubernetes.io/consume-provisioning-request"
+CLASS_NAME_ANNOTATION = "autoscaling.x-k8s.io/provisioning-class-name"
+
+# ProvisioningRequest condition analogs (autoscaling.x-k8s.io)
+PR_PENDING = "Pending"
+PR_ACCEPTED = "Accepted"
+PR_PROVISIONED = "Provisioned"
+PR_FAILED = "Failed"
+PR_BOOKING_EXPIRED = "BookingExpired"
+PR_CAPACITY_REVOKED = "CapacityRevoked"
+
+
+@dataclass
+class RetryStrategy:
+    """provisioningrequestconfig_types.go:75-96 defaults."""
+
+    backoff_limit_count: int = 3
+    backoff_base_seconds: float = 60.0
+    backoff_max_seconds: float = 1800.0
+
+    def backoff(self, attempt: int) -> float:
+        """Backoff before retry attempt ``attempt+1`` (b*2^(n-1))."""
+        return min(
+            self.backoff_base_seconds * (2.0 ** max(attempt - 1, 0)),
+            self.backoff_max_seconds,
+        )
+
+
+@dataclass
+class ProvisioningRequestConfig:
+    name: str
+    provisioning_class_name: str = "check-capacity.autoscaling.x-k8s.io"
+    parameters: Dict[str, str] = field(default_factory=dict)
+    # empty -> all resources managed
+    managed_resources: Tuple[str, ...] = ()
+    retry_strategy: RetryStrategy = field(default_factory=RetryStrategy)
+
+
+@dataclass
+class ProvisioningRequest:
+    """The simulated autoscaling.x-k8s.io ProvisioningRequest."""
+
+    name: str
+    workload_key: str
+    check_name: str
+    attempt: int
+    provisioning_class_name: str
+    parameters: Dict[str, str] = field(default_factory=dict)
+    pod_sets: Tuple = ()  # (podset_name, count) pairs
+    state: str = PR_PENDING
+    message: str = ""
+
+
+class ProvisioningController:
+    """One reconciler instance handles every AdmissionCheck whose
+    controllerName is the provisioning controller."""
+
+    def __init__(self, runtime, configs: Optional[Dict[str, ProvisioningRequestConfig]] = None):
+        self.runtime = runtime
+        self.configs = configs or {}
+        self.requests: Dict[str, ProvisioningRequest] = {}
+        # (workload, check) -> retry bookkeeping
+        self._attempts: Dict[Tuple[str, str], int] = {}
+        self._retry_after: Dict[Tuple[str, str], float] = {}
+
+    def add_config(self, cfg: ProvisioningRequestConfig) -> None:
+        self.configs[cfg.name] = cfg
+
+    # ---- helpers ----
+    def _relevant_checks(self, wl: Workload) -> List[str]:
+        out = []
+        for name, state in wl.admission_check_states.items():
+            ac = self.runtime.cache.admission_checks.get(name)
+            if ac is not None and ac.controller_name == PROVISIONING_CONTROLLER_NAME:
+                out.append(name)
+        return out
+
+    def _config_for(self, check_name: str) -> Optional[ProvisioningRequestConfig]:
+        ac = self.runtime.cache.admission_checks.get(check_name)
+        if ac is None:
+            return None
+        return self.configs.get(ac.parameters or "")
+
+    @staticmethod
+    def pr_name(wl: Workload, check: str, attempt: int) -> str:
+        return f"{wl.name}-{check}-{attempt}"
+
+    def _managed_podsets(self, wl: Workload, cfg: ProvisioningRequestConfig):
+        """PR podsets with the ADMITTED counts (partial admission scales
+        them below spec counts — the autoscaler must not over-provision)."""
+        counts = {}
+        if wl.admission is not None:
+            counts = {
+                psa.name: psa.count for psa in wl.admission.pod_set_assignments
+            }
+        out = []
+        for ps in wl.pod_sets:
+            if cfg.managed_resources and not any(
+                r in cfg.managed_resources for r in ps.requests
+            ):
+                continue
+            out.append((ps.name, counts.get(ps.name, ps.count)))
+        return out
+
+    # ---- reconcile (controller.go:116-340) ----
+    def reconcile(self, wl: Workload) -> None:
+        if wl.is_finished or not wl.has_quota_reservation:
+            # PRs for unreserved workloads are garbage collected
+            self._gc(wl)
+            if not wl.is_finished:
+                # the eviction this controller requested has completed;
+                # reset Retry so the next nomination isn't blocked
+                # (workload ResetChecksOnEviction)
+                for check in self._relevant_checks(wl):
+                    st = wl.admission_check_states[check]
+                    if st.state == AdmissionCheckStateType.RETRY:
+                        st.state = AdmissionCheckStateType.PENDING
+            return
+        now = self.runtime.clock.now()
+        for check in self._relevant_checks(wl):
+            cfg = self._config_for(check)
+            state = wl.admission_check_states[check]
+            if cfg is None:
+                # missing config makes the check inactive, not a terminal
+                # verdict — workloads wait Pending until it appears
+                state.state = AdmissionCheckStateType.PENDING
+                state.message = "missing ProvisioningRequestConfig for the check"
+                continue
+            managed = self._managed_podsets(wl, cfg)
+            if not managed:
+                # no podset requests managed resources: ready (:spec note)
+                state.state = AdmissionCheckStateType.READY
+                state.message = "No ProvisioningRequest needed"
+                continue
+
+            key = (wl.key, check)
+            attempt = self._attempts.get(key, 1)
+            pr_key = self.pr_name(wl, check, attempt)
+            pr = self.requests.get(pr_key)
+            if pr is None:
+                retry_at = self._retry_after.get(key)
+                if retry_at is not None and now < retry_at:
+                    continue  # wait out the backoff window
+                pr = ProvisioningRequest(
+                    name=pr_key,
+                    workload_key=wl.key,
+                    check_name=check,
+                    attempt=attempt,
+                    provisioning_class_name=cfg.provisioning_class_name,
+                    parameters=dict(cfg.parameters),
+                    pod_sets=tuple(managed),
+                )
+                self.requests[pr_key] = pr
+                self.runtime.event("ProvisioningRequestCreated", wl, pr_key)
+
+            self._sync_check_state(wl, state, pr, cfg, attempt, key, now)
+
+    def _sync_check_state(self, wl, state: AdmissionCheckState, pr, cfg, attempt, key, now):
+        retries_left = attempt <= cfg.retry_strategy.backoff_limit_count
+        if pr.state == PR_FAILED or (
+            pr.state == PR_BOOKING_EXPIRED and not wl.is_admitted
+        ):
+            if retries_left:
+                state.state = AdmissionCheckStateType.PENDING
+                state.message = f"Retrying after failure: {pr.message}"
+                self._attempts[key] = attempt + 1
+                self._retry_after[key] = now + cfg.retry_strategy.backoff(attempt)
+            else:
+                state.state = AdmissionCheckStateType.REJECTED
+                state.message = pr.message or "provisioning failed"
+        elif pr.state == PR_CAPACITY_REVOKED:
+            # capacity lost after provisioning: evict + requeue (Retry)
+            state.state = AdmissionCheckStateType.RETRY
+            state.message = pr.message or "Capacity was revoked"
+        elif pr.state == PR_PROVISIONED:
+            if state.state != AdmissionCheckStateType.READY:
+                state.state = AdmissionCheckStateType.READY
+                state.message = pr.message or "Provisioned"
+                state.pod_set_updates = {
+                    ps_name: {
+                        "annotations": {
+                            CONSUME_PR_ANNOTATION: pr.name,
+                            CLASS_NAME_ANNOTATION: pr.provisioning_class_name,
+                        },
+                    }
+                    for ps_name, _count in pr.pod_sets
+                }
+        elif pr.state == PR_BOOKING_EXPIRED and wl.is_admitted:
+            # booking expiry after admission is normal (capacity already
+            # consumed) — keep the check Ready (controller.go:598-614)
+            pass
+        elif pr.state == PR_ACCEPTED:
+            state.state = AdmissionCheckStateType.PENDING
+            if pr.message:
+                state.message = pr.message  # ETA propagation
+        else:
+            state.state = AdmissionCheckStateType.PENDING
+
+    def _gc(self, wl: Workload) -> None:
+        """Reservation lost or workload finished: drop this workload's
+        PRs and retry bookkeeping so a fresh reservation provisions
+        from scratch (default KeepQuotaForProvReqRetry=false)."""
+        for key, pr in list(self.requests.items()):
+            if pr.workload_key == wl.key:
+                del self.requests[key]
+        for key in list(self._attempts):
+            if key[0] == wl.key:
+                del self._attempts[key]
+        for key in list(self._retry_after):
+            if key[0] == wl.key:
+                del self._retry_after[key]
+
+    # ---- test/bridge helpers ----
+    def active_request_for(self, wl: Workload, check: str) -> Optional[ProvisioningRequest]:
+        attempt = self._attempts.get((wl.key, check), 1)
+        return self.requests.get(self.pr_name(wl, check, attempt))

@@ -18,6 +18,7 @@ from kueue_tpu.controllers.podset_info import PodSetInfo, from_assignment
 from kueue_tpu.models import Workload
 from kueue_tpu.models.constants import (
     EVICTED_BY_PREEMPTION,
+    MULTIKUEUE_CONTROLLER_NAME,
     WorkloadConditionType,
 )
 from kueue_tpu.models.workload import PodSet
@@ -202,6 +203,11 @@ class JobReconciler:
         # ignore unmanaged jobs
         if not self.manage_jobs_without_queue_name and not job.queue_name():
             return
+        # a foreign managedBy means some other controller owns this job
+        # entirely — no workload, no quota (reference managedBy gate)
+        mb = getattr(job, "managed_by", None)
+        if mb is not None and mb != MULTIKUEUE_CONTROLLER_NAME:
+            return
 
         # 1. ensure one matching workload
         wl = self._workload_for(job)
@@ -270,6 +276,10 @@ class JobReconciler:
         # 7. suspended
         if job.is_suspended():
             if wl.is_admitted:
+                if getattr(job, "managed_by", None) == MULTIKUEUE_CONTROLLER_NAME:
+                    # MultiKueue managedBy: the winning remote cluster
+                    # runs the job; keep it suspended here
+                    return
                 self.start_job(job, wl)
                 return
             q = job.queue_name()

@@ -29,6 +29,9 @@ class BatchJob(GenericJob):
     priority_class: str = ""
 
     suspended: bool = True
+    # MultiKueue managedBy (batch Job spec.managedBy, feature
+    # MultiKueueBatchJobWithManagedBy): non-None defers local start
+    managed_by: Optional[str] = None
     parallelism: int = 1
     completions: int = 1
     backoff_limit: int = 6

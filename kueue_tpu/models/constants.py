@@ -82,6 +82,10 @@ EVICTED_BY_LOCAL_QUEUE_STOPPED = "LocalQueueStopped"
 EVICTED_BY_DEACTIVATION = "Deactivated"
 EVICTED_BY_MAXIMUM_EXECUTION_TIME = "MaximumExecutionTimeExceeded"
 
+# AdmissionCheck controller names (two-phase admission plugins).
+PROVISIONING_CONTROLLER_NAME = "kueue.x-k8s.io/provisioning-request"
+MULTIKUEUE_CONTROLLER_NAME = "kueue.x-k8s.io/multikueue"
+
 # TAS podset annotation equivalents (apis/kueue/v1alpha1/topology_types.go:24-79).
 TOPOLOGY_MODE_REQUIRED = "Required"
 TOPOLOGY_MODE_PREFERRED = "Preferred"

@@ -1,0 +1,281 @@
+"""AdmissionCheck controller tests: provisioning + MultiKueue.
+
+Scenario coverage mirrors the reference's
+test/integration/singlecluster/controller/admissionchecks and
+test/integration/multikueue suites.
+"""
+
+import pytest
+
+from kueue_tpu.models import AdmissionCheck, ClusterQueue, LocalQueue, ResourceFlavor
+from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+from kueue_tpu.models.constants import AdmissionCheckStateType, WorkloadConditionType
+from kueue_tpu.admissionchecks import (
+    MULTIKUEUE_CONTROLLER_NAME,
+    PROVISIONING_CONTROLLER_NAME,
+    MultiKueueCluster,
+    MultiKueueConfig,
+    MultiKueueController,
+    ProvisioningController,
+    ProvisioningRequestConfig,
+)
+from kueue_tpu.admissionchecks.provisioning import (
+    CONSUME_PR_ANNOTATION,
+    PR_CAPACITY_REVOKED,
+    PR_FAILED,
+    PR_PROVISIONED,
+    RetryStrategy,
+)
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.controllers.jobs import BatchJob
+from kueue_tpu.utils.clock import FakeClock
+
+
+def base_runtime(clock=None, quota="10"):
+    rt = ClusterRuntime(clock=clock or FakeClock(1000.0))
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq", namespace_selector={},
+            resource_groups=(
+                ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": quota}),)),
+            ),
+        )
+    )
+    rt.add_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+    return rt
+
+
+class TestProvisioning:
+    def make(self, retry=None):
+        clock = FakeClock(1000.0)
+        rt = base_runtime(clock)
+        rt.add_admission_check(
+            AdmissionCheck(
+                name="prov", controller_name=PROVISIONING_CONTROLLER_NAME,
+                parameters="prc",
+            )
+        )
+        rt.cache.cluster_queues["cq"].model.admission_checks = ("prov",)
+        ctrl = ProvisioningController(rt)
+        ctrl.add_config(
+            ProvisioningRequestConfig(
+                name="prc", retry_strategy=retry or RetryStrategy(),
+            )
+        )
+        rt.admission_check_controllers.append(ctrl.reconcile)
+        return rt, ctrl, clock
+
+    def submit(self, rt):
+        job = BatchJob.build("ns", "j", "lq", parallelism=2, requests={"cpu": "1"})
+        rt.add_job(job)
+        rt.run_until_idle()
+        return job, rt.workloads["ns/job-j"]
+
+    def test_pr_created_on_quota_reservation(self, *a):
+        rt, ctrl, clock = self.make()
+        job, wl = self.submit(rt)
+        assert wl.has_quota_reservation and not wl.is_admitted
+        pr = ctrl.active_request_for(wl, "prov")
+        assert pr is not None
+        assert pr.pod_sets == (("main", 2),)
+
+    def test_provisioned_flips_ready_with_podset_updates(self):
+        rt, ctrl, clock = self.make()
+        job, wl = self.submit(rt)
+        pr = ctrl.active_request_for(wl, "prov")
+        pr.state = PR_PROVISIONED
+        rt.run_until_idle()
+        assert wl.is_admitted
+        assert not job.is_suspended()
+        st = wl.admission_check_states["prov"]
+        upd = st.pod_set_updates["main"]["annotations"]
+        assert upd[CONSUME_PR_ANNOTATION] == pr.name
+
+    def test_failed_retries_with_backoff_then_rejects(self):
+        rt, ctrl, clock = self.make(retry=RetryStrategy(backoff_limit_count=1, backoff_base_seconds=30))
+        job, wl = self.submit(rt)
+        pr1 = ctrl.active_request_for(wl, "prov")
+        pr1.state = PR_FAILED
+        pr1.message = "out of stock"
+        rt.run_until_idle()
+        st = wl.admission_check_states["prov"]
+        assert st.state == AdmissionCheckStateType.PENDING
+        assert "Retrying" in st.message
+        # second PR only after the backoff window
+        assert ctrl.active_request_for(wl, "prov") is None
+        clock.advance(31.0)
+        rt.run_until_idle()
+        pr2 = ctrl.active_request_for(wl, "prov")
+        assert pr2 is not None and pr2.attempt == 2
+        # second failure exhausts the limit -> Rejected -> deactivated
+        pr2.state = PR_FAILED
+        rt.run_until_idle()
+        assert not wl.active
+        assert job.is_suspended()
+
+    def test_capacity_revoked_triggers_retry_eviction(self):
+        rt, ctrl, clock = self.make()
+        job, wl = self.submit(rt)
+        pr = ctrl.active_request_for(wl, "prov")
+        pr.state = PR_PROVISIONED
+        rt.run_until_idle()
+        assert not job.is_suspended()
+        pr.state = PR_CAPACITY_REVOKED
+        rt.run_until_idle()
+        # evicted, job stopped; a fresh reservation re-provisions from
+        # scratch, so the job stays suspended behind a new Pending PR
+        assert job.is_suspended()
+        assert not wl.is_admitted
+        pr2 = ctrl.active_request_for(wl, "prov")
+        assert pr2 is not None and pr2.state not in (PR_CAPACITY_REVOKED,)
+
+    def test_unmanaged_resources_skip_provisioning(self):
+        rt, ctrl, clock = self.make()
+        ctrl.configs["prc"].managed_resources = ("tpu.google.com/v5e",)
+        job, wl = self.submit(rt)
+        assert wl.admission_check_states["prov"].state == AdmissionCheckStateType.READY
+        assert wl.is_admitted
+
+
+def make_worker(quota="10"):
+    return base_runtime(FakeClock(1000.0), quota)
+
+
+class TestMultiKueue:
+    def make(self, worker_quotas=("10", "10")):
+        clock = FakeClock(1000.0)
+        rt = base_runtime(clock)
+        rt.add_admission_check(
+            AdmissionCheck(
+                name="mk", controller_name=MULTIKUEUE_CONTROLLER_NAME,
+                parameters="mkc",
+            )
+        )
+        rt.cache.cluster_queues["cq"].model.admission_checks = ("mk",)
+        workers = {
+            f"worker{i}": MultiKueueCluster(
+                name=f"worker{i}", runtime=make_worker(q)
+            )
+            for i, q in enumerate(worker_quotas, 1)
+        }
+        ctrl = MultiKueueController(
+            rt,
+            clusters=workers,
+            configs={"mkc": MultiKueueConfig(name="mkc", clusters=tuple(workers))},
+        )
+        rt.admission_check_controllers.append(ctrl.reconcile)
+        return rt, ctrl, workers, clock
+
+    def drive(self, rt, workers, n=4):
+        for _ in range(n):
+            rt.run_until_idle()
+            for w in workers.values():
+                w.runtime.run_until_idle()
+
+    def test_dispatch_first_reserving_wins(self):
+        rt, ctrl, workers, clock = self.make(worker_quotas=("0", "10"))
+        job = BatchJob.build(
+            "ns", "j", "lq", parallelism=2, requests={"cpu": "1"},
+            managed_by=MULTIKUEUE_CONTROLLER_NAME,
+        )
+        rt.add_job(job)
+        self.drive(rt, workers)
+        wl = rt.workloads["ns/job-j"]
+        assert wl.is_admitted
+        # worker2 (with quota) won; worker1's copy deleted
+        assert ctrl._reserving[wl.key] == "worker2"
+        assert wl.key not in workers["worker1"].runtime.workloads
+        # local job stays suspended (managedBy); remote copy runs
+        assert job.is_suspended()
+        remote_job = workers["worker2"].runtime.jobs[job.key]
+        assert not remote_job.is_suspended()
+
+    def test_remote_finish_propagates(self):
+        rt, ctrl, workers, clock = self.make()
+        job = BatchJob.build(
+            "ns", "j", "lq", parallelism=2, requests={"cpu": "1"},
+            managed_by=MULTIKUEUE_CONTROLLER_NAME,
+        )
+        rt.add_job(job)
+        self.drive(rt, workers)
+        wl = rt.workloads["ns/job-j"]
+        winner = workers[ctrl._reserving[wl.key]]
+        remote_job = winner.runtime.jobs[job.key]
+        remote_job.complete(success=True)
+        self.drive(rt, workers)
+        assert wl.is_finished
+        assert job.succeeded == job.completions  # status copied back
+        # remote objects garbage collected
+        assert wl.key not in winner.runtime.workloads
+
+    def test_worker_lost_requeues(self):
+        rt, ctrl, workers, clock = self.make()
+        ctrl.worker_lost_timeout = 60.0
+        job = BatchJob.build(
+            "ns", "j", "lq", parallelism=2, requests={"cpu": "1"},
+            managed_by=MULTIKUEUE_CONTROLLER_NAME,
+        )
+        rt.add_job(job)
+        self.drive(rt, workers)
+        wl = rt.workloads["ns/job-j"]
+        winner = workers[ctrl._reserving[wl.key]]
+        winner.mark_lost(clock.now())
+        clock.advance(61.0)
+        self.drive(rt, workers)
+        # check flipped Retry -> eviction -> requeue; with the other
+        # worker still healthy the workload is re-dispatched there
+        assert wl.key in ctrl._reserving
+        assert ctrl._reserving[wl.key] != winner.name
+
+    def test_lost_winner_reconnect_no_dual_execution(self):
+        rt, ctrl, workers, clock = self.make()
+        ctrl.worker_lost_timeout = 60.0
+        job = BatchJob.build(
+            "ns", "j", "lq", parallelism=2, requests={"cpu": "1"},
+            managed_by=MULTIKUEUE_CONTROLLER_NAME,
+        )
+        rt.add_job(job)
+        self.drive(rt, workers)
+        wl = rt.workloads["ns/job-j"]
+        old_winner = workers[ctrl._reserving[wl.key]]
+        old_winner.mark_lost(clock.now())
+        clock.advance(61.0)
+        self.drive(rt, workers)
+        new_winner = ctrl._reserving[wl.key]
+        assert new_winner != old_winner.name
+        # the lost winner reconnects: its stale copy and job must be GCed
+        old_winner.mark_connected()
+        self.drive(rt, workers)
+        assert wl.key not in old_winner.runtime.workloads
+        assert job.key not in old_winner.runtime.jobs
+        running = [
+            w.name for w in workers.values()
+            if (rj := w.runtime.jobs.get(job.key)) is not None and rj.is_active()
+        ]
+        assert running == [new_winner]
+
+    def test_foreign_managed_by_is_ignored(self):
+        rt, ctrl, workers, clock = self.make()
+        job = BatchJob.build(
+            "ns", "alien", "lq", parallelism=2, requests={"cpu": "1"},
+            managed_by="example.com/other-controller",
+        )
+        rt.add_job(job)
+        self.drive(rt, workers)
+        # no workload, no quota consumed for a foreign-managed job
+        assert "ns/job-alien" not in rt.workloads
+        assert rt.cache.usage_for("cq") == {}
+
+    def test_quota_respected_on_workers(self):
+        rt, ctrl, workers, clock = self.make(worker_quotas=("1", "1"))
+        job = BatchJob.build(
+            "ns", "big", "lq", parallelism=4, requests={"cpu": "1"},
+            managed_by=MULTIKUEUE_CONTROLLER_NAME,
+        )
+        rt.add_job(job)
+        self.drive(rt, workers)
+        wl = rt.workloads["ns/job-big"]
+        # neither worker can fit 4 cpus: stays pending
+        assert not wl.is_admitted
+        assert wl.admission_check_states["mk"].state == AdmissionCheckStateType.PENDING
