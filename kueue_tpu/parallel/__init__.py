@@ -1,0 +1,5 @@
+"""Device-mesh sharding of the admission solver."""
+
+from kueue_tpu.parallel.sharded_solver import ShardedSolver, make_mesh
+
+__all__ = ["ShardedSolver", "make_mesh"]

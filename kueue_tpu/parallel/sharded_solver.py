@@ -1,0 +1,136 @@
+"""ShardedSolver — the admission cycle over a jax.sharding.Mesh.
+
+The reference scales by running ONE scheduler goroutine per cluster
+(pkg/scheduler/scheduler.go:143-154 — leader-elected, single-threaded).
+The TPU-native scale axis is different: one cycle is a batched tensor
+program, and the mesh shards it:
+
+  - ``wl`` (data axis): heads are sharded — phase-1 flavor
+    classification is embarrassingly parallel over heads, so each
+    device classifies its shard against replicated quota tensors.
+  - ``fr`` (tensor axis, 2-D meshes): the [N, FR] quota tensors are
+    sharded over flavor-resource cells for very wide clusters (many
+    flavors x resources); XLA inserts the gathers.
+
+Phase-2 conflict resolution (the lax.scan over admission order) is
+sequential by construction — it runs replicated on the gathered
+phase-1 output, which costs one all-gather of O(W) small vectors and no
+communication inside the scan.
+
+Multi-host: build the mesh from ``jax.devices()`` after
+``jax.distributed.initialize()`` — the same code shards over ICI within
+a host/pod and DCN across hosts; no host-side changes needed
+(collectives ride the mesh like any pjit program).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from kueue_tpu._jax import jax, jnp
+from kueue_tpu.ops.assign_kernel import HeadsBatch, SolveResult, solve_cycle
+from kueue_tpu.ops.quota import QuotaTree
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, fr_parallel: bool = False
+):
+    """A 1-D ``(wl,)`` or 2-D ``(wl, fr)`` mesh over the first
+    n_devices available devices."""
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if fr_parallel and n >= 4 and n % 2 == 0:
+        return Mesh(devices.reshape(n // 2, 2), ("wl", "fr"))
+    return Mesh(devices.reshape(n), ("wl",))
+
+
+class ShardedSolver:
+    """Places solver inputs on the mesh and runs the jitted cycle.
+
+    The jit is cached per (shapes, mesh); repeated cycles with the same
+    padded shapes reuse the compiled executable — size buckets should be
+    chosen by the caller (static shapes are an XLA requirement; see
+    SURVEY.md §7 hard-parts (c)).
+    """
+
+    def __init__(self, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        has_fr = "fr" in mesh.axis_names
+
+        def sh(*spec):
+            return NamedSharding(mesh, P(*spec))
+
+        fr_spec = sh(None, "fr") if has_fr else sh(None, None)
+        self._tree_sh = QuotaTree(
+            parent=sh(None),
+            level_mask=sh(None, None),
+            nominal=fr_spec,
+            lending_limit=fr_spec,
+            borrowing_limit=fr_spec,
+        )
+        self._usage_sh = fr_spec
+        self._heads_sh = HeadsBatch(
+            cq_row=sh("wl"),
+            cells=sh("wl", None, None),
+            qty=sh("wl", None, None),
+            valid=sh("wl", None),
+            priority=sh("wl"),
+            timestamp=sh("wl"),
+        )
+        self._paths_sh = sh(None, None)
+        self._jit = jax.jit(solve_cycle)
+
+    @property
+    def wl_axis_size(self) -> int:
+        return self.mesh.shape["wl"]
+
+    def pad_heads(self, heads: HeadsBatch) -> HeadsBatch:
+        """Pad W up to a multiple of the wl axis (padding rows have
+        cq_row == -1 and are never admitted)."""
+        w = heads.cq_row.shape[0]
+        step = self.wl_axis_size
+        target = ((w + step - 1) // step) * step
+        if target == w:
+            return heads
+        pad = target - w
+
+        def pad0(x):
+            widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+            return jnp.pad(x, widths, constant_values=0)
+
+        return HeadsBatch(
+            cq_row=jnp.pad(heads.cq_row, (0, pad), constant_values=-1),
+            cells=jnp.pad(
+                heads.cells, [(0, pad), (0, 0), (0, 0)], constant_values=-1
+            ),
+            qty=pad0(heads.qty),
+            valid=pad0(heads.valid),
+            priority=pad0(heads.priority),
+            timestamp=pad0(heads.timestamp),
+        )
+
+    def place(self, tree: QuotaTree, local_usage, heads: HeadsBatch, paths):
+        """device_put every input with its mesh sharding."""
+        tree_d = jax.device_put(tree, self._tree_sh)
+        usage_d = jax.device_put(local_usage, self._usage_sh)
+        heads_d = jax.device_put(heads, self._heads_sh)
+        paths_d = jax.device_put(paths, self._paths_sh)
+        return tree_d, usage_d, heads_d, paths_d
+
+    def __call__(
+        self, tree: QuotaTree, local_usage, heads: HeadsBatch, paths
+    ) -> SolveResult:
+        heads = self.pad_heads(heads)
+        tree_d, usage_d, heads_d, paths_d = self.place(
+            tree, local_usage, heads, paths
+        )
+        with self.mesh:
+            return self._jit(tree_d, usage_d, heads_d, paths_d)

@@ -1,0 +1,52 @@
+"""Sharded-solver tests on the 8-device virtual CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kueue_tpu.parallel import ShardedSolver, make_mesh
+
+
+def build_problem(seed=0, n_cq=24, n_cohort=4, fr=8, w=20, k=3, c=3):
+    import __graft_entry__
+
+    return __graft_entry__._synthetic_problem(
+        n_cq=n_cq, n_cohort=n_cohort, fr=fr, w=w, k=k, c=c
+    )
+
+
+@pytest.mark.parametrize("fr_parallel", [False, True])
+def test_sharded_matches_single_device(fr_parallel):
+    from kueue_tpu.ops.assign_kernel import solve_cycle_jit
+
+    tree, usage, heads, paths = build_problem(w=24)
+    expected = solve_cycle_jit(tree, usage, heads, paths)
+
+    mesh = make_mesh(8, fr_parallel=fr_parallel)
+    solver = ShardedSolver(mesh)
+    got = solver(tree, usage, heads, paths)
+
+    np.testing.assert_array_equal(np.asarray(got.chosen), np.asarray(expected.chosen))
+    np.testing.assert_array_equal(np.asarray(got.admitted), np.asarray(expected.admitted))
+    np.testing.assert_array_equal(np.asarray(got.usage), np.asarray(expected.usage))
+
+
+def test_padding_to_axis_multiple():
+    tree, usage, heads, paths = build_problem(w=13)  # not divisible by 8
+    from kueue_tpu.ops.assign_kernel import solve_cycle_jit
+
+    expected = solve_cycle_jit(tree, usage, heads, paths)
+    solver = ShardedSolver(make_mesh(8))
+    got = solver(tree, usage, heads, paths)
+    assert got.admitted.shape[0] == 16  # padded
+    np.testing.assert_array_equal(
+        np.asarray(got.admitted)[:13], np.asarray(expected.admitted)
+    )
+    assert not np.asarray(got.admitted)[13:].any()
+
+
+def test_mesh_shapes():
+    assert make_mesh(8).axis_names == ("wl",)
+    assert make_mesh(8, fr_parallel=True).axis_names == ("wl", "fr")
+    assert make_mesh(3, fr_parallel=True).axis_names == ("wl",)  # odd: 1-D
