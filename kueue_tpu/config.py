@@ -1,0 +1,202 @@
+"""Manager configuration.
+
+Reference: apis/config/v1beta1/configuration_types.go:31-474 +
+defaults.go + pkg/config validation. A single Configuration object
+(decodable from a plain dict / YAML mapping) drives ClusterRuntime
+construction — the analog of the ``--config`` file in
+cmd/kueue/main.go:106-144, including feature-gate conflict checks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu import features
+from kueue_tpu.controllers.workload_controller import WaitForPodsReadyConfig
+
+DEFAULT_NAMESPACE = "kueue-system"
+
+# configuration_types.go:351-388 — the built-in integrations list
+KNOWN_FRAMEWORKS = (
+    "batch/job",
+    "jobset.x-k8s.io/jobset",
+    "kubeflow.org/mpijob",
+    "kubeflow.org/paddlejob",
+    "kubeflow.org/pytorchjob",
+    "kubeflow.org/tfjob",
+    "kubeflow.org/xgboostjob",
+    "ray.io/rayjob",
+    "ray.io/raycluster",
+    "workload.codeflare.dev/appwrapper",
+    "pod",
+    "deployment",
+    "statefulset",
+    "leaderworkerset.x-k8s.io/leaderworkerset",
+)
+DEFAULT_FRAMEWORKS = ("batch/job",)
+
+FS_LESS_THAN_OR_EQUAL_TO_FINAL_SHARE = "LessThanOrEqualToFinalShare"
+FS_LESS_THAN_INITIAL_SHARE = "LessThanInitialShare"
+
+
+@dataclass
+class MultiKueueSettings:
+    """configuration_types.go:248-268."""
+
+    gc_interval_seconds: float = 60.0
+    origin: str = "multikueue"
+    worker_lost_timeout_seconds: float = 900.0
+
+
+@dataclass
+class FairSharingSettings:
+    """configuration_types.go:445-474."""
+
+    enable: bool = False
+    preemption_strategies: Tuple[str, ...] = (
+        FS_LESS_THAN_OR_EQUAL_TO_FINAL_SHARE,
+        FS_LESS_THAN_INITIAL_SHARE,
+    )
+
+
+@dataclass
+class ResourceSettings:
+    """configuration_types.go:418-443."""
+
+    exclude_resource_prefixes: Tuple[str, ...] = ()
+    # resource name -> {"strategy": Sum|Replace|Retain, "outputs": {...}}
+    transformations: Dict[str, dict] = field(default_factory=dict)
+
+
+@dataclass
+class Configuration:
+    namespace: str = DEFAULT_NAMESPACE
+    manage_jobs_without_queue_name: bool = False
+    managed_jobs_namespace_selector: Optional[Dict[str, str]] = None
+    wait_for_pods_ready: WaitForPodsReadyConfig = field(
+        default_factory=WaitForPodsReadyConfig
+    )
+    integrations_frameworks: Tuple[str, ...] = DEFAULT_FRAMEWORKS
+    multikueue: MultiKueueSettings = field(default_factory=MultiKueueSettings)
+    fair_sharing: FairSharingSettings = field(default_factory=FairSharingSettings)
+    resources: ResourceSettings = field(default_factory=ResourceSettings)
+    feature_gates: Dict[str, bool] = field(default_factory=dict)
+
+    def validate(self) -> List[str]:
+        """pkg/config validation + main.go:129-144 gate conflict check."""
+        errs: List[str] = []
+        for fw in self.integrations_frameworks:
+            if fw not in KNOWN_FRAMEWORKS:
+                errs.append(f"unknown integration framework {fw!r}")
+        for s in self.fair_sharing.preemption_strategies:
+            if s not in (
+                FS_LESS_THAN_OR_EQUAL_TO_FINAL_SHARE,
+                FS_LESS_THAN_INITIAL_SHARE,
+            ):
+                errs.append(f"unknown fairSharing preemptionStrategy {s!r}")
+        w = self.wait_for_pods_ready
+        if w.enable:
+            if w.timeout_seconds <= 0:
+                errs.append("waitForPodsReady.timeout must be positive")
+            if w.backoff_limit_count is not None and w.backoff_limit_count < 0:
+                errs.append("waitForPodsReady.requeuingStrategy.backoffLimitCount must be >= 0")
+            if w.backoff_max_seconds < w.backoff_base_seconds:
+                errs.append("waitForPodsReady backoffMaxSeconds must be >= backoffBaseSeconds")
+        for name in self.feature_gates:
+            if name not in features.gates.known():
+                errs.append(f"unknown feature gate {name!r}")
+        return errs
+
+    def apply_feature_gates(self) -> None:
+        features.gates.set_from_map(self.feature_gates)
+
+
+def load_config(data: Optional[dict]) -> Configuration:
+    """Decode a plain mapping (parsed YAML) with defaulting.
+
+    Mirrors apis/config/v1beta1/defaults.go: absent keys get defaults;
+    unknown top-level keys are an error (strict decoding).
+    """
+    data = dict(data or {})
+    cfg = Configuration()
+
+    known = {
+        "namespace", "manageJobsWithoutQueueName", "managedJobsNamespaceSelector",
+        "waitForPodsReady", "integrations", "multiKueue", "fairSharing",
+        "resources", "featureGates",
+    }
+    unknown = set(data) - known
+    if unknown:
+        raise ValueError(f"unknown configuration keys: {sorted(unknown)}")
+
+    cfg.namespace = data.get("namespace", DEFAULT_NAMESPACE)
+    cfg.manage_jobs_without_queue_name = bool(
+        data.get("manageJobsWithoutQueueName", False)
+    )
+    cfg.managed_jobs_namespace_selector = data.get("managedJobsNamespaceSelector")
+
+    w = data.get("waitForPodsReady") or {}
+    rq = w.get("requeuingStrategy") or {}
+    cfg.wait_for_pods_ready = WaitForPodsReadyConfig(
+        enable=bool(w.get("enable", False)),
+        timeout_seconds=float(w.get("timeout", 300)),
+        block_admission=bool(w.get("blockAdmission", w.get("enable", False))),
+        backoff_base_seconds=float(rq.get("backoffBaseSeconds", 60)),
+        backoff_limit_count=rq.get("backoffLimitCount"),
+        backoff_max_seconds=float(rq.get("backoffMaxSeconds", 3600)),
+        recovery_timeout_seconds=w.get("recoveryTimeout"),
+    )
+
+    integ = data.get("integrations") or {}
+    cfg.integrations_frameworks = tuple(
+        integ.get("frameworks", DEFAULT_FRAMEWORKS)
+    )
+
+    mk = data.get("multiKueue") or {}
+    cfg.multikueue = MultiKueueSettings(
+        gc_interval_seconds=float(mk.get("gcInterval", 60)),
+        origin=mk.get("origin", "multikueue"),
+        worker_lost_timeout_seconds=float(mk.get("workerLostTimeout", 900)),
+    )
+
+    fs = data.get("fairSharing") or {}
+    cfg.fair_sharing = FairSharingSettings(
+        enable=bool(fs.get("enable", False)),
+        preemption_strategies=tuple(
+            fs.get(
+                "preemptionStrategies",
+                (FS_LESS_THAN_OR_EQUAL_TO_FINAL_SHARE, FS_LESS_THAN_INITIAL_SHARE),
+            )
+        ),
+    )
+
+    res = data.get("resources") or {}
+    cfg.resources = ResourceSettings(
+        exclude_resource_prefixes=tuple(res.get("excludeResourcePrefixes", ())),
+        transformations={
+            t["input"]: {k: v for k, v in t.items() if k != "input"}
+            for t in res.get("transformations", ())
+        },
+    )
+
+    cfg.feature_gates = dict(data.get("featureGates") or {})
+
+    errs = cfg.validate()
+    if errs:
+        raise ValueError("; ".join(errs))
+    return cfg
+
+
+def runtime_from_config(cfg: Configuration, clock=None, tas_cache=None):
+    """main.go setupControllers analog."""
+    from kueue_tpu.controllers import ClusterRuntime
+
+    cfg.apply_feature_gates()
+    return ClusterRuntime(
+        clock=clock,
+        wait_for_pods_ready=cfg.wait_for_pods_ready,
+        manage_jobs_without_queue_name=cfg.manage_jobs_without_queue_name,
+        fair_sharing=cfg.fair_sharing.enable,
+        tas_cache=tas_cache,
+    )

@@ -51,12 +51,15 @@ class ClusterRuntime:
         fair_sharing: bool = False,
         tas_cache=None,
     ):
+        from kueue_tpu.metrics import Metrics
+
         self.clock = clock or Clock()
         self.cache = Cache()
         self.queues = QueueManager(self.clock)
         self.workloads: Dict[str, Workload] = {}
         self.jobs: Dict[str, GenericJob] = {}
         self.events: List[Event] = []
+        self.metrics = Metrics()
         self.pods_ready_cfg = wait_for_pods_ready or WaitForPodsReadyConfig()
 
         tas_check = tas_assign = None
@@ -69,17 +72,11 @@ class ClusterRuntime:
             tas_check = self.tas_manager.check
             tas_assign = self.tas_manager.assign
 
-        from kueue_tpu.core.preemption import Preemptor
-
         self.scheduler = Scheduler(
             queues=self.queues,
             cache=self.cache,
             clock=self.clock,
-            preemptor=Preemptor(
-                self.clock,
-                enable_fair_sharing=fair_sharing,
-                events=lambda kind, wl, msg: self.event(kind, wl, msg),
-            ),
+            preemptor=self._make_preemptor(fair_sharing),
             fair_sharing=fair_sharing,
             wait_for_pods_ready_block=self.pods_ready_cfg.enable
             and self.pods_ready_cfg.block_admission,
@@ -99,9 +96,78 @@ class ClusterRuntime:
         # name -> callable(workload) run during reconcile loops
         self.admission_check_controllers: List[Callable[[Workload], None]] = []
 
+    def _make_preemptor(self, fair_sharing: bool):
+        from kueue_tpu.core.preemption import Preemptor
+
+        p = Preemptor(
+            self.clock,
+            enable_fair_sharing=fair_sharing,
+            events=lambda kind, wl, msg: self.event(kind, wl, msg),
+        )
+        p.metrics_hook = self._record_preemption
+        return p
+
     # ---- events ----
     def event(self, kind: str, wl: Workload, message: str = "") -> None:
         self.events.append(Event(kind=kind, object_key=wl.key, message=message))
+        self._record_metric_event(kind, wl)
+
+    def _record_metric_event(self, kind: str, wl: Workload) -> None:
+        """Event -> metric mapping (metrics.go report call sites).
+
+        Preemptions are reported via the preemptor's metrics hook (the
+        preempting CQ isn't derivable from the victim workload)."""
+        now = self.clock.now()
+        cq = wl.admission.cluster_queue if wl.admission else ""
+        if kind == "QuotaReserved" and cq:
+            self.metrics.report_quota_reserved(cq, now - wl.creation_time)
+        elif kind == "Admitted" and cq:
+            qr = wl.conditions.get(WorkloadConditionType.QUOTA_RESERVED)
+            checks_wait = now - qr.last_transition_time if qr else 0.0
+            self.metrics.report_admitted(
+                cq, now - wl.creation_time, checks_wait,
+                lq=wl.queue_name, namespace=wl.namespace,
+            )
+        elif kind == "Evicted" and cq:
+            ev = wl.conditions.get(WorkloadConditionType.EVICTED)
+            self.metrics.report_evicted(
+                cq, ev.reason if ev else "", lq=wl.queue_name,
+                namespace=wl.namespace,
+            )
+
+    def _record_preemption(self, preempting_cq: str, reason: str, victim: Workload) -> None:
+        """ReportPreemption (metrics.go): counts the preemption for the
+        preempting CQ AND the eviction (reason Preempted) for the
+        victim's CQ."""
+        self.metrics.report_preemption(preempting_cq, reason)
+        victim_cq = victim.admission.cluster_queue if victim.admission else ""
+        if victim_cq:
+            self.metrics.report_evicted(
+                victim_cq, "Preempted", lq=victim.queue_name,
+                namespace=victim.namespace,
+            )
+
+    def _report_cycle_metrics(self, result, duration_s: float) -> None:
+        outcome = "success" if result.admitted else "inadmissible"
+        self.metrics.report_admission_attempt(outcome, duration_s)
+        for cq_name, pending in self.queues.cluster_queues.items():
+            self.metrics.report_pending_workloads(
+                cq_name, pending.pending_active(), pending.pending_inadmissible()
+            )
+            cached = self.cache.cluster_queues.get(cq_name)
+            if cached is not None:
+                self.metrics.reserving_active_workloads.set(
+                    len(cached.workloads), cluster_queue=cq_name
+                )
+                self.metrics.admitted_active_workloads.set(
+                    sum(1 for w in cached.workloads.values() if w.is_admitted),
+                    cluster_queue=cq_name,
+                )
+        # "skips in the LAST cycle": reset CQs with no skips this cycle
+        for cq_name in self.queues.cluster_queues:
+            self.metrics.admission_cycle_preemption_skips.set(
+                result.skipped_preemptions.get(cq_name, 0), cluster_queue=cq_name
+            )
 
     # ---- API-object lifecycle (delegates, main.go setupControllers) ----
     def add_cluster_queue(self, cq: ClusterQueue) -> None:
@@ -111,6 +177,7 @@ class ClusterRuntime:
     def delete_cluster_queue(self, name: str) -> None:
         self.cache.delete_cluster_queue(name)
         self.queues.delete_cluster_queue(name)
+        self.metrics.clear_cluster_queue(name)
 
     def add_local_queue(self, lq: LocalQueue) -> None:
         self.cache.add_or_update_local_queue(lq)
@@ -249,13 +316,22 @@ class ClusterRuntime:
             parts.append((key, job.is_suspended()))
         return tuple(parts), len(self.events)
 
+    def schedule_once(self):
+        """One scheduler cycle with metric reporting."""
+        import time
+
+        t0 = time.perf_counter()
+        result = self.scheduler.schedule()
+        self._report_cycle_metrics(result, time.perf_counter() - t0)
+        return result
+
     def run_until_idle(self, max_iterations: int = 50) -> int:
         """Reconcile + schedule until nothing changes. Returns cycles."""
         cycles = 0
         for _ in range(max_iterations):
             before = self._state_fingerprint()
             self.reconcile_once()
-            self.scheduler.schedule()
+            self.schedule_once()
             self.reconcile_once()
             cycles += 1
             if self._state_fingerprint() == before:

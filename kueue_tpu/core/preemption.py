@@ -89,6 +89,9 @@ class Preemptor(PreemptorBase):
         self.apply_preemption = apply_preemption or (lambda wl, reason, msg: True)
         self._ts_policy = timestamp_policy
         self.events = events or (lambda kind, wl, msg: None)
+        # (preempting_cq, reason, victim) -> None; set by the runtime to
+        # report preempted_workloads_total / evicted_workloads_total
+        self.metrics_hook = None
 
     # ---- entry point (preemption.go:127-191) ----
     def get_targets(
@@ -138,7 +141,8 @@ class Preemptor(PreemptorBase):
 
     # ---- issue (preemption.go:232-265) ----
     def issue_preemptions(
-        self, preemptor: Workload, targets: List[PreemptionTarget]
+        self, preemptor: Workload, targets: List[PreemptionTarget],
+        preempting_cq: str = "",
     ) -> int:
         count = 0
         now = self.clock.now()
@@ -166,6 +170,8 @@ class Preemptor(PreemptorBase):
 
                     st.state = AdmissionCheckStateType.PENDING
                 self.events("Preempted", wl, msg)
+                if self.metrics_hook is not None:
+                    self.metrics_hook(preempting_cq, t.reason, wl)
                 count += 1
         return count
 

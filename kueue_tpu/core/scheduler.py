@@ -192,7 +192,9 @@ class Scheduler:
 
             if mode == Mode.PREEMPT:
                 e.workload.last_assignment = None
-                n = self.preemptor.issue_preemptions(e.workload, e.preemption_targets)
+                n = self.preemptor.issue_preemptions(
+                    e.workload, e.preemption_targets, preempting_cq=e.cq_name
+                )
                 if n:
                     e.inadmissible_msg += (
                         f". Pending the preemption of {n} workload(s)"

@@ -1,0 +1,45 @@
+"""State dumper (pkg/debugger/debugger.go:31-50 — SIGUSR2 analog).
+
+``dump(runtime)`` renders the queue heaps and cache state as text;
+``attach_signal_handler`` wires it to SIGUSR2 like the reference.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+from typing import List
+
+
+def dump(runtime) -> str:
+    lines: List[str] = ["=== kueue_tpu state dump ==="]
+    lines.append("-- pending queues --")
+    for name, pending in sorted(runtime.queues.cluster_queues.items()):
+        active = sorted(pending.heap.keys())
+        if pending.inflight is not None:
+            active.append(pending.inflight.key + " (inflight)")
+        parked = sorted(pending.inadmissible)
+        lines.append(
+            f"ClusterQueue {name}: active={len(active)} inadmissible={len(parked)}"
+        )
+        for key in active:
+            lines.append(f"  heap: {key}")
+        for key in parked:
+            lines.append(f"  inadmissible: {key}")
+    lines.append("-- cache (admitted) --")
+    for name, cached in sorted(runtime.cache.cluster_queues.items()):
+        lines.append(f"ClusterQueue {name}: admitted={len(cached.workloads)}")
+        for key, wl in sorted(cached.workloads.items()):
+            lines.append(f"  workload: {key} admitted={wl.is_admitted}")
+        for fr, qty in sorted(cached.usage.items()):
+            lines.append(f"  usage: {fr.flavor}/{fr.resource}={qty}")
+    if runtime.cache.assumed_workloads:
+        lines.append(f"assumed: {sorted(runtime.cache.assumed_workloads)}")
+    return "\n".join(lines)
+
+
+def attach_signal_handler(runtime, signum: int = signal.SIGUSR2) -> None:
+    def handler(_sig, _frame):
+        sys.stderr.write(dump(runtime) + "\n")
+
+    signal.signal(signum, handler)

@@ -1,0 +1,6 @@
+"""Prometheus-style metrics (pkg/metrics/metrics.go)."""
+
+from kueue_tpu.metrics.registry import Counter, Gauge, Histogram, Registry
+from kueue_tpu.metrics.metrics import Metrics
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "Metrics"]

@@ -1,0 +1,225 @@
+"""The Kueue metric set (pkg/metrics/metrics.go:70-380).
+
+Every metric keeps the reference's name (namespace ``kueue``), labels
+and type, so dashboards/alerts written against the Go implementation
+read identically. LocalQueue variants are emitted only when the
+LocalQueueMetrics feature gate is on (:115-331).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_tpu import features
+from kueue_tpu.metrics.registry import Registry
+
+NS = "kueue"
+
+# admission_attempt_duration_seconds exponential buckets (metrics.go:88)
+ATTEMPT_BUCKETS = tuple(0.0001 * (10 ** i) for i in range(8))
+
+
+class Metrics:
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+
+        self.admission_attempts_total = r.counter(
+            f"{NS}_admission_attempts_total",
+            "Total number of attempts to admit workloads, label 'result' is success or inadmissible",
+            ("result",),
+        )
+        self.admission_attempt_duration_seconds = r.histogram(
+            f"{NS}_admission_attempt_duration_seconds",
+            "Latency of an admission attempt",
+            ("result",),
+            buckets=ATTEMPT_BUCKETS,
+        )
+        self.admission_cycle_preemption_skips = r.gauge(
+            f"{NS}_admission_cycle_preemption_skips",
+            "Number of workloads whose preemption was skipped in the last cycle",
+            ("cluster_queue",),
+        )
+        self.pending_workloads = r.gauge(
+            f"{NS}_pending_workloads",
+            "Number of pending workloads, per cluster_queue and status (active|inadmissible)",
+            ("cluster_queue", "status"),
+        )
+        self.quota_reserved_workloads_total = r.counter(
+            f"{NS}_quota_reserved_workloads_total",
+            "Total number of quota reserved workloads per cluster_queue",
+            ("cluster_queue",),
+        )
+        self.quota_reserved_wait_time_seconds = r.histogram(
+            f"{NS}_quota_reserved_wait_time_seconds",
+            "Time between workload creation and quota reservation",
+            ("cluster_queue",),
+        )
+        self.admitted_workloads_total = r.counter(
+            f"{NS}_admitted_workloads_total",
+            "Total number of admitted workloads per cluster_queue",
+            ("cluster_queue",),
+        )
+        self.admission_wait_time_seconds = r.histogram(
+            f"{NS}_admission_wait_time_seconds",
+            "Time between workload creation and admission",
+            ("cluster_queue",),
+        )
+        self.admission_checks_wait_time_seconds = r.histogram(
+            f"{NS}_admission_checks_wait_time_seconds",
+            "Time between quota reservation and admission",
+            ("cluster_queue",),
+        )
+        self.evicted_workloads_total = r.counter(
+            f"{NS}_evicted_workloads_total",
+            "Total number of evicted workloads per cluster_queue and reason",
+            ("cluster_queue", "reason"),
+        )
+        self.preempted_workloads_total = r.counter(
+            f"{NS}_preempted_workloads_total",
+            "Total number of preempted workloads per preempting cluster_queue and reason",
+            ("preempting_cluster_queue", "reason"),
+        )
+        self.reserving_active_workloads = r.gauge(
+            f"{NS}_reserving_active_workloads",
+            "Number of workloads with quota reservation per cluster_queue",
+            ("cluster_queue",),
+        )
+        self.admitted_active_workloads = r.gauge(
+            f"{NS}_admitted_active_workloads",
+            "Number of admitted not-finished workloads per cluster_queue",
+            ("cluster_queue",),
+        )
+        self.cluster_queue_status = r.gauge(
+            f"{NS}_cluster_queue_status",
+            "ClusterQueue status (1 for the active condition state)",
+            ("cluster_queue", "status"),
+        )
+        self.cluster_queue_resource_reservation = r.gauge(
+            f"{NS}_cluster_queue_resource_reservation",
+            "Total quantity of reserved quota per cohort/cluster_queue/flavor/resource",
+            ("cohort", "cluster_queue", "flavor", "resource"),
+        )
+        self.cluster_queue_resource_usage = r.gauge(
+            f"{NS}_cluster_queue_resource_usage",
+            "Total quantity of used quota per cohort/cluster_queue/flavor/resource",
+            ("cohort", "cluster_queue", "flavor", "resource"),
+        )
+        self.cluster_queue_nominal_quota = r.gauge(
+            f"{NS}_cluster_queue_nominal_quota",
+            "Nominal quota per cohort/cluster_queue/flavor/resource",
+            ("cohort", "cluster_queue", "flavor", "resource"),
+        )
+        self.cluster_queue_borrowing_limit = r.gauge(
+            f"{NS}_cluster_queue_borrowing_limit",
+            "Borrowing limit per cohort/cluster_queue/flavor/resource",
+            ("cohort", "cluster_queue", "flavor", "resource"),
+        )
+        self.cluster_queue_lending_limit = r.gauge(
+            f"{NS}_cluster_queue_lending_limit",
+            "Lending limit per cohort/cluster_queue/flavor/resource",
+            ("cohort", "cluster_queue", "flavor", "resource"),
+        )
+        self.cluster_queue_weighted_share = r.gauge(
+            f"{NS}_cluster_queue_weighted_share",
+            "Fair-sharing weighted share per cluster_queue",
+            ("cluster_queue",),
+        )
+        self.cohort_weighted_share = r.gauge(
+            f"{NS}_cohort_weighted_share",
+            "Fair-sharing weighted share per cohort",
+            ("cohort",),
+        )
+        # LocalQueue variants (LocalQueueMetrics feature gate)
+        self.local_queue_pending_workloads = r.gauge(
+            f"{NS}_local_queue_pending_workloads",
+            "Number of pending workloads per local_queue",
+            ("local_queue", "namespace", "status"),
+        )
+        self.local_queue_admitted_workloads_total = r.counter(
+            f"{NS}_local_queue_admitted_workloads_total",
+            "Total admitted workloads per local_queue",
+            ("local_queue", "namespace"),
+        )
+        self.local_queue_evicted_workloads_total = r.counter(
+            f"{NS}_local_queue_evicted_workloads_total",
+            "Total evicted workloads per local_queue and reason",
+            ("local_queue", "namespace", "reason"),
+        )
+
+    # ---- reporting helpers (metrics.go:387-470) ----
+    @property
+    def lq_enabled(self) -> bool:
+        return features.enabled("LocalQueueMetrics")
+
+    def report_admission_attempt(self, result: str, duration_s: float) -> None:
+        self.admission_attempts_total.inc(result=result)
+        self.admission_attempt_duration_seconds.observe(duration_s, result=result)
+
+    def report_pending_workloads(self, cq: str, active: int, inadmissible: int) -> None:
+        self.pending_workloads.set(active, cluster_queue=cq, status="active")
+        self.pending_workloads.set(
+            inadmissible, cluster_queue=cq, status="inadmissible"
+        )
+
+    def report_quota_reserved(self, cq: str, wait_s: float) -> None:
+        self.quota_reserved_workloads_total.inc(cluster_queue=cq)
+        self.quota_reserved_wait_time_seconds.observe(wait_s, cluster_queue=cq)
+
+    def report_admitted(self, cq: str, wait_s: float, checks_wait_s: float,
+                        lq: str = "", namespace: str = "") -> None:
+        self.admitted_workloads_total.inc(cluster_queue=cq)
+        self.admission_wait_time_seconds.observe(wait_s, cluster_queue=cq)
+        self.admission_checks_wait_time_seconds.observe(
+            checks_wait_s, cluster_queue=cq
+        )
+        if lq and self.lq_enabled:
+            self.local_queue_admitted_workloads_total.inc(
+                local_queue=lq, namespace=namespace
+            )
+
+    def report_evicted(self, cq: str, reason: str, lq: str = "", namespace: str = "") -> None:
+        self.evicted_workloads_total.inc(cluster_queue=cq, reason=reason)
+        if lq and self.lq_enabled:
+            self.local_queue_evicted_workloads_total.inc(
+                local_queue=lq, namespace=namespace, reason=reason
+            )
+
+    def report_preemption(self, preempting_cq: str, reason: str) -> None:
+        self.preempted_workloads_total.inc(
+            preempting_cluster_queue=preempting_cq, reason=reason
+        )
+
+    def report_cq_quotas(self, cohort: str, cq: str, quotas) -> None:
+        """quotas: iterable of (flavor, resource, nominal, borrowing, lending)."""
+        for flavor, resource, nominal, borrowing, lending in quotas:
+            labels = dict(
+                cohort=cohort, cluster_queue=cq, flavor=flavor, resource=resource
+            )
+            self.cluster_queue_nominal_quota.set(nominal, **labels)
+            if borrowing is not None:
+                self.cluster_queue_borrowing_limit.set(borrowing, **labels)
+            if lending is not None:
+                self.cluster_queue_lending_limit.set(lending, **labels)
+
+    def report_cq_usage(self, cohort: str, cq: str, usage) -> None:
+        """usage: iterable of (flavor, resource, reserved, used)."""
+        for flavor, resource, reserved, used in usage:
+            labels = dict(
+                cohort=cohort, cluster_queue=cq, flavor=flavor, resource=resource
+            )
+            self.cluster_queue_resource_reservation.set(reserved, **labels)
+            self.cluster_queue_resource_usage.set(used, **labels)
+
+    def clear_cluster_queue(self, cq: str) -> None:
+        """ClearClusterQueueResourceMetrics on CQ delete."""
+        for metric in (
+            self.pending_workloads,
+            self.reserving_active_workloads,
+            self.admitted_active_workloads,
+            self.admission_cycle_preemption_skips,
+            self.cluster_queue_status,
+        ):
+            for key in list(metric._values):
+                if key and key[0] == cq:
+                    metric._values.pop(key, None)

@@ -1,0 +1,80 @@
+"""Visibility API — on-demand pending-workloads summaries.
+
+Reference: apis/visibility/v1beta1 + pkg/visibility (the embedded
+apiserver serving PendingWorkloadsSummary subresources on CQ/LQ at
+:8082). Here the same payloads are computed straight from the
+QueueManager's heap snapshots (pkg/queue/manager.go:695-731); servers
+(HTTP, gRPC) can wrap these functions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from kueue_tpu.core.queue_manager import QueueManager
+
+
+@dataclass
+class PendingWorkload:
+    """visibility/v1beta1 PendingWorkload."""
+
+    name: str
+    namespace: str
+    local_queue_name: str
+    priority: int
+    position_in_cluster_queue: int
+    position_in_local_queue: int
+
+
+@dataclass
+class PendingWorkloadsSummary:
+    items: List[PendingWorkload] = field(default_factory=list)
+
+
+def pending_workloads_in_cq(
+    queues: QueueManager, cq_name: str, offset: int = 0, limit: int = 1000
+) -> PendingWorkloadsSummary:
+    """pkg/visibility/api/v1beta1/pending_workloads_cq.go:37-46."""
+    pending = queues.cluster_queues.get(cq_name)
+    if pending is None:
+        return PendingWorkloadsSummary()
+    ordered = pending.snapshot_sorted()
+    lq_positions: dict = {}
+    items: List[PendingWorkload] = []
+    for pos, wl in enumerate(ordered):
+        lq_key = f"{wl.namespace}/{wl.queue_name}"
+        lq_pos = lq_positions.get(lq_key, 0)
+        lq_positions[lq_key] = lq_pos + 1
+        if pos < offset or len(items) >= limit:
+            continue
+        items.append(
+            PendingWorkload(
+                name=wl.name,
+                namespace=wl.namespace,
+                local_queue_name=wl.queue_name,
+                priority=queues._priority(wl),
+                position_in_cluster_queue=pos,
+                position_in_local_queue=lq_pos,
+            )
+        )
+    return PendingWorkloadsSummary(items=items)
+
+
+def pending_workloads_in_lq(
+    queues: QueueManager, namespace: str, lq_name: str,
+    offset: int = 0, limit: int = 1000,
+) -> PendingWorkloadsSummary:
+    """LQ variant: the CQ summary filtered to one LocalQueue, with LQ
+    positions recomputed."""
+    lq = queues.local_queues.get(f"{namespace}/{lq_name}")
+    if lq is None:
+        return PendingWorkloadsSummary()
+    cq_summary = pending_workloads_in_cq(
+        queues, lq.cluster_queue, offset=0, limit=1 << 30
+    )
+    items = [
+        pw for pw in cq_summary.items
+        if pw.namespace == namespace and pw.local_queue_name == lq_name
+    ]
+    return PendingWorkloadsSummary(items=items[offset : offset + limit])

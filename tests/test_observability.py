@@ -1,0 +1,174 @@
+"""Metrics, visibility, config and debugger tests."""
+
+import signal
+
+import pytest
+
+from kueue_tpu import features
+from kueue_tpu.config import Configuration, load_config, runtime_from_config
+from kueue_tpu.debugger import dump
+from kueue_tpu.metrics.registry import Counter, Gauge, Histogram, Registry
+from kueue_tpu.visibility import pending_workloads_in_cq, pending_workloads_in_lq
+from kueue_tpu.models import ClusterQueue, LocalQueue, ResourceFlavor
+from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.controllers.jobs import BatchJob
+from kueue_tpu.utils.clock import FakeClock
+
+
+class TestRegistry:
+    def test_counter_and_labels(self):
+        r = Registry()
+        c = r.counter("kueue_test_total", "help text", ("result",))
+        c.inc(result="success")
+        c.inc(2, result="success")
+        c.inc(result="inadmissible")
+        assert c.value(result="success") == 3
+        text = r.expose()
+        assert '# TYPE kueue_test_total counter' in text
+        assert 'kueue_test_total{result="success"} 3' in text
+
+    def test_label_mismatch_rejected(self):
+        c = Counter("x", "h", ("a",))
+        with pytest.raises(ValueError):
+            c.inc(b="nope")
+
+    def test_gauge_set(self):
+        g = Gauge("g", "h", ("q",))
+        g.set(5, q="cq")
+        g.dec(2, q="cq")
+        assert g.value(q="cq") == 3
+
+    def test_histogram_buckets(self):
+        h = Histogram("h", "help", (), buckets=(0.1, 1, 10))
+        for v in (0.05, 0.5, 5, 50):
+            h.observe(v)
+        text = "\n".join(h.collect())
+        assert 'h_bucket{le="0.1"} 1' in text
+        assert 'h_bucket{le="1"} 2' in text
+        assert 'h_bucket{le="10"} 3' in text
+        assert 'h_bucket{le="+Inf"} 4' in text
+        assert h.count() == 4
+
+
+def run_scenario():
+    clock = FakeClock(1000.0)
+    rt = ClusterRuntime(clock=clock)
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq", namespace_selector={},
+            resource_groups=(
+                ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": "2"}),)),
+            ),
+        )
+    )
+    rt.add_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+    jobs = []
+    for i in range(4):
+        j = BatchJob.build("ns", f"j{i}", "lq", parallelism=1, requests={"cpu": "1"})
+        rt.add_job(j)
+        jobs.append(j)
+        clock.advance(1.0)
+        rt.run_until_idle()
+    return rt, jobs, clock
+
+
+class TestRuntimeMetrics:
+    def test_admission_metrics_reported(self):
+        rt, jobs, clock = run_scenario()
+        m = rt.metrics
+        assert m.admitted_workloads_total.value(cluster_queue="cq") == 2
+        assert m.quota_reserved_workloads_total.value(cluster_queue="cq") == 2
+        assert m.pending_workloads.value(cluster_queue="cq", status="inadmissible") == 2
+        assert m.reserving_active_workloads.value(cluster_queue="cq") == 2
+        assert m.admission_attempts_total.value(result="success") >= 2
+        text = m.registry.expose()
+        assert "kueue_admission_attempt_duration_seconds_bucket" in text
+
+    def test_eviction_metric(self):
+        rt, jobs, clock = run_scenario()
+        wl = rt.workloads["ns/job-j0"]
+        wl.active = False
+        rt.run_until_idle()
+        assert (
+            rt.metrics.evicted_workloads_total.value(
+                cluster_queue="cq", reason="Deactivated"
+            )
+            == 1
+        )
+
+
+class TestVisibility:
+    def test_cq_summary_positions(self):
+        rt, jobs, clock = run_scenario()
+        summary = pending_workloads_in_cq(rt.queues, "cq")
+        names = [pw.name for pw in summary.items]
+        assert names == ["job-j2", "job-j3"]
+        assert [pw.position_in_cluster_queue for pw in summary.items] == [0, 1]
+        assert [pw.position_in_local_queue for pw in summary.items] == [0, 1]
+
+    def test_lq_summary(self):
+        rt, jobs, clock = run_scenario()
+        summary = pending_workloads_in_lq(rt.queues, "ns", "lq")
+        assert len(summary.items) == 2
+        assert pending_workloads_in_lq(rt.queues, "ns", "nope").items == []
+
+    def test_offset_limit(self):
+        rt, jobs, clock = run_scenario()
+        summary = pending_workloads_in_cq(rt.queues, "cq", offset=1, limit=1)
+        assert [pw.name for pw in summary.items] == ["job-j3"]
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = load_config({})
+        assert cfg.namespace == "kueue-system"
+        assert cfg.integrations_frameworks == ("batch/job",)
+        assert not cfg.wait_for_pods_ready.enable
+        assert cfg.multikueue.worker_lost_timeout_seconds == 900
+
+    def test_full_decode(self):
+        cfg = load_config({
+            "namespace": "custom",
+            "manageJobsWithoutQueueName": True,
+            "waitForPodsReady": {
+                "enable": True, "timeout": 120,
+                "requeuingStrategy": {"backoffLimitCount": 5, "backoffBaseSeconds": 10},
+            },
+            "integrations": {"frameworks": ["batch/job", "pod"]},
+            "fairSharing": {"enable": True},
+            "featureGates": {"TopologyAwareScheduling": True},
+        })
+        assert cfg.wait_for_pods_ready.backoff_limit_count == 5
+        assert cfg.fair_sharing.enable
+        rt = runtime_from_config(cfg, clock=FakeClock(0.0))
+        assert rt.scheduler.fair_sharing
+        assert features.enabled("TopologyAwareScheduling")
+        features.gates.set("TopologyAwareScheduling", False)  # restore
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown configuration keys"):
+            load_config({"nope": 1})
+
+    def test_unknown_framework_rejected(self):
+        with pytest.raises(ValueError, match="unknown integration framework"):
+            load_config({"integrations": {"frameworks": ["bogus/kind"]}})
+
+    def test_invalid_pods_ready_timeout(self):
+        with pytest.raises(ValueError, match="timeout must be positive"):
+            load_config({"waitForPodsReady": {"enable": True, "timeout": -1}})
+
+    def test_unknown_feature_gate(self):
+        with pytest.raises(ValueError, match="unknown feature gate"):
+            load_config({"featureGates": {"NoSuchGate": True}})
+
+
+class TestDebugger:
+    def test_dump_renders_state(self):
+        rt, jobs, clock = run_scenario()
+        text = dump(rt)
+        assert "ClusterQueue cq" in text
+        assert "admitted=2" in text
+        assert "inadmissible: " in text
+        assert "usage: default/cpu=2000" in text
