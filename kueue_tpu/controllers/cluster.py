@@ -148,8 +148,15 @@ class ClusterRuntime:
             )
 
     def _report_cycle_metrics(self, result, duration_s: float) -> None:
-        outcome = "success" if result.admitted else "inadmissible"
-        self.metrics.report_admission_attempt(outcome, duration_s)
+        # no-op cycles (empty queues) are not admission attempts —
+        # reporting them would drown the success/inadmissible ratio
+        considered = (
+            result.admitted or result.requeued or result.preempting
+            or result.skipped_preemptions
+        )
+        if considered:
+            outcome = "success" if result.admitted else "inadmissible"
+            self.metrics.report_admission_attempt(outcome, duration_s)
         for cq_name, pending in self.queues.cluster_queues.items():
             self.metrics.report_pending_workloads(
                 cq_name, pending.pending_active(), pending.pending_inadmissible()

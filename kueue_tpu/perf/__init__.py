@@ -1,0 +1,33 @@
+"""Performance / scalability harness.
+
+Reference: test/performance/scheduler (minimalkueue + runner + checker).
+The runner drives the scheduling core alone (no job integrations — the
+minimalkueue configuration) in VIRTUAL time: workload creation follows
+the generator intervals, admitted workloads finish after their
+simulated runtime, and the checker asserts admission-latency /
+utilization expectations like default_rangespec.yaml.
+"""
+
+from kueue_tpu.perf.generator import (
+    CohortClass,
+    GeneratorConfig,
+    QueueSetClass,
+    WorkloadClass,
+    WorkloadSet,
+    DEFAULT_GENERATOR_CONFIG,
+)
+from kueue_tpu.perf.runner import RunResult, run
+from kueue_tpu.perf.checker import RangeSpec, check
+
+__all__ = [
+    "CohortClass",
+    "GeneratorConfig",
+    "QueueSetClass",
+    "WorkloadClass",
+    "WorkloadSet",
+    "DEFAULT_GENERATOR_CONFIG",
+    "RunResult",
+    "run",
+    "RangeSpec",
+    "check",
+]

@@ -1,0 +1,55 @@
+"""Result checker (test/performance/scheduler/checker +
+default_rangespec.yaml)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kueue_tpu.perf.runner import RunResult
+
+
+@dataclass
+class RangeSpec:
+    max_wall_s: Optional[float] = None
+    # workload class -> max average time-to-admission (virtual seconds)
+    wl_classes_max_avg_tta_s: Dict[str, float] = field(default_factory=dict)
+    # min average utilization over every CQ (fraction, e.g. 0.55)
+    cq_min_avg_utilization: Optional[float] = None
+    require_all_admitted: bool = True
+
+
+def check(result: RunResult, spec: RangeSpec) -> List[str]:
+    """Returns violations ([] = pass)."""
+    errs: List[str] = []
+    if spec.require_all_admitted and result.admitted < result.total:
+        errs.append(f"admitted {result.admitted}/{result.total} workloads")
+    if spec.max_wall_s is not None and result.wall_s > spec.max_wall_s:
+        errs.append(f"wall time {result.wall_s:.1f}s > {spec.max_wall_s}s")
+    for cls, max_avg in spec.wl_classes_max_avg_tta_s.items():
+        avg = result.avg_tta(cls)
+        if avg > max_avg:
+            errs.append(
+                f"class {cls}: avg time-to-admission {avg:.2f}s > {max_avg}s"
+            )
+    if spec.cq_min_avg_utilization is not None:
+        for name, util in result.cq_avg_utilization.items():
+            if util < spec.cq_min_avg_utilization:
+                errs.append(
+                    f"cq {name}: avg utilization {util:.2%} < "
+                    f"{spec.cq_min_avg_utilization:.2%}"
+                )
+    return errs
+
+
+# default_rangespec.yaml admission-latency expectations, virtual-time
+# equivalents (the reference values are wall-clock on a CI VM; virtual
+# time removes host speed, so the latency ceilings carry over directly).
+DEFAULT_RANGE_SPEC = RangeSpec(
+    wl_classes_max_avg_tta_s={
+        "large": 11.0,
+        "medium": 90.0,
+        "small": 233.0,
+    },
+    cq_min_avg_utilization=None,  # utilization is asserted per-scenario
+)

@@ -1,0 +1,219 @@
+"""Scenario generator (test/performance/scheduler/generator).
+
+Mirrors default_generator_config.yaml: cohort classes -> queue-set
+classes (nominalQuota/borrowingLimit/preemption) -> workload sets
+(count, creationIntervalMs, per-workload class/runtime/priority/
+request). Workloads round-robin over the cohort's LocalQueues.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, List, Tuple
+
+from kueue_tpu.models import ClusterQueue, LocalQueue, ResourceFlavor, Workload
+from kueue_tpu.models.cluster_queue import FlavorQuotas, Preemption, ResourceGroup
+from kueue_tpu.models.constants import (
+    PreemptionPolicy,
+    ReclaimWithinCohortPolicy,
+)
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.resources import requests_from_spec
+
+NAMESPACE = "perf"
+FLAVOR = "default"
+
+
+@dataclass
+class WorkloadClass:
+    class_name: str
+    runtime_ms: int
+    priority: int
+    request_cpu: int  # whole cpus
+
+
+@dataclass
+class WorkloadSet:
+    count: int
+    creation_interval_ms: int
+    workloads: Tuple[WorkloadClass, ...]
+
+
+@dataclass
+class QueueSetClass:
+    class_name: str
+    count: int
+    nominal_quota: int
+    borrowing_limit: int
+    reclaim_within_cohort: ReclaimWithinCohortPolicy
+    within_cluster_queue: PreemptionPolicy
+    workload_sets: Tuple[WorkloadSet, ...]
+
+
+@dataclass
+class CohortClass:
+    class_name: str
+    count: int
+    queue_sets: Tuple[QueueSetClass, ...]
+
+
+@dataclass
+class GeneratorConfig:
+    cohorts: Tuple[CohortClass, ...]
+
+    def scaled(self, factor: float) -> "GeneratorConfig":
+        """Uniformly scale workload counts (for fast CI runs)."""
+        def scale_ws(ws: WorkloadSet) -> WorkloadSet:
+            return WorkloadSet(
+                count=max(1, int(ws.count * factor)),
+                creation_interval_ms=ws.creation_interval_ms,
+                workloads=ws.workloads,
+            )
+
+        return GeneratorConfig(
+            cohorts=tuple(
+                CohortClass(
+                    class_name=c.class_name,
+                    count=c.count,
+                    queue_sets=tuple(
+                        QueueSetClass(
+                            class_name=q.class_name,
+                            count=q.count,
+                            nominal_quota=q.nominal_quota,
+                            borrowing_limit=q.borrowing_limit,
+                            reclaim_within_cohort=q.reclaim_within_cohort,
+                            within_cluster_queue=q.within_cluster_queue,
+                            workload_sets=tuple(scale_ws(ws) for ws in q.workload_sets),
+                        )
+                        for q in c.queue_sets
+                    ),
+                )
+                for c in self.cohorts
+            )
+        )
+
+
+# default_generator_config.yaml:1-30
+DEFAULT_GENERATOR_CONFIG = GeneratorConfig(
+    cohorts=(
+        CohortClass(
+            class_name="cohort",
+            count=5,
+            queue_sets=(
+                QueueSetClass(
+                    class_name="cq",
+                    count=6,
+                    nominal_quota=20,
+                    borrowing_limit=100,
+                    reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    workload_sets=(
+                        WorkloadSet(350, 100, (WorkloadClass("small", 200, 50, 1),)),
+                        WorkloadSet(100, 500, (WorkloadClass("medium", 500, 100, 5),)),
+                        WorkloadSet(50, 1200, (WorkloadClass("large", 1000, 200, 20),)),
+                    ),
+                ),
+            ),
+        ),
+    )
+)
+
+
+@dataclass
+class GeneratedWorkload:
+    workload: Workload
+    class_name: str
+    runtime_s: float
+    creation_s: float
+
+
+@dataclass
+class Scenario:
+    flavor: ResourceFlavor
+    cluster_queues: List[ClusterQueue] = field(default_factory=list)
+    local_queues: List[LocalQueue] = field(default_factory=list)
+    workloads: List[GeneratedWorkload] = field(default_factory=list)
+    # cq name -> nominal cpu quota (for utilization accounting)
+    nominal_cpu: dict = field(default_factory=dict)
+
+
+def generate(config: GeneratorConfig) -> Scenario:
+    scenario = Scenario(flavor=ResourceFlavor(name=FLAVOR))
+    wl_seq = 0
+    for cc in config.cohorts:
+        for ci in range(cc.count):
+            cohort_name = f"{cc.class_name}-{ci}"
+            for qs in cc.queue_sets:
+                lq_names: List[str] = []
+                for qi in range(qs.count):
+                    cq_name = f"{cohort_name}-{qs.class_name}-{qi}"
+                    scenario.cluster_queues.append(
+                        ClusterQueue(
+                            name=cq_name,
+                            cohort=cohort_name,
+                            namespace_selector={},
+                            resource_groups=(
+                                ResourceGroup(
+                                    ("cpu",),
+                                    (
+                                        FlavorQuotas.build(
+                                            FLAVOR,
+                                            {
+                                                "cpu": (
+                                                    str(qs.nominal_quota),
+                                                    str(qs.borrowing_limit),
+                                                    None,
+                                                )
+                                            },
+                                        ),
+                                    ),
+                                ),
+                            ),
+                            preemption=Preemption(
+                                reclaim_within_cohort=qs.reclaim_within_cohort,
+                                within_cluster_queue=qs.within_cluster_queue,
+                            ),
+                        )
+                    )
+                    scenario.nominal_cpu[cq_name] = qs.nominal_quota * 1000
+                    lq_name = f"lq-{cq_name}"
+                    scenario.local_queues.append(
+                        LocalQueue(
+                            namespace=NAMESPACE, name=lq_name, cluster_queue=cq_name
+                        )
+                    )
+                    lq_names.append(lq_name)
+
+                # workload sets spread round-robin over the cohort's LQs
+                for si, ws in enumerate(qs.workload_sets):
+                    t_ms = 0.0
+                    for i in range(ws.count):
+                        t_ms += ws.creation_interval_ms
+                        wc = ws.workloads[i % len(ws.workloads)]
+                        lq = lq_names[i % len(lq_names)]
+                        wl = Workload(
+                            namespace=NAMESPACE,
+                            name=f"wl-{cohort_name}-{si}-{wl_seq}",
+                            queue_name=lq,
+                            priority=wc.priority,
+                            creation_time=t_ms / 1000.0,
+                            pod_sets=(
+                                PodSet(
+                                    name="main",
+                                    count=1,
+                                    requests=requests_from_spec(
+                                        {"cpu": str(wc.request_cpu)}
+                                    ),
+                                ),
+                            ),
+                        )
+                        wl_seq += 1
+                        scenario.workloads.append(
+                            GeneratedWorkload(
+                                workload=wl,
+                                class_name=wc.class_name,
+                                runtime_s=wc.runtime_ms / 1000.0,
+                                creation_s=t_ms / 1000.0,
+                            )
+                        )
+    return scenario

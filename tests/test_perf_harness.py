@@ -1,0 +1,55 @@
+"""Perf harness tests (scaled-down default scenario)."""
+
+from kueue_tpu.perf import DEFAULT_GENERATOR_CONFIG, RangeSpec, check, run
+from kueue_tpu.perf.generator import generate
+
+
+class TestGenerator:
+    def test_default_config_shape(self):
+        scenario = generate(DEFAULT_GENERATOR_CONFIG)
+        assert len(scenario.cluster_queues) == 30  # 5 cohorts x 6 CQs
+        assert len(scenario.local_queues) == 30
+        assert len(scenario.workloads) == 2500  # 5 x (350+100+50)
+        classes = {}
+        for gw in scenario.workloads:
+            classes[gw.class_name] = classes.get(gw.class_name, 0) + 1
+        assert classes == {"small": 1750, "medium": 500, "large": 250}
+        # borrowing limits present
+        cq = scenario.cluster_queues[0]
+        rq = cq.resource_groups[0].flavors[0].resources["cpu"]
+        assert rq.nominal == 20_000 and rq.borrowing_limit == 100_000
+
+    def test_scaled(self):
+        cfg = DEFAULT_GENERATOR_CONFIG.scaled(0.1)
+        scenario = generate(cfg)
+        assert len(scenario.workloads) == 5 * (35 + 10 + 5)
+
+
+class TestRunner:
+    def test_scaled_run_admits_everything(self):
+        result = run(DEFAULT_GENERATOR_CONFIG.scaled(0.04))
+        assert result.admitted == result.total == 100
+        assert result.virtual_s > 0
+        assert set(result.time_to_admission) == {"small", "medium", "large"}
+        violations = check(
+            result,
+            RangeSpec(
+                wl_classes_max_avg_tta_s={"large": 11.0, "medium": 90.0, "small": 233.0},
+            ),
+        )
+        assert violations == []
+
+    def test_contention_produces_queueing(self):
+        # 10x the load on the same quota: small workloads must wait
+        cfg = DEFAULT_GENERATOR_CONFIG.scaled(0.2)
+        result = run(cfg)
+        assert result.admitted == result.total
+        # higher-priority large workloads admit faster than small ones
+        assert result.avg_tta("large") <= result.avg_tta("small") + 1e-9
+
+    def test_checker_flags_violations(self):
+        result = run(DEFAULT_GENERATOR_CONFIG.scaled(0.04))
+        errs = check(
+            result, RangeSpec(wl_classes_max_avg_tta_s={"small": -1.0})
+        )
+        assert errs and "small" in errs[0]
