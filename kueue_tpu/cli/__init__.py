@@ -1,0 +1,1 @@
+"""kueuectl-equivalent CLI (cmd/kueuectl)."""

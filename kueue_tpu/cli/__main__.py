@@ -1,0 +1,443 @@
+"""python -m kueue_tpu.cli — the kueuectl equivalent.
+
+Reference: cmd/kueuectl/app (create {cq,lq,rf}, list {cq,lq,workload,
+rf}, stop/resume {workload,cq,lq}) plus cmd/importer (bulk pod import).
+State lives in a JSON file (--state, default ./kueue-state.json) — the
+CLI's durable store standing in for the API server; ``schedule`` loads
+the state, runs admission cycles, and writes decisions back.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+from kueue_tpu import serialization as ser
+from kueue_tpu.models.constants import StopPolicy, WorkloadConditionType
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.models import Workload
+from kueue_tpu.resources import requests_from_spec
+
+
+class State:
+    def __init__(self, path: str):
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                self.data = json.load(f)
+        else:
+            self.data = ser.state_to_dict([], [], [], [])
+
+    def save(self) -> None:
+        with open(self.path, "w") as f:
+            json.dump(self.data, f, indent=1, sort_keys=True)
+
+    def find(self, section: str, name: str, namespace: str = "") -> dict:
+        for obj in self.data.get(section, []):
+            if obj["name"] == name and obj.get("namespace", "") == (namespace or obj.get("namespace", "")):
+                return obj
+        raise SystemExit(f"error: {section[:-1]} {name!r} not found")
+
+    def upsert(self, section: str, obj: dict) -> None:
+        items = self.data.setdefault(section, [])
+        for i, existing in enumerate(items):
+            if existing["name"] == obj["name"] and existing.get("namespace") == obj.get("namespace"):
+                items[i] = obj
+                return
+        items.append(obj)
+
+    def build_runtime(self):
+        from kueue_tpu.controllers import ClusterRuntime
+
+        rt = ClusterRuntime()
+        for f in self.data.get("resourceFlavors", []):
+            rt.add_flavor(ser.flavor_from_dict(f))
+        for t in self.data.get("topologies", []):
+            rt.add_topology(ser.topology_from_dict(t))
+        for c in self.data.get("cohorts", []):
+            rt.add_cohort(ser.cohort_from_dict(c))
+        for a in self.data.get("admissionChecks", []):
+            rt.add_admission_check(ser.check_from_dict(a))
+        for p in self.data.get("workloadPriorityClasses", []):
+            rt.add_priority_class(ser.priority_class_from_dict(p))
+        for c in self.data.get("clusterQueues", []):
+            rt.add_cluster_queue(ser.cq_from_dict(c))
+        for l in self.data.get("localQueues", []):
+            rt.add_local_queue(ser.lq_from_dict(l))
+        for w in self.data.get("workloads", []):
+            rt.add_workload(ser.workload_from_dict(w))
+        return rt
+
+
+def _parse_quotas(spec: str) -> Dict[str, str]:
+    """cpu=10,memory=5Gi -> {"cpu": "10", "memory": "5Gi"}"""
+    out: Dict[str, str] = {}
+    for part in spec.split(","):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        if not v:
+            raise SystemExit(f"error: invalid quota {part!r} (want resource=quantity)")
+        out[k] = v
+    return out
+
+
+def _parse_labels(spec: str) -> Dict[str, str]:
+    return _parse_quotas(spec)
+
+
+# ---- create ----
+def cmd_create_cq(state: State, args) -> None:
+    quotas = _parse_quotas(args.nominal_quota)
+    borrowing = _parse_quotas(args.borrowing_limit) if args.borrowing_limit else {}
+    lending = _parse_quotas(args.lending_limit) if args.lending_limit else {}
+    resources = [
+        {
+            "name": r,
+            "nominalQuota": _canon(r, q),
+            "borrowingLimit": _canon(r, borrowing[r]) if r in borrowing else None,
+            "lendingLimit": _canon(r, lending[r]) if r in lending else None,
+        }
+        for r, q in quotas.items()
+    ]
+    obj = {
+        "name": args.name,
+        "cohort": args.cohort,
+        "queueingStrategy": args.queuing_strategy,
+        "namespaceSelector": {},
+        "stopPolicy": "None",
+        "admissionChecks": [],
+        "preemption": {
+            "reclaimWithinCohort": args.reclaim_within_cohort,
+            "withinClusterQueue": args.preemption_within_cluster_queue,
+            "borrowWithinCohort": {"policy": "Never", "maxPriorityThreshold": None},
+        },
+        "resourceGroups": [
+            {
+                "coveredResources": list(quotas),
+                "flavors": [{"name": args.flavor, "resources": resources}],
+            }
+        ],
+    }
+    ser.cq_from_dict(obj)  # validate
+    state.upsert("clusterQueues", obj)
+    state.save()
+    print(f"clusterqueue.kueue.x-k8s.io/{args.name} created")
+
+
+def _canon(resource: str, qty: str) -> int:
+    from kueue_tpu.resources import quantity_to_int
+
+    return quantity_to_int(resource, qty)
+
+
+def cmd_create_lq(state: State, args) -> None:
+    obj = {
+        "name": args.name,
+        "namespace": args.namespace,
+        "clusterQueue": args.clusterqueue,
+        "stopPolicy": "None",
+    }
+    ser.lq_from_dict(obj)
+    state.upsert("localQueues", obj)
+    state.save()
+    print(f"localqueue.kueue.x-k8s.io/{args.name} created")
+
+
+def cmd_create_rf(state: State, args) -> None:
+    obj = {
+        "name": args.name,
+        "nodeLabels": _parse_labels(args.node_labels) if args.node_labels else {},
+        "nodeTaints": [],
+        "tolerations": [],
+        "topologyName": args.topology,
+    }
+    ser.flavor_from_dict(obj)
+    state.upsert("resourceFlavors", obj)
+    state.save()
+    print(f"resourceflavor.kueue.x-k8s.io/{args.name} created")
+
+
+def cmd_create_workload(state: State, args) -> None:
+    import time
+
+    wl = Workload(
+        namespace=args.namespace,
+        name=args.name,
+        queue_name=args.localqueue,
+        priority=args.priority,
+        creation_time=time.time(),
+        pod_sets=(
+            PodSet(
+                name="main",
+                count=args.count,
+                requests=requests_from_spec(_parse_quotas(args.requests)),
+            ),
+        ),
+    )
+    state.upsert("workloads", ser.workload_to_dict(wl))
+    state.save()
+    print(f"workload.kueue.x-k8s.io/{args.name} created")
+
+
+# ---- list ----
+def _print_table(headers: List[str], rows: List[List[str]]) -> None:
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip())
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+
+
+def cmd_list_cq(state: State, args) -> None:
+    rt = state.build_runtime()
+    rows = []
+    for c in state.data.get("clusterQueues", []):
+        name = c["name"]
+        pending = rt.queues.pending_workloads(name)
+        admitted = rt.cache.admitted_count(name)
+        rows.append([name, c.get("cohort") or "", str(pending), str(admitted)])
+    _print_table(["NAME", "COHORT", "PENDING WORKLOADS", "ADMITTED WORKLOADS"], rows)
+
+
+def cmd_list_lq(state: State, args) -> None:
+    rows = [
+        [l["namespace"], l["name"], l["clusterQueue"]]
+        for l in state.data.get("localQueues", [])
+        if not args.namespace or l["namespace"] == args.namespace
+    ]
+    _print_table(["NAMESPACE", "NAME", "CLUSTERQUEUE"], rows)
+
+
+def cmd_list_rf(state: State, args) -> None:
+    rows = [
+        [f["name"], ",".join(f"{k}={v}" for k, v in f.get("nodeLabels", {}).items())]
+        for f in state.data.get("resourceFlavors", [])
+    ]
+    _print_table(["NAME", "NODE LABELS"], rows)
+
+
+def cmd_list_workload(state: State, args) -> None:
+    rows = []
+    for w in state.data.get("workloads", []):
+        if args.namespace and w["namespace"] != args.namespace:
+            continue
+        wl = ser.workload_from_dict(w)
+        status = "PENDING"
+        if wl.is_finished:
+            status = "FINISHED"
+        elif wl.is_admitted:
+            status = "ADMITTED"
+        elif wl.has_quota_reservation:
+            status = "QUOTARESERVED"
+        elif not wl.active:
+            status = "INACTIVE"
+        rows.append([
+            w["namespace"], w["name"], w.get("queueName", ""),
+            wl.admission.cluster_queue if wl.admission else "", status,
+        ])
+    _print_table(
+        ["NAMESPACE", "NAME", "LOCALQUEUE", "CLUSTERQUEUE", "STATUS"], rows
+    )
+
+
+# ---- stop / resume ----
+def cmd_stop(state: State, args) -> None:
+    if args.kind == "workload":
+        obj = state.find("workloads", args.name, args.namespace)
+        obj["active"] = False
+    elif args.kind == "clusterqueue":
+        obj = state.find("clusterQueues", args.name)
+        obj["stopPolicy"] = StopPolicy.HOLD_AND_DRAIN.value
+    else:
+        obj = state.find("localQueues", args.name, args.namespace)
+        obj["stopPolicy"] = StopPolicy.HOLD_AND_DRAIN.value
+    state.save()
+    print(f"{args.kind}.kueue.x-k8s.io/{args.name} stopped")
+
+
+def cmd_resume(state: State, args) -> None:
+    if args.kind == "workload":
+        obj = state.find("workloads", args.name, args.namespace)
+        obj["active"] = True
+    elif args.kind == "clusterqueue":
+        obj = state.find("clusterQueues", args.name)
+        obj["stopPolicy"] = StopPolicy.NONE.value
+    else:
+        obj = state.find("localQueues", args.name, args.namespace)
+        obj["stopPolicy"] = StopPolicy.NONE.value
+    state.save()
+    print(f"{args.kind}.kueue.x-k8s.io/{args.name} resumed")
+
+
+# ---- pending-workloads (visibility) ----
+def cmd_pending_workloads(state: State, args) -> None:
+    from kueue_tpu.visibility import pending_workloads_in_cq
+
+    rt = state.build_runtime()
+    summary = pending_workloads_in_cq(rt.queues, args.clusterqueue)
+    rows = [
+        [str(pw.position_in_cluster_queue), pw.namespace, pw.name,
+         pw.local_queue_name, str(pw.priority)]
+        for pw in summary.items
+    ]
+    _print_table(["POSITION", "NAMESPACE", "NAME", "LOCALQUEUE", "PRIORITY"], rows)
+
+
+# ---- schedule ----
+def cmd_schedule(state: State, args) -> None:
+    rt = state.build_runtime()
+    for _ in range(args.cycles):
+        rt.run_until_idle()
+    state.data["workloads"] = [
+        ser.workload_to_dict(wl) for wl in rt.workloads.values()
+    ]
+    state.save()
+    admitted = sum(1 for wl in rt.workloads.values() if wl.is_admitted)
+    pending = sum(
+        rt.queues.pending_workloads(name)
+        for name in rt.queues.cluster_queues
+    )
+    print(f"admitted={admitted} pending={pending}")
+
+
+# ---- importer (cmd/importer) ----
+def cmd_import(state: State, args) -> None:
+    """Bulk-import running pods: each becomes an admitted workload
+    charging usage (cmd/importer/pod)."""
+    with open(args.file) as f:
+        pods = json.load(f)
+    imported = 0
+    skipped = 0
+    lqs = {
+        (l["namespace"], l["name"]): l["clusterQueue"]
+        for l in state.data.get("localQueues", [])
+    }
+    for pod in pods:
+        queue = pod.get("labels", {}).get("kueue.x-k8s.io/queue-name", "")
+        cq = lqs.get((pod["namespace"], queue))
+        if cq is None:
+            skipped += 1
+            continue
+        requests = requests_from_spec(pod.get("requests", {}))
+        wl = Workload(
+            namespace=pod["namespace"],
+            name=f"pod-{pod['name']}",
+            queue_name=queue,
+            pod_sets=(PodSet(name="main", count=1, requests=requests),),
+        )
+        # imported pods are already running: admit directly at the
+        # first flavor of the CQ (importer/pod/pod.go)
+        cq_obj = ser.cq_from_dict(state.find("clusterQueues", cq))
+        flavor = cq_obj.resource_groups[0].flavors[0].name
+        from kueue_tpu.models.workload import Admission, PodSetAssignment
+
+        wl.admission = Admission(
+            cluster_queue=cq,
+            pod_set_assignments=(
+                PodSetAssignment(
+                    name="main",
+                    flavors={r: flavor for r in requests},
+                    resource_usage=dict(requests),
+                    count=1,
+                ),
+            ),
+        )
+        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True, "QuotaReserved")
+        wl.set_condition(WorkloadConditionType.ADMITTED, True, "Admitted")
+        state.upsert("workloads", ser.workload_to_dict(wl))
+        imported += 1
+    state.save()
+    print(f"imported={imported} skipped={skipped}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="kueuectl")
+    ap.add_argument("--state", default="kueue-state.json")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    create = sub.add_parser("create").add_subparsers(dest="kind", required=True)
+    ccq = create.add_parser("clusterqueue", aliases=["cq"])
+    ccq.add_argument("name")
+    ccq.add_argument("--cohort")
+    ccq.add_argument("--flavor", default="default")
+    ccq.add_argument("--nominal-quota", required=True, help="cpu=10,memory=5Gi")
+    ccq.add_argument("--borrowing-limit")
+    ccq.add_argument("--lending-limit")
+    ccq.add_argument("--queuing-strategy", default="BestEffortFIFO",
+                     choices=["StrictFIFO", "BestEffortFIFO"])
+    ccq.add_argument("--reclaim-within-cohort", default="Never",
+                     choices=["Never", "LowerPriority", "Any"])
+    ccq.add_argument("--preemption-within-cluster-queue", default="Never",
+                     choices=["Never", "LowerPriority", "LowerOrNewerEqualPriority"])
+    ccq.set_defaults(fn=cmd_create_cq)
+
+    clq = create.add_parser("localqueue", aliases=["lq"])
+    clq.add_argument("name")
+    clq.add_argument("-n", "--namespace", default="default")
+    clq.add_argument("-c", "--clusterqueue", required=True)
+    clq.set_defaults(fn=cmd_create_lq)
+
+    crf = create.add_parser("resourceflavor", aliases=["rf"])
+    crf.add_argument("name")
+    crf.add_argument("--node-labels")
+    crf.add_argument("--topology")
+    crf.set_defaults(fn=cmd_create_rf)
+
+    cwl = create.add_parser("workload", aliases=["wl"])
+    cwl.add_argument("name")
+    cwl.add_argument("-n", "--namespace", default="default")
+    cwl.add_argument("-q", "--localqueue", required=True)
+    cwl.add_argument("--count", type=int, default=1)
+    cwl.add_argument("--requests", required=True, help="cpu=1,memory=1Gi")
+    cwl.add_argument("--priority", type=int, default=0)
+    cwl.set_defaults(fn=cmd_create_workload)
+
+    lst = sub.add_parser("list").add_subparsers(dest="kind", required=True)
+    lcq = lst.add_parser("clusterqueue", aliases=["cq"])
+    lcq.set_defaults(fn=cmd_list_cq)
+    llq = lst.add_parser("localqueue", aliases=["lq"])
+    llq.add_argument("-n", "--namespace", default="")
+    llq.set_defaults(fn=cmd_list_lq)
+    lrf = lst.add_parser("resourceflavor", aliases=["rf"])
+    lrf.set_defaults(fn=cmd_list_rf)
+    lwl = lst.add_parser("workload", aliases=["wl"])
+    lwl.add_argument("-n", "--namespace", default="")
+    lwl.set_defaults(fn=cmd_list_workload)
+
+    for verb, fn in (("stop", cmd_stop), ("resume", cmd_resume)):
+        p = sub.add_parser(verb)
+        p.add_argument("kind", choices=["workload", "clusterqueue", "localqueue"])
+        p.add_argument("name")
+        p.add_argument("-n", "--namespace", default="default")
+        p.set_defaults(fn=fn)
+
+    pw = sub.add_parser("pending-workloads")
+    pw.add_argument("clusterqueue")
+    pw.set_defaults(fn=cmd_pending_workloads)
+
+    sch = sub.add_parser("schedule")
+    sch.add_argument("--cycles", type=int, default=1)
+    sch.set_defaults(fn=cmd_schedule)
+
+    imp = sub.add_parser("import")
+    imp.add_argument("--file", required=True)
+    imp.set_defaults(fn=cmd_import)
+
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    state = State(args.state)
+    args.fn(state, args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -228,9 +228,13 @@ class ClusterRuntime:
     # ---- workload store, used by reconcilers ----
     def add_workload(self, wl: Workload) -> None:
         self.workloads[wl.key] = wl
+        if wl.is_finished:
+            return
         if wl.admission is not None and wl.has_quota_reservation:
             self.cache.add_or_update_workload(wl)
-        else:
+        elif wl.active:
+            # inactive workloads never queue (workload_controller.go
+            # create/update handlers route them out of the queues)
             self.queues.add_or_update_workload(wl)
 
     def delete_workload(self, wl: Workload) -> None:
@@ -265,6 +269,15 @@ class ClusterRuntime:
         wl.conditions.pop(WorkloadConditionType.EVICTED, None)
         if wl.active:
             self.queues.requeue_workload(wl, RequeueReason.GENERIC)
+
+    def has_job_for(self, wl: Workload) -> bool:
+        for job in self.jobs.values():
+            if (
+                job.namespace == wl.namespace
+                and self.job_reconciler.workload_name_for(job) == wl.name
+            ):
+                return True
+        return False
 
     def requeue_after_backoff(self, wl: Workload) -> None:
         # The Requeued-condition flip is a workload update event: the

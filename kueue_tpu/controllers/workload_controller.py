@@ -81,8 +81,11 @@ class WorkloadReconciler:
                     runtime.requeue_after_backoff(wl)
 
         # deactivation (workload_controller.go:190-224): spec.active
-        # false evicts and never requeues
+        # false evicts, leaves the queues, and never requeues. The
+        # REQUEUED=False breadcrumb lets the reactivation branch above
+        # requeue the workload when spec.active flips back.
         if not wl.active:
+            runtime.queues.delete_workload(wl)
             if not wl.is_evicted:
                 self._evict(
                     wl,
@@ -90,7 +93,21 @@ class WorkloadReconciler:
                     "The workload is deactivated",
                     now,
                 )
+            if wl.conditions.get(WorkloadConditionType.REQUEUED) is None or (
+                wl.conditions[WorkloadConditionType.REQUEUED].status
+            ):
+                wl.set_condition(
+                    WorkloadConditionType.REQUEUED, False,
+                    EVICTED_BY_DEACTIVATION, "The workload is deactivated",
+                    now=now,
+                )
+            self._complete_jobless_eviction(wl, now)
             return
+
+        # evicted workloads WITHOUT a job (plain Workload objects, e.g.
+        # CLI/importer-created) complete their eviction here — the job
+        # reconciler's step 6 does it for job-backed ones
+        self._complete_jobless_eviction(wl, now)
 
         # admission-check outcomes (:409-421,511-545)
         if self._sync_admission_checks(wl, now):
@@ -126,6 +143,25 @@ class WorkloadReconciler:
                 waited = now - adm.last_transition_time
                 if waited >= cfg.timeout_seconds:
                     self._evict_pods_ready_timeout(wl, now)
+
+    def _complete_jobless_eviction(self, wl: Workload, now: float) -> None:
+        from kueue_tpu.models.constants import EVICTED_BY_PREEMPTION
+
+        ev = wl.conditions.get(WorkloadConditionType.EVICTED)
+        if (
+            ev is None
+            or not ev.status
+            or not wl.has_quota_reservation
+            or self.runtime.has_job_for(wl)
+        ):
+            return
+        if wl.active:
+            wl.set_condition(
+                WorkloadConditionType.REQUEUED,
+                ev.reason == EVICTED_BY_PREEMPTION,
+                ev.reason, ev.message, now=now,
+            )
+        self.runtime.unset_quota_reservation(wl, "Pending", ev.message)
 
     # ---- admission checks ----
     def _sync_admission_checks(self, wl: Workload, now: float) -> bool:

@@ -238,6 +238,9 @@ class Scheduler:
             if wl.key in self.cache.assumed_workloads or self._is_admitted(wl):
                 entries.pop()  # already assumed/admitted: drop silently
                 continue
+            if not wl.is_active():
+                e.inadmissible_msg = "The workload is deactivated"
+                continue
             if wl.has_retry_check() or wl.has_rejected_check():
                 e.inadmissible_msg = "The workload has failed admission checks"
                 continue

@@ -212,27 +212,9 @@ class Metrics:
             self.cluster_queue_resource_usage.set(used, **labels)
 
     def clear_cluster_queue(self, cq: str) -> None:
-        """ClearClusterQueueResourceMetrics on CQ delete: drop every
-        series labeled with this cluster_queue, at whatever label
-        position each metric declares it."""
-        for metric in (
-            self.pending_workloads,
-            self.reserving_active_workloads,
-            self.admitted_active_workloads,
-            self.admission_cycle_preemption_skips,
-            self.cluster_queue_status,
-            self.cluster_queue_weighted_share,
-            self.cluster_queue_resource_reservation,
-            self.cluster_queue_resource_usage,
-            self.cluster_queue_nominal_quota,
-            self.cluster_queue_borrowing_limit,
-            self.cluster_queue_lending_limit,
-        ):
-            try:
-                idx = metric.label_names.index("cluster_queue")
-            except ValueError:
-                continue
-            with metric._lock:
-                for key in list(metric._values):
-                    if key[idx] == cq:
-                        metric._values.pop(key, None)
+        """ClearClusterQueueMetrics on CQ delete: drop every series of
+        every metric labeled with this cluster_queue — gauges, counters
+        and histograms alike — so a recreated CQ starts fresh."""
+        for metric in self.registry._metrics.values():
+            metric.clear_matching("cluster_queue", cq)
+            metric.clear_matching("preempting_cluster_queue", cq)

@@ -46,6 +46,24 @@ class _Metric:
             )
         return tuple(str(labels[n]) for n in self.label_names)
 
+    def clear_matching(self, label: str, value: str) -> None:
+        """Drop every series whose ``label`` equals ``value`` (no-op if
+        this metric doesn't carry the label)."""
+        try:
+            idx = self.label_names.index(label)
+        except ValueError:
+            return
+        with self._lock:
+            self._clear_keys(
+                [k for k in self._series_keys() if k[idx] == value]
+            )
+
+    def _series_keys(self):  # overridden per kind
+        return ()
+
+    def _clear_keys(self, keys) -> None:
+        raise NotImplementedError
+
 
 class Counter(_Metric):
     kind = "counter"
@@ -64,6 +82,13 @@ class Counter(_Metric):
 
     def delete(self, **labels) -> None:
         self._values.pop(self._key(labels), None)
+
+    def _series_keys(self):
+        return list(self._values)
+
+    def _clear_keys(self, keys) -> None:
+        for k in keys:
+            self._values.pop(k, None)
 
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
@@ -109,6 +134,15 @@ class Histogram(_Metric):
                     counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+
+    def _series_keys(self):
+        return list(self._totals)
+
+    def _clear_keys(self, keys) -> None:
+        for k in keys:
+            self._counts.pop(k, None)
+            self._sums.pop(k, None)
+            self._totals.pop(k, None)
 
     def count(self, **labels) -> int:
         return self._totals.get(self._key(labels), 0)

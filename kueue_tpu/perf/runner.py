@@ -87,23 +87,26 @@ def run(config: GeneratorConfig, max_virtual_s: float = 100_000.0) -> RunResult:
             usage_integral[name] += used * dt
         last_t = now
 
-    admitted_count = 0
+    admitted_keys: set = set()
     cycles = 0
     t_start = time.perf_counter()
 
     def drive_scheduler() -> None:
         """Run cycles until quiescent at the current virtual instant."""
-        nonlocal admitted_count, cycles, seq
+        nonlocal cycles, seq
         while True:
             result = sched.schedule()
             cycles += 1
             progressed = False
             for e in result.admitted:
                 gw = by_key[e.workload.key]
-                tta.setdefault(gw.class_name, []).append(
-                    clock.now() - gw.creation_s
-                )
-                admitted_count += 1
+                if e.workload.key not in admitted_keys:
+                    # first admission only: re-admissions after a
+                    # preemption must not double-count tta/admitted
+                    tta.setdefault(gw.class_name, []).append(
+                        clock.now() - gw.creation_s
+                    )
+                    admitted_keys.add(e.workload.key)
                 epoch = admission_epoch.get(gw.workload.key, 0) + 1
                 admission_epoch[gw.workload.key] = epoch
                 heapq.heappush(
@@ -182,7 +185,7 @@ def run(config: GeneratorConfig, max_virtual_s: float = 100_000.0) -> RunResult:
     return RunResult(
         wall_s=wall_s,
         virtual_s=virtual_s,
-        admitted=admitted_count,
+        admitted=len(admitted_keys),
         total=len(scenario.workloads),
         cycles=cycles,
         time_to_admission=tta,

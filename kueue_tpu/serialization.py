@@ -1,0 +1,439 @@
+"""Object-model <-> plain-dict serialization.
+
+The durable wire format: the CLI's state file, the importer's input,
+and checkpoint/restore all speak it. Field names follow the reference
+CRDs' JSON (apis/kueue/v1beta1) so manifests diff cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from kueue_tpu.models import (
+    AdmissionCheck,
+    ClusterQueue,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+    WorkloadPriorityClass,
+)
+from kueue_tpu.models.cluster_queue import (
+    FlavorQuotas,
+    Preemption,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_tpu.models.cohort import Cohort
+from kueue_tpu.models.constants import (
+    BorrowWithinCohortPolicy,
+    FlavorFungibilityPolicy,
+    PreemptionPolicy,
+    QueueingStrategy,
+    ReclaimWithinCohortPolicy,
+    StopPolicy,
+    WorkloadConditionType,
+)
+from kueue_tpu.models.resource_flavor import Taint, Toleration
+from kueue_tpu.models.topology import Topology, TopologyLevel
+from kueue_tpu.models.workload import (
+    Admission,
+    Condition,
+    PodSet,
+    PodSetAssignment,
+    PodSetTopologyRequest,
+    RequeueState,
+    TopologyAssignment,
+    TopologyDomainAssignment,
+)
+from kueue_tpu.models.admission_check import AdmissionCheckState
+from kueue_tpu.models.constants import AdmissionCheckStateType
+
+
+# ---- flavors ----
+def flavor_to_dict(f: ResourceFlavor) -> dict:
+    return {
+        "name": f.name,
+        "nodeLabels": dict(f.node_labels),
+        "nodeTaints": [
+            {"key": t.key, "value": t.value, "effect": t.effect}
+            for t in f.node_taints
+        ],
+        "tolerations": [
+            {"key": t.key, "operator": t.operator, "value": t.value, "effect": t.effect}
+            for t in f.tolerations
+        ],
+        "topologyName": f.topology_name,
+    }
+
+
+def flavor_from_dict(d: dict) -> ResourceFlavor:
+    return ResourceFlavor(
+        name=d["name"],
+        node_labels=dict(d.get("nodeLabels", {})),
+        node_taints=tuple(
+            Taint(t["key"], t.get("value", ""), t.get("effect", "NoSchedule"))
+            for t in d.get("nodeTaints", [])
+        ),
+        tolerations=tuple(
+            Toleration(
+                t.get("key", ""), t.get("operator", "Equal"),
+                t.get("value", ""), t.get("effect", ""),
+            )
+            for t in d.get("tolerations", [])
+        ),
+        topology_name=d.get("topologyName"),
+    )
+
+
+# ---- cluster queues ----
+def cq_to_dict(cq: ClusterQueue) -> dict:
+    return {
+        "name": cq.name,
+        "cohort": cq.cohort,
+        "queueingStrategy": cq.queueing_strategy.value,
+        "namespaceSelector": cq.namespace_selector,
+        "stopPolicy": cq.stop_policy.value,
+        "admissionChecks": list(cq.admission_checks),
+        "fairSharingWeight": cq.fair_sharing.weight_milli,
+        "flavorFungibility": {
+            "whenCanBorrow": cq.flavor_fungibility.when_can_borrow.value,
+            "whenCanPreempt": cq.flavor_fungibility.when_can_preempt.value,
+        },
+        "preemption": {
+            "reclaimWithinCohort": cq.preemption.reclaim_within_cohort.value,
+            "withinClusterQueue": cq.preemption.within_cluster_queue.value,
+            "borrowWithinCohort": {
+                "policy": cq.preemption.borrow_within_cohort.policy.value,
+                "maxPriorityThreshold": cq.preemption.borrow_within_cohort.max_priority_threshold,
+            },
+        },
+        "resourceGroups": [
+            {
+                "coveredResources": list(rg.covered_resources),
+                "flavors": [
+                    {
+                        "name": fq.name,
+                        "resources": [
+                            {
+                                "name": rname,
+                                "nominalQuota": rq.nominal,
+                                "borrowingLimit": rq.borrowing_limit,
+                                "lendingLimit": rq.lending_limit,
+                            }
+                            for rname, rq in fq.resources.items()
+                        ],
+                    }
+                    for fq in rg.flavors
+                ],
+            }
+            for rg in cq.resource_groups
+        ],
+    }
+
+
+def cq_from_dict(d: dict) -> ClusterQueue:
+    from kueue_tpu.models.cluster_queue import (
+        BorrowWithinCohort,
+        FairSharing,
+        FlavorFungibility,
+    )
+
+    preemption = d.get("preemption", {})
+    borrow = preemption.get("borrowWithinCohort", {})
+    ff = d.get("flavorFungibility", {})
+    return ClusterQueue(
+        name=d["name"],
+        cohort=d.get("cohort"),
+        queueing_strategy=QueueingStrategy(
+            d.get("queueingStrategy", "BestEffortFIFO")
+        ),
+        namespace_selector=d.get("namespaceSelector"),
+        stop_policy=StopPolicy(d.get("stopPolicy", "None")),
+        admission_checks=tuple(d.get("admissionChecks", ())),
+        fair_sharing=FairSharing(weight_milli=d.get("fairSharingWeight", 1000)),
+        flavor_fungibility=FlavorFungibility(
+            when_can_borrow=FlavorFungibilityPolicy(ff.get("whenCanBorrow", "Borrow")),
+            when_can_preempt=FlavorFungibilityPolicy(
+                ff.get("whenCanPreempt", "TryNextFlavor")
+            ),
+        ),
+        preemption=Preemption(
+            reclaim_within_cohort=ReclaimWithinCohortPolicy(
+                preemption.get("reclaimWithinCohort", "Never")
+            ),
+            within_cluster_queue=PreemptionPolicy(
+                preemption.get("withinClusterQueue", "Never")
+            ),
+            borrow_within_cohort=BorrowWithinCohort(
+                policy=BorrowWithinCohortPolicy(borrow.get("policy", "Never")),
+                max_priority_threshold=borrow.get("maxPriorityThreshold"),
+            ),
+        ),
+        resource_groups=tuple(
+            ResourceGroup(
+                covered_resources=tuple(rg["coveredResources"]),
+                flavors=tuple(
+                    FlavorQuotas(
+                        name=fq["name"],
+                        resources={
+                            r["name"]: ResourceQuota(
+                                nominal=r.get("nominalQuota", 0),
+                                borrowing_limit=r.get("borrowingLimit"),
+                                lending_limit=r.get("lendingLimit"),
+                            )
+                            for r in fq["resources"]
+                        },
+                    )
+                    for fq in rg["flavors"]
+                ),
+            )
+            for rg in d.get("resourceGroups", ())
+        ),
+    )
+
+
+# ---- local queues / cohorts / checks / topologies / priority classes ----
+def lq_to_dict(lq: LocalQueue) -> dict:
+    return {
+        "name": lq.name,
+        "namespace": lq.namespace,
+        "clusterQueue": lq.cluster_queue,
+        "stopPolicy": lq.stop_policy.value,
+    }
+
+
+def lq_from_dict(d: dict) -> LocalQueue:
+    return LocalQueue(
+        name=d["name"],
+        namespace=d["namespace"],
+        cluster_queue=d["clusterQueue"],
+        stop_policy=StopPolicy(d.get("stopPolicy", "None")),
+    )
+
+
+def cohort_to_dict(c: Cohort) -> dict:
+    return {"name": c.name, "parent": c.parent}
+
+
+def cohort_from_dict(d: dict) -> Cohort:
+    return Cohort(name=d["name"], parent=d.get("parent"))
+
+
+def check_to_dict(ac: AdmissionCheck) -> dict:
+    return {
+        "name": ac.name,
+        "controllerName": ac.controller_name,
+        "parameters": ac.parameters,
+    }
+
+
+def check_from_dict(d: dict) -> AdmissionCheck:
+    return AdmissionCheck(
+        name=d["name"],
+        controller_name=d["controllerName"],
+        parameters=d.get("parameters"),
+    )
+
+
+def topology_to_dict(t: Topology) -> dict:
+    return {"name": t.name, "levels": [lv.node_label for lv in t.levels]}
+
+
+def topology_from_dict(d: dict) -> Topology:
+    return Topology(
+        name=d["name"],
+        levels=tuple(TopologyLevel(k) for k in d["levels"]),
+    )
+
+
+def priority_class_to_dict(pc: WorkloadPriorityClass) -> dict:
+    return {"name": pc.name, "value": pc.value}
+
+
+def priority_class_from_dict(d: dict) -> WorkloadPriorityClass:
+    return WorkloadPriorityClass(name=d["name"], value=d["value"])
+
+
+# ---- workloads ----
+def workload_to_dict(wl: Workload) -> dict:
+    out = {
+        "name": wl.name,
+        "namespace": wl.namespace,
+        "queueName": wl.queue_name,
+        "priority": wl.priority,
+        "priorityClassName": wl.priority_class_name,
+        "active": wl.active,
+        "creationTime": wl.creation_time,
+        "maximumExecutionTimeSeconds": wl.maximum_execution_time_seconds,
+        "podSets": [
+            {
+                "name": ps.name,
+                "count": ps.count,
+                "minCount": ps.min_count,
+                "requests": dict(ps.requests),
+                "nodeSelector": dict(ps.node_selector),
+                "topologyRequest": (
+                    {
+                        "mode": ps.topology_request.mode,
+                        "level": ps.topology_request.level,
+                    }
+                    if ps.topology_request
+                    else None
+                ),
+            }
+            for ps in wl.pod_sets
+        ],
+        "conditions": [
+            {
+                "type": c.type.value,
+                "status": c.status,
+                "reason": c.reason,
+                "message": c.message,
+                "lastTransitionTime": c.last_transition_time,
+            }
+            for c in wl.conditions.values()
+        ],
+        "admissionChecks": [
+            {
+                "name": s.name,
+                "state": s.state.value,
+                "message": s.message,
+            }
+            for s in wl.admission_check_states.values()
+        ],
+        "reclaimablePods": dict(wl.reclaimable_pods),
+    }
+    if wl.requeue_state is not None:
+        out["requeueState"] = {
+            "count": wl.requeue_state.count,
+            "requeueAt": wl.requeue_state.requeue_at,
+        }
+    if wl.admission is not None:
+        out["admission"] = {
+            "clusterQueue": wl.admission.cluster_queue,
+            "podSetAssignments": [
+                {
+                    "name": psa.name,
+                    "flavors": dict(psa.flavors),
+                    "resourceUsage": dict(psa.resource_usage),
+                    "count": psa.count,
+                    "topologyAssignment": (
+                        {
+                            "levels": list(psa.topology_assignment.levels),
+                            "domains": [
+                                {"values": list(dd.values), "count": dd.count}
+                                for dd in psa.topology_assignment.domains
+                            ],
+                        }
+                        if psa.topology_assignment
+                        else None
+                    ),
+                }
+                for psa in wl.admission.pod_set_assignments
+            ],
+        }
+    return out
+
+
+def workload_from_dict(d: dict) -> Workload:
+    wl = Workload(
+        name=d["name"],
+        namespace=d["namespace"],
+        queue_name=d.get("queueName", ""),
+        priority=d.get("priority", 0),
+        priority_class_name=d.get("priorityClassName", ""),
+        active=d.get("active", True),
+        creation_time=d.get("creationTime", 0.0),
+        maximum_execution_time_seconds=d.get("maximumExecutionTimeSeconds"),
+        pod_sets=tuple(
+            PodSet(
+                name=ps["name"],
+                count=ps["count"],
+                min_count=ps.get("minCount"),
+                requests=dict(ps.get("requests", {})),
+                node_selector=dict(ps.get("nodeSelector", {})),
+                topology_request=(
+                    PodSetTopologyRequest(
+                        mode=ps["topologyRequest"]["mode"],
+                        level=ps["topologyRequest"].get("level"),
+                    )
+                    if ps.get("topologyRequest")
+                    else None
+                ),
+            )
+            for ps in d.get("podSets", ())
+        ),
+    )
+    for c in d.get("conditions", ()):
+        wl.conditions[WorkloadConditionType(c["type"])] = Condition(
+            type=WorkloadConditionType(c["type"]),
+            status=c["status"],
+            reason=c.get("reason", ""),
+            message=c.get("message", ""),
+            last_transition_time=c.get("lastTransitionTime", 0.0),
+        )
+    for s in d.get("admissionChecks", ()):
+        wl.admission_check_states[s["name"]] = AdmissionCheckState(
+            name=s["name"],
+            state=AdmissionCheckStateType(s["state"]),
+            message=s.get("message", ""),
+        )
+    wl.reclaimable_pods = dict(d.get("reclaimablePods", {}))
+    rs = d.get("requeueState")
+    if rs is not None:
+        wl.requeue_state = RequeueState(
+            count=rs.get("count", 0), requeue_at=rs.get("requeueAt")
+        )
+    adm = d.get("admission")
+    if adm is not None:
+        wl.admission = Admission(
+            cluster_queue=adm["clusterQueue"],
+            pod_set_assignments=tuple(
+                PodSetAssignment(
+                    name=psa["name"],
+                    flavors=dict(psa.get("flavors", {})),
+                    resource_usage=dict(psa.get("resourceUsage", {})),
+                    count=psa.get("count", 0),
+                    topology_assignment=(
+                        TopologyAssignment(
+                            levels=tuple(psa["topologyAssignment"]["levels"]),
+                            domains=tuple(
+                                TopologyDomainAssignment(
+                                    values=tuple(dd["values"]), count=dd["count"]
+                                )
+                                for dd in psa["topologyAssignment"]["domains"]
+                            ),
+                        )
+                        if psa.get("topologyAssignment")
+                        else None
+                    ),
+                )
+                for psa in adm.get("podSetAssignments", ())
+            ),
+        )
+    return wl
+
+
+# ---- whole-state save/load ----
+def state_to_dict(
+    flavors: List[ResourceFlavor],
+    cluster_queues: List[ClusterQueue],
+    local_queues: List[LocalQueue],
+    workloads: List[Workload],
+    cohorts: Optional[List[Cohort]] = None,
+    checks: Optional[List[AdmissionCheck]] = None,
+    topologies: Optional[List[Topology]] = None,
+    priority_classes: Optional[List[WorkloadPriorityClass]] = None,
+) -> dict:
+    return {
+        "resourceFlavors": [flavor_to_dict(f) for f in flavors],
+        "clusterQueues": [cq_to_dict(c) for c in cluster_queues],
+        "localQueues": [lq_to_dict(l) for l in local_queues],
+        "workloads": [workload_to_dict(w) for w in workloads],
+        "cohorts": [cohort_to_dict(c) for c in cohorts or []],
+        "admissionChecks": [check_to_dict(a) for a in checks or []],
+        "topologies": [topology_to_dict(t) for t in topologies or []],
+        "workloadPriorityClasses": [
+            priority_class_to_dict(p) for p in priority_classes or []
+        ],
+    }

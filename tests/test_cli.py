@@ -1,0 +1,164 @@
+"""CLI (kueuectl-equivalent) + serialization tests."""
+
+import json
+
+import pytest
+
+from kueue_tpu import serialization as ser
+from kueue_tpu.cli.__main__ import main
+from kueue_tpu.models import ClusterQueue, ResourceFlavor, Workload
+from kueue_tpu.models.cluster_queue import FlavorQuotas, Preemption, ResourceGroup
+from kueue_tpu.models.constants import (
+    PreemptionPolicy,
+    ReclaimWithinCohortPolicy,
+    WorkloadConditionType,
+)
+from kueue_tpu.models.resource_flavor import Taint, Toleration
+from kueue_tpu.models.workload import PodSet, PodSetTopologyRequest
+
+
+def cli(tmp_path, *argv):
+    return main(["--state", str(tmp_path / "state.json"), *argv])
+
+
+class TestSerializationRoundTrip:
+    def test_flavor(self):
+        f = ResourceFlavor(
+            name="f", node_labels={"a": "b"},
+            node_taints=(Taint("k", "v", "NoSchedule"),),
+            tolerations=(Toleration(key="t", operator="Exists"),),
+            topology_name="topo",
+        )
+        assert ser.flavor_from_dict(ser.flavor_to_dict(f)) == f
+
+    def test_cluster_queue(self):
+        cq = ClusterQueue(
+            name="cq", cohort="co", namespace_selector={},
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu", "memory"),
+                    (FlavorQuotas.build("f", {"cpu": ("10", "5", "2"), "memory": "1Gi"}),),
+                ),
+            ),
+            preemption=Preemption(
+                reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            ),
+            admission_checks=("check-1",),
+        )
+        rt = ser.cq_from_dict(ser.cq_to_dict(cq))
+        assert rt == cq
+
+    def test_workload_with_admission(self):
+        wl = Workload(
+            namespace="ns", name="w", queue_name="lq", priority=7,
+            creation_time=12.5,
+            pod_sets=(
+                PodSet.build(
+                    "main", 3, {"cpu": "2"},
+                    topology_request=PodSetTopologyRequest(mode="Required", level="rack"),
+                ),
+            ),
+        )
+        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True, "QuotaReserved", now=1.0)
+        from kueue_tpu.models.workload import Admission, PodSetAssignment, TopologyAssignment, TopologyDomainAssignment
+
+        wl.admission = Admission(
+            cluster_queue="cq",
+            pod_set_assignments=(
+                PodSetAssignment(
+                    name="main", flavors={"cpu": "f"},
+                    resource_usage={"cpu": 6000}, count=3,
+                    topology_assignment=TopologyAssignment(
+                        levels=("rack",),
+                        domains=(TopologyDomainAssignment(("r1",), 3),),
+                    ),
+                ),
+            ),
+        )
+        rt = ser.workload_from_dict(ser.workload_to_dict(wl))
+        assert rt.admission == wl.admission
+        assert rt.conditions.keys() == wl.conditions.keys()
+        assert rt.pod_sets == wl.pod_sets
+
+
+class TestCLI:
+    def setup_cluster(self, tmp_path):
+        cli(tmp_path, "create", "rf", "default")
+        cli(
+            tmp_path, "create", "cq", "team-a",
+            "--nominal-quota", "cpu=4",
+        )
+        cli(tmp_path, "create", "lq", "main", "-n", "prod", "-c", "team-a")
+
+    def test_create_and_schedule(self, tmp_path, capsys):
+        self.setup_cluster(tmp_path)
+        for i in range(3):
+            cli(tmp_path, "create", "wl", f"job-{i}", "-n", "prod",
+                "-q", "main", "--requests", "cpu=2")
+        cli(tmp_path, "schedule")
+        out = capsys.readouterr().out
+        assert "admitted=2 pending=1" in out
+        cli(tmp_path, "list", "wl")
+        out = capsys.readouterr().out
+        assert out.count("ADMITTED") == 2
+        assert out.count("PENDING") >= 1
+
+    def test_pending_workloads_positions(self, tmp_path, capsys):
+        self.setup_cluster(tmp_path)
+        for i in range(3):
+            cli(tmp_path, "create", "wl", f"job-{i}", "-n", "prod",
+                "-q", "main", "--requests", "cpu=4")
+        cli(tmp_path, "schedule")
+        capsys.readouterr()
+        cli(tmp_path, "pending-workloads", "team-a")
+        out = capsys.readouterr().out
+        assert "POSITION" in out and "job-1" in out and "job-2" in out
+
+    def test_stop_resume_workload(self, tmp_path, capsys):
+        self.setup_cluster(tmp_path)
+        cli(tmp_path, "create", "wl", "j", "-n", "prod", "-q", "main",
+            "--requests", "cpu=2")
+        cli(tmp_path, "stop", "workload", "j", "-n", "prod")
+        cli(tmp_path, "schedule")
+        out = capsys.readouterr().out
+        assert "admitted=0" in out
+        cli(tmp_path, "resume", "workload", "j", "-n", "prod")
+        cli(tmp_path, "schedule")
+        out = capsys.readouterr().out
+        assert "admitted=1" in out
+
+    def test_stop_cluster_queue_holds_admission(self, tmp_path, capsys):
+        self.setup_cluster(tmp_path)
+        cli(tmp_path, "stop", "clusterqueue", "team-a")
+        cli(tmp_path, "create", "wl", "j", "-n", "prod", "-q", "main",
+            "--requests", "cpu=2")
+        cli(tmp_path, "schedule")
+        out = capsys.readouterr().out
+        assert "admitted=0" in out
+
+    def test_invalid_quota_errors(self, tmp_path):
+        with pytest.raises(SystemExit):
+            cli(tmp_path, "create", "cq", "bad", "--nominal-quota", "cpu")
+
+    def test_import_pods(self, tmp_path, capsys):
+        self.setup_cluster(tmp_path)
+        pods = [
+            {"namespace": "prod", "name": "p1",
+             "labels": {"kueue.x-k8s.io/queue-name": "main"},
+             "requests": {"cpu": "2"}},
+            {"namespace": "prod", "name": "p2",
+             "labels": {}, "requests": {"cpu": "1"}},
+        ]
+        pod_file = tmp_path / "pods.json"
+        pod_file.write_text(json.dumps(pods))
+        cli(tmp_path, "import", "--file", str(pod_file))
+        out = capsys.readouterr().out
+        assert "imported=1 skipped=1" in out
+        # imported pod charges quota: only one 2-cpu job still fits
+        for i in range(2):
+            cli(tmp_path, "create", "wl", f"job-{i}", "-n", "prod",
+                "-q", "main", "--requests", "cpu=2")
+        cli(tmp_path, "schedule")
+        out = capsys.readouterr().out
+        assert "admitted=2 pending=1" in out  # pod-p1 + one job
