@@ -94,6 +94,12 @@ def cmd_create_cq(state: State, args) -> None:
     quotas = _parse_quotas(args.nominal_quota)
     borrowing = _parse_quotas(args.borrowing_limit) if args.borrowing_limit else {}
     lending = _parse_quotas(args.lending_limit) if args.lending_limit else {}
+    for label, limits in (("borrowing-limit", borrowing), ("lending-limit", lending)):
+        unknown = set(limits) - set(quotas)
+        if unknown:
+            raise SystemExit(
+                f"error: --{label} for resources without nominal quota: {sorted(unknown)}"
+            )
     resources = [
         {
             "name": r,

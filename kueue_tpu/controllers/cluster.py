@@ -58,6 +58,8 @@ class ClusterRuntime:
         self.queues = QueueManager(self.clock)
         self.workloads: Dict[str, Workload] = {}
         self.jobs: Dict[str, GenericJob] = {}
+        # workload key -> job key (O(1) has_job_for on eviction paths)
+        self._jobs_by_workload: Dict[str, str] = {}
         self.events: List[Event] = []
         self.metrics = Metrics()
         self.pods_ready_cfg = wait_for_pods_ready or WaitForPodsReadyConfig()
@@ -211,13 +213,18 @@ class ClusterRuntime:
         self.cache.add_or_update_priority_class(pc)
 
     # ---- jobs ----
+    def _wl_key_for_job(self, job: GenericJob) -> str:
+        return f"{job.namespace}/{self.job_reconciler.workload_name_for(job)}"
+
     def add_job(self, job: GenericJob) -> None:
         self.jobs[job.key] = job
+        self._jobs_by_workload[self._wl_key_for_job(job)] = job.key
 
     def delete_job(self, key: str) -> None:
         job = self.jobs.pop(key, None)
         if job is None:
             return
+        self._jobs_by_workload.pop(self._wl_key_for_job(job), None)
         # job deletion releases its workload (reconciler dropFinalizers)
         wl = self.workloads.get(
             f"{job.namespace}/{self.job_reconciler.workload_name_for(job)}"
@@ -271,13 +278,7 @@ class ClusterRuntime:
             self.queues.requeue_workload(wl, RequeueReason.GENERIC)
 
     def has_job_for(self, wl: Workload) -> bool:
-        for job in self.jobs.values():
-            if (
-                job.namespace == wl.namespace
-                and self.job_reconciler.workload_name_for(job) == wl.name
-            ):
-                return True
-        return False
+        return wl.key in self._jobs_by_workload
 
     def requeue_after_backoff(self, wl: Workload) -> None:
         # The Requeued-condition flip is a workload update event: the

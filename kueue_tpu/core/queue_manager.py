@@ -75,7 +75,15 @@ class PendingClusterQueue:
         self.clock = clock
         self._priority_fn = priority_fn
         self._ts_policy = timestamp_policy
-        self.heap: Heap[Workload] = Heap(key_fn=lambda w: w.key, less=self._less)
+        # native C++ heap when the shared library is available, else the
+        # generic Python heap with the identical ordering
+        from kueue_tpu.utils.native_heap import make_workload_heap
+
+        self.heap = make_workload_heap(
+            key_fn=lambda w: w.key,
+            priority_fn=priority_fn,
+            timestamp_fn=lambda w: queue_order_timestamp(w, timestamp_policy),
+        )
         self.inadmissible: Dict[str, Workload] = {}
         self.pop_cycle = 0
         self.queue_inadmissible_cycle = -1

@@ -1,0 +1,218 @@
+"""ctypes bindings for the C++ runtime core (native/kueue_native.cpp).
+
+``load()`` only dlopens — compiling is an explicit step
+(``ensure_built()`` or ``make -C native``) so constructing a queue can
+never block on a compiler. Every consumer falls back to the pure-Python
+implementation when loading fails: the native path is an accelerator,
+never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libkueue_native.so"))
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s", "libkueue_native.so"],
+            cwd=os.path.abspath(_NATIVE_DIR),
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def ensure_built() -> bool:
+    """Explicitly compile the library if absent, then load it."""
+    global _load_attempted
+    if not os.path.exists(_LIB_PATH):
+        if not _build():
+            return False
+        _load_attempted = False  # retry the dlopen
+    return load() is not None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, or None when unavailable. Never compiles."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+
+    c = ctypes
+    i64, i32p, i64p = c.c_int64, c.POINTER(c.c_int32), c.POINTER(c.c_int64)
+    lib.heap_new.restype = c.c_void_p
+    lib.heap_free.argtypes = [c.c_void_p]
+    lib.heap_len.argtypes = [c.c_void_p]
+    lib.heap_len.restype = c.c_int
+    lib.heap_contains.argtypes = [c.c_void_p, i64]
+    lib.heap_contains.restype = c.c_int
+    lib.heap_push.argtypes = [c.c_void_p, i64, i64, i64]
+    lib.heap_push_if_not_present.argtypes = [c.c_void_p, i64, i64, i64]
+    lib.heap_push_if_not_present.restype = c.c_int
+    lib.heap_delete_key.argtypes = [c.c_void_p, i64]
+    lib.heap_delete_key.restype = c.c_int
+    lib.heap_pop.argtypes = [c.c_void_p]
+    lib.heap_pop.restype = i64
+    lib.heap_peek.argtypes = [c.c_void_p]
+    lib.heap_peek.restype = i64
+
+    ci = c.c_int
+    lib.quota_subtree.argtypes = [i32p, i32p, ci, ci, i64p, i64p, i64p, i64p]
+    lib.quota_usage_tree.argtypes = [i32p, i32p, ci, ci, i64p, i64p, i64p]
+    lib.quota_available_node.argtypes = [i32p, ci, ci, i64p, i64p, i64p, i64p, i64p]
+    lib.quota_add_usage.argtypes = [i32p, ci, ci, i64p, i64p, ci, i64p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeHeap:
+    """Keyed pending-queue heap: (priority desc, timestamp asc, FIFO).
+
+    Keys are caller-interned int64 ids (the Python wrapper in
+    utils/heap keeps the object map).
+    """
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.heap_new()
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.heap_free(self._h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return self._lib.heap_len(self._h)
+
+    def __contains__(self, key: int) -> bool:
+        return bool(self._lib.heap_contains(self._h, key))
+
+    def push(self, key: int, priority: int, timestamp_ns: int) -> None:
+        self._lib.heap_push(self._h, key, priority, timestamp_ns)
+
+    def push_if_not_present(self, key: int, priority: int, timestamp_ns: int) -> bool:
+        return bool(
+            self._lib.heap_push_if_not_present(self._h, key, priority, timestamp_ns)
+        )
+
+    def delete(self, key: int) -> bool:
+        return bool(self._lib.heap_delete_key(self._h, key))
+
+    def pop(self) -> Optional[int]:
+        key = self._lib.heap_pop(self._h)
+        return None if key == -1 else key
+
+    def peek(self) -> Optional[int]:
+        key = self._lib.heap_peek(self._h)
+        return None if key == -1 else key
+
+
+def _as_i64(arr):
+    import numpy as np
+
+    a = np.ascontiguousarray(arr, dtype=np.int64)
+    return a, a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _as_i32(arr):
+    import numpy as np
+
+    a = np.ascontiguousarray(arr, dtype=np.int32)
+    return a, a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativeQuota:
+    """Flat-array quota math mirroring ops/quota.py on the CPU."""
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+
+    def subtree(self, parent, order, nominal, lending):
+        import numpy as np
+
+        n, fr = nominal.shape
+        parent_a, parent_p = _as_i32(parent)
+        order_a, order_p = _as_i32(order)
+        nominal_a, nominal_p = _as_i64(nominal)
+        lending_a, lending_p = _as_i64(lending)
+        subtree = np.zeros((n, fr), dtype=np.int64)
+        guaranteed = np.zeros((n, fr), dtype=np.int64)
+        subtree_p = subtree.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        guaranteed_p = guaranteed.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        self._lib.quota_subtree(
+            parent_p, order_p, n, fr, nominal_p, lending_p, subtree_p, guaranteed_p
+        )
+        return subtree, guaranteed
+
+    def usage_tree(self, parent, order, guaranteed, local_usage):
+        import numpy as np
+
+        n, fr = guaranteed.shape
+        _, parent_p = _as_i32(parent)
+        _, order_p = _as_i32(order)
+        _, guaranteed_p = _as_i64(guaranteed)
+        _, local_p = _as_i64(local_usage)
+        usage = np.zeros((n, fr), dtype=np.int64)
+        usage_p = usage.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        self._lib.quota_usage_tree(
+            parent_p, order_p, n, fr, guaranteed_p, local_p, usage_p
+        )
+        return usage
+
+    def available_node(self, path, subtree, guaranteed, borrowing, usage):
+        import numpy as np
+
+        fr = subtree.shape[1]
+        _, path_p = _as_i32(path)
+        _, subtree_p = _as_i64(subtree)
+        _, guaranteed_p = _as_i64(guaranteed)
+        _, borrowing_p = _as_i64(borrowing)
+        _, usage_p = _as_i64(usage)
+        out = np.zeros(fr, dtype=np.int64)
+        out_p = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        self._lib.quota_available_node(
+            path_p, len(path), fr, subtree_p, guaranteed_p, borrowing_p,
+            usage_p, out_p,
+        )
+        return out
+
+    def add_usage(self, path, guaranteed, delta, usage, sign=1):
+        _, path_p = _as_i32(path)
+        _, guaranteed_p = _as_i64(guaranteed)
+        _, delta_p = _as_i64(delta)
+        usage_c, usage_p = _as_i64(usage)
+        self._lib.quota_add_usage(
+            path_p, len(path), guaranteed.shape[1], guaranteed_p, delta_p,
+            sign, usage_p,
+        )
+        return usage_c

@@ -1,0 +1,130 @@
+"""Native-backed workload heap.
+
+Same interface as utils/heap.Heap, specialized to the pending-queue
+ordering (priority desc, timestamp asc, FIFO tie-break) so the heap
+arithmetic runs inside the C++ library (native/kueue_native.cpp) —
+string keys are interned to int64 ids, Python only keeps the id->object
+map. Falls back transparently: ``make_workload_heap`` returns the pure-
+Python Heap when the shared library is unavailable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from kueue_tpu.utils.heap import Heap
+
+
+class NativeWorkloadHeap:
+    def __init__(
+        self,
+        key_fn: Callable[[object], str],
+        priority_fn: Callable[[object], int],
+        timestamp_fn: Callable[[object], float],
+    ):
+        from kueue_tpu.native import NativeHeap
+
+        self._key_fn = key_fn
+        self._priority_fn = priority_fn
+        self._timestamp_fn = timestamp_fn
+        self._heap = NativeHeap()
+        self._ids: Dict[str, int] = {}
+        self._values: Dict[int, object] = {}
+        self._keys_by_id: Dict[int, str] = {}
+        self._next_id = 0
+
+    def _intern(self, key: str) -> int:
+        i = self._ids.get(key)
+        if i is None:
+            i = self._next_id
+            self._next_id += 1
+            self._ids[key] = i
+            self._keys_by_id[i] = key
+        return i
+
+    def _rank(self, item) -> tuple:
+        return (
+            int(self._priority_fn(item)),
+            int(self._timestamp_fn(item) * 1e9),
+        )
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __contains__(self, key: str) -> bool:
+        i = self._ids.get(key)
+        return i is not None and i in self._heap
+
+    def keys(self):
+        return [self._keys_by_id[i] for i in self._values if i in self._heap]
+
+    def items(self):
+        return [v for i, v in self._values.items() if i in self._heap]
+
+    def push_if_not_present(self, item) -> bool:
+        key = self._key_fn(item)
+        i = self._intern(key)
+        prio, ts = self._rank(item)
+        if self._heap.push_if_not_present(i, prio, ts):
+            self._values[i] = item
+            return True
+        return False
+
+    def push_or_update(self, item) -> None:
+        key = self._key_fn(item)
+        i = self._intern(key)
+        prio, ts = self._rank(item)
+        self._heap.push(i, prio, ts)
+        self._values[i] = item
+
+    def _forget(self, i: int) -> None:
+        self._values.pop(i, None)
+        key = self._keys_by_id.pop(i, None)
+        if key is not None:
+            self._ids.pop(key, None)
+
+    def delete(self, key: str) -> bool:
+        i = self._ids.get(key)
+        if i is None or not self._heap.delete(i):
+            return False
+        self._forget(i)
+        return True
+
+    def get_by_key(self, key: str):
+        i = self._ids.get(key)
+        if i is None or i not in self._heap:
+            return None
+        return self._values.get(i)
+
+    def peek(self):
+        i = self._heap.peek()
+        return None if i is None else self._values.get(i)
+
+    def pop(self):
+        i = self._heap.pop()
+        if i is None:
+            return None
+        value = self._values.get(i)
+        self._forget(i)
+        return value
+
+
+def make_workload_heap(
+    key_fn: Callable[[object], str],
+    priority_fn: Callable[[object], int],
+    timestamp_fn: Callable[[object], float],
+):
+    """Native heap when the library loads, else the generic Heap with
+    the equivalent comparator."""
+    from kueue_tpu import native
+
+    if native.available():
+        return NativeWorkloadHeap(key_fn, priority_fn, timestamp_fn)
+
+    def less(a, b) -> bool:
+        pa, pb = priority_fn(a), priority_fn(b)
+        if pa != pb:
+            return pa > pb
+        return timestamp_fn(a) < timestamp_fn(b)
+
+    return Heap(key_fn, less)

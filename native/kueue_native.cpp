@@ -1,0 +1,241 @@
+// kueue_native — the hot-path runtime core in C++.
+//
+// The reference's control plane is compiled Go (SURVEY.md §2); the
+// TPU build keeps JAX/XLA for the batched solver and uses this native
+// library for the serving-path data structures around it:
+//
+//  - a keyed binary heap with the pending-queue ordering
+//    (priority desc, timestamp asc — pkg/queue/cluster_queue.go:413-426
+//    and pkg/util/heap), push-or-update / delete-by-key / pop;
+//  - cohort quota-tree math over flat arrays (subtreeQuota /
+//    available / addUsage bubble-up — pkg/cache/resource_node.go),
+//    the CPU mirror of ops/quota.py for small host-side problems.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- heap
+
+struct HeapEntry {
+  int64_t key;
+  int64_t priority;   // higher pops first
+  int64_t timestamp;  // lower pops first among equal priorities
+  int64_t seq;        // FIFO tie-break for full determinism
+};
+
+struct Heap {
+  std::vector<HeapEntry> items;              // binary heap
+  std::unordered_map<int64_t, size_t> index; // key -> position
+  int64_t next_seq = 0;
+};
+
+static bool heap_less(const HeapEntry& a, const HeapEntry& b) {
+  // "a pops before b"
+  if (a.priority != b.priority) return a.priority > b.priority;
+  if (a.timestamp != b.timestamp) return a.timestamp < b.timestamp;
+  return a.seq < b.seq;
+}
+
+static void heap_swap(Heap* h, size_t i, size_t j) {
+  std::swap(h->items[i], h->items[j]);
+  h->index[h->items[i].key] = i;
+  h->index[h->items[j].key] = j;
+}
+
+static void sift_up(Heap* h, size_t i) {
+  while (i > 0) {
+    size_t parent = (i - 1) / 2;
+    if (!heap_less(h->items[i], h->items[parent])) break;
+    heap_swap(h, i, parent);
+    i = parent;
+  }
+}
+
+static void sift_down(Heap* h, size_t i) {
+  size_t n = h->items.size();
+  for (;;) {
+    size_t left = 2 * i + 1, right = 2 * i + 2, best = i;
+    if (left < n && heap_less(h->items[left], h->items[best])) best = left;
+    if (right < n && heap_less(h->items[right], h->items[best])) best = right;
+    if (best == i) break;
+    heap_swap(h, i, best);
+    i = best;
+  }
+}
+
+Heap* heap_new() { return new Heap(); }
+
+void heap_free(Heap* h) { delete h; }
+
+int heap_len(const Heap* h) { return static_cast<int>(h->items.size()); }
+
+int heap_contains(const Heap* h, int64_t key) {
+  return h->index.count(key) ? 1 : 0;
+}
+
+// Push a new entry or update an existing one (PushOrUpdate). Updates
+// take a fresh seq — the Python fallback's push_or_update re-pushes the
+// entry, so among exact rank ties an updated entry pops AFTER its
+// peers; the two implementations must order identically.
+void heap_push(Heap* h, int64_t key, int64_t priority, int64_t timestamp) {
+  auto it = h->index.find(key);
+  if (it != h->index.end()) {
+    size_t i = it->second;
+    h->items[i].priority = priority;
+    h->items[i].timestamp = timestamp;
+    h->items[i].seq = h->next_seq++;
+    sift_up(h, i);
+    sift_down(h, i);
+    return;
+  }
+  HeapEntry e{key, priority, timestamp, h->next_seq++};
+  h->items.push_back(e);
+  h->index[key] = h->items.size() - 1;
+  sift_up(h, h->items.size() - 1);
+}
+
+// Push only if absent (PushIfNotPresent). Returns 1 if pushed.
+int heap_push_if_not_present(Heap* h, int64_t key, int64_t priority,
+                             int64_t timestamp) {
+  if (h->index.count(key)) return 0;
+  heap_push(h, key, priority, timestamp);
+  return 1;
+}
+
+int heap_delete_key(Heap* h, int64_t key) {
+  auto it = h->index.find(key);
+  if (it == h->index.end()) return 0;
+  size_t i = it->second;
+  size_t last = h->items.size() - 1;
+  if (i != last) heap_swap(h, i, last);
+  h->index.erase(h->items.back().key);
+  h->items.pop_back();
+  if (i < h->items.size()) {
+    sift_up(h, i);
+    sift_down(h, i);
+  }
+  return 1;
+}
+
+// Pop the head; returns its key or -1 when empty.
+int64_t heap_pop(Heap* h) {
+  if (h->items.empty()) return -1;
+  int64_t key = h->items[0].key;
+  heap_delete_key(h, key);
+  return key;
+}
+
+int64_t heap_peek(const Heap* h) {
+  return h->items.empty() ? -1 : h->items[0].key;
+}
+
+// ------------------------------------------------------ quota tree math
+//
+// Flat layout shared with ops/quota.py: N nodes (CQs then cohorts),
+// FR flavor-resource cells, parent[i] = parent node or -1, order =
+// node indices sorted deepest-level-first (callers precompute).
+// NO_LIMIT sentinel matches ops/quota.py (1<<60).
+
+static const int64_t NO_LIMIT = 1ll << 60;
+
+static inline int64_t guaranteed_of(int64_t subtree, int64_t lending) {
+  if (lending < NO_LIMIT) {
+    int64_t g = subtree - lending;
+    return g > 0 ? g : 0;
+  }
+  return 0;
+}
+
+// subtreeQuota + guaranteedQuota (resource_node.go:157-193).
+void quota_subtree(const int32_t* parent, const int32_t* order, int n, int fr,
+                   const int64_t* nominal, const int64_t* lending,
+                   int64_t* subtree, int64_t* guaranteed) {
+  std::memcpy(subtree, nominal, sizeof(int64_t) * n * fr);
+  for (int oi = 0; oi < n; ++oi) {
+    int i = order[oi];
+    int p = parent[i];
+    for (int j = 0; j < fr; ++j) {
+      int64_t g = guaranteed_of(subtree[i * fr + j], lending[i * fr + j]);
+      guaranteed[i * fr + j] = g;
+      if (p >= 0) subtree[p * fr + j] += subtree[i * fr + j] - g;
+    }
+  }
+  // guaranteed of roots computed above in the same pass (order covers
+  // every node; roots simply have no parent write)
+}
+
+// Usage tree from leaf usage (bubble-up of over-guaranteed amounts).
+void quota_usage_tree(const int32_t* parent, const int32_t* order, int n,
+                      int fr, const int64_t* guaranteed,
+                      const int64_t* local_usage, int64_t* usage) {
+  std::memcpy(usage, local_usage, sizeof(int64_t) * n * fr);
+  for (int oi = 0; oi < n; ++oi) {
+    int i = order[oi];
+    int p = parent[i];
+    if (p < 0) continue;
+    for (int j = 0; j < fr; ++j) {
+      int64_t over = usage[i * fr + j] - guaranteed[i * fr + j];
+      if (over > 0) usage[p * fr + j] += over;
+    }
+  }
+}
+
+// available() for ONE node (resource_node.go:89-104), walking the
+// ancestor path root-down. path = [node, parent, ..., root, -1...].
+void quota_available_node(const int32_t* path, int path_len, int fr,
+                          const int64_t* subtree, const int64_t* guaranteed,
+                          const int64_t* borrowing, const int64_t* usage,
+                          int64_t* out) {
+  int depth = 0;
+  while (depth < path_len && path[depth] >= 0) depth++;
+  for (int j = 0; j < fr; ++j) {
+    int root = path[depth - 1];
+    int64_t avail = subtree[root * fr + j] - usage[root * fr + j];
+    for (int d = depth - 2; d >= 0; --d) {
+      int i = path[d];
+      int64_t stored = subtree[i * fr + j] - guaranteed[i * fr + j];
+      int64_t used = usage[i * fr + j] - guaranteed[i * fr + j];
+      if (used < 0) used = 0;
+      int64_t clamped = avail;
+      if (borrowing[i * fr + j] < NO_LIMIT) {
+        int64_t with_max = stored - used + borrowing[i * fr + j];
+        if (with_max < clamped) clamped = with_max;
+      }
+      int64_t local = guaranteed[i * fr + j] - usage[i * fr + j];
+      if (local < 0) local = 0;
+      avail = local + clamped;
+    }
+    out[j] = avail;
+  }
+}
+
+// addUsage bubble-up for one node (resource_node.go:123-144).
+// sign=+1 add, -1 remove. Mutates the full usage tree in place.
+void quota_add_usage(const int32_t* path, int path_len, int fr,
+                     const int64_t* guaranteed, const int64_t* delta, int sign,
+                     int64_t* usage) {
+  std::vector<int64_t> d(delta, delta + fr);
+  for (int j = 0; j < fr; ++j) d[j] *= sign;
+  int depth = 0;
+  while (depth < path_len && path[depth] >= 0) depth++;
+  for (int lvl = 0; lvl < depth; ++lvl) {
+    int i = path[lvl];
+    for (int j = 0; j < fr; ++j) {
+      int64_t old_u = usage[i * fr + j];
+      int64_t new_u = old_u + d[j];
+      usage[i * fr + j] = new_u;
+      int64_t g = guaranteed[i * fr + j];
+      int64_t over_old = old_u - g > 0 ? old_u - g : 0;
+      int64_t over_new = new_u - g > 0 ? new_u - g : 0;
+      d[j] = over_new - over_old;
+    }
+  }
+}
+
+}  // extern "C"

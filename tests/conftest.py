@@ -19,3 +19,9 @@ import jax  # noqa: E402
 # config must be forced back to cpu after import.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# Compile the native C++ runtime core once per session (load() itself
+# never compiles); native tests skip when no compiler is available.
+from kueue_tpu import native  # noqa: E402
+
+native.ensure_built()
