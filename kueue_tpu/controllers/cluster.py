@@ -224,11 +224,10 @@ class ClusterRuntime:
         job = self.jobs.pop(key, None)
         if job is None:
             return
-        self._jobs_by_workload.pop(self._wl_key_for_job(job), None)
+        wl_key = self._wl_key_for_job(job)
+        self._jobs_by_workload.pop(wl_key, None)
         # job deletion releases its workload (reconciler dropFinalizers)
-        wl = self.workloads.get(
-            f"{job.namespace}/{self.job_reconciler.workload_name_for(job)}"
-        )
+        wl = self.workloads.get(wl_key)
         if wl is not None:
             self.delete_workload(wl)
 

@@ -2,5 +2,42 @@
 
 from kueue_tpu.controllers.jobs.batch_job import BatchJob
 from kueue_tpu.controllers.jobs.jobset import JobSet, ReplicatedJob
+from kueue_tpu.controllers.jobs.replica_job import ReplicaJob, ReplicaSpec
+from kueue_tpu.controllers.jobs.kubeflow import (
+    MPIJob,
+    PaddleJob,
+    PyTorchJob,
+    TFJob,
+    XGBoostJob,
+)
+from kueue_tpu.controllers.jobs.ray import RayCluster, RayJob, WorkerGroup
+from kueue_tpu.controllers.jobs.appwrapper import AppWrapper, AppWrapperComponent
+from kueue_tpu.controllers.jobs.pod import PodGroup, SimPod
+from kueue_tpu.controllers.jobs.serving import (
+    Deployment,
+    LeaderWorkerSet,
+    StatefulSet,
+)
 
-__all__ = ["BatchJob", "JobSet", "ReplicatedJob"]
+__all__ = [
+    "BatchJob",
+    "JobSet",
+    "ReplicatedJob",
+    "ReplicaJob",
+    "ReplicaSpec",
+    "MPIJob",
+    "PaddleJob",
+    "PyTorchJob",
+    "TFJob",
+    "XGBoostJob",
+    "RayCluster",
+    "RayJob",
+    "WorkerGroup",
+    "AppWrapper",
+    "AppWrapperComponent",
+    "PodGroup",
+    "SimPod",
+    "Deployment",
+    "LeaderWorkerSet",
+    "StatefulSet",
+]

@@ -1,0 +1,41 @@
+"""AppWrapper integration (pkg/controller/jobs/appwrapper).
+
+An AppWrapper bundles components, each contributing podsets; the
+wrapper is suspend-based and its workload covers the union of all
+component podsets (appwrapper_controller.go PodSets)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Tuple
+
+from kueue_tpu.controllers.jobs.replica_job import ReplicaJob, ReplicaSpec
+from kueue_tpu.resources import requests_from_spec
+
+
+@dataclass
+class AppWrapperComponent:
+    name: str
+    pod_sets: Tuple[ReplicaSpec, ...] = ()
+
+    @staticmethod
+    def build(name, pod_sets) -> "AppWrapperComponent":
+        return AppWrapperComponent(
+            name=name,
+            pod_sets=tuple(
+                ReplicaSpec.build(f"{name}-{ps_name}", replicas, requests)
+                for ps_name, replicas, requests in pod_sets
+            ),
+        )
+
+
+@dataclass
+class AppWrapper(ReplicaJob):
+    kind = "AppWrapper"
+    components: Tuple[AppWrapperComponent, ...] = ()
+
+    def __post_init__(self):
+        if self.components and not self.replicas:
+            self.replicas = tuple(
+                ps for comp in self.components for ps in comp.pod_sets
+            )

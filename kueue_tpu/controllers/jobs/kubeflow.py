@@ -1,0 +1,65 @@
+"""Kubeflow training-operator integrations.
+
+Reference: pkg/controller/jobs/kubeflow/jobs/{paddlejob,pytorchjob,
+tfjob,xgboostjob} + jobs/mpijob. Each kind is ReplicaSpecs in a fixed
+role order (kubeflowjob_controller.go OrderedReplicaTypes) -> podsets.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from kueue_tpu.controllers.jobs.replica_job import ReplicaJob, ReplicaSpec
+
+
+def _ordered(replicas: Tuple[ReplicaSpec, ...], order: Tuple[str, ...]):
+    rank = {name: i for i, name in enumerate(order)}
+    return tuple(sorted(replicas, key=lambda r: rank.get(r.name, len(order))))
+
+
+@dataclass
+class PyTorchJob(ReplicaJob):
+    kind = "PyTorchJob"
+    ROLE_ORDER = ("Master", "Worker")
+
+    def __post_init__(self):
+        self.replicas = _ordered(self.replicas, self.ROLE_ORDER)
+
+
+@dataclass
+class TFJob(ReplicaJob):
+    kind = "TFJob"
+    ROLE_ORDER = ("Chief", "Master", "PS", "Worker")
+
+    def __post_init__(self):
+        self.replicas = _ordered(self.replicas, self.ROLE_ORDER)
+
+
+@dataclass
+class PaddleJob(ReplicaJob):
+    kind = "PaddleJob"
+    ROLE_ORDER = ("Master", "Worker")
+
+    def __post_init__(self):
+        self.replicas = _ordered(self.replicas, self.ROLE_ORDER)
+
+
+@dataclass
+class XGBoostJob(ReplicaJob):
+    kind = "XGBoostJob"
+    ROLE_ORDER = ("Master", "Worker")
+
+    def __post_init__(self):
+        self.replicas = _ordered(self.replicas, self.ROLE_ORDER)
+
+
+@dataclass
+class MPIJob(ReplicaJob):
+    """jobs/mpijob — kubeflow mpi-operator v2beta1."""
+
+    kind = "MPIJob"
+    ROLE_ORDER = ("Launcher", "Worker")
+
+    def __post_init__(self):
+        self.replicas = _ordered(self.replicas, self.ROLE_ORDER)

@@ -1,0 +1,69 @@
+"""Ray integrations (pkg/controller/jobs/ray).
+
+RayJob / RayCluster: one head podset plus one podset per worker group
+(rayjob_controller.go PodSets). RayCluster is the standalone-cluster
+variant whose "finish" is deletion rather than completion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Tuple
+
+from kueue_tpu.controllers.jobs.replica_job import ReplicaJob, ReplicaSpec
+from kueue_tpu.resources import requests_from_spec
+
+HEAD_PODSET = "head"
+
+
+@dataclass
+class WorkerGroup:
+    name: str
+    replicas: int = 1
+    requests: dict = field(default_factory=dict)
+
+    @staticmethod
+    def build(name, replicas=1, requests=None) -> "WorkerGroup":
+        return WorkerGroup(
+            name=name, replicas=replicas,
+            requests=requests_from_spec(requests or {}),
+        )
+
+
+def _ray_replicas(head_requests, worker_groups) -> Tuple[ReplicaSpec, ...]:
+    out = [ReplicaSpec(name=HEAD_PODSET, replicas=1, requests=dict(head_requests))]
+    for wg in worker_groups:
+        out.append(
+            ReplicaSpec(name=wg.name, replicas=wg.replicas, requests=dict(wg.requests))
+        )
+    return tuple(out)
+
+
+@dataclass
+class RayJob(ReplicaJob):
+    kind = "RayJob"
+
+    @staticmethod
+    def build(namespace, name, queue, head_requests=None, worker_groups=(), **kw):
+        return RayJob(
+            namespace=namespace, name=name, queue=queue,
+            replicas=_ray_replicas(
+                requests_from_spec(head_requests or {}), worker_groups
+            ),
+            **kw,
+        )
+
+
+@dataclass
+class RayCluster(ReplicaJob):
+    kind = "RayCluster"
+
+    @staticmethod
+    def build(namespace, name, queue, head_requests=None, worker_groups=(), **kw):
+        return RayCluster(
+            namespace=namespace, name=name, queue=queue,
+            replicas=_ray_replicas(
+                requests_from_spec(head_requests or {}), worker_groups
+            ),
+            **kw,
+        )

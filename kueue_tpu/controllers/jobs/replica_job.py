@@ -1,0 +1,130 @@
+"""Shared base for multi-role replica jobs (kubeflow family, Ray, ...).
+
+The kubeflow integrations (pkg/controller/jobs/kubeflow/kubeflowjob/
+kubeflowjob_controller.go) all reduce to: ReplicaSpecs (role -> count +
+pod template) become podsets in a fixed role order; RunPolicy.suspend
+gates the job; admission injects per-role node selectors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+from kueue_tpu.controllers.jobframework import GenericJob
+from kueue_tpu.controllers.podset_info import PodSetInfo
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.resources import Requests, requests_from_spec
+
+
+@dataclass
+class ReplicaSpec:
+    """One role (Launcher/Worker/Master/...) of a replicated job."""
+
+    name: str
+    replicas: int = 1
+    requests: Requests = field(default_factory=dict)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: Tuple = ()
+
+    @staticmethod
+    def build(name, replicas=1, requests=None, **kw) -> "ReplicaSpec":
+        return ReplicaSpec(
+            name=name, replicas=replicas,
+            requests=requests_from_spec(requests or {}), **kw,
+        )
+
+
+@dataclass
+class ReplicaJob(GenericJob):
+    """Suspend-based job whose podsets mirror its replica specs."""
+
+    kind = "ReplicaJob"
+    namespace: str = ""
+    name: str = ""
+    queue: str = ""
+    priority_class: str = ""
+    suspended: bool = True
+    replicas: Tuple[ReplicaSpec, ...] = ()
+
+    # simulated status
+    active_pods: int = 0
+    ready_pods: int = 0
+    terminal_state: str = ""  # "" | Succeeded | Failed
+
+    _original_selectors: Optional[Dict[str, Dict[str, str]]] = None
+
+    def queue_name(self) -> str:
+        return self.queue
+
+    def workload_priority_class(self) -> str:
+        return self.priority_class
+
+    def is_suspended(self) -> bool:
+        return self.suspended
+
+    def suspend(self) -> None:
+        self.suspended = True
+        self.active_pods = 0
+        self.ready_pods = 0
+
+    def total_pods(self) -> int:
+        return sum(r.replicas for r in self.replicas)
+
+    def pod_sets(self) -> Tuple[PodSet, ...]:
+        return tuple(
+            PodSet(
+                name=r.name,
+                count=r.replicas,
+                requests=dict(r.requests),
+                node_selector=dict(r.node_selector),
+                tolerations=tuple(r.tolerations),
+            )
+            for r in self.replicas
+        )
+
+    def run_with_podsets_info(self, infos: Sequence[PodSetInfo]) -> None:
+        by_name = {i.name: i for i in infos}
+        self._original_selectors = {
+            r.name: dict(r.node_selector) for r in self.replicas
+        }
+        for r in self.replicas:
+            info = by_name.get(r.name)
+            if info is not None:
+                merged = dict(r.node_selector)
+                merged.update(info.node_selector)
+                r.node_selector = merged
+        self.suspended = False
+        self.active_pods = self.total_pods()
+
+    def restore_podsets_info(self, infos: Sequence[PodSetInfo]) -> bool:
+        changed = False
+        if self._original_selectors is not None:
+            for r in self.replicas:
+                orig = self._original_selectors.get(r.name)
+                if orig is not None and r.node_selector != orig:
+                    r.node_selector = orig
+                    changed = True
+            self._original_selectors = None
+        return changed
+
+    def is_active(self) -> bool:
+        return self.active_pods > 0
+
+    def finished(self) -> Tuple[str, bool, bool]:
+        if self.terminal_state == "Succeeded":
+            return f"{self.kind} finished successfully", True, True
+        if self.terminal_state == "Failed":
+            return f"{self.kind} failed", False, True
+        return "", False, False
+
+    def pods_ready(self) -> bool:
+        return not self.suspended and self.ready_pods >= self.total_pods()
+
+    # simulation helpers
+    def mark_pods_ready(self) -> None:
+        self.ready_pods = self.total_pods()
+
+    def complete(self, success: bool = True) -> None:
+        self.terminal_state = "Succeeded" if success else "Failed"
+        self.active_pods = 0

@@ -1,0 +1,196 @@
+"""Serving-workload integrations: Deployment, StatefulSet,
+LeaderWorkerSet.
+
+Reference: pkg/controller/jobs/{deployment,statefulset,
+leaderworkerset}. Serving workloads never "finish" — their pods are
+managed through the pod-group machinery (queue-name propagated by the
+webhooks); scale changes resize the workload. Here each is a
+GenericJob whose podsets track spec.replicas and whose Finished state
+only occurs on deletion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+from kueue_tpu.controllers.jobframework import GenericJob
+from kueue_tpu.controllers.podset_info import PodSetInfo
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.resources import Requests, requests_from_spec
+
+
+@dataclass
+class _ServingBase(GenericJob):
+    namespace: str = ""
+    name: str = ""
+    queue: str = ""
+    priority_class: str = ""
+    replicas: int = 1
+    requests: Requests = field(default_factory=dict)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+
+    # pods gated until admitted (the pod webhook gates them)
+    started: bool = False
+    ready_replicas: int = 0
+    deleted: bool = False
+
+    _original_selector: Optional[Dict[str, str]] = None
+
+    def queue_name(self) -> str:
+        return self.queue
+
+    def workload_priority_class(self) -> str:
+        return self.priority_class
+
+    def is_suspended(self) -> bool:
+        return not self.started
+
+    def suspend(self) -> None:
+        self.started = False
+        self.ready_replicas = 0
+
+    def pod_sets(self) -> Tuple[PodSet, ...]:
+        return (
+            PodSet(
+                name="main",
+                count=self.replicas,
+                requests=dict(self.requests),
+                node_selector=dict(self.node_selector),
+            ),
+        )
+
+    def run_with_podsets_info(self, infos: Sequence[PodSetInfo]) -> None:
+        info = infos[0]
+        self._original_selector = dict(self.node_selector)
+        merged = dict(self.node_selector)
+        merged.update(info.node_selector)
+        self.node_selector = merged
+        self.started = True
+        self.ready_replicas = self.replicas
+
+    def restore_podsets_info(self, infos: Sequence[PodSetInfo]) -> bool:
+        if self._original_selector is None:
+            return False
+        changed = self.node_selector != self._original_selector
+        self.node_selector = self._original_selector
+        self._original_selector = None
+        return changed
+
+    def is_active(self) -> bool:
+        return self.started and self.ready_replicas > 0
+
+    def finished(self) -> Tuple[str, bool, bool]:
+        if self.deleted:
+            return "Deleted", True, True
+        return "", False, False
+
+    def pods_ready(self) -> bool:
+        return self.started and self.ready_replicas >= self.replicas
+
+    def scale(self, replicas: int) -> None:
+        self.replicas = replicas
+        if self.started:
+            self.ready_replicas = replicas
+
+
+@dataclass
+class Deployment(_ServingBase):
+    kind = "Deployment"
+
+    @staticmethod
+    def build(namespace, name, queue, replicas=1, requests=None, **kw):
+        return Deployment(
+            namespace=namespace, name=name, queue=queue, replicas=replicas,
+            requests=requests_from_spec(requests or {}), **kw,
+        )
+
+
+@dataclass
+class StatefulSet(_ServingBase):
+    kind = "StatefulSet"
+
+    @staticmethod
+    def build(namespace, name, queue, replicas=1, requests=None, **kw):
+        return StatefulSet(
+            namespace=namespace, name=name, queue=queue, replicas=replicas,
+            requests=requests_from_spec(requests or {}), **kw,
+        )
+
+
+@dataclass
+class LeaderWorkerSet(GenericJob):
+    """leaderworkerset.x-k8s.io: groups of 1 leader + N workers,
+    replicated ``replicas`` times; one workload per replica group in
+    the reference — collapsed here to leader/workers podsets scaled by
+    the group count."""
+
+    kind = "LeaderWorkerSet"
+    namespace: str = ""
+    name: str = ""
+    queue: str = ""
+    priority_class: str = ""
+    replicas: int = 1  # number of groups
+    group_size: int = 2  # leader + workers per group
+    leader_requests: Requests = field(default_factory=dict)
+    worker_requests: Requests = field(default_factory=dict)
+    started: bool = False
+    deleted: bool = False
+
+    @staticmethod
+    def build(namespace, name, queue, replicas=1, group_size=2,
+              leader_requests=None, worker_requests=None, **kw):
+        return LeaderWorkerSet(
+            namespace=namespace, name=name, queue=queue,
+            replicas=replicas, group_size=group_size,
+            leader_requests=requests_from_spec(leader_requests or {}),
+            worker_requests=requests_from_spec(worker_requests or {}),
+            **kw,
+        )
+
+    def queue_name(self) -> str:
+        return self.queue
+
+    def workload_priority_class(self) -> str:
+        return self.priority_class
+
+    def is_suspended(self) -> bool:
+        return not self.started
+
+    def suspend(self) -> None:
+        self.started = False
+
+    def pod_sets(self) -> Tuple[PodSet, ...]:
+        workers_per_group = self.group_size - 1
+        podsets = [
+            PodSet(
+                name="leader", count=self.replicas,
+                requests=dict(self.leader_requests),
+            )
+        ]
+        if workers_per_group > 0:
+            podsets.append(
+                PodSet(
+                    name="workers",
+                    count=self.replicas * workers_per_group,
+                    requests=dict(self.worker_requests),
+                )
+            )
+        return tuple(podsets)
+
+    def run_with_podsets_info(self, infos: Sequence[PodSetInfo]) -> None:
+        self.started = True
+
+    def restore_podsets_info(self, infos: Sequence[PodSetInfo]) -> bool:
+        return False
+
+    def is_active(self) -> bool:
+        return self.started
+
+    def finished(self) -> Tuple[str, bool, bool]:
+        if self.deleted:
+            return "Deleted", True, True
+        return "", False, False
+
+    def pods_ready(self) -> bool:
+        return self.started

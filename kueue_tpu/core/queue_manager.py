@@ -92,12 +92,15 @@ class PendingClusterQueue:
         self.namespace_selector: Optional[Dict[str, str]] = None
 
     def _less(self, a: Workload, b: Workload) -> bool:
+        """Strict ordering (cluster_queue.go:413-426); ties report
+        neither-less so snapshot_sorted's stable sort preserves
+        insertion order, matching the heaps' FIFO tie-break."""
         pa, pb = self._priority_fn(a), self._priority_fn(b)
         if pa != pb:
             return pa > pb
         ta = queue_order_timestamp(a, self._ts_policy)
         tb = queue_order_timestamp(b, self._ts_policy)
-        return ta <= tb
+        return ta < tb
 
     # ---- backoff gate ----
     def _backoff_expired(self, wl: Workload) -> bool:

@@ -36,12 +36,13 @@ def _build() -> bool:
 
 
 def ensure_built() -> bool:
-    """Explicitly compile the library if absent, then load it."""
+    """Explicitly (re)compile the library, then load it. ``make`` is
+    timestamp-incremental, so this is cheap when nothing changed and
+    never validates a stale binary after source edits."""
     global _load_attempted
-    if not os.path.exists(_LIB_PATH):
-        if not _build():
-            return False
-        _load_attempted = False  # retry the dlopen
+    if not _build() and not os.path.exists(_LIB_PATH):
+        return False
+    _load_attempted = False  # retry the dlopen against the fresh build
     return load() is not None
 
 
@@ -178,10 +179,12 @@ class NativeQuota:
         import numpy as np
 
         n, fr = guaranteed.shape
-        _, parent_p = _as_i32(parent)
-        _, order_p = _as_i32(order)
-        _, guaranteed_p = _as_i64(guaranteed)
-        _, local_p = _as_i64(local_usage)
+        # keep every converted array referenced until after the C call —
+        # `_`-rebinding would free a temporary the pointer still targets
+        parent_a, parent_p = _as_i32(parent)
+        order_a, order_p = _as_i32(order)
+        guaranteed_a, guaranteed_p = _as_i64(guaranteed)
+        local_a, local_p = _as_i64(local_usage)
         usage = np.zeros((n, fr), dtype=np.int64)
         usage_p = usage.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
         self._lib.quota_usage_tree(
@@ -193,26 +196,26 @@ class NativeQuota:
         import numpy as np
 
         fr = subtree.shape[1]
-        _, path_p = _as_i32(path)
-        _, subtree_p = _as_i64(subtree)
-        _, guaranteed_p = _as_i64(guaranteed)
-        _, borrowing_p = _as_i64(borrowing)
-        _, usage_p = _as_i64(usage)
+        path_a, path_p = _as_i32(path)
+        subtree_a, subtree_p = _as_i64(subtree)
+        guaranteed_a, guaranteed_p = _as_i64(guaranteed)
+        borrowing_a, borrowing_p = _as_i64(borrowing)
+        usage_a, usage_p = _as_i64(usage)
         out = np.zeros(fr, dtype=np.int64)
         out_p = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
         self._lib.quota_available_node(
-            path_p, len(path), fr, subtree_p, guaranteed_p, borrowing_p,
+            path_p, len(path_a), fr, subtree_p, guaranteed_p, borrowing_p,
             usage_p, out_p,
         )
         return out
 
     def add_usage(self, path, guaranteed, delta, usage, sign=1):
-        _, path_p = _as_i32(path)
-        _, guaranteed_p = _as_i64(guaranteed)
-        _, delta_p = _as_i64(delta)
+        path_a, path_p = _as_i32(path)
+        guaranteed_a, guaranteed_p = _as_i64(guaranteed)
+        delta_a, delta_p = _as_i64(delta)
         usage_c, usage_p = _as_i64(usage)
         self._lib.quota_add_usage(
-            path_p, len(path), guaranteed.shape[1], guaranteed_p, delta_p,
+            path_p, len(path_a), guaranteed.shape[1], guaranteed_p, delta_p,
             sign, usage_p,
         )
         return usage_c

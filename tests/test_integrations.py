@@ -1,0 +1,212 @@
+"""Job-integration tests: kubeflow family, Ray, AppWrapper, pod groups,
+serving workloads."""
+
+import pytest
+
+from kueue_tpu.controllers.jobs import (
+    AppWrapper,
+    AppWrapperComponent,
+    Deployment,
+    LeaderWorkerSet,
+    MPIJob,
+    PodGroup,
+    PyTorchJob,
+    RayJob,
+    ReplicaSpec,
+    SimPod,
+    StatefulSet,
+    TFJob,
+    WorkerGroup,
+)
+from tests.test_controllers import make_runtime
+
+
+class TestKubeflow:
+    def test_pytorch_role_order_and_admission(self):
+        rt, clock = make_runtime(quota="10", flavor_labels={"tpu": "v5e"})
+        job = PyTorchJob(
+            namespace="ns", name="train", queue="lq",
+            replicas=(
+                ReplicaSpec.build("Worker", 4, {"cpu": "1"}),
+                ReplicaSpec.build("Master", 1, {"cpu": "1"}),
+            ),
+        )
+        rt.add_job(job)
+        rt.run_until_idle()
+        wl = rt.workloads["ns/pytorchjob-train"]
+        assert wl.is_admitted
+        # roles ordered Master first (OrderedReplicaTypes)
+        assert [ps.name for ps in wl.pod_sets] == ["Master", "Worker"]
+        assert not job.is_suspended()
+        assert all(r.node_selector == {"tpu": "v5e"} for r in job.replicas)
+        job.complete()
+        rt.run_until_idle()
+        assert wl.is_finished
+
+    def test_mpijob_launcher_worker(self):
+        rt, clock = make_runtime(quota="5")
+        job = MPIJob(
+            namespace="ns", name="mpi", queue="lq",
+            replicas=(
+                ReplicaSpec.build("Worker", 4, {"cpu": "1"}),
+                ReplicaSpec.build("Launcher", 1, {"cpu": "1"}),
+            ),
+        )
+        rt.add_job(job)
+        rt.run_until_idle()
+        wl = rt.workloads["ns/mpijob-mpi"]
+        assert [ps.name for ps in wl.pod_sets] == ["Launcher", "Worker"]
+        assert wl.is_admitted
+
+    def test_tfjob_too_big_queued(self):
+        rt, clock = make_runtime(quota="3")
+        job = TFJob(
+            namespace="ns", name="tf", queue="lq",
+            replicas=(
+                ReplicaSpec.build("Chief", 1, {"cpu": "1"}),
+                ReplicaSpec.build("Worker", 4, {"cpu": "1"}),
+            ),
+        )
+        rt.add_job(job)
+        rt.run_until_idle()
+        assert job.is_suspended()
+        assert rt.queues.pending_workloads("cq") == 1
+
+
+class TestRay:
+    def test_rayjob_head_and_workers(self):
+        rt, clock = make_runtime(quota="10")
+        job = RayJob.build(
+            "ns", "ray", "lq", head_requests={"cpu": "1"},
+            worker_groups=(WorkerGroup.build("small", 4, {"cpu": "1"}),),
+        )
+        rt.add_job(job)
+        rt.run_until_idle()
+        wl = rt.workloads["ns/rayjob-ray"]
+        assert wl.is_admitted
+        assert [(ps.name, ps.count) for ps in wl.pod_sets] == [
+            ("head", 1), ("small", 4),
+        ]
+
+
+class TestAppWrapper:
+    def test_components_aggregate(self):
+        rt, clock = make_runtime(quota="10")
+        aw = AppWrapper(
+            namespace="ns", name="bundle", queue="lq",
+            components=(
+                AppWrapperComponent.build("db", [("main", 1, {"cpu": "2"})]),
+                AppWrapperComponent.build("app", [("main", 3, {"cpu": "1"})]),
+            ),
+        )
+        rt.add_job(aw)
+        rt.run_until_idle()
+        wl = rt.workloads["ns/appwrapper-bundle"]
+        assert wl.is_admitted
+        assert [(ps.name, ps.count) for ps in wl.pod_sets] == [
+            ("db-main", 1), ("app-main", 3),
+        ]
+
+
+class TestPodGroups:
+    def test_single_pod_gating(self):
+        rt, clock = make_runtime(quota="1", flavor_labels={"zone": "a"})
+        pod = SimPod.build("p1", {"cpu": "1"})
+        group = PodGroup.single("ns", pod, "lq")
+        rt.add_job(group)
+        rt.run_until_idle()
+        wl = rt.workloads["ns/pod-p1"]
+        assert wl.is_admitted
+        assert not pod.gated  # admission removed the scheduling gate
+        assert pod.phase == "Running"
+        assert pod.node_selector == {"zone": "a"}
+        group.succeed_all()
+        rt.run_until_idle()
+        assert wl.is_finished
+
+    def test_group_admits_roles(self):
+        rt, clock = make_runtime(quota="10")
+        group = PodGroup(
+            namespace="ns", name="grp", queue="lq", total_count=3,
+            pods=[
+                SimPod.build("driver-0", {"cpu": "2"}, role="driver"),
+                SimPod.build("exec-0", {"cpu": "1"}, role="exec"),
+                SimPod.build("exec-1", {"cpu": "1"}, role="exec"),
+            ],
+        )
+        rt.add_job(group)
+        rt.run_until_idle()
+        wl = rt.workloads["ns/pod-grp"]
+        assert wl.is_admitted
+        assert [(ps.name, ps.count) for ps in wl.pod_sets] == [
+            ("driver", 1), ("exec", 2),
+        ]
+
+    def test_group_failure_and_replacement(self):
+        rt, clock = make_runtime(quota="10")
+        group = PodGroup(
+            namespace="ns", name="grp", queue="lq", total_count=2,
+            pods=[
+                SimPod.build("a", {"cpu": "1"}),
+                SimPod.build("b", {"cpu": "1"}),
+            ],
+        )
+        rt.add_job(group)
+        rt.run_until_idle()
+        group.pods[0].phase = "Failed"
+        # replacement joins; group not failed
+        group.replace_failed(SimPod.build("a2", {"cpu": "1"}, gated=False, phase="Running"))
+        msg, success, finished = group.finished()
+        assert not finished
+        group.succeed_all()
+        rt.run_until_idle()
+        assert rt.workloads["ns/pod-grp"].is_finished
+
+    def test_eviction_deletes_started_pods(self):
+        rt, clock = make_runtime(quota="1")
+        pod = SimPod.build("p1", {"cpu": "1"})
+        group = PodGroup.single("ns", pod, "lq")
+        rt.add_job(group)
+        rt.run_until_idle()
+        wl = rt.workloads["ns/pod-p1"]
+        wl.active = False
+        rt.run_until_idle()
+        assert pod.phase == "Deleted"
+
+
+class TestServing:
+    def test_deployment_admits_and_scales(self):
+        rt, clock = make_runtime(quota="4")
+        dep = Deployment.build("ns", "web", "lq", replicas=2, requests={"cpu": "1"})
+        rt.add_job(dep)
+        rt.run_until_idle()
+        wl = rt.workloads["ns/deployment-web"]
+        assert wl.is_admitted and dep.started
+        # scale up within quota: workload recreated at the new size
+        dep.scale(4)
+        rt.run_until_idle()
+        wl2 = rt.workloads["ns/deployment-web"]
+        assert wl2.pod_sets[0].count == 4
+        assert wl2.is_admitted
+
+    def test_statefulset_never_finishes(self):
+        rt, clock = make_runtime(quota="4")
+        ss = StatefulSet.build("ns", "db", "lq", replicas=1, requests={"cpu": "1"})
+        rt.add_job(ss)
+        rt.run_until_idle()
+        assert rt.workloads["ns/statefulset-db"].is_admitted
+        assert ss.finished() == ("", False, False)
+
+    def test_leaderworkerset_podsets(self):
+        rt, clock = make_runtime(quota="12")
+        lws = LeaderWorkerSet.build(
+            "ns", "serve", "lq", replicas=2, group_size=3,
+            leader_requests={"cpu": "1"}, worker_requests={"cpu": "1"},
+        )
+        rt.add_job(lws)
+        rt.run_until_idle()
+        wl = rt.workloads["ns/leaderworkerset-serve"]
+        assert wl.is_admitted
+        assert [(ps.name, ps.count) for ps in wl.pod_sets] == [
+            ("leader", 2), ("workers", 4),
+        ]

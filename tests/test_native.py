@@ -85,6 +85,37 @@ class TestNativeHeap:
         assert len(nh) == len(ph)
 
 
+class TestBackendEquivalence:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_py_and_native_workload_heaps_identical(self, seed):
+        """The two make_workload_heap backends must order IDENTICALLY,
+        including exact rank ties (frozen ranks + fresh seq on update)."""
+        from kueue_tpu.utils.native_heap import NativeWorkloadHeap, PyWorkloadHeap
+
+        rng = np.random.default_rng(seed)
+        mk = lambda cls: cls(lambda x: x[0], lambda x: x[1], lambda x: x[2])
+        nh, ph = mk(NativeWorkloadHeap), mk(PyWorkloadHeap)
+        for step in range(1000):
+            op = rng.random()
+            key = f"k{int(rng.integers(0, 40))}"
+            if op < 0.5:
+                item = (key, int(rng.integers(0, 4)), float(rng.integers(0, 4)))
+                nh.push_or_update(item)
+                ph.push_or_update(item)
+            elif op < 0.65:
+                item = (key, int(rng.integers(0, 4)), float(rng.integers(0, 4)))
+                assert nh.push_if_not_present(item) == ph.push_if_not_present(item)
+            elif op < 0.8:
+                assert nh.delete(key) == ph.delete(key)
+            else:
+                a, b = nh.pop(), ph.pop()
+                assert (a is None) == (b is None)
+                if a is not None:
+                    assert a[0] == b[0], (step, a, b)
+        assert len(nh) == len(ph)
+        assert sorted(nh.keys()) == sorted(ph.keys())
+
+
 class TestNativeQuota:
     def build(self, seed=0, n_cq=20, n_cohort=5, fr=6):
         rng = np.random.default_rng(seed)
