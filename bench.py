@@ -77,6 +77,7 @@ def build_problem(seed: int = 0):
         valid=jnp.asarray(valid),
         priority=jnp.asarray(rng.integers(0, 100, size=W).astype(np.int64)),
         timestamp=jnp.asarray(np.arange(W, dtype=np.int64)),
+        no_reclaim=jnp.asarray(np.zeros(W, dtype=bool)),
     )
     return tree, jnp.asarray(local_usage), batch, paths
 

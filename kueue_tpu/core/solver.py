@@ -45,6 +45,7 @@ class Lowered:
     valid: np.ndarray  # bool[W,K]
     priority: np.ndarray  # int64[W]
     timestamp: np.ndarray  # int64[W] (ns)
+    no_reclaim: np.ndarray  # bool[W] — reserve capacity when blocked
     # per head: candidate k -> flavor name chosen per resource group
     candidate_flavors: List[List[Dict[str, str]]] = field(default_factory=list)
     heads: List[Workload] = field(default_factory=list)
@@ -79,6 +80,7 @@ def lower_heads(
         valid=np.zeros((w, k), dtype=bool),
         priority=np.zeros(w, dtype=np.int64),
         timestamp=np.zeros(w, dtype=np.int64),
+        no_reclaim=np.zeros(w, dtype=bool),
     )
 
     for i, (wl, cq_name) in enumerate(heads):
@@ -163,7 +165,10 @@ def lower_heads(
         for options in per_rg:
             combos = [prev + [opt] for prev in combos for opt in options]
 
+        from kueue_tpu.core.preemption import can_always_reclaim
+
         out.cq_row[i] = snapshot.row(cq_name)
+        out.no_reclaim[i] = not can_always_reclaim(cq)
         out.priority[i] = priority_of(wl, snapshot.priority_classes)
         ts = timestamp_fn(wl) if timestamp_fn else wl.creation_time
         out.timestamp[i] = int(ts * 1e9)
@@ -237,6 +242,7 @@ def solve_heads(
         valid=jnp.asarray(lowered.valid),
         priority=jnp.asarray(lowered.priority),
         timestamp=jnp.asarray(lowered.timestamp),
+        no_reclaim=jnp.asarray(lowered.no_reclaim),
     )
     result = solve_cycle_jit(tree, jnp.asarray(snapshot.local_usage), batch, paths)
     return lowered, result

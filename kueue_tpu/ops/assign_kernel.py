@@ -44,7 +44,14 @@ from __future__ import annotations
 from typing import NamedTuple, Tuple
 
 from kueue_tpu._jax import jax, jnp, lax
-from kueue_tpu.ops.quota import NO_LIMIT, QuotaTree, available_all, subtree_quota, usage_tree
+from kueue_tpu.ops.quota import (
+    NO_LIMIT,
+    QuotaTree,
+    available_all,
+    potential_available_all,
+    subtree_quota,
+    usage_tree,
+)
 
 
 class HeadsBatch(NamedTuple):
@@ -57,6 +64,9 @@ class HeadsBatch(NamedTuple):
     valid:     bool[W,K]  — candidate slot is populated.
     priority:  int64[W]
     timestamp: int64[W]   — queue-order timestamp (ns); lower = older.
+    no_reclaim: bool[W]   — CQ cannot always reclaim
+                            (reclaimWithinCohort != Any): blocked
+                            preempt-mode heads RESERVE capacity.
     """
 
     cq_row: jnp.ndarray
@@ -65,16 +75,19 @@ class HeadsBatch(NamedTuple):
     valid: jnp.ndarray
     priority: jnp.ndarray
     timestamp: jnp.ndarray
+    no_reclaim: jnp.ndarray
 
 
 class SolveResult(NamedTuple):
     """chosen: int32[W] candidate index (-1 = no fit in phase 1).
     admitted: bool[W]; borrows: bool[W] (of the chosen candidate);
+    reserved: bool[W] — blocked preempt-mode head reserved capacity;
     usage: int64[N,FR] final leaf usage after all admissions."""
 
     chosen: jnp.ndarray
     admitted: jnp.ndarray
     borrows: jnp.ndarray
+    reserved: jnp.ndarray
     usage: jnp.ndarray
 
 
@@ -108,16 +121,22 @@ def phase1_classify(
     guaranteed: jnp.ndarray,
     local_usage: jnp.ndarray,
     heads: HeadsBatch,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Pick each head's first fitting candidate against the cycle-start
-    snapshot. Returns (chosen int32[W], borrows bool[W,K]).
+    snapshot. Returns (chosen int32[W], borrows bool[W,K],
+    preempt_k int32[W]).
 
     Equivalent to running FlavorAssigner.assign for every head with the
     default fungibility policy (stop at the first Fit —
     flavorassigner.go:620-638) before any admission mutates usage.
+    ``preempt_k`` is the representative preempt-mode candidate for
+    unfit heads: the first candidate whose request fits within the
+    cohort's potentialAvailable (flavorassigner.go:692-726 classifies
+    such candidates Preempt/Reclaim rather than NoFit).
     """
     usage = usage_tree(tree, guaranteed, local_usage)
     avail = available_all(tree, subtree, guaranteed, usage)  # [N, FR]
+    potential = potential_available_all(tree, subtree, guaranteed)  # [N, FR]
 
     cq = jnp.maximum(heads.cq_row, 0)  # [W]
     # Zero-quantity cells never constrain the fit: the host path masks
@@ -130,9 +149,22 @@ def phase1_classify(
     avail_wkc = avail[cq[:, None, None], cells]  # [W,K,C]
     subtree_wkc = subtree[cq[:, None, None], cells]
     local_wkc = local_usage[cq[:, None, None], cells]
+    potential_wkc = potential[cq[:, None, None], cells]
 
     fits = jnp.all(
         jnp.where(cell_need, avail_wkc >= heads.qty, True), axis=-1
+    )  # [W,K]
+    # default-policy PREEMPT per cell: request <= potentialAvailable
+    # AND request <= nominal (flavorassigner.go:692-726; the
+    # preempt-while-borrowing policies stay on the host path)
+    nominal_wkc = tree.nominal[cq[:, None, None], cells]
+    pot_fits = jnp.all(
+        jnp.where(
+            cell_need,
+            (heads.qty <= potential_wkc) & (heads.qty <= nominal_wkc),
+            True,
+        ),
+        axis=-1,
     )  # [W,K]
     has_cohort = (tree.parent[cq] >= 0)[:, None]  # [W,1]
     borrows = (
@@ -146,8 +178,16 @@ def phase1_classify(
     fit_ok = fits & heads.valid
     first_fit = jnp.argmax(fit_ok, axis=1)  # first True (argmax on bool)
     any_fit = jnp.any(fit_ok, axis=1)
-    chosen = jnp.where(any_fit & (heads.cq_row >= 0), first_fit, -1).astype(jnp.int32)
-    return chosen, borrows
+    populated = heads.cq_row >= 0
+    chosen = jnp.where(any_fit & populated, first_fit, -1).astype(jnp.int32)
+
+    pre_ok = pot_fits & heads.valid
+    first_pre = jnp.argmax(pre_ok, axis=1)
+    any_pre = jnp.any(pre_ok, axis=1)
+    preempt_k = jnp.where(
+        any_pre & populated & (chosen < 0), first_pre, -1
+    ).astype(jnp.int32)
+    return chosen, borrows, preempt_k
 
 
 def _avail_along_path(
@@ -226,35 +266,42 @@ def solve_cycle(
     """
     max_depth = tree.max_depth
     subtree, guaranteed = subtree_quota(tree)
-    chosen, borrows_wk = phase1_classify(tree, subtree, guaranteed, local_usage, heads)
-
-    w = heads.cq_row.shape[0]
-    chosen_safe = jnp.maximum(chosen, 0)
-    head_borrow = jnp.take_along_axis(borrows_wk, chosen_safe[:, None], axis=1)[:, 0]
-    head_borrow = head_borrow & (chosen >= 0)
-
-    # entry order: (borrowing asc, priority desc, timestamp asc); padded
-    # or unfit heads sink to the end.
-    unfit = chosen < 0
-    order = jnp.lexsort(
-        (heads.timestamp, -heads.priority, head_borrow.astype(jnp.int64), unfit.astype(jnp.int64))
+    chosen, borrows_wk, preempt_k = phase1_classify(
+        tree, subtree, guaranteed, local_usage, heads
     )
 
-    cells_chosen = jnp.take_along_axis(
-        heads.cells, chosen_safe[:, None, None], axis=1
+    w = heads.cq_row.shape[0]
+    # effective candidate: the fit choice, else the preempt-mode
+    # representative — preempt-mode heads participate in entry order so
+    # their capacity reservation blocks later borrowers
+    # (scheduler.go:228-242)
+    eff_k = jnp.where(chosen >= 0, chosen, preempt_k)
+    eff_safe = jnp.maximum(eff_k, 0)
+    head_borrow = jnp.take_along_axis(borrows_wk, eff_safe[:, None], axis=1)[:, 0]
+    head_borrow = head_borrow & (eff_k >= 0)
+
+    # entry order: (borrowing asc, priority desc, timestamp asc); padded
+    # or hopeless (NoFit-everywhere) heads sink to the end.
+    nofit = eff_k < 0
+    order = jnp.lexsort(
+        (heads.timestamp, -heads.priority, head_borrow.astype(jnp.int64), nofit.astype(jnp.int64))
+    )
+
+    cells_eff = jnp.take_along_axis(
+        heads.cells, eff_safe[:, None, None], axis=1
     )[:, 0]  # [W, C]
-    qty_chosen = jnp.take_along_axis(heads.qty, chosen_safe[:, None, None], axis=1)[:, 0]
+    qty_eff = jnp.take_along_axis(heads.qty, eff_safe[:, None, None], axis=1)[:, 0]
 
     # full usage tree as the scan carry (leaf + interior rows)
     usage0 = usage_tree(tree, guaranteed, local_usage)
 
     def step(usage, wi):
         cq = heads.cq_row[wi]
-        active = (cq >= 0) & (chosen[wi] >= 0)
         cqs = jnp.maximum(cq, 0)
         path = paths[cqs]  # [D+1]
-        cells = cells_chosen[wi]
-        qty = qty_chosen[wi]
+        cells = cells_eff[wi]
+        qty = qty_eff[wi]
+        ccells = jnp.maximum(cells, 0)
         cell_valid = (cells >= 0) & (qty > 0)
 
         avail = _avail_along_path(
@@ -262,17 +309,47 @@ def solve_cycle(
         )
         fits = jnp.all(jnp.where(cell_valid, avail >= qty, True))
 
-        admit = active & fits
+        admit = (cq >= 0) & (chosen[wi] >= 0) & fits
         usage = _bubble_usage(
             path, cells, cell_valid, qty, usage, guaranteed, max_depth, admit
         )
-        return usage, admit
 
-    usage_final, admitted_in_order = lax.scan(step, usage0, order)
+        # blocked preempt-mode head: reserve capacity so later entries
+        # can't take it (resourcesToReserve, scheduler.go:391-416)
+        reserve = (
+            (cq >= 0)
+            & (chosen[wi] < 0)
+            & (preempt_k[wi] >= 0)
+            & heads.no_reclaim[wi]
+        )
+        nominal_c = tree.nominal[cqs, ccells]
+        bl_c = tree.borrowing_limit[cqs, ccells]
+        leaf_usage_c = usage[cqs, ccells]
+        borrow_cap = jnp.where(
+            bl_c < NO_LIMIT,
+            jnp.minimum(qty, nominal_c + bl_c - leaf_usage_c),
+            qty,
+        )
+        nominal_cap = jnp.maximum(0, jnp.minimum(qty, nominal_c - leaf_usage_c))
+        reserve_qty = jnp.where(head_borrow[wi], borrow_cap, nominal_cap)
+        usage = _bubble_usage(
+            path, cells, cell_valid, reserve_qty, usage, guaranteed,
+            max_depth, reserve,
+        )
+        return usage, (admit, reserve)
+
+    usage_final, (admitted_in_order, reserved_in_order) = lax.scan(
+        step, usage0, order
+    )
 
     admitted = jnp.zeros(w, dtype=bool).at[order].set(admitted_in_order)
+    reserved = jnp.zeros(w, dtype=bool).at[order].set(reserved_in_order)
     return SolveResult(
-        chosen=chosen, admitted=admitted, borrows=head_borrow, usage=usage_final
+        chosen=chosen,
+        admitted=admitted,
+        borrows=head_borrow,
+        reserved=reserved,
+        usage=usage_final,
     )
 
 

@@ -84,6 +84,7 @@ class ShardedSolver:
             valid=sh("wl", None),
             priority=sh("wl"),
             timestamp=sh("wl"),
+            no_reclaim=sh("wl"),
         )
         self._paths_sh = sh(None, None)
         self._jit = jax.jit(solve_cycle)
@@ -115,6 +116,7 @@ class ShardedSolver:
             valid=pad0(heads.valid),
             priority=pad0(heads.priority),
             timestamp=pad0(heads.timestamp),
+            no_reclaim=pad0(heads.no_reclaim),
         )
 
     def place(self, tree: QuotaTree, local_usage, heads: HeadsBatch, paths):

@@ -46,7 +46,8 @@ def build_env(cq_specs, flavors=("default",)):
     return sched, mgr, cache, clock
 
 
-def cq_single(name, quota, cohort=None, flavors_quotas=None, borrowing=None):
+def cq_single(name, quota, cohort=None, flavors_quotas=None, borrowing=None,
+              reclaim=ReclaimWithinCohortPolicy.ANY):
     fqs = flavors_quotas or (
         FlavorQuotas.build("default", {"cpu": (quota, borrowing, None)}),
     )
@@ -55,7 +56,7 @@ def cq_single(name, quota, cohort=None, flavors_quotas=None, borrowing=None):
         cohort=cohort,
         namespace_selector={},
         resource_groups=(ResourceGroup(("cpu",), tuple(fqs)),),
-        preemption=Preemption(reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY),
+        preemption=Preemption(reclaim_within_cohort=reclaim),
     )
 
 
@@ -169,6 +170,44 @@ def test_priority_orders_heads_across_cqs():
     assert admitted == {"high": {"cpu": "default"}}
 
 
+def test_blocked_preemption_reserves_capacity():
+    """scheduler.go:228-242: under reclaimWithinCohort=Never a blocked
+    preempt-mode head RESERVES its capacity, so a later borrower must
+    not take it — kernel and host must agree."""
+    sched, mgr, cache, _ = build_env(
+        [
+            cq_single("a", "10", cohort="co", reclaim=ReclaimWithinCohortPolicy.NEVER),
+            cq_single("b", "0", cohort="co", reclaim=ReclaimWithinCohortPolicy.NEVER),
+        ]
+    )
+    # fill A to 8/10 so its next head is preempt-mode (4 > 2 available)
+    submit(mgr, "base", "lq-a", cpu="8", t=0.0)
+    run_parity(sched, mgr, cache)
+    # A's head (higher priority) is blocked-preempt; B's wants to borrow
+    # the remaining 2 — the reservation must block it
+    submit(mgr, "blocked", "lq-a", cpu="4", prio=10, t=1.0)
+    submit(mgr, "borrower", "lq-b", cpu="2", prio=0, t=2.0)
+    admitted = run_parity(sched, mgr, cache)
+    assert admitted == {}
+
+
+def test_reclaim_any_does_not_reserve():
+    """With reclaimWithinCohort=Any capacity can always be taken back,
+    so the borrower IS admitted despite the blocked head."""
+    sched, mgr, cache, _ = build_env(
+        [
+            cq_single("a", "10", cohort="co", reclaim=ReclaimWithinCohortPolicy.ANY),
+            cq_single("b", "0", cohort="co", reclaim=ReclaimWithinCohortPolicy.ANY),
+        ]
+    )
+    submit(mgr, "base", "lq-a", cpu="8", t=0.0)
+    run_parity(sched, mgr, cache)
+    submit(mgr, "blocked", "lq-a", cpu="4", prio=10, t=1.0)
+    submit(mgr, "borrower", "lq-b", cpu="2", prio=0, t=2.0)
+    admitted = run_parity(sched, mgr, cache)
+    assert admitted == {"borrower": {"cpu": "default"}}
+
+
 @pytest.mark.parametrize("seed", range(8))
 def test_randomized_parity(seed):
     rng = np.random.default_rng(seed)
@@ -184,7 +223,17 @@ def test_randomized_parity(seed):
                 if cohort is not None and rng.random() < 0.5
                 else None
             )
-            cqs.append(cq_single(f"cq-{idx}", quota, cohort=cohort, borrowing=borrowing))
+            reclaim = (
+                ReclaimWithinCohortPolicy.NEVER
+                if rng.random() < 0.5
+                else ReclaimWithinCohortPolicy.ANY
+            )
+            cqs.append(
+                cq_single(
+                    f"cq-{idx}", quota, cohort=cohort, borrowing=borrowing,
+                    reclaim=reclaim,
+                )
+            )
             idx += 1
     sched, mgr, cache, _ = build_env(cqs)
     for i, cq in enumerate(cqs):
