@@ -64,7 +64,7 @@ class ClusterRuntime:
         self.metrics = Metrics()
         self.pods_ready_cfg = wait_for_pods_ready or WaitForPodsReadyConfig()
 
-        tas_check = tas_assign = None
+        tas_check = tas_assign = tas_fits = None
         self.tas_manager = None
         if tas_cache is not None:
             from kueue_tpu.tas import TASManager
@@ -73,6 +73,7 @@ class ClusterRuntime:
             self.tas_manager = TASManager(tas_cache, self.cache.flavors)
             tas_check = self.tas_manager.check
             tas_assign = self.tas_manager.assign
+            tas_fits = self.tas_manager.fits
 
         self.scheduler = Scheduler(
             queues=self.queues,
@@ -84,6 +85,7 @@ class ClusterRuntime:
             and self.pods_ready_cfg.block_admission,
             tas_check=tas_check,
             tas_assign=tas_assign,
+            tas_fits=tas_fits,
             events=lambda kind, wl, msg: self.event(kind, wl, msg),
         )
         self.job_reconciler = JobReconciler(

@@ -94,12 +94,14 @@ class PendingClusterQueue:
     def _less(self, a: Workload, b: Workload) -> bool:
         """Strict ordering (cluster_queue.go:413-426); ties report
         neither-less so snapshot_sorted's stable sort preserves
-        insertion order, matching the heaps' FIFO tie-break."""
+        insertion order, matching the heaps' FIFO tie-break. Timestamps
+        quantize to integer ns exactly like the heap ranks do, so heap
+        pop order and snapshot ordering agree on near-ties."""
         pa, pb = self._priority_fn(a), self._priority_fn(b)
         if pa != pb:
             return pa > pb
-        ta = queue_order_timestamp(a, self._ts_policy)
-        tb = queue_order_timestamp(b, self._ts_policy)
+        ta = int(queue_order_timestamp(a, self._ts_policy) * 1e9)
+        tb = int(queue_order_timestamp(b, self._ts_policy) * 1e9)
         return ta < tb
 
     # ---- backoff gate ----

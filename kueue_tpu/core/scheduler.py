@@ -102,6 +102,7 @@ class Scheduler:
         wait_for_pods_ready_block: bool = False,
         tas_check=None,
         tas_assign=None,
+        tas_fits=None,
         events: Optional[Callable[[str, Workload, str], None]] = None,
         limit_range_validate: Optional[Callable[[Workload], Optional[str]]] = None,
     ):
@@ -122,6 +123,7 @@ class Scheduler:
         self.wait_for_pods_ready_block = wait_for_pods_ready_block
         self.tas_check = tas_check
         self.tas_assign = tas_assign
+        self.tas_fits = tas_fits
         self.events = events or (lambda kind, wl, msg: None)
         self.limit_range_validate = limit_range_validate
         self.scheduling_cycle = 0
@@ -185,6 +187,27 @@ class Scheduler:
                         result.skipped_preemptions.get(e.cq_name, 0) + 1
                     )
                 continue
+
+            # Re-validate topology assignments against in-cycle TAS
+            # usage: quota fits() above is blind to domain capacity, but
+            # an earlier admission this cycle may have taken the same
+            # rack/host (reference Fits' TAS branch,
+            # clusterqueue_snapshot.go:135-149).
+            if (
+                mode == Mode.FIT
+                and self.tas_fits is not None
+                and any(
+                    ps.topology_assignment is not None
+                    for ps in e.assignment.pod_sets
+                )
+            ):
+                tas_msg = self.tas_fits(
+                    e.workload, e.cq_name, e.assignment, snapshot
+                )
+                if tas_msg:
+                    e.status = EntryStatus.SKIPPED
+                    e.inadmissible_msg = tas_msg
+                    continue
 
             for t in e.preemption_targets:
                 preempted_keys[t.workload.workload.key] = t.workload

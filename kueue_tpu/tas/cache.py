@@ -56,15 +56,12 @@ class TASFlavorCache:
         self.nodes.pop(name, None)
 
     # ---- usage lifecycle (cache.AddOrUpdateWorkload TAS side) ----
-    def add_usage(self, wl: Workload) -> None:
-        self._apply_usage(wl, +1)
-
-    def remove_usage(self, wl: Workload) -> None:
-        self._apply_usage(wl, -1)
-
-    def _apply_usage(self, wl: Workload, sign: int) -> None:
+    def charge_entries(self, wl: Workload) -> List[Tuple[str, Dict[str, int], int]]:
+        """(domain id, usage delta, pod count) entries this workload's
+        admission charges against this flavor's domains."""
+        out: List[Tuple[str, Dict[str, int], int]] = []
         if wl.admission is None:
-            return
+            return out
         podsets = {ps.name: ps for ps in wl.pod_sets}
         for psa in wl.admission.pod_set_assignments:
             ta = psa.topology_assignment
@@ -76,13 +73,29 @@ class TASFlavorCache:
             if ps is None:
                 continue
             for dom in ta.domains:
-                did = domain_id(dom.values)
-                acc = self._usage.setdefault(did, {})
-                for r, v in ps.requests.items():
-                    acc[r] = acc.get(r, 0) + sign * v * dom.count
-                self._usage_counts[did] = (
-                    self._usage_counts.get(did, 0) + sign * dom.count
+                out.append(
+                    (
+                        domain_id(dom.values),
+                        {r: v * dom.count for r, v in ps.requests.items()},
+                        dom.count,
+                    )
                 )
+        return out
+
+    def apply_entries(
+        self, entries: List[Tuple[str, Dict[str, int], int]], sign: int
+    ) -> None:
+        for did, usage, count in entries:
+            acc = self._usage.setdefault(did, {})
+            for r, v in usage.items():
+                acc[r] = acc.get(r, 0) + sign * v
+            self._usage_counts[did] = self._usage_counts.get(did, 0) + sign * count
+
+    def add_usage(self, wl: Workload) -> None:
+        self.apply_entries(self.charge_entries(wl), +1)
+
+    def remove_usage(self, wl: Workload) -> None:
+        self.apply_entries(self.charge_entries(wl), -1)
 
     # ---- snapshot (tas_flavor.go snapshot build) ----
     def snapshot(self) -> TASFlavorSnapshot:
@@ -114,9 +127,12 @@ class TASCache:
         self.flavors: Dict[str, TASFlavorCache] = {}
         self.topologies: Dict[str, Topology] = {}
         self._nodes: Dict[str, Node] = {}
-        # Charged workload keys — makes add/remove idempotent so event
-        # replays or CQ-gone teardown paths can't double-charge/release.
-        self._charged: set = set()
+        # Charge ledger: wl key -> {flavor: entries charged}. Release
+        # reads the ledger, not the passed workload object, so a stale
+        # caller copy (different admission/topology than what was
+        # charged) can't leave residual or negative domain usage; also
+        # makes add/remove idempotent under event replays.
+        self._charged: Dict[str, Dict[str, list]] = {}
         # Every TAS-intent flavor ever seen, so a Topology arriving late
         # rebinds flavors added before it.
         self._flavor_objs: Dict[str, ResourceFlavor] = {}
@@ -180,17 +196,26 @@ class TASCache:
     def add_usage(self, wl: Workload) -> None:
         if wl.key in self._charged:
             return
-        self._charged.add(wl.key)
-        for fc in self.flavors.values():
-            fc.add_usage(wl)
-        self.generation += 1
+        ledger: Dict[str, list] = {}
+        for name, fc in self.flavors.items():
+            entries = fc.charge_entries(wl)
+            if entries:
+                fc.apply_entries(entries, +1)
+                ledger[name] = entries
+        self._charged[wl.key] = ledger
+        # non-TAS workloads (empty ledger) change no domain state; don't
+        # invalidate consumers' per-generation snapshot caches for them
+        if ledger:
+            self.generation += 1
 
     def remove_usage(self, wl: Workload) -> None:
-        if wl.key not in self._charged:
+        ledger = self._charged.pop(wl.key, None)
+        if not ledger:
             return
-        self._charged.discard(wl.key)
-        for fc in self.flavors.values():
-            fc.remove_usage(wl)
+        for name, entries in ledger.items():
+            fc = self.flavors.get(name)
+            if fc is not None:
+                fc.apply_entries(entries, -1)
         self.generation += 1
 
     def snapshots(self) -> Dict[str, TASFlavorSnapshot]:

@@ -160,3 +160,64 @@ class TASManager:
                 psr.reasons.append(result.failure_reason)
                 psr.update_mode(GranularMode.NO_FIT)
         return assignment
+
+    # ---- hook 3: in-cycle admit-time re-validation ----
+    def fits(
+        self, wl: Workload, cq_name: str, assignment: AssignmentResult, snapshot
+    ) -> Optional[str]:
+        """Re-validate an entry's topology assignments against CURRENT
+        TAS usage (reference: ClusterQueueSnapshot.Fits' TAS branch,
+        pkg/cache/clusterqueue_snapshot.go:135-149).
+
+        Assignments were computed at nominate time against one shared
+        TAS snapshot; an earlier admission this cycle charges the TAS
+        cache (bumping its generation), so this check sees in-cycle
+        usage and rejects overlapping domain assignments. Returns an
+        error message, or None when everything still fits.
+        """
+        from kueue_tpu.tas.snapshot import domain_id as _domain_id
+
+        podsets = {ps.name: ps for ps in wl.pod_sets}
+        # per flavor: domain id -> usage assumed by earlier podsets of
+        # THIS workload (same accounting as find_topology_assignments)
+        assumed: Dict[str, Dict[str, Dict[str, int]]] = {}
+        for psr in assignment.pod_sets:
+            ta = psr.topology_assignment
+            if ta is None:
+                continue
+            ps = podsets.get(psr.name)
+            if ps is None:
+                continue
+            flavor_names = {c.name for c in psr.flavors.values()}
+            if len(flavor_names) != 1:
+                continue
+            flavor_name = next(iter(flavor_names))
+            if not self._is_tas_flavor(flavor_name):
+                continue
+            snap = self._snapshot_for(flavor_name)
+            req = TASPodSetRequest(
+                podset_name=psr.name,
+                count=psr.count,
+                single_pod_requests=dict(ps.requests),
+                topology_request=ps.topology_request,
+                tolerations=tuple(ps.tolerations),
+                flavor=flavor_name,
+            )
+            facc = assumed.setdefault(flavor_name, {})
+            counts = snap.podset_fit_counts(req, facc)
+            for dom in ta.domains:
+                did = _domain_id(dom.values)
+                leaf = snap.leaves.get(did)
+                if leaf is None:
+                    return (
+                        f'topology domain "{did}" of flavor "{flavor_name}"'
+                        " no longer exists"
+                    )
+                if counts[leaf.leaf_idx] < dom.count:
+                    return (
+                        "Workload no longer fits: topology domain "
+                        f'"{did}" cannot hold {dom.count} pod(s) of pod set '
+                        f"{psr.name} after in-cycle TAS admissions"
+                    )
+            snap.charge_assumed(facc, req, ta)
+        return None

@@ -492,6 +492,40 @@ class TASFlavorSnapshot:
             domains = self._update_counts_to_minimum(lower, req.count, unconstrained)
         return self._build_assignment(domains), ""
 
+    def podset_fit_counts(
+        self,
+        req: TASPodSetRequest,
+        assumed_usage: Dict[str, Dict[str, int]],
+        simulate_empty: bool = False,
+    ) -> np.ndarray:
+        """Phase-1 per-leaf pod-fit counts for one podset request —
+        the same counts find_topology_assignment places against, exposed
+        for admit-time re-validation (ClusterQueueSnapshot.Fits' TAS
+        branch). int64[L], indexed by ``leaves[did].leaf_idx``."""
+        requests = dict(req.single_pod_requests)
+        requests[PODS] = requests.get(PODS, 0) + 1
+        return self._leaf_counts(
+            requests,
+            assumed_usage,
+            simulate_empty,
+            tuple(req.tolerations) + self.tolerations,
+        )
+
+    @staticmethod
+    def charge_assumed(
+        assumed: Dict[str, Dict[str, int]],
+        req: TASPodSetRequest,
+        assignment: TopologyAssignment,
+    ) -> None:
+        """Accumulate one podset's assumed usage the way
+        find_topology_assignments does: the FULL TotalRequests() charged
+        to EVERY assigned domain (parity quirk, :383-390)."""
+        total = req.total_requests()
+        for dom in assignment.domains:
+            acc = assumed.setdefault(domain_id(dom.values), {})
+            for r, v in total.items():
+                acc[r] = acc.get(r, 0) + v
+
     # ---- multi-podset entry (FindTopologyAssignmentsForFlavor :374-392) ----
     def find_topology_assignments(
         self,
@@ -509,13 +543,5 @@ class TASFlavorSnapshot:
                 result.failure_reason = reason
                 result.failed_podset = req.podset_name
                 return result
-            # Parity quirk preserved: the reference charges the podset's
-            # FULL TotalRequests() to EVERY assigned domain
-            # (FindTopologyAssignmentsForFlavor :383-390), a conservative
-            # over-count across later podsets in the same workload.
-            total = req.total_requests()
-            for dom in assignment.domains:
-                acc = assumed.setdefault(domain_id(dom.values), {})
-                for r, v in total.items():
-                    acc[r] = acc.get(r, 0) + v
+            self.charge_assumed(assumed, req, assignment)
         return result

@@ -194,6 +194,10 @@ void quota_available_node(const int32_t* path, int path_len, int fr,
                           int64_t* out) {
   int depth = 0;
   while (depth < path_len && path[depth] >= 0) depth++;
+  if (depth == 0) {  // empty path: nothing available, no OOB read
+    for (int j = 0; j < fr; ++j) out[j] = 0;
+    return;
+  }
   for (int j = 0; j < fr; ++j) {
     int root = path[depth - 1];
     int64_t avail = subtree[root * fr + j] - usage[root * fr + j];

@@ -415,3 +415,105 @@ class TestSchedulerIntegration:
         )
         msg = manager.check(cq, ps, plain)
         assert "does not support TopologyAwareScheduling" in msg
+
+
+class TestInCycleTASRecheck:
+    """Two heads from different CQs sharing a TAS flavor must not be
+    admitted in one cycle with overlapping domain assignments
+    (reference: ClusterQueueSnapshot.Fits validates TAS usage,
+    clusterqueue_snapshot.go:135-149)."""
+
+    def _env_two_cqs(self):
+        cache = Cache()
+        qm = QueueManager(Clock())
+        topo = Topology(
+            name="default",
+            levels=(TopologyLevel(BLOCK), TopologyLevel(RACK), TopologyLevel(HOST)),
+        )
+        flavor = ResourceFlavor(name="tas-flavor", topology_name="default")
+        tas = TASCache()
+        tas.add_or_update_topology(topo)
+        cache.add_or_update_topology(topo)
+        cache.add_or_update_flavor(flavor)
+        tas.add_or_update_flavor(flavor)
+        for i, (labels, alloc) in enumerate(DEFAULT_NODES):
+            tas.add_or_update_node(Node(name=f"n{i}", labels=labels, allocatable=alloc))
+        cache.tas_cache = tas
+        for cq_name, lq_name in (("cq-a", "lq-a"), ("cq-b", "lq-b")):
+            cq = ClusterQueue(
+                name=cq_name,
+                namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",), (FlavorQuotas.build("tas-flavor", {"cpu": "24"}),)
+                    ),
+                ),
+            )
+            cache.add_or_update_cluster_queue(cq)
+            qm.add_cluster_queue(cq)
+            lq = LocalQueue(namespace="ns", name=lq_name, cluster_queue=cq_name)
+            cache.add_or_update_local_queue(lq)
+            qm.add_local_queue(lq)
+        manager = TASManager(tas, cache.flavors)
+        sched = Scheduler(
+            queues=qm, cache=cache, clock=Clock(),
+            tas_check=manager.check, tas_assign=manager.assign,
+            tas_fits=manager.fits,
+        )
+        return sched, qm, cache, tas
+
+    @staticmethod
+    def _rack_workload(name, lq_name, t):
+        # 12 cpu in one rack: only r3 (h4+h5+h6, 12 cpu) can hold it
+        tr = PodSetTopologyRequest(mode="Required", level=RACK)
+        return Workload(
+            namespace="ns", name=name, queue_name=lq_name, creation_time=t,
+            pod_sets=(PodSet.build("main", 12, {"cpu": "1"}, topology_request=tr),),
+        )
+
+    def test_overlapping_heads_not_both_admitted(self):
+        sched, qm, cache, tas = self._env_two_cqs()
+        qm.add_or_update_workload(self._rack_workload("wa", "lq-a", 0.0))
+        qm.add_or_update_workload(self._rack_workload("wb", "lq-b", 1.0))
+        res = sched.schedule()
+        # only one fits in rack r3; the other is skipped this cycle
+        assert len(res.admitted) == 1
+        assert res.admitted[0].workload.name == "wa"
+        skipped = [e for e in res.requeued if e.workload.name == "wb"]
+        assert skipped and "no longer fits" in skipped[0].inadmissible_msg.lower()
+        # domains are NOT over-subscribed: total charged in r3 <= 12 cpu
+        fc = tas.flavors["tas-flavor"]
+        total = sum(
+            acc.get("cpu", 0) for acc in fc._usage.values()
+        )
+        assert total == 12000
+
+    def test_non_overlapping_heads_both_admitted(self):
+        sched, qm, cache, tas = self._env_two_cqs()
+        tr = PodSetTopologyRequest(mode="Required", level=RACK)
+        # 8-cpu rack workload -> r1 (h1+h2); 12-cpu rack workload -> r3
+        wa = Workload(
+            namespace="ns", name="wa", queue_name="lq-a", creation_time=0.0,
+            pod_sets=(PodSet.build("main", 12, {"cpu": "1"}, topology_request=tr),),
+        )
+        wb = Workload(
+            namespace="ns", name="wb", queue_name="lq-b", creation_time=1.0,
+            pod_sets=(PodSet.build("main", 8, {"cpu": "1"}, topology_request=tr),),
+        )
+        qm.add_or_update_workload(wa)
+        qm.add_or_update_workload(wb)
+        res = sched.schedule()
+        assert sorted(e.workload.name for e in res.admitted) == ["wa", "wb"]
+
+    def test_skipped_head_admits_next_cycle(self):
+        sched, qm, cache, tas = self._env_two_cqs()
+        qm.add_or_update_workload(self._rack_workload("wa", "lq-a", 0.0))
+        qm.add_or_update_workload(self._rack_workload("wb", "lq-b", 1.0))
+        sched.schedule()
+        # wa finishes; its TAS usage is released
+        wa = next(iter(cache.cluster_queues["cq-a"].workloads.values()))
+        cache.delete_workload(wa)
+        qm.queue_associated_inadmissible_workloads_after("cq-a")
+        qm.queue_associated_inadmissible_workloads_after("cq-b")
+        res = sched.schedule()
+        assert [e.workload.name for e in res.admitted] == ["wb"]
