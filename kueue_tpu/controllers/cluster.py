@@ -50,6 +50,8 @@ class ClusterRuntime:
         manage_jobs_without_queue_name: bool = False,
         fair_sharing: bool = False,
         tas_cache=None,
+        use_solver: Optional[bool] = None,
+        solver_threshold: int = 16,
     ):
         from kueue_tpu.metrics import Metrics
 
@@ -87,6 +89,8 @@ class ClusterRuntime:
             tas_assign=tas_assign,
             tas_fits=tas_fits,
             events=lambda kind, wl, msg: self.event(kind, wl, msg),
+            use_solver=use_solver,
+            solver_threshold=solver_threshold,
         )
         self.job_reconciler = JobReconciler(
             self,

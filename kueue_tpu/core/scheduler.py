@@ -87,6 +87,20 @@ class CycleResult:
     preempting: List[Entry] = field(default_factory=list)
     requeued: List[Entry] = field(default_factory=list)
     skipped_preemptions: Dict[str, int] = field(default_factory=dict)
+    # which conflict-resolution path ran: "device" (TPU phase-2 scan)
+    # or "host" (sequential admit loop)
+    resolution: str = "host"
+
+
+@dataclass
+class DevicePlan:
+    """Device phase-2 outcome for a pure cycle: the admitted flags and
+    entry order computed by ops/assign_kernel.solve_cycle, replayed by
+    the host for bookkeeping only (no quota re-checks)."""
+
+    entries: List[Entry]
+    admitted: "np.ndarray"  # bool[W]
+    order: "np.ndarray"  # int32[>=W], device entry order
 
 
 class Scheduler:
@@ -105,6 +119,8 @@ class Scheduler:
         tas_fits=None,
         events: Optional[Callable[[str, Workload, str], None]] = None,
         limit_range_validate: Optional[Callable[[Workload], Optional[str]]] = None,
+        use_solver: Optional[bool] = None,
+        solver_threshold: int = 16,
     ):
         self.queues = queues
         self.cache = cache
@@ -126,6 +142,11 @@ class Scheduler:
         self.tas_fits = tas_fits
         self.events = events or (lambda kind, wl, msg: None)
         self.limit_range_validate = limit_range_validate
+        # Batched TPU solver as the production nomination path: None =
+        # auto (on when the cycle has >= solver_threshold assignable
+        # heads), True = always, False = never (host-only oracle path).
+        self.use_solver = use_solver
+        self.solver_threshold = solver_threshold
         self.scheduling_cycle = 0
 
     # ---- the cycle (scheduler.go:176-310) ----
@@ -138,7 +159,9 @@ class Scheduler:
             return result
 
         snapshot = take_snapshot(self.cache)
-        entries = self._nominate(heads, snapshot)
+        entries, device_plan = self._nominate(heads, snapshot)
+        if device_plan is not None:
+            return self._finalize_device(entries, device_plan, snapshot, result)
         ordered = self._iterate(entries, snapshot)
 
         preempted_keys: Dict[str, WorkloadSnapshot] = {}
@@ -244,16 +267,52 @@ class Scheduler:
         return result
 
     # ---- nomination (scheduler.go:344-378) ----
-    def _nominate(self, heads: List[Workload], snapshot: Snapshot) -> List[Entry]:
-        entries: List[Entry] = []
-        flavors = self.cache.flavors
-        assigner = FlavorAssigner(
+    def _nominate(
+        self, heads: List[Workload], snapshot: Snapshot
+    ) -> Tuple[List[Entry], Optional[DevicePlan]]:
+        entries, to_assign = self._prevalidate(heads, snapshot)
+        if self._solver_enabled(len(to_assign)):
+            plan = self._assign_with_solver(to_assign, snapshot)
+            return entries, plan
+        assigner = self._make_assigner(snapshot)
+        for e in to_assign:
+            self._host_assign(assigner, e, snapshot)
+        return entries, None
+
+    def _solver_enabled(self, n_assignable: int) -> bool:
+        if self.use_solver is False or n_assignable == 0:
+            return False
+        if self.use_solver is True:
+            return True
+        return n_assignable >= self.solver_threshold
+
+    def _make_assigner(self, snapshot: Snapshot) -> FlavorAssigner:
+        return FlavorAssigner(
             snapshot,
-            flavors,
+            self.cache.flavors,
             enable_fair_sharing=self.fair_sharing,
             reclaim_oracle=functools.partial(self._reclaim_oracle, snapshot),
             tas_check=self.tas_check,
         )
+
+    def _host_assign(
+        self, assigner: FlavorAssigner, e: Entry, snapshot: Snapshot
+    ) -> None:
+        assignment, targets = self._get_assignments(
+            assigner, e.workload, e.cq_name, snapshot
+        )
+        e.assignment = assignment
+        e.preemption_targets = targets
+        e.inadmissible_msg = assignment.message()
+        e.workload.last_assignment = assignment.last_state
+
+    def _prevalidate(
+        self, heads: List[Workload], snapshot: Snapshot
+    ) -> Tuple[List[Entry], List[Entry]]:
+        """Per-head admission preconditions (scheduler.go:361-369).
+        Returns (all entries, the subset needing flavor assignment)."""
+        entries: List[Entry] = []
+        to_assign: List[Entry] = []
         for wl in heads:
             cq_name = self.queues.cluster_queue_for_workload(wl) or ""
             e = Entry(workload=wl, cq_name=cq_name)
@@ -286,14 +345,181 @@ class Scheduler:
                 if err:
                     e.inadmissible_msg = err
                     continue
-            assignment, targets = self._get_assignments(
-                assigner, wl, cq_name, snapshot
+            to_assign.append(e)
+        return entries, to_assign
+
+    # ---- batched nomination on the device (the production hot path) ----
+    def _assign_with_solver(
+        self, to_assign: List[Entry], snapshot: Snapshot
+    ) -> Optional[DevicePlan]:
+        """Nominate every assignable head in one device dispatch
+        (ops/assign_kernel.solve_cycle); heads the dense formulation
+        can't represent — multi-podset, non-default fungibility, TAS,
+        candidate overflow — and heads the kernel classifies non-Fit
+        (potential preemption) fall back to the host FlavorAssigner,
+        which remains the decision authority for them.
+
+        Returns a DevicePlan when the whole cycle is resolvable from
+        the device phase-2 scan (every host-path entry is NO_FIT with
+        no preemption targets, so no usage interleaving outside the
+        device model); otherwise None, and the host admit loop runs
+        over the device-assigned entries.
+        """
+        from kueue_tpu.core.solver import dispatch_lowered, lower_heads
+
+        heads = [(e.workload, e.cq_name) for e in to_assign]
+        lowered = lower_heads(
+            snapshot,
+            heads,
+            self.cache.flavors,
+            timestamp_fn=lambda wl: queue_order_timestamp(wl, self.queues._ts_policy),
+        )
+        fallback = set(lowered.fallback)
+        if len(fallback) == len(to_assign):
+            # nothing representable: skip the device dispatch entirely
+            assigner = self._make_assigner(snapshot)
+            for e in to_assign:
+                self._host_assign(assigner, e, snapshot)
+            return None
+        res = dispatch_lowered(snapshot, lowered)
+        chosen = np.asarray(res.chosen)
+        host_idx = [
+            i
+            for i in range(len(to_assign))
+            if i in fallback or chosen[i] < 0
+        ]
+        if host_idx:
+            assigner = self._make_assigner(snapshot)
+            for i in host_idx:
+                self._host_assign(assigner, to_assign[i], snapshot)
+        host_set = set(host_idx)
+        for i, e in enumerate(to_assign):
+            if i in host_set:
+                continue
+            e.assignment = self._assignment_from_device(
+                lowered, i, int(chosen[i]), snapshot
             )
-            e.assignment = assignment
-            e.preemption_targets = targets
-            e.inadmissible_msg = assignment.message()
-            wl.last_assignment = assignment.last_state
-        return entries
+            e.workload.last_assignment = e.assignment.last_state
+
+        # Pure cycle: nothing host-side can mutate usage, so the device
+        # scan's admitted flags ARE the cycle outcome.
+        pure = (
+            not self.fair_sharing
+            and all(
+                to_assign[i].assignment.representative_mode() == Mode.NO_FIT
+                and not to_assign[i].preemption_targets
+                for i in host_idx
+            )
+        )
+        if not pure:
+            return None
+        return DevicePlan(
+            entries=to_assign,
+            admitted=np.asarray(res.admitted),
+            order=np.asarray(res.order),
+        )
+
+    def _assignment_from_device(
+        self,
+        lowered,
+        i: int,
+        k: int,
+        snapshot: Snapshot,
+    ) -> AssignmentResult:
+        """Reconstruct the host-equivalent FIT AssignmentResult from the
+        kernel's chosen candidate (single podset, default fungibility —
+        lower_heads guarantees these invariants for non-fallback heads)."""
+        from kueue_tpu.core.flavor_assigner import (
+            AssignmentState,
+            FlavorChoice,
+            GranularMode,
+            PodSetResult,
+        )
+        from kueue_tpu.core.workload_info import effective_podset_count
+
+        wl = lowered.heads[i]
+        cq_name = lowered.cq_names[i]
+        ps = wl.pod_sets[0]
+        count = effective_podset_count(wl, ps)
+        flavor_map = lowered.candidate_flavors[i][k]
+        tried_map = lowered.candidate_tried[i][k]
+        r = snapshot.row(cq_name)
+        psr = PodSetResult(name=ps.name, count=count)
+        usage: Dict = {}
+        result = AssignmentResult(pod_sets=[psr])
+        cells = lowered.cells[i, k]
+        qty = lowered.qty[i, k]
+        for c in range(cells.shape[0]):
+            j = int(cells[c])
+            if j < 0:
+                continue
+            fr = snapshot.fr_list[j]
+            q = int(qty[c])
+            usage[fr] = usage.get(fr, 0) + q
+            # per-resource borrow flag (flavorassigner.go:698): request
+            # pushes the CQ above nominal in this cell
+            borrow = bool(
+                snapshot.local_usage[r, j] + q > snapshot.nominal[r, j]
+            ) and snapshot.has_cohort(cq_name)
+            if borrow:
+                result.borrowing = True
+            psr.flavors[fr.resource] = FlavorChoice(
+                name=fr.flavor,
+                mode=GranularMode.FIT,
+                tried_flavor_idx=tried_map.get(fr.resource, -1),
+                borrow=borrow,
+            )
+        result.usage = usage
+        result.last_state = AssignmentState(
+            last_tried_flavor_idx=[dict(tried_map)],
+            cluster_queue_generation=snapshot.generations.get(cq_name, 0),
+        )
+        return result
+
+    def _finalize_device(
+        self,
+        entries: List[Entry],
+        plan: DevicePlan,
+        snapshot: Snapshot,
+        result: CycleResult,
+    ) -> CycleResult:
+        """Replay the device phase-2 outcome: admit flagged entries in
+        device order (bookkeeping only — the scan already resolved
+        conflicts), skip Fit entries the scan rejected, requeue the
+        rest. Mirrors the tail of the host loop (scheduler.go:211-292)
+        minus the per-entry quota re-checks."""
+        result.resolution = "device"
+        for idx in plan.order:
+            if idx >= len(plan.entries):
+                continue  # padding rows
+            e = plan.entries[int(idx)]
+            if e.assignment is None:
+                continue
+            if e.assignment.representative_mode() != Mode.FIT:
+                continue
+            if bool(plan.admitted[int(idx)]):
+                snapshot.add_usage(
+                    e.cq_name, snapshot.vector_of(e.assignment.usage)
+                )
+                if self.wait_for_pods_ready_block and self.cache.workloads_not_ready:
+                    e.status = EntryStatus.SKIPPED
+                    e.inadmissible_msg = (
+                        "waiting for all admitted workloads to be in PodsReady condition"
+                    )
+                    continue
+                e.status = EntryStatus.NOMINATED
+                if self._admit(e, snapshot):
+                    result.admitted.append(e)
+            else:
+                e.status = EntryStatus.SKIPPED
+                e.inadmissible_msg = (
+                    "Workload no longer fits after processing another workload"
+                )
+        for e in entries:
+            if e.status != EntryStatus.ASSUMED:
+                self._requeue_and_update(e)
+                result.requeued.append(e)
+        return result
 
     def _is_admitted(self, wl: Workload) -> bool:
         cached = self.cache.cluster_queues.get(
@@ -358,7 +584,9 @@ class Scheduler:
     def _entry_sort_key(self, e: Entry):
         borrows = e.assignment.borrowing if e.assignment else False
         prio = priority_of(e.workload, self.cache.priority_classes)
-        ts = queue_order_timestamp(e.workload, self.queues._ts_policy)
+        # int-ns, matching the heap ranks and the device lexsort key so
+        # every ordering surface agrees on near-ties
+        ts = int(queue_order_timestamp(e.workload, self.queues._ts_policy) * 1e9)
         return (1 if borrows else 0, -prio, ts)
 
     # ---- usage re-check (scheduler.go:380-388) ----

@@ -48,6 +48,10 @@ class Lowered:
     no_reclaim: np.ndarray  # bool[W] — reserve capacity when blocked
     # per head: candidate k -> flavor name chosen per resource group
     candidate_flavors: List[List[Dict[str, str]]] = field(default_factory=list)
+    # per head: candidate k -> resource -> host-equivalent tried-flavor
+    # cursor (LastAssignment idx; -1 when the chosen flavor is the last
+    # of its resource group, matching _find_flavor_for_resource)
+    candidate_tried: List[List[Dict[str, int]]] = field(default_factory=list)
     heads: List[Workload] = field(default_factory=list)
     cq_names: List[str] = field(default_factory=list)
     fallback: List[int] = field(default_factory=list)  # indices into input heads
@@ -87,6 +91,7 @@ def lower_heads(
         out.heads.append(wl)
         out.cq_names.append(cq_name)
         out.candidate_flavors.append([])
+        out.candidate_tried.append([])
         if cq_name not in snapshot.cq_models:
             out.fallback.append(i)
             continue
@@ -121,7 +126,7 @@ def lower_heads(
         gen = snapshot.generations.get(cq_name, 0)
         if state is not None and gen > state.cluster_queue_generation:
             state = None
-        per_rg: List[List[Tuple[str, Dict[str, int]]]] = []
+        per_rg: List[List[Tuple[str, Dict[str, int], int]]] = []
         representable = True
         for rg, rg_req in touched:
             label_keys = group_label_keys(rg.flavors, flavors)
@@ -129,8 +134,10 @@ def lower_heads(
             if state is not None:
                 first_res = sorted(rg_req)[0]
                 start = state.next_flavor_to_try(0, first_res)
-            options: List[Tuple[str, Dict[str, int]]] = []
-            for fq in rg.flavors[start:]:
+            n_flavors = len(rg.flavors)
+            options: List[Tuple[str, Dict[str, int], int]] = []
+            for gi in range(start, n_flavors):
+                fq = rg.flavors[gi]
                 flavor = flavors.get(fq.name)
                 if flavor is not None and flavor.topology_name is not None:
                     # TAS flavors (incl. implied TAS on TAS-only CQs)
@@ -139,7 +146,10 @@ def lower_heads(
                     representable = False
                     break
                 if flavor_eligible(flavor, ps, label_keys):
-                    options.append((fq.name, rg_req))
+                    # host cursor semantics: a FIT at the group's last
+                    # flavor stores -1 (restart from 0 next time)
+                    tried = -1 if gi == n_flavors - 1 else gi
+                    options.append((fq.name, rg_req, tried))
             if not representable:
                 break
             if not options:
@@ -161,7 +171,7 @@ def lower_heads(
         # cartesian product across RGs in reference order (first RG's
         # flavor walk is the outer loop — matches the sequential search
         # trying RG1 flavors fully per RG0 choice)
-        combos: List[List[Tuple[str, Dict[str, int]]]] = [[]]
+        combos: List[List[Tuple[str, Dict[str, int], int]]] = [[]]
         for options in per_rg:
             combos = [prev + [opt] for prev in combos for opt in options]
 
@@ -174,9 +184,10 @@ def lower_heads(
         out.timestamp[i] = int(ts * 1e9)
         for ki, combo in enumerate(combos):
             flavor_map: Dict[str, str] = {}
+            tried_map: Dict[str, int] = {}
             ci = 0
             ok = True
-            for fname, rg_req in combo:
+            for fname, rg_req, tried in combo:
                 for r, q in sorted(rg_req.items()):
                     j = snapshot.fr_index.get(FlavorResource(fname, r))
                     if j is None:
@@ -185,16 +196,19 @@ def lower_heads(
                     out.cells[i, ki, ci] = j
                     out.qty[i, ki, ci] = q
                     flavor_map[r] = fname
+                    tried_map[r] = tried
                     ci += 1
                 if not ok:
                     break
             if ok:
                 out.valid[i, ki] = True
                 out.candidate_flavors[i].append(flavor_map)
+                out.candidate_tried[i].append(tried_map)
             else:
                 out.cells[i, ki, :] = -1
                 out.qty[i, ki, :] = 0
                 out.candidate_flavors[i].append({})
+                out.candidate_tried[i].append({})
         if not out.valid[i].any():
             out.cq_row[i] = -1
             out.fallback.append(i)
@@ -219,6 +233,61 @@ def tree_arrays(snapshot: Snapshot):
     return tree, paths
 
 
+def _bucket(w: int, minimum: int = 64) -> int:
+    """Round the head count up to a power-of-two bucket so the jit
+    solver compiles once per bucket, not once per distinct head count
+    (workload arrival is continuous; XLA shapes are static)."""
+    n = minimum
+    while n < w:
+        n *= 2
+    return n
+
+
+def dispatch_lowered(
+    snapshot: Snapshot,
+    lowered: Lowered,
+    pad_heads: bool = True,
+):
+    """Ship an already-lowered batch to the device solver.
+
+    Padding rows (cq_row == -1) are inert in both solver phases, so the
+    first ``len(lowered.heads)`` result entries map 1:1 onto the input
+    heads.
+    """
+    import numpy as np
+
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.ops.assign_kernel import HeadsBatch, solve_cycle_jit
+
+    w = len(lowered.heads)
+    w_pad = _bucket(w) if pad_heads else w
+    cq_row, cells, qty = lowered.cq_row, lowered.cells, lowered.qty
+    valid, priority = lowered.valid, lowered.priority
+    timestamp, no_reclaim = lowered.timestamp, lowered.no_reclaim
+    if w_pad > w:
+        pad = w_pad - w
+        cq_row = np.concatenate([cq_row, np.full(pad, -1, dtype=np.int32)])
+        cells = np.concatenate(
+            [cells, np.full((pad,) + cells.shape[1:], -1, dtype=np.int32)]
+        )
+        qty = np.concatenate([qty, np.zeros((pad,) + qty.shape[1:], dtype=np.int64)])
+        valid = np.concatenate([valid, np.zeros((pad,) + valid.shape[1:], dtype=bool)])
+        priority = np.concatenate([priority, np.zeros(pad, dtype=np.int64)])
+        timestamp = np.concatenate([timestamp, np.zeros(pad, dtype=np.int64)])
+        no_reclaim = np.concatenate([no_reclaim, np.zeros(pad, dtype=bool)])
+    tree, paths = tree_arrays(snapshot)
+    batch = HeadsBatch(
+        cq_row=jnp.asarray(cq_row),
+        cells=jnp.asarray(cells),
+        qty=jnp.asarray(qty),
+        valid=jnp.asarray(valid),
+        priority=jnp.asarray(priority),
+        timestamp=jnp.asarray(timestamp),
+        no_reclaim=jnp.asarray(no_reclaim),
+    )
+    return solve_cycle_jit(tree, jnp.asarray(snapshot.local_usage), batch, paths)
+
+
 def solve_heads(
     snapshot: Snapshot,
     heads: Sequence[Tuple[Workload, str]],
@@ -226,23 +295,10 @@ def solve_heads(
     max_candidates: int = 8,
     max_cells: int = 16,
     timestamp_fn=None,
+    pad_heads: bool = True,
 ):
     """One-call convenience: lower, dispatch, return (Lowered, SolveResult)."""
-    from kueue_tpu._jax import jnp
-    from kueue_tpu.ops.assign_kernel import HeadsBatch, solve_cycle_jit
-
     lowered = lower_heads(
         snapshot, heads, flavors, max_candidates, max_cells, timestamp_fn
     )
-    tree, paths = tree_arrays(snapshot)
-    batch = HeadsBatch(
-        cq_row=jnp.asarray(lowered.cq_row),
-        cells=jnp.asarray(lowered.cells),
-        qty=jnp.asarray(lowered.qty),
-        valid=jnp.asarray(lowered.valid),
-        priority=jnp.asarray(lowered.priority),
-        timestamp=jnp.asarray(lowered.timestamp),
-        no_reclaim=jnp.asarray(lowered.no_reclaim),
-    )
-    result = solve_cycle_jit(tree, jnp.asarray(snapshot.local_usage), batch, paths)
-    return lowered, result
+    return lowered, dispatch_lowered(snapshot, lowered, pad_heads)

@@ -82,13 +82,17 @@ class SolveResult(NamedTuple):
     """chosen: int32[W] candidate index (-1 = no fit in phase 1).
     admitted: bool[W]; borrows: bool[W] (of the chosen candidate);
     reserved: bool[W] — blocked preempt-mode head reserved capacity;
-    usage: int64[N,FR] final leaf usage after all admissions."""
+    usage: int64[N,FR] final leaf usage after all admissions;
+    order: int32[W] — the admission entry order used by phase 2
+    (scheduler.go:575-599), so the host can replay bookkeeping in the
+    same sequence."""
 
     chosen: jnp.ndarray
     admitted: jnp.ndarray
     borrows: jnp.ndarray
     reserved: jnp.ndarray
     usage: jnp.ndarray
+    order: jnp.ndarray
 
 
 def build_paths(parent, max_depth: int):
@@ -350,6 +354,7 @@ def solve_cycle(
         borrows=head_borrow,
         reserved=reserved,
         usage=usage_final,
+        order=order.astype(jnp.int32),
     )
 
 

@@ -42,13 +42,20 @@ class RunResult:
         return sum(vals) / len(vals) if vals else 0.0
 
 
-def run(config: GeneratorConfig, max_virtual_s: float = 100_000.0) -> RunResult:
+def run(
+    config: GeneratorConfig,
+    max_virtual_s: float = 100_000.0,
+    use_solver: Optional[bool] = None,
+) -> RunResult:
     scenario = generate(config)
     clock = FakeClock(0.0)
     cache = Cache()
     queues = QueueManager(clock)
     preemptor = Preemptor(clock)
-    sched = Scheduler(queues=queues, cache=cache, clock=clock, preemptor=preemptor)
+    sched = Scheduler(
+        queues=queues, cache=cache, clock=clock, preemptor=preemptor,
+        use_solver=use_solver,
+    )
 
     cache.add_or_update_flavor(scenario.flavor)
     for cq in scenario.cluster_queues:

@@ -53,3 +53,14 @@ class TestRunner:
             result, RangeSpec(wl_classes_max_avg_tta_s={"small": -1.0})
         )
         assert errs and "small" in errs[0]
+
+
+class TestSolverRunnerParity:
+    def test_scaled_run_solver_matches_host(self):
+        cfg = DEFAULT_GENERATOR_CONFIG.scaled(0.08)
+        host = run(cfg, use_solver=False)
+        dev = run(cfg, use_solver=True)
+        assert dev.admitted == host.admitted == dev.total
+        # identical admission decisions: per-class TTA lists match exactly
+        assert dev.time_to_admission == host.time_to_admission
+        assert dev.cq_avg_utilization == host.cq_avg_utilization

@@ -1,0 +1,391 @@
+"""Production solver-path parity: Scheduler with use_solver=True must
+make decisions identical to the host-only path (use_solver=False is the
+decision oracle — reference semantics per pkg/scheduler/scheduler.go).
+
+Scenarios are built twice from one spec (fresh objects per run) and
+drained cycle-by-cycle; per-cycle admitted order, assigned flavors,
+usage, skip/requeue outcomes and final cache state must match exactly.
+"""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.models import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import Preemption
+from kueue_tpu.models.constants import PreemptionPolicy, ReclaimWithinCohortPolicy
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.preemption import Preemptor
+from kueue_tpu.core.queue_manager import QueueManager
+from kueue_tpu.core.scheduler import Scheduler
+from kueue_tpu.utils.clock import FakeClock
+
+
+def build_env(spec, use_solver):
+    """spec: dict with cohorts, cqs, flavors, workloads (pure data, so
+    both environments get independent-but-identical objects)."""
+    clock = FakeClock(1000.0)
+    cache = Cache()
+    for fname in spec["flavors"]:
+        cache.add_or_update_flavor(ResourceFlavor(name=fname))
+    mgr = QueueManager(clock=clock)
+    for cq_spec in spec["cqs"]:
+        groups = []
+        for rg in cq_spec["groups"]:
+            groups.append(
+                ResourceGroup(
+                    tuple(rg["resources"]),
+                    tuple(
+                        FlavorQuotas.build(
+                            f, {r: (v, bl, ll) for r, v in q.items()}
+                        )
+                        for f, q, bl, ll in rg["flavors"]
+                    ),
+                )
+            )
+        cq = ClusterQueue(
+            name=cq_spec["name"],
+            cohort=cq_spec.get("cohort"),
+            namespace_selector={},
+            resource_groups=tuple(groups),
+            preemption=cq_spec.get("preemption") or Preemption(),
+        )
+        cache.add_or_update_cluster_queue(cq)
+        mgr.add_cluster_queue(cq)
+        mgr.add_local_queue(
+            LocalQueue(
+                namespace="ns", name=f"lq-{cq.name}", cluster_queue=cq.name
+            )
+        )
+    preemptor = Preemptor(clock)
+    sched = Scheduler(
+        queues=mgr,
+        cache=cache,
+        clock=clock,
+        preemptor=preemptor,
+        use_solver=use_solver,
+        solver_threshold=1,
+    )
+    workloads = {}
+    for w in spec["workloads"]:
+        wl = Workload(
+            namespace="ns",
+            name=w["name"],
+            queue_name=w["queue"],
+            priority=w.get("prio", 0),
+            creation_time=w["t"],
+            pod_sets=tuple(
+                PodSet.build(ps["name"], ps["count"], dict(ps["requests"]))
+                for ps in w["pod_sets"]
+            ),
+        )
+        workloads[w["name"]] = wl
+        mgr.add_or_update_workload(wl)
+    return sched, mgr, cache, workloads
+
+
+def drain_and_trace(sched, mgr, cache, max_cycles=60):
+    """Run cycles to quiescence; return the decision trace."""
+    trace = []
+    for _ in range(max_cycles):
+        res = sched.schedule()
+        cycle = {
+            "admitted": [
+                (
+                    e.workload.name,
+                    e.cq_name,
+                    tuple(
+                        sorted(
+                            (psa.name, tuple(sorted(psa.flavors.items())), psa.count)
+                            for psa in e.workload.admission.pod_set_assignments
+                        )
+                    ),
+                )
+                for e in res.admitted
+            ],
+            "preempting": sorted(e.workload.name for e in res.preempting),
+            "skipped": sorted(
+                e.workload.name
+                for e in res.requeued
+                if "no longer fits" in (e.inadmissible_msg or "")
+            ),
+        }
+        trace.append(cycle)
+        if not res.admitted and not res.preempting:
+            # nothing moved; drain parked entries once then stop
+            moved = False
+            for cq_name in list(mgr.cluster_queues):
+                moved = (
+                    mgr.queue_associated_inadmissible_workloads_after(cq_name)
+                    or moved
+                )
+            if not moved:
+                break
+    final = {
+        name: sorted(cached.workloads) for name, cached in cache.cluster_queues.items()
+    }
+    return trace, final
+
+
+def assert_parity(spec):
+    s_host, m_host, c_host, _ = build_env(spec, use_solver=False)
+    s_dev, m_dev, c_dev, _ = build_env(spec, use_solver=True)
+    host_trace, host_final = drain_and_trace(s_host, m_host, c_host)
+    dev_trace, dev_final = drain_and_trace(s_dev, m_dev, c_dev)
+    assert dev_trace == host_trace
+    assert dev_final == host_final
+    return host_trace
+
+
+def random_spec(seed, with_preemption=False, n_cohorts=2, cqs_per_cohort=3,
+                n_flavors=3, workloads_per_cq=6):
+    rng = np.random.default_rng(seed)
+    flavors = [f"fl-{i}" for i in range(n_flavors)]
+    cqs = []
+    workloads = []
+    t = 0.0
+    for ci in range(n_cohorts):
+        for qi in range(cqs_per_cohort):
+            name = f"cq-{ci}-{qi}"
+            k = int(rng.integers(1, n_flavors + 1))
+            fls = []
+            for f in flavors[:k]:
+                quota = {"cpu": str(int(rng.integers(4, 16)))}
+                bl = (
+                    str(int(rng.integers(0, 10)))
+                    if rng.random() < 0.4
+                    else None
+                )
+                ll = (
+                    str(int(rng.integers(0, 6)))
+                    if rng.random() < 0.3
+                    else None
+                )
+                fls.append((f, quota, bl, ll))
+            preemption = None
+            if with_preemption and rng.random() < 0.5:
+                preemption = Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+                )
+            cqs.append(
+                {
+                    "name": name,
+                    "cohort": f"cohort-{ci}",
+                    "groups": [{"resources": ["cpu"], "flavors": fls}],
+                    "preemption": preemption,
+                }
+            )
+            for wi in range(workloads_per_cq):
+                t += 1.0
+                workloads.append(
+                    {
+                        "name": f"wl-{ci}-{qi}-{wi}",
+                        "queue": f"lq-{name}",
+                        "prio": int(rng.integers(0, 4)) * 10,
+                        "t": t,
+                        "pod_sets": [
+                            {
+                                "name": "main",
+                                "count": int(rng.integers(1, 4)),
+                                "requests": {"cpu": str(int(rng.integers(1, 6)))},
+                            }
+                        ],
+                    }
+                )
+    return {"flavors": flavors, "cqs": cqs, "workloads": workloads}
+
+
+class TestSolverPathParity:
+    @pytest.mark.parametrize("seed", range(12))
+    def test_randomized_fit_only(self, seed):
+        trace = assert_parity(random_spec(seed))
+        assert any(c["admitted"] for c in trace)
+
+    @pytest.mark.parametrize("seed", range(12, 20))
+    def test_randomized_with_preemption(self, seed):
+        assert_parity(random_spec(seed, with_preemption=True))
+
+    def test_multi_resource_groups(self):
+        # two resource groups (cpu+memory | gpu) exercises the cartesian
+        # candidate enumeration
+        spec = {
+            "flavors": ["fa", "fb", "ga"],
+            "cqs": [
+                {
+                    "name": "cq-x",
+                    "cohort": "co",
+                    "groups": [
+                        {
+                            "resources": ["cpu", "memory"],
+                            "flavors": [
+                                ("fa", {"cpu": "4", "memory": "8Gi"}, None, None),
+                                ("fb", {"cpu": "8", "memory": "16Gi"}, None, None),
+                            ],
+                        },
+                        {
+                            "resources": ["gpu"],
+                            "flavors": [("ga", {"gpu": "2"}, None, None)],
+                        },
+                    ],
+                    "preemption": None,
+                },
+                {
+                    "name": "cq-y",
+                    "cohort": "co",
+                    "groups": [
+                        {
+                            "resources": ["cpu", "memory"],
+                            "flavors": [
+                                ("fa", {"cpu": "6", "memory": "12Gi"}, None, None)
+                            ],
+                        }
+                    ],
+                    "preemption": None,
+                },
+            ],
+            "workloads": [
+                {
+                    "name": f"w{i}",
+                    "queue": "lq-cq-x" if i % 2 == 0 else "lq-cq-y",
+                    "prio": i % 3,
+                    "t": float(i),
+                    "pod_sets": [
+                        {
+                            "name": "main",
+                            "count": 1 + i % 2,
+                            "requests": (
+                                {"cpu": "2", "memory": "4Gi", "gpu": "1"}
+                                if i % 4 == 0
+                                else {"cpu": "3", "memory": "2Gi"}
+                            ),
+                        }
+                    ],
+                }
+                for i in range(10)
+            ],
+        }
+        assert_parity(spec)
+
+
+class TestDeviceResolution:
+    def test_pure_cycle_resolves_on_device(self):
+        spec = random_spec(99)
+        sched, mgr, cache, _ = build_env(spec, use_solver=True)
+        res = sched.schedule()
+        assert res.resolution == "device"
+        assert res.admitted
+
+    def test_host_resolution_when_preemption_possible(self):
+        # one CQ full of low-prio work + a high-prio head that must
+        # preempt: the cycle needs the host loop
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq",
+                    "cohort": None,
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "4"}, None, None)]}
+                    ],
+                    "preemption": Preemption(
+                        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+                    ),
+                }
+            ],
+            "workloads": [
+                {
+                    "name": "low",
+                    "queue": "lq-cq",
+                    "prio": 0,
+                    "t": 0.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "4"}}
+                    ],
+                }
+            ],
+        }
+        sched, mgr, cache, wls = build_env(spec, use_solver=True)
+        r = sched.schedule()
+        assert [e.workload.name for e in r.admitted] == ["low"]
+        # now a high-prio head that requires preemption
+        high = Workload(
+            namespace="ns", name="high", queue_name="lq-cq", priority=100,
+            creation_time=5.0,
+            pod_sets=(PodSet.build("main", 1, {"cpu": "4"}),),
+        )
+        mgr.add_or_update_workload(high)
+        r2 = sched.schedule()
+        assert r2.resolution == "host"
+        assert [e.workload.name for e in r2.preempting] == ["high"]
+
+    def test_solver_off_never_uses_device(self):
+        spec = random_spec(7)
+        sched, mgr, cache, _ = build_env(spec, use_solver=False)
+        res = sched.schedule()
+        assert res.resolution == "host"
+
+    def test_auto_threshold(self):
+        spec = random_spec(3, n_cohorts=1, cqs_per_cohort=2, workloads_per_cq=1)
+        sched, mgr, cache, _ = build_env(spec, use_solver=None)
+        sched.solver_threshold = 16  # 2 heads < 16 -> host
+        res = sched.schedule()
+        assert res.resolution == "host"
+
+
+class TestCursorParity:
+    def test_requeued_fit_head_keeps_host_cursor(self):
+        # two CQs in a cohort with limited shared capacity; both heads
+        # FIT at nominate time but only one survives phase 2 -> the
+        # skipped one's LastAssignment cursor must match the host path
+        spec = {
+            "flavors": ["f1", "f2"],
+            "cqs": [
+                {
+                    "name": f"cq-{i}",
+                    "cohort": "co",
+                    "groups": [
+                        {
+                            "resources": ["cpu"],
+                            "flavors": [
+                                ("f1", {"cpu": "2"}, None, None),
+                                ("f2", {"cpu": "2"}, None, None),
+                            ],
+                        }
+                    ],
+                    "preemption": None,
+                }
+                for i in range(2)
+            ],
+            "workloads": [
+                {
+                    "name": f"w{i}",
+                    "queue": f"lq-cq-{i}",
+                    "prio": 0,
+                    "t": float(i),
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "4"}}
+                    ],
+                }
+                for i in range(2)
+            ],
+        }
+        s_host, m_host, c_host, wl_host = build_env(spec, use_solver=False)
+        s_dev, m_dev, c_dev, wl_dev = build_env(spec, use_solver=True)
+        s_host.schedule()
+        s_dev.schedule()
+        for name in wl_host:
+            lh = wl_host[name].last_assignment
+            ld = wl_dev[name].last_assignment
+            if lh is None:
+                assert ld is None
+            else:
+                assert ld is not None
+                assert lh.last_tried_flavor_idx == ld.last_tried_flavor_idx
