@@ -1,0 +1,215 @@
+"""Bulk admission drain — host glue for ops/drain_kernel.py.
+
+Lowers an entire pending backlog (every queued workload, not just the
+cycle heads) into dense per-CQ queue tensors, runs the multi-cycle
+drain on the device in ONE dispatch + ONE fetch, and maps the decisions
+back to workloads. The per-cycle semantics match the sequential
+Scheduler exactly for preemption-free, fully-representable backlogs
+(asserted in tests/test_drain.py); workloads the dense form can't
+express are reported in ``fallback`` for the normal cycle loop.
+
+Use cases: the 50k-pending north-star drain (bench.py), bulk import
+(cli import), and capacity what-if planning.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kueue_tpu.models import ResourceFlavor, Workload
+from kueue_tpu.core.snapshot import Snapshot
+from kueue_tpu.core.solver import Lowered, _bucket, lower_heads, tree_arrays
+
+
+@dataclass
+class DrainPlan:
+    queues_np: dict  # field name -> numpy array (DrainQueues layout)
+    # (q, pos) -> index into lowered.heads
+    head_of: Dict[Tuple[int, int], int]
+    lowered: Lowered
+    cq_order: List[str]  # queue index -> cq name
+    n_segments: int
+    n_steps: int
+    max_cycles: int
+    fallback: List[int] = field(default_factory=list)
+
+
+@dataclass
+class DrainOutcome:
+    # (workload, cq_name, resource->flavor map, cycle index)
+    admitted: List[Tuple[Workload, str, Dict[str, str], int]]
+    parked: List[Tuple[Workload, str]]
+    fallback: List[Tuple[Workload, str]]
+    cycles: int
+
+
+def plan_drain(
+    snapshot: Snapshot,
+    pending: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    max_candidates: int = 8,
+    max_cells: int = 4,
+    timestamp_fn=None,
+) -> DrainPlan:
+    """Lower the backlog and pack it into per-CQ queue tensors.
+
+    ``pending`` must be in per-CQ heap order (priority desc, timestamp
+    asc — use QueueManager pending snapshots); relative order across
+    CQs is irrelevant.
+    """
+    from kueue_tpu.ops.assign_kernel import build_roots
+
+    lowered = lower_heads(
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
+    )
+    fallback = set(lowered.fallback)
+    # the drain's candidate-cursor retry (k+1 on an in-cycle conflict
+    # loss) is exact only when candidates enumerate ONE resource
+    # group's flavor walk; multi-group workloads go to the cycle loop
+    for i in range(len(lowered.heads)):
+        if i not in fallback and lowered.n_groups[i] != 1:
+            fallback.add(i)
+
+    by_cq: Dict[str, List[int]] = {}
+    for i, cq_name in enumerate(lowered.cq_names):
+        if i in fallback:
+            continue
+        by_cq.setdefault(cq_name, []).append(i)
+
+    cq_order = sorted(by_cq)
+    q = max(len(cq_order), 1)
+    l = max((len(v) for v in by_cq.values()), default=1)
+    k, c = max_candidates, max_cells
+
+    cq_rows = np.full(q, -1, dtype=np.int32)
+    qlen = np.zeros(q, dtype=np.int32)
+    cells = np.full((q, l, k, c), -1, dtype=np.int32)
+    qty = np.zeros((q, l, k, c), dtype=np.int64)
+    valid = np.zeros((q, l, k), dtype=bool)
+    reset = np.zeros((q, l, k), dtype=bool)
+    priority = np.zeros((q, l), dtype=np.int64)
+    timestamp = np.zeros((q, l), dtype=np.int64)
+    no_reclaim = np.zeros(q, dtype=bool)
+    head_of: Dict[Tuple[int, int], int] = {}
+
+    reset_of_tried: Dict[int, np.ndarray] = {}
+    for qi, cq_name in enumerate(cq_order):
+        idxs = by_cq[cq_name]
+        cq_rows[qi] = snapshot.row(cq_name)
+        qlen[qi] = len(idxs)
+        no_reclaim[qi] = bool(lowered.no_reclaim[idxs[0]])
+        n = len(idxs)
+        idx_arr = np.asarray(idxs, dtype=np.int64)
+        cells[qi, :n] = lowered.cells[idx_arr]
+        qty[qi, :n] = lowered.qty[idx_arr]
+        valid[qi, :n] = lowered.valid[idx_arr]
+        priority[qi, :n] = lowered.priority[idx_arr]
+        timestamp[qi, :n] = lowered.timestamp[idx_arr]
+        for pos, i in enumerate(idxs):
+            head_of[(qi, pos)] = i
+            tried = lowered.candidate_tried[i]
+            # tried lists are shared per lowering template: memoize the
+            # reset row per list identity (single group: every resource
+            # carries the same cursor)
+            row = reset_of_tried.get(id(tried))
+            if row is None:
+                row = np.zeros(k, dtype=bool)
+                for kk, tried_map in enumerate(tried):
+                    if tried_map and next(iter(tried_map.values())) == -1:
+                        row[kk] = True
+                reset_of_tried[id(tried)] = row
+            reset[qi, pos] = row
+
+    roots = build_roots(snapshot.flat.parent)
+    seg_id = np.full(q, -1, dtype=np.int32)
+    live = cq_rows >= 0
+    if live.any():
+        uniq, inv = np.unique(roots[cq_rows[live]], return_inverse=True)
+        seg_id[live] = inv.astype(np.int32)
+        n_segments = _bucket(len(uniq), minimum=8)
+        n_steps = _bucket(int(np.bincount(inv).max()), minimum=8)
+    else:
+        n_segments = n_steps = 8
+
+    return DrainPlan(
+        queues_np=dict(
+            cq_rows=cq_rows,
+            seg_id=seg_id,
+            qlen=qlen,
+            cells=cells,
+            qty=qty,
+            valid=valid,
+            reset=reset,
+            priority=priority,
+            timestamp=timestamp,
+            no_reclaim=no_reclaim,
+        ),
+        head_of=head_of,
+        lowered=lowered,
+        cq_order=cq_order,
+        n_segments=n_segments,
+        n_steps=n_steps,
+        # every cycle either admits or parks at least one head (a
+        # conflict-lost head implies another head's admission), so 2L+8
+        # cycles always suffice; the while_loop stops at quiescence
+        max_cycles=2 * l + 8,
+    )
+
+
+def run_drain(
+    snapshot: Snapshot,
+    pending: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    max_candidates: int = 8,
+    max_cells: int = 4,
+    timestamp_fn=None,
+) -> DrainOutcome:
+    """Plan + solve + map back, with one device round trip."""
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.ops.drain_kernel import DrainQueues, solve_drain_packed_jit
+
+    plan = plan_drain(
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
+    )
+    tree, paths, _ = tree_arrays(snapshot)
+    queues = DrainQueues(**{k: jnp.asarray(v) for k, v in plan.queues_np.items()})
+
+    flat = np.asarray(
+        solve_drain_packed_jit(
+            tree,
+            jnp.asarray(snapshot.local_usage),
+            queues,
+            paths,
+            n_segments=plan.n_segments,
+            n_steps=plan.n_steps,
+            max_cycles=plan.max_cycles,
+        )
+    )  # the single fetch
+    ql = plan.queues_np["cells"].shape[0] * plan.queues_np["cells"].shape[1]
+    adm_k = flat[:ql].reshape(plan.queues_np["cells"].shape[:2])
+    adm_cycle = flat[ql : 2 * ql].reshape(adm_k.shape)
+    cycles = int(flat[-1])
+
+    lowered = plan.lowered
+    admitted: List[Tuple[Workload, str, Dict[str, str], int]] = []
+    parked: List[Tuple[Workload, str]] = []
+    for (qi, pos), i in plan.head_of.items():
+        wl = lowered.heads[i]
+        cq_name = lowered.cq_names[i]
+        kk = int(adm_k[qi, pos])
+        if kk >= 0:
+            admitted.append(
+                (wl, cq_name, lowered.candidate_flavors[i][kk], int(adm_cycle[qi, pos]))
+            )
+        else:
+            parked.append((wl, cq_name))
+    admitted.sort(key=lambda t: t[3])
+    fb = [
+        (lowered.heads[i], lowered.cq_names[i]) for i in sorted(set(lowered.fallback))
+    ]
+    return DrainOutcome(
+        admitted=admitted, parked=parked, fallback=fb, cycles=cycles
+    )

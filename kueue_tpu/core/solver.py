@@ -55,6 +55,9 @@ class Lowered:
     heads: List[Workload] = field(default_factory=list)
     cq_names: List[str] = field(default_factory=list)
     fallback: List[int] = field(default_factory=list)  # indices into input heads
+    # per head: number of resource groups its request touches (the
+    # drain's candidate-cursor resume is exact only for 1 group)
+    n_groups: List[int] = field(default_factory=list)
 
 
 def _default_fungibility(cq: ClusterQueue) -> bool:
@@ -63,6 +66,174 @@ def _default_fungibility(cq: ClusterQueue) -> bool:
         ff.when_can_borrow == FlavorFungibilityPolicy.BORROW
         and ff.when_can_preempt == FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
     )
+
+
+class _Template:
+    """Quantity-independent lowering of one (CQ, podset shape, cursor)
+    combination — the candidate enumeration is identical for every
+    workload sharing it, so bulk lowering (50k-pending drains) builds it
+    once and only fills per-workload quantities."""
+
+    __slots__ = (
+        "fallback",
+        "n_groups",
+        "cq_row",
+        "no_reclaim",
+        "candidates",
+        "any_valid",
+        "cells_arr",
+        "valid_row",
+        "qty_sel",
+        "res_names",
+        "flavor_list",
+        "tried_list",
+    )
+
+    def __init__(self):
+        self.fallback = False
+        self.n_groups = 0
+        self.cq_row = -1
+        self.no_reclaim = False
+        # per candidate slot: None (invalid) or
+        # (cell_js, cell_resources, flavor_map, tried_map)
+        self.candidates: List = []
+        self.any_valid = False
+        # dense per-template rows shared by every head using it:
+        # cells_arr int32[K,C]; valid_row bool[K]; qty_sel int32[K,C]
+        # indexes a per-head request vector laid out as res_names + [0]
+        self.cells_arr = None
+        self.valid_row = None
+        self.qty_sel = None
+        self.res_names: Tuple[str, ...] = ()
+        self.flavor_list: List[Dict[str, str]] = []
+        self.tried_list: List[Dict[str, int]] = []
+
+
+def _podset_sig(ps) -> tuple:
+    sel = tuple(sorted(ps.node_selector.items())) if ps.node_selector else ()
+    return (tuple(sorted(ps.requests)), sel, tuple(ps.tolerations))
+
+
+def _build_template(
+    snapshot: Snapshot,
+    cq,
+    cq_name: str,
+    ps,
+    starts: Tuple[int, ...],
+    flavors: Dict[str, ResourceFlavor],
+    k: int,
+    c: int,
+) -> _Template:
+    t = _Template()
+
+    # resource groups touched by this podset, in CQ order (names only —
+    # quantities are per-workload)
+    touched: List[Tuple[object, List[str]]] = []
+    for rg in cq.resource_groups:
+        rg_res = [r for r in sorted(ps.requests) if r in rg.covered_resources]
+        if PODS in rg.covered_resources:
+            rg_res.append(PODS)
+        if rg_res:
+            touched.append((rg, sorted(rg_res)))
+    covered = {r for rg, _ in touched for r in rg.covered_resources}
+    if any(r not in covered for r in ps.requests):
+        t.fallback = True  # resource not covered: host reports it
+        return t
+    t.n_groups = len(touched)
+
+    per_rg: List[List[Tuple[str, int]]] = []
+    for gidx, (rg, rg_res) in enumerate(touched):
+        label_keys = group_label_keys(rg.flavors, flavors)
+        start = starts[gidx] if gidx < len(starts) else 0
+        n_flavors = len(rg.flavors)
+        options: List[Tuple[str, int]] = []
+        for gi in range(start, n_flavors):
+            fq = rg.flavors[gi]
+            flavor = flavors.get(fq.name)
+            if flavor is not None and flavor.topology_name is not None:
+                # TAS flavors (incl. implied TAS on TAS-only CQs)
+                # need topology placement — host path only
+                t.fallback = True
+                return t
+            if flavor_eligible(flavor, ps, label_keys):
+                # host cursor semantics: a FIT at the group's last
+                # flavor stores -1 (restart from 0 next time)
+                tried = -1 if gi == n_flavors - 1 else gi
+                options.append((fq.name, tried))
+        if not options:
+            t.fallback = True
+            return t
+        per_rg.append(options)
+
+    n_cand = 1
+    for options in per_rg:
+        n_cand *= len(options)
+    n_cells = sum(len(rg_res) for _, rg_res in touched)
+    if n_cand > k or n_cells > c:
+        t.fallback = True
+        return t
+
+    # cartesian product across RGs in reference order (first RG's
+    # flavor walk is the outer loop — matches the sequential search
+    # trying RG1 flavors fully per RG0 choice)
+    combos: List[List[Tuple[int, str, int]]] = [[]]
+    for gidx, options in enumerate(per_rg):
+        combos = [prev + [(gidx, f, tr)] for prev in combos for (f, tr) in options]
+
+    from kueue_tpu.core.preemption import can_always_reclaim
+
+    t.cq_row = snapshot.row(cq_name)
+    t.no_reclaim = not can_always_reclaim(cq)
+    for combo in combos:
+        cell_js: List[int] = []
+        cell_rs: List[str] = []
+        flavor_map: Dict[str, str] = {}
+        tried_map: Dict[str, int] = {}
+        ok = True
+        for gidx, fname, tried in combo:
+            for r in touched[gidx][1]:
+                j = snapshot.fr_index.get(FlavorResource(fname, r))
+                if j is None:
+                    ok = False
+                    break
+                cell_js.append(j)
+                cell_rs.append(r)
+                flavor_map[r] = fname
+                tried_map[r] = tried
+            if not ok:
+                break
+        if ok:
+            t.candidates.append(
+                (tuple(cell_js), tuple(cell_rs), flavor_map, tried_map)
+            )
+            t.any_valid = True
+        else:
+            t.candidates.append(None)
+    if not t.any_valid:
+        t.fallback = True
+        return t
+
+    # dense rows for the vectorized per-head fill
+    res_names = tuple(sorted({r for _, rg_res in touched for r in rg_res}))
+    r_idx = {r: x for x, r in enumerate(res_names)}
+    t.res_names = res_names
+    t.cells_arr = np.full((k, c), -1, dtype=np.int32)
+    t.valid_row = np.zeros(k, dtype=bool)
+    # unused cell slots select the trailing 0 of the request vector
+    t.qty_sel = np.full((k, c), len(res_names), dtype=np.int32)
+    for ki, cand in enumerate(t.candidates):
+        if cand is None:
+            t.flavor_list.append({})
+            t.tried_list.append({})
+            continue
+        cell_js, cell_rs, flavor_map, tried_map = cand
+        for ci, (j, r) in enumerate(zip(cell_js, cell_rs)):
+            t.cells_arr[ki, ci] = j
+            t.qty_sel[ki, ci] = r_idx[r]
+        t.valid_row[ki] = True
+        t.flavor_list.append(flavor_map)
+        t.tried_list.append(tried_map)
+    return t
 
 
 def lower_heads(
@@ -74,7 +245,11 @@ def lower_heads(
     timestamp_fn=None,
 ) -> Lowered:
     """Build the dense head batch; route inexpressible heads to
-    ``fallback`` (handled by the host FlavorAssigner)."""
+    ``fallback`` (handled by the host FlavorAssigner).
+
+    Candidate enumeration is memoized per (CQ, podset shape, cursor):
+    a bulk backlog over 1k CQs lowers in O(templates + heads), not
+    O(heads x flavors)."""
     w = len(heads)
     k, c = max_candidates, max_cells
     out = Lowered(
@@ -86,12 +261,14 @@ def lower_heads(
         timestamp=np.zeros(w, dtype=np.int64),
         no_reclaim=np.zeros(w, dtype=bool),
     )
+    templates: Dict[tuple, _Template] = {}
 
     for i, (wl, cq_name) in enumerate(heads):
         out.heads.append(wl)
         out.cq_names.append(cq_name)
         out.candidate_flavors.append([])
         out.candidate_tried.append([])
+        out.n_groups.append(0)
         if cq_name not in snapshot.cq_models:
             out.fallback.append(i)
             continue
@@ -103,122 +280,62 @@ def lower_heads(
         if ps.topology_request is not None:
             out.fallback.append(i)  # TAS placement stays on the host path
             continue
-        count = effective_podset_count(wl, ps)
-        requests = {r: v * count for r, v in ps.requests.items()}
 
-        # resource groups touched by this workload, in CQ order
-        touched = []
-        for rg in cq.resource_groups:
-            rg_req = {
-                r: requests[r] for r in rg.covered_resources if r in requests
-            }
-            if PODS in rg.covered_resources:
-                rg_req[PODS] = count
-            if rg_req:
-                touched.append((rg, rg_req))
-        covered = {r for rg, _ in touched for r in rg.covered_resources}
-        if any(r not in covered for r in requests):
-            out.fallback.append(i)  # resource not covered: host reports it
-            continue
-
-        # per-RG eligible flavor lists (order preserved, cursor applied)
+        # per-RG cursor starts (LastAssignment resume)
         state = wl.last_assignment
         gen = snapshot.generations.get(cq_name, 0)
         if state is not None and gen > state.cluster_queue_generation:
             state = None
-        per_rg: List[List[Tuple[str, Dict[str, int], int]]] = []
-        representable = True
-        for rg, rg_req in touched:
-            label_keys = group_label_keys(rg.flavors, flavors)
-            start = 0
-            if state is not None:
-                first_res = sorted(rg_req)[0]
-                start = state.next_flavor_to_try(0, first_res)
-            n_flavors = len(rg.flavors)
-            options: List[Tuple[str, Dict[str, int], int]] = []
-            for gi in range(start, n_flavors):
-                fq = rg.flavors[gi]
-                flavor = flavors.get(fq.name)
-                if flavor is not None and flavor.topology_name is not None:
-                    # TAS flavors (incl. implied TAS on TAS-only CQs)
-                    # need topology placement — host path only
-                    options = []
-                    representable = False
-                    break
-                if flavor_eligible(flavor, ps, label_keys):
-                    # host cursor semantics: a FIT at the group's last
-                    # flavor stores -1 (restart from 0 next time)
-                    tried = -1 if gi == n_flavors - 1 else gi
-                    options.append((fq.name, rg_req, tried))
-            if not representable:
-                break
-            if not options:
-                representable = False
-                break
-            per_rg.append(options)
-        if not representable:
+        if state is None:
+            starts: Tuple[int, ...] = ()
+        else:
+            starts_l = []
+            for rg in cq.resource_groups:
+                rg_res = [
+                    r for r in sorted(ps.requests) if r in rg.covered_resources
+                ]
+                if PODS in rg.covered_resources:
+                    rg_res.append(PODS)
+                if rg_res:
+                    starts_l.append(state.next_flavor_to_try(0, sorted(rg_res)[0]))
+            starts = tuple(starts_l)
+
+        key = (cq_name, _podset_sig(ps), starts)
+        t = templates.get(key)
+        if t is None:
+            t = _build_template(snapshot, cq, cq_name, ps, starts, flavors, k, c)
+            templates[key] = t
+        out.n_groups[i] = t.n_groups
+        if t.fallback:
             out.fallback.append(i)
             continue
 
-        n_cand = 1
-        for options in per_rg:
-            n_cand *= len(options)
-        n_cells = sum(len(rg_req) for _, rg_req in touched)
-        if n_cand > k or n_cells > c:
-            out.fallback.append(i)
-            continue
+        count = effective_podset_count(wl, ps)
+        requests = {r: v * count for r, v in ps.requests.items()}
+        requests[PODS] = count
 
-        # cartesian product across RGs in reference order (first RG's
-        # flavor walk is the outer loop — matches the sequential search
-        # trying RG1 flavors fully per RG0 choice)
-        combos: List[List[Tuple[str, Dict[str, int], int]]] = [[]]
-        for options in per_rg:
-            combos = [prev + [opt] for prev in combos for opt in options]
-
-        from kueue_tpu.core.preemption import can_always_reclaim
-
-        out.cq_row[i] = snapshot.row(cq_name)
-        out.no_reclaim[i] = not can_always_reclaim(cq)
+        out.cq_row[i] = t.cq_row
+        out.no_reclaim[i] = t.no_reclaim
         out.priority[i] = priority_of(wl, snapshot.priority_classes)
         ts = timestamp_fn(wl) if timestamp_fn else wl.creation_time
         out.timestamp[i] = int(ts * 1e9)
-        for ki, combo in enumerate(combos):
-            flavor_map: Dict[str, str] = {}
-            tried_map: Dict[str, int] = {}
-            ci = 0
-            ok = True
-            for fname, rg_req, tried in combo:
-                for r, q in sorted(rg_req.items()):
-                    j = snapshot.fr_index.get(FlavorResource(fname, r))
-                    if j is None:
-                        ok = False
-                        break
-                    out.cells[i, ki, ci] = j
-                    out.qty[i, ki, ci] = q
-                    flavor_map[r] = fname
-                    tried_map[r] = tried
-                    ci += 1
-                if not ok:
-                    break
-            if ok:
-                out.valid[i, ki] = True
-                out.candidate_flavors[i].append(flavor_map)
-                out.candidate_tried[i].append(tried_map)
-            else:
-                out.cells[i, ki, :] = -1
-                out.qty[i, ki, :] = 0
-                out.candidate_flavors[i].append({})
-                out.candidate_tried[i].append({})
-        if not out.valid[i].any():
-            out.cq_row[i] = -1
-            out.fallback.append(i)
+        # vectorized fill: template rows + per-head request vector
+        out.cells[i] = t.cells_arr
+        out.valid[i] = t.valid_row
+        rvec = np.zeros(len(t.res_names) + 1, dtype=np.int64)
+        for x, r in enumerate(t.res_names):
+            rvec[x] = requests.get(r, 0)
+        out.qty[i] = rvec[t.qty_sel]
+        # shared read-only maps (one list per template, not per head)
+        out.candidate_flavors[i] = t.flavor_list
+        out.candidate_tried[i] = t.tried_list
     return out
 
 
 def tree_arrays(snapshot: Snapshot):
-    """(QuotaTree, paths) device inputs from a Snapshot."""
+    """(QuotaTree, paths, roots) device inputs from a Snapshot."""
     from kueue_tpu._jax import jnp
-    from kueue_tpu.ops.assign_kernel import build_paths
+    from kueue_tpu.ops.assign_kernel import build_paths, build_roots
     from kueue_tpu.ops.quota import QuotaTree
 
     flat = snapshot.flat
@@ -230,7 +347,8 @@ def tree_arrays(snapshot: Snapshot):
         borrowing_limit=jnp.asarray(snapshot.borrowing_limit),
     )
     paths = jnp.asarray(build_paths(flat.parent, flat.max_depth))
-    return tree, paths
+    roots = build_roots(flat.parent)
+    return tree, paths, roots
 
 
 def _bucket(w: int, minimum: int = 64) -> int:
@@ -248,16 +366,27 @@ def dispatch_lowered(
     lowered: Lowered,
     pad_heads: bool = True,
 ):
-    """Ship an already-lowered batch to the device solver.
+    """Ship an already-lowered batch to the segmented device solver.
 
     Padding rows (cq_row == -1) are inert in both solver phases, so the
     first ``len(lowered.heads)`` result entries map 1:1 onto the input
-    heads.
+    heads. The phase-2 step bound is the max head count in any root
+    cohort (independent roots resolve in parallel), bucketed so the jit
+    caches per bucket.
+
+    Returns a HOST-side SolveResult (numpy arrays, usage omitted):
+    all per-head outputs come back in one packed fetch, because every
+    device->host retrieval pays a full round trip on remote-attached
+    TPUs and the scheduler reads several fields per cycle.
     """
     import numpy as np
 
     from kueue_tpu._jax import jnp
-    from kueue_tpu.ops.assign_kernel import HeadsBatch, solve_cycle_jit
+    from kueue_tpu.ops.assign_kernel import (
+        HeadsBatch,
+        SolveResult,
+        solve_cycle_segmented_packed_jit,
+    )
 
     w = len(lowered.heads)
     w_pad = _bucket(w) if pad_heads else w
@@ -275,7 +404,7 @@ def dispatch_lowered(
         priority = np.concatenate([priority, np.zeros(pad, dtype=np.int64)])
         timestamp = np.concatenate([timestamp, np.zeros(pad, dtype=np.int64)])
         no_reclaim = np.concatenate([no_reclaim, np.zeros(pad, dtype=bool)])
-    tree, paths = tree_arrays(snapshot)
+    tree, paths, roots = tree_arrays(snapshot)
     batch = HeadsBatch(
         cq_row=jnp.asarray(cq_row),
         cells=jnp.asarray(cells),
@@ -285,7 +414,36 @@ def dispatch_lowered(
         timestamp=jnp.asarray(timestamp),
         no_reclaim=jnp.asarray(no_reclaim),
     )
-    return solve_cycle_jit(tree, jnp.asarray(snapshot.local_usage), batch, paths)
+    # compact segment ids: one per LIVE root cohort; the max head count
+    # within one root bounds phase-2's sequential depth
+    seg_id = np.full(w_pad, -1, dtype=np.int32)
+    live_mask = cq_row >= 0
+    if live_mask.any():
+        uniq, inv = np.unique(roots[cq_row[live_mask]], return_inverse=True)
+        seg_id[live_mask] = inv.astype(np.int32)
+        n_segments = _bucket(len(uniq), minimum=8)
+        n_steps = _bucket(int(np.bincount(inv).max()), minimum=8)
+    else:
+        n_segments = n_steps = 8
+    packed = np.asarray(
+        solve_cycle_segmented_packed_jit(
+            tree,
+            jnp.asarray(snapshot.local_usage),
+            batch,
+            paths,
+            jnp.asarray(seg_id),
+            n_segments=n_segments,
+            n_steps=n_steps,
+        )
+    )  # ONE device->host round trip for the whole cycle outcome
+    return SolveResult(
+        chosen=packed[0].astype(np.int32),
+        admitted=packed[1].astype(bool),
+        borrows=packed[2].astype(bool),
+        reserved=packed[3].astype(bool),
+        usage=None,
+        order=packed[4].astype(np.int32),
+    )
 
 
 def solve_heads(

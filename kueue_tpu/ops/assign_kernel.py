@@ -111,6 +111,21 @@ def build_paths(parent, max_depth: int):
     return paths
 
 
+def build_roots(parent):
+    """int32[N] root node of every node (itself when parentless).
+    Host-side helper; segments of the segmented phase-2 resolver."""
+    import numpy as np
+
+    n = parent.shape[0]
+    roots = np.empty(n, dtype=np.int32)
+    for i in range(n):
+        cur = i
+        while parent[cur] >= 0:
+            cur = int(parent[cur])
+        roots[i] = cur
+    return roots
+
+
 def _gather_cells(mat: jnp.ndarray, rows: jnp.ndarray, cells: jnp.ndarray) -> jnp.ndarray:
     """mat[rows[d], cells[c]] -> [D+1, C] with negative indices clamped
     (callers mask)."""
@@ -359,3 +374,188 @@ def solve_cycle(
 
 
 solve_cycle_jit = jax.jit(solve_cycle, static_argnames=())
+
+
+def solve_cycle_segmented(
+    tree: QuotaTree,
+    local_usage: jnp.ndarray,
+    heads: HeadsBatch,
+    paths: jnp.ndarray,  # int32[N, D+1]
+    seg_id: jnp.ndarray,  # int32[W] compact root-cohort id per head (-1 pad)
+    n_segments: int,  # static: number of distinct live root cohorts (bucketed)
+    n_steps: int,  # static: >= max heads per root cohort (bucketed)
+) -> SolveResult:
+    """Segmented phase-2: independent root cohorts resolve in parallel.
+
+    Heads of ClusterQueues under different cohort roots touch disjoint
+    node rows (usage bubbles stay inside their tree), so the sequential
+    admit-order scan only has to serialize WITHIN a root. Each scan step
+    processes one head per live root — all roots advance together —
+    cutting sequential depth from O(W) to O(max heads per root): the
+    50-cohort north-star shape runs ~W/50 steps of 50-wide vector work
+    instead of W scalar steps.
+
+    ``seg_id`` is the host-compacted root id (build_roots + np.unique),
+    so step width is the number of LIVE roots, not the node count.
+
+    Semantics are identical to solve_cycle: within a root, heads process
+    in the global entry order (scheduler.go:575-599); across roots the
+    interleaving differs but no state is shared, so the admitted set,
+    reservations and final usage tree match exactly (property-tested in
+    tests/test_assign_kernel.py).
+    """
+    max_depth = tree.max_depth
+    subtree, guaranteed = subtree_quota(tree)
+    chosen, borrows_wk, preempt_k = phase1_classify(
+        tree, subtree, guaranteed, local_usage, heads
+    )
+
+    w = heads.cq_row.shape[0]
+    eff_k = jnp.where(chosen >= 0, chosen, preempt_k)
+    eff_safe = jnp.maximum(eff_k, 0)
+    head_borrow = jnp.take_along_axis(borrows_wk, eff_safe[:, None], axis=1)[:, 0]
+    head_borrow = head_borrow & (eff_k >= 0)
+
+    nofit = eff_k < 0
+    order = jnp.lexsort(
+        (heads.timestamp, -heads.priority, head_borrow.astype(jnp.int64), nofit.astype(jnp.int64))
+    )
+
+    cq = jnp.maximum(heads.cq_row, 0)  # [W]
+
+    # per sorted slot: its segment and whether it does any work
+    seg = jnp.maximum(seg_id, 0)[order]  # [W]
+    valid_sorted = (heads.cq_row[order] >= 0) & (seg_id[order] >= 0) & (~nofit[order])
+    # rank = number of valid same-segment predecessors in sorted order
+    same = seg[None, :] == seg[:, None]  # [W, W]
+    before = jnp.tril(jnp.ones((w, w), dtype=bool), k=-1)
+    rank = jnp.sum(same & before & valid_sorted[None, :], axis=1)  # [W]
+
+    # schedule matrix: mat[s, g] = head index processed at step s
+    rank_scatter = jnp.where(valid_sorted, rank, n_steps)  # OOB rows drop
+    mat = (
+        jnp.full((n_steps, n_segments), -1, dtype=jnp.int32)
+        .at[rank_scatter, seg]
+        .set(order.astype(jnp.int32), mode="drop")
+    )
+
+    cells_eff = jnp.take_along_axis(
+        heads.cells, eff_safe[:, None, None], axis=1
+    )[:, 0]  # [W, C]
+    qty_eff = jnp.take_along_axis(heads.qty, eff_safe[:, None, None], axis=1)[:, 0]
+
+    usage0 = usage_tree(tree, guaranteed, local_usage)
+
+    avail_v = jax.vmap(
+        _avail_along_path, in_axes=(0, 0, None, None, None, None, None)
+    )
+
+    def step(usage, s):
+        idx = mat[s]  # [G] head index or -1
+        active = idx >= 0
+        hidx = jnp.maximum(idx, 0)
+        cqs = cq[hidx]  # [G]
+        path = paths[cqs]  # [G, D+1]
+        cells = cells_eff[hidx]  # [G, C]
+        qty = qty_eff[hidx]
+        ccells = jnp.maximum(cells, 0)
+        cell_valid = (cells >= 0) & (qty > 0) & active[:, None]
+
+        avail = avail_v(
+            path, cells, usage, subtree, guaranteed, tree.borrowing_limit, max_depth
+        )  # [G, C]
+        fits = jnp.all(jnp.where(cell_valid, avail >= qty, True), axis=1)
+
+        admit = active & (chosen[hidx] >= 0) & fits
+        reserve = (
+            active
+            & (chosen[hidx] < 0)
+            & (preempt_k[hidx] >= 0)
+            & heads.no_reclaim[hidx]
+        )
+        nominal_c = tree.nominal[cqs[:, None], ccells]  # [G, C]
+        bl_c = tree.borrowing_limit[cqs[:, None], ccells]
+        leaf_usage_c = usage[cqs[:, None], ccells]
+        borrow_cap = jnp.where(
+            bl_c < NO_LIMIT,
+            jnp.minimum(qty, nominal_c + bl_c - leaf_usage_c),
+            qty,
+        )
+        nominal_cap = jnp.maximum(0, jnp.minimum(qty, nominal_c - leaf_usage_c))
+        reserve_qty = jnp.where(head_borrow[hidx][:, None], borrow_cap, nominal_cap)
+
+        delta = jnp.where(
+            cell_valid & admit[:, None],
+            qty,
+            jnp.where(cell_valid & reserve[:, None], reserve_qty, 0),
+        )  # [G, C]
+
+        # vectorized addUsage bubble-up: slots touch disjoint trees, so
+        # one scatter-add per level is conflict-free across slots
+        for d in range(0, max_depth + 1):
+            node = jnp.maximum(path[:, d], 0)  # [G]
+            node_valid = (path[:, d] >= 0)[:, None]
+            old = usage[node[:, None], ccells]  # [G, C]
+            g = guaranteed[node[:, None], ccells]
+            new = old + delta
+            usage = usage.at[node[:, None], ccells].add(
+                jnp.where(node_valid, delta, 0)
+            )
+            over_old = jnp.maximum(0, old - g)
+            over_new = jnp.maximum(0, new - g)
+            delta = jnp.where(node_valid, over_new - over_old, delta)
+        return usage, (admit, reserve)
+
+    usage_final, (admit_sn, reserve_sn) = lax.scan(
+        step, usage0, jnp.arange(n_steps)
+    )
+
+    # scatter [S, G] step outcomes back onto heads
+    flat_idx = mat.reshape(-1)
+    safe_idx = jnp.where(flat_idx >= 0, flat_idx, w)  # OOB drops
+    admitted = (
+        jnp.zeros(w, dtype=bool).at[safe_idx].set(admit_sn.reshape(-1), mode="drop")
+    )
+    reserved = (
+        jnp.zeros(w, dtype=bool).at[safe_idx].set(reserve_sn.reshape(-1), mode="drop")
+    )
+    return SolveResult(
+        chosen=chosen,
+        admitted=admitted,
+        borrows=head_borrow,
+        reserved=reserved,
+        usage=usage_final,
+        order=order.astype(jnp.int32),
+    )
+
+
+solve_cycle_segmented_jit = jax.jit(
+    solve_cycle_segmented, static_argnames=("n_segments", "n_steps")
+)
+
+
+def _solve_cycle_segmented_packed(
+    tree, local_usage, heads, paths, seg_id, n_segments: int, n_steps: int
+):
+    """solve_cycle_segmented with the per-head outputs stacked into ONE
+    int64[5, W] tensor, so the host retrieves the whole cycle outcome in
+    a single device->host fetch (each fetch pays a full round trip on
+    remote-attached TPUs; see bench.py)."""
+    r = solve_cycle_segmented(
+        tree, local_usage, heads, paths, seg_id, n_segments, n_steps
+    )
+    packed = jnp.stack(
+        [
+            r.chosen.astype(jnp.int64),
+            r.admitted.astype(jnp.int64),
+            r.borrows.astype(jnp.int64),
+            r.reserved.astype(jnp.int64),
+            r.order.astype(jnp.int64),
+        ]
+    )
+    return packed
+
+
+solve_cycle_segmented_packed_jit = jax.jit(
+    _solve_cycle_segmented_packed, static_argnames=("n_segments", "n_steps")
+)

@@ -1,0 +1,316 @@
+"""Multi-cycle admission drain — the whole backlog on the device.
+
+The interactive scheduler ping-pongs one cycle at a time: pop heads,
+solve, fetch, admit, repeat. On a remote-attached TPU every fetch pays
+a full host<->device round trip, which dwarfs the solve itself. For the
+bulk scenario the north star describes (a large pending backlog drained
+to quiescence with no arrivals in between — BASELINE.md: 50k pending
+over 1k ClusterQueues), the TPU-native formulation is to keep the WHOLE
+drain on device: per-CQ pending queues become dense tensors, the
+pop-head/solve/advance loop becomes a ``lax.while_loop`` over cycles,
+and ONE fetch returns every admission decision.
+
+Per cycle this reproduces exactly the reference's semantics
+(``pkg/scheduler/scheduler.go:176-310``) for preemption-free drains:
+
+- heads: each CQ's queue front (one head per CQ per cycle, matching
+  queue.Manager.Heads);
+- nomination: phase-1 flavor classification against cycle-start usage
+  (ops/assign_kernel.phase1_classify);
+- conflict resolution: the segmented phase-2 scan in the reference's
+  entry order (scheduler.go:575-599), independent root cohorts in
+  parallel;
+- queue motion: admitted heads leave; NoFit heads park forever (in a
+  drain no capacity is ever released, so the reference's
+  inadmissible-parking reactivation can never fire — the cursor just
+  advances); heads that fit at nomination but lost the in-cycle
+  conflict stay at the front and retry next cycle (BestEffortFIFO
+  immediate requeue, cluster_queue.go:402-407);
+- capacity reservation: blocked preempt-mode heads with
+  reclaimWithinCohort != Any reserve capacity WITHIN their cycle
+  (scheduler.go:228-242); reservations drop at cycle end because the
+  reserving head parks — rebuilding the usage tree from leaf rows each
+  cycle makes this exact.
+
+Decision parity with the sequential host scheduler is asserted in
+tests/test_drain.py.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from kueue_tpu._jax import jax, jnp, lax
+from kueue_tpu.ops.assign_kernel import (
+    HeadsBatch,
+    _avail_along_path,
+    phase1_classify,
+)
+from kueue_tpu.ops.quota import NO_LIMIT, QuotaTree, subtree_quota, usage_tree
+
+
+class DrainQueues(NamedTuple):
+    """Per-ClusterQueue pending queues, densely packed.
+
+    Q queues, L max queue length, K flavor candidates, C cells.
+
+    cq_rows:  int32[Q]     — tree row of each queue's ClusterQueue.
+    seg_id:   int32[Q]     — compact root-cohort id (segmented phase 2).
+    qlen:     int32[Q]     — live entries in each queue.
+    cells:    int32[Q,L,K,C] / qty: int64[Q,L,K,C] / valid: bool[Q,L,K]
+              — each entry's lowered flavor candidates (core/solver.py
+              lower_heads layout).
+    reset:    bool[Q,L,K]  — candidate k is the LAST flavor of its
+              resource group (host cursor semantics store -1 there:
+              a conflict-skipped head restarts the walk from flavor 0
+              instead of resuming past the end).
+    priority: int64[Q,L] / timestamp: int64[Q,L] — entry order keys,
+              already sorted within each queue (priority desc, ts asc —
+              the pending-heap order, cluster_queue.go:413-426).
+    no_reclaim: bool[Q]    — CQ reserves capacity when blocked.
+    """
+
+    cq_rows: jnp.ndarray
+    seg_id: jnp.ndarray
+    qlen: jnp.ndarray
+    cells: jnp.ndarray
+    qty: jnp.ndarray
+    valid: jnp.ndarray
+    reset: jnp.ndarray
+    priority: jnp.ndarray
+    timestamp: jnp.ndarray
+    no_reclaim: jnp.ndarray
+
+
+class DrainResult(NamedTuple):
+    """admitted_k: int32[Q,L] chosen candidate per queue entry (-1 =
+    never admitted); admitted_cycle: int32[Q,L] cycle index of the
+    admission (-1 = never); cycles: int32 scalar — cycles executed;
+    local_usage: int64[N,FR] final leaf usage."""
+
+    admitted_k: jnp.ndarray
+    admitted_cycle: jnp.ndarray
+    cycles: jnp.ndarray
+    local_usage: jnp.ndarray
+
+
+def solve_drain(
+    tree: QuotaTree,
+    local_usage: jnp.ndarray,  # int64[N, FR] starting leaf usage
+    queues: DrainQueues,
+    paths: jnp.ndarray,  # int32[N, D+1]
+    n_segments: int,
+    n_steps: int,
+    max_cycles: int,
+) -> DrainResult:
+    max_depth = tree.max_depth
+    subtree, guaranteed = subtree_quota(tree)
+
+    q, l, k, c = queues.cells.shape
+    q_idx = jnp.arange(q)
+
+    avail_v = jax.vmap(
+        _avail_along_path, in_axes=(0, 0, None, None, None, None, None)
+    )
+
+    def cycle_body(state):
+        local, cursor, k_start, adm_k, adm_cycle, cycle = state
+
+        active = cursor < queues.qlen  # [Q]
+        cur = jnp.minimum(cursor, l - 1)
+        # candidate cursor: a conflict-skipped head resumes its flavor
+        # walk past the candidate it chose last cycle (LastAssignment
+        # semantics, flavorassigner.go:359-377 + cluster_queue.go:231)
+        k_mask = jnp.arange(k)[None, :] >= k_start[:, None]  # [Q, K]
+        heads = HeadsBatch(
+            cq_row=jnp.where(active, queues.cq_rows, -1).astype(jnp.int32),
+            cells=queues.cells[q_idx, cur],  # [Q, K, C]
+            qty=queues.qty[q_idx, cur],
+            valid=queues.valid[q_idx, cur] & active[:, None] & k_mask,
+            priority=queues.priority[q_idx, cur],
+            timestamp=queues.timestamp[q_idx, cur],
+            no_reclaim=queues.no_reclaim,
+        )
+
+        chosen, borrows_wk, preempt_k = phase1_classify(
+            tree, subtree, guaranteed, local, heads
+        )
+        eff_k = jnp.where(chosen >= 0, chosen, preempt_k)
+        eff_safe = jnp.maximum(eff_k, 0)
+        head_borrow = jnp.take_along_axis(
+            borrows_wk, eff_safe[:, None], axis=1
+        )[:, 0] & (eff_k >= 0)
+        nofit = eff_k < 0
+
+        order = jnp.lexsort(
+            (
+                heads.timestamp,
+                -heads.priority,
+                head_borrow.astype(jnp.int64),
+                nofit.astype(jnp.int64),
+            )
+        )
+        seg = jnp.maximum(queues.seg_id, 0)[order]
+        valid_sorted = active[order] & (queues.seg_id[order] >= 0) & (~nofit[order])
+        same = seg[None, :] == seg[:, None]
+        before = jnp.tril(jnp.ones((q, q), dtype=bool), k=-1)
+        rank = jnp.sum(same & before & valid_sorted[None, :], axis=1)
+        rank_scatter = jnp.where(valid_sorted, rank, n_steps)
+        mat = (
+            jnp.full((n_steps, n_segments), -1, dtype=jnp.int32)
+            .at[rank_scatter, seg]
+            .set(order.astype(jnp.int32), mode="drop")
+        )
+
+        cells_eff = jnp.take_along_axis(
+            heads.cells, eff_safe[:, None, None], axis=1
+        )[:, 0]
+        qty_eff = jnp.take_along_axis(heads.qty, eff_safe[:, None, None], axis=1)[:, 0]
+        cq = jnp.maximum(heads.cq_row, 0)
+
+        usage0 = usage_tree(tree, guaranteed, local)
+
+        def step(usage, s):
+            idx = mat[s]  # [G]
+            act = idx >= 0
+            hidx = jnp.maximum(idx, 0)
+            cqs = cq[hidx]
+            path = paths[cqs]
+            cells_ = cells_eff[hidx]
+            qty_ = qty_eff[hidx]
+            ccells = jnp.maximum(cells_, 0)
+            cell_valid = (cells_ >= 0) & (qty_ > 0) & act[:, None]
+
+            avail = avail_v(
+                path, cells_, usage, subtree, guaranteed,
+                tree.borrowing_limit, max_depth,
+            )
+            fits = jnp.all(jnp.where(cell_valid, avail >= qty_, True), axis=1)
+            admit = act & (chosen[hidx] >= 0) & fits
+            reserve = (
+                act
+                & (chosen[hidx] < 0)
+                & (preempt_k[hidx] >= 0)
+                & heads.no_reclaim[hidx]
+            )
+            nominal_c = tree.nominal[cqs[:, None], ccells]
+            bl_c = tree.borrowing_limit[cqs[:, None], ccells]
+            leaf_usage_c = usage[cqs[:, None], ccells]
+            borrow_cap = jnp.where(
+                bl_c < NO_LIMIT,
+                jnp.minimum(qty_, nominal_c + bl_c - leaf_usage_c),
+                qty_,
+            )
+            nominal_cap = jnp.maximum(
+                0, jnp.minimum(qty_, nominal_c - leaf_usage_c)
+            )
+            reserve_qty = jnp.where(
+                head_borrow[hidx][:, None], borrow_cap, nominal_cap
+            )
+            delta = jnp.where(
+                cell_valid & admit[:, None],
+                qty_,
+                jnp.where(cell_valid & reserve[:, None], reserve_qty, 0),
+            )
+            for d in range(0, max_depth + 1):
+                node = jnp.maximum(path[:, d], 0)
+                node_valid = (path[:, d] >= 0)[:, None]
+                old = usage[node[:, None], ccells]
+                g = guaranteed[node[:, None], ccells]
+                new = old + delta
+                usage = usage.at[node[:, None], ccells].add(
+                    jnp.where(node_valid, delta, 0)
+                )
+                over_old = jnp.maximum(0, old - g)
+                over_new = jnp.maximum(0, new - g)
+                delta = jnp.where(node_valid, over_new - over_old, delta)
+            return usage, admit
+
+        _, admit_sn = lax.scan(step, usage0, jnp.arange(n_steps))
+
+        flat_idx = mat.reshape(-1)
+        safe_idx = jnp.where(flat_idx >= 0, flat_idx, q)
+        admitted = (
+            jnp.zeros(q, dtype=bool)
+            .at[safe_idx]
+            .set(admit_sn.reshape(-1), mode="drop")
+        )
+
+        # leaf usage adds for admissions only — the cycle's reservations
+        # die with the cycle (the reserving head parks), and rebuilding
+        # the interior rows from leaves next cycle makes that exact
+        cell_valid = (cells_eff >= 0) & (qty_eff > 0)
+        add = jnp.where(cell_valid & admitted[:, None], qty_eff, 0)
+        local = local.at[cq[:, None], jnp.maximum(cells_eff, 0)].add(add)
+
+        # queue motion: admitted leave; non-Fit heads park (advance) —
+        # including preempt-classified reserving heads, whose exhausted
+        # flavor walk stores no pending cursor so the host parks them
+        # too; only in-cycle conflict losers stay and retry, resuming
+        # past the candidate they chose
+        advance = active & (admitted | (chosen < 0))
+        adm_k = adm_k.at[q_idx, cur].set(
+            jnp.where(admitted & active, chosen, adm_k[q_idx, cur])
+        )
+        adm_cycle = adm_cycle.at[q_idx, cur].set(
+            jnp.where(admitted & active, cycle, adm_cycle[q_idx, cur])
+        )
+        # cursor semantics of the host walk: choosing the group's LAST
+        # flavor stores -1 (restart at 0); otherwise resume past it
+        chosen_safe = jnp.maximum(chosen, 0)
+        chose_last = queues.reset[q_idx, cur, chosen_safe]  # [Q]
+        lost = active & (chosen >= 0) & (~admitted)
+        k_start = jnp.where(
+            advance,
+            0,
+            jnp.where(lost, jnp.where(chose_last, 0, chosen_safe + 1), k_start),
+        ).astype(jnp.int32)
+        cursor = cursor + advance.astype(jnp.int32)
+        return local, cursor, k_start, adm_k, adm_cycle, cycle + 1
+
+    def cond(state):
+        _, cursor, _, _, _, cycle = state
+        return jnp.any(cursor < queues.qlen) & (cycle < max_cycles)
+
+    init = (
+        local_usage,
+        jnp.zeros(q, dtype=jnp.int32),
+        jnp.zeros(q, dtype=jnp.int32),
+        jnp.full((q, l), -1, dtype=jnp.int32),
+        jnp.full((q, l), -1, dtype=jnp.int32),
+        jnp.int32(0),
+    )
+    local_f, _, _, adm_k, adm_cycle, cycles = lax.while_loop(cond, cycle_body, init)
+    return DrainResult(
+        admitted_k=adm_k,
+        admitted_cycle=adm_cycle,
+        cycles=cycles,
+        local_usage=local_f,
+    )
+
+
+solve_drain_jit = jax.jit(
+    solve_drain, static_argnames=("n_segments", "n_steps", "max_cycles")
+)
+
+
+def _solve_drain_packed(
+    tree, local_usage, queues, paths, n_segments: int, n_steps: int, max_cycles: int
+):
+    """solve_drain with the decision tensors flattened into one int32
+    vector so the host retrieves the whole drain in a single fetch."""
+    r = solve_drain(
+        tree, local_usage, queues, paths, n_segments, n_steps, max_cycles
+    )
+    return jnp.concatenate(
+        [
+            r.admitted_k.reshape(-1),
+            r.admitted_cycle.reshape(-1),
+            r.cycles[None],
+        ]
+    )
+
+
+solve_drain_packed_jit = jax.jit(
+    _solve_drain_packed, static_argnames=("n_segments", "n_steps", "max_cycles")
+)

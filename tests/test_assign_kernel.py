@@ -261,3 +261,114 @@ def test_lower_heads_fallback_routes():
     snapshot = take_snapshot(cache)
     lowered = lower_heads(snapshot, [(wl, "cq")], cache.flavors)
     assert lowered.fallback == [0]
+
+
+class TestSegmentedEquivalence:
+    """solve_cycle_segmented must match the reference O(W) scan
+    (solve_cycle) bit-for-bit on every output."""
+
+    @staticmethod
+    def _problem(seed, n_cq=48, n_cohort=6, fr=8, w=64, k=3, c=3,
+                 loose_cqs=4, with_limits=True, with_reserve=True):
+        from kueue_tpu._jax import jnp
+        from kueue_tpu.ops.assign_kernel import HeadsBatch, build_paths, build_roots
+        from kueue_tpu.ops.quota import NO_LIMIT, QuotaTree
+
+        rng = np.random.default_rng(seed)
+        n = n_cq + n_cohort
+        parent = np.full(n, -1, dtype=np.int32)
+        # most CQs under cohorts; a few parentless (their own roots)
+        parent[:n_cq - loose_cqs] = n_cq + rng.integers(
+            0, n_cohort, size=n_cq - loose_cqs
+        )
+        level_mask = np.zeros((2, n), dtype=bool)
+        level_mask[0, n_cq:] = True
+        level_mask[0, n_cq - loose_cqs:n_cq] = True  # parentless CQs at root level
+        level_mask[1, :n_cq - loose_cqs] = True
+        nominal = np.zeros((n, fr), dtype=np.int64)
+        nominal[:n_cq] = rng.integers(5, 60, size=(n_cq, fr))
+        lend = np.full((n, fr), NO_LIMIT, dtype=np.int64)
+        borrow = np.full((n, fr), NO_LIMIT, dtype=np.int64)
+        if with_limits:
+            mask = rng.random((n_cq, fr)) < 0.3
+            lend[:n_cq][mask] = rng.integers(0, 20, size=int(mask.sum()))
+            mask = rng.random((n_cq, fr)) < 0.3
+            borrow[:n_cq][mask] = rng.integers(0, 20, size=int(mask.sum()))
+        tree = QuotaTree(
+            parent=jnp.asarray(parent),
+            level_mask=jnp.asarray(level_mask),
+            nominal=jnp.asarray(nominal),
+            lending_limit=jnp.asarray(lend),
+            borrowing_limit=jnp.asarray(borrow),
+        )
+        paths = jnp.asarray(build_paths(parent, 1))
+        roots = build_roots(parent)
+        local_usage = np.zeros((n, fr), dtype=np.int64)
+        local_usage[:n_cq] = rng.integers(0, 30, size=(n_cq, fr))
+
+        cq_row = np.full(w, -1, dtype=np.int32)
+        n_heads = min(w - 2, n_cq)  # leave some padding rows
+        cq_row[:n_heads] = rng.permutation(n_cq)[:n_heads]
+        seg_id = np.full(w, -1, dtype=np.int32)
+        live = cq_row >= 0
+        uniq, inv = np.unique(roots[cq_row[live]], return_inverse=True)
+        seg_id[live] = inv.astype(np.int32)
+        n_segments = len(uniq)
+        cells = rng.integers(0, fr, size=(w, k, c)).astype(np.int32)
+        # some unused cell slots
+        cells[rng.random((w, k, c)) < 0.2] = -1
+        qty = rng.integers(0, 25, size=(w, k, c)).astype(np.int64)
+        valid = rng.random((w, k)) < 0.9
+        batch = HeadsBatch(
+            cq_row=jnp.asarray(cq_row),
+            cells=jnp.asarray(cells),
+            qty=jnp.asarray(qty),
+            valid=jnp.asarray(valid),
+            priority=jnp.asarray(rng.integers(0, 5, size=w).astype(np.int64)),
+            timestamp=jnp.asarray(rng.permutation(w).astype(np.int64)),
+            no_reclaim=jnp.asarray(
+                (rng.random(w) < 0.5) if with_reserve else np.zeros(w, bool)
+            ),
+        )
+        return tree, jnp.asarray(local_usage), batch, paths, jnp.asarray(seg_id), n_segments
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_matches_scan(self, seed):
+        from kueue_tpu.ops.assign_kernel import (
+            solve_cycle_jit,
+            solve_cycle_segmented_jit,
+        )
+
+        tree, usage, batch, paths, seg_id, n_seg = self._problem(seed)
+        ref = solve_cycle_jit(tree, usage, batch, paths)
+        # generous step bound (>= max heads per root) and a tight one
+        for n_steps in (64, 32):
+            seg = solve_cycle_segmented_jit(
+                tree, usage, batch, paths, seg_id,
+                n_segments=n_seg, n_steps=n_steps,
+            )
+            np.testing.assert_array_equal(np.asarray(seg.chosen), np.asarray(ref.chosen))
+            np.testing.assert_array_equal(
+                np.asarray(seg.admitted), np.asarray(ref.admitted), err_msg=f"seed {seed}"
+            )
+            np.testing.assert_array_equal(
+                np.asarray(seg.reserved), np.asarray(ref.reserved)
+            )
+            np.testing.assert_array_equal(np.asarray(seg.usage), np.asarray(ref.usage))
+            np.testing.assert_array_equal(np.asarray(seg.order), np.asarray(ref.order))
+
+    def test_single_root_degenerates_to_scan(self):
+        from kueue_tpu.ops.assign_kernel import (
+            solve_cycle_jit,
+            solve_cycle_segmented_jit,
+        )
+
+        tree, usage, batch, paths, seg_id, n_seg = self._problem(
+            3, n_cq=16, n_cohort=1, loose_cqs=0, w=20
+        )
+        ref = solve_cycle_jit(tree, usage, batch, paths)
+        seg = solve_cycle_segmented_jit(
+            tree, usage, batch, paths, seg_id, n_segments=n_seg, n_steps=32
+        )
+        np.testing.assert_array_equal(np.asarray(seg.admitted), np.asarray(ref.admitted))
+        np.testing.assert_array_equal(np.asarray(seg.usage), np.asarray(ref.usage))

@@ -1,0 +1,156 @@
+"""On-device bulk drain vs the sequential host scheduler.
+
+The drain kernel runs the whole multi-cycle backlog on device; for
+preemption-free, fully-representable backlogs its decisions — who is
+admitted, with which flavors, in which cycle — must match running the
+host Scheduler cycle-by-cycle to quiescence.
+"""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.core.drain import run_drain
+from kueue_tpu.core.queue_manager import queue_order_timestamp
+from kueue_tpu.core.snapshot import take_snapshot
+
+from tests.test_solver_path import build_env, random_spec
+
+
+def host_drain_trace(spec):
+    """Drain via the host scheduler; returns {wl name: (flavors, cycle)}
+    plus the parked set."""
+    sched, mgr, cache, _ = build_env(spec, use_solver=False)
+    admitted = {}
+    cycle = 0
+    for _ in range(200):
+        # quiescent only when every active heap is empty — a cycle that
+        # parks its head uncovers the next workload behind it
+        if not any(
+            pq.pending_active() > 0 for pq in mgr.cluster_queues.values()
+        ):
+            break
+        res = sched.schedule()
+        for e in res.admitted:
+            psa = e.workload.admission.pod_set_assignments[0]
+            admitted[e.workload.name] = (dict(psa.flavors), cycle)
+        cycle += 1
+    parked = {
+        wl.name
+        for pq in mgr.cluster_queues.values()
+        for wl in list(pq.inadmissible.values()) + list(pq.heap.items())
+    }
+    return admitted, parked
+
+
+def device_drain_trace(spec):
+    sched, mgr, cache, _ = build_env(spec, use_solver=False)
+    # collect the backlog in per-CQ heap order
+    pending = []
+    for cq_name, pq in mgr.cluster_queues.items():
+        for wl in pq.snapshot_sorted():
+            pending.append((wl, cq_name))
+    snapshot = take_snapshot(cache)
+    outcome = run_drain(
+        snapshot,
+        pending,
+        cache.flavors,
+        timestamp_fn=lambda wl: queue_order_timestamp(wl, mgr._ts_policy),
+    )
+    admitted = {
+        wl.name: (flavors, cycle) for wl, _, flavors, cycle in outcome.admitted
+    }
+    parked = {wl.name for wl, _ in outcome.parked}
+    return admitted, parked, outcome
+
+
+class TestDrainParity:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_randomized(self, seed):
+        spec = random_spec(seed, workloads_per_cq=8)
+        host_admitted, host_parked = host_drain_trace(spec)
+        dev_admitted, dev_parked, outcome = device_drain_trace(spec)
+        assert not outcome.fallback
+        assert dev_admitted == host_admitted
+        assert dev_parked == host_parked
+
+    def test_multi_flavor_spillover(self):
+        # second flavor absorbs what the first can't; drain must walk
+        # candidates exactly like the host
+        spec = {
+            "flavors": ["fast", "slow"],
+            "cqs": [
+                {
+                    "name": "cq",
+                    "cohort": "co",
+                    "groups": [
+                        {
+                            "resources": ["cpu"],
+                            "flavors": [
+                                ("fast", {"cpu": "4"}, None, None),
+                                ("slow", {"cpu": "100"}, None, None),
+                            ],
+                        }
+                    ],
+                    "preemption": None,
+                }
+            ],
+            "workloads": [
+                {
+                    "name": f"w{i}",
+                    "queue": "lq-cq",
+                    "prio": 0,
+                    "t": float(i),
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "3"}}
+                    ],
+                }
+                for i in range(6)
+            ],
+        }
+        host_admitted, _ = host_drain_trace(spec)
+        dev_admitted, _, _ = device_drain_trace(spec)
+        assert dev_admitted == host_admitted
+        # first workload on "fast", rest spill to "slow"
+        assert dev_admitted["w0"][0] == {"cpu": "fast"}
+        assert dev_admitted["w1"][0] == {"cpu": "slow"}
+
+    def test_cohort_borrowing_contention(self):
+        # shared cohort capacity: cross-CQ conflicts resolved per cycle
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": f"cq-{i}",
+                    "cohort": "co",
+                    "groups": [
+                        {
+                            "resources": ["cpu"],
+                            "flavors": [("f", {"cpu": "4"}, None, None)],
+                        }
+                    ],
+                    "preemption": None,
+                }
+                for i in range(4)
+            ],
+            "workloads": [
+                {
+                    "name": f"w{i}",
+                    "queue": f"lq-cq-{i % 4}",
+                    "prio": (i * 7) % 3,
+                    "t": float(i),
+                    "pod_sets": [
+                        {
+                            "name": "main",
+                            "count": 1,
+                            "requests": {"cpu": str(2 + (i % 5))},
+                        }
+                    ],
+                }
+                for i in range(20)
+            ],
+        }
+        host_admitted, host_parked = host_drain_trace(spec)
+        dev_admitted, dev_parked, outcome = device_drain_trace(spec)
+        assert dev_admitted == host_admitted
+        assert dev_parked == host_parked
+        assert outcome.cycles >= 2
