@@ -1,31 +1,159 @@
-"""Fair-sharing admission ordering.
+"""Fair-sharing admission ordering — lazy tournament iterator.
 
-Equivalent of ``pkg/scheduler/fair_sharing_iterator.go``: when fair
-sharing is enabled, entries are ordered by the DominantResourceShare
-their ClusterQueue would have *after* admitting them, so capacity flows
-to the least-served tenant first. Ties fall back to the classical key
-(non-borrowing first, priority, FIFO).
+Equivalent of ``pkg/scheduler/fair_sharing_iterator.go:33-120``: when
+fair sharing is enabled the scheduler does not sort entries once — it
+pops them one at a time, and every pop re-evaluates DominantResourceShare
+against the *current* snapshot (which earlier admissions in the same
+cycle have already mutated via ``add_usage``). Each pop runs a
+tournament over the picked entry's cohort tree:
 
-The snapshot's usage doesn't change while ordering (admission happens
-afterwards, with per-entry fit re-checks), so each entry's key is
-computed exactly once and sorted — equivalent to the reference's
-tournament over an unchanged snapshot without the O(n^2) re-evaluation.
+- every remaining head in the tree simulates its own admission
+  (usage addition), and its DRS — and the DRS of every ancestor cohort
+  with that usage included — is recorded per (parent-cohort, workload),
+- the tournament recursively nominates one winner per cohort node:
+  children (CQs and sub-cohorts) are compared at their parent by the
+  DRS value recorded for that parent level, with ties broken by
+  priority (behind the PrioritySortingWithinCohort gate) then FIFO
+  timestamp,
+- the root's winner is yielded and removed; the next pop recomputes.
+
+Entries whose ClusterQueue has no cohort are yielded directly (no
+tournament). Order across distinct cohort trees is unspecified in the
+reference (Go map iteration); here it is deterministic: lowest original
+entry index first.
 """
 
 from __future__ import annotations
 
-from typing import Callable, List
+from collections import deque
+from typing import Callable, Dict, Iterator, List, Tuple
+
+import numpy as np
 
 from kueue_tpu.core.snapshot import Snapshot
 
 
-def fair_sharing_order(entries: List, snapshot: Snapshot, base_key: Callable) -> List:
-    def key(e):
-        if e.cq_name in snapshot.cq_models and e.assignment is not None:
-            wl_vec = snapshot.vector_of(e.assignment.usage)
-            drs = snapshot.dominant_resource_share(e.cq_name, wl_vec)
-        else:
-            drs = 0
-        return (drs,) + tuple(base_key(e))
+def _root_of(parent: np.ndarray, row: int) -> int:
+    r = row
+    while parent[r] >= 0:
+        r = int(parent[r])
+    return r
 
-    return sorted(entries, key=key)
+
+def fair_sharing_iter(
+    entries: List, snapshot: Snapshot, tie_key: Callable
+) -> Iterator:
+    """Yield entries in fair-sharing tournament order, re-evaluating DRS
+    between pops. ``tie_key(e)`` must return the non-DRS comparison key
+    (priority/FIFO), already accounting for feature gates."""
+    # heads keyed by CQ row; deque guards against (unexpected) multiple
+    # entries per CQ — the reference's map would silently overwrite.
+    by_row: Dict[int, deque] = {}
+    order_idx: Dict[int, int] = {}
+    pending: List = []
+    for i, e in enumerate(entries):
+        order_idx[id(e)] = i
+        if e.cq_name in snapshot.cq_models:
+            by_row.setdefault(snapshot.row(e.cq_name), deque()).append(e)
+        else:
+            pending.append(e)  # unknown CQ: no tournament to run
+
+    for e in pending:
+        yield e
+
+    parent = snapshot.flat.parent
+    # tree topology and per-entry keys are fixed for the iterator's
+    # lifetime — compute once, not per pop
+    n_nodes = parent.shape[0]
+    children: Dict[int, Tuple[List[int], List[int]]] = {}
+    for row in range(snapshot.flat.n_cq, n_nodes):
+        children[row] = snapshot.children_of(row)
+    root_cache: Dict[int, int] = {}
+    usage_cache: Dict[int, np.ndarray] = {}
+    tie_cache: Dict[int, tuple] = {}
+
+    def root_of(row: int) -> int:
+        r = root_cache.get(row)
+        if r is None:
+            r = root_cache[row] = _root_of(parent, row)
+        return r
+
+    def entry_usage(e) -> np.ndarray:
+        vec = usage_cache.get(id(e))
+        if vec is None:
+            if e.assignment is not None:
+                vec = snapshot.vector_of(e.assignment.usage)
+            else:
+                vec = np.zeros(len(snapshot.fr_list), dtype=np.int64)
+            usage_cache[id(e)] = vec
+        return vec
+
+    def entry_tie(e) -> tuple:
+        t = tie_cache.get(id(e))
+        if t is None:
+            t = tie_cache[id(e)] = tuple(tie_key(e))
+        return t
+
+    def compute_drs(root: int) -> Dict[Tuple[int, int], int]:
+        """fair_sharing_iterator.go computeDRS: for every remaining head
+        under ``root``, simulate its admission and record, at each
+        ancestor cohort level, the DRS of the child node on the path
+        (with the workload's usage included)."""
+        drs: Dict[Tuple[int, int], int] = {}
+        for row, dq in by_row.items():
+            if not dq or root_of(row) != root:
+                continue
+            e = dq[0]
+            vec = entry_usage(e)
+            snapshot.local_usage[row] += vec
+            dws = snapshot.all_node_drs()
+            snapshot.local_usage[row] -= vec
+            cur = int(dws[row])
+            for anc in snapshot.path_to_root(row):
+                drs[(anc, id(e))] = cur
+                cur = int(dws[anc])
+        return drs
+
+    def tournament(row: int, drs: Dict[Tuple[int, int], int]):
+        """runTournament: one winner per cohort node, compared at this
+        node by its recorded DRS, then tie_key, then original index."""
+        cq_rows, cohort_rows = children[row]
+        candidates = []
+        for cr in cohort_rows:
+            w = tournament(cr, drs)
+            if w is not None:
+                candidates.append(w)
+        for qr in cq_rows:
+            dq = by_row.get(qr)
+            if dq:
+                candidates.append(dq[0])
+        if not candidates:
+            return None
+        return min(
+            candidates,
+            key=lambda e: (
+                drs.get((row, id(e)), 0),
+                entry_tie(e),
+                order_idx[id(e)],
+            ),
+        )
+
+    while by_row:
+        # deterministic getCq: lowest original index among remaining heads
+        first = min(
+            (dq[0] for dq in by_row.values() if dq),
+            key=lambda e: order_idx[id(e)],
+        )
+        row = snapshot.row(first.cq_name)
+        if parent[row] < 0:
+            winner = first
+        else:
+            root = root_of(row)
+            winner = tournament(root, compute_drs(root))
+            if winner is None:  # unreachable: first is in the tree
+                winner = first
+        wrow = snapshot.row(winner.cq_name)
+        by_row[wrow].popleft()
+        if not by_row[wrow]:
+            del by_row[wrow]
+        yield winner

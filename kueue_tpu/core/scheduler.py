@@ -574,12 +574,27 @@ class Scheduler:
         return assignment
 
     # ---- ordering (scheduler.go:561-642) ----
-    def _iterate(self, entries: List[Entry], snapshot: Snapshot) -> List[Entry]:
+    def _iterate(self, entries: List[Entry], snapshot: Snapshot):
         if self.fair_sharing:
-            from kueue_tpu.core.fair_sharing_iterator import fair_sharing_order
+            from kueue_tpu.core.fair_sharing_iterator import fair_sharing_iter
 
-            return fair_sharing_order(entries, snapshot, self._entry_sort_key)
+            # lazy: each pop re-evaluates DRS against the snapshot as
+            # mutated by admissions earlier in this cycle
+            return fair_sharing_iter(entries, snapshot, self._fair_tie_key)
         return sorted(entries, key=self._entry_sort_key)
+
+    def _fair_tie_key(self, e: "Entry"):
+        """Non-DRS tournament tiebreak (fair_sharing_iterator.go less()):
+        priority desc behind PrioritySortingWithinCohort, then FIFO."""
+        from kueue_tpu.features import enabled
+
+        parts = []
+        if enabled("PrioritySortingWithinCohort"):
+            parts.append(-priority_of(e.workload, self.cache.priority_classes))
+        parts.append(
+            int(queue_order_timestamp(e.workload, self.queues._ts_policy) * 1e9)
+        )
+        return tuple(parts)
 
     def _entry_sort_key(self, e: Entry):
         borrows = e.assignment.borrowing if e.assignment else False
