@@ -44,6 +44,10 @@ class DrainOutcome:
     parked: List[Tuple[Workload, str]]
     fallback: List[Tuple[Workload, str]]
     cycles: int
+    # max_cycles hit before quiescence: entries the kernel never
+    # processed were routed to ``fallback`` (not parked), so the cycle
+    # loop — not a silent park — decides them
+    truncated: bool = False
 
 
 def plan_drain(
@@ -131,8 +135,20 @@ def plan_drain(
         seg_id[live] = inv.astype(np.int32)
         n_segments = _bucket(len(uniq), minimum=8)
         n_steps = _bucket(int(np.bincount(inv).max()), minimum=8)
+        # Sound cycle cap: every cycle, each root cohort with live heads
+        # retires at least one entry — its rank-0 valid head admits (no
+        # in-segment predecessor has touched usage yet) and NoFit heads
+        # park unconditionally — so cycles <= the largest segment's
+        # total entry count. Conflict-lost heads retrying per remaining
+        # candidate are covered: each loss pairs with an admission in
+        # the same segment that cycle. (The former 2*L+8 bound wrongly
+        # assumed per-queue progress.)
+        max_seg_events = int(
+            np.bincount(inv, weights=qlen[live].astype(np.float64)).max()
+        )
     else:
         n_segments = n_steps = 8
+        max_seg_events = 0
 
     return DrainPlan(
         queues_np=dict(
@@ -152,10 +168,9 @@ def plan_drain(
         cq_order=cq_order,
         n_segments=n_segments,
         n_steps=n_steps,
-        # every cycle either admits or parks at least one head (a
-        # conflict-lost head implies another head's admission), so 2L+8
-        # cycles always suffice; the while_loop stops at quiescence
-        max_cycles=2 * l + 8,
+        # the while_loop stops at quiescence; this is a backstop only —
+        # bucketed because it is a static jit arg (compile reuse)
+        max_cycles=_bucket(max_seg_events + 8, minimum=16),
     )
 
 
@@ -166,14 +181,20 @@ def run_drain(
     max_candidates: int = 8,
     max_cells: int = 4,
     timestamp_fn=None,
+    max_cycles: Optional[int] = None,
 ) -> DrainOutcome:
-    """Plan + solve + map back, with one device round trip."""
+    """Plan + solve + map back, with one device round trip.
+
+    ``max_cycles`` overrides the computed backstop (operators capping
+    device time; tests exercising truncation routing)."""
     from kueue_tpu._jax import jnp
     from kueue_tpu.ops.drain_kernel import DrainQueues, solve_drain_packed_jit
 
     plan = plan_drain(
         snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
     )
+    if max_cycles is not None:
+        plan.max_cycles = max_cycles
     tree, paths, _ = tree_arrays(snapshot)
     queues = DrainQueues(**{k: jnp.asarray(v) for k, v in plan.queues_np.items()})
 
@@ -188,14 +209,18 @@ def run_drain(
             max_cycles=plan.max_cycles,
         )
     )  # the single fetch
-    ql = plan.queues_np["cells"].shape[0] * plan.queues_np["cells"].shape[1]
-    adm_k = flat[:ql].reshape(plan.queues_np["cells"].shape[:2])
-    adm_cycle = flat[ql : 2 * ql].reshape(adm_k.shape)
+    nq, nl = plan.queues_np["cells"].shape[:2]
+    ql = nq * nl
+    adm_k = flat[:ql].reshape((nq, nl))
+    adm_cycle = flat[ql : 2 * ql].reshape((nq, nl))
+    cursor = flat[2 * ql : 2 * ql + nq]
     cycles = int(flat[-1])
+    truncated = bool(np.any(cursor < plan.queues_np["qlen"]))
 
     lowered = plan.lowered
     admitted: List[Tuple[Workload, str, Dict[str, str], int]] = []
     parked: List[Tuple[Workload, str]] = []
+    extra_fallback: List[Tuple[Workload, str]] = []
     for (qi, pos), i in plan.head_of.items():
         wl = lowered.heads[i]
         cq_name = lowered.cq_names[i]
@@ -204,12 +229,16 @@ def run_drain(
             admitted.append(
                 (wl, cq_name, lowered.candidate_flavors[i][kk], int(adm_cycle[qi, pos]))
             )
+        elif pos >= int(cursor[qi]):
+            # never processed (max_cycles backstop hit): not a decision
+            extra_fallback.append((wl, cq_name))
         else:
             parked.append((wl, cq_name))
     admitted.sort(key=lambda t: t[3])
     fb = [
         (lowered.heads[i], lowered.cq_names[i]) for i in sorted(set(lowered.fallback))
-    ]
+    ] + extra_fallback
     return DrainOutcome(
-        admitted=admitted, parked=parked, fallback=fb, cycles=cycles
+        admitted=admitted, parked=parked, fallback=fb, cycles=cycles,
+        truncated=truncated,
     )

@@ -17,6 +17,12 @@ tournament over the picked entry's cohort tree:
   timestamp,
 - the root's winner is yielded and removed; the next pop recomputes.
 
+Like the reference's computeDRS, the per-head simulation only evaluates
+the head's root-to-leaf path: the base usage tree is built once per pop
+and the head's usage is bubbled up its path incrementally (O(depth x FR)
+per head, not O(N x FR)); lendable capacity (potentialAvailable) depends
+only on quota, so it is computed once per iterator.
+
 Entries whose ClusterQueue has no cohort are yielded directly (no
 tournament). Order across distinct cohort trees is unspecified in the
 reference (Go map iteration); here it is deterministic: lowest original
@@ -31,13 +37,61 @@ from typing import Callable, Dict, Iterator, List, Tuple
 import numpy as np
 
 from kueue_tpu.core.snapshot import Snapshot
+from kueue_tpu.ops.quota import DRS_MAX
 
 
-def _root_of(parent: np.ndarray, row: int) -> int:
-    r = row
-    while parent[r] >= 0:
-        r = int(parent[r])
-    return r
+def path_drs(
+    snapshot: Snapshot,
+    usage0: np.ndarray,
+    pot: np.ndarray,
+    row: int,
+    vec: np.ndarray,
+) -> List[Tuple[int, int]]:
+    """DRS of ``row`` and each ancestor with ``vec`` added at ``row``,
+    as [(node_row, dws)] leaf-to-root. Semantically identical to adding
+    vec to local_usage and reading dominant_resource_share_np at the
+    path rows (property-tested in tests/test_fair_sharing_iterator.py),
+    but restricted to the path."""
+    parent = snapshot.flat.parent
+    resource_index = snapshot.resource_index
+    n_res = len(snapshot.resource_names)
+    out: List[Tuple[int, int]] = []
+    node = row
+    # bubble the addition up the path exactly like usage_tree_np: the
+    # contribution to the parent is the over-guaranteed delta
+    delta = vec
+    while node >= 0:
+        old = usage0[node]
+        new = old + delta
+        p = int(parent[node])
+        borrowed_fr = np.maximum(0, new - snapshot.subtree[node])
+        if p >= 0:
+            borrowed = np.zeros(n_res, dtype=np.int64)
+            np.add.at(borrowed, resource_index, borrowed_fr)
+            lendable = np.zeros(n_res, dtype=np.int64)
+            np.add.at(lendable, resource_index, pot[p])
+            ratio = np.where(
+                (borrowed > 0) & (lendable > 0),
+                borrowed * 1000 // np.maximum(lendable, 1),
+                -1,
+            )
+            if bool((borrowed > 0).any()):
+                weight = int(snapshot.weight_milli[node])
+                if weight == 0:
+                    dws = DRS_MAX
+                else:
+                    num = int(ratio.max()) * 1000
+                    dws = int(np.sign(num) * (abs(num) // max(weight, 1)))
+            else:
+                dws = 0
+        else:
+            dws = 0
+        out.append((node, dws))
+        if p >= 0:
+            g = snapshot.guaranteed[node]
+            delta = np.maximum(0, new - g) - np.maximum(0, old - g)
+        node = p
+    return out
 
 
 def fair_sharing_iter(
@@ -64,19 +118,23 @@ def fair_sharing_iter(
     parent = snapshot.flat.parent
     # tree topology and per-entry keys are fixed for the iterator's
     # lifetime — compute once, not per pop
-    n_nodes = parent.shape[0]
+    from kueue_tpu.ops.assign_kernel import build_roots
+    from kueue_tpu.ops.quota_np import potential_available_all_np
+
+    roots = build_roots(parent)
+    n_cq = snapshot.flat.n_cq
     children: Dict[int, Tuple[List[int], List[int]]] = {}
-    for row in range(snapshot.flat.n_cq, n_nodes):
-        children[row] = snapshot.children_of(row)
-    root_cache: Dict[int, int] = {}
+    for i, p in enumerate(parent):
+        p = int(p)
+        if p >= 0:
+            slot = children.setdefault(p, ([], []))
+            slot[0 if i < n_cq else 1].append(i)
+    pot = potential_available_all_np(
+        parent, snapshot.flat.level_masks(), snapshot.subtree,
+        snapshot.guaranteed, snapshot.borrowing_limit,
+    )
     usage_cache: Dict[int, np.ndarray] = {}
     tie_cache: Dict[int, tuple] = {}
-
-    def root_of(row: int) -> int:
-        r = root_cache.get(row)
-        if r is None:
-            r = root_cache[row] = _root_of(parent, row)
-        return r
 
     def entry_usage(e) -> np.ndarray:
         vec = usage_cache.get(id(e))
@@ -100,24 +158,22 @@ def fair_sharing_iter(
         ancestor cohort level, the DRS of the child node on the path
         (with the workload's usage included)."""
         drs: Dict[Tuple[int, int], int] = {}
+        usage0 = snapshot.usage()  # shared base tree for this pop
         for row, dq in by_row.items():
-            if not dq or root_of(row) != root:
+            if not dq or roots[row] != root:
                 continue
             e = dq[0]
-            vec = entry_usage(e)
-            snapshot.local_usage[row] += vec
-            dws = snapshot.all_node_drs()
-            snapshot.local_usage[row] -= vec
-            cur = int(dws[row])
-            for anc in snapshot.path_to_root(row):
-                drs[(anc, id(e))] = cur
-                cur = int(dws[anc])
+            chain = path_drs(snapshot, usage0, pot, row, entry_usage(e))
+            # value recorded at an ancestor = DRS of the child on the
+            # path (the node one step below it)
+            for (node, dws), (anc, _) in zip(chain, chain[1:]):
+                drs[(anc, id(e))] = dws
         return drs
 
     def tournament(row: int, drs: Dict[Tuple[int, int], int]):
         """runTournament: one winner per cohort node, compared at this
         node by its recorded DRS, then tie_key, then original index."""
-        cq_rows, cohort_rows = children[row]
+        cq_rows, cohort_rows = children.get(row, ([], []))
         candidates = []
         for cr in cohort_rows:
             w = tournament(cr, drs)
@@ -148,8 +204,7 @@ def fair_sharing_iter(
         if parent[row] < 0:
             winner = first
         else:
-            root = root_of(row)
-            winner = tournament(root, compute_drs(root))
+            winner = tournament(int(roots[row]), compute_drs(int(roots[row])))
             if winner is None:  # unreachable: first is in the tree
                 winner = first
         wrow = snapshot.row(winner.cq_name)

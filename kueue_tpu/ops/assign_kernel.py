@@ -376,6 +376,32 @@ def solve_cycle(
 solve_cycle_jit = jax.jit(solve_cycle, static_argnames=())
 
 
+def segmented_rank(seg: jnp.ndarray, valid_sorted: jnp.ndarray) -> jnp.ndarray:
+    """Per sorted slot: how many valid same-segment predecessors it has.
+
+    Sort-plus-cumsum formulation — O(W log W) compute, O(W) memory —
+    replacing the former W x W pairwise mask, which was quadratic and
+    capped the usable head/queue count (~1k) well below the 10k+-CQ
+    shapes the drain targets. A stable sort groups slots by segment
+    while preserving slot order; within each run the exclusive cumsum of
+    the valid flags minus the run-start offset is exactly the pairwise
+    rank.
+    """
+    w = seg.shape[0]
+    order2 = jnp.lexsort((jnp.arange(w), seg))  # group by segment, keep slot order
+    valid2 = valid_sorted[order2].astype(jnp.int32)
+    seg2 = seg[order2]
+    excl = jnp.cumsum(valid2) - valid2  # exclusive prefix count of valid
+    first = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), seg2[1:] != seg2[:-1]]
+    )
+    # excl is nondecreasing, so a running max of run-start values always
+    # holds the CURRENT run's start offset
+    base = lax.cummax(jnp.where(first, excl, -1))
+    rank2 = (excl - base).astype(jnp.int32)
+    return jnp.zeros(w, dtype=jnp.int32).at[order2].set(rank2)
+
+
 def solve_cycle_segmented(
     tree: QuotaTree,
     local_usage: jnp.ndarray,
@@ -427,9 +453,7 @@ def solve_cycle_segmented(
     seg = jnp.maximum(seg_id, 0)[order]  # [W]
     valid_sorted = (heads.cq_row[order] >= 0) & (seg_id[order] >= 0) & (~nofit[order])
     # rank = number of valid same-segment predecessors in sorted order
-    same = seg[None, :] == seg[:, None]  # [W, W]
-    before = jnp.tril(jnp.ones((w, w), dtype=bool), k=-1)
-    rank = jnp.sum(same & before & valid_sorted[None, :], axis=1)  # [W]
+    rank = segmented_rank(seg, valid_sorted)  # [W]
 
     # schedule matrix: mat[s, g] = head index processed at step s
     rank_scatter = jnp.where(valid_sorted, rank, n_steps)  # OOB rows drop

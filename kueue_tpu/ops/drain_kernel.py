@@ -45,6 +45,7 @@ from kueue_tpu.ops.assign_kernel import (
     HeadsBatch,
     _avail_along_path,
     phase1_classify,
+    segmented_rank,
 )
 from kueue_tpu.ops.quota import NO_LIMIT, QuotaTree, subtree_quota, usage_tree
 
@@ -85,11 +86,14 @@ class DrainQueues(NamedTuple):
 class DrainResult(NamedTuple):
     """admitted_k: int32[Q,L] chosen candidate per queue entry (-1 =
     never admitted); admitted_cycle: int32[Q,L] cycle index of the
-    admission (-1 = never); cycles: int32 scalar — cycles executed;
-    local_usage: int64[N,FR] final leaf usage."""
+    admission (-1 = never); cursor: int32[Q] final queue position —
+    entries at pos >= cursor were never processed (max_cycles hit);
+    cycles: int32 scalar — cycles executed; local_usage: int64[N,FR]
+    final leaf usage."""
 
     admitted_k: jnp.ndarray
     admitted_cycle: jnp.ndarray
+    cursor: jnp.ndarray
     cycles: jnp.ndarray
     local_usage: jnp.ndarray
 
@@ -152,9 +156,7 @@ def solve_drain(
         )
         seg = jnp.maximum(queues.seg_id, 0)[order]
         valid_sorted = active[order] & (queues.seg_id[order] >= 0) & (~nofit[order])
-        same = seg[None, :] == seg[:, None]
-        before = jnp.tril(jnp.ones((q, q), dtype=bool), k=-1)
-        rank = jnp.sum(same & before & valid_sorted[None, :], axis=1)
+        rank = segmented_rank(seg, valid_sorted)
         rank_scatter = jnp.where(valid_sorted, rank, n_steps)
         mat = (
             jnp.full((n_steps, n_segments), -1, dtype=jnp.int32)
@@ -280,10 +282,13 @@ def solve_drain(
         jnp.full((q, l), -1, dtype=jnp.int32),
         jnp.int32(0),
     )
-    local_f, _, _, adm_k, adm_cycle, cycles = lax.while_loop(cond, cycle_body, init)
+    local_f, cursor_f, _, adm_k, adm_cycle, cycles = lax.while_loop(
+        cond, cycle_body, init
+    )
     return DrainResult(
         admitted_k=adm_k,
         admitted_cycle=adm_cycle,
+        cursor=cursor_f,
         cycles=cycles,
         local_usage=local_f,
     )
@@ -306,6 +311,7 @@ def _solve_drain_packed(
         [
             r.admitted_k.reshape(-1),
             r.admitted_cycle.reshape(-1),
+            r.cursor,
             r.cycles[None],
         ]
     )

@@ -154,3 +154,107 @@ class TestDrainParity:
         assert dev_admitted == host_admitted
         assert dev_parked == host_parked
         assert outcome.cycles >= 2
+
+
+def deep_tree_spec(seed, depth=3, fanout=2, workloads_per_cq=5):
+    """Cohort tree of the given depth: root holds the quota, interior
+    cohorts are pass-through, CQs at the leaves borrow all the way up."""
+    rng = np.random.default_rng(seed)
+    cohorts = [
+        {
+            "name": "root",
+            "groups": [
+                {"resources": ["cpu"], "flavors": [("f", {"cpu": "40"}, None, None)]}
+            ],
+        }
+    ]
+    parents = ["root"]
+    for d in range(1, depth):
+        nxt = []
+        for p in parents:
+            for i in range(fanout):
+                name = f"{p}-{i}"
+                cohorts.append({"name": name, "parent": p})
+                nxt.append(name)
+        parents = nxt
+    cqs = []
+    workloads = []
+    t = 0.0
+    for p in parents:
+        name = f"cq-{p}"
+        cqs.append(
+            {
+                "name": name,
+                "cohort": p,
+                "groups": [
+                    {
+                        "resources": ["cpu"],
+                        "flavors": [("f", {"cpu": "2"}, None, None)],
+                    }
+                ],
+                "preemption": None,
+            }
+        )
+        for wi in range(workloads_per_cq):
+            t += 1.0
+            workloads.append(
+                {
+                    "name": f"w-{name}-{wi}",
+                    "queue": f"lq-{name}",
+                    "prio": int(rng.integers(0, 3)),
+                    "t": t,
+                    "pod_sets": [
+                        {
+                            "name": "main",
+                            "count": 1,
+                            "requests": {"cpu": str(int(rng.integers(1, 6)))},
+                        }
+                    ],
+                }
+            )
+    return {"flavors": ["f"], "cohorts": cohorts, "cqs": cqs, "workloads": workloads}
+
+
+class TestDrainDeepTree:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_depth3_parity(self, seed):
+        spec = deep_tree_spec(seed)
+        host_admitted, host_parked = host_drain_trace(spec)
+        dev_admitted, dev_parked, outcome = device_drain_trace(spec)
+        assert not outcome.fallback
+        assert not outcome.truncated
+        assert dev_admitted == host_admitted
+        assert dev_parked == host_parked
+
+
+class TestDrainTruncation:
+    def test_max_cycles_routes_unprocessed_to_fallback(self):
+        spec = random_spec(3, workloads_per_cq=8)
+        sched, mgr, cache, _ = build_env(spec, use_solver=False)
+        pending = []
+        for cq_name, pq in mgr.cluster_queues.items():
+            for wl in pq.snapshot_sorted():
+                pending.append((wl, cq_name))
+        snapshot = take_snapshot(cache)
+        kwargs = dict(
+            flavors=cache.flavors,
+            timestamp_fn=lambda wl: queue_order_timestamp(wl, mgr._ts_policy),
+        )
+        cut = run_drain(snapshot, pending, max_cycles=1, **kwargs)
+        assert cut.truncated
+        assert cut.cycles == 1
+        assert cut.fallback  # unprocessed entries are NOT silently parked
+        snapshot2 = take_snapshot(cache)
+        full = run_drain(snapshot2, pending, **kwargs)
+        assert not full.truncated
+        # decided prefixes agree; everything else was surfaced as fallback
+        decided = {wl.name for wl, *_ in cut.admitted} | {
+            wl.name for wl, _ in cut.parked
+        }
+        full_admitted = {wl.name for wl, *_ in full.admitted}
+        for wl, *_ in cut.admitted:
+            assert wl.name in full_admitted
+        assert (
+            decided | {wl.name for wl, _ in cut.fallback}
+            == {wl.name for wl, _ in pending}
+        )

@@ -163,6 +163,71 @@ def test_drs_recorded_per_ancestor_level():
     assert [e.workload.name for e in result.admitted] == ["w2", "w1"]
 
 
+def test_path_drs_matches_full_tree_drs():
+    """Property: the path-restricted DRS chain equals adding the vector
+    to local_usage and reading all_node_drs at the path rows."""
+    import random
+
+    from kueue_tpu.core.fair_sharing_iterator import path_drs
+    from kueue_tpu.ops.quota_np import potential_available_all_np
+
+    rng = random.Random(7)
+    for trial in range(12):
+        cache = Cache()
+        cache.add_or_update_flavor(ResourceFlavor(name="default"))
+        cache.add_or_update_cohort(
+            cohort_with_quota("root", str(rng.randint(10, 80)))
+        )
+        mids = []
+        for m in range(rng.randint(1, 3)):
+            name = f"mid-{m}"
+            mids.append(name)
+            cache.add_or_update_cohort(
+                cohort_with_quota(name, str(rng.randint(0, 20)), parent="root")
+            )
+        cq_names = []
+        for i in range(rng.randint(2, 5)):
+            name = f"cq-{i}"
+            cq_names.append(name)
+            parent = rng.choice(mids + ["root"])
+            w = rng.choice([0, 500, 1000, 2000])
+            cache.add_or_update_cluster_queue(
+                cq(name, cpu=str(rng.randint(0, 8)), cohort=parent, weight=w)
+            )
+        # pre-existing usage
+        from kueue_tpu.core.workload_info import make_admission
+        from kueue_tpu.models import WorkloadConditionType
+
+        for i, name in enumerate(cq_names):
+            if rng.random() < 0.6:
+                wl = pending(f"adm-{i}", name, str(rng.randint(1, 12)))
+                wl.admission = make_admission(
+                    name, {"main": {"cpu": "default"}}, wl
+                )
+                wl.set_condition(
+                    WorkloadConditionType.QUOTA_RESERVED, True,
+                    reason="QuotaReserved", now=0.0,
+                )
+                cache.add_or_update_workload(wl)
+
+        snap = take_snapshot(cache)
+        pot = potential_available_all_np(
+            snap.flat.parent, snap.flat.level_masks(), snap.subtree,
+            snap.guaranteed, snap.borrowing_limit,
+        )
+        for name in cq_names:
+            row = snap.row(name)
+            vec = np.zeros(len(snap.fr_list), dtype=np.int64)
+            if snap.fr_list:
+                vec[rng.randrange(len(snap.fr_list))] = rng.randint(0, 15000)
+            chain = path_drs(snap, snap.usage(), pot, row, vec)
+            snap.local_usage[row] += vec
+            full = snap.all_node_drs()
+            snap.local_usage[row] -= vec
+            for node, dws in chain:
+                assert dws == int(full[node]), (trial, name, node)
+
+
 def test_iterator_yields_every_entry_exactly_once():
     cache = Cache()
     cache.add_or_update_flavor(ResourceFlavor(name="default"))

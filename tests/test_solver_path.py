@@ -35,6 +35,22 @@ def build_env(spec, use_solver):
     cache = Cache()
     for fname in spec["flavors"]:
         cache.add_or_update_flavor(ResourceFlavor(name=fname))
+    for c in spec.get("cohorts", []):
+        from kueue_tpu.models.cohort import Cohort
+
+        groups = tuple(
+            ResourceGroup(
+                tuple(rg["resources"]),
+                tuple(
+                    FlavorQuotas.build(f, {r: (v, bl, ll) for r, v in q.items()})
+                    for f, q, bl, ll in rg["flavors"]
+                ),
+            )
+            for rg in c.get("groups", [])
+        )
+        cache.add_or_update_cohort(
+            Cohort(name=c["name"], parent=c.get("parent"), resource_groups=groups)
+        )
     mgr = QueueManager(clock=clock)
     for cq_spec in spec["cqs"]:
         groups = []
