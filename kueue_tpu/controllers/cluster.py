@@ -52,6 +52,8 @@ class ClusterRuntime:
         tas_cache=None,
         use_solver: Optional[bool] = None,
         solver_threshold: int = 16,
+        use_preempt_solver: Optional[bool] = None,
+        preempt_solver_threshold: int = 4,
     ):
         from kueue_tpu.metrics import Metrics
 
@@ -91,6 +93,8 @@ class ClusterRuntime:
             events=lambda kind, wl, msg: self.event(kind, wl, msg),
             use_solver=use_solver,
             solver_threshold=solver_threshold,
+            use_preempt_solver=use_preempt_solver,
+            preempt_solver_threshold=preempt_solver_threshold,
         )
         self.job_reconciler = JobReconciler(
             self,

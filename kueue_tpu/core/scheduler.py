@@ -121,6 +121,8 @@ class Scheduler:
         limit_range_validate: Optional[Callable[[Workload], Optional[str]]] = None,
         use_solver: Optional[bool] = None,
         solver_threshold: int = 16,
+        use_preempt_solver: Optional[bool] = None,
+        preempt_solver_threshold: int = 4,
     ):
         self.queues = queues
         self.cache = cache
@@ -147,6 +149,11 @@ class Scheduler:
         # heads), True = always, False = never (host-only oracle path).
         self.use_solver = use_solver
         self.solver_threshold = solver_threshold
+        # Batched TPU victim search for preempt-mode heads: None = auto
+        # (on when the cycle defers >= preempt_solver_threshold preempt
+        # heads), True = always, False = never (host Preemptor loop).
+        self.use_preempt_solver = use_preempt_solver
+        self.preempt_solver_threshold = preempt_solver_threshold
         self.scheduling_cycle = 0
 
     # ---- the cycle (scheduler.go:176-310) ----
@@ -165,6 +172,20 @@ class Scheduler:
         ordered = self._iterate(entries, snapshot)
 
         preempted_keys: Dict[str, WorkloadSnapshot] = {}
+        # Incremental removal: once an entry's targets are accepted they
+        # STAY removed from the snapshot (the reference removes + reverts
+        # every accumulated target per fits() call — scheduler.go:380-388
+        # — which is O(entries x targets) churn; keeping them removed is
+        # observationally identical for every later fits() since those
+        # remove the same set again). removed_acc reconstructs the
+        # pre-removal rows for resourcesToReserve, which the reference
+        # evaluates WITH preempted workloads still present. The fair-
+        # sharing iterator reads snapshot usage between pops, so that
+        # path keeps the reference's remove/revert shape.
+        incremental = not self.fair_sharing
+        removed_acc: Optional[np.ndarray] = (
+            np.zeros_like(snapshot.local_usage) if incremental else None
+        )
         for e in ordered:
             if e.assignment is None:
                 continue
@@ -180,7 +201,7 @@ class Scheduler:
                 cq = snapshot.cq_models[e.cq_name]
                 if not can_always_reclaim(cq):
                     snapshot.add_usage(
-                        e.cq_name, self._reserve_vector(e, snapshot)
+                        e.cq_name, self._reserve_vector(e, snapshot, removed_acc)
                     )
                 continue
 
@@ -198,9 +219,20 @@ class Scheduler:
                 continue
 
             usage_vec = snapshot.vector_of(e.assignment.usage)
-            if not self._fits_after_removals(
-                snapshot, e, usage_vec, preempted_keys
-            ):
+            own_removed: List[WorkloadSnapshot] = []
+            if incremental:
+                for t in e.preemption_targets:
+                    ws = snapshot.remove_workload(t.workload.workload.key)
+                    if ws is not None:
+                        own_removed.append(ws)
+                fits_now = snapshot.fits(e.cq_name, usage_vec)
+            else:
+                fits_now = self._fits_after_removals(
+                    snapshot, e, usage_vec, preempted_keys
+                )
+            if not fits_now:
+                for ws in own_removed:
+                    snapshot.add_workload(ws)
                 e.status = EntryStatus.SKIPPED
                 e.inadmissible_msg = (
                     "Workload no longer fits after processing another workload"
@@ -228,12 +260,17 @@ class Scheduler:
                     e.workload, e.cq_name, e.assignment, snapshot
                 )
                 if tas_msg:
+                    for ws in own_removed:
+                        snapshot.add_workload(ws)
                     e.status = EntryStatus.SKIPPED
                     e.inadmissible_msg = tas_msg
                     continue
 
             for t in e.preemption_targets:
                 preempted_keys[t.workload.workload.key] = t.workload
+            if removed_acc is not None:
+                for ws in own_removed:
+                    removed_acc[ws.cq_row] += ws.usage_vec
             snapshot.add_usage(e.cq_name, usage_vec)
 
             if mode == Mode.PREEMPT:
@@ -275,8 +312,10 @@ class Scheduler:
             plan = self._assign_with_solver(to_assign, snapshot)
             return entries, plan
         assigner = self._make_assigner(snapshot)
+        deferred: List[Entry] = []
         for e in to_assign:
-            self._host_assign(assigner, e, snapshot)
+            self._host_assign(assigner, e, snapshot, deferred)
+        self._resolve_deferred(assigner, deferred, snapshot)
         return entries, None
 
     def _solver_enabled(self, n_assignable: int) -> bool:
@@ -296,15 +335,74 @@ class Scheduler:
         )
 
     def _host_assign(
-        self, assigner: FlavorAssigner, e: Entry, snapshot: Snapshot
+        self,
+        assigner: FlavorAssigner,
+        e: Entry,
+        snapshot: Snapshot,
+        deferred: Optional[List[Entry]] = None,
     ) -> None:
-        assignment, targets = self._get_assignments(
-            assigner, e.workload, e.cq_name, snapshot
-        )
+        """Assign flavors; preempt-mode entries are parked in
+        ``deferred`` (when given) so the whole cycle's victim searches
+        run in ONE batched device dispatch (_resolve_deferred) instead
+        of a sequential simulate/undo loop per head. All searches run
+        against the cycle-start snapshot either way, so deferral cannot
+        change decisions."""
+        if deferred is not None and self.use_preempt_solver is not False:
+            full = assigner.assign(e.workload, e.cq_name)
+            if full.representative_mode() == Mode.PREEMPT:
+                e.assignment = full
+                deferred.append(e)
+                return
+            assignment, targets = self._finish_assignment(
+                assigner, e.workload, e.cq_name, snapshot, full
+            )
+        else:
+            assignment, targets = self._get_assignments(
+                assigner, e.workload, e.cq_name, snapshot
+            )
         e.assignment = assignment
         e.preemption_targets = targets
         e.inadmissible_msg = assignment.message()
         e.workload.last_assignment = assignment.last_state
+
+    def _resolve_deferred(
+        self, assigner: FlavorAssigner, deferred: List[Entry], snapshot: Snapshot
+    ) -> None:
+        """Victim search for every deferred preempt-mode entry —
+        batched on device above the threshold, host loop otherwise."""
+        if not deferred:
+            return
+        batch_on = (
+            self.use_preempt_solver is True
+            or (
+                self.use_preempt_solver is None
+                and len(deferred) >= self.preempt_solver_threshold
+            )
+        )
+        if batch_on:
+            from kueue_tpu.core.preempt_batch import batched_get_targets
+
+            all_targets = batched_get_targets(
+                snapshot,
+                [(e.workload, e.cq_name, e.assignment) for e in deferred],
+                self.preemptor,
+            )
+        else:
+            all_targets = [
+                self.preemptor.get_targets(
+                    e.workload, e.cq_name, e.assignment, snapshot
+                )
+                for e in deferred
+            ]
+        for e, targets in zip(deferred, all_targets):
+            if targets:
+                e.preemption_targets = targets
+            else:
+                e.assignment, e.preemption_targets = self._finish_assignment(
+                    assigner, e.workload, e.cq_name, snapshot, e.assignment
+                )
+            e.inadmissible_msg = e.assignment.message()
+            e.workload.last_assignment = e.assignment.last_state
 
     def _prevalidate(
         self, heads: List[Workload], snapshot: Snapshot
@@ -378,8 +476,10 @@ class Scheduler:
         if len(fallback) == len(to_assign):
             # nothing representable: skip the device dispatch entirely
             assigner = self._make_assigner(snapshot)
+            deferred: List[Entry] = []
             for e in to_assign:
-                self._host_assign(assigner, e, snapshot)
+                self._host_assign(assigner, e, snapshot, deferred)
+            self._resolve_deferred(assigner, deferred, snapshot)
             return None
         res = dispatch_lowered(snapshot, lowered)
         chosen = np.asarray(res.chosen)
@@ -390,8 +490,10 @@ class Scheduler:
         ]
         if host_idx:
             assigner = self._make_assigner(snapshot)
+            host_deferred: List[Entry] = []
             for i in host_idx:
-                self._host_assign(assigner, to_assign[i], snapshot)
+                self._host_assign(assigner, to_assign[i], snapshot, host_deferred)
+            self._resolve_deferred(assigner, host_deferred, snapshot)
         host_set = set(host_idx)
         for i, e in enumerate(to_assign):
             if i in host_set:
@@ -541,14 +643,24 @@ class Scheduler:
         snapshot: Snapshot,
     ) -> Tuple[AssignmentResult, List[PreemptionTarget]]:
         full = assigner.assign(wl, cq_name)
-        mode = full.representative_mode()
-        if mode == Mode.FIT:
-            full = self._with_tas(wl, cq_name, full, snapshot)
-            return full, []
-        if mode == Mode.PREEMPT:
+        if full.representative_mode() == Mode.PREEMPT:
             targets = self.preemptor.get_targets(wl, cq_name, full, snapshot)
             if targets:
                 return full, targets
+        return self._finish_assignment(assigner, wl, cq_name, snapshot, full)
+
+    def _finish_assignment(
+        self,
+        assigner: FlavorAssigner,
+        wl: Workload,
+        cq_name: str,
+        snapshot: Snapshot,
+        full: AssignmentResult,
+    ) -> Tuple[AssignmentResult, List[PreemptionTarget]]:
+        """Tail of getAssignments once preemption targets are known to
+        be absent: TAS attach for Fit, else partial-admission search."""
+        if full.representative_mode() == Mode.FIT:
+            return self._with_tas(wl, cq_name, full, snapshot), []
         if self.partial_admission and any(
             ps.min_count is not None for ps in wl.pod_sets
         ):
@@ -626,7 +738,12 @@ class Scheduler:
         return ok
 
     # ---- capacity reservation on blocked preemption (scheduler.go:391-416) ----
-    def _reserve_vector(self, e: Entry, snapshot: Snapshot) -> np.ndarray:
+    def _reserve_vector(
+        self,
+        e: Entry,
+        snapshot: Snapshot,
+        removed_acc: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
         usage_vec = snapshot.vector_of(e.assignment.usage)
         r = snapshot.row(e.cq_name)
         if e.assignment.representative_mode() != Mode.PREEMPT:
@@ -634,6 +751,12 @@ class Scheduler:
         reserved = np.zeros_like(usage_vec)
         from kueue_tpu.ops.quota import NO_LIMIT
 
+        # the reference evaluates reservation with this cycle's
+        # preempted workloads still counted in usage; under incremental
+        # removal removed_acc restores that view
+        local = snapshot.local_usage[r]
+        if removed_acc is not None:
+            local = local + removed_acc[r]
         for j in range(len(usage_vec)):
             u = int(usage_vec[j])
             if u == 0:
@@ -645,11 +768,11 @@ class Scheduler:
                 else:
                     reserved[j] = min(
                         u,
-                        int(snapshot.nominal[r, j]) + bl - int(snapshot.local_usage[r, j]),
+                        int(snapshot.nominal[r, j]) + bl - int(local[j]),
                     )
             else:
                 reserved[j] = max(
-                    0, min(u, int(snapshot.nominal[r, j]) - int(snapshot.local_usage[r, j]))
+                    0, min(u, int(snapshot.nominal[r, j]) - int(local[j]))
                 )
         return reserved
 

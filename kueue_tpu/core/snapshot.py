@@ -67,6 +67,12 @@ class Snapshot:
     weight_milli: np.ndarray  # int64[N]
     cq_models: Dict[str, ClusterQueue]
     workloads: Dict[str, WorkloadSnapshot] = field(default_factory=dict)
+    # per-CQ workload index (maintained by add/remove_workload) and
+    # memoized root/membership lookups — the preemption candidate scan
+    # hits these once per head per cycle
+    _by_cq: Dict[str, Dict[str, WorkloadSnapshot]] = field(default_factory=dict)
+    _roots: Optional[np.ndarray] = None
+    _members: Dict[int, Set[str]] = field(default_factory=dict)
     inactive_cqs: Tuple[str, ...] = ()
     # AllocatableResourceGeneration per CQ (invalidates LastAssignment)
     generations: Dict[str, int] = field(default_factory=dict)
@@ -127,41 +133,48 @@ class Snapshot:
 
     def add_workload(self, ws: WorkloadSnapshot) -> None:
         self.workloads[ws.workload.key] = ws
+        self._by_cq.setdefault(ws.cq_name, {})[ws.workload.key] = ws
         self.local_usage[ws.cq_row] += ws.usage_vec
 
     def remove_workload(self, wl_key: str) -> Optional[WorkloadSnapshot]:
         ws = self.workloads.pop(wl_key, None)
         if ws is not None:
+            self._by_cq.get(ws.cq_name, {}).pop(wl_key, None)
             self.local_usage[ws.cq_row] -= ws.usage_vec
         return ws
 
     def workloads_in_cq(self, cq_name: str) -> List[WorkloadSnapshot]:
-        return [ws for ws in self.workloads.values() if ws.cq_name == cq_name]
+        return list(self._by_cq.get(cq_name, {}).values())
 
     def workloads_in_cohort_of(self, cq_name: str) -> List[WorkloadSnapshot]:
         members = self.cohort_members(cq_name)
-        return [ws for ws in self.workloads.values() if ws.cq_name in members]
+        return [
+            ws
+            for m in members
+            for ws in self._by_cq.get(m, {}).values()
+        ]
+
+    def roots(self) -> np.ndarray:
+        """int32[N] root node per node, computed once per snapshot."""
+        if self._roots is None:
+            from kueue_tpu.ops.assign_kernel import build_roots
+
+            self._roots = build_roots(self.flat.parent)
+        return self._roots
 
     def cohort_members(self, cq_name: str) -> Set[str]:
         """All CQ names in the same cohort tree (incl. cq_name)."""
-        parent = self.flat.parent
-        roots: Dict[int, int] = {}
-
-        def root_of(i: int) -> int:
-            if i in roots:
-                return roots[i]
-            r = i
-            while parent[r] >= 0:
-                r = int(parent[r])
-            roots[i] = r
-            return r
-
-        me = root_of(self.row(cq_name))
-        return {
-            name
-            for name in self.flat.cq_names
-            if root_of(self.flat.index[name]) == me
-        }
+        roots = self.roots()
+        me = int(roots[self.row(cq_name)])
+        cached = self._members.get(me)
+        if cached is None:
+            cached = {
+                name
+                for name in self.flat.cq_names
+                if int(roots[self.flat.index[name]]) == me
+            }
+            self._members[me] = cached
+        return cached
 
     def has_cohort(self, cq_name: str) -> bool:
         return self.flat.parent[self.row(cq_name)] >= 0

@@ -153,11 +153,14 @@ class Workload:
             raise ValueError("podSet names must be unique")
         if not self.uid:
             self.uid = f"{self.namespace}/{self.name}"
+        # identity is immutable; hot paths (snapshot simulate/undo,
+        # queue maps) read .key millions of times per cycle
+        self._key = f"{self.namespace}/{self.name}"
 
     # ---- identity ----
     @property
     def key(self) -> str:
-        return f"{self.namespace}/{self.name}"
+        return self._key
 
     # ---- condition helpers (pkg/workload semantics) ----
     def condition_true(self, ctype: WorkloadConditionType) -> bool:
