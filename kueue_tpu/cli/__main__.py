@@ -50,26 +50,7 @@ class State:
         items.append(obj)
 
     def build_runtime(self):
-        from kueue_tpu.controllers import ClusterRuntime
-
-        rt = ClusterRuntime()
-        for f in self.data.get("resourceFlavors", []):
-            rt.add_flavor(ser.flavor_from_dict(f))
-        for t in self.data.get("topologies", []):
-            rt.add_topology(ser.topology_from_dict(t))
-        for c in self.data.get("cohorts", []):
-            rt.add_cohort(ser.cohort_from_dict(c))
-        for a in self.data.get("admissionChecks", []):
-            rt.add_admission_check(ser.check_from_dict(a))
-        for p in self.data.get("workloadPriorityClasses", []):
-            rt.add_priority_class(ser.priority_class_from_dict(p))
-        for c in self.data.get("clusterQueues", []):
-            rt.add_cluster_queue(ser.cq_from_dict(c))
-        for l in self.data.get("localQueues", []):
-            rt.add_local_queue(ser.lq_from_dict(l))
-        for w in self.data.get("workloads", []):
-            rt.add_workload(ser.workload_from_dict(w))
-        return rt
+        return ser.runtime_from_state(self.data)
 
 
 def _parse_quotas(spec: str) -> Dict[str, str]:
@@ -283,15 +264,27 @@ def cmd_resume(state: State, args) -> None:
 
 # ---- pending-workloads (visibility) ----
 def cmd_pending_workloads(state: State, args) -> None:
-    from kueue_tpu.visibility import pending_workloads_in_cq
+    if getattr(args, "server", None):
+        # live query against a running kueue_tpu.server (the reference's
+        # kubectl plugin hitting the visibility apiserver)
+        from kueue_tpu.server import KueueClient
 
-    rt = state.build_runtime()
-    summary = pending_workloads_in_cq(rt.queues, args.clusterqueue)
-    rows = [
-        [str(pw.position_in_cluster_queue), pw.namespace, pw.name,
-         pw.local_queue_name, str(pw.priority)]
-        for pw in summary.items
-    ]
+        summary = KueueClient(args.server).pending_workloads_cq(args.clusterqueue)
+        rows = [
+            [str(i["positionInClusterQueue"]), i["namespace"], i["name"],
+             i["localQueueName"], str(i["priority"])]
+            for i in summary["items"]
+        ]
+    else:
+        from kueue_tpu.visibility import pending_workloads_in_cq
+
+        rt = state.build_runtime()
+        summary = pending_workloads_in_cq(rt.queues, args.clusterqueue)
+        rows = [
+            [str(pw.position_in_cluster_queue), pw.namespace, pw.name,
+             pw.local_queue_name, str(pw.priority)]
+            for pw in summary.items
+        ]
     _print_table(["POSITION", "NAMESPACE", "NAME", "LOCALQUEUE", "PRIORITY"], rows)
 
 
@@ -425,6 +418,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     pw = sub.add_parser("pending-workloads")
     pw.add_argument("clusterqueue")
+    pw.add_argument(
+        "--server", help="query a running kueue_tpu.server instead of --state"
+    )
     pw.set_defaults(fn=cmd_pending_workloads)
 
     sch = sub.add_parser("schedule")

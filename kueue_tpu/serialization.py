@@ -47,6 +47,16 @@ from kueue_tpu.models.workload import (
 )
 from kueue_tpu.models.admission_check import AdmissionCheckState
 from kueue_tpu.models.constants import AdmissionCheckStateType
+from kueue_tpu.resources import quantity_to_int
+
+
+def _canon_qty(resource: str, value) -> int:
+    """Wire quantities: ints are already-canonical (what to_dict
+    emits); strings are human quantities ("2", "4Gi") as written in
+    hand-authored manifests — parse them the way PodSet.build does."""
+    if isinstance(value, int):
+        return value
+    return quantity_to_int(resource, value)
 
 
 # ---- flavors ----
@@ -177,9 +187,19 @@ def cq_from_dict(d: dict) -> ClusterQueue:
                         name=fq["name"],
                         resources={
                             r["name"]: ResourceQuota(
-                                nominal=r.get("nominalQuota", 0),
-                                borrowing_limit=r.get("borrowingLimit"),
-                                lending_limit=r.get("lendingLimit"),
+                                nominal=_canon_qty(
+                                    r["name"], r.get("nominalQuota", 0)
+                                ),
+                                borrowing_limit=(
+                                    _canon_qty(r["name"], r["borrowingLimit"])
+                                    if r.get("borrowingLimit") is not None
+                                    else None
+                                ),
+                                lending_limit=(
+                                    _canon_qty(r["name"], r["lendingLimit"])
+                                    if r.get("lendingLimit") is not None
+                                    else None
+                                ),
                             )
                             for r in fq["resources"]
                         },
@@ -350,7 +370,10 @@ def workload_from_dict(d: dict) -> Workload:
                 name=ps["name"],
                 count=ps["count"],
                 min_count=ps.get("minCount"),
-                requests=dict(ps.get("requests", {})),
+                requests={
+                    r: _canon_qty(r, q)
+                    for r, q in ps.get("requests", {}).items()
+                },
                 node_selector=dict(ps.get("nodeSelector", {})),
                 topology_request=(
                     PodSetTopologyRequest(
@@ -392,7 +415,10 @@ def workload_from_dict(d: dict) -> Workload:
                 PodSetAssignment(
                     name=psa["name"],
                     flavors=dict(psa.get("flavors", {})),
-                    resource_usage=dict(psa.get("resourceUsage", {})),
+                    resource_usage={
+                        r: _canon_qty(r, q)
+                        for r, q in psa.get("resourceUsage", {}).items()
+                    },
                     count=psa.get("count", 0),
                     topology_assignment=(
                         TopologyAssignment(
@@ -415,6 +441,50 @@ def workload_from_dict(d: dict) -> Workload:
 
 
 # ---- whole-state save/load ----
+def runtime_from_state(data: dict, **runtime_kwargs):
+    """Build a ClusterRuntime from a serialized state dict (the wire
+    format consumed by the CLI's state file and the server's solver
+    endpoint). Insertion order mirrors cmd/kueue/main.go
+    setupControllers: flavors/topologies/cohorts/checks/classes before
+    queues, workloads last."""
+    from kueue_tpu.controllers import ClusterRuntime
+
+    rt = ClusterRuntime(**runtime_kwargs)
+    for f in data.get("resourceFlavors", []):
+        rt.add_flavor(flavor_from_dict(f))
+    for t in data.get("topologies", []):
+        rt.add_topology(topology_from_dict(t))
+    for c in data.get("cohorts", []):
+        rt.add_cohort(cohort_from_dict(c))
+    for a in data.get("admissionChecks", []):
+        rt.add_admission_check(check_from_dict(a))
+    for p in data.get("workloadPriorityClasses", []):
+        rt.add_priority_class(priority_class_from_dict(p))
+    for c in data.get("clusterQueues", []):
+        rt.add_cluster_queue(cq_from_dict(c))
+    for l in data.get("localQueues", []):
+        rt.add_local_queue(lq_from_dict(l))
+    for w in data.get("workloads", []):
+        rt.add_workload(workload_from_dict(w))
+    return rt
+
+
+def runtime_to_state(rt) -> dict:
+    """Dump a live ClusterRuntime back to the wire format (the durable
+    checkpoint; reference: all state lives in the API server and is
+    reconstructed on restart — SURVEY §5 checkpoint/resume)."""
+    return state_to_dict(
+        flavors=list(rt.cache.flavors.values()),
+        cluster_queues=[c.model for c in rt.cache.cluster_queues.values()],
+        local_queues=list(rt.cache.local_queues.values()),
+        workloads=list(rt.workloads.values()),
+        cohorts=list(rt.cache.cohorts.values()),
+        checks=list(rt.cache.admission_checks.values()),
+        topologies=list(rt.cache.topologies.values()),
+        priority_classes=list(rt.cache.priority_classes.values()),
+    )
+
+
 def state_to_dict(
     flavors: List[ResourceFlavor],
     cluster_queues: List[ClusterQueue],

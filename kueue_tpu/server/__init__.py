@@ -1,0 +1,19 @@
+"""Service surface — HTTP/JSON server for the control plane.
+
+The reference serves three network surfaces: the visibility embedded
+apiserver (pkg/visibility/server.go:62-118), the metrics endpoint
+(cmd/kueue/main.go:154-179), and the AdmissionCheck plugin boundary
+that external controllers speak through the API server
+(apis/kueue/v1beta1/admissioncheck_types.go:23-45). This package
+provides the TPU-native framework's equivalents over plain HTTP/JSON:
+a live object API feeding a ClusterRuntime, the visibility
+pending-workloads API, a Prometheus text metrics endpoint, and the
+``jax-assign`` solver service — the batched TPU nomination path
+exposed as a stateless AdmissionCheck-style controller consuming
+serialized snapshots.
+"""
+
+from kueue_tpu.server.app import KueueServer, solve_assign
+from kueue_tpu.server.client import KueueClient
+
+__all__ = ["KueueServer", "KueueClient", "solve_assign"]

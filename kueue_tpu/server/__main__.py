@@ -1,0 +1,61 @@
+"""python -m kueue_tpu.server — standalone control-plane server.
+
+The cmd/kueue/main.go analog for the service surface: loads optional
+state (--state, the CLI's JSON wire format), binds the HTTP server
+(object API + visibility + metrics + jax-assign + dashboard), and
+serves until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kueue_tpu.server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8082)
+    parser.add_argument(
+        "--state", help="JSON state file to preload (CLI wire format)"
+    )
+    parser.add_argument(
+        "--no-solver", action="store_true",
+        help="disable the batched TPU nomination path",
+    )
+    parser.add_argument(
+        "--no-auto-reconcile", action="store_true",
+        help="only reconcile on POST /reconcile",
+    )
+    args = parser.parse_args(argv)
+
+    from kueue_tpu import serialization as ser
+    from kueue_tpu.server import KueueServer
+
+    runtime = None
+    if args.state:
+        with open(args.state) as f:
+            runtime = ser.runtime_from_state(
+                json.load(f), use_solver=not args.no_solver
+            )
+    srv = KueueServer(
+        runtime=runtime,
+        host=args.host,
+        port=args.port,
+        auto_reconcile=not args.no_auto_reconcile,
+    )
+    port = srv.start()
+    print(f"kueue-tpu server listening on http://{args.host}:{port}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

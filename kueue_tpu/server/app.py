@@ -1,0 +1,468 @@
+"""HTTP/JSON server: object API, visibility, metrics, jax-assign.
+
+Routes (all JSON unless noted):
+
+  GET  /healthz | /readyz                      liveness/readiness probes
+                                               (cmd/kueue/main.go:181-189)
+  GET  /metrics                                Prometheus text exposition
+                                               (cmd/kueue/main.go:154-179)
+  GET  /apis/visibility/v1beta1/clusterqueues/{cq}/pendingworkloads
+  GET  /apis/visibility/v1beta1/namespaces/{ns}/localqueues/{lq}/pendingworkloads
+                                               (pkg/visibility/server.go:62-118,
+                                               api/v1beta1/pending_workloads_cq.go:37-46)
+  GET  /apis/kueue/v1beta1/{section}           list objects w/ status
+  POST /apis/kueue/v1beta1/{section}           upsert one object (webhook
+                                               defaulting+validation applied)
+  DELETE /apis/kueue/v1beta1/workloads/{ns}/{name}
+  DELETE /apis/kueue/v1beta1/clusterqueues/{name}
+  POST /apis/kueue/v1beta1/workloads/{ns}/{name}/admissionchecks
+                                               flip a check state — the
+                                               phase-2 plugin boundary
+                                               (admissioncheck_types.go:23-45)
+  POST /reconcile                              run_until_idle; returns cycles
+  GET  /state                                  full state dump (checkpoint)
+  POST /apis/solver/v1beta1/assign             stateless jax-assign: body is
+                                               a serialized snapshot, reply
+                                               is per-workload decisions
+  GET  /                                       dashboard (kueueviz analog)
+  GET  /api/dashboard                          dashboard JSON feed
+
+The server owns one ClusterRuntime guarded by an RLock; handlers are
+thin translations between the wire format (serialization.py) and
+runtime calls. ThreadingHTTPServer gives per-request threads the way
+the reference's apiservers do per-connection goroutines.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from kueue_tpu import serialization as ser
+from kueue_tpu import visibility
+from kueue_tpu.models.constants import (
+    AdmissionCheckStateType,
+    WorkloadConditionType,
+)
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+# section name (URL, lowercase plural) -> (state key, from_dict, runtime add)
+_SECTIONS: Dict[str, Tuple[str, Callable, str]] = {
+    "resourceflavors": ("resourceFlavors", ser.flavor_from_dict, "add_flavor"),
+    "clusterqueues": ("clusterQueues", ser.cq_from_dict, "add_cluster_queue"),
+    "localqueues": ("localQueues", ser.lq_from_dict, "add_local_queue"),
+    "workloads": ("workloads", ser.workload_from_dict, "add_workload"),
+    "cohorts": ("cohorts", ser.cohort_from_dict, "add_cohort"),
+    "admissionchecks": ("admissionChecks", ser.check_from_dict, "add_admission_check"),
+    "topologies": ("topologies", ser.topology_from_dict, "add_topology"),
+    "workloadpriorityclasses": (
+        "workloadPriorityClasses",
+        ser.priority_class_from_dict,
+        "add_priority_class",
+    ),
+}
+
+
+def solve_assign(request: dict) -> dict:
+    """The ``jax-assign`` service: one nomination pass (or a full drain
+    to idle) over a serialized snapshot, on the batched TPU solver.
+
+    Stateless by design — the AdmissionCheck contract
+    (admissioncheck_types.go:23-45) is that the controller observes a
+    workload + cluster state and reports a verdict; feeding it explicit
+    snapshots keeps the service free of watch machinery and lets one
+    server serve many control planes.
+    """
+    state = request.get("state")
+    if not isinstance(state, dict):
+        raise ApiError(400, "body must carry a 'state' object")
+    opts = request.get("options", {})
+    use_solver = bool(opts.get("useSolver", True))
+    until_idle = bool(opts.get("untilIdle", False))
+    rt = ser.runtime_from_state(
+        state,
+        use_solver=use_solver,
+        use_preempt_solver=use_solver,
+    )
+    cycles = 0
+    decisions: List[dict] = []
+    preemptions: List[dict] = []
+    if until_idle:
+        cycles = rt.run_until_idle()
+    else:
+        result = rt.schedule_once()
+        cycles = 1
+        for entry in result.preempting:
+            for tgt in entry.preemption_targets:
+                preemptions.append(
+                    {
+                        "victim": tgt.workload.workload.key,
+                        "by": entry.workload.key,
+                        "reason": tgt.reason,
+                    }
+                )
+    for key in sorted(rt.workloads):
+        wl = rt.workloads[key]
+        item = {
+            "workload": key,
+            "outcome": (
+                "Admitted"
+                if wl.is_admitted
+                else "QuotaReserved"
+                if wl.has_quota_reservation
+                else "Pending"
+            ),
+        }
+        if wl.admission is not None:
+            item["admission"] = ser.workload_to_dict(wl)["admission"]
+        decisions.append(item)
+    return {
+        "cycles": cycles,
+        "decisions": decisions,
+        "preemptions": preemptions,
+        "resolution": "device" if use_solver else "host",
+    }
+
+
+class KueueServer:
+    """Owns the runtime + HTTP server. start()/stop() for embedding in
+    tests; ``python -m kueue_tpu.server`` for standalone use."""
+
+    def __init__(
+        self,
+        runtime=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        auto_reconcile: bool = True,
+        validators: Optional[list] = None,
+    ):
+        if runtime is None:
+            from kueue_tpu.controllers import ClusterRuntime
+
+            runtime = ClusterRuntime()
+        self.runtime = runtime
+        self.lock = threading.RLock()
+        self.auto_reconcile = auto_reconcile
+        if validators is None:
+            from kueue_tpu.webhooks import default_admission_chain
+
+            validators = default_admission_chain()
+        # admission chain: callables (section, obj_dict, old_obj|None,
+        # runtime) -> possibly-mutated obj_dict, raising ApiError on
+        # rejection (the webhook layer; pkg/webhooks/webhooks.go:25)
+        self.validators = list(validators)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._host = host
+        self._port = port
+
+    # ---- object API ----
+    def _find_existing(self, section_key: str, obj: dict):
+        data = ser.runtime_to_state(self.runtime)
+        for existing in data.get(section_key, []):
+            if existing["name"] == obj.get("name") and existing.get(
+                "namespace", ""
+            ) == obj.get("namespace", ""):
+                return existing
+        return None
+
+    def apply(self, section: str, obj: dict) -> dict:
+        """Upsert one object through the webhook admission chain."""
+        if section not in _SECTIONS:
+            raise ApiError(404, f"unknown section {section!r}")
+        state_key, from_dict, add_name = _SECTIONS[section]
+        from kueue_tpu.webhooks import ValidationError
+
+        with self.lock:
+            old = self._find_existing(state_key, obj)
+            try:
+                for admit in self.validators:
+                    obj = admit(section, obj, old, self.runtime)
+            except ValidationError as e:
+                raise ApiError(422, str(e))
+            model = from_dict(obj)
+            getattr(self.runtime, add_name)(model)
+            if self.auto_reconcile:
+                self.runtime.run_until_idle()
+        return obj
+
+    def delete(self, section: str, namespace: str, name: str) -> None:
+        with self.lock:
+            if section == "workloads":
+                wl = self.runtime.workloads.get(f"{namespace}/{name}")
+                if wl is None:
+                    raise ApiError(404, f"workload {namespace}/{name} not found")
+                self.runtime.delete_workload(wl)
+            elif section == "clusterqueues":
+                if name not in self.runtime.cache.cluster_queues:
+                    raise ApiError(404, f"clusterqueue {name} not found")
+                self.runtime.delete_cluster_queue(name)
+            else:
+                raise ApiError(405, f"delete not supported for {section}")
+            if self.auto_reconcile:
+                self.runtime.run_until_idle()
+
+    def set_admission_check_state(
+        self, namespace: str, name: str, check: str, state: str, message: str = ""
+    ) -> None:
+        """External controller flips a check — phase 2 of two-phase
+        admission (workload_controller.go:251-275 syncs the Admitted
+        condition on the next reconcile)."""
+        with self.lock:
+            wl = self.runtime.workloads.get(f"{namespace}/{name}")
+            if wl is None:
+                raise ApiError(404, f"workload {namespace}/{name} not found")
+            try:
+                state_t = AdmissionCheckStateType(state)
+            except ValueError:
+                raise ApiError(400, f"invalid check state {state!r}")
+            from kueue_tpu.models.admission_check import AdmissionCheckState
+
+            wl.admission_check_states[check] = AdmissionCheckState(
+                name=check, state=state_t, message=message
+            )
+            if self.auto_reconcile:
+                self.runtime.run_until_idle()
+
+    def list_section(self, section: str) -> dict:
+        if section not in _SECTIONS:
+            raise ApiError(404, f"unknown section {section!r}")
+        state_key = _SECTIONS[section][0]
+        with self.lock:
+            items = ser.runtime_to_state(self.runtime).get(state_key, [])
+            return {"items": items}
+
+    # ---- http plumbing ----
+    def start(self) -> int:
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self._host, self._port), handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+
+_ROUTES: List[Tuple[str, re.Pattern, str]] = [
+    ("GET", re.compile(r"^/healthz$"), "healthz"),
+    ("GET", re.compile(r"^/readyz$"), "healthz"),
+    ("GET", re.compile(r"^/metrics$"), "metrics"),
+    (
+        "GET",
+        re.compile(
+            r"^/apis/visibility/v1beta1/clusterqueues/([^/]+)/pendingworkloads$"
+        ),
+        "vis_cq",
+    ),
+    (
+        "GET",
+        re.compile(
+            r"^/apis/visibility/v1beta1/namespaces/([^/]+)/localqueues/([^/]+)/pendingworkloads$"
+        ),
+        "vis_lq",
+    ),
+    (
+        "POST",
+        re.compile(r"^/apis/kueue/v1beta1/workloads/([^/]+)/([^/]+)/admissionchecks$"),
+        "check_state",
+    ),
+    ("GET", re.compile(r"^/apis/kueue/v1beta1/([a-z]+)$"), "list"),
+    ("POST", re.compile(r"^/apis/kueue/v1beta1/([a-z]+)$"), "apply"),
+    (
+        "DELETE",
+        re.compile(r"^/apis/kueue/v1beta1/(workloads)/([^/]+)/([^/]+)$"),
+        "delete_ns",
+    ),
+    (
+        "DELETE",
+        re.compile(r"^/apis/kueue/v1beta1/(clusterqueues)/([^/]+)$"),
+        "delete",
+    ),
+    ("POST", re.compile(r"^/reconcile$"), "reconcile"),
+    ("GET", re.compile(r"^/state$"), "state"),
+    ("POST", re.compile(r"^/apis/solver/v1beta1/assign$"), "solve"),
+    ("GET", re.compile(r"^/api/dashboard$"), "dashboard_json"),
+    ("GET", re.compile(r"^/$"), "dashboard_html"),
+]
+
+
+def _make_handler(srv: KueueServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        # ---- dispatch ----
+        def _dispatch(self, method: str):
+            parsed = urlparse(self.path)
+            query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+            for m, pat, name in _ROUTES:
+                if m != method:
+                    continue
+                match = pat.match(parsed.path)
+                if match:
+                    try:
+                        getattr(self, f"_h_{name}")(*match.groups(), **{"query": query})
+                    except ApiError as e:
+                        self._send_json({"error": e.message}, status=e.status)
+                    except Exception as e:  # noqa: BLE001 — surface as 500
+                        self._send_json({"error": repr(e)}, status=500)
+                    return
+            self._send_json({"error": f"no route for {method} {parsed.path}"}, 404)
+
+        def do_GET(self):
+            self._dispatch("GET")
+
+        def do_POST(self):
+            self._dispatch("POST")
+
+        def do_DELETE(self):
+            self._dispatch("DELETE")
+
+        # ---- helpers ----
+        def _body(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            if length == 0:
+                return {}
+            raw = self.rfile.read(length)
+            try:
+                return json.loads(raw)
+            except json.JSONDecodeError as e:
+                raise ApiError(400, f"invalid JSON body: {e}")
+
+        def _send_json(self, obj, status: int = 200) -> None:
+            payload = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _send_text(self, text: str, content_type: str, status: int = 200) -> None:
+            payload = text.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        # ---- handlers ----
+        def _h_healthz(self, query):
+            self._send_json({"status": "ok"})
+
+        def _h_metrics(self, query):
+            with srv.lock:
+                text = srv.runtime.metrics.registry.expose()
+            self._send_text(text, "text/plain; version=0.0.4")
+
+        def _h_vis_cq(self, cq, query):
+            with srv.lock:
+                summary = visibility.pending_workloads_in_cq(
+                    srv.runtime.queues,
+                    cq,
+                    offset=int(query.get("offset", 0)),
+                    limit=int(query.get("limit", 1000)),
+                )
+            self._send_json(_summary_to_dict(summary))
+
+        def _h_vis_lq(self, ns, lq, query):
+            with srv.lock:
+                summary = visibility.pending_workloads_in_lq(
+                    srv.runtime.queues,
+                    ns,
+                    lq,
+                    offset=int(query.get("offset", 0)),
+                    limit=int(query.get("limit", 1000)),
+                )
+            self._send_json(_summary_to_dict(summary))
+
+        def _h_list(self, section, query):
+            self._send_json(srv.list_section(section))
+
+        def _h_apply(self, section, query):
+            obj = srv.apply(section, self._body())
+            self._send_json({"applied": obj})
+
+        def _h_delete_ns(self, section, ns, name, query):
+            srv.delete(section, ns, name)
+            self._send_json({"deleted": f"{ns}/{name}"})
+
+        def _h_delete(self, section, name, query):
+            srv.delete(section, "", name)
+            self._send_json({"deleted": name})
+
+        def _h_check_state(self, ns, name, query):
+            body = self._body()
+            srv.set_admission_check_state(
+                ns,
+                name,
+                check=body.get("name", ""),
+                state=body.get("state", ""),
+                message=body.get("message", ""),
+            )
+            self._send_json({"updated": f"{ns}/{name}"})
+
+        def _h_reconcile(self, query):
+            with srv.lock:
+                cycles = srv.runtime.run_until_idle()
+            self._send_json({"cycles": cycles})
+
+        def _h_state(self, query):
+            with srv.lock:
+                self._send_json(ser.runtime_to_state(srv.runtime))
+
+        def _h_solve(self, query):
+            # stateless: deliberately NOT under srv.lock — solving a
+            # posted snapshot doesn't touch the live runtime
+            self._send_json(solve_assign(self._body()))
+
+        def _h_dashboard_json(self, query):
+            from kueue_tpu.server.dashboard import dashboard_payload
+
+            with srv.lock:
+                self._send_json(dashboard_payload(srv.runtime))
+
+        def _h_dashboard_html(self, query):
+            from kueue_tpu.server.dashboard import DASHBOARD_HTML
+
+            self._send_text(DASHBOARD_HTML, "text/html")
+
+    return Handler
+
+
+def _summary_to_dict(summary: visibility.PendingWorkloadsSummary) -> dict:
+    return {
+        "items": [
+            {
+                "name": pw.name,
+                "namespace": pw.namespace,
+                "localQueueName": pw.local_queue_name,
+                "priority": pw.priority,
+                "positionInClusterQueue": pw.position_in_cluster_queue,
+                "positionInLocalQueue": pw.position_in_local_queue,
+            }
+            for pw in summary.items
+        ]
+    }

@@ -1,0 +1,112 @@
+"""Typed HTTP client for KueueServer (the client-go analog).
+
+Thin urllib wrapper; every method mirrors one server route. Used by
+the CLI's --server mode and by round-trip tests.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+class ClientError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class KueueClient:
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self.base_url}{path}",
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                ctype = resp.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                message = str(e)
+            raise ClientError(e.code, message)
+        if ctype.startswith("application/json"):
+            return json.loads(raw)
+        return raw.decode()
+
+    # ---- probes / metrics ----
+    def healthz(self) -> dict:
+        return self._request("GET", "/healthz")
+
+    def metrics_text(self) -> str:
+        return self._request("GET", "/metrics")
+
+    # ---- objects ----
+    def apply(self, section: str, obj: dict) -> dict:
+        return self._request("POST", f"/apis/kueue/v1beta1/{section}", obj)
+
+    def list(self, section: str) -> list:
+        return self._request("GET", f"/apis/kueue/v1beta1/{section}")["items"]
+
+    def delete_workload(self, namespace: str, name: str) -> dict:
+        return self._request(
+            "DELETE", f"/apis/kueue/v1beta1/workloads/{namespace}/{name}"
+        )
+
+    def delete_cluster_queue(self, name: str) -> dict:
+        return self._request("DELETE", f"/apis/kueue/v1beta1/clusterqueues/{name}")
+
+    def set_admission_check_state(
+        self, namespace: str, name: str, check: str, state: str, message: str = ""
+    ) -> dict:
+        return self._request(
+            "POST",
+            f"/apis/kueue/v1beta1/workloads/{namespace}/{name}/admissionchecks",
+            {"name": check, "state": state, "message": message},
+        )
+
+    # ---- visibility ----
+    def pending_workloads_cq(self, cq: str, offset: int = 0, limit: int = 1000) -> dict:
+        return self._request(
+            "GET",
+            f"/apis/visibility/v1beta1/clusterqueues/{cq}/pendingworkloads"
+            f"?offset={offset}&limit={limit}",
+        )
+
+    def pending_workloads_lq(
+        self, namespace: str, lq: str, offset: int = 0, limit: int = 1000
+    ) -> dict:
+        return self._request(
+            "GET",
+            f"/apis/visibility/v1beta1/namespaces/{namespace}/localqueues/{lq}"
+            f"/pendingworkloads?offset={offset}&limit={limit}",
+        )
+
+    # ---- control ----
+    def reconcile(self) -> dict:
+        return self._request("POST", "/reconcile")
+
+    def state(self) -> dict:
+        return self._request("GET", "/state")
+
+    def solve(self, state: dict, use_solver: bool = True, until_idle: bool = False) -> dict:
+        return self._request(
+            "POST",
+            "/apis/solver/v1beta1/assign",
+            {"state": state, "options": {"useSolver": use_solver, "untilIdle": until_idle}},
+        )
+
+    def dashboard(self) -> dict:
+        return self._request("GET", "/api/dashboard")

@@ -1,0 +1,208 @@
+"""kueueviz-equivalent read-only dashboard.
+
+Reference: cmd/kueueviz — a Go/gin backend streaming cluster state to
+a React frontend over websockets. Here the same live views (cluster
+queues with quota/usage bars, local queues, workloads with admission
+state, flavors, cohorts, recent events) are computed server-side into
+one JSON payload (``dashboard_payload``) and rendered by a single
+self-contained HTML page that polls ``/api/dashboard`` — no external
+assets, so it works in air-gapped deployments.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from kueue_tpu.models.constants import WorkloadConditionType
+
+
+def _workload_state(wl) -> str:
+    if wl.is_finished:
+        return "Finished"
+    if wl.is_admitted:
+        return "Admitted"
+    if wl.has_quota_reservation:
+        return "QuotaReserved"
+    ev = wl.conditions.get(WorkloadConditionType.EVICTED)
+    if ev is not None and ev.status:
+        return "Evicted"
+    return "Pending"
+
+
+def dashboard_payload(rt) -> dict:
+    """One read of the runtime -> everything the dashboard shows."""
+    cache = rt.cache
+    queues = rt.queues
+
+    cqs: List[dict] = []
+    for name, cached in sorted(cache.cluster_queues.items()):
+        model = cached.model
+        pending = queues.cluster_queues.get(name)
+        quota_rows: List[dict] = []
+        for rg in model.resource_groups:
+            for fq in rg.flavors:
+                for rname, rq in fq.resources.items():
+                    used = 0
+                    for fr, qty in cached.usage.items():
+                        if fr.flavor == fq.name and fr.resource == rname:
+                            used = qty
+                            break
+                    quota_rows.append(
+                        {
+                            "flavor": fq.name,
+                            "resource": rname,
+                            "used": used,
+                            "nominal": rq.nominal,
+                            "borrowingLimit": rq.borrowing_limit,
+                            "lendingLimit": rq.lending_limit,
+                        }
+                    )
+        cqs.append(
+            {
+                "name": name,
+                "cohort": model.cohort,
+                "strategy": model.queueing_strategy.value,
+                "stopPolicy": model.stop_policy.value,
+                "pendingActive": pending.pending_active() if pending else 0,
+                "pendingInadmissible": (
+                    pending.pending_inadmissible() if pending else 0
+                ),
+                "reserving": len(cached.workloads),
+                "admitted": sum(
+                    1 for w in cached.workloads.values() if w.is_admitted
+                ),
+                "quota": quota_rows,
+            }
+        )
+
+    lqs = [
+        {
+            "namespace": lq.namespace,
+            "name": lq.name,
+            "clusterQueue": lq.cluster_queue,
+            "stopPolicy": lq.stop_policy.value,
+        }
+        for lq in sorted(
+            cache.local_queues.values(), key=lambda l: (l.namespace, l.name)
+        )
+    ]
+
+    workloads = [
+        {
+            "key": key,
+            "queue": wl.queue_name,
+            "priority": wl.priority,
+            "state": _workload_state(wl),
+            "clusterQueue": wl.admission.cluster_queue if wl.admission else "",
+        }
+        for key, wl in sorted(rt.workloads.items())
+    ]
+
+    state_counts: Dict[str, int] = {}
+    for w in workloads:
+        state_counts[w["state"]] = state_counts.get(w["state"], 0) + 1
+
+    return {
+        "clusterQueues": cqs,
+        "localQueues": lqs,
+        "workloads": workloads,
+        "workloadStates": state_counts,
+        "resourceFlavors": sorted(cache.flavors),
+        "cohorts": sorted(cache.cohorts),
+        "events": [
+            {"kind": e.kind, "object": e.object_key, "message": e.message}
+            for e in rt.events[-100:]
+        ],
+    }
+
+
+DASHBOARD_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>kueue-tpu dashboard</title>
+<style>
+  :root { --bg:#fafaf8; --fg:#1a1a18; --muted:#6b6b66; --line:#e3e3de;
+          --accent:#3b6ea5; --ok:#2e7d4f; --warn:#b3681f; --bad:#a8403a;
+          --card:#ffffff; }
+  @media (prefers-color-scheme: dark) {
+    :root { --bg:#161614; --fg:#ebebe6; --muted:#9a9a92; --line:#33332e;
+            --accent:#7aa7d4; --ok:#63b384; --warn:#d79a55; --bad:#d4766f;
+            --card:#1f1f1c; }
+  }
+  body { margin:0; font:14px/1.5 system-ui,sans-serif; background:var(--bg);
+         color:var(--fg); padding:24px; }
+  h1 { font-size:18px; margin:0 0 4px; } h2 { font-size:14px; margin:24px 0 8px; }
+  .muted { color:var(--muted); }
+  .tiles { display:flex; gap:12px; flex-wrap:wrap; margin:16px 0; }
+  .tile { background:var(--card); border:1px solid var(--line); border-radius:8px;
+          padding:12px 16px; min-width:110px; }
+  .tile b { display:block; font-size:22px; font-weight:600; }
+  table { border-collapse:collapse; width:100%; background:var(--card);
+          border:1px solid var(--line); border-radius:8px; overflow:hidden; }
+  th,td { text-align:left; padding:6px 10px; border-top:1px solid var(--line);
+          font-variant-numeric:tabular-nums; }
+  th { background:transparent; color:var(--muted); font-weight:500;
+       border-top:none; font-size:12px; }
+  .bar { background:var(--line); border-radius:3px; height:8px; width:140px;
+         display:inline-block; vertical-align:middle; }
+  .bar i { display:block; height:8px; border-radius:3px; background:var(--accent); }
+  .bar i.over { background:var(--warn); }
+  .state-Admitted { color:var(--ok); } .state-Pending { color:var(--muted); }
+  .state-Evicted { color:var(--bad); } .state-QuotaReserved { color:var(--warn); }
+  .state-Finished { color:var(--muted); }
+  code { font-size:12px; }
+</style>
+</head>
+<body>
+<h1>kueue-tpu</h1>
+<div class="muted">read-only control-plane dashboard &middot; polls /api/dashboard every 2s</div>
+<div class="tiles" id="tiles"></div>
+<h2>ClusterQueues</h2><div id="cqs"></div>
+<h2>Workloads</h2><div id="wls"></div>
+<h2>LocalQueues</h2><div id="lqs"></div>
+<h2>Recent events</h2><div id="events"></div>
+<script>
+function esc(s){return String(s).replace(/[&<>"]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]))}
+function bar(used,nominal){
+  const pct = nominal>0 ? Math.min(100*used/nominal,100) : 0;
+  const over = nominal>0 && used>nominal;
+  return `<span class="bar"><i class="${over?'over':''}" style="width:${pct}%"></i></span>`;
+}
+function render(d){
+  const st = d.workloadStates||{};
+  document.getElementById('tiles').innerHTML =
+    [['ClusterQueues',d.clusterQueues.length],['LocalQueues',d.localQueues.length],
+     ['Workloads',d.workloads.length],['Admitted',st.Admitted||0],
+     ['Pending',st.Pending||0],['Flavors',d.resourceFlavors.length],
+     ['Cohorts',d.cohorts.length]]
+    .map(([k,v])=>`<div class="tile"><b>${v}</b><span class="muted">${k}</span></div>`).join('');
+  document.getElementById('cqs').innerHTML = '<table><tr><th>name</th><th>cohort</th>'+
+    '<th>pending</th><th>admitted</th><th>quota (used / nominal)</th></tr>'+
+    d.clusterQueues.map(cq=>`<tr><td>${esc(cq.name)}</td><td>${esc(cq.cohort||'')}</td>`+
+      `<td>${cq.pendingActive}+${cq.pendingInadmissible}</td><td>${cq.admitted}</td><td>`+
+      cq.quota.map(q=>`${esc(q.flavor)}/${esc(q.resource)} ${bar(q.used,q.nominal)} `+
+        `<code>${q.used}/${q.nominal}</code>`).join('<br>')+
+      `</td></tr>`).join('')+'</table>';
+  document.getElementById('wls').innerHTML = '<table><tr><th>workload</th><th>queue</th>'+
+    '<th>priority</th><th>state</th><th>clusterQueue</th></tr>'+
+    d.workloads.slice(0,500).map(w=>`<tr><td>${esc(w.key)}</td><td>${esc(w.queue)}</td>`+
+      `<td>${w.priority}</td><td class="state-${w.state}">${w.state}</td>`+
+      `<td>${esc(w.clusterQueue)}</td></tr>`).join('')+'</table>';
+  document.getElementById('lqs').innerHTML = '<table><tr><th>namespace</th><th>name</th>'+
+    '<th>clusterQueue</th><th>stopPolicy</th></tr>'+
+    d.localQueues.map(l=>`<tr><td>${esc(l.namespace)}</td><td>${esc(l.name)}</td>`+
+      `<td>${esc(l.clusterQueue)}</td><td>${l.stopPolicy}</td></tr>`).join('')+'</table>';
+  document.getElementById('events').innerHTML = '<table><tr><th>kind</th><th>object</th>'+
+    '<th>message</th></tr>'+
+    d.events.slice().reverse().map(e=>`<tr><td>${esc(e.kind)}</td><td>${esc(e.object)}</td>`+
+      `<td>${esc(e.message)}</td></tr>`).join('')+'</table>';
+}
+async function tick(){
+  try { render(await (await fetch('/api/dashboard')).json()); } catch(e) {}
+}
+tick(); setInterval(tick, 2000);
+</script>
+</body>
+</html>
+"""

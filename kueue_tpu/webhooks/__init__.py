@@ -1,0 +1,40 @@
+"""Admission webhooks — defaulting + validation + immutability.
+
+Reference: pkg/webhooks/{workload,clusterqueue,cohort,resourceflavor}
+_webhook.go plus the CEL markers compiled into the CRDs
+(apis/kueue/v1beta1/workload_types.go:637-641,
+clusterqueue_types.go:49, localqueue_types.go:28). In the reference
+these run inside the API server's admission phase; here they run at
+ClusterRuntime ingress — the server applies the chain to every object
+POSTed to /apis/kueue/v1beta1/*, and embedders can call
+``default_admission_chain()`` themselves before feeding a runtime.
+
+Each entry in the chain is ``admit(section, obj, old, runtime) ->
+obj`` operating on wire-format dicts (serialization.py), raising
+``ValidationError`` on rejection. Defaulting mutates a copy; the
+caller persists whatever the chain returns.
+"""
+
+from kueue_tpu.webhooks.validation import (
+    ValidationError,
+    default_admission_chain,
+    default_cluster_queue,
+    default_workload,
+    validate_cluster_queue,
+    validate_cohort,
+    validate_local_queue,
+    validate_resource_flavor,
+    validate_workload,
+)
+
+__all__ = [
+    "ValidationError",
+    "default_admission_chain",
+    "default_cluster_queue",
+    "default_workload",
+    "validate_cluster_queue",
+    "validate_cohort",
+    "validate_local_queue",
+    "validate_resource_flavor",
+    "validate_workload",
+]

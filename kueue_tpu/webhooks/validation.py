@@ -1,0 +1,509 @@
+"""Defaulting + validation rules for the core API objects.
+
+Behavioral port of pkg/webhooks/workload_webhook.go:43-310,
+clusterqueue_webhook.go:97-235, resourceflavor_webhook.go:88-120,
+cohort_webhook.go:69, and the CRD CEL markers
+(workload_types.go:27,36-37,261,637-641; clusterqueue_types.go:49,
+166,423; localqueue_types.go:28; resourceflavor taint/toleration
+rules at resourceflavor_types.go / workload_types.go:443-448).
+
+Everything operates on the wire-format dicts from serialization.py —
+the framework's admission boundary — and accumulates field errors the
+way field.ErrorList does, so one request reports every problem at
+once.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Tuple
+
+# DNS-1123: subdomain (queue names, class names) and label (podset
+# names) — the kubebuilder Pattern markers on the CRDs.
+_DNS1123_SUBDOMAIN = re.compile(
+    r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$"
+)
+_DNS1123_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+# label keys: optional DNS-subdomain prefix / name segment
+_LABEL_NAME = re.compile(r"^([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9]$")
+_LABEL_VALUE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$")
+
+MAX_PODSETS = 8  # workload_types.go:36 MaxItems=8
+TAINT_EFFECTS = ("NoSchedule", "PreferNoSchedule", "NoExecute")
+TOLERATION_OPERATORS = ("Equal", "Exists")
+
+
+class ValidationError(Exception):
+    """Aggregate of field errors, the field.ErrorList.ToAggregate()
+    analog."""
+
+    def __init__(self, errors: List[Tuple[str, str]]):
+        self.errors = list(errors)
+        super().__init__(
+            "; ".join(f"{path}: {msg}" for path, msg in self.errors)
+        )
+
+
+class _Errs:
+    def __init__(self):
+        self.items: List[Tuple[str, str]] = []
+
+    def add(self, path: str, msg: str) -> None:
+        self.items.append((path, msg))
+
+    def raise_if_any(self) -> None:
+        if self.items:
+            raise ValidationError(self.items)
+
+
+def _check_name(errs: _Errs, path: str, value, required: bool = True) -> None:
+    if not value:
+        if required:
+            errs.add(path, "name is required")
+        return
+    if not isinstance(value, str) or len(value) > 253:
+        errs.add(path, "must be a string of at most 253 characters")
+        return
+    if not _DNS1123_SUBDOMAIN.match(value):
+        errs.add(path, "must be a lowercase RFC 1123 subdomain")
+
+
+def _try_canon(obj: dict, key: str, resource: str) -> None:
+    """Canonicalize one quantity field in place (the resource.Quantity
+    decode the reference gets from the API machinery). Unparseable
+    values are left as-is for the validator to flag."""
+    from kueue_tpu.serialization import _canon_qty
+
+    value = obj.get(key)
+    if value is None or isinstance(value, int):
+        return
+    try:
+        obj[key] = _canon_qty(resource, value)
+    except Exception:  # noqa: BLE001 — validator reports it with a path
+        pass
+
+
+def _check_quantity(errs: _Errs, path: str, value, resource: str = ""):
+    """Canonical int for the value, or None after reporting a field
+    error. Accepts already-canonical ints and parseable quantity
+    strings (defaulters normally canonicalize first; direct validator
+    callers may pass either)."""
+    from kueue_tpu.serialization import _canon_qty
+
+    if isinstance(value, int):
+        return value
+    if value is None:
+        return None
+    try:
+        return _canon_qty(resource, value)
+    except Exception:  # noqa: BLE001
+        errs.add(path, f"invalid quantity {value!r}")
+        return None
+
+
+def _check_labels(errs: _Errs, path: str, labels) -> None:
+    if not isinstance(labels, dict):
+        errs.add(path, "must be a string map")
+        return
+    for k, v in labels.items():
+        name = k.rsplit("/", 1)[-1]
+        if len(name) > 63 or not _LABEL_NAME.match(name):
+            errs.add(f"{path}[{k}]", "invalid label key")
+        if len(str(v)) > 63 or not _LABEL_VALUE.match(str(v)):
+            errs.add(f"{path}[{k}]", "invalid label value")
+
+
+# ---------------------------------------------------------------- workload
+def default_workload(obj: dict, runtime=None) -> dict:
+    """workload_webhook.go:56-68 + jobframework podset-name defaulting
+    + priority-from-class (utils/priority resolves at admission; here
+    the spec invariant 'priority must not be nil when priorityClassName
+    is set' (workload_types.go:27) is satisfied by resolving early)."""
+    from kueue_tpu.features import enabled
+
+    out = dict(obj)
+    pod_sets = [dict(ps) for ps in out.get("podSets", [])]
+    if len(pod_sets) == 1 and not pod_sets[0].get("name"):
+        pod_sets[0]["name"] = "main"
+    for ps in pod_sets:
+        if not enabled("PartialAdmission"):
+            ps["minCount"] = None
+        requests = dict(ps.get("requests", {}))
+        for rname in requests:
+            _try_canon(requests, rname, rname)
+        ps["requests"] = requests
+    out["podSets"] = pod_sets
+    out.setdefault("active", True)
+    pc_name = out.get("priorityClassName")
+    if pc_name and out.get("priority") is None and runtime is not None:
+        pc = runtime.cache.priority_classes.get(pc_name)
+        if pc is not None:
+            out["priority"] = pc.value
+    return out
+
+
+def validate_workload(obj: dict, old: Optional[dict] = None) -> None:
+    errs = _Errs()
+    _check_name(errs, "metadata.name", obj.get("name"))
+    _check_name(errs, "spec.queueName", obj.get("queueName"), required=False)
+    _check_name(
+        errs, "spec.priorityClassName", obj.get("priorityClassName"),
+        required=False,
+    )
+    if obj.get("priorityClassName") and obj.get("priority") is None:
+        # workload_types.go:27 CEL
+        errs.add(
+            "spec.priority",
+            "priority should not be nil when priorityClassName is set",
+        )
+    met = obj.get("maximumExecutionTimeSeconds")
+    if met is not None and met < 1:
+        errs.add("spec.maximumExecutionTimeSeconds", "must be at least 1")
+
+    pod_sets = obj.get("podSets", [])
+    if not 1 <= len(pod_sets) <= MAX_PODSETS:
+        # workload_types.go:36-37 MinItems=1 MaxItems=8
+        errs.add(
+            "spec.podSets", f"must have between 1 and {MAX_PODSETS} elements"
+        )
+    seen = set()
+    min_count_sets = 0
+    names = set()
+    for i, ps in enumerate(pod_sets):
+        path = f"spec.podSets[{i}]"
+        name = ps.get("name", "")
+        names.add(name)
+        if not name or not _DNS1123_LABEL.match(name) or len(name) > 63:
+            errs.add(f"{path}.name", "must be a lowercase RFC 1123 label")
+        if name in seen:
+            errs.add(f"{path}.name", f"duplicate podSet name {name!r}")
+        seen.add(name)
+        count = ps.get("count", 0)
+        if count < 1:
+            errs.add(f"{path}.count", "must be at least 1")
+        mc = ps.get("minCount")
+        if mc is not None:
+            min_count_sets += 1
+            if not 0 < mc <= count:
+                # workload_types.go:261 CEL
+                errs.add(
+                    f"{path}.minCount",
+                    "minCount should be positive and less or equal to count",
+                )
+        for rname, qty in ps.get("requests", {}).items():
+            if rname == "pods":
+                # workload_webhook.go validateContainer: reserved key
+                errs.add(
+                    f"{path}.requests[pods]",
+                    "the key is reserved for internal kueue use",
+                )
+            _check_quantity(errs, f"{path}.requests[{rname}]", qty, rname)
+    if min_count_sets > 1:
+        # workload_webhook.go:109-111
+        errs.add(
+            "spec.podSets",
+            f"{min_count_sets} podSets use minCount; at most one podSet "
+            "can use minCount",
+        )
+
+    _validate_workload_status(errs, obj, names)
+    if old is not None:
+        _validate_workload_update(errs, obj, old)
+    errs.raise_if_any()
+
+
+def _has_quota_reservation(obj: dict) -> bool:
+    return any(
+        c.get("type") == "QuotaReserved" and c.get("status")
+        for c in obj.get("conditions", [])
+    )
+
+
+def _validate_workload_status(errs: _Errs, obj: dict, podset_names) -> None:
+    adm = obj.get("admission")
+    if adm is not None:
+        psas = adm.get("podSetAssignments", [])
+        if _has_quota_reservation(obj) and len(psas) != len(
+            obj.get("podSets", [])
+        ):
+            # workload_types.go:637-641 CEL
+            errs.add(
+                "status.admission.podSetAssignments",
+                "must have the same number of podSets as the spec",
+            )
+        for i, psa in enumerate(psas):
+            path = f"status.admission.podSetAssignments[{i}]"
+            if psa.get("name") not in podset_names:
+                errs.add(f"{path}.name", f"unknown podSet {psa.get('name')!r}")
+            count = psa.get("count", 0)
+            if count > 0:
+                for rname, qty in psa.get("resourceUsage", {}).items():
+                    if qty % count != 0:
+                        errs.add(
+                            f"{path}.resourceUsage[{rname}]",
+                            f"is not a multiple of {count}",
+                        )
+    counts = {ps.get("name"): ps.get("count", 0) for ps in obj.get("podSets", [])}
+    for name, count in obj.get("reclaimablePods", {}).items():
+        path = f"status.reclaimablePods[{name}]"
+        if name not in counts:
+            errs.add(f"{path}.name", f"unknown podSet {name!r}")
+        elif count > counts[name]:
+            errs.add(
+                f"{path}.count", f"should be less or equal to {counts[name]}"
+            )
+
+
+def _validate_workload_update(errs: _Errs, obj: dict, old: dict) -> None:
+    """workload_webhook.go:269-310 ValidateWorkloadUpdate."""
+    if _has_quota_reservation(old):
+        if obj.get("podSets") != old.get("podSets"):
+            errs.add("spec.podSets", "field is immutable with quota reserved")
+    if old.get("admission") is not None:
+        if obj.get("queueName") != old.get("queueName"):
+            # workload_types.go queueName CEL: immutable while admitted
+            errs.add(
+                "spec.queueName",
+                "field is immutable while admission is not null",
+            )
+        if (
+            obj.get("admission") is not None
+            and obj.get("admission") != old.get("admission")
+        ):
+            # admission can be set or unset but not changed
+            errs.add("status.admission", "field is immutable")
+    if _has_quota_reservation(old) and _has_quota_reservation(obj):
+        old_recl = old.get("reclaimablePods", {})
+        for name, count in obj.get("reclaimablePods", {}).items():
+            if name in old_recl and count < old_recl[name]:
+                # reclaimable counts must not decrease while admitted
+                errs.add(
+                    f"status.reclaimablePods[{name}].count",
+                    f"cannot be less than {old_recl[name]}",
+                )
+
+
+# ---------------------------------------------------------- cluster queue
+def default_cluster_queue(obj: dict, runtime=None) -> dict:
+    """clusterqueue_webhook.go:59-67 — the finalizer default has no
+    analog here; queueingStrategy/stopPolicy defaults come from the
+    dataclass. Quantity strings in quotas are canonicalized here (the
+    resource.Quantity decode)."""
+    out = dict(obj)
+    groups = []
+    for rg in out.get("resourceGroups", []):
+        rg = dict(rg)
+        flavors = []
+        for fq in rg.get("flavors", []):
+            fq = dict(fq)
+            resources = []
+            for rq in fq.get("resources", []):
+                rq = dict(rq)
+                rname = rq.get("name", "")
+                for key in ("nominalQuota", "borrowingLimit", "lendingLimit"):
+                    _try_canon(rq, key, rname)
+                resources.append(rq)
+            fq["resources"] = resources
+            flavors.append(fq)
+        rg["flavors"] = flavors
+        groups.append(rg)
+    if groups:
+        out["resourceGroups"] = groups
+    return out
+
+
+def _validate_resource_groups(
+    errs: _Errs, obj: dict, has_parent: bool, kind_path: str = "spec"
+) -> None:
+    """clusterqueue_webhook.go:139-235 validateResourceGroups."""
+    seen_resources = set()
+    seen_flavors = set()
+    for i, rg in enumerate(obj.get("resourceGroups", [])):
+        rg_path = f"{kind_path}.resourceGroups[{i}]"
+        covered = rg.get("coveredResources", [])
+        if not covered:
+            errs.add(f"{rg_path}.coveredResources", "must not be empty")
+        for j, rname in enumerate(covered):
+            if rname in seen_resources:
+                errs.add(
+                    f"{rg_path}.coveredResources[{j}]",
+                    f"duplicate resource {rname!r}",
+                )
+            seen_resources.add(rname)
+        for j, fq in enumerate(rg.get("flavors", [])):
+            f_path = f"{rg_path}.flavors[{j}]"
+            fname = fq.get("name", "")
+            _check_name(errs, f"{f_path}.name", fname)
+            if fname in seen_flavors:
+                errs.add(f"{f_path}.name", f"duplicate flavor {fname!r}")
+            seen_flavors.add(fname)
+            resources = fq.get("resources", [])
+            listed = [r.get("name") for r in resources]
+            if listed != list(covered):
+                # clusterqueue_types.go:166 CEL + name-order check
+                errs.add(
+                    f"{f_path}.resources",
+                    "must match coveredResources (same names, same order)",
+                )
+            for k, rq in enumerate(resources):
+                r_path = f"{f_path}.resources[{k}]"
+                rname = rq.get("name", "")
+                nominal = _check_quantity(
+                    errs, f"{r_path}.nominalQuota",
+                    rq.get("nominalQuota", 0), rname,
+                )
+                if nominal is not None and nominal < 0:
+                    errs.add(f"{r_path}.nominalQuota", "must not be negative")
+                limits = {}
+                for limit_name in ("borrowingLimit", "lendingLimit"):
+                    raw = rq.get(limit_name)
+                    if raw is None:
+                        continue
+                    limit = _check_quantity(
+                        errs, f"{r_path}.{limit_name}", raw, rname
+                    )
+                    if limit is None:
+                        continue
+                    limits[limit_name] = limit
+                    if limit < 0:
+                        errs.add(f"{r_path}.{limit_name}", "must not be negative")
+                    if not has_parent:
+                        # clusterqueue_types.go:49 CEL + validateLimit
+                        errs.add(
+                            f"{r_path}.{limit_name}",
+                            "must be nil when cohort is empty",
+                        )
+                lend = limits.get("lendingLimit")
+                if nominal is not None and lend is not None and lend > nominal:
+                    errs.add(
+                        f"{r_path}.lendingLimit",
+                        "must be less than or equal to the nominalQuota",
+                    )
+
+
+def validate_cluster_queue(obj: dict, old: Optional[dict] = None) -> None:
+    errs = _Errs()
+    _check_name(errs, "metadata.name", obj.get("name"))
+    _check_name(errs, "spec.cohort", obj.get("cohort"), required=False)
+    _validate_resource_groups(errs, obj, has_parent=bool(obj.get("cohort")))
+    prem = obj.get("preemption", {})
+    borrow = prem.get("borrowWithinCohort", {})
+    if (
+        prem.get("reclaimWithinCohort", "Never") == "Never"
+        and borrow.get("policy", "Never") != "Never"
+    ):
+        # clusterqueue_types.go:423 CEL / clusterqueue_webhook.go:120-128
+        errs.add(
+            "spec.preemption",
+            "reclaimWithinCohort=Never and borrowWithinCohort.Policy!=Never",
+        )
+    if borrow.get("policy", "Never") == "LowerPriority" and borrow.get(
+        "maxPriorityThreshold"
+    ) is None:
+        pass  # threshold optional: unlimited below-priority borrow-preempt
+    weight = obj.get("fairSharingWeight")
+    if weight is not None and weight < 0:
+        errs.add("spec.fairSharing.weight", "must not be negative")
+    errs.raise_if_any()
+
+
+# ------------------------------------------------- local queue / cohort
+def validate_local_queue(obj: dict, old: Optional[dict] = None) -> None:
+    errs = _Errs()
+    _check_name(errs, "metadata.name", obj.get("name"))
+    _check_name(errs, "metadata.namespace", obj.get("namespace"))
+    _check_name(errs, "spec.clusterQueue", obj.get("clusterQueue"))
+    if old is not None and obj.get("clusterQueue") != old.get("clusterQueue"):
+        # localqueue_types.go:28 CEL: field is immutable
+        errs.add("spec.clusterQueue", "field is immutable")
+    errs.raise_if_any()
+
+
+def validate_cohort(obj: dict, old: Optional[dict] = None) -> None:
+    errs = _Errs()
+    _check_name(errs, "metadata.name", obj.get("name"))
+    _check_name(errs, "spec.parent", obj.get("parent"), required=False)
+    if obj.get("parent") and obj["parent"] == obj["name"]:
+        errs.add("spec.parent", "cohort cannot be its own parent")
+    if "resourceGroups" in obj:
+        _validate_resource_groups(
+            errs, obj, has_parent=bool(obj.get("parent"))
+        )
+    errs.raise_if_any()
+
+
+# -------------------------------------------------------- resource flavor
+def validate_resource_flavor(obj: dict, old: Optional[dict] = None) -> None:
+    """resourceflavor_webhook.go:88-120 + toleration CEL rules
+    (workload_types.go:443-448)."""
+    errs = _Errs()
+    _check_name(errs, "metadata.name", obj.get("name"))
+    _check_labels(errs, "spec.nodeLabels", obj.get("nodeLabels", {}))
+    for i, taint in enumerate(obj.get("nodeTaints", [])):
+        path = f"spec.nodeTaints[{i}]"
+        if not taint.get("key"):
+            errs.add(f"{path}.key", "must not be empty")
+        if taint.get("effect") not in TAINT_EFFECTS:
+            errs.add(
+                f"{path}.effect",
+                f"supported taint effect values: {', '.join(TAINT_EFFECTS)}",
+            )
+    for i, tol in enumerate(obj.get("tolerations", [])):
+        path = f"spec.tolerations[{i}]"
+        op = tol.get("operator", "Equal")
+        if op not in TOLERATION_OPERATORS:
+            errs.add(
+                f"{path}.operator",
+                "supported toleration values: 'Equal'(default), 'Exists'",
+            )
+        if not tol.get("key") and op != "Exists":
+            errs.add(
+                f"{path}.operator",
+                "operator must be Exists when 'key' is empty",
+            )
+        if op == "Exists" and tol.get("value"):
+            errs.add(
+                f"{path}.value",
+                "a value must be empty when 'operator' is 'Exists'",
+            )
+        effect = tol.get("effect", "")
+        if effect and effect not in TAINT_EFFECTS:
+            errs.add(
+                f"{path}.effect",
+                f"supported taint effect values: {', '.join(TAINT_EFFECTS)}",
+            )
+    errs.raise_if_any()
+
+
+# ------------------------------------------------------------- the chain
+_VALIDATORS = {
+    "workloads": validate_workload,
+    "clusterqueues": validate_cluster_queue,
+    "localqueues": validate_local_queue,
+    "cohorts": validate_cohort,
+    "resourceflavors": validate_resource_flavor,
+}
+
+_DEFAULTERS = {
+    "workloads": default_workload,
+    "clusterqueues": default_cluster_queue,
+    # cohorts carry the same resourceGroups shape (quantity canon)
+    "cohorts": default_cluster_queue,
+}
+
+
+def default_admission_chain() -> List[Callable]:
+    """The per-kind defaulter + validator stages the server installs
+    (pkg/webhooks/webhooks.go:25 Setup analog)."""
+
+    def _defaulting(section, obj, old, runtime):
+        fn = _DEFAULTERS.get(section)
+        return fn(obj, runtime) if fn else obj
+
+    def _validating(section, obj, old, runtime):
+        fn = _VALIDATORS.get(section)
+        if fn:
+            fn(obj, old)
+        return obj
+
+    return [_defaulting, _validating]

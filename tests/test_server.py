@@ -1,0 +1,264 @@
+"""Service surface tests: client<->server round trips for the object
+API, visibility, metrics, the phase-2 check endpoint, the stateless
+jax-assign solver, and the dashboard feed."""
+
+import pytest
+
+from kueue_tpu import serialization as ser
+from kueue_tpu.models import ClusterQueue, LocalQueue, ResourceFlavor, Workload
+from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.server import KueueClient, KueueServer, solve_assign
+from kueue_tpu.server.client import ClientError
+
+
+def _cq_dict(name="cq-a", cohort=None, cpu="10"):
+    cq = ClusterQueue(
+        name=name,
+        cohort=cohort,
+        namespace_selector={},
+        resource_groups=(
+            ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": cpu}),)),
+        ),
+    )
+    return ser.cq_to_dict(cq)
+
+
+def _wl_dict(name, cpu="2", queue="lq-a", priority=0):
+    wl = Workload(
+        namespace="ns",
+        name=name,
+        queue_name=queue,
+        priority=priority,
+        pod_sets=(PodSet.build("main", 1, {"cpu": cpu}),),
+    )
+    return ser.workload_to_dict(wl)
+
+
+@pytest.fixture()
+def server():
+    srv = KueueServer()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return KueueClient(f"http://127.0.0.1:{server.port}")
+
+
+def _seed(client):
+    client.apply("resourceflavors", ser.flavor_to_dict(ResourceFlavor(name="default")))
+    client.apply("clusterqueues", _cq_dict())
+    client.apply(
+        "localqueues",
+        ser.lq_to_dict(LocalQueue(namespace="ns", name="lq-a", cluster_queue="cq-a")),
+    )
+
+
+class TestObjectApi:
+    def test_health_and_metrics(self, client):
+        assert client.healthz() == {"status": "ok"}
+        assert "# TYPE" in client.metrics_text()
+
+    def test_apply_and_admit(self, client):
+        _seed(client)
+        client.apply("workloads", _wl_dict("w1"))
+        state = client.state()
+        wl = next(w for w in state["workloads"] if w["name"] == "w1")
+        # auto-reconcile admitted it (quota 10 >= 2)
+        assert wl["admission"]["clusterQueue"] == "cq-a"
+        assert [c["name"] for c in client.list("clusterqueues")] == ["cq-a"]
+
+    def test_validation_rejects(self, client):
+        _seed(client)
+        bad = _wl_dict("w1")
+        bad["podSets"] = []  # MinItems=1
+        with pytest.raises(ClientError) as exc:
+            client.apply("workloads", bad)
+        assert exc.value.status == 422
+        assert "podSets" in exc.value.message
+
+    def test_unknown_section_404(self, client):
+        with pytest.raises(ClientError) as exc:
+            client.apply("gadgets", {"name": "x"})
+        assert exc.value.status == 404
+
+    def test_delete_workload(self, client):
+        _seed(client)
+        client.apply("workloads", _wl_dict("w1"))
+        client.delete_workload("ns", "w1")
+        assert all(w["name"] != "w1" for w in client.state()["workloads"])
+        with pytest.raises(ClientError) as exc:
+            client.delete_workload("ns", "w1")
+        assert exc.value.status == 404
+
+    def test_visibility_positions(self, client):
+        _seed(client)
+        # one admitted + two pending behind a full queue
+        client.apply("workloads", _wl_dict("big", cpu="10"))
+        client.apply("workloads", _wl_dict("p1", cpu="4", priority=5))
+        client.apply("workloads", _wl_dict("p2", cpu="4", priority=1))
+        summary = client.pending_workloads_cq("cq-a")
+        names = [i["name"] for i in summary["items"]]
+        assert names == ["p1", "p2"]  # priority order
+        assert summary["items"][0]["positionInClusterQueue"] == 0
+        lq = client.pending_workloads_lq("ns", "lq-a")
+        assert [i["name"] for i in lq["items"]] == ["p1", "p2"]
+
+    def test_admission_check_phase2(self, client):
+        client.apply("resourceflavors", ser.flavor_to_dict(ResourceFlavor(name="default")))
+        client.apply(
+            "admissionchecks",
+            {"name": "prov", "controllerName": "test-controller"},
+        )
+        cq = _cq_dict()
+        cq["admissionChecks"] = ["prov"]
+        client.apply("clusterqueues", cq)
+        client.apply(
+            "localqueues",
+            ser.lq_to_dict(LocalQueue(namespace="ns", name="lq-a", cluster_queue="cq-a")),
+        )
+        client.apply("workloads", _wl_dict("w1"))
+        state = client.state()
+        wl = next(w for w in state["workloads"] if w["name"] == "w1")
+        # phase 1 done, phase 2 pending
+        assert wl["admission"]["clusterQueue"] == "cq-a"
+        assert not any(
+            c["type"] == "Admitted" and c["status"] for c in wl["conditions"]
+        )
+        client.set_admission_check_state("ns", "w1", "prov", "Ready")
+        wl = next(w for w in client.state()["workloads"] if w["name"] == "w1")
+        assert any(c["type"] == "Admitted" and c["status"] for c in wl["conditions"])
+
+    def test_dashboard(self, client):
+        _seed(client)
+        client.apply("workloads", _wl_dict("w1"))
+        dash = client.dashboard()
+        assert dash["clusterQueues"][0]["name"] == "cq-a"
+        assert dash["workloadStates"].get("Admitted") == 1
+        quota = dash["clusterQueues"][0]["quota"][0]
+        assert quota["used"] == 2000 and quota["nominal"] == 10000
+
+    def test_dashboard_html_served(self, server):
+        import urllib.request
+
+        html = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/", timeout=10
+        ).read().decode()
+        assert "kueue-tpu" in html and "/api/dashboard" in html
+
+
+class TestSolverService:
+    def _state(self, n=6):
+        flavors = [ser.flavor_to_dict(ResourceFlavor(name="default"))]
+        cqs = [_cq_dict("cq-a", cpu="8")]
+        lqs = [
+            ser.lq_to_dict(
+                LocalQueue(namespace="ns", name="lq-a", cluster_queue="cq-a")
+            )
+        ]
+        wls = [_wl_dict(f"w{i}", cpu="2", priority=i) for i in range(n)]
+        return {
+            "resourceFlavors": flavors,
+            "clusterQueues": cqs,
+            "localQueues": lqs,
+            "workloads": wls,
+        }
+
+    def test_solve_assign_function(self):
+        out = solve_assign({"state": self._state(), "options": {"untilIdle": True}})
+        admitted = [d for d in out["decisions"] if d["outcome"] != "Pending"]
+        # 8 cpu quota, 2 cpu each -> exactly 4 admitted
+        assert len(admitted) == 4
+        # highest priorities win
+        assert {d["workload"] for d in admitted} == {f"ns/w{i}" for i in (2, 3, 4, 5)}
+        for d in admitted:
+            assert d["admission"]["clusterQueue"] == "cq-a"
+
+    def test_solver_vs_host_parity(self):
+        solver = solve_assign(
+            {"state": self._state(), "options": {"untilIdle": True, "useSolver": True}}
+        )
+        host = solve_assign(
+            {"state": self._state(), "options": {"untilIdle": True, "useSolver": False}}
+        )
+        assert [d["outcome"] for d in solver["decisions"]] == [
+            d["outcome"] for d in host["decisions"]
+        ]
+
+    def test_solve_over_http(self, client):
+        out = client.solve(self._state(), until_idle=True)
+        assert sum(d["outcome"] != "Pending" for d in out["decisions"]) == 4
+
+    def test_solve_bad_body(self, client):
+        with pytest.raises(ClientError) as exc:
+            client._request("POST", "/apis/solver/v1beta1/assign", {"nope": 1})
+        assert exc.value.status == 400
+
+    def test_single_cycle_reports_preemptions(self):
+        state = self._state(2)
+        # saturate with an admitted low-prio wl, then a high-prio head
+        from kueue_tpu.models.constants import (
+            PreemptionPolicy,
+            ReclaimWithinCohortPolicy,
+        )
+
+        state["clusterQueues"][0]["preemption"]["withinClusterQueue"] = (
+            PreemptionPolicy.LOWER_PRIORITY.value
+        )
+        victim = _wl_dict("victim", cpu="8", priority=0)
+        victim["admission"] = {
+            "clusterQueue": "cq-a",
+            "podSetAssignments": [
+                {
+                    "name": "main",
+                    "flavors": {"cpu": "default"},
+                    "resourceUsage": {"cpu": 8000},
+                    "count": 1,
+                }
+            ],
+        }
+        victim["conditions"] = [
+            {
+                "type": "QuotaReserved",
+                "status": True,
+                "reason": "QuotaReserved",
+                "message": "",
+                "lastTransitionTime": 0.0,
+            }
+        ]
+        state["workloads"] = [victim, _wl_dict("attacker", cpu="8", priority=50)]
+        out = solve_assign({"state": state})
+        assert out["preemptions"] == [
+            {"victim": "ns/victim", "by": "ns/attacker", "reason": "InClusterQueue"}
+        ]
+
+
+class TestCliServerMode:
+    def test_pending_workloads_via_server(self, server, client, capsys):
+        from kueue_tpu.cli.__main__ import main
+
+        _seed(client)
+        client.apply("workloads", _wl_dict("big", cpu="10"))
+        client.apply("workloads", _wl_dict("p1", cpu="4", priority=5))
+        main(
+            [
+                "pending-workloads",
+                "cq-a",
+                "--server",
+                f"http://127.0.0.1:{server.port}",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert "p1" in out and "big" not in out
+
+
+class TestStateRoundTrip:
+    def test_runtime_state_round_trip(self, client):
+        _seed(client)
+        client.apply("workloads", _wl_dict("w1"))
+        state = client.state()
+        rt2 = ser.runtime_from_state(state)
+        assert ser.runtime_to_state(rt2) == state

@@ -1,0 +1,493 @@
+"""Webhook layer tests — table-driven, mirroring the reference's
+pkg/webhooks/*_test.go cases."""
+
+import pytest
+
+from kueue_tpu.features import override
+from kueue_tpu.webhooks import (
+    ValidationError,
+    default_workload,
+    validate_cluster_queue,
+    validate_cohort,
+    validate_local_queue,
+    validate_resource_flavor,
+    validate_workload,
+)
+
+
+def _wl(**over):
+    base = {
+        "name": "wl",
+        "namespace": "ns",
+        "queueName": "lq",
+        "podSets": [{"name": "main", "count": 2, "requests": {"cpu": "1"}}],
+    }
+    base.update(over)
+    return base
+
+
+def _paths(exc):
+    return [p for p, _ in exc.value.errors]
+
+
+WORKLOAD_INVALID = [
+    ("no-podsets", _wl(podSets=[]), "spec.podSets"),
+    (
+        "too-many-podsets",
+        _wl(podSets=[{"name": f"p{i}", "count": 1} for i in range(9)]),
+        "spec.podSets",
+    ),
+    (
+        "bad-podset-name",
+        _wl(podSets=[{"name": "Main_X", "count": 1}]),
+        "spec.podSets[0].name",
+    ),
+    (
+        "dup-podset-name",
+        _wl(podSets=[{"name": "a", "count": 1}, {"name": "a", "count": 1}]),
+        "spec.podSets[1].name",
+    ),
+    (
+        "zero-count",
+        _wl(podSets=[{"name": "a", "count": 0}]),
+        "spec.podSets[0].count",
+    ),
+    (
+        "min-count-above-count",
+        _wl(podSets=[{"name": "a", "count": 2, "minCount": 3}]),
+        "spec.podSets[0].minCount",
+    ),
+    (
+        "two-min-counts",
+        _wl(
+            podSets=[
+                {"name": "a", "count": 2, "minCount": 1},
+                {"name": "b", "count": 2, "minCount": 1},
+            ]
+        ),
+        "spec.podSets",
+    ),
+    (
+        "reserved-pods-resource",
+        _wl(podSets=[{"name": "a", "count": 1, "requests": {"pods": "1"}}]),
+        "spec.podSets[0].requests[pods]",
+    ),
+    (
+        "bad-queue-name",
+        _wl(queueName="Not_Valid"),
+        "spec.queueName",
+    ),
+    (
+        "priority-class-without-priority",
+        _wl(priorityClassName="high"),
+        "spec.priority",
+    ),
+    (
+        "max-exec-time-zero",
+        _wl(maximumExecutionTimeSeconds=0),
+        "spec.maximumExecutionTimeSeconds",
+    ),
+    (
+        "unknown-reclaimable-podset",
+        _wl(reclaimablePods={"ghost": 1}),
+        "status.reclaimablePods[ghost].name",
+    ),
+    (
+        "reclaimable-over-count",
+        _wl(reclaimablePods={"main": 5}),
+        "status.reclaimablePods[main].count",
+    ),
+]
+
+
+class TestWorkloadValidation:
+    def test_valid(self):
+        validate_workload(_wl())
+
+    @pytest.mark.parametrize(
+        "case,obj,path", WORKLOAD_INVALID, ids=[c[0] for c in WORKLOAD_INVALID]
+    )
+    def test_invalid(self, case, obj, path):
+        with pytest.raises(ValidationError) as exc:
+            validate_workload(obj)
+        assert path in _paths(exc)
+
+    def test_admission_usage_not_multiple_of_count(self):
+        obj = _wl(
+            admission={
+                "clusterQueue": "cq",
+                "podSetAssignments": [
+                    {
+                        "name": "main",
+                        "flavors": {"cpu": "f"},
+                        "resourceUsage": {"cpu": 3001},
+                        "count": 2,
+                    }
+                ],
+            }
+        )
+        with pytest.raises(ValidationError) as exc:
+            validate_workload(obj)
+        assert "status.admission.podSetAssignments[0].resourceUsage[cpu]" in _paths(exc)
+
+    def test_quota_reserved_requires_matching_assignments(self):
+        # workload_types.go:637-641 CEL
+        obj = _wl(
+            conditions=[{"type": "QuotaReserved", "status": True}],
+            admission={"clusterQueue": "cq", "podSetAssignments": []},
+        )
+        with pytest.raises(ValidationError) as exc:
+            validate_workload(obj)
+        assert "status.admission.podSetAssignments" in _paths(exc)
+
+    def test_all_errors_reported_at_once(self):
+        obj = _wl(
+            queueName="Bad_Q",
+            podSets=[{"name": "a", "count": 0}],
+            maximumExecutionTimeSeconds=0,
+        )
+        with pytest.raises(ValidationError) as exc:
+            validate_workload(obj)
+        assert len(exc.value.errors) >= 3
+
+
+class TestWorkloadImmutability:
+    def _reserved(self, **over):
+        return _wl(
+            conditions=[{"type": "QuotaReserved", "status": True}],
+            admission={
+                "clusterQueue": "cq",
+                "podSetAssignments": [
+                    {
+                        "name": "main",
+                        "flavors": {"cpu": "f"},
+                        "resourceUsage": {"cpu": 2000},
+                        "count": 2,
+                    }
+                ],
+            },
+            **over,
+        )
+
+    def test_podsets_immutable_with_reservation(self):
+        old = self._reserved()
+        new = self._reserved(
+            podSets=[{"name": "main", "count": 3, "requests": {"cpu": "1"}}]
+        )
+        # count changed -> both podSets and assignment-count mismatch fire
+        with pytest.raises(ValidationError) as exc:
+            validate_workload(new, old)
+        assert "spec.podSets" in _paths(exc)
+
+    def test_queue_name_immutable_while_admitted(self):
+        old = self._reserved()
+        new = self._reserved(queueName="other")
+        with pytest.raises(ValidationError) as exc:
+            validate_workload(new, old)
+        assert "spec.queueName" in _paths(exc)
+
+    def test_queue_name_mutable_before_admission(self):
+        validate_workload(_wl(queueName="other"), _wl())
+
+    def test_admission_set_or_unset_ok_change_not(self):
+        old = self._reserved()
+        # unsetting is fine
+        cleared = _wl(conditions=[])
+        validate_workload(cleared, old)
+        # changing is not
+        new = self._reserved()
+        new["admission"] = dict(new["admission"], clusterQueue="cq2")
+        with pytest.raises(ValidationError) as exc:
+            validate_workload(new, old)
+        assert "status.admission" in _paths(exc)
+
+    def test_reclaimable_cannot_decrease_while_admitted(self):
+        old = self._reserved(reclaimablePods={"main": 2})
+        new = self._reserved(reclaimablePods={"main": 1})
+        with pytest.raises(ValidationError) as exc:
+            validate_workload(new, old)
+        assert "status.reclaimablePods[main].count" in _paths(exc)
+
+
+class TestWorkloadDefaulting:
+    def test_single_podset_named_main(self):
+        obj = {"name": "w", "podSets": [{"count": 1}]}
+        assert default_workload(obj)["podSets"][0]["name"] == "main"
+
+    def test_min_count_dropped_without_partial_admission(self):
+        obj = _wl(podSets=[{"name": "a", "count": 2, "minCount": 1}])
+        with override("PartialAdmission", False):
+            assert default_workload(obj)["podSets"][0]["minCount"] is None
+        with override("PartialAdmission", True):
+            assert default_workload(obj)["podSets"][0]["minCount"] == 1
+
+    def test_priority_resolved_from_class(self):
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.models import WorkloadPriorityClass
+
+        rt = ClusterRuntime()
+        rt.add_priority_class(WorkloadPriorityClass(name="high", value=500))
+        out = default_workload(_wl(priorityClassName="high"), rt)
+        assert out["priority"] == 500
+        validate_workload(out)  # now passes the CEL-equivalent rule
+
+    def test_active_defaults_true(self):
+        assert default_workload({"name": "w", "podSets": []})["active"] is True
+
+
+def _cq(**over):
+    base = {
+        "name": "cq",
+        "resourceGroups": [
+            {
+                "coveredResources": ["cpu"],
+                "flavors": [
+                    {
+                        "name": "default",
+                        "resources": [{"name": "cpu", "nominalQuota": 10_000}],
+                    }
+                ],
+            }
+        ],
+    }
+    base.update(over)
+    return base
+
+
+def _quota(name="cpu", nominal=10_000, **over):
+    return dict({"name": name, "nominalQuota": nominal}, **over)
+
+
+CQ_INVALID = [
+    (
+        "borrowing-limit-without-cohort",
+        _cq(
+            resourceGroups=[
+                {
+                    "coveredResources": ["cpu"],
+                    "flavors": [
+                        {
+                            "name": "f",
+                            "resources": [_quota(borrowingLimit=1000)],
+                        }
+                    ],
+                }
+            ]
+        ),
+        "borrowingLimit",
+    ),
+    (
+        "lending-limit-without-cohort",
+        _cq(
+            resourceGroups=[
+                {
+                    "coveredResources": ["cpu"],
+                    "flavors": [
+                        {"name": "f", "resources": [_quota(lendingLimit=1000)]}
+                    ],
+                }
+            ]
+        ),
+        "lendingLimit",
+    ),
+    (
+        "negative-nominal",
+        _cq(
+            resourceGroups=[
+                {
+                    "coveredResources": ["cpu"],
+                    "flavors": [{"name": "f", "resources": [_quota(nominal=-5)]}],
+                }
+            ]
+        ),
+        "nominalQuota",
+    ),
+    (
+        "flavor-resources-mismatch",
+        _cq(
+            resourceGroups=[
+                {
+                    "coveredResources": ["cpu", "memory"],
+                    "flavors": [{"name": "f", "resources": [_quota()]}],
+                }
+            ]
+        ),
+        "resources",
+    ),
+    (
+        "duplicate-covered-resource",
+        _cq(
+            resourceGroups=[
+                {
+                    "coveredResources": ["cpu"],
+                    "flavors": [{"name": "f", "resources": [_quota()]}],
+                },
+                {
+                    "coveredResources": ["cpu"],
+                    "flavors": [{"name": "g", "resources": [_quota()]}],
+                },
+            ]
+        ),
+        "coveredResources",
+    ),
+    (
+        "duplicate-flavor",
+        _cq(
+            resourceGroups=[
+                {
+                    "coveredResources": ["cpu"],
+                    "flavors": [
+                        {"name": "f", "resources": [_quota()]},
+                        {"name": "f", "resources": [_quota()]},
+                    ],
+                }
+            ]
+        ),
+        "flavors[1].name",
+    ),
+    (
+        "reclaim-never-borrow-set",
+        _cq(
+            preemption={
+                "reclaimWithinCohort": "Never",
+                "borrowWithinCohort": {"policy": "LowerPriority"},
+            }
+        ),
+        "spec.preemption",
+    ),
+]
+
+
+class TestClusterQueueValidation:
+    def test_valid(self):
+        validate_cluster_queue(_cq())
+
+    def test_valid_with_cohort_limits(self):
+        obj = _cq(
+            cohort="team",
+            resourceGroups=[
+                {
+                    "coveredResources": ["cpu"],
+                    "flavors": [
+                        {
+                            "name": "f",
+                            "resources": [
+                                _quota(borrowingLimit=5000, lendingLimit=5000)
+                            ],
+                        }
+                    ],
+                }
+            ],
+        )
+        validate_cluster_queue(obj)
+
+    @pytest.mark.parametrize(
+        "case,obj,path_frag", CQ_INVALID, ids=[c[0] for c in CQ_INVALID]
+    )
+    def test_invalid(self, case, obj, path_frag):
+        with pytest.raises(ValidationError) as exc:
+            validate_cluster_queue(obj)
+        assert any(path_frag in p for p in _paths(exc))
+
+    def test_lending_above_nominal(self):
+        obj = _cq(
+            cohort="team",
+            resourceGroups=[
+                {
+                    "coveredResources": ["cpu"],
+                    "flavors": [
+                        {
+                            "name": "f",
+                            "resources": [_quota(nominal=1000, lendingLimit=2000)],
+                        }
+                    ],
+                }
+            ],
+        )
+        with pytest.raises(ValidationError) as exc:
+            validate_cluster_queue(obj)
+        assert any("lendingLimit" in p for p in _paths(exc))
+
+
+class TestLocalQueueAndCohort:
+    def test_lq_cluster_queue_immutable(self):
+        old = {"name": "lq", "namespace": "ns", "clusterQueue": "a"}
+        new = {"name": "lq", "namespace": "ns", "clusterQueue": "b"}
+        with pytest.raises(ValidationError) as exc:
+            validate_local_queue(new, old)
+        assert "spec.clusterQueue" in _paths(exc)
+        validate_local_queue(dict(old), old)
+
+    def test_cohort_self_parent(self):
+        with pytest.raises(ValidationError):
+            validate_cohort({"name": "a", "parent": "a"})
+        validate_cohort({"name": "a", "parent": "b"})
+
+    def test_cohort_limits_require_parent(self):
+        obj = {
+            "name": "a",
+            "resourceGroups": [
+                {
+                    "coveredResources": ["cpu"],
+                    "flavors": [
+                        {"name": "f", "resources": [_quota(borrowingLimit=1)]}
+                    ],
+                }
+            ],
+        }
+        with pytest.raises(ValidationError):
+            validate_cohort(obj)
+        validate_cohort(dict(obj, parent="root"))
+
+
+class TestResourceFlavorValidation:
+    def test_valid(self):
+        validate_resource_flavor(
+            {
+                "name": "f",
+                "nodeLabels": {"zone": "z1"},
+                "nodeTaints": [{"key": "k", "value": "v", "effect": "NoSchedule"}],
+                "tolerations": [{"key": "t", "operator": "Exists"}],
+            }
+        )
+
+    @pytest.mark.parametrize(
+        "case,obj,path_frag",
+        [
+            (
+                "taint-no-key",
+                {"name": "f", "nodeTaints": [{"effect": "NoSchedule"}]},
+                "nodeTaints[0].key",
+            ),
+            (
+                "taint-bad-effect",
+                {"name": "f", "nodeTaints": [{"key": "k", "effect": "Nope"}]},
+                "nodeTaints[0].effect",
+            ),
+            (
+                "toleration-exists-with-value",
+                {
+                    "name": "f",
+                    "tolerations": [
+                        {"key": "k", "operator": "Exists", "value": "v"}
+                    ],
+                },
+                "tolerations[0].value",
+            ),
+            (
+                "toleration-empty-key-equal",
+                {"name": "f", "tolerations": [{"operator": "Equal"}]},
+                "tolerations[0].operator",
+            ),
+            (
+                "bad-label-value",
+                {"name": "f", "nodeLabels": {"k": "bad value!"}},
+                "nodeLabels",
+            ),
+        ],
+        ids=lambda c: c if isinstance(c, str) else "",
+    )
+    def test_invalid(self, case, obj, path_frag):
+        with pytest.raises(ValidationError) as exc:
+            validate_resource_flavor(obj)
+        assert any(path_frag in p for p in _paths(exc))
