@@ -243,6 +243,18 @@ class ClusterRuntime:
 
     # ---- workload store, used by reconcilers ----
     def add_workload(self, wl: Workload) -> None:
+        # Replacing a DIFFERENT object under the same key releases the
+        # old copy's cache/queue state first (the reference's update
+        # handlers route transitions explicitly; here delete+add is
+        # observationally the same and leak-free — e.g. a re-POST with
+        # admission unset must free the previously charged quota).
+        old = self.workloads.get(wl.key)
+        if old is not None and old is not wl:
+            self.queues.delete_workload(old)
+            if self.cache.delete_workload(old):
+                self.queues.queue_associated_inadmissible_workloads_after(
+                    old.admission.cluster_queue if old.admission else ""
+                )
         self.workloads[wl.key] = wl
         if wl.is_finished:
             return

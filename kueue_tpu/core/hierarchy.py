@@ -227,7 +227,13 @@ class FlatHierarchy:
         return len(self.cq_names) + len(self.cohort_names)
 
     def level_masks(self) -> np.ndarray:
-        """bool[max_depth+1, N]: mask of nodes at each depth."""
-        return np.stack(
-            [self.depth == d for d in range(self.max_depth + 1)]
-        ) if self.n_nodes else np.zeros((1, 0), dtype=bool)
+        """bool[max_depth+1, N]: mask of nodes at each depth.
+        Memoized — the hierarchy is frozen, and the scheduler asks for
+        these masks thousands of times per cycle."""
+        cached = getattr(self, "_lm_cache", None)
+        if cached is None:
+            cached = np.stack(
+                [self.depth == d for d in range(self.max_depth + 1)]
+            ) if self.n_nodes else np.zeros((1, 0), dtype=bool)
+            object.__setattr__(self, "_lm_cache", cached)
+        return cached

@@ -78,24 +78,107 @@ class Snapshot:
     generations: Dict[str, int] = field(default_factory=dict)
     # WorkloadPriorityClass map for consistent priority resolution
     priority_classes: Dict[str, object] = field(default_factory=dict)
+    # derived-matrix caches, invalidated by _mutated()
+    _usage_version: int = 0
+    _usage_cache: Optional[tuple] = None
+    _avail_cache: Optional[tuple] = None
+    _pa_cache: Optional[np.ndarray] = None
+    # incrementally-maintained tree usage (usage_tree_np semantics):
+    # updated along the mutated row's ancestor path in O(depth*FR)
+    # instead of re-running the full level-scheduled reduction, so the
+    # admit loop's per-entry fits() re-check is a path walk, not a
+    # matrix recompute.
+    _tree_usage: Optional[np.ndarray] = None
+    _paths: Dict[int, List[int]] = field(default_factory=dict)
 
     # ---- derived state ----
+    # The usage/available matrices are O(N*FR) tree reductions queried
+    # thousands of times per cycle (per head, per flavor) but mutated
+    # only between queries (add/remove usage). A version counter keyed
+    # cache collapses the recomputes to one per mutation epoch.
+    def _mutated(self) -> None:
+        self._usage_version += 1
+
     def usage(self) -> np.ndarray:
-        return usage_tree_np(
-            self.flat.parent, self._lm(), self.guaranteed, self.local_usage
-        )
+        if self._usage_cache is None or self._usage_cache[0] != self._usage_version:
+            self._usage_cache = (
+                self._usage_version,
+                usage_tree_np(
+                    self.flat.parent, self._lm(), self.guaranteed,
+                    self.local_usage,
+                ),
+            )
+        return self._usage_cache[1]
+
+    # ---- incremental tree usage + single-row available ----
+    def _ensure_tree_usage(self) -> np.ndarray:
+        if self._tree_usage is None:
+            self._tree_usage = self.usage().copy()
+        return self._tree_usage
+
+    def _apply_tree_delta(self, row: int, vec: np.ndarray, sign: int) -> None:
+        """Propagate a leaf usage change up the cohort path; exact twin
+        of re-running usage_tree_np (child contribution to its parent is
+        max(0, usage - guaranteed))."""
+        if self._tree_usage is None:
+            return
+        U, G, parent = self._tree_usage, self.guaranteed, self.flat.parent
+        delta = sign * vec
+        cur = row
+        while True:
+            old_excess = np.maximum(0, U[cur] - G[cur])
+            U[cur] = U[cur] + delta
+            p = int(parent[cur])
+            if p < 0:
+                break
+            delta = np.maximum(0, U[cur] - G[cur]) - old_excess
+            if not delta.any():
+                break
+            cur = p
+
+    def _path_of(self, row: int) -> List[int]:
+        path = self._paths.get(row)
+        if path is None:
+            path = [row] + self.path_to_root(row)
+            self._paths[row] = path
+        return path
+
+    def available_row(self, row: int) -> np.ndarray:
+        """available() for one node via a root->node path walk over the
+        incrementally-maintained tree usage — O(depth*FR) instead of the
+        O(N*FR) full reduction; parity with available_all_np is asserted
+        in tests."""
+        U = self._ensure_tree_usage()
+        path = self._path_of(row)
+        root = path[-1]
+        avail = self.subtree[root] - U[root]
+        for n in reversed(path[:-1]):
+            stored = self.subtree[n] - self.guaranteed[n]
+            used = np.maximum(0, U[n] - self.guaranteed[n])
+            with_max = stored - used + self.borrowing_limit[n]
+            has_borrow = self.borrowing_limit[n] < NO_LIMIT
+            clamped = np.where(has_borrow, np.minimum(with_max, avail), avail)
+            avail = np.maximum(0, self.guaranteed[n] - U[n]) + clamped
+        return avail
 
     def available(self) -> np.ndarray:
-        return available_all_np(
-            self.flat.parent, self._lm(), self.subtree, self.guaranteed,
-            self.borrowing_limit, self.usage(),
-        )
+        if self._avail_cache is None or self._avail_cache[0] != self._usage_version:
+            self._avail_cache = (
+                self._usage_version,
+                available_all_np(
+                    self.flat.parent, self._lm(), self.subtree,
+                    self.guaranteed, self.borrowing_limit, self.usage(),
+                ),
+            )
+        return self._avail_cache[1]
 
     def potential_available(self) -> np.ndarray:
-        return potential_available_all_np(
-            self.flat.parent, self._lm(), self.subtree, self.guaranteed,
-            self.borrowing_limit,
-        )
+        if self._pa_cache is None:  # usage-independent: compute once
+            self._pa_cache = potential_available_all_np(
+                self.flat.parent, self._lm(), self.subtree, self.guaranteed,
+                self.borrowing_limit,
+            )
+        return self._pa_cache
 
     def _lm(self) -> np.ndarray:
         return self.flat.level_masks()
@@ -106,12 +189,12 @@ class Snapshot:
     # ---- queries (ClusterQueueSnapshot equivalents) ----
     def fits(self, cq_name: str, usage_vec: np.ndarray) -> bool:
         """FitInCohort/Fits: every requested cell within available."""
-        avail = self.available()[self.row(cq_name)]
+        avail = self.available_row(self.row(cq_name))
         need = usage_vec > 0
         return bool(np.all(avail[need] >= usage_vec[need]))
 
     def available_for(self, cq_name: str) -> np.ndarray:
-        return self.available()[self.row(cq_name)]
+        return self.available_row(self.row(cq_name))
 
     def borrowing_after(self, cq_name: str, usage_vec: np.ndarray) -> bool:
         """Would admitting usage_vec push the CQ above its nominal
@@ -126,21 +209,31 @@ class Snapshot:
 
     # ---- simulation (SimulateUsageAddition/Removal, RemoveWorkload) ----
     def add_usage(self, cq_name: str, usage_vec: np.ndarray) -> None:
-        self.local_usage[self.row(cq_name)] += usage_vec
+        row = self.row(cq_name)
+        self.local_usage[row] += usage_vec
+        self._apply_tree_delta(row, usage_vec, 1)
+        self._mutated()
 
     def remove_usage(self, cq_name: str, usage_vec: np.ndarray) -> None:
-        self.local_usage[self.row(cq_name)] -= usage_vec
+        row = self.row(cq_name)
+        self.local_usage[row] -= usage_vec
+        self._apply_tree_delta(row, usage_vec, -1)
+        self._mutated()
 
     def add_workload(self, ws: WorkloadSnapshot) -> None:
         self.workloads[ws.workload.key] = ws
         self._by_cq.setdefault(ws.cq_name, {})[ws.workload.key] = ws
         self.local_usage[ws.cq_row] += ws.usage_vec
+        self._apply_tree_delta(ws.cq_row, ws.usage_vec, 1)
+        self._mutated()
 
     def remove_workload(self, wl_key: str) -> Optional[WorkloadSnapshot]:
         ws = self.workloads.pop(wl_key, None)
         if ws is not None:
             self._by_cq.get(ws.cq_name, {}).pop(wl_key, None)
             self.local_usage[ws.cq_row] -= ws.usage_vec
+            self._apply_tree_delta(ws.cq_row, ws.usage_vec, -1)
+            self._mutated()
         return ws
 
     def workloads_in_cq(self, cq_name: str) -> List[WorkloadSnapshot]:

@@ -35,12 +35,14 @@ def main(argv=None) -> int:
     from kueue_tpu import serialization as ser
     from kueue_tpu.server import KueueServer
 
-    runtime = None
+    use_solver = False if args.no_solver else None
     if args.state:
         with open(args.state) as f:
-            runtime = ser.runtime_from_state(
-                json.load(f), use_solver=not args.no_solver
-            )
+            runtime = ser.runtime_from_state(json.load(f), use_solver=use_solver)
+    else:
+        from kueue_tpu.controllers import ClusterRuntime
+
+        runtime = ClusterRuntime(use_solver=use_solver)
     srv = KueueServer(
         runtime=runtime,
         host=args.host,

@@ -100,6 +100,12 @@ def solve_assign(request: dict) -> dict:
     preemptions: List[dict] = []
     if until_idle:
         cycles = rt.run_until_idle()
+        # preemptions executed during the drain surface as events
+        preemptions = [
+            {"victim": e.object_key, "reason": e.message}
+            for e in rt.events
+            if e.kind == "Preempted"
+        ]
     else:
         result = rt.schedule_once()
         cycles = 1
@@ -168,13 +174,37 @@ class KueueServer:
         self._port = port
 
     # ---- object API ----
-    def _find_existing(self, section_key: str, obj: dict):
-        data = ser.runtime_to_state(self.runtime)
-        for existing in data.get(section_key, []):
-            if existing["name"] == obj.get("name") and existing.get(
-                "namespace", ""
-            ) == obj.get("namespace", ""):
-                return existing
+    def _find_existing(self, section: str, obj: dict):
+        """Wire dict of the stored object with the same identity, via a
+        direct store lookup (no full-state serialization on the ingest
+        path)."""
+        rt = self.runtime
+        name = obj.get("name", "")
+        namespace = obj.get("namespace", "")
+        if section == "workloads":
+            wl = rt.workloads.get(f"{namespace}/{name}")
+            return ser.workload_to_dict(wl) if wl is not None else None
+        if section == "clusterqueues":
+            cached = rt.cache.cluster_queues.get(name)
+            return ser.cq_to_dict(cached.model) if cached is not None else None
+        if section == "localqueues":
+            lq = rt.cache.local_queues.get(f"{namespace}/{name}")
+            return ser.lq_to_dict(lq) if lq is not None else None
+        if section == "resourceflavors":
+            f = rt.cache.flavors.get(name)
+            return ser.flavor_to_dict(f) if f is not None else None
+        if section == "cohorts":
+            c = rt.cache.cohorts.get(name)
+            return ser.cohort_to_dict(c) if c is not None else None
+        if section == "admissionchecks":
+            ac = rt.cache.admission_checks.get(name)
+            return ser.check_to_dict(ac) if ac is not None else None
+        if section == "topologies":
+            t = rt.cache.topologies.get(name)
+            return ser.topology_to_dict(t) if t is not None else None
+        if section == "workloadpriorityclasses":
+            pc = rt.cache.priority_classes.get(name)
+            return ser.priority_class_to_dict(pc) if pc is not None else None
         return None
 
     def apply(self, section: str, obj: dict) -> dict:
@@ -185,7 +215,7 @@ class KueueServer:
         from kueue_tpu.webhooks import ValidationError
 
         with self.lock:
-            old = self._find_existing(state_key, obj)
+            old = self._find_existing(section, obj)
             try:
                 for admit in self.validators:
                     obj = admit(section, obj, old, self.runtime)
@@ -238,10 +268,48 @@ class KueueServer:
     def list_section(self, section: str) -> dict:
         if section not in _SECTIONS:
             raise ApiError(404, f"unknown section {section!r}")
-        state_key = _SECTIONS[section][0]
+        rt = self.runtime
         with self.lock:
-            items = ser.runtime_to_state(self.runtime).get(state_key, [])
-            return {"items": items}
+            if section == "workloads":
+                items = [
+                    ser.workload_to_dict(w) for _, w in sorted(rt.workloads.items())
+                ]
+            elif section == "clusterqueues":
+                items = [
+                    ser.cq_to_dict(c.model)
+                    for _, c in sorted(rt.cache.cluster_queues.items())
+                ]
+            elif section == "localqueues":
+                items = [
+                    ser.lq_to_dict(l)
+                    for _, l in sorted(rt.cache.local_queues.items())
+                ]
+            elif section == "resourceflavors":
+                items = [
+                    ser.flavor_to_dict(f)
+                    for _, f in sorted(rt.cache.flavors.items())
+                ]
+            elif section == "cohorts":
+                items = [
+                    ser.cohort_to_dict(c)
+                    for _, c in sorted(rt.cache.cohorts.items())
+                ]
+            elif section == "admissionchecks":
+                items = [
+                    ser.check_to_dict(a)
+                    for _, a in sorted(rt.cache.admission_checks.items())
+                ]
+            elif section == "topologies":
+                items = [
+                    ser.topology_to_dict(t)
+                    for _, t in sorted(rt.cache.topologies.items())
+                ]
+            else:  # workloadpriorityclasses
+                items = [
+                    ser.priority_class_to_dict(p)
+                    for _, p in sorted(rt.cache.priority_classes.items())
+                ]
+        return {"items": items}
 
     # ---- http plumbing ----
     def start(self) -> int:
@@ -377,24 +445,27 @@ def _make_handler(srv: KueueServer):
                 text = srv.runtime.metrics.registry.expose()
             self._send_text(text, "text/plain; version=0.0.4")
 
+        def _int_param(self, query, key, default):
+            try:
+                return int(query.get(key, default))
+            except ValueError:
+                raise ApiError(400, f"query parameter {key!r} must be an integer")
+
         def _h_vis_cq(self, cq, query):
+            offset = self._int_param(query, "offset", 0)
+            limit = self._int_param(query, "limit", 1000)
             with srv.lock:
                 summary = visibility.pending_workloads_in_cq(
-                    srv.runtime.queues,
-                    cq,
-                    offset=int(query.get("offset", 0)),
-                    limit=int(query.get("limit", 1000)),
+                    srv.runtime.queues, cq, offset=offset, limit=limit
                 )
             self._send_json(_summary_to_dict(summary))
 
         def _h_vis_lq(self, ns, lq, query):
+            offset = self._int_param(query, "offset", 0)
+            limit = self._int_param(query, "limit", 1000)
             with srv.lock:
                 summary = visibility.pending_workloads_in_lq(
-                    srv.runtime.queues,
-                    ns,
-                    lq,
-                    offset=int(query.get("offset", 0)),
-                    limit=int(query.get("limit", 1000)),
+                    srv.runtime.queues, ns, lq, offset=offset, limit=limit
                 )
             self._send_json(_summary_to_dict(summary))
 
@@ -430,8 +501,9 @@ def _make_handler(srv: KueueServer):
             self._send_json({"cycles": cycles})
 
         def _h_state(self, query):
-            with srv.lock:
-                self._send_json(ser.runtime_to_state(srv.runtime))
+            with srv.lock:  # snapshot under lock; write to client outside
+                state = ser.runtime_to_state(srv.runtime)
+            self._send_json(state)
 
         def _h_solve(self, query):
             # stateless: deliberately NOT under srv.lock — solving a
@@ -442,7 +514,8 @@ def _make_handler(srv: KueueServer):
             from kueue_tpu.server.dashboard import dashboard_payload
 
             with srv.lock:
-                self._send_json(dashboard_payload(srv.runtime))
+                payload = dashboard_payload(srv.runtime)
+            self._send_json(payload)
 
         def _h_dashboard_html(self, query):
             from kueue_tpu.server.dashboard import DASHBOARD_HTML

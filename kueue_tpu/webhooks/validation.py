@@ -238,7 +238,10 @@ def _validate_workload_status(errs: _Errs, obj: dict, podset_names) -> None:
             count = psa.get("count", 0)
             if count > 0:
                 for rname, qty in psa.get("resourceUsage", {}).items():
-                    if qty % count != 0:
+                    qty = _check_quantity(
+                        errs, f"{path}.resourceUsage[{rname}]", qty, rname
+                    )
+                    if qty is not None and qty % count != 0:
                         errs.add(
                             f"{path}.resourceUsage[{rname}]",
                             f"is not a multiple of {count}",
@@ -254,22 +257,84 @@ def _validate_workload_status(errs: _Errs, obj: dict, podset_names) -> None:
             )
 
 
+def _norm_qty_map(m: dict) -> dict:
+    from kueue_tpu.serialization import _canon_qty
+
+    out = {}
+    for r, q in (m or {}).items():
+        try:
+            out[r] = _canon_qty(r, q)
+        except Exception:  # noqa: BLE001 — unparseable compares as-is
+            out[r] = q
+    return out
+
+
+def _norm_podsets(pod_sets) -> tuple:
+    """Semantic form of a podSet list: defaults filled, quantities
+    canonical — so a re-POST of the original sparse manifest compares
+    equal to the fully-serialized stored copy."""
+    return tuple(
+        (
+            ps.get("name", ""),
+            ps.get("count", 0),
+            ps.get("minCount"),
+            tuple(sorted(_norm_qty_map(ps.get("requests")).items())),
+            tuple(sorted((ps.get("nodeSelector") or {}).items())),
+            (
+                (ps["topologyRequest"].get("mode"), ps["topologyRequest"].get("level"))
+                if ps.get("topologyRequest")
+                else None
+            ),
+        )
+        for ps in pod_sets or []
+    )
+
+
+def _norm_admission(adm: Optional[dict]):
+    if adm is None:
+        return None
+    return (
+        adm.get("clusterQueue", ""),
+        tuple(
+            (
+                psa.get("name", ""),
+                tuple(sorted((psa.get("flavors") or {}).items())),
+                tuple(sorted(_norm_qty_map(psa.get("resourceUsage")).items())),
+                psa.get("count", 0),
+                (
+                    (
+                        tuple(psa["topologyAssignment"].get("levels", ())),
+                        tuple(
+                            (tuple(d.get("values", ())), d.get("count", 0))
+                            for d in psa["topologyAssignment"].get("domains", ())
+                        ),
+                    )
+                    if psa.get("topologyAssignment")
+                    else None
+                ),
+            )
+            for psa in adm.get("podSetAssignments", ())
+        ),
+    )
+
+
 def _validate_workload_update(errs: _Errs, obj: dict, old: dict) -> None:
-    """workload_webhook.go:269-310 ValidateWorkloadUpdate."""
+    """workload_webhook.go:269-310 ValidateWorkloadUpdate. Comparisons
+    are over semantic forms (defaults filled, quantities canonical),
+    not raw wire dicts."""
     if _has_quota_reservation(old):
-        if obj.get("podSets") != old.get("podSets"):
+        if _norm_podsets(obj.get("podSets")) != _norm_podsets(old.get("podSets")):
             errs.add("spec.podSets", "field is immutable with quota reserved")
     if old.get("admission") is not None:
-        if obj.get("queueName") != old.get("queueName"):
+        if (obj.get("queueName") or "") != (old.get("queueName") or ""):
             # workload_types.go queueName CEL: immutable while admitted
             errs.add(
                 "spec.queueName",
                 "field is immutable while admission is not null",
             )
-        if (
-            obj.get("admission") is not None
-            and obj.get("admission") != old.get("admission")
-        ):
+        if obj.get("admission") is not None and _norm_admission(
+            obj["admission"]
+        ) != _norm_admission(old["admission"]):
             # admission can be set or unset but not changed
             errs.add("status.admission", "field is immutable")
     if _has_quota_reservation(old) and _has_quota_reservation(obj):
@@ -397,10 +462,8 @@ def validate_cluster_queue(obj: dict, old: Optional[dict] = None) -> None:
             "spec.preemption",
             "reclaimWithinCohort=Never and borrowWithinCohort.Policy!=Never",
         )
-    if borrow.get("policy", "Never") == "LowerPriority" and borrow.get(
-        "maxPriorityThreshold"
-    ) is None:
-        pass  # threshold optional: unlimited below-priority borrow-preempt
+    # borrowWithinCohort.maxPriorityThreshold is optional even for
+    # LowerPriority (unlimited below-priority borrow-preempt)
     weight = obj.get("fairSharingWeight")
     if weight is not None and weight < 0:
         errs.add("spec.fairSharing.weight", "must not be negative")
@@ -423,7 +486,7 @@ def validate_cohort(obj: dict, old: Optional[dict] = None) -> None:
     errs = _Errs()
     _check_name(errs, "metadata.name", obj.get("name"))
     _check_name(errs, "spec.parent", obj.get("parent"), required=False)
-    if obj.get("parent") and obj["parent"] == obj["name"]:
+    if obj.get("parent") and obj["parent"] == obj.get("name"):
         errs.add("spec.parent", "cohort cannot be its own parent")
     if "resourceGroups" in obj:
         _validate_resource_groups(

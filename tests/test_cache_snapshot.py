@@ -253,3 +253,70 @@ def test_np_jax_kernel_parity():
     )
     np.testing.assert_array_equal(d_np, np.asarray(d_j))
     np.testing.assert_array_equal(dom_np, np.asarray(dom_j))
+
+
+def test_incremental_available_row_parity():
+    """available_row (path-walk over incrementally-maintained tree
+    usage) must match the full available_all_np reduction cell-for-cell
+    across random interleaved add/remove mutations."""
+    import numpy as np
+
+    from kueue_tpu.models import ClusterQueue, ResourceFlavor, LocalQueue
+    from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+    from kueue_tpu.models.cohort import Cohort
+    from kueue_tpu.core.cache import Cache
+    from kueue_tpu.core.snapshot import take_snapshot
+
+    rng = np.random.default_rng(7)
+    cache = Cache()
+    cache.add_or_update_flavor(ResourceFlavor(name="f"))
+    # depth-3 forest: root <- mid-a/mid-b <- cqs, with lending/borrowing
+    cache.add_or_update_cohort(Cohort(name="root"))
+    cache.add_or_update_cohort(Cohort(name="mid-a", parent="root"))
+    cache.add_or_update_cohort(Cohort(name="mid-b", parent="root"))
+    names = []
+    for i in range(8):
+        name = f"cq{i}"
+        names.append(name)
+        cache.add_or_update_cluster_queue(
+            ClusterQueue(
+                name=name,
+                cohort="mid-a" if i % 2 else "mid-b",
+                namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",),
+                        (
+                            FlavorQuotas.build(
+                                "f",
+                                {
+                                    "cpu": (
+                                        str(4 + i),
+                                        str(3),  # borrowingLimit
+                                        str(2),  # lendingLimit
+                                    )
+                                },
+                            ),
+                        ),
+                    ),
+                ),
+            )
+        )
+    snap = take_snapshot(cache)
+    # force the incremental structures alive before mutations
+    for name in names:
+        snap.available_row(snap.row(name))
+    for step in range(200):
+        name = names[int(rng.integers(0, len(names)))]
+        vec = np.zeros(len(snap.fr_list), dtype=np.int64)
+        vec[int(rng.integers(0, len(snap.fr_list)))] = int(rng.integers(1, 5000))
+        if rng.random() < 0.5:
+            snap.add_usage(name, vec)
+        else:
+            snap.remove_usage(name, vec)
+        full = snap.available()
+        for q in names:
+            r = snap.row(q)
+            np.testing.assert_array_equal(
+                snap.available_row(r), full[r], err_msg=f"step {step} row {q}"
+            )

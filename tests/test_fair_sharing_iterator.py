@@ -221,9 +221,9 @@ def test_path_drs_matches_full_tree_drs():
             if snap.fr_list:
                 vec[rng.randrange(len(snap.fr_list))] = rng.randint(0, 15000)
             chain = path_drs(snap, snap.usage(), pot, row, vec)
-            snap.local_usage[row] += vec
+            snap.add_usage(name, vec)
             full = snap.all_node_drs()
-            snap.local_usage[row] -= vec
+            snap.remove_usage(name, vec)
             for node, dws in chain:
                 assert dws == int(full[node]), (trial, name, node)
 

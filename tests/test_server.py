@@ -236,6 +236,97 @@ class TestSolverService:
         ]
 
 
+class TestReviewRegressions:
+    def test_repost_unadmitting_releases_quota(self, client):
+        """Re-POSTing an admitted workload with admission cleared must
+        free the previously charged quota (no leak)."""
+        _seed(client)
+        client.apply("workloads", _wl_dict("w1", cpu="10"))
+        state = client.state()
+        wl = next(w for w in state["workloads"] if w["name"] == "w1")
+        assert wl["admission"]["clusterQueue"] == "cq-a"
+        # unset admission + conditions: back to pending
+        wl = dict(wl)
+        wl["admission"] = None
+        wl["conditions"] = []
+        client.apply("workloads", wl)
+        # quota was released: the re-posted workload re-admits
+        wl2 = next(w for w in client.state()["workloads"] if w["name"] == "w1")
+        assert wl2["admission"]["clusterQueue"] == "cq-a"
+        dash = client.dashboard()
+        quota = dash["clusterQueues"][0]["quota"][0]
+        assert quota["used"] == 10000  # charged once, not twice
+
+    def test_repost_sparse_manifest_not_rejected(self, client):
+        """Semantically-identical sparse manifests must not trip the
+        immutability check against the fully-serialized stored copy."""
+        _seed(client)
+        sparse = {
+            "name": "w1",
+            "namespace": "ns",
+            "queueName": "lq-a",
+            "podSets": [{"name": "main", "count": 1, "requests": {"cpu": "2"}}],
+        }
+        client.apply("workloads", sparse)
+        wl = next(w for w in client.state()["workloads"] if w["name"] == "w1")
+        assert wl["admission"]  # admitted, quota reserved
+        client.apply("workloads", dict(sparse))  # re-POST unchanged: ok
+        changed = dict(sparse)
+        changed["podSets"] = [
+            {"name": "main", "count": 2, "requests": {"cpu": "2"}}
+        ]
+        with pytest.raises(ClientError) as exc:
+            client.apply("workloads", changed)
+        assert exc.value.status == 422
+        assert "immutable" in exc.value.message
+
+    def test_bad_query_param_is_400(self, client):
+        _seed(client)
+        with pytest.raises(ClientError) as exc:
+            client._request(
+                "GET",
+                "/apis/visibility/v1beta1/clusterqueues/cq-a/pendingworkloads?limit=abc",
+            )
+        assert exc.value.status == 400
+
+    def test_cohort_missing_name_is_422(self, client):
+        with pytest.raises(ClientError) as exc:
+            client.apply("cohorts", {"parent": "root"})
+        assert exc.value.status == 422
+
+    def test_until_idle_reports_preemptions(self):
+        from kueue_tpu.models.constants import PreemptionPolicy
+
+        state = TestSolverService()._state(0)
+        state["clusterQueues"][0]["preemption"]["withinClusterQueue"] = (
+            PreemptionPolicy.LOWER_PRIORITY.value
+        )
+        victim = _wl_dict("victim", cpu="8", priority=0)
+        victim["admission"] = {
+            "clusterQueue": "cq-a",
+            "podSetAssignments": [
+                {
+                    "name": "main",
+                    "flavors": {"cpu": "default"},
+                    "resourceUsage": {"cpu": 8000},
+                    "count": 1,
+                }
+            ],
+        }
+        victim["conditions"] = [
+            {
+                "type": "QuotaReserved",
+                "status": True,
+                "reason": "QuotaReserved",
+                "message": "",
+                "lastTransitionTime": 0.0,
+            }
+        ]
+        state["workloads"] = [victim, _wl_dict("attacker", cpu="8", priority=50)]
+        out = solve_assign({"state": state, "options": {"untilIdle": True}})
+        assert any(p["victim"] == "ns/victim" for p in out["preemptions"])
+
+
 class TestCliServerMode:
     def test_pending_workloads_via_server(self, server, client, capsys):
         from kueue_tpu.cli.__main__ import main
