@@ -171,6 +171,242 @@ def plan_drain(
         # the while_loop stops at quiescence; this is a backstop only —
         # bucketed because it is a static jit arg (compile reuse)
         max_cycles=_bucket(max_seg_events + 8, minimum=16),
+        # the COMPLETE fallback set (lowering fallbacks + multi-group
+        # heads excluded above) — outcome mapping must use this, not
+        # lowered.fallback, or the extra exclusions silently vanish
+        fallback=sorted(fallback),
+    )
+
+
+@dataclass
+class PreemptDrainOutcome(DrainOutcome):
+    # (victim workload, cq_name, cycle index of the eviction)
+    preempted: List[Tuple[Workload, str, int]] = field(default_factory=list)
+
+
+def _preempt_eligible_cq(cq) -> bool:
+    """Device preemption-drain scope: candidates must come from the
+    head's own ClusterQueue only, so cohort reclaim (and therefore
+    borrowWithinCohort) must be off (preemption.go:480-524 — cross-CQ
+    candidates exist only under reclaimWithinCohort)."""
+    from kueue_tpu.models.constants import (
+        BorrowWithinCohortPolicy,
+        ReclaimWithinCohortPolicy,
+    )
+
+    prem = cq.preemption
+    if cq.cohort is not None and (
+        prem.reclaim_within_cohort != ReclaimWithinCohortPolicy.NEVER
+    ):
+        return False
+    return prem.borrow_within_cohort.policy == BorrowWithinCohortPolicy.NEVER
+
+
+def run_drain_preempt(
+    snapshot: Snapshot,
+    pending: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    max_candidates: int = 8,
+    max_cells: int = 4,
+    max_victims: int = 32,
+    max_victim_cells: int = 4,
+    timestamp_fn=None,
+    max_cycles: Optional[int] = None,
+) -> PreemptDrainOutcome:
+    """Multi-cycle drain WITH classic within-CQ preemption, one device
+    dispatch + one fetch (ops/drain_kernel.solve_drain_preempt).
+
+    Heads of ClusterQueues outside the dense scope (cohort reclaim,
+    borrowWithinCohort, too many candidates/cells) are routed to
+    ``fallback`` for the sequential cycle loop. The caller applies the
+    reported evictions (set Evicted conditions, release cache usage) —
+    this function only decides.
+    """
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.models.constants import PreemptionPolicy
+    from kueue_tpu.ops.drain_kernel import (
+        DrainQueues,
+        VictimPanels,
+        solve_drain_preempt_packed_jit,
+    )
+
+    plan = plan_drain(
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
+    )
+
+    # per-CQ eligibility + victim panels
+    q = len(plan.cq_order) if plan.cq_order else 1
+    v_cap, cv = max_victims, max_victim_cells
+    vcells = np.full((q, max(v_cap, 1), cv), -1, dtype=np.int32)
+    vqty = np.zeros((q, max(v_cap, 1), cv), dtype=np.int64)
+    vprio = np.zeros((q, max(v_cap, 1)), dtype=np.int64)
+    vts = np.zeros((q, max(v_cap, 1)), dtype=np.int64)
+    vvalid = np.zeros((q, max(v_cap, 1)), dtype=bool)
+    can_preempt = np.zeros(q, dtype=bool)
+    same_prio_ok = np.zeros(q, dtype=bool)
+    # (qi, slot) -> WorkloadSnapshot, for mapping evictions back
+    victim_of: Dict[Tuple[int, int], object] = {}
+    drop_queues: List[int] = []
+
+    from kueue_tpu.models.constants import WorkloadConditionType
+
+    for qi, cq_name in enumerate(plan.cq_order):
+        cq = snapshot.cq_models[cq_name]
+        candidates = snapshot.workloads_in_cq(cq_name)
+        wcq = cq.preemption.within_cluster_queue
+        preempts = wcq != PreemptionPolicy.NEVER
+        if preempts and (
+            not _preempt_eligible_cq(cq)
+            or len(candidates) > v_cap
+            or any(
+                int(np.count_nonzero(ws.usage_vec)) > cv for ws in candidates
+            )
+        ):
+            drop_queues.append(qi)
+            continue
+        can_preempt[qi] = preempts
+        same_prio_ok[qi] = (
+            wcq == PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY
+        )
+        if not preempts:
+            continue
+        # candidate order: evicted first, lowest priority, newest
+        # quota reservation (preemption.go:591-618; in_cq uniform here)
+        candidates.sort(
+            key=lambda ws: (
+                0
+                if ws.workload.condition_true(WorkloadConditionType.EVICTED)
+                else 1,
+                ws.priority,
+                -ws.quota_reserved_time,
+                ws.workload.uid,
+            )
+        )
+        for slot, ws in enumerate(candidates):
+            js = np.flatnonzero(ws.usage_vec)
+            vcells[qi, slot, : len(js)] = js
+            vqty[qi, slot, : len(js)] = ws.usage_vec[js]
+            vprio[qi, slot] = ws.priority
+            ts = (
+                timestamp_fn(ws.workload)
+                if timestamp_fn
+                else ws.workload.creation_time
+            )
+            vts[qi, slot] = int(ts * 1e9)
+            vvalid[qi, slot] = True
+            victim_of[(qi, slot)] = ws
+
+    # drop ineligible queues to the fallback path
+    extra_fb_entries: List[Tuple[Workload, str]] = []
+    if drop_queues:
+        for qi in drop_queues:
+            plan.queues_np["qlen"][qi] = 0
+            plan.queues_np["cq_rows"][qi] = -1
+            plan.queues_np["seg_id"][qi] = -1
+            for pos in range(plan.queues_np["cells"].shape[1]):
+                i = plan.head_of.pop((qi, pos), None)
+                if i is not None:
+                    extra_fb_entries.append(
+                        (plan.lowered.heads[i], plan.lowered.cq_names[i])
+                    )
+
+    # cycle cap: between evictions the preemption-free per-segment
+    # progress bound applies (>=1 retire per cycle per live segment);
+    # each eviction cycle retires nothing but consumes a victim and can
+    # reactivate the segment's parked entries once
+    qlen = plan.queues_np["qlen"]
+    seg_id = plan.queues_np["seg_id"]
+    live = seg_id >= 0
+    if live.any():
+        nseg = int(seg_id[live].max()) + 1
+        seg_entries = np.bincount(
+            seg_id[live], weights=qlen[live].astype(np.float64), minlength=nseg
+        )
+        seg_victims = np.bincount(
+            seg_id[live],
+            weights=vvalid.sum(axis=1)[live].astype(np.float64),
+            minlength=nseg,
+        )
+        cap = int(((seg_victims + 1) * seg_entries + seg_victims).max()) + 8
+    else:
+        cap = 16
+    plan.max_cycles = _bucket(cap, minimum=16)
+    if max_cycles is not None:
+        plan.max_cycles = max_cycles
+
+    tree, paths, _ = tree_arrays(snapshot)
+    queues = DrainQueues(**{k: jnp.asarray(v) for k, v in plan.queues_np.items()})
+    victims = VictimPanels(
+        vcells=jnp.asarray(vcells),
+        vqty=jnp.asarray(vqty),
+        vprio=jnp.asarray(vprio),
+        vts=jnp.asarray(vts),
+        vvalid=jnp.asarray(vvalid),
+        can_preempt=jnp.asarray(can_preempt),
+        same_prio_ok=jnp.asarray(same_prio_ok),
+    )
+    flat = np.asarray(
+        solve_drain_preempt_packed_jit(
+            tree,
+            jnp.asarray(snapshot.local_usage),
+            queues,
+            victims,
+            paths,
+            n_segments=plan.n_segments,
+            n_steps=plan.n_steps,
+            max_cycles=plan.max_cycles,
+        )
+    )  # the single fetch
+    nq, nl = plan.queues_np["cells"].shape[:2]
+    nv = vcells.shape[1]
+    ql, qv = nq * nl, nq * nv
+    off = 0
+    status = flat[off : off + ql].reshape((nq, nl)); off += ql
+    adm_k = flat[off : off + ql].reshape((nq, nl)); off += ql
+    adm_cycle = flat[off : off + ql].reshape((nq, nl)); off += ql
+    evicted = flat[off : off + qv].reshape((nq, nv)).astype(bool); off += qv
+    evict_cycle = flat[off : off + qv].reshape((nq, nv)); off += qv
+    cycles = int(flat[-1])
+    truncated = bool(
+        np.any((status == 0) & (np.arange(nl)[None, :] < qlen[:, None]))
+    )
+
+    lowered = plan.lowered
+    admitted: List[Tuple[Workload, str, Dict[str, str], int]] = []
+    parked: List[Tuple[Workload, str]] = []
+    extra_fallback: List[Tuple[Workload, str]] = list(extra_fb_entries)
+    for (qi, pos), i in plan.head_of.items():
+        wl = lowered.heads[i]
+        cq_name = lowered.cq_names[i]
+        st = int(status[qi, pos])
+        kk = int(adm_k[qi, pos])
+        if st == 2 and kk >= 0:
+            admitted.append(
+                (wl, cq_name, lowered.candidate_flavors[i][kk], int(adm_cycle[qi, pos]))
+            )
+        elif st == 0:
+            # still pending at max_cycles: not a decision
+            extra_fallback.append((wl, cq_name))
+        else:
+            parked.append((wl, cq_name))
+    admitted.sort(key=lambda t: t[3])
+    preempted: List[Tuple[Workload, str, int]] = []
+    for (qi, slot), ws in victim_of.items():
+        if evicted[qi, slot]:
+            preempted.append(
+                (ws.workload, plan.cq_order[qi], int(evict_cycle[qi, slot]))
+            )
+    preempted.sort(key=lambda t: t[2])
+    fb = [
+        (lowered.heads[i], lowered.cq_names[i]) for i in plan.fallback
+    ] + extra_fallback
+    return PreemptDrainOutcome(
+        admitted=admitted,
+        parked=parked,
+        fallback=fb,
+        cycles=cycles,
+        truncated=truncated,
+        preempted=preempted,
     )
 
 
@@ -236,7 +472,7 @@ def run_drain(
             parked.append((wl, cq_name))
     admitted.sort(key=lambda t: t[3])
     fb = [
-        (lowered.heads[i], lowered.cq_names[i]) for i in sorted(set(lowered.fallback))
+        (lowered.heads[i], lowered.cq_names[i]) for i in plan.fallback
     ] + extra_fallback
     return DrainOutcome(
         admitted=admitted, parked=parked, fallback=fb, cycles=cycles,

@@ -44,6 +44,7 @@ from kueue_tpu._jax import jax, jnp, lax
 from kueue_tpu.ops.assign_kernel import (
     HeadsBatch,
     _avail_along_path,
+    _gather_cells,
     phase1_classify,
     segmented_rank,
 )
@@ -296,6 +297,506 @@ def solve_drain(
 
 solve_drain_jit = jax.jit(
     solve_drain, static_argnames=("n_segments", "n_steps", "max_cycles")
+)
+
+
+class VictimPanels(NamedTuple):
+    """Per-ClusterQueue admitted-workload (candidate) panels for the
+    preemption-enabled drain. V victim slots, Cv cells per victim.
+
+    vcells: int32[Q,V,Cv] — GLOBAL flavor-resource cell ids of the
+            victim's admitted usage (-1 pads).
+    vqty:   int64[Q,V,Cv] — usage quantity per cell.
+    vprio:  int64[Q,V] / vts: int64[Q,V] — priority and queue-order
+            timestamp (the LowerOrNewerEqualPriority rule compares the
+            preemptor's timestamp against the candidate's).
+    vvalid: bool[Q,V].
+    can_preempt:  bool[Q] — withinClusterQueue != Never.
+    same_prio_ok: bool[Q] — policy == LowerOrNewerEqualPriority.
+
+    Victim slots arrive pre-sorted in the host's candidate order
+    (preemption.go:591-618: evicted first, lowest priority, newest) —
+    remove-until-fit scans them in slot order.
+    """
+
+    vcells: jnp.ndarray
+    vqty: jnp.ndarray
+    vprio: jnp.ndarray
+    vts: jnp.ndarray
+    vvalid: jnp.ndarray
+    can_preempt: jnp.ndarray
+    same_prio_ok: jnp.ndarray
+
+
+class PreemptDrainResult(NamedTuple):
+    """status: int32[Q,L] final entry state (0 pending=never decided
+    before max_cycles, 1 parked, 2 admitted); admitted_k / admitted_cycle
+    as DrainResult; evicted: bool[Q,V] victim was preempted;
+    evicted_cycle: int32[Q,V]; cycles; local_usage."""
+
+    status: jnp.ndarray
+    admitted_k: jnp.ndarray
+    admitted_cycle: jnp.ndarray
+    evicted: jnp.ndarray
+    evicted_cycle: jnp.ndarray
+    cycles: jnp.ndarray
+    local_usage: jnp.ndarray
+
+
+def _victim_search_one(
+    hpath: jnp.ndarray,  # int32[D+1] head ancestor path
+    cells: jnp.ndarray,  # int32[C] head candidate cells
+    qty: jnp.ndarray,  # int64[C]
+    cell_need: jnp.ndarray,  # bool[C]
+    vq_at: jnp.ndarray,  # int64[V,C] victim usage gathered at head cells
+    eligible: jnp.ndarray,  # bool[V]
+    active: jnp.ndarray,  # bool scalar
+    usage0: jnp.ndarray,  # int64[N,FR] cycle-start usage tree
+    subtree: jnp.ndarray,
+    guaranteed: jnp.ndarray,
+    borrowing_limit: jnp.ndarray,
+    max_depth: int,
+):
+    """minimalPreemptions for one head over same-CQ candidates
+    (preemption.go:275-342), evaluated along the head's ancestor path
+    only — every candidate shares the head's CQ, so removal deltas
+    propagate along exactly this path, and only the head's candidate
+    cells constrain the fit. Single ladder attempt with borrowing
+    allowed (all candidates in-CQ — preemption.go:127-191).
+
+    Returns (targets bool[V], success bool)."""
+    n_cand = vq_at.shape[0]
+    g_path = _gather_cells(guaranteed, hpath, cells)  # [D+1, C]
+    sub_path = _gather_cells(subtree, hpath, cells)
+    bl_path = _gather_cells(borrowing_limit, hpath, cells)
+    u0_path = _gather_cells(usage0, hpath, cells)
+    valid_d = hpath >= 0  # [D+1]
+    root_pos = jnp.sum(valid_d.astype(jnp.int32)) - 1
+
+    def avail_of(u_path):
+        avail = jnp.zeros_like(qty)
+        for d in range(max_depth, -1, -1):
+            is_root = d == root_pos
+            root_avail = sub_path[d] - u_path[d]
+            stored = sub_path[d] - g_path[d]
+            used = jnp.maximum(0, u_path[d] - g_path[d])
+            with_max = stored - used + bl_path[d]
+            clamped = jnp.where(
+                bl_path[d] < NO_LIMIT, jnp.minimum(with_max, avail), avail
+            )
+            nonroot = jnp.maximum(0, g_path[d] - u_path[d]) + clamped
+            avail = jnp.where(valid_d[d], jnp.where(is_root, root_avail, nonroot), avail)
+        return avail
+
+    def bubble(u_path, delta, apply):
+        d_c = jnp.where(apply, delta, 0)
+        for d in range(0, max_depth + 1):
+            old = u_path[d]
+            new = old + d_c
+            u_path = u_path.at[d].set(jnp.where(valid_d[d], new, old))
+            over_old = jnp.maximum(0, old - g_path[d])
+            over_new = jnp.maximum(0, new - g_path[d])
+            d_c = jnp.where(valid_d[d], over_new - over_old, d_c)
+        return u_path
+
+    def fits(u_path):
+        return jnp.all(jnp.where(cell_need, avail_of(u_path) >= qty, True))
+
+    def rm_body(carry, v):
+        u_path, done, fit_at, removed = carry
+        act = eligible[v] & ~done & active
+        u_path = bubble(u_path, -vq_at[v], act)
+        removed = removed.at[v].set(act)
+        now_fits = act & fits(u_path)
+        fit_at = jnp.where(now_fits & ~done, v, fit_at)
+        done = done | now_fits
+        return (u_path, done, fit_at, removed), None
+
+    init = (u0_path, ~active, jnp.int32(-1), jnp.zeros(n_cand, dtype=bool))
+    (u_path, done, fit_at, removed), _ = lax.scan(
+        rm_body, init, jnp.arange(n_cand, dtype=jnp.int32)
+    )
+    found = done & active
+
+    def fb_body(carry, v):
+        u_path, removed = carry
+        act = found & removed[v] & (v != fit_at)
+        u2 = bubble(u_path, vq_at[v], act)
+        keep = act & fits(u2)
+        u_path = jnp.where(keep, u2, u_path)
+        removed = removed.at[v].set(removed[v] & ~keep)
+        return (u_path, removed), None
+
+    (u_path, removed), _ = lax.scan(
+        fb_body, (u_path, removed), jnp.arange(n_cand - 1, -1, -1, dtype=jnp.int32)
+    )
+    return removed & found, found
+
+
+def solve_drain_preempt(
+    tree: QuotaTree,
+    local_usage: jnp.ndarray,  # int64[N, FR]
+    queues: DrainQueues,
+    victims: VictimPanels,
+    paths: jnp.ndarray,  # int32[N, D+1]
+    n_segments: int,
+    n_steps: int,
+    max_cycles: int,
+) -> PreemptDrainResult:
+    """Multi-cycle drain with classic within-ClusterQueue preemption on
+    the device. Per cycle:
+
+    - phase 1: flavor classification (Fit / Preempt / NoFit) against
+      cycle-start usage, plus a batched minimalPreemptions victim
+      search for preempt-classified heads;
+    - phase 2: segmented scan in entry order; preempting entries remove
+      their victims, re-check fits (scheduler.go:211-292), and charge
+      their usage for the remainder of the cycle;
+    - cycle end: admitted heads leave and charge leaf usage; successful
+      preempters' victims are EVICTED (leaf usage released — the
+      reconciler's stopJob/delete round-trip, compressed to the cycle
+      boundary) and the preempting head retries next cycle with its
+      flavor walk reset (the host clears LastAssignment on preemption
+      issue); blocked heads PARK, and any eviction in a root cohort
+      reactivates that cohort's parked entries
+      (queue.Manager.QueueAssociatedInadmissibleWorkloadsAfter).
+
+    Entry state is per-(queue, position): pending(0)/parked(1)/
+    admitted(2); each queue's head is its first pending entry in heap
+    order. Scope (host lowering enforces): single-podset single-RG
+    default-fungibility heads, candidates within the head's own
+    ClusterQueue only (reclaimWithinCohort == Never or no cohort), no
+    fair sharing.
+    """
+    max_depth = tree.max_depth
+    subtree, guaranteed = subtree_quota(tree)
+
+    q, l, k, c = queues.cells.shape
+    v = victims.vqty.shape[1]
+    q_idx = jnp.arange(q)
+    l_idx = jnp.arange(l)
+
+    avail_v = jax.vmap(
+        _avail_along_path, in_axes=(0, 0, None, None, None, None, None)
+    )
+    search_v = jax.vmap(
+        _victim_search_one,
+        in_axes=(0, 0, 0, 0, 0, 0, 0, None, None, None, None, None),
+    )
+
+    def cycle_body(state):
+        (local, status, k_start, adm_k, adm_cycle,
+         vevicted, evict_cycle, cycle) = state
+
+        # head of each queue = first pending entry in heap order
+        pend = status == 0  # [Q,L]
+        pos_cand = jnp.where(pend, l_idx[None, :], l)
+        cur_raw = jnp.min(pos_cand, axis=1)  # [Q]
+        active = (cur_raw < l) & (cur_raw < queues.qlen)
+        cur = jnp.minimum(cur_raw, l - 1)
+
+        k_mask = jnp.arange(k)[None, :] >= k_start[:, None]
+        heads = HeadsBatch(
+            cq_row=jnp.where(active, queues.cq_rows, -1).astype(jnp.int32),
+            cells=queues.cells[q_idx, cur],
+            qty=queues.qty[q_idx, cur],
+            valid=queues.valid[q_idx, cur] & active[:, None] & k_mask,
+            priority=queues.priority[q_idx, cur],
+            timestamp=queues.timestamp[q_idx, cur],
+            no_reclaim=queues.no_reclaim,
+        )
+
+        chosen, borrows_wk, preempt_k = phase1_classify(
+            tree, subtree, guaranteed, local, heads
+        )
+        eff_k = jnp.where(chosen >= 0, chosen, preempt_k)
+        eff_safe = jnp.maximum(eff_k, 0)
+        head_borrow = jnp.take_along_axis(
+            borrows_wk, eff_safe[:, None], axis=1
+        )[:, 0] & (eff_k >= 0)
+        nofit = eff_k < 0
+
+        cells_eff = jnp.take_along_axis(
+            heads.cells, eff_safe[:, None, None], axis=1
+        )[:, 0]  # [Q, C]
+        qty_eff = jnp.take_along_axis(heads.qty, eff_safe[:, None, None], axis=1)[:, 0]
+        cell_need = (cells_eff >= 0) & (qty_eff > 0)
+        cq = jnp.maximum(heads.cq_row, 0)
+
+        usage0 = usage_tree(tree, guaranteed, local)
+
+        # ---- batched victim search for preempt-classified heads ----
+        # victim usage gathered at the head's candidate cells: the fit
+        # check reads only those cells, and same-CQ candidates bubble
+        # along exactly the head's path (cell dynamics independent)
+        match = victims.vcells[:, :, :, None] == jnp.maximum(cells_eff, 0)[:, None, None, :]
+        match = match & (victims.vcells >= 0)[:, :, :, None]
+        vq_at = jnp.sum(
+            jnp.where(match, victims.vqty[:, :, :, None], 0), axis=2
+        )  # [Q, V, C]
+        is_pre_head = active & (chosen < 0) & (preempt_k >= 0) & victims.can_preempt
+        live_victim = victims.vvalid & ~vevicted
+        # candidate filters (preemption.go:480-524): priority rule +
+        # uses-a-needed-flavor-resource
+        lower = victims.vprio < heads.priority[:, None]
+        newer_eq = (
+            victims.same_prio_ok[:, None]
+            & (victims.vprio == heads.priority[:, None])
+            & (heads.timestamp[:, None] < victims.vts)
+        )
+        uses = jnp.any(vq_at * cell_need[:, None, :].astype(jnp.int64) > 0, axis=2)
+        eligible = live_victim & (lower | newer_eq) & uses
+
+        targets, psuccess = search_v(
+            paths[cq], cells_eff, qty_eff, cell_need, vq_at, eligible,
+            is_pre_head, usage0, subtree, guaranteed, tree.borrowing_limit,
+            max_depth,
+        )  # [Q,V], [Q]
+        psuccess = psuccess & is_pre_head
+        # victims' summed usage at head cells — the phase-2 removal delta
+        vminus = jnp.sum(
+            jnp.where(targets[:, :, None], vq_at, 0), axis=1
+        )  # [Q, C]
+
+        # ---- entry order: preempt-classified heads participate like
+        # the host admit loop (successful searches charge usage +
+        # evict; failed ones reserve) ----
+        order = jnp.lexsort(
+            (
+                heads.timestamp,
+                -heads.priority,
+                head_borrow.astype(jnp.int64),
+                nofit.astype(jnp.int64),
+            )
+        )
+        seg = jnp.maximum(queues.seg_id, 0)[order]
+        valid_sorted = active[order] & (queues.seg_id[order] >= 0) & (~nofit[order])
+        rank = segmented_rank(seg, valid_sorted)
+        rank_scatter = jnp.where(valid_sorted, rank, n_steps)
+        mat = (
+            jnp.full((n_steps, n_segments), -1, dtype=jnp.int32)
+            .at[rank_scatter, seg]
+            .set(order.astype(jnp.int32), mode="drop")
+        )
+
+        def step(usage, s):
+            idx = mat[s]  # [G]
+            act = idx >= 0
+            hidx = jnp.maximum(idx, 0)
+            cqs = cq[hidx]
+            path = paths[cqs]
+            cells_ = cells_eff[hidx]
+            qty_ = qty_eff[hidx]
+            ccells = jnp.maximum(cells_, 0)
+            cell_valid = cell_need[hidx] & act[:, None]
+            pre_ = psuccess[hidx] & act
+
+            # preempting entries: remove victims first (simulate the
+            # issue; the admit-loop removes targets before fits —
+            # scheduler.go:380-388)
+            delta_pre = jnp.where(
+                cell_valid & pre_[:, None], -vminus[hidx], 0
+            )
+            for d in range(0, max_depth + 1):
+                node = jnp.maximum(path[:, d], 0)
+                node_valid = (path[:, d] >= 0)[:, None]
+                g = guaranteed[node[:, None], ccells]
+                old = usage[node[:, None], ccells]
+                new = old + delta_pre
+                usage = usage.at[node[:, None], ccells].add(
+                    jnp.where(node_valid, delta_pre, 0)
+                )
+                delta_pre = jnp.where(
+                    node_valid,
+                    jnp.maximum(0, new - g) - jnp.maximum(0, old - g),
+                    delta_pre,
+                )
+
+            avail = avail_v(
+                path, cells_, usage, subtree, guaranteed,
+                tree.borrowing_limit, max_depth,
+            )
+            fits = jnp.all(jnp.where(cell_valid, avail >= qty_, True), axis=1)
+            admit = act & (chosen[hidx] >= 0) & fits
+            pre_ok = pre_ & fits
+            reserve = (
+                act
+                & (chosen[hidx] < 0)
+                & (preempt_k[hidx] >= 0)
+                & ~psuccess[hidx]
+                & heads.no_reclaim[hidx]
+            )
+            nominal_c = tree.nominal[cqs[:, None], ccells]
+            bl_c = tree.borrowing_limit[cqs[:, None], ccells]
+            leaf_usage_c = usage[cqs[:, None], ccells]
+            borrow_cap = jnp.where(
+                bl_c < NO_LIMIT,
+                jnp.minimum(qty_, nominal_c + bl_c - leaf_usage_c),
+                qty_,
+            )
+            nominal_cap = jnp.maximum(
+                0, jnp.minimum(qty_, nominal_c - leaf_usage_c)
+            )
+            reserve_qty = jnp.where(
+                head_borrow[hidx][:, None], borrow_cap, nominal_cap
+            )
+            # post delta: charge admitted + successful preempters
+            # (AddUsage runs for both — scheduler.go:211-292), reserve
+            # blocked no-reclaim heads, REVERT failed preempters
+            delta = jnp.where(
+                cell_valid & (admit | pre_ok)[:, None],
+                qty_,
+                jnp.where(
+                    cell_valid & reserve[:, None],
+                    reserve_qty,
+                    jnp.where(cell_valid & (pre_ & ~fits)[:, None], vminus[hidx], 0),
+                ),
+            )
+            for d in range(0, max_depth + 1):
+                node = jnp.maximum(path[:, d], 0)
+                node_valid = (path[:, d] >= 0)[:, None]
+                g = guaranteed[node[:, None], ccells]
+                old = usage[node[:, None], ccells]
+                new = old + delta
+                usage = usage.at[node[:, None], ccells].add(
+                    jnp.where(node_valid, delta, 0)
+                )
+                delta = jnp.where(
+                    node_valid,
+                    jnp.maximum(0, new - g) - jnp.maximum(0, old - g),
+                    delta,
+                )
+            return usage, (admit, pre_ok)
+
+        _, (admit_sn, pre_ok_sn) = lax.scan(step, usage0, jnp.arange(n_steps))
+
+        flat_idx = mat.reshape(-1)
+        safe_idx = jnp.where(flat_idx >= 0, flat_idx, q)
+        admitted = (
+            jnp.zeros(q, dtype=bool).at[safe_idx].set(admit_sn.reshape(-1), mode="drop")
+        )
+        preempt_ok = (
+            jnp.zeros(q, dtype=bool).at[safe_idx].set(pre_ok_sn.reshape(-1), mode="drop")
+        )
+
+        # ---- cycle end: leaf usage ----
+        add = jnp.where(cell_need & admitted[:, None], qty_eff, 0)
+        local = local.at[cq[:, None], jnp.maximum(cells_eff, 0)].add(add)
+        # evict the successful preempters' victims: release their FULL
+        # admitted usage (all cells) from their CQ's leaf row
+        newly_evicted = targets & preempt_ok[:, None]  # [Q,V]
+        ev_qty = jnp.where(
+            newly_evicted[:, :, None] & (victims.vcells >= 0), victims.vqty, 0
+        )  # [Q,V,Cv]
+        rows_b = jnp.broadcast_to(
+            cq[:, None, None], victims.vcells.shape
+        )
+        local = local.at[
+            rows_b.reshape(-1), jnp.maximum(victims.vcells, 0).reshape(-1)
+        ].add(-ev_qty.reshape(-1))
+        vevicted = vevicted | newly_evicted
+        evict_cycle = jnp.where(newly_evicted, cycle, evict_cycle)
+
+        # ---- queue motion ----
+        adm_k = adm_k.at[q_idx, cur].set(
+            jnp.where(admitted & active, chosen, adm_k[q_idx, cur])
+        )
+        adm_cycle = adm_cycle.at[q_idx, cur].set(
+            jnp.where(admitted & active, cycle, adm_cycle[q_idx, cur])
+        )
+        # park only NOT_NOMINATED outcomes (NoFit, or preempt search
+        # found no victim set — the reserve branch). Heads SKIPPED in
+        # the admit loop — a successful search losing the in-cycle
+        # fits() re-check — requeue immediately (FAILED_AFTER_NOMINATION,
+        # scheduler._requeue_and_update) and stay pending.
+        pre_skipped = psuccess & ~preempt_ok
+        new_entry_status = jnp.where(
+            admitted,
+            2,
+            jnp.where(
+                active & (chosen < 0) & ~preempt_ok & ~pre_skipped, 1, 0
+            ),
+        )  # per-queue head status
+        status = status.at[q_idx, cur].set(
+            jnp.where(active, new_entry_status, status[q_idx, cur])
+        )
+        # reactivate parked entries in root cohorts where usage released
+        released_seg = (
+            jnp.zeros(n_segments + 1, dtype=bool)
+            .at[jnp.where(queues.seg_id >= 0, queues.seg_id, n_segments)]
+            .max(jnp.any(newly_evicted, axis=1))
+        )
+        seg_released = released_seg[jnp.maximum(queues.seg_id, 0)] & (
+            queues.seg_id >= 0
+        )
+        status = jnp.where(
+            seg_released[:, None] & (status == 1), 0, status
+        )
+
+        chosen_safe = jnp.maximum(chosen, 0)
+        chose_last = queues.reset[q_idx, cur, chosen_safe]
+        lost = active & (chosen >= 0) & (~admitted)
+        k_start = jnp.where(
+            admitted | (active & (chosen < 0)) | preempt_ok,
+            0,
+            jnp.where(lost, jnp.where(chose_last, 0, chosen_safe + 1), k_start),
+        ).astype(jnp.int32)
+        return (
+            local, status, k_start, adm_k, adm_cycle,
+            vevicted, evict_cycle, cycle + 1,
+        )
+
+    def cond(state):
+        _, status, _, _, _, _, _, cycle = state
+        has_pending = jnp.any((status == 0) & (l_idx[None, :] < queues.qlen[:, None]))
+        return has_pending & (cycle < max_cycles)
+
+    init = (
+        local_usage,
+        jnp.zeros((q, l), dtype=jnp.int32),
+        jnp.zeros(q, dtype=jnp.int32),
+        jnp.full((q, l), -1, dtype=jnp.int32),
+        jnp.full((q, l), -1, dtype=jnp.int32),
+        jnp.zeros((q, v), dtype=bool),
+        jnp.full((q, v), -1, dtype=jnp.int32),
+        jnp.int32(0),
+    )
+    (local_f, status_f, _, adm_k, adm_cycle, vevicted, evict_cycle, cycles) = (
+        lax.while_loop(cond, cycle_body, init)
+    )
+    return PreemptDrainResult(
+        status=status_f,
+        admitted_k=adm_k,
+        admitted_cycle=adm_cycle,
+        evicted=vevicted,
+        evicted_cycle=evict_cycle,
+        cycles=cycles,
+        local_usage=local_f,
+    )
+
+
+def _solve_drain_preempt_packed(
+    tree, local_usage, queues, victims, paths,
+    n_segments: int, n_steps: int, max_cycles: int,
+):
+    r = solve_drain_preempt(
+        tree, local_usage, queues, victims, paths, n_segments, n_steps, max_cycles
+    )
+    return jnp.concatenate(
+        [
+            r.status.reshape(-1),
+            r.admitted_k.reshape(-1),
+            r.admitted_cycle.reshape(-1),
+            r.evicted.astype(jnp.int32).reshape(-1),
+            r.evicted_cycle.reshape(-1),
+            r.cycles[None],
+        ]
+    )
+
+
+solve_drain_preempt_packed_jit = jax.jit(
+    _solve_drain_preempt_packed,
+    static_argnames=("n_segments", "n_steps", "max_cycles"),
 )
 
 

@@ -57,20 +57,65 @@ class ApiError(Exception):
         self.message = message
 
 
-# section name (URL, lowercase plural) -> (state key, from_dict, runtime add)
-_SECTIONS: Dict[str, Tuple[str, Callable, str]] = {
-    "resourceflavors": ("resourceFlavors", ser.flavor_from_dict, "add_flavor"),
-    "clusterqueues": ("clusterQueues", ser.cq_from_dict, "add_cluster_queue"),
-    "localqueues": ("localQueues", ser.lq_from_dict, "add_local_queue"),
-    "workloads": ("workloads", ser.workload_from_dict, "add_workload"),
-    "cohorts": ("cohorts", ser.cohort_from_dict, "add_cohort"),
-    "admissionchecks": ("admissionChecks", ser.check_from_dict, "add_admission_check"),
-    "topologies": ("topologies", ser.topology_from_dict, "add_topology"),
-    "workloadpriorityclasses": (
-        "workloadPriorityClasses",
-        ser.priority_class_from_dict,
-        "add_priority_class",
+class _Section:
+    """One object kind's wiring: wire<->model codecs, the runtime add
+    method, and a direct store lookup (keyed by (namespace, name))."""
+
+    def __init__(self, from_dict, to_dict, add_name, store):
+        self.from_dict = from_dict
+        self.to_dict = to_dict
+        self.add_name = add_name
+        self.store = store  # (runtime, namespace, name) -> model | None
+
+
+_SECTIONS: Dict[str, _Section] = {
+    "resourceflavors": _Section(
+        ser.flavor_from_dict, ser.flavor_to_dict, "add_flavor",
+        lambda rt, ns, n: rt.cache.flavors.get(n),
     ),
+    "clusterqueues": _Section(
+        ser.cq_from_dict,
+        lambda m: ser.cq_to_dict(m.model if hasattr(m, "model") else m),
+        "add_cluster_queue",
+        lambda rt, ns, n: rt.cache.cluster_queues.get(n),
+    ),
+    "localqueues": _Section(
+        ser.lq_from_dict, ser.lq_to_dict, "add_local_queue",
+        lambda rt, ns, n: rt.cache.local_queues.get(f"{ns}/{n}"),
+    ),
+    "workloads": _Section(
+        ser.workload_from_dict, ser.workload_to_dict, "add_workload",
+        lambda rt, ns, n: rt.workloads.get(f"{ns}/{n}"),
+    ),
+    "cohorts": _Section(
+        ser.cohort_from_dict, ser.cohort_to_dict, "add_cohort",
+        lambda rt, ns, n: rt.cache.cohorts.get(n),
+    ),
+    "admissionchecks": _Section(
+        ser.check_from_dict, ser.check_to_dict, "add_admission_check",
+        lambda rt, ns, n: rt.cache.admission_checks.get(n),
+    ),
+    "topologies": _Section(
+        ser.topology_from_dict, ser.topology_to_dict, "add_topology",
+        lambda rt, ns, n: rt.cache.topologies.get(n),
+    ),
+    "workloadpriorityclasses": _Section(
+        ser.priority_class_from_dict, ser.priority_class_to_dict,
+        "add_priority_class",
+        lambda rt, ns, n: rt.cache.priority_classes.get(n),
+    ),
+}
+
+# lister: every live model of a section, sorted by store key
+_LISTERS: Dict[str, Callable] = {
+    "resourceflavors": lambda rt: rt.cache.flavors,
+    "clusterqueues": lambda rt: rt.cache.cluster_queues,
+    "localqueues": lambda rt: rt.cache.local_queues,
+    "workloads": lambda rt: rt.workloads,
+    "cohorts": lambda rt: rt.cache.cohorts,
+    "admissionchecks": lambda rt: rt.cache.admission_checks,
+    "topologies": lambda rt: rt.cache.topologies,
+    "workloadpriorityclasses": lambda rt: rt.cache.priority_classes,
 }
 
 
@@ -99,13 +144,28 @@ def solve_assign(request: dict) -> dict:
     decisions: List[dict] = []
     preemptions: List[dict] = []
     if until_idle:
-        cycles = rt.run_until_idle()
-        # preemptions executed during the drain surface as events
-        preemptions = [
-            {"victim": e.object_key, "reason": e.message}
-            for e in rt.events
-            if e.kind == "Preempted"
-        ]
+        # collect per-cycle preemption targets so the response shape
+        # matches the single-cycle branch ({victim, by, reason})
+        orig = rt.scheduler.schedule
+
+        def spy_schedule():
+            result = orig()
+            for entry in result.preempting:
+                for tgt in entry.preemption_targets:
+                    preemptions.append(
+                        {
+                            "victim": tgt.workload.workload.key,
+                            "by": entry.workload.key,
+                            "reason": tgt.reason,
+                        }
+                    )
+            return result
+
+        rt.scheduler.schedule = spy_schedule
+        try:
+            cycles = rt.run_until_idle()
+        finally:
+            rt.scheduler.schedule = orig
     else:
         result = rt.schedule_once()
         cycles = 1
@@ -178,40 +238,19 @@ class KueueServer:
         """Wire dict of the stored object with the same identity, via a
         direct store lookup (no full-state serialization on the ingest
         path)."""
-        rt = self.runtime
-        name = obj.get("name", "")
-        namespace = obj.get("namespace", "")
-        if section == "workloads":
-            wl = rt.workloads.get(f"{namespace}/{name}")
-            return ser.workload_to_dict(wl) if wl is not None else None
-        if section == "clusterqueues":
-            cached = rt.cache.cluster_queues.get(name)
-            return ser.cq_to_dict(cached.model) if cached is not None else None
-        if section == "localqueues":
-            lq = rt.cache.local_queues.get(f"{namespace}/{name}")
-            return ser.lq_to_dict(lq) if lq is not None else None
-        if section == "resourceflavors":
-            f = rt.cache.flavors.get(name)
-            return ser.flavor_to_dict(f) if f is not None else None
-        if section == "cohorts":
-            c = rt.cache.cohorts.get(name)
-            return ser.cohort_to_dict(c) if c is not None else None
-        if section == "admissionchecks":
-            ac = rt.cache.admission_checks.get(name)
-            return ser.check_to_dict(ac) if ac is not None else None
-        if section == "topologies":
-            t = rt.cache.topologies.get(name)
-            return ser.topology_to_dict(t) if t is not None else None
-        if section == "workloadpriorityclasses":
-            pc = rt.cache.priority_classes.get(name)
-            return ser.priority_class_to_dict(pc) if pc is not None else None
-        return None
+        sec = _SECTIONS.get(section)
+        if sec is None:
+            return None
+        model = sec.store(
+            self.runtime, obj.get("namespace", ""), obj.get("name", "")
+        )
+        return sec.to_dict(model) if model is not None else None
 
     def apply(self, section: str, obj: dict) -> dict:
         """Upsert one object through the webhook admission chain."""
-        if section not in _SECTIONS:
+        sec = _SECTIONS.get(section)
+        if sec is None:
             raise ApiError(404, f"unknown section {section!r}")
-        state_key, from_dict, add_name = _SECTIONS[section]
         from kueue_tpu.webhooks import ValidationError
 
         with self.lock:
@@ -221,8 +260,8 @@ class KueueServer:
                     obj = admit(section, obj, old, self.runtime)
             except ValidationError as e:
                 raise ApiError(422, str(e))
-            model = from_dict(obj)
-            getattr(self.runtime, add_name)(model)
+            model = sec.from_dict(obj)
+            getattr(self.runtime, sec.add_name)(model)
             if self.auto_reconcile:
                 self.runtime.run_until_idle()
         return obj
@@ -266,49 +305,14 @@ class KueueServer:
                 self.runtime.run_until_idle()
 
     def list_section(self, section: str) -> dict:
-        if section not in _SECTIONS:
+        sec = _SECTIONS.get(section)
+        if sec is None:
             raise ApiError(404, f"unknown section {section!r}")
-        rt = self.runtime
+        store = _LISTERS[section]
         with self.lock:
-            if section == "workloads":
-                items = [
-                    ser.workload_to_dict(w) for _, w in sorted(rt.workloads.items())
-                ]
-            elif section == "clusterqueues":
-                items = [
-                    ser.cq_to_dict(c.model)
-                    for _, c in sorted(rt.cache.cluster_queues.items())
-                ]
-            elif section == "localqueues":
-                items = [
-                    ser.lq_to_dict(l)
-                    for _, l in sorted(rt.cache.local_queues.items())
-                ]
-            elif section == "resourceflavors":
-                items = [
-                    ser.flavor_to_dict(f)
-                    for _, f in sorted(rt.cache.flavors.items())
-                ]
-            elif section == "cohorts":
-                items = [
-                    ser.cohort_to_dict(c)
-                    for _, c in sorted(rt.cache.cohorts.items())
-                ]
-            elif section == "admissionchecks":
-                items = [
-                    ser.check_to_dict(a)
-                    for _, a in sorted(rt.cache.admission_checks.items())
-                ]
-            elif section == "topologies":
-                items = [
-                    ser.topology_to_dict(t)
-                    for _, t in sorted(rt.cache.topologies.items())
-                ]
-            else:  # workloadpriorityclasses
-                items = [
-                    ser.priority_class_to_dict(p)
-                    for _, p in sorted(rt.cache.priority_classes.items())
-                ]
+            items = [
+                sec.to_dict(m) for _, m in sorted(store(self.runtime).items())
+            ]
         return {"items": items}
 
     # ---- http plumbing ----

@@ -258,3 +258,322 @@ class TestDrainTruncation:
             decided | {wl.name for wl, _ in cut.fallback}
             == {wl.name for wl, _ in pending}
         )
+
+
+# ---------------------------------------------------------------- preemption
+def _admit_victim(cache, mgr_clock_t, name, cq_name, flavor, cpu, prio, uid_t):
+    from kueue_tpu.core.workload_info import make_admission
+    from kueue_tpu.models import Workload, WorkloadConditionType
+    from kueue_tpu.models.workload import PodSet
+
+    wl = Workload(
+        namespace="ns", name=name, queue_name=f"lq-{cq_name}", priority=prio,
+        creation_time=uid_t,
+        pod_sets=(PodSet.build("main", 1, {"cpu": cpu}),),
+    )
+    wl.admission = make_admission(cq_name, {"main": {"cpu": flavor}}, wl)
+    wl.set_condition(
+        WorkloadConditionType.QUOTA_RESERVED, True, reason="QuotaReserved",
+        now=uid_t,
+    )
+    cache.add_or_update_workload(wl)
+    return wl
+
+
+def build_preempt_env(spec):
+    """build_env + pre-admitted victims from spec['victims']:
+    (name, cq, flavor, cpu, prio, t) tuples."""
+    sched, mgr, cache, workloads = build_env(spec, use_solver=False)
+    for name, cq_name, flavor, cpu, prio, t in spec.get("victims", []):
+        _admit_victim(cache, None, name, cq_name, flavor, cpu, prio, t)
+    return sched, mgr, cache, workloads
+
+
+def host_preempt_drain_trace(spec):
+    """Host truth: scheduler cycles with evictions applied between
+    cycles (the reconciler's stop/delete round-trip compressed to the
+    cycle boundary), to quiescence."""
+    sched, mgr, cache, _ = build_preempt_env(spec)
+    admitted, evicted = {}, set()
+    for _ in range(300):
+        progressed = False
+        if any(pq.pending_active() > 0 for pq in mgr.cluster_queues.values()):
+            progressed = True  # active heads: the cycle itself is progress
+        res = sched.schedule()
+        for e in res.admitted:
+            psa = e.workload.admission.pod_set_assignments[0]
+            admitted[e.workload.name] = dict(psa.flavors)
+        victims = []
+        for e in res.preempting:
+            for t in e.preemption_targets:
+                victims.append(t.workload.workload)
+        for wl in victims:
+            if wl.name in evicted:
+                continue
+            evicted.add(wl.name)
+            cq_name = wl.admission.cluster_queue
+            cache.delete_workload(wl)
+            mgr.queue_associated_inadmissible_workloads_after(cq_name)
+            progressed = True
+        if not progressed:
+            break
+    parked = {
+        wl.name
+        for pq in mgr.cluster_queues.values()
+        for wl in list(pq.inadmissible.values()) + list(pq.heap.items())
+    }
+    return admitted, evicted, parked
+
+
+def device_preempt_drain_trace(spec, **kw):
+    from kueue_tpu.core.drain import run_drain_preempt
+
+    sched, mgr, cache, _ = build_preempt_env(spec)
+    pending = []
+    for cq_name, pq in mgr.cluster_queues.items():
+        for wl in pq.snapshot_sorted():
+            pending.append((wl, cq_name))
+    snapshot = take_snapshot(cache)
+    outcome = run_drain_preempt(
+        snapshot,
+        pending,
+        cache.flavors,
+        timestamp_fn=lambda wl: queue_order_timestamp(wl, mgr._ts_policy),
+        **kw,
+    )
+    admitted = {wl.name: flavors for wl, _, flavors, _ in outcome.admitted}
+    evicted = {wl.name for wl, _, _ in outcome.preempted}
+    parked = {wl.name for wl, _ in outcome.parked}
+    return admitted, evicted, parked, outcome
+
+
+def preempt_spec(seed, n_cohorts=2, cqs_per_cohort=3, victims_per_cq=4,
+                 workloads_per_cq=4):
+    """Random scenario inside the device preemption-drain scope:
+    within-CQ preemption, reclaimWithinCohort=Never, single RG."""
+    from kueue_tpu.models.cluster_queue import Preemption
+    from kueue_tpu.models.constants import PreemptionPolicy
+
+    rng = np.random.default_rng(seed)
+    flavors = ["fl-0", "fl-1"]
+    cqs, workloads, victims = [], [], []
+    t = 0.0
+    for ci in range(n_cohorts):
+        for qi in range(cqs_per_cohort):
+            name = f"cq-{ci}-{qi}"
+            cohort = f"cohort-{ci}" if rng.random() < 0.7 else None
+            k = int(rng.integers(1, 3))
+            fls = []
+            for f in flavors[:k]:
+                bl = (
+                    str(int(rng.integers(0, 8)))
+                    if cohort is not None and rng.random() < 0.4
+                    else None
+                )
+                fls.append((f, {"cpu": str(int(rng.integers(6, 16)))}, bl, None))
+            policy = rng.choice(
+                [
+                    PreemptionPolicy.NEVER,
+                    PreemptionPolicy.LOWER_PRIORITY,
+                    PreemptionPolicy.LOWER_PRIORITY,
+                    PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY,
+                ]
+            )
+            cqs.append(
+                {
+                    "name": name,
+                    "cohort": cohort,
+                    "groups": [{"resources": ["cpu"], "flavors": fls}],
+                    "preemption": Preemption(within_cluster_queue=policy),
+                }
+            )
+            for vi in range(int(rng.integers(0, victims_per_cq + 1))):
+                t += 1.0
+                victims.append(
+                    (
+                        f"victim-{ci}-{qi}-{vi}", name,
+                        fls[int(rng.integers(0, len(fls)))][0],
+                        str(int(rng.integers(1, 5))),
+                        int(rng.integers(0, 3)) * 10, t,
+                    )
+                )
+            for wi in range(workloads_per_cq):
+                t += 1.0
+                workloads.append(
+                    {
+                        "name": f"wl-{ci}-{qi}-{wi}",
+                        "queue": f"lq-{name}",
+                        "prio": int(rng.integers(0, 4)) * 10,
+                        "t": t,
+                        "pod_sets": [
+                            {
+                                "name": "main",
+                                "count": int(rng.integers(1, 3)),
+                                "requests": {"cpu": str(int(rng.integers(1, 7)))},
+                            }
+                        ],
+                    }
+                )
+    return {
+        "flavors": flavors, "cqs": cqs, "workloads": workloads,
+        "victims": victims,
+    }
+
+
+class TestPreemptDrainParity:
+    def test_basic_preempt_then_admit(self):
+        from kueue_tpu.models.cluster_queue import Preemption
+        from kueue_tpu.models.constants import PreemptionPolicy
+
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq",
+                    "cohort": None,
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "10"}, None, None)]}
+                    ],
+                    "preemption": Preemption(
+                        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+                    ),
+                }
+            ],
+            "workloads": [
+                {
+                    "name": "attacker", "queue": "lq-cq", "prio": 100, "t": 50.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "8"}}
+                    ],
+                }
+            ],
+            "victims": [
+                ("v0", "cq", "f", "4", 0, 1.0),
+                ("v1", "cq", "f", "4", 10, 2.0),
+            ],
+        }
+        admitted, evicted, parked, outcome = device_preempt_drain_trace(spec)
+        h_admitted, h_evicted, h_parked = host_preempt_drain_trace(spec)
+        assert admitted == h_admitted == {"attacker": {"cpu": "f"}}
+        assert evicted == h_evicted
+        assert parked == h_parked == set()
+        assert not outcome.fallback and not outcome.truncated
+
+    def test_minimal_victim_set(self):
+        """Fill-back keeps unnecessary victims admitted: only enough
+        victims to fit the head are evicted."""
+        from kueue_tpu.models.cluster_queue import Preemption
+        from kueue_tpu.models.constants import PreemptionPolicy
+
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq",
+                    "cohort": None,
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "12"}, None, None)]}
+                    ],
+                    "preemption": Preemption(
+                        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+                    ),
+                }
+            ],
+            "workloads": [
+                {
+                    "name": "attacker", "queue": "lq-cq", "prio": 100, "t": 50.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "4"}}
+                    ],
+                }
+            ],
+            "victims": [
+                ("v-low", "cq", "f", "4", 0, 1.0),
+                ("v-mid", "cq", "f", "4", 10, 2.0),
+                ("v-high", "cq", "f", "4", 20, 3.0),
+            ],
+        }
+        admitted, evicted, parked, _ = device_preempt_drain_trace(spec)
+        h_admitted, h_evicted, h_parked = host_preempt_drain_trace(spec)
+        assert admitted == h_admitted
+        assert evicted == h_evicted == {"v-low"}
+        assert parked == h_parked
+
+    def test_never_policy_parks(self):
+        from kueue_tpu.models.cluster_queue import Preemption
+        from kueue_tpu.models.constants import PreemptionPolicy
+
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq",
+                    "cohort": None,
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "10"}, None, None)]}
+                    ],
+                    "preemption": Preemption(
+                        within_cluster_queue=PreemptionPolicy.NEVER
+                    ),
+                }
+            ],
+            "workloads": [
+                {
+                    "name": "blocked", "queue": "lq-cq", "prio": 100, "t": 50.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "8"}}
+                    ],
+                }
+            ],
+            "victims": [("v0", "cq", "f", "8", 0, 1.0)],
+        }
+        admitted, evicted, parked, _ = device_preempt_drain_trace(spec)
+        h_admitted, h_evicted, h_parked = host_preempt_drain_trace(spec)
+        assert admitted == h_admitted == {}
+        assert evicted == h_evicted == set()
+        assert parked == h_parked == {"blocked"}
+
+    def test_cohort_reclaim_routes_to_fallback(self):
+        from kueue_tpu.models.cluster_queue import Preemption
+        from kueue_tpu.models.constants import (
+            PreemptionPolicy,
+            ReclaimWithinCohortPolicy,
+        )
+
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "10"}, None, None)]}
+                    ],
+                    "preemption": Preemption(
+                        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                        reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+                    ),
+                }
+            ],
+            "workloads": [
+                {
+                    "name": "w", "queue": "lq-cq", "prio": 100, "t": 50.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "8"}}
+                    ],
+                }
+            ],
+            "victims": [("v0", "cq", "f", "8", 0, 1.0)],
+        }
+        _, _, _, outcome = device_preempt_drain_trace(spec)
+        assert [wl.name for wl, _ in outcome.fallback] == ["w"]
+
+    @pytest.mark.parametrize("seed", range(16))
+    def test_randomized(self, seed):
+        spec = preempt_spec(seed)
+        h_admitted, h_evicted, h_parked = host_preempt_drain_trace(spec)
+        admitted, evicted, parked, outcome = device_preempt_drain_trace(spec)
+        assert not outcome.fallback
+        assert admitted == h_admitted
+        assert evicted == h_evicted
+        assert parked == h_parked
