@@ -234,116 +234,6 @@ def contended_drain_bench(rng):
     )
 
 
-def contended_bench(rng):
-    """Interactive contended variant: every ClusterQueue is full of
-    admitted lower-priority workloads and its head requires preemption,
-    so the cycle's cost is the victim search (classic
-    minimalPreemptions) — the reference's simulate/undo loop
-    (preemption.go:275-342), here ONE batched device dispatch for all
-    heads. Returns ms/cycle of a full Scheduler.schedule() call."""
-    import time
-
-    from kueue_tpu.models import (
-        ClusterQueue,
-        FlavorQuotas,
-        LocalQueue,
-        Preemption,
-        ResourceFlavor,
-        Workload,
-        WorkloadConditionType,
-    )
-    from kueue_tpu.models.cluster_queue import ResourceGroup
-    from kueue_tpu.models.constants import (
-        PreemptionPolicy,
-        ReclaimWithinCohortPolicy,
-    )
-    from kueue_tpu.models.workload import PodSet
-    from kueue_tpu.core.cache import Cache
-    from kueue_tpu.core.queue_manager import QueueManager
-    from kueue_tpu.core.preemption import Preemptor
-    from kueue_tpu.core.scheduler import Scheduler
-    from kueue_tpu.core.workload_info import make_admission
-    from kueue_tpu.utils.clock import FakeClock
-
-    n_cq, victims_per_cq = 1000, 8
-    clock = FakeClock(0.0)
-    cache = Cache()
-    mgr = QueueManager(clock)
-    cache.add_or_update_flavor(ResourceFlavor(name="default"))
-    prem = Preemption(
-        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
-        reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
-    )
-    for i in range(n_cq):
-        name = f"ccq-{i}"
-        cq = ClusterQueue(
-            name=name,
-            cohort=f"ccohort-{i % N_COHORT}",
-            namespace_selector={},
-            resource_groups=(
-                ResourceGroup(
-                    ("cpu",),
-                    (FlavorQuotas.build("default", {"cpu": "16"}),),
-                ),
-            ),
-            preemption=prem,
-        )
-        cache.add_or_update_cluster_queue(cq)
-        mgr.add_cluster_queue(cq)
-        mgr.add_local_queue(
-            LocalQueue(namespace="ns", name=f"lq-{name}", cluster_queue=name)
-        )
-        # saturate the quota with preemptible admitted workloads
-        for v in range(victims_per_cq):
-            wl = Workload(
-                namespace="ns", name=f"victim-{i}-{v}",
-                queue_name=f"lq-{name}", priority=int(rng.integers(0, 40)),
-                pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
-            )
-            wl.admission = make_admission(name, {"main": {"cpu": "default"}}, wl)
-            wl.set_condition(
-                WorkloadConditionType.QUOTA_RESERVED, True,
-                reason="QuotaReserved", now=float(v),
-            )
-            cache.add_or_update_workload(wl)
-        # one high-priority head per CQ that can only start by preempting
-        mgr.add_or_update_workload(
-            Workload(
-                namespace="ns", name=f"pre-{i}", queue_name=f"lq-{name}",
-                priority=100, creation_time=float(i),
-                pod_sets=(
-                    PodSet.build(
-                        "main", 1, {"cpu": str(int(rng.integers(4, 12)))}
-                    ),
-                ),
-            )
-        )
-    sched = Scheduler(
-        queues=mgr,
-        cache=cache,
-        clock=clock,
-        preemptor=Preemptor(clock),
-        use_solver=True,
-        use_preempt_solver=True,
-    )
-    # warmup compiles at identical shapes; re-queue the heads afterwards
-    t0 = time.perf_counter()
-    res = sched.schedule()
-    warm_s = time.perf_counter() - t0
-    n_preempting = len(res.preempting)
-    assert n_preempting == n_cq, f"expected {n_cq} preempting, got {n_preempting}"
-    times = []
-    for _ in range(3):
-        # schedule() already requeued the heads (pending-preemption
-        # parking); reactivate them for an identical next cycle
-        mgr.queue_inadmissible_workloads({f"ccq-{i}" for i in range(n_cq)})
-        t0 = time.perf_counter()
-        res = sched.schedule()
-        times.append(time.perf_counter() - t0)
-        assert len(res.preempting) == n_cq
-    return float(np.median(times)) * 1e3, n_preempting, warm_s
-
-
 def main():
     from kueue_tpu.core.drain import run_drain
     from kueue_tpu.core.snapshot import take_snapshot

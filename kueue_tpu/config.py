@@ -199,4 +199,5 @@ def runtime_from_config(cfg: Configuration, clock=None, tas_cache=None):
         manage_jobs_without_queue_name=cfg.manage_jobs_without_queue_name,
         fair_sharing=cfg.fair_sharing.enable,
         tas_cache=tas_cache,
+        resources=cfg.resources,
     )

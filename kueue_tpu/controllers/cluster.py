@@ -54,6 +54,7 @@ class ClusterRuntime:
         solver_threshold: int = 16,
         use_preempt_solver: Optional[bool] = None,
         preempt_solver_threshold: int = 4,
+        resources=None,  # config.ResourceSettings (quota-view transform)
     ):
         from kueue_tpu.metrics import Metrics
 
@@ -67,6 +68,16 @@ class ClusterRuntime:
         self.events: List[Event] = []
         self.metrics = Metrics()
         self.pods_ready_cfg = wait_for_pods_ready or WaitForPodsReadyConfig()
+        # resource adjustment pipeline stores (pkg/workload/resources.go)
+        self.limit_ranges: Dict[str, "object"] = {}  # key -> LimitRange
+        self.runtime_classes: Dict[str, "object"] = {}  # name -> RuntimeClass
+        self.transform_config = None
+        if resources is not None:
+            from kueue_tpu.core.workload_info import ResourceTransformConfig
+
+            self.transform_config = ResourceTransformConfig.from_settings(
+                resources
+            )
 
         tas_check = tas_assign = tas_fits = None
         self.tas_manager = None
@@ -74,7 +85,9 @@ class ClusterRuntime:
             from kueue_tpu.tas import TASManager
 
             self.cache.tas_cache = tas_cache
-            self.tas_manager = TASManager(tas_cache, self.cache.flavors)
+            self.tas_manager = TASManager(
+                tas_cache, self.cache.flavors, transform=self.transform_config
+            )
             tas_check = self.tas_manager.check
             tas_assign = self.tas_manager.assign
             tas_fits = self.tas_manager.fits
@@ -95,6 +108,8 @@ class ClusterRuntime:
             solver_threshold=solver_threshold,
             use_preempt_solver=use_preempt_solver,
             preempt_solver_threshold=preempt_solver_threshold,
+            transform_config=self.transform_config,
+            limit_range_validate=self._validate_workload_resources,
         )
         self.job_reconciler = JobReconciler(
             self,
@@ -222,6 +237,31 @@ class ClusterRuntime:
     def add_priority_class(self, pc: WorkloadPriorityClass) -> None:
         self.cache.add_or_update_priority_class(pc)
 
+    # ---- resource adjustment objects ----
+    def add_limit_range(self, lr) -> None:
+        self.limit_ranges[lr.key] = lr
+
+    def delete_limit_range(self, key: str) -> None:
+        self.limit_ranges.pop(key, None)
+
+    def add_runtime_class(self, rc) -> None:
+        self.runtime_classes[rc.name] = rc
+
+    def delete_runtime_class(self, name: str) -> None:
+        self.runtime_classes.pop(name, None)
+
+    def _validate_workload_resources(self, wl: Workload) -> Optional[str]:
+        """Scheduler nomination validation (scheduler.go:361-369):
+        LimitRange bounds + requests<=limits."""
+        from kueue_tpu.core.limit_range import (
+            validate_limit_range,
+            validate_resources,
+        )
+
+        errs = validate_limit_range(wl, self.limit_ranges.values())
+        errs += validate_resources(wl)
+        return "; ".join(errs) if errs else None
+
     # ---- jobs ----
     def _wl_key_for_job(self, job: GenericJob) -> str:
         return f"{job.namespace}/{self.job_reconciler.workload_name_for(job)}"
@@ -261,6 +301,17 @@ class ClusterRuntime:
         if wl.admission is not None and wl.has_quota_reservation:
             self.cache.add_or_update_workload(wl)
         elif wl.active:
+            # spec-level resource adjustment before queuing (the
+            # jobframework reconciler calls workload.AdjustResources on
+            # create — RuntimeClass overhead, LimitRange defaults,
+            # limits-as-missing-requests). Unconditional: the
+            # limits-as-requests step applies even with no LimitRange
+            # or RuntimeClass objects (resources.go handleLimitsToRequests)
+            from kueue_tpu.core.limit_range import adjust_workload_resources
+
+            adjust_workload_resources(
+                wl, self.limit_ranges.values(), self.runtime_classes
+            )
             # inactive workloads never queue (workload_controller.go
             # create/update handlers route them out of the queues)
             self.queues.add_or_update_workload(wl)

@@ -132,15 +132,42 @@ class JobReconciler:
                 return False
         return True
 
-    @classmethod
-    def _equivalent(cls, wl: Workload, job: GenericJob) -> bool:
+    def _adjusted_job_podsets(self, job: GenericJob):
+        """The desired workload podsets for this job, run through the
+        resource-adjustment pipeline. The reference constructs the
+        desired Workload and calls AdjustResources BEFORE comparing
+        (reconciler.go ConstructWorkload), so stored workloads — which
+        were adjusted at ingress — compare against adjusted specs, not
+        raw job specs (otherwise any LimitRange default would make
+        every stored workload look stale: delete/recreate forever)."""
+        import copy
+
+        from kueue_tpu.core.limit_range import adjust_workload_resources
+
+        podsets = [copy.copy(ps) for ps in job.pod_sets()]
+        for ps in podsets:
+            ps.requests = dict(ps.requests)
+            ps.limits = dict(ps.limits)
+            ps.overhead = dict(ps.overhead)
+        probe = Workload(
+            namespace=job.namespace, name="-", pod_sets=tuple(podsets)
+        )
+        adjust_workload_resources(
+            probe,
+            self.runtime.limit_ranges.values(),
+            self.runtime.runtime_classes,
+        )
+        return list(probe.pod_sets)
+
+    def _equivalent(self, wl: Workload, job: GenericJob) -> bool:
         """EquivalentToWorkload (reconciler.go:797-860): with a quota
         reservation the job must match the RUNNING podsets — counts
         replaced by the admission's (possibly partially-admitted)
         counts; a suspended job may still match the original spec.
         Exact-count equality prevents a running job from scaling past
         its admission (quota bypass)."""
-        job_podsets = job.pod_sets()
+        cls = type(self)
+        job_podsets = self._adjusted_job_podsets(job)
         if wl.has_quota_reservation and wl.admission is not None:
             counts = {
                 psa.name: psa.count for psa in wl.admission.pod_set_assignments

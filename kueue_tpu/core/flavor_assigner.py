@@ -142,12 +142,14 @@ class AssignmentResult:
                 )
         return "; ".join(parts)
 
-    def to_admission(self, cq_name: str, wl: Workload) -> Admission:
+    def to_admission(
+        self, cq_name: str, wl: Workload, transform=None
+    ) -> Admission:
         podsets = {ps.name: ps for ps in wl.pod_sets}
         psas = []
         for psr in self.pod_sets:
             ps = podsets[psr.name]
-            scaled = _scaled_requests(wl, ps, psr.count)
+            scaled = _scaled_requests(wl, ps, psr.count, transform)
             if PODS in psr.flavors:
                 # the implicit pods resource is charged too
                 scaled[PODS] = psr.count
@@ -163,8 +165,12 @@ class AssignmentResult:
         return Admission(cluster_queue=cq_name, pod_set_assignments=tuple(psas))
 
 
-def _scaled_requests(wl: Workload, ps: PodSet, count: int) -> Requests:
-    return {r: v * count for r, v in ps.requests.items()}
+def _scaled_requests(
+    wl: Workload, ps: PodSet, count: int, transform=None
+) -> Requests:
+    from kueue_tpu.core.workload_info import quota_per_pod
+
+    return {r: v * count for r, v in quota_per_pod(ps, transform).items()}
 
 
 # TAS compatibility hook: (cq, podset, flavor) -> error message or None.
@@ -182,6 +188,7 @@ class FlavorAssigner:
         reclaim_oracle: Optional[ReclaimOracle] = None,
         tas_check: Optional[TASCheck] = None,
         flavor_fungibility_enabled: bool = True,
+        transform=None,  # ResourceTransformConfig for the quota view
     ):
         self.snapshot = snapshot
         self.flavors = flavors
@@ -189,6 +196,7 @@ class FlavorAssigner:
         self.reclaim_oracle = reclaim_oracle or (lambda cq, wl, fr, q: False)
         self.tas_check = tas_check
         self.fungibility_enabled = flavor_fungibility_enabled
+        self.transform = transform
 
     # ---- public entry (flavorassigner.go:367-379) ----
     def assign(
@@ -221,7 +229,7 @@ class FlavorAssigner:
 
         for ps_idx, ps in enumerate(wl.pod_sets):
             count = counts[ps_idx] if counts is not None else effective_podset_count(wl, ps)
-            requests = {r: v * count for r, v in ps.requests.items()}
+            requests = _scaled_requests(wl, ps, count, self.transform)
             if PODS in rg_by_resource:
                 requests[PODS] = count
 

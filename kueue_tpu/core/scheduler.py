@@ -123,6 +123,7 @@ class Scheduler:
         solver_threshold: int = 16,
         use_preempt_solver: Optional[bool] = None,
         preempt_solver_threshold: int = 4,
+        transform_config=None,  # ResourceTransformConfig (quota view)
     ):
         self.queues = queues
         self.cache = cache
@@ -154,6 +155,7 @@ class Scheduler:
         # heads), True = always, False = never (host Preemptor loop).
         self.use_preempt_solver = use_preempt_solver
         self.preempt_solver_threshold = preempt_solver_threshold
+        self.transform_config = transform_config
         self.scheduling_cycle = 0
 
     # ---- the cycle (scheduler.go:176-310) ----
@@ -332,6 +334,7 @@ class Scheduler:
             enable_fair_sharing=self.fair_sharing,
             reclaim_oracle=functools.partial(self._reclaim_oracle, snapshot),
             tas_check=self.tas_check,
+            transform=self.transform_config,
         )
 
     def _host_assign(
@@ -471,6 +474,7 @@ class Scheduler:
             heads,
             self.cache.flavors,
             timestamp_fn=lambda wl: queue_order_timestamp(wl, self.queues._ts_policy),
+            transform=self.transform_config,
         )
         fallback = set(lowered.fallback)
         if len(fallback) == len(to_assign):
@@ -780,7 +784,9 @@ class Scheduler:
     def _admit(self, e: Entry, snapshot: Snapshot) -> bool:
         wl = e.workload
         now = self.clock.now()
-        admission = e.assignment.to_admission(e.cq_name, wl)
+        admission = e.assignment.to_admission(
+            e.cq_name, wl, transform=self.transform_config
+        )
         wl.admission = admission
         wl.set_condition(
             WorkloadConditionType.QUOTA_RESERVED, True, reason="QuotaReserved", now=now

@@ -30,7 +30,7 @@ from kueue_tpu.models.cluster_queue import ClusterQueue
 from kueue_tpu.models.constants import FlavorFungibilityPolicy
 from kueue_tpu.models.resource_flavor import flavor_eligible, group_label_keys
 from kueue_tpu.core.snapshot import Snapshot
-from kueue_tpu.core.workload_info import effective_podset_count
+from kueue_tpu.core.workload_info import effective_podset_count, quota_per_pod
 from kueue_tpu.resources import PODS, FlavorResource
 from kueue_tpu.utils.priority import priority_of
 
@@ -109,9 +109,9 @@ class _Template:
         self.tried_list: List[Dict[str, int]] = []
 
 
-def _podset_sig(ps) -> tuple:
+def _podset_sig(ps, per_pod) -> tuple:
     sel = tuple(sorted(ps.node_selector.items())) if ps.node_selector else ()
-    return (tuple(sorted(ps.requests)), sel, tuple(ps.tolerations))
+    return (tuple(sorted(per_pod)), sel, tuple(ps.tolerations))
 
 
 def _build_template(
@@ -119,6 +119,7 @@ def _build_template(
     cq,
     cq_name: str,
     ps,
+    per_pod: Dict[str, int],  # quota-view requests (overhead+transform)
     starts: Tuple[int, ...],
     flavors: Dict[str, ResourceFlavor],
     k: int,
@@ -130,13 +131,13 @@ def _build_template(
     # quantities are per-workload)
     touched: List[Tuple[object, List[str]]] = []
     for rg in cq.resource_groups:
-        rg_res = [r for r in sorted(ps.requests) if r in rg.covered_resources]
+        rg_res = [r for r in sorted(per_pod) if r in rg.covered_resources]
         if PODS in rg.covered_resources:
             rg_res.append(PODS)
         if rg_res:
             touched.append((rg, sorted(rg_res)))
     covered = {r for rg, _ in touched for r in rg.covered_resources}
-    if any(r not in covered for r in ps.requests):
+    if any(r not in covered for r in per_pod):
         t.fallback = True  # resource not covered: host reports it
         return t
     t.n_groups = len(touched)
@@ -243,6 +244,7 @@ def lower_heads(
     max_candidates: int = 8,
     max_cells: int = 16,
     timestamp_fn=None,
+    transform=None,  # ResourceTransformConfig for the quota view
 ) -> Lowered:
     """Build the dense head batch; route inexpressible heads to
     ``fallback`` (handled by the host FlavorAssigner).
@@ -280,6 +282,7 @@ def lower_heads(
         if ps.topology_request is not None:
             out.fallback.append(i)  # TAS placement stays on the host path
             continue
+        per_pod = quota_per_pod(ps, transform)
 
         # per-RG cursor starts (LastAssignment resume)
         state = wl.last_assignment
@@ -292,7 +295,7 @@ def lower_heads(
             starts_l = []
             for rg in cq.resource_groups:
                 rg_res = [
-                    r for r in sorted(ps.requests) if r in rg.covered_resources
+                    r for r in sorted(per_pod) if r in rg.covered_resources
                 ]
                 if PODS in rg.covered_resources:
                     rg_res.append(PODS)
@@ -300,10 +303,12 @@ def lower_heads(
                     starts_l.append(state.next_flavor_to_try(0, sorted(rg_res)[0]))
             starts = tuple(starts_l)
 
-        key = (cq_name, _podset_sig(ps), starts)
+        key = (cq_name, _podset_sig(ps, per_pod), starts)
         t = templates.get(key)
         if t is None:
-            t = _build_template(snapshot, cq, cq_name, ps, starts, flavors, k, c)
+            t = _build_template(
+                snapshot, cq, cq_name, ps, per_pod, starts, flavors, k, c
+            )
             templates[key] = t
         out.n_groups[i] = t.n_groups
         if t.fallback:
@@ -311,7 +316,7 @@ def lower_heads(
             continue
 
         count = effective_podset_count(wl, ps)
-        requests = {r: v * count for r, v in ps.requests.items()}
+        requests = {r: v * count for r, v in per_pod.items()}
         requests[PODS] = count
 
         out.cq_row[i] = t.cq_row

@@ -22,22 +22,62 @@ from kueue_tpu.resources import (
 )
 
 
+RETAIN = "Retain"
+REPLACE = "Replace"
+
+
+@dataclass
+class ResourceTransform:
+    """One transformation rule (configuration_types.go:432-443)."""
+
+    outputs: Dict[str, float] = field(default_factory=dict)
+    strategy: str = RETAIN  # Retain keeps the input; Replace drops it
+
+
 @dataclass
 class ResourceTransformConfig:
     """resources.excludeResourcePrefixes + transformations
     (apis/config/v1beta1/configuration_types.go:418-443)."""
 
     exclude_prefixes: Tuple[str, ...] = ()
-    # input resource -> {output resource: factor} (Replace semantics)
-    transformations: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    transformations: Dict[str, ResourceTransform] = field(default_factory=dict)
+
+    @staticmethod
+    def from_settings(settings) -> "ResourceTransformConfig":
+        """Build from config.ResourceSettings (the --config file's
+        resources section)."""
+        from kueue_tpu.resources import quantity_to_int
+
+        transforms = {}
+        for name, spec in settings.transformations.items():
+            transforms[name] = ResourceTransform(
+                outputs={
+                    # quantity strings ("2", "5Gi") are canonical units
+                    # per unit of input (ResourceList semantics);
+                    # numeric values are raw factors
+                    k: (
+                        float(quantity_to_int(k, v))
+                        if isinstance(v, str)
+                        else float(v)
+                    )
+                    for k, v in (spec.get("outputs") or {}).items()
+                },
+                strategy=spec.get("strategy", RETAIN),
+            )
+        return ResourceTransformConfig(
+            exclude_prefixes=tuple(settings.exclude_resource_prefixes),
+            transformations=transforms,
+        )
 
     def apply(self, requests: Requests) -> Requests:
         out: Requests = {}
         for name, qty in requests.items():
-            if name in self.transformations:
-                for target, factor in self.transformations[name].items():
+            tr = self.transformations.get(name)
+            if tr is not None:
+                for target, factor in tr.outputs.items():
                     out[target] = out.get(target, 0) + int(qty * factor)
-                continue
+                if tr.strategy == REPLACE:
+                    continue
             if any(name.startswith(p) for p in self.exclude_prefixes):
                 continue
             out[name] = out.get(name, 0) + qty
@@ -50,12 +90,28 @@ def effective_podset_count(wl: Workload, ps: PodSet) -> int:
     return max(0, ps.count - reclaimed)
 
 
+def quota_per_pod(
+    ps: PodSet, transform: Optional[ResourceTransformConfig] = None
+) -> Requests:
+    """The per-pod quantities quota accounting sees: spec requests plus
+    RuntimeClass overhead, run through excludeResourcePrefixes/
+    transformations (workload.Info's TotalRequests view,
+    pkg/workload/resources.go + configuration_types.go:418-443)."""
+    if not ps.overhead and transform is None:
+        return ps.requests  # fast path: the common case allocates nothing
+    merged = dict(ps.requests)
+    for k, v in ps.overhead.items():
+        merged[k] = merged.get(k, 0) + v
+    return transform.apply(merged) if transform else merged
+
+
 def podset_requests(
     wl: Workload, ps: PodSet, transform: Optional[ResourceTransformConfig] = None
 ) -> Requests:
     """Total effective requests of one podset (count x per-pod)."""
-    per_pod = transform.apply(ps.requests) if transform else dict(ps.requests)
-    return scale_requests(per_pod, effective_podset_count(wl, ps))
+    return scale_requests(
+        quota_per_pod(ps, transform), effective_podset_count(wl, ps)
+    )
 
 
 def total_requests(

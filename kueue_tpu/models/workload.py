@@ -55,6 +55,15 @@ class PodSet:
     topology_request: Optional[PodSetTopologyRequest] = None
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: Tuple[Toleration, ...] = ()
+    # Per-pod resource limits; the adjustment pipeline uses them as
+    # missing requests (pkg/workload/resources.go
+    # UseLimitsAsMissingRequestsInPod) and validates requests <= limits.
+    limits: Requests = field(default_factory=dict)
+    # RuntimeClass pod overhead (podSpec.overhead): charged on top of
+    # requests for quota purposes; filled from the RuntimeClass object
+    # when runtime_class_name is set and overhead is empty.
+    overhead: Requests = field(default_factory=dict)
+    runtime_class_name: Optional[str] = None
 
     def __post_init__(self):
         if self.count < 1:
@@ -63,8 +72,18 @@ class PodSet:
             raise ValueError("PodSet.minCount must be in (0, count]")
 
     @staticmethod
-    def build(name: str, count: int, requests: Dict[str, object], **kw) -> "PodSet":
-        return PodSet(name=name, count=count, requests=requests_from_spec(requests), **kw)
+    def build(
+        name: str, count: int, requests: Dict[str, object],
+        limits: Optional[Dict[str, object]] = None,
+        overhead: Optional[Dict[str, object]] = None,
+        **kw,
+    ) -> "PodSet":
+        return PodSet(
+            name=name, count=count, requests=requests_from_spec(requests),
+            limits=requests_from_spec(limits or {}),
+            overhead=requests_from_spec(overhead or {}),
+            **kw,
+        )
 
     def total_requests(self) -> Requests:
         return scale_requests(self.requests, self.count)

@@ -274,6 +274,61 @@ def priority_class_from_dict(d: dict) -> WorkloadPriorityClass:
     return WorkloadPriorityClass(name=d["name"], value=d["value"])
 
 
+# ---- limit ranges / runtime classes (resource adjustment inputs) ----
+def limit_range_to_dict(lr) -> dict:
+    return {
+        "name": lr.name,
+        "namespace": lr.namespace,
+        "limits": [
+            {
+                "type": item.type,
+                "max": dict(item.max),
+                "min": dict(item.min),
+                "default": dict(item.default),
+                "defaultRequest": dict(item.default_request),
+            }
+            for item in lr.items
+        ],
+    }
+
+
+def limit_range_from_dict(d: dict):
+    from kueue_tpu.core.limit_range import LimitRange, LimitRangeItem
+
+    def qmap(m):
+        return {r: _canon_qty(r, q) for r, q in (m or {}).items()}
+
+    return LimitRange(
+        namespace=d["namespace"],
+        name=d["name"],
+        items=[
+            LimitRangeItem(
+                type=item.get("type", "Container"),
+                max=qmap(item.get("max")),
+                min=qmap(item.get("min")),
+                default=qmap(item.get("default")),
+                default_request=qmap(item.get("defaultRequest")),
+            )
+            for item in d.get("limits", [])
+        ],
+    )
+
+
+def runtime_class_to_dict(rc) -> dict:
+    return {"name": rc.name, "overhead": dict(rc.overhead)}
+
+
+def runtime_class_from_dict(d: dict):
+    from kueue_tpu.core.limit_range import RuntimeClass
+
+    return RuntimeClass(
+        name=d["name"],
+        overhead={
+            r: _canon_qty(r, q) for r, q in (d.get("overhead") or {}).items()
+        },
+    )
+
+
 # ---- workloads ----
 def workload_to_dict(wl: Workload) -> dict:
     out = {
@@ -291,6 +346,9 @@ def workload_to_dict(wl: Workload) -> dict:
                 "count": ps.count,
                 "minCount": ps.min_count,
                 "requests": dict(ps.requests),
+                "limits": dict(ps.limits),
+                "overhead": dict(ps.overhead),
+                "runtimeClassName": ps.runtime_class_name,
                 "nodeSelector": dict(ps.node_selector),
                 "topologyRequest": (
                     {
@@ -374,6 +432,15 @@ def workload_from_dict(d: dict) -> Workload:
                     r: _canon_qty(r, q)
                     for r, q in ps.get("requests", {}).items()
                 },
+                limits={
+                    r: _canon_qty(r, q)
+                    for r, q in (ps.get("limits") or {}).items()
+                },
+                overhead={
+                    r: _canon_qty(r, q)
+                    for r, q in (ps.get("overhead") or {}).items()
+                },
+                runtime_class_name=ps.get("runtimeClassName"),
                 node_selector=dict(ps.get("nodeSelector", {})),
                 topology_request=(
                     PodSetTopologyRequest(
@@ -460,6 +527,10 @@ def runtime_from_state(data: dict, **runtime_kwargs):
         rt.add_admission_check(check_from_dict(a))
     for p in data.get("workloadPriorityClasses", []):
         rt.add_priority_class(priority_class_from_dict(p))
+    for lr in data.get("limitRanges", []):
+        rt.add_limit_range(limit_range_from_dict(lr))
+    for rc in data.get("runtimeClasses", []):
+        rt.add_runtime_class(runtime_class_from_dict(rc))
     for c in data.get("clusterQueues", []):
         rt.add_cluster_queue(cq_from_dict(c))
     for l in data.get("localQueues", []):
@@ -473,7 +544,7 @@ def runtime_to_state(rt) -> dict:
     """Dump a live ClusterRuntime back to the wire format (the durable
     checkpoint; reference: all state lives in the API server and is
     reconstructed on restart — SURVEY §5 checkpoint/resume)."""
-    return state_to_dict(
+    out = state_to_dict(
         flavors=list(rt.cache.flavors.values()),
         cluster_queues=[c.model for c in rt.cache.cluster_queues.values()],
         local_queues=list(rt.cache.local_queues.values()),
@@ -483,6 +554,13 @@ def runtime_to_state(rt) -> dict:
         topologies=list(rt.cache.topologies.values()),
         priority_classes=list(rt.cache.priority_classes.values()),
     )
+    out["limitRanges"] = [
+        limit_range_to_dict(lr) for lr in rt.limit_ranges.values()
+    ]
+    out["runtimeClasses"] = [
+        runtime_class_to_dict(rc) for rc in rt.runtime_classes.values()
+    ]
+    return out
 
 
 def state_to_dict(

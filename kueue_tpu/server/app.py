@@ -59,63 +59,66 @@ class ApiError(Exception):
 
 class _Section:
     """One object kind's wiring: wire<->model codecs, the runtime add
-    method, and a direct store lookup (keyed by (namespace, name))."""
+    method, and its store (one accessor serves both the point lookup
+    and listing, so the mappings cannot diverge)."""
 
-    def __init__(self, from_dict, to_dict, add_name, store):
+    def __init__(self, from_dict, to_dict, add_name, store_map, namespaced=False):
         self.from_dict = from_dict
         self.to_dict = to_dict
         self.add_name = add_name
-        self.store = store  # (runtime, namespace, name) -> model | None
+        self.store_map = store_map  # runtime -> {key: model}
+        self.namespaced = namespaced
+
+    def lookup(self, rt, namespace: str, name: str):
+        key = f"{namespace}/{name}" if self.namespaced else name
+        return self.store_map(rt).get(key)
 
 
 _SECTIONS: Dict[str, _Section] = {
     "resourceflavors": _Section(
         ser.flavor_from_dict, ser.flavor_to_dict, "add_flavor",
-        lambda rt, ns, n: rt.cache.flavors.get(n),
+        lambda rt: rt.cache.flavors,
     ),
     "clusterqueues": _Section(
         ser.cq_from_dict,
         lambda m: ser.cq_to_dict(m.model if hasattr(m, "model") else m),
         "add_cluster_queue",
-        lambda rt, ns, n: rt.cache.cluster_queues.get(n),
+        lambda rt: rt.cache.cluster_queues,
     ),
     "localqueues": _Section(
         ser.lq_from_dict, ser.lq_to_dict, "add_local_queue",
-        lambda rt, ns, n: rt.cache.local_queues.get(f"{ns}/{n}"),
+        lambda rt: rt.cache.local_queues, namespaced=True,
     ),
     "workloads": _Section(
         ser.workload_from_dict, ser.workload_to_dict, "add_workload",
-        lambda rt, ns, n: rt.workloads.get(f"{ns}/{n}"),
+        lambda rt: rt.workloads, namespaced=True,
     ),
     "cohorts": _Section(
         ser.cohort_from_dict, ser.cohort_to_dict, "add_cohort",
-        lambda rt, ns, n: rt.cache.cohorts.get(n),
+        lambda rt: rt.cache.cohorts,
     ),
     "admissionchecks": _Section(
         ser.check_from_dict, ser.check_to_dict, "add_admission_check",
-        lambda rt, ns, n: rt.cache.admission_checks.get(n),
+        lambda rt: rt.cache.admission_checks,
     ),
     "topologies": _Section(
         ser.topology_from_dict, ser.topology_to_dict, "add_topology",
-        lambda rt, ns, n: rt.cache.topologies.get(n),
+        lambda rt: rt.cache.topologies,
     ),
     "workloadpriorityclasses": _Section(
         ser.priority_class_from_dict, ser.priority_class_to_dict,
         "add_priority_class",
-        lambda rt, ns, n: rt.cache.priority_classes.get(n),
+        lambda rt: rt.cache.priority_classes,
     ),
-}
-
-# lister: every live model of a section, sorted by store key
-_LISTERS: Dict[str, Callable] = {
-    "resourceflavors": lambda rt: rt.cache.flavors,
-    "clusterqueues": lambda rt: rt.cache.cluster_queues,
-    "localqueues": lambda rt: rt.cache.local_queues,
-    "workloads": lambda rt: rt.workloads,
-    "cohorts": lambda rt: rt.cache.cohorts,
-    "admissionchecks": lambda rt: rt.cache.admission_checks,
-    "topologies": lambda rt: rt.cache.topologies,
-    "workloadpriorityclasses": lambda rt: rt.cache.priority_classes,
+    "limitranges": _Section(
+        ser.limit_range_from_dict, ser.limit_range_to_dict, "add_limit_range",
+        lambda rt: rt.limit_ranges, namespaced=True,
+    ),
+    "runtimeclasses": _Section(
+        ser.runtime_class_from_dict, ser.runtime_class_to_dict,
+        "add_runtime_class",
+        lambda rt: rt.runtime_classes,
+    ),
 }
 
 
@@ -241,7 +244,7 @@ class KueueServer:
         sec = _SECTIONS.get(section)
         if sec is None:
             return None
-        model = sec.store(
+        model = sec.lookup(
             self.runtime, obj.get("namespace", ""), obj.get("name", "")
         )
         return sec.to_dict(model) if model is not None else None
@@ -308,10 +311,10 @@ class KueueServer:
         sec = _SECTIONS.get(section)
         if sec is None:
             raise ApiError(404, f"unknown section {section!r}")
-        store = _LISTERS[section]
         with self.lock:
             items = [
-                sec.to_dict(m) for _, m in sorted(store(self.runtime).items())
+                sec.to_dict(m)
+                for _, m in sorted(sec.store_map(self.runtime).items())
             ]
         return {"items": items}
 

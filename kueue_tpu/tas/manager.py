@@ -26,14 +26,21 @@ from typing import Dict, Optional
 from kueue_tpu.models import ClusterQueue, ResourceFlavor, Workload
 from kueue_tpu.models.workload import PodSet
 from kueue_tpu.core.flavor_assigner import AssignmentResult, GranularMode
+from kueue_tpu.core.workload_info import quota_per_pod
 from kueue_tpu.tas.cache import TASCache
 from kueue_tpu.tas.snapshot import TASPodSetRequest
 
 
 class TASManager:
-    def __init__(self, tas_cache: TASCache, flavors: Dict[str, ResourceFlavor]):
+    def __init__(
+        self,
+        tas_cache: TASCache,
+        flavors: Dict[str, ResourceFlavor],
+        transform=None,  # ResourceTransformConfig (quota view)
+    ):
         self.tas_cache = tas_cache
         self.flavors = flavors
+        self.transform = transform
         # snapshots cached per TASCache generation: one build per state
         # change instead of one per nominated workload
         self._snapshots = {}
@@ -136,7 +143,12 @@ class TASManager:
                 TASPodSetRequest(
                     podset_name=psr.name,
                     count=psr.count,
-                    single_pod_requests=dict(ps.requests),
+                    # topology capacity must count what pods actually
+                    # consume on nodes: requests + RuntimeClass overhead
+                    # (+transformations), same as quota accounting
+                    single_pod_requests=dict(
+                        quota_per_pod(ps, self.transform)
+                    ),
                     topology_request=ps.topology_request,
                     tolerations=tuple(ps.tolerations),
                     implied=self._is_tas_implied(ps, cq),

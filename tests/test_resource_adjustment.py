@@ -1,0 +1,350 @@
+"""Resource adjustment pipeline: LimitRange defaulting/validation,
+RuntimeClass overhead, limits-as-requests, excludeResourcePrefixes and
+transformations — mirroring pkg/workload/resources.go and
+pkg/util/limitrange behaviors."""
+
+import pytest
+
+from kueue_tpu.models import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import ResourceGroup
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.core.limit_range import (
+    LimitRange,
+    LimitRangeItem,
+    RuntimeClass,
+    adjust_workload_resources,
+    summarize,
+    validate_limit_range,
+    validate_resources,
+)
+from kueue_tpu.core.workload_info import (
+    REPLACE,
+    RETAIN,
+    ResourceTransform,
+    ResourceTransformConfig,
+    quota_per_pod,
+)
+from kueue_tpu.controllers import ClusterRuntime
+
+
+def wl_with(ps: PodSet) -> Workload:
+    return Workload(namespace="ns", name="w", queue_name="lq", pod_sets=(ps,))
+
+
+class TestSummarize:
+    def test_merge_rules(self):
+        a = LimitRange(
+            namespace="ns", name="a",
+            items=[
+                LimitRangeItem.build(
+                    max={"cpu": "8"}, min={"cpu": "1"},
+                    default={"cpu": "4"}, default_request={"cpu": "2"},
+                )
+            ],
+        )
+        b = LimitRange(
+            namespace="ns", name="b",
+            items=[
+                LimitRangeItem.build(
+                    max={"cpu": "6"}, min={"cpu": "2"},
+                    default={"cpu": "3"}, default_request={"cpu": "1"},
+                )
+            ],
+        )
+        s = summarize([a, b])["Container"]
+        assert s.max == {"cpu": 6000}  # keep-min
+        assert s.min == {"cpu": 2000}  # keep-max
+        assert s.default == {"cpu": 4000}  # keep-first
+        assert s.default_request == {"cpu": 2000}
+
+
+class TestAdjust:
+    def test_limit_range_defaults_applied(self):
+        lr = LimitRange(
+            namespace="ns", name="lr",
+            items=[
+                LimitRangeItem.build(
+                    default={"cpu": "4"}, default_request={"cpu": "2"}
+                )
+            ],
+        )
+        wl = wl_with(PodSet.build("main", 1, {}))
+        adjust_workload_resources(wl, [lr])
+        assert wl.pod_sets[0].requests == {"cpu": 2000}
+        assert wl.pod_sets[0].limits == {"cpu": 4000}
+
+    def test_limits_used_as_missing_requests(self):
+        wl = wl_with(
+            PodSet.build("main", 1, {"cpu": "1"}, limits={"cpu": "2", "memory": "1Gi"})
+        )
+        adjust_workload_resources(wl, [])
+        # cpu request explicit; memory request defaulted from its limit
+        assert wl.pod_sets[0].requests == {"cpu": 1000, "memory": 1 << 30}
+
+    def test_runtime_class_overhead_filled(self):
+        wl = wl_with(
+            PodSet.build("main", 1, {"cpu": "1"}, runtime_class_name="gvisor")
+        )
+        adjust_workload_resources(
+            wl, [], {"gvisor": RuntimeClass.build("gvisor", {"cpu": "250m"})}
+        )
+        assert wl.pod_sets[0].overhead == {"cpu": 250}
+        # explicit overhead is never overwritten
+        wl2 = wl_with(
+            PodSet.build(
+                "main", 1, {"cpu": "1"}, runtime_class_name="gvisor",
+                overhead={"cpu": "100m"},
+            )
+        )
+        adjust_workload_resources(
+            wl2, [], {"gvisor": RuntimeClass.build("gvisor", {"cpu": "250m"})}
+        )
+        assert wl2.pod_sets[0].overhead == {"cpu": 100}
+
+    def test_other_namespace_limit_range_ignored(self):
+        lr = LimitRange(
+            namespace="other", name="lr",
+            items=[LimitRangeItem.build(default_request={"cpu": "2"})],
+        )
+        wl = wl_with(PodSet.build("main", 1, {}))
+        adjust_workload_resources(wl, [lr])
+        assert wl.pod_sets[0].requests == {}
+
+
+class TestValidate:
+    def test_requests_exceed_limits(self):
+        wl = wl_with(PodSet.build("main", 1, {"cpu": "4"}, limits={"cpu": "2"}))
+        errs = validate_resources(wl)
+        assert errs and "must not exceed" in errs[0]
+        assert validate_resources(
+            wl_with(PodSet.build("main", 1, {"cpu": "1"}, limits={"cpu": "2"}))
+        ) == []
+
+    def test_limit_range_bounds(self):
+        lr = LimitRange(
+            namespace="ns", name="lr",
+            items=[LimitRangeItem.build(max={"cpu": "4"}, min={"cpu": "1"})],
+        )
+        over = wl_with(PodSet.build("main", 1, {"cpu": "8"}))
+        under = wl_with(PodSet.build("main", 1, {"cpu": "500m"}))
+        ok = wl_with(PodSet.build("main", 1, {"cpu": "2"}))
+        assert any("above" in e for e in validate_limit_range(over, [lr]))
+        assert any("below" in e for e in validate_limit_range(under, [lr]))
+        assert validate_limit_range(ok, [lr]) == []
+
+    def test_pod_type_includes_overhead(self):
+        lr = LimitRange(
+            namespace="ns", name="lr",
+            items=[LimitRangeItem.build(type="Pod", max={"cpu": "4"})],
+        )
+        wl = wl_with(
+            PodSet.build("main", 1, {"cpu": "3800m"}, overhead={"cpu": "500m"})
+        )
+        assert any("above" in e for e in validate_limit_range(wl, [lr]))
+
+
+class TestTransform:
+    def test_retain_and_replace(self):
+        cfg = ResourceTransformConfig(
+            transformations={
+                "nvidia.com/mig-1g.5gb": ResourceTransform(
+                    outputs={"example.com/gpu-units": 1, "example.com/gpu-mem": 5},
+                    strategy=REPLACE,
+                ),
+                "cpu": ResourceTransform(
+                    outputs={"example.com/credits": 2}, strategy=RETAIN
+                ),
+            }
+        )
+        ps = PodSet(
+            name="main", count=1,
+            requests={"nvidia.com/mig-1g.5gb": 2, "cpu": 3},
+        )
+        out = quota_per_pod(ps, cfg)
+        assert out == {
+            "example.com/gpu-units": 2,
+            "example.com/gpu-mem": 10,
+            "cpu": 3,
+            "example.com/credits": 6,
+        }
+
+    def test_exclude_prefixes(self):
+        cfg = ResourceTransformConfig(exclude_prefixes=("networking.example.com/",))
+        ps = PodSet(
+            name="main", count=1,
+            requests={"cpu": 1, "networking.example.com/vpc": 1},
+        )
+        assert quota_per_pod(ps, cfg) == {"cpu": 1}
+
+    def test_overhead_added_to_quota_view(self):
+        ps = PodSet(name="main", count=1, requests={"cpu": 1000}, overhead={"cpu": 250})
+        assert quota_per_pod(ps) == {"cpu": 1250}
+
+    def test_fast_path_returns_spec_requests(self):
+        ps = PodSet(name="main", count=1, requests={"cpu": 1000})
+        assert quota_per_pod(ps) is ps.requests
+
+
+def _runtime(**kw):
+    rt = ClusterRuntime(**kw)
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq",
+            namespace_selector={},
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu",), (FlavorQuotas.build("default", {"cpu": "10"}),)
+                ),
+            ),
+        )
+    )
+    rt.add_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+    return rt
+
+
+class TestJobEquivalence:
+    def test_limit_range_defaults_do_not_churn_job_workloads(self):
+        """A LimitRange default applied at workload ingress must not
+        make the job reconciler see its workload as stale (delete/
+        recreate loop): equivalence compares adjusted-vs-adjusted."""
+        from kueue_tpu.controllers.jobs.batch_job import BatchJob
+
+        rt = _runtime()
+        rt.add_limit_range(
+            LimitRange(
+                namespace="ns", name="lr",
+                items=[
+                    LimitRangeItem.build(default_request={"memory": "1Gi"})
+                ],
+            )
+        )
+        job = BatchJob.build(
+            "ns", "train", "lq", parallelism=1, requests={"cpu": "1"}
+        )
+        rt.add_job(job)
+        rt.run_until_idle()
+        created = sum(1 for e in rt.job_reconciler.events if e.kind == "CreatedWorkload")
+        deleted = sum(1 for e in rt.job_reconciler.events if e.kind == "DeletedWorkload")
+        assert created == 1 and deleted == 0
+        wl = rt.workloads[
+            f"ns/{rt.job_reconciler.workload_name_for(job)}"
+        ]
+        # memory quota only admits if within CQ... cq has no memory
+        # quota, so just assert the workload is stable and unsuspended
+        # decisions aside, and the adjusted requests stuck
+        assert wl.pod_sets[0].requests.get("memory") == 1 << 30
+
+
+class TestRuntimeIntegration:
+    def test_adjustment_at_ingress_then_admission(self):
+        rt = _runtime()
+        rt.add_limit_range(
+            LimitRange(
+                namespace="ns", name="lr",
+                items=[LimitRangeItem.build(default_request={"cpu": "2"})],
+            )
+        )
+        rt.add_runtime_class(RuntimeClass.build("rtc", {"cpu": "1"}))
+        wl = wl_with(PodSet.build("main", 1, {}, runtime_class_name="rtc"))
+        rt.add_workload(wl)
+        rt.run_until_idle()
+        # defaulted to 2 cpu + 1 cpu overhead => 3 cpu charged
+        assert wl.is_admitted
+        assert wl.admission.pod_set_assignments[0].resource_usage == {"cpu": 3000}
+
+    def test_limit_range_violation_is_inadmissible(self):
+        rt = _runtime()
+        rt.add_limit_range(
+            LimitRange(
+                namespace="ns", name="lr",
+                items=[LimitRangeItem.build(max={"cpu": "2"})],
+            )
+        )
+        wl = wl_with(PodSet.build("main", 1, {"cpu": "4"}))
+        rt.add_workload(wl)
+        rt.run_until_idle()
+        assert not wl.is_admitted
+        pq = rt.queues.cluster_queues["cq"]
+        assert wl.key in pq.inadmissible
+
+    def test_requests_above_limits_inadmissible(self):
+        rt = _runtime()
+        wl = wl_with(PodSet.build("main", 1, {"cpu": "4"}, limits={"cpu": "2"}))
+        rt.add_workload(wl)
+        rt.run_until_idle()
+        assert not wl.is_admitted
+
+    def test_transform_affects_quota_not_spec(self):
+        from kueue_tpu.config import ResourceSettings
+
+        rt = _runtime(
+            resources=ResourceSettings(
+                transformations={
+                    "example.com/accel": {
+                        "strategy": "Replace",
+                        "outputs": {"cpu": 2.0},
+                    }
+                }
+            )
+        )
+        wl = wl_with(PodSet(name="main", count=1, requests={"example.com/accel": 3}))
+        rt.add_workload(wl)
+        rt.run_until_idle()
+        assert wl.is_admitted
+        # quota charged on the transformed resource (3 accel -> 6 cpu
+        # canonical units)
+        assert wl.admission.pod_set_assignments[0].resource_usage == {"cpu": 6}
+        # the spec keeps the original resource
+        assert wl.pod_sets[0].requests == {"example.com/accel": 3}
+
+    def test_transform_solver_parity(self):
+        """Device solver and host assigner agree under transformations."""
+        from kueue_tpu.config import ResourceSettings
+
+        decisions = {}
+        for use_solver in (False, True):
+            rt = _runtime(
+                resources=ResourceSettings(
+                    exclude_resource_prefixes=("ignored.example.com/",),
+                    transformations={
+                        "example.com/accel": {
+                            "strategy": "Replace",
+                            "outputs": {"cpu": 2000.0},
+                        }
+                    },
+                ),
+                use_solver=use_solver,
+                solver_threshold=1,
+            )
+            for i in range(6):
+                rt.add_workload(
+                    Workload(
+                        namespace="ns", name=f"w{i}", queue_name="lq",
+                        priority=i, creation_time=float(i),
+                        pod_sets=(
+                            PodSet(
+                                name="main", count=1,
+                                requests={
+                                    "example.com/accel": 2,
+                                    "ignored.example.com/x": 5,
+                                },
+                            ),
+                        ),
+                    )
+                )
+            rt.run_until_idle()
+            decisions[use_solver] = sorted(
+                name for name, wl in (
+                    (w.name, w) for w in rt.workloads.values()
+                ) if wl.is_admitted
+            )
+        assert decisions[False] == decisions[True]
+        # 10 cpu quota / 4 cpu per wl -> 2 admitted (highest priority)
+        assert decisions[True] == ["w4", "w5"]
