@@ -81,13 +81,18 @@ class ClusterRuntime:
 
         tas_check = tas_assign = tas_fits = None
         self.tas_manager = None
+        self.node_controller = None
+        self.topology_ungater = None
         if tas_cache is not None:
             from kueue_tpu.tas import TASManager
+            from kueue_tpu.controllers.tas import NodeController, TopologyUngater
 
             self.cache.tas_cache = tas_cache
             self.tas_manager = TASManager(
                 tas_cache, self.cache.flavors, transform=self.transform_config
             )
+            self.node_controller = NodeController(tas_cache)
+            self.topology_ungater = TopologyUngater()
             tas_check = self.tas_manager.check
             tas_assign = self.tas_manager.assign
             tas_fits = self.tas_manager.fits
@@ -237,6 +242,15 @@ class ClusterRuntime:
     def add_priority_class(self, pc: WorkloadPriorityClass) -> None:
         self.cache.add_or_update_priority_class(pc)
 
+    # ---- nodes (TAS capacity; resource_flavor.go node watch) ----
+    def add_node(self, node) -> None:
+        if self.node_controller is not None:
+            self.node_controller.add_or_update_node(node)
+
+    def delete_node(self, name: str) -> None:
+        if self.node_controller is not None:
+            self.node_controller.delete_node(name)
+
     # ---- resource adjustment objects ----
     def add_limit_range(self, lr) -> None:
         self.limit_ranges[lr.key] = lr
@@ -319,6 +333,10 @@ class ClusterRuntime:
     def delete_workload(self, wl: Workload) -> None:
         self.workloads.pop(wl.key, None)
         self.queues.delete_workload(wl)
+        if self.topology_ungater is not None:
+            # drop any outstanding ungate expectations: a recreated
+            # workload under the same key must not inherit the barrier
+            self.topology_ungater.expectations.forget(wl.key)
         if self.cache.delete_workload(wl):
             self.queues.queue_associated_inadmissible_workloads_after(
                 wl.admission.cluster_queue if wl.admission else ""
@@ -334,6 +352,9 @@ class ClusterRuntime:
         """workload.UnsetQuotaReservationWithCondition + requeue."""
         now = self.clock.now()
         cq_name = wl.admission.cluster_queue if wl.admission else ""
+        if self.topology_ungater is not None:
+            # eviction invalidates the old assignment's pending ungates
+            self.topology_ungater.expectations.forget(wl.key)
         if self.cache.delete_workload(wl):
             self.queues.queue_associated_inadmissible_workloads_after(cq_name)
         wl.admission = None
@@ -384,6 +405,22 @@ class ClusterRuntime:
             self.workload_reconciler.reconcile(wl)
             for ctrl in self.admission_check_controllers:
                 ctrl(wl)
+        if self.topology_ungater is not None:
+            self._run_topology_ungater()
+
+    def _run_topology_ungater(self) -> None:
+        """Per TAS-admitted pod-group workload: deliver last pass's pod
+        events (the informer echo), then reconcile the ungater."""
+        from kueue_tpu.controllers.jobs.pod import PodGroup
+
+        for job in list(self.jobs.values()):
+            if not isinstance(job, PodGroup):
+                continue
+            wl = self.workloads.get(self._wl_key_for_job(job))
+            if wl is None:
+                continue
+            self.topology_ungater.observe_job(wl.key, job)
+            self.topology_ungater.reconcile(wl, job)
 
     def _state_fingerprint(self):
         parts = []

@@ -144,7 +144,14 @@ class JobReconciler:
 
         from kueue_tpu.core.limit_range import adjust_workload_resources
 
-        podsets = [copy.copy(ps) for ps in job.pod_sets()]
+        raw = job.pod_sets()
+        if (
+            not self.runtime.limit_ranges
+            and not self.runtime.runtime_classes
+            and not any(ps.limits for ps in raw)
+        ):
+            return list(raw)  # nothing can adjust: skip the probe build
+        podsets = [copy.copy(ps) for ps in raw]
         for ps in podsets:
             ps.requests = dict(ps.requests)
             ps.limits = dict(ps.limits)
@@ -219,8 +226,29 @@ class JobReconciler:
                         )
                     )
             infos.append(info)
+        self._inject_topology_gates(job, wl)
         job.run_with_podsets_info(infos)
         self._event("Started", job, f"Admitted by clusterQueue {wl.admission.cluster_queue}")
+
+    @staticmethod
+    def _inject_topology_gates(job: GenericJob, wl: Workload) -> None:
+        """Pod webhook analog (pod_webhook.go:192-201): pods of podsets
+        admitted with a TopologyAssignment carry the topology
+        scheduling gate; the TAS ungater releases them per domain."""
+        from kueue_tpu.controllers.jobs.pod import PodGroup
+
+        if not isinstance(job, PodGroup):
+            return
+        tas_podsets = {
+            psa.name
+            for psa in wl.admission.pod_set_assignments
+            if psa.topology_assignment is not None
+        }
+        if not tas_podsets:
+            return
+        for p in job.observed():
+            if p.role in tas_podsets and p.phase == "Pending":
+                p.topology_gate = True
 
     # ---- the reconcile (reconciler.go:234-561) ----
     def reconcile(self, job: GenericJob) -> None:

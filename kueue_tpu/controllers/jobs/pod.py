@@ -40,6 +40,21 @@ class SimPod:
     gated: bool = True
     phase: str = POD_PENDING
     node_selector: Dict[str, str] = field(default_factory=dict)
+    # The kueue.x-k8s.io/topology scheduling gate (pod_webhook.go:192-201):
+    # injected for TAS workloads; removed per-domain by the topology
+    # ungater (controllers/tas.py), NOT by admission.
+    topology_gate: bool = False
+    # rank-ordered placement (job completion index etc.)
+    rank: Optional[int] = None
+    uid: str = ""
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = f"uid-{self.name}-{id(self):x}"
+
+    @property
+    def schedulable(self) -> bool:
+        return not self.gated and not self.topology_gate
 
     @staticmethod
     def build(name, requests=None, **kw) -> "SimPod":
@@ -126,8 +141,10 @@ class PodGroup(GenericJob):
                 merged = dict(p.node_selector)
                 merged.update(info.node_selector)
                 p.node_selector = merged
-            p.gated = False  # topology_ungater / admission ungate
-            if p.phase == POD_PENDING:
+            p.gated = False  # the admission gate lifts at start
+            # topology-gated pods stay Pending until the ungater
+            # removes the topology gate per domain assignment
+            if p.phase == POD_PENDING and p.schedulable:
                 p.phase = POD_RUNNING
 
     def restore_podsets_info(self, infos: Sequence[PodSetInfo]) -> bool:

@@ -89,6 +89,10 @@ def level_counts(topo: TASTopology, counts: jnp.ndarray) -> Tuple[jnp.ndarray, .
     return tuple(out)
 
 
+# jitted CountIn used by TASFlavorSnapshot above DEVICE_LEAF_THRESHOLD
+leaf_counts_jit = jax.jit(leaf_counts)
+
+
 @jax.jit
 def fill_in_counts(
     topo: TASTopology,

@@ -210,7 +210,8 @@ class TASManager:
             req = TASPodSetRequest(
                 podset_name=psr.name,
                 count=psr.count,
-                single_pod_requests=dict(ps.requests),
+                # same quota view as assign(): overhead + transformations
+                single_pod_requests=dict(quota_per_pod(ps, self.transform)),
                 topology_request=ps.topology_request,
                 tolerations=tuple(ps.tolerations),
                 flavor=flavor_name,

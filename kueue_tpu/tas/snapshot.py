@@ -120,6 +120,8 @@ class TASFlavorSnapshot:
         self._free_map: Dict[str, Dict[str, int]] = {}
         self._tas_usage_map: Dict[str, Dict[str, int]] = {}
         self._taints_map: Dict[str, Tuple] = {}
+        # dense device view, built lazily on first device-path use
+        self._topo_dev = None
 
     # ---- node ingest (tas_flavor_snapshot.go:138-220) ----
     def is_lowest_level_hostname(self) -> bool:
@@ -185,6 +187,7 @@ class TASFlavorSnapshot:
     def freeze(self) -> None:
         if self._frozen:
             return
+        self._topo_dev = None  # device view rebuilt with the host arrays
         self.domains = {}
         self.roots = {}
         self.domains_per_level = [{} for _ in self.level_keys]
@@ -234,6 +237,70 @@ class TASFlavorSnapshot:
         parent.children.append(dom)
 
     # ---- phase 1: fillInCounts (:647-690) ----
+    # Leaf count above which phase-1 CountIn runs on the accelerator
+    # (ops/tas_kernel.leaf_counts) instead of host numpy. The numpy
+    # reduction is O(L*R) and beats a device dispatch for small
+    # topologies — on a REMOTE-attached TPU each dispatch+fetch pays a
+    # ~100ms+ tunnel round trip, so the threshold is deliberately high:
+    # it pays off for fleet-scale topologies (10^5+ leaves) or on-die
+    # deployments. Tests drop it to exercise device/host parity.
+    DEVICE_LEAF_THRESHOLD = 100_000
+
+    def _leaf_counts_device(
+        self,
+        requests: Dict[str, int],
+        assumed_usage: Dict[str, Dict[str, int]],
+        simulate_empty: bool,
+        tolerations: Tuple[Toleration, ...],
+    ) -> np.ndarray:
+        """Jit twin of the host CountIn (decision-identical; parity
+        asserted in tests/test_tas.py). Requests naming a resource no
+        node carries short-circuit to zeros (host semantics)."""
+        from kueue_tpu._jax import jnp
+        from kueue_tpu.ops import tas_kernel
+
+        if self._topo_dev is None:
+            self._topo_dev = tas_kernel.topology_from_snapshot(self)
+        topo = self._topo_dev
+        n_l = len(self._leaf_order)
+        r_index = {r: j for j, r in enumerate(self._resources)}
+
+        req = np.zeros(len(self._resources), dtype=np.int64)
+        for r, v in requests.items():
+            if v == 0:
+                continue
+            j = r_index.get(r)
+            if j is None:
+                return np.zeros(n_l, dtype=np.int64)
+            req[j] = v
+
+        assumed = np.zeros((n_l, len(self._resources)), dtype=np.int64)
+        for did, usage in assumed_usage.items():
+            leaf = self.leaves.get(did)
+            if leaf is None:
+                continue
+            for r, v in usage.items():
+                j = r_index.get(r)
+                if j is not None:
+                    assumed[leaf.leaf_idx, j] += v
+
+        taint_ok = np.ones(n_l, dtype=bool)
+        if self.is_lowest_level_hostname():
+            for i, taints in enumerate(self._leaf_taints):
+                if taints and not taints_tolerated(taints, tolerations):
+                    taint_ok[i] = False
+
+        counts = np.asarray(
+            tas_kernel.leaf_counts_jit(
+                topo,
+                jnp.asarray(req[None, :]),
+                jnp.asarray(assumed[None, :, :]),
+                jnp.asarray(taint_ok[None, :]),
+                jnp.asarray(np.array([simulate_empty])),
+            )
+        )[0]
+        return counts
+
     def _leaf_counts(
         self,
         requests: Dict[str, int],
@@ -244,6 +311,10 @@ class TASFlavorSnapshot:
         """Vectorized CountIn over all leaves. Returns int64[L]."""
         self.freeze()
         n_l = len(self._leaf_order)
+        if n_l >= self.DEVICE_LEAF_THRESHOLD:
+            return self._leaf_counts_device(
+                requests, assumed_usage, simulate_empty, tolerations
+            )
         remaining = self._free.copy()
         if not simulate_empty:
             remaining -= self._tas_usage
