@@ -35,21 +35,66 @@ from kueue_tpu.models.constants import (
 
 @dataclass
 class MultiKueueCluster:
-    """multikueue_types.go:61-137 — one worker cluster."""
+    """multikueue_types.go:61-137 — one worker cluster.
+
+    ``runtime`` (an in-process ClusterRuntime) or ``transport`` (any
+    RemoteTransport — HTTPTransport for a real remote control plane)
+    names the wire; the controller attaches a RemoteClient that owns
+    the reconnect/backoff state machine. ``mark_lost``/``mark_connected``
+    force the state (tests; the production path flips it from observed
+    transport failures/successes)."""
 
     name: str
-    runtime: object  # the remote ClusterRuntime ("kubeconfig client")
-    active: bool = True  # connectivity (remoteClient reconnect state)
-    lost_since: Optional[float] = None
+    runtime: object = None  # legacy in-process shorthand
+    transport: object = None  # RemoteTransport
+    client: object = None  # RemoteClient, attached by the controller
+
+    def __post_init__(self):
+        if self.transport is None and self.runtime is not None:
+            from kueue_tpu.admissionchecks.multikueue_transport import (
+                InProcessTransport,
+            )
+
+            self.transport = InProcessTransport(self.runtime)
+        elif self.runtime is None and self.transport is not None:
+            self.runtime = self.transport.runtime
+
+    @property
+    def active(self) -> bool:
+        return self.client.active if self.client is not None else True
+
+    @property
+    def lost_since(self) -> Optional[float]:
+        return self.client.lost_since if self.client is not None else None
+
+    def _flaky(self):
+        from kueue_tpu.admissionchecks.multikueue_transport import (
+            FlakyTransport,
+        )
+
+        if not isinstance(self.transport, FlakyTransport):
+            self.transport = FlakyTransport(self.transport)
+            if self.client is not None:
+                self.client.transport = self.transport
+        return self.transport
 
     def mark_lost(self, now: float) -> None:
-        if self.active:
-            self.active = False
-            self.lost_since = now
+        """Take the wire down (fault injection) and flip the client's
+        state — subsequent calls fail until mark_connected."""
+        self._flaky().down = True
+        if self.client is not None and self.client.active:
+            self.client.active = False
+            self.client.lost_since = now
+            self.client.failed_attempts = 1
+            self.client.next_retry_at = now + self.client.base_backoff_s
 
     def mark_connected(self) -> None:
-        self.active = True
-        self.lost_since = None
+        self._flaky().down = False
+        if self.client is not None:
+            self.client._record_success()
+
+    def call(self, op: str, *args):
+        return self.client.call(op, *args)
 
 
 @dataclass
@@ -109,22 +154,56 @@ class MultiKueueController:
         adapters: Optional[Dict[str, MultiKueueAdapter]] = None,
         worker_lost_timeout: float = 900.0,  # config multiKueue.workerLostTimeout
         origin: str = "local",
+        batch_dispatch: bool = False,
+        base_backoff_s: float = 1.0,
+        max_backoff_s: float = 300.0,
+        gc_interval_s: float = 60.0,  # config multiKueue.gcInterval
     ):
         self.runtime = runtime
-        self.clusters = clusters or {}
+        self.clusters = {}
         self.configs = configs or {}
         self.adapters = adapters or {"Job": BatchJobAdapter()}
         self.worker_lost_timeout = worker_lost_timeout
         self.origin = origin
+        # Batched cross-cluster dispatch: remote creates accumulate per
+        # cluster during a reconcile pass and go out in ONE transport
+        # exchange per cluster on flush() (the runtime loop calls it
+        # after each pass) — amortizing per-request DCN latency the way
+        # the drain amortizes device dispatches.
+        self.batch_dispatch = batch_dispatch
+        self.base_backoff_s = base_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._create_buffer: Dict[str, List[Workload]] = {}
+        # pass-boundary detection for the lazy flush backstop
+        self._seen_this_pass: set = set()
+        self.gc_interval_s = gc_interval_s
+        self._last_gc = float("-inf")
         # workload key -> winning cluster name
         self._reserving: Dict[str, str] = {}
         # workload key -> clusters that ever received copies; non-winner
         # members are cleaned up as soon as they are reachable (covers a
         # lost winner reconnecting after the workload moved elsewhere)
         self._dispatched: Dict[str, set] = {}
+        for cluster in (clusters or {}).values():
+            self.add_cluster(cluster)
+
+    def __call__(self, wl: Workload) -> None:
+        """Registered directly on runtime.admission_check_controllers."""
+        self.reconcile(wl)
 
     # ---- wiring ----
     def add_cluster(self, cluster: MultiKueueCluster) -> None:
+        if cluster.client is None:
+            from kueue_tpu.admissionchecks.multikueue_transport import (
+                RemoteClient,
+            )
+
+            cluster.client = RemoteClient(
+                cluster.transport,
+                self.runtime.clock,
+                base_backoff_s=self.base_backoff_s,
+                max_backoff_s=self.max_backoff_s,
+            )
         self.clusters[cluster.name] = cluster
 
     def add_config(self, cfg: MultiKueueConfig) -> None:
@@ -154,8 +233,9 @@ class MultiKueueController:
                 return job
         return None
 
-    @staticmethod
-    def _remote_copy(wl: Workload) -> Workload:
+    def _remote_copy(self, wl: Workload) -> Workload:
+        from kueue_tpu.admissionchecks.multikueue_transport import ORIGIN_LABEL
+
         return Workload(
             namespace=wl.namespace,
             name=wl.name,
@@ -165,10 +245,31 @@ class MultiKueueController:
             priority_class_name=wl.priority_class_name,
             priority_class_source=wl.priority_class_source,
             creation_time=wl.creation_time,
+            labels={ORIGIN_LABEL: self.origin},
         )
+
+    def _unbuffer(self, wl_key: str) -> None:
+        """Drop pending batched creates for a workload whose dispatch
+        intent is gone (deleted/finished/un-reserved locally) — a stale
+        buffered create must never materialize an orphan remote."""
+        for batch in self._create_buffer.values():
+            batch[:] = [w for w in batch if w.key != wl_key]
 
     # ---- reconcile (workload.go:159-425) ----
     def reconcile(self, wl: Workload) -> None:
+        from kueue_tpu.admissionchecks.multikueue_transport import (
+            ClusterUnreachable,
+            RemoteRejected,
+        )
+
+        # lazy flush backstop: reaching the same workload again means a
+        # new pass started (covers bound-method registration where the
+        # runtime's flush hook can't fire)
+        if self.batch_dispatch and wl.key in self._seen_this_pass:
+            self.flush()
+            self._seen_this_pass.clear()
+        self._seen_this_pass.add(wl.key)
+
         checks = self._relevant_checks(wl)
         if not checks:
             return
@@ -180,10 +281,12 @@ class MultiKueueController:
         adapter = self.adapters.get(job.kind if job is not None else "Job")
 
         if wl.is_finished:
+            self._unbuffer(wl.key)
             self._gc_remotes(wl, clusters, job, adapter)
             return
         if not wl.has_quota_reservation:
             # reservation lost locally: drop remote copies
+            self._unbuffer(wl.key)
             self._gc_remotes(wl, clusters, job, adapter)
             self._reserving.pop(wl.key, None)
             return
@@ -193,61 +296,153 @@ class MultiKueueController:
         winner_name = self._reserving.get(wl.key)
         if winner_name is not None:
             cluster = self.clusters.get(winner_name)
-            if cluster is None or not cluster.active:
-                lost_for = (
-                    now - cluster.lost_since
-                    if cluster is not None and cluster.lost_since is not None
-                    else self.worker_lost_timeout
-                )
-                if lost_for >= self.worker_lost_timeout:
-                    # worker lost: requeue locally (workload.go:421-425)
-                    self._reserving.pop(wl.key, None)
-                    state.state = AdmissionCheckStateType.RETRY
-                    state.message = f"Worker cluster {winner_name} lost"
-                    self.runtime.event("MultiKueueClusterLost", wl, winner_name)
-                return
-            self._sync_winner(wl, state, cluster, job, adapter)
+            if cluster is not None and cluster.client.reachable():
+                # sync doubles as the reconnect probe: success restores
+                # the cluster, failure records it and falls to the timer
+                self._sync_winner(wl, state, cluster, job, adapter)
+                if cluster.active:
+                    return
+            lost_for = (
+                now - cluster.lost_since
+                if cluster is not None and cluster.lost_since is not None
+                else self.worker_lost_timeout
+            )
+            if lost_for >= self.worker_lost_timeout:
+                # worker lost: requeue locally (workload.go:421-425)
+                self._reserving.pop(wl.key, None)
+                state.state = AdmissionCheckStateType.RETRY
+                state.message = f"Worker cluster {winner_name} lost"
+                self.runtime.event("MultiKueueClusterLost", wl, winner_name)
             return
 
         # no winner yet: ensure remote copies exist, look for a reserver
+        reserving = []
         for cluster in clusters:
-            if not cluster.active:
+            if not cluster.client.reachable():
                 continue
-            remote = cluster.runtime
-            rwl = remote.workloads.get(wl.key)
-            if rwl is None:
-                remote.add_workload(self._remote_copy(wl))
-            self._dispatched.setdefault(wl.key, set()).add(cluster.name)
-
-        reserving = [
-            c for c in clusters
-            if c.active
-            and (rwl := c.runtime.workloads.get(wl.key)) is not None
-            and rwl.has_quota_reservation
-        ]
+            try:
+                rwl = cluster.call("get_workload", wl.key)
+                if rwl is None:
+                    copy = self._remote_copy(wl)
+                    if self.batch_dispatch:
+                        buf = self._create_buffer.setdefault(cluster.name, [])
+                        if all(w.key != copy.key for w in buf):
+                            buf.append(copy)
+                    else:
+                        cluster.call("create_workload", copy)
+                self._dispatched.setdefault(wl.key, set()).add(cluster.name)
+                if rwl is not None and rwl.has_quota_reservation:
+                    reserving.append(cluster)
+            except ClusterUnreachable:
+                continue
+            except RemoteRejected as e:
+                # the remote refused this object (its webhook chain):
+                # per-workload condition, not a connectivity event
+                state.state = AdmissionCheckStateType.PENDING
+                state.message = f"Rejected by {cluster.name}: {e}"
+                self.runtime.event("MultiKueueRejected", wl, str(e))
+                continue
         if not reserving:
-            state.state = AdmissionCheckStateType.PENDING
-            state.message = "The workload is pending reservation in the worker clusters"
+            if state.state != AdmissionCheckStateType.PENDING:
+                state.state = AdmissionCheckStateType.PENDING
+                state.message = (
+                    "The workload is pending reservation in the worker clusters"
+                )
             return
 
         winner = reserving[0]  # FirstReserving wins (workload.go:381)
         self._reserving[wl.key] = winner.name
         for cluster in clusters:
-            if cluster.name != winner.name and cluster.active:
-                self._delete_remote(cluster.runtime, wl.key)
+            if cluster.name != winner.name:
+                self._delete_on(cluster, wl.key, job, adapter)
         self.runtime.event("MultiKueueReserved", wl, winner.name)
         self._sync_winner(wl, state, winner, job, adapter)
 
+    def flush(self) -> None:
+        """Send buffered remote creates, one batched exchange per
+        cluster (batched cross-cluster dispatch)."""
+        from kueue_tpu.admissionchecks.multikueue_transport import (
+            ClusterUnreachable,
+            RemoteRejected,
+        )
+
+        for name, batch in list(self._create_buffer.items()):
+            cluster = self.clusters.get(name)
+            if cluster is None:
+                del self._create_buffer[name]  # cluster removed: drop
+                continue
+            if not batch or not cluster.client.reachable():
+                continue
+            try:
+                cluster.call("create_workloads", batch)
+                self._create_buffer[name] = []
+            except ClusterUnreachable:
+                pass  # retried next pass; dispatch sets keep the intent
+            except RemoteRejected:
+                # some object in the batch was refused: resolve per-item
+                # (rejected items drop; unreachable keeps the remainder)
+                remaining = list(batch)
+                while remaining:
+                    w = remaining[0]
+                    try:
+                        cluster.call("create_workload", w)
+                    except RemoteRejected:
+                        pass  # refused: dropped (reconcile re-reports)
+                    except ClusterUnreachable:
+                        break
+                    remaining.pop(0)
+                self._create_buffer[name] = remaining
+        self._seen_this_pass.clear()
+        # periodic orphan GC (multiKueue.gcInterval; workload.go GC of
+        # remote objects whose local owner is gone)
+        now = self.runtime.clock.now()
+        if now - self._last_gc >= self.gc_interval_s:
+            self._last_gc = now
+            self.gc_orphans()
+
+    def gc_orphans(self) -> int:
+        """Delete remote workloads labeled with this origin whose local
+        owner no longer exists (workload.go orphan GC under churn —
+        e.g. the local workload deleted while the worker was lost)."""
+        from kueue_tpu.admissionchecks.multikueue_transport import (
+            ClusterUnreachable,
+        )
+
+        deleted = 0
+        for cluster in self.clusters.values():
+            if not cluster.client.reachable():
+                continue
+            try:
+                keys = cluster.call("list_workload_keys", self.origin)
+                for key in keys:
+                    if key not in self.runtime.workloads:
+                        cluster.call("delete_workload", key)
+                        deleted += 1
+                        self._dispatched.get(key, set()).discard(cluster.name)
+            except ClusterUnreachable:
+                continue
+        return deleted
+
     def _sync_winner(self, wl, state, cluster, job, adapter) -> None:
-        remote = cluster.runtime
-        rwl = remote.workloads.get(wl.key)
+        from kueue_tpu.admissionchecks.multikueue_transport import (
+            ClusterUnreachable,
+        )
+
+        try:
+            rwl = cluster.call("get_workload", wl.key)
+        except ClusterUnreachable:
+            return  # worker-lost timer runs in reconcile
         if rwl is None:
             # remote copy disappeared: retry from scratch
             self._reserving.pop(wl.key, None)
             state.state = AdmissionCheckStateType.PENDING
             state.message = "Remote workload lost; recreating"
             return
-        if job is not None and adapter is not None:
+        # job sync needs an in-process remote runtime (adapters operate
+        # on job objects; over the HTTP transport only workload dispatch
+        # and status sync-back flow — the remote kueue manages its jobs)
+        remote = cluster.transport.runtime
+        if job is not None and adapter is not None and remote is not None:
             adapter.sync_job(job, remote, wl)
             adapter.copy_status(job, remote)
         if rwl.is_finished:
@@ -265,38 +460,43 @@ class MultiKueueController:
             state.state = AdmissionCheckStateType.READY
             state.message = f'The workload got reservation on "{cluster.name}"'
 
+    def _delete_on(self, cluster, wl_key: str, job, adapter) -> bool:
+        """Remove the remote job + workload copy from one cluster.
+        True when the cluster acknowledged (dispatch intent cleared);
+        False when unreachable (retried once it reconnects)."""
+        from kueue_tpu.admissionchecks.multikueue_transport import (
+            ClusterUnreachable,
+        )
+
+        if cluster is None or not cluster.client.reachable():
+            return False
+        try:
+            if (
+                job is not None
+                and adapter is not None
+                and cluster.transport.runtime is not None
+            ):
+                adapter.delete_remote_job(job, cluster.transport.runtime)
+            cluster.call("delete_workload", wl_key)
+        except ClusterUnreachable:
+            return False
+        self._dispatched.get(wl_key, set()).discard(cluster.name)
+        return True
+
     def _cleanup_stale_dispatches(self, wl, job, adapter) -> None:
         """Delete copies on any reachable cluster that is not the
         current winner (workload.go:381-421 drop-others + GC of orphan
         remotes after reconnect)."""
         winner = self._reserving.get(wl.key)
-        dispatched = self._dispatched.get(wl.key, set())
-        for name in list(dispatched):
-            if name == winner:
-                continue
-            cluster = self.clusters.get(name)
-            if cluster is None or not cluster.active:
-                continue  # retried next reconcile once reachable
-            if winner is not None:
-                if job is not None and adapter is not None:
-                    adapter.delete_remote_job(job, cluster.runtime)
-                self._delete_remote(cluster.runtime, wl.key)
-                dispatched.discard(name)
-
-    def _delete_remote(self, remote, wl_key: str) -> None:
-        rwl = remote.workloads.get(wl_key)
-        if rwl is not None:
-            remote.delete_workload(rwl)
+        if winner is None:
+            return
+        for name in list(self._dispatched.get(wl.key, set())):
+            if name != winner:
+                self._delete_on(self.clusters.get(name), wl.key, job, adapter)
 
     def _gc_remotes(self, wl, clusters, job, adapter) -> None:
-        dispatched = self._dispatched.get(wl.key, set())
         for cluster in clusters:
-            if not cluster.active:
-                continue  # stays in _dispatched; cleaned on reconnect
-            if job is not None and adapter is not None:
-                adapter.delete_remote_job(job, cluster.runtime)
-            self._delete_remote(cluster.runtime, wl.key)
-            dispatched.discard(cluster.name)
+            self._delete_on(cluster, wl.key, job, adapter)
         self._reserving.pop(wl.key, None)
-        if not dispatched:
+        if not self._dispatched.get(wl.key):
             self._dispatched.pop(wl.key, None)

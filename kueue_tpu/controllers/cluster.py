@@ -405,6 +405,12 @@ class ClusterRuntime:
             self.workload_reconciler.reconcile(wl)
             for ctrl in self.admission_check_controllers:
                 ctrl(wl)
+        for ctrl in self.admission_check_controllers:
+            # controllers buffering cross-cluster writes (MultiKueue
+            # batched dispatch) flush once per pass
+            flush = getattr(ctrl, "flush", None)
+            if flush is not None:
+                flush()
         if self.topology_ungater is not None:
             self._run_topology_ungater()
 

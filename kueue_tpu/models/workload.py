@@ -148,6 +148,8 @@ class Workload:
     maximum_execution_time_seconds: Optional[int] = None
     creation_time: float = 0.0
     uid: str = ""
+    # object labels (kueue.x-k8s.io/multikueue-origin etc.)
+    labels: Dict[str, str] = field(default_factory=dict)
 
     # ---- status ----
     admission: Optional[Admission] = None

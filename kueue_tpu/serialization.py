@@ -334,6 +334,7 @@ def workload_to_dict(wl: Workload) -> dict:
     out = {
         "name": wl.name,
         "namespace": wl.namespace,
+        "labels": dict(wl.labels),
         "queueName": wl.queue_name,
         "priority": wl.priority,
         "priorityClassName": wl.priority_class_name,
@@ -417,6 +418,7 @@ def workload_from_dict(d: dict) -> Workload:
     wl = Workload(
         name=d["name"],
         namespace=d["namespace"],
+        labels=dict(d.get("labels", {})),
         queue_name=d.get("queueName", ""),
         priority=d.get("priority", 0),
         priority_class_name=d.get("priorityClassName", ""),

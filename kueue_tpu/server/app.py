@@ -249,7 +249,7 @@ class KueueServer:
         )
         return sec.to_dict(model) if model is not None else None
 
-    def apply(self, section: str, obj: dict) -> dict:
+    def apply(self, section: str, obj: dict, reconcile: bool = True) -> dict:
         """Upsert one object through the webhook admission chain."""
         sec = _SECTIONS.get(section)
         if sec is None:
@@ -265,7 +265,7 @@ class KueueServer:
                 raise ApiError(422, str(e))
             model = sec.from_dict(obj)
             getattr(self.runtime, sec.add_name)(model)
-            if self.auto_reconcile:
+            if reconcile and self.auto_reconcile:
                 self.runtime.run_until_idle()
         return obj
 
@@ -306,6 +306,35 @@ class KueueServer:
             )
             if self.auto_reconcile:
                 self.runtime.run_until_idle()
+
+    def get_object(self, section: str, namespace: str, name: str) -> dict:
+        sec = _SECTIONS.get(section)
+        if sec is None:
+            raise ApiError(404, f"unknown section {section!r}")
+        with self.lock:
+            model = sec.lookup(self.runtime, namespace, name)
+            if model is None:
+                raise ApiError(404, f"{section[:-1]} {namespace}/{name} not found")
+            return sec.to_dict(model)
+
+    def apply_batch(self, body: dict) -> Dict[str, int]:
+        """Bulk upsert: {section: [objects]} in one request (the
+        MultiKueue batched-dispatch wire). Each object still passes the
+        webhook admission chain; reconcile runs once at the end."""
+        counts: Dict[str, int] = {}
+        unknown = [s for s in body if s not in _SECTIONS]
+        if unknown:
+            raise ApiError(404, f"unknown sections {unknown}")
+        for section, objs in body.items():
+            if not isinstance(objs, list):
+                raise ApiError(400, f"section {section!r} must be a list")
+            for obj in objs:
+                self.apply(section, obj, reconcile=False)
+                counts[section] = counts.get(section, 0) + 1
+        if self.auto_reconcile:
+            with self.lock:
+                self.runtime.run_until_idle()
+        return counts
 
     def list_section(self, section: str) -> dict:
         sec = _SECTIONS.get(section)
@@ -363,6 +392,13 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
         "check_state",
     ),
     ("GET", re.compile(r"^/apis/kueue/v1beta1/([a-z]+)$"), "list"),
+    (
+        "GET",
+        re.compile(r"^/apis/kueue/v1beta1/([a-z]+)/([^/]+)/([^/]+)$"),
+        "get_ns",
+    ),
+    ("GET", re.compile(r"^/apis/kueue/v1beta1/([a-z]+)/([^/]+)$"), "get"),
+    ("POST", re.compile(r"^/apis/kueue/v1beta1/batch$"), "apply_batch"),
     ("POST", re.compile(r"^/apis/kueue/v1beta1/([a-z]+)$"), "apply"),
     (
         "DELETE",
@@ -479,9 +515,20 @@ def _make_handler(srv: KueueServer):
         def _h_list(self, section, query):
             self._send_json(srv.list_section(section))
 
+        def _h_get_ns(self, section, ns, name, query):
+            self._send_json(srv.get_object(section, ns, name))
+
+        def _h_get(self, section, name, query):
+            self._send_json(srv.get_object(section, "", name))
+
         def _h_apply(self, section, query):
             obj = srv.apply(section, self._body())
             self._send_json({"applied": obj})
+
+        def _h_apply_batch(self, query):
+            body = self._body()
+            counts = srv.apply_batch(body)
+            self._send_json({"applied": counts})
 
         def _h_delete_ns(self, section, ns, name, query):
             srv.delete(section, ns, name)

@@ -57,8 +57,20 @@ class KueueClient:
     def apply(self, section: str, obj: dict) -> dict:
         return self._request("POST", f"/apis/kueue/v1beta1/{section}", obj)
 
+    def apply_batch(self, sections: dict) -> dict:
+        """Bulk upsert {section: [objects]} in one request."""
+        return self._request("POST", "/apis/kueue/v1beta1/batch", sections)
+
     def list(self, section: str) -> list:
         return self._request("GET", f"/apis/kueue/v1beta1/{section}")["items"]
+
+    def get(self, section: str, name: str) -> dict:
+        return self._request("GET", f"/apis/kueue/v1beta1/{section}/{name}")
+
+    def get_workload(self, namespace: str, name: str) -> dict:
+        return self._request(
+            "GET", f"/apis/kueue/v1beta1/workloads/{namespace}/{name}"
+        )
 
     def delete_workload(self, namespace: str, name: str) -> dict:
         return self._request(

@@ -1,0 +1,291 @@
+"""MultiKueue transport hardening: reconnect/backoff state machine,
+orphan GC, batched dispatch, and dispatch to a real remote control
+plane over HTTP (multikueuecluster.go:76-187 behaviors)."""
+
+import pytest
+
+from kueue_tpu.models import (
+    AdmissionCheck,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import ResourceGroup
+from kueue_tpu.models.constants import (
+    MULTIKUEUE_CONTROLLER_NAME,
+    AdmissionCheckStateType,
+)
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.admissionchecks.multikueue import (
+    MultiKueueCluster,
+    MultiKueueConfig,
+    MultiKueueController,
+)
+from kueue_tpu.admissionchecks.multikueue_transport import (
+    ORIGIN_LABEL,
+    ClusterUnreachable,
+    FlakyTransport,
+    HTTPTransport,
+    InProcessTransport,
+    RemoteClient,
+    TransportError,
+)
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.utils.clock import FakeClock
+
+
+def simple_runtime(clock=None, cpu="10"):
+    rt = ClusterRuntime(clock=clock)
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq",
+            namespace_selector={},
+            resource_groups=(
+                ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": cpu}),)),
+            ),
+        )
+    )
+    rt.add_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+    return rt
+
+
+def wl(name, cpu="1", **kw):
+    return Workload(
+        namespace="ns", name=name, queue_name="lq",
+        pod_sets=(PodSet.build("main", 1, {"cpu": cpu}),), **kw,
+    )
+
+
+class TestRemoteClientStateMachine:
+    def test_backoff_doubles_and_caps(self):
+        clock = FakeClock(0.0)
+        transport = FlakyTransport(InProcessTransport(simple_runtime(clock)))
+        client = RemoteClient(transport, clock, base_backoff_s=1.0, max_backoff_s=8.0)
+        transport.down = True
+        delays = []
+        for _ in range(6):
+            clock.advance(1000.0)  # past any backoff window
+            with pytest.raises(ClusterUnreachable):
+                client.call("get_workload", "ns/x")
+            delays.append(client.next_retry_at - clock.now())
+        assert delays == [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]  # b*2^(n-1), capped
+        assert not client.active and client.lost_since is not None
+
+    def test_calls_refused_inside_backoff_window(self):
+        clock = FakeClock(0.0)
+        transport = FlakyTransport(InProcessTransport(simple_runtime(clock)))
+        client = RemoteClient(transport, clock, base_backoff_s=10.0)
+        transport.down = True
+        with pytest.raises(ClusterUnreachable):
+            client.call("get_workload", "ns/x")
+        calls_before = transport.calls
+        with pytest.raises(ClusterUnreachable):
+            client.call("get_workload", "ns/x")  # window not elapsed
+        assert transport.calls == calls_before  # refused WITHOUT probing
+        clock.advance(10.0)
+        transport.down = False
+        assert client.call("get_workload", "ns/x") is None  # probe succeeds
+        assert client.active and client.failed_attempts == 0
+
+    def test_success_resets_backoff(self):
+        clock = FakeClock(0.0)
+        transport = FlakyTransport(InProcessTransport(simple_runtime(clock)))
+        client = RemoteClient(transport, clock, base_backoff_s=1.0)
+        transport.down = True
+        for _ in range(4):
+            clock.advance(100.0)
+            with pytest.raises(ClusterUnreachable):
+                client.call("get_workload", "ns/x")
+        transport.down = False
+        clock.advance(100.0)
+        client.call("get_workload", "ns/x")
+        transport.down = True
+        clock.advance(100.0)
+        with pytest.raises(ClusterUnreachable):
+            client.call("get_workload", "ns/x")
+        # first failure after recovery restarts at the base delay
+        assert client.next_retry_at - clock.now() == 1.0
+
+
+def mk_setup(clock=None, batch_dispatch=False):
+    clock = clock or FakeClock(0.0)
+    rt = simple_runtime(clock)
+    rt.add_admission_check(
+        AdmissionCheck(
+            name="mk", controller_name=MULTIKUEUE_CONTROLLER_NAME, parameters="cfg"
+        )
+    )
+    cq = rt.cache.cluster_queues["cq"].model
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq", namespace_selector={},
+            resource_groups=cq.resource_groups,
+            admission_checks=("mk",),
+        )
+    )
+    workers = {
+        name: MultiKueueCluster(name=name, runtime=simple_runtime(FakeClock(0.0)))
+        for name in ("w1", "w2")
+    }
+    ctrl = MultiKueueController(
+        rt,
+        clusters=workers,
+        configs={"cfg": MultiKueueConfig(name="cfg", clusters=("w1", "w2"))},
+        batch_dispatch=batch_dispatch,
+    )
+    rt.admission_check_controllers.append(ctrl)
+    return rt, ctrl, workers, clock
+
+
+def drive(rt, workers, n=6):
+    for _ in range(n):
+        rt.run_until_idle()
+        for w in workers.values():
+            if w.runtime is not None:
+                w.runtime.run_until_idle()
+
+
+class TestOrphanGC:
+    def test_orphan_deleted_when_local_owner_gone(self):
+        rt, ctrl, workers, clock = mk_setup()
+        w = wl("orphan")
+        rt.add_workload(w)
+        drive(rt, workers)
+        assert ctrl._reserving.get(w.key) in ("w1", "w2")
+        # local owner disappears while remotes hold copies
+        rt.delete_workload(w)
+        removed = ctrl.gc_orphans()
+        assert removed >= 1
+        for worker in workers.values():
+            assert w.key not in worker.runtime.workloads
+
+    def test_gc_only_touches_own_origin(self):
+        rt, ctrl, workers, clock = mk_setup()
+        foreign = wl("foreign")
+        foreign.labels[ORIGIN_LABEL] = "someone-else"
+        workers["w1"].runtime.add_workload(foreign)
+        unlabeled = wl("native")
+        workers["w1"].runtime.add_workload(unlabeled)
+        assert ctrl.gc_orphans() == 0
+        assert foreign.key in workers["w1"].runtime.workloads
+        assert unlabeled.key in workers["w1"].runtime.workloads
+
+    def test_gc_skips_lost_clusters(self):
+        rt, ctrl, workers, clock = mk_setup()
+        w = wl("x")
+        rt.add_workload(w)
+        drive(rt, workers)
+        rt.delete_workload(w)
+        workers["w1"].mark_lost(clock.now())
+        workers["w2"].mark_lost(clock.now())
+        assert ctrl.gc_orphans() == 0  # nothing reachable
+        workers["w1"].mark_connected()
+        workers["w2"].mark_connected()
+        assert ctrl.gc_orphans() >= 1
+
+
+class _RecordingTransport(FlakyTransport):
+    def __init__(self, inner):
+        super().__init__(inner)
+        self.ops = []
+
+    def _fwd(self, name, *args):
+        self.ops.append(name)
+        return super()._fwd(name, *args)
+
+
+class TestBatchedDispatch:
+    def test_one_exchange_per_cluster(self):
+        rt, ctrl, workers, clock = mk_setup(batch_dispatch=True)
+        recorders = {}
+        for name, w in workers.items():
+            w.transport = _RecordingTransport(w.transport)
+            w.client.transport = w.transport
+            recorders[name] = w.transport
+        for i in range(5):
+            rt.add_workload(wl(f"b{i}"))
+        drive(rt, workers)
+        for name, tr in recorders.items():
+            # creates went out ONLY through the batched exchange
+            assert "create_workload" not in tr.ops
+            assert "create_workloads" in tr.ops
+        # every workload reached a reservation through the batched path,
+        # the winner holds all copies, the loser's were dropped
+        for i in range(5):
+            assert f"ns/b{i}" in ctrl._reserving
+        winners = {ctrl._reserving[f"ns/b{i}"] for i in range(5)}
+        for name, w in workers.items():
+            held = [k for k in w.runtime.workloads if k.startswith("ns/b")]
+            assert len(held) == (5 if name in winners else 0)
+
+    def test_batch_survives_transport_failure(self):
+        rt, ctrl, workers, clock = mk_setup(batch_dispatch=True)
+        workers["w1"].mark_lost(clock.now())
+        rt.add_workload(wl("resilient"))
+        drive(rt, workers)
+        # dispatched to the healthy cluster regardless
+        assert "ns/resilient" in workers["w2"].runtime.workloads
+
+
+class TestHTTPTransportDispatch:
+    def test_cross_control_plane_over_http(self):
+        """A real remote: the worker cluster is a kueue_tpu.server and
+        MultiKueue dispatches over the wire."""
+        from kueue_tpu.server import KueueServer
+
+        worker_rt = simple_runtime()
+        srv = KueueServer(runtime=worker_rt)
+        port = srv.start()
+        try:
+            clock = FakeClock(0.0)
+            rt = simple_runtime(clock)
+            rt.add_admission_check(
+                AdmissionCheck(
+                    name="mk",
+                    controller_name=MULTIKUEUE_CONTROLLER_NAME,
+                    parameters="cfg",
+                )
+            )
+            cq = rt.cache.cluster_queues["cq"].model
+            rt.add_cluster_queue(
+                ClusterQueue(
+                    name="cq", namespace_selector={},
+                    resource_groups=cq.resource_groups,
+                    admission_checks=("mk",),
+                )
+            )
+            cluster = MultiKueueCluster(
+                name="http-worker",
+                transport=HTTPTransport(f"http://127.0.0.1:{port}"),
+            )
+            ctrl = MultiKueueController(
+                rt,
+                clusters={"http-worker": cluster},
+                configs={
+                    "cfg": MultiKueueConfig(name="cfg", clusters=("http-worker",))
+                },
+            )
+            rt.admission_check_controllers.append(ctrl)
+            w = wl("remote-job")
+            rt.add_workload(w)
+            for _ in range(6):
+                rt.run_until_idle()
+            # the copy crossed the wire, reserved remotely (the server
+            # auto-reconciles), and the local check flipped Ready
+            assert w.key in worker_rt.workloads
+            assert worker_rt.workloads[w.key].labels[ORIGIN_LABEL] == "local"
+            assert (
+                w.admission_check_states["mk"].state
+                == AdmissionCheckStateType.READY
+            )
+            assert w.is_admitted
+        finally:
+            srv.stop()
+
+    def test_http_transport_error_surfaces(self):
+        tr = HTTPTransport("http://127.0.0.1:1")  # nothing listening
+        with pytest.raises(TransportError):
+            tr.get_workload("ns/x")
