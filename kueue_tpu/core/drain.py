@@ -418,11 +418,14 @@ def run_drain(
     max_cells: int = 4,
     timestamp_fn=None,
     max_cycles: Optional[int] = None,
+    mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
 ) -> DrainOutcome:
     """Plan + solve + map back, with one device round trip.
 
     ``max_cycles`` overrides the computed backstop (operators capping
-    device time; tests exercising truncation routing)."""
+    device time; tests exercising truncation routing). With ``mesh``
+    the per-queue tensors are sharded along the mesh's ``wl`` axis
+    (each device owns a slice of the ClusterQueues)."""
     from kueue_tpu._jax import jnp
     from kueue_tpu.ops.drain_kernel import DrainQueues, solve_drain_packed_jit
 
@@ -432,12 +435,26 @@ def run_drain(
     if max_cycles is not None:
         plan.max_cycles = max_cycles
     tree, paths, _ = tree_arrays(snapshot)
-    queues = DrainQueues(**{k: jnp.asarray(v) for k, v in plan.queues_np.items()})
+    queues_np = plan.queues_np
+    if mesh is not None:
+        from kueue_tpu.parallel.sharded_solver import (
+            pad_queue_arrays,
+            place_drain_inputs,
+        )
+
+        queues_np = pad_queue_arrays(queues_np, mesh.shape["wl"])
+        # numpy -> device_put straight onto the shards (one transfer)
+        tree, usage_in, queues, paths = place_drain_inputs(
+            mesh, tree, snapshot.local_usage, DrainQueues(**queues_np), paths
+        )
+    else:
+        usage_in = jnp.asarray(snapshot.local_usage)
+        queues = DrainQueues(**{k: jnp.asarray(v) for k, v in queues_np.items()})
 
     flat = np.asarray(
         solve_drain_packed_jit(
             tree,
-            jnp.asarray(snapshot.local_usage),
+            usage_in,
             queues,
             paths,
             n_segments=plan.n_segments,
@@ -445,13 +462,13 @@ def run_drain(
             max_cycles=plan.max_cycles,
         )
     )  # the single fetch
-    nq, nl = plan.queues_np["cells"].shape[:2]
+    nq, nl = queues_np["cells"].shape[:2]  # incl. mesh padding rows
     ql = nq * nl
     adm_k = flat[:ql].reshape((nq, nl))
     adm_cycle = flat[ql : 2 * ql].reshape((nq, nl))
     cursor = flat[2 * ql : 2 * ql + nq]
     cycles = int(flat[-1])
-    truncated = bool(np.any(cursor < plan.queues_np["qlen"]))
+    truncated = bool(np.any(cursor < queues_np["qlen"]))
 
     lowered = plan.lowered
     admitted: List[Tuple[Workload, str, Dict[str, str], int]] = []

@@ -370,6 +370,7 @@ def dispatch_lowered(
     snapshot: Snapshot,
     lowered: Lowered,
     pad_heads: bool = True,
+    mesh=None,  # jax.sharding.Mesh: shard heads along "wl"
 ):
     """Ship an already-lowered batch to the segmented device solver.
 
@@ -395,6 +396,13 @@ def dispatch_lowered(
 
     w = len(lowered.heads)
     w_pad = _bucket(w) if pad_heads else w
+    if mesh is not None:
+        # W must divide the mesh's wl axis (uneven device_put shards
+        # are rejected); power-of-two buckets already do for
+        # power-of-two meshes, this covers the rest
+        from kueue_tpu.parallel.sharded_solver import pad_w_multiple
+
+        w_pad = pad_w_multiple(w_pad, mesh.shape["wl"])
     cq_row, cells, qty = lowered.cq_row, lowered.cells, lowered.qty
     valid, priority = lowered.valid, lowered.priority
     timestamp, no_reclaim = lowered.timestamp, lowered.no_reclaim
@@ -410,14 +418,9 @@ def dispatch_lowered(
         timestamp = np.concatenate([timestamp, np.zeros(pad, dtype=np.int64)])
         no_reclaim = np.concatenate([no_reclaim, np.zeros(pad, dtype=bool)])
     tree, paths, roots = tree_arrays(snapshot)
-    batch = HeadsBatch(
-        cq_row=jnp.asarray(cq_row),
-        cells=jnp.asarray(cells),
-        qty=jnp.asarray(qty),
-        valid=jnp.asarray(valid),
-        priority=jnp.asarray(priority),
-        timestamp=jnp.asarray(timestamp),
-        no_reclaim=jnp.asarray(no_reclaim),
+    batch_np = HeadsBatch(
+        cq_row=cq_row, cells=cells, qty=qty, valid=valid,
+        priority=priority, timestamp=timestamp, no_reclaim=no_reclaim,
     )
     # compact segment ids: one per LIVE root cohort; the max head count
     # within one root bounds phase-2's sequential depth
@@ -430,13 +433,25 @@ def dispatch_lowered(
         n_steps = _bucket(int(np.bincount(inv).max()), minimum=8)
     else:
         n_segments = n_steps = 8
+    if mesh is not None:
+        # numpy -> device_put straight onto the shards (one transfer,
+        # no staging of the full batch on a single device)
+        from kueue_tpu.parallel.sharded_solver import place_cycle_inputs
+
+        tree, usage_in, batch, paths, seg_in = place_cycle_inputs(
+            mesh, tree, snapshot.local_usage, batch_np, paths, seg_id
+        )
+    else:
+        batch = HeadsBatch(*(jnp.asarray(x) for x in batch_np))
+        usage_in = jnp.asarray(snapshot.local_usage)
+        seg_in = jnp.asarray(seg_id)
     packed = np.asarray(
         solve_cycle_segmented_packed_jit(
             tree,
-            jnp.asarray(snapshot.local_usage),
+            usage_in,
             batch,
             paths,
-            jnp.asarray(seg_id),
+            seg_in,
             n_segments=n_segments,
             n_steps=n_steps,
         )

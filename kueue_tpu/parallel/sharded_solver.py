@@ -60,34 +60,19 @@ class ShardedSolver:
     """
 
     def __init__(self, mesh):
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         self.mesh = mesh
-        has_fr = "fr" in mesh.axis_names
-
-        def sh(*spec):
-            return NamedSharding(mesh, P(*spec))
-
-        fr_spec = sh(None, "fr") if has_fr else sh(None, None)
-        self._tree_sh = QuotaTree(
-            parent=sh(None),
-            level_mask=sh(None, None),
-            nominal=fr_spec,
-            lending_limit=fr_spec,
-            borrowing_limit=fr_spec,
-        )
-        self._usage_sh = fr_spec
-        self._heads_sh = HeadsBatch(
-            cq_row=sh("wl"),
-            cells=sh("wl", None, None),
-            qty=sh("wl", None, None),
-            valid=sh("wl", None),
-            priority=sh("wl"),
-            timestamp=sh("wl"),
-            no_reclaim=sh("wl"),
-        )
-        self._paths_sh = sh(None, None)
         self._jit = jax.jit(solve_cycle)
+
+    def place(self, tree: QuotaTree, local_usage, heads: HeadsBatch, paths):
+        """device_put every input with its mesh sharding (shared layout
+        builders — the same specs the production entries use)."""
+        fr_size = tree.nominal.shape[1]
+        return (
+            jax.device_put(tree, build_tree_spec(self.mesh, fr_size)),
+            jax.device_put(local_usage, _fr_spec(self.mesh, fr_size)),
+            jax.device_put(heads, build_heads_spec(self.mesh)),
+            jax.device_put(paths, _sh(self.mesh, None, None)),
+        )
 
     @property
     def wl_axis_size(self) -> int:
@@ -119,14 +104,6 @@ class ShardedSolver:
             no_reclaim=pad0(heads.no_reclaim),
         )
 
-    def place(self, tree: QuotaTree, local_usage, heads: HeadsBatch, paths):
-        """device_put every input with its mesh sharding."""
-        tree_d = jax.device_put(tree, self._tree_sh)
-        usage_d = jax.device_put(local_usage, self._usage_sh)
-        heads_d = jax.device_put(heads, self._heads_sh)
-        paths_d = jax.device_put(paths, self._paths_sh)
-        return tree_d, usage_d, heads_d, paths_d
-
     def __call__(
         self, tree: QuotaTree, local_usage, heads: HeadsBatch, paths
     ) -> SolveResult:
@@ -136,3 +113,121 @@ class ShardedSolver:
         )
         with self.mesh:
             return self._jit(tree_d, usage_d, heads_d, paths_d)
+
+
+# ---- production-entry placement (the segmented cycle + the drains) ----
+def _sh(mesh, *spec):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(*spec))
+
+
+def _fr_spec(mesh, fr_size: int):
+    """[_, FR] sharding: fr-sharded only when the mesh has an fr axis
+    AND the cell count divides it (device_put rejects uneven shards);
+    replicated otherwise."""
+    if "fr" in mesh.axis_names and fr_size % mesh.shape["fr"] == 0:
+        return _sh(mesh, None, "fr")
+    return _sh(mesh, None, None)
+
+
+def build_tree_spec(mesh, fr_size: int) -> QuotaTree:
+    fr = _fr_spec(mesh, fr_size)
+    return QuotaTree(
+        parent=_sh(mesh, None),
+        level_mask=_sh(mesh, None, None),
+        nominal=fr,
+        lending_limit=fr,
+        borrowing_limit=fr,
+    )
+
+
+def build_heads_spec(mesh) -> HeadsBatch:
+    return HeadsBatch(
+        cq_row=_sh(mesh, "wl"),
+        cells=_sh(mesh, "wl", None, None),
+        qty=_sh(mesh, "wl", None, None),
+        valid=_sh(mesh, "wl", None),
+        priority=_sh(mesh, "wl"),
+        timestamp=_sh(mesh, "wl"),
+        no_reclaim=_sh(mesh, "wl"),
+    )
+
+
+def place_cycle_inputs(mesh, tree: QuotaTree, local_usage, heads: HeadsBatch, paths, seg_id):
+    """device_put the segmented-cycle inputs (core/solver.dispatch_lowered)
+    with the production layout: heads + segment ids sharded along ``wl``,
+    quota tensors replicated (fr-sharded on a 2-D mesh when FR divides
+    the axis). Inputs may be numpy arrays — device_put transfers each
+    host buffer straight to its shards (no staging on one device). The
+    caller pads W to a multiple of the wl axis (pad_w_multiple)."""
+    fr_size = tree.nominal.shape[1]
+    return (
+        jax.device_put(tree, build_tree_spec(mesh, fr_size)),
+        jax.device_put(local_usage, _fr_spec(mesh, fr_size)),
+        jax.device_put(heads, build_heads_spec(mesh)),
+        jax.device_put(paths, _sh(mesh, None, None)),
+        jax.device_put(seg_id, _sh(mesh, "wl")),
+    )
+
+
+def pad_w_multiple(w: int, multiple: int) -> int:
+    """Head-count target divisible by the mesh's wl axis."""
+    return ((w + multiple - 1) // multiple) * multiple
+
+
+def pad_queue_arrays(queues_np: dict, multiple: int) -> dict:
+    """Pad the drain's Q axis to a multiple of the mesh's wl axis with
+    inert queues (qlen 0, cq_row/seg_id -1)."""
+    import numpy as np
+
+    q = queues_np["qlen"].shape[0]
+    target = ((q + multiple - 1) // multiple) * multiple
+    if target == q:
+        return queues_np
+    pad = target - q
+    out = {}
+    for name, arr in queues_np.items():
+        pad_block = np.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)
+        if name in ("cq_rows", "seg_id"):
+            pad_block -= 1
+        if name == "cells":
+            pad_block[:] = -1
+        out[name] = np.concatenate([arr, pad_block])
+    return out
+
+
+def place_drain_inputs(mesh, tree: QuotaTree, local_usage, queues, paths, victims=None):
+    """device_put drain inputs: per-queue tensors sharded along ``wl``
+    (the Q axis — each device owns a slice of the ClusterQueues; the
+    phase-2 segmented scan runs on the gathered per-cycle heads),
+    quota tree + paths replicated."""
+    rep2 = _sh(mesh, None, None)
+    tree_d = jax.device_put(
+        tree,
+        QuotaTree(
+            parent=_sh(mesh, None), level_mask=rep2, nominal=rep2,
+            lending_limit=rep2, borrowing_limit=rep2,
+        ),
+    )
+    q_specs = type(queues)(
+        **{
+            name: _sh(mesh, "wl", *([None] * (getattr(queues, name).ndim - 1)))
+            for name in queues._fields
+        }
+    )
+    out = (
+        tree_d,
+        jax.device_put(local_usage, rep2),
+        jax.device_put(queues, q_specs),
+        jax.device_put(paths, rep2),
+    )
+    if victims is None:
+        return out
+    v_specs = type(victims)(
+        **{
+            name: _sh(mesh, "wl", *([None] * (getattr(victims, name).ndim - 1)))
+            for name in victims._fields
+        }
+    )
+    return out + (jax.device_put(victims, v_specs),)

@@ -50,3 +50,61 @@ def test_mesh_shapes():
     assert make_mesh(8).axis_names == ("wl",)
     assert make_mesh(8, fr_parallel=True).axis_names == ("wl", "fr")
     assert make_mesh(3, fr_parallel=True).axis_names == ("wl",)  # odd: 1-D
+
+
+def test_sharded_drain_matches_unsharded():
+    """run_drain with a mesh (Q axis sharded over 8 devices) must make
+    identical decisions to the unsharded dispatch."""
+    from kueue_tpu.core.drain import run_drain
+    from kueue_tpu.core.queue_manager import queue_order_timestamp
+    from kueue_tpu.core.snapshot import take_snapshot
+    from kueue_tpu.parallel import make_mesh
+
+    from tests.test_solver_path import build_env, random_spec
+
+    spec = random_spec(3, workloads_per_cq=6)
+    outcomes = {}
+    for label, mesh in (("plain", None), ("mesh", make_mesh(8))):
+        sched, mgr, cache, _ = build_env(spec, use_solver=False)
+        pending = []
+        for cq_name, pq in mgr.cluster_queues.items():
+            for wl in pq.snapshot_sorted():
+                pending.append((wl, cq_name))
+        out = run_drain(
+            take_snapshot(cache), pending, cache.flavors,
+            timestamp_fn=lambda wl: queue_order_timestamp(wl, mgr._ts_policy),
+            mesh=mesh,
+        )
+        outcomes[label] = (
+            {(wl.name, tuple(sorted(fl.items())), cyc) for wl, _, fl, cyc in out.admitted},
+            {wl.name for wl, _ in out.parked},
+        )
+    assert outcomes["plain"] == outcomes["mesh"]
+
+
+def test_sharded_dispatch_lowered_matches_unsharded():
+    """dispatch_lowered with a mesh shards heads along wl; decisions
+    must match the unsharded path."""
+    import numpy as np
+
+    from kueue_tpu.core.solver import dispatch_lowered, lower_heads
+    from kueue_tpu.core.queue_manager import queue_order_timestamp
+    from kueue_tpu.core.snapshot import take_snapshot
+    from kueue_tpu.parallel import make_mesh
+
+    from tests.test_solver_path import build_env, random_spec
+
+    spec = random_spec(5, workloads_per_cq=4)
+    sched, mgr, cache, _ = build_env(spec, use_solver=False)
+    heads = []
+    for cq_name, pq in mgr.cluster_queues.items():
+        for wl in pq.snapshot_sorted():
+            heads.append((wl, cq_name))
+    snapshot = take_snapshot(cache)
+    ts = lambda wl: queue_order_timestamp(wl, mgr._ts_policy)  # noqa: E731
+    lowered = lower_heads(snapshot, heads, cache.flavors, timestamp_fn=ts)
+    plain = dispatch_lowered(snapshot, lowered)
+    sharded = dispatch_lowered(snapshot, lowered, mesh=make_mesh(8))
+    np.testing.assert_array_equal(plain.chosen, sharded.chosen)
+    np.testing.assert_array_equal(plain.admitted, sharded.admitted)
+    np.testing.assert_array_equal(plain.reserved, sharded.reserved)
