@@ -77,41 +77,49 @@ class _SubtreeIndex:
                 d += 1
 
 
+def _head_candidates(snapshot: Snapshot, item, preemptor):
+    """Shared per-head discovery: build the preemption context and the
+    sorted candidate list (preemption.go:111-115), used by both the
+    classic and the fair lowerings."""
+    from kueue_tpu.core.preemption import _Ctx
+
+    wl, cq_name, assignment = item
+    ctx = _Ctx(
+        preemptor=wl,
+        cq_name=cq_name,
+        cq_row=snapshot.row(cq_name),
+        snapshot=snapshot,
+        frs_need_preemption=preemptor._frs_need_preemption(assignment),
+        usage_vec=snapshot.vector_of(assignment.usage),
+    )
+    candidates = preemptor._find_candidates(ctx)
+    candidates.sort(key=preemptor._candidate_key(ctx))
+    return ctx, candidates
+
+
 def lower_preemption(
     snapshot: Snapshot,
     items: Sequence[Tuple[Workload, str, AssignmentResult]],
     preemptor,
 ) -> LoweredPreemption:
-    """items: (workload, cq_name, PREEMPT-mode assignment) per head."""
-    from kueue_tpu.core.preemption import _Ctx
+    """items: (workload, cq_name, PREEMPT-mode assignment) per head.
+    Classic strategy ladder only — batched_get_targets routes
+    fair-sharing heads to lower_fair_preemption before reaching here."""
     from kueue_tpu.ops.assign_kernel import build_roots
 
     out = LoweredPreemption()
-    if preemptor.enable_fair_sharing:
-        out.fallback = list(range(len(items)))
-        return out
-
     parent = snapshot.flat.parent
     roots = build_roots(parent)
     max_depth = snapshot.flat.max_depth
     subtrees: Dict[int, _SubtreeIndex] = {}
 
     per_attempt_meta: List[dict] = []
-    for idx, (wl, cq_name, assignment) in enumerate(items):
-        frs = preemptor._frs_need_preemption(assignment)
-        ctx = _Ctx(
-            preemptor=wl,
-            cq_name=cq_name,
-            cq_row=snapshot.row(cq_name),
-            snapshot=snapshot,
-            frs_need_preemption=frs,
-            usage_vec=snapshot.vector_of(assignment.usage),
-        )
-        candidates = preemptor._find_candidates(ctx)
+    for idx, item in enumerate(items):
+        wl, cq_name, assignment = item
+        ctx, candidates = _head_candidates(snapshot, item, preemptor)
         out.rows_of[idx] = []
         if not candidates:
             continue  # no candidates -> no targets; nothing to dispatch
-        candidates.sort(key=preemptor._candidate_key(ctx))
         if len(candidates) > MAX_CANDIDATES:
             out.fallback.append(idx)
             continue
@@ -153,7 +161,7 @@ def lower_preemption(
             )
             out.rows_of[idx].append(row_id)
             per_attempt_meta.append(
-                {"ctx": ctx, "cells": cells, "frs": frs}
+                {"ctx": ctx, "cells": cells, "frs": ctx.frs_need_preemption}
             )
 
     if not out.attempts:
@@ -281,6 +289,243 @@ def _reason_for(ws: WorkloadSnapshot, cq_name: str, thr: Optional[int]) -> str:
     return IN_COHORT_RECLAMATION
 
 
+# ---- fair sharing (ops/fair_preempt_kernel.py) ----
+MAX_FAIR_CELLS = 32
+MAX_FAIR_NODES = 64
+
+
+def _bubble_np(paths, local_row, cells_qty, usage, guaranteed):
+    """numpy addUsage bubble on a local panel (resource_node.go:123-144)."""
+    path = paths[local_row]
+    delta = cells_qty.copy()
+    for node in path:
+        if node < 0:
+            break
+        old = usage[node].copy()
+        usage[node] += delta
+        delta = np.maximum(0, usage[node] - guaranteed[node]) - np.maximum(
+            0, old - guaranteed[node]
+        )
+        if not delta.any():
+            break
+    return usage
+
+
+def lower_fair_preemption(
+    snapshot: Snapshot,
+    items: Sequence[Tuple[Workload, str, AssignmentResult]],
+    preemptor,
+):
+    """Lower fair-sharing heads into FairProblem panels. Returns
+    (problem_arrays|None, meta) where meta carries per-head candidate
+    lists and the fallback indices."""
+    from kueue_tpu.core.preemption import (
+        LESS_THAN_OR_EQUAL_TO_FINAL_SHARE,
+        _Ctx,
+    )
+    from kueue_tpu.ops.assign_kernel import build_roots
+
+    parent = snapshot.flat.parent
+    roots = build_roots(parent)
+    max_depth = snapshot.flat.max_depth
+    n_cq = snapshot.flat.n_cq
+    subtrees: Dict[int, _SubtreeIndex] = {}
+
+    rows_meta: List[dict] = []
+    fallback: List[int] = []
+    empty: List[int] = []
+    for idx, item in enumerate(items):
+        ctx, candidates = _head_candidates(snapshot, item, preemptor)
+        if not candidates:
+            empty.append(idx)
+            continue
+        if len(candidates) > MAX_CANDIDATES:
+            fallback.append(idx)
+            continue
+        root = int(roots[ctx.cq_row])
+        sub = subtrees.get(root)
+        if sub is None:
+            rows = np.flatnonzero(roots == root)
+            sub = _SubtreeIndex(rows, parent, max_depth)
+            subtrees[root] = sub
+        if len(sub.rows) > MAX_FAIR_NODES:
+            fallback.append(idx)
+            continue
+        # ACTIVE cell universe of the whole subtree: DRS aggregates
+        # over every cell carrying quota or usage, not just head cells
+        panel_rows = sub.rows
+        active = (
+            (snapshot.nominal[panel_rows] > 0).any(axis=0)
+            | (snapshot.local_usage[panel_rows] > 0).any(axis=0)
+            | (ctx.usage_vec > 0)
+        )
+        cells = [int(j) for j in np.flatnonzero(active)]
+        if len(cells) > MAX_FAIR_CELLS:
+            fallback.append(idx)
+            continue
+        rows_meta.append(
+            {"idx": idx, "ctx": ctx, "cands": candidates, "cells": cells,
+             "sub": sub}
+        )
+
+    meta = {"rows": rows_meta, "fallback": fallback, "empty": empty}
+    if not rows_meta:
+        return None, meta
+
+    w = len(rows_meta)
+    s = _bucket(max(len(m["sub"].rows) for m in rows_meta), minimum=2)
+    cu = _bucket(max(len(m["cells"]) for m in rows_meta), minimum=2)
+    v = _bucket(max(len(m["cands"]) for m in rows_meta), minimum=2)
+    d1 = max_depth + 1
+    res_names = sorted(
+        {
+            snapshot.fr_list[j].resource
+            for m in rows_meta
+            for j in m["cells"]
+        }
+    )
+    r = max(len(res_names) + 1, 2)  # +1 inert bucket for padded cells
+    res_id = {name: i for i, name in enumerate(res_names)}
+
+    from kueue_tpu.ops.quota import NO_LIMIT
+
+    usage_global = snapshot.usage()
+    depth_global = snapshot.flat.depth
+
+    arrays = dict(
+        paths=np.full((w, s, d1), -1, dtype=np.int32),
+        usage0=np.zeros((w, s, cu), dtype=np.int64),
+        subtree_q=np.zeros((w, s, cu), dtype=np.int64),
+        guaranteed=np.zeros((w, s, cu), dtype=np.int64),
+        borrow_lim=np.full((w, s, cu), NO_LIMIT, dtype=np.int64),
+        weight=np.full((w, s), 1000, dtype=np.int64),
+        parent_loc=np.full((w, s), -1, dtype=np.int32),
+        depth_s=np.zeros((w, s), dtype=np.int32),
+        is_cq=np.zeros((w, s), dtype=bool),
+        svalid=np.zeros((w, s), dtype=bool),
+        anc_of_head=np.zeros((w, s), dtype=bool),
+        hrow=np.zeros(w, dtype=np.int32),
+        need_qty=np.zeros((w, cu), dtype=np.int64),
+        res_of=np.full((w, cu), r - 1, dtype=np.int32),  # pad: inert bucket
+        crow=np.zeros((w, v), dtype=np.int32),
+        cqty=np.zeros((w, v, cu), dtype=np.int64),
+        cvalid=np.zeros((w, v), dtype=bool),
+        row_valid=np.ones(w, dtype=bool),
+    )
+
+    for a_i, m in enumerate(rows_meta):
+        ctx, sub, cells = m["ctx"], m["sub"], m["cells"]
+        ns, nc = len(sub.rows), len(cells)
+        ix = np.ix_(sub.rows, cells)
+        arrays["paths"][a_i, :ns] = sub.paths
+        arrays["usage0"][a_i, :ns, :nc] = usage_global[ix]
+        arrays["subtree_q"][a_i, :ns, :nc] = snapshot.subtree[ix]
+        arrays["guaranteed"][a_i, :ns, :nc] = snapshot.guaranteed[ix]
+        arrays["borrow_lim"][a_i, :ns, :nc] = snapshot.borrowing_limit[ix]
+        arrays["weight"][a_i, :ns] = snapshot.weight_milli[sub.rows]
+        root_depth = int(depth_global[sub.rows].min())
+        for i, grow in enumerate(sub.rows):
+            gp = int(parent[grow])
+            arrays["parent_loc"][a_i, i] = sub.local.get(gp, -1)
+            arrays["depth_s"][a_i, i] = int(depth_global[grow]) - root_depth
+            arrays["is_cq"][a_i, i] = grow < n_cq
+        arrays["svalid"][a_i, :ns] = True
+        for anc in snapshot.path_to_root(ctx.cq_row):
+            li = sub.local.get(int(anc))
+            if li is not None:
+                arrays["anc_of_head"][a_i, li] = True
+        hrow_l = sub.local[ctx.cq_row]
+        arrays["hrow"][a_i] = hrow_l
+        arrays["need_qty"][a_i, :nc] = ctx.usage_vec[cells]
+        for ci, j in enumerate(cells):
+            arrays["res_of"][a_i, ci] = res_id[snapshot.fr_list[j].resource]
+        # the head's usage is part of the simulated state
+        # (preemption.go:394-395 AddUsage before DRS)
+        _bubble_np(
+            arrays["paths"][a_i], hrow_l, arrays["need_qty"][a_i],
+            arrays["usage0"][a_i], arrays["guaranteed"][a_i],
+        )
+        for vi, ws in enumerate(m["cands"]):
+            arrays["crow"][a_i, vi] = sub.local[ws.cq_row]
+            arrays["cqty"][a_i, vi, :nc] = ws.usage_vec[cells]
+            arrays["cvalid"][a_i, vi] = True
+
+    strategy1 = (
+        0
+        if preemptor.fs_strategies[0] == LESS_THAN_OR_EQUAL_TO_FINAL_SHARE
+        else 1
+    )
+    meta.update(
+        arrays=arrays, s=s, cu=cu, v=v, r=r, depth=max_depth,
+        strategy1=strategy1, has_second=len(preemptor.fs_strategies) > 1,
+    )
+    return arrays, meta
+
+
+def batched_fair_get_targets(
+    snapshot: Snapshot,
+    items: Sequence[Tuple[Workload, str, AssignmentResult]],
+    preemptor,
+) -> List[List[PreemptionTarget]]:
+    """Fair-sharing victim sets for every preempt-mode head in one
+    device dispatch; per-head fallback to the host Preemptor where the
+    dense form doesn't apply. Parity: tests/test_fair_preempt.py."""
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.core.preemption import (
+        IN_CLUSTER_QUEUE,
+        IN_COHORT_FAIR_SHARING,
+    )
+    from kueue_tpu.ops.fair_preempt_kernel import (
+        FairProblem,
+        solve_fair_packed_jit,
+    )
+
+    results: List[List[PreemptionTarget]] = [[] for _ in items]
+    arrays, meta = lower_fair_preemption(snapshot, items, preemptor)
+    for idx in meta["fallback"]:
+        wl, cq_name, assignment = items[idx]
+        results[idx] = preemptor.get_targets(wl, cq_name, assignment, snapshot)
+    if arrays is None:
+        return results
+
+    w = arrays["row_valid"].shape[0]
+    w_pad = _bucket(w, minimum=8)
+    arrays = _pad_rows(arrays, w_pad)
+    problem = FairProblem(**{k: jnp.asarray(x) for k, x in arrays.items()})
+    flat = np.asarray(
+        solve_fair_packed_jit(
+            problem,
+            depth=meta["depth"],
+            n_cand=meta["v"],
+            n_local=meta["s"],
+            n_res=meta["r"],
+            strategy1=meta["strategy1"],
+            has_second=meta["has_second"],
+        )
+    )  # one fetch
+    targets_mask = flat[: w_pad * meta["v"]].reshape(w_pad, meta["v"])
+    fits = flat[w_pad * meta["v"] :].astype(bool)
+
+    for a_i, m in enumerate(meta["rows"]):
+        if not fits[a_i]:
+            continue
+        idx = m["idx"]
+        cq_name = items[idx][1]
+        results[idx] = [
+            PreemptionTarget(
+                workload=ws,
+                reason=(
+                    IN_CLUSTER_QUEUE
+                    if ws.cq_name == cq_name
+                    else IN_COHORT_FAIR_SHARING
+                ),
+            )
+            for vi, ws in enumerate(m["cands"])
+            if targets_mask[a_i, vi]
+        ]
+    return results
+
+
 def batched_get_targets(
     snapshot: Snapshot,
     items: Sequence[Tuple[Workload, str, AssignmentResult]],
@@ -295,6 +540,9 @@ def batched_get_targets(
         PreemptProblem,
         solve_preempt_packed_jit,
     )
+
+    if preemptor.enable_fair_sharing:
+        return batched_fair_get_targets(snapshot, items, preemptor)
 
     results: List[List[PreemptionTarget]] = [[] for _ in items]
     lowered = lower_preemption(snapshot, items, preemptor)

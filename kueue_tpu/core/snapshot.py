@@ -83,6 +83,7 @@ class Snapshot:
     _usage_cache: Optional[tuple] = None
     _avail_cache: Optional[tuple] = None
     _pa_cache: Optional[np.ndarray] = None
+    _drs_cache: Optional[tuple] = None
     # incrementally-maintained tree usage (usage_tree_np semantics):
     # updated along the mutated row's ancestor path in O(depth*FR)
     # instead of re-running the full level-scheduled reduction, so the
@@ -289,15 +290,19 @@ class Snapshot:
 
     def all_node_drs(self) -> np.ndarray:
         """DominantResourceShare of every node (CQs and cohorts) against
-        current usage — used by the fair-sharing preemption tournament."""
-        n, fr = self.local_usage.shape
-        dws, _ = dominant_resource_share_np(
-            self.flat.parent, self._lm(), self.subtree, self.guaranteed,
-            self.borrowing_limit, self.usage(),
-            np.zeros((n, fr), dtype=np.int64), self.weight_milli,
-            self.resource_index, len(self.resource_names),
-        )
-        return dws
+        current usage — used by the fair-sharing preemption tournament.
+        Version-cached: the tournament asks several times per pick while
+        usage only changes between picks."""
+        if self._drs_cache is None or self._drs_cache[0] != self._usage_version:
+            n, fr = self.local_usage.shape
+            dws, _ = dominant_resource_share_np(
+                self.flat.parent, self._lm(), self.subtree, self.guaranteed,
+                self.borrowing_limit, self.usage(),
+                np.zeros((n, fr), dtype=np.int64), self.weight_milli,
+                self.resource_index, len(self.resource_names),
+            )
+            self._drs_cache = (self._usage_version, dws)
+        return self._drs_cache[1]
 
     def path_to_root(self, row: int) -> List[int]:
         """Node rows from `row`'s parent up to (and including) the root."""

@@ -1,0 +1,425 @@
+"""Batched fair-sharing preemption — the tournament on the device.
+
+The reference's fair-sharing victim search
+(``pkg/scheduler/preemption/preemption.go:372-463`` +
+``preemption/fairsharing/``) walks the cohort tree from the root
+picking the highest-DominantResourceShare subtree, pops that
+ClusterQueue's next candidate, gates it through the configured
+strategy at the almost-LCA, and re-evaluates DRS after every accepted
+removal — a sequential simulate/undo loop with full-tree DRS
+recomputation per step. This kernel runs that exact loop per
+preempt-mode head as a bounded ``lax.while_loop`` over local subtree
+panels, vmapped over heads: one dispatch resolves every head's fair
+victim set ("fair-share victim search becomes a batched argmin").
+
+Exactness notes (parity asserted in tests/test_fair_preempt.py):
+
+- panels carry EVERY flavor-resource cell with quota or usage anywhere
+  in the head's root cohort (not just the head's request cells): DRS
+  aggregates borrowed/lendable per RESOURCE over all cells
+  (pkg/cache/fair_sharing.go:49-104), so a cell-subset panel would
+  miss borrowing the head doesn't touch — the host lowering builds the
+  full active-cell universe and falls back above the padding cap;
+- pruning in the first pass is recomputed per pick instead of stored:
+  every host prune condition (drs==0 off the preemptor's path,
+  exhausted candidates) is a monotone function of the simulated state,
+  so recomputation decides identically; the second strategy's
+  ``drop_queue`` IS persistent state and is carried as a mask;
+- tie-breaks copy the host walk exactly: children are scanned in
+  ascending row order keeping >=, so the highest (drs, local row)
+  wins; cohorts win ties against ClusterQueues;
+- the strategy gate evaluates target_new_share on a probe removal that
+  is rolled back when rejected (rejected candidates move to the retry
+  set without touching usage), matching preemption.go:438-453.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+from kueue_tpu._jax import jax, jnp, lax
+from kueue_tpu.ops.quota import DRS_MAX, NO_LIMIT
+
+# strategy ids (config fairSharing.preemptionStrategies)
+LESS_THAN_OR_EQUAL_TO_FINAL = 0
+LESS_THAN_INITIAL = 1
+
+
+class FairProblem(NamedTuple):
+    """W head rows, each a local subtree problem.
+
+    S = padded subtree size, Cu = padded cell count (the subtree's
+    ACTIVE cell universe), V = padded candidate count, D = padded
+    local depth, R = padded resource-name count.
+
+    paths:      int32[W, S, D+1] — local ancestor path per local row.
+    usage0:     int64[W, S, Cu]  — bubbled usage INCLUDING the head's
+                requested usage at its row (the host adds it before
+                computing DRS — preemption.go:394-395).
+    subtree_q / guaranteed / borrow_lim: int64[W, S, Cu].
+    weight:     int64[W, S]      — fairSharing weight per node.
+    parent_loc: int32[W, S]      — local parent (-1 root / padding).
+    depth_s:    int32[W, S]      — distance from the root (root = 0).
+    is_cq:      bool[W, S]; svalid: bool[W, S].
+    anc_of_head: bool[W, S]      — strict ancestors of the head row.
+    hrow:       int32[W].
+    need_qty:   int64[W, Cu]     — head request per cell.
+    res_of:     int32[W, Cu]     — cell -> resource bucket (padded
+                cells point at the inert last bucket; scatter-add keeps
+                the aggregation off the TPU-unsupported s64 dot path).
+    crow:       int32[W, V]; cqty: int64[W, V, Cu]; cvalid: bool[W, V].
+    row_valid:  bool[W].
+    """
+
+    paths: jnp.ndarray
+    usage0: jnp.ndarray
+    subtree_q: jnp.ndarray
+    guaranteed: jnp.ndarray
+    borrow_lim: jnp.ndarray
+    weight: jnp.ndarray
+    parent_loc: jnp.ndarray
+    depth_s: jnp.ndarray
+    is_cq: jnp.ndarray
+    svalid: jnp.ndarray
+    anc_of_head: jnp.ndarray
+    hrow: jnp.ndarray
+    need_qty: jnp.ndarray
+    res_of: jnp.ndarray
+    crow: jnp.ndarray
+    cqty: jnp.ndarray
+    cvalid: jnp.ndarray
+    row_valid: jnp.ndarray
+
+
+class FairResult(NamedTuple):
+    targets: jnp.ndarray  # bool[W, V]
+    fits: jnp.ndarray  # bool[W]
+
+
+def _bubble(paths_row, crow, qty, usage, guaranteed, depth, apply):
+    """addUsage/removeUsage bubble on the panel at candidate row crow
+    (signed qty)."""
+    path = paths_row[jnp.maximum(crow, 0)]
+    delta = jnp.where(apply, qty, 0)
+    for d in range(0, depth + 1):
+        node = jnp.maximum(path[d], 0)
+        node_valid = path[d] >= 0
+        old = usage[node]
+        g = guaranteed[node]
+        new = old + delta
+        usage = usage.at[node].add(jnp.where(node_valid, delta, 0))
+        delta = jnp.where(
+            node_valid,
+            jnp.maximum(0, new - g) - jnp.maximum(0, old - g),
+            delta,
+        )
+    return usage
+
+
+def _solve_one_fair(
+    p: FairProblem,
+    depth: int,
+    n_cand: int,
+    n_local: int,
+    n_res: int,
+    strategy1: int,
+    has_second: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One head row (no W axis on the inputs)."""
+    hrow = jnp.maximum(p.hrow, 0)
+    hpath = p.paths[hrow]
+    need = p.need_qty > 0
+    s_idx = jnp.arange(n_local)
+    valid_d = hpath >= 0
+    root_pos_h = jnp.sum(valid_d.astype(jnp.int32)) - 1
+    root_row = hpath[jnp.maximum(root_pos_h, 0)]
+
+    def avail_at_head(usage):
+        """available() at the head row (clamped >= 0 per cell)."""
+        rows = jnp.maximum(hpath, 0)
+        sub = p.subtree_q[rows]
+        g = p.guaranteed[rows]
+        bl = p.borrow_lim[rows]
+        u = usage[rows]
+        avail = jnp.zeros_like(p.need_qty)
+        for d in range(depth, -1, -1):
+            is_root = d == root_pos_h
+            root_avail = sub[d] - u[d]
+            stored = sub[d] - g[d]
+            used = jnp.maximum(0, u[d] - g[d])
+            with_max = stored - used + bl[d]
+            clamped = jnp.where(
+                bl[d] < NO_LIMIT, jnp.minimum(with_max, avail), avail
+            )
+            nonroot = jnp.maximum(0, g[d] - u[d]) + clamped
+            avail = jnp.where(
+                valid_d[d], jnp.where(is_root, root_avail, nonroot), avail
+            )
+        return jnp.maximum(avail, 0)
+
+    def fits_without_head(usage):
+        """_fits_for_fair_sharing: evaluate with the head's usage
+        removed from the simulated state."""
+        u2 = _bubble(p.paths, p.hrow, -p.need_qty, usage, p.guaranteed, depth, True)
+        return jnp.all(jnp.where(need, avail_at_head(u2) >= p.need_qty, True))
+
+    def drs_panel(usage):
+        """all_node_drs on the local panel (fair_sharing.go:49-104,
+        integer semantics of ops/quota_np.dominant_resource_share_np)."""
+        borrowed_c = jnp.maximum(0, usage - p.subtree_q)  # [S, Cu]
+        borrowed = (
+            jnp.zeros((n_local, n_res), dtype=jnp.int64)
+            .at[:, p.res_of]
+            .add(borrowed_c)
+        )  # [S, R]
+        # potentialAvailable, top-down by depth
+        pot = p.subtree_q
+        has_borrow = p.borrow_lim < NO_LIMIT
+        for d in range(1, depth + 1):
+            mask = (p.depth_s == d)[:, None]
+            parent_pot = pot[jnp.maximum(p.parent_loc, 0)]
+            v = p.guaranteed + parent_pot
+            v = jnp.where(
+                has_borrow, jnp.minimum(p.subtree_q + p.borrow_lim, v), v
+            )
+            pot = jnp.where(mask, v, pot)
+        parent_pot = pot[jnp.maximum(p.parent_loc, 0)]
+        lendable = (
+            jnp.zeros((n_local, n_res), dtype=jnp.int64)
+            .at[:, p.res_of]
+            .add(parent_pot)
+        )  # [S, R]
+        lendable = jnp.where((p.parent_loc >= 0)[:, None], lendable, 0)
+        ratio = jnp.where(
+            (borrowed > 0) & (lendable > 0),
+            borrowed * 1000 // jnp.maximum(lendable, 1),
+            -1,
+        )
+        drs = jnp.max(ratio, axis=1)
+        active = jnp.any(borrowed > 0, axis=1) & (p.parent_loc >= 0)
+        num = drs * 1000
+        den = jnp.maximum(p.weight, 1)
+        trunc = jnp.sign(num) * (jnp.abs(num) // den)
+        return jnp.where(
+            active, jnp.where(p.weight == 0, DRS_MAX, trunc), 0
+        )
+
+    def cq_has_avail(avail_v):
+        """bool[S]: CQ row has an available candidate."""
+        onehot = (p.crow[:, None] == s_idx[None, :]) & avail_v[:, None]
+        return jnp.any(onehot, axis=0)
+
+    def tournament(drs, avail_v, pruned2):
+        """next_target: the host walk with recomputed pruning. Returns
+        local CQ row or -1."""
+        has_c = cq_has_avail(avail_v)
+        elig_cq = (
+            p.is_cq
+            & p.svalid
+            & has_c
+            & ~pruned2
+            & ~((drs == 0) & (s_idx != hrow))
+        )
+        # cohort walkability, bottom-up: subtree holds an eligible CQ
+        # reachable through walkable cohorts
+        ok = jnp.where(p.is_cq, elig_cq, False)
+        for d in range(depth, 0, -1):
+            at_d = p.depth_s == d
+            contrib = ok & at_d
+            gathered = jnp.zeros(n_local, dtype=bool).at[
+                jnp.maximum(p.parent_loc, 0)
+            ].max(contrib & (p.parent_loc >= 0))
+            cohort_walkable = (~p.is_cq) & (
+                (drs != 0) | p.anc_of_head | (s_idx == root_row)
+            )
+            ok = ok | (gathered & (cohort_walkable | (s_idx == root_row)))
+        # no cohort (head is rootless): pick own row directly
+        rootless = p.parent_loc[hrow] < 0
+
+        def walk(_):
+            cur = root_row
+            pick = jnp.int32(-1)
+            done = ~ok[root_row]
+            for _ in range(depth + 1):
+                child = p.svalid & (p.parent_loc == cur)
+                cq_ch = child & elig_cq
+                co_ch = child & (~p.is_cq) & ok
+                best_cq_drs = jnp.max(jnp.where(cq_ch, drs, -1))
+                best_cq = jnp.max(
+                    jnp.where(cq_ch & (drs == best_cq_drs), s_idx, -1)
+                )
+                best_co_drs = jnp.max(jnp.where(co_ch, drs, -1))
+                best_co = jnp.max(
+                    jnp.where(co_ch & (drs == best_co_drs), s_idx, -1)
+                )
+                go_cohort = (best_co >= 0) & (
+                    (best_cq < 0) | (best_co_drs >= best_cq_drs)
+                )
+                new_pick = jnp.where(go_cohort, jnp.int32(-1), best_cq)
+                pick = jnp.where(done, pick, new_pick)
+                done = done | ~go_cohort
+                cur = jnp.where(go_cohort, best_co, cur)
+            return pick.astype(jnp.int32)
+
+        own = jnp.where(has_c[hrow], hrow.astype(jnp.int32), jnp.int32(-1))
+        return jnp.where(rootless, own, walk(None))
+
+    def pop_first(row, avail_v):
+        cond = (p.crow == row) & avail_v
+        return jnp.argmin(jnp.where(cond, jnp.arange(n_cand), n_cand)).astype(
+            jnp.int32
+        ), jnp.any(cond)
+
+    def lca_of(target_row):
+        """First ancestor of the TARGET that is also a head ancestor
+        (least_common_ancestor.go) — used for BOTH shares."""
+        path = p.paths[jnp.maximum(target_row, 0)]
+        in_anc = p.anc_of_head[jnp.maximum(path, 0)] & (path >= 0)
+        return path[jnp.argmax(in_anc)]
+
+    def almost_lca(row, lca):
+        """Node on row's path just below the lca."""
+        path = p.paths[jnp.maximum(row, 0)]
+        pos = jnp.argmax(path == lca)
+        return path[jnp.maximum(pos - 1, 0)]
+
+    max_iters = 2 * n_cand + n_local + 4
+
+    def body(state):
+        (usage, removed, rstep, retried, pruned2, phase,
+         done, fits, n_removed, it) = state
+        avail1 = p.cvalid & ~removed & ~retried
+        avail2 = p.cvalid & ~removed & retried
+        avail_v = jnp.where(phase == 1, avail1, avail2)
+        no_pruned = jnp.zeros_like(pruned2)
+        drs = drs_panel(usage)
+        pick = tournament(
+            drs, avail_v, jnp.where(phase == 1, no_pruned, pruned2)
+        )
+
+        # --- pick == -1: phase transition or give up ---
+        to_phase2 = (pick < 0) & (phase == 1) & has_second
+        give_up = (pick < 0) & ~to_phase2
+        phase = jnp.where(to_phase2, 2, phase)
+        done = done | give_up
+
+        act = (pick >= 0) & ~done
+        v, v_ok = pop_first(jnp.maximum(pick, 0), avail_v)
+        act = act & v_ok
+        own = act & (pick == hrow) & (phase == 1)
+
+        lca = lca_of(pick)
+        pre_share = drs[jnp.maximum(almost_lca(hrow, lca), 0)]
+        tgt_old = drs[jnp.maximum(almost_lca(pick, lca), 0)]
+
+        # probe removal (used by strategy gate AND the accepted path)
+        usage_probe = _bubble(
+            p.paths, p.crow[v], -p.cqty[v], usage, p.guaranteed, depth, act
+        )
+        drs2 = drs_panel(usage_probe)
+        tgt_new = drs2[jnp.maximum(almost_lca(pick, lca), 0)]
+        allowed_s1 = jnp.where(
+            strategy1 == LESS_THAN_OR_EQUAL_TO_FINAL,
+            pre_share <= tgt_new,
+            pre_share < tgt_old,
+        )
+        allowed_s2 = pre_share < tgt_old
+        accept = act & (
+            own
+            | ((phase == 1) & ~own & allowed_s1)
+            | ((phase == 2) & allowed_s2)
+        )
+        reject1 = act & (phase == 1) & ~own & ~allowed_s1
+
+        usage = jnp.where(accept, usage_probe, usage)
+        removed = removed.at[v].set(removed[v] | accept)
+        rstep = rstep.at[v].set(jnp.where(accept, n_removed, rstep[v]))
+        n_removed = n_removed + accept.astype(jnp.int32)
+        retried = retried.at[v].set(retried[v] | reject1)
+        # strategy 2 drops the picked queue unconditionally
+        pruned2 = pruned2.at[jnp.maximum(pick, 0)].set(
+            pruned2[jnp.maximum(pick, 0)] | (act & (phase == 2))
+        )
+
+        now_fits = accept & fits_without_head(usage)
+        fits = fits | now_fits
+        done = done | now_fits
+        return (
+            usage, removed, rstep, retried, pruned2, phase,
+            done, fits, n_removed, it + 1,
+        )
+
+    def cond(state):
+        done, it = state[6], state[9]
+        return ~done & (it < max_iters)
+
+    init = (
+        p.usage0,
+        jnp.zeros(n_cand, dtype=bool),
+        jnp.full(n_cand, -1, dtype=jnp.int32),
+        jnp.zeros(n_cand, dtype=bool),
+        jnp.zeros(n_local, dtype=bool),
+        jnp.int32(1),
+        ~p.row_valid,
+        jnp.zeros((), dtype=bool),
+        jnp.int32(0),
+        jnp.int32(0),
+    )
+    (usage, removed, rstep, retried, pruned2, phase,
+     done, fits, n_removed, _) = lax.while_loop(cond, body, init)
+    fits = fits & p.row_valid
+
+    # ---- fill-back (reverse removal order, skipping the last) ----
+    usage = _bubble(
+        p.paths, p.hrow, -p.need_qty, usage, p.guaranteed, depth, fits
+    )
+
+    def fb_body(carry, s):
+        usage, removed = carry
+        cond_v = rstep == s
+        v = jnp.argmax(cond_v)
+        act = fits & jnp.any(cond_v) & (s <= n_removed - 2) & (s >= 0)
+        u2 = _bubble(
+            p.paths, p.crow[v], p.cqty[v], usage, p.guaranteed, depth, act
+        )
+        keep = act & jnp.all(
+            jnp.where(need, avail_at_head(u2) >= p.need_qty, True)
+        )
+        usage = jnp.where(keep, u2, usage)
+        removed = removed.at[v].set(removed[v] & ~keep)
+        return (usage, removed), None
+
+    (usage, removed), _ = lax.scan(
+        fb_body, (usage, removed), jnp.arange(n_cand - 2, -1, -1, dtype=jnp.int32)
+    )
+    return removed & fits, fits
+
+
+def solve_fair(
+    p: FairProblem, depth: int, n_cand: int, n_local: int, n_res: int,
+    strategy1: int, has_second: bool,
+) -> FairResult:
+    targets, fits = jax.vmap(
+        lambda row: _solve_one_fair(
+            row, depth, n_cand, n_local, n_res, strategy1, has_second
+        )
+    )(p)
+    return FairResult(targets=targets, fits=fits)
+
+
+def _solve_fair_packed(
+    p: FairProblem, depth: int, n_cand: int, n_local: int, n_res: int,
+    strategy1: int, has_second: bool,
+):
+    r = solve_fair(p, depth, n_cand, n_local, n_res, strategy1, has_second)
+    return jnp.concatenate(
+        [r.targets.astype(jnp.int32).reshape(-1), r.fits.astype(jnp.int32)]
+    )
+
+
+solve_fair_packed_jit = jax.jit(
+    _solve_fair_packed,
+    static_argnames=(
+        "depth", "n_cand", "n_local", "n_res", "strategy1", "has_second"
+    ),
+)

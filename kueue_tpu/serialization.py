@@ -510,15 +510,17 @@ def workload_from_dict(d: dict) -> Workload:
 
 
 # ---- whole-state save/load ----
-def runtime_from_state(data: dict, **runtime_kwargs):
-    """Build a ClusterRuntime from a serialized state dict (the wire
-    format consumed by the CLI's state file and the server's solver
-    endpoint). Insertion order mirrors cmd/kueue/main.go
-    setupControllers: flavors/topologies/cohorts/checks/classes before
-    queues, workloads last."""
+def runtime_from_state(data: dict, runtime=None, **runtime_kwargs):
+    """Build (or populate) a ClusterRuntime from a serialized state
+    dict (the wire format consumed by the CLI's state file and the
+    server's solver endpoint). Insertion order mirrors
+    cmd/kueue/main.go setupControllers: flavors/topologies/cohorts/
+    checks/classes before queues, workloads last. Pass ``runtime`` to
+    load into a preconfigured runtime (e.g. one built from a --config
+    file)."""
     from kueue_tpu.controllers import ClusterRuntime
 
-    rt = ClusterRuntime(**runtime_kwargs)
+    rt = runtime if runtime is not None else ClusterRuntime(**runtime_kwargs)
     for f in data.get("resourceFlavors", []):
         rt.add_flavor(flavor_from_dict(f))
     for t in data.get("topologies", []):

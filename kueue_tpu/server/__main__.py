@@ -20,7 +20,15 @@ def main(argv=None) -> int:
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8082)
     parser.add_argument(
-        "--state", help="JSON state file to preload (CLI wire format)"
+        "--config",
+        help="manager configuration file (kueue_tpu.config schema, "
+        "the --config of cmd/kueue/main.go)",
+    )
+    parser.add_argument(
+        "--state",
+        help="JSON state file (CLI wire format): loaded at startup if "
+        "present, written back on shutdown — the durable checkpoint "
+        "active-passive recovery restarts from",
     )
     parser.add_argument(
         "--no-solver", action="store_true",
@@ -32,17 +40,29 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
+    import os
+
     from kueue_tpu import serialization as ser
     from kueue_tpu.server import KueueServer
 
     use_solver = False if args.no_solver else None
-    if args.state:
-        with open(args.state) as f:
-            runtime = ser.runtime_from_state(json.load(f), use_solver=use_solver)
+    if args.config:
+        import yaml
+
+        from kueue_tpu.config import load_config, runtime_from_config
+
+        with open(args.config) as f:
+            cfg = load_config(yaml.safe_load(f))
+        runtime = runtime_from_config(cfg)
+        if use_solver is not None:
+            runtime.scheduler.use_solver = use_solver
     else:
         from kueue_tpu.controllers import ClusterRuntime
 
         runtime = ClusterRuntime(use_solver=use_solver)
+    if args.state and os.path.exists(args.state):
+        with open(args.state) as f:
+            ser.runtime_from_state(json.load(f), runtime=runtime)
     srv = KueueServer(
         runtime=runtime,
         host=args.host,
@@ -56,6 +76,11 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     stop.wait()
     srv.stop()
+    if args.state:
+        with srv.lock:
+            with open(args.state, "w") as f:
+                json.dump(ser.runtime_to_state(runtime), f, indent=1)
+        print(f"state saved to {args.state}", flush=True)
     return 0
 
 

@@ -1,0 +1,207 @@
+"""Batched fair-sharing victim search vs the host tournament.
+
+Randomized decision parity: ops/fair_preempt_kernel (vmapped device
+tournament) must produce the same victim sets as
+core/preemption._fair_preemptions for every head, across cohort
+shapes, weights, borrowing patterns, and both strategy stacks."""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.models import (
+    ClusterQueue,
+    FlavorQuotas,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import FairSharing, Preemption, ResourceGroup
+from kueue_tpu.models.cohort import Cohort
+from kueue_tpu.models.constants import (
+    PreemptionPolicy,
+    ReclaimWithinCohortPolicy,
+)
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.preemption import (
+    LESS_THAN_INITIAL_SHARE,
+    LESS_THAN_OR_EQUAL_TO_FINAL_SHARE,
+    Preemptor,
+)
+from kueue_tpu.core.preempt_batch import batched_fair_get_targets
+from kueue_tpu.core.flavor_assigner import FlavorAssigner
+from kueue_tpu.core.snapshot import take_snapshot
+from kueue_tpu.core.workload_info import make_admission
+from kueue_tpu.utils.clock import FakeClock
+
+
+def build_fair_cluster(seed, n_cohorts=2, cqs_per_cohort=3, victims_per_cq=3,
+                       deep=False, n_res=1):
+    """Cohort forest with admitted (partly borrowing) workloads."""
+    rng = np.random.default_rng(seed)
+    cache = Cache()
+    resources = ["cpu", "memory"][:n_res]
+    for f in ("fl-a", "fl-b"):
+        cache.add_or_update_flavor(ResourceFlavor(name=f))
+    prem = Preemption(
+        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+        reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+    )
+    cq_names = []
+    for ci in range(n_cohorts):
+        parent = None
+        if deep:
+            cache.add_or_update_cohort(Cohort(name=f"root-{ci}"))
+            cache.add_or_update_cohort(
+                Cohort(name=f"mid-{ci}", parent=f"root-{ci}")
+            )
+            parent = f"mid-{ci}"
+        for qi in range(cqs_per_cohort):
+            name = f"cq-{ci}-{qi}"
+            cq_names.append(name)
+            nfl = int(rng.integers(1, 3))
+            quotas = tuple(
+                FlavorQuotas.build(
+                    f, {res: str(int(rng.integers(4, 12))) for res in resources}
+                )
+                for f in ("fl-a", "fl-b")[:nfl]
+            )
+            cache.add_or_update_cluster_queue(
+                ClusterQueue(
+                    name=name,
+                    cohort=(
+                        parent
+                        if parent is not None and qi % 2 == 0
+                        else f"root-{ci}" if deep else f"cohort-{ci}"
+                    ),
+                    namespace_selector={},
+                    resource_groups=(ResourceGroup(tuple(resources), quotas),),
+                    preemption=prem,
+                    fair_sharing=FairSharing(
+                        weight_milli=int(rng.choice([500, 1000, 1000, 2000]))
+                    ),
+                )
+            )
+            flavor_names = [q.name for q in quotas]
+            for vi in range(int(rng.integers(1, victims_per_cq + 1))):
+                wl = Workload(
+                    namespace="ns", name=f"v-{ci}-{qi}-{vi}",
+                    queue_name=f"lq-{name}",
+                    priority=int(rng.integers(0, 3)) * 10,
+                    creation_time=float(rng.integers(0, 100)),
+                    pod_sets=(
+                        PodSet.build(
+                            "main", int(rng.integers(1, 4)),
+                            {
+                                res: str(int(rng.integers(1, 5)))
+                                for res in resources
+                            },
+                        ),
+                    ),
+                )
+                flavor = flavor_names[int(rng.integers(0, len(flavor_names)))]
+                wl.admission = make_admission(
+                    name, {"main": {res: flavor for res in resources}}, wl
+                )
+                from kueue_tpu.models import WorkloadConditionType
+
+                wl.set_condition(
+                    WorkloadConditionType.QUOTA_RESERVED, True,
+                    reason="QuotaReserved", now=float(vi),
+                )
+                cache.add_or_update_workload(wl)
+    return cache, cq_names
+
+
+def fair_items(cache, cq_names, seed, n_heads=6):
+    """Preempt-mode heads with their assignments (host authority)."""
+    rng = np.random.default_rng(seed + 1000)
+    snapshot = take_snapshot(cache)
+    assigner = FlavorAssigner(
+        snapshot, cache.flavors, enable_fair_sharing=True
+    )
+    items = []
+    for i in range(n_heads):
+        cq_name = cq_names[int(rng.integers(0, len(cq_names)))]
+        wl = Workload(
+            namespace="ns", name=f"head-{i}", queue_name=f"lq-{cq_name}",
+            priority=100, creation_time=1000.0 + i,
+            pod_sets=(
+                PodSet.build(
+                    "main", int(rng.integers(1, 3)),
+                    {"cpu": str(int(rng.integers(2, 8)))},
+                ),
+            ),
+        )
+        assignment = assigner.assign(wl, cq_name)
+        from kueue_tpu.core.flavor_assigner import Mode
+
+        if assignment.representative_mode() == Mode.PREEMPT:
+            items.append((wl, cq_name, assignment))
+    return snapshot, items
+
+
+def assert_fair_parity(seed, strategies, **cluster_kw):
+    cache, cq_names = build_fair_cluster(seed, **cluster_kw)
+    snapshot, items = fair_items(cache, cq_names, seed)
+    if not items:
+        pytest.skip("no preempt-mode heads generated")
+    preemptor = Preemptor(
+        FakeClock(0.0), enable_fair_sharing=True, fs_strategies=strategies
+    )
+    batched = batched_fair_get_targets(snapshot, items, preemptor)
+    for i, (wl, cq_name, assignment) in enumerate(items):
+        host = preemptor.get_targets(wl, cq_name, assignment, snapshot)
+        host_set = {
+            (t.workload.workload.name, t.reason) for t in host
+        }
+        dev_set = {
+            (t.workload.workload.name, t.reason) for t in batched[i]
+        }
+        assert dev_set == host_set, (
+            f"seed={seed} head={wl.name} cq={cq_name}: "
+            f"device={sorted(dev_set)} host={sorted(host_set)}"
+        )
+    return items
+
+
+BOTH = (LESS_THAN_OR_EQUAL_TO_FINAL_SHARE, LESS_THAN_INITIAL_SHARE)
+
+
+class TestFairPreemptParity:
+    @pytest.mark.parametrize("seed", range(12))
+    def test_randomized_default_strategies(self, seed):
+        assert_fair_parity(seed, BOTH)
+
+    @pytest.mark.parametrize("seed", range(12, 18))
+    def test_randomized_single_strategy(self, seed):
+        assert_fair_parity(seed, (LESS_THAN_OR_EQUAL_TO_FINAL_SHARE,))
+
+    @pytest.mark.parametrize("seed", range(18, 24))
+    def test_randomized_initial_share_first(self, seed):
+        assert_fair_parity(seed, (LESS_THAN_INITIAL_SHARE,))
+
+    @pytest.mark.parametrize("seed", range(24, 32))
+    def test_randomized_deep_trees(self, seed):
+        assert_fair_parity(seed, BOTH, deep=True, n_cohorts=2)
+
+    @pytest.mark.parametrize("seed", range(32, 38))
+    def test_randomized_two_resources(self, seed):
+        assert_fair_parity(seed, BOTH, n_res=2)
+
+    def test_some_scenario_produces_targets(self):
+        """Sanity: across the seeds at least one head actually preempts
+        (guards against vacuous parity)."""
+        found = False
+        for seed in range(12):
+            cache, cq_names = build_fair_cluster(seed)
+            snapshot, items = fair_items(cache, cq_names, seed)
+            if not items:
+                continue
+            preemptor = Preemptor(
+                FakeClock(0.0), enable_fair_sharing=True, fs_strategies=BOTH
+            )
+            out = batched_fair_get_targets(snapshot, items, preemptor)
+            if any(out):
+                found = True
+                break
+        assert found
