@@ -234,6 +234,113 @@ def contended_drain_bench(rng):
     )
 
 
+def fair_victim_search_bench(rng):
+    """Fair-sharing victim search, batched: N preempt-mode heads across
+    borrowing cohorts resolved in ONE device dispatch
+    (ops/fair_preempt_kernel), vs the host tournament running the same
+    searches sequentially (preemption.go:372-463). Returns
+    (device_ms, host_ms, n_heads)."""
+    import time
+
+    from kueue_tpu.models import (
+        ClusterQueue,
+        FlavorQuotas,
+        Preemption,
+        ResourceFlavor,
+        Workload,
+        WorkloadConditionType,
+    )
+    from kueue_tpu.models.cluster_queue import FairSharing, ResourceGroup
+    from kueue_tpu.models.constants import (
+        PreemptionPolicy,
+        ReclaimWithinCohortPolicy,
+    )
+    from kueue_tpu.models.workload import PodSet
+    from kueue_tpu.core.cache import Cache
+    from kueue_tpu.core.flavor_assigner import FlavorAssigner, Mode
+    from kueue_tpu.core.preempt_batch import batched_fair_get_targets
+    from kueue_tpu.core.preemption import Preemptor
+    from kueue_tpu.core.snapshot import take_snapshot
+    from kueue_tpu.core.workload_info import make_admission
+    from kueue_tpu.utils.clock import FakeClock
+
+    n_cohorts, cqs_per_cohort, victims_per_cq = 64, 4, 6
+    cache = Cache()
+    cache.add_or_update_flavor(ResourceFlavor(name="default"))
+    prem = Preemption(
+        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+        reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+    )
+    cq_names = []
+    for ci in range(n_cohorts):
+        for qi in range(cqs_per_cohort):
+            name = f"fcq-{ci}-{qi}"
+            cq_names.append(name)
+            cache.add_or_update_cluster_queue(
+                ClusterQueue(
+                    name=name,
+                    cohort=f"fco-{ci}",
+                    namespace_selector={},
+                    resource_groups=(
+                        ResourceGroup(
+                            ("cpu",),
+                            (FlavorQuotas.build("default", {"cpu": "8"}),),
+                        ),
+                    ),
+                    preemption=prem,
+                    fair_sharing=FairSharing(
+                        weight_milli=int(rng.choice([500, 1000, 2000]))
+                    ),
+                )
+            )
+            # over-admit so CQs borrow from the cohort
+            for v in range(victims_per_cq):
+                wl = Workload(
+                    namespace="ns", name=f"fv-{ci}-{qi}-{v}",
+                    queue_name=f"lq-{name}",
+                    priority=int(rng.integers(0, 30)),
+                    creation_time=float(v),
+                    pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
+                )
+                wl.admission = make_admission(
+                    name, {"main": {"cpu": "default"}}, wl
+                )
+                wl.set_condition(
+                    WorkloadConditionType.QUOTA_RESERVED, True,
+                    reason="QuotaReserved", now=float(v),
+                )
+                cache.add_or_update_workload(wl)
+    snapshot = take_snapshot(cache)
+    assigner = FlavorAssigner(snapshot, cache.flavors, enable_fair_sharing=True)
+    items = []
+    for i, name in enumerate(cq_names):
+        wl = Workload(
+            namespace="ns", name=f"fh-{i}", queue_name=f"lq-{name}",
+            priority=100, creation_time=1000.0 + i,
+            pod_sets=(
+                PodSet.build("main", 1, {"cpu": str(int(rng.integers(4, 8)))}),
+            ),
+        )
+        a = assigner.assign(wl, name)
+        if a.representative_mode() == Mode.PREEMPT:
+            items.append((wl, name, a))
+    preemptor = Preemptor(FakeClock(0.0), enable_fair_sharing=True)
+    batched_fair_get_targets(snapshot, items, preemptor)  # warm compile
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = batched_fair_get_targets(snapshot, items, preemptor)
+        times.append(time.perf_counter() - t0)
+    # strategy gates legitimately reject many heads; just require the
+    # batch to be non-trivially productive
+    assert sum(1 for t in out if t) >= len(items) // 8
+    t0 = time.perf_counter()
+    for wl, name, a in items:
+        preemptor.get_targets(wl, name, a, snapshot)
+    host_s = time.perf_counter() - t0
+    return float(np.median(times)) * 1e3, host_s * 1e3, len(items)
+
+
 def tas_placement_bench(rng):
     """50k-pod gang placement over a 3-level topology (block -> rack ->
     hostname): TASFlavorSnapshot's two-phase fit
@@ -324,6 +431,7 @@ def main():
 
     cd_ms, cd_cycles, cd_admitted, cd_evicted = contended_drain_bench(rng)
     tas_ms, tas_leaves, tas_pods = tas_placement_bench(rng)
+    fair_ms, fair_host_ms, fair_heads = fair_victim_search_bench(rng)
 
     print(
         json.dumps(
@@ -354,6 +462,19 @@ def main():
                 "tas_value": round(tas_ms, 3),
                 "tas_unit": "ms/placement",
                 "tas_vs_baseline": round(BASELINE_MS / tas_ms, 2),
+                "fair_metric": (
+                    f"fair_victim_search ({fair_heads} preempt heads over "
+                    f"64 borrowing cohorts, batched tournament, one "
+                    f"dispatch; host tournament {round(fair_host_ms, 1)} ms)"
+                ),
+                "fair_value": round(fair_ms, 3),
+                "fair_unit": "ms/batch",
+                # one interactive dispatch carries the ~140ms tunnel
+                # round trip on remote-attached TPUs; the honest
+                # comparison for this batch is against the host
+                # tournament doing the same searches sequentially
+                "fair_vs_baseline": round(BASELINE_MS / fair_ms, 2),
+                "fair_speedup_vs_host": round(fair_host_ms / fair_ms, 1),
             }
         )
     )

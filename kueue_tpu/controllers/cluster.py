@@ -237,7 +237,97 @@ class ClusterRuntime:
         self.queues.forest.add_cohort(cohort.name, cohort.parent)
 
     def add_admission_check(self, ac: AdmissionCheck) -> None:
+        old = self.cache.admission_checks.get(ac.name)
+        if ac.active is None and old is not None:
+            # the Active condition is controller-owned status; a spec
+            # re-apply that doesn't carry it must not reset it
+            ac.active = old.active
+            ac.active_message = old.active_message
         self.cache.add_or_update_admission_check(ac)
+        if old is not None and old.active != ac.active:
+            self._reactivate_cqs_with_check(ac.name)
+
+    def _reactivate_cqs_with_check(self, name: str) -> None:
+        # activity change invalidates CQ statuses: reactivate parked
+        # heads of affected CQs so the next cycle re-evaluates them
+        for cq_name, cached in self.cache.cluster_queues.items():
+            if name in self.cache._all_check_names(cached.model):
+                self.queues.queue_associated_inadmissible_workloads_after(cq_name)
+
+    def set_admission_check_active(
+        self, name: str, active: bool, message: str = ""
+    ) -> None:
+        """AdmissionCheck Active-condition lifecycle
+        (admissioncheck_controller.go:83-116): the owning controller
+        flips it when parameters (fail to) resolve; dependent CQs go
+        inactive and their heads park until it recovers."""
+        ac = self.cache.admission_checks.get(name)
+        if ac is None or (ac.active == active and ac.active_message == message):
+            return
+        ac.active = active
+        ac.active_message = message
+        self._reactivate_cqs_with_check(name)
+
+    def local_queue_status(self, namespace: str, name: str) -> Optional[dict]:
+        """LocalQueueStatus mirror (localqueue_types.go:104-150):
+        pending/reserving/admitted counts + per-flavor usage."""
+        lq = self.cache.local_queues.get(f"{namespace}/{name}")
+        if lq is None:
+            return None
+        pending_q = self.queues.cluster_queues.get(lq.cluster_queue)
+        pending = 0
+        if pending_q is not None:
+            pending = sum(
+                1
+                for wl in list(pending_q.heap.items())
+                + list(pending_q.inadmissible.values())
+                if wl.namespace == namespace and wl.queue_name == name
+            )
+        reserving = admitted = 0
+        cached = self.cache.cluster_queues.get(lq.cluster_queue)
+        if cached is not None:
+            for wl in cached.workloads.values():
+                if wl.namespace == namespace and wl.queue_name == name:
+                    reserving += 1
+                    admitted += wl.is_admitted
+        usage = self.cache.local_queue_usage(lq)
+        flavors = sorted({fr.flavor for fr in usage})
+        return {
+            "pendingWorkloads": pending,
+            "reservingWorkloads": reserving,
+            "admittedWorkloads": int(admitted),
+            "flavorUsage": [
+                {
+                    "name": fname,
+                    "resources": [
+                        {"name": fr.resource, "total": qty}
+                        for fr, qty in sorted(usage.items())
+                        if fr.flavor == fname
+                    ],
+                }
+                for fname in flavors
+            ],
+            "flavors": flavors,
+        }
+
+    def flavor_in_use(self, name: str) -> Optional[str]:
+        """First ClusterQueue referencing the flavor, or None — the
+        ResourceFlavor finalizer's guard (resourceflavor_controller.go:
+        the finalizer delays deletion while any CQ references it)."""
+        for cq_name, cached in self.cache.cluster_queues.items():
+            if name in cached.model.flavor_names():
+                return cq_name
+        return None
+
+    def delete_flavor(self, name: str) -> None:
+        in_use = self.flavor_in_use(name)
+        if in_use is not None:
+            raise ValueError(
+                f"resourceFlavor {name!r} is in use by clusterQueue {in_use!r}"
+            )
+        self.cache.delete_flavor(name)
+        if self.cache.tas_cache is not None:
+            self.cache.tas_cache.delete_flavor(name)
 
     def add_priority_class(self, pc: WorkloadPriorityClass) -> None:
         self.cache.add_or_update_priority_class(pc)

@@ -156,6 +156,10 @@ class Cache:
             if ac is None:
                 reasons.append("AdmissionCheckNotFound")
                 break
+            if ac.active is False:  # None = condition unset = active
+                # clusterqueue_controller.go: CheckNotFoundOrInactive
+                reasons.append("AdmissionCheckInactive")
+                break
         for fname in cq.flavor_names():
             flavor = self.flavors.get(fname)
             if flavor and flavor.topology_name and flavor.topology_name not in self.topologies:

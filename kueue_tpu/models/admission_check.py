@@ -19,6 +19,13 @@ class AdmissionCheck:
     controller_name: str
     parameters: Optional[str] = None  # opaque reference resolved by the controller
     retry_delay_seconds: int = 15
+    # Active condition (admissioncheck_controller.go:83-116): STATUS,
+    # owned by the check's controller — flipped when its parameters
+    # (fail to) resolve; CQs referencing an inactive check go inactive.
+    # None = unset (spec applies never carry it; the runtime preserves
+    # the previous condition on update and treats unset as active).
+    active: Optional[bool] = None
+    active_message: str = ""
 
     def __post_init__(self):
         if not (self.name and self.controller_name):

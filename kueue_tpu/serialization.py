@@ -244,6 +244,8 @@ def check_to_dict(ac: AdmissionCheck) -> dict:
         "name": ac.name,
         "controllerName": ac.controller_name,
         "parameters": ac.parameters,
+        "active": ac.active,
+        "activeMessage": ac.active_message,
     }
 
 
@@ -252,6 +254,10 @@ def check_from_dict(d: dict) -> AdmissionCheck:
         name=d["name"],
         controller_name=d["controllerName"],
         parameters=d.get("parameters"),
+        # absent = status unset (spec applies must not reset the
+        # controller-owned Active condition)
+        active=d.get("active"),
+        active_message=d.get("activeMessage", ""),
     )
 
 

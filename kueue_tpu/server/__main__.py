@@ -77,9 +77,14 @@ def main(argv=None) -> int:
     stop.wait()
     srv.stop()
     if args.state:
+        # atomic checkpoint: never truncate the previous state before
+        # the new one is fully on disk (a SIGKILL mid-write must not
+        # destroy the only durable copy)
+        tmp = args.state + ".tmp"
         with srv.lock:
-            with open(args.state, "w") as f:
+            with open(tmp, "w") as f:
                 json.dump(ser.runtime_to_state(runtime), f, indent=1)
+        os.replace(tmp, args.state)
         print(f"state saved to {args.state}", flush=True)
     return 0
 

@@ -280,6 +280,14 @@ class KueueServer:
                 if name not in self.runtime.cache.cluster_queues:
                     raise ApiError(404, f"clusterqueue {name} not found")
                 self.runtime.delete_cluster_queue(name)
+            elif section == "resourceflavors":
+                if name not in self.runtime.cache.flavors:
+                    raise ApiError(404, f"resourceflavor {name} not found")
+                try:
+                    self.runtime.delete_flavor(name)
+                except ValueError as e:
+                    # the ResourceFlavor finalizer's user-visible effect
+                    raise ApiError(409, str(e))
             else:
                 raise ApiError(405, f"delete not supported for {section}")
             if self.auto_reconcile:
@@ -407,8 +415,15 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
     ),
     (
         "DELETE",
-        re.compile(r"^/apis/kueue/v1beta1/(clusterqueues)/([^/]+)$"),
+        re.compile(r"^/apis/kueue/v1beta1/(clusterqueues|resourceflavors)/([^/]+)$"),
         "delete",
+    ),
+    (
+        "GET",
+        re.compile(
+            r"^/apis/kueue/v1beta1/localqueues/([^/]+)/([^/]+)/status$"
+        ),
+        "lq_status",
     ),
     ("POST", re.compile(r"^/reconcile$"), "reconcile"),
     ("GET", re.compile(r"^/state$"), "state"),
@@ -517,6 +532,13 @@ def _make_handler(srv: KueueServer):
 
         def _h_get_ns(self, section, ns, name, query):
             self._send_json(srv.get_object(section, ns, name))
+
+        def _h_lq_status(self, ns, name, query):
+            with srv.lock:
+                status = srv.runtime.local_queue_status(ns, name)
+            if status is None:
+                raise ApiError(404, f"localqueue {ns}/{name} not found")
+            self._send_json(status)
 
         def _h_get(self, section, name, query):
             self._send_json(srv.get_object(section, "", name))

@@ -327,6 +327,75 @@ class TestReviewRegressions:
         assert any(p["victim"] == "ns/victim" for p in out["preemptions"])
 
 
+class TestControllerBreadth:
+    def test_lq_status_mirror(self, client):
+        _seed(client)
+        client.apply("workloads", _wl_dict("w1", cpu="4"))
+        client.apply("workloads", _wl_dict("big", cpu="8"))  # stays pending
+        status = client._request(
+            "GET", "/apis/kueue/v1beta1/localqueues/ns/lq-a/status"
+        )
+        assert status["admittedWorkloads"] == 1
+        assert status["reservingWorkloads"] == 1
+        assert status["pendingWorkloads"] == 1
+        usage = status["flavorUsage"][0]
+        assert usage["name"] == "default"
+        assert usage["resources"][0] == {"name": "cpu", "total": 4000}
+
+    def test_resource_flavor_in_use_conflict(self, client):
+        _seed(client)
+        with pytest.raises(ClientError) as exc:
+            client._request(
+                "DELETE", "/apis/kueue/v1beta1/resourceflavors/default"
+            )
+        assert exc.value.status == 409
+        client.delete_cluster_queue("cq-a")
+        client._request("DELETE", "/apis/kueue/v1beta1/resourceflavors/default")
+        assert client.list("resourceflavors") == []
+
+    def test_admission_check_inactive_blocks_cq(self, server, client):
+        client.apply(
+            "resourceflavors", ser.flavor_to_dict(ResourceFlavor(name="default"))
+        )
+        client.apply(
+            "admissionchecks",
+            {"name": "prov", "controllerName": "test-controller"},
+        )
+        cq = _cq_dict()
+        cq["admissionChecks"] = ["prov"]
+        client.apply("clusterqueues", cq)
+        client.apply(
+            "localqueues",
+            ser.lq_to_dict(
+                LocalQueue(namespace="ns", name="lq-a", cluster_queue="cq-a")
+            ),
+        )
+        # flip the check inactive: the CQ must go inactive and the
+        # workload must not reserve quota
+        server.runtime.set_admission_check_active(
+            "prov", False, "parameters not found"
+        )
+        status = server.runtime.cache.cluster_queue_status("cq-a")
+        assert not status.active
+        assert "AdmissionCheckInactive" in status.reasons
+        client.apply("workloads", _wl_dict("w1"))
+        wl = next(w for w in client.state()["workloads"] if w["name"] == "w1")
+        assert wl.get("admission") is None
+        # a spec re-apply WITHOUT the status field must not reset the
+        # controller-owned Active condition
+        client.apply(
+            "admissionchecks",
+            {"name": "prov", "controllerName": "test-controller"},
+        )
+        status = server.runtime.cache.cluster_queue_status("cq-a")
+        assert not status.active and "AdmissionCheckInactive" in status.reasons
+        # recovery reactivates and admits
+        server.runtime.set_admission_check_active("prov", True)
+        client.reconcile()
+        wl = next(w for w in client.state()["workloads"] if w["name"] == "w1")
+        assert wl["admission"]["clusterQueue"] == "cq-a"
+
+
 class TestCliServerMode:
     def test_pending_workloads_via_server(self, server, client, capsys):
         from kueue_tpu.cli.__main__ import main
