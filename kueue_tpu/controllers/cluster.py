@@ -189,6 +189,12 @@ class ClusterRuntime:
         if considered:
             outcome = "success" if result.admitted else "inadmissible"
             self.metrics.report_admission_attempt(outcome, duration_s)
+            if self.scheduler.last_traces:
+                trace = self.scheduler.last_traces[-1]
+                for phase, seconds in trace.spans.items():
+                    self.metrics.admission_cycle_phase_duration_seconds.observe(
+                        seconds, phase=phase
+                    )
         for cq_name, pending in self.queues.cluster_queues.items():
             self.metrics.report_pending_workloads(
                 cq_name, pending.pending_active(), pending.pending_inadmissible()
@@ -244,15 +250,22 @@ class ClusterRuntime:
             ac.active = old.active
             ac.active_message = old.active_message
         self.cache.add_or_update_admission_check(ac)
-        if old is not None and old.active != ac.active:
+        # the check APPEARING is itself a status change: CQs that went
+        # inactive on AdmissionCheckNotFound must wake their parked
+        # heads, same as an active-flag flip
+        if old is None or old.active != ac.active:
             self._reactivate_cqs_with_check(ac.name)
 
     def _reactivate_cqs_with_check(self, name: str) -> None:
         # activity change invalidates CQ statuses: reactivate parked
-        # heads of affected CQs so the next cycle re-evaluates them
-        for cq_name, cached in self.cache.cluster_queues.items():
-            if name in self.cache._all_check_names(cached.model):
-                self.queues.queue_associated_inadmissible_workloads_after(cq_name)
+        # heads of affected CQs in ONE queue-manager pass
+        affected = {
+            cq_name
+            for cq_name, cached in self.cache.cluster_queues.items()
+            if name in self.cache._all_check_names(cached.model)
+        }
+        if affected:
+            self.queues.queue_inadmissible_workloads(affected)
 
     def set_admission_check_active(
         self, name: str, active: bool, message: str = ""

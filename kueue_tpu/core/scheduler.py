@@ -82,6 +82,36 @@ class Preemptor:
 
 
 @dataclass
+class CycleTrace:
+    """Per-cycle phase attribution — the pprof/log-attribution analog
+    (reference: schedulingCycle counter + verbose snapshot/attempt
+    dumps, pkg/scheduler/logging.go; the scalability harness' CPU
+    profiles). Kept in Scheduler.last_traces (ring buffer), observed
+    into the phase-duration histogram by the runtime, dumped by the
+    debugger and served at /debug/cycles."""
+
+    cycle: int = 0
+    heads: int = 0
+    admitted: int = 0
+    preempting: int = 0
+    resolution: str = "host"
+    total_s: float = 0.0
+    # phase -> seconds: snapshot / nominate / admit
+    spans: Dict[str, float] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "cycle": self.cycle,
+            "heads": self.heads,
+            "admitted": self.admitted,
+            "preempting": self.preempting,
+            "resolution": self.resolution,
+            "totalMs": round(self.total_s * 1e3, 3),
+            "spansMs": {k: round(v * 1e3, 3) for k, v in self.spans.items()},
+        }
+
+
+@dataclass
 class CycleResult:
     admitted: List[Entry] = field(default_factory=list)
     preempting: List[Entry] = field(default_factory=list)
@@ -157,20 +187,37 @@ class Scheduler:
         self.preempt_solver_threshold = preempt_solver_threshold
         self.transform_config = transform_config
         self.scheduling_cycle = 0
+        # per-cycle phase traces, newest last (ring buffer)
+        from collections import deque
+
+        self.last_traces = deque(maxlen=128)
 
     # ---- the cycle (scheduler.go:176-310) ----
     def schedule(self) -> CycleResult:
+        import time as _time
+
         self.scheduling_cycle += 1
         result = CycleResult()
+        trace = CycleTrace(cycle=self.scheduling_cycle)
+        t0 = _time.perf_counter()
 
         heads = self.queues.heads()
+        trace.heads = len(heads)
         if not heads:
             return result
 
         snapshot = take_snapshot(self.cache)
+        trace.spans["snapshot"] = _time.perf_counter() - t0
+        t1 = _time.perf_counter()
         entries, device_plan = self._nominate(heads, snapshot)
+        trace.spans["nominate"] = _time.perf_counter() - t1
         if device_plan is not None:
-            return self._finalize_device(entries, device_plan, snapshot, result)
+            t2 = _time.perf_counter()
+            out = self._finalize_device(entries, device_plan, snapshot, result)
+            trace.spans["admit"] = _time.perf_counter() - t2
+            self._finish_trace(trace, out, t0)
+            return out
+        t2 = _time.perf_counter()  # 'admit' includes the entry ordering
         ordered = self._iterate(entries, snapshot)
 
         preempted_keys: Dict[str, WorkloadSnapshot] = {}
@@ -303,7 +350,18 @@ class Scheduler:
             if e.status != EntryStatus.ASSUMED:
                 self._requeue_and_update(e)
                 result.requeued.append(e)
+        trace.spans["admit"] = _time.perf_counter() - t2
+        self._finish_trace(trace, result, t0)
         return result
+
+    def _finish_trace(self, trace: "CycleTrace", result: CycleResult, t0) -> None:
+        import time as _time
+
+        trace.total_s = _time.perf_counter() - t0
+        trace.admitted = len(result.admitted)
+        trace.preempting = len(result.preempting)
+        trace.resolution = result.resolution
+        self.last_traces.append(trace)
 
     # ---- nomination (scheduler.go:344-378) ----
     def _nominate(

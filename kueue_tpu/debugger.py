@@ -35,6 +35,18 @@ def dump(runtime) -> str:
             lines.append(f"  usage: {fr.flavor}/{fr.resource}={qty}")
     if runtime.cache.assumed_workloads:
         lines.append(f"assumed: {sorted(runtime.cache.assumed_workloads)}")
+    traces = list(getattr(runtime.scheduler, "last_traces", ()))
+    if traces:
+        lines.append("-- recent cycles (phase attribution) --")
+        for t in traces[-10:]:
+            spans = " ".join(
+                f"{k}={v * 1e3:.2f}ms" for k, v in t.spans.items()
+            )
+            lines.append(
+                f"cycle {t.cycle}: heads={t.heads} admitted={t.admitted} "
+                f"preempting={t.preempting} resolution={t.resolution} "
+                f"total={t.total_s * 1e3:.2f}ms {spans}"
+            )
     return "\n".join(lines)
 
 

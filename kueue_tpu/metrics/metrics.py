@@ -35,6 +35,12 @@ class Metrics:
             ("result",),
             buckets=ATTEMPT_BUCKETS,
         )
+        self.admission_cycle_phase_duration_seconds = r.histogram(
+            f"{NS}_admission_cycle_phase_duration_seconds",
+            "Per-phase latency of a scheduling cycle (snapshot|nominate|admit)",
+            ("phase",),
+            buckets=ATTEMPT_BUCKETS,
+        )
         self.admission_cycle_preemption_skips = r.gauge(
             f"{NS}_admission_cycle_preemption_skips",
             "Number of workloads whose preemption was skipped in the last cycle",

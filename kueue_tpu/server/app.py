@@ -426,6 +426,7 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
         "lq_status",
     ),
     ("POST", re.compile(r"^/reconcile$"), "reconcile"),
+    ("GET", re.compile(r"^/debug/cycles$"), "debug_cycles"),
     ("GET", re.compile(r"^/state$"), "state"),
     ("POST", re.compile(r"^/apis/solver/v1beta1/assign$"), "solve"),
     ("GET", re.compile(r"^/api/dashboard$"), "dashboard_json"),
@@ -575,6 +576,14 @@ def _make_handler(srv: KueueServer):
             with srv.lock:
                 cycles = srv.runtime.run_until_idle()
             self._send_json({"cycles": cycles})
+
+        def _h_debug_cycles(self, query):
+            # per-cycle phase attribution (the pprof-ish surface)
+            with srv.lock:
+                traces = [
+                    t.to_dict() for t in srv.runtime.scheduler.last_traces
+                ]
+            self._send_json({"cycles": traces})
 
         def _h_state(self, query):
             with srv.lock:  # snapshot under lock; write to client outside

@@ -172,3 +172,80 @@ class TestDebugger:
         assert "admitted=2" in text
         assert "inadmissible: " in text
         assert "usage: default/cpu=2000" in text
+
+
+class TestCycleTracing:
+    """Per-cycle phase attribution (the pprof/log-attribution analog)."""
+
+    def _runtime_with_work(self):
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.models import (
+            ClusterQueue,
+            FlavorQuotas,
+            LocalQueue,
+            ResourceFlavor,
+            Workload,
+        )
+        from kueue_tpu.models.cluster_queue import ResourceGroup
+        from kueue_tpu.models.workload import PodSet
+
+        rt = ClusterRuntime()
+        rt.add_flavor(ResourceFlavor(name="default"))
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name="cq", namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",), (FlavorQuotas.build("default", {"cpu": "8"}),)
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+        for i in range(3):
+            rt.add_workload(
+                Workload(
+                    namespace="ns", name=f"w{i}", queue_name="lq",
+                    pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
+                )
+            )
+        return rt
+
+    def test_traces_recorded_and_observed(self):
+        rt = self._runtime_with_work()
+        rt.run_until_idle()
+        traces = list(rt.scheduler.last_traces)
+        assert traces
+        t = traces[0]
+        assert t.heads >= 1 and t.admitted >= 1
+        assert set(t.spans) >= {"snapshot", "nominate", "admit"}
+        assert t.total_s > 0
+        d = t.to_dict()
+        assert d["spansMs"]["nominate"] >= 0
+        # histogram observed per phase
+        h = rt.metrics.admission_cycle_phase_duration_seconds
+        assert h.count(phase="nominate") >= 1
+        assert h.count(phase="admit") >= 1
+
+    def test_debugger_includes_traces(self):
+        from kueue_tpu.debugger import dump
+
+        rt = self._runtime_with_work()
+        rt.run_until_idle()
+        text = dump(rt)
+        assert "recent cycles" in text and "nominate=" in text
+
+    def test_server_debug_endpoint(self):
+        from kueue_tpu.server import KueueClient, KueueServer
+
+        rt = self._runtime_with_work()
+        srv = KueueServer(runtime=rt)
+        port = srv.start()
+        try:
+            client = KueueClient(f"http://127.0.0.1:{port}")
+            client.reconcile()
+            out = client._request("GET", "/debug/cycles")
+            assert out["cycles"]
+            assert "spansMs" in out["cycles"][0]
+        finally:
+            srv.stop()

@@ -396,6 +396,34 @@ class TestControllerBreadth:
         assert wl["admission"]["clusterQueue"] == "cq-a"
 
 
+class TestAdmissionCheckRecovery:
+    def test_check_created_after_cq_wakes_parked_heads(self, server, client):
+        """CQ references a check that doesn't exist yet: workloads park
+        on AdmissionCheckNotFound; CREATING the check must reactivate
+        them (the common apply-order recovery path)."""
+        client.apply(
+            "resourceflavors", ser.flavor_to_dict(ResourceFlavor(name="default"))
+        )
+        cq = _cq_dict()
+        cq["admissionChecks"] = ["late-check"]
+        client.apply("clusterqueues", cq)
+        client.apply(
+            "localqueues",
+            ser.lq_to_dict(
+                LocalQueue(namespace="ns", name="lq-a", cluster_queue="cq-a")
+            ),
+        )
+        client.apply("workloads", _wl_dict("w1"))
+        wl = next(w for w in client.state()["workloads"] if w["name"] == "w1")
+        assert wl.get("admission") is None  # parked: check missing
+        client.apply(
+            "admissionchecks",
+            {"name": "late-check", "controllerName": "test-controller"},
+        )
+        wl = next(w for w in client.state()["workloads"] if w["name"] == "w1")
+        assert wl["admission"]["clusterQueue"] == "cq-a"
+
+
 class TestCliServerMode:
     def test_pending_workloads_via_server(self, server, client, capsys):
         from kueue_tpu.cli.__main__ import main
