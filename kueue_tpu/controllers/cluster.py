@@ -232,11 +232,36 @@ class ClusterRuntime:
         self.cache.add_or_update_flavor(flavor)
         if self.cache.tas_cache is not None:
             self.cache.tas_cache.add_or_update_flavor(flavor)
+        # watcher fan-out (clusterqueue_controller.go:137-380): a flavor
+        # appearing OR changing (e.g. a corrected topology_name) can
+        # clear an inactive-CQ reason — wake referencing CQs' parked
+        # heads; still-inadmissible ones simply re-park
+        self._reactivate_cqs(lambda cq: flavor.name in cq.flavor_names())
 
     def add_topology(self, topo: Topology) -> None:
         self.cache.add_or_update_topology(topo)
         if self.cache.tas_cache is not None:
             self.cache.tas_cache.add_or_update_topology(topo)
+
+        # reactivate CQs whose TAS flavors reference this topology
+        # (TopologyNotFound recovery; updates included)
+        def refs_topo(cq) -> bool:
+            for fname in cq.flavor_names():
+                f = self.cache.flavors.get(fname)
+                if f is not None and f.topology_name == topo.name:
+                    return True
+            return False
+
+        self._reactivate_cqs(refs_topo)
+
+    def _reactivate_cqs(self, predicate) -> None:
+        affected = {
+            name
+            for name, cached in self.cache.cluster_queues.items()
+            if predicate(cached.model)
+        }
+        if affected:
+            self.queues.queue_inadmissible_workloads(affected)
 
     def add_cohort(self, cohort: Cohort) -> None:
         self.cache.add_or_update_cohort(cohort)
@@ -259,13 +284,9 @@ class ClusterRuntime:
     def _reactivate_cqs_with_check(self, name: str) -> None:
         # activity change invalidates CQ statuses: reactivate parked
         # heads of affected CQs in ONE queue-manager pass
-        affected = {
-            cq_name
-            for cq_name, cached in self.cache.cluster_queues.items()
-            if name in self.cache._all_check_names(cached.model)
-        }
-        if affected:
-            self.queues.queue_inadmissible_workloads(affected)
+        self._reactivate_cqs(
+            lambda cq: name in self.cache._all_check_names(cq)
+        )
 
     def set_admission_check_active(
         self, name: str, active: bool, message: str = ""

@@ -16,6 +16,8 @@ head counts while this driver remains the decision authority.
 from __future__ import annotations
 
 import functools
+import time as _time
+from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -188,14 +190,10 @@ class Scheduler:
         self.transform_config = transform_config
         self.scheduling_cycle = 0
         # per-cycle phase traces, newest last (ring buffer)
-        from collections import deque
-
         self.last_traces = deque(maxlen=128)
 
     # ---- the cycle (scheduler.go:176-310) ----
     def schedule(self) -> CycleResult:
-        import time as _time
-
         self.scheduling_cycle += 1
         result = CycleResult()
         trace = CycleTrace(cycle=self.scheduling_cycle)
@@ -205,9 +203,11 @@ class Scheduler:
         trace.heads = len(heads)
         if not heads:
             return result
+        trace.spans["heads"] = _time.perf_counter() - t0
 
+        t1 = _time.perf_counter()
         snapshot = take_snapshot(self.cache)
-        trace.spans["snapshot"] = _time.perf_counter() - t0
+        trace.spans["snapshot"] = _time.perf_counter() - t1
         t1 = _time.perf_counter()
         entries, device_plan = self._nominate(heads, snapshot)
         trace.spans["nominate"] = _time.perf_counter() - t1
@@ -355,8 +355,6 @@ class Scheduler:
         return result
 
     def _finish_trace(self, trace: "CycleTrace", result: CycleResult, t0) -> None:
-        import time as _time
-
         trace.total_s = _time.perf_counter() - t0
         trace.admitted = len(result.admitted)
         trace.preempting = len(result.preempting)

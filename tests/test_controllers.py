@@ -378,3 +378,149 @@ class TestReclaimablePods:
         a.succeeded = 2
         rt.run_until_idle()
         assert not b.is_suspended()
+
+
+class TestWatcherFanOut:
+    """clusterqueue_controller.go:137-380 watcher fan-out: objects a CQ
+    depends on APPEARING must wake workloads parked on the
+    corresponding *NotFound reason."""
+
+    def test_late_flavor_reactivates(self):
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.models import (
+            ClusterQueue,
+            FlavorQuotas,
+            LocalQueue,
+            ResourceFlavor,
+            Workload,
+        )
+        from kueue_tpu.models.cluster_queue import ResourceGroup
+        from kueue_tpu.models.workload import PodSet
+
+        rt = ClusterRuntime()
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name="cq", namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",),
+                        (FlavorQuotas.build("late-flavor", {"cpu": "8"}),),
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+        wl = Workload(
+            namespace="ns", name="w", queue_name="lq",
+            pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
+        )
+        rt.add_workload(wl)
+        rt.run_until_idle()
+        assert not wl.is_admitted
+        assert "FlavorNotFound" in rt.cache.cluster_queue_status("cq").reasons
+        rt.add_flavor(ResourceFlavor(name="late-flavor"))
+        rt.run_until_idle()
+        assert wl.is_admitted
+
+    def test_flavor_update_fixing_topology_ref_reactivates(self):
+        """A flavor UPDATE (corrected topology_name) must also wake
+        parked heads, not just flavor creation."""
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.models import (
+            ClusterQueue,
+            FlavorQuotas,
+            LocalQueue,
+            ResourceFlavor,
+            Workload,
+        )
+        from kueue_tpu.models.cluster_queue import ResourceGroup
+        from kueue_tpu.models.topology import Topology, TopologyLevel
+        from kueue_tpu.models.workload import PodSet
+        from kueue_tpu.resources import requests_from_spec
+        from kueue_tpu.tas.cache import Node, TASCache
+
+        rt = ClusterRuntime(tas_cache=TASCache())
+        rt.add_topology(
+            Topology(
+                name="real-topo",
+                levels=(TopologyLevel("kubernetes.io/hostname"),),
+            )
+        )
+        rt.add_node(
+            Node(
+                name="n1", labels={"kubernetes.io/hostname": "n1"},
+                allocatable=requests_from_spec({"cpu": "8", "pods": "10"}),
+            )
+        )
+        rt.add_flavor(ResourceFlavor(name="f", topology_name="typo-topo"))
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name="cq", namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",), (FlavorQuotas.build("f", {"cpu": "8"}),)
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+        wl = Workload(
+            namespace="ns", name="w", queue_name="lq",
+            pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
+        )
+        rt.add_workload(wl)
+        rt.run_until_idle()
+        assert not wl.is_admitted  # TopologyNotFound
+        rt.add_flavor(ResourceFlavor(name="f", topology_name="real-topo"))
+        rt.run_until_idle()
+        assert wl.is_admitted
+
+    def test_late_topology_reactivates(self):
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.models import (
+            ClusterQueue,
+            FlavorQuotas,
+            LocalQueue,
+            ResourceFlavor,
+            Workload,
+        )
+        from kueue_tpu.models.cluster_queue import ResourceGroup
+        from kueue_tpu.models.topology import Topology, TopologyLevel
+        from kueue_tpu.models.workload import PodSet
+        from kueue_tpu.resources import requests_from_spec
+        from kueue_tpu.tas.cache import Node, TASCache
+
+        rt = ClusterRuntime(tas_cache=TASCache())
+        rt.add_flavor(ResourceFlavor(name="tas-f", topology_name="late-topo"))
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name="cq", namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",), (FlavorQuotas.build("tas-f", {"cpu": "8"}),)
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+        wl = Workload(
+            namespace="ns", name="w", queue_name="lq",
+            pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
+        )
+        rt.add_workload(wl)
+        rt.run_until_idle()
+        assert not wl.is_admitted
+        rt.add_topology(
+            Topology(
+                name="late-topo",
+                levels=(TopologyLevel("kubernetes.io/hostname"),),
+            )
+        )
+        rt.add_node(
+            Node(
+                name="n1", labels={"kubernetes.io/hostname": "n1"},
+                allocatable=requests_from_spec({"cpu": "8", "pods": "10"}),
+            )
+        )
+        rt.run_until_idle()
+        assert wl.is_admitted
