@@ -225,13 +225,9 @@ class MultiKueueController:
         return [self.clusters[c] for c in cfg.clusters if c in self.clusters]
 
     def _local_job_for(self, wl: Workload):
-        for job in self.runtime.jobs.values():
-            if (
-                job.namespace == wl.namespace
-                and self.runtime.job_reconciler.workload_name_for(job) == wl.name
-            ):
-                return job
-        return None
+        # O(1) via the runtime's workload->job index (the reference
+        # resolves this through a field index, reconciler.go ownership)
+        return self.runtime.job_for(wl)
 
     def _remote_copy(self, wl: Workload) -> Workload:
         from kueue_tpu.admissionchecks.multikueue_transport import ORIGIN_LABEL

@@ -127,6 +127,8 @@ class ClusterRuntime:
         # AdmissionCheck controllers (provisioning, multikueue, custom):
         # name -> callable(workload) run during reconcile loops
         self.admission_check_controllers: List[Callable[[Workload], None]] = []
+        # QueueVisibility (deprecated, gated): cq -> top pending heads
+        self.cq_pending_snapshots: Dict[str, List[dict]] = {}
 
     def _make_preemptor(self, fair_sharing: bool):
         from kueue_tpu.core.preemption import Preemptor
@@ -497,6 +499,12 @@ class ClusterRuntime:
     def has_job_for(self, wl: Workload) -> bool:
         return wl.key in self._jobs_by_workload
 
+    def job_for(self, wl: Workload):
+        """The job owning this workload, or None (O(1) via the
+        workload->job index)."""
+        job_key = self._jobs_by_workload.get(wl.key)
+        return self.jobs.get(job_key) if job_key else None
+
     def requeue_after_backoff(self, wl: Workload) -> None:
         # The Requeued-condition flip is a workload update event: the
         # queue's push_or_update unparks it (manager.go UpdateWorkload).
@@ -537,6 +545,46 @@ class ClusterRuntime:
                 flush()
         if self.topology_ungater is not None:
             self._run_topology_ungater()
+        self._update_queue_visibility()
+
+    # CQ status pending-workloads snapshots (the deprecated
+    # QueueVisibility feature: clusterqueue_controller.go's snapshot
+    # worker publishing the top pending heads into CQ status; the
+    # on-demand visibility API is the successor and always available)
+    queue_visibility_max_count = 10
+    # refresh cadence (queueVisibility.updateIntervalSeconds — the
+    # reference runs a periodic worker, not an inline per-cycle sort)
+    queue_visibility_update_interval_s = 5.0
+    _queue_visibility_last = float("-inf")
+
+    def _update_queue_visibility(self) -> None:
+        from kueue_tpu.features import enabled
+
+        if not enabled("QueueVisibility"):
+            if self.cq_pending_snapshots:
+                self.cq_pending_snapshots = {}  # no stale data when off
+            return
+        now = self.clock.now()
+        if now - self._queue_visibility_last < self.queue_visibility_update_interval_s:
+            return
+        self._queue_visibility_last = now
+        from kueue_tpu.visibility import pending_workloads_in_cq
+
+        self.cq_pending_snapshots = {
+            name: [
+                {
+                    "name": pw.name,
+                    "namespace": pw.namespace,
+                    "localQueueName": pw.local_queue_name,
+                    "priority": pw.priority,
+                    "positionInClusterQueue": pw.position_in_cluster_queue,
+                }
+                for pw in pending_workloads_in_cq(
+                    self.queues, name, limit=self.queue_visibility_max_count
+                ).items
+            ]
+            for name in self.queues.cluster_queues
+        }
 
     def _run_topology_ungater(self) -> None:
         """Per TAS-admitted pod-group workload: deliver last pass's pod

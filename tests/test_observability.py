@@ -249,3 +249,51 @@ class TestCycleTracing:
             assert "spansMs" in out["cycles"][0]
         finally:
             srv.stop()
+
+
+def test_queue_visibility_snapshots_gated():
+    """Deprecated QueueVisibility: gated CQ-status snapshots of the top
+    pending heads (clusterqueue_controller.go snapshot worker)."""
+    from kueue_tpu.controllers import ClusterRuntime
+    from kueue_tpu.features import override
+    from kueue_tpu.models import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        ResourceFlavor,
+        Workload,
+    )
+    from kueue_tpu.models.cluster_queue import ResourceGroup
+    from kueue_tpu.models.workload import PodSet
+
+    rt = ClusterRuntime()
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq", namespace_selector={},
+            resource_groups=(
+                ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": "2"}),)),
+            ),
+        )
+    )
+    rt.add_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+    for i in range(4):
+        rt.add_workload(
+            Workload(
+                namespace="ns", name=f"w{i}", queue_name="lq",
+                priority=i, creation_time=float(i),
+                pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
+            )
+        )
+    rt.run_until_idle()
+    assert rt.cq_pending_snapshots == {}  # gate off by default
+    with override("QueueVisibility", True):
+        rt.queue_visibility_max_count = 2
+        rt.reconcile_once()
+        snap = rt.cq_pending_snapshots["cq"]
+        assert len(snap) == 2  # truncated to maxCount
+        # highest-priority pending head first
+        assert snap[0]["positionInClusterQueue"] == 0
+    # disabling the gate clears stale data on the next pass
+    rt.reconcile_once()
+    assert rt.cq_pending_snapshots == {}
