@@ -191,6 +191,19 @@ class Scheduler:
         self.scheduling_cycle = 0
         # per-cycle phase traces, newest last (ring buffer)
         self.last_traces = deque(maxlen=128)
+        # Latency-aware auto gating. A device dispatch pays a fixed
+        # round-trip cost (tens of ms on remote-attached TPUs) that only
+        # amortizes once the cycle batches enough heads, so auto mode
+        # measures both paths at runtime and routes each cycle to the
+        # cheaper one: EMA of the host cost per head, running MIN of the
+        # observed dispatch wall time (min because the first dispatch
+        # includes one-time XLA compilation). The min erodes slightly on
+        # every skip so a stale pessimistic sample (compile included)
+        # re-probes eventually instead of disabling the device forever.
+        self._host_assign_ema: Optional[float] = None  # s/head
+        self._device_dispatch_min: Optional[float] = None  # s/dispatch
+        self._host_victim_ema: Optional[float] = None  # s/deferred head
+        self._device_victim_min: Optional[float] = None  # s/batch
 
     # ---- the cycle (scheduler.go:176-310) ----
     def schedule(self) -> CycleResult:
@@ -371,17 +384,50 @@ class Scheduler:
             return entries, plan
         assigner = self._make_assigner(snapshot)
         deferred: List[Entry] = []
+        t_host = _time.perf_counter()
         for e in to_assign:
             self._host_assign(assigner, e, snapshot, deferred)
+        if to_assign:
+            per_head = (_time.perf_counter() - t_host) / len(to_assign)
+            self._host_assign_ema = (
+                per_head
+                if self._host_assign_ema is None
+                else 0.8 * self._host_assign_ema + 0.2 * per_head
+            )
         self._resolve_deferred(assigner, deferred, snapshot)
         return entries, None
+
+    # cold-start guesses until the first real measurement lands
+    _HOST_ASSIGN_DEFAULT = 1e-4  # s/head, host flavor loop
+    _HOST_VICTIM_DEFAULT = 4e-3  # s/head, host victim search
 
     def _solver_enabled(self, n_assignable: int) -> bool:
         if self.use_solver is False or n_assignable == 0:
             return False
         if self.use_solver is True:
             return True
-        return n_assignable >= self.solver_threshold
+        if n_assignable < self.solver_threshold:
+            return False
+        if self._device_dispatch_min is None:
+            return True  # probe once; the measurement gates later cycles
+        host_est = n_assignable * (
+            self._host_assign_ema or self._HOST_ASSIGN_DEFAULT
+        )
+        if host_est >= self._device_dispatch_min:
+            return True
+        self._device_dispatch_min *= 0.995  # stale-estimate erosion
+        return False
+
+    def _victim_device_worthwhile(self, n_deferred: int) -> bool:
+        if self._device_victim_min is None:
+            return True  # probe once
+        host_est = n_deferred * (
+            self._host_victim_ema or self._HOST_VICTIM_DEFAULT
+        )
+        if host_est >= self._device_victim_min:
+            return True
+        self._device_victim_min *= 0.995
+        return False
 
     def _make_assigner(self, snapshot: Snapshot) -> FlavorAssigner:
         return FlavorAssigner(
@@ -431,13 +477,12 @@ class Scheduler:
         batched on device above the threshold, host loop otherwise."""
         if not deferred:
             return
-        batch_on = (
-            self.use_preempt_solver is True
-            or (
-                self.use_preempt_solver is None
-                and len(deferred) >= self.preempt_solver_threshold
-            )
+        batch_on = self.use_preempt_solver is True or (
+            self.use_preempt_solver is None
+            and len(deferred) >= self.preempt_solver_threshold
+            and self._victim_device_worthwhile(len(deferred))
         )
+        t0 = _time.perf_counter()
         if batch_on:
             from kueue_tpu.core.preempt_batch import batched_get_targets
 
@@ -446,6 +491,12 @@ class Scheduler:
                 [(e.workload, e.cq_name, e.assignment) for e in deferred],
                 self.preemptor,
             )
+            dt = _time.perf_counter() - t0
+            self._device_victim_min = (
+                dt
+                if self._device_victim_min is None
+                else min(self._device_victim_min, dt)
+            )
         else:
             all_targets = [
                 self.preemptor.get_targets(
@@ -453,6 +504,12 @@ class Scheduler:
                 )
                 for e in deferred
             ]
+            per_head = (_time.perf_counter() - t0) / len(deferred)
+            self._host_victim_ema = (
+                per_head
+                if self._host_victim_ema is None
+                else 0.8 * self._host_victim_ema + 0.2 * per_head
+            )
         for e, targets in zip(deferred, all_targets):
             if targets:
                 e.preemption_targets = targets
@@ -541,7 +598,14 @@ class Scheduler:
                 self._host_assign(assigner, e, snapshot, deferred)
             self._resolve_deferred(assigner, deferred, snapshot)
             return None
+        t0 = _time.perf_counter()
         res = dispatch_lowered(snapshot, lowered)
+        dt = _time.perf_counter() - t0
+        self._device_dispatch_min = (
+            dt
+            if self._device_dispatch_min is None
+            else min(self._device_dispatch_min, dt)
+        )
         chosen = np.asarray(res.chosen)
         host_idx = [
             i

@@ -355,6 +355,43 @@ class TestDeviceResolution:
         res = sched.schedule()
         assert res.resolution == "host"
 
+    def test_auto_mode_is_latency_aware(self):
+        # Auto mode routes by measured cost: with a measured dispatch
+        # far above the host estimate, the device stays off; once the
+        # head count makes the host estimate exceed it, device turns on.
+        spec = random_spec(3, n_cohorts=1, cqs_per_cohort=2, workloads_per_cq=1)
+        sched, _, _, _ = build_env(spec, use_solver=None)
+        sched.solver_threshold = 4
+        sched._host_assign_ema = 1e-4
+        sched._device_dispatch_min = 0.05  # 50 ms tunnel dispatch
+        assert not sched._solver_enabled(10)  # 1 ms host < 50 ms device
+        assert sched._solver_enabled(10_000)  # 1 s host > 50 ms device
+        # no measurement yet -> probe the device once
+        sched2, _, _, _ = build_env(spec, use_solver=None)
+        sched2.solver_threshold = 4
+        assert sched2._solver_enabled(4)
+
+    def test_auto_mode_stale_estimate_erodes(self):
+        # A pessimistic first sample (XLA compile included) must not
+        # disable the device forever: each skip erodes the stored min.
+        spec = random_spec(3, n_cohorts=1, cqs_per_cohort=2, workloads_per_cq=1)
+        sched, _, _, _ = build_env(spec, use_solver=None)
+        sched.solver_threshold = 4
+        sched._host_assign_ema = 1e-4
+        sched._device_dispatch_min = 30.0  # cold compile sample
+        for _ in range(5):
+            assert not sched._solver_enabled(100)
+        assert sched._device_dispatch_min < 30.0
+
+    def test_auto_mode_probes_then_measures(self):
+        # End to end: first eligible auto cycle dispatches (probe) and
+        # records a measurement; the gate then has real data.
+        spec = random_spec(11, n_cohorts=2, cqs_per_cohort=4, workloads_per_cq=4)
+        sched, mgr, cache, _ = build_env(spec, use_solver=None)
+        sched.solver_threshold = 1
+        sched.schedule()
+        assert sched._device_dispatch_min is not None
+
 
 class TestCursorParity:
     def test_requeued_fit_head_keeps_host_cursor(self):
