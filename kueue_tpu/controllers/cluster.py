@@ -63,6 +63,11 @@ class ClusterRuntime:
         self.queues = QueueManager(self.clock)
         self.workloads: Dict[str, Workload] = {}
         self.jobs: Dict[str, GenericJob] = {}
+        # field-index layer (pkg/controller/core/indexer): queue key,
+        # admitted CQ, admission-check name -> workload keys
+        from kueue_tpu.controllers.indexer import workload_indexer
+
+        self.indexer = workload_indexer()
         # workload key -> job key (O(1) has_job_for on eviction paths)
         self._jobs_by_workload: Dict[str, str] = {}
         self.events: List[Event] = []
@@ -144,6 +149,12 @@ class ClusterRuntime:
     # ---- events ----
     def event(self, kind: str, wl: Workload, message: str = "") -> None:
         self.events.append(Event(kind=kind, object_key=wl.key, message=message))
+        # status transitions mutate workloads in place (admission set/
+        # cleared, check states flipped); the informer cache the
+        # reference indexes over sees those as update events, so the
+        # index refreshes here — every transition emits an event
+        if wl.key in self.workloads:
+            self.indexer.update(wl.key, wl)
         self._record_metric_event(kind, wl)
 
     def _record_metric_event(self, kind: str, wl: Workload) -> None:
@@ -310,22 +321,23 @@ class ClusterRuntime:
         lq = self.cache.local_queues.get(f"{namespace}/{name}")
         if lq is None:
             return None
-        pending_q = self.queues.cluster_queues.get(lq.cluster_queue)
-        pending = 0
-        if pending_q is not None:
-            pending = sum(
-                1
-                for wl in list(pending_q.heap.items())
-                + list(pending_q.inadmissible.values())
-                if wl.namespace == namespace and wl.queue_name == name
-            )
-        reserving = admitted = 0
-        cached = self.cache.cluster_queues.get(lq.cluster_queue)
-        if cached is not None:
-            for wl in cached.workloads.values():
-                if wl.namespace == namespace and wl.queue_name == name:
-                    reserving += 1
-                    admitted += wl.is_admitted
+        # resolve members via the queue-key field index instead of
+        # scanning heaps and the CQ's workload map (the reference lists
+        # with MatchingFields{WorkloadQueueKey}, localqueue_controller)
+        from kueue_tpu.controllers.indexer import WORKLOAD_QUEUE_KEY
+
+        pending = reserving = admitted = 0
+        for key in self.indexer.lookup(
+            WORKLOAD_QUEUE_KEY, f"{namespace}/{name}"
+        ):
+            wl = self.workloads.get(key)
+            if wl is None or wl.is_finished:
+                continue
+            if wl.has_quota_reservation:
+                reserving += 1
+                admitted += wl.is_admitted
+            elif wl.active:
+                pending += 1
         usage = self.cache.local_queue_usage(lq)
         flavors = sorted({fr.flavor for fr in usage})
         return {
@@ -436,6 +448,7 @@ class ClusterRuntime:
                     old.admission.cluster_queue if old.admission else ""
                 )
         self.workloads[wl.key] = wl
+        self.indexer.update(wl.key, wl)
         if wl.is_finished:
             return
         if wl.admission is not None and wl.has_quota_reservation:
@@ -458,6 +471,7 @@ class ClusterRuntime:
 
     def delete_workload(self, wl: Workload) -> None:
         self.workloads.pop(wl.key, None)
+        self.indexer.delete(wl.key)
         self.queues.delete_workload(wl)
         if self.topology_ungater is not None:
             # drop any outstanding ungate expectations: a recreated
@@ -496,6 +510,16 @@ class ClusterRuntime:
         if wl.active:
             self.queues.requeue_workload(wl, RequeueReason.GENERIC)
 
+    def list_workloads(self, field: str, value: str) -> List[Workload]:
+        """Index-backed workload listing (the analog of client.List with
+        MatchingFields over a registered field index)."""
+        out = []
+        for key in self.indexer.lookup(field, value):
+            wl = self.workloads.get(key)
+            if wl is not None:
+                out.append(wl)
+        return out
+
     def has_job_for(self, wl: Workload) -> bool:
         return wl.key in self._jobs_by_workload
 
@@ -519,6 +543,8 @@ class ClusterRuntime:
     def on_workload_queue_changed(self, wl: Workload) -> None:
         self.queues.delete_workload(wl)
         self.queues.add_or_update_workload(wl)
+        # queue_name is an indexed field mutated in place with no event
+        self.indexer.update(wl.key, wl)
 
     def update_reclaimable_pods(self, wl: Workload, recl: Dict[str, int]) -> None:
         wl.reclaimable_pods = dict(recl)

@@ -1,0 +1,201 @@
+"""Field-index layer tests (pkg/controller/core/indexer/indexer.go).
+
+Covers the generic FieldIndexer (multi-value postings, incremental
+update/delete, registration ordering) and the runtime wiring: the
+standard workload indexes stay consistent through admission, eviction
+and deletion, and index-backed listings match brute-force scans.
+"""
+
+import pytest
+
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.controllers.indexer import (
+    WORKLOAD_ADMISSION_CHECK_KEY,
+    WORKLOAD_CLUSTER_QUEUE_KEY,
+    WORKLOAD_QUEUE_KEY,
+    FieldIndexer,
+    workload_indexer,
+)
+from kueue_tpu.controllers.jobs import BatchJob
+from kueue_tpu.models import (
+    AdmissionCheck,
+    ClusterQueue,
+    LocalQueue,
+    ResourceFlavor,
+)
+from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+from kueue_tpu.utils.clock import FakeClock
+
+
+class TestFieldIndexer:
+    def test_multi_value_postings(self):
+        ix = FieldIndexer()
+        ix.register("tags", lambda o: list(o))
+        ix.update("a", ["x", "y"])
+        ix.update("b", ["y"])
+        assert ix.lookup("tags", "x") == ["a"]
+        assert ix.lookup("tags", "y") == ["a", "b"]
+        assert ix.values("tags") == ["x", "y"]
+
+    def test_update_replaces_old_postings(self):
+        ix = FieldIndexer()
+        ix.register("tags", lambda o: list(o))
+        ix.update("a", ["x"])
+        ix.update("a", ["z"])
+        assert ix.lookup("tags", "x") == []
+        assert ix.lookup("tags", "z") == ["a"]
+
+    def test_delete_clears_empty_posting(self):
+        ix = FieldIndexer()
+        ix.register("tags", lambda o: list(o))
+        ix.update("a", ["x"])
+        ix.delete("a")
+        assert ix.lookup("tags", "x") == []
+        assert ix.values("tags") == []
+        assert len(ix) == 0
+
+    def test_empty_values_not_indexed(self):
+        ix = FieldIndexer()
+        ix.register("tags", lambda o: list(o))
+        ix.update("a", [""])
+        assert ix.values("tags") == []
+
+    def test_duplicate_registration_rejected(self):
+        ix = FieldIndexer()
+        ix.register("f", lambda o: [])
+        with pytest.raises(ValueError):
+            ix.register("f", lambda o: [])
+
+    def test_late_registration_rejected(self):
+        ix = FieldIndexer()
+        ix.register("f", lambda o: ["v"])
+        ix.update("a", object())
+        with pytest.raises(RuntimeError):
+            ix.register("g", lambda o: [])
+
+    def test_unknown_field_raises(self):
+        ix = FieldIndexer()
+        with pytest.raises(KeyError):
+            ix.lookup("nope", "v")
+
+
+def make_runtime(**kw):
+    checks = kw.pop("checks", None)
+    clock = FakeClock(start=1000.0)
+    rt = ClusterRuntime(clock=clock, **kw)
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq",
+            namespace_selector={},
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu",), (FlavorQuotas.build("default", {"cpu": "4"}),)
+                ),
+            ),
+            **({"admission_checks": checks} if checks else {}),
+        )
+    )
+    rt.add_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+    return rt, clock
+
+
+class TestRuntimeWiring:
+    def test_queue_index_tracks_lifecycle(self):
+        rt, _ = make_runtime()
+        job = BatchJob.build("ns", "j1", "lq", parallelism=1, requests={"cpu": "1"})
+        rt.add_job(job)
+        rt.reconcile_once()
+        keys = rt.indexer.lookup(WORKLOAD_QUEUE_KEY, "ns/lq")
+        assert len(keys) == 1
+        wls = rt.list_workloads(WORKLOAD_QUEUE_KEY, "ns/lq")
+        assert [w.queue_name for w in wls] == ["lq"]
+        rt.delete_job(job.key)
+        rt.reconcile_once()
+        assert rt.indexer.lookup(WORKLOAD_QUEUE_KEY, "ns/lq") == []
+
+    def test_cluster_queue_index_follows_admission(self):
+        rt, _ = make_runtime()
+        job = BatchJob.build("ns", "j1", "lq", parallelism=1, requests={"cpu": "1"})
+        rt.add_job(job)
+        rt.reconcile_once()  # creates the workload
+        assert rt.indexer.lookup(WORKLOAD_CLUSTER_QUEUE_KEY, "cq") == []
+        rt.schedule_once()  # admits -> admission set, event emitted
+        rt.reconcile_once()
+        admitted = rt.list_workloads(WORKLOAD_CLUSTER_QUEUE_KEY, "cq")
+        assert len(admitted) == 1
+        assert admitted[0].admission.cluster_queue == "cq"
+
+    def test_admission_check_index(self):
+        rt, _ = make_runtime(checks=("prov",))
+        rt.add_admission_check(AdmissionCheck(name="prov", controller_name="c"))
+        job = BatchJob.build("ns", "j1", "lq", parallelism=1, requests={"cpu": "1"})
+        rt.add_job(job)
+        rt.reconcile_once()
+        rt.schedule_once()
+        rt.reconcile_once()  # workload controller syncs check states
+        assert len(rt.indexer.lookup(WORKLOAD_ADMISSION_CHECK_KEY, "prov")) == 1
+
+    def test_index_matches_brute_force_scan(self):
+        rt, _ = make_runtime()
+        for i in range(6):
+            rt.add_job(
+                BatchJob.build(
+                    "ns", f"j{i}", "lq", parallelism=1, requests={"cpu": "1"}
+                )
+            )
+        rt.reconcile_once()
+        for _ in range(6):
+            rt.schedule_once()
+        rt.reconcile_once()
+        want = sorted(
+            w.key
+            for w in rt.workloads.values()
+            if w.admission is not None and w.admission.cluster_queue == "cq"
+        )
+        assert rt.indexer.lookup(WORKLOAD_CLUSTER_QUEUE_KEY, "cq") == want
+
+    def test_local_queue_status_counts_from_index(self):
+        rt, _ = make_runtime()
+        # quota 4 cpus; 6 one-cpu jobs -> 4 admitted, 2 pending
+        for i in range(6):
+            rt.add_job(
+                BatchJob.build(
+                    "ns", f"j{i}", "lq", parallelism=1, requests={"cpu": "1"}
+                )
+            )
+        rt.reconcile_once()
+        for _ in range(6):  # heads() pops one head per CQ per cycle
+            rt.schedule_once()
+        rt.reconcile_once()
+        st = rt.local_queue_status("ns", "lq")
+        assert st["reservingWorkloads"] == 4
+        assert st["admittedWorkloads"] == 4
+        assert st["pendingWorkloads"] == 2
+
+
+def test_queue_change_refreshes_index():
+    # queue_name is mutated in place (jobframework queue-move) with no
+    # event; on_workload_queue_changed must refresh the index or the
+    # LQ status mirror counts the workload under the old queue forever
+    rt, _ = make_runtime()
+    rt.add_local_queue(LocalQueue(namespace="ns", name="lq2", cluster_queue="cq"))
+    job = BatchJob.build("ns", "j1", "lq", parallelism=1, requests={"cpu": "99"})
+    rt.add_job(job)
+    rt.reconcile_once()  # pending (doesn't fit), indexed under ns/lq
+    (wl,) = rt.list_workloads(WORKLOAD_QUEUE_KEY, "ns/lq")
+    wl.queue_name = "lq2"
+    rt.on_workload_queue_changed(wl)
+    assert rt.list_workloads(WORKLOAD_QUEUE_KEY, "ns/lq") == []
+    assert [w.key for w in rt.list_workloads(WORKLOAD_QUEUE_KEY, "ns/lq2")] == [wl.key]
+
+
+def test_standard_indexer_fields():
+    ix = workload_indexer()
+    assert sorted(ix._extractors) == sorted(
+        [
+            WORKLOAD_QUEUE_KEY,
+            WORKLOAD_CLUSTER_QUEUE_KEY,
+            WORKLOAD_ADMISSION_CHECK_KEY,
+        ]
+    )
