@@ -9,10 +9,72 @@ serves until interrupted.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
+import os
 import signal
 import sys
+import tempfile
 import threading
+
+
+def fenced_checkpoint(srv, state_path: str) -> bool:
+    """Atomically checkpoint srv.runtime to ``state_path``; returns
+    False without writing when this replica no longer holds the lease.
+
+    Atomic (unique tmp via mkstemp + os.replace under the server lock):
+    a SIGKILL mid-write must not destroy the only durable copy, and a
+    concurrent periodic + shutdown checkpoint must not race on a shared
+    tmp path. Fenced: with an elector, the dump/replace runs inside the
+    lease's critical section only while the on-disk record still names
+    us — a deposed leader resuming from a stall cannot clobber the new
+    leader's newer checkpoint (the fencing-token guarantee)."""
+    from kueue_tpu import serialization as ser
+
+    def _dump() -> None:
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(state_path) or ".", prefix=".state-"
+        )
+        try:
+            with srv.lock:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(ser.runtime_to_state(srv.runtime), f, indent=1)
+                os.replace(tmp, state_path)
+        except BaseException:
+            # failed dumps must not accumulate orphan tmp files on the
+            # (possibly already-full) shared volume
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            raise
+
+    if srv.elector is None:
+        _dump()
+        return True
+    lease = srv.elector.lease
+    with lease._locked():
+        if not lease.is_held():
+            return False  # deposed: the new leader owns the state file
+        _dump()
+    return True
+
+
+def promote_reload(srv, state_path: str, build_runtime) -> bool:
+    """On lease takeover, REBUILD srv.runtime from the old leader's
+    latest checkpoint — not an upsert into the standby's stale store,
+    which would resurrect objects the old leader deleted. Data loss is
+    bounded by the checkpoint period. Returns True when a checkpoint
+    was loaded."""
+    from kueue_tpu import serialization as ser
+
+    if not (state_path and os.path.exists(state_path)):
+        return False
+    fresh = build_runtime()
+    with open(state_path) as f:
+        ser.runtime_from_state(json.load(f), runtime=fresh)
+    with srv.lock:
+        srv.runtime = fresh
+        fresh.run_until_idle()
+    return True
 
 
 def main(argv=None) -> int:
@@ -38,54 +100,168 @@ def main(argv=None) -> int:
         "--no-auto-reconcile", action="store_true",
         help="only reconcile on POST /reconcile",
     )
+    parser.add_argument(
+        "--leader-elect-lease",
+        help="path to a shared lease file (on the state volume): "
+        "enables leader election — the holder accepts writes and "
+        "schedules, standbys serve reads and take over on lapse "
+        "(the LeaderElection analog of cmd/kueue/main.go)",
+    )
+    parser.add_argument(
+        "--leader-elect-identity",
+        help="this replica's identity in the lease "
+        "(default: hostname-pid)",
+    )
+    parser.add_argument(
+        "--leader-elect-lease-duration", type=float, default=15.0,
+        help="seconds a lapsed lease stays unclaimable before takeover",
+    )
+    parser.add_argument(
+        "--state-checkpoint-period", type=float, default=30.0,
+        help="seconds between periodic --state checkpoints while "
+        "leading (bounds data loss on SIGKILL; 0 disables)",
+    )
     args = parser.parse_args(argv)
-
-    import os
 
     from kueue_tpu import serialization as ser
     from kueue_tpu.server import KueueServer
 
     use_solver = False if args.no_solver else None
-    if args.config:
-        import yaml
 
-        from kueue_tpu.config import load_config, runtime_from_config
+    def build_runtime():
+        """Construct a runtime exactly the way startup does — also used
+        to REBUILD on promotion, so a promoted standby starts from the
+        checkpoint alone instead of merging it into a stale store."""
+        if args.config:
+            import yaml
 
-        with open(args.config) as f:
-            cfg = load_config(yaml.safe_load(f))
-        runtime = runtime_from_config(cfg)
-        if use_solver is not None:
-            runtime.scheduler.use_solver = use_solver
-    else:
+            from kueue_tpu.config import load_config, runtime_from_config
+
+            with open(args.config) as f:
+                cfg = load_config(yaml.safe_load(f))
+            rt = runtime_from_config(cfg)
+            if use_solver is not None:
+                rt.scheduler.use_solver = use_solver
+            return rt
         from kueue_tpu.controllers import ClusterRuntime
 
-        runtime = ClusterRuntime(use_solver=use_solver)
+        return ClusterRuntime(use_solver=use_solver)
+
+    runtime = build_runtime()
     if args.state and os.path.exists(args.state):
         with open(args.state) as f:
             ser.runtime_from_state(json.load(f), runtime=runtime)
+    srv = None  # assigned below; the callbacks close over it
+    # last_token: the fencing token of our last tenure. A promotion
+    # only reloads the checkpoint when the token moved — i.e. another
+    # holder (or an unknown intermediary) intervened. Re-acquiring our
+    # own still-valid lease after a transient renewal failure keeps the
+    # token, so a lease flap must NOT roll the runtime back to a
+    # checkpoint that predates writes we acknowledged. "boot" marks the
+    # initial synchronous tick in srv.start(): we just loaded the same
+    # checkpoint in main(), so reloading it again is pure waste.
+    ha = {"last_token": None, "boot": True}
+
+    def checkpoint() -> bool:
+        if not args.state:
+            return True
+        return fenced_checkpoint(srv, args.state)
+
+    def on_promoted() -> None:
+        tok = elector.lease.token
+        first = ha["boot"]  # cleared in main() right after srv.start()
+        resumed = ha["last_token"] is not None and ha["last_token"] == tok
+        ha["last_token"] = tok
+        if first or resumed:
+            return
+        if args.state and promote_reload(srv, args.state, build_runtime):
+            print(
+                "promoted to leader; rebuilt state from checkpoint",
+                flush=True,
+            )
+
+    elector = None
+    if args.leader_elect_lease:
+        import socket
+
+        from kueue_tpu.utils.lease import FileLease, LeaderElector
+
+        identity = (
+            args.leader_elect_identity
+            or f"{socket.gethostname()}-{os.getpid()}"
+        )
+        elector = LeaderElector(
+            FileLease(
+                args.leader_elect_lease,
+                identity,
+                duration=args.leader_elect_lease_duration,
+            ),
+            on_started_leading=on_promoted,
+        )
     srv = KueueServer(
         runtime=runtime,
         host=args.host,
         port=args.port,
         auto_reconcile=not args.no_auto_reconcile,
+        elector=elector,
     )
     port = srv.start()
-    print(f"kueue-tpu server listening on http://{args.host}:{port}", flush=True)
+    ha["boot"] = False  # any later promotion is a real takeover
+    role = ""
+    if elector is not None:
+        role = " as leader" if elector.is_leader else " as standby"
+    print(
+        f"kueue-tpu server listening on http://{args.host}:{port}{role}",
+        flush=True,
+    )
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
+
+    ckpt_thread = None
+    if args.state and args.state_checkpoint_period > 0:
+        # periodic leader checkpoints bound the data lost to a SIGKILL
+        # (and are what a promoted standby reloads); standbys never
+        # checkpoint — on a shared state volume that would clobber the
+        # leader's durable copy with a stale one
+        def _ckpt_loop():
+            while not stop.wait(args.state_checkpoint_period):
+                if elector is None or elector.is_leader:
+                    try:
+                        checkpoint()
+                    except Exception as e:  # noqa: BLE001 — any failure
+                        # (volume error, serialization bug) must not
+                        # silently kill periodic durability for the
+                        # rest of the process lifetime
+                        print(f"checkpoint failed: {e!r}", flush=True)
+
+        ckpt_thread = threading.Thread(target=_ckpt_loop, daemon=True)
+        ckpt_thread.start()
+
     stop.wait()
-    srv.stop()
-    if args.state:
-        # atomic checkpoint: never truncate the previous state before
-        # the new one is fully on disk (a SIGKILL mid-write must not
-        # destroy the only durable copy)
-        tmp = args.state + ".tmp"
-        with srv.lock:
-            with open(tmp, "w") as f:
-                json.dump(ser.runtime_to_state(runtime), f, indent=1)
-        os.replace(tmp, args.state)
-        print(f"state saved to {args.state}", flush=True)
+    was_leader = elector is None or elector.is_leader
+    # write-safe shutdown: requests drain, THEN the final checkpoint
+    # lands, THEN the lease is released — a standby promoted by the
+    # release reloads a checkpoint that includes every accepted write
+    final = {"saved": False}
+
+    def _final_checkpoint() -> None:
+        final["saved"] = checkpoint()
+
+    srv.stop(before_release=_final_checkpoint if was_leader else None)
+    if ckpt_thread is not None:
+        ckpt_thread.join(timeout=5)
+    if args.state and was_leader:
+        if final["saved"]:
+            print(f"state saved to {args.state}", flush=True)
+        else:
+            # the fence refused the write: the lease lapsed during
+            # drain and another replica owns the state file now
+            print(
+                f"final checkpoint SKIPPED (lease no longer held); "
+                f"latest state lives with the current leader",
+                flush=True,
+            )
     return 0
 
 

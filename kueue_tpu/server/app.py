@@ -215,6 +215,7 @@ class KueueServer:
         port: int = 0,
         auto_reconcile: bool = True,
         validators: Optional[list] = None,
+        elector=None,  # utils.lease.LeaderElector: HA replica mode
     ):
         if runtime is None:
             from kueue_tpu.controllers import ClusterRuntime
@@ -235,6 +236,21 @@ class KueueServer:
         self._thread: Optional[threading.Thread] = None
         self._host = host
         self._port = port
+        # Leader election (leader_aware_reconciler.go analog): with an
+        # elector configured, only the leader accepts mutating calls;
+        # standbys keep serving reads (visibility, metrics, dashboard,
+        # stateless solves) and take over when the lease lapses.
+        self.elector = elector
+        self._election_stop = threading.Event()
+        self._election_thread: Optional[threading.Thread] = None
+
+    def require_leader(self) -> None:
+        if self.elector is not None and not self.elector.is_leader:
+            raise ApiError(
+                503,
+                "not leader; writes are served by "
+                f"{self.elector.lease.holder() or 'no current holder'}",
+            )
 
     # ---- object API ----
     def _find_existing(self, section: str, obj: dict):
@@ -256,6 +272,7 @@ class KueueServer:
             raise ApiError(404, f"unknown section {section!r}")
         from kueue_tpu.webhooks import ValidationError
 
+        self.require_leader()
         with self.lock:
             old = self._find_existing(section, obj)
             try:
@@ -270,6 +287,7 @@ class KueueServer:
         return obj
 
     def delete(self, section: str, namespace: str, name: str) -> None:
+        self.require_leader()
         with self.lock:
             if section == "workloads":
                 wl = self.runtime.workloads.get(f"{namespace}/{name}")
@@ -299,6 +317,7 @@ class KueueServer:
         """External controller flips a check — phase 2 of two-phase
         admission (workload_controller.go:251-275 syncs the Admitted
         condition on the next reconcile)."""
+        self.require_leader()
         with self.lock:
             wl = self.runtime.workloads.get(f"{namespace}/{name}")
             if wl is None:
@@ -323,12 +342,23 @@ class KueueServer:
             model = sec.lookup(self.runtime, namespace, name)
             if model is None:
                 raise ApiError(404, f"{section[:-1]} {namespace}/{name} not found")
-            return sec.to_dict(model)
+            obj = sec.to_dict(model)
+            if section == "clusterqueues":
+                # QueueVisibility (gated): the reference publishes the
+                # interval snapshots into CQ .status.pendingWorkloadsStatus
+                # (clusterqueue_controller.go snapshot worker)
+                snap = self.runtime.cq_pending_snapshots.get(name)
+                if snap is not None:
+                    obj.setdefault("status", {})["pendingWorkloadsStatus"] = {
+                        "clusterQueuePendingWorkload": snap,
+                    }
+            return obj
 
     def apply_batch(self, body: dict) -> Dict[str, int]:
         """Bulk upsert: {section: [objects]} in one request (the
         MultiKueue batched-dispatch wire). Each object still passes the
         webhook admission chain; reconcile runs once at the end."""
+        self.require_leader()
         counts: Dict[str, int] = {}
         unknown = [s for s in body if s not in _SECTIONS]
         if unknown:
@@ -363,13 +393,44 @@ class KueueServer:
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+        if self.elector is not None:
+            self.elector.tick()  # contend immediately, then renew async
+            self._election_stop.clear()
+            self._election_thread = threading.Thread(
+                target=self._election_loop, daemon=True
+            )
+            self._election_thread.start()
         return self._httpd.server_address[1]
 
-    def stop(self) -> None:
+    def _election_loop(self) -> None:
+        # renew at a third of the lease duration, the same ratio as
+        # client-go's RenewDeadline/LeaseDuration defaults
+        period = max(self.elector.lease.duration / 3.0, 0.05)
+        while not self._election_stop.wait(period):
+            try:
+                self.elector.tick()
+            except Exception:  # noqa: BLE001 — a transient IO error on
+                # the lease volume must not kill the election loop (the
+                # lease would then silently lapse / never be contended)
+                pass
+
+    def stop(self, before_release=None) -> None:
+        """Shut down in write-safe order: stop accepting requests
+        FIRST, then run ``before_release`` (the final state checkpoint),
+        then release the lease — so a standby can only take over after
+        the checkpoint it will reload from is fully on disk."""
+        if self._election_thread is not None:
+            self._election_stop.set()
+            self._election_thread.join(timeout=5)
+            self._election_thread = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if before_release is not None:
+            before_release()
+        if self.elector is not None:
+            self.elector.step_down()
 
     @property
     def port(self) -> int:
@@ -378,7 +439,7 @@ class KueueServer:
 
 _ROUTES: List[Tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/healthz$"), "healthz"),
-    ("GET", re.compile(r"^/readyz$"), "healthz"),
+    ("GET", re.compile(r"^/readyz$"), "readyz"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
     (
         "GET",
@@ -499,6 +560,16 @@ def _make_handler(srv: KueueServer):
         def _h_healthz(self, query):
             self._send_json({"status": "ok"})
 
+        def _h_readyz(self, query):
+            # standby replicas are Ready (they serve reads) but report
+            # their role so probes/operators can tell them apart
+            body = {"status": "ok"}
+            if srv.elector is not None:
+                body["leader"] = srv.elector.is_leader
+                body["holder"] = srv.elector.lease.holder()
+                body["identity"] = srv.elector.identity
+            self._send_json(body)
+
         def _h_metrics(self, query):
             with srv.lock:
                 text = srv.runtime.metrics.registry.expose()
@@ -573,6 +644,7 @@ def _make_handler(srv: KueueServer):
             self._send_json({"updated": f"{ns}/{name}"})
 
         def _h_reconcile(self, query):
+            srv.require_leader()
             with srv.lock:
                 cycles = srv.runtime.run_until_idle()
             self._send_json({"cycles": cycles})

@@ -1,0 +1,208 @@
+"""Leader lease + elector — the HA analog.
+
+The reference runs replicated managers behind Kubernetes Lease-based
+leader election: one replica schedules and writes, the others stay hot
+serving reads and take over when the lease lapses
+(cmd/kueue/main.go LeaderElection, pkg/controller/core/
+leader_aware_reconciler.go — non-leader replicas serve reads while
+deferring writes). This repo's runtime is a single process around the
+TPU solver, so the analog is a shared-file lease on the state volume
+(the deployment manifest backs it with a PVC): every read-modify-write
+runs under an flock'd sidecar lock so acquisition/takeover is a real
+critical section, the record is replaced atomically (tmp + os.replace,
+no torn reads), takeover happens only after the holder's renewal goes
+stale for a full lease duration, and a monotonically increasing fencing
+token makes a deposed leader's late write detectable.
+
+Clock is injected (utils/clock.py) so expiry/takeover is testable with
+FakeClock, matching how the reference injects fake clocks in its
+election tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import json
+import os
+import tempfile
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from kueue_tpu.utils.clock import Clock
+
+
+@dataclass
+class LeaseRecord:
+    holder: str
+    renew_time: float
+    duration: float
+    token: int  # fencing token, increases on every change of holder
+
+    def to_dict(self) -> dict:
+        return {
+            "holder": self.holder,
+            "renewTime": self.renew_time,
+            "durationSeconds": self.duration,
+            "token": self.token,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LeaseRecord":
+        return cls(
+            holder=d.get("holder", ""),
+            renew_time=float(d.get("renewTime", 0.0)),
+            duration=float(d.get("durationSeconds", 15.0)),
+            token=int(d.get("token", 0)),
+        )
+
+
+class FileLease:
+    """A lease file on shared storage. One writer wins; expiry is
+    judged by renewTime + duration against the local clock (replicas
+    are assumed clock-synced the way Lease-based election assumes it)."""
+
+    def __init__(self, path: str, identity: str, duration: float = 15.0,
+                 clock: Optional[Clock] = None):
+        self.path = path
+        self.identity = identity
+        self.duration = duration
+        self.clock = clock or Clock()
+        self.token: Optional[int] = None  # held fencing token
+
+    @contextlib.contextmanager
+    def _locked(self):
+        """flock-serialized critical section for every read-modify-write.
+
+        Without it two standbys can both read token N during a takeover
+        and both write N+1 — two leaders with the same fencing token.
+        The sidecar .lock file lives on the same (state) volume as the
+        lease; all writers go through this code path, so the advisory
+        lock is effective mutual exclusion."""
+        fd = os.open(self.path + ".lock", os.O_CREAT | os.O_RDWR)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    # ---- reading ----
+    def read(self) -> Optional[LeaseRecord]:
+        try:
+            with open(self.path) as f:
+                return LeaseRecord.from_dict(json.load(f))
+        except (FileNotFoundError, json.JSONDecodeError, ValueError):
+            return None
+
+    def holder(self) -> str:
+        rec = self.read()
+        return rec.holder if rec is not None else ""
+
+    def is_held(self) -> bool:
+        """True iff the on-disk record still names us with our fencing
+        token — the check a fenced write performs inside ``_locked()``
+        before touching shared state."""
+        rec = self.read()
+        return (
+            rec is not None
+            and rec.holder == self.identity
+            and (self.token is None or rec.token == self.token)
+        )
+
+    def _expired(self, rec: LeaseRecord) -> bool:
+        return self.clock.now() >= rec.renew_time + rec.duration
+
+    # ---- writing ----
+    def _write(self, rec: LeaseRecord) -> None:
+        # atomic replace: a reader never sees a torn record, and a
+        # crash mid-renewal leaves the previous (valid) record in place
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(self.path) or ".", prefix=".lease-"
+        )
+        with os.fdopen(fd, "w") as f:
+            json.dump(rec.to_dict(), f)
+        os.replace(tmp, self.path)
+
+    def try_acquire(self) -> bool:
+        """Acquire if the lease is free, expired, or already ours."""
+        with self._locked():
+            now = self.clock.now()
+            rec = self.read()
+            if rec is None:
+                rec = LeaseRecord("", 0.0, self.duration, token=0)
+            if rec.holder == self.identity:
+                self.token = rec.token
+                return self._renew_locked()
+            if rec.holder and not self._expired(rec):
+                return False
+            # free, corrupt, or expired — take over, bumping the fencing
+            # token so writes guarded by the old token are rejectable
+            new = LeaseRecord(self.identity, now, self.duration, rec.token + 1)
+            self._write(new)
+            self.token = new.token
+            return True
+
+    def renew(self) -> bool:
+        """Extend our lease. Fails (and drops leadership) if another
+        holder took over — the fencing check."""
+        with self._locked():
+            return self._renew_locked()
+
+    def _renew_locked(self) -> bool:
+        rec = self.read()
+        if rec is None or rec.holder != self.identity or (
+            self.token is not None and rec.token != self.token
+        ):
+            self.token = None
+            return False
+        rec.renew_time = self.clock.now()
+        self._write(rec)
+        return True
+
+    def release(self) -> None:
+        with self._locked():
+            rec = self.read()
+            if rec is not None and rec.holder == self.identity:
+                self._write(LeaseRecord("", 0.0, self.duration, rec.token))
+            self.token = None
+
+
+class LeaderElector:
+    """Tick-driven election loop state machine over a FileLease.
+
+    ``tick()`` is called periodically (by the server's election thread
+    or a test); it acquires/renews and fires the callbacks on
+    transitions, mirroring leaderelection.LeaderCallbacks."""
+
+    def __init__(
+        self,
+        lease: FileLease,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ):
+        self.lease = lease
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.is_leader = False
+
+    @property
+    def identity(self) -> str:
+        return self.lease.identity
+
+    def tick(self) -> bool:
+        was = self.is_leader
+        now = self.lease.renew() if was else self.lease.try_acquire()
+        self.is_leader = now
+        if now and not was and self.on_started_leading:
+            self.on_started_leading()
+        if was and not now and self.on_stopped_leading:
+            self.on_stopped_leading()
+        return now
+
+    def step_down(self) -> None:
+        if self.is_leader:
+            self.lease.release()
+            self.is_leader = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()

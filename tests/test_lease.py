@@ -1,0 +1,343 @@
+"""Leader lease + elector + server HA tests.
+
+Covers the FileLease state machine (acquire, renew, expiry takeover,
+fencing tokens, lost-race detection) with a fake clock, the
+LeaderElector callback transitions, and the server wiring: a standby
+replica serves reads but refuses writes with 503 naming the holder
+(leader_aware_reconciler.go behavior), then takes over when the
+leader's lease lapses.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from kueue_tpu.server import KueueServer
+from kueue_tpu.utils.clock import FakeClock
+from kueue_tpu.utils.lease import FileLease, LeaderElector
+
+
+def make_lease(tmp_path, identity, clock, duration=15.0):
+    return FileLease(
+        str(tmp_path / "leader.lease"), identity, duration=duration, clock=clock
+    )
+
+
+class TestFileLease:
+    def test_fresh_acquire(self, tmp_path):
+        clock = FakeClock(start=100.0)
+        a = make_lease(tmp_path, "a", clock)
+        assert a.try_acquire()
+        assert a.holder() == "a"
+        assert a.token == 1
+
+    def test_second_replica_blocked_while_fresh(self, tmp_path):
+        clock = FakeClock(start=100.0)
+        a = make_lease(tmp_path, "a", clock)
+        b = make_lease(tmp_path, "b", clock)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        assert b.holder() == "a"
+
+    def test_renew_extends(self, tmp_path):
+        clock = FakeClock(start=100.0)
+        a = make_lease(tmp_path, "a", clock)
+        b = make_lease(tmp_path, "b", clock)
+        assert a.try_acquire()
+        clock.advance(14.0)
+        assert a.renew()
+        clock.advance(14.0)  # 28s after acquire, 14s after renew
+        assert not b.try_acquire()
+
+    def test_takeover_after_expiry_bumps_token(self, tmp_path):
+        clock = FakeClock(start=100.0)
+        a = make_lease(tmp_path, "a", clock)
+        b = make_lease(tmp_path, "b", clock)
+        assert a.try_acquire()
+        clock.advance(15.0)  # exactly one duration -> expired
+        assert b.try_acquire()
+        assert b.holder() == "b"
+        assert b.token == 2
+
+    def test_deposed_leader_cannot_renew(self, tmp_path):
+        clock = FakeClock(start=100.0)
+        a = make_lease(tmp_path, "a", clock)
+        b = make_lease(tmp_path, "b", clock)
+        assert a.try_acquire()
+        clock.advance(16.0)
+        assert b.try_acquire()
+        assert not a.renew()  # fencing: holder changed
+        assert a.token is None
+
+    def test_release_frees_immediately(self, tmp_path):
+        clock = FakeClock(start=100.0)
+        a = make_lease(tmp_path, "a", clock)
+        b = make_lease(tmp_path, "b", clock)
+        assert a.try_acquire()
+        a.release()
+        assert b.try_acquire()  # no expiry wait after clean release
+        assert b.token == 2
+
+    def test_reacquire_own_lease_is_renewal(self, tmp_path):
+        clock = FakeClock(start=100.0)
+        a = make_lease(tmp_path, "a", clock)
+        assert a.try_acquire()
+        clock.advance(5.0)
+        assert a.try_acquire()
+        rec = a.read()
+        assert rec.renew_time == 105.0
+        assert rec.token == 1  # same holder: token unchanged
+
+    def test_corrupt_lease_file_is_claimable(self, tmp_path):
+        clock = FakeClock(start=100.0)
+        path = tmp_path / "leader.lease"
+        path.write_text("{not json")
+        a = make_lease(tmp_path, "a", clock)
+        assert a.try_acquire()
+        assert a.holder() == "a"
+
+
+class TestLeaderElector:
+    def test_callbacks_fire_on_transitions(self, tmp_path):
+        clock = FakeClock(start=100.0)
+        events = []
+        a = LeaderElector(
+            make_lease(tmp_path, "a", clock),
+            on_started_leading=lambda: events.append("a-start"),
+            on_stopped_leading=lambda: events.append("a-stop"),
+        )
+        b = LeaderElector(
+            make_lease(tmp_path, "b", clock),
+            on_started_leading=lambda: events.append("b-start"),
+        )
+        assert a.tick()
+        assert not b.tick()
+        clock.advance(20.0)
+        assert b.tick()  # takeover
+        assert not a.tick()  # renewal fails -> stop callback
+        assert events == ["a-start", "b-start", "a-stop"]
+
+    def test_step_down(self, tmp_path):
+        clock = FakeClock(start=100.0)
+        a = LeaderElector(make_lease(tmp_path, "a", clock))
+        b = LeaderElector(make_lease(tmp_path, "b", clock))
+        a.tick()
+        a.step_down()
+        assert not a.is_leader
+        assert b.tick()
+
+
+CQ = {
+    "name": "cq",
+    "namespaceSelector": {},
+    "resourceGroups": [
+        {
+            "coveredResources": ["cpu"],
+            "flavors": [
+                {
+                    "name": "default",
+                    "resources": [{"name": "cpu", "nominalQuota": 4000}],
+                }
+            ],
+        }
+    ],
+}
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read())
+
+
+class TestServerHA:
+    def test_standby_serves_reads_rejects_writes(self, tmp_path):
+        from kueue_tpu.server.app import ApiError
+
+        clock = FakeClock(start=100.0)
+        leader = KueueServer(
+            elector=LeaderElector(make_lease(tmp_path, "rep-1", clock))
+        )
+        standby = KueueServer(
+            elector=LeaderElector(make_lease(tmp_path, "rep-2", clock))
+        )
+        p1, p2 = leader.start(), standby.start()
+        try:
+            leader.apply(
+                "resourceflavors", {"name": "default", "nodeLabels": {}}
+            )
+            leader.apply("clusterqueues", dict(CQ))
+            with pytest.raises(ApiError) as e:
+                standby.apply("clusterqueues", dict(CQ))
+            assert e.value.status == 503
+            assert "rep-1" in e.value.message
+            # reads still served by the standby
+            ready = _get(p2, "/readyz")
+            assert ready["leader"] is False
+            assert ready["holder"] == "rep-1"
+            assert _get(p1, "/readyz")["leader"] is True
+        finally:
+            leader.stop()
+            standby.stop()
+
+    def test_standby_takes_over_on_lapse(self, tmp_path):
+        clock = FakeClock(start=100.0)
+        leader = KueueServer(
+            elector=LeaderElector(make_lease(tmp_path, "rep-1", clock))
+        )
+        standby = KueueServer(
+            elector=LeaderElector(make_lease(tmp_path, "rep-2", clock))
+        )
+        leader.start()
+        standby.start()
+        try:
+            assert leader.elector.is_leader
+            # leader dies without releasing: stop its renewals only
+            leader._election_stop.set()
+            clock.advance(30.0)
+            standby.elector.tick()
+            assert standby.elector.is_leader
+            standby.apply(
+                "resourceflavors", {"name": "default", "nodeLabels": {}}
+            )  # writes now accepted
+            assert _get(standby.port, "/readyz")["holder"] == "rep-2"
+        finally:
+            leader.stop()
+            standby.stop()
+
+    def test_standby_rejects_batch_even_empty(self, tmp_path):
+        from kueue_tpu.server.app import ApiError
+
+        clock = FakeClock(start=100.0)
+        leader = KueueServer(
+            elector=LeaderElector(make_lease(tmp_path, "rep-1", clock))
+        )
+        standby = KueueServer(
+            elector=LeaderElector(make_lease(tmp_path, "rep-2", clock))
+        )
+        leader.start()
+        standby.start()
+        try:
+            # an empty batch must not slip past the leader gate and run
+            # run_until_idle on the standby's stale state
+            with pytest.raises(ApiError) as e:
+                standby.apply_batch({})
+            assert e.value.status == 503
+        finally:
+            leader.stop()
+            standby.stop()
+
+    def test_stop_checkpoints_before_release(self, tmp_path):
+        # shutdown order: requests drained -> before_release runs while
+        # the lease is STILL held -> only then is it released
+        clock = FakeClock(start=100.0)
+        lease = make_lease(tmp_path, "rep-1", clock)
+        srv = KueueServer(elector=LeaderElector(lease))
+        srv.start()
+        assert srv.elector.is_leader
+        seen = {}
+
+        def ckpt():
+            seen["holder_at_checkpoint"] = lease.holder()
+
+        srv.stop(before_release=ckpt)
+        assert seen["holder_at_checkpoint"] == "rep-1"
+        assert lease.holder() == ""  # released after the checkpoint
+
+    def test_concurrent_takeover_single_winner(self, tmp_path):
+        # two standbys racing an expired lease: flock serializes the
+        # read-modify-write, so exactly one wins and tokens stay unique
+        clock = FakeClock(start=100.0)
+        a = make_lease(tmp_path, "a", clock)
+        assert a.try_acquire()
+        clock.advance(60.0)
+        import threading
+
+        leases = [make_lease(tmp_path, f"s{i}", clock) for i in range(8)]
+        results = [None] * 8
+        barrier = threading.Barrier(8)
+
+        def contend(i):
+            barrier.wait()
+            results[i] = leases[i].try_acquire()
+
+        ts = [threading.Thread(target=contend, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sum(results) == 1  # exactly one new leader
+        winner = results.index(True)
+        assert leases[winner].token == 2
+
+    def test_promotion_rebuilds_instead_of_merging(self, tmp_path):
+        # Objects deleted on the old leader must NOT survive promotion:
+        # the standby rebuilds from the checkpoint, it does not upsert
+        # into its stale boot-time store.
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.server.__main__ import fenced_checkpoint, promote_reload
+
+        state = str(tmp_path / "state.json")
+        leader = KueueServer()
+        leader.apply("resourceflavors", {"name": "keep", "nodeLabels": {}})
+        leader.apply("resourceflavors", {"name": "doomed", "nodeLabels": {}})
+        # standby boots from this snapshot (both flavors present)
+        assert fenced_checkpoint(leader, state)
+        standby = KueueServer()
+        promote_reload(standby, state, ClusterRuntime)
+        assert set(standby.runtime.cache.flavors) == {"keep", "doomed"}
+        # leader deletes one and checkpoints; then dies
+        leader.delete("resourceflavors", "", "doomed")
+        assert fenced_checkpoint(leader, state)
+        # promotion rebuilds: the deleted flavor must not resurrect
+        assert promote_reload(standby, state, ClusterRuntime)
+        assert set(standby.runtime.cache.flavors) == {"keep"}
+
+    def test_deposed_leader_checkpoint_is_fenced(self, tmp_path):
+        # A leader that lost the lease during a stall must not clobber
+        # the new leader's state file.
+        from kueue_tpu.server.__main__ import fenced_checkpoint
+
+        clock = FakeClock(start=100.0)
+        state = str(tmp_path / "state.json")
+        old = KueueServer(
+            elector=LeaderElector(make_lease(tmp_path, "old", clock))
+        )
+        new = KueueServer(
+            elector=LeaderElector(make_lease(tmp_path, "new", clock))
+        )
+        old.elector.tick()
+        assert fenced_checkpoint(old, state)
+        clock.advance(60.0)  # old stalls; its lease lapses
+        new.elector.tick()
+        assert new.elector.is_leader
+        new.apply("resourceflavors", {"name": "newer", "nodeLabels": {}})
+        assert fenced_checkpoint(new, state)
+        # the stale leader's periodic checkpoint fires on resume: the
+        # fence must refuse it (its in-memory is_leader may still be
+        # True, but the on-disk record no longer names it)
+        assert not fenced_checkpoint(old, state)
+        with open(state) as f:
+            names = [fl["name"] for fl in json.load(f)["resourceFlavors"]]
+        assert names == ["newer"]
+
+    def test_cq_pending_snapshot_served_in_status(self, tmp_path):
+        # QueueVisibility snapshots surface via GET clusterqueues
+        # .status.pendingWorkloadsStatus (the reference's CQ status
+        # snapshot worker output).
+        srv = KueueServer()
+        srv.apply("resourceflavors", {"name": "default", "nodeLabels": {}})
+        srv.apply("clusterqueues", dict(CQ))
+        srv.runtime.cq_pending_snapshots["cq"] = [
+            {"name": "w1", "namespace": "ns", "localQueueName": "lq",
+             "priority": 0, "positionInClusterQueue": 0}
+        ]
+        obj = srv.get_object("clusterqueues", "", "cq")
+        pws = obj["status"]["pendingWorkloadsStatus"]
+        assert pws["clusterQueuePendingWorkload"][0]["name"] == "w1"
+
+    def test_no_elector_means_always_writable(self):
+        srv = KueueServer()
+        srv.apply("resourceflavors", {"name": "default", "nodeLabels": {}})
+        body = srv.list_section("resourceflavors")
+        assert len(body["items"]) == 1
