@@ -9,12 +9,10 @@ serves until interrupted.
 from __future__ import annotations
 
 import argparse
-import contextlib
 import json
 import os
 import signal
 import sys
-import tempfile
 import threading
 
 
@@ -22,48 +20,45 @@ def fenced_checkpoint(srv, state_path: str) -> bool:
     """Atomically checkpoint srv.runtime to ``state_path``; returns
     False without writing when this replica no longer holds the lease.
 
-    Atomic (unique tmp via mkstemp + os.replace under the server lock):
-    a SIGKILL mid-write must not destroy the only durable copy, and a
-    concurrent periodic + shutdown checkpoint must not race on a shared
-    tmp path. Fenced: with an elector, the dump/replace runs inside the
-    lease's critical section only while the on-disk record still names
-    us — a deposed leader resuming from a stall cannot clobber the new
-    leader's newer checkpoint (the fencing-token guarantee)."""
+    Atomic (unique tmp + os.replace, tmp unlinked on failure): a SIGKILL
+    mid-write must not destroy the only durable copy, and a concurrent
+    periodic + shutdown checkpoint must not race on a shared tmp path.
+    Fenced: with an elector, the file write runs inside the lease's
+    critical section only while the on-disk record still names us — a
+    deposed leader resuming from a stall cannot clobber the new leader's
+    newer checkpoint (the fencing-token guarantee). Serialization
+    happens OUTSIDE the flock (under the server lock alone): the fence
+    only needs to cover the replace, and holding the shared-volume lock
+    for a multi-second 50k-workload dump would stall every replica's
+    election tick."""
     from kueue_tpu import serialization as ser
+    from kueue_tpu.utils.lease import atomic_write_text
 
-    def _dump() -> None:
-        fd, tmp = tempfile.mkstemp(
-            dir=os.path.dirname(state_path) or ".", prefix=".state-"
-        )
-        try:
-            with srv.lock:
-                with os.fdopen(fd, "w") as f:
-                    json.dump(ser.runtime_to_state(srv.runtime), f, indent=1)
-                os.replace(tmp, state_path)
-        except BaseException:
-            # failed dumps must not accumulate orphan tmp files on the
-            # (possibly already-full) shared volume
-            with contextlib.suppress(OSError):
-                os.unlink(tmp)
-            raise
-
+    with srv.lock:
+        text = json.dumps(ser.runtime_to_state(srv.runtime), indent=1)
     if srv.elector is None:
-        _dump()
+        atomic_write_text(state_path, text, ".state-")
         return True
     lease = srv.elector.lease
     with lease._locked():
         if not lease.is_held():
             return False  # deposed: the new leader owns the state file
-        _dump()
+        atomic_write_text(state_path, text, ".state-")
     return True
 
 
-def promote_reload(srv, state_path: str, build_runtime) -> bool:
+def promote_reload(srv, state_path: str, build_runtime,
+                   run_reconcile: bool = True) -> bool:
     """On lease takeover, REBUILD srv.runtime from the old leader's
     latest checkpoint — not an upsert into the standby's stale store,
     which would resurrect objects the old leader deleted. Data loss is
     bounded by the checkpoint period. Returns True when a checkpoint
-    was loaded."""
+    was loaded.
+
+    Also used for the standby read-refresh with ``run_reconcile=False``:
+    a standby mirrors the leader's checkpoint verbatim and must NOT run
+    scheduling cycles of its own, which would admit pending workloads
+    in its local copy and diverge the read surface from the leader."""
     from kueue_tpu import serialization as ser
 
     if not (state_path and os.path.exists(state_path)):
@@ -73,7 +68,8 @@ def promote_reload(srv, state_path: str, build_runtime) -> bool:
         ser.runtime_from_state(json.load(f), runtime=fresh)
     with srv.lock:
         srv.runtime = fresh
-        fresh.run_until_idle()
+        if run_reconcile:
+            fresh.run_until_idle()
     return True
 
 
@@ -220,20 +216,31 @@ def main(argv=None) -> int:
 
     ckpt_thread = None
     if args.state and args.state_checkpoint_period > 0:
-        # periodic leader checkpoints bound the data lost to a SIGKILL
-        # (and are what a promoted standby reloads); standbys never
+        # Periodic leader checkpoints bound the data lost to a SIGKILL
+        # (and are what a promoted standby reloads). Standbys never
         # checkpoint — on a shared state volume that would clobber the
-        # leader's durable copy with a stale one
+        # leader's durable copy with a stale one — but they DO reload
+        # each new checkpoint so their read endpoints (visibility,
+        # metrics, dashboard, GETs) track the leader instead of serving
+        # boot-time state forever.
+        reloaded_mtime = [0.0]
+
         def _ckpt_loop():
             while not stop.wait(args.state_checkpoint_period):
-                if elector is None or elector.is_leader:
-                    try:
+                try:
+                    if elector is None or elector.is_leader:
                         checkpoint()
-                    except Exception as e:  # noqa: BLE001 — any failure
-                        # (volume error, serialization bug) must not
-                        # silently kill periodic durability for the
-                        # rest of the process lifetime
-                        print(f"checkpoint failed: {e!r}", flush=True)
+                    elif os.path.exists(args.state):
+                        mtime = os.path.getmtime(args.state)
+                        if mtime > reloaded_mtime[0]:
+                            promote_reload(srv, args.state, build_runtime,
+                                           run_reconcile=False)
+                            reloaded_mtime[0] = mtime
+                except Exception as e:  # noqa: BLE001 — any failure
+                    # (volume error, serialization bug) must not
+                    # silently kill periodic durability for the
+                    # rest of the process lifetime
+                    print(f"checkpoint failed: {e!r}", flush=True)
 
         ckpt_thread = threading.Thread(target=_ckpt_loop, daemon=True)
         ckpt_thread.start()

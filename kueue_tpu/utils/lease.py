@@ -32,6 +32,22 @@ from typing import Callable, Optional
 from kueue_tpu.utils.clock import Clock
 
 
+def atomic_write_text(path: str, text: str, prefix: str = ".tmp-") -> None:
+    """Write ``text`` to ``path`` via unique tmp + os.replace: a reader
+    never sees a torn file, a crash mid-write leaves the previous copy
+    intact, and a FAILED write never leaks its tmp file (a full shared
+    volume must not accumulate orphans on every retry)."""
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".", prefix=prefix)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
 @dataclass
 class LeaseRecord:
     holder: str
@@ -115,14 +131,7 @@ class FileLease:
 
     # ---- writing ----
     def _write(self, rec: LeaseRecord) -> None:
-        # atomic replace: a reader never sees a torn record, and a
-        # crash mid-renewal leaves the previous (valid) record in place
-        fd, tmp = tempfile.mkstemp(
-            dir=os.path.dirname(self.path) or ".", prefix=".lease-"
-        )
-        with os.fdopen(fd, "w") as f:
-            json.dump(rec.to_dict(), f)
-        os.replace(tmp, self.path)
+        atomic_write_text(self.path, json.dumps(rec.to_dict()), ".lease-")
 
     def try_acquire(self) -> bool:
         """Acquire if the lease is free, expired, or already ours."""
@@ -193,11 +202,22 @@ class LeaderElector:
     def tick(self) -> bool:
         was = self.is_leader
         now = self.lease.renew() if was else self.lease.try_acquire()
-        self.is_leader = now
-        if now and not was and self.on_started_leading:
-            self.on_started_leading()
-        if was and not now and self.on_stopped_leading:
-            self.on_stopped_leading()
+        if now and not was:
+            # fire the promotion callback BEFORE is_leader becomes
+            # observable: gates like require_leader() read the flag
+            # outside any lock, so a write must not be admitted against
+            # pre-promotion state that the callback is about to replace.
+            # If the callback raises, we stay non-leader and the next
+            # tick retries (our own fresh lease renews fine).
+            if self.on_started_leading:
+                self.on_started_leading()
+            self.is_leader = True
+        elif was and not now:
+            self.is_leader = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+        else:
+            self.is_leader = now
         return now
 
     def step_down(self) -> None:

@@ -336,7 +336,75 @@ class TestServerHA:
         pws = obj["status"]["pendingWorkloadsStatus"]
         assert pws["clusterQueuePendingWorkload"][0]["name"] == "w1"
 
-    def test_no_elector_means_always_writable(self):
+    def test_promotion_callback_runs_before_leader_flag(self, tmp_path):
+        # require_leader() reads is_leader without a lock, so the
+        # promotion callback (which swaps in the reloaded runtime) must
+        # complete BEFORE the flag becomes observable — otherwise a
+        # write can be accepted against the stale pre-promotion runtime
+        # and silently discarded by the swap.
+        clock = FakeClock(start=100.0)
+        seen = {}
+        elector = LeaderElector(
+            make_lease(tmp_path, "a", clock),
+            on_started_leading=lambda: seen.setdefault(
+                "flag_during_callback", elector.is_leader
+            ),
+        )
+        assert elector.tick()
+        assert seen["flag_during_callback"] is False
+        assert elector.is_leader
+
+    def test_failed_promotion_callback_retries(self, tmp_path):
+        clock = FakeClock(start=100.0)
+        calls = []
+
+        def boom():
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("reload failed")
+
+        elector = LeaderElector(
+            make_lease(tmp_path, "a", clock), on_started_leading=boom
+        )
+        with pytest.raises(RuntimeError):
+            elector.tick()
+        assert not elector.is_leader  # not observable as leader
+        assert elector.tick()  # next tick retries and succeeds
+        assert elector.is_leader
+
+    def test_failed_lease_write_leaves_no_tmp_files(self, tmp_path):
+        from kueue_tpu.utils.lease import atomic_write_text
+
+        target = tmp_path / "x"
+        atomic_write_text(str(target), "hi")
+        assert target.read_text() == "hi"
+        # replacing onto a directory fails after the tmp was created;
+        # the tmp must be unlinked, not leaked onto the shared volume
+        bad = tmp_path / "adir"
+        bad.mkdir()
+        with pytest.raises(OSError):
+            atomic_write_text(str(bad), "hi")
+        leftovers = [p.name for p in tmp_path.iterdir()
+                     if p.name.startswith(".tmp-")]
+        assert leftovers == []
+
+    def test_standby_refresh_mirrors_without_scheduling(self, tmp_path):
+        # promote_reload(run_reconcile=False): a standby mirrors the
+        # checkpoint verbatim and must not admit pending workloads in
+        # its local copy.
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.server.__main__ import fenced_checkpoint, promote_reload
+
+        state = str(tmp_path / "state.json")
+        leader = KueueServer(auto_reconcile=False)
+        leader.apply("resourceflavors", {"name": "default", "nodeLabels": {}},
+                     reconcile=False)
+        leader.apply("clusterqueues", dict(CQ), reconcile=False)
+        assert fenced_checkpoint(leader, state)
+        standby = KueueServer()
+        assert promote_reload(standby, state, ClusterRuntime,
+                              run_reconcile=False)
+        assert "cq" in standby.runtime.cache.cluster_queues
         srv = KueueServer()
         srv.apply("resourceflavors", {"name": "default", "nodeLabels": {}})
         body = srv.list_section("resourceflavors")
