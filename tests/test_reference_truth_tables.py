@@ -1023,3 +1023,298 @@ class TestFairPreemptionsParity:
                        ("c1", IN_COHORT_FAIR_SHARING),
                        ("c2", IN_COHORT_FAIR_SHARING),
                        ("e1", IN_COHORT_FAIR_SHARING)}
+
+
+# ---------------------------------------------------------------------------
+# Scheduler cycle truth tables (scheduler_test.go TestSchedule).
+# Shared fixture CQs (scheduler_test.go:84-167): sales (default 50, no
+# borrowing), eng-alpha / eng-beta in cohort "eng" (on-demand 50 with
+# borrowingLimit 50/10, spot 100/0 and 0/100, beta adds model-a gpu 20
+# and preemption), lend-a / lend-b in cohort "lend" with lendingLimits
+# 2/2. One scheduler cycle, asserting the same scheduled set, flavor
+# picks, usage, and queue leftovers.
+# ---------------------------------------------------------------------------
+
+from kueue_tpu.core.queue_manager import QueueManager
+from kueue_tpu.core.scheduler import Scheduler
+from kueue_tpu.models import LocalQueue, QueueingStrategy
+
+
+def _strict(name, cohort, groups, preemption=None):
+    return ClusterQueue(
+        name=name, cohort=cohort, namespace_selector={},
+        queueing_strategy=QueueingStrategy.STRICT_FIFO,
+        resource_groups=tuple(groups),
+        preemption=preemption or Preemption(),
+    )
+
+
+def sched_fixture_cqs():
+    return [
+        # the reference's fixture writes borrowingLimit 0 on cohort-less
+        # "sales"; our model enforces the CEL rule (borrowingLimit
+        # requires cohort), and without a cohort the limit is inert
+        _strict("sales", None,
+                [rg(FlavorQuotas.build("default", {"cpu": "50"}))]),
+        _strict("eng-alpha", "eng",
+                [rg(FlavorQuotas.build("on-demand", {"cpu": ("50", "50", None)}),
+                    FlavorQuotas.build("spot", {"cpu": ("100", "0", None)}))]),
+        _strict("eng-beta", "eng",
+                [rg(FlavorQuotas.build("on-demand", {"cpu": ("50", "10", None)}),
+                    FlavorQuotas.build("spot", {"cpu": ("0", "100", None)})),
+                 rg(FlavorQuotas.build("model-a", {"example.com/gpu": ("20", "0", None)}))],
+                preemption=Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY)),
+        # lend-a/lend-b keep the default BestEffortFIFO strategy (the
+        # reference fixture sets StrictFIFO only on sales/eng queues);
+        # BestEffortFIFO is what parks NoFit heads as inadmissible
+        ClusterQueue(
+            name="lend-a", cohort="lend", namespace_selector={},
+            resource_groups=(
+                rg(FlavorQuotas.build("default", {"cpu": ("3", None, "2")})),)),
+        ClusterQueue(
+            name="lend-b", cohort="lend", namespace_selector={},
+            resource_groups=(
+                rg(FlavorQuotas.build("default", {"cpu": ("2", None, "2")})),)),
+    ]
+
+
+SCHED_FLAVORS = [ResourceFlavor(name=n)
+                 for n in ("default", "on-demand", "spot", "model-a")]
+
+
+def sched_env(extra_cqs=(), cohorts=(), fair=False):
+    from kueue_tpu.core.preemption import Preemptor
+
+    clock = FakeClock(NOW)
+    cache = Cache()
+    for f in SCHED_FLAVORS:
+        cache.add_or_update_flavor(f)
+    mgr = QueueManager(clock=clock)
+    for c in cohorts:
+        cache.add_or_update_cohort(c)
+    for cq in list(sched_fixture_cqs()) + list(extra_cqs):
+        cache.add_or_update_cluster_queue(cq)
+        mgr.add_cluster_queue(cq)
+        mgr.add_local_queue(LocalQueue(
+            namespace="ns", name=f"lq-{cq.name}", cluster_queue=cq.name))
+    sched = Scheduler(
+        queues=mgr, cache=cache, clock=clock, fair_sharing=fair,
+        preemptor=Preemptor(clock, enable_fair_sharing=fair),
+    )
+    return sched, mgr, cache, clock
+
+
+def sched_pending(mgr, name, cq, pod_sets, prio=0, t=None):
+    wl = Workload(
+        namespace="ns", name=name, queue_name=f"lq-{cq}", priority=prio,
+        creation_time=NOW if t is None else t,
+        pod_sets=tuple(pod_sets),
+    )
+    mgr.add_or_update_workload(wl)
+    return wl
+
+
+def sched_admitted(cache, name, cq, pod_sets, flavors, prio=0):
+    wl = Workload(
+        namespace="ns", name=name, queue_name=f"lq-{cq}", priority=prio,
+        creation_time=NOW, pod_sets=tuple(pod_sets),
+    )
+    wl.admission = make_admission(cq, flavors, wl)
+    wl.set_condition(
+        WorkloadConditionType.QUOTA_RESERVED, True,
+        reason="QuotaReserved", now=NOW,
+    )
+    cache.add_or_update_workload(wl)
+    return wl
+
+
+def admitted_names(res):
+    return sorted(e.workload.name for e in res.admitted)
+
+
+def psa(wl, ps_name):
+    (m,) = [p for p in wl.admission.pod_set_assignments if p.name == ps_name]
+    return m
+
+
+class TestSchedulerCycleParity:
+    """scheduler_test.go TestSchedule, case names preserved."""
+
+    def test_admit_in_different_cohorts(self):  # :469
+        sched, mgr, cache, _ = sched_env()
+        sched_pending(mgr, "new-sales", "sales",
+                      [PodSet.build("one", 1, {"cpu": "1"})])
+        sched_pending(mgr, "new-alpha", "eng-alpha",
+                      [PodSet.build("one", 51, {"cpu": "1"})])  # borrows
+        res = sched.schedule()
+        assert admitted_names(res) == ["new-alpha", "new-sales"]
+        wl = cache.cluster_queues["eng-alpha"].workloads["ns/new-alpha"]
+        assert psa(wl, "one").flavors["cpu"] == "on-demand"
+        assert psa(wl, "one").resource_usage["cpu"] == 51_000
+
+    def test_admit_in_same_cohort_with_no_borrowing(self):  # :518
+        sched, mgr, cache, _ = sched_env()
+        sched_pending(mgr, "new-alpha", "eng-alpha",
+                      [PodSet.build("one", 40, {"cpu": "1"})])
+        sched_pending(mgr, "new-beta", "eng-beta",
+                      [PodSet.build("one", 40, {"cpu": "1"})])
+        res = sched.schedule()
+        assert admitted_names(res) == ["new-alpha", "new-beta"]
+        for cq, name in (("eng-alpha", "new-alpha"), ("eng-beta", "new-beta")):
+            wl = cache.cluster_queues[cq].workloads[f"ns/{name}"]
+            assert psa(wl, "one").flavors["cpu"] == "on-demand"
+
+    def test_assign_multiple_resources_and_flavors(self):  # :567
+        sched, mgr, cache, _ = sched_env()
+        sched_pending(mgr, "new", "eng-beta", [
+            PodSet.build("one", 10, {"cpu": "6", "example.com/gpu": "1"}),
+            PodSet.build("two", 40, {"cpu": "1"}),
+        ])
+        res = sched.schedule()
+        assert admitted_names(res) == ["new"]
+        wl = cache.cluster_queues["eng-beta"].workloads["ns/new"]
+        one, two = psa(wl, "one"), psa(wl, "two")
+        assert one.flavors == {"cpu": "on-demand", "example.com/gpu": "model-a"}
+        assert one.resource_usage["cpu"] == 60_000
+        assert two.flavors == {"cpu": "spot"}
+        assert two.resource_usage["cpu"] == 40_000
+
+    def test_cannot_borrow_when_cohort_assigned_would_overadmit(self):  # :613
+        sched, mgr, cache, _ = sched_env()
+        sched_pending(mgr, "new-alpha", "eng-alpha",
+                      [PodSet.build("one", 45, {"cpu": "1"})])
+        sched_pending(mgr, "new-beta", "eng-beta",
+                      [PodSet.build("one", 56, {"cpu": "1"})])
+        res = sched.schedule()
+        assert admitted_names(res) == ["new-alpha"]
+        # beta stays in the active queue (requeued), not inadmissible
+        assert "ns/new-beta" in mgr.cluster_queues["eng-beta"].heap.keys()
+
+    def test_can_borrow_when_cohort_assigned_without_overadmission(self):  # :650
+        sched, mgr, cache, _ = sched_env()
+        sched_pending(mgr, "new-alpha", "eng-alpha",
+                      [PodSet.build("one", 45, {"cpu": "1"})])
+        sched_pending(mgr, "new-beta", "eng-beta",
+                      [PodSet.build("one", 55, {"cpu": "1"})])
+        res = sched.schedule()
+        assert admitted_names(res) == ["new-alpha", "new-beta"]
+
+    def test_can_borrow_when_reclaim_possible_in_other_flavor(self):  # :699
+        sched, mgr, cache, _ = sched_env()
+        sched_admitted(cache, "user-on-demand", "eng-beta",
+                       [PodSet.build("main", 1, {"cpu": "50"})],
+                       {"main": {"cpu": "on-demand"}})
+        sched_admitted(cache, "user-spot", "eng-beta",
+                       [PodSet.build("main", 1, {"cpu": "1"})],
+                       {"main": {"cpu": "spot"}})
+        sched_pending(mgr, "can-reclaim", "eng-alpha",
+                      [PodSet.build("main", 1, {"cpu": "100"})])
+        sched_pending(mgr, "needs-to-borrow", "eng-beta",
+                      [PodSet.build("main", 1, {"cpu": "1"})])
+        res = sched.schedule()
+        assert admitted_names(res) == ["needs-to-borrow"]
+        wl = cache.cluster_queues["eng-beta"].workloads["ns/needs-to-borrow"]
+        assert psa(wl, "main").flavors["cpu"] == "on-demand"
+
+    def test_workload_exceeds_lending_limit_when_borrowing(self):  # :730
+        sched, mgr, cache, _ = sched_env()
+        sched_admitted(cache, "a", "lend-b",
+                       [PodSet.build("main", 1, {"cpu": "2"})],
+                       {"main": {"cpu": "default"}})
+        sched_pending(mgr, "b", "lend-b",
+                      [PodSet.build("main", 1, {"cpu": "3"})])
+        res = sched.schedule()
+        assert admitted_names(res) == []
+        assert "ns/b" in mgr.cluster_queues["lend-b"].inadmissible
+
+    def test_fair_sharing_lowest_share_first(self):  # :1487
+        shared = _strict("eng-shared", "eng", [
+            rg(FlavorQuotas.build("on-demand", {"cpu": ("10", "0", None)}))])
+        sched, mgr, cache, _ = sched_env(extra_cqs=[shared], fair=True)
+        sched_admitted(cache, "all_nominal", "eng-alpha",
+                       [PodSet.build("one", 50, {"cpu": "1"})],
+                       {"one": {"cpu": "on-demand"}})
+        sched_admitted(cache, "borrowing", "eng-beta",
+                       [PodSet.build("one", 55, {"cpu": "1"})],
+                       {"one": {"cpu": "on-demand"}})
+        sched_pending(mgr, "older_new", "eng-beta",
+                      [PodSet.build("one", 1, {"cpu": "1"})], t=NOW - 60)
+        sched_pending(mgr, "new", "eng-alpha",
+                      [PodSet.build("one", 5, {"cpu": "1"})], t=NOW)
+        res = sched.schedule()
+        # eng-alpha has the lower share (all nominal) so its head wins
+        # the cycle despite the older eng-beta head
+        assert admitted_names(res) == ["new"]
+        assert "ns/older_new" in mgr.cluster_queues["eng-beta"].heap.keys()
+
+    def test_hierarchical_fair_sharing_tournament(self):  # :1569
+        cohorts = [
+            Cohort(name="A", resource_groups=(
+                rg(FlavorQuotas.build("on-demand", {"cpu": "200"})),)),
+            Cohort(name="B", parent="A"),
+            Cohort(name="C", parent="A"),
+        ]
+        zero = {"cpu": ("0", None, None)}
+        extra = [
+            _strict("d", "B", [rg(FlavorQuotas.build("on-demand", zero))]),
+            _strict("e", "B", [rg(FlavorQuotas.build("on-demand", zero))]),
+            _strict("f", "C", [rg(FlavorQuotas.build("on-demand", zero))]),
+            _strict("g", "C", [rg(FlavorQuotas.build("on-demand", zero))]),
+        ]
+        sched, mgr, cache, _ = sched_env(
+            extra_cqs=extra, cohorts=cohorts, fair=True)
+        sched_admitted(cache, "d0", "d", [PodSet.build("one", 1, {"cpu": "10"})],
+                       {"one": {"cpu": "on-demand"}})
+        sched_admitted(cache, "e0", "e", [PodSet.build("one", 1, {"cpu": "20"})],
+                       {"one": {"cpu": "on-demand"}})
+        sched_admitted(cache, "g0", "g", [PodSet.build("one", 1, {"cpu": "100"})],
+                       {"one": {"cpu": "on-demand"}})
+        sched_pending(mgr, "d1", "d", [PodSet.build("one", 1, {"cpu": "70"})])
+        sched_pending(mgr, "e1", "e", [PodSet.build("one", 1, {"cpu": "61"})])
+        sched_pending(mgr, "f1", "f", [PodSet.build("one", 1, {"cpu": "1"})])
+        sched_pending(mgr, "g1", "g", [PodSet.build("one", 1, {"cpu": "1"})])
+        res = sched.schedule()
+        # d1 wins: B's post-admission share (100) < C's (101), and d
+        # beats e at the lower tournament level (80 < 81)
+        assert admitted_names(res) == ["d1"]
+
+    def test_fair_sharing_highest_priority_first(self):  # :1816
+        cohorts = [
+            Cohort(name="A", resource_groups=(
+                rg(FlavorQuotas.build("on-demand", {"cpu": "10"})),)),
+        ]
+        zero = {"cpu": ("0", None, None)}
+        extra = [
+            _strict("b", "A", [rg(FlavorQuotas.build("on-demand", zero))]),
+            _strict("c", "A", [rg(FlavorQuotas.build("on-demand", zero))]),
+        ]
+        sched, mgr, cache, _ = sched_env(
+            extra_cqs=extra, cohorts=cohorts, fair=True)
+        sched_pending(mgr, "b1", "b", [PodSet.build("one", 1, {"cpu": "10"})],
+                      prio=99)
+        sched_pending(mgr, "c1", "c", [PodSet.build("one", 1, {"cpu": "10"})],
+                      prio=101)
+        res = sched.schedule()
+        assert admitted_names(res) == ["c1"]
+        assert "ns/b1" in mgr.cluster_queues["b"].heap.keys()
+
+    def test_fair_sharing_earliest_timestamp_first(self):  # :1870
+        cohorts = [
+            Cohort(name="A", resource_groups=(
+                rg(FlavorQuotas.build("on-demand", {"cpu": "10"})),)),
+        ]
+        zero = {"cpu": ("0", None, None)}
+        extra = [
+            _strict("b", "A", [rg(FlavorQuotas.build("on-demand", zero))]),
+            _strict("c", "A", [rg(FlavorQuotas.build("on-demand", zero))]),
+        ]
+        sched, mgr, cache, _ = sched_env(
+            extra_cqs=extra, cohorts=cohorts, fair=True)
+        sched_pending(mgr, "b1", "b", [PodSet.build("one", 1, {"cpu": "10"})],
+                      prio=101, t=NOW + 1)
+        sched_pending(mgr, "c1", "c", [PodSet.build("one", 1, {"cpu": "10"})],
+                      prio=101, t=NOW)
+        res = sched.schedule()
+        assert admitted_names(res) == ["c1"]
