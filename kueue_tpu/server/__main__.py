@@ -24,41 +24,62 @@ def fenced_checkpoint(srv, state_path: str) -> bool:
     mid-write must not destroy the only durable copy, and a concurrent
     periodic + shutdown checkpoint must not race on a shared tmp path.
     Fenced: with an elector, the file write runs inside the lease's
-    critical section only while the on-disk record still names us — a
-    deposed leader resuming from a stall cannot clobber the new leader's
-    newer checkpoint (the fencing-token guarantee). Serialization
-    happens OUTSIDE the flock (under the server lock alone): the fence
-    only needs to cover the replace, and holding the shared-volume lock
-    for a multi-second 50k-workload dump would stall every replica's
-    election tick."""
+    critical section only while the on-disk record still names us WITH
+    THE SAME fencing token the snapshot was serialized under — a deposed
+    leader resuming from a stall cannot clobber the new leader's newer
+    checkpoint, even if it re-acquired the lease in the meantime (its
+    token changed, so the pre-deposition snapshot is refused).
+    Serialization happens OUTSIDE the flock (under the server lock
+    alone): holding the shared-volume lock for a multi-second
+    50k-workload dump would stall every replica's election tick.
+    A process-local sequence (srv._ckpt_seq/_ckpt_written) additionally
+    orders concurrent checkpoints in the SAME process, so a stalled
+    periodic dump can never replace a newer shutdown dump."""
     from kueue_tpu import serialization as ser
     from kueue_tpu.utils.lease import atomic_write_text
 
     with srv.lock:
         text = json.dumps(ser.runtime_to_state(srv.runtime), indent=1)
-    if srv.elector is None:
+        snap_token = srv.elector.lease.token if srv.elector else None
+        srv._ckpt_seq += 1
+        seq = srv._ckpt_seq
+
+    def _write_if_newest() -> bool:
+        if seq <= srv._ckpt_written:
+            return False  # a newer snapshot already landed
         atomic_write_text(state_path, text, ".state-")
+        srv._ckpt_written = seq
         return True
+
+    if srv.elector is None:
+        with srv._ckpt_write_lock:
+            return _write_if_newest()
     lease = srv.elector.lease
     with lease._locked():
-        if not lease.is_held():
-            return False  # deposed: the new leader owns the state file
-        atomic_write_text(state_path, text, ".state-")
-    return True
+        if not lease.is_held() or lease.token != snap_token:
+            # deposed since the snapshot was taken (even if we lead
+            # again under a new token): the snapshot is stale
+            return False
+        with srv._ckpt_write_lock:
+            return _write_if_newest()
 
 
 def promote_reload(srv, state_path: str, build_runtime,
-                   run_reconcile: bool = True) -> bool:
+                   run_reconcile: bool = True,
+                   require_standby: bool = False) -> bool:
     """On lease takeover, REBUILD srv.runtime from the old leader's
     latest checkpoint — not an upsert into the standby's stale store,
     which would resurrect objects the old leader deleted. Data loss is
     bounded by the checkpoint period. Returns True when a checkpoint
     was loaded.
 
-    Also used for the standby read-refresh with ``run_reconcile=False``:
-    a standby mirrors the leader's checkpoint verbatim and must NOT run
-    scheduling cycles of its own, which would admit pending workloads
-    in its local copy and diverge the read surface from the leader."""
+    Also used for the standby read-refresh with ``run_reconcile=False``
+    + ``require_standby=True``: a standby mirrors the leader's
+    checkpoint verbatim and must NOT run scheduling cycles of its own;
+    and if this replica was promoted while the (slow) mirror rebuild was
+    in flight, the swap is abandoned — installing a never-reconciled
+    pre-promotion mirror over the new leader's live runtime would
+    discard writes accepted since promotion."""
     from kueue_tpu import serialization as ser
 
     if not (state_path and os.path.exists(state_path)):
@@ -67,6 +88,8 @@ def promote_reload(srv, state_path: str, build_runtime,
     with open(state_path) as f:
         ser.runtime_from_state(json.load(f), runtime=fresh)
     with srv.lock:
+        if require_standby and srv.elector is not None and srv.elector.is_leader:
+            return False
         srv.runtime = fresh
         if run_reconcile:
             fresh.run_until_idle()
@@ -167,10 +190,17 @@ def main(argv=None) -> int:
         tok = elector.lease.token
         first = ha["boot"]  # cleared in main() right after srv.start()
         resumed = ha["last_token"] is not None and ha["last_token"] == tok
-        ha["last_token"] = tok
         if first or resumed:
+            ha["last_token"] = tok
             return
-        if args.state and promote_reload(srv, args.state, build_runtime):
+        # Record the token only AFTER a successful reload: if the
+        # reload raises (transient volume error), tick() leaves us
+        # non-leader and the NEXT promotion attempt must not classify
+        # itself as a resume and skip the reload — that would lead with
+        # the stale pre-takeover runtime.
+        reloaded = args.state and promote_reload(srv, args.state, build_runtime)
+        ha["last_token"] = tok
+        if reloaded:
             print(
                 "promoted to leader; rebuilt state from checkpoint",
                 flush=True,
@@ -223,7 +253,11 @@ def main(argv=None) -> int:
         # each new checkpoint so their read endpoints (visibility,
         # metrics, dashboard, GETs) track the leader instead of serving
         # boot-time state forever.
-        reloaded_mtime = [0.0]
+        # start from the checkpoint main() already loaded: the first
+        # standby iteration must not rebuild identical state
+        reloaded_mtime = [
+            os.path.getmtime(args.state) if os.path.exists(args.state) else 0.0
+        ]
 
         def _ckpt_loop():
             while not stop.wait(args.state_checkpoint_period):
@@ -234,7 +268,8 @@ def main(argv=None) -> int:
                         mtime = os.path.getmtime(args.state)
                         if mtime > reloaded_mtime[0]:
                             promote_reload(srv, args.state, build_runtime,
-                                           run_reconcile=False)
+                                           run_reconcile=False,
+                                           require_standby=True)
                             reloaded_mtime[0] = mtime
                 except Exception as e:  # noqa: BLE001 — any failure
                     # (volume error, serialization bug) must not

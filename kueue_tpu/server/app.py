@@ -243,6 +243,12 @@ class KueueServer:
         self.elector = elector
         self._election_stop = threading.Event()
         self._election_thread: Optional[threading.Thread] = None
+        # checkpoint ordering (used by __main__.fenced_checkpoint): a
+        # snapshot serialized earlier must never replace one serialized
+        # later, even if its disk write happens last
+        self._ckpt_seq = 0
+        self._ckpt_written = 0
+        self._ckpt_write_lock = threading.Lock()
 
     def require_leader(self) -> None:
         if self.elector is not None and not self.elector.is_leader:

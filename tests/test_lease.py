@@ -405,6 +405,74 @@ class TestServerHA:
         assert promote_reload(standby, state, ClusterRuntime,
                               run_reconcile=False)
         assert "cq" in standby.runtime.cache.cluster_queues
+
+    def test_stale_snapshot_refused_after_reacquire(self, tmp_path):
+        # A snapshot serialized under token T must not land after the
+        # replica was deposed and re-acquired under a newer token — the
+        # snapshot predates the intervening leader's writes. The fence
+        # in fenced_checkpoint compares the serialization-time token
+        # against the on-disk record inside the flock.
+        from kueue_tpu.server.__main__ import fenced_checkpoint
+
+        clock = FakeClock(start=100.0)
+        state = str(tmp_path / "state.json")
+        old = KueueServer(
+            elector=LeaderElector(make_lease(tmp_path, "old", clock))
+        )
+        other = LeaderElector(make_lease(tmp_path, "other", clock))
+        old.elector.tick()
+        snap_token = old.elector.lease.token
+        clock.advance(60.0)
+        assert other.tick()  # deposes old (token 2)
+        clock.advance(60.0)
+        assert not old.elector.tick()  # renewal fails: old is deposed
+        assert old.elector.tick()  # then re-acquires under token 3
+        lease = old.elector.lease
+        assert lease.token != snap_token
+        with lease._locked():
+            # the exact condition fenced_checkpoint enforces for the
+            # stalled pre-deposition snapshot:
+            assert lease.is_held() and lease.token != snap_token
+        # a FRESH checkpoint (serialized under the current token) lands
+        assert fenced_checkpoint(old, state)
+
+    def test_checkpoint_sequence_orders_same_process_writes(self, tmp_path):
+        # a snapshot serialized earlier must never replace one
+        # serialized later (stalled periodic dump vs shutdown dump)
+        from kueue_tpu.server.__main__ import fenced_checkpoint
+
+        state = str(tmp_path / "state.json")
+        srv = KueueServer()
+        srv.apply("resourceflavors", {"name": "early", "nodeLabels": {}})
+        assert fenced_checkpoint(srv, state)
+        first_written = srv._ckpt_written
+        assert first_written == srv._ckpt_seq
+        # emulate the stalled dump: its seq predates the landed write
+        srv._ckpt_seq = first_written - 2
+        assert not fenced_checkpoint(srv, state)
+        assert srv._ckpt_written == first_written
+
+    def test_standby_refresh_abandoned_if_promoted_mid_flight(self, tmp_path):
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.server.__main__ import fenced_checkpoint, promote_reload
+
+        clock = FakeClock(start=100.0)
+        state = str(tmp_path / "state.json")
+        leader = KueueServer()
+        leader.apply("resourceflavors", {"name": "default", "nodeLabels": {}})
+        assert fenced_checkpoint(leader, state)
+        standby = KueueServer(
+            elector=LeaderElector(make_lease(tmp_path, "s", clock))
+        )
+        standby.elector.tick()  # wins the (uncontended) lease
+        before = standby.runtime
+        # a refresh STARTED while standby completes after promotion:
+        # the swap must be abandoned, not clobber the live runtime
+        assert not promote_reload(standby, state, ClusterRuntime,
+                                  run_reconcile=False, require_standby=True)
+        assert standby.runtime is before
+
+    def test_no_elector_means_always_writable(self):
         srv = KueueServer()
         srv.apply("resourceflavors", {"name": "default", "nodeLabels": {}})
         body = srv.list_section("resourceflavors")
