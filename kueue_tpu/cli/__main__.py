@@ -262,6 +262,67 @@ def cmd_resume(state: State, args) -> None:
     print(f"{args.kind}.kueue.x-k8s.io/{args.name} resumed")
 
 
+# ---- delete (cmd/kueuectl/app/delete) ----
+_DELETE_SECTIONS = {
+    "workload": ("workloads", "workloads"),
+    "clusterqueue": ("clusterQueues", "clusterqueues"),
+    "localqueue": ("localQueues", None),  # no server delete route
+    "resourceflavor": ("resourceFlavors", "resourceflavors"),
+}
+
+
+def cmd_delete(state: State, args) -> None:
+    section, server_section = _DELETE_SECTIONS[args.kind]
+    # clusterqueue/resourceflavor are cluster-scoped: the namespace
+    # default must not make State.find miss them
+    namespaced = args.kind in ("workload", "localqueue")
+    ns = getattr(args, "namespace", "") if namespaced else ""
+    if getattr(args, "server", None):
+        from kueue_tpu.server import KueueClient
+
+        client = KueueClient(args.server)
+        if args.kind == "workload":
+            client.delete_workload(ns, args.name)
+        elif args.kind == "clusterqueue":
+            client.delete_cluster_queue(args.name)
+        else:
+            raise SystemExit(
+                f"error: server delete not supported for {args.kind}"
+            )
+    else:
+        obj = state.find(section, args.name, ns)
+        state.data[section].remove(obj)
+        state.save()
+    print(f"{args.kind}.kueue.x-k8s.io/{args.name} deleted")
+
+
+# ---- passthrough get (cmd/kueuectl/app/passthrough) ----
+def cmd_get(state: State, args) -> None:
+    section, server_section = _DELETE_SECTIONS[args.kind]
+    # clusterqueue/resourceflavor are cluster-scoped: the namespace
+    # default must not make State.find miss them
+    namespaced = args.kind in ("workload", "localqueue")
+    ns = getattr(args, "namespace", "") if namespaced else ""
+    if getattr(args, "server", None):
+        from kueue_tpu.server import KueueClient
+
+        client = KueueClient(args.server)
+        if args.kind == "workload":
+            obj = client.get_workload(ns, args.name)
+        else:
+            obj = client.get(server_section or section, args.name)
+    else:
+        obj = state.find(section, args.name, ns)
+    json.dump(obj, sys.stdout, indent=1, sort_keys=True)
+    print()
+
+
+def cmd_version(state: State, args) -> None:
+    from kueue_tpu import __version__
+
+    print(f"kueuectl (kueue-tpu) {__version__}")
+
+
 # ---- pending-workloads (visibility) ----
 def cmd_pending_workloads(state: State, args) -> None:
     if getattr(args, "server", None):
@@ -416,6 +477,27 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("-n", "--namespace", default="default")
         p.set_defaults(fn=fn)
 
+    dele = sub.add_parser("delete")
+    dele.add_argument("kind", choices=sorted(_DELETE_SECTIONS))
+    dele.add_argument("name")
+    dele.add_argument("-n", "--namespace", default="default")
+    dele.add_argument(
+        "--server", help="delete on a running kueue_tpu.server instead of --state"
+    )
+    dele.set_defaults(fn=cmd_delete)
+
+    get = sub.add_parser("get")
+    get.add_argument("kind", choices=sorted(_DELETE_SECTIONS))
+    get.add_argument("name")
+    get.add_argument("-n", "--namespace", default="default")
+    get.add_argument(
+        "--server", help="read from a running kueue_tpu.server instead of --state"
+    )
+    get.set_defaults(fn=cmd_get)
+
+    ver = sub.add_parser("version")
+    ver.set_defaults(fn=cmd_version)
+
     pw = sub.add_parser("pending-workloads")
     pw.add_argument("clusterqueue")
     pw.add_argument(
@@ -437,7 +519,16 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     state = State(args.state)
-    args.fn(state, args)
+    try:
+        args.fn(state, args)
+    except BrokenPipeError:
+        # stdout closed early (e.g. piped through `head`): exit quietly
+        # the way kubectl-style tools do
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+        return 141  # 128 + SIGPIPE
     return 0
 
 

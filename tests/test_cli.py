@@ -162,3 +162,47 @@ class TestCLI:
         cli(tmp_path, "schedule")
         out = capsys.readouterr().out
         assert "admitted=2 pending=1" in out  # pod-p1 + one job
+
+
+class TestDeleteGetVersion:
+    def test_delete_workload(self, tmp_path, capsys):
+        cli(tmp_path, "create", "rf", "default")
+        cli(tmp_path, "create", "cq", "cq", "--nominal-quota", "cpu=4")
+        cli(tmp_path, "create", "lq", "lq", "-c", "cq")
+        cli(tmp_path, "create", "wl", "w1", "-q", "lq", "--requests", "cpu=1")
+        cli(tmp_path, "delete", "workload", "w1")
+        out = capsys.readouterr().out
+        assert "workload.kueue.x-k8s.io/w1 deleted" in out
+        state = json.load(open(tmp_path / "state.json"))
+        assert state["workloads"] == []
+
+    def test_delete_missing_errors(self, tmp_path):
+        with pytest.raises(SystemExit):
+            cli(tmp_path, "delete", "clusterqueue", "nope")
+
+    def test_get_passthrough_json(self, tmp_path, capsys):
+        cli(tmp_path, "create", "rf", "default")
+        cli(tmp_path, "create", "cq", "cq", "--nominal-quota", "cpu=4")
+        capsys.readouterr()
+        cli(tmp_path, "get", "clusterqueue", "cq")
+        obj = json.loads(capsys.readouterr().out)
+        assert obj["name"] == "cq"
+
+    def test_version(self, tmp_path, capsys):
+        cli(tmp_path, "version")
+        assert "kueuectl" in capsys.readouterr().out
+
+    def test_server_mode_get_and_delete(self, tmp_path, capsys):
+        from kueue_tpu.server import KueueServer
+
+        srv = KueueServer()
+        port = srv.start()
+        try:
+            srv.apply("resourceflavors", {"name": "default", "nodeLabels": {}})
+            addr = f"http://127.0.0.1:{port}"
+            capsys.readouterr()
+            cli(tmp_path, "get", "resourceflavor", "default", "--server", addr)
+            obj = json.loads(capsys.readouterr().out)
+            assert obj["name"] == "default"
+        finally:
+            srv.stop()
