@@ -1318,3 +1318,160 @@ class TestSchedulerCycleParity:
                       prio=101, t=NOW)
         res = sched.schedule()
         assert admitted_names(res) == ["c1"]
+
+
+# ---------------------------------------------------------------------------
+# TAS placement truth tables (pkg/cache/tas_cache_test.go
+# TestFindTopologyAssignment). Reference node fixtures re-stated
+# verbatim (defaultNodes :51-118, binaryTreesNodes :200-289, and the
+# per-case trees), asserting the same TopologyAssignment (levels +
+# domain values + per-domain counts) under the same placement-profile
+# feature gates.
+# ---------------------------------------------------------------------------
+
+from kueue_tpu import features
+from kueue_tpu.tas import TASFlavorSnapshot, TASPodSetRequest
+from kueue_tpu.models.workload import PodSetTopologyRequest
+
+BLOCK, RACK, HOST = (
+    "cloud.com/topology-block",
+    "cloud.com/topology-rack",
+    "kubernetes.io/hostname",
+)
+THREE_LEVELS = (BLOCK, RACK, HOST)
+TWO_LEVELS = (BLOCK, RACK)
+
+
+def tas_node(b, r, x, cpu=1, mem=1 << 30, pods=10):
+    return ({BLOCK: b, RACK: r, HOST: x},
+            {"cpu": cpu * 1000, "memory": mem, "pods": pods})
+
+
+# defaultNodes (tas_cache_test.go:51-118): x6 is the big host
+TAS_DEFAULT_NODES = [
+    tas_node("b1", "r1", "x1"),
+    tas_node("b1", "r2", "x2"),
+    tas_node("b1", "r2", "x3"),
+    tas_node("b1", "r2", "x4"),
+    tas_node("b2", "r1", "x5"),
+    tas_node("b2", "r2", "x6", cpu=2, mem=4 << 30, pods=40),
+]
+
+# binaryTreesNodes (:200-289): 2 blocks x 2 racks x 2 hosts, uniform
+TAS_BINARY_NODES = [
+    tas_node(f"b{bi}", f"r{ri}", f"x{(bi - 1) * 4 + (ri - 1) * 2 + hi}")
+    for bi in (1, 2) for ri in (1, 2) for hi in (1, 2)
+]
+
+
+def tas_snapshot(nodes, levels=THREE_LEVELS):
+    snap = TASFlavorSnapshot("default", tuple(levels))
+    for labels, alloc in nodes:
+        snap.add_node(labels, alloc, ())
+    snap.freeze()
+    return snap
+
+
+def tas_request(count, level, mode="Required", cpu=1000):
+    return TASPodSetRequest(
+        podset_name="main", count=count,
+        single_pod_requests={"cpu": cpu},
+        topology_request=PodSetTopologyRequest(mode=mode, level=level),
+    )
+
+
+def domains_of(ta):
+    return sorted((tuple(d.values), d.count) for d in ta.domains)
+
+
+class TestTASPlacementParity:
+    """tas_cache_test.go TestFindTopologyAssignment, names preserved."""
+
+    def test_minimize_racks_before_nodes_most_free(self):  # :306
+        nodes = [
+            tas_node("b1", "r1", "x1", cpu=2),
+            tas_node("b1", "r2", "x2", cpu=2, pods=20),
+            tas_node("b1", "r3", "x3"),
+            tas_node("b1", "r3", "x4"),
+            tas_node("b1", "r3", "x5"),
+            tas_node("b1", "r3", "x6"),
+        ]
+        with features.override("TASProfileMostFreeCapacity", True):
+            snap = tas_snapshot(nodes)
+            ta, reason = snap.find_topology_assignment(
+                tas_request(4, BLOCK), {})
+        assert reason == ""
+        assert ta.levels == (HOST,)
+        assert domains_of(ta) == [(("x3",), 1), (("x4",), 1),
+                                  (("x5",), 1), (("x6",), 1)]
+
+    def test_minimize_fragmentation_least_free(self):  # :417
+        nodes = [
+            tas_node("b1", "r1", "x1", cpu=2),
+            tas_node("b1", "r1", "x2"),
+            tas_node("b1", "r1", "x3"),
+        ]
+        with features.override("TASProfileLeastFreeCapacity", True):
+            snap = tas_snapshot(nodes)
+            ta, reason = snap.find_topology_assignment(
+                tas_request(2, BLOCK), {})
+        assert reason == ""
+        assert domains_of(ta) == [(("x2",), 1), (("x3",), 1)]
+
+    def test_choose_node_that_accommodates_all_pods(self):  # :483
+        nodes = [
+            tas_node("b1", "r1", "x1", cpu=2),
+            tas_node("b1", "r1", "x2"),
+            tas_node("b1", "r1", "x3"),
+        ]
+        snap = tas_snapshot(nodes)
+        ta, reason = snap.find_topology_assignment(tas_request(2, BLOCK), {})
+        assert reason == ""
+        assert domains_of(ta) == [(("x1",), 2)]
+
+    def test_block_required_binary_tree_best_fit(self):  # :784
+        snap = tas_snapshot(TAS_BINARY_NODES)
+        ta, reason = snap.find_topology_assignment(tas_request(4, BLOCK), {})
+        assert reason == ""
+        assert domains_of(ta) == [(("x1",), 1), (("x2",), 1),
+                                  (("x3",), 1), (("x4",), 1)]
+
+    def test_block_required_binary_tree_most_free(self):  # :743
+        with features.override("TASProfileMostFreeCapacity", True):
+            snap = tas_snapshot(TAS_BINARY_NODES)
+            ta, reason = snap.find_topology_assignment(
+                tas_request(4, BLOCK), {})
+        assert reason == ""
+        assert domains_of(ta) == [(("x1",), 1), (("x2",), 1),
+                                  (("x3",), 1), (("x4",), 1)]
+
+    def test_host_required_best_fit(self):  # :871
+        snap = tas_snapshot(TAS_DEFAULT_NODES)
+        ta, reason = snap.find_topology_assignment(tas_request(1, HOST), {})
+        assert reason == ""
+        assert domains_of(ta) == [(("x1",), 1)]
+
+    def test_host_required_most_free(self):  # :824
+        with features.override("TASProfileMostFreeCapacity", True):
+            snap = tas_snapshot(TAS_DEFAULT_NODES)
+            ta, reason = snap.find_topology_assignment(
+                tas_request(1, HOST), {})
+        assert reason == ""
+        assert domains_of(ta) == [(("x6",), 1)]
+
+    def test_rack_required_two_levels_most_free(self):  # :939
+        with features.override("TASProfileMostFreeCapacity", True):
+            snap = tas_snapshot(TAS_DEFAULT_NODES, levels=TWO_LEVELS)
+            ta, reason = snap.find_topology_assignment(
+                tas_request(1, RACK), {})
+        assert reason == ""
+        assert ta.levels == TWO_LEVELS
+        assert domains_of(ta) == [(("b1", "r2"), 1)]
+
+    def test_rack_preferred_multiple_racks_least_free(self):  # :987
+        with features.override("TASProfileLeastFreeCapacity", True):
+            snap = tas_snapshot(TAS_DEFAULT_NODES, levels=TWO_LEVELS)
+            ta, reason = snap.find_topology_assignment(
+                tas_request(2, RACK, mode="Preferred"), {})
+        assert reason == ""
+        assert domains_of(ta) == [(("b2", "r1"), 1), (("b2", "r2"), 1)]
