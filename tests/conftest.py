@@ -25,3 +25,9 @@ jax.config.update("jax_enable_x64", True)
 from kueue_tpu import native  # noqa: E402
 
 native.ensure_built()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: process-level e2e tests (spawn real servers)"
+    )
