@@ -70,12 +70,6 @@ def plan_drain(
         snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
     )
     fallback = set(lowered.fallback)
-    # the drain's candidate-cursor retry (k+1 on an in-cycle conflict
-    # loss) is exact only when candidates enumerate ONE resource
-    # group's flavor walk; multi-group workloads go to the cycle loop
-    for i in range(len(lowered.heads)):
-        if i not in fallback and lowered.n_groups[i] != 1:
-            fallback.add(i)
 
     by_cq: Dict[str, List[int]] = {}
     for i, cq_name in enumerate(lowered.cq_names):
@@ -93,13 +87,25 @@ def plan_drain(
     cells = np.full((q, l, k, c), -1, dtype=np.int32)
     qty = np.zeros((q, l, k, c), dtype=np.int64)
     valid = np.zeros((q, l, k), dtype=bool)
-    reset = np.zeros((q, l, k), dtype=bool)
+    # per-group candidate cursor inputs (drain_kernel.DrainQueues):
+    # G = widest resource-group vector among representable heads
+    g = max(
+        [1]
+        + [
+            lowered.n_groups[i]
+            for i in range(len(lowered.heads))
+            if i not in fallback
+        ]
+    )
+    gidx = np.zeros((q, l, k, g), dtype=np.int32)
+    glast = np.zeros((q, l, k, g), dtype=bool)
+    cgrp = np.full(cells.shape, -1, dtype=np.int8)
     priority = np.zeros((q, l), dtype=np.int64)
     timestamp = np.zeros((q, l), dtype=np.int64)
     no_reclaim = np.zeros(q, dtype=bool)
     head_of: Dict[Tuple[int, int], int] = {}
 
-    reset_of_tried: Dict[int, np.ndarray] = {}
+    cursor_rows_of: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
     for qi, cq_name in enumerate(cq_order):
         idxs = by_cq[cq_name]
         cq_rows[qi] = snapshot.row(cq_name)
@@ -110,22 +116,29 @@ def plan_drain(
         cells[qi, :n] = lowered.cells[idx_arr]
         qty[qi, :n] = lowered.qty[idx_arr]
         valid[qi, :n] = lowered.valid[idx_arr]
+        cgrp[qi, :n] = lowered.cgrp[idx_arr]
         priority[qi, :n] = lowered.priority[idx_arr]
         timestamp[qi, :n] = lowered.timestamp[idx_arr]
         for pos, i in enumerate(idxs):
             head_of[(qi, pos)] = i
-            tried = lowered.candidate_tried[i]
-            # tried lists are shared per lowering template: memoize the
-            # reset row per list identity (single group: every resource
-            # carries the same cursor)
-            row = reset_of_tried.get(id(tried))
-            if row is None:
-                row = np.zeros(k, dtype=bool)
-                for kk, tried_map in enumerate(tried):
-                    if tried_map and next(iter(tried_map.values())) == -1:
-                        row[kk] = True
-                reset_of_tried[id(tried)] = row
-            reset[qi, pos] = row
+            groups = lowered.candidate_groups[i]
+            # group lists are shared per lowering template: memoize the
+            # dense cursor rows per list identity
+            rows = cursor_rows_of.get(id(groups))
+            if rows is None:
+                gi_row = np.zeros((k, g), dtype=np.int32)
+                # pad group slots (heads touching fewer than G groups)
+                # must stay permanently eligible: glast=True makes the
+                # resumed start 0, so gidx(0) >= 0 always holds
+                gl_row = np.ones((k, g), dtype=bool)
+                for kk, gvec in enumerate(groups):
+                    for gx, (fi, lastf) in enumerate(gvec):
+                        gi_row[kk, gx] = fi
+                        gl_row[kk, gx] = lastf
+                rows = (gi_row, gl_row)
+                cursor_rows_of[id(groups)] = rows
+            gidx[qi, pos] = rows[0]
+            glast[qi, pos] = rows[1]
 
     roots = build_roots(snapshot.flat.parent)
     seg_id = np.full(q, -1, dtype=np.int32)
@@ -136,16 +149,18 @@ def plan_drain(
         n_segments = _bucket(len(uniq), minimum=8)
         n_steps = _bucket(int(np.bincount(inv).max()), minimum=8)
         # Sound cycle cap: every cycle, each root cohort with live heads
-        # retires at least one entry — its rank-0 valid head admits (no
-        # in-segment predecessor has touched usage yet) and NoFit heads
-        # park unconditionally — so cycles <= the largest segment's
-        # total entry count. Conflict-lost heads retrying per remaining
-        # candidate are covered: each loss pairs with an admission in
-        # the same segment that cycle. (The former 2*L+8 bound wrongly
-        # assumed per-queue progress.)
+        # retires at least one entry OR advances some head's per-group
+        # flavor cursor — its rank-0 valid head admits (no in-segment
+        # predecessor has touched usage yet); NoFit heads park unless a
+        # group's walk stored a pending cursor, in which case the
+        # cursor strictly advances and can do so at most K times per
+        # entry before the walk exhausts and parks. Conflict-lost heads
+        # retrying per remaining candidate pair with a same-segment
+        # admission that cycle. So cycles <= largest segment's entries
+        # x (1 + K pending retries each).
         max_seg_events = int(
             np.bincount(inv, weights=qlen[live].astype(np.float64)).max()
-        )
+        ) * (max_candidates + 1)
     else:
         n_segments = n_steps = 8
         max_seg_events = 0
@@ -158,7 +173,9 @@ def plan_drain(
             cells=cells,
             qty=qty,
             valid=valid,
-            reset=reset,
+            gidx=gidx,
+            glast=glast,
+            cgrp=cgrp,
             priority=priority,
             timestamp=timestamp,
             no_reclaim=no_reclaim,
@@ -171,9 +188,8 @@ def plan_drain(
         # the while_loop stops at quiescence; this is a backstop only —
         # bucketed because it is a static jit arg (compile reuse)
         max_cycles=_bucket(max_seg_events + 8, minimum=16),
-        # the COMPLETE fallback set (lowering fallbacks + multi-group
-        # heads excluded above) — outcome mapping must use this, not
-        # lowered.fallback, or the extra exclusions silently vanish
+        # the COMPLETE fallback set — outcome mapping must use this,
+        # not lowered.fallback, or extra exclusions silently vanish
         fallback=sorted(fallback),
     )
 
@@ -327,7 +343,14 @@ def run_drain_preempt(
             weights=vvalid.sum(axis=1)[live].astype(np.float64),
             minlength=nseg,
         )
-        cap = int(((seg_victims + 1) * seg_entries + seg_victims).max()) + 8
+        # each entry may additionally burn up to max_candidates cycles
+        # retrying with advanced per-group pending cursors before it
+        # retires (the PendingFlavors emulation), hence the (K+1) factor
+        cap = (
+            int(((seg_victims + 1) * seg_entries + seg_victims).max())
+            * (max_candidates + 1)
+            + 8
+        )
     else:
         cap = 16
     plan.max_cycles = _bucket(cap, minimum=16)

@@ -46,6 +46,8 @@ class Lowered:
     priority: np.ndarray  # int64[W]
     timestamp: np.ndarray  # int64[W] (ns)
     no_reclaim: np.ndarray  # bool[W] — reserve capacity when blocked
+    # int8[W,K,C]: resource-group index of each candidate cell (-1 pad)
+    cgrp: np.ndarray = None
     # per head: candidate k -> flavor name chosen per resource group
     candidate_flavors: List[List[Dict[str, str]]] = field(default_factory=list)
     # per head: candidate k -> resource -> host-equivalent tried-flavor
@@ -55,9 +57,12 @@ class Lowered:
     heads: List[Workload] = field(default_factory=list)
     cq_names: List[str] = field(default_factory=list)
     fallback: List[int] = field(default_factory=list)  # indices into input heads
-    # per head: number of resource groups its request touches (the
-    # drain's candidate-cursor resume is exact only for 1 group)
+    # per head: number of resource groups its request touches
     n_groups: List[int] = field(default_factory=list)
+    # per head: candidate k -> tuple per resource group of
+    # (flavor index within the group's walk, chose-last flag) — the
+    # drain's per-group candidate-cursor resume (LastAssignment vector)
+    candidate_groups: List[List[tuple]] = field(default_factory=list)
 
 
 def _default_fungibility(cq: ClusterQueue) -> bool:
@@ -84,9 +89,11 @@ class _Template:
         "cells_arr",
         "valid_row",
         "qty_sel",
+        "cgrp_arr",
         "res_names",
         "flavor_list",
         "tried_list",
+        "group_list",
     )
 
     def __init__(self):
@@ -104,9 +111,14 @@ class _Template:
         self.cells_arr = None
         self.valid_row = None
         self.qty_sel = None
+        # int8[K,C]: resource-group index of each candidate cell (-1 pad)
+        self.cgrp_arr = None
         self.res_names: Tuple[str, ...] = ()
         self.flavor_list: List[Dict[str, str]] = []
         self.tried_list: List[Dict[str, int]] = []
+        # per candidate: tuple per group of (flavor idx in rg.flavors,
+        # is-last-flavor flag); empty tuple for invalid candidates
+        self.group_list: List[tuple] = []
 
 
 def _podset_sig(ps, per_pod) -> tuple:
@@ -159,8 +171,9 @@ def _build_template(
             if flavor_eligible(flavor, ps, label_keys):
                 # host cursor semantics: a FIT at the group's last
                 # flavor stores -1 (restart from 0 next time)
-                tried = -1 if gi == n_flavors - 1 else gi
-                options.append((fq.name, tried))
+                last = gi == n_flavors - 1
+                tried = -1 if last else gi
+                options.append((fq.name, tried, gi, last))
         if not options:
             t.fallback = True
             return t
@@ -177,9 +190,13 @@ def _build_template(
     # cartesian product across RGs in reference order (first RG's
     # flavor walk is the outer loop — matches the sequential search
     # trying RG1 flavors fully per RG0 choice)
-    combos: List[List[Tuple[int, str, int]]] = [[]]
+    combos: List[List[tuple]] = [[]]
     for gidx, options in enumerate(per_rg):
-        combos = [prev + [(gidx, f, tr)] for prev in combos for (f, tr) in options]
+        combos = [
+            prev + [(gidx, f, tr, gi, lastf)]
+            for prev in combos
+            for (f, tr, gi, lastf) in options
+        ]
 
     from kueue_tpu.core.preemption import can_always_reclaim
 
@@ -188,10 +205,13 @@ def _build_template(
     for combo in combos:
         cell_js: List[int] = []
         cell_rs: List[str] = []
+        cell_gs: List[int] = []
         flavor_map: Dict[str, str] = {}
         tried_map: Dict[str, int] = {}
+        gvec: List[tuple] = []
         ok = True
-        for gidx, fname, tried in combo:
+        for gidx, fname, tried, gi, lastf in combo:
+            gvec.append((gi, lastf))
             for r in touched[gidx][1]:
                 j = snapshot.fr_index.get(FlavorResource(fname, r))
                 if j is None:
@@ -199,17 +219,20 @@ def _build_template(
                     break
                 cell_js.append(j)
                 cell_rs.append(r)
+                cell_gs.append(gidx)
                 flavor_map[r] = fname
                 tried_map[r] = tried
             if not ok:
                 break
         if ok:
             t.candidates.append(
-                (tuple(cell_js), tuple(cell_rs), flavor_map, tried_map)
+                (tuple(cell_js), tuple(cell_rs), flavor_map, tried_map, tuple(cell_gs))
             )
+            t.group_list.append(tuple(gvec))
             t.any_valid = True
         else:
             t.candidates.append(None)
+            t.group_list.append(())
     if not t.any_valid:
         t.fallback = True
         return t
@@ -222,15 +245,17 @@ def _build_template(
     t.valid_row = np.zeros(k, dtype=bool)
     # unused cell slots select the trailing 0 of the request vector
     t.qty_sel = np.full((k, c), len(res_names), dtype=np.int32)
+    t.cgrp_arr = np.full((k, c), -1, dtype=np.int8)
     for ki, cand in enumerate(t.candidates):
         if cand is None:
             t.flavor_list.append({})
             t.tried_list.append({})
             continue
-        cell_js, cell_rs, flavor_map, tried_map = cand
-        for ci, (j, r) in enumerate(zip(cell_js, cell_rs)):
+        cell_js, cell_rs, flavor_map, tried_map, cell_gs = cand
+        for ci, (j, r, cg) in enumerate(zip(cell_js, cell_rs, cell_gs)):
             t.cells_arr[ki, ci] = j
             t.qty_sel[ki, ci] = r_idx[r]
+            t.cgrp_arr[ki, ci] = cg
         t.valid_row[ki] = True
         t.flavor_list.append(flavor_map)
         t.tried_list.append(tried_map)
@@ -259,6 +284,7 @@ def lower_heads(
         cells=np.full((w, k, c), -1, dtype=np.int32),
         qty=np.zeros((w, k, c), dtype=np.int64),
         valid=np.zeros((w, k), dtype=bool),
+        cgrp=np.full((w, k, c), -1, dtype=np.int8),
         priority=np.zeros(w, dtype=np.int64),
         timestamp=np.zeros(w, dtype=np.int64),
         no_reclaim=np.zeros(w, dtype=bool),
@@ -272,6 +298,7 @@ def lower_heads(
         out.cq_names.append(cq_name)
         out.candidate_flavors.append([])
         out.candidate_tried.append([])
+        out.candidate_groups.append([])
         out.n_groups.append(0)
         if cq_name not in snapshot.cq_models:
             out.fallback.append(i)
@@ -326,6 +353,7 @@ def lower_heads(
         # shared read-only maps (one list per template, not per head)
         out.candidate_flavors[i] = t.flavor_list
         out.candidate_tried[i] = t.tried_list
+        out.candidate_groups[i] = t.group_list
         # defer the array fills: heads sharing a template batch into ONE
         # numpy op per field instead of four small ops per head (the
         # per-head fills dominated bulk-drain lowering wall time)
@@ -340,6 +368,7 @@ def lower_heads(
         out.cq_row[ii] = t.cq_row
         out.cells[ii] = t.cells_arr
         out.valid[ii] = t.valid_row
+        out.cgrp[ii] = t.cgrp_arr
         # request matrix: rows = heads in this group, cols = the
         # template's resource order (+1 zero column for padding cells)
         rmat = np.zeros((len(ii), len(t.res_names) + 1), dtype=np.int64)

@@ -62,10 +62,19 @@ class DrainQueues(NamedTuple):
     cells:    int32[Q,L,K,C] / qty: int64[Q,L,K,C] / valid: bool[Q,L,K]
               — each entry's lowered flavor candidates (core/solver.py
               lower_heads layout).
-    reset:    bool[Q,L,K]  — candidate k is the LAST flavor of its
-              resource group (host cursor semantics store -1 there:
-              a conflict-skipped head restarts the walk from flavor 0
-              instead of resuming past the end).
+    gidx:     int32[Q,L,K,G] — candidate k's flavor index within each
+              of the entry's G resource-group walks (pad groups 0).
+    glast:    bool[Q,L,K,G] — that flavor is the LAST of its group's
+              walk (host cursor semantics store -1 there: the resumed
+              walk restarts that group at flavor 0). Together these
+              carry the per-group LastAssignment vector: a
+              conflict-skipped head's next attempt admits exactly the
+              candidates whose every group index is >= the resumed
+              per-group start — the same set a host-side template
+              rebuilt from the stored cursors would enumerate.
+    cgrp:     int8[Q,L,K,C] — resource-group index of each candidate
+              cell (-1 pad), for the per-group first-fit walk of the
+              PendingFlavors emulation.
     priority: int64[Q,L] / timestamp: int64[Q,L] — entry order keys,
               already sorted within each queue (priority desc, ts asc —
               the pending-heap order, cluster_queue.go:413-426).
@@ -78,7 +87,9 @@ class DrainQueues(NamedTuple):
     cells: jnp.ndarray
     qty: jnp.ndarray
     valid: jnp.ndarray
-    reset: jnp.ndarray
+    gidx: jnp.ndarray
+    glast: jnp.ndarray
+    cgrp: jnp.ndarray
     priority: jnp.ndarray
     timestamp: jnp.ndarray
     no_reclaim: jnp.ndarray
@@ -97,6 +108,96 @@ class DrainResult(NamedTuple):
     cursor: jnp.ndarray
     cycles: jnp.ndarray
     local_usage: jnp.ndarray
+
+
+def _group_cursor_inputs(queues, q_idx, cur):
+    """Per-cycle gathers shared by _pending_walk and
+    _preempt_representative: current entries' per-group flavor indexes,
+    chose-last flags, and the cell->group one-hot mask."""
+    gid = queues.gidx[q_idx, cur]  # [Q,K,G]
+    gl = queues.glast[q_idx, cur]  # [Q,K,G]
+    cg = queues.cgrp[q_idx, cur]  # [Q,K,C]
+    g = gid.shape[-1]
+    gmask = cg[..., None] == jnp.arange(g)[None, None, None, :]  # [Q,K,C,G]
+    return gid, gl, gmask
+
+
+def _pending_walk(gid, gl, gmask, head_valid, fit_cells):
+    """Host PendingFlavors emulation (cluster_queue.go:231 + the
+    fungibility cursor, flavor_assigner._find_flavor_for_resource).
+
+    A PREEMPT-mode nomination (every group produced choices) stores the
+    representative's cursor: groups that stopped at a FIT flavor store
+    that index (-1 when it is the group's last), preempt/reclaim groups
+    ran their walk to the end and store -1. The head requeues
+    IMMEDIATELY (stays at the queue front) iff any group's stored
+    cursor is pending, retrying next cycle from the advanced starts.
+    A NO_FIT nomination (some group produced no choices) CLEARS the
+    whole cursor (flavor_assigner.assign wipes psr.flavors on group
+    failure), so NoFit heads always park. Returns
+    (pending bool[Q], next_start int32[Q,G]) — callers gate on
+    preempt-mode."""
+    gfit = jnp.all(
+        jnp.where(gmask, fit_cells[..., None], True), axis=2
+    )  # [Q,K,G]
+    cand_ok = head_valid[:, :, None] & gfit
+    inf = jnp.int32(2**30)
+    fidx = jnp.min(jnp.where(cand_ok, gid, inf), axis=1)  # [Q,G]
+    found = fidx < inf
+    is_last = jnp.any((gid == fidx[:, None, :]) & gl & cand_ok, axis=1)
+    stored = jnp.where(found & ~is_last, fidx, -1)
+    pending = jnp.any(stored >= 0, axis=1)
+    return pending, (stored + 1).astype(jnp.int32)
+
+
+def _preempt_representative(
+    gid, gmask, head_valid, fit_cells, pot_cells, reclaim_cells
+):
+    """Host-equivalent preempt-mode representative.
+
+    The host's per-group flavor walk stops at the first FIT flavor;
+    otherwise it traverses the whole group preferring the best granular
+    mode seen (RECLAIM > PREEMPT, earliest wins —
+    flavor_assigner._find_flavor_for_resource + the reclaim oracle
+    upgrade). The representative assignment combines each group's best
+    choice, so the device must pick THAT candidate combo — not simply
+    the first preempt-eligible combo — or its capacity reservations and
+    borrow-ordering diverge from the host. Returns
+    (pre_k int32[Q], has_pre bool[Q])."""
+    # cell granular mode: FIT=3 > RECLAIM=2 > PREEMPT=1 > NOFIT=0
+    cellmode = jnp.where(
+        fit_cells,
+        3,
+        jnp.where(pot_cells & reclaim_cells, 2, jnp.where(pot_cells, 1, 0)),
+    ).astype(jnp.int32)
+    gmode = jnp.min(
+        jnp.where(gmask, cellmode[..., None], 3), axis=2
+    )  # [Q,K,G]
+    inf = jnp.int32(2**30)
+    valid3 = head_valid[:, :, None]  # [Q,K,1]
+    # first FIT flavor per group (the walk stops there)
+    fit_idx = jnp.min(
+        jnp.where(valid3 & (gmode == 3), gid, inf), axis=1
+    )  # [Q,G]
+    # otherwise: best mode seen across the walk, earliest flavor of it
+    best_mode = jnp.max(
+        jnp.where(valid3, gmode, -1), axis=1
+    )  # [Q,G]
+    best_idx = jnp.min(
+        jnp.where(valid3 & (gmode == best_mode[:, None, :]), gid, inf), axis=1
+    )
+    want_idx = jnp.where(fit_idx < inf, fit_idx, best_idx)  # [Q,G]
+    has_pre = jnp.all(
+        jnp.where(fit_idx < inf, 3, best_mode) >= 1, axis=1
+    ) & jnp.all(want_idx < inf, axis=1)
+    # the candidate whose per-group flavors equal the per-group bests
+    match = head_valid & jnp.all(gid == want_idx[:, None, :], axis=-1)  # [Q,K]
+    pre_k = jnp.where(
+        jnp.any(match, axis=1) & has_pre,
+        jnp.argmax(match, axis=1),
+        -1,
+    ).astype(jnp.int32)
+    return pre_k, (pre_k >= 0)
 
 
 def solve_drain(
@@ -119,14 +220,19 @@ def solve_drain(
     )
 
     def cycle_body(state):
-        local, cursor, k_start, adm_k, adm_cycle, cycle = state
+        local, cursor, g_start, adm_k, adm_cycle, cycle = state
 
         active = cursor < queues.qlen  # [Q]
         cur = jnp.minimum(cursor, l - 1)
-        # candidate cursor: a conflict-skipped head resumes its flavor
-        # walk past the candidate it chose last cycle (LastAssignment
-        # semantics, flavorassigner.go:359-377 + cluster_queue.go:231)
-        k_mask = jnp.arange(k)[None, :] >= k_start[:, None]  # [Q, K]
+        # per-group candidate cursor: a conflict-skipped head resumes
+        # each resource group's flavor walk past the flavor it chose
+        # last cycle (LastAssignment semantics, flavorassigner.go:
+        # 359-377 + cluster_queue.go:231); a candidate stays eligible
+        # iff EVERY group index is past its group's start — the
+        # cartesian sub-walk the rebuilt host template would enumerate
+        k_mask = jnp.all(
+            queues.gidx[q_idx, cur] >= g_start[:, None, :], axis=-1
+        )  # [Q, K]
         heads = HeadsBatch(
             cq_row=jnp.where(active, queues.cq_rows, -1).astype(jnp.int32),
             cells=queues.cells[q_idx, cur],  # [Q, K, C]
@@ -137,9 +243,16 @@ def solve_drain(
             no_reclaim=queues.no_reclaim,
         )
 
-        chosen, borrows_wk, preempt_k = phase1_classify(
-            tree, subtree, guaranteed, local, heads
+        (chosen, borrows_wk, _first_pre, fit_cells, pot_cells,
+         reclaim_cells) = phase1_classify(
+            tree, subtree, guaranteed, local, heads, return_cell_fit=True
         )
+        gid_cur, gl_cur, gmask_cur = _group_cursor_inputs(queues, q_idx, cur)
+        pre_rep, _ = _preempt_representative(
+            gid_cur, gmask_cur, heads.valid, fit_cells, pot_cells,
+            reclaim_cells,
+        )
+        preempt_k = jnp.where(chosen < 0, pre_rep, -1)
         eff_k = jnp.where(chosen >= 0, chosen, preempt_k)
         eff_safe = jnp.maximum(eff_k, 0)
         head_borrow = jnp.take_along_axis(
@@ -246,39 +359,53 @@ def solve_drain(
         add = jnp.where(cell_valid & admitted[:, None], qty_eff, 0)
         local = local.at[cq[:, None], jnp.maximum(cells_eff, 0)].add(add)
 
-        # queue motion: admitted leave; non-Fit heads park (advance) —
-        # including preempt-classified reserving heads, whose exhausted
-        # flavor walk stores no pending cursor so the host parks them
-        # too; only in-cycle conflict losers stay and retry, resuming
-        # past the candidate they chose
-        advance = active & (admitted | (chosen < 0))
+        # queue motion: admitted leave; non-Fit heads park (advance)
+        # UNLESS some resource group's independent walk stored a pending
+        # flavor cursor — those requeue immediately and retry from the
+        # advanced per-group starts (PendingFlavors; multi-group heads
+        # can be NoFit overall while one group found a non-final fit);
+        # in-cycle conflict losers stay, resuming past the chosen combo
+        walk_pending, walk_next = _pending_walk(
+            gid_cur, gl_cur, gmask_cur, heads.valid, fit_cells
+        )
+        pend = walk_pending & (preempt_k >= 0)  # NoFit heads never pend
+        retrying = active & (chosen < 0) & pend
+        advance = active & (admitted | ((chosen < 0) & ~pend))
         adm_k = adm_k.at[q_idx, cur].set(
             jnp.where(admitted & active, chosen, adm_k[q_idx, cur])
         )
         adm_cycle = adm_cycle.at[q_idx, cur].set(
             jnp.where(admitted & active, cycle, adm_cycle[q_idx, cur])
         )
-        # cursor semantics of the host walk: choosing the group's LAST
-        # flavor stores -1 (restart at 0); otherwise resume past it
+        # cursor semantics of the host walk, per group: choosing the
+        # group's LAST flavor stores -1 (restart that group at 0);
+        # otherwise resume past the chosen flavor
         chosen_safe = jnp.maximum(chosen, 0)
-        chose_last = queues.reset[q_idx, cur, chosen_safe]  # [Q]
+        gi_c = queues.gidx[q_idx, cur, chosen_safe]  # [Q, G]
+        last_c = queues.glast[q_idx, cur, chosen_safe]  # [Q, G]
+        resumed = jnp.where(last_c, 0, gi_c + 1)
         lost = active & (chosen >= 0) & (~admitted)
-        k_start = jnp.where(
-            advance,
+        g_start = jnp.where(
+            advance[:, None],
             0,
-            jnp.where(lost, jnp.where(chose_last, 0, chosen_safe + 1), k_start),
+            jnp.where(
+                lost[:, None],
+                resumed,
+                jnp.where(retrying[:, None], walk_next, g_start),
+            ),
         ).astype(jnp.int32)
         cursor = cursor + advance.astype(jnp.int32)
-        return local, cursor, k_start, adm_k, adm_cycle, cycle + 1
+        return local, cursor, g_start, adm_k, adm_cycle, cycle + 1
 
     def cond(state):
         _, cursor, _, _, _, cycle = state
         return jnp.any(cursor < queues.qlen) & (cycle < max_cycles)
 
+    g = queues.gidx.shape[-1]
     init = (
         local_usage,
         jnp.zeros(q, dtype=jnp.int32),
-        jnp.zeros(q, dtype=jnp.int32),
+        jnp.zeros((q, g), dtype=jnp.int32),
         jnp.full((q, l), -1, dtype=jnp.int32),
         jnp.full((q, l), -1, dtype=jnp.int32),
         jnp.int32(0),
@@ -463,8 +590,10 @@ def solve_drain_preempt(
 
     Entry state is per-(queue, position): pending(0)/parked(1)/
     admitted(2); each queue's head is its first pending entry in heap
-    order. Scope (host lowering enforces): single-podset single-RG
-    default-fungibility heads, candidates within the head's own
+    order. Scope (host lowering enforces): single-podset
+    default-fungibility heads (any number of resource groups — the
+    per-group cursor vectors and the reclaim-oracle emulation cover the
+    cartesian candidate walk), candidates within the head's own
     ClusterQueue only (reclaimWithinCohort == Never or no cohort), no
     fair sharing.
     """
@@ -485,7 +614,7 @@ def solve_drain_preempt(
     )
 
     def cycle_body(state):
-        (local, status, k_start, adm_k, adm_cycle,
+        (local, status, g_start, adm_k, adm_cycle,
          vevicted, evict_cycle, cycle) = state
 
         # head of each queue = first pending entry in heap order
@@ -495,7 +624,9 @@ def solve_drain_preempt(
         active = (cur_raw < l) & (cur_raw < queues.qlen)
         cur = jnp.minimum(cur_raw, l - 1)
 
-        k_mask = jnp.arange(k)[None, :] >= k_start[:, None]
+        k_mask = jnp.all(
+            queues.gidx[q_idx, cur] >= g_start[:, None, :], axis=-1
+        )
         heads = HeadsBatch(
             cq_row=jnp.where(active, queues.cq_rows, -1).astype(jnp.int32),
             cells=queues.cells[q_idx, cur],
@@ -506,9 +637,42 @@ def solve_drain_preempt(
             no_reclaim=queues.no_reclaim,
         )
 
-        chosen, borrows_wk, preempt_k = phase1_classify(
-            tree, subtree, guaranteed, local, heads
+        (chosen, borrows_wk, _first_pre, fit_cells, pot_cells,
+         reclaim_leaf) = phase1_classify(
+            tree, subtree, guaranteed, local, heads, return_cell_fit=True
         )
+        # Victim-eligibility predicate (preemption.go:480-524 priority
+        # rule), shared by the reclaim-oracle emulation here and the
+        # victim search below — ONE definition so they cannot drift.
+        live_victim = victims.vvalid & ~vevicted  # [Q,V]
+        lower = victims.vprio < heads.priority[:, None]
+        newer_eq = (
+            victims.same_prio_ok[:, None]
+            & (victims.vprio == heads.priority[:, None])
+            & (heads.timestamp[:, None] < victims.vts)
+        )
+        elig_v = live_victim & (lower | newer_eq)  # [Q,V]
+        # Reclaim-oracle emulation under the preempt-drain scope
+        # (reclaimWithinCohort=Never): the oracle's target search sees
+        # only same-CQ candidates, so the upgrade holds iff the leaf
+        # condition does AND no live eligible victim uses the cell's
+        # flavor-resource (a candidate existing means the oracle finds
+        # a same-CQ target and refuses the upgrade).
+        # victim uses candidate cell: [Q,K,C] via [Q,V,Cv] matching
+        vmatch = (
+            victims.vcells[:, None, :, :, None]
+            == jnp.maximum(heads.cells, 0)[:, :, None, None, :]
+        ) & (victims.vcells >= 0)[:, None, :, :, None]  # [Q,K,V,Cv,C]
+        victim_on_cell = jnp.any(
+            vmatch & elig_v[:, None, :, None, None], axis=(2, 3)
+        )  # [Q,K,C]
+        reclaim_cells = reclaim_leaf & ~victim_on_cell
+        gid_cur, gl_cur, gmask_cur = _group_cursor_inputs(queues, q_idx, cur)
+        pre_rep, _ = _preempt_representative(
+            gid_cur, gmask_cur, heads.valid, fit_cells, pot_cells,
+            reclaim_cells,
+        )
+        preempt_k = jnp.where(chosen < 0, pre_rep, -1)
         eff_k = jnp.where(chosen >= 0, chosen, preempt_k)
         eff_safe = jnp.maximum(eff_k, 0)
         head_borrow = jnp.take_along_axis(
@@ -535,17 +699,10 @@ def solve_drain_preempt(
             jnp.where(match, victims.vqty[:, :, :, None], 0), axis=2
         )  # [Q, V, C]
         is_pre_head = active & (chosen < 0) & (preempt_k >= 0) & victims.can_preempt
-        live_victim = victims.vvalid & ~vevicted
-        # candidate filters (preemption.go:480-524): priority rule +
+        # candidate filter: the shared priority predicate above +
         # uses-a-needed-flavor-resource
-        lower = victims.vprio < heads.priority[:, None]
-        newer_eq = (
-            victims.same_prio_ok[:, None]
-            & (victims.vprio == heads.priority[:, None])
-            & (heads.timestamp[:, None] < victims.vts)
-        )
         uses = jnp.any(vq_at * cell_need[:, None, :].astype(jnp.int64) > 0, axis=2)
-        eligible = live_victim & (lower | newer_eq) & uses
+        eligible = elig_v & uses
 
         targets, psuccess = search_v(
             paths[cq], cells_eff, qty_eff, cell_need, vq_at, eligible,
@@ -710,11 +867,24 @@ def solve_drain_preempt(
         # fits() re-check — requeue immediately (FAILED_AFTER_NOMINATION,
         # scheduler._requeue_and_update) and stay pending.
         pre_skipped = psuccess & ~preempt_ok
+        walk_pending, walk_next = _pending_walk(
+            gid_cur, gl_cur, gmask_cur, heads.valid, fit_cells
+        )
+        pend = walk_pending & (preempt_k >= 0)  # NoFit heads never pend
+        retrying = (
+            active & (chosen < 0) & ~preempt_ok & ~pre_skipped & pend
+        )
         new_entry_status = jnp.where(
             admitted,
             2,
             jnp.where(
-                active & (chosen < 0) & ~preempt_ok & ~pre_skipped, 1, 0
+                active
+                & (chosen < 0)
+                & ~preempt_ok
+                & ~pre_skipped
+                & ~pend,
+                1,
+                0,
             ),
         )  # per-queue head status
         status = status.at[q_idx, cur].set(
@@ -734,15 +904,24 @@ def solve_drain_preempt(
         )
 
         chosen_safe = jnp.maximum(chosen, 0)
-        chose_last = queues.reset[q_idx, cur, chosen_safe]
+        gi_c = queues.gidx[q_idx, cur, chosen_safe]  # [Q, G]
+        last_c = queues.glast[q_idx, cur, chosen_safe]  # [Q, G]
+        resumed = jnp.where(last_c, 0, gi_c + 1)
         lost = active & (chosen >= 0) & (~admitted)
-        k_start = jnp.where(
-            admitted | (active & (chosen < 0)) | preempt_ok,
+        walk_reset = (
+            admitted | (active & (chosen < 0) & ~retrying) | preempt_ok
+        )
+        g_start = jnp.where(
+            walk_reset[:, None],
             0,
-            jnp.where(lost, jnp.where(chose_last, 0, chosen_safe + 1), k_start),
+            jnp.where(
+                lost[:, None],
+                resumed,
+                jnp.where(retrying[:, None], walk_next, g_start),
+            ),
         ).astype(jnp.int32)
         return (
-            local, status, k_start, adm_k, adm_cycle,
+            local, status, g_start, adm_k, adm_cycle,
             vevicted, evict_cycle, cycle + 1,
         )
 
@@ -751,10 +930,11 @@ def solve_drain_preempt(
         has_pending = jnp.any((status == 0) & (l_idx[None, :] < queues.qlen[:, None]))
         return has_pending & (cycle < max_cycles)
 
+    g = queues.gidx.shape[-1]
     init = (
         local_usage,
         jnp.zeros((q, l), dtype=jnp.int32),
-        jnp.zeros(q, dtype=jnp.int32),
+        jnp.zeros((q, g), dtype=jnp.int32),
         jnp.full((q, l), -1, dtype=jnp.int32),
         jnp.full((q, l), -1, dtype=jnp.int32),
         jnp.zeros((q, v), dtype=bool),

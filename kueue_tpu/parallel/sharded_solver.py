@@ -191,7 +191,7 @@ def pad_queue_arrays(queues_np: dict, multiple: int) -> dict:
         pad_block = np.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)
         if name in ("cq_rows", "seg_id"):
             pad_block -= 1
-        if name == "cells":
+        if name in ("cells", "cgrp"):
             pad_block[:] = -1
         out[name] = np.concatenate([arr, pad_block])
     return out

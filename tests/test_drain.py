@@ -262,16 +262,20 @@ class TestDrainTruncation:
 
 # ---------------------------------------------------------------- preemption
 def _admit_victim(cache, mgr_clock_t, name, cq_name, flavor, cpu, prio, uid_t):
+    """flavor/cpu: either a flavor name + cpu quantity (the single-RG
+    shorthand) or {resource: flavor} + {resource: quantity} dicts."""
     from kueue_tpu.core.workload_info import make_admission
     from kueue_tpu.models import Workload, WorkloadConditionType
     from kueue_tpu.models.workload import PodSet
 
+    requests = cpu if isinstance(cpu, dict) else {"cpu": cpu}
+    flavors = flavor if isinstance(flavor, dict) else {"cpu": flavor}
     wl = Workload(
         namespace="ns", name=name, queue_name=f"lq-{cq_name}", priority=prio,
         creation_time=uid_t,
-        pod_sets=(PodSet.build("main", 1, {"cpu": cpu}),),
+        pod_sets=(PodSet.build("main", 1, requests),),
     )
-    wl.admission = make_admission(cq_name, {"main": {"cpu": flavor}}, wl)
+    wl.admission = make_admission(cq_name, {"main": flavors}, wl)
     wl.set_condition(
         WorkloadConditionType.QUOTA_RESERVED, True, reason="QuotaReserved",
         now=uid_t,
@@ -667,3 +671,205 @@ class TestDrainParityDeepTrees:
         assert not outcome.fallback
         assert dev_admitted == host_admitted
         assert dev_parked == host_parked
+
+
+def multi_rg_spec(seed, n_cohorts=2, cqs_per_cohort=3, workloads_per_cq=6):
+    """Backlogs whose CQs cover TWO resource groups ((cpu,memory) and
+    gpu): candidates are cartesian products of per-group flavor walks,
+    exercising the drain's per-group cursor vectors."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    flavors = ["fa", "fb", "ga", "gb"]
+    cqs, workloads = [], []
+    t = 0.0
+    for ci in range(n_cohorts):
+        for qi in range(cqs_per_cohort):
+            name = f"cq-{ci}-{qi}"
+            kf = int(rng.integers(1, 3))  # 1-2 cpu/mem flavors
+            kg = int(rng.integers(1, 3))  # 1-2 gpu flavors
+            cpu_flavors = [
+                (f, {"cpu": str(int(rng.integers(6, 16))),
+                     "memory": f"{int(rng.integers(8, 32))}Gi"},
+                 str(int(rng.integers(0, 8))) if rng.random() < 0.4 else None,
+                 None)
+                for f in ["fa", "fb"][:kf]
+            ]
+            gpu_flavors = [
+                (f, {"gpu": str(int(rng.integers(2, 8)))},
+                 str(int(rng.integers(0, 4))) if rng.random() < 0.3 else None,
+                 None)
+                for f in ["ga", "gb"][:kg]
+            ]
+            cqs.append({
+                "name": name,
+                "cohort": f"cohort-{ci}",
+                "groups": [
+                    {"resources": ["cpu", "memory"], "flavors": cpu_flavors},
+                    {"resources": ["gpu"], "flavors": gpu_flavors},
+                ],
+                "preemption": None,
+            })
+            for wi in range(workloads_per_cq):
+                t += 1.0
+                requests = {"cpu": str(int(rng.integers(1, 5))),
+                            "memory": f"{int(rng.integers(1, 8))}Gi"}
+                if rng.random() < 0.7:  # most workloads touch both RGs
+                    requests["gpu"] = str(int(rng.integers(1, 3)))
+                workloads.append({
+                    "name": f"wl-{ci}-{qi}-{wi}",
+                    "queue": f"lq-{name}",
+                    "prio": int(rng.integers(0, 4)) * 10,
+                    "t": t,
+                    "pod_sets": [{
+                        "name": "main",
+                        "count": int(rng.integers(1, 3)),
+                        "requests": requests,
+                    }],
+                })
+    return {"flavors": flavors, "cqs": cqs, "workloads": workloads}
+
+
+class TestDrainMultiResourceGroup:
+    """Multi-RG backlogs run ON DEVICE: the per-group cursor vectors
+    must reproduce the sequential scheduler's per-group LastAssignment
+    resume exactly (previously these heads were routed to fallback)."""
+
+    @pytest.mark.parametrize("seed", range(16))
+    def test_randomized_parity(self, seed):
+        spec = multi_rg_spec(seed)
+        host_admitted, host_parked = host_drain_trace(spec)
+        dev_admitted, dev_parked, outcome = device_drain_trace(spec)
+        # multi-RG heads must actually run on the device now
+        assert not outcome.fallback
+        assert dev_admitted == host_admitted
+        assert dev_parked == host_parked
+        assert host_admitted  # non-trivial scenario
+
+    def test_cartesian_cursor_resume_after_conflict(self):
+        # Two CQs in one cohort contend for borrowed gpu quota: the
+        # loser's retry must resume its (cpu x gpu) cartesian walk at
+        # the per-group cursors, not at combo k+1.
+        spec = {
+            "flavors": ["fa", "fb", "ga", "gb"],
+            "cqs": [
+                {
+                    "name": f"cq-{x}",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [
+                            ("fa", {"cpu": "4"}, "4", None),
+                            ("fb", {"cpu": "4"}, "4", None),
+                        ]},
+                        {"resources": ["gpu"], "flavors": [
+                            ("ga", {"gpu": "1"}, "1", None),
+                            ("gb", {"gpu": "2"}, "2", None),
+                        ]},
+                    ],
+                    "preemption": None,
+                }
+                for x in ("a", "b")
+            ],
+            "workloads": [
+                {
+                    "name": f"w-{x}-{i}",
+                    "queue": f"lq-cq-{x}",
+                    "prio": 0,
+                    "t": float(i + (0 if x == "a" else 10)),
+                    "pod_sets": [{
+                        "name": "main", "count": 1,
+                        "requests": {"cpu": "3", "gpu": "2"},
+                    }],
+                }
+                for x in ("a", "b")
+                for i in range(3)
+            ],
+        }
+        host_admitted, host_parked = host_drain_trace(spec)
+        dev_admitted, dev_parked, outcome = device_drain_trace(spec)
+        assert not outcome.fallback
+        assert dev_admitted == host_admitted
+        assert dev_parked == host_parked
+
+
+def multi_rg_preempt_spec(seed, n_cqs=4, victims_per_cq=3, workloads_per_cq=4):
+    """Multi-resource-group scenarios INSIDE the preempt-drain scope
+    (withinClusterQueue=LowerPriority, no cohort): saturated CQs whose
+    victims and pending workloads both span two resource groups, so the
+    device's per-group cursors, reclaim-oracle emulation, and victim
+    search run together."""
+    from kueue_tpu.models.cluster_queue import Preemption
+    from kueue_tpu.models.constants import PreemptionPolicy
+
+    rng = np.random.default_rng(seed)
+    cqs, workloads, victims = [], [], []
+    t = 0.0
+    for qi in range(n_cqs):
+        name = f"cq-{qi}"
+        kf = int(rng.integers(1, 3))
+        kg = int(rng.integers(1, 3))
+        cpu_flavors = [
+            (f, {"cpu": str(int(rng.integers(8, 16)))}, None, None)
+            for f in ["fa", "fb"][:kf]
+        ]
+        gpu_flavors = [
+            (f, {"gpu": str(int(rng.integers(4, 8)))}, None, None)
+            for f in ["ga", "gb"][:kg]
+        ]
+        cqs.append({
+            "name": name,
+            "cohort": None,
+            "groups": [
+                {"resources": ["cpu"], "flavors": cpu_flavors},
+                {"resources": ["gpu"], "flavors": gpu_flavors},
+            ],
+            "preemption": Preemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+            ),
+        })
+        for vi in range(victims_per_cq):
+            t += 1.0
+            requests = {"cpu": str(int(rng.integers(2, 6)))}
+            flavors = {"cpu": rng.choice(["fa", "fb"][:kf])}
+            if rng.random() < 0.8:
+                requests["gpu"] = str(int(rng.integers(1, 3)))
+                flavors["gpu"] = rng.choice(["ga", "gb"][:kg])
+            victims.append((f"v-{qi}-{vi}", name, flavors, requests, 0, t))
+        for wi in range(workloads_per_cq):
+            t += 1.0
+            requests = {"cpu": str(int(rng.integers(2, 6)))}
+            if rng.random() < 0.8:
+                requests["gpu"] = str(int(rng.integers(1, 3)))
+            workloads.append({
+                "name": f"wl-{qi}-{wi}",
+                "queue": f"lq-{name}",
+                "prio": int(rng.integers(1, 4)) * 10,
+                "t": t,
+                "pod_sets": [{
+                    "name": "main", "count": 1, "requests": requests,
+                }],
+            })
+    return {
+        "flavors": ["fa", "fb", "ga", "gb"],
+        "cqs": cqs,
+        "workloads": workloads,
+        "victims": victims,
+    }
+
+
+class TestPreemptDrainMultiResourceGroup:
+    """Multi-RG preemption drains on device: per-group cursor vectors +
+    reclaim-oracle emulation + in-kernel victim search must match the
+    sequential host scheduler with evictions applied at cycle
+    boundaries."""
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_randomized_parity(self, seed):
+        spec = multi_rg_preempt_spec(seed)
+        ha, he, hp = host_preempt_drain_trace(spec)
+        da, de, dp, outcome = device_preempt_drain_trace(spec)
+        assert not outcome.fallback
+        assert da == ha
+        assert de == he
+        assert dp == hp
+        assert ha and he  # scenario admits and evicts
