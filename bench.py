@@ -55,7 +55,7 @@ def build_cluster(rng):
     cache = Cache()
     mgr = QueueManager(clock)
     flavors = [f"fl-{i}" for i in range(N_FLAVORS)]
-    for f in flavors:
+    for f in flavors + ["gpu-fl"]:
         cache.add_or_update_flavor(ResourceFlavor(name=f))
     for i in range(N_CQ):
         name = f"cq-{i}"
@@ -77,11 +77,24 @@ def build_cluster(rng):
             )
             for f in flavors
         )
+        # second resource group (single accelerator flavor): ~a third
+        # of the backlog requests gpus, so the drain's per-group cursor
+        # vectors and cartesian candidates run at full 50k scale
+        gpu_quota = (
+            FlavorQuotas.build(
+                "gpu-fl",
+                {"gpu": (str(int(rng.integers(4, 16))),
+                         str(int(rng.integers(2, 8))), None)},
+            ),
+        )
         cq = ClusterQueue(
             name=name,
             cohort=f"cohort-{i % N_COHORT}",
             namespace_selector={},
-            resource_groups=(ResourceGroup(("cpu", "memory"), quotas),),
+            resource_groups=(
+                ResourceGroup(("cpu", "memory"), quotas),
+                ResourceGroup(("gpu",), gpu_quota),
+            ),
         )
         cache.add_or_update_cluster_queue(cq)
         mgr.add_cluster_queue(cq)
@@ -100,9 +113,14 @@ def build_backlog(rng):
     prios = rng.integers(0, 4, size=n) * 50
     cpus = rng.integers(1, 16, size=n)
     mems = rng.integers(1, 32, size=n)
+    gpus = rng.integers(1, 3, size=n)
+    wants_gpu = rng.random(size=n) < 0.33
     counts = rng.integers(1, 5, size=n)
     for i in range(n):
         cq = f"cq-{i % N_CQ}"
+        requests = {"cpu": str(cpus[i]), "memory": f"{mems[i]}Gi"}
+        if wants_gpu[i]:
+            requests["gpu"] = str(gpus[i])  # second resource group
         wl = Workload(
             namespace="ns",
             name=f"w{i}",
@@ -110,11 +128,7 @@ def build_backlog(rng):
             priority=int(prios[i]),
             creation_time=float(i),
             pod_sets=(
-                PodSet.build(
-                    "main",
-                    int(counts[i]),
-                    {"cpu": str(cpus[i]), "memory": f"{mems[i]}Gi"},
-                ),
+                PodSet.build("main", int(counts[i]), requests),
             ),
         )
         pending.append((wl, cq))
@@ -412,14 +426,14 @@ def main():
 
     # one full warmup at identical shapes (jit compile; the cache keys
     # are static shapes, so the measured run reuses the executable)
-    run_drain(snapshot, pending, cache.flavors, max_cells=2)
+    run_drain(snapshot, pending, cache.flavors, max_cells=3)
 
     reps = 3
     times = []
     for _ in range(reps):
         snapshot = take_snapshot(cache)
         t0 = time.perf_counter()
-        outcome = run_drain(snapshot, pending, cache.flavors, max_cells=2)
+        outcome = run_drain(snapshot, pending, cache.flavors, max_cells=3)
         times.append(time.perf_counter() - t0)
     total_s = float(np.median(times))
 
@@ -438,7 +452,7 @@ def main():
             {
                 "metric": (
                     f"full_drain_cycle_latency ({n_total // 1000}k pending x "
-                    f"{N_CQ} CQs, {N_COHORT} cohorts, K={N_FLAVORS}, "
+                    f"{N_CQ} CQs, {N_COHORT} cohorts, K={N_FLAVORS}, 2 RGs, "
                     f"{outcome.cycles} cycles, {n_admitted} admitted, "
                     "lowering included)"
                 ),
