@@ -67,7 +67,8 @@ def plan_drain(
     from kueue_tpu.ops.assign_kernel import build_roots
 
     lowered = lower_heads(
-        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn,
+        any_fungibility=True,
     )
     fallback = set(lowered.fallback)
 
@@ -100,6 +101,8 @@ def plan_drain(
     gidx = np.zeros((q, l, k, g), dtype=np.int32)
     glast = np.zeros((q, l, k, g), dtype=bool)
     cgrp = np.full(cells.shape, -1, dtype=np.int8)
+    ffb = np.ones(q, dtype=bool)
+    ffp = np.zeros(q, dtype=bool)
     priority = np.zeros((q, l), dtype=np.int64)
     timestamp = np.zeros((q, l), dtype=np.int64)
     no_reclaim = np.zeros(q, dtype=bool)
@@ -111,6 +114,8 @@ def plan_drain(
         cq_rows[qi] = snapshot.row(cq_name)
         qlen[qi] = len(idxs)
         no_reclaim[qi] = bool(lowered.no_reclaim[idxs[0]])
+        ffb[qi] = bool(lowered.ffb[idxs[0]])
+        ffp[qi] = bool(lowered.ffp[idxs[0]])
         n = len(idxs)
         idx_arr = np.asarray(idxs, dtype=np.int64)
         cells[qi, :n] = lowered.cells[idx_arr]
@@ -176,6 +181,8 @@ def plan_drain(
             gidx=gidx,
             glast=glast,
             cgrp=cgrp,
+            ffb=ffb,
+            ffp=ffp,
             priority=priority,
             timestamp=timestamp,
             no_reclaim=no_reclaim,

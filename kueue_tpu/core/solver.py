@@ -48,6 +48,10 @@ class Lowered:
     no_reclaim: np.ndarray  # bool[W] — reserve capacity when blocked
     # int8[W,K,C]: resource-group index of each candidate cell (-1 pad)
     cgrp: np.ndarray = None
+    # bool[W]: the head CQ's fungibility bits (whenCanBorrow == Borrow /
+    # whenCanPreempt == Preempt) — consumed by the drain's group walk
+    ffb: np.ndarray = None
+    ffp: np.ndarray = None
     # per head: candidate k -> flavor name chosen per resource group
     candidate_flavors: List[List[Dict[str, str]]] = field(default_factory=list)
     # per head: candidate k -> resource -> host-equivalent tried-flavor
@@ -270,9 +274,16 @@ def lower_heads(
     max_cells: int = 16,
     timestamp_fn=None,
     transform=None,  # ResourceTransformConfig for the quota view
+    any_fungibility=False,  # drain path: policy bits instead of fallback
 ) -> Lowered:
     """Build the dense head batch; route inexpressible heads to
     ``fallback`` (handled by the host FlavorAssigner).
+
+    ``any_fungibility=True`` lowers heads of CQs with non-default
+    flavorFungibility too, recording the policy bits (ffb/ffp) for the
+    drain kernels' policy-aware group walk; the interactive cycle path
+    keeps the default-only scope (its phase-1 assumes the default
+    stop-at-first-fit walk).
 
     Candidate enumeration is memoized per (CQ, podset shape, cursor):
     a bulk backlog over 1k CQs lowers in O(templates + heads), not
@@ -285,6 +296,8 @@ def lower_heads(
         qty=np.zeros((w, k, c), dtype=np.int64),
         valid=np.zeros((w, k), dtype=bool),
         cgrp=np.full((w, k, c), -1, dtype=np.int8),
+        ffb=np.ones(w, dtype=bool),
+        ffp=np.zeros(w, dtype=bool),
         priority=np.zeros(w, dtype=np.int64),
         timestamp=np.zeros(w, dtype=np.int64),
         no_reclaim=np.zeros(w, dtype=bool),
@@ -304,9 +317,14 @@ def lower_heads(
             out.fallback.append(i)
             continue
         cq = snapshot.cq_models[cq_name]
-        if len(wl.pod_sets) != 1 or not _default_fungibility(cq):
+        if len(wl.pod_sets) != 1 or (
+            not any_fungibility and not _default_fungibility(cq)
+        ):
             out.fallback.append(i)
             continue
+        ff = cq.flavor_fungibility
+        out.ffb[i] = ff.when_can_borrow == FlavorFungibilityPolicy.BORROW
+        out.ffp[i] = ff.when_can_preempt == FlavorFungibilityPolicy.PREEMPT
         ps = wl.pod_sets[0]
         if ps.topology_request is not None:
             out.fallback.append(i)  # TAS placement stays on the host path

@@ -223,7 +223,12 @@ def phase1_classify(
         reclaim_cells = jnp.where(
             cell_need, local_wkc + heads.qty <= nominal_wkc, True
         )
-        return chosen, borrows, preempt_k, fit_cells, pot_cells, reclaim_cells
+        borrow_cells = (
+            jnp.where(cell_need, local_wkc + heads.qty > subtree_wkc, False)
+            & has_cohort[..., None]
+        )
+        return (chosen, borrows, preempt_k, fit_cells, pot_cells,
+                reclaim_cells, borrow_cells)
     return chosen, borrows, preempt_k
 
 

@@ -73,8 +73,11 @@ class DrainQueues(NamedTuple):
               per-group start — the same set a host-side template
               rebuilt from the stored cursors would enumerate.
     cgrp:     int8[Q,L,K,C] — resource-group index of each candidate
-              cell (-1 pad), for the per-group first-fit walk of the
-              PendingFlavors emulation.
+              cell (-1 pad), for the per-group walks.
+    ffb/ffp:  bool[Q] — the ClusterQueue's flavorFungibility policy
+              bits: whenCanBorrow == Borrow / whenCanPreempt == Preempt
+              (clusterqueue_types.go:379-401), consumed by the
+              policy-aware group walk.
     priority: int64[Q,L] / timestamp: int64[Q,L] — entry order keys,
               already sorted within each queue (priority desc, ts asc —
               the pending-heap order, cluster_queue.go:413-426).
@@ -90,6 +93,8 @@ class DrainQueues(NamedTuple):
     gidx: jnp.ndarray
     glast: jnp.ndarray
     cgrp: jnp.ndarray
+    ffb: jnp.ndarray
+    ffp: jnp.ndarray
     priority: jnp.ndarray
     timestamp: jnp.ndarray
     no_reclaim: jnp.ndarray
@@ -111,9 +116,9 @@ class DrainResult(NamedTuple):
 
 
 def _group_cursor_inputs(queues, q_idx, cur):
-    """Per-cycle gathers shared by _pending_walk and
-    _preempt_representative: current entries' per-group flavor indexes,
-    chose-last flags, and the cell->group one-hot mask."""
+    """Per-cycle gathers for the policy-aware group walk: current
+    entries' per-group flavor indexes, chose-last flags, and the
+    cell->group one-hot mask."""
     gid = queues.gidx[q_idx, cur]  # [Q,K,G]
     gl = queues.glast[q_idx, cur]  # [Q,K,G]
     cg = queues.cgrp[q_idx, cur]  # [Q,K,C]
@@ -122,49 +127,35 @@ def _group_cursor_inputs(queues, q_idx, cur):
     return gid, gl, gmask
 
 
-def _pending_walk(gid, gl, gmask, head_valid, fit_cells):
-    """Host PendingFlavors emulation (cluster_queue.go:231 + the
-    fungibility cursor, flavor_assigner._find_flavor_for_resource).
-
-    A PREEMPT-mode nomination (every group produced choices) stores the
-    representative's cursor: groups that stopped at a FIT flavor store
-    that index (-1 when it is the group's last), preempt/reclaim groups
-    ran their walk to the end and store -1. The head requeues
-    IMMEDIATELY (stays at the queue front) iff any group's stored
-    cursor is pending, retrying next cycle from the advanced starts.
-    A NO_FIT nomination (some group produced no choices) CLEARS the
-    whole cursor (flavor_assigner.assign wipes psr.flavors on group
-    failure), so NoFit heads always park. Returns
-    (pending bool[Q], next_start int32[Q,G]) — callers gate on
-    preempt-mode."""
-    gfit = jnp.all(
-        jnp.where(gmask, fit_cells[..., None], True), axis=2
-    )  # [Q,K,G]
-    cand_ok = head_valid[:, :, None] & gfit
-    inf = jnp.int32(2**30)
-    fidx = jnp.min(jnp.where(cand_ok, gid, inf), axis=1)  # [Q,G]
-    found = fidx < inf
-    is_last = jnp.any((gid == fidx[:, None, :]) & gl & cand_ok, axis=1)
-    stored = jnp.where(found & ~is_last, fidx, -1)
-    pending = jnp.any(stored >= 0, axis=1)
-    return pending, (stored + 1).astype(jnp.int32)
-
-
-def _preempt_representative(
-    gid, gmask, head_valid, fit_cells, pot_cells, reclaim_cells
+def _group_walk(
+    gid, gl, gmask, head_valid, fit_cells, pot_cells, reclaim_cells,
+    borrow_cells, ffb, ffp,
 ):
-    """Host-equivalent preempt-mode representative.
+    """Policy-aware emulation of the host's per-group flavor walk
+    (flavor_assigner._find_flavor_for_resource + _should_try_next_flavor
+    + the reclaim-oracle upgrade), vectorized over queues.
 
-    The host's per-group flavor walk stops at the first FIT flavor;
-    otherwise it traverses the whole group preferring the best granular
-    mode seen (RECLAIM > PREEMPT, earliest wins —
-    flavor_assigner._find_flavor_for_resource + the reclaim oracle
-    upgrade). The representative assignment combines each group's best
-    choice, so the device must pick THAT candidate combo — not simply
-    the first preempt-eligible combo — or its capacity reservations and
-    borrow-ordering diverge from the host. Returns
-    (pre_k int32[Q], has_pre bool[Q])."""
-    # cell granular mode: FIT=3 > RECLAIM=2 > PREEMPT=1 > NOFIT=0
+    Each resource group walks its flavors (ascending index, restricted
+    by the per-group cursor already folded into ``head_valid``):
+
+    - a flavor STOPS the walk when it fits and is non-borrowing, when
+      it fits and whenCanBorrow=Borrow (``ffb``), or — under
+      whenCanPreempt=Preempt (``ffp``) — when it is preempt/reclaim
+      eligible (subject to the same borrow condition);
+    - otherwise the walk runs to the group's end and the best granular
+      mode seen wins (FIT > RECLAIM > PREEMPT, earliest flavor of it);
+    - the stored cursor is the stop index (-1 when the stop was the
+      group's last flavor or the walk ran to the end), and the podset's
+      LastAssignment is pending iff any group stored a real index.
+
+    Returns (chosen int32[Q], pre_k int32[Q], pending bool[Q],
+    next_start int32[Q,G]): the representative candidate for FIT heads,
+    for preempt-mode heads, the PendingFlavors flag, and the per-group
+    resume starts used by conflict-loss and pending retries alike."""
+    g = gid.shape[-1]
+    inf = jnp.int32(2**30)
+    valid3 = head_valid[:, :, None]  # [Q,K,1]
+    # per-candidate per-group aggregates
     cellmode = jnp.where(
         fit_cells,
         3,
@@ -173,31 +164,39 @@ def _preempt_representative(
     gmode = jnp.min(
         jnp.where(gmask, cellmode[..., None], 3), axis=2
     )  # [Q,K,G]
-    inf = jnp.int32(2**30)
-    valid3 = head_valid[:, :, None]  # [Q,K,1]
-    # first FIT flavor per group (the walk stops there)
-    fit_idx = jnp.min(
-        jnp.where(valid3 & (gmode == 3), gid, inf), axis=1
-    )  # [Q,G]
-    # otherwise: best mode seen across the walk, earliest flavor of it
-    best_mode = jnp.max(
-        jnp.where(valid3, gmode, -1), axis=1
-    )  # [Q,G]
+    gborrow = jnp.any(
+        jnp.where(gmask, borrow_cells[..., None], False), axis=2
+    )  # [Q,K,G]
+    borrow_ok = ~gborrow | ffb[:, None, None]
+    stop = valid3 & (
+        ((gmode == 3) & borrow_ok)
+        | ((gmode == 1) | (gmode == 2)) & ffp[:, None, None] & borrow_ok
+    )
+    stop_idx = jnp.min(jnp.where(stop, gid, inf), axis=1)  # [Q,G]
+    stopped = stop_idx < inf
+    best_mode = jnp.max(jnp.where(valid3, gmode, -1), axis=1)  # [Q,G]
     best_idx = jnp.min(
         jnp.where(valid3 & (gmode == best_mode[:, None, :]), gid, inf), axis=1
     )
-    want_idx = jnp.where(fit_idx < inf, fit_idx, best_idx)  # [Q,G]
-    has_pre = jnp.all(
-        jnp.where(fit_idx < inf, 3, best_mode) >= 1, axis=1
-    ) & jnp.all(want_idx < inf, axis=1)
-    # the candidate whose per-group flavors equal the per-group bests
-    match = head_valid & jnp.all(gid == want_idx[:, None, :], axis=-1)  # [Q,K]
+    choice_idx = jnp.where(stopped, stop_idx, best_idx)  # [Q,G]
+    at_choice = valid3 & (gid == choice_idx[:, None, :])
+    choice_mode = jnp.max(jnp.where(at_choice, gmode, -1), axis=1)  # [Q,G]
+    have = (choice_idx < inf) & (choice_mode >= 1)
+    head_mode = jnp.min(jnp.where(have, choice_mode, 0), axis=1)  # [Q]
+    match = head_valid & jnp.all(gid == choice_idx[:, None, :], axis=-1)
+    has_rep = jnp.any(match, axis=1)
+    k_rep = jnp.argmax(match, axis=1).astype(jnp.int32)
+    chosen = jnp.where((head_mode == 3) & has_rep, k_rep, -1)
     pre_k = jnp.where(
-        jnp.any(match, axis=1) & has_pre,
-        jnp.argmax(match, axis=1),
-        -1,
-    ).astype(jnp.int32)
-    return pre_k, (pre_k >= 0)
+        ((head_mode == 1) | (head_mode == 2)) & has_rep, k_rep, -1
+    )
+    # stored cursor: the stop index unless it was the group's last
+    # flavor; best-mode (non-stop) walks ran to the end and store -1
+    is_last = jnp.any(at_choice & gl, axis=1)
+    tried = jnp.where(stopped & ~is_last, choice_idx, -1)
+    pending = jnp.any(tried >= 0, axis=1)
+    next_start = (tried + 1).astype(jnp.int32)
+    return chosen, pre_k, pending, next_start
 
 
 def solve_drain(
@@ -243,16 +242,15 @@ def solve_drain(
             no_reclaim=queues.no_reclaim,
         )
 
-        (chosen, borrows_wk, _first_pre, fit_cells, pot_cells,
-         reclaim_cells) = phase1_classify(
+        (_p1_chosen, borrows_wk, _p1_pre, fit_cells, pot_cells,
+         reclaim_cells, borrow_cells) = phase1_classify(
             tree, subtree, guaranteed, local, heads, return_cell_fit=True
         )
         gid_cur, gl_cur, gmask_cur = _group_cursor_inputs(queues, q_idx, cur)
-        pre_rep, _ = _preempt_representative(
-            gid_cur, gmask_cur, heads.valid, fit_cells, pot_cells,
-            reclaim_cells,
+        chosen, preempt_k, walk_pending, walk_next = _group_walk(
+            gid_cur, gl_cur, gmask_cur, heads.valid, fit_cells, pot_cells,
+            reclaim_cells, borrow_cells, queues.ffb, queues.ffp,
         )
-        preempt_k = jnp.where(chosen < 0, pre_rep, -1)
         eff_k = jnp.where(chosen >= 0, chosen, preempt_k)
         eff_safe = jnp.maximum(eff_k, 0)
         head_borrow = jnp.take_along_axis(
@@ -365,9 +363,6 @@ def solve_drain(
         # advanced per-group starts (PendingFlavors; multi-group heads
         # can be NoFit overall while one group found a non-final fit);
         # in-cycle conflict losers stay, resuming past the chosen combo
-        walk_pending, walk_next = _pending_walk(
-            gid_cur, gl_cur, gmask_cur, heads.valid, fit_cells
-        )
         pend = walk_pending & (preempt_k >= 0)  # NoFit heads never pend
         retrying = active & (chosen < 0) & pend
         advance = active & (admitted | ((chosen < 0) & ~pend))
@@ -380,19 +375,14 @@ def solve_drain(
         # cursor semantics of the host walk, per group: choosing the
         # group's LAST flavor stores -1 (restart that group at 0);
         # otherwise resume past the chosen flavor
-        chosen_safe = jnp.maximum(chosen, 0)
-        gi_c = queues.gidx[q_idx, cur, chosen_safe]  # [Q, G]
-        last_c = queues.glast[q_idx, cur, chosen_safe]  # [Q, G]
-        resumed = jnp.where(last_c, 0, gi_c + 1)
+        # both conflict losers and pending retries resume from the
+        # walk's stored per-group cursors (LastAssignment semantics —
+        # a policy that stopped a group mid-walk stores that index)
         lost = active & (chosen >= 0) & (~admitted)
         g_start = jnp.where(
             advance[:, None],
             0,
-            jnp.where(
-                lost[:, None],
-                resumed,
-                jnp.where(retrying[:, None], walk_next, g_start),
-            ),
+            jnp.where((lost | retrying)[:, None], walk_next, g_start),
         ).astype(jnp.int32)
         cursor = cursor + advance.astype(jnp.int32)
         return local, cursor, g_start, adm_k, adm_cycle, cycle + 1
@@ -637,8 +627,8 @@ def solve_drain_preempt(
             no_reclaim=queues.no_reclaim,
         )
 
-        (chosen, borrows_wk, _first_pre, fit_cells, pot_cells,
-         reclaim_leaf) = phase1_classify(
+        (_p1_chosen, borrows_wk, _p1_pre, fit_cells, pot_cells,
+         reclaim_leaf, borrow_cells) = phase1_classify(
             tree, subtree, guaranteed, local, heads, return_cell_fit=True
         )
         # Victim-eligibility predicate (preemption.go:480-524 priority
@@ -668,11 +658,10 @@ def solve_drain_preempt(
         )  # [Q,K,C]
         reclaim_cells = reclaim_leaf & ~victim_on_cell
         gid_cur, gl_cur, gmask_cur = _group_cursor_inputs(queues, q_idx, cur)
-        pre_rep, _ = _preempt_representative(
-            gid_cur, gmask_cur, heads.valid, fit_cells, pot_cells,
-            reclaim_cells,
+        chosen, preempt_k, walk_pending, walk_next = _group_walk(
+            gid_cur, gl_cur, gmask_cur, heads.valid, fit_cells, pot_cells,
+            reclaim_cells, borrow_cells, queues.ffb, queues.ffp,
         )
-        preempt_k = jnp.where(chosen < 0, pre_rep, -1)
         eff_k = jnp.where(chosen >= 0, chosen, preempt_k)
         eff_safe = jnp.maximum(eff_k, 0)
         head_borrow = jnp.take_along_axis(
@@ -867,9 +856,6 @@ def solve_drain_preempt(
         # fits() re-check — requeue immediately (FAILED_AFTER_NOMINATION,
         # scheduler._requeue_and_update) and stay pending.
         pre_skipped = psuccess & ~preempt_ok
-        walk_pending, walk_next = _pending_walk(
-            gid_cur, gl_cur, gmask_cur, heads.valid, fit_cells
-        )
         pend = walk_pending & (preempt_k >= 0)  # NoFit heads never pend
         retrying = (
             active & (chosen < 0) & ~preempt_ok & ~pre_skipped & pend
@@ -903,10 +889,6 @@ def solve_drain_preempt(
             seg_released[:, None] & (status == 1), 0, status
         )
 
-        chosen_safe = jnp.maximum(chosen, 0)
-        gi_c = queues.gidx[q_idx, cur, chosen_safe]  # [Q, G]
-        last_c = queues.glast[q_idx, cur, chosen_safe]  # [Q, G]
-        resumed = jnp.where(last_c, 0, gi_c + 1)
         lost = active & (chosen >= 0) & (~admitted)
         walk_reset = (
             admitted | (active & (chosen < 0) & ~retrying) | preempt_ok
@@ -914,11 +896,7 @@ def solve_drain_preempt(
         g_start = jnp.where(
             walk_reset[:, None],
             0,
-            jnp.where(
-                lost[:, None],
-                resumed,
-                jnp.where(retrying[:, None], walk_next, g_start),
-            ),
+            jnp.where((lost | retrying)[:, None], walk_next, g_start),
         ).astype(jnp.int32)
         return (
             local, status, g_start, adm_k, adm_cycle,

@@ -873,3 +873,148 @@ class TestPreemptDrainMultiResourceGroup:
         assert de == he
         assert dp == hp
         assert ha and he  # scenario admits and evicts
+
+
+def fungibility_spec(seed, n_cohorts=2, cqs_per_cohort=3, workloads_per_cq=7):
+    """Backlogs over CQs with randomized flavorFungibility policies
+    (whenCanBorrow Borrow|TryNextFlavor x whenCanPreempt
+    TryNextFlavor|Preempt): the drain's policy-aware group walk must
+    stop/continue exactly like the host's _should_try_next_flavor."""
+    from kueue_tpu.models import FlavorFungibility
+    from kueue_tpu.models.constants import FlavorFungibilityPolicy as FFP
+
+    rng = np.random.default_rng(seed)
+    flavors = [f"fl-{i}" for i in range(3)]
+    cqs, workloads = [], []
+    t = 0.0
+    for ci in range(n_cohorts):
+        for qi in range(cqs_per_cohort):
+            name = f"cq-{ci}-{qi}"
+            k = int(rng.integers(2, 4))
+            fls = [
+                (f, {"cpu": str(int(rng.integers(4, 14)))},
+                 str(int(rng.integers(0, 10))) if rng.random() < 0.5 else None,
+                 None)
+                for f in flavors[:k]
+            ]
+            # index, not rng.choice: numpy truncates str-enum members
+            # to fixed-width unicode scalars that equal neither member
+            fung = FlavorFungibility(
+                when_can_borrow=[FFP.BORROW, FFP.TRY_NEXT_FLAVOR][
+                    int(rng.integers(0, 2))
+                ],
+                when_can_preempt=[FFP.TRY_NEXT_FLAVOR, FFP.PREEMPT][
+                    int(rng.integers(0, 2))
+                ],
+            )
+            cqs.append({
+                "name": name,
+                "cohort": f"cohort-{ci}",
+                "groups": [{"resources": ["cpu"], "flavors": fls}],
+                "preemption": None,
+                "fungibility": fung,
+            })
+            for wi in range(workloads_per_cq):
+                t += 1.0
+                workloads.append({
+                    "name": f"wl-{ci}-{qi}-{wi}",
+                    "queue": f"lq-{name}",
+                    "prio": int(rng.integers(0, 4)) * 10,
+                    "t": t,
+                    "pod_sets": [{
+                        "name": "main",
+                        "count": int(rng.integers(1, 4)),
+                        "requests": {"cpu": str(int(rng.integers(1, 6)))},
+                    }],
+                })
+    return {"flavors": flavors, "cqs": cqs, "workloads": workloads}
+
+
+class TestDrainFungibilityPolicies:
+    """Non-default flavorFungibility on device (previously host-only):
+    TryNextFlavor borrowing (prefer a later non-borrowing flavor, fall
+    back to the first borrowing fit) and Preempt stopping."""
+
+    @pytest.mark.parametrize("seed", range(16))
+    def test_randomized_parity(self, seed):
+        spec = fungibility_spec(seed)
+        host_admitted, host_parked = host_drain_trace(spec)
+        dev_admitted, dev_parked, outcome = device_drain_trace(spec)
+        assert not outcome.fallback
+        assert dev_admitted == host_admitted
+        assert dev_parked == host_parked
+        assert host_admitted
+
+    def test_try_next_flavor_prefers_non_borrowing(self):
+        # first flavor only fits by borrowing; whenCanBorrow=
+        # TryNextFlavor must walk on and take the non-borrowing second
+        from kueue_tpu.models import FlavorFungibility
+        from kueue_tpu.models.constants import FlavorFungibilityPolicy as FFP
+
+        spec = {
+            "flavors": ["small", "big"],
+            "cqs": [
+                {
+                    "name": "cq-a",
+                    "cohort": "co",
+                    "groups": [{"resources": ["cpu"], "flavors": [
+                        ("small", {"cpu": "2"}, "10", None),
+                        ("big", {"cpu": "10"}, None, None),
+                    ]}],
+                    "preemption": None,
+                    "fungibility": FlavorFungibility(
+                        when_can_borrow=FFP.TRY_NEXT_FLAVOR,
+                        when_can_preempt=FFP.TRY_NEXT_FLAVOR,
+                    ),
+                },
+                {
+                    "name": "cq-b",
+                    "cohort": "co",
+                    "groups": [{"resources": ["cpu"], "flavors": [
+                        ("small", {"cpu": "10"}, None, None),
+                    ]}],
+                    "preemption": None,
+                },
+            ],
+            "workloads": [
+                {
+                    "name": "w0", "queue": "lq-cq-a", "prio": 0, "t": 0.0,
+                    "pod_sets": [{"name": "main", "count": 1,
+                                  "requests": {"cpu": "4"}}],
+                }
+            ],
+        }
+        host_admitted, _ = host_drain_trace(spec)
+        dev_admitted, _, outcome = device_drain_trace(spec)
+        assert not outcome.fallback
+        assert dev_admitted == host_admitted
+        assert dev_admitted["w0"][0] == {"cpu": "big"}
+
+
+class TestPreemptDrainFungibility:
+    """Non-default fungibility through solve_drain_preempt: the policy
+    bits must reach the preempt kernel's group walk alongside the
+    victim-aware reclaim upgrade."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_randomized_parity(self, seed):
+        from kueue_tpu.models import FlavorFungibility
+        from kueue_tpu.models.constants import FlavorFungibilityPolicy as FFP
+
+        rng = np.random.default_rng(1000 + seed)
+        spec = multi_rg_preempt_spec(seed)
+        for cq_spec in spec["cqs"]:
+            cq_spec["fungibility"] = FlavorFungibility(
+                when_can_borrow=[FFP.BORROW, FFP.TRY_NEXT_FLAVOR][
+                    int(rng.integers(0, 2))
+                ],
+                when_can_preempt=[FFP.TRY_NEXT_FLAVOR, FFP.PREEMPT][
+                    int(rng.integers(0, 2))
+                ],
+            )
+        ha, he, hp = host_preempt_drain_trace(spec)
+        da, de, dp, outcome = device_preempt_drain_trace(spec)
+        assert not outcome.fallback
+        assert da == ha
+        assert de == he
+        assert dp == hp

@@ -66,12 +66,16 @@ def build_env(spec, use_solver):
                     ),
                 )
             )
+        cq_kwargs = {}
+        if cq_spec.get("fungibility") is not None:
+            cq_kwargs["flavor_fungibility"] = cq_spec["fungibility"]
         cq = ClusterQueue(
             name=cq_spec["name"],
             cohort=cq_spec.get("cohort"),
             namespace_selector={},
             resource_groups=tuple(groups),
             preemption=cq_spec.get("preemption") or Preemption(),
+            **cq_kwargs,
         )
         cache.add_or_update_cluster_queue(cq)
         mgr.add_cluster_queue(cq)
