@@ -121,15 +121,18 @@ def build_backlog(rng):
         requests = {"cpu": str(cpus[i]), "memory": f"{mems[i]}Gi"}
         if wants_gpu[i]:
             requests["gpu"] = str(gpus[i])  # second resource group
+        # single-podset backlog: at this contention level a multi-podset
+        # mix makes thousands of heads PendingFlavors spinners (the
+        # reference's immediate-requeue semantics never decide them), so
+        # the headline drain stays fully decidable; multi-podset drains
+        # are covered by tests/test_drain.py TestDrainMultiPodset
         wl = Workload(
             namespace="ns",
             name=f"w{i}",
             queue_name=f"lq-{cq}",
             priority=int(prios[i]),
             creation_time=float(i),
-            pod_sets=(
-                PodSet.build("main", int(counts[i]), requests),
-            ),
+            pod_sets=(PodSet.build("main", int(counts[i]), requests),),
         )
         pending.append((wl, cq))
     # per-CQ heap order: priority desc, timestamp asc

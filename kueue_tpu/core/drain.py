@@ -21,7 +21,12 @@ import numpy as np
 
 from kueue_tpu.models import ResourceFlavor, Workload
 from kueue_tpu.core.snapshot import Snapshot
-from kueue_tpu.core.solver import Lowered, _bucket, lower_heads, tree_arrays
+from kueue_tpu.core.solver import (
+    MultiLowered,
+    _bucket,
+    lower_heads_multi,
+    tree_arrays,
+)
 
 
 @dataclass
@@ -29,7 +34,7 @@ class DrainPlan:
     queues_np: dict  # field name -> numpy array (DrainQueues layout)
     # (q, pos) -> index into lowered.heads
     head_of: Dict[Tuple[int, int], int]
-    lowered: Lowered
+    lowered: MultiLowered
     cq_order: List[str]  # queue index -> cq name
     n_segments: int
     n_steps: int
@@ -50,6 +55,24 @@ class DrainOutcome:
     truncated: bool = False
 
 
+def _admitted_flavors(lowered, i: int, adm_k_row) -> Dict[str, str]:
+    """resource -> flavor map of an admitted head.
+
+    Single-podset heads keep the flat {resource: flavor} shape; a
+    multi-podset head returns {podset name: {resource: flavor}} (the
+    per-PodSetAssignment flavors of the reference Admission)."""
+    npods = int(lowered.n_podsets[i])
+    wl = lowered.heads[i]
+    if npods <= 1:
+        return dict(lowered.candidate_flavors[i][0][int(adm_k_row[0])])
+    return {
+        wl.pod_sets[pp].name: dict(
+            lowered.candidate_flavors[i][pp][int(adm_k_row[pp])]
+        )
+        for pp in range(npods)
+    }
+
+
 def plan_drain(
     snapshot: Snapshot,
     pending: Sequence[Tuple[Workload, str]],
@@ -57,6 +80,7 @@ def plan_drain(
     max_candidates: int = 8,
     max_cells: int = 4,
     timestamp_fn=None,
+    max_podsets: int = 4,
 ) -> DrainPlan:
     """Lower the backlog and pack it into per-CQ queue tensors.
 
@@ -66,9 +90,9 @@ def plan_drain(
     """
     from kueue_tpu.ops.assign_kernel import build_roots
 
-    lowered = lower_heads(
-        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn,
-        any_fungibility=True,
+    lowered = lower_heads_multi(
+        snapshot, pending, flavors, max_candidates, max_cells, max_podsets,
+        timestamp_fn, any_fungibility=True,
     )
     fallback = set(lowered.fallback)
 
@@ -82,12 +106,23 @@ def plan_drain(
     q = max(len(cq_order), 1)
     l = max((len(v) for v in by_cq.values()), default=1)
     k, c = max_candidates, max_cells
+    # P = widest podset vector among representable heads (padded
+    # podsets are inert in the kernel: no cells, mode FIT)
+    pdim = max(
+        [1]
+        + [
+            int(lowered.n_podsets[i])
+            for i in range(len(lowered.heads))
+            if i not in fallback
+        ]
+    )
 
     cq_rows = np.full(q, -1, dtype=np.int32)
     qlen = np.zeros(q, dtype=np.int32)
-    cells = np.full((q, l, k, c), -1, dtype=np.int32)
-    qty = np.zeros((q, l, k, c), dtype=np.int64)
-    valid = np.zeros((q, l, k), dtype=bool)
+    n_podsets = np.ones((q, l), dtype=np.int32)
+    cells = np.full((q, l, pdim, k, c), -1, dtype=np.int32)
+    qty = np.zeros((q, l, pdim, k, c), dtype=np.int64)
+    valid = np.zeros((q, l, pdim, k), dtype=bool)
     # per-group candidate cursor inputs (drain_kernel.DrainQueues):
     # G = widest resource-group vector among representable heads
     g = max(
@@ -98,11 +133,14 @@ def plan_drain(
             if i not in fallback
         ]
     )
-    gidx = np.zeros((q, l, k, g), dtype=np.int32)
-    glast = np.zeros((q, l, k, g), dtype=bool)
+    gidx = np.zeros((q, l, pdim, k, g), dtype=np.int32)
+    glast = np.zeros((q, l, pdim, k, g), dtype=bool)
     cgrp = np.full(cells.shape, -1, dtype=np.int8)
     ffb = np.ones(q, dtype=bool)
     ffp = np.zeros(q, dtype=bool)
+    # convergent-retry budget per queue: the max joint cursor-odometer
+    # size of its entries (clamped; see drain_kernel stuck machinery)
+    retry_cap = np.full(q, 2 * max_candidates + 2, dtype=np.int32)
     priority = np.zeros((q, l), dtype=np.int64)
     timestamp = np.zeros((q, l), dtype=np.int64)
     no_reclaim = np.zeros(q, dtype=bool)
@@ -116,34 +154,39 @@ def plan_drain(
         no_reclaim[qi] = bool(lowered.no_reclaim[idxs[0]])
         ffb[qi] = bool(lowered.ffb[idxs[0]])
         ffp[qi] = bool(lowered.ffp[idxs[0]])
+        retry_cap[qi] = min(
+            4096, max(lowered.walk_states[i] for i in idxs) + 1
+        )
         n = len(idxs)
         idx_arr = np.asarray(idxs, dtype=np.int64)
-        cells[qi, :n] = lowered.cells[idx_arr]
-        qty[qi, :n] = lowered.qty[idx_arr]
-        valid[qi, :n] = lowered.valid[idx_arr]
-        cgrp[qi, :n] = lowered.cgrp[idx_arr]
+        n_podsets[qi, :n] = lowered.n_podsets[idx_arr]
+        cells[qi, :n] = lowered.cells[idx_arr, :pdim]
+        qty[qi, :n] = lowered.qty[idx_arr, :pdim]
+        valid[qi, :n] = lowered.valid[idx_arr, :pdim]
+        cgrp[qi, :n] = lowered.cgrp[idx_arr, :pdim]
         priority[qi, :n] = lowered.priority[idx_arr]
         timestamp[qi, :n] = lowered.timestamp[idx_arr]
         for pos, i in enumerate(idxs):
             head_of[(qi, pos)] = i
-            groups = lowered.candidate_groups[i]
-            # group lists are shared per lowering template: memoize the
-            # dense cursor rows per list identity
-            rows = cursor_rows_of.get(id(groups))
-            if rows is None:
-                gi_row = np.zeros((k, g), dtype=np.int32)
-                # pad group slots (heads touching fewer than G groups)
-                # must stay permanently eligible: glast=True makes the
-                # resumed start 0, so gidx(0) >= 0 always holds
-                gl_row = np.ones((k, g), dtype=bool)
-                for kk, gvec in enumerate(groups):
-                    for gx, (fi, lastf) in enumerate(gvec):
-                        gi_row[kk, gx] = fi
-                        gl_row[kk, gx] = lastf
-                rows = (gi_row, gl_row)
-                cursor_rows_of[id(groups)] = rows
-            gidx[qi, pos] = rows[0]
-            glast[qi, pos] = rows[1]
+            for pp in range(int(lowered.n_podsets[i])):
+                groups = lowered.candidate_groups[i][pp]
+                # group lists are shared per lowering template: memoize
+                # the dense cursor rows per list identity
+                rows = cursor_rows_of.get(id(groups))
+                if rows is None:
+                    gi_row = np.zeros((k, g), dtype=np.int32)
+                    # pad group slots (heads touching fewer than G
+                    # groups) must stay permanently eligible:
+                    # glast=True makes the resumed start 0
+                    gl_row = np.ones((k, g), dtype=bool)
+                    for kk, gvec in enumerate(groups):
+                        for gx, (fi, lastf) in enumerate(gvec):
+                            gi_row[kk, gx] = fi
+                            gl_row[kk, gx] = lastf
+                    rows = (gi_row, gl_row)
+                    cursor_rows_of[id(groups)] = rows
+                gidx[qi, pos, pp] = rows[0]
+                glast[qi, pos, pp] = rows[1]
 
     roots = build_roots(snapshot.flat.parent)
     seg_id = np.full(q, -1, dtype=np.int32)
@@ -165,7 +208,7 @@ def plan_drain(
         # x (1 + K pending retries each).
         max_seg_events = int(
             np.bincount(inv, weights=qlen[live].astype(np.float64)).max()
-        ) * (max_candidates + 1)
+        ) * (int(retry_cap.max()) + 1)
     else:
         n_segments = n_steps = 8
         max_seg_events = 0
@@ -178,11 +221,13 @@ def plan_drain(
             cells=cells,
             qty=qty,
             valid=valid,
+            n_podsets=n_podsets,
             gidx=gidx,
             glast=glast,
             cgrp=cgrp,
             ffb=ffb,
             ffp=ffp,
+            retry_cap=retry_cap,
             priority=priority,
             timestamp=timestamp,
             no_reclaim=no_reclaim,
@@ -355,7 +400,7 @@ def run_drain_preempt(
         # retires (the PendingFlavors emulation), hence the (K+1) factor
         cap = (
             int(((seg_victims + 1) * seg_entries + seg_victims).max())
-            * (max_candidates + 1)
+            * (int(plan.queues_np["retry_cap"].max()) + 1)
             + 8
         )
     else:
@@ -387,18 +432,26 @@ def run_drain_preempt(
             max_cycles=plan.max_cycles,
         )
     )  # the single fetch
-    nq, nl = plan.queues_np["cells"].shape[:2]
+    nq, nl, npd = plan.queues_np["cells"].shape[:3]
     nv = vcells.shape[1]
-    ql, qv = nq * nl, nq * nv
+    ql, qv, qlp = nq * nl, nq * nv, nq * nl * npd
     off = 0
     status = flat[off : off + ql].reshape((nq, nl)); off += ql
-    adm_k = flat[off : off + ql].reshape((nq, nl)); off += ql
+    adm_k = flat[off : off + qlp].reshape((nq, nl, npd)); off += qlp
     adm_cycle = flat[off : off + ql].reshape((nq, nl)); off += ql
     evicted = flat[off : off + qv].reshape((nq, nv)).astype(bool); off += qv
     evict_cycle = flat[off : off + qv].reshape((nq, nv)); off += qv
+    stuck_q = flat[off : off + nq].astype(bool); off += nq
     cycles = int(flat[-1])
+    # truncated = the CYCLE CAP cut undecided work; queues frozen by
+    # the stuck machinery are a terminal no-decision, not truncation —
+    # rerunning with a larger cap cannot resolve them
     truncated = bool(
-        np.any((status == 0) & (np.arange(nl)[None, :] < qlen[:, None]))
+        np.any(
+            (status == 0)
+            & (np.arange(nl)[None, :] < qlen[:, None])
+            & ~stuck_q[:, None]
+        )
     )
 
     lowered = plan.lowered
@@ -409,10 +462,11 @@ def run_drain_preempt(
         wl = lowered.heads[i]
         cq_name = lowered.cq_names[i]
         st = int(status[qi, pos])
-        kk = int(adm_k[qi, pos])
+        kk = int(adm_k[qi, pos, 0])
         if st == 2 and kk >= 0:
             admitted.append(
-                (wl, cq_name, lowered.candidate_flavors[i][kk], int(adm_cycle[qi, pos]))
+                (wl, cq_name, _admitted_flavors(lowered, i, adm_k[qi, pos]),
+                 int(adm_cycle[qi, pos]))
             )
         elif st == 0:
             # still pending at max_cycles: not a decision
@@ -492,13 +546,16 @@ def run_drain(
             max_cycles=plan.max_cycles,
         )
     )  # the single fetch
-    nq, nl = queues_np["cells"].shape[:2]  # incl. mesh padding rows
+    nq, nl, npd = queues_np["cells"].shape[:3]  # incl. mesh padding
     ql = nq * nl
-    adm_k = flat[:ql].reshape((nq, nl))
-    adm_cycle = flat[ql : 2 * ql].reshape((nq, nl))
-    cursor = flat[2 * ql : 2 * ql + nq]
+    qlp = nq * nl * npd
+    adm_k = flat[:qlp].reshape((nq, nl, npd))
+    adm_cycle = flat[qlp : qlp + ql].reshape((nq, nl))
+    cursor = flat[qlp + ql : qlp + ql + nq]
+    stuck_q = flat[qlp + ql + nq : qlp + ql + 2 * nq].astype(bool)
     cycles = int(flat[-1])
-    truncated = bool(np.any(cursor < queues_np["qlen"]))
+    # stuck-frozen queues are terminal no-decisions, not truncation
+    truncated = bool(np.any((cursor < queues_np["qlen"]) & ~stuck_q))
 
     lowered = plan.lowered
     admitted: List[Tuple[Workload, str, Dict[str, str], int]] = []
@@ -507,10 +564,11 @@ def run_drain(
     for (qi, pos), i in plan.head_of.items():
         wl = lowered.heads[i]
         cq_name = lowered.cq_names[i]
-        kk = int(adm_k[qi, pos])
+        kk = int(adm_k[qi, pos, 0])
         if kk >= 0:
             admitted.append(
-                (wl, cq_name, lowered.candidate_flavors[i][kk], int(adm_cycle[qi, pos]))
+                (wl, cq_name, _admitted_flavors(lowered, i, adm_k[qi, pos]),
+                 int(adm_cycle[qi, pos]))
             )
         elif pos >= int(cursor[qi]):
             # never processed (max_cycles backstop hit): not a decision

@@ -98,6 +98,7 @@ class _Template:
         "flavor_list",
         "tried_list",
         "group_list",
+        "group_sizes",
     )
 
     def __init__(self):
@@ -123,6 +124,9 @@ class _Template:
         # per candidate: tuple per group of (flavor idx in rg.flavors,
         # is-last-flavor flag); empty tuple for invalid candidates
         self.group_list: List[tuple] = []
+        # full walk length (len(rg.flavors)) per touched group — sizes
+        # the drain's convergent-retry odometer bound
+        self.group_sizes: Tuple[int, ...] = ()
 
 
 def _podset_sig(ps, per_pod) -> tuple:
@@ -157,6 +161,7 @@ def _build_template(
         t.fallback = True  # resource not covered: host reports it
         return t
     t.n_groups = len(touched)
+    t.group_sizes = tuple(len(rg.flavors) for rg, _ in touched)
 
     per_rg: List[List[Tuple[str, int]]] = []
     for gidx, (rg, rg_res) in enumerate(touched):
@@ -266,6 +271,22 @@ def _build_template(
     return t
 
 
+def _resolve_starts(cq, per_pod, state, ps_idx: int) -> Tuple[int, ...]:
+    """Per-resource-group cursor starts from a workload's
+    LastAssignment (AssignmentState.next_flavor_to_try) — ONE
+    definition shared by the cycle and drain lowerings."""
+    if state is None:
+        return ()
+    starts_l = []
+    for rg in cq.resource_groups:
+        rg_res = [r for r in sorted(per_pod) if r in rg.covered_resources]
+        if PODS in rg.covered_resources:
+            rg_res.append(PODS)
+        if rg_res:
+            starts_l.append(state.next_flavor_to_try(ps_idx, sorted(rg_res)[0]))
+    return tuple(starts_l)
+
+
 def lower_heads(
     snapshot: Snapshot,
     heads: Sequence[Tuple[Workload, str]],
@@ -336,19 +357,7 @@ def lower_heads(
         gen = snapshot.generations.get(cq_name, 0)
         if state is not None and gen > state.cluster_queue_generation:
             state = None
-        if state is None:
-            starts: Tuple[int, ...] = ()
-        else:
-            starts_l = []
-            for rg in cq.resource_groups:
-                rg_res = [
-                    r for r in sorted(per_pod) if r in rg.covered_resources
-                ]
-                if PODS in rg.covered_resources:
-                    rg_res.append(PODS)
-                if rg_res:
-                    starts_l.append(state.next_flavor_to_try(0, sorted(rg_res)[0]))
-            starts = tuple(starts_l)
+        starts = _resolve_starts(cq, per_pod, state, 0)
 
         key = (cq_name, _podset_sig(ps, per_pod), starts)
         t = templates.get(key)
@@ -542,3 +551,211 @@ def solve_heads(
         snapshot, heads, flavors, max_candidates, max_cells, timestamp_fn
     )
     return lowered, dispatch_lowered(snapshot, lowered, pad_heads)
+
+
+@dataclass
+class MultiLowered:
+    """Dense multi-podset head batch for the drain: the single-podset
+    layout with an extra P axis (podsets padded to a common max). A
+    workload's podsets nominate SEQUENTIALLY in the kernel — the
+    host couples them only through assignment_usage at shared
+    (flavor, resource) cells, so each podset keeps its own candidate
+    template, cursor vector, and walk."""
+
+    cq_row: np.ndarray  # int32[W]
+    n_podsets: np.ndarray  # int32[W]
+    cells: np.ndarray  # int32[W,P,K,C]
+    qty: np.ndarray  # int64[W,P,K,C]
+    valid: np.ndarray  # bool[W,P,K]
+    cgrp: np.ndarray  # int8[W,P,K,C]
+    priority: np.ndarray  # int64[W]
+    timestamp: np.ndarray  # int64[W]
+    no_reclaim: np.ndarray  # bool[W]
+    ffb: np.ndarray  # bool[W]
+    ffp: np.ndarray  # bool[W]
+    # per head per podset: candidate k -> maps (template-shared lists)
+    candidate_flavors: List[List[list]] = field(default_factory=list)
+    candidate_groups: List[List[list]] = field(default_factory=list)
+    heads: List[Workload] = field(default_factory=list)
+    cq_names: List[str] = field(default_factory=list)
+    fallback: List[int] = field(default_factory=list)
+    n_groups: List[int] = field(default_factory=list)  # max over podsets
+    # per head: number of distinct joint cursor states its podsets' walk
+    # odometer can take — prod over podsets of prod over groups of
+    # (walk length + 1); a CONVERGENT PendingFlavors retry sequence
+    # cannot exceed it, so it is the sound stuck-detection budget
+    walk_states: List[int] = field(default_factory=list)
+
+
+def lower_heads_multi(
+    snapshot: Snapshot,
+    heads: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    max_candidates: int = 8,
+    max_cells: int = 16,
+    max_podsets: int = 4,
+    timestamp_fn=None,
+    transform=None,
+    any_fungibility: bool = True,
+) -> MultiLowered:
+    """lower_heads generalized over podsets (drain path).
+
+    Cursor starts resolve per (podset index, resource) from the
+    workload's LastAssignment, exactly like the host's
+    AssignmentState.next_flavor_to_try."""
+    w = len(heads)
+    k, c = max_candidates, max_cells
+    # size the podset axis to what the batch actually needs: the
+    # common all-single-podset backlog must not pay 4x the memory and
+    # memset of a padded axis
+    pmax = max(
+        [1]
+        + [
+            len(wl.pod_sets)
+            for wl, cqn in heads
+            if len(wl.pod_sets) <= max_podsets
+            and cqn in snapshot.cq_models
+        ]
+    )
+    out = MultiLowered(
+        cq_row=np.full(w, -1, dtype=np.int32),
+        n_podsets=np.zeros(w, dtype=np.int32),
+        cells=np.full((w, pmax, k, c), -1, dtype=np.int32),
+        qty=np.zeros((w, pmax, k, c), dtype=np.int64),
+        valid=np.zeros((w, pmax, k), dtype=bool),
+        cgrp=np.full((w, pmax, k, c), -1, dtype=np.int8),
+        priority=np.zeros(w, dtype=np.int64),
+        timestamp=np.zeros(w, dtype=np.int64),
+        no_reclaim=np.zeros(w, dtype=bool),
+        ffb=np.ones(w, dtype=bool),
+        ffp=np.zeros(w, dtype=bool),
+    )
+    templates: Dict[tuple, _Template] = {}
+    groups: Dict[tuple, tuple] = {}  # (key, p) -> (t, idxs, pcs)
+
+    for i, (wl, cq_name) in enumerate(heads):
+        out.heads.append(wl)
+        out.cq_names.append(cq_name)
+        # per-podset maps appended as podsets lower (indexed p <
+        # n_podsets only; fallback heads keep empty lists)
+        flav_i: list = []
+        grp_i: list = []
+        out.candidate_flavors.append(flav_i)
+        out.candidate_groups.append(grp_i)
+        out.n_groups.append(0)
+        out.walk_states.append(1)
+        if cq_name not in snapshot.cq_models:
+            out.fallback.append(i)
+            continue
+        cq = snapshot.cq_models[cq_name]
+        if len(wl.pod_sets) > max_podsets or (
+            not any_fungibility and not _default_fungibility(cq)
+        ):
+            out.fallback.append(i)
+            continue
+        ff = cq.flavor_fungibility
+        out.ffb[i] = ff.when_can_borrow == FlavorFungibilityPolicy.BORROW
+        out.ffp[i] = ff.when_can_preempt == FlavorFungibilityPolicy.PREEMPT
+
+        state = wl.last_assignment
+        gen = snapshot.generations.get(cq_name, 0)
+        if state is not None and gen > state.cluster_queue_generation:
+            state = None
+
+        # fast path: the overwhelmingly common single-podset head skips
+        # the per-podset list plumbing below (bulk-drain lowering cost)
+        if len(wl.pod_sets) == 1:
+            ps = wl.pod_sets[0]
+            if ps.topology_request is not None:
+                out.fallback.append(i)
+                continue
+            per_pod = quota_per_pod(ps, transform)
+            starts = _resolve_starts(cq, per_pod, state, 0)
+            key = (cq_name, _podset_sig(ps, per_pod), starts)
+            t = templates.get(key)
+            if t is None:
+                t = _build_template(
+                    snapshot, cq, cq_name, ps, per_pod, starts, flavors, k, c
+                )
+                templates[key] = t
+            if t.fallback:
+                out.fallback.append(i)
+                continue
+            out.cq_row[i] = t.cq_row
+            out.n_podsets[i] = 1
+            out.no_reclaim[i] = t.no_reclaim
+            out.priority[i] = priority_of(wl, snapshot.priority_classes)
+            ts = timestamp_fn(wl) if timestamp_fn else wl.creation_time
+            out.timestamp[i] = int(ts * 1e9)
+            out.n_groups[i] = t.n_groups
+            ws = 1
+            for n_g in t.group_sizes:
+                ws *= n_g + 1
+            out.walk_states[i] = ws
+            flav_i.append(t.flavor_list)
+            grp_i.append(t.group_list)
+            group = groups.get((key, 0))
+            if group is None:
+                group = groups[(key, 0)] = (t, [], [])
+            group[1].append(i)
+            group[2].append((per_pod, effective_podset_count(wl, ps)))
+            continue
+
+        bad = False
+        head_templates = []
+        for ps_idx, ps in enumerate(wl.pod_sets):
+            if ps.topology_request is not None:
+                bad = True  # TAS placement stays on the host path
+                break
+            per_pod = quota_per_pod(ps, transform)
+            starts = _resolve_starts(cq, per_pod, state, ps_idx)
+            key = (cq_name, _podset_sig(ps, per_pod), starts)
+            t = templates.get(key)
+            if t is None:
+                t = _build_template(
+                    snapshot, cq, cq_name, ps, per_pod, starts, flavors, k, c
+                )
+                templates[key] = t
+            if t.fallback:
+                bad = True
+                break
+            head_templates.append((key, t, ps, per_pod))
+        if bad:
+            out.fallback.append(i)
+            continue
+
+        out.cq_row[i] = head_templates[0][1].cq_row
+        out.n_podsets[i] = len(wl.pod_sets)
+        out.no_reclaim[i] = head_templates[0][1].no_reclaim
+        out.priority[i] = priority_of(wl, snapshot.priority_classes)
+        ts = timestamp_fn(wl) if timestamp_fn else wl.creation_time
+        out.timestamp[i] = int(ts * 1e9)
+        out.n_groups[i] = max(t.n_groups for _, t, _, _ in head_templates)
+        ws = 1
+        for _, t, _, _ in head_templates:
+            for n_g in t.group_sizes:
+                ws *= n_g + 1
+        out.walk_states[i] = ws
+        for p, (key, t, ps, per_pod) in enumerate(head_templates):
+            flav_i.append(t.flavor_list)
+            grp_i.append(t.group_list)
+            count = effective_podset_count(wl, ps)
+            group = groups.get((key, p))
+            if group is None:
+                group = groups[(key, p)] = (t, [], [])
+            group[1].append(i)
+            group[2].append((per_pod, count))
+
+    for (key, p), (t, idxs, pcs) in groups.items():
+        ii = np.asarray(idxs, dtype=np.intp)
+        out.cells[ii, p] = t.cells_arr
+        out.valid[ii, p] = t.valid_row
+        out.cgrp[ii, p] = t.cgrp_arr
+        rmat = np.zeros((len(ii), len(t.res_names) + 1), dtype=np.int64)
+        for x, r in enumerate(t.res_names):
+            if r == PODS:
+                rmat[:, x] = [count for (_, count) in pcs]
+            else:
+                rmat[:, x] = [pp.get(r, 0) * count for (pp, count) in pcs]
+        out.qty[ii, p] = rmat[:, t.qty_sel]
+    return out

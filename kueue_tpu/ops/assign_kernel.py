@@ -134,6 +134,55 @@ def _gather_cells(mat: jnp.ndarray, rows: jnp.ndarray, cells: jnp.ndarray) -> jn
     return mat[r, c]
 
 
+def cell_masks(
+    tree: QuotaTree,
+    subtree: jnp.ndarray,
+    guaranteed: jnp.ndarray,
+    local_usage: jnp.ndarray,
+    cq_row: jnp.ndarray,  # int32[W]
+    cells: jnp.ndarray,  # int32[W,K,C]
+    qty: jnp.ndarray,  # int64[W,K,C] (already inflated by any
+    #                     accumulated same-nomination usage)
+    usage=None,  # precomputed usage_tree, or None to build it
+    avail=None,  # precomputed available_all (once per cycle)
+    potential=None,  # precomputed potential_available_all (constant)
+):
+    """Per-cell classification masks against the cycle-start snapshot
+    (zero/pad cells are permissive): fit, preempt-eligible, the reclaim
+    upgrade's leaf condition, and borrowing. The quantity compared is
+    the caller's ``qty`` — multi-podset nominations inflate it with the
+    usage accumulated by earlier podsets of the same workload
+    (flavor_assigner's assignment_usage), which couples podsets only at
+    the cell level, never through the tree."""
+    if usage is None:
+        usage = usage_tree(tree, guaranteed, local_usage)
+    if avail is None:
+        avail = available_all(tree, subtree, guaranteed, usage)  # [N, FR]
+    if potential is None:
+        potential = potential_available_all(tree, subtree, guaranteed)
+
+    cq = jnp.maximum(cq_row, 0)
+    cell_need = (cells >= 0) & (qty > 0)
+    cc = jnp.maximum(cells, 0)
+    avail_wkc = avail[cq[:, None, None], cc]
+    potential_wkc = potential[cq[:, None, None], cc]
+    local_wkc = local_usage[cq[:, None, None], cc]
+    subtree_wkc = subtree[cq[:, None, None], cc]
+    nominal_wkc = tree.nominal[cq[:, None, None], cc]
+    has_cohort = (tree.parent[cq] >= 0)[:, None]
+
+    fit_cells = jnp.where(cell_need, avail_wkc >= qty, True)
+    pot_cells = jnp.where(
+        cell_need, (qty <= potential_wkc) & (qty <= nominal_wkc), True
+    )
+    reclaim_cells = jnp.where(cell_need, local_wkc + qty <= nominal_wkc, True)
+    borrow_cells = (
+        jnp.where(cell_need, local_wkc + qty > subtree_wkc, False)
+        & has_cohort[..., None]
+    )
+    return fit_cells, pot_cells, reclaim_cells, borrow_cells, cell_need
+
+
 def phase1_classify(
     tree: QuotaTree,
     subtree: jnp.ndarray,

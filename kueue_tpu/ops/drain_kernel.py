@@ -42,10 +42,8 @@ from typing import NamedTuple
 
 from kueue_tpu._jax import jax, jnp, lax
 from kueue_tpu.ops.assign_kernel import (
-    HeadsBatch,
     _avail_along_path,
     _gather_cells,
-    phase1_classify,
     segmented_rank,
 )
 from kueue_tpu.ops.quota import NO_LIMIT, QuotaTree, subtree_quota, usage_tree
@@ -54,7 +52,11 @@ from kueue_tpu.ops.quota import NO_LIMIT, QuotaTree, subtree_quota, usage_tree
 class DrainQueues(NamedTuple):
     """Per-ClusterQueue pending queues, densely packed.
 
-    Q queues, L max queue length, K flavor candidates, C cells.
+    Q queues, L max queue length, P podsets, K flavor candidates,
+    C cells per candidate. Per-entry tensors carry a podset axis:
+    cells/qty int[Q,L,P,K,C], valid bool[Q,L,P,K], gidx/glast
+    [Q,L,P,K,G], cgrp [Q,L,P,K,C]; n_podsets int32[Q,L] counts the
+    REAL podsets (pad podsets are inert).
 
     cq_rows:  int32[Q]     — tree row of each queue's ClusterQueue.
     seg_id:   int32[Q]     — compact root-cohort id (segmented phase 2).
@@ -78,6 +80,10 @@ class DrainQueues(NamedTuple):
               bits: whenCanBorrow == Borrow / whenCanPreempt == Preempt
               (clusterqueue_types.go:379-401), consumed by the
               policy-aware group walk.
+    retry_cap: int32[Q] — PendingFlavors retry budget: the queue's max
+              joint cursor-odometer size (prod over podsets and groups
+              of walk length + 1). A CONVERGENT retry sequence cannot
+              exceed it, so exceeding it proves a divergent spin.
     priority: int64[Q,L] / timestamp: int64[Q,L] — entry order keys,
               already sorted within each queue (priority desc, ts asc —
               the pending-heap order, cluster_queue.go:413-426).
@@ -93,8 +99,10 @@ class DrainQueues(NamedTuple):
     gidx: jnp.ndarray
     glast: jnp.ndarray
     cgrp: jnp.ndarray
+    n_podsets: jnp.ndarray
     ffb: jnp.ndarray
     ffp: jnp.ndarray
+    retry_cap: jnp.ndarray
     priority: jnp.ndarray
     timestamp: jnp.ndarray
     no_reclaim: jnp.ndarray
@@ -113,18 +121,7 @@ class DrainResult(NamedTuple):
     cursor: jnp.ndarray
     cycles: jnp.ndarray
     local_usage: jnp.ndarray
-
-
-def _group_cursor_inputs(queues, q_idx, cur):
-    """Per-cycle gathers for the policy-aware group walk: current
-    entries' per-group flavor indexes, chose-last flags, and the
-    cell->group one-hot mask."""
-    gid = queues.gidx[q_idx, cur]  # [Q,K,G]
-    gl = queues.glast[q_idx, cur]  # [Q,K,G]
-    cg = queues.cgrp[q_idx, cur]  # [Q,K,C]
-    g = gid.shape[-1]
-    gmask = cg[..., None] == jnp.arange(g)[None, None, None, :]  # [Q,K,C,G]
-    return gid, gl, gmask
+    stuck: jnp.ndarray  # bool[Q] — frozen PendingFlavors spinners
 
 
 def _group_walk(
@@ -199,6 +196,133 @@ def _group_walk(
     return chosen, pre_k, pending, next_start
 
 
+def _nominate_multi(
+    tree, subtree, guaranteed, local, usage0, queues, q_idx, cur, active,
+    g_start, potential, victims=None, elig_v=None,
+):
+    """Sequential multi-podset nomination for the current heads.
+
+    The host nominates a workload's podsets IN ORDER; podset p's flavor
+    walk evaluates quantities inflated by the usage accumulated by
+    podsets < p at shared (flavor, resource) cells (assignment_usage —
+    cell-level coupling only, never through the tree). A podset with no
+    choices fails the whole workload (later podsets unprocessed, cursor
+    cleared); preempt-mode podsets keep accumulating.
+
+    Returns (is_fit, is_pre, pending, head_borrow, rep_k [Q,P],
+    next_start [Q,P,G], mcells [Q,P*C], mqty [Q,P*C]) where
+    mcells/mqty are the merged representative cells with per-fr
+    quantities SUMMED onto the first occurrence (duplicates zeroed), so
+    fits checks, usage deltas and reservations each count shared cells
+    once."""
+    from kueue_tpu.ops.assign_kernel import available_all, cell_masks
+
+    q, l, pmax, k, c = queues.cells.shape
+    # tree-wide availability once per cycle (NOT per podset): every
+    # podset's masks read the same cycle-start snapshot
+    avail0 = available_all(tree, subtree, guaranteed, usage0)
+    g = queues.gidx.shape[-1]
+    n_fr = local.shape[1]
+    head_cq = jnp.where(active, queues.cq_rows, -1).astype(jnp.int32)
+
+    accum = jnp.zeros((q, n_fr), dtype=jnp.int64)
+    processed = jnp.ones(q, dtype=bool)
+    head_mode = jnp.full(q, 3, dtype=jnp.int32)
+    head_borrow = jnp.zeros(q, dtype=bool)
+    pending = jnp.zeros(q, dtype=bool)
+    rep_list, nstart_list, cells_list, qty_list = [], [], [], []
+    npod = queues.n_podsets[q_idx, cur]  # [Q]
+
+    for p in range(pmax):
+        real = active & (p < npod)
+        cells_p = queues.cells[q_idx, cur, p]  # [Q,K,C]
+        qty_p = queues.qty[q_idx, cur, p]
+        accum_at = accum[q_idx[:, None, None], jnp.maximum(cells_p, 0)]
+        infl = qty_p + jnp.where((cells_p >= 0) & (qty_p > 0), accum_at, 0)
+        fit_cells, pot_cells, reclaim_cells, borrow_cells, cell_need = (
+            cell_masks(
+                tree, subtree, guaranteed, local, head_cq, cells_p, infl,
+                usage=usage0, avail=avail0, potential=potential,
+            )
+        )
+        if victims is not None:
+            # reclaim-oracle victim check at this podset's cells
+            vmatch = (
+                victims.vcells[:, None, :, :, None]
+                == jnp.maximum(cells_p, 0)[:, :, None, None, :]
+            ) & (victims.vcells >= 0)[:, None, :, :, None]
+            victim_on_cell = jnp.any(
+                vmatch & elig_v[:, None, :, None, None], axis=(2, 3)
+            )
+            reclaim_cells = reclaim_cells & ~victim_on_cell
+        gid_p = queues.gidx[q_idx, cur, p]
+        gl_p = queues.glast[q_idx, cur, p]
+        cg_p = queues.cgrp[q_idx, cur, p]
+        gmask_p = cg_p[..., None] == jnp.arange(g)[None, None, None, :]
+        k_mask_p = jnp.all(gid_p >= g_start[:, p][:, None, :], axis=-1)
+        valid_p = queues.valid[q_idx, cur, p] & real[:, None] & k_mask_p
+        chosen_p, pre_p, pending_p, nstart_p = _group_walk(
+            gid_p, gl_p, gmask_p, valid_p, fit_cells, pot_cells,
+            reclaim_cells, borrow_cells, queues.ffb, queues.ffp,
+        )
+        live = real & processed
+        mode_p = jnp.where(
+            chosen_p >= 0, 3, jnp.where(pre_p >= 0, 1, 0)
+        )
+        mode_p = jnp.where(live, mode_p, 3)  # pads/unprocessed inert
+        rep_p = jnp.where(chosen_p >= 0, chosen_p, pre_p)
+        use_p = live & (rep_p >= 0)
+        rep_safe = jnp.maximum(rep_p, 0)
+        cells_rep = jnp.take_along_axis(
+            cells_p, rep_safe[:, None, None], axis=1
+        )[:, 0]  # [Q,C]
+        qty_rep = jnp.take_along_axis(
+            qty_p, rep_safe[:, None, None], axis=1
+        )[:, 0]
+        cells_rep = jnp.where(use_p[:, None] & (cells_rep >= 0), cells_rep, -1)
+        qty_rep = jnp.where(cells_rep >= 0, qty_rep, 0)
+        # assignment_usage grows for fit AND preempt choices alike
+        accum = accum.at[
+            q_idx[:, None], jnp.maximum(cells_rep, 0)
+        ].add(jnp.where(cells_rep >= 0, qty_rep, 0))
+        borrow_rep = jnp.any(
+            jnp.take_along_axis(
+                borrow_cells, rep_safe[:, None, None], axis=1
+            )[:, 0]
+            & (cells_rep >= 0),
+            axis=1,
+        )
+        head_borrow = head_borrow | (borrow_rep & use_p)
+        pending = pending | (pending_p & live)
+        head_mode = jnp.minimum(head_mode, mode_p)
+        processed = processed & (mode_p >= 1)
+        rep_list.append(jnp.where(use_p, rep_p, -1))
+        nstart_list.append(jnp.where(live[:, None], nstart_p, 0))
+        cells_list.append(cells_rep)
+        qty_list.append(qty_rep)
+
+    rep_k = jnp.stack(rep_list, axis=1)  # [Q,P]
+    next_start = jnp.stack(nstart_list, axis=1)  # [Q,P,G]
+    mcells = jnp.concatenate(cells_list, axis=1)  # [Q,P*C]
+    mqty = jnp.concatenate(qty_list, axis=1)
+    # merge duplicate frs: sum onto the first occurrence, zero the rest
+    # (the host fits()/reserve vectors are per-fr sums)
+    pc = pmax * c
+    pos = jnp.arange(pc)
+    same = (mcells[:, None, :] == mcells[:, :, None]) & (mcells >= 0)[:, None, :]
+    summed = jnp.sum(jnp.where(same, mqty[:, None, :], 0), axis=2)
+    first = ~jnp.any(
+        same & (pos[None, None, :] < pos[None, :, None]), axis=2
+    )
+    mqty = jnp.where(first & (mcells >= 0), summed, 0)
+    mcells = jnp.where(first, mcells, -1)
+
+    is_fit = active & (head_mode == 3)
+    is_pre = active & (head_mode >= 1) & (head_mode < 3)
+    pend = pending & is_pre  # NoFit nominations clear the cursor
+    return is_fit, is_pre, pend, head_borrow, rep_k, next_start, mcells, mqty
+
+
 def solve_drain(
     tree: QuotaTree,
     local_usage: jnp.ndarray,  # int64[N, FR] starting leaf usage
@@ -210,8 +334,11 @@ def solve_drain(
 ) -> DrainResult:
     max_depth = tree.max_depth
     subtree, guaranteed = subtree_quota(tree)
+    from kueue_tpu.ops.assign_kernel import potential_available_all
 
-    q, l, k, c = queues.cells.shape
+    potential = potential_available_all(tree, subtree, guaranteed)
+
+    q, l, pmax, k, c = queues.cells.shape
     q_idx = jnp.arange(q)
 
     avail_v = jax.vmap(
@@ -219,49 +346,25 @@ def solve_drain(
     )
 
     def cycle_body(state):
-        local, cursor, g_start, adm_k, adm_cycle, cycle = state
+        (local, cursor, g_start, retries, stuck, no_prog, adm_k,
+         adm_cycle, cycle) = state
 
         active = cursor < queues.qlen  # [Q]
         cur = jnp.minimum(cursor, l - 1)
-        # per-group candidate cursor: a conflict-skipped head resumes
-        # each resource group's flavor walk past the flavor it chose
-        # last cycle (LastAssignment semantics, flavorassigner.go:
-        # 359-377 + cluster_queue.go:231); a candidate stays eligible
-        # iff EVERY group index is past its group's start — the
-        # cartesian sub-walk the rebuilt host template would enumerate
-        k_mask = jnp.all(
-            queues.gidx[q_idx, cur] >= g_start[:, None, :], axis=-1
-        )  # [Q, K]
-        heads = HeadsBatch(
-            cq_row=jnp.where(active, queues.cq_rows, -1).astype(jnp.int32),
-            cells=queues.cells[q_idx, cur],  # [Q, K, C]
-            qty=queues.qty[q_idx, cur],
-            valid=queues.valid[q_idx, cur] & active[:, None] & k_mask,
-            priority=queues.priority[q_idx, cur],
-            timestamp=queues.timestamp[q_idx, cur],
-            no_reclaim=queues.no_reclaim,
+        usage0 = usage_tree(tree, guaranteed, local)
+        (is_fit, is_pre, pend, head_borrow, rep_k, walk_next,
+         cells_eff, qty_eff) = _nominate_multi(
+            tree, subtree, guaranteed, local, usage0, queues, q_idx, cur,
+            active, g_start, potential,
         )
+        nofit = ~(is_fit | is_pre)
 
-        (_p1_chosen, borrows_wk, _p1_pre, fit_cells, pot_cells,
-         reclaim_cells, borrow_cells) = phase1_classify(
-            tree, subtree, guaranteed, local, heads, return_cell_fit=True
-        )
-        gid_cur, gl_cur, gmask_cur = _group_cursor_inputs(queues, q_idx, cur)
-        chosen, preempt_k, walk_pending, walk_next = _group_walk(
-            gid_cur, gl_cur, gmask_cur, heads.valid, fit_cells, pot_cells,
-            reclaim_cells, borrow_cells, queues.ffb, queues.ffp,
-        )
-        eff_k = jnp.where(chosen >= 0, chosen, preempt_k)
-        eff_safe = jnp.maximum(eff_k, 0)
-        head_borrow = jnp.take_along_axis(
-            borrows_wk, eff_safe[:, None], axis=1
-        )[:, 0] & (eff_k >= 0)
-        nofit = eff_k < 0
-
+        prio = queues.priority[q_idx, cur]
+        ts = queues.timestamp[q_idx, cur]
         order = jnp.lexsort(
             (
-                heads.timestamp,
-                -heads.priority,
+                ts,
+                -prio,
                 head_borrow.astype(jnp.int64),
                 nofit.astype(jnp.int64),
             )
@@ -276,13 +379,7 @@ def solve_drain(
             .set(order.astype(jnp.int32), mode="drop")
         )
 
-        cells_eff = jnp.take_along_axis(
-            heads.cells, eff_safe[:, None, None], axis=1
-        )[:, 0]
-        qty_eff = jnp.take_along_axis(heads.qty, eff_safe[:, None, None], axis=1)[:, 0]
-        cq = jnp.maximum(heads.cq_row, 0)
-
-        usage0 = usage_tree(tree, guaranteed, local)
+        cq = jnp.maximum(queues.cq_rows, 0)
 
         def step(usage, s):
             idx = mat[s]  # [G]
@@ -300,13 +397,8 @@ def solve_drain(
                 tree.borrowing_limit, max_depth,
             )
             fits = jnp.all(jnp.where(cell_valid, avail >= qty_, True), axis=1)
-            admit = act & (chosen[hidx] >= 0) & fits
-            reserve = (
-                act
-                & (chosen[hidx] < 0)
-                & (preempt_k[hidx] >= 0)
-                & heads.no_reclaim[hidx]
-            )
+            admit = act & is_fit[hidx] & fits
+            reserve = act & is_pre[hidx] & queues.no_reclaim[hidx]
             nominal_c = tree.nominal[cqs[:, None], ccells]
             bl_c = tree.borrowing_limit[cqs[:, None], ccells]
             leaf_usage_c = usage[cqs[:, None], ccells]
@@ -330,13 +422,13 @@ def solve_drain(
                 node = jnp.maximum(path[:, d], 0)
                 node_valid = (path[:, d] >= 0)[:, None]
                 old = usage[node[:, None], ccells]
-                g = guaranteed[node[:, None], ccells]
+                gg = guaranteed[node[:, None], ccells]
                 new = old + delta
                 usage = usage.at[node[:, None], ccells].add(
                     jnp.where(node_valid, delta, 0)
                 )
-                over_old = jnp.maximum(0, old - g)
-                over_new = jnp.maximum(0, new - g)
+                over_old = jnp.maximum(0, old - gg)
+                over_new = jnp.maximum(0, new - gg)
                 delta = jnp.where(node_valid, over_new - over_old, delta)
             return usage, admit
 
@@ -358,50 +450,83 @@ def solve_drain(
         local = local.at[cq[:, None], jnp.maximum(cells_eff, 0)].add(add)
 
         # queue motion: admitted leave; non-Fit heads park (advance)
-        # UNLESS some resource group's independent walk stored a pending
-        # flavor cursor — those requeue immediately and retry from the
-        # advanced per-group starts (PendingFlavors; multi-group heads
-        # can be NoFit overall while one group found a non-final fit);
-        # in-cycle conflict losers stay, resuming past the chosen combo
-        pend = walk_pending & (preempt_k >= 0)  # NoFit heads never pend
-        retrying = active & (chosen < 0) & pend
-        advance = active & (admitted | ((chosen < 0) & ~pend))
+        # unless a podset walk stored a pending flavor cursor
+        # (PendingFlavors); in-cycle conflict losers stay, resuming
+        # every podset from its stored per-group cursors
+        # Non-converging PendingFlavors loops: the reference's
+        # immediate-requeue can oscillate forever when podset/group
+        # cursors alternately advance and reset — the live scheduler
+        # spins until cluster events change the state, but a drain has
+        # no events. A queue whose head retried more times than its
+        # joint cursor odometer has states (queues.retry_cap — no
+        # convergent walk can need more) is provably cycling and is
+        # marked STUCK: its head keeps re-nominating with a frozen
+        # cursor every remaining cycle — so its per-cycle capacity
+        # reservations keep shaping other queues' decisions exactly
+        # like the host's spin — but the queue stops counting toward
+        # termination and its undecided entries are reported as
+        # fallback (no decision), matching the host's never-decided
+        # spinners.
+        over_budget = retries >= queues.retry_cap
+        stuck = stuck | (active & (~is_fit) & pend & over_budget)
+        # a stuck head whose frozen nomination later RESOLVES (another
+        # queue's motion freed capacity: it admits, or its walk now
+        # exhausts and parks) un-sticks — the host spinner would pick
+        # up the same state change
+        resolve = active & (admitted | ((~is_fit) & ~pend))
+        stuck = stuck & ~resolve
+        retrying = active & (~is_fit) & pend & ~stuck
+        advance = resolve
+        retries = jnp.where(
+            advance | ~active, 0, jnp.where(retrying, retries + 1, retries)
+        )
+        # Global stagnation guard: a frozen spinner's reservation can
+        # STARVE another queue's FIT head (it loses the in-cycle
+        # re-check every cycle without ever advancing) — the host spins
+        # on that too. With no queue advancing for 2x the retry budget,
+        # the per-cycle state is provably cyclic, so every remaining
+        # non-advancing queue is marked stuck (no decision).
+        any_advance = jnp.any(advance)
+        no_prog = jnp.where(any_advance, 0, no_prog + 1)
+        stuck = stuck | (
+            (no_prog >= 2 * jnp.max(queues.retry_cap)) & active & ~advance
+        )
         adm_k = adm_k.at[q_idx, cur].set(
-            jnp.where(admitted & active, chosen, adm_k[q_idx, cur])
+            jnp.where(
+                (admitted & active)[:, None], rep_k, adm_k[q_idx, cur]
+            )
         )
         adm_cycle = adm_cycle.at[q_idx, cur].set(
             jnp.where(admitted & active, cycle, adm_cycle[q_idx, cur])
         )
-        # cursor semantics of the host walk, per group: choosing the
-        # group's LAST flavor stores -1 (restart that group at 0);
-        # otherwise resume past the chosen flavor
-        # both conflict losers and pending retries resume from the
-        # walk's stored per-group cursors (LastAssignment semantics —
-        # a policy that stopped a group mid-walk stores that index)
-        lost = active & (chosen >= 0) & (~admitted)
+        lost = active & is_fit & (~admitted)
         g_start = jnp.where(
-            advance[:, None],
+            advance[:, None, None],
             0,
-            jnp.where((lost | retrying)[:, None], walk_next, g_start),
+            jnp.where((lost | retrying)[:, None, None], walk_next, g_start),
         ).astype(jnp.int32)
         cursor = cursor + advance.astype(jnp.int32)
-        return local, cursor, g_start, adm_k, adm_cycle, cycle + 1
+        return (local, cursor, g_start, retries, stuck, no_prog, adm_k,
+                adm_cycle, cycle + 1)
 
     def cond(state):
-        _, cursor, _, _, _, cycle = state
-        return jnp.any(cursor < queues.qlen) & (cycle < max_cycles)
+        _, cursor, _, _, stuck, _, _, _, cycle = state
+        return jnp.any((cursor < queues.qlen) & ~stuck) & (cycle < max_cycles)
 
     g = queues.gidx.shape[-1]
     init = (
         local_usage,
         jnp.zeros(q, dtype=jnp.int32),
-        jnp.zeros((q, g), dtype=jnp.int32),
-        jnp.full((q, l), -1, dtype=jnp.int32),
+        jnp.zeros((q, pmax, g), dtype=jnp.int32),
+        jnp.zeros(q, dtype=jnp.int32),
+        jnp.zeros(q, dtype=bool),
+        jnp.int32(0),
+        jnp.full((q, l, pmax), -1, dtype=jnp.int32),
         jnp.full((q, l), -1, dtype=jnp.int32),
         jnp.int32(0),
     )
-    local_f, cursor_f, _, adm_k, adm_cycle, cycles = lax.while_loop(
-        cond, cycle_body, init
+    (local_f, cursor_f, _, _, stuck_f, _, adm_k, adm_cycle, cycles) = (
+        lax.while_loop(cond, cycle_body, init)
     )
     return DrainResult(
         admitted_k=adm_k,
@@ -409,6 +534,7 @@ def solve_drain(
         cursor=cursor_f,
         cycles=cycles,
         local_usage=local_f,
+        stuck=stuck_f,
     )
 
 
@@ -456,6 +582,7 @@ class PreemptDrainResult(NamedTuple):
     admitted_cycle: jnp.ndarray
     evicted: jnp.ndarray
     evicted_cycle: jnp.ndarray
+    stuck: jnp.ndarray  # bool[Q] — frozen PendingFlavors spinners
     cycles: jnp.ndarray
     local_usage: jnp.ndarray
 
@@ -589,8 +716,11 @@ def solve_drain_preempt(
     """
     max_depth = tree.max_depth
     subtree, guaranteed = subtree_quota(tree)
+    from kueue_tpu.ops.assign_kernel import potential_available_all
 
-    q, l, k, c = queues.cells.shape
+    potential = potential_available_all(tree, subtree, guaranteed)
+
+    q, l, pmax, k, c = queues.cells.shape
     v = victims.vqty.shape[1]
     q_idx = jnp.arange(q)
     l_idx = jnp.arange(l)
@@ -604,8 +734,8 @@ def solve_drain_preempt(
     )
 
     def cycle_body(state):
-        (local, status, g_start, adm_k, adm_cycle,
-         vevicted, evict_cycle, cycle) = state
+        (local, status, g_start, retries, stuck, no_prog, adm_k,
+         adm_cycle, vevicted, evict_cycle, cycle) = state
 
         # head of each queue = first pending entry in heap order
         pend = status == 0  # [Q,L]
@@ -614,69 +744,29 @@ def solve_drain_preempt(
         active = (cur_raw < l) & (cur_raw < queues.qlen)
         cur = jnp.minimum(cur_raw, l - 1)
 
-        k_mask = jnp.all(
-            queues.gidx[q_idx, cur] >= g_start[:, None, :], axis=-1
-        )
-        heads = HeadsBatch(
-            cq_row=jnp.where(active, queues.cq_rows, -1).astype(jnp.int32),
-            cells=queues.cells[q_idx, cur],
-            qty=queues.qty[q_idx, cur],
-            valid=queues.valid[q_idx, cur] & active[:, None] & k_mask,
-            priority=queues.priority[q_idx, cur],
-            timestamp=queues.timestamp[q_idx, cur],
-            no_reclaim=queues.no_reclaim,
-        )
-
-        (_p1_chosen, borrows_wk, _p1_pre, fit_cells, pot_cells,
-         reclaim_leaf, borrow_cells) = phase1_classify(
-            tree, subtree, guaranteed, local, heads, return_cell_fit=True
-        )
+        prio = queues.priority[q_idx, cur]
+        ts = queues.timestamp[q_idx, cur]
         # Victim-eligibility predicate (preemption.go:480-524 priority
-        # rule), shared by the reclaim-oracle emulation here and the
-        # victim search below — ONE definition so they cannot drift.
+        # rule), shared by the reclaim-oracle emulation inside the
+        # nomination and the victim search below.
         live_victim = victims.vvalid & ~vevicted  # [Q,V]
-        lower = victims.vprio < heads.priority[:, None]
+        lower = victims.vprio < prio[:, None]
         newer_eq = (
             victims.same_prio_ok[:, None]
-            & (victims.vprio == heads.priority[:, None])
-            & (heads.timestamp[:, None] < victims.vts)
+            & (victims.vprio == prio[:, None])
+            & (ts[:, None] < victims.vts)
         )
         elig_v = live_victim & (lower | newer_eq)  # [Q,V]
-        # Reclaim-oracle emulation under the preempt-drain scope
-        # (reclaimWithinCohort=Never): the oracle's target search sees
-        # only same-CQ candidates, so the upgrade holds iff the leaf
-        # condition does AND no live eligible victim uses the cell's
-        # flavor-resource (a candidate existing means the oracle finds
-        # a same-CQ target and refuses the upgrade).
-        # victim uses candidate cell: [Q,K,C] via [Q,V,Cv] matching
-        vmatch = (
-            victims.vcells[:, None, :, :, None]
-            == jnp.maximum(heads.cells, 0)[:, :, None, None, :]
-        ) & (victims.vcells >= 0)[:, None, :, :, None]  # [Q,K,V,Cv,C]
-        victim_on_cell = jnp.any(
-            vmatch & elig_v[:, None, :, None, None], axis=(2, 3)
-        )  # [Q,K,C]
-        reclaim_cells = reclaim_leaf & ~victim_on_cell
-        gid_cur, gl_cur, gmask_cur = _group_cursor_inputs(queues, q_idx, cur)
-        chosen, preempt_k, walk_pending, walk_next = _group_walk(
-            gid_cur, gl_cur, gmask_cur, heads.valid, fit_cells, pot_cells,
-            reclaim_cells, borrow_cells, queues.ffb, queues.ffp,
-        )
-        eff_k = jnp.where(chosen >= 0, chosen, preempt_k)
-        eff_safe = jnp.maximum(eff_k, 0)
-        head_borrow = jnp.take_along_axis(
-            borrows_wk, eff_safe[:, None], axis=1
-        )[:, 0] & (eff_k >= 0)
-        nofit = eff_k < 0
-
-        cells_eff = jnp.take_along_axis(
-            heads.cells, eff_safe[:, None, None], axis=1
-        )[:, 0]  # [Q, C]
-        qty_eff = jnp.take_along_axis(heads.qty, eff_safe[:, None, None], axis=1)[:, 0]
-        cell_need = (cells_eff >= 0) & (qty_eff > 0)
-        cq = jnp.maximum(heads.cq_row, 0)
 
         usage0 = usage_tree(tree, guaranteed, local)
+        (is_fit, is_pre, pend, head_borrow, rep_k, walk_next,
+         cells_eff, qty_eff) = _nominate_multi(
+            tree, subtree, guaranteed, local, usage0, queues, q_idx, cur,
+            active, g_start, potential, victims=victims, elig_v=elig_v,
+        )
+        nofit = ~(is_fit | is_pre)
+        cell_need = (cells_eff >= 0) & (qty_eff > 0)
+        cq = jnp.maximum(queues.cq_rows, 0)
 
         # ---- batched victim search for preempt-classified heads ----
         # victim usage gathered at the head's candidate cells: the fit
@@ -687,7 +777,7 @@ def solve_drain_preempt(
         vq_at = jnp.sum(
             jnp.where(match, victims.vqty[:, :, :, None], 0), axis=2
         )  # [Q, V, C]
-        is_pre_head = active & (chosen < 0) & (preempt_k >= 0) & victims.can_preempt
+        is_pre_head = is_pre & victims.can_preempt
         # candidate filter: the shared priority predicate above +
         # uses-a-needed-flavor-resource
         uses = jnp.any(vq_at * cell_need[:, None, :].astype(jnp.int64) > 0, axis=2)
@@ -709,8 +799,8 @@ def solve_drain_preempt(
         # evict; failed ones reserve) ----
         order = jnp.lexsort(
             (
-                heads.timestamp,
-                -heads.priority,
+                ts,
+                -prio,
                 head_borrow.astype(jnp.int64),
                 nofit.astype(jnp.int64),
             )
@@ -763,14 +853,13 @@ def solve_drain_preempt(
                 tree.borrowing_limit, max_depth,
             )
             fits = jnp.all(jnp.where(cell_valid, avail >= qty_, True), axis=1)
-            admit = act & (chosen[hidx] >= 0) & fits
+            admit = act & is_fit[hidx] & fits
             pre_ok = pre_ & fits
             reserve = (
                 act
-                & (chosen[hidx] < 0)
-                & (preempt_k[hidx] >= 0)
+                & is_pre[hidx]
                 & ~psuccess[hidx]
-                & heads.no_reclaim[hidx]
+                & queues.no_reclaim[hidx]
             )
             nominal_c = tree.nominal[cqs[:, None], ccells]
             bl_c = tree.borrowing_limit[cqs[:, None], ccells]
@@ -845,7 +934,9 @@ def solve_drain_preempt(
 
         # ---- queue motion ----
         adm_k = adm_k.at[q_idx, cur].set(
-            jnp.where(admitted & active, chosen, adm_k[q_idx, cur])
+            jnp.where(
+                (admitted & active)[:, None], rep_k, adm_k[q_idx, cur]
+            )
         )
         adm_cycle = adm_cycle.at[q_idx, cur].set(
             jnp.where(admitted & active, cycle, adm_cycle[q_idx, cur])
@@ -856,16 +947,25 @@ def solve_drain_preempt(
         # fits() re-check — requeue immediately (FAILED_AFTER_NOMINATION,
         # scheduler._requeue_and_update) and stay pending.
         pre_skipped = psuccess & ~preempt_ok
-        pend = walk_pending & (preempt_k >= 0)  # NoFit heads never pend
+        # stuck-queue freeze (see solve_drain): non-converging
+        # PendingFlavors loops keep nominating (their reservations
+        # still shape other queues) but stop counting toward
+        # termination; their undecided entries report as fallback
+        over_budget = retries >= queues.retry_cap
+        stuck = stuck | (
+            active & (~is_fit) & ~preempt_ok & ~pre_skipped & pend
+            & over_budget
+        )
         retrying = (
-            active & (chosen < 0) & ~preempt_ok & ~pre_skipped & pend
+            active & (~is_fit) & ~preempt_ok & ~pre_skipped & pend
+            & ~stuck
         )
         new_entry_status = jnp.where(
             admitted,
             2,
             jnp.where(
                 active
-                & (chosen < 0)
+                & (~is_fit)
                 & ~preempt_ok
                 & ~pre_skipped
                 & ~pend,
@@ -873,6 +973,24 @@ def solve_drain_preempt(
                 0,
             ),
         )  # per-queue head status
+        head_advanced = active & (new_entry_status != 0)
+        # a resolving head (admit/park) un-sticks its queue — the host
+        # spinner would pick up the same state change
+        stuck = stuck & ~head_advanced
+        retries = jnp.where(
+            head_advanced | ~active,
+            0,
+            jnp.where(retrying, retries + 1, retries),
+        )
+        # global stagnation guard (see solve_drain): starved heads that
+        # never advance behind frozen reservations are no-decisions
+        any_prog = jnp.any(head_advanced) | jnp.any(newly_evicted)
+        no_prog = jnp.where(any_prog, 0, no_prog + 1)
+        stuck = stuck | (
+            (no_prog >= 2 * jnp.max(queues.retry_cap))
+            & active
+            & ~head_advanced
+        )
         status = status.at[q_idx, cur].set(
             jnp.where(active, new_entry_status, status[q_idx, cur])
         )
@@ -889,39 +1007,45 @@ def solve_drain_preempt(
             seg_released[:, None] & (status == 1), 0, status
         )
 
-        lost = active & (chosen >= 0) & (~admitted)
+        lost = active & is_fit & (~admitted)
         walk_reset = (
-            admitted | (active & (chosen < 0) & ~retrying) | preempt_ok
+            admitted | (active & (~is_fit) & ~retrying) | preempt_ok
         )
         g_start = jnp.where(
-            walk_reset[:, None],
+            walk_reset[:, None, None],
             0,
-            jnp.where((lost | retrying)[:, None], walk_next, g_start),
+            jnp.where((lost | retrying)[:, None, None], walk_next, g_start),
         ).astype(jnp.int32)
         return (
-            local, status, g_start, adm_k, adm_cycle,
-            vevicted, evict_cycle, cycle + 1,
+            local, status, g_start, retries, stuck, no_prog, adm_k,
+            adm_cycle, vevicted, evict_cycle, cycle + 1,
         )
 
     def cond(state):
-        _, status, _, _, _, _, _, cycle = state
-        has_pending = jnp.any((status == 0) & (l_idx[None, :] < queues.qlen[:, None]))
+        _, status, _, _, stuck, _, _, _, _, _, cycle = state
+        has_pending = jnp.any(
+            (status == 0)
+            & (l_idx[None, :] < queues.qlen[:, None])
+            & ~stuck[:, None]
+        )
         return has_pending & (cycle < max_cycles)
 
     g = queues.gidx.shape[-1]
     init = (
         local_usage,
         jnp.zeros((q, l), dtype=jnp.int32),
-        jnp.zeros((q, g), dtype=jnp.int32),
-        jnp.full((q, l), -1, dtype=jnp.int32),
+        jnp.zeros((q, pmax, g), dtype=jnp.int32),
+        jnp.zeros(q, dtype=jnp.int32),
+        jnp.zeros(q, dtype=bool),
+        jnp.int32(0),
+        jnp.full((q, l, pmax), -1, dtype=jnp.int32),
         jnp.full((q, l), -1, dtype=jnp.int32),
         jnp.zeros((q, v), dtype=bool),
         jnp.full((q, v), -1, dtype=jnp.int32),
         jnp.int32(0),
     )
-    (local_f, status_f, _, adm_k, adm_cycle, vevicted, evict_cycle, cycles) = (
-        lax.while_loop(cond, cycle_body, init)
-    )
+    (local_f, status_f, _, _, stuck_f, _, adm_k, adm_cycle, vevicted,
+     evict_cycle, cycles) = lax.while_loop(cond, cycle_body, init)
     return PreemptDrainResult(
         status=status_f,
         admitted_k=adm_k,
@@ -930,6 +1054,7 @@ def solve_drain_preempt(
         evicted_cycle=evict_cycle,
         cycles=cycles,
         local_usage=local_f,
+        stuck=stuck_f,
     )
 
 
@@ -947,6 +1072,7 @@ def _solve_drain_preempt_packed(
             r.admitted_cycle.reshape(-1),
             r.evicted.astype(jnp.int32).reshape(-1),
             r.evicted_cycle.reshape(-1),
+            r.stuck.astype(jnp.int32),
             r.cycles[None],
         ]
     )
@@ -971,6 +1097,7 @@ def _solve_drain_packed(
             r.admitted_k.reshape(-1),
             r.admitted_cycle.reshape(-1),
             r.cursor,
+            r.stuck.astype(jnp.int32),
             r.cycles[None],
         ]
     )

@@ -1018,3 +1018,216 @@ class TestPreemptDrainFungibility:
         assert da == ha
         assert de == he
         assert dp == hp
+
+
+def host_drain_trace_multi(spec):
+    """Host truth with per-podset flavor maps: single-podset workloads
+    keep the flat {resource: flavor}; multi-podset ones nest by podset
+    name — the same shapes the device outcome mapping produces.
+
+    Returns (admitted, parked, undecided): at quiescence heaps are
+    empty, so heap leftovers exist only when the cycle cap was hit —
+    a PendingFlavors retry loop that never converges (the reference's
+    immediate-requeue machinery spins identically,
+    cluster_queue.go:231); those entries are no-decision, which the
+    device drain reports as fallback after ITS cycle cap."""
+    sched, mgr, cache, _ = build_env(spec, use_solver=False)
+    admitted = {}
+    cycle = 0
+    for _ in range(300):
+        if not any(
+            pq.pending_active() > 0 for pq in mgr.cluster_queues.values()
+        ):
+            break
+        res = sched.schedule()
+        for e in res.admitted:
+            psas = e.workload.admission.pod_set_assignments
+            if len(psas) == 1:
+                fl = dict(psas[0].flavors)
+            else:
+                fl = {psa.name: dict(psa.flavors) for psa in psas}
+            admitted[e.workload.name] = (fl, cycle)
+        cycle += 1
+    parked = {
+        wl.name
+        for pq in mgr.cluster_queues.values()
+        for wl in pq.inadmissible.values()
+    }
+    undecided = {
+        wl.name
+        for pq in mgr.cluster_queues.values()
+        for wl in pq.heap.items()
+    }
+    return admitted, parked, undecided
+
+
+def multi_podset_spec(seed, n_cohorts=2, cqs_per_cohort=3, workloads_per_cq=5):
+    """Driver+worker style workloads: 2-3 podsets per workload sharing
+    (flavor, resource) cells, so podset nominations couple through
+    assignment_usage exactly like the host's sequential walk."""
+    rng = np.random.default_rng(seed)
+    flavors = ["fa", "fb"]
+    cqs, workloads = [], []
+    t = 0.0
+    for ci in range(n_cohorts):
+        for qi in range(cqs_per_cohort):
+            name = f"cq-{ci}-{qi}"
+            k = int(rng.integers(1, 3))
+            fls = [
+                (f, {"cpu": str(int(rng.integers(8, 20)))},
+                 str(int(rng.integers(0, 10))) if rng.random() < 0.4 else None,
+                 None)
+                for f in flavors[:k]
+            ]
+            cqs.append({
+                "name": name,
+                "cohort": f"cohort-{ci}",
+                "groups": [{"resources": ["cpu"], "flavors": fls}],
+                "preemption": None,
+            })
+            for wi in range(workloads_per_cq):
+                t += 1.0
+                npods = int(rng.integers(1, 4))
+                pod_sets = [
+                    {
+                        "name": ["driver", "worker", "aux"][pp],
+                        "count": int(rng.integers(1, 3)),
+                        "requests": {"cpu": str(int(rng.integers(1, 5)))},
+                    }
+                    for pp in range(npods)
+                ]
+                workloads.append({
+                    "name": f"wl-{ci}-{qi}-{wi}",
+                    "queue": f"lq-{name}",
+                    "prio": int(rng.integers(0, 4)) * 10,
+                    "t": t,
+                    "pod_sets": pod_sets,
+                })
+    return {"flavors": flavors, "cqs": cqs, "workloads": workloads}
+
+
+class TestDrainMultiPodset:
+    """Multi-podset workloads on the device drain: podsets nominate
+    sequentially with assignment_usage coupling at shared cells
+    (previously every multi-podset head routed to the host fallback)."""
+
+    @pytest.mark.parametrize("seed", range(16))
+    def test_randomized_parity(self, seed):
+        spec = multi_podset_spec(seed)
+        host_admitted, host_parked, undecided = host_drain_trace_multi(spec)
+        dev_admitted, dev_parked, outcome = device_drain_trace(spec)
+        assert dev_admitted == host_admitted
+        assert dev_parked == host_parked
+        # non-converging PendingFlavors retry loops spin forever on the
+        # host (the reference's immediate-requeue does the same until
+        # external events change state); the drain freezes the stuck
+        # queue — the head keeps nominating so its reservations still
+        # shape other queues — and reports its entries as no-decision
+        assert {wl.name for wl, _ in outcome.fallback} == undecided
+        assert host_admitted
+
+    def test_podsets_share_cells(self):
+        # driver 3cpu + workers 2x2cpu = 7 > fa's 8? fits; the SECOND
+        # workload's driver alone would fit fa but the sum must spill:
+        # assignment_usage coupling decides flavors per podset
+        spec = {
+            "flavors": ["fa", "fb"],
+            "cqs": [{
+                "name": "cq",
+                "cohort": "co",
+                "groups": [{"resources": ["cpu"], "flavors": [
+                    ("fa", {"cpu": "8"}, None, None),
+                    ("fb", {"cpu": "100"}, None, None),
+                ]}],
+                "preemption": None,
+            }],
+            "workloads": [
+                {
+                    "name": f"w{i}",
+                    "queue": "lq-cq",
+                    "prio": 0,
+                    "t": float(i),
+                    "pod_sets": [
+                        {"name": "driver", "count": 1,
+                         "requests": {"cpu": "3"}},
+                        {"name": "worker", "count": 2,
+                         "requests": {"cpu": "2"}},
+                    ],
+                }
+                for i in range(3)
+            ],
+        }
+        host_admitted, host_parked, undecided = host_drain_trace_multi(spec)
+        dev_admitted, dev_parked, outcome = device_drain_trace(spec)
+        assert not outcome.fallback and not undecided
+        assert dev_admitted == host_admitted
+        assert dev_parked == host_parked
+
+
+class TestPreemptDrainMultiPodset:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_randomized_parity(self, seed):
+        # multi-podset pending workloads over saturated single-podset
+        # victims (within-CQ preemption)
+        rng = np.random.default_rng(seed)
+        spec = multi_rg_preempt_spec(seed, n_cqs=3)
+        for w in spec["workloads"]:
+            if rng.random() < 0.6:
+                w["pod_sets"].append({
+                    "name": "worker",
+                    "count": int(rng.integers(1, 3)),
+                    "requests": {"cpu": str(int(rng.integers(1, 4)))},
+                })
+        ha, he, hp = host_preempt_drain_trace(spec)
+        da, de, dp, outcome = device_preempt_drain_trace(spec)
+        assert not outcome.fallback
+        assert set(da) == set(ha)
+        assert de == he
+        assert dp == hp
+
+
+def test_retry_cap_scales_with_walk_odometer():
+    """The stuck-detection budget must cover any CONVERGENT
+    PendingFlavors sequence: prod over podsets and resource groups of
+    (walk length + 1), not a flat multiple of K."""
+    from kueue_tpu.core.drain import plan_drain
+    from tests.test_solver_path import build_env
+
+    spec = {
+        "flavors": ["f0", "f1", "f2", "f3", "g0", "g1"],
+        "cqs": [{
+            "name": "cq",
+            "cohort": "co",
+            "groups": [
+                {"resources": ["cpu"], "flavors": [
+                    (f, {"cpu": "4"}, None, None) for f in ["f0", "f1", "f2", "f3"]
+                ]},
+                {"resources": ["gpu"], "flavors": [
+                    (g, {"gpu": "2"}, None, None) for g in ["g0", "g1"]
+                ]},
+            ],
+            "preemption": None,
+        }],
+        "workloads": [
+            {
+                "name": "w-multi", "queue": "lq-cq", "prio": 0, "t": 0.0,
+                "pod_sets": [
+                    {"name": "driver", "count": 1,
+                     "requests": {"cpu": "1", "gpu": "1"}},
+                    {"name": "worker", "count": 1,
+                     "requests": {"cpu": "1"}},
+                ],
+            },
+        ],
+    }
+    sched, mgr, cache, _ = build_env(spec, use_solver=False)
+    pending = [
+        (wl, cqn.replace("lq-", ""))
+        for cqn, pq in mgr.cluster_queues.items()
+        for wl in pq.snapshot_sorted()
+    ]
+    pending = [(wl, "cq") for wl, _ in pending]
+    snap = take_snapshot(cache)
+    plan = plan_drain(snap, pending, cache.flavors)
+    # driver: (4+1)*(2+1)=15; worker: (4+1)=5 -> joint odometer 75 (+1)
+    assert int(plan.queues_np["retry_cap"][0]) == 76
