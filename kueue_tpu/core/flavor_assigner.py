@@ -469,35 +469,37 @@ def find_max_counts(
     assign_fn: Callable[[Sequence[int]], AssignmentResult],
     wl: Workload,
 ) -> Optional[List[int]]:
-    """Partial-admission search (podset_reducer.go:64-90).
-
-    Binary search over a global scale-down fraction applied to every
-    podset between minCount and count, looking for the largest counts
-    whose assignment mode is Fit.
-    """
+    """Partial-admission search, mirroring the reference's reducer
+    exactly (podset_reducer.go:56-86): scale DOWN from the full counts
+    by ``delta_j * i / totalDelta`` and binary-search the smallest
+    reduction index i whose assignment fits — the per-unit granularity
+    makes the found total exact (e.g. the reducer's 150k-pod cases),
+    where a fixed-denominator fraction would under-shoot."""
     full = [effective_podset_count(wl, ps) for ps in wl.pod_sets]
     mins = [
         ps.min_count if ps.min_count is not None else effective_podset_count(wl, ps)
         for ps in wl.pod_sets
     ]
-    if full == mins:
+    deltas = [f - m for f, m in zip(full, mins)]
+    total_delta = sum(deltas)
+    if total_delta == 0:
         return None
 
-    def counts_at(fraction_milli: int) -> List[int]:
-        return [
-            max(m, min(f, m + (f - m) * fraction_milli // 1000))
-            for m, f in zip(mins, full)
-        ]
+    def counts_at(i: int) -> List[int]:
+        return [f - d * i // total_delta for f, d in zip(full, deltas)]
 
-    if assign_fn(counts_at(0)).representative_mode() != Mode.FIT:
-        return None
-    lo, hi = 0, 1000  # counts_at(lo) fits; probe upward
-    if assign_fn(counts_at(hi)).representative_mode() == Mode.FIT:
-        return counts_at(hi)
-    while hi - lo > 1:
+    # Go sort.Search: smallest i in [0, totalDelta] with fit(i); the
+    # last-good check detects a non-monotone predicate the same way the
+    # reference's `idx == lastGoodIdx` does
+    last_good = -1
+    lo, hi = 0, total_delta + 1
+    while lo < hi:
         mid = (lo + hi) // 2
         if assign_fn(counts_at(mid)).representative_mode() == Mode.FIT:
-            lo = mid
-        else:
+            last_good = mid
             hi = mid
+        else:
+            lo = mid + 1
+    if lo > total_delta or lo != last_good:
+        return None
     return counts_at(lo)

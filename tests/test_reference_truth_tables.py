@@ -1475,3 +1475,84 @@ class TestTASPlacementParity:
                 tas_request(2, RACK, mode="Preferred"), {})
         assert reason == ""
         assert domains_of(ta) == [(("b2", "r1"), 1), (("b2", "r2"), 1)]
+
+
+# ---------------------------------------------------------------------------
+# Partial-admission reducer truth tables (podset_reducer_test.go
+# TestSearch): the binary search over scaled-down podset counts must
+# find the reference's exact totals, including the 150k-pod
+# granularity cases.
+# ---------------------------------------------------------------------------
+
+from kueue_tpu.core.flavor_assigner import (
+    AssignmentResult as _AR,
+    PodSetResult as _PSR,
+    find_max_counts,
+)
+from kueue_tpu.core.flavor_assigner import GranularMode as _GM
+from kueue_tpu.core.flavor_assigner import FlavorChoice as _FC
+
+
+def _reduce(pod_sets, count_limit):
+    """Drive find_max_counts with the reference's fits predicate:
+    total scaled count <= countLimit. pod_sets: [(count, min|None)]."""
+    wl = Workload(
+        namespace="ns", name="w", queue_name="lq",
+        pod_sets=tuple(
+            PodSet.build(f"ps{i}", cnt, {"cpu": "1"},
+                         min_count=mn)
+            for i, (cnt, mn) in enumerate(pod_sets)
+        ),
+    )
+
+    def assign_fn(counts):
+        fit = sum(counts) <= count_limit
+        mode = _GM.FIT if fit else _GM.NO_FIT
+        psrs = [
+            _PSR(name=f"ps{i}", count=c,
+                 flavors={"cpu": _FC("f", mode)} if fit else {},
+                 reasons=[] if fit else ["over limit"])
+            for i, c in enumerate(counts)
+        ]
+        return _AR(pod_sets=psrs)
+
+    res = find_max_counts(assign_fn, wl)
+    if res is None:
+        return False, 0
+    return True, sum(res)
+
+
+class TestPodSetReducerParity:
+    """podset_reducer_test.go TestSearch, case names preserved (the
+    'empty' case is unrepresentable: the Workload model requires >= 1
+    podSet, matching the CRD's minItems)."""
+
+    def test_partial_not_available(self):
+        found, _ = _reduce([(1, None), (2, 2)], 2)
+        assert not found
+
+    def test_partial_available(self):
+        found, total = _reduce([(5, 3), (5, 4), (5, 1), (5, 2)], 15)
+        assert found and total == 15
+
+    def test_one_partial_available(self):
+        found, total = _reduce([(5, 3), (5, None), (5, None), (5, None)], 19)
+        assert found and total == 19
+
+    def test_to_min(self):
+        found, total = _reduce([(5, 3), (5, 4), (5, 1), (5, 2)], 10)
+        assert found and total == 10
+
+    def test_to_max(self):
+        found, total = _reduce([(5, 3), (5, 4), (5, 1), (5, 2)], 20)
+        assert found and total == 20
+
+    def test_no_overflow(self):
+        found, total = _reduce([(150_000, 1)] * 8, 150_000)
+        assert found and total == 150_000
+
+    def test_max_pods_on_127(self):
+        found, total = _reduce(
+            [(150_000, 1)] + [(1, None)] * 7, 150_000
+        )
+        assert found and total == 150_000
