@@ -37,7 +37,7 @@ from kueue_tpu.models import (
 )
 from kueue_tpu.models.constants import FlavorFungibilityPolicy
 from kueue_tpu.models.workload import PodSet
-from kueue_tpu.resources import FlavorResource, parse_quantity
+from kueue_tpu.resources import FlavorResource, parse_quantity, quantity_to_int
 
 Mi = 2**20
 Gi = 2**30
@@ -1556,3 +1556,237 @@ class TestPodSetReducerParity:
             [(150_000, 1)] + [(1, None)] * 7, 150_000
         )
         assert found and total == 150_000
+
+
+# ---------------------------------------------------------------------------
+# DominantResourceShare truth tables (pkg/cache/fair_sharing_test.go
+# TestDominantResourceShare): exact weighted-share values and dominant
+# resources per node, including hierarchical cohorts, weights
+# (integer/decimal/zero), lending and borrowing limits.
+# ---------------------------------------------------------------------------
+
+from kueue_tpu.ops.quota import DRS_MAX
+from kueue_tpu.ops.quota_np import dominant_resource_share_np
+
+
+def _drs_env(cqs, cohorts=(), usage=None, wl_req=None):
+    """usage: {cq_name: {(flavor, resource): qty}} charged via admitted
+    workloads; wl_req: {(flavor, resource): qty} added for the first
+    CQ (the reference's flvResQ incoming-workload usage). Returns
+    {node name: (weighted share, dominant resource or None)}."""
+    cache = Cache()
+    for f in ("default", "on-demand", "spot"):
+        cache.add_or_update_flavor(ResourceFlavor(name=f))
+    for c in cohorts:
+        cache.add_or_update_cohort(c)
+    for cq in cqs:
+        cache.add_or_update_cluster_queue(cq)
+    n = 0
+    for cq_name, charge in (usage or {}).items():
+        for (flavor, resource), qty in charge.items():
+            n += 1
+            wl = Workload(
+                namespace="ns", name=f"u{n}", queue_name="lq",
+                pod_sets=(PodSet.build("main", 1, {resource: qty}),),
+            )
+            wl.admission = make_admission(
+                cq_name, {"main": {resource: flavor}}, wl
+            )
+            cache.add_or_update_workload(wl)
+    snap = take_snapshot(cache)
+    nrows, nfr = snap.local_usage.shape
+    wl_mat = np.zeros((nrows, nfr), dtype=np.int64)
+    if wl_req:
+        r0 = snap.row(cqs[0].name)
+        for (flavor, resource), qty in wl_req.items():
+            j = snap.fr_index[FlavorResource(flavor, resource)]
+            wl_mat[r0, j] = quantity_to_int(resource, qty)
+    lm = snap.flat.level_masks()
+    dws, dom = dominant_resource_share_np(
+        snap.flat.parent, lm, snap.subtree, snap.guaranteed,
+        snap.borrowing_limit, snap.usage(), wl_mat, snap.weight_milli,
+        snap.resource_index, len(snap.resource_names),
+    )
+    out = {}
+    for name in [c.name for c in cqs] + [c.name for c in cohorts]:
+        r = snap.row(name)
+        d = int(dom[r])
+        out[name] = (
+            int(dws[r]),
+            snap.resource_names[d] if d >= 0 else None,
+        )
+    return out
+
+
+def _drs_cq(name, quotas, cohort="test-cohort", weight=1000):
+    return ClusterQueue(
+        name=name, cohort=cohort, namespace_selector={},
+        resource_groups=(rg(FlavorQuotas.build("default", quotas)),),
+        fair_sharing=FairSharing(weight_milli=weight),
+    )
+
+
+import numpy as np
+
+from kueue_tpu.models.cluster_queue import FairSharing
+
+
+class TestDominantResourceShareParity:
+    """fair_sharing_test.go TestDominantResourceShare, names preserved."""
+
+    def test_no_cohort(self):
+        cq = _drs_cq("cq", {"cpu": "2000", "example.com/gpu": "5"},
+                     cohort=None)
+        got = _drs_env([cq], usage={"cq": {
+            ("default", "cpu"): "1", ("default", "example.com/gpu"): "2"}})
+        assert got["cq"] == (0, None)
+
+    def _pair(self, cq_quotas, lending_quotas, usage, wl_req=None,
+              weight=1000, lending_weight=1000):
+        cq = _drs_cq("cq", cq_quotas, weight=weight)
+        lend = _drs_cq("lending-cq", lending_quotas, weight=lending_weight)
+        cohorts = [Cohort(name="test-cohort")]
+        return _drs_env([cq, lend], cohorts, usage=usage, wl_req=wl_req)
+
+    def test_usage_below_nominal(self):
+        got = self._pair(
+            {"cpu": "2", "example.com/gpu": "5"},
+            {"cpu": "8", "example.com/gpu": "5"},
+            {"cq": {("default", "cpu"): "1",
+                    ("default", "example.com/gpu"): "2"}},
+        )
+        assert got["cq"] == (0, None)
+        assert got["lending-cq"] == (0, None)
+        assert got["test-cohort"] == (0, None)
+
+    def test_usage_above_nominal(self):
+        got = self._pair(
+            {"cpu": "2", "example.com/gpu": "5"},
+            {"cpu": "8", "example.com/gpu": "5"},
+            {"cq": {("default", "cpu"): "3",
+                    ("default", "example.com/gpu"): "7"}},
+        )
+        assert got["cq"] == (200, "example.com/gpu")  # (7-5)*1000/10
+        assert got["lending-cq"] == (0, None)
+        assert got["test-cohort"] == (0, None)
+
+    def test_one_resource_above_nominal(self):
+        got = self._pair(
+            {"cpu": "2", "example.com/gpu": "5"},
+            {"cpu": "8", "example.com/gpu": "5"},
+            {"cq": {("default", "cpu"): "3",
+                    ("default", "example.com/gpu"): "3"}},
+        )
+        assert got["cq"] == (100, "cpu")  # (3-2)*1000/10
+
+    def test_usage_with_workload_above_nominal(self):
+        got = self._pair(
+            {"cpu": "2", "example.com/gpu": "5"},
+            {"cpu": "8", "example.com/gpu": "5"},
+            {"cq": {("default", "cpu"): "1",
+                    ("default", "example.com/gpu"): "2"}},
+            wl_req={("default", "cpu"): "4",
+                    ("default", "example.com/gpu"): "4"},
+        )
+        assert got["cq"] == (300, "cpu")  # (1+4-2)*1000/10
+
+    def test_resource_with_zero_lendable(self):
+        got = self._pair(
+            {"cpu": "2", "example.com/gpu": ("2", None, "0")},
+            {"cpu": "8", "example.com/gpu": ("64", None, "0")},
+            {"cq": {("default", "cpu"): "1",
+                    ("default", "example.com/gpu"): "1"}},
+            wl_req={("default", "cpu"): "4",
+                    ("default", "example.com/gpu"): "4"},
+        )
+        assert got["cq"] == (300, "cpu")  # gpu lendable is zero
+
+    def test_multiple_flavors(self):
+        cq = ClusterQueue(
+            name="cq", cohort="test-cohort", namespace_selector={},
+            resource_groups=(rg(
+                FlavorQuotas.build("on-demand", {"cpu": "20"}),
+                FlavorQuotas.build("spot", {"cpu": "80"}),
+            ),),
+        )
+        lend = ClusterQueue(
+            name="lending-cq", cohort="test-cohort", namespace_selector={},
+            resource_groups=(rg(
+                FlavorQuotas.build("on-demand", {"cpu": "100"}),
+            ),),
+        )
+        got = _drs_env(
+            [cq, lend], [Cohort(name="test-cohort")],
+            usage={"cq": {("on-demand", "cpu"): "15", ("spot", "cpu"): "5"}},
+            wl_req={("on-demand", "cpu"): "10"},
+        )
+        assert got["cq"] == (25, "cpu")  # ((15+10-20)+0)*1000/200
+
+    def test_above_nominal_with_integer_weight(self):
+        got = self._pair(
+            {"example.com/gpu": "5"},
+            {"example.com/gpu": "5"},
+            {"cq": {("default", "example.com/gpu"): "7"}},
+            weight=2000,
+        )
+        assert got["cq"] == (100, "example.com/gpu")  # ((7-5)*1000/10)/2
+
+    def test_above_nominal_with_decimal_weight(self):
+        got = self._pair(
+            {"example.com/gpu": "5"},
+            {"example.com/gpu": "5"},
+            {"cq": {("default", "example.com/gpu"): "7"}},
+            weight=500,
+        )
+        assert got["cq"] == (400, "example.com/gpu")  # ((7-5)*1000/10)/0.5
+
+    def test_above_nominal_with_zero_weight(self):
+        got = self._pair(
+            {"example.com/gpu": "5"},
+            {"example.com/gpu": "10"},
+            {"cq": {("default", "example.com/gpu"): "7"}},
+            weight=0,
+        )
+        assert got["cq"] == (DRS_MAX, "example.com/gpu")
+
+    def test_cohort_has_resource_share(self):
+        cq = _drs_cq("cq", {"example.com/gpu": "5"}, cohort="child-cohort")
+        cohorts = [
+            Cohort(name="child-cohort", parent="root",
+                   fair_sharing=FairSharing(weight_milli=2000)),
+            Cohort(name="root", resource_groups=(
+                rg(FlavorQuotas.build("default", {"example.com/gpu": "45"})),)),
+        ]
+        got = _drs_env([cq], cohorts,
+                       usage={"cq": {("default", "example.com/gpu"): "10"}})
+        assert got["cq"] == (100, "example.com/gpu")  # (5/50)*1000
+        assert got["child-cohort"] == (50, "example.com/gpu")  # /2
+        assert got["root"] == (0, None)
+
+    def test_resource_share_only_at_root(self):
+        cq = _drs_cq("cq", {"example.com/gpu": "0"}, cohort="child-cohort")
+        cohorts = [
+            Cohort(name="child-cohort", parent="root",
+                   fair_sharing=FairSharing(weight_milli=2000)),
+            Cohort(name="root", resource_groups=(
+                rg(FlavorQuotas.build("default", {"example.com/gpu": "50"})),)),
+        ]
+        got = _drs_env([cq], cohorts,
+                       usage={"cq": {("default", "example.com/gpu"): "10"}})
+        assert got["cq"] == (200, "example.com/gpu")  # (10/50)*1000
+        assert got["child-cohort"] == (100, "example.com/gpu")
+
+    def test_resource_share_affected_by_borrowing_limit(self):
+        cq = _drs_cq("cq", {"example.com/gpu": "0"}, cohort="child-cohort")
+        cohorts = [
+            Cohort(name="child-cohort", parent="root", resource_groups=(
+                rg(FlavorQuotas.build(
+                    "default", {"example.com/gpu": ("0", "10", None)})),)),
+            Cohort(name="root", resource_groups=(
+                rg(FlavorQuotas.build("default", {"example.com/gpu": "50"})),)),
+        ]
+        got = _drs_env([cq], cohorts,
+                       usage={"cq": {("default", "example.com/gpu"): "10"}})
+        assert got["cq"] == (1000, "example.com/gpu")  # (10/10)*1000
+        assert got["child-cohort"] == (200, "example.com/gpu")  # (10/50)*1000
+        assert got["root"] == (0, None)
