@@ -46,12 +46,7 @@ class Lowered:
     priority: np.ndarray  # int64[W]
     timestamp: np.ndarray  # int64[W] (ns)
     no_reclaim: np.ndarray  # bool[W] — reserve capacity when blocked
-    # int8[W,K,C]: resource-group index of each candidate cell (-1 pad)
-    cgrp: np.ndarray = None
-    # bool[W]: the head CQ's fungibility bits (whenCanBorrow == Borrow /
-    # whenCanPreempt == Preempt) — consumed by the drain's group walk
-    ffb: np.ndarray = None
-    ffp: np.ndarray = None
+
     # per head: candidate k -> flavor name chosen per resource group
     candidate_flavors: List[List[Dict[str, str]]] = field(default_factory=list)
     # per head: candidate k -> resource -> host-equivalent tried-flavor
@@ -63,10 +58,7 @@ class Lowered:
     fallback: List[int] = field(default_factory=list)  # indices into input heads
     # per head: number of resource groups its request touches
     n_groups: List[int] = field(default_factory=list)
-    # per head: candidate k -> tuple per resource group of
-    # (flavor index within the group's walk, chose-last flag) — the
-    # drain's per-group candidate-cursor resume (LastAssignment vector)
-    candidate_groups: List[List[tuple]] = field(default_factory=list)
+
 
 
 def _default_fungibility(cq: ClusterQueue) -> bool:
@@ -295,16 +287,11 @@ def lower_heads(
     max_cells: int = 16,
     timestamp_fn=None,
     transform=None,  # ResourceTransformConfig for the quota view
-    any_fungibility=False,  # drain path: policy bits instead of fallback
 ) -> Lowered:
-    """Build the dense head batch; route inexpressible heads to
-    ``fallback`` (handled by the host FlavorAssigner).
-
-    ``any_fungibility=True`` lowers heads of CQs with non-default
-    flavorFungibility too, recording the policy bits (ffb/ffp) for the
-    drain kernels' policy-aware group walk; the interactive cycle path
-    keeps the default-only scope (its phase-1 assumes the default
-    stop-at-first-fit walk).
+    """Build the dense head batch for the INTERACTIVE cycle path; route
+    inexpressible heads to ``fallback`` (handled by the host
+    FlavorAssigner). The drain lowers via lower_heads_multi, which also
+    carries multi-podset, fungibility-policy and cursor-vector inputs.
 
     Candidate enumeration is memoized per (CQ, podset shape, cursor):
     a bulk backlog over 1k CQs lowers in O(templates + heads), not
@@ -316,9 +303,6 @@ def lower_heads(
         cells=np.full((w, k, c), -1, dtype=np.int32),
         qty=np.zeros((w, k, c), dtype=np.int64),
         valid=np.zeros((w, k), dtype=bool),
-        cgrp=np.full((w, k, c), -1, dtype=np.int8),
-        ffb=np.ones(w, dtype=bool),
-        ffp=np.zeros(w, dtype=bool),
         priority=np.zeros(w, dtype=np.int64),
         timestamp=np.zeros(w, dtype=np.int64),
         no_reclaim=np.zeros(w, dtype=bool),
@@ -332,20 +316,14 @@ def lower_heads(
         out.cq_names.append(cq_name)
         out.candidate_flavors.append([])
         out.candidate_tried.append([])
-        out.candidate_groups.append([])
         out.n_groups.append(0)
         if cq_name not in snapshot.cq_models:
             out.fallback.append(i)
             continue
         cq = snapshot.cq_models[cq_name]
-        if len(wl.pod_sets) != 1 or (
-            not any_fungibility and not _default_fungibility(cq)
-        ):
+        if len(wl.pod_sets) != 1 or not _default_fungibility(cq):
             out.fallback.append(i)
             continue
-        ff = cq.flavor_fungibility
-        out.ffb[i] = ff.when_can_borrow == FlavorFungibilityPolicy.BORROW
-        out.ffp[i] = ff.when_can_preempt == FlavorFungibilityPolicy.PREEMPT
         ps = wl.pod_sets[0]
         if ps.topology_request is not None:
             out.fallback.append(i)  # TAS placement stays on the host path
@@ -380,7 +358,6 @@ def lower_heads(
         # shared read-only maps (one list per template, not per head)
         out.candidate_flavors[i] = t.flavor_list
         out.candidate_tried[i] = t.tried_list
-        out.candidate_groups[i] = t.group_list
         # defer the array fills: heads sharing a template batch into ONE
         # numpy op per field instead of four small ops per head (the
         # per-head fills dominated bulk-drain lowering wall time)
@@ -395,7 +372,6 @@ def lower_heads(
         out.cq_row[ii] = t.cq_row
         out.cells[ii] = t.cells_arr
         out.valid[ii] = t.valid_row
-        out.cgrp[ii] = t.cgrp_arr
         # request matrix: rows = heads in this group, cols = the
         # template's resource order (+1 zero column for padding cells)
         rmat = np.zeros((len(ii), len(t.res_names) + 1), dtype=np.int64)
