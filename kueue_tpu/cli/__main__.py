@@ -352,6 +352,40 @@ def cmd_pending_workloads(state: State, args) -> None:
 # ---- schedule ----
 def cmd_schedule(state: State, args) -> None:
     rt = state.build_runtime()
+    if getattr(args, "platform", None):
+        # explicit device selection (some images pin jax_platforms in
+        # sitecustomize, so the env var alone cannot force a backend)
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    if getattr(args, "drain", False):
+        # capacity what-if: the WHOLE pending backlog planned in one
+        # device dispatch (core/drain) and summarized; the cycle loop
+        # below then takes the authoritative decisions (identical by
+        # the drain parity suites, plus it handles fallbacks)
+        from kueue_tpu.core.drain import run_drain
+        from kueue_tpu.core.queue_manager import queue_order_timestamp
+        from kueue_tpu.core.snapshot import take_snapshot
+
+        pending = [
+            (wl, cq_name)
+            for cq_name, pq in rt.queues.cluster_queues.items()
+            for wl in pq.snapshot_sorted()
+        ]
+        outcome = run_drain(
+            take_snapshot(rt.cache),
+            pending,
+            rt.cache.flavors,
+            timestamp_fn=lambda wl: queue_order_timestamp(
+                wl, rt.queues._ts_policy
+            ),
+        )
+        print(
+            f"drain plan: cycles={outcome.cycles} "
+            f"admitted={len(outcome.admitted)} "
+            f"parked={len(outcome.parked)} "
+            f"fallback={len(outcome.fallback)}"
+        )
     for _ in range(args.cycles):
         rt.run_until_idle()
     state.data["workloads"] = [
@@ -507,6 +541,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     sch = sub.add_parser("schedule")
     sch.add_argument("--cycles", type=int, default=1)
+    sch.add_argument(
+        "--drain", action="store_true",
+        help="print a bulk what-if plan (whole backlog in one device "
+        "dispatch) before the cycle loop decides",
+    )
+    sch.add_argument(
+        "--platform", choices=["cpu", "tpu"],
+        help="force the JAX backend for --drain dispatches",
+    )
     sch.set_defaults(fn=cmd_schedule)
 
     imp = sub.add_parser("import")
