@@ -237,8 +237,13 @@ def _nominate_multi(
         real = active & (p < npod)
         cells_p = queues.cells[q_idx, cur, p]  # [Q,K,C]
         qty_p = queues.qty[q_idx, cur, p]
-        accum_at = accum[q_idx[:, None, None], jnp.maximum(cells_p, 0)]
-        infl = qty_p + jnp.where((cells_p >= 0) & (qty_p > 0), accum_at, 0)
+        if p == 0:
+            infl = qty_p  # nothing accumulated yet (static fast path)
+        else:
+            accum_at = accum[q_idx[:, None, None], jnp.maximum(cells_p, 0)]
+            infl = qty_p + jnp.where(
+                (cells_p >= 0) & (qty_p > 0), accum_at, 0
+            )
         fit_cells, pot_cells, reclaim_cells, borrow_cells, cell_need = (
             cell_masks(
                 tree, subtree, guaranteed, local, head_cq, cells_p, infl,
@@ -281,10 +286,12 @@ def _nominate_multi(
         )[:, 0]
         cells_rep = jnp.where(use_p[:, None] & (cells_rep >= 0), cells_rep, -1)
         qty_rep = jnp.where(cells_rep >= 0, qty_rep, 0)
-        # assignment_usage grows for fit AND preempt choices alike
-        accum = accum.at[
-            q_idx[:, None], jnp.maximum(cells_rep, 0)
-        ].add(jnp.where(cells_rep >= 0, qty_rep, 0))
+        if p < pmax - 1:
+            # assignment_usage grows for fit AND preempt choices alike
+            # (skipped after the last podset: nobody reads it)
+            accum = accum.at[
+                q_idx[:, None], jnp.maximum(cells_rep, 0)
+            ].add(jnp.where(cells_rep >= 0, qty_rep, 0))
         borrow_rep = jnp.any(
             jnp.take_along_axis(
                 borrow_cells, rep_safe[:, None, None], axis=1
@@ -305,17 +312,20 @@ def _nominate_multi(
     next_start = jnp.stack(nstart_list, axis=1)  # [Q,P,G]
     mcells = jnp.concatenate(cells_list, axis=1)  # [Q,P*C]
     mqty = jnp.concatenate(qty_list, axis=1)
-    # merge duplicate frs: sum onto the first occurrence, zero the rest
-    # (the host fits()/reserve vectors are per-fr sums)
-    pc = pmax * c
-    pos = jnp.arange(pc)
-    same = (mcells[:, None, :] == mcells[:, :, None]) & (mcells >= 0)[:, None, :]
-    summed = jnp.sum(jnp.where(same, mqty[:, None, :], 0), axis=2)
-    first = ~jnp.any(
-        same & (pos[None, None, :] < pos[None, :, None]), axis=2
-    )
-    mqty = jnp.where(first & (mcells >= 0), summed, 0)
-    mcells = jnp.where(first, mcells, -1)
+    if pmax > 1:
+        # merge duplicate frs: sum onto the first occurrence, zero the
+        # rest (the host fits()/reserve vectors are per-fr sums); a
+        # single candidate's cells are distinct frs by construction, so
+        # P=1 skips this entirely
+        pc = pmax * c
+        pos = jnp.arange(pc)
+        same = (mcells[:, None, :] == mcells[:, :, None]) & (mcells >= 0)[:, None, :]
+        summed = jnp.sum(jnp.where(same, mqty[:, None, :], 0), axis=2)
+        first = ~jnp.any(
+            same & (pos[None, None, :] < pos[None, :, None]), axis=2
+        )
+        mqty = jnp.where(first & (mcells >= 0), summed, 0)
+        mcells = jnp.where(first, mcells, -1)
 
     is_fit = active & (head_mode == 3)
     is_pre = active & (head_mode >= 1) & (head_mode < 3)
