@@ -128,3 +128,88 @@ def test_server_process_lifecycle(tmp_path):
             proc2.wait(timeout=20)
         except subprocess.TimeoutExpired:
             proc2.kill()
+
+
+@pytest.mark.slow
+def test_ha_failover_two_processes(tmp_path):
+    """Two real server processes sharing a lease file: the leader takes
+    writes, the standby serves reads and rejects writes with 503, and
+    after SIGKILL of the leader the standby takes over, reloads the
+    shared checkpoint, and accepts writes."""
+    base = 18700 + os.getpid() % 200
+    state = str(tmp_path / "state.json")
+    lease = str(tmp_path / "leader.lease")
+
+    def spawn(port, ident):
+        return _spawn(port, state, extra=(
+            "--leader-elect-lease", lease,
+            "--leader-elect-identity", ident,
+            "--leader-elect-lease-duration", "2",
+            "--state-checkpoint-period", "1",
+        ))
+
+    p1 = spawn(base, "rep-1")
+    try:
+        _wait_ready(base)
+        p2 = spawn(base + 1, "rep-2")
+        try:
+            _wait_ready(base + 1)
+            r1 = _request(base, "GET", "/readyz")
+            r2 = _request(base + 1, "GET", "/readyz")
+            assert r1["leader"] is True and r2["leader"] is False
+
+            _request(base, "POST", "/apis/kueue/v1beta1/resourceflavors",
+                     {"name": "default", "nodeLabels": {}})
+            # standby rejects writes, naming the holder
+            try:
+                _request(base + 1, "POST",
+                         "/apis/kueue/v1beta1/resourceflavors",
+                         {"name": "x", "nodeLabels": {}})
+                raise AssertionError("standby accepted a write")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+            # wait until a periodic checkpoint CONTAINING the write
+            # lands (existence alone could be a pre-write snapshot)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                try:
+                    with open(state) as f:
+                        if any(
+                            fl["name"] == "default"
+                            for fl in json.load(f).get("resourceFlavors", [])
+                        ):
+                            break
+                except (OSError, json.JSONDecodeError):
+                    pass
+                time.sleep(0.2)
+            else:
+                raise AssertionError("checkpoint never captured the write")
+            p1.kill()
+            p1.wait(timeout=10)
+            # standby takes over within a few lease durations
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    if _request(base + 1, "GET", "/readyz")["leader"]:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            r2 = _request(base + 1, "GET", "/readyz")
+            assert r2["leader"] is True
+            # promoted standby rebuilt from the checkpoint and takes writes
+            flavors = _request(
+                base + 1, "GET", "/apis/kueue/v1beta1/resourceflavors"
+            )["items"]
+            assert any(f["name"] == "default" for f in flavors)
+            _request(base + 1, "POST", "/apis/kueue/v1beta1/resourceflavors",
+                     {"name": "post-failover", "nodeLabels": {}})
+        finally:
+            p2.send_signal(signal.SIGTERM)
+            try:
+                p2.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p2.kill()
+    finally:
+        if p1.poll() is None:
+            p1.kill()
