@@ -305,8 +305,13 @@ def host_preempt_drain_trace(spec):
             progressed = True  # active heads: the cycle itself is progress
         res = sched.schedule()
         for e in res.admitted:
-            psa = e.workload.admission.pod_set_assignments[0]
-            admitted[e.workload.name] = dict(psa.flavors)
+            psas = e.workload.admission.pod_set_assignments
+            if len(psas) == 1:
+                admitted[e.workload.name] = dict(psas[0].flavors)
+            else:
+                admitted[e.workload.name] = {
+                    psa.name: dict(psa.flavors) for psa in psas
+                }
         victims = []
         for e in res.preempting:
             for t in e.preemption_targets:
@@ -1181,7 +1186,7 @@ class TestPreemptDrainMultiPodset:
         ha, he, hp = host_preempt_drain_trace(spec)
         da, de, dp, outcome = device_preempt_drain_trace(spec)
         assert not outcome.fallback
-        assert set(da) == set(ha)
+        assert da == ha
         assert de == he
         assert dp == hp
 
