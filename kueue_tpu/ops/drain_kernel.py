@@ -548,11 +548,6 @@ def solve_drain(
     )
 
 
-solve_drain_jit = jax.jit(
-    solve_drain, static_argnames=("n_segments", "n_steps", "max_cycles")
-)
-
-
 class VictimPanels(NamedTuple):
     """Per-ClusterQueue admitted-workload (candidate) panels for the
     preemption-enabled drain. V victim slots, Cv cells per victim.
