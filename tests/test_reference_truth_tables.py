@@ -1790,3 +1790,55 @@ class TestDominantResourceShareParity:
         assert got["cq"] == (1000, "example.com/gpu")  # (10/10)*1000
         assert got["child-cohort"] == (200, "example.com/gpu")  # (10/50)*1000
         assert got["root"] == (0, None)
+
+
+class TestSchedulerSameCycleBorrowing:
+    """scheduler_test.go TestSchedule same-cycle borrowing trio: one
+    admission per borrowing cohort per cycle is NOT the rule — multiple
+    borrowers admit together when the cohort quota still fits all of
+    them after in-cycle re-checks."""
+
+    def _borrow_env(self):
+        preemption = Preemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+        )
+        extra = [
+            ClusterQueue(
+                name=f"cq{i}", cohort="co", namespace_selector={},
+                queueing_strategy=QueueingStrategy.STRICT_FIFO,
+                resource_groups=(rg(FlavorQuotas.build("default", {
+                    "r1": ("10", "10", None), "r2": ("10", "10", None)})),),
+                preemption=preemption,
+            )
+            for i in (1, 2, 3)
+        ]
+        return sched_env(extra_cqs=extra)
+
+    def test_two_borrow_different_resources_same_cycle(self):  # :1251
+        sched, mgr, cache, _ = self._borrow_env()
+        sched_pending(mgr, "wl1", "cq1", [PodSet.build("main", 1, {"r1": "16"})],
+                      prio=-1)
+        sched_pending(mgr, "wl2", "cq2", [PodSet.build("main", 1, {"r2": "16"})],
+                      prio=-2)
+        res = sched.schedule()
+        assert admitted_names(res) == ["wl1", "wl2"]
+
+    def test_two_borrow_same_resource_fits_cohort(self):  # :1286
+        sched, mgr, cache, _ = self._borrow_env()
+        sched_pending(mgr, "wl1", "cq1", [PodSet.build("main", 1, {"r1": "16"})],
+                      prio=-1)
+        sched_pending(mgr, "wl2", "cq2", [PodSet.build("main", 1, {"r1": "14"})],
+                      prio=-2)
+        res = sched.schedule()
+        assert admitted_names(res) == ["wl1", "wl2"]
+
+    def test_only_one_borrows_when_cohort_cannot_fit_both(self):  # :1321
+        sched, mgr, cache, _ = self._borrow_env()
+        sched_pending(mgr, "wl1", "cq1", [PodSet.build("main", 1, {"r1": "16"})],
+                      prio=-1)
+        sched_pending(mgr, "wl2", "cq2", [PodSet.build("main", 1, {"r1": "16"})],
+                      prio=-2)
+        res = sched.schedule()
+        assert admitted_names(res) == ["wl1"]
+        assert "ns/wl2" in mgr.cluster_queues["cq2"].heap.keys()
