@@ -1842,3 +1842,43 @@ class TestSchedulerSameCycleBorrowing:
         res = sched.schedule()
         assert admitted_names(res) == ["wl1"]
         assert "ns/wl2" in mgr.cluster_queues["cq2"].heap.keys()
+
+
+def test_preemption_wait_does_not_block_other_borrower():  # :1356
+    """A head blocked on (impossible) preemption reserves capacity but
+    must not keep a DIFFERENT ClusterQueue's borrowing head from
+    admitting when the reservation still leaves room."""
+    from kueue_tpu.models.cluster_queue import BorrowWithinCohort
+
+    prem = Preemption(
+        reclaim_within_cohort=ReclaimWithinCohortPolicy.LOWER_PRIORITY,
+        borrow_within_cohort=BorrowWithinCohort(
+            policy=BorrowWithinCohortPolicy.LOWER_PRIORITY),
+    )
+    extra = [
+        ClusterQueue(
+            name="cq_shared", cohort="pwb", namespace_selector={},
+            resource_groups=(rg(FlavorQuotas.build(
+                "default", {"cpu": ("4", "0", None)})),)),
+        ClusterQueue(
+            name="cq_a", cohort="pwb", namespace_selector={},
+            resource_groups=(rg(FlavorQuotas.build(
+                "default", {"cpu": ("0", "3", None)})),),
+            preemption=prem),
+        ClusterQueue(
+            name="cq_b", cohort="pwb", namespace_selector={},
+            resource_groups=(rg(FlavorQuotas.build(
+                "default", {"cpu": ("0", None, None)})),),
+            preemption=prem),
+    ]
+    sched, mgr, cache, _ = sched_env(extra_cqs=extra)
+    sched_admitted(cache, "admitted_a", "cq_a",
+                   [PodSet.build("main", 1, {"cpu": "2"})],
+                   {"main": {"cpu": "default"}})
+    sched_pending(mgr, "a", "cq_a", [PodSet.build("main", 1, {"cpu": "3"})],
+                  t=NOW + 1)
+    sched_pending(mgr, "b", "cq_b", [PodSet.build("main", 1, {"cpu": "1"})],
+                  t=NOW + 2)
+    res = sched.schedule()
+    assert admitted_names(res) == ["b"]
+    assert "ns/a" in mgr.cluster_queues["cq_a"].inadmissible
