@@ -1882,3 +1882,80 @@ def test_preemption_wait_does_not_block_other_borrower():  # :1356
     res = sched.schedule()
     assert admitted_names(res) == ["b"]
     assert "ns/a" in mgr.cluster_queues["cq_a"].inadmissible
+
+
+class TestSchedulerPreemptionFlavorPreference:
+    """scheduler_test.go: which flavor a preemptor targets when several
+    need preemption — reclaim-only flavors beat within-CQ preemption,
+    and a later flavor that doesn't improve the assignment loses to the
+    first (flavorassigner whenCanPreempt + oracle interplay driving the
+    real cycle, with victims recorded via the preemptor)."""
+
+    def _env(self, beta_preemption=True):
+        prem = Preemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            reclaim_within_cohort=ReclaimWithinCohortPolicy.LOWER_PRIORITY,
+        )
+        extra = [
+            ClusterQueue(
+                name="other-alpha", cohort="other", namespace_selector={},
+                resource_groups=(rg(
+                    FlavorQuotas.build("on-demand", {"gpu": "10"}),
+                    FlavorQuotas.build("spot", {"gpu": "10"}),
+                ),),
+                preemption=prem,
+            ),
+            ClusterQueue(
+                name="other-beta", cohort="other", namespace_selector={},
+                resource_groups=(rg(
+                    FlavorQuotas.build("on-demand", {"gpu": ("0", None, None)}),
+                    FlavorQuotas.build("spot", {"gpu": ("0", None, None)}),
+                ),),
+                preemption=prem if beta_preemption else Preemption(),
+            ),
+        ]
+        return sched_env(extra_cqs=extra)
+
+    def test_prefer_reclamation_over_cq_priority_preemption(self):  # :2655
+        sched, mgr, cache, _ = self._env()
+        sched_admitted(cache, "a1", "other-alpha",
+                       [PodSet.build("main", 1, {"gpu": "5"})],
+                       {"main": {"gpu": "on-demand"}}, prio=50)
+        sched_admitted(cache, "b1", "other-beta",
+                       [PodSet.build("main", 1, {"gpu": "5"})],
+                       {"main": {"gpu": "spot"}}, prio=50)
+        sched_pending(mgr, "preemptor", "other-alpha",
+                      [PodSet.build("main", 1, {"gpu": "6"})], prio=100)
+        res = sched.schedule()
+        # spot only needs reclaiming the borrower b1; on-demand would
+        # preempt a1 in the own CQ — reclaim wins
+        victims = {
+            t.workload.workload.name
+            for e in res.preempting
+            for t in e.preemption_targets
+        }
+        assert victims == {"b1"}
+        assert admitted_names(res) == []
+
+    def test_prefer_first_flavor_when_second_needs_reclaim_and_cq(self):  # :2716
+        sched, mgr, cache, _ = self._env()
+        sched_admitted(cache, "a1", "other-alpha",
+                       [PodSet.build("main", 1, {"gpu": "5"})],
+                       {"main": {"gpu": "on-demand"}}, prio=50)
+        sched_admitted(cache, "a2", "other-alpha",
+                       [PodSet.build("main", 1, {"gpu": "5"})],
+                       {"main": {"gpu": "spot"}}, prio=50)
+        sched_admitted(cache, "b1", "other-beta",
+                       [PodSet.build("main", 1, {"gpu": "5"})],
+                       {"main": {"gpu": "spot"}}, prio=50)
+        sched_pending(mgr, "preemptor", "other-alpha",
+                      [PodSet.build("main", 1, {"gpu": "6"})], prio=100)
+        res = sched.schedule()
+        # spot would need reclaim AND a within-CQ preemption — no
+        # improvement over on-demand's single within-CQ victim
+        victims = {
+            t.workload.workload.name
+            for e in res.preempting
+            for t in e.preemption_targets
+        }
+        assert victims == {"a1"}
