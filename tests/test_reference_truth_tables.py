@@ -1959,3 +1959,81 @@ class TestSchedulerPreemptionFlavorPreference:
             for t in e.preemption_targets
         }
         assert victims == {"a1"}
+
+
+class TestSchedulerMinimalPreemptions:
+    """scheduler_test.go: victim-set minimality and preemption
+    eligibility driven through the real cycle."""
+
+    def test_minimal_preemptions_when_target_queue_exhausted(self):  # :1926
+        prem = Preemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+        )
+        extra = [
+            ClusterQueue(
+                name="other-alpha", cohort="other", namespace_selector={},
+                resource_groups=(rg(FlavorQuotas.build(
+                    "on-demand", {"cpu": "2"})),),
+                preemption=prem),
+            ClusterQueue(
+                name="other-beta", cohort="other", namespace_selector={},
+                resource_groups=(rg(FlavorQuotas.build(
+                    "on-demand", {"cpu": "2"})),)),
+            ClusterQueue(
+                name="other-gamma", cohort="other", namespace_selector={},
+                resource_groups=(rg(FlavorQuotas.build(
+                    "on-demand", {"cpu": "2"})),)),
+        ]
+        sched, mgr, cache, _ = sched_env(extra_cqs=extra)
+        for name, prio in (("a1", -2), ("a2", -2), ("a3", -1)):
+            sched_admitted(cache, name, "other-alpha",
+                           [PodSet.build("main", 1, {"cpu": "1"})],
+                           {"main": {"cpu": "on-demand"}}, prio=prio)
+        for name in ("b1", "b2", "b3"):
+            sched_admitted(cache, name, "other-beta",
+                           [PodSet.build("main", 1, {"cpu": "1"})],
+                           {"main": {"cpu": "on-demand"}}, prio=0)
+        sched_pending(mgr, "incoming", "other-alpha",
+                      [PodSet.build("main", 1, {"cpu": "2"})], prio=0)
+        res = sched.schedule()
+        victims = {
+            t.workload.workload.name
+            for e in res.preempting
+            for t in e.preemption_targets
+        }
+        # minimal set: exactly the two lowest-priority own-CQ victims,
+        # not the newer a3 and none of beta's same-priority workloads
+        assert victims == {"a1", "a2"}
+
+    def test_preemptor_must_fit_within_nominal(self):  # :2015
+        prem = Preemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+        )
+        extra = [
+            ClusterQueue(
+                name="other-alpha", cohort="other", namespace_selector={},
+                resource_groups=(rg(FlavorQuotas.build(
+                    "on-demand", {"cpu": "2"})),),
+                preemption=prem),
+            ClusterQueue(
+                name="other-beta", cohort="other", namespace_selector={},
+                resource_groups=(rg(FlavorQuotas.build(
+                    "on-demand", {"cpu": "2"})),)),
+        ]
+        sched, mgr, cache, _ = sched_env(extra_cqs=extra)
+        sched_admitted(cache, "a1", "other-alpha",
+                       [PodSet.build("main", 1, {"cpu": "1"})],
+                       {"main": {"cpu": "on-demand"}}, prio=-1)
+        sched_admitted(cache, "b1", "other-beta",
+                       [PodSet.build("main", 1, {"cpu": "1"})],
+                       {"main": {"cpu": "on-demand"}}, prio=-1)
+        sched_pending(mgr, "incoming", "other-alpha",
+                      [PodSet.build("main", 1, {"cpu": "3"})], prio=1)
+        res = sched.schedule()
+        # 3 cpu exceeds other-alpha's 2-cpu nominal: no preemption at
+        # all (borrowing preemptors are ineligible), workload parks
+        assert admitted_names(res) == []
+        assert not res.preempting
+        assert "ns/incoming" in mgr.cluster_queues["other-alpha"].inadmissible
