@@ -2037,3 +2037,66 @@ class TestSchedulerMinimalPreemptions:
         assert admitted_names(res) == []
         assert not res.preempting
         assert "ns/incoming" in mgr.cluster_queues["other-alpha"].inadmissible
+
+
+def test_multiple_preemptions_skip_overlapping_targets():  # :2453
+    """Two preemptors targeting the same fair-sharing victim in one
+    cycle: the first (higher priority) issues its preemptions, the
+    second is SKIPPED with the per-CQ skip counter incremented
+    (scheduler.go overlapping-targets rule).
+
+    The reference case leaves ReclaimWithinCohort UNSET, which its
+    undefaulted test fixtures treat as non-Never (fair-sharing
+    preemption proceeds); this model defaults the field like the
+    webhook does, so the port sets it explicitly."""
+    prem = Preemption(
+        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+        reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+    )
+    extra = [
+        ClusterQueue(
+            name="other-alpha", cohort="other", namespace_selector={},
+            resource_groups=(rg(FlavorQuotas.build("default", {
+                "cpu": ("0", None, None), "alpha-resource": "1"})),),
+            preemption=prem),
+        ClusterQueue(
+            name="other-beta", cohort="other", namespace_selector={},
+            resource_groups=(rg(FlavorQuotas.build("default", {
+                "cpu": ("0", None, None), "beta-resource": "1"})),),
+            preemption=prem),
+        ClusterQueue(
+            name="other-gamma", cohort="other", namespace_selector={},
+            resource_groups=(rg(FlavorQuotas.build("default", {
+                "cpu": ("0", None, None), "gamma-resource": "1"})),),
+            preemption=prem),
+        ClusterQueue(
+            name="resource-bank", cohort="other", namespace_selector={},
+            resource_groups=(rg(FlavorQuotas.build("default", {"cpu": "9"})),)),
+    ]
+    sched, mgr, cache, _ = sched_env(extra_cqs=extra, fair=True)
+    sched_admitted(cache, "a1", "other-alpha",
+                   [PodSet.build("main", 1, {"alpha-resource": "1"})],
+                   {"main": {"alpha-resource": "default"}}, prio=0)
+    sched_admitted(cache, "b1", "other-beta",
+                   [PodSet.build("main", 1, {"beta-resource": "1"})],
+                   {"main": {"beta-resource": "default"}}, prio=0)
+    sched_admitted(cache, "c1", "other-gamma",
+                   [PodSet.build("main", 1, {"cpu": "9"})],
+                   {"main": {"cpu": "default"}}, prio=0)
+    sched_pending(mgr, "preemptor", "other-alpha",
+                  [PodSet.build("main", 1,
+                                {"cpu": "3", "alpha-resource": "1"})],
+                  prio=100)
+    sched_pending(mgr, "pretending-preemptor", "other-beta",
+                  [PodSet.build("main", 1,
+                                {"cpu": "3", "beta-resource": "1"})],
+                  prio=99)
+    res = sched.schedule()
+    victims = {
+        t.workload.workload.name
+        for e in res.preempting
+        for t in e.preemption_targets
+    }
+    assert victims == {"a1", "c1"}
+    assert res.skipped_preemptions.get("other-beta") == 1
+    assert not res.skipped_preemptions.get("other-alpha")
