@@ -2100,3 +2100,46 @@ def test_multiple_preemptions_skip_overlapping_targets():  # :2453
     assert victims == {"a1", "c1"}
     assert res.skipped_preemptions.get("other-beta") == 1
     assert not res.skipped_preemptions.get("other-alpha")
+
+
+class TestFairSharingCycleMore:
+    """Two more fair-sharing cycle scenarios from the reference."""
+
+    def test_lowest_drf_after_admission(self):  # :1681
+        cohorts = [Cohort(name="A", resource_groups=(
+            rg(FlavorQuotas.build("on-demand", {"cpu": "100"})),))]
+        zero = {"cpu": ("0", None, None)}
+        extra = [
+            _strict("b", "A", [rg(FlavorQuotas.build("on-demand", zero))]),
+            _strict("c", "A", [rg(FlavorQuotas.build("on-demand", zero))]),
+        ]
+        sched, mgr, cache, _ = sched_env(
+            extra_cqs=extra, cohorts=cohorts, fair=True)
+        sched_admitted(cache, "b0", "b", [PodSet.build("one", 1, {"cpu": "10"})],
+                       {"one": {"cpu": "on-demand"}})
+        sched_pending(mgr, "b1", "b", [PodSet.build("one", 1, {"cpu": "50"})])
+        sched_pending(mgr, "c1", "c", [PodSet.build("one", 1, {"cpu": "75"})])
+        res = sched.schedule()
+        # b0+b1 = 60 < c1's 75: b ends with the lower share, so b1 wins
+        assert admitted_names(res) == ["b1"]
+        assert "ns/c1" in mgr.cluster_queues["c"].heap.keys()
+
+    def test_singleton_cqs_and_no_cohort(self):  # :1751
+        cohorts = [
+            Cohort(name="A", resource_groups=(
+                rg(FlavorQuotas.build("on-demand", {"cpu": "10"})),)),
+            Cohort(name="B"),
+        ]
+        extra = [
+            _strict("a", "A", [rg(FlavorQuotas.build(
+                "on-demand", {"cpu": ("0", None, None)}))]),
+            _strict("b", "B", [rg(FlavorQuotas.build("on-demand", {"cpu": "10"}))]),
+            _strict("c", None, [rg(FlavorQuotas.build("on-demand", {"cpu": "10"}))]),
+        ]
+        sched, mgr, cache, _ = sched_env(
+            extra_cqs=extra, cohorts=cohorts, fair=True)
+        for cq in ("a", "b", "c"):
+            sched_pending(mgr, f"{cq}1", cq,
+                          [PodSet.build("one", 1, {"cpu": "10"})])
+        res = sched.schedule()
+        assert admitted_names(res) == ["a1", "b1", "c1"]
