@@ -2143,3 +2143,39 @@ class TestFairSharingCycleMore:
                           [PodSet.build("one", 1, {"cpu": "10"})])
         res = sched.schedule()
         assert admitted_names(res) == ["a1", "b1", "c1"]
+
+
+def test_no_overadmission_while_borrowing():  # :939
+    """An existing gamma borrower holds 51 on-demand (1 over nominal
+    via borrowing): beta's 50-pod head and alpha's 1-pod head admit on
+    the cohort's remaining capacity while gamma's 50-pod head must NOT
+    overadmit and parks."""
+    prem = Preemption(
+        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+        reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+    )
+    gamma = ClusterQueue(
+        name="eng-gamma", cohort="eng", namespace_selector={},
+        resource_groups=(rg(
+            FlavorQuotas.build("on-demand", {"cpu": ("50", "10", None)}),
+            FlavorQuotas.build("spot", {"cpu": ("0", "100", None)}),
+        ),),
+        preemption=prem,
+    )
+    sched, mgr, cache, _ = sched_env(extra_cqs=[gamma])
+    sched_admitted(
+        cache, "existing", "eng-gamma",
+        [PodSet.build("borrow-on-demand", 51, {"cpu": "1"}),
+         PodSet.build("use-all-spot", 100, {"cpu": "1"})],
+        {"borrow-on-demand": {"cpu": "on-demand"},
+         "use-all-spot": {"cpu": "spot"}},
+    )
+    sched_pending(mgr, "new", "eng-beta",
+                  [PodSet.build("one", 50, {"cpu": "1"})], t=NOW - 2)
+    sched_pending(mgr, "new-alpha", "eng-alpha",
+                  [PodSet.build("one", 1, {"cpu": "1"})], t=NOW - 1)
+    sched_pending(mgr, "new-gamma", "eng-gamma",
+                  [PodSet.build("one", 50, {"cpu": "1"})], t=NOW)
+    res = sched.schedule()
+    assert admitted_names(res) == ["new", "new-alpha"]
+    assert not res.skipped_preemptions
