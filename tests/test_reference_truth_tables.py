@@ -2179,3 +2179,52 @@ def test_no_overadmission_while_borrowing():  # :939
     res = sched.schedule()
     assert admitted_names(res) == ["new", "new-alpha"]
     assert not res.skipped_preemptions
+
+
+class TestSchedulerPartialAdmission:
+    """Partial admission through the real cycle (scheduler_test.go)."""
+
+    def _admitted_counts(self, cache, cq, wl_name):
+        wl = cache.cluster_queues[cq].workloads[f"ns/{wl_name}"]
+        return {psa.name: psa.count for psa in wl.admission.pod_set_assignments}
+
+    def test_partial_admission_single_variable_pod_set(self):  # :1060
+        sched, mgr, cache, _ = sched_env()
+        sched_pending(mgr, "new", "sales",
+                      [PodSet.build("one", 50, {"cpu": "2"}, min_count=20)])
+        res = sched.schedule()
+        assert admitted_names(res) == ["new"]
+        # 50-cpu quota / 2 cpu per pod -> exactly 25 of the 50 pods
+        assert self._admitted_counts(cache, "sales", "new") == {"one": 25}
+
+    def test_partial_admission_preempt_first(self):  # :1089
+        sched, mgr, cache, _ = sched_env()
+        sched_admitted(cache, "old", "eng-beta",
+                       [PodSet.build("one", 10, {"example.com/gpu": "1"})],
+                       {"one": {"example.com/gpu": "model-a"}}, prio=-4)
+        sched_pending(mgr, "new", "eng-beta",
+                      [PodSet.build("one", 20, {"example.com/gpu": "1"},
+                                    min_count=10)], prio=4)
+        res = sched.schedule()
+        # preemption beats scaling down: the old workload is evicted
+        # and the new one waits for the eviction round-trip
+        victims = {
+            t.workload.workload.name
+            for e in res.preempting
+            for t in e.preemption_targets
+        }
+        assert victims == {"old"}
+        assert admitted_names(res) == []
+
+    def test_partial_admission_multiple_variable_pod_sets(self):  # :1169
+        sched, mgr, cache, _ = sched_env()
+        sched_pending(mgr, "new", "sales", [
+            PodSet.build("one", 20, {"cpu": "1"}),
+            PodSet.build("two", 30, {"cpu": "1"}, min_count=10),
+            PodSet.build("three", 15, {"cpu": "1"}, min_count=5),
+        ])
+        res = sched.schedule()
+        assert admitted_names(res) == ["new"]
+        assert self._admitted_counts(cache, "sales", "new") == {
+            "one": 20, "two": 20, "three": 10,
+        }
