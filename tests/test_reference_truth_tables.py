@@ -2228,3 +2228,60 @@ class TestSchedulerPartialAdmission:
         assert self._admitted_counts(cache, "sales", "new") == {
             "one": 20, "two": 20, "three": 10,
         }
+
+
+class TestSchedulerResourceValidation:
+    """scheduler_test.go: workloads failing in-cycle resource
+    validation park with a Pending event (nominate-time LimitRange and
+    requests<=limits checks, scheduler.go:361-369)."""
+
+    def _runtime(self):
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.models import LocalQueue as LQ
+        from kueue_tpu.utils.clock import FakeClock
+
+        rt = ClusterRuntime(clock=FakeClock(1000.0))
+        rt.add_flavor(ResourceFlavor(name="default"))
+        rt.add_cluster_queue(ClusterQueue(
+            name="sales", namespace_selector={},
+            resource_groups=(rg(FlavorQuotas.build("default", {"cpu": "50"})),),
+        ))
+        rt.add_local_queue(LQ(namespace="sales", name="main",
+                              cluster_queue="sales"))
+        return rt
+
+    def test_container_violates_limit_range(self):  # :2579
+        from kueue_tpu.core.limit_range import LimitRange, LimitRangeItem
+
+        rt = self._runtime()
+        rt.limit_ranges["sales/alpha"] = LimitRange(
+            name="alpha", namespace="sales",
+            items=(LimitRangeItem.build(max={"cpu": "300m"}),),
+        )
+        wl = Workload(
+            namespace="sales", name="new", queue_name="main",
+            pod_sets=(PodSet.build("one", 1, {"cpu": "500m"}),),
+        )
+        rt.add_workload(wl)
+        rt.schedule_once()
+        assert wl.admission is None
+        assert any(
+            e.object_key == "sales/new" and "Pending" in e.kind
+            for e in rt.events
+        )
+
+    def test_requests_exceed_limits(self):  # :2613
+        rt = self._runtime()
+        wl = Workload(
+            namespace="sales", name="new", queue_name="main",
+            pod_sets=(PodSet.build("one", 1, {"cpu": "200m"},
+                                   limits={"cpu": "100m"}),),
+        )
+        rt.add_workload(wl)
+        rt.schedule_once()
+        assert wl.admission is None
+        assert any(
+            e.object_key == "sales/new"
+            and "exceed" in e.message
+            for e in rt.events
+        )
