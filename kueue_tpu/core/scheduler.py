@@ -83,6 +83,37 @@ class Preemptor:
         return False
 
 
+class _LatencyEstimate:
+    """Windowed-min device-latency estimate with skip-erosion re-probe.
+
+    The min over the last ``window`` measurements discards the one-time
+    XLA-compile cost in the first sample, yet still RISES within
+    ``window`` dispatches when the device genuinely slows down (a plain
+    running min can only fall, so once skip-erosion pushed it below the
+    true dispatch round trip the gate would lock onto the slower device
+    path forever). Erosion accumulates on skipped cycles to force an
+    eventual re-probe and resets on the next real measurement, so a
+    re-probe that measures slow re-disables the device."""
+
+    def __init__(self, window: int = 5, erosion_rate: float = 0.995):
+        self._samples: deque = deque(maxlen=window)
+        self._erosion_rate = erosion_rate
+        self._erosion = 1.0
+
+    @property
+    def value(self) -> Optional[float]:
+        if not self._samples:
+            return None
+        return min(self._samples) * self._erosion
+
+    def observe(self, dt: float) -> None:
+        self._samples.append(dt)
+        self._erosion = 1.0
+
+    def erode(self) -> None:
+        self._erosion *= self._erosion_rate
+
+
 @dataclass
 class CycleTrace:
     """Per-cycle phase attribution — the pprof/log-attribution analog
@@ -195,15 +226,14 @@ class Scheduler:
         # round-trip cost (tens of ms on remote-attached TPUs) that only
         # amortizes once the cycle batches enough heads, so auto mode
         # measures both paths at runtime and routes each cycle to the
-        # cheaper one: EMA of the host cost per head, running MIN of the
-        # observed dispatch wall time (min because the first dispatch
-        # includes one-time XLA compilation). The min erodes slightly on
-        # every skip so a stale pessimistic sample (compile included)
-        # re-probes eventually instead of disabling the device forever.
+        # cheaper one: EMA of the host cost per head, windowed MIN of
+        # the observed dispatch wall time (see _LatencyEstimate for why
+        # windowed: a running min can only fall, which let skip-erosion
+        # permanently lock the gate onto a slow device).
         self._host_assign_ema: Optional[float] = None  # s/head
-        self._device_dispatch_min: Optional[float] = None  # s/dispatch
+        self._device_dispatch_est = _LatencyEstimate()  # s/dispatch
         self._host_victim_ema: Optional[float] = None  # s/deferred head
-        self._device_victim_min: Optional[float] = None  # s/batch
+        self._device_victim_est = _LatencyEstimate()  # s/batch
 
     # ---- the cycle (scheduler.go:176-310) ----
     def schedule(self) -> CycleResult:
@@ -408,25 +438,27 @@ class Scheduler:
             return True
         if n_assignable < self.solver_threshold:
             return False
-        if self._device_dispatch_min is None:
+        device_est = self._device_dispatch_est.value
+        if device_est is None:
             return True  # probe once; the measurement gates later cycles
         host_est = n_assignable * (
             self._host_assign_ema or self._HOST_ASSIGN_DEFAULT
         )
-        if host_est >= self._device_dispatch_min:
+        if host_est >= device_est:
             return True
-        self._device_dispatch_min *= 0.995  # stale-estimate erosion
+        self._device_dispatch_est.erode()  # stale-estimate re-probe
         return False
 
     def _victim_device_worthwhile(self, n_deferred: int) -> bool:
-        if self._device_victim_min is None:
+        device_est = self._device_victim_est.value
+        if device_est is None:
             return True  # probe once
         host_est = n_deferred * (
             self._host_victim_ema or self._HOST_VICTIM_DEFAULT
         )
-        if host_est >= self._device_victim_min:
+        if host_est >= device_est:
             return True
-        self._device_victim_min *= 0.995
+        self._device_victim_est.erode()
         return False
 
     def _make_assigner(self, snapshot: Snapshot) -> FlavorAssigner:
@@ -492,11 +524,7 @@ class Scheduler:
                 self.preemptor,
             )
             dt = _time.perf_counter() - t0
-            self._device_victim_min = (
-                dt
-                if self._device_victim_min is None
-                else min(self._device_victim_min, dt)
-            )
+            self._device_victim_est.observe(dt)
         else:
             all_targets = [
                 self.preemptor.get_targets(
@@ -601,11 +629,7 @@ class Scheduler:
         t0 = _time.perf_counter()
         res = dispatch_lowered(snapshot, lowered)
         dt = _time.perf_counter() - t0
-        self._device_dispatch_min = (
-            dt
-            if self._device_dispatch_min is None
-            else min(self._device_dispatch_min, dt)
-        )
+        self._device_dispatch_est.observe(dt)
         chosen = np.asarray(res.chosen)
         host_idx = [
             i

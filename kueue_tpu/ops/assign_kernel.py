@@ -189,7 +189,6 @@ def phase1_classify(
     guaranteed: jnp.ndarray,
     local_usage: jnp.ndarray,
     heads: HeadsBatch,
-    return_cell_fit: bool = False,
 ) -> Tuple[jnp.ndarray, ...]:
     """Pick each head's first fitting candidate against the cycle-start
     snapshot. Returns (chosen int32[W], borrows bool[W,K],
@@ -256,28 +255,8 @@ def phase1_classify(
     preempt_k = jnp.where(
         any_pre & populated & (chosen < 0), first_pre, -1
     ).astype(jnp.int32)
-    if return_cell_fit:
-        # per-cell masks against the cycle-start snapshot (zero/pad
-        # cells True) — the drain derives each resource group's
-        # independent flavor walk from them: fit (stops the group's
-        # walk), preempt-eligible, and the reclaim upgrade's leaf
-        # condition (preemption.is_reclaim_possible's first check:
-        # own-row usage + request within nominal)
-        fit_cells = jnp.where(cell_need, avail_wkc >= heads.qty, True)
-        pot_cells = jnp.where(
-            cell_need,
-            (heads.qty <= potential_wkc) & (heads.qty <= nominal_wkc),
-            True,
-        )
-        reclaim_cells = jnp.where(
-            cell_need, local_wkc + heads.qty <= nominal_wkc, True
-        )
-        borrow_cells = (
-            jnp.where(cell_need, local_wkc + heads.qty > subtree_wkc, False)
-            & has_cohort[..., None]
-        )
-        return (chosen, borrows, preempt_k, fit_cells, pot_cells,
-                reclaim_cells, borrow_cells)
+    # Per-cell masks (for the drain's resource-group walks) live in the
+    # standalone cell_masks() helper above — single source of truth.
     return chosen, borrows, preempt_k
 
 

@@ -712,12 +712,13 @@ def solve_drain_preempt(
 
     Entry state is per-(queue, position): pending(0)/parked(1)/
     admitted(2); each queue's head is its first pending entry in heap
-    order. Scope (host lowering enforces): single-podset
-    default-fungibility heads (any number of resource groups — the
-    per-group cursor vectors and the reclaim-oracle emulation cover the
-    cartesian candidate walk), candidates within the head's own
-    ClusterQueue only (reclaimWithinCohort == Never or no cohort), no
-    fair sharing.
+    order. Scope (host lowering enforces): multi-podset heads (up to
+    max_podsets), any flavorFungibility policy, any number of resource
+    groups — the per-group cursor vectors and the reclaim-oracle
+    emulation cover the cartesian candidate walk. Remaining exclusions
+    routed to host fallback by the lowering: TAS topology requests,
+    cohort reclaim / borrowWithinCohort candidate scopes, fair sharing,
+    and heads past the candidate/cell caps.
     """
     max_depth = tree.max_depth
     subtree, guaranteed = subtree_quota(tree)
@@ -743,8 +744,8 @@ def solve_drain_preempt(
          adm_cycle, vevicted, evict_cycle, cycle) = state
 
         # head of each queue = first pending entry in heap order
-        pend = status == 0  # [Q,L]
-        pos_cand = jnp.where(pend, l_idx[None, :], l)
+        entry_pending = status == 0  # [Q,L]
+        pos_cand = jnp.where(entry_pending, l_idx[None, :], l)
         cur_raw = jnp.min(pos_cand, axis=1)  # [Q]
         active = (cur_raw < l) & (cur_raw < queues.qlen)
         cur = jnp.minimum(cur_raw, l - 1)
@@ -764,7 +765,7 @@ def solve_drain_preempt(
         elig_v = live_victim & (lower | newer_eq)  # [Q,V]
 
         usage0 = usage_tree(tree, guaranteed, local)
-        (is_fit, is_pre, pend, head_borrow, rep_k, walk_next,
+        (is_fit, is_pre, pend_flavors, head_borrow, rep_k, walk_next,
          cells_eff, qty_eff) = _nominate_multi(
             tree, subtree, guaranteed, local, usage0, queues, q_idx, cur,
             active, g_start, potential, victims=victims, elig_v=elig_v,
@@ -958,11 +959,11 @@ def solve_drain_preempt(
         # termination; their undecided entries report as fallback
         over_budget = retries >= queues.retry_cap
         stuck = stuck | (
-            active & (~is_fit) & ~preempt_ok & ~pre_skipped & pend
+            active & (~is_fit) & ~preempt_ok & ~pre_skipped & pend_flavors
             & over_budget
         )
         retrying = (
-            active & (~is_fit) & ~preempt_ok & ~pre_skipped & pend
+            active & (~is_fit) & ~preempt_ok & ~pre_skipped & pend_flavors
             & ~stuck
         )
         new_entry_status = jnp.where(
@@ -973,7 +974,7 @@ def solve_drain_preempt(
                 & (~is_fit)
                 & ~preempt_ok
                 & ~pre_skipped
-                & ~pend,
+                & ~pend_flavors,
                 1,
                 0,
             ),

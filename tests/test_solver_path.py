@@ -367,7 +367,7 @@ class TestDeviceResolution:
         sched, _, _, _ = build_env(spec, use_solver=None)
         sched.solver_threshold = 4
         sched._host_assign_ema = 1e-4
-        sched._device_dispatch_min = 0.05  # 50 ms tunnel dispatch
+        sched._device_dispatch_est.observe(0.05)  # 50 ms tunnel dispatch
         assert not sched._solver_enabled(10)  # 1 ms host < 50 ms device
         assert sched._solver_enabled(10_000)  # 1 s host > 50 ms device
         # no measurement yet -> probe the device once
@@ -377,15 +377,15 @@ class TestDeviceResolution:
 
     def test_auto_mode_stale_estimate_erodes(self):
         # A pessimistic first sample (XLA compile included) must not
-        # disable the device forever: each skip erodes the stored min.
+        # disable the device forever: each skip erodes the estimate.
         spec = random_spec(3, n_cohorts=1, cqs_per_cohort=2, workloads_per_cq=1)
         sched, _, _, _ = build_env(spec, use_solver=None)
         sched.solver_threshold = 4
         sched._host_assign_ema = 1e-4
-        sched._device_dispatch_min = 30.0  # cold compile sample
+        sched._device_dispatch_est.observe(30.0)  # cold compile sample
         for _ in range(5):
             assert not sched._solver_enabled(100)
-        assert sched._device_dispatch_min < 30.0
+        assert sched._device_dispatch_est.value < 30.0
 
     def test_auto_mode_probes_then_measures(self):
         # End to end: first eligible auto cycle dispatches (probe) and
@@ -394,7 +394,59 @@ class TestDeviceResolution:
         sched, mgr, cache, _ = build_env(spec, use_solver=None)
         sched.solver_threshold = 1
         sched.schedule()
-        assert sched._device_dispatch_min is not None
+        assert sched._device_dispatch_est.value is not None
+
+    def test_gate_recovers_when_device_slows(self):
+        # Drift: erosion re-probes a stale estimate, and a slow re-probe
+        # measurement RAISES the estimate back (windowed min, not a
+        # running min), so the gate re-disables a genuinely slow device
+        # instead of locking onto it forever.
+        spec = random_spec(3, n_cohorts=1, cqs_per_cohort=2, workloads_per_cq=1)
+        sched, _, _, _ = build_env(spec, use_solver=None)
+        sched.solver_threshold = 4
+        sched._host_assign_ema = 1e-4
+        est = sched._device_dispatch_est
+        est.observe(0.04)  # warm-era fast sample
+        # host est for 100 heads = 10 ms < 40 ms -> skip; erode far past
+        # the true dispatch cost (the old running-min bug's trigger)
+        for _ in range(2000):
+            if sched._solver_enabled(100):
+                break
+        assert sched._solver_enabled(100)  # eroded below 10 ms: re-probe
+        # the re-probe measures the TRUE cost (50 ms, device got slower;
+        # window fills with slow samples, the old fast one ages out)
+        for _ in range(est._samples.maxlen):
+            est.observe(0.05)
+        assert est.value >= 0.05  # estimate rose: windowed, not min()
+        assert not sched._solver_enabled(100)  # 10 ms host wins again
+
+    def test_gate_converges_when_device_speeds_up(self):
+        # Drift the other way: after a slow era the device gets fast
+        # (e.g. recompile cached); one fast measurement immediately
+        # lowers the windowed min and the gate re-enables.
+        spec = random_spec(3, n_cohorts=1, cqs_per_cohort=2, workloads_per_cq=1)
+        sched, _, _, _ = build_env(spec, use_solver=None)
+        sched.solver_threshold = 4
+        sched._host_assign_ema = 1e-4
+        est = sched._device_dispatch_est
+        est.observe(0.5)  # slow era
+        assert not sched._solver_enabled(100)  # 10 ms host < 500 ms
+        est.observe(0.005)  # fast sample lands (e.g. forced dispatch)
+        assert sched._solver_enabled(100)  # 10 ms host > 5 ms device
+
+    def test_erosion_resets_on_measurement(self):
+        from kueue_tpu.core.scheduler import _LatencyEstimate
+
+        est = _LatencyEstimate(window=3, erosion_rate=0.5)
+        est.observe(1.0)
+        est.erode()
+        est.erode()
+        assert est.value == 0.25
+        est.observe(2.0)  # fresh measurement cancels accumulated erosion
+        assert est.value == 1.0  # min(1.0, 2.0) * 1.0
+        est.observe(3.0)
+        est.observe(4.0)  # window now [2, 3, 4]: the 1.0 sample aged out
+        assert est.value == 2.0
 
 
 class TestCursorParity:
