@@ -141,17 +141,20 @@ def build_backlog(rng):
 
 
 def contended_drain_bench(rng):
-    """Contended drain: every ClusterQueue starts saturated with
-    admitted lower-priority workloads and a backlog of higher-priority
-    pending workloads that can only start by preempting them. The
-    WHOLE multi-cycle drain — victim search (minimalPreemptions,
-    preemption.go:275-342), in-cycle fits re-checks, evictions, and
-    the follow-up admissions — runs on the device in ONE dispatch +
-    ONE fetch (ops/drain_kernel.solve_drain_preempt). Decision parity
-    with the sequential host scheduler (evictions applied at cycle
-    boundaries) is asserted in tests/test_drain.py
-    TestPreemptDrainParity. Returns (ms/cycle, cycles, admitted,
-    evicted)."""
+    """Contended drain with CROSS-CQ cohort reclamation: per 10-CQ
+    cohort, five "hoarder" ClusterQueues sit saturated ABOVE their
+    nominal quota (borrowing from the cohort; they never preempt), and
+    five "reclaimer" CQs hold a higher-priority backlog that can only
+    start by reclaiming that borrowed capacity (preemption.go:480-524)
+    — plus within-CQ preemption of the reclaimers' own victims, and
+    drain-admitted workloads becoming reclaim candidates themselves
+    (part-B pool slots). The WHOLE multi-cycle drain — the strategy
+    ladder with borrowWithinCohort thresholds, in-cycle fits re-checks,
+    cross-CQ evictions, and follow-up admissions — runs on the device
+    in ONE dispatch + ONE fetch (ops/drain_kernel.solve_drain_preempt).
+    Decision parity with the sequential host scheduler is asserted in
+    tests/test_drain.py TestPreemptDrainCohortReclaim. Returns
+    (ms/cycle, cycles, admitted, evicted)."""
     import time
 
     from kueue_tpu.models import (
@@ -163,8 +166,12 @@ def contended_drain_bench(rng):
         Workload,
         WorkloadConditionType,
     )
-    from kueue_tpu.models.cluster_queue import ResourceGroup
-    from kueue_tpu.models.constants import PreemptionPolicy
+    from kueue_tpu.models.cluster_queue import BorrowWithinCohort, ResourceGroup
+    from kueue_tpu.models.constants import (
+        BorrowWithinCohortPolicy,
+        PreemptionPolicy,
+        ReclaimWithinCohortPolicy,
+    )
     from kueue_tpu.models.workload import PodSet
     from kueue_tpu.core.cache import Cache
     from kueue_tpu.core.drain import run_drain_preempt
@@ -173,17 +180,37 @@ def contended_drain_bench(rng):
     from kueue_tpu.core.workload_info import make_admission
     from kueue_tpu.utils.clock import FakeClock
 
-    n_cq, victims_per_cq, wl_per_cq = 1000, 8, 10
+    n_cq, cohort_size = 1000, 10
+    hoarder_victims, reclaimer_victims, wl_per_reclaimer = 8, 4, 10
     clock = FakeClock(0.0)
     cache = Cache()
     mgr = QueueManager(clock)
     cache.add_or_update_flavor(ResourceFlavor(name="default"))
-    prem = Preemption(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
     for i in range(n_cq):
         name = f"ccq-{i}"
+        hoarder = (i % cohort_size) < cohort_size // 2
+        if hoarder:
+            prem = Preemption()  # never preempts; a pure reclaim target
+        else:
+            prem = Preemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                reclaim_within_cohort=(
+                    ReclaimWithinCohortPolicy.ANY
+                    if i % 2
+                    else ReclaimWithinCohortPolicy.LOWER_PRIORITY
+                ),
+                borrow_within_cohort=(
+                    BorrowWithinCohort(
+                        policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+                        max_priority_threshold=60,
+                    )
+                    if i % 3 == 0
+                    else BorrowWithinCohort()
+                ),
+            )
         cq = ClusterQueue(
             name=name,
-            cohort=f"ccohort-{i % N_COHORT}",
+            cohort=f"ccohort-{i // cohort_size}",
             namespace_selector={},
             resource_groups=(
                 ResourceGroup(
@@ -191,18 +218,22 @@ def contended_drain_bench(rng):
                     (FlavorQuotas.build("default", {"cpu": "16"}),),
                 ),
             ),
-            preemption=prem,  # reclaim=Never: within-CQ victim search
+            preemption=prem,
         )
         cache.add_or_update_cluster_queue(cq)
         mgr.add_cluster_queue(cq)
         mgr.add_local_queue(
             LocalQueue(namespace="ns", name=f"lq-{name}", cluster_queue=name)
         )
-        for v in range(victims_per_cq):
+        # hoarders: 8 x 3 = 24 > nominal 16 (borrowing 8 from the
+        # cohort); reclaimers: 4 x 2 = 8 (room for their own backlog)
+        n_vic = hoarder_victims if hoarder else reclaimer_victims
+        v_cpu = "3" if hoarder else "2"
+        for v in range(n_vic):
             wl = Workload(
                 namespace="ns", name=f"victim-{i}-{v}",
                 queue_name=f"lq-{name}", priority=int(rng.integers(0, 40)),
-                pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
+                pod_sets=(PodSet.build("main", 1, {"cpu": v_cpu}),),
             )
             wl.admission = make_admission(name, {"main": {"cpu": "default"}}, wl)
             wl.set_condition(
@@ -210,20 +241,21 @@ def contended_drain_bench(rng):
                 reason="QuotaReserved", now=float(v),
             )
             cache.add_or_update_workload(wl)
-        for w in range(wl_per_cq):
-            mgr.add_or_update_workload(
-                Workload(
-                    namespace="ns", name=f"pre-{i}-{w}",
-                    queue_name=f"lq-{name}",
-                    priority=50 + 10 * int(rng.integers(0, 6)),
-                    creation_time=float(i * wl_per_cq + w),
-                    pod_sets=(
-                        PodSet.build(
-                            "main", 1, {"cpu": str(int(rng.integers(2, 8)))}
+        if not hoarder:
+            for w in range(wl_per_reclaimer):
+                mgr.add_or_update_workload(
+                    Workload(
+                        namespace="ns", name=f"pre-{i}-{w}",
+                        queue_name=f"lq-{name}",
+                        priority=50 + 10 * int(rng.integers(0, 6)),
+                        creation_time=float(i * wl_per_reclaimer + w),
+                        pod_sets=(
+                            PodSet.build(
+                                "main", 1, {"cpu": str(int(rng.integers(2, 8)))}
+                            ),
                         ),
-                    ),
+                    )
                 )
-            )
     pending = []
     for cq_name, pq in mgr.cluster_queues.items():
         for wl in pq.snapshot_sorted():
@@ -231,18 +263,29 @@ def contended_drain_bench(rng):
     ts_fn = lambda wl: queue_order_timestamp(wl, mgr._ts_policy)  # noqa: E731
 
     snapshot = take_snapshot(cache)
-    run_drain_preempt(snapshot, pending, cache.flavors, timestamp_fn=ts_fn)
+    run_drain_preempt(
+        snapshot, pending, cache.flavors, timestamp_fn=ts_fn, search_width=64
+    )
 
     times = []
     for _ in range(3):
         snapshot = take_snapshot(cache)
         t0 = time.perf_counter()
         outcome = run_drain_preempt(
-            snapshot, pending, cache.flavors, timestamp_fn=ts_fn
+            snapshot, pending, cache.flavors, timestamp_fn=ts_fn,
+            search_width=64,
         )
         times.append(time.perf_counter() - t0)
     assert not outcome.fallback and not outcome.truncated
     assert outcome.preempted and outcome.admitted
+    # cross-CQ reclaim actually fired: hoarders never preempt, so any
+    # eviction of a hoarder victim was a reclaim by another CQ
+    hoarder_evictions = sum(
+        1
+        for _, cq_name, _ in outcome.preempted
+        if (int(cq_name.split("-")[1]) % cohort_size) < cohort_size // 2
+    )
+    assert hoarder_evictions > 0, "no cross-CQ reclaim in contended bench"
     return (
         float(np.median(times)) * 1e3 / outcome.cycles,
         outcome.cycles,

@@ -252,119 +252,305 @@ class PreemptDrainOutcome(DrainOutcome):
     preempted: List[Tuple[Workload, str, int]] = field(default_factory=list)
 
 
-def _preempt_eligible_cq(cq) -> bool:
-    """Device preemption-drain scope: candidates must come from the
-    head's own ClusterQueue only, so cohort reclaim (and therefore
-    borrowWithinCohort) must be off (preemption.go:480-524 — cross-CQ
-    candidates exist only under reclaimWithinCohort)."""
-    from kueue_tpu.models.constants import (
-        BorrowWithinCohortPolicy,
-        ReclaimWithinCohortPolicy,
-    )
-
-    prem = cq.preemption
-    if cq.cohort is not None and (
-        prem.reclaim_within_cohort != ReclaimWithinCohortPolicy.NEVER
-    ):
-        return False
-    return prem.borrow_within_cohort.policy == BorrowWithinCohortPolicy.NEVER
-
-
 def run_drain_preempt(
     snapshot: Snapshot,
     pending: Sequence[Tuple[Workload, str]],
     flavors: Dict[str, ResourceFlavor],
     max_candidates: int = 8,
     max_cells: int = 4,
-    max_victims: int = 32,
+    max_victims: int = 512,
     max_victim_cells: int = 4,
     timestamp_fn=None,
     max_cycles: Optional[int] = None,
+    now: Optional[float] = None,
+    search_width: int = 32,
 ) -> PreemptDrainOutcome:
-    """Multi-cycle drain WITH classic within-CQ preemption, one device
-    dispatch + one fetch (ops/drain_kernel.solve_drain_preempt).
+    """Multi-cycle drain WITH classic preemption — within-ClusterQueue
+    and cross-CQ cohort reclamation — in one device dispatch + one
+    fetch (ops/drain_kernel.solve_drain_preempt).
 
-    Heads of ClusterQueues outside the dense scope (cohort reclaim,
-    borrowWithinCohort, too many candidates/cells) are routed to
-    ``fallback`` for the sequential cycle loop. The caller applies the
-    reported evictions (set Evicted conditions, release cache usage) —
-    this function only decides.
+    Candidates are pooled per root cohort (segment): every member CQ's
+    admitted workloads (part A), plus one slot per pending entry that
+    becomes a live reclaim candidate once the drain admits it (part B —
+    the host cycle loop sees drain-admitted workloads in its snapshot
+    the same way). ``now`` is the quota-reservation instant attributed
+    to in-drain admissions for candidate ordering (default: after every
+    part-A reservation). ``max_victims`` caps a SEGMENT's pool;
+    overflowing segments route their preempt-capable queues to
+    ``fallback`` for the sequential cycle loop, as do victims with more
+    than ``max_victim_cells`` distinct usage cells. ``search_width``
+    bounds one head's per-cycle candidate scan; a head that fails an
+    overflowing search is reported via ``fallback`` (no-decision), not
+    parked. The caller applies the reported admissions and evictions in
+    cycle order (a drain-admitted entry may later be evicted by a
+    reclaiming CQ: it appears in BOTH lists) — this function only
+    decides.
     """
     from kueue_tpu._jax import jnp
-    from kueue_tpu.models.constants import PreemptionPolicy
+    from kueue_tpu.models.constants import (
+        BorrowWithinCohortPolicy,
+        PreemptionPolicy,
+        ReclaimWithinCohortPolicy,
+        WorkloadConditionType,
+    )
+    from kueue_tpu.ops.assign_kernel import build_roots
     from kueue_tpu.ops.drain_kernel import (
         DrainQueues,
-        VictimPanels,
+        SegVictims,
         solve_drain_preempt_packed_jit,
     )
 
     plan = plan_drain(
         snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
     )
+    q = max(len(plan.cq_order), 1)
+    nl = plan.queues_np["cells"].shape[1]
+    pdim, kdim, cdim = plan.queues_np["cells"].shape[2:]
+    merged_cells = pdim * cdim  # the kernel's mcells width
 
-    # per-CQ eligibility + victim panels
-    q = len(plan.cq_order) if plan.cq_order else 1
-    v_cap, cv = max_victims, max_victim_cells
-    vcells = np.full((q, max(v_cap, 1), cv), -1, dtype=np.int32)
-    vqty = np.zeros((q, max(v_cap, 1), cv), dtype=np.int64)
-    vprio = np.zeros((q, max(v_cap, 1)), dtype=np.int64)
-    vts = np.zeros((q, max(v_cap, 1)), dtype=np.int64)
-    vvalid = np.zeros((q, max(v_cap, 1)), dtype=bool)
-    can_preempt = np.zeros(q, dtype=bool)
+    # ---- per-queue preemption policy flags ----
+    NO_THR = 1 << 60
+    same_enabled = np.zeros(q, dtype=bool)
     same_prio_ok = np.zeros(q, dtype=bool)
-    # (qi, slot) -> WorkloadSnapshot, for mapping evictions back
-    victim_of: Dict[Tuple[int, int], object] = {}
-    drop_queues: List[int] = []
-
-    from kueue_tpu.models.constants import WorkloadConditionType
-
+    reclaim_enabled = np.zeros(q, dtype=bool)
+    only_lower = np.zeros(q, dtype=bool)
+    bwc = np.zeros(q, dtype=bool)
+    bwc_thr1 = np.full(q, NO_THR, dtype=np.int64)
     for qi, cq_name in enumerate(plan.cq_order):
-        cq = snapshot.cq_models[cq_name]
-        candidates = snapshot.workloads_in_cq(cq_name)
-        wcq = cq.preemption.within_cluster_queue
-        preempts = wcq != PreemptionPolicy.NEVER
-        if preempts and (
-            not _preempt_eligible_cq(cq)
-            or len(candidates) > v_cap
-            or any(
-                int(np.count_nonzero(ws.usage_vec)) > cv for ws in candidates
-            )
-        ):
-            drop_queues.append(qi)
-            continue
-        can_preempt[qi] = preempts
+        prem = snapshot.cq_models[cq_name].preemption
+        same_enabled[qi] = prem.within_cluster_queue != PreemptionPolicy.NEVER
         same_prio_ok[qi] = (
-            wcq == PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY
+            prem.within_cluster_queue
+            == PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY
         )
-        if not preempts:
+        reclaim_enabled[qi] = snapshot.has_cohort(cq_name) and (
+            prem.reclaim_within_cohort != ReclaimWithinCohortPolicy.NEVER
+        )
+        # the host rule is != Any (preemption.py _find_candidates), not
+        # == LowerPriority — they differ on unknown policy values
+        only_lower[qi] = (
+            prem.reclaim_within_cohort != ReclaimWithinCohortPolicy.ANY
+        )
+        pol = prem.borrow_within_cohort
+        bwc[qi] = pol.policy != BorrowWithinCohortPolicy.NEVER
+        if pol.max_priority_threshold is not None:
+            bwc_thr1[qi] = int(pol.max_priority_threshold) + 1
+    can_search = same_enabled | reclaim_enabled
+
+    # ---- segment membership ----
+    cq_rows = plan.queues_np["cq_rows"]
+    seg_id = plan.queues_np["seg_id"]
+    qlen = plan.queues_np["qlen"]
+    roots_all = build_roots(snapshot.flat.parent)
+    n_cq = snapshot.flat.n_cq
+    row_names = snapshot.flat.cq_names  # row i -> name
+    queue_of_row = {int(cq_rows[qi]): qi for qi in range(len(plan.cq_order))}
+    seg_root = {}
+    seg_queues: Dict[int, List[int]] = {}
+    for qi in range(len(plan.cq_order)):
+        s = int(seg_id[qi])
+        if s < 0:
             continue
-        # candidate order: evicted first, lowest priority, newest
-        # quota reservation (preemption.go:591-618; in_cq uniform here)
-        candidates.sort(
-            key=lambda ws: (
-                0
-                if ws.workload.condition_true(WorkloadConditionType.EVICTED)
-                else 1,
-                ws.priority,
-                -ws.quota_reserved_time,
-                ws.workload.uid,
+        seg_root[s] = int(roots_all[int(cq_rows[qi])])
+        seg_queues.setdefault(s, []).append(qi)
+    seg_members: Dict[int, List[int]] = {
+        s: [r for r in range(n_cq) if int(roots_all[r]) == root]
+        for s, root in seg_root.items()
+    }
+    scoped = {
+        s: any(reclaim_enabled[qi] for qi in seg_queues[s])
+        for s in seg_root
+    }
+
+    # ---- pool membership + segment scope checks ----
+    tree, paths_j, _ = tree_arrays(snapshot)
+    paths_np = np.asarray(paths_j)
+    pool_of: Dict[int, list] = {}  # s -> [(ws, owner_row)]
+    bad_segments: List[int] = []
+    for s, members in seg_members.items():
+        entries = []
+        bad = False
+        for r in members:
+            name = row_names[r]
+            qi = queue_of_row.get(r)
+            include = scoped[s] or (qi is not None and same_enabled[qi])
+            if not include:
+                continue
+            for ws in snapshot.workloads_in_cq(name):
+                if int(np.count_nonzero(ws.usage_vec)) > max_victim_cells:
+                    # an unrepresentable victim of an included CQ makes
+                    # the whole segment's searches unsound
+                    bad = True
+                    break
+                entries.append((ws, r))
+            if bad:
+                break
+        n_b = sum(int(qlen[qi]) for qi in seg_queues[s]) if scoped[s] else 0
+        if bad or len(entries) + n_b > max_victims:
+            bad_segments.append(s)
+            pool_of[s] = []
+        else:
+            pool_of[s] = entries
+
+    # searching queues of bad segments fall back to the cycle loop
+    drop_queues: List[int] = [
+        qi
+        for s in bad_segments
+        for qi in seg_queues[s]
+        if can_search[qi]
+    ]
+    for s in bad_segments:
+        scoped[s] = False
+    dropped = set(drop_queues)
+
+    # ---- dense pool arrays ----
+    pool_totals = [
+        len(pool_of.get(s, []))
+        + (
+            sum(int(qlen[qi]) for qi in seg_queues[s] if qi not in dropped)
+            if scoped[s]
+            else 0
+        )
+        for s in seg_root
+    ]
+    v_cap = _bucket(max(pool_totals, default=1), minimum=8)
+    s_dim = plan.n_segments
+    cv = max(
+        merged_cells,
+        max(
+            (
+                int(np.count_nonzero(ws.usage_vec))
+                for pool in pool_of.values()
+                for ws, _ in pool
+            ),
+            default=1,
+        ),
+    )
+    dmax = paths_np.shape[1]
+    node_counts = [
+        len(
+            np.unique(
+                paths_np[np.asarray(members, dtype=np.int64)][
+                    paths_np[np.asarray(members, dtype=np.int64)] >= 0
+                ]
             )
         )
-        for slot, ws in enumerate(candidates):
+        for members in seg_members.values()
+    ]
+    m_dim = _bucket(max(node_counts, default=1), minimum=4)
+
+    scells = np.full((s_dim, v_cap, cv), -1, dtype=np.int32)
+    sqty = np.zeros((s_dim, v_cap, cv), dtype=np.int64)
+    sprio = np.zeros((s_dim, v_cap), dtype=np.int64)
+    sts = np.zeros((s_dim, v_cap), dtype=np.int64)
+    svalid0 = np.zeros((s_dim, v_cap), dtype=bool)
+    sowner = np.full((s_dim, v_cap), -1, dtype=np.int32)
+    sowner_local = np.zeros((s_dim, v_cap), dtype=np.int32)
+    sslot_q = np.full((s_dim, v_cap), -1, dtype=np.int32)
+    sslot_l = np.full((s_dim, v_cap), -1, dtype=np.int32)
+    seg_nodes = np.full((s_dim, m_dim), -1, dtype=np.int32)
+    lpaths = np.full((s_dim, m_dim, dmax), -1, dtype=np.int32)
+    hlocal = np.zeros(q, dtype=np.int32)
+    perm = np.tile(np.arange(v_cap, dtype=np.int32), (q, 1))
+    entry_slot = np.full((q, nl), -1, dtype=np.int32)
+    victim_of: Dict[Tuple[int, int], object] = {}
+    slot_meta: Dict[int, list] = {}  # s -> [(evicted0, owner, prio, rt, uid)]
+
+    if now is None:
+        rts = [
+            ws.quota_reserved_time
+            for pool in pool_of.values()
+            for ws, _ in pool
+        ]
+        now = (max(rts) + 1.0) if rts else 0.0
+
+    for s, members in seg_members.items():
+        nodes = np.unique(
+            paths_np[np.asarray(members, dtype=np.int64)]
+        )
+        nodes = nodes[nodes >= 0]
+        local_id = {int(g): i for i, g in enumerate(nodes)}
+        seg_nodes[s, : len(nodes)] = nodes
+        for i, gnode in enumerate(nodes):
+            gp = paths_np[int(gnode)]
+            for d in range(dmax):
+                if gp[d] >= 0:
+                    lpaths[s, i, d] = local_id[int(gp[d])]
+        for qi in seg_queues[s]:
+            hlocal[qi] = local_id[int(cq_rows[qi])]
+        meta = []
+        slot = 0
+        for ws, owner in pool_of.get(s, []):
             js = np.flatnonzero(ws.usage_vec)
-            vcells[qi, slot, : len(js)] = js
-            vqty[qi, slot, : len(js)] = ws.usage_vec[js]
-            vprio[qi, slot] = ws.priority
-            ts = (
+            scells[s, slot, : len(js)] = js
+            sqty[s, slot, : len(js)] = ws.usage_vec[js]
+            sprio[s, slot] = ws.priority
+            tsv = (
                 timestamp_fn(ws.workload)
                 if timestamp_fn
                 else ws.workload.creation_time
             )
-            vts[qi, slot] = int(ts * 1e9)
-            vvalid[qi, slot] = True
-            victim_of[(qi, slot)] = ws
+            sts[s, slot] = int(tsv * 1e9)
+            svalid0[s, slot] = True
+            sowner[s, slot] = owner
+            sowner_local[s, slot] = local_id[int(owner)]
+            victim_of[(s, slot)] = ws
+            meta.append(
+                (
+                    ws.workload.condition_true(WorkloadConditionType.EVICTED),
+                    int(owner),
+                    int(ws.priority),
+                    float(ws.quota_reserved_time),
+                    ws.workload.uid,
+                )
+            )
+            slot += 1
+        if scoped[s]:
+            for qi in seg_queues[s]:
+                if qi in dropped:
+                    continue
+                for pos in range(int(qlen[qi])):
+                    i = plan.head_of[(qi, pos)]
+                    wl = plan.lowered.heads[i]
+                    sprio[s, slot] = plan.queues_np["priority"][qi, pos]
+                    sts[s, slot] = plan.queues_np["timestamp"][qi, pos]
+                    sowner[s, slot] = cq_rows[qi]
+                    sowner_local[s, slot] = local_id[int(cq_rows[qi])]
+                    sslot_q[s, slot] = qi
+                    sslot_l[s, slot] = pos
+                    entry_slot[qi, pos] = slot
+                    meta.append(
+                        (
+                            False,
+                            int(cq_rows[qi]),
+                            int(plan.queues_np["priority"][qi, pos]),
+                            float(now),
+                            wl.uid,
+                        )
+                    )
+                    slot += 1
+        slot_meta[s] = meta
+        # candidate order per queue (preemption.go:591-618): evicted
+        # first, other-CQ first, lowest priority, most recently
+        # reserved, uid; pad slots last
+        for qi in seg_queues[s]:
+            own = int(cq_rows[qi])
+            keyed = sorted(
+                range(len(meta)),
+                key=lambda j: (
+                    0 if meta[j][0] else 1,
+                    0 if meta[j][1] != own else 1,
+                    meta[j][2],
+                    -meta[j][3],
+                    meta[j][4],
+                ),
+            )
+            perm[qi, : len(keyed)] = np.asarray(keyed, dtype=np.int32)
+            perm[qi, len(keyed) :] = np.arange(
+                len(keyed), v_cap, dtype=np.int32
+            )
 
-    # drop ineligible queues to the fallback path
+    # ---- drop ineligible queues to the fallback path ----
     extra_fb_entries: List[Tuple[Workload, str]] = []
     if drop_queues:
         for qi in drop_queues:
@@ -380,8 +566,8 @@ def run_drain_preempt(
 
     # cycle cap: between evictions the preemption-free per-segment
     # progress bound applies (>=1 retire per cycle per live segment);
-    # each eviction cycle retires nothing but consumes a victim and can
-    # reactivate the segment's parked entries once
+    # each eviction cycle retires nothing but consumes a pool slot and
+    # can reactivate the segment's parked entries once
     qlen = plan.queues_np["qlen"]
     seg_id = plan.queues_np["seg_id"]
     live = seg_id >= 0
@@ -390,11 +576,10 @@ def run_drain_preempt(
         seg_entries = np.bincount(
             seg_id[live], weights=qlen[live].astype(np.float64), minlength=nseg
         )
-        seg_victims = np.bincount(
-            seg_id[live],
-            weights=vvalid.sum(axis=1)[live].astype(np.float64),
-            minlength=nseg,
-        )
+        seg_victims = np.zeros(nseg, dtype=np.float64)
+        for s in seg_root:
+            if s < nseg:
+                seg_victims[s] = len(slot_meta.get(s, []))
         # each entry may additionally burn up to max_candidates cycles
         # retrying with advanced per-group pending cursors before it
         # retires (the PendingFlavors emulation), hence the (K+1) factor
@@ -409,16 +594,28 @@ def run_drain_preempt(
     if max_cycles is not None:
         plan.max_cycles = max_cycles
 
-    tree, paths, _ = tree_arrays(snapshot)
     queues = DrainQueues(**{k: jnp.asarray(v) for k, v in plan.queues_np.items()})
-    victims = VictimPanels(
-        vcells=jnp.asarray(vcells),
-        vqty=jnp.asarray(vqty),
-        vprio=jnp.asarray(vprio),
-        vts=jnp.asarray(vts),
-        vvalid=jnp.asarray(vvalid),
-        can_preempt=jnp.asarray(can_preempt),
+    victims = SegVictims(
+        scells=jnp.asarray(scells),
+        sqty=jnp.asarray(sqty),
+        sprio=jnp.asarray(sprio),
+        sts=jnp.asarray(sts),
+        svalid0=jnp.asarray(svalid0),
+        sowner=jnp.asarray(sowner),
+        sowner_local=jnp.asarray(sowner_local),
+        sslot_q=jnp.asarray(sslot_q),
+        sslot_l=jnp.asarray(sslot_l),
+        seg_nodes=jnp.asarray(seg_nodes),
+        lpaths=jnp.asarray(lpaths),
+        hlocal=jnp.asarray(hlocal),
+        perm=jnp.asarray(perm),
+        entry_slot=jnp.asarray(entry_slot),
+        same_enabled=jnp.asarray(same_enabled),
         same_prio_ok=jnp.asarray(same_prio_ok),
+        reclaim_enabled=jnp.asarray(reclaim_enabled),
+        only_lower=jnp.asarray(only_lower),
+        bwc=jnp.asarray(bwc),
+        bwc_thr1=jnp.asarray(bwc_thr1),
     )
     flat = np.asarray(
         solve_drain_preempt_packed_jit(
@@ -426,21 +623,21 @@ def run_drain_preempt(
             jnp.asarray(snapshot.local_usage),
             queues,
             victims,
-            paths,
+            paths_j,
             n_segments=plan.n_segments,
             n_steps=plan.n_steps,
             max_cycles=plan.max_cycles,
+            search_width=search_width,
         )
     )  # the single fetch
-    nq, nl, npd = plan.queues_np["cells"].shape[:3]
-    nv = vcells.shape[1]
-    ql, qv, qlp = nq * nl, nq * nv, nq * nl * npd
+    nq, nl2, npd = plan.queues_np["cells"].shape[:3]
+    ql, sv, qlp = nq * nl2, s_dim * v_cap, nq * nl2 * npd
     off = 0
-    status = flat[off : off + ql].reshape((nq, nl)); off += ql
-    adm_k = flat[off : off + qlp].reshape((nq, nl, npd)); off += qlp
-    adm_cycle = flat[off : off + ql].reshape((nq, nl)); off += ql
-    evicted = flat[off : off + qv].reshape((nq, nv)).astype(bool); off += qv
-    evict_cycle = flat[off : off + qv].reshape((nq, nv)); off += qv
+    status = flat[off : off + ql].reshape((nq, nl2)); off += ql
+    adm_k = flat[off : off + qlp].reshape((nq, nl2, npd)); off += qlp
+    adm_cycle = flat[off : off + ql].reshape((nq, nl2)); off += ql
+    evicted = flat[off : off + sv].reshape((s_dim, v_cap)).astype(bool); off += sv
+    evict_cycle = flat[off : off + sv].reshape((s_dim, v_cap)); off += sv
     stuck_q = flat[off : off + nq].astype(bool); off += nq
     cycles = int(flat[-1])
     # truncated = the CYCLE CAP cut undecided work; queues frozen by
@@ -449,7 +646,7 @@ def run_drain_preempt(
     truncated = bool(
         np.any(
             (status == 0)
-            & (np.arange(nl)[None, :] < qlen[:, None])
+            & (np.arange(nl2)[None, :] < qlen[:, None])
             & ~stuck_q[:, None]
         )
     )
@@ -475,11 +672,23 @@ def run_drain_preempt(
             parked.append((wl, cq_name))
     admitted.sort(key=lambda t: t[3])
     preempted: List[Tuple[Workload, str, int]] = []
-    for (qi, slot), ws in victim_of.items():
-        if evicted[qi, slot]:
-            preempted.append(
-                (ws.workload, plan.cq_order[qi], int(evict_cycle[qi, slot]))
-            )
+    for s in seg_root:
+        for slot in range(len(slot_meta.get(s, []))):
+            if not evicted[s, slot]:
+                continue
+            cyc = int(evict_cycle[s, slot])
+            ws = victim_of.get((s, slot))
+            if ws is not None:
+                preempted.append(
+                    (ws.workload, row_names[int(sowner[s, slot])], cyc)
+                )
+            else:
+                qi, pos = int(sslot_q[s, slot]), int(sslot_l[s, slot])
+                i = plan.head_of.get((qi, pos))
+                if i is not None:
+                    preempted.append(
+                        (lowered.heads[i], lowered.cq_names[i], cyc)
+                    )
     preempted.sort(key=lambda t: t[2])
     fb = [
         (lowered.heads[i], lowered.cq_names[i]) for i in plan.fallback

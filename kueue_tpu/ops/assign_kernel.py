@@ -146,6 +146,9 @@ def cell_masks(
     usage=None,  # precomputed usage_tree, or None to build it
     avail=None,  # precomputed available_all (once per cycle)
     potential=None,  # precomputed potential_available_all (constant)
+    pwb=None,  # bool[W] canPreemptWhileBorrowing: the CQ's preempt mode
+    #            also covers requests above nominal
+    #            (flavorassigner.py:425-441, borrowWithinCohort != Never)
 ):
     """Per-cell classification masks against the cycle-start snapshot
     (zero/pad cells are permissive): fit, preempt-eligible, the reclaim
@@ -172,8 +175,11 @@ def cell_masks(
     has_cohort = (tree.parent[cq] >= 0)[:, None]
 
     fit_cells = jnp.where(cell_need, avail_wkc >= qty, True)
+    nominal_ok = qty <= nominal_wkc
+    if pwb is not None:
+        nominal_ok = nominal_ok | pwb[:, None, None]
     pot_cells = jnp.where(
-        cell_need, (qty <= potential_wkc) & (qty <= nominal_wkc), True
+        cell_need, (qty <= potential_wkc) & nominal_ok, True
     )
     reclaim_cells = jnp.where(cell_need, local_wkc + qty <= nominal_wkc, True)
     borrow_cells = (

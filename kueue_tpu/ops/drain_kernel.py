@@ -198,7 +198,7 @@ def _group_walk(
 
 def _nominate_multi(
     tree, subtree, guaranteed, local, usage0, queues, q_idx, cur, active,
-    g_start, potential, victims=None, elig_v=None,
+    g_start, potential, vcells_q=None, elig_v=None, pwb=None,
 ):
     """Sequential multi-podset nomination for the current heads.
 
@@ -210,11 +210,13 @@ def _nominate_multi(
     cleared); preempt-mode podsets keep accumulating.
 
     Returns (is_fit, is_pre, pending, head_borrow, rep_k [Q,P],
-    next_start [Q,P,G], mcells [Q,P*C], mqty [Q,P*C]) where
-    mcells/mqty are the merged representative cells with per-fr
+    next_start [Q,P,G], mcells [Q,P*C], mqty [Q,P*C], mneed [Q,P*C])
+    where mcells/mqty are the merged representative cells with per-fr
     quantities SUMMED onto the first occurrence (duplicates zeroed), so
     fits checks, usage deltas and reservations each count shared cells
-    once."""
+    once; mneed marks the merged cells whose resource was classified
+    preempt-mode (the host's frs_need_preemption — any podset whose
+    choice at that flavor-resource did not Fit)."""
     from kueue_tpu.ops.assign_kernel import available_all, cell_masks
 
     q, l, pmax, k, c = queues.cells.shape
@@ -225,12 +227,26 @@ def _nominate_multi(
     n_fr = local.shape[1]
     head_cq = jnp.where(active, queues.cq_rows, -1).astype(jnp.int32)
 
+    veto = None
+    if vcells_q is not None:
+        # reclaim-oracle victim check (preemption_oracle.go emulation):
+        # a flavor-resource cell carrying an ELIGIBLE same-CQ victim
+        # cannot be upgraded to RECLAIM. Scattered once per cycle into
+        # a dense [Q, FR] mask, then gathered per podset below.
+        vq3 = vcells_q.shape
+        qq3 = jnp.broadcast_to(jnp.arange(q)[:, None, None], vq3)
+        veto = (
+            jnp.zeros((q, n_fr + 1), dtype=bool)
+            .at[qq3, jnp.where(vcells_q >= 0, vcells_q, n_fr)]
+            .max(elig_v[:, :, None] & (vcells_q >= 0))[:, :n_fr]
+        )
+
     accum = jnp.zeros((q, n_fr), dtype=jnp.int64)
     processed = jnp.ones(q, dtype=bool)
     head_mode = jnp.full(q, 3, dtype=jnp.int32)
     head_borrow = jnp.zeros(q, dtype=bool)
     pending = jnp.zeros(q, dtype=bool)
-    rep_list, nstart_list, cells_list, qty_list = [], [], [], []
+    rep_list, nstart_list, cells_list, qty_list, need_list = [], [], [], [], []
     npod = queues.n_podsets[q_idx, cur]  # [Q]
 
     for p in range(pmax):
@@ -247,18 +263,13 @@ def _nominate_multi(
         fit_cells, pot_cells, reclaim_cells, borrow_cells, cell_need = (
             cell_masks(
                 tree, subtree, guaranteed, local, head_cq, cells_p, infl,
-                usage=usage0, avail=avail0, potential=potential,
+                usage=usage0, avail=avail0, potential=potential, pwb=pwb,
             )
         )
-        if victims is not None:
-            # reclaim-oracle victim check at this podset's cells
-            vmatch = (
-                victims.vcells[:, None, :, :, None]
-                == jnp.maximum(cells_p, 0)[:, :, None, None, :]
-            ) & (victims.vcells >= 0)[:, None, :, :, None]
-            victim_on_cell = jnp.any(
-                vmatch & elig_v[:, None, :, None, None], axis=(2, 3)
-            )
+        if veto is not None:
+            victim_on_cell = veto[
+                q_idx[:, None, None], jnp.maximum(cells_p, 0)
+            ] & (cells_p >= 0)
             reclaim_cells = reclaim_cells & ~victim_on_cell
         gid_p = queues.gidx[q_idx, cur, p]
         gl_p = queues.glast[q_idx, cur, p]
@@ -286,6 +297,14 @@ def _nominate_multi(
         )[:, 0]
         cells_rep = jnp.where(use_p[:, None] & (cells_rep >= 0), cells_rep, -1)
         qty_rep = jnp.where(cells_rep >= 0, qty_rep, 0)
+        # cells of this podset's choice that did NOT fit at cycle-start
+        # usage = its frs_need_preemption contribution (the host reads
+        # choice.mode == Preempt per resource; cellmode < 3 is the same
+        # predicate at the representative candidate)
+        fit_rep = jnp.take_along_axis(
+            fit_cells, rep_safe[:, None, None], axis=1
+        )[:, 0]  # [Q,C]
+        need_rep = (cells_rep >= 0) & (qty_rep > 0) & ~fit_rep
         if p < pmax - 1:
             # assignment_usage grows for fit AND preempt choices alike
             # (skipped after the last podset: nobody reads it)
@@ -307,11 +326,13 @@ def _nominate_multi(
         nstart_list.append(jnp.where(live[:, None], nstart_p, 0))
         cells_list.append(cells_rep)
         qty_list.append(qty_rep)
+        need_list.append(need_rep)
 
     rep_k = jnp.stack(rep_list, axis=1)  # [Q,P]
     next_start = jnp.stack(nstart_list, axis=1)  # [Q,P,G]
     mcells = jnp.concatenate(cells_list, axis=1)  # [Q,P*C]
     mqty = jnp.concatenate(qty_list, axis=1)
+    mneed = jnp.concatenate(need_list, axis=1)
     if pmax > 1:
         # merge duplicate frs: sum onto the first occurrence, zero the
         # rest (the host fits()/reserve vectors are per-fr sums); a
@@ -324,13 +345,17 @@ def _nominate_multi(
         first = ~jnp.any(
             same & (pos[None, None, :] < pos[None, :, None]), axis=2
         )
+        # frs_need is a SET union across podsets: any podset's preempt-
+        # mode choice at the fr marks the merged cell
+        mneed = jnp.any(same & mneed[:, None, :], axis=2) & first
         mqty = jnp.where(first & (mcells >= 0), summed, 0)
         mcells = jnp.where(first, mcells, -1)
 
     is_fit = active & (head_mode == 3)
     is_pre = active & (head_mode >= 1) & (head_mode < 3)
     pend = pending & is_pre  # NoFit nominations clear the cursor
-    return is_fit, is_pre, pend, head_borrow, rep_k, next_start, mcells, mqty
+    return (is_fit, is_pre, pend, head_borrow, rep_k, next_start,
+            mcells, mqty, mneed)
 
 
 def solve_drain(
@@ -363,7 +388,7 @@ def solve_drain(
         cur = jnp.minimum(cursor, l - 1)
         usage0 = usage_tree(tree, guaranteed, local)
         (is_fit, is_pre, pend, head_borrow, rep_k, walk_next,
-         cells_eff, qty_eff) = _nominate_multi(
+         cells_eff, qty_eff, _mneed) = _nominate_multi(
             tree, subtree, guaranteed, local, usage0, queues, q_idx, cur,
             active, g_start, potential,
         )
@@ -548,39 +573,79 @@ def solve_drain(
     )
 
 
-class VictimPanels(NamedTuple):
-    """Per-ClusterQueue admitted-workload (candidate) panels for the
-    preemption-enabled drain. V victim slots, Cv cells per victim.
+class SegVictims(NamedTuple):
+    """Per-root-cohort (segment) candidate pools + per-queue search
+    config for the preemption-enabled drain.
 
-    vcells: int32[Q,V,Cv] — GLOBAL flavor-resource cell ids of the
-            victim's admitted usage (-1 pads).
-    vqty:   int64[Q,V,Cv] — usage quantity per cell.
-    vprio:  int64[Q,V] / vts: int64[Q,V] — priority and queue-order
-            timestamp (the LowerOrNewerEqualPriority rule compares the
-            preemptor's timestamp against the candidate's).
-    vvalid: bool[Q,V].
-    can_preempt:  bool[Q] — withinClusterQueue != Never.
+    S segments, V pool slots per segment, Cv cells per victim, M local
+    nodes per segment (the segment's CQs + interior cohorts + root),
+    D+1 global path length, Q queues, L entries per queue.
+
+    Pool slots come in two parts. Part A: workloads already admitted in
+    the snapshot — their cells/qty are static. Part B: one slot per
+    pending queue entry of the segment — invalid until the drain admits
+    the entry, at which point the kernel fills the slot with the
+    admitted cells/qty so the entry becomes a live reclaim candidate
+    for later cycles (the host cycle loop sees drain-admitted workloads
+    in its snapshot the same way; preemption.go:480-524).
+
+    scells/sqty: int32/int64[S,V,Cv] — GLOBAL flavor-resource cells of
+            the slot's admitted usage (-1 pads; part B -1 until filled).
+    sprio/sts: int64[S,V] — priority and queue-order timestamp (the
+            LowerOrNewerEqualPriority rule compares the preemptor's
+            timestamp against the candidate's).
+    svalid0: bool[S,V] — slot live at drain start (part A only).
+    sowner: int32[S,V] — owner ClusterQueue's global tree row (-1 pad).
+    sowner_local: int32[S,V] — owner CQ's segment-local node id.
+    sslot_q/sslot_l: int32[S,V] — part B: the (queue, position) of the
+            entry occupying this slot (-1 for part A).
+    seg_nodes: int32[S,M] — global rows of the segment's nodes (-1 pad).
+    lpaths: int32[S,M,D+1] — each local node's ancestor path expressed
+            in LOCAL node ids (leaf first, -1 beyond the root).
+    hlocal: int32[Q] — each queue's CQ as a local node id.
+    perm: int32[Q,V] — the queue's candidate order over its segment's
+            slots (preemption.go:591-618: evicted first, other-CQ
+            first, lowest priority, most recently reserved; in-drain
+            admissions all share one reservation instant).
+    entry_slot: int32[Q,L] — part-B pool slot of each entry (-1 none).
+    same_enabled: bool[Q] — withinClusterQueue != Never.
     same_prio_ok: bool[Q] — policy == LowerOrNewerEqualPriority.
-
-    Victim slots arrive pre-sorted in the host's candidate order
-    (preemption.go:591-618: evicted first, lowest priority, newest) —
-    remove-until-fit scans them in slot order.
+    reclaim_enabled: bool[Q] — reclaimWithinCohort != Never (w/ cohort).
+    only_lower: bool[Q] — reclaimWithinCohort == LowerPriority.
+    bwc: bool[Q] — borrowWithinCohort.policy != Never.
+    bwc_thr1: int64[Q] — maxPriorityThreshold+1 (NO_LIMIT when unset);
+            the runtime threshold is min(head priority, bwc_thr1)
+            (preemption.go:194-204).
     """
 
-    vcells: jnp.ndarray
-    vqty: jnp.ndarray
-    vprio: jnp.ndarray
-    vts: jnp.ndarray
-    vvalid: jnp.ndarray
-    can_preempt: jnp.ndarray
+    scells: jnp.ndarray
+    sqty: jnp.ndarray
+    sprio: jnp.ndarray
+    sts: jnp.ndarray
+    svalid0: jnp.ndarray
+    sowner: jnp.ndarray
+    sowner_local: jnp.ndarray
+    sslot_q: jnp.ndarray
+    sslot_l: jnp.ndarray
+    seg_nodes: jnp.ndarray
+    lpaths: jnp.ndarray
+    hlocal: jnp.ndarray
+    perm: jnp.ndarray
+    entry_slot: jnp.ndarray
+    same_enabled: jnp.ndarray
     same_prio_ok: jnp.ndarray
+    reclaim_enabled: jnp.ndarray
+    only_lower: jnp.ndarray
+    bwc: jnp.ndarray
+    bwc_thr1: jnp.ndarray
 
 
 class PreemptDrainResult(NamedTuple):
     """status: int32[Q,L] final entry state (0 pending=never decided
     before max_cycles, 1 parked, 2 admitted); admitted_k / admitted_cycle
-    as DrainResult; evicted: bool[Q,V] victim was preempted;
-    evicted_cycle: int32[Q,V]; cycles; local_usage."""
+    as DrainResult; evicted: bool[S,V] pool slot was preempted (part-A
+    snapshot victims AND part-B drain-admitted entries);
+    evicted_cycle: int32[S,V]; cycles; local_usage."""
 
     status: jnp.ndarray
     admitted_k: jnp.ndarray
@@ -592,92 +657,127 @@ class PreemptDrainResult(NamedTuple):
     local_usage: jnp.ndarray
 
 
-def _victim_search_one(
-    hpath: jnp.ndarray,  # int32[D+1] head ancestor path
-    cells: jnp.ndarray,  # int32[C] head candidate cells
-    qty: jnp.ndarray,  # int64[C]
-    cell_need: jnp.ndarray,  # bool[C]
-    vq_at: jnp.ndarray,  # int64[V,C] victim usage gathered at head cells
-    eligible: jnp.ndarray,  # bool[V]
-    active: jnp.ndarray,  # bool scalar
-    usage0: jnp.ndarray,  # int64[N,FR] cycle-start usage tree
-    subtree: jnp.ndarray,
-    guaranteed: jnp.ndarray,
-    borrowing_limit: jnp.ndarray,
+def _compact_candidates(cand_ord: jnp.ndarray, width: int):
+    """Pack the True positions of ``cand_ord`` (bool[Q,V], already in
+    per-queue candidate order) into the first ``width`` slots.
+
+    Returns (comp int32[Q,width] of ord indices, -1 pads; overflow
+    bool[Q]). minimalPreemptions stops at the first fitting prefix, so
+    truncating the candidate list is exact whenever the search succeeds
+    within the window or fails without overflow; a failed search WITH
+    overflow is inconclusive and the caller must freeze the queue as a
+    no-decision (host fallback) rather than park it."""
+    qn, v = cand_ord.shape
+    rank = jnp.cumsum(cand_ord.astype(jnp.int32), axis=1) - 1
+    dest = jnp.where(cand_ord & (rank < width), rank, width)
+    qq = jnp.broadcast_to(jnp.arange(qn)[:, None], (qn, v))
+    src = jnp.broadcast_to(jnp.arange(v, dtype=jnp.int32)[None, :], (qn, v))
+    comp = (
+        jnp.full((qn, width + 1), -1, dtype=jnp.int32)
+        .at[qq, dest]
+        .set(src)[:, :width]
+    )
+    overflow = jnp.any(cand_ord & (rank >= width), axis=1)
+    return comp, overflow
+
+
+def _ladder_search_one(
+    enabled,  # bool scalar — run this attempt at all
+    ab_init,  # bool scalar — attempt's starting allowBorrowing
+    thr_on,  # bool scalar — borrowWithinCohort threshold active
+    thr,  # int64 scalar — allowBorrowingBelowPriority
+    comp,  # int32[Ve] compacted ord indices (-1 pads)
+    vq_ord,  # int64[V,C] candidate usage at head cells, per-queue order
+    same_ord,  # bool[V]
+    prio_ord,  # int64[V]
+    olocal_ord,  # int32[V] owner CQ local node id
+    u0_sub,  # int64[M,C] cycle-start bubbled usage on segment nodes
+    lf0_sub,  # int64[M,C] cycle-start leaf usage
+    g_sub,  # int64[M,C] guaranteed
+    sub_sub,  # int64[M,C] subtree quota
+    bl_sub,  # int64[M,C] borrowing limit
+    nom_sub,  # int64[M,C] nominal
+    lpaths_q,  # int32[M,D+1] local ancestor paths
+    hlocal_q,  # int32 head CQ local id
+    qty,  # int64[C] head request (merged podsets)
+    cell_need,  # bool[C]
+    need_pre,  # bool[C] cells in frs_need_preemption
     max_depth: int,
 ):
-    """minimalPreemptions for one head over same-CQ candidates
-    (preemption.go:275-342), evaluated along the head's ancestor path
-    only — every candidate shares the head's CQ, so removal deltas
-    propagate along exactly this path, and only the head's candidate
-    cells constrain the fit. Single ladder attempt with borrowing
-    allowed (all candidates in-CQ — preemption.go:127-191).
+    """One minimalPreemptions attempt for one head over its segment's
+    candidate pool (preemption.go:275-342), on segment-local panels —
+    the drain twin of preempt_kernel._solve_one, with the same in-loop
+    semantics: other-CQ candidates only count while their CQ still
+    borrows in a cell needing preemption (preemption.go:300), the
+    borrowWithinCohort priority threshold permanently disables
+    borrowing (:307-312), fit = available() along the head's path plus
+    the nominal cap when borrowing is disallowed (:552-574), and
+    fill-back re-adds candidates in reverse (:318-338).
 
-    Returns (targets bool[V], success bool)."""
-    n_cand = vq_at.shape[0]
-    g_path = _gather_cells(guaranteed, hpath, cells)  # [D+1, C]
-    sub_path = _gather_cells(subtree, hpath, cells)
-    bl_path = _gather_cells(borrowing_limit, hpath, cells)
-    u0_path = _gather_cells(usage0, hpath, cells)
-    valid_d = hpath >= 0  # [D+1]
-    root_pos = jnp.sum(valid_d.astype(jnp.int32)) - 1
+    Returns (removed bool[Ve] in STEP space, found bool)."""
+    from kueue_tpu.ops.preempt_kernel import _avail_local, _bubble_local
 
-    def avail_of(u_path):
-        avail = jnp.zeros_like(qty)
-        for d in range(max_depth, -1, -1):
-            is_root = d == root_pos
-            root_avail = sub_path[d] - u_path[d]
-            stored = sub_path[d] - g_path[d]
-            used = jnp.maximum(0, u_path[d] - g_path[d])
-            with_max = stored - used + bl_path[d]
-            clamped = jnp.where(
-                bl_path[d] < NO_LIMIT, jnp.minimum(with_max, avail), avail
-            )
-            nonroot = jnp.maximum(0, g_path[d] - u_path[d]) + clamped
-            avail = jnp.where(valid_d[d], jnp.where(is_root, root_avail, nonroot), avail)
-        return avail
+    ve = comp.shape[0]
+    hl = jnp.maximum(hlocal_q, 0)
+    hpath = lpaths_q[hl]
 
-    def bubble(u_path, delta, apply):
-        d_c = jnp.where(apply, delta, 0)
-        for d in range(0, max_depth + 1):
-            old = u_path[d]
-            new = old + d_c
-            u_path = u_path.at[d].set(jnp.where(valid_d[d], new, old))
-            over_old = jnp.maximum(0, old - g_path[d])
-            over_new = jnp.maximum(0, new - g_path[d])
-            d_c = jnp.where(valid_d[d], over_new - over_old, d_c)
-        return u_path
+    def fits(u, lf, ab):
+        avail = _avail_local(hpath, u, sub_sub, g_sub, bl_sub, max_depth)
+        ok = jnp.all(jnp.where(cell_need, avail >= qty, True))
+        nb_ok = jnp.all(
+            jnp.where(cell_need, lf[hl] + qty <= nom_sub[hl], True)
+        )
+        return ok & (ab | nb_ok)
 
-    def fits(u_path):
-        return jnp.all(jnp.where(cell_need, avail_of(u_path) >= qty, True))
-
-    def rm_body(carry, v):
-        u_path, done, fit_at, removed = carry
-        act = eligible[v] & ~done & active
-        u_path = bubble(u_path, -vq_at[v], act)
-        removed = removed.at[v].set(act)
-        now_fits = act & fits(u_path)
-        fit_at = jnp.where(now_fits & ~done, v, fit_at)
+    def rm_body(carry, j):
+        u, lf, ab, done, fit_at, removed = carry
+        v = comp[j]
+        vv = jnp.maximum(v, 0)
+        same = same_ord[vv]
+        ol = jnp.maximum(olocal_ord[vv], 0)
+        # other-CQ candidates only while their CQ still borrows (in the
+        # simulated state) in a cell needing preemption
+        ob = jnp.any((lf[ol] > nom_sub[ol]) & need_pre)
+        act = (v >= 0) & ~done & enabled & (same | ob)
+        flip = act & (~same) & thr_on & (prio_ord[vv] >= thr)
+        ab = ab & ~flip
+        u = _bubble_local(lpaths_q[ol], -vq_ord[vv], u, g_sub, max_depth, act)
+        lf = lf.at[ol].add(jnp.where(act, -vq_ord[vv], 0))
+        removed = removed.at[j].set(act)
+        now_fits = act & fits(u, lf, ab)
+        fit_at = jnp.where(now_fits & ~done, j, fit_at)
         done = done | now_fits
-        return (u_path, done, fit_at, removed), None
+        return (u, lf, ab, done, fit_at, removed), None
 
-    init = (u0_path, ~active, jnp.int32(-1), jnp.zeros(n_cand, dtype=bool))
-    (u_path, done, fit_at, removed), _ = lax.scan(
-        rm_body, init, jnp.arange(n_cand, dtype=jnp.int32)
+    init = (
+        u0_sub,
+        lf0_sub,
+        ab_init & enabled,
+        ~enabled,
+        jnp.int32(-1),
+        jnp.zeros(ve, dtype=bool),
     )
-    found = done & active
+    (u, lf, ab, done, fit_at, removed), _ = lax.scan(
+        rm_body, init, jnp.arange(ve, dtype=jnp.int32)
+    )
+    found = done & enabled
 
-    def fb_body(carry, v):
-        u_path, removed = carry
-        act = found & removed[v] & (v != fit_at)
-        u2 = bubble(u_path, vq_at[v], act)
-        keep = act & fits(u2)
-        u_path = jnp.where(keep, u2, u_path)
-        removed = removed.at[v].set(removed[v] & ~keep)
-        return (u_path, removed), None
+    def fb_body(carry, j):
+        u, lf, removed = carry
+        v = comp[j]
+        vv = jnp.maximum(v, 0)
+        ol = jnp.maximum(olocal_ord[vv], 0)
+        act = found & removed[j] & (j != fit_at)
+        u2 = _bubble_local(lpaths_q[ol], vq_ord[vv], u, g_sub, max_depth, act)
+        lf2 = lf.at[ol].add(jnp.where(act, vq_ord[vv], 0))
+        keep = act & fits(u2, lf2, ab)
+        u = jnp.where(keep, u2, u)
+        lf = jnp.where(keep, lf2, lf)
+        removed = removed.at[j].set(removed[j] & ~keep)
+        return (u, lf, removed), None
 
-    (u_path, removed), _ = lax.scan(
-        fb_body, (u_path, removed), jnp.arange(n_cand - 1, -1, -1, dtype=jnp.int32)
+    (u, lf, removed), _ = lax.scan(
+        fb_body, (u, lf, removed), jnp.arange(ve - 1, -1, -1, dtype=jnp.int32)
     )
     return removed & found, found
 
@@ -686,39 +786,50 @@ def solve_drain_preempt(
     tree: QuotaTree,
     local_usage: jnp.ndarray,  # int64[N, FR]
     queues: DrainQueues,
-    victims: VictimPanels,
+    victims: SegVictims,
     paths: jnp.ndarray,  # int32[N, D+1]
     n_segments: int,
     n_steps: int,
     max_cycles: int,
+    search_width: int = 32,
 ) -> PreemptDrainResult:
-    """Multi-cycle drain with classic within-ClusterQueue preemption on
-    the device. Per cycle:
+    """Multi-cycle drain with classic preemption on the device —
+    within-ClusterQueue AND cross-CQ cohort reclamation. Per cycle:
 
     - phase 1: flavor classification (Fit / Preempt / NoFit) against
-      cycle-start usage, plus a batched minimalPreemptions victim
-      search for preempt-classified heads;
+      cycle-start usage, plus a batched minimalPreemptions strategy
+      ladder (preemption.go:144-191) for preempt-classified heads over
+      their segment's candidate pool: same-CQ candidates under the
+      withinClusterQueue priority rule plus candidates from borrowing
+      member CQs under reclaimWithinCohort / borrowWithinCohort
+      (preemption.go:480-524, :194-204);
     - phase 2: segmented scan in entry order; preempting entries remove
-      their victims, re-check fits (scheduler.go:211-292), and charge
-      their usage for the remainder of the cycle;
-    - cycle end: admitted heads leave and charge leaf usage; successful
-      preempters' victims are EVICTED (leaf usage released — the
-      reconciler's stopJob/delete round-trip, compressed to the cycle
-      boundary) and the preempting head retries next cycle with its
-      flavor walk reset (the host clears LastAssignment on preemption
-      issue); blocked heads PARK, and any eviction in a root cohort
-      reactivates that cohort's parked entries
+      their victims (exact cross-CQ propagation: the usage tree is
+      recomputed from leaf rows each step), re-check fits
+      (scheduler.go:211-292), and charge their usage; heads whose
+      targets overlap an earlier eviction this cycle are SKIPPED and
+      retry (the scheduler's overlapping-preemption-targets guard);
+    - cycle end: admitted heads leave, charge leaf usage, and fill
+      their part-B pool slot so they become live reclaim candidates
+      for later cycles (the host cycle loop sees drain-admitted
+      workloads in its snapshot); evicted victims release their usage
+      at their OWNER row, and any eviction in a root cohort reactivates
+      that cohort's parked entries
       (queue.Manager.QueueAssociatedInadmissibleWorkloadsAfter).
 
     Entry state is per-(queue, position): pending(0)/parked(1)/
     admitted(2); each queue's head is its first pending entry in heap
-    order. Scope (host lowering enforces): multi-podset heads (up to
-    max_podsets), any flavorFungibility policy, any number of resource
-    groups — the per-group cursor vectors and the reclaim-oracle
-    emulation cover the cartesian candidate walk. Remaining exclusions
-    routed to host fallback by the lowering: TAS topology requests,
-    cohort reclaim / borrowWithinCohort candidate scopes, fair sharing,
-    and heads past the candidate/cell caps.
+    order. A drain-admitted entry later reclaimed keeps status 2 and is
+    additionally reported evicted — the caller applies admissions and
+    evictions in cycle order. Scope (host lowering enforces):
+    multi-podset heads, any flavorFungibility policy, any number of
+    resource groups, all withinClusterQueue / reclaimWithinCohort /
+    borrowWithinCohort policies. Remaining exclusions routed to host
+    fallback: TAS topology requests, fair sharing, candidate/cell/pool
+    caps. A head whose eligible-candidate list overflows
+    ``search_width`` and whose search fails is frozen as a no-decision
+    (truncation is only exact when the search succeeds in-window or
+    fails without overflow — see _compact_candidates).
     """
     max_depth = tree.max_depth
     subtree, guaranteed = subtree_quota(tree)
@@ -727,21 +838,23 @@ def solve_drain_preempt(
     potential = potential_available_all(tree, subtree, guaranteed)
 
     q, l, pmax, k, c = queues.cells.shape
-    v = victims.vqty.shape[1]
+    s_dim, v, cv = victims.scells.shape
     q_idx = jnp.arange(q)
     l_idx = jnp.arange(l)
+    sq = jnp.maximum(queues.seg_id, 0)  # [Q]
+    cq = jnp.maximum(queues.cq_rows, 0)
+    can_search = victims.same_enabled | victims.reclaim_enabled
+    seg_rows = jnp.maximum(victims.seg_nodes, 0)  # [S, M]
 
     avail_v = jax.vmap(
         _avail_along_path, in_axes=(0, 0, None, None, None, None, None)
     )
-    search_v = jax.vmap(
-        _victim_search_one,
-        in_axes=(0, 0, 0, 0, 0, 0, 0, None, None, None, None, None),
-    )
+    ladder_v = jax.vmap(_ladder_search_one, in_axes=(0,) * 20 + (None,))
 
     def cycle_body(state):
         (local, status, g_start, retries, stuck, no_prog, adm_k,
-         adm_cycle, vevicted, evict_cycle, cycle) = state
+         adm_cycle, pcells, pqty, pvalid, vevicted, evict_cycle,
+         cycle) = state
 
         # head of each queue = first pending entry in heap order
         entry_pending = status == 0  # [Q,L]
@@ -752,53 +865,161 @@ def solve_drain_preempt(
 
         prio = queues.priority[q_idx, cur]
         ts = queues.timestamp[q_idx, cur]
-        # Victim-eligibility predicate (preemption.go:480-524 priority
-        # rule), shared by the reclaim-oracle emulation inside the
-        # nomination and the victim search below.
-        live_victim = victims.vvalid & ~vevicted  # [Q,V]
-        lower = victims.vprio < prio[:, None]
+
+        # ---- per-queue views of the segment candidate pool ----
+        live_q = (pvalid & ~vevicted)[sq]  # [Q,V]
+        sprio_q = victims.sprio[sq]
+        sts_q = victims.sts[sq]
+        olocal_q = jnp.maximum(victims.sowner_local[sq], 0)  # [Q,V]
+        slot_ok = victims.sowner[sq] >= 0  # [Q,V]
+        same_q = slot_ok & (
+            victims.sowner_local[sq] == victims.hlocal[:, None]
+        )
+
+        # same-CQ victim-eligibility (preemption.go:480-524 priority
+        # rule) — shared by the reclaim-oracle emulation inside the
+        # nomination and the ladder search below
+        lower = sprio_q < prio[:, None]
         newer_eq = (
             victims.same_prio_ok[:, None]
-            & (victims.vprio == prio[:, None])
-            & (ts[:, None] < victims.vts)
+            & (sprio_q == prio[:, None])
+            & (ts[:, None] < sts_q)
         )
-        elig_v = live_victim & (lower | newer_eq)  # [Q,V]
+        elig_same = (
+            live_q & same_q & victims.same_enabled[:, None]
+            & (lower | newer_eq)
+        )
 
         usage0 = usage_tree(tree, guaranteed, local)
+        pcells_q = pcells[sq]  # [Q,V,Cv]
+        pqty_q = pqty[sq]
         (is_fit, is_pre, pend_flavors, head_borrow, rep_k, walk_next,
-         cells_eff, qty_eff) = _nominate_multi(
+         cells_eff, qty_eff, need_pre) = _nominate_multi(
             tree, subtree, guaranteed, local, usage0, queues, q_idx, cur,
-            active, g_start, potential, victims=victims, elig_v=elig_v,
+            active, g_start, potential, vcells_q=pcells_q,
+            elig_v=elig_same, pwb=victims.bwc,
         )
         nofit = ~(is_fit | is_pre)
-        cell_need = (cells_eff >= 0) & (qty_eff > 0)
-        cq = jnp.maximum(queues.cq_rows, 0)
+        cell_need = (cells_eff >= 0) & (qty_eff > 0)  # [Q,C']
+        cells_c = jnp.maximum(cells_eff, 0)
 
-        # ---- batched victim search for preempt-classified heads ----
-        # victim usage gathered at the head's candidate cells: the fit
-        # check reads only those cells, and same-CQ candidates bubble
-        # along exactly the head's path (cell dynamics independent)
-        match = victims.vcells[:, :, :, None] == jnp.maximum(cells_eff, 0)[:, None, None, :]
-        match = match & (victims.vcells >= 0)[:, :, :, None]
+        # ---- segment-local panels at this cycle's head cells ----
+        rows_q = seg_rows[sq]  # [Q, M] global rows
+        u0_sub = usage0[rows_q[:, :, None], cells_c[:, None, :]]
+        lf0_sub = local[rows_q[:, :, None], cells_c[:, None, :]]
+        g_sub = guaranteed[rows_q[:, :, None], cells_c[:, None, :]]
+        sub_sub = subtree[rows_q[:, :, None], cells_c[:, None, :]]
+        bl_sub = tree.borrowing_limit[
+            rows_q[:, :, None], cells_c[:, None, :]
+        ]
+        nom_sub = tree.nominal[rows_q[:, :, None], cells_c[:, None, :]]
+        lpaths_q = victims.lpaths[sq]  # [Q, M, D+1]
+
+        # victim usage gathered at head cells
+        match = pcells_q[:, :, :, None] == cells_c[:, None, None, :]
+        match = match & (pcells_q >= 0)[:, :, :, None]
         vq_at = jnp.sum(
-            jnp.where(match, victims.vqty[:, :, :, None], 0), axis=2
-        )  # [Q, V, C]
-        is_pre_head = is_pre & victims.can_preempt
-        # candidate filter: the shared priority predicate above +
-        # uses-a-needed-flavor-resource
-        uses = jnp.any(vq_at * cell_need[:, None, :].astype(jnp.int64) > 0, axis=2)
-        eligible = elig_v & uses
+            jnp.where(match, pqty_q[:, :, :, None], 0), axis=2
+        )  # [Q, V, C']
 
-        targets, psuccess = search_v(
-            paths[cq], cells_eff, qty_eff, cell_need, vq_at, eligible,
-            is_pre_head, usage0, subtree, guaranteed, tree.borrowing_limit,
-            max_depth,
-        )  # [Q,V], [Q]
-        psuccess = psuccess & is_pre_head
-        # victims' summed usage at head cells — the phase-2 removal delta
-        vminus = jnp.sum(
-            jnp.where(targets[:, :, None], vq_at, 0), axis=1
-        )  # [Q, C]
+        # ---- candidate eligibility (preemption.go:480-524) ----
+        # candidates must use a flavor-resource needing preemption
+        uses = jnp.any(
+            vq_at * need_pre[:, None, :].astype(jnp.int64) > 0, axis=2
+        )
+        # other-CQ candidates: their CQ borrows at cycle start in a
+        # cell needing preemption (discovery-time _cq_is_borrowing)
+        borrow_by_local = jnp.any(
+            (lf0_sub > nom_sub) & need_pre[:, None, :], axis=2
+        )  # [Q, M]
+        owner_borrow0 = jnp.take_along_axis(borrow_by_local, olocal_q, axis=1)
+        oth_prio_ok = (~victims.only_lower[:, None]) | lower
+        elig_other = (
+            live_q & ~same_q & slot_ok
+            & victims.reclaim_enabled[:, None]
+            & oth_prio_ok & owner_borrow0
+        )
+        elig = uses & (elig_same | elig_other)
+
+        # ---- the strategy ladder (preemption.go:144-191) ----
+        hl = jnp.maximum(victims.hlocal, 0)
+        lf0_h = lf0_sub[q_idx, hl]  # [Q, C']
+        nom_h = nom_sub[q_idx, hl]
+        under_nominal = jnp.all(
+            jnp.where(need_pre, lf0_h < nom_h, True), axis=1
+        )
+        other_exists = jnp.any(elig & ~same_q, axis=1)
+        thr = jnp.minimum(prio, victims.bwc_thr1)  # [Q]
+        case_a = ~other_exists
+        case_b = other_exists & victims.bwc
+        case_c = other_exists & ~victims.bwc & under_nominal
+        # remaining: straight to the same-queue fallback attempt
+        cand1 = jnp.where(
+            case_b[:, None],
+            elig
+            & (same_q | (sprio_q < thr[:, None]) | under_nominal[:, None]),
+            jnp.where((case_a | case_c)[:, None], elig, elig & same_q),
+        )
+        ab1 = ~case_c  # reclaim-without-borrowing attempt disallows it
+        thr_on1 = case_b
+        run2 = case_c  # failed attempt C falls back to same-queue
+        cand2 = elig & same_q
+
+        enabled1 = active & is_pre & can_search
+        ord_of = victims.perm  # [Q,V] slot ids in candidate order
+
+        def to_ord(x):
+            return jnp.take_along_axis(x, ord_of, axis=1)
+
+        vq_ord = jnp.take_along_axis(vq_at, ord_of[:, :, None], axis=1)
+        same_ord = to_ord(same_q)
+        prio_ord = to_ord(sprio_q)
+        olocal_ord = to_ord(olocal_q)
+        comp1, over1 = _compact_candidates(to_ord(cand1), search_width)
+        comp2, over2 = _compact_candidates(to_ord(cand2), search_width)
+
+        rm1, found1 = ladder_v(
+            enabled1, ab1, thr_on1, thr, comp1, vq_ord, same_ord,
+            prio_ord, olocal_ord, u0_sub, lf0_sub, g_sub, sub_sub,
+            bl_sub, nom_sub, lpaths_q, victims.hlocal, qty_eff,
+            cell_need, need_pre, max_depth,
+        )
+        rm2, found2 = ladder_v(
+            enabled1 & run2, jnp.ones(q, dtype=bool),
+            jnp.zeros(q, dtype=bool), thr, comp2, vq_ord, same_ord,
+            prio_ord, olocal_ord, u0_sub, lf0_sub, g_sub, sub_sub,
+            bl_sub, nom_sub, lpaths_q, victims.hlocal, qty_eff,
+            cell_need, need_pre, max_depth,
+        )
+        # inconclusive truncated attempts freeze the head as a
+        # no-decision. An attempt-1 overflow-and-miss is inconclusive
+        # REGARDLESS of attempt 2: the untruncated host ladder may have
+        # succeeded at attempt 1 with different (cross-CQ) targets, so
+        # a fallback attempt-2 success must not mask it.
+        p1_bad = over1 & ~found1
+        p2_bad = run2 & over2 & ~found2
+        untrusted = enabled1 & (p1_bad | (~found1 & p2_bad))
+        psuccess = is_pre & ~untrusted & (found1 | found2)
+
+        def to_slots(rm, comp, on):
+            # step space -> ord space -> slot space
+            slot_idx = jnp.take_along_axis(
+                ord_of, jnp.maximum(comp, 0), axis=1
+            )
+            valid = (comp >= 0) & rm & on[:, None]
+            slot_w = jnp.where(valid, slot_idx, v)
+            qq2 = jnp.broadcast_to(q_idx[:, None], slot_w.shape)
+            return (
+                jnp.zeros((q, v + 1), dtype=bool)
+                .at[qq2, slot_w]
+                .max(valid)[:, :v]
+            )
+
+        targets = jnp.where(
+            found1[:, None],
+            to_slots(rm1, comp1, found1),
+            to_slots(rm2, comp2, found2 & run2),
+        )  # [Q, V] slot space
 
         # ---- entry order: preempt-classified heads participate like
         # the host admit loop (successful searches charge usage +
@@ -821,7 +1042,9 @@ def solve_drain_preempt(
             .set(order.astype(jnp.int32), mode="drop")
         )
 
-        def step(usage, s):
+        def step(carry, s):
+            leaf, usage_c, ev_now = carry  # invariant: usage_c ==
+            #                                usage_tree(leaf)
             idx = mat[s]  # [G]
             act = idx >= 0
             hidx = jnp.maximum(idx, 0)
@@ -831,36 +1054,53 @@ def solve_drain_preempt(
             qty_ = qty_eff[hidx]
             ccells = jnp.maximum(cells_, 0)
             cell_valid = cell_need[hidx] & act[:, None]
-            pre_ = psuccess[hidx] & act
+            sq_h = sq[hidx]  # [G]
+            htarg = targets[hidx] & act[:, None]  # [G, V]
+            # overlapping-preemption-targets guard: an earlier head
+            # this cycle already evicted one of our victims -> skip
+            overlap = jnp.any(htarg & ev_now[sq_h], axis=1)
+            do_pre = psuccess[hidx] & act & ~overlap
 
-            # preempting entries: remove victims first (simulate the
-            # issue; the admit-loop removes targets before fits —
-            # scheduler.go:380-388)
-            delta_pre = jnp.where(
-                cell_valid & pre_[:, None], -vminus[hidx], 0
+            # remove victims at their OWNER leaf rows; on removal steps
+            # the usage tree is rebuilt from leaves, which propagates
+            # the removal through the victims' own ancestors exactly
+            # (usage is a deterministic function of leaf usage). Steps
+            # without removals — the common case — skip the rebuild and
+            # keep the incrementally-maintained tree.
+            pc_h = pcells[sq_h]  # [G, V, Cv]
+            pq_h = pqty[sq_h]
+            vrows = jnp.maximum(victims.sowner[sq_h], 0)  # [G, V]
+            rm_mask = htarg & do_pre[:, None]
+            rm_qty = jnp.where(rm_mask[:, :, None] & (pc_h >= 0), pq_h, 0)
+            rows_b = jnp.broadcast_to(vrows[:, :, None], pc_h.shape)
+            cols_b = jnp.maximum(pc_h, 0)
+            any_rm = jnp.any(rm_mask)
+            leaf2 = leaf.at[
+                rows_b.reshape(-1), cols_b.reshape(-1)
+            ].add(-rm_qty.reshape(-1))
+
+            usage = lax.cond(
+                any_rm,
+                lambda _: usage_tree(tree, guaranteed, leaf2),
+                lambda _: usage_c,
+                None,
             )
-            for d in range(0, max_depth + 1):
-                node = jnp.maximum(path[:, d], 0)
-                node_valid = (path[:, d] >= 0)[:, None]
-                g = guaranteed[node[:, None], ccells]
-                old = usage[node[:, None], ccells]
-                new = old + delta_pre
-                usage = usage.at[node[:, None], ccells].add(
-                    jnp.where(node_valid, delta_pre, 0)
-                )
-                delta_pre = jnp.where(
-                    node_valid,
-                    jnp.maximum(0, new - g) - jnp.maximum(0, old - g),
-                    delta_pre,
-                )
-
             avail = avail_v(
                 path, cells_, usage, subtree, guaranteed,
                 tree.borrowing_limit, max_depth,
             )
-            fits = jnp.all(jnp.where(cell_valid, avail >= qty_, True), axis=1)
+            fits = jnp.all(
+                jnp.where(cell_valid, avail >= qty_, True), axis=1
+            )
             admit = act & is_fit[hidx] & fits
-            pre_ok = pre_ & fits
+            pre_ok = do_pre & fits
+            # revert failed preempters' removals
+            revert = do_pre & ~fits
+            revert_qty = jnp.where(revert[:, None, None], rm_qty, 0)
+            leaf2 = leaf2.at[
+                rows_b.reshape(-1), cols_b.reshape(-1)
+            ].add(revert_qty.reshape(-1))
+
             reserve = (
                 act
                 & is_pre[hidx]
@@ -869,7 +1109,7 @@ def solve_drain_preempt(
             )
             nominal_c = tree.nominal[cqs[:, None], ccells]
             bl_c = tree.borrowing_limit[cqs[:, None], ccells]
-            leaf_usage_c = usage[cqs[:, None], ccells]
+            leaf_usage_c = leaf2[cqs[:, None], ccells]
             borrow_cap = jnp.where(
                 bl_c < NO_LIMIT,
                 jnp.minimum(qty_, nominal_c + bl_c - leaf_usage_c),
@@ -881,62 +1121,99 @@ def solve_drain_preempt(
             reserve_qty = jnp.where(
                 head_borrow[hidx][:, None], borrow_cap, nominal_cap
             )
-            # post delta: charge admitted + successful preempters
-            # (AddUsage runs for both — scheduler.go:211-292), reserve
-            # blocked no-reclaim heads, REVERT failed preempters
+            # charge admitted + successful preempters (AddUsage runs
+            # for both — scheduler.go:211-292), reserve blocked
+            # no-reclaim heads
             delta = jnp.where(
                 cell_valid & (admit | pre_ok)[:, None],
                 qty_,
-                jnp.where(
-                    cell_valid & reserve[:, None],
-                    reserve_qty,
-                    jnp.where(cell_valid & (pre_ & ~fits)[:, None], vminus[hidx], 0),
-                ),
+                jnp.where(cell_valid & reserve[:, None], reserve_qty, 0),
             )
-            for d in range(0, max_depth + 1):
-                node = jnp.maximum(path[:, d], 0)
-                node_valid = (path[:, d] >= 0)[:, None]
-                g = guaranteed[node[:, None], ccells]
-                old = usage[node[:, None], ccells]
-                new = old + delta
-                usage = usage.at[node[:, None], ccells].add(
-                    jnp.where(node_valid, delta, 0)
-                )
-                delta = jnp.where(
-                    node_valid,
-                    jnp.maximum(0, new - g) - jnp.maximum(0, old - g),
-                    delta,
-                )
-            return usage, (admit, pre_ok)
+            leaf2 = leaf2.at[cqs[:, None], ccells].add(
+                jnp.where(cell_valid, delta, 0)
+            )
 
-        _, (admit_sn, pre_ok_sn) = lax.scan(step, usage0, jnp.arange(n_steps))
+            def charge_inc(_):
+                # bubble the charges up the head paths (lanes are
+                # distinct root cohorts, so their paths are disjoint
+                # and the per-level scatters cannot collide)
+                u = usage
+                d = delta
+                for dep in range(0, max_depth + 1):
+                    node = jnp.maximum(path[:, dep], 0)
+                    node_valid = (path[:, dep] >= 0)[:, None]
+                    gq = guaranteed[node[:, None], ccells]
+                    old = u[node[:, None], ccells]
+                    new = old + d
+                    u = u.at[node[:, None], ccells].add(
+                        jnp.where(node_valid, d, 0)
+                    )
+                    d = jnp.where(
+                        node_valid,
+                        jnp.maximum(0, new - gq) - jnp.maximum(0, old - gq),
+                        d,
+                    )
+                return u
+
+            usage_n = lax.cond(
+                jnp.any(revert),
+                lambda _: usage_tree(tree, guaranteed, leaf2),
+                charge_inc,
+                None,
+            )
+            ev_now = ev_now.at[jnp.where(act, sq_h, s_dim)].max(
+                htarg & pre_ok[:, None], mode="drop"
+            )
+            return (leaf2, usage_n, ev_now), (admit, pre_ok)
+
+        (_, _, ev_now_f), (admit_sn, pre_ok_sn) = lax.scan(
+            step,
+            (local, usage0, jnp.zeros((s_dim, v), dtype=bool)),
+            jnp.arange(n_steps),
+        )
 
         flat_idx = mat.reshape(-1)
         safe_idx = jnp.where(flat_idx >= 0, flat_idx, q)
         admitted = (
-            jnp.zeros(q, dtype=bool).at[safe_idx].set(admit_sn.reshape(-1), mode="drop")
+            jnp.zeros(q, dtype=bool)
+            .at[safe_idx]
+            .set(admit_sn.reshape(-1), mode="drop")
         )
         preempt_ok = (
-            jnp.zeros(q, dtype=bool).at[safe_idx].set(pre_ok_sn.reshape(-1), mode="drop")
+            jnp.zeros(q, dtype=bool)
+            .at[safe_idx]
+            .set(pre_ok_sn.reshape(-1), mode="drop")
         )
 
         # ---- cycle end: leaf usage ----
         add = jnp.where(cell_need & admitted[:, None], qty_eff, 0)
-        local = local.at[cq[:, None], jnp.maximum(cells_eff, 0)].add(add)
-        # evict the successful preempters' victims: release their FULL
-        # admitted usage (all cells) from their CQ's leaf row
-        newly_evicted = targets & preempt_ok[:, None]  # [Q,V]
-        ev_qty = jnp.where(
-            newly_evicted[:, :, None] & (victims.vcells >= 0), victims.vqty, 0
-        )  # [Q,V,Cv]
-        rows_b = jnp.broadcast_to(
-            cq[:, None, None], victims.vcells.shape
+        local = local.at[cq[:, None], cells_c].add(add)
+        # evict: release each victim's FULL usage from its OWNER row
+        newly = ev_now_f  # [S, V] this cycle's evictions
+        ev_qty = jnp.where(newly[:, :, None] & (pcells >= 0), pqty, 0)
+        owner_b = jnp.broadcast_to(
+            jnp.maximum(victims.sowner, 0)[:, :, None], pcells.shape
         )
         local = local.at[
-            rows_b.reshape(-1), jnp.maximum(victims.vcells, 0).reshape(-1)
+            owner_b.reshape(-1), jnp.maximum(pcells, 0).reshape(-1)
         ].add(-ev_qty.reshape(-1))
-        vevicted = vevicted | newly_evicted
-        evict_cycle = jnp.where(newly_evicted, cycle, evict_cycle)
+        vevicted = vevicted | newly
+        evict_cycle = jnp.where(newly, cycle, evict_cycle)
+
+        # admitted entries fill their part-B pool slot: they are live
+        # reclaim candidates from the next cycle on
+        slot_w = victims.entry_slot[q_idx, cur]  # [Q]
+        fill = admitted & active & (slot_w >= 0)
+        sq_w = jnp.where(fill, sq, s_dim)
+        sl_w = jnp.maximum(slot_w, 0)
+        pad = cv - cells_eff.shape[1]
+        mc_w = jnp.pad(cells_eff, ((0, 0), (0, pad)), constant_values=-1)
+        mq_w = jnp.pad(qty_eff, ((0, 0), (0, pad)))
+        pcells = pcells.at[sq_w, sl_w].set(
+            mc_w.astype(pcells.dtype), mode="drop"
+        )
+        pqty = pqty.at[sq_w, sl_w].set(mq_w, mode="drop")
+        pvalid = pvalid.at[sq_w, sl_w].max(fill, mode="drop")
 
         # ---- queue motion ----
         adm_k = adm_k.at[q_idx, cur].set(
@@ -950,14 +1227,11 @@ def solve_drain_preempt(
         # park only NOT_NOMINATED outcomes (NoFit, or preempt search
         # found no victim set — the reserve branch). Heads SKIPPED in
         # the admit loop — a successful search losing the in-cycle
-        # fits() re-check — requeue immediately (FAILED_AFTER_NOMINATION,
-        # scheduler._requeue_and_update) and stay pending.
+        # fits() re-check or overlapping an earlier eviction — requeue
+        # immediately (FAILED_AFTER_NOMINATION) and stay pending.
         pre_skipped = psuccess & ~preempt_ok
-        # stuck-queue freeze (see solve_drain): non-converging
-        # PendingFlavors loops keep nominating (their reservations
-        # still shape other queues) but stop counting toward
-        # termination; their undecided entries report as fallback
         over_budget = retries >= queues.retry_cap
+        stuck = stuck | untrusted
         stuck = stuck | (
             active & (~is_fit) & ~preempt_ok & ~pre_skipped & pend_flavors
             & over_budget
@@ -990,7 +1264,7 @@ def solve_drain_preempt(
         )
         # global stagnation guard (see solve_drain): starved heads that
         # never advance behind frozen reservations are no-decisions
-        any_prog = jnp.any(head_advanced) | jnp.any(newly_evicted)
+        any_prog = jnp.any(head_advanced) | jnp.any(newly)
         no_prog = jnp.where(any_prog, 0, no_prog + 1)
         stuck = stuck | (
             (no_prog >= 2 * jnp.max(queues.retry_cap))
@@ -1001,17 +1275,9 @@ def solve_drain_preempt(
             jnp.where(active, new_entry_status, status[q_idx, cur])
         )
         # reactivate parked entries in root cohorts where usage released
-        released_seg = (
-            jnp.zeros(n_segments + 1, dtype=bool)
-            .at[jnp.where(queues.seg_id >= 0, queues.seg_id, n_segments)]
-            .max(jnp.any(newly_evicted, axis=1))
-        )
-        seg_released = released_seg[jnp.maximum(queues.seg_id, 0)] & (
-            queues.seg_id >= 0
-        )
-        status = jnp.where(
-            seg_released[:, None] & (status == 1), 0, status
-        )
+        seg_released = jnp.any(newly, axis=1)  # [S]
+        q_released = seg_released[sq] & (queues.seg_id >= 0)
+        status = jnp.where(q_released[:, None] & (status == 1), 0, status)
 
         lost = active & is_fit & (~admitted)
         walk_reset = (
@@ -1024,11 +1290,14 @@ def solve_drain_preempt(
         ).astype(jnp.int32)
         return (
             local, status, g_start, retries, stuck, no_prog, adm_k,
-            adm_cycle, vevicted, evict_cycle, cycle + 1,
+            adm_cycle, pcells, pqty, pvalid, vevicted, evict_cycle,
+            cycle + 1,
         )
 
     def cond(state):
-        _, status, _, _, stuck, _, _, _, _, _, cycle = state
+        status = state[1]
+        stuck = state[4]
+        cycle = state[13]
         has_pending = jnp.any(
             (status == 0)
             & (l_idx[None, :] < queues.qlen[:, None])
@@ -1046,12 +1315,17 @@ def solve_drain_preempt(
         jnp.int32(0),
         jnp.full((q, l, pmax), -1, dtype=jnp.int32),
         jnp.full((q, l), -1, dtype=jnp.int32),
-        jnp.zeros((q, v), dtype=bool),
-        jnp.full((q, v), -1, dtype=jnp.int32),
+        victims.scells,
+        victims.sqty,
+        victims.svalid0,
+        jnp.zeros((s_dim, v), dtype=bool),
+        jnp.full((s_dim, v), -1, dtype=jnp.int32),
         jnp.int32(0),
     )
-    (local_f, status_f, _, _, stuck_f, _, adm_k, adm_cycle, vevicted,
-     evict_cycle, cycles) = lax.while_loop(cond, cycle_body, init)
+    (local_f, status_f, _, _, stuck_f, _, adm_k, adm_cycle, _, _, _,
+     vevicted, evict_cycle, cycles) = lax.while_loop(
+        cond, cycle_body, init
+    )
     return PreemptDrainResult(
         status=status_f,
         admitted_k=adm_k,
@@ -1066,10 +1340,11 @@ def solve_drain_preempt(
 
 def _solve_drain_preempt_packed(
     tree, local_usage, queues, victims, paths,
-    n_segments: int, n_steps: int, max_cycles: int,
+    n_segments: int, n_steps: int, max_cycles: int, search_width: int,
 ):
     r = solve_drain_preempt(
-        tree, local_usage, queues, victims, paths, n_segments, n_steps, max_cycles
+        tree, local_usage, queues, victims, paths, n_segments, n_steps,
+        max_cycles, search_width,
     )
     return jnp.concatenate(
         [
@@ -1086,7 +1361,7 @@ def _solve_drain_preempt_packed(
 
 solve_drain_preempt_packed_jit = jax.jit(
     _solve_drain_preempt_packed,
-    static_argnames=("n_segments", "n_steps", "max_cycles"),
+    static_argnames=("n_segments", "n_steps", "max_cycles", "search_width"),
 )
 
 

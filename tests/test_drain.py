@@ -380,14 +380,15 @@ def preempt_spec(seed, n_cohorts=2, cqs_per_cohort=3, victims_per_cq=4,
                     else None
                 )
                 fls.append((f, {"cpu": str(int(rng.integers(6, 16)))}, bl, None))
-            policy = rng.choice(
-                [
-                    PreemptionPolicy.NEVER,
-                    PreemptionPolicy.LOWER_PRIORITY,
-                    PreemptionPolicy.LOWER_PRIORITY,
-                    PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY,
-                ]
-            )
+            # index the list (rng.choice would coerce enums to numpy
+            # strings and corrupt the policies)
+            policy_opts = [
+                PreemptionPolicy.NEVER,
+                PreemptionPolicy.LOWER_PRIORITY,
+                PreemptionPolicy.LOWER_PRIORITY,
+                PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY,
+            ]
+            policy = policy_opts[int(rng.integers(0, len(policy_opts)))]
             cqs.append(
                 {
                     "name": name,
@@ -542,7 +543,10 @@ class TestPreemptDrainParity:
         assert evicted == h_evicted == set()
         assert parked == h_parked == {"blocked"}
 
-    def test_cohort_reclaim_routes_to_fallback(self):
+    def test_cohort_reclaim_stays_in_kernel(self):
+        # A reclaimWithinCohort CQ is IN the device scope (round 4):
+        # the head preempts the lower-priority same-CQ victim in-kernel
+        # instead of falling back to the cycle loop.
         from kueue_tpu.models.cluster_queue import Preemption
         from kueue_tpu.models.constants import (
             PreemptionPolicy,
@@ -574,12 +578,300 @@ class TestPreemptDrainParity:
             ],
             "victims": [("v0", "cq", "f", "8", 0, 1.0)],
         }
-        _, _, _, outcome = device_preempt_drain_trace(spec)
-        assert [wl.name for wl, _ in outcome.fallback] == ["w"]
+        admitted, evicted, parked, outcome = device_preempt_drain_trace(spec)
+        assert not outcome.fallback
+        h_admitted, h_evicted, h_parked = host_preempt_drain_trace(spec)
+        assert admitted == h_admitted
+        assert evicted == h_evicted == {"v0"}
+        assert parked == h_parked
 
     @pytest.mark.parametrize("seed", range(16))
     def test_randomized(self, seed):
         spec = preempt_spec(seed)
+        h_admitted, h_evicted, h_parked = host_preempt_drain_trace(spec)
+        admitted, evicted, parked, outcome = device_preempt_drain_trace(spec)
+        assert not outcome.fallback
+        assert admitted == h_admitted
+        assert evicted == h_evicted
+        assert parked == h_parked
+
+
+def cohort_reclaim_spec(seed, n_cohorts=2, cqs_per_cohort=3,
+                        victims_per_cq=3, workloads_per_cq=3):
+    """Random cross-CQ contention: cohorts whose members borrow (some
+    victims admitted above nominal), mixed withinClusterQueue /
+    reclaimWithinCohort / borrowWithinCohort policies with priority
+    thresholds — the preemption scope the round-3 drain routed to host
+    fallback (preemption.go:480-524, :194-204)."""
+    from kueue_tpu.models.cluster_queue import BorrowWithinCohort, Preemption
+    from kueue_tpu.models.constants import (
+        BorrowWithinCohortPolicy,
+        PreemptionPolicy,
+        ReclaimWithinCohortPolicy,
+    )
+
+    rng = np.random.default_rng(seed + 31000)
+    flavors = ["fl-0", "fl-1"]
+    cqs, workloads, victims = [], [], []
+    t = 0.0
+    for ci in range(n_cohorts):
+        for qi in range(cqs_per_cohort):
+            name = f"cq-{ci}-{qi}"
+            k = int(rng.integers(1, 3))
+            fls = []
+            for f in flavors[:k]:
+                bl = (
+                    str(int(rng.integers(0, 10)))
+                    if rng.random() < 0.5
+                    else None
+                )
+                fls.append((f, {"cpu": str(int(rng.integers(4, 12)))}, bl, None))
+            # index the lists (rng.choice would coerce enums to numpy
+            # strings and corrupt the policies)
+            wcq_opts = [
+                PreemptionPolicy.NEVER,
+                PreemptionPolicy.LOWER_PRIORITY,
+                PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY,
+            ]
+            wcq = wcq_opts[int(rng.integers(0, len(wcq_opts)))]
+            reclaim_opts = [
+                ReclaimWithinCohortPolicy.NEVER,
+                ReclaimWithinCohortPolicy.LOWER_PRIORITY,
+                ReclaimWithinCohortPolicy.ANY,
+                ReclaimWithinCohortPolicy.ANY,
+            ]
+            reclaim = reclaim_opts[int(rng.integers(0, len(reclaim_opts)))]
+            if rng.random() < 0.4 and reclaim != ReclaimWithinCohortPolicy.NEVER:
+                bwc = BorrowWithinCohort(
+                    policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+                    max_priority_threshold=(
+                        int(rng.integers(0, 4)) * 10
+                        if rng.random() < 0.7
+                        else None
+                    ),
+                )
+            else:
+                bwc = BorrowWithinCohort()
+            cqs.append(
+                {
+                    "name": name,
+                    "cohort": f"cohort-{ci}",
+                    "groups": [{"resources": ["cpu"], "flavors": fls}],
+                    "preemption": Preemption(
+                        within_cluster_queue=wcq,
+                        reclaim_within_cohort=reclaim,
+                        borrow_within_cohort=bwc,
+                    ),
+                }
+            )
+            # victims sized to overshoot nominal sometimes: the CQ then
+            # borrows from the cohort, making its workloads reclaimable
+            for vi in range(int(rng.integers(0, victims_per_cq + 1))):
+                t += 1.0
+                victims.append(
+                    (
+                        f"victim-{ci}-{qi}-{vi}", name,
+                        fls[int(rng.integers(0, len(fls)))][0],
+                        str(int(rng.integers(1, 9))),
+                        int(rng.integers(0, 3)) * 10, t,
+                    )
+                )
+            for wi in range(workloads_per_cq):
+                t += 1.0
+                workloads.append(
+                    {
+                        "name": f"wl-{ci}-{qi}-{wi}",
+                        "queue": f"lq-{name}",
+                        "prio": int(rng.integers(0, 5)) * 10,
+                        "t": t,
+                        "pod_sets": [
+                            {
+                                "name": "main",
+                                "count": int(rng.integers(1, 3)),
+                                "requests": {"cpu": str(int(rng.integers(1, 6)))},
+                            }
+                        ],
+                    }
+                )
+    return {
+        "flavors": flavors, "cqs": cqs, "workloads": workloads,
+        "victims": victims,
+    }
+
+
+class TestPreemptDrainCohortReclaim:
+    def test_cross_cq_reclaim_releases_borrowed(self):
+        # cq-a borrows above nominal; cq-b's head reclaims from it
+        # (reclaimWithinCohort=Any) without touching cq-b's own victims
+        from kueue_tpu.models.cluster_queue import Preemption
+        from kueue_tpu.models.constants import (
+            PreemptionPolicy,
+            ReclaimWithinCohortPolicy,
+        )
+
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq-a",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "4"}, None, None)]}
+                    ],
+                    "preemption": Preemption(),
+                },
+                {
+                    "name": "cq-b",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "6"}, None, None)]}
+                    ],
+                    "preemption": Preemption(
+                        reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+                    ),
+                },
+            ],
+            "workloads": [
+                {
+                    "name": "wb", "queue": "lq-cq-b", "prio": 0, "t": 50.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "6"}}
+                    ],
+                }
+            ],
+            # cq-a holds 8 > nominal 4: borrowing 4 from the cohort
+            "victims": [
+                ("va-0", "cq-a", "f", "4", 50, 1.0),
+                ("va-1", "cq-a", "f", "4", 50, 2.0),
+            ],
+        }
+        admitted, evicted, parked, outcome = device_preempt_drain_trace(spec)
+        assert not outcome.fallback
+        h_admitted, h_evicted, h_parked = host_preempt_drain_trace(spec)
+        assert admitted == h_admitted
+        assert evicted == h_evicted
+        # reclaim succeeds even though the victims have HIGHER priority
+        # (reclaimWithinCohort=Any has no priority constraint)
+        assert "wb" in admitted and len(evicted) == 1
+        assert parked == h_parked
+
+    def test_lower_priority_reclaim_respects_priority(self):
+        from kueue_tpu.models.cluster_queue import Preemption
+        from kueue_tpu.models.constants import ReclaimWithinCohortPolicy
+
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq-a",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "4"}, None, None)]}
+                    ],
+                    "preemption": Preemption(),
+                },
+                {
+                    "name": "cq-b",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "6"}, None, None)]}
+                    ],
+                    "preemption": Preemption(
+                        reclaim_within_cohort=ReclaimWithinCohortPolicy.LOWER_PRIORITY,
+                    ),
+                },
+            ],
+            "workloads": [
+                {
+                    "name": "wb", "queue": "lq-cq-b", "prio": 10, "t": 50.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "6"}}
+                    ],
+                }
+            ],
+            # borrowing victims at prio 50 >= 10: NOT reclaimable
+            "victims": [
+                ("va-0", "cq-a", "f", "4", 50, 1.0),
+                ("va-1", "cq-a", "f", "4", 50, 2.0),
+            ],
+        }
+        admitted, evicted, parked, outcome = device_preempt_drain_trace(spec)
+        assert not outcome.fallback
+        h_admitted, h_evicted, h_parked = host_preempt_drain_trace(spec)
+        assert admitted == h_admitted == {}
+        assert evicted == h_evicted == set()
+        assert parked == h_parked == {"wb"}
+
+    def test_admitted_entry_becomes_reclaim_candidate(self):
+        # cq-a's entry admits first (borrowing into the cohort), then
+        # cq-b's later head reclaims it — the part-B dynamic-victim
+        # flow: the workload ends BOTH admitted and evicted, exactly as
+        # the host cycle loop decides it
+        from kueue_tpu.models.cluster_queue import Preemption
+        from kueue_tpu.models.constants import (
+            PreemptionPolicy,
+            ReclaimWithinCohortPolicy,
+        )
+
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq-a",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "2"}, None, None)]}
+                    ],
+                    "preemption": Preemption(),
+                },
+                {
+                    "name": "cq-b",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "6"}, None, None)]}
+                    ],
+                    "preemption": Preemption(
+                        reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+                    ),
+                },
+            ],
+            "workloads": [
+                # admitted in cycle 1, borrowing 4 above cq-a's nominal
+                {
+                    "name": "wa", "queue": "lq-cq-a", "prio": 50, "t": 10.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "6"}}
+                    ],
+                },
+                # keeps wb off cycle 1: NoFit (100 > total), parks
+                {
+                    "name": "w-big", "queue": "lq-cq-b", "prio": 90, "t": 5.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "100"}}
+                    ],
+                },
+                # cq-b's cycle-2 head needs its nominal back -> reclaims
+                # the DRAIN-ADMITTED wa (borrowing by then)
+                {
+                    "name": "wb", "queue": "lq-cq-b", "prio": 0, "t": 20.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "6"}}
+                    ],
+                },
+            ],
+            "victims": [],
+        }
+        admitted, evicted, parked, outcome = device_preempt_drain_trace(spec)
+        assert not outcome.fallback
+        h_admitted, h_evicted, h_parked = host_preempt_drain_trace(spec)
+        assert admitted == h_admitted
+        assert evicted == h_evicted
+        assert parked == h_parked
+        assert "wa" in admitted and "wa" in evicted and "wb" in admitted
+
+    @pytest.mark.parametrize("seed", range(24))
+    def test_randomized(self, seed):
+        spec = cohort_reclaim_spec(seed)
         h_admitted, h_evicted, h_parked = host_preempt_drain_trace(spec)
         admitted, evicted, parked, outcome = device_preempt_drain_trace(spec)
         assert not outcome.fallback
