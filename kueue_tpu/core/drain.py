@@ -361,6 +361,13 @@ def run_drain_preempt(
         s: any(reclaim_enabled[qi] for qi in seg_queues[s])
         for s in seg_root
     }
+    # part-B (drain-admitted-entry) slots are needed whenever ANY queue
+    # in the segment searches — not just under cohort reclaim: a parked
+    # higher-priority head reactivated by an eviction can preempt a
+    # drain-admitted lower-priority entry of its OWN ClusterQueue
+    dynamic = {
+        s: any(can_search[qi] for qi in seg_queues[s]) for s in seg_root
+    }
 
     # ---- pool membership + segment scope checks ----
     tree, paths_j, _ = tree_arrays(snapshot)
@@ -385,7 +392,7 @@ def run_drain_preempt(
                 entries.append((ws, r))
             if bad:
                 break
-        n_b = sum(int(qlen[qi]) for qi in seg_queues[s]) if scoped[s] else 0
+        n_b = sum(int(qlen[qi]) for qi in seg_queues[s]) if dynamic[s] else 0
         if bad or len(entries) + n_b > max_victims:
             bad_segments.append(s)
             pool_of[s] = []
@@ -401,6 +408,7 @@ def run_drain_preempt(
     ]
     for s in bad_segments:
         scoped[s] = False
+        dynamic[s] = False
     dropped = set(drop_queues)
 
     # ---- dense pool arrays ----
@@ -408,7 +416,7 @@ def run_drain_preempt(
         len(pool_of.get(s, []))
         + (
             sum(int(qlen[qi]) for qi in seg_queues[s] if qi not in dropped)
-            if scoped[s]
+            if dynamic[s]
             else 0
         )
         for s in seg_root
@@ -505,7 +513,7 @@ def run_drain_preempt(
                 )
             )
             slot += 1
-        if scoped[s]:
+        if dynamic[s]:
             for qi in seg_queues[s]:
                 if qi in dropped:
                     continue
@@ -712,19 +720,60 @@ def run_drain(
     timestamp_fn=None,
     max_cycles: Optional[int] = None,
     mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
+    fair_sharing: bool = False,
 ) -> DrainOutcome:
     """Plan + solve + map back, with one device round trip.
 
     ``max_cycles`` overrides the computed backstop (operators capping
     device time; tests exercising truncation routing). With ``mesh``
     the per-queue tensors are sharded along the mesh's ``wl`` axis
-    (each device owns a slice of the ClusterQueues)."""
+    (each device owns a slice of the ClusterQueues). With
+    ``fair_sharing`` the cycle's admission order is the fair-sharing
+    cohort tournament run ON DEVICE (ops/drain_kernel.solve_drain_fair)
+    instead of the (borrowing, priority, FIFO) sort; preempt-capable
+    ClusterQueues route to ``fallback`` in fair mode (the fair victim
+    search stays on the per-cycle batched path), and ``mesh`` is not
+    supported (the tournament reduces over the whole cohort forest)."""
     from kueue_tpu._jax import jnp
     from kueue_tpu.ops.drain_kernel import DrainQueues, solve_drain_packed_jit
+
+    if fair_sharing and mesh is not None:
+        raise ValueError("fair_sharing drains do not support mesh sharding")
 
     plan = plan_drain(
         snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
     )
+    extra_fb_entries: List[Tuple[Workload, str]] = []
+    if fair_sharing:
+        # the in-kernel tournament orders admissions; fair PREEMPTION
+        # stays on the per-cycle batched path, so preempt-capable CQs
+        # fall back to the cycle loop
+        from kueue_tpu.models.constants import (
+            PreemptionPolicy,
+            ReclaimWithinCohortPolicy,
+        )
+
+        for qi, cq_name in enumerate(plan.cq_order):
+            prem = snapshot.cq_models[cq_name].preemption
+            capable = (
+                prem.within_cluster_queue != PreemptionPolicy.NEVER
+                or (
+                    snapshot.has_cohort(cq_name)
+                    and prem.reclaim_within_cohort
+                    != ReclaimWithinCohortPolicy.NEVER
+                )
+            )
+            if not capable:
+                continue
+            plan.queues_np["qlen"][qi] = 0
+            plan.queues_np["cq_rows"][qi] = -1
+            plan.queues_np["seg_id"][qi] = -1
+            for pos in range(plan.queues_np["cells"].shape[1]):
+                i = plan.head_of.pop((qi, pos), None)
+                if i is not None:
+                    extra_fb_entries.append(
+                        (plan.lowered.heads[i], plan.lowered.cq_names[i])
+                    )
     if max_cycles is not None:
         plan.max_cycles = max_cycles
     tree, paths, _ = tree_arrays(snapshot)
@@ -744,17 +793,57 @@ def run_drain(
         usage_in = jnp.asarray(snapshot.local_usage)
         queues = DrainQueues(**{k: jnp.asarray(v) for k, v in queues_np.items()})
 
-    flat = np.asarray(
-        solve_drain_packed_jit(
-            tree,
-            usage_in,
-            queues,
-            paths,
-            n_segments=plan.n_segments,
-            n_steps=plan.n_steps,
-            max_cycles=plan.max_cycles,
+    if fair_sharing:
+        from kueue_tpu.features import enabled as _feature_enabled
+        from kueue_tpu.ops.drain_kernel import solve_drain_fair_packed_jit
+        from kueue_tpu.ops.quota_np import potential_available_all_np
+
+        parent = snapshot.flat.parent
+        n_nodes = len(parent)
+        # paths already encode depth: valid path length - 1
+        depth_of = (
+            np.sum(np.asarray(paths) >= 0, axis=1) - 1
+        ).astype(np.int32)
+        # lendable depends on quota only: potentialAvailable of the
+        # PARENT, summed per resource (fair_sharing.go:90-104)
+        pot = potential_available_all_np(
+            parent, snapshot.flat.level_masks(), snapshot.subtree,
+            snapshot.guaranteed, snapshot.borrowing_limit,
         )
-    )  # the single fetch
+        n_res = len(snapshot.resource_names)
+        lendable = np.zeros((n_nodes, n_res), dtype=np.int64)
+        parent_pot = pot[np.maximum(parent, 0)]
+        np.add.at(lendable.T, snapshot.resource_index, parent_pot.T)
+        lendable[parent < 0] = 0
+        flat = np.asarray(
+            solve_drain_fair_packed_jit(
+                tree,
+                usage_in,
+                queues,
+                paths,
+                jnp.asarray(depth_of),
+                jnp.asarray(snapshot.weight_milli),
+                jnp.asarray(lendable),
+                jnp.asarray(snapshot.resource_index.astype(np.int32)),
+                n_segments=plan.n_segments,
+                n_steps=plan.n_steps,
+                max_cycles=plan.max_cycles,
+                n_res=n_res,
+                prio_tie=bool(_feature_enabled("PrioritySortingWithinCohort")),
+            )
+        )  # the single fetch
+    else:
+        flat = np.asarray(
+            solve_drain_packed_jit(
+                tree,
+                usage_in,
+                queues,
+                paths,
+                n_segments=plan.n_segments,
+                n_steps=plan.n_steps,
+                max_cycles=plan.max_cycles,
+            )
+        )  # the single fetch
     nq, nl, npd = queues_np["cells"].shape[:3]  # incl. mesh padding
     ql = nq * nl
     qlp = nq * nl * npd
@@ -785,9 +874,11 @@ def run_drain(
         else:
             parked.append((wl, cq_name))
     admitted.sort(key=lambda t: t[3])
-    fb = [
-        (lowered.heads[i], lowered.cq_names[i]) for i in plan.fallback
-    ] + extra_fallback
+    fb = (
+        [(lowered.heads[i], lowered.cq_names[i]) for i in plan.fallback]
+        + extra_fb_entries
+        + extra_fallback
+    )
     return DrainOutcome(
         admitted=admitted, parked=parked, fallback=fb, cycles=cycles,
         truncated=truncated,

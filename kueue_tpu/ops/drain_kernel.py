@@ -46,7 +46,13 @@ from kueue_tpu.ops.assign_kernel import (
     _gather_cells,
     segmented_rank,
 )
-from kueue_tpu.ops.quota import NO_LIMIT, QuotaTree, subtree_quota, usage_tree
+from kueue_tpu.ops.quota import (
+    DRS_MAX,
+    NO_LIMIT,
+    QuotaTree,
+    subtree_quota,
+    usage_tree,
+)
 
 
 class DrainQueues(NamedTuple):
@@ -358,6 +364,61 @@ def _nominate_multi(
             mcells, mqty, mneed)
 
 
+def _cursor_queue_motion(
+    queues, q_idx, cur, active, is_fit, pend, admitted, rep_k, walk_next,
+    retries, stuck, no_prog, adm_k, adm_cycle, g_start, cursor, cycle,
+):
+    """Cursor-based end-of-cycle queue motion, shared by solve_drain
+    and solve_drain_fair.
+
+    Admitted heads leave; non-Fit heads park (advance) unless a podset
+    walk stored a pending flavor cursor (PendingFlavors); in-cycle
+    conflict losers stay, resuming every podset from its stored
+    per-group cursors. Non-converging PendingFlavors loops: the
+    reference's immediate-requeue can oscillate forever when
+    podset/group cursors alternately advance and reset — the live
+    scheduler spins until cluster events change the state, but a drain
+    has no events. A queue whose head retried more times than its joint
+    cursor odometer has states (queues.retry_cap — no convergent walk
+    can need more) is provably cycling and is marked STUCK: its head
+    keeps re-nominating with a frozen cursor every remaining cycle — so
+    its per-cycle capacity reservations keep shaping other queues'
+    decisions exactly like the host's spin — but the queue stops
+    counting toward termination and its undecided entries are reported
+    as fallback (no decision). A stuck head whose frozen nomination
+    later RESOLVES un-sticks. Global stagnation guard: with no queue
+    advancing for 2x the retry budget the per-cycle state is provably
+    cyclic, so every remaining non-advancing queue is marked stuck."""
+    over_budget = retries >= queues.retry_cap
+    stuck = stuck | (active & (~is_fit) & pend & over_budget)
+    resolve = active & (admitted | ((~is_fit) & ~pend))
+    stuck = stuck & ~resolve
+    retrying = active & (~is_fit) & pend & ~stuck
+    advance = resolve
+    retries = jnp.where(
+        advance | ~active, 0, jnp.where(retrying, retries + 1, retries)
+    )
+    any_advance = jnp.any(advance)
+    no_prog = jnp.where(any_advance, 0, no_prog + 1)
+    stuck = stuck | (
+        (no_prog >= 2 * jnp.max(queues.retry_cap)) & active & ~advance
+    )
+    adm_k = adm_k.at[q_idx, cur].set(
+        jnp.where((admitted & active)[:, None], rep_k, adm_k[q_idx, cur])
+    )
+    adm_cycle = adm_cycle.at[q_idx, cur].set(
+        jnp.where(admitted & active, cycle, adm_cycle[q_idx, cur])
+    )
+    lost = active & is_fit & (~admitted)
+    g_start = jnp.where(
+        advance[:, None, None],
+        0,
+        jnp.where((lost | retrying)[:, None, None], walk_next, g_start),
+    ).astype(jnp.int32)
+    cursor = cursor + advance.astype(jnp.int32)
+    return cursor, g_start, retries, stuck, no_prog, adm_k, adm_cycle
+
+
 def solve_drain(
     tree: QuotaTree,
     local_usage: jnp.ndarray,  # int64[N, FR] starting leaf usage
@@ -484,63 +545,13 @@ def solve_drain(
         add = jnp.where(cell_valid & admitted[:, None], qty_eff, 0)
         local = local.at[cq[:, None], jnp.maximum(cells_eff, 0)].add(add)
 
-        # queue motion: admitted leave; non-Fit heads park (advance)
-        # unless a podset walk stored a pending flavor cursor
-        # (PendingFlavors); in-cycle conflict losers stay, resuming
-        # every podset from its stored per-group cursors
-        # Non-converging PendingFlavors loops: the reference's
-        # immediate-requeue can oscillate forever when podset/group
-        # cursors alternately advance and reset — the live scheduler
-        # spins until cluster events change the state, but a drain has
-        # no events. A queue whose head retried more times than its
-        # joint cursor odometer has states (queues.retry_cap — no
-        # convergent walk can need more) is provably cycling and is
-        # marked STUCK: its head keeps re-nominating with a frozen
-        # cursor every remaining cycle — so its per-cycle capacity
-        # reservations keep shaping other queues' decisions exactly
-        # like the host's spin — but the queue stops counting toward
-        # termination and its undecided entries are reported as
-        # fallback (no decision), matching the host's never-decided
-        # spinners.
-        over_budget = retries >= queues.retry_cap
-        stuck = stuck | (active & (~is_fit) & pend & over_budget)
-        # a stuck head whose frozen nomination later RESOLVES (another
-        # queue's motion freed capacity: it admits, or its walk now
-        # exhausts and parks) un-sticks — the host spinner would pick
-        # up the same state change
-        resolve = active & (admitted | ((~is_fit) & ~pend))
-        stuck = stuck & ~resolve
-        retrying = active & (~is_fit) & pend & ~stuck
-        advance = resolve
-        retries = jnp.where(
-            advance | ~active, 0, jnp.where(retrying, retries + 1, retries)
-        )
-        # Global stagnation guard: a frozen spinner's reservation can
-        # STARVE another queue's FIT head (it loses the in-cycle
-        # re-check every cycle without ever advancing) — the host spins
-        # on that too. With no queue advancing for 2x the retry budget,
-        # the per-cycle state is provably cyclic, so every remaining
-        # non-advancing queue is marked stuck (no decision).
-        any_advance = jnp.any(advance)
-        no_prog = jnp.where(any_advance, 0, no_prog + 1)
-        stuck = stuck | (
-            (no_prog >= 2 * jnp.max(queues.retry_cap)) & active & ~advance
-        )
-        adm_k = adm_k.at[q_idx, cur].set(
-            jnp.where(
-                (admitted & active)[:, None], rep_k, adm_k[q_idx, cur]
+        (cursor, g_start, retries, stuck, no_prog, adm_k, adm_cycle) = (
+            _cursor_queue_motion(
+                queues, q_idx, cur, active, is_fit, pend, admitted,
+                rep_k, walk_next, retries, stuck, no_prog, adm_k,
+                adm_cycle, g_start, cursor, cycle,
             )
         )
-        adm_cycle = adm_cycle.at[q_idx, cur].set(
-            jnp.where(admitted & active, cycle, adm_cycle[q_idx, cur])
-        )
-        lost = active & is_fit & (~admitted)
-        g_start = jnp.where(
-            advance[:, None, None],
-            0,
-            jnp.where((lost | retrying)[:, None, None], walk_next, g_start),
-        ).astype(jnp.int32)
-        cursor = cursor + advance.astype(jnp.int32)
         return (local, cursor, g_start, retries, stuck, no_prog, adm_k,
                 adm_cycle, cycle + 1)
 
@@ -571,6 +582,333 @@ def solve_drain(
         local_usage=local_f,
         stuck=stuck_f,
     )
+
+
+def _fair_chain(
+    usage, borrowed_base, paths_q, mcells, mqty, subtree, guaranteed,
+    lendable, weight, parent, res_of, n_res: int, max_depth: int,
+):
+    """Per-head fair-sharing DRS chain (fair_sharing_iterator.py
+    path_drs, vectorized): for each queue q and path level d, the
+    DominantResourceShare of path node d with q's representative usage
+    added at its CQ row. Only the head's cells change, so the node's
+    per-resource borrowed total is borrowed_base plus the head-cell
+    delta; lendable depends on quota alone and is precomputed.
+
+    usage: int64[N,FR]; borrowed_base: int64[N,R] (max(0, usage -
+    subtree) summed per resource); paths_q: int32[Q,D+1]; mcells/mqty:
+    [Q,C']; lendable: int64[N,R]; weight: int64[N]; res_of: int32[C']
+    per queue -> resource bucket of each head cell (n_res = pad).
+    Returns int64[Q, D+1]."""
+    qn, cdim = mcells.shape
+    cells_c = jnp.maximum(mcells, 0)
+    cell_ok = (mcells >= 0) & (mqty > 0)
+    delta = jnp.where(cell_ok, mqty, 0)  # [Q,C']
+    chains = []
+    for d in range(max_depth + 1):
+        node = jnp.maximum(paths_q[:, d], 0)  # [Q]
+        node_valid = paths_q[:, d] >= 0
+        u_at = usage[node[:, None], cells_c]  # [Q,C']
+        sub_at = subtree[node[:, None], cells_c]
+        g_at = guaranteed[node[:, None], cells_c]
+        new = u_at + delta
+        bdelta = jnp.maximum(0, new - sub_at) - jnp.maximum(0, u_at - sub_at)
+        qq = jnp.broadcast_to(jnp.arange(qn)[:, None], res_of.shape)
+        badd = (
+            jnp.zeros((qn, n_res + 1), dtype=jnp.int64)
+            .at[qq, res_of]
+            .add(jnp.where(cell_ok, bdelta, 0))[:, :n_res]
+        )
+        borrowed = borrowed_base[node] + badd  # [Q,R]
+        lend = lendable[node]
+        ratio = jnp.where(
+            (borrowed > 0) & (lend > 0),
+            borrowed * 1000 // jnp.maximum(lend, 1),
+            -1,
+        )
+        drs = jnp.max(ratio, axis=1)
+        has_parent = parent[node] >= 0
+        active = jnp.any(borrowed > 0, axis=1) & has_parent & node_valid
+        w = weight[node]
+        num = drs * 1000
+        trunc = jnp.sign(num) * (jnp.abs(num) // jnp.maximum(w, 1))
+        dws = jnp.where(active, jnp.where(w == 0, DRS_MAX, trunc), 0)
+        chains.append(dws)
+        # bubble the head usage to the next level (over-guaranteed)
+        delta = jnp.where(
+            node_valid[:, None],
+            jnp.maximum(0, new - g_at) - jnp.maximum(0, u_at - g_at),
+            delta,
+        )
+    return jnp.stack(chains, axis=1)  # [Q, D+1]
+
+
+def _fair_tournament(
+    chain, remaining, paths_q, cq_rows, depth_of, parent, prio, ts,
+    n_nodes: int, max_tree_depth: int, prio_tie: bool,
+):
+    """One fair-sharing pop per root cohort (fair_sharing_iterator.py
+    tournament, vectorized over the whole forest): every cohort node
+    picks the best of its children's winners, compared by the child's
+    recorded DRS at that node (chain value at the child's position on
+    the winner's path), tie-broken by priority (behind the
+    PrioritySortingWithinCohort gate), FIFO timestamp, then queue index.
+    Returns bool[Q]: this queue's head wins its root's tournament."""
+    INF = jnp.int64(1 << 62)
+    qn = remaining.shape[0]
+    cqr = jnp.maximum(cq_rows, 0)
+    head_depth = depth_of[cqr]  # [Q]
+
+    # per-node winner state, initialized at the CQ leaves
+    win_q = jnp.full(n_nodes, -1, dtype=jnp.int32).at[
+        jnp.where(remaining, cqr, n_nodes)
+    ].set(jnp.arange(qn, dtype=jnp.int32), mode="drop")
+
+    tie1 = jnp.where(prio_tie, -prio, 0)  # [Q]
+    tie2 = ts
+
+    for d in range(max_tree_depth, 0, -1):
+        at_d = (depth_of == d) & (win_q >= 0)
+        wq = jnp.maximum(win_q, 0)
+        # key at the parent = winner's chain value at THIS node's
+        # position on its path: level = head_depth[q] - d
+        lvl = jnp.clip(head_depth[wq] - d, 0, chain.shape[1] - 1)
+        k_dws = jnp.where(at_d, chain[wq, lvl], INF)
+        k_t1 = jnp.where(at_d, tie1[wq], INF)
+        k_t2 = jnp.where(at_d, tie2[wq], INF)
+        k_qi = jnp.where(at_d, wq.astype(jnp.int64), INF)
+        seg = jnp.where(at_d & (parent >= 0), parent, n_nodes)
+        m1 = jax.ops.segment_min(k_dws, seg, num_segments=n_nodes + 1)[:n_nodes]
+        s1 = at_d & (k_dws == m1[jnp.maximum(parent, 0)])
+        m2 = jax.ops.segment_min(
+            jnp.where(s1, k_t1, INF), seg, num_segments=n_nodes + 1
+        )[:n_nodes]
+        s2 = s1 & (k_t1 == m2[jnp.maximum(parent, 0)])
+        m3 = jax.ops.segment_min(
+            jnp.where(s2, k_t2, INF), seg, num_segments=n_nodes + 1
+        )[:n_nodes]
+        s3 = s2 & (k_t2 == m3[jnp.maximum(parent, 0)])
+        m4 = jax.ops.segment_min(
+            jnp.where(s3, k_qi, INF), seg, num_segments=n_nodes + 1
+        )[:n_nodes]
+        parent_win = jnp.where(m4 < INF, m4, -1).astype(jnp.int32)
+        # only overwrite nodes that RECEIVED proposals this round
+        got = m1 < INF
+        win_q = jnp.where(got, parent_win, win_q)
+
+    # root of each queue = last valid node on its path
+    root_pos = jnp.sum((paths_q >= 0).astype(jnp.int32), axis=1) - 1
+    root_row = paths_q[jnp.arange(qn), jnp.maximum(root_pos, 0)]
+    return remaining & (win_q[jnp.maximum(root_row, 0)] == jnp.arange(qn))
+
+
+def solve_drain_fair(
+    tree: QuotaTree,
+    local_usage: jnp.ndarray,  # int64[N, FR]
+    queues: DrainQueues,
+    paths: jnp.ndarray,  # int32[N, D+1]
+    depth_of: jnp.ndarray,  # int32[N] tree depth (roots 0)
+    weight: jnp.ndarray,  # int64[N] fairSharing weight_milli
+    lendable: jnp.ndarray,  # int64[N, R] (quota-only, precomputed)
+    res_of_fr: jnp.ndarray,  # int32[FR] cell -> resource bucket
+    n_segments: int,
+    n_steps: int,
+    max_cycles: int,
+    n_res: int,
+    prio_tie: bool,
+) -> DrainResult:
+    """Multi-cycle drain under FAIR-SHARING admission ordering — the
+    whole fair tournament on the device. Each cycle pops heads via the
+    lazy cohort tournament (fair_sharing_iterator.go:33-120): one pop
+    per root cohort per step, every pop re-evaluating
+    DominantResourceShare against the usage as mutated by the cycle's
+    earlier admissions and reservations, exactly like the host
+    iterator. Preemption stays out of scope (the host lowering routes
+    preempt-capable CQs to fallback in fair mode); preempt-classified
+    heads of never-preempting CQs pop, reserve (no_reclaim) and park
+    as in solve_drain.
+    """
+    max_depth = tree.max_depth
+    subtree, guaranteed = subtree_quota(tree)
+    from kueue_tpu.ops.assign_kernel import potential_available_all
+
+    potential = potential_available_all(tree, subtree, guaranteed)
+
+    q, l, pmax, k, c = queues.cells.shape
+    n_nodes = tree.parent.shape[0]
+    q_idx = jnp.arange(q)
+    cq = jnp.maximum(queues.cq_rows, 0)
+    paths_q = paths[cq]  # [Q, D+1]
+
+    avail_v = jax.vmap(
+        _avail_along_path, in_axes=(0, 0, None, None, None, None, None)
+    )
+
+    def cycle_body(state):
+        (local, cursor, g_start, retries, stuck, no_prog, adm_k,
+         adm_cycle, cycle) = state
+
+        active = cursor < queues.qlen  # [Q]
+        cur = jnp.minimum(cursor, l - 1)
+        usage0 = usage_tree(tree, guaranteed, local)
+        (is_fit, is_pre, pend, head_borrow, rep_k, walk_next,
+         cells_eff, qty_eff, _mneed) = _nominate_multi(
+            tree, subtree, guaranteed, local, usage0, queues, q_idx, cur,
+            active, g_start, potential,
+        )
+        nofit = ~(is_fit | is_pre)
+        prio = queues.priority[q_idx, cur]
+        ts = queues.timestamp[q_idx, cur]
+        cells_c = jnp.maximum(cells_eff, 0)
+        cell_valid_all = (cells_eff >= 0) & (qty_eff > 0)
+        res_of_q = jnp.where(
+            cell_valid_all, res_of_fr[cells_c], n_res
+        ).astype(jnp.int32)
+
+        def step(carry, s):
+            usage, remaining = carry
+            # borrowed per resource for every node, against live usage
+            nn = jnp.broadcast_to(
+                jnp.arange(n_nodes)[:, None], usage.shape
+            )
+            bb = (
+                jnp.zeros((n_nodes, n_res + 1), dtype=jnp.int64)
+                .at[nn, res_of_fr[None, :].repeat(n_nodes, axis=0)]
+                .add(jnp.maximum(0, usage - subtree))[:, :n_res]
+            )
+            chain = _fair_chain(
+                usage, bb, paths_q, cells_eff, qty_eff, subtree,
+                guaranteed, lendable, weight, tree.parent, res_of_q,
+                n_res, max_depth,
+            )
+            win = _fair_tournament(
+                chain, remaining, paths_q, queues.cq_rows, depth_of,
+                tree.parent, prio, ts, n_nodes, max_depth, prio_tie,
+            )
+            avail = avail_v(
+                paths_q, cells_eff, usage, subtree, guaranteed,
+                tree.borrowing_limit, max_depth,
+            )
+            cell_valid = cell_valid_all & win[:, None]
+            fits = jnp.all(
+                jnp.where(cell_valid, avail >= qty_eff, True), axis=1
+            )
+            admit = win & is_fit & fits
+            reserve = win & is_pre & queues.no_reclaim
+            nominal_c = tree.nominal[cq[:, None], cells_c]
+            bl_c = tree.borrowing_limit[cq[:, None], cells_c]
+            leaf_usage_c = usage[cq[:, None], cells_c]
+            borrow_cap = jnp.where(
+                bl_c < NO_LIMIT,
+                jnp.minimum(qty_eff, nominal_c + bl_c - leaf_usage_c),
+                qty_eff,
+            )
+            nominal_cap = jnp.maximum(
+                0, jnp.minimum(qty_eff, nominal_c - leaf_usage_c)
+            )
+            reserve_qty = jnp.where(
+                head_borrow[:, None], borrow_cap, nominal_cap
+            )
+            delta = jnp.where(
+                cell_valid & admit[:, None],
+                qty_eff,
+                jnp.where(cell_valid & reserve[:, None], reserve_qty, 0),
+            )
+            # winners are one per root cohort: their paths are disjoint,
+            # so the per-level scatters cannot collide
+            for d in range(0, max_depth + 1):
+                node = jnp.maximum(paths_q[:, d], 0)
+                node_valid = (paths_q[:, d] >= 0)[:, None]
+                old = usage[node[:, None], cells_c]
+                gg = guaranteed[node[:, None], cells_c]
+                new = old + delta
+                usage = usage.at[node[:, None], cells_c].add(
+                    jnp.where(node_valid, delta, 0)
+                )
+                delta = jnp.where(
+                    node_valid,
+                    jnp.maximum(0, new - gg) - jnp.maximum(0, old - gg),
+                    delta,
+                )
+            remaining = remaining & ~win
+            return (usage, remaining), admit
+
+        participants = active & ~nofit & (queues.cq_rows >= 0)
+        (_, _), admit_sn = lax.scan(
+            step, (usage0, participants), jnp.arange(n_steps)
+        )
+        admitted = jnp.any(admit_sn, axis=0)  # [Q]
+
+        # leaf usage adds for admissions only — reservations die with
+        # the cycle (the reserving head parks)
+        add = jnp.where(cell_valid_all & admitted[:, None], qty_eff, 0)
+        local = local.at[cq[:, None], cells_c].add(add)
+
+        (cursor, g_start, retries, stuck, no_prog, adm_k, adm_cycle) = (
+            _cursor_queue_motion(
+                queues, q_idx, cur, active, is_fit, pend, admitted,
+                rep_k, walk_next, retries, stuck, no_prog, adm_k,
+                adm_cycle, g_start, cursor, cycle,
+            )
+        )
+        return (local, cursor, g_start, retries, stuck, no_prog, adm_k,
+                adm_cycle, cycle + 1)
+
+    def cond(state):
+        _, cursor, _, _, stuck, _, _, _, cycle = state
+        return jnp.any((cursor < queues.qlen) & ~stuck) & (cycle < max_cycles)
+
+    g = queues.gidx.shape[-1]
+    init = (
+        local_usage,
+        jnp.zeros(q, dtype=jnp.int32),
+        jnp.zeros((q, pmax, g), dtype=jnp.int32),
+        jnp.zeros(q, dtype=jnp.int32),
+        jnp.zeros(q, dtype=bool),
+        jnp.int32(0),
+        jnp.full((q, l, pmax), -1, dtype=jnp.int32),
+        jnp.full((q, l), -1, dtype=jnp.int32),
+        jnp.int32(0),
+    )
+    (local_f, cursor_f, _, _, stuck_f, _, adm_k, adm_cycle, cycles) = (
+        lax.while_loop(cond, cycle_body, init)
+    )
+    return DrainResult(
+        admitted_k=adm_k,
+        admitted_cycle=adm_cycle,
+        cursor=cursor_f,
+        cycles=cycles,
+        local_usage=local_f,
+        stuck=stuck_f,
+    )
+
+
+def _solve_drain_fair_packed(
+    tree, local_usage, queues, paths, depth_of, weight, lendable,
+    res_of_fr, n_segments: int, n_steps: int, max_cycles: int,
+    n_res: int, prio_tie: bool,
+):
+    r = solve_drain_fair(
+        tree, local_usage, queues, paths, depth_of, weight, lendable,
+        res_of_fr, n_segments, n_steps, max_cycles, n_res, prio_tie,
+    )
+    return jnp.concatenate(
+        [
+            r.admitted_k.reshape(-1),
+            r.admitted_cycle.reshape(-1),
+            r.cursor,
+            r.stuck.astype(jnp.int32),
+            r.cycles[None],
+        ]
+    )
+
+
+solve_drain_fair_packed_jit = jax.jit(
+    _solve_drain_fair_packed,
+    static_argnames=(
+        "n_segments", "n_steps", "max_cycles", "n_res", "prio_tie"
+    ),
+)
 
 
 class SegVictims(NamedTuple):

@@ -595,6 +595,77 @@ class TestPreemptDrainParity:
         assert evicted == h_evicted
         assert parked == h_parked
 
+    def test_reactivated_head_preempts_drain_admitted_same_cq(self):
+        # Within-CQ-only cohort (no reclaim anywhere): w-hi parks (its
+        # only candidate outranks it), the lower-priority w-lo admits
+        # behind it, and an eviction elsewhere in the cohort reactivates
+        # w-hi — which must then preempt the DRAIN-ADMITTED w-lo. The
+        # part-B candidate pool must exist even without cohort reclaim
+        # (regression: slots were gated on reclaim being enabled).
+        from kueue_tpu.models.cluster_queue import Preemption
+        from kueue_tpu.models.constants import PreemptionPolicy
+
+        prem = Preemption(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq-a",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "8"}, None, None)]}
+                    ],
+                    "preemption": prem,
+                },
+                {
+                    "name": "cq-b",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "12"}, None, None)]}
+                    ],
+                    "preemption": prem,
+                },
+            ],
+            "workloads": [
+                {
+                    "name": "w-blk", "queue": "lq-cq-b", "prio": 60, "t": 1.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "21"}}
+                    ],
+                },
+                {
+                    "name": "w-hi", "queue": "lq-cq-a", "prio": 50, "t": 2.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "8"}}
+                    ],
+                },
+                {
+                    "name": "w-lo", "queue": "lq-cq-a", "prio": 0, "t": 3.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "4"}}
+                    ],
+                },
+                {
+                    "name": "w-e", "queue": "lq-cq-b", "prio": 50, "t": 6.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "6"}}
+                    ],
+                },
+            ],
+            "victims": [
+                ("v0", "cq-a", "f", "8", 60, 1.0),
+                ("v2", "cq-b", "f", "5", 0, 2.0),
+                ("v2b", "cq-b", "f", "2", 70, 3.0),
+            ],
+        }
+        h_admitted, h_evicted, h_parked = host_preempt_drain_trace(spec)
+        admitted, evicted, parked, outcome = device_preempt_drain_trace(spec)
+        assert not outcome.fallback
+        assert admitted == h_admitted
+        assert evicted == h_evicted
+        assert parked == h_parked
+        assert "w-lo" in evicted and "w-hi" in admitted
+
 
 def cohort_reclaim_spec(seed, n_cohorts=2, cqs_per_cohort=3,
                         victims_per_cq=3, workloads_per_cq=3):
@@ -1481,6 +1552,192 @@ class TestPreemptDrainMultiPodset:
         assert da == ha
         assert de == he
         assert dp == hp
+
+
+def host_fair_drain_trace(spec):
+    """Host truth under fair-sharing admission ordering: scheduler
+    cycles with fair_sharing enabled, to quiescence."""
+    sched, mgr, cache, _ = build_env(spec, use_solver=False)
+    sched.fair_sharing = True
+    admitted = {}
+    cycle = 0
+    for _ in range(200):
+        if not any(
+            pq.pending_active() > 0 for pq in mgr.cluster_queues.values()
+        ):
+            break
+        res = sched.schedule()
+        for e in res.admitted:
+            psa = e.workload.admission.pod_set_assignments[0]
+            admitted[e.workload.name] = (dict(psa.flavors), cycle)
+        cycle += 1
+    parked = {
+        wl.name
+        for pq in mgr.cluster_queues.values()
+        for wl in list(pq.inadmissible.values()) + list(pq.heap.items())
+    }
+    return admitted, parked
+
+
+def device_fair_drain_trace(spec):
+    sched, mgr, cache, _ = build_env(spec, use_solver=False)
+    pending = []
+    for cq_name, pq in mgr.cluster_queues.items():
+        for wl in pq.snapshot_sorted():
+            pending.append((wl, cq_name))
+    snapshot = take_snapshot(cache)
+    outcome = run_drain(
+        snapshot,
+        pending,
+        cache.flavors,
+        timestamp_fn=lambda wl: queue_order_timestamp(wl, mgr._ts_policy),
+        fair_sharing=True,
+    )
+    admitted = {
+        wl.name: (flavors, cycle) for wl, _, flavors, cycle in outcome.admitted
+    }
+    parked = {wl.name for wl, _ in outcome.parked}
+    return admitted, parked, outcome
+
+
+def fair_drain_spec(seed, n_cohorts=2, cqs_per_cohort=4, workloads_per_cq=5):
+    """Cohorts with shared borrowable capacity, unequal fairSharing
+    weights and contending backlogs — admission ORDER is decided by the
+    DRS tournament, not (priority, FIFO)."""
+    rng = np.random.default_rng(seed + 47000)
+    flavors = ["fl-0", "fl-1"]
+    cqs, workloads = [], []
+    t = 0.0
+    weights = [500, 1000, 1000, 2000]
+    for ci in range(n_cohorts):
+        for qi in range(cqs_per_cohort):
+            name = f"cq-{ci}-{qi}"
+            k = int(rng.integers(1, 3))
+            fls = []
+            for f in flavors[:k]:
+                fls.append((f, {"cpu": str(int(rng.integers(2, 8)))}, None, None))
+            cqs.append(
+                {
+                    "name": name,
+                    "cohort": f"cohort-{ci}",
+                    "groups": [{"resources": ["cpu"], "flavors": fls}],
+                    "fair_weight": weights[int(rng.integers(0, len(weights)))],
+                }
+            )
+            for wi in range(workloads_per_cq):
+                t += 1.0
+                workloads.append(
+                    {
+                        "name": f"wl-{ci}-{qi}-{wi}",
+                        "queue": f"lq-{name}",
+                        "prio": int(rng.integers(0, 3)) * 10,
+                        "t": t,
+                        "pod_sets": [
+                            {
+                                "name": "main",
+                                "count": int(rng.integers(1, 3)),
+                                "requests": {"cpu": str(int(rng.integers(1, 5)))},
+                            }
+                        ],
+                    }
+                )
+    return {"flavors": flavors, "cqs": cqs, "workloads": workloads}
+
+
+class TestDrainFairSharing:
+    def test_tournament_orders_by_drs(self):
+        # cq-a (weight 500) already borrows heavily; cq-b (weight 2000)
+        # borrows little. Fair order admits cq-b's head first when only
+        # one can fit — the opposite of the FIFO order.
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq-a",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "2"}, None, None)]}
+                    ],
+                    "fair_weight": 500,
+                },
+                {
+                    "name": "cq-b",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "2"}, None, None)]}
+                    ],
+                    "fair_weight": 2000,
+                },
+            ],
+            "workloads": [
+                # FIFO would admit wa first (earlier timestamp)
+                {
+                    "name": "wa", "queue": "lq-cq-a", "prio": 0, "t": 1.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "3"}}
+                    ],
+                },
+                {
+                    "name": "wb", "queue": "lq-cq-b", "prio": 0, "t": 2.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "3"}}
+                    ],
+                },
+            ],
+        }
+        h_admitted, h_parked = host_fair_drain_trace(spec)
+        d_admitted, d_parked, outcome = device_fair_drain_trace(spec)
+        assert not outcome.fallback
+        assert d_admitted == h_admitted
+        assert d_parked == h_parked
+        # both would borrow 1 above nominal 2; b's weight (2000) makes
+        # its simulated share lower, so b wins the tournament, admits
+        # in cycle 0, and a (no capacity left) parks
+        assert d_admitted == {"wb": ({"cpu": "f"}, 0)}
+        assert d_parked == {"wa"}
+        # the NON-fair order decides the opposite way (wa is older), so
+        # the tournament — not FIFO — made this call
+        ff_admitted, ff_parked, _ = device_drain_trace(spec)
+        assert "wa" in ff_admitted and ff_parked == {"wb"}
+
+    def test_preempt_capable_cqs_fall_back_in_fair_mode(self):
+        from kueue_tpu.models.cluster_queue import Preemption
+        from kueue_tpu.models.constants import PreemptionPolicy
+
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"], "flavors": [("f", {"cpu": "4"}, None, None)]}
+                    ],
+                    "preemption": Preemption(
+                        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+                    ),
+                }
+            ],
+            "workloads": [
+                {
+                    "name": "w", "queue": "lq-cq", "prio": 0, "t": 1.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "2"}}
+                    ],
+                }
+            ],
+        }
+        _, _, outcome = device_fair_drain_trace(spec)
+        assert [wl.name for wl, _ in outcome.fallback] == ["w"]
+
+    @pytest.mark.parametrize("seed", range(16))
+    def test_randomized(self, seed):
+        spec = fair_drain_spec(seed)
+        h_admitted, h_parked = host_fair_drain_trace(spec)
+        d_admitted, d_parked, outcome = device_fair_drain_trace(spec)
+        assert not outcome.fallback
+        assert d_admitted == h_admitted
+        assert d_parked == h_parked
 
 
 def test_retry_cap_scales_with_walk_odometer():

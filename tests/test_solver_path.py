@@ -69,6 +69,12 @@ def build_env(spec, use_solver):
         cq_kwargs = {}
         if cq_spec.get("fungibility") is not None:
             cq_kwargs["flavor_fungibility"] = cq_spec["fungibility"]
+        if cq_spec.get("fair_weight") is not None:
+            from kueue_tpu.models.cluster_queue import FairSharing
+
+            cq_kwargs["fair_sharing"] = FairSharing(
+                weight_milli=int(cq_spec["fair_weight"])
+            )
         cq = ClusterQueue(
             name=cq_spec["name"],
             cohort=cq_spec.get("cohort"),
