@@ -81,6 +81,7 @@ def plan_drain(
     max_cells: int = 4,
     timestamp_fn=None,
     max_podsets: int = 4,
+    allow_tas: bool = False,
 ) -> DrainPlan:
     """Lower the backlog and pack it into per-CQ queue tensors.
 
@@ -92,7 +93,7 @@ def plan_drain(
 
     lowered = lower_heads_multi(
         snapshot, pending, flavors, max_candidates, max_cells, max_podsets,
-        timestamp_fn, any_fungibility=True,
+        timestamp_fn, any_fungibility=True, allow_tas=allow_tas,
     )
     fallback = set(lowered.fallback)
 
@@ -708,6 +709,323 @@ def run_drain_preempt(
         cycles=cycles,
         truncated=truncated,
         preempted=preempted,
+    )
+
+
+@dataclass
+class TASDrainOutcome(DrainOutcome):
+    # TopologyAssignment per admitted entry, aligned with ``admitted``
+    # (None for non-TAS workloads)
+    assignments: List[object] = field(default_factory=list)
+
+
+def run_drain_tas(
+    snapshot: Snapshot,
+    pending: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    tas_cache,
+    max_candidates: int = 8,
+    max_cells: int = 4,
+    timestamp_fn=None,
+    max_cycles: Optional[int] = None,
+) -> TASDrainOutcome:
+    """Multi-cycle drain with Topology-Aware Scheduling heads decided
+    on the device (ops/drain_kernel.solve_drain_tas) — one dispatch +
+    one fetch, then a cheap host replay (one placement per ADMITTED
+    workload, grouped per cycle against cycle-start state) that
+    reconstructs the TopologyAssignments and asserts the kernel's final
+    TAS leaf usage is reproduced exactly.
+
+    Scope: single-podset Required-mode topology requests on ONE shared
+    taint-free TAS flavor; TAS ClusterQueues must be preemption-free
+    and single-flavor. Heads outside the scope route to ``fallback``
+    for the sequential cycle loop.
+    """
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.core.workload_info import quota_per_pod
+    from kueue_tpu.models.constants import (
+        TOPOLOGY_MODE_REQUIRED,
+        PreemptionPolicy,
+        ReclaimWithinCohortPolicy,
+    )
+    from kueue_tpu.ops.drain_kernel import (
+        DrainQueues,
+        TASHeads,
+        solve_drain_tas_packed_jit,
+    )
+    from kueue_tpu.ops.tas_kernel import topology_from_snapshot
+    from kueue_tpu.resources import PODS
+    from kueue_tpu.tas.snapshot import TASPodSetRequest, domain_id
+
+    plan = plan_drain(
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn,
+        allow_tas=True,
+    )
+    q = max(len(plan.cq_order), 1)
+    nl = plan.queues_np["cells"].shape[1]
+
+    tas_flavor_names = set(tas_cache.flavors)
+
+    def cq_flavor_names(cq_name):
+        cq = snapshot.cq_models[cq_name]
+        return {fq.name for rg in cq.resource_groups for fq in rg.flavors}
+
+    # ---- scope: classify queues, pick THE shared topology flavor ----
+    drop: List[int] = []
+    tas_queue: Dict[int, str] = {}  # qi -> tas flavor name
+    for qi, cq_name in enumerate(plan.cq_order):
+        prem = snapshot.cq_models[cq_name].preemption
+        names = cq_flavor_names(cq_name)
+        tnames = names & tas_flavor_names
+        if not tnames:
+            # plain quota queue — but topology-requesting entries on a
+            # non-TAS flavor must NOT be silently admitted as plain:
+            # the host rejects the flavor ("does not support
+            # TopologyAwareScheduling", tas/manager.py check) and parks
+            for pos in range(int(plan.queues_np["qlen"][qi])):
+                i = plan.head_of.get((qi, pos))
+                if i is not None and any(
+                    ps.topology_request is not None
+                    for ps in plan.lowered.heads[i].pod_sets
+                ):
+                    drop.append(qi)
+                    break
+            continue
+        capable = prem.within_cluster_queue != PreemptionPolicy.NEVER or (
+            snapshot.has_cohort(cq_name)
+            and prem.reclaim_within_cohort != ReclaimWithinCohortPolicy.NEVER
+        )
+        if capable or len(names) != 1:
+            drop.append(qi)
+            continue
+        tas_queue[qi] = next(iter(tnames))
+    flavor_pool = set(tas_queue.values())
+    shared = sorted(flavor_pool)[0] if flavor_pool else None
+    for qi in list(tas_queue):
+        if tas_queue[qi] != shared:
+            drop.append(qi)
+            del tas_queue[qi]
+
+    snap = tas_cache.flavors[shared].snapshot() if shared else None
+    if snap is not None:
+        snap.freeze()
+        if any(t for t in snap._leaf_taints):
+            drop.extend(tas_queue)
+            tas_queue = {}
+            snap = None
+
+    # per-entry TAS lowering + scope checks
+    n_res_t = len(snap._resources) if snap is not None else 1
+    r_index = (
+        {r: j for j, r in enumerate(snap._resources)}
+        if snap is not None
+        else {}
+    )
+    t_is = np.zeros(q, dtype=bool)
+    t_req = np.zeros((q, nl, max(n_res_t, 1)), dtype=np.int64)
+    t_count = np.zeros((q, nl), dtype=np.int32)
+    t_level = np.zeros((q, nl), dtype=np.int32)
+    dropped = set(drop)
+    for qi, fname in tas_queue.items():
+        if qi in dropped:
+            continue
+        ok = True
+        for pos in range(int(plan.queues_np["qlen"][qi])):
+            i = plan.head_of.get((qi, pos))
+            if i is None:
+                continue
+            wl = plan.lowered.heads[i]
+            if len(wl.pod_sets) != 1:
+                ok = False
+                break
+            ps = wl.pod_sets[0]
+            tr = ps.topology_request
+            if (
+                tr is None
+                or tr.mode != TOPOLOGY_MODE_REQUIRED
+                or tr.level not in snap.level_keys
+            ):
+                ok = False
+                break
+            per_pod = dict(quota_per_pod(ps, None))
+            per_pod[PODS] = per_pod.get(PODS, 0) + 1
+            if any(r not in r_index for r in per_pod):
+                ok = False
+                break
+            for r, v in per_pod.items():
+                t_req[qi, pos, r_index[r]] = int(v)
+            t_count[qi, pos] = int(ps.count)
+            t_level[qi, pos] = snap.level_keys.index(tr.level)
+        if not ok:
+            drop.append(qi)
+            dropped.add(qi)
+        else:
+            t_is[qi] = True
+
+    # drop out-of-scope queues to the fallback path
+    extra_fb: List[Tuple[Workload, str]] = []
+    for qi in sorted(set(drop)):
+        plan.queues_np["qlen"][qi] = 0
+        plan.queues_np["cq_rows"][qi] = -1
+        plan.queues_np["seg_id"][qi] = -1
+        for pos in range(nl):
+            i = plan.head_of.pop((qi, pos), None)
+            if i is not None:
+                extra_fb.append(
+                    (plan.lowered.heads[i], plan.lowered.cq_names[i])
+                )
+
+    if max_cycles is not None:
+        plan.max_cycles = max_cycles
+    tree, paths, _ = tree_arrays(snapshot)
+    queues = DrainQueues(
+        **{k: jnp.asarray(v) for k, v in plan.queues_np.items()}
+    )
+
+    if snap is not None:
+        from kueue_tpu.ops.tas_kernel import domain_parent_map
+
+        topo = topology_from_snapshot(snap)
+        topo_free, tas_usage0 = topo.free, topo.tas_usage
+        seg_ids_j, n_domains = topo.seg_ids, topo.n_domains
+        parent_map = domain_parent_map(snap)
+        lf_n = topo_free.shape[0]
+    else:
+        # no TAS queue in scope: inert 1-leaf topology
+        topo_free = jnp.zeros((1, 1), dtype=jnp.int64)
+        tas_usage0 = jnp.zeros((1, 1), dtype=jnp.int64)
+        seg_ids_j = jnp.zeros((1, 1), dtype=jnp.int32)
+        n_domains = (1,)
+        parent_map = np.zeros((1, 1), dtype=np.int32)
+        lf_n = 1
+        n_res_t = 1
+
+    theads = TASHeads(
+        t_is=jnp.asarray(t_is),
+        t_req=jnp.asarray(t_req[:, :, :n_res_t]),
+        t_count=jnp.asarray(t_count),
+        t_level=jnp.asarray(t_level),
+        parent_map=jnp.asarray(parent_map),
+    )
+    n_live = int((plan.queues_np["cq_rows"] >= 0).sum())
+    n_steps = _bucket(max(n_live, 1), minimum=8)
+
+    flat = np.asarray(
+        solve_drain_tas_packed_jit(
+            tree,
+            jnp.asarray(snapshot.local_usage),
+            queues,
+            paths,
+            topo_free,
+            tas_usage0,
+            seg_ids_j,
+            theads,
+            n_domains=n_domains,
+            n_steps=n_steps,
+            max_cycles=plan.max_cycles,
+        )
+    )  # the single fetch
+    nq, nl2, npd = plan.queues_np["cells"].shape[:3]
+    ql, qlp = nq * nl2, nq * nl2 * npd
+    off = 0
+    adm_k = flat[off : off + qlp].reshape((nq, nl2, npd)); off += qlp
+    adm_cycle = flat[off : off + ql].reshape((nq, nl2)); off += ql
+    adm_step = flat[off : off + ql].reshape((nq, nl2)); off += ql
+    cursor = flat[off : off + nq]; off += nq
+    stuck_q = flat[off : off + nq].astype(bool); off += nq
+    tas_final = flat[off : off + lf_n * n_res_t].reshape((lf_n, n_res_t))
+    off += lf_n * n_res_t
+    cycles = int(flat[-1])
+    qlen = plan.queues_np["qlen"]
+    truncated = bool(np.any((cursor < qlen) & ~stuck_q))
+
+    lowered = plan.lowered
+    admitted: List[Tuple[Workload, str, Dict[str, str], int]] = []
+    adm_meta: List[Tuple[int, int, int]] = []  # (cycle, step, index)
+    parked: List[Tuple[Workload, str]] = []
+    extra_fallback: List[Tuple[Workload, str]] = list(extra_fb)
+    for (qi, pos), i in plan.head_of.items():
+        wl = lowered.heads[i]
+        cq_name = lowered.cq_names[i]
+        kk = int(adm_k[qi, pos, 0])
+        if kk >= 0:
+            adm_meta.append(
+                (int(adm_cycle[qi, pos]), int(adm_step[qi, pos]), len(admitted))
+            )
+            admitted.append(
+                (wl, cq_name, _admitted_flavors(lowered, i, adm_k[qi, pos]),
+                 int(adm_cycle[qi, pos]))
+            )
+        elif pos >= int(cursor[qi]):
+            extra_fallback.append((wl, cq_name))
+        else:
+            parked.append((wl, cq_name))
+    order = sorted(range(len(admitted)), key=lambda j: adm_meta[j][:2])
+    admitted = [admitted[adm_meta[j][2]] for j in order]
+    adm_meta = [adm_meta[j] for j in order]
+
+    # ---- replay: reconstruct TopologyAssignments per admission cycle
+    # against cycle-start state (the kernel nominates against it too);
+    # the final leaf usage must reproduce the kernel's exactly ----
+    assignments: List[object] = [None] * len(admitted)
+    if snap is not None:
+        j = 0
+        while j < len(admitted):
+            cyc = adm_meta[j][0]
+            batch = []
+            while j < len(admitted) and adm_meta[j][0] == cyc:
+                wl, cq_name, _, _ = admitted[j]
+                if wl.pod_sets[0].topology_request is not None:
+                    batch.append(j)
+                j += 1
+            placed = []
+            for bj in batch:
+                wl = admitted[bj][0]
+                ps = wl.pod_sets[0]
+                req = TASPodSetRequest(
+                    podset_name=ps.name,
+                    count=ps.count,
+                    single_pod_requests=dict(quota_per_pod(ps, None)),
+                    topology_request=ps.topology_request,
+                    tolerations=tuple(ps.tolerations),
+                )
+                ta, reason = snap.find_topology_assignment(req, {})
+                assert not reason, (
+                    f"TAS drain replay failed for {wl.name}: {reason}"
+                )
+                assignments[bj] = ta
+                placed.append((req, ta))
+            for req, ta in placed:  # charge AFTER the batch (cycle end)
+                for dom in ta.domains:
+                    did = domain_id(dom.values)
+                    usage = {
+                        r: v * dom.count
+                        for r, v in req.single_pod_requests.items()
+                    }
+                    snap.add_tas_usage(did, usage, dom.count)
+        snap.freeze()
+        if not np.array_equal(snap._tas_usage, tas_final):
+            bad = np.argwhere(snap._tas_usage != tas_final)[:8]
+            raise AssertionError(
+                "TAS drain replay does not reproduce the kernel's leaf "
+                "usage — placement parity bug; first diffs (leaf, res): "
+                + "; ".join(
+                    f"{tuple(ix)}: host={snap._tas_usage[tuple(ix)]} "
+                    f"kernel={tas_final[tuple(ix)]}"
+                    for ix in bad
+                )
+            )
+
+    fb = [
+        (lowered.heads[i], lowered.cq_names[i]) for i in plan.fallback
+    ] + extra_fallback
+    return TASDrainOutcome(
+        admitted=admitted,
+        parked=parked,
+        fallback=fb,
+        cycles=cycles,
+        truncated=truncated,
+        assignments=assignments,
     )
 
 

@@ -136,6 +136,7 @@ def _build_template(
     flavors: Dict[str, ResourceFlavor],
     k: int,
     c: int,
+    allow_tas: bool = False,
 ) -> _Template:
     t = _Template()
 
@@ -164,9 +165,15 @@ def _build_template(
         for gi in range(start, n_flavors):
             fq = rg.flavors[gi]
             flavor = flavors.get(fq.name)
-            if flavor is not None and flavor.topology_name is not None:
+            if (
+                not allow_tas
+                and flavor is not None
+                and flavor.topology_name is not None
+            ):
                 # TAS flavors (incl. implied TAS on TAS-only CQs)
-                # need topology placement — host path only
+                # need topology placement — host path only, unless the
+                # caller is the TAS drain (run_drain_tas), which does
+                # the placement in kernel
                 t.fallback = True
                 return t
             if flavor_eligible(flavor, ps, label_keys):
@@ -573,6 +580,7 @@ def lower_heads_multi(
     timestamp_fn=None,
     transform=None,
     any_fungibility: bool = True,
+    allow_tas: bool = False,
 ) -> MultiLowered:
     """lower_heads generalized over podsets (drain path).
 
@@ -642,7 +650,7 @@ def lower_heads_multi(
         # the per-podset list plumbing below (bulk-drain lowering cost)
         if len(wl.pod_sets) == 1:
             ps = wl.pod_sets[0]
-            if ps.topology_request is not None:
+            if ps.topology_request is not None and not allow_tas:
                 out.fallback.append(i)
                 continue
             per_pod = quota_per_pod(ps, transform)
@@ -651,7 +659,8 @@ def lower_heads_multi(
             t = templates.get(key)
             if t is None:
                 t = _build_template(
-                    snapshot, cq, cq_name, ps, per_pod, starts, flavors, k, c
+                    snapshot, cq, cq_name, ps, per_pod, starts, flavors, k, c,
+                    allow_tas=allow_tas,
                 )
                 templates[key] = t
             if t.fallback:
@@ -680,7 +689,7 @@ def lower_heads_multi(
         bad = False
         head_templates = []
         for ps_idx, ps in enumerate(wl.pod_sets):
-            if ps.topology_request is not None:
+            if ps.topology_request is not None and not allow_tas:
                 bad = True  # TAS placement stays on the host path
                 break
             per_pod = quota_per_pod(ps, transform)

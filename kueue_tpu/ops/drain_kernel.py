@@ -584,6 +584,432 @@ def solve_drain(
     )
 
 
+class TASHeads(NamedTuple):
+    """Per-queue TAS lowering for solve_drain_tas (one shared topology).
+
+    t_is:    bool[Q]         — the queue's entries are TAS workloads.
+    t_req:   int64[Q, L, Rt] — per-ENTRY per-pod request vector on the
+             topology resource axis (pods slot included as 1).
+    t_count: int32[Q, L]     — gang size per entry.
+    t_level: int32[Q, L]     — requested topology level index (Required).
+    parent_map: int32[D_t, ND] — domain -> parent domain index at the
+             level above (row 0 unused, zero; ordering owned by
+             ops/tas_kernel.domain_parent_map), ND = max domains/level.
+    """
+
+    t_is: jnp.ndarray
+    t_req: jnp.ndarray  # int64[Q, L, Rt]
+    t_count: jnp.ndarray  # int32[Q, L]
+    t_level: jnp.ndarray  # int32[Q, L]
+    parent_map: jnp.ndarray  # int32[D_t, ND]
+
+
+def _tas_fit_and_place(
+    topo_free,  # int64[Lf, Rt]
+    tas_u,  # int64[Lf, Rt] current TAS usage
+    seg_ids,  # int32[D_t, Lf]
+    n_domains,  # static tuple per level
+    parent_map,  # int32[D_t, ND]
+    req,  # int64[Rt] per-pod request
+    count,  # int32 gang size
+    level,  # int32 requested level index
+    place: bool,
+):
+    """Phase-1 counts + the reference's REQUIRED-mode phase-2 greedy
+    (BestFit default profile) for ONE podset against the current TAS
+    state (tas_flavor_snapshot.go:394-444,494-621). Returns
+    (fits bool, taken int64[Lf]) — ``taken`` is all-zero unless
+    ``place`` and the request fits."""
+    n_lf = topo_free.shape[0]
+    d_t = len(n_domains)
+    nd_max = parent_map.shape[1]
+    INF = jnp.int64(1 << 62)
+
+    remaining = topo_free - tas_u
+    per_res = jnp.sign(remaining) * (
+        jnp.abs(remaining) // jnp.maximum(req[None, :], 1)
+    )
+    per_res = jnp.where((req > 0)[None, :], per_res, MAX_COUNT_TAS)
+    counts = jnp.clip(jnp.min(per_res, axis=-1), None, MAX_COUNT_TAS)
+    counts = jnp.maximum(counts, jnp.int64(-(1 << 40)))  # keep sums sane
+
+    # per-level domain totals, padded to ND
+    states = []
+    for d in range(d_t):
+        s = jax.ops.segment_sum(
+            counts, seg_ids[d], num_segments=n_domains[d]
+        )
+        s = jnp.pad(s, (0, nd_max - n_domains[d]), constant_values=-1)
+        states.append(s)
+
+    cnt = count.astype(jnp.int64)
+
+    def pick_single(s, valid):
+        """BestFit: the domain with the smallest state >= count
+        (first in (-state, values) order among equal states)."""
+        fit = valid & (s >= cnt)
+        mval = jnp.min(jnp.where(fit, s, INF))
+        idx = jnp.argmax(fit & (s == mval))
+        return jnp.any(fit), idx.astype(jnp.int32)
+
+    # required mode: the requested level must hold one fitting domain
+    alloc = jnp.zeros((d_t, nd_max), dtype=jnp.int64)
+    fits_lvl = []
+    pick_lvl = []
+    for d in range(d_t):
+        valid = jnp.arange(nd_max) < n_domains[d]
+        ok, idx = pick_single(states[d], valid)
+        fits_lvl.append(ok)
+        pick_lvl.append(idx)
+    fits = jnp.select(
+        [level == d for d in range(d_t)], fits_lvl, False
+    )
+    pick0 = jnp.select(
+        [level == d for d in range(d_t)], pick_lvl, 0
+    )
+
+    if not place:
+        return fits, jnp.zeros(n_lf, dtype=jnp.int64)
+
+    # seed the allocation at the requested level, then descend with the
+    # pooled greedy split (update_counts_to_minimum, BestFit jumps)
+    for d in range(d_t):
+        seed = (
+            jnp.zeros(nd_max, dtype=jnp.int64)
+            .at[pick0]
+            .set(jnp.where(fits, cnt, 0))
+        )
+        alloc = alloc.at[d].set(jnp.where(level == d, seed, alloc[d]))
+
+    def split(s, child_ok):
+        """Greedy desc-order fill of ``cnt`` over the masked domains
+        with the BestFit jump (tas_flavor_snapshot.go:468-511)."""
+        sm = jnp.where(child_ok, s, jnp.int64(-1))
+        order = jnp.lexsort((jnp.arange(nd_max), -sm))
+        ss = sm[order]
+        prefix = jnp.cumsum(jnp.maximum(ss, 0)) - jnp.maximum(ss, 0)
+        remaining = cnt - prefix
+        # the host walk never evaluates a position with remaining <= 0
+        # (the covering take returns first), so pads/zero-state domains
+        # can never be picked
+        covered = (remaining > 0) & (ss >= remaining)
+        k = jnp.argmax(covered)
+        rem_k = jnp.maximum(remaining[k], 0)
+        fitmask = (jnp.arange(nd_max) >= k) & (ss >= rem_k) & (rem_k > 0)
+        mval = jnp.min(jnp.where(fitmask, ss, INF))
+        jstar = jnp.argmax(fitmask & (ss == mval))
+        take = jnp.where(jnp.arange(nd_max) < k, jnp.maximum(ss, 0), 0)
+        take = take.at[jstar].set(rem_k)
+        # scatter back to value order
+        out = jnp.zeros(nd_max, dtype=jnp.int64).at[order].set(take)
+        return jnp.where(child_ok, out, 0)
+
+    for d in range(1, d_t):
+        # children (at level d) of domains picked at level d-1
+        pm = jnp.maximum(parent_map[d], 0)
+        picked_above = alloc[d - 1][pm] > 0
+        child_ok = picked_above & (jnp.arange(nd_max) < n_domains[d])
+        lower = jnp.where(
+            (level < d) & fits, split(states[d], child_ok), alloc[d]
+        )
+        alloc = alloc.at[d].set(lower)
+
+    # leaf-level taken counts
+    leaf_alloc = alloc[d_t - 1]
+    taken = leaf_alloc[seg_ids[d_t - 1]]  # [Lf] via leaf->domain id
+    # a leaf-level domain maps 1:1 onto leaves in this lowering, but
+    # gather defensively through seg_ids anyway
+    taken = jnp.where(fits, taken, 0)
+    return fits, taken
+
+
+MAX_COUNT_TAS = (1 << 31) - 1
+
+
+class TASDrainResult(NamedTuple):
+    """DrainResult plus TAS outputs: adm_step int32[Q,L] (intra-cycle
+    admission sequence — the host replay orders placements by
+    (admitted_cycle, adm_step)); tas_usage int64[Lf,Rt] final TAS leaf
+    usage (the host replay asserts it reproduces this exactly)."""
+
+    admitted_k: jnp.ndarray
+    admitted_cycle: jnp.ndarray
+    adm_step: jnp.ndarray
+    cursor: jnp.ndarray
+    cycles: jnp.ndarray
+    local_usage: jnp.ndarray
+    tas_usage: jnp.ndarray
+    stuck: jnp.ndarray
+
+
+def solve_drain_tas(
+    tree: QuotaTree,
+    local_usage: jnp.ndarray,  # int64[N, FR]
+    queues: DrainQueues,
+    paths: jnp.ndarray,  # int32[N, D+1]
+    topo_free: jnp.ndarray,  # int64[Lf, Rt]
+    tas_usage0: jnp.ndarray,  # int64[Lf, Rt]
+    seg_ids: jnp.ndarray,  # int32[D_t, Lf]
+    theads: TASHeads,
+    n_domains,  # static tuple
+    n_steps: int,  # TOTAL sequential steps per cycle (global order)
+    max_cycles: int,
+) -> TASDrainResult:
+    """Multi-cycle drain with Topology-Aware Scheduling heads decided
+    IN KERNEL. A shared topology couples every ClusterQueue using the
+    flavor — across cohorts — so phase 2 is one GLOBAL sequential scan
+    in the scheduler's entry order (the reference admits sequentially
+    too; cross-cohort TAS contention resolves by that order), not the
+    per-root-cohort parallel scan of solve_drain. Per cycle:
+
+    - nomination: the normal quota walk, then each quota-Fit TAS head
+      checks placement feasibility against CYCLE-START TAS state (the
+      host's Assignment.WorkloadsTopologyRequests degrade-to-NoFit,
+      tas_flavorassigner.go:31-50): infeasible heads park;
+    - phase 2: one head per step in global (borrowing, priority, FIFO)
+      order; TAS heads re-fit AND place against the LIVE TAS state
+      (the admit-time re-validation) with the reference's phase-2
+      greedy — REQUIRED mode, BestFit profile
+      (tas_flavor_snapshot.go:394-444,494-621) — and charge the
+      assigned leaves immediately; losers stay pending and re-park
+      next cycle once nomination sees the new state.
+
+    Scope (host lowering enforces): single-podset Required-mode heads
+    on one shared taint-free topology, no preemption, default TAS
+    profile. The host replays admitted placements in (cycle, step)
+    order to reconstruct TopologyAssignments and asserts the final
+    leaf usage matches ``tas_usage``.
+    """
+    max_depth = tree.max_depth
+    subtree, guaranteed = subtree_quota(tree)
+    from kueue_tpu.ops.assign_kernel import potential_available_all
+
+    potential = potential_available_all(tree, subtree, guaranteed)
+
+    q, l, pmax, k, c = queues.cells.shape
+    q_idx = jnp.arange(q)
+    cq = jnp.maximum(queues.cq_rows, 0)
+
+    tas_place_v = jax.vmap(
+        lambda req, count, level, tas_u: _tas_fit_and_place(
+            topo_free, tas_u, seg_ids, n_domains, theads.parent_map,
+            req, count, level, place=True,
+        ),
+        in_axes=(0, 0, 0, None),
+    )
+
+    def cycle_body(state):
+        (local, tas_u, cursor, g_start, retries, stuck, no_prog, adm_k,
+         adm_cycle, adm_step, cycle) = state
+
+        active = cursor < queues.qlen  # [Q]
+        cur = jnp.minimum(cursor, l - 1)
+        usage0 = usage_tree(tree, guaranteed, local)
+        (is_fit, is_pre, pend, head_borrow, rep_k, walk_next,
+         cells_eff, qty_eff, _mneed) = _nominate_multi(
+            tree, subtree, guaranteed, local, usage0, queues, q_idx, cur,
+            active, g_start, potential,
+        )
+        # TAS placement at NOMINATION against cycle-start TAS state
+        # (Assignment.WorkloadsTopologyRequests); the admit-time check
+        # below only re-validates THESE assigned leaves — the host does
+        # not re-place in-cycle (tas/manager.py fits())
+        t_req = theads.t_req[q_idx, cur]  # [Q, Rt]
+        t_count = theads.t_count[q_idx, cur]
+        t_level = theads.t_level[q_idx, cur]
+        tas_head = theads.t_is & active
+        tas_nom_ok, taken0 = tas_place_v(t_req, t_count, t_level, tas_u)
+        tas_parked = tas_head & is_fit & ~tas_nom_ok
+        is_fit = is_fit & ~tas_parked
+        pend = pend & ~tas_parked  # degrade-to-NoFit clears the cursor
+        nofit = ~(is_fit | is_pre)
+
+        prio = queues.priority[q_idx, cur]
+        ts = queues.timestamp[q_idx, cur]
+        order = jnp.lexsort(
+            (
+                ts,
+                -prio,
+                head_borrow.astype(jnp.int64),
+                nofit.astype(jnp.int64),
+            )
+        )
+        valid_sorted = active[order] & (queues.cq_rows[order] >= 0) & (~nofit[order])
+        rank = jnp.cumsum(valid_sorted.astype(jnp.int32)) - 1
+        dest = jnp.where(valid_sorted & (rank < n_steps), rank, n_steps)
+        mat1 = (
+            jnp.full(n_steps + 1, -1, dtype=jnp.int32)
+            .at[dest]
+            .set(order.astype(jnp.int32))[:n_steps]
+        )
+
+        cell_valid_all = (cells_eff >= 0) & (qty_eff > 0)
+        cells_c = jnp.maximum(cells_eff, 0)
+
+        def step(carry, s):
+            usage, tas_u_s = carry
+            hq = mat1[s]
+            act = hq >= 0
+            hh = jnp.maximum(hq, 0)
+            path = paths[cq[hh]]
+            cells_ = cells_eff[hh]
+            qty_ = qty_eff[hh]
+            ccells = jnp.maximum(cells_, 0)
+            cell_valid = cell_valid_all[hh] & act
+
+            avail = _avail_along_path(
+                path, cells_, usage, subtree, guaranteed,
+                tree.borrowing_limit, max_depth,
+            )
+            fits_q = jnp.all(jnp.where(cell_valid, avail >= qty_, True))
+            # admit-time TAS re-validation: every NOMINATED leaf must
+            # still hold its assigned count against LIVE usage
+            taken_h = taken0[hh]  # [Lf]
+            rem = topo_free - tas_u_s
+            per_res = jnp.sign(rem) * (
+                jnp.abs(rem) // jnp.maximum(t_req[hh][None, :], 1)
+            )
+            per_res = jnp.where(
+                (t_req[hh] > 0)[None, :], per_res, MAX_COUNT_TAS
+            )
+            counts_now = jnp.min(per_res, axis=-1)
+            t_ok = jnp.all((taken_h == 0) | (counts_now >= taken_h))
+            tas_gate = jnp.where(tas_head[hh], t_ok, True)
+            admit = act & is_fit[hh] & fits_q & tas_gate
+            # charge the nominated leaves for admitted TAS heads
+            tas_u_s = tas_u_s + jnp.where(
+                admit & tas_head[hh],
+                t_req[hh][None, :] * taken_h[:, None],
+                0,
+            )
+            reserve = act & is_pre[hh] & queues.no_reclaim[hh]
+            nominal_c = tree.nominal[cq[hh], ccells]
+            bl_c = tree.borrowing_limit[cq[hh], ccells]
+            leaf_usage_c = usage[cq[hh], ccells]
+            borrow_cap = jnp.where(
+                bl_c < NO_LIMIT,
+                jnp.minimum(qty_, nominal_c + bl_c - leaf_usage_c),
+                qty_,
+            )
+            nominal_cap = jnp.maximum(
+                0, jnp.minimum(qty_, nominal_c - leaf_usage_c)
+            )
+            reserve_qty = jnp.where(head_borrow[hh], borrow_cap, nominal_cap)
+            delta = jnp.where(
+                cell_valid & admit,
+                qty_,
+                jnp.where(cell_valid & reserve, reserve_qty, 0),
+            )
+            for d in range(0, max_depth + 1):
+                node = jnp.maximum(path[d], 0)
+                node_valid = path[d] >= 0
+                old = usage[node, ccells]
+                gg = guaranteed[node, ccells]
+                new = old + delta
+                usage = usage.at[node, ccells].add(
+                    jnp.where(node_valid, delta, 0)
+                )
+                delta = jnp.where(
+                    node_valid,
+                    jnp.maximum(0, new - gg) - jnp.maximum(0, old - gg),
+                    delta,
+                )
+            return (usage, tas_u_s), admit
+
+        (_, tas_u), admit_sn = lax.scan(
+            step, (usage0, tas_u), jnp.arange(n_steps)
+        )
+        safe_idx = jnp.where(mat1 >= 0, mat1, q)
+        admitted = (
+            jnp.zeros(q, dtype=bool)
+            .at[safe_idx]
+            .set(admit_sn, mode="drop")
+        )
+        step_of = (
+            jnp.full(q + 1, -1, dtype=jnp.int32)
+            .at[safe_idx]
+            .set(
+                jnp.where(admit_sn, jnp.arange(n_steps, dtype=jnp.int32), -1),
+                mode="drop",
+            )[:q]
+        )
+
+        add = jnp.where(cell_valid_all & admitted[:, None], qty_eff, 0)
+        local = local.at[cq[:, None], cells_c].add(add)
+        adm_step = adm_step.at[q_idx, cur].set(
+            jnp.where(admitted & active, step_of, adm_step[q_idx, cur])
+        )
+        (cursor, g_start, retries, stuck, no_prog, adm_k, adm_cycle) = (
+            _cursor_queue_motion(
+                queues, q_idx, cur, active, is_fit, pend, admitted,
+                rep_k, walk_next, retries, stuck, no_prog, adm_k,
+                adm_cycle, g_start, cursor, cycle,
+            )
+        )
+        return (local, tas_u, cursor, g_start, retries, stuck, no_prog,
+                adm_k, adm_cycle, adm_step, cycle + 1)
+
+    def cond(state):
+        cursor = state[2]
+        stuck = state[5]
+        cycle = state[10]
+        return jnp.any((cursor < queues.qlen) & ~stuck) & (cycle < max_cycles)
+
+    g = queues.gidx.shape[-1]
+    init = (
+        local_usage,
+        tas_usage0,
+        jnp.zeros(q, dtype=jnp.int32),
+        jnp.zeros((q, pmax, g), dtype=jnp.int32),
+        jnp.zeros(q, dtype=jnp.int32),
+        jnp.zeros(q, dtype=bool),
+        jnp.int32(0),
+        jnp.full((q, l, pmax), -1, dtype=jnp.int32),
+        jnp.full((q, l), -1, dtype=jnp.int32),
+        jnp.full((q, l), -1, dtype=jnp.int32),
+        jnp.int32(0),
+    )
+    (local_f, tas_f, cursor_f, _, _, stuck_f, _, adm_k, adm_cycle,
+     adm_step, cycles) = lax.while_loop(cond, cycle_body, init)
+    return TASDrainResult(
+        admitted_k=adm_k,
+        admitted_cycle=adm_cycle,
+        adm_step=adm_step,
+        cursor=cursor_f,
+        cycles=cycles,
+        local_usage=local_f,
+        tas_usage=tas_f,
+        stuck=stuck_f,
+    )
+
+
+def _solve_drain_tas_packed(
+    tree, local_usage, queues, paths, topo_free, tas_usage0, seg_ids,
+    theads, n_domains, n_steps: int, max_cycles: int,
+):
+    r = solve_drain_tas(
+        tree, local_usage, queues, paths, topo_free, tas_usage0, seg_ids,
+        theads, n_domains, n_steps, max_cycles,
+    )
+    return jnp.concatenate(
+        [
+            r.admitted_k.reshape(-1),
+            r.admitted_cycle.reshape(-1),
+            r.adm_step.reshape(-1),
+            r.cursor,
+            r.stuck.astype(jnp.int32),
+            r.tas_usage.reshape(-1),
+            r.cycles[None],
+        ]
+    )
+
+
+solve_drain_tas_packed_jit = jax.jit(
+    _solve_drain_tas_packed,
+    static_argnames=("n_domains", "n_steps", "max_cycles"),
+)
+
+
 def _fair_chain(
     usage, borrowed_base, paths_q, mcells, mqty, subtree, guaranteed,
     lendable, weight, parent, res_of, n_res: int, max_depth: int,

@@ -106,6 +106,14 @@ def fill_in_counts(
     return counts, level_counts(topo, counts)
 
 
+def _level_prefix_index(snap, d):
+    """Domain order at level d: sorted by level_values prefix (stable,
+    matches host _sorted_domains tie-break order). SINGLE owner of the
+    domain-index ordering — seg_ids and parent maps must agree."""
+    prefixes = sorted({leaf.level_values[: d + 1] for leaf in snap._leaf_order})
+    return {p: i for i, p in enumerate(prefixes)}
+
+
 def topology_from_snapshot(snap) -> TASTopology:
     """Build the dense view from a host TASFlavorSnapshot (frozen)."""
     import numpy as np
@@ -117,16 +125,30 @@ def topology_from_snapshot(snap) -> TASTopology:
     seg_ids = np.zeros((depth, n_l), dtype=np.int32)
     n_domains = []
     for d in range(depth):
-        # domain order: sorted by level_values prefix (stable, matches
-        # host _sorted_domains tie-break order)
-        prefixes = sorted({leaf.level_values[: d + 1] for leaf in leaves})
-        index = {p: i for i, p in enumerate(prefixes)}
+        index = _level_prefix_index(snap, d)
         for i, leaf in enumerate(leaves):
             seg_ids[d, i] = index[leaf.level_values[: d + 1]]
-        n_domains.append(len(prefixes))
+        n_domains.append(len(index))
     return TASTopology(
         free=jnp.asarray(snap._free),
         tas_usage=jnp.asarray(snap._tas_usage),
         seg_ids=jnp.asarray(seg_ids),
         n_domains=tuple(n_domains),
     )
+
+
+def domain_parent_map(snap):
+    """int32[D, ND]: domain index at level d -> parent index at level
+    d-1, in the SAME ordering as topology_from_snapshot's seg_ids (row
+    0 is unused and zero)."""
+    import numpy as np
+
+    snap.freeze()
+    depth = len(snap.level_keys)
+    indexes = [_level_prefix_index(snap, d) for d in range(depth)]
+    nd_max = max(len(ix) for ix in indexes)
+    parent_map = np.zeros((depth, nd_max), dtype=np.int32)
+    for d in range(1, depth):
+        for p, idx in indexes[d].items():
+            parent_map[d, idx] = indexes[d - 1][p[:-1]]
+    return parent_map
