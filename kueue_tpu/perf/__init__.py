@@ -14,10 +14,15 @@ from kueue_tpu.perf.generator import (
     QueueSetClass,
     WorkloadClass,
     WorkloadSet,
+    CONTENDED_GENERATOR_CONFIG,
     DEFAULT_GENERATOR_CONFIG,
 )
 from kueue_tpu.perf.runner import RunResult, run
-from kueue_tpu.perf.checker import RangeSpec, check
+from kueue_tpu.perf.checker import (
+    CONTENDED_RANGE_SPEC,
+    RangeSpec,
+    check,
+)
 
 __all__ = [
     "CohortClass",
@@ -25,7 +30,9 @@ __all__ = [
     "QueueSetClass",
     "WorkloadClass",
     "WorkloadSet",
+    "CONTENDED_GENERATOR_CONFIG",
     "DEFAULT_GENERATOR_CONFIG",
+    "CONTENDED_RANGE_SPEC",
     "RunResult",
     "run",
     "RangeSpec",

@@ -36,6 +36,12 @@ class RunResult:
     time_to_admission: Dict[str, List[float]] = field(default_factory=dict)
     # cq -> time-weighted average cpu utilization (fraction of nominal)
     cq_avg_utilization: Dict[str, float] = field(default_factory=dict)
+    # fraction of virtual time with a non-empty pending backlog (at
+    # scheduler quiescence — workloads that COULD not admit)
+    backlog_fraction: float = 0.0
+    # cq -> time-weighted average utilization restricted to backlogged
+    # intervals (the no-idle-capacity-under-backlog floor)
+    cq_backlogged_utilization: Dict[str, float] = field(default_factory=dict)
 
     def avg_tta(self, class_name: str) -> float:
         vals = self.time_to_admission.get(class_name, [])
@@ -80,18 +86,38 @@ def run(
     tta: Dict[str, List[float]] = {}
     # cq -> (last_event_time, integral of used_cpu dt)
     usage_integral: Dict[str, float] = {name: 0.0 for name in scenario.nominal_cpu}
+    backlog_integral: Dict[str, float] = {name: 0.0 for name in scenario.nominal_cpu}
+    # cohorts are independent capacity pools (borrowing is within-cohort
+    # only), so a CQ only counts as idle-under-backlog while ITS cohort
+    # has pending work
+    cohort_of = {cq.name: cq.cohort for cq in scenario.cluster_queues}
+    cohort_backlog_time: Dict[object, float] = {}
+    backlog_time = 0.0
     last_t = 0.0
 
     def accrue_usage(now: float) -> None:
-        nonlocal last_t
+        nonlocal last_t, backlog_time
         dt = now - last_t
         if dt <= 0:
             return
+        # backlog at quiescence: the scheduler ran to a fixed point at
+        # last_t, so anything still pending could NOT be admitted
+        backlogged_cohorts = {
+            cohort_of.get(name)
+            for name, pq in queues.cluster_queues.items()
+            if pq.pending_active() > 0 or len(pq.inadmissible) > 0
+        }
+        if backlogged_cohorts:
+            backlog_time += dt
+        for co in backlogged_cohorts:
+            cohort_backlog_time[co] = cohort_backlog_time.get(co, 0.0) + dt
         for name in usage_integral:
             used = sum(
                 qty for fr, qty in cache.usage_for(name).items() if fr.resource == "cpu"
             )
             usage_integral[name] += used * dt
+            if cohort_of.get(name) in backlogged_cohorts:
+                backlog_integral[name] += used * dt
         last_t = now
 
     admitted_keys: set = set()
@@ -183,10 +209,17 @@ def run(
     wall_s = time.perf_counter() - t_start
 
     cq_avg = {}
+    cq_backlogged = {}
     for name, integral in usage_integral.items():
         nominal = scenario.nominal_cpu[name]
         cq_avg[name] = (
             integral / (nominal * virtual_s) if virtual_s > 0 and nominal else 0.0
+        )
+        co_time = cohort_backlog_time.get(cohort_of.get(name), 0.0)
+        cq_backlogged[name] = (
+            backlog_integral[name] / (nominal * co_time)
+            if co_time > 0 and nominal
+            else 1.0  # never backlogged: the floor is vacuously met
         )
 
     return RunResult(
@@ -197,4 +230,6 @@ def run(
         cycles=cycles,
         time_to_admission=tta,
         cq_avg_utilization=cq_avg,
+        backlog_fraction=backlog_time / virtual_s if virtual_s > 0 else 0.0,
+        cq_backlogged_utilization=cq_backlogged,
     )
