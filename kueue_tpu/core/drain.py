@@ -265,6 +265,7 @@ def run_drain_preempt(
     max_cycles: Optional[int] = None,
     now: Optional[float] = None,
     search_width: int = 32,
+    mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
 ) -> PreemptDrainOutcome:
     """Multi-cycle drain WITH classic preemption — within-ClusterQueue
     and cross-CQ cohort reclamation — in one device dispatch + one
@@ -309,8 +310,9 @@ def run_drain_preempt(
     pdim, kdim, cdim = plan.queues_np["cells"].shape[2:]
     merged_cells = pdim * cdim  # the kernel's mcells width
 
+    from kueue_tpu.ops.drain_kernel import NO_BWC_THRESHOLD as NO_THR
+
     # ---- per-queue preemption policy flags ----
-    NO_THR = 1 << 60
     same_enabled = np.zeros(q, dtype=bool)
     same_prio_ok = np.zeros(q, dtype=bool)
     reclaim_enabled = np.zeros(q, dtype=bool)
@@ -603,43 +605,61 @@ def run_drain_preempt(
     if max_cycles is not None:
         plan.max_cycles = max_cycles
 
-    queues = DrainQueues(**{k: jnp.asarray(v) for k, v in plan.queues_np.items()})
-    victims = SegVictims(
-        scells=jnp.asarray(scells),
-        sqty=jnp.asarray(sqty),
-        sprio=jnp.asarray(sprio),
-        sts=jnp.asarray(sts),
-        svalid0=jnp.asarray(svalid0),
-        sowner=jnp.asarray(sowner),
-        sowner_local=jnp.asarray(sowner_local),
-        sslot_q=jnp.asarray(sslot_q),
-        sslot_l=jnp.asarray(sslot_l),
-        seg_nodes=jnp.asarray(seg_nodes),
-        lpaths=jnp.asarray(lpaths),
-        hlocal=jnp.asarray(hlocal),
-        perm=jnp.asarray(perm),
-        entry_slot=jnp.asarray(entry_slot),
-        same_enabled=jnp.asarray(same_enabled),
-        same_prio_ok=jnp.asarray(same_prio_ok),
-        reclaim_enabled=jnp.asarray(reclaim_enabled),
-        only_lower=jnp.asarray(only_lower),
-        bwc=jnp.asarray(bwc),
-        bwc_thr1=jnp.asarray(bwc_thr1),
+    queues_np = plan.queues_np
+    victims_np = dict(
+        scells=scells, sqty=sqty, sprio=sprio, sts=sts, svalid0=svalid0,
+        sowner=sowner, sowner_local=sowner_local, sslot_q=sslot_q,
+        sslot_l=sslot_l, seg_nodes=seg_nodes, lpaths=lpaths,
+        hlocal=hlocal, perm=perm, entry_slot=entry_slot,
+        same_enabled=same_enabled, same_prio_ok=same_prio_ok,
+        reclaim_enabled=reclaim_enabled, only_lower=only_lower, bwc=bwc,
+        bwc_thr1=bwc_thr1,
     )
+    if mesh is not None:
+        from kueue_tpu.parallel.sharded_solver import (
+            pad_queue_arrays,
+            pad_victim_arrays,
+            place_preempt_drain_inputs,
+        )
+
+        mult = mesh.shape["wl"]
+        queues_np = pad_queue_arrays(queues_np, mult)
+        victims_np = pad_victim_arrays(
+            victims_np, queues_np["qlen"].shape[0]
+        )
+        tree_in, usage_in, queues, victims, paths_in = (
+            place_preempt_drain_inputs(
+                mesh,
+                tree,
+                snapshot.local_usage,
+                DrainQueues(**queues_np),
+                SegVictims(**victims_np),
+                paths_j,
+            )
+        )
+    else:
+        tree_in, paths_in = tree, paths_j
+        usage_in = jnp.asarray(snapshot.local_usage)
+        queues = DrainQueues(
+            **{k: jnp.asarray(v) for k, v in queues_np.items()}
+        )
+        victims = SegVictims(
+            **{k: jnp.asarray(v) for k, v in victims_np.items()}
+        )
     flat = np.asarray(
         solve_drain_preempt_packed_jit(
-            tree,
-            jnp.asarray(snapshot.local_usage),
+            tree_in,
+            usage_in,
             queues,
             victims,
-            paths_j,
+            paths_in,
             n_segments=plan.n_segments,
             n_steps=plan.n_steps,
             max_cycles=plan.max_cycles,
             search_width=search_width,
         )
     )  # the single fetch
-    nq, nl2, npd = plan.queues_np["cells"].shape[:3]
+    nq, nl2, npd = queues_np["cells"].shape[:3]  # incl. mesh padding
     ql, sv, qlp = nq * nl2, s_dim * v_cap, nq * nl2 * npd
     off = 0
     status = flat[off : off + ql].reshape((nq, nl2)); off += ql
@@ -655,7 +675,7 @@ def run_drain_preempt(
     truncated = bool(
         np.any(
             (status == 0)
-            & (np.arange(nl2)[None, :] < qlen[:, None])
+            & (np.arange(nl2)[None, :] < queues_np["qlen"][:, None])
             & ~stuck_q[:, None]
         )
     )

@@ -466,10 +466,13 @@ def batched_fair_get_targets(
     snapshot: Snapshot,
     items: Sequence[Tuple[Workload, str, AssignmentResult]],
     preemptor,
+    mesh=None,
 ) -> List[List[PreemptionTarget]]:
     """Fair-sharing victim sets for every preempt-mode head in one
     device dispatch; per-head fallback to the host Preemptor where the
-    dense form doesn't apply. Parity: tests/test_fair_preempt.py."""
+    dense form doesn't apply. With ``mesh`` the head rows are sharded
+    along ``wl`` (each device runs a slice of the independent subtree
+    simulations). Parity: tests/test_fair_preempt.py."""
     from kueue_tpu._jax import jnp
     from kueue_tpu.core.preemption import (
         IN_CLUSTER_QUEUE,
@@ -490,8 +493,16 @@ def batched_fair_get_targets(
 
     w = arrays["row_valid"].shape[0]
     w_pad = _bucket(w, minimum=8)
+    if mesh is not None:
+        from kueue_tpu.parallel.sharded_solver import pad_w_multiple
+
+        w_pad = pad_w_multiple(w_pad, mesh.shape["wl"])
     arrays = _pad_rows(arrays, w_pad)
     problem = FairProblem(**{k: jnp.asarray(x) for k, x in arrays.items()})
+    if mesh is not None:
+        from kueue_tpu.parallel.sharded_solver import place_fair_problem
+
+        problem = place_fair_problem(mesh, problem)
     flat = np.asarray(
         solve_fair_packed_jit(
             problem,

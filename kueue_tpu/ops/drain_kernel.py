@@ -1404,6 +1404,18 @@ class SegVictims(NamedTuple):
     bwc_thr1: jnp.ndarray
 
 
+# SegVictims fields indexed per QUEUE (the [Q, ...] axis) — the mesh
+# path shards exactly these along ``wl`` and pads them with inert
+# queues; everything else is per-segment / topology, replicated.
+SEG_VICTIM_Q_FIELDS = (
+    "hlocal", "perm", "entry_slot", "same_enabled", "same_prio_ok",
+    "reclaim_enabled", "only_lower", "bwc", "bwc_thr1",
+)
+
+# bwc_thr1 sentinel meaning "no maxPriorityThreshold configured"
+NO_BWC_THRESHOLD = 1 << 60
+
+
 class PreemptDrainResult(NamedTuple):
     """status: int32[Q,L] final entry state (0 pending=never decided
     before max_cycles, 1 parked, 2 admitted); admitted_k / admitted_cycle

@@ -231,3 +231,72 @@ def place_drain_inputs(mesh, tree: QuotaTree, local_usage, queues, paths, victim
         }
     )
     return out + (jax.device_put(victims, v_specs),)
+
+
+from kueue_tpu.ops.drain_kernel import (  # noqa: E402
+    NO_BWC_THRESHOLD,
+    SEG_VICTIM_Q_FIELDS as _VICTIM_Q_FIELDS,
+)
+
+
+def pad_victim_arrays(victims_np: dict, q_target: int) -> dict:
+    """Pad SegVictims' per-queue arrays to the mesh-padded Q with inert
+    queues (identity perm, no entries, all policies off)."""
+    import numpy as np
+
+    q = victims_np["hlocal"].shape[0]
+    if q_target == q:
+        return victims_np
+    pad = q_target - q
+    out = dict(victims_np)
+    for name in _VICTIM_Q_FIELDS:
+        arr = victims_np[name]
+        if name == "perm":
+            block = np.tile(
+                np.arange(arr.shape[1], dtype=arr.dtype), (pad, 1)
+            )
+        elif name == "entry_slot":
+            block = np.full((pad,) + arr.shape[1:], -1, dtype=arr.dtype)
+        elif name == "bwc_thr1":
+            block = np.full((pad,), NO_BWC_THRESHOLD, dtype=arr.dtype)
+        else:
+            block = np.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)
+        out[name] = np.concatenate([arr, block])
+    return out
+
+
+def place_preempt_drain_inputs(mesh, tree, local_usage, queues, victims, paths):
+    """device_put for the preemption drain: per-queue tensors (queues +
+    SegVictims' per-queue config) sharded along ``wl``; quota tree,
+    paths and the per-segment candidate pools replicated (every shard's
+    queues search the same pools; pool-state updates are resolved by
+    GSPMD)."""
+    tree_d, local_d, queues_d, paths_d = place_drain_inputs(
+        mesh, tree, local_usage, queues, paths
+    )
+    v_specs = type(victims)(
+        **{
+            name: (
+                _sh(mesh, "wl", *([None] * (getattr(victims, name).ndim - 1)))
+                if name in _VICTIM_Q_FIELDS
+                else _sh(mesh, *([None] * getattr(victims, name).ndim))
+            )
+            for name in victims._fields
+        }
+    )
+    return tree_d, local_d, queues_d, jax.device_put(victims, v_specs), paths_d
+
+
+def place_fair_problem(mesh, problem):
+    """device_put a FairProblem with every head row sharded along
+    ``wl`` — the fair tournament search is embarrassingly parallel over
+    heads (one local-subtree simulation each)."""
+    specs = type(problem)(
+        **{
+            name: _sh(
+                mesh, "wl", *([None] * (getattr(problem, name).ndim - 1))
+            )
+            for name in problem._fields
+        }
+    )
+    return jax.device_put(problem, specs)

@@ -108,3 +108,63 @@ def test_sharded_dispatch_lowered_matches_unsharded():
     np.testing.assert_array_equal(plain.chosen, sharded.chosen)
     np.testing.assert_array_equal(plain.admitted, sharded.admitted)
     np.testing.assert_array_equal(plain.reserved, sharded.reserved)
+
+
+def test_sharded_preempt_drain_matches_unsharded():
+    """run_drain_preempt with a mesh (queues + per-queue victim config
+    sharded along wl, segment pools replicated) must decide identically
+    to the unsharded dispatch — cohort reclaim included."""
+    from kueue_tpu.core.queue_manager import queue_order_timestamp
+    from kueue_tpu.core.snapshot import take_snapshot
+    from kueue_tpu.parallel import make_mesh
+
+    from tests.test_drain import build_preempt_env, cohort_reclaim_spec
+
+    spec = cohort_reclaim_spec(2)
+    outcomes = {}
+    for label, mesh in (("plain", None), ("mesh", make_mesh(8))):
+        from kueue_tpu.core.drain import run_drain_preempt
+
+        sched, mgr, cache, _ = build_preempt_env(spec)
+        pending = []
+        for cq_name, pq in mgr.cluster_queues.items():
+            for wl in pq.snapshot_sorted():
+                pending.append((wl, cq_name))
+        out = run_drain_preempt(
+            take_snapshot(cache), pending, cache.flavors,
+            timestamp_fn=lambda wl: queue_order_timestamp(wl, mgr._ts_policy),
+            mesh=mesh,
+        )
+        outcomes[label] = (
+            {(wl.name, cyc) for wl, _, _, cyc in out.admitted},
+            {wl.name for wl, _, _ in out.preempted},
+            {wl.name for wl, _ in out.parked},
+        )
+    assert outcomes["plain"] == outcomes["mesh"]
+
+
+def test_sharded_fair_search_matches_unsharded():
+    """batched_fair_get_targets with a mesh (FairProblem rows sharded
+    along wl) must return the same victim sets."""
+    import pytest
+
+    from kueue_tpu.core.preempt_batch import batched_fair_get_targets
+    from kueue_tpu.core.preemption import Preemptor
+    from kueue_tpu.parallel import make_mesh
+    from kueue_tpu.utils.clock import FakeClock
+
+    from tests.test_fair_preempt import build_fair_cluster, fair_items
+
+    cache, cq_names = build_fair_cluster(3)
+    snapshot, items = fair_items(cache, cq_names, 3)
+    if not items:
+        pytest.skip("no preempt-mode heads generated")
+    preemptor = Preemptor(FakeClock(0.0), enable_fair_sharing=True)
+    plain = batched_fair_get_targets(snapshot, items, preemptor)
+    sharded = batched_fair_get_targets(
+        snapshot, items, preemptor, mesh=make_mesh(8)
+    )
+    names = lambda rs: [  # noqa: E731
+        sorted(t.workload.workload.name for t in r) for r in rs
+    ]
+    assert names(plain) == names(sharded)
