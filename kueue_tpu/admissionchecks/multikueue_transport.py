@@ -102,10 +102,12 @@ class HTTPTransport(RemoteTransport):
     state machine drives reconnects exactly like the in-process fakes.
     """
 
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    def __init__(self, base_url: str, timeout: float = 10.0, token=None):
         from kueue_tpu.server import KueueClient
 
-        self.client = KueueClient(base_url, timeout=timeout)
+        # token: bearer credential for workers started with
+        # --auth-token (the kubeconfig credential analog)
+        self.client = KueueClient(base_url, timeout=timeout, token=token)
 
     def _wrap(self, fn, *args):
         import urllib.error

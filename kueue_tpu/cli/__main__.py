@@ -280,7 +280,7 @@ def cmd_delete(state: State, args) -> None:
     if getattr(args, "server", None):
         from kueue_tpu.server import KueueClient
 
-        client = KueueClient(args.server)
+        client = KueueClient(args.server, token=args.token)
         if args.kind == "workload":
             client.delete_workload(ns, args.name)
         elif args.kind == "clusterqueue":
@@ -306,7 +306,7 @@ def cmd_get(state: State, args) -> None:
     if getattr(args, "server", None):
         from kueue_tpu.server import KueueClient
 
-        client = KueueClient(args.server)
+        client = KueueClient(args.server, token=args.token)
         if args.kind == "workload":
             obj = client.get_workload(ns, args.name)
         else:
@@ -330,7 +330,7 @@ def cmd_pending_workloads(state: State, args) -> None:
         # kubectl plugin hitting the visibility apiserver)
         from kueue_tpu.server import KueueClient
 
-        summary = KueueClient(args.server).pending_workloads_cq(args.clusterqueue)
+        summary = KueueClient(args.server, token=args.token).pending_workloads_cq(args.clusterqueue)
         rows = [
             [str(i["positionInClusterQueue"]), i["namespace"], i["name"],
              i["localQueueName"], str(i["priority"])]
@@ -518,6 +518,10 @@ def build_parser() -> argparse.ArgumentParser:
     dele.add_argument(
         "--server", help="delete on a running kueue_tpu.server instead of --state"
     )
+    dele.add_argument(
+        "--token", default=os.environ.get("KUEUE_AUTH_TOKEN") or None,
+        help="bearer token for a secured server (default: $KUEUE_AUTH_TOKEN)",
+    )
     dele.set_defaults(fn=cmd_delete)
 
     get = sub.add_parser("get")
@@ -526,6 +530,10 @@ def build_parser() -> argparse.ArgumentParser:
     get.add_argument("-n", "--namespace", default="default")
     get.add_argument(
         "--server", help="read from a running kueue_tpu.server instead of --state"
+    )
+    get.add_argument(
+        "--token", default=os.environ.get("KUEUE_AUTH_TOKEN") or None,
+        help="bearer token for a secured server (default: $KUEUE_AUTH_TOKEN)",
     )
     get.set_defaults(fn=cmd_get)
 
@@ -536,6 +544,10 @@ def build_parser() -> argparse.ArgumentParser:
     pw.add_argument("clusterqueue")
     pw.add_argument(
         "--server", help="query a running kueue_tpu.server instead of --state"
+    )
+    pw.add_argument(
+        "--token", default=os.environ.get("KUEUE_AUTH_TOKEN") or None,
+        help="bearer token for a secured server (default: $KUEUE_AUTH_TOKEN)",
     )
     pw.set_defaults(fn=cmd_pending_workloads)
 

@@ -140,6 +140,13 @@ def main(argv=None) -> int:
         help="seconds between periodic --state checkpoints while "
         "leading (bounds data loss on SIGKILL; 0 disables)",
     )
+    parser.add_argument(
+        "--auth-token",
+        default=os.environ.get("KUEUE_AUTH_TOKEN") or None,
+        help="bearer token gating mutating routes, metrics, state and "
+        "debug (the secured-endpoint analog of cmd/kueue/main.go "
+        "authn/z; default: $KUEUE_AUTH_TOKEN, unset = open)",
+    )
     args = parser.parse_args(argv)
 
     from kueue_tpu import serialization as ser
@@ -230,6 +237,7 @@ def main(argv=None) -> int:
         port=args.port,
         auto_reconcile=not args.no_auto_reconcile,
         elector=elector,
+        auth_token=args.auth_token,
     )
     port = srv.start()
     ha["boot"] = False  # any later promotion is a real takeover

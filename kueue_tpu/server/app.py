@@ -216,6 +216,7 @@ class KueueServer:
         auto_reconcile: bool = True,
         validators: Optional[list] = None,
         elector=None,  # utils.lease.LeaderElector: HA replica mode
+        auth_token: Optional[str] = None,
     ):
         if runtime is None:
             from kueue_tpu.controllers import ClusterRuntime
@@ -241,6 +242,13 @@ class KueueServer:
         # standbys keep serving reads (visibility, metrics, dashboard,
         # stateless solves) and take over when the lease lapses.
         self.elector = elector
+        # Bearer-token authentication for the secured surface: mutating
+        # routes, metrics, state and debug (the reference serves metrics
+        # behind authn/z and its write paths through the authenticated
+        # apiserver — cmd/kueue/main.go:154-179). None = open (dev mode,
+        # in-cluster behind a NetworkPolicy). Probes, visibility and the
+        # dashboard stay open either way.
+        self.auth_token = auth_token
         self._election_stop = threading.Event()
         self._election_thread: Optional[threading.Thread] = None
         # checkpoint ordering (used by __main__.fenced_checkpoint): a
@@ -443,6 +451,14 @@ class KueueServer:
         return self._httpd.server_address[1] if self._httpd else self._port
 
 
+# route names gated by KueueServer.auth_token (when configured)
+_SECURED_ROUTES = frozenset(
+    {
+        "apply", "apply_batch", "delete", "delete_ns", "check_state",
+        "reconcile", "solve", "metrics", "state", "debug_cycles",
+    }
+)
+
 _ROUTES: List[Tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/healthz$"), "healthz"),
     ("GET", re.compile(r"^/readyz$"), "readyz"),
@@ -518,6 +534,7 @@ def _make_handler(srv: KueueServer):
                 match = pat.match(parsed.path)
                 if match:
                     try:
+                        self._check_auth(name)
                         getattr(self, f"_h_{name}")(*match.groups(), **{"query": query})
                     except ApiError as e:
                         self._send_json({"error": e.message}, status=e.status)
@@ -536,6 +553,23 @@ def _make_handler(srv: KueueServer):
             self._dispatch("DELETE")
 
         # ---- helpers ----
+        def _check_auth(self, route_name: str) -> None:
+            if srv.auth_token is None or route_name not in _SECURED_ROUTES:
+                return
+            import hmac
+
+            header = self.headers.get("Authorization", "")
+            expect = f"Bearer {srv.auth_token}"
+            if not hmac.compare_digest(header.encode(), expect.encode()):
+                # the rejected request's body was never read: drain it
+                # (and drop the connection) so a keep-alive client's
+                # next request is not parsed out of the stale bytes
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+                self.close_connection = True
+                raise ApiError(401, "missing or invalid bearer token")
+
         def _body(self) -> dict:
             length = int(self.headers.get("Content-Length", 0))
             if length == 0:
@@ -551,6 +585,10 @@ def _make_handler(srv: KueueServer):
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(payload)))
+            if self.close_connection:
+                # tell keep-alive clients not to reuse the connection
+                # (set by the auth rejection path)
+                self.send_header("Connection", "close")
             self.end_headers()
             self.wfile.write(payload)
 

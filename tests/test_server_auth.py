@@ -1,0 +1,74 @@
+"""Bearer-token authentication on the secured service surface
+(ref: cmd/kueue/main.go:154-179 — metrics behind authn/z, writes via
+the authenticated apiserver). Probes, visibility and the dashboard
+stay open."""
+
+import pytest
+
+from kueue_tpu import serialization as ser
+from kueue_tpu.models import ResourceFlavor
+from kueue_tpu.server import KueueClient, KueueServer
+from kueue_tpu.server.client import ClientError
+
+TOKEN = "s3cret-token"
+
+
+@pytest.fixture()
+def server():
+    srv = KueueServer(auth_token=TOKEN)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+class TestBearerAuth:
+    def test_unauthenticated_writes_rejected(self, server):
+        anon = KueueClient(f"http://127.0.0.1:{server.port}")
+        with pytest.raises(ClientError) as e:
+            anon.apply(
+                "resourceflavors",
+                ser.flavor_to_dict(ResourceFlavor(name="default")),
+            )
+        assert e.value.status == 401
+
+    def test_wrong_token_rejected(self, server):
+        bad = KueueClient(f"http://127.0.0.1:{server.port}", token="nope")
+        with pytest.raises(ClientError) as e:
+            bad.reconcile()
+        assert e.value.status == 401
+
+    def test_metrics_and_state_secured(self, server):
+        anon = KueueClient(f"http://127.0.0.1:{server.port}")
+        for call in (anon.metrics_text, anon.state):
+            with pytest.raises(ClientError) as e:
+                call()
+            assert e.value.status == 401
+
+    def test_probes_and_reads_stay_open(self, server):
+        anon = KueueClient(f"http://127.0.0.1:{server.port}")
+        assert anon.healthz()["status"] == "ok"
+        assert anon.list("workloads") == []
+        assert "clusterQueues" in anon.dashboard()
+
+    def test_token_grants_full_surface(self, server):
+        c = KueueClient(f"http://127.0.0.1:{server.port}", token=TOKEN)
+        c.apply(
+            "resourceflavors",
+            ser.flavor_to_dict(ResourceFlavor(name="default")),
+        )
+        assert "kueue_admission_attempts_total" in c.metrics_text()
+        c.reconcile()
+        assert isinstance(c.state(), dict)
+
+    def test_no_token_server_stays_open(self):
+        srv = KueueServer()
+        srv.start()
+        try:
+            anon = KueueClient(f"http://127.0.0.1:{srv.port}")
+            anon.apply(
+                "resourceflavors",
+                ser.flavor_to_dict(ResourceFlavor(name="default")),
+            )
+            anon.metrics_text()
+        finally:
+            srv.stop()

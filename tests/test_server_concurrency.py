@@ -1,0 +1,252 @@
+"""Concurrency stress for the threaded service surface — the -race
+posture (SURVEY §4): N writer threads racing /apply and admission-check
+flips against M reader threads (visibility, metrics, dashboard, state)
+and a continuous /reconcile loop, then invariant checks: no double
+admission, cached usage equals the sum of admitted workloads' requests,
+and the dashboard/metrics stayed serveable throughout. Also run against
+the HA pair (leader + read-only standby)."""
+
+import threading
+
+import pytest
+
+from kueue_tpu import serialization as ser
+from kueue_tpu.models import ClusterQueue, LocalQueue, ResourceFlavor, Workload
+from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.server import KueueClient, KueueServer
+from kueue_tpu.server.client import ClientError
+
+N_CQ = 4
+N_WRITERS = 4
+N_READERS = 3
+WL_PER_WRITER = 25
+
+
+def _seed(client):
+    client.apply(
+        "resourceflavors", ser.flavor_to_dict(ResourceFlavor(name="default"))
+    )
+    client.apply(
+        "admissionchecks", {"name": "prov", "controllerName": "test-ctl"}
+    )
+    for i in range(N_CQ):
+        cq = ClusterQueue(
+            name=f"cq-{i}",
+            cohort="co",
+            namespace_selector={},
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu",),
+                    (FlavorQuotas.build("default", {"cpu": "20"}),),
+                ),
+            ),
+        )
+        cq_d = ser.cq_to_dict(cq)
+        if i == 0:  # one CQ gates phase 2 behind an admission check
+            cq_d["admissionChecks"] = ["prov"]
+        client.apply("clusterqueues", cq_d)
+        client.apply(
+            "localqueues",
+            ser.lq_to_dict(
+                LocalQueue(
+                    namespace="ns", name=f"lq-{i}", cluster_queue=f"cq-{i}"
+                )
+            ),
+        )
+
+
+def _wl_dict(name, queue, cpu, priority):
+    wl = Workload(
+        namespace="ns",
+        name=name,
+        queue_name=queue,
+        priority=priority,
+        pod_sets=(PodSet.build("main", 1, {"cpu": str(cpu)}),),
+    )
+    return ser.workload_to_dict(wl)
+
+
+def _storm(base_url, errors):
+    """Writers + readers + a reconcile loop against one server."""
+    stop = threading.Event()
+
+    def writer(wi):
+        try:
+            c = KueueClient(base_url)
+            for j in range(WL_PER_WRITER):
+                c.apply(
+                    "workloads",
+                    _wl_dict(
+                        f"w-{wi}-{j}", f"lq-{(wi + j) % N_CQ}",
+                        cpu=1 + (j % 3), priority=(j % 4) * 10,
+                    ),
+                )
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(f"writer {wi}: {e!r}")
+
+    def reader(ri):
+        try:
+            c = KueueClient(base_url)
+            while not stop.is_set():
+                c.metrics_text()
+                c.dashboard()
+                try:
+                    c.pending_workloads_cq("cq-0")
+                except ClientError:
+                    pass  # CQ may not be applied yet on a standby
+                c.state()
+        except Exception as e:  # pragma: no cover
+            errors.append(f"reader {ri}: {e!r}")
+
+    def reconciler():
+        try:
+            c = KueueClient(base_url)
+            while not stop.is_set():
+                c.reconcile()
+        except Exception as e:  # pragma: no cover
+            errors.append(f"reconciler: {e!r}")
+
+    def check_flipper():
+        # races phase-2 check flips against admissions: cq-0's
+        # workloads gate on check "prov"; flip whatever is reserved
+        try:
+            c = KueueClient(base_url)
+            while not stop.is_set():
+                for w in c.state().get("workloads", []):
+                    adm = w.get("admission") or {}
+                    if adm.get("clusterQueue") == "cq-0":
+                        try:
+                            c.set_admission_check_state(
+                                w["namespace"], w["name"], "prov", "Ready"
+                            )
+                        except ClientError:
+                            pass  # raced a finish/eviction
+        except Exception as e:  # pragma: no cover
+            errors.append(f"check flipper: {e!r}")
+
+    writers = [
+        threading.Thread(target=writer, args=(i,)) for i in range(N_WRITERS)
+    ]
+    readers = [
+        threading.Thread(target=reader, args=(i,)) for i in range(N_READERS)
+    ]
+    rec = threading.Thread(target=reconciler)
+    flip = threading.Thread(target=check_flipper)
+    for t in writers + readers + [rec, flip]:
+        t.start()
+    for t in writers:
+        t.join(timeout=120)
+    stop.set()
+    for t in readers + [rec, flip]:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in writers + readers + [rec, flip])
+
+
+def _assert_invariants(server):
+    """No double admission; cached usage == sum of admitted requests."""
+    rt = server.runtime
+    with server.lock:
+        seen = set()
+        per_cq_cpu = {f"cq-{i}": 0 for i in range(N_CQ)}
+        n_admitted = 0
+        all_wls = [
+            wl
+            for cached in rt.cache.cluster_queues.values()
+            for wl in cached.workloads.values()
+        ]
+        for wl in all_wls:
+            if wl.admission is None:
+                continue
+            assert wl.key not in seen, f"double admission of {wl.key}"
+            seen.add(wl.key)
+            n_admitted += 1
+            cq = wl.admission.cluster_queue
+            for psa in wl.admission.pod_set_assignments:
+                ps = next(p for p in wl.pod_sets if p.name == psa.name)
+                per_cq_cpu[cq] += ps.requests["cpu"] * ps.count
+        from kueue_tpu.resources import FlavorResource
+
+        total_used = 0
+        for name, expect in per_cq_cpu.items():
+            usage = rt.cache.usage_for(name)
+            got = usage.get(FlavorResource("default", "cpu"), 0)
+            assert got == expect, (
+                f"{name}: cached usage {got} != admitted sum {expect}"
+            )
+            total_used += got
+        # individual CQs may borrow within the cohort, but the cohort's
+        # total capacity is inviolable
+        assert total_used <= 20_000 * N_CQ, (
+            f"cohort over-admitted: {total_used} > {20_000 * N_CQ}"
+        )
+        assert n_admitted > 0, "storm admitted nothing"
+
+
+class TestConcurrentServer:
+    def test_storm_keeps_invariants(self):
+        srv = KueueServer()
+        srv.start()
+        try:
+            client = KueueClient(f"http://127.0.0.1:{srv.port}")
+            _seed(client)
+            errors: list = []
+            _storm(f"http://127.0.0.1:{srv.port}", errors)
+            assert errors == []
+            client.reconcile()
+            _assert_invariants(srv)
+            # every applied workload is accounted for: admitted or pending
+            total = len(client.list("workloads"))
+            assert total == N_WRITERS * WL_PER_WRITER
+        finally:
+            srv.stop()
+
+
+class TestConcurrentHAPair:
+    def test_storm_against_leader_with_standby_reads(self, tmp_path):
+        # leader + standby sharing a lease file: writers hit the leader,
+        # readers hammer BOTH (standbys serve reads); invariants hold on
+        # the leader afterwards
+        import time
+
+        from kueue_tpu.utils.lease import FileLease, LeaderElector
+
+        lease = str(tmp_path / "leader.lease")
+        leader = KueueServer(
+            elector=LeaderElector(FileLease(lease, "rep-1", duration=15.0))
+        )
+        leader.start()
+        deadline = time.time() + 10
+        while not leader.elector.is_leader and time.time() < deadline:
+            time.sleep(0.05)
+        assert leader.elector.is_leader
+        standby = KueueServer(
+            elector=LeaderElector(FileLease(lease, "rep-2", duration=15.0))
+        )
+        standby.start()
+        try:
+            lc = KueueClient(f"http://127.0.0.1:{leader.port}")
+            _seed(lc)
+            errors: list = []
+            stop = threading.Event()
+
+            def standby_reader():
+                try:
+                    c = KueueClient(f"http://127.0.0.1:{standby.port}")
+                    while not stop.is_set():
+                        c.metrics_text()
+                        c.healthz()
+                except Exception as e:  # pragma: no cover
+                    errors.append(f"standby reader: {e!r}")
+
+            t = threading.Thread(target=standby_reader)
+            t.start()
+            _storm(f"http://127.0.0.1:{leader.port}", errors)
+            stop.set()
+            t.join(timeout=30)
+            assert errors == []
+            lc.reconcile()
+            _assert_invariants(leader)
+        finally:
+            standby.stop()
+            leader.stop()
