@@ -2285,3 +2285,111 @@ class TestSchedulerResourceValidation:
             and "exceed" in e.message
             for e in rt.events
         )
+
+
+def run_preemption_drain(admitted, incoming_reqs, target_cq, prio=0,
+                         creation=NOW):
+    """The DRAIN twin of run_preemption: the incoming head goes through
+    run_drain_preempt against the same fixture cluster, and the evicted
+    set is the truth-table victim set (the drain's per-cycle semantics
+    must reproduce the reference preemption tables end to end — victim
+    classification here is the kernel's own, not a forced assignment)."""
+    from kueue_tpu.core.drain import run_drain_preempt
+    from kueue_tpu.core.queue_manager import QueueManager, queue_order_timestamp
+    from kueue_tpu.models import LocalQueue
+
+    cache = preempt_env(admitted)
+    mgr = QueueManager(FakeClock(start=NOW + 100))
+    for cq in fixture_cqs():
+        mgr.add_cluster_queue(cq)
+        mgr.add_local_queue(
+            LocalQueue(namespace="ns", name=f"lq-{cq.name}", cluster_queue=cq.name)
+        )
+    wl = Workload(
+        namespace="ns", name="in", queue_name=f"lq-{target_cq}",
+        priority=prio, creation_time=creation,
+        pod_sets=(PodSet.build("main", 1, incoming_reqs),),
+    )
+    mgr.add_or_update_workload(wl)
+    pending = [
+        (w, cq_name)
+        for cq_name, pq in mgr.cluster_queues.items()
+        for w in pq.snapshot_sorted()
+    ]
+    outcome = run_drain_preempt(
+        take_snapshot(cache), pending, cache.flavors,
+        timestamp_fn=lambda w: queue_order_timestamp(w, mgr._ts_policy),
+    )
+    assert not outcome.fallback
+    admitted = {w.name for w, _, _, _ in outcome.admitted}
+    return {w.name for w, _, _ in outcome.preempted}, admitted
+
+
+class TestPreemptionDrainParity:
+    """The same preemption_test.go tables, decided by the device DRAIN
+    (ops/drain_kernel.solve_drain_preempt) instead of the host
+    Preemptor — victim sets must match the reference expectations."""
+
+    def test_preempt_lowest_priority(self):  # :289
+        got, admitted = run_preemption_drain(
+            [("low", "standalone", {"cpu": "2"}, {"cpu": "default"}, -1, NOW),
+             ("mid", "standalone", {"cpu": "2"}, {"cpu": "default"}, 0, NOW),
+             ("high", "standalone", {"cpu": "2"}, {"cpu": "default"}, 1, NOW)],
+            {"cpu": "2"}, "standalone", prio=1)
+        assert got == {"low"}
+        assert "in" in admitted
+
+    def test_preempt_multiple(self):  # :329
+        got, admitted = run_preemption_drain(
+            [("low", "standalone", {"cpu": "2"}, {"cpu": "default"}, -1, NOW),
+             ("mid", "standalone", {"cpu": "2"}, {"cpu": "default"}, 0, NOW),
+             ("high", "standalone", {"cpu": "2"}, {"cpu": "default"}, 1, NOW)],
+            {"cpu": "3"}, "standalone", prio=1)
+        assert got == {"low", "mid"}
+        assert "in" in admitted
+
+    def test_no_preemption_for_low_priority(self):  # :370
+        got, admitted = run_preemption_drain(
+            [("low", "standalone", {"cpu": "3"}, {"cpu": "default"}, -1, NOW),
+             ("mid", "standalone", {"cpu": "3"}, {"cpu": "default"}, 0, NOW)],
+            {"cpu": "1"}, "standalone", prio=-1)
+        assert got == set()
+        assert "in" not in admitted  # parks: nobody to preempt
+
+    def test_minimal_set_excludes_low_priority(self):  # :471
+        got, admitted = run_preemption_drain(
+            [("low", "standalone", {"cpu": "1"}, {"cpu": "default"}, -1, NOW),
+             ("mid", "standalone", {"cpu": "2"}, {"cpu": "default"}, 0, NOW),
+             ("high", "standalone", {"cpu": "3"}, {"cpu": "default"}, 1, NOW)],
+            {"cpu": "2"}, "standalone", prio=1)
+        assert got == {"mid"}
+        assert "in" in admitted
+
+    def test_reclaim_quota_from_borrower(self):  # :556
+        got, admitted = run_preemption_drain(
+            [("c1-low", "c1", {"cpu": "3"}, {"cpu": "default"}, -1, NOW),
+             ("c2-mid", "c2", {"cpu": "3"}, {"cpu": "default"}, 0, NOW),
+             ("c2-high", "c2", {"cpu": "6"}, {"cpu": "default"}, 1, NOW)],
+            {"cpu": "3"}, "c1", prio=1)
+        assert got == {"c2-mid"}
+        assert "in" in admitted
+
+    def test_no_workloads_borrowing(self):  # :633
+        got, admitted = run_preemption_drain(
+            [("c1-high", "c1", {"cpu": "4"}, {"cpu": "default"}, 1, NOW),
+             ("c2-low-1", "c2", {"cpu": "4"}, {"cpu": "default"}, -1, NOW)],
+            {"cpu": "4"}, "c1", prio=1)
+        assert got == set()
+        # nothing to reclaim, but the cohort still has free capacity:
+        # the head admits by borrowing (preemption_test.go:633 runs the
+        # search in isolation; the drain runs the full cycle)
+        assert "in" in admitted
+
+    def test_no_reclaim_same_priority_for_lower_priority_policy(self):  # :920
+        got, admitted = run_preemption_drain(
+            [("c1", "c1", {"cpu": "2"}, {"cpu": "default"}, 0, NOW),
+             ("c2-1", "c2", {"cpu": "4"}, {"cpu": "default"}, 0, NOW),
+             ("c2-2", "c2", {"cpu": "4"}, {"cpu": "default"}, 0, NOW)],
+            {"cpu": "4"}, "c1", prio=0)
+        assert got == set()
+        assert "in" not in admitted  # parks: same-prio, LowerPriority policy
