@@ -55,6 +55,7 @@ class ClusterRuntime:
         use_preempt_solver: Optional[bool] = None,
         preempt_solver_threshold: int = 4,
         resources=None,  # config.ResourceSettings (quota-view transform)
+        bulk_drain_threshold: Optional[int] = 256,
     ):
         from kueue_tpu.metrics import Metrics
 
@@ -134,6 +135,17 @@ class ClusterRuntime:
         self.admission_check_controllers: List[Callable[[Workload], None]] = []
         # QueueVisibility (deprecated, gated): cq -> top pending heads
         self.cq_pending_snapshots: Dict[str, List[dict]] = {}
+        # Bulk path: backlogs at/above this head count route through the
+        # single-dispatch device drains instead of one-head-per-CQ
+        # cycles (None disables). Auto-gated like the cycle path: a
+        # windowed-min estimate of the drain cost per head (erodes on
+        # skipped opportunities so a compile-heavy first sample re-probes
+        # instead of disabling the path forever) must beat the host
+        # nomination estimate.
+        from kueue_tpu.core.scheduler import _LatencyEstimate
+
+        self.bulk_drain_threshold = bulk_drain_threshold
+        self._drain_est = _LatencyEstimate()
 
     def _make_preemptor(self, fair_sharing: bool):
         from kueue_tpu.core.preemption import Preemptor
@@ -660,14 +672,264 @@ class ClusterRuntime:
         return result
 
     def run_until_idle(self, max_iterations: int = 50) -> int:
-        """Reconcile + schedule until nothing changes. Returns cycles."""
+        """Reconcile + schedule until nothing changes. Returns cycles.
+
+        Bulk backlogs are shaped as single-dispatch device drains: when
+        the pending count clears ``bulk_drain_threshold``, one
+        ``bulk_drain`` call replaces that iteration's cycle (the
+        reference's scheduler-as-the-service, scheduler.go:143-154, at
+        drain granularity); leftovers — fallback heads, reactivated
+        parked entries below threshold — run through the normal cycle.
+        """
         cycles = 0
         for _ in range(max_iterations):
             before = self._state_fingerprint()
             self.reconcile_once()
-            self.schedule_once()
+            if self.bulk_drain() is None:
+                self.schedule_once()
             self.reconcile_once()
             cycles += 1
             if self._state_fingerprint() == before:
                 break
         return cycles
+
+    # ---- the bulk path: device drains as the service (north star) ----
+    def bulk_drain(self):
+        """Decide the whole pending backlog in ONE device dispatch
+        (core/drain.run_drain / run_drain_preempt) and apply the
+        outcome through the same admission/eviction machinery the cycle
+        loop uses. Returns the CycleResult, or None when the backlog is
+        below threshold / the drain is gated off."""
+        import time as _time
+
+        from kueue_tpu.core.drain import run_drain, run_drain_preempt
+        from kueue_tpu.core.queue_manager import queue_order_timestamp
+        from kueue_tpu.core.scheduler import CycleTrace
+        from kueue_tpu.core.snapshot import take_snapshot
+        from kueue_tpu.models.constants import (
+            PreemptionPolicy,
+            ReclaimWithinCohortPolicy,
+        )
+
+        sched = self.scheduler
+        if self.bulk_drain_threshold is None or sched.use_solver is False:
+            return None
+        if sched.wait_for_pods_ready_block and self.cache.workloads_not_ready:
+            return None  # the cycle loop enforces the PodsReady block
+        live = [
+            pq
+            for pq in self.queues.cluster_queues.values()
+            if pq.active and pq.pending_active() > 0
+        ]
+        total = sum(pq.pending_active() for pq in live)
+        # depth gate: a shallow-but-wide backlog (every CQ ~1 deep)
+        # drains in a couple of ordinary cycles anyway
+        if total < self.bulk_drain_threshold or total < 2 * len(live):
+            return None
+
+        t0 = _time.perf_counter()
+        snapshot = take_snapshot(self.cache)
+        backlog: List[Workload] = []
+        for name in sorted(self.queues.cluster_queues):
+            pq = self.queues.cluster_queues[name]
+            if pq.active:
+                backlog.extend(pq.snapshot_active_sorted())
+        _, to_assign = sched._prevalidate(backlog, snapshot)
+        tas_flavors = set()
+        if self.cache.tas_cache is not None:
+            tas_flavors = set(self.cache.tas_cache.flavors)
+
+        def _drainable(e) -> bool:
+            # partial admission decides at reduced counts and TAS
+            # flavors need placement state — both stay with the host
+            # cycle loop (the drain kernel has no twin for either here)
+            if sched.partial_admission and any(
+                ps.min_count is not None for ps in e.workload.pod_sets
+            ):
+                return False
+            if tas_flavors:
+                cq = snapshot.cq_models.get(e.cq_name)
+                if cq is not None and any(
+                    fq.name in tas_flavors
+                    for rg in cq.resource_groups
+                    for fq in rg.flavors
+                ):
+                    return False
+            return True
+
+        pending = [
+            (e.workload, e.cq_name) for e in to_assign if _drainable(e)
+        ]
+        if len(pending) < self.bulk_drain_threshold:
+            return None
+        # latency gate, same machinery as the cycle path: probe once,
+        # then require the measured drain cost/head (plan + dispatch,
+        # windowed min) to beat the host nomination estimate; erode on
+        # skip so a compile-heavy probe re-probes instead of latching
+        # the path off
+        host_est = sched._host_assign_ema or sched._HOST_ASSIGN_DEFAULT
+        drain_est = self._drain_est.value
+        if drain_est is not None and drain_est > host_est:
+            self._drain_est.erode()
+            return None
+
+        ts_fn = lambda wl: queue_order_timestamp(  # noqa: E731
+            wl, self.queues._ts_policy
+        )
+
+        def _preempt_capable(cq_name: str) -> bool:
+            cq = snapshot.cq_models.get(cq_name)
+            if cq is None:
+                return False
+            prem = cq.preemption
+            return prem.within_cluster_queue != PreemptionPolicy.NEVER or (
+                snapshot.has_cohort(cq_name)
+                and prem.reclaim_within_cohort
+                != ReclaimWithinCohortPolicy.NEVER
+            )
+
+        if sched.fair_sharing:
+            outcome = run_drain(
+                snapshot, pending, self.cache.flavors, timestamp_fn=ts_fn,
+                fair_sharing=True,
+            )
+        elif any(_preempt_capable(c) for c in {c for _, c in pending}):
+            outcome = run_drain_preempt(
+                snapshot, pending, self.cache.flavors, timestamp_fn=ts_fn
+            )
+        else:
+            outcome = run_drain(
+                snapshot, pending, self.cache.flavors, timestamp_fn=ts_fn
+            )
+        # plan+dispatch cost only — the apply below is per-admission
+        # bookkeeping both paths pay
+        self._drain_est.observe(
+            (_time.perf_counter() - t0) / max(len(pending), 1)
+        )
+        result = self._apply_drain_outcome(outcome, snapshot)
+        dt = _time.perf_counter() - t0
+        sched.scheduling_cycle += 1
+        trace = CycleTrace(
+            cycle=sched.scheduling_cycle,
+            heads=len(pending),
+            admitted=len(result.admitted),
+            preempting=len(result.preempting),
+            resolution="drain",
+            total_s=dt,
+            spans={"drain": dt},
+        )
+        sched.last_traces.append(trace)
+        self._report_cycle_metrics(result, dt)
+        sched.notify_cycle(result)
+        return result
+
+    def _apply_drain_outcome(self, outcome, snapshot):
+        """Apply a DrainOutcome in kernel cycle order: evictions before
+        the admissions that depend on them, the same interleaving the
+        sequential cycle loop would produce (compressed to one pass).
+        Fallback heads stay in the heap for the cycle loop."""
+        from kueue_tpu.core.scheduler import (
+            CycleResult,
+            Entry,
+            EntryStatus,
+        )
+        from kueue_tpu.models.constants import WorkloadConditionType as WCT
+
+        result = CycleResult(resolution="drain")
+        events: List[tuple] = []
+        for ev in getattr(outcome, "evictions", []) or []:
+            events.append((ev.cycle, 0, ev))
+        for adm in outcome.admitted:
+            events.append((adm[3], 1, adm))
+        events.sort(key=lambda t: (t[0], t[1]))
+        preempting_entries: Dict[str, Entry] = {}
+        for _, kind, payload in events:
+            if kind == 0:
+                self._apply_drain_eviction(
+                    payload, preempting_entries, result
+                )
+                continue
+            wl, cq_name, fmap, _cyc = payload
+            first = next(iter(fmap.values()), None)
+            psmap = (
+                fmap
+                if isinstance(first, dict)
+                else {wl.pod_sets[0].name: fmap}
+            )
+            admission = self._drain_admission(wl, cq_name, psmap)
+            ok, _msg = self.scheduler.admit_prepared(
+                wl, cq_name, admission, snapshot.cq_models[cq_name]
+            )
+            if ok:
+                self.queues.remove_from_pending(wl)
+                result.admitted.append(
+                    Entry(
+                        workload=wl, cq_name=cq_name,
+                        status=EntryStatus.ASSUMED,
+                    )
+                )
+            # failure leaves the head in the heap; the cycle loop
+            # retries it (same as FAILED_AFTER_NOMINATION)
+        now = self.clock.now()
+        for wl, _cq_name in outcome.parked:
+            wl.set_condition(
+                WCT.QUOTA_RESERVED, False, reason="Pending",
+                message="Workload didn't fit", now=now,
+            )
+            self.event("Pending", wl, "Workload didn't fit")
+            self.queues.park_workload(wl)
+        return result
+
+    def _drain_admission(self, wl, cq_name: str, psmap):
+        """Admission from a drain flavor map through the SAME quota view
+        as the cycle path (AssignmentResult.to_admission): per-pod
+        quantities via quota_per_pod (RuntimeClass overhead + resource
+        transforms), effective counts, implicit pods charge."""
+        from kueue_tpu.core.workload_info import (
+            effective_podset_count,
+            quota_per_pod,
+        )
+        from kueue_tpu.models.workload import Admission, PodSetAssignment
+        from kueue_tpu.resources import PODS
+
+        podsets = {ps.name: ps for ps in wl.pod_sets}
+        psas = []
+        for name, fmap in psmap.items():
+            ps = podsets[name]
+            count = effective_podset_count(wl, ps)
+            scaled = {
+                r: v * count
+                for r, v in quota_per_pod(ps, self.transform_config).items()
+            }
+            if PODS in fmap:
+                scaled[PODS] = count
+            psas.append(
+                PodSetAssignment(
+                    name=name,
+                    flavors=dict(fmap),
+                    resource_usage=scaled,
+                    count=count,
+                )
+            )
+        return Admission(cluster_queue=cq_name, pod_set_assignments=tuple(psas))
+
+    def _apply_drain_eviction(self, ev, preempting_entries, result) -> None:
+        from types import SimpleNamespace
+
+        from kueue_tpu.core.scheduler import Entry, PreemptionTarget
+
+        evictor = ev.by_workload if ev.by_workload is not None else ev.victim
+        target = PreemptionTarget(
+            workload=SimpleNamespace(workload=ev.victim), reason=ev.reason
+        )
+        self.scheduler.preemptor.issue_preemptions(
+            evictor, [target], preempting_cq=ev.by_cq or ev.victim_cq
+        )
+        e = preempting_entries.get(evictor.key)
+        if e is None:
+            e = Entry(
+                workload=evictor, cq_name=ev.by_cq or ev.victim_cq
+            )
+            preempting_entries[evictor.key] = e
+            result.preempting.append(e)
+        e.preemption_targets.append(target)

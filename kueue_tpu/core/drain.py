@@ -248,9 +248,27 @@ def plan_drain(
 
 
 @dataclass
+class DrainEviction:
+    """One eviction with evictor attribution (the kernel records the
+    evicting queue exactly; the evicting ENTRY is the queue's next
+    admission at/after the eviction cycle — exact except for the rare
+    head that evicts, then loses every later fits() re-check)."""
+
+    victim: Workload
+    victim_cq: str
+    cycle: int
+    by_cq: Optional[str] = None
+    by_workload: Optional[Workload] = None
+    # Preempted condition reason (preemption.py IN_* constants)
+    reason: str = "InClusterQueue"
+
+
+@dataclass
 class PreemptDrainOutcome(DrainOutcome):
     # (victim workload, cq_name, cycle index of the eviction)
     preempted: List[Tuple[Workload, str, int]] = field(default_factory=list)
+    # same evictions, with evictor attribution (aligned order)
+    evictions: List[DrainEviction] = field(default_factory=list)
 
 
 def run_drain_preempt(
@@ -667,6 +685,7 @@ def run_drain_preempt(
     adm_cycle = flat[off : off + ql].reshape((nq, nl2)); off += ql
     evicted = flat[off : off + sv].reshape((s_dim, v_cap)).astype(bool); off += sv
     evict_cycle = flat[off : off + sv].reshape((s_dim, v_cap)); off += sv
+    evict_by = flat[off : off + sv].reshape((s_dim, v_cap)); off += sv
     stuck_q = flat[off : off + nq].astype(bool); off += nq
     cycles = int(flat[-1])
     # truncated = the CYCLE CAP cut undecided work; queues frozen by
@@ -700,7 +719,44 @@ def run_drain_preempt(
         else:
             parked.append((wl, cq_name))
     admitted.sort(key=lambda t: t[3])
+    from kueue_tpu.core.preemption import (
+        IN_CLUSTER_QUEUE,
+        IN_COHORT_RECLAIM_WHILE_BORROWING,
+        IN_COHORT_RECLAMATION,
+    )
+    from kueue_tpu.ops.drain_kernel import NO_BWC_THRESHOLD
+
+    def _evictor_entry(qi: int, cyc: int):
+        """(workload, priority) of queue qi's evicting entry at cycle
+        cyc: its next admission at/after cyc (a preempting head charges
+        usage at the eviction cycle and admits at a later one); falls
+        back to the queue's first never-admitted entry when the head
+        lost every later re-check and parked."""
+        best = None
+        first_unadmitted = None
+        for pos in range(int(plan.queues_np["qlen"][qi])):
+            i = plan.head_of.get((qi, pos))
+            if i is None:
+                continue
+            if int(status[qi, pos]) == 2:
+                ac = int(adm_cycle[qi, pos])
+                if ac >= cyc and (best is None or ac < best[0]):
+                    best = (ac, i, pos)
+            elif first_unadmitted is None:
+                first_unadmitted = (i, pos)
+        if best is not None:
+            i, pos = best[1], best[2]
+        elif first_unadmitted is not None:
+            i, pos = first_unadmitted
+        else:
+            return None, 0
+        return (
+            lowered.heads[i],
+            int(plan.queues_np["priority"][qi, pos]),
+        )
+
     preempted: List[Tuple[Workload, str, int]] = []
+    evictions: List[DrainEviction] = []
     for s in seg_root:
         for slot in range(len(slot_meta.get(s, []))):
             if not evicted[s, slot]:
@@ -708,17 +764,40 @@ def run_drain_preempt(
             cyc = int(evict_cycle[s, slot])
             ws = victim_of.get((s, slot))
             if ws is not None:
-                preempted.append(
-                    (ws.workload, row_names[int(sowner[s, slot])], cyc)
-                )
+                victim_wl = ws.workload
+                victim_cq = row_names[int(sowner[s, slot])]
             else:
                 qi, pos = int(sslot_q[s, slot]), int(sslot_l[s, slot])
                 i = plan.head_of.get((qi, pos))
-                if i is not None:
-                    preempted.append(
-                        (lowered.heads[i], lowered.cq_names[i], cyc)
-                    )
-    preempted.sort(key=lambda t: t[2])
+                if i is None:
+                    continue
+                victim_wl = lowered.heads[i]
+                victim_cq = lowered.cq_names[i]
+            preempted.append((victim_wl, victim_cq, cyc))
+            qi_by = int(evict_by[s, slot])
+            by_cq = by_wl = None
+            reason = IN_CLUSTER_QUEUE
+            if 0 <= qi_by < len(plan.cq_order):
+                by_cq = plan.cq_order[qi_by]
+                by_wl, by_prio = _evictor_entry(qi_by, cyc)
+                if int(cq_rows[qi_by]) != int(sowner[s, slot]):
+                    # the ladder's threshold rule (preemption.go:353-357):
+                    # below min(evictor priority, maxPriorityThreshold+1)
+                    # the reclaim rode borrowWithinCohort
+                    thr = min(by_prio, int(bwc_thr1[qi_by]), NO_BWC_THRESHOLD)
+                    if bwc[qi_by] and int(sprio[s, slot]) < thr:
+                        reason = IN_COHORT_RECLAIM_WHILE_BORROWING
+                    else:
+                        reason = IN_COHORT_RECLAMATION
+            evictions.append(
+                DrainEviction(
+                    victim=victim_wl, victim_cq=victim_cq, cycle=cyc,
+                    by_cq=by_cq, by_workload=by_wl, reason=reason,
+                )
+            )
+    order = sorted(range(len(preempted)), key=lambda ix: preempted[ix][2])
+    preempted = [preempted[ix] for ix in order]
+    evictions = [evictions[ix] for ix in order]
     fb = [
         (lowered.heads[i], lowered.cq_names[i]) for i in plan.fallback
     ] + extra_fallback
@@ -729,6 +808,7 @@ def run_drain_preempt(
         cycles=cycles,
         truncated=truncated,
         preempted=preempted,
+        evictions=evictions,
     )
 
 
@@ -1010,9 +1090,10 @@ def run_drain_tas(
                     tolerations=tuple(ps.tolerations),
                 )
                 ta, reason = snap.find_topology_assignment(req, {})
-                assert not reason, (
-                    f"TAS drain replay failed for {wl.name}: {reason}"
-                )
+                if reason:  # explicit raise: must survive `python -O`
+                    raise AssertionError(
+                        f"TAS drain replay failed for {wl.name}: {reason}"
+                    )
                 assignments[bj] = ta
                 placed.append((req, ta))
             for req, ta in placed:  # charge AFTER the batch (cycle end)

@@ -232,6 +232,17 @@ class PendingClusterQueue:
         items = list(self.heap.items()) + list(self.inadmissible.values())
         if self.inflight is not None:
             items.append(self.inflight)
+        return self._heap_order(items)
+
+    def snapshot_active_sorted(self) -> List[Workload]:
+        """Active pending only (heap + inflight, no parked), in heap
+        order — the workloads the cycle loop would pop as heads."""
+        items = list(self.heap.items())
+        if self.inflight is not None:
+            items.append(self.inflight)
+        return self._heap_order(items)
+
+    def _heap_order(self, items: List[Workload]) -> List[Workload]:
         import functools
 
         return sorted(
@@ -240,6 +251,15 @@ class PendingClusterQueue:
                 lambda a, b: -1 if self._less(a, b) else (1 if self._less(b, a) else 0)
             ),
         )
+
+    def park(self, wl: Workload) -> None:
+        """Move a workload straight into inadmissible parking (the bulk
+        drain's terminal NoFit outcome; the kernel already modeled the
+        requeue/reactivation churn the host would run to get here)."""
+        key = wl.key
+        self.heap.delete(key)
+        self._forget_inflight(key)
+        self.inadmissible[key] = wl
 
 
 class QueueManager:
@@ -359,6 +379,27 @@ class QueueManager:
             pending = self.cluster_queues.get(lq.cluster_queue)
             if pending is not None:
                 pending.delete(wl.key)
+
+    def remove_from_pending(self, wl: Workload) -> None:
+        """Drop a workload from its CQ's pending structures only (the
+        admitted path: it stays a LocalQueue item, unlike
+        delete_workload)."""
+        lq = self.local_queues.get(self._lq_key_for(wl))
+        if lq is None:
+            return
+        pending = self.cluster_queues.get(lq.cluster_queue)
+        if pending is not None:
+            pending.delete(wl.key)
+
+    def park_workload(self, wl: Workload) -> None:
+        """Terminal-NoFit parking for the bulk drain (see
+        ClusterQueuePending.park)."""
+        lq = self.local_queues.get(self._lq_key_for(wl))
+        if lq is None:
+            return
+        pending = self.cluster_queues.get(lq.cluster_queue)
+        if pending is not None:
+            pending.park(wl)
 
     def requeue_workload(self, wl: Workload, reason: RequeueReason) -> bool:
         lq = self.local_queues.get(self._lq_key_for(wl))

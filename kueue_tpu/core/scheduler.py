@@ -222,6 +222,11 @@ class Scheduler:
         self.scheduling_cycle = 0
         # per-cycle phase traces, newest last (ring buffer)
         self.last_traces = deque(maxlen=128)
+        # First-class cycle-result hook: every completed cycle (host,
+        # device, or runtime bulk drain) is delivered to these
+        # callbacks — the public observation surface for preemption
+        # reporting and admission spies (no monkeypatching schedule()).
+        self.cycle_observers: List[Callable[[CycleResult], None]] = []
         # Latency-aware auto gating. A device dispatch pays a fixed
         # round-trip cost (tens of ms on remote-attached TPUs) that only
         # amortizes once the cycle batches enough heads, so auto mode
@@ -245,6 +250,7 @@ class Scheduler:
         heads = self.queues.heads()
         trace.heads = len(heads)
         if not heads:
+            self.notify_cycle(result)
             return result
         trace.spans["heads"] = _time.perf_counter() - t0
 
@@ -259,6 +265,7 @@ class Scheduler:
             out = self._finalize_device(entries, device_plan, snapshot, result)
             trace.spans["admit"] = _time.perf_counter() - t2
             self._finish_trace(trace, out, t0)
+            self.notify_cycle(out)
             return out
         t2 = _time.perf_counter()  # 'admit' includes the entry ordering
         ordered = self._iterate(entries, snapshot)
@@ -395,7 +402,12 @@ class Scheduler:
                 result.requeued.append(e)
         trace.spans["admit"] = _time.perf_counter() - t2
         self._finish_trace(trace, result, t0)
+        self.notify_cycle(result)
         return result
+
+    def notify_cycle(self, result: CycleResult) -> None:
+        for cb in list(self.cycle_observers):
+            cb(result)
 
     def _finish_trace(self, trace: "CycleTrace", result: CycleResult, t0) -> None:
         trace.total_s = _time.perf_counter() - t0
@@ -926,24 +938,37 @@ class Scheduler:
 
     # ---- admission (scheduler.go:498-555) ----
     def _admit(self, e: Entry, snapshot: Snapshot) -> bool:
-        wl = e.workload
-        now = self.clock.now()
         admission = e.assignment.to_admission(
-            e.cq_name, wl, transform=self.transform_config
+            e.cq_name, e.workload, transform=self.transform_config
         )
+        ok, msg = self.admit_prepared(
+            e.workload, e.cq_name, admission, snapshot.cq_models[e.cq_name]
+        )
+        if ok:
+            e.status = EntryStatus.ASSUMED
+        else:
+            e.inadmissible_msg = msg
+            # end-of-cycle loop requeues every non-assumed entry
+            e.status = EntryStatus.NOMINATED
+        return ok
+
+    def admit_prepared(self, wl: Workload, cq_name: str, admission, cq_model) -> Tuple[bool, str]:
+        """Admission tail shared by the cycle loop and the runtime's
+        bulk drain: set conditions + check states from a ready Admission
+        object, assume in the cache, durable-write. Returns (ok, msg)."""
+        now = self.clock.now()
         wl.admission = admission
         wl.set_condition(
             WorkloadConditionType.QUOTA_RESERVED, True, reason="QuotaReserved", now=now
         )
         # initialize admission-check states for checks applying to the
         # assigned flavors (two-phase admission)
-        cq = snapshot.cq_models[e.cq_name]
         flavors_used = {
-            c.name for ps in e.assignment.pod_sets for c in ps.flavors.values()
+            f for psa in admission.pod_set_assignments for f in psa.flavors.values()
         }
         from kueue_tpu.models.admission_check import AdmissionCheckState
 
-        required = self.cache.admission_checks_for_workload(cq, flavors_used)
+        required = self.cache.admission_checks_for_workload(cq_model, flavors_used)
         for name in required:
             if name not in wl.admission_check_states:
                 wl.admission_check_states[name] = AdmissionCheckState(name=name)
@@ -953,28 +978,24 @@ class Scheduler:
             )
 
         if not self.cache.assume_workload(wl):
-            e.inadmissible_msg = "Failed to assume workload"
-            self._rollback_admission(wl, e.inadmissible_msg)
-            return False
-        e.status = EntryStatus.ASSUMED
+            msg = "Failed to assume workload"
+            self._rollback_admission(wl, msg)
+            return False, msg
         # Workload leaves the pending queue: drop the flavor cursor so a
         # later eviction restarts the search from the first flavor.
         wl.last_assignment = None
 
-        ok = self.apply_admission(wl)
-        if not ok:
+        if not self.apply_admission(wl):
             self.cache.forget_workload(wl)
-            e.inadmissible_msg = "Failed to admit workload: durable write failed"
-            self._rollback_admission(wl, e.inadmissible_msg)
-            # end-of-cycle loop requeues every non-assumed entry
-            e.status = EntryStatus.NOMINATED
-            return False
+            msg = "Failed to admit workload: durable write failed"
+            self._rollback_admission(wl, msg)
+            return False, msg
         self.events(
-            "QuotaReserved", wl, f"Quota reserved in ClusterQueue {e.cq_name}"
+            "QuotaReserved", wl, f"Quota reserved in ClusterQueue {cq_name}"
         )
         if wl.is_admitted:
-            self.events("Admitted", wl, f"Admitted by ClusterQueue {e.cq_name}")
-        return True
+            self.events("Admitted", wl, f"Admitted by ClusterQueue {cq_name}")
+        return True, ""
 
     def _rollback_admission(self, wl: Workload, msg: str) -> None:
         """Undo the optimistic condition writes of a failed admission

@@ -1421,13 +1421,17 @@ class PreemptDrainResult(NamedTuple):
     before max_cycles, 1 parked, 2 admitted); admitted_k / admitted_cycle
     as DrainResult; evicted: bool[S,V] pool slot was preempted (part-A
     snapshot victims AND part-B drain-admitted entries);
-    evicted_cycle: int32[S,V]; cycles; local_usage."""
+    evicted_cycle: int32[S,V]; evicted_by: int32[S,V] queue index of the
+    evicting head (-1 where not evicted) — each victim is removed by
+    exactly one head (the overlap guard plus the live mask forbid a
+    second eviction), so the attribution is exact; cycles; local_usage."""
 
     status: jnp.ndarray
     admitted_k: jnp.ndarray
     admitted_cycle: jnp.ndarray
     evicted: jnp.ndarray
     evicted_cycle: jnp.ndarray
+    evicted_by: jnp.ndarray
     stuck: jnp.ndarray  # bool[Q] — frozen PendingFlavors spinners
     cycles: jnp.ndarray
     local_usage: jnp.ndarray
@@ -1630,7 +1634,7 @@ def solve_drain_preempt(
     def cycle_body(state):
         (local, status, g_start, retries, stuck, no_prog, adm_k,
          adm_cycle, pcells, pqty, pvalid, vevicted, evict_cycle,
-         cycle) = state
+         evict_by, cycle) = state
 
         # head of each queue = first pending entry in heap order
         entry_pending = status == 0  # [Q,L]
@@ -1819,8 +1823,8 @@ def solve_drain_preempt(
         )
 
         def step(carry, s):
-            leaf, usage_c, ev_now = carry  # invariant: usage_c ==
-            #                                usage_tree(leaf)
+            leaf, usage_c, ev_now, ev_by_now = carry  # invariant:
+            #                           usage_c == usage_tree(leaf)
             idx = mat[s]  # [G]
             act = idx >= 0
             hidx = jnp.maximum(idx, 0)
@@ -1940,11 +1944,27 @@ def solve_drain_preempt(
             ev_now = ev_now.at[jnp.where(act, sq_h, s_dim)].max(
                 htarg & pre_ok[:, None], mode="drop"
             )
-            return (leaf2, usage_n, ev_now), (admit, pre_ok)
+            # evictor attribution: at most one head ever evicts a given
+            # slot (live mask + overlap guard), so max over a -1 init
+            # records exactly the evicting queue's index
+            ev_by_now = ev_by_now.at[jnp.where(act, sq_h, s_dim)].max(
+                jnp.where(
+                    htarg & pre_ok[:, None],
+                    hidx[:, None].astype(jnp.int32),
+                    -1,
+                ),
+                mode="drop",
+            )
+            return (leaf2, usage_n, ev_now, ev_by_now), (admit, pre_ok)
 
-        (_, _, ev_now_f), (admit_sn, pre_ok_sn) = lax.scan(
+        (_, _, ev_now_f, ev_by_f), (admit_sn, pre_ok_sn) = lax.scan(
             step,
-            (local, usage0, jnp.zeros((s_dim, v), dtype=bool)),
+            (
+                local,
+                usage0,
+                jnp.zeros((s_dim, v), dtype=bool),
+                jnp.full((s_dim, v), -1, dtype=jnp.int32),
+            ),
             jnp.arange(n_steps),
         )
 
@@ -1975,6 +1995,7 @@ def solve_drain_preempt(
         ].add(-ev_qty.reshape(-1))
         vevicted = vevicted | newly
         evict_cycle = jnp.where(newly, cycle, evict_cycle)
+        evict_by = jnp.where(newly, ev_by_f, evict_by)
 
         # admitted entries fill their part-B pool slot: they are live
         # reclaim candidates from the next cycle on
@@ -2067,13 +2088,13 @@ def solve_drain_preempt(
         return (
             local, status, g_start, retries, stuck, no_prog, adm_k,
             adm_cycle, pcells, pqty, pvalid, vevicted, evict_cycle,
-            cycle + 1,
+            evict_by, cycle + 1,
         )
 
     def cond(state):
         status = state[1]
         stuck = state[4]
-        cycle = state[13]
+        cycle = state[14]
         has_pending = jnp.any(
             (status == 0)
             & (l_idx[None, :] < queues.qlen[:, None])
@@ -2096,10 +2117,11 @@ def solve_drain_preempt(
         victims.svalid0,
         jnp.zeros((s_dim, v), dtype=bool),
         jnp.full((s_dim, v), -1, dtype=jnp.int32),
+        jnp.full((s_dim, v), -1, dtype=jnp.int32),
         jnp.int32(0),
     )
     (local_f, status_f, _, _, stuck_f, _, adm_k, adm_cycle, _, _, _,
-     vevicted, evict_cycle, cycles) = lax.while_loop(
+     vevicted, evict_cycle, evict_by, cycles) = lax.while_loop(
         cond, cycle_body, init
     )
     return PreemptDrainResult(
@@ -2108,6 +2130,7 @@ def solve_drain_preempt(
         admitted_cycle=adm_cycle,
         evicted=vevicted,
         evicted_cycle=evict_cycle,
+        evicted_by=evict_by,
         cycles=cycles,
         local_usage=local_f,
         stuck=stuck_f,
@@ -2129,6 +2152,7 @@ def _solve_drain_preempt_packed(
             r.admitted_cycle.reshape(-1),
             r.evicted.astype(jnp.int32).reshape(-1),
             r.evicted_cycle.reshape(-1),
+            r.evicted_by.reshape(-1),
             r.stuck.astype(jnp.int32),
             r.cycles[None],
         ]

@@ -146,32 +146,11 @@ def solve_assign(request: dict) -> dict:
     cycles = 0
     decisions: List[dict] = []
     preemptions: List[dict] = []
-    if until_idle:
-        # collect per-cycle preemption targets so the response shape
-        # matches the single-cycle branch ({victim, by, reason})
-        orig = rt.scheduler.schedule
 
-        def spy_schedule():
-            result = orig()
-            for entry in result.preempting:
-                for tgt in entry.preemption_targets:
-                    preemptions.append(
-                        {
-                            "victim": tgt.workload.workload.key,
-                            "by": entry.workload.key,
-                            "reason": tgt.reason,
-                        }
-                    )
-            return result
-
-        rt.scheduler.schedule = spy_schedule
-        try:
-            cycles = rt.run_until_idle()
-        finally:
-            rt.scheduler.schedule = orig
-    else:
-        result = rt.schedule_once()
-        cycles = 1
+    def observe(result) -> None:
+        # per-cycle preemption targets via the scheduler's first-class
+        # cycle-result hook; the bulk drain path reports through the
+        # same surface (ClusterRuntime.bulk_drain -> notify_cycle)
         for entry in result.preempting:
             for tgt in entry.preemption_targets:
                 preemptions.append(
@@ -181,6 +160,16 @@ def solve_assign(request: dict) -> dict:
                         "reason": tgt.reason,
                     }
                 )
+
+    rt.scheduler.cycle_observers.append(observe)
+    try:
+        if until_idle:
+            cycles = rt.run_until_idle()
+        else:
+            rt.schedule_once()
+            cycles = 1
+    finally:
+        rt.scheduler.cycle_observers.remove(observe)
     for key in sorted(rt.workloads):
         wl = rt.workloads[key]
         item = {
