@@ -592,6 +592,180 @@ def fair_drain_bench(rng):
     return float(np.median(times)), host_s, len(pending), outcome.cycles
 
 
+def fair_preempt_drain_bench(rng):
+    """Bulk fair-sharing drain WITH fair preemption — the production
+    fair-cohort config: borrowing victims saturate every cohort and the
+    backlog can only start through the in-kernel fair victim tournament
+    (ops/drain_kernel.solve_drain_fair_preempt; parity
+    tests/test_drain.py TestFairPreemptDrain) vs the host fair
+    scheduler with evictions applied between cycles. Returns
+    (device_s, host_s, n_pending, cycles, evicted)."""
+    import time
+
+    from kueue_tpu.core.cache import Cache
+    from kueue_tpu.core.drain import run_drain_fair_preempt
+    from kueue_tpu.core.preemption import Preemptor
+    from kueue_tpu.core.queue_manager import QueueManager, queue_order_timestamp
+    from kueue_tpu.core.scheduler import Scheduler
+    from kueue_tpu.core.snapshot import take_snapshot
+    from kueue_tpu.core.workload_info import make_admission
+    from kueue_tpu.models import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        Preemption,
+        ResourceFlavor,
+        Workload,
+        WorkloadConditionType,
+    )
+    from kueue_tpu.models.cluster_queue import FairSharing, ResourceGroup
+    from kueue_tpu.models.constants import (
+        PreemptionPolicy,
+        ReclaimWithinCohortPolicy,
+    )
+    from kueue_tpu.models.workload import PodSet
+    from kueue_tpu.utils.clock import FakeClock
+
+    n_cq, cohort_size, wl_per_cq, victims_per_cq = 60, 6, 4, 3
+    weights = [500, 1000, 1000, 2000]
+    prem = Preemption(
+        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+        reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+    )
+
+    def build():
+        clock = FakeClock(0.0)
+        cache = Cache()
+        mgr = QueueManager(clock)
+        cache.add_or_update_flavor(ResourceFlavor(name="default"))
+        w_rng = np.random.default_rng(11)
+        t = 0.0
+        for i in range(n_cq):
+            name = f"fpcq-{i}"
+            cq = ClusterQueue(
+                name=name,
+                cohort=f"fpcohort-{i // cohort_size}",
+                namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",),
+                        (FlavorQuotas.build("default", {"cpu": "8"}),),
+                    ),
+                ),
+                fair_sharing=FairSharing(
+                    weight_milli=weights[int(w_rng.integers(0, len(weights)))]
+                ),
+                preemption=prem,
+            )
+            cache.add_or_update_cluster_queue(cq)
+            mgr.add_cluster_queue(cq)
+            mgr.add_local_queue(
+                LocalQueue(namespace="ns", name=f"lq-{name}", cluster_queue=name)
+            )
+            # every other CQ hoards: admitted victims borrowing above
+            # nominal make the cohort DRS-positive (fair reclaim bait)
+            if i % 2 == 0:
+                for v in range(victims_per_cq):
+                    t += 1.0
+                    wl = Workload(
+                        namespace="ns", name=f"fpv-{i}-{v}",
+                        queue_name=f"lq-{name}",
+                        priority=int(w_rng.integers(0, 2)) * 5,
+                        creation_time=t,
+                        pod_sets=(PodSet.build("main", 1, {"cpu": "5"}),),
+                    )
+                    wl.admission = make_admission(
+                        name, {"main": {"cpu": "default"}}, wl
+                    )
+                    wl.set_condition(
+                        WorkloadConditionType.QUOTA_RESERVED, True,
+                        reason="QuotaReserved", now=t,
+                    )
+                    cache.add_or_update_workload(wl)
+            for w in range(wl_per_cq):
+                t += 1.0
+                mgr.add_or_update_workload(
+                    Workload(
+                        namespace="ns", name=f"fpwl-{i}-{w}",
+                        queue_name=f"lq-{name}",
+                        priority=20 + int(w_rng.integers(0, 3)) * 10,
+                        creation_time=t,
+                        pod_sets=(
+                            PodSet.build(
+                                "main", 1,
+                                {"cpu": str(int(w_rng.integers(2, 6)))},
+                            ),
+                        ),
+                    )
+                )
+        return clock, cache, mgr
+
+    # device: one dispatch decides admissions + fair evictions
+    clock, cache, mgr = build()
+    pending = []
+    for cq_name, pq in mgr.cluster_queues.items():
+        for wl in pq.snapshot_sorted():
+            pending.append((wl, cq_name))
+    ts_fn = lambda wl: queue_order_timestamp(wl, mgr._ts_policy)  # noqa: E731
+    snapshot = take_snapshot(cache)
+    run_drain_fair_preempt(
+        snapshot, pending, cache.flavors, timestamp_fn=ts_fn
+    )  # warmup (compile)
+    times = []
+    for _ in range(3):
+        snapshot = take_snapshot(cache)
+        t0 = time.perf_counter()
+        outcome = run_drain_fair_preempt(
+            snapshot, pending, cache.flavors, timestamp_fn=ts_fn
+        )
+        times.append(time.perf_counter() - t0)
+    assert not outcome.fallback and not outcome.truncated
+    dev_admitted = {wl.name for wl, _, _, _ in outcome.admitted}
+    dev_evicted = {wl.name for wl, _, _ in outcome.preempted}
+    assert dev_evicted, "fair-preempt bench evicted nothing"
+
+    # host: fair scheduler + fair preemptor, evictions applied between
+    # cycles (the reconciler round trip compressed to cycle boundaries)
+    clock, cache, mgr = build()
+    sched = Scheduler(
+        queues=mgr, cache=cache, clock=clock,
+        preemptor=Preemptor(clock, enable_fair_sharing=True),
+        use_solver=False, fair_sharing=True,
+    )
+    host_admitted, host_evicted = set(), set()
+    t0 = time.perf_counter()
+    for _ in range(600):
+        progressed = False
+        if any(
+            pq.pending_active() > 0 for pq in mgr.cluster_queues.values()
+        ):
+            progressed = True
+        res = sched.schedule()
+        host_admitted.update(e.workload.name for e in res.admitted)
+        victims = [
+            t_.workload.workload
+            for e in res.preempting
+            for t_ in e.preemption_targets
+        ]
+        for wl in victims:
+            if wl.name in host_evicted:
+                continue
+            host_evicted.add(wl.name)
+            cq_name = wl.admission.cluster_queue
+            cache.delete_workload(wl)
+            mgr.queue_associated_inadmissible_workloads_after(cq_name)
+            progressed = True
+        if not progressed:
+            break
+    host_s = time.perf_counter() - t0
+    assert dev_admitted == host_admitted, "fair-preempt decision divergence"
+    assert dev_evicted == host_evicted, "fair-preempt eviction divergence"
+    return (
+        float(np.median(times)), host_s, len(pending), outcome.cycles,
+        len(dev_evicted),
+    )
+
+
 def tas_drain_bench(rng):
     """TAS-heavy drain: 10k gang workloads with Required topology
     requests over a 1024-host topology (16 blocks x 8 racks x 8 hosts),
@@ -747,6 +921,9 @@ def payload_main():
     tas_ms, tas_leaves, tas_pods = tas_placement_bench(rng)
     fair_ms, fair_host_ms, fair_heads = fair_victim_search_bench(rng)
     fd_s, fd_host_s, fd_pending, fd_cycles = fair_drain_bench(rng)
+    fp_s, fp_host_s, fp_pending, fp_cycles, fp_evicted = (
+        fair_preempt_drain_bench(rng)
+    )
     td_ms, td_cycles, td_admitted, td_pending = tas_drain_bench(rng)
 
     print(
@@ -794,6 +971,19 @@ def payload_main():
                 ),
                 "fair_drain_value": round(fd_s * 1e3, 3),
                 "fair_drain_unit": "ms/drain",
+                "fair_preempt_drain_metric": (
+                    f"fair_preempt_drain ({fp_pending} pending x 60 CQs in "
+                    f"10 fair cohorts saturated by borrowing victims, "
+                    f"in-kernel fair victim tournament + DRS ordering, "
+                    f"{fp_cycles} cycles, {fp_evicted} evicted, one "
+                    f"dispatch; host fair scheduler "
+                    f"{round(fp_host_s * 1e3, 1)} ms)"
+                ),
+                "fair_preempt_drain_value": round(fp_s * 1e3, 3),
+                "fair_preempt_drain_unit": "ms/drain",
+                "fair_preempt_drain_speedup_vs_host": round(
+                    fp_host_s / max(fp_s, 1e-9), 1
+                ),
                 "tas_drain_metric": (
                     f"tas_drain ({td_pending // 1000}k Required-mode gangs "
                     f"over 1024 hosts, in-kernel placement, {td_cycles} "
@@ -835,7 +1025,20 @@ def _run_payload(force_cpu: bool):
         return None, f"payload timed out after {PAYLOAD_TIMEOUT_S}s"
     if p.returncode != 0:
         tail = (p.stderr or p.stdout or "").strip().splitlines()
-        return None, (tail[-1][:400] if tail else f"payload rc={p.returncode}")
+        # last line that looks like the actual exception — JAX appends
+        # a traceback-filtering NOTICE after the real error, and axon
+        # logs INFO/WARN lines; neither names the failure
+        noise = (
+            "For simplicity, JAX has removed",
+            "Set JAX_TRACEBACK_FILTERING",
+            "--------------------",
+        )
+        for line in reversed(tail):
+            s = line.strip()
+            if not s or any(s.startswith(n) for n in noise):
+                continue
+            return None, s[:400]
+        return None, f"payload rc={p.returncode}"
     for line in reversed((p.stdout or "").strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):

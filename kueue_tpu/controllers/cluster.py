@@ -726,6 +726,18 @@ class ClusterRuntime:
         # drains in a couple of ordinary cycles anyway
         if total < self.bulk_drain_threshold or total < 2 * len(live):
             return None
+        # latency gate FIRST, same machinery as the cycle path: probe
+        # once, then require the measured drain cost/head (plan +
+        # dispatch, windowed min) to beat the host nomination estimate;
+        # erode on skip so a compile-heavy probe re-probes instead of
+        # latching the path off. Checked before the snapshot +
+        # prevalidate pass so a gated-off iteration doesn't pay that
+        # O(backlog) work twice.
+        host_est = sched._host_assign_ema or sched._HOST_ASSIGN_DEFAULT
+        drain_est = self._drain_est.value
+        if drain_est is not None and drain_est > host_est:
+            self._drain_est.erode()
+            return None
 
         t0 = _time.perf_counter()
         snapshot = take_snapshot(self.cache)
@@ -762,16 +774,6 @@ class ClusterRuntime:
         ]
         if len(pending) < self.bulk_drain_threshold:
             return None
-        # latency gate, same machinery as the cycle path: probe once,
-        # then require the measured drain cost/head (plan + dispatch,
-        # windowed min) to beat the host nomination estimate; erode on
-        # skip so a compile-heavy probe re-probes instead of latching
-        # the path off
-        host_est = sched._host_assign_ema or sched._HOST_ASSIGN_DEFAULT
-        drain_est = self._drain_est.value
-        if drain_est is not None and drain_est > host_est:
-            self._drain_est.erode()
-            return None
 
         ts_fn = lambda wl: queue_order_timestamp(  # noqa: E731
             wl, self.queues._ts_policy
@@ -788,12 +790,20 @@ class ClusterRuntime:
                 != ReclaimWithinCohortPolicy.NEVER
             )
 
-        if sched.fair_sharing:
+        any_preempt = any(_preempt_capable(c) for c in {c for _, c in pending})
+        if sched.fair_sharing and any_preempt:
+            from kueue_tpu.core.drain import run_drain_fair_preempt
+
+            outcome = run_drain_fair_preempt(
+                snapshot, pending, self.cache.flavors, timestamp_fn=ts_fn,
+                fs_strategies=getattr(sched.preemptor, "fs_strategies", None),
+            )
+        elif sched.fair_sharing:
             outcome = run_drain(
                 snapshot, pending, self.cache.flavors, timestamp_fn=ts_fn,
                 fair_sharing=True,
             )
-        elif any(_preempt_capable(c) for c in {c for _, c in pending}):
+        elif any_preempt:
             outcome = run_drain_preempt(
                 snapshot, pending, self.cache.flavors, timestamp_fn=ts_fn
             )
@@ -806,6 +816,16 @@ class ClusterRuntime:
         self._drain_est.observe(
             (_time.perf_counter() - t0) / max(len(pending), 1)
         )
+        if not (
+            outcome.admitted
+            or outcome.parked
+            or getattr(outcome, "preempted", None)
+        ):
+            # every head fell back (unrepresentable backlog): the drain
+            # decided NOTHING — let the cycle loop run this iteration,
+            # or run_until_idle would see an unchanged fingerprint and
+            # stop with the whole backlog still pending
+            return None
         result = self._apply_drain_outcome(outcome, snapshot)
         dt = _time.perf_counter() - t0
         sched.scheduling_cycle += 1

@@ -271,42 +271,63 @@ class PreemptDrainOutcome(DrainOutcome):
     evictions: List[DrainEviction] = field(default_factory=list)
 
 
-def run_drain_preempt(
-    snapshot: Snapshot,
-    pending: Sequence[Tuple[Workload, str]],
-    flavors: Dict[str, ResourceFlavor],
-    max_candidates: int = 8,
-    max_cells: int = 4,
-    max_victims: int = 512,
-    max_victim_cells: int = 4,
-    timestamp_fn=None,
-    max_cycles: Optional[int] = None,
-    now: Optional[float] = None,
-    search_width: int = 32,
-    mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
-) -> PreemptDrainOutcome:
-    """Multi-cycle drain WITH classic preemption — within-ClusterQueue
-    and cross-CQ cohort reclamation — in one device dispatch + one
-    fetch (ops/drain_kernel.solve_drain_preempt).
+def _fair_lendable(snapshot: Snapshot, paths_np: np.ndarray):
+    """(depth_of, lendable, res_of_fr) for the fair-sharing drains.
 
-    Candidates are pooled per root cohort (segment): every member CQ's
-    admitted workloads (part A), plus one slot per pending entry that
-    becomes a live reclaim candidate once the drain admits it (part B —
-    the host cycle loop sees drain-admitted workloads in its snapshot
-    the same way). ``now`` is the quota-reservation instant attributed
-    to in-drain admissions for candidate ordering (default: after every
-    part-A reservation). ``max_victims`` caps a SEGMENT's pool;
-    overflowing segments route their preempt-capable queues to
-    ``fallback`` for the sequential cycle loop, as do victims with more
-    than ``max_victim_cells`` distinct usage cells. ``search_width``
-    bounds one head's per-cycle candidate scan; a head that fails an
-    overflowing search is reported via ``fallback`` (no-decision), not
-    parked. The caller applies the reported admissions and evictions in
-    cycle order (a drain-admitted entry may later be evicted by a
-    reclaiming CQ: it appears in BOTH lists) — this function only
-    decides.
-    """
-    from kueue_tpu._jax import jnp
+    lendable depends on quota only: potentialAvailable of the PARENT,
+    summed per resource (fair_sharing.go:90-104)."""
+    from kueue_tpu.ops.quota_np import potential_available_all_np
+
+    parent = snapshot.flat.parent
+    depth_of = (np.sum(paths_np >= 0, axis=1) - 1).astype(np.int32)
+    pot = potential_available_all_np(
+        parent, snapshot.flat.level_masks(), snapshot.subtree,
+        snapshot.guaranteed, snapshot.borrowing_limit,
+    )
+    n_res = len(snapshot.resource_names)
+    lendable = np.zeros((len(parent), n_res), dtype=np.int64)
+    parent_pot = pot[np.maximum(parent, 0)]
+    np.add.at(lendable.T, snapshot.resource_index, parent_pot.T)
+    lendable[parent < 0] = 0
+    return depth_of, lendable, snapshot.resource_index.astype(np.int32)
+
+
+@dataclass
+class _VictimLowering:
+    """Shared per-root-cohort candidate-pool lowering, consumed by the
+    classic (run_drain_preempt) and fair (run_drain_fair_preempt)
+    preemption drains."""
+
+    victims_np: dict
+    slot_meta: Dict[int, list]
+    victim_of: Dict[Tuple[int, int], object]
+    extra_fb_entries: List[Tuple[Workload, str]]
+    seg_root: Dict[int, int]
+    seg_queues: Dict[int, List[int]]
+    seg_members: Dict[int, List[int]]
+    local_ids: Dict[int, Dict[int, int]]  # s -> global row -> local id
+    row_names: list
+    tree: object
+    paths_j: object
+    v_cap: int
+    s_dim: int
+    cv: int
+    m_dim: int
+
+
+def _lower_victim_pools(
+    snapshot: Snapshot,
+    plan: DrainPlan,
+    timestamp_fn,
+    now: Optional[float],
+    max_victims: int,
+    max_victim_cells: int,
+    max_cycles: Optional[int],
+    extra_segment_bad=None,  # fn(s, members) -> bool: extra scope veto
+) -> _VictimLowering:
+    """Build the SegVictims arrays + metadata for a preemption drain
+    (the shared middle of run_drain_preempt, unchanged semantics) and
+    set plan.max_cycles. Mutates plan (drops ineligible queues)."""
     from kueue_tpu.models.constants import (
         BorrowWithinCohortPolicy,
         PreemptionPolicy,
@@ -314,21 +335,12 @@ def run_drain_preempt(
         WorkloadConditionType,
     )
     from kueue_tpu.ops.assign_kernel import build_roots
-    from kueue_tpu.ops.drain_kernel import (
-        DrainQueues,
-        SegVictims,
-        solve_drain_preempt_packed_jit,
-    )
+    from kueue_tpu.ops.drain_kernel import NO_BWC_THRESHOLD as NO_THR
 
-    plan = plan_drain(
-        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
-    )
     q = max(len(plan.cq_order), 1)
     nl = plan.queues_np["cells"].shape[1]
     pdim, kdim, cdim = plan.queues_np["cells"].shape[2:]
     merged_cells = pdim * cdim  # the kernel's mcells width
-
-    from kueue_tpu.ops.drain_kernel import NO_BWC_THRESHOLD as NO_THR
 
     # ---- per-queue preemption policy flags ----
     same_enabled = np.zeros(q, dtype=bool)
@@ -414,6 +426,8 @@ def run_drain_preempt(
             if bad:
                 break
         n_b = sum(int(qlen[qi]) for qi in seg_queues[s]) if dynamic[s] else 0
+        if extra_segment_bad is not None and not bad:
+            bad = bool(extra_segment_bad(s, members))
         if bad or len(entries) + n_b > max_victims:
             bad_segments.append(s)
             pool_of[s] = []
@@ -493,12 +507,14 @@ def run_drain_preempt(
         ]
         now = (max(rts) + 1.0) if rts else 0.0
 
+    local_ids: Dict[int, Dict[int, int]] = {}
     for s, members in seg_members.items():
         nodes = np.unique(
             paths_np[np.asarray(members, dtype=np.int64)]
         )
         nodes = nodes[nodes >= 0]
         local_id = {int(g): i for i, g in enumerate(nodes)}
+        local_ids[s] = local_id
         seg_nodes[s, : len(nodes)] = nodes
         for i, gnode in enumerate(nodes):
             gp = paths_np[int(gnode)]
@@ -623,7 +639,6 @@ def run_drain_preempt(
     if max_cycles is not None:
         plan.max_cycles = max_cycles
 
-    queues_np = plan.queues_np
     victims_np = dict(
         scells=scells, sqty=sqty, sprio=sprio, sts=sts, svalid0=svalid0,
         sowner=sowner, sowner_local=sowner_local, sslot_q=sslot_q,
@@ -633,6 +648,78 @@ def run_drain_preempt(
         reclaim_enabled=reclaim_enabled, only_lower=only_lower, bwc=bwc,
         bwc_thr1=bwc_thr1,
     )
+    return _VictimLowering(
+        victims_np=victims_np,
+        slot_meta=slot_meta,
+        victim_of=victim_of,
+        extra_fb_entries=extra_fb_entries,
+        seg_root=seg_root,
+        seg_queues=seg_queues,
+        seg_members=seg_members,
+        local_ids=local_ids,
+        row_names=row_names,
+        tree=tree,
+        paths_j=paths_j,
+        v_cap=v_cap,
+        s_dim=s_dim,
+        cv=cv,
+        m_dim=m_dim,
+    )
+
+
+def run_drain_preempt(
+    snapshot: Snapshot,
+    pending: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    max_candidates: int = 8,
+    max_cells: int = 4,
+    max_victims: int = 512,
+    max_victim_cells: int = 4,
+    timestamp_fn=None,
+    max_cycles: Optional[int] = None,
+    now: Optional[float] = None,
+    search_width: int = 32,
+    mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
+) -> PreemptDrainOutcome:
+    """Multi-cycle drain WITH classic preemption — within-ClusterQueue
+    and cross-CQ cohort reclamation — in one device dispatch + one
+    fetch (ops/drain_kernel.solve_drain_preempt).
+
+    Candidates are pooled per root cohort (segment): every member CQ's
+    admitted workloads (part A), plus one slot per pending entry that
+    becomes a live reclaim candidate once the drain admits it (part B —
+    the host cycle loop sees drain-admitted workloads in its snapshot
+    the same way). ``now`` is the quota-reservation instant attributed
+    to in-drain admissions for candidate ordering (default: after every
+    part-A reservation). ``max_victims`` caps a SEGMENT's pool;
+    overflowing segments route their preempt-capable queues to
+    ``fallback`` for the sequential cycle loop, as do victims with more
+    than ``max_victim_cells`` distinct usage cells. ``search_width``
+    bounds one head's per-cycle candidate scan; a head that fails an
+    overflowing search is reported via ``fallback`` (no-decision), not
+    parked. The caller applies the reported admissions and evictions in
+    cycle order (a drain-admitted entry may later be evicted by a
+    reclaiming CQ: it appears in BOTH lists) — this function only
+    decides.
+    """
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.ops.drain_kernel import (
+        DrainQueues,
+        SegVictims,
+        solve_drain_preempt_packed_jit,
+    )
+
+    plan = plan_drain(
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
+    )
+    low = _lower_victim_pools(
+        snapshot, plan, timestamp_fn, now, max_victims, max_victim_cells,
+        max_cycles,
+    )
+    tree, paths_j = low.tree, low.paths_j
+    victims_np = low.victims_np
+
+    queues_np = plan.queues_np
     if mesh is not None:
         from kueue_tpu.parallel.sharded_solver import (
             pad_queue_arrays,
@@ -677,6 +764,33 @@ def run_drain_preempt(
             search_width=search_width,
         )
     )  # the single fetch
+    return _preempt_outcome(plan, low, flat, queues_np, fair=False)
+
+
+def _preempt_outcome(
+    plan: DrainPlan,
+    low: _VictimLowering,
+    flat: np.ndarray,
+    queues_np: dict,
+    fair: bool,
+) -> PreemptDrainOutcome:
+    """Unpack a PreemptDrainResult flat vector and map decisions back
+    to workloads (shared by the classic and fair preemption drains;
+    ``fair`` switches the Preempted-condition reason rules)."""
+    lowered = plan.lowered
+    s_dim, v_cap = low.s_dim, low.v_cap
+    slot_meta, victim_of = low.slot_meta, low.victim_of
+    seg_root, row_names = low.seg_root, low.row_names
+    extra_fb_entries = low.extra_fb_entries
+    victims_np = low.victims_np
+    sowner = victims_np["sowner"]
+    sprio = victims_np["sprio"]
+    sslot_q = victims_np["sslot_q"]
+    sslot_l = victims_np["sslot_l"]
+    bwc = victims_np["bwc"]
+    bwc_thr1 = victims_np["bwc_thr1"]
+    cq_rows = plan.queues_np["cq_rows"]
+
     nq, nl2, npd = queues_np["cells"].shape[:3]  # incl. mesh padding
     ql, sv, qlp = nq * nl2, s_dim * v_cap, nq * nl2 * npd
     off = 0
@@ -721,6 +835,7 @@ def run_drain_preempt(
     admitted.sort(key=lambda t: t[3])
     from kueue_tpu.core.preemption import (
         IN_CLUSTER_QUEUE,
+        IN_COHORT_FAIR_SHARING,
         IN_COHORT_RECLAIM_WHILE_BORROWING,
         IN_COHORT_RECLAMATION,
     )
@@ -781,14 +896,22 @@ def run_drain_preempt(
                 by_cq = plan.cq_order[qi_by]
                 by_wl, by_prio = _evictor_entry(qi_by, cyc)
                 if int(cq_rows[qi_by]) != int(sowner[s, slot]):
-                    # the ladder's threshold rule (preemption.go:353-357):
-                    # below min(evictor priority, maxPriorityThreshold+1)
-                    # the reclaim rode borrowWithinCohort
-                    thr = min(by_prio, int(bwc_thr1[qi_by]), NO_BWC_THRESHOLD)
-                    if bwc[qi_by] and int(sprio[s, slot]) < thr:
-                        reason = IN_COHORT_RECLAIM_WHILE_BORROWING
+                    if fair:
+                        # fair tournament victims from another CQ
+                        # (preemption.py _fair_preemptions)
+                        reason = IN_COHORT_FAIR_SHARING
                     else:
-                        reason = IN_COHORT_RECLAMATION
+                        # the ladder's threshold rule
+                        # (preemption.go:353-357): below min(evictor
+                        # priority, maxPriorityThreshold+1) the reclaim
+                        # rode borrowWithinCohort
+                        thr = min(
+                            by_prio, int(bwc_thr1[qi_by]), NO_BWC_THRESHOLD
+                        )
+                        if bwc[qi_by] and int(sprio[s, slot]) < thr:
+                            reason = IN_COHORT_RECLAIM_WHILE_BORROWING
+                        else:
+                            reason = IN_COHORT_RECLAMATION
             evictions.append(
                 DrainEviction(
                     victim=victim_wl, victim_cq=victim_cq, cycle=cyc,
@@ -810,6 +933,182 @@ def run_drain_preempt(
         preempted=preempted,
         evictions=evictions,
     )
+
+
+def run_drain_fair_preempt(
+    snapshot: Snapshot,
+    pending: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    max_candidates: int = 8,
+    max_cells: int = 4,
+    max_victims: int = 512,
+    max_victim_cells: int = 4,
+    max_fair_cells: int = 64,
+    timestamp_fn=None,
+    max_cycles: Optional[int] = None,
+    now: Optional[float] = None,
+    fs_strategies: Optional[Sequence[str]] = None,
+) -> PreemptDrainOutcome:
+    """Multi-cycle drain with FAIR-SHARING admission ordering AND
+    fair-sharing preemption — the production fair-cohort configuration
+    — in one device dispatch + one fetch
+    (ops/drain_kernel.solve_drain_fair_preempt).
+
+    The candidate pools are the classic preemption drain's (fair
+    sharing shares _find_candidates and the candidate ordering —
+    preemption.go:480-524, :591-618); on top of them each segment gets
+    LOCAL fair panels carrying its ACTIVE cell universe (every
+    flavor-resource with quota or usage anywhere in the root cohort
+    plus every queued entry's candidate cells — DRS aggregates over all
+    of them, fair_sharing.go:49-104). A segment whose universe exceeds
+    ``max_fair_cells`` routes its preempt-capable queues to
+    ``fallback``, like the host batcher's MAX_FAIR_CELLS cap
+    (core/preempt_batch.py). ``fs_strategies`` defaults to the
+    Preemptor's [LessThanOrEqualToFinalShare, LessThanInitialShare].
+    Victim attribution reasons are InClusterQueue / InCohortFairSharing
+    (preemption.py _fair_preemptions)."""
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.features import enabled as _feature_enabled
+    from kueue_tpu.core.preemption import (
+        LESS_THAN_OR_EQUAL_TO_FINAL_SHARE,
+        LESS_THAN_INITIAL_SHARE,
+    )
+    from kueue_tpu.ops.drain_kernel import (
+        DrainQueues,
+        FairSegPanels,
+        SegVictims,
+        solve_drain_fair_preempt_packed_jit,
+    )
+
+    plan = plan_drain(
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
+    )
+    parent_arr = snapshot.flat.parent
+    n_cq = snapshot.flat.n_cq
+    n_res = len(snapshot.resource_names)
+    res_of_fr = snapshot.resource_index.astype(np.int32)
+    universe_of: Dict[int, np.ndarray] = {}
+    seg_id_np = plan.queues_np["seg_id"]
+    qlen_np = plan.queues_np["qlen"]
+    queues_by_seg: Dict[int, List[int]] = {}
+    for qi in range(len(plan.cq_order)):
+        if int(seg_id_np[qi]) >= 0:
+            queues_by_seg.setdefault(int(seg_id_np[qi]), []).append(qi)
+
+    def seg_universe_bad(s: int, members) -> bool:
+        """Compute the segment's active cell universe; veto the segment
+        (dropping its searching queues to fallback) when it exceeds the
+        panel cap."""
+        nodes = set()
+        for r in members:
+            cur = int(r)
+            while cur >= 0:
+                nodes.add(cur)
+                cur = int(parent_arr[cur])
+        rows = np.asarray(sorted(nodes), dtype=np.int64)
+        active = (snapshot.nominal[rows] > 0).any(axis=0) | (
+            snapshot.local_usage[rows] > 0
+        ).any(axis=0)
+        for qi in queues_by_seg.get(s, ()):
+            cells_q = plan.queues_np["cells"][qi, : int(qlen_np[qi])]
+            cs = cells_q[cells_q >= 0]
+            if cs.size:
+                active[np.unique(cs)] = True
+        universe_of[s] = np.flatnonzero(active).astype(np.int32)
+        return len(universe_of[s]) > max_fair_cells
+
+    low = _lower_victim_pools(
+        snapshot, plan, timestamp_fn, now, max_victims, max_victim_cells,
+        max_cycles, extra_segment_bad=seg_universe_bad,
+    )
+    tree, paths_j = low.tree, low.paths_j
+    victims_np = low.victims_np
+    s_dim, v_cap, m_dim = low.s_dim, low.v_cap, low.m_dim
+
+    # ---- fair panels ----
+    good = {
+        s: u for s, u in universe_of.items() if len(u) <= max_fair_cells
+    }
+    cu = _bucket(max((len(u) for u in good.values()), default=1), minimum=2)
+    seg_cells = np.full((s_dim, cu), -1, dtype=np.int32)
+    parent_local = np.full((s_dim, m_dim), -1, dtype=np.int32)
+    depth_local = np.zeros((s_dim, m_dim), dtype=np.int32)
+    is_cq_local = np.zeros((s_dim, m_dim), dtype=bool)
+    node_valid = np.zeros((s_dim, m_dim), dtype=bool)
+    weight_local = np.full((s_dim, m_dim), 1000, dtype=np.int64)
+    res_of_cell = np.full((s_dim, cu), n_res, dtype=np.int32)
+    svqty_cu = np.zeros((s_dim, v_cap, cu), dtype=np.int64)
+
+    paths_np = np.asarray(paths_j)
+    depth_of, lendable, _ = _fair_lendable(snapshot, paths_np)
+    for s, local_id in low.local_ids.items():
+        u = good.get(s)
+        if u is None:
+            continue  # vetoed segment: panels stay inert
+        seg_cells[s, : len(u)] = u
+        res_of_cell[s, : len(u)] = res_of_fr[u]
+        root_depth = min(int(depth_of[g]) for g in local_id)
+        for gnode, li in local_id.items():
+            node_valid[s, li] = True
+            is_cq_local[s, li] = gnode < n_cq
+            parent_local[s, li] = local_id.get(int(parent_arr[gnode]), -1)
+            weight_local[s, li] = int(snapshot.weight_milli[gnode])
+            depth_local[s, li] = int(depth_of[gnode]) - root_depth
+        cell_pos = {int(j): ci for ci, j in enumerate(u)}
+        for (ss, slot), ws in low.victim_of.items():
+            if ss != s:
+                continue
+            for j in np.flatnonzero(ws.usage_vec):
+                ci = cell_pos.get(int(j))
+                if ci is None:  # usage cells are in the universe by
+                    raise AssertionError(  # construction
+                        f"victim cell {j} outside segment {s} universe"
+                    )
+                svqty_cu[s, slot, ci] = int(ws.usage_vec[j])
+
+    strategies = list(
+        fs_strategies
+        or [LESS_THAN_OR_EQUAL_TO_FINAL_SHARE, LESS_THAN_INITIAL_SHARE]
+    )
+    strategy1 = (
+        0 if strategies[0] == LESS_THAN_OR_EQUAL_TO_FINAL_SHARE else 1
+    )
+
+    queues_np = plan.queues_np
+    queues = DrainQueues(**{k: jnp.asarray(v) for k, v in queues_np.items()})
+    victims = SegVictims(**{k: jnp.asarray(v) for k, v in victims_np.items()})
+    fairp = FairSegPanels(
+        seg_cells=jnp.asarray(seg_cells),
+        parent_local=jnp.asarray(parent_local),
+        depth_local=jnp.asarray(depth_local),
+        is_cq_local=jnp.asarray(is_cq_local),
+        node_valid=jnp.asarray(node_valid),
+        weight_local=jnp.asarray(weight_local),
+        res_of_cell=jnp.asarray(res_of_cell),
+        svqty_cu=jnp.asarray(svqty_cu),
+    )
+    flat = np.asarray(
+        solve_drain_fair_preempt_packed_jit(
+            tree,
+            jnp.asarray(snapshot.local_usage),
+            queues,
+            victims,
+            fairp,
+            paths_j,
+            jnp.asarray(depth_of),
+            jnp.asarray(snapshot.weight_milli),
+            jnp.asarray(lendable),
+            jnp.asarray(res_of_fr),
+            n_segments=plan.n_segments,
+            n_steps=plan.n_steps,
+            max_cycles=plan.max_cycles,
+            n_res=n_res,
+            prio_tie=bool(_feature_enabled("PrioritySortingWithinCohort")),
+            strategy1=strategy1,
+            has_second=len(strategies) > 1,
+        )
+    )  # the single fetch
+    return _preempt_outcome(plan, low, flat, queues_np, fair=True)
 
 
 @dataclass
@@ -1150,14 +1449,15 @@ def run_drain(
     ``fair_sharing`` the cycle's admission order is the fair-sharing
     cohort tournament run ON DEVICE (ops/drain_kernel.solve_drain_fair)
     instead of the (borrowing, priority, FIFO) sort; preempt-capable
-    ClusterQueues route to ``fallback`` in fair mode (the fair victim
-    search stays on the per-cycle batched path), and ``mesh`` is not
-    supported (the tournament reduces over the whole cohort forest)."""
+    ClusterQueues route to ``fallback`` in fair mode (use
+    run_drain_fair_preempt for fair preemption in the drain). With
+    ``mesh`` the per-queue tensors (and the fair DRS chain work) are
+    sharded along ``wl``; node-space tensors stay replicated — separate
+    root cohorts are independent subproblems, so the tournament's
+    segment reductions parallelize and GSPMD resolves the node-space
+    scatters."""
     from kueue_tpu._jax import jnp
     from kueue_tpu.ops.drain_kernel import DrainQueues, solve_drain_packed_jit
-
-    if fair_sharing and mesh is not None:
-        raise ValueError("fair_sharing drains do not support mesh sharding")
 
     plan = plan_drain(
         snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
@@ -1215,35 +1515,37 @@ def run_drain(
     if fair_sharing:
         from kueue_tpu.features import enabled as _feature_enabled
         from kueue_tpu.ops.drain_kernel import solve_drain_fair_packed_jit
-        from kueue_tpu.ops.quota_np import potential_available_all_np
 
-        parent = snapshot.flat.parent
-        n_nodes = len(parent)
-        # paths already encode depth: valid path length - 1
-        depth_of = (
-            np.sum(np.asarray(paths) >= 0, axis=1) - 1
-        ).astype(np.int32)
-        # lendable depends on quota only: potentialAvailable of the
-        # PARENT, summed per resource (fair_sharing.go:90-104)
-        pot = potential_available_all_np(
-            parent, snapshot.flat.level_masks(), snapshot.subtree,
-            snapshot.guaranteed, snapshot.borrowing_limit,
-        )
         n_res = len(snapshot.resource_names)
-        lendable = np.zeros((n_nodes, n_res), dtype=np.int64)
-        parent_pot = pot[np.maximum(parent, 0)]
-        np.add.at(lendable.T, snapshot.resource_index, parent_pot.T)
-        lendable[parent < 0] = 0
+        depth_of, lendable, res_of_fr = _fair_lendable(
+            snapshot, np.asarray(paths)
+        )
+        if mesh is not None:
+            from kueue_tpu.parallel.sharded_solver import (
+                place_fair_drain_extras,
+            )
+
+            depth_in, weight_in, lendable_in, res_in = (
+                place_fair_drain_extras(
+                    mesh, depth_of, snapshot.weight_milli, lendable,
+                    res_of_fr,
+                )
+            )
+        else:
+            depth_in = jnp.asarray(depth_of)
+            weight_in = jnp.asarray(snapshot.weight_milli)
+            lendable_in = jnp.asarray(lendable)
+            res_in = jnp.asarray(res_of_fr)
         flat = np.asarray(
             solve_drain_fair_packed_jit(
                 tree,
                 usage_in,
                 queues,
                 paths,
-                jnp.asarray(depth_of),
-                jnp.asarray(snapshot.weight_milli),
-                jnp.asarray(lendable),
-                jnp.asarray(snapshot.resource_index.astype(np.int32)),
+                depth_in,
+                weight_in,
+                lendable_in,
+                res_in,
                 n_segments=plan.n_segments,
                 n_steps=plan.n_steps,
                 max_cycles=plan.max_cycles,

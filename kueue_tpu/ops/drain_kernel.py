@@ -2137,6 +2137,599 @@ def solve_drain_preempt(
     )
 
 
+class FairSegPanels(NamedTuple):
+    """Per-root-cohort local panels for the IN-DRAIN fair-sharing
+    victim search (the drain twin of core/preempt_batch.py's
+    lower_fair_preemption panels, shapes shared with SegVictims).
+
+    S segments, M local nodes, Cu panel cells (the segment's ACTIVE
+    cell universe: every flavor-resource with quota or usage anywhere
+    in the root cohort, plus every queued entry's candidate cells —
+    DRS aggregates borrowed/lendable per RESOURCE over all of them,
+    fair_sharing.go:49-104), V pool slots.
+
+    seg_cells:    int32[S,Cu] — global FR cell ids (-1 pads).
+    parent_local: int32[S,M] — local parent (-1 root / pads).
+    depth_local:  int32[S,M] — local depth (segment root = 0).
+    is_cq_local:  bool[S,M]; node_valid: bool[S,M].
+    weight_local: int64[S,M] — fairSharing weight_milli per node.
+    res_of_cell:  int32[S,Cu] — panel cell -> resource bucket; pads
+                  point at the inert extra bucket (n_res).
+    svqty_cu:     int64[S,V,Cu] — pool-slot usage at PANEL cell
+                  positions (part A static; part B zero until the
+                  drain admits the entry and fills the slot).
+    """
+
+    seg_cells: jnp.ndarray
+    parent_local: jnp.ndarray
+    depth_local: jnp.ndarray
+    is_cq_local: jnp.ndarray
+    node_valid: jnp.ndarray
+    weight_local: jnp.ndarray
+    res_of_cell: jnp.ndarray
+    svqty_cu: jnp.ndarray
+
+
+def solve_drain_fair_preempt(
+    tree: QuotaTree,
+    local_usage: jnp.ndarray,  # int64[N, FR]
+    queues: DrainQueues,
+    victims: SegVictims,
+    fair: FairSegPanels,
+    paths: jnp.ndarray,  # int32[N, D+1]
+    depth_of: jnp.ndarray,  # int32[N] tree depth (roots 0)
+    weight: jnp.ndarray,  # int64[N] fairSharing weight_milli
+    lendable: jnp.ndarray,  # int64[N, R] (quota-only, precomputed)
+    res_of_fr: jnp.ndarray,  # int32[FR] cell -> resource bucket
+    n_segments: int,
+    n_steps: int,
+    max_cycles: int,
+    n_res: int,
+    prio_tie: bool,
+    strategy1: int,
+    has_second: bool,
+) -> PreemptDrainResult:
+    """Multi-cycle drain with FAIR-SHARING admission ordering AND
+    fair-sharing preemption, fully on the device — the production
+    fair-cohort configuration (keps/1714-fair-sharing) in one dispatch.
+
+    Per cycle, matching the host scheduler with fair_sharing enabled:
+
+    - phase 1: flavor classification against cycle-start usage, then
+      the fair victim TOURNAMENT (preemption.go:372-463 — highest-DRS
+      subtree walk, almost-LCA strategy gates, both strategies) for
+      every preempt-classified head, vmapped over heads via
+      fair_preempt_kernel._solve_one_fair on per-segment local panels
+      constructed in-kernel from live usage + the live candidate pool
+      (part-A snapshot victims and part-B drain-admitted entries);
+    - phase 2: admissions pop via the in-kernel fair-sharing cohort
+      tournament (one pop per root per step, DRS re-evaluated against
+      usage as mutated by earlier pops). A popped preempt head with a
+      victim set is checked for target overlap with this cycle's
+      earlier evictions, then re-checked for fit with EVERY accepted
+      victim removed (the host's non-incremental fits-after-removals:
+      the fair iterator reads usage with victims still present, so
+      removals live only inside the fit check); on success it charges
+      its usage (scheduler.go:211-292) and its victims are evicted at
+      cycle end while the head retries next cycle — exactly the host's
+      PENDING_PREEMPTION round trip compressed to the cycle boundary;
+    - a popped preempt head with NO victim set reserves capacity for
+      the rest of the cycle unless reclaimWithinCohort=Any, then parks;
+      evictions reactivate the root cohort's parked entries.
+    """
+    from kueue_tpu.ops.assign_kernel import potential_available_all
+    from kueue_tpu.ops.fair_preempt_kernel import FairProblem, _solve_one_fair
+
+    max_depth = tree.max_depth
+    subtree, guaranteed = subtree_quota(tree)
+    potential = potential_available_all(tree, subtree, guaranteed)
+
+    q, l, pmax, k, c = queues.cells.shape
+    s_dim, v, cv = victims.scells.shape
+    m_dim = victims.seg_nodes.shape[1]
+    cu = fair.seg_cells.shape[1]
+    dmax = victims.lpaths.shape[2]
+    q_idx = jnp.arange(q)
+    l_idx = jnp.arange(l)
+    sq = jnp.maximum(queues.seg_id, 0)  # [Q]
+    cq = jnp.maximum(queues.cq_rows, 0)
+    can_search = victims.same_enabled | victims.reclaim_enabled
+    seg_rows = jnp.maximum(victims.seg_nodes, 0)  # [S, M]
+    n_nodes = tree.parent.shape[0]
+    paths_q = paths[cq]  # [Q, D+1]
+    pwb_fair = victims.bwc | victims.reclaim_enabled
+
+    avail_v = jax.vmap(
+        _avail_along_path, in_axes=(0, 0, None, None, None, None, None)
+    )
+    fair_search_v = jax.vmap(
+        lambda row: _solve_one_fair(
+            row, dmax - 1, v, m_dim, n_res + 1, strategy1, has_second
+        )
+    )
+
+    # static per-queue panel gathers
+    segcells_q = fair.seg_cells[sq]  # [Q, Cu]
+    cu_valid = segcells_q >= 0
+    scc = jnp.maximum(segcells_q, 0)
+    lpaths_qs = victims.lpaths[sq]  # [Q, M, D+1]
+    parent_loc_q = fair.parent_local[sq]
+    depth_loc_q = fair.depth_local[sq]
+    is_cq_q = fair.is_cq_local[sq]
+    nvalid_q = fair.node_valid[sq]
+    weight_q = fair.weight_local[sq]
+    res_of_cu_q = fair.res_of_cell[sq]
+    hl = jnp.maximum(victims.hlocal, 0)
+    hpath_l = lpaths_qs[q_idx, hl]  # [Q, D+1] local head path
+    anc_of_head_q = jnp.any(
+        (hpath_l[:, 1:, None] == jnp.arange(m_dim)[None, None, :])
+        & (hpath_l[:, 1:, None] >= 0),
+        axis=1,
+    )  # [Q, M]
+
+    def cycle_body(state):
+        (local, status, g_start, retries, stuck, no_prog, adm_k,
+         adm_cycle, pcells, pqty, pq_cu, pvalid, vevicted, evict_cycle,
+         evict_by, cycle) = state
+
+        # head of each queue = first pending entry in heap order
+        entry_pending = status == 0  # [Q,L]
+        pos_cand = jnp.where(entry_pending, l_idx[None, :], l)
+        cur_raw = jnp.min(pos_cand, axis=1)  # [Q]
+        active = (cur_raw < l) & (cur_raw < queues.qlen)
+        cur = jnp.minimum(cur_raw, l - 1)
+
+        prio = queues.priority[q_idx, cur]
+        ts = queues.timestamp[q_idx, cur]
+
+        # ---- per-queue views of the segment candidate pool ----
+        live_q = (pvalid & ~vevicted)[sq]  # [Q,V]
+        sprio_q = victims.sprio[sq]
+        sts_q = victims.sts[sq]
+        olocal_q = jnp.maximum(victims.sowner_local[sq], 0)  # [Q,V]
+        slot_ok = victims.sowner[sq] >= 0  # [Q,V]
+        same_q = slot_ok & (
+            victims.sowner_local[sq] == victims.hlocal[:, None]
+        )
+
+        # same-CQ victim eligibility (preemption.go:480-524 — identical
+        # for fair sharing: _find_candidates is shared)
+        lower = sprio_q < prio[:, None]
+        newer_eq = (
+            victims.same_prio_ok[:, None]
+            & (sprio_q == prio[:, None])
+            & (ts[:, None] < sts_q)
+        )
+        elig_same = (
+            live_q & same_q & victims.same_enabled[:, None]
+            & (lower | newer_eq)
+        )
+
+        usage0 = usage_tree(tree, guaranteed, local)
+        pcells_q = pcells[sq]  # [Q,V,Cv]
+        pqty_q = pqty[sq]
+        (is_fit, is_pre, pend_flavors, head_borrow, rep_k, walk_next,
+         cells_eff, qty_eff, need_pre) = _nominate_multi(
+            tree, subtree, guaranteed, local, usage0, queues, q_idx, cur,
+            active, g_start, potential, vcells_q=pcells_q,
+            elig_v=elig_same, pwb=pwb_fair,
+        )
+        nofit = ~(is_fit | is_pre)
+        cell_need = (cells_eff >= 0) & (qty_eff > 0)  # [Q,C']
+        cells_c = jnp.maximum(cells_eff, 0)
+
+        # ---- candidate eligibility (shared with classic) ----
+        match = pcells_q[:, :, :, None] == cells_c[:, None, None, :]
+        match = match & (pcells_q >= 0)[:, :, :, None]
+        vq_at = jnp.sum(
+            jnp.where(match, pqty_q[:, :, :, None], 0), axis=2
+        )  # [Q, V, C']
+        uses = jnp.any(
+            vq_at * need_pre[:, None, :].astype(jnp.int64) > 0, axis=2
+        )
+        rows_q = seg_rows[sq]  # [Q, M]
+        lf0_sub = local[rows_q[:, :, None], cells_c[:, None, :]]
+        nom_sub = tree.nominal[rows_q[:, :, None], cells_c[:, None, :]]
+        borrow_by_local = jnp.any(
+            (lf0_sub > nom_sub) & need_pre[:, None, :], axis=2
+        )  # [Q, M]
+        owner_borrow0 = jnp.take_along_axis(borrow_by_local, olocal_q, axis=1)
+        oth_prio_ok = (~victims.only_lower[:, None]) | lower
+        elig_other = (
+            live_q & ~same_q & slot_ok
+            & victims.reclaim_enabled[:, None]
+            & oth_prio_ok & owner_borrow0
+        )
+        elig = uses & (elig_same | elig_other)
+
+        # ---- fair victim tournament, vmapped over heads ----
+        enabled1 = active & is_pre & can_search
+        ord_of = victims.perm  # [Q,V] slot ids in candidate order
+
+        def to_ord(x):
+            return jnp.take_along_axis(x, ord_of, axis=1)
+
+        # head request mapped onto panel cell positions
+        match_h = (
+            (cells_c[:, :, None] == scc[:, None, :])
+            & cell_need[:, :, None]
+            & cu_valid[:, None, :]
+        )  # [Q, C', Cu]
+        need_qty_cu = jnp.sum(
+            jnp.where(match_h, qty_eff[:, :, None], 0), axis=1
+        )  # [Q, Cu]
+
+        # live usage panels (pad cells/rows zeroed so DRS buckets stay
+        # inert — the host lowering zero-fills the same way)
+        pmask = (cu_valid[:, None, :] & nvalid_q[:, :, None])
+        pu0 = jnp.where(
+            pmask, usage0[rows_q[:, :, None], scc[:, None, :]], 0
+        )  # [Q, M, Cu]
+        psub = jnp.where(pmask, subtree[rows_q[:, :, None], scc[:, None, :]], 0)
+        pg = jnp.where(
+            pmask, guaranteed[rows_q[:, :, None], scc[:, None, :]], 0
+        )
+        pbl = jnp.where(
+            pmask,
+            tree.borrowing_limit[rows_q[:, :, None], scc[:, None, :]],
+            NO_LIMIT,
+        )
+        # the head's usage is part of the simulated state
+        # (preemption.go:394-395 AddUsage before DRS)
+        from kueue_tpu.ops.fair_preempt_kernel import _bubble as _fp_bubble
+
+        pu0 = jax.vmap(
+            lambda pths, hr, qty_row, u, g: _fp_bubble(
+                pths, hr, qty_row, u, g, dmax - 1, True
+            )
+        )(lpaths_qs, victims.hlocal, need_qty_cu, pu0, pg)
+
+        pq_cu_q = pq_cu[sq]  # [Q, V, Cu]
+        problem = FairProblem(
+            paths=lpaths_qs,
+            usage0=pu0,
+            subtree_q=psub,
+            guaranteed=pg,
+            borrow_lim=pbl,
+            weight=weight_q,
+            parent_loc=parent_loc_q,
+            depth_s=depth_loc_q,
+            is_cq=is_cq_q,
+            svalid=nvalid_q,
+            anc_of_head=anc_of_head_q,
+            hrow=victims.hlocal,
+            need_qty=need_qty_cu,
+            res_of=res_of_cu_q,
+            crow=to_ord(olocal_q).astype(jnp.int32),
+            cqty=jnp.take_along_axis(pq_cu_q, ord_of[:, :, None], axis=1),
+            cvalid=to_ord(live_q & elig) & enabled1[:, None],
+            row_valid=enabled1,
+        )
+        targets_ord, search_fits = fair_search_v(problem)  # [Q,V], [Q]
+        psuccess = enabled1 & search_fits
+        # ord space -> slot space
+        qq2 = jnp.broadcast_to(q_idx[:, None], ord_of.shape)
+        targets = (
+            jnp.zeros((q, v), dtype=bool)
+            .at[qq2, ord_of]
+            .max(targets_ord & psuccess[:, None])
+        )  # [Q, V] slot space
+
+        # ---- phase 2: the admission tournament with dispositions ----
+        res_of_q = jnp.where(
+            cell_need, res_of_fr[cells_c], n_res
+        ).astype(jnp.int32)
+        participants = active & ~nofit & (queues.cq_rows >= 0)
+        owner_rows_b = jnp.broadcast_to(
+            jnp.maximum(victims.sowner, 0)[:, :, None], pcells.shape
+        )
+        pc_cols = jnp.maximum(pcells, 0)
+
+        def step(carry, s):
+            usage, leaf_c, remaining, ev_now, ev_by_now = carry
+            nn = jnp.broadcast_to(jnp.arange(n_nodes)[:, None], usage.shape)
+            bb = (
+                jnp.zeros((n_nodes, n_res + 1), dtype=jnp.int64)
+                .at[nn, res_of_fr[None, :].repeat(n_nodes, axis=0)]
+                .add(jnp.maximum(0, usage - subtree))[:, :n_res]
+            )
+            chain = _fair_chain(
+                usage, bb, paths_q, cells_eff, qty_eff, subtree,
+                guaranteed, lendable, weight, tree.parent, res_of_q,
+                n_res, max_depth,
+            )
+            win = _fair_tournament(
+                chain, remaining, paths_q, queues.cq_rows, depth_of,
+                tree.parent, prio, ts, n_nodes, max_depth, prio_tie,
+            )
+            own_t = targets & (win & psuccess)[:, None]  # [Q,V]
+            overlap = jnp.any(own_t & ev_now[sq], axis=1)
+            do_pre = win & is_pre & psuccess & ~overlap
+            # winners are one per root cohort: scatter own targets to
+            # segment space without collision
+            sq_w = jnp.where(do_pre, sq, s_dim)
+            own_t_seg = (
+                jnp.zeros((s_dim + 1, v), dtype=bool)
+                .at[sq_w]
+                .max(own_t)[:s_dim]
+            )
+            # fits with EVERY accepted victim removed (the host's
+            # non-incremental fits-after-removals); each winner's path
+            # only sees its own segment's removals, so applying all
+            # segments at once is exact
+            rm_all = ev_now | own_t_seg
+            rm_qty = jnp.where(rm_all[:, :, None] & (pcells >= 0), pqty, 0)
+            leaf_fits = leaf_c.at[
+                owner_rows_b.reshape(-1), pc_cols.reshape(-1)
+            ].add(-rm_qty.reshape(-1))
+            usage_fits = usage_tree(tree, guaranteed, leaf_fits)
+            avail = avail_v(
+                paths_q, cells_eff, usage_fits, subtree, guaranteed,
+                tree.borrowing_limit, max_depth,
+            )
+            cell_valid = cell_need & win[:, None]
+            fits = jnp.all(
+                jnp.where(cell_valid, avail >= qty_eff, True), axis=1
+            )
+            admit = win & is_fit & fits
+            pre_ok = do_pre & fits
+            reserve = win & is_pre & ~psuccess & queues.no_reclaim
+            nominal_c = tree.nominal[cq[:, None], cells_c]
+            bl_c = tree.borrowing_limit[cq[:, None], cells_c]
+            leaf_usage_c = leaf_c[cq[:, None], cells_c]
+            borrow_cap = jnp.where(
+                bl_c < NO_LIMIT,
+                jnp.minimum(qty_eff, nominal_c + bl_c - leaf_usage_c),
+                qty_eff,
+            )
+            nominal_cap = jnp.maximum(
+                0, jnp.minimum(qty_eff, nominal_c - leaf_usage_c)
+            )
+            reserve_qty = jnp.where(
+                head_borrow[:, None], borrow_cap, nominal_cap
+            )
+            # charge admitted heads, successful preemptors (AddUsage
+            # runs for both — scheduler.go:211-292) and reservations;
+            # victims stay present in the tournament's usage
+            delta = jnp.where(
+                cell_valid & (admit | pre_ok)[:, None],
+                qty_eff,
+                jnp.where(cell_valid & reserve[:, None], reserve_qty, 0),
+            )
+            leaf_c = leaf_c.at[cq[:, None], cells_c].add(
+                jnp.where(cell_valid, delta, 0)
+            )
+            # winners' paths are disjoint: per-level scatters can't
+            # collide
+            d = delta
+            for dep in range(0, max_depth + 1):
+                node = jnp.maximum(paths_q[:, dep], 0)
+                node_valid = (paths_q[:, dep] >= 0)[:, None]
+                gg = guaranteed[node[:, None], cells_c]
+                old = usage[node[:, None], cells_c]
+                new = old + d
+                usage = usage.at[node[:, None], cells_c].add(
+                    jnp.where(node_valid, d, 0)
+                )
+                d = jnp.where(
+                    node_valid,
+                    jnp.maximum(0, new - gg) - jnp.maximum(0, old - gg),
+                    d,
+                )
+            # only commit the winner's targets when ITS fit held; at
+            # most one head ever evicts a given slot (live mask +
+            # overlap guard), so max over the -1 init records exactly
+            # the evicting queue's index
+            sq_ok = jnp.where(pre_ok, sq, s_dim)
+            ev_commit = (
+                jnp.zeros((s_dim + 1, v), dtype=bool)
+                .at[sq_ok]
+                .max(own_t)[:s_dim]
+            )
+            ev_now = ev_now | ev_commit
+            ev_by_now = ev_by_now.at[sq_ok].max(
+                jnp.where(
+                    own_t & pre_ok[:, None],
+                    q_idx[:, None].astype(jnp.int32),
+                    -1,
+                ),
+                mode="drop",
+            )
+            remaining = remaining & ~win
+            return (usage, leaf_c, remaining, ev_now, ev_by_now), (
+                admit, pre_ok,
+            )
+
+        init_ev_by = jnp.full((s_dim, v), -1, dtype=jnp.int32)
+        (_, _, _, ev_now_f, ev_by_f), (admit_sn, pre_ok_sn) = lax.scan(
+            step,
+            (
+                usage0,
+                local,
+                participants,
+                jnp.zeros((s_dim, v), dtype=bool),
+                init_ev_by,
+            ),
+            jnp.arange(n_steps),
+        )
+        admitted = jnp.any(admit_sn, axis=0)  # [Q]
+        preempt_ok = jnp.any(pre_ok_sn, axis=0)
+
+        # ---- cycle end: leaf usage ----
+        add = jnp.where(cell_need & admitted[:, None], qty_eff, 0)
+        local = local.at[cq[:, None], cells_c].add(add)
+        newly = ev_now_f  # [S, V] this cycle's evictions
+        ev_qty = jnp.where(newly[:, :, None] & (pcells >= 0), pqty, 0)
+        local = local.at[
+            owner_rows_b.reshape(-1), pc_cols.reshape(-1)
+        ].add(-ev_qty.reshape(-1))
+        vevicted = vevicted | newly
+        evict_cycle = jnp.where(newly, cycle, evict_cycle)
+        evict_by = jnp.where(newly, ev_by_f, evict_by)
+
+        # admitted entries fill their part-B pool slot
+        slot_w = victims.entry_slot[q_idx, cur]  # [Q]
+        fill = admitted & active & (slot_w >= 0)
+        sq_w2 = jnp.where(fill, sq, s_dim)
+        sl_w = jnp.maximum(slot_w, 0)
+        pad = cv - cells_eff.shape[1]
+        mc_w = jnp.pad(cells_eff, ((0, 0), (0, pad)), constant_values=-1)
+        mq_w = jnp.pad(qty_eff, ((0, 0), (0, pad)))
+        pcells = pcells.at[sq_w2, sl_w].set(
+            mc_w.astype(pcells.dtype), mode="drop"
+        )
+        pqty = pqty.at[sq_w2, sl_w].set(mq_w, mode="drop")
+        pq_cu = pq_cu.at[sq_w2, sl_w].set(need_qty_cu, mode="drop")
+        pvalid = pvalid.at[sq_w2, sl_w].max(fill, mode="drop")
+
+        # ---- queue motion (as solve_drain_preempt) ----
+        adm_k = adm_k.at[q_idx, cur].set(
+            jnp.where(
+                (admitted & active)[:, None], rep_k, adm_k[q_idx, cur]
+            )
+        )
+        adm_cycle = adm_cycle.at[q_idx, cur].set(
+            jnp.where(admitted & active, cycle, adm_cycle[q_idx, cur])
+        )
+        pre_skipped = psuccess & ~preempt_ok
+        over_budget = retries >= queues.retry_cap
+        stuck = stuck | (
+            active & (~is_fit) & ~preempt_ok & ~pre_skipped & pend_flavors
+            & over_budget
+        )
+        retrying = (
+            active & (~is_fit) & ~preempt_ok & ~pre_skipped & pend_flavors
+            & ~stuck
+        )
+        new_entry_status = jnp.where(
+            admitted,
+            2,
+            jnp.where(
+                active
+                & (~is_fit)
+                & ~preempt_ok
+                & ~pre_skipped
+                & ~pend_flavors,
+                1,
+                0,
+            ),
+        )
+        head_advanced = active & (new_entry_status != 0)
+        stuck = stuck & ~head_advanced
+        retries = jnp.where(
+            head_advanced | ~active,
+            0,
+            jnp.where(retrying, retries + 1, retries),
+        )
+        any_prog = jnp.any(head_advanced) | jnp.any(newly)
+        no_prog = jnp.where(any_prog, 0, no_prog + 1)
+        stuck = stuck | (
+            (no_prog >= 2 * jnp.max(queues.retry_cap))
+            & active
+            & ~head_advanced
+        )
+        status = status.at[q_idx, cur].set(
+            jnp.where(active, new_entry_status, status[q_idx, cur])
+        )
+        seg_released = jnp.any(newly, axis=1)  # [S]
+        q_released = seg_released[sq] & (queues.seg_id >= 0)
+        status = jnp.where(q_released[:, None] & (status == 1), 0, status)
+
+        lost = active & is_fit & (~admitted)
+        walk_reset = (
+            admitted | (active & (~is_fit) & ~retrying) | preempt_ok
+        )
+        g_start = jnp.where(
+            walk_reset[:, None, None],
+            0,
+            jnp.where((lost | retrying)[:, None, None], walk_next, g_start),
+        ).astype(jnp.int32)
+        return (
+            local, status, g_start, retries, stuck, no_prog, adm_k,
+            adm_cycle, pcells, pqty, pq_cu, pvalid, vevicted, evict_cycle,
+            evict_by, cycle + 1,
+        )
+
+    def cond(state):
+        status = state[1]
+        stuck = state[4]
+        cycle = state[15]
+        has_pending = jnp.any(
+            (status == 0)
+            & (l_idx[None, :] < queues.qlen[:, None])
+            & ~stuck[:, None]
+        )
+        return has_pending & (cycle < max_cycles)
+
+    g = queues.gidx.shape[-1]
+    init = (
+        local_usage,
+        jnp.zeros((q, l), dtype=jnp.int32),
+        jnp.zeros((q, pmax, g), dtype=jnp.int32),
+        jnp.zeros(q, dtype=jnp.int32),
+        jnp.zeros(q, dtype=bool),
+        jnp.int32(0),
+        jnp.full((q, l, pmax), -1, dtype=jnp.int32),
+        jnp.full((q, l), -1, dtype=jnp.int32),
+        victims.scells,
+        victims.sqty,
+        fair.svqty_cu,
+        victims.svalid0,
+        jnp.zeros((s_dim, v), dtype=bool),
+        jnp.full((s_dim, v), -1, dtype=jnp.int32),
+        jnp.full((s_dim, v), -1, dtype=jnp.int32),
+        jnp.int32(0),
+    )
+    (local_f, status_f, _, _, stuck_f, _, adm_k, adm_cycle, _, _, _, _,
+     vevicted, evict_cycle, evict_by, cycles) = lax.while_loop(
+        cond, cycle_body, init
+    )
+    return PreemptDrainResult(
+        status=status_f,
+        admitted_k=adm_k,
+        admitted_cycle=adm_cycle,
+        evicted=vevicted,
+        evicted_cycle=evict_cycle,
+        evicted_by=evict_by,
+        cycles=cycles,
+        local_usage=local_f,
+        stuck=stuck_f,
+    )
+
+
+def _solve_drain_fair_preempt_packed(
+    tree, local_usage, queues, victims, fair, paths, depth_of, weight,
+    lendable, res_of_fr, n_segments: int, n_steps: int, max_cycles: int,
+    n_res: int, prio_tie: bool, strategy1: int, has_second: bool,
+):
+    r = solve_drain_fair_preempt(
+        tree, local_usage, queues, victims, fair, paths, depth_of,
+        weight, lendable, res_of_fr, n_segments, n_steps, max_cycles,
+        n_res, prio_tie, strategy1, has_second,
+    )
+    return jnp.concatenate(
+        [
+            r.status.reshape(-1),
+            r.admitted_k.reshape(-1),
+            r.admitted_cycle.reshape(-1),
+            r.evicted.astype(jnp.int32).reshape(-1),
+            r.evicted_cycle.reshape(-1),
+            r.evicted_by.reshape(-1),
+            r.stuck.astype(jnp.int32),
+            r.cycles[None],
+        ]
+    )
+
+
+solve_drain_fair_preempt_packed_jit = jax.jit(
+    _solve_drain_fair_preempt_packed,
+    static_argnames=(
+        "n_segments", "n_steps", "max_cycles", "n_res", "prio_tie",
+        "strategy1", "has_second",
+    ),
+)
+
+
 def _solve_drain_preempt_packed(
     tree, local_usage, queues, victims, paths,
     n_segments: int, n_steps: int, max_cycles: int, search_width: int,

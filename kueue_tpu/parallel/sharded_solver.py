@@ -287,6 +287,19 @@ def place_preempt_drain_inputs(mesh, tree, local_usage, queues, victims, paths):
     return tree_d, local_d, queues_d, jax.device_put(victims, v_specs), paths_d
 
 
+def place_fair_drain_extras(mesh, depth_of, weight, lendable, res_of_fr):
+    """device_put the fair drain's node-space extras replicated (the
+    tournament reduces over the whole cohort forest on every shard;
+    separate root cohorts are independent, so the Q-sharded chain work
+    parallelizes and GSPMD resolves the node-space scatters)."""
+    return (
+        jax.device_put(depth_of, _sh(mesh, None)),
+        jax.device_put(weight, _sh(mesh, None)),
+        jax.device_put(lendable, _sh(mesh, None, None)),
+        jax.device_put(res_of_fr, _sh(mesh, None)),
+    )
+
+
 def place_fair_problem(mesh, problem):
     """device_put a FairProblem with every head row sharded along
     ``wl`` — the fair tournament search is embarrassingly parallel over

@@ -263,6 +263,122 @@ class TestBulkDrainService:
             results.append(final_state(rt))
         assert results[0] == results[1]
 
+    def test_fair_preempting_backlog_through_bulk_path(self):
+        """Fair cohorts WITH preemption (the production fair config) go
+        through run_drain_fair_preempt in ONE dispatch: preempt-capable
+        CQs stay in the drain (no wholesale fallback), victims carry
+        fair-sharing reasons, and the usual state invariants hold."""
+        from kueue_tpu.models.cluster_queue import FairSharing
+        from kueue_tpu.resources import FlavorResource
+
+        clock = FakeClock(start=1000.0)
+        rt = ClusterRuntime(
+            clock=clock, fair_sharing=True, bulk_drain_threshold=64
+        )
+        rt.add_flavor(ResourceFlavor(name="default"))
+        weights = [500, 1000, 2000]
+        prem = Preemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+        )
+        for i in range(N_CQ):
+            rt.add_cluster_queue(
+                ClusterQueue(
+                    name=f"cq-{i}", cohort=f"co-{i // 4}",
+                    namespace_selector={},
+                    resource_groups=(
+                        ResourceGroup(
+                            ("cpu",),
+                            (FlavorQuotas.build("default", {"cpu": "16"}),),
+                        ),
+                    ),
+                    fair_sharing=FairSharing(
+                        weight_milli=weights[i % len(weights)]
+                    ),
+                    preemption=prem,
+                )
+            )
+            rt.add_local_queue(
+                LocalQueue(
+                    namespace="ns", name=f"lq-{i}", cluster_queue=f"cq-{i}"
+                )
+            )
+        seed_victims(rt)
+        seed_backlog(rt, wl_per_cq=20, priority_base=50)
+        rt.run_until_idle(max_iterations=300)
+        traces = drain_traces(rt)
+        assert traces, "fair-preempt bulk path never dispatched"
+        # preempt-capable fair CQs stayed in the drain: the dispatch saw
+        # the whole representable backlog, and preemptions came from it
+        assert traces[0].heads == N_CQ * 20
+        assert any(t.preempting for t in traces)
+        reasons = {
+            k: wl.conditions[WorkloadConditionType.PREEMPTED].reason
+            for k, wl in rt.workloads.items()
+            if wl.conditions.get(WorkloadConditionType.PREEMPTED) is not None
+            and wl.conditions[WorkloadConditionType.PREEMPTED].status
+        }
+        assert reasons and set(reasons.values()) <= {
+            "InClusterQueue",
+            "InCohortFairSharing",
+        }
+        # cache consistency: usage == sum of admitted requests
+        fr = FlavorResource("default", "cpu")
+        for i in range(N_CQ):
+            cached = rt.cache.cluster_queues[f"cq-{i}"]
+            want = sum(
+                psa.resource_usage.get("cpu", 0)
+                for wl in cached.workloads.values()
+                for psa in wl.admission.pod_set_assignments
+            )
+            got = rt.cache.usage_for(f"cq-{i}").get(fr, 0)
+            assert got == want, f"cq-{i}: usage {got} != admitted {want}"
+        admitted, _evicted, parked = final_state(rt)
+        in_heap = {
+            wl.key
+            for pq in rt.queues.cluster_queues.values()
+            for wl in pq.snapshot_active_sorted()
+        }
+        for k in rt.workloads:
+            assert (
+                k in admitted or k in parked or k in in_heap
+            ), f"workload {k} vanished from every surface"
+
+    def test_no_progress_drain_falls_through_to_cycle(self):
+        """A drain that decides NOTHING (all heads fell back) must not
+        satisfy run_until_idle's iteration — the cycle loop runs and the
+        backlog still gets scheduled (regression: an all-fallback drain
+        used to break the loop with everything pending)."""
+        rt, _ = build_rt(bulk=True, threshold=64)
+        seed_backlog(rt, wl_per_cq=20)
+
+        import kueue_tpu.core.drain as drain_mod
+        from kueue_tpu.core.drain import DrainOutcome
+
+        orig = drain_mod.run_drain
+
+        def all_fallback_drain(snapshot, pending, flavors, **kw):
+            return DrainOutcome(
+                admitted=[], parked=[], fallback=list(pending), cycles=0
+            )
+
+        # bulk_drain imports run_drain from the module at call time
+        drain_mod.run_drain = all_fallback_drain
+        try:
+            rt.run_until_idle(max_iterations=300)
+        finally:
+            drain_mod.run_drain = orig
+        admitted, _, parked = final_state(rt)
+        assert admitted, "cycle loop never ran after a no-progress drain"
+        # every workload reached a decision surface
+        in_heap = {
+            wl.key
+            for pq in rt.queues.cluster_queues.values()
+            for wl in pq.snapshot_active_sorted()
+        }
+        for k in rt.workloads:
+            assert k in admitted or k in parked or k in in_heap
+
     def test_gates(self):
         # below threshold: no drain
         rt, _ = build_rt(bulk=True, threshold=10_000)

@@ -1740,6 +1740,252 @@ class TestDrainFairSharing:
         assert d_parked == h_parked
 
 
+def host_fair_preempt_drain_trace(spec, fs_strategies=None):
+    """Host truth under fair-sharing ordering AND fair-sharing
+    preemption: scheduler cycles with evictions applied between cycles
+    (the reconciler round-trip compressed to the cycle boundary)."""
+    sched, mgr, cache, _ = build_preempt_env(spec)
+    sched.fair_sharing = True
+    sched.preemptor.enable_fair_sharing = True
+    if fs_strategies is not None:
+        sched.preemptor.fs_strategies = list(fs_strategies)
+    admitted, evicted = {}, set()
+    for _ in range(300):
+        progressed = False
+        if any(pq.pending_active() > 0 for pq in mgr.cluster_queues.values()):
+            progressed = True
+        res = sched.schedule()
+        for e in res.admitted:
+            psa = e.workload.admission.pod_set_assignments[0]
+            admitted[e.workload.name] = dict(psa.flavors)
+        victims = []
+        for e in res.preempting:
+            for t in e.preemption_targets:
+                victims.append(t.workload.workload)
+        for wl in victims:
+            if wl.name in evicted:
+                continue
+            evicted.add(wl.name)
+            cq_name = wl.admission.cluster_queue
+            cache.delete_workload(wl)
+            mgr.queue_associated_inadmissible_workloads_after(cq_name)
+            progressed = True
+        if not progressed:
+            break
+    parked = {
+        wl.name
+        for pq in mgr.cluster_queues.values()
+        for wl in list(pq.inadmissible.values()) + list(pq.heap.items())
+    }
+    return admitted, evicted, parked
+
+
+def device_fair_preempt_drain_trace(spec, fs_strategies=None, **kw):
+    from kueue_tpu.core.drain import run_drain_fair_preempt
+
+    sched, mgr, cache, _ = build_preempt_env(spec)
+    pending = []
+    for cq_name, pq in mgr.cluster_queues.items():
+        for wl in pq.snapshot_sorted():
+            pending.append((wl, cq_name))
+    snapshot = take_snapshot(cache)
+    outcome = run_drain_fair_preempt(
+        snapshot,
+        pending,
+        cache.flavors,
+        timestamp_fn=lambda wl: queue_order_timestamp(wl, mgr._ts_policy),
+        fs_strategies=fs_strategies,
+        **kw,
+    )
+    admitted = {wl.name: flavors for wl, _, flavors, _ in outcome.admitted}
+    evicted = {wl.name for wl, _, _ in outcome.preempted}
+    parked = {wl.name for wl, _ in outcome.parked}
+    return admitted, evicted, parked, outcome
+
+
+def fair_preempt_spec(
+    seed, n_cohorts=2, cqs_per_cohort=3, victims_per_cq=3, workloads_per_cq=3
+):
+    """Random fair cohorts WITH preemption enabled — borrowing victims
+    saturate shared capacity, pending backlogs need the fair victim
+    tournament to start."""
+    from kueue_tpu.models.cluster_queue import Preemption
+    from kueue_tpu.models.constants import (
+        PreemptionPolicy,
+        ReclaimWithinCohortPolicy,
+    )
+
+    rng = np.random.default_rng(seed + 91000)
+    flavors = ["fl-0"]
+    cqs, workloads, victims = [], [], []
+    weights = [500, 1000, 1000, 2000]
+    wcq_opts = [
+        PreemptionPolicy.NEVER,
+        PreemptionPolicy.LOWER_PRIORITY,
+        PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY,
+    ]
+    rwc_opts = [
+        ReclaimWithinCohortPolicy.NEVER,
+        ReclaimWithinCohortPolicy.LOWER_PRIORITY,
+        ReclaimWithinCohortPolicy.ANY,
+    ]
+    t = 0.0
+    for ci in range(n_cohorts):
+        for qi in range(cqs_per_cohort):
+            name = f"cq-{ci}-{qi}"
+            quota = int(rng.integers(4, 10))
+            cqs.append(
+                {
+                    "name": name,
+                    "cohort": f"cohort-{ci}",
+                    "groups": [
+                        {
+                            "resources": ["cpu"],
+                            "flavors": [("fl-0", {"cpu": str(quota)}, None, None)],
+                        }
+                    ],
+                    "fair_weight": weights[int(rng.integers(0, len(weights)))],
+                    "preemption": Preemption(
+                        within_cluster_queue=wcq_opts[
+                            int(rng.integers(0, len(wcq_opts)))
+                        ],
+                        reclaim_within_cohort=rwc_opts[
+                            int(rng.integers(0, len(rwc_opts)))
+                        ],
+                    ),
+                }
+            )
+            # admitted victims, some borrowing above nominal (DRS > 0)
+            for vi in range(int(rng.integers(1, victims_per_cq + 1))):
+                t += 1.0
+                victims.append(
+                    (
+                        f"victim-{ci}-{qi}-{vi}", name, "fl-0",
+                        str(int(rng.integers(2, 7))),
+                        int(rng.integers(0, 3)) * 10, t,
+                    )
+                )
+            for wi in range(workloads_per_cq):
+                t += 1.0
+                workloads.append(
+                    {
+                        "name": f"wl-{ci}-{qi}-{wi}",
+                        "queue": f"lq-{name}",
+                        "prio": int(rng.integers(0, 3)) * 10,
+                        "t": t,
+                        "pod_sets": [
+                            {
+                                "name": "main",
+                                "count": 1,
+                                "requests": {
+                                    "cpu": str(int(rng.integers(1, 5)))
+                                },
+                            }
+                        ],
+                    }
+                )
+    return {
+        "flavors": flavors, "cqs": cqs, "workloads": workloads,
+        "victims": victims,
+    }
+
+
+class TestFairPreemptDrain:
+    def test_fair_preemption_in_kernel(self):
+        # cohort capacity saturated by a borrowing low-weight CQ; the
+        # high-weight CQ's head can only start via the fair victim
+        # tournament — no fallback, eviction decided in the drain
+        from kueue_tpu.models.cluster_queue import Preemption
+        from kueue_tpu.models.constants import (
+            PreemptionPolicy,
+            ReclaimWithinCohortPolicy,
+        )
+        from kueue_tpu.core.preemption import IN_COHORT_FAIR_SHARING
+
+        prem = Preemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+        )
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq-a",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"],
+                         "flavors": [("f", {"cpu": "4"}, None, None)]}
+                    ],
+                    "fair_weight": 1000,
+                    "preemption": prem,
+                },
+                {
+                    "name": "cq-b",
+                    "cohort": "co",
+                    "groups": [
+                        {"resources": ["cpu"],
+                         "flavors": [("f", {"cpu": "4"}, None, None)]}
+                    ],
+                    "fair_weight": 1000,
+                    "preemption": prem,
+                },
+            ],
+            # cq-a borrows the whole cohort (8 cpu over nominal 4)
+            "victims": [
+                ("va-0", "cq-a", "f", "4", 0, 1.0),
+                ("va-1", "cq-a", "f", "4", 0, 2.0),
+            ],
+            "workloads": [
+                {
+                    "name": "wb", "queue": "lq-cq-b", "prio": 0, "t": 3.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "3"}}
+                    ],
+                }
+            ],
+        }
+        ha, he, hp = host_fair_preempt_drain_trace(spec)
+        da, de, dp, outcome = device_fair_preempt_drain_trace(spec)
+        assert not outcome.fallback
+        assert da == ha
+        assert de == he
+        assert dp == hp
+        assert "wb" in da and de  # preemption actually happened
+        other_cq = [
+            ev for ev in outcome.evictions if ev.victim_cq != ev.by_cq
+        ]
+        assert all(
+            ev.reason == IN_COHORT_FAIR_SHARING for ev in other_cq
+        ) and other_cq
+
+    @pytest.mark.parametrize("seed", range(16))
+    def test_randomized_parity(self, seed):
+        spec = fair_preempt_spec(seed)
+        ha, he, hp = host_fair_preempt_drain_trace(spec)
+        da, de, dp, outcome = device_fair_preempt_drain_trace(spec)
+        assert not outcome.fallback
+        assert da == ha
+        assert de == he
+        assert dp == hp
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_randomized_parity_single_strategy(self, seed):
+        # LessThanInitialShare alone (the other configurable strategy
+        # list, config fairSharing.preemptionStrategies)
+        from kueue_tpu.core.preemption import LESS_THAN_INITIAL_SHARE
+
+        strategies = [LESS_THAN_INITIAL_SHARE]
+        spec = fair_preempt_spec(seed + 300)
+        ha, he, hp = host_fair_preempt_drain_trace(spec, strategies)
+        da, de, dp, outcome = device_fair_preempt_drain_trace(
+            spec, fs_strategies=strategies
+        )
+        assert not outcome.fallback
+        assert da == ha
+        assert de == he
+        assert dp == hp
+
+
 def test_retry_cap_scales_with_walk_odometer():
     """The stuck-detection budget must cover any CONVERGENT
     PendingFlavors sequence: prod over podsets and resource groups of

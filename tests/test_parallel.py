@@ -143,6 +143,44 @@ def test_sharded_preempt_drain_matches_unsharded():
     assert outcomes["plain"] == outcomes["mesh"]
 
 
+def test_sharded_fair_drain_matches_unsharded():
+    """run_drain(fair_sharing=True) with a mesh (per-queue tensors +
+    DRS chain work sharded along wl, node space replicated) must make
+    identical decisions — separate root cohorts are independent
+    subproblems the tournament shards over."""
+    from kueue_tpu.core.drain import run_drain
+    from kueue_tpu.core.queue_manager import queue_order_timestamp
+    from kueue_tpu.core.snapshot import take_snapshot
+    from kueue_tpu.parallel import make_mesh
+
+    from tests.test_drain import fair_drain_spec
+    from tests.test_solver_path import build_env
+
+    spec = fair_drain_spec(7, n_cohorts=3, cqs_per_cohort=3)
+    outcomes = {}
+    for label, mesh in (("plain", None), ("mesh", make_mesh(8))):
+        sched, mgr, cache, _ = build_env(spec, use_solver=False)
+        pending = []
+        for cq_name, pq in mgr.cluster_queues.items():
+            for wl in pq.snapshot_sorted():
+                pending.append((wl, cq_name))
+        out = run_drain(
+            take_snapshot(cache), pending, cache.flavors,
+            timestamp_fn=lambda wl: queue_order_timestamp(wl, mgr._ts_policy),
+            fair_sharing=True,
+            mesh=mesh,
+        )
+        assert not out.fallback
+        outcomes[label] = (
+            {
+                (wl.name, tuple(sorted(fl.items())), cyc)
+                for wl, _, fl, cyc in out.admitted
+            },
+            {wl.name for wl, _ in out.parked},
+        )
+    assert outcomes["plain"] == outcomes["mesh"]
+
+
 def test_sharded_fair_search_matches_unsharded():
     """batched_fair_get_targets with a mesh (FairProblem rows sharded
     along wl) must return the same victim sets."""
