@@ -767,11 +767,13 @@ def fair_preempt_drain_bench(rng):
 
 
 def tas_drain_bench(rng):
-    """TAS-heavy drain: 10k gang workloads with Required topology
-    requests over a 1024-host topology (16 blocks x 8 racks x 8 hosts),
-    the WHOLE backlog decided in ONE device dispatch — nomination
-    placement, in-cycle re-validation and leaf charging all in kernel
-    (ops/drain_kernel.solve_drain_tas; parity tests/test_tas_drain.py).
+    """TAS-heavy drain: 10k gang workloads with MIXED-MODE topology
+    requests (Required / Preferred with level relaxation /
+    Unconstrained) over a 1024-host topology (16 blocks x 8 racks x 8
+    hosts), the WHOLE backlog decided in ONE device dispatch —
+    nomination placement, in-cycle re-validation and leaf charging all
+    in kernel (ops/drain_kernel.solve_drain_tas; parity
+    tests/test_tas_drain.py incl. TestTASDrainWidenedScope).
     Returns (ms/cycle, cycles, admitted, n_pending)."""
     import time
 
@@ -844,9 +846,16 @@ def tas_drain_bench(rng):
             LocalQueue(namespace="ns", name=f"lq-{name}", cluster_queue=name)
         )
         for w in range(wl_per_cq):
+            mode = ("Required", "Preferred", "Unconstrained")[
+                int(rng.integers(0, 3))
+            ]
             tr = PodSetTopologyRequest(
-                mode="Required",
-                level=levels[int(rng.integers(0, len(levels)))],
+                mode=mode,
+                level=(
+                    None
+                    if mode == "Unconstrained"
+                    else levels[int(rng.integers(0, len(levels)))]
+                ),
             )
             mgr.add_or_update_workload(
                 Workload(
@@ -888,6 +897,15 @@ def tas_drain_bench(rng):
     )
 
 
+def _stage(msg: str):
+    """Progress marker on STDERR (the driver only parses stdout JSON);
+    lets a timed-out payload show which stage it died in."""
+    print(f"[bench +{time.perf_counter() - _T0:.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+
 def payload_main():
     from kueue_tpu.core.drain import run_drain
     from kueue_tpu.core.snapshot import take_snapshot
@@ -900,6 +918,7 @@ def payload_main():
 
     # one full warmup at identical shapes (jit compile; the cache keys
     # are static shapes, so the measured run reuses the executable)
+    _stage("headline drain: warmup (compile)")
     run_drain(snapshot, pending, cache.flavors, max_cells=3)
 
     reps = 3
@@ -917,14 +936,21 @@ def payload_main():
     assert outcome.cycles > 0 and n_admitted > 0
     ms_per_cycle = total_s * 1e3 / outcome.cycles
 
+    _stage("contended drain")
     cd_ms, cd_cycles, cd_admitted, cd_evicted = contended_drain_bench(rng)
+    _stage("tas placement")
     tas_ms, tas_leaves, tas_pods = tas_placement_bench(rng)
+    _stage("fair victim search")
     fair_ms, fair_host_ms, fair_heads = fair_victim_search_bench(rng)
+    _stage("fair drain")
     fd_s, fd_host_s, fd_pending, fd_cycles = fair_drain_bench(rng)
+    _stage("fair preempt drain")
     fp_s, fp_host_s, fp_pending, fp_cycles, fp_evicted = (
         fair_preempt_drain_bench(rng)
     )
+    _stage("tas drain")
     td_ms, td_cycles, td_admitted, td_pending = tas_drain_bench(rng)
+    _stage("done; emitting")
 
     print(
         json.dumps(
@@ -985,9 +1011,10 @@ def payload_main():
                     fp_host_s / max(fp_s, 1e-9), 1
                 ),
                 "tas_drain_metric": (
-                    f"tas_drain ({td_pending // 1000}k Required-mode gangs "
-                    f"over 1024 hosts, in-kernel placement, {td_cycles} "
-                    f"cycles, {td_admitted} admitted, zero fallback)"
+                    f"tas_drain ({td_pending // 1000}k mixed-mode gangs "
+                    "(Required/Preferred/Unconstrained) over 1024 hosts, "
+                    f"in-kernel placement, {td_cycles} cycles, "
+                    f"{td_admitted} admitted, zero fallback)"
                 ),
                 "tas_drain_value": round(td_ms, 3),
                 "tas_drain_unit": "ms/cycle",

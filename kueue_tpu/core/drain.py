@@ -427,7 +427,7 @@ def _lower_victim_pools(
                 break
         n_b = sum(int(qlen[qi]) for qi in seg_queues[s]) if dynamic[s] else 0
         if extra_segment_bad is not None and not bad:
-            bad = bool(extra_segment_bad(s, members))
+            bad = bool(extra_segment_bad(s, members, seg_queues[s]))
         if bad or len(entries) + n_b > max_victims:
             bad_segments.append(s)
             pool_of[s] = []
@@ -988,17 +988,13 @@ def run_drain_fair_preempt(
     n_res = len(snapshot.resource_names)
     res_of_fr = snapshot.resource_index.astype(np.int32)
     universe_of: Dict[int, np.ndarray] = {}
-    seg_id_np = plan.queues_np["seg_id"]
     qlen_np = plan.queues_np["qlen"]
-    queues_by_seg: Dict[int, List[int]] = {}
-    for qi in range(len(plan.cq_order)):
-        if int(seg_id_np[qi]) >= 0:
-            queues_by_seg.setdefault(int(seg_id_np[qi]), []).append(qi)
 
-    def seg_universe_bad(s: int, members) -> bool:
+    def seg_universe_bad(s: int, members, seg_queues_s) -> bool:
         """Compute the segment's active cell universe; veto the segment
         (dropping its searching queues to fallback) when it exceeds the
-        panel cap."""
+        panel cap. ``seg_queues_s`` is the lowering's own queue list
+        for this segment."""
         nodes = set()
         for r in members:
             cur = int(r)
@@ -1009,7 +1005,7 @@ def run_drain_fair_preempt(
         active = (snapshot.nominal[rows] > 0).any(axis=0) | (
             snapshot.local_usage[rows] > 0
         ).any(axis=0)
-        for qi in queues_by_seg.get(s, ()):
+        for qi in seg_queues_s:
             cells_q = plan.queues_np["cells"][qi, : int(qlen_np[qi])]
             cs = cells_q[cells_q >= 0]
             if cs.size:
@@ -1041,6 +1037,9 @@ def run_drain_fair_preempt(
 
     paths_np = np.asarray(paths_j)
     depth_of, lendable, _ = _fair_lendable(snapshot, paths_np)
+    victims_by_seg: Dict[int, List[Tuple[int, object]]] = {}
+    for (ss, slot), ws in low.victim_of.items():
+        victims_by_seg.setdefault(ss, []).append((slot, ws))
     for s, local_id in low.local_ids.items():
         u = good.get(s)
         if u is None:
@@ -1055,9 +1054,7 @@ def run_drain_fair_preempt(
             weight_local[s, li] = int(snapshot.weight_milli[gnode])
             depth_local[s, li] = int(depth_of[gnode]) - root_depth
         cell_pos = {int(j): ci for ci, j in enumerate(u)}
-        for (ss, slot), ws in low.victim_of.items():
-            if ss != s:
-                continue
+        for slot, ws in victims_by_seg.get(s, ()):
             for j in np.flatnonzero(ws.usage_vec):
                 ci = cell_pos.get(int(j))
                 if ci is None:  # usage cells are in the universe by
@@ -1111,6 +1108,89 @@ def run_drain_fair_preempt(
     return _preempt_outcome(plan, low, flat, queues_np, fair=True)
 
 
+def _merge_tas_forests(snaps, union_res, d_global):
+    """Concatenate per-flavor topologies into ONE disjoint domain
+    forest, aligned at the LEAF level.
+
+    A flavor with fewer levels gets structural dummy TOP levels (one
+    domain per missing level, chained) so seg_ids/parent maps stay
+    rectangular; the dummies are semantically unreachable — the kernel
+    clamps the preferred-mode relax-up at each flavor's real top
+    (TASHeads.t_top). Returns (topo_free, tas_usage, seg_ids,
+    n_domains, parent_map, leaf_flavor, leaf_off) on the union resource
+    axis, or None when ``snaps`` is empty."""
+    if not snaps:
+        return None
+    from kueue_tpu.ops.tas_kernel import _level_prefix_index
+
+    n_res = max(len(union_res), 1)
+    u_index = {r: j for j, r in enumerate(union_res)}
+    n_f = len(snaps)
+    idxs_of = []
+    counts = []  # [F][D] domains per flavor per global level
+    for s in snaps:
+        df = len(s.level_keys)
+        idxs = [_level_prefix_index(s, d) for d in range(df)]
+        idxs_of.append(idxs)
+        counts.append(
+            [1] * (d_global - df) + [len(ix) for ix in idxs]
+        )
+    n_domains = tuple(
+        sum(counts[f][d] for f in range(n_f)) for d in range(d_global)
+    )
+    dom_off = [[0] * n_f for _ in range(d_global)]
+    for d in range(d_global):
+        acc = 0
+        for f in range(n_f):
+            dom_off[d][f] = acc
+            acc += counts[f][d]
+    lf_total = sum(len(s._leaf_order) for s in snaps)
+    nd_max = max(n_domains)
+    seg_ids = np.zeros((d_global, lf_total), dtype=np.int32)
+    parent_map = np.zeros((d_global, nd_max), dtype=np.int32)
+    topo_free = np.zeros((lf_total, n_res), dtype=np.int64)
+    tas_usage = np.zeros((lf_total, n_res), dtype=np.int64)
+    leaf_flavor = np.zeros(lf_total, dtype=np.int32)
+    leaf_off: Dict[int, int] = {}
+    off_l = 0
+    for f, s in enumerate(snaps):
+        df = len(s.level_keys)
+        lvl_off = d_global - df
+        nl_f = len(s._leaf_order)
+        idxs = idxs_of[f]
+        leaf_off[f] = off_l
+        leaf_flavor[off_l : off_l + nl_f] = f
+        cols = [u_index[r] for r in s._resources]
+        topo_free[off_l : off_l + nl_f, cols] = s._free
+        tas_usage[off_l : off_l + nl_f, cols] = s._tas_usage
+        for d in range(d_global):
+            dl = d - lvl_off
+            if dl < 0:
+                seg_ids[d, off_l : off_l + nl_f] = dom_off[d][f]
+            else:
+                for i, leaf in enumerate(s._leaf_order):
+                    seg_ids[d, off_l + i] = (
+                        dom_off[d][f] + idxs[dl][leaf.level_values[: dl + 1]]
+                    )
+        for d in range(1, d_global):
+            dl = d - lvl_off
+            if dl < 0:
+                parent_map[d, dom_off[d][f]] = dom_off[d - 1][f]
+            elif dl == 0:
+                for idx in idxs[0].values():
+                    parent_map[d, dom_off[d][f] + idx] = dom_off[d - 1][f]
+            else:
+                for p, idx in idxs[dl].items():
+                    parent_map[d, dom_off[d][f] + idx] = (
+                        dom_off[d - 1][f] + idxs[dl - 1][p[:-1]]
+                    )
+        off_l += nl_f
+    return (
+        topo_free, tas_usage, seg_ids, n_domains, parent_map,
+        leaf_flavor, leaf_off,
+    )
+
+
 @dataclass
 class TASDrainOutcome(DrainOutcome):
     # TopologyAssignment per admitted entry, aligned with ``admitted``
@@ -1133,17 +1213,25 @@ def run_drain_tas(
     one fetch, then a cheap host replay (one placement per ADMITTED
     workload, grouped per cycle against cycle-start state) that
     reconstructs the TopologyAssignments and asserts the kernel's final
-    TAS leaf usage is reproduced exactly.
+    TAS leaf usage is reproduced exactly, flavor by flavor.
 
-    Scope: single-podset Required-mode topology requests on ONE shared
-    taint-free TAS flavor; TAS ClusterQueues must be preemption-free
-    and single-flavor. Heads outside the scope route to ``fallback``
-    for the sequential cycle loop.
+    Scope: single-podset topology requests in ALL THREE modes —
+    Required, Preferred (level relaxation,
+    tas_flavor_snapshot.go:513-549), Unconstrained — over ANY number of
+    taint-free TAS flavors (queues segmented by flavor, each placing
+    into its own subtree of one merged domain forest); TAS
+    ClusterQueues must be preemption-free and single-flavor, and the
+    default BestFit profile applies (the gated Most/LeastFree profiles
+    stay host-side). Topology requests on non-TAS ClusterQueues PARK in
+    kernel at the exact cycle the host would reject the flavor. Heads
+    outside the scope route to ``fallback`` for the cycle loop.
     """
     from kueue_tpu._jax import jnp
     from kueue_tpu.core.workload_info import quota_per_pod
     from kueue_tpu.models.constants import (
+        TOPOLOGY_MODE_PREFERRED,
         TOPOLOGY_MODE_REQUIRED,
+        TOPOLOGY_MODE_UNCONSTRAINED,
         PreemptionPolicy,
         ReclaimWithinCohortPolicy,
     )
@@ -1152,7 +1240,6 @@ def run_drain_tas(
         TASHeads,
         solve_drain_tas_packed_jit,
     )
-    from kueue_tpu.ops.tas_kernel import topology_from_snapshot
     from kueue_tpu.resources import PODS
     from kueue_tpu.tas.snapshot import TASPodSetRequest, domain_id
 
@@ -1164,31 +1251,38 @@ def run_drain_tas(
     nl = plan.queues_np["cells"].shape[1]
 
     tas_flavor_names = set(tas_cache.flavors)
+    TAS_MODE_ID = {
+        TOPOLOGY_MODE_REQUIRED: 0,
+        TOPOLOGY_MODE_PREFERRED: 1,
+        TOPOLOGY_MODE_UNCONSTRAINED: 2,
+    }
 
     def cq_flavor_names(cq_name):
         cq = snapshot.cq_models[cq_name]
         return {fq.name for rg in cq.resource_groups for fq in rg.flavors}
 
-    # ---- scope: classify queues, pick THE shared topology flavor ----
+    # ---- scope: classify queues; EVERY in-scope TAS flavor joins the
+    # merged domain forest (queues segmented by flavor) ----
     drop: List[int] = []
     tas_queue: Dict[int, str] = {}  # qi -> tas flavor name
+    t_bad = np.zeros((q, nl), dtype=bool)
     for qi, cq_name in enumerate(plan.cq_order):
         prem = snapshot.cq_models[cq_name].preemption
         names = cq_flavor_names(cq_name)
         tnames = names & tas_flavor_names
         if not tnames:
-            # plain quota queue — but topology-requesting entries on a
-            # non-TAS flavor must NOT be silently admitted as plain:
-            # the host rejects the flavor ("does not support
-            # TopologyAwareScheduling", tas/manager.py check) and parks
+            # plain quota queue — topology-requesting entries on a
+            # non-TAS flavor are PARKED in kernel at the exact cycle
+            # the host would reject the flavor ("does not support
+            # TopologyAwareScheduling", tas/manager.py check); the
+            # queue itself stays in the drain
             for pos in range(int(plan.queues_np["qlen"][qi])):
                 i = plan.head_of.get((qi, pos))
                 if i is not None and any(
                     ps.topology_request is not None
                     for ps in plan.lowered.heads[i].pod_sets
                 ):
-                    drop.append(qi)
-                    break
+                    t_bad[qi, pos] = True
             continue
         capable = prem.within_cluster_queue != PreemptionPolicy.NEVER or (
             snapshot.has_cohort(cq_name)
@@ -1198,36 +1292,48 @@ def run_drain_tas(
             drop.append(qi)
             continue
         tas_queue[qi] = next(iter(tnames))
-    flavor_pool = set(tas_queue.values())
-    shared = sorted(flavor_pool)[0] if flavor_pool else None
-    for qi in list(tas_queue):
-        if tas_queue[qi] != shared:
-            drop.append(qi)
-            del tas_queue[qi]
 
-    snap = tas_cache.flavors[shared].snapshot() if shared else None
-    if snap is not None:
-        snap.freeze()
-        if any(t for t in snap._leaf_taints):
-            drop.extend(tas_queue)
-            tas_queue = {}
-            snap = None
+    # per-flavor snapshots; tainted flavors stay host-side (the kernel
+    # has no toleration filtering)
+    flavor_names = sorted(set(tas_queue.values()))
+    snaps: Dict[str, object] = {}
+    for fname in flavor_names:
+        s = tas_cache.flavors[fname].snapshot()
+        s.freeze()
+        if any(t for t in s._leaf_taints):
+            for qi in [k for k, v in tas_queue.items() if v == fname]:
+                drop.append(qi)
+                del tas_queue[qi]
+        else:
+            snaps[fname] = s
+    flavor_names = sorted(snaps)
+    flavor_idx = {f: i for i, f in enumerate(flavor_names)}
+
+    # union resource axis + merged level depth
+    union_res = sorted(
+        {r for s in snaps.values() for r in s._resources}
+    )
+    n_res_t = max(len(union_res), 1)
+    u_index = {r: j for j, r in enumerate(union_res)}
+    d_global = max(
+        (len(s.level_keys) for s in snaps.values()), default=1
+    )
 
     # per-entry TAS lowering + scope checks
-    n_res_t = len(snap._resources) if snap is not None else 1
-    r_index = (
-        {r: j for j, r in enumerate(snap._resources)}
-        if snap is not None
-        else {}
-    )
     t_is = np.zeros(q, dtype=bool)
-    t_req = np.zeros((q, nl, max(n_res_t, 1)), dtype=np.int64)
+    t_req = np.zeros((q, nl, n_res_t), dtype=np.int64)
     t_count = np.zeros((q, nl), dtype=np.int32)
     t_level = np.zeros((q, nl), dtype=np.int32)
+    t_mode = np.zeros((q, nl), dtype=np.int32)
+    t_top = np.zeros(q, dtype=np.int32)
+    t_flavor = np.zeros(q, dtype=np.int32)
     dropped = set(drop)
     for qi, fname in tas_queue.items():
         if qi in dropped:
             continue
+        snap_f = snaps[fname]
+        lvl_off = d_global - len(snap_f.level_keys)
+        r_index_f = set(snap_f._resources)
         ok = True
         for pos in range(int(plan.queues_np["qlen"][qi])):
             i = plan.head_of.get((qi, pos))
@@ -1239,27 +1345,33 @@ def run_drain_tas(
                 break
             ps = wl.pod_sets[0]
             tr = ps.topology_request
-            if (
-                tr is None
-                or tr.mode != TOPOLOGY_MODE_REQUIRED
-                or tr.level not in snap.level_keys
-            ):
+            if tr is None or tr.mode not in TAS_MODE_ID:
+                ok = False
+                break
+            if tr.mode == TOPOLOGY_MODE_UNCONSTRAINED:
+                lvl_local = len(snap_f.level_keys) - 1  # lowest level
+            elif tr.level in snap_f.level_keys:
+                lvl_local = snap_f.level_keys.index(tr.level)
+            else:
                 ok = False
                 break
             per_pod = dict(quota_per_pod(ps, None))
             per_pod[PODS] = per_pod.get(PODS, 0) + 1
-            if any(r not in r_index for r in per_pod):
+            if any(r not in r_index_f for r in per_pod):
                 ok = False
                 break
             for r, v in per_pod.items():
-                t_req[qi, pos, r_index[r]] = int(v)
+                t_req[qi, pos, u_index[r]] = int(v)
             t_count[qi, pos] = int(ps.count)
-            t_level[qi, pos] = snap.level_keys.index(tr.level)
+            t_level[qi, pos] = lvl_off + lvl_local
+            t_mode[qi, pos] = TAS_MODE_ID[tr.mode]
         if not ok:
             drop.append(qi)
             dropped.add(qi)
         else:
             t_is[qi] = True
+            t_top[qi] = d_global - len(snap_f.level_keys)
+            t_flavor[qi] = flavor_idx[fname]
 
     # drop out-of-scope queues to the fallback path
     extra_fb: List[Tuple[Workload, str]] = []
@@ -1281,14 +1393,24 @@ def run_drain_tas(
         **{k: jnp.asarray(v) for k, v in plan.queues_np.items()}
     )
 
-    if snap is not None:
-        from kueue_tpu.ops.tas_kernel import domain_parent_map
-
-        topo = topology_from_snapshot(snap)
-        topo_free, tas_usage0 = topo.free, topo.tas_usage
-        seg_ids_j, n_domains = topo.seg_ids, topo.n_domains
-        parent_map = domain_parent_map(snap)
-        lf_n = topo_free.shape[0]
+    live_flavors = sorted(
+        {tas_queue[qi] for qi in tas_queue if qi not in dropped}
+    )
+    merged = _merge_tas_forests(
+        [snaps[f] for f in live_flavors], union_res, d_global
+    )
+    if merged is not None:
+        (topo_free_np, tas_usage0_np, seg_ids_np, n_domains, parent_map,
+         leaf_flavor_np, leaf_off) = merged
+        # remap queue flavor ids onto the LIVE flavor axis
+        live_idx = {f: i for i, f in enumerate(live_flavors)}
+        for qi, fname in tas_queue.items():
+            if qi not in dropped:
+                t_flavor[qi] = live_idx[fname]
+        topo_free = jnp.asarray(topo_free_np)
+        tas_usage0 = jnp.asarray(tas_usage0_np)
+        seg_ids_j = jnp.asarray(seg_ids_np)
+        lf_n = topo_free_np.shape[0]
     else:
         # no TAS queue in scope: inert 1-leaf topology
         topo_free = jnp.zeros((1, 1), dtype=jnp.int64)
@@ -1296,15 +1418,23 @@ def run_drain_tas(
         seg_ids_j = jnp.zeros((1, 1), dtype=jnp.int32)
         n_domains = (1,)
         parent_map = np.zeros((1, 1), dtype=np.int32)
+        leaf_flavor_np = np.zeros(1, dtype=np.int32)
+        leaf_off = {}
         lf_n = 1
-        n_res_t = 1
+        n_res_t = max(n_res_t, 1)
+        t_req = t_req[:, :, :1]
 
     theads = TASHeads(
         t_is=jnp.asarray(t_is),
-        t_req=jnp.asarray(t_req[:, :, :n_res_t]),
+        t_req=jnp.asarray(t_req),
         t_count=jnp.asarray(t_count),
         t_level=jnp.asarray(t_level),
+        t_mode=jnp.asarray(t_mode),
+        t_top=jnp.asarray(t_top),
+        t_flavor=jnp.asarray(t_flavor),
+        leaf_flavor=jnp.asarray(leaf_flavor_np),
         parent_map=jnp.asarray(parent_map),
+        t_bad=jnp.asarray(t_bad),
     )
     n_live = int((plan.queues_np["cq_rows"] >= 0).sum())
     n_steps = _bucket(max(n_live, 1), minimum=8)
@@ -1364,22 +1494,33 @@ def run_drain_tas(
     adm_meta = [adm_meta[j] for j in order]
 
     # ---- replay: reconstruct TopologyAssignments per admission cycle
-    # against cycle-start state (the kernel nominates against it too);
-    # the final leaf usage must reproduce the kernel's exactly ----
+    # against cycle-start state (the kernel nominates against it too),
+    # per FLAVOR; the final leaf usage must reproduce the kernel's
+    # exactly, flavor by flavor ----
     assignments: List[object] = [None] * len(admitted)
-    if snap is not None:
+    if live_flavors:
+        live_idx = {f: i for i, f in enumerate(live_flavors)}
+        flavor_of_cq = {
+            plan.cq_order[qi]: fname
+            for qi, fname in tas_queue.items()
+            if qi not in dropped
+        }
         j = 0
         while j < len(admitted):
             cyc = adm_meta[j][0]
             batch = []
             while j < len(admitted) and adm_meta[j][0] == cyc:
                 wl, cq_name, _, _ = admitted[j]
-                if wl.pod_sets[0].topology_request is not None:
+                if (
+                    wl.pod_sets[0].topology_request is not None
+                    and cq_name in flavor_of_cq
+                ):
                     batch.append(j)
                 j += 1
             placed = []
             for bj in batch:
-                wl = admitted[bj][0]
+                wl, cq_name = admitted[bj][0], admitted[bj][1]
+                sf = snaps[flavor_of_cq[cq_name]]
                 ps = wl.pod_sets[0]
                 req = TASPodSetRequest(
                     podset_name=ps.name,
@@ -1388,33 +1529,40 @@ def run_drain_tas(
                     topology_request=ps.topology_request,
                     tolerations=tuple(ps.tolerations),
                 )
-                ta, reason = snap.find_topology_assignment(req, {})
+                ta, reason = sf.find_topology_assignment(req, {})
                 if reason:  # explicit raise: must survive `python -O`
                     raise AssertionError(
                         f"TAS drain replay failed for {wl.name}: {reason}"
                     )
                 assignments[bj] = ta
-                placed.append((req, ta))
-            for req, ta in placed:  # charge AFTER the batch (cycle end)
+                placed.append((sf, req, ta))
+            for sf, req, ta in placed:  # charge AFTER the batch
                 for dom in ta.domains:
                     did = domain_id(dom.values)
                     usage = {
                         r: v * dom.count
                         for r, v in req.single_pod_requests.items()
                     }
-                    snap.add_tas_usage(did, usage, dom.count)
-        snap.freeze()
-        if not np.array_equal(snap._tas_usage, tas_final):
-            bad = np.argwhere(snap._tas_usage != tas_final)[:8]
-            raise AssertionError(
-                "TAS drain replay does not reproduce the kernel's leaf "
-                "usage — placement parity bug; first diffs (leaf, res): "
-                + "; ".join(
-                    f"{tuple(ix)}: host={snap._tas_usage[tuple(ix)]} "
-                    f"kernel={tas_final[tuple(ix)]}"
-                    for ix in bad
+                    sf.add_tas_usage(did, usage, dom.count)
+        for fname in live_flavors:
+            sf = snaps[fname]
+            sf.freeze()
+            off = leaf_off[live_idx[fname]]
+            nl_f = len(sf._leaf_order)
+            cols = [u_index[r] for r in sf._resources]
+            sub = tas_final[off : off + nl_f][:, cols]
+            if not np.array_equal(sf._tas_usage, sub):
+                bad = np.argwhere(sf._tas_usage != sub)[:8]
+                raise AssertionError(
+                    f"TAS drain replay does not reproduce the kernel's "
+                    f"leaf usage for flavor {fname} — placement parity "
+                    "bug; first diffs (leaf, res): "
+                    + "; ".join(
+                        f"{tuple(ix)}: host={sf._tas_usage[tuple(ix)]} "
+                        f"kernel={sub[tuple(ix)]}"
+                        for ix in bad
+                    )
                 )
-            )
 
     fb = [
         (lowered.heads[i], lowered.cq_names[i]) for i in plan.fallback

@@ -585,23 +585,46 @@ def solve_drain(
 
 
 class TASHeads(NamedTuple):
-    """Per-queue TAS lowering for solve_drain_tas (one shared topology).
+    """Per-queue TAS lowering for solve_drain_tas over a MERGED domain
+    forest: every in-scope TAS flavor's topology concatenated into one
+    disjoint forest, aligned at the LEAF level (a flavor with fewer
+    levels gets structural dummy top levels so seg_ids/parent chains
+    stay rectangular; dummies are unreachable — ``t_top`` clamps the
+    preferred-mode relax-up at each flavor's real top).
 
     t_is:    bool[Q]         — the queue's entries are TAS workloads.
     t_req:   int64[Q, L, Rt] — per-ENTRY per-pod request vector on the
-             topology resource axis (pods slot included as 1).
+             UNION topology resource axis (pods slot included as 1).
     t_count: int32[Q, L]     — gang size per entry.
-    t_level: int32[Q, L]     — requested topology level index (Required).
+    t_level: int32[Q, L]     — requested topology level index in GLOBAL
+             (merged) level space; leaf level for unconstrained mode.
+    t_mode:  int32[Q, L]     — 0 Required, 1 Preferred, 2 Unconstrained
+             (tas_flavor_snapshot.go:513-568 search modes).
+    t_top:   int32[Q]        — the queue's flavor's top level in global
+             space (= D_global - D_flavor); relax-up stops here.
+    t_flavor: int32[Q]       — the queue's flavor index.
+    leaf_flavor: int32[Lf]   — owning flavor per merged-forest leaf
+             (placement masks every other flavor's leaves to state 0).
     parent_map: int32[D_t, ND] — domain -> parent domain index at the
-             level above (row 0 unused, zero; ordering owned by
-             ops/tas_kernel.domain_parent_map), ND = max domains/level.
+             level above (row 0 unused, zero; ordering owned by the
+             merged-forest lowering), ND = max domains/level.
     """
 
     t_is: jnp.ndarray
     t_req: jnp.ndarray  # int64[Q, L, Rt]
     t_count: jnp.ndarray  # int32[Q, L]
     t_level: jnp.ndarray  # int32[Q, L]
+    t_mode: jnp.ndarray  # int32[Q, L]
+    t_top: jnp.ndarray  # int32[Q]
+    t_flavor: jnp.ndarray  # int32[Q]
+    leaf_flavor: jnp.ndarray  # int32[Lf]
     parent_map: jnp.ndarray  # int32[D_t, ND]
+    # bool[Q, L] — entry requests a topology on a ClusterQueue whose
+    # flavor doesn't support TAS: the host rejects the flavor and PARKS
+    # the head ("does not support TopologyAwareScheduling",
+    # tas/manager.py check); forcing NoFit reproduces that park at the
+    # exact same cycle instead of dropping the whole queue to fallback
+    t_bad: jnp.ndarray
 
 
 def _tas_fit_and_place(
@@ -612,18 +635,35 @@ def _tas_fit_and_place(
     parent_map,  # int32[D_t, ND]
     req,  # int64[Rt] per-pod request
     count,  # int32 gang size
-    level,  # int32 requested level index
+    level,  # int32 requested level index (global level space)
     place: bool,
+    mode=None,  # int32: 0 Required, 1 Preferred, 2 Unconstrained
+    top_level=None,  # int32: the flavor's real top level (relax floor)
+    leaf_sel=None,  # bool[Lf]: the flavor's leaves in the merged forest
 ):
-    """Phase-1 counts + the reference's REQUIRED-mode phase-2 greedy
-    (BestFit default profile) for ONE podset against the current TAS
-    state (tas_flavor_snapshot.go:394-444,494-621). Returns
-    (fits bool, taken int64[Lf]) — ``taken`` is all-zero unless
-    ``place`` and the request fits."""
+    """Phase-1 counts + the reference's phase-2 greedy (BestFit default
+    profile) for ONE podset against the current TAS state
+    (tas_flavor_snapshot.go:394-444,494-621), all three search modes:
+
+    - Required: the requested level must hold ONE fitting domain;
+    - Preferred: relax upward (level-1, ..., the flavor's top) looking
+      for a single fit, then fall back to a multi-domain greedy take at
+      the top level (:443-465);
+    - Unconstrained: single fit at the lowest level, else the
+      multi-domain take AT that level (no upward relaxation).
+
+    Returns (fits bool, taken int64[Lf]) — ``taken`` is all-zero unless
+    ``place`` and the request fits. ``mode``/``top_level`` default to
+    Required at level with no floor; ``leaf_sel`` masks the counts to
+    the entry's own flavor in a merged multi-flavor forest."""
     n_lf = topo_free.shape[0]
     d_t = len(n_domains)
     nd_max = parent_map.shape[1]
     INF = jnp.int64(1 << 62)
+    if mode is None:
+        mode = jnp.int32(0)
+    if top_level is None:
+        top_level = jnp.int32(0)
 
     remaining = topo_free - tas_u
     per_res = jnp.sign(remaining) * (
@@ -632,6 +672,10 @@ def _tas_fit_and_place(
     per_res = jnp.where((req > 0)[None, :], per_res, MAX_COUNT_TAS)
     counts = jnp.clip(jnp.min(per_res, axis=-1), None, MAX_COUNT_TAS)
     counts = jnp.maximum(counts, jnp.int64(-(1 << 40)))  # keep sums sane
+    if leaf_sel is not None:
+        # other flavors' leaves are invisible: their domains total 0
+        # and can never be picked (gang counts are >= 1)
+        counts = jnp.where(leaf_sel, counts, 0)
 
     # per-level domain totals, padded to ND
     states = []
@@ -652,34 +696,53 @@ def _tas_fit_and_place(
         idx = jnp.argmax(fit & (s == mval))
         return jnp.any(fit), idx.astype(jnp.int32)
 
-    # required mode: the requested level must hold one fitting domain
     alloc = jnp.zeros((d_t, nd_max), dtype=jnp.int64)
     fits_lvl = []
     pick_lvl = []
+    total_lvl = []
     for d in range(d_t):
         valid = jnp.arange(nd_max) < n_domains[d]
         ok, idx = pick_single(states[d], valid)
         fits_lvl.append(ok)
         pick_lvl.append(idx)
-    fits = jnp.select(
-        [level == d for d in range(d_t)], fits_lvl, False
+        # the multi-domain take walks positive-state domains only
+        # (:453); its capacity is their sum
+        total_lvl.append(
+            jnp.sum(jnp.where(valid, jnp.maximum(states[d], 0), 0))
+        )
+    ok_vec = jnp.stack(fits_lvl)  # [D]
+    idx_vec = jnp.stack(pick_lvl)  # [D]
+    total_vec = jnp.stack(total_lvl)  # [D]
+    lvl_idx = jnp.arange(d_t)
+
+    ok_at_l = jnp.take(ok_vec, jnp.clip(level, 0, d_t - 1))
+    total_at_l = jnp.take(total_vec, jnp.clip(level, 0, d_t - 1))
+    total_at_top = jnp.take(total_vec, jnp.clip(top_level, 0, d_t - 1))
+    # preferred: FIRST single fit walking up from the requested level
+    # (:446-448) = the deepest fitting level in [top_level, level]
+    in_range = (lvl_idx <= level) & (lvl_idx >= top_level)
+    pref_ok = ok_vec & in_range
+    pref_found = jnp.any(pref_ok)
+    pref_level = jnp.max(jnp.where(pref_ok, lvl_idx, -1)).astype(jnp.int32)
+
+    is_pref = mode == 1
+    is_unc = mode == 2
+    fits = jnp.where(
+        is_pref,
+        pref_found | (total_at_top >= cnt),
+        jnp.where(is_unc, ok_at_l | (total_at_l >= cnt), ok_at_l),
     )
-    pick0 = jnp.select(
-        [level == d for d in range(d_t)], pick_lvl, 0
+    multi = jnp.where(
+        is_pref, ~pref_found, jnp.where(is_unc, ~ok_at_l, False)
     )
+    fit_level = jnp.where(
+        is_pref,
+        jnp.where(pref_found, pref_level, top_level),
+        level,
+    ).astype(jnp.int32)
 
     if not place:
         return fits, jnp.zeros(n_lf, dtype=jnp.int64)
-
-    # seed the allocation at the requested level, then descend with the
-    # pooled greedy split (update_counts_to_minimum, BestFit jumps)
-    for d in range(d_t):
-        seed = (
-            jnp.zeros(nd_max, dtype=jnp.int64)
-            .at[pick0]
-            .set(jnp.where(fits, cnt, 0))
-        )
-        alloc = alloc.at[d].set(jnp.where(level == d, seed, alloc[d]))
 
     def split(s, child_ok):
         """Greedy desc-order fill of ``cnt`` over the masked domains
@@ -704,13 +767,29 @@ def _tas_fit_and_place(
         out = jnp.zeros(nd_max, dtype=jnp.int64).at[order].set(take)
         return jnp.where(child_ok, out, 0)
 
+    # seed the allocation at the fit level — one best-fit domain capped
+    # at count, or the multi-domain greedy take (:450-465) — then
+    # descend with the pooled greedy split (update_counts_to_minimum,
+    # BestFit jumps)
+    for d in range(d_t):
+        valid = jnp.arange(nd_max) < n_domains[d]
+        single_seed = (
+            jnp.zeros(nd_max, dtype=jnp.int64)
+            .at[idx_vec[d]]
+            .set(jnp.where(fits & ~multi, cnt, 0))
+        )
+        seed = jnp.where(
+            multi & fits, split(states[d], valid), single_seed
+        )
+        alloc = alloc.at[d].set(jnp.where(fit_level == d, seed, alloc[d]))
+
     for d in range(1, d_t):
         # children (at level d) of domains picked at level d-1
         pm = jnp.maximum(parent_map[d], 0)
         picked_above = alloc[d - 1][pm] > 0
         child_ok = picked_above & (jnp.arange(nd_max) < n_domains[d])
         lower = jnp.where(
-            (level < d) & fits, split(states[d], child_ok), alloc[d]
+            (fit_level < d) & fits, split(states[d], child_ok), alloc[d]
         )
         alloc = alloc.at[d].set(lower)
 
@@ -790,12 +869,19 @@ def solve_drain_tas(
     q_idx = jnp.arange(q)
     cq = jnp.maximum(queues.cq_rows, 0)
 
+    # per-queue flavor leaf mask over the merged forest
+    leaf_sel_q = (
+        theads.leaf_flavor[None, :] == theads.t_flavor[:, None]
+    )  # [Q, Lf]
     tas_place_v = jax.vmap(
-        lambda req, count, level, tas_u: _tas_fit_and_place(
-            topo_free, tas_u, seg_ids, n_domains, theads.parent_map,
-            req, count, level, place=True,
+        lambda req, count, level, mode, top, lsel, tas_u: (
+            _tas_fit_and_place(
+                topo_free, tas_u, seg_ids, n_domains, theads.parent_map,
+                req, count, level, place=True, mode=mode, top_level=top,
+                leaf_sel=lsel,
+            )
         ),
-        in_axes=(0, 0, 0, None),
+        in_axes=(0, 0, 0, 0, 0, 0, None),
     )
 
     def cycle_body(state):
@@ -817,11 +903,21 @@ def solve_drain_tas(
         t_req = theads.t_req[q_idx, cur]  # [Q, Rt]
         t_count = theads.t_count[q_idx, cur]
         t_level = theads.t_level[q_idx, cur]
+        t_mode = theads.t_mode[q_idx, cur]
         tas_head = theads.t_is & active
-        tas_nom_ok, taken0 = tas_place_v(t_req, t_count, t_level, tas_u)
+        tas_nom_ok, taken0 = tas_place_v(
+            t_req, t_count, t_level, t_mode, theads.t_top, leaf_sel_q,
+            tas_u,
+        )
         tas_parked = tas_head & is_fit & ~tas_nom_ok
+        # topology request on a non-TAS flavor: the host rejects the
+        # flavor at nomination and parks the head
+        t_bad_h = theads.t_bad[q_idx, cur]
+        tas_parked = tas_parked | (t_bad_h & active)
         is_fit = is_fit & ~tas_parked
+        is_pre = is_pre & ~(t_bad_h & active)
         pend = pend & ~tas_parked  # degrade-to-NoFit clears the cursor
+        pend = pend & ~(t_bad_h & active)
         nofit = ~(is_fit | is_pre)
 
         prio = queues.priority[q_idx, cur]

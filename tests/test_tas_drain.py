@@ -82,8 +82,10 @@ def build_env(n_cq=3, blocks=2, racks=3, hosts=4, host_cpu=8, quota="999"):
     return sched, qm, cache, tas
 
 
-def tas_wl(name, lq, count, cpu, level, prio=0, t=0.0):
-    tr = PodSetTopologyRequest(mode="Required", level=level)
+def tas_wl(name, lq, count, cpu, level, prio=0, t=0.0, mode="Required"):
+    tr = PodSetTopologyRequest(
+        mode=mode, level=None if mode == "Unconstrained" else level
+    )
     return Workload(
         namespace="ns", name=name, queue_name=lq, priority=prio,
         creation_time=t,
@@ -93,7 +95,7 @@ def tas_wl(name, lq, count, cpu, level, prio=0, t=0.0):
     )
 
 
-def tas_spec(seed, n_cq=3, wl_per_cq=5):
+def tas_spec(seed, n_cq=3, wl_per_cq=5, modes=("Required",)):
     rng = np.random.default_rng(seed + 61000)
     wls = []
     t = 0.0
@@ -110,6 +112,7 @@ def tas_spec(seed, n_cq=3, wl_per_cq=5):
                     level=levels[int(rng.integers(0, len(levels)))],
                     prio=int(rng.integers(0, 3)) * 10,
                     t=t,
+                    mode=modes[int(rng.integers(0, len(modes)))],
                 )
             )
     return wls
@@ -215,11 +218,13 @@ class TestTASDrain:
         assert d_adm == h_adm
         assert d_park == h_park
 
-    def test_topology_request_on_non_tas_flavor_falls_back(self):
+    def test_topology_request_on_non_tas_flavor_parks_in_kernel(self):
         # a Required-topology workload on a CQ whose flavor has no
         # topology must NOT be silently admitted as plain quota: the
-        # host rejects the flavor and parks, so the drain routes the
-        # queue to fallback (regression: it admitted with no placement)
+        # host rejects the flavor and parks — the drain PARKS the entry
+        # in kernel (t_bad) at the same cycle instead of dropping the
+        # whole queue to fallback (regression r1: it admitted with no
+        # placement; r4: it punted the entire queue)
         sched, qm, cache, tas = build_env()
         plain_flavor = ResourceFlavor(name="plain")
         cache.add_or_update_flavor(plain_flavor)
@@ -247,7 +252,8 @@ class TestTASDrain:
             snapshot, pending, cache.flavors, tas,
             timestamp_fn=lambda wl: queue_order_timestamp(wl, qm._ts_policy),
         )
-        assert [wl.name for wl, _ in outcome.fallback] == ["w"]
+        assert not outcome.fallback
+        assert [wl.name for wl, _ in outcome.parked] == ["w"]
         assert not outcome.admitted
 
     @pytest.mark.parametrize("seed", range(16))
@@ -255,6 +261,276 @@ class TestTASDrain:
         wls = tas_spec(seed)
         h_adm, h_park = host_trace(wls)
         d_adm, d_park, outcome = device_trace(wls)
+        assert not outcome.fallback
+        assert d_adm == h_adm
+        assert d_park == h_park
+
+
+def build_env_two_flavors(
+    n_cq=4, blocks=2, racks=3, hosts=4, host_cpu=8, quota="999",
+    flat_racks=4, flat_hosts=3,
+):
+    """Two TAS flavors with DIFFERENT topologies: tas-a (3 levels,
+    block/rack/host) and tas-b (2 levels, rack/host). Even CQs use
+    tas-a, odd CQs tas-b — the drain segments queues by flavor over one
+    merged forest."""
+    cache = Cache()
+    qm = QueueManager(Clock())
+    tas = TASCache()
+    topo_a = Topology(
+        name="deep",
+        levels=(TopologyLevel(BLOCK), TopologyLevel(RACK), TopologyLevel(HOST)),
+    )
+    topo_b = Topology(
+        name="flat", levels=(TopologyLevel(RACK), TopologyLevel(HOST))
+    )
+    # nodeLabels partition the fleet between the flavors (a flavor with
+    # no selector would ingest every node)
+    fl_a = ResourceFlavor(
+        name="tas-a", topology_name="deep", node_labels={"pool": "a"}
+    )
+    fl_b = ResourceFlavor(
+        name="tas-b", topology_name="flat", node_labels={"pool": "b"}
+    )
+    for topo in (topo_a, topo_b):
+        tas.add_or_update_topology(topo)
+        cache.add_or_update_topology(topo)
+    for fl in (fl_a, fl_b):
+        cache.add_or_update_flavor(fl)
+        tas.add_or_update_flavor(fl)
+    for b in range(blocks):
+        for r in range(racks):
+            for h in range(hosts):
+                tas.add_or_update_node(
+                    Node(
+                        name=f"a-{b}-{r}-{h}",
+                        labels={
+                            "pool": "a",
+                            BLOCK: f"b{b}",
+                            RACK: f"b{b}-r{r}",
+                            HOST: f"ha-{b}-{r}-{h}",
+                        },
+                        allocatable={"cpu": host_cpu * 1000, "pods": 32},
+                    )
+                )
+    for r in range(flat_racks):
+        for h in range(flat_hosts):
+            tas.add_or_update_node(
+                Node(
+                    name=f"b-{r}-{h}",
+                    labels={"pool": "b", RACK: f"fr{r}", HOST: f"hb-{r}-{h}"},
+                    allocatable={"cpu": host_cpu * 1000, "pods": 32},
+                )
+            )
+    cache.tas_cache = tas
+    for i in range(n_cq):
+        fname = "tas-a" if i % 2 == 0 else "tas-b"
+        cq = ClusterQueue(
+            name=f"cq-{i}",
+            namespace_selector={},
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu",), (FlavorQuotas.build(fname, {"cpu": quota}),)
+                ),
+            ),
+        )
+        cache.add_or_update_cluster_queue(cq)
+        qm.add_cluster_queue(cq)
+        lq = LocalQueue(namespace="ns", name=f"lq-{i}", cluster_queue=f"cq-{i}")
+        cache.add_or_update_local_queue(lq)
+        qm.add_local_queue(lq)
+    manager = TASManager(tas, cache.flavors)
+    sched = Scheduler(
+        queues=qm, cache=cache, clock=Clock(),
+        tas_check=manager.check, tas_assign=manager.assign,
+        tas_fits=manager.fits,
+        use_solver=False,
+    )
+    return sched, qm, cache, tas
+
+
+def two_flavor_spec(seed, n_cq=4, wl_per_cq=4, modes=("Required",)):
+    """Workloads across both flavors' queues; odd (tas-b) queues only
+    request rack/host levels (the flat topology has no block)."""
+    rng = np.random.default_rng(seed + 71000)
+    wls = []
+    t = 0.0
+    for i in range(n_cq):
+        levels = [BLOCK, RACK, HOST] if i % 2 == 0 else [RACK, HOST]
+        for w in range(wl_per_cq):
+            t += 1.0
+            wls.append(
+                dict(
+                    name=f"wl-{i}-{w}",
+                    lq=f"lq-{i}",
+                    count=int(rng.integers(1, 9)),
+                    cpu=str(int(rng.integers(1, 4))),
+                    level=levels[int(rng.integers(0, len(levels)))],
+                    prio=int(rng.integers(0, 3)) * 10,
+                    t=t,
+                    mode=modes[int(rng.integers(0, len(modes)))],
+                )
+            )
+    return wls
+
+
+ALL_MODES = ("Required", "Preferred", "Unconstrained")
+
+
+class TestTASDrainWidenedScope:
+    """VERDICT r4 item 4: preferred-mode level relaxation, unconstrained
+    mode, and multiple TAS flavors per drain — all in kernel, zero
+    fallback."""
+
+    def test_preferred_relaxes_to_block(self):
+        # one rack holds 4 hosts x 8 cpu = 16 pods at 2 cpu; 20 pods
+        # can't fit one rack, so Preferred relaxes to the block level
+        # and splits across its racks (Required at RACK would park)
+        wls = [
+            dict(name="pref", lq="lq-0", count=20, cpu="2", level=RACK,
+                 t=1.0, mode="Preferred"),
+            dict(name="reqd", lq="lq-1", count=20, cpu="2", level=RACK,
+                 t=2.0, mode="Required"),
+        ]
+        h_adm, h_park = host_trace(wls)
+        d_adm, d_park, outcome = device_trace(wls)
+        assert not outcome.fallback
+        assert d_adm == h_adm
+        assert d_park == h_park
+        assert "pref" in d_adm and "reqd" in d_park
+        # the placement genuinely spans more than one rack
+        racks_used = {v[:2] for v, _ in d_adm["pref"][1]}
+        assert len(racks_used) > 1
+
+    def test_preferred_multi_domain_at_top(self):
+        # no single BLOCK holds 50 pods at 2 cpu (a block = 3 racks x
+        # 16 pods = 48): the preferred search falls through to the
+        # multi-domain take across blocks (:450-465)
+        wls = [
+            dict(name="huge", lq="lq-0", count=50, cpu="2", level=RACK,
+                 t=1.0, mode="Preferred"),
+        ]
+        h_adm, h_park = host_trace(wls)
+        d_adm, d_park, outcome = device_trace(wls)
+        assert not outcome.fallback
+        assert d_adm == h_adm and d_park == h_park
+        assert "huge" in d_adm
+        blocks_used = {v[:1] for v, _ in d_adm["huge"][1]}
+        assert len(blocks_used) > 1
+
+    def test_unconstrained_splits_at_leaf(self):
+        # unconstrained: single host if possible, else greedy across
+        # hosts with no upward relaxation
+        wls = [
+            dict(name="u-small", lq="lq-0", count=3, cpu="2", level=HOST,
+                 t=1.0, mode="Unconstrained"),
+            dict(name="u-big", lq="lq-1", count=10, cpu="2", level=HOST,
+                 t=2.0, mode="Unconstrained"),
+        ]
+        h_adm, h_park = host_trace(wls)
+        d_adm, d_park, outcome = device_trace(wls)
+        assert not outcome.fallback
+        assert d_adm == h_adm and d_park == h_park
+        assert len(d_adm["u-small"][1]) == 1  # one host suffices
+        assert len(d_adm["u-big"][1]) > 1  # 10 pods x 2 cpu > one host
+
+    def test_two_flavors_segment_by_queue(self):
+        wls = [
+            dict(name="a1", lq="lq-0", count=6, cpu="2", level=RACK, t=1.0),
+            dict(name="b1", lq="lq-1", count=6, cpu="2", level=RACK, t=2.0),
+            dict(name="a2", lq="lq-2", count=4, cpu="1", level=HOST, t=3.0),
+            dict(name="b2", lq="lq-3", count=4, cpu="1", level=HOST, t=4.0),
+        ]
+        sched, qm, cache, tas = build_env_two_flavors()
+        for w in wls:
+            qm.add_or_update_workload(tas_wl(**w))
+        pending = []
+        for cq_name, pq in qm.cluster_queues.items():
+            for wl in pq.snapshot_sorted():
+                pending.append((wl, cq_name))
+        outcome = run_drain_tas(
+            take_snapshot(cache), pending, cache.flavors, tas,
+            timestamp_fn=lambda wl: queue_order_timestamp(wl, qm._ts_policy),
+        )
+        assert not outcome.fallback
+        assigned = {
+            wl.name: ta for (wl, _, _, _), ta in
+            zip(outcome.admitted, outcome.assignments)
+        }
+        assert set(assigned) == {"a1", "b1", "a2", "b2"}
+        # flavor isolation: deep-topology hosts are ha-*, flat hb-*
+        for name, prefix in (("a1", "ha-"), ("a2", "ha-"),
+                             ("b1", "hb-"), ("b2", "hb-")):
+            hosts = {v[-1] for v in
+                     (d.values for d in assigned[name].domains)}
+            assert all(h.startswith(prefix) for h in hosts), (name, hosts)
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_randomized_all_modes(self, seed):
+        wls = tas_spec(seed + 100, modes=ALL_MODES)
+        h_adm, h_park = host_trace(wls)
+        d_adm, d_park, outcome = device_trace(wls)
+        assert not outcome.fallback
+        assert d_adm == h_adm
+        assert d_park == h_park
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_randomized_two_flavors(self, seed):
+        wls = two_flavor_spec(seed, modes=ALL_MODES)
+
+        def host():
+            sched, qm, cache, _ = build_env_two_flavors()
+            for w in wls:
+                qm.add_or_update_workload(tas_wl(**w))
+            admitted, cycle = {}, 0
+            for _ in range(100):
+                if not any(
+                    pq.pending_active() > 0
+                    for pq in qm.cluster_queues.values()
+                ):
+                    break
+                res = sched.schedule()
+                for e in res.admitted:
+                    psa = e.workload.admission.pod_set_assignments[0]
+                    ta = psa.topology_assignment
+                    admitted[e.workload.name] = (
+                        cycle,
+                        tuple(sorted((d.values, d.count) for d in ta.domains)),
+                    )
+                cycle += 1
+            parked = {
+                wl.name
+                for pq in qm.cluster_queues.values()
+                for wl in list(pq.inadmissible.values()) + list(pq.heap.items())
+            }
+            return admitted, parked
+
+        def device():
+            sched, qm, cache, tas = build_env_two_flavors()
+            for w in wls:
+                qm.add_or_update_workload(tas_wl(**w))
+            pending = []
+            for cq_name, pq in qm.cluster_queues.items():
+                for wl in pq.snapshot_sorted():
+                    pending.append((wl, cq_name))
+            outcome = run_drain_tas(
+                take_snapshot(cache), pending, cache.flavors, tas,
+                timestamp_fn=lambda wl: queue_order_timestamp(
+                    wl, qm._ts_policy
+                ),
+            )
+            admitted = {}
+            for (wl, _, _, cycle), ta in zip(
+                outcome.admitted, outcome.assignments
+            ):
+                admitted[wl.name] = (
+                    cycle,
+                    tuple(sorted((d.values, d.count) for d in ta.domains)),
+                )
+            return admitted, {wl.name for wl, _ in outcome.parked}, outcome
+
+        h_adm, h_park = host()
+        d_adm, d_park, outcome = device()
         assert not outcome.fallback
         assert d_adm == h_adm
         assert d_park == h_park
