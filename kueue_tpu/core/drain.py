@@ -323,7 +323,9 @@ def _lower_victim_pools(
     max_victims: int,
     max_victim_cells: int,
     max_cycles: Optional[int],
-    extra_segment_bad=None,  # fn(s, members) -> bool: extra scope veto
+    # fn(s, members, seg_queues_s) -> bool: extra scope veto, given the
+    # segment id, its member CQ rows and its queue-index list
+    extra_segment_bad=None,
 ) -> _VictimLowering:
     """Build the SegVictims arrays + metadata for a preemption drain
     (the shared middle of run_drain_preempt, unchanged semantics) and
@@ -1108,6 +1110,14 @@ def run_drain_fair_preempt(
     return _preempt_outcome(plan, low, flat, queues_np, fair=True)
 
 
+# caps keeping the TAS placement kernel's i32 prefix sums exact:
+# MAX_TAS_COUNT * MAX_TAS_DOMAINS < 2^31 (drain_kernel.split). Gangs
+# above a million pods or merged forests above 2048 leaves route to the
+# host cycle loop.
+MAX_TAS_COUNT = 1 << 20
+MAX_TAS_DOMAINS = 1 << 11
+
+
 def _merge_tas_forests(snaps, union_res, d_global):
     """Concatenate per-flavor topologies into ONE disjoint domain
     forest, aligned at the LEAF level.
@@ -1294,17 +1304,22 @@ def run_drain_tas(
         tas_queue[qi] = next(iter(tnames))
 
     # per-flavor snapshots; tainted flavors stay host-side (the kernel
-    # has no toleration filtering)
+    # has no toleration filtering), and the merged forest caps its
+    # domain axis so the placement kernel's i32 prefix sums stay exact
+    # (MAX_TAS_COUNT x MAX_TAS_DOMAINS < 2^31 — see drain_kernel.split)
     flavor_names = sorted(set(tas_queue.values()))
     snaps: Dict[str, object] = {}
+    total_leaves = 0
     for fname in flavor_names:
         s = tas_cache.flavors[fname].snapshot()
         s.freeze()
-        if any(t for t in s._leaf_taints):
+        over = total_leaves + len(s._leaf_order) > MAX_TAS_DOMAINS
+        if over or any(t for t in s._leaf_taints):
             for qi in [k for k, v in tas_queue.items() if v == fname]:
                 drop.append(qi)
                 del tas_queue[qi]
         else:
+            total_leaves += len(s._leaf_order)
             snaps[fname] = s
     flavor_names = sorted(snaps)
     flavor_idx = {f: i for i, f in enumerate(flavor_names)}
@@ -1359,6 +1374,9 @@ def run_drain_tas(
             per_pod[PODS] = per_pod.get(PODS, 0) + 1
             if any(r not in r_index_f for r in per_pod):
                 ok = False
+                break
+            if int(ps.count) > MAX_TAS_COUNT:
+                ok = False  # keeps the kernel's i32 prefix sums exact
                 break
             for r, v in per_pod.items():
                 t_req[qi, pos, u_index[r]] = int(v)

@@ -746,11 +746,22 @@ def _tas_fit_and_place(
 
     def split(s, child_ok):
         """Greedy desc-order fill of ``cnt`` over the masked domains
-        with the BestFit jump (tas_flavor_snapshot.go:468-511)."""
+        with the BestFit jump (tas_flavor_snapshot.go:468-511).
+
+        The prefix sum runs in int32 on values clamped to ``cnt``: the
+        positions at/before the covering domain all have state <
+        remaining <= cnt, so clamping changes nothing there, and later
+        positions are never read (argmax takes the FIRST covered). An
+        s64 cumsum lowers to a u32-pair variadic reduce-window on TPU
+        whose scoped-vmem footprint blows the 16M limit at wide domain
+        axes (observed at [100, 1024]); i32 halves it. Exact given the
+        lowering's count/domain caps (MAX_TAS_COUNT x MAX_TAS_DOMAINS
+        < 2^31)."""
         sm = jnp.where(child_ok, s, jnp.int64(-1))
         order = jnp.lexsort((jnp.arange(nd_max), -sm))
         ss = sm[order]
-        prefix = jnp.cumsum(jnp.maximum(ss, 0)) - jnp.maximum(ss, 0)
+        ss_c = jnp.minimum(jnp.maximum(ss, 0), cnt).astype(jnp.int32)
+        prefix = (jnp.cumsum(ss_c) - ss_c).astype(jnp.int64)
         remaining = cnt - prefix
         # the host walk never evaluates a position with remaining <= 0
         # (the covering take returns first), so pads/zero-state domains

@@ -229,6 +229,85 @@ def random_spec(seed, with_preemption=False, n_cohorts=2, cqs_per_cohort=3,
     return {"flavors": flavors, "cqs": cqs, "workloads": workloads}
 
 
+class TestResidentCycleState:
+    def test_delta_updates_and_invalidation(self):
+        """dispatch_lowered with device-resident tensors must decide
+        identically to a fresh-ship dispatch across usage mutations
+        (delta path) and quota edits (structure invalidation)."""
+        from kueue_tpu.core.queue_manager import queue_order_timestamp
+        from kueue_tpu.core.snapshot import take_snapshot
+        from kueue_tpu.core.solver import (
+            ResidentCycleState,
+            dispatch_lowered,
+            lower_heads,
+        )
+        from kueue_tpu.core.workload_info import make_admission
+        from kueue_tpu.models import Workload, WorkloadConditionType
+        from kueue_tpu.models.workload import PodSet
+
+        spec = random_spec(21)
+        sched, mgr, cache, _ = build_env(spec, use_solver=False)
+        resident = ResidentCycleState()
+        ts = lambda wl: queue_order_timestamp(wl, mgr._ts_policy)  # noqa: E731
+
+        def compare():
+            heads = [
+                (wl, cq)
+                for cq, pq in mgr.cluster_queues.items()
+                for wl in pq.snapshot_sorted()
+            ]
+            snapshot = take_snapshot(cache)
+            lowered = lower_heads(
+                snapshot, heads, cache.flavors, timestamp_fn=ts
+            )
+            fresh = dispatch_lowered(snapshot, lowered)
+            res = dispatch_lowered(snapshot, lowered, resident=resident)
+            np.testing.assert_array_equal(fresh.chosen, res.chosen)
+            np.testing.assert_array_equal(fresh.admitted, res.admitted)
+            np.testing.assert_array_equal(fresh.reserved, res.reserved)
+
+        compare()  # cold: full upload
+        assert resident.full_uploads == 1
+
+        # admit a workload -> a few changed usage rows ship as a delta
+        cq_name = spec["cqs"][0]["name"]
+        flavor = spec["cqs"][0]["groups"][0]["flavors"][0][0]
+        wl = Workload(
+            namespace="ns", name="resident-victim",
+            queue_name=f"lq-{cq_name}", priority=0, creation_time=500.0,
+            pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
+        )
+        wl.admission = make_admission(cq_name, {"main": {"cpu": flavor}}, wl)
+        wl.set_condition(
+            WorkloadConditionType.QUOTA_RESERVED, True,
+            reason="QuotaReserved", now=500.0,
+        )
+        cache.add_or_update_workload(wl)
+        compare()
+        assert resident.full_uploads == 1  # no re-upload
+        assert resident.delta_rows >= 1
+
+        # quota edit -> structure fingerprint changes -> full re-upload
+        from kueue_tpu.models import ClusterQueue
+        from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+
+        cq0 = cache.cluster_queues[cq_name].model
+        new_groups = (
+            ResourceGroup(
+                ("cpu",),
+                (FlavorQuotas.build(flavor, {"cpu": "99"}),),
+            ),
+        )
+        cache.add_or_update_cluster_queue(
+            ClusterQueue(
+                name=cq_name, cohort=cq0.cohort,
+                namespace_selector={}, resource_groups=new_groups,
+            )
+        )
+        compare()
+        assert resident.full_uploads == 2
+
+
 class TestSolverPathParity:
     @pytest.mark.parametrize("seed", range(12))
     def test_randomized_fit_only(self, seed):
