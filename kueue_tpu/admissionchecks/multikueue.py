@@ -21,6 +21,7 @@ boundary is the gRPC/DCN link between control planes). The controller:
 
 from __future__ import annotations
 
+import zlib
 from copy import deepcopy
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -173,11 +174,23 @@ class MultiKueueController:
         self.batch_dispatch = batch_dispatch
         self.base_backoff_s = base_backoff_s
         self.max_backoff_s = max_backoff_s
-        self._create_buffer: Dict[str, List[Workload]] = {}
+        # cluster -> workload key -> buffered copy (keyed so the dedup
+        # check at buffering time and _unbuffer at winner pick are O(1)
+        # — at 10k-workload dispatch waves a list scan per pick is
+        # O(picks x backlog))
+        self._create_buffer: Dict[str, Dict[str, Workload]] = {}
         # pass-boundary detection for the lazy flush backstop
         self._seen_this_pass: set = set()
         self.gc_interval_s = gc_interval_s
         self._last_gc = float("-inf")
+        # dispatch telemetry (the perf harness's at-scale scenario
+        # asserts the first-reserving race path actually runs and the
+        # winner load spreads): workloads observed with >1 cluster
+        # reserving at pick time, and the latest winner per workload —
+        # a re-pick after worker loss overwrites instead of
+        # double-counting, so sum(winner_counts) == workloads picked
+        self.first_reserving_races = 0
+        self._winner_by_key: Dict[str, str] = {}
         # workload key -> winning cluster name
         self._reserving: Dict[str, str] = {}
         # workload key -> clusters that ever received copies; non-winner
@@ -190,6 +203,13 @@ class MultiKueueController:
     def __call__(self, wl: Workload) -> None:
         """Registered directly on runtime.admission_check_controllers."""
         self.reconcile(wl)
+
+    @property
+    def winner_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for name in self._winner_by_key.values():
+            out[name] = out.get(name, 0) + 1
+        return out
 
     # ---- wiring ----
     def add_cluster(self, cluster: MultiKueueCluster) -> None:
@@ -217,12 +237,26 @@ class MultiKueueController:
                 out.append(name)
         return out
 
-    def _clusters_for_check(self, check_name: str) -> List[MultiKueueCluster]:
+    def _clusters_for_check(
+        self, check_name: str, rotate_for: str = ""
+    ) -> List[MultiKueueCluster]:
         ac = self.runtime.cache.admission_checks.get(check_name)
         cfg = self.configs.get(ac.parameters or "") if ac else None
         if cfg is None:
             return []
-        return [self.clusters[c] for c in cfg.clusters if c in self.clusters]
+        out = [self.clusters[c] for c in cfg.clusters if c in self.clusters]
+        if rotate_for and len(out) > 1:
+            # The reference reads the cluster set out of a Go map, so the
+            # scan order — and with it which of several simultaneous
+            # reservers "wins first" — is arbitrary per reconcile
+            # (multikueue_types.go cluster set; workload.go:381 takes the
+            # first found). Rotating by a stable workload-key hash keeps
+            # that no-structural-favorite property while staying
+            # deterministic for tests: in a symmetric lockstep system a
+            # fixed order would funnel every win to cluster[0].
+            off = zlib.crc32(rotate_for.encode()) % len(out)
+            out = out[off:] + out[:off]
+        return out
 
     def _local_job_for(self, wl: Workload):
         # O(1) via the runtime's workload->job index (the reference
@@ -246,10 +280,11 @@ class MultiKueueController:
 
     def _unbuffer(self, wl_key: str) -> None:
         """Drop pending batched creates for a workload whose dispatch
-        intent is gone (deleted/finished/un-reserved locally) — a stale
-        buffered create must never materialize an orphan remote."""
+        intent is gone (deleted/finished/un-reserved locally, or a
+        winner was picked) — a stale buffered create must never
+        materialize an orphan remote."""
         for batch in self._create_buffer.values():
-            batch[:] = [w for w in batch if w.key != wl_key]
+            batch.pop(wl_key, None)
 
     # ---- reconcile (workload.go:159-425) ----
     def reconcile(self, wl: Workload) -> None:
@@ -269,10 +304,20 @@ class MultiKueueController:
         checks = self._relevant_checks(wl)
         if not checks:
             return
+        if (
+            wl.is_finished
+            and wl.key not in self._reserving
+            and not self._dispatched.get(wl.key)
+        ):
+            # fully reaped: no remote copies, no buffered creates with
+            # intent recorded — skip the per-cluster GC probing (at 10k
+            # finished workloads that's 4 wire calls per workload per
+            # pass for nothing)
+            return
         now = self.runtime.clock.now()
         check = checks[0]
         state = wl.admission_check_states[check]
-        clusters = self._clusters_for_check(check)
+        clusters = self._clusters_for_check(check, rotate_for=wl.key)
         job = self._local_job_for(wl)
         adapter = self.adapters.get(job.kind if job is not None else "Job")
 
@@ -321,9 +366,8 @@ class MultiKueueController:
                 if rwl is None:
                     copy = self._remote_copy(wl)
                     if self.batch_dispatch:
-                        buf = self._create_buffer.setdefault(cluster.name, [])
-                        if all(w.key != copy.key for w in buf):
-                            buf.append(copy)
+                        buf = self._create_buffer.setdefault(cluster.name, {})
+                        buf.setdefault(copy.key, copy)
                     else:
                         cluster.call("create_workload", copy)
                 self._dispatched.setdefault(wl.key, set()).add(cluster.name)
@@ -347,7 +391,16 @@ class MultiKueueController:
             return
 
         winner = reserving[0]  # FirstReserving wins (workload.go:381)
+        if len(reserving) > 1:
+            self.first_reserving_races += 1
+        self._winner_by_key[wl.key] = winner.name
         self._reserving[wl.key] = winner.name
+        # a loser whose create is still only BUFFERED (it was
+        # unreachable at the last flush) has no remote copy for
+        # _delete_on to remove — drop the pending create too, or the
+        # end-of-pass flush materializes an untracked duplicate that
+        # reserves quota and runs the job alongside the winner
+        self._unbuffer(wl.key)
         for cluster in clusters:
             if cluster.name != winner.name:
                 self._delete_on(cluster, wl.key, job, adapter)
@@ -370,23 +423,22 @@ class MultiKueueController:
             if not batch or not cluster.client.reachable():
                 continue
             try:
-                cluster.call("create_workloads", batch)
-                self._create_buffer[name] = []
+                cluster.call("create_workloads", list(batch.values()))
+                self._create_buffer[name] = {}
             except ClusterUnreachable:
                 pass  # retried next pass; dispatch sets keep the intent
             except RemoteRejected:
                 # some object in the batch was refused: resolve per-item
                 # (rejected items drop; unreachable keeps the remainder)
-                remaining = list(batch)
-                while remaining:
-                    w = remaining[0]
+                remaining = dict(batch)
+                for key, w in list(remaining.items()):
                     try:
                         cluster.call("create_workload", w)
                     except RemoteRejected:
                         pass  # refused: dropped (reconcile re-reports)
                     except ClusterUnreachable:
                         break
-                    remaining.pop(0)
+                    remaining.pop(key)
                 self._create_buffer[name] = remaining
         self._seen_this_pass.clear()
         # periodic orphan GC (multiKueue.gcInterval; workload.go GC of

@@ -56,6 +56,7 @@ class ClusterRuntime:
         preempt_solver_threshold: int = 4,
         resources=None,  # config.ResourceSettings (quota-view transform)
         bulk_drain_threshold: Optional[int] = 256,
+        drain_gate=None,  # latency-gate override (perf harness pins it open)
     ):
         from kueue_tpu.metrics import Metrics
 
@@ -145,7 +146,7 @@ class ClusterRuntime:
         from kueue_tpu.core.scheduler import _LatencyEstimate
 
         self.bulk_drain_threshold = bulk_drain_threshold
-        self._drain_est = _LatencyEstimate()
+        self._drain_est = drain_gate if drain_gate is not None else _LatencyEstimate()
 
     def _make_preemptor(self, fair_sharing: bool):
         from kueue_tpu.core.preemption import Preemptor

@@ -53,16 +53,19 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0,
                     help="scale workload counts (1.0 = the full 2500-workload scenario)")
-    ap.add_argument("--scenario", choices=["default", "contended", "both"],
-                    default="both")
+    ap.add_argument(
+        "--scenario",
+        choices=["default", "contended", "multikueue", "both", "all"],
+        default="both",
+    )
     args = ap.parse_args()
 
     out = {}
     failed = False
     runs = []
-    if args.scenario in ("default", "both"):
+    if args.scenario in ("default", "both", "all"):
         runs.append(("default", DEFAULT_GENERATOR_CONFIG, DEFAULT_RANGE_SPEC))
-    if args.scenario in ("contended", "both"):
+    if args.scenario in ("contended", "both", "all"):
         runs.append(
             ("contended", CONTENDED_GENERATOR_CONFIG, CONTENDED_RANGE_SPEC)
         )
@@ -73,6 +76,39 @@ def main() -> int:
         violations = check(result, spec)
         failed = failed or bool(violations)
         out[name] = _report(result, violations)
+    if args.scenario in ("multikueue", "all"):
+        # BASELINE config #5: 4 worker clusters x 10k workloads through
+        # batched cross-cluster dispatch (virtual time; full runtimes)
+        from kueue_tpu.perf.multikueue import (
+            MULTIKUEUE_RANGE_SPEC,
+            check_mk,
+            run_multikueue,
+        )
+
+        mk = run_multikueue(
+            n_workers=4, n_workloads=max(1, int(10_000 * args.scale))
+        )
+        mk_violations = check_mk(mk, MULTIKUEUE_RANGE_SPEC)
+        failed = failed or bool(mk_violations)
+        out["multikueue"] = {
+            "wall_s": round(mk.wall_s, 2),
+            "virtual_s": round(mk.virtual_s, 2),
+            "workers": mk.n_workers,
+            "total": mk.total,
+            "dispatched": mk.dispatched,
+            "finished": mk.finished,
+            "dispatch_per_sec_wall": round(mk.dispatch_per_sec_wall, 1),
+            "driver_iterations": mk.driver_iterations,
+            "unbatched_creates": mk.unbatched_creates,
+            "batched_exchanges": mk.batched_exchanges,
+            "avg_batch": round(mk.avg_batch, 1),
+            "max_batch": mk.max_batch,
+            "first_reserving_races": mk.first_reserving_races,
+            "winner_counts": mk.winner_counts,
+            "orphans_gced": mk.orphans_gced,
+            "remote_leftovers": mk.remote_leftovers,
+            "violations": mk_violations,
+        }
     # the reference runner completes the default scenario in ~351s wall
     # (default_rangespec.yaml) — dominated by apiserver round-trips; the
     # dense in-process core is throughput-bound only

@@ -212,14 +212,16 @@ class TestBatchedDispatch:
             # creates went out ONLY through the batched exchange
             assert "create_workload" not in tr.ops
             assert "create_workloads" in tr.ops
-        # every workload reached a reservation through the batched path,
-        # the winner holds all copies, the loser's were dropped
+        # every workload reached a reservation through the batched path;
+        # per workload, the winner holds its copy and the losers' were
+        # dropped (the cluster scan order rotates per workload key, so
+        # wins spread instead of funneling to clusters[0])
         for i in range(5):
-            assert f"ns/b{i}" in ctrl._reserving
-        winners = {ctrl._reserving[f"ns/b{i}"] for i in range(5)}
-        for name, w in workers.items():
-            held = [k for k in w.runtime.workloads if k.startswith("ns/b")]
-            assert len(held) == (5 if name in winners else 0)
+            key = f"ns/b{i}"
+            assert key in ctrl._reserving
+            winner = ctrl._reserving[key]
+            for name, w in workers.items():
+                assert (key in w.runtime.workloads) == (name == winner)
 
     def test_batch_survives_transport_failure(self):
         rt, ctrl, workers, clock = mk_setup(batch_dispatch=True)
@@ -228,6 +230,29 @@ class TestBatchedDispatch:
         drive(rt, workers)
         # dispatched to the healthy cluster regardless
         assert "ns/resilient" in workers["w2"].runtime.workloads
+
+    def test_winner_pick_drops_losers_buffered_creates(self):
+        """A loser whose create was still buffered (cluster unreachable
+        at the last flush) must NOT get the copy materialized by a later
+        flush: that copy would be invisible to _cleanup_stale_dispatches
+        and gc_orphans (local owner exists) and run the job in duplicate
+        alongside the winner."""
+        rt, ctrl, workers, clock = mk_setup(batch_dispatch=True)
+        w = wl("buffered-loser")
+        # w2 is down: its create stays in the batch buffer at flush time
+        workers["w2"].mark_lost(clock.now())
+        rt.add_workload(w)
+        drive(rt, workers)
+        assert ctrl._reserving.get(w.key) == "w1"  # only reachable cluster
+        # reconnect w2 AFTER the winner was picked; subsequent passes
+        # flush whatever is still buffered
+        clock.advance(1000.0)
+        workers["w2"].mark_connected()
+        drive(rt, workers)
+        assert w.key not in workers["w2"].runtime.workloads, (
+            "buffered create materialized an orphan copy on the loser"
+        )
+        assert w.key in workers["w1"].runtime.workloads
 
 
 class TestHTTPTransportDispatch:

@@ -114,3 +114,66 @@ class TestContendedScenario:
         result = run(DEFAULT_GENERATOR_CONFIG.scaled(0.04), use_solver=False)
         errs = check(result, CONTENDED_RANGE_SPEC)
         assert errs  # no backlog, zero TTAs -> floors flag it
+
+
+class TestMultiKueueAtScale:
+    """BASELINE config #5 at test scale: worker clusters x workloads
+    through batched dispatch, full lifecycle to completion
+    (workload.go:298-425 behaviors at fleet granularity)."""
+
+    def test_dispatch_lifecycle_floors(self):
+        from kueue_tpu.perf.multikueue import (
+            MULTIKUEUE_RANGE_SPEC,
+            check_mk,
+            run_multikueue,
+        )
+
+        # 320 workloads over 4 workers; capacity forces ~2 dispatch
+        # waves; backlog (320) clears the 256 bulk-drain threshold so
+        # the device drain and the batched dispatch compose
+        r = run_multikueue(
+            n_workers=4,
+            n_workloads=320,
+            worker_cpu_each=40,
+            n_queues=8,
+        )
+        assert check_mk(r, MULTIKUEUE_RANGE_SPEC) == []
+        assert r.finished == r.total == 320
+        # wire efficiency: every create rode a batched exchange, and
+        # batches were real (≥ tens of creates per exchange on average)
+        assert r.unbatched_creates == 0
+        assert r.total_batched_creates >= 4 * 320  # a copy per cluster
+        assert r.avg_batch >= 10.0
+        # the first-reserving race path genuinely ran and resolved
+        assert r.first_reserving_races > 0
+        # the load spread across ALL workers (scan-order rotation)
+        assert set(r.winner_counts) == {f"worker{i}" for i in range(4)}
+        assert min(r.winner_counts.values()) >= 0.05 * r.total
+        assert sum(r.winner_counts.values()) == r.total
+        # hygiene: no origin-labeled remote survives the final GC
+        assert r.remote_leftovers == 0
+
+    def test_checker_flags_unbatched_and_orphans(self):
+        from kueue_tpu.perf.multikueue import (
+            MKRangeSpec,
+            MKRunResult,
+            check_mk,
+        )
+
+        bad = MKRunResult(
+            wall_s=1.0, virtual_s=1.0, n_workers=4, total=10, dispatched=10,
+            finished=9, driver_iterations=1, unbatched_creates=3,
+            batched_exchanges=2, total_batched_creates=4, max_batch=2,
+            avg_batch=1.5, first_reserving_races=0,
+            winner_counts={"worker0": 10},
+            orphans_gced=0, remote_leftovers=2,
+        )
+        errs = check_mk(bad, MKRangeSpec())
+        joined = "\n".join(errs)
+        assert "finished 9/10" in joined
+        assert "bypassed the batched exchange" in joined
+        assert "races" in joined
+        assert "survived GC" in joined
+        # a worker that never won is a spread violation even though the
+        # per-worker share loop can only see workers that DID win
+        assert "only 1/4 workers" in joined
