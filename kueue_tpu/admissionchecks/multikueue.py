@@ -186,10 +186,14 @@ class MultiKueueController:
         # dispatch telemetry (the perf harness's at-scale scenario
         # asserts the first-reserving race path actually runs and the
         # winner load spreads): workloads observed with >1 cluster
-        # reserving at pick time, and the latest winner per workload —
-        # a re-pick after worker loss overwrites instead of
-        # double-counting, so sum(winner_counts) == workloads picked
+        # reserving at pick time, and winner picks per cluster.
+        # winner_counts aggregates (finished workloads stay counted);
+        # _winner_by_key only tracks IN-FLIGHT picks so a re-pick after
+        # worker loss moves the count instead of double-counting, and is
+        # pruned at reap — the telemetry must not grow with every
+        # workload the controller has ever seen
         self.first_reserving_races = 0
+        self.winner_counts: Dict[str, int] = {}
         self._winner_by_key: Dict[str, str] = {}
         # workload key -> winning cluster name
         self._reserving: Dict[str, str] = {}
@@ -203,13 +207,6 @@ class MultiKueueController:
     def __call__(self, wl: Workload) -> None:
         """Registered directly on runtime.admission_check_controllers."""
         self.reconcile(wl)
-
-    @property
-    def winner_counts(self) -> Dict[str, int]:
-        out: Dict[str, int] = {}
-        for name in self._winner_by_key.values():
-            out[name] = out.get(name, 0) + 1
-        return out
 
     # ---- wiring ----
     def add_cluster(self, cluster: MultiKueueCluster) -> None:
@@ -393,7 +390,13 @@ class MultiKueueController:
         winner = reserving[0]  # FirstReserving wins (workload.go:381)
         if len(reserving) > 1:
             self.first_reserving_races += 1
+        prev = self._winner_by_key.get(wl.key)
+        if prev is not None:  # re-pick: move the count, don't double it
+            self.winner_counts[prev] -= 1
         self._winner_by_key[wl.key] = winner.name
+        self.winner_counts[winner.name] = (
+            self.winner_counts.get(winner.name, 0) + 1
+        )
         self._reserving[wl.key] = winner.name
         # a loser whose create is still only BUFFERED (it was
         # unreachable at the last flush) has no remote copy for
@@ -546,5 +549,6 @@ class MultiKueueController:
         for cluster in clusters:
             self._delete_on(cluster, wl.key, job, adapter)
         self._reserving.pop(wl.key, None)
+        self._winner_by_key.pop(wl.key, None)
         if not self._dispatched.get(wl.key):
             self._dispatched.pop(wl.key, None)

@@ -102,12 +102,23 @@ class HTTPTransport(RemoteTransport):
     state machine drives reconnects exactly like the in-process fakes.
     """
 
-    def __init__(self, base_url: str, timeout: float = 10.0, token=None):
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 10.0,
+        token=None,
+        ca_cert=None,
+        insecure: bool = False,
+    ):
         from kueue_tpu.server import KueueClient
 
         # token: bearer credential for workers started with
-        # --auth-token (the kubeconfig credential analog)
-        self.client = KueueClient(base_url, timeout=timeout, token=token)
+        # --auth-token; ca_cert/insecure: TLS trust for https workers
+        # (the kubeconfig credential + certificate-authority analogs)
+        self.client = KueueClient(
+            base_url, timeout=timeout, token=token,
+            ca_cert=ca_cert, insecure=insecure,
+        )
 
     def _wrap(self, fn, *args):
         import urllib.error

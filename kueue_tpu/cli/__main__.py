@@ -71,6 +71,38 @@ def _parse_labels(spec: str) -> Dict[str, str]:
 
 
 # ---- create ----
+def _server_client(args):
+    """KueueClient from the shared --server connection flags."""
+    from kueue_tpu.server import KueueClient
+
+    return KueueClient(
+        args.server,
+        token=args.token,
+        ca_cert=getattr(args, "ca_cert", None),
+        insecure=getattr(args, "insecure", False),
+    )
+
+
+def _add_server_flags(parser, server_help):
+    """--server plus its credential/trust companions (the kubeconfig
+    server/token/certificate-authority triple for the CLI)."""
+    parser.add_argument("--server", help=server_help)
+    parser.add_argument(
+        "--token", default=os.environ.get("KUEUE_AUTH_TOKEN") or None,
+        help="bearer token for a secured server (default: $KUEUE_AUTH_TOKEN)",
+    )
+    parser.add_argument(
+        "--ca-cert",
+        default=os.environ.get("KUEUE_CA_CERT") or None,
+        help="CA bundle verifying an https:// server (the ca.crt from "
+        "the server's --tls-cert-dir; default: $KUEUE_CA_CERT)",
+    )
+    parser.add_argument(
+        "--insecure", action="store_true",
+        help="skip TLS verification (dev only)",
+    )
+
+
 def cmd_create_cq(state: State, args) -> None:
     quotas = _parse_quotas(args.nominal_quota)
     borrowing = _parse_quotas(args.borrowing_limit) if args.borrowing_limit else {}
@@ -278,9 +310,7 @@ def cmd_delete(state: State, args) -> None:
     namespaced = args.kind in ("workload", "localqueue")
     ns = getattr(args, "namespace", "") if namespaced else ""
     if getattr(args, "server", None):
-        from kueue_tpu.server import KueueClient
-
-        client = KueueClient(args.server, token=args.token)
+        client = _server_client(args)
         if args.kind == "workload":
             client.delete_workload(ns, args.name)
         elif args.kind == "clusterqueue":
@@ -304,9 +334,7 @@ def cmd_get(state: State, args) -> None:
     namespaced = args.kind in ("workload", "localqueue")
     ns = getattr(args, "namespace", "") if namespaced else ""
     if getattr(args, "server", None):
-        from kueue_tpu.server import KueueClient
-
-        client = KueueClient(args.server, token=args.token)
+        client = _server_client(args)
         if args.kind == "workload":
             obj = client.get_workload(ns, args.name)
         else:
@@ -328,9 +356,7 @@ def cmd_pending_workloads(state: State, args) -> None:
     if getattr(args, "server", None):
         # live query against a running kueue_tpu.server (the reference's
         # kubectl plugin hitting the visibility apiserver)
-        from kueue_tpu.server import KueueClient
-
-        summary = KueueClient(args.server, token=args.token).pending_workloads_cq(args.clusterqueue)
+        summary = _server_client(args).pending_workloads_cq(args.clusterqueue)
         rows = [
             [str(i["positionInClusterQueue"]), i["namespace"], i["name"],
              i["localQueueName"], str(i["priority"])]
@@ -515,26 +541,14 @@ def build_parser() -> argparse.ArgumentParser:
     dele.add_argument("kind", choices=sorted(_DELETE_SECTIONS))
     dele.add_argument("name")
     dele.add_argument("-n", "--namespace", default="default")
-    dele.add_argument(
-        "--server", help="delete on a running kueue_tpu.server instead of --state"
-    )
-    dele.add_argument(
-        "--token", default=os.environ.get("KUEUE_AUTH_TOKEN") or None,
-        help="bearer token for a secured server (default: $KUEUE_AUTH_TOKEN)",
-    )
+    _add_server_flags(dele, "delete on a running kueue_tpu.server instead of --state")
     dele.set_defaults(fn=cmd_delete)
 
     get = sub.add_parser("get")
     get.add_argument("kind", choices=sorted(_DELETE_SECTIONS))
     get.add_argument("name")
     get.add_argument("-n", "--namespace", default="default")
-    get.add_argument(
-        "--server", help="read from a running kueue_tpu.server instead of --state"
-    )
-    get.add_argument(
-        "--token", default=os.environ.get("KUEUE_AUTH_TOKEN") or None,
-        help="bearer token for a secured server (default: $KUEUE_AUTH_TOKEN)",
-    )
+    _add_server_flags(get, "read from a running kueue_tpu.server instead of --state")
     get.set_defaults(fn=cmd_get)
 
     ver = sub.add_parser("version")
@@ -542,13 +556,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     pw = sub.add_parser("pending-workloads")
     pw.add_argument("clusterqueue")
-    pw.add_argument(
-        "--server", help="query a running kueue_tpu.server instead of --state"
-    )
-    pw.add_argument(
-        "--token", default=os.environ.get("KUEUE_AUTH_TOKEN") or None,
-        help="bearer token for a secured server (default: $KUEUE_AUTH_TOKEN)",
-    )
+    _add_server_flags(pw, "query a running kueue_tpu.server instead of --state")
     pw.set_defaults(fn=cmd_pending_workloads)
 
     sch = sub.add_parser("schedule")

@@ -147,7 +147,33 @@ def main(argv=None) -> int:
         "debug (the secured-endpoint analog of cmd/kueue/main.go "
         "authn/z; default: $KUEUE_AUTH_TOKEN, unset = open)",
     )
+    parser.add_argument(
+        "--tls-cert-dir",
+        help="serve TLS with self-managed certs in this directory "
+        "(ca.crt/tls.crt/tls.key generated and rotated before expiry "
+        "— the internalCertManagement analog of pkg/util/cert; "
+        "clients verify against ca.crt)",
+    )
+    parser.add_argument(
+        "--tls-cert",
+        help="serve TLS with a provided certificate (PEM path; pair "
+        "with --tls-key — the provided-certificates mode of "
+        "cmd/kueue/main.go:161-168)",
+    )
+    parser.add_argument("--tls-key", help="private key for --tls-cert")
+    parser.add_argument(
+        "--tls-dns-name", action="append", default=None,
+        help="SAN for self-managed certs (repeatable; default: "
+        "--host + localhost + 127.0.0.1)",
+    )
     args = parser.parse_args(argv)
+    if bool(args.tls_cert) != bool(args.tls_key):
+        parser.error("--tls-cert and --tls-key must be given together")
+    if args.tls_cert_dir and args.tls_cert:
+        parser.error(
+            "--tls-cert-dir (self-managed) and --tls-cert (provided) "
+            "are mutually exclusive"
+        )
 
     from kueue_tpu import serialization as ser
     from kueue_tpu.server import KueueServer
@@ -231,6 +257,15 @@ def main(argv=None) -> int:
             ),
             on_started_leading=on_promoted,
         )
+    tls = None
+    if args.tls_cert_dir:
+        from kueue_tpu.utils.cert import CertRotator
+
+        sans = args.tls_dns_name or ["localhost", "127.0.0.1", args.host]
+        # dedupe, keep order (the host may already be a default SAN)
+        tls = CertRotator(args.tls_cert_dir, dns_names=list(dict.fromkeys(sans)))
+    elif args.tls_cert:
+        tls = (args.tls_cert, args.tls_key)
     srv = KueueServer(
         runtime=runtime,
         host=args.host,
@@ -238,14 +273,16 @@ def main(argv=None) -> int:
         auto_reconcile=not args.no_auto_reconcile,
         elector=elector,
         auth_token=args.auth_token,
+        tls=tls,
     )
     port = srv.start()
     ha["boot"] = False  # any later promotion is a real takeover
     role = ""
     if elector is not None:
         role = " as leader" if elector.is_leader else " as standby"
+    scheme = "https" if tls is not None else "http"
     print(
-        f"kueue-tpu server listening on http://{args.host}:{port}{role}",
+        f"kueue-tpu server listening on {scheme}://{args.host}:{port}{role}",
         flush=True,
     )
     stop = threading.Event()

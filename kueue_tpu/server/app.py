@@ -206,6 +206,7 @@ class KueueServer:
         validators: Optional[list] = None,
         elector=None,  # utils.lease.LeaderElector: HA replica mode
         auth_token: Optional[str] = None,
+        tls=None,  # utils.cert.CertRotator, or (cert_path, key_path)
     ):
         if runtime is None:
             from kueue_tpu.controllers import ClusterRuntime
@@ -238,6 +239,15 @@ class KueueServer:
         # in-cluster behind a NetworkPolicy). Probes, visibility and the
         # dashboard stay open either way.
         self.auth_token = auth_token
+        # TLS serving (cmd/kueue/main.go:154-179: secure serving with a
+        # cert watcher over rotated files). A CertRotator gives
+        # self-managed certs with pre-expiry rotation hot-reloaded into
+        # the live SSLContext; a (cert, key) path pair is the
+        # provided-certificates mode.
+        self.tls = tls
+        self._ssl_context = None
+        self._tls_rotation_stop = threading.Event()
+        self._tls_rotation_thread: Optional[threading.Thread] = None
         self._election_stop = threading.Event()
         self._election_thread: Optional[threading.Thread] = None
         # checkpoint ordering (used by __main__.fenced_checkpoint): a
@@ -389,9 +399,54 @@ class KueueServer:
         return {"items": items}
 
     # ---- http plumbing ----
-    def start(self) -> int:
+    def _load_certs(self) -> None:
+        """(Re)load the serving cert into the live SSLContext — new
+        handshakes pick it up immediately (the certwatcher analog)."""
+        if hasattr(self.tls, "cert_path"):
+            cert_path, key_path = self.tls.cert_path, self.tls.key_path
+        else:
+            cert_path, key_path = self.tls
+        self._ssl_context.load_cert_chain(cert_path, key_path)
+
+    def _tls_rotation_loop(self, period: float) -> None:
+        import sys
+        import traceback
+
+        while not self._tls_rotation_stop.wait(period):
+            try:
+                self.tls.maybe_rotate()
+            except Exception:  # noqa: BLE001 — a transient IO error on
+                # the cert volume must not kill the rotation loop (the
+                # cert would then silently expire in place) — but it
+                # must be VISIBLE: a persistently failing rotation ends
+                # in an expired cert ~a refresh window later, and the
+                # operator needs the trail
+                print("tls cert rotation failed:", file=sys.stderr)
+                traceback.print_exc()
+
+    def start(self, tls_rotation_period_s: float = 3600.0) -> int:
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((self._host, self._port), handler)
+        if self.tls is not None:
+            import ssl
+
+            if hasattr(self.tls, "ensure"):
+                self.tls.ensure()
+            self._ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._load_certs()
+            if hasattr(self.tls, "reload_hooks"):
+                self.tls.reload_hooks.append(self._load_certs)
+            self._httpd.socket = self._ssl_context.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
+            if hasattr(self.tls, "maybe_rotate"):
+                self._tls_rotation_stop.clear()
+                self._tls_rotation_thread = threading.Thread(
+                    target=self._tls_rotation_loop,
+                    args=(tls_rotation_period_s,),
+                    daemon=True,
+                )
+                self._tls_rotation_thread.start()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
@@ -422,6 +477,15 @@ class KueueServer:
         FIRST, then run ``before_release`` (the final state checkpoint),
         then release the lease — so a standby can only take over after
         the checkpoint it will reload from is fully on disk."""
+        if self._tls_rotation_thread is not None:
+            self._tls_rotation_stop.set()
+            self._tls_rotation_thread.join(timeout=5)
+            self._tls_rotation_thread = None
+        if self.tls is not None and hasattr(self.tls, "reload_hooks"):
+            try:
+                self.tls.reload_hooks.remove(self._load_certs)
+            except ValueError:
+                pass
         if self._election_thread is not None:
             self._election_stop.set()
             self._election_thread.join(timeout=5)

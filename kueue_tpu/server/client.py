@@ -20,10 +20,29 @@ class ClientError(Exception):
 
 
 class KueueClient:
-    def __init__(self, base_url: str, timeout: float = 30.0, token: Optional[str] = None):
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 30.0,
+        token: Optional[str] = None,
+        ca_cert: Optional[str] = None,
+        insecure: bool = False,
+    ):
+        """``ca_cert``: path to a CA bundle that must have signed the
+        server's cert (the kubeconfig certificate-authority analog for
+        an https:// base_url). ``insecure``: skip verification (the
+        kubeconfig insecure-skip-tls-verify analog, tests only)."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
+        self._ssl_context = None
+        if base_url.startswith("https"):
+            import ssl
+
+            if insecure:
+                self._ssl_context = ssl._create_unverified_context()
+            else:
+                self._ssl_context = ssl.create_default_context(cafile=ca_cert)
 
     def _request(self, method: str, path: str, body: Optional[dict] = None):
         data = json.dumps(body).encode() if body is not None else None
@@ -37,7 +56,9 @@ class KueueClient:
             headers=headers,
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ssl_context
+            ) as resp:
                 raw = resp.read()
                 ctype = resp.headers.get("Content-Type", "")
         except urllib.error.HTTPError as e:

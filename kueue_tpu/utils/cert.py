@@ -1,0 +1,301 @@
+"""Internal certificate management — self-signed CA + serving cert
+with rotation.
+
+Reference: pkg/util/cert/cert.go (ManageCerts wires the
+cert-controller rotator: a self-signed CA kept in a Secret signs the
+webhook serving cert, both regenerated before expiry) and
+cmd/kueue/main.go:154-179 (the metrics endpoint serves TLS through a
+certwatcher that hot-reloads rotated files).
+
+TPU-native shape: ``CertRotator`` owns a cert directory (the Secret
+analog) holding ``ca.crt``, ``tls.crt`` and ``tls.key``. ``ensure()``
+generates what's missing; ``maybe_rotate()`` re-issues the serving
+cert once it enters the refresh window (and re-roots everything when
+the CA itself nears expiry), then fires the registered reload hooks —
+the certwatcher analog; ``KueueServer`` registers a hook that reloads
+its ``ssl.SSLContext`` so new handshakes pick up the rotated cert
+without a restart.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import ipaddress
+import os
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+CA_NAME = "kueue-ca"
+CA_ORGANIZATION = "kueue"
+
+
+def _x509():
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    return x509, hashes, serialization, ec
+
+
+def _name(x509, common_name: str):
+    from cryptography.x509.oid import NameOID
+
+    return x509.Name(
+        [
+            x509.NameAttribute(NameOID.COMMON_NAME, common_name),
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, CA_ORGANIZATION),
+        ]
+    )
+
+
+def _now() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def generate_ca(
+    valid_days: int = 3650, now: Optional[_dt.datetime] = None
+) -> Tuple[bytes, bytes]:
+    """Self-signed CA (cert-controller rotator's CACert): returns
+    (cert_pem, key_pem)."""
+    x509, hashes, serialization, ec = _x509()
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = _name(x509, CA_NAME)
+    now = now or _now()
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - _dt.timedelta(minutes=5))
+        .not_valid_after(now + _dt.timedelta(days=valid_days))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0), critical=True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=True, key_cert_sign=True, crl_sign=True,
+                content_commitment=False, key_encipherment=False,
+                data_encipherment=False, key_agreement=False,
+                encipher_only=False, decipher_only=False,
+            ),
+            critical=True,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    return (
+        cert.public_bytes(serialization.Encoding.PEM),
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ),
+    )
+
+
+def issue_serving_cert(
+    ca_cert_pem: bytes,
+    ca_key_pem: bytes,
+    dns_names: Sequence[str],
+    valid_days: int = 365,
+    now: Optional[_dt.datetime] = None,
+) -> Tuple[bytes, bytes]:
+    """Serving cert signed by the CA, SANs covering ``dns_names``
+    (hostnames or IP literals — the reference's
+    <service>.<namespace>.svc DNSName)."""
+    x509, hashes, serialization, ec = _x509()
+    ca_cert = x509.load_pem_x509_certificate(ca_cert_pem)
+    ca_key = serialization.load_pem_private_key(ca_key_pem, password=None)
+    key = ec.generate_private_key(ec.SECP256R1())
+    sans: List[object] = []
+    for n in dns_names:
+        try:
+            sans.append(x509.IPAddress(ipaddress.ip_address(n)))
+        except ValueError:
+            sans.append(x509.DNSName(n))
+    now = now or _now()
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name(x509, dns_names[0] if dns_names else "kueue"))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - _dt.timedelta(minutes=5))
+        .not_valid_after(now + _dt.timedelta(days=valid_days))
+        .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
+        .add_extension(
+            x509.ExtendedKeyUsage(
+                [x509.oid.ExtendedKeyUsageOID.SERVER_AUTH]
+            ),
+            critical=False,
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+    return (
+        cert.public_bytes(serialization.Encoding.PEM),
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ),
+    )
+
+
+def cert_not_after(cert_pem: bytes) -> _dt.datetime:
+    """Expiry of the FIRST cert in ``cert_pem`` (in a CA bundle the
+    active root leads; retired overlap roots follow)."""
+    x509, *_ = _x509()
+    return x509.load_pem_x509_certificate(cert_pem).not_valid_after_utc
+
+
+_PEM_END = b"-----END CERTIFICATE-----"
+
+
+def _first_pem_block(bundle: bytes) -> bytes:
+    end = bundle.find(_PEM_END)
+    if end < 0:
+        return bundle
+    return bundle[: end + len(_PEM_END)] + b"\n"
+
+
+class CertRotator:
+    """Self-managed serving certs with pre-expiry rotation.
+
+    ``cert_dir`` is the Secret/certDir analog: ``ca.crt``, ``tls.crt``,
+    ``tls.key`` (names match the reference's mounted Secret keys,
+    cmd/kueue/main.go:166-168). ``refresh_before_days`` mirrors the
+    rotator's LookaheadInterval: the serving cert is re-issued once it
+    is within that window of expiry. Reload hooks (the certwatcher
+    analog) fire after every (re)issue.
+    """
+
+    def __init__(
+        self,
+        cert_dir: str,
+        dns_names: Sequence[str] = ("localhost", "127.0.0.1"),
+        ca_valid_days: int = 3650,
+        cert_valid_days: int = 365,
+        refresh_before_days: int = 30,
+        now_fn: Callable[[], _dt.datetime] = _now,
+    ):
+        self.cert_dir = cert_dir
+        self.dns_names = tuple(dns_names)
+        self.ca_valid_days = ca_valid_days
+        self.cert_valid_days = cert_valid_days
+        self.refresh_before = _dt.timedelta(days=refresh_before_days)
+        self._now = now_fn
+        self._lock = threading.Lock()
+        self.reload_hooks: List[Callable[[], None]] = []
+        self.rotations = 0
+
+    # file paths (mounted-Secret layout)
+    @property
+    def ca_path(self) -> str:
+        return os.path.join(self.cert_dir, "ca.crt")
+
+    @property
+    def cert_path(self) -> str:
+        return os.path.join(self.cert_dir, "tls.crt")
+
+    @property
+    def key_path(self) -> str:
+        return os.path.join(self.cert_dir, "tls.key")
+
+    @property
+    def _ca_key_path(self) -> str:
+        return os.path.join(self.cert_dir, "ca.key")
+
+    def _read(self, path: str) -> Optional[bytes]:
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def _write(self, path: str, data: bytes) -> None:
+        import tempfile
+
+        os.makedirs(self.cert_dir, exist_ok=True)
+        # unique tmp + os.replace (same discipline as
+        # utils.lease.atomic_write_text): a reader never sees a torn
+        # cert, and two processes pointed at one cert dir can't
+        # interleave writes through a shared predictable tmp name
+        fd, tmp = tempfile.mkstemp(dir=self.cert_dir, prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            if path == self._ca_key_path or path == self.key_path:
+                os.chmod(tmp, 0o600)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def ensure(self) -> None:
+        """Generate whatever is missing (first boot)."""
+        with self._lock:
+            self._ensure_locked()
+
+    def _ensure_locked(self) -> None:
+        ca_cert = self._read(self.ca_path)
+        ca_key = self._read(self._ca_key_path)
+        if ca_cert is None or ca_key is None:
+            ca_cert, ca_key = generate_ca(self.ca_valid_days, now=self._now())
+            self._write(self.ca_path, ca_cert)
+            self._write(self._ca_key_path, ca_key)
+            # a new root invalidates every cert it ever signed
+            cert = key = None
+        else:
+            cert = self._read(self.cert_path)
+            key = self._read(self.key_path)
+        if cert is None or key is None:
+            cert, key = issue_serving_cert(
+                ca_cert, ca_key, self.dns_names, self.cert_valid_days,
+                now=self._now(),
+            )
+            self._write(self.cert_path, cert)
+            self._write(self.key_path, key)
+            self.rotations += 1
+            self._fire_hooks()
+
+    def maybe_rotate(self) -> bool:
+        """Re-issue the serving cert when inside the refresh window;
+        re-root first when the CA itself is near expiry. Returns True
+        when anything was re-issued (certwatcher hooks fired)."""
+        with self._lock:
+            self._ensure_locked()
+            now = self._now()
+            rotated = False
+            ca_bundle = self._read(self.ca_path)
+            ca_cert = _first_pem_block(ca_bundle)  # active root leads
+            if cert_not_after(ca_cert) - now <= self.refresh_before:
+                new_root, ca_key = generate_ca(self.ca_valid_days, now=now)
+                # ship old+new roots together for one rotation period
+                # (the cert-controller rotator's CA overlap): clients
+                # still holding the previous ca.crt bundle keep
+                # verifying while the new root propagates — an abrupt
+                # root swap would hard-fail every existing client at
+                # the instant of rotation
+                self._write(self.ca_path, new_root + ca_cert)
+                self._write(self._ca_key_path, ca_key)
+                ca_cert = new_root
+                rotated = True  # force serving re-issue under the new root
+            cert = self._read(self.cert_path)
+            if rotated or cert_not_after(cert) - now <= self.refresh_before:
+                ca_key = self._read(self._ca_key_path)
+                cert, key = issue_serving_cert(
+                    ca_cert, ca_key, self.dns_names, self.cert_valid_days,
+                    now=now,
+                )
+                self._write(self.cert_path, cert)
+                self._write(self.key_path, key)
+                self.rotations += 1
+                self._fire_hooks()
+                return True
+            return False
+
+    def _fire_hooks(self) -> None:
+        for hook in list(self.reload_hooks):
+            hook()

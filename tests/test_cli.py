@@ -221,3 +221,55 @@ class TestScheduleDrain:
         out = capsys.readouterr().out
         assert "drain plan:" in out and "admitted=4" in out
         assert "admitted=4 pending=2" in out  # the cycle loop agrees
+
+
+class TestCLIOverTLS:
+    def test_get_against_https_server(self, tmp_path, capsys):
+        """kueuectl against a TLS server: --ca-cert verifies the
+        rotator's CA (the kubeconfig certificate-authority triple)."""
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.models import LocalQueue
+        from kueue_tpu.server import KueueServer
+        from kueue_tpu.utils.cert import CertRotator
+
+        rt = ClusterRuntime()
+        rt.add_flavor(ResourceFlavor(name="default"))
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name="cq",
+                namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",),
+                        (FlavorQuotas.build("default", {"cpu": "4"}),),
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(
+            LocalQueue(namespace="ns", name="lq", cluster_queue="cq")
+        )
+        rot = CertRotator(str(tmp_path / "certs"))
+        srv = KueueServer(runtime=rt, tls=rot)
+        port = srv.start()
+        try:
+            rc = main(
+                [
+                    "get", "clusterqueue", "cq",
+                    "--server", f"https://127.0.0.1:{port}",
+                    "--ca-cert", rot.ca_path,
+                ]
+            )
+            assert rc == 0
+            assert '"cq"' in capsys.readouterr().out
+            # without the CA the handshake must fail loudly, not fall
+            # back to plaintext
+            with pytest.raises(Exception):
+                main(
+                    [
+                        "get", "clusterqueue", "cq",
+                        "--server", f"https://127.0.0.1:{port}",
+                    ]
+                )
+        finally:
+            srv.stop()
