@@ -752,27 +752,33 @@ class ClusterRuntime:
         if self.cache.tas_cache is not None:
             tas_flavors = set(self.cache.tas_cache.flavors)
 
-        def _drainable(e) -> bool:
-            # partial admission decides at reduced counts and TAS
-            # flavors need placement state — both stay with the host
-            # cycle loop (the drain kernel has no twin for either here)
-            if sched.partial_admission and any(
-                ps.min_count is not None for ps in e.workload.pod_sets
-            ):
-                return False
-            if tas_flavors:
-                cq = snapshot.cq_models.get(e.cq_name)
-                if cq is not None and any(
-                    fq.name in tas_flavors
-                    for rg in cq.resource_groups
-                    for fq in rg.flavors
-                ):
-                    return False
-            return True
+        def _on_tas_cq(cq_name: str) -> bool:
+            cq = snapshot.cq_models.get(cq_name)
+            return cq is not None and any(
+                fq.name in tas_flavors
+                for rg in cq.resource_groups
+                for fq in rg.flavors
+            )
 
-        pending = [
-            (e.workload, e.cq_name) for e in to_assign if _drainable(e)
-        ]
+        def _drainable(e) -> bool:
+            # partial admission decides at reduced counts — that stays
+            # with the host cycle loop (no drain twin)
+            return not (
+                sched.partial_admission
+                and any(ps.min_count is not None for ps in e.workload.pod_sets)
+            )
+
+        candidates = [e for e in to_assign if _drainable(e)]
+        tas_cqs = (
+            {
+                c
+                for c in {e.cq_name for e in candidates}
+                if _on_tas_cq(c)  # one resource-group scan per CQ
+            }
+            if tas_flavors
+            else set()
+        )
+        pending = [(e.workload, e.cq_name) for e in candidates]
         if len(pending) < self.bulk_drain_threshold:
             return None
 
@@ -791,7 +797,18 @@ class ClusterRuntime:
                 != ReclaimWithinCohortPolicy.NEVER
             )
 
-        any_preempt = any(_preempt_capable(c) for c in {c for _, c in pending})
+        # TAS heads ride the drain only through run_drain_tas, which has
+        # no eviction support: with fair sharing or any preempt-capable
+        # plain CQ in the backlog, TAS heads fall to the cycle loop and
+        # the rest drains as before (the preempt scopes can't carry
+        # placement state in one dispatch)
+        plain_cqs = {c for _, c in pending} - tas_cqs
+        any_preempt = any(_preempt_capable(c) for c in plain_cqs)
+        use_tas = bool(tas_cqs) and not sched.fair_sharing and not any_preempt
+        if tas_cqs and not use_tas:
+            pending = [(w, c) for w, c in pending if c not in tas_cqs]
+            if len(pending) < self.bulk_drain_threshold:
+                return None
         if sched.fair_sharing and any_preempt:
             from kueue_tpu.core.drain import run_drain_fair_preempt
 
@@ -807,6 +824,13 @@ class ClusterRuntime:
         elif any_preempt:
             outcome = run_drain_preempt(
                 snapshot, pending, self.cache.flavors, timestamp_fn=ts_fn
+            )
+        elif use_tas:
+            from kueue_tpu.core.drain import run_drain_tas
+
+            outcome = run_drain_tas(
+                snapshot, pending, self.cache.flavors,
+                self.cache.tas_cache, timestamp_fn=ts_fn,
             )
         else:
             outcome = run_drain(
@@ -860,8 +884,11 @@ class ClusterRuntime:
         events: List[tuple] = []
         for ev in getattr(outcome, "evictions", []) or []:
             events.append((ev.cycle, 0, ev))
-        for adm in outcome.admitted:
-            events.append((adm[3], 1, adm))
+        # TASDrainOutcome aligns a TopologyAssignment per admitted entry
+        assignments = list(getattr(outcome, "assignments", []) or [])
+        for idx, adm in enumerate(outcome.admitted):
+            ta = assignments[idx] if idx < len(assignments) else None
+            events.append((adm[3], 1, (adm, ta)))
         events.sort(key=lambda t: (t[0], t[1]))
         preempting_entries: Dict[str, Entry] = {}
         for _, kind, payload in events:
@@ -870,14 +897,16 @@ class ClusterRuntime:
                     payload, preempting_entries, result
                 )
                 continue
-            wl, cq_name, fmap, _cyc = payload
+            (wl, cq_name, fmap, _cyc), ta = payload
             first = next(iter(fmap.values()), None)
             psmap = (
                 fmap
                 if isinstance(first, dict)
                 else {wl.pod_sets[0].name: fmap}
             )
-            admission = self._drain_admission(wl, cq_name, psmap)
+            admission = self._drain_admission(
+                wl, cq_name, psmap, tas_assignment=ta
+            )
             ok, _msg = self.scheduler.admit_prepared(
                 wl, cq_name, admission, snapshot.cq_models[cq_name]
             )
@@ -901,11 +930,14 @@ class ClusterRuntime:
             self.queues.park_workload(wl)
         return result
 
-    def _drain_admission(self, wl, cq_name: str, psmap):
+    def _drain_admission(self, wl, cq_name: str, psmap, tas_assignment=None):
         """Admission from a drain flavor map through the SAME quota view
         as the cycle path (AssignmentResult.to_admission): per-pod
         quantities via quota_per_pod (RuntimeClass overhead + resource
-        transforms), effective counts, implicit pods charge."""
+        transforms), effective counts, implicit pods charge. A TAS
+        drain's TopologyAssignment attaches to the topology-requesting
+        podset (single-podset scope) so cache assumption charges the
+        TAS leaf domains exactly like a cycle-path admission."""
         from kueue_tpu.core.workload_info import (
             effective_podset_count,
             quota_per_pod,
@@ -930,6 +962,11 @@ class ClusterRuntime:
                     flavors=dict(fmap),
                     resource_usage=scaled,
                     count=count,
+                    topology_assignment=(
+                        tas_assignment
+                        if ps.topology_request is not None
+                        else None
+                    ),
                 )
             )
         return Admission(cluster_queue=cq_name, pod_set_assignments=tuple(psas))

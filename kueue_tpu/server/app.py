@@ -436,9 +436,20 @@ class KueueServer:
             self._load_certs()
             if hasattr(self.tls, "reload_hooks"):
                 self.tls.reload_hooks.append(self._load_certs)
+            # handshake lazily in the per-request worker thread, NOT in
+            # the accept loop: with the default do_handshake_on_connect
+            # a single client that connects and sends nothing would
+            # block accept() — and with it every other connection,
+            # including the HTTPS probes — indefinitely. The handler
+            # timeout below bounds a stalled handshake to its own
+            # worker thread.
             self._httpd.socket = self._ssl_context.wrap_socket(
-                self._httpd.socket, server_side=True
+                self._httpd.socket,
+                server_side=True,
+                do_handshake_on_connect=False,
             )
+            if handler.timeout is None:
+                handler.timeout = 60.0
             if hasattr(self.tls, "maybe_rotate"):
                 self._tls_rotation_stop.clear()
                 self._tls_rotation_thread = threading.Thread(

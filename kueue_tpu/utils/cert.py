@@ -79,6 +79,12 @@ def generate_ca(
             ),
             critical=True,
         )
+        # SKI lets chain building (and the rotator's phase-2 check)
+        # tell same-subject roots apart across re-roots
+        .add_extension(
+            x509.SubjectKeyIdentifier.from_public_key(key.public_key()),
+            critical=False,
+        )
         .sign(key, hashes.SHA256())
     )
     return (
@@ -128,6 +134,12 @@ def issue_serving_cert(
             ),
             critical=False,
         )
+        .add_extension(
+            x509.AuthorityKeyIdentifier.from_issuer_public_key(
+                ca_cert.public_key()
+            ),
+            critical=False,
+        )
         .sign(ca_key, hashes.SHA256())
     )
     return (
@@ -155,6 +167,43 @@ def _first_pem_block(bundle: bytes) -> bytes:
     if end < 0:
         return bundle
     return bundle[: end + len(_PEM_END)] + b"\n"
+
+
+def _pem_blocks(bundle: bytes) -> List[bytes]:
+    out = []
+    rest = bundle
+    while True:
+        end = rest.find(_PEM_END)
+        if end < 0:
+            break
+        out.append(rest[: end + len(_PEM_END)] + b"\n")
+        rest = rest[end + len(_PEM_END):]
+    return out
+
+
+def _signing_root(cert_pem: bytes, bundle: bytes) -> Optional[bytes]:
+    """The bundle root whose SubjectKeyIdentifier matches the serving
+    cert's AuthorityKeyIdentifier (None when unmatched — e.g. certs
+    issued before AKI stamping)."""
+    x509, *_ = _x509()
+    cert = x509.load_pem_x509_certificate(cert_pem)
+    try:
+        aki = cert.extensions.get_extension_for_class(
+            x509.AuthorityKeyIdentifier
+        ).value.key_identifier
+    except x509.ExtensionNotFound:
+        return None
+    for root_pem in _pem_blocks(bundle):
+        root = x509.load_pem_x509_certificate(root_pem)
+        try:
+            ski = root.extensions.get_extension_for_class(
+                x509.SubjectKeyIdentifier
+            ).value.digest
+        except x509.ExtensionNotFound:
+            continue
+        if ski == aki:
+            return root_pem
+    return None
 
 
 class CertRotator:
@@ -270,20 +319,42 @@ class CertRotator:
             rotated = False
             ca_bundle = self._read(self.ca_path)
             ca_cert = _first_pem_block(ca_bundle)  # active root leads
-            if cert_not_after(ca_cert) - now <= self.refresh_before:
+            if cert_not_after(ca_cert) - now <= 2 * self.refresh_before:
+                # Two-phase re-root (the cert-controller rotator's CA
+                # overlap). Phase 1, here: generate the new root EARLY
+                # (two refresh windows before the old root expires) and
+                # ship old+new roots as one bundle — but keep SERVING
+                # the cert signed by the old root, which stays valid.
+                # Re-signing immediately would hard-fail every client
+                # still holding the pre-rotation ca.crt at the instant
+                # of rotation. Phase 2 happens when the serving cert
+                # enters its own refresh window (at most one window
+                # later): it re-signs under the bundle's newest root,
+                # by which time clients have had a full window to pick
+                # up the new bundle — and the old root is still valid
+                # for another window beyond that, covering stragglers.
                 new_root, ca_key = generate_ca(self.ca_valid_days, now=now)
-                # ship old+new roots together for one rotation period
-                # (the cert-controller rotator's CA overlap): clients
-                # still holding the previous ca.crt bundle keep
-                # verifying while the new root propagates — an abrupt
-                # root swap would hard-fail every existing client at
-                # the instant of rotation
-                self._write(self.ca_path, new_root + ca_cert)
+                ca_bundle = new_root + ca_cert
+                self._write(self.ca_path, ca_bundle)
                 self._write(self._ca_key_path, ca_key)
                 ca_cert = new_root
-                rotated = True  # force serving re-issue under the new root
+                rotated = True
             cert = self._read(self.cert_path)
-            if rotated or cert_not_after(cert) - now <= self.refresh_before:
+            reissue = cert_not_after(cert) - now <= self.refresh_before
+            if not reissue:
+                # phase 2: the ROOT that signed the current serving
+                # cert (matched by AKI/SKI — same-subject roots are
+                # otherwise indistinguishable) is one window from
+                # expiry. A long-lived serving cert chained to a dying
+                # retired root must re-sign under the new root now, not
+                # when its own validity runs out.
+                signer = _signing_root(cert, ca_bundle)
+                if (
+                    signer is not None
+                    and cert_not_after(signer) - now <= self.refresh_before
+                ):
+                    reissue = True
+            if reissue:
                 ca_key = self._read(self._ca_key_path)
                 cert, key = issue_serving_cert(
                     ca_cert, ca_key, self.dns_names, self.cert_valid_days,
@@ -293,8 +364,8 @@ class CertRotator:
                 self._write(self.key_path, key)
                 self.rotations += 1
                 self._fire_hooks()
-                return True
-            return False
+                rotated = True
+            return rotated
 
     def _fire_hooks(self) -> None:
         for hook in list(self.reload_hooks):

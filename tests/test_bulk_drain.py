@@ -647,3 +647,246 @@ class TestDrainEvictionAttribution:
             assert ev.reason == "InCohortReclamation"
             assert ev.by_workload is not None
             assert ev.by_workload.name == "reclaim-head"
+
+
+class TestTASBulkDrain:
+    """Topology-requesting backlogs through the service bulk path: one
+    run_drain_tas dispatch, decisions + TAS leaf charges identical to
+    the pure cycle loop (tas_flavor_snapshot.go placement semantics at
+    drain granularity)."""
+
+    N_TAS_CQ = 4
+    WL_PER_CQ = 20
+
+    def _build_rt(self, bulk: bool, threshold: int = 64):
+        from kueue_tpu.models import Topology
+        from kueue_tpu.models.topology import TopologyLevel
+        from kueue_tpu.tas import TASCache
+        from kueue_tpu.tas.cache import Node
+
+        BLOCK = "cloud.google.com/gce-topology-block"
+        RACK = "cloud.google.com/gce-topology-rack"
+        HOST = "kubernetes.io/hostname"
+        topo = Topology(
+            name="default",
+            levels=(
+                TopologyLevel(BLOCK), TopologyLevel(RACK), TopologyLevel(HOST)
+            ),
+        )
+        tas = TASCache()
+        tas.add_or_update_topology(topo)
+        flavor = ResourceFlavor(name="tas-flavor", topology_name="default")
+        tas.add_or_update_flavor(flavor)
+        for b in range(2):
+            for r in range(3):
+                for h in range(4):
+                    tas.add_or_update_node(
+                        Node(
+                            name=f"n-{b}-{r}-{h}",
+                            labels={
+                                BLOCK: f"b{b}",
+                                RACK: f"b{b}-r{r}",
+                                HOST: f"h-{b}-{r}-{h}",
+                            },
+                            allocatable={"cpu": 8000, "pods": 64},
+                        )
+                    )
+        clock = FakeClock(start=1000.0)
+        rt = ClusterRuntime(
+            clock=clock,
+            tas_cache=tas,
+            bulk_drain_threshold=threshold if bulk else None,
+        )
+        rt.cache.add_or_update_topology(topo)
+        rt.add_flavor(flavor)
+        for i in range(self.N_TAS_CQ):
+            rt.add_cluster_queue(
+                ClusterQueue(
+                    name=f"tcq-{i}",
+                    namespace_selector={},
+                    resource_groups=(
+                        ResourceGroup(
+                            ("cpu",),
+                            (FlavorQuotas.build("tas-flavor", {"cpu": "999"}),),
+                        ),
+                    ),
+                )
+            )
+            rt.add_local_queue(
+                LocalQueue(
+                    namespace="ns", name=f"tlq-{i}", cluster_queue=f"tcq-{i}"
+                )
+            )
+        return rt, (BLOCK, RACK, HOST)
+
+    def _seed(self, rt, levels, seed=7):
+        from kueue_tpu.models.workload import PodSetTopologyRequest
+
+        BLOCK, RACK, HOST = levels
+        rng = np.random.default_rng(seed)
+        modes = ("Required", "Preferred", "Unconstrained")
+        lvls = (BLOCK, RACK, RACK, HOST)
+        t = 0.0
+        for i in range(self.N_TAS_CQ):
+            for w in range(self.WL_PER_CQ):
+                t += 1.0
+                mode = modes[int(rng.integers(0, 3))]
+                tr = PodSetTopologyRequest(
+                    mode=mode,
+                    level=(
+                        None
+                        if mode == "Unconstrained"
+                        else lvls[int(rng.integers(0, 4))]
+                    ),
+                )
+                rt.add_workload(
+                    Workload(
+                        namespace="ns", name=f"tw-{i}-{w}",
+                        queue_name=f"tlq-{i}",
+                        creation_time=t,
+                        pod_sets=(
+                            PodSet.build(
+                                "main",
+                                int(rng.integers(1, 9)),
+                                {"cpu": str(int(rng.integers(1, 4)))},
+                                topology_request=tr,
+                            ),
+                        ),
+                    )
+                )
+
+    def _tas_leaf_usage(self, rt):
+        snap = rt.cache.tas_cache.flavors["tas-flavor"].snapshot()
+        return {
+            did: dict(u) for did, u in snap._tas_usage_map.items() if u
+        }
+
+    def test_tas_backlog_one_dispatch_parity(self):
+        rt_b, levels = self._build_rt(bulk=True)
+        self._seed(rt_b, levels)
+        rt_b.run_until_idle(max_iterations=300)
+        traces = drain_traces(rt_b)
+        assert traces, "TAS bulk path never dispatched a drain"
+        assert traces[0].heads == self.N_TAS_CQ * self.WL_PER_CQ
+        adm_b, ev_b, park_b = final_state(rt_b)
+        assert adm_b and not ev_b
+
+        rt_c, levels_c = self._build_rt(bulk=False)
+        self._seed(rt_c, levels_c)
+        rt_c.run_until_idle(max_iterations=300)
+        assert not drain_traces(rt_c)
+        assert final_state(rt_c) == (adm_b, ev_b, park_b)
+        # every admitted workload carries a real TopologyAssignment and
+        # the TAS leaf charges match the cycle loop's exactly
+        for key in adm_b:
+            psa = rt_b.workloads[key].admission.pod_set_assignments[0]
+            assert psa.topology_assignment is not None
+            assert sum(d.count for d in psa.topology_assignment.domains) > 0
+        assert self._tas_leaf_usage(rt_b) == self._tas_leaf_usage(rt_c)
+
+    def test_mixed_tas_and_plain_backlog(self):
+        """Plain quota CQs drain in the SAME run_drain_tas dispatch as
+        the TAS queues (non-TAS queues stay in the TAS drain)."""
+        rt_b, levels = self._build_rt(bulk=True)
+        rt_b.add_flavor(ResourceFlavor(name="plain"))
+        rt_b.add_cluster_queue(
+            ClusterQueue(
+                name="pcq",
+                namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",), (FlavorQuotas.build("plain", {"cpu": "40"}),)
+                    ),
+                ),
+            )
+        )
+        rt_b.add_local_queue(
+            LocalQueue(namespace="ns", name="plq", cluster_queue="pcq")
+        )
+        self._seed(rt_b, levels)
+        for w in range(30):
+            rt_b.add_workload(
+                Workload(
+                    namespace="ns", name=f"pw-{w}", queue_name="plq",
+                    creation_time=2000.0 + w,
+                    pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
+                )
+            )
+        rt_b.run_until_idle(max_iterations=300)
+        traces = drain_traces(rt_b)
+        assert traces
+        assert traces[0].heads == self.N_TAS_CQ * self.WL_PER_CQ + 30
+
+        rt_c, levels_c = self._build_rt(bulk=False)
+        rt_c.add_flavor(ResourceFlavor(name="plain"))
+        rt_c.add_cluster_queue(
+            ClusterQueue(
+                name="pcq",
+                namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",), (FlavorQuotas.build("plain", {"cpu": "40"}),)
+                    ),
+                ),
+            )
+        )
+        rt_c.add_local_queue(
+            LocalQueue(namespace="ns", name="plq", cluster_queue="pcq")
+        )
+        self._seed(rt_c, levels_c)
+        for w in range(30):
+            rt_c.add_workload(
+                Workload(
+                    namespace="ns", name=f"pw-{w}", queue_name="plq",
+                    creation_time=2000.0 + w,
+                    pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
+                )
+            )
+        rt_c.run_until_idle(max_iterations=300)
+        assert final_state(rt_c) == final_state(rt_b)
+
+    def test_preempting_plain_cq_sends_tas_to_cycle_loop(self):
+        """A preempt-capable PLAIN CQ in the backlog forces the preempt
+        drain, which cannot carry placement state: TAS heads must fall
+        to the cycle loop (not drain unplaced, not block the drain)."""
+        rt, levels = self._build_rt(bulk=True, threshold=16)
+        rt.add_flavor(ResourceFlavor(name="plain"))
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name="pcq",
+                cohort="co",
+                namespace_selector={},
+                preemption=Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=ReclaimWithinCohortPolicy.ANY,
+                ),
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",), (FlavorQuotas.build("plain", {"cpu": "99"}),)
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(
+            LocalQueue(namespace="ns", name="plq", cluster_queue="pcq")
+        )
+        self._seed(rt, levels)
+        for w in range(20):
+            rt.add_workload(
+                Workload(
+                    namespace="ns", name=f"pw-{w}", queue_name="plq",
+                    creation_time=2000.0 + w,
+                    pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
+                )
+            )
+        rt.run_until_idle(max_iterations=400)
+        traces = drain_traces(rt)
+        # the drain ran for the plain backlog only
+        assert traces and traces[0].heads == 20
+        # and the TAS heads still got decided — by the cycle loop
+        adm, _, _ = final_state(rt)
+        assert any(k.startswith("ns/tw-") for k in adm)
+        for key in adm:
+            if key.startswith("ns/tw-"):
+                psa = rt.workloads[key].admission.pod_set_assignments[0]
+                assert psa.topology_assignment is not None

@@ -98,7 +98,14 @@ class TestCertGeneration:
         assert cert_not_after(new_cert) > cert_not_after(old_cert)
         assert fired == [True]
 
-    def test_ca_rotation_rercoots_serving_cert(self, tmp_path):
+    def test_ca_reroot_two_phase_overlap(self, tmp_path):
+        """Re-root is two-phase: the new root ships in a bundle with
+        the old one while the old-root-signed serving cert KEEPS
+        serving (clients holding the stale ca.crt must not hard-fail at
+        the instant of rotation); the serving cert re-signs under the
+        new root one refresh window later, before its signer dies."""
+        from cryptography import x509
+
         now = [dt.datetime.now(dt.timezone.utc)]
         rot = CertRotator(
             str(tmp_path),
@@ -109,24 +116,33 @@ class TestCertGeneration:
         )
         rot.ensure()
         old_ca = open(rot.ca_path, "rb").read()
-        now[0] += dt.timedelta(days=75)  # CA has 25 days left
-        assert rot.maybe_rotate() is True
-        new_bundle = open(rot.ca_path, "rb").read()
-        assert new_bundle != old_ca
-        # the old root stays in the bundle for one rotation period (CA
-        # overlap): clients holding the previous ca.crt keep verifying
-        assert old_ca.strip() in new_bundle
-        # the re-issued serving cert chains to the NEW root (the
-        # bundle's leading cert)
-        from cryptography import x509
+        old_serving = open(rot.cert_path, "rb").read()
 
-        ca = x509.load_pem_x509_certificate(new_bundle)
+        # phase 1: CA has 55 days left (<= 2 windows) -> re-root early,
+        # bundle = new + old, serving cert untouched
+        now[0] += dt.timedelta(days=45)
+        assert rot.maybe_rotate() is True
+        bundle = open(rot.ca_path, "rb").read()
+        assert bundle != old_ca
+        assert old_ca.strip() in bundle  # overlap: old root still trusted
+        assert open(rot.cert_path, "rb").read() == old_serving
+
+        # phase 2: the old root (the serving cert's signer) is now one
+        # window from expiry -> re-sign under the bundle's new root
+        now[0] += dt.timedelta(days=26)  # old root: 29 days left
+        assert rot.maybe_rotate() is True
+        new_root = x509.load_pem_x509_certificate(bundle)
         serving = x509.load_pem_x509_certificate(
             open(rot.cert_path, "rb").read()
         )
-        assert serving.issuer == ca.subject
-        assert ca.public_bytes
-        assert old_ca.startswith(b"-----BEGIN")
+        aki = serving.extensions.get_extension_for_class(
+            x509.AuthorityKeyIdentifier
+        ).value.key_identifier
+        ski = new_root.extensions.get_extension_for_class(
+            x509.SubjectKeyIdentifier
+        ).value.digest
+        assert aki == ski  # chained to the NEW root now
+
         # next re-root keeps only {newest, previous} — no unbounded tail
         now[0] += dt.timedelta(days=3650)
         rot.maybe_rotate()
@@ -320,4 +336,34 @@ class TestMultiKueueOverTLS:
             )
             assert wl.is_admitted
         finally:
+            srv.stop()
+
+
+class TestTLSAcceptLoopResilience:
+    def test_stalled_client_does_not_block_server(self, tmp_path):
+        """A client that connects and never speaks must not wedge the
+        accept loop: the handshake runs lazily in the per-request
+        worker thread with a bounded timeout, so probes keep serving."""
+        import socket
+        import time
+
+        rot = CertRotator(str(tmp_path))
+        srv = KueueServer(runtime=simple_runtime(), tls=rot)
+        port = srv.start()
+        stalled = []
+        try:
+            # several silent TCP connections held open
+            for _ in range(3):
+                s = socket.create_connection(("127.0.0.1", port), timeout=5)
+                stalled.append(s)
+            time.sleep(0.2)
+            client = KueueClient(
+                f"https://127.0.0.1:{port}", ca_cert=rot.ca_path, timeout=10
+            )
+            t0 = time.monotonic()
+            assert client.healthz()["status"] == "ok"
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            for s in stalled:
+                s.close()
             srv.stop()
