@@ -658,7 +658,7 @@ class TestTASBulkDrain:
     N_TAS_CQ = 4
     WL_PER_CQ = 20
 
-    def _build_rt(self, bulk: bool, threshold: int = 64):
+    def _build_rt(self, bulk: bool, threshold: int = 64, fair: bool = False):
         from kueue_tpu.models import Topology
         from kueue_tpu.models.topology import TopologyLevel
         from kueue_tpu.tas import TASCache
@@ -695,6 +695,7 @@ class TestTASBulkDrain:
         rt = ClusterRuntime(
             clock=clock,
             tas_cache=tas,
+            fair_sharing=fair,
             bulk_drain_threshold=threshold if bulk else None,
         )
         rt.cache.add_or_update_topology(topo)
@@ -890,3 +891,77 @@ class TestTASBulkDrain:
             if key.startswith("ns/tw-"):
                 psa = rt.workloads[key].admission.pod_set_assignments[0]
                 assert psa.topology_assignment is not None
+
+    def test_fair_sharing_sends_tas_to_cycle_loop(self):
+        """Fair sharing has no TAS drain scope either: with a
+        fair-sharing runtime the TAS heads fall to the cycle loop while
+        the plain backlog still drains (fair ordering) — and BOTH
+        halves fully admit (capacities are sized to make full admission
+        deterministic, so a half that silently decides nothing fails)."""
+        from kueue_tpu.models.workload import PodSetTopologyRequest
+
+        rt, levels = self._build_rt(bulk=True, threshold=16, fair=True)
+        _, _, HOST = levels
+        rt.add_flavor(ResourceFlavor(name="plain"))
+        for i in range(2):
+            rt.add_cluster_queue(
+                ClusterQueue(
+                    name=f"fcq-{i}",
+                    cohort="fair-co",
+                    namespace_selector={},
+                    resource_groups=(
+                        ResourceGroup(
+                            ("cpu",),
+                            (FlavorQuotas.build("plain", {"cpu": "30"}),),
+                        ),
+                    ),
+                )
+            )
+            rt.add_local_queue(
+                LocalQueue(
+                    namespace="ns", name=f"flq-{i}", cluster_queue=f"fcq-{i}"
+                )
+            )
+        # 10 TAS gangs of 2x1cpu on a 192-cpu topology, quota 999: all
+        # must admit; 2x15 plain 2cpu workloads against quota 2x30: all
+        # must admit
+        for w in range(10):
+            rt.add_workload(
+                Workload(
+                    namespace="ns", name=f"tw-{w}", queue_name="tlq-0",
+                    creation_time=float(w),
+                    pod_sets=(
+                        PodSet.build(
+                            "main", 2, {"cpu": "1"},
+                            topology_request=PodSetTopologyRequest(
+                                mode="Required", level=HOST
+                            ),
+                        ),
+                    ),
+                )
+            )
+        for i in range(2):
+            for w in range(15):
+                rt.add_workload(
+                    Workload(
+                        namespace="ns", name=f"fw-{i}-{w}",
+                        queue_name=f"flq-{i}",
+                        creation_time=100.0 + i * 15 + w,
+                        pod_sets=(PodSet.build("main", 1, {"cpu": "2"}),),
+                    )
+                )
+        rt.run_until_idle(max_iterations=300)
+        traces = drain_traces(rt)
+        # the fair drain ran over the PLAIN backlog only
+        assert traces and traces[0].heads == 30
+        adm, _, _ = final_state(rt)
+        # the plain fair backlog fully admitted through the drain
+        assert all(f"ns/fw-{i}-{w}" in adm for i in range(2) for w in range(15))
+        # and every TAS head was still decided — by the cycle loop,
+        # with real placements
+        tas_admitted = [k for k in adm if k.startswith("ns/tw-")]
+        assert len(tas_admitted) == 10
+        for key in tas_admitted:
+            psa = rt.workloads[key].admission.pod_set_assignments[0]
+            assert psa.topology_assignment is not None
+            assert sum(d.count for d in psa.topology_assignment.domains) == 2
