@@ -385,32 +385,53 @@ def cmd_schedule(state: State, args) -> None:
 
         jax.config.update("jax_platforms", args.platform)
     if getattr(args, "drain", False):
-        # capacity what-if: the WHOLE pending backlog planned in one
-        # device dispatch (core/drain) and summarized; the cycle loop
-        # below then takes the authoritative decisions (identical by
-        # the drain parity suites, plus it handles fallbacks)
-        from kueue_tpu.core.drain import run_drain
+        # capacity what-if: the pending backlog planned in one device
+        # dispatch (core/drain) and summarized; the cycle loop below
+        # then takes the authoritative decisions (identical by the
+        # drain parity suites, plus it handles fallbacks). Backlog
+        # collection (ClusterRuntime.drain_backlog), scope selection
+        # (classify_drain_scope) and dispatch (run_drain_for_scope) are
+        # the SAME code the service bulk path runs, so the plan routes
+        # exactly like production.
+        from kueue_tpu.core.drain import (
+            classify_drain_scope,
+            run_drain_for_scope,
+        )
         from kueue_tpu.core.queue_manager import queue_order_timestamp
         from kueue_tpu.core.snapshot import take_snapshot
 
-        pending = [
-            (wl, cq_name)
-            for cq_name, pq in rt.queues.cluster_queues.items()
-            for wl in pq.snapshot_sorted()
-        ]
-        outcome = run_drain(
-            take_snapshot(rt.cache),
-            pending,
-            rt.cache.flavors,
+        snapshot = take_snapshot(rt.cache)
+        backlog = rt.drain_backlog(snapshot)
+        tas_flavors = (
+            set(rt.cache.tas_cache.flavors)
+            if rt.cache.tas_cache is not None
+            else set()
+        )
+        kind, pending = classify_drain_scope(
+            snapshot, backlog, tas_flavors, rt.scheduler.fair_sharing
+        )
+        outcome = run_drain_for_scope(
+            kind, snapshot, pending, rt.cache.flavors,
+            tas_cache=rt.cache.tas_cache,
+            fs_strategies=getattr(
+                rt.scheduler.preemptor, "fs_strategies", None
+            ),
             timestamp_fn=lambda wl: queue_order_timestamp(
                 wl, rt.queues._ts_policy
             ),
         )
+        evicted = len(getattr(outcome, "evictions", []) or [])
+        # heads the classifier dropped to the cycle loop (TAS heads in
+        # a preempt/fair backlog) were never planned — say so, or the
+        # counts read as if they were rejected
+        excluded = len(backlog) - len(pending)
         print(
-            f"drain plan: cycles={outcome.cycles} "
+            f"drain plan ({kind}): cycles={outcome.cycles} "
             f"admitted={len(outcome.admitted)} "
+            f"evicted={evicted} "
             f"parked={len(outcome.parked)} "
-            f"fallback={len(outcome.fallback)}"
+            f"fallback={len(outcome.fallback)} "
+            f"excluded={excluded}"
         )
     for _ in range(args.cycles):
         rt.run_until_idle()

@@ -695,6 +695,29 @@ class ClusterRuntime:
         return cycles
 
     # ---- the bulk path: device drains as the service (north star) ----
+    def drain_backlog(self, snapshot):
+        """The drain-representable pending backlog exactly as the bulk
+        path sees it: active queues' heads in heap order, prevalidated,
+        minus partial-admission heads (those decide at reduced counts
+        on the host cycle loop — no drain twin). Shared with the CLI's
+        ``--drain`` what-if so its plan classifies over the same
+        backlog production would."""
+        sched = self.scheduler
+        backlog: List[Workload] = []
+        for name in sorted(self.queues.cluster_queues):
+            pq = self.queues.cluster_queues[name]
+            if pq.active:
+                backlog.extend(pq.snapshot_active_sorted())
+        _, to_assign = sched._prevalidate(backlog, snapshot)
+        return [
+            (e.workload, e.cq_name)
+            for e in to_assign
+            if not (
+                sched.partial_admission
+                and any(ps.min_count is not None for ps in e.workload.pod_sets)
+            )
+        ]
+
     def bulk_drain(self):
         """Decide the whole pending backlog in ONE device dispatch
         (core/drain.run_drain / run_drain_preempt) and apply the
@@ -703,14 +726,9 @@ class ClusterRuntime:
         below threshold / the drain is gated off."""
         import time as _time
 
-        from kueue_tpu.core.drain import run_drain, run_drain_preempt
         from kueue_tpu.core.queue_manager import queue_order_timestamp
         from kueue_tpu.core.scheduler import CycleTrace
         from kueue_tpu.core.snapshot import take_snapshot
-        from kueue_tpu.models.constants import (
-            PreemptionPolicy,
-            ReclaimWithinCohortPolicy,
-        )
 
         sched = self.scheduler
         if self.bulk_drain_threshold is None or sched.use_solver is False:
@@ -742,43 +760,7 @@ class ClusterRuntime:
 
         t0 = _time.perf_counter()
         snapshot = take_snapshot(self.cache)
-        backlog: List[Workload] = []
-        for name in sorted(self.queues.cluster_queues):
-            pq = self.queues.cluster_queues[name]
-            if pq.active:
-                backlog.extend(pq.snapshot_active_sorted())
-        _, to_assign = sched._prevalidate(backlog, snapshot)
-        tas_flavors = set()
-        if self.cache.tas_cache is not None:
-            tas_flavors = set(self.cache.tas_cache.flavors)
-
-        def _on_tas_cq(cq_name: str) -> bool:
-            cq = snapshot.cq_models.get(cq_name)
-            return cq is not None and any(
-                fq.name in tas_flavors
-                for rg in cq.resource_groups
-                for fq in rg.flavors
-            )
-
-        def _drainable(e) -> bool:
-            # partial admission decides at reduced counts — that stays
-            # with the host cycle loop (no drain twin)
-            return not (
-                sched.partial_admission
-                and any(ps.min_count is not None for ps in e.workload.pod_sets)
-            )
-
-        candidates = [e for e in to_assign if _drainable(e)]
-        tas_cqs = (
-            {
-                c
-                for c in {e.cq_name for e in candidates}
-                if _on_tas_cq(c)  # one resource-group scan per CQ
-            }
-            if tas_flavors
-            else set()
-        )
-        pending = [(e.workload, e.cq_name) for e in candidates]
+        pending = self.drain_backlog(snapshot)
         if len(pending) < self.bulk_drain_threshold:
             return None
 
@@ -786,56 +768,27 @@ class ClusterRuntime:
             wl, self.queues._ts_policy
         )
 
-        def _preempt_capable(cq_name: str) -> bool:
-            cq = snapshot.cq_models.get(cq_name)
-            if cq is None:
-                return False
-            prem = cq.preemption
-            return prem.within_cluster_queue != PreemptionPolicy.NEVER or (
-                snapshot.has_cohort(cq_name)
-                and prem.reclaim_within_cohort
-                != ReclaimWithinCohortPolicy.NEVER
-            )
+        from kueue_tpu.core.drain import (
+            classify_drain_scope,
+            run_drain_for_scope,
+        )
 
-        # TAS heads ride the drain only through run_drain_tas, which has
-        # no eviction support: with fair sharing or any preempt-capable
-        # plain CQ in the backlog, TAS heads fall to the cycle loop and
-        # the rest drains as before (the preempt scopes can't carry
-        # placement state in one dispatch)
-        plain_cqs = {c for _, c in pending} - tas_cqs
-        any_preempt = any(_preempt_capable(c) for c in plain_cqs)
-        use_tas = bool(tas_cqs) and not sched.fair_sharing and not any_preempt
-        if tas_cqs and not use_tas:
-            pending = [(w, c) for w, c in pending if c not in tas_cqs]
-            if len(pending) < self.bulk_drain_threshold:
-                return None
-        if sched.fair_sharing and any_preempt:
-            from kueue_tpu.core.drain import run_drain_fair_preempt
-
-            outcome = run_drain_fair_preempt(
-                snapshot, pending, self.cache.flavors, timestamp_fn=ts_fn,
-                fs_strategies=getattr(sched.preemptor, "fs_strategies", None),
-            )
-        elif sched.fair_sharing:
-            outcome = run_drain(
-                snapshot, pending, self.cache.flavors, timestamp_fn=ts_fn,
-                fair_sharing=True,
-            )
-        elif any_preempt:
-            outcome = run_drain_preempt(
-                snapshot, pending, self.cache.flavors, timestamp_fn=ts_fn
-            )
-        elif use_tas:
-            from kueue_tpu.core.drain import run_drain_tas
-
-            outcome = run_drain_tas(
-                snapshot, pending, self.cache.flavors,
-                self.cache.tas_cache, timestamp_fn=ts_fn,
-            )
-        else:
-            outcome = run_drain(
-                snapshot, pending, self.cache.flavors, timestamp_fn=ts_fn
-            )
+        tas_flavors = (
+            set(self.cache.tas_cache.flavors)
+            if self.cache.tas_cache is not None
+            else set()
+        )
+        kind, pending = classify_drain_scope(
+            snapshot, pending, tas_flavors, sched.fair_sharing
+        )
+        if len(pending) < self.bulk_drain_threshold:
+            return None  # TAS heads dropped to the cycle loop shrank it
+        outcome = run_drain_for_scope(
+            kind, snapshot, pending, self.cache.flavors,
+            tas_cache=self.cache.tas_cache,
+            fs_strategies=getattr(sched.preemptor, "fs_strategies", None),
+            timestamp_fn=ts_fn,
+        )
         # plan+dispatch cost only — the apply below is per-admission
         # bookkeeping both paths pay
         self._drain_est.observe(

@@ -669,6 +669,101 @@ def _lower_victim_pools(
     )
 
 
+def classify_drain_scope(
+    snapshot: Snapshot,
+    pending: Sequence[Tuple[Workload, str]],
+    tas_flavors,
+    fair_sharing: bool,
+):
+    """Pick which drain covers a backlog — shared by the service bulk
+    path (ClusterRuntime.bulk_drain) and the CLI's ``--drain`` what-if
+    plan, so the plan printout routes exactly like production.
+
+    Returns ``(kind, pending2)`` with kind one of ``"fair_preempt"``,
+    ``"fair"``, ``"preempt"``, ``"tas"``, ``"plain"``. TAS heads ride
+    the drain only through run_drain_tas, which has no eviction
+    support: with fair sharing or any preempt-capable plain CQ in the
+    backlog they are dropped from ``pending2`` (the cycle loop decides
+    them) and the rest drains under the preempt/fair scopes.
+    """
+    from kueue_tpu.models.constants import (
+        PreemptionPolicy,
+        ReclaimWithinCohortPolicy,
+    )
+
+    tas_flavors = set(tas_flavors or ())
+
+    def _on_tas_cq(cq_name: str) -> bool:
+        cq = snapshot.cq_models.get(cq_name)
+        return cq is not None and any(
+            fq.name in tas_flavors
+            for rg in cq.resource_groups
+            for fq in rg.flavors
+        )
+
+    def _preempt_capable(cq_name: str) -> bool:
+        cq = snapshot.cq_models.get(cq_name)
+        if cq is None:
+            return False
+        prem = cq.preemption
+        return prem.within_cluster_queue != PreemptionPolicy.NEVER or (
+            snapshot.has_cohort(cq_name)
+            and prem.reclaim_within_cohort != ReclaimWithinCohortPolicy.NEVER
+        )
+
+    cq_names = {c for _, c in pending}
+    tas_cqs = (
+        {c for c in cq_names if _on_tas_cq(c)} if tas_flavors else set()
+    )
+    any_preempt = any(_preempt_capable(c) for c in cq_names - tas_cqs)
+    use_tas = bool(tas_cqs) and not fair_sharing and not any_preempt
+    pending2 = list(pending)
+    if tas_cqs and not use_tas:
+        pending2 = [(w, c) for w, c in pending2 if c not in tas_cqs]
+    if fair_sharing and any_preempt:
+        return "fair_preempt", pending2
+    if fair_sharing:
+        return "fair", pending2
+    if any_preempt:
+        return "preempt", pending2
+    if use_tas:
+        return "tas", pending2
+    return "plain", pending2
+
+
+def run_drain_for_scope(
+    kind: str,
+    snapshot: Snapshot,
+    pending: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    tas_cache=None,
+    fs_strategies=None,
+    timestamp_fn=None,
+):
+    """Dispatch the drain a classify_drain_scope kind names — the ONE
+    place the kind→drain mapping lives, so the service bulk path and
+    the CLI what-if stay identical by construction."""
+    if kind == "fair_preempt":
+        return run_drain_fair_preempt(
+            snapshot, pending, flavors, timestamp_fn=timestamp_fn,
+            fs_strategies=fs_strategies,
+        )
+    if kind == "fair":
+        return run_drain(
+            snapshot, pending, flavors, timestamp_fn=timestamp_fn,
+            fair_sharing=True,
+        )
+    if kind == "preempt":
+        return run_drain_preempt(
+            snapshot, pending, flavors, timestamp_fn=timestamp_fn
+        )
+    if kind == "tas":
+        return run_drain_tas(
+            snapshot, pending, flavors, tas_cache, timestamp_fn=timestamp_fn
+        )
+    return run_drain(snapshot, pending, flavors, timestamp_fn=timestamp_fn)
+
+
 def run_drain_preempt(
     snapshot: Snapshot,
     pending: Sequence[Tuple[Workload, str]],

@@ -219,7 +219,7 @@ class TestScheduleDrain:
         capsys.readouterr()
         cli(tmp_path, "schedule", "--cycles", "8", "--drain")
         out = capsys.readouterr().out
-        assert "drain plan:" in out and "admitted=4" in out
+        assert "drain plan (plain):" in out and "admitted=4" in out
         assert "admitted=4 pending=2" in out  # the cycle loop agrees
 
 
@@ -273,3 +273,96 @@ class TestCLIOverTLS:
                 )
         finally:
             srv.stop()
+
+
+class TestScheduleDrainScopes:
+    def test_preempting_state_plans_through_preempt_drain(
+        self, tmp_path, capsys
+    ):
+        """A state with preempt-capable ClusterQueues and admitted
+        victims must plan via the preempt drain (the same classifier
+        the service bulk path uses) and report the planned evictions."""
+        import json as _json
+
+        state = {
+            "resourceFlavors": [{"name": "default"}],
+            "clusterQueues": [
+                {
+                    "name": "cq",
+                    "namespaceSelector": {},
+                    "preemption": {
+                        "withinClusterQueue": "LowerPriority",
+                    },
+                    "resourceGroups": [
+                        {
+                            "coveredResources": ["cpu"],
+                            "flavors": [
+                                {
+                                    "name": "default",
+                                    "resources": [
+                                        {"name": "cpu", "nominalQuota": "4"}
+                                    ],
+                                }
+                            ],
+                        }
+                    ],
+                }
+            ],
+            "localQueues": [
+                {"namespace": "default", "name": "lq", "clusterQueue": "cq"}
+            ],
+            "workloads": [
+                # a low-priority victim saturating the CQ
+                {
+                    "namespace": "default",
+                    "name": "victim",
+                    "queueName": "lq",
+                    "priority": 0,
+                    "podSets": [
+                        {
+                            "name": "main",
+                            "count": 1,
+                            "requests": {"cpu": "4"},
+                        }
+                    ],
+                    "admission": {
+                        "clusterQueue": "cq",
+                        "podSetAssignments": [
+                            {
+                                "name": "main",
+                                "flavors": {"cpu": "default"},
+                                "resourceUsage": {"cpu": "4"},
+                                "count": 1,
+                            }
+                        ],
+                    },
+                    "conditions": [
+                        {
+                            "type": "QuotaReserved",
+                            "status": True,
+                            "reason": "QuotaReserved",
+                        }
+                    ],
+                },
+                # a high-priority head that can only start by preempting
+                {
+                    "namespace": "default",
+                    "name": "head",
+                    "queueName": "lq",
+                    "priority": 100,
+                    "podSets": [
+                        {
+                            "name": "main",
+                            "count": 1,
+                            "requests": {"cpu": "4"},
+                        }
+                    ],
+                },
+            ],
+        }
+        path = tmp_path / "state.json"
+        path.write_text(_json.dumps(state))
+        main(["--state", str(path), "schedule", "--drain", "--cycles", "0"])
+        out = capsys.readouterr().out
+        assert "drain plan (preempt):" in out
+        assert "admitted=1" in out and "evicted=1" in out
