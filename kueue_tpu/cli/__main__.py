@@ -300,6 +300,7 @@ _DELETE_SECTIONS = {
     "clusterqueue": ("clusterQueues", "clusterqueues"),
     "localqueue": ("localQueues", None),  # no server delete route
     "resourceflavor": ("resourceFlavors", "resourceflavors"),
+    "node": ("nodes", "nodes"),  # TAS capacity inventory
 }
 
 
@@ -313,8 +314,10 @@ def cmd_delete(state: State, args) -> None:
         client = _server_client(args)
         if args.kind == "workload":
             client.delete_workload(ns, args.name)
-        elif args.kind == "clusterqueue":
-            client.delete_cluster_queue(args.name)
+        elif server_section is not None:
+            client._request(
+                "DELETE", f"/apis/kueue/v1beta1/{server_section}/{args.name}"
+            )
         else:
             raise SystemExit(
                 f"error: server delete not supported for {args.kind}"

@@ -59,6 +59,44 @@ def _canon_qty(resource: str, value) -> int:
     return quantity_to_int(resource, value)
 
 
+# ---- nodes (TAS capacity inventory) ----
+def node_to_dict(n) -> dict:
+    return {
+        "name": n.name,
+        "labels": dict(n.labels),
+        # canonical ints verbatim: a str() here would make the reload
+        # re-parse milli-canonical values as human quantities (1000x
+        # inflation per checkpoint round trip)
+        "allocatable": dict(n.allocatable),
+        "taints": [
+            {"key": t.key, "value": t.value, "effect": t.effect}
+            for t in n.taints
+        ],
+        "ready": n.ready,
+        "nonTasUsage": dict(n.non_tas_usage),
+    }
+
+
+def node_from_dict(d: dict):
+    from kueue_tpu.tas.cache import Node
+
+    return Node(
+        name=d["name"],
+        labels=dict(d.get("labels", {})),
+        allocatable={
+            r: _canon_qty(r, q) for r, q in d.get("allocatable", {}).items()
+        },
+        taints=tuple(
+            Taint(t["key"], t.get("value", ""), t.get("effect", "NoSchedule"))
+            for t in d.get("taints", [])
+        ),
+        ready=d.get("ready", True),
+        non_tas_usage={
+            r: _canon_qty(r, q) for r, q in d.get("nonTasUsage", {}).items()
+        },
+    )
+
+
 # ---- flavors ----
 def flavor_to_dict(f: ResourceFlavor) -> dict:
     return {
@@ -526,11 +564,25 @@ def runtime_from_state(data: dict, runtime=None, **runtime_kwargs):
     file)."""
     from kueue_tpu.controllers import ClusterRuntime
 
+    if data.get("nodes"):
+        # a state carrying node inventory implies TAS intent — without
+        # a TASCache the nodes would silently drop on load
+        if runtime is None and "tas_cache" not in runtime_kwargs:
+            from kueue_tpu.tas import TASCache
+
+            runtime_kwargs["tas_cache"] = TASCache()
+        elif runtime is not None and runtime.cache.tas_cache is None:
+            raise ValueError(
+                "state carries TAS node inventory but the provided "
+                "runtime has no TAS cache"
+            )
     rt = runtime if runtime is not None else ClusterRuntime(**runtime_kwargs)
     for f in data.get("resourceFlavors", []):
         rt.add_flavor(flavor_from_dict(f))
     for t in data.get("topologies", []):
         rt.add_topology(topology_from_dict(t))
+    for n in data.get("nodes", []):
+        rt.add_node(node_from_dict(n))
     for c in data.get("cohorts", []):
         rt.add_cohort(cohort_from_dict(c))
     for a in data.get("admissionChecks", []):
@@ -570,6 +622,14 @@ def runtime_to_state(rt) -> dict:
     out["runtimeClasses"] = [
         runtime_class_to_dict(rc) for rc in rt.runtime_classes.values()
     ]
+    if rt.cache.tas_cache is not None and rt.cache.tas_cache.node_inventory:
+        # TAS node inventory is control-plane state here (the reference
+        # watches corev1.Node; a standalone restart must not forget its
+        # topology capacity)
+        out["nodes"] = [
+            node_to_dict(n)
+            for n in rt.cache.tas_cache.node_inventory.values()
+        ]
     return out
 
 

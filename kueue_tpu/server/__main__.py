@@ -184,6 +184,8 @@ def main(argv=None) -> int:
         """Construct a runtime exactly the way startup does — also used
         to REBUILD on promotion, so a promoted standby starts from the
         checkpoint alone instead of merging it into a stale store."""
+        from kueue_tpu.tas import TASCache
+
         if args.config:
             import yaml
 
@@ -191,13 +193,13 @@ def main(argv=None) -> int:
 
             with open(args.config) as f:
                 cfg = load_config(yaml.safe_load(f))
-            rt = runtime_from_config(cfg)
+            rt = runtime_from_config(cfg, tas_cache=TASCache())
             if use_solver is not None:
                 rt.scheduler.use_solver = use_solver
             return rt
         from kueue_tpu.controllers import ClusterRuntime
 
-        return ClusterRuntime(use_solver=use_solver)
+        return ClusterRuntime(use_solver=use_solver, tas_cache=TASCache())
 
     runtime = build_runtime()
     if args.state and os.path.exists(args.state):

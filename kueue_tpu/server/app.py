@@ -14,7 +14,7 @@ Routes (all JSON unless noted):
   POST /apis/kueue/v1beta1/{section}           upsert one object (webhook
                                                defaulting+validation applied)
   DELETE /apis/kueue/v1beta1/workloads/{ns}/{name}
-  DELETE /apis/kueue/v1beta1/clusterqueues/{name}
+  DELETE /apis/kueue/v1beta1/{clusterqueues|resourceflavors|nodes}/{name}
   POST /apis/kueue/v1beta1/workloads/{ns}/{name}/admissionchecks
                                                flip a check state — the
                                                phase-2 plugin boundary
@@ -104,6 +104,16 @@ _SECTIONS: Dict[str, _Section] = {
     "topologies": _Section(
         ser.topology_from_dict, ser.topology_to_dict, "add_topology",
         lambda rt: rt.cache.topologies,
+    ),
+    # TAS node inventory (the corev1.Node watch analog: a standalone
+    # control plane ingests its topology capacity through its own API)
+    "nodes": _Section(
+        ser.node_from_dict, ser.node_to_dict, "add_node",
+        lambda rt: (
+            rt.cache.tas_cache.node_inventory
+            if rt.cache.tas_cache is not None
+            else {}
+        ),
     ),
     "workloadpriorityclasses": _Section(
         ser.priority_class_from_dict, ser.priority_class_to_dict,
@@ -210,8 +220,11 @@ class KueueServer:
     ):
         if runtime is None:
             from kueue_tpu.controllers import ClusterRuntime
+            from kueue_tpu.tas import TASCache
 
-            runtime = ClusterRuntime()
+            # TAS-capable by default: a standalone control plane must
+            # be able to ingest node inventory through its own API
+            runtime = ClusterRuntime(tas_cache=TASCache())
         self.runtime = runtime
         self.lock = threading.RLock()
         self.auto_reconcile = auto_reconcile
@@ -293,7 +306,18 @@ class KueueServer:
                     obj = admit(section, obj, old, self.runtime)
             except ValidationError as e:
                 raise ApiError(422, str(e))
-            model = sec.from_dict(obj)
+            if section == "nodes" and self.runtime.cache.tas_cache is None:
+                # add_node would silently no-op: acknowledging a write
+                # we discarded is worse than refusing it
+                raise ApiError(
+                    409, "runtime has no TAS cache; node inventory disabled"
+                )
+            try:
+                model = sec.from_dict(obj)
+            except (KeyError, TypeError, ValueError) as e:
+                # a codec miss is the CALLER's malformed body, not a
+                # server fault — 400, never a 500 stack trace
+                raise ApiError(400, f"malformed {section} object: {e!r}")
             getattr(self.runtime, sec.add_name)(model)
             if reconcile and self.auto_reconcile:
                 self.runtime.run_until_idle()
@@ -319,6 +343,11 @@ class KueueServer:
                 except ValueError as e:
                     # the ResourceFlavor finalizer's user-visible effect
                     raise ApiError(409, str(e))
+            elif section == "nodes":
+                tc = self.runtime.cache.tas_cache
+                if tc is None or name not in tc.node_inventory:
+                    raise ApiError(404, f"node {name} not found")
+                self.runtime.delete_node(name)
             else:
                 raise ApiError(405, f"delete not supported for {section}")
             if self.auto_reconcile:
@@ -562,7 +591,7 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
     ),
     (
         "DELETE",
-        re.compile(r"^/apis/kueue/v1beta1/(clusterqueues|resourceflavors)/([^/]+)$"),
+        re.compile(r"^/apis/kueue/v1beta1/(clusterqueues|resourceflavors|nodes)/([^/]+)$"),
         "delete",
     ),
     (

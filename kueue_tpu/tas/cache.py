@@ -139,6 +139,12 @@ class TASCache:
         # Bumped on any mutation; consumers cache snapshots per generation.
         self.generation = 0
 
+    @property
+    def node_inventory(self) -> Dict[str, Node]:
+        """The ingested node set (the control plane's wire surface and
+        checkpoint read this — keep it public)."""
+        return self._nodes
+
     def add_or_update_topology(self, topo: Topology) -> None:
         self.topologies[topo.name] = topo
         self.generation += 1

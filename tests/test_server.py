@@ -450,3 +450,168 @@ class TestStateRoundTrip:
         state = client.state()
         rt2 = ser.runtime_from_state(state)
         assert ser.runtime_to_state(rt2) == state
+
+
+class TestTASOverTheWire:
+    """Topology-aware scheduling through the service surface alone: a
+    standalone control plane ingests its node inventory via its own
+    API (the corev1.Node watch analog), places topology-requesting
+    workloads, and persists the inventory across restarts."""
+
+    BLOCK = "cloud.google.com/gce-topology-block"
+    HOST = "kubernetes.io/hostname"
+
+    def _seed_tas(self, client, n_hosts=4):
+        client.apply(
+            "topologies",
+            {
+                "name": "default",
+                "levels": [self.BLOCK, self.HOST],
+            },
+        )
+        client.apply(
+            "resourceflavors",
+            {"name": "tas-flavor", "topologyName": "default"},
+        )
+        for h in range(n_hosts):
+            client.apply(
+                "nodes",
+                {
+                    "name": f"n-{h}",
+                    "labels": {self.BLOCK: f"b{h % 2}", self.HOST: f"n-{h}"},
+                    "allocatable": {"cpu": "8", "pods": "32"},
+                },
+            )
+        client.apply(
+            "clusterqueues",
+            {
+                "name": "tcq",
+                "namespaceSelector": {},
+                "resourceGroups": [
+                    {
+                        "coveredResources": ["cpu"],
+                        "flavors": [
+                            {
+                                "name": "tas-flavor",
+                                "resources": [
+                                    {"name": "cpu", "nominalQuota": "99"}
+                                ],
+                            }
+                        ],
+                    }
+                ],
+            },
+        )
+        client.apply(
+            "localqueues",
+            {"namespace": "ns", "name": "tlq", "clusterQueue": "tcq"},
+        )
+
+    def _tas_wl(self, name, count=2, level=None):
+        return {
+            "namespace": "ns",
+            "name": name,
+            "queueName": "tlq",
+            "podSets": [
+                {
+                    "name": "main",
+                    "count": count,
+                    "requests": {"cpu": "1"},
+                    "topologyRequest": {
+                        "mode": "Required",
+                        "level": level or self.HOST,
+                    },
+                }
+            ],
+        }
+
+    def test_tas_placement_via_api(self, server, client):
+        self._seed_tas(client)
+        client.apply("workloads", self._tas_wl("gang-1", count=4))
+        client.reconcile()
+        got = client.get_workload("ns", "gang-1")
+        psa = got["admission"]["podSetAssignments"][0]
+        ta = psa["topologyAssignment"]
+        assert ta is not None
+        assert sum(d["count"] for d in ta["domains"]) == 4
+        # node listing serves the ingested inventory back
+        names = {n["name"] for n in client.list("nodes")}
+        assert names == {"n-0", "n-1", "n-2", "n-3"}
+
+    def test_node_delete_shrinks_capacity(self, server, client):
+        self._seed_tas(client, n_hosts=1)
+        client._request("DELETE", "/apis/kueue/v1beta1/nodes/n-0")
+        from kueue_tpu.server.client import ClientError
+
+        with pytest.raises(ClientError) as ei:
+            client._request("DELETE", "/apis/kueue/v1beta1/nodes/n-0")
+        assert ei.value.status == 404
+        # no capacity left: a Required-host gang must stay pending
+        client.apply("workloads", self._tas_wl("stuck", count=2))
+        client.reconcile()
+        got = client.get_workload("ns", "stuck")
+        assert got.get("admission") is None
+
+    def test_state_round_trip_preserves_nodes(self, server, client):
+        self._seed_tas(client)
+        client.apply("workloads", self._tas_wl("gang-rt", count=2))
+        client.reconcile()
+        state = client.state()
+        assert {n["name"] for n in state["nodes"]} == {
+            "n-0", "n-1", "n-2", "n-3"
+        }
+        # a fresh control plane rebuilt from the checkpoint still
+        # places topology gangs (the inventory survived the restart)
+        rt2 = ser.runtime_from_state(state)
+        assert rt2.cache.tas_cache is not None
+        assert set(rt2.cache.tas_cache._nodes) == {
+            "n-0", "n-1", "n-2", "n-3"
+        }
+        from kueue_tpu.models.workload import PodSetTopologyRequest
+
+        wl = Workload(
+            namespace="ns", name="after-restart", queue_name="tlq",
+            pod_sets=(
+                PodSet.build(
+                    "main", 2, {"cpu": "1"},
+                    topology_request=PodSetTopologyRequest(
+                        mode="Required", level=self.HOST
+                    ),
+                ),
+            ),
+        )
+        rt2.add_workload(wl)
+        rt2.run_until_idle()
+        assert wl.admission is not None
+        psa = wl.admission.pod_set_assignments[0]
+        assert psa.topology_assignment is not None
+
+    def test_node_wire_round_trip_is_idempotent(self):
+        """to_dict/from_dict must be a fixed point: a str() of the
+        canonical milli value would re-parse as a human quantity and
+        inflate capacity 1000x per checkpoint cycle."""
+        from kueue_tpu.tas.cache import Node
+
+        n = Node(
+            name="n-rt",
+            labels={self.HOST: "n-rt"},
+            allocatable={"cpu": 8000, "pods": 32},
+            non_tas_usage={"cpu": 500},
+        )
+        once = ser.node_from_dict(ser.node_to_dict(n))
+        assert once.allocatable == n.allocatable
+        assert once.non_tas_usage == n.non_tas_usage
+        twice = ser.node_from_dict(ser.node_to_dict(once))
+        assert twice.allocatable == n.allocatable
+        # human-authored quantities still parse on first ingest
+        human = ser.node_from_dict(
+            {"name": "h", "allocatable": {"cpu": "8", "memory": "4Gi"}}
+        )
+        assert human.allocatable["cpu"] == 8000
+
+    def test_malformed_node_body_is_a_400(self, server, client):
+        from kueue_tpu.server.client import ClientError
+
+        with pytest.raises(ClientError) as ei:
+            client.apply("nodes", {"labels": {}})  # no name
+        assert ei.value.status == 400
