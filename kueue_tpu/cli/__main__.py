@@ -180,9 +180,45 @@ def cmd_create_rf(state: State, args) -> None:
     print(f"resourceflavor.kueue.x-k8s.io/{args.name} created")
 
 
+def cmd_create_topology(state: State, args) -> None:
+    obj = {
+        "name": args.name,
+        "levels": [lv for lv in args.levels.split(",") if lv],
+    }
+    ser.topology_from_dict(obj)  # validate
+    state.upsert("topologies", obj)
+    state.save()
+    print(f"topology.kueue.x-k8s.io/{args.name} created")
+
+
+def cmd_create_node(state: State, args) -> None:
+    obj = {
+        "name": args.name,
+        "labels": _parse_labels(args.labels),
+        "allocatable": _parse_quotas(args.allocatable),
+        "taints": [],
+        "ready": not args.not_ready,
+        "nonTasUsage": {},
+    }
+    ser.node_from_dict(obj)  # validate (canonicalizes quantities)
+    state.upsert("nodes", obj)
+    state.save()
+    print(f"node/{args.name} created")
+
+
 def cmd_create_workload(state: State, args) -> None:
     import time
 
+    tr = None
+    required = args.topology_required
+    preferred = args.topology_preferred
+    if required or preferred:
+        from kueue_tpu.models.workload import PodSetTopologyRequest
+
+        tr = PodSetTopologyRequest(
+            mode="Required" if required else "Preferred",
+            level=required or preferred,
+        )
     wl = Workload(
         namespace=args.namespace,
         name=args.name,
@@ -194,6 +230,7 @@ def cmd_create_workload(state: State, args) -> None:
                 name="main",
                 count=args.count,
                 requests=requests_from_spec(_parse_quotas(args.requests)),
+                topology_request=tr,
             ),
         ),
     )
@@ -315,9 +352,7 @@ def cmd_delete(state: State, args) -> None:
         if args.kind == "workload":
             client.delete_workload(ns, args.name)
         elif server_section is not None:
-            client._request(
-                "DELETE", f"/apis/kueue/v1beta1/{server_section}/{args.name}"
-            )
+            client.delete(server_section, args.name)
         else:
             raise SystemExit(
                 f"error: server delete not supported for {args.kind}"
@@ -326,7 +361,10 @@ def cmd_delete(state: State, args) -> None:
         obj = state.find(section, args.name, ns)
         state.data[section].remove(obj)
         state.save()
-    print(f"{args.kind}.kueue.x-k8s.io/{args.name} deleted")
+    if args.kind == "node":
+        print(f"node/{args.name} deleted")  # Node is core/v1, no group
+    else:
+        print(f"{args.kind}.kueue.x-k8s.io/{args.name} deleted")
 
 
 # ---- passthrough get (cmd/kueuectl/app/passthrough) ----
@@ -533,6 +571,29 @@ def build_parser() -> argparse.ArgumentParser:
     crf.add_argument("--topology")
     crf.set_defaults(fn=cmd_create_rf)
 
+    cto = create.add_parser("topology")
+    cto.add_argument("name")
+    cto.add_argument(
+        "--levels", required=True,
+        help="comma-separated node label keys, top level first "
+        "(e.g. block,rack,kubernetes.io/hostname)",
+    )
+    cto.set_defaults(fn=cmd_create_topology)
+
+    cnode = create.add_parser("node")
+    cnode.add_argument("name")
+    cnode.add_argument(
+        "--labels", required=True,
+        help="topology-level labels, k=v comma-separated",
+    )
+    cnode.add_argument(
+        "--allocatable", required=True,
+        help="capacity, resource=quantity comma-separated "
+        "(e.g. cpu=8,pods=32)",
+    )
+    cnode.add_argument("--not-ready", action="store_true")
+    cnode.set_defaults(fn=cmd_create_node)
+
     cwl = create.add_parser("workload", aliases=["wl"])
     cwl.add_argument("name")
     cwl.add_argument("-n", "--namespace", default="default")
@@ -540,6 +601,15 @@ def build_parser() -> argparse.ArgumentParser:
     cwl.add_argument("--count", type=int, default=1)
     cwl.add_argument("--requests", required=True, help="cpu=1,memory=1Gi")
     cwl.add_argument("--priority", type=int, default=0)
+    topo_group = cwl.add_mutually_exclusive_group()
+    topo_group.add_argument(
+        "--topology-required",
+        help="gang placement: required topology level (node label key)",
+    )
+    topo_group.add_argument(
+        "--topology-preferred",
+        help="gang placement: preferred topology level (node label key)",
+    )
     cwl.set_defaults(fn=cmd_create_workload)
 
     lst = sub.add_parser("list").add_subparsers(dest="kind", required=True)

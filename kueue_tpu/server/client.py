@@ -102,8 +102,13 @@ class KueueClient:
             "DELETE", f"/apis/kueue/v1beta1/workloads/{namespace}/{name}"
         )
 
+    def delete(self, section: str, name: str) -> dict:
+        """Delete a cluster-scoped object (clusterqueues,
+        resourceflavors, nodes)."""
+        return self._request("DELETE", f"/apis/kueue/v1beta1/{section}/{name}")
+
     def delete_cluster_queue(self, name: str) -> dict:
-        return self._request("DELETE", f"/apis/kueue/v1beta1/clusterqueues/{name}")
+        return self.delete("clusterqueues", name)
 
     def set_admission_check_state(
         self, namespace: str, name: str, check: str, state: str, message: str = ""

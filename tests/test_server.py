@@ -564,7 +564,7 @@ class TestTASOverTheWire:
         # places topology gangs (the inventory survived the restart)
         rt2 = ser.runtime_from_state(state)
         assert rt2.cache.tas_cache is not None
-        assert set(rt2.cache.tas_cache._nodes) == {
+        assert set(rt2.cache.tas_cache.node_inventory) == {
             "n-0", "n-1", "n-2", "n-3"
         }
         from kueue_tpu.models.workload import PodSetTopologyRequest
