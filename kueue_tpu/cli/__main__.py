@@ -302,6 +302,24 @@ def cmd_list_workload(state: State, args) -> None:
     )
 
 
+def cmd_list_topology(state: State, args) -> None:
+    rows = [
+        [t["name"], ",".join(t.get("levels", []))]
+        for t in state.data.get("topologies", [])
+    ]
+    _print_table(["NAME", "LEVELS"], rows)
+
+
+def cmd_list_node(state: State, args) -> None:
+    rows = []
+    for n in state.data.get("nodes", []):
+        alloc = ",".join(f"{r}={q}" for r, q in n.get("allocatable", {}).items())
+        labels = ",".join(f"{k}={v}" for k, v in n.get("labels", {}).items())
+        ready = "True" if n.get("ready", True) else "False"
+        rows.append([n["name"], ready, alloc, labels])
+    _print_table(["NAME", "READY", "ALLOCATABLE", "LABELS"], rows)
+
+
 # ---- stop / resume ----
 def cmd_stop(state: State, args) -> None:
     if args.kind == "workload":
@@ -618,6 +636,10 @@ def build_parser() -> argparse.ArgumentParser:
     llq = lst.add_parser("localqueue", aliases=["lq"])
     llq.add_argument("-n", "--namespace", default="")
     llq.set_defaults(fn=cmd_list_lq)
+    lto = lst.add_parser("topology")
+    lto.set_defaults(fn=cmd_list_topology)
+    lnode = lst.add_parser("node")
+    lnode.set_defaults(fn=cmd_list_node)
     lrf = lst.add_parser("resourceflavor", aliases=["rf"])
     lrf.set_defaults(fn=cmd_list_rf)
     lwl = lst.add_parser("workload", aliases=["wl"])

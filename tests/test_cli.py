@@ -413,3 +413,17 @@ class TestCLITopologyAuthoring:
         capsys.readouterr()
         state = json.loads((tmp_path / "state.json").read_text())
         assert state.get("nodes", []) == []
+
+    def test_list_topology_and_node(self, tmp_path, capsys):
+        cli(tmp_path, "create", "topology", "t", "--levels", "rack,host")
+        cli(tmp_path, "create", "node", "n-0",
+            "--labels", "rack=r0,host=n-0", "--allocatable", "cpu=4")
+        capsys.readouterr()
+        cli(tmp_path, "list", "topology")
+        out = capsys.readouterr().out
+        assert "rack,host" in out
+        cli(tmp_path, "list", "node")
+        out = capsys.readouterr().out
+        # the state keeps the human-authored quantity; node_from_dict
+        # canonicalizes on load
+        assert "n-0" in out and "cpu=4" in out and "rack=r0" in out
