@@ -311,9 +311,16 @@ def cmd_list_topology(state: State, args) -> None:
 
 
 def cmd_list_node(state: State, args) -> None:
+    from kueue_tpu.resources import int_to_display
+
     rows = []
     for n in state.data.get("nodes", []):
-        alloc = ",".join(f"{r}={q}" for r, q in n.get("allocatable", {}).items())
+        alloc = ",".join(
+            # ints are canonical (server-exported state: cpu in milli);
+            # strings are human-authored and render verbatim
+            f"{r}={int_to_display(r, q) if isinstance(q, int) else q}"
+            for r, q in n.get("allocatable", {}).items()
+        )
         labels = ",".join(f"{k}={v}" for k, v in n.get("labels", {}).items())
         ready = "True" if n.get("ready", True) else "False"
         rows.append([n["name"], ready, alloc, labels])

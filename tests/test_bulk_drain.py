@@ -965,3 +965,147 @@ class TestTASBulkDrain:
             psa = rt.workloads[key].admission.pod_set_assignments[0]
             assert psa.topology_assignment is not None
             assert sum(d.count for d in psa.topology_assignment.domains) == 2
+
+
+class TestServerTASBulkApply:
+    """The north-star story over the wire: node inventory, topology,
+    TAS flavor, queues, and a bulk batch of topology-requesting gangs
+    all arrive through the HTTP API, and the backlog is decided by ONE
+    TAS drain dispatch (asserted via /debug/cycles) with real
+    TopologyAssignments served back."""
+
+    BLOCK = "cloud.google.com/gce-topology-block"
+    HOST = "kubernetes.io/hostname"
+    N_TCQ = 4
+    WL_PER_CQ = 80  # 320 >= the default bulk_drain_threshold of 256
+
+    def test_bulk_tas_apply_one_drain_dispatch(self):
+        import json
+        import urllib.request
+
+        from kueue_tpu.server import KueueServer
+
+        srv = KueueServer()
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+
+            def post(path, body):
+                req = urllib.request.Request(
+                    base + path, data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req) as resp:
+                    return json.loads(resp.read())
+
+            nodes = [
+                {
+                    "name": f"n-{b}-{h}",
+                    "labels": {
+                        self.BLOCK: f"b{b}",
+                        self.HOST: f"n-{b}-{h}",
+                    },
+                    "allocatable": {"cpu": "16", "pods": "64"},
+                }
+                for b in range(4)
+                for h in range(8)
+            ]
+            cqs, lqs, wls = [], [], []
+            rng = np.random.default_rng(11)
+            modes = ("Required", "Preferred", "Unconstrained")
+            for i in range(self.N_TCQ):
+                cqs.append(
+                    {
+                        "name": f"stcq-{i}",
+                        "namespaceSelector": {},
+                        "resourceGroups": [
+                            {
+                                "coveredResources": ["cpu"],
+                                "flavors": [
+                                    {
+                                        "name": "tas-flavor",
+                                        "resources": [
+                                            {
+                                                "name": "cpu",
+                                                "nominalQuota": "999",
+                                            }
+                                        ],
+                                    }
+                                ],
+                            }
+                        ],
+                    }
+                )
+                lqs.append(
+                    {
+                        "namespace": "ns",
+                        "name": f"stlq-{i}",
+                        "clusterQueue": f"stcq-{i}",
+                    }
+                )
+                for w in range(self.WL_PER_CQ):
+                    mode = modes[int(rng.integers(0, 3))]
+                    wls.append(
+                        {
+                            "namespace": "ns",
+                            "name": f"stw-{i}-{w}",
+                            "queueName": f"stlq-{i}",
+                            "creationTime": float(i * self.WL_PER_CQ + w),
+                            "podSets": [
+                                {
+                                    "name": "main",
+                                    "count": int(rng.integers(1, 5)),
+                                    "requests": {"cpu": "1"},
+                                    "topologyRequest": {
+                                        "mode": mode,
+                                        "level": (
+                                            None
+                                            if mode == "Unconstrained"
+                                            else self.HOST
+                                        ),
+                                    },
+                                }
+                            ],
+                        }
+                    )
+            post(
+                "/apis/kueue/v1beta1/batch",
+                {
+                    "topologies": [
+                        {"name": "default", "levels": [self.BLOCK, self.HOST]}
+                    ],
+                    "resourceflavors": [
+                        {"name": "tas-flavor", "topologyName": "default"}
+                    ],
+                    "nodes": nodes,
+                    "clusterqueues": cqs,
+                    "localqueues": lqs,
+                },
+            )
+            post("/apis/kueue/v1beta1/batch", {"workloads": wls})
+            with urllib.request.urlopen(base + "/debug/cycles") as resp:
+                cycles = json.loads(resp.read())["cycles"]
+            drains = [c for c in cycles if c["resolution"] == "drain"]
+            assert len(drains) == 1, (
+                f"expected exactly one drain dispatch, got {len(drains)}"
+            )
+            assert drains[0]["heads"] == self.N_TCQ * self.WL_PER_CQ
+            admitted = [
+                wl
+                for wl in srv.runtime.workloads.values()
+                if wl.has_quota_reservation
+            ]
+            assert admitted
+            # every admitted gang carries a real placement, and the
+            # modes that REQUIRE a single domain actually got one
+            for wl in admitted:
+                psa = wl.admission.pod_set_assignments[0]
+                ta = psa.topology_assignment
+                assert ta is not None
+                total = sum(d.count for d in ta.domains)
+                assert total == wl.pod_sets[0].count
+                if wl.pod_sets[0].topology_request.mode == "Required":
+                    assert len(ta.domains) == 1
+        finally:
+            srv.stop()

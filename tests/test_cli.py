@@ -427,3 +427,21 @@ class TestCLITopologyAuthoring:
         # the state keeps the human-authored quantity; node_from_dict
         # canonicalizes on load
         assert "n-0" in out and "cpu=4" in out and "rack=r0" in out
+
+    def test_list_node_renders_canonical_ints(self, tmp_path, capsys):
+        """Server-exported states carry canonical milli quantities;
+        the listing must render them human-readable, not 1000x raw."""
+        state = {
+            "nodes": [
+                {
+                    "name": "n-c",
+                    "labels": {"h": "n-c"},
+                    "allocatable": {"cpu": 16000, "pods": 64},
+                    "ready": True,
+                }
+            ]
+        }
+        (tmp_path / "state.json").write_text(json.dumps(state))
+        cli(tmp_path, "list", "node")
+        out = capsys.readouterr().out
+        assert "cpu=16" in out and "cpu=16000" not in out
