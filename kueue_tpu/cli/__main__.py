@@ -441,6 +441,43 @@ def cmd_pending_workloads(state: State, args) -> None:
     _print_table(["POSITION", "NAMESPACE", "NAME", "LOCALQUEUE", "PRIORITY"], rows)
 
 
+# ---- events (the `kubectl get events` / `--watch` analog) ----
+def cmd_events(state: State, args) -> None:
+    """List the control plane's recorded events, or follow them live
+    (resourceVersion long-poll — the client blocks server-side until
+    something newer lands; no polling loop)."""
+    if not getattr(args, "server", None):
+        raise SystemExit(
+            "error: events requires --server (the live event stream "
+            "exists only in a running kueue_tpu.server)"
+        )
+    client = _server_client(args)
+
+    def row(e: dict) -> List[str]:
+        return [
+            str(e.get("resourceVersion", "")),
+            e.get("reason", ""),
+            e.get("object", ""),
+            str(e.get("count", 1)),
+            e.get("message", ""),
+        ]
+
+    headers = ["RV", "REASON", "OBJECT", "COUNT", "MESSAGE"]
+    if args.watch:
+        _print_table(headers, [])
+        try:
+            for e in client.watch(
+                "events", resource_version=args.resource_version
+            ):
+                print("  ".join(row(e)))
+        except KeyboardInterrupt:
+            pass
+        return
+    out = client.events(args.resource_version)
+    _print_table(headers, [row(e) for e in out.get("items", [])])
+    print(f"resourceVersion: {out.get('resourceVersion', 0)}")
+
+
 # ---- schedule ----
 def cmd_schedule(state: State, args) -> None:
     rt = state.build_runtime()
@@ -676,6 +713,19 @@ def build_parser() -> argparse.ArgumentParser:
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=cmd_version)
+
+    ev = sub.add_parser("events")
+    ev.add_argument(
+        "-w", "--watch", action="store_true",
+        help="follow the stream live (resourceVersion long-poll; "
+        "Ctrl-C to stop)",
+    )
+    ev.add_argument(
+        "--resource-version", type=int, default=0,
+        help="only events newer than this resourceVersion",
+    )
+    _add_server_flags(ev, "read events from a running kueue_tpu.server")
+    ev.set_defaults(fn=cmd_events)
 
     pw = sub.add_parser("pending-workloads")
     pw.add_argument("clusterqueue")

@@ -10,7 +10,6 @@ the reference achieves asynchronously with informers + workqueues.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from kueue_tpu.models import (
@@ -25,6 +24,7 @@ from kueue_tpu.models.cohort import Cohort
 from kueue_tpu.models.constants import WorkloadConditionType
 from kueue_tpu.models.topology import Topology
 from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.events import Event, EventRecorder
 from kueue_tpu.core.queue_manager import QueueManager, RequeueReason
 from kueue_tpu.core.scheduler import Scheduler
 from kueue_tpu.controllers.jobframework import GenericJob, JobReconciler
@@ -34,12 +34,7 @@ from kueue_tpu.controllers.workload_controller import (
 )
 from kueue_tpu.utils.clock import Clock
 
-
-@dataclass
-class Event:
-    kind: str
-    object_key: str
-    message: str = ""
+__all__ = ["ClusterRuntime", "Event"]
 
 
 class ClusterRuntime:
@@ -72,7 +67,10 @@ class ClusterRuntime:
         self.indexer = workload_indexer()
         # workload key -> job key (O(1) has_job_for on eviction paths)
         self._jobs_by_workload: Dict[str, str] = {}
-        self.events: List[Event] = []
+        # the recorder IS the live observability spine: every status
+        # transition lands here, stamped with a monotone resourceVersion
+        # the server's watch/SSE surface resumes from
+        self.events = EventRecorder(clock=self.clock)
         self.metrics = Metrics()
         self.pods_ready_cfg = wait_for_pods_ready or WaitForPodsReadyConfig()
         # resource adjustment pipeline stores (pkg/workload/resources.go)
@@ -161,7 +159,7 @@ class ClusterRuntime:
 
     # ---- events ----
     def event(self, kind: str, wl: Workload, message: str = "") -> None:
-        self.events.append(Event(kind=kind, object_key=wl.key, message=message))
+        self.events.record(kind, wl.key, message)
         # status transitions mutate workloads in place (admission set/
         # cleared, check states flipped); the informer cache the
         # reference indexes over sees those as update events, so the
@@ -175,6 +173,9 @@ class ClusterRuntime:
 
         Preemptions are reported via the preemptor's metrics hook (the
         preempting CQ isn't derivable from the victim workload)."""
+        # every recorded event mirrors into the scrape surface, so
+        # alerting sees the same series the watch stream tells
+        self.metrics.events_total.inc(kind="Workload", reason=kind)
         now = self.clock.now()
         cq = wl.admission.cluster_queue if wl.admission else ""
         if kind == "QuotaReserved" and cq:
@@ -221,6 +222,7 @@ class ClusterRuntime:
                     self.metrics.admission_cycle_phase_duration_seconds.observe(
                         seconds, phase=phase
                     )
+                self.metrics.report_cycle(trace)
         for cq_name, pending in self.queues.cluster_queues.items():
             self.metrics.report_pending_workloads(
                 cq_name, pending.pending_active(), pending.pending_inadmissible()
@@ -661,7 +663,10 @@ class ClusterRuntime:
         for key in sorted(self.jobs):
             job = self.jobs[key]
             parts.append((key, job.is_suspended()))
-        return tuple(parts), len(self.events)
+        # the recorder's resourceVersion advances on series dedups too,
+        # so a repeated event still registers as progress (the old
+        # len(events) missed that once dedup landed)
+        return tuple(parts), self.events.resource_version
 
     def schedule_once(self):
         """One scheduler cycle with metric reporting."""
@@ -763,6 +768,7 @@ class ClusterRuntime:
         pending = self.drain_backlog(snapshot)
         if len(pending) < self.bulk_drain_threshold:
             return None
+        t_snapshot = _time.perf_counter() - t0
 
         ts_fn = lambda wl: queue_order_timestamp(  # noqa: E731
             wl, self.queues._ts_policy
@@ -778,17 +784,21 @@ class ClusterRuntime:
             if self.cache.tas_cache is not None
             else set()
         )
+        t1 = _time.perf_counter()
         kind, pending = classify_drain_scope(
             snapshot, pending, tas_flavors, sched.fair_sharing
         )
+        t_classify = _time.perf_counter() - t1
         if len(pending) < self.bulk_drain_threshold:
             return None  # TAS heads dropped to the cycle loop shrank it
+        t1 = _time.perf_counter()
         outcome = run_drain_for_scope(
             kind, snapshot, pending, self.cache.flavors,
             tas_cache=self.cache.tas_cache,
             fs_strategies=getattr(sched.preemptor, "fs_strategies", None),
             timestamp_fn=ts_fn,
         )
+        t_solve = _time.perf_counter() - t1
         # plan+dispatch cost only — the apply below is per-admission
         # bookkeeping both paths pay
         self._drain_est.observe(
@@ -804,7 +814,9 @@ class ClusterRuntime:
             # or run_until_idle would see an unchanged fingerprint and
             # stop with the whole backlog still pending
             return None
+        t1 = _time.perf_counter()
         result = self._apply_drain_outcome(outcome, snapshot)
+        t_apply = _time.perf_counter() - t1
         dt = _time.perf_counter() - t0
         sched.scheduling_cycle += 1
         trace = CycleTrace(
@@ -814,7 +826,17 @@ class ClusterRuntime:
             preempting=len(result.preempting),
             resolution="drain",
             total_s=dt,
-            spans={"drain": dt},
+            # drain-path phase attribution: snapshot+backlog collection,
+            # scope classification, the device plan+dispatch, and the
+            # host-side outcome apply
+            spans={
+                "snapshot": t_snapshot,
+                "classify": t_classify,
+                "solve": t_solve,
+                "apply": t_apply,
+            },
+            device_s=t_solve,
+            host_s=dt - t_solve,
         )
         sched.last_traces.append(trace)
         self._report_cycle_metrics(result, dt)

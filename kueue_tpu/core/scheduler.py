@@ -129,8 +129,13 @@ class CycleTrace:
     preempting: int = 0
     resolution: str = "host"
     total_s: float = 0.0
-    # phase -> seconds: snapshot / nominate / admit
+    # phase -> seconds: snapshot / nominate / admit on the cycle path,
+    # snapshot / classify / solve / apply on the bulk-drain path
     spans: Dict[str, float] = field(default_factory=dict)
+    # device vs host attribution: time spent inside device dispatches
+    # (assign/victim kernels, the drain solve) vs everything else
+    device_s: float = 0.0
+    host_s: float = 0.0
 
     def to_dict(self) -> dict:
         return {
@@ -140,6 +145,8 @@ class CycleTrace:
             "preempting": self.preempting,
             "resolution": self.resolution,
             "totalMs": round(self.total_s * 1e3, 3),
+            "deviceMs": round(self.device_s * 1e3, 3),
+            "hostMs": round(self.host_s * 1e3, 3),
             "spansMs": {k: round(v * 1e3, 3) for k, v in self.spans.items()},
         }
 
@@ -243,12 +250,16 @@ class Scheduler:
         # per cycle only changed usage rows + the heads batch transfer
         # (core/solver.ResidentCycleState; VERDICT r4 item 7)
         self._resident_state = None
+        # device time accumulated by the CURRENT cycle's dispatches
+        # (assign + victim kernels), folded into its CycleTrace
+        self._cycle_device_s = 0.0
 
     # ---- the cycle (scheduler.go:176-310) ----
     def schedule(self) -> CycleResult:
         self.scheduling_cycle += 1
         result = CycleResult()
         trace = CycleTrace(cycle=self.scheduling_cycle)
+        self._cycle_device_s = 0.0
         t0 = _time.perf_counter()
 
         heads = self.queues.heads()
@@ -418,6 +429,8 @@ class Scheduler:
         trace.admitted = len(result.admitted)
         trace.preempting = len(result.preempting)
         trace.resolution = result.resolution
+        trace.device_s = self._cycle_device_s
+        trace.host_s = max(trace.total_s - self._cycle_device_s, 0.0)
         self.last_traces.append(trace)
 
     # ---- nomination (scheduler.go:344-378) ----
@@ -541,6 +554,7 @@ class Scheduler:
             )
             dt = _time.perf_counter() - t0
             self._device_victim_est.observe(dt)
+            self._cycle_device_s += dt
         else:
             all_targets = [
                 self.preemptor.get_targets(
@@ -650,6 +664,7 @@ class Scheduler:
         res = dispatch_lowered(snapshot, lowered, resident=self._resident_state)
         dt = _time.perf_counter() - t0
         self._device_dispatch_est.observe(dt)
+        self._cycle_device_s += dt
         chosen = np.asarray(res.chosen)
         host_idx = [
             i

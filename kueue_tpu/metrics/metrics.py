@@ -41,6 +41,42 @@ class Metrics:
             ("phase",),
             buckets=ATTEMPT_BUCKETS,
         )
+        # event-stream mirror: every EventRecorder.record() increments
+        # this, so scrape-based alerting sees the same story the
+        # watch/SSE stream tells (kind = object kind, reason = event
+        # reason: Admitted, Pending, Evicted, Preempted, ...)
+        self.events_total = r.counter(
+            f"{NS}_events_total",
+            "Total number of recorded events per object kind and reason",
+            ("kind", "reason"),
+        )
+        # per-cycle trace mirror (CycleTrace): counts/latency by which
+        # conflict-resolution path ran (host | device | drain)
+        self.cycle_total = r.counter(
+            f"{NS}_cycle_total",
+            "Total number of scheduling cycles per resolution path",
+            ("resolution",),
+        )
+        self.cycle_duration_seconds = r.histogram(
+            f"{NS}_cycle_duration_seconds",
+            "Wall-clock latency of a scheduling cycle per resolution path",
+            ("resolution",),
+            buckets=ATTEMPT_BUCKETS,
+        )
+        self.cycle_device_seconds = r.histogram(
+            f"{NS}_cycle_device_seconds",
+            "Time a scheduling cycle spent inside device dispatches",
+            ("resolution",),
+            buckets=ATTEMPT_BUCKETS,
+        )
+        self.cycle_last_heads = r.gauge(
+            f"{NS}_cycle_last_heads",
+            "Head count of the most recent scheduling cycle",
+        )
+        self.cycle_last_admitted = r.gauge(
+            f"{NS}_cycle_last_admitted",
+            "Admissions in the most recent scheduling cycle",
+        )
         self.admission_cycle_preemption_skips = r.gauge(
             f"{NS}_admission_cycle_preemption_skips",
             "Number of workloads whose preemption was skipped in the last cycle",
@@ -161,6 +197,18 @@ class Metrics:
     def report_admission_attempt(self, result: str, duration_s: float) -> None:
         self.admission_attempts_total.inc(result=result)
         self.admission_attempt_duration_seconds.observe(duration_s, result=result)
+
+    def report_cycle(self, trace) -> None:
+        """Mirror one CycleTrace into the scrape surface."""
+        self.cycle_total.inc(resolution=trace.resolution)
+        self.cycle_duration_seconds.observe(
+            trace.total_s, resolution=trace.resolution
+        )
+        self.cycle_device_seconds.observe(
+            trace.device_s, resolution=trace.resolution
+        )
+        self.cycle_last_heads.set(trace.heads)
+        self.cycle_last_admitted.set(trace.admitted)
 
     def report_pending_workloads(self, cq: str, active: int, inadmissible: int) -> None:
         self.pending_workloads.set(active, cluster_queue=cq, status="active")

@@ -80,6 +80,15 @@ class Counter(_Metric):
     def value(self, **labels) -> float:
         return self._values.get(self._key(labels), 0.0)
 
+    def series(self):
+        """(labels_dict, value) per live series — the public read the
+        dashboard aggregates from (no poking at _values)."""
+        with self._lock:
+            return [
+                (dict(zip(self.label_names, key)), v)
+                for key, v in self._values.items()
+            ]
+
     def delete(self, **labels) -> None:
         self._values.pop(self._key(labels), None)
 

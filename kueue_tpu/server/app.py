@@ -19,6 +19,14 @@ Routes (all JSON unless noted):
                                                flip a check state — the
                                                phase-2 plugin boundary
                                                (admissioncheck_types.go:23-45)
+  GET  /apis/kueue/v1beta1/events              recorded events (+resourceVersion)
+  GET  /apis/kueue/v1beta1/{section}?watch=1&resourceVersion=N
+                                               long-poll: blocks until events
+                                               newer than N land (410 when N
+                                               fell out of the ring — relist)
+  GET  /events/stream                          Server-Sent-Events live tail
+                                               (id: = resourceVersion, resumes
+                                               via Last-Event-ID)
   POST /reconcile                              run_until_idle; returns cycles
   GET  /state                                  full state dump (checkpoint)
   POST /apis/solver/v1beta1/assign             stateless jax-assign: body is
@@ -120,6 +128,9 @@ _SECTIONS: Dict[str, _Section] = {
         "add_priority_class",
         lambda rt: rt.cache.priority_classes,
     ),
+    # "events" is NOT here: it is read-only and served straight from
+    # the runtime's EventRecorder (list + watch below), never upserted
+
     "limitranges": _Section(
         ser.limit_range_from_dict, ser.limit_range_to_dict, "add_limit_range",
         lambda rt: rt.limit_ranges, namespaced=True,
@@ -269,6 +280,9 @@ class KueueServer:
         self._ckpt_seq = 0
         self._ckpt_written = 0
         self._ckpt_write_lock = threading.Lock()
+        # flipped by stop(): parked watch long-polls and SSE tails
+        # check it so shutdown never waits out a full poll window
+        self._stopping = threading.Event()
 
     def require_leader(self) -> None:
         if self.elector is not None and not self.elector.is_leader:
@@ -454,6 +468,7 @@ class KueueServer:
                 traceback.print_exc()
 
     def start(self, tls_rotation_period_s: float = 3600.0) -> int:
+        self._stopping.clear()
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((self._host, self._port), handler)
         if self.tls is not None:
@@ -517,6 +532,7 @@ class KueueServer:
         FIRST, then run ``before_release`` (the final state checkpoint),
         then release the lease — so a standby can only take over after
         the checkpoint it will reload from is fully on disk."""
+        self._stopping.set()  # unpark watch long-polls / SSE tails
         if self._tls_rotation_thread is not None:
             self._tls_rotation_stop.set()
             self._tls_rotation_thread.join(timeout=5)
@@ -602,6 +618,7 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
         "lq_status",
     ),
     ("POST", re.compile(r"^/reconcile$"), "reconcile"),
+    ("GET", re.compile(r"^/events/stream$"), "events_stream"),
     ("GET", re.compile(r"^/debug/cycles$"), "debug_cycles"),
     ("GET", re.compile(r"^/state$"), "state"),
     ("POST", re.compile(r"^/apis/solver/v1beta1/assign$"), "solve"),
@@ -736,8 +753,105 @@ def _make_handler(srv: KueueServer):
                 )
             self._send_json(_summary_to_dict(summary))
 
+        # section -> the event ``regarding.kind`` a watch on it filters
+        # to ("events" itself is unfiltered). Today every emission site
+        # regards a Workload; the map keeps the route shape K8s-true so
+        # future kinds slot in without a new URL scheme.
+        _REGARDING = {
+            "events": None,
+            "workloads": "Workload",
+            "clusterqueues": "ClusterQueue",
+            "localqueues": "LocalQueue",
+        }
+
         def _h_list(self, section, query):
+            if query.get("watch") in ("1", "true"):
+                return self._watch(section, query)
+            if section == "events":
+                rec = srv.runtime.events
+                items, _ = rec.since(
+                    self._int_param(query, "resourceVersion", 0)
+                )
+                return self._send_json(
+                    {"items": items, "resourceVersion": rec.resource_version}
+                )
             self._send_json(srv.list_section(section))
+
+        def _watch(self, section, query):
+            """resourceVersion long-poll (the apiserver watch analog):
+            blocks OUTSIDE srv.lock until the recorder stamps something
+            newer than the client's resourceVersion, then returns the
+            delta. 410 when the version fell out of the bounded ring —
+            the client must relist and re-watch from the fresh head."""
+            if section != "events" and section not in _SECTIONS:
+                raise ApiError(404, f"unknown section {section!r}")
+            regarding = self._REGARDING.get(
+                section, section[:-1].capitalize()
+            )
+            rv = self._int_param(query, "resourceVersion", 0)
+            try:
+                timeout = min(float(query.get("timeoutSeconds", 30)), 300.0)
+            except ValueError:
+                raise ApiError(400, "timeoutSeconds must be a number")
+            rec = srv.runtime.events
+            items, latest, too_old = rec.wait(
+                rv, timeout, regarding_kind=regarding,
+                should_stop=srv._stopping.is_set,
+            )
+            if too_old:
+                raise ApiError(
+                    410,
+                    f"resourceVersion {rv} is too old; relist and "
+                    f"re-watch from {latest}",
+                )
+            self._send_json({"items": items, "resourceVersion": latest})
+
+        def _h_events_stream(self, query):
+            """Server-Sent-Events live tail of the event pipeline. Each
+            frame's ``id:`` is the event's resourceVersion, so EventSource
+            reconnects resume exactly where they dropped (Last-Event-ID);
+            an ``event: reset`` frame tells the client its resume point
+            fell out of the ring (the 410 analog mid-stream). Heartbeat
+            comments every poll window keep proxies from reaping the
+            connection and surface dead clients to the server."""
+            rv = self._int_param(query, "resourceVersion", 0)
+            last_id = self.headers.get("Last-Event-ID")
+            if last_id:
+                try:
+                    rv = int(last_id)
+                except ValueError:
+                    pass
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.close_connection = True
+            self.end_headers()
+            rec = srv.runtime.events
+            try:
+                while not srv._stopping.is_set():
+                    if srv.runtime.events is not rec:
+                        # HA promotion swapped the runtime (and with it
+                        # the recorder): restart from its head
+                        rec = srv.runtime.events
+                        rv = 0
+                    items, latest, too_old = rec.wait(
+                        rv, 15.0, should_stop=srv._stopping.is_set
+                    )
+                    if too_old:
+                        self.wfile.write(b"event: reset\ndata: {}\n\n")
+                    for item in items:
+                        frame = (
+                            f"id: {item['resourceVersion']}\n"
+                            f"data: {json.dumps(item)}\n\n"
+                        )
+                        self.wfile.write(frame.encode())
+                    if not items:
+                        self.wfile.write(b": keep-alive\n\n")
+                    self.wfile.flush()
+                    rv = max(rv, latest)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass  # client went away — the stream's normal ending
 
         def _h_get_ns(self, section, ns, name, query):
             self._send_json(srv.get_object(section, ns, name))

@@ -40,7 +40,13 @@ class KueueClient:
             import ssl
 
             if insecure:
-                self._ssl_context = ssl._create_unverified_context()
+                # public-API spelling of an unverified context (the
+                # private ssl._create_unverified_context helper is not
+                # a stable interface)
+                ctx = ssl.create_default_context()
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+                self._ssl_context = ctx
             else:
                 self._ssl_context = ssl.create_default_context(cafile=ca_cert)
 
@@ -135,6 +141,71 @@ class KueueClient:
             f"/apis/visibility/v1beta1/namespaces/{namespace}/localqueues/{lq}"
             f"/pendingworkloads?offset={offset}&limit={limit}",
         )
+
+    # ---- events / watch ----
+    def events(self, resource_version: int = 0) -> dict:
+        """Recorded events newer than ``resource_version`` plus the
+        current head version (the relist half of list+watch)."""
+        return self._request(
+            "GET",
+            f"/apis/kueue/v1beta1/events?resourceVersion={resource_version}",
+        )
+
+    def watch(
+        self,
+        section: str = "events",
+        resource_version: int = 0,
+        poll_timeout: float = 30.0,
+    ):
+        """Generator of event dicts via resourceVersion long-polls (the
+        client-go Watch analog): each iteration blocks server-side until
+        something newer than the last delivered resourceVersion lands —
+        no client-side polling loop. On 410 (resume point fell out of
+        the ring) it relists and continues from the fresh head."""
+        rv = resource_version
+        while True:
+            try:
+                out = self._request(
+                    "GET",
+                    f"/apis/kueue/v1beta1/{section}?watch=1"
+                    f"&resourceVersion={rv}&timeoutSeconds={poll_timeout}",
+                )
+            except ClientError as e:
+                if e.status != 410:
+                    raise
+                out = self.events()  # gap: relist, resume from head
+            for item in out.get("items", []):
+                yield item
+            # follow the server's head verbatim (not max): an HA
+            # promotion swaps the recorder and restarts its versions,
+            # and pinning the old high-water would park this watch
+            # forever
+            rv = int(out.get("resourceVersion", rv))
+
+    def stream_events(self, resource_version: int = 0):
+        """Generator over the server's SSE tail (/events/stream): yields
+        event dicts as the server pushes them. The read blocks on the
+        live connection — delivery is server push, not polling; the
+        server's keep-alive comments bound each socket read well below
+        ``timeout``."""
+        req = urllib.request.Request(
+            f"{self.base_url}/events/stream?resourceVersion={resource_version}",
+            headers=(
+                {"Authorization": f"Bearer {self.token}"} if self.token else {}
+            ),
+        )
+        resp = urllib.request.urlopen(
+            req, timeout=max(self.timeout, 30.0), context=self._ssl_context
+        )
+        try:
+            for raw in resp:
+                line = raw.decode().rstrip("\n")
+                if line.startswith("data: "):
+                    payload = line[len("data: "):]
+                    if payload and payload != "{}":
+                        yield json.loads(payload)
+        finally:
+            resp.close()
 
     # ---- control ----
     def reconcile(self) -> dict:

@@ -1,12 +1,20 @@
-"""kueueviz-equivalent read-only dashboard.
+"""kueueviz-equivalent live dashboard.
 
 Reference: cmd/kueueviz — a Go/gin backend streaming cluster state to
 a React frontend over websockets. Here the same live views (cluster
-queues with quota/usage bars, local queues, workloads with admission
-state, flavors, cohorts, recent events) are computed server-side into
+queues with quota/usage bars and pending/admitted/evicted counters,
+local queues, workloads with admission state, flavors, cohorts, the
+event stream, last-cycle phase timings) are computed server-side into
 one JSON payload (``dashboard_payload``) and rendered by a single
-self-contained HTML page that polls ``/api/dashboard`` — no external
-assets, so it works in air-gapped deployments.
+self-contained HTML page — no external assets, so it works in
+air-gapped deployments.
+
+The page is LIVE, not poll-only: it subscribes to the server's
+Server-Sent-Events tail (``/events/stream``), appends events as they
+arrive, and refetches the payload when the stream reports change
+(debounced), falling back to 5 s polling only while the stream is
+down. Idle clusters cost one open socket and a heartbeat, not a
+request every 2 s.
 """
 
 from __future__ import annotations
@@ -33,6 +41,14 @@ def dashboard_payload(rt) -> dict:
     """One read of the runtime -> everything the dashboard shows."""
     cache = rt.cache
     queues = rt.queues
+
+    # per-CQ eviction totals from the scrape surface (summed over
+    # reasons) — the counter survives workload deletion, so the tile
+    # shows history, not just currently-evicted objects
+    evicted_by_cq: Dict[str, float] = {}
+    for labels, value in rt.metrics.evicted_workloads_total.series():
+        cq = labels.get("cluster_queue", "")
+        evicted_by_cq[cq] = evicted_by_cq.get(cq, 0) + value
 
     cqs: List[dict] = []
     for name, cached in sorted(cache.cluster_queues.items()):
@@ -71,6 +87,7 @@ def dashboard_payload(rt) -> dict:
                 "admitted": sum(
                     1 for w in cached.workloads.values() if w.is_admitted
                 ),
+                "evicted": int(evicted_by_cq.get(name, 0)),
                 "quota": quota_rows,
             }
         )
@@ -102,6 +119,7 @@ def dashboard_payload(rt) -> dict:
     for w in workloads:
         state_counts[w["state"]] = state_counts.get(w["state"], 0) + 1
 
+    traces = list(rt.scheduler.last_traces)
     return {
         "clusterQueues": cqs,
         "localQueues": lqs,
@@ -109,8 +127,18 @@ def dashboard_payload(rt) -> dict:
         "workloadStates": state_counts,
         "resourceFlavors": sorted(cache.flavors),
         "cohorts": sorted(cache.cohorts),
+        # the watch head: a client that refetches can resume its event
+        # stream from here without a gap
+        "resourceVersion": rt.events.resource_version,
+        "lastCycle": traces[-1].to_dict() if traces else None,
         "events": [
-            {"kind": e.kind, "object": e.object_key, "message": e.message}
+            {
+                "kind": e.kind,
+                "object": e.object_key,
+                "message": e.message,
+                "count": e.count,
+                "resourceVersion": e.resource_version,
+            }
             for e in rt.events[-100:]
         ],
     }
@@ -134,6 +162,8 @@ DASHBOARD_HTML = """<!doctype html>
          color:var(--fg); padding:24px; }
   h1 { font-size:18px; margin:0 0 4px; } h2 { font-size:14px; margin:24px 0 8px; }
   .muted { color:var(--muted); }
+  #mode { font-weight:600; }
+  #mode.live { color:var(--ok); } #mode.poll { color:var(--warn); }
   .tiles { display:flex; gap:12px; flex-wrap:wrap; margin:16px 0; }
   .tile { background:var(--card); border:1px solid var(--line); border-radius:8px;
           padding:12px 16px; min-width:110px; }
@@ -151,17 +181,19 @@ DASHBOARD_HTML = """<!doctype html>
   .state-Admitted { color:var(--ok); } .state-Pending { color:var(--muted); }
   .state-Evicted { color:var(--bad); } .state-QuotaReserved { color:var(--warn); }
   .state-Finished { color:var(--muted); }
+  .ev-Admitted { color:var(--ok); } .ev-Preempted,.ev-Evicted { color:var(--bad); }
   code { font-size:12px; }
 </style>
 </head>
 <body>
 <h1>kueue-tpu</h1>
-<div class="muted">read-only control-plane dashboard &middot; polls /api/dashboard every 2s</div>
+<div class="muted">control-plane dashboard &middot; <span id="mode" class="poll">connecting&hellip;</span></div>
 <div class="tiles" id="tiles"></div>
+<h2>Last cycle</h2><div id="cycle"></div>
 <h2>ClusterQueues</h2><div id="cqs"></div>
 <h2>Workloads</h2><div id="wls"></div>
 <h2>LocalQueues</h2><div id="lqs"></div>
-<h2>Recent events</h2><div id="events"></div>
+<h2>Event stream</h2><div id="events"></div>
 <script>
 function esc(s){return String(s).replace(/[&<>"]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]))}
 function bar(used,nominal){
@@ -169,18 +201,42 @@ function bar(used,nominal){
   const over = nominal>0 && used>nominal;
   return `<span class="bar"><i class="${over?'over':''}" style="width:${pct}%"></i></span>`;
 }
+const evlog = [];            // live event ring (newest first, capped)
+function pushEvent(e){
+  evlog.unshift(e);
+  if (evlog.length > 100) evlog.pop();
+  renderEvents();
+}
+function renderEvents(){
+  document.getElementById('events').innerHTML = '<table><tr><th>rv</th><th>reason</th>'+
+    '<th>object</th><th>count</th><th>message</th></tr>'+
+    evlog.map(e=>`<tr><td>${e.resourceVersion}</td>`+
+      `<td class="ev-${esc(e.reason||e.kind)}">${esc(e.reason||e.kind)}</td>`+
+      `<td>${esc(e.object)}</td><td>${e.count||1}</td>`+
+      `<td>${esc(e.message)}</td></tr>`).join('')+'</table>';
+}
 function render(d){
   const st = d.workloadStates||{};
   document.getElementById('tiles').innerHTML =
     [['ClusterQueues',d.clusterQueues.length],['LocalQueues',d.localQueues.length],
      ['Workloads',d.workloads.length],['Admitted',st.Admitted||0],
-     ['Pending',st.Pending||0],['Flavors',d.resourceFlavors.length],
-     ['Cohorts',d.cohorts.length]]
+     ['Pending',st.Pending||0],['Evicted',st.Evicted||0],
+     ['Flavors',d.resourceFlavors.length],['Cohorts',d.cohorts.length]]
     .map(([k,v])=>`<div class="tile"><b>${v}</b><span class="muted">${k}</span></div>`).join('');
+  const c = d.lastCycle;
+  document.getElementById('cycle').innerHTML = !c ? '<span class="muted">no cycles yet</span>' :
+    '<table><tr><th>cycle</th><th>resolution</th><th>heads</th><th>admitted</th>'+
+    '<th>preempting</th><th>total</th><th>device</th><th>host</th><th>phases</th></tr>'+
+    `<tr><td>${c.cycle}</td><td>${esc(c.resolution)}</td><td>${c.heads}</td>`+
+    `<td>${c.admitted}</td><td>${c.preempting}</td><td>${c.totalMs} ms</td>`+
+    `<td>${c.deviceMs} ms</td><td>${c.hostMs} ms</td><td><code>`+
+    Object.entries(c.spansMs||{}).map(([k,v])=>`${esc(k)}=${v}`).join(' ')+
+    `</code></td></tr></table>`;
   document.getElementById('cqs').innerHTML = '<table><tr><th>name</th><th>cohort</th>'+
-    '<th>pending</th><th>admitted</th><th>quota (used / nominal)</th></tr>'+
+    '<th>pending</th><th>admitted</th><th>evicted</th><th>quota (used / nominal)</th></tr>'+
     d.clusterQueues.map(cq=>`<tr><td>${esc(cq.name)}</td><td>${esc(cq.cohort||'')}</td>`+
-      `<td>${cq.pendingActive}+${cq.pendingInadmissible}</td><td>${cq.admitted}</td><td>`+
+      `<td>${cq.pendingActive}+${cq.pendingInadmissible}</td><td>${cq.admitted}</td>`+
+      `<td>${cq.evicted||0}</td><td>`+
       cq.quota.map(q=>`${esc(q.flavor)}/${esc(q.resource)} ${bar(q.used,q.nominal)} `+
         `<code>${q.used}/${q.nominal}</code>`).join('<br>')+
       `</td></tr>`).join('')+'</table>';
@@ -193,15 +249,35 @@ function render(d){
     '<th>clusterQueue</th><th>stopPolicy</th></tr>'+
     d.localQueues.map(l=>`<tr><td>${esc(l.namespace)}</td><td>${esc(l.name)}</td>`+
       `<td>${esc(l.clusterQueue)}</td><td>${l.stopPolicy}</td></tr>`).join('')+'</table>';
-  document.getElementById('events').innerHTML = '<table><tr><th>kind</th><th>object</th>'+
-    '<th>message</th></tr>'+
-    d.events.slice().reverse().map(e=>`<tr><td>${esc(e.kind)}</td><td>${esc(e.object)}</td>`+
-      `<td>${esc(e.message)}</td></tr>`).join('')+'</table>';
+  if (!evlog.length && d.events) {           // seed the log once from the payload
+    d.events.slice().reverse().forEach(e=>{ evlog.unshift(e); if(evlog.length>100) evlog.pop(); });
+    renderEvents();
+  }
 }
-async function tick(){
+async function refetch(){
   try { render(await (await fetch('/api/dashboard')).json()); } catch(e) {}
 }
-tick(); setInterval(tick, 2000);
+let refetchTimer = null;
+function scheduleRefetch(){          // debounce: one fetch per burst of events
+  if (refetchTimer) return;
+  refetchTimer = setTimeout(()=>{ refetchTimer = null; refetch(); }, 250);
+}
+let pollTimer = null;
+function setMode(live){
+  const el = document.getElementById('mode');
+  el.textContent = live ? 'live (event stream)' : 'polling /api/dashboard every 5s';
+  el.className = live ? 'live' : 'poll';
+  if (live && pollTimer) { clearInterval(pollTimer); pollTimer = null; }
+  if (!live && !pollTimer) pollTimer = setInterval(refetch, 5000);
+}
+function connect(){
+  const es = new EventSource('/events/stream');
+  es.onopen = ()=>setMode(true);
+  es.onmessage = (m)=>{ try { pushEvent(JSON.parse(m.data)); } catch(e) {} scheduleRefetch(); };
+  es.addEventListener('reset', ()=>{ evlog.length = 0; refetch(); });
+  es.onerror = ()=>setMode(false);   // EventSource auto-reconnects with Last-Event-ID
+}
+refetch(); connect();
 </script>
 </body>
 </html>

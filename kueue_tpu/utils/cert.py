@@ -274,6 +274,11 @@ class CertRotator:
                 f.write(data)
             if path == self._ca_key_path or path == self.key_path:
                 os.chmod(tmp, 0o600)
+            else:
+                # public artifacts (ca.crt, tls.crt) must be readable
+                # by verifying clients; mkstemp's 0600 default would
+                # lock them to the server's uid
+                os.chmod(tmp, 0o644)
             os.replace(tmp, path)
         except BaseException:
             try:
@@ -292,8 +297,11 @@ class CertRotator:
         ca_key = self._read(self._ca_key_path)
         if ca_cert is None or ca_key is None:
             ca_cert, ca_key = generate_ca(self.ca_valid_days, now=self._now())
-            self._write(self.ca_path, ca_cert)
+            # key before bundle (same discipline as the re-root below):
+            # a crash between the two writes must leave a state the next
+            # pass repairs, never a root without its signing key
             self._write(self._ca_key_path, ca_key)
+            self._write(self.ca_path, ca_cert)
             # a new root invalidates every cert it ever signed
             cert = key = None
         else:
@@ -335,8 +343,17 @@ class CertRotator:
                 # for another window beyond that, covering stragglers.
                 new_root, ca_key = generate_ca(self.ca_valid_days, now=now)
                 ca_bundle = new_root + ca_cert
-                self._write(self.ca_path, ca_bundle)
+                # Write the new CA KEY first, then the bundle. A crash
+                # between the writes then leaves key=new/bundle=old,
+                # which the next maybe_rotate repairs by re-rooting
+                # again (the bundle's lead root still reads near-expiry).
+                # The old order left bundle=new/key=old: the near-expiry
+                # check passes, and phase 2 would silently sign serving
+                # certs with the retired key while chaining their
+                # issuer/AKI to the new root — a broken chain nothing
+                # re-checks until clients hard-fail.
                 self._write(self._ca_key_path, ca_key)
+                self._write(self.ca_path, ca_bundle)
                 ca_cert = new_root
                 rotated = True
             cert = self._read(self.cert_path)

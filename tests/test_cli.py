@@ -208,6 +208,48 @@ class TestDeleteGetVersion:
             srv.stop()
 
 
+class TestCLIEvents:
+    def test_events_lists_recorded_events(self, tmp_path, capsys):
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.models import LocalQueue
+        from kueue_tpu.server import KueueServer
+
+        rt = ClusterRuntime()
+        rt.add_flavor(ResourceFlavor(name="default"))
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name="cq", namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",), (FlavorQuotas.build("default", {"cpu": "4"}),)
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(
+            LocalQueue(namespace="ns", name="lq", cluster_queue="cq")
+        )
+        srv = KueueServer(runtime=rt)
+        port = srv.start()
+        try:
+            wl = Workload(
+                namespace="ns", name="w1", queue_name="lq",
+                pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+            )
+            srv.apply("workloads", ser.workload_to_dict(wl))
+            capsys.readouterr()
+            cli(tmp_path, "events", "--server", f"http://127.0.0.1:{port}")
+            out = capsys.readouterr().out
+            assert "Admitted" in out and "ns/w1" in out
+            assert "resourceVersion:" in out
+        finally:
+            srv.stop()
+
+    def test_events_requires_server(self, tmp_path):
+        with pytest.raises(SystemExit, match="requires --server"):
+            cli(tmp_path, "events")
+
+
 class TestScheduleDrain:
     def test_drain_plan_matches_cycle_outcome(self, tmp_path, capsys):
         cli(tmp_path, "create", "rf", "default")
