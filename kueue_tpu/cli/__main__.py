@@ -425,20 +425,110 @@ def cmd_pending_workloads(state: State, args) -> None:
         summary = _server_client(args).pending_workloads_cq(args.clusterqueue)
         rows = [
             [str(i["positionInClusterQueue"]), i["namespace"], i["name"],
-             i["localQueueName"], str(i["priority"])]
+             i["localQueueName"], str(i["priority"]),
+             i.get("inadmissibleReason", "")]
             for i in summary["items"]
         ]
     else:
         from kueue_tpu.visibility import pending_workloads_in_cq
 
         rt = state.build_runtime()
-        summary = pending_workloads_in_cq(rt.queues, args.clusterqueue)
+        summary = pending_workloads_in_cq(
+            rt.queues, args.clusterqueue, audit=rt.audit
+        )
         rows = [
             [str(pw.position_in_cluster_queue), pw.namespace, pw.name,
-             pw.local_queue_name, str(pw.priority)]
+             pw.local_queue_name, str(pw.priority), pw.inadmissible_reason]
             for pw in summary.items
         ]
-    _print_table(["POSITION", "NAMESPACE", "NAME", "LOCALQUEUE", "PRIORITY"], rows)
+    _print_table(
+        ["POSITION", "NAMESPACE", "NAME", "LOCALQUEUE", "PRIORITY", "REASON"],
+        rows,
+    )
+
+
+# ---- explain (the decision audit trail as a timeline) ----
+def _render_decision_timeline(key: str, status: str, rows: List[dict]) -> None:
+    """Render one workload's decision history (wire dicts, oldest
+    first) the way `kubectl describe` renders conditions: one line per
+    decision plus indented detail for flavors/rejections/victims."""
+    print(f"Workload:      {key}")
+    print(f"Status:        {status}")
+    if not rows:
+        print("Decisions:     <none recorded>")
+        print(
+            "  (the workload was never nominated — check that its "
+            "LocalQueue exists and the ClusterQueue is active)"
+        )
+        return
+    print("Decisions:")
+    for d in rows:
+        cycles = (
+            f"cycle {d['cycle']}"
+            if d.get("lastCycle", d["cycle"]) == d["cycle"]
+            else f"cycles {d['cycle']}-{d['lastCycle']}"
+        )
+        seen = f" (seen x{d['count']})" if d.get("count", 1) > 1 else ""
+        via = d.get("nominatedVia", "host")
+        print(
+            f"  {cycles} [{d.get('resolution', 'host')}/{via}] "
+            f"{d['outcome']}: {d['reason']}{seen}"
+        )
+        if d.get("message"):
+            print(f"      message:  {d['message']}")
+        for ps_name, fmap in sorted(d.get("flavors", {}).items()):
+            chosen = ", ".join(f"{r}->{f}" for r, f in sorted(fmap.items()))
+            print(f"      podset {ps_name}: {chosen}")
+        for ps_name, reasons in sorted(d.get("flavorReasons", {}).items()):
+            for r in reasons:
+                print(f"      rejected [{ps_name}]: {r}")
+        pre = d.get("preemption")
+        if pre:
+            if pre.get("blocked"):
+                print(f"      preemption blocked: {pre['blocked']}")
+            for v in pre.get("victims", []):
+                print(
+                    f"      victim: {v['workload']} ({v['reason']})"
+                )
+        topo = d.get("topology")
+        if topo:
+            for ps_name, t in sorted(topo.items()):
+                doms = "; ".join(
+                    f"{'/'.join(dom['values'])} x{dom['count']}"
+                    for dom in t.get("domains", [])
+                )
+                print(f"      topology [{ps_name}]: {doms}")
+
+
+def cmd_explain(state: State, args) -> None:
+    """Why is this workload pending (or how was it admitted)? Renders
+    the decision audit trail; --server reads a live control plane,
+    otherwise the state file is loaded and scheduled in memory (no
+    writes) to reproduce the decisions."""
+    ns, name = args.namespace, args.name
+    key = f"{ns}/{name}"
+    if getattr(args, "server", None):
+        client = _server_client(args)
+        wl_dict = client.get_workload(ns, name)
+        wl = ser.workload_from_dict(wl_dict)
+        rows = client.workload_decisions(ns, name).get("items", [])
+    else:
+        rt = state.build_runtime()
+        rt.run_until_idle()  # in-memory only: state file is NOT saved
+        wl = rt.workloads.get(key)
+        if wl is None:
+            raise SystemExit(f"error: workload {key!r} not found")
+        rows = [r.to_dict() for r in rt.audit.for_workload(key)]
+    status = "PENDING"
+    if wl.is_finished:
+        status = "FINISHED"
+    elif wl.is_admitted:
+        status = "ADMITTED"
+    elif wl.has_quota_reservation:
+        status = "QUOTARESERVED"
+    elif not wl.active:
+        status = "INACTIVE"
+    _render_decision_timeline(key, status, rows)
 
 
 # ---- events (the `kubectl get events` / `--watch` analog) ----
@@ -731,6 +821,18 @@ def build_parser() -> argparse.ArgumentParser:
     pw.add_argument("clusterqueue")
     _add_server_flags(pw, "query a running kueue_tpu.server instead of --state")
     pw.set_defaults(fn=cmd_pending_workloads)
+
+    exp = sub.add_parser(
+        "explain",
+        help="render a workload's admission-decision history "
+        "(why pending / how admitted)",
+    )
+    exp.add_argument("name")
+    exp.add_argument("-n", "--namespace", default="default")
+    _add_server_flags(
+        exp, "read the decision trail from a running kueue_tpu.server"
+    )
+    exp.set_defaults(fn=cmd_explain)
 
     sch = sub.add_parser("schedule")
     sch.add_argument("--cycles", type=int, default=1)

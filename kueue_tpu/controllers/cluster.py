@@ -72,6 +72,14 @@ class ClusterRuntime:
         # the server's watch/SSE surface resumes from
         self.events = EventRecorder(clock=self.clock)
         self.metrics = Metrics()
+        # per-workload decision audit trail (core/audit.py): every
+        # admission decision — host cycle, device cycle, bulk drain —
+        # lands here; served at /debug/workloads/<ns>/<name>/decisions
+        # and rendered by `kueuectl explain`
+        from kueue_tpu.core.audit import DecisionAuditLog
+
+        self.audit = DecisionAuditLog(clock=self.clock)
+        self.audit.observers.append(self._record_decision_metric)
         self.pods_ready_cfg = wait_for_pods_ready or WaitForPodsReadyConfig()
         # resource adjustment pipeline stores (pkg/workload/resources.go)
         self.limit_ranges: Dict[str, "object"] = {}  # key -> LimitRange
@@ -120,6 +128,7 @@ class ClusterRuntime:
             preempt_solver_threshold=preempt_solver_threshold,
             transform_config=self.transform_config,
             limit_range_validate=self._validate_workload_resources,
+            audit=self.audit,
         )
         self.job_reconciler = JobReconciler(
             self,
@@ -192,6 +201,16 @@ class ClusterRuntime:
             self.metrics.report_evicted(
                 cq, ev.reason if ev else "", lq=wl.queue_name,
                 namespace=wl.namespace,
+            )
+
+    def _record_decision_metric(self, rec) -> None:
+        """Audit-log observer: mirror each inadmissible decision into
+        kueue_inadmissible_reason_total (the canonical enum keeps the
+        label space bounded). Admitted/Preempting decisions are
+        progress, not inadmissibility — they stay out of the series."""
+        if rec.outcome in ("Pending", "Skipped"):
+            self.metrics.report_inadmissible_reason(
+                rec.cluster_queue, rec.reason.value
             )
 
     def _record_preemption(self, preempting_cq: str, reason: str, victim: Workload) -> None:
@@ -487,6 +506,7 @@ class ClusterRuntime:
     def delete_workload(self, wl: Workload) -> None:
         self.workloads.pop(wl.key, None)
         self.indexer.delete(wl.key)
+        self.audit.forget(wl.key)  # history follows the object lifecycle
         self.queues.delete_workload(wl)
         if self.topology_ungater is not None:
             # drop any outstanding ungate expectations: a recreated
@@ -815,10 +835,12 @@ class ClusterRuntime:
             # stop with the whole backlog still pending
             return None
         t1 = _time.perf_counter()
+        # the drain IS this iteration's cycle: number it before the
+        # apply so its decision records carry the right cycle id
+        sched.scheduling_cycle += 1
         result = self._apply_drain_outcome(outcome, snapshot)
         t_apply = _time.perf_counter() - t1
         dt = _time.perf_counter() - t0
-        sched.scheduling_cycle += 1
         trace = CycleTrace(
             cycle=sched.scheduling_cycle,
             heads=len(pending),
@@ -848,13 +870,16 @@ class ClusterRuntime:
         the admissions that depend on them, the same interleaving the
         sequential cycle loop would produce (compressed to one pass).
         Fallback heads stay in the heap for the cycle loop."""
+        from kueue_tpu.core.audit import DecisionRecord
         from kueue_tpu.core.scheduler import (
             CycleResult,
             Entry,
             EntryStatus,
         )
+        from kueue_tpu.models.constants import InadmissibleReason
         from kueue_tpu.models.constants import WorkloadConditionType as WCT
 
+        cycle = self.scheduler.scheduling_cycle
         result = CycleResult(resolution="drain")
         events: List[tuple] = []
         for ev in getattr(outcome, "evictions", []) or []:
@@ -893,17 +918,73 @@ class ClusterRuntime:
                         status=EntryStatus.ASSUMED,
                     )
                 )
+                self.audit.record(
+                    DecisionRecord(
+                        workload=wl.key,
+                        cluster_queue=cq_name,
+                        cycle=cycle,
+                        outcome="Admitted",
+                        reason=InadmissibleReason.ADMITTED,
+                        resolution="drain",
+                        nominated_via="device",
+                        cohort=self._cohort_of(cq_name),
+                        flavors={
+                            name: dict(fm) for name, fm in psmap.items()
+                        },
+                    )
+                )
             # failure leaves the head in the heap; the cycle loop
             # retries it (same as FAILED_AFTER_NOMINATION)
         now = self.clock.now()
-        for wl, _cq_name in outcome.parked:
+        for wl, cq_name in outcome.parked:
             wl.set_condition(
-                WCT.QUOTA_RESERVED, False, reason="Pending",
+                WCT.QUOTA_RESERVED, False,
+                reason=InadmissibleReason.INSUFFICIENT_QUOTA.value,
                 message="Workload didn't fit", now=now,
             )
             self.event("Pending", wl, "Workload didn't fit")
             self.queues.park_workload(wl)
+            self.audit.record(
+                DecisionRecord(
+                    workload=wl.key,
+                    cluster_queue=cq_name,
+                    cycle=cycle,
+                    outcome="Pending",
+                    reason=InadmissibleReason.INSUFFICIENT_QUOTA,
+                    message="Workload didn't fit",
+                    resolution="drain",
+                    nominated_via="device",
+                    cohort=self._cohort_of(cq_name),
+                )
+            )
+        for e in result.preempting:
+            self.audit.record(
+                DecisionRecord(
+                    workload=e.workload.key,
+                    cluster_queue=e.cq_name,
+                    cycle=cycle,
+                    outcome="Preempting",
+                    reason=InadmissibleReason.PREEMPTING,
+                    resolution="drain",
+                    nominated_via="device",
+                    cohort=self._cohort_of(e.cq_name),
+                    preemption={
+                        "victims": [
+                            {
+                                "workload": t.workload.workload.key,
+                                "reason": t.reason,
+                            }
+                            for t in e.preemption_targets
+                        ],
+                        "search": "device",
+                    },
+                )
+            )
         return result
+
+    def _cohort_of(self, cq_name: str) -> str:
+        cached = self.cache.cluster_queues.get(cq_name)
+        return (cached.model.cohort or "") if cached is not None else ""
 
     def _drain_admission(self, wl, cq_name: str, psmap, tas_assignment=None):
         """Admission from a drain flavor map through the SAME quota view

@@ -1,0 +1,199 @@
+"""Decision audit trail — why every admission decision went the way it did.
+
+The scheduler computes rich per-workload rationale every cycle —
+flavor-by-flavor rejection reasons, preemption victim choices, TAS
+placements, which resolution path (host loop, batched device scan,
+bulk drain) decided — and before this module all of it died with the
+CycleResult. The audit log keeps it: one ``DecisionRecord`` per
+nominated entry per cycle, stored in a bounded per-workload ring so a
+stuck job's full decision history is inspectable after the fact
+(``GET /debug/workloads/<ns>/<name>/decisions``, ``kueuectl explain``,
+the dashboard's "why pending" panel, the SIGUSR2 dump).
+
+Design constraints:
+
+- reasons are members of the canonical ``InadmissibleReason`` enum
+  (models/constants.py) — ``record()`` rejects ad-hoc strings so the
+  ``kueue_inadmissible_reason_total`` label space stays bounded;
+- consecutive identical decisions count-dedup (the EventSeries analog):
+  a workload parked for a thousand cycles holds ONE record with
+  ``count=1000`` and a moving ``last_cycle``, so hot requeue loops
+  cannot flush real history out of the ring;
+- host and device paths attribute identically: the record carries both
+  the cycle's resolution path and which engine nominated the entry, so
+  solver-vs-host discrepancies are diffable from the trail alone.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional
+
+from kueue_tpu.models.constants import InadmissibleReason
+
+
+@dataclass
+class DecisionRecord:
+    """One admission decision for one workload in one cycle."""
+
+    workload: str  # "namespace/name" key
+    cluster_queue: str
+    cycle: int  # scheduling cycle that first produced this decision
+    outcome: str  # Admitted | Preempting | Skipped | Pending
+    reason: InadmissibleReason
+    message: str = ""
+    # which path resolved the cycle (host | device | drain) and which
+    # engine nominated this entry (host FlavorAssigner | device kernel)
+    resolution: str = "host"
+    nominated_via: str = "host"
+    # borrowing/cohort state at evaluation time
+    borrowing: bool = False
+    cohort: str = ""
+    # podset name -> {resource: flavor} for the chosen assignment
+    flavors: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    # podset name -> normalized flavor-by-flavor rejection reasons
+    flavor_reasons: Dict[str, List[str]] = field(default_factory=dict)
+    # {"victims": [{"workload", "reason"}...], "search": "host|device"}
+    # or {"blocked": <why no victims>} for a preempt-mode dead end
+    preemption: Optional[dict] = None
+    # TAS placement outcome: {"podset": {"levels": [...], "domains":
+    # [{"values": [...], "count": n}, ...]}}
+    topology: Optional[dict] = None
+    # dedup bookkeeping
+    count: int = 1
+    last_cycle: int = 0
+    timestamp: float = 0.0
+
+    def __post_init__(self):
+        if self.last_cycle < self.cycle:
+            self.last_cycle = self.cycle
+
+    def dedup_key(self) -> tuple:
+        return (
+            self.workload,
+            self.cluster_queue,
+            self.outcome,
+            self.reason.value,
+            self.message,
+            self.nominated_via,
+            self.resolution,
+        )
+
+    def to_dict(self) -> dict:
+        out = {
+            "workload": self.workload,
+            "clusterQueue": self.cluster_queue,
+            "cycle": self.cycle,
+            "lastCycle": self.last_cycle,
+            "count": self.count,
+            "outcome": self.outcome,
+            "reason": self.reason.value,
+            "message": self.message,
+            "resolution": self.resolution,
+            "nominatedVia": self.nominated_via,
+            "borrowing": self.borrowing,
+            "cohort": self.cohort,
+            "timestamp": self.timestamp,
+        }
+        if self.flavors:
+            out["flavors"] = self.flavors
+        if self.flavor_reasons:
+            out["flavorReasons"] = self.flavor_reasons
+        if self.preemption is not None:
+            out["preemption"] = self.preemption
+        if self.topology is not None:
+            out["topology"] = self.topology
+        return out
+
+
+class DecisionAuditLog:
+    """Bounded per-workload decision history.
+
+    ``per_workload`` bounds each workload's ring; ``max_workloads``
+    bounds the tracked-key set with LRU eviction so a churn-heavy
+    cluster (create/delete thousands of short jobs) cannot grow the log
+    without bound. Thread-safe: the scheduler writes under the server
+    lock but debug/visibility readers may race it.
+    """
+
+    def __init__(
+        self,
+        per_workload: int = 32,
+        max_workloads: int = 4096,
+        clock=None,
+    ):
+        self.per_workload = per_workload
+        self.max_workloads = max_workloads
+        self._clock = clock
+        self._records: "OrderedDict[str, Deque[DecisionRecord]]" = OrderedDict()
+        self._lock = threading.Lock()
+        # called with each incoming record (before dedup-merge), the
+        # runtime's metric mirror hangs here
+        self.observers: List[Callable[[DecisionRecord], None]] = []
+
+    def _now(self) -> float:
+        if self._clock is not None:
+            return self._clock.now()
+        import time
+
+        return time.time()
+
+    def record(self, rec: DecisionRecord) -> DecisionRecord:
+        if not isinstance(rec.reason, InadmissibleReason):
+            raise ValueError(
+                f"decision reason {rec.reason!r} is not a canonical "
+                "InadmissibleReason — ad-hoc reason strings are not allowed"
+            )
+        with self._lock:
+            rec.timestamp = self._now()
+            ring = self._records.get(rec.workload)
+            if ring is None:
+                ring = deque(maxlen=self.per_workload)
+                self._records[rec.workload] = ring
+            self._records.move_to_end(rec.workload)
+            while len(self._records) > self.max_workloads:
+                self._records.popitem(last=False)
+            if ring and ring[-1].dedup_key() == rec.dedup_key():
+                latest = ring[-1]
+                latest.count += 1
+                latest.last_cycle = max(latest.last_cycle, rec.last_cycle)
+                latest.timestamp = rec.timestamp
+                stored = latest
+            else:
+                ring.append(rec)
+                stored = rec
+        for cb in list(self.observers):
+            cb(rec)
+        return stored
+
+    # ---- reads ----
+    def for_workload(self, key: str) -> List[DecisionRecord]:
+        with self._lock:
+            return list(self._records.get(key, ()))
+
+    def latest(self, key: str) -> Optional[DecisionRecord]:
+        with self._lock:
+            ring = self._records.get(key)
+            return ring[-1] if ring else None
+
+    def tail(self, n: int = 20) -> List[DecisionRecord]:
+        """The n most recent records across all workloads, oldest
+        first (last_cycle order) — the SIGUSR2 dump's view."""
+        with self._lock:
+            everything = [r for ring in self._records.values() for r in ring]
+        everything.sort(key=lambda r: (r.last_cycle, r.workload))
+        return everything[-n:]
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._records.pop(key, None)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._records)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(ring) for ring in self._records.values())

@@ -53,6 +53,16 @@ class Mode(IntEnum):
     FIT = 2
 
 
+def normalize_reasons(reasons: Sequence[str]) -> List[str]:
+    """Canonical presentation order for rejection reasons: sorted and
+    de-duplicated. The flavor walk appends reasons in iteration order
+    (which differs between the cursor-resume and fresh-start paths and
+    between host and device nomination), so decision records, condition
+    messages and events all normalize through here to stay byte-stable
+    across runs and resolution paths."""
+    return sorted(set(reasons))
+
+
 class GranularMode(IntEnum):
     """Internal modes distinguishing cohort reclamation from preemption."""
 
@@ -138,7 +148,7 @@ class AssignmentResult:
             if ps.reasons:
                 parts.append(
                     f"couldn't assign flavors to pod set {ps.name}: "
-                    + ", ".join(sorted(ps.reasons))
+                    + ", ".join(normalize_reasons(ps.reasons))
                 )
         return "; ".join(parts)
 
@@ -260,6 +270,10 @@ class FlavorAssigner:
                 flavor_idx[res] = choice.tried_flavor_idx
             new_state.last_tried_flavor_idx.append(flavor_idx)
 
+            # store normalized (sorted, de-duplicated) reasons so every
+            # consumer — message(), decision records, events — sees the
+            # same stable ordering regardless of flavor-walk order
+            psr.reasons = normalize_reasons(psr.reasons)
             result.pod_sets.append(psr)
             if failed or (requests and not psr.flavors):
                 result.last_state = new_state

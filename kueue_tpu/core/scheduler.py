@@ -25,13 +25,18 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from kueue_tpu.models import Workload
-from kueue_tpu.models.constants import WorkloadConditionType
-from kueue_tpu.core.cache import Cache
+from kueue_tpu.models.constants import (
+    InadmissibleReason,
+    WorkloadConditionType,
+    classify_inadmissible_message,
+)
+from kueue_tpu.core.audit import DecisionAuditLog, DecisionRecord
 from kueue_tpu.core.flavor_assigner import (
     AssignmentResult,
     FlavorAssigner,
     Mode,
     find_max_counts,
+    normalize_reasons,
 )
 from kueue_tpu.core.queue_manager import QueueManager, RequeueReason, queue_order_timestamp
 from kueue_tpu.core.snapshot import Snapshot, WorkloadSnapshot, take_snapshot
@@ -61,6 +66,10 @@ class Entry:
     inadmissible_msg: str = ""
     requeue_reason: RequeueReason = RequeueReason.GENERIC
     preemption_targets: List[PreemptionTarget] = field(default_factory=list)
+    # decision-attribution breadcrumbs for the audit trail: which engine
+    # nominated this entry and which ran its victim search
+    nominated_via: str = "host"
+    victim_search: str = ""
 
 
 class Preemptor:
@@ -194,6 +203,7 @@ class Scheduler:
         use_preempt_solver: Optional[bool] = None,
         preempt_solver_threshold: int = 4,
         transform_config=None,  # ResourceTransformConfig (quota view)
+        audit: Optional[DecisionAuditLog] = None,
     ):
         self.queues = queues
         self.cache = cache
@@ -226,6 +236,9 @@ class Scheduler:
         self.use_preempt_solver = use_preempt_solver
         self.preempt_solver_threshold = preempt_solver_threshold
         self.transform_config = transform_config
+        # per-workload decision audit trail; both resolution paths (and
+        # the runtime's bulk drain) record through the same log
+        self.audit = audit if audit is not None else DecisionAuditLog(clock=clock)
         self.scheduling_cycle = 0
         # per-cycle phase traces, newest last (ring buffer)
         self.last_traces = deque(maxlen=128)
@@ -280,6 +293,7 @@ class Scheduler:
             out = self._finalize_device(entries, device_plan, snapshot, result)
             trace.spans["admit"] = _time.perf_counter() - t2
             self._finish_trace(trace, out, t0)
+            self._audit_cycle(entries, out)
             self.notify_cycle(out)
             return out
         t2 = _time.perf_counter()  # 'admit' includes the entry ordering
@@ -417,6 +431,7 @@ class Scheduler:
                 result.requeued.append(e)
         trace.spans["admit"] = _time.perf_counter() - t2
         self._finish_trace(trace, result, t0)
+        self._audit_cycle(entries, result)
         self.notify_cycle(result)
         return result
 
@@ -432,6 +447,97 @@ class Scheduler:
         trace.device_s = self._cycle_device_s
         trace.host_s = max(trace.total_s - self._cycle_device_s, 0.0)
         self.last_traces.append(trace)
+
+    # ---- decision audit (core/audit.py) ----
+    def _audit_cycle(self, entries: List[Entry], result: CycleResult) -> None:
+        if self.audit is None:
+            return
+        preempting = {id(e) for e in result.preempting}
+        for e in entries:
+            self.audit.record(
+                self._decision_of(e, result.resolution, id(e) in preempting)
+            )
+
+    def _decision_of(
+        self, e: Entry, resolution: str, is_preempting: bool
+    ) -> DecisionRecord:
+        """Lower one entry's cycle outcome into a DecisionRecord. Both
+        resolution paths funnel through here, so an identical scenario
+        attributes identically whether the device scan or the host loop
+        decided it."""
+        a = e.assignment
+        flavors: Dict[str, Dict[str, str]] = {}
+        flavor_reasons: Dict[str, List[str]] = {}
+        topology: Optional[dict] = None
+        borrowing = False
+        if a is not None:
+            borrowing = a.borrowing
+            for ps in a.pod_sets:
+                if ps.flavors:
+                    flavors[ps.name] = {
+                        res: c.name for res, c in sorted(ps.flavors.items())
+                    }
+                if ps.reasons:
+                    flavor_reasons[ps.name] = normalize_reasons(ps.reasons)
+                ta = ps.topology_assignment
+                if ta is not None:
+                    topology = topology or {}
+                    topology[ps.name] = {
+                        "levels": list(ta.levels),
+                        "domains": [
+                            {"values": list(d.values), "count": d.count}
+                            for d in ta.domains
+                        ],
+                    }
+        preemption: Optional[dict] = None
+        if e.preemption_targets:
+            preemption = {
+                "victims": [
+                    {
+                        "workload": t.workload.workload.key,
+                        "reason": t.reason,
+                    }
+                    for t in e.preemption_targets
+                ],
+                "search": e.victim_search or "host",
+            }
+        elif a is not None and a.representative_mode() == Mode.PREEMPT:
+            preemption = {"blocked": "no preemption candidates found"}
+
+        if e.status == EntryStatus.ASSUMED:
+            outcome, reason = "Admitted", InadmissibleReason.ADMITTED
+        elif is_preempting:
+            outcome = "Preempting"
+            reason = (
+                InadmissibleReason.PENDING_PREEMPTION
+                if e.requeue_reason == RequeueReason.PENDING_PREEMPTION
+                else InadmissibleReason.PREEMPTING
+            )
+        elif e.status == EntryStatus.SKIPPED:
+            outcome = "Skipped"
+            reason = classify_inadmissible_message(e.inadmissible_msg)
+        else:
+            outcome = "Pending"
+            reason = classify_inadmissible_message(e.inadmissible_msg)
+
+        cached = self.cache.cluster_queues.get(e.cq_name)
+        cohort = cached.model.cohort or "" if cached is not None else ""
+        return DecisionRecord(
+            workload=e.workload.key,
+            cluster_queue=e.cq_name,
+            cycle=self.scheduling_cycle,
+            outcome=outcome,
+            reason=reason,
+            message=e.inadmissible_msg,
+            resolution=resolution,
+            nominated_via=e.nominated_via,
+            borrowing=borrowing,
+            cohort=cohort,
+            flavors=flavors,
+            flavor_reasons=flavor_reasons,
+            preemption=preemption,
+            topology=topology,
+        )
 
     # ---- nomination (scheduler.go:344-378) ----
     def _nominate(
@@ -569,6 +675,7 @@ class Scheduler:
                 else 0.8 * self._host_victim_ema + 0.2 * per_head
             )
         for e, targets in zip(deferred, all_targets):
+            e.victim_search = "device" if batch_on else "host"
             if targets:
                 e.preemption_targets = targets
             else:
@@ -681,6 +788,7 @@ class Scheduler:
         for i, e in enumerate(to_assign):
             if i in host_set:
                 continue
+            e.nominated_via = "device"
             e.assignment = self._assignment_from_device(
                 lowered, i, int(chosen[i]), snapshot
             )
@@ -1043,10 +1151,18 @@ class Scheduler:
             e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
         self.queues.requeue_workload(e.workload, e.requeue_reason)
         if e.status in (EntryStatus.NOT_NOMINATED, EntryStatus.SKIPPED):
+            # the structured reason rides on the condition: operators
+            # (and the visibility API) read WHY from the reason without
+            # parsing the free-form message
+            canonical = classify_inadmissible_message(e.inadmissible_msg)
             e.workload.set_condition(
                 WorkloadConditionType.QUOTA_RESERVED,
                 False,
-                reason="Pending",
+                reason=(
+                    canonical.value
+                    if canonical != InadmissibleReason.UNKNOWN
+                    else "Pending"
+                ),
                 message=e.inadmissible_msg,
                 now=self.clock.now(),
             )

@@ -47,6 +47,20 @@ def dump(runtime) -> str:
                 f"preempting={t.preempting} resolution={t.resolution} "
                 f"total={t.total_s * 1e3:.2f}ms {spans}"
             )
+    # decision audit tail: a hung server's "why pending" is triagable
+    # from the signal dump alone, no HTTP surface needed
+    audit = getattr(runtime, "audit", None)
+    recent = audit.tail(20) if audit is not None else []
+    if recent:
+        lines.append("-- recent decisions (audit trail) --")
+        for rec in recent:
+            seen = f" x{rec.count}" if rec.count > 1 else ""
+            msg = f" :: {rec.message}" if rec.message else ""
+            lines.append(
+                f"cycle {rec.last_cycle} [{rec.resolution}] {rec.workload} "
+                f"@ {rec.cluster_queue}: {rec.outcome}/{rec.reason.value}"
+                f"{seen}{msg}"
+            )
     return "\n".join(lines)
 
 

@@ -87,6 +87,15 @@ class Metrics:
             "Number of pending workloads, per cluster_queue and status (active|inadmissible)",
             ("cluster_queue", "status"),
         )
+        # "why pending" scrape surface: one series per (cq, canonical
+        # reason), fed by the decision audit trail (core/audit.py). The
+        # reason label is a member of InadmissibleReason — a closed
+        # enum — so cardinality stays bounded
+        self.inadmissible_reason_total = r.counter(
+            f"{NS}_inadmissible_reason_total",
+            "Total inadmissible admission decisions per cluster_queue and canonical reason",
+            ("cluster_queue", "reason"),
+        )
         self.quota_reserved_workloads_total = r.counter(
             f"{NS}_quota_reserved_workloads_total",
             "Total number of quota reserved workloads per cluster_queue",
@@ -209,6 +218,9 @@ class Metrics:
         )
         self.cycle_last_heads.set(trace.heads)
         self.cycle_last_admitted.set(trace.admitted)
+
+    def report_inadmissible_reason(self, cq: str, reason: str) -> None:
+        self.inadmissible_reason_total.inc(cluster_queue=cq, reason=reason)
 
     def report_pending_workloads(self, cq: str, active: int, inadmissible: int) -> None:
         self.pending_workloads.set(active, cluster_queue=cq, status="active")

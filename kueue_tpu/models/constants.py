@@ -86,6 +86,124 @@ EVICTED_BY_MAXIMUM_EXECUTION_TIME = "MaximumExecutionTimeExceeded"
 PROVISIONING_CONTROLLER_NAME = "kueue.x-k8s.io/provisioning-request"
 MULTIKUEUE_CONTROLLER_NAME = "kueue.x-k8s.io/multikueue"
 
+
+class InadmissibleReason(str, Enum):
+    """Canonical admission-decision reasons (the low-cardinality label
+    space of ``kueue_inadmissible_reason_total`` and the ``reason``
+    field of every DecisionRecord in core/audit.py).
+
+    Free-form inadmissibility messages stay on the record for humans;
+    alerting, metrics and the visibility API key on these values only,
+    so the set must stay closed — tests/test_audit.py lints that no
+    ad-hoc reason string reaches the audit trail or the event recorder.
+    """
+
+    # terminal / progressing outcomes
+    ADMITTED = "Admitted"
+    PREEMPTING = "Preempting"
+    PENDING_PREEMPTION = "PendingPreemption"
+    # prevalidation (scheduler.go:361-369)
+    DEACTIVATED = "WorkloadDeactivated"
+    FAILED_ADMISSION_CHECKS = "FailedAdmissionChecks"
+    CLUSTER_QUEUE_INACTIVE = "ClusterQueueInactive"
+    CLUSTER_QUEUE_NOT_FOUND = "ClusterQueueNotFound"
+    NAMESPACE_MISMATCH = "NamespaceMismatch"
+    INVALID_RESOURCES = "InvalidResources"
+    # flavor assignment (flavorassigner.go classification)
+    RESOURCE_UNAVAILABLE = "ResourceUnavailableInClusterQueue"
+    FLAVOR_NOT_FOUND = "FlavorNotFound"
+    UNTOLERATED_TAINT = "UntoleratedTaint"
+    NODE_AFFINITY_MISMATCH = "NodeAffinityMismatch"
+    NO_QUOTA_FOR_RESOURCE = "NoQuotaForResource"
+    REQUEST_EXCEEDS_CAPACITY = "RequestExceedsMaxCapacity"
+    INSUFFICIENT_QUOTA = "InsufficientQuota"
+    NO_FLAVOR_ATTEMPTED = "NoFlavorAttempted"
+    # topology-aware scheduling
+    TOPOLOGY_INCOMPATIBLE = "TopologyIncompatible"
+    TOPOLOGY_NO_FIT = "TopologyNoFit"
+    # in-cycle admit-loop outcomes (scheduler.go:211-292)
+    OVERLAPPING_PREEMPTION = "OverlappingPreemptionTargets"
+    LOST_QUOTA_RACE = "LostQuotaRace"
+    WAITING_FOR_PODS_READY = "WaitingForPodsReady"
+    ASSUME_FAILED = "AssumeFailed"
+    DURABLE_WRITE_FAILED = "DurableWriteFailed"
+    UNKNOWN = "Unknown"
+
+
+# Event reasons the runtime recorder accepts (``ClusterRuntime.event``
+# first argument). Closed set for the same low-cardinality contract as
+# InadmissibleReason: kueue_events_total{reason=...} must not explode.
+EVENT_REASONS = frozenset(
+    {
+        "QuotaReserved",
+        "Admitted",
+        "Pending",
+        "Evicted",
+        "Preempted",
+        "Deactivated",
+        "AdmissionChecksRejected",
+        "ProvisioningRequestCreated",
+        "MultiKueueClusterLost",
+        "MultiKueueRejected",
+        "MultiKueueReserved",
+    }
+)
+
+
+# Patterns mapping free-form inadmissibility messages to the canonical
+# reason, most-specific first: compound messages (several flavors
+# rejected for different causes, "; "-joined podsets) resolve to the
+# FIRST listed pattern they match, so quota-shaped causes (closest to
+# admission) dominate structural ones deterministically.
+_INADMISSIBLE_PATTERNS = (
+    (r"Pending the preemption", InadmissibleReason.PENDING_PREEMPTION),
+    (r"overlapping preemption targets", InadmissibleReason.OVERLAPPING_PREEMPTION),
+    (r"no longer fits after processing", InadmissibleReason.LOST_QUOTA_RACE),
+    (r"PodsReady condition", InadmissibleReason.WAITING_FOR_PODS_READY),
+    (r"insufficient unused quota", InadmissibleReason.INSUFFICIENT_QUOTA),
+    (r"request > maximum capacity", InadmissibleReason.REQUEST_EXCEEDS_CAPACITY),
+    (r"no quota defined for", InadmissibleReason.NO_QUOTA_FOR_RESOURCE),
+    (r"Workload didn't fit", InadmissibleReason.INSUFFICIENT_QUOTA),
+    (r"untolerated taint", InadmissibleReason.UNTOLERATED_TAINT),
+    (r"doesn't match node affinity", InadmissibleReason.NODE_AFFINITY_MISMATCH),
+    (r"unavailable in ClusterQueue", InadmissibleReason.RESOURCE_UNAVAILABLE),
+    (
+        r"TopologyAwareScheduling|information missing in TAS cache"
+        r"|does not contain the requested level",
+        InadmissibleReason.TOPOLOGY_INCOMPATIBLE,
+    ),
+    (r"topology|TAS pod set", InadmissibleReason.TOPOLOGY_NO_FIT),
+    (r"could be attempted", InadmissibleReason.NO_FLAVOR_ATTEMPTED),
+    (r"flavor \S+ not found", InadmissibleReason.FLAVOR_NOT_FOUND),
+    (r"ClusterQueue \S+ is inactive", InadmissibleReason.CLUSTER_QUEUE_INACTIVE),
+    (r"ClusterQueue \S+ not found", InadmissibleReason.CLUSTER_QUEUE_NOT_FOUND),
+    (r"namespace doesn't match", InadmissibleReason.NAMESPACE_MISMATCH),
+    (r"deactivated", InadmissibleReason.DEACTIVATED),
+    (r"failed admission checks", InadmissibleReason.FAILED_ADMISSION_CHECKS),
+    (
+        r"limitRange|must not exceed its limits",
+        InadmissibleReason.INVALID_RESOURCES,
+    ),
+    (r"Failed to assume", InadmissibleReason.ASSUME_FAILED),
+    (r"durable write failed", InadmissibleReason.DURABLE_WRITE_FAILED),
+)
+
+
+def classify_inadmissible_message(message: str) -> InadmissibleReason:
+    """Map a free-form inadmissibility message onto the canonical
+    reason enum. Deterministic: first matching pattern wins, so stable
+    given the normalized (sorted) reason ordering the FlavorAssigner
+    emits. Unmatched messages classify as UNKNOWN — the audit lint
+    treats that as a bug in the emitting site, not a valid label."""
+    import re as _re
+
+    if not message:
+        return InadmissibleReason.UNKNOWN
+    for pattern, reason in _INADMISSIBLE_PATTERNS:
+        if _re.search(pattern, message):
+            return reason
+    return InadmissibleReason.UNKNOWN
+
 # TAS podset annotation equivalents (apis/kueue/v1alpha1/topology_types.go:24-79).
 TOPOLOGY_MODE_REQUIRED = "Required"
 TOPOLOGY_MODE_PREFERRED = "Preferred"

@@ -9,7 +9,12 @@ Routes (all JSON unless noted):
   GET  /apis/visibility/v1beta1/clusterqueues/{cq}/pendingworkloads
   GET  /apis/visibility/v1beta1/namespaces/{ns}/localqueues/{lq}/pendingworkloads
                                                (pkg/visibility/server.go:62-118,
-                                               api/v1beta1/pending_workloads_cq.go:37-46)
+                                               api/v1beta1/pending_workloads_cq.go:37-46;
+                                               items carry the latest structured
+                                               inadmissibleReason from the audit trail)
+  GET  /debug/workloads/{ns}/{name}/decisions  per-workload decision audit
+                                               trail (core/audit.py) — the
+                                               `kueuectl explain` payload
   GET  /apis/kueue/v1beta1/{section}           list objects w/ status
   POST /apis/kueue/v1beta1/{section}           upsert one object (webhook
                                                defaulting+validation applied)
@@ -205,6 +210,11 @@ def solve_assign(request: dict) -> dict:
         }
         if wl.admission is not None:
             item["admission"] = ser.workload_to_dict(wl)["admission"]
+        else:
+            latest = rt.audit.latest(key)
+            if latest is not None:
+                item["inadmissibleReason"] = latest.reason.value
+                item["message"] = latest.message
         decisions.append(item)
     return {
         "cycles": cycles,
@@ -565,6 +575,7 @@ _SECURED_ROUTES = frozenset(
     {
         "apply", "apply_batch", "delete", "delete_ns", "check_state",
         "reconcile", "solve", "metrics", "state", "debug_cycles",
+        "workload_decisions",
     }
 )
 
@@ -620,6 +631,11 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/reconcile$"), "reconcile"),
     ("GET", re.compile(r"^/events/stream$"), "events_stream"),
     ("GET", re.compile(r"^/debug/cycles$"), "debug_cycles"),
+    (
+        "GET",
+        re.compile(r"^/debug/workloads/([^/]+)/([^/]+)/decisions$"),
+        "workload_decisions",
+    ),
     ("GET", re.compile(r"^/state$"), "state"),
     ("POST", re.compile(r"^/apis/solver/v1beta1/assign$"), "solve"),
     ("GET", re.compile(r"^/api/dashboard$"), "dashboard_json"),
@@ -740,7 +756,8 @@ def _make_handler(srv: KueueServer):
             limit = self._int_param(query, "limit", 1000)
             with srv.lock:
                 summary = visibility.pending_workloads_in_cq(
-                    srv.runtime.queues, cq, offset=offset, limit=limit
+                    srv.runtime.queues, cq, offset=offset, limit=limit,
+                    audit=getattr(srv.runtime, "audit", None),
                 )
             self._send_json(_summary_to_dict(summary))
 
@@ -749,7 +766,8 @@ def _make_handler(srv: KueueServer):
             limit = self._int_param(query, "limit", 1000)
             with srv.lock:
                 summary = visibility.pending_workloads_in_lq(
-                    srv.runtime.queues, ns, lq, offset=offset, limit=limit
+                    srv.runtime.queues, ns, lq, offset=offset, limit=limit,
+                    audit=getattr(srv.runtime, "audit", None),
                 )
             self._send_json(_summary_to_dict(summary))
 
@@ -908,6 +926,20 @@ def _make_handler(srv: KueueServer):
                 ]
             self._send_json({"cycles": traces})
 
+        def _h_workload_decisions(self, ns, name, query):
+            """Per-workload decision audit trail (oldest first). 404
+            only when the workload is unknown AND left no trail — a
+            just-deleted workload's history stays readable until the
+            audit ring forgets it."""
+            key = f"{ns}/{name}"
+            with srv.lock:
+                audit = getattr(srv.runtime, "audit", None)
+                items = visibility.workload_decisions(audit, key)
+                known = key in srv.runtime.workloads
+            if not items and not known:
+                raise ApiError(404, f"workload {key} not found")
+            self._send_json({"workload": key, "items": items})
+
         def _h_state(self, query):
             with srv.lock:  # snapshot under lock; write to client outside
                 state = ser.runtime_to_state(srv.runtime)
@@ -943,6 +975,9 @@ def _summary_to_dict(summary: visibility.PendingWorkloadsSummary) -> dict:
                 "priority": pw.priority,
                 "positionInClusterQueue": pw.position_in_cluster_queue,
                 "positionInLocalQueue": pw.position_in_local_queue,
+                "inadmissibleReason": pw.inadmissible_reason,
+                "message": pw.message,
+                "lastCycle": pw.last_cycle,
             }
             for pw in summary.items
         ]

@@ -142,6 +142,13 @@ class KueueClient:
             f"/pendingworkloads?offset={offset}&limit={limit}",
         )
 
+    def workload_decisions(self, namespace: str, name: str) -> dict:
+        """Per-workload decision audit trail (the `kueuectl explain`
+        payload): {"workload": key, "items": [DecisionRecord dicts]}."""
+        return self._request(
+            "GET", f"/debug/workloads/{namespace}/{name}/decisions"
+        )
+
     # ---- events / watch ----
     def events(self, resource_version: int = 0) -> dict:
         """Recorded events newer than ``resource_version`` plus the

@@ -104,16 +104,39 @@ def dashboard_payload(rt) -> dict:
         )
     ]
 
-    workloads = [
-        {
-            "key": key,
-            "queue": wl.queue_name,
-            "priority": wl.priority,
-            "state": _workload_state(wl),
-            "clusterQueue": wl.admission.cluster_queue if wl.admission else "",
-        }
-        for key, wl in sorted(rt.workloads.items())
-    ]
+    audit = getattr(rt, "audit", None)
+    workloads: List[dict] = []
+    why_pending: List[dict] = []
+    reason_tally: Dict[str, int] = {}
+    for key, wl in sorted(rt.workloads.items()):
+        state = _workload_state(wl)
+        workloads.append(
+            {
+                "key": key,
+                "queue": wl.queue_name,
+                "priority": wl.priority,
+                "state": state,
+                "clusterQueue": wl.admission.cluster_queue if wl.admission else "",
+            }
+        )
+        # the "why pending" panel: latest structured reason per
+        # not-yet-reserved workload, straight from the audit trail
+        if state in ("Pending", "Evicted") and audit is not None:
+            latest = audit.latest(key)
+            if latest is not None:
+                why_pending.append(
+                    {
+                        "workload": key,
+                        "clusterQueue": latest.cluster_queue,
+                        "reason": latest.reason.value,
+                        "message": latest.message,
+                        "count": latest.count,
+                        "lastCycle": latest.last_cycle,
+                    }
+                )
+                reason_tally[latest.reason.value] = (
+                    reason_tally.get(latest.reason.value, 0) + 1
+                )
 
     state_counts: Dict[str, int] = {}
     for w in workloads:
@@ -127,6 +150,8 @@ def dashboard_payload(rt) -> dict:
         "workloadStates": state_counts,
         "resourceFlavors": sorted(cache.flavors),
         "cohorts": sorted(cache.cohorts),
+        "whyPending": why_pending,
+        "pendingReasons": reason_tally,
         # the watch head: a client that refetches can resume its event
         # stream from here without a gap
         "resourceVersion": rt.events.resource_version,
@@ -191,6 +216,7 @@ DASHBOARD_HTML = """<!doctype html>
 <div class="tiles" id="tiles"></div>
 <h2>Last cycle</h2><div id="cycle"></div>
 <h2>ClusterQueues</h2><div id="cqs"></div>
+<h2>Why pending</h2><div id="why"></div>
 <h2>Workloads</h2><div id="wls"></div>
 <h2>LocalQueues</h2><div id="lqs"></div>
 <h2>Event stream</h2><div id="events"></div>
@@ -240,6 +266,19 @@ function render(d){
       cq.quota.map(q=>`${esc(q.flavor)}/${esc(q.resource)} ${bar(q.used,q.nominal)} `+
         `<code>${q.used}/${q.nominal}</code>`).join('<br>')+
       `</td></tr>`).join('')+'</table>';
+  const why = d.whyPending||[];
+  const tally = Object.entries(d.pendingReasons||{}).sort((a,b)=>b[1]-a[1])
+    .map(([r,n])=>`<span class="tile" style="padding:4px 10px;min-width:0">`+
+      `<b style="font-size:14px;display:inline">${n}</b> <span class="muted">${esc(r)}</span></span>`).join(' ');
+  document.getElementById('why').innerHTML = !why.length
+    ? '<span class="muted">nothing pending with a recorded decision</span>'
+    : `<div class="tiles">${tally}</div>`+
+      '<table><tr><th>workload</th><th>clusterQueue</th><th>reason</th>'+
+      '<th>seen</th><th>last cycle</th><th>message</th></tr>'+
+      why.slice(0,200).map(p=>`<tr><td>${esc(p.workload)}</td>`+
+        `<td>${esc(p.clusterQueue)}</td><td class="ev-Evicted">${esc(p.reason)}</td>`+
+        `<td>&times;${p.count}</td><td>${p.lastCycle}</td>`+
+        `<td>${esc(p.message)}</td></tr>`).join('')+'</table>';
   document.getElementById('wls').innerHTML = '<table><tr><th>workload</th><th>queue</th>'+
     '<th>priority</th><th>state</th><th>clusterQueue</th></tr>'+
     d.workloads.slice(0,500).map(w=>`<tr><td>${esc(w.key)}</td><td>${esc(w.queue)}</td>`+

@@ -1,0 +1,461 @@
+"""Decision audit trail tests (core/audit.py + surfaces).
+
+Covers the ISSUE 2 acceptance scenario — a workload rejected by quota,
+then by taints, then admitted via preemption across successive cycles,
+with identical canonical reasons on the host and device (solver)
+resolution paths — plus the audit log's dedup/bounds, the reason-enum
+lint (no ad-hoc reason strings in events or decision records), the
+server decisions endpoint, `kueuectl explain` rendering, the
+inadmissible-reason metric, the dashboard "why pending" feed, and the
+SIGUSR2 dump.
+"""
+
+import contextlib
+import io
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.core.audit import DecisionAuditLog, DecisionRecord
+from kueue_tpu.models import (
+    ClusterQueue,
+    LocalQueue,
+    PreemptionPolicy,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import (
+    FlavorQuotas,
+    Preemption,
+    ResourceGroup,
+)
+from kueue_tpu.models.constants import (
+    EVENT_REASONS,
+    InadmissibleReason,
+    classify_inadmissible_message,
+)
+from kueue_tpu.models.resource_flavor import Taint
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.utils.clock import FakeClock
+
+
+def _cq(preemption_policy=PreemptionPolicy.NEVER):
+    return ClusterQueue(
+        name="cq",
+        namespace_selector={},
+        preemption=Preemption(within_cluster_queue=preemption_policy),
+        resource_groups=(
+            ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": "2"}),)),
+        ),
+    )
+
+
+def _wl(name, cpu="2", priority=0, created=0.0):
+    return Workload(
+        namespace="ns", name=name, queue_name="lq", priority=priority,
+        creation_time=created,
+        pod_sets=(PodSet.build("main", 1, {"cpu": cpu}),),
+    )
+
+
+def run_acceptance_scenario(use_solver):
+    """Quota rejection -> taint rejection -> admission via preemption,
+    driven by object updates between reconcile passes."""
+    rt = ClusterRuntime(clock=FakeClock(1000.0), use_solver=use_solver)
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(_cq())
+    rt.add_local_queue(LocalQueue(namespace="ns", name="lq", cluster_queue="cq"))
+
+    # phase 0: a low-priority victim takes the whole quota
+    rt.add_workload(_wl("victim", priority=0, created=0.0))
+    rt.run_until_idle()
+    assert rt.workloads["ns/victim"].is_admitted
+
+    # phase 1: the subject can't fit and nobody is preemptible
+    rt.add_workload(_wl("subject", priority=10, created=1.0))
+    rt.run_until_idle()
+
+    # phase 2: the flavor grows a taint the subject doesn't tolerate
+    # (the update reactivates the parked head)
+    rt.add_flavor(
+        ResourceFlavor(
+            name="default",
+            node_taints=(Taint(key="maintenance", value="true"),),
+        )
+    )
+    rt.run_until_idle()
+
+    # phase 3: taint lifted AND the CQ allows in-queue preemption
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(_cq(PreemptionPolicy.LOWER_PRIORITY))
+    rt.run_until_idle()
+    assert rt.workloads["ns/subject"].is_admitted
+    return rt
+
+
+class TestAcceptanceScenario:
+    """ISSUE 2 acceptance criterion."""
+
+    @pytest.mark.parametrize("use_solver", [False, True])
+    def test_three_phase_history_with_cycle_ids(self, use_solver):
+        rt = run_acceptance_scenario(use_solver)
+        recs = rt.audit.for_workload("ns/subject")
+        seq = [(r.outcome, r.reason) for r in recs]
+        assert seq == [
+            ("Pending", InadmissibleReason.INSUFFICIENT_QUOTA),
+            ("Pending", InadmissibleReason.UNTOLERATED_TAINT),
+            ("Preempting", InadmissibleReason.PENDING_PREEMPTION),
+            ("Admitted", InadmissibleReason.ADMITTED),
+        ]
+        cycles = [r.cycle for r in recs]
+        assert cycles == sorted(cycles) and len(set(cycles)) == len(cycles)
+        # the preemption record names the victim and its reason
+        pre = recs[2].preemption
+        assert pre["victims"] == [
+            {"workload": "ns/victim", "reason": "InClusterQueue"}
+        ]
+        # flavor-by-flavor rejection details survive
+        assert any(
+            "untolerated taint" in r
+            for r in recs[1].flavor_reasons.get("main", [])
+        )
+        assert recs[0].message and "insufficient unused quota" in recs[0].message
+
+    def test_host_and_device_paths_attribute_identically(self):
+        host = run_acceptance_scenario(use_solver=False)
+        device = run_acceptance_scenario(use_solver=True)
+        h = [(r.outcome, r.reason, r.message)
+             for r in host.audit.for_workload("ns/subject")]
+        d = [(r.outcome, r.reason, r.message)
+             for r in device.audit.for_workload("ns/subject")]
+        assert h == d
+
+    def test_decisions_endpoint(self):
+        from kueue_tpu.server import KueueClient, KueueServer
+        from kueue_tpu.server.client import ClientError
+
+        rt = run_acceptance_scenario(use_solver=False)
+        srv = KueueServer(runtime=rt)
+        port = srv.start()
+        try:
+            client = KueueClient(f"http://127.0.0.1:{port}")
+            out = client.workload_decisions("ns", "subject")
+            assert out["workload"] == "ns/subject"
+            reasons = [i["reason"] for i in out["items"]]
+            assert reasons == [
+                "InsufficientQuota", "UntoleratedTaint",
+                "PendingPreemption", "Admitted",
+            ]
+            assert all("cycle" in i for i in out["items"])
+            with pytest.raises(ClientError) as ei:
+                client.workload_decisions("ns", "ghost")
+            assert ei.value.status == 404
+        finally:
+            srv.stop()
+
+    def test_explain_server_mode_renders_timeline(self, tmp_path):
+        from kueue_tpu.cli.__main__ import main
+        from kueue_tpu.server import KueueServer
+
+        rt = run_acceptance_scenario(use_solver=False)
+        srv = KueueServer(runtime=rt)
+        port = srv.start()
+        try:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = main([
+                    "--state", str(tmp_path / "state.json"),
+                    "explain", "subject", "-n", "ns",
+                    "--server", f"http://127.0.0.1:{port}",
+                ])
+            text = buf.getvalue()
+            assert rc == 0
+            assert "Workload:      ns/subject" in text
+            assert "Status:        ADMITTED" in text
+            for needle in (
+                "InsufficientQuota", "UntoleratedTaint",
+                "PendingPreemption", "Admitted",
+                "victim: ns/victim (InClusterQueue)",
+                "untolerated taint",
+            ):
+                assert needle in text, f"explain output missing {needle!r}"
+        finally:
+            srv.stop()
+
+    def test_explain_state_mode_reproduces_decisions(self, tmp_path):
+        from kueue_tpu import serialization as ser
+        from kueue_tpu.cli.__main__ import main
+
+        state = {
+            "resourceFlavors": [{"name": "default"}],
+            "clusterQueues": [
+                {
+                    "name": "cq", "namespaceSelector": {},
+                    "resourceGroups": [{
+                        "coveredResources": ["cpu"],
+                        "flavors": [{
+                            "name": "default",
+                            "resources": [{"name": "cpu", "nominalQuota": "1"}],
+                        }],
+                    }],
+                }
+            ],
+            "localQueues": [
+                {"name": "lq", "namespace": "ns", "clusterQueue": "cq"}
+            ],
+            "workloads": [
+                ser.workload_to_dict(_wl("starved", cpu="2", created=0.0))
+            ],
+        }
+        path = tmp_path / "state.json"
+        path.write_text(json.dumps(state))
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = main(["--state", str(path), "explain", "starved", "-n", "ns"])
+        text = buf.getvalue()
+        assert rc == 0
+        assert "Status:        PENDING" in text
+        assert "RequestExceedsMaxCapacity" in text
+        # offline explain is a read-only what-if: the state file is intact
+        assert json.loads(path.read_text()) == state
+
+
+class TestDecisionAuditLog:
+    def _rec(self, cycle=1, reason=InadmissibleReason.INSUFFICIENT_QUOTA,
+             message="no quota", workload="ns/w"):
+        return DecisionRecord(
+            workload=workload, cluster_queue="cq", cycle=cycle,
+            outcome="Pending", reason=reason, message=message,
+        )
+
+    def test_consecutive_identical_decisions_dedup(self):
+        log = DecisionAuditLog(clock=FakeClock(5.0))
+        log.record(self._rec(cycle=1))
+        stored = log.record(self._rec(cycle=7))
+        recs = log.for_workload("ns/w")
+        assert len(recs) == 1
+        assert stored.count == 2
+        assert (stored.cycle, stored.last_cycle) == (1, 7)
+        # a different reason breaks the series
+        log.record(self._rec(cycle=9, reason=InadmissibleReason.UNTOLERATED_TAINT,
+                             message="taint"))
+        assert len(log.for_workload("ns/w")) == 2
+
+    def test_per_workload_ring_bound(self):
+        log = DecisionAuditLog(per_workload=4)
+        for i in range(8):
+            # alternate messages so nothing dedups
+            log.record(self._rec(cycle=i, message=f"m{i}"))
+        recs = log.for_workload("ns/w")
+        assert len(recs) == 4
+        assert [r.cycle for r in recs] == [4, 5, 6, 7]
+
+    def test_max_workloads_lru_eviction(self):
+        log = DecisionAuditLog(max_workloads=3)
+        for i in range(5):
+            log.record(self._rec(workload=f"ns/w{i}"))
+        assert len(log.keys()) == 3
+        assert log.for_workload("ns/w0") == []
+        assert log.latest("ns/w4") is not None
+
+    def test_tail_orders_by_cycle(self):
+        log = DecisionAuditLog()
+        log.record(self._rec(workload="ns/b", cycle=2))
+        log.record(self._rec(workload="ns/a", cycle=1))
+        log.record(self._rec(workload="ns/c", cycle=3))
+        assert [r.workload for r in log.tail(2)] == ["ns/b", "ns/c"]
+
+    def test_forget_drops_history(self):
+        log = DecisionAuditLog()
+        log.record(self._rec())
+        log.forget("ns/w")
+        assert log.for_workload("ns/w") == [] and len(log) == 0
+
+
+class TestReasonLint:
+    """Satellite: no ad-hoc reason strings — every event reason emitted
+    through the runtime recorder and every DecisionRecord reason must
+    belong to the canonical enums."""
+
+    def test_audit_log_rejects_ad_hoc_reason_strings(self):
+        log = DecisionAuditLog()
+        with pytest.raises(ValueError, match="canonical"):
+            log.record(
+                DecisionRecord(
+                    workload="ns/w", cluster_queue="cq", cycle=1,
+                    outcome="Pending", reason="SomeAdHocString",  # type: ignore[arg-type]
+                )
+            )
+
+    def test_source_event_reasons_are_canonical(self):
+        """Static lint over the package: every literal first argument
+        of runtime.event(...) / self.events(...) / events.record(...)
+        must be a member of EVENT_REASONS."""
+        pkg = Path(__file__).resolve().parent.parent / "kueue_tpu"
+        call = re.compile(
+            r"\.(?:event|events|record)\(\s*\n?\s*\"([A-Za-z]+)\""
+        )
+        offenders = []
+        for path in sorted(pkg.rglob("*.py")):
+            for kind in call.findall(path.read_text()):
+                if kind not in EVENT_REASONS:
+                    offenders.append((str(path.relative_to(pkg)), kind))
+        assert not offenders, (
+            f"ad-hoc event reasons (add to EVENT_REASONS or fix the "
+            f"call site): {offenders}"
+        )
+
+    def test_scenario_records_classify_without_unknown(self):
+        rt = run_acceptance_scenario(use_solver=False)
+        for key in rt.audit.keys():
+            for rec in rt.audit.for_workload(key):
+                assert isinstance(rec.reason, InadmissibleReason)
+                assert rec.reason != InadmissibleReason.UNKNOWN, (
+                    f"{key}: message {rec.message!r} classified UNKNOWN"
+                )
+
+    def test_classifier_known_messages(self):
+        cases = {
+            "couldn't assign flavors to pod set main: insufficient unused "
+            "quota for cpu in flavor default, 1 more needed":
+                InadmissibleReason.INSUFFICIENT_QUOTA,
+            "insufficient quota for cpu in flavor default, request > "
+            "maximum capacity (3 > 2)":
+                InadmissibleReason.REQUEST_EXCEEDS_CAPACITY,
+            "untolerated taint in flavor default":
+                InadmissibleReason.UNTOLERATED_TAINT,
+            "flavor gone not found": InadmissibleReason.FLAVOR_NOT_FOUND,
+            "ClusterQueue cq not found":
+                InadmissibleReason.CLUSTER_QUEUE_NOT_FOUND,
+            "ClusterQueue cq is inactive":
+                InadmissibleReason.CLUSTER_QUEUE_INACTIVE,
+            "Workload namespace doesn't match ClusterQueue selector":
+                InadmissibleReason.NAMESPACE_MISMATCH,
+            "The workload is deactivated": InadmissibleReason.DEACTIVATED,
+            "The workload has failed admission checks":
+                InadmissibleReason.FAILED_ADMISSION_CHECKS,
+            "Workload no longer fits after processing another workload":
+                InadmissibleReason.LOST_QUOTA_RACE,
+            "Workload has overlapping preemption targets with another "
+            "workload": InadmissibleReason.OVERLAPPING_PREEMPTION,
+            "waiting for all admitted workloads to be in PodsReady "
+            "condition": InadmissibleReason.WAITING_FOR_PODS_READY,
+            'topology "t" doesn\'t allow to fit any of 3 pod(s)':
+                InadmissibleReason.TOPOLOGY_NO_FIT,
+            'Flavor "f" supports only TopologyAwareScheduling':
+                InadmissibleReason.TOPOLOGY_INCOMPATIBLE,
+            "Workload didn't fit": InadmissibleReason.INSUFFICIENT_QUOTA,
+            "": InadmissibleReason.UNKNOWN,
+            "gibberish nobody emits": InadmissibleReason.UNKNOWN,
+        }
+        for message, expected in cases.items():
+            assert classify_inadmissible_message(message) == expected, message
+
+
+class TestMetricAndDashboard:
+    def test_inadmissible_reason_metric_series(self):
+        rt = run_acceptance_scenario(use_solver=False)
+        m = rt.metrics
+        assert m.inadmissible_reason_total.value(
+            cluster_queue="cq", reason="InsufficientQuota"
+        ) >= 1
+        assert m.inadmissible_reason_total.value(
+            cluster_queue="cq", reason="UntoleratedTaint"
+        ) >= 1
+        text = m.registry.expose()
+        assert "kueue_inadmissible_reason_total" in text
+
+    def test_dashboard_why_pending_panel_feed(self):
+        from kueue_tpu.server.dashboard import DASHBOARD_HTML, dashboard_payload
+
+        rt = ClusterRuntime()
+        rt.add_flavor(ResourceFlavor(name="default"))
+        rt.add_cluster_queue(_cq())
+        rt.add_local_queue(
+            LocalQueue(namespace="ns", name="lq", cluster_queue="cq")
+        )
+        rt.add_workload(_wl("fits", created=0.0))
+        rt.add_workload(_wl("starved", created=1.0))
+        rt.run_until_idle()
+        payload = dashboard_payload(rt)
+        why = payload["whyPending"]
+        assert [w["workload"] for w in why] == ["ns/starved"]
+        assert why[0]["reason"] == "InsufficientQuota"
+        assert payload["pendingReasons"] == {"InsufficientQuota": 1}
+        assert 'id="why"' in DASHBOARD_HTML and "whyPending" in DASHBOARD_HTML
+
+    def test_visibility_items_reason_over_http(self):
+        from kueue_tpu.server import KueueClient, KueueServer
+
+        rt = run_acceptance_scenario(use_solver=False)
+        srv = KueueServer(runtime=rt)
+        port = srv.start()
+        try:
+            client = KueueClient(f"http://127.0.0.1:{port}")
+            out = client.pending_workloads_cq("cq")
+            # the preempted victim is pending again, with its reason
+            items = {i["name"]: i for i in out["items"]}
+            assert "victim" in items
+            assert items["victim"]["inadmissibleReason"] == "InsufficientQuota"
+        finally:
+            srv.stop()
+
+
+class TestDebuggerDump:
+    def test_dump_includes_decisions_and_traces(self):
+        from kueue_tpu.debugger import dump
+
+        rt = run_acceptance_scenario(use_solver=False)
+        text = dump(rt)
+        assert "recent decisions (audit trail)" in text
+        assert "ns/subject @ cq: Admitted/Admitted" in text
+        assert "recent cycles (phase attribution)" in text
+
+
+class TestDrainPathDecisions:
+    def test_bulk_drain_records_with_drain_resolution(self):
+        rt = ClusterRuntime(bulk_drain_threshold=4)
+        rt.add_flavor(ResourceFlavor(name="default"))
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name="cq", namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",), (FlavorQuotas.build("default", {"cpu": "4"}),)
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(
+            LocalQueue(namespace="ns", name="lq", cluster_queue="cq")
+        )
+        for i in range(8):
+            rt.add_workload(_wl(f"w{i}", cpu="1", created=float(i)))
+        rt.run_until_idle()
+        drains = [
+            t for t in rt.scheduler.last_traces if t.resolution == "drain"
+        ]
+        assert drains, "bulk drain never ran"
+        admitted = [
+            rt.audit.latest(f"ns/w{i}")
+            for i in range(8)
+            if rt.workloads[f"ns/w{i}"].is_admitted
+        ]
+        assert admitted and all(
+            r is not None and r.resolution == "drain" for r in admitted
+        )
+        parked = [
+            rt.audit.latest(f"ns/w{i}")
+            for i in range(8)
+            if not rt.workloads[f"ns/w{i}"].is_admitted
+        ]
+        assert parked and all(
+            r.reason == InadmissibleReason.INSUFFICIENT_QUOTA for r in parked
+        )
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -269,6 +269,7 @@ class TestCLIOverTLS:
     def test_get_against_https_server(self, tmp_path, capsys):
         """kueuectl against a TLS server: --ca-cert verifies the
         rotator's CA (the kubeconfig certificate-authority triple)."""
+        pytest.importorskip("cryptography")
         from kueue_tpu.controllers import ClusterRuntime
         from kueue_tpu.models import LocalQueue
         from kueue_tpu.server import KueueServer

@@ -7,7 +7,12 @@ import ssl
 
 import pytest
 
-from kueue_tpu.models import (
+# the whole module exercises cert generation/rotation; without the
+# cryptography package every test would fail at the first CA issue —
+# skip them as missing-dependency instead
+pytest.importorskip("cryptography")
+
+from kueue_tpu.models import (  # noqa: E402
     ClusterQueue,
     FlavorQuotas,
     LocalQueue,

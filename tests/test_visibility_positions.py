@@ -1,0 +1,179 @@
+"""Visibility pending-position math (pkg/visibility analog).
+
+Direct coverage for pending_workloads_in_cq / _in_lq ordering: priority
+descending, FIFO within ties, per-LocalQueue position recomputation,
+StrictFIFO head-blocking vs BestEffortFIFO parking, and stable absolute
+positions under offset/limit pagination.
+"""
+
+import pytest
+
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.models import (
+    ClusterQueue,
+    LocalQueue,
+    QueueingStrategy,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.visibility import (
+    pending_position,
+    pending_workloads_in_cq,
+    pending_workloads_in_lq,
+)
+
+
+def _runtime(cpu="2", strategy=QueueingStrategy.BEST_EFFORT_FIFO, lqs=("lq",)):
+    rt = ClusterRuntime()
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq",
+            namespace_selector={},
+            queueing_strategy=strategy,
+            resource_groups=(
+                ResourceGroup(("cpu",), (FlavorQuotas.build("default", {"cpu": cpu}),)),
+            ),
+        )
+    )
+    for lq in lqs:
+        rt.add_local_queue(LocalQueue(namespace="ns", name=lq, cluster_queue="cq"))
+    return rt
+
+
+def _wl(name, cpu="2", priority=0, created=0.0, lq="lq"):
+    return Workload(
+        namespace="ns", name=name, queue_name=lq, priority=priority,
+        creation_time=created,
+        pod_sets=(PodSet.build("main", 1, {"cpu": cpu}),),
+    )
+
+
+class TestClusterQueuePositions:
+    def test_priority_orders_positions(self):
+        rt = _runtime(cpu="0")  # nothing fits: everything stays pending
+        for i, prio in enumerate([1, 5, 3]):
+            rt.add_workload(_wl(f"w{i}", priority=prio, created=float(i)))
+        rt.run_until_idle()
+        summary = pending_workloads_in_cq(rt.queues, "cq")
+        names = [pw.name for pw in summary.items]
+        assert names == ["w1", "w2", "w0"]  # priority desc
+        assert [pw.position_in_cluster_queue for pw in summary.items] == [0, 1, 2]
+
+    def test_priority_ties_fall_back_to_fifo(self):
+        rt = _runtime(cpu="0")
+        # same priority, deliberately added out of creation order
+        rt.add_workload(_wl("late", priority=7, created=50.0))
+        rt.add_workload(_wl("early", priority=7, created=10.0))
+        rt.add_workload(_wl("mid", priority=7, created=30.0))
+        rt.run_until_idle()
+        names = [pw.name for pw in pending_workloads_in_cq(rt.queues, "cq").items]
+        assert names == ["early", "mid", "late"]
+
+    def test_offset_limit_keeps_absolute_positions(self):
+        rt = _runtime(cpu="0")
+        for i in range(5):
+            rt.add_workload(_wl(f"w{i}", created=float(i)))
+        rt.run_until_idle()
+        page = pending_workloads_in_cq(rt.queues, "cq", offset=2, limit=2)
+        assert [pw.name for pw in page.items] == ["w2", "w3"]
+        # positions are absolute (computed before slicing), not page-relative
+        assert [pw.position_in_cluster_queue for pw in page.items] == [2, 3]
+
+    def test_unknown_cq_is_empty(self):
+        rt = _runtime()
+        assert pending_workloads_in_cq(rt.queues, "nope").items == []
+
+
+class TestLocalQueuePositions:
+    def test_per_lq_positions_recomputed_from_interleaved_cq_order(self):
+        rt = _runtime(cpu="0", lqs=("lq-a", "lq-b"))
+        # CQ order interleaves the two LQs: a0, b0, a1, b1 by priority
+        rt.add_workload(_wl("a0", priority=9, created=0.0, lq="lq-a"))
+        rt.add_workload(_wl("b0", priority=8, created=1.0, lq="lq-b"))
+        rt.add_workload(_wl("a1", priority=7, created=2.0, lq="lq-a"))
+        rt.add_workload(_wl("b1", priority=6, created=3.0, lq="lq-b"))
+        rt.run_until_idle()
+        cq_items = pending_workloads_in_cq(rt.queues, "cq").items
+        assert [pw.name for pw in cq_items] == ["a0", "b0", "a1", "b1"]
+        # each LQ numbers its own members 0..n over the CQ ordering
+        assert [(pw.name, pw.position_in_local_queue) for pw in cq_items] == [
+            ("a0", 0), ("b0", 0), ("a1", 1), ("b1", 1)
+        ]
+        lq_b = pending_workloads_in_lq(rt.queues, "ns", "lq-b")
+        assert [pw.name for pw in lq_b.items] == ["b0", "b1"]
+        # CQ positions survive the LQ filter (the reference keeps both)
+        assert [pw.position_in_cluster_queue for pw in lq_b.items] == [1, 3]
+
+    def test_lq_offset_limit(self):
+        rt = _runtime(cpu="0")
+        for i in range(4):
+            rt.add_workload(_wl(f"w{i}", created=float(i)))
+        rt.run_until_idle()
+        page = pending_workloads_in_lq(rt.queues, "ns", "lq", offset=1, limit=2)
+        assert [pw.name for pw in page.items] == ["w1", "w2"]
+
+    def test_unknown_lq_is_empty(self):
+        rt = _runtime()
+        assert pending_workloads_in_lq(rt.queues, "ns", "nope").items == []
+
+
+class TestQueueingStrategyVisibility:
+    """StrictFIFO blocks behind an unadmittable head; BestEffortFIFO
+    parks it and admits the rest — the pending listing must show both
+    truthfully."""
+
+    def _load(self, strategy):
+        rt = _runtime(cpu="2", strategy=strategy)
+        # head needs more than total quota -> can never admit
+        rt.add_workload(_wl("blocker", cpu="3", priority=5, created=0.0))
+        rt.add_workload(_wl("small", cpu="1", priority=0, created=1.0))
+        rt.run_until_idle()
+        return rt
+
+    def test_strict_fifo_blocks_and_lists_both(self):
+        rt = self._load(QueueingStrategy.STRICT_FIFO)
+        assert not rt.workloads["ns/small"].is_admitted
+        items = pending_workloads_in_cq(rt.queues, "cq", audit=rt.audit).items
+        assert [pw.name for pw in items] == ["blocker", "small"]
+        assert [pw.position_in_cluster_queue for pw in items] == [0, 1]
+
+    def test_best_effort_fifo_parks_blocker_and_admits_small(self):
+        rt = self._load(QueueingStrategy.BEST_EFFORT_FIFO)
+        assert rt.workloads["ns/small"].is_admitted
+        items = pending_workloads_in_cq(rt.queues, "cq", audit=rt.audit).items
+        assert [pw.name for pw in items] == ["blocker"]
+        # the parked head carries its structured reason
+        assert items[0].inadmissible_reason == "RequestExceedsMaxCapacity"
+
+    def test_pending_position_lookup(self):
+        rt = self._load(QueueingStrategy.STRICT_FIFO)
+        pw = pending_position(rt.queues, "cq", "ns/small", audit=rt.audit)
+        assert pw is not None and pw.position_in_cluster_queue == 1
+        assert pending_position(rt.queues, "cq", "ns/gone") is None
+
+
+class TestReasonEnrichment:
+    def test_items_carry_latest_structured_reason(self):
+        rt = _runtime(cpu="2")
+        rt.add_workload(_wl("fits", cpu="2", created=0.0))
+        rt.add_workload(_wl("starved", cpu="2", created=1.0))
+        rt.run_until_idle()
+        items = pending_workloads_in_cq(rt.queues, "cq", audit=rt.audit).items
+        assert [pw.name for pw in items] == ["starved"]
+        assert items[0].inadmissible_reason == "InsufficientQuota"
+        assert "insufficient unused quota" in items[0].message
+        assert items[0].last_cycle >= 1
+
+    def test_no_audit_keeps_reason_empty(self):
+        rt = _runtime(cpu="0")
+        rt.add_workload(_wl("w", created=0.0))
+        rt.run_until_idle()
+        items = pending_workloads_in_cq(rt.queues, "cq").items
+        assert items and items[0].inadmissible_reason == ""
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))
