@@ -531,6 +531,112 @@ def cmd_explain(state: State, args) -> None:
     _render_decision_timeline(key, status, rows)
 
 
+# ---- plan (the what-if capacity planner) ----
+def _render_plan(report: dict, target: str) -> None:
+    """Render one PlanReport (wire dict) as a ranked scenario table
+    plus the recommendation line — the operator-facing half of the
+    stuck-workload loop (`explain` says why; `plan` says what next)."""
+    rows = []
+    for s in report.get("scenarios", []):
+        fc = s.get("forecast") or {}
+        band = fc.get("band")
+        rows.append([
+            s["name"] + (" *" if s.get("baseline") else ""),
+            str(len(s.get("admitted", []))),
+            "+" + str(len(s.get("newlyAdmitted", []))),
+            str(len(s.get("lost", []))),
+            str(s.get("preemptionCandidates", 0)),
+            str(s.get("borrowing", 0)),
+            (
+                f"{fc.get('mean', 0)}s [{band[0]}-{band[1]}]"
+                if band
+                else ""
+            ),
+        ])
+    _print_table(
+        ["SCENARIO", "ADMITS", "NEW", "LOST", "PREEMPT", "BORROW", "TTA FORECAST"],
+        rows,
+    )
+    print("(* = baseline: the cluster as configured today)")
+    baseline = report.get("baseline") or {}
+    if target and target in (baseline.get("reasons") or {}):
+        why = baseline["reasons"][target]
+        print(f"Today:         {target} is pending: {why['reason']}")
+    rec = report.get("recommended")
+    if rec:
+        scen = next(
+            (s for s in report["scenarios"] if s["name"] == rec), None
+        )
+        newly = ", ".join(scen.get("newlyAdmitted", [])) if scen else ""
+        print(f"Recommended:   {rec}")
+        if scen:
+            for d in scen.get("deltas", []):
+                print(f"  apply:       {d}")
+        if newly:
+            print(f"  would admit: {newly}")
+    else:
+        print(
+            "Recommended:   <none> — no evaluated scenario admits "
+            "anything the baseline doesn't"
+        )
+    if report.get("unmodeled"):
+        print(
+            "Unmodeled (host-path-only heads, excluded from the sweep): "
+            + ", ".join(report["unmodeled"])
+        )
+
+
+def cmd_plan(state: State, args) -> None:
+    """What would it take to admit this workload (or drain this CQ's
+    backlog)? --server plans against a live control plane; otherwise
+    the state file is loaded and planned in memory (no writes), like
+    `explain`'s offline mode."""
+    target = f"{args.namespace}/{args.name}" if args.name else ""
+    options: Dict[str, object] = {"includeReasons": "baseline"}
+    if args.forecast:
+        options["forecast"] = True
+        options["runtimeHintSeconds"] = args.runtime_hint
+    scenarios = None
+    if args.scenarios:
+        with open(args.scenarios) as f:
+            scenarios = json.load(f)
+    if not target and not args.clusterqueue and not scenarios:
+        raise SystemExit(
+            "error: plan needs a workload name, --clusterqueue, or "
+            "--scenarios"
+        )
+    if getattr(args, "server", None):
+        report = _server_client(args).plan(
+            scenarios=scenarios,
+            workload=target or None,
+            cluster_queue=args.clusterqueue or None,
+            options=options,
+        )
+    else:
+        from kueue_tpu.planner import Planner, scenario_from_dict
+
+        rt = state.build_runtime()
+        rt.run_until_idle()  # in-memory only: state file is NOT saved
+        planner = Planner.for_runtime(rt)
+        hint = args.runtime_hint
+        report = planner.plan(
+            scenarios=(
+                [
+                    scenario_from_dict(sd, default_name=f"scenario-{i}")
+                    for i, sd in enumerate(scenarios)
+                ]
+                if scenarios
+                else None
+            ),
+            target_workload=target,
+            target_cq=args.clusterqueue or "",
+            include_reasons="baseline",
+            forecast=args.forecast,
+            runtime_hint=(lambda wl: hint) if args.forecast else None,
+        ).to_dict()
+    _render_plan(report, target)
+
+
 # ---- events (the `kubectl get events` / `--watch` analog) ----
 def cmd_events(state: State, args) -> None:
     """List the control plane's recorded events, or follow them live
@@ -833,6 +939,39 @@ def build_parser() -> argparse.ArgumentParser:
         exp, "read the decision trail from a running kueue_tpu.server"
     )
     exp.set_defaults(fn=cmd_explain)
+
+    pl = sub.add_parser(
+        "plan",
+        help="what-if capacity planner: which config change would "
+        "admit this workload (or this ClusterQueue's backlog), and "
+        "when",
+    )
+    pl.add_argument(
+        "name", nargs="?", default="",
+        help="target workload name (omit with --clusterqueue)",
+    )
+    pl.add_argument("-n", "--namespace", default="default")
+    pl.add_argument(
+        "--clusterqueue", default="",
+        help="plan a quota sweep for this ClusterQueue instead of one "
+        "workload",
+    )
+    pl.add_argument(
+        "--scenarios",
+        help="JSON file with explicit scenarios "
+        '([{"name", "deltas": [{"kind": "quota", ...}]}])',
+    )
+    pl.add_argument(
+        "--forecast", action="store_true",
+        help="include the virtual-time time-to-admission forecast",
+    )
+    pl.add_argument(
+        "--runtime-hint", type=float, default=600.0,
+        help="assumed per-workload runtime seconds for the forecast "
+        "(default 600)",
+    )
+    _add_server_flags(pl, "plan against a running kueue_tpu.server")
+    pl.set_defaults(fn=cmd_plan)
 
     sch = sub.add_parser("schedule")
     sch.add_argument("--cycles", type=int, default=1)

@@ -1,0 +1,208 @@
+"""Shared snapshot <-> device-array codec.
+
+One definition of the dense array layout the device kernels consume —
+the cohort forest as parent links + level masks, the quota triple and
+leaf usage as int64[N, FR] matrices — extracted from the solver's
+inlined encoding so the live cycle dispatch, the bulk drain and the
+capacity planner all read the SAME bytes for the same snapshot and
+cannot drift. ``encode_snapshot`` is view-based (no copies) so the hot
+path pays nothing for the indirection; ``decode_snapshot`` rebuilds an
+independent, fully functional ``Snapshot`` from the arrays (the
+planner's per-scenario host snapshots; round-trip equality is asserted
+in tests/test_encode.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from kueue_tpu.core.hierarchy import FlatHierarchy
+from kueue_tpu.core.snapshot import Snapshot, WorkloadSnapshot
+from kueue_tpu.ops.quota_np import subtree_quota_np
+from kueue_tpu.resources import FlavorResource
+
+__all__ = ["EncodedSnapshot", "encode_snapshot", "decode_snapshot", "device_arrays"]
+
+
+@dataclass
+class EncodedSnapshot:
+    """The snapshot's dense-tensor essence.
+
+    Array fields are the device payload (what the kernels consume);
+    the name tuples + host-object maps carry exactly enough identity to
+    decode back into a ``Snapshot``. Quota/usage arrays are VIEWS of
+    the source snapshot by default — callers mutating them (the planner
+    stacking scenario variants) must copy first (``with_quota``).
+    """
+
+    cq_names: Tuple[str, ...]
+    cohort_names: Tuple[str, ...]
+    fr_list: Tuple[FlavorResource, ...]
+    parent: np.ndarray  # int32[N]
+    level_mask: np.ndarray  # bool[D+1, N]
+    nominal: np.ndarray  # int64[N, FR]
+    lending_limit: np.ndarray  # int64[N, FR]
+    borrowing_limit: np.ndarray  # int64[N, FR]
+    local_usage: np.ndarray  # int64[N, FR]
+    weight_milli: np.ndarray  # int64[N]
+    generations: Dict[str, int] = field(default_factory=dict)
+    inactive_cqs: Tuple[str, ...] = ()
+    # host-only references (never shipped to the device)
+    cq_models: Dict[str, object] = field(default_factory=dict)
+    priority_classes: Dict[str, object] = field(default_factory=dict)
+    workloads: Dict[str, WorkloadSnapshot] = field(default_factory=dict)
+
+    @property
+    def n_cq(self) -> int:
+        return len(self.cq_names)
+
+    @property
+    def n_nodes(self) -> int:
+        return self.parent.shape[0]
+
+    @property
+    def max_depth(self) -> int:
+        return self.level_mask.shape[0] - 1
+
+    def row(self, name: str) -> int:
+        names = self.cq_names
+        try:
+            return names.index(name)
+        except ValueError:
+            return self.n_cq + self.cohort_names.index(name)
+
+    def with_quota(
+        self,
+        nominal: Optional[np.ndarray] = None,
+        lending_limit: Optional[np.ndarray] = None,
+        borrowing_limit: Optional[np.ndarray] = None,
+        local_usage: Optional[np.ndarray] = None,
+        weight_milli: Optional[np.ndarray] = None,
+    ) -> "EncodedSnapshot":
+        """A variant sharing structure but carrying replacement quota /
+        usage arrays — how the planner materializes one scenario."""
+        return replace(
+            self,
+            nominal=self.nominal if nominal is None else nominal,
+            lending_limit=(
+                self.lending_limit if lending_limit is None else lending_limit
+            ),
+            borrowing_limit=(
+                self.borrowing_limit if borrowing_limit is None else borrowing_limit
+            ),
+            local_usage=(
+                self.local_usage if local_usage is None else local_usage
+            ),
+            weight_milli=(
+                self.weight_milli if weight_milli is None else weight_milli
+            ),
+        )
+
+
+def encode_snapshot(snapshot: Snapshot) -> EncodedSnapshot:
+    """Snapshot -> dense arrays (views; zero-copy)."""
+    flat = snapshot.flat
+    return EncodedSnapshot(
+        cq_names=flat.cq_names,
+        cohort_names=flat.cohort_names,
+        fr_list=snapshot.fr_list,
+        parent=flat.parent,
+        level_mask=flat.level_masks(),
+        nominal=snapshot.nominal,
+        lending_limit=snapshot.lending_limit,
+        borrowing_limit=snapshot.borrowing_limit,
+        local_usage=snapshot.local_usage,
+        weight_milli=snapshot.weight_milli,
+        generations=snapshot.generations,
+        inactive_cqs=snapshot.inactive_cqs,
+        cq_models=snapshot.cq_models,
+        priority_classes=snapshot.priority_classes,
+        workloads=snapshot.workloads,
+    )
+
+
+def decode_snapshot(enc: EncodedSnapshot) -> Snapshot:
+    """Arrays -> an independent Snapshot (array fields copied, so the
+    result is safely mutable: the planner's forecast simulation
+    add/remove-usage loops run on decoded scenario snapshots without
+    touching the live state)."""
+    index = {name: i for i, name in enumerate(enc.cq_names)}
+    for j, name in enumerate(enc.cohort_names):
+        index[name] = enc.n_cq + j
+    parent = np.array(enc.parent, dtype=np.int32, copy=True)
+    n = parent.shape[0]
+    depth = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        d, cur = 0, int(parent[i])
+        while cur >= 0:
+            d += 1
+            cur = int(parent[cur])
+        depth[i] = d
+    flat = FlatHierarchy(
+        cq_names=tuple(enc.cq_names),
+        cohort_names=tuple(enc.cohort_names),
+        index=index,
+        parent=parent,
+        depth=depth,
+        max_depth=int(depth.max()) if n else 0,
+        inactive_cqs=(),
+    )
+    nominal = np.array(enc.nominal, dtype=np.int64, copy=True)
+    lend = np.array(enc.lending_limit, dtype=np.int64, copy=True)
+    borrow = np.array(enc.borrowing_limit, dtype=np.int64, copy=True)
+    subtree, guaranteed = subtree_quota_np(
+        parent, flat.level_masks(), nominal, lend
+    )
+    fr_list = tuple(enc.fr_list)
+    fr_index = {fr: j for j, fr in enumerate(fr_list)}
+    resource_names = tuple(sorted({fr.resource for fr in fr_list}))
+    rname_index = {r: i for i, r in enumerate(resource_names)}
+    resource_index = np.array(
+        [rname_index[fr.resource] for fr in fr_list], dtype=np.int32
+    )
+    snap = Snapshot(
+        flat=flat,
+        fr_list=fr_list,
+        fr_index=fr_index,
+        resource_names=resource_names,
+        resource_index=resource_index,
+        nominal=nominal,
+        lending_limit=lend,
+        borrowing_limit=borrow,
+        subtree=subtree,
+        guaranteed=guaranteed,
+        local_usage=np.array(enc.local_usage, dtype=np.int64, copy=True),
+        weight_milli=np.array(enc.weight_milli, dtype=np.int64, copy=True),
+        cq_models=dict(enc.cq_models),
+        inactive_cqs=tuple(enc.inactive_cqs),
+        generations=dict(enc.generations),
+        priority_classes=dict(enc.priority_classes),
+    )
+    # workload registrations WITHOUT re-adding usage: local_usage above
+    # already carries their charge (add_workload would double-count)
+    for key, ws in enc.workloads.items():
+        snap.workloads[key] = ws
+        snap._by_cq.setdefault(ws.cq_name, {})[key] = ws
+    return snap
+
+
+def device_arrays(enc: EncodedSnapshot):
+    """(QuotaTree, paths, roots) — the device inputs every kernel
+    consumer (cycle dispatch, drain, planner) builds through here."""
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.ops.assign_kernel import build_paths, build_roots
+    from kueue_tpu.ops.quota import QuotaTree
+
+    tree = QuotaTree(
+        parent=jnp.asarray(enc.parent),
+        level_mask=jnp.asarray(enc.level_mask),
+        nominal=jnp.asarray(enc.nominal),
+        lending_limit=jnp.asarray(enc.lending_limit),
+        borrowing_limit=jnp.asarray(enc.borrowing_limit),
+    )
+    paths = jnp.asarray(build_paths(enc.parent, enc.max_depth))
+    roots = build_roots(enc.parent)
+    return tree, paths, roots

@@ -392,22 +392,12 @@ def lower_heads(
 
 
 def tree_arrays(snapshot: Snapshot):
-    """(QuotaTree, paths, roots) device inputs from a Snapshot."""
-    from kueue_tpu._jax import jnp
-    from kueue_tpu.ops.assign_kernel import build_paths, build_roots
-    from kueue_tpu.ops.quota import QuotaTree
+    """(QuotaTree, paths, roots) device inputs from a Snapshot, via the
+    shared snapshot->array codec (core/encode.py) — the ONE encoding
+    the cycle dispatch, the drain and the planner all consume."""
+    from kueue_tpu.core.encode import device_arrays, encode_snapshot
 
-    flat = snapshot.flat
-    tree = QuotaTree(
-        parent=jnp.asarray(flat.parent),
-        level_mask=jnp.asarray(flat.level_masks()),
-        nominal=jnp.asarray(snapshot.nominal),
-        lending_limit=jnp.asarray(snapshot.lending_limit),
-        borrowing_limit=jnp.asarray(snapshot.borrowing_limit),
-    )
-    paths = jnp.asarray(build_paths(flat.parent, flat.max_depth))
-    roots = build_roots(flat.parent)
-    return tree, paths, roots
+    return device_arrays(encode_snapshot(snapshot))
 
 
 class ResidentCycleState:
@@ -534,6 +524,49 @@ def _bucket(w: int, minimum: int = 64) -> int:
     return n
 
 
+def pack_heads(lowered: Lowered, roots, w_pad: int):
+    """Pad a lowered head batch to ``w_pad`` rows and derive the
+    segmented phase-2 schedule inputs. Shared by the cycle dispatch and
+    the planner's scenario batch so the two cannot disagree on padding
+    or segment compaction. Returns numpy
+    (HeadsBatch, seg_id, n_segments, n_steps)."""
+    import numpy as np
+
+    from kueue_tpu.ops.assign_kernel import HeadsBatch
+
+    w = len(lowered.heads)
+    cq_row, cells, qty = lowered.cq_row, lowered.cells, lowered.qty
+    valid, priority = lowered.valid, lowered.priority
+    timestamp, no_reclaim = lowered.timestamp, lowered.no_reclaim
+    if w_pad > w:
+        pad = w_pad - w
+        cq_row = np.concatenate([cq_row, np.full(pad, -1, dtype=np.int32)])
+        cells = np.concatenate(
+            [cells, np.full((pad,) + cells.shape[1:], -1, dtype=np.int32)]
+        )
+        qty = np.concatenate([qty, np.zeros((pad,) + qty.shape[1:], dtype=np.int64)])
+        valid = np.concatenate([valid, np.zeros((pad,) + valid.shape[1:], dtype=bool)])
+        priority = np.concatenate([priority, np.zeros(pad, dtype=np.int64)])
+        timestamp = np.concatenate([timestamp, np.zeros(pad, dtype=np.int64)])
+        no_reclaim = np.concatenate([no_reclaim, np.zeros(pad, dtype=bool)])
+    batch_np = HeadsBatch(
+        cq_row=cq_row, cells=cells, qty=qty, valid=valid,
+        priority=priority, timestamp=timestamp, no_reclaim=no_reclaim,
+    )
+    # compact segment ids: one per LIVE root cohort; the max head count
+    # within one root bounds phase-2's sequential depth
+    seg_id = np.full(w_pad, -1, dtype=np.int32)
+    live_mask = cq_row >= 0
+    if live_mask.any():
+        uniq, inv = np.unique(roots[cq_row[live_mask]], return_inverse=True)
+        seg_id[live_mask] = inv.astype(np.int32)
+        n_segments = _bucket(len(uniq), minimum=8)
+        n_steps = _bucket(int(np.bincount(inv).max()), minimum=8)
+    else:
+        n_segments = n_steps = 8
+    return batch_np, seg_id, n_segments, n_steps
+
+
 def dispatch_lowered(
     snapshot: Snapshot,
     lowered: Lowered,
@@ -577,40 +610,12 @@ def dispatch_lowered(
         from kueue_tpu.parallel.sharded_solver import pad_w_multiple
 
         w_pad = pad_w_multiple(w_pad, mesh.shape["wl"])
-    cq_row, cells, qty = lowered.cq_row, lowered.cells, lowered.qty
-    valid, priority = lowered.valid, lowered.priority
-    timestamp, no_reclaim = lowered.timestamp, lowered.no_reclaim
-    if w_pad > w:
-        pad = w_pad - w
-        cq_row = np.concatenate([cq_row, np.full(pad, -1, dtype=np.int32)])
-        cells = np.concatenate(
-            [cells, np.full((pad,) + cells.shape[1:], -1, dtype=np.int32)]
-        )
-        qty = np.concatenate([qty, np.zeros((pad,) + qty.shape[1:], dtype=np.int64)])
-        valid = np.concatenate([valid, np.zeros((pad,) + valid.shape[1:], dtype=bool)])
-        priority = np.concatenate([priority, np.zeros(pad, dtype=np.int64)])
-        timestamp = np.concatenate([timestamp, np.zeros(pad, dtype=np.int64)])
-        no_reclaim = np.concatenate([no_reclaim, np.zeros(pad, dtype=bool)])
     usage_resident = None
     if resident is not None and mesh is None:
         tree, paths, roots, usage_resident = resident.refresh(snapshot)
     else:
         tree, paths, roots = tree_arrays(snapshot)
-    batch_np = HeadsBatch(
-        cq_row=cq_row, cells=cells, qty=qty, valid=valid,
-        priority=priority, timestamp=timestamp, no_reclaim=no_reclaim,
-    )
-    # compact segment ids: one per LIVE root cohort; the max head count
-    # within one root bounds phase-2's sequential depth
-    seg_id = np.full(w_pad, -1, dtype=np.int32)
-    live_mask = cq_row >= 0
-    if live_mask.any():
-        uniq, inv = np.unique(roots[cq_row[live_mask]], return_inverse=True)
-        seg_id[live_mask] = inv.astype(np.int32)
-        n_segments = _bucket(len(uniq), minimum=8)
-        n_steps = _bucket(int(np.bincount(inv).max()), minimum=8)
-    else:
-        n_segments = n_steps = 8
+    batch_np, seg_id, n_segments, n_steps = pack_heads(lowered, roots, w_pad)
     if mesh is not None:
         # numpy -> device_put straight onto the shards (one transfer,
         # no staging of the full batch on a single device)

@@ -181,6 +181,33 @@ class Metrics:
             "Fair-sharing weighted share per cohort",
             ("cohort",),
         )
+        # capacity planner (kueue_tpu/planner): scrape surface for the
+        # what-if scenario sweeps — run counts per target kind, total
+        # scenarios evaluated, and batch latency per resolution path
+        # (device = one vmapped launch, host = numpy reference)
+        self.planner_runs_total = r.counter(
+            f"{NS}_planner_runs_total",
+            "Total capacity-planner runs per target kind (workload|clusterqueue|adhoc)",
+            ("target",),
+        )
+        self.planner_scenarios_total = r.counter(
+            f"{NS}_planner_scenarios_total",
+            "Total what-if scenarios evaluated by the capacity planner",
+        )
+        self.planner_duration_seconds = r.histogram(
+            f"{NS}_planner_duration_seconds",
+            "Wall-clock latency of one planner scenario batch per path (device|host)",
+            ("path",),
+            buckets=ATTEMPT_BUCKETS,
+        )
+        # `path` is a closed set: materialize both series up front so
+        # the scrape surface is complete before the first plan runs
+        for path in ("device", "host"):
+            self.planner_duration_seconds.touch(path=path)
+        self.planner_last_scenarios = r.gauge(
+            f"{NS}_planner_last_scenarios",
+            "Scenario count of the most recent capacity-planner run",
+        )
         # LocalQueue variants (LocalQueueMetrics feature gate)
         self.local_queue_pending_workloads = r.gauge(
             f"{NS}_local_queue_pending_workloads",
@@ -218,6 +245,15 @@ class Metrics:
         )
         self.cycle_last_heads.set(trace.heads)
         self.cycle_last_admitted.set(trace.admitted)
+
+    def report_planner(
+        self, target_kind: str, n_scenarios: int, duration_s: float, path: str
+    ) -> None:
+        """Mirror one capacity-planner run into the scrape surface."""
+        self.planner_runs_total.inc(target=target_kind)
+        self.planner_scenarios_total.inc(n_scenarios)
+        self.planner_duration_seconds.observe(duration_s, path=path)
+        self.planner_last_scenarios.set(n_scenarios)
 
     def report_inadmissible_reason(self, cq: str, reason: str) -> None:
         self.inadmissible_reason_total.inc(cluster_queue=cq, reason=reason)

@@ -144,6 +144,16 @@ class Histogram(_Metric):
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
 
+    def touch(self, **labels) -> None:
+        """Materialize a zero-count series for a known label value, so
+        closed label sets expose complete (all-zero) bucket/sum/count
+        series before the first observation."""
+        key = self._key(labels)
+        with self._lock:
+            self._counts.setdefault(key, [0] * len(self.buckets))
+            self._sums.setdefault(key, 0.0)
+            self._totals.setdefault(key, 0)
+
     def _series_keys(self):
         return list(self._totals)
 

@@ -148,6 +148,40 @@ def _stretch(cfg: GeneratorConfig, runtime_factor: int) -> GeneratorConfig:
 CONTENDED_GENERATOR_CONFIG = _stretch(DEFAULT_GENERATOR_CONFIG, 100)
 
 
+def override_nominal_cpu(scenario: "Scenario", overrides: dict) -> None:
+    """Replace ClusterQueues' cpu nominal quota in a generated Scenario
+    (whole CPUs), keeping each CQ's other spec intact — how a
+    planner-recommended quota delta is applied to the generator world
+    before perf/runner.run measures the real time-to-admission
+    (tests/test_planner.py forecast validation)."""
+    import dataclasses
+
+    from kueue_tpu.models.cluster_queue import ResourceQuota
+
+    for i, cq in enumerate(scenario.cluster_queues):
+        cpus = overrides.get(cq.name)
+        if cpus is None:
+            continue
+        new_groups = []
+        for rg in cq.resource_groups:
+            new_flavors = []
+            for fq in rg.flavors:
+                res = dict(fq.resources)
+                if "cpu" in res:
+                    old = res["cpu"]
+                    res["cpu"] = ResourceQuota(
+                        nominal=int(cpus) * 1000,
+                        borrowing_limit=old.borrowing_limit,
+                        lending_limit=old.lending_limit,
+                    )
+                new_flavors.append(dataclasses.replace(fq, resources=res))
+            new_groups.append(dataclasses.replace(rg, flavors=tuple(new_flavors)))
+        scenario.cluster_queues[i] = dataclasses.replace(
+            cq, resource_groups=tuple(new_groups)
+        )
+        scenario.nominal_cpu[cq.name] = int(cpus) * 1000
+
+
 @dataclass
 class GeneratedWorkload:
     workload: Workload

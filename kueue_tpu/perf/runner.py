@@ -52,8 +52,18 @@ def run(
     config: GeneratorConfig,
     max_virtual_s: float = 100_000.0,
     use_solver: Optional[bool] = None,
+    scenario_mutator=None,  # callable(Scenario) -> None, applied post-generate
 ) -> RunResult:
+    """Drive one generated scenario to completion in virtual time.
+
+    ``scenario_mutator`` edits the generated Scenario in place before
+    the run — the hook the planner's forecast-validation path uses to
+    apply a recommended quota delta (perf/generator.override_nominal_cpu)
+    and then measure the REAL time-to-admission against the forecast
+    band."""
     scenario = generate(config)
+    if scenario_mutator is not None:
+        scenario_mutator(scenario)
     clock = FakeClock(0.0)
     cache = Cache()
     queues = QueueManager(clock)

@@ -1,0 +1,50 @@
+"""Capacity planning — vmapped multi-scenario admission forecasting.
+
+The read-only "what would it take?" subsystem over the admission hot
+path: encode the live snapshot once (core/encode.py), lower the pending
+backlog once (core/solver.py), then solve S hypothetical cluster
+configurations — quota bumps, flavor capacity changes, lending /
+borrowing limit edits, TAS-domain drains, priority shifts — in ONE
+batched device launch (ops/plan_kernel.py under ``jax.vmap``). Served
+as ``POST /debug/plan``, ``KueueClient.plan()``, ``kueuectl plan`` and
+the dashboard's "What would it take?" panel; exported as
+``kueue_planner_*`` metrics.
+"""
+
+from kueue_tpu.planner.engine import (
+    Planner,
+    PlanReport,
+    ScenarioOutcome,
+    plan_request,
+    solve_scenario_host,
+)
+from kueue_tpu.planner.scenarios import (
+    BorrowingLimitDelta,
+    DrainDomainDelta,
+    FairShareWeightDelta,
+    FlavorCapacityDelta,
+    LendingLimitDelta,
+    NominalQuotaDelta,
+    PlanScenario,
+    PriorityDelta,
+    delta_from_dict,
+    scenario_from_dict,
+)
+
+__all__ = [
+    "Planner",
+    "PlanReport",
+    "ScenarioOutcome",
+    "plan_request",
+    "solve_scenario_host",
+    "PlanScenario",
+    "NominalQuotaDelta",
+    "FlavorCapacityDelta",
+    "LendingLimitDelta",
+    "BorrowingLimitDelta",
+    "FairShareWeightDelta",
+    "PriorityDelta",
+    "DrainDomainDelta",
+    "delta_from_dict",
+    "scenario_from_dict",
+]

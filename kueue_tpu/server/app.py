@@ -15,6 +15,15 @@ Routes (all JSON unless noted):
   GET  /debug/workloads/{ns}/{name}/decisions  per-workload decision audit
                                                trail (core/audit.py) — the
                                                `kueuectl explain` payload
+  POST /debug/plan                             what-if capacity planner
+                                               (kueue_tpu/planner): scenario
+                                               deltas (or an auto-generated
+                                               sweep for a target) solved in
+                                               one vmapped device launch —
+                                               strictly read-only, leader
+                                               only (forecasts the LEADER's
+                                               next decisions; standby state
+                                               may lag)
   GET  /apis/kueue/v1beta1/{section}           list objects w/ status
   POST /apis/kueue/v1beta1/{section}           upsert one object (webhook
                                                defaulting+validation applied)
@@ -575,7 +584,7 @@ _SECURED_ROUTES = frozenset(
     {
         "apply", "apply_batch", "delete", "delete_ns", "check_state",
         "reconcile", "solve", "metrics", "state", "debug_cycles",
-        "workload_decisions",
+        "workload_decisions", "plan",
     }
 )
 
@@ -631,6 +640,7 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/reconcile$"), "reconcile"),
     ("GET", re.compile(r"^/events/stream$"), "events_stream"),
     ("GET", re.compile(r"^/debug/cycles$"), "debug_cycles"),
+    ("POST", re.compile(r"^/debug/plan$"), "plan"),
     (
         "GET",
         re.compile(r"^/debug/workloads/([^/]+)/([^/]+)/decisions$"),
@@ -925,6 +935,25 @@ def _make_handler(srv: KueueServer):
                     t.to_dict() for t in srv.runtime.scheduler.last_traces
                 ]
             self._send_json({"cycles": traces})
+
+        def _h_plan(self, query):
+            """What-if capacity planner. Leader-only: a plan is a
+            forecast of the LEADER's next admission decisions — a
+            standby's state can lag its watch, so serving plans there
+            would produce confidently wrong answers. Strictly read-only
+            over the runtime (guardrail-tested: state dump and event
+            resourceVersion are byte-identical across a plan call)."""
+            srv.require_leader()
+            from kueue_tpu.planner import plan_request
+            from kueue_tpu.planner.scenarios import ScenarioApplyError
+
+            body = self._body()
+            with srv.lock:
+                try:
+                    report = plan_request(srv.runtime, body)
+                except (ScenarioApplyError, KeyError, ValueError) as e:
+                    raise ApiError(400, f"invalid plan request: {e}")
+            self._send_json(report)
 
         def _h_workload_decisions(self, ns, name, query):
             """Per-workload decision audit trail (oldest first). 404

@@ -149,6 +149,31 @@ class KueueClient:
             "GET", f"/debug/workloads/{namespace}/{name}/decisions"
         )
 
+    def plan(
+        self,
+        scenarios: Optional[list] = None,
+        workload: Optional[str] = None,
+        cluster_queue: Optional[str] = None,
+        options: Optional[dict] = None,
+    ) -> dict:
+        """What-if capacity plan (the `kueuectl plan` payload): POST
+        scenario deltas — or just a target, letting the server generate
+        the candidate-fix sweep — and get back ranked per-scenario
+        admission outcomes. Read-only; leader-only in HA mode."""
+        body: dict = {}
+        if scenarios is not None:
+            body["scenarios"] = scenarios
+        target = {}
+        if workload:
+            target["workload"] = workload
+        if cluster_queue:
+            target["clusterQueue"] = cluster_queue
+        if target:
+            body["target"] = target
+        if options:
+            body["options"] = options
+        return self._request("POST", "/debug/plan", body)
+
     # ---- events / watch ----
     def events(self, resource_version: int = 0) -> dict:
         """Recorded events newer than ``resource_version`` plus the

@@ -217,6 +217,7 @@ DASHBOARD_HTML = """<!doctype html>
 <h2>Last cycle</h2><div id="cycle"></div>
 <h2>ClusterQueues</h2><div id="cqs"></div>
 <h2>Why pending</h2><div id="why"></div>
+<h2>What would it take?</h2><div id="plan" class="muted">pick <b>plan</b> on a pending workload above to sweep candidate fixes (quota bumps, borrowing lifts) through the capacity planner</div>
 <h2>Workloads</h2><div id="wls"></div>
 <h2>LocalQueues</h2><div id="lqs"></div>
 <h2>Event stream</h2><div id="events"></div>
@@ -274,11 +275,12 @@ function render(d){
     ? '<span class="muted">nothing pending with a recorded decision</span>'
     : `<div class="tiles">${tally}</div>`+
       '<table><tr><th>workload</th><th>clusterQueue</th><th>reason</th>'+
-      '<th>seen</th><th>last cycle</th><th>message</th></tr>'+
+      '<th>seen</th><th>last cycle</th><th>message</th><th></th></tr>'+
       why.slice(0,200).map(p=>`<tr><td>${esc(p.workload)}</td>`+
         `<td>${esc(p.clusterQueue)}</td><td class="ev-Evicted">${esc(p.reason)}</td>`+
         `<td>&times;${p.count}</td><td>${p.lastCycle}</td>`+
-        `<td>${esc(p.message)}</td></tr>`).join('')+'</table>';
+        `<td>${esc(p.message)}</td>`+
+        `<td><a href="#plan" onclick="plan('${esc(p.workload)}');return true">plan</a></td></tr>`).join('')+'</table>';
   document.getElementById('wls').innerHTML = '<table><tr><th>workload</th><th>queue</th>'+
     '<th>priority</th><th>state</th><th>clusterQueue</th></tr>'+
     d.workloads.slice(0,500).map(w=>`<tr><td>${esc(w.key)}</td><td>${esc(w.queue)}</td>`+
@@ -295,6 +297,30 @@ function render(d){
 }
 async function refetch(){
   try { render(await (await fetch('/api/dashboard')).json()); } catch(e) {}
+}
+async function plan(key){            // the "What would it take?" panel
+  const el = document.getElementById('plan');
+  el.innerHTML = `<span class="muted">planning for ${esc(key)}&hellip;</span>`;
+  try {
+    const r = await fetch('/debug/plan', {method:'POST',
+      headers:{'Content-Type':'application/json'},
+      body: JSON.stringify({target:{workload:key},
+                            options:{includeReasons:'baseline'}})});
+    if (!r.ok) throw new Error((await r.json()).error || r.status);
+    const d = await r.json();
+    const rec = d.recommended
+      ? `<p>Recommended: <b>${esc(d.recommended)}</b></p>`
+      : '<p class="muted">no evaluated scenario admits anything the baseline does not</p>';
+    el.innerHTML = `<p class="muted">target ${esc(key)} &middot; `+
+      `${d.heads} heads &middot; ${d.backend} &middot; ${d.durationMs} ms</p>`+rec+
+      '<table><tr><th>scenario</th><th>admits</th><th>new</th>'+
+      '<th>preempt</th><th>borrow</th><th>deltas</th></tr>'+
+      d.scenarios.map(s=>`<tr><td>${esc(s.name)}${s.baseline?' *':''}</td>`+
+        `<td>${s.admitted.length}</td><td>+${s.newlyAdmitted.length}</td>`+
+        `<td>${s.preemptionCandidates}</td><td>${s.borrowing}</td>`+
+        `<td><code>${s.deltas.map(esc).join('; ')}</code></td></tr>`).join('')+
+      '</table>';
+  } catch(e) { el.innerHTML = `<span class="ev-Evicted">plan failed: ${esc(e.message||e)}</span>`; }
 }
 let refetchTimer = null;
 function scheduleRefetch(){          // debounce: one fetch per burst of events

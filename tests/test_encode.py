@@ -1,0 +1,187 @@
+"""Shared snapshot <-> device-array codec (core/encode.py).
+
+The ISSUE 3 round-trip satellite: the encoding the live cycle, the bulk
+drain and the capacity planner consume is ONE definition — encode a
+snapshot, decode it back, and the result must be an equal, independent,
+fully functional Snapshot. Divergence here would let the planner
+forecast a cluster the scheduler isn't actually running.
+"""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.core.encode import (
+    decode_snapshot,
+    device_arrays,
+    encode_snapshot,
+)
+from kueue_tpu.core.snapshot import take_snapshot
+from kueue_tpu.models import (
+    ClusterQueue,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+from kueue_tpu.models.cohort import Cohort
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.utils.clock import FakeClock
+
+
+def _runtime():
+    """Two-level cohort forest with borrowing limits, two flavors,
+    admitted usage — enough structure that every encoded field is
+    non-trivial."""
+    rt = ClusterRuntime(clock=FakeClock(1000.0))
+    rt.add_flavor(ResourceFlavor(name="on-demand"))
+    rt.add_flavor(ResourceFlavor(name="spot"))
+    rt.add_cohort(Cohort(name="root"))
+    rt.add_cohort(Cohort(name="team", parent="root"))
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq-a",
+            cohort="team",
+            namespace_selector={},
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu", "memory"),
+                    (
+                        FlavorQuotas.build(
+                            "on-demand",
+                            {"cpu": ("4", "2", "1"), "memory": "8Gi"},
+                        ),
+                        FlavorQuotas.build("spot", {"cpu": "2", "memory": "4Gi"}),
+                    ),
+                ),
+            ),
+        )
+    )
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq-b",
+            cohort="root",
+            namespace_selector={},
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu",), (FlavorQuotas.build("on-demand", {"cpu": "8"}),)
+                ),
+            ),
+        )
+    )
+    rt.add_local_queue(LocalQueue(namespace="ns", name="lq-a", cluster_queue="cq-a"))
+    rt.add_local_queue(LocalQueue(namespace="ns", name="lq-b", cluster_queue="cq-b"))
+    rt.add_workload(
+        Workload(
+            namespace="ns", name="running", queue_name="lq-a", priority=1,
+            creation_time=0.0,
+            pod_sets=(PodSet.build("main", 1, {"cpu": "2", "memory": "2Gi"}),),
+        )
+    )
+    rt.run_until_idle()
+    assert rt.workloads["ns/running"].is_admitted
+    return rt
+
+
+class TestRoundTrip:
+    def test_encode_decode_equal_snapshot(self):
+        snap = take_snapshot(_runtime().cache)
+        enc = encode_snapshot(snap)
+        back = decode_snapshot(enc)
+
+        # identity / forest structure
+        assert back.flat.cq_names == snap.flat.cq_names
+        assert back.flat.cohort_names == snap.flat.cohort_names
+        assert back.flat.index == snap.flat.index
+        assert np.array_equal(back.flat.parent, snap.flat.parent)
+        assert np.array_equal(back.flat.depth, snap.flat.depth)
+        assert back.flat.max_depth == snap.flat.max_depth
+        assert np.array_equal(back._lm(), snap._lm())
+
+        # quota cells and derived trees
+        assert back.fr_list == snap.fr_list
+        assert back.fr_index == snap.fr_index
+        assert back.resource_names == snap.resource_names
+        assert np.array_equal(back.resource_index, snap.resource_index)
+        for field in (
+            "nominal", "lending_limit", "borrowing_limit",
+            "subtree", "guaranteed", "local_usage", "weight_milli",
+        ):
+            assert np.array_equal(getattr(back, field), getattr(snap, field)), field
+
+        # host-object carry-over
+        assert set(back.cq_models) == set(snap.cq_models)
+        assert back.generations == snap.generations
+        assert back.inactive_cqs == snap.inactive_cqs
+        assert set(back.workloads) == set(snap.workloads)
+        for key, ws in snap.workloads.items():
+            assert np.array_equal(back.workloads[key].usage_vec, ws.usage_vec)
+
+    def test_encode_is_view_decode_is_copy(self):
+        snap = take_snapshot(_runtime().cache)
+        enc = encode_snapshot(snap)
+        # encode is zero-copy: the hot path pays nothing
+        assert enc.nominal is snap.nominal
+        assert enc.local_usage is snap.local_usage
+        # decode is independent: mutating the decoded snapshot (the
+        # planner's per-scenario simulations) never touches the source
+        back = decode_snapshot(enc)
+        vec = back.vector_of({})
+        back.nominal[0, 0] += 1000
+        back.add_usage(back.flat.cq_names[0], vec)
+        assert np.array_equal(enc.nominal, snap.nominal)
+        assert np.array_equal(enc.local_usage, snap.local_usage)
+
+    def test_decoded_snapshot_is_functional(self):
+        """The decoded snapshot must answer the same admission
+        questions as the original — fits/available/borrowing drive the
+        planner's forecast simulation."""
+        snap = take_snapshot(_runtime().cache)
+        back = decode_snapshot(encode_snapshot(snap))
+        for cq in snap.flat.cq_names:
+            assert np.array_equal(
+                back.available_for(cq), snap.available_for(cq)
+            ), cq
+            probe = np.zeros(len(snap.fr_list), dtype=np.int64)
+            probe[0] = 1000
+            assert back.fits(cq, probe) == snap.fits(cq, probe), cq
+            assert back.is_borrowing(cq) == snap.is_borrowing(cq), cq
+        # usage bubbles identically through the cohort tree
+        cq = snap.flat.cq_names[0]
+        vec = np.zeros(len(snap.fr_list), dtype=np.int64)
+        vec[0] = 2000
+        snap.add_usage(cq, vec)
+        back.add_usage(cq, vec)
+        assert np.array_equal(back.usage(), snap.usage())
+        snap.remove_usage(cq, vec)
+        back.remove_usage(cq, vec)
+        assert np.array_equal(back.usage(), snap.usage())
+
+    def test_with_quota_variant_shares_structure(self):
+        snap = take_snapshot(_runtime().cache)
+        enc = encode_snapshot(snap)
+        bumped = enc.nominal.copy()
+        bumped[0, 0] += 4000
+        variant = enc.with_quota(nominal=bumped)
+        assert variant.parent is enc.parent  # structure is shared
+        assert variant.lending_limit is enc.lending_limit
+        back = decode_snapshot(variant)
+        assert back.nominal[0, 0] == snap.nominal[0, 0] + 4000
+        # untouched cells identical
+        assert np.array_equal(back.nominal[1:], snap.nominal[1:])
+
+    def test_device_arrays_match_solver_tree(self):
+        """tree_arrays (the scheduler's device inputs) now routes
+        through encode — the two consumers read the same bytes."""
+        from kueue_tpu.core.solver import tree_arrays
+
+        snap = take_snapshot(_runtime().cache)
+        tree, paths, roots = tree_arrays(snap)
+        tree2, paths2, roots2 = device_arrays(encode_snapshot(snap))
+        assert np.array_equal(np.asarray(tree.nominal), np.asarray(tree2.nominal))
+        assert np.array_equal(np.asarray(tree.parent), np.asarray(tree2.parent))
+        assert np.array_equal(
+            np.asarray(tree.level_mask), np.asarray(tree2.level_mask)
+        )
+        assert np.array_equal(np.asarray(paths), np.asarray(paths2))
+        assert np.array_equal(roots, roots2)

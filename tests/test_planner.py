@@ -1,0 +1,660 @@
+"""What-if capacity planner (kueue_tpu/planner) — ISSUE 3.
+
+Covers the scenario-delta vocabulary and wire codec, the no-op-delta
+differential (a batch of identical no-op scenarios must reproduce the
+live scheduler's next-cycle outcome bit-for-bit on BOTH the host and
+the vmapped device path, including canonical InadmissibleReasons), the
+forecast-validation loop against perf/runner's virtual clock, the
+strictly-read-only `/debug/plan` guardrail (byte-identical state dump
+and event resourceVersion, 503 on a non-leader replica), the
+`kueue_planner_*` metrics exposition lint, and the `kueuectl plan`
+surface (server + offline state-replay modes).
+"""
+
+import contextlib
+import io
+import json
+import re
+
+import numpy as np
+import pytest
+
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.models import (
+    ClusterQueue,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+from kueue_tpu.models.constants import InadmissibleReason
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.planner import (
+    BorrowingLimitDelta,
+    DrainDomainDelta,
+    FairShareWeightDelta,
+    FlavorCapacityDelta,
+    LendingLimitDelta,
+    NominalQuotaDelta,
+    Planner,
+    PlanScenario,
+    PriorityDelta,
+    delta_from_dict,
+    plan_request,
+    scenario_from_dict,
+)
+from kueue_tpu.planner.scenarios import ScenarioApplyError
+from kueue_tpu.utils.clock import FakeClock
+
+
+def _cq(name, cpu="4", cohort=None, borrowing=None, lending=None):
+    return ClusterQueue(
+        name=name,
+        cohort=cohort,
+        namespace_selector={},
+        resource_groups=(
+            ResourceGroup(
+                ("cpu",),
+                (FlavorQuotas.build("default", {"cpu": (cpu, borrowing, lending)}),),
+            ),
+        ),
+    )
+
+
+def _wl(name, cpu="2", lq="lq-a", priority=0, created=0.0):
+    return Workload(
+        namespace="ns", name=name, queue_name=lq, priority=priority,
+        creation_time=created,
+        pod_sets=(PodSet.build("main", 1, {"cpu": cpu}),),
+    )
+
+
+def _runtime(workloads=(), settle=True):
+    """Cohort of two CQs (cq-a cannot borrow, cq-b can lend)."""
+    rt = ClusterRuntime(clock=FakeClock(1000.0))
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(_cq("cq-a", cpu="4", cohort="co", borrowing="0"))
+    rt.add_cluster_queue(_cq("cq-b", cpu="4", cohort="co"))
+    rt.add_local_queue(LocalQueue(namespace="ns", name="lq-a", cluster_queue="cq-a"))
+    rt.add_local_queue(LocalQueue(namespace="ns", name="lq-b", cluster_queue="cq-b"))
+    for wl in workloads:
+        rt.add_workload(wl)
+    if settle:
+        rt.run_until_idle()
+    return rt
+
+
+def _stuck_runtime():
+    """One admitted workload, one stuck: ns/big needs 8 cpus against
+    cq-a's nominal 4 with borrowing disabled — only a config change
+    admits it."""
+    return _runtime([
+        _wl("small", cpu="2", created=0.0),
+        _wl("big", cpu="8", created=1.0),
+    ])
+
+
+class TestScenarioDeltas:
+    def _view(self):
+        from kueue_tpu.core.encode import encode_snapshot
+        from kueue_tpu.core.snapshot import take_snapshot
+        from kueue_tpu.planner.scenarios import ArrayView
+
+        rt = _stuck_runtime()
+        snap = take_snapshot(rt.cache)
+        enc = encode_snapshot(snap)
+        row_index = {name: i for i, name in enumerate(enc.cq_names)}
+        for j, name in enumerate(enc.cohort_names):
+            row_index[name] = enc.n_cq + j
+        return snap, ArrayView(
+            nominal=enc.nominal.copy(),
+            lending=enc.lending_limit.copy(),
+            borrowing=enc.borrowing_limit.copy(),
+            usage=enc.local_usage.copy(),
+            priority=np.zeros(4, dtype=np.int64),
+            weight=enc.weight_milli.copy(),
+            row_index=row_index,
+            fr_index=snap.fr_index,
+            head_slots={"ns/big": [1]},
+            n_cq=enc.n_cq,
+        )
+
+    def test_quota_delta_clamps_at_zero(self):
+        snap, view = self._view()
+        r = view.row("cq-a")
+        j = view.cell("default", "cpu")
+        before = int(view.nominal[r, j])
+        NominalQuotaDelta("cq-a", "default", "cpu", 4000).apply(view)
+        assert view.nominal[r, j] == before + 4000
+        NominalQuotaDelta("cq-a", "default", "cpu", -10**9).apply(view)
+        assert view.nominal[r, j] == 0
+
+    def test_flavor_removal_and_limits(self):
+        from kueue_tpu.ops.quota import NO_LIMIT
+
+        snap, view = self._view()
+        r = view.row("cq-b")
+        j = view.cell("default", "cpu")
+        FlavorCapacityDelta.build("cq-b", "default", None).apply(view)
+        assert view.nominal[r, j] == 0
+        BorrowingLimitDelta("cq-a", "default", "cpu", None).apply(view)
+        assert view.borrowing[view.row("cq-a"), j] == NO_LIMIT
+        LendingLimitDelta("cq-b", "default", "cpu", 1000).apply(view)
+        assert view.lending[r, j] == 1000
+        FairShareWeightDelta("cq-b", 2500).apply(view)
+        assert view.weight[r] == 2500
+        PriorityDelta("ns/big", 100).apply(view)
+        assert view.priority[1] == 100
+
+    def test_drain_domain_subtracts_across_rows(self):
+        snap, view = self._view()
+        j = view.cell("default", "cpu")
+        total_before = int(view.nominal[: view.n_cq, j].sum())
+        DrainDomainDelta.build("default", {"cpu": 6000}, domain="rack-1").apply(view)
+        assert int(view.nominal[: view.n_cq, j].sum()) == total_before - 6000
+
+    def test_unknown_references_raise(self):
+        snap, view = self._view()
+        with pytest.raises(ScenarioApplyError):
+            NominalQuotaDelta("ghost", "default", "cpu", 1).apply(view)
+        with pytest.raises(ScenarioApplyError):
+            NominalQuotaDelta("cq-a", "default", "gpu", 1).apply(view)
+        with pytest.raises(ScenarioApplyError):
+            PriorityDelta("ns/ghost", 1).apply(view)
+        with pytest.raises(ScenarioApplyError):
+            delta_from_dict({"kind": "warp-drive"})
+
+    def test_wire_codec_round_trip(self):
+        deltas = [
+            NominalQuotaDelta("cq-a", "default", "cpu", -2000),
+            FlavorCapacityDelta.build("cq-a", "default", {"cpu": 1000}),
+            FlavorCapacityDelta.build("cq-a", "default", None),
+            LendingLimitDelta("cq-b", "default", "cpu", 5),
+            BorrowingLimitDelta("cq-a", "default", "cpu", None),
+            FairShareWeightDelta("cq-b", 1500),
+            PriorityDelta("ns/big", 7),
+            DrainDomainDelta.build("default", {"cpu": 4000}, domain="rack-2"),
+        ]
+        for d in deltas:
+            assert delta_from_dict(d.to_dict()) == d, d
+        scen = PlanScenario(name="mix", deltas=tuple(deltas))
+        back = scenario_from_dict(scen.to_dict())
+        assert back == scen
+        assert len(scen.describe()) == len(deltas)
+
+
+class TestNoOpDifferential:
+    """ISSUE 3 satellite: N identical no-op scenarios must all equal
+    the live scheduler's next-cycle outcome bit-for-bit, host vs
+    vmapped device paths, reasons included."""
+
+    def _pending_runtime(self):
+        # backlog with admissible and quota-blocked heads, NO settling:
+        # the next cycle is still ahead of us
+        return _runtime(
+            [
+                _wl("a1", cpu="2", lq="lq-a", priority=10, created=0.0),
+                _wl("a2", cpu="8", lq="lq-a", priority=5, created=1.0),
+                _wl("b1", cpu="3", lq="lq-b", priority=0, created=2.0),
+                _wl("b2", cpu="3", lq="lq-b", priority=0, created=3.0),
+            ],
+            settle=False,
+        )
+
+    def test_noop_scenarios_equal_next_cycle(self):
+        rt = self._pending_runtime()
+        planner = Planner.for_runtime(rt)
+        noops = [PlanScenario(name=f"noop-{i}") for i in range(6)]
+        # device path with per-scenario host verification = bit-for-bit
+        report = planner.plan(
+            scenarios=noops, heads_mode="cycle",
+            include_reasons="all", verify_host=True,
+        )
+        base = report.baseline
+        for o in report.scenarios:
+            assert o.admitted == base.admitted
+            assert o.pending == base.pending
+            assert o.newly_admitted == [] and o.lost == []
+            assert o.borrowing == base.borrowing
+            assert o.reserved == base.reserved
+            assert o.preemption_candidates == base.preemption_candidates
+            assert o.utilization == base.utilization
+
+        # pure-host plan agrees with the device plan
+        host = planner.plan(
+            scenarios=noops, heads_mode="cycle",
+            include_reasons="all", use_device=False,
+        )
+        assert host.backend == "host" and report.backend == "device"
+        for a, b in zip(report.scenarios, host.scenarios):
+            assert a.name == b.name
+            assert a.admitted == b.admitted
+            assert a.pending == b.pending
+            assert a.reasons == b.reasons
+
+        # ... and both agree with what the scheduler ACTUALLY does next
+        result = rt.scheduler.schedule()
+        cycle_admitted = sorted(e.workload.key for e in result.admitted)
+        assert base.admitted == cycle_admitted
+        # canonical reasons for the still-pending heads match the audit
+        # trail the live cycle just recorded (PR 2 enum end-to-end)
+        for key in base.pending:
+            recs = rt.audit.for_workload(key)
+            assert recs, key
+            assert base.reasons[key]["reason"] == recs[-1].reason.value, key
+
+    def test_noop_differential_full_backlog(self):
+        """backlog mode: every pending workload (not just CQ heads)
+        solves; a no-op sweep still matches the drained fixed point."""
+        rt = self._pending_runtime()
+        planner = Planner.for_runtime(rt)
+        report = planner.plan(
+            scenarios=[PlanScenario(name=f"noop-{i}") for i in range(4)],
+            heads_mode="backlog", verify_host=True,
+        )
+        rt.run_until_idle()
+        actually_admitted = sorted(
+            k for k, wl in rt.workloads.items() if wl.is_admitted
+        )
+        for o in report.scenarios:
+            assert o.admitted == actually_admitted
+        # the quota-blocked head stays pending everywhere
+        assert "ns/a2" in report.baseline.pending
+
+
+class TestWhatWouldItTake:
+    """The acceptance-criterion loop: a quota-rejected workload, and a
+    sweep that names the scenario admitting it."""
+
+    def test_target_workload_recommendation(self):
+        rt = _stuck_runtime()
+        assert not rt.workloads["ns/big"].is_admitted
+        planner = Planner.for_runtime(rt)
+        report = planner.plan(
+            target_workload="ns/big", include_reasons="all", verify_host=True
+        )
+        assert "ns/big" in report.baseline.pending
+        assert report.recommended is not None
+        rec = report.scenario(report.recommended)
+        assert "ns/big" in rec.newly_admitted
+        assert rec.deltas  # a concrete, applicable config change
+        # baseline names the canonical reason it is stuck today
+        assert report.baseline.reasons["ns/big"]["reason"] in (
+            InadmissibleReason.REQUEST_EXCEEDS_CAPACITY.value,
+            InadmissibleReason.INSUFFICIENT_QUOTA.value,
+        )
+
+    def test_cluster_queue_sweep(self):
+        # big alone against an empty cq-a: the +100% sweep point (4->8
+        # cpus) is exactly enough
+        rt = _runtime([_wl("big", cpu="8", created=0.0)])
+        planner = Planner.for_runtime(rt)
+        report = planner.plan(target_cq="cq-a", verify_host=True)
+        assert len(report.scenarios) > 1
+        admitting = [o for o in report.scenarios if "ns/big" in o.newly_admitted]
+        assert admitting, "a +100% cq-a sweep must admit ns/big"
+
+    def test_ranking_prefers_cheapest_admitting_scenario(self):
+        rt = _stuck_runtime()
+        planner = Planner.for_runtime(rt)
+        sweep = Planner.quota_sweep("cq-a", "default", "cpu", [2000, 8000, 64000])
+        report = planner.plan(scenarios=sweep, target_workload="ns/big")
+        rec = report.scenario(report.recommended)
+        assert "ns/big" in rec.admitted
+        # both +8000 and +64000 admit it; the cheaper intervention wins
+        assert report.recommended == "cq-a/default/cpu +8000"
+
+    def test_scenario_apply_error_does_not_crash_plan(self):
+        rt = _stuck_runtime()
+        planner = Planner.for_runtime(rt)
+        with pytest.raises(ScenarioApplyError):
+            planner.plan(
+                scenarios=[
+                    PlanScenario(
+                        name="bad",
+                        deltas=(NominalQuotaDelta("ghost", "default", "cpu", 1),),
+                    )
+                ]
+            )
+
+
+class TestForecast:
+    def test_forecast_validated_against_perf_runner(self):
+        """ISSUE 3 satellite: apply the planner-recommended quota delta
+        to a real runtime and drive perf/runner; the measured mean
+        time-to-admission must fall inside the planner's forecast band."""
+        from kueue_tpu.core.cache import Cache
+        from kueue_tpu.core.queue_manager import QueueManager
+        from kueue_tpu.perf.generator import (
+            CohortClass,
+            GeneratorConfig,
+            QueueSetClass,
+            WorkloadClass,
+            WorkloadSet,
+            generate,
+            override_nominal_cpu,
+        )
+        from kueue_tpu.perf.runner import run
+        from kueue_tpu.models.constants import (
+            PreemptionPolicy,
+            ReclaimWithinCohortPolicy,
+        )
+
+        # compact variant of the default generator world: one cohort,
+        # two CQs, all arrivals at t=0, 60s runtimes, no preemption
+        cfg = GeneratorConfig(
+            cohorts=(
+                CohortClass(
+                    class_name="cohort", count=1,
+                    queue_sets=(
+                        QueueSetClass(
+                            class_name="cq", count=2,
+                            nominal_quota=8, borrowing_limit=0,
+                            reclaim_within_cohort=ReclaimWithinCohortPolicy.NEVER,
+                            within_cluster_queue=PreemptionPolicy.NEVER,
+                            workload_sets=(
+                                WorkloadSet(
+                                    12, 0, (WorkloadClass("small", 60_000, 0, 4),)
+                                ),
+                            ),
+                        ),
+                    ),
+                ),
+            )
+        )
+        scenario = generate(cfg)
+        runtimes = {gw.workload.key: gw.runtime_s for gw in scenario.workloads}
+
+        # a live runtime holding the same pending world at t=0
+        cache = Cache()
+        queues = QueueManager(FakeClock(0.0))
+        cache.add_or_update_flavor(scenario.flavor)
+        for cq in scenario.cluster_queues:
+            cache.add_or_update_cluster_queue(cq)
+            queues.add_cluster_queue(cq)
+        for lq in scenario.local_queues:
+            cache.add_or_update_local_queue(lq)
+            queues.add_local_queue(lq)
+        for gw in scenario.workloads:
+            queues.add_or_update_workload(gw.workload)
+
+        planner = Planner(cache=cache, queues=queues)
+        cq_names = [cq.name for cq in scenario.cluster_queues]
+        bump = PlanScenario(
+            name="double both CQs",
+            deltas=tuple(
+                NominalQuotaDelta(n, "default", "cpu", 8000) for n in cq_names
+            ),
+        )
+        report = planner.plan(
+            scenarios=[bump],
+            forecast=True,
+            runtime_hint=lambda wl: runtimes[wl.key],
+            verify_host=True,
+        )
+        fc = report.scenario("double both CQs").forecast
+        lo, hi = fc["band"]
+        assert hi > lo >= 0.0
+
+        # drive the REAL runtime with the recommended delta applied
+        measured = run(
+            cfg,
+            scenario_mutator=lambda s: override_nominal_cpu(
+                s, {n: 16 for n in cq_names}
+            ),
+        )
+        assert measured.admitted == measured.total
+        ttas = [t for vals in measured.time_to_admission.values() for t in vals]
+        mean_tta = sum(ttas) / len(ttas)
+        assert lo <= mean_tta <= hi, (
+            f"measured mean tta {mean_tta}s outside forecast band "
+            f"[{lo}, {hi}] (forecast mean {fc['mean']})"
+        )
+        # the forecast point estimate is itself inside a 2x factor
+        assert fc["mean"] == pytest.approx(mean_tta, rel=1.0)
+
+    def test_forecast_improves_with_quota(self):
+        """More capacity must never slow the forecast down."""
+        rt = _runtime(
+            [_wl(f"w{i}", cpu="2", created=float(i)) for i in range(8)]
+        )
+        planner = Planner.for_runtime(rt)
+        sweep = Planner.quota_sweep("cq-a", "default", "cpu", [0, 8000])
+        report = planner.plan(
+            scenarios=sweep, forecast=True, runtime_hint=lambda wl: 100.0
+        )
+        base = report.scenario("cq-a/default/cpu +0").forecast
+        more = report.scenario("cq-a/default/cpu +8000").forecast
+        assert more["mean"] <= base["mean"]
+
+
+class TestServerGuardrail:
+    """ISSUE 3 satellite: /debug/plan is strictly read-only and
+    leader-only."""
+
+    def test_plan_request_mutates_nothing(self):
+        from kueue_tpu.server import KueueClient, KueueServer
+
+        rt = _stuck_runtime()
+        srv = KueueServer(runtime=rt)
+        port = srv.start()
+        try:
+            client = KueueClient(f"http://127.0.0.1:{port}")
+            state_before = client.state()
+            rv_before = client.events()["resourceVersion"]
+            report = client.plan(
+                workload="ns/big",
+                options={"includeReasons": "all", "forecast": True,
+                         "runtimeHintSeconds": 60.0},
+            )
+            assert report["recommended"]
+            rec = next(
+                s for s in report["scenarios"]
+                if s["name"] == report["recommended"]
+            )
+            assert "ns/big" in rec["newlyAdmitted"]
+            # byte-identical state dump + unchanged resourceVersion
+            state_after = client.state()
+            assert json.dumps(state_after, sort_keys=True) == json.dumps(
+                state_before, sort_keys=True
+            )
+            assert client.events()["resourceVersion"] == rv_before
+            # explicit scenario bodies exercise the wire codec
+            r2 = client.plan(
+                scenarios=[{
+                    "name": "bump",
+                    "deltas": [{
+                        "kind": "quota", "node": "cq-a",
+                        "flavor": "default", "resource": "cpu",
+                        "delta": 8000,
+                    }],
+                }],
+            )
+            assert "ns/big" in r2["scenarios"][0]["admitted"] or any(
+                "ns/big" in s["admitted"] for s in r2["scenarios"]
+            )
+            assert json.dumps(client.state(), sort_keys=True) == json.dumps(
+                state_before, sort_keys=True
+            )
+        finally:
+            srv.stop()
+
+    def test_invalid_plan_body_is_400(self):
+        from kueue_tpu.server import KueueClient, KueueServer
+        from kueue_tpu.server.client import ClientError
+
+        srv = KueueServer(runtime=_stuck_runtime())
+        port = srv.start()
+        try:
+            client = KueueClient(f"http://127.0.0.1:{port}")
+            with pytest.raises(ClientError) as ei:
+                client.plan(scenarios=[{
+                    "name": "bad",
+                    "deltas": [{"kind": "quota", "node": "ghost",
+                                "flavor": "default", "resource": "cpu",
+                                "delta": 1}],
+                }])
+            assert ei.value.status == 400
+        finally:
+            srv.stop()
+
+    def test_plan_rejected_on_non_leader(self, tmp_path):
+        import time
+
+        from kueue_tpu.server import KueueClient, KueueServer
+        from kueue_tpu.server.client import ClientError
+        from kueue_tpu.utils.lease import FileLease, LeaderElector
+
+        lease = str(tmp_path / "leader.lease")
+        leader = KueueServer(
+            elector=LeaderElector(FileLease(lease, "rep-1", duration=15.0))
+        )
+        leader.start()
+        deadline = time.time() + 10
+        while not leader.elector.is_leader and time.time() < deadline:
+            time.sleep(0.05)
+        assert leader.elector.is_leader
+        standby = KueueServer(
+            elector=LeaderElector(FileLease(lease, "rep-2", duration=15.0))
+        )
+        standby.start()
+        try:
+            sc = KueueClient(f"http://127.0.0.1:{standby.port}")
+            with pytest.raises(ClientError) as ei:
+                sc.plan(cluster_queue="anything")
+            assert ei.value.status == 503
+        finally:
+            standby.stop()
+            leader.stop()
+
+
+# one Prometheus exposition line: name{labels} value (the shared lint
+# grammar from tests/test_observability.py)
+_SERIES_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r" (?:[+-]?(?:\d+(?:\.\d+)?(?:e[+-]?\d+)?|Inf|NaN))$"
+)
+
+
+class TestPlannerMetrics:
+    def test_planner_metrics_exposed_and_lint_clean(self):
+        rt = _stuck_runtime()
+        planner = Planner.for_runtime(rt)
+        planner.plan(target_workload="ns/big")
+        planner.plan(target_cq="cq-a", use_device=False)
+        text = rt.metrics.registry.expose()
+        assert 'kueue_planner_runs_total{target="workload"} 1' in text
+        assert 'kueue_planner_runs_total{target="clusterqueue"} 1' in text
+        assert "kueue_planner_scenarios_total" in text
+        assert "kueue_planner_last_scenarios" in text
+        assert 'kueue_planner_duration_seconds_count{path="device"} 1' in text
+        assert 'kueue_planner_duration_seconds_count{path="host"} 1' in text
+        # every planner series obeys the exposition grammar
+        planner_lines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("kueue_planner_")
+        ]
+        assert planner_lines
+        for ln in planner_lines:
+            assert _SERIES_RE.match(ln), f"bad series line: {ln!r}"
+        # HELP/TYPE preamble present for each planner metric family
+        for fam in (
+            "kueue_planner_runs_total",
+            "kueue_planner_scenarios_total",
+            "kueue_planner_duration_seconds",
+            "kueue_planner_last_scenarios",
+        ):
+            assert f"# HELP {fam} " in text, fam
+            assert f"# TYPE {fam} " in text, fam
+
+
+class TestCli:
+    def test_plan_server_mode_renders_recommendation(self, tmp_path):
+        from kueue_tpu.cli.__main__ import main
+        from kueue_tpu.server import KueueServer
+
+        rt = _stuck_runtime()
+        srv = KueueServer(runtime=rt)
+        port = srv.start()
+        try:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = main([
+                    "--state", str(tmp_path / "state.json"),
+                    "plan", "big", "-n", "ns",
+                    "--forecast", "--runtime-hint", "60",
+                    "--server", f"http://127.0.0.1:{port}",
+                ])
+            text = buf.getvalue()
+            assert rc == 0
+            assert "Recommended:" in text
+            assert "quota" in text
+            assert "would admit: ns/big" in text
+            assert "baseline" in text
+        finally:
+            srv.stop()
+
+    def test_plan_offline_state_mode_is_read_only(self, tmp_path):
+        from kueue_tpu import serialization as ser
+        from kueue_tpu.cli.__main__ import main
+
+        state = {
+            "resourceFlavors": [{"name": "default"}],
+            "clusterQueues": [
+                {
+                    "name": "cq", "namespaceSelector": {},
+                    "resourceGroups": [{
+                        "coveredResources": ["cpu"],
+                        "flavors": [{
+                            "name": "default",
+                            "resources": [{"name": "cpu", "nominalQuota": "1"}],
+                        }],
+                    }],
+                }
+            ],
+            "localQueues": [
+                {"name": "lq", "namespace": "ns", "clusterQueue": "cq"}
+            ],
+            "workloads": [
+                ser.workload_to_dict(_wl("starved", cpu="2", lq="lq"))
+            ],
+        }
+        path = tmp_path / "state.json"
+        path.write_text(json.dumps(state))
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = main(["--state", str(path), "plan", "starved", "-n", "ns"])
+        text = buf.getvalue()
+        assert rc == 0
+        assert "Recommended:" in text
+        assert "ns/starved" in text
+        # offline plan is a read-only what-if: the state file is intact
+        assert json.loads(path.read_text()) == state
+
+    def test_plan_requires_a_target(self, tmp_path):
+        from kueue_tpu.cli.__main__ import main
+
+        with pytest.raises(SystemExit):
+            main(["--state", str(tmp_path / "state.json"), "plan"])
+
+
+class TestPlanRequestWire:
+    def test_plan_request_auto_sweep_for_cq(self):
+        rt = _stuck_runtime()
+        out = plan_request(rt, {"target": {"clusterQueue": "cq-a"}})
+        assert out["targetClusterQueue"] == "cq-a"
+        assert len(out["scenarios"]) > 1
+        assert out["launches"] == 1
+        assert out["scenariosPerSecond"] is None or out["scenariosPerSecond"] > 0
+
+    def test_plan_request_verify_host_option(self):
+        rt = _stuck_runtime()
+        out = plan_request(
+            rt,
+            {
+                "target": {"workload": "ns/big"},
+                "options": {"verifyHost": True, "includeReasons": "baseline"},
+            },
+        )
+        assert out["recommended"]
