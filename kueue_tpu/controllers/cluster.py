@@ -80,6 +80,14 @@ class ClusterRuntime:
 
         self.audit = DecisionAuditLog(clock=self.clock)
         self.audit.observers.append(self._record_decision_metric)
+        # Durable-state spine (kueue_tpu/storage): when a Journal is
+        # attached (attach_journal), every state mutation appends a
+        # record stamped with this monotone resourceVersion, and
+        # recovery replays records newer than the last checkpoint.
+        # None = checkpoint-only durability (the pre-journal behavior).
+        self.journal = None
+        self.resource_version = 0
+        self._journal_degraded_seen = False
         self.pods_ready_cfg = wait_for_pods_ready or WaitForPodsReadyConfig()
         # resource adjustment pipeline stores (pkg/workload/resources.go)
         self.limit_ranges: Dict[str, "object"] = {}  # key -> LimitRange
@@ -166,6 +174,79 @@ class ClusterRuntime:
         p.metrics_hook = self._record_preemption
         return p
 
+    # ---- durable-state journaling (kueue_tpu/storage) ----
+    def attach_journal(self, journal) -> None:
+        """Start journaling every mutation to ``journal`` (an opened
+        storage.Journal). Wire AFTER recovery: replay applies records
+        through the same mutation methods and must not re-append."""
+        journal.metrics = self.metrics
+        self.journal = journal
+        self.metrics.journal_degraded.set(1 if journal.degraded else 0)
+        self.metrics.journal_segments.set(journal.stats().segments)
+
+    def _journal_append(self, rtype: str, data: dict) -> None:
+        j = self.journal
+        if j is None:
+            return
+        self.resource_version += 1
+        rec = j.append(rtype, data, rv=self.resource_version)
+        if j.degraded != self._journal_degraded_seen:
+            # flip (either direction) is an operator-visible transition:
+            # event + gauge; /healthz reads the journal stats directly
+            self._journal_degraded_seen = j.degraded
+            self.metrics.journal_degraded.set(1 if j.degraded else 0)
+            if j.degraded:
+                self.events.record(
+                    "JournalDegraded", "control-plane/journal",
+                    f"journal append failed ({j.last_error}); persistence "
+                    "degraded to checkpoint-only until writes succeed",
+                    regarding_kind="ControlPlane",
+                )
+            else:
+                self.events.record(
+                    "JournalRecovered", "control-plane/journal",
+                    "journal writes succeeding again; full durability "
+                    "restored",
+                    regarding_kind="ControlPlane",
+                )
+        if rec is not None:
+            # the record is durable (or at least queued to the OS) but
+            # the in-memory apply that follows has not completed — the
+            # exact window recovery's replay must close
+            from kueue_tpu.testing import faults
+
+            faults.fire("journal.post_append_pre_apply")
+
+    def _journal_wl(self, wl: Workload, require_stored: bool = False) -> None:
+        if self.journal is None:
+            return
+        if require_stored and wl.key not in self.workloads:
+            # an upsert record for an already-deleted workload would
+            # resurrect it at replay
+            return
+        from kueue_tpu import serialization as ser
+
+        self._journal_append("workload_upsert", ser.workload_to_dict(wl))
+
+    def _journal_wl_delete(self, key: str) -> None:
+        if self.journal is None:
+            return
+        self._journal_append("workload_delete", {"key": key})
+
+    def _journal_obj(self, section: str, obj: dict) -> None:
+        if self.journal is None:
+            return
+        self._journal_append(
+            "object_upsert", {"section": section, "object": obj}
+        )
+
+    def _journal_obj_delete(self, section: str, key: str) -> None:
+        if self.journal is None:
+            return
+        self._journal_append(
+            "object_delete", {"section": section, "key": key}
+        )
+
     # ---- events ----
     def event(self, kind: str, wl: Workload, message: str = "") -> None:
         self.events.record(kind, wl.key, message)
@@ -175,6 +256,13 @@ class ClusterRuntime:
         # index refreshes here — every transition emits an event
         if wl.key in self.workloads:
             self.indexer.update(wl.key, wl)
+            # the event IS the durable-write moment for in-place status
+            # transitions (admission applied, eviction, check flips).
+            # "Pending" is excluded: its condition churn regenerates on
+            # the first post-recovery cycle and would dominate journal
+            # volume on large contended backlogs.
+            if kind != "Pending":
+                self._journal_wl(wl)
         self._record_metric_event(kind, wl)
 
     def _record_metric_event(self, kind: str, wl: Workload) -> None:
@@ -262,20 +350,33 @@ class ClusterRuntime:
             )
 
     # ---- API-object lifecycle (delegates, main.go setupControllers) ----
+    # Config mutations journal WAL-style: the record lands before the
+    # stores mutate, so a crash in the window leaves a replayable
+    # record, never a silently-applied-but-forgotten change.
     def add_cluster_queue(self, cq: ClusterQueue) -> None:
+        from kueue_tpu import serialization as ser
+
+        self._journal_obj("clusterqueues", ser.cq_to_dict(cq))
         self.cache.add_or_update_cluster_queue(cq)
         self.queues.add_cluster_queue(cq)
 
     def delete_cluster_queue(self, name: str) -> None:
+        self._journal_obj_delete("clusterqueues", name)
         self.cache.delete_cluster_queue(name)
         self.queues.delete_cluster_queue(name)
         self.metrics.clear_cluster_queue(name)
 
     def add_local_queue(self, lq: LocalQueue) -> None:
+        from kueue_tpu import serialization as ser
+
+        self._journal_obj("localqueues", ser.lq_to_dict(lq))
         self.cache.add_or_update_local_queue(lq)
         self.queues.add_local_queue(lq)
 
     def add_flavor(self, flavor: ResourceFlavor) -> None:
+        from kueue_tpu import serialization as ser
+
+        self._journal_obj("resourceflavors", ser.flavor_to_dict(flavor))
         self.cache.add_or_update_flavor(flavor)
         if self.cache.tas_cache is not None:
             self.cache.tas_cache.add_or_update_flavor(flavor)
@@ -286,6 +387,9 @@ class ClusterRuntime:
         self._reactivate_cqs(lambda cq: flavor.name in cq.flavor_names())
 
     def add_topology(self, topo: Topology) -> None:
+        from kueue_tpu import serialization as ser
+
+        self._journal_obj("topologies", ser.topology_to_dict(topo))
         self.cache.add_or_update_topology(topo)
         if self.cache.tas_cache is not None:
             self.cache.tas_cache.add_or_update_topology(topo)
@@ -311,10 +415,16 @@ class ClusterRuntime:
             self.queues.queue_inadmissible_workloads(affected)
 
     def add_cohort(self, cohort: Cohort) -> None:
+        from kueue_tpu import serialization as ser
+
+        self._journal_obj("cohorts", ser.cohort_to_dict(cohort))
         self.cache.add_or_update_cohort(cohort)
         self.queues.forest.add_cohort(cohort.name, cohort.parent)
 
     def add_admission_check(self, ac: AdmissionCheck) -> None:
+        from kueue_tpu import serialization as ser
+
+        self._journal_obj("admissionchecks", ser.check_to_dict(ac))
         old = self.cache.admission_checks.get(ac.name)
         if ac.active is None and old is not None:
             # the Active condition is controller-owned status; a spec
@@ -347,6 +457,11 @@ class ClusterRuntime:
             return
         ac.active = active
         ac.active_message = message
+        from kueue_tpu import serialization as ser
+
+        # in-place status flip: journal the post-state (replay upserts
+        # the check with the flipped Active condition)
+        self._journal_obj("admissionchecks", ser.check_to_dict(ac))
         self._reactivate_cqs_with_check(name)
 
     def local_queue_status(self, namespace: str, name: str) -> Optional[dict]:
@@ -407,33 +522,51 @@ class ClusterRuntime:
             raise ValueError(
                 f"resourceFlavor {name!r} is in use by clusterQueue {in_use!r}"
             )
+        self._journal_obj_delete("resourceflavors", name)
         self.cache.delete_flavor(name)
         if self.cache.tas_cache is not None:
             self.cache.tas_cache.delete_flavor(name)
 
     def add_priority_class(self, pc: WorkloadPriorityClass) -> None:
+        from kueue_tpu import serialization as ser
+
+        self._journal_obj(
+            "workloadpriorityclasses", ser.priority_class_to_dict(pc)
+        )
         self.cache.add_or_update_priority_class(pc)
 
     # ---- nodes (TAS capacity; resource_flavor.go node watch) ----
     def add_node(self, node) -> None:
         if self.node_controller is not None:
+            from kueue_tpu import serialization as ser
+
+            self._journal_obj("nodes", ser.node_to_dict(node))
             self.node_controller.add_or_update_node(node)
 
     def delete_node(self, name: str) -> None:
         if self.node_controller is not None:
+            self._journal_obj_delete("nodes", name)
             self.node_controller.delete_node(name)
 
     # ---- resource adjustment objects ----
     def add_limit_range(self, lr) -> None:
+        from kueue_tpu import serialization as ser
+
+        self._journal_obj("limitranges", ser.limit_range_to_dict(lr))
         self.limit_ranges[lr.key] = lr
 
     def delete_limit_range(self, key: str) -> None:
+        self._journal_obj_delete("limitranges", key)
         self.limit_ranges.pop(key, None)
 
     def add_runtime_class(self, rc) -> None:
+        from kueue_tpu import serialization as ser
+
+        self._journal_obj("runtimeclasses", ser.runtime_class_to_dict(rc))
         self.runtime_classes[rc.name] = rc
 
     def delete_runtime_class(self, name: str) -> None:
+        self._journal_obj_delete("runtimeclasses", name)
         self.runtime_classes.pop(name, None)
 
     def _validate_workload_resources(self, wl: Workload) -> Optional[str]:
@@ -469,6 +602,12 @@ class ClusterRuntime:
 
     # ---- workload store, used by reconcilers ----
     def add_workload(self, wl: Workload) -> None:
+        # WAL ordering: the upsert record lands before any store
+        # mutates (crash in between replays to the same state)
+        self._journal_wl(wl)
+        self._add_workload_stores(wl)
+
+    def _add_workload_stores(self, wl: Workload) -> None:
         # Replacing a DIFFERENT object under the same key releases the
         # old copy's cache/queue state first (the reference's update
         # handlers route transitions explicitly; here delete+add is
@@ -504,6 +643,7 @@ class ClusterRuntime:
             self.queues.add_or_update_workload(wl)
 
     def delete_workload(self, wl: Workload) -> None:
+        self._journal_wl_delete(wl.key)
         self.workloads.pop(wl.key, None)
         self.indexer.delete(wl.key)
         self.audit.forget(wl.key)  # history follows the object lifecycle
@@ -522,6 +662,9 @@ class ClusterRuntime:
         self.queues.delete_workload(wl)
         if self.cache.delete_workload(wl):
             self.queues.queue_associated_inadmissible_workloads_after(cq_name)
+        # quota release is a durable transition: the recovered cache
+        # must not keep charging a finished workload
+        self._journal_wl(wl, require_stored=True)
 
     def unset_quota_reservation(self, wl: Workload, reason: str, message: str) -> None:
         """workload.UnsetQuotaReservationWithCondition + requeue."""
@@ -544,6 +687,10 @@ class ClusterRuntime:
         wl.conditions.pop(WorkloadConditionType.EVICTED, None)
         if wl.active:
             self.queues.requeue_workload(wl, RequeueReason.GENERIC)
+        # the quota release + requeue is the durable post-state (the
+        # Evicted event journaled the pre-release state; this record
+        # supersedes it so replay cannot resurrect the admission)
+        self._journal_wl(wl, require_stored=True)
 
     def list_workloads(self, field: str, value: str) -> List[Workload]:
         """Index-backed workload listing (the analog of client.List with
@@ -568,6 +715,7 @@ class ClusterRuntime:
         # The Requeued-condition flip is a workload update event: the
         # queue's push_or_update unparks it (manager.go UpdateWorkload).
         self.queues.add_or_update_workload(wl)
+        self._journal_wl(wl)
 
     def on_pods_ready_changed(self, wl: Workload, ready: bool) -> None:
         if ready:
@@ -580,6 +728,7 @@ class ClusterRuntime:
         self.queues.add_or_update_workload(wl)
         # queue_name is an indexed field mutated in place with no event
         self.indexer.update(wl.key, wl)
+        self._journal_wl(wl, require_stored=True)
 
     def update_reclaimable_pods(self, wl: Workload, recl: Dict[str, int]) -> None:
         wl.reclaimable_pods = dict(recl)
@@ -589,6 +738,7 @@ class ClusterRuntime:
             self.queues.queue_associated_inadmissible_workloads_after(
                 wl.admission.cluster_queue
             )
+        self._journal_wl(wl, require_stored=True)
 
     # ---- the loop ----
     def reconcile_once(self) -> None:
@@ -660,6 +810,96 @@ class ClusterRuntime:
                 continue
             self.topology_ungater.observe_job(wl.key, job)
             self.topology_ungater.reconcile(wl, job)
+
+    # ---- control-plane invariants (recovery gate) ----
+    def check_invariants(self) -> List[str]:
+        """Structural consistency of the whole control plane — the
+        conditions that, violated, mean the scheduler would double-book
+        accelerators or strand workloads. Returns violation strings
+        (empty = consistent). Recovery refuses to serve on violations;
+        ``kueuectl state verify`` reports them offline.
+
+        Checked:
+        - per CQ: cached usage equals the sum of admission_usage over
+          its tracked workloads, and nothing is negative;
+        - every cache-tracked workload exists in the store, carries an
+          admission naming that CQ;
+        - no workload is simultaneously pending (heap/parking lot) and
+          holding a quota reservation, and no key appears in two
+          pending pools;
+        - resourceVersion monotone: the journal's newest stamped rv
+          never exceeds the runtime's counter;
+        - heap membership consistent: every pending key resolves to a
+          live, active, not-finished workload.
+        """
+        from kueue_tpu.core.workload_info import admission_usage
+
+        v: List[str] = []
+        for name, cached in self.cache.cluster_queues.items():
+            expect: Dict[object, int] = {}
+            for key, wl in cached.workloads.items():
+                if wl.admission is None:
+                    v.append(f"cq {name}: tracked workload {key} has no admission")
+                    continue
+                if wl.admission.cluster_queue != name:
+                    v.append(
+                        f"cq {name}: tracked workload {key} admitted to "
+                        f"{wl.admission.cluster_queue!r}"
+                    )
+                if key not in self.workloads and key not in self.cache.assumed_workloads:
+                    v.append(f"cq {name}: tracked workload {key} not in store")
+                for fr, qty in admission_usage(wl).items():
+                    expect[fr] = expect.get(fr, 0) + qty
+            actual = {fr: q for fr, q in cached.usage.items() if q != 0}
+            expected = {fr: q for fr, q in expect.items() if q != 0}
+            if actual != expected:
+                diff = {
+                    fr: (actual.get(fr, 0), expected.get(fr, 0))
+                    for fr in set(actual) | set(expected)
+                    if actual.get(fr, 0) != expected.get(fr, 0)
+                }
+                v.append(
+                    f"cq {name}: usage != sum of admitted "
+                    f"(actual, expected): {diff}"
+                )
+            for fr, qty in cached.usage.items():
+                if qty < 0:
+                    v.append(f"cq {name}: negative usage {fr}={qty}")
+        seen_pending: Dict[str, str] = {}
+        for name, pq in self.queues.cluster_queues.items():
+            heap_keys = set(pq.heap.keys())
+            parked_keys = set(pq.inadmissible)
+            dup = heap_keys & parked_keys
+            if dup:
+                v.append(
+                    f"cq {name}: keys in both heap and parking lot: "
+                    f"{sorted(dup)}"
+                )
+            pending = heap_keys | parked_keys
+            if pq.inflight is not None:
+                pending.add(pq.inflight.key)
+            for key in pending:
+                prev = seen_pending.get(key)
+                if prev is not None and prev != name:
+                    v.append(f"workload {key} pending in both {prev} and {name}")
+                seen_pending[key] = name
+                wl = self.workloads.get(key)
+                if wl is None:
+                    v.append(f"cq {name}: pending key {key} not in store")
+                    continue
+                if wl.has_quota_reservation:
+                    v.append(
+                        f"workload {key} simultaneously pending in {name} "
+                        "and holding a quota reservation"
+                    )
+                if wl.is_finished:
+                    v.append(f"cq {name}: finished workload {key} still pending")
+        if self.journal is not None and self.journal.last_rv > self.resource_version:
+            v.append(
+                f"resourceVersion regressed: journal stamped "
+                f"{self.journal.last_rv}, runtime at {self.resource_version}"
+            )
+        return v
 
     def _state_fingerprint(self):
         parts = []
@@ -819,6 +1059,9 @@ class ClusterRuntime:
             timestamp_fn=ts_fn,
         )
         t_solve = _time.perf_counter() - t1
+        from kueue_tpu.testing import faults
+
+        faults.fire("cycle.post_solve_pre_apply")
         # plan+dispatch cost only — the apply below is per-admission
         # bookkeeping both paths pay
         self._drain_est.observe(

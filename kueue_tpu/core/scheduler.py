@@ -288,6 +288,11 @@ class Scheduler:
         t1 = _time.perf_counter()
         entries, device_plan = self._nominate(heads, snapshot)
         trace.spans["nominate"] = _time.perf_counter() - t1
+        # crash-consistency fault point: nomination (host walk or device
+        # solve) is complete, nothing has been applied or journaled yet
+        from kueue_tpu.testing import faults
+
+        faults.fire("cycle.post_solve_pre_apply")
         if device_plan is not None:
             t2 = _time.perf_counter()
             out = self._finalize_device(entries, device_plan, snapshot, result)

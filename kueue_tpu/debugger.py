@@ -61,6 +61,26 @@ def dump(runtime) -> str:
                 f"@ {rec.cluster_queue}: {rec.outcome}/{rec.reason.value}"
                 f"{seen}{msg}"
             )
+    # persistence stats: a hung server's durability posture (is the
+    # journal keeping up? degraded?) is triagable from the signal dump
+    journal = getattr(runtime, "journal", None)
+    if journal is not None:
+        st = journal.stats()
+        lines.append("-- persistence (write-ahead journal) --")
+        age = (
+            f"{st.last_fsync_age_s:.3f}s"
+            if st.last_fsync_age_s is not None
+            else "never"
+        )
+        lines.append(
+            f"segments={st.segments} bytes={st.bytes} "
+            f"lastSeq={st.last_seq} lastRv={st.last_rv} "
+            f"appends={st.appends} dropped={st.dropped_appends} "
+            f"fsyncs={st.fsyncs} lastFsyncAge={age} "
+            f"degraded={st.degraded}"
+        )
+        if st.last_error:
+            lines.append(f"lastError: {st.last_error}")
     return "\n".join(lines)
 
 

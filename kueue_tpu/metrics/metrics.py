@@ -208,6 +208,50 @@ class Metrics:
             f"{NS}_planner_last_scenarios",
             "Scenario count of the most recent capacity-planner run",
         )
+        # durable-state subsystem (kueue_tpu/storage): journal health +
+        # crash-recovery accounting. journal_degraded is the paging
+        # signal — 1 means appends are failing (ENOSPC/EIO) and the
+        # control plane is running on checkpoint-only durability.
+        self.journal_degraded = r.gauge(
+            f"{NS}_journal_degraded",
+            "1 while journal appends are failing and persistence is degraded to checkpoint-only",
+        )
+        self.journal_appends_total = r.counter(
+            f"{NS}_journal_appends_total",
+            "Total journal records successfully appended",
+        )
+        self.journal_append_errors_total = r.counter(
+            f"{NS}_journal_append_errors_total",
+            "Total journal append failures (records lost to degraded persistence)",
+        )
+        self.journal_fsyncs_total = r.counter(
+            f"{NS}_journal_fsyncs_total",
+            "Total fsync calls on the active journal segment",
+        )
+        self.journal_bytes_written_total = r.counter(
+            f"{NS}_journal_bytes_written_total",
+            "Total bytes appended to the journal",
+        )
+        self.journal_segments = r.gauge(
+            f"{NS}_journal_segments",
+            "Journal segment files currently on disk",
+        )
+        self.recovery_runs_total = r.counter(
+            f"{NS}_recovery_runs_total",
+            "Total checkpoint+journal recoveries performed by this process",
+        )
+        self.recovery_replayed_records_total = r.counter(
+            f"{NS}_recovery_replayed_records_total",
+            "Total journal records replayed during recovery",
+        )
+        self.recovery_skipped_stale_records_total = r.counter(
+            f"{NS}_recovery_skipped_stale_records_total",
+            "Total journal records refused during recovery for carrying a stale fencing token",
+        )
+        self.recovery_torn_bytes_total = r.counter(
+            f"{NS}_recovery_torn_bytes_total",
+            "Total torn-tail bytes truncated from the journal during recovery",
+        )
         # LocalQueue variants (LocalQueueMetrics feature gate)
         self.local_queue_pending_workloads = r.gauge(
             f"{NS}_local_queue_pending_workloads",

@@ -146,6 +146,10 @@ EVENT_REASONS = frozenset(
         "MultiKueueClusterLost",
         "MultiKueueRejected",
         "MultiKueueReserved",
+        # durable-state subsystem (kueue_tpu/storage): journal append
+        # failure flips persistence to degraded; recovery flips it back
+        "JournalDegraded",
+        "JournalRecovered",
     }
 )
 

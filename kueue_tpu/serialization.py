@@ -599,6 +599,14 @@ def runtime_from_state(data: dict, runtime=None, **runtime_kwargs):
         rt.add_local_queue(lq_from_dict(l))
     for w in data.get("workloads", []):
         rt.add_workload(workload_from_dict(w))
+    # persistence metadata (written by checkpoints): restore the
+    # monotone mutation counter so post-recovery journal records keep
+    # increasing instead of restarting from zero
+    persistence = data.get("persistence") or {}
+    rt.resource_version = max(
+        getattr(rt, "resource_version", 0),
+        int(persistence.get("resourceVersion", 0)),
+    )
     return rt
 
 
@@ -630,6 +638,15 @@ def runtime_to_state(rt) -> dict:
             node_to_dict(n)
             for n in rt.cache.tas_cache.node_inventory.values()
         ]
+    # persistence metadata: which journal prefix this checkpoint covers
+    # (recovery replays only records with seq > journalSeq) and the
+    # runtime's monotone mutation counter. journal=None serializes
+    # seq 0 — replay-everything, the correct degenerate case.
+    journal = getattr(rt, "journal", None)
+    out["persistence"] = {
+        "resourceVersion": getattr(rt, "resource_version", 0),
+        "journalSeq": journal.last_seq if journal is not None else 0,
+    }
     return out
 
 

@@ -39,16 +39,37 @@ def fenced_checkpoint(srv, state_path: str) -> bool:
     from kueue_tpu.utils.lease import atomic_write_text
 
     with srv.lock:
-        text = json.dumps(ser.runtime_to_state(srv.runtime), indent=1)
+        state = ser.runtime_to_state(srv.runtime)
         snap_token = srv.elector.lease.token if srv.elector else None
+        # stamp the serialization-time token into the checkpoint: the
+        # recovery replay refuses journal records with OLDER tokens (a
+        # deposed leader's stray appends)
+        state["persistence"]["token"] = snap_token
+        # the journal prefix this checkpoint covers — safe to compact
+        # once the checkpoint is durably on disk
+        snap_journal_seq = state["persistence"]["journalSeq"]
+        journal = getattr(srv.runtime, "journal", None)
+        text = json.dumps(state, indent=1)
         srv._ckpt_seq += 1
         seq = srv._ckpt_seq
 
     def _write_if_newest() -> bool:
         if seq <= srv._ckpt_written:
             return False  # a newer snapshot already landed
-        atomic_write_text(state_path, text, ".state-")
+        if journal is not None:
+            # records up to snap_journal_seq must be durable BEFORE the
+            # checkpoint that compacts them away claims to cover them
+            try:
+                journal.sync()
+            except OSError:
+                pass  # degraded journal: the checkpoint still lands
+        atomic_write_text(
+            state_path, text, ".state-", fault_point="checkpoint.mid_write"
+        )
         srv._ckpt_written = seq
+        if journal is not None:
+            # the checkpoint IS the compaction point
+            journal.compact(snap_journal_seq)
         return True
 
     if srv.elector is None:
@@ -66,30 +87,59 @@ def fenced_checkpoint(srv, state_path: str) -> bool:
 
 def promote_reload(srv, state_path: str, build_runtime,
                    run_reconcile: bool = True,
-                   require_standby: bool = False) -> bool:
+                   require_standby: bool = False,
+                   journal_path: str = "",
+                   journal_opts: dict = None) -> bool:
     """On lease takeover, REBUILD srv.runtime from the old leader's
     latest checkpoint — not an upsert into the standby's stale store,
     which would resurrect objects the old leader deleted. Data loss is
-    bounded by the checkpoint period. Returns True when a checkpoint
-    was loaded.
+    bounded by the checkpoint period — or, with ``journal_path``, by
+    the journal fsync window: promotion then runs full recovery
+    (checkpoint + replay of newer records, stale fencing tokens
+    refused, invariants checked) and attaches the journal to the new
+    runtime. Returns True when a checkpoint was loaded (or, with a
+    journal, when anything was recovered).
 
     Also used for the standby read-refresh with ``run_reconcile=False``
     + ``require_standby=True``: a standby mirrors the leader's
-    checkpoint verbatim and must NOT run scheduling cycles of its own;
-    and if this replica was promoted while the (slow) mirror rebuild was
-    in flight, the swap is abandoned — installing a never-reconciled
-    pre-promotion mirror over the new leader's live runtime would
-    discard writes accepted since promotion."""
+    checkpoint verbatim and must NOT run scheduling cycles of its own
+    — nor open the journal for append (that would truncate/extend a
+    file the leader is writing); standby refreshes stay
+    checkpoint-only. And if this replica was promoted while the (slow)
+    mirror rebuild was in flight, the swap is abandoned — installing a
+    never-reconciled pre-promotion mirror over the new leader's live
+    runtime would discard writes accepted since promotion."""
     from kueue_tpu import serialization as ser
 
-    if not (state_path and os.path.exists(state_path)):
-        return False
-    fresh = build_runtime()
-    with open(state_path) as f:
-        ser.runtime_from_state(json.load(f), runtime=fresh)
+    journal = None
+    if journal_path and not require_standby:
+        from kueue_tpu.storage import recover
+
+        fresh = build_runtime()
+        res = recover(state_path, journal_path, runtime=fresh, strict=True,
+                      **(journal_opts or {}))
+        journal = res.journal
+        loaded = res.checkpoint_loaded or res.replayed > 0
+        if not loaded:
+            journal.close()
+            return False
+    else:
+        if not (state_path and os.path.exists(state_path)):
+            return False
+        fresh = build_runtime()
+        with open(state_path) as f:
+            ser.runtime_from_state(json.load(f), runtime=fresh)
     with srv.lock:
         if require_standby and srv.elector is not None and srv.elector.is_leader:
             return False
+        if journal is not None:
+            journal.token_provider = (
+                (lambda: srv.elector.lease.token) if srv.elector else None
+            )
+            fresh.attach_journal(journal)
+            old_journal = getattr(srv.runtime, "journal", None)
+            if old_journal is not None and old_journal is not journal:
+                old_journal.close()
         srv.runtime = fresh
         if run_reconcile:
             fresh.run_until_idle()
@@ -110,6 +160,32 @@ def main(argv=None) -> int:
         help="JSON state file (CLI wire format): loaded at startup if "
         "present, written back on shutdown — the durable checkpoint "
         "active-passive recovery restarts from",
+    )
+    parser.add_argument(
+        "--journal",
+        help="directory for the write-ahead admission journal: every "
+        "state mutation is appended as a CRC-framed record; startup "
+        "(and promotion) recovers from the newest checkpoint plus "
+        "replay of newer records, bounding crash data loss to the "
+        "fsync window instead of the checkpoint period",
+    )
+    parser.add_argument(
+        "--journal-fsync", choices=["always", "interval", "never"],
+        default="interval",
+        help="journal durability policy: always = fsync every append "
+        "(power-loss-safe, slow), interval = fsync at most every "
+        "--journal-fsync-interval seconds (the default), never = "
+        "leave it to the OS",
+    )
+    parser.add_argument(
+        "--journal-fsync-interval", type=float, default=0.05,
+        help="seconds between journal fsyncs under --journal-fsync "
+        "interval (the bounded power-loss window)",
+    )
+    parser.add_argument(
+        "--journal-segment-bytes", type=int, default=8 * 1024 * 1024,
+        help="rotate journal segments at this size; checkpoints delete "
+        "fully-covered segments (compaction)",
     )
     parser.add_argument(
         "--no-solver", action="store_true",
@@ -201,8 +277,26 @@ def main(argv=None) -> int:
 
         return ClusterRuntime(use_solver=use_solver, tas_cache=TASCache())
 
+    journal_opts = {
+        "fsync_policy": args.journal_fsync,
+        "fsync_interval_s": args.journal_fsync_interval,
+        "segment_max_bytes": args.journal_segment_bytes,
+    }
     runtime = build_runtime()
-    if args.state and os.path.exists(args.state):
+    journal = None
+    if args.journal:
+        from kueue_tpu.storage import recover
+
+        # crash recovery: checkpoint + replay of newer journal records
+        # (torn tail truncated, stale fencing tokens refused), then the
+        # invariant check — a violating state must not serve
+        res = recover(
+            args.state, args.journal, runtime=runtime, strict=True,
+            **journal_opts,
+        )
+        journal = res.journal
+        print(f"journal recovery: {res.summary()}", flush=True)
+    elif args.state and os.path.exists(args.state):
         with open(args.state) as f:
             ser.runtime_from_state(json.load(f), runtime=runtime)
     srv = None  # assigned below; the callbacks close over it
@@ -233,11 +327,15 @@ def main(argv=None) -> int:
         # non-leader and the NEXT promotion attempt must not classify
         # itself as a resume and skip the reload — that would lead with
         # the stale pre-takeover runtime.
-        reloaded = args.state and promote_reload(srv, args.state, build_runtime)
+        reloaded = (args.state or args.journal) and promote_reload(
+            srv, args.state, build_runtime,
+            journal_path=args.journal or "", journal_opts=journal_opts,
+        )
         ha["last_token"] = tok
         if reloaded:
             print(
-                "promoted to leader; rebuilt state from checkpoint",
+                "promoted to leader; rebuilt state from checkpoint"
+                + (" + journal replay" if args.journal else ""),
                 flush=True,
             )
 
@@ -259,6 +357,13 @@ def main(argv=None) -> int:
             ),
             on_started_leading=on_promoted,
         )
+    if journal is not None:
+        # attach AFTER recovery (replay must not re-journal) and after
+        # the elector exists, so records carry the live fencing token
+        journal.token_provider = (
+            (lambda: elector.lease.token) if elector is not None else None
+        )
+        runtime.attach_journal(journal)
     tls = None
     if args.tls_cert_dir:
         from kueue_tpu.utils.cert import CertRotator
@@ -340,6 +445,9 @@ def main(argv=None) -> int:
     srv.stop(before_release=_final_checkpoint if was_leader else None)
     if ckpt_thread is not None:
         ckpt_thread.join(timeout=5)
+    live_journal = getattr(srv.runtime, "journal", None)
+    if live_journal is not None:
+        live_journal.close()  # final fsync of any unsynced tail
     if args.state and was_leader:
         if final["saved"]:
             print(f"state saved to {args.state}", flush=True)

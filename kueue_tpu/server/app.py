@@ -738,7 +738,26 @@ def _make_handler(srv: KueueServer):
 
         # ---- handlers ----
         def _h_healthz(self, query):
-            self._send_json({"status": "ok"})
+            body = {"status": "ok"}
+            journal = getattr(srv.runtime, "journal", None)
+            if journal is not None:
+                st = journal.stats()
+                # degraded persistence is a health DETAIL, not a
+                # liveness failure: restarting the pod cannot fix a
+                # full volume, so the probe stays 200 and the operator
+                # pages on status/kueue_journal_degraded instead
+                if st.degraded:
+                    body["status"] = "degraded"
+                body["persistence"] = {
+                    "mode": "degraded" if st.degraded else "journaling",
+                    "journalSegments": st.segments,
+                    "journalBytes": st.bytes,
+                    "lastSeq": st.last_seq,
+                    "droppedAppends": st.dropped_appends,
+                    "lastError": st.last_error,
+                    "lastFsyncAgeS": st.last_fsync_age_s,
+                }
+            self._send_json(body)
 
         def _h_readyz(self, query):
             # standby replicas are Ready (they serve reads) but report

@@ -1,0 +1,38 @@
+"""Durable-state subsystem: write-ahead admission journal + recovery.
+
+The reference delegates durability to etcd — every Workload status
+write is a durable API-server transaction and a restarted manager
+rebuilds cache/queues from the watch. This self-contained control
+plane previously had only the 30 s fenced checkpoint, so a crash
+forgot up to 30 s of admissions/evictions/quota releases. The journal
+closes that window: every state mutation appends a CRC-framed record
+stamped with the leader fencing token and a monotone resourceVersion;
+recovery is newest-valid-checkpoint + replay of newer records, checked
+by ``ClusterRuntime.check_invariants()`` before serving.
+"""
+
+from kueue_tpu.storage.journal import (  # noqa: F401
+    FSYNC_POLICIES,
+    Journal,
+    JournalRecord,
+    SegmentReport,
+    scan_segment,
+)
+from kueue_tpu.storage.recovery import (  # noqa: F401
+    RecoveryError,
+    RecoveryResult,
+    recover,
+    verify_chain,
+)
+
+__all__ = [
+    "FSYNC_POLICIES",
+    "Journal",
+    "JournalRecord",
+    "SegmentReport",
+    "scan_segment",
+    "RecoveryError",
+    "RecoveryResult",
+    "recover",
+    "verify_chain",
+]

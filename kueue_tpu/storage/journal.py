@@ -1,0 +1,480 @@
+"""Append-only write-ahead journal of control-plane state mutations.
+
+Record stream semantics: each record describes ONE applied mutation
+(workload upsert/delete, config object upsert/delete) as its
+post-state, stamped with a strictly increasing ``seq``, the runtime's
+monotone ``rv`` (resourceVersion) and the leader's fencing ``token``.
+Replay of any PREFIX of the stream onto the checkpoint it follows
+yields a consistent runtime (evictions are journaled before the
+admissions that depend on them, in apply order), and records are
+idempotent upserts — so recovery never loses or double-applies an
+admission regardless of where the crash landed.
+
+On-disk format, chosen for torn-tail tolerance over density:
+
+  segment file  journal-<first seq, 10 digits>.wal
+  frame         <u32 payload length LE> <u32 crc32(payload) LE> <payload>
+  payload       one JSON object {"seq","rv","token","ts","type","data"}
+
+A crash mid-append leaves a torn final frame; ``open()`` scans the last
+segment, truncates at the first bad frame and keeps serving — the
+journal NEVER refuses to start over a torn tail (that is the expected
+crash shape, not corruption). Bad frames in a non-final segment are
+real corruption and are reported (``verify_chain``) but open() still
+starts from what is readable.
+
+Durability knobs: ``fsync_policy`` in {"always","interval","never"}.
+``always`` fsyncs every append (power-loss-safe, slow); ``interval``
+fsyncs when ``fsync_interval_s`` has elapsed since the last sync
+(bounded loss window, the production default); ``never`` leaves it to
+the OS (crash-of-process safe, power-loss unsafe).
+
+Failure model: a failed append (ENOSPC, EIO) flips ``degraded`` and
+returns None instead of raising — the control plane keeps admitting
+with checkpoint-only durability and self-heals the moment a write
+succeeds again. The owner (ClusterRuntime) mirrors the flag into an
+event + /healthz + the ``kueue_journal_degraded`` gauge.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from kueue_tpu.testing import faults
+
+_HEADER = struct.Struct("<II")  # payload length, crc32(payload)
+_MAX_FRAME = 64 << 20  # sanity bound: a "length" beyond this is garbage
+_SEGMENT_PREFIX = "journal-"
+_SEGMENT_SUFFIX = ".wal"
+
+FSYNC_POLICIES = ("always", "interval", "never")
+
+
+@dataclass
+class JournalRecord:
+    seq: int
+    rv: int
+    token: Optional[int]
+    type: str
+    data: dict
+    ts: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "rv": self.rv,
+            "token": self.token,
+            "ts": self.ts,
+            "type": self.type,
+            "data": self.data,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JournalRecord":
+        return cls(
+            seq=int(d["seq"]),
+            rv=int(d.get("rv", 0)),
+            token=(int(d["token"]) if d.get("token") is not None else None),
+            type=d["type"],
+            data=d.get("data", {}),
+            ts=float(d.get("ts", 0.0)),
+        )
+
+
+@dataclass
+class SegmentReport:
+    """Result of scanning one segment file."""
+
+    path: str
+    records: int = 0
+    bytes_valid: int = 0  # offset of the first bad frame (== size if clean)
+    bytes_total: int = 0
+    torn: bool = False  # a bad/partial frame ended the scan early
+    error: str = ""
+    first_seq: Optional[int] = None
+    last_seq: Optional[int] = None
+
+
+def _segment_name(first_seq: int) -> str:
+    return f"{_SEGMENT_PREFIX}{first_seq:010d}{_SEGMENT_SUFFIX}"
+
+
+def _list_segments(path: str) -> List[str]:
+    try:
+        names = os.listdir(path)
+    except FileNotFoundError:
+        return []
+    out = [
+        n
+        for n in names
+        if n.startswith(_SEGMENT_PREFIX) and n.endswith(_SEGMENT_SUFFIX)
+    ]
+    return sorted(out)
+
+
+def scan_segment(
+    path: str, collect: Optional[List[JournalRecord]] = None
+) -> SegmentReport:
+    """Frame-by-frame scan. Stops at the first bad frame (short header,
+    short payload, CRC mismatch, unparsable JSON) and reports the valid
+    prefix; never raises on corruption."""
+    rep = SegmentReport(path=path, bytes_total=os.path.getsize(path))
+    with open(path, "rb") as f:
+        off = 0
+        while True:
+            header = f.read(_HEADER.size)
+            if not header:
+                break  # clean EOF
+            if len(header) < _HEADER.size:
+                rep.torn, rep.error = True, "short frame header"
+                break
+            length, crc = _HEADER.unpack(header)
+            if length == 0 or length > _MAX_FRAME:
+                rep.torn, rep.error = True, f"implausible frame length {length}"
+                break
+            payload = f.read(length)
+            if len(payload) < length:
+                rep.torn, rep.error = True, "short frame payload"
+                break
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                rep.torn, rep.error = True, "crc mismatch"
+                break
+            try:
+                rec = JournalRecord.from_dict(json.loads(payload))
+            except (ValueError, KeyError, TypeError) as e:
+                rep.torn, rep.error = True, f"unparsable payload: {e!r}"
+                break
+            off += _HEADER.size + length
+            rep.records += 1
+            rep.bytes_valid = off
+            if rep.first_seq is None:
+                rep.first_seq = rec.seq
+            rep.last_seq = rec.seq
+            if collect is not None:
+                collect.append(rec)
+    return rep
+
+
+@dataclass
+class JournalStats:
+    segments: int = 0
+    bytes: int = 0
+    last_seq: int = 0
+    last_rv: int = 0
+    appends: int = 0
+    dropped_appends: int = 0
+    fsyncs: int = 0
+    degraded: bool = False
+    last_error: str = ""
+    last_fsync_age_s: Optional[float] = None
+    torn_bytes_truncated: int = 0
+    compactions: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "segments": self.segments,
+            "bytes": self.bytes,
+            "lastSeq": self.last_seq,
+            "lastRv": self.last_rv,
+            "appends": self.appends,
+            "droppedAppends": self.dropped_appends,
+            "fsyncs": self.fsyncs,
+            "degraded": self.degraded,
+            "lastError": self.last_error,
+            "lastFsyncAgeS": self.last_fsync_age_s,
+            "tornBytesTruncated": self.torn_bytes_truncated,
+            "compactions": self.compactions,
+        }
+
+
+class Journal:
+    """One journal directory. Single-writer by contract — mutual
+    exclusion comes from the leader lease, and the fencing token on
+    every record makes a deposed writer's stray appends refusable at
+    replay time (recovery.py)."""
+
+    def __init__(
+        self,
+        path: str,
+        fsync_policy: str = "interval",
+        fsync_interval_s: float = 0.05,
+        segment_max_bytes: int = 8 << 20,
+        token_provider: Optional[Callable[[], Optional[int]]] = None,
+        metrics=None,  # kueue_tpu.metrics.Metrics (optional mirror)
+    ):
+        if fsync_policy not in FSYNC_POLICIES:
+            raise ValueError(
+                f"fsync_policy must be one of {FSYNC_POLICIES}, "
+                f"got {fsync_policy!r}"
+            )
+        self.path = path
+        self.fsync_policy = fsync_policy
+        self.fsync_interval_s = fsync_interval_s
+        self.segment_max_bytes = segment_max_bytes
+        self.token_provider = token_provider
+        self.metrics = metrics
+        self.last_seq = 0
+        self.last_rv = 0
+        self.degraded = False
+        self.last_error = ""
+        self._appends = 0
+        self._dropped = 0
+        self._fsyncs = 0
+        self._compactions = 0
+        self._torn_truncated = 0
+        self._fh = None  # active segment append handle
+        self._active = None  # active segment file name
+        self._active_size = 0
+        self._last_fsync = None  # monotonic time of the last sync
+        self._opened = False
+
+    # ---- lifecycle ----
+    def open(self) -> "Journal":
+        """Scan existing segments, truncate a torn tail of the LAST
+        segment, and open it (or a fresh one) for append. Never refuses
+        to start: whatever valid prefix exists is the journal."""
+        os.makedirs(self.path, exist_ok=True)
+        segments = _list_segments(self.path)
+        if segments:
+            last = os.path.join(self.path, segments[-1])
+            rep = scan_segment(last)
+            if rep.torn and rep.bytes_valid < rep.bytes_total:
+                self._torn_truncated += rep.bytes_total - rep.bytes_valid
+                with open(last, "rb+") as f:
+                    f.truncate(rep.bytes_valid)
+            # seq/rv resume from the newest readable record anywhere in
+            # the chain (the last segment may have lost its only record
+            # to the truncation)
+            for name in reversed(segments):
+                recs: List[JournalRecord] = []
+                scan_segment(os.path.join(self.path, name), collect=recs)
+                if recs:
+                    self.last_seq = recs[-1].seq
+                    self.last_rv = recs[-1].rv
+                    break
+            self._active = segments[-1]
+            self._active_size = os.path.getsize(last)
+            self._fh = open(last, "ab", buffering=0)
+        else:
+            self._start_segment(self.last_seq + 1)
+        self._opened = True
+        return self
+
+    def close(self) -> None:
+        if self._fh is not None and not self._fh.closed:
+            try:
+                os.fsync(self._fh.fileno())
+            except OSError:
+                pass
+            self._fh.close()
+        self._fh = None
+        self._opened = False
+
+    def _start_segment(self, first_seq: int) -> None:
+        if self._fh is not None and not self._fh.closed:
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+        # null the handle FIRST: if the new open fails (ENOSPC on the
+        # volume's metadata), append's degraded path must find a
+        # reopenable state, not a closed handle that raises ValueError
+        self._fh = None
+        self._active = _segment_name(first_seq)
+        self._fh = open(os.path.join(self.path, self._active), "ab",
+                        buffering=0)
+        self._active_size = 0
+
+    def _ensure_handle(self) -> None:
+        """Reopen the active segment if a failed rotation/close left no
+        usable handle — the degraded path's self-heal route."""
+        if self._fh is None or self._fh.closed:
+            path = os.path.join(self.path, self._active)
+            self._fh = open(path, "ab", buffering=0)
+            self._active_size = os.path.getsize(path)
+
+    # ---- writing ----
+    def append(
+        self,
+        rtype: str,
+        data: dict,
+        rv: int = 0,
+        token: Optional[int] = None,
+    ) -> Optional[JournalRecord]:
+        """Append one record. Returns the record, or None when the
+        write failed — the journal is then ``degraded`` and stays
+        usable; the next successful append clears the flag."""
+        if not self._opened:
+            raise RuntimeError("journal not open()ed")
+        if token is None and self.token_provider is not None:
+            token = self.token_provider()
+        rec = JournalRecord(
+            seq=self.last_seq + 1,
+            rv=rv,
+            token=token,
+            type=rtype,
+            data=data,
+            ts=time.time(),
+        )
+        payload = json.dumps(rec.to_dict(), separators=(",", ":")).encode()
+        frame = _HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
+        try:
+            self._ensure_handle()
+            if self._active_size + len(frame) + len(payload) > self.segment_max_bytes \
+                    and self._active_size > 0:
+                self._start_segment(rec.seq)
+            # ONE unbuffered write: the frame is either fully in the
+            # OS (process death keeps it) or the exception path below
+            # truncates the partial tail
+            self._fh.write(frame + payload)
+            self._active_size += len(frame) + len(payload)
+        except OSError as e:
+            self._note_failure(e)
+            self._dropped += 1
+            # a partial frame may have reached the file: cut back to
+            # the last known-good offset so records appended after the
+            # volume recovers don't land behind unreadable garbage
+            import contextlib
+
+            with contextlib.suppress(OSError, TypeError):
+                os.truncate(
+                    os.path.join(self.path, self._active), self._active_size
+                )
+            return None
+        self.last_seq = rec.seq
+        self.last_rv = max(self.last_rv, rec.rv)
+        self._appends += 1
+        if self.metrics is not None:
+            self.metrics.journal_appends_total.inc()
+            self.metrics.journal_bytes_written_total.inc(
+                len(frame) + len(payload)
+            )
+        try:
+            self._maybe_fsync()
+        except OSError as e:
+            # the record reached the OS but its durability is uncertain
+            # until a later fsync succeeds: keep the seq (the record
+            # EXISTS — replay will see it), flag degraded
+            self._note_failure(e)
+            return rec
+        if self.degraded:
+            # self-heal: durability is back, tell the owner
+            self.degraded = False
+            self.last_error = ""
+            if self.metrics is not None:
+                self.metrics.journal_degraded.set(0)
+        return rec
+
+    def _note_failure(self, e: OSError) -> None:
+        self.degraded = True
+        self.last_error = repr(e)
+        if self.metrics is not None:
+            self.metrics.journal_append_errors_total.inc()
+            self.metrics.journal_degraded.set(1)
+
+    def _maybe_fsync(self) -> None:
+        if self.fsync_policy == "never":
+            return  # unbuffered writes are already with the OS
+        if self.fsync_policy == "interval":
+            now = time.monotonic()
+            if (
+                self._last_fsync is not None
+                and now - self._last_fsync < self.fsync_interval_s
+            ):
+                return
+        self.sync()
+
+    def sync(self) -> None:
+        """fsync the active segment (raises OSError on failure —
+        callers on the append path translate that into degraded)."""
+        faults.fire("journal.fsync")
+        os.fsync(self._fh.fileno())
+        self._last_fsync = time.monotonic()
+        self._fsyncs += 1
+        if self.metrics is not None:
+            self.metrics.journal_fsyncs_total.inc()
+
+    # ---- reading ----
+    def segment_paths(self) -> List[str]:
+        return [os.path.join(self.path, n) for n in _list_segments(self.path)]
+
+    def records(self, min_seq: int = 0) -> Iterator[JournalRecord]:
+        """Every readable record with seq > min_seq, in order. Stops at
+        the first bad frame anywhere in the chain (records after a gap
+        must never apply out of order)."""
+        for seg in self.segment_paths():
+            recs: List[JournalRecord] = []
+            rep = scan_segment(seg, collect=recs)
+            for rec in recs:
+                if rec.seq > min_seq:
+                    yield rec
+            if rep.torn:
+                return
+
+    # ---- compaction ----
+    def compact(self, upto_seq: int) -> int:
+        """A durable checkpoint covering everything <= upto_seq makes
+        those records dead weight: delete every sealed segment whose
+        records are all covered, rotating first if the ACTIVE segment
+        is itself fully covered. Returns segments deleted."""
+        if not self._opened or upto_seq <= 0:
+            return 0
+        if self.last_seq <= upto_seq and self._active_size > 0:
+            # everything so far is covered: seal the active segment so
+            # it becomes deletable and appends continue in a fresh one
+            self._start_segment(self.last_seq + 1)
+        names = _list_segments(self.path)
+        deleted = 0
+        for i, name in enumerate(names):
+            if name == self._active:
+                continue
+            # a sealed segment's records all precede the next segment's
+            # first seq; covered iff that boundary is <= upto_seq
+            if i + 1 < len(names):
+                nxt = names[i + 1]
+                boundary = int(nxt[len(_SEGMENT_PREFIX):-len(_SEGMENT_SUFFIX)]) - 1
+            else:
+                boundary = self.last_seq
+            if boundary <= upto_seq:
+                try:
+                    os.unlink(os.path.join(self.path, name))
+                    deleted += 1
+                except OSError:
+                    pass
+        if deleted:
+            self._compactions += 1
+        if self.metrics is not None:
+            self.metrics.journal_segments.set(len(_list_segments(self.path)))
+        return deleted
+
+    # ---- stats ----
+    def stats(self) -> JournalStats:
+        segs = self.segment_paths()
+        total = 0
+        for s in segs:
+            try:
+                total += os.path.getsize(s)
+            except OSError:
+                pass
+        return JournalStats(
+            segments=len(segs),
+            bytes=total,
+            last_seq=self.last_seq,
+            last_rv=self.last_rv,
+            appends=self._appends,
+            dropped_appends=self._dropped,
+            fsyncs=self._fsyncs,
+            degraded=self.degraded,
+            last_error=self.last_error,
+            last_fsync_age_s=(
+                time.monotonic() - self._last_fsync
+                if self._last_fsync is not None
+                else None
+            ),
+            torn_bytes_truncated=self._torn_truncated,
+            compactions=self._compactions,
+        )

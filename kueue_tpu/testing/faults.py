@@ -1,0 +1,141 @@
+"""Named fault-injection points for crash-consistency testing.
+
+The durable-state subsystem (kueue_tpu/storage) makes exact promises
+about which crash windows are recoverable: "record appended but not yet
+applied", "checkpoint tmp written but not yet renamed", "solve finished
+but outcome not yet applied". Each of those windows is marked in
+production code with ``fire("<point name>")`` — a no-op unless a test
+armed the point — so the chaos suite can kill the process (in effect:
+raise through the whole call stack) at every registered point and prove
+recovery converges.
+
+Registered points (grep for ``faults.fire`` to audit):
+
+  journal.post_append_pre_apply   a journal record is durable but the
+                                  in-memory mutation it describes has
+                                  not completed (ClusterRuntime hooks)
+  journal.fsync                   immediately before os.fsync on the
+                                  journal segment — arm with an OSError
+                                  action to simulate ENOSPC/EIO and
+                                  drive the degraded-persistence path
+  checkpoint.mid_write            checkpoint tmp file fully written +
+                                  fsynced, os.replace not yet executed
+  cycle.post_solve_pre_apply      scheduler nomination / drain solve
+                                  complete, outcome not yet applied
+
+Crashes are raised as ``InjectedCrash(BaseException)`` on purpose:
+broad ``except Exception`` recovery paths in the server must NOT be
+able to swallow a simulated power loss — only the test harness catches
+it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, Optional
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death at a named fault point."""
+
+
+class _Armed:
+    __slots__ = ("action", "skip", "fired")
+
+    def __init__(self, action, skip: int):
+        self.action = action
+        self.skip = skip  # fire() calls to let through before acting
+        self.fired = 0  # times the ACTION ran
+
+
+_lock = threading.Lock()
+_armed: Dict[str, _Armed] = {}
+
+
+def fire(name: str) -> None:
+    """Production-side hook. Free when nothing is armed (one falsy dict
+    probe); runs the armed action otherwise. ``action="crash"`` raises
+    InjectedCrash; a callable action is invoked (and may raise, e.g.
+    OSError for a simulated fsync failure)."""
+    if not _armed:
+        return
+    with _lock:
+        a = _armed.get(name)
+        if a is None:
+            return
+        if a.skip > 0:
+            a.skip -= 1
+            return
+        a.fired += 1
+        action = a.action
+    if action == "crash":
+        raise InjectedCrash(f"injected crash at fault point {name!r}")
+    action()
+
+
+def arm(name: str, action="crash", skip: int = 0) -> None:
+    """Arm ``name``: the (skip+1)-th fire() runs ``action`` (and every
+    later one too, until reset/disarm)."""
+    with _lock:
+        _armed[name] = _Armed(action, skip)
+
+
+def disarm(name: str) -> int:
+    """Disarm one point; returns how many times its action ran."""
+    with _lock:
+        a = _armed.pop(name, None)
+        return a.fired if a is not None else 0
+
+
+def fired(name: str) -> int:
+    with _lock:
+        a = _armed.get(name)
+        return a.fired if a is not None else 0
+
+
+def reset() -> None:
+    """Disarm everything (test teardown)."""
+    with _lock:
+        _armed.clear()
+
+
+def fire_count(name: str) -> Optional[int]:
+    """How many fire() calls remain before the action triggers (None
+    when not armed) — lets sweeps enumerate occurrence indices."""
+    with _lock:
+        a = _armed.get(name)
+        return a.skip if a is not None else None
+
+
+def make_failing_fsync(errno_: int = 28) -> Callable[[], None]:
+    """Action for ``journal.fsync``: raise ENOSPC (default) the way a
+    full volume would."""
+
+    def _raise():
+        raise OSError(errno_, os.strerror(errno_))
+
+    return _raise
+
+
+def corrupt_tail(segment_path: str, nbytes: int = 7) -> None:
+    """Torn-tail corruptor: truncate the last ``nbytes`` of a journal
+    segment, simulating a power loss mid-append (the kernel got part of
+    the frame to disk). ``nbytes`` larger than the file empties it."""
+    size = os.path.getsize(segment_path)
+    with open(segment_path, "rb+") as f:
+        f.truncate(max(0, size - nbytes))
+
+
+def garble_tail(segment_path: str, nbytes: int = 4) -> None:
+    """Bit-rot corruptor: flip the last ``nbytes`` in place (frame
+    length intact, CRC now wrong) — the other torn-tail shape."""
+    size = os.path.getsize(segment_path)
+    if size == 0:
+        return
+    n = min(nbytes, size)
+    with open(segment_path, "rb+") as f:
+        f.seek(size - n)
+        tail = f.read(n)
+        f.seek(size - n)
+        f.write(bytes(b ^ 0xFF for b in tail))

@@ -32,20 +32,51 @@ from typing import Callable, Optional
 from kueue_tpu.utils.clock import Clock
 
 
-def atomic_write_text(path: str, text: str, prefix: str = ".tmp-") -> None:
+def atomic_write_text(path: str, text: str, prefix: str = ".tmp-",
+                      durable: bool = True, fault_point: str = "") -> None:
     """Write ``text`` to ``path`` via unique tmp + os.replace: a reader
     never sees a torn file, a crash mid-write leaves the previous copy
     intact, and a FAILED write never leaks its tmp file (a full shared
-    volume must not accumulate orphans on every retry)."""
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".", prefix=prefix)
+    volume must not accumulate orphans on every retry).
+
+    ``durable`` (default): fsync the tmp file BEFORE os.replace and the
+    parent directory AFTER — without both, the rename can land while
+    the data (or the directory entry) is still only in the page cache,
+    and a power loss leaves an empty/old lease or checkpoint. That is
+    fatal for exactly the files this writes: the fencing-token lease
+    and the fenced state checkpoint.
+
+    ``fault_point``: name of a kueue_tpu.testing.faults point fired
+    between the durable tmp write and the rename (the
+    ``checkpoint.mid_write`` crash window)."""
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=prefix)
     try:
         with os.fdopen(fd, "w") as f:
             f.write(text)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
+        if fault_point:
+            from kueue_tpu.testing import faults
+
+            faults.fire(fault_point)
         os.replace(tmp, path)
     except BaseException:
         with contextlib.suppress(OSError):
             os.unlink(tmp)
         raise
+    if durable:
+        # the rename itself must reach the disk: fsync the directory.
+        # Best-effort (suppress) only because some filesystems refuse
+        # O_RDONLY-fd fsync on directories; the file data is already
+        # durable either way.
+        with contextlib.suppress(OSError):
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
 
 
 @dataclass

@@ -128,6 +128,119 @@ class TestLeaderElector:
         assert b.tick()
 
 
+class TestLeaseContention:
+    """Two electors on ONE lease file with SKEWED clocks — the
+    replicas-disagree-about-time shape Lease-based election tolerates
+    as long as skew stays well under the lease duration. Across every
+    handover the fencing token must strictly increase, and a deposed
+    holder's fenced checkpoint must be refused."""
+
+    def test_token_strictly_increases_across_skewed_handovers(self, tmp_path):
+        # b's clock runs 3 s ahead of a's: expiry judgments disagree
+        # but takeover still happens only after a full duration of
+        # staleness as seen by the TAKING replica
+        clock_a = FakeClock(start=100.0)
+        clock_b = FakeClock(start=103.0)
+        a = LeaderElector(make_lease(tmp_path, "a", clock_a, duration=15.0))
+        b = LeaderElector(make_lease(tmp_path, "b", clock_b, duration=15.0))
+        tokens = []
+
+        def advance(dt):
+            clock_a.advance(dt)
+            clock_b.advance(dt)
+
+        assert a.tick()
+        tokens.append(a.lease.token)
+        for _ in range(4):
+            # current leader stalls: no renewals; the OTHER replica
+            # ticks until it takes over
+            holder, taker = (a, b) if a.is_leader else (b, a)
+            for _ in range(40):
+                advance(1.0)
+                if taker.tick():
+                    break
+            assert taker.is_leader
+            assert not holder.tick()  # fencing: renewal refused
+            tokens.append(taker.lease.token)
+        assert tokens == sorted(tokens)
+        assert len(set(tokens)) == len(tokens), f"token reused: {tokens}"
+        for prev, cur in zip(tokens, tokens[1:]):
+            assert cur > prev
+
+    def test_deposed_holder_checkpoint_refused_under_skew(self, tmp_path):
+        from kueue_tpu.server.__main__ import fenced_checkpoint
+
+        clock_old = FakeClock(start=100.0)
+        clock_new = FakeClock(start=98.0)  # new replica's clock lags
+        state = str(tmp_path / "state.json")
+        old = KueueServer(
+            elector=LeaderElector(make_lease(tmp_path, "old", clock_old))
+        )
+        new = KueueServer(
+            elector=LeaderElector(make_lease(tmp_path, "new", clock_new))
+        )
+        old.elector.tick()
+        assert fenced_checkpoint(old, state)
+        clock_old.advance(60.0)
+        clock_new.advance(60.0)
+        assert new.elector.tick()  # takeover under the lagging clock
+        new.apply("resourceflavors", {"name": "survivor", "nodeLabels": {}})
+        assert fenced_checkpoint(new, state)
+        # the stalled pre-deposition leader resumes and checkpoints:
+        # refused — the on-disk record no longer names it
+        assert not fenced_checkpoint(old, state)
+        with open(state) as f:
+            names = [fl["name"] for fl in json.load(f)["resourceFlavors"]]
+        assert names == ["survivor"]
+
+
+class TestAtomicWriteDurability:
+    def test_tmp_fsynced_before_replace_and_dir_after(self, tmp_path, monkeypatch):
+        # power-loss safety: the data must be on disk before the rename
+        # makes it visible, and the rename itself must be fsynced via
+        # the parent directory
+        import os as os_mod
+
+        from kueue_tpu.utils.lease import atomic_write_text
+
+        calls = []
+        real_fsync, real_replace = os_mod.fsync, os_mod.replace
+        monkeypatch.setattr(
+            "os.fsync", lambda fd: (calls.append(("fsync", fd)), real_fsync(fd))[1]
+        )
+        monkeypatch.setattr(
+            "os.replace",
+            lambda a, b: (calls.append(("replace",)), real_replace(a, b))[1],
+        )
+        target = tmp_path / "lease"
+        atomic_write_text(str(target), "data")
+        assert target.read_text() == "data"
+        kinds = [c[0] for c in calls]
+        # file fsync, then replace, then directory fsync
+        assert kinds == ["fsync", "replace", "fsync"]
+
+    def test_failed_durable_write_still_unlinks_tmp(self, tmp_path):
+        from kueue_tpu.utils.lease import atomic_write_text
+
+        bad = tmp_path / "adir"
+        bad.mkdir()
+        with pytest.raises(OSError):
+            atomic_write_text(str(bad), "hi")
+        leftovers = [p.name for p in tmp_path.iterdir()
+                     if p.name.startswith(".tmp-")]
+        assert leftovers == []
+
+    def test_non_durable_mode_skips_fsync(self, tmp_path, monkeypatch):
+        from kueue_tpu.utils.lease import atomic_write_text
+
+        calls = []
+        monkeypatch.setattr("os.fsync", lambda fd: calls.append(fd))
+        target = tmp_path / "x"
+        atomic_write_text(str(target), "hi", durable=False)
+        assert target.read_text() == "hi"
+        assert calls == []
+
+
 CQ = {
     "name": "cq",
     "namespaceSelector": {},

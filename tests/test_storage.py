@@ -1,0 +1,917 @@
+"""Durable-state subsystem tests: write-ahead journal framing and
+rotation, torn-tail tolerance, fencing-token refusal, checkpoint
+compaction, degraded-persistence flip/self-heal, the control-plane
+invariant checker, and the kill-at-every-crash-point chaos property:
+for a seeded admission/preemption trace, crashing at each registered
+fault point and recovering yields a runtime where ``check_invariants``
+holds and the admitted set equals the no-crash run — no lost,
+duplicated, or double-charged admission.
+"""
+
+import json
+import os
+
+import pytest
+
+from kueue_tpu import serialization as ser
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.models import (
+    ClusterQueue,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.storage import (
+    Journal,
+    RecoveryError,
+    recover,
+    scan_segment,
+    verify_chain,
+)
+from kueue_tpu.storage.recovery import apply_record
+from kueue_tpu.testing import faults
+from kueue_tpu.utils.clock import FakeClock
+from kueue_tpu.utils.lease import atomic_write_text
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---- scenario helpers ----
+def cq_dict(name, quota="4", cohort=None, preempt=False):
+    return {
+        "name": name,
+        "cohort": cohort,
+        "namespaceSelector": {},
+        "preemption": {
+            "withinClusterQueue": "LowerPriority" if preempt else "Never",
+            "reclaimWithinCohort": "Never",
+            "borrowWithinCohort": {"policy": "Never"},
+        },
+        "resourceGroups": [
+            {
+                "coveredResources": ["cpu"],
+                "flavors": [
+                    {
+                        "name": "default",
+                        "resources": [
+                            {"name": "cpu", "nominalQuota": quota}
+                        ],
+                    }
+                ],
+            }
+        ],
+    }
+
+
+def wl_dict(name, cq_index=0, prio=0, cpu="1", t=0.0):
+    wl = Workload(
+        namespace="ns", name=name, queue_name=f"lq-{cq_index}",
+        priority=prio, creation_time=t,
+        pod_sets=(PodSet.build("main", 1, {"cpu": cpu}),),
+    )
+    return ser.workload_to_dict(wl)
+
+
+def fresh_rt(clock_start=0.0):
+    return ClusterRuntime(
+        clock=FakeClock(clock_start), use_solver=False,
+        bulk_drain_threshold=None,
+    )
+
+
+def simple_rt(tmp_path, with_journal=True, fsync="interval"):
+    rt = fresh_rt()
+    journal = None
+    if with_journal:
+        journal = Journal(
+            str(tmp_path / "journal"), fsync_policy=fsync
+        ).open()
+        rt.attach_journal(journal)
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(ser.cq_from_dict(cq_dict("cq-0")))
+    rt.add_local_queue(
+        LocalQueue(namespace="ns", name="lq-0", cluster_queue="cq-0")
+    )
+    return rt, journal
+
+
+def admitted_set(rt):
+    return frozenset(
+        k for k, wl in rt.workloads.items() if wl.is_admitted
+    )
+
+
+class TestJournalFraming:
+    def test_roundtrip(self, tmp_path):
+        j = Journal(str(tmp_path / "j")).open()
+        for i in range(5):
+            rec = j.append("workload_upsert", {"i": i}, rv=i + 1, token=7)
+            assert rec is not None and rec.seq == i + 1
+        j.close()
+        j2 = Journal(str(tmp_path / "j")).open()
+        recs = list(j2.records())
+        assert [r.data["i"] for r in recs] == list(range(5))
+        assert [r.seq for r in recs] == [1, 2, 3, 4, 5]
+        assert all(r.token == 7 for r in recs)
+        assert j2.last_seq == 5
+        j2.close()
+
+    def test_segment_rotation_and_seq_continuity(self, tmp_path):
+        j = Journal(str(tmp_path / "j"), segment_max_bytes=256).open()
+        for i in range(40):
+            j.append("workload_upsert", {"pad": "x" * 40, "i": i})
+        st = j.stats()
+        assert st.segments > 1
+        assert [r.data["i"] for r in j.records()] == list(range(40))
+        j.close()
+        # reopen resumes the seq after the newest record
+        j2 = Journal(str(tmp_path / "j"), segment_max_bytes=256).open()
+        assert j2.last_seq == 40
+        rec = j2.append("workload_upsert", {"i": 40})
+        assert rec.seq == 41
+        j2.close()
+
+    def test_torn_tail_truncated_on_open(self, tmp_path):
+        j = Journal(str(tmp_path / "j")).open()
+        for i in range(10):
+            j.append("workload_upsert", {"i": i})
+        j.close()
+        seg = j.segment_paths()[-1]
+        faults.corrupt_tail(seg, nbytes=9)  # rip into the last frame
+        j2 = Journal(str(tmp_path / "j")).open()
+        got = [r.data["i"] for r in j2.records()]
+        assert got == list(range(9))  # only the torn record is lost
+        assert j2.stats().torn_bytes_truncated > 0
+        # the journal accepts appends after truncation, seq reuses the
+        # torn record's slot (it never durably existed)
+        rec = j2.append("workload_upsert", {"i": "fresh"})
+        assert rec.seq == 10
+        j2.close()
+
+    def test_garbled_tail_stops_scan(self, tmp_path):
+        j = Journal(str(tmp_path / "j")).open()
+        for i in range(6):
+            j.append("workload_upsert", {"i": i})
+        j.close()
+        seg = j.segment_paths()[-1]
+        faults.garble_tail(seg, nbytes=4)  # CRC now wrong, length intact
+        rep = scan_segment(seg)
+        assert rep.torn and rep.records == 5
+        j2 = Journal(str(tmp_path / "j")).open()
+        assert [r.data["i"] for r in j2.records()] == list(range(5))
+        j2.close()
+
+    def test_empty_and_missing_dir(self, tmp_path):
+        j = Journal(str(tmp_path / "does" / "not" / "exist")).open()
+        assert list(j.records()) == []
+        assert j.last_seq == 0
+        j.close()
+
+    def test_compaction_deletes_covered_segments(self, tmp_path):
+        j = Journal(str(tmp_path / "j"), segment_max_bytes=256).open()
+        for i in range(40):
+            j.append("workload_upsert", {"pad": "x" * 40, "i": i})
+        before = len(j.segment_paths())
+        assert before > 2
+        deleted = j.compact(upto_seq=20)
+        assert deleted > 0
+        # everything newer than the compaction point survives
+        got = [r.data["i"] for r in j.records(min_seq=20)]
+        assert got == list(range(20, 40))
+        # full compaction seals the active segment and empties the rest
+        j.compact(upto_seq=40)
+        assert list(j.records(min_seq=40)) == []
+        rec = j.append("workload_upsert", {"i": 40})
+        assert rec.seq == 41
+        j.close()
+
+    @pytest.mark.parametrize("policy", ["always", "interval", "never"])
+    def test_fsync_policies_roundtrip(self, tmp_path, policy):
+        j = Journal(str(tmp_path / "j"), fsync_policy=policy).open()
+        for i in range(8):
+            assert j.append("workload_upsert", {"i": i}) is not None
+        if policy == "always":
+            assert j.stats().fsyncs >= 8
+        elif policy == "never":
+            # only lifecycle syncs (none yet): appends never fsync
+            assert j.stats().fsyncs == 0
+        j.close()
+        j2 = Journal(str(tmp_path / "j")).open()
+        assert [r.data["i"] for r in j2.records()] == list(range(8))
+        j2.close()
+
+    def test_partial_write_failure_truncated_and_recovers(self, tmp_path):
+        # ENOSPC mid-frame: the partial tail must be cut back so that
+        # records appended after the volume recovers stay readable
+        j = Journal(str(tmp_path / "j"), fsync_policy="never").open()
+        j.append("workload_upsert", {"i": 0})
+        real = j._fh
+
+        class HalfWrite:
+            def __init__(self, fh):
+                self.fh = fh
+
+            def write(self, b):
+                self.fh.write(b[: len(b) // 2])
+                raise OSError(28, "No space left on device")
+
+            def __getattr__(self, name):
+                return getattr(self.fh, name)
+
+        j._fh = HalfWrite(real)
+        assert j.append("workload_upsert", {"i": 1}) is None
+        assert j.degraded and j.stats().dropped_appends == 1
+        j._fh = real
+        rec = j.append("workload_upsert", {"i": 2})
+        assert rec is not None and rec.seq == 2 and not j.degraded
+        j.close()
+        j2 = Journal(str(tmp_path / "j")).open()
+        assert [r.data["i"] for r in j2.records()] == [0, 2]
+        assert [r.seq for r in j2.records()] == [1, 2]  # gap-free
+        j2.close()
+
+    def test_rejects_unknown_policy(self, tmp_path):
+        with pytest.raises(ValueError):
+            Journal(str(tmp_path / "j"), fsync_policy="sometimes")
+
+
+class TestDegradedPersistence:
+    def test_fsync_failure_degrades_and_self_heals(self, tmp_path):
+        rt, journal = simple_rt(tmp_path, fsync="always")
+        assert rt.metrics.journal_degraded.value() == 0
+        faults.arm("journal.fsync", faults.make_failing_fsync())
+        rt.add_workload(ser.workload_from_dict(wl_dict("w0")))
+        assert journal.degraded
+        assert rt.metrics.journal_degraded.value() == 1
+        assert any(e.kind == "JournalDegraded" for e in rt.events)
+        assert rt.metrics.journal_append_errors_total.value() >= 1
+        # an fsync failure does NOT lose the record (it reached the
+        # OS); only a failed WRITE drops one — the seq keeps advancing
+        # so the chain stays gap-free
+        assert journal.stats().dropped_appends == 0
+        assert journal.last_seq > 0
+        # the volume recovers: the next append self-heals
+        faults.reset()
+        rt.add_workload(ser.workload_from_dict(wl_dict("w1")))
+        assert not journal.degraded
+        assert rt.metrics.journal_degraded.value() == 0
+        assert any(e.kind == "JournalRecovered" for e in rt.events)
+        # the runtime kept serving throughout — both workloads landed
+        assert "ns/w0" in rt.workloads and "ns/w1" in rt.workloads
+        journal.close()
+
+    def test_healthz_reports_degraded(self, tmp_path):
+        import urllib.request
+
+        from kueue_tpu.server import KueueServer
+
+        rt, journal = simple_rt(tmp_path, fsync="always")
+        srv = KueueServer(runtime=rt)
+        port = srv.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz"
+            ) as r:
+                body = json.loads(r.read())
+            assert body["status"] == "ok"
+            assert body["persistence"]["mode"] == "journaling"
+            faults.arm("journal.fsync", faults.make_failing_fsync())
+            srv.apply("workloads", wl_dict("w0"))
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz"
+            ) as r:
+                body = json.loads(r.read())
+            assert body["status"] == "degraded"
+            assert body["persistence"]["mode"] == "degraded"
+            assert body["persistence"]["lastError"]
+        finally:
+            srv.stop()
+            journal.close()
+
+    def test_debugger_dump_includes_journal_stats(self, tmp_path):
+        from kueue_tpu.debugger import dump
+
+        rt, journal = simple_rt(tmp_path)
+        rt.add_workload(ser.workload_from_dict(wl_dict("w0")))
+        rt.run_until_idle()
+        text = dump(rt)
+        assert "persistence (write-ahead journal)" in text
+        assert "degraded=False" in text
+        assert f"lastSeq={journal.last_seq}" in text
+        journal.close()
+
+
+class TestRecovery:
+    def test_journal_only_replay_matches_live_state(self, tmp_path):
+        rt, journal = simple_rt(tmp_path)
+        for i in range(6):
+            rt.add_workload(
+                ser.workload_from_dict(wl_dict(f"w{i}", t=float(i)))
+            )
+        rt.run_until_idle()
+        live_admitted = admitted_set(rt)
+        assert live_admitted  # quota 4, six 1-cpu workloads: 4 admitted
+        journal.close()
+
+        res = recover(None, str(tmp_path / "journal"), runtime=fresh_rt())
+        rt2 = res.runtime
+        assert res.replayed > 0
+        assert admitted_set(rt2) == live_admitted
+        assert rt2.cache.usage_for("cq-0") == rt.cache.usage_for("cq-0")
+        assert rt2.check_invariants() == []
+        assert (
+            rt2.metrics.recovery_replayed_records_total.value()
+            == res.replayed
+        )
+        assert rt2.metrics.recovery_runs_total.value() == 1
+        res.journal.close()
+
+    def test_checkpoint_plus_journal_and_compaction(self, tmp_path):
+        state = str(tmp_path / "state.json")
+        rt, journal = simple_rt(tmp_path)
+        rt.add_workload(ser.workload_from_dict(wl_dict("early", t=0.0)))
+        rt.run_until_idle()
+        # checkpoint covering the journal so far; compact
+        snap = ser.runtime_to_state(rt)
+        atomic_write_text(state, json.dumps(snap), ".state-")
+        journal.compact(snap["persistence"]["journalSeq"])
+        # post-checkpoint mutations live only in the journal
+        rt.add_workload(ser.workload_from_dict(wl_dict("late", t=1.0)))
+        rt.run_until_idle()
+        live_admitted = admitted_set(rt)
+        journal.close()
+
+        res = recover(state, str(tmp_path / "journal"), runtime=fresh_rt())
+        assert res.checkpoint_loaded
+        assert admitted_set(res.runtime) == live_admitted
+        assert "ns/early" in res.runtime.workloads
+        assert "ns/late" in res.runtime.workloads
+        assert res.runtime.check_invariants() == []
+        res.journal.close()
+
+    def test_replay_is_idempotent_for_applied_records(self, tmp_path):
+        # the journal.post_append_pre_apply shape: a record exists for a
+        # mutation that DID complete in memory before the crash; replay
+        # applies it again onto the checkpoint — usage must not double
+        rt, journal = simple_rt(tmp_path)
+        rt.add_workload(ser.workload_from_dict(wl_dict("w0")))
+        rt.run_until_idle()
+        journal.close()
+        res = recover(None, str(tmp_path / "journal"), runtime=fresh_rt())
+        rt2 = res.runtime
+        # re-apply EVERY record a second time: upserts converge
+        for rec in res.journal.records():
+            apply_record(rt2, rec)
+        assert rt2.check_invariants() == []
+        assert len(admitted_set(rt2)) == 1
+        from kueue_tpu.resources import FlavorResource
+
+        assert rt2.cache.usage_for("cq-0") == {
+            FlavorResource("default", "cpu"): 1000
+        }
+        res.journal.close()
+
+    def test_stale_fencing_token_records_refused(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        rt, journal = simple_rt(tmp_path)
+        journal.token_provider = lambda: 2  # the CURRENT leader
+        rt.add_workload(ser.workload_from_dict(wl_dict("current", t=0.0)))
+        rt.run_until_idle()
+        # a deposed leader (token 1) resumes from a stall and appends a
+        # stray record AFTER the new leader's writes
+        journal.token_provider = lambda: 1
+        rt.add_workload(ser.workload_from_dict(wl_dict("stray", t=1.0)))
+        journal.close()
+
+        res = recover(None, jdir, runtime=fresh_rt())
+        assert res.skipped_stale >= 1
+        assert "ns/current" in res.runtime.workloads
+        assert "ns/stray" not in res.runtime.workloads
+        assert (
+            res.runtime.metrics.recovery_skipped_stale_records_total.value()
+            == res.skipped_stale
+        )
+        assert res.runtime.check_invariants() == []
+        res.journal.close()
+
+    def test_torn_tail_recovery_counted_in_metrics(self, tmp_path):
+        rt, journal = simple_rt(tmp_path)
+        for i in range(4):
+            rt.add_workload(ser.workload_from_dict(wl_dict(f"w{i}")))
+        rt.run_until_idle()
+        journal.close()
+        faults.corrupt_tail(journal.segment_paths()[-1], nbytes=5)
+        res = recover(None, str(tmp_path / "journal"), runtime=fresh_rt())
+        assert res.torn_bytes > 0
+        assert (
+            res.runtime.metrics.recovery_torn_bytes_total.value()
+            == res.torn_bytes
+        )
+        assert res.runtime.check_invariants() == []
+        res.journal.close()
+
+    def test_strict_recovery_refuses_invariant_violations(self, tmp_path):
+        rt, journal = simple_rt(tmp_path)
+        rt.add_workload(ser.workload_from_dict(wl_dict("w0")))
+        rt.run_until_idle()
+        journal.close()
+
+        class Broken(ClusterRuntime):
+            def check_invariants(self):
+                return ["synthetic violation"]
+
+        with pytest.raises(RecoveryError) as e:
+            recover(
+                None, str(tmp_path / "journal"),
+                runtime=Broken(clock=FakeClock(0.0), use_solver=False),
+            )
+        assert "synthetic violation" in str(e.value)
+
+    def test_config_changes_replay(self, tmp_path):
+        rt, journal = simple_rt(tmp_path)
+        rt.add_cluster_queue(ser.cq_from_dict(cq_dict("cq-extra", "8")))
+        rt.add_local_queue(
+            LocalQueue(namespace="ns", name="lq-extra",
+                       cluster_queue="cq-extra")
+        )
+        rt.add_flavor(ResourceFlavor(name="spare"))
+        rt.delete_flavor("spare")
+        journal.close()
+        res = recover(None, str(tmp_path / "journal"), runtime=fresh_rt())
+        rt2 = res.runtime
+        assert "cq-extra" in rt2.cache.cluster_queues
+        assert "ns/lq-extra" in rt2.cache.local_queues
+        assert "spare" not in rt2.cache.flavors
+        assert "default" in rt2.cache.flavors
+        res.journal.close()
+
+
+class TestVerifyChain:
+    def test_clean_chain_ok(self, tmp_path):
+        rt, journal = simple_rt(tmp_path)
+        rt.add_workload(ser.workload_from_dict(wl_dict("w0")))
+        rt.run_until_idle()
+        journal.close()
+        rep = verify_chain(str(tmp_path / "journal"))
+        assert rep.ok and rep.records > 0 and not rep.torn_tail
+
+    def test_torn_final_segment_is_benign(self, tmp_path):
+        rt, journal = simple_rt(tmp_path)
+        for i in range(4):
+            rt.add_workload(ser.workload_from_dict(wl_dict(f"w{i}")))
+        journal.close()
+        faults.garble_tail(journal.segment_paths()[-1])
+        rep = verify_chain(str(tmp_path / "journal"))
+        assert rep.torn_tail and rep.ok  # expected crash shape
+
+    def test_corrupt_middle_segment_fails(self, tmp_path):
+        j = Journal(str(tmp_path / "j"), segment_max_bytes=256).open()
+        for i in range(30):
+            j.append("workload_upsert", {"pad": "x" * 40, "i": i})
+        paths = j.segment_paths()
+        j.close()
+        assert len(paths) > 2
+        faults.garble_tail(paths[0])
+        rep = verify_chain(str(tmp_path / "j"))
+        assert rep.corrupt and not rep.ok
+
+    def test_stale_tokens_reported_not_fatal(self, tmp_path):
+        j = Journal(str(tmp_path / "j")).open()
+        j.append("workload_upsert", {"i": 0}, token=2)
+        j.append("workload_upsert", {"i": 1}, token=1)  # deposed stray
+        j.close()
+        rep = verify_chain(str(tmp_path / "j"))
+        assert rep.ok and rep.stale_token_records == 1
+
+
+class TestInvariants:
+    def test_clean_runtime_passes(self, tmp_path):
+        rt, journal = simple_rt(tmp_path)
+        for i in range(3):
+            rt.add_workload(ser.workload_from_dict(wl_dict(f"w{i}")))
+        rt.run_until_idle()
+        assert rt.check_invariants() == []
+        journal.close()
+
+    def test_usage_drift_detected(self, tmp_path):
+        rt, _ = simple_rt(tmp_path, with_journal=False)
+        rt.add_workload(ser.workload_from_dict(wl_dict("w0")))
+        rt.run_until_idle()
+        from kueue_tpu.resources import FlavorResource
+
+        cached = rt.cache.cluster_queues["cq-0"]
+        cached.usage[FlavorResource("default", "cpu")] += 500  # corrupt
+        violations = rt.check_invariants()
+        assert any("usage != sum of admitted" in v for v in violations)
+
+    def test_pending_and_admitted_simultaneously_detected(self, tmp_path):
+        rt, _ = simple_rt(tmp_path, with_journal=False)
+        rt.add_workload(ser.workload_from_dict(wl_dict("w0")))
+        rt.run_until_idle()
+        wl = rt.workloads["ns/w0"]
+        assert wl.is_admitted
+        # force the admitted workload back into the pending heap
+        rt.queues.cluster_queues["cq-0"].heap.push_or_update(wl)
+        violations = rt.check_invariants()
+        assert any("simultaneously pending" in v for v in violations)
+
+    def test_unknown_pending_key_detected(self, tmp_path):
+        rt, _ = simple_rt(tmp_path, with_journal=False)
+        ghost = ser.workload_from_dict(wl_dict("ghost"))
+        rt.queues.cluster_queues["cq-0"].heap.push_or_update(ghost)
+        violations = rt.check_invariants()
+        assert any("not in store" in v for v in violations)
+
+    def test_resource_version_regression_detected(self, tmp_path):
+        rt, journal = simple_rt(tmp_path)
+        rt.add_workload(ser.workload_from_dict(wl_dict("w0")))
+        rt.resource_version = 0  # simulate a counter rollback
+        violations = rt.check_invariants()
+        assert any("resourceVersion regressed" in v for v in violations)
+        journal.close()
+
+
+class TestKueuectlState:
+    """`kueuectl state verify` / `state replay` — the offline fsck."""
+
+    def _make_volume(self, tmp_path):
+        rt, journal = simple_rt(tmp_path)
+        for i in range(5):
+            rt.add_workload(
+                ser.workload_from_dict(wl_dict(f"w{i}", t=float(i)))
+            )
+        rt.run_until_idle()
+        state = str(tmp_path / "state.json")
+        _do_checkpoint(rt, state)
+        rt.add_workload(ser.workload_from_dict(wl_dict("post", t=9.0)))
+        rt.run_until_idle()
+        journal.close()
+        return state, str(tmp_path / "journal"), admitted_set(rt)
+
+    def test_verify_ok(self, tmp_path, capsys):
+        from kueue_tpu.cli.__main__ import main
+
+        state, jdir, _ = self._make_volume(tmp_path)
+        rc = main(["--state", state, "state", "verify", "--journal", jdir])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "verify: OK" in out
+        assert "recovery dry run" in out
+
+    def test_verify_nonzero_on_corrupt_checkpoint(self, tmp_path, capsys):
+        from kueue_tpu.cli.__main__ import main
+
+        state, jdir, _ = self._make_volume(tmp_path)
+        with open(state, "w") as f:
+            f.write("{not json")
+        with pytest.raises(SystemExit) as e:
+            main(["--state", state, "state", "verify", "--journal", jdir])
+        assert e.value.code == 2
+        assert "CORRUPT" in capsys.readouterr().out
+
+    def test_verify_nonzero_on_corrupt_middle_segment(self, tmp_path, capsys):
+        from kueue_tpu.cli.__main__ import main
+
+        j = Journal(str(tmp_path / "j"), segment_max_bytes=256).open()
+        for i in range(30):
+            j.append("workload_upsert", {"pad": "x" * 40, "i": i})
+        paths = j.segment_paths()
+        j.close()
+        faults.garble_tail(paths[0])
+        with pytest.raises(SystemExit) as e:
+            main([
+                "--state", str(tmp_path / "nope.json"),
+                "state", "verify", "--journal", str(tmp_path / "j"),
+            ])
+        assert e.value.code == 2
+
+    def test_verify_reports_torn_tail_as_benign(self, tmp_path, capsys):
+        from kueue_tpu.cli.__main__ import main
+
+        state, jdir, _ = self._make_volume(tmp_path)
+        segs = sorted(
+            os.path.join(jdir, n) for n in os.listdir(jdir)
+        )
+        faults.garble_tail(segs[-1])
+        rc = main(["--state", state, "state", "verify", "--journal", jdir])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "torn tail on the final segment: benign" in out
+
+    def test_replay_materializes_recovered_state(self, tmp_path, capsys):
+        from kueue_tpu.cli.__main__ import main
+
+        state, jdir, live_admitted = self._make_volume(tmp_path)
+        out_path = str(tmp_path / "replayed.json")
+        rc = main([
+            "--state", state, "state", "replay",
+            "--journal", jdir, "-o", out_path,
+        ])
+        assert rc == 0
+        with open(out_path) as f:
+            replayed = json.load(f)
+        # the post-checkpoint workload exists only via the journal
+        names = {w["name"] for w in replayed["workloads"]}
+        assert "post" in names
+        # the materialized file loads as a normal state file and agrees
+        rt = ser.runtime_from_state(replayed, runtime=fresh_rt())
+        assert admitted_set(rt) == live_admitted
+
+
+class TestServerCheckpointIntegration:
+    def test_fenced_checkpoint_embeds_persistence_and_compacts(self, tmp_path):
+        from kueue_tpu.server import KueueServer
+        from kueue_tpu.server.__main__ import fenced_checkpoint
+
+        rt, journal = simple_rt(tmp_path)
+        srv = KueueServer(runtime=rt, auto_reconcile=False)
+        for i in range(4):
+            srv.apply("workloads", wl_dict(f"w{i}", t=float(i)),
+                      reconcile=False)
+        rt.run_until_idle()
+        seq_before = journal.last_seq
+        assert seq_before > 0
+        state = str(tmp_path / "state.json")
+        assert fenced_checkpoint(srv, state)
+        with open(state) as f:
+            snap = json.load(f)
+        assert snap["persistence"]["journalSeq"] == seq_before
+        assert snap["persistence"]["resourceVersion"] == rt.resource_version
+        assert "token" in snap["persistence"]
+        # the checkpoint compacted the fully-covered journal prefix
+        assert list(journal.records(min_seq=0)) == []
+        # post-checkpoint mutations start a fresh tail; recovery stacks
+        # them on the checkpoint
+        srv.apply("workloads", wl_dict("late", t=9.0), reconcile=False)
+        rt.run_until_idle()
+        journal.close()
+        res = recover(state, str(tmp_path / "journal"), runtime=fresh_rt())
+        assert res.checkpoint_loaded and res.replayed > 0
+        assert "ns/late" in res.runtime.workloads
+        assert admitted_set(res.runtime) == admitted_set(rt)
+        assert res.runtime.check_invariants() == []
+        res.journal.close()
+
+    def test_promote_reload_with_journal(self, tmp_path):
+        from kueue_tpu.server import KueueServer
+        from kueue_tpu.server.__main__ import fenced_checkpoint, promote_reload
+
+        rt, journal = simple_rt(tmp_path)
+        leader = KueueServer(runtime=rt, auto_reconcile=False)
+        leader.apply("workloads", wl_dict("w0"), reconcile=False)
+        rt.run_until_idle()
+        state = str(tmp_path / "state.json")
+        assert fenced_checkpoint(leader, state)
+        # a post-checkpoint admission the standby can only learn from
+        # the journal
+        leader.apply("workloads", wl_dict("w1", t=1.0), reconcile=False)
+        rt.run_until_idle()
+        journal.close()  # leader dies
+
+        standby = KueueServer()
+        assert promote_reload(
+            standby, state, fresh_rt, journal_path=str(tmp_path / "journal")
+        )
+        assert "ns/w1" in standby.runtime.workloads
+        assert standby.runtime.journal is not None
+        assert standby.runtime.check_invariants() == []
+        standby.runtime.journal.close()
+
+
+# ---- the chaos property ----
+CRASH_POINTS = (
+    "journal.post_append_pre_apply",
+    "cycle.post_solve_pre_apply",
+    "checkpoint.mid_write",
+)
+
+
+def make_trace(rng, n_cq=3, n_wl=24):
+    """A randomized admission/preemption trace as a replayable op list.
+    Distinct priorities + creation times keep the scheduler's decisions
+    order-deterministic, so crash/recover/continue must converge to the
+    no-crash fixed point."""
+    ops = [("config", None)]
+    prios = [int(p) for p in rng.permutation(n_wl * 10)[:n_wl]]
+    added = []
+    for i in range(n_wl):
+        ops.append(
+            (
+                "add",
+                wl_dict(
+                    f"w{i}",
+                    cq_index=int(rng.integers(0, n_cq)),
+                    prio=prios[i],
+                    cpu=str(int(rng.integers(1, 3))),
+                    t=float(i),
+                ),
+            )
+        )
+        added.append(f"ns/w{i}")
+        r = rng.random()
+        if r < 0.15 and added:
+            victim = added[int(rng.integers(0, len(added)))]
+            ops.append(("delete", victim))
+        elif r < 0.3:
+            ops.append(("checkpoint", None))
+    ops.append(("checkpoint", None))
+    return ops
+
+
+def _apply_config(rt, n_cq=3):
+    rt.add_flavor(ResourceFlavor(name="default"))
+    for c in range(n_cq):
+        rt.add_cluster_queue(
+            ser.cq_from_dict(cq_dict(f"cq-{c}", quota="6", preempt=True))
+        )
+        rt.add_local_queue(
+            LocalQueue(namespace="ns", name=f"lq-{c}",
+                       cluster_queue=f"cq-{c}")
+        )
+
+
+def _do_checkpoint(rt, state_path):
+    snap = ser.runtime_to_state(rt)
+    if rt.journal is not None:
+        rt.journal.sync()
+    atomic_write_text(
+        state_path, json.dumps(snap), ".state-",
+        fault_point="checkpoint.mid_write",
+    )
+    if rt.journal is not None:
+        rt.journal.compact(snap["persistence"]["journalSeq"])
+
+
+def _apply_op(rt, op, state_path):
+    kind, payload = op
+    if kind == "config":
+        _apply_config(rt)
+    elif kind == "add":
+        rt.add_workload(ser.workload_from_dict(payload))
+    elif kind == "delete":
+        wl = rt.workloads.get(payload)
+        if wl is not None:
+            rt.delete_workload(wl)
+    elif kind == "checkpoint":
+        _do_checkpoint(rt, state_path)
+    rt.clock.advance(1.0)
+    rt.run_until_idle()
+
+
+def _settle(rt):
+    """Advance past every requeue backoff and run to the fixed point."""
+    for _ in range(6):
+        rt.clock.advance(120.0)
+        rt.run_until_idle()
+
+
+def _boot(tmp_path, clock_start):
+    state = str(tmp_path / "state.json")
+    rt = fresh_rt(clock_start)
+    res = recover(
+        state if os.path.exists(state) else None,
+        str(tmp_path / "journal"),
+        runtime=rt,
+        strict=True,
+    )
+    rt.attach_journal(res.journal)
+    return rt
+
+
+def run_trace(tmp_path, ops, crash_point=None, crash_skip=0):
+    """Run the trace with the journal attached; on an injected crash,
+    discard the runtime (simulated process death), recover from disk
+    and CONTINUE from the op that crashed. Returns the final runtime.
+    """
+    state = str(tmp_path / "state.json")
+    clock_now = [0.0]
+    rt = _boot(tmp_path, clock_now[0])
+    if crash_point is not None:
+        faults.arm(crash_point, "crash", skip=crash_skip)
+    crashed = False
+    i = 0
+    while i < len(ops):
+        try:
+            _apply_op(rt, ops[i], state)
+            clock_now[0] = rt.clock.now()
+            i += 1
+        except faults.InjectedCrash:
+            assert not crashed, "fault stayed armed after recovery"
+            crashed = True
+            faults.reset()
+            # process death: the crashed runtime is gone; recover from
+            # what reached disk and re-apply the in-flight op
+            rt = _boot(tmp_path, clock_now[0])
+    try:
+        _settle(rt)
+    finally:
+        rt.journal.close()
+    return rt, crashed
+
+
+def _expected(tmp_path, ops):
+    rt, crashed = run_trace(tmp_path, ops)
+    assert not crashed
+    return admitted_set(rt), rt.cache.usage_for
+
+
+class TestChaosDeterministic:
+    """Tier-1 subset: fixed seeds, every registered crash point, a few
+    occurrence indices each. The full randomized sweep is the `slow`
+    variant below."""
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_journaling_does_not_change_decisions(self, tmp_path, seed):
+        import numpy as np
+
+        ops = make_trace(np.random.default_rng(seed))
+        # journal-off reference
+        rt_off = fresh_rt()
+        state = str(tmp_path / "off-state.json")
+        for op in ops:
+            if op[0] != "checkpoint":
+                _apply_op(rt_off, op, state)
+        _settle(rt_off)
+        # journal-on run
+        jdir = tmp_path / "on"
+        jdir.mkdir()
+        rt_on, _ = run_trace(jdir, ops)
+        assert admitted_set(rt_on) == admitted_set(rt_off)
+        assert rt_on.check_invariants() == []
+
+    @pytest.mark.parametrize("point", CRASH_POINTS)
+    @pytest.mark.parametrize("skip", [0, 2, 7])
+    def test_crash_recover_converges(self, tmp_path, point, skip):
+        import numpy as np
+
+        ops = make_trace(np.random.default_rng(3))
+        base = tmp_path / "base"
+        base.mkdir()
+        want, _ = _expected(base, ops)
+        case = tmp_path / f"{point.replace('.', '-')}-{skip}"
+        case.mkdir()
+        rt, crashed = run_trace(case, ops, crash_point=point, crash_skip=skip)
+        assert admitted_set(rt) == want
+        assert rt.check_invariants() == []
+
+    def test_crash_during_checkpoint_keeps_previous_checkpoint(self, tmp_path):
+        import numpy as np
+
+        ops = make_trace(np.random.default_rng(5))
+        base = tmp_path / "base"
+        base.mkdir()
+        want, _ = _expected(base, ops)
+        case = tmp_path / "case"
+        case.mkdir()
+        # crash the SECOND checkpoint mid-write: the first one must
+        # still anchor recovery
+        rt, crashed = run_trace(
+            case, ops, crash_point="checkpoint.mid_write", crash_skip=1
+        )
+        assert admitted_set(rt) == want
+        assert rt.check_invariants() == []
+        # no orphaned checkpoint tmp files on the volume
+        leftovers = [
+            p.name for p in case.iterdir() if p.name.startswith(".state-")
+        ]
+        assert leftovers == []
+
+
+@pytest.mark.slow
+class TestChaosRandomizedSweep:
+    """The full property: many seeds x every crash point x several
+    occurrence indices. Each case crashes, recovers, continues, and
+    must converge to the no-crash admitted set with invariants intact.
+    """
+
+    @pytest.mark.parametrize("seed", list(range(8)))
+    def test_kill_at_every_point(self, tmp_path, seed):
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        ops = make_trace(rng, n_wl=30)
+        base = tmp_path / "base"
+        base.mkdir()
+        want, _ = _expected(base, ops)
+        skips = [int(s) for s in rng.integers(0, 30, size=3)]
+        for point in CRASH_POINTS:
+            for skip in skips:
+                case = tmp_path / f"{point.replace('.', '-')}-{skip}"
+                case.mkdir(exist_ok=True)
+                rt, _ = run_trace(
+                    case, ops, crash_point=point, crash_skip=skip
+                )
+                assert admitted_set(rt) == want, (
+                    f"divergence after crash at {point} (skip {skip})"
+                )
+                assert rt.check_invariants() == [], (
+                    f"invariants broken after crash at {point} "
+                    f"(skip {skip})"
+                )
