@@ -1199,6 +1199,165 @@ def journal_bench(rng, n_cq=40, wl_per_cq=40, fsync_policy="interval"):
     return baseline_ms, journal_ms, appends, j_wall, len(j_admitted)
 
 
+def failover_bench(rng, n_cq=16, wl_per_phase=256, k_div=16):
+    """Self-healing hot path (core/guard.py): steady-state cycle
+    latency vs. cycle latency during an injected device outage
+    (solver.device_raise armed → circuit opens → host-mirror cycles)
+    and after re-probe recovery, plus the sampled-divergence-check
+    overhead at K=k_div vs K=0. Asserts the loop keeps admitting under
+    the outage, nothing is contained/aborted, and the final admitted
+    set equals a host-only (forced-mirror) run of the same backlog.
+
+    Returns (steady_ms, outage_ms, recovered_ms, div_overhead_pct,
+    admitted, failovers)."""
+    import time
+
+    from kueue_tpu.controllers import ClusterRuntime
+    from kueue_tpu.core.guard import GuardConfig
+    from kueue_tpu.models import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        ResourceFlavor,
+        Workload,
+    )
+    from kueue_tpu.models.cluster_queue import ResourceGroup
+    from kueue_tpu.models.workload import PodSet
+    from kueue_tpu.testing import faults
+    from kueue_tpu.utils.clock import FakeClock
+
+    prios = rng.integers(0, 4, size=8 * wl_per_phase) * 10
+    cpus = rng.integers(1, 4, size=8 * wl_per_phase)
+    wl_seq = [0]
+
+    def build(mode: str, k: int):
+        rt = ClusterRuntime(
+            clock=FakeClock(0.0),
+            use_solver=True,
+            bulk_drain_threshold=None,
+            guard_config=GuardConfig(
+                mode=mode, divergence_check_every=k, base_backoff_s=5.0
+            ),
+        )
+        rt.add_flavor(ResourceFlavor(name="default"))
+        for i in range(n_cq):
+            name = f"fcq-{i}"
+            rt.add_cluster_queue(
+                ClusterQueue(
+                    name=name,
+                    namespace_selector={},
+                    resource_groups=(
+                        ResourceGroup(
+                            ("cpu",),
+                            (FlavorQuotas.build("default", {"cpu": "4096"}),),
+                        ),
+                    ),
+                )
+            )
+            rt.add_local_queue(
+                LocalQueue(namespace="ns", name=f"lq-{name}", cluster_queue=name)
+            )
+        return rt
+
+    def feed(rt, n):
+        for _ in range(n):
+            j = wl_seq[0]
+            wl_seq[0] += 1
+            rt.add_workload(
+                Workload(
+                    namespace="ns", name=f"fwl-{j}",
+                    queue_name=f"lq-fcq-{j % n_cq}",
+                    priority=int(prios[j % len(prios)]),
+                    creation_time=float(j),
+                    pod_sets=(
+                        PodSet.build(
+                            "main", 1, {"cpu": str(cpus[j % len(cpus)])}
+                        ),
+                    ),
+                )
+            )
+
+    def run_phase(rt, n_cycles, agg=np.median):
+        times = []
+        for _ in range(n_cycles):
+            t0 = time.perf_counter()
+            rt.schedule_once()
+            times.append(time.perf_counter() - t0)
+        return float(agg(times)) * 1e3
+
+    cycles_per_phase = wl_per_phase // n_cq
+    rt = build("auto", k_div)
+    faults.reset()
+    # warmup (jit compile) + steady state
+    wl_seq[0] = 0
+    feed(rt, wl_per_phase)
+    run_phase(rt, 2)
+    steady_ms = run_phase(rt, cycles_per_phase - 2)
+
+    # injected device outage: every launch raises until disarmed; the
+    # breaker opens after its threshold and cycles run on the mirror
+    feed(rt, wl_per_phase)
+
+    def _raise():
+        raise RuntimeError("injected device fault (bench)")
+
+    faults.arm("solver.device_raise", action=_raise)
+    outage_ms = run_phase(rt, cycles_per_phase)
+    assert rt.guard.breaker.state in ("open", "half_open"), (
+        "outage did not open the circuit"
+    )
+    faults.disarm("solver.device_raise")
+
+    # recovery: let the backoff lapse; the next cycle is the half-open
+    # probe and the device path closes again
+    rt.clock.advance(3600.0)
+    feed(rt, wl_per_phase)
+    recovered_ms = run_phase(rt, cycles_per_phase)
+    assert rt.guard.breaker.state == "closed", "re-probe did not recover"
+    assert rt.guard.contained_cycles == 0, "a cycle aborted"
+    failovers = rt.guard.failovers
+    admitted = frozenset(
+        k for k, wl in rt.workloads.items() if wl.is_admitted
+    )
+    assert len(admitted) == 3 * wl_per_phase, "loop stopped admitting"
+
+    # host-only authority run over the SAME workload sequence
+    host_rt = build("host", 0)
+    wl_seq[0] = 0
+    feed(host_rt, 3 * wl_per_phase)
+    while True:
+        if host_rt.run_until_idle(max_iterations=50) < 50:
+            break
+    host_admitted = frozenset(
+        k for k, wl in host_rt.workloads.items() if wl.is_admitted
+    )
+    assert admitted == host_admitted, "failover changed decisions"
+
+    # divergence-check overhead at K=k_div, measured EXACTLY: the guard
+    # accumulates the wall time of every sampled check (mirror re-solve
+    # + compare); the ratio against total cycle wall time is the
+    # overhead — an A/B sweep at these cycle times is dominated by
+    # process-lifetime drift (turbo/GC), which dwarfs the real cost
+    r = build("auto", k_div)
+    wl_seq[0] = 0
+    feed(r, 2 * wl_per_phase)
+    run_phase(r, 2)  # warmup (compile)
+    check_s0 = r.guard.divergence_check_s
+    t0 = time.perf_counter()
+    for _ in range(2 * cycles_per_phase - 2):
+        r.schedule_once()
+    total_s = time.perf_counter() - t0
+    check_s = r.guard.divergence_check_s - check_s0
+    assert r.guard.divergence_checks >= 1, "sweep never hit a check"
+    div_overhead_pct = (
+        check_s / (total_s - check_s) * 100 if total_s > check_s else 0.0
+    )
+    return (
+        steady_ms, outage_ms, recovered_ms, div_overhead_pct,
+        len(admitted), failovers,
+    )
+
+
 def _stage(msg: str):
     """Progress marker on STDERR (the driver only parses stdout JSON);
     lets a timed-out payload show which stage it died in."""
@@ -1407,6 +1566,27 @@ def _stage_journal() -> dict:
     }
 
 
+def _stage_failover() -> dict:
+    steady, outage, recovered, div_pct, admitted, failovers = failover_bench(
+        np.random.default_rng(11)
+    )
+    return {
+        "failover_metric": (
+            "solver_failover_cycle_latency (16-CQ interactive cycles: "
+            "steady device path vs. injected device outage [circuit "
+            "open, host-mirror authority] vs. after half-open re-probe "
+            f"recovery; {admitted} admitted across the run, "
+            f"{failovers} failovers, decisions == host-only run "
+            "asserted)"
+        ),
+        "failover_value": round(outage, 3),
+        "failover_unit": "ms/cycle (during outage)",
+        "failover_steady_ms_per_cycle": round(steady, 3),
+        "failover_recovered_ms_per_cycle": round(recovered, 3),
+        "failover_divergence_overhead_pct": round(div_pct, 1),
+    }
+
+
 def _stage_tas_drain() -> dict:
     td_ms, td_cycles, td_admitted, td_pending = tas_drain_bench(
         np.random.default_rng(6)
@@ -1438,6 +1618,7 @@ STAGES = {
     "interactive": _stage_interactive,
     "planner": _stage_planner,
     "journal": _stage_journal,
+    "failover": _stage_failover,
 }
 
 
@@ -1606,6 +1787,12 @@ def driver_main(stage_names=None):
         record.setdefault("metric", record.get("journal_metric"))
         record.setdefault("value", record["journal_value"])
         record.setdefault("unit", record.get("journal_unit"))
+    if "value" not in record and "failover_value" in record:
+        # failover-only invocation (--failover): the during-outage
+        # cycle latency IS the headline
+        record.setdefault("metric", record.get("failover_metric"))
+        record.setdefault("value", record["failover_value"])
+        record.setdefault("unit", record.get("failover_unit"))
     if "value" not in record:
         # the HEADLINE stage failed but others succeeded: keep every
         # completed stage's metrics (stage isolation's whole point) and
@@ -1635,6 +1822,10 @@ def driver_main(stage_names=None):
         compact["scenarios_per_s"] = record["planner_scenarios_per_s"]
     if "journal_appends_per_s" in record:
         compact["appends_per_s"] = record["journal_appends_per_s"]
+    if "failover_divergence_overhead_pct" in record:
+        compact["divergence_overhead_pct"] = record[
+            "failover_divergence_overhead_pct"
+        ]
     print(json.dumps(compact))
 
 
@@ -1664,5 +1855,11 @@ if __name__ == "__main__":
         # compact last line carries {"headline_ms", "backend",
         # "appends_per_s"}
         driver_main(["journal"])
+    elif "--failover" in sys.argv:
+        # failover-only mode: steady-state vs device-outage vs
+        # recovered cycle latency + divergence-check overhead, compact
+        # last line carries {"headline_ms", "backend",
+        # "divergence_overhead_pct"}
+        driver_main(["failover"])
     else:
         driver_main()

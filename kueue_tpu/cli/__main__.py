@@ -785,6 +785,74 @@ def cmd_events(state: State, args) -> None:
     print(f"resourceVersion: {out.get('resourceVersion', 0)}")
 
 
+# ---- quarantine (core/guard.py poison-workload triage) ----
+def cmd_quarantine(state: State, args) -> None:
+    """``kueuectl quarantine list|clear`` — inspect and release the
+    poison-workload quarantine. Server mode talks to the live control
+    plane (/debug/quarantine); offline mode reads/edits the state
+    file's ``quarantine`` section (the checkpointed entries)."""
+    if getattr(args, "server", None):
+        client = _server_client(args)
+        if args.action == "clear":
+            out = client.quarantine_clear(args.workload or None)
+            cleared = out.get("cleared", [])
+            print(
+                f"cleared {len(cleared)} workload(s): "
+                + (", ".join(cleared) if cleared else "<none>")
+            )
+            return
+        out = client.quarantine_list()
+        solver = out.get("solver", {})
+        if solver:
+            print(
+                f"solver path: {solver.get('path')} "
+                f"(breaker {solver.get('breaker')}, "
+                f"{solver.get('failovers', 0)} failovers, "
+                f"{solver.get('divergences', 0)} divergences)"
+            )
+        _print_table(
+            ["WORKLOAD", "STRIKES", "SINCE", "UNTIL", "REASON"],
+            [
+                [
+                    q.get("key", ""),
+                    str(q.get("strikes", 0)),
+                    f"{q.get('since', 0):.0f}",
+                    f"{q.get('until', 0):.0f}",
+                    q.get("message", ""),
+                ]
+                for q in out.get("items", [])
+            ],
+        )
+        return
+    entries = state.data.get("quarantine", [])
+    if args.action == "clear":
+        keep = [
+            q for q in entries
+            if args.workload and q.get("key") != args.workload
+        ]
+        cleared = [q["key"] for q in entries if q not in keep]
+        state.data["quarantine"] = keep
+        state.save()
+        print(
+            f"cleared {len(cleared)} workload(s): "
+            + (", ".join(cleared) if cleared else "<none>")
+        )
+        return
+    _print_table(
+        ["WORKLOAD", "STRIKES", "SINCE", "UNTIL", "REASON"],
+        [
+            [
+                q.get("key", ""),
+                str(q.get("strikes", 0)),
+                f"{q.get('since', 0):.0f}",
+                f"{q.get('until', 0):.0f}",
+                q.get("message", ""),
+            ]
+            for q in entries
+        ],
+    )
+
+
 # ---- schedule ----
 def cmd_schedule(state: State, args) -> None:
     rt = state.build_runtime()
@@ -1057,6 +1125,22 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_server_flags(ev, "read events from a running kueue_tpu.server")
     ev.set_defaults(fn=cmd_events)
+
+    qr = sub.add_parser(
+        "quarantine",
+        help="poison-workload quarantine triage: list sidelined "
+        "workloads or clear (requeue) them",
+    )
+    qr.add_argument("action", choices=["list", "clear"])
+    qr.add_argument(
+        "workload", nargs="?", default="",
+        help="ns/name to clear (clear with no workload releases all)",
+    )
+    _add_server_flags(
+        qr, "live control plane to triage (default: the --state file's "
+        "checkpointed quarantine section)",
+    )
+    qr.set_defaults(fn=cmd_quarantine)
 
     pw = sub.add_parser("pending-workloads")
     pw.add_argument("clusterqueue")

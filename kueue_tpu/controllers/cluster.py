@@ -52,6 +52,8 @@ class ClusterRuntime:
         resources=None,  # config.ResourceSettings (quota-view transform)
         bulk_drain_threshold: Optional[int] = 256,
         drain_gate=None,  # latency-gate override (perf harness pins it open)
+        solver_path: str = "auto",  # auto | host | device (guard mode)
+        guard_config=None,  # core.guard.GuardConfig override
     ):
         from kueue_tpu.metrics import Metrics
 
@@ -100,6 +102,30 @@ class ClusterRuntime:
                 resources
             )
 
+        # Self-healing hot path (core/guard.py): the resilient solver
+        # executor (circuit breaker + host-mirror failover + sampled
+        # divergence detection) and the poison-workload quarantine,
+        # both wired into this runtime's events/metrics/journal.
+        from kueue_tpu.core.guard import GuardConfig, QuarantineList, SolverGuard
+
+        if guard_config is None:
+            guard_config = GuardConfig(mode=solver_path)
+        self.quarantine = QuarantineList(
+            threshold=guard_config.poison_threshold,
+            ttl_s=guard_config.quarantine_ttl_s,
+        )
+        self.guard = SolverGuard(
+            clock=self.clock,
+            config=guard_config,
+            record_event=self._record_solver_event,
+            metrics=self.metrics,
+            journal_hook=self._journal_guard_record,
+        )
+        # the most recent journaled solver divergence verdict (replayed
+        # by recovery so a restart knows which path produced the
+        # admitted state on disk)
+        self.last_solver_verdict = None
+
         tas_check = tas_assign = tas_fits = None
         self.tas_manager = None
         self.node_controller = None
@@ -137,7 +163,10 @@ class ClusterRuntime:
             transform_config=self.transform_config,
             limit_range_validate=self._validate_workload_resources,
             audit=self.audit,
+            guard=self.guard,
+            quarantine=self.quarantine,
         )
+        self.scheduler.on_quarantine = self._on_workload_quarantined
         self.job_reconciler = JobReconciler(
             self,
             manage_jobs_without_queue_name=manage_jobs_without_queue_name,
@@ -246,6 +275,81 @@ class ClusterRuntime:
         self._journal_append(
             "object_delete", {"section": section, "key": key}
         )
+
+    # ---- self-healing hot path (core/guard.py) ----
+    def _record_solver_event(self, reason: str, message: str) -> None:
+        """Guard hook: breaker transitions, divergences and contained
+        cycles land on the same event pipeline every other status
+        transition uses (reasons are members of EVENT_REASONS)."""
+        self.events.record(
+            reason, "control-plane/solver", message,
+            regarding_kind="ControlPlane",
+        )
+        self.metrics.events_total.inc(kind="ControlPlane", reason=reason)
+
+    def _journal_guard_record(self, rtype: str, data: dict) -> None:
+        """Guard hook: durable solver verdicts (which path produced the
+        admitted state) ride the PR-4 journal."""
+        if rtype == "solver_verdict":
+            self.last_solver_verdict = dict(data)
+        self._journal_append(rtype, data)
+
+    def _on_workload_quarantined(self, wl: Workload, message: str) -> None:
+        """Scheduler hook AFTER the quarantine entry, condition and
+        event landed: journal the entry durably and refresh the gauge
+        (the WorkloadQuarantined event already journaled the workload's
+        post-state through the event funnel)."""
+        entry = self.quarantine.get(wl.key)
+        self._journal_append(
+            "quarantine_set",
+            entry.to_dict() if entry is not None else
+            {"key": wl.key, "message": message},
+        )
+        self.metrics.solver_quarantined_workloads.set(len(self.quarantine))
+
+    def _sweep_quarantine(self) -> None:
+        """TTL re-admission: expired quarantine entries rejoin
+        nomination (reconcile-driven, FakeClock-disciplined)."""
+        for entry in self.quarantine.expired(self.clock.now()):
+            self._release_quarantine(entry.key, "TTL elapsed")
+
+    def _release_quarantine(self, key: str, why: str) -> bool:
+        entry = self.quarantine.release(key)
+        if entry is None:
+            return False
+        self._journal_append("quarantine_clear", {"key": key})
+        self.metrics.solver_quarantined_workloads.set(len(self.quarantine))
+        wl = self.workloads.get(key)
+        if wl is not None:
+            wl.set_condition(
+                WorkloadConditionType.QUOTA_RESERVED, False,
+                reason="Pending",
+                message=f"quarantine released ({why}); workload requeued",
+                now=self.clock.now(),
+            )
+            self.event(
+                "WorkloadUnquarantined", wl, f"quarantine released ({why})"
+            )
+            # unpark: the condition flip re-enters the pending heap
+            self.queues.add_or_update_workload(wl)
+        return True
+
+    def clear_quarantine(self, key: Optional[str] = None) -> List[str]:
+        """``kueuectl quarantine clear`` / POST /debug/quarantine/clear:
+        release one (or every) quarantined workload back to nomination.
+        Returns the released keys."""
+        keys = (
+            [key] if key is not None
+            else [e.key for e in self.quarantine.items()]
+        )
+        return [
+            k for k in keys
+            if self._release_quarantine(k, "cleared by operator")
+        ]
+
+    def quarantine_report(self) -> List[dict]:
+        """The kueuectl/debug-route listing."""
+        return [e.to_dict() for e in self.quarantine.items()]
 
     # ---- events ----
     def event(self, kind: str, wl: Workload, message: str = "") -> None:
@@ -647,6 +751,7 @@ class ClusterRuntime:
         self.workloads.pop(wl.key, None)
         self.indexer.delete(wl.key)
         self.audit.forget(wl.key)  # history follows the object lifecycle
+        self.quarantine.forget(wl.key)  # strikes die with the object
         self.queues.delete_workload(wl)
         if self.topology_ungater is not None:
             # drop any outstanding ungate expectations: a recreated
@@ -742,6 +847,7 @@ class ClusterRuntime:
 
     # ---- the loop ----
     def reconcile_once(self) -> None:
+        self._sweep_quarantine()
         for job in list(self.jobs.values()):
             self.job_reconciler.reconcile(job)
         for wl in list(self.workloads.values()):
@@ -998,6 +1104,11 @@ class ClusterRuntime:
         sched = self.scheduler
         if self.bulk_drain_threshold is None or sched.use_solver is False:
             return None
+        if not sched.guard.allow_device():
+            # device circuit open / quarantined / forced host mode: the
+            # drain has no device to run on — the cycle loop (host
+            # authority, per-head) decides the backlog this iteration
+            return None
         if sched.wait_for_pods_ready_block and self.cache.workloads_not_ready:
             return None  # the cycle loop enforces the PodsReady block
         live = [
@@ -1023,12 +1134,14 @@ class ClusterRuntime:
             self._drain_est.erode()
             return None
 
+        sched.guard.begin_cycle()
         t0 = _time.perf_counter()
         snapshot = take_snapshot(self.cache)
         pending = self.drain_backlog(snapshot)
         if len(pending) < self.bulk_drain_threshold:
             return None
         t_snapshot = _time.perf_counter() - t0
+        sched.guard.phase_checkpoint("drain.snapshot")
 
         ts_fn = lambda wl: queue_order_timestamp(  # noqa: E731
             wl, self.queues._ts_policy
@@ -1049,16 +1162,28 @@ class ClusterRuntime:
             snapshot, pending, tas_flavors, sched.fair_sharing
         )
         t_classify = _time.perf_counter() - t1
+        sched.guard.phase_checkpoint("drain.classify")
         if len(pending) < self.bulk_drain_threshold:
             return None  # TAS heads dropped to the cycle loop shrank it
         t1 = _time.perf_counter()
-        outcome = run_drain_for_scope(
-            kind, snapshot, pending, self.cache.flavors,
-            tas_cache=self.cache.tas_cache,
-            fs_strategies=getattr(sched.preemptor, "fs_strategies", None),
-            timestamp_fn=ts_fn,
+        # the drain launch runs under the same guard as the cycle
+        # dispatch: a raising or deadline-late solve is contained,
+        # strikes the breaker, and this iteration's backlog falls back
+        # to the per-head cycle loop instead of a crashed drain
+        guarded = sched.guard.device_call(
+            lambda: run_drain_for_scope(
+                kind, snapshot, pending, self.cache.flavors,
+                tas_cache=self.cache.tas_cache,
+                fs_strategies=getattr(sched.preemptor, "fs_strategies", None),
+                timestamp_fn=ts_fn,
+            ),
+            label="bulk drain",
         )
+        if guarded.result is None:
+            return None
+        outcome = guarded.result
         t_solve = _time.perf_counter() - t1
+        sched.guard.phase_checkpoint("drain.solve", device_used=True)
         from kueue_tpu.testing import faults
 
         faults.fire("cycle.post_solve_pre_apply")
@@ -1081,8 +1206,17 @@ class ClusterRuntime:
         # the drain IS this iteration's cycle: number it before the
         # apply so its decision records carry the right cycle id
         sched.scheduling_cycle += 1
-        result = self._apply_drain_outcome(outcome, snapshot)
+        try:
+            result = self._apply_drain_outcome(outcome, snapshot)
+        except faults.InjectedCrash:
+            raise  # simulated power loss: the recovery chaos suite's window
+        except Exception as exc:  # noqa: BLE001 — contained apply: the
+            # admissions that committed stand (transactional per head);
+            # unprocessed heads remain in their heaps for the cycle loop
+            sched.guard.note_contained_cycle(exc)
+            return None
         t_apply = _time.perf_counter() - t1
+        sched.guard.phase_checkpoint("drain.apply", device_used=True)
         dt = _time.perf_counter() - t0
         trace = CycleTrace(
             cycle=sched.scheduling_cycle,

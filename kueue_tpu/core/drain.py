@@ -1700,6 +1700,7 @@ def run_drain(
     max_cycles: Optional[int] = None,
     mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
     fair_sharing: bool = False,
+    use_device: bool = True,
 ) -> DrainOutcome:
     """Plan + solve + map back, with one device round trip.
 
@@ -1716,10 +1717,21 @@ def run_drain(
     sharded along ``wl``; node-space tensors stay replicated — separate
     root cohorts are independent subproblems, so the tournament's
     segment reductions parallelize and GSPMD resolves the node-space
-    scatters."""
+    scatters.
+
+    ``use_device=False`` solves the IDENTICAL plan on the numpy host
+    mirror (ops/drain_np.solve_drain_np) — bit-for-bit the same
+    decisions, property-tested across seeded random snapshots
+    (tests/test_drain_parity.py). Plain scope only: the fair
+    tournament keeps the kernel as its single implementation."""
     from kueue_tpu._jax import jnp
     from kueue_tpu.ops.drain_kernel import DrainQueues, solve_drain_packed_jit
 
+    if not use_device and (fair_sharing or mesh is not None):
+        raise ValueError(
+            "use_device=False covers the plain drain only (no fair "
+            "tournament, no mesh sharding)"
+        )
     plan = plan_drain(
         snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
     )
@@ -1756,6 +1768,37 @@ def run_drain(
                     )
     if max_cycles is not None:
         plan.max_cycles = max_cycles
+    if not use_device:
+        # the numpy twin over the identical plan tensors — the guard's
+        # host-authority drain and the parity property-test surface
+        from kueue_tpu.core.encode import encode_snapshot
+        from kueue_tpu.ops.assign_kernel import build_paths
+        from kueue_tpu.ops.drain_np import solve_drain_np
+
+        enc = encode_snapshot(snapshot)
+        paths_np = build_paths(enc.parent, enc.max_depth)
+        host = solve_drain_np(
+            enc.parent,
+            enc.level_mask,
+            enc.nominal.astype(np.int64, copy=False),
+            enc.lending_limit.astype(np.int64, copy=False),
+            enc.borrowing_limit.astype(np.int64, copy=False),
+            enc.local_usage.astype(np.int64, copy=False),
+            plan.queues_np,
+            paths_np,
+            enc.max_depth,
+            plan.max_cycles,
+        )
+        return _map_drain_result(
+            plan,
+            host.admitted_k,
+            host.admitted_cycle,
+            host.cursor,
+            host.stuck,
+            int(host.cycles),
+            plan.queues_np,
+            extra_fb_entries=[],
+        )
     tree, paths, _ = tree_arrays(snapshot)
     queues_np = plan.queues_np
     if mesh is not None:
@@ -1834,6 +1877,25 @@ def run_drain(
     cursor = flat[qlp + ql : qlp + ql + nq]
     stuck_q = flat[qlp + ql + nq : qlp + ql + 2 * nq].astype(bool)
     cycles = int(flat[-1])
+    return _map_drain_result(
+        plan, adm_k, adm_cycle, cursor, stuck_q, cycles, queues_np,
+        extra_fb_entries,
+    )
+
+
+def _map_drain_result(
+    plan: DrainPlan,
+    adm_k,
+    adm_cycle,
+    cursor,
+    stuck_q,
+    cycles: int,
+    queues_np: dict,
+    extra_fb_entries: List[Tuple[Workload, str]],
+) -> DrainOutcome:
+    """Map a plain drain's per-queue result tensors back onto workloads
+    — ONE definition shared by the device fetch and the numpy host
+    mirror, so the two paths cannot disagree on outcome decoding."""
     # stuck-frozen queues are terminal no-decisions, not truncation
     truncated = bool(np.any((cursor < queues_np["qlen"]) & ~stuck_q))
 

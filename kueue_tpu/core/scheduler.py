@@ -31,6 +31,7 @@ from kueue_tpu.models.constants import (
     classify_inadmissible_message,
 )
 from kueue_tpu.core.audit import DecisionAuditLog, DecisionRecord
+from kueue_tpu.core.guard import QuarantineList, SolverGuard, bisect_poison
 from kueue_tpu.core.flavor_assigner import (
     AssignmentResult,
     FlavorAssigner,
@@ -175,11 +176,15 @@ class CycleResult:
 class DevicePlan:
     """Device phase-2 outcome for a pure cycle: the admitted flags and
     entry order computed by ops/assign_kernel.solve_cycle, replayed by
-    the host for bookkeeping only (no quota re-checks)."""
+    the host for bookkeeping only (no quota re-checks). ``via`` records
+    which engine actually solved it — "device", or "host-mirror" when
+    the guard routed the batch to the numpy twin (circuit open /
+    forced host mode / divergence quarantine)."""
 
     entries: List[Entry]
     admitted: "np.ndarray"  # bool[W]
     order: "np.ndarray"  # int32[>=W], device entry order
+    via: str = "device"
 
 
 class Scheduler:
@@ -204,6 +209,8 @@ class Scheduler:
         preempt_solver_threshold: int = 4,
         transform_config=None,  # ResourceTransformConfig (quota view)
         audit: Optional[DecisionAuditLog] = None,
+        guard: Optional[SolverGuard] = None,
+        quarantine: Optional[QuarantineList] = None,
     ):
         self.queues = queues
         self.cache = cache
@@ -239,6 +246,20 @@ class Scheduler:
         # per-workload decision audit trail; both resolution paths (and
         # the runtime's bulk drain) record through the same log
         self.audit = audit if audit is not None else DecisionAuditLog(clock=clock)
+        # Resilient solver executor (core/guard.py): exception
+        # containment + wall-clock deadline around every device launch,
+        # device-path circuit breaker with host-mirror failover, sampled
+        # divergence detection. A bare Scheduler gets a hookless guard;
+        # ClusterRuntime wires events/metrics/journal into it.
+        self.guard = guard if guard is not None else SolverGuard(clock=clock)
+        # Poison-workload quarantine: shared with the runtime (its TTL
+        # sweep and kueuectl surface) when one is attached.
+        self.quarantine = (
+            quarantine if quarantine is not None else QuarantineList()
+        )
+        # runtime hooks fired when a workload enters quarantine (journal
+        # record + gauge) — None outside a ClusterRuntime
+        self.on_quarantine: Optional[Callable[[Workload, str], None]] = None
         self.scheduling_cycle = 0
         # per-cycle phase traces, newest last (ring buffer)
         self.last_traces = deque(maxlen=128)
@@ -274,6 +295,7 @@ class Scheduler:
         trace = CycleTrace(cycle=self.scheduling_cycle)
         self._cycle_device_s = 0.0
         t0 = _time.perf_counter()
+        self.guard.begin_cycle()
 
         heads = self.queues.heads()
         trace.heads = len(heads)
@@ -281,13 +303,26 @@ class Scheduler:
             self.notify_cycle(result)
             return result
         trace.spans["heads"] = _time.perf_counter() - t0
+        try:
+            return self._schedule_guarded(heads, result, trace, t0)
+        except Exception as exc:  # noqa: BLE001 — the cycle guard's
+            # outer containment: an escaped phase exception must cost
+            # this cycle's decisions, never the scheduler itself.
+            # InjectedCrash (simulated power loss) is a BaseException
+            # and passes through untouched.
+            return self._contain_cycle_failure(heads, result, trace, t0, exc)
 
+    def _schedule_guarded(self, heads, result, trace, t0) -> CycleResult:
         t1 = _time.perf_counter()
         snapshot = take_snapshot(self.cache)
         trace.spans["snapshot"] = _time.perf_counter() - t1
+        self.guard.phase_checkpoint("snapshot")
         t1 = _time.perf_counter()
         entries, device_plan = self._nominate(heads, snapshot)
         trace.spans["nominate"] = _time.perf_counter() - t1
+        self.guard.phase_checkpoint(
+            "nominate", device_used=self._cycle_device_s > 0
+        )
         # crash-consistency fault point: nomination (host walk or device
         # solve) is complete, nothing has been applied or journaled yet
         from kueue_tpu.testing import faults
@@ -297,6 +332,9 @@ class Scheduler:
             t2 = _time.perf_counter()
             out = self._finalize_device(entries, device_plan, snapshot, result)
             trace.spans["admit"] = _time.perf_counter() - t2
+            self.guard.phase_checkpoint(
+                "admit", device_used=self._cycle_device_s > 0
+            )
             self._finish_trace(trace, out, t0)
             self._audit_cycle(entries, out)
             self.notify_cycle(out)
@@ -435,10 +473,100 @@ class Scheduler:
                 self._requeue_and_update(e)
                 result.requeued.append(e)
         trace.spans["admit"] = _time.perf_counter() - t2
+        self.guard.phase_checkpoint(
+            "admit", device_used=self._cycle_device_s > 0
+        )
         self._finish_trace(trace, result, t0)
         self._audit_cycle(entries, result)
         self.notify_cycle(result)
         return result
+
+    # ---- cycle guard: containment + poison attribution ----
+    def _contain_cycle_failure(
+        self, heads, result: CycleResult, trace, t0, exc: Exception
+    ) -> CycleResult:
+        """An exception escaped a cycle phase. The cycle is charged,
+        never the scheduler: admissions that committed before the raise
+        stand (they are in the cache and in ``result.admitted``); every
+        other popped head is requeued so nothing is stranded. Poison
+        attribution runs a side-effect-free nomination probe over the
+        heads and bisects to the offender(s); a head that keeps doing
+        this crosses the strike threshold and is quarantined."""
+        self.guard.note_contained_cycle(exc)
+        for wl in bisect_poison(list(heads), self._nomination_probe):
+            msg = self._poison_strike(wl, exc)
+            wl.set_condition(
+                WorkloadConditionType.QUOTA_RESERVED, False,
+                reason=classify_inadmissible_message(msg).value,
+                message=msg, now=self.clock.now(),
+            )
+        for wl in heads:
+            if wl.key in self.cache.assumed_workloads or self._is_admitted(wl):
+                continue
+            # FAILED_AFTER_NOMINATION: straight back onto the heap (a
+            # GENERIC requeue would park every innocent head in the
+            # inadmissible lot with nothing to reactivate it — the
+            # contained cycle must cost a retry, not the backlog)
+            self.queues.requeue_workload(
+                wl, RequeueReason.FAILED_AFTER_NOMINATION
+            )
+        self._finish_trace(trace, result, t0)
+        self.notify_cycle(result)
+        return result
+
+    def _nomination_probe(self, subset) -> None:
+        """Re-run prevalidation + host flavor assignment for a subset of
+        heads against a throwaway snapshot — raises iff the subset
+        contains a head whose scheduling raises. Side-effect-free: the
+        snapshot is private and the flavor cursors are restored."""
+        snap = take_snapshot(self.cache)
+        saved = [(wl, wl.last_assignment) for wl in subset]
+        try:
+            _entries, to_assign = self._prevalidate(list(subset), snap)
+            assigner = self._make_assigner(snap)
+            for e in to_assign:
+                self._host_assign(assigner, e, snap, None)
+        finally:
+            for wl, la in saved:
+                wl.last_assignment = la
+
+    def _poison_strike(self, wl: Workload, exc) -> str:
+        """One contained failure attributed to this head: strike it,
+        quarantine at the threshold. Returns the cycle's
+        inadmissibility message (classifies to SCHEDULING_FAILURE /
+        QUARANTINED)."""
+        n = self.quarantine.strike(wl.key)
+        if n >= self.quarantine.threshold:
+            msg = (
+                f"The workload is quarantined after {n} scheduling "
+                f"failures (last: {exc!r})"
+            )
+            self._do_quarantine(wl, msg)
+            return msg
+        return (
+            f"Workload raised during scheduling ({exc!r}); strike "
+            f"{n}/{self.quarantine.threshold} toward quarantine"
+        )
+
+    def _do_quarantine(self, wl: Workload, msg: str) -> None:
+        now = self.clock.now()
+        self.quarantine.add(wl.key, msg, now)
+        wl.set_condition(
+            WorkloadConditionType.QUOTA_RESERVED, False,
+            reason=InadmissibleReason.QUARANTINED.value,
+            message=msg, now=now,
+        )
+        self.events("WorkloadQuarantined", wl, msg)
+        if self.on_quarantine is not None:
+            self.on_quarantine(wl, msg)
+
+    def _contain_head_failure(self, e: Entry, exc: Exception) -> None:
+        """Per-head exception containment in the nomination loops: the
+        head costs itself, not the cycle. Attribution is exact here, so
+        no bisection is needed."""
+        e.assignment = None
+        e.preemption_targets = []
+        e.inadmissible_msg = self._poison_strike(e.workload, exc)
 
     def notify_cycle(self, result: CycleResult) -> None:
         for cb in list(self.cycle_observers):
@@ -556,7 +684,11 @@ class Scheduler:
         deferred: List[Entry] = []
         t_host = _time.perf_counter()
         for e in to_assign:
-            self._host_assign(assigner, e, snapshot, deferred)
+            try:
+                self._host_assign(assigner, e, snapshot, deferred)
+            except Exception as exc:  # noqa: BLE001 — per-head guard:
+                # the raising head costs itself, never the cycle
+                self._contain_head_failure(e, exc)
         if to_assign:
             per_head = (_time.perf_counter() - t_host) / len(to_assign)
             self._host_assign_ema = (
@@ -658,21 +790,31 @@ class Scheduler:
         if batch_on:
             from kueue_tpu.core.preempt_batch import batched_get_targets
 
-            all_targets = batched_get_targets(
-                snapshot,
-                [(e.workload, e.cq_name, e.assignment) for e in deferred],
-                self.preemptor,
-            )
-            dt = _time.perf_counter() - t0
-            self._device_victim_est.observe(dt)
-            self._cycle_device_s += dt
-        else:
-            all_targets = [
-                self.preemptor.get_targets(
-                    e.workload, e.cq_name, e.assignment, snapshot
+            try:
+                all_targets = batched_get_targets(
+                    snapshot,
+                    [(e.workload, e.cq_name, e.assignment) for e in deferred],
+                    self.preemptor,
                 )
-                for e in deferred
-            ]
+                dt = _time.perf_counter() - t0
+                self._device_victim_est.observe(dt)
+                self._cycle_device_s += dt
+            except Exception:  # noqa: BLE001 — a failed victim-search
+                # kernel degrades to the host loop, never the cycle
+                batch_on = False
+                t0 = _time.perf_counter()
+        if not batch_on:
+            all_targets = []
+            for e in deferred:
+                try:
+                    all_targets.append(
+                        self.preemptor.get_targets(
+                            e.workload, e.cq_name, e.assignment, snapshot
+                        )
+                    )
+                except Exception as exc:  # noqa: BLE001 — per-head guard
+                    self._contain_head_failure(e, exc)
+                    all_targets.append([])
             per_head = (_time.perf_counter() - t0) / len(deferred)
             self._host_victim_ema = (
                 per_head
@@ -680,15 +822,20 @@ class Scheduler:
                 else 0.8 * self._host_victim_ema + 0.2 * per_head
             )
         for e, targets in zip(deferred, all_targets):
+            if e.assignment is None:
+                continue  # contained above: strike message already set
             e.victim_search = "device" if batch_on else "host"
-            if targets:
-                e.preemption_targets = targets
-            else:
-                e.assignment, e.preemption_targets = self._finish_assignment(
-                    assigner, e.workload, e.cq_name, snapshot, e.assignment
-                )
-            e.inadmissible_msg = e.assignment.message()
-            e.workload.last_assignment = e.assignment.last_state
+            try:
+                if targets:
+                    e.preemption_targets = targets
+                else:
+                    e.assignment, e.preemption_targets = self._finish_assignment(
+                        assigner, e.workload, e.cq_name, snapshot, e.assignment
+                    )
+                e.inadmissible_msg = e.assignment.message()
+                e.workload.last_assignment = e.assignment.last_state
+            except Exception as exc:  # noqa: BLE001 — per-head guard
+                self._contain_head_failure(e, exc)
 
     def _prevalidate(
         self, heads: List[Workload], snapshot: Snapshot
@@ -703,6 +850,15 @@ class Scheduler:
             entries.append(e)
             if wl.key in self.cache.assumed_workloads or self._is_admitted(wl):
                 entries.pop()  # already assumed/admitted: drop silently
+                continue
+            if self.quarantine.active(wl.key, self.clock.now()):
+                # sidelined poison head: never nominated until its TTL
+                # lapses or an operator clears it (kueuectl quarantine)
+                q = self.quarantine.get(wl.key)
+                e.inadmissible_msg = (
+                    f"The workload is quarantined until t={q.until:.0f}: "
+                    f"{q.message}"
+                )
                 continue
             if not wl.is_active():
                 e.inadmissible_msg = "The workload is deactivated"
@@ -743,6 +899,12 @@ class Scheduler:
         (potential preemption) fall back to the host FlavorAssigner,
         which remains the decision authority for them.
 
+        The launch itself runs under the SolverGuard: a raising/late
+        device dispatch (or an open circuit / divergence quarantine)
+        resolves the same lowered batch on the numpy host mirror
+        instead — per-head host fallback is the last resort when even
+        lowering fails.
+
         Returns a DevicePlan when the whole cycle is resolvable from
         the device phase-2 scan (every host-path entry is NO_FIT with
         no preemption targets, so no usage interleaving outside the
@@ -752,31 +914,44 @@ class Scheduler:
         from kueue_tpu.core.solver import dispatch_lowered, lower_heads
 
         heads = [(e.workload, e.cq_name) for e in to_assign]
-        lowered = lower_heads(
-            snapshot,
-            heads,
-            self.cache.flavors,
-            timestamp_fn=lambda wl: queue_order_timestamp(wl, self.queues._ts_policy),
-            transform=self.transform_config,
-        )
+        try:
+            lowered = lower_heads(
+                snapshot,
+                heads,
+                self.cache.flavors,
+                timestamp_fn=lambda wl: queue_order_timestamp(wl, self.queues._ts_policy),
+                transform=self.transform_config,
+            )
+        except Exception as exc:  # noqa: BLE001 — batch-level lowering
+            # failure: bisect to the poison head(s), host path for the
+            # rest (per-head contained)
+            self._bisect_lowering_failure(to_assign, snapshot, exc)
+            return None
         fallback = set(lowered.fallback)
         if len(fallback) == len(to_assign):
             # nothing representable: skip the device dispatch entirely
-            assigner = self._make_assigner(snapshot)
-            deferred: List[Entry] = []
-            for e in to_assign:
-                self._host_assign(assigner, e, snapshot, deferred)
-            self._resolve_deferred(assigner, deferred, snapshot)
+            self._host_assign_contained(to_assign, snapshot)
             return None
         if self._resident_state is None:
             from kueue_tpu.core.solver import ResidentCycleState
 
             self._resident_state = ResidentCycleState()
-        t0 = _time.perf_counter()
-        res = dispatch_lowered(snapshot, lowered, resident=self._resident_state)
-        dt = _time.perf_counter() - t0
-        self._device_dispatch_est.observe(dt)
-        self._cycle_device_s += dt
+        outcome = self.guard.solve(
+            snapshot,
+            lowered,
+            dispatch=lambda: dispatch_lowered(
+                snapshot, lowered, resident=self._resident_state
+            ),
+        )
+        if outcome.result is None:
+            # device failed AND the host mirror raised (a poison head
+            # corrupting the batch): per-head host fallback decides
+            self._host_assign_contained(to_assign, snapshot)
+            return None
+        if outcome.device_dt is not None:
+            self._device_dispatch_est.observe(outcome.device_dt)
+            self._cycle_device_s += outcome.device_dt
+        res = outcome.result
         chosen = np.asarray(res.chosen)
         host_idx = [
             i
@@ -784,16 +959,14 @@ class Scheduler:
             if i in fallback or chosen[i] < 0
         ]
         if host_idx:
-            assigner = self._make_assigner(snapshot)
-            host_deferred: List[Entry] = []
-            for i in host_idx:
-                self._host_assign(assigner, to_assign[i], snapshot, host_deferred)
-            self._resolve_deferred(assigner, host_deferred, snapshot)
+            self._host_assign_contained(
+                [to_assign[i] for i in host_idx], snapshot
+            )
         host_set = set(host_idx)
         for i, e in enumerate(to_assign):
             if i in host_set:
                 continue
-            e.nominated_via = "device"
+            e.nominated_via = outcome.via
             e.assignment = self._assignment_from_device(
                 lowered, i, int(chosen[i]), snapshot
             )
@@ -804,7 +977,8 @@ class Scheduler:
         pure = (
             not self.fair_sharing
             and all(
-                to_assign[i].assignment.representative_mode() == Mode.NO_FIT
+                to_assign[i].assignment is not None
+                and to_assign[i].assignment.representative_mode() == Mode.NO_FIT
                 and not to_assign[i].preemption_targets
                 for i in host_idx
             )
@@ -815,6 +989,49 @@ class Scheduler:
             entries=to_assign,
             admitted=np.asarray(res.admitted),
             order=np.asarray(res.order),
+            via=outcome.via,
+        )
+
+    def _host_assign_contained(
+        self, entries: List[Entry], snapshot: Snapshot
+    ) -> None:
+        """Host FlavorAssigner pass with per-head exception containment
+        — the guard's last-resort fallback and the device path's
+        host-side companion for unrepresentable heads."""
+        assigner = self._make_assigner(snapshot)
+        deferred: List[Entry] = []
+        for e in entries:
+            try:
+                self._host_assign(assigner, e, snapshot, deferred)
+            except Exception as exc:  # noqa: BLE001 — per-head guard
+                self._contain_head_failure(e, exc)
+        self._resolve_deferred(assigner, deferred, snapshot)
+
+    def _bisect_lowering_failure(
+        self, to_assign: List[Entry], snapshot: Snapshot, exc: Exception
+    ) -> None:
+        """lower_heads raised for the whole batch — attribution needs
+        the guard's bisection (the raise names no head). Poison heads
+        are struck/quarantined; the rest nominate on the host path."""
+        from kueue_tpu.core.solver import lower_heads
+
+        def probe(subset) -> None:
+            lower_heads(
+                snapshot,
+                [(e.workload, e.cq_name) for e in subset],
+                self.cache.flavors,
+                timestamp_fn=lambda wl: queue_order_timestamp(
+                    wl, self.queues._ts_policy
+                ),
+                transform=self.transform_config,
+            )
+
+        poison = bisect_poison(to_assign, probe)
+        for e in poison:
+            self._contain_head_failure(e, exc)
+        poison_ids = {id(e) for e in poison}
+        self._host_assign_contained(
+            [e for e in to_assign if id(e) not in poison_ids], snapshot
         )
 
     def _assignment_from_device(
@@ -886,7 +1103,7 @@ class Scheduler:
         conflicts), skip Fit entries the scan rejected, requeue the
         rest. Mirrors the tail of the host loop (scheduler.go:211-292)
         minus the per-entry quota re-checks."""
-        result.resolution = "device"
+        result.resolution = plan.via
         for idx in plan.order:
             if idx >= len(plan.entries):
                 continue  # padding rows
@@ -1113,17 +1330,31 @@ class Scheduler:
                 WorkloadConditionType.ADMITTED, True, reason="Admitted", now=now
             )
 
-        if not self.cache.assume_workload(wl):
-            msg = "Failed to assume workload"
-            self._rollback_admission(wl, msg)
-            return False, msg
-        # Workload leaves the pending queue: drop the flavor cursor so a
-        # later eviction restarts the search from the first flavor.
-        wl.last_assignment = None
+        # Stage → commit. The condition writes above are the STAGE; the
+        # cache assumption + durable write below are the COMMIT, and
+        # any exception inside rolls this head back completely (cache
+        # forgotten, conditions reverted) before converting to an
+        # ordinary requeue — so a raising durable-write hook mid-apply
+        # can never leave cached usage != Σ admitted. InjectedCrash is
+        # a BaseException and still models a real process death.
+        try:
+            if not self.cache.assume_workload(wl):
+                msg = "Failed to assume workload"
+                self._rollback_admission(wl, msg)
+                return False, msg
+            # Workload leaves the pending queue: drop the flavor cursor
+            # so a later eviction restarts from the first flavor.
+            wl.last_assignment = None
 
-        if not self.apply_admission(wl):
-            self.cache.forget_workload(wl)
-            msg = "Failed to admit workload: durable write failed"
+            if not self.apply_admission(wl):
+                self.cache.forget_workload(wl)
+                msg = "Failed to admit workload: durable write failed"
+                self._rollback_admission(wl, msg)
+                return False, msg
+        except Exception as exc:  # noqa: BLE001 — transactional apply
+            if wl.key in self.cache.assumed_workloads:
+                self.cache.forget_workload(wl)
+            msg = f"Failed to admit workload: durable write failed ({exc!r})"
             self._rollback_admission(wl, msg)
             return False, msg
         self.events(

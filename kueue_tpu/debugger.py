@@ -81,6 +81,29 @@ def dump(runtime) -> str:
         )
         if st.last_error:
             lines.append(f"lastError: {st.last_error}")
+    # solver-guard posture: which engine is deciding and why (core/guard)
+    guard = getattr(getattr(runtime, "scheduler", None), "guard", None)
+    if guard is not None:
+        h = guard.health()
+        lines.append("-- solver guard (self-healing hot path) --")
+        lines.append(
+            f"path={h['path']} mode={h['mode']} breaker={h['breaker']} "
+            f"failovers={h['failovers']} "
+            f"divergences={h['divergences']}/{h['divergenceChecks']} "
+            f"containedCycles={h['containedCycles']} "
+            f"deadlineBreaches={h['deadlineBreaches']}"
+        )
+        if h["lastFailure"]:
+            lines.append(f"lastFailure: {h['lastFailure']}")
+        quarantine = getattr(runtime, "quarantine", None)
+        if quarantine is not None and len(quarantine):
+            lines.append(
+                "quarantined: "
+                + ", ".join(
+                    f"{e.key} (strikes={e.strikes}, until={e.until:.0f})"
+                    for e in quarantine.items()
+                )
+            )
     return "\n".join(lines)
 
 

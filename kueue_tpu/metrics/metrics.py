@@ -208,6 +208,35 @@ class Metrics:
             f"{NS}_planner_last_scenarios",
             "Scenario count of the most recent capacity-planner run",
         )
+        # self-healing hot path (core/guard.py): which solver path the
+        # next cycle takes (exactly one of the two series is 1), and
+        # the failover / divergence / quarantine accounting.
+        # kueue_solver_path{path="host"} == 1 is the paging signal for
+        # a degraded (circuit-open or quarantined) device path.
+        self.solver_path = r.gauge(
+            f"{NS}_solver_path",
+            "Active solver path (1 on the path admission currently uses)",
+            ("path",),
+        )
+        for path in ("device", "host"):
+            self.solver_path.set(1 if path == "device" else 0, path=path)
+        self.solver_failovers_total = r.counter(
+            f"{NS}_solver_failovers_total",
+            "Total device-solver failures converted into host-mirror fallback, by cause (raise|deadline)",
+            ("reason",),
+        )
+        self.solver_divergence_checks_total = r.counter(
+            f"{NS}_solver_divergence_checks_total",
+            "Total sampled differential verifications of the device solver against the host mirror",
+        )
+        self.solver_divergences_total = r.counter(
+            f"{NS}_solver_divergences_total",
+            "Total divergences caught by the sampled differential verification (each quarantines the device path)",
+        )
+        self.solver_quarantined_workloads = r.gauge(
+            f"{NS}_solver_quarantined_workloads",
+            "Workloads currently sidelined by the poison-workload quarantine",
+        )
         # durable-state subsystem (kueue_tpu/storage): journal health +
         # crash-recovery accounting. journal_degraded is the paging
         # signal — 1 means appends are failing (ENOSPC/EIO) and the

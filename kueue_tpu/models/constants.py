@@ -127,6 +127,10 @@ class InadmissibleReason(str, Enum):
     WAITING_FOR_PODS_READY = "WaitingForPodsReady"
     ASSUME_FAILED = "AssumeFailed"
     DURABLE_WRITE_FAILED = "DurableWriteFailed"
+    # self-healing hot path (core/guard.py): a head whose scheduling
+    # raised gets a contained strike; repeated strikes quarantine it
+    SCHEDULING_FAILURE = "SchedulingFailure"
+    QUARANTINED = "WorkloadQuarantined"
     UNKNOWN = "Unknown"
 
 
@@ -150,6 +154,16 @@ EVENT_REASONS = frozenset(
         # failure flips persistence to degraded; recovery flips it back
         "JournalDegraded",
         "JournalRecovered",
+        # self-healing hot path (core/guard.py): device-path circuit
+        # breaker transitions, sampled-divergence quarantine of the
+        # device solver, contained cycle failures, and the
+        # poison-workload quarantine lifecycle
+        "SolverFailover",
+        "SolverRecovered",
+        "SolverDiverged",
+        "SchedulingCycleFailed",
+        "WorkloadQuarantined",
+        "WorkloadUnquarantined",
     }
 )
 
@@ -190,6 +204,10 @@ _INADMISSIBLE_PATTERNS = (
     ),
     (r"Failed to assume", InadmissibleReason.ASSUME_FAILED),
     (r"durable write failed", InadmissibleReason.DURABLE_WRITE_FAILED),
+    # self-healing hot path: quarantine dominates the strike message
+    # (a quarantined head's message also names the original failure)
+    (r"is quarantined", InadmissibleReason.QUARANTINED),
+    (r"raised during scheduling", InadmissibleReason.SCHEDULING_FAILURE),
 )
 
 

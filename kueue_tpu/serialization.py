@@ -599,6 +599,17 @@ def runtime_from_state(data: dict, runtime=None, **runtime_kwargs):
         rt.add_local_queue(lq_from_dict(l))
     for w in data.get("workloads", []):
         rt.add_workload(workload_from_dict(w))
+    # poison-workload quarantine (core/guard.py): sidelined heads stay
+    # sidelined across restarts — the journal records them, and the
+    # checkpoint must too or compaction would silently release poison
+    for q in data.get("quarantine", []):
+        rt.quarantine.restore(
+            q["key"],
+            message=q.get("message", ""),
+            since=float(q.get("since", 0.0)),
+            until=float(q.get("until", 0.0)),
+            strikes=int(q.get("strikes", 0)),
+        )
     # persistence metadata (written by checkpoints): restore the
     # monotone mutation counter so post-recovery journal records keep
     # increasing instead of restarting from zero
@@ -647,6 +658,9 @@ def runtime_to_state(rt) -> dict:
         "resourceVersion": getattr(rt, "resource_version", 0),
         "journalSeq": journal.last_seq if journal is not None else 0,
     }
+    quarantine = getattr(rt, "quarantine", None)
+    if quarantine is not None and len(quarantine):
+        out["quarantine"] = [e.to_dict() for e in quarantine.items()]
     return out
 
 

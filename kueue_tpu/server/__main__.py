@@ -192,6 +192,14 @@ def main(argv=None) -> int:
         help="disable the batched TPU nomination path",
     )
     parser.add_argument(
+        "--solver-path", choices=["auto", "host", "device"], default="auto",
+        help="solver guard mode (core/guard.py): auto = device with "
+        "circuit-breaker failover to the numpy host mirror (the "
+        "default), host = force the host mirror (degraded-solver "
+        "runbook escape hatch), device = never fail over (debugging; "
+        "device faults propagate)",
+    )
+    parser.add_argument(
         "--no-auto-reconcile", action="store_true",
         help="only reconcile on POST /reconcile",
     )
@@ -272,10 +280,15 @@ def main(argv=None) -> int:
             rt = runtime_from_config(cfg, tas_cache=TASCache())
             if use_solver is not None:
                 rt.scheduler.use_solver = use_solver
+            if args.solver_path != "auto":
+                rt.guard.config.mode = args.solver_path
             return rt
         from kueue_tpu.controllers import ClusterRuntime
 
-        return ClusterRuntime(use_solver=use_solver, tas_cache=TASCache())
+        return ClusterRuntime(
+            use_solver=use_solver, tas_cache=TASCache(),
+            solver_path=args.solver_path,
+        )
 
     journal_opts = {
         "fsync_policy": args.journal_fsync,

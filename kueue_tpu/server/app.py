@@ -584,7 +584,7 @@ _SECURED_ROUTES = frozenset(
     {
         "apply", "apply_batch", "delete", "delete_ns", "check_state",
         "reconcile", "solve", "metrics", "state", "debug_cycles",
-        "workload_decisions", "plan",
+        "workload_decisions", "plan", "quarantine_list", "quarantine_clear",
     }
 )
 
@@ -640,6 +640,8 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/reconcile$"), "reconcile"),
     ("GET", re.compile(r"^/events/stream$"), "events_stream"),
     ("GET", re.compile(r"^/debug/cycles$"), "debug_cycles"),
+    ("GET", re.compile(r"^/debug/quarantine$"), "quarantine_list"),
+    ("POST", re.compile(r"^/debug/quarantine/clear$"), "quarantine_clear"),
     ("POST", re.compile(r"^/debug/plan$"), "plan"),
     (
         "GET",
@@ -757,6 +759,22 @@ def _make_handler(srv: KueueServer):
                     "lastError": st.last_error,
                     "lastFsyncAgeS": st.last_fsync_age_s,
                 }
+            # solver-path detail (core/guard.py): same journal-degraded
+            # convention — an open/quarantined device circuit or any
+            # quarantined workload flips "degraded" while the probe
+            # stays 200 (admission still runs, on the host mirror)
+            guard = getattr(
+                getattr(srv.runtime, "scheduler", None), "guard", None
+            )
+            if guard is not None:
+                detail = guard.health()
+                quarantine = getattr(srv.runtime, "quarantine", None)
+                detail["quarantinedWorkloads"] = (
+                    len(quarantine) if quarantine is not None else 0
+                )
+                body["solver"] = detail
+                if guard.degraded or detail["quarantinedWorkloads"]:
+                    body["status"] = "degraded"
             self._send_json(body)
 
         def _h_readyz(self, query):
@@ -954,6 +972,29 @@ def _make_handler(srv: KueueServer):
                     t.to_dict() for t in srv.runtime.scheduler.last_traces
                 ]
             self._send_json({"cycles": traces})
+
+        def _h_quarantine_list(self, query):
+            """Poison-workload quarantine triage (kueuectl quarantine
+            list): sidelined workloads + the solver guard's state."""
+            with srv.lock:
+                items = srv.runtime.quarantine_report()
+                guard = getattr(srv.runtime.scheduler, "guard", None)
+                solver = guard.health() if guard is not None else {}
+            self._send_json({"items": items, "solver": solver})
+
+        def _h_quarantine_clear(self, query):
+            """Release one (body: {"workload": "ns/name"}) or every
+            (empty body) quarantined workload back to nomination —
+            ``kueuectl quarantine clear`` / the manual requeue."""
+            srv.require_leader()
+            body = self._body()
+            with srv.lock:
+                cleared = srv.runtime.clear_quarantine(
+                    body.get("workload") or None
+                )
+                if srv.auto_reconcile and cleared:
+                    srv.runtime.run_until_idle()
+            self._send_json({"cleared": cleared})
 
         def _h_plan(self, query):
             """What-if capacity planner. Leader-only: a plan is a

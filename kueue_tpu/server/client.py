@@ -240,6 +240,17 @@ class KueueClient:
             resp.close()
 
     # ---- control ----
+    def quarantine_list(self) -> dict:
+        """Sidelined poison workloads + the solver guard's health
+        (GET /debug/quarantine)."""
+        return self._request("GET", "/debug/quarantine")
+
+    def quarantine_clear(self, workload: Optional[str] = None) -> dict:
+        """Release one quarantined workload ("ns/name") — or all of
+        them — back to nomination (POST /debug/quarantine/clear)."""
+        body = {"workload": workload} if workload else {}
+        return self._request("POST", "/debug/quarantine/clear", body)
+
     def reconcile(self) -> dict:
         return self._request("POST", "/reconcile")
 

@@ -143,7 +143,18 @@ def dashboard_payload(rt) -> dict:
         state_counts[w["state"]] = state_counts.get(w["state"], 0) + 1
 
     traces = list(rt.scheduler.last_traces)
+    # solver-path badge (core/guard.py): which engine decides the next
+    # cycle, breaker state, and the quarantine roster
+    guard = getattr(rt.scheduler, "guard", None)
+    solver = guard.health() if guard is not None else {}
+    quarantine = getattr(rt, "quarantine", None)
+    solver["quarantined"] = (
+        [e.to_dict() for e in quarantine.items()]
+        if quarantine is not None
+        else []
+    )
     return {
+        "solver": solver,
         "clusterQueues": cqs,
         "localQueues": lqs,
         "workloads": workloads,
@@ -208,11 +219,16 @@ DASHBOARD_HTML = """<!doctype html>
   .state-Finished { color:var(--muted); }
   .ev-Admitted { color:var(--ok); } .ev-Preempted,.ev-Evicted { color:var(--bad); }
   code { font-size:12px; }
+  .badge { display:inline-block; border-radius:10px; padding:1px 10px;
+           font-size:12px; font-weight:600; border:1px solid var(--line); }
+  .badge.device { color:var(--ok); } .badge.host { color:var(--warn); }
+  .badge.quarantined { color:var(--bad); }
 </style>
 </head>
 <body>
 <h1>kueue-tpu</h1>
-<div class="muted">control-plane dashboard &middot; <span id="mode" class="poll">connecting&hellip;</span></div>
+<div class="muted">control-plane dashboard &middot; <span id="mode" class="poll">connecting&hellip;</span>
+ &middot; solver <span id="solver" class="badge">&hellip;</span></div>
 <div class="tiles" id="tiles"></div>
 <h2>Last cycle</h2><div id="cycle"></div>
 <h2>ClusterQueues</h2><div id="cqs"></div>
@@ -243,6 +259,18 @@ function renderEvents(){
       `<td>${esc(e.message)}</td></tr>`).join('')+'</table>';
 }
 function render(d){
+  const sv = d.solver||{};
+  const svEl = document.getElementById('solver');
+  if (sv.path){
+    const q = (sv.quarantined||[]).length;
+    const cls = sv.breaker==='quarantined' ? 'quarantined' : sv.path;
+    svEl.className = 'badge '+cls;
+    svEl.textContent = sv.path + (sv.breaker!=='closed' ? ` (${sv.breaker})` : '')
+      + (q ? ` · ${q} quarantined wl` : '');
+    svEl.title = `mode=${sv.mode} failovers=${sv.failovers} `+
+      `divergences=${sv.divergences}/${sv.divergenceChecks} checks `+
+      `containedCycles=${sv.containedCycles}`;
+  }
   const st = d.workloadStates||{};
   document.getElementById('tiles').innerHTML =
     [['ClusterQueues',d.clusterQueues.length],['LocalQueues',d.localQueues.length],

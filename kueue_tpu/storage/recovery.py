@@ -31,6 +31,11 @@ WORKLOAD_UPSERT = "workload_upsert"
 WORKLOAD_DELETE = "workload_delete"
 OBJECT_UPSERT = "object_upsert"
 OBJECT_DELETE = "object_delete"
+# self-healing hot path (core/guard.py): poison-workload quarantine
+# lifecycle + durable solver divergence verdicts
+QUARANTINE_SET = "quarantine_set"
+QUARANTINE_CLEAR = "quarantine_clear"
+SOLVER_VERDICT = "solver_verdict"
 
 
 class RecoveryError(Exception):
@@ -122,6 +127,29 @@ def apply_record(rt, rec: JournalRecord) -> None:
                 # e.g. a flavor back in use after replay reordering —
                 # the final state converges from later records
                 pass
+    elif rec.type == QUARANTINE_SET:
+        quarantine = getattr(rt, "quarantine", None)
+        if quarantine is not None:
+            quarantine.restore(
+                rec.data["key"],
+                message=rec.data.get("message", ""),
+                since=float(rec.data.get("since", 0.0)),
+                until=float(rec.data.get("until", 0.0)),
+                strikes=int(rec.data.get("strikes", 0)),
+            )
+    elif rec.type == QUARANTINE_CLEAR:
+        quarantine = getattr(rt, "quarantine", None)
+        if quarantine is not None:
+            quarantine.release(rec.data["key"])
+    elif rec.type == SOLVER_VERDICT:
+        # which solver path produced the admitted state on disk — a
+        # recovered process must know the device path was quarantined
+        # and must not trust the same kernel again without operator
+        # action (same binary, same hardware, same divergence)
+        rt.last_solver_verdict = dict(rec.data)
+        guard = getattr(rt, "guard", None)
+        if guard is not None:
+            guard.breaker.quarantine("journaled divergence verdict (recovered)")
     # unknown record types are skipped: an older binary replaying a
     # newer journal must not crash on vocabulary it doesn't know
 

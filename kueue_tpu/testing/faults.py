@@ -1,39 +1,83 @@
-"""Named fault-injection points for crash-consistency testing.
+"""Named fault-injection points for crash-consistency and failover
+testing.
 
-The durable-state subsystem (kueue_tpu/storage) makes exact promises
-about which crash windows are recoverable: "record appended but not yet
+The durable-state subsystem (kueue_tpu/storage) and the resilient
+solver executor (kueue_tpu/core/guard.py) make exact promises about
+which failure windows are survivable: "record appended but not yet
 applied", "checkpoint tmp written but not yet renamed", "solve finished
-but outcome not yet applied". Each of those windows is marked in
-production code with ``fire("<point name>")`` — a no-op unless a test
-armed the point — so the chaos suite can kill the process (in effect:
-raise through the whole call stack) at every registered point and prove
-recovery converges.
+but outcome not yet applied", "device launch raised/hung/answered
+wrong". Each of those windows is marked in production code with
+``fire("<point name>")`` (or ``transform`` for result-corruption
+points) — a no-op unless a test armed the point — so the chaos suites
+can kill the process / fail the device at every registered point and
+prove recovery (or failover) converges.
 
-Registered points (grep for ``faults.fire`` to audit):
-
-  journal.post_append_pre_apply   a journal record is durable but the
-                                  in-memory mutation it describes has
-                                  not completed (ClusterRuntime hooks)
-  journal.fsync                   immediately before os.fsync on the
-                                  journal segment — arm with an OSError
-                                  action to simulate ENOSPC/EIO and
-                                  drive the degraded-persistence path
-  checkpoint.mid_write            checkpoint tmp file fully written +
-                                  fsynced, os.replace not yet executed
-  cycle.post_solve_pre_apply      scheduler nomination / drain solve
-                                  complete, outcome not yet applied
+Every point carried by a production call site MUST be registered in
+``FAULT_POINTS`` below; ``list_fault_points()`` exposes the registry
+and tests/test_guard.py lints the tree so no call site can introduce an
+undocumented point (mirroring the PR-2 reason-enum lint).
 
 Crashes are raised as ``InjectedCrash(BaseException)`` on purpose:
-broad ``except Exception`` recovery paths in the server must NOT be
-able to swallow a simulated power loss — only the test harness catches
-it.
+broad ``except Exception`` recovery paths in the server — including the
+cycle guard's exception containment — must NOT be able to swallow a
+simulated power loss — only the test harness catches it.
 """
 
 from __future__ import annotations
 
 import os
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
+
+# ---- the fault-point registry ----
+# name -> where it fires and which failure window it models. The chaos
+# suites enumerate this table; the lint test asserts every
+# ``faults.fire("...")`` / ``faults.transform("...")`` /
+# ``fault_point="..."`` call site in the tree names a registered point.
+FAULT_POINTS: Dict[str, str] = {
+    "journal.post_append_pre_apply": (
+        "a journal record is durable but the in-memory mutation it "
+        "describes has not completed (ClusterRuntime journal hooks)"
+    ),
+    "journal.fsync": (
+        "immediately before os.fsync on the journal segment — arm with "
+        "an OSError action to simulate ENOSPC/EIO and drive the "
+        "degraded-persistence path"
+    ),
+    "checkpoint.mid_write": (
+        "checkpoint tmp file fully written + fsynced, os.replace not "
+        "yet executed (utils/lease.atomic_write_text)"
+    ),
+    "cycle.post_solve_pre_apply": (
+        "scheduler nomination / drain solve complete, outcome not yet "
+        "applied (core/scheduler.schedule, controllers.bulk_drain)"
+    ),
+    "solver.device_raise": (
+        "immediately before a device solver dispatch (cycle batch or "
+        "bulk drain) — arm to make the launch raise; the guard must "
+        "contain it and fail over to the host mirror"
+    ),
+    "solver.device_hang": (
+        "immediately after a device dispatch returns — arm with a "
+        "clock-advancing action to simulate a hang past the guard's "
+        "device deadline (FakeClock-disciplined)"
+    ),
+    "solver.device_wrong_answer": (
+        "transform point over the device SolveResult — arm with a "
+        "corrupting callable to model a silently diverging kernel; the "
+        "sampled differential check must catch it"
+    ),
+    "cycle.phase_deadline": (
+        "at each schedule()/bulk_drain phase boundary — arm with a "
+        "clock-advancing action to push the cycle past its wall-clock "
+        "deadline"
+    ),
+}
+
+
+def list_fault_points() -> List[str]:
+    """Sorted names of every registered fault point."""
+    return sorted(FAULT_POINTS)
 
 
 class InjectedCrash(BaseException):
@@ -72,6 +116,28 @@ def fire(name: str) -> None:
     if action == "crash":
         raise InjectedCrash(f"injected crash at fault point {name!r}")
     action()
+
+
+def transform(name: str, value):
+    """Result-corruption hook (``solver.device_wrong_answer``-style
+    points): returns ``value`` untouched unless the point is armed with
+    a callable, which receives the value and returns its replacement.
+    ``action="crash"`` still raises, so every point is also usable as a
+    plain crash site."""
+    if not _armed:
+        return value
+    with _lock:
+        a = _armed.get(name)
+        if a is None:
+            return value
+        if a.skip > 0:
+            a.skip -= 1
+            return value
+        a.fired += 1
+        action = a.action
+    if action == "crash":
+        raise InjectedCrash(f"injected crash at fault point {name!r}")
+    return action(value)
 
 
 def arm(name: str, action="crash", skip: int = 0) -> None:

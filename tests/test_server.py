@@ -59,7 +59,12 @@ def _seed(client):
 
 class TestObjectApi:
     def test_health_and_metrics(self, client):
-        assert client.healthz() == {"status": "ok"}
+        body = client.healthz()
+        assert body["status"] == "ok"
+        # solver-guard detail rides every health probe (core/guard.py)
+        assert body["solver"]["path"] == "device"
+        assert body["solver"]["breaker"] == "closed"
+        assert body["solver"]["quarantinedWorkloads"] == 0
         assert "# TYPE" in client.metrics_text()
 
     def test_apply_and_admit(self, client):
