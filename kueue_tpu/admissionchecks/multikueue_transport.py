@@ -21,6 +21,8 @@ successful call restores it.
 
 from __future__ import annotations
 
+import random
+import threading
 from copy import deepcopy
 from typing import Dict, List, Optional
 
@@ -214,7 +216,15 @@ class RemoteClient:
     Every transport call flows through ``call``: while lost, calls are
     refused until the backoff window elapses; the next attempt is the
     reconnect probe — success restores the cluster, failure doubles
-    the wait (b * 2^(n-1), capped)."""
+    the wait (b * 2^(n-1), capped). The backoff carries multiplicative
+    ``jitter``: after a shared partition heals, N clusters whose
+    clients failed in lockstep must NOT retry in lockstep (a
+    synchronized reconnect storm against the recovering control
+    plane), so each window is stretched by an independent factor in
+    [1, 1+jitter). While lost, at most ``max_inflight_probes``
+    concurrent calls may act as the reconnect probe — every other
+    caller is refused immediately, capping the in-flight retries a
+    slow half-open remote can accumulate."""
 
     def __init__(
         self,
@@ -222,15 +232,23 @@ class RemoteClient:
         clock,
         base_backoff_s: float = 1.0,
         max_backoff_s: float = 300.0,
+        jitter: float = 0.1,
+        max_inflight_probes: int = 1,
+        rng: Optional[random.Random] = None,
     ):
         self.transport = transport
         self.clock = clock
         self.base_backoff_s = base_backoff_s
         self.max_backoff_s = max_backoff_s
+        self.jitter = jitter
+        self.max_inflight_probes = max_inflight_probes
+        self._rng = rng if rng is not None else random.Random()
         self.active = True
         self.lost_since: Optional[float] = None
         self.failed_attempts = 0
         self.next_retry_at = 0.0
+        self._mu = threading.Lock()
+        self._inflight_probes = 0
 
     def _record_failure(self) -> None:
         now = self.clock.now()
@@ -242,6 +260,8 @@ class RemoteClient:
             self.max_backoff_s,
             self.base_backoff_s * (2 ** (self.failed_attempts - 1)),
         )
+        if self.jitter:
+            delay *= 1.0 + self.jitter * self._rng.random()
         self.next_retry_at = now + delay
 
     def _record_success(self) -> None:
@@ -256,10 +276,22 @@ class RemoteClient:
         return self.active or self.clock.now() >= self.next_retry_at
 
     def call(self, op: str, *args):
-        if not self.active and self.clock.now() < self.next_retry_at:
-            raise ClusterUnreachable(
-                f"backoff until t={self.next_retry_at:.1f}"
-            )
+        probing = False
+        with self._mu:
+            if not self.active:
+                if self.clock.now() < self.next_retry_at:
+                    raise ClusterUnreachable(
+                        f"backoff until t={self.next_retry_at:.1f}"
+                    )
+                if self._inflight_probes >= self.max_inflight_probes:
+                    # another caller already holds the reconnect probe:
+                    # refuse instead of stacking retries on a remote
+                    # that may be answering slowly
+                    raise ClusterUnreachable(
+                        "reconnect probe already in flight"
+                    )
+                self._inflight_probes += 1
+                probing = True
         try:
             result = getattr(self.transport, op)(*args)
         except TransportError as e:
@@ -270,5 +302,9 @@ class RemoteClient:
             # state recovers, the rejection propagates per-workload
             self._record_success()
             raise
+        finally:
+            if probing:
+                with self._mu:
+                    self._inflight_probes -= 1
         self._record_success()
         return result

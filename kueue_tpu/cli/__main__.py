@@ -529,6 +529,59 @@ def cmd_explain(state: State, args) -> None:
     elif not wl.active:
         status = "INACTIVE"
     _render_decision_timeline(key, status, rows)
+    # MultiKueue federation: the dispatcher stamps the winning worker
+    # cluster into the local workload's labels
+    from kueue_tpu.federation import WINNER_LABEL
+
+    winner = (wl.labels or {}).get(WINNER_LABEL)
+    if winner:
+        print(f'Winning cluster: "{winner}" (MultiKueue federation)')
+
+
+def cmd_clusters(state: State, args) -> None:
+    """`kueuectl clusters list` — the federation worker-cluster roster:
+    connectivity, quarantine state, dispatch/win counters. Reads a live
+    federation manager (--server)."""
+    if not getattr(args, "server", None):
+        raise SystemExit(
+            "error: `kueuectl clusters list` reads a live federation "
+            "manager; pass --server http://<manager>"
+        )
+    from kueue_tpu.server.client import ClientError
+
+    client = _server_client(args)
+    try:
+        items = client.federation_clusters().get("items", [])
+    except ClientError as e:
+        if e.status == 404:
+            raise SystemExit(
+                "error: federation is not enabled on this server "
+                "(start it with --federation-worker NAME=URL)"
+            )
+        raise
+    rows = []
+    for c in items:
+        status = "Active" if c.get("active") else "Lost"
+        if c.get("quarantinedUntil") is not None:
+            status = "Quarantined"
+        rows.append(
+            [
+                c.get("name", ""),
+                status,
+                str(c.get("wins", 0)),
+                str(c.get("dispatches", 0)),
+                str(c.get("strikes", 0)),
+                (
+                    "-"
+                    if c.get("lostSince") is None
+                    else f"t={c['lostSince']:.0f}"
+                ),
+            ]
+        )
+    _print_table(
+        ["NAME", "STATUS", "WINS", "DISPATCHES", "STRIKES", "LOST-SINCE"],
+        rows,
+    )
 
 
 # ---- plan (the what-if capacity planner) ----
@@ -1158,6 +1211,15 @@ def build_parser() -> argparse.ArgumentParser:
         exp, "read the decision trail from a running kueue_tpu.server"
     )
     exp.set_defaults(fn=cmd_explain)
+
+    cl = sub.add_parser(
+        "clusters",
+        help="MultiKueue federation: worker-cluster roster "
+        "(connectivity, quarantine, dispatch/win counters)",
+    )
+    cl.add_argument("action", choices=["list"])
+    _add_server_flags(cl, "federation manager to query (required)")
+    cl.set_defaults(fn=cmd_clusters)
 
     pl = sub.add_parser(
         "plan",

@@ -90,6 +90,13 @@ class ClusterRuntime:
         self.journal = None
         self.resource_version = 0
         self._journal_degraded_seen = False
+        # MultiKueue federation (kueue_tpu/federation): when a
+        # FederationDispatcher is attached it runs once per reconcile
+        # pass — mirror/poll/retract against the worker control planes.
+        # Recovery replays federation_* journal records into
+        # federation_replay; the dispatcher adopts them on construction.
+        self.federation = None
+        self.federation_replay: List[tuple] = []
         self.pods_ready_cfg = wait_for_pods_ready or WaitForPodsReadyConfig()
         # resource adjustment pipeline stores (pkg/workload/resources.go)
         self.limit_ranges: Dict[str, "object"] = {}  # key -> LimitRange
@@ -860,6 +867,8 @@ class ClusterRuntime:
             flush = getattr(ctrl, "flush", None)
             if flush is not None:
                 flush()
+        if self.federation is not None:
+            self.federation.step()
         if self.topology_ungater is not None:
             self._run_topology_ungater()
         self._update_queue_visibility()

@@ -237,6 +237,37 @@ class Metrics:
             f"{NS}_solver_quarantined_workloads",
             "Workloads currently sidelined by the poison-workload quarantine",
         )
+        # MultiKueue federation (kueue_tpu/federation): cross-cluster
+        # dispatch accounting. clusters_active dropping below the
+        # configured cluster count is the paging signal for a partition
+        # (paired with /healthz's "federation" detail reporting
+        # "degraded" while any configured worker is lost).
+        self.multikueue_dispatches_total = r.counter(
+            f"{NS}_multikueue_dispatches_total",
+            "Total federation transport exchanges per worker cluster and outcome (ok|unreachable|rejected)",
+            ("cluster", "outcome"),
+        )
+        self.multikueue_retractions_total = r.counter(
+            f"{NS}_multikueue_retractions_total",
+            "Total retraction protocol transitions by outcome (enqueued|acked|retried|deduped)",
+            ("outcome",),
+        )
+        self.multikueue_remote_rtt_seconds = r.histogram(
+            f"{NS}_multikueue_remote_rtt_seconds",
+            "Round-trip latency of federation transport exchanges per worker cluster",
+            ("cluster",),
+            buckets=ATTEMPT_BUCKETS,
+        )
+        # `cluster` is open-ended (worker names), so materialize the
+        # empty-label series up front — the exposition grammar (every
+        # histogram exposes buckets) must hold before the first
+        # dispatch; the dispatcher touches each real cluster's series
+        # as it is configured
+        self.multikueue_remote_rtt_seconds.touch(cluster="")
+        self.multikueue_clusters_active = r.gauge(
+            f"{NS}_multikueue_clusters_active",
+            "Worker clusters currently reachable and not quarantined",
+        )
         # durable-state subsystem (kueue_tpu/storage): journal health +
         # crash-recovery accounting. journal_degraded is the paging
         # signal — 1 means appends are failing (ENOSPC/EIO) and the
@@ -327,6 +358,19 @@ class Metrics:
         self.planner_scenarios_total.inc(n_scenarios)
         self.planner_duration_seconds.observe(duration_s, path=path)
         self.planner_last_scenarios.set(n_scenarios)
+
+    def report_dispatch(
+        self, cluster: str, outcome: str, rtt_s: Optional[float] = None
+    ) -> None:
+        """Mirror one federation transport exchange into the scrape
+        surface (outcome in ok|unreachable|rejected; RTT only when the
+        exchange completed a round trip)."""
+        self.multikueue_dispatches_total.inc(cluster=cluster, outcome=outcome)
+        if rtt_s is not None:
+            self.multikueue_remote_rtt_seconds.observe(rtt_s, cluster=cluster)
+
+    def report_retraction(self, outcome: str) -> None:
+        self.multikueue_retractions_total.inc(outcome=outcome)
 
     def report_inadmissible_reason(self, cq: str, reason: str) -> None:
         self.inadmissible_reason_total.inc(cluster_queue=cq, reason=reason)

@@ -150,6 +150,12 @@ EVENT_REASONS = frozenset(
         "MultiKueueClusterLost",
         "MultiKueueRejected",
         "MultiKueueReserved",
+        # MultiKueue federation (kueue_tpu/federation): idempotent
+        # retraction acks, and the per-cluster guard that sidelines a
+        # persistently failing remote from new dispatches
+        "MultiKueueRetracted",
+        "MultiKueueClusterQuarantined",
+        "MultiKueueClusterRecovered",
         # durable-state subsystem (kueue_tpu/storage): journal append
         # failure flips persistence to degraded; recovery flips it back
         "JournalDegraded",

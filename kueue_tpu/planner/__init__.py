@@ -15,6 +15,7 @@ from kueue_tpu.planner.engine import (
     Planner,
     PlanReport,
     ScenarioOutcome,
+    forecast_time_to_admission,
     plan_request,
     solve_scenario_host,
 )
@@ -35,6 +36,7 @@ __all__ = [
     "Planner",
     "PlanReport",
     "ScenarioOutcome",
+    "forecast_time_to_admission",
     "plan_request",
     "solve_scenario_host",
     "PlanScenario",

@@ -57,7 +57,13 @@ from kueue_tpu.planner.scenarios import (
     scenario_from_dict,
 )
 
-__all__ = ["Planner", "PlanReport", "ScenarioOutcome", "plan_request"]
+__all__ = [
+    "Planner",
+    "PlanReport",
+    "ScenarioOutcome",
+    "forecast_time_to_admission",
+    "plan_request",
+]
 
 BASELINE_NAME = "baseline"
 
@@ -1083,3 +1089,84 @@ def plan_request(rt, body: dict) -> dict:
         verify_host=bool(options.get("verifyHost", False)),
     )
     return report.to_dict()
+
+
+def forecast_time_to_admission(
+    rt,
+    wl,
+    runtime_hint_s: float = 600.0,
+    horizon_s: float = 1e6,
+) -> Optional[float]:
+    """Virtual-time forecast of WHEN a cluster would admit one
+    not-yet-submitted workload — the federation dispatcher's placement
+    score ("which cluster *would* admit this gang, and when").
+
+    Strictly read-only over ``rt`` (a ClusterRuntime): the candidate's
+    lowered flavor candidates are tested against the live snapshot
+    (0.0 = quota clears on the next cycle), then against a
+    discrete-event release simulation where every admitted workload
+    frees its usage after ``runtime_hint_s`` — the same virtual-clock
+    discipline as ``Planner._forecast``. Returns seconds until the
+    earliest fit, or None when the cluster cannot admit the workload
+    within ``horizon_s`` (unknown queue, unrepresentable shape, or no
+    capacity ever frees up).
+    """
+    import heapq
+
+    snapshot = take_snapshot(rt.cache)
+    cq_name = rt.queues.cluster_queue_for_workload(wl)
+    if cq_name is None or cq_name not in snapshot.cq_models:
+        return None
+    saved_cursor = wl.last_assignment
+    try:
+        lowered = lower_heads(
+            snapshot,
+            [(wl, cq_name)],
+            rt.cache.flavors,
+            transform=getattr(rt, "transform_config", None),
+        )
+    except Exception:  # noqa: BLE001 — an unscorable head must never
+        # take the dispatch path down; the dispatcher treats None as
+        # "rank last", not as an error
+        return None
+    finally:
+        wl.last_assignment = saved_cursor
+    if lowered.fallback or not len(lowered.heads):
+        return None
+
+    def vec_of(k: int) -> np.ndarray:
+        vec = np.zeros(len(snapshot.fr_list), dtype=np.int64)
+        cells, qty = lowered.cells[0, k], lowered.qty[0, k]
+        for c in range(cells.shape[0]):
+            if cells[c] >= 0:
+                vec[int(cells[c])] += int(qty[c])
+        return vec
+
+    candidates = [
+        vec_of(k)
+        for k in range(lowered.valid.shape[1])
+        if lowered.valid[0, k]
+    ]
+    if not candidates:
+        return None
+    if any(snapshot.fits(cq_name, vec) for vec in candidates):
+        return 0.0
+    # release simulation: admitted usage frees after runtime_hint_s
+    events: List[tuple] = []
+    seq = 0
+    for ws in snapshot.workloads.values():
+        heapq.heappush(
+            events, (runtime_hint_s, seq, ws.cq_name, ws.usage_vec.copy())
+        )
+        seq += 1
+    while events:
+        t, _, name, vec = heapq.heappop(events)
+        if t > horizon_s:
+            return None
+        snapshot.remove_usage(name, vec)
+        while events and events[0][0] == t:
+            _, _, name2, vec2 = heapq.heappop(events)
+            snapshot.remove_usage(name2, vec2)
+        if any(snapshot.fits(cq_name, v) for v in candidates):
+            return float(t)
+    return None

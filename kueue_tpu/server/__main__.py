@@ -204,6 +204,32 @@ def main(argv=None) -> int:
         help="only reconcile on POST /reconcile",
     )
     parser.add_argument(
+        "--federation-worker", action="append", default=None,
+        metavar="NAME=URL",
+        help="run this control plane as a MultiKueue federation manager "
+        "dispatching to the named worker control plane (repeatable; "
+        "URL is another kueue_tpu.server). Pending workloads mirror to "
+        "the planner-ranked workers, admit wherever quota clears "
+        "first, and losers are retracted through the journaled "
+        "at-least-once retraction protocol",
+    )
+    parser.add_argument(
+        "--federation-worker-token", default=None,
+        help="bearer token presented to --federation-worker servers "
+        "started with --auth-token",
+    )
+    parser.add_argument(
+        "--federation-lost-timeout", type=float, default=900.0,
+        help="seconds a partitioned worker may hold a workload's "
+        "reservation before the dispatcher deposes it (fence bump + "
+        "re-dispatch; the multiKueue.workerLostTimeout analog)",
+    )
+    parser.add_argument(
+        "--federation-fanout", type=int, default=None,
+        help="mirror each workload to at most this many best-ranked "
+        "workers (default: all configured workers)",
+    )
+    parser.add_argument(
         "--leader-elect-lease",
         help="path to a shared lease file (on the state volume): "
         "enables leader election — the holder accepts writes and "
@@ -377,6 +403,40 @@ def main(argv=None) -> int:
             (lambda: elector.lease.token) if elector is not None else None
         )
         runtime.attach_journal(journal)
+    if args.federation_worker:
+        # federation manager mode: dispatch to remote worker control
+        # planes over HTTP. Built AFTER journal attach so dispatch /
+        # winner / retraction records are journaled, and the dispatcher
+        # adopts any federation_* records recovery replayed.
+        from kueue_tpu.admissionchecks.multikueue import MultiKueueCluster
+        from kueue_tpu.admissionchecks.multikueue_transport import (
+            HTTPTransport,
+        )
+        from kueue_tpu.federation import FederationDispatcher
+
+        workers = {}
+        for spec in args.federation_worker:
+            name, sep, url = spec.partition("=")
+            if not sep or not name or not url:
+                parser.error(
+                    f"--federation-worker must be NAME=URL, got {spec!r}"
+                )
+            workers[name] = MultiKueueCluster(
+                name=name,
+                transport=HTTPTransport(
+                    url, token=args.federation_worker_token
+                ),
+            )
+        FederationDispatcher(
+            runtime,
+            clusters=workers,
+            worker_lost_timeout=args.federation_lost_timeout,
+            fanout=args.federation_fanout,
+        )
+        print(
+            f"federation manager: dispatching to {sorted(workers)}",
+            flush=True,
+        )
     tls = None
     if args.tls_cert_dir:
         from kueue_tpu.utils.cert import CertRotator

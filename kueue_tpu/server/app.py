@@ -637,6 +637,16 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
         ),
         "lq_status",
     ),
+    (
+        "GET",
+        re.compile(r"^/apis/federation/v1beta1/clusters$"),
+        "federation_clusters",
+    ),
+    (
+        "GET",
+        re.compile(r"^/apis/federation/v1beta1/status$"),
+        "federation_status",
+    ),
     ("POST", re.compile(r"^/reconcile$"), "reconcile"),
     ("GET", re.compile(r"^/events/stream$"), "events_stream"),
     ("GET", re.compile(r"^/debug/cycles$"), "debug_cycles"),
@@ -774,6 +784,17 @@ def _make_handler(srv: KueueServer):
                 )
                 body["solver"] = detail
                 if guard.degraded or detail["quarantinedWorkloads"]:
+                    body["status"] = "degraded"
+            # federation detail (kueue_tpu/federation): same convention
+            # — a lost or quarantined worker cluster flips "degraded"
+            # while the probe stays 200 (the dispatcher keeps routing
+            # around it; the operator pages on the detail /
+            # kueue_multikueue_clusters_active instead)
+            fed = getattr(srv.runtime, "federation", None)
+            if fed is not None:
+                detail = fed.health_report()
+                body["federation"] = detail
+                if detail["degraded"]:
                     body["status"] = "degraded"
             self._send_json(body)
 
@@ -958,6 +979,27 @@ def _make_handler(srv: KueueServer):
                 message=body.get("message", ""),
             )
             self._send_json({"updated": f"{ns}/{name}"})
+
+        def _h_federation_clusters(self, query):
+            """Worker-cluster roster + connectivity/guard state — the
+            `kueuectl clusters list` payload. 404 when this control
+            plane is not running a federation dispatcher."""
+            fed = getattr(srv.runtime, "federation", None)
+            if fed is None:
+                raise ApiError(404, "federation is not enabled")
+            with srv.lock:
+                items = fed.cluster_report()
+            self._send_json({"items": items})
+
+        def _h_federation_status(self, query):
+            """Full federation status: cluster roster, per-workload
+            dispatch state (winner, fence), pending retractions."""
+            fed = getattr(srv.runtime, "federation", None)
+            if fed is None:
+                raise ApiError(404, "federation is not enabled")
+            with srv.lock:
+                status = fed.status()
+            self._send_json(status)
 
         def _h_reconcile(self, query):
             srv.require_leader()

@@ -239,6 +239,18 @@ class KueueClient:
         finally:
             resp.close()
 
+    # ---- federation ----
+    def federation_clusters(self) -> dict:
+        """Worker-cluster roster of a federation manager (the
+        `kueuectl clusters list` payload): {"items": [...]}.
+        404 (ClientError) when the server runs no dispatcher."""
+        return self._request("GET", "/apis/federation/v1beta1/clusters")
+
+    def federation_status(self) -> dict:
+        """Full federation status: health, clusters, per-workload
+        dispatch state (winner + fence), pending retractions."""
+        return self._request("GET", "/apis/federation/v1beta1/status")
+
     # ---- control ----
     def quarantine_list(self) -> dict:
         """Sidelined poison workloads + the solver guard's health

@@ -36,6 +36,20 @@ OBJECT_DELETE = "object_delete"
 QUARANTINE_SET = "quarantine_set"
 QUARANTINE_CLEAR = "quarantine_clear"
 SOLVER_VERDICT = "solver_verdict"
+# MultiKueue federation (kueue_tpu/federation): dispatch intent, winner
+# picks and the retraction queue — replayed in append order into
+# runtime.federation_replay and adopted by the FederationDispatcher, so
+# a dispatcher killed mid-dispatch converges from its own records
+FEDERATION_DISPATCH = "federation_dispatch"
+FEDERATION_WINNER = "federation_winner"
+FEDERATION_RETRACT_ENQUEUE = "federation_retract_enqueue"
+FEDERATION_RETRACT_DONE = "federation_retract_done"
+_FEDERATION_TYPES = (
+    FEDERATION_DISPATCH,
+    FEDERATION_WINNER,
+    FEDERATION_RETRACT_ENQUEUE,
+    FEDERATION_RETRACT_DONE,
+)
 
 
 class RecoveryError(Exception):
@@ -141,6 +155,20 @@ def apply_record(rt, rec: JournalRecord) -> None:
         quarantine = getattr(rt, "quarantine", None)
         if quarantine is not None:
             quarantine.release(rec.data["key"])
+    elif rec.type in _FEDERATION_TYPES:
+        # federation state is owned by the dispatcher, which usually
+        # does not exist yet at recovery time: park the records (in
+        # append order) for FederationDispatcher.restore() — or apply
+        # them live when a dispatcher is already attached
+        fed = getattr(rt, "federation", None)
+        if fed is not None:
+            fed.restore([(rec.type, dict(rec.data))])
+        else:
+            replay = getattr(rt, "federation_replay", None)
+            if replay is None:
+                replay = []
+                rt.federation_replay = replay
+            replay.append((rec.type, dict(rec.data)))
     elif rec.type == SOLVER_VERDICT:
         # which solver path produced the admitted state on disk — a
         # recovered process must know the device path was quarantined

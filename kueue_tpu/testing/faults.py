@@ -72,6 +72,37 @@ FAULT_POINTS: Dict[str, str] = {
         "clock-advancing action to push the cycle past its wall-clock "
         "deadline"
     ),
+    # ---- MultiKueue federation (kueue_tpu/federation) ----
+    "multikueue.partition": (
+        "immediately before every federation transport exchange "
+        "(mirror / poll / sync-back) — arm with a TransportError-raising "
+        "action to model a network partition on that wire, or 'crash' "
+        "to kill the dispatcher mid-exchange"
+    ),
+    "multikueue.lost_retraction": (
+        "immediately before a retraction's remote delete is sent — arm "
+        "with a TransportError-raising action to model the retraction "
+        "lost to a partition (must be retried, at-least-once), or "
+        "'crash' to kill the dispatcher between send and ack"
+    ),
+    "multikueue.duplicate_admit": (
+        "in the winner pick, after remote reservations were observed "
+        "and before the winner record is journaled — the window where "
+        "more than one cluster may hold a reservation; a crash here "
+        "must still converge to exactly one admission after recovery"
+    ),
+    "multikueue.worker_crash": (
+        "at the top of every federation pass — arm with an action that "
+        "crashes + journal-recovers a worker control plane in place; "
+        "the dispatcher must converge to the same federated admitted "
+        "set against the recovered worker"
+    ),
+    "multikueue.stale_token": (
+        "transform point over the fencing token echoed in every remote "
+        "sync-back — arm with a corrupting callable to model a deposed "
+        "winner's stale copy; the dispatcher must refuse the token and "
+        "retract the copy instead of double-admitting"
+    ),
 }
 
 

@@ -63,7 +63,10 @@ class TestRemoteClientStateMachine:
     def test_backoff_doubles_and_caps(self):
         clock = FakeClock(0.0)
         transport = FlakyTransport(InProcessTransport(simple_runtime(clock)))
-        client = RemoteClient(transport, clock, base_backoff_s=1.0, max_backoff_s=8.0)
+        client = RemoteClient(
+            transport, clock, base_backoff_s=1.0, max_backoff_s=8.0,
+            jitter=0.0,
+        )
         transport.down = True
         delays = []
         for _ in range(6):
@@ -77,7 +80,7 @@ class TestRemoteClientStateMachine:
     def test_calls_refused_inside_backoff_window(self):
         clock = FakeClock(0.0)
         transport = FlakyTransport(InProcessTransport(simple_runtime(clock)))
-        client = RemoteClient(transport, clock, base_backoff_s=10.0)
+        client = RemoteClient(transport, clock, base_backoff_s=10.0, jitter=0.0)
         transport.down = True
         with pytest.raises(ClusterUnreachable):
             client.call("get_workload", "ns/x")
@@ -93,7 +96,7 @@ class TestRemoteClientStateMachine:
     def test_success_resets_backoff(self):
         clock = FakeClock(0.0)
         transport = FlakyTransport(InProcessTransport(simple_runtime(clock)))
-        client = RemoteClient(transport, clock, base_backoff_s=1.0)
+        client = RemoteClient(transport, clock, base_backoff_s=1.0, jitter=0.0)
         transport.down = True
         for _ in range(4):
             clock.advance(100.0)
@@ -108,6 +111,89 @@ class TestRemoteClientStateMachine:
             client.call("get_workload", "ns/x")
         # first failure after recovery restarts at the base delay
         assert client.next_retry_at - clock.now() == 1.0
+
+
+    def test_backoff_jitter_desynchronizes_retry_storms(self):
+        """The deterministic b*2^(n-1) schedule retried every cluster
+        at the same instant after a shared partition healed; jitter
+        stretches each window by an independent factor in
+        [1, 1+jitter) so N clients spread out."""
+        import random
+
+        clock = FakeClock(0.0)
+        transport = FlakyTransport(InProcessTransport(simple_runtime(clock)))
+        client = RemoteClient(
+            transport, clock, base_backoff_s=1.0, max_backoff_s=64.0,
+            jitter=0.5, rng=random.Random(7),
+        )
+        transport.down = True
+        delays = []
+        for _ in range(5):
+            clock.advance(1000.0)
+            with pytest.raises(ClusterUnreachable):
+                client.call("get_workload", "ns/x")
+            delays.append(client.next_retry_at - clock.now())
+        for i, d in enumerate(delays):
+            base = 1.0 * (2 ** i)
+            assert base <= d < base * 1.5, (i, d)
+        # two clients sharing the failure schedule do NOT share retry
+        # instants (seeded differently)
+        other = RemoteClient(
+            FlakyTransport(InProcessTransport(simple_runtime(clock))),
+            clock, base_backoff_s=1.0, max_backoff_s=64.0,
+            jitter=0.5, rng=random.Random(8),
+        )
+        other.transport.down = True
+        clock.advance(1000.0)
+        with pytest.raises(ClusterUnreachable):
+            client.call("get_workload", "ns/x")
+        with pytest.raises(ClusterUnreachable):
+            other.call("get_workload", "ns/x")
+        assert client.next_retry_at != other.next_retry_at
+
+    def test_single_reconnect_probe_in_flight(self):
+        """While lost, only max_inflight_probes callers may touch the
+        wire; concurrent callers are refused immediately — the
+        in-flight retry cap per cluster."""
+        import threading
+
+        clock = FakeClock(0.0)
+        inner = InProcessTransport(simple_runtime(clock))
+        release = threading.Event()
+        started = threading.Event()
+
+        class Blocking(FlakyTransport):
+            def _fwd(self, name, *args):
+                self.calls += 1
+                if self.down:
+                    self.failures += 1
+                    raise TransportError("injected fault")
+                started.set()
+                assert release.wait(5.0)
+                return getattr(self.inner, name)(*args)
+
+        transport = Blocking(inner)
+        client = RemoteClient(transport, clock, base_backoff_s=1.0, jitter=0.0)
+        transport.down = True
+        with pytest.raises(ClusterUnreachable):
+            client.call("get_workload", "ns/x")  # now lost
+        clock.advance(2.0)  # backoff elapsed: next call is the probe
+        transport.down = False
+        t = threading.Thread(
+            target=lambda: client.call("get_workload", "ns/x"), daemon=True
+        )
+        t.start()
+        assert started.wait(5.0)
+        calls_before = transport.calls
+        # the probe is in flight: a second caller is refused WITHOUT
+        # touching the transport
+        with pytest.raises(ClusterUnreachable, match="probe already"):
+            client.call("get_workload", "ns/x")
+        assert transport.calls == calls_before
+        release.set()
+        t.join(timeout=5.0)
+        assert client.active  # the probe's success restored the cluster
+        client.call("get_workload", "ns/x")  # active path: no cap
 
 
 def mk_setup(clock=None, batch_dispatch=False):
@@ -314,3 +400,95 @@ class TestHTTPTransportDispatch:
         tr = HTTPTransport("http://127.0.0.1:1")  # nothing listening
         with pytest.raises(TransportError):
             tr.get_workload("ns/x")
+
+
+class TestHTTPTransportClassification:
+    """HTTPTransport against a real in-process kueue_tpu.server app
+    (until now only the InProcessTransport/FlakyTransport paths were
+    exercised here): the 4xx -> RemoteRejected vs 5xx -> TransportError
+    contract, 404 as idempotent absence, and the batched-create wire."""
+
+    def _server(self):
+        from kueue_tpu.server import KueueServer
+
+        rt = simple_runtime()
+        srv = KueueServer(runtime=rt)
+        port = srv.start()
+        return srv, rt, HTTPTransport(f"http://127.0.0.1:{port}")
+
+    def test_4xx_webhook_rejection_is_remote_rejected(self):
+        from kueue_tpu.admissionchecks.multikueue_transport import (
+            RemoteRejected,
+        )
+
+        srv, rt, tr = self._server()
+        try:
+            # DNS-invalid name: the remote webhook chain answers 422 —
+            # a per-workload refusal, NOT a connectivity failure
+            bad = wl("ok")
+            bad.name = "Not_A_DNS_Name"
+            with pytest.raises(RemoteRejected):
+                tr.create_workload(bad)
+        finally:
+            srv.stop()
+
+    def test_5xx_server_fault_is_transport_error(self):
+        srv, rt, tr = self._server()
+        try:
+            def boom(wl):
+                raise RuntimeError("remote control plane fault")
+
+            rt.add_workload = boom  # the handler surfaces this as 500
+            with pytest.raises(TransportError):
+                tr.create_workload(wl("victim"))
+        finally:
+            srv.stop()
+
+    def test_404_is_idempotent_absence_not_an_error(self):
+        srv, rt, tr = self._server()
+        try:
+            assert tr.get_workload("ns/never-created") is None
+            # deleting an absent copy is the retraction protocol's ack
+            # path after redelivery: it must NOT raise
+            assert tr.delete_workload("ns/never-created") is None
+        finally:
+            srv.stop()
+
+    def test_batched_create_and_origin_listing_over_the_wire(self):
+        srv, rt, tr = self._server()
+        try:
+            batch = []
+            for i in range(3):
+                w = wl(f"batch-{i}")
+                w.labels[ORIGIN_LABEL] = "mgr-a"
+                batch.append(w)
+            foreign = wl("foreign")
+            foreign.labels[ORIGIN_LABEL] = "mgr-b"
+            tr.create_workloads(batch + [foreign])
+            assert len(rt.workloads) == 4
+            keys = tr.list_workload_keys("mgr-a")
+            assert sorted(keys) == [f"ns/batch-{i}" for i in range(3)]
+        finally:
+            srv.stop()
+
+    def test_remote_client_recovers_connectivity_on_4xx(self):
+        """A 4xx proves the wire works: the RemoteClient must record
+        success (cluster active) while propagating the rejection."""
+        from kueue_tpu.admissionchecks.multikueue_transport import (
+            RemoteRejected,
+        )
+        from kueue_tpu.utils.clock import FakeClock
+
+        srv, rt, tr = self._server()
+        try:
+            clock = FakeClock(0.0)
+            client = RemoteClient(tr, clock, base_backoff_s=1.0, jitter=0.0)
+            client.active = False
+            client.next_retry_at = 0.0
+            bad = wl("ok")
+            bad.name = "Not_A_DNS_Name"
+            with pytest.raises(RemoteRejected):
+                client.call("create_workload", bad)
+            assert client.active and client.failed_attempts == 0
+        finally:
+            srv.stop()
