@@ -54,6 +54,14 @@ class ClusterRuntime:
         drain_gate=None,  # latency-gate override (perf harness pins it open)
         solver_path: str = "auto",  # auto | host | device (guard mode)
         guard_config=None,  # core.guard.GuardConfig override
+        # Double-buffered drain loop (core/pipeline.py): "on" = chunked
+        # rounds with the next round's encode+solve prefetched on a
+        # speculative snapshot while the host applies the current one
+        # (the default), "serial" = the same chunked rounds without
+        # prefetch (the A/B + property-test comparator), "off" = the
+        # pre-pipeline single-dispatch drain.
+        drain_pipeline: str = "on",
+        pipeline_chunk_cycles: int = 16,
     ):
         from kueue_tpu.metrics import Metrics
 
@@ -198,6 +206,17 @@ class ClusterRuntime:
 
         self.bulk_drain_threshold = bulk_drain_threshold
         self._drain_est = drain_gate if drain_gate is not None else _LatencyEstimate()
+        # Double-buffered drain loop state (core/pipeline.py)
+        from kueue_tpu.core.pipeline import PipelineStats
+
+        if drain_pipeline not in ("on", "serial", "off"):
+            raise ValueError(
+                f"drain_pipeline must be on|serial|off, got {drain_pipeline!r}"
+            )
+        self.drain_pipeline = drain_pipeline
+        self.pipeline_chunk_cycles = max(1, int(pipeline_chunk_cycles))
+        self.pipeline = PipelineStats()
+        self._pipeline_committed = 0  # committed prefetches (divergence sampling)
 
     def _make_preemptor(self, fair_sharing: bool):
         from kueue_tpu.core.preemption import Preemptor
@@ -1174,6 +1193,15 @@ class ClusterRuntime:
         sched.guard.phase_checkpoint("drain.classify")
         if len(pending) < self.bulk_drain_threshold:
             return None  # TAS heads dropped to the cycle loop shrank it
+        if kind == "plain" and self.drain_pipeline != "off":
+            # the double-buffered chunked loop (core/pipeline.py) —
+            # plain scope only: speculation needs nothing beyond the
+            # kernel-reported final usage, and the conflict check
+            # proves each commit; other scopes keep the one-shot path
+            return self._pipelined_bulk_drain(
+                snapshot, pending, ts_fn, t_snapshot, t_classify,
+                prefetch=self.drain_pipeline == "on",
+            )
         t1 = _time.perf_counter()
         # the drain launch runs under the same guard as the cycle
         # dispatch: a raising or deadline-late solve is contained,
@@ -1250,6 +1278,222 @@ class ClusterRuntime:
         self._report_cycle_metrics(result, dt)
         sched.notify_cycle(result)
         return result
+
+    def _pipelined_bulk_drain(
+        self, snapshot, pending, ts_fn, t_snapshot, t_classify,
+        prefetch=True,
+    ):
+        """The double-buffered drain loop (core/pipeline.py): chunked
+        rounds of ``pipeline_chunk_cycles`` kernel cycles each; while
+        the host applies round t (journal append, runtime mutation,
+        audit/event emission), round t+1's encode + device solve is
+        already in flight against a speculative snapshot — the
+        kernel-reported final usage of round t over the exact backlog
+        round t left undecided. At commit the speculative inputs are
+        compared against the real post-apply state; a mismatch discards
+        the prefetch and re-solves (``prefetch=False`` runs the same
+        rounds serially — the property-test comparator). Every round
+        runs under the cycle guard: launches are contained, the
+        deadline covers the whole launch→fetch window of prefetched
+        solves, and every K-th committed prefetch is differentially
+        verified against the numpy drain mirror."""
+        import time as _time
+
+        from kueue_tpu.core.drain import launch_drain, run_drain
+        from kueue_tpu.core.pipeline import (
+            drain_inputs_match,
+            outcome_signature,
+            pending_matches,
+            speculative_snapshot,
+        )
+        from kueue_tpu.core.scheduler import CycleTrace
+        from kueue_tpu.core.snapshot import take_snapshot
+        from kueue_tpu.testing import faults
+
+        sched = self.scheduler
+        stats = self.pipeline
+        chunk = self.pipeline_chunk_cycles
+        flavors = self.cache.flavors
+        last_result = None
+        verify_next = False
+
+        def _launch(snap, pend):
+            return sched.guard.device_launch(
+                lambda: launch_drain(
+                    snap, pend, flavors, timestamp_fn=ts_fn, max_cycles=chunk
+                ),
+                label="pipelined drain round",
+            )
+
+        def _set_inflight(v):
+            stats.inflight = v
+            self.metrics.pipeline_inflight.set(v)
+
+        t1 = _time.perf_counter()
+        glaunch = _launch(snapshot, pending)
+        t_dispatch = _time.perf_counter() - t1
+        rounds = 0
+        while True:
+            rounds += 1
+            t1 = _time.perf_counter()
+            out_g = sched.guard.device_join(glaunch, lambda h: h.fetch())
+            t_solve = t_dispatch + (_time.perf_counter() - t1)
+            stats.solve_s += t_solve
+            _set_inflight(0)
+            if out_g.result is None:
+                # contained launch/fetch failure (or deadline breach):
+                # undecided heads stay in their heaps; the breaker
+                # decides whether the next iteration retries the device
+                return last_result
+            outcome = out_g.result
+            sched.guard.phase_checkpoint("drain.solve", device_used=True)
+            faults.fire("cycle.post_solve_pre_apply")
+            self._drain_est.observe(t_solve / max(len(pending), 1))
+            if verify_next:
+                verify_next = False
+                snap_v, pend_v = snapshot, list(pending)
+                host = sched.guard.check_drain_divergence(
+                    outcome_signature(outcome),
+                    lambda: (
+                        lambda o: (o, outcome_signature(o))
+                    )(
+                        run_drain(
+                            snap_v, pend_v, flavors, timestamp_fn=ts_fn,
+                            max_cycles=chunk, use_device=False,
+                        )
+                    ),
+                    heads=len(pend_v),
+                )
+                if host is not None:
+                    outcome = host  # host mirror is now the authority
+            undecided = outcome.undecided
+            decided = bool(outcome.admitted or outcome.parked)
+            if not decided:
+                # the chunk decided NOTHING (fully unrepresentable or
+                # stuck-frozen backlog): remaining heads fall to the
+                # cycle loop; returning the last applied round keeps
+                # run_until_idle's fingerprint honest
+                return last_result
+
+            # ---- prefetch round t+1 before applying round t ----
+            pf = pf_snap = None
+            t_prefetch = 0.0
+            if (
+                prefetch
+                and undecided
+                and outcome.final_usage is not None
+                and sched.guard.allow_device()
+            ):
+                t1 = _time.perf_counter()
+                pf_snap = speculative_snapshot(snapshot, outcome.final_usage)
+                pf = sched.guard.device_launch(
+                    lambda: launch_drain(
+                        pf_snap, undecided, flavors, timestamp_fn=ts_fn,
+                        max_cycles=chunk,
+                    ),
+                    label="pipelined drain prefetch",
+                )
+                t_prefetch = _time.perf_counter() - t1
+                if pf.failed:
+                    pf = None
+                else:
+                    stats.prefetches += 1
+                    _set_inflight(1)
+                faults.fire("cycle.prefetch_launched")
+
+            # ---- apply round t (the overlapped host stage) ----
+            sched.guard.begin_cycle()
+            t1 = _time.perf_counter()
+            sched.scheduling_cycle += 1
+            try:
+                result = self._apply_drain_outcome(outcome, snapshot)
+            except faults.InjectedCrash:
+                raise  # simulated power loss: the chaos suite's window
+            except Exception as exc:  # noqa: BLE001 — contained apply
+                sched.guard.note_contained_cycle(exc)
+                _set_inflight(0)
+                return last_result
+            t_apply = _time.perf_counter() - t1
+            stats.rounds += 1
+            stats.apply_s += t_apply
+            if pf is not None:
+                stats.overlapped_apply_s += t_apply
+            self.metrics.pipeline_overlap_ratio.set(stats.overlap_ratio)
+            sched.guard.phase_checkpoint("drain.apply", device_used=True)
+
+            # ---- commit or discard the prefetch ----
+            t_commit = 0.0
+            if undecided:
+                t1 = _time.perf_counter()
+                snapshot2 = take_snapshot(self.cache)
+                pending2 = self.drain_backlog(snapshot2)
+                if not pending2:
+                    # the undecided heads vanished under us (deleted /
+                    # deactivated mid-apply): nothing left to solve —
+                    # drop any prefetch and finish
+                    if pf is not None:
+                        stats.discards += 1
+                        self.metrics.pipeline_prefetch_discards_total.inc()
+                    undecided = []
+                committed = (
+                    undecided
+                    and pf is not None
+                    and pf_snap is not None
+                    and pending_matches(undecided, pending2)
+                    and drain_inputs_match(pf_snap, snapshot2)
+                )
+                t_commit = _time.perf_counter() - t1
+                if not undecided:
+                    pass
+                elif committed:
+                    stats.commits += 1
+                    self._pipeline_committed += 1
+                    faults.fire("cycle.commit_pre_apply")
+                    glaunch, t_dispatch = pf, 0.0
+                    verify_next = sched.guard.should_sample_drain(
+                        self._pipeline_committed
+                    )
+                else:
+                    if pf is not None:
+                        stats.discards += 1
+                        self.metrics.pipeline_prefetch_discards_total.inc()
+                    _set_inflight(0)
+                    t1 = _time.perf_counter()
+                    glaunch = _launch(snapshot2, pending2)
+                    t_dispatch = _time.perf_counter() - t1
+                snapshot, pending = snapshot2, pending2
+
+            # ---- per-round trace + metrics + notification ----
+            spans = {
+                "solve": t_solve,
+                "apply": t_apply,
+                "prefetch": t_prefetch,
+                "commit": t_commit,
+            }
+            if rounds == 1:
+                spans["snapshot"] = t_snapshot
+                spans["classify"] = t_classify
+            dt = sum(spans.values())
+            trace = CycleTrace(
+                cycle=sched.scheduling_cycle,
+                heads=len(outcome.admitted)
+                + len(outcome.parked)
+                + len(outcome.fallback),
+                admitted=len(result.admitted),
+                preempting=len(result.preempting),
+                resolution="drain",
+                total_s=dt,
+                spans=spans,
+                device_s=t_solve,
+                host_s=dt - t_solve,
+            )
+            sched.last_traces.append(trace)
+            self._report_cycle_metrics(result, dt)
+            sched.notify_cycle(result)
+            last_result = result
+            if not undecided or rounds >= 100000:
+                _set_inflight(0)
+                return last_result
 
     def _apply_drain_outcome(self, outcome, snapshot):
         """Apply a DrainOutcome in kernel cycle order: evictions before

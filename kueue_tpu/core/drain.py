@@ -53,6 +53,17 @@ class DrainOutcome:
     # processed were routed to ``fallback`` (not parked), so the cycle
     # loop — not a silent park — decides them
     truncated: bool = False
+    # the truncation-routed subset of ``fallback``: entries the kernel
+    # simply never reached before max_cycles (NOT structurally
+    # unrepresentable, NOT stuck-frozen) — re-running the drain over
+    # exactly these from the post-apply state continues where this
+    # chunk stopped. The pipelined drain loop (core/pipeline.py) feeds
+    # them to the next round.
+    undecided: List[Tuple[Workload, str]] = field(default_factory=list)
+    # final leaf usage [N, FR] as the kernel left it — the speculative
+    # post-apply snapshot the pipeline launches round t+1 against
+    # (None on paths that don't report it)
+    final_usage: Optional[np.ndarray] = None
 
 
 def _admitted_flavors(lowered, i: int, adm_k_row) -> Dict[str, str]:
@@ -764,6 +775,109 @@ def run_drain_for_scope(
     return run_drain(snapshot, pending, flavors, timestamp_fn=timestamp_fn)
 
 
+def launch_drain_for_scope(
+    kind: str,
+    snapshot: Snapshot,
+    pending: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    timestamp_fn=None,
+    max_cycles: Optional[int] = None,
+) -> Optional[DrainLaunch]:
+    """Async (launch/fetch) twin of ``run_drain_for_scope`` for the
+    scopes the pipelined drain loop can double-buffer. Returns None for
+    scopes without a launch/fetch split yet (fair / preempt / TAS keep
+    the blocking path — the pipeline falls back to serial rounds for
+    them)."""
+    if kind != "plain":
+        return None
+    return launch_drain(
+        snapshot, pending, flavors, timestamp_fn=timestamp_fn,
+        max_cycles=max_cycles,
+    )
+
+
+class PanelTuner:
+    """Online kernel-shape search for the victim-search panel width.
+
+    The contended drain is THROUGHPUT-bound in the strategy-ladder scan
+    (BENCH_NOTES_r05.md: cost scales with ``search_width``; fusing the
+    two attempts changed nothing), so the shape lever is the panel
+    width itself. Candidate panels are already sorted by the
+    preemption-cost key (evicted first, other-CQ first, lowest
+    priority, most recently reserved — preemption.go:591-618, the
+    ordering PREMA/arXiv:1909.04548 motivates), so the true victim set
+    is a PREFIX of the panel in the common case and a narrow window
+    finds it. Exactness is guaranteed by the escape hatch in
+    ``run_drain_preempt``: a solve whose ``overflowed`` flag fired
+    (some eligible list overflowed the window AND the search missed)
+    is discarded and re-solved at the next wider width, ending at the
+    exact ``search_width`` — decisions are bit-for-bit the fixed-width
+    kernel's at every step.
+
+    This tuner is the per-workload-mix coordinate descent of
+    arXiv:2406.20037 reduced to the one live coordinate: per final
+    (exact) width it walks the width ladder — an escalation widens the
+    starting panel for the next call, ``shrink_after`` consecutive
+    clean narrow solves try the next narrower rung. State only ever
+    changes WHICH executable runs, never what it answers."""
+
+    LADDER = (8, 16, 32, 64, 128, 256, 512)
+
+    def __init__(self, shrink_after: int = 8):
+        self.shrink_after = shrink_after
+        self._narrow: Dict[int, int] = {}  # final width -> narrow width
+        self._clean: Dict[int, int] = {}  # consecutive clean solves
+        self.escalations = 0
+        self.solves = 0
+
+    def _default_narrow(self, final: int) -> int:
+        for w in self.LADDER:
+            if w * 4 >= final:
+                return min(w, final)
+        return final
+
+    def widths_for(self, final: int) -> Tuple[int, ...]:
+        """The width schedule for one drain: (narrow, ..., final)."""
+        narrow = self._narrow.get(final)
+        if narrow is None:
+            narrow = self._default_narrow(final)
+            self._narrow[final] = narrow
+        if narrow >= final:
+            return (final,)
+        return (narrow, final)
+
+    def observe(self, final: int, escalated: bool) -> None:
+        self.solves += 1
+        narrow = self._narrow.get(final, final)
+        if escalated:
+            self.escalations += 1
+            self._clean[final] = 0
+            # widen: next rung up (capped at final)
+            self._narrow[final] = min(final, max(narrow * 2, 8))
+        else:
+            n = self._clean.get(final, 0) + 1
+            self._clean[final] = n
+            if n >= self.shrink_after and narrow > self.LADDER[0]:
+                self._narrow[final] = narrow // 2
+                self._clean[final] = 0
+
+
+# process-wide default tuner: the production runtime and the bench
+# share it so the shape converges to the live workload mix
+_PANEL_TUNER = PanelTuner()
+
+# operator override (server --panel-widths): a fixed schedule replaces
+# the tuner's; None = tune online
+_PANEL_WIDTHS_OVERRIDE: Optional[Tuple[int, ...]] = None
+
+
+def set_default_panel_widths(widths: Optional[Sequence[int]]) -> None:
+    """Pin the victim-search panel schedule process-wide (the server's
+    ``--panel-widths`` knob); None restores the online PanelTuner."""
+    global _PANEL_WIDTHS_OVERRIDE
+    _PANEL_WIDTHS_OVERRIDE = tuple(widths) if widths is not None else None
+
+
 def run_drain_preempt(
     snapshot: Snapshot,
     pending: Sequence[Tuple[Workload, str]],
@@ -777,6 +891,8 @@ def run_drain_preempt(
     now: Optional[float] = None,
     search_width: int = 32,
     mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
+    panel_widths: Optional[Sequence[int]] = None,
+    panel_tuner: Optional[PanelTuner] = None,
 ) -> PreemptDrainOutcome:
     """Multi-cycle drain WITH classic preemption — within-ClusterQueue
     and cross-CQ cohort reclamation — in one device dispatch + one
@@ -798,6 +914,14 @@ def run_drain_preempt(
     cycle order (a drain-admitted entry may later be evicted by a
     reclaiming CQ: it appears in BOTH lists) — this function only
     decides.
+
+    ``panel_widths`` overrides the panel schedule (last entry = the
+    trusted exact width); default is the process-wide ``PanelTuner``'s
+    (narrow, search_width) schedule — the solve runs at the narrow
+    cost-ordered panel and re-solves at the wide exact width ONLY when
+    the kernel reports an inconclusive truncated search, so decisions
+    always equal the fixed-``search_width`` kernel's (asserted in
+    tests/test_drain_parity.py).
     """
     from kueue_tpu._jax import jnp
     from kueue_tpu.ops.drain_kernel import (
@@ -848,19 +972,51 @@ def run_drain_preempt(
         victims = SegVictims(
             **{k: jnp.asarray(v) for k, v in victims_np.items()}
         )
-    flat = np.asarray(
-        solve_drain_preempt_packed_jit(
-            tree_in,
-            usage_in,
-            queues,
-            victims,
-            paths_in,
-            n_segments=plan.n_segments,
-            n_steps=plan.n_steps,
-            max_cycles=plan.max_cycles,
-            search_width=search_width,
-        )
-    )  # the single fetch
+    # ---- the two-tier panel ladder (exactness escape hatch) ----
+    # Solve at the narrow cost-ordered panel first; if ANY head's
+    # search overflowed the window and missed (the kernel's
+    # ``overflowed`` flag — the only way truncation can be inexact),
+    # discard and re-solve at the next wider width, ending at the
+    # exact ``search_width``. Decisions are therefore bit-for-bit the
+    # fixed ``search_width`` kernel's: a clean narrow run is provably
+    # identical (every search succeeded in-window or failed with its
+    # whole eligible list in-window), and an escalated run IS the wide
+    # run.
+    tuner = panel_tuner if panel_tuner is not None else _PANEL_TUNER
+    if panel_widths is None:
+        panel_widths = _PANEL_WIDTHS_OVERRIDE
+    if mesh is not None:
+        # sharded dispatch keeps the single exact width: the GSPMD
+        # partitioner miscompiles the narrow-panel compaction at small
+        # static widths (mixed s32/s64 compare in the partitioned HLO),
+        # and the mesh path is not the contended hot path anyway
+        widths = (search_width,)
+        panel_widths = widths
+    elif panel_widths is not None:
+        widths = tuple(panel_widths)
+    else:
+        widths = tuner.widths_for(search_width)
+    escalated = False
+    for i, width in enumerate(widths):
+        flat = np.asarray(
+            solve_drain_preempt_packed_jit(
+                tree_in,
+                usage_in,
+                queues,
+                victims,
+                paths_in,
+                n_segments=plan.n_segments,
+                n_steps=plan.n_steps,
+                max_cycles=plan.max_cycles,
+                search_width=int(width),
+            )
+        )  # one fetch per tier; the common case stops at the first
+        overflowed = bool(flat[-2])
+        if not overflowed or i == len(widths) - 1:
+            break
+        escalated = True
+    if panel_widths is None:
+        tuner.observe(search_width, escalated)
     return _preempt_outcome(plan, low, flat, queues_np, fair=False)
 
 
@@ -1690,6 +1846,94 @@ def run_drain_tas(
     )
 
 
+@dataclass
+class DrainLaunch:
+    """An in-flight plain-drain device dispatch (launch/fetch split).
+
+    ``launch_drain`` dispatches the packed solve and returns
+    immediately — JAX's async dispatch keeps the device working while
+    the host does something else (the pipelined drain loop applies the
+    PREVIOUS round's outcome inside this window, core/pipeline.py).
+    ``fetch()`` blocks on the ONE result fetch and maps decisions back
+    to workloads. Nothing between construction and fetch touches
+    runtime state, so an unfetched launch is always safe to discard
+    (the pipeline's conflict-miss path)."""
+
+    plan: DrainPlan
+    queues_np: dict
+    flat_dev: object  # unfetched device array
+    usage_shape: Tuple[int, int]
+    extra_fb_entries: List[Tuple[Workload, str]] = field(default_factory=list)
+    # the exact backlog this launch solves, in per-CQ heap order — the
+    # pipeline's commit check compares it against the real post-apply
+    # backlog before trusting a speculative launch
+    pending: Optional[List[Tuple[Workload, str]]] = None
+    max_cycles: Optional[int] = None
+
+    def fetch(self) -> DrainOutcome:
+        flat = np.asarray(self.flat_dev)  # the single fetch
+        nq, nl, npd = self.queues_np["cells"].shape[:3]  # incl. padding
+        ql = nq * nl
+        qlp = nq * nl * npd
+        adm_k = flat[:qlp].reshape((nq, nl, npd))
+        adm_cycle = flat[qlp : qlp + ql].reshape((nq, nl))
+        cursor = flat[qlp + ql : qlp + ql + nq]
+        stuck_q = flat[qlp + ql + nq : qlp + ql + 2 * nq].astype(bool)
+        off = qlp + ql + 2 * nq
+        n_u = int(self.usage_shape[0]) * int(self.usage_shape[1])
+        final_usage = flat[off : off + n_u].reshape(self.usage_shape)
+        cycles = int(flat[-1])
+        return _map_drain_result(
+            self.plan, adm_k, adm_cycle, cursor, stuck_q, cycles,
+            self.queues_np, self.extra_fb_entries,
+            final_usage=final_usage,
+        )
+
+
+def launch_drain(
+    snapshot: Snapshot,
+    pending: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    max_candidates: int = 8,
+    max_cells: int = 4,
+    timestamp_fn=None,
+    max_cycles: Optional[int] = None,
+) -> DrainLaunch:
+    """Plan + DISPATCH the plain device drain without fetching — the
+    async half of ``run_drain`` (device, no fair sharing, no mesh: the
+    pipelined hot path). ``run_drain(...) == launch_drain(...).fetch()``
+    for that configuration, by construction."""
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.ops.drain_kernel import DrainQueues, solve_drain_packed_jit
+
+    plan = plan_drain(
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
+    )
+    if max_cycles is not None:
+        plan.max_cycles = max_cycles
+    tree, paths, _ = tree_arrays(snapshot)
+    queues = DrainQueues(
+        **{k: jnp.asarray(v) for k, v in plan.queues_np.items()}
+    )
+    flat_dev = solve_drain_packed_jit(
+        tree,
+        jnp.asarray(snapshot.local_usage),
+        queues,
+        paths,
+        n_segments=plan.n_segments,
+        n_steps=plan.n_steps,
+        max_cycles=plan.max_cycles,
+    )
+    return DrainLaunch(
+        plan=plan,
+        queues_np=plan.queues_np,
+        flat_dev=flat_dev,
+        usage_shape=tuple(snapshot.local_usage.shape),
+        pending=list(pending),
+        max_cycles=plan.max_cycles,
+    )
+
+
 def run_drain(
     snapshot: Snapshot,
     pending: Sequence[Tuple[Workload, str]],
@@ -1798,6 +2042,7 @@ def run_drain(
             int(host.cycles),
             plan.queues_np,
             extra_fb_entries=[],
+            final_usage=np.asarray(host.local_usage),
         )
     tree, paths, _ = tree_arrays(snapshot)
     queues_np = plan.queues_np
@@ -1840,47 +2085,40 @@ def run_drain(
             weight_in = jnp.asarray(snapshot.weight_milli)
             lendable_in = jnp.asarray(lendable)
             res_in = jnp.asarray(res_of_fr)
-        flat = np.asarray(
-            solve_drain_fair_packed_jit(
-                tree,
-                usage_in,
-                queues,
-                paths,
-                depth_in,
-                weight_in,
-                lendable_in,
-                res_in,
-                n_segments=plan.n_segments,
-                n_steps=plan.n_steps,
-                max_cycles=plan.max_cycles,
-                n_res=n_res,
-                prio_tie=bool(_feature_enabled("PrioritySortingWithinCohort")),
-            )
-        )  # the single fetch
+        flat_dev = solve_drain_fair_packed_jit(
+            tree,
+            usage_in,
+            queues,
+            paths,
+            depth_in,
+            weight_in,
+            lendable_in,
+            res_in,
+            n_segments=plan.n_segments,
+            n_steps=plan.n_steps,
+            max_cycles=plan.max_cycles,
+            n_res=n_res,
+            prio_tie=bool(_feature_enabled("PrioritySortingWithinCohort")),
+        )
     else:
-        flat = np.asarray(
-            solve_drain_packed_jit(
-                tree,
-                usage_in,
-                queues,
-                paths,
-                n_segments=plan.n_segments,
-                n_steps=plan.n_steps,
-                max_cycles=plan.max_cycles,
-            )
-        )  # the single fetch
-    nq, nl, npd = queues_np["cells"].shape[:3]  # incl. mesh padding
-    ql = nq * nl
-    qlp = nq * nl * npd
-    adm_k = flat[:qlp].reshape((nq, nl, npd))
-    adm_cycle = flat[qlp : qlp + ql].reshape((nq, nl))
-    cursor = flat[qlp + ql : qlp + ql + nq]
-    stuck_q = flat[qlp + ql + nq : qlp + ql + 2 * nq].astype(bool)
-    cycles = int(flat[-1])
-    return _map_drain_result(
-        plan, adm_k, adm_cycle, cursor, stuck_q, cycles, queues_np,
-        extra_fb_entries,
-    )
+        flat_dev = solve_drain_packed_jit(
+            tree,
+            usage_in,
+            queues,
+            paths,
+            n_segments=plan.n_segments,
+            n_steps=plan.n_steps,
+            max_cycles=plan.max_cycles,
+        )
+    return DrainLaunch(
+        plan=plan,
+        queues_np=queues_np,
+        flat_dev=flat_dev,
+        usage_shape=tuple(snapshot.local_usage.shape),
+        extra_fb_entries=extra_fb_entries,
+        pending=list(pending),
+        max_cycles=plan.max_cycles,
+    ).fetch()
 
 
 def _map_drain_result(
@@ -1892,6 +2130,7 @@ def _map_drain_result(
     cycles: int,
     queues_np: dict,
     extra_fb_entries: List[Tuple[Workload, str]],
+    final_usage: Optional[np.ndarray] = None,
 ) -> DrainOutcome:
     """Map a plain drain's per-queue result tensors back onto workloads
     — ONE definition shared by the device fetch and the numpy host
@@ -1903,6 +2142,7 @@ def _map_drain_result(
     admitted: List[Tuple[Workload, str, Dict[str, str], int]] = []
     parked: List[Tuple[Workload, str]] = []
     extra_fallback: List[Tuple[Workload, str]] = []
+    undecided: List[Tuple[Workload, str]] = []
     for (qi, pos), i in plan.head_of.items():
         wl = lowered.heads[i]
         cq_name = lowered.cq_names[i]
@@ -1913,8 +2153,13 @@ def _map_drain_result(
                  int(adm_cycle[qi, pos]))
             )
         elif pos >= int(cursor[qi]):
-            # never processed (max_cycles backstop hit): not a decision
+            # never processed (max_cycles backstop hit): not a decision.
+            # Entries of stuck-frozen queues are terminal no-decisions
+            # (a rerun cannot resolve them); the rest are undecided and
+            # a follow-up chunk from the post-apply state decides them.
             extra_fallback.append((wl, cq_name))
+            if not bool(stuck_q[qi]):
+                undecided.append((wl, cq_name))
         else:
             parked.append((wl, cq_name))
     admitted.sort(key=lambda t: t[3])
@@ -1925,5 +2170,5 @@ def _map_drain_result(
     )
     return DrainOutcome(
         admitted=admitted, parked=parked, fallback=fb, cycles=cycles,
-        truncated=truncated,
+        truncated=truncated, undecided=undecided, final_usage=final_usage,
     )

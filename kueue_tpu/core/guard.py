@@ -347,6 +347,20 @@ class GuardConfig:
 
 
 @dataclass
+class DeviceLaunch:
+    """One guarded ASYNC device dispatch (the pipelined drain's
+    prefetch window): the unfetched handle plus the deadline clock's
+    start. ``failed=True`` means the launch itself raised and was
+    contained — the matching join returns an empty GuardOutcome."""
+
+    handle: object = None
+    t0: float = 0.0
+    t0_wall: float = 0.0
+    label: str = ""
+    failed: bool = False
+
+
+@dataclass
 class GuardOutcome:
     """One guarded batch solve: the result (None = both paths failed —
     callers fall back to per-head host assignment), which path produced
@@ -501,6 +515,125 @@ class SolverGuard:
         self.device_solves += 1
         self._note_success()
         return GuardOutcome(result=out, via="device", device_dt=dt_wall)
+
+    # ---- the guarded ASYNC device call (pipelined drain prefetch) ----
+    def device_launch(self, fn: Callable[[], object], label: str):
+        """Async half of ``device_call``: run the dispatch (which
+        returns an unfetched handle — JAX async dispatch) under
+        exception containment and START the deadline clock. The
+        matching ``device_join`` applies the deadline to the WHOLE
+        launch→fetch window, so a prefetched solve lives under exactly
+        the wall-clock budget a synchronous one does."""
+        import time as _time
+
+        from kueue_tpu.testing import faults
+
+        t0 = self.clock.now()
+        t0_wall = _time.perf_counter()
+        if self.config.mode == "device":
+            # debugging mode: no containment, faults still fire
+            faults.fire("solver.device_raise")
+            return DeviceLaunch(
+                handle=fn(), t0=t0, t0_wall=t0_wall, label=label
+            )
+        try:
+            faults.fire("solver.device_raise")
+            handle = fn()
+        except faults.InjectedCrash:
+            raise
+        except Exception as exc:  # noqa: BLE001 — containment IS the point
+            self._note_failure(f"{label} raised: {exc!r}", "raise")
+            return DeviceLaunch(failed=True, label=label)
+        return DeviceLaunch(handle=handle, t0=t0, t0_wall=t0_wall, label=label)
+
+    def device_join(
+        self, launch: "DeviceLaunch", fetch_fn: Callable[[object], object]
+    ) -> GuardOutcome:
+        """Blocking half: fetch the launched result. Deadline breaches
+        and raises count against the breaker exactly like
+        ``device_call`` — the result of a late prefetch is discarded."""
+        import time as _time
+
+        from kueue_tpu.testing import faults
+
+        if launch.failed:
+            return GuardOutcome(result=None, via="device")
+        if self.config.mode == "device":
+            out = fetch_fn(launch.handle)
+            faults.fire("solver.device_hang")
+            return GuardOutcome(result=out, via="device", device_dt=None)
+        try:
+            out = fetch_fn(launch.handle)
+            faults.fire("solver.device_hang")
+        except faults.InjectedCrash:
+            raise
+        except Exception as exc:  # noqa: BLE001
+            self._note_failure(f"{launch.label} raised: {exc!r}", "raise")
+            return GuardOutcome(result=None, via="device")
+        dt_clock = self.clock.now() - launch.t0
+        dt_wall = _time.perf_counter() - launch.t0_wall
+        if dt_clock > self.config.device_deadline_s:
+            self._note_failure(
+                f"{launch.label} exceeded device deadline "
+                f"({dt_clock:.3f}s > {self.config.device_deadline_s}s)",
+                "deadline",
+            )
+            return GuardOutcome(result=None, via="device", device_dt=None)
+        self.device_solves += 1
+        self._note_success()
+        return GuardOutcome(result=out, via="device", device_dt=dt_wall)
+
+    # ---- sampled drain divergence (pipelined rounds) ----
+    def should_sample_drain(self, committed: int) -> bool:
+        """Every K-th COMMITTED prefetched drain round is differentially
+        verified against the numpy drain mirror (K =
+        divergence_check_every, 0 disables) — the PR-5 sampling
+        discipline extended to the prefetched launch surface."""
+        k = self.config.divergence_check_every
+        return bool(k) and committed > 0 and committed % k == 0
+
+    def check_drain_divergence(
+        self, device_sig: dict, host_solve: Callable[[], tuple], heads: int
+    ):
+        """Compare a committed prefetched drain round's decision
+        signature against the host mirror's (ops/drain_np via
+        run_drain(use_device=False) — bit-for-bit by construction).
+        Returns the HOST outcome when they diverge (the caller must
+        adopt it; the device path is quarantined), None on agreement."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self.divergence_checks += 1
+        if self.metrics is not None:
+            self.metrics.solver_divergence_checks_total.inc()
+        host_outcome, host_sig = host_solve()
+        self.divergence_check_s += _time.perf_counter() - t0
+        if host_sig == device_sig:
+            return None
+        bad = sorted(
+            k for k in device_sig if device_sig.get(k) != host_sig.get(k)
+        )
+        self.divergences += 1
+        self.breaker.quarantine(f"drain divergence in {bad}")
+        verdict = {
+            "fields": bad,
+            "surface": "drain-prefetch",
+            "deviceSolves": self.device_solves,
+            "heads": heads,
+            "authority": "host",
+        }
+        self.last_divergence = verdict
+        if self.metrics is not None:
+            self.metrics.solver_divergences_total.inc()
+        self.record_event(
+            "SolverDiverged",
+            f"prefetched drain solve diverged from the host mirror in "
+            f"{bad}; device path quarantined, host mirror is now the "
+            "decision authority",
+        )
+        self.journal_hook("solver_verdict", dict(verdict))
+        self._report_path()
+        return host_outcome
 
     # ---- the guarded cycle batch solve ----
     def solve(self, snapshot, lowered, dispatch: Callable[[], object]) -> GuardOutcome:

@@ -1,0 +1,154 @@
+"""Double-buffered admission drain — speculation mechanics + stats.
+
+The bulk drain used to be strictly serial per round: encode -> device
+solve -> fetch -> host apply (journal append, runtime mutation,
+audit/event emission), with the device idle during the apply and the
+host idle during the solve. The pipelined loop
+(controllers/cluster.ClusterRuntime._pipelined_bulk_drain) overlaps
+them: while the host applies round *t*, round *t+1*'s encode + device
+solve is already in flight against a SPECULATIVE snapshot — the
+kernel-reported final leaf usage of round *t* substituted into round
+*t*'s snapshot — over the exact backlog round *t* left undecided.
+
+Correctness never rests on the speculation. At commit time the
+speculative inputs are compared against the REAL post-apply state
+(``drain_inputs_match`` + ``pending_matches`` below); only on bitwise
+agreement is the prefetched result trusted, otherwise it is discarded
+(``kueue_pipeline_prefetch_discards_total``) and the round re-solves
+from the real snapshot. Drain rounds touch disjoint head prefixes, so
+the common case commits. Nothing about a prefetch is journaled or
+applied before its commit check passes, which keeps the PR-4/PR-5
+crash-consistency story intact — the fault points
+``cycle.prefetch_launched`` and ``cycle.commit_pre_apply``
+(testing/faults.py) mark the two new windows and the chaos suite in
+tests/test_pipeline.py proves a crash in either never ships a stale
+decision.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class PipelineStats:
+    """Observable pipeline accounting (the ``kueue_pipeline_*`` metric
+    source and the dashboard badge detail)."""
+
+    rounds: int = 0
+    prefetches: int = 0  # speculative launches dispatched
+    commits: int = 0  # prefetches whose conflict check passed
+    discards: int = 0  # prefetches invalidated by the apply
+    inflight: int = 0  # speculative launches currently in flight (0|1)
+    apply_s: float = 0.0  # total host apply wall time
+    overlapped_apply_s: float = 0.0  # apply time with a solve in flight
+    solve_s: float = 0.0  # total blocked-on-fetch + dispatch wall time
+
+    @property
+    def overlap_ratio(self) -> float:
+        """Fraction of host apply time that ran with a device solve in
+        flight — 1.0 means every apply was fully double-buffered."""
+        return (
+            self.overlapped_apply_s / self.apply_s if self.apply_s > 0 else 0.0
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "rounds": self.rounds,
+            "prefetches": self.prefetches,
+            "commits": self.commits,
+            "discards": self.discards,
+            "inflight": self.inflight,
+            "overlapRatio": round(self.overlap_ratio, 4),
+            "applyMs": round(self.apply_s * 1e3, 3),
+            "overlappedApplyMs": round(self.overlapped_apply_s * 1e3, 3),
+            "solveMs": round(self.solve_s * 1e3, 3),
+        }
+
+
+def speculative_snapshot(snapshot, final_usage: np.ndarray):
+    """Round t's snapshot with the kernel-reported final leaf usage
+    substituted — the predicted post-apply state round t+1 solves
+    against.
+
+    Shallow copy: quota arrays, hierarchy and models are shared (the
+    apply never mutates them — it mutates the CACHE, and the next real
+    snapshot is taken fresh); only ``local_usage`` is replaced and the
+    usage-derived caches dropped so nothing stale leaks through."""
+    spec = copy.copy(snapshot)
+    spec.local_usage = np.asarray(final_usage, dtype=np.int64).copy()
+    spec._usage_cache = None
+    spec._avail_cache = None
+    spec._drs_cache = None
+    spec._tree_usage = None
+    spec._usage_version = snapshot._usage_version + 1
+    return spec
+
+
+def drain_inputs_match(spec_snapshot, real_snapshot) -> bool:
+    """The commit-time conflict check over everything the plain drain
+    kernel reads: hierarchy identity, quota tensors and leaf usage.
+    Cheap — a handful of array equality scans — and SOUND: if it
+    passes, the speculative launch solved byte-identical inputs to the
+    launch a serial loop would have made from ``real_snapshot``."""
+    a, b = spec_snapshot, real_snapshot
+    if a.flat.cq_names != b.flat.cq_names:
+        return False
+    if a.fr_list != b.fr_list or a.inactive_cqs != b.inactive_cqs:
+        return False
+    return (
+        np.array_equal(a.flat.parent, b.flat.parent)
+        and np.array_equal(a.nominal, b.nominal)
+        and np.array_equal(a.lending_limit, b.lending_limit)
+        and np.array_equal(a.borrowing_limit, b.borrowing_limit)
+        and np.array_equal(a.local_usage, b.local_usage)
+    )
+
+
+def pending_matches(
+    speculated: Sequence[Tuple[object, str]],
+    actual: Sequence[Tuple[object, str]],
+) -> bool:
+    """Does the real post-apply backlog equal the one the prefetch was
+    planned over? Order matters WITHIN a ClusterQueue (heap order feeds
+    the queue tensors positionally) but not across CQs (plan_drain
+    re-buckets per CQ)."""
+    if len(speculated) != len(actual):
+        return False
+
+    def per_cq(items):
+        by: Dict[str, List[str]] = {}
+        for wl, cq in items:
+            by.setdefault(cq, []).append(wl.key)
+        return by
+
+    return per_cq(speculated) == per_cq(actual)
+
+
+def outcome_signature(outcome) -> dict:
+    """Decision fingerprint of a DrainOutcome for the sampled
+    prefetch-divergence check (guard): everything that feeds the apply,
+    nothing incidental."""
+    def _fmap(flavors):
+        # single-podset {res: flavor} or multi-podset {ps: {res: flavor}}
+        return tuple(
+            sorted(
+                (k, tuple(sorted(v.items())) if isinstance(v, dict) else v)
+                for k, v in flavors.items()
+            )
+        )
+
+    return {
+        "admitted": sorted(
+            (wl.key, cq, _fmap(flavors), cycle)
+            for wl, cq, flavors, cycle in outcome.admitted
+        ),
+        "parked": sorted((wl.key, cq) for wl, cq in outcome.parked),
+        "fallback": sorted((wl.key, cq) for wl, cq in outcome.fallback),
+        "cycles": outcome.cycles,
+        "truncated": outcome.truncated,
+    }

@@ -481,6 +481,7 @@ def batched_fair_get_targets(
     from kueue_tpu.ops.fair_preempt_kernel import (
         FairProblem,
         solve_fair_packed_jit,
+        split_panel_rows,
     )
 
     results: List[List[PreemptionTarget]] = [[] for _ in items]
@@ -491,37 +492,81 @@ def batched_fair_get_targets(
     if arrays is None:
         return results
 
-    w = arrays["row_valid"].shape[0]
-    w_pad = _bucket(w, minimum=8)
-    if mesh is not None:
-        from kueue_tpu.parallel.sharded_solver import pad_w_multiple
+    def solve_rows(rows_arrays, v_dim):
+        """One dispatch over a row subset at candidate-panel width
+        ``v_dim``; returns (targets_mask, fits) for those rows."""
+        w_sub = rows_arrays["row_valid"].shape[0]
+        w_pad = _bucket(w_sub, minimum=8)
+        if mesh is not None:
+            from kueue_tpu.parallel.sharded_solver import pad_w_multiple
 
-        w_pad = pad_w_multiple(w_pad, mesh.shape["wl"])
-    arrays = _pad_rows(arrays, w_pad)
-    problem = FairProblem(**{k: jnp.asarray(x) for k, x in arrays.items()})
-    if mesh is not None:
-        from kueue_tpu.parallel.sharded_solver import place_fair_problem
-
-        problem = place_fair_problem(mesh, problem)
-    flat = np.asarray(
-        solve_fair_packed_jit(
-            problem,
-            depth=meta["depth"],
-            n_cand=meta["v"],
-            n_local=meta["s"],
-            n_res=meta["r"],
-            strategy1=meta["strategy1"],
-            has_second=meta["has_second"],
+            w_pad = pad_w_multiple(w_pad, mesh.shape["wl"])
+        rows_arrays = _pad_rows(rows_arrays, w_pad)
+        problem = FairProblem(
+            **{k: jnp.asarray(x) for k, x in rows_arrays.items()}
         )
-    )  # one fetch
-    targets_mask = flat[: w_pad * meta["v"]].reshape(w_pad, meta["v"])
-    fits = flat[w_pad * meta["v"] :].astype(bool)
+        if mesh is not None:
+            from kueue_tpu.parallel.sharded_solver import place_fair_problem
+
+            problem = place_fair_problem(mesh, problem)
+        flat = np.asarray(
+            solve_fair_packed_jit(
+                problem,
+                depth=meta["depth"],
+                n_cand=v_dim,
+                n_local=meta["s"],
+                n_res=meta["r"],
+                strategy1=meta["strategy1"],
+                has_second=meta["has_second"],
+            )
+        )  # one fetch per tier
+        return (
+            flat[: w_pad * v_dim].reshape(w_pad, v_dim),
+            flat[w_pad * v_dim :].astype(bool),
+        )
+
+    # two-tier cost-ordered candidate panels (split_panel_rows): heads
+    # whose whole pool fits the bucketed-median panel solve at the
+    # narrow width (the while_loop trip count scales with V); only
+    # overflowing heads pay the full-width panel. Exact by membership —
+    # a head never sees a truncated view of its OWN pool. Sharded runs
+    # keep the single full-width dispatch (one collective).
+    counts = [len(m["cands"]) for m in meta["rows"]]
+    if mesh is None:
+        v_narrow, narrow_rows, wide_rows = split_panel_rows(
+            counts, meta["v"], _bucket
+        )
+    else:
+        v_narrow, narrow_rows, wide_rows = meta["v"], list(
+            range(len(counts))
+        ), []
+
+    targets_of = {}
+    fits_of = {}
+    for rows, v_dim in ((narrow_rows, v_narrow), (wide_rows, meta["v"])):
+        if not rows:
+            continue
+        sub = {
+            k: (
+                x[rows][:, :v_dim]
+                if k in ("crow", "cvalid")
+                else x[rows][:, :v_dim, :]
+                if k == "cqty"
+                else x[rows]
+            )
+            for k, x in arrays.items()
+        }
+        tmask, fits = solve_rows(sub, v_dim)
+        for out_i, a_i in enumerate(rows):
+            targets_of[a_i] = tmask[out_i]
+            fits_of[a_i] = bool(fits[out_i])
 
     for a_i, m in enumerate(meta["rows"]):
-        if not fits[a_i]:
+        if not fits_of.get(a_i, False):
             continue
         idx = m["idx"]
         cq_name = items[idx][1]
+        tmask = targets_of[a_i]
         results[idx] = [
             PreemptionTarget(
                 workload=ws,
@@ -532,7 +577,7 @@ def batched_fair_get_targets(
                 ),
             )
             for vi, ws in enumerate(m["cands"])
-            if targets_mask[a_i, vi]
+            if vi < len(tmask) and tmask[vi]
         ]
     return results
 

@@ -104,6 +104,17 @@ def dump(runtime) -> str:
                     for e in quarantine.items()
                 )
             )
+    # double-buffered drain loop posture (core/pipeline.py)
+    pipe = getattr(runtime, "pipeline", None)
+    if pipe is not None:
+        d = pipe.to_dict()
+        lines.append("-- drain pipeline (double-buffered loop) --")
+        lines.append(
+            f"mode={getattr(runtime, 'drain_pipeline', 'off')} "
+            f"rounds={d['rounds']} prefetches={d['prefetches']} "
+            f"commits={d['commits']} discards={d['discards']} "
+            f"inflight={d['inflight']} overlapRatio={d['overlapRatio']}"
+        )
     return "\n".join(lines)
 
 

@@ -237,6 +237,29 @@ class Metrics:
             f"{NS}_solver_quarantined_workloads",
             "Workloads currently sidelined by the poison-workload quarantine",
         )
+        # double-buffered drain loop (core/pipeline.py): overlap_ratio
+        # near 1 means every host apply ran with the next round's solve
+        # in flight; a rising discard counter means applies keep
+        # invalidating the speculation (pipeline off-rhythm — check
+        # what mutates state mid-drain); inflight is the live 0/1
+        # speculative-launch gauge.
+        self.pipeline_overlap_ratio = r.gauge(
+            f"{NS}_pipeline_overlap_ratio",
+            "Fraction of bulk-drain host apply time that ran with the next round's device solve in flight",
+        )
+        self.pipeline_prefetch_discards_total = r.counter(
+            f"{NS}_pipeline_prefetch_discards_total",
+            "Total speculative drain launches discarded because the apply invalidated their inputs",
+        )
+        self.pipeline_inflight = r.gauge(
+            f"{NS}_pipeline_inflight",
+            "Speculative drain launches currently in flight (0 or 1)",
+        )
+        # label-less series: materialize at zero so the scrape surface
+        # is complete before the first pipelined drain runs
+        self.pipeline_overlap_ratio.set(0.0)
+        self.pipeline_prefetch_discards_total.inc(0.0)
+        self.pipeline_inflight.set(0)
         # MultiKueue federation (kueue_tpu/federation): cross-cluster
         # dispatch accounting. clusters_active dropping below the
         # configured cluster count is the paging signal for a partition

@@ -1,0 +1,60 @@
+"""Device kernels and their host-authority mirrors.
+
+Every device kernel module in this package MUST keep a bit-for-bit
+host mirror and a wired parity test — the solver guard's failover and
+the pipelined drain's sampled divergence checks are only sound because
+of that discipline. ``KERNEL_MIRRORS`` is the machine-checked registry
+(tests/test_drain_parity.py::TestKernelMirrorRegistry lints it): every
+``ops/*_kernel.py`` (plus the quota recurrences) names its mirror — a
+numpy twin or the sequential host scheduler surface — and the test
+module asserting parity. Adding a kernel without registering a mirror,
+or pointing at a mirror/test that does not exist, fails CI.
+"""
+
+from __future__ import annotations
+
+# kernel module (this package) -> (mirror dotted path "module:attr",
+# parity test module under tests/). The mirror attr must resolve at
+# import time; the test file must exist and reference the kernel.
+KERNEL_MIRRORS = {
+    "assign_kernel": (
+        # cycle batch nomination: numpy twin routed through the shared
+        # snapshot codec (the guard's failover authority)
+        "kueue_tpu.core.guard:solve_lowered_host",
+        "tests/test_solver_path.py",
+    ),
+    "drain_kernel": (
+        # plain bulk drain: identical int64 recurrences over identical
+        # DrainPlan tensors (run_drain(use_device=False)); the preempt/
+        # fair/TAS drains' host twin is the sequential scheduler,
+        # asserted in tests/test_drain.py
+        "kueue_tpu.ops.drain_np:solve_drain_np",
+        "tests/test_drain_parity.py",
+    ),
+    "preempt_kernel": (
+        # classic victim search: the host Preemptor ladder
+        "kueue_tpu.core.preemption:Preemptor",
+        "tests/test_preempt_batch.py",
+    ),
+    "fair_preempt_kernel": (
+        # fair tournament: the host Preemptor's fair strategies
+        "kueue_tpu.core.preemption:Preemptor",
+        "tests/test_fair_preempt.py",
+    ),
+    "plan_kernel": (
+        # what-if planner sweep: the numpy scenario solver
+        "kueue_tpu.planner.engine:solve_scenario_host",
+        "tests/test_planner.py",
+    ),
+    "tas_kernel": (
+        # TAS placement: the host snapshot's exact placement replay
+        # (run_drain_tas asserts leaf-usage reproduction in-line)
+        "kueue_tpu.tas.snapshot:TASFlavorSnapshot",
+        "tests/test_tas_drain.py",
+    ),
+    "quota": (
+        # quota tree recurrences: the numpy twins
+        "kueue_tpu.ops.quota_np:usage_tree_np",
+        "tests/test_quota_ops.py",
+    ),
+}

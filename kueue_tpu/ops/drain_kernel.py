@@ -1425,13 +1425,16 @@ def _solve_drain_fair_packed(
         tree, local_usage, queues, paths, depth_of, weight, lendable,
         res_of_fr, n_segments, n_steps, max_cycles, n_res, prio_tie,
     )
+    # same layout as _solve_drain_packed (final leaf usage included)
+    # so run_drain unpacks both scopes with one decoder
     return jnp.concatenate(
         [
-            r.admitted_k.reshape(-1),
-            r.admitted_cycle.reshape(-1),
-            r.cursor,
-            r.stuck.astype(jnp.int32),
-            r.cycles[None],
+            r.admitted_k.reshape(-1).astype(jnp.int64),
+            r.admitted_cycle.reshape(-1).astype(jnp.int64),
+            r.cursor.astype(jnp.int64),
+            r.stuck.astype(jnp.int64),
+            r.local_usage.reshape(-1),
+            r.cycles[None].astype(jnp.int64),
         ]
     )
 
@@ -1531,7 +1534,17 @@ class PreemptDrainResult(NamedTuple):
     evicted_cycle: int32[S,V]; evicted_by: int32[S,V] queue index of the
     evicting head (-1 where not evicted) — each victim is removed by
     exactly one head (the overlap guard plus the live mask forbid a
-    second eviction), so the attribution is exact; cycles; local_usage."""
+    second eviction), so the attribution is exact; cycles; local_usage.
+
+    overflowed: bool scalar — some head's eligible-candidate list
+    overflowed the ``search_width`` panel AND its search missed
+    (inconclusive truncation) at least once. While False the panel
+    truncation was EXACT everywhere (every search either succeeded
+    inside the window — minimalPreemptions stops at the first fitting
+    prefix — or failed with the full eligible list in-window), so the
+    whole drain's decisions are identical to any wider panel's. The
+    host uses it as the escalation trigger of the two-tier panel
+    ladder (core/drain.run_drain_preempt panel_widths)."""
 
     status: jnp.ndarray
     admitted_k: jnp.ndarray
@@ -1542,6 +1555,7 @@ class PreemptDrainResult(NamedTuple):
     stuck: jnp.ndarray  # bool[Q] — frozen PendingFlavors spinners
     cycles: jnp.ndarray
     local_usage: jnp.ndarray
+    overflowed: jnp.ndarray
 
 
 def _compact_candidates(cand_ord: jnp.ndarray, width: int):
@@ -1741,7 +1755,7 @@ def solve_drain_preempt(
     def cycle_body(state):
         (local, status, g_start, retries, stuck, no_prog, adm_k,
          adm_cycle, pcells, pqty, pvalid, vevicted, evict_cycle,
-         evict_by, cycle) = state
+         evict_by, ovf, cycle) = state
 
         # head of each queue = first pending entry in heap order
         entry_pending = status == 0  # [Q,L]
@@ -1886,6 +1900,10 @@ def solve_drain_preempt(
         p1_bad = over1 & ~found1
         p2_bad = run2 & over2 & ~found2
         untrusted = enabled1 & (p1_bad | (~found1 & p2_bad))
+        # inconclusive truncation anywhere taints the WHOLE drain for
+        # the panel ladder: the host discards this result and re-solves
+        # at the next wider width instead of shipping the freeze
+        ovf = ovf | jnp.any(untrusted)
         psuccess = is_pre & ~untrusted & (found1 | found2)
 
         def to_slots(rm, comp, on):
@@ -2195,13 +2213,13 @@ def solve_drain_preempt(
         return (
             local, status, g_start, retries, stuck, no_prog, adm_k,
             adm_cycle, pcells, pqty, pvalid, vevicted, evict_cycle,
-            evict_by, cycle + 1,
+            evict_by, ovf, cycle + 1,
         )
 
     def cond(state):
         status = state[1]
         stuck = state[4]
-        cycle = state[14]
+        cycle = state[15]
         has_pending = jnp.any(
             (status == 0)
             & (l_idx[None, :] < queues.qlen[:, None])
@@ -2225,10 +2243,11 @@ def solve_drain_preempt(
         jnp.zeros((s_dim, v), dtype=bool),
         jnp.full((s_dim, v), -1, dtype=jnp.int32),
         jnp.full((s_dim, v), -1, dtype=jnp.int32),
+        jnp.zeros((), dtype=bool),
         jnp.int32(0),
     )
     (local_f, status_f, _, _, stuck_f, _, adm_k, adm_cycle, _, _, _,
-     vevicted, evict_cycle, evict_by, cycles) = lax.while_loop(
+     vevicted, evict_cycle, evict_by, ovf_f, cycles) = lax.while_loop(
         cond, cycle_body, init
     )
     return PreemptDrainResult(
@@ -2241,6 +2260,7 @@ def solve_drain_preempt(
         cycles=cycles,
         local_usage=local_f,
         stuck=stuck_f,
+        overflowed=ovf_f,
     )
 
 
@@ -2801,6 +2821,9 @@ def solve_drain_fair_preempt(
         cycles=cycles,
         local_usage=local_f,
         stuck=stuck_f,
+        # the fair tournament searches the whole pool (panels carry the
+        # full active-cell universe) — no truncation to escalate from
+        overflowed=jnp.zeros((), dtype=bool),
     )
 
 
@@ -2823,6 +2846,7 @@ def _solve_drain_fair_preempt_packed(
             r.evicted_cycle.reshape(-1),
             r.evicted_by.reshape(-1),
             r.stuck.astype(jnp.int32),
+            r.overflowed.astype(jnp.int32)[None],
             r.cycles[None],
         ]
     )
@@ -2854,6 +2878,7 @@ def _solve_drain_preempt_packed(
             r.evicted_cycle.reshape(-1),
             r.evicted_by.reshape(-1),
             r.stuck.astype(jnp.int32),
+            r.overflowed.astype(jnp.int32)[None],
             r.cycles[None],
         ]
     )
@@ -2868,18 +2893,23 @@ solve_drain_preempt_packed_jit = jax.jit(
 def _solve_drain_packed(
     tree, local_usage, queues, paths, n_segments: int, n_steps: int, max_cycles: int
 ):
-    """solve_drain with the decision tensors flattened into one int32
-    vector so the host retrieves the whole drain in a single fetch."""
+    """solve_drain with the decision tensors flattened into one vector
+    so the host retrieves the whole drain in a single fetch. The final
+    leaf usage rides along (promoting the vector to int64): the
+    pipelined drain loop launches round t+1's solve against it as the
+    speculative post-apply snapshot while the host still applies round
+    t (core/pipeline.py)."""
     r = solve_drain(
         tree, local_usage, queues, paths, n_segments, n_steps, max_cycles
     )
     return jnp.concatenate(
         [
-            r.admitted_k.reshape(-1),
-            r.admitted_cycle.reshape(-1),
-            r.cursor,
-            r.stuck.astype(jnp.int32),
-            r.cycles[None],
+            r.admitted_k.reshape(-1).astype(jnp.int64),
+            r.admitted_cycle.reshape(-1).astype(jnp.int64),
+            r.cursor.astype(jnp.int64),
+            r.stuck.astype(jnp.int64),
+            r.local_usage.reshape(-1),
+            r.cycles[None].astype(jnp.int64),
         ]
     )
 

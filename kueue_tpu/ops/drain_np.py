@@ -4,8 +4,16 @@ The quota_np story extended to the multi-cycle drain: identical int64
 recurrences over identical arrays, so ``core/drain.run_drain(...,
 use_device=False)`` is the bit-for-bit HOST AUTHORITY twin of the
 device drain — the differential-testing surface for the solver guard's
-failover path and the seeded 50-snapshot parity property test
-(tests/test_drain_parity.py).
+failover path, the seeded 50-snapshot parity property test
+(tests/test_drain_parity.py), AND the pipelined drain loop's sampled
+prefetch-divergence check (every K-th committed speculative round is
+re-solved here and compared decision-for-decision,
+core/guard.check_drain_divergence). The mirror follows the pipeline's
+chunked shapes for free: ``max_cycles`` is an input, the cursor routes
+unreached entries to the undecided set exactly like the kernel, and
+``local_usage`` in the result is the same final-usage surface the
+kernel's packed vector now carries (the speculation input). Registered
+in ops/__init__.KERNEL_MIRRORS (the kernel<->mirror parity lint).
 
 Scope matches the plain kernel exactly: multi-podset nomination with
 policy-aware group walks and cursor resume, the (borrowing, priority,

@@ -35,7 +35,7 @@ Exactness notes (parity asserted in tests/test_fair_preempt.py):
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import List, NamedTuple, Sequence, Tuple
 
 from kueue_tpu._jax import jax, jnp, lax
 from kueue_tpu.ops.quota import DRS_MAX, NO_LIMIT
@@ -43,6 +43,38 @@ from kueue_tpu.ops.quota import DRS_MAX, NO_LIMIT
 # strategy ids (config fairSharing.preemptionStrategies)
 LESS_THAN_OR_EQUAL_TO_FINAL = 0
 LESS_THAN_INITIAL = 1
+
+
+def split_panel_rows(
+    counts: Sequence[int], v_full: int, bucket
+) -> Tuple[int, List[int], List[int]]:
+    """Two-tier candidate-panel schedule for the batched tournament.
+
+    The while_loop trip count scales with the candidate-panel width V
+    (``max_iters = 2V + S + 4``) and V is padded to the LARGEST head's
+    pool, so one deep pool taxes every head in the batch. Candidates
+    are already in preemption-cost order (the host candidate sort), so
+    the fix is shape, not semantics: heads whose whole pool fits a
+    narrow panel (the bucketed median pool size) solve in a narrow
+    dispatch; only the overflowing heads re-solve at the full width.
+    Because a head's search is an independent subproblem over its OWN
+    candidates, truncating the shared V axis is EXACT for any head
+    whose pool fits the panel — the escape hatch is membership, not a
+    post-hoc check.
+
+    Returns ``(v_narrow, narrow_rows, wide_rows)``; ``wide_rows`` is
+    empty when every pool fits the narrow panel."""
+    counts = list(counts)
+    if not counts:
+        return v_full, [], []
+    ordered = sorted(counts)
+    median = ordered[(len(ordered) - 1) // 2]
+    v_narrow = min(bucket(max(median, 1), minimum=2), v_full)
+    if v_narrow >= v_full:
+        return v_full, list(range(len(counts))), []
+    narrow = [i for i, c in enumerate(counts) if c <= v_narrow]
+    wide = [i for i, c in enumerate(counts) if c > v_narrow]
+    return v_narrow, narrow, wide
 
 
 class FairProblem(NamedTuple):

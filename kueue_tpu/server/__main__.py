@@ -200,6 +200,29 @@ def main(argv=None) -> int:
         "device faults propagate)",
     )
     parser.add_argument(
+        "--pipeline", choices=["on", "serial", "off"], default="on",
+        help="double-buffered bulk-drain loop (core/pipeline.py): on = "
+        "chunked drain rounds with the next round's encode+solve "
+        "prefetched on a speculative snapshot while the host applies "
+        "the current one (the default; overlap observable via "
+        "kueue_pipeline_* metrics and /debug/cycles spans), serial = "
+        "the same chunked rounds without prefetch (A/B baseline), "
+        "off = the pre-pipeline single-dispatch drain",
+    )
+    parser.add_argument(
+        "--pipeline-chunk-cycles", type=int, default=16,
+        help="kernel cycles per pipelined drain round: smaller chunks "
+        "overlap sooner but pay more dispatch round trips",
+    )
+    parser.add_argument(
+        "--panel-widths", default=None, metavar="W1,W2",
+        help="fixed victim-search panel-width schedule for the "
+        "contended drain (e.g. '16,64': narrow cost-ordered panel "
+        "first, escalate to the exact wide panel only on an "
+        "inconclusive truncated search). Default: the online "
+        "per-workload-mix PanelTuner picks the narrow width",
+    )
+    parser.add_argument(
         "--no-auto-reconcile", action="store_true",
         help="only reconcile on POST /reconcile",
     )
@@ -290,6 +313,13 @@ def main(argv=None) -> int:
 
     use_solver = False if args.no_solver else None
 
+    if args.panel_widths:
+        from kueue_tpu.core import drain as _drain_mod
+
+        _drain_mod.set_default_panel_widths(
+            tuple(int(w) for w in args.panel_widths.split(","))
+        )
+
     def build_runtime():
         """Construct a runtime exactly the way startup does — also used
         to REBUILD on promotion, so a promoted standby starts from the
@@ -308,12 +338,16 @@ def main(argv=None) -> int:
                 rt.scheduler.use_solver = use_solver
             if args.solver_path != "auto":
                 rt.guard.config.mode = args.solver_path
+            rt.drain_pipeline = args.pipeline
+            rt.pipeline_chunk_cycles = max(1, args.pipeline_chunk_cycles)
             return rt
         from kueue_tpu.controllers import ClusterRuntime
 
         return ClusterRuntime(
             use_solver=use_solver, tas_cache=TASCache(),
             solver_path=args.solver_path,
+            drain_pipeline=args.pipeline,
+            pipeline_chunk_cycles=args.pipeline_chunk_cycles,
         )
 
     journal_opts = {

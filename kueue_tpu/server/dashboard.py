@@ -153,8 +153,14 @@ def dashboard_payload(rt) -> dict:
         if quarantine is not None
         else []
     )
+    # pipeline badge (core/pipeline.py): drain double-buffering mode +
+    # live overlap/discard accounting, next to the solver badge
+    pipe_stats = getattr(rt, "pipeline", None)
+    pipeline = pipe_stats.to_dict() if pipe_stats is not None else {}
+    pipeline["mode"] = getattr(rt, "drain_pipeline", "off")
     return {
         "solver": solver,
+        "pipeline": pipeline,
         "clusterQueues": cqs,
         "localQueues": lqs,
         "workloads": workloads,
@@ -228,7 +234,8 @@ DASHBOARD_HTML = """<!doctype html>
 <body>
 <h1>kueue-tpu</h1>
 <div class="muted">control-plane dashboard &middot; <span id="mode" class="poll">connecting&hellip;</span>
- &middot; solver <span id="solver" class="badge">&hellip;</span></div>
+ &middot; solver <span id="solver" class="badge">&hellip;</span>
+ &middot; pipeline <span id="pipeline" class="badge">&hellip;</span></div>
 <div class="tiles" id="tiles"></div>
 <h2>Last cycle</h2><div id="cycle"></div>
 <h2>ClusterQueues</h2><div id="cqs"></div>
@@ -270,6 +277,16 @@ function render(d){
     svEl.title = `mode=${sv.mode} failovers=${sv.failovers} `+
       `divergences=${sv.divergences}/${sv.divergenceChecks} checks `+
       `containedCycles=${sv.containedCycles}`;
+  }
+  const pl = d.pipeline||{};
+  const plEl = document.getElementById('pipeline');
+  if (pl.mode){
+    plEl.className = 'badge '+(pl.mode==='on' ? 'device' : 'host');
+    plEl.textContent = pl.mode + (pl.rounds ?
+      ` · ${Math.round((pl.overlapRatio||0)*100)}% overlap` : '');
+    plEl.title = `rounds=${pl.rounds||0} prefetches=${pl.prefetches||0} `+
+      `commits=${pl.commits||0} discards=${pl.discards||0} `+
+      `inflight=${pl.inflight||0}`;
   }
   const st = d.workloadStates||{};
   document.getElementById('tiles').innerHTML =

@@ -10,6 +10,19 @@ landing after the new leader's), then run
 ``verify_chain()`` is the offline fsck half (``kueuectl state
 verify``): segment-by-segment CRC/sequence/token validation with no
 mutation of the files — safe to run against a live volume.
+
+Pipelined-drain contract (PR 7, core/pipeline.py): the double-buffered
+drain loop journals NOTHING about a speculative round before its
+commit check passes — prefetched solves live only in device memory and
+the in-process launch handle. Recovery therefore needs no new record
+types for the pipeline: a crash at ``cycle.prefetch_launched`` (round
+t's apply not yet journaled) or ``cycle.commit_pre_apply`` (rounds
+<= t durable, round t+1 unshipped) replays to exactly the state the
+SERIAL loop would recover to, and the rerun re-decides the rest —
+property-tested per fault point x occurrence in tests/test_pipeline.py.
+A ``solver_verdict`` record with ``surface: "drain-prefetch"`` is the
+durable trace of a sampled prefetch divergence (guard quarantine), and
+replay re-quarantines from it like any other verdict.
 """
 
 from __future__ import annotations

@@ -52,6 +52,21 @@ FAULT_POINTS: Dict[str, str] = {
         "scheduler nomination / drain solve complete, outcome not yet "
         "applied (core/scheduler.schedule, controllers.bulk_drain)"
     ),
+    "cycle.prefetch_launched": (
+        "pipelined drain: round t+1's speculative encode + device solve "
+        "just dispatched, round t's outcome NOT yet applied or "
+        "journaled (controllers._pipelined_bulk_drain) — a crash here "
+        "must recover exactly like a crash before the serial apply; "
+        "the in-flight speculative result is lost, never shipped"
+    ),
+    "cycle.commit_pre_apply": (
+        "pipelined drain: the conflict check just proved the "
+        "speculative inputs equal the real post-apply state, the "
+        "prefetched decisions are NOT yet fetched/applied/journaled — "
+        "a crash here leaves rounds <= t durable and round t+1 "
+        "undecided; recovery + rerun must converge to the serial "
+        "loop's admitted set"
+    ),
     "solver.device_raise": (
         "immediately before a device solver dispatch (cycle batch or "
         "bulk drain) — arm to make the launch raise; the guard must "

@@ -104,3 +104,166 @@ class TestDrainParityWideSweep:
         rng = np.random.default_rng(10_000 + seed)
         depth = int(rng.integers(4, 12))
         _assert_parity(random_spec(seed, workloads_per_cq=depth), seed)
+
+
+class TestPanelLadderExactness:
+    """The two-tier victim-search panel (run_drain_preempt
+    ``panel_widths``): decisions bit-for-bit identical to the fixed
+    wide panel under EVERY narrow schedule — a clean narrow solve is
+    provably exact, and an inconclusive truncated search escalates to
+    the wide width instead of shipping the freeze."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    @pytest.mark.parametrize("widths", [(1, 32), (2, 32), (8, 32)])
+    def test_narrow_schedule_matches_wide(self, seed, widths):
+        from tests.test_drain import device_preempt_drain_trace, preempt_spec
+
+        spec = preempt_spec(seed)
+        wide = device_preempt_drain_trace(
+            spec, search_width=32, panel_widths=(32,)
+        )
+        narrow = device_preempt_drain_trace(
+            spec, search_width=32, panel_widths=widths
+        )
+        assert wide[:3] == narrow[:3], (
+            f"seed {seed} widths {widths}: decisions diverged"
+        )
+        assert {w.name for w, _ in wide[3].fallback} == {
+            w.name for w, _ in narrow[3].fallback
+        }
+        assert [c for *_, c in wide[3].admitted] == [
+            c for *_, c in narrow[3].admitted
+        ], "admission cycle indices diverged"
+
+    def test_escalation_fires_and_stays_exact(self):
+        """A width-1 panel on a head that needs several victims MUST
+        trip the kernel's inconclusive-truncation flag; the tuner
+        observes the escalation and the decisions equal the wide run."""
+        from kueue_tpu.core.drain import PanelTuner
+        from kueue_tpu.models.cluster_queue import Preemption
+        from kueue_tpu.models.constants import PreemptionPolicy
+
+        from tests.test_drain import device_preempt_drain_trace
+
+        spec = {
+            "flavors": ["f"],
+            "cqs": [
+                {
+                    "name": "cq",
+                    "cohort": None,
+                    "groups": [
+                        {
+                            "resources": ["cpu"],
+                            "flavors": [("f", {"cpu": "10"}, None, None)],
+                        }
+                    ],
+                    "preemption": Preemption(
+                        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+                    ),
+                }
+            ],
+            "workloads": [
+                {
+                    "name": "attacker", "queue": "lq-cq", "prio": 100,
+                    "t": 50.0,
+                    "pod_sets": [
+                        {"name": "main", "count": 1, "requests": {"cpu": "8"}}
+                    ],
+                }
+            ],
+            # four 2-cpu victims: the search must remove several, so a
+            # width-1 window overflows and misses (inconclusive)
+            "victims": [
+                ("v0", "cq", "f", "2", 0, 1.0),
+                ("v1", "cq", "f", "2", 0, 2.0),
+                ("v2", "cq", "f", "2", 10, 3.0),
+                ("v3", "cq", "f", "2", 10, 4.0),
+            ],
+        }
+        tuner = PanelTuner()
+        tuner._narrow[32] = 1  # force the overflowing narrow tier
+        narrow = device_preempt_drain_trace(
+            spec, search_width=32, panel_tuner=tuner
+        )
+        wide = device_preempt_drain_trace(
+            spec, search_width=32, panel_widths=(32,)
+        )
+        assert tuner.escalations == 1, "escape hatch never fired"
+        assert tuner._narrow[32] > 1, "tuner did not widen after escalation"
+        assert narrow[:3] == wide[:3]
+        assert narrow[1], "no eviction happened — vacuous scenario"
+
+    def test_tuner_walks_the_ladder(self):
+        from kueue_tpu.core.drain import PanelTuner
+
+        t = PanelTuner(shrink_after=2)
+        assert t.widths_for(64) == (16, 64)
+        assert t.widths_for(8) == (8,)  # narrow == final collapses
+        t.observe(64, escalated=True)
+        assert t.widths_for(64) == (32, 64)
+        t.observe(64, escalated=False)
+        t.observe(64, escalated=False)  # shrink_after clean solves
+        assert t.widths_for(64) == (16, 64)
+        t2 = PanelTuner()
+        t2._narrow[64] = 64
+        assert t2.widths_for(64) == (64,)
+
+
+class TestKernelMirrorRegistry:
+    """The kernel<->host-mirror parity lint (ops/__init__.py
+    KERNEL_MIRRORS): every device kernel module must register a mirror
+    that resolves and a parity test file that exists — so a new kernel
+    (or a reworked panel shape) cannot silently drop mirror coverage."""
+
+    def _kernel_modules(self):
+        from pathlib import Path
+
+        import kueue_tpu.ops as ops_pkg
+
+        root = Path(ops_pkg.__file__).parent
+        names = {p.stem for p in root.glob("*_kernel.py")}
+        names.add("quota")  # the tree recurrences are device code too
+        return names
+
+    def test_every_kernel_has_a_registered_mirror(self):
+        from kueue_tpu.ops import KERNEL_MIRRORS
+
+        missing = self._kernel_modules() - set(KERNEL_MIRRORS)
+        assert not missing, (
+            f"device kernels without a registered host mirror: {missing} "
+            "— add a numpy/host twin and a parity test, then register "
+            "them in ops/__init__.KERNEL_MIRRORS"
+        )
+        stale = set(KERNEL_MIRRORS) - self._kernel_modules()
+        assert not stale, f"registry entries with no kernel file: {stale}"
+
+    def test_mirrors_resolve_and_tests_exist(self):
+        import importlib
+        from pathlib import Path
+
+        from kueue_tpu.ops import KERNEL_MIRRORS
+
+        repo = Path(__file__).resolve().parent.parent
+        for kernel, (mirror, test_path) in KERNEL_MIRRORS.items():
+            mod_name, attr = mirror.split(":")
+            mod = importlib.import_module(mod_name)
+            assert hasattr(mod, attr), (
+                f"{kernel}: mirror {mirror} does not resolve"
+            )
+            tf = repo / test_path
+            assert tf.is_file() and tf.stat().st_size > 0, (
+                f"{kernel}: parity test {test_path} missing"
+            )
+
+    def test_drain_mirror_is_wired_to_the_kernel_shapes(self):
+        """The registered drain mirror must accept the live DrainPlan
+        shapes end-to-end — the property the whole registry exists to
+        protect (a shape rework that breaks the mirror fails HERE even
+        if no parity seed happens to cover the new field)."""
+        spec = random_spec(0, workloads_per_cq=6)
+        (_, _, _, dev), (_, _, _, host) = _both_traces(spec)
+        assert host.final_usage is not None
+        assert dev.final_usage is not None
+        # the two paths agree on the speculation surface too: the
+        # final leaf usage the pipelined loop launches round t+1 from
+        assert np.array_equal(dev.final_usage, host.final_usage)

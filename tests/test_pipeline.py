@@ -1,0 +1,377 @@
+"""The double-buffered (pipelined) drain loop — core/pipeline.py +
+ClusterRuntime._pipelined_bulk_drain.
+
+The load-bearing property: the pipelined loop produces the BIT-FOR-BIT
+same admitted set, journal record sequence and audit records as the
+serial loop on the same inputs — the speculation is a pure latency
+optimization, never a semantic one. The chaos suite extends the
+tests/test_guard.py pattern to the two new fault points
+(``cycle.prefetch_launched``, ``cycle.commit_pre_apply``): a crash in
+either window, followed by journal recovery and a rerun, converges to
+the serial loop's admitted set — a prefetched decision is never
+shipped stale.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.core.guard import SolverGuard
+from kueue_tpu.core.scheduler import _LatencyEstimate
+from kueue_tpu.models import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import ResourceGroup
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.storage import Journal, recover
+from kueue_tpu.testing import faults
+from kueue_tpu.utils.clock import FakeClock
+
+N_CQ = 6
+N_WL = 90
+THRESHOLD = 16
+CHUNK = 2  # tiny chunks -> many rounds -> many prefetch windows
+
+
+class _OpenGate(_LatencyEstimate):
+    """Latency gate pinned open: these tests exercise the drain path
+    itself, not the host-vs-drain routing heuristic."""
+
+    @property
+    def value(self):
+        return None
+
+
+def _bare_rt(mode="on", chunk=CHUNK):
+    rt = ClusterRuntime(
+        clock=FakeClock(0.0),
+        bulk_drain_threshold=THRESHOLD,
+        drain_pipeline=mode,
+        pipeline_chunk_cycles=chunk,
+        drain_gate=_OpenGate(),
+    )
+    rt.guard.config.divergence_check_every = 0
+    return rt
+
+
+def build_rt(seed, mode, journal_dir=None, chunk=CHUNK):
+    """A seeded plain-scope environment deep enough that the chunked
+    loop runs many rounds (per-CQ depth 15, chunk 2)."""
+    rt = _bare_rt(mode, chunk)
+    journal = None
+    if journal_dir is not None:
+        journal = Journal(str(journal_dir)).open()
+        rt.attach_journal(journal)
+    rng = np.random.default_rng(seed)
+    rt.add_flavor(ResourceFlavor(name="default"))
+    for i in range(N_CQ):
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name=f"cq-{i}",
+                cohort=f"c-{i % 2}",
+                namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",),
+                        (
+                            FlavorQuotas.build(
+                                "default",
+                                {
+                                    "cpu": (
+                                        str(int(rng.integers(10, 30))),
+                                        "8",
+                                        None,
+                                    )
+                                },
+                            ),
+                        ),
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(
+            LocalQueue(namespace="ns", name=f"lq-{i}", cluster_queue=f"cq-{i}")
+        )
+    for j in range(N_WL):
+        rt.add_workload(
+            Workload(
+                namespace="ns",
+                name=f"w{j}",
+                queue_name=f"lq-{j % N_CQ}",
+                priority=int(rng.integers(0, 4)) * 10,
+                creation_time=float(j),
+                pod_sets=(
+                    PodSet.build(
+                        "main", 1, {"cpu": str(int(rng.integers(1, 6)))}
+                    ),
+                ),
+            )
+        )
+    return rt, journal
+
+
+def admitted(rt):
+    return frozenset(
+        k for k, wl in rt.workloads.items() if wl.has_quota_reservation
+    )
+
+
+def parked(rt):
+    return frozenset(
+        key
+        for pq in rt.queues.cluster_queues.values()
+        for key in pq.inadmissible
+    )
+
+
+def journal_sequence(journal_dir):
+    j = Journal(str(journal_dir)).open()
+    try:
+        return [
+            (r.type, json.dumps(r.data, sort_keys=True))
+            for r in j.records()
+        ]
+    finally:
+        j.close()
+
+
+def audit_dump(rt):
+    return {
+        key: [r.to_dict() for r in rt.audit.for_workload(key)]
+        for key in rt.audit.keys()
+    }
+
+
+class TestPipelinedEqualsSerial:
+    """The bit-for-bit property over seeded traces: decisions, journal
+    record sequence and audit trail identical with prefetch on/off."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_decisions_journal_audit_identical(self, tmp_path, seed):
+        rt_s, j_s = build_rt(seed, "serial", tmp_path / "s")
+        rt_p, j_p = build_rt(seed, "on", tmp_path / "p")
+        rt_s.run_until_idle(max_iterations=60)
+        rt_p.run_until_idle(max_iterations=60)
+        assert admitted(rt_s) == admitted(rt_p)
+        assert parked(rt_s) == parked(rt_p)
+        assert admitted(rt_p), "vacuous trace: nothing admitted"
+        # the pipeline actually engaged and every prefetch resolved
+        assert rt_p.pipeline.rounds > 1
+        assert rt_p.pipeline.prefetches >= 1
+        assert (
+            rt_p.pipeline.commits + rt_p.pipeline.discards
+            == rt_p.pipeline.prefetches
+        )
+        assert rt_s.pipeline.prefetches == 0  # serial mode never speculates
+        assert not rt_s.check_invariants() and not rt_p.check_invariants()
+        j_s.close()
+        j_p.close()
+        assert journal_sequence(tmp_path / "s") == journal_sequence(
+            tmp_path / "p"
+        )
+        assert audit_dump(rt_s) == audit_dump(rt_p)
+
+    def test_one_shot_mode_matches_decisions(self, tmp_path):
+        # drain_pipeline="off" (the pre-pipeline single dispatch) must
+        # agree on the admitted set too — chunking is decision-neutral
+        rt_p, _ = build_rt(7, "on")
+        rt_o, _ = build_rt(7, "off")
+        rt_p.run_until_idle(max_iterations=60)
+        rt_o.run_until_idle(max_iterations=60)
+        assert admitted(rt_p) == admitted(rt_o)
+        assert parked(rt_p) == parked(rt_o)
+        assert rt_o.pipeline.rounds == 0  # one-shot path bypasses it
+
+    def test_overlap_accounting(self):
+        rt, _ = build_rt(3, "on")
+        rt.run_until_idle(max_iterations=60)
+        s = rt.pipeline
+        assert s.commits >= 1
+        assert 0.0 < s.overlap_ratio <= 1.0
+        assert s.inflight == 0  # nothing left in flight at quiescence
+        d = s.to_dict()
+        assert d["rounds"] == s.rounds and "overlapRatio" in d
+
+    def test_prefetch_spans_on_cycle_traces(self):
+        rt, _ = build_rt(3, "on")
+        rt.run_until_idle(max_iterations=60)
+        drains = [
+            t for t in rt.scheduler.last_traces if t.resolution == "drain"
+        ]
+        assert drains
+        for t in drains:
+            assert "solve" in t.spans and "apply" in t.spans
+            assert "prefetch" in t.spans and "commit" in t.spans
+        # pipeline metrics mirrored
+        reg = rt.metrics.registry
+        text = reg.expose() if hasattr(reg, "expose") else ""
+        if text:
+            assert "kueue_pipeline_overlap_ratio" in text
+            assert "kueue_pipeline_prefetch_discards_total" in text
+            assert "kueue_pipeline_inflight" in text
+
+
+class TestConflictDiscard:
+    def test_invalidated_speculation_is_discarded_not_shipped(self):
+        """Mutating queue state during the apply (a workload deleted
+        under the drain's feet) must invalidate the speculative launch:
+        the prefetch is discarded, the round re-solves from the real
+        snapshot, and the final decisions match the serial loop run
+        against the same interference."""
+
+        def run(mode):
+            rt, _ = build_rt(5, mode)
+            orig = rt._apply_drain_outcome
+            state = {"fired": False}
+
+            def interfering_apply(outcome, snapshot):
+                res = orig(outcome, snapshot)
+                if not state["fired"] and outcome.undecided:
+                    # delete one still-undecided workload mid-loop: the
+                    # real post-apply backlog no longer matches the
+                    # speculated one
+                    state["fired"] = True
+                    wl, _cq = outcome.undecided[0]
+                    rt.delete_workload(wl)
+                return res
+
+            rt._apply_drain_outcome = interfering_apply
+            rt.run_until_idle(max_iterations=60)
+            assert state["fired"], "interference never triggered"
+            return rt
+
+        rt_p = run("on")
+        rt_s = run("serial")
+        assert rt_p.pipeline.discards >= 1
+        assert admitted(rt_p) == admitted(rt_s)
+        assert not rt_p.check_invariants()
+
+
+class TestPipelineChaos:
+    """Crash-at-every-new-fault-point x occurrence sweep (the
+    tests/test_guard.py chaos pattern): recovery from the journal plus
+    a rerun converges to the fault-free serial admitted set."""
+
+    POINTS = ("cycle.prefetch_launched", "cycle.commit_pre_apply")
+
+    @pytest.mark.parametrize("point", POINTS)
+    @pytest.mark.parametrize("occurrence", [0, 1, 2])
+    def test_crash_recover_converge(self, tmp_path, point, occurrence):
+        ref, j_ref = build_rt(0, "serial", tmp_path / "ref")
+        ref.run_until_idle(max_iterations=60)
+        ref_admitted = admitted(ref)
+        j_ref.close()
+
+        rt, j = build_rt(0, "on", tmp_path / "j")
+        faults.arm(point, "crash", skip=occurrence)
+        crashed = False
+        try:
+            rt.run_until_idle(max_iterations=60)
+        except faults.InjectedCrash:
+            crashed = True
+        finally:
+            faults.reset()
+        j.close()
+        if not crashed:
+            pytest.fail(f"{point} occurrence {occurrence} never fired")
+
+        # recovery: replay the journal into a bare runtime, then finish
+        rt2 = _bare_rt("on")
+        res = recover(None, str(tmp_path / "j"), runtime=rt2, strict=True)
+        rt2.attach_journal(res.journal)
+        rt2.run_until_idle(max_iterations=60)
+        assert admitted(rt2) == ref_admitted
+        assert parked(rt2) == parked(ref)
+        assert not rt2.check_invariants()
+
+    def test_points_registered(self):
+        for p in self.POINTS:
+            assert p in faults.FAULT_POINTS
+
+
+class TestGuardCoversPrefetch:
+    def test_async_deadline_counts_against_breaker(self):
+        """A prefetched launch that answers past the device deadline is
+        discarded and strikes the breaker — the deadline window covers
+        launch -> fetch, not just the blocking call."""
+        clock = FakeClock(0.0)
+        guard = SolverGuard(clock=clock)
+        guard.config.device_deadline_s = 5.0
+        launch = guard.device_launch(lambda: "handle", label="prefetch")
+        clock.advance(10.0)  # the apply "took too long"; fetch is late
+        out = guard.device_join(launch, lambda h: h + ":fetched")
+        assert out.result is None
+        assert guard.breaker.consecutive_failures == 1
+
+    def test_async_within_deadline_succeeds(self):
+        clock = FakeClock(0.0)
+        guard = SolverGuard(clock=clock)
+        launch = guard.device_launch(lambda: 41, label="prefetch")
+        clock.advance(1.0)
+        out = guard.device_join(launch, lambda h: h + 1)
+        assert out.result == 42
+        assert guard.device_solves == 1
+
+    def test_launch_raise_contained(self):
+        guard = SolverGuard(clock=FakeClock(0.0))
+
+        def boom():
+            raise RuntimeError("bad dispatch")
+
+        launch = guard.device_launch(boom, label="prefetch")
+        assert launch.failed
+        out = guard.device_join(launch, lambda h: h)
+        assert out.result is None
+        assert guard.failovers == 1
+
+    def test_drain_divergence_quarantines(self):
+        guard = SolverGuard(clock=FakeClock(0.0))
+        events = []
+        guard.record_event = lambda reason, msg: events.append(reason)
+        host = guard.check_drain_divergence(
+            {"admitted": ["a"]},
+            lambda: ("HOST_OUTCOME", {"admitted": ["b"]}),
+            heads=3,
+        )
+        assert host == "HOST_OUTCOME"
+        assert guard.breaker.quarantined
+        assert guard.divergences == 1
+        assert "SolverDiverged" in events
+        assert guard.last_divergence["surface"] == "drain-prefetch"
+
+    def test_drain_divergence_agreement_is_free(self):
+        guard = SolverGuard(clock=FakeClock(0.0))
+        sig = {"admitted": ["a"]}
+        assert (
+            guard.check_drain_divergence(sig, lambda: (None, dict(sig)), 1)
+            is None
+        )
+        assert not guard.breaker.quarantined
+
+    def test_sampling_schedule(self):
+        guard = SolverGuard(clock=FakeClock(0.0))
+        guard.config.divergence_check_every = 4
+        hits = [n for n in range(1, 13) if guard.should_sample_drain(n)]
+        assert hits == [4, 8, 12]
+        guard.config.divergence_check_every = 0
+        assert not guard.should_sample_drain(4)
+
+    def test_sampled_rounds_verified_in_loop(self):
+        """K=1: every committed prefetch re-solves on the numpy mirror;
+        agreement keeps the device path closed and decisions stand."""
+        rt, _ = build_rt(2, "on")
+        rt.guard.config.divergence_check_every = 1
+        rt.run_until_idle(max_iterations=60)
+        assert rt.pipeline.commits >= 1
+        assert rt.guard.divergence_checks >= 1
+        assert rt.guard.divergences == 0
+        assert not rt.guard.breaker.quarantined
+        ref, _ = build_rt(2, "serial")
+        ref.run_until_idle(max_iterations=60)
+        assert admitted(rt) == admitted(ref)
