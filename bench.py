@@ -181,7 +181,7 @@ def build_backlog(rng):
     return pending
 
 
-def contended_drain_bench(rng):
+def contended_drain_bench(rng, mesh=None):
     """Contended drain with CROSS-CQ cohort reclamation: per 10-CQ
     cohort, five "hoarder" ClusterQueues sit saturated ABOVE their
     nominal quota (borrowing from the cohort; they never preempt), and
@@ -194,8 +194,9 @@ def contended_drain_bench(rng):
     cross-CQ evictions, and follow-up admissions — runs on the device
     in ONE dispatch + ONE fetch (ops/drain_kernel.solve_drain_preempt).
     Decision parity with the sequential host scheduler is asserted in
-    tests/test_drain.py TestPreemptDrainCohortReclaim. Returns
-    (ms/cycle, cycles, admitted, evicted)."""
+    tests/test_drain.py TestPreemptDrainCohortReclaim. With ``mesh``
+    the per-queue tensors shard across devices (the --sharded A/B).
+    Returns (ms/cycle, cycles, admitted, evicted, decision_sig)."""
     import time
 
     from kueue_tpu.models import (
@@ -305,7 +306,8 @@ def contended_drain_bench(rng):
 
     snapshot = take_snapshot(cache)
     run_drain_preempt(
-        snapshot, pending, cache.flavors, timestamp_fn=ts_fn, search_width=64
+        snapshot, pending, cache.flavors, timestamp_fn=ts_fn,
+        search_width=64, mesh=mesh,
     )
 
     times = []
@@ -314,7 +316,7 @@ def contended_drain_bench(rng):
         t0 = time.perf_counter()
         outcome = run_drain_preempt(
             snapshot, pending, cache.flavors, timestamp_fn=ts_fn,
-            search_width=64,
+            search_width=64, mesh=mesh,
         )
         times.append(time.perf_counter() - t0)
     assert not outcome.fallback and not outcome.truncated
@@ -328,11 +330,20 @@ def contended_drain_bench(rng):
     )
     assert hoarder_evictions > 0, "no cross-CQ reclaim in contended bench"
     _note_times("contended", [t / outcome.cycles for t in times])
+    sig = (
+        frozenset(
+            (wl.name, cq, cyc) for wl, cq, _, cyc in outcome.admitted
+        ),
+        frozenset((wl.name, cq, cyc) for wl, cq, cyc in outcome.preempted),
+        frozenset(wl.name for wl, _ in outcome.parked),
+        outcome.cycles,
+    )
     return (
         float(np.median(times)) * 1e3 / outcome.cycles,
         outcome.cycles,
         len(outcome.admitted),
         len(outcome.preempted),
+        sig,
     )
 
 
@@ -1747,7 +1758,7 @@ def _stage_pipeline() -> dict:
 def _stage_contended() -> dict:
     from kueue_tpu.core.drain import _PANEL_TUNER
 
-    cd_ms, cd_cycles, cd_admitted, cd_evicted = contended_drain_bench(
+    cd_ms, cd_cycles, cd_admitted, cd_evicted, _sig = contended_drain_bench(
         np.random.default_rng(1)
     )
     return {
@@ -1956,6 +1967,103 @@ def _stage_federation() -> dict:
     }
 
 
+def sharded_drain_bench():
+    """1-device vs mesh A/B on the 50k plain drain: the same backlog
+    (headline seed) solved through ``run_drain`` single-device and
+    under the full local mesh, admitted/parked/cycle decisions asserted
+    bit-for-bit equal via the pipeline's outcome signature. Returns
+    (t_1dev_s, t_mesh_s, cycles, n_admitted, n_devices)."""
+    import time
+
+    import jax
+
+    from kueue_tpu.core.drain import run_drain
+    from kueue_tpu.core.pipeline import outcome_signature
+    from kueue_tpu.core.snapshot import take_snapshot
+    from kueue_tpu.parallel import make_mesh
+
+    n_dev = len(jax.devices())
+    assert n_dev >= 2, (
+        f"--sharded needs >=2 devices, have {n_dev} (on CPU the driver "
+        "forces 8 virtual devices via "
+        "--xla_force_host_platform_device_count)"
+    )
+    mesh = make_mesh(n_dev)
+    rng = np.random.default_rng(0)  # the headline seed: same backlog
+    cache, _mgr = build_cluster(rng)
+    pending = build_backlog(rng)
+
+    def run(mesh_, label):
+        _stage(f"sharded: {label} warmup (compile)")
+        run_drain(
+            take_snapshot(cache), pending, cache.flavors, max_cells=3,
+            mesh=mesh_,
+        )
+        _stage(f"sharded: {label} measured")
+        times = []
+        for _ in range(3):
+            snapshot = take_snapshot(cache)
+            t0 = time.perf_counter()
+            out = run_drain(
+                snapshot, pending, cache.flavors, max_cells=3, mesh=mesh_
+            )
+            times.append(time.perf_counter() - t0)
+        _note_times(f"sharded_{label}", [t / out.cycles for t in times])
+        return float(np.median(times)), out
+
+    t1, out1 = run(None, "1-device")
+    tm, outm = run(mesh, f"{n_dev}-device mesh")
+    assert outcome_signature(out1) == outcome_signature(outm), (
+        "sharded drain changed decisions"
+    )
+    assert out1.admitted and out1.cycles > 0
+    return t1, tm, out1.cycles, len(out1.admitted), n_dev
+
+
+def _stage_sharded() -> dict:
+    t1, tm, cycles, admitted, n_dev = sharded_drain_bench()
+    # contended drain A/B: same seed -> identical env; decisions
+    # asserted equal across 1-device and mesh
+    _stage("sharded: contended 1-device")
+    c1_ms, c_cycles, c_adm, c_evi, sig1 = contended_drain_bench(
+        np.random.default_rng(1)
+    )
+    from kueue_tpu.parallel import make_mesh
+
+    _stage(f"sharded: contended {n_dev}-device mesh")
+    cm_ms, _, _, _, sigm = contended_drain_bench(
+        np.random.default_rng(1), mesh=make_mesh(n_dev)
+    )
+    assert sig1 == sigm, "sharded contended drain changed decisions"
+    ms_1dev = t1 * 1e3 / cycles
+    ms_mesh = tm * 1e3 / cycles
+    from kueue_tpu.parallel.harness import last_panel_schedule
+
+    return {
+        "sharded_metric": (
+            f"sharded_drain_cycle_latency ({N_CQ * WL_PER_CQ // 1000}k "
+            f"pending x {N_CQ} CQs drained under a wl={n_dev} device "
+            f"mesh vs 1 device, admitted sets asserted bit-for-bit "
+            f"equal, {cycles} cycles, {admitted} admitted; plus the "
+            f"contended reclaim drain A/B [{c_cycles} cycles, {c_adm} "
+            f"admitted, {c_evi} preempted, decisions equal])"
+        ),
+        "sharded_value": round(ms_mesh, 3),
+        "sharded_unit": "ms/cycle (mesh)",
+        "sharded_1dev_ms_per_cycle": round(ms_1dev, 3),
+        "sharded_speedup": round(ms_1dev / max(ms_mesh, 1e-9), 2),
+        "sharded_n_devices": n_dev,
+        "sharded_vs_baseline": round(BASELINE_MS / ms_mesh, 2),
+        "sharded_spread_ms": _spread_of(f"sharded_{n_dev}-device mesh"),
+        "sharded_1dev_spread_ms": _spread_of("sharded_1-device"),
+        "contended_sharded_ms_per_cycle": round(cm_ms, 3),
+        "contended_1dev_ms_per_cycle": round(c1_ms, 3),
+        "contended_sharded_speedup": round(c1_ms / max(cm_ms, 1e-9), 2),
+        # the probe-gated narrow-panel schedule the mesh ran under
+        "sharded_panel_schedule": last_panel_schedule() or None,
+    }
+
+
 def _stage_tas_drain() -> dict:
     td_ms, td_cycles, td_admitted, td_pending = tas_drain_bench(
         np.random.default_rng(6)
@@ -1980,6 +2088,7 @@ def _stage_tas_drain() -> dict:
 STAGES = {
     "headline": _stage_headline,
     "pipeline": _stage_pipeline,
+    "sharded": _stage_sharded,
     "contended": _stage_contended,
     "tas": _stage_tas,
     "fair": _stage_fair,
@@ -2018,6 +2127,15 @@ def _run_payload(force_cpu: bool, stage: "str | None" = None, timeout_s=None):
     if force_cpu:
         env["JAX_PLATFORMS"] = "cpu"
         cmd.append("--force-cpu")
+        if stage == "sharded":
+            # the sharded A/B needs >=2 devices: on CPU force 8 virtual
+            # ones (the tier-1 test mesh), set before the payload's
+            # first JAX import; real accelerators use real devices
+            flags = env.get("XLA_FLAGS", "")
+            if "--xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
     timeout_s = timeout_s or PAYLOAD_TIMEOUT_S
     try:
         p = subprocess.run(
@@ -2177,6 +2295,12 @@ def driver_main(stage_names=None):
         record.setdefault("metric", record.get("federation_metric"))
         record.setdefault("value", record["federation_value"])
         record.setdefault("unit", record.get("federation_unit"))
+    if "value" not in record and "sharded_value" in record:
+        # sharded-only invocation (--sharded): the mesh drain cycle
+        # latency IS the headline
+        record.setdefault("metric", record.get("sharded_metric"))
+        record.setdefault("value", record["sharded_value"])
+        record.setdefault("unit", record.get("sharded_unit"))
     if "value" not in record:
         # the HEADLINE stage failed but others succeeded: keep every
         # completed stage's metrics (stage isolation's whole point) and
@@ -2214,6 +2338,9 @@ def driver_main(stage_names=None):
         compact["admissions_per_s"] = record["federation_admissions_per_s"]
     if "pipeline_speedup_vs_serial" in record:
         compact["pipeline_speedup"] = record["pipeline_speedup_vs_serial"]
+    if "sharded_speedup" in record:
+        compact["n_devices"] = record.get("sharded_n_devices")
+        compact["sharded_speedup"] = record["sharded_speedup"]
     print(json.dumps(compact))
 
 
@@ -2254,6 +2381,12 @@ if __name__ == "__main__":
         # A/B at 50k pending; compact last line carries
         # {"headline_ms", "backend", "pipeline_speedup"}
         driver_main(["pipeline"])
+    elif "--sharded" in sys.argv:
+        # sharded-only mode: 1-device vs mesh A/B on the 50k plain
+        # drain + the contended reclaim drain, admitted sets asserted
+        # bit-for-bit equal; compact last line carries
+        # {"headline_ms", "backend", "n_devices", "sharded_speedup"}
+        driver_main(["sharded"])
     elif "--federation" in sys.argv:
         # federation-only mode: 3 in-process workers behind the
         # dispatcher — dispatch fan-out latency + federated admission

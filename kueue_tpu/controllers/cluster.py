@@ -62,6 +62,13 @@ class ClusterRuntime:
         # pre-pipeline single-dispatch drain.
         drain_pipeline: str = "on",
         pipeline_chunk_cycles: int = 16,
+        # Multi-chip admission (kueue_tpu/parallel): a jax.sharding.Mesh
+        # — or an operator spec ("auto" | "off" | a device count,
+        # resolved via parallel.resolve_mesh) — shards every
+        # drain-family launch (plain / contended / fair / TAS, blocking
+        # AND pipelined-prefetched) over the mesh's wl axis. None/"off"
+        # = single-device (the pre-PR-8 behavior).
+        mesh=None,
     ):
         from kueue_tpu.metrics import Metrics
 
@@ -217,6 +224,67 @@ class ClusterRuntime:
         self.pipeline_chunk_cycles = max(1, int(pipeline_chunk_cycles))
         self.pipeline = PipelineStats()
         self._pipeline_committed = 0  # committed prefetches (divergence sampling)
+        # Multi-chip admission state: the resolved mesh, its metric
+        # posture, and the resident drain encode (single-device
+        # pipelined rounds keep quota/hierarchy buffers on device and
+        # delta-ship only touched usage rows — core/encode.py)
+        self.mesh = None
+        self._mesh_label = "off"
+        self._mesh_place_seen = 0.0
+        self._drain_resident = None
+        self.set_mesh(mesh)
+
+    def set_mesh(self, mesh) -> None:
+        """Install (or clear) the admission mesh: accepts a Mesh, an
+        operator spec ("auto" | "off" | device count), or None; updates
+        the kueue_mesh_* gauges either way."""
+        if isinstance(mesh, (str, int)):
+            from kueue_tpu.parallel import resolve_mesh
+
+            mesh = resolve_mesh(mesh)
+        self.mesh = mesh
+        from kueue_tpu.parallel import mesh_shape_str
+
+        self._mesh_label = mesh_shape_str(mesh)
+        if mesh is None:
+            self.metrics.mesh_devices.set(0)
+            self.metrics.mesh_shard_width.set(0)
+        else:
+            self.metrics.mesh_devices.set(int(mesh.size))
+            self.metrics.mesh_shard_width.set(int(mesh.shape["wl"]))
+
+    def mesh_status(self) -> dict:
+        """Mesh posture for the dashboard badge + SIGUSR2 dump: shape,
+        device count, jit-bucket compile/reuse accounting, placement
+        seconds, narrow-panel fence state, resident-encode stats."""
+        from kueue_tpu.parallel import bucket_stats
+        from kueue_tpu.parallel.harness import (
+            last_panel_schedule,
+            place_seconds,
+        )
+
+        resident = self._drain_resident
+        return {
+            "shape": self._mesh_label,
+            "devices": int(self.mesh.size) if self.mesh is not None else 0,
+            "buckets": bucket_stats(),
+            "placeSeconds": round(place_seconds(), 6),
+            "panelSchedule": last_panel_schedule(),
+            "residentEncode": resident.stats() if resident is not None else {},
+        }
+
+    def _note_mesh_metrics(self) -> None:
+        """Fold the harness' cumulative placement time into the
+        kueue_mesh_allgather_seconds counter (delta since last fold)."""
+        if self.mesh is None:
+            return
+        from kueue_tpu.parallel.harness import place_seconds
+
+        total = place_seconds()
+        delta = total - self._mesh_place_seen
+        if delta > 0:
+            self.metrics.mesh_allgather_seconds.inc(delta)
+            self._mesh_place_seen = total
 
     def _make_preemptor(self, fair_sharing: bool):
         from kueue_tpu.core.preemption import Preemptor
@@ -1213,6 +1281,7 @@ class ClusterRuntime:
                 tas_cache=self.cache.tas_cache,
                 fs_strategies=getattr(sched.preemptor, "fs_strategies", None),
                 timestamp_fn=ts_fn,
+                mesh=self.mesh,
             ),
             label="bulk drain",
         )
@@ -1254,6 +1323,7 @@ class ClusterRuntime:
             return None
         t_apply = _time.perf_counter() - t1
         sched.guard.phase_checkpoint("drain.apply", device_used=True)
+        self._note_mesh_metrics()
         dt = _time.perf_counter() - t0
         trace = CycleTrace(
             cycle=sched.scheduling_cycle,
@@ -1273,6 +1343,7 @@ class ClusterRuntime:
             },
             device_s=t_solve,
             host_s=dt - t_solve,
+            mesh=self._mesh_label,
         )
         sched.last_traces.append(trace)
         self._report_cycle_metrics(result, dt)
@@ -1316,11 +1387,21 @@ class ClusterRuntime:
         flavors = self.cache.flavors
         last_result = None
         verify_next = False
+        mesh = self.mesh
+        if mesh is None and self._drain_resident is None:
+            from kueue_tpu.core.encode import ResidentEncoder
+
+            self._drain_resident = ResidentEncoder()
+        # single-device rounds reuse the resident device buffers; the
+        # mesh path re-places with shardings every round (device_put
+        # onto shards IS its transfer plan)
+        resident = self._drain_resident if mesh is None else None
 
         def _launch(snap, pend):
             return sched.guard.device_launch(
                 lambda: launch_drain(
-                    snap, pend, flavors, timestamp_fn=ts_fn, max_cycles=chunk
+                    snap, pend, flavors, timestamp_fn=ts_fn, max_cycles=chunk,
+                    mesh=mesh, resident=resident,
                 ),
                 label="pipelined drain round",
             )
@@ -1389,7 +1470,7 @@ class ClusterRuntime:
                 pf = sched.guard.device_launch(
                     lambda: launch_drain(
                         pf_snap, undecided, flavors, timestamp_fn=ts_fn,
-                        max_cycles=chunk,
+                        max_cycles=chunk, mesh=mesh, resident=resident,
                     ),
                     label="pipelined drain prefetch",
                 )
@@ -1473,6 +1554,7 @@ class ClusterRuntime:
             if rounds == 1:
                 spans["snapshot"] = t_snapshot
                 spans["classify"] = t_classify
+            self._note_mesh_metrics()
             dt = sum(spans.values())
             trace = CycleTrace(
                 cycle=sched.scheduling_cycle,
@@ -1486,6 +1568,7 @@ class ClusterRuntime:
                 spans=spans,
                 device_s=t_solve,
                 host_s=dt - t_solve,
+                mesh=self._mesh_label,
             )
             sched.last_traces.append(trace)
             self._report_cycle_metrics(result, dt)

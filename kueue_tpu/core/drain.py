@@ -750,29 +750,36 @@ def run_drain_for_scope(
     tas_cache=None,
     fs_strategies=None,
     timestamp_fn=None,
+    mesh=None,  # jax.sharding.Mesh: shard every drain kind's Q axis
 ):
     """Dispatch the drain a classify_drain_scope kind names — the ONE
     place the kind→drain mapping lives, so the service bulk path and
-    the CLI what-if stay identical by construction."""
+    the CLI what-if stay identical by construction. ``mesh`` flows to
+    every kind: the whole drain family runs under a ``(wl[, fr])`` mesh
+    with decisions bit-for-bit the single-device kernels'
+    (tests/test_mesh_drain.py)."""
     if kind == "fair_preempt":
         return run_drain_fair_preempt(
             snapshot, pending, flavors, timestamp_fn=timestamp_fn,
-            fs_strategies=fs_strategies,
+            fs_strategies=fs_strategies, mesh=mesh,
         )
     if kind == "fair":
         return run_drain(
             snapshot, pending, flavors, timestamp_fn=timestamp_fn,
-            fair_sharing=True,
+            fair_sharing=True, mesh=mesh,
         )
     if kind == "preempt":
         return run_drain_preempt(
-            snapshot, pending, flavors, timestamp_fn=timestamp_fn
+            snapshot, pending, flavors, timestamp_fn=timestamp_fn, mesh=mesh
         )
     if kind == "tas":
         return run_drain_tas(
-            snapshot, pending, flavors, tas_cache, timestamp_fn=timestamp_fn
+            snapshot, pending, flavors, tas_cache, timestamp_fn=timestamp_fn,
+            mesh=mesh,
         )
-    return run_drain(snapshot, pending, flavors, timestamp_fn=timestamp_fn)
+    return run_drain(
+        snapshot, pending, flavors, timestamp_fn=timestamp_fn, mesh=mesh
+    )
 
 
 def launch_drain_for_scope(
@@ -782,6 +789,8 @@ def launch_drain_for_scope(
     flavors: Dict[str, ResourceFlavor],
     timestamp_fn=None,
     max_cycles: Optional[int] = None,
+    mesh=None,
+    resident=None,
 ) -> Optional[DrainLaunch]:
     """Async (launch/fetch) twin of ``run_drain_for_scope`` for the
     scopes the pipelined drain loop can double-buffer. Returns None for
@@ -792,7 +801,7 @@ def launch_drain_for_scope(
         return None
     return launch_drain(
         snapshot, pending, flavors, timestamp_fn=timestamp_fn,
-        max_cycles=max_cycles,
+        max_cycles=max_cycles, mesh=mesh, resident=resident,
     )
 
 
@@ -893,6 +902,10 @@ def run_drain_preempt(
     mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
     panel_widths: Optional[Sequence[int]] = None,
     panel_tuner: Optional[PanelTuner] = None,
+    # internal (the narrow-panel GSPMD probe): run the given
+    # panel_widths under the mesh WITHOUT consulting the probe verdict
+    # — the probe itself is what establishes it
+    _trust_panel_widths: bool = False,
 ) -> PreemptDrainOutcome:
     """Multi-cycle drain WITH classic preemption — within-ClusterQueue
     and cross-CQ cohort reclamation — in one device dispatch + one
@@ -942,12 +955,16 @@ def run_drain_preempt(
 
     queues_np = plan.queues_np
     if mesh is not None:
+        import time as _time
+
+        from kueue_tpu.parallel import harness
         from kueue_tpu.parallel.sharded_solver import (
             pad_queue_arrays,
             pad_victim_arrays,
             place_preempt_drain_inputs,
         )
 
+        t0p = _time.perf_counter()
         mult = mesh.shape["wl"]
         queues_np = pad_queue_arrays(queues_np, mult)
         victims_np = pad_victim_arrays(
@@ -963,6 +980,7 @@ def run_drain_preempt(
                 paths_j,
             )
         )
+        harness.note_place_seconds(_time.perf_counter() - t0p)
     else:
         tree_in, paths_in = tree, paths_j
         usage_in = jnp.asarray(snapshot.local_usage)
@@ -985,32 +1003,79 @@ def run_drain_preempt(
     tuner = panel_tuner if panel_tuner is not None else _PANEL_TUNER
     if panel_widths is None:
         panel_widths = _PANEL_WIDTHS_OVERRIDE
-    if mesh is not None:
-        # sharded dispatch keeps the single exact width: the GSPMD
-        # partitioner miscompiles the narrow-panel compaction at small
-        # static widths (mixed s32/s64 compare in the partitioned HLO),
-        # and the mesh path is not the contended hot path anyway
-        widths = (search_width,)
-        panel_widths = widths
-    elif panel_widths is not None:
+    if panel_widths is not None:
         widths = tuple(panel_widths)
     else:
         widths = tuner.widths_for(search_width)
+    if mesh is not None and not _trust_panel_widths:
+        # The GSPMD partitioner miscompiles the narrow-panel compaction
+        # at small static widths (mixed s32/s64 index compare in the
+        # partitioned HLO). Under a mesh each narrow rung therefore
+        # runs only after a per-(mesh, width) canary PROVES the
+        # partitioned solve reproduces single-device decisions
+        # (parallel/harness.narrow_panels_supported, memoized);
+        # unsupported rungs are clamped up the ladder, degenerating to
+        # the pinned exact ``search_width`` where the miscompile is
+        # real at every rung (the PR-7 fence). The exactness escape
+        # hatch is unchanged either way: ``overflowed`` is replicated
+        # across shards and escalation re-solves wider, so a clean
+        # narrow run is provably the wide run's decisions.
+        from kueue_tpu.parallel.harness import (
+            mesh_safe_widths,
+            note_panel_schedule,
+        )
+
+        safe = mesh_safe_widths(mesh, widths)
+        note_panel_schedule(safe, fenced=safe != widths)
+        widths = safe
     escalated = False
     for i, width in enumerate(widths):
-        flat = np.asarray(
-            solve_drain_preempt_packed_jit(
-                tree_in,
-                usage_in,
-                queues,
-                victims,
-                paths_in,
-                n_segments=plan.n_segments,
-                n_steps=plan.n_steps,
-                max_cycles=plan.max_cycles,
-                search_width=int(width),
+        if mesh is not None:
+            from kueue_tpu.parallel import harness
+
+            harness.note_bucket(
+                "preempt_kernel",
+                (
+                    queues_np["cells"].shape, plan.n_segments, plan.n_steps,
+                    plan.max_cycles, int(width),
+                ),
+                mesh,
             )
-        )  # one fetch per tier; the common case stops at the first
+        try:
+            flat = np.asarray(
+                solve_drain_preempt_packed_jit(
+                    tree_in,
+                    usage_in,
+                    queues,
+                    victims,
+                    paths_in,
+                    n_segments=plan.n_segments,
+                    n_steps=plan.n_steps,
+                    max_cycles=plan.max_cycles,
+                    search_width=int(width),
+                )
+            )  # one fetch per tier; the common case stops at the first
+        except Exception as exc:
+            from kueue_tpu.testing import faults
+
+            if (
+                mesh is None
+                or i == len(widths) - 1
+                or isinstance(exc, faults.InjectedCrash)
+            ):
+                raise
+            # The GSPMD miscompile is shape-dependent: the canary probe
+            # certifies a width per MESH, but a particular problem's
+            # partitioned HLO can still be rejected by the verifier at
+            # a narrow width (loud compile failure, never a silent
+            # wrong answer). Demote the width for this mesh — future
+            # schedules clamp past it — and escalate to the next rung;
+            # only the final exact width is allowed to raise.
+            from kueue_tpu.parallel.harness import demote_panel_width
+
+            demote_panel_width(mesh, int(width))
+            escalated = True
+            continue
         overflowed = bool(flat[-2])
         if not overflowed or i == len(widths) - 1:
             break
@@ -1201,11 +1266,19 @@ def run_drain_fair_preempt(
     max_cycles: Optional[int] = None,
     now: Optional[float] = None,
     fs_strategies: Optional[Sequence[str]] = None,
+    mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
 ) -> PreemptDrainOutcome:
     """Multi-cycle drain with FAIR-SHARING admission ordering AND
     fair-sharing preemption — the production fair-cohort configuration
     — in one device dispatch + one fetch
     (ops/drain_kernel.solve_drain_fair_preempt).
+
+    With ``mesh`` the per-queue tensors (and SegVictims' per-queue
+    config) shard along ``wl``; the candidate pools, fair panels and
+    node-space extras stay replicated — every panel tensor is SEGMENT
+    space, the tournament reduces over whole root cohorts on each
+    shard, and decisions are bit-for-bit the single-device kernel's
+    (tests/test_mesh_drain.py).
 
     The candidate pools are the classic preemption drain's (fair
     sharing shares _find_candidates and the candidate ordering —
@@ -1325,30 +1398,77 @@ def run_drain_fair_preempt(
     )
 
     queues_np = plan.queues_np
-    queues = DrainQueues(**{k: jnp.asarray(v) for k, v in queues_np.items()})
-    victims = SegVictims(**{k: jnp.asarray(v) for k, v in victims_np.items()})
-    fairp = FairSegPanels(
-        seg_cells=jnp.asarray(seg_cells),
-        parent_local=jnp.asarray(parent_local),
-        depth_local=jnp.asarray(depth_local),
-        is_cq_local=jnp.asarray(is_cq_local),
-        node_valid=jnp.asarray(node_valid),
-        weight_local=jnp.asarray(weight_local),
-        res_of_cell=jnp.asarray(res_of_cell),
-        svqty_cu=jnp.asarray(svqty_cu),
+    fairp_np = dict(
+        seg_cells=seg_cells, parent_local=parent_local,
+        depth_local=depth_local, is_cq_local=is_cq_local,
+        node_valid=node_valid, weight_local=weight_local,
+        res_of_cell=res_of_cell, svqty_cu=svqty_cu,
     )
+    if mesh is not None:
+        import time as _time
+
+        from kueue_tpu.parallel import harness
+        from kueue_tpu.parallel.sharded_solver import (
+            pad_queue_arrays,
+            pad_victim_arrays,
+            place_fair_drain_extras,
+            place_fair_preempt_drain_inputs,
+        )
+
+        t0p = _time.perf_counter()
+        queues_np = pad_queue_arrays(queues_np, mesh.shape["wl"])
+        victims_np = pad_victim_arrays(victims_np, queues_np["qlen"].shape[0])
+        tree_in, usage_in, queues, victims, fairp, paths_in = (
+            place_fair_preempt_drain_inputs(
+                mesh,
+                tree,
+                snapshot.local_usage,
+                DrainQueues(**queues_np),
+                SegVictims(**victims_np),
+                FairSegPanels(**fairp_np),
+                paths_j,
+            )
+        )
+        depth_in, weight_in, lendable_in, res_in = place_fair_drain_extras(
+            mesh, depth_of, snapshot.weight_milli, lendable, res_of_fr
+        )
+        harness.note_place_seconds(_time.perf_counter() - t0p)
+        harness.note_bucket(
+            "fair_preempt_kernel",
+            (
+                queues_np["cells"].shape, plan.n_segments, plan.n_steps,
+                plan.max_cycles,
+            ),
+            mesh,
+        )
+    else:
+        tree_in, paths_in = tree, paths_j
+        usage_in = jnp.asarray(snapshot.local_usage)
+        queues = DrainQueues(
+            **{k: jnp.asarray(v) for k, v in queues_np.items()}
+        )
+        victims = SegVictims(
+            **{k: jnp.asarray(v) for k, v in victims_np.items()}
+        )
+        fairp = FairSegPanels(
+            **{k: jnp.asarray(v) for k, v in fairp_np.items()}
+        )
+        depth_in = jnp.asarray(depth_of)
+        weight_in = jnp.asarray(snapshot.weight_milli)
+        lendable_in = jnp.asarray(lendable)
+        res_in = jnp.asarray(res_of_fr)
     flat = np.asarray(
         solve_drain_fair_preempt_packed_jit(
-            tree,
-            jnp.asarray(snapshot.local_usage),
+            tree_in,
+            usage_in,
             queues,
             victims,
             fairp,
-            paths_j,
-            jnp.asarray(depth_of),
-            jnp.asarray(snapshot.weight_milli),
-            jnp.asarray(lendable),
-            jnp.asarray(res_of_fr),
+            paths_in,
+            depth_in,
+            weight_in,
+            lendable_in,
+            res_in,
             n_segments=plan.n_segments,
             n_steps=plan.n_steps,
             max_cycles=plan.max_cycles,
@@ -1468,6 +1588,7 @@ def run_drain_tas(
     max_cells: int = 4,
     timestamp_fn=None,
     max_cycles: Optional[int] = None,
+    mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
 ) -> TASDrainOutcome:
     """Multi-cycle drain with Topology-Aware Scheduling heads decided
     on the device (ops/drain_kernel.solve_drain_tas) — one dispatch +
@@ -1475,6 +1596,13 @@ def run_drain_tas(
     workload, grouped per cycle against cycle-start state) that
     reconstructs the TopologyAssignments and asserts the kernel's final
     TAS leaf usage is reproduced exactly, flavor by flavor.
+
+    With ``mesh`` the per-queue tensors (DrainQueues + TASHeads' Q
+    rows) shard along ``wl`` and the merged domain forest stays
+    replicated — every shard's queues place into the same forest and
+    GSPMD resolves the sequential placement scan's leaf-usage scatters;
+    the host replay's exactness assertion is unchanged and doubles as a
+    per-drain mesh-parity check.
 
     Scope: single-podset topology requests in ALL THREE modes —
     Required, Preferred (level relaxation,
@@ -1658,9 +1786,6 @@ def run_drain_tas(
     if max_cycles is not None:
         plan.max_cycles = max_cycles
     tree, paths, _ = tree_arrays(snapshot)
-    queues = DrainQueues(
-        **{k: jnp.asarray(v) for k, v in plan.queues_np.items()}
-    )
 
     live_flavors = sorted(
         {tas_queue[qi] for qi in tas_queue if qi not in dropped}
@@ -1676,15 +1801,12 @@ def run_drain_tas(
         for qi, fname in tas_queue.items():
             if qi not in dropped:
                 t_flavor[qi] = live_idx[fname]
-        topo_free = jnp.asarray(topo_free_np)
-        tas_usage0 = jnp.asarray(tas_usage0_np)
-        seg_ids_j = jnp.asarray(seg_ids_np)
         lf_n = topo_free_np.shape[0]
     else:
         # no TAS queue in scope: inert 1-leaf topology
-        topo_free = jnp.zeros((1, 1), dtype=jnp.int64)
-        tas_usage0 = jnp.zeros((1, 1), dtype=jnp.int64)
-        seg_ids_j = jnp.zeros((1, 1), dtype=jnp.int32)
+        topo_free_np = np.zeros((1, 1), dtype=np.int64)
+        tas_usage0_np = np.zeros((1, 1), dtype=np.int64)
+        seg_ids_np = np.zeros((1, 1), dtype=np.int32)
         n_domains = (1,)
         parent_map = np.zeros((1, 1), dtype=np.int32)
         leaf_flavor_np = np.zeros(1, dtype=np.int32)
@@ -1693,37 +1815,72 @@ def run_drain_tas(
         n_res_t = max(n_res_t, 1)
         t_req = t_req[:, :, :1]
 
-    theads = TASHeads(
-        t_is=jnp.asarray(t_is),
-        t_req=jnp.asarray(t_req),
-        t_count=jnp.asarray(t_count),
-        t_level=jnp.asarray(t_level),
-        t_mode=jnp.asarray(t_mode),
-        t_top=jnp.asarray(t_top),
-        t_flavor=jnp.asarray(t_flavor),
-        leaf_flavor=jnp.asarray(leaf_flavor_np),
-        parent_map=jnp.asarray(parent_map),
-        t_bad=jnp.asarray(t_bad),
+    theads_np = dict(
+        t_is=t_is, t_req=t_req, t_count=t_count, t_level=t_level,
+        t_mode=t_mode, t_top=t_top, t_flavor=t_flavor,
+        leaf_flavor=leaf_flavor_np, parent_map=parent_map, t_bad=t_bad,
     )
     n_live = int((plan.queues_np["cq_rows"] >= 0).sum())
     n_steps = _bucket(max(n_live, 1), minimum=8)
 
+    queues_np = plan.queues_np
+    if mesh is not None:
+        import time as _time
+
+        from kueue_tpu.parallel import harness
+        from kueue_tpu.parallel.sharded_solver import (
+            pad_queue_arrays,
+            pad_tas_arrays,
+            place_tas_drain_inputs,
+        )
+
+        t0p = _time.perf_counter()
+        queues_np = pad_queue_arrays(queues_np, mesh.shape["wl"])
+        theads_np = pad_tas_arrays(theads_np, queues_np["qlen"].shape[0])
+        (tree_in, usage_in, queues, paths_in, topo_in, tusage_in,
+         seg_in, theads) = place_tas_drain_inputs(
+            mesh, tree, snapshot.local_usage, DrainQueues(**queues_np),
+            paths, topo_free_np, tas_usage0_np, seg_ids_np,
+            TASHeads(**theads_np),
+        )
+        harness.note_place_seconds(_time.perf_counter() - t0p)
+        harness.note_bucket(
+            "tas_kernel",
+            (
+                queues_np["cells"].shape, tuple(n_domains), n_steps,
+                plan.max_cycles,
+            ),
+            mesh,
+        )
+    else:
+        tree_in, paths_in = tree, paths
+        usage_in = jnp.asarray(snapshot.local_usage)
+        queues = DrainQueues(
+            **{k: jnp.asarray(v) for k, v in queues_np.items()}
+        )
+        topo_in = jnp.asarray(topo_free_np)
+        tusage_in = jnp.asarray(tas_usage0_np)
+        seg_in = jnp.asarray(seg_ids_np)
+        theads = TASHeads(
+            **{k: jnp.asarray(v) for k, v in theads_np.items()}
+        )
+
     flat = np.asarray(
         solve_drain_tas_packed_jit(
-            tree,
-            jnp.asarray(snapshot.local_usage),
+            tree_in,
+            usage_in,
             queues,
-            paths,
-            topo_free,
-            tas_usage0,
-            seg_ids_j,
+            paths_in,
+            topo_in,
+            tusage_in,
+            seg_in,
             theads,
             n_domains=n_domains,
             n_steps=n_steps,
             max_cycles=plan.max_cycles,
         )
     )  # the single fetch
-    nq, nl2, npd = plan.queues_np["cells"].shape[:3]
+    nq, nl2, npd = queues_np["cells"].shape[:3]
     ql, qlp = nq * nl2, nq * nl2 * npd
     off = 0
     adm_k = flat[off : off + qlp].reshape((nq, nl2, npd)); off += qlp
@@ -1734,7 +1891,7 @@ def run_drain_tas(
     tas_final = flat[off : off + lf_n * n_res_t].reshape((lf_n, n_res_t))
     off += lf_n * n_res_t
     cycles = int(flat[-1])
-    qlen = plan.queues_np["qlen"]
+    qlen = queues_np["qlen"]
     truncated = bool(np.any((cursor < qlen) & ~stuck_q))
 
     lowered = plan.lowered
@@ -1898,11 +2055,22 @@ def launch_drain(
     max_cells: int = 4,
     timestamp_fn=None,
     max_cycles: Optional[int] = None,
+    mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
+    resident=None,  # core.encode.ResidentEncoder (single-device only)
 ) -> DrainLaunch:
     """Plan + DISPATCH the plain device drain without fetching — the
-    async half of ``run_drain`` (device, no fair sharing, no mesh: the
-    pipelined hot path). ``run_drain(...) == launch_drain(...).fetch()``
-    for that configuration, by construction."""
+    async half of ``run_drain`` (device, no fair sharing: the pipelined
+    hot path). ``run_drain(...) == launch_drain(...).fetch()`` for that
+    configuration, by construction.
+
+    With ``mesh`` the per-queue tensors shard along the mesh's ``wl``
+    axis exactly like ``run_drain(mesh=...)`` — prefetched pipelined
+    launches ride the same sharded path as blocking solves. With
+    ``resident`` (single-device only; ignored under a mesh) the quota
+    tree + paths stay device-resident between rounds and only changed
+    leaf-usage rows ship (core/encode.ResidentEncoder)."""
+    import time as _time
+
     from kueue_tpu._jax import jnp
     from kueue_tpu.ops.drain_kernel import DrainQueues, solve_drain_packed_jit
 
@@ -1911,13 +2079,41 @@ def launch_drain(
     )
     if max_cycles is not None:
         plan.max_cycles = max_cycles
-    tree, paths, _ = tree_arrays(snapshot)
-    queues = DrainQueues(
-        **{k: jnp.asarray(v) for k, v in plan.queues_np.items()}
-    )
+    queues_np = plan.queues_np
+    if mesh is not None:
+        from kueue_tpu.parallel import harness
+        from kueue_tpu.parallel.sharded_solver import (
+            pad_queue_arrays,
+            place_drain_inputs,
+        )
+
+        tree, paths, _ = tree_arrays(snapshot)
+        t0p = _time.perf_counter()
+        queues_np = pad_queue_arrays(queues_np, mesh.shape["wl"])
+        tree, usage_in, queues, paths = place_drain_inputs(
+            mesh, tree, snapshot.local_usage, DrainQueues(**queues_np), paths
+        )
+        harness.note_place_seconds(_time.perf_counter() - t0p)
+        harness.note_bucket(
+            "drain_kernel",
+            (
+                queues_np["cells"].shape, plan.n_segments, plan.n_steps,
+                plan.max_cycles,
+            ),
+            mesh,
+        )
+    else:
+        if resident is not None:
+            tree, paths, usage_in = resident.refresh(snapshot)
+        else:
+            tree, paths, _ = tree_arrays(snapshot)
+            usage_in = jnp.asarray(snapshot.local_usage)
+        queues = DrainQueues(
+            **{k: jnp.asarray(v) for k, v in queues_np.items()}
+        )
     flat_dev = solve_drain_packed_jit(
         tree,
-        jnp.asarray(snapshot.local_usage),
+        usage_in,
         queues,
         paths,
         n_segments=plan.n_segments,
@@ -1926,7 +2122,7 @@ def launch_drain(
     )
     return DrainLaunch(
         plan=plan,
-        queues_np=plan.queues_np,
+        queues_np=queues_np,
         flat_dev=flat_dev,
         usage_shape=tuple(snapshot.local_usage.shape),
         pending=list(pending),
@@ -2047,15 +2243,28 @@ def run_drain(
     tree, paths, _ = tree_arrays(snapshot)
     queues_np = plan.queues_np
     if mesh is not None:
+        import time as _time
+
+        from kueue_tpu.parallel import harness
         from kueue_tpu.parallel.sharded_solver import (
             pad_queue_arrays,
             place_drain_inputs,
         )
 
+        t0p = _time.perf_counter()
         queues_np = pad_queue_arrays(queues_np, mesh.shape["wl"])
         # numpy -> device_put straight onto the shards (one transfer)
         tree, usage_in, queues, paths = place_drain_inputs(
             mesh, tree, snapshot.local_usage, DrainQueues(**queues_np), paths
+        )
+        harness.note_place_seconds(_time.perf_counter() - t0p)
+        harness.note_bucket(
+            "drain_kernel",
+            (
+                queues_np["cells"].shape, plan.n_segments, plan.n_steps,
+                plan.max_cycles, "fair" if fair_sharing else "plain",
+            ),
+            mesh,
         )
     else:
         usage_in = jnp.asarray(snapshot.local_usage)

@@ -146,6 +146,9 @@ class CycleTrace:
     # (assign/victim kernels, the drain solve) vs everything else
     device_s: float = 0.0
     host_s: float = 0.0
+    # mesh annotation: "off" single-device, else the active mesh shape
+    # ("wl=8", "wl=4,fr=2") the drain solves sharded over
+    mesh: str = "off"
 
     def to_dict(self) -> dict:
         return {
@@ -157,6 +160,7 @@ class CycleTrace:
             "totalMs": round(self.total_s * 1e3, 3),
             "deviceMs": round(self.device_s * 1e3, 3),
             "hostMs": round(self.host_s * 1e3, 3),
+            "mesh": self.mesh,
             "spansMs": {k: round(v * 1e3, 3) for k, v in self.spans.items()},
         }
 

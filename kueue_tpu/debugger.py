@@ -115,6 +115,38 @@ def dump(runtime) -> str:
             f"commits={d['commits']} discards={d['discards']} "
             f"inflight={d['inflight']} overlapRatio={d['overlapRatio']}"
         )
+    # multi-chip admission posture (kueue_tpu/parallel): active mesh
+    # shape + the size-bucketed jit-cache hit accounting — a low hit
+    # rate means the shape buckets are mistuned and every backlog
+    # recompiles
+    mesh_status = getattr(runtime, "mesh_status", None)
+    if mesh_status is not None:
+        m = mesh_status()
+        lines.append("-- mesh (multi-chip admission) --")
+        bk = m.get("buckets", {})
+        lines.append(
+            f"shape={m['shape']} devices={m['devices']} "
+            f"placeSeconds={m['placeSeconds']} "
+            f"jitBuckets={bk.get('buckets', 0)} "
+            f"bucketHits={bk.get('hits', 0)}"
+        )
+        for kernel, st in sorted(bk.get("perKernel", {}).items()):
+            lines.append(
+                f"  {kernel}: compiled={st['misses']} reused={st['hits']}"
+            )
+        panel = m.get("panelSchedule") or {}
+        if panel:
+            lines.append(
+                f"  contended panel schedule: widths={panel.get('widths')} "
+                f"fenced={panel.get('fenced')}"
+            )
+        res = m.get("residentEncode") or {}
+        if res:
+            lines.append(
+                f"  resident encode: fullEncodes={res.get('fullEncodes')} "
+                f"deltaRounds={res.get('deltaRounds')} "
+                f"deltaRows={res.get('deltaRows')}"
+            )
     return "\n".join(lines)
 
 

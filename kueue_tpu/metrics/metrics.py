@@ -260,6 +260,29 @@ class Metrics:
         self.pipeline_overlap_ratio.set(0.0)
         self.pipeline_prefetch_discards_total.inc(0.0)
         self.pipeline_inflight.set(0)
+        # multi-chip admission (kueue_tpu/parallel): mesh posture + the
+        # host-side sharding overhead. mesh_devices is 0 while the
+        # server runs single-device (--mesh off or < 2 devices);
+        # allgather_seconds accumulates the wall time spent placing
+        # sharded drain inputs across the mesh (the observable host
+        # cost of sharding — the in-kernel collectives ride device_s).
+        self.mesh_devices = r.gauge(
+            f"{NS}_mesh_devices",
+            "Devices in the active admission mesh (0 = single-device)",
+        )
+        self.mesh_shard_width = r.gauge(
+            f"{NS}_mesh_shard_width",
+            "Queue-axis (wl) shard count of the active admission mesh (0 = single-device)",
+        )
+        self.mesh_allgather_seconds = r.counter(
+            f"{NS}_mesh_allgather_seconds",
+            "Cumulative seconds spent placing/gathering sharded drain inputs across the mesh",
+        )
+        # materialize at zero: the scrape surface is complete before
+        # the first sharded drain (and while the mesh is off)
+        self.mesh_devices.set(0)
+        self.mesh_shard_width.set(0)
+        self.mesh_allgather_seconds.inc(0.0)
         # MultiKueue federation (kueue_tpu/federation): cross-cluster
         # dispatch accounting. clusters_active dropping below the
         # configured cluster count is the paging signal for a partition

@@ -9,6 +9,12 @@ of that discipline. ``KERNEL_MIRRORS`` is the machine-checked registry
 numpy twin or the sequential host scheduler surface — and the test
 module asserting parity. Adding a kernel without registering a mirror,
 or pointing at a mirror/test that does not exist, fails CI.
+
+Mesh-sharded launches change NOTHING here: mirrors are mesh-agnostic,
+so a sharded kernel answers to the same mirror as its single-device
+twin. ``kueue_tpu.parallel.SHARDED_KERNELS`` is the companion registry
+of sharded entry points; the same lint asserts every entry there also
+appears below and resolves.
 """
 
 from __future__ import annotations

@@ -1024,7 +1024,7 @@ def solve_drain_tas(
             return (usage, tas_u_s), admit
 
         (_, tas_u), admit_sn = lax.scan(
-            step, (usage0, tas_u), jnp.arange(n_steps)
+            step, (usage0, tas_u), jnp.arange(n_steps, dtype=jnp.int32)
         )
         safe_idx = jnp.where(mat1 >= 0, mat1, q)
         admitted = (

@@ -1,5 +1,29 @@
-"""Device-mesh sharding of the admission solver."""
+"""Device-mesh sharding of the admission solver and the drain family.
 
+``sharded_solver`` owns the placement specs (which tensor shards along
+which mesh axis); ``harness`` owns everything shared around them: mesh
+resolution for the server's ``--mesh`` flag, jit-bucket + placement
+accounting, the narrow-panel GSPMD probe, and the sharded-entry-point
+registry linted against ``ops.KERNEL_MIRRORS``.
+"""
+
+from kueue_tpu.parallel.harness import (
+    SHARDED_KERNELS,
+    bucket_stats,
+    mesh_safe_widths,
+    mesh_shape_str,
+    narrow_panels_supported,
+    resolve_mesh,
+)
 from kueue_tpu.parallel.sharded_solver import ShardedSolver, make_mesh
 
-__all__ = ["ShardedSolver", "make_mesh"]
+__all__ = [
+    "SHARDED_KERNELS",
+    "ShardedSolver",
+    "bucket_stats",
+    "make_mesh",
+    "mesh_safe_widths",
+    "mesh_shape_str",
+    "narrow_panels_supported",
+    "resolve_mesh",
+]

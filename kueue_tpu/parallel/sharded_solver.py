@@ -287,6 +287,120 @@ def place_preempt_drain_inputs(mesh, tree, local_usage, queues, victims, paths):
     return tree_d, local_d, queues_d, jax.device_put(victims, v_specs), paths_d
 
 
+def place_fair_preempt_drain_inputs(
+    mesh, tree, local_usage, queues, victims, fairp, paths
+):
+    """device_put for the fair-preemption drain: the classic preempt
+    placement (per-queue tensors + SegVictims' per-queue config sharded
+    along ``wl``, candidate pools replicated) plus the FairSegPanels
+    replicated — every panel tensor lives in SEGMENT space [S, ...],
+    and the tournament reduces over whole root cohorts on every shard
+    (separate roots are independent; GSPMD resolves the panel-state
+    scatters exactly like the fair drain's node-space ones)."""
+    tree_d, local_d, queues_d, victims_d, paths_d = (
+        place_preempt_drain_inputs(mesh, tree, local_usage, queues,
+                                   victims, paths)
+    )
+    f_specs = type(fairp)(
+        **{
+            name: _sh(mesh, *([None] * getattr(fairp, name).ndim))
+            for name in fairp._fields
+        }
+    )
+    return (
+        tree_d, local_d, queues_d, victims_d,
+        jax.device_put(fairp, f_specs), paths_d,
+    )
+
+
+# TASHeads fields indexed by queue (sharded along ``wl``); the merged
+# domain forest (leaf_flavor / parent_map, and the topo_free /
+# tas_usage0 / seg_ids companions) stays replicated — every shard's
+# queues place into the same forest and GSPMD resolves the leaf-usage
+# scatters of the sequential placement scan.
+TAS_Q_FIELDS = (
+    "t_is", "t_req", "t_count", "t_level", "t_mode", "t_top",
+    "t_flavor", "t_bad",
+)
+
+
+def pad_tas_arrays(theads_np: dict, q_target: int) -> dict:
+    """Pad TASHeads' per-queue arrays to the mesh-padded Q with inert
+    rows (t_is False — the kernel never touches them; zero requests)."""
+    import numpy as np
+
+    q = theads_np["t_is"].shape[0]
+    if q_target == q:
+        return theads_np
+    pad = q_target - q
+    out = dict(theads_np)
+    for name in TAS_Q_FIELDS:
+        arr = theads_np[name]
+        block = np.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)
+        out[name] = np.concatenate([arr, block])
+    return out
+
+
+def place_tas_drain_inputs(
+    mesh, tree, local_usage, queues, paths,
+    topo_free, tas_usage0, seg_ids, theads,
+):
+    """device_put for the TAS drain: heavy per-queue tensors (ndim >= 2
+    — cells/qty/cursors, TASHeads' request matrices) sharded along
+    ``wl``; the merged domain forest replicated (every shard's queues
+    place into the same forest and GSPMD resolves the placement scan's
+    leaf-usage scatters).
+
+    GSPMD fence: the 1-D per-queue control vectors (cq_rows, qlen,
+    retry_cap, the policy flags, t_is/t_top/t_flavor) stay REPLICATED
+    here — sharding any of them trips a partitioner miscompile in this
+    kernel's admission scan (a mixed s64/s32 index compare in the
+    partitioned dynamic_update_slice; hlo-verifier rejection observed
+    on the 8-device CPU mesh, same family as the narrow-panel
+    compaction bug). They are O(Q) scalars, so replicating them costs
+    nothing next to the [Q,L,P,K,C] candidate tensors that DO shard;
+    decision parity is asserted in tests/test_mesh_drain.py."""
+    rep2 = _sh(mesh, None, None)
+    tree_d = jax.device_put(
+        tree,
+        QuotaTree(
+            parent=_sh(mesh, None), level_mask=rep2, nominal=rep2,
+            lending_limit=rep2, borrowing_limit=rep2,
+        ),
+    )
+    q_specs = type(queues)(
+        **{
+            name: (
+                _sh(mesh, "wl", *([None] * (getattr(queues, name).ndim - 1)))
+                if getattr(queues, name).ndim >= 2
+                else _sh(mesh, *([None] * getattr(queues, name).ndim))
+            )
+            for name in queues._fields
+        }
+    )
+    rep = lambda a: jax.device_put(  # noqa: E731
+        a, _sh(mesh, *([None] * a.ndim))
+    )
+    t_specs = type(theads)(
+        **{
+            name: (
+                _sh(mesh, "wl", *([None] * (getattr(theads, name).ndim - 1)))
+                if name in TAS_Q_FIELDS and getattr(theads, name).ndim >= 2
+                else _sh(mesh, *([None] * getattr(theads, name).ndim))
+            )
+            for name in theads._fields
+        }
+    )
+    return (
+        tree_d,
+        jax.device_put(local_usage, rep2),
+        jax.device_put(queues, q_specs),
+        jax.device_put(paths, rep2),
+        rep(topo_free), rep(tas_usage0), rep(seg_ids),
+        jax.device_put(theads, t_specs),
+    )
+
+
 def place_fair_drain_extras(mesh, depth_of, weight, lendable, res_of_fr):
     """device_put the fair drain's node-space extras replicated (the
     tournament reduces over the whole cohort forest on every shard;

@@ -215,6 +215,18 @@ def main(argv=None) -> int:
         "overlap sooner but pay more dispatch round trips",
     )
     parser.add_argument(
+        "--mesh", default="off", metavar="auto|N|off",
+        help="multi-chip admission (kueue_tpu/parallel): shard every "
+        "drain-family device launch over a (wl[, fr]) device mesh — "
+        "auto = all local devices, N = the first N, off = "
+        "single-device (the default). Composes with --pipeline "
+        "(prefetched launches ride the same sharded path) and with "
+        "the solver guard (host mirrors are mesh-agnostic). Falls "
+        "back to single-device when fewer than 2 devices resolve; "
+        "multi-host meshes need jax.distributed.initialize() before "
+        "startup (deploy/README 'Multi-chip admission')",
+    )
+    parser.add_argument(
         "--panel-widths", default=None, metavar="W1,W2",
         help="fixed victim-search panel-width schedule for the "
         "contended drain (e.g. '16,64': narrow cost-ordered panel "
@@ -320,6 +332,24 @@ def main(argv=None) -> int:
             tuple(int(w) for w in args.panel_widths.split(","))
         )
 
+    mesh = None
+    if args.mesh and args.mesh != "off":
+        from kueue_tpu.parallel import mesh_shape_str, resolve_mesh
+
+        mesh = resolve_mesh(args.mesh)
+        if mesh is None:
+            print(
+                f"--mesh {args.mesh}: fewer than 2 devices resolve; "
+                "running single-device",
+                flush=True,
+            )
+        else:
+            print(
+                f"multi-chip admission: mesh {mesh_shape_str(mesh)} over "
+                f"{mesh.size} devices",
+                flush=True,
+            )
+
     def build_runtime():
         """Construct a runtime exactly the way startup does — also used
         to REBUILD on promotion, so a promoted standby starts from the
@@ -340,6 +370,7 @@ def main(argv=None) -> int:
                 rt.guard.config.mode = args.solver_path
             rt.drain_pipeline = args.pipeline
             rt.pipeline_chunk_cycles = max(1, args.pipeline_chunk_cycles)
+            rt.set_mesh(mesh)
             return rt
         from kueue_tpu.controllers import ClusterRuntime
 
@@ -348,6 +379,7 @@ def main(argv=None) -> int:
             solver_path=args.solver_path,
             drain_pipeline=args.pipeline,
             pipeline_chunk_cycles=args.pipeline_chunk_cycles,
+            mesh=mesh,
         )
 
     journal_opts = {

@@ -158,9 +158,14 @@ def dashboard_payload(rt) -> dict:
     pipe_stats = getattr(rt, "pipeline", None)
     pipeline = pipe_stats.to_dict() if pipe_stats is not None else {}
     pipeline["mode"] = getattr(rt, "drain_pipeline", "off")
+    # mesh badge (kueue_tpu/parallel): multi-chip admission posture —
+    # active mesh shape, device count, jit-bucket reuse
+    mesh_status = getattr(rt, "mesh_status", None)
+    mesh = mesh_status() if mesh_status is not None else {"shape": "off", "devices": 0}
     return {
         "solver": solver,
         "pipeline": pipeline,
+        "mesh": mesh,
         "clusterQueues": cqs,
         "localQueues": lqs,
         "workloads": workloads,
@@ -235,7 +240,8 @@ DASHBOARD_HTML = """<!doctype html>
 <h1>kueue-tpu</h1>
 <div class="muted">control-plane dashboard &middot; <span id="mode" class="poll">connecting&hellip;</span>
  &middot; solver <span id="solver" class="badge">&hellip;</span>
- &middot; pipeline <span id="pipeline" class="badge">&hellip;</span></div>
+ &middot; pipeline <span id="pipeline" class="badge">&hellip;</span>
+ &middot; mesh <span id="mesh" class="badge">&hellip;</span></div>
 <div class="tiles" id="tiles"></div>
 <h2>Last cycle</h2><div id="cycle"></div>
 <h2>ClusterQueues</h2><div id="cqs"></div>
@@ -288,6 +294,13 @@ function render(d){
       `commits=${pl.commits||0} discards=${pl.discards||0} `+
       `inflight=${pl.inflight||0}`;
   }
+  const ms = d.mesh||{};
+  const msEl = document.getElementById('mesh');
+  msEl.className = 'badge '+(ms.devices>1 ? 'device' : 'host');
+  msEl.textContent = ms.devices>1 ? `${ms.shape} · ${ms.devices} devices` : 'off';
+  const bk = (ms.buckets||{});
+  msEl.title = `jit buckets: ${bk.buckets||0} compiled, ${bk.hits||0} reuses; `+
+    `place=${ms.placeSeconds||0}s`;
   const st = d.workloadStates||{};
   document.getElementById('tiles').innerHTML =
     [['ClusterQueues',d.clusterQueues.length],['LocalQueues',d.localQueues.length],

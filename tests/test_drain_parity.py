@@ -237,6 +237,30 @@ class TestKernelMirrorRegistry:
         stale = set(KERNEL_MIRRORS) - self._kernel_modules()
         assert not stale, f"registry entries with no kernel file: {stale}"
 
+    def test_sharded_entry_points_share_the_single_device_mirror(self):
+        """PR-8 extension: every kernel with a mesh path
+        (parallel.SHARDED_KERNELS) must be registered here too — a
+        sharded launch answers to the SAME host mirror as its
+        single-device twin (mirrors are mesh-agnostic), so the guard's
+        failover and the pipelined drain's divergence sampling never
+        change with the mesh. A sharded entry without a mirror, or one
+        that does not resolve, fails CI."""
+        import importlib
+
+        from kueue_tpu.ops import KERNEL_MIRRORS
+        from kueue_tpu.parallel import SHARDED_KERNELS
+
+        missing = set(SHARDED_KERNELS) - set(KERNEL_MIRRORS)
+        assert not missing, (
+            f"sharded kernels without a registered host mirror: {missing}"
+        )
+        for kernel, entry in SHARDED_KERNELS.items():
+            mod_name, attr = entry.split(":")
+            mod = importlib.import_module(mod_name)
+            assert hasattr(mod, attr), (
+                f"{kernel}: sharded entry point {entry} does not resolve"
+            )
+
     def test_mirrors_resolve_and_tests_exist(self):
         import importlib
         from pathlib import Path
