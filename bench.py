@@ -1673,6 +1673,343 @@ def federation_bench(rng, n_workers=3, n_wl=120, worker_cpu=200):
     )
 
 
+def serve_bench(
+    rng,
+    duration_s=4.0,
+    rate_per_s=80.0,
+    n_readers=3,
+    n_cq=8,
+    quota_cpu=16,
+):
+    """Sustained arrival-stream serving A/B (the ISSUE-9 guardrail):
+    an open-loop Poisson arrival stream (perf/generator.ArrivalProcess)
+    of mixed small/medium workloads is POSTed against a live journaled
+    leader while an admission loop drains it and reader threads hammer
+    the visibility/health surface — phase A with the readers on the
+    LEADER (no replica attached), phase B with a journal-tailing READ
+    REPLICA attached and the readers moved there. Reports admission
+    throughput, decision-latency percentiles (submit -> Admitted, wall
+    clock), read QPS offloaded, max replica staleness, and the leader
+    admission-loop regression from attaching the replica. At the end
+    of phase B the drained leader and caught-up replica state dumps
+    are asserted byte-identical (the convergence acceptance check).
+
+    Host nomination path on purpose: the measured surface is serving +
+    journal + replication, and a one-off device compile landing in
+    phase A would bias the A/B. The replica runs as a SEPARATE
+    PROCESS (``python -m kueue_tpu.server --replica-of``) — the
+    production topology — so the leader pays exactly the real
+    attachment cost (serving the replication feed), not the replica's
+    own replay work."""
+    import socket
+    import tempfile
+    import threading
+
+    from kueue_tpu import serialization as ser
+    from kueue_tpu.controllers import ClusterRuntime
+    from kueue_tpu.perf.generator import ArrivalProcess, arrival_stream
+    from kueue_tpu.server import KueueServer
+    from kueue_tpu.server.client import KueueClient
+    from kueue_tpu.storage import Journal
+
+    def cq_dict(name):
+        return {
+            "name": name,
+            "namespaceSelector": {},
+            "resourceGroups": [
+                {
+                    "coveredResources": ["cpu"],
+                    "flavors": [
+                        {
+                            "name": "default",
+                            "resources": [
+                                {"name": "cpu",
+                                 "nominalQuota": str(quota_cpu)}
+                            ],
+                        }
+                    ],
+                }
+            ],
+        }
+
+    proc = ArrivalProcess(
+        rate_per_s=rate_per_s, duration_s=duration_s, process="poisson"
+    )
+
+    def run_phase(with_replica: bool, phase_rng) -> dict:
+        tmp = tempfile.mkdtemp(prefix="kueue-serve-")
+        rt = ClusterRuntime(use_solver=False, bulk_drain_threshold=None)
+        journal = Journal(os.path.join(tmp, "journal")).open()
+        rt.attach_journal(journal)
+        from kueue_tpu.models import LocalQueue, ResourceFlavor
+
+        rt.add_flavor(ResourceFlavor(name="default"))
+        lq_names = []
+        for i in range(n_cq):
+            rt.add_cluster_queue(ser.cq_from_dict(cq_dict(f"cq-{i}")))
+            lq = LocalQueue(
+                namespace="perf", name=f"lq-{i}", cluster_queue=f"cq-{i}"
+            )
+            rt.add_local_queue(lq)
+            lq_names.append(lq.name)
+        srv = KueueServer(runtime=rt, auto_reconcile=False)
+        port = srv.start()
+        leader_url = f"http://127.0.0.1:{port}"
+        rep_proc = None
+        read_url = leader_url
+        if with_replica:
+            with socket.socket() as s:  # pre-pick a free port
+                s.bind(("127.0.0.1", 0))
+                rport = s.getsockname()[1]
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            rep_proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "kueue_tpu.server",
+                    "--replica-of", leader_url,
+                    "--port", str(rport),
+                    "--replica-poll-interval", "0.05",
+                    "--replica-id", "bench-replica",
+                ],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            read_url = f"http://127.0.0.1:{rport}"
+            probe = KueueClient(read_url, timeout=2.0)
+            deadline = time.perf_counter() + 60.0
+            while time.perf_counter() < deadline:
+                try:
+                    if not probe.healthz().get("replication", {}).get(
+                        "lastError"
+                    ):
+                        break
+                except Exception:  # noqa: BLE001 — still booting
+                    pass
+                time.sleep(0.2)
+            else:
+                rep_proc.kill()
+                raise RuntimeError("replica subprocess never became healthy")
+
+        stream = arrival_stream(proc, lq_names, phase_rng)
+        stop = threading.Event()
+        submit_ts: dict = {}
+        admit_lat: list = []
+        cycle_times: list = []
+        due: dict = {}  # key -> wall time its service completes
+        seen_admitted: set = set()
+        reads = [0] * n_readers
+        read_errors = [0]
+        max_lag = [0.0]
+
+        rep_status: dict = {}
+
+        def admission_loop():
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                with srv.lock:
+                    srv.runtime.run_until_idle()
+                    now = time.perf_counter()
+                    for key, wl in list(srv.runtime.workloads.items()):
+                        if wl.is_admitted and key not in seen_admitted:
+                            seen_admitted.add(key)
+                            if key in submit_ts:
+                                admit_lat.append(now - submit_ts[key])
+                            due[key] = now + float(
+                                wl.labels.get("bench/runtime-s", 0.2)
+                                if wl.labels else 0.2
+                            )
+                    # service completion: finished workloads release
+                    # their quota (mixed arrival/FINISH/query traffic)
+                    for key, t_done in list(due.items()):
+                        if now >= t_done:
+                            wl = srv.runtime.workloads.get(key)
+                            if wl is not None:
+                                srv.runtime.delete_workload(wl)
+                            due.pop(key, None)
+                cycle_times.append(time.perf_counter() - t0)
+                stop.wait(0.01)
+
+        def lag_sampler():
+            client = KueueClient(read_url, timeout=2.0)
+            while not stop.is_set():
+                try:
+                    detail = client.healthz().get("replication", {})
+                    rep_status.update(detail)
+                    max_lag[0] = max(
+                        max_lag[0], float(detail.get("lagSeconds", 0.0))
+                    )
+                except Exception:  # noqa: BLE001 — sampler only
+                    pass
+                stop.wait(0.2)
+
+        def reader_loop(idx: int):
+            client = KueueClient(read_url, timeout=5.0)
+            i = 0
+            while not stop.is_set():
+                try:
+                    if i % 3 == 2:
+                        client.healthz()
+                    else:
+                        client.pending_workloads_cq(f"cq-{i % n_cq}")
+                    reads[idx] += 1
+                except Exception:  # noqa: BLE001 — count and continue
+                    read_errors[0] += 1
+                i += 1
+
+        threads = [threading.Thread(target=admission_loop, daemon=True)]
+        threads += [
+            threading.Thread(target=reader_loop, args=(i,), daemon=True)
+            for i in range(n_readers)
+        ]
+        if rep_proc is not None:
+            threads.append(
+                threading.Thread(target=lag_sampler, daemon=True)
+            )
+        for t in threads:
+            t.start()
+        writer = KueueClient(leader_url, timeout=10.0)
+        t_start = time.perf_counter()
+        for gw in stream:
+            delay = gw.creation_s - (time.perf_counter() - t_start)
+            if delay > 0:
+                time.sleep(delay)
+            d = ser.workload_to_dict(gw.workload)
+            d.setdefault("labels", {})["bench/runtime-s"] = str(
+                gw.runtime_s
+            )
+            submit_ts[f"perf/{gw.workload.name}"] = time.perf_counter()
+            writer.apply("workloads", d)
+        wall = time.perf_counter() - t_start
+        # drain the tail: stop arrivals, let admission finish the rest
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            with srv.lock:
+                backlog = sum(
+                    1
+                    for wl in srv.runtime.workloads.values()
+                    if not wl.is_admitted
+                )
+            if backlog == 0:
+                break
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        converged = None
+        records_applied = None
+        if rep_proc is not None:
+            # quiescent convergence: replica caught up to the leader's
+            # journal head serves a byte-identical state dump
+            probe = KueueClient(read_url, timeout=5.0)
+            deadline = time.perf_counter() + 15.0
+            while time.perf_counter() < deadline:
+                try:
+                    detail = probe.healthz().get("replication", {})
+                    if detail.get("appliedSeq", -1) >= journal.last_seq:
+                        rep_status.update(detail)
+                        break
+                except Exception:  # noqa: BLE001 — keep waiting
+                    pass
+                time.sleep(0.1)
+            records_applied = rep_status.get("recordsApplied")
+            leader_state = json.dumps(
+                KueueClient(leader_url).state(), sort_keys=True
+            )
+            replica_state = json.dumps(probe.state(), sort_keys=True)
+            converged = leader_state == replica_state
+            rep_proc.terminate()
+            rep_proc.wait(timeout=15)
+        srv.stop()
+        journal.close()
+        lat_ms = sorted(x * 1e3 for x in admit_lat)
+
+        def pct(p):
+            if not lat_ms:
+                return None
+            return round(lat_ms[min(len(lat_ms) - 1,
+                                    int(p * len(lat_ms)))], 3)
+
+        return {
+            "submitted": len(stream),
+            "admitted": len(seen_admitted),
+            "admissions_per_s": round(len(seen_admitted) / wall, 1),
+            "lat_p50_ms": pct(0.50),
+            "lat_p95_ms": pct(0.95),
+            "cycle_ms": round(
+                float(np.median(cycle_times)) * 1e3, 3
+            ) if cycle_times else None,
+            "read_qps": round(sum(reads) / wall, 1),
+            "read_errors": read_errors[0],
+            "max_lag_s": (
+                round(max_lag[0], 3) if rep_proc is not None else None
+            ),
+            "records_applied": records_applied,
+            "converged": converged,
+        }
+
+    _stage("serve: phase A (no replica, readers on leader)")
+    base = run_phase(False, np.random.default_rng(rng.integers(1 << 30)))
+    _stage("serve: phase B (replica attached, readers on replica)")
+    with_rep = run_phase(True, np.random.default_rng(rng.integers(1 << 30)))
+    assert with_rep["converged"], (
+        "replica state dump != leader state dump at quiescence"
+    )
+    assert with_rep["max_lag_s"] is not None and with_rep["max_lag_s"] < 2.0, (
+        f"replica staleness {with_rep['max_lag_s']}s exceeds the 2s bound"
+    )
+    assert with_rep["admitted"] == with_rep["submitted"], (
+        "serve phase B did not drain to quiescence"
+    )
+    return base, with_rep
+
+
+def _stage_serve() -> dict:
+    base, with_rep = serve_bench(np.random.default_rng(14))
+    reg_pct = (
+        (with_rep["cycle_ms"] / base["cycle_ms"] - 1.0) * 100.0
+        if base["cycle_ms"] else 0.0
+    )
+    return {
+        "serve_metric": (
+            "sustained_arrival_stream_serving (open-loop Poisson "
+            "arrivals at 80/s of mixed 1/5-cpu workloads against a "
+            "journaled leader + admission loop, 3 reader threads on "
+            "visibility/healthz; phase A readers on the leader, phase "
+            "B a journal-tailing read replica attached and the readers "
+            "moved there; leader+replica state dumps asserted "
+            "byte-identical at quiescence; "
+            f"{with_rep['admitted']} admitted in phase B)"
+        ),
+        # headline: median submit->Admitted decision latency with the
+        # replica attached — the number "serving heavy traffic" feels
+        "serve_value": with_rep["lat_p50_ms"],
+        "serve_unit": "ms (p50 decision latency, replica attached)",
+        "serve_admissions_per_s": with_rep["admissions_per_s"],
+        "serve_lat_p95_ms": with_rep["lat_p95_ms"],
+        "serve_read_qps": with_rep["read_qps"],
+        "serve_reads_offloaded_per_s": with_rep["read_qps"],
+        "serve_max_lag_s": with_rep["max_lag_s"],
+        "serve_records_applied": with_rep["records_applied"],
+        "serve_cycle_ms": with_rep["cycle_ms"],
+        "serve_cycle_ms_no_replica": base["cycle_ms"],
+        # honest caveat: the replica runs as a second PROCESS; on a
+        # box with few cores it competes with the leader for CPU, so
+        # this regression number bounds feed-serving overhead only on
+        # multi-core hosts (production topology: separate machines)
+        "serve_cycle_regression_pct": round(reg_pct, 1),
+        "serve_host_cores": os.cpu_count(),
+        "serve_read_errors": with_rep["read_errors"],
+        "serve_baseline": {
+            "admissions_per_s": base["admissions_per_s"],
+            "lat_p50_ms": base["lat_p50_ms"],
+            "lat_p95_ms": base["lat_p95_ms"],
+            "read_qps": base["read_qps"],
+        },
+    }
+
+
 def _stage(msg: str):
     """Progress marker on STDERR (the driver only parses stdout JSON);
     lets a timed-out payload show which stage it died in."""
@@ -2100,7 +2437,88 @@ STAGES = {
     "journal": _stage_journal,
     "failover": _stage_failover,
     "federation": _stage_federation,
+    "serve": _stage_serve,
 }
+
+# ---- the BENCH_*.json compact-line contract ----
+# Stages that can run alone (SINGLE_STAGE_MODES) publish their headline
+# through the "<stage>_value"/"<stage>_metric"/"<stage>_unit" triple;
+# finalize_headline() promotes the first present one into the top-level
+# value/metric/unit so the compact last line ALWAYS carries headline_ms
+# + backend. compact_line() then folds the per-stage extras in. Both
+# are pure functions over the record dict — tests/test_bench_schema.py
+# lints every registered mode against the contract, so a new stage
+# cannot silently drift from it.
+HEADLINE_FALLBACK_STAGES = (
+    "planner",
+    "journal",
+    "failover",
+    "pipeline",
+    "federation",
+    "sharded",
+    "serve",
+)
+
+# record key -> compact-line key (folded in order; a single-stage run
+# carries exactly its own extras)
+COMPACT_EXTRAS = (
+    ("planner_scenarios_per_s", "scenarios_per_s"),
+    ("journal_appends_per_s", "appends_per_s"),
+    ("failover_divergence_overhead_pct", "divergence_overhead_pct"),
+    ("federation_admissions_per_s", "admissions_per_s"),
+    ("pipeline_speedup_vs_serial", "pipeline_speedup"),
+    ("sharded_n_devices", "n_devices"),
+    ("sharded_speedup", "sharded_speedup"),
+    ("serve_admissions_per_s", "admissions_per_s"),
+    ("serve_read_qps", "read_qps"),
+    ("serve_max_lag_s", "max_lag_s"),
+)
+
+# CLI flag -> the stage list it runs (one-stage modes)
+SINGLE_STAGE_MODES = {
+    "--planner": ["planner"],
+    "--journal": ["journal"],
+    "--failover": ["failover"],
+    "--pipeline": ["pipeline"],
+    "--sharded": ["sharded"],
+    "--federation": ["federation"],
+    "--serve": ["serve"],
+}
+
+
+def finalize_headline(record: dict) -> dict:
+    """Promote a single-stage run's metric triple to the headline slot
+    (no-op when the headline stage ran); guarantee the value/metric/
+    unit keys exist even when every stage failed."""
+    for name in HEADLINE_FALLBACK_STAGES:
+        if "value" in record:
+            break
+        if f"{name}_value" in record:
+            record.setdefault("metric", record.get(f"{name}_metric"))
+            record.setdefault("value", record[f"{name}_value"])
+            record.setdefault("unit", record.get(f"{name}_unit"))
+    if "value" not in record:
+        # the HEADLINE stage failed but others succeeded: keep every
+        # completed stage's metrics (stage isolation's whole point) and
+        # mark the headline fields as missing
+        record.setdefault("metric", "full_drain_cycle_latency (stage failed)")
+        record.setdefault("value", None)
+        record.setdefault("unit", "ms/cycle")
+        record.setdefault("vs_baseline", None)
+    return record
+
+
+def compact_line(record: dict) -> dict:
+    """The tail-truncation-proof last line: always headline_ms +
+    backend, plus whichever per-stage extras the record carries."""
+    compact = {
+        "headline_ms": record.get("value"),
+        "backend": record.get("backend"),
+    }
+    for src, dst in COMPACT_EXTRAS:
+        if src in record:
+            compact[dst] = record[src]
+    return compact
 
 
 def payload_main(stage_names=None):
@@ -2265,50 +2683,7 @@ def driver_main(stage_names=None):
         )
         print(json.dumps({"headline_ms": None, "backend": "error"}))
         sys.exit(1)
-    if "value" not in record and "planner_value" in record:
-        # planner-only invocation (--planner): its per-scenario latency
-        # IS the headline
-        record.setdefault("metric", record.get("planner_metric"))
-        record.setdefault("value", record["planner_value"])
-        record.setdefault("unit", record.get("planner_unit"))
-    if "value" not in record and "journal_value" in record:
-        # journal-only invocation (--journal): the journaled-cycle
-        # latency IS the headline
-        record.setdefault("metric", record.get("journal_metric"))
-        record.setdefault("value", record["journal_value"])
-        record.setdefault("unit", record.get("journal_unit"))
-    if "value" not in record and "failover_value" in record:
-        # failover-only invocation (--failover): the during-outage
-        # cycle latency IS the headline
-        record.setdefault("metric", record.get("failover_metric"))
-        record.setdefault("value", record["failover_value"])
-        record.setdefault("unit", record.get("failover_unit"))
-    if "value" not in record and "pipeline_value" in record:
-        # pipeline-only invocation (--pipeline): the pipelined full
-        # drain wall-clock IS the headline
-        record.setdefault("metric", record.get("pipeline_metric"))
-        record.setdefault("value", record["pipeline_value"])
-        record.setdefault("unit", record.get("pipeline_unit"))
-    if "value" not in record and "federation_value" in record:
-        # federation-only invocation (--federation): the dispatch
-        # fan-out latency IS the headline
-        record.setdefault("metric", record.get("federation_metric"))
-        record.setdefault("value", record["federation_value"])
-        record.setdefault("unit", record.get("federation_unit"))
-    if "value" not in record and "sharded_value" in record:
-        # sharded-only invocation (--sharded): the mesh drain cycle
-        # latency IS the headline
-        record.setdefault("metric", record.get("sharded_metric"))
-        record.setdefault("value", record["sharded_value"])
-        record.setdefault("unit", record.get("sharded_unit"))
-    if "value" not in record:
-        # the HEADLINE stage failed but others succeeded: keep every
-        # completed stage's metrics (stage isolation's whole point) and
-        # mark the headline fields as missing
-        record.setdefault("metric", "full_drain_cycle_latency (stage failed)")
-        record.setdefault("value", None)
-        record.setdefault("unit", "ms/cycle")
-        record.setdefault("vs_baseline", None)
+    finalize_headline(record)
     n_tpu = sum(1 for b in stage_backend.values() if b == "tpu")
     if n_tpu == len(stage_backend):
         record["backend"] = "tpu"
@@ -2325,23 +2700,7 @@ def driver_main(stage_names=None):
     # compact headline LAST: the BENCH artifact is tail-truncated, so
     # the final line must always carry the essential numbers even when
     # the full record above gets cut
-    compact = {"headline_ms": record.get("value"), "backend": record["backend"]}
-    if "planner_scenarios_per_s" in record:
-        compact["scenarios_per_s"] = record["planner_scenarios_per_s"]
-    if "journal_appends_per_s" in record:
-        compact["appends_per_s"] = record["journal_appends_per_s"]
-    if "failover_divergence_overhead_pct" in record:
-        compact["divergence_overhead_pct"] = record[
-            "failover_divergence_overhead_pct"
-        ]
-    if "federation_admissions_per_s" in record:
-        compact["admissions_per_s"] = record["federation_admissions_per_s"]
-    if "pipeline_speedup_vs_serial" in record:
-        compact["pipeline_speedup"] = record["pipeline_speedup_vs_serial"]
-    if "sharded_speedup" in record:
-        compact["n_devices"] = record.get("sharded_n_devices")
-        compact["sharded_speedup"] = record["sharded_speedup"]
-    print(json.dumps(compact))
+    print(json.dumps(compact_line(record)))
 
 
 TPU_BUDGET_S = 1800
@@ -2361,38 +2720,17 @@ if __name__ == "__main__":
         if "--stage" in sys.argv:
             stage_names = [sys.argv[sys.argv.index("--stage") + 1]]
         payload_main(stage_names)
-    elif "--planner" in sys.argv:
-        # planner-only mode: one stage, compact last line carries
-        # {"headline_ms", "backend", "scenarios_per_s"}
-        driver_main(["planner"])
-    elif "--journal" in sys.argv:
-        # journal-only mode: append+fsync overhead per admission cycle,
-        # compact last line carries {"headline_ms", "backend",
-        # "appends_per_s"}
-        driver_main(["journal"])
-    elif "--failover" in sys.argv:
-        # failover-only mode: steady-state vs device-outage vs
-        # recovered cycle latency + divergence-check overhead, compact
-        # last line carries {"headline_ms", "backend",
-        # "divergence_overhead_pct"}
-        driver_main(["failover"])
-    elif "--pipeline" in sys.argv:
-        # pipeline-only mode: the double-buffered vs serial drain-loop
-        # A/B at 50k pending; compact last line carries
-        # {"headline_ms", "backend", "pipeline_speedup"}
-        driver_main(["pipeline"])
-    elif "--sharded" in sys.argv:
-        # sharded-only mode: 1-device vs mesh A/B on the 50k plain
-        # drain + the contended reclaim drain, admitted sets asserted
-        # bit-for-bit equal; compact last line carries
-        # {"headline_ms", "backend", "n_devices", "sharded_speedup"}
-        driver_main(["sharded"])
-    elif "--federation" in sys.argv:
-        # federation-only mode: 3 in-process workers behind the
-        # dispatcher — dispatch fan-out latency + federated admission
-        # throughput, federated admitted set == single-cluster
-        # reference asserted; compact last line carries
-        # {"headline_ms", "backend", "admissions_per_s"}
-        driver_main(["federation"])
     else:
-        driver_main()
+        # one-stage modes (--planner, --journal, --failover,
+        # --pipeline, --sharded, --federation, --serve): the stage's
+        # metric triple becomes the headline (finalize_headline) and
+        # its COMPACT_EXTRAS ride the compact last line — e.g. --serve
+        # emits {"headline_ms", "backend", "admissions_per_s",
+        # "read_qps", "max_lag_s"}. The registry is linted in
+        # tests/test_bench_schema.py.
+        for flag, stages in SINGLE_STAGE_MODES.items():
+            if flag in sys.argv:
+                driver_main(stages)
+                break
+        else:
+            driver_main()

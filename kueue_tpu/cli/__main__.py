@@ -83,6 +83,16 @@ def _server_client(args):
     )
 
 
+def _replica_note(client) -> None:
+    """After a read against --server: tell the operator when the
+    answer came from a read replica (and how stale it may be) — on
+    stderr so piped/table output stays parseable."""
+    if getattr(client, "served_by_replica", False):
+        lag = client.last_replica_lag_s
+        detail = f", lag {lag:.2f}s behind leader" if lag is not None else ""
+        print(f"(replica{detail})", file=sys.stderr)
+
+
 def _add_server_flags(parser, server_help):
     """--server plus its credential/trust companions (the kubeconfig
     server/token/certificate-authority triple for the CLI)."""
@@ -422,7 +432,9 @@ def cmd_pending_workloads(state: State, args) -> None:
     if getattr(args, "server", None):
         # live query against a running kueue_tpu.server (the reference's
         # kubectl plugin hitting the visibility apiserver)
-        summary = _server_client(args).pending_workloads_cq(args.clusterqueue)
+        client = _server_client(args)
+        summary = client.pending_workloads_cq(args.clusterqueue)
+        _replica_note(client)
         rows = [
             [str(i["positionInClusterQueue"]), i["namespace"], i["name"],
              i["localQueueName"], str(i["priority"]),
@@ -512,6 +524,7 @@ def cmd_explain(state: State, args) -> None:
         wl_dict = client.get_workload(ns, name)
         wl = ser.workload_from_dict(wl_dict)
         rows = client.workload_decisions(ns, name).get("items", [])
+        _replica_note(client)
     else:
         rt = state.build_runtime()
         rt.run_until_idle()  # in-memory only: state file is NOT saved
@@ -582,6 +595,53 @@ def cmd_clusters(state: State, args) -> None:
         ["NAME", "STATUS", "WINS", "DISPATCHES", "STRIKES", "LOST-SINCE"],
         rows,
     )
+
+
+def cmd_replicas(state: State, args) -> None:
+    """`kueuectl replicas` — the read-replica roster: on a leader,
+    every follower that polled the replication feed with how far
+    behind it is; pointed at a replica, that replica's own tail
+    status."""
+    if not getattr(args, "server", None):
+        raise SystemExit(
+            "error: `kueuectl replicas` reads a live control plane; "
+            "pass --server http://<leader-or-replica>"
+        )
+    client = _server_client(args)
+    out = client.replicas()
+    if out.get("role") == "replica":
+        rows = [
+            [
+                s.get("id", ""),
+                str(s.get("appliedSeq", 0)),
+                f"{s.get('lagSeconds', 0.0):.3f}s",
+                str(s.get("resyncs", 0)),
+                str(s.get("recordsApplied", 0)),
+                s.get("lastError", "") or "-",
+            ]
+            for s in out.get("items", [])
+        ]
+        _print_table(
+            ["ID", "APPLIED-SEQ", "LAG", "RESYNCS", "RECORDS", "LAST-ERROR"],
+            rows,
+        )
+        print(f"(replica of {out['items'][0].get('leader', '?')})"
+              if out.get("items") else "(replica)")
+        return
+    rows = [
+        [
+            r.get("id", ""),
+            str(r.get("appliedSeq", 0)),
+            str(r.get("behind", 0)),
+            f"{r.get('lagSeconds', 0.0):.3f}s",
+            f"{r.get('lastSeenAgoS', 0.0):.1f}s ago",
+        ]
+        for r in out.get("items", [])
+    ]
+    _print_table(
+        ["ID", "APPLIED-SEQ", "BEHIND", "LAG", "LAST-POLL"], rows
+    )
+    print(f"leader journal head: seq {out.get('lastSeq', 0)}")
 
 
 # ---- plan (the what-if capacity planner) ----
@@ -659,12 +719,16 @@ def cmd_plan(state: State, args) -> None:
             "--scenarios"
         )
     if getattr(args, "server", None):
-        report = _server_client(args).plan(
+        client = _server_client(args)
+        report = client.plan(
             scenarios=scenarios,
             workload=target or None,
             cluster_queue=args.clusterqueue or None,
             options=options,
         )
+        # a replica's plan is best-effort-stale by design: its state
+        # trails the leader by the tail poll interval
+        _replica_note(client)
     else:
         from kueue_tpu.planner import Planner, scenario_from_dict
 
@@ -834,6 +898,7 @@ def cmd_events(state: State, args) -> None:
             pass
         return
     out = client.events(args.resource_version)
+    _replica_note(client)
     _print_table(headers, [row(e) for e in out.get("items", [])])
     print(f"resourceVersion: {out.get('resourceVersion', 0)}")
 
@@ -1220,6 +1285,15 @@ def build_parser() -> argparse.ArgumentParser:
     cl.add_argument("action", choices=["list"])
     _add_server_flags(cl, "federation manager to query (required)")
     cl.set_defaults(fn=cmd_clusters)
+
+    repl = sub.add_parser(
+        "replicas",
+        help="read-replica roster: followers tailing this leader's "
+        "journal and how far behind each is (or, against a replica, "
+        "its own tail status)",
+    )
+    _add_server_flags(repl, "leader (or replica) to query (required)")
+    repl.set_defaults(fn=cmd_replicas)
 
     pl = sub.add_parser(
         "plan",

@@ -447,7 +447,7 @@ class ClusterRuntime:
 
     # ---- events ----
     def event(self, kind: str, wl: Workload, message: str = "") -> None:
-        self.events.record(kind, wl.key, message)
+        ev = self.events.record(kind, wl.key, message)
         # status transitions mutate workloads in place (admission set/
         # cleared, check states flipped); the informer cache the
         # reference indexes over sees those as update events, so the
@@ -456,10 +456,15 @@ class ClusterRuntime:
             self.indexer.update(wl.key, wl)
             # the event IS the durable-write moment for in-place status
             # transitions (admission applied, eviction, check flips).
-            # "Pending" is excluded: its condition churn regenerates on
-            # the first post-recovery cycle and would dominate journal
-            # volume on large contended backlogs.
-            if kind != "Pending":
+            # "Pending" journals only when the recorder opens a NEW
+            # (workload, message) series: the first park with a given
+            # reason ships its condition post-state (so recovery — and
+            # journal-tailing read replicas, which never run cycles —
+            # converge on pending conditions too), while the hot
+            # requeue churn that would dominate journal volume on
+            # large contended backlogs dedups into count bumps and
+            # stays out, same bound the event ring itself uses.
+            if kind != "Pending" or ev.count == 1:
                 self._journal_wl(wl)
         self._record_metric_event(kind, wl)
 

@@ -65,6 +65,10 @@ class DecisionRecord:
     count: int = 1
     last_cycle: int = 0
     timestamp: float = 0.0
+    # monotone log position (stamped by DecisionAuditLog.record; a
+    # dedup merge RESTAMPS the merged record) — the replication feed's
+    # resume cursor, exactly the EventRecorder resourceVersion pattern
+    seq: int = 0
 
     def __post_init__(self):
         if self.last_cycle < self.cycle:
@@ -96,6 +100,7 @@ class DecisionRecord:
             "borrowing": self.borrowing,
             "cohort": self.cohort,
             "timestamp": self.timestamp,
+            "seq": self.seq,
         }
         if self.flavors:
             out["flavors"] = self.flavors
@@ -106,6 +111,32 @@ class DecisionRecord:
         if self.topology is not None:
             out["topology"] = self.topology
         return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DecisionRecord":
+        """Wire-dict inverse of ``to_dict`` — the replication ingest
+        half (storage/tailer.py ships audit deltas so a read replica's
+        ``explain`` renders the leader's decision rationale)."""
+        return cls(
+            workload=d["workload"],
+            cluster_queue=d.get("clusterQueue", ""),
+            cycle=int(d.get("cycle", 0)),
+            outcome=d.get("outcome", "Pending"),
+            reason=InadmissibleReason(d.get("reason", "Unknown")),
+            message=d.get("message", ""),
+            resolution=d.get("resolution", "host"),
+            nominated_via=d.get("nominatedVia", "host"),
+            borrowing=bool(d.get("borrowing", False)),
+            cohort=d.get("cohort", ""),
+            flavors=d.get("flavors") or {},
+            flavor_reasons=d.get("flavorReasons") or {},
+            preemption=d.get("preemption"),
+            topology=d.get("topology"),
+            count=int(d.get("count", 1)),
+            last_cycle=int(d.get("lastCycle", 0)),
+            timestamp=float(d.get("timestamp", 0.0)),
+            seq=int(d.get("seq", 0)),
+        )
 
 
 class DecisionAuditLog:
@@ -129,6 +160,16 @@ class DecisionAuditLog:
         self._clock = clock
         self._records: "OrderedDict[str, Deque[DecisionRecord]]" = OrderedDict()
         self._lock = threading.Lock()
+        # monotone stamp of the newest record/merge — the replication
+        # feed cursor (a dedup merge restamps, so "records with seq >
+        # N" always includes every ring entry that CHANGED since N)
+        self.seq = 0
+        # recent-stamp log for O(delta) feed reads: every stamp (new
+        # record or merge restamp) appends here; since() walks the
+        # suffix instead of scanning every tracked ring (the feed polls
+        # this at the replica poll rate). Bounded: a cursor older than
+        # the log's left edge falls back to the full scan.
+        self._stamp_log: Deque = deque(maxlen=8192)
         # called with each incoming record (before dedup-merge), the
         # runtime's metric mirror hangs here
         self.observers: List[Callable[[DecisionRecord], None]] = []
@@ -155,18 +196,76 @@ class DecisionAuditLog:
             self._records.move_to_end(rec.workload)
             while len(self._records) > self.max_workloads:
                 self._records.popitem(last=False)
+            self.seq += 1
             if ring and ring[-1].dedup_key() == rec.dedup_key():
                 latest = ring[-1]
                 latest.count += 1
                 latest.last_cycle = max(latest.last_cycle, rec.last_cycle)
                 latest.timestamp = rec.timestamp
+                latest.seq = self.seq
                 stored = latest
             else:
+                rec.seq = self.seq
                 ring.append(rec)
                 stored = rec
+            self._stamp_log.append((self.seq, stored))
         for cb in list(self.observers):
             cb(rec)
         return stored
+
+    def ingest(self, item: dict) -> None:
+        """Replication ingest (storage/tailer.py): upsert one leader
+        audit record verbatim — seq preserved, observers NOT notified
+        (the metric mirror must count each decision once, on the
+        leader). A repeat of the tail record's dedup key is the
+        leader's count-merge restamp and replaces it in place."""
+        rec = DecisionRecord.from_dict(item)
+        with self._lock:
+            if rec.seq <= self.seq:
+                return  # overlap from a re-poll: already ingested
+            self.seq = rec.seq
+            ring = self._records.get(rec.workload)
+            if ring is None:
+                ring = deque(maxlen=self.per_workload)
+                self._records[rec.workload] = ring
+            self._records.move_to_end(rec.workload)
+            while len(self._records) > self.max_workloads:
+                self._records.popitem(last=False)
+            if ring and ring[-1].dedup_key() == rec.dedup_key():
+                ring[-1] = rec  # the leader's merged copy supersedes
+            else:
+                ring.append(rec)
+
+    def since(self, seq: int, limit: int = 2048) -> List[dict]:
+        """Wire dicts of every record stamped newer than ``seq``, in
+        seq order (capped at ``limit``) — the replication feed's audit
+        delta. O(delta) via the stamp log when the cursor is inside its
+        window (every repeat poll); a record restamped several times in
+        the window ships once, at its latest stamp."""
+        with self._lock:
+            log = self._stamp_log
+            if not log or seq + 1 >= log[0][0]:
+                # fast path: the log still covers everything after seq
+                picked = []
+                emitted = set()
+                for stamp, rec in reversed(log):
+                    if stamp <= seq:
+                        break
+                    # only a record's LATEST stamp represents it; older
+                    # stamps of the same object are superseded merges
+                    if rec.seq == stamp and id(rec) not in emitted:
+                        emitted.add(id(rec))
+                        picked.append(rec)
+                picked.reverse()
+                return [r.to_dict() for r in picked[:limit]]
+            newer = [
+                r
+                for ring in self._records.values()
+                for r in ring
+                if r.seq > seq
+            ]
+        newer.sort(key=lambda r: r.seq)
+        return [r.to_dict() for r in newer[:limit]]
 
     # ---- reads ----
     def for_workload(self, key: str) -> List[DecisionRecord]:

@@ -131,6 +131,71 @@ class EventRecorder:
             self._cond.notify_all()
             return ev
 
+    def ingest(self, item: dict) -> Optional[Event]:
+        """Replication ingest (storage/tailer.py): append a wire-format
+        event EXACTLY as the leader stamped it — the resourceVersion is
+        preserved, never re-issued, so a watcher that fails over from
+        leader to replica (or back) resumes from the same version
+        space. The feed is already series-deduped and rv-ordered on the
+        leader; a repeat of a known series key here is the leader's
+        count bump and restamps the same ring entry. Out-of-date items
+        (rv <= the newest ingested) are dropped — re-polls overlap."""
+        rv = int(item.get("resourceVersion", 0))
+        with self._cond:
+            if rv <= self._rv:
+                return None
+            self._rv = rv
+            regarding = item.get("regarding") or {}
+            key = (
+                regarding.get("kind", "Workload"),
+                item.get("object", ""),
+                item.get("reason", ""),
+                item.get("message", ""),
+            )
+            ev = self._series.get(key)
+            if ev is not None:
+                ev.count = int(item.get("count", ev.count + 1))
+                ev.last_timestamp = float(item.get("lastTimestamp", 0.0))
+                ev.resource_version = rv
+                self._ring.remove(ev)
+                self._ring.append(ev)
+            else:
+                ev = Event(
+                    kind=item.get("reason", ""),
+                    object_key=item.get("object", ""),
+                    message=item.get("message", ""),
+                    regarding_kind=regarding.get("kind", "Workload"),
+                    count=int(item.get("count", 1)),
+                    first_timestamp=float(item.get("firstTimestamp", 0.0)),
+                    last_timestamp=float(item.get("lastTimestamp", 0.0)),
+                    resource_version=rv,
+                )
+                self._ring.append(ev)
+                self._series[key] = ev
+                while len(self._ring) > self.ring_size:
+                    old = self._ring.pop(0)
+                    self._evicted_rv = max(
+                        self._evicted_rv, old.resource_version
+                    )
+                    okey = (old.regarding_kind, old.object_key, old.kind,
+                            old.message)
+                    if self._series.get(okey) is old:
+                        del self._series[okey]
+            self._cond.notify_all()
+            return ev
+
+    def note_gap(self, rv: int) -> None:
+        """Replication gap marker: the upstream feed could not fill
+        versions up to ``rv`` (the leader's ring already evicted them).
+        Local watchers resumed below ``rv`` must relist — the same
+        too-old signal a trimmed local ring produces."""
+        with self._cond:
+            if rv > self._evicted_rv:
+                self._evicted_rv = rv
+            if rv > self._rv:
+                self._rv = rv
+            self._cond.notify_all()
+
     # ---- read / watch ----
     @property
     def resource_version(self) -> int:

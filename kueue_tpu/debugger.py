@@ -104,6 +104,22 @@ def dump(runtime) -> str:
                     for e in quarantine.items()
                 )
             )
+    # replication posture (kueue_tpu/replica): role + staleness — on a
+    # replica, how far its replay trails the leader; on the leader the
+    # staleness fields are materialized at zero and the line still
+    # prints (same schema everywhere, grep-stable)
+    from kueue_tpu.replica import replication_section
+
+    rep = replication_section(runtime)
+    lines.append("-- replication (journal-tailing read replicas) --")
+    lines.append(
+        f"role={rep.get('role')} appliedSeq={rep.get('appliedSeq', 0)} "
+        f"lagSeconds={rep.get('lagSeconds', 0.0)} "
+        f"recordsApplied={rep.get('recordsApplied', 0)} "
+        f"resyncs={rep.get('resyncs', 0)}"
+    )
+    if rep.get("lastError"):
+        lines.append(f"lastError: {rep['lastError']}")
     # double-buffered drain loop posture (core/pipeline.py)
     pipe = getattr(runtime, "pipeline", None)
     if pipe is not None:

@@ -358,6 +358,34 @@ class Metrics:
             f"{NS}_recovery_torn_bytes_total",
             "Total torn-tail bytes truncated from the journal during recovery",
         )
+        # journal-tailing read replicas (kueue_tpu/storage/tailer.py):
+        # staleness + replay accounting. On a replica, applied_seq
+        # trails the leader's kueue_journal_appends head by the poll
+        # interval and lag_seconds is the paging signal for a replica
+        # falling behind; on the leader all four stay at zero (the
+        # roster lives on /apis/kueue/v1beta1/replicas instead).
+        self.replica_applied_seq = r.gauge(
+            f"{NS}_replica_applied_seq",
+            "Newest journal sequence this replica has applied (0 on the leader)",
+        )
+        self.replica_lag_seconds = r.gauge(
+            f"{NS}_replica_lag_seconds",
+            "Estimated staleness of this replica behind the leader's journal head",
+        )
+        self.replica_records_applied_total = r.counter(
+            f"{NS}_replica_records_applied_total",
+            "Total journal records applied by this replica's tailer",
+        )
+        self.replica_resyncs_total = r.counter(
+            f"{NS}_replica_resyncs_total",
+            "Total checkpoint resyncs (compaction jumps + fencing re-anchors)",
+        )
+        # materialize at zero: the replication section of the scrape
+        # surface exists on every process, leader included
+        self.replica_applied_seq.set(0)
+        self.replica_lag_seconds.set(0.0)
+        self.replica_records_applied_total.inc(0.0)
+        self.replica_resyncs_total.inc(0.0)
         # LocalQueue variants (LocalQueueMetrics feature gate)
         self.local_queue_pending_workloads = r.gauge(
             f"{NS}_local_queue_pending_workloads",

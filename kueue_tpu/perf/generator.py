@@ -190,6 +190,86 @@ class GeneratedWorkload:
     creation_s: float
 
 
+# ---- sustained arrival streams (bench.py --serve) ----
+@dataclass
+class ArrivalProcess:
+    """An open-loop arrival process for sustained-traffic serving
+    benchmarks: workloads arrive at ``rate_per_s`` for ``duration_s``,
+    spaced deterministically ("uniform") or with exponential
+    inter-arrival gaps ("poisson" — the classic open-system model where
+    arrivals don't wait for service). The batch workload sets above
+    model a backlog dumped at t=0; this models the steady stream a
+    serving control plane actually faces."""
+
+    rate_per_s: float = 100.0
+    duration_s: float = 10.0
+    process: str = "poisson"  # "poisson" | "uniform"
+    classes: Tuple[WorkloadClass, ...] = (
+        WorkloadClass("small", 200, 50, 1),
+        WorkloadClass("medium", 500, 100, 5),
+    )
+
+    def arrival_times(self, rng) -> List[float]:
+        """Seconds-from-start of every arrival in [0, duration_s)."""
+        if self.process not in ("poisson", "uniform"):
+            raise ValueError(
+                f"process must be poisson|uniform, got {self.process!r}"
+            )
+        if self.rate_per_s <= 0:
+            return []
+        if self.process == "uniform":
+            gap = 1.0 / self.rate_per_s
+            n = int(self.duration_s * self.rate_per_s)
+            return [i * gap for i in range(n)]
+        times: List[float] = []
+        t = 0.0
+        while True:
+            t += float(rng.exponential(1.0 / self.rate_per_s))
+            if t >= self.duration_s:
+                return times
+            times.append(t)
+
+
+def arrival_stream(
+    proc: ArrivalProcess,
+    lq_names: List[str],
+    rng,
+    namespace: str = NAMESPACE,
+    name_prefix: str = "arr",
+) -> List[GeneratedWorkload]:
+    """Materialize an ArrivalProcess as creation-time-stamped
+    workloads round-robined over ``lq_names`` (class round-robin like
+    the batch generator). The caller replays them against a live
+    control plane at their creation offsets — perf/runner for
+    virtual-time runs, bench.py --serve for wall-clock serving."""
+    out: List[GeneratedWorkload] = []
+    for i, t in enumerate(proc.arrival_times(rng)):
+        wc = proc.classes[i % len(proc.classes)]
+        wl = Workload(
+            namespace=namespace,
+            name=f"{name_prefix}-{i}",
+            queue_name=lq_names[i % len(lq_names)],
+            priority=wc.priority,
+            creation_time=t,
+            pod_sets=(
+                PodSet(
+                    name="main",
+                    count=1,
+                    requests=requests_from_spec({"cpu": str(wc.request_cpu)}),
+                ),
+            ),
+        )
+        out.append(
+            GeneratedWorkload(
+                workload=wl,
+                class_name=wc.class_name,
+                runtime_s=wc.runtime_ms / 1000.0,
+                creation_s=t,
+            )
+        )
+    return out
+
+
 @dataclass
 class Scenario:
     flavor: ResourceFlavor

@@ -1,0 +1,16 @@
+"""Read replicas — journal-tailing followers of a leader control plane.
+
+``ReadReplica`` owns a read-only ClusterRuntime kept live by a
+``storage.tailer.JournalTailer`` polling the leader's replication feed,
+plus the poll thread and the serving wiring: installed into a
+``KueueServer`` (``--replica-of URL``) it serves watch/SSE, visibility,
+``explain`` and best-effort-stale ``plan`` from the replayed state,
+while every mutating route 307-redirects to the leader.
+"""
+
+from kueue_tpu.replica.replica import (  # noqa: F401
+    ReadReplica,
+    replication_section,
+)
+
+__all__ = ["ReadReplica", "replication_section"]

@@ -1,0 +1,139 @@
+"""ReadReplica — the serving side of a journal-tailing follower.
+
+Composition root for replica mode: builds the tailer over an
+``HTTPTailSource`` (or any source), runs the poll loop on a daemon
+thread, installs each (re)built runtime into the owning ``KueueServer``
+under its serving lock, and exposes the replication posture every
+surface reads (``/healthz``, ``kueue_replica_*``, the dashboard badge,
+the SIGUSR2 dump, ``kueuectl replicas``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from kueue_tpu.storage.tailer import HTTPTailSource, JournalTailer
+
+
+class ReadReplica:
+    def __init__(
+        self,
+        leader_url: str,
+        token: Optional[str] = None,
+        replica_id: Optional[str] = None,
+        build_runtime: Optional[Callable[[], object]] = None,
+        poll_interval_s: float = 0.5,
+        ca_cert: Optional[str] = None,
+        insecure: bool = False,
+        source=None,
+    ):
+        self.leader_url = leader_url.rstrip("/")
+        self.poll_interval_s = poll_interval_s
+        if source is None:
+            source = HTTPTailSource(
+                leader_url, token=token, replica_id=replica_id,
+                ca_cert=ca_cert, insecure=insecure,
+            )
+        self.replica_id = getattr(source, "replica_id", replica_id or "replica")
+        self.tailer = JournalTailer(
+            source,
+            build_runtime=build_runtime,
+            on_install=self._on_install,
+        )
+        self._server = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- server wiring ----
+    def attach(self, server) -> None:
+        """Bind to the serving KueueServer: share its request lock (a
+        reader must never observe a half-applied record) and swap its
+        runtime pointer whenever the tailer installs a rebuilt one."""
+        self._server = server
+        self.tailer.lock = server.lock
+        rt = self.tailer.ensure_runtime()
+        self.tailer.metrics = rt.metrics
+        server.runtime = rt
+
+    def _on_install(self, rt) -> None:
+        # the runtime carries a back-pointer so surfaces that only see
+        # the runtime (debugger.dump, dashboard_payload) find the
+        # replication posture
+        rt.replica = self
+        self.tailer.metrics = rt.metrics
+        if self._server is not None:
+            # tailer.lock IS server.lock after attach — reentrant, so
+            # taking it here is safe from both the poll thread and an
+            # attach-time install
+            with self._server.lock:
+                self._server.runtime = rt
+
+    # ---- sync ----
+    def sync(self, resync: bool = False):
+        """One synchronous tail step (tests and the startup path).
+        ``resync=True`` forces the initial checkpoint anchor."""
+        if resync:
+            self.tailer.resync()
+        return self.tailer.poll_once()
+
+    def start(self) -> None:
+        """Anchor on the leader's checkpoint, then tail on a daemon
+        thread. The initial anchor is best-effort: an unreachable
+        leader leaves an empty replica that keeps retrying — replicas
+        must boot independently of leader availability."""
+        try:
+            self.tailer.resync()
+        except Exception as e:  # noqa: BLE001 — boot must not depend
+            # on the leader being up; the poll loop retries
+            self.tailer.last_error = f"initial sync failed: {e}"
+        self.tailer.poll_once()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.tailer.poll_once()
+            except Exception as e:  # noqa: BLE001 — a tail failure
+                # (leader down, malformed batch) must not kill the
+                # loop; the replica serves its last consistent state
+                self.tailer.last_error = repr(e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ---- posture ----
+    @property
+    def runtime(self):
+        return self.tailer.ensure_runtime()
+
+    def status(self) -> dict:
+        out = {"role": "replica", "leader": self.leader_url,
+               "id": self.replica_id}
+        out.update(self.tailer.status())
+        return out
+
+
+def replication_section(rt) -> dict:
+    """The replication posture of ANY runtime — the shared payload for
+    /healthz, the dashboard badge and the SIGUSR2 dump. On a replica it
+    is the tailer's live status; on a leader (or a journal-less
+    single-node plane) every staleness field is materialized at zero so
+    dashboards render one schema everywhere."""
+    rep = getattr(rt, "replica", None)
+    if rep is not None:
+        return rep.status()
+    journal = getattr(rt, "journal", None)
+    return {
+        "role": "leader" if journal is not None else "single",
+        "appliedSeq": journal.last_seq if journal is not None else 0,
+        "lagSeconds": 0.0,
+        "recordsApplied": 0,
+        "resyncs": 0,
+        "lastError": "",
+    }

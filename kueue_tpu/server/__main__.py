@@ -239,6 +239,32 @@ def main(argv=None) -> int:
         help="only reconcile on POST /reconcile",
     )
     parser.add_argument(
+        "--replica-of", metavar="URL",
+        help="run as a journal-tailing READ REPLICA of the leader at "
+        "URL (a kueue_tpu.server started with --journal): the leader's "
+        "replication feed is polled and replayed into a live read-only "
+        "runtime serving watch/SSE, visibility, explain and "
+        "best-effort-stale plan; mutating requests 307-redirect to the "
+        "leader. Staleness is reported on /healthz and "
+        "kueue_replica_{applied_seq,lag_seconds}",
+    )
+    parser.add_argument(
+        "--replica-poll-interval", type=float, default=0.5,
+        help="seconds between replication-feed polls in --replica-of "
+        "mode (the staleness floor)",
+    )
+    parser.add_argument(
+        "--replica-id",
+        help="this replica's identity in the leader's roster "
+        "(default: hostname-pid)",
+    )
+    parser.add_argument(
+        "--replica-token", default=None,
+        help="bearer token presented to a --replica-of leader started "
+        "with --auth-token (default: --auth-token, so one shared "
+        "token secures both directions)",
+    )
+    parser.add_argument(
         "--federation-worker", action="append", default=None,
         metavar="NAME=URL",
         help="run this control plane as a MultiKueue federation manager "
@@ -319,6 +345,17 @@ def main(argv=None) -> int:
             "--tls-cert-dir (self-managed) and --tls-cert (provided) "
             "are mutually exclusive"
         )
+    if args.replica_of:
+        # a replica never writes: it neither journals (single-writer
+        # log), contends for the lease, nor dispatches federation work
+        for flag, val in (
+            ("--journal", args.journal),
+            ("--state", args.state),
+            ("--leader-elect-lease", args.leader_elect_lease),
+            ("--federation-worker", args.federation_worker),
+        ):
+            if val:
+                parser.error(f"--replica-of is incompatible with {flag}")
 
     from kueue_tpu import serialization as ser
     from kueue_tpu.server import KueueServer
@@ -503,6 +540,21 @@ def main(argv=None) -> int:
             f"federation manager: dispatching to {sorted(workers)}",
             flush=True,
         )
+    replica = None
+    if args.replica_of:
+        import socket
+
+        from kueue_tpu.replica import ReadReplica
+
+        replica = ReadReplica(
+            args.replica_of,
+            token=args.replica_token or args.auth_token,
+            replica_id=(
+                args.replica_id or f"{socket.gethostname()}-{os.getpid()}"
+            ),
+            build_runtime=build_runtime,
+            poll_interval_s=args.replica_poll_interval,
+        )
     tls = None
     if args.tls_cert_dir:
         from kueue_tpu.utils.cert import CertRotator
@@ -520,12 +572,20 @@ def main(argv=None) -> int:
         elector=elector,
         auth_token=args.auth_token,
         tls=tls,
+        replica=replica,
     )
     port = srv.start()
+    if replica is not None:
+        # anchor on the leader's checkpoint (best-effort — an
+        # unreachable leader leaves an empty replica retrying) and
+        # start the tail loop
+        replica.start()
     ha["boot"] = False  # any later promotion is a real takeover
     role = ""
     if elector is not None:
         role = " as leader" if elector.is_leader else " as standby"
+    elif replica is not None:
+        role = f" as read replica of {args.replica_of}"
     scheme = "https" if tls is not None else "http"
     print(
         f"kueue-tpu server listening on {scheme}://{args.host}:{port}{role}",
@@ -534,6 +594,17 @@ def main(argv=None) -> int:
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    if hasattr(signal, "SIGUSR2"):
+        # the pkg/debugger analog: kill -USR2 <pid> dumps queues,
+        # cache, recent cycles, persistence/solver/replication posture
+        # to stderr. Reads srv.runtime at SIGNAL time, so it follows
+        # promotion/replica runtime swaps.
+        from kueue_tpu import debugger
+
+        signal.signal(
+            signal.SIGUSR2,
+            lambda *_: sys.stderr.write(debugger.dump(srv.runtime) + "\n"),
+        )
 
     ckpt_thread = None
     if args.state and args.state_checkpoint_period > 0:
@@ -581,6 +652,8 @@ def main(argv=None) -> int:
     def _final_checkpoint() -> None:
         final["saved"] = checkpoint()
 
+    if replica is not None:
+        replica.stop()
     srv.stop(before_release=_final_checkpoint if was_leader else None)
     if ckpt_thread is not None:
         ckpt_thread.join(timeout=5)

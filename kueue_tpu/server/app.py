@@ -33,6 +33,15 @@ Routes (all JSON unless noted):
                                                flip a check state — the
                                                phase-2 plugin boundary
                                                (admissioncheck_types.go:23-45)
+  GET  /apis/kueue/v1beta1/journal?sinceSeq=N  replication feed (leader):
+                                               journal records past N bundled
+                                               with event-recorder and audit
+                                               deltas — the read-replica tail
+                                               (storage/tailer.py); registers
+                                               the polling replica in the
+                                               roster
+  GET  /apis/kueue/v1beta1/replicas            replica roster (leader) or
+                                               this replica's own status
   GET  /apis/kueue/v1beta1/events              recorded events (+resourceVersion)
   GET  /apis/kueue/v1beta1/{section}?watch=1&resourceVersion=N
                                                long-poll: blocks until events
@@ -60,6 +69,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -247,6 +257,7 @@ class KueueServer:
         elector=None,  # utils.lease.LeaderElector: HA replica mode
         auth_token: Optional[str] = None,
         tls=None,  # utils.cert.CertRotator, or (cert_path, key_path)
+        replica=None,  # replica.ReadReplica: journal-tailing follower
     ):
         if runtime is None:
             from kueue_tpu.controllers import ClusterRuntime
@@ -302,6 +313,18 @@ class KueueServer:
         # flipped by stop(): parked watch long-polls and SSE tails
         # check it so shutdown never waits out a full poll window
         self._stopping = threading.Event()
+        # Read-replica mode (kueue_tpu/replica): a journal-tailing
+        # follower serving watch/SSE, visibility, explain and
+        # best-effort-stale plan from replayed leader state; every
+        # mutating route 307-redirects to the leader. The replica
+        # installs its runtime (and every resync rebuild) through
+        # self.lock, replacing whatever runtime= was passed.
+        self.replica = replica
+        # leader-side follower roster, fed by the replication feed's
+        # ?replica=...&appliedSeq=... poll params (kueuectl replicas)
+        self.replica_roster: Dict[str, dict] = {}
+        if replica is not None:
+            replica.attach(self)
 
     def require_leader(self) -> None:
         if self.elector is not None and not self.elector.is_leader:
@@ -585,6 +608,21 @@ _SECURED_ROUTES = frozenset(
         "apply", "apply_batch", "delete", "delete_ns", "check_state",
         "reconcile", "solve", "metrics", "state", "debug_cycles",
         "workload_decisions", "plan", "quarantine_list", "quarantine_clear",
+        # the replication feed serializes every state mutation — gate
+        # it exactly like /state
+        "journal_tail",
+    }
+)
+
+# mutating routes a read replica refuses: 307 to the leader, method and
+# body preserved (kueuectl and KueueClient follow it transparently).
+# NOT here: "solve" (stateless compute over a posted snapshot) and
+# "plan" (read-only what-if over the replayed state — best-effort-stale
+# by design, documented in deploy/README).
+_REPLICA_REDIRECTED = frozenset(
+    {
+        "apply", "apply_batch", "delete", "delete_ns", "check_state",
+        "reconcile", "quarantine_clear",
     }
 )
 
@@ -611,6 +649,10 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
         re.compile(r"^/apis/kueue/v1beta1/workloads/([^/]+)/([^/]+)/admissionchecks$"),
         "check_state",
     ),
+    # literal routes FIRST: the generic section pattern below would
+    # swallow "journal"/"replicas" as object listings
+    ("GET", re.compile(r"^/apis/kueue/v1beta1/journal$"), "journal_tail"),
+    ("GET", re.compile(r"^/apis/kueue/v1beta1/replicas$"), "replicas"),
     ("GET", re.compile(r"^/apis/kueue/v1beta1/([a-z]+)$"), "list"),
     (
         "GET",
@@ -682,6 +724,16 @@ def _make_handler(srv: KueueServer):
                 match = pat.match(parsed.path)
                 if match:
                     try:
+                        if (
+                            srv.replica is not None
+                            and name in _REPLICA_REDIRECTED
+                        ):
+                            # writes belong to the leader: 307 keeps
+                            # method + body intact across the redirect
+                            self._send_redirect(
+                                srv.replica.leader_url + self.path
+                            )
+                            return
                         self._check_auth(name)
                         getattr(self, f"_h_{name}")(*match.groups(), **{"query": query})
                     except ApiError as e:
@@ -733,10 +785,40 @@ def _make_handler(srv: KueueServer):
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(payload)))
+            if srv.replica is not None:
+                # every replica-served read is labeled with its role +
+                # staleness so clients (kueuectl) can tell the user the
+                # answer may trail the leader
+                self.send_header("X-Kueue-Role", "replica")
+                self.send_header(
+                    "X-Kueue-Replica-Lag",
+                    f"{srv.replica.tailer.lag_s:.3f}",
+                )
             if self.close_connection:
                 # tell keep-alive clients not to reuse the connection
                 # (set by the auth rejection path)
                 self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _send_redirect(self, location: str) -> None:
+            """307: same method + body at the leader. The unread
+            request body is drained (and the connection dropped) so a
+            keep-alive client's next request does not parse out of the
+            stale bytes."""
+            length = int(self.headers.get("Content-Length", 0))
+            if length:
+                self.rfile.read(length)
+            self.close_connection = True
+            payload = json.dumps(
+                {"error": "read replica: writes are served by the leader",
+                 "leader": location}
+            ).encode()
+            self.send_response(307)
+            self.send_header("Location", location)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.send_header("Connection", "close")
             self.end_headers()
             self.wfile.write(payload)
 
@@ -796,6 +878,22 @@ def _make_handler(srv: KueueServer):
                 body["federation"] = detail
                 if detail["degraded"]:
                     body["status"] = "degraded"
+            # replication detail (kueue_tpu/replica): a replica reports
+            # its staleness (appliedSeq, lagSeconds) here; a failing
+            # tail flips "degraded" — the replica still serves its last
+            # consistent state, so the probe stays 200 and the operator
+            # pages on kueue_replica_lag_seconds / this detail
+            if srv.replica is not None:
+                detail = srv.replica.status()
+                body["replication"] = detail
+                if detail.get("lastError"):
+                    body["status"] = "degraded"
+            elif srv.replica_roster:
+                from kueue_tpu.replica import replication_section
+
+                detail = replication_section(srv.runtime)
+                detail["replicas"] = len(srv.replica_roster)
+                body["replication"] = detail
             self._send_json(body)
 
         def _h_readyz(self, query):
@@ -1039,13 +1137,18 @@ def _make_handler(srv: KueueServer):
             self._send_json({"cleared": cleared})
 
         def _h_plan(self, query):
-            """What-if capacity planner. Leader-only: a plan is a
-            forecast of the LEADER's next admission decisions — a
-            standby's state can lag its watch, so serving plans there
-            would produce confidently wrong answers. Strictly read-only
-            over the runtime (guardrail-tested: state dump and event
-            resourceVersion are byte-identical across a plan call)."""
-            srv.require_leader()
+            """What-if capacity planner. Leader-only in elector HA (a
+            checkpoint-refresh standby's state can lag by the whole
+            checkpoint period, so plans there would be confidently
+            wrong) — but a journal-tailing READ REPLICA serves it:
+            its state trails by one poll interval, the response carries
+            the X-Kueue-Replica-Lag header, and the semantics are
+            documented best-effort-stale (deploy/README "Read
+            replicas"). Strictly read-only over the runtime
+            (guardrail-tested: state dump and event resourceVersion are
+            byte-identical across a plan call)."""
+            if srv.replica is None:
+                srv.require_leader()
             from kueue_tpu.planner import plan_request
             from kueue_tpu.planner.scenarios import ScenarioApplyError
 
@@ -1074,7 +1177,116 @@ def _make_handler(srv: KueueServer):
         def _h_state(self, query):
             with srv.lock:  # snapshot under lock; write to client outside
                 state = ser.runtime_to_state(srv.runtime)
+                if srv.replica is not None:
+                    # the replica has no journal attached, so stamp its
+                    # APPLIED position instead of journalSeq=0 — at
+                    # quiescence this makes the replica's dump
+                    # byte-identical to the leader's (the convergence
+                    # acceptance check)
+                    state["persistence"]["journalSeq"] = (
+                        srv.replica.tailer.applied_seq
+                    )
             self._send_json(state)
+
+        def _h_journal_tail(self, query):
+            """The replication feed read replicas poll: journal records
+            past ``sinceSeq``, bundled with the event-recorder and
+            audit-log deltas so one round trip per poll interval keeps
+            every replica read surface current. Registers the polling
+            replica in the roster. The segment scan runs OUTSIDE
+            srv.lock — segments are append-only, the CRC framing makes
+            a concurrently half-written tail frame invisible, and
+            holding the serving lock for an O(delta) file scan would
+            put reads back on the admission hot path."""
+            journal = getattr(srv.runtime, "journal", None)
+            if journal is None:
+                raise ApiError(
+                    404,
+                    "no journal attached; replicas tail a leader "
+                    "started with --journal",
+                )
+            since = self._int_param(query, "sinceSeq", 0)
+            limit = max(1, min(self._int_param(query, "limit", 2048), 65536))
+            first_available = journal.first_available_seq()
+            body = {
+                "lastSeq": journal.last_seq,
+                "firstAvailableSeq": first_available,
+                "token": (
+                    journal.token_provider()
+                    if journal.token_provider is not None
+                    else None
+                ),
+                "leaderTime": time.time(),
+            }
+            if since + 1 < first_available and journal.last_seq > since:
+                # the requested prefix was compacted away: the replica
+                # must re-anchor on a checkpoint (GET /state) — sending
+                # records with a hole would corrupt its replay
+                body["compacted"] = True
+                body["records"] = []
+            else:
+                body["compacted"] = False
+                # offset-cursor tail: a caught-up replica's repeat poll
+                # reads O(delta) bytes, not the whole active segment
+                body["records"] = [
+                    rec.to_dict()
+                    for rec in journal.tail_records(since, limit=limit)
+                ]
+            # event + audit deltas (rv/seq-addressed, recorder-locked)
+            ev_rv = self._int_param(query, "sinceEventRv", 0)
+            rec_events = srv.runtime.events
+            items, too_old = rec_events.since(ev_rv)
+            body["events"] = items
+            body["eventsRv"] = rec_events.resource_version
+            body["eventsTooOld"] = too_old
+            audit = getattr(srv.runtime, "audit", None)
+            audit_seq = self._int_param(query, "sinceAuditSeq", 0)
+            body["audit"] = audit.since(audit_seq) if audit is not None else []
+            body["auditSeq"] = audit.seq if audit is not None else 0
+            replica_id = query.get("replica")
+            if replica_id:
+                try:
+                    applied = int(query.get("appliedSeq", since))
+                    lag = float(query.get("lagSeconds", 0.0))
+                except ValueError:
+                    applied, lag = since, 0.0
+                srv.replica_roster[replica_id] = {
+                    "id": replica_id,
+                    "appliedSeq": applied,
+                    "lagSeconds": lag,
+                    "lastSeen": body["leaderTime"],
+                }
+            self._send_json(body)
+
+        def _h_replicas(self, query):
+            """Follower roster (leader) / own status (replica) — the
+            ``kueuectl replicas`` payload."""
+            if srv.replica is not None:
+                self._send_json(
+                    {"role": "replica", "items": [srv.replica.status()]}
+                )
+                return
+            journal = getattr(srv.runtime, "journal", None)
+            now = time.time()
+            items = []
+            for entry in sorted(
+                srv.replica_roster.values(), key=lambda e: e["id"]
+            ):
+                item = dict(entry)
+                item["lastSeenAgoS"] = round(now - entry["lastSeen"], 3)
+                item["behind"] = (
+                    max(0, journal.last_seq - entry["appliedSeq"])
+                    if journal is not None
+                    else 0
+                )
+                items.append(item)
+            self._send_json(
+                {
+                    "role": "leader",
+                    "lastSeq": journal.last_seq if journal is not None else 0,
+                    "items": items,
+                }
+            )
 
         def _h_solve(self, query):
             # stateless: deliberately NOT under srv.lock — solving a

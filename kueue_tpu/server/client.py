@@ -35,6 +35,13 @@ class KueueClient:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
+        # replica awareness, refreshed per request: read replicas label
+        # every response with X-Kueue-Role/X-Kueue-Replica-Lag, and
+        # mutating verbs they 307-redirect are re-issued at the leader
+        # (urllib refuses to follow 307 with a body on its own)
+        self.last_role: Optional[str] = None
+        self.last_replica_lag_s: Optional[float] = None
+        self.last_redirected_to: Optional[str] = None
         self._ssl_context = None
         if base_url.startswith("https"):
             import ssl
@@ -51,15 +58,17 @@ class KueueClient:
                 self._ssl_context = ssl.create_default_context(cafile=ca_cert)
 
     def _request(self, method: str, path: str, body: Optional[dict] = None):
+        self.last_redirected_to = None
+        return self._request_url(f"{self.base_url}{path}", method, body)
+
+    def _request_url(self, url: str, method: str,
+                     body: Optional[dict] = None, redirects: int = 1):
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"} if data else {}
         if self.token is not None:
             headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
-            f"{self.base_url}{path}",
-            data=data,
-            method=method,
-            headers=headers,
+            url, data=data, method=method, headers=headers
         )
         try:
             with urllib.request.urlopen(
@@ -67,7 +76,19 @@ class KueueClient:
             ) as resp:
                 raw = resp.read()
                 ctype = resp.headers.get("Content-Type", "")
+                self._note_replica_headers(resp.headers)
         except urllib.error.HTTPError as e:
+            if e.code in (307, 308) and redirects > 0:
+                # a read replica redirecting a mutating verb to its
+                # leader: urllib never re-sends a body across a
+                # redirect, so follow it ourselves — same method, same
+                # body, once (the leader does not redirect again)
+                location = e.headers.get("Location")
+                if location:
+                    self.last_redirected_to = location
+                    return self._request_url(
+                        location, method, body, redirects=redirects - 1
+                    )
             try:
                 message = json.loads(e.read()).get("error", str(e))
             except Exception:  # noqa: BLE001
@@ -76,6 +97,20 @@ class KueueClient:
         if ctype.startswith("application/json"):
             return json.loads(raw)
         return raw.decode()
+
+    def _note_replica_headers(self, headers) -> None:
+        self.last_role = headers.get("X-Kueue-Role") or "leader"
+        lag = headers.get("X-Kueue-Replica-Lag")
+        try:
+            self.last_replica_lag_s = float(lag) if lag is not None else None
+        except ValueError:
+            self.last_replica_lag_s = None
+
+    @property
+    def served_by_replica(self) -> bool:
+        """Did the last response come from a read replica? (Drives the
+        kueuectl "(replica, lag …)" note on read commands.)"""
+        return self.last_role == "replica"
 
     # ---- probes / metrics ----
     def healthz(self) -> dict:
@@ -238,6 +273,46 @@ class KueueClient:
                         yield json.loads(payload)
         finally:
             resp.close()
+
+    # ---- replication (read replicas) ----
+    def journal_tail(
+        self,
+        since_seq: int = 0,
+        since_event_rv: int = 0,
+        since_audit_seq: int = 0,
+        limit: int = 2048,
+        replica: Optional[str] = None,
+        applied_seq: Optional[int] = None,
+        lag_s: Optional[float] = None,
+    ) -> dict:
+        """One replication-feed poll (the JournalTailer wire): journal
+        records with seq > ``since_seq`` plus event/audit deltas, and
+        the leader's head/compaction-floor/fencing posture. ``replica``
+        + ``applied_seq``/``lag_s`` register this follower in the
+        leader's roster."""
+        params = [
+            f"sinceSeq={since_seq}",
+            f"sinceEventRv={since_event_rv}",
+            f"sinceAuditSeq={since_audit_seq}",
+            f"limit={limit}",
+        ]
+        if replica:
+            from urllib.parse import quote
+
+            params.append(f"replica={quote(replica)}")
+            if applied_seq is not None:
+                params.append(f"appliedSeq={applied_seq}")
+            if lag_s is not None:
+                params.append(f"lagSeconds={lag_s}")
+        return self._request(
+            "GET", "/apis/kueue/v1beta1/journal?" + "&".join(params)
+        )
+
+    def replicas(self) -> dict:
+        """The follower roster (`kueuectl replicas` payload): on a
+        leader, every replica that polled the feed with its staleness;
+        on a replica, its own status."""
+        return self._request("GET", "/apis/kueue/v1beta1/replicas")
 
     # ---- federation ----
     def federation_clusters(self) -> dict:

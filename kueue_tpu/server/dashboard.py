@@ -162,10 +162,17 @@ def dashboard_payload(rt) -> dict:
     # active mesh shape, device count, jit-bucket reuse
     mesh_status = getattr(rt, "mesh_status", None)
     mesh = mesh_status() if mesh_status is not None else {"shape": "off", "devices": 0}
+    # replication badge (kueue_tpu/replica): role + staleness —
+    # materialized at zero on the leader so the badge renders one
+    # schema on every plane
+    from kueue_tpu.replica import replication_section
+
+    replication = replication_section(rt)
     return {
         "solver": solver,
         "pipeline": pipeline,
         "mesh": mesh,
+        "replication": replication,
         "clusterQueues": cqs,
         "localQueues": lqs,
         "workloads": workloads,
@@ -241,7 +248,8 @@ DASHBOARD_HTML = """<!doctype html>
 <div class="muted">control-plane dashboard &middot; <span id="mode" class="poll">connecting&hellip;</span>
  &middot; solver <span id="solver" class="badge">&hellip;</span>
  &middot; pipeline <span id="pipeline" class="badge">&hellip;</span>
- &middot; mesh <span id="mesh" class="badge">&hellip;</span></div>
+ &middot; mesh <span id="mesh" class="badge">&hellip;</span>
+ &middot; replication <span id="replication" class="badge">&hellip;</span></div>
 <div class="tiles" id="tiles"></div>
 <h2>Last cycle</h2><div id="cycle"></div>
 <h2>ClusterQueues</h2><div id="cqs"></div>
@@ -301,6 +309,20 @@ function render(d){
   const bk = (ms.buckets||{});
   msEl.title = `jit buckets: ${bk.buckets||0} compiled, ${bk.hits||0} reuses; `+
     `place=${ms.placeSeconds||0}s`;
+  const rp = d.replication||{};
+  const rpEl = document.getElementById('replication');
+  if (rp.role){
+    const lag = rp.lagSeconds||0;
+    rpEl.className = 'badge '+(rp.role==='replica'
+      ? (rp.lastError ? 'quarantined' : (lag > 2 ? 'host' : 'device'))
+      : 'host');
+    rpEl.textContent = rp.role==='replica'
+      ? `replica · seq ${rp.appliedSeq||0} · lag ${lag.toFixed ? lag.toFixed(2) : lag}s`
+      : rp.role;
+    rpEl.title = `appliedSeq=${rp.appliedSeq||0} lag=${lag}s `+
+      `recordsApplied=${rp.recordsApplied||0} resyncs=${rp.resyncs||0}`+
+      (rp.lastError ? ` lastError=${rp.lastError}` : '');
+  }
   const st = d.workloadStates||{};
   document.getElementById('tiles').innerHTML =
     [['ClusterQueues',d.clusterQueues.length],['LocalQueues',d.localQueues.length],

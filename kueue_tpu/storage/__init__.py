@@ -24,6 +24,13 @@ from kueue_tpu.storage.recovery import (  # noqa: F401
     recover,
     verify_chain,
 )
+from kueue_tpu.storage.tailer import (  # noqa: F401
+    HTTPTailSource,
+    JournalTailer,
+    LocalTailSource,
+    TailBatch,
+    TailSourceError,
+)
 
 __all__ = [
     "FSYNC_POLICIES",
@@ -35,4 +42,9 @@ __all__ = [
     "RecoveryResult",
     "recover",
     "verify_chain",
+    "HTTPTailSource",
+    "JournalTailer",
+    "LocalTailSource",
+    "TailBatch",
+    "TailSourceError",
 ]

@@ -1,0 +1,490 @@
+"""Journal tailing — live read replicas off the write-ahead log.
+
+The PR-4 journal is a totally-ordered, CRC-framed, fence-stamped
+mutation log; ``JournalTailer`` follows it INCREMENTALLY and applies
+each record through the existing ``storage/recovery.py`` replay
+machinery into a live read-only ClusterRuntime — no restart, no
+checkpoint round-trip. Standbys previously refreshed only from the 30 s
+checkpoint; a tailing replica is behind by one poll interval plus the
+leader's fsync window, which turns the control plane into 1 writer +
+N readers: watch/SSE, visibility, ``explain`` and (best-effort-stale)
+``plan`` fan out to replicas while the leader's cycle budget stays on
+admission.
+
+Two tail sources:
+
+- ``HTTPTailSource`` — polls the leader's replication feed
+  (``GET /apis/kueue/v1beta1/journal?sinceSeq=N``), which bundles the
+  journal delta with the event-recorder and audit-log deltas so ONE
+  round trip per interval keeps all three read surfaces current, and
+  registers the replica in the leader's roster (``kueuectl replicas``).
+- ``LocalTailSource`` — scans the journal directory directly (shared
+  state volume, the classic log-shipping topology). Journal records
+  only; events/audit mirroring needs the HTTP feed.
+
+Failure handling, in the order the tailer hits them:
+
+- torn tail: the segment scan stops at the first bad frame; the next
+  poll re-reads from the same seq — a frame half-written by the leader
+  is simply not applied yet (never garbage-applied: CRC framing);
+- segment rotation: invisible — the fetch is seq-addressed and the
+  segment-name first-seq index skips sealed segments below the cursor;
+- compaction jump: the leader deleted the segment holding the
+  replica's resume seq (``firstAvailableSeq`` moved past it) — fall
+  back to a checkpoint fetch (leader ``/state``), rebuild the runtime
+  from it, resume tailing from the checkpoint's ``journalSeq``
+  (fault point ``replica.tail_gap`` marks the detection,
+  ``replica.resync`` the rebuild);
+- fencing-token change: a record stamped with a token BELOW the
+  maximum seen is a deposed leader's stray append — skipped, exactly
+  like recovery's replay. A token ABOVE it means a leader handover:
+  the replica may have applied pre-handover records the new leader's
+  recovery refused, so it RE-ANCHORS — full checkpoint resync under
+  the new token — instead of trusting its own prefix.
+
+The tailer never journals (the replica runtime keeps ``journal=None``;
+``apply_record`` routes through the same mutation methods recovery
+uses) and never schedules — it only applies the leader's decisions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from kueue_tpu.storage.journal import (
+    JournalRecord,
+    _list_segments,
+    _segment_first_seq,
+    iter_segment_records,
+)
+from kueue_tpu.storage.recovery import apply_record
+from kueue_tpu.testing import faults
+
+
+@dataclass
+class TailBatch:
+    """One fetch from a tail source: the journal delta past the
+    replica's cursor plus (HTTP feed only) the event/audit deltas."""
+
+    records: List[JournalRecord] = field(default_factory=list)
+    last_seq: int = 0  # the leader's journal head
+    first_available_seq: int = 0  # compaction floor (0 = everything)
+    compacted: bool = False  # requested prefix no longer on disk
+    token: Optional[int] = None  # the leader's CURRENT fencing token
+    events: List[dict] = field(default_factory=list)
+    events_rv: int = 0
+    events_too_old: bool = False
+    audit: List[dict] = field(default_factory=list)
+    audit_seq: int = 0
+    leader_time: float = 0.0
+
+
+class TailSourceError(Exception):
+    """The tail source could not produce a batch (leader unreachable,
+    malformed response). The tailer keeps serving its current state and
+    retries on the next poll."""
+
+
+class LocalTailSource:
+    """Tail a journal directory on a shared volume. Read-only: never
+    opens segments for append, never truncates a torn tail (that is the
+    leader's job) — a torn frame just ends this poll's batch."""
+
+    def __init__(self, journal_path: str, state_path: Optional[str] = None,
+                 limit: int = 4096):
+        self.journal_path = journal_path
+        self.state_path = state_path
+        self.limit = limit
+
+    def fetch(self, since_seq: int, since_event_rv: int = 0,
+              since_audit_seq: int = 0, status: Optional[dict] = None
+              ) -> TailBatch:
+        try:
+            names = _list_segments(self.journal_path)
+        except OSError as e:
+            raise TailSourceError(f"journal dir unreadable: {e!r}")
+        batch = TailBatch(
+            first_available_seq=(
+                _segment_first_seq(names[0]) if names else 0
+            ),
+            leader_time=time.time(),
+        )
+        for rec in iter_segment_records(self.journal_path, names, since_seq):
+            batch.records.append(rec)
+            if len(batch.records) >= self.limit:
+                break
+        last = batch.records[-1].seq if batch.records else since_seq
+        batch.last_seq = max(last, since_seq)
+        # the resume seq fell below the compaction floor AND nothing
+        # bridges the gap: the records between cursor and floor are gone
+        if batch.first_available_seq > since_seq + 1 and not any(
+            r.seq == since_seq + 1 for r in batch.records[:1]
+        ):
+            batch.compacted = True
+        return batch
+
+    def checkpoint(self) -> Optional[dict]:
+        if not (self.state_path and os.path.exists(self.state_path)):
+            return None
+        try:
+            with open(self.state_path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            raise TailSourceError(f"checkpoint unreadable: {e!r}")
+
+
+class HTTPTailSource:
+    """Tail a remote leader over its replication feed. Carries the
+    replica's identity + staleness back to the leader on every poll so
+    ``kueuectl replicas`` on the leader lists live followers."""
+
+    def __init__(self, leader_url: str, token: Optional[str] = None,
+                 replica_id: Optional[str] = None, timeout: float = 30.0,
+                 ca_cert: Optional[str] = None, insecure: bool = False,
+                 limit: int = 4096):
+        from kueue_tpu.server.client import KueueClient
+
+        self.leader_url = leader_url.rstrip("/")
+        self.replica_id = replica_id or f"replica-{os.getpid()}"
+        self.limit = limit
+        self.client = KueueClient(
+            leader_url, timeout=timeout, token=token, ca_cert=ca_cert,
+            insecure=insecure,
+        )
+
+    def fetch(self, since_seq: int, since_event_rv: int = 0,
+              since_audit_seq: int = 0, status: Optional[dict] = None
+              ) -> TailBatch:
+        from kueue_tpu.server.client import ClientError
+
+        status = status or {}
+        try:
+            out = self.client.journal_tail(
+                since_seq=since_seq,
+                since_event_rv=since_event_rv,
+                since_audit_seq=since_audit_seq,
+                limit=self.limit,
+                replica=self.replica_id,
+                applied_seq=status.get("appliedSeq"),
+                lag_s=status.get("lagSeconds"),
+            )
+        except (ClientError, OSError) as e:
+            raise TailSourceError(f"leader feed fetch failed: {e}")
+        try:
+            return TailBatch(
+                records=[
+                    JournalRecord.from_dict(r)
+                    for r in out.get("records", [])
+                ],
+                last_seq=int(out.get("lastSeq", 0)),
+                first_available_seq=int(out.get("firstAvailableSeq", 0)),
+                compacted=bool(out.get("compacted", False)),
+                token=out.get("token"),
+                events=out.get("events", []),
+                events_rv=int(out.get("eventsRv", 0)),
+                events_too_old=bool(out.get("eventsTooOld", False)),
+                audit=out.get("audit", []),
+                audit_seq=int(out.get("auditSeq", 0)),
+                leader_time=float(out.get("leaderTime", 0.0)),
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            raise TailSourceError(f"malformed feed response: {e!r}")
+
+    def checkpoint(self) -> Optional[dict]:
+        from kueue_tpu.server.client import ClientError
+
+        try:
+            return self.client.state()
+        except (ClientError, OSError) as e:
+            raise TailSourceError(f"leader checkpoint fetch failed: {e}")
+
+
+@dataclass
+class TailResult:
+    """What one poll did (poll_once return value)."""
+
+    applied: int = 0
+    skipped_stale: int = 0
+    resynced: bool = False
+    caught_up: bool = False
+    error: str = ""
+
+
+class JournalTailer:
+    """Follow a journal source and keep ``self.runtime`` a live replay
+    of the leader's state. Apply happens under ``self.lock`` (share the
+    serving lock via ``lock=`` so readers never see a half-applied
+    record); a resync REPLACES the runtime and reports it through
+    ``on_install`` so the server can swap its pointer atomically."""
+
+    def __init__(
+        self,
+        source,
+        build_runtime: Optional[Callable[[], object]] = None,
+        lock: Optional[threading.RLock] = None,
+        on_install: Optional[Callable[[object], None]] = None,
+        now_fn: Callable[[], float] = time.time,
+        metrics=None,
+    ):
+        if build_runtime is None:
+            def build_runtime():
+                from kueue_tpu.controllers import ClusterRuntime
+                from kueue_tpu.tas import TASCache
+
+                return ClusterRuntime(
+                    tas_cache=TASCache(), use_solver=False,
+                    bulk_drain_threshold=None,
+                )
+
+        self.source = source
+        self.build_runtime = build_runtime
+        self.lock = lock or threading.RLock()
+        self.on_install = on_install
+        self.now_fn = now_fn
+        self.metrics = metrics
+        self.runtime = None
+        # replication cursors
+        self.applied_seq = 0
+        self.events_rv = 0
+        self.audit_seq = 0
+        self.max_token: Optional[int] = None
+        # accounting (stable across resyncs — the runtime is rebuilt,
+        # the tailer is not)
+        self.records_applied = 0
+        self.skipped_stale = 0
+        self.resyncs = 0
+        self.lag_s = 0.0
+        self.last_error = ""
+        self.last_poll_ts: Optional[float] = None
+        # consecutive polls where the leader claimed a head PAST our
+        # cursor yet shipped zero records and no compaction marker — a
+        # self-inconsistent feed (e.g. the journal directory deleted
+        # under a live leader). One or two can be a torn in-flight
+        # frame; persistent means the incremental path is dead and
+        # only a checkpoint re-anchor recovers.
+        self._empty_behind = 0
+
+    # ---- lifecycle ----
+    def ensure_runtime(self):
+        """The serving runtime (built fresh on first use — an empty
+        replica serves empty reads until the first sync lands)."""
+        if self.runtime is None:
+            with self.lock:
+                if self.runtime is None:
+                    self._install(self.build_runtime())
+        return self.runtime
+
+    def _install(self, rt) -> None:
+        """Swap in a rebuilt runtime, carrying the OBSERVABILITY spine
+        over: the event recorder, audit log and metrics registry are
+        long-lived replica-side stores (resourceVersion/seq continuity
+        across resyncs — a watcher must not see the version space
+        restart), while object/queue/cache state belongs to the new
+        runtime."""
+        old = self.runtime
+        if old is not None:
+            rt.events = old.events
+            rt.audit = old.audit
+            rt.metrics = old.metrics
+        rt.journal = None  # replicas never append (single-writer log)
+        self.runtime = rt
+        if self.on_install is not None:
+            self.on_install(rt)
+
+    # ---- sync ----
+    def status(self) -> dict:
+        behind = None
+        return {
+            "appliedSeq": self.applied_seq,
+            "appliedEventsRv": self.events_rv,
+            "appliedAuditSeq": self.audit_seq,
+            "lagSeconds": round(self.lag_s, 3),
+            "recordsApplied": self.records_applied,
+            "skippedStaleRecords": self.skipped_stale,
+            "resyncs": self.resyncs,
+            "fencingToken": self.max_token,
+            "lastError": self.last_error,
+            "lastPollAgoS": (
+                round(self.now_fn() - self.last_poll_ts, 3)
+                if self.last_poll_ts is not None
+                else behind
+            ),
+        }
+
+    def resync(self) -> bool:
+        """Checkpoint fetch + full runtime rebuild — the fallback when
+        incremental tailing cannot continue (first attach against a
+        compacted journal, compaction jump, fencing re-anchor). Returns
+        False (current runtime keeps serving) when the source has no
+        checkpoint or the rebuild fails."""
+        faults.fire("replica.resync")
+        ckpt = self.source.checkpoint()
+        if ckpt is None:
+            return False
+        from kueue_tpu import serialization as ser
+
+        fresh = self.build_runtime()
+        old = self.runtime
+        if old is not None:
+            # the long-lived spine must be on the runtime BEFORE the
+            # load so nothing lands on throwaway recorders
+            fresh.events = old.events
+            fresh.audit = old.audit
+            fresh.metrics = old.metrics
+        fresh.journal = None
+        ser.runtime_from_state(ckpt, runtime=fresh)
+        violations = fresh.check_invariants()
+        if violations:
+            raise TailSourceError(
+                "leader checkpoint violates invariants: "
+                + "; ".join(violations[:3])
+            )
+        persistence = ckpt.get("persistence") or {}
+        with self.lock:
+            self._install(fresh)
+            self.applied_seq = int(persistence.get("journalSeq", 0))
+            if persistence.get("token") is not None:
+                self.max_token = int(persistence["token"])
+        self.resyncs += 1
+        if self.metrics is not None:
+            self.metrics.replica_resyncs_total.inc()
+        return True
+
+    def poll_once(self) -> TailResult:
+        """One tail iteration: fetch past the cursor, re-anchor if the
+        prefix is gone or the fence moved, apply what remains. Never
+        raises on source failure — the replica keeps serving its last
+        consistent state and reports the error."""
+        res = TailResult()
+        try:
+            res = self._poll(res)
+            self.last_error = ""
+        except TailSourceError as e:
+            self.last_error = str(e)
+            res.error = self.last_error
+        self.last_poll_ts = self.now_fn()
+        if self.metrics is not None:
+            self.metrics.replica_applied_seq.set(self.applied_seq)
+            self.metrics.replica_lag_seconds.set(self.lag_s)
+        return res
+
+    def _poll(self, res: TailResult) -> TailResult:
+        self.ensure_runtime()
+        batch = self.source.fetch(
+            self.applied_seq, self.events_rv, self.audit_seq,
+            status={"appliedSeq": self.applied_seq,
+                    "lagSeconds": round(self.lag_s, 3)},
+        )
+        if batch.compacted or batch.last_seq < self.applied_seq:
+            # the leader cannot serve our resume point: compaction ate
+            # it, or the head REGRESSED (fresh journal dir / restore
+            # from older backup) — both mean our prefix is not a prefix
+            # of the leader's log anymore
+            faults.fire("replica.tail_gap")
+            res.resynced = self.resync()
+            if not res.resynced:
+                raise TailSourceError(
+                    "resume seq unavailable and no checkpoint to resync "
+                    f"from (cursor {self.applied_seq}, leader floor "
+                    f"{batch.first_available_seq})"
+                )
+            batch = self.source.fetch(
+                self.applied_seq, self.events_rv, self.audit_seq
+            )
+        applied_ts = None
+        for rec in batch.records:
+            if rec.seq <= self.applied_seq:
+                continue  # overlap from a re-poll
+            if rec.seq != self.applied_seq + 1:
+                # a hole inside the feed itself — never expected from a
+                # healthy leader; resync rather than apply out of order
+                faults.fire("replica.tail_gap")
+                if not self.resync():
+                    raise TailSourceError(
+                        f"feed skipped seq {self.applied_seq + 1} -> "
+                        f"{rec.seq} and no checkpoint to resync from"
+                    )
+                res.resynced = True
+                break
+            if rec.token is not None:
+                if self.max_token is not None and rec.token < self.max_token:
+                    # a deposed leader's stray append: refuse it, but
+                    # advance past it — recovery replay does the same
+                    self.applied_seq = rec.seq
+                    self.skipped_stale += 1
+                    res.skipped_stale += 1
+                    continue
+                if self.max_token is not None and rec.token > self.max_token:
+                    # leader handover: our applied prefix may contain
+                    # records the new leader's recovery refused —
+                    # re-anchor on its checkpoint instead of guessing
+                    faults.fire("replica.tail_gap")
+                    if self.resync():
+                        res.resynced = True
+                        break
+                    # no checkpoint: adopt the new fence and keep
+                    # tailing (journal-only topologies — recovery
+                    # semantics make the applied records idempotent)
+                self.max_token = (
+                    rec.token if self.max_token is None
+                    else max(self.max_token, rec.token)
+                )
+            with self.lock:
+                apply_record(self.runtime, rec)
+                self.applied_seq = rec.seq
+                self.runtime.resource_version = max(
+                    getattr(self.runtime, "resource_version", 0), rec.rv
+                )
+            self.records_applied += 1
+            res.applied += 1
+            applied_ts = rec.ts
+            if self.metrics is not None:
+                self.metrics.replica_records_applied_total.inc()
+        # event / audit mirroring (HTTP feed; empty lists otherwise)
+        rec_events = self.runtime.events
+        if batch.events_too_old:
+            rec_events.note_gap(batch.events_rv)
+        for item in batch.events:
+            rec_events.ingest(item)
+        self.events_rv = max(self.events_rv, batch.events_rv)
+        for item in batch.audit:
+            self.runtime.audit.ingest(item)
+        self.audit_seq = max(self.audit_seq, batch.audit_seq)
+        # inconsistent-feed fence: behind with nothing shipped and no
+        # compaction marker — tolerate a couple (a torn in-flight tail
+        # frame reads as empty), then re-anchor on a checkpoint
+        if (
+            res.applied == 0
+            and not res.resynced
+            and not batch.records
+            and batch.last_seq > self.applied_seq
+        ):
+            self._empty_behind += 1
+            if self._empty_behind >= 3:
+                self._empty_behind = 0
+                faults.fire("replica.tail_gap")
+                if self.resync():
+                    res.resynced = True
+                else:
+                    raise TailSourceError(
+                        f"feed reports head {batch.last_seq} past cursor "
+                        f"{self.applied_seq} but ships no records and no "
+                        "checkpoint is available"
+                    )
+        else:
+            self._empty_behind = 0
+        # staleness: the shipping delay of the newest record this poll
+        # applied (leader append-stamp -> replica apply, leader-clock
+        # stamped so cross-host skew clamps at 0); an idle caught-up
+        # poll (nothing new to ship) reads 0
+        res.caught_up = self.applied_seq >= batch.last_seq
+        if applied_ts:
+            self.lag_s = max(0.0, self.now_fn() - applied_ts)
+        elif res.caught_up:
+            self.lag_s = 0.0
+        return res

@@ -118,6 +118,22 @@ FAULT_POINTS: Dict[str, str] = {
         "winner's stale copy; the dispatcher must refuse the token and "
         "retract the copy instead of double-admitting"
     ),
+    # ---- journal-tailing read replicas (kueue_tpu/storage/tailer.py) ----
+    "replica.tail_gap": (
+        "the tailer just detected that the leader can no longer serve "
+        "its resume position (compaction deleted the segment under it, "
+        "the leader's head regressed, or the feed skipped a seq) and is "
+        "about to fall back to a checkpoint resync — arm with 'crash' "
+        "to kill the replica in the window, or a raising action to "
+        "model the detection racing a concurrent compact()"
+    ),
+    "replica.resync": (
+        "checkpoint resync: the leader's state dump is fetched and a "
+        "fresh runtime is about to be rebuilt from it (first attach, "
+        "compaction jump, or fencing-token re-anchor after a leader "
+        "handover) — arm to fail or crash the rebuild; the tailer must "
+        "keep serving the previous runtime and retry on the next poll"
+    ),
 }
 
 

@@ -1,0 +1,96 @@
+"""Bench compact-line schema lint (over the stage registry): every
+``bench.py`` invocation's final compact JSON line must carry
+``headline_ms`` + ``backend`` — the ``BENCH_*.json`` contract the
+growth driver tail-parses — so ``--serve`` and future stages cannot
+silently drift from it. Pure-function lint: the stage registry, the
+single-stage CLI modes, the headline-promotion fallback and the
+compact-line builder are exercised on synthetic records, no benchmark
+runs.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_module", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCompactLineContract:
+    def test_every_single_stage_mode_names_a_registered_stage(self, bench):
+        for flag, stages in bench.SINGLE_STAGE_MODES.items():
+            assert stages, f"{flag} runs no stages"
+            for name in stages:
+                assert name in bench.STAGES, (
+                    f"{flag} names unregistered stage {name!r}"
+                )
+            # a one-stage mode must be able to promote its metric to
+            # the headline slot, or its compact line ships headline_ms
+            # null on a SUCCESSFUL run
+            assert stages[0] in bench.HEADLINE_FALLBACK_STAGES, (
+                f"{flag}'s stage {stages[0]!r} has no headline fallback"
+            )
+
+    def test_fallback_stages_are_registered(self, bench):
+        for name in bench.HEADLINE_FALLBACK_STAGES:
+            assert name in bench.STAGES
+
+    def test_compact_line_always_has_headline_and_backend(self, bench):
+        # full run: the headline stage supplies value directly
+        record = bench.finalize_headline({"value": 47.1, "backend": "tpu"})
+        compact = bench.compact_line(record)
+        assert compact["headline_ms"] == 47.1
+        assert compact["backend"] == "tpu"
+        # each single-stage mode: the stage's *_value triple promotes
+        for flag, stages in bench.SINGLE_STAGE_MODES.items():
+            name = stages[0]
+            record = bench.finalize_headline(
+                {
+                    f"{name}_value": 12.5,
+                    f"{name}_metric": f"{name} metric",
+                    f"{name}_unit": "ms",
+                    "backend": "cpu-fallback",
+                }
+            )
+            compact = bench.compact_line(record)
+            assert set(compact) >= {"headline_ms", "backend"}, flag
+            assert compact["headline_ms"] == 12.5, (
+                f"{flag}: stage value did not promote to headline_ms"
+            )
+            assert compact["backend"] == "cpu-fallback"
+        # total failure still yields the contract keys (value None)
+        record = bench.finalize_headline({"backend": "error"})
+        compact = bench.compact_line(record)
+        assert set(compact) >= {"headline_ms", "backend"}
+
+    def test_compact_extras_reference_known_keys(self, bench):
+        # every extra source key is produced by some stage's record —
+        # approximated by requiring the stage-name prefix convention
+        prefixes = tuple(bench.STAGES) + ("serve",)
+        for src, dst in bench.COMPACT_EXTRAS:
+            assert any(src.startswith(p) for p in prefixes), src
+            assert dst
+        # the --serve contract keys specifically
+        record = bench.finalize_headline(
+            {
+                "serve_value": 9.9,
+                "serve_unit": "ms",
+                "serve_admissions_per_s": 50.0,
+                "serve_read_qps": 1000.0,
+                "serve_max_lag_s": 0.1,
+                "backend": "cpu-fallback",
+            }
+        )
+        compact = bench.compact_line(record)
+        assert set(compact) >= {
+            "headline_ms", "backend", "admissions_per_s", "read_qps",
+            "max_lag_s",
+        }

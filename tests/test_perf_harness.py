@@ -25,6 +25,63 @@ class TestGenerator:
         assert len(scenario.workloads) == 5 * (35 + 10 + 5)
 
 
+class TestArrivalProcess:
+    """The open-loop arrival-stream config (bench.py --serve)."""
+
+    def test_uniform_spacing(self):
+        import numpy as np
+
+        from kueue_tpu.perf.generator import ArrivalProcess
+
+        proc = ArrivalProcess(
+            rate_per_s=10.0, duration_s=2.0, process="uniform"
+        )
+        times = proc.arrival_times(np.random.default_rng(0))
+        assert len(times) == 20
+        gaps = {round(b - a, 6) for a, b in zip(times, times[1:])}
+        assert gaps == {0.1}
+
+    def test_poisson_is_seeded_and_rate_correct(self):
+        import numpy as np
+
+        from kueue_tpu.perf.generator import ArrivalProcess
+
+        proc = ArrivalProcess(rate_per_s=100.0, duration_s=20.0)
+        a = proc.arrival_times(np.random.default_rng(7))
+        b = proc.arrival_times(np.random.default_rng(7))
+        assert a == b, "same seed must reproduce the same stream"
+        # law of large numbers: ~2000 arrivals within 10%
+        assert 1800 <= len(a) <= 2200
+        assert all(0.0 <= t < 20.0 for t in a)
+        assert a == sorted(a)
+
+    def test_arrival_stream_round_robins_queues_and_classes(self):
+        import numpy as np
+        import pytest
+
+        from kueue_tpu.perf.generator import (
+            ArrivalProcess,
+            arrival_stream,
+        )
+
+        proc = ArrivalProcess(
+            rate_per_s=5.0, duration_s=2.0, process="uniform"
+        )
+        stream = arrival_stream(
+            proc, ["lq-0", "lq-1"], np.random.default_rng(0)
+        )
+        assert len(stream) == 10
+        assert {gw.workload.queue_name for gw in stream} == {"lq-0", "lq-1"}
+        assert {gw.class_name for gw in stream} == {"small", "medium"}
+        for gw in stream:
+            assert gw.workload.creation_time == gw.creation_s
+            assert gw.runtime_s > 0
+        with pytest.raises(ValueError):
+            ArrivalProcess(process="bursty").arrival_times(
+                np.random.default_rng(0)
+            )
+
+
 class TestRunner:
     def test_scaled_run_admits_everything(self):
         result = run(DEFAULT_GENERATOR_CONFIG.scaled(0.04))
