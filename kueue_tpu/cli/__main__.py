@@ -512,6 +512,43 @@ def _render_decision_timeline(key: str, status: str, rows: List[dict]) -> None:
                 print(f"      topology [{ps_name}]: {doms}")
 
 
+def _render_trace_summary(rows: List[dict], trace_payload: dict) -> None:
+    """The explain footer (kueue_tpu/tracing): the workload's trace id
+    plus per-span durations of the cycle that produced its LAST
+    decision — where the time between enqueue and that decision went."""
+    tid = trace_payload.get("traceId") or next(
+        (d["traceId"] for d in reversed(rows) if d.get("traceId")), None
+    )
+    if not tid:
+        return
+    print(f"Trace:         {tid}")
+    spans = trace_payload.get("spans", [])
+    # the last decision span's cycle trace carries the durations
+    cycle_tid = None
+    for s in spans:
+        if s.get("traceId") == tid and (s.get("attrs") or {}).get("cycleTrace"):
+            cycle_tid = s["attrs"]["cycleTrace"]
+    if cycle_tid is None:
+        return
+    cycle_spans = [s for s in spans if s.get("traceId") == cycle_tid]
+    if not cycle_spans:
+        return
+    root = next((s for s in cycle_spans if s.get("name") == "cycle"), None)
+    label = ""
+    if root is not None:
+        attrs = root.get("attrs") or {}
+        label = (
+            f" (cycle {attrs.get('cycle', '?')}, "
+            f"{attrs.get('resolution', '?')})"
+        )
+    print(f"Trace spans{label}:")
+    for s in sorted(cycle_spans, key=lambda x: x.get("start", 0.0)):
+        indent = "  " if s.get("name") == "cycle" else "    "
+        dur = s.get("durationMs")
+        dur_str = f"{dur:.3f} ms" if dur is not None else "open"
+        print(f"{indent}{s.get('name')}: {dur_str}")
+
+
 def cmd_explain(state: State, args) -> None:
     """Why is this workload pending (or how was it admitted)? Renders
     the decision audit trail; --server reads a live control plane,
@@ -519,11 +556,18 @@ def cmd_explain(state: State, args) -> None:
     writes) to reproduce the decisions."""
     ns, name = args.namespace, args.name
     key = f"{ns}/{name}"
+    trace_payload: dict = {}
     if getattr(args, "server", None):
         client = _server_client(args)
         wl_dict = client.get_workload(ns, name)
         wl = ser.workload_from_dict(wl_dict)
         rows = client.workload_decisions(ns, name).get("items", [])
+        from kueue_tpu.server.client import ClientError
+
+        try:
+            trace_payload = client.workload_trace(ns, name)
+        except (ClientError, OSError):
+            trace_payload = {}  # pre-tracing server / evicted trace
         _replica_note(client)
     else:
         rt = state.build_runtime()
@@ -532,6 +576,9 @@ def cmd_explain(state: State, args) -> None:
         if wl is None:
             raise SystemExit(f"error: workload {key!r} not found")
         rows = [r.to_dict() for r in rt.audit.for_workload(key)]
+        from kueue_tpu.tracing import workload_trace_payload
+
+        trace_payload = workload_trace_payload(rt, key)
     status = "PENDING"
     if wl.is_finished:
         status = "FINISHED"
@@ -542,6 +589,7 @@ def cmd_explain(state: State, args) -> None:
     elif not wl.active:
         status = "INACTIVE"
     _render_decision_timeline(key, status, rows)
+    _render_trace_summary(rows, trace_payload)
     # MultiKueue federation: the dispatcher stamps the winning worker
     # cluster into the local workload's labels
     from kueue_tpu.federation import WINNER_LABEL
@@ -549,6 +597,75 @@ def cmd_explain(state: State, args) -> None:
     winner = (wl.labels or {}).get(WINNER_LABEL)
     if winner:
         print(f'Winning cluster: "{winner}" (MultiKueue federation)')
+
+
+def cmd_trace(state: State, args) -> None:
+    """`kueuectl trace <wl> [-o trace.json]` — the workload's full
+    distributed trace: lifecycle spans plus every cycle span tree its
+    decisions reference, as a text tree or (with -o) Chrome
+    trace-event JSON loadable in Perfetto / chrome://tracing.
+    --server reads a live control plane (leader OR replica — replicas
+    mirror the leader's spans off the journal feed); otherwise the
+    state file is scheduled in memory and ITS trace is rendered."""
+    ns, name = args.namespace, args.name
+    key = f"{ns}/{name}"
+    if getattr(args, "server", None):
+        client = _server_client(args)
+        payload = client.workload_trace(ns, name)
+        _replica_note(client)
+    else:
+        rt = state.build_runtime()
+        rt.run_until_idle()  # in-memory only
+        if key not in rt.workloads:
+            raise SystemExit(f"error: workload {key!r} not found")
+        from kueue_tpu.tracing import workload_trace_payload
+
+        payload = workload_trace_payload(rt, key)
+    spans = payload.get("spans", [])
+    if not spans:
+        print(f"Workload:      {key}")
+        print("Trace:         <none recorded>")
+        print(
+            "  (traces are kept in a bounded in-memory store; an old "
+            "workload's trace may have been evicted)"
+        )
+        return
+    if getattr(args, "output", None):
+        from kueue_tpu.tracing import to_chrome_trace
+
+        with open(args.output, "w") as f:
+            json.dump(to_chrome_trace(spans), f, indent=1)
+        print(
+            f"wrote {len(spans)} spans to {args.output} "
+            "(Chrome trace-event JSON; open in Perfetto or "
+            "chrome://tracing)"
+        )
+        return
+    print(f"Workload:      {key}")
+    print(f"Trace:         {payload.get('traceId')}")
+    by_trace: Dict[str, List[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s.get("traceId", ""), []).append(s)
+    # lifecycle trace first, referenced cycle traces after
+    ordered = sorted(
+        by_trace.items(),
+        key=lambda kv: (kv[0] != payload.get("traceId"), kv[0]),
+    )
+    for tid, group in ordered:
+        kind = "lifecycle" if tid == payload.get("traceId") else "cycle"
+        print(f"  [{kind}] {tid}")
+        roots = {s["spanId"] for s in group if not s.get("parentId")}
+        for s in sorted(group, key=lambda x: (x.get("start", 0.0))):
+            indent = "    " if s["spanId"] in roots else "      "
+            dur = s.get("durationMs")
+            dur_str = f"{dur:.3f} ms" if dur is not None else "open"
+            attrs = s.get("attrs") or {}
+            extra = ""
+            if "outcome" in attrs:
+                extra = f" [{attrs['outcome']}/{attrs.get('reason', '')}]"
+            elif "event" in attrs:
+                extra = f" [{attrs['event']}]"
+            print(f"{indent}{s.get('name')}: {dur_str}{extra}")
 
 
 def cmd_clusters(state: State, args) -> None:
@@ -1276,6 +1393,22 @@ def build_parser() -> argparse.ArgumentParser:
         exp, "read the decision trail from a running kueue_tpu.server"
     )
     exp.set_defaults(fn=cmd_explain)
+
+    tr = sub.add_parser(
+        "trace",
+        help="render a workload's distributed trace (lifecycle + "
+        "cycle span trees); -o exports Chrome trace-event JSON for "
+        "Perfetto",
+    )
+    tr.add_argument("name")
+    tr.add_argument("-n", "--namespace", default="default")
+    tr.add_argument(
+        "-o", "--output",
+        help="write Chrome trace-event JSON here instead of printing "
+        "the span tree (load in Perfetto / chrome://tracing)",
+    )
+    _add_server_flags(tr, "read traces from a running kueue_tpu.server")
+    tr.set_defaults(fn=cmd_trace)
 
     cl = sub.add_parser(
         "clusters",

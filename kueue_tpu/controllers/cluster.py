@@ -69,6 +69,10 @@ class ClusterRuntime:
         # AND pipelined-prefetched) over the mesh's wl axis. None/"off"
         # = single-device (the pre-PR-8 behavior).
         mesh=None,
+        # Distributed tracing (kueue_tpu/tracing): always-on span
+        # subsystem — workload lifecycle traces + per-cycle span trees.
+        # False = no-op tracer (the bench.py --trace baseline).
+        tracing: bool = True,
     ):
         from kueue_tpu.metrics import Metrics
 
@@ -89,6 +93,16 @@ class ClusterRuntime:
         # the server's watch/SSE surface resumes from
         self.events = EventRecorder(clock=self.clock)
         self.metrics = Metrics()
+        # distributed tracing (kueue_tpu/tracing): ONE tracer shared by
+        # scheduler, audit log, guard and journal — workload lifecycle
+        # traces (trace ids stamped into decisions/events) + cycle span
+        # trees, served at /debug/traces and shipped to read replicas
+        # on the journal feed
+        from kueue_tpu.tracing import Tracer
+
+        self.tracer = Tracer(
+            clock=self.clock, metrics=self.metrics, enabled=tracing
+        )
         # per-workload decision audit trail (core/audit.py): every
         # admission decision — host cycle, device cycle, bulk drain —
         # lands here; served at /debug/workloads/<ns>/<name>/decisions
@@ -96,6 +110,7 @@ class ClusterRuntime:
         from kueue_tpu.core.audit import DecisionAuditLog
 
         self.audit = DecisionAuditLog(clock=self.clock)
+        self.audit.tracer = self.tracer
         self.audit.observers.append(self._record_decision_metric)
         # Durable-state spine (kueue_tpu/storage): when a Journal is
         # attached (attach_journal), every state mutation appends a
@@ -143,6 +158,9 @@ class ClusterRuntime:
             metrics=self.metrics,
             journal_hook=self._journal_guard_record,
         )
+        # guard spans (divergence checks, failovers) land on the
+        # in-flight cycle's span tree
+        self.guard.tracer = self.tracer
         # the most recent journaled solver divergence verdict (replayed
         # by recovery so a restart knows which path produced the
         # admitted state on disk)
@@ -187,6 +205,7 @@ class ClusterRuntime:
             audit=self.audit,
             guard=self.guard,
             quarantine=self.quarantine,
+            tracer=self.tracer,
         )
         self.scheduler.on_quarantine = self._on_workload_quarantined
         self.job_reconciler = JobReconciler(
@@ -284,6 +303,9 @@ class ClusterRuntime:
         delta = total - self._mesh_place_seen
         if delta > 0:
             self.metrics.mesh_allgather_seconds.inc(delta)
+            self.tracer.add_cycle_span(
+                "cycle.mesh_place", delta, attrs={"mesh": self._mesh_label}
+            )
             self._mesh_place_seen = total
 
     def _make_preemptor(self, fair_sharing: bool):
@@ -303,6 +325,7 @@ class ClusterRuntime:
         storage.Journal). Wire AFTER recovery: replay applies records
         through the same mutation methods and must not re-append."""
         journal.metrics = self.metrics
+        journal.tracer = self.tracer  # fsync spans on the cycle tree
         self.journal = journal
         self.metrics.journal_degraded.set(1 if journal.degraded else 0)
         self.metrics.journal_segments.set(journal.stats().segments)
@@ -447,7 +470,15 @@ class ClusterRuntime:
 
     # ---- events ----
     def event(self, kind: str, wl: Workload, message: str = "") -> None:
-        ev = self.events.record(kind, wl.key, message)
+        tid = self.tracer.workload_trace_id(wl.key) or ""
+        ev = self.events.record(kind, wl.key, message, trace_id=tid)
+        # lifecycle span on the FIRST occurrence of a series (the same
+        # count-dedup bound journaling uses); Admitted closes the root
+        # and observes queue-to-admission latency
+        self.tracer.note_event(
+            kind, wl.key, ev.count,
+            cq=wl.admission.cluster_queue if wl.admission else "",
+        )
         # status transitions mutate workloads in place (admission set/
         # cleared, check states flipped); the informer cache the
         # reference indexes over sees those as update events, so the
@@ -844,12 +875,23 @@ class ClusterRuntime:
             # inactive workloads never queue (workload_controller.go
             # create/update handlers route them out of the queues)
             self.queues.add_or_update_workload(wl)
+            # enqueue opens the lifecycle trace (idempotent across
+            # status-update re-adds); a propagated traceparent label
+            # (MultiKueue dispatch / HTTP apply) JOINS the upstream
+            # trace so one id spans manager, worker and replica
+            from kueue_tpu.tracing import TRACEPARENT_LABEL
+
+            self.tracer.begin_workload(
+                wl.key,
+                traceparent=(wl.labels or {}).get(TRACEPARENT_LABEL),
+            )
 
     def delete_workload(self, wl: Workload) -> None:
         self._journal_wl_delete(wl.key)
         self.workloads.pop(wl.key, None)
         self.indexer.delete(wl.key)
         self.audit.forget(wl.key)  # history follows the object lifecycle
+        self.tracer.forget_workload(wl.key)
         self.quarantine.forget(wl.key)  # strikes die with the object
         self.queues.delete_workload(wl)
         if self.topology_ungater is not None:
@@ -863,6 +905,7 @@ class ClusterRuntime:
 
     def on_workload_finished(self, wl: Workload) -> None:
         cq_name = wl.admission.cluster_queue if wl.admission else ""
+        self.tracer.end_workload(wl.key, status="Finished", cq=cq_name)
         self.queues.delete_workload(wl)
         if self.cache.delete_workload(wl):
             self.queues.queue_associated_inadmissible_workloads_after(cq_name)
@@ -1315,8 +1358,11 @@ class ClusterRuntime:
             return None
         t1 = _time.perf_counter()
         # the drain IS this iteration's cycle: number it before the
-        # apply so its decision records carry the right cycle id
+        # apply so its decision records carry the right cycle id — and
+        # open its span-tree buffer so those records (and any guard/
+        # journal spans the apply produces) reference this trace
         sched.scheduling_cycle += 1
+        sched.tracer.next_cycle(sched.scheduling_cycle)
         try:
             result = self._apply_drain_outcome(outcome, snapshot)
         except faults.InjectedCrash:
@@ -1325,6 +1371,7 @@ class ClusterRuntime:
             # admissions that committed stand (transactional per head);
             # unprocessed heads remain in their heaps for the cycle loop
             sched.guard.note_contained_cycle(exc)
+            sched.tracer.discard_cycle()
             return None
         t_apply = _time.perf_counter() - t1
         sched.guard.phase_checkpoint("drain.apply", device_used=True)
@@ -1350,6 +1397,7 @@ class ClusterRuntime:
             host_s=dt - t_solve,
             mesh=self._mesh_label,
         )
+        sched.tracer.record_cycle(trace)
         sched.last_traces.append(trace)
         self._report_cycle_metrics(result, dt)
         sched.notify_cycle(result)
@@ -1491,12 +1539,18 @@ class ClusterRuntime:
             sched.guard.begin_cycle()
             t1 = _time.perf_counter()
             sched.scheduling_cycle += 1
+            # the round's span-tree buffer: decision records from the
+            # apply, discard markers and guard/journal spans land here;
+            # flushed atomically with the round's CycleTrace below — a
+            # crash at any fault point in between drops it whole
+            sched.tracer.next_cycle(sched.scheduling_cycle)
             try:
                 result = self._apply_drain_outcome(outcome, snapshot)
             except faults.InjectedCrash:
                 raise  # simulated power loss: the chaos suite's window
             except Exception as exc:  # noqa: BLE001 — contained apply
                 sched.guard.note_contained_cycle(exc)
+                sched.tracer.discard_cycle()
                 _set_inflight(0)
                 return last_result
             t_apply = _time.perf_counter() - t1
@@ -1520,6 +1574,10 @@ class ClusterRuntime:
                     if pf is not None:
                         stats.discards += 1
                         self.metrics.pipeline_prefetch_discards_total.inc()
+                        sched.tracer.add_cycle_span(
+                            "cycle.discard",
+                            attrs={"why": "backlog vanished mid-apply"},
+                        )
                     undecided = []
                 committed = (
                     undecided
@@ -1543,6 +1601,10 @@ class ClusterRuntime:
                     if pf is not None:
                         stats.discards += 1
                         self.metrics.pipeline_prefetch_discards_total.inc()
+                        sched.tracer.add_cycle_span(
+                            "cycle.discard",
+                            attrs={"why": "speculation invalidated"},
+                        )
                     _set_inflight(0)
                     t1 = _time.perf_counter()
                     glaunch = _launch(snapshot2, pending2)
@@ -1575,6 +1637,7 @@ class ClusterRuntime:
                 host_s=dt - t_solve,
                 mesh=self._mesh_label,
             )
+            sched.tracer.record_cycle(trace)
             sched.last_traces.append(trace)
             self._report_cycle_metrics(result, dt)
             sched.notify_cycle(result)

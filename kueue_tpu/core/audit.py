@@ -69,6 +69,10 @@ class DecisionRecord:
     # dedup merge RESTAMPS the merged record) — the replication feed's
     # resume cursor, exactly the EventRecorder resourceVersion pattern
     seq: int = 0
+    # the workload's lifecycle trace id (kueue_tpu/tracing), stamped at
+    # record time so `kueuectl explain` and read replicas correlate
+    # this decision with its span tree. Empty = untraced emitter.
+    trace_id: str = ""
 
     def __post_init__(self):
         if self.last_cycle < self.cycle:
@@ -102,6 +106,8 @@ class DecisionRecord:
             "timestamp": self.timestamp,
             "seq": self.seq,
         }
+        if self.trace_id:
+            out["traceId"] = self.trace_id
         if self.flavors:
             out["flavors"] = self.flavors
         if self.flavor_reasons:
@@ -136,6 +142,7 @@ class DecisionRecord:
             last_cycle=int(d.get("lastCycle", 0)),
             timestamp=float(d.get("timestamp", 0.0)),
             seq=int(d.get("seq", 0)),
+            trace_id=d.get("traceId", ""),
         )
 
 
@@ -173,6 +180,11 @@ class DecisionAuditLog:
         # called with each incoming record (before dedup-merge), the
         # runtime's metric mirror hangs here
         self.observers: List[Callable[[DecisionRecord], None]] = []
+        # distributed tracing (kueue_tpu/tracing): when attached, every
+        # record is stamped with its workload's lifecycle trace id, and
+        # every NEW ring entry (not a dedup merge — hot requeue loops
+        # must not spam spans) lands as decision spans on that trace
+        self.tracer = None
 
     def _now(self) -> float:
         if self._clock is not None:
@@ -187,6 +199,9 @@ class DecisionAuditLog:
                 f"decision reason {rec.reason!r} is not a canonical "
                 "InadmissibleReason — ad-hoc reason strings are not allowed"
             )
+        tracer = self.tracer
+        if tracer is not None and not rec.trace_id:
+            rec.trace_id = tracer.workload_trace_id(rec.workload) or ""
         with self._lock:
             rec.timestamp = self._now()
             ring = self._records.get(rec.workload)

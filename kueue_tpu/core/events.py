@@ -51,10 +51,14 @@ class Event:
     first_timestamp: float = 0.0
     last_timestamp: float = 0.0
     resource_version: int = 0
+    # distributed-tracing annotation (kueue_tpu/tracing): the regarded
+    # workload's lifecycle trace id — watch/SSE consumers can jump from
+    # an event straight to its waterfall. Empty = untraced emitter.
+    trace_id: str = ""
 
     def to_dict(self) -> dict:
         ns, _, name = self.object_key.rpartition("/")
-        return {
+        out = {
             "reason": self.kind,
             "object": self.object_key,
             "message": self.message,
@@ -68,6 +72,9 @@ class Event:
             "lastTimestamp": self.last_timestamp,
             "resourceVersion": self.resource_version,
         }
+        if self.trace_id:
+            out["traceId"] = self.trace_id
+        return out
 
 
 class EventRecorder:
@@ -95,6 +102,7 @@ class EventRecorder:
         object_key: str,
         message: str = "",
         regarding_kind: str = "Workload",
+        trace_id: str = "",
     ) -> Event:
         with self._cond:
             now = self._now()
@@ -105,6 +113,8 @@ class EventRecorder:
                 ev.count += 1
                 ev.last_timestamp = now
                 ev.resource_version = self._rv
+                if trace_id:
+                    ev.trace_id = trace_id
                 self._ring.remove(ev)
                 self._ring.append(ev)
             else:
@@ -116,6 +126,7 @@ class EventRecorder:
                     first_timestamp=now,
                     last_timestamp=now,
                     resource_version=self._rv,
+                    trace_id=trace_id,
                 )
                 self._ring.append(ev)
                 self._series[key] = ev
@@ -157,6 +168,8 @@ class EventRecorder:
                 ev.count = int(item.get("count", ev.count + 1))
                 ev.last_timestamp = float(item.get("lastTimestamp", 0.0))
                 ev.resource_version = rv
+                if item.get("traceId"):
+                    ev.trace_id = item["traceId"]
                 self._ring.remove(ev)
                 self._ring.append(ev)
             else:
@@ -169,6 +182,7 @@ class EventRecorder:
                     first_timestamp=float(item.get("firstTimestamp", 0.0)),
                     last_timestamp=float(item.get("lastTimestamp", 0.0)),
                     resource_version=rv,
+                    trace_id=item.get("traceId", ""),
                 )
                 self._ring.append(ev)
                 self._series[key] = ev
@@ -183,6 +197,14 @@ class EventRecorder:
                         del self._series[okey]
             self._cond.notify_all()
             return ev
+
+    def kick(self) -> None:
+        """Wake every parked watcher WITHOUT recording anything — the
+        read-replica tail calls this after a poll applies records so
+        blocked watch/SSE waiters re-evaluate immediately instead of
+        rediscovering state at their next bounded-wait tick."""
+        with self._cond:
+            self._cond.notify_all()
 
     def note_gap(self, rv: int) -> None:
         """Replication gap marker: the upstream feed could not fill

@@ -402,6 +402,10 @@ class SolverGuard:
         self.record_event = record_event or (lambda reason, msg: None)
         self.metrics = metrics
         self.journal_hook = journal_hook or (lambda rtype, data: None)
+        # tracing hook (kueue_tpu/tracing): failovers and divergence
+        # checks land as spans on the in-flight cycle's span tree.
+        # None until the owning Scheduler/ClusterRuntime wires it.
+        self.tracer = None
         # counters (mirrored into kueue_solver_* when metrics attached)
         self.device_solves = 0
         self.failovers = 0
@@ -444,6 +448,10 @@ class SolverGuard:
         self.failovers += 1
         if self.metrics is not None:
             self.metrics.solver_failovers_total.inc(reason=label)
+        if self.tracer is not None:
+            self.tracer.add_cycle_span(
+                "cycle.guard_failover", attrs={"cause": label}
+            )
         opened = self.breaker.record_failure(reason)
         if opened:
             self.record_event(
@@ -607,7 +615,14 @@ class SolverGuard:
         if self.metrics is not None:
             self.metrics.solver_divergence_checks_total.inc()
         host_outcome, host_sig = host_solve()
-        self.divergence_check_s += _time.perf_counter() - t0
+        dt = _time.perf_counter() - t0
+        self.divergence_check_s += dt
+        if self.tracer is not None:
+            self.tracer.add_cycle_span(
+                "cycle.divergence_check", dt,
+                attrs={"surface": "drain-prefetch",
+                       "diverged": host_sig != device_sig},
+            )
         if host_sig == device_sig:
             return None
         bad = sorted(
@@ -683,7 +698,13 @@ class SolverGuard:
             self.metrics.solver_divergence_checks_total.inc()
         host = self._mirror_of(snapshot, lowered)
         bad = results_match(device_res, host)
-        self.divergence_check_s += _time.perf_counter() - t0
+        dt = _time.perf_counter() - t0
+        self.divergence_check_s += dt
+        if self.tracer is not None:
+            self.tracer.add_cycle_span(
+                "cycle.divergence_check", dt,
+                attrs={"surface": "cycle", "diverged": bool(bad)},
+            )
         if not bad:
             return None
         self.divergences += 1

@@ -149,9 +149,13 @@ class CycleTrace:
     # mesh annotation: "off" single-device, else the active mesh shape
     # ("wl=8", "wl=4,fr=2") the drain solves sharded over
     mesh: str = "off"
+    # cycle span-tree id (kueue_tpu/tracing): the phase timings above
+    # are lowered into real parent/child spans under this trace, served
+    # at /debug/traces/<id> and referenced by decision records
+    trace_id: str = ""
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "cycle": self.cycle,
             "heads": self.heads,
             "admitted": self.admitted,
@@ -163,6 +167,9 @@ class CycleTrace:
             "mesh": self.mesh,
             "spansMs": {k: round(v * 1e3, 3) for k, v in self.spans.items()},
         }
+        if self.trace_id:
+            out["traceId"] = self.trace_id
+        return out
 
 
 @dataclass
@@ -215,6 +222,7 @@ class Scheduler:
         audit: Optional[DecisionAuditLog] = None,
         guard: Optional[SolverGuard] = None,
         quarantine: Optional[QuarantineList] = None,
+        tracer=None,  # tracing.Tracer; None = a private always-on one
     ):
         self.queues = queues
         self.cache = cache
@@ -247,15 +255,28 @@ class Scheduler:
         self.use_preempt_solver = use_preempt_solver
         self.preempt_solver_threshold = preempt_solver_threshold
         self.transform_config = transform_config
+        # distributed tracing (kueue_tpu/tracing): cycle span trees are
+        # buffered per cycle and flushed atomically with the CycleTrace;
+        # a bare Scheduler gets its own tracer, ClusterRuntime shares
+        # one across scheduler/audit/guard/journal
+        if tracer is None:
+            from kueue_tpu.tracing import Tracer
+
+            tracer = Tracer(clock=clock)
+        self.tracer = tracer
         # per-workload decision audit trail; both resolution paths (and
         # the runtime's bulk drain) record through the same log
         self.audit = audit if audit is not None else DecisionAuditLog(clock=clock)
+        if self.audit.tracer is None:
+            self.audit.tracer = self.tracer
         # Resilient solver executor (core/guard.py): exception
         # containment + wall-clock deadline around every device launch,
         # device-path circuit breaker with host-mirror failover, sampled
         # divergence detection. A bare Scheduler gets a hookless guard;
         # ClusterRuntime wires events/metrics/journal into it.
         self.guard = guard if guard is not None else SolverGuard(clock=clock)
+        if getattr(self.guard, "tracer", None) is None:
+            self.guard.tracer = self.tracer
         # Poison-workload quarantine: shared with the runtime (its TTL
         # sweep and kueuectl surface) when one is attached.
         self.quarantine = (
@@ -306,6 +327,10 @@ class Scheduler:
         if not heads:
             self.notify_cycle(result)
             return result
+        # open the cycle span-tree buffer: mid-cycle spans (divergence
+        # checks, fsyncs) and decision records reference its trace id;
+        # _finish_trace flushes it atomically, a crashed cycle drops it
+        self.tracer.next_cycle(self.scheduling_cycle)
         trace.spans["heads"] = _time.perf_counter() - t0
         try:
             return self._schedule_guarded(heads, result, trace, t0)
@@ -583,6 +608,9 @@ class Scheduler:
         trace.resolution = result.resolution
         trace.device_s = self._cycle_device_s
         trace.host_s = max(trace.total_s - self._cycle_device_s, 0.0)
+        # the phase timings above, lowered into a real span tree (one
+        # atomic flush — a cycle that never reaches here leaks nothing)
+        self.tracer.record_cycle(trace)
         self.last_traces.append(trace)
 
     # ---- decision audit (core/audit.py) ----

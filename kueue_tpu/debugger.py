@@ -120,6 +120,24 @@ def dump(runtime) -> str:
     )
     if rep.get("lastError"):
         lines.append(f"lastError: {rep['lastError']}")
+    # tracing posture (kueue_tpu/tracing): store occupancy + the most
+    # recent cycle span tree — a hung server's last-cycle time
+    # attribution is triagable from the signal dump alone
+    tracer = getattr(runtime, "tracer", None)
+    if tracer is not None:
+        st = tracer.stats()
+        lines.append("-- tracing (lifecycle + cycle span trees) --")
+        lines.append(
+            f"traces={st['traces']} spans={st['spans']} "
+            f"openSpans={st['openSpans']} seq={st['seq']} "
+            f"enabled={st['enabled']} passive={st['passive']}"
+        )
+        if traces and getattr(traces[-1], "trace_id", ""):
+            for s in tracer.trace(traces[-1].trace_id):
+                dur = (
+                    f"{s.duration * 1e3:.3f}ms" if s.ended else "open"
+                )
+                lines.append(f"  {s.name}: {dur}")
     # double-buffered drain loop posture (core/pipeline.py)
     pipe = getattr(runtime, "pipeline", None)
     if pipe is not None:

@@ -207,6 +207,12 @@ class FederationDispatcher:
     def _journal(self, rtype: str, data: dict) -> None:
         self.runtime._journal_append(rtype, data)
 
+    def _trace_span(self, name: str, key: str, attrs: dict) -> None:
+        """One federation hop on the workload's lifecycle trace."""
+        tracer = getattr(self.runtime, "tracer", None)
+        if tracer is not None:
+            tracer.add_workload_span(name, key, attrs)
+
     def restore(self, records: List[tuple]) -> None:
         """Rebuild dispatch state from replayed journal records (in
         append order). Mirrors are NOT assumed to exist — the first
@@ -391,6 +397,10 @@ class FederationDispatcher:
             DISPATCH_RECORD,
             {"key": st.key, "fence": st.fence, "clusters": st.clusters},
         )
+        self._trace_span(
+            "federation.dispatch", wl.key,
+            {"clusters": list(st.clusters), "fence": st.fence},
+        )
         self._set_pending(
             wl,
             "The workload is pending reservation in the worker clusters",
@@ -400,6 +410,24 @@ class FederationDispatcher:
         self._pick_winner(wl, st, now)
 
     def _remote_copy(self, wl: Workload, fence: int) -> Workload:
+        labels = {ORIGIN_LABEL: self.origin, FENCE_LABEL: str(fence)}
+        # W3C trace-context propagation: the mirrored copy carries the
+        # manager's lifecycle trace as a traceparent label, so the
+        # winning worker's runtime JOINS that trace instead of minting
+        # a fresh id — one trace spans manager, worker and replica
+        tracer = getattr(self.runtime, "tracer", None)
+        if tracer is not None:
+            tid = tracer.workload_trace_id(wl.key)
+            root = tracer.workload_root(wl.key)
+            if tid is not None and root is not None:
+                from kueue_tpu.tracing import (
+                    TRACEPARENT_LABEL,
+                    format_traceparent,
+                )
+
+                labels[TRACEPARENT_LABEL] = format_traceparent(
+                    tid, root.span_id
+                )
         return Workload(
             namespace=wl.namespace,
             name=wl.name,
@@ -409,7 +437,7 @@ class FederationDispatcher:
             priority_class_name=wl.priority_class_name,
             priority_class_source=wl.priority_class_source,
             creation_time=wl.creation_time,
-            labels={ORIGIN_LABEL: self.origin, FENCE_LABEL: str(fence)},
+            labels=labels,
         )
 
     def _retraction_outstanding(self, key: str, cluster: str) -> bool:
@@ -503,6 +531,10 @@ class FederationDispatcher:
         )
         self.health[winner].wins += 1
         wl.labels[WINNER_LABEL] = winner
+        self._trace_span(
+            "federation.winner", st.key,
+            {"cluster": winner, "fence": st.fence},
+        )
         self.runtime.event(
             "MultiKueueReserved", wl,
             f'The workload got reservation on "{winner}" (fence {st.fence})',
@@ -574,6 +606,11 @@ class FederationDispatcher:
             return
         if rwl.has_quota_reservation:
             if not wl.has_quota_reservation:
+                self._trace_span(
+                    "federation.sync_back", st.key,
+                    {"cluster": st.winner, "fence": st.fence,
+                     "observed": "QuotaReserved"},
+                )
                 wl.set_condition(
                     WorkloadConditionType.QUOTA_RESERVED, True,
                     reason="QuotaReserved",
@@ -709,6 +746,10 @@ class FederationDispatcher:
 
     def _ack_retraction(self, r: Retraction) -> None:
         r.acked = True
+        self._trace_span(
+            "federation.retract", r.key,
+            {"cluster": r.cluster, "fence": r.fence},
+        )
         self._journal(
             RETRACT_DONE_RECORD,
             {"key": r.key, "cluster": r.cluster, "fence": r.fence},

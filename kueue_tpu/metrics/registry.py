@@ -144,6 +144,24 @@ class Histogram(_Metric):
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
 
+    def observe_many(self, values, **labels) -> None:
+        """Batched observe: one label-key resolution for a whole list
+        of observations (hot-path mirrors batch per cycle — per-sample
+        key hashing would cost more than the samples)."""
+        key = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            total = self._sums.get(key, 0.0)
+            n = 0
+            for value in values:
+                for i, b in enumerate(self.buckets):
+                    if value <= b:
+                        counts[i] += 1
+                total += value
+                n += 1
+            self._sums[key] = total
+            self._totals[key] = self._totals.get(key, 0) + n
+
     def touch(self, **labels) -> None:
         """Materialize a zero-count series for a known label value, so
         closed label sets expose complete (all-zero) bucket/sum/count

@@ -41,6 +41,11 @@ class ReadReplica:
             build_runtime=build_runtime,
             on_install=self._on_install,
         )
+        # SSE/watch fan-out: a poll that applied anything wakes every
+        # blocked watch long-poll / SSE tail immediately — clients see
+        # the tailer's own arrival instead of rediscovering state at
+        # their next bounded-wait tick (ROADMAP PR-9 follow-up)
+        self.tailer.on_applied = self._wake_watchers
         self._server = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -55,6 +60,11 @@ class ReadReplica:
         rt = self.tailer.ensure_runtime()
         self.tailer.metrics = rt.metrics
         server.runtime = rt
+
+    def _wake_watchers(self, _res) -> None:
+        rt = self.tailer.runtime
+        if rt is not None:
+            rt.events.kick()
 
     def _on_install(self, rt) -> None:
         # the runtime carries a back-pointer so surfaces that only see

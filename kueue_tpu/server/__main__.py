@@ -312,6 +312,13 @@ def main(argv=None) -> int:
         "leading (bounds data loss on SIGKILL; 0 disables)",
     )
     parser.add_argument(
+        "--trace-capacity", type=int, default=4096,
+        help="traces kept in the in-memory distributed-tracing store "
+        "(LRU-bounded; served at /debug/traces, exported by `kueuectl "
+        "trace`, replicated to read replicas on the journal feed). "
+        "0 disables tracing entirely",
+    )
+    parser.add_argument(
         "--auth-token",
         default=os.environ.get("KUEUE_AUTH_TOKEN") or None,
         help="bearer token gating mutating routes, metrics, state and "
@@ -408,16 +415,25 @@ def main(argv=None) -> int:
             rt.drain_pipeline = args.pipeline
             rt.pipeline_chunk_cycles = max(1, args.pipeline_chunk_cycles)
             rt.set_mesh(mesh)
+            _apply_trace_capacity(rt)
             return rt
         from kueue_tpu.controllers import ClusterRuntime
 
-        return ClusterRuntime(
+        rt = ClusterRuntime(
             use_solver=use_solver, tas_cache=TASCache(),
             solver_path=args.solver_path,
             drain_pipeline=args.pipeline,
             pipeline_chunk_cycles=args.pipeline_chunk_cycles,
             mesh=mesh,
         )
+        _apply_trace_capacity(rt)
+        return rt
+
+    def _apply_trace_capacity(rt):
+        if args.trace_capacity <= 0:
+            rt.tracer.enabled = False
+        else:
+            rt.tracer.max_traces = args.trace_capacity
 
     journal_opts = {
         "fsync_policy": args.journal_fsync,

@@ -611,6 +611,9 @@ _SECURED_ROUTES = frozenset(
         # the replication feed serializes every state mutation — gate
         # it exactly like /state
         "journal_tail",
+        # trace stores expose workload identities + timing: same gate
+        # as the decision audit surface
+        "debug_traces", "debug_trace_get", "workload_trace",
     }
 )
 
@@ -692,6 +695,13 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/reconcile$"), "reconcile"),
     ("GET", re.compile(r"^/events/stream$"), "events_stream"),
     ("GET", re.compile(r"^/debug/cycles$"), "debug_cycles"),
+    ("GET", re.compile(r"^/debug/traces$"), "debug_traces"),
+    ("GET", re.compile(r"^/debug/traces/([^/]+)$"), "debug_trace_get"),
+    (
+        "GET",
+        re.compile(r"^/debug/workloads/([^/]+)/([^/]+)/trace$"),
+        "workload_trace",
+    ),
     ("GET", re.compile(r"^/debug/quarantine$"), "quarantine_list"),
     ("POST", re.compile(r"^/debug/quarantine/clear$"), "quarantine_clear"),
     ("POST", re.compile(r"^/debug/plan$"), "plan"),
@@ -1050,12 +1060,33 @@ def _make_handler(srv: KueueServer):
         def _h_get(self, section, name, query):
             self._send_json(srv.get_object(section, "", name))
 
+        def _propagate_traceparent(self, section, obj) -> None:
+            """W3C trace-context over the HTTP plane: a ``traceparent``
+            request header on a workload upsert lands as the
+            traceparent label, so the receiving runtime JOINS the
+            caller's trace instead of minting a fresh id (the
+            kubeconfig-free analog of header propagation — labels
+            survive serialization, journaling and replication)."""
+            if section != "workloads" or not isinstance(obj, dict):
+                return
+            from kueue_tpu.tracing import TRACEPARENT_LABEL, parse_traceparent
+
+            header = self.headers.get("traceparent")
+            if parse_traceparent(header) is None:
+                return
+            labels = obj.setdefault("labels", {})
+            labels.setdefault(TRACEPARENT_LABEL, header)
+
         def _h_apply(self, section, query):
-            obj = srv.apply(section, self._body())
+            body = self._body()
+            self._propagate_traceparent(section, body)
+            obj = srv.apply(section, body)
             self._send_json({"applied": obj})
 
         def _h_apply_batch(self, query):
             body = self._body()
+            for obj in body.get("workloads", []) or []:
+                self._propagate_traceparent("workloads", obj)
             counts = srv.apply_batch(body)
             self._send_json({"applied": counts})
 
@@ -1112,6 +1143,44 @@ def _make_handler(srv: KueueServer):
                     t.to_dict() for t in srv.runtime.scheduler.last_traces
                 ]
             self._send_json({"cycles": traces})
+
+        def _h_debug_traces(self, query):
+            """Bounded in-memory trace store: newest traces first
+            (id, root span, span count, duration)."""
+            tracer = getattr(srv.runtime, "tracer", None)
+            limit = self._int_param(query, "limit", 64)
+            with srv.lock:
+                items = (
+                    tracer.traces_summary(limit) if tracer is not None else []
+                )
+            self._send_json({"items": items})
+
+        def _h_debug_trace_get(self, trace_id, query):
+            """One full span tree."""
+            tracer = getattr(srv.runtime, "tracer", None)
+            with srv.lock:
+                spans = (
+                    [s.to_dict() for s in tracer.trace(trace_id)]
+                    if tracer is not None
+                    else []
+                )
+            if not spans:
+                raise ApiError(404, f"trace {trace_id} not found")
+            self._send_json({"traceId": trace_id, "spans": spans})
+
+        def _h_workload_trace(self, ns, name, query):
+            """The workload's lifecycle trace plus every cycle trace
+            its decisions reference — the `kueuectl trace` payload
+            (Chrome-trace exportable)."""
+            from kueue_tpu.tracing import workload_trace_payload
+
+            key = f"{ns}/{name}"
+            with srv.lock:
+                payload = workload_trace_payload(srv.runtime, key)
+                known = key in srv.runtime.workloads
+            if not payload["spans"] and not known:
+                raise ApiError(404, f"workload {key} not found")
+            self._send_json(payload)
 
         def _h_quarantine_list(self, query):
             """Poison-workload quarantine triage (kueuectl quarantine
@@ -1243,6 +1312,15 @@ def _make_handler(srv: KueueServer):
             audit_seq = self._int_param(query, "sinceAuditSeq", 0)
             body["audit"] = audit.since(audit_seq) if audit is not None else []
             body["auditSeq"] = audit.seq if audit is not None else 0
+            # span delta (kueue_tpu/tracing): replicas render the
+            # LEADER's waterfalls, so the feed ships every span stamped
+            # since the replica's cursor alongside events/audit
+            tracer = getattr(srv.runtime, "tracer", None)
+            span_seq = self._int_param(query, "sinceSpanSeq", 0)
+            body["spans"] = (
+                tracer.since(span_seq) if tracer is not None else []
+            )
+            body["spansSeq"] = tracer.seq if tracer is not None else 0
             replica_id = query.get("replica")
             if replica_id:
                 try:

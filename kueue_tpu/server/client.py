@@ -42,6 +42,11 @@ class KueueClient:
         self.last_role: Optional[str] = None
         self.last_replica_lag_s: Optional[float] = None
         self.last_redirected_to: Optional[str] = None
+        # W3C trace-context propagation: when set, every request
+        # carries it as the ``traceparent`` header (workload upserts at
+        # the server join the caller's trace; the replication feed
+        # annotates the replica roster with it)
+        self.traceparent: Optional[str] = None
         self._ssl_context = None
         if base_url.startswith("https"):
             import ssl
@@ -67,6 +72,8 @@ class KueueClient:
         headers = {"Content-Type": "application/json"} if data else {}
         if self.token is not None:
             headers["Authorization"] = f"Bearer {self.token}"
+        if self.traceparent:
+            headers["traceparent"] = self.traceparent
         req = urllib.request.Request(
             url, data=data, method=method, headers=headers
         )
@@ -184,6 +191,24 @@ class KueueClient:
             "GET", f"/debug/workloads/{namespace}/{name}/decisions"
         )
 
+    # ---- distributed tracing (kueue_tpu/tracing) ----
+    def traces(self, limit: int = 64) -> dict:
+        """Newest traces in the server's bounded store:
+        {"items": [{traceId, root, spans, durationMs, attrs}]}."""
+        return self._request("GET", f"/debug/traces?limit={limit}")
+
+    def trace(self, trace_id: str) -> dict:
+        """One full span tree: {"traceId": ..., "spans": [...]}."""
+        return self._request("GET", f"/debug/traces/{trace_id}")
+
+    def workload_trace(self, namespace: str, name: str) -> dict:
+        """The workload's lifecycle trace plus its referenced cycle
+        traces (the `kueuectl trace` payload): {"workload", "traceId",
+        "spans"} — Chrome-trace exportable via tracing.to_chrome_trace."""
+        return self._request(
+            "GET", f"/debug/workloads/{namespace}/{name}/trace"
+        )
+
     def plan(
         self,
         scenarios: Optional[list] = None,
@@ -284,16 +309,18 @@ class KueueClient:
         replica: Optional[str] = None,
         applied_seq: Optional[int] = None,
         lag_s: Optional[float] = None,
+        since_span_seq: int = 0,
     ) -> dict:
         """One replication-feed poll (the JournalTailer wire): journal
-        records with seq > ``since_seq`` plus event/audit deltas, and
-        the leader's head/compaction-floor/fencing posture. ``replica``
-        + ``applied_seq``/``lag_s`` register this follower in the
-        leader's roster."""
+        records with seq > ``since_seq`` plus event/audit/span deltas,
+        and the leader's head/compaction-floor/fencing posture.
+        ``replica`` + ``applied_seq``/``lag_s`` register this follower
+        in the leader's roster."""
         params = [
             f"sinceSeq={since_seq}",
             f"sinceEventRv={since_event_rv}",
             f"sinceAuditSeq={since_audit_seq}",
+            f"sinceSpanSeq={since_span_seq}",
             f"limit={limit}",
         ]
         if replica:

@@ -168,7 +168,26 @@ def dashboard_payload(rt) -> dict:
     from kueue_tpu.replica import replication_section
 
     replication = replication_section(rt)
+    # trace waterfall (kueue_tpu/tracing): the most recent cycle's
+    # span tree — on a replica these are the LEADER's spans, mirrored
+    # off the journal feed
+    tracer = getattr(rt, "tracer", None)
+    last_trace = None
+    if tracer is not None:
+        tid = traces[-1].trace_id if traces else None
+        if not tid:
+            # replicas never run cycles: fall back to the newest cycle
+            # trace in the (ingested) store
+            for summary in tracer.traces_summary(limit=32):
+                if summary.get("root") == "cycle":
+                    tid = summary["traceId"]
+                    break
+        if tid:
+            spans = [s.to_dict() for s in tracer.trace(tid)]
+            if spans:
+                last_trace = {"traceId": tid, "spans": spans}
     return {
+        "lastTrace": last_trace,
         "solver": solver,
         "pipeline": pipeline,
         "mesh": mesh,
@@ -252,6 +271,7 @@ DASHBOARD_HTML = """<!doctype html>
  &middot; replication <span id="replication" class="badge">&hellip;</span></div>
 <div class="tiles" id="tiles"></div>
 <h2>Last cycle</h2><div id="cycle"></div>
+<h2>Trace waterfall</h2><div id="waterfall" class="muted">no trace yet</div>
 <h2>ClusterQueues</h2><div id="cqs"></div>
 <h2>Why pending</h2><div id="why"></div>
 <h2>What would it take?</h2><div id="plan" class="muted">pick <b>plan</b> on a pending workload above to sweep candidate fixes (quota bumps, borrowing lifts) through the capacity planner</div>
@@ -279,7 +299,27 @@ function renderEvents(){
       `<td>${esc(e.object)}</td><td>${e.count||1}</td>`+
       `<td>${esc(e.message)}</td></tr>`).join('')+'</table>';
 }
+function renderWaterfall(t){
+  const el = document.getElementById('waterfall');
+  if (!t || !(t.spans||[]).length){ el.innerHTML = '<span class="muted">no trace yet</span>'; return; }
+  const spans = t.spans.slice().sort((a,b)=>(a.start-b.start));
+  const t0 = Math.min(...spans.map(s=>s.start));
+  const t1 = Math.max(...spans.map(s=>s.start + (s.durationMs||0)/1e3));
+  const total = Math.max(t1 - t0, 1e-9);
+  el.innerHTML = `<div class="muted" style="margin-bottom:4px">trace <code>${esc(t.traceId)}</code>`+
+    ` &middot; ${spans.length} spans &middot; ${(total*1e3).toFixed(2)} ms</div>`+
+    '<table>'+spans.map(s=>{
+      const left = 100*(s.start - t0)/total;
+      const w = Math.max(100*((s.durationMs||0)/1e3)/total, 0.5);
+      const dur = s.durationMs==null ? 'open' : s.durationMs.toFixed(3)+' ms';
+      const depth = s.parentId ? 1 : 0;
+      return `<tr><td style="padding-left:${10+depth*14}px;white-space:nowrap"><code>${esc(s.name)}</code></td>`+
+        `<td style="width:55%"><span class="bar" style="width:100%"><i style="margin-left:${left}%;width:${w}%"></i></span></td>`+
+        `<td class="muted" style="white-space:nowrap">${dur}</td></tr>`;
+    }).join('')+'</table>';
+}
 function render(d){
+  renderWaterfall(d.lastTrace);
   const sv = d.solver||{};
   const svEl = document.getElementById('solver');
   if (sv.path){

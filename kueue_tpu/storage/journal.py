@@ -292,6 +292,10 @@ class Journal:
         self.segment_max_bytes = segment_max_bytes
         self.token_provider = token_provider
         self.metrics = metrics
+        # tracing hook (kueue_tpu/tracing): real fsync syscalls land as
+        # cycle.journal_fsync spans on the in-flight cycle's span tree
+        # (wired by ClusterRuntime.attach_journal; None = untraced)
+        self.tracer = None
         self.last_seq = 0
         self.last_rv = 0
         self.degraded = False
@@ -472,11 +476,16 @@ class Journal:
         """fsync the active segment (raises OSError on failure —
         callers on the append path translate that into degraded)."""
         faults.fire("journal.fsync")
+        t0 = time.monotonic()
         os.fsync(self._fh.fileno())
         self._last_fsync = time.monotonic()
         self._fsyncs += 1
         if self.metrics is not None:
             self.metrics.journal_fsyncs_total.inc()
+        if self.tracer is not None:
+            self.tracer.add_cycle_span(
+                "cycle.journal_fsync", self._last_fsync - t0
+            )
 
     # ---- reading ----
     def segment_paths(self) -> List[str]:

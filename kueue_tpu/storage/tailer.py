@@ -81,6 +81,11 @@ class TailBatch:
     events_too_old: bool = False
     audit: List[dict] = field(default_factory=list)
     audit_seq: int = 0
+    # span delta (kueue_tpu/tracing; HTTP feed only): the leader's
+    # lifecycle/cycle spans, ingested verbatim so replica waterfalls
+    # render the leader's trace ids
+    spans: List[dict] = field(default_factory=list)
+    spans_seq: int = 0
     leader_time: float = 0.0
 
 
@@ -102,8 +107,8 @@ class LocalTailSource:
         self.limit = limit
 
     def fetch(self, since_seq: int, since_event_rv: int = 0,
-              since_audit_seq: int = 0, status: Optional[dict] = None
-              ) -> TailBatch:
+              since_audit_seq: int = 0, status: Optional[dict] = None,
+              since_span_seq: int = 0) -> TailBatch:
         try:
             names = _list_segments(self.journal_path)
         except OSError as e:
@@ -158,8 +163,8 @@ class HTTPTailSource:
         )
 
     def fetch(self, since_seq: int, since_event_rv: int = 0,
-              since_audit_seq: int = 0, status: Optional[dict] = None
-              ) -> TailBatch:
+              since_audit_seq: int = 0, status: Optional[dict] = None,
+              since_span_seq: int = 0) -> TailBatch:
         from kueue_tpu.server.client import ClientError
 
         status = status or {}
@@ -168,6 +173,7 @@ class HTTPTailSource:
                 since_seq=since_seq,
                 since_event_rv=since_event_rv,
                 since_audit_seq=since_audit_seq,
+                since_span_seq=since_span_seq,
                 limit=self.limit,
                 replica=self.replica_id,
                 applied_seq=status.get("appliedSeq"),
@@ -190,6 +196,8 @@ class HTTPTailSource:
                 events_too_old=bool(out.get("eventsTooOld", False)),
                 audit=out.get("audit", []),
                 audit_seq=int(out.get("auditSeq", 0)),
+                spans=out.get("spans", []),
+                spans_seq=int(out.get("spansSeq", 0)),
                 leader_time=float(out.get("leaderTime", 0.0)),
             )
         except (KeyError, TypeError, ValueError) as e:
@@ -213,6 +221,10 @@ class TailResult:
     resynced: bool = False
     caught_up: bool = False
     error: str = ""
+    # event/span items this poll ingested (drives the watcher wake-up:
+    # a poll that changed ANY read surface kicks blocked waiters)
+    events_ingested: int = 0
+    spans_ingested: int = 0
 
 
 class JournalTailer:
@@ -252,7 +264,13 @@ class JournalTailer:
         self.applied_seq = 0
         self.events_rv = 0
         self.audit_seq = 0
+        self.span_seq = 0
         self.max_token: Optional[int] = None
+        # SSE/watch fan-out (replica/replica.py wires this): called
+        # after any poll that applied records or ingested events/spans,
+        # so blocked watch/SSE waiters wake on the tailer's own arrival
+        # instead of rediscovering at the next bounded-wait tick
+        self.on_applied: Optional[Callable[[TailResult], None]] = None
         # accounting (stable across resyncs — the runtime is rebuilt,
         # the tailer is not)
         self.records_applied = 0
@@ -291,6 +309,13 @@ class JournalTailer:
             rt.events = old.events
             rt.audit = old.audit
             rt.metrics = old.metrics
+            if getattr(old, "tracer", None) is not None:
+                rt.tracer = old.tracer
+        tracer = getattr(rt, "tracer", None)
+        if tracer is not None:
+            # replicas render the LEADER's spans: local recording off,
+            # ingest/reads stay live (seq continuity across resyncs)
+            tracer.passive = True
         rt.journal = None  # replicas never append (single-writer log)
         self.runtime = rt
         if self.on_install is not None:
@@ -303,6 +328,7 @@ class JournalTailer:
             "appliedSeq": self.applied_seq,
             "appliedEventsRv": self.events_rv,
             "appliedAuditSeq": self.audit_seq,
+            "appliedSpanSeq": self.span_seq,
             "lagSeconds": round(self.lag_s, 3),
             "recordsApplied": self.records_applied,
             "skippedStaleRecords": self.skipped_stale,
@@ -371,15 +397,40 @@ class JournalTailer:
         if self.metrics is not None:
             self.metrics.replica_applied_seq.set(self.applied_seq)
             self.metrics.replica_lag_seconds.set(self.lag_s)
+        if self.on_applied is not None and (
+            res.applied or res.events_ingested or res.spans_ingested
+            or res.resynced
+        ):
+            self.on_applied(res)
         return res
+
+    def _fetch(self):
+        """One source fetch. ``since_span_seq`` is passed only to
+        sources that accept it (custom/legacy sources predate the span
+        delta and must keep working)."""
+        import inspect
+
+        kwargs = {
+            "status": {
+                "appliedSeq": self.applied_seq,
+                "lagSeconds": round(self.lag_s, 3),
+            },
+        }
+        try:
+            params = inspect.signature(self.source.fetch).parameters
+            if "since_span_seq" in params or any(
+                p.kind == p.VAR_KEYWORD for p in params.values()
+            ):
+                kwargs["since_span_seq"] = self.span_seq
+        except (TypeError, ValueError):
+            kwargs["since_span_seq"] = self.span_seq
+        return self.source.fetch(
+            self.applied_seq, self.events_rv, self.audit_seq, **kwargs
+        )
 
     def _poll(self, res: TailResult) -> TailResult:
         self.ensure_runtime()
-        batch = self.source.fetch(
-            self.applied_seq, self.events_rv, self.audit_seq,
-            status={"appliedSeq": self.applied_seq,
-                    "lagSeconds": round(self.lag_s, 3)},
-        )
+        batch = self._fetch()
         if batch.compacted or batch.last_seq < self.applied_seq:
             # the leader cannot serve our resume point: compaction ate
             # it, or the head REGRESSED (fresh journal dir / restore
@@ -445,16 +496,23 @@ class JournalTailer:
             applied_ts = rec.ts
             if self.metrics is not None:
                 self.metrics.replica_records_applied_total.inc()
-        # event / audit mirroring (HTTP feed; empty lists otherwise)
+        # event / audit / span mirroring (HTTP feed; empty otherwise)
         rec_events = self.runtime.events
         if batch.events_too_old:
             rec_events.note_gap(batch.events_rv)
         for item in batch.events:
-            rec_events.ingest(item)
+            if rec_events.ingest(item) is not None:
+                res.events_ingested += 1
         self.events_rv = max(self.events_rv, batch.events_rv)
         for item in batch.audit:
             self.runtime.audit.ingest(item)
         self.audit_seq = max(self.audit_seq, batch.audit_seq)
+        tracer = getattr(self.runtime, "tracer", None)
+        if tracer is not None:
+            for item in batch.spans:
+                tracer.ingest(item)
+                res.spans_ingested += 1
+        self.span_seq = max(self.span_seq, batch.spans_seq)
         # inconsistent-feed fence: behind with nothing shipped and no
         # compaction marker — tolerate a couple (a torn in-flight tail
         # frame reads as empty), then re-anchor on a checkpoint

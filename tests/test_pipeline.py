@@ -142,8 +142,14 @@ def journal_sequence(journal_dir):
 
 
 def audit_dump(rt):
+    # traceId is a per-process random identifier (kueue_tpu/tracing),
+    # not part of the decision: strip it before the bit-for-bit compare
+    def strip(d):
+        d.pop("traceId", None)
+        return d
+
     return {
-        key: [r.to_dict() for r in rt.audit.for_workload(key)]
+        key: [strip(r.to_dict()) for r in rt.audit.for_workload(key)]
         for key in rt.audit.keys()
     }
 

@@ -1,0 +1,814 @@
+"""Distributed tracing (kueue_tpu/tracing) tests.
+
+Span-tree structural properties (every cycle span parented, no
+orphans, monotone clock), the closed span-name registry + its source
+lint (the reason-enum lint pattern), traceparent propagation across an
+in-process federation manager→worker pair and a leader→replica journal
+tail, the crash chaos case (``cycle.commit_pre_apply`` never leaks
+half-open spans through recovery), the HTTP/CLI surfaces, and the
+``kueue_trace_*`` metric families.
+"""
+
+import json
+import re
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.core.scheduler import _LatencyEstimate
+from kueue_tpu.models import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import ResourceGroup
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.storage import Journal, recover
+from kueue_tpu.testing import faults
+from kueue_tpu.tracing import (
+    SPAN_NAMES,
+    TRACEPARENT_LABEL,
+    Tracer,
+    format_traceparent,
+    lifecycle_spans,
+    parse_traceparent,
+    to_chrome_trace,
+    workload_trace_payload,
+)
+from kueue_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def build_rt(
+    n_cq=2, n_wl=8, cpu="4", clock=None, tracing=True, **kw
+):
+    rt = ClusterRuntime(
+        clock=clock or FakeClock(0.0),
+        use_solver=False,
+        bulk_drain_threshold=None,
+        tracing=tracing,
+        **kw,
+    )
+    rt.add_flavor(ResourceFlavor(name="default"))
+    for i in range(n_cq):
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name=f"cq-{i}",
+                namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",),
+                        (FlavorQuotas.build("default", {"cpu": cpu}),),
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(
+            LocalQueue(namespace="ns", name=f"lq-{i}", cluster_queue=f"cq-{i}")
+        )
+    for j in range(n_wl):
+        rt.add_workload(
+            Workload(
+                namespace="ns",
+                name=f"w{j}",
+                queue_name=f"lq-{j % n_cq}",
+                creation_time=float(j),
+                pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+            )
+        )
+    return rt
+
+
+def admitted(rt):
+    return frozenset(k for k, w in rt.workloads.items() if w.is_admitted)
+
+
+def cycle_traces(tracer):
+    """trace id -> spans, for every trace rooted at a cycle span."""
+    out = {}
+    for summary in tracer.traces_summary(limit=10_000):
+        if summary["root"] == "cycle":
+            out[summary["traceId"]] = tracer.trace(summary["traceId"])
+    return out
+
+
+class TestSpanTreeProperties:
+    def test_cycle_spans_parented_no_orphans_monotone(self):
+        rt = build_rt()
+        rt.run_until_idle()
+        trees = cycle_traces(rt.tracer)
+        assert trees, "no cycle traces recorded"
+        for tid, spans in trees.items():
+            ids = {s.span_id for s in spans}
+            roots = [s for s in spans if s.parent_id is None]
+            assert len(roots) == 1, f"{tid}: expected exactly one root"
+            root = roots[0]
+            assert root.name == "cycle" and root.ended
+            for s in spans:
+                assert s.trace_id == tid
+                if s.parent_id is not None:
+                    assert s.parent_id in ids, f"orphan span {s.name}"
+                # monotone clock: spans end at or after they start, and
+                # children start no earlier than the tree's origin
+                assert s.ended and s.duration >= 0
+                assert s.start >= root.start - max(root.duration, 0.0) - 1.0
+            # seq stamps strictly increase in record order
+            seqs = [s.seq for s in spans]
+            assert seqs == sorted(seqs)
+            assert len(set(seqs)) == len(seqs)
+        # nothing cycle-shaped left open anywhere
+        assert rt.tracer.open_spans("cycle") == []
+
+    def test_lifecycle_trace_arc(self):
+        rt = build_rt(n_cq=1, n_wl=3, cpu="2")
+        rt.run_until_idle()
+        # cpu=2 admits w0+w1; w2 stays pending
+        tid, spans = lifecycle_spans(rt, "ns/w0")
+        assert tid == rt.tracer.workload_trace_id("ns/w0")
+        names = [s["name"] for s in spans]
+        assert names[0] == "workload.lifecycle"
+        for expected in (
+            "workload.enqueue",
+            "workload.quota_reserve",
+            "workload.admit",
+            "workload.nominate",
+        ):
+            assert expected in names
+        root = spans[0]
+        assert root["durationMs"] is not None
+        assert root["attrs"].get("status") == "Admitted"
+        # every non-root span parents to the root
+        for s in spans[1:]:
+            assert s["parentId"] == root["spanId"]
+        # a pending workload's root stays open (by design)
+        pending_tid = rt.tracer.workload_trace_id("ns/w2")
+        pending_root = rt.tracer.trace(pending_tid)[0]
+        assert not pending_root.ended
+        # queue-to-admission histogram observed for the admitted CQ
+        text = rt.metrics.registry.expose()
+        assert (
+            'kueue_trace_queue_to_admission_seconds_count'
+            '{cluster_queue="cq-0"} 2' in text
+        )
+
+    def test_decision_records_and_cycle_traces_correlate(self):
+        rt = build_rt()
+        rt.run_until_idle()
+        rec = rt.audit.latest("ns/w0")
+        tid = rt.tracer.workload_trace_id("ns/w0")
+        assert rec.trace_id == tid
+        assert rec.to_dict()["traceId"] == tid
+        # the decision span names the cycle trace that decided it, and
+        # that trace exists with phase children
+        _, spans = lifecycle_spans(rt, "ns/w0")
+        decision = next(
+            s for s in spans if s["name"] == "workload.nominate"
+        )
+        cycle_tid = decision["attrs"]["cycleTrace"]
+        cycle_names = {s.name for s in rt.tracer.trace(cycle_tid)}
+        assert "cycle" in cycle_names
+        assert {"cycle.snapshot", "cycle.nominate", "cycle.admit"} <= cycle_names
+        # /debug/cycles carries the same id
+        trace = next(
+            t for t in rt.scheduler.last_traces if t.trace_id == cycle_tid
+        )
+        assert trace.to_dict()["traceId"] == cycle_tid
+        # events carry the lifecycle trace id on the wire
+        admitted_ev = next(
+            e
+            for e in rt.events
+            if e.kind == "Admitted" and e.object_key == "ns/w0"
+        )
+        assert admitted_ev.to_dict()["traceId"] == tid
+
+    def test_hot_requeue_churn_produces_no_span_growth(self):
+        # one workload that never fits: repeat cycles dedup into audit
+        # count bumps and must NOT grow its lifecycle trace (stored OR
+        # synthesized)
+        rt = build_rt(n_cq=1, n_wl=1, cpu="0")
+        rt.run_until_idle()
+        tid = rt.tracer.workload_trace_id("ns/w0")
+        before_stored = len(rt.tracer.trace(tid))
+        before_synth = len(lifecycle_spans(rt, "ns/w0")[1])
+        for _ in range(5):
+            rt.queues.queue_inadmissible_workloads({"cq-0"})
+            rt.run_until_idle()
+        assert len(rt.tracer.trace(tid)) == before_stored
+        assert len(lifecycle_spans(rt, "ns/w0")[1]) == before_synth
+
+    def test_tracing_never_changes_decisions(self):
+        a = build_rt(n_cq=3, n_wl=24, cpu="5", tracing=True)
+        b = build_rt(n_cq=3, n_wl=24, cpu="5", tracing=False)
+        a.run_until_idle()
+        b.run_until_idle()
+        assert admitted(a) == admitted(b)
+        assert len(b.tracer) == 0  # disabled tracer records nothing
+
+    def test_store_is_bounded_lru(self):
+        rt = build_rt(n_cq=1, n_wl=0)
+        rt.tracer.max_traces = 4
+        for j in range(12):
+            rt.add_workload(
+                Workload(
+                    namespace="ns", name=f"b{j}", queue_name="lq-0",
+                    creation_time=float(j),
+                    pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+                )
+            )
+        st = rt.tracer.stats()
+        assert st["traces"] <= 4
+        # evicted workloads lost their index entry, newest kept it
+        assert rt.tracer.workload_trace_id("ns/b11") is not None
+        assert rt.tracer.workload_trace_id("ns/b0") is None
+
+
+class TestSpanNameRegistry:
+    def test_tracer_rejects_ad_hoc_names(self):
+        tr = Tracer()
+        with pytest.raises(ValueError, match="closed registry"):
+            tr.record_span("made.up", trace_id="t", parent_id=None)
+        tr.next_cycle(1)
+        with pytest.raises(ValueError, match="closed registry"):
+            tr.add_cycle_span("cycle.bogus")
+        with pytest.raises(ValueError, match="closed registry"):
+            tr.add_workload_span("workload.bogus", "ns/x")
+
+    def test_source_span_names_are_registered(self):
+        """Static lint over the package: every literal span name at a
+        recording call site must be a member of SPAN_NAMES — the
+        EVENT_REASONS lint pattern applied to tracing."""
+        pkg = Path(__file__).resolve().parent.parent / "kueue_tpu"
+        call = re.compile(
+            r"\.(?:add_cycle_span|add_workload_span|record_span"
+            r"|_trace_span)\(\s*\n?\s*\"([A-Za-z_.]+)\""
+        )
+        offenders = []
+        found = set()
+        for path in sorted(pkg.rglob("*.py")):
+            for name in call.findall(path.read_text()):
+                found.add(name)
+                if name not in SPAN_NAMES:
+                    offenders.append((str(path.relative_to(pkg)), name))
+        assert not offenders, (
+            f"ad-hoc span names (add to SPAN_NAMES or fix the call "
+            f"site): {offenders}"
+        )
+        assert found, "lint matched no call sites — pattern rotted"
+
+    def test_cycle_phase_mapping_covers_emitted_phases(self):
+        from kueue_tpu.tracing import CYCLE_PHASE_SPANS
+
+        for phase, name in CYCLE_PHASE_SPANS.items():
+            assert name in SPAN_NAMES, (phase, name)
+
+    def test_metric_families_materialized_at_zero(self):
+        from kueue_tpu.metrics import Metrics
+
+        text = Metrics().registry.expose()
+        assert 'kueue_trace_spans_total{name="cycle.solve"} 0' in text
+        assert 'kueue_trace_spans_total{name="workload.lifecycle"} 0' in text
+        assert "kueue_trace_queue_to_admission_seconds_bucket" in text
+
+
+class TestTraceparent:
+    def test_round_trip(self):
+        tr = Tracer()
+        tid = tr.new_trace_id()
+        assert len(tid) == 32
+        span_id = tr._next_id(16)
+        header = format_traceparent(tid, span_id)
+        assert parse_traceparent(header) == (tid, span_id)
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            None,
+            "",
+            "garbage",
+            "00-short-span-01",
+            "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace
+            "00-" + "z" * 32 + "-" + "1" * 16 + "-01",  # non-hex
+        ],
+    )
+    def test_malformed_rejected(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_begin_workload_joins_propagated_trace(self):
+        upstream = Tracer()
+        tid = upstream.begin_workload("ns/x")
+        root = upstream.workload_root("ns/x")
+        downstream = Tracer()
+        joined = downstream.begin_workload(
+            "ns/x", traceparent=format_traceparent(tid, root.span_id)
+        )
+        assert joined == tid
+        down_root = downstream.workload_root("ns/x")
+        assert down_root.trace_id == tid
+        assert down_root.parent_id == root.span_id
+
+
+class TestFederationPropagation:
+    """One workload admitted via MultiKueue dispatch yields ONE trace
+    id on the manager and the winning worker, with the worker's
+    lifecycle root parented into the manager's — and the union of both
+    planes' spans forms a single connected tree covering
+    enqueue→dispatch→worker decision→sync-back→admit."""
+
+    def _federate(self):
+        from kueue_tpu.admissionchecks.multikueue import MultiKueueCluster
+        from kueue_tpu.federation import FederationDispatcher
+
+        clock = FakeClock(0.0)
+        workers = {}
+        clusters = {}
+        for name in ("east", "west"):
+            rt = build_rt(n_cq=1, n_wl=0, cpu="10", clock=clock)
+            workers[name] = rt
+            clusters[name] = MultiKueueCluster(name=name, runtime=rt)
+        mgr = ClusterRuntime(clock=clock, use_solver=False)
+        disp = FederationDispatcher(
+            mgr, clusters=clusters, drive_inprocess=True,
+            worker_lost_timeout=20.0,
+        )
+        return mgr, disp, workers, clock
+
+    def test_single_trace_spans_manager_and_winner(self):
+        from kueue_tpu.federation import WINNER_LABEL
+
+        mgr, disp, workers, clock = self._federate()
+        # worker LQs are namespaced ns/lq-0; the manager mirrors the
+        # workload verbatim, so its queue name must resolve remotely
+        mgr.add_workload(
+            Workload(
+                namespace="ns", name="fed-1", queue_name="lq-0",
+                pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+            )
+        )
+        for _ in range(6):
+            mgr.run_until_idle()
+            clock.advance(5.0)
+        wl = mgr.workloads["ns/fed-1"]
+        assert wl.is_admitted
+        winner = wl.labels[WINNER_LABEL]
+        wrt = workers[winner]
+
+        mtid = mgr.tracer.workload_trace_id("ns/fed-1")
+        assert mtid is not None
+        # the winner's plane carries the SAME trace id (traceparent
+        # label propagation through the dispatch copy)
+        assert wrt.tracer.workload_trace_id("ns/fed-1") == mtid
+        assert (
+            wrt.workloads["ns/fed-1"].labels[TRACEPARENT_LABEL].split("-")[1]
+            == mtid
+        )
+
+        _, mgr_spans = lifecycle_spans(mgr, "ns/fed-1")
+        _, wrk_spans = lifecycle_spans(wrt, "ns/fed-1")
+        names = {s["name"] for s in mgr_spans}
+        assert {
+            "workload.lifecycle", "workload.enqueue",
+            "federation.dispatch", "federation.winner",
+            "federation.sync_back", "workload.quota_reserve",
+            "workload.admit",
+        } <= names
+        assert {"workload.lifecycle", "workload.nominate"} <= {
+            s["name"] for s in wrk_spans
+        }
+        # connected tree across planes: one root, every parent resolves
+        union = mgr_spans + wrk_spans
+        ids = {s["spanId"] for s in union}
+        roots = [s for s in union if s["parentId"] is None]
+        assert len(roots) == 1
+        for s in union:
+            if s["parentId"] is not None:
+                assert s["parentId"] in ids, f"disconnected {s['name']}"
+        # the manager root closed on admission with the e2e latency
+        assert roots[0]["durationMs"] is not None
+        # ...and the worker's decision references its own cycle trace
+        # (the encode/solve/apply layer of the waterfall)
+        decision = next(
+            s for s in wrk_spans if s["name"] == "workload.nominate"
+        )
+        cycle_tid = decision["attrs"]["cycleTrace"]
+        assert {s.name for s in wrt.tracer.trace(cycle_tid)} >= {"cycle"}
+
+
+    def test_trace_reaches_a_replica_tailing_the_manager(self, tmp_path):
+        """The acceptance e2e: one workload admitted via federation
+        dispatch yields a single trace id visible on the manager, the
+        winning worker AND a replica tailing the manager's journal
+        feed."""
+        from kueue_tpu.federation import WINNER_LABEL
+        from kueue_tpu.replica import ReadReplica
+        from kueue_tpu.server import KueueServer
+
+        mgr, disp, workers, clock = self._federate()
+        journal = Journal(str(tmp_path / "mgr-journal")).open()
+        mgr.attach_journal(journal)
+        srv = KueueServer(runtime=mgr, auto_reconcile=False)
+        port = srv.start()
+        rep = ReadReplica(
+            f"http://127.0.0.1:{port}", replica_id="fed-rep",
+            build_runtime=lambda: ClusterRuntime(
+                use_solver=False, bulk_drain_threshold=None
+            ),
+        )
+        try:
+            rep.sync(resync=True)
+            mgr.add_workload(
+                Workload(
+                    namespace="ns", name="fed-2", queue_name="lq-0",
+                    pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+                )
+            )
+            for _ in range(6):
+                mgr.run_until_idle()
+                clock.advance(5.0)
+            rep.sync()
+            wl = mgr.workloads["ns/fed-2"]
+            assert wl.is_admitted
+            mtid = mgr.tracer.workload_trace_id("ns/fed-2")
+            assert mtid is not None
+            winner = workers[wl.labels[WINNER_LABEL]]
+            assert winner.tracer.workload_trace_id("ns/fed-2") == mtid
+            replica_rt = rep.tailer.runtime
+            assert replica_rt.tracer.workload_trace_id("ns/fed-2") == mtid
+            # the replica's copy of the manager trace covers the full
+            # enqueue→dispatch→sync-back→admit arc, span ids preserved
+            _, rep_spans = lifecycle_spans(replica_rt, "ns/fed-2")
+            _, mgr_spans = lifecycle_spans(mgr, "ns/fed-2")
+            assert {
+                "workload.lifecycle", "workload.enqueue",
+                "federation.dispatch", "federation.winner",
+                "federation.sync_back", "workload.admit",
+            } <= {s["name"] for s in rep_spans}
+            assert {s["spanId"] for s in rep_spans} == {
+                s["spanId"] for s in mgr_spans
+            }
+            # the replica's trace payload (kueuectl trace / explain
+            # footer) resolves to the same id — no audit record exists
+            # on the manager plane (the WORKERS decided), the tracer
+            # index alone carries it
+            payload = workload_trace_payload(replica_rt, "ns/fed-2")
+            assert payload["traceId"] == mtid
+        finally:
+            srv.stop()
+            journal.close()
+
+
+def _wire_wl(name):
+    return {
+        "namespace": "ns", "name": name, "queueName": "lq-0",
+        "podSets": [{"name": "main", "count": 1, "requests": {"cpu": "1"}}],
+    }
+
+
+@pytest.fixture()
+def traced_pair(tmp_path):
+    """Journaled leader server + manually-synced HTTP read replica (the
+    test_replica http_pair shape, tracing-focused)."""
+    from kueue_tpu.replica import ReadReplica
+    from kueue_tpu.server import KueueServer
+    from kueue_tpu.server.client import KueueClient
+
+    class Pair:
+        def __init__(self):
+            self.rt = build_rt(n_cq=1, n_wl=0, cpu="8")
+            self.journal = Journal(str(tmp_path / "journal")).open()
+            self.rt.attach_journal(self.journal)
+            self.srv = KueueServer(runtime=self.rt)
+            port = self.srv.start()
+            self.leader_url = f"http://127.0.0.1:{port}"
+            self.leader = KueueClient(self.leader_url)
+            self.rep = ReadReplica(
+                self.leader_url, replica_id="trace-rep",
+                build_runtime=lambda: ClusterRuntime(
+                    use_solver=False, bulk_drain_threshold=None
+                ),
+            )
+            self.rsrv = KueueServer(replica=self.rep)
+            rport = self.rsrv.start()
+            self.replica = KueueClient(f"http://127.0.0.1:{rport}")
+            self.rep.sync(resync=True)
+
+        def close(self):
+            self.rsrv.stop()
+            self.srv.stop()
+            self.journal.close()
+
+    pair = Pair()
+    yield pair
+    pair.close()
+
+
+class TestReplicaPropagation:
+    def test_replica_mirrors_leader_trace(self, traced_pair):
+        p = traced_pair
+        p.leader.apply("workloads", _wire_wl("wl-0"))
+        p.rep.sync()
+        leader_tid = p.rt.tracer.workload_trace_id("ns/wl-0")
+        assert leader_tid is not None
+        # the replica's tracer holds the LEADER's spans, same ids
+        replica_rt = p.rep.tailer.runtime
+        assert replica_rt.tracer.passive
+        assert replica_rt.tracer.workload_trace_id("ns/wl-0") == leader_tid
+        leader_payload = p.leader.workload_trace("ns", "wl-0")
+        replica_payload = p.replica.workload_trace("ns", "wl-0")
+        assert replica_payload["traceId"] == leader_tid
+        assert {s["spanId"] for s in leader_payload["spans"]} == {
+            s["spanId"] for s in replica_payload["spans"]
+        }
+        # explain's trail names the same trace on both planes
+        for client in (p.leader, p.replica):
+            items = client.workload_decisions("ns", "wl-0")["items"]
+            assert items and items[-1]["traceId"] == leader_tid
+
+    def test_replica_repolls_ship_only_deltas(self, traced_pair):
+        p = traced_pair
+        p.leader.apply("workloads", _wire_wl("wl-a"))
+        first = p.rep.sync()
+        assert first.spans_ingested > 0
+        quiet = p.rep.sync()
+        assert quiet.spans_ingested == 0  # caught-up poll ships nothing
+        p.leader.apply("workloads", _wire_wl("wl-b"))
+        third = p.rep.sync()
+        assert third.spans_ingested > 0
+
+    def test_poll_wakes_blocked_replica_watchers(self, traced_pair):
+        """The PR-9 follow-up: a watcher parked on the replica returns
+        as soon as a poll applies records — not at the long-poll
+        timeout."""
+        p = traced_pair
+        base_rv = p.replica.events()["resourceVersion"]
+        got = {}
+
+        def watch():
+            t0 = time.monotonic()
+            out = p.replica._request(
+                "GET",
+                "/apis/kueue/v1beta1/events?watch=1"
+                f"&resourceVersion={base_rv}&timeoutSeconds=30",
+            )
+            got["dt"] = time.monotonic() - t0
+            got["items"] = out.get("items", [])
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let the watcher park
+        p.leader.apply("workloads", _wire_wl("wl-wake"))
+        p.rep.sync()  # the tailer's own arrival must wake the watcher
+        t.join(timeout=10)
+        assert not t.is_alive(), "watcher never woke"
+        assert got["items"], "watcher woke without the new events"
+        assert got["dt"] < 10.0, f"watcher waited {got['dt']:.1f}s"
+
+    def test_kick_wakes_waiters_without_recording(self):
+        from kueue_tpu.core.events import EventRecorder
+
+        rec = EventRecorder()
+        woke = {}
+
+        def wait():
+            t0 = time.monotonic()
+            rec.wait(0, timeout=30.0, should_stop=lambda: woke.get("stop"))
+            woke["dt"] = time.monotonic() - t0
+
+        t = threading.Thread(target=wait, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        woke["stop"] = True
+        rec.kick()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert woke["dt"] < 5.0
+        assert rec.resource_version == 0  # kick stamped nothing
+
+
+class _OpenGate(_LatencyEstimate):
+    @property
+    def value(self):
+        return None
+
+
+def build_drain_rt(seed, journal_dir=None, tracing=True):
+    rt = ClusterRuntime(
+        clock=FakeClock(0.0),
+        bulk_drain_threshold=16,
+        drain_pipeline="on",
+        pipeline_chunk_cycles=2,
+        drain_gate=_OpenGate(),
+        tracing=tracing,
+    )
+    rt.guard.config.divergence_check_every = 0
+    journal = None
+    if journal_dir is not None:
+        journal = Journal(str(journal_dir)).open()
+        rt.attach_journal(journal)
+    rng = np.random.default_rng(seed)
+    rt.add_flavor(ResourceFlavor(name="default"))
+    for i in range(4):
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name=f"cq-{i}",
+                namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",),
+                        (
+                            FlavorQuotas.build(
+                                "default",
+                                {"cpu": str(int(rng.integers(8, 20)))},
+                            ),
+                        ),
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(
+            LocalQueue(namespace="ns", name=f"lq-{i}", cluster_queue=f"cq-{i}")
+        )
+    for j in range(60):
+        rt.add_workload(
+            Workload(
+                namespace="ns", name=f"w{j}", queue_name=f"lq-{j % 4}",
+                priority=int(rng.integers(0, 4)) * 10,
+                creation_time=float(j),
+                pod_sets=(
+                    PodSet.build(
+                        "main", 1, {"cpu": str(int(rng.integers(1, 5)))}
+                    ),
+                ),
+            )
+        )
+    return rt, journal
+
+
+class TestChaosNoHalfOpenSpans:
+    """A crash at ``cycle.commit_pre_apply`` (or the prefetch window)
+    never leaks half-open spans: cycle spans are buffered per round and
+    flushed atomically, so the crashed round simply never exists in the
+    store — before OR after journal recovery."""
+
+    POINTS = ("cycle.commit_pre_apply", "cycle.prefetch_launched")
+
+    @pytest.mark.parametrize("point", POINTS)
+    def test_crash_recover_leaves_no_open_cycle_spans(self, tmp_path, point):
+        ref, _ = build_drain_rt(0)
+        ref.run_until_idle(max_iterations=60)
+        ref_admitted = admitted(ref)
+        assert ref.tracer.open_spans("cycle") == []
+
+        rt, j = build_drain_rt(0, journal_dir=tmp_path / "j")
+        faults.arm(point, "crash")
+        crashed = False
+        try:
+            rt.run_until_idle(max_iterations=60)
+        except faults.InjectedCrash:
+            crashed = True
+        finally:
+            faults.reset()
+        j.close()
+        assert crashed, f"{point} never fired"
+        # the crashed process' store holds only COMPLETE cycle trees
+        assert rt.tracer.open_spans("cycle") == []
+        for tid, spans in cycle_traces(rt.tracer).items():
+            assert all(s.ended for s in spans), tid
+
+        # recovery into a fresh runtime: replay + finish the drain
+        rt2, _ = build_drain_rt(0, tracing=True)
+        rt2.journal = None
+        res = recover(None, str(tmp_path / "j"), runtime=rt2, strict=True)
+        rt2.attach_journal(res.journal)
+        rt2.run_until_idle(max_iterations=60)
+        res.journal.close()
+        assert admitted(rt2) == ref_admitted
+        assert rt2.tracer.open_spans("cycle") == []
+        assert not rt2.check_invariants()
+
+
+class TestSurfaces:
+    def test_debug_trace_routes(self):
+        from kueue_tpu.server import KueueClient, KueueServer
+
+        rt = build_rt()
+        rt.run_until_idle()
+        srv = KueueServer(runtime=rt)
+        port = srv.start()
+        try:
+            client = KueueClient(f"http://127.0.0.1:{port}")
+            items = client.traces()["items"]
+            assert items
+            one = client.trace(items[0]["traceId"])
+            assert one["spans"]
+            payload = client.workload_trace("ns", "w0")
+            assert payload["traceId"] == rt.tracer.workload_trace_id("ns/w0")
+            assert any(
+                s["name"] == "workload.admit" for s in payload["spans"]
+            )
+            from kueue_tpu.server.client import ClientError
+
+            with pytest.raises(ClientError) as ei:
+                client.trace("no-such-trace")
+            assert ei.value.status == 404
+            with pytest.raises(ClientError) as ei:
+                client.workload_trace("ns", "nope")
+            assert ei.value.status == 404
+        finally:
+            srv.stop()
+
+    def test_traceparent_header_joins_trace_on_apply(self):
+        from kueue_tpu.server import KueueClient, KueueServer
+
+        rt = build_rt(n_cq=1, n_wl=0)
+        srv = KueueServer(runtime=rt)
+        port = srv.start()
+        try:
+            upstream = Tracer()
+            tid = upstream.begin_workload("ns/hdr-1")
+            root = upstream.workload_root("ns/hdr-1")
+            client = KueueClient(f"http://127.0.0.1:{port}")
+            client.traceparent = format_traceparent(tid, root.span_id)
+            client.apply("workloads", _wire_wl("hdr-1"))
+            assert rt.tracer.workload_trace_id("ns/hdr-1") == tid
+        finally:
+            srv.stop()
+
+    def test_chrome_trace_export(self):
+        rt = build_rt()
+        rt.run_until_idle()
+        payload = workload_trace_payload(rt, "ns/w0")
+        out = to_chrome_trace(payload["spans"])
+        events = out["traceEvents"]
+        assert events
+        for e in events:
+            assert e["ph"] in ("X", "i")
+            assert e["ts"] >= 0
+            if e["ph"] == "X":
+                assert e["dur"] >= 0
+        json.dumps(out)  # serializable
+        assert to_chrome_trace([]) == {"traceEvents": []}
+
+    def test_kueuectl_trace_and_explain(self, tmp_path, capsys):
+        from kueue_tpu import serialization as ser
+        from kueue_tpu.cli.__main__ import main
+
+        rt = build_rt(n_cq=1, n_wl=2, cpu="4")
+        state_path = tmp_path / "state.json"
+        state_path.write_text(json.dumps(ser.runtime_to_state(rt)))
+        main(["--state", str(state_path), "explain", "w0", "-n", "ns"])
+        out = capsys.readouterr().out
+        assert "Trace:" in out
+        assert "cycle.snapshot" in out or "Trace spans" in out
+        # tree rendering
+        main(["--state", str(state_path), "trace", "w0", "-n", "ns"])
+        out = capsys.readouterr().out
+        assert "workload.lifecycle" in out and "[cycle]" in out
+        # Chrome export
+        export = tmp_path / "trace.json"
+        main([
+            "--state", str(state_path), "trace", "w0", "-n", "ns",
+            "-o", str(export),
+        ])
+        capsys.readouterr()
+        dumped = json.loads(export.read_text())
+        assert dumped["traceEvents"]
+
+    def test_dashboard_waterfall_payload(self):
+        from kueue_tpu.server.dashboard import DASHBOARD_HTML, dashboard_payload
+
+        rt = build_rt()
+        rt.run_until_idle()
+        payload = dashboard_payload(rt)
+        last = payload["lastTrace"]
+        assert last is not None
+        assert last["traceId"] == rt.scheduler.last_traces[-1].trace_id
+        assert any(s["name"] == "cycle" for s in last["spans"])
+        assert "waterfall" in DASHBOARD_HTML
+
+    def test_sigusr2_dump_has_tracing_section(self):
+        from kueue_tpu.debugger import dump
+
+        rt = build_rt()
+        rt.run_until_idle()
+        text = dump(rt)
+        assert "-- tracing (lifecycle + cycle span trees) --" in text
+        assert "cycle.snapshot" in text
+
+    def test_spans_total_counts(self):
+        rt = build_rt()
+        rt.run_until_idle()
+        m = rt.metrics.trace_spans_total
+        assert m.value(name="cycle") >= 1
+        assert m.value(name="workload.lifecycle") >= 1
