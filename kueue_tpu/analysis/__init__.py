@@ -1,0 +1,81 @@
+"""kueuelint — AST-based static analysis for the control plane.
+
+Every bug class the hot path has actually hit is *statically
+detectable*: the TAS s64/s32 dynamic-update-slice miscompile (PR 8,
+fenced by a canary probe), the journal/replay Pending-convergence
+asymmetry (PR 9), host calls leaking into jitted kernels, naked wall
+clocks dodging the repo-wide clock-injection law, and unlocked writes
+to state shared across the pipeline / replica / tracer / journal
+threads. This package promotes the five ad-hoc source scans that grew
+inside test files into a real subsystem: a shared source loader +
+visitor core (``core.py``), a shrink-only baseline (``baseline.py``),
+``# kueuelint: disable=<rule>`` pragmas, and one rule module per risk
+surface.
+
+Surfaces:
+
+- ``python -m kueue_tpu.analysis [--rule R] [--update-baseline]``
+  (exit 2 on findings not covered by the baseline)
+- ``kueuectl lint`` (same engine, CLI-integrated)
+- ``tests/test_analysis.py`` runs the full suite over the package in
+  tier-1, with per-rule known-bad/known-good fixtures.
+"""
+
+from __future__ import annotations
+
+from kueue_tpu.analysis.baseline import (
+    DEFAULT_BASELINE_PATH,
+    Baseline,
+    BaselineEntry,
+)
+from kueue_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    all_rules,
+    iter_sources,
+    repo_root,
+    rule_names,
+    run_analysis,
+)
+
+
+def lint(rules=None, root=None, respect_baseline=True):
+    """Run kueuelint over the real package and return the findings the
+    baseline does not cover — the one-call surface the (previously
+    ad-hoc) lint tests wrap. ``rules=None`` runs everything."""
+    findings = run_analysis(root or repo_root(), rules=rules)
+    if not respect_baseline:
+        return findings
+    baseline = Baseline.load()
+    if rules is not None:
+        baseline = Baseline(
+            e for e in baseline.entries if e.rule in set(rules)
+        )
+    new, _suppressed, _stale = baseline.split(findings)
+    return new
+
+# importing the rule modules registers them with the rule registry
+from kueue_tpu.analysis import rules_clock  # noqa: F401  (registers)
+from kueue_tpu.analysis import rules_dtype  # noqa: F401
+from kueue_tpu.analysis import rules_journal  # noqa: F401
+from kueue_tpu.analysis import rules_locks  # noqa: F401
+from kueue_tpu.analysis import rules_registry  # noqa: F401
+from kueue_tpu.analysis import rules_trace  # noqa: F401
+
+__all__ = [
+    "AnalysisContext",
+    "Baseline",
+    "BaselineEntry",
+    "DEFAULT_BASELINE_PATH",
+    "Finding",
+    "Rule",
+    "SourceFile",
+    "all_rules",
+    "iter_sources",
+    "lint",
+    "repo_root",
+    "rule_names",
+    "run_analysis",
+]

@@ -1,0 +1,149 @@
+"""``python -m kueue_tpu.analysis`` — the kueuelint command line.
+
+Exit codes: 0 clean (or every finding baselined), 2 new findings or a
+baseline that must shrink, 1 usage error. ``kueuectl lint`` wraps
+``main`` so both surfaces stay byte-identical.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from kueue_tpu.analysis.baseline import DEFAULT_BASELINE_PATH, Baseline
+from kueue_tpu.analysis.core import repo_root as default_root
+from kueue_tpu.analysis.core import rule_names, run_analysis
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m kueue_tpu.analysis",
+        description=(
+            "kueuelint — AST-based static analysis for the kueue_tpu "
+            "control plane"
+        ),
+    )
+    p.add_argument(
+        "--rule", "-r", action="append", dest="rules", metavar="RULE",
+        help="run only this rule (repeatable); default: all",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="list registered rules and exit",
+    )
+    p.add_argument(
+        "--root", default=None,
+        help="analysis root (default: the repo root containing kueue_tpu/)",
+    )
+    p.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: {DEFAULT_BASELINE_PATH})",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every finding",
+    )
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help=(
+            "shrink the baseline to the entries still matched by a "
+            "current finding (never grows; see --allow-grow)"
+        ),
+    )
+    p.add_argument(
+        "--allow-grow", action="store_true",
+        help=(
+            "with --update-baseline: rewrite the baseline to the full "
+            "current finding set (reviewed debt intake only)"
+        ),
+    )
+    p.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="print only the summary line",
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        from kueue_tpu.analysis.core import all_rules
+
+        for rule in all_rules():
+            print(f"{rule.name:18s} {rule.description}")
+        return 0
+    try:
+        selected = args.rules
+        if selected is not None:
+            known = set(rule_names())
+            bad = [r for r in selected if r not in known]
+            if bad:
+                print(
+                    f"unknown rule(s): {', '.join(bad)}; known: "
+                    f"{', '.join(sorted(known))}",
+                    file=sys.stderr,
+                )
+                return 1
+        root = args.root or default_root()
+        findings = run_analysis(root, rules=selected)
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"kueuelint failed: {e}", file=sys.stderr)
+        return 1
+
+    baseline_path = args.baseline or DEFAULT_BASELINE_PATH
+    if args.no_baseline:
+        baseline = Baseline()
+    else:
+        baseline = Baseline.load(baseline_path)
+    if selected is not None:
+        # partial runs must not call untouched rules' entries stale
+        baseline = Baseline(
+            e for e in baseline.entries if e.rule in set(selected)
+        )
+    new, suppressed, stale = baseline.split(findings)
+
+    if args.update_baseline:
+        updated = (
+            baseline.grown(findings) if args.allow_grow
+            else baseline.shrink(findings)
+        )
+        if selected is not None:
+            full = Baseline.load(baseline_path)
+            keep = [
+                e for e in full.entries if e.rule not in set(selected)
+            ]
+            updated = Baseline(list(updated.entries) + keep)
+        updated.save(baseline_path)
+        print(
+            f"baseline updated: {len(updated)} entr"
+            f"{'y' if len(updated) == 1 else 'ies'} "
+            f"({len(stale)} shrunk"
+            + (f", grown to cover {len(new)} new" if args.allow_grow else "")
+            + ")"
+        )
+        if args.allow_grow:
+            new = []
+        # either way the rewrite just removed every stale entry
+        stale = []
+
+    if not args.quiet:
+        for f in new:
+            print(str(f))
+        for e in stale:
+            print(
+                f"stale baseline entry (no matching finding — run "
+                f"--update-baseline): {e.format()}"
+            )
+    n_rules = len(selected) if selected else len(rule_names())
+    print(
+        f"kueuelint: {n_rules} rule(s), {len(findings)} finding(s) "
+        f"({len(suppressed)} baselined, {len(new)} new, "
+        f"{len(stale)} stale baseline entr"
+        f"{'y' if len(stale) == 1 else 'ies'})"
+    )
+    return 2 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

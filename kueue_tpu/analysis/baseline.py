@@ -1,0 +1,163 @@
+"""Shrink-only finding baseline.
+
+The baseline is the set of findings the tree is *allowed* to have —
+each entry justified at review time and checked in next to the rules.
+The contract is a ratchet:
+
+- a finding NOT in the baseline fails the run (exit 2);
+- ``--update-baseline`` only ever REMOVES entries (findings that got
+  fixed); growing the baseline needs the explicit ``--allow-grow``
+  escape hatch, so new debt is a reviewed decision, never a default;
+- every entry must still resolve to a real file:line and match a
+  current finding — a stale entry (the code moved on) fails the
+  stale-baseline check in tests/test_analysis.py until the baseline is
+  re-shrunk.
+
+Entry identity is (rule, file, message): line numbers drift with
+unrelated edits, so they are carried for navigation and staleness
+checking but excluded from matching.
+
+Format: one tab-separated line per entry —
+
+    rule<TAB>file:line<TAB>message
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Tuple
+
+from kueue_tpu.analysis.core import Finding
+
+#: checked-in baseline, package-relative (the analysis root is the
+#: repo root, so entries are ``kueue_tpu/...`` paths)
+DEFAULT_BASELINE_PATH = os.path.join(
+    os.path.dirname(__file__), "BASELINE.txt"
+)
+
+_HEADER = (
+    "# kueuelint baseline — shrink-only; every entry is a justified,\n"
+    "# reviewed finding. Regenerate with:\n"
+    "#   python -m kueue_tpu.analysis --update-baseline\n"
+    "# (growth requires --allow-grow and a review)\n"
+)
+
+
+@dataclass(frozen=True, order=True)
+class BaselineEntry:
+    rule: str
+    file: str
+    line: int
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.file, self.message)
+
+    @classmethod
+    def from_finding(cls, f: Finding) -> "BaselineEntry":
+        return cls(rule=f.rule, file=f.file, line=f.line, message=f.message)
+
+    def format(self) -> str:
+        return f"{self.rule}\t{self.file}:{self.line}\t{self.message}"
+
+    @classmethod
+    def parse(cls, line: str) -> "BaselineEntry":
+        rule, loc, message = line.split("\t", 2)
+        path, _, lineno = loc.rpartition(":")
+        return cls(
+            rule=rule.strip(), file=path.strip(),
+            line=int(lineno), message=message.strip(),
+        )
+
+
+class Baseline:
+    """The checked-in allowance set + matching/ratchet operations."""
+
+    def __init__(self, entries: Iterable[BaselineEntry] = ()):
+        self.entries: List[BaselineEntry] = sorted(entries)
+
+    # ---- persistence ----
+    @classmethod
+    def load(cls, path: str = DEFAULT_BASELINE_PATH) -> "Baseline":
+        entries: List[BaselineEntry] = []
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                for raw in f:
+                    raw = raw.rstrip("\n")
+                    if not raw or raw.startswith("#"):
+                        continue
+                    entries.append(BaselineEntry.parse(raw))
+        return cls(entries)
+
+    def save(self, path: str = DEFAULT_BASELINE_PATH) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(_HEADER)
+            for e in sorted(self.entries):
+                f.write(e.format() + "\n")
+
+    # ---- matching ----
+    def _index(self) -> Dict[Tuple[str, str, str], BaselineEntry]:
+        return {e.key(): e for e in self.entries}
+
+    def split(
+        self, findings: Iterable[Finding]
+    ) -> Tuple[List[Finding], List[Finding], List[BaselineEntry]]:
+        """(new, suppressed, stale): findings outside the baseline,
+        findings the baseline covers, and entries no current finding
+        matches (fixed code — the baseline must shrink)."""
+        idx = self._index()
+        new: List[Finding] = []
+        suppressed: List[Finding] = []
+        matched = set()
+        for f in findings:
+            if f.key() in idx:
+                suppressed.append(f)
+                matched.add(f.key())
+            else:
+                new.append(f)
+        stale = [e for e in self.entries if e.key() not in matched]
+        return new, suppressed, stale
+
+    def shrink(self, findings: Iterable[Finding]) -> "Baseline":
+        """The ratchet: keep only entries still matched by a current
+        finding, with line numbers refreshed to where the finding sits
+        today. Never adds."""
+        idx = self._index()
+        kept = [
+            BaselineEntry.from_finding(f)
+            for f in findings
+            if f.key() in idx
+        ]
+        return Baseline(kept)
+
+    def grown(self, findings: Iterable[Finding]) -> "Baseline":
+        """--allow-grow: the baseline becomes exactly the current
+        finding set (bootstrap / reviewed debt intake)."""
+        return Baseline(BaselineEntry.from_finding(f) for f in findings)
+
+    def stale_locations(self, root: str) -> List[str]:
+        """Entries whose file:line no longer resolves — the file is
+        gone or shorter than the recorded line. The checked-in baseline
+        must always point at real code."""
+        problems: List[str] = []
+        for e in self.entries:
+            path = os.path.join(root, e.file)
+            if not os.path.isfile(path):
+                problems.append(f"{e.format()} — file does not exist")
+                continue
+            try:
+                with open(path, encoding="utf-8") as f:
+                    n_lines = sum(1 for _ in f)
+            except OSError as exc:
+                problems.append(f"{e.format()} — unreadable: {exc}")
+                continue
+            if e.line < 1 or e.line > n_lines:
+                problems.append(
+                    f"{e.format()} — line {e.line} out of range "
+                    f"(file has {n_lines} lines)"
+                )
+        return problems
+
+    def __len__(self) -> int:
+        return len(self.entries)

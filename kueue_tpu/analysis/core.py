@@ -1,0 +1,333 @@
+"""kueuelint core: source loading, pragmas, findings, rule registry.
+
+Design mirrors the registries the rules themselves enforce: a closed,
+machine-checked vocabulary. A rule is a class with a kebab-case
+``name``; it sees every loaded :class:`SourceFile` via ``check`` and
+gets a whole-tree ``finalize`` pass for cross-module diffs (the
+journal<->replay symmetry check is a registry diff, not a per-file
+scan). Pragma suppression is applied centrally so every rule honors
+``# kueuelint: disable=<rule>`` identically.
+
+Pragma grammar (comment anywhere on the line, or the line above):
+
+    # kueuelint: disable=rule-a,rule-b — optional justification
+    # kueuelint: disable-file=rule-a — whole-file, first 20 lines
+    # kueuelint: holds=_lock  (lock-discipline: fn runs with lock held)
+
+Rules never crash the run: a file that fails to parse produces a
+``parse-error`` finding instead of an exception, so the lint stays
+usable mid-refactor.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_PRAGMA_RE = re.compile(
+    r"#\s*kueuelint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_,-]+)"
+)
+_HOLDS_RE = re.compile(r"#\s*kueuelint:\s*holds\s*=\s*([A-Za-z0-9_.]+)")
+#: attribute annotation marking lock-guarded shared state, e.g.
+#:     self._cursor = 0  # guarded by: _lock
+_GUARDED_RE = re.compile(r"#\s*guarded by:\s*([A-Za-z0-9_.]+)")
+
+#: how deep into a file a disable-file pragma may sit
+_FILE_PRAGMA_WINDOW = 20
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One lint finding, anchored to a repo-relative file:line."""
+
+    rule: str
+    file: str  # posix, relative to the analysis root
+    line: int
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift with unrelated edits,
+        so baseline matching is (rule, file, message)."""
+        return (self.rule, self.file, self.message)
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    """One parsed source file + its pragma and comment maps."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines: List[str] = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as e:
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        # line number -> set of rules disabled on that line
+        self._line_disables: Dict[int, set] = {}
+        self._file_disables: set = set()
+        for i, line in enumerate(self.lines, start=1):
+            if "kueuelint" not in line:
+                continue
+            for kind, rules in _PRAGMA_RE.findall(line):
+                names = {r.strip() for r in rules.split(",") if r.strip()}
+                if kind == "disable-file" and i <= _FILE_PRAGMA_WINDOW:
+                    self._file_disables |= names
+                elif kind == "disable":
+                    self._line_disables.setdefault(i, set()).update(names)
+
+    # ---- pragma queries ----
+    def disabled(self, rule: str, line: int) -> bool:
+        """Is ``rule`` suppressed at ``line``? A pragma covers its own
+        line and the line directly below it (pragma-above style)."""
+        if rule in self._file_disables or "all" in self._file_disables:
+            return True
+        for at in (line, line - 1):
+            names = self._line_disables.get(at)
+            if names and (rule in names or "all" in names):
+                return True
+        return False
+
+    # ---- comment-annotation queries (lock-discipline et al) ----
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def guarded_by(self, line: int) -> Optional[str]:
+        """The ``# guarded by: <lock>`` annotation on ``line`` (or the
+        line above — long constructor lines wrap)."""
+        for at in (line, line - 1):
+            m = _GUARDED_RE.search(self.line_text(at))
+            if m:
+                return m.group(1)
+        return None
+
+    def holds_lock(self, line: int) -> Optional[str]:
+        """The ``# kueuelint: holds=<lock>`` marker on a def line (or
+        the line above), declaring the function runs with the lock
+        already held by every caller."""
+        for at in (line, line - 1):
+            m = _HOLDS_RE.search(self.line_text(at))
+            if m:
+                return m.group(1)
+        return None
+
+
+@dataclass
+class AnalysisContext:
+    """Everything a rule may need beyond one file: the root, every
+    loaded source, and free-form per-rule config overrides (fixture
+    tests swap closed registries in through here)."""
+
+    root: str
+    sources: List[SourceFile] = field(default_factory=list)
+    config: Dict[str, object] = field(default_factory=dict)
+
+    def source(self, rel: str) -> Optional[SourceFile]:
+        rel = rel.replace(os.sep, "/")
+        for src in self.sources:
+            if src.rel == rel or src.rel.endswith("/" + rel):
+                return src
+        return None
+
+
+class Rule:
+    """Base rule. Subclasses set ``name``/``description`` and override
+    ``check`` (per file) and/or ``finalize`` (after all files)."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, src: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        return []
+
+    def finalize(self, ctx: AnalysisContext) -> List[Finding]:
+        return []
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator adding a rule to the closed registry."""
+    if not cls.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    if cls.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {cls.name!r}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def rule_names() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def all_rules(names: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Instantiate the selected rules (all when ``names`` is None)."""
+    if names is None:
+        names = rule_names()
+    unknown = [n for n in names if n not in _REGISTRY]
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {unknown}; known: {rule_names()}"
+        )
+    return [_REGISTRY[n]() for n in names]
+
+
+def repo_root() -> str:
+    """The repo root: the parent of the kueue_tpu package."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def iter_sources(
+    root: str, subdir: str = "kueue_tpu"
+) -> Iterable[SourceFile]:
+    """Load every ``*.py`` under ``root/subdir`` (the package tree —
+    the same scope the legacy in-test scans covered). ``subdir=''``
+    scans the root itself (fixture trees)."""
+    base = os.path.join(root, subdir) if subdir else root
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            yield SourceFile(path, rel, text)
+
+
+def run_analysis(
+    root: str,
+    rules: Optional[Sequence[str]] = None,
+    subdir: str = "kueue_tpu",
+    config: Optional[dict] = None,
+    sources: Optional[List[SourceFile]] = None,
+) -> List[Finding]:
+    """Run the selected rules over the tree; returns pragma-filtered,
+    sorted findings. ``sources`` short-circuits loading (fixtures)."""
+    ctx = AnalysisContext(root=root, config=dict(config or {}))
+    ctx.sources = (
+        list(sources) if sources is not None
+        else list(iter_sources(root, subdir=subdir))
+    )
+    active = all_rules(rules)
+    findings: List[Finding] = []
+    for src in ctx.sources:
+        if src.parse_error is not None:
+            findings.append(
+                Finding("parse-error", src.rel, 1, src.parse_error)
+            )
+            continue
+        for rule in active:
+            for f in rule.check(src, ctx):
+                if not src.disabled(f.rule, f.line):
+                    findings.append(f)
+    by_rel = {s.rel: s for s in ctx.sources}
+    for rule in active:
+        for f in rule.finalize(ctx):
+            src = by_rel.get(f.file)
+            if src is None or not src.disabled(f.rule, f.line):
+                findings.append(f)
+    return sorted(findings)
+
+
+# ---- shared AST helpers (used by several rule modules) ----
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def module_str_constants(tree: ast.AST) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments (simple targets),
+    the vocabulary style every registry in this repo uses."""
+    out: Dict[str, str] = {}
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            val = str_const(node.value)
+            if isinstance(tgt, ast.Name) and val is not None:
+                out[tgt.id] = val
+    return out
+
+
+def module_str_tuples(tree: ast.AST) -> Dict[str, List[str]]:
+    """Module-level ``NAME = (A, B, ...)`` where elements are string
+    constants or names resolvable through :func:`module_str_constants`."""
+    consts = module_str_constants(tree)
+    out: Dict[str, List[str]] = {}
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                vals: List[str] = []
+                ok = True
+                for elt in node.value.elts:
+                    s = str_const(elt)
+                    if s is None and isinstance(elt, ast.Name):
+                        s = consts.get(elt.id)
+                    if s is None:
+                        ok = False
+                        break
+                    vals.append(s)
+                if ok and vals:
+                    out[tgt.id] = vals
+    return out
+
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Alias -> canonical module path for ``import x as y`` /
+    ``from x import y [as z]`` — so ``_time.time()`` resolves to
+    ``time.time`` wherever the module was renamed."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def resolve_call_name(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted name of a call target with import aliases
+    resolved (``_time.monotonic`` -> ``time.monotonic``)."""
+    dn = dotted_name(call.func)
+    if dn is None:
+        return None
+    head, _, rest = dn.partition(".")
+    canon = aliases.get(head, head)
+    return f"{canon}.{rest}" if rest else canon

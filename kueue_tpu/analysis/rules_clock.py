@@ -1,0 +1,176 @@
+"""clock-discipline — no naked wall clocks outside the allowlist.
+
+Clock injection is repo-wide law (``utils/clock.Clock`` /
+``FakeClock``): anything that *schedules, stamps or expires* must read
+time through an injected clock so the deterministic test suites
+(leases, quarantine TTLs, federation heartbeats, replica lag) can
+drive it. A naked ``time.time()`` in a code path under test is a
+flake factory; in a code path NOT under test it is untestable policy.
+
+The allowlist below is the triage ledger: each entry names the exact
+scope (``file`` or ``file::Qual.name``) and carries the justification
+reviewed when it was added. A stale entry (the code got fixed or
+moved) is itself a finding — the allowlist shrinks like the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    import_aliases,
+    register,
+    resolve_call_name,
+)
+
+#: canonical dotted call names that count as a naked wall clock.
+#: perf_counter is deliberately absent: duration *measurement* is not
+#: schedule-relevant time and FakeClock cannot meaningfully replace it.
+NAKED_CLOCK_CALLS = {
+    "time.time",
+    "time.monotonic",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.today",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+}
+
+#: scope -> justification. Scope is a repo-relative path, optionally
+#: ``::Qualified.name`` to pin one class/function. Keep justifications
+#: honest — they are the documented contract for why injection does
+#: not apply.
+CLOCK_ALLOWLIST: Dict[str, str] = {
+    "kueue_tpu/utils/clock.py": (
+        "the Clock implementation itself — the single place the wall "
+        "clock is allowed to enter the system"
+    ),
+    "kueue_tpu/core/events.py::EventRecorder._now": (
+        "documented fallback when no clock is injected; every runtime "
+        "construction path wires ClusterRuntime.clock in"
+    ),
+    "kueue_tpu/core/events.py::EventRecorder.wait": (
+        "long-poll deadline arithmetic over a real condition-variable "
+        "wait: monotonic by design, and a FakeClock cannot wake a "
+        "blocked thread"
+    ),
+    "kueue_tpu/core/audit.py::DecisionAuditLog._now": (
+        "documented fallback when no clock is injected (mirrors "
+        "EventRecorder._now)"
+    ),
+    "kueue_tpu/tracing/tracer.py::Tracer.now": (
+        "documented fallback when no clock is injected; span alignment "
+        "across processes needs the real wall clock in production"
+    ),
+    "kueue_tpu/storage/journal.py::Journal._maybe_fsync": (
+        "fsync pacing is interval arithmetic local to this process: "
+        "monotonic by design (a wall-clock jump must not force or "
+        "starve fsyncs); record timestamps use the injected clock"
+    ),
+    "kueue_tpu/storage/journal.py::Journal.sync": (
+        "fsync pacing bookkeeping (see _maybe_fsync) — monotonic by "
+        "design"
+    ),
+    "kueue_tpu/storage/journal.py::Journal.stats": (
+        "last-fsync age derives from the monotonic pacing stamps; "
+        "reported, never scheduled on"
+    ),
+    "kueue_tpu/utils/cert.py::_now": (
+        "certificate validity fallback: every generate_* accepts an "
+        "explicit now= and the rotator tests inject it; X.509 "
+        "notBefore/notAfter must be real UTC wall time in production"
+    ),
+    "kueue_tpu/cli/__main__.py::cmd_create_workload": (
+        "one-shot CLI stamping creationTime on a workload it is about "
+        "to POST; no loop, no test seam — the server re-stamps "
+        "authoritative times"
+    ),
+}
+
+
+def _scope_allowed(rel: str, qualname: str) -> bool:
+    if rel in CLOCK_ALLOWLIST:
+        return True
+    return f"{rel}::{qualname}" in CLOCK_ALLOWLIST
+
+
+@register
+class ClockDisciplineRule(Rule):
+    name = "clock-discipline"
+    description = (
+        "time.time()/time.monotonic()/datetime.now() outside the "
+        "justified allowlist — inject a Clock instead"
+    )
+
+    def check(self, src: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        allowlist = ctx.config.get("clock_allowlist", CLOCK_ALLOWLIST)
+        aliases = import_aliases(src.tree)
+        findings: List[Finding] = []
+        used_scopes = ctx.config.setdefault("_clock_used_scopes", set())
+
+        # walk with an explicit qualname stack so findings (and the
+        # allowlist) can address one method, not a whole file
+        def visit(node: ast.AST, stack: List[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    visit(child, stack + [child.name])
+                    continue
+                if isinstance(child, ast.Call):
+                    canon = resolve_call_name(child, aliases)
+                    if canon in NAKED_CLOCK_CALLS:
+                        qual = ".".join(stack)
+                        scope_file = src.rel
+                        scope_fn = f"{src.rel}::{qual}" if qual else src.rel
+                        if scope_file in allowlist:
+                            used_scopes.add(scope_file)
+                        elif scope_fn in allowlist:
+                            used_scopes.add(scope_fn)
+                        else:
+                            findings.append(
+                                Finding(
+                                    self.name,
+                                    src.rel,
+                                    child.lineno,
+                                    f"naked {canon}() in "
+                                    f"{qual or '<module>'} — inject a "
+                                    "Clock (utils/clock) or add a "
+                                    "justified allowlist entry",
+                                )
+                            )
+                visit(child, stack)
+
+        visit(src.tree, [])
+        return findings
+
+    def finalize(self, ctx: AnalysisContext) -> List[Finding]:
+        """A stale allowlist entry is debt pretending to be paid."""
+        allowlist = ctx.config.get("clock_allowlist", CLOCK_ALLOWLIST)
+        used = ctx.config.get("_clock_used_scopes", set())
+        scanned = {s.rel for s in ctx.sources}
+        findings: List[Finding] = []
+        for scope in sorted(allowlist):
+            rel = scope.split("::", 1)[0]
+            if rel not in scanned:
+                continue  # partial runs must not flag unscanned scopes
+            if scope not in used:
+                findings.append(
+                    Finding(
+                        self.name,
+                        rel,
+                        1,
+                        f"stale clock allowlist entry {scope!r} — no "
+                        "naked clock call remains there; shrink "
+                        "CLOCK_ALLOWLIST",
+                    )
+                )
+        return findings

@@ -1,0 +1,276 @@
+"""kernel-dtype — mixed-width integers in device kernels.
+
+The exact bug class this catches shipped twice before being fenced at
+runtime: GSPMD miscompiles partitioned ``dynamic_update_slice`` /
+compare ops whose integer operands mix s64 and s32 (the TAS drain's
+per-queue cursor DUS, PR 8; the narrow-panel compaction, PR 7). The
+canary probe catches it on real meshes *after* compilation — this rule
+catches it at lint time, on every kernel file, with no device.
+
+Mechanics: a per-function width inference over the obvious dtype
+sources (``dtype=jnp.int32`` constructor kwargs, ``.astype(...)``,
+``jnp.int32(x)`` casts, propagation through arithmetic, indexing and
+``jnp.where``), then three checks wherever BOTH sides are known:
+
+- comparisons mixing widths (the s64/s32 compare miscompile class);
+- ``lax.dynamic_update_slice`` / ``.at[...].set/add/...`` where the
+  operand width differs from the target array's width (the DUS class);
+- arithmetic mixing widths — an implicit promotion the partitioner,
+  not the author, decides how to lower.
+
+Unknown widths stay silent: the rule is deliberately conservative —
+every finding is a real mixed-width site needing an explicit
+``astype`` (or a pragma explaining why the mix is safe).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+#: dtype attribute / call names -> bit width (signed and unsigned
+#: collapse: the miscompile class is about width, not signedness)
+INT_WIDTHS = {
+    "int8": 8, "uint8": 8,
+    "int16": 16, "uint16": 16,
+    "int32": 32, "uint32": 32,
+    "int64": 64, "uint64": 64,
+}
+
+#: array constructors whose dtype kwarg types the result
+_CONSTRUCTORS = {
+    "zeros", "ones", "full", "empty", "arange", "array", "asarray",
+    "zeros_like", "ones_like", "full_like", "iota",
+}
+
+#: width-preserving elementwise/structural ops: f(x, ...) has x's width
+_PRESERVING = {
+    "minimum", "maximum", "abs", "clip", "sort", "cumsum", "sum",
+    "max", "min", "roll", "flip", "take", "squeeze", "reshape",
+    "broadcast_to", "repeat", "tile", "concatenate", "stack",
+    "expand_dims", "argsort",
+}
+
+_ARITH_OPS = (
+    ast.Add, ast.Sub, ast.Mult, ast.FloorDiv, ast.Mod,
+    ast.BitAnd, ast.BitOr, ast.BitXor, ast.LShift, ast.RShift,
+)
+
+#: .at[...] update methods (sugar over dynamic_update_slice / scatter)
+_AT_UPDATES = {"set", "add", "subtract", "multiply", "max", "min"}
+
+
+def _width_of_dtype_expr(node: ast.AST) -> Optional[int]:
+    """``jnp.int32`` / ``np.int64`` / ``"int32"`` -> width."""
+    if isinstance(node, ast.Attribute):
+        return INT_WIDTHS.get(node.attr)
+    if isinstance(node, ast.Name):
+        return INT_WIDTHS.get(node.id)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return INT_WIDTHS.get(node.value)
+    return None
+
+
+class _WidthEnv:
+    """Integer widths of local names within one function scope."""
+
+    def __init__(self, parent: Optional["_WidthEnv"] = None):
+        self.vars: Dict[str, int] = dict(parent.vars) if parent else {}
+
+    def infer(self, node: ast.AST) -> Optional[int]:
+        if isinstance(node, ast.Name):
+            return self.vars.get(node.id)
+        if isinstance(node, ast.Subscript):
+            # indexing an int array yields elements of the same width
+            return self.infer(node.value)
+        if isinstance(node, ast.UnaryOp):
+            return self.infer(node.operand)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, _ARITH_OPS):
+            lw, rw = self.infer(node.left), self.infer(node.right)
+            if lw is not None and rw is not None and lw == rw:
+                return lw
+            # mixed/unknown: result width is the partitioner's guess —
+            # exactly what the visitor flags at the site
+            if lw is not None and rw is None:
+                return lw  # python-int operand adapts (weak typing)
+            if rw is not None and lw is None:
+                return rw
+            return None
+        if isinstance(node, ast.IfExp):
+            lw, rw = self.infer(node.body), self.infer(node.orelse)
+            return lw if lw == rw else None
+        if isinstance(node, ast.Call):
+            return self._infer_call(node)
+        return None
+
+    def _infer_call(self, call: ast.Call) -> Optional[int]:
+        fn = call.func
+        # x.astype(jnp.int64)
+        if isinstance(fn, ast.Attribute) and fn.attr == "astype" and call.args:
+            return _width_of_dtype_expr(call.args[0])
+        dn = dotted_name(fn)
+        if dn is None:
+            return None
+        leaf = dn.rsplit(".", 1)[-1]
+        # jnp.int32(x) — scalar/array cast
+        if leaf in INT_WIDTHS:
+            return INT_WIDTHS[leaf]
+        # constructors with explicit dtype kwarg
+        if leaf in _CONSTRUCTORS:
+            for kw in call.keywords:
+                if kw.arg == "dtype":
+                    return _width_of_dtype_expr(kw.value)
+            return None
+        # jnp.where(c, a, b): width of the agreeing branches
+        if leaf == "where" and len(call.args) == 3:
+            aw, bw = self.infer(call.args[1]), self.infer(call.args[2])
+            return aw if aw == bw else None
+        if leaf in _PRESERVING and call.args:
+            return self.infer(call.args[0])
+        # lax.dynamic_slice / dynamic_update_slice return operand-typed
+        if leaf in ("dynamic_slice", "dynamic_update_slice") and call.args:
+            return self.infer(call.args[0])
+        return None
+
+
+def _is_kernel_file(rel: str) -> bool:
+    if "/ops/" not in f"/{rel}":
+        return False
+    base = rel.rsplit("/", 1)[-1]
+    return base.endswith("_kernel.py") or base == "quota.py"
+
+
+@register
+class KernelDtypeRule(Rule):
+    name = "kernel-dtype"
+    description = (
+        "mixed-width integer operands feeding dynamic_update_slice, "
+        "comparisons or arithmetic in device kernels (ops/*_kernel.py) "
+        "— the TAS s64/s32 GSPMD miscompile class"
+    )
+
+    def check(self, src: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        if not ctx.config.get("dtype_all_files") and not _is_kernel_file(
+            src.rel
+        ):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(node, src, findings)
+        return findings
+
+    # ---- per-function pass ----
+    def _check_function(
+        self, fn: ast.FunctionDef, src: SourceFile, findings: List[Finding]
+    ) -> None:
+        env = _WidthEnv()
+        # parameter annotations don't carry widths; only local
+        # assignments seed the environment — conservative by design
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = stmt.targets[0]
+                if isinstance(tgt, ast.Name):
+                    w = env.infer(stmt.value)
+                    if w is not None:
+                        env.vars[tgt.id] = w
+                    else:
+                        # reassignment to unknown clears stale knowledge
+                        env.vars.pop(tgt.id, None)
+        # second pass: flag mixed-width uses now that names are typed
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Compare):
+                self._check_compare(node, env, src, findings)
+            elif isinstance(node, ast.BinOp) and isinstance(
+                node.op, _ARITH_OPS
+            ):
+                self._check_binop(node, env, src, findings)
+            elif isinstance(node, ast.Call):
+                self._check_call(node, env, src, findings)
+
+    def _mixed(self, a: Optional[int], b: Optional[int]) -> bool:
+        return a is not None and b is not None and a != b
+
+    def _check_compare(
+        self, node: ast.Compare, env: _WidthEnv, src: SourceFile,
+        findings: List[Finding],
+    ) -> None:
+        operands = [node.left] + list(node.comparators)
+        for left, right in zip(operands, operands[1:]):
+            lw, rw = env.infer(left), env.infer(right)
+            if self._mixed(lw, rw):
+                findings.append(
+                    Finding(
+                        self.name, src.rel, node.lineno,
+                        f"mixed-width integer comparison (s{lw} vs "
+                        f"s{rw}) — GSPMD has miscompiled partitioned "
+                        "mixed-width compares; align with an explicit "
+                        "astype",
+                    )
+                )
+
+    def _check_binop(
+        self, node: ast.BinOp, env: _WidthEnv, src: SourceFile,
+        findings: List[Finding],
+    ) -> None:
+        lw, rw = env.infer(node.left), env.infer(node.right)
+        if self._mixed(lw, rw):
+            findings.append(
+                Finding(
+                    self.name, src.rel, node.lineno,
+                    f"implicit integer promotion (s{lw} op s{rw}) "
+                    "without an explicit astype — make the width "
+                    "deliberate",
+                )
+            )
+
+    def _check_call(
+        self, node: ast.Call, env: _WidthEnv, src: SourceFile,
+        findings: List[Finding],
+    ) -> None:
+        dn = dotted_name(node.func)
+        leaf = dn.rsplit(".", 1)[-1] if dn else None
+        # lax.dynamic_update_slice(target, update, idx...)
+        if leaf == "dynamic_update_slice" and len(node.args) >= 2:
+            tw, uw = env.infer(node.args[0]), env.infer(node.args[1])
+            if self._mixed(tw, uw):
+                findings.append(
+                    Finding(
+                        self.name, src.rel, node.lineno,
+                        f"dynamic_update_slice mixes operand widths "
+                        f"(target s{tw}, update s{uw}) — the exact TAS "
+                        "s64/s32 DUS miscompile shape; astype the "
+                        "update to the target's width",
+                    )
+                )
+        # arr.at[idx].set(value) sugar over DUS/scatter
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _AT_UPDATES
+            and isinstance(node.func.value, ast.Subscript)
+            and isinstance(node.func.value.value, ast.Attribute)
+            and node.func.value.value.attr == "at"
+        ):
+            target = node.func.value.value.value  # arr in arr.at[...]
+            tw = env.infer(target)
+            for arg in node.args:
+                uw = env.infer(arg)
+                if self._mixed(tw, uw):
+                    findings.append(
+                        Finding(
+                            self.name, src.rel, node.lineno,
+                            f".at[...].{node.func.attr} mixes operand "
+                            f"widths (target s{tw}, update s{uw}) — "
+                            "scatter/DUS lowering; astype the update "
+                            "to the target's width",
+                        )
+                    )

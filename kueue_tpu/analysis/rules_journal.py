@@ -1,0 +1,212 @@
+"""journal-symmetry — every appended record kind must replay.
+
+The WAL is only a WAL if every record kind the runtime appends is
+(a) applied by ``storage/recovery.apply_record`` on restart and
+(b) reachable by the replica tailer (which routes through the same
+``apply_record``). The PR-9 convergence bug was exactly this asymmetry
+— a record shape the journal emitted that replay reconstructed
+differently — and it was found by a chaos test; this rule turns the
+contract into a registry diff that fails at lint time.
+
+Mechanics (all AST, cross-module):
+
+- **producers**: every ``*._journal_append(KIND, ...)`` /
+  ``*._journal(KIND, ...)`` call site, with KIND a string literal or a
+  module-level constant (``DISPATCH_RECORD = "federation_dispatch"``);
+- **handlers**: the record types ``apply_record`` dispatches on —
+  ``rec.type == CONST`` comparisons and ``rec.type in TUPLE`` member-
+  ship tests, constants resolved within the defining module;
+- **tailer path**: some module other than the recovery module must
+  call ``apply_record(...)`` (the tailer's ingest loop) — delete that
+  wiring and replicas silently diverge from recovery.
+
+A produced kind with no handler, a handled kind no producer emits
+(dead vocabulary masking a deleted producer), or a missing tailer path
+are each findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    dotted_name,
+    module_str_constants,
+    module_str_tuples,
+    register,
+    str_const,
+)
+
+#: the append funnels: controllers/cluster.ClusterRuntime and
+#: federation/dispatcher route every durable mutation through
+#: ``_journal_append``/``_journal``; the solver guard emits its
+#: durable verdicts through the injected ``journal_hook``
+_PRODUCER_FUNCS = {"_journal_append", "_journal", "journal_hook"}
+
+
+def _resolve_kind(
+    arg: ast.AST, consts: Dict[str, str]
+) -> Optional[str]:
+    s = str_const(arg)
+    if s is not None:
+        return s
+    if isinstance(arg, ast.Name):
+        return consts.get(arg.id)
+    if isinstance(arg, ast.Attribute):
+        # recovery.WORKLOAD_UPSERT style cross-module reference: the
+        # attr name is the constant; resolve against local consts too
+        return consts.get(arg.attr)
+    return None
+
+
+def _collect_producers(
+    src: SourceFile,
+) -> List[Tuple[str, int]]:
+    """(kind, line) for every journal-append call in ``src``."""
+    consts = module_str_constants(src.tree)
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (
+            isinstance(fn, ast.Attribute) and fn.attr in _PRODUCER_FUNCS
+        ):
+            continue
+        if not node.args:
+            continue
+        kind = _resolve_kind(node.args[0], consts)
+        if kind is None:
+            # a pass-through parameter (the funnel itself re-forwarding
+            # its own argument) — not a production site
+            continue
+        out.append((kind, node.lineno))
+    return out
+
+
+def _collect_handlers(
+    src: SourceFile,
+) -> Optional[Dict[str, int]]:
+    """kind -> dispatch line, from this module's ``apply_record`` (None
+    when the module does not define one)."""
+    apply_fn = None
+    for node in ast.iter_child_nodes(src.tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "apply_record"
+        ):
+            apply_fn = node
+            break
+    if apply_fn is None:
+        return None
+    consts = module_str_constants(src.tree)
+    tuples = module_str_tuples(src.tree)
+    handled: Dict[str, int] = {}
+    for node in ast.walk(apply_fn):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        left = dotted_name(node.left)
+        if left is None or not left.endswith(".type"):
+            continue
+        comp = node.comparators[0]
+        if isinstance(node.ops[0], ast.Eq):
+            kind = _resolve_kind(comp, consts)
+            if kind is not None:
+                handled.setdefault(kind, node.lineno)
+        elif isinstance(node.ops[0], ast.In):
+            names: List[str] = []
+            if isinstance(comp, ast.Name):
+                names = tuples.get(comp.id, [])
+            elif isinstance(comp, (ast.Tuple, ast.List)):
+                for elt in comp.elts:
+                    k = _resolve_kind(elt, consts)
+                    if k is not None:
+                        names.append(k)
+            for kind in names:
+                handled.setdefault(kind, node.lineno)
+    return handled
+
+
+@register
+class JournalSymmetryRule(Rule):
+    name = "journal-symmetry"
+    description = (
+        "journal record kinds appended by the runtime must resolve to "
+        "a recovery.apply_record handler and a tailer-ingestible path"
+    )
+
+    def finalize(self, ctx: AnalysisContext) -> List[Finding]:
+        producers: Dict[str, List[Tuple[str, int]]] = {}
+        handlers: Dict[str, int] = {}
+        handler_src: Optional[SourceFile] = None
+        tailer_calls_apply = False
+        for src in ctx.sources:
+            if src.tree is None:
+                continue
+            for kind, line in _collect_producers(src):
+                producers.setdefault(kind, []).append((src.rel, line))
+            h = _collect_handlers(src)
+            if h is not None:
+                handlers.update(h)
+                handler_src = src
+            else:
+                # an apply_record CALL outside the defining module is
+                # the tailer/replica ingest path
+                for node in ast.walk(src.tree):
+                    if isinstance(node, ast.Call):
+                        dn = dotted_name(node.func)
+                        if dn is not None and dn.rsplit(".", 1)[
+                            -1
+                        ] == "apply_record":
+                            tailer_calls_apply = True
+        if not producers:
+            return []
+        findings: List[Finding] = []
+        if handler_src is None:
+            first_kind = sorted(producers)[0]
+            rel, line = producers[first_kind][0]
+            findings.append(
+                Finding(
+                    self.name, rel, line,
+                    "journal records are appended but no module defines "
+                    "an apply_record handler — replay is impossible",
+                )
+            )
+            return findings
+        for kind in sorted(producers):
+            if kind not in handlers:
+                for rel, line in producers[kind]:
+                    findings.append(
+                        Finding(
+                            self.name, rel, line,
+                            f"record kind {kind!r} is journaled here "
+                            "but has no apply_record handler in "
+                            f"{handler_src.rel} — recovery and "
+                            "replicas will silently drop it",
+                        )
+                    )
+        for kind in sorted(handlers):
+            if kind not in producers:
+                findings.append(
+                    Finding(
+                        self.name, handler_src.rel, handlers[kind],
+                        f"apply_record handles kind {kind!r} but no "
+                        "journal-append site produces it — dead "
+                        "vocabulary (or its producer was deleted)",
+                    )
+                )
+        if not tailer_calls_apply:
+            findings.append(
+                Finding(
+                    self.name, handler_src.rel, 1,
+                    "no module outside the recovery module calls "
+                    "apply_record — the journal tailer (read replicas) "
+                    "has no ingest path for these records",
+                )
+            )
+        return findings

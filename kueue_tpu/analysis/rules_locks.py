@@ -1,0 +1,357 @@
+"""lock-discipline — annotated shared state must be written under its
+lock.
+
+Four thread families mutate control-plane state concurrently: the
+scheduler/pipeline thread, the server request threads, the replica
+tail thread and the tracer's readers. The repo convention this rule
+checks is an explicit ownership annotation on the attribute:
+
+    self._cursor = 0  # guarded by: _lock
+
+Every *write* to an annotated attribute (rebind, augment, subscript
+store, delete, or a mutating container call like ``.append``/
+``.update``) must then be lexically inside ``with self.<lock>:`` —
+unless the enclosing function is the constructor (happens-before
+publication), carries the ``_locked`` suffix convention, or declares
+``# kueuelint: holds=<lock>`` (both mean "every caller holds it").
+
+Writes from *outside* the owning class (``stats.rounds += 1`` in some
+other module) are always findings: cross-object mutation of guarded
+state must go through a method of the owning class, where the lock is
+visible and checkable. Reads are deliberately unchecked — the repo
+has intentional lock-free read paths (GIL-atomic dict gets on the
+tracer hot path) and flagging them would teach people to ignore the
+rule.
+
+Dataclass fields annotate the same way on the class-body line:
+
+    rounds: int = 0  # guarded by: _lock
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    register,
+)
+
+#: container-method calls that mutate the receiver
+_MUTATING_CALLS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "clear", "update", "setdefault", "add",
+    "discard", "move_to_end", "sort", "reverse",
+}
+
+_CTORS = {"__init__", "__post_init__", "__new__"}
+
+
+@dataclass
+class _Guarded:
+    cls: str
+    attr: str
+    lock: str
+    file: str
+    line: int
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` -> ``x`` (else None)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _base_attr(node: ast.AST) -> Optional[Tuple[str, str]]:
+    """``name.x`` -> (name, x) for a non-self single-level base."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id != "self"
+    ):
+        return node.value.id, node.attr
+    return None
+
+
+def _collect_guarded(src: SourceFile) -> List[_Guarded]:
+    out: List[_Guarded] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            # dataclass-style class-body annotation
+            if isinstance(stmt, (ast.AnnAssign, ast.Assign)):
+                lock = src.guarded_by(stmt.lineno)
+                if lock is None:
+                    continue
+                tgt = (
+                    stmt.target
+                    if isinstance(stmt, ast.AnnAssign)
+                    else (stmt.targets[0] if len(stmt.targets) == 1 else None)
+                )
+                if isinstance(tgt, ast.Name):
+                    out.append(
+                        _Guarded(node.name, tgt.id, lock, src.rel, stmt.lineno)
+                    )
+            elif (
+                isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and stmt.name in _CTORS
+            ):
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Assign):
+                        tgts = sub.targets
+                    elif isinstance(sub, ast.AnnAssign):
+                        tgts = [sub.target]
+                    else:
+                        continue
+                    lock = src.guarded_by(sub.lineno)
+                    if lock is None:
+                        continue
+                    for t in tgts:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            out.append(
+                                _Guarded(
+                                    node.name, attr, lock, src.rel,
+                                    sub.lineno,
+                                )
+                            )
+    return out
+
+
+def _class_attr_definitions(src: SourceFile) -> List[Tuple[str, str]]:
+    """(attr, class) for every attribute a class defines — class-body
+    annotations/assignments plus constructor ``self.x = ...``."""
+    out: List[Tuple[str, str]] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                out.append((stmt.target.id, node.name))
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out.append((t.id, node.name))
+            elif (
+                isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and stmt.name in _CTORS
+            ):
+                for sub in ast.walk(stmt):
+                    tgts: List[ast.AST] = []
+                    if isinstance(sub, ast.Assign):
+                        tgts = list(sub.targets)
+                    elif isinstance(sub, ast.AnnAssign):
+                        tgts = [sub.target]
+                    for t in tgts:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            out.append((attr, node.name))
+    return out
+
+
+class _WriteVisitor:
+    """Walks a method body tracking which self.<lock>s are held."""
+
+    def __init__(
+        self,
+        rule: "LockDisciplineRule",
+        src: SourceFile,
+        guards: Dict[str, str],  # attr -> lock (for the current class)
+        findings: List[Finding],
+        method: str,
+    ):
+        self.rule = rule
+        self.src = src
+        self.guards = guards
+        self.findings = findings
+        self.method = method
+
+    def visit(self, node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, ast.With):
+            newly = set(held)
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    newly.add(attr)
+            for stmt in node.body:
+                self.visit(stmt, newly)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested closure: runs inline in practice; inherits held
+            inner_holds = self.src.holds_lock(node.lineno)
+            inner = set(held)
+            if inner_holds is not None:
+                inner.add(inner_holds)
+            if node.name.endswith("_locked"):
+                inner |= set(self.guards.values())
+            for stmt in node.body:
+                self.visit(stmt, inner)
+            return
+        self._check_node(node, held)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child, held)
+
+    def _check_node(self, node: ast.AST, held: Set[str]) -> None:
+        writes: List[Tuple[str, int, str]] = []  # (attr, line, how)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._target_writes(t, node.lineno, writes)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            self._target_writes(node.target, node.lineno, writes)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._target_writes(t, node.lineno, writes)
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _MUTATING_CALLS
+            ):
+                attr = _self_attr(fn.value)
+                if attr is not None:
+                    writes.append((attr, node.lineno, f".{fn.attr}()"))
+        for attr, line, how in writes:
+            lock = self.guards.get(attr)
+            if lock is not None and lock not in held:
+                self.findings.append(
+                    Finding(
+                        self.rule.name, self.src.rel, line,
+                        f"write to self.{attr} ({how}) in "
+                        f"{self.method} outside `with self.{lock}:` — "
+                        f"the attribute is annotated `guarded by: "
+                        f"{lock}`",
+                    )
+                )
+
+    def _target_writes(
+        self, t: ast.AST, line: int, writes: List[Tuple[str, int, str]]
+    ) -> None:
+        attr = _self_attr(t)
+        if attr is not None:
+            writes.append((attr, line, "assignment"))
+            return
+        if isinstance(t, ast.Subscript):
+            attr = _self_attr(t.value)
+            if attr is not None:
+                writes.append((attr, line, "subscript store"))
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for elt in t.elts:
+                self._target_writes(elt, line, writes)
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = (
+        "writes to `# guarded by: <lock>`-annotated attributes outside "
+        "`with self.<lock>:` (and any cross-class write to them)"
+    )
+
+    def finalize(self, ctx: AnalysisContext) -> List[Finding]:
+        guarded: List[_Guarded] = []
+        for src in ctx.sources:
+            if src.tree is not None:
+                guarded.extend(_collect_guarded(src))
+        if not guarded:
+            return []
+        findings: List[Finding] = []
+        by_class: Dict[Tuple[str, str], Dict[str, str]] = {}
+        for g in guarded:
+            by_class.setdefault((g.file, g.cls), {})[g.attr] = g.lock
+        # the cross-class check is name-based (no type inference), so
+        # it only applies to attribute names that belong to EXACTLY
+        # one class in the tree — `foo.runtime = x` says nothing when
+        # three unrelated classes define a `runtime`
+        owners: Dict[str, Set[str]] = {}
+        for src in ctx.sources:
+            if src.tree is None:
+                continue
+            for attr, cls in _class_attr_definitions(src):
+                owners.setdefault(attr, set()).add(cls)
+        all_attrs: Dict[str, str] = {
+            g.attr: g.cls
+            for g in guarded
+            if len(owners.get(g.attr, {g.cls})) <= 1
+        }
+
+        for src in ctx.sources:
+            if src.tree is None:
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ClassDef):
+                    guards = by_class.get((src.rel, node.name))
+                    if guards:
+                        self._check_class(node, src, guards, findings)
+            self._check_foreign_writes(src, all_attrs, by_class, findings)
+        return findings
+
+    def _check_class(
+        self,
+        cls: ast.ClassDef,
+        src: SourceFile,
+        guards: Dict[str, str],
+        findings: List[Finding],
+    ) -> None:
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if stmt.name in _CTORS:
+                continue  # construction happens-before publication
+            held: Set[str] = set()
+            if stmt.name.endswith("_locked"):
+                held |= set(guards.values())
+            holds = src.holds_lock(stmt.lineno)
+            if holds is None and stmt.decorator_list:
+                holds = src.holds_lock(stmt.decorator_list[0].lineno)
+            if holds is not None:
+                held.add(holds)
+            visitor = _WriteVisitor(
+                self, src, guards, findings, f"{cls.name}.{stmt.name}"
+            )
+            for inner in stmt.body:
+                visitor.visit(inner, held)
+
+    def _check_foreign_writes(
+        self,
+        src: SourceFile,
+        all_attrs: Dict[str, str],
+        by_class: Dict[Tuple[str, str], Dict[str, str]],
+        findings: List[Finding],
+    ) -> None:
+        """Writes like ``stats.rounds += 1`` from outside the owning
+        class: the lock is not even visible there."""
+        for node in ast.walk(src.tree):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                base = _base_attr(t)
+                if base is None:
+                    continue
+                name, attr = base
+                owner = all_attrs.get(attr)
+                if owner is None:
+                    continue
+                findings.append(
+                    Finding(
+                        self.name, src.rel, node.lineno,
+                        f"write to {name}.{attr} outside class {owner} "
+                        f"— the attribute is lock-guarded; mutate it "
+                        f"through a {owner} method that takes the lock",
+                    )
+                )

@@ -1,0 +1,210 @@
+"""trace-safety — host calls inside jitted/vmapped functions.
+
+A jitted function runs once as a *trace* over abstract values; any
+host-side call inside it either burns in a trace-time constant
+(``time.time()``, ``random.*`` — silently frozen forever) or raises a
+``TracerError`` only on the first real batch shape (``.item()``,
+``int()`` on a tracer, Python ``if`` on a traced boolean). Every one
+of those is statically visible.
+
+Traced scope is computed, not guessed:
+
+- functions decorated with ``jit`` / ``jax.jit`` / ``partial(jax.jit,
+  ...)`` / ``vmap`` / ``pmap``;
+- functions passed by name into ``jax.jit(...)`` / ``vmap`` / ``pmap``
+  / ``shard_map`` or as loop/branch bodies to ``lax.while_loop`` /
+  ``lax.scan`` / ``lax.fori_loop`` / ``lax.cond`` / ``lax.switch``;
+- every ``def``/``lambda`` nested inside a traced function (the drain
+  kernels are built almost entirely from such closures).
+
+Host-function bodies in the same file (numpy planners, mirrors) are
+deliberately out of scope — the rule follows the tracer, not the file.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    dotted_name,
+    import_aliases,
+    register,
+    resolve_call_name,
+)
+
+#: calls that freeze a host value into the trace
+_FROZEN_HOST_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "time.sleep",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "random.random", "random.randint", "random.uniform",
+    "random.choice", "random.shuffle", "random.sample",
+    "random.randrange", "random.getrandbits",
+    "numpy.random.rand", "numpy.random.randn", "numpy.random.randint",
+    "numpy.random.random", "numpy.random.uniform",
+    "numpy.random.choice", "numpy.random.permutation",
+}
+
+#: jit-family transforms whose function argument becomes traced
+_TRACING_TRANSFORMS = {"jit", "vmap", "pmap", "shard_map", "checkpoint"}
+#: lax control-flow whose callables run traced
+_TRACING_CONTROL = {"while_loop", "scan", "fori_loop", "cond", "switch"}
+
+#: modules whose calls yield traced arrays — int()/float()/bool() or a
+#: Python if over an expression containing one concretizes a tracer
+_TRACER_MODULES = {"jnp", "lax", "jax"}
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    dn = dotted_name(dec)
+    if dn is not None:
+        leaf = dn.rsplit(".", 1)[-1]
+        if leaf in _TRACING_TRANSFORMS:
+            return True
+    if isinstance(dec, ast.Call):
+        # @partial(jax.jit, static_argnums=...) and @jax.jit(...) forms
+        dn = dotted_name(dec.func)
+        if dn is not None:
+            leaf = dn.rsplit(".", 1)[-1]
+            if leaf in _TRACING_TRANSFORMS:
+                return True
+            if leaf == "partial" and dec.args:
+                return _decorator_traces(dec.args[0])
+    return False
+
+
+def _contains_tracer_call(node: ast.AST) -> Optional[str]:
+    """A call rooted at jnp/lax/jax inside ``node`` (the expression
+    produces a traced array), or None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            dn = dotted_name(sub.func)
+            if dn is not None and dn.split(".", 1)[0] in _TRACER_MODULES:
+                return dn
+    return None
+
+
+class _TracedSetBuilder(ast.NodeVisitor):
+    """Collects the names of module-level/nested functions that run
+    under a tracer."""
+
+    def __init__(self):
+        self.traced: Set[str] = set()
+        # name -> FunctionDef for transitive marking
+        self.defs: Dict[str, ast.FunctionDef] = {}
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.defs[node.name] = node
+        if any(_decorator_traces(d) for d in node.decorator_list):
+            self.traced.add(node.name)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dn = dotted_name(node.func)
+        if dn is not None:
+            leaf = dn.rsplit(".", 1)[-1]
+            if leaf in _TRACING_TRANSFORMS | _TRACING_CONTROL:
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if isinstance(arg, ast.Name):
+                        self.traced.add(arg.id)
+        self.generic_visit(node)
+
+
+@register
+class TraceSafetyRule(Rule):
+    name = "trace-safety"
+    description = (
+        "host calls (time/random/.item()/int() on tracers/Python if on "
+        "traced values) inside jitted or vmapped functions"
+    )
+
+    def check(self, src: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        builder = _TracedSetBuilder()
+        builder.visit(src.tree)
+        if not builder.traced:
+            return []
+        aliases = import_aliases(src.tree)
+        findings: List[Finding] = []
+        seen: Set[int] = set()
+        for name in sorted(builder.traced):
+            fn = builder.defs.get(name)
+            if fn is not None and id(fn) not in seen:
+                seen.add(id(fn))
+                self._check_traced_body(fn, src, aliases, findings)
+        return findings
+
+    def _check_traced_body(
+        self,
+        fn: ast.FunctionDef,
+        src: SourceFile,
+        aliases: Dict[str, str],
+        findings: List[Finding],
+    ) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                self._check_call(node, fn, src, aliases, findings)
+            elif isinstance(node, (ast.If, ast.While)):
+                culprit = _contains_tracer_call(node.test)
+                if culprit is not None:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    findings.append(
+                        Finding(
+                            self.name, src.rel, node.lineno,
+                            f"Python `{kind}` on a traced value "
+                            f"({culprit}(...)) inside jitted "
+                            f"`{fn.name}` — concretizes the tracer; "
+                            "use lax.cond / jnp.where",
+                        )
+                    )
+
+    def _check_call(
+        self,
+        node: ast.Call,
+        fn: ast.FunctionDef,
+        src: SourceFile,
+        aliases: Dict[str, str],
+        findings: List[Finding],
+    ) -> None:
+        canon = resolve_call_name(node, aliases)
+        if canon in _FROZEN_HOST_CALLS:
+            findings.append(
+                Finding(
+                    self.name, src.rel, node.lineno,
+                    f"host call {canon}() inside jitted `{fn.name}` — "
+                    "its value freezes into the trace at compile time",
+                )
+            )
+            return
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            findings.append(
+                Finding(
+                    self.name, src.rel, node.lineno,
+                    f".item() inside jitted `{fn.name}` — forces a "
+                    "device sync and fails on tracers",
+                )
+            )
+            return
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("int", "float", "bool")
+            and node.args
+        ):
+            culprit = _contains_tracer_call(node.args[0])
+            if culprit is not None:
+                findings.append(
+                    Finding(
+                        self.name, src.rel, node.lineno,
+                        f"{node.func.id}() over a traced value "
+                        f"({culprit}(...)) inside jitted `{fn.name}` "
+                        "— concretizes the tracer",
+                    )
+                )

@@ -1210,6 +1210,25 @@ def cmd_import(state: State, args) -> None:
     print(f"imported={imported} skipped={skipped}")
 
 
+def cmd_lint(state: State, args) -> None:
+    """kueuelint — the AST-based static analysis suite
+    (kueue_tpu/analysis): kernel dtype/trace safety, journal<->replay
+    symmetry, clock & lock discipline, registry lints. Exit 2 on
+    findings the shrink-only baseline does not cover."""
+    from kueue_tpu.analysis.__main__ import main as lint_main
+
+    argv: List[str] = []
+    for rule in args.rule or []:
+        argv += ["--rule", rule]
+    for flag in ("update_baseline", "allow_grow", "no_baseline",
+                 "list_rules", "quiet"):
+        if getattr(args, flag):
+            argv.append("--" + flag.replace("_", "-"))
+    rc = lint_main(argv)
+    if rc != 0:
+        raise SystemExit(rc)
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="kueuectl")
     ap.add_argument("--state", default="kueue-state.json")
@@ -1323,6 +1342,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=cmd_version)
+
+    lnt = sub.add_parser(
+        "lint",
+        help="kueuelint static analysis over the kueue_tpu package",
+    )
+    lnt.add_argument(
+        "--rule", "-r", action="append", metavar="RULE",
+        help="run only this rule (repeatable)",
+    )
+    lnt.add_argument("--update-baseline", action="store_true")
+    lnt.add_argument("--allow-grow", action="store_true")
+    lnt.add_argument("--no-baseline", action="store_true")
+    lnt.add_argument("--list-rules", action="store_true")
+    lnt.add_argument("-q", "--quiet", action="store_true")
+    lnt.set_defaults(fn=cmd_lint)
 
     st = sub.add_parser(
         "state",

@@ -326,6 +326,7 @@ class ClusterRuntime:
         through the same mutation methods and must not re-append."""
         journal.metrics = self.metrics
         journal.tracer = self.tracer  # fsync spans on the cycle tree
+        journal.clock = self.clock  # record ts rides the replica feed
         self.journal = journal
         self.metrics.journal_degraded.set(1 if journal.degraded else 0)
         self.metrics.journal_segments.set(journal.stats().segments)
@@ -1460,7 +1461,7 @@ class ClusterRuntime:
             )
 
         def _set_inflight(v):
-            stats.inflight = v
+            stats.set_inflight(v)
             self.metrics.pipeline_inflight.set(v)
 
         t1 = _time.perf_counter()
@@ -1472,7 +1473,7 @@ class ClusterRuntime:
             t1 = _time.perf_counter()
             out_g = sched.guard.device_join(glaunch, lambda h: h.fetch())
             t_solve = t_dispatch + (_time.perf_counter() - t1)
-            stats.solve_s += t_solve
+            stats.note_solve(t_solve)
             _set_inflight(0)
             if out_g.result is None:
                 # contained launch/fetch failure (or deadline breach):
@@ -1531,7 +1532,7 @@ class ClusterRuntime:
                 if pf.failed:
                     pf = None
                 else:
-                    stats.prefetches += 1
+                    stats.note_prefetch()
                     _set_inflight(1)
                 faults.fire("cycle.prefetch_launched")
 
@@ -1554,10 +1555,7 @@ class ClusterRuntime:
                 _set_inflight(0)
                 return last_result
             t_apply = _time.perf_counter() - t1
-            stats.rounds += 1
-            stats.apply_s += t_apply
-            if pf is not None:
-                stats.overlapped_apply_s += t_apply
+            stats.note_apply(t_apply, overlapped=pf is not None)
             self.metrics.pipeline_overlap_ratio.set(stats.overlap_ratio)
             sched.guard.phase_checkpoint("drain.apply", device_used=True)
 
@@ -1572,7 +1570,7 @@ class ClusterRuntime:
                     # deactivated mid-apply): nothing left to solve —
                     # drop any prefetch and finish
                     if pf is not None:
-                        stats.discards += 1
+                        stats.note_discard()
                         self.metrics.pipeline_prefetch_discards_total.inc()
                         sched.tracer.add_cycle_span(
                             "cycle.discard",
@@ -1590,7 +1588,7 @@ class ClusterRuntime:
                 if not undecided:
                     pass
                 elif committed:
-                    stats.commits += 1
+                    stats.note_commit()
                     self._pipeline_committed += 1
                     faults.fire("cycle.commit_pre_apply")
                     glaunch, t_dispatch = pf, 0.0
@@ -1599,7 +1597,7 @@ class ClusterRuntime:
                     )
                 else:
                     if pf is not None:
-                        stats.discards += 1
+                        stats.note_discard()
                         self.metrics.pipeline_prefetch_discards_total.inc()
                         sched.tracer.add_cycle_span(
                             "cycle.discard",

@@ -28,6 +28,7 @@ decision.
 from __future__ import annotations
 
 import copy
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -37,37 +38,86 @@ import numpy as np
 @dataclass
 class PipelineStats:
     """Observable pipeline accounting (the ``kueue_pipeline_*`` metric
-    source and the dashboard badge detail)."""
+    source and the dashboard badge detail).
 
-    rounds: int = 0
-    prefetches: int = 0  # speculative launches dispatched
-    commits: int = 0  # prefetches whose conflict check passed
-    discards: int = 0  # prefetches invalidated by the apply
-    inflight: int = 0  # speculative launches currently in flight (0|1)
-    apply_s: float = 0.0  # total host apply wall time
-    overlapped_apply_s: float = 0.0  # apply time with a solve in flight
-    solve_s: float = 0.0  # total blocked-on-fetch + dispatch wall time
+    Written by the drain thread mid-round while the server's request
+    threads render ``to_dict`` (dashboard, SIGUSR2 dump) — so every
+    mutation goes through a ``note_*`` method under ``_lock`` and
+    ``to_dict`` snapshots under the same lock (a dump mid-round must
+    never show ``overlapped_apply_s`` from round t with ``apply_s``
+    from round t-1). kueuelint's lock-discipline rule enforces the
+    annotations below."""
+
+    rounds: int = 0  # guarded by: _lock
+    prefetches: int = 0  # guarded by: _lock — speculative launches
+    commits: int = 0  # guarded by: _lock — conflict check passed
+    discards: int = 0  # guarded by: _lock — invalidated by the apply
+    inflight: int = 0  # guarded by: _lock — launches in flight (0|1)
+    apply_s: float = 0.0  # guarded by: _lock — host apply wall time
+    overlapped_apply_s: float = 0.0  # guarded by: _lock
+    solve_s: float = 0.0  # guarded by: _lock — blocked-on-fetch wall
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False,
+    )
+
+    # ---- mutation API (the drain thread) ----
+    def note_solve(self, seconds: float) -> None:
+        with self._lock:
+            self.solve_s += seconds
+
+    def note_prefetch(self) -> None:
+        with self._lock:
+            self.prefetches += 1
+
+    def note_apply(self, seconds: float, overlapped: bool) -> None:
+        """One applied round: ``overlapped`` when a speculative solve
+        was in flight during the apply."""
+        with self._lock:
+            self.rounds += 1
+            self.apply_s += seconds
+            if overlapped:
+                self.overlapped_apply_s += seconds
+
+    def note_commit(self) -> None:
+        with self._lock:
+            self.commits += 1
+
+    def note_discard(self) -> None:
+        with self._lock:
+            self.discards += 1
+
+    def set_inflight(self, n: int) -> None:
+        with self._lock:
+            self.inflight = n
+
+    # ---- read API (request threads) ----
+    def _overlap_ratio_locked(self) -> float:
+        return (
+            self.overlapped_apply_s / self.apply_s if self.apply_s > 0 else 0.0
+        )
 
     @property
     def overlap_ratio(self) -> float:
         """Fraction of host apply time that ran with a device solve in
         flight — 1.0 means every apply was fully double-buffered."""
-        return (
-            self.overlapped_apply_s / self.apply_s if self.apply_s > 0 else 0.0
-        )
+        with self._lock:
+            return self._overlap_ratio_locked()
 
     def to_dict(self) -> dict:
-        return {
-            "rounds": self.rounds,
-            "prefetches": self.prefetches,
-            "commits": self.commits,
-            "discards": self.discards,
-            "inflight": self.inflight,
-            "overlapRatio": round(self.overlap_ratio, 4),
-            "applyMs": round(self.apply_s * 1e3, 3),
-            "overlappedApplyMs": round(self.overlapped_apply_s * 1e3, 3),
-            "solveMs": round(self.solve_s * 1e3, 3),
-        }
+        with self._lock:
+            return {
+                "rounds": self.rounds,
+                "prefetches": self.prefetches,
+                "commits": self.commits,
+                "discards": self.discards,
+                "inflight": self.inflight,
+                "overlapRatio": round(self._overlap_ratio_locked(), 4),
+                "applyMs": round(self.apply_s * 1e3, 3),
+                "overlappedApplyMs": round(
+                    self.overlapped_apply_s * 1e3, 3
+                ),
+                "solveMs": round(self.solve_s * 1e3, 3),
+            }
 
 
 def speculative_snapshot(snapshot, final_usage: np.ndarray):

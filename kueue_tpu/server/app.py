@@ -268,6 +268,15 @@ class KueueServer:
             runtime = ClusterRuntime(tas_cache=TASCache())
         self.runtime = runtime
         self.lock = threading.RLock()
+        # serving-surface clock: the runtime's injected clock when it
+        # has one (FakeClock tests drive roster staleness and feed
+        # leaderTime through it), a fresh Clock otherwise
+        clock = getattr(runtime, "clock", None)
+        if clock is None:
+            from kueue_tpu.utils.clock import Clock
+
+            clock = Clock()
+        self.clock = clock
         self.auto_reconcile = auto_reconcile
         if validators is None:
             from kueue_tpu.webhooks import default_admission_chain
@@ -1285,7 +1294,7 @@ def _make_handler(srv: KueueServer):
                     if journal.token_provider is not None
                     else None
                 ),
-                "leaderTime": time.time(),
+                "leaderTime": srv.clock.now(),
             }
             if since + 1 < first_available and journal.last_seq > since:
                 # the requested prefix was compacted away: the replica
@@ -1345,7 +1354,7 @@ def _make_handler(srv: KueueServer):
                 )
                 return
             journal = getattr(srv.runtime, "journal", None)
-            now = time.time()
+            now = srv.clock.now()
             items = []
             for entry in sorted(
                 srv.replica_roster.values(), key=lambda e: e["id"]

@@ -280,6 +280,7 @@ class Journal:
         segment_max_bytes: int = 8 << 20,
         token_provider: Optional[Callable[[], Optional[int]]] = None,
         metrics=None,  # kueue_tpu.metrics.Metrics (optional mirror)
+        clock=None,  # utils.clock.Clock — stamps record ts (replica lag)
     ):
         if fsync_policy not in FSYNC_POLICIES:
             raise ValueError(
@@ -292,6 +293,14 @@ class Journal:
         self.segment_max_bytes = segment_max_bytes
         self.token_provider = token_provider
         self.metrics = metrics
+        if clock is None:
+            from kueue_tpu.utils.clock import Clock
+
+            clock = Clock()
+        # record append-stamps ride the wire to replicas (lag math);
+        # injected so FakeClock tests control them. fsync pacing below
+        # deliberately stays monotonic (see _maybe_fsync).
+        self.clock = clock
         # tracing hook (kueue_tpu/tracing): real fsync syscalls land as
         # cycle.journal_fsync spans on the in-flight cycle's span tree
         # (wired by ClusterRuntime.attach_journal; None = untraced)
@@ -402,7 +411,7 @@ class Journal:
             token=token,
             type=rtype,
             data=data,
-            ts=time.time(),
+            ts=self.clock.now(),
         )
         payload = json.dumps(rec.to_dict(), separators=(",", ":")).encode()
         frame = _HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF)

@@ -101,10 +101,15 @@ class LocalTailSource:
     leader's job) — a torn frame just ends this poll's batch."""
 
     def __init__(self, journal_path: str, state_path: Optional[str] = None,
-                 limit: int = 4096):
+                 limit: int = 4096,
+                 now_fn: Callable[[], float] = time.time):
         self.journal_path = journal_path
         self.state_path = state_path
         self.limit = limit
+        # injected leader-clock stand-in: on a shared volume there is
+        # no leader process answering, so the batch's leader_time is
+        # this host's wall clock (same host, same clock domain)
+        self.now_fn = now_fn
 
     def fetch(self, since_seq: int, since_event_rv: int = 0,
               since_audit_seq: int = 0, status: Optional[dict] = None,
@@ -117,7 +122,7 @@ class LocalTailSource:
             first_available_seq=(
                 _segment_first_seq(names[0]) if names else 0
             ),
-            leader_time=time.time(),
+            leader_time=self.now_fn(),
         )
         for rec in iter_segment_records(self.journal_path, names, since_seq):
             batch.records.append(rec)
@@ -259,13 +264,17 @@ class JournalTailer:
         self.on_install = on_install
         self.now_fn = now_fn
         self.metrics = metrics
-        self.runtime = None
+        # the poll thread writes, the server's request threads read
+        # (status(), /healthz, roster echo): every attribute below is
+        # lock-guarded so a mid-poll status never pairs round t's
+        # cursor with round t-1's lag (kueuelint lock-discipline)
+        self.runtime = None  # guarded by: lock
         # replication cursors
-        self.applied_seq = 0
-        self.events_rv = 0
-        self.audit_seq = 0
-        self.span_seq = 0
-        self.max_token: Optional[int] = None
+        self.applied_seq = 0  # guarded by: lock
+        self.events_rv = 0  # guarded by: lock
+        self.audit_seq = 0  # guarded by: lock
+        self.span_seq = 0  # guarded by: lock
+        self.max_token: Optional[int] = None  # guarded by: lock
         # SSE/watch fan-out (replica/replica.py wires this): called
         # after any poll that applied records or ingested events/spans,
         # so blocked watch/SSE waiters wake on the tailer's own arrival
@@ -273,19 +282,19 @@ class JournalTailer:
         self.on_applied: Optional[Callable[[TailResult], None]] = None
         # accounting (stable across resyncs — the runtime is rebuilt,
         # the tailer is not)
-        self.records_applied = 0
-        self.skipped_stale = 0
-        self.resyncs = 0
-        self.lag_s = 0.0
-        self.last_error = ""
-        self.last_poll_ts: Optional[float] = None
+        self.records_applied = 0  # guarded by: lock
+        self.skipped_stale = 0  # guarded by: lock
+        self.resyncs = 0  # guarded by: lock
+        self.lag_s = 0.0  # guarded by: lock
+        self.last_error = ""  # guarded by: lock
+        self.last_poll_ts: Optional[float] = None  # guarded by: lock
         # consecutive polls where the leader claimed a head PAST our
         # cursor yet shipped zero records and no compaction marker — a
         # self-inconsistent feed (e.g. the journal directory deleted
         # under a live leader). One or two can be a torn in-flight
         # frame; persistent means the incremental path is dead and
         # only a checkpoint re-anchor recovers.
-        self._empty_behind = 0
+        self._empty_behind = 0  # guarded by: lock
 
     # ---- lifecycle ----
     def ensure_runtime(self):
@@ -297,7 +306,7 @@ class JournalTailer:
                     self._install(self.build_runtime())
         return self.runtime
 
-    def _install(self, rt) -> None:
+    def _install(self, rt) -> None:  # kueuelint: holds=lock
         """Swap in a rebuilt runtime, carrying the OBSERVABILITY spine
         over: the event recorder, audit log and metrics registry are
         long-lived replica-side stores (resourceVersion/seq continuity
@@ -324,6 +333,10 @@ class JournalTailer:
     # ---- sync ----
     def status(self) -> dict:
         behind = None
+        with self.lock:
+            return self._status_locked(behind)
+
+    def _status_locked(self, behind) -> dict:
         return {
             "appliedSeq": self.applied_seq,
             "appliedEventsRv": self.events_rv,
@@ -376,7 +389,7 @@ class JournalTailer:
             self.applied_seq = int(persistence.get("journalSeq", 0))
             if persistence.get("token") is not None:
                 self.max_token = int(persistence["token"])
-        self.resyncs += 1
+            self.resyncs += 1
         if self.metrics is not None:
             self.metrics.replica_resyncs_total.inc()
         return True
@@ -389,11 +402,14 @@ class JournalTailer:
         res = TailResult()
         try:
             res = self._poll(res)
-            self.last_error = ""
+            with self.lock:
+                self.last_error = ""
         except TailSourceError as e:
-            self.last_error = str(e)
-            res.error = self.last_error
-        self.last_poll_ts = self.now_fn()
+            with self.lock:
+                self.last_error = str(e)
+            res.error = str(e)
+        with self.lock:
+            self.last_poll_ts = self.now_fn()
         if self.metrics is not None:
             self.metrics.replica_applied_seq.set(self.applied_seq)
             self.metrics.replica_lag_seconds.set(self.lag_s)
@@ -466,8 +482,9 @@ class JournalTailer:
                 if self.max_token is not None and rec.token < self.max_token:
                     # a deposed leader's stray append: refuse it, but
                     # advance past it — recovery replay does the same
-                    self.applied_seq = rec.seq
-                    self.skipped_stale += 1
+                    with self.lock:
+                        self.applied_seq = rec.seq
+                        self.skipped_stale += 1
                     res.skipped_stale += 1
                     continue
                 if self.max_token is not None and rec.token > self.max_token:
@@ -481,17 +498,18 @@ class JournalTailer:
                     # no checkpoint: adopt the new fence and keep
                     # tailing (journal-only topologies — recovery
                     # semantics make the applied records idempotent)
-                self.max_token = (
-                    rec.token if self.max_token is None
-                    else max(self.max_token, rec.token)
-                )
+                with self.lock:
+                    self.max_token = (
+                        rec.token if self.max_token is None
+                        else max(self.max_token, rec.token)
+                    )
             with self.lock:
                 apply_record(self.runtime, rec)
                 self.applied_seq = rec.seq
                 self.runtime.resource_version = max(
                     getattr(self.runtime, "resource_version", 0), rec.rv
                 )
-            self.records_applied += 1
+                self.records_applied += 1
             res.applied += 1
             applied_ts = rec.ts
             if self.metrics is not None:
@@ -503,16 +521,19 @@ class JournalTailer:
         for item in batch.events:
             if rec_events.ingest(item) is not None:
                 res.events_ingested += 1
-        self.events_rv = max(self.events_rv, batch.events_rv)
+        with self.lock:
+            self.events_rv = max(self.events_rv, batch.events_rv)
         for item in batch.audit:
             self.runtime.audit.ingest(item)
-        self.audit_seq = max(self.audit_seq, batch.audit_seq)
+        with self.lock:
+            self.audit_seq = max(self.audit_seq, batch.audit_seq)
         tracer = getattr(self.runtime, "tracer", None)
         if tracer is not None:
             for item in batch.spans:
                 tracer.ingest(item)
                 res.spans_ingested += 1
-        self.span_seq = max(self.span_seq, batch.spans_seq)
+        with self.lock:
+            self.span_seq = max(self.span_seq, batch.spans_seq)
         # inconsistent-feed fence: behind with nothing shipped and no
         # compaction marker — tolerate a couple (a torn in-flight tail
         # frame reads as empty), then re-anchor on a checkpoint
@@ -522,9 +543,12 @@ class JournalTailer:
             and not batch.records
             and batch.last_seq > self.applied_seq
         ):
-            self._empty_behind += 1
-            if self._empty_behind >= 3:
-                self._empty_behind = 0
+            with self.lock:
+                self._empty_behind += 1
+                tripped = self._empty_behind >= 3
+                if tripped:
+                    self._empty_behind = 0
+            if tripped:
                 faults.fire("replica.tail_gap")
                 if self.resync():
                     res.resynced = True
@@ -535,14 +559,16 @@ class JournalTailer:
                         "checkpoint is available"
                     )
         else:
-            self._empty_behind = 0
+            with self.lock:
+                self._empty_behind = 0
         # staleness: the shipping delay of the newest record this poll
         # applied (leader append-stamp -> replica apply, leader-clock
         # stamped so cross-host skew clamps at 0); an idle caught-up
         # poll (nothing new to ship) reads 0
         res.caught_up = self.applied_seq >= batch.last_seq
-        if applied_ts:
-            self.lag_s = max(0.0, self.now_fn() - applied_ts)
-        elif res.caught_up:
-            self.lag_s = 0.0
+        with self.lock:
+            if applied_ts:
+                self.lag_s = max(0.0, self.now_fn() - applied_ts)
+            elif res.caught_up:
+                self.lag_s = 0.0
         return res

@@ -44,6 +44,7 @@ the newest ``max_traces`` traces, not all 50k.
 
 from __future__ import annotations
 
+import itertools as _itertools
 import os
 import threading
 import time as _time
@@ -159,34 +160,41 @@ class Tracer:
         self.enabled = enabled
         self.passive = False
         # trace id -> spans in record order (LRU-bounded on traces)
-        self._traces: "OrderedDict[str, List[Span]]" = OrderedDict()
-        # workload key -> lifecycle trace id
-        self._workload: Dict[str, str] = {}
+        self._traces: "OrderedDict[str, List[Span]]" = OrderedDict()  # guarded by: _lock
+        # workload key -> lifecycle trace id (writes locked; the event/
+        # audit hot path does GIL-atomic lock-free dict READS — see
+        # workload_trace_id)
+        self._workload: Dict[str, str] = {}  # guarded by: _lock
         # workload key -> open lifecycle root (for close-on-admit)
-        self._roots: Dict[str, Span] = {}
+        self._roots: Dict[str, Span] = {}  # guarded by: _lock
         self._lock = threading.Lock()
         # replication stamp (the audit-log seq pattern): every stored
         # or updated span restamps; since() ships each span once at its
         # latest stamp
-        self.seq = 0
-        self._stamp_log: Deque = deque(maxlen=8192)
+        self.seq = 0  # guarded by: _lock
+        self._stamp_log: Deque = deque(maxlen=8192)  # guarded by: _lock
         # id generation: process-unique prefix + counter — cheap, and
-        # unique across the processes of one deployment (pid+random)
+        # unique across the processes of one deployment (pid+random).
+        # itertools.count.__next__ is C-atomic under the GIL, so id
+        # generation never needs _lock even though recording sites
+        # call it both inside and outside the locked region (a plain
+        # `self._n += 1` here raced scheduler vs request threads into
+        # duplicate span ids)
         self._id_prefix = f"{os.getpid() & 0xFFFF:04x}{int.from_bytes(os.urandom(4), 'big'):08x}"
-        self._n = 0
+        self._ids = iter(_itertools.count(1))
         # the in-flight cycle: (trace_id, root_span_id, cycle, buffer)
         # — children buffered here flush atomically in record_cycle
         self._cycle: Optional[Tuple[str, str, int, List[Span]]] = None
         # the most recently FLUSHED cycle trace id: the scheduler's
         # audit pass runs just after the flush and still references it
-        self._last_cycle_tid: Optional[str] = None
+        self._last_cycle_tid: Optional[str] = None  # guarded by: _lock
         # batched kueue_trace_spans_total mirror: a per-span registry
         # inc costs more than the span itself (label-key hashing), so
         # counts accumulate here and flush per cycle / per read — the
         # hot path pays one dict bump per span, the scrape surface lags
         # by at most one cycle
-        self._pending_counts: Dict[str, int] = {}
-        self._pending_n = 0
+        self._pending_counts: Dict[str, int] = {}  # guarded by: _lock
+        self._pending_n = 0  # guarded by: _lock
         # exact self-accounting: wall seconds spent inside the tracer's
         # recording entry points (the guard.divergence_check_s pattern)
         # — bench.py --trace asserts the <2% overhead budget on THIS,
@@ -196,11 +204,11 @@ class Tracer:
         # batched queue-to-admission waits (cq -> [seconds]), same
         # rationale: one histogram label resolution per flush, not per
         # admitted workload
-        self._pending_waits: Dict[str, List[float]] = {}
+        self._pending_waits: Dict[str, List[float]] = {}  # guarded by: _lock
         # scheduling-cycle number -> cycle trace id (bounded): the
         # read-time synthesis of decision spans correlates an audit
         # record's cycle with its span tree through this index
-        self._cycle_index: "OrderedDict[int, str]" = OrderedDict()
+        self._cycle_index: "OrderedDict[int, str]" = OrderedDict()  # guarded by: _lock
 
     # ---- clock / ids ----
     def now(self) -> float:
@@ -209,14 +217,15 @@ class Tracer:
     def _next_id(self, width: int = 16) -> str:
         """Hex id: process-entropy prefix + monotone counter, so ids
         never collide across the processes sharing one trace (manager /
-        worker / replica)."""
-        self._n += 1
+        worker / replica) — nor across this process's threads (the
+        counter is a GIL-atomic itertools.count, callable with or
+        without _lock held)."""
+        n = next(self._ids)
         ent = width - 10 if width > 10 else 0
-        return self._id_prefix[:ent] + f"{self._n:x}".rjust(width - ent, "0")
+        return self._id_prefix[:ent] + f"{n:x}".rjust(width - ent, "0")
 
     def new_trace_id(self) -> str:
-        self._n += 1
-        return self._id_prefix + f"{self._n:x}".rjust(20, "0")
+        return self._id_prefix + f"{next(self._ids):x}".rjust(20, "0")
 
     # ---- storage primitives ----
     def _check_name(self, name: str) -> None:
@@ -227,7 +236,7 @@ class Tracer:
                 "names are not allowed"
             )
 
-    def _store(self, span: Span) -> Span:
+    def _store(self, span: Span) -> Span:  # kueuelint: holds=_lock
         """Stamp + append one span (lock held by caller)."""
         self.seq += 1
         span.seq = self.seq
@@ -274,7 +283,7 @@ class Tracer:
         with self._lock:
             self._flush_counts_locked()
 
-    def _restamp(self, span: Span) -> None:
+    def _restamp(self, span: Span) -> None:  # kueuelint: holds=_lock
         self.seq += 1
         span.seq = self.seq
         self._stamp_log.append((self.seq, span))
@@ -574,7 +583,7 @@ class Tracer:
             while len(self._cycle_index) > 8192:
                 self._cycle_index.popitem(last=False)
             self._flush_counts_locked()
-        self._last_cycle_tid = tid
+            self._last_cycle_tid = tid
         if trace is not None:
             trace.trace_id = tid
         return tid

@@ -293,19 +293,15 @@ class TestReasonLint:
     def test_source_event_reasons_are_canonical(self):
         """Static lint over the package: every literal first argument
         of runtime.event(...) / self.events(...) / events.record(...)
-        must be a member of EVENT_REASONS."""
-        pkg = Path(__file__).resolve().parent.parent / "kueue_tpu"
-        call = re.compile(
-            r"\.(?:event|events|record)\(\s*\n?\s*\"([A-Za-z]+)\""
-        )
-        offenders = []
-        for path in sorted(pkg.rglob("*.py")):
-            for kind in call.findall(path.read_text()):
-                if kind not in EVENT_REASONS:
-                    offenders.append((str(path.relative_to(pkg)), kind))
+        must be a member of EVENT_REASONS. Thin wrapper over the
+        kueuelint ``reason-enum`` rule (kueue_tpu/analysis) — the one
+        scanning implementation since PR 11."""
+        from kueue_tpu.analysis import lint
+
+        offenders = lint(rules=["reason-enum"])
         assert not offenders, (
-            f"ad-hoc event reasons (add to EVENT_REASONS or fix the "
-            f"call site): {offenders}"
+            "ad-hoc event reasons (add to EVENT_REASONS or fix the "
+            "call site):\n" + "\n".join(str(f) for f in offenders)
         )
 
     def test_scenario_records_classify_without_unknown(self):

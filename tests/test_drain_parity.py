@@ -213,71 +213,39 @@ class TestKernelMirrorRegistry:
     """The kernel<->host-mirror parity lint (ops/__init__.py
     KERNEL_MIRRORS): every device kernel module must register a mirror
     that resolves and a parity test file that exists — so a new kernel
-    (or a reworked panel shape) cannot silently drop mirror coverage."""
+    (or a reworked panel shape) cannot silently drop mirror coverage.
+    Thin wrappers over the kueuelint ``kernel-mirrors`` rule
+    (kueue_tpu/analysis) — one scanning implementation since PR 11,
+    historical test names preserved."""
 
-    def _kernel_modules(self):
-        from pathlib import Path
+    def _findings(self):
+        from kueue_tpu.analysis import lint
 
-        import kueue_tpu.ops as ops_pkg
-
-        root = Path(ops_pkg.__file__).parent
-        names = {p.stem for p in root.glob("*_kernel.py")}
-        names.add("quota")  # the tree recurrences are device code too
-        return names
+        return lint(rules=["kernel-mirrors"])
 
     def test_every_kernel_has_a_registered_mirror(self):
-        from kueue_tpu.ops import KERNEL_MIRRORS
-
-        missing = self._kernel_modules() - set(KERNEL_MIRRORS)
-        assert not missing, (
-            f"device kernels without a registered host mirror: {missing} "
-            "— add a numpy/host twin and a parity test, then register "
-            "them in ops/__init__.KERNEL_MIRRORS"
-        )
-        stale = set(KERNEL_MIRRORS) - self._kernel_modules()
-        assert not stale, f"registry entries with no kernel file: {stale}"
+        offenders = [
+            f for f in self._findings()
+            if "host mirror" in f.message or "stale" in f.message
+        ]
+        assert not offenders, "\n".join(str(f) for f in offenders)
 
     def test_sharded_entry_points_share_the_single_device_mirror(self):
         """PR-8 extension: every kernel with a mesh path
-        (parallel.SHARDED_KERNELS) must be registered here too — a
-        sharded launch answers to the SAME host mirror as its
-        single-device twin (mirrors are mesh-agnostic), so the guard's
-        failover and the pipelined drain's divergence sampling never
-        change with the mesh. A sharded entry without a mirror, or one
-        that does not resolve, fails CI."""
-        import importlib
-
-        from kueue_tpu.ops import KERNEL_MIRRORS
-        from kueue_tpu.parallel import SHARDED_KERNELS
-
-        missing = set(SHARDED_KERNELS) - set(KERNEL_MIRRORS)
-        assert not missing, (
-            f"sharded kernels without a registered host mirror: {missing}"
-        )
-        for kernel, entry in SHARDED_KERNELS.items():
-            mod_name, attr = entry.split(":")
-            mod = importlib.import_module(mod_name)
-            assert hasattr(mod, attr), (
-                f"{kernel}: sharded entry point {entry} does not resolve"
-            )
+        (parallel.SHARDED_KERNELS) must be registered too — a sharded
+        launch answers to the SAME host mirror as its single-device
+        twin (mirrors are mesh-agnostic), so the guard's failover and
+        the pipelined drain's divergence sampling never change with
+        the mesh. A sharded entry without a mirror, or one that does
+        not resolve, fails CI."""
+        offenders = [
+            f for f in self._findings() if "sharded" in f.message
+        ]
+        assert not offenders, "\n".join(str(f) for f in offenders)
 
     def test_mirrors_resolve_and_tests_exist(self):
-        import importlib
-        from pathlib import Path
-
-        from kueue_tpu.ops import KERNEL_MIRRORS
-
-        repo = Path(__file__).resolve().parent.parent
-        for kernel, (mirror, test_path) in KERNEL_MIRRORS.items():
-            mod_name, attr = mirror.split(":")
-            mod = importlib.import_module(mod_name)
-            assert hasattr(mod, attr), (
-                f"{kernel}: mirror {mirror} does not resolve"
-            )
-            tf = repo / test_path
-            assert tf.is_file() and tf.stat().st_size > 0, (
-                f"{kernel}: parity test {test_path} missing"
-            )
+        offenders = self._findings()
+        assert not offenders, "\n".join(str(f) for f in offenders)
 
     def test_drain_mirror_is_wired_to_the_kernel_shapes(self):
         """The registered drain mirror must accept the live DrainPlan

@@ -11,9 +11,7 @@ bugfix), /healthz degradation, and the fault-point registry lint.
 """
 
 import json
-import re
 import urllib.request
-from pathlib import Path
 
 import numpy as np
 import pytest
@@ -721,29 +719,14 @@ class TestFaultPointRegistry:
         a ``faults.fire("...")`` / ``faults.transform("...")`` /
         ``fault_point="..."`` call site must be registered in
         FAULT_POINTS (mirroring the PR-2 reason-enum lint), and every
-        registered point must have at least one production call site."""
-        root = Path(__file__).resolve().parent.parent / "kueue_tpu"
-        call = re.compile(
-            r"(?:faults\.(?:fire|transform)\(\s*\n?\s*|fault_point=)\"([a-z_.]+)\""
-        )
-        seen = {}
-        for path in sorted(root.rglob("*.py")):
-            if path.name == "faults.py":
-                continue
-            for name in call.findall(path.read_text()):
-                seen.setdefault(name, []).append(
-                    str(path.relative_to(root))
-                )
-        unregistered = {
-            n: p for n, p in seen.items() if n not in faults.FAULT_POINTS
-        }
-        assert not unregistered, (
-            f"unregistered fault points (add to FAULT_POINTS): "
-            f"{unregistered}"
-        )
-        unfired = set(faults.list_fault_points()) - set(seen)
-        assert not unfired, (
-            f"registered fault points with no call site: {unfired}"
+        registered point must have at least one production call site.
+        Thin wrapper over the kueuelint ``fault-point`` rule."""
+        from kueue_tpu.analysis import lint
+
+        offenders = lint(rules=["fault-point"])
+        assert not offenders, (
+            "fault-point registry violations:\n"
+            + "\n".join(str(f) for f in offenders)
         )
 
     def test_list_fault_points_sorted_and_documented(self):

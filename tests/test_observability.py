@@ -626,6 +626,18 @@ class TestMetricsExposition:
                     f"{name}{dict(key)}: +Inf bucket != _count"
                 )
 
+    def test_metric_family_names_lint(self):
+        """Static half of this exposition lint, promoted to the
+        kueuelint ``metrics-families`` rule (kueue_tpu/analysis):
+        family names must be kueue_-prefixed, grammar-valid and unique
+        with non-empty HELP strings. The runtime grammar + histogram
+        invariants stay in the tests above — they need a live
+        registry, not an AST."""
+        from kueue_tpu.analysis import lint
+
+        offenders = lint(rules=["metrics-families"])
+        assert not offenders, "\n".join(str(f) for f in offenders)
+
     def test_server_metrics_route_lints(self):
         from kueue_tpu.server import KueueClient, KueueServer
 

@@ -381,3 +381,56 @@ class TestGuardCoversPrefetch:
         ref, _ = build_rt(2, "serial")
         ref.run_until_idle(max_iterations=60)
         assert admitted(rt) == admitted(ref)
+
+
+class TestPipelineStatsLocking:
+    """kueuelint lock-discipline satellite: PipelineStats is written by
+    the drain thread and rendered by request threads, so every
+    mutation goes through a locked ``note_*`` method and ``to_dict``
+    snapshots atomically."""
+
+    def test_note_api_totals(self):
+        from kueue_tpu.core.pipeline import PipelineStats
+
+        st = PipelineStats()
+        st.note_solve(0.5)
+        st.note_prefetch()
+        st.note_apply(1.0, overlapped=True)
+        st.note_apply(1.0, overlapped=False)
+        st.note_commit()
+        st.note_discard()
+        st.set_inflight(1)
+        d = st.to_dict()
+        assert d["rounds"] == 2 and d["prefetches"] == 1
+        assert d["commits"] == 1 and d["discards"] == 1
+        assert d["inflight"] == 1
+        assert d["overlapRatio"] == 0.5
+        assert st.overlap_ratio == 0.5
+
+    def test_to_dict_never_tears_mid_round(self):
+        """apply_s and overlapped_apply_s move together inside one
+        note_apply: a concurrent to_dict must never observe the ratio
+        above 1.0 (the torn state a field-at-a-time writer exposed)."""
+        import threading
+
+        from kueue_tpu.core.pipeline import PipelineStats
+
+        st = PipelineStats()
+        errors = []
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                d = st.to_dict()
+                if d["overlapRatio"] > 1.0:
+                    errors.append(d)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            for _ in range(3000):
+                st.note_apply(1e-4, overlapped=True)
+        finally:
+            stop.set()
+            t.join()
+        assert not errors, errors[:3]

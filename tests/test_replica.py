@@ -726,3 +726,86 @@ class TestServeBenchPlumbing:
         assert res.error and tailer.last_error
         with pytest.raises(TailSourceError):
             tailer.source.checkpoint()
+
+
+class TestTailerClockAndLocking:
+    """kueuelint satellites: LocalTailSource stamps leader_time through
+    its injected ``now_fn``, and the tailer's cursors/accounting are
+    written under ``lock`` so a status() racing a poll never tears."""
+
+    def test_local_source_leader_time_is_injected(self, tmp_path):
+        rt, journal = leader_with_journal(tmp_path)
+        submit(rt, "wl-0")
+        clock = FakeClock(500.0)
+        src = LocalTailSource(
+            str(tmp_path / "journal"), now_fn=clock.now
+        )
+        batch = src.fetch(0)
+        assert batch.leader_time == 500.0
+        clock.advance(7.0)
+        assert src.fetch(batch.last_seq).leader_time == 507.0
+        journal.close()
+
+    def test_status_is_consistent_under_concurrent_polls(self, tmp_path):
+        """Hammer poll_once from one thread while reading status from
+        another: every snapshot must be internally consistent (cursor
+        never behind recordsApplied progress seen earlier)."""
+        rt, journal = leader_with_journal(tmp_path)
+        tailer = local_tailer(tmp_path)
+        errors = []
+        stop = threading.Event()
+
+        def reader():
+            last_applied = -1
+            while not stop.is_set():
+                st = tailer.status()
+                if st["appliedSeq"] < last_applied:
+                    errors.append(
+                        f"appliedSeq regressed: {st['appliedSeq']} < "
+                        f"{last_applied}"
+                    )
+                last_applied = st["appliedSeq"]
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            for i in range(20):
+                submit(rt, f"wl-{i}")
+                tailer.poll_once()
+        finally:
+            stop.set()
+            t.join()
+        assert not errors, errors
+        assert tailer.status()["appliedSeq"] == journal.last_seq
+        journal.close()
+
+
+class TestServerClockInjection:
+    """kueuelint clock-discipline satellite: the serving surface's
+    timestamps (feed leaderTime, roster staleness) come from the
+    runtime's injected clock, so a FakeClock pins them."""
+
+    def test_feed_leader_time_and_roster_staleness_use_runtime_clock(
+        self, tmp_path
+    ):
+        from kueue_tpu.server import KueueServer
+        from kueue_tpu.server.client import KueueClient
+
+        rt, journal = leader_with_journal(tmp_path)
+        rt.clock.set(1000.0)
+        srv = KueueServer(runtime=rt)
+        assert srv.clock is rt.clock
+        port = srv.start()
+        try:
+            client = KueueClient(f"http://127.0.0.1:{port}")
+            out = client.journal_tail(
+                since_seq=0, replica="rep-a", applied_seq=0, lag_s=0.0
+            )
+            assert out["leaderTime"] == 1000.0
+            rt.clock.advance(12.0)
+            roster = client.replicas()
+            item = [i for i in roster["items"] if i["id"] == "rep-a"][0]
+            assert item["lastSeenAgoS"] == 12.0
+        finally:
+            srv.stop()
+            journal.close()

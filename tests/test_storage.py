@@ -915,3 +915,30 @@ class TestChaosRandomizedSweep:
                     f"invariants broken after crash at {point} "
                     f"(skip {skip})"
                 )
+
+
+class TestJournalClockInjection:
+    """kueuelint clock-discipline satellite: record append-stamps ride
+    the replica feed (lag math), so they come from an injected clock —
+    a FakeClock test can pin every ``ts`` on disk."""
+
+    def test_injected_clock_stamps_record_ts(self, tmp_path):
+        clock = FakeClock(1234.5)
+        j = Journal(
+            str(tmp_path / "j"), fsync_policy="never", clock=clock
+        ).open()
+        j.append("workload_delete", {"key": "ns/a"}, rv=1)
+        clock.advance(10.0)
+        j.append("workload_delete", {"key": "ns/b"}, rv=2)
+        recs = list(j.records())
+        assert [r.ts for r in recs] == [1234.5, 1244.5]
+        j.close()
+
+    def test_attach_journal_adopts_runtime_clock(self, tmp_path):
+        rt = ClusterRuntime(clock=FakeClock(77.0), use_solver=False)
+        j = Journal(str(tmp_path / "j"), fsync_policy="never").open()
+        rt.attach_journal(j)
+        assert j.clock is rt.clock
+        rt.add_flavor(ResourceFlavor(name="default"))
+        assert list(j.records())[-1].ts == 77.0
+        j.close()
