@@ -154,6 +154,79 @@ class SpanNameRule(Rule):
         ]
 
 
+# ---- policy-name ----
+@register
+class PolicyNameRule(Rule):
+    name = "policy-name"
+    description = (
+        "literal admission-policy names at resolve_policy()/"
+        "set_policy()/PolicyDelta() call sites must belong to the "
+        "closed kueue_tpu.policy.POLICY registry"
+    )
+
+    _CALL_NAMES = {"resolve_policy", "set_policy", "PolicyDelta"}
+    _NAME_RE = re.compile(r"^[a-z][a-z0-9-]*$")
+
+    def _policies(self, ctx: AnalysisContext) -> Set[str]:
+        names = ctx.config.get("policy_names")
+        if names is None:
+            from kueue_tpu.policy import POLICY
+
+            names = POLICY
+        return set(names)
+
+    def check(self, src: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        policies = self._policies(ctx)
+        findings: List[Finding] = []
+        matched = ctx.config.setdefault("_policy_sites", [])
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            callee = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if callee not in self._CALL_NAMES:
+                continue
+            s = _first_str_arg(node)
+            if s is None:
+                # also accept policy= keyword literals
+                for kw in node.keywords:
+                    if kw.arg == "policy":
+                        s = str_const(kw.value)
+                        break
+            if s is None or not self._NAME_RE.match(s):
+                continue
+            matched.append(s)
+            if s not in policies:
+                findings.append(
+                    Finding(
+                        self.name, src.rel, node.lineno,
+                        f"unregistered admission policy {s!r} — the "
+                        "POLICY registry is closed; add the policy "
+                        "there or fix the call site",
+                    )
+                )
+        return findings
+
+    def finalize(self, ctx: AnalysisContext) -> List[Finding]:
+        if not ctx.config.get("require_call_sites", True):
+            return []
+        if ctx.config.get("_policy_sites"):
+            return []
+        rel = next(
+            (s.rel for s in ctx.sources if s.rel.endswith("policy/engine.py")),
+            ctx.sources[0].rel if ctx.sources else "<tree>",
+        )
+        return [
+            Finding(
+                self.name, rel, 1,
+                "policy-name lint matched no call sites — the "
+                "call-site pattern rotted (resolution API renamed?)",
+            )
+        ]
+
+
 # ---- fault-point ----
 @register
 class FaultPointRule(Rule):
@@ -349,6 +422,18 @@ class KernelMirrorsRule(Rule):
             sharded = SHARDED_KERNELS
         return dict(mirrors), dict(sharded)
 
+    def _scored(self, ctx: AnalysisContext) -> Dict[str, Tuple[str, str]]:
+        scored = ctx.config.get("scored_kernels")
+        if scored is None:
+            if "kernel_mirrors" in ctx.config:
+                # fixture run overriding the mirror registry without a
+                # scored registry: none, by construction
+                return {}
+            from kueue_tpu.ops import SCORED_KERNELS
+
+            scored = SCORED_KERNELS
+        return dict(scored)
+
     def finalize(self, ctx: AnalysisContext) -> List[Finding]:
         stems = ctx.config.get("kernel_stems")
         anchor = next(
@@ -411,6 +496,46 @@ class KernelMirrorsRule(Rule):
             self._check_resolves(
                 stem, entry, "sharded entry point", anchor, findings
             )
+        # policy-scored entry points (kueue_tpu/policy): every
+        # SCORED_KERNELS entry must name a kernel registered above,
+        # resolve both halves, and carry an existing parity test —
+        # a scored kernel cannot ship without a bit-exact scored mirror
+        for ref, (mirror, test_path) in sorted(self._scored(ctx).items()):
+            if ":" not in ref:
+                findings.append(
+                    Finding(
+                        self.name, anchor, 1,
+                        f"scored kernel {ref!r} is not a "
+                        "'module_stem:entry_point' reference",
+                    )
+                )
+                continue
+            stem, attr = ref.split(":", 1)
+            if stem not in mirrors:
+                findings.append(
+                    Finding(
+                        self.name, anchor, 1,
+                        f"scored kernel {ref!r}: module {stem!r} is not "
+                        "registered in KERNEL_MIRRORS",
+                    )
+                )
+            self._check_resolves(
+                ref, f"kueue_tpu.ops.{stem}:{attr}",
+                "scored entry point", anchor, findings,
+            )
+            self._check_resolves(
+                ref, mirror, "scored mirror", anchor, findings
+            )
+            if test_path is not None:
+                tf = os.path.join(ctx.root, test_path)
+                if not (os.path.isfile(tf) and os.path.getsize(tf) > 0):
+                    findings.append(
+                        Finding(
+                            self.name, anchor, 1,
+                            f"scored kernel {ref!r}: parity test "
+                            f"{test_path!r} missing or empty",
+                        )
+                    )
         return findings
 
     def _check_resolves(

@@ -491,6 +491,17 @@ def _render_decision_timeline(key: str, status: str, rows: List[dict]) -> None:
         for ps_name, fmap in sorted(d.get("flavors", {}).items()):
             chosen = ", ".join(f"{r}->{f}" for r, f in sorted(fmap.items()))
             print(f"      podset {ps_name}: {chosen}")
+        sc = d.get("scores")
+        if sc:
+            # admission-policy flavor score breakdown (kueue_tpu/policy)
+            per = sc.get("perFlavor", {})
+            ranked = sorted(per.items(), key=lambda t: (-t[1], t[0]))
+            line = ", ".join(f"{f}={v}" for f, v in ranked)
+            print(
+                f"      scores [{sc.get('policy', '?')}]: {line} "
+                f"(winner {sc.get('winner', '?')}, "
+                f"margin {sc.get('margin', 0)})"
+            )
         for ps_name, reasons in sorted(d.get("flavorReasons", {}).items()):
             for r in reasons:
                 print(f"      rejected [{ps_name}]: {r}")
@@ -830,6 +841,14 @@ def cmd_plan(state: State, args) -> None:
     if args.scenarios:
         with open(args.scenarios) as f:
             scenarios = json.load(f)
+    if getattr(args, "policy", ""):
+        scenarios = list(scenarios or [])
+        scenarios.append(
+            {
+                "name": f"policy {args.policy}",
+                "deltas": [{"kind": "policy", "policy": args.policy}],
+            }
+        )
     if not target and not args.clusterqueue and not scenarios:
         raise SystemExit(
             "error: plan needs a workload name, --clusterqueue, or "
@@ -1482,6 +1501,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--scenarios",
         help="JSON file with explicit scenarios "
         '([{"name", "deltas": [{"kind": "quota", ...}]}])',
+    )
+    pl.add_argument(
+        "--policy", default="",
+        help="what-if an admission-policy switch (kueue_tpu/policy "
+        "registry, e.g. gavel): adds a policy scenario next to the "
+        "baseline — run with --forecast to compare makespan/TTA "
+        "before enabling --policy on the server",
     )
     pl.add_argument(
         "--forecast", action="store_true",

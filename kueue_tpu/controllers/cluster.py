@@ -73,6 +73,11 @@ class ClusterRuntime:
         # subsystem — workload lifecycle traces + per-cycle span trees.
         # False = no-op tracer (the bench.py --trace baseline).
         tracing: bool = True,
+        # Admission policy (kueue_tpu/policy): a registered policy name
+        # ("first-fit" | "gavel" | "prema" | "deadline" |
+        # "gavel-deadline") or an AdmissionPolicy instance. The default
+        # first-fit policy is bit-for-bit the pre-policy decisions.
+        policy=None,
     ):
         from kueue_tpu.metrics import Metrics
 
@@ -166,6 +171,19 @@ class ClusterRuntime:
         # admitted state on disk)
         self.last_solver_verdict = None
 
+        # active admission policy (kueue_tpu/policy); set_policy swaps
+        # it live, journals the change, and keeps scheduler + drains +
+        # planner on the same instance
+        from kueue_tpu.policy import AdmissionPolicy, resolve_policy
+
+        if policy is None:
+            policy = resolve_policy("first-fit")
+        elif isinstance(policy, str):
+            policy = resolve_policy(policy)
+        elif not isinstance(policy, AdmissionPolicy):
+            raise ValueError(f"not an admission policy: {policy!r}")
+        self.policy = policy
+
         tas_check = tas_assign = tas_fits = None
         self.tas_manager = None
         self.node_controller = None
@@ -206,8 +224,12 @@ class ClusterRuntime:
             guard=self.guard,
             quarantine=self.quarantine,
             tracer=self.tracer,
+            policy=self.policy,
         )
         self.scheduler.on_quarantine = self._on_workload_quarantined
+        if self.scheduler.preemptor is not None:
+            self.scheduler.preemptor.policy = self.policy
+        self._report_policy_metrics()
         self.job_reconciler = JobReconciler(
             self,
             manage_jobs_without_queue_name=manage_jobs_without_queue_name,
@@ -252,6 +274,49 @@ class ClusterRuntime:
         self._mesh_place_seen = 0.0
         self._drain_resident = None
         self.set_mesh(mesh)
+
+    # ---- admission policy (kueue_tpu/policy) ----
+    def set_policy(self, policy, journal: bool = True) -> None:
+        """Install the admission policy: a registered name or an
+        AdmissionPolicy instance. Journals a ``policy_config`` record
+        (recovery and read replicas converge on the same policy),
+        emits a PolicyConfigured event, and refreshes kueue_policy_*.
+        ``journal=False`` is the recovery/replica replay path — replay
+        must not re-journal."""
+        from kueue_tpu.policy import AdmissionPolicy, resolve_policy
+
+        if policy is None or isinstance(policy, str):
+            policy = resolve_policy(policy)
+        elif not isinstance(policy, AdmissionPolicy):
+            raise ValueError(f"not an admission policy: {policy!r}")
+        changed = policy.name != self.policy.name
+        self.policy = policy
+        self.scheduler.policy = policy
+        preemptor = getattr(self.scheduler, "preemptor", None)
+        if preemptor is not None:
+            preemptor.policy = policy
+        self._report_policy_metrics(changed=changed and journal)
+        if changed:
+            self.events.record(
+                "PolicyConfigured", "control-plane/policy",
+                f"admission policy set to {policy.name!r}",
+                regarding_kind="ControlPlane",
+            )
+            self.metrics.events_total.inc(
+                kind="ControlPlane", reason="PolicyConfigured"
+            )
+        if journal:
+            self._journal_append("policy_config", policy.to_dict())
+
+    def _report_policy_metrics(self, changed: bool = False) -> None:
+        from kueue_tpu.policy import policy_names
+
+        for name in policy_names():
+            self.metrics.policy_active.set(
+                1 if name == self.policy.name else 0, policy=name
+            )
+        if changed:
+            self.metrics.policy_changes_total.inc()
 
     def set_mesh(self, mesh) -> None:
         """Install (or clear) the admission mesh: accepts a Mesh, an
@@ -534,6 +599,11 @@ class ClusterRuntime:
         if rec.outcome in ("Pending", "Skipped"):
             self.metrics.report_inadmissible_reason(
                 rec.cluster_queue, rec.reason.value
+            )
+        scores = getattr(rec, "scores", None)
+        if scores:
+            self.metrics.policy_scored_decisions_total.inc(
+                policy=scores.get("policy", "")
             )
 
     def _record_preemption(self, preempting_cq: str, reason: str, victim: Workload) -> None:
@@ -1331,6 +1401,8 @@ class ClusterRuntime:
                 fs_strategies=getattr(sched.preemptor, "fs_strategies", None),
                 timestamp_fn=ts_fn,
                 mesh=self.mesh,
+                policy=self.policy,
+                now=self.clock.now(),
             ),
             label="bulk drain",
         )
@@ -1450,12 +1522,16 @@ class ClusterRuntime:
         # mesh path re-places with shardings every round (device_put
         # onto shards IS its transfer plan)
         resident = self._drain_resident if mesh is None else None
+        # one policy clock for the whole pipelined drain: the sampled
+        # divergence re-solve must compile IDENTICAL score/boost
+        # tensors or deadline boosts would fake a divergence
+        policy, pol_now = self.policy, self.clock.now()
 
         def _launch(snap, pend):
             return sched.guard.device_launch(
                 lambda: launch_drain(
                     snap, pend, flavors, timestamp_fn=ts_fn, max_cycles=chunk,
-                    mesh=mesh, resident=resident,
+                    mesh=mesh, resident=resident, policy=policy, now=pol_now,
                 ),
                 label="pipelined drain round",
             )
@@ -1495,6 +1571,7 @@ class ClusterRuntime:
                         run_drain(
                             snap_v, pend_v, flavors, timestamp_fn=ts_fn,
                             max_cycles=chunk, use_device=False,
+                            policy=policy, now=pol_now,
                         )
                     ),
                     heads=len(pend_v),

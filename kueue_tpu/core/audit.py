@@ -61,6 +61,12 @@ class DecisionRecord:
     # TAS placement outcome: {"podset": {"levels": [...], "domains":
     # [{"values": [...], "count": n}, ...]}}
     topology: Optional[dict] = None
+    # admission-policy flavor score breakdown (kueue_tpu/policy):
+    # {"policy": name, "perFlavor": {"<flavors>": score_milli, ...},
+    #  "winner": "<flavors>", "margin": winner - runner-up} — why a
+    # flavor won under a scoring policy (`kueuectl explain` renders it;
+    # absent under the default first-fit policy)
+    scores: Optional[dict] = None
     # dedup bookkeeping
     count: int = 1
     last_cycle: int = 0
@@ -116,6 +122,8 @@ class DecisionRecord:
             out["preemption"] = self.preemption
         if self.topology is not None:
             out["topology"] = self.topology
+        if self.scores is not None:
+            out["scores"] = self.scores
         return out
 
     @classmethod
@@ -138,6 +146,7 @@ class DecisionRecord:
             flavor_reasons=d.get("flavorReasons") or {},
             preemption=d.get("preemption"),
             topology=d.get("topology"),
+            scores=d.get("scores"),
             count=int(d.get("count", 1)),
             last_cycle=int(d.get("lastCycle", 0)),
             timestamp=float(d.get("timestamp", 0.0)),

@@ -93,6 +93,9 @@ def plan_drain(
     timestamp_fn=None,
     max_podsets: int = 4,
     allow_tas: bool = False,
+    policy=None,  # kueue_tpu/policy AdmissionPolicy: compiles the
+    #               per-entry candidate score tensor (zeros = first-fit)
+    now: float = 0.0,  # policy clock (deadline boosts)
 ) -> DrainPlan:
     """Lower the backlog and pack it into per-CQ queue tensors.
 
@@ -106,6 +109,10 @@ def plan_drain(
         snapshot, pending, flavors, max_candidates, max_cells, max_podsets,
         timestamp_fn, any_fungibility=True, allow_tas=allow_tas,
     )
+    if policy is not None and not policy.is_default:
+        from kueue_tpu.policy import annotate_multi
+
+        annotate_multi(policy, lowered, now)
     fallback = set(lowered.fallback)
 
     by_cq: Dict[str, List[int]] = {}
@@ -148,6 +155,9 @@ def plan_drain(
     gidx = np.zeros((q, l, pdim, k, g), dtype=np.int32)
     glast = np.zeros((q, l, pdim, k, g), dtype=bool)
     cgrp = np.full(cells.shape, -1, dtype=np.int8)
+    # policy candidate scores (zeros = the default first-fit policy —
+    # the kernels' score-argmax then IS the first-fit walk)
+    score = np.zeros((q, l, pdim, k), dtype=np.int64)
     ffb = np.ones(q, dtype=bool)
     ffp = np.zeros(q, dtype=bool)
     # convergent-retry budget per queue: the max joint cursor-odometer
@@ -176,6 +186,8 @@ def plan_drain(
         qty[qi, :n] = lowered.qty[idx_arr, :pdim]
         valid[qi, :n] = lowered.valid[idx_arr, :pdim]
         cgrp[qi, :n] = lowered.cgrp[idx_arr, :pdim]
+        if lowered.score is not None:
+            score[qi, :n] = lowered.score[idx_arr, :pdim]
         priority[qi, :n] = lowered.priority[idx_arr]
         timestamp[qi, :n] = lowered.timestamp[idx_arr]
         for pos, i in enumerate(idxs):
@@ -243,6 +255,7 @@ def plan_drain(
             priority=priority,
             timestamp=timestamp,
             no_reclaim=no_reclaim,
+            score=score,
         ),
         head_of=head_of,
         lowered=lowered,
@@ -337,6 +350,7 @@ def _lower_victim_pools(
     # fn(s, members, seg_queues_s) -> bool: extra scope veto, given the
     # segment id, its member CQ rows and its queue-index list
     extra_segment_bad=None,
+    policy=None,  # kueue_tpu/policy: PREMA victim-cost adjustments
 ) -> _VictimLowering:
     """Build the SegVictims arrays + metadata for a preemption drain
     (the shared middle of run_drain_preempt, unchanged semantics) and
@@ -510,7 +524,15 @@ def _lower_victim_pools(
     perm = np.tile(np.arange(v_cap, dtype=np.int32), (q, 1))
     entry_slot = np.full((q, nl), -1, dtype=np.int32)
     victim_of: Dict[Tuple[int, int], object] = {}
-    slot_meta: Dict[int, list] = {}  # s -> [(evicted0, owner, prio, rt, uid)]
+    slot_meta: Dict[int, list] = {}  # s -> [(evicted0, owner, prio, rt, uid, adj)]
+    # PREMA-style victim-cost adjustment (kueue_tpu/policy): inserted
+    # into the candidate sort key between the (evicted, other-CQ)
+    # tiers and priority; zero for every victim under the default
+    # policy, so the ordering is byte-identical to the unadjusted sort
+    def _cost_adjust(wl) -> int:
+        if policy is None or policy.is_default:
+            return 0
+        return int(policy.victim_cost_adjust(wl))
 
     if now is None:
         rts = [
@@ -560,6 +582,7 @@ def _lower_victim_pools(
                     int(ws.priority),
                     float(ws.quota_reserved_time),
                     ws.workload.uid,
+                    _cost_adjust(ws.workload),
                 )
             )
             slot += 1
@@ -584,6 +607,7 @@ def _lower_victim_pools(
                             int(plan.queues_np["priority"][qi, pos]),
                             float(now),
                             wl.uid,
+                            _cost_adjust(wl),
                         )
                     )
                     slot += 1
@@ -598,6 +622,7 @@ def _lower_victim_pools(
                 key=lambda j: (
                     0 if meta[j][0] else 1,
                     0 if meta[j][1] != own else 1,
+                    meta[j][5],
                     meta[j][2],
                     -meta[j][3],
                     meta[j][4],
@@ -751,6 +776,8 @@ def run_drain_for_scope(
     fs_strategies=None,
     timestamp_fn=None,
     mesh=None,  # jax.sharding.Mesh: shard every drain kind's Q axis
+    policy=None,  # kueue_tpu/policy AdmissionPolicy, every kind
+    now: float = 0.0,
 ):
     """Dispatch the drain a classify_drain_scope kind names — the ONE
     place the kind→drain mapping lives, so the service bulk path and
@@ -761,24 +788,26 @@ def run_drain_for_scope(
     if kind == "fair_preempt":
         return run_drain_fair_preempt(
             snapshot, pending, flavors, timestamp_fn=timestamp_fn,
-            fs_strategies=fs_strategies, mesh=mesh,
+            fs_strategies=fs_strategies, mesh=mesh, policy=policy,
         )
     if kind == "fair":
         return run_drain(
             snapshot, pending, flavors, timestamp_fn=timestamp_fn,
-            fair_sharing=True, mesh=mesh,
+            fair_sharing=True, mesh=mesh, policy=policy, now=now,
         )
     if kind == "preempt":
         return run_drain_preempt(
-            snapshot, pending, flavors, timestamp_fn=timestamp_fn, mesh=mesh
+            snapshot, pending, flavors, timestamp_fn=timestamp_fn, mesh=mesh,
+            policy=policy,
         )
     if kind == "tas":
         return run_drain_tas(
             snapshot, pending, flavors, tas_cache, timestamp_fn=timestamp_fn,
-            mesh=mesh,
+            mesh=mesh, policy=policy, now=now,
         )
     return run_drain(
-        snapshot, pending, flavors, timestamp_fn=timestamp_fn, mesh=mesh
+        snapshot, pending, flavors, timestamp_fn=timestamp_fn, mesh=mesh,
+        policy=policy, now=now,
     )
 
 
@@ -791,6 +820,8 @@ def launch_drain_for_scope(
     max_cycles: Optional[int] = None,
     mesh=None,
     resident=None,
+    policy=None,  # kueue_tpu/policy AdmissionPolicy
+    now: float = 0.0,
 ) -> Optional[DrainLaunch]:
     """Async (launch/fetch) twin of ``run_drain_for_scope`` for the
     scopes the pipelined drain loop can double-buffer. Returns None for
@@ -802,6 +833,7 @@ def launch_drain_for_scope(
     return launch_drain(
         snapshot, pending, flavors, timestamp_fn=timestamp_fn,
         max_cycles=max_cycles, mesh=mesh, resident=resident,
+        policy=policy, now=now,
     )
 
 
@@ -902,6 +934,8 @@ def run_drain_preempt(
     mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
     panel_widths: Optional[Sequence[int]] = None,
     panel_tuner: Optional[PanelTuner] = None,
+    policy=None,  # kueue_tpu/policy AdmissionPolicy: scored flavor
+    #               choice + PREMA victim-cost adjustments
     # internal (the narrow-panel GSPMD probe): run the given
     # panel_widths under the mesh WITHOUT consulting the probe verdict
     # — the probe itself is what establishes it
@@ -944,11 +978,12 @@ def run_drain_preempt(
     )
 
     plan = plan_drain(
-        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn,
+        policy=policy, now=now or 0.0,
     )
     low = _lower_victim_pools(
         snapshot, plan, timestamp_fn, now, max_victims, max_victim_cells,
-        max_cycles,
+        max_cycles, policy=policy,
     )
     tree, paths_j = low.tree, low.paths_j
     victims_np = low.victims_np
@@ -1265,6 +1300,7 @@ def run_drain_fair_preempt(
     timestamp_fn=None,
     max_cycles: Optional[int] = None,
     now: Optional[float] = None,
+    policy=None,  # kueue_tpu/policy AdmissionPolicy
     fs_strategies: Optional[Sequence[str]] = None,
     mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
 ) -> PreemptDrainOutcome:
@@ -1307,7 +1343,8 @@ def run_drain_fair_preempt(
     )
 
     plan = plan_drain(
-        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn,
+        policy=policy, now=now or 0.0,
     )
     parent_arr = snapshot.flat.parent
     n_cq = snapshot.flat.n_cq
@@ -1341,7 +1378,7 @@ def run_drain_fair_preempt(
 
     low = _lower_victim_pools(
         snapshot, plan, timestamp_fn, now, max_victims, max_victim_cells,
-        max_cycles, extra_segment_bad=seg_universe_bad,
+        max_cycles, extra_segment_bad=seg_universe_bad, policy=policy,
     )
     tree, paths_j = low.tree, low.paths_j
     victims_np = low.victims_np
@@ -1589,6 +1626,8 @@ def run_drain_tas(
     timestamp_fn=None,
     max_cycles: Optional[int] = None,
     mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
+    policy=None,  # kueue_tpu/policy AdmissionPolicy
+    now: float = 0.0,
 ) -> TASDrainOutcome:
     """Multi-cycle drain with Topology-Aware Scheduling heads decided
     on the device (ops/drain_kernel.solve_drain_tas) — one dispatch +
@@ -1634,7 +1673,7 @@ def run_drain_tas(
 
     plan = plan_drain(
         snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn,
-        allow_tas=True,
+        allow_tas=True, policy=policy, now=now,
     )
     q = max(len(plan.cq_order), 1)
     nl = plan.queues_np["cells"].shape[1]
@@ -2057,6 +2096,8 @@ def launch_drain(
     max_cycles: Optional[int] = None,
     mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
     resident=None,  # core.encode.ResidentEncoder (single-device only)
+    policy=None,  # kueue_tpu/policy AdmissionPolicy (scored admission)
+    now: float = 0.0,
 ) -> DrainLaunch:
     """Plan + DISPATCH the plain device drain without fetching — the
     async half of ``run_drain`` (device, no fair sharing: the pipelined
@@ -2075,7 +2116,8 @@ def launch_drain(
     from kueue_tpu.ops.drain_kernel import DrainQueues, solve_drain_packed_jit
 
     plan = plan_drain(
-        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn,
+        policy=policy, now=now,
     )
     if max_cycles is not None:
         plan.max_cycles = max_cycles
@@ -2141,6 +2183,8 @@ def run_drain(
     mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
     fair_sharing: bool = False,
     use_device: bool = True,
+    policy=None,  # kueue_tpu/policy AdmissionPolicy (scored admission)
+    now: float = 0.0,
 ) -> DrainOutcome:
     """Plan + solve + map back, with one device round trip.
 
@@ -2173,7 +2217,8 @@ def run_drain(
             "tournament, no mesh sharding)"
         )
     plan = plan_drain(
-        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn,
+        policy=policy, now=now,
     )
     extra_fb_entries: List[Tuple[Workload, str]] = []
     if fair_sharing:

@@ -30,6 +30,8 @@ __all__ = [
     "encode_snapshot",
     "decode_snapshot",
     "device_arrays",
+    "encode_candidate_scores",
+    "encode_candidate_scores_multi",
 ]
 
 
@@ -212,6 +214,111 @@ def device_arrays(enc: EncodedSnapshot):
     paths = jnp.asarray(build_paths(enc.parent, enc.max_depth))
     roots = build_roots(enc.parent)
     return tree, paths, roots
+
+
+# ---- admission-policy score tensors (kueue_tpu/policy) ----
+# The policy subsystem's declarative inputs (per-flavor throughput,
+# deadlines, remaining work — workload labels) enter the device path
+# HERE: compiled once per lowered batch into dense int64 score tensors
+# the scored kernels argmax over. Like the quota codec above, this is
+# the single definition both the cycle dispatch (core/solver.pack_heads)
+# and the bulk drain (core/drain.plan_drain) ship, so device kernels
+# and their numpy mirrors read the SAME bytes for the same policy.
+
+
+def _flavor_sig(flavor_map: dict) -> Tuple[str, ...]:
+    """A candidate's distinct flavor names (one flavor per touched
+    resource group; dict values repeat per resource)."""
+    return tuple(sorted(set(flavor_map.values())))
+
+
+def _template_sigs(flist, n_k: int, sig_cache: dict):
+    """(k, flavor_sig) tuple of a template-shared candidate flavor
+    list — computed ONCE per list identity (lowering shares one list
+    per template, so a 50k-head backlog resolves this O(templates)
+    times). The returned tuple is hashable: score rows cache on IT,
+    not on template identity, so the hundreds of per-CQ templates that
+    enumerate the same flavors share one compiled row."""
+    sigs = sig_cache.get(id(flist))
+    if sigs is None:
+        sigs = sig_cache[id(flist)] = tuple(
+            (k, _flavor_sig(fmap))
+            for k, fmap in enumerate(flist[:n_k])
+            if fmap
+        )
+    return sigs
+
+
+def encode_candidate_scores(
+    policy, heads, candidate_flavors, n_k: int
+) -> np.ndarray:
+    """int64[W, K] candidate scores for a cycle batch.
+
+    ``candidate_flavors[i][k]`` is the lowered {resource: flavor} map
+    (core/solver.Lowered). Candidate flavor signatures memoize per
+    template-shared list identity and scores per (workload labels,
+    flavor set), so compilation is O(templates + distinct pairs), not
+    O(heads x candidates)."""
+    w = len(heads)
+    score = np.zeros((w, n_k), dtype=np.int64)
+    cache: dict = {}
+    sig_cache: dict = {}
+    for i, wl in enumerate(heads):
+        flist = candidate_flavors[i]
+        if not flist:
+            continue
+        labels = getattr(wl, "labels", None)
+        labels_sig = tuple(sorted(labels.items())) if labels else ()
+        for k, fsig in _template_sigs(flist, n_k, sig_cache):
+            key = (labels_sig, fsig)
+            s = cache.get(key)
+            if s is None:
+                s = cache[key] = int(policy.candidate_score(wl, fsig))
+            score[i, k] = s
+    return score
+
+
+def encode_candidate_scores_multi(policy, lowered) -> np.ndarray:
+    """int64[W, P, K] candidate scores for a drain batch
+    (core/solver.MultiLowered): every podset's candidate walk scores
+    independently, exactly like its flavor walk.
+
+    Bulk discipline (the 50k-head drain must not pay a python loop per
+    candidate): heads grouped by (label signature, template flavor
+    list) share ONE computed score row, scattered with fancy indexing —
+    compilation is O(heads) dict appends + O(distinct groups) policy
+    calls."""
+    w, pmax, n_k = lowered.valid.shape
+    score = np.zeros((w, pmax, n_k), dtype=np.int64)
+    sig_cache: dict = {}
+    groups: dict = {}  # (labels_sig, candidate sigs, p) -> [head idx]
+    rep: dict = {}  # group key -> representative workload
+    for i, wl in enumerate(lowered.heads):
+        per_ps = lowered.candidate_flavors[i]
+        if not per_ps:
+            continue
+        labels = getattr(wl, "labels", None)
+        labels_sig = tuple(sorted(labels.items())) if labels else ()
+        for p, flist in enumerate(per_ps[:pmax]):
+            key = (labels_sig, _template_sigs(flist, n_k, sig_cache), p)
+            g = groups.get(key)
+            if g is None:
+                g = groups[key] = []
+                rep[key] = wl
+            g.append(i)
+    row_cache: dict = {}
+    for key, idxs in groups.items():
+        labels_sig, sigs, p = key
+        rkey = (labels_sig, sigs)
+        row = row_cache.get(rkey)
+        if row is None:
+            wl = rep[key]
+            row = np.zeros(n_k, dtype=np.int64)
+            for k, fsig in sigs:
+                row[k] = int(policy.candidate_score(wl, fsig))
+            row_cache[rkey] = row
+        score[np.asarray(idxs, dtype=np.intp), p] = row
+    return score
 
 
 def _pow2(n: int, minimum: int = 4) -> int:

@@ -145,10 +145,20 @@ class AssignmentResult:
     def message(self) -> str:
         parts = []
         for ps in self.pod_sets:
-            if ps.reasons:
+            # score-outranked reasons (kueue_tpu/policy) are
+            # informational — the flavor FIT, a higher-scoring flavor
+            # won — so they ride flavor_reasons/the audit trail but
+            # never the blocking inadmissibility message (an Admitted
+            # decision must not read "couldn't assign")
+            blocking = [
+                r
+                for r in normalize_reasons(ps.reasons)
+                if " lost on score to " not in r
+            ]
+            if blocking:
                 parts.append(
                     f"couldn't assign flavors to pod set {ps.name}: "
-                    + ", ".join(normalize_reasons(ps.reasons))
+                    + ", ".join(blocking)
                 )
         return "; ".join(parts)
 
@@ -199,6 +209,11 @@ class FlavorAssigner:
         tas_check: Optional[TASCheck] = None,
         flavor_fungibility_enabled: bool = True,
         transform=None,  # ResourceTransformConfig for the quota view
+        policy=None,  # kueue_tpu/policy AdmissionPolicy: with a scoring
+        #               policy the walk evaluates EVERY stop-eligible
+        #               flavor and picks the best score (ties keep walk
+        #               order); fitting-but-outranked flavors get the
+        #               canonical ScoreOutrankedFlavor reason
     ):
         self.snapshot = snapshot
         self.flavors = flavors
@@ -207,6 +222,11 @@ class FlavorAssigner:
         self.tas_check = tas_check
         self.fungibility_enabled = flavor_fungibility_enabled
         self.transform = transform
+        self.policy = policy
+
+    @property
+    def _scoring(self) -> bool:
+        return self.policy is not None and not self.policy.is_default
 
     # ---- public entry (flavorassigner.go:367-379) ----
     def assign(
@@ -319,6 +339,12 @@ class FlavorAssigner:
         start = state.next_flavor_to_try(ps_idx, res_name) if state else 0
         attempted_idx = -1
         avail_row = None  # computed lazily once
+        scoring = self._scoring and self.fungibility_enabled
+        # scored walk: (idx, flavor, assignments, mode) of every flavor
+        # the default walk would have STOPPED at — the policy argmaxes
+        # over them instead of taking the first
+        stops: List = []
+        outranked: List[str] = []
         for idx in range(start, len(rg.flavors)):
             attempted_idx = idx
             f_name = rg.flavors[idx].name
@@ -368,6 +394,16 @@ class FlavorAssigner:
                 if not _should_try_next_flavor(
                     representative, cq.flavor_fungibility, needs_borrowing
                 ):
+                    if scoring:
+                        # don't stop: the policy ranks every stop-
+                        # eligible flavor after the full walk
+                        stops.append(
+                            (idx, f_name, assignments, representative)
+                        )
+                        if representative > best_mode:
+                            best = assignments
+                            best_mode = representative
+                        continue
                     best = assignments
                     best_mode = representative
                     break
@@ -381,13 +417,30 @@ class FlavorAssigner:
                     if best_mode == GranularMode.FIT:
                         return best, []
 
+        if scoring and stops:
+            ranked = [
+                (self.policy.candidate_score(wl, (fn,)), -i, i, fn, asg, rep)
+                for (i, fn, asg, rep) in stops
+            ]
+            fit_ranked = [t for t in ranked if t[5] == GranularMode.FIT]
+            pool = fit_ranked or ranked
+            winner = max(pool)  # highest score, ties -> earliest flavor
+            best, best_mode = winner[4], winner[5]
+            for t in fit_ranked:
+                if t[2] != winner[2]:
+                    outranked.append(
+                        f"flavor {t[3]} fits but lost on score to "
+                        f"flavor {winner[3]} under policy "
+                        f"{self.policy.name} ({t[0]} vs {winner[0]})"
+                    )
+            reasons.extend(outranked)
         if self.fungibility_enabled:
             n_flavors = len(rg.flavors)
             tried = -1 if attempted_idx == n_flavors - 1 else attempted_idx
             for choice in best.values():
                 choice.tried_flavor_idx = tried
             if best_mode == GranularMode.FIT:
-                return best, []
+                return best, list(outranked)
         if not best and not reasons:
             # No flavor was attempted (exhausted cursor with no retryable
             # flavor); never report an empty-reason failure, which would

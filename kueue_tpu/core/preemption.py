@@ -92,6 +92,10 @@ class Preemptor(PreemptorBase):
         # (preempting_cq, reason, victim) -> None; set by the runtime to
         # report preempted_workloads_total / evicted_workloads_total
         self.metrics_hook = None
+        # admission policy (kueue_tpu/policy): PREMA-style victim-cost
+        # adjustments in the candidate ordering; None/first-fit = the
+        # unadjusted reference order
+        self.policy = None
 
     # ---- entry point (preemption.go:127-191) ----
     def get_targets(
@@ -280,12 +284,23 @@ class Preemptor(PreemptorBase):
         return out
 
     def _candidate_key(self, ctx: _Ctx):
+        policy = self.policy
+        scoring = policy is not None and not policy.is_default
+
         def key(ws: WorkloadSnapshot):
             evicted = ws.workload.condition_true(WorkloadConditionType.EVICTED)
             in_cq = ws.cq_name == ctx.cq_name
+            # PREMA victim-cost adjustment (kueue_tpu/policy): between
+            # the (evicted, other-CQ) tiers and priority; zero under
+            # the default policy, so the order is exactly the
+            # reference's (preemption.go:591-618)
+            adjust = (
+                policy.victim_cost_adjust(ws.workload) if scoring else 0
+            )
             return (
                 0 if evicted else 1,
                 0 if not in_cq else 1,
+                adjust,
                 ws.priority,
                 -ws.quota_reserved_time,
                 ws.workload.uid,

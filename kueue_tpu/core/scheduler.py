@@ -223,6 +223,8 @@ class Scheduler:
         guard: Optional[SolverGuard] = None,
         quarantine: Optional[QuarantineList] = None,
         tracer=None,  # tracing.Tracer; None = a private always-on one
+        policy=None,  # kueue_tpu/policy AdmissionPolicy; None/first-fit
+        #               = score-free nomination (bit-for-bit pre-policy)
     ):
         self.queues = queues
         self.cache = cache
@@ -255,6 +257,13 @@ class Scheduler:
         self.use_preempt_solver = use_preempt_solver
         self.preempt_solver_threshold = preempt_solver_threshold
         self.transform_config = transform_config
+        # active admission policy (kueue_tpu/policy). The runtime's
+        # set_policy swaps it live; the audit breakdown below explains
+        # scored flavor choices per cycle.
+        self.policy = policy
+        # workload key -> flavor score breakdown of the LAST nomination
+        # (cleared per cycle; consumed by _decision_of)
+        self._cycle_scores: Dict[str, dict] = {}
         # distributed tracing (kueue_tpu/tracing): cycle span trees are
         # buffered per cycle and flushed atomically with the CycleTrace;
         # a bare Scheduler gets its own tracer, ClusterRuntime shares
@@ -319,6 +328,7 @@ class Scheduler:
         result = CycleResult()
         trace = CycleTrace(cycle=self.scheduling_cycle)
         self._cycle_device_s = 0.0
+        self._cycle_scores.clear()
         t0 = _time.perf_counter()
         self.guard.begin_cycle()
 
@@ -702,7 +712,45 @@ class Scheduler:
             flavor_reasons=flavor_reasons,
             preemption=preemption,
             topology=topology,
+            scores=self._cycle_scores.get(e.workload.key),
         )
+
+    def _record_cycle_scores(self, lowered) -> None:
+        """Per-head flavor score breakdown for the audit trail
+        (kueue_tpu/policy): score per candidate flavor set, the
+        highest-scoring set, and the winning margin — `kueuectl
+        explain` renders it so operators see WHY a flavor won. The
+        actual assignment (which may differ when the top-scoring
+        flavor doesn't fit) rides the record's ``flavors`` field."""
+        score = lowered.score
+        if score is None:
+            return
+        fallback = set(lowered.fallback)
+        for i, wl in enumerate(lowered.heads):
+            if i in fallback:
+                continue
+            per: Dict[str, int] = {}
+            for k, fmap in enumerate(lowered.candidate_flavors[i]):
+                if not fmap or k >= lowered.valid.shape[1]:
+                    continue
+                if not lowered.valid[i, k]:
+                    continue
+                sig = "+".join(sorted(set(fmap.values())))
+                sc = int(score[i, k])
+                if sig not in per or sc > per[sig]:
+                    per[sig] = sc
+            if not per:
+                continue
+            ranked = sorted(per.items(), key=lambda t: (-t[1], t[0]))
+            margin = (
+                ranked[0][1] - ranked[1][1] if len(ranked) > 1 else ranked[0][1]
+            )
+            self._cycle_scores[wl.key] = {
+                "policy": self.policy.name,
+                "perFlavor": per,
+                "winner": ranked[0][0],
+                "margin": margin,
+            }
 
     # ---- nomination (scheduler.go:344-378) ----
     def _nominate(
@@ -773,6 +821,7 @@ class Scheduler:
             reclaim_oracle=functools.partial(self._reclaim_oracle, snapshot),
             tas_check=self.tas_check,
             transform=self.transform_config,
+            policy=self.policy,
         )
 
     def _host_assign(
@@ -959,6 +1008,14 @@ class Scheduler:
             # rest (per-head contained)
             self._bisect_lowering_failure(to_assign, snapshot, exc)
             return None
+        if self.policy is not None and not self.policy.is_default:
+            # compile the policy's score tensors onto the batch BEFORE
+            # the guard sees it: the device kernel and the host mirror
+            # both read lowered.score, so divergence checks stay sound
+            from kueue_tpu.policy import annotate_lowered
+
+            annotate_lowered(self.policy, lowered, now=self.clock.now())
+            self._record_cycle_scores(lowered)
         fallback = set(lowered.fallback)
         if len(fallback) == len(to_assign):
             # nothing representable: skip the device dispatch entirely

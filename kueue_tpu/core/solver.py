@@ -46,6 +46,11 @@ class Lowered:
     priority: np.ndarray  # int64[W]
     timestamp: np.ndarray  # int64[W] (ns)
     no_reclaim: np.ndarray  # bool[W] — reserve capacity when blocked
+    # int64[W,K] admission-policy candidate scores (kueue_tpu/policy:
+    # annotate_lowered compiles them from workload labels); None = the
+    # default first-fit policy (pack_heads ships zeros, the kernel's
+    # score-argmax then IS the first-fit argmax)
+    score: Optional[np.ndarray] = None
 
     # per head: candidate k -> flavor name chosen per resource group
     candidate_flavors: List[List[Dict[str, str]]] = field(default_factory=list)
@@ -538,6 +543,12 @@ def pack_heads(lowered: Lowered, roots, w_pad: int):
     cq_row, cells, qty = lowered.cq_row, lowered.cells, lowered.qty
     valid, priority = lowered.valid, lowered.priority
     timestamp, no_reclaim = lowered.timestamp, lowered.no_reclaim
+    # policy score tensor: always shipped as a real array (zeros = the
+    # default first-fit policy) so every consumer — mesh placement,
+    # the planner's vmapped sweep, the host mirror — sees one pytree
+    score = lowered.score
+    if score is None:
+        score = np.zeros(valid.shape, dtype=np.int64)
     if w_pad > w:
         pad = w_pad - w
         cq_row = np.concatenate([cq_row, np.full(pad, -1, dtype=np.int32)])
@@ -549,9 +560,13 @@ def pack_heads(lowered: Lowered, roots, w_pad: int):
         priority = np.concatenate([priority, np.zeros(pad, dtype=np.int64)])
         timestamp = np.concatenate([timestamp, np.zeros(pad, dtype=np.int64)])
         no_reclaim = np.concatenate([no_reclaim, np.zeros(pad, dtype=bool)])
+        score = np.concatenate(
+            [score, np.zeros((pad,) + score.shape[1:], dtype=np.int64)]
+        )
     batch_np = HeadsBatch(
         cq_row=cq_row, cells=cells, qty=qty, valid=valid,
         priority=priority, timestamp=timestamp, no_reclaim=no_reclaim,
+        score=score,
     )
     # compact segment ids: one per LIVE root cohort; the max head count
     # within one root bounds phase-2's sequential depth
@@ -689,6 +704,9 @@ class MultiLowered:
     no_reclaim: np.ndarray  # bool[W]
     ffb: np.ndarray  # bool[W]
     ffp: np.ndarray  # bool[W]
+    # int64[W,P,K] admission-policy candidate scores (kueue_tpu/policy:
+    # annotate_multi); None = default first-fit (plan_drain ships zeros)
+    score: Optional[np.ndarray] = None
     # per head per podset: candidate k -> maps (template-shared lists)
     candidate_flavors: List[List[list]] = field(default_factory=list)
     candidate_groups: List[List[list]] = field(default_factory=list)

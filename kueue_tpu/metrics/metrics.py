@@ -208,6 +208,25 @@ class Metrics:
             f"{NS}_planner_last_scenarios",
             "Scenario count of the most recent capacity-planner run",
         )
+        # admission policies (kueue_tpu/policy): which registered
+        # policy is active (exactly one series is 1), how many times
+        # the config changed, and decisions made under scoring policies
+        self.policy_active = r.gauge(
+            f"{NS}_policy_active",
+            "1 for the active admission policy (first-fit|gavel|prema|"
+            "deadline|gavel-deadline), 0 otherwise",
+            ("policy",),
+        )
+        self.policy_changes_total = r.counter(
+            f"{NS}_policy_changes_total",
+            "Total admission-policy configuration changes",
+        )
+        self.policy_scored_decisions_total = r.counter(
+            f"{NS}_policy_scored_decisions_total",
+            "Total admission decisions carrying a flavor score "
+            "breakdown (made under a scoring, non-first-fit policy)",
+            ("policy",),
+        )
         # self-healing hot path (core/guard.py): which solver path the
         # next cycle takes (exactly one of the two series is 1), and
         # the failover / divergence / quarantine accounting.

@@ -131,6 +131,11 @@ class InadmissibleReason(str, Enum):
     # raised gets a contained strike; repeated strikes quarantine it
     SCHEDULING_FAILURE = "SchedulingFailure"
     QUARANTINED = "WorkloadQuarantined"
+    # admission policies (kueue_tpu/policy): a flavor that FITS but was
+    # outranked by a higher-scoring flavor under the active policy —
+    # distinct from "doesn't fit" so audit/metrics stay low-cardinality
+    # and `kueuectl explain` can say why the flavor lost
+    SCORE_OUTRANKED = "ScoreOutrankedFlavor"
     UNKNOWN = "Unknown"
 
 
@@ -170,6 +175,9 @@ EVENT_REASONS = frozenset(
         "SchedulingCycleFailed",
         "WorkloadQuarantined",
         "WorkloadUnquarantined",
+        # admission policies (kueue_tpu/policy): the active policy
+        # changed (server --policy, set_policy, recovery replay)
+        "PolicyConfigured",
     }
 )
 
@@ -184,6 +192,7 @@ _INADMISSIBLE_PATTERNS = (
     (r"overlapping preemption targets", InadmissibleReason.OVERLAPPING_PREEMPTION),
     (r"no longer fits after processing", InadmissibleReason.LOST_QUOTA_RACE),
     (r"PodsReady condition", InadmissibleReason.WAITING_FOR_PODS_READY),
+    (r"lost on score to flavor", InadmissibleReason.SCORE_OUTRANKED),
     (r"insufficient unused quota", InadmissibleReason.INSUFFICIENT_QUOTA),
     (r"request > maximum capacity", InadmissibleReason.REQUEST_EXCEEDS_CAPACITY),
     (r"no quota defined for", InadmissibleReason.NO_QUOTA_FOR_RESOURCE),

@@ -64,3 +64,37 @@ KERNEL_MIRRORS = {
         "tests/test_quota_ops.py",
     ),
 }
+
+# Policy-scored entry points (kueue_tpu/policy): the kernels whose
+# candidate choice is a masked score-argmax over admission-policy
+# score tensors. Each entry names "module_stem:entry_point" -> (host
+# mirror "module:attr", parity test). The kueuelint ``kernel-mirrors``
+# rule enforces, beyond the per-module registry above: the stem must
+# itself be registered in KERNEL_MIRRORS, the scored entry point and
+# its mirror must resolve, and the parity test file must exist — so a
+# scored kernel cannot ship without a bit-exact scored mirror. The
+# first-fit default (all-zero scores) makes every entry here decide
+# bit-for-bit like its unscored self (tests/test_policy.py).
+SCORED_KERNELS = {
+    "assign_kernel:solve_cycle_segmented": (
+        # scored cycle batch: the planner's scenario mirror reads the
+        # same HeadsBatch.score tensor
+        "kueue_tpu.planner.engine:solve_scenario_host",
+        "tests/test_policy.py",
+    ),
+    "assign_kernel:phase1_classify": (
+        "kueue_tpu.planner.engine:solve_scenario_host",
+        "tests/test_policy.py",
+    ),
+    "drain_kernel:solve_drain": (
+        # scored plain drain: the numpy drain twin reads
+        # queues_np["score"] through the identical group walk
+        "kueue_tpu.ops.drain_np:solve_drain_np",
+        "tests/test_policy.py",
+    ),
+    "plan_kernel:_solve_scenarios": (
+        # the vmapped what-if sweep's per-scenario score axis
+        "kueue_tpu.planner.engine:solve_scenario_host",
+        "tests/test_policy.py",
+    ),
+}

@@ -76,6 +76,13 @@ class HeadsBatch(NamedTuple):
     priority: jnp.ndarray
     timestamp: jnp.ndarray
     no_reclaim: jnp.ndarray
+    # int64[W,K] admission-policy candidate scores (kueue_tpu/policy):
+    # the flavor choice is a masked score-argmax with ties keeping the
+    # walk order, so an all-zero tensor — the default first-fit policy
+    # — reproduces the boolean first-fit argmax bit-for-bit. None (the
+    # default; kernel-level tests build batches without one) is
+    # identical to all-zero.
+    score: jnp.ndarray = None
 
 
 class SolveResult(NamedTuple):
@@ -249,14 +256,20 @@ def phase1_classify(
         & has_cohort
     )  # [W,K]
 
+    # masked score-argmax (kueue_tpu/policy): among eligible candidates
+    # pick the highest score; jnp.argmax's first-max tie-break keeps
+    # the walk order, so the default all-zero scores (or score=None)
+    # reproduce the boolean first-fit argmax bit-for-bit
+    score = heads.score if heads.score is not None else jnp.int64(0)
+    neg = jnp.int64(-(2**62))
     fit_ok = fits & heads.valid
-    first_fit = jnp.argmax(fit_ok, axis=1)  # first True (argmax on bool)
+    first_fit = jnp.argmax(jnp.where(fit_ok, score, neg), axis=1)
     any_fit = jnp.any(fit_ok, axis=1)
     populated = heads.cq_row >= 0
     chosen = jnp.where(any_fit & populated, first_fit, -1).astype(jnp.int32)
 
     pre_ok = pot_fits & heads.valid
-    first_pre = jnp.argmax(pre_ok, axis=1)
+    first_pre = jnp.argmax(jnp.where(pre_ok, score, neg), axis=1)
     any_pre = jnp.any(pre_ok, axis=1)
     preempt_k = jnp.where(
         any_pre & populated & (chosen < 0), first_pre, -1

@@ -112,6 +112,13 @@ class DrainQueues(NamedTuple):
     priority: jnp.ndarray
     timestamp: jnp.ndarray
     no_reclaim: jnp.ndarray
+    # int64[Q,L,P,K] admission-policy candidate scores
+    # (kueue_tpu/policy): the group walk's candidate choice becomes a
+    # masked score-argmax with ties keeping the walk order — an
+    # all-zero tensor (the default first-fit policy) reproduces the
+    # earliest-flavor choice bit-for-bit. None (kernel-level tests)
+    # is identical to all-zero; plan_drain always ships an array.
+    score: jnp.ndarray = None
 
 
 class DrainResult(NamedTuple):
@@ -132,7 +139,7 @@ class DrainResult(NamedTuple):
 
 def _group_walk(
     gid, gl, gmask, head_valid, fit_cells, pot_cells, reclaim_cells,
-    borrow_cells, ffb, ffp,
+    borrow_cells, ffb, ffp, score=None,
 ):
     """Policy-aware emulation of the host's per-group flavor walk
     (flavor_assigner._find_flavor_for_resource + _should_try_next_flavor
@@ -151,12 +158,24 @@ def _group_walk(
       group's last flavor or the walk ran to the end), and the podset's
       LastAssignment is pending iff any group stored a real index.
 
+    With ``score`` (int64[Q,K], kueue_tpu/policy) the per-group choice
+    is a masked score-argmax: among stop-eligible candidates the
+    highest score wins, ties keep the earliest flavor index; the
+    best-mode fallback (walks that ran to the end) scores identically
+    within the best granular mode. All-zero scores (or score=None)
+    reduce every reduction to the earliest-flavor choice — the default
+    first-fit walk, bit-for-bit.
+
     Returns (chosen int32[Q], pre_k int32[Q], pending bool[Q],
     next_start int32[Q,G]): the representative candidate for FIT heads,
     for preempt-mode heads, the PendingFlavors flag, and the per-group
     resume starts used by conflict-loss and pending retries alike."""
     g = gid.shape[-1]
     inf = jnp.int32(2**30)
+    neg = jnp.int64(-(2**62))
+    sc = (score if score is not None else jnp.zeros_like(head_valid, jnp.int64))[
+        :, :, None
+    ]  # [Q,K,1]
     valid3 = head_valid[:, :, None]  # [Q,K,1]
     # per-candidate per-group aggregates
     cellmode = jnp.where(
@@ -175,11 +194,17 @@ def _group_walk(
         ((gmode == 3) & borrow_ok)
         | ((gmode == 1) | (gmode == 2)) & ffp[:, None, None] & borrow_ok
     )
-    stop_idx = jnp.min(jnp.where(stop, gid, inf), axis=1)  # [Q,G]
+    stop_sc = jnp.where(stop, sc, neg)  # [Q,K,G]
+    stop_best = jnp.max(stop_sc, axis=1)  # [Q,G]
+    stop_sel = stop & (stop_sc == stop_best[:, None, :])
+    stop_idx = jnp.min(jnp.where(stop_sel, gid, inf), axis=1)  # [Q,G]
     stopped = stop_idx < inf
     best_mode = jnp.max(jnp.where(valid3, gmode, -1), axis=1)  # [Q,G]
+    bm_sel = valid3 & (gmode == best_mode[:, None, :])
+    bm_sc = jnp.where(bm_sel, sc, neg)
+    bm_best = jnp.max(bm_sc, axis=1)  # [Q,G]
     best_idx = jnp.min(
-        jnp.where(valid3 & (gmode == best_mode[:, None, :]), gid, inf), axis=1
+        jnp.where(bm_sel & (bm_sc == bm_best[:, None, :]), gid, inf), axis=1
     )
     choice_idx = jnp.where(stopped, stop_idx, best_idx)  # [Q,G]
     at_choice = valid3 & (gid == choice_idx[:, None, :])
@@ -283,9 +308,13 @@ def _nominate_multi(
         gmask_p = cg_p[..., None] == jnp.arange(g)[None, None, None, :]
         k_mask_p = jnp.all(gid_p >= g_start[:, p][:, None, :], axis=-1)
         valid_p = queues.valid[q_idx, cur, p] & real[:, None] & k_mask_p
+        score_p = (
+            queues.score[q_idx, cur, p] if queues.score is not None else None
+        )
         chosen_p, pre_p, pending_p, nstart_p = _group_walk(
             gid_p, gl_p, gmask_p, valid_p, fit_cells, pot_cells,
             reclaim_cells, borrow_cells, queues.ffb, queues.ffp,
+            score=score_p,
         )
         live = real & processed
         mode_p = jnp.where(

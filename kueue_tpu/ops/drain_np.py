@@ -126,10 +126,16 @@ def _cell_masks_np(
 
 def _group_walk_np(
     gid, gl, gmask, head_valid, fit_cells, pot_cells, reclaim_cells,
-    borrow_cells, ffb, ffp,
+    borrow_cells, ffb, ffp, score=None,
 ):
-    """drain_kernel._group_walk, jnp → np verbatim."""
+    """drain_kernel._group_walk, jnp → np verbatim (including the
+    policy score-argmax: all-zero/absent scores reduce to the
+    earliest-flavor choice bit-for-bit)."""
     inf = np.int32(2**30)
+    neg = np.int64(-(2**62))
+    sc = (
+        score if score is not None else np.zeros(head_valid.shape, np.int64)
+    )[:, :, None]  # [Q,K,1]
     valid3 = head_valid[:, :, None]  # [Q,K,1]
     cellmode = np.where(
         fit_cells,
@@ -145,11 +151,17 @@ def _group_walk_np(
         ((gmode == 3) & borrow_ok)
         | ((gmode == 1) | (gmode == 2)) & ffp[:, None, None] & borrow_ok
     )
-    stop_idx = np.min(np.where(stop, gid, inf), axis=1)  # [Q,G]
+    stop_sc = np.where(stop, sc, neg)  # [Q,K,G]
+    stop_best = np.max(stop_sc, axis=1)  # [Q,G]
+    stop_sel = stop & (stop_sc == stop_best[:, None, :])
+    stop_idx = np.min(np.where(stop_sel, gid, inf), axis=1)  # [Q,G]
     stopped = stop_idx < inf
     best_mode = np.max(np.where(valid3, gmode, -1), axis=1)  # [Q,G]
+    bm_sel = valid3 & (gmode == best_mode[:, None, :])
+    bm_sc = np.where(bm_sel, sc, neg)
+    bm_best = np.max(bm_sc, axis=1)  # [Q,G]
     best_idx = np.min(
-        np.where(valid3 & (gmode == best_mode[:, None, :]), gid, inf), axis=1
+        np.where(bm_sel & (bm_sc == bm_best[:, None, :]), gid, inf), axis=1
     )
     choice_idx = np.where(stopped, stop_idx, best_idx)  # [Q,G]
     at_choice = valid3 & (gid == choice_idx[:, None, :])
@@ -217,9 +229,12 @@ def _nominate_multi_np(
         gmask_p = cg_p[..., None] == np.arange(g)[None, None, None, :]
         k_mask_p = np.all(gid_p >= g_start[:, p][:, None, :], axis=-1)
         valid_p = queues["valid"][q_idx, cur, p] & real[:, None] & k_mask_p
+        score_np = queues.get("score")
+        score_p = score_np[q_idx, cur, p] if score_np is not None else None
         chosen_p, pre_p, pending_p, nstart_p = _group_walk_np(
             gid_p, gl_p, gmask_p, valid_p, fit_cells, pot_cells,
             reclaim_cells, borrow_cells, queues["ffb"], queues["ffp"],
+            score=score_p,
         )
         live = real & processed
         mode_p = np.where(chosen_p >= 0, 3, np.where(pre_p >= 0, 1, 0))

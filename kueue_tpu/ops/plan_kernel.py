@@ -41,13 +41,15 @@ def _solve_scenarios(
     borrowing_s,  # int64[S, N, FR]
     usage_s,  # int64[S, N, FR]
     priority_s,  # int64[S, W]
-    heads: HeadsBatch,  # shared across scenarios (priority overridden)
+    score_s,  # int64[S, W, K] — per-scenario policy scores (the
+    #            ``policy`` scenario kind; all-zero rows = first-fit)
+    heads: HeadsBatch,  # shared across scenarios (priority/score overridden)
     paths,  # int32[N, D+1]
     seg_id,  # int32[W]
     n_segments: int,
     n_steps: int,
 ):
-    def one(nominal, lending, borrowing, usage, priority):
+    def one(nominal, lending, borrowing, usage, priority, score):
         tree = QuotaTree(
             parent=parent,
             level_mask=level_mask,
@@ -55,7 +57,7 @@ def _solve_scenarios(
             lending_limit=lending,
             borrowing_limit=borrowing,
         )
-        h = heads._replace(priority=priority)
+        h = heads._replace(priority=priority, score=score)
         subtree, guaranteed = subtree_quota(tree)
         # preempt-mode representative per head (phase 1 inside the
         # segmented solve doesn't surface it); XLA CSEs the shared work
@@ -75,7 +77,9 @@ def _solve_scenarios(
         )
         return per_head, r.usage
 
-    return jax.vmap(one)(nominal_s, lending_s, borrowing_s, usage_s, priority_s)
+    return jax.vmap(one)(
+        nominal_s, lending_s, borrowing_s, usage_s, priority_s, score_s
+    )
 
 
 solve_scenarios_jit = jax.jit(

@@ -66,6 +66,10 @@ class ShardedSolver:
     def place(self, tree: QuotaTree, local_usage, heads: HeadsBatch, paths):
         """device_put every input with its mesh sharding (shared layout
         builders — the same specs the production entries use)."""
+        if heads.score is None:
+            heads = heads._replace(
+                score=jnp.zeros(heads.valid.shape, dtype=jnp.int64)
+            )
         fr_size = tree.nominal.shape[1]
         return (
             jax.device_put(tree, build_tree_spec(self.mesh, fr_size)),
@@ -92,6 +96,11 @@ class ShardedSolver:
             widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
             return jnp.pad(x, widths, constant_values=0)
 
+        score = (
+            heads.score
+            if heads.score is not None
+            else jnp.zeros(heads.valid.shape, dtype=jnp.int64)
+        )
         return HeadsBatch(
             cq_row=jnp.pad(heads.cq_row, (0, pad), constant_values=-1),
             cells=jnp.pad(
@@ -102,6 +111,7 @@ class ShardedSolver:
             priority=pad0(heads.priority),
             timestamp=pad0(heads.timestamp),
             no_reclaim=pad0(heads.no_reclaim),
+            score=pad0(score),
         )
 
     def __call__(
@@ -151,6 +161,7 @@ def build_heads_spec(mesh) -> HeadsBatch:
         priority=_sh(mesh, "wl"),
         timestamp=_sh(mesh, "wl"),
         no_reclaim=_sh(mesh, "wl"),
+        score=_sh(mesh, "wl", None),
     )
 
 

@@ -261,15 +261,22 @@ def solve_scenario_host(
     )
 
     populated = batch.cq_row >= 0
+    # masked score-argmax (kueue_tpu/policy) — np.argmax's first-max
+    # tie-break keeps the walk order, so all-zero/absent scores are
+    # the boolean first-fit argmax bit-for-bit (the kernel's rule)
+    score = getattr(batch, "score", None)
+    if score is None:
+        score = np.int64(0)
+    neg = np.int64(-(2**62))
     fit_ok = fits & batch.valid
-    first_fit = np.argmax(fit_ok, axis=1)
+    first_fit = np.argmax(np.where(fit_ok, score, neg), axis=1)
     chosen = np.where(
         fit_ok.any(axis=1) & populated, first_fit, -1
     ).astype(np.int32)
     pre_ok = pot_fits & batch.valid
     preempt_k = np.where(
         pre_ok.any(axis=1) & populated & (chosen < 0),
-        np.argmax(pre_ok, axis=1),
+        np.argmax(np.where(pre_ok, score, neg), axis=1),
         -1,
     ).astype(np.int32)
 
@@ -363,6 +370,10 @@ class Planner:
         metrics=None,
         max_candidates: int = 8,
         max_cells: int = 16,
+        policy=None,  # the runtime's ACTIVE AdmissionPolicy: the
+        #               baseline scenario scores with it, so a plan's
+        #               baseline always reflects live behavior
+        clock=None,
     ):
         self.cache = cache
         self.queues = queues
@@ -371,6 +382,8 @@ class Planner:
         self.transform = transform
         self.tas_cache = tas_cache
         self.metrics = metrics
+        self.policy = policy
+        self.clock = clock
 
         self.max_candidates = max_candidates
         self.max_cells = max_cells
@@ -384,7 +397,14 @@ class Planner:
             transform=rt.transform_config,
             tas_cache=rt.cache.tas_cache,
             metrics=rt.metrics,
+            policy=getattr(rt, "policy", None),
+            clock=getattr(rt, "clock", None),
         )
+
+    def _now(self) -> float:
+        if self.clock is not None:
+            return self.clock.now()
+        return 0.0
 
     # ---- backlog collection (read-only) ----
     def backlog(
@@ -575,6 +595,11 @@ class Planner:
             timestamp_fn=self._timestamp_fn(),
             transform=self.transform,
         )
+        if self.policy is not None and not self.policy.is_default:
+            # baseline = the runtime's ACTIVE policy (kueue_tpu/policy)
+            from kueue_tpu.policy import annotate_lowered
+
+            annotate_lowered(self.policy, lowered, now=self._now())
         unmodeled = sorted({lowered.heads[i].key for i in lowered.fallback})
         w = len(lowered.heads)
         w_pad = _bucket(w) if w else 0
@@ -610,21 +635,28 @@ class Planner:
         priority_pad = np.zeros(w_pad, dtype=np.int64)
         priority_pad[:w] = lowered.priority
         priority_s = np.repeat(priority_pad[None], s, axis=0)
+        # per-scenario policy score matrices: the baseline row carries
+        # the active policy's scores (pack_heads padded them); the
+        # ``policy`` scenario kind overwrites its own copy
+        score_s = np.repeat(batch_np.score[None], s, axis=0)
+        scenario_policy: List[str] = []
         for si, scen in enumerate(scen_list):
-            scen.apply(
-                ArrayView(
-                    nominal=nominal_s[si],
-                    lending=lending_s[si],
-                    borrowing=borrowing_s[si],
-                    usage=usage_s[si],
-                    priority=priority_s[si],
-                    weight=weight_s[si],
-                    row_index=row_index,
-                    fr_index=snapshot.fr_index,
-                    head_slots=head_slots,
-                    n_cq=enc.n_cq,
-                )
+            view = ArrayView(
+                nominal=nominal_s[si],
+                lending=lending_s[si],
+                borrowing=borrowing_s[si],
+                usage=usage_s[si],
+                priority=priority_s[si],
+                weight=weight_s[si],
+                row_index=row_index,
+                fr_index=snapshot.fr_index,
+                head_slots=head_slots,
+                n_cq=enc.n_cq,
+                score=score_s[si],
+                lowered=lowered,
             )
+            scen.apply(view)
+            scenario_policy.append(view.policy_name)
 
         device = use_device if use_device is not None else True
         launches = 0
@@ -640,6 +672,7 @@ class Planner:
                 jnp.asarray(borrowing_s),
                 jnp.asarray(usage_s),
                 jnp.asarray(priority_s),
+                jnp.asarray(score_s),
                 type(batch_np)(*(jnp.asarray(x) for x in batch_np)),
                 jnp.asarray(paths_np),
                 jnp.asarray(seg_id),
@@ -675,6 +708,7 @@ class Planner:
                 self._host_raw(
                     enc, nominal_s[si], lending_s[si], borrowing_s[si],
                     usage_s[si], priority_s[si], batch_np, paths_np, w,
+                    score=score_s[si],
                 )
                 for si in range(s)
             ]
@@ -685,6 +719,7 @@ class Planner:
                 host = self._host_raw(
                     enc, nominal_s[si], lending_s[si], borrowing_s[si],
                     usage_s[si], priority_s[si], batch_np, paths_np, w,
+                    score=score_s[si],
                 )
                 for k in ("chosen", "admitted", "borrows", "reserved"):
                     if not np.array_equal(raws[si][k], host[k]):
@@ -701,9 +736,11 @@ class Planner:
         )
         if forecast and runtime_hint is not None:
             for si, o in enumerate(outcomes):
+                pol = self._scenario_policy(scenario_policy[si])
                 o.forecast = self._forecast(
                     enc, nominal_s[si], lending_s[si], borrowing_s[si],
                     lowered, raws[si], runtime_hint, forecast_horizon_s,
+                    policy=pol, score=score_s[si],
                 )
 
         ranked = self._rank(outcomes, target_workload)
@@ -746,9 +783,11 @@ class Planner:
     # ---- internals ----
     def _host_raw(
         self, enc, nominal, lending, borrowing, usage, priority,
-        batch_np, paths_np, w,
+        batch_np, paths_np, w, score=None,
     ) -> dict:
         batch = batch_np._replace(priority=priority)
+        if score is not None:
+            batch = batch._replace(score=score)
         out = solve_scenario_host(
             enc.parent, enc.level_mask, nominal, lending, borrowing,
             usage, batch, paths_np, enc.max_depth,
@@ -898,9 +937,18 @@ class Planner:
             if wl.key == key:
                 yield i
 
+    def _scenario_policy(self, name: str):
+        """Resolve one scenario's effective policy for the forecast:
+        the PolicyDelta's pick, else the planner's active policy."""
+        if name:
+            from kueue_tpu.policy import resolve_policy
+
+            return resolve_policy(name)
+        return self.policy
+
     def _forecast(
         self, enc, nominal, lending, borrowing, lowered: Lowered, raw,
-        runtime_hint, horizon_s: float,
+        runtime_hint, horizon_s: float, policy=None, score=None,
     ) -> dict:
         """Virtual-time time-to-admission forecast for the scenario's
         still-pending backlog: a discrete-event simulation on the
@@ -908,7 +956,14 @@ class Planner:
         finishes (per ``runtime_hint`` seconds), pending heads re-try
         their lowered candidates in entry order. Same virtual-clock
         discipline as perf/runner.py; validated against it in
-        tests/test_planner.py."""
+        tests/test_planner.py.
+
+        With a scoring ``policy`` (kueue_tpu/policy) the simulation is
+        heterogeneity-aware: pending heads try candidates in score
+        order (best flavor first, the kernels' argmax rule) and every
+        admitted workload's virtual runtime scales by the policy's
+        throughput model — so a Gavel scenario's makespan/TTA deltas vs
+        the first-fit baseline are visible in one report."""
         import heapq
 
         from kueue_tpu.utils.clock import FakeClock
@@ -922,6 +977,7 @@ class Planner:
         clock = FakeClock(0.0)
         fallback = set(lowered.fallback)
         w = len(lowered.heads)
+        scoring = policy is not None and not policy.is_default
 
         def vec_of(i: int, k: int) -> np.ndarray:
             vec = np.zeros(len(snap.fr_list), dtype=np.int64)
@@ -930,6 +986,27 @@ class Planner:
                 if cells[c] >= 0:
                     vec[int(cells[c])] += int(qty[c])
             return vec
+
+        def runtime_of(i: int, k: int) -> float:
+            rt_s = float(runtime_hint(lowered.heads[i]))
+            if scoring and 0 <= k < len(lowered.candidate_flavors[i]):
+                fmap = lowered.candidate_flavors[i][k]
+                if fmap:
+                    fsig = tuple(sorted(set(fmap.values())))
+                    rt_s *= float(
+                        policy.runtime_scale(lowered.heads[i], fsig)
+                    )
+            return rt_s
+
+        def candidate_order(i: int) -> List[int]:
+            ks = [
+                k
+                for k in range(lowered.valid.shape[1])
+                if lowered.valid[i, k]
+            ]
+            if scoring and score is not None:
+                ks.sort(key=lambda k: (-int(score[i, k]), k))
+            return ks
 
         events: List[tuple] = []  # (finish_t, seq, cq_name, usage_vec)
         seq = 0
@@ -941,6 +1018,7 @@ class Planner:
             )
             seq += 1
         tta: Dict[str, float] = {}
+        done_at: Dict[str, float] = {}  # completion time of backlog work
         pending: List[int] = []
         order = raw.get("order")
         order_iter = (
@@ -956,12 +1034,10 @@ class Planner:
                 tta[key] = 0.0
                 k = int(raw["chosen"][i])
                 vec = vec_of(i, max(k, 0))
+                rt_s = runtime_of(i, max(k, 0))
+                done_at[key] = rt_s
                 heapq.heappush(
-                    events,
-                    (
-                        float(runtime_hint(lowered.heads[i])),
-                        seq, lowered.cq_names[i], vec,
-                    ),
+                    events, (rt_s, seq, lowered.cq_names[i], vec)
                 )
                 seq += 1
             else:
@@ -979,14 +1055,11 @@ class Planner:
             still: List[int] = []
             for i in pending:
                 admitted_now = False
-                nvalid = lowered.valid[i]
-                for k in range(nvalid.shape[0]):
-                    if not nvalid[k]:
-                        continue
+                for k in candidate_order(i):
                     vec_k = vec_of(i, k)
                     if snap.fits(lowered.cq_names[i], vec_k):
                         snap.add_usage(lowered.cq_names[i], vec_k)
-                        rt_s = float(runtime_hint(lowered.heads[i]))
+                        rt_s = runtime_of(i, k)
                         max_rt = max(max_rt, rt_s)
                         heapq.heappush(
                             events,
@@ -994,6 +1067,7 @@ class Planner:
                         )
                         seq += 1
                         tta[lowered.heads[i].key] = t
+                        done_at[lowered.heads[i].key] = t + rt_s
                         admitted_now = True
                         break
                 if not admitted_now:
@@ -1014,14 +1088,20 @@ class Planner:
             }
             vals.append(t)
         mean = sum(vals) / len(vals) if vals else 0.0
-        return {
+        out = {
             "perWorkload": per_wl,
             "mean": round(mean, 3),
             "band": [round(0.5 * mean, 3), round(2.0 * mean + max_rt, 3)],
+            # virtual completion time of the last backlog workload to
+            # finish — the Gavel-vs-FIFO makespan comparison surface
+            "makespan": round(max(done_at.values()), 3) if done_at else 0.0,
             "unadmitted": sorted(
                 lowered.heads[i].key for i in pending
             ),
         }
+        if scoring:
+            out["policy"] = policy.name
+        return out
 
     @staticmethod
     def _first_slot(lowered: Lowered, key: str) -> int:

@@ -25,6 +25,11 @@ Supported delta kinds (wire ``kind`` in parentheses):
 - ``DrainDomainDelta`` (drainDomain): remove a TAS domain's allocatable
   capacity from the flavor's nominal cells (greedy across CQ rows in
   row order) — the quota-level model of draining those nodes.
+- ``PolicyDelta`` (policy): switch the admission policy (the closed
+  kueue_tpu/policy registry) for the scenario — per-candidate score
+  tensors + deadline priority boosts compiled onto the scenario's copy
+  of the lowered backlog, the safe what-if before ``--policy`` is
+  enabled live.
 """
 
 from __future__ import annotations
@@ -47,6 +52,7 @@ __all__ = [
     "FairShareWeightDelta",
     "PriorityDelta",
     "DrainDomainDelta",
+    "PolicyDelta",
     "delta_from_dict",
     "scenario_from_dict",
 ]
@@ -75,6 +81,14 @@ class ArrayView:
     fr_index: Dict[FlavorResource, int]
     head_slots: Dict[str, List[int]]  # workload key -> head row(s)
     n_cq: int = 0
+    # admission-policy what-if surface (the ``policy`` scenario kind):
+    # per-head x per-candidate score matrix int64[W_pad, K] owned by
+    # this scenario, the lowered cycle batch (read-only context the
+    # delta compiles scores from), and the name of the policy applied
+    # (read back by the planner for forecast runtime scaling)
+    score: Optional[np.ndarray] = None
+    lowered: Optional[object] = None
+    policy_name: str = ""
 
     def row(self, name: str) -> int:
         r = self.row_index.get(name)
@@ -346,6 +360,65 @@ class DrainDomainDelta(ScenarioDelta):
         }
 
 
+class PolicyDelta(ScenarioDelta):
+    """Switch the admission policy for one scenario — the what-if an
+    operator runs BEFORE enabling ``--policy`` on a live control plane.
+
+    Compiles the named policy (closed kueue_tpu/policy.POLICY registry)
+    onto the scenario's copy of the lowered backlog: per-candidate
+    flavor scores into ``view.score`` and deadline boosts into
+    ``view.priority``; the planner's forecast then also scales each
+    admitted workload's virtual runtime by the policy's throughput
+    model, so makespan/TTA deltas vs the baseline are visible in the
+    same report."""
+
+    kind = "policy"
+
+    def __init__(self, policy: str, now: float = 0.0):
+        self.policy = policy
+        self.now = now
+
+    def apply(self, view: ArrayView) -> None:
+        from kueue_tpu.policy import resolve_policy
+
+        try:
+            pol = resolve_policy(self.policy)
+        except ValueError as e:
+            raise ScenarioApplyError(str(e))
+        view.policy_name = pol.name
+        lowered = view.lowered
+        if lowered is None or view.score is None:
+            raise ScenarioApplyError(
+                "policy scenario requires a lowered backlog "
+                "(no score surface on this plan)"
+            )
+        if pol.is_default:
+            view.score[:] = 0
+            return
+        from kueue_tpu.core.encode import encode_candidate_scores
+
+        w = len(lowered.heads)
+        view.score[:w] = encode_candidate_scores(
+            pol, lowered.heads, lowered.candidate_flavors,
+            view.score.shape[1],
+        )
+        view.score[w:] = 0
+        for i, wl in enumerate(lowered.heads):
+            boost = pol.priority_boost(wl, self.now)
+            if boost:
+                view.priority[i] += boost
+
+    def cost(self) -> float:
+        # a policy switch is config-only: the cheapest intervention
+        return 0.0
+
+    def describe(self) -> str:
+        return f"admission policy -> {self.policy}"
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "policy": self.policy, "now": self.now}
+
+
 @dataclass(frozen=True)
 class PlanScenario:
     name: str
@@ -406,6 +479,8 @@ def delta_from_dict(d: dict) -> ScenarioDelta:
             {k: int(v) for k, v in (d.get("amounts") or {}).items()},
             domain=d.get("domain", ""),
         )
+    if kind == "policy":
+        return PolicyDelta(d["policy"], now=float(d.get("now", 0.0)))
     raise ScenarioApplyError(f"unknown scenario delta kind {kind!r}")
 
 

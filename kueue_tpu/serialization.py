@@ -610,6 +610,16 @@ def runtime_from_state(data: dict, runtime=None, **runtime_kwargs):
             until=float(q.get("until", 0.0)),
             strikes=int(q.get("strikes", 0)),
         )
+    # checkpointed admission policy (kueue_tpu/policy): restore WITHOUT
+    # journaling (recovery replay must not re-append), so offline
+    # `kueuectl explain` replays decisions under the policy the server
+    # was actually running
+    pol = data.get("policy")
+    if pol and hasattr(rt, "set_policy"):
+        try:
+            rt.set_policy(pol, journal=False)
+        except ValueError:
+            pass  # a newer binary's policy vocabulary: keep the default
     # persistence metadata (written by checkpoints): restore the
     # monotone mutation counter so post-recovery journal records keep
     # increasing instead of restarting from zero
@@ -653,6 +663,9 @@ def runtime_to_state(rt) -> dict:
     # (recovery replays only records with seq > journalSeq) and the
     # runtime's monotone mutation counter. journal=None serializes
     # seq 0 — replay-everything, the correct degenerate case.
+    policy = getattr(rt, "policy", None)
+    if policy is not None and not policy.is_default:
+        out["policy"] = policy.name
     journal = getattr(rt, "journal", None)
     out["persistence"] = {
         "resourceVersion": getattr(rt, "resource_version", 0),

@@ -200,6 +200,17 @@ def main(argv=None) -> int:
         "device faults propagate)",
     )
     parser.add_argument(
+        "--policy", default="first-fit",
+        help="admission policy (kueue_tpu/policy closed registry): "
+        "first-fit = the score-free default (bit-for-bit the "
+        "pre-policy decisions), gavel = heterogeneity-aware flavor "
+        "scoring from kueue.tpu/throughput-<flavor> labels, prema = "
+        "predictive victim ordering from kueue.tpu/remaining-seconds, "
+        "deadline = SLO-boosted nomination from kueue.tpu/deadline, "
+        "gavel-deadline = both. What-if a switch first: kueuectl plan "
+        "with a {\"kind\": \"policy\"} scenario delta",
+    )
+    parser.add_argument(
         "--pipeline", choices=["on", "serial", "off"], default="on",
         help="double-buffered bulk-drain loop (core/pipeline.py): on = "
         "chunked drain rounds with the next round's encode+solve "
@@ -415,6 +426,8 @@ def main(argv=None) -> int:
             rt.drain_pipeline = args.pipeline
             rt.pipeline_chunk_cycles = max(1, args.pipeline_chunk_cycles)
             rt.set_mesh(mesh)
+            if args.policy != "first-fit":
+                rt.set_policy(args.policy, journal=False)
             _apply_trace_capacity(rt)
             return rt
         from kueue_tpu.controllers import ClusterRuntime
@@ -425,6 +438,7 @@ def main(argv=None) -> int:
             drain_pipeline=args.pipeline,
             pipeline_chunk_cycles=args.pipeline_chunk_cycles,
             mesh=mesh,
+            policy=args.policy,
         )
         _apply_trace_capacity(rt)
         return rt

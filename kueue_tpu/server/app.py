@@ -886,6 +886,11 @@ def _make_handler(srv: KueueServer):
                 body["solver"] = detail
                 if guard.degraded or detail["quarantinedWorkloads"]:
                     body["status"] = "degraded"
+            # active admission policy (kueue_tpu/policy): informational
+            # — the dashboard badge and runbooks read it here
+            policy = getattr(srv.runtime, "policy", None)
+            if policy is not None:
+                body["policy"] = policy.name
             # federation detail (kueue_tpu/federation): same convention
             # — a lost or quarantined worker cluster flips "degraded"
             # while the probe stays 200 (the dispatcher keeps routing

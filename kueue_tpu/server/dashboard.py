@@ -162,6 +162,14 @@ def dashboard_payload(rt) -> dict:
     # active mesh shape, device count, jit-bucket reuse
     mesh_status = getattr(rt, "mesh_status", None)
     mesh = mesh_status() if mesh_status is not None else {"shape": "off", "devices": 0}
+    # policy badge (kueue_tpu/policy): the active admission policy —
+    # green when the default first-fit is in effect, amber for a
+    # scoring policy (operators should have what-if'd it first)
+    pol = getattr(rt, "policy", None)
+    policy = {
+        "name": pol.name if pol is not None else "first-fit",
+        "default": bool(pol.is_default) if pol is not None else True,
+    }
     # replication badge (kueue_tpu/replica): role + staleness —
     # materialized at zero on the leader so the badge renders one
     # schema on every plane
@@ -191,6 +199,7 @@ def dashboard_payload(rt) -> dict:
         "solver": solver,
         "pipeline": pipeline,
         "mesh": mesh,
+        "policy": policy,
         "replication": replication,
         "clusterQueues": cqs,
         "localQueues": lqs,
@@ -268,6 +277,7 @@ DASHBOARD_HTML = """<!doctype html>
  &middot; solver <span id="solver" class="badge">&hellip;</span>
  &middot; pipeline <span id="pipeline" class="badge">&hellip;</span>
  &middot; mesh <span id="mesh" class="badge">&hellip;</span>
+ &middot; policy <span id="policy" class="badge">&hellip;</span>
  &middot; replication <span id="replication" class="badge">&hellip;</span></div>
 <div class="tiles" id="tiles"></div>
 <h2>Last cycle</h2><div id="cycle"></div>
@@ -349,6 +359,13 @@ function render(d){
   const bk = (ms.buckets||{});
   msEl.title = `jit buckets: ${bk.buckets||0} compiled, ${bk.hits||0} reuses; `+
     `place=${ms.placeSeconds||0}s`;
+  const po = d.policy||{};
+  const poEl = document.getElementById('policy');
+  poEl.className = 'badge '+(po.default===false ? 'host' : 'device');
+  poEl.textContent = po.name || 'first-fit';
+  poEl.title = po.default===false
+    ? 'scoring admission policy active (kueue_policy_* metrics)'
+    : 'default first-fit policy (bit-for-bit reference decisions)';
   const rp = d.replication||{};
   const rpEl = document.getElementById('replication');
   if (rp.role){

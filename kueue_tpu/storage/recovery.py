@@ -49,6 +49,10 @@ OBJECT_DELETE = "object_delete"
 QUARANTINE_SET = "quarantine_set"
 QUARANTINE_CLEAR = "quarantine_clear"
 SOLVER_VERDICT = "solver_verdict"
+# admission policy (kueue_tpu/policy): the active-policy config record
+# — recovery and journal-tailing read replicas converge on the policy
+# the leader was running
+POLICY_CONFIG = "policy_config"
 # MultiKueue federation (kueue_tpu/federation): dispatch intent, winner
 # picks and the retraction queue — replayed in append order into
 # runtime.federation_replay and adopted by the FederationDispatcher, so
@@ -182,6 +186,15 @@ def apply_record(rt, rec: JournalRecord) -> None:
                 replay = []
                 rt.federation_replay = replay
             replay.append((rec.type, dict(rec.data)))
+    elif rec.type == POLICY_CONFIG:
+        set_policy = getattr(rt, "set_policy", None)
+        if set_policy is not None:
+            try:
+                set_policy(rec.data.get("policy"), journal=False)
+            except ValueError:
+                # a newer binary's policy vocabulary — keep the default
+                # rather than crash replay
+                pass
     elif rec.type == SOLVER_VERDICT:
         # which solver path produced the admitted state on disk — a
         # recovered process must know the device path was quarantined

@@ -629,7 +629,7 @@ class TestEngine:
                 "kernel-dtype", "trace-safety", "journal-symmetry",
                 "clock-discipline", "lock-discipline", "reason-enum",
                 "span-name", "fault-point", "metrics-families",
-                "kernel-mirrors",
+                "kernel-mirrors", "policy-name",
             ]
         )
 

@@ -1,0 +1,791 @@
+"""Admission-policy subsystem (kueue_tpu/policy) — registry, scored
+kernels, and the default-policy bit-for-bit parity contract.
+
+The load-bearing property: the default ``first-fit`` policy (and a
+wholly absent policy) produce **bit-for-bit identical** decisions to
+the pre-policy kernels across the drain family, the cycle path, the
+mesh, the pipelined launch/fetch split, device AND host mirror — the
+scored kernels' masked score-argmax degenerates exactly to the boolean
+first-fit argmax under all-zero scores. On top of that: the Gavel
+policy's heterogeneity-aware decisions agree device-vs-host
+(SCORED_KERNELS parity), the planner's ``policy`` scenario kind shows
+Gavel beating FIFO on makespan/mean-TTA over a seeded heterogeneous
+trace, decisions carry the flavor score breakdown end-to-end
+(audit -> server decisions endpoint -> ``kueuectl explain`` -> read
+replica wire codec), the policy config is journaled + checkpointed,
+and the kueuelint ``policy-name`` rule keeps the registry closed.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from kueue_tpu.core.drain import launch_drain, plan_drain, run_drain
+from kueue_tpu.core.queue_manager import queue_order_timestamp
+from kueue_tpu.core.snapshot import take_snapshot
+from kueue_tpu.models import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+)
+from kueue_tpu.models.constants import (
+    InadmissibleReason,
+    classify_inadmissible_message,
+)
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.policy import (
+    DEADLINE_LABEL,
+    DEFAULT_POLICY,
+    POLICY,
+    REMAINING_SECONDS_LABEL,
+    THROUGHPUT_LABEL_PREFIX,
+    annotate_lowered,
+    resolve_policy,
+)
+
+from tests.test_solver_path import (
+    assert_parity,  # noqa: F401  (re-export convenience)
+    build_env,
+    drain_and_trace,
+    random_spec,
+)
+
+FF = resolve_policy("first-fit")
+GAVEL = resolve_policy("gavel")
+
+
+# ---- helpers ----
+def _pending_of(mgr):
+    return [
+        (wl, cq_name)
+        for cq_name, pq in mgr.cluster_queues.items()
+        for wl in pq.snapshot_sorted()
+    ]
+
+
+def _drain_trace(spec, policy=None, use_device=True, mesh=None,
+                 labels=None, max_cycles=None):
+    """One drain run from a fresh env; returns comparable decisions."""
+    sched, mgr, cache, workloads = build_env(spec, use_solver=False)
+    if labels:
+        for name, lab in labels.items():
+            workloads[name].labels = dict(lab)
+    snapshot = take_snapshot(cache)
+    outcome = run_drain(
+        snapshot,
+        _pending_of(mgr),
+        cache.flavors,
+        timestamp_fn=lambda wl: queue_order_timestamp(wl, mgr._ts_policy),
+        use_device=use_device,
+        policy=policy,
+        mesh=mesh,
+        max_cycles=max_cycles,
+    )
+    admitted = {
+        wl.name: (tuple(sorted(flavors.items())), cycle)
+        for wl, _, flavors, cycle in outcome.admitted
+    }
+    parked = {wl.name for wl, _ in outcome.parked}
+    fallback = {wl.name for wl, _ in outcome.fallback}
+    return admitted, parked, fallback, outcome
+
+
+def _hetero_spec(n_wl=8, quota_slow="8", quota_fast="8", request="4"):
+    """Two-flavor heterogeneous cluster: the CQ walks ``slow`` first
+    (the first-fit choice), ``fast`` second; workloads declare 4x
+    throughput on ``fast``."""
+    return {
+        "flavors": ["slow", "fast"],
+        "cqs": [
+            {
+                "name": "cq",
+                "cohort": None,
+                "groups": [
+                    {
+                        "resources": ["cpu"],
+                        "flavors": [
+                            ("slow", {"cpu": quota_slow}, None, None),
+                            ("fast", {"cpu": quota_fast}, None, None),
+                        ],
+                    }
+                ],
+            }
+        ],
+        "workloads": [
+            {
+                "name": f"wl-{i}",
+                "queue": "lq-cq",
+                "prio": 0,
+                "t": float(i + 1),
+                "pod_sets": [
+                    {"name": "main", "count": 1, "requests": {"cpu": request}}
+                ],
+            }
+            for i in range(n_wl)
+        ],
+    }
+
+
+def _hetero_labels(n_wl=8, tput="4"):
+    return {
+        f"wl-{i}": {THROUGHPUT_LABEL_PREFIX + "fast": tput}
+        for i in range(n_wl)
+    }
+
+
+# ---- registry ----
+class TestPolicyRegistry:
+    def test_registry_is_closed(self):
+        assert sorted(POLICY) == [
+            "deadline", "first-fit", "gavel", "gavel-deadline", "prema",
+        ]
+        assert DEFAULT_POLICY == "first-fit"
+
+    def test_resolve_known_and_default(self):
+        assert resolve_policy(None).name == "first-fit"
+        assert resolve_policy("").name == "first-fit"
+        assert resolve_policy("gavel").name == "gavel"
+        assert resolve_policy("first-fit").is_default
+        assert not resolve_policy("gavel").is_default
+
+    def test_resolve_unknown_raises(self):
+        with pytest.raises(ValueError, match="unknown admission policy"):
+            resolve_policy("shortest-job-first")
+
+    def test_default_policy_compiles_nothing(self):
+        from kueue_tpu.core.solver import lower_heads
+
+        spec = random_spec(0, workloads_per_cq=4)
+        sched, mgr, cache, _ = build_env(spec, use_solver=False)
+        snapshot = take_snapshot(cache)
+        heads = _pending_of(mgr)
+        lowered = lower_heads(snapshot, heads, cache.flavors)
+        before = lowered.priority.copy()
+        annotate_lowered(FF, lowered, now=123.0)
+        assert lowered.score is None  # default = no score tensor at all
+        assert np.array_equal(lowered.priority, before)
+
+
+# ---- the parity contract (satellite: default == pre-policy, everywhere) ----
+class TestDefaultPolicyParity:
+    """``--policy first-fit`` (and policy absent) must decide
+    bit-for-bit like the pre-policy kernels: admitted sets, flavors,
+    admission cycles, parked sets, fallback routing — device and host
+    mirror, mesh-sharded and pipelined-launch paths included."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    @pytest.mark.parametrize("use_device", [True, False])
+    def test_drain_first_fit_bit_for_bit(self, seed, use_device):
+        spec = random_spec(seed, workloads_per_cq=8)
+        base = _drain_trace(spec, policy=None, use_device=use_device)
+        ff = _drain_trace(spec, policy=FF, use_device=use_device)
+        assert base[:3] == ff[:3], f"seed {seed}: decisions diverge"
+        assert base[3].cycles == ff[3].cycles
+        assert np.array_equal(base[3].final_usage, ff[3].final_usage)
+
+    @pytest.mark.parametrize("seed", [0, 2])
+    def test_drain_zero_scores_equal_absent_scores(self, seed):
+        """An explicit all-zero score tensor and NO score tensor are
+        the same program output (the kernel-level degeneracy claim)."""
+        spec = random_spec(seed, workloads_per_cq=6)
+        sched, mgr, cache, _ = build_env(spec, use_solver=False)
+        snapshot = take_snapshot(cache)
+        pending = _pending_of(mgr)
+        ts = lambda wl: queue_order_timestamp(wl, mgr._ts_policy)  # noqa: E731
+        plan = plan_drain(snapshot, pending, cache.flavors, timestamp_fn=ts)
+        assert "score" in plan.queues_np
+        assert plan.queues_np["score"].dtype == np.int64
+        assert not plan.queues_np["score"].any()
+
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_cycle_first_fit_bit_for_bit(self, seed):
+        """The interactive cycle path (Scheduler use_solver=True, the
+        guard-dispatched scored kernel) with --policy first-fit equals
+        the policy-absent run — including the audit trail."""
+        spec = random_spec(seed, workloads_per_cq=6)
+
+        def run(policy):
+            sched, mgr, cache, _ = build_env(spec, use_solver=True)
+            sched.policy = policy
+            trace, final = drain_and_trace(sched, mgr, cache)
+            audit = {
+                key: [
+                    (r.outcome, r.reason.value, r.flavors, r.scores)
+                    for r in sched.audit.for_workload(key)
+                ]
+                for key in sched.audit.keys()
+            }
+            return trace, final, audit
+
+        assert run(None) == run(FF)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_mesh_first_fit_parity(self, seed):
+        from kueue_tpu.parallel import make_mesh
+
+        spec = random_spec(seed, workloads_per_cq=6)
+        base = _drain_trace(spec, policy=None, mesh=None)
+        meshed = _drain_trace(spec, policy=FF, mesh=make_mesh(4))
+        assert base[:3] == meshed[:3]
+        assert base[3].cycles == meshed[3].cycles
+
+    def test_pipeline_launch_first_fit_parity(self):
+        """The pipelined drain's launch/fetch split with the default
+        policy equals the blocking policy-absent solve (chunked shapes
+        included — the speculation surface the pipeline trusts)."""
+        spec = random_spec(1, workloads_per_cq=8)
+        sched, mgr, cache, _ = build_env(spec, use_solver=False)
+        snapshot = take_snapshot(cache)
+        pending = _pending_of(mgr)
+        ts = lambda wl: queue_order_timestamp(wl, mgr._ts_policy)  # noqa: E731
+        blocking = run_drain(
+            snapshot, pending, cache.flavors, timestamp_fn=ts, max_cycles=16
+        )
+        launched = launch_drain(
+            snapshot, pending, cache.flavors, timestamp_fn=ts,
+            max_cycles=16, policy=FF,
+        ).fetch()
+        assert {
+            (wl.name, tuple(sorted(f.items())), c)
+            for wl, _, f, c in blocking.admitted
+        } == {
+            (wl.name, tuple(sorted(f.items())), c)
+            for wl, _, f, c in launched.admitted
+        }
+        assert np.array_equal(blocking.final_usage, launched.final_usage)
+
+    @pytest.mark.parametrize("seed", [0])
+    def test_preempt_drain_first_fit_parity(self, seed):
+        """The contended (victim-search) drain under --policy
+        first-fit: the zero cost-adjust keeps the candidate panels
+        byte-identical, so decisions and evictions match exactly."""
+        from tests.test_drain import device_preempt_drain_trace, preempt_spec
+
+        spec = preempt_spec(seed)
+        base = device_preempt_drain_trace(spec)
+        scored = device_preempt_drain_trace(spec, policy=FF)
+        assert base[:3] == scored[:3]
+
+
+# ---- scored kernels (SCORED_KERNELS parity + Gavel semantics) ----
+class TestScoredKernels:
+    def test_gavel_prefers_declared_flavor(self):
+        """Gavel admits gangs to the flavor where their declared
+        throughput is best — not where they first fit."""
+        spec = _hetero_spec()
+        labels = _hetero_labels()
+        ff = _drain_trace(spec, policy=None)
+        gv = _drain_trace(spec, policy=GAVEL, labels=labels)
+        assert ff[0] and gv[0], "vacuous scenario: nothing admitted"
+        # first-fit fills the slow flavor first; gavel fills fast first
+        first_ff = ff[0]["wl-0"][0]
+        first_gv = gv[0]["wl-0"][0]
+        assert dict(first_ff)["cpu"] == "slow"
+        assert dict(first_gv)["cpu"] == "fast"
+        gavel_fast = sum(
+            1 for f, _ in gv[0].values() if dict(f)["cpu"] == "fast"
+        )
+        ff_fast = sum(
+            1 for f, _ in ff[0].values() if dict(f)["cpu"] == "fast"
+        )
+        assert gavel_fast >= ff_fast
+        assert gv[0] != ff[0]
+
+    def test_gavel_drain_device_host_bit_for_bit(self):
+        """The scored drain kernel and its numpy mirror agree on every
+        Gavel decision (the SCORED_KERNELS parity contract the guard's
+        divergence sampling relies on)."""
+        spec = _hetero_spec()
+        labels = _hetero_labels()
+        dev = _drain_trace(spec, policy=GAVEL, use_device=True, labels=labels)
+        host = _drain_trace(spec, policy=GAVEL, use_device=False, labels=labels)
+        assert dev[:3] == host[:3]
+        assert dev[3].cycles == host[3].cycles
+        assert np.array_equal(dev[3].final_usage, host[3].final_usage)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_gavel_randomized_drain_parity(self, seed):
+        """Seeded random clusters with random throughput labels: the
+        scored device drain equals the scored host mirror everywhere,
+        not just on the hand-built shape."""
+        rng = np.random.default_rng(9000 + seed)
+        spec = random_spec(seed, workloads_per_cq=6)
+        labels = {
+            w["name"]: {
+                THROUGHPUT_LABEL_PREFIX
+                + f"fl-{int(rng.integers(0, 3))}": f"{rng.uniform(0.5, 4):.2f}"
+            }
+            for w in spec["workloads"]
+            if rng.random() < 0.7
+        }
+        dev = _drain_trace(spec, policy=GAVEL, use_device=True, labels=labels)
+        host = _drain_trace(
+            spec, policy=GAVEL, use_device=False, labels=labels
+        )
+        assert dev[:3] == host[:3], f"seed {seed}: scored paths diverge"
+
+    def test_cycle_scored_device_matches_host_mirror(self):
+        """The scored cycle batch: dispatch_lowered vs the guard's
+        solve_lowered_host over a Gavel-annotated batch — bit-for-bit
+        (results_match empty), so SolverGuard divergence checks stay
+        sound under a scoring policy."""
+        from kueue_tpu.core.guard import results_match, solve_lowered_host
+        from kueue_tpu.core.solver import dispatch_lowered, lower_heads
+
+        spec = _hetero_spec()
+        sched, mgr, cache, workloads = build_env(spec, use_solver=False)
+        for name, lab in _hetero_labels().items():
+            workloads[name].labels = dict(lab)
+        snapshot = take_snapshot(cache)
+        lowered = lower_heads(snapshot, _pending_of(mgr), cache.flavors)
+        annotate_lowered(GAVEL, lowered, now=0.0)
+        assert lowered.score is not None and lowered.score.any()
+        dev = dispatch_lowered(snapshot, lowered)
+        host = solve_lowered_host(snapshot, lowered)
+        assert results_match(dev, host) == []
+        # and the scored choice is a real deviation from first-fit
+        ff_lowered = lower_heads(snapshot, _pending_of(mgr), cache.flavors)
+        ff = dispatch_lowered(snapshot, ff_lowered)
+        assert not np.array_equal(
+            np.asarray(dev.chosen), np.asarray(ff.chosen)
+        )
+
+
+# ---- deadline + prema primitives ----
+class TestDeadlineAndPrema:
+    def test_deadline_boost_monotone_and_capped(self):
+        from kueue_tpu.policy.engine import DEADLINE_BOOST_CAP, _deadline_boost
+
+        far = _deadline_boost(10_000.0, 0.0)
+        near = _deadline_boost(10.0, 0.0)
+        passed = _deadline_boost(0.0, 10.0)
+        assert 0 <= far < near < passed == DEADLINE_BOOST_CAP
+
+    def test_deadline_policy_tightens_nomination_order(self):
+        from kueue_tpu.core.solver import lower_heads
+
+        spec = _hetero_spec(n_wl=2)
+        sched, mgr, cache, workloads = build_env(spec, use_solver=False)
+        # wl-1 is younger but has an imminent deadline
+        workloads["wl-1"].labels = {DEADLINE_LABEL: "100"}
+        snapshot = take_snapshot(cache)
+        lowered = lower_heads(snapshot, _pending_of(mgr), cache.flavors)
+        base = lowered.priority.copy()
+        annotate_lowered(resolve_policy("deadline"), lowered, now=95.0)
+        idx = {wl.name: i for i, wl in enumerate(lowered.heads)}
+        assert lowered.priority[idx["wl-1"]] > base[idx["wl-1"]]
+        assert lowered.priority[idx["wl-0"]] == base[idx["wl-0"]]
+
+    def test_prema_victim_cost_adjust_prefers_more_remaining_work(self):
+        prema = resolve_policy("prema")
+        nearly_done = Workload(
+            namespace="ns", name="nearly",
+            labels={REMAINING_SECONDS_LABEL: "10"},
+        )
+        just_started = Workload(
+            namespace="ns", name="fresh",
+            labels={REMAINING_SECONDS_LABEL: "5000"},
+        )
+        unlabeled = Workload(namespace="ns", name="opaque")
+        # lower key = preferred victim
+        assert prema.victim_cost_adjust(just_started) < prema.victim_cost_adjust(
+            nearly_done
+        )
+        assert prema.victim_cost_adjust(unlabeled) == 0
+        assert FF.victim_cost_adjust(just_started) == 0
+
+    def test_preemptor_candidate_order_uses_prema_adjust(self):
+        """The host Preemptor's candidate key: under PREMA the
+        fresh (most remaining work) victim sorts first despite equal
+        priority; under the default policy order is untouched."""
+        from kueue_tpu.core.preemption import Preemptor
+        from kueue_tpu.core.snapshot import WorkloadSnapshot
+        from kueue_tpu.utils.clock import FakeClock
+
+        def ws(name, remaining=None):
+            wl = Workload(namespace="ns", name=name)
+            if remaining is not None:
+                wl.labels = {REMAINING_SECONDS_LABEL: str(remaining)}
+            return WorkloadSnapshot(
+                workload=wl, cq_name="other", cq_row=0, priority=5,
+                quota_reserved_time=1.0,
+                usage_vec=np.zeros(1, dtype=np.int64),
+            )
+
+        class Ctx:
+            cq_name = "cq"
+
+        pre = Preemptor(FakeClock(0.0))
+        a, b = ws("a", remaining=10), ws("b", remaining=5000)
+        default_order = sorted([a, b], key=pre._candidate_key(Ctx()))
+        assert [w.workload.name for w in default_order] == ["a", "b"]
+        pre.policy = resolve_policy("prema")
+        prema_order = sorted([a, b], key=pre._candidate_key(Ctx()))
+        assert [w.workload.name for w in prema_order] == ["b", "a"]
+
+
+# ---- the planner's policy scenario kind (acceptance criterion) ----
+def _hetero_runtime(n_wl=8):
+    from kueue_tpu.controllers import ClusterRuntime
+
+    rt = ClusterRuntime()
+    rt.add_flavor(ResourceFlavor(name="slow"))
+    rt.add_flavor(ResourceFlavor(name="fast"))
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq",
+            namespace_selector={},
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu",),
+                    (
+                        FlavorQuotas.build("slow", {"cpu": ("8", None, None)}),
+                        FlavorQuotas.build("fast", {"cpu": ("8", None, None)}),
+                    ),
+                ),
+            ),
+        )
+    )
+    rt.add_local_queue(
+        LocalQueue(namespace="ns", name="lq", cluster_queue="cq")
+    )
+    for i in range(n_wl):
+        rt.add_workload(
+            Workload(
+                namespace="ns",
+                name=f"wl-{i}",
+                queue_name="lq",
+                creation_time=float(i + 1),
+                labels={THROUGHPUT_LABEL_PREFIX + "fast": "4"},
+                pod_sets=(PodSet.build("main", 1, {"cpu": "4"}),),
+            )
+        )
+    return rt
+
+
+class TestPlannerPolicyScenario:
+    def test_policy_delta_wire_codec_round_trip(self):
+        from kueue_tpu.planner.scenarios import (
+            PolicyDelta,
+            delta_from_dict,
+            scenario_from_dict,
+        )
+
+        d = PolicyDelta("gavel", now=42.0)
+        d2 = delta_from_dict(d.to_dict())
+        assert (d2.kind, d2.policy, d2.now) == ("policy", "gavel", 42.0)
+        scen = scenario_from_dict(
+            {"name": "try gavel", "deltas": [{"kind": "policy",
+                                             "policy": "gavel"}]}
+        )
+        assert scen.deltas[0].policy == "gavel"
+        assert "gavel" in d.describe()
+
+    def test_policy_delta_unknown_policy_rejected(self):
+        from kueue_tpu.planner.scenarios import delta_from_dict
+        from kueue_tpu.planner.engine import Planner
+        from kueue_tpu.planner.scenarios import PlanScenario
+
+        rt = _hetero_runtime(2)
+        planner = Planner.for_runtime(rt)
+        bad = PlanScenario(
+            name="bad",
+            deltas=(delta_from_dict({"kind": "policy", "policy": "sjf"}),),
+        )
+        from kueue_tpu.planner.scenarios import ScenarioApplyError
+
+        with pytest.raises(ScenarioApplyError):
+            planner.plan(scenarios=[bad])
+
+    @pytest.mark.parametrize("use_device", [True, False])
+    def test_gavel_beats_fifo_on_makespan_and_tta(self, use_device):
+        """THE acceptance forecast: on a seeded heterogeneous trace the
+        Gavel scenario's virtual-time makespan and mean
+        time-to-admission beat the first-fit baseline — demonstrable
+        via `kueuectl plan` BEFORE the policy is enabled live."""
+        from kueue_tpu.planner.engine import Planner
+        from kueue_tpu.planner.scenarios import PlanScenario, PolicyDelta
+
+        rt = _hetero_runtime()
+        planner = Planner.for_runtime(rt)
+        report = planner.plan(
+            scenarios=[
+                PlanScenario(name="gavel", deltas=(PolicyDelta("gavel"),))
+            ],
+            forecast=True,
+            runtime_hint=lambda wl: 100.0,
+            use_device=use_device,
+            verify_host=use_device,  # device sweep == host mirror too
+        )
+        base = report.baseline.forecast
+        gavel = report.scenario("gavel").forecast
+        assert base is not None and gavel is not None
+        assert gavel.get("policy") == "gavel"
+        assert gavel["makespan"] < base["makespan"], (
+            f"gavel {gavel['makespan']}s !< fifo {base['makespan']}s"
+        )
+        assert gavel["mean"] <= base["mean"]
+
+    def test_plan_request_wire_path(self):
+        """POST /debug/plan body with a policy scenario — the server
+        wire path `kueuectl plan --policy gavel` drives."""
+        from kueue_tpu.planner.engine import plan_request
+
+        rt = _hetero_runtime()
+        body = {
+            "scenarios": [
+                {
+                    "name": "policy gavel",
+                    "deltas": [{"kind": "policy", "policy": "gavel"}],
+                }
+            ],
+            "options": {"forecast": True, "runtimeHintSeconds": 100.0},
+        }
+        report = plan_request(rt, body)
+        names = [s["name"] for s in report["scenarios"]]
+        assert "policy gavel" in names
+        gavel = next(
+            s for s in report["scenarios"] if s["name"] == "policy gavel"
+        )
+        base = report["baseline"]
+        assert gavel["forecast"]["makespan"] < base["forecast"]["makespan"]
+
+
+# ---- audit / explain / server / replica (satellite) ----
+class TestScoreBreakdownSurfaces:
+    def _scored_runtime(self):
+        from kueue_tpu.controllers import ClusterRuntime
+
+        rt = _hetero_runtime()
+        rt.scheduler.use_solver = True
+        rt.scheduler.solver_threshold = 1
+        rt.set_policy("gavel")
+        rt.run_until_idle()
+        return rt
+
+    def test_audit_records_carry_score_breakdown(self):
+        rt = self._scored_runtime()
+        rec = rt.audit.latest("ns/wl-0")
+        assert rec is not None and rec.scores is not None
+        sc = rec.scores
+        assert sc["policy"] == "gavel"
+        assert sc["perFlavor"]["fast"] > sc["perFlavor"]["slow"]
+        assert sc["winner"] == "fast"
+        assert sc["margin"] == sc["perFlavor"]["fast"] - sc["perFlavor"]["slow"]
+        # the wire dict round-trips through the replica ingest codec
+        from kueue_tpu.core.audit import DecisionRecord
+
+        back = DecisionRecord.from_dict(rec.to_dict())
+        assert back.scores == rec.scores
+
+    def test_server_decisions_endpoint_renders_scores(self):
+        from kueue_tpu.server import KueueClient, KueueServer
+
+        rt = self._scored_runtime()
+        srv = KueueServer(runtime=rt, auto_reconcile=False)
+        srv.start()
+        try:
+            client = KueueClient(f"http://127.0.0.1:{srv.port}")
+            body = client.workload_decisions("ns", "wl-0")
+            items = body.get("items", [])
+            assert items, "no decisions served"
+            sc = items[-1].get("scores")
+            assert sc and sc["policy"] == "gavel" and sc["winner"] == "fast"
+            assert client.healthz().get("policy") == "gavel"
+        finally:
+            srv.stop()
+
+    def test_explain_renders_score_breakdown(self, capsys):
+        from kueue_tpu.cli.__main__ import _render_decision_timeline
+
+        rt = self._scored_runtime()
+        rows = [r.to_dict() for r in rt.audit.for_workload("ns/wl-0")]
+        _render_decision_timeline("ns/wl-0", "ADMITTED", rows)
+        out = capsys.readouterr().out
+        assert "scores [gavel]:" in out
+        assert "winner fast" in out
+
+    def test_offline_state_replay_reproduces_scores(self):
+        """`kueuectl explain` offline mode: the checkpoint carries the
+        policy, so an in-memory replay re-derives the same scored
+        decisions the server made."""
+        from kueue_tpu import serialization as ser
+
+        rt = self._scored_runtime()
+        state = ser.runtime_to_state(rt)
+        assert state["policy"] == "gavel"
+        rt2 = ser.runtime_from_state(json.loads(json.dumps(state)))
+        assert rt2.policy.name == "gavel"
+        rt2.scheduler.use_solver = True
+        rt2.scheduler.solver_threshold = 1
+        rt2.run_until_idle()
+        keys = [k for k in rt2.audit.keys()]
+        scored = [
+            rt2.audit.latest(k)
+            for k in keys
+            if rt2.audit.latest(k) and rt2.audit.latest(k).scores
+        ]
+        assert scored, "offline replay produced no scored decisions"
+        assert all(r.scores["policy"] == "gavel" for r in scored)
+
+
+# ---- durability: journaled + replayed policy config ----
+class TestPolicyDurability:
+    def test_set_policy_journals_and_recovery_replays(self, tmp_path):
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.storage import Journal, recover
+
+        jdir = str(tmp_path / "journal")
+        rt = ClusterRuntime()
+        journal = Journal(jdir).open()
+        rt.attach_journal(journal)
+        rt.set_policy("gavel")
+        journal.close()
+        res = recover(None, jdir, runtime=ClusterRuntime(), strict=False)
+        assert res.runtime.policy.name == "gavel"
+        assert res.runtime.scheduler.policy.name == "gavel"
+        res.journal.close()
+
+    def test_apply_record_policy_config(self):
+        from kueue_tpu.controllers import ClusterRuntime
+        from kueue_tpu.storage.journal import JournalRecord
+        from kueue_tpu.storage.recovery import apply_record
+
+        rt = ClusterRuntime()
+        apply_record(
+            rt,
+            JournalRecord(
+                seq=1, rv=1, type="policy_config",
+                data={"policy": "prema"}, token=None, ts=0.0,
+            ),
+        )
+        assert rt.policy.name == "prema"
+        # unknown vocabulary from a newer binary: skipped, not a crash
+        apply_record(
+            rt,
+            JournalRecord(
+                seq=2, rv=2, type="policy_config",
+                data={"policy": "policy-from-the-future"}, token=None,
+                ts=0.0,
+            ),
+        )
+        assert rt.policy.name == "prema"
+
+    def test_policy_change_emits_event_and_metrics(self):
+        from kueue_tpu.controllers import ClusterRuntime
+
+        rt = ClusterRuntime()
+        rt.set_policy("gavel")
+        kinds = [e.kind for e in rt.events]
+        assert "PolicyConfigured" in kinds
+        text = rt.metrics.registry.expose()
+        assert 'kueue_policy_active{policy="gavel"} 1' in text
+        assert 'kueue_policy_active{policy="first-fit"} 0' in text
+
+
+# ---- FlavorAssigner: score-outranked reason (satellite fix) ----
+class TestFlavorAssignerScoreOutranked:
+    def test_enum_member_and_classifier(self):
+        assert InadmissibleReason.SCORE_OUTRANKED.value == "ScoreOutrankedFlavor"
+        reason = classify_inadmissible_message(
+            "flavor slow fits but lost on score to flavor fast under "
+            "policy gavel (1000 vs 4000)"
+        )
+        assert reason is InadmissibleReason.SCORE_OUTRANKED
+
+    def test_assigner_distinguishes_outranked_from_no_fit(self):
+        from kueue_tpu.core.flavor_assigner import FlavorAssigner, Mode
+
+        spec = _hetero_spec(n_wl=1)
+        sched, mgr, cache, workloads = build_env(spec, use_solver=False)
+        workloads["wl-0"].labels = dict(_hetero_labels(1)["wl-0"])
+        snapshot = take_snapshot(cache)
+        assigner = FlavorAssigner(snapshot, cache.flavors, policy=GAVEL)
+        result = assigner.assign(workloads["wl-0"], "cq")
+        assert result.representative_mode() == Mode.FIT
+        ps = result.pod_sets[0]
+        assert ps.flavors["cpu"].name == "fast"
+        assert any("lost on score" in r for r in ps.reasons)
+        # the default policy keeps the first-fit walk and clean reasons
+        ff_assigner = FlavorAssigner(snapshot, cache.flavors, policy=FF)
+        ff = ff_assigner.assign(workloads["wl-0"], "cq")
+        assert ff.pod_sets[0].flavors["cpu"].name == "slow"
+        assert not ff.pod_sets[0].reasons
+
+
+# ---- kueuelint: policy-name + scored-kernel registry rules ----
+POLICY_BAD = '''\
+from kueue_tpu.policy import resolve_policy
+
+def configure(rt):
+    rt.set_policy("shortest-job-first")
+    return resolve_policy("gavel")
+'''
+
+POLICY_GOOD = '''\
+from kueue_tpu.policy import resolve_policy
+
+def configure(rt):
+    rt.set_policy("gavel")
+    return resolve_policy("first-fit")
+'''
+
+
+class TestKueuelintPolicyRules:
+    def test_bad_literal_policy_name_flagged(self, tmp_path):
+        from tests.test_analysis import run_fixture
+
+        findings = run_fixture(
+            tmp_path, {"policy_fixture.py": POLICY_BAD}, ["policy-name"]
+        )
+        assert [f.rule for f in findings] == ["policy-name"]
+        assert "shortest-job-first" in findings[0].message
+
+    def test_good_literal_policy_names_clean(self, tmp_path):
+        from tests.test_analysis import run_fixture
+
+        assert not run_fixture(
+            tmp_path, {"policy_fixture.py": POLICY_GOOD}, ["policy-name"]
+        )
+
+    def test_tree_is_clean_and_call_sites_exist(self):
+        from kueue_tpu.analysis import lint
+
+        assert lint(rules=["policy-name"]) == []
+
+    def test_scored_kernel_registry_resolves(self):
+        """The extended kernel-mirrors rule: every SCORED_KERNELS entry
+        names a registered kernel module, a resolving entry point +
+        mirror, and THIS test file as its parity test."""
+        from kueue_tpu.analysis import lint
+        from kueue_tpu.ops import SCORED_KERNELS
+
+        assert SCORED_KERNELS, "scored-kernel registry is empty"
+        assert lint(rules=["kernel-mirrors"]) == []
+
+    def test_scored_kernel_rule_catches_unregistered_stem(self, tmp_path):
+        from tests.test_analysis import run_fixture
+
+        findings = run_fixture(
+            tmp_path,
+            {"ops/__init__.py": "KERNEL_MIRRORS = {}\n"},
+            ["kernel-mirrors"],
+            config={
+                "kernel_mirrors": {},
+                "sharded_kernels": {},
+                "kernel_stems": set(),
+                "scored_kernels": {
+                    "ghost_kernel:solve": (
+                        "kueue_tpu.ops.drain_np:solve_drain_np",
+                        None,
+                    )
+                },
+            },
+        )
+        assert any(
+            "not registered in KERNEL_MIRRORS" in f.message for f in findings
+        )
