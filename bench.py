@@ -1820,38 +1820,45 @@ def trace_bench(rng):
 
 def serve_bench(
     rng,
-    duration_s=4.0,
-    rate_per_s=80.0,
-    n_readers=3,
+    duration_s=3.0,
+    rate_per_s=1200.0,
+    n_writers=96,
+    n_readers=2,
     n_cq=8,
-    quota_cpu=16,
+    quota_cpu=64,
 ):
-    """Sustained arrival-stream serving A/B (the ISSUE-9 guardrail):
-    an open-loop Poisson arrival stream (perf/generator.ArrivalProcess)
-    of mixed small/medium workloads is POSTed against a live journaled
-    leader while an admission loop drains it and reader threads hammer
-    the visibility/health surface — phase A with the readers on the
-    LEADER (no replica attached), phase B with a journal-tailing READ
-    REPLICA attached and the readers moved there. Reports admission
-    throughput, decision-latency percentiles (submit -> Admitted, wall
-    clock), read QPS offloaded, max replica staleness, and the leader
-    admission-loop regression from attaching the replica. At the end
-    of phase B the drained leader and caught-up replica state dumps
-    are asserted byte-identical (the convergence acceptance check).
+    """Scaled serving-tier A/B (the gateway acceptance guardrail): an
+    open-loop Poisson arrival stream (perf/generator.ArrivalProcess) at
+    ``rate_per_s`` is POSTed by ``n_writers`` concurrent writer threads
+    against a live journaled leader whose admission runs on a dedicated
+    loop (identical in both phases, so the A/B isolates the WRITE
+    path) — phase A with the gateway OFF (every POST takes the serving
+    lock individually, contending with the admission passes), phase B
+    with the WriteGateway coalescing writes (one lock critical section
+    + one group-committed journal sync + one recorder wake per flush
+    window, per-tenant token buckets shedding with 429; the writers'
+    KueueClient honors Retry-After with capped jittered backoff). A
+    journal-tailing READ REPLICA subprocess is attached in BOTH phases
+    (identical serving surface) with reader threads on it. Reports sustained ingest
+    throughput (accepted POSTs/s over the ingest wall), POST round-trip
+    (enqueue) latency percentiles, decision latency, shed percentage,
+    read QPS offloaded, and max replica staleness; each phase's
+    drained leader and caught-up replica state dumps are asserted
+    byte-identical (the convergence acceptance check), and the A/B
+    must show >=2x sustained ingest or >=2x lower p95 enqueue latency.
 
     Host nomination path on purpose: the measured surface is serving +
-    journal + replication, and a one-off device compile landing in
-    phase A would bias the A/B. The replica runs as a SEPARATE
-    PROCESS (``python -m kueue_tpu.server --replica-of``) — the
-    production topology — so the leader pays exactly the real
-    attachment cost (serving the replication feed), not the replica's
-    own replay work."""
+    journal + replication; a one-off device compile landing in phase A
+    would bias the A/B. The replica runs as a SEPARATE PROCESS
+    (``python -m kueue_tpu.server --replica-of``) — the production
+    topology."""
     import socket
     import tempfile
     import threading
 
     from kueue_tpu import serialization as ser
     from kueue_tpu.controllers import ClusterRuntime
+    from kueue_tpu.gateway import TenantLimiter, WriteGateway
     from kueue_tpu.perf.generator import ArrivalProcess, arrival_stream
     from kueue_tpu.server import KueueServer
     from kueue_tpu.server.client import KueueClient
@@ -1881,7 +1888,7 @@ def serve_bench(
         rate_per_s=rate_per_s, duration_s=duration_s, process="poisson"
     )
 
-    def run_phase(with_replica: bool, phase_rng) -> dict:
+    def run_phase(batching: bool, phase_rng) -> dict:
         tmp = tempfile.mkdtemp(prefix="kueue-serve-")
         rt = ClusterRuntime(use_solver=False, bulk_drain_threshold=None)
         journal = Journal(os.path.join(tmp, "journal")).open()
@@ -1897,65 +1904,128 @@ def serve_bench(
             )
             rt.add_local_queue(lq)
             lq_names.append(lq.name)
-        srv = KueueServer(runtime=rt, auto_reconcile=False)
+        gateway = None
+        if batching:
+            # tenant budget: 2x each LocalQueue's balanced share of the
+            # stream — a Poisson burst can trip it (shed + client
+            # retry-after backoff engage), steady traffic flows.
+            # reconcile=False: admission cadence is the dedicated loop
+            # below in BOTH phases, so the A/B isolates the WRITE path
+            # (per-request serving-lock acquisition + journal fsync +
+            # recorder wake vs one of each per flush window)
+            gateway = WriteGateway(
+                flush_interval_s=0.002,
+                max_batch=1024,
+                max_queue=8192,
+                reconcile=False,
+                limiter=TenantLimiter(
+                    2.0 * rate_per_s / n_cq,
+                    burst=2.0 * rate_per_s / n_cq,
+                ),
+            )
+        srv = KueueServer(runtime=rt, auto_reconcile=False, gateway=gateway)
         port = srv.start()
         leader_url = f"http://127.0.0.1:{port}"
-        rep_proc = None
-        read_url = leader_url
-        if with_replica:
-            with socket.socket() as s:  # pre-pick a free port
-                s.bind(("127.0.0.1", 0))
-                rport = s.getsockname()[1]
-            env = dict(os.environ)
-            env["JAX_PLATFORMS"] = "cpu"
-            rep_proc = subprocess.Popen(
-                [
-                    sys.executable, "-m", "kueue_tpu.server",
-                    "--replica-of", leader_url,
-                    "--port", str(rport),
-                    "--replica-poll-interval", "0.05",
-                    "--replica-id", "bench-replica",
-                ],
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-                env=env,
-                stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL,
-            )
-            read_url = f"http://127.0.0.1:{rport}"
-            probe = KueueClient(read_url, timeout=2.0)
-            deadline = time.perf_counter() + 60.0
-            while time.perf_counter() < deadline:
-                try:
-                    if not probe.healthz().get("replication", {}).get(
-                        "lastError"
-                    ):
-                        break
-                except Exception:  # noqa: BLE001 — still booting
-                    pass
-                time.sleep(0.2)
-            else:
-                rep_proc.kill()
-                raise RuntimeError("replica subprocess never became healthy")
+        with socket.socket() as s:  # pre-pick a free port
+            s.bind(("127.0.0.1", 0))
+            rport = s.getsockname()[1]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        rep_proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "kueue_tpu.server",
+                "--replica-of", leader_url,
+                "--port", str(rport),
+                "--replica-poll-interval", "0.05",
+                "--replica-id", "bench-replica",
+            ],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        read_url = f"http://127.0.0.1:{rport}"
+        probe = KueueClient(read_url, timeout=2.0)
+        deadline = time.perf_counter() + 60.0
+        while time.perf_counter() < deadline:
+            try:
+                if not probe.healthz().get("replication", {}).get(
+                    "lastError"
+                ):
+                    break
+            except Exception:  # noqa: BLE001 — still booting
+                pass
+            time.sleep(0.2)
+        else:
+            rep_proc.kill()
+            raise RuntimeError("replica subprocess never became healthy")
 
         stream = arrival_stream(proc, lq_names, phase_rng)
         stop = threading.Event()
+        stats_lock = threading.Lock()
         submit_ts: dict = {}
-        admit_lat: list = []
-        cycle_times: list = []
+        post_lat: list = []  # POST round trip (the enqueue latency)
+        admit_lat: list = []  # submit -> Admitted (decision latency)
         due: dict = {}  # key -> wall time its service completes
         seen_admitted: set = set()
+        accepted = [0]
+        post_failures = [0]
+        throttled = [0]
         reads = [0] * n_readers
         read_errors = [0]
         max_lag = [0.0]
-
         rep_status: dict = {}
+        next_arrival = [0]
+        t_start = time.perf_counter()
 
-        def admission_loop():
-            while not stop.is_set():
+        def writer_loop():
+            # shed writes retry with capped jittered Retry-After
+            # backoff (the KueueClient 429 contract)
+            client = KueueClient(
+                leader_url, timeout=30.0, max_429_retries=8,
+                backoff_base_s=0.02, backoff_cap_s=0.5,
+            )
+            while True:
+                with stats_lock:
+                    i = next_arrival[0]
+                    next_arrival[0] += 1
+                if i >= len(stream):
+                    break
+                gw = stream[i]
+                delay = gw.creation_s - (time.perf_counter() - t_start)
+                if delay > 0:
+                    time.sleep(delay)
+                d = ser.workload_to_dict(gw.workload)
+                d.setdefault("labels", {})["bench/runtime-s"] = str(
+                    gw.runtime_s
+                )
+                key = f"perf/{gw.workload.name}"
                 t0 = time.perf_counter()
+                submit_ts[key] = t0
+                try:
+                    client.apply("workloads", d)
+                except Exception:  # noqa: BLE001 — a write the backoff
+                    # could not land (shed past the retry budget)
+                    with stats_lock:
+                        post_failures[0] += 1
+                    submit_ts.pop(key, None)
+                    continue
+                lat = time.perf_counter() - t0
+                with stats_lock:
+                    accepted[0] += 1
+                    post_lat.append(lat)
+            with stats_lock:
+                throttled[0] += client.throttled_total
+
+        def completion_loop():
+            # the admission loop (identical in both phases — the A/B
+            # measures the WRITE path): one run_until_idle pass, then
+            # decision-latency tracking + service completion (finished
+            # workloads release quota)
+            while not stop.is_set():
+                now = time.perf_counter()
                 with srv.lock:
                     srv.runtime.run_until_idle()
-                    now = time.perf_counter()
                     for key, wl in list(srv.runtime.workloads.items()):
                         if wl.is_admitted and key not in seen_admitted:
                             seen_admitted.add(key)
@@ -1965,16 +2035,13 @@ def serve_bench(
                                 wl.labels.get("bench/runtime-s", 0.2)
                                 if wl.labels else 0.2
                             )
-                    # service completion: finished workloads release
-                    # their quota (mixed arrival/FINISH/query traffic)
                     for key, t_done in list(due.items()):
                         if now >= t_done:
                             wl = srv.runtime.workloads.get(key)
                             if wl is not None:
                                 srv.runtime.delete_workload(wl)
                             due.pop(key, None)
-                cycle_times.append(time.perf_counter() - t0)
-                stop.wait(0.01)
+                stop.wait(0.005)
 
         def lag_sampler():
             client = KueueClient(read_url, timeout=2.0)
@@ -2003,111 +2070,128 @@ def serve_bench(
                     read_errors[0] += 1
                 i += 1
 
-        threads = [threading.Thread(target=admission_loop, daemon=True)]
-        threads += [
+        writers = [
+            threading.Thread(target=writer_loop, daemon=True)
+            for _ in range(n_writers)
+        ]
+        aux = [threading.Thread(target=completion_loop, daemon=True)]
+        aux += [
             threading.Thread(target=reader_loop, args=(i,), daemon=True)
             for i in range(n_readers)
         ]
-        if rep_proc is not None:
-            threads.append(
-                threading.Thread(target=lag_sampler, daemon=True)
-            )
-        for t in threads:
+        aux.append(threading.Thread(target=lag_sampler, daemon=True))
+        for t in writers + aux:
             t.start()
-        writer = KueueClient(leader_url, timeout=10.0)
-        t_start = time.perf_counter()
-        for gw in stream:
-            delay = gw.creation_s - (time.perf_counter() - t_start)
-            if delay > 0:
-                time.sleep(delay)
-            d = ser.workload_to_dict(gw.workload)
-            d.setdefault("labels", {})["bench/runtime-s"] = str(
-                gw.runtime_s
-            )
-            submit_ts[f"perf/{gw.workload.name}"] = time.perf_counter()
-            writer.apply("workloads", d)
-        wall = time.perf_counter() - t_start
-        # drain the tail: stop arrivals, let admission finish the rest
+        for t in writers:
+            t.join(timeout=300)
+        wall_ingest = time.perf_counter() - t_start
+        # drain the tail: stop arrivals, admit everything accepted
         deadline = time.perf_counter() + 30.0
         while time.perf_counter() < deadline:
             with srv.lock:
+                srv.runtime.run_until_idle()
                 backlog = sum(
                     1
                     for wl in srv.runtime.workloads.values()
                     if not wl.is_admitted
                 )
-            if backlog == 0:
+            if backlog == 0 and len(seen_admitted) >= accepted[0]:
                 break
-            time.sleep(0.05)
+            time.sleep(0.02)
         stop.set()
-        for t in threads:
+        for t in aux:
             t.join(timeout=10)
-        converged = None
-        records_applied = None
-        if rep_proc is not None:
-            # quiescent convergence: replica caught up to the leader's
-            # journal head serves a byte-identical state dump
-            probe = KueueClient(read_url, timeout=5.0)
-            deadline = time.perf_counter() + 15.0
-            while time.perf_counter() < deadline:
-                try:
-                    detail = probe.healthz().get("replication", {})
-                    if detail.get("appliedSeq", -1) >= journal.last_seq:
-                        rep_status.update(detail)
-                        break
-                except Exception:  # noqa: BLE001 — keep waiting
-                    pass
-                time.sleep(0.1)
-            records_applied = rep_status.get("recordsApplied")
-            leader_state = json.dumps(
-                KueueClient(leader_url).state(), sort_keys=True
-            )
-            replica_state = json.dumps(probe.state(), sort_keys=True)
-            converged = leader_state == replica_state
-            rep_proc.terminate()
-            rep_proc.wait(timeout=15)
+        gw_stats = gateway.status() if gateway is not None else {}
+        shed_total = sum(gw_stats.get("shed", {}).values())
+        # quiescent convergence: replica caught up to the leader's
+        # journal head serves a byte-identical state dump
+        probe = KueueClient(read_url, timeout=5.0)
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline:
+            try:
+                detail = probe.healthz().get("replication", {})
+                if detail.get("appliedSeq", -1) >= journal.last_seq:
+                    rep_status.update(detail)
+                    break
+            except Exception:  # noqa: BLE001 — keep waiting
+                pass
+            time.sleep(0.1)
+        records_applied = rep_status.get("recordsApplied")
+        leader_state = json.dumps(
+            KueueClient(leader_url).state(), sort_keys=True
+        )
+        replica_state = json.dumps(probe.state(), sort_keys=True)
+        converged = leader_state == replica_state
+        rep_proc.terminate()
+        rep_proc.wait(timeout=15)
         srv.stop()
         journal.close()
-        lat_ms = sorted(x * 1e3 for x in admit_lat)
 
-        def pct(p):
-            if not lat_ms:
+        def pct(samples, p):
+            vals = sorted(x * 1e3 for x in samples)
+            if not vals:
                 return None
-            return round(lat_ms[min(len(lat_ms) - 1,
-                                    int(p * len(lat_ms)))], 3)
+            return round(vals[min(len(vals) - 1, int(p * len(vals)))], 3)
 
+        attempts = accepted[0] + shed_total
         return {
             "submitted": len(stream),
+            "accepted": accepted[0],
+            "post_failures": post_failures[0],
             "admitted": len(seen_admitted),
-            "admissions_per_s": round(len(seen_admitted) / wall, 1),
-            "lat_p50_ms": pct(0.50),
-            "lat_p95_ms": pct(0.95),
-            "cycle_ms": round(
-                float(np.median(cycle_times)) * 1e3, 3
-            ) if cycle_times else None,
-            "read_qps": round(sum(reads) / wall, 1),
+            "ingest_per_s": round(accepted[0] / max(wall_ingest, 1e-9), 1),
+            "ingest_wall_s": round(wall_ingest, 3),
+            "enqueue_p50_ms": pct(post_lat, 0.50),
+            "enqueue_p95_ms": pct(post_lat, 0.95),
+            "decision_p50_ms": pct(admit_lat, 0.50),
+            "decision_p95_ms": pct(admit_lat, 0.95),
+            "shed_429s": shed_total,
+            "client_throttled": throttled[0],
+            "shed_pct": round(
+                100.0 * shed_total / attempts, 2
+            ) if attempts else 0.0,
+            "gateway": {
+                k: gw_stats.get(k)
+                for k in ("batches", "lastBatch", "maxBatchSeen",
+                          "applied", "shed")
+            } if gw_stats else None,
+            "read_qps": round(sum(reads) / max(wall_ingest, 1e-9), 1),
             "read_errors": read_errors[0],
-            "max_lag_s": (
-                round(max_lag[0], 3) if rep_proc is not None else None
-            ),
+            "max_lag_s": round(max_lag[0], 3),
             "records_applied": records_applied,
             "converged": converged,
         }
 
-    _stage("serve: phase A (no replica, readers on leader)")
+    _stage("serve: phase A (gateway off — per-request serial ingest)")
     base = run_phase(False, np.random.default_rng(rng.integers(1 << 30)))
-    _stage("serve: phase B (replica attached, readers on replica)")
-    with_rep = run_phase(True, np.random.default_rng(rng.integers(1 << 30)))
-    assert with_rep["converged"], (
-        "replica state dump != leader state dump at quiescence"
+    _stage("serve: phase B (gateway on — coalesced batched ingest)")
+    batched = run_phase(True, np.random.default_rng(rng.integers(1 << 30)))
+    for name, phase in (("A", base), ("B", batched)):
+        assert phase["converged"], (
+            f"serve phase {name}: replica state dump != leader state "
+            "dump at quiescence"
+        )
+        assert phase["max_lag_s"] < 2.0, (
+            f"serve phase {name}: replica staleness {phase['max_lag_s']}s "
+            "exceeds the 2s bound"
+        )
+        assert phase["admitted"] == phase["accepted"], (
+            f"serve phase {name} did not drain to quiescence "
+            f"({phase['admitted']} admitted of {phase['accepted']})"
+        )
+    ingest_ratio = batched["ingest_per_s"] / max(base["ingest_per_s"], 1e-9)
+    p95_ratio = (
+        base["enqueue_p95_ms"] / max(batched["enqueue_p95_ms"], 1e-9)
+        if base["enqueue_p95_ms"] and batched["enqueue_p95_ms"]
+        else 0.0
     )
-    assert with_rep["max_lag_s"] is not None and with_rep["max_lag_s"] < 2.0, (
-        f"replica staleness {with_rep['max_lag_s']}s exceeds the 2s bound"
+    assert ingest_ratio >= 2.0 or p95_ratio >= 2.0, (
+        f"gateway batching A/B below the 2x acceptance bar: ingest "
+        f"{batched['ingest_per_s']} vs {base['ingest_per_s']}/s "
+        f"({ingest_ratio:.2f}x), enqueue p95 {batched['enqueue_p95_ms']} "
+        f"vs {base['enqueue_p95_ms']} ms ({p95_ratio:.2f}x)"
     )
-    assert with_rep["admitted"] == with_rep["submitted"], (
-        "serve phase B did not drain to quiescence"
-    )
-    return base, with_rep
+    return base, batched
 
 
 def policy_drain_bench(rng, n_cq=48, wl_per_cq=64, reps=6, hint_s=600.0):
@@ -2289,45 +2373,62 @@ def policy_drain_bench(rng, n_cq=48, wl_per_cq=64, reps=6, hint_s=600.0):
 
 
 def _stage_serve() -> dict:
-    base, with_rep = serve_bench(np.random.default_rng(14))
-    reg_pct = (
-        (with_rep["cycle_ms"] / base["cycle_ms"] - 1.0) * 100.0
-        if base["cycle_ms"] else 0.0
+    base, batched = serve_bench(np.random.default_rng(14))
+    ingest_ratio = (
+        batched["ingest_per_s"] / max(base["ingest_per_s"], 1e-9)
+    )
+    p95_ratio = (
+        base["enqueue_p95_ms"] / max(batched["enqueue_p95_ms"], 1e-9)
+        if base["enqueue_p95_ms"] and batched["enqueue_p95_ms"]
+        else None
     )
     return {
         "serve_metric": (
-            "sustained_arrival_stream_serving (open-loop Poisson "
-            "arrivals at 80/s of mixed 1/5-cpu workloads against a "
-            "journaled leader + admission loop, 3 reader threads on "
-            "visibility/healthz; phase A readers on the leader, phase "
-            "B a journal-tailing read replica attached and the readers "
-            "moved there; leader+replica state dumps asserted "
-            "byte-identical at quiescence; "
-            f"{with_rep['admitted']} admitted in phase B)"
+            "gateway_batched_ingest_ab (open-loop Poisson arrivals at "
+            "1200/s of mixed 1/5-cpu workloads POSTed by 96 concurrent "
+            "writers against a journaled leader; phase "
+            "A per-request serial ingest, phase B WriteGateway "
+            "coalescing [one lock section + group-committed journal "
+            "sync + one recorder wake per 2ms flush window, per-tenant "
+            "token buckets shedding 429, writers honoring Retry-After; "
+            "identical dedicated admission loop in both phases]; a "
+            "journal-tailing read replica subprocess "
+            "attached in BOTH phases with 2 reader threads; "
+            "leader+replica state dumps asserted byte-identical at "
+            "quiescence per phase; >=2x ingest-or-p95 asserted; "
+            f"{batched['admitted']} admitted in phase B)"
         ),
-        # headline: median submit->Admitted decision latency with the
-        # replica attached — the number "serving heavy traffic" feels
-        "serve_value": with_rep["lat_p50_ms"],
-        "serve_unit": "ms (p50 decision latency, replica attached)",
-        "serve_admissions_per_s": with_rep["admissions_per_s"],
-        "serve_lat_p95_ms": with_rep["lat_p95_ms"],
-        "serve_read_qps": with_rep["read_qps"],
-        "serve_reads_offloaded_per_s": with_rep["read_qps"],
-        "serve_max_lag_s": with_rep["max_lag_s"],
-        "serve_records_applied": with_rep["records_applied"],
-        "serve_cycle_ms": with_rep["cycle_ms"],
-        "serve_cycle_ms_no_replica": base["cycle_ms"],
-        # honest caveat: the replica runs as a second PROCESS; on a
-        # box with few cores it competes with the leader for CPU, so
-        # this regression number bounds feed-serving overhead only on
-        # multi-core hosts (production topology: separate machines)
-        "serve_cycle_regression_pct": round(reg_pct, 1),
+        # headline: sustained accepted-write throughput with batching
+        # on — the number "serving heavy traffic" is gated on
+        "serve_value": batched["ingest_per_s"],
+        "serve_unit": "workloads/s (sustained ingest, gateway batching)",
+        "serve_ingest_per_s": batched["ingest_per_s"],
+        "serve_shed_pct": batched["shed_pct"],
+        "serve_ingest_speedup": round(ingest_ratio, 2),
+        "serve_enqueue_p50_ms": batched["enqueue_p50_ms"],
+        "serve_enqueue_p95_ms": batched["enqueue_p95_ms"],
+        "serve_enqueue_p95_speedup": (
+            round(p95_ratio, 2) if p95_ratio is not None else None
+        ),
+        "serve_decision_p50_ms": batched["decision_p50_ms"],
+        "serve_decision_p95_ms": batched["decision_p95_ms"],
+        "serve_admissions_per_s": batched["ingest_per_s"],
+        "serve_gateway": batched["gateway"],
+        "serve_accepted": batched["accepted"],
+        "serve_submitted": batched["submitted"],
+        "serve_post_failures": batched["post_failures"],
+        "serve_client_throttled": batched["client_throttled"],
+        "serve_read_qps": batched["read_qps"],
+        "serve_reads_offloaded_per_s": batched["read_qps"],
+        "serve_max_lag_s": batched["max_lag_s"],
+        "serve_records_applied": batched["records_applied"],
         "serve_host_cores": os.cpu_count(),
-        "serve_read_errors": with_rep["read_errors"],
+        "serve_read_errors": batched["read_errors"],
         "serve_baseline": {
-            "admissions_per_s": base["admissions_per_s"],
-            "lat_p50_ms": base["lat_p50_ms"],
-            "lat_p95_ms": base["lat_p95_ms"],
+            "ingest_per_s": base["ingest_per_s"],
+            "enqueue_p50_ms": base["enqueue_p50_ms"],
+            "enqueue_p95_ms": base["enqueue_p95_ms"],
+            "decision_p50_ms": base["decision_p50_ms"],
             "read_qps": base["read_qps"],
         },
     }
@@ -2863,6 +2964,8 @@ COMPACT_EXTRAS = (
     ("sharded_n_devices", "n_devices"),
     ("sharded_speedup", "sharded_speedup"),
     ("serve_admissions_per_s", "admissions_per_s"),
+    ("serve_ingest_per_s", "ingest_per_s"),
+    ("serve_shed_pct", "shed_pct"),
     ("serve_read_qps", "read_qps"),
     ("serve_max_lag_s", "max_lag_s"),
     ("trace_overhead_pct", "trace_overhead_pct"),

@@ -155,10 +155,22 @@ class HTTPTransport(RemoteTransport):
 
         if not wls:
             return
-        self._wrap(
+        out = self._wrap(
             self.client.apply_batch,
             {"workloads": [ser.workload_to_dict(w) for w in wls]},
         )
+        # partial-failure batches: the server now lands the good
+        # objects and reports rejections per section instead of
+        # failing the whole request — surface the rejection the way a
+        # single create's webhook 4xx would (the dispatcher treats it
+        # as RemoteRejected while the applied copies proceed)
+        if out and isinstance(out, dict):
+            rejected = out.get("rejected") or {}
+            if sum(rejected.values()):
+                raise RemoteRejected(
+                    out.get("firstError")
+                    or f"remote rejected {sum(rejected.values())} of the batch"
+                )
 
     def delete_workload(self, key: str) -> None:
         ns, _, name = key.partition("/")

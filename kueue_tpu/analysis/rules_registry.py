@@ -306,11 +306,19 @@ class MetricsFamiliesRule(Rule):
         "metric family names must be kueue_-prefixed, grammar-valid "
         "and unique, with non-empty HELP (static half of the "
         "exposition lint; the runtime grammar/histogram invariants "
-        "stay in tests/test_observability.py)"
+        "stay in tests/test_observability.py); families under the "
+        "exposed-at-zero prefixes (kueue_gateway_*, kueue_slo_*) must "
+        "be materialized at zero in their defining module"
     )
 
     _FAMILY_GRAMMAR = re.compile(r"^[a-z][a-z0-9_]*$")
     _FACTORIES = {"counter", "gauge", "histogram"}
+    # serving-tier families are scrape-surface contracts: dashboards
+    # and burn-rate alerts must see the whole family at zero before the
+    # first request/admission, so their defining module must call
+    # inc/set/touch on each one (the materialize-at-zero idiom)
+    _ZERO_PREFIXES = ("kueue_gateway_", "kueue_slo_")
+    _ZERO_CALLS = {"inc", "set", "touch"}
 
     def _resolve_name(
         self, node: ast.AST, consts: Dict[str, str]
@@ -394,7 +402,72 @@ class MetricsFamiliesRule(Rule):
                         "string",
                     )
                 )
+        findings.extend(self._zero_exposure(src, ctx, consts))
         return findings
+
+    def _zero_exposure(
+        self, src: SourceFile, ctx: AnalysisContext, consts: Dict[str, str]
+    ) -> List[Finding]:
+        """Families under the exposed-at-zero prefixes must have an
+        ``self.<attr>.inc/set/touch(...)`` call in the module that
+        registers them — the static proxy for "the scrape surface is
+        complete before the first observation"."""
+        prefixes = tuple(
+            ctx.config.get("metrics_zero_prefixes", self._ZERO_PREFIXES)
+        )
+        if not prefixes:
+            return []
+        # self.<attr> = r.counter("<name>", ...) assignments
+        by_attr: Dict[str, Tuple[str, int]] = {}
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                continue
+            call = node.value
+            if not (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in self._FACTORIES
+                and call.args
+            ):
+                continue
+            name = self._resolve_name(call.args[0], consts)
+            if name is not None and name.startswith(prefixes):
+                by_attr[tgt.attr] = (name, node.lineno)
+        if not by_attr:
+            return []
+        materialized = set()
+        for node in ast.walk(src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._ZERO_CALLS
+            ):
+                continue
+            v = node.func.value
+            if (
+                isinstance(v, ast.Attribute)
+                and isinstance(v.value, ast.Name)
+                and v.value.id == "self"
+            ):
+                materialized.add(v.attr)
+        return [
+            Finding(
+                self.name, src.rel, lineno,
+                f"metric family {name!r} is not materialized at zero "
+                f"(no self.{attr}.inc/set/touch call in this module — "
+                "the scrape surface must be complete before the first "
+                "observation)",
+            )
+            for attr, (name, lineno) in sorted(by_attr.items())
+            if attr not in materialized
+        ]
 
 
 # ---- kernel-mirrors ----

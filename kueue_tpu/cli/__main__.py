@@ -741,8 +741,12 @@ def cmd_replicas(state: State, args) -> None:
         rows = [
             [
                 s.get("id", ""),
+                str(s.get("hop", 1)),
                 str(s.get("appliedSeq", 0)),
                 f"{s.get('lagSeconds', 0.0):.3f}s",
+                "/".join(
+                    f"{x:.3f}" for x in s.get("pathLagSeconds", [])
+                ) or "-",
                 str(s.get("resyncs", 0)),
                 str(s.get("recordsApplied", 0)),
                 s.get("lastError", "") or "-",
@@ -750,15 +754,35 @@ def cmd_replicas(state: State, args) -> None:
             for s in out.get("items", [])
         ]
         _print_table(
-            ["ID", "APPLIED-SEQ", "LAG", "RESYNCS", "RECORDS", "LAST-ERROR"],
+            ["ID", "HOP", "APPLIED-SEQ", "LAG", "PATH-LAG", "RESYNCS",
+             "RECORDS", "LAST-ERROR"],
             rows,
         )
         print(f"(replica of {out['items'][0].get('leader', '?')})"
               if out.get("items") else "(replica)")
+        children = out.get("children") or []
+        if children:
+            print()
+            print("downstream replicas tailing this node:")
+            _print_table(
+                ["ID", "HOP", "APPLIED-SEQ", "BEHIND", "LAG", "LAST-POLL"],
+                [
+                    [
+                        r.get("id", ""),
+                        str(r.get("hop", "?")),
+                        str(r.get("appliedSeq", 0)),
+                        str(r.get("behind", 0)),
+                        f"{r.get('lagSeconds', 0.0):.3f}s",
+                        f"{r.get('lastSeenAgoS', 0.0):.1f}s ago",
+                    ]
+                    for r in children
+                ],
+            )
         return
     rows = [
         [
             r.get("id", ""),
+            str(r.get("hop", 1)),
             str(r.get("appliedSeq", 0)),
             str(r.get("behind", 0)),
             f"{r.get('lagSeconds', 0.0):.3f}s",
@@ -767,9 +791,65 @@ def cmd_replicas(state: State, args) -> None:
         for r in out.get("items", [])
     ]
     _print_table(
-        ["ID", "APPLIED-SEQ", "BEHIND", "LAG", "LAST-POLL"], rows
+        ["ID", "HOP", "APPLIED-SEQ", "BEHIND", "LAG", "LAST-POLL"], rows
     )
     print(f"leader journal head: seq {out.get('lastSeq', 0)}")
+
+
+def cmd_slo(state: State, args) -> None:
+    """`kueuectl slo` — admission-SLO standings: per-ClusterQueue p95
+    queue-to-admission target, attainment ratio and error-budget burn
+    rate (the kueue_slo_* family, rendered)."""
+    if not getattr(args, "server", None):
+        raise SystemExit(
+            "error: `kueuectl slo` reads a live control plane; "
+            "pass --server http://<leader>"
+        )
+    client = _server_client(args)
+    out = client.slo()
+    _replica_note(client)
+    if not out.get("enabled"):
+        print(
+            "SLO tracking is not configured on this control plane "
+            "(start the server with --slo-target-p95 / --slo-target)"
+        )
+        return
+    rows = []
+    for e in out.get("clusterQueues", []):
+        burn = e.get("burnRate", 0.0)
+        if e.get("degraded"):
+            status = "BURNING"
+        elif e.get("burningSinceS") is not None:
+            status = "burning"
+        else:
+            status = "ok"
+        rows.append(
+            [
+                e.get("clusterQueue", ""),
+                f"{e.get('targetSeconds', 0.0):g}s",
+                f"{e.get('attainment', 1.0) * 100:.2f}%",
+                f"{out.get('objective', 0.95) * 100:g}%",
+                f"{burn:.2f}x",
+                str(e.get("admitted", 0)),
+                status,
+            ]
+        )
+    _print_table(
+        ["CLUSTERQUEUE", "TARGET-P95", "ATTAINMENT", "OBJECTIVE",
+         "BURN", "ADMITTED", "STATUS"],
+        rows,
+    )
+    if not rows:
+        print(
+            "(no admissions observed yet for any targeted ClusterQueue)"
+        )
+    window = out.get("burnWindowSeconds", 0)
+    print(
+        f"burn window {window:g}s; threshold "
+        f"{out.get('burnThreshold', 0):g}x sustained "
+        f"{out.get('sustainSeconds', 0):g}s -> degraded"
+        + ("  ** DEGRADED **" if out.get("degraded") else "")
+    )
 
 
 # ---- plan (the what-if capacity planner) ----
@@ -1480,6 +1560,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_server_flags(repl, "leader (or replica) to query (required)")
     repl.set_defaults(fn=cmd_replicas)
+
+    slo = sub.add_parser(
+        "slo",
+        help="admission-SLO standings: per-ClusterQueue p95 "
+        "queue-to-admission target, attainment ratio and error-budget "
+        "burn rate (kueue_slo_* rendered)",
+    )
+    _add_server_flags(slo, "control plane to query (required)")
+    slo.set_defaults(fn=cmd_slo)
 
     pl = sub.add_parser(
         "plan",

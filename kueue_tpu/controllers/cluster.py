@@ -117,6 +117,14 @@ class ClusterRuntime:
         self.audit = DecisionAuditLog(clock=self.clock)
         self.audit.tracer = self.tracer
         self.audit.observers.append(self._record_decision_metric)
+        # admission SLOs (kueue_tpu/gateway/slo.py): attainment +
+        # error-budget burn over the lifecycle tracer's
+        # queue-to-admission histogram. Passive until targets are
+        # configured (server --slo-target-p95 / --slo-target); served
+        # at /apis/kueue/v1beta1/slo, /healthz and `kueuectl slo`
+        from kueue_tpu.gateway.slo import SLOTracker
+
+        self.slo = SLOTracker(self.metrics, clock=self.clock)
         # Durable-state spine (kueue_tpu/storage): when a Journal is
         # attached (attach_journal), every state mutation appends a
         # record stamped with this monotone resourceVersion, and

@@ -29,6 +29,7 @@ tests asserting on ``runtime.events``) read it unchanged.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time as _time
 from dataclasses import dataclass
@@ -91,6 +92,40 @@ class EventRecorder:
         # resume below it has a gap the recorder can no longer fill
         self._evicted_rv = 0
         self._cond = threading.Condition()
+        # wake coalescing (kueue_tpu/gateway): while held > 0, records
+        # mark pending instead of notifying — the coalesce() exit fires
+        # ONE notify_all for the whole window. `wakes` counts actual
+        # notify_all invocations (the exactly-once-per-flush test reads
+        # it); waiters are condition-based re-checks with a bounded
+        # wait, so a deferred wake can never lose an event.
+        self._held = 0
+        self._pending_wake = False
+        self.wakes = 0
+
+    def _notify_locked(self) -> None:  # kueuelint: holds=_cond
+        if self._held > 0:
+            self._pending_wake = True
+            return
+        self.wakes += 1
+        self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def coalesce(self):
+        """Defer watcher wake-ups: everything recorded (or ingested)
+        inside the context produces ONE notify_all at exit — the
+        gateway wraps each flush window in this so N batched appends
+        wake blocked watch/SSE waiters exactly once."""
+        with self._cond:
+            self._held += 1
+        try:
+            yield self
+        finally:
+            with self._cond:
+                self._held -= 1
+                if self._held == 0 and self._pending_wake:
+                    self._pending_wake = False
+                    self.wakes += 1
+                    self._cond.notify_all()
 
     # ---- recording ----
     def _now(self) -> float:
@@ -139,7 +174,7 @@ class EventRecorder:
                             old.message)
                     if self._series.get(okey) is old:
                         del self._series[okey]
-            self._cond.notify_all()
+            self._notify_locked()
             return ev
 
     def ingest(self, item: dict) -> Optional[Event]:
@@ -195,7 +230,7 @@ class EventRecorder:
                             old.message)
                     if self._series.get(okey) is old:
                         del self._series[okey]
-            self._cond.notify_all()
+            self._notify_locked()
             return ev
 
     def kick(self) -> None:
@@ -204,7 +239,7 @@ class EventRecorder:
         blocked watch/SSE waiters re-evaluate immediately instead of
         rediscovering state at their next bounded-wait tick."""
         with self._cond:
-            self._cond.notify_all()
+            self._notify_locked()
 
     def note_gap(self, rv: int) -> None:
         """Replication gap marker: the upstream feed could not fill
@@ -216,7 +251,7 @@ class EventRecorder:
                 self._evicted_rv = rv
             if rv > self._rv:
                 self._rv = rv
-            self._cond.notify_all()
+            self._notify_locked()
 
     # ---- read / watch ----
     @property

@@ -113,13 +113,48 @@ def dump(runtime) -> str:
     rep = replication_section(runtime)
     lines.append("-- replication (journal-tailing read replicas) --")
     lines.append(
-        f"role={rep.get('role')} appliedSeq={rep.get('appliedSeq', 0)} "
+        f"role={rep.get('role')} hop={rep.get('hop', 0)} "
+        f"appliedSeq={rep.get('appliedSeq', 0)} "
         f"lagSeconds={rep.get('lagSeconds', 0.0)} "
+        f"pathLag={rep.get('pathLagSeconds', [])} "
         f"recordsApplied={rep.get('recordsApplied', 0)} "
         f"resyncs={rep.get('resyncs', 0)}"
     )
     if rep.get("lastError"):
         lines.append(f"lastError: {rep['lastError']}")
+    # gateway posture (kueue_tpu/gateway): write-path batching queue +
+    # shed accounting — a saturated ingest path is triagable from the
+    # signal dump alone
+    gw = getattr(runtime, "gateway", None)
+    if gw is not None:
+        g = gw.status()
+        lines.append("-- gateway (write-path batching) --")
+        lines.append(
+            f"queueDepth={g['queueDepth']}/{g['maxQueue']} "
+            f"batches={g['batches']} applied={g['applied']} "
+            f"rejected={g['rejected']} lastBatch={g['lastBatch']} "
+            f"maxBatchSeen={g['maxBatchSeen']} "
+            f"flushIntervalS={g['flushIntervalS']} shed={g['shed']}"
+        )
+    # admission-SLO posture (kueue_tpu/gateway/slo.py): attainment +
+    # burn per targeted CQ
+    slo = getattr(runtime, "slo", None)
+    if slo is not None and slo.enabled:
+        slo.maybe_refresh()
+        s = slo.report()
+        lines.append("-- admission SLOs (kueue_slo_*) --")
+        lines.append(
+            f"objective={s['objective']} degraded={s['degraded']} "
+            f"burnWindowS={s['burnWindowSeconds']} "
+            f"burnThreshold={s['burnThreshold']}"
+        )
+        for e in s["clusterQueues"]:
+            lines.append(
+                f"  {e['clusterQueue']}: target={e['targetSeconds']}s "
+                f"attainment={e['attainment']} burn={e['burnRate']}x "
+                f"admitted={e['admitted']}"
+                + (" DEGRADED" if e["degraded"] else "")
+            )
     # tracing posture (kueue_tpu/tracing): store occupancy + the most
     # recent cycle span tree — a hung server's last-cycle time
     # attribution is triagable from the signal dump alone

@@ -1,0 +1,32 @@
+"""Gateway serving tier — write-path batching, per-tenant
+backpressure and admission SLOs.
+
+Three cooperating pieces turn the control plane's ingest surface into
+a real serving tier (the Tesserae observation: scheduler *serving*
+scalability, not per-cycle solve speed, gates large deployments):
+
+- ``batcher.WriteGateway`` — a bounded coalescing queue in front of
+  the leader: concurrent workload POSTs (and ``apply_batch`` sections)
+  drain into ONE serving-lock critical section per flush window with
+  one group-committed journal sync and one EventRecorder wake, instead
+  of per-request locking;
+- ``ratelimit.TenantLimiter`` — token-bucket rate limits keyed by
+  LocalQueue/namespace with fair load-shedding (429 + Retry-After);
+- ``slo.SLOTracker`` — the ``kueue_slo_*`` family: attainment ratio
+  and error-budget burn rate computed from the PR-10
+  ``kueue_trace_queue_to_admission_seconds`` histogram against
+  per-ClusterQueue p95 targets, flipping /healthz to "degraded" on
+  sustained burn.
+"""
+
+from kueue_tpu.gateway.batcher import GatewayThrottled, WriteGateway
+from kueue_tpu.gateway.ratelimit import TenantLimiter, TokenBucket
+from kueue_tpu.gateway.slo import SLOTracker
+
+__all__ = [
+    "GatewayThrottled",
+    "SLOTracker",
+    "TenantLimiter",
+    "TokenBucket",
+    "WriteGateway",
+]

@@ -1,0 +1,356 @@
+"""WriteGateway — the bounded coalescing queue in front of the leader.
+
+Every workload POST previously took the serving lock individually (and
+with auto-reconcile ran a full admission pass per request): at a few
+hundred arrivals per second the lock convoy IS the latency. The
+gateway turns the write path into group commit:
+
+- request threads ENQUEUE (bounded queue, per-tenant token buckets +
+  a per-tenant queue-share cap shedding with 429 + Retry-After) and
+  block on a completion event;
+- a single flusher drains everything that arrived within one flush
+  window into ONE ``server.lock`` critical section, applying each
+  request in arrival order through the exact same
+  ``KueueServer.apply`` path the serial route uses — so decisions,
+  journal record sequences and recovery/replica convergence are
+  bit-identical to applying the same sequence serially — with the
+  journal in group-commit mode (one fsync per window, not per append)
+  and the event recorder coalescing wakes (ONE notify per window);
+- one admission pass (``run_until_idle``) runs per window instead of
+  per request.
+
+Fault point ``gateway.flush_mid_batch`` fires between consecutive
+applies of a batch: a crash there leaves earlier items journaled and
+later items unapplied — the chaos suite proves PR-4 recovery plus
+client re-submit converges to the serial reference with no lost or
+duplicated workload.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from kueue_tpu.gateway.ratelimit import TenantLimiter, tenant_key
+from kueue_tpu.testing import faults
+
+SHED_REASONS = ("tenant_rate", "tenant_share", "queue_full")
+
+
+class GatewayThrottled(Exception):
+    """The gateway shed this write: the caller should retry after
+    ``retry_after_s`` (surfaced as HTTP 429 + Retry-After)."""
+
+    def __init__(self, message: str, retry_after_s: float, reason: str):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.reason = reason
+
+
+@dataclass
+class _Request:
+    section: str
+    obj: dict
+    tenant: str
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[dict] = None
+    error: Optional[Exception] = None
+
+
+class WriteGateway:
+    def __init__(
+        self,
+        flush_interval_s: float = 0.005,
+        max_batch: int = 256,
+        max_queue: int = 4096,
+        limiter: Optional[TenantLimiter] = None,
+        tenant_share_cap: float = 0.5,
+        reconcile: Optional[bool] = None,
+        clock=None,
+        submit_timeout_s: float = 30.0,
+    ):
+        """``reconcile``: run one admission pass per flush window
+        (None = follow the attached server's ``auto_reconcile``).
+        ``tenant_share_cap``: fraction of the queue one tenant may
+        occupy — the fairness fence that keeps a flooding tenant from
+        starving everyone else even inside its rate budget."""
+        if clock is None:
+            from kueue_tpu.utils.clock import Clock
+
+            clock = Clock()
+        self.clock = clock
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max(1, max_batch)
+        self.max_queue = max(1, max_queue)
+        self.limiter = limiter
+        self.tenant_share = max(1, int(self.max_queue * tenant_share_cap))
+        self.reconcile = reconcile
+        self.submit_timeout_s = submit_timeout_s
+        self.server = None  # KueueServer, set by attach()
+        self._cv = threading.Condition()
+        self._queue: Deque[_Request] = deque()  # guarded by: _cv
+        self._per_tenant: Dict[str, int] = {}  # guarded by: _cv
+        # accounting (read by /healthz, the dashboard and SIGUSR2)
+        self.batches = 0  # guarded by: _cv
+        self.applied_total = 0  # guarded by: _cv
+        self.rejected_total = 0  # guarded by: _cv
+        self.shed: Dict[str, int] = {r: 0 for r in SHED_REASONS}  # guarded by: _cv
+        self.last_batch = 0  # guarded by: _cv
+        self.last_flush_s = 0.0  # guarded by: _cv
+        self.max_batch_seen = 0  # guarded by: _cv
+        # flusher lifecycle (Event/Thread are internally synchronized)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- wiring ----
+    def attach(self, server) -> None:
+        self.server = server
+        # back-pointer for runtime-only surfaces (dashboard payload,
+        # SIGUSR2 dump); refreshed per flush so promotion-time runtime
+        # swaps re-acquire it
+        server.runtime.gateway = self
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # fail anything still parked so request threads unblock
+        self.flush_once()
+
+    # ---- request side ----
+    def _metrics(self):
+        srv = self.server
+        rt = getattr(srv, "runtime", None) if srv is not None else None
+        return getattr(rt, "metrics", None)
+
+    def _shed(self, reason: str, retry_after_s: float, message: str):
+        with self._cv:
+            self.shed[reason] = self.shed.get(reason, 0) + 1
+        m = self._metrics()
+        if m is not None:
+            m.gateway_shed_total.inc(reason=reason)
+            m.gateway_requests_total.inc(outcome="shed")
+        raise GatewayThrottled(message, retry_after_s, reason)
+
+    def _enqueue(self, section: str, obj: dict,
+                 limit: bool = True) -> _Request:
+        """Admission control + enqueue (no wait). Raises
+        GatewayThrottled when the write is shed."""
+        tenant = tenant_key(section, obj)
+        if limit and self.limiter is not None:
+            retry = self.limiter.check(tenant)
+            if retry > 0:
+                self._shed(
+                    "tenant_rate", retry,
+                    f"tenant {tenant!r} exceeded its write budget",
+                )
+        req = _Request(section=section, obj=obj, tenant=tenant)
+        with self._cv:
+            queue_full = len(self._queue) >= self.max_queue
+            tenant_full = (
+                not queue_full
+                and limit
+                and self._per_tenant.get(tenant, 0) >= self.tenant_share
+            )
+            if not queue_full and not tenant_full:
+                self._queue.append(req)
+                self._per_tenant[tenant] = self._per_tenant.get(tenant, 0) + 1
+                self._cv.notify_all()
+                return req
+        window = max(self.flush_interval_s, 0.001)
+        if queue_full:
+            self._shed(
+                "queue_full", 2 * window,
+                "gateway coalescing queue is full",
+            )
+        self._shed(
+            "tenant_share", 2 * window,
+            f"tenant {tenant!r} holds its whole queue share",
+        )
+
+    def submit(self, section: str, obj: dict) -> dict:
+        """One write through the gateway: enqueue, wait for the flush
+        that applies it, return the applied object (or re-raise the
+        ApiError the webhook chain produced for it)."""
+        req = self._enqueue(section, obj)
+        if not req.done.wait(self.submit_timeout_s):
+            raise TimeoutError(
+                f"gateway flush did not complete within "
+                f"{self.submit_timeout_s}s"
+            )
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def submit_batch(self, body: Dict[str, list]) -> dict:
+        """``apply_batch`` through the coalescing queue: every section
+        item is enqueued contiguously (arrival order preserved — config
+        objects land before the workloads that reference them) and the
+        per-section applied/rejected counts + first error come back
+        once the flush completes. The batch wire is the trusted
+        federation path: it respects queue capacity but bypasses the
+        per-tenant limiter."""
+        items: List[Tuple[str, dict]] = []
+        for section, objs in body.items():
+            for obj in objs:
+                items.append((section, obj))
+        with self._cv:
+            room = len(self._queue) + len(items) <= self.max_queue
+        if not room:
+            window = max(self.flush_interval_s, 0.001)
+            self._shed(
+                "queue_full", 2 * window,
+                "gateway coalescing queue cannot hold the batch",
+            )
+        reqs = [self._enqueue(s, o, limit=False) for s, o in items]
+        applied: Dict[str, int] = {}
+        rejected: Dict[str, int] = {}
+        first_error: Optional[str] = None
+        for i, req in enumerate(reqs):
+            if not req.done.wait(self.submit_timeout_s):
+                raise TimeoutError(
+                    f"gateway flush did not complete within "
+                    f"{self.submit_timeout_s}s"
+                )
+            if req.error is not None:
+                rejected[req.section] = rejected.get(req.section, 0) + 1
+                if first_error is None:
+                    msg = getattr(req.error, "message", str(req.error))
+                    first_error = f"{req.section}[{i}]: {msg}"
+            else:
+                applied[req.section] = applied.get(req.section, 0) + 1
+        return {
+            "applied": applied,
+            "rejected": rejected,
+            "firstError": first_error,
+        }
+
+    # ---- flush side ----
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cv:
+                while not self._queue and not self._stop.is_set():
+                    self._cv.wait(0.5)
+            if self._stop.is_set():
+                break
+            # the coalescing window: let concurrent posts pile up
+            self._stop.wait(self.flush_interval_s)
+            try:
+                self.flush_once()
+            except Exception:  # noqa: BLE001 — a flush failure must not
+                # kill the flusher (waiters got their per-item errors;
+                # anything still pending flushes next round). Injected
+                # crashes are BaseException and deliberately NOT caught.
+                pass
+
+    def flush_once(self) -> int:
+        """Drain up to ``max_batch`` queued writes into one serving-lock
+        critical section. Returns how many requests completed."""
+        with self._cv:
+            batch: List[_Request] = []
+            while self._queue and len(batch) < self.max_batch:
+                req = self._queue.popleft()
+                n = self._per_tenant.get(req.tenant, 0) - 1
+                if n > 0:
+                    self._per_tenant[req.tenant] = n
+                else:
+                    self._per_tenant.pop(req.tenant, None)
+                batch.append(req)
+            depth = len(self._queue)
+        if not batch:
+            return 0
+        srv = self.server
+        t0 = self.clock.now()
+        applied = rejected = 0
+        try:
+            with srv.lock:
+                rt = srv.runtime
+                rt.gateway = self
+                journal = getattr(rt, "journal", None)
+                events = getattr(rt, "events", None)
+                with contextlib.ExitStack() as stack:
+                    if events is not None and hasattr(events, "coalesce"):
+                        # ONE recorder wake per flush window
+                        stack.enter_context(events.coalesce())
+                    if journal is not None:
+                        # group commit: one fsync per flush window
+                        stack.enter_context(journal.group())
+                    for i, req in enumerate(batch):
+                        if i:
+                            faults.fire("gateway.flush_mid_batch")
+                        try:
+                            req.result = srv.apply(
+                                req.section, req.obj, reconcile=False
+                            )
+                            applied += 1
+                        except Exception as e:  # noqa: BLE001 — the
+                            # item's own rejection (webhook 422, codec
+                            # 400, not-leader 503); delivered to its
+                            # waiter, the rest of the batch proceeds
+                            req.error = e
+                            rejected += 1
+                    do_reconcile = (
+                        srv.auto_reconcile
+                        if self.reconcile is None
+                        else self.reconcile
+                    )
+                    if applied and do_reconcile:
+                        # ONE admission wake per flush window
+                        rt.run_until_idle()
+        finally:
+            for req in batch:
+                req.done.set()
+        flush_s = max(0.0, self.clock.now() - t0)
+        with self._cv:
+            self.batches += 1
+            self.applied_total += applied
+            self.rejected_total += rejected
+            self.last_batch = len(batch)
+            self.last_flush_s = flush_s
+            self.max_batch_seen = max(self.max_batch_seen, len(batch))
+        m = self._metrics()
+        if m is not None:
+            m.gateway_batches_total.inc()
+            if applied:
+                m.gateway_requests_total.inc(applied, outcome="applied")
+            if rejected:
+                m.gateway_requests_total.inc(rejected, outcome="rejected")
+            m.gateway_batch_size.observe(len(batch))
+            m.gateway_flush_duration_seconds.observe(flush_s)
+            m.gateway_queue_depth.set(depth)
+        slo = getattr(getattr(srv, "runtime", None), "slo", None)
+        if slo is not None:
+            slo.maybe_refresh()
+        return len(batch)
+
+    # ---- posture ----
+    def status(self) -> dict:
+        with self._cv:
+            return {
+                "enabled": True,
+                "queueDepth": len(self._queue),
+                "maxQueue": self.max_queue,
+                "flushIntervalS": self.flush_interval_s,
+                "maxBatch": self.max_batch,
+                "batches": self.batches,
+                "applied": self.applied_total,
+                "rejected": self.rejected_total,
+                "shed": dict(self.shed),
+                "lastBatch": self.last_batch,
+                "maxBatchSeen": self.max_batch_seen,
+                "lastFlushS": round(self.last_flush_s, 6),
+                "limiter": (
+                    self.limiter.status() if self.limiter is not None else None
+                ),
+            }

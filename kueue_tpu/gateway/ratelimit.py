@@ -1,0 +1,112 @@
+"""Per-tenant token buckets — the gateway's backpressure primitive.
+
+One bucket per tenant key (LocalQueue ``ns/queue`` for workload
+writes, the namespace otherwise), refilled continuously at
+``rate_per_s`` up to ``burst``. Buckets are independent on purpose:
+fairness here means a flooding tenant exhausts ITS OWN budget and gets
+429s while every other tenant's bucket stays full — there is no shared
+pool a single tenant could drain. Clock-injected so FakeClock tests
+drive refill deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class TokenBucket:
+    """Continuous-refill token bucket. ``try_take`` returns 0.0 when a
+    token was taken, else the seconds until one becomes available (the
+    Retry-After the gateway sends)."""
+
+    def __init__(self, rate_per_s: float, burst: float, clock=None):
+        if rate_per_s <= 0:
+            raise ValueError("rate_per_s must be positive")
+        if clock is None:
+            from kueue_tpu.utils.clock import Clock
+
+            clock = Clock()
+        self.rate_per_s = float(rate_per_s)
+        self.burst = max(1.0, float(burst))
+        self.clock = clock
+        self._tokens = self.burst
+        self._last = clock.now()
+
+    def try_take(self, n: float = 1.0) -> float:
+        now = self.clock.now()
+        if now > self._last:
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rate_per_s
+            )
+        self._last = now
+        if self._tokens >= n:
+            self._tokens -= n
+            return 0.0
+        return (n - self._tokens) / self.rate_per_s
+
+    @property
+    def tokens(self) -> float:
+        return self._tokens
+
+
+class TenantLimiter:
+    """Lazy per-tenant bucket map. ``check(tenant)`` returns 0.0 when
+    the write may proceed, else the retry-after seconds. Bounded: the
+    map is LRU-evicted above ``max_tenants`` (an abuser minting fresh
+    tenant keys must not grow it without bound — a fresh key starts
+    from a full bucket anyway, so eviction never penalizes anyone)."""
+
+    def __init__(
+        self,
+        rate_per_s: float,
+        burst: Optional[float] = None,
+        clock=None,
+        max_tenants: int = 4096,
+    ):
+        if clock is None:
+            from kueue_tpu.utils.clock import Clock
+
+            clock = Clock()
+        self.rate_per_s = float(rate_per_s)
+        self.burst = float(burst) if burst is not None else max(
+            1.0, 2.0 * rate_per_s
+        )
+        self.clock = clock
+        self.max_tenants = max_tenants
+        self._buckets: Dict[str, TokenBucket] = {}  # guarded by: _lock
+        self._lock = threading.Lock()
+
+    def check(self, tenant: str) -> float:
+        with self._lock:
+            bucket = self._buckets.pop(tenant, None)
+            if bucket is None:
+                bucket = TokenBucket(
+                    self.rate_per_s, self.burst, clock=self.clock
+                )
+            self._buckets[tenant] = bucket  # re-insert = LRU touch
+            while len(self._buckets) > self.max_tenants:
+                self._buckets.pop(next(iter(self._buckets)))
+            return bucket.try_take()
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "ratePerS": self.rate_per_s,
+                "burst": self.burst,
+                "tenants": len(self._buckets),
+            }
+
+
+def tenant_key(section: str, obj: dict) -> str:
+    """The backpressure key for one write: workload writes are
+    accounted to their LocalQueue (``ns/queueName`` — the tenant unit
+    Kueue quotas by), other object kinds to their namespace, and
+    cluster-scoped config writes to a shared ``_config`` tenant."""
+    if not isinstance(obj, dict):
+        return "_config"
+    ns = obj.get("namespace", "")
+    if section == "workloads":
+        q = obj.get("queueName", "")
+        return f"{ns}/{q}" if q else (ns or "_config")
+    return ns or "_config"

@@ -402,6 +402,77 @@ class Metrics:
         # cluster_queue is open-ended: materialize the empty-label
         # series up front, the multikueue_remote_rtt_seconds pattern
         self.trace_queue_to_admission_seconds.touch(cluster_queue="")
+        # gateway serving tier (kueue_tpu/gateway): write-path batching
+        # + per-tenant backpressure accounting. A rising shed counter
+        # is the load-shedding signal (pair with the per-reason label
+        # to tell a flooding tenant from a saturated queue); queue
+        # depth near the configured bound means flushes cannot keep up
+        # with arrivals.
+        self.gateway_requests_total = r.counter(
+            f"{NS}_gateway_requests_total",
+            "Total writes through the gateway per outcome (applied|rejected|shed)",
+            ("outcome",),
+        )
+        for outcome in ("applied", "rejected", "shed"):
+            self.gateway_requests_total.inc(0.0, outcome=outcome)
+        self.gateway_batches_total = r.counter(
+            f"{NS}_gateway_batches_total",
+            "Total coalesced flush windows the gateway drained",
+        )
+        self.gateway_batches_total.inc(0.0)
+        self.gateway_shed_total = r.counter(
+            f"{NS}_gateway_shed_total",
+            "Total writes shed with 429 per reason (tenant_rate|tenant_share|queue_full)",
+            ("reason",),
+        )
+        for reason in ("tenant_rate", "tenant_share", "queue_full"):
+            self.gateway_shed_total.inc(0.0, reason=reason)
+        self.gateway_queue_depth = r.gauge(
+            f"{NS}_gateway_queue_depth",
+            "Writes waiting in the gateway coalescing queue after the last flush",
+        )
+        self.gateway_queue_depth.set(0)
+        self.gateway_batch_size = r.histogram(
+            f"{NS}_gateway_batch_size",
+            "Requests coalesced into one gateway flush window",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        )
+        self.gateway_batch_size.touch()
+        self.gateway_flush_duration_seconds = r.histogram(
+            f"{NS}_gateway_flush_duration_seconds",
+            "Wall-clock latency of one gateway flush (apply + reconcile + group fsync)",
+            buckets=ATTEMPT_BUCKETS,
+        )
+        self.gateway_flush_duration_seconds.touch()
+        # admission SLOs (kueue_tpu/gateway/slo.py): attainment and
+        # error-budget burn of enqueue->admission latency against
+        # per-ClusterQueue p95 targets, computed from the
+        # kueue_trace_queue_to_admission_seconds histogram.
+        # kueue_slo_degraded == 1 (sustained burn) is the paging
+        # signal and flips /healthz to "degraded".
+        self.slo_target_seconds = r.gauge(
+            f"{NS}_slo_target_seconds",
+            "Configured p95 queue-to-admission target per cluster_queue",
+            ("cluster_queue",),
+        )
+        self.slo_target_seconds.set(0.0, cluster_queue="")
+        self.slo_attainment_ratio = r.gauge(
+            f"{NS}_slo_attainment_ratio",
+            "Fraction of admissions within the queue-to-admission target per cluster_queue",
+            ("cluster_queue",),
+        )
+        self.slo_attainment_ratio.set(0.0, cluster_queue="")
+        self.slo_error_budget_burn_rate = r.gauge(
+            f"{NS}_slo_error_budget_burn_rate",
+            "Windowed error-budget burn rate per cluster_queue (1.0 consumes the budget exactly at the sustainable pace)",
+            ("cluster_queue",),
+        )
+        self.slo_error_budget_burn_rate.set(0.0, cluster_queue="")
+        self.slo_degraded = r.gauge(
+            f"{NS}_slo_degraded",
+            "1 while any cluster_queue's burn rate has exceeded the threshold for the sustain window",
+        )
+        self.slo_degraded.set(0)
         # journal-tailing read replicas (kueue_tpu/storage/tailer.py):
         # staleness + replay accounting. On a replica, applied_seq
         # trails the leader's kueue_journal_appends head by the poll

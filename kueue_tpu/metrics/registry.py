@@ -184,6 +184,24 @@ class Histogram(_Metric):
     def count(self, **labels) -> int:
         return self._totals.get(self._key(labels), 0)
 
+    def snapshot(self):
+        """(labels_dict, bucket_counts, total, sum) per live series —
+        the public read aggregators (the SLO tracker) compute from
+        without poking at the private maps. ``bucket_counts`` aligns
+        with ``self.buckets``."""
+        with self._lock:
+            return [
+                (
+                    dict(zip(self.label_names, key)),
+                    list(
+                        self._counts.get(key, [0] * len(self.buckets))
+                    ),
+                    self._totals.get(key, 0),
+                    self._sums.get(key, 0.0),
+                )
+                for key in self._totals
+            ]
+
     def sum(self, **labels) -> float:
         return self._sums.get(self._key(labels), 0.0)
 

@@ -143,6 +143,7 @@ def replication_section(rt) -> dict:
         "role": "leader" if journal is not None else "single",
         "appliedSeq": journal.last_seq if journal is not None else 0,
         "lagSeconds": 0.0,
+        "hop": 0,
         "recordsApplied": 0,
         "resyncs": 0,
         "lastError": "",

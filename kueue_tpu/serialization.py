@@ -671,6 +671,17 @@ def runtime_to_state(rt) -> dict:
         "resourceVersion": getattr(rt, "resource_version", 0),
         "journalSeq": journal.last_seq if journal is not None else 0,
     }
+    if journal is not None:
+        # the serving fence: a journaled leader's live /state is a
+        # checkpoint a replica may anchor on, and mid-chain re-anchors
+        # (fan-out trees) need the fence to survive the hop —
+        # fenced_checkpoint overwrites this with its snapshot-time
+        # token, so disk checkpoints are unchanged
+        out["persistence"]["token"] = (
+            journal.token_provider()
+            if journal.token_provider is not None
+            else None
+        )
     quarantine = getattr(rt, "quarantine", None)
     if quarantine is not None and len(quarantine):
         out["quarantine"] = [e.to_dict() for e in quarantine.items()]

@@ -250,6 +250,73 @@ def main(argv=None) -> int:
         help="only reconcile on POST /reconcile",
     )
     parser.add_argument(
+        "--gateway", choices=["on", "off"], default="off",
+        help="write-path gateway (kueue_tpu/gateway): coalesce "
+        "concurrent workload POSTs (and batch sections) into one "
+        "serving-lock critical section, one group-committed journal "
+        "sync and one admission pass per flush window, with "
+        "per-tenant token-bucket load-shedding (429 + Retry-After). "
+        "The serving-at-scale ingest path — see deploy/README "
+        "'Serving at scale'",
+    )
+    parser.add_argument(
+        "--gateway-flush-interval", type=float, default=0.005,
+        help="seconds one gateway flush window coalesces arrivals for "
+        "(smaller = lower added latency, less batching)",
+    )
+    parser.add_argument(
+        "--gateway-max-batch", type=int, default=256,
+        help="most requests one gateway flush applies in one critical "
+        "section",
+    )
+    parser.add_argument(
+        "--gateway-queue-depth", type=int, default=4096,
+        help="bounded coalescing-queue capacity; arrivals beyond it "
+        "are shed with 429",
+    )
+    parser.add_argument(
+        "--gateway-tenant-rate", type=float, default=0.0,
+        help="per-tenant (LocalQueue/namespace) sustained write budget "
+        "in requests/s; 0 disables the rate limiter (queue-capacity "
+        "shedding still applies)",
+    )
+    parser.add_argument(
+        "--gateway-tenant-burst", type=float, default=0.0,
+        help="per-tenant token-bucket burst (default: 2x the rate)",
+    )
+    parser.add_argument(
+        "--slo-target-p95", type=float, default=0.0,
+        help="default queue-to-admission p95 target in seconds for "
+        "every ClusterQueue (kueue_slo_* family; 0 disables SLO "
+        "tracking unless --slo-target sets per-CQ targets)",
+    )
+    parser.add_argument(
+        "--slo-target", action="append", default=None, metavar="CQ=SECONDS",
+        help="per-ClusterQueue queue-to-admission p95 target "
+        "(repeatable; overrides --slo-target-p95 for that CQ)",
+    )
+    parser.add_argument(
+        "--slo-objective", type=float, default=0.95,
+        help="fraction of admissions that must land within the target "
+        "(the error budget is 1 - objective)",
+    )
+    parser.add_argument(
+        "--slo-burn-window", type=float, default=300.0,
+        help="sliding window (seconds) the error-budget burn rate is "
+        "computed over",
+    )
+    parser.add_argument(
+        "--slo-burn-threshold", type=float, default=2.0,
+        help="burn rate above which the budget is burning too fast; "
+        "sustained for --slo-sustain seconds flips /healthz to "
+        "'degraded' and kueue_slo_degraded to 1",
+    )
+    parser.add_argument(
+        "--slo-sustain", type=float, default=60.0,
+        help="seconds the burn threshold must be continuously exceeded "
+        "before the SLO reports degraded",
+    )
+    parser.add_argument(
         "--replica-of", metavar="URL",
         help="run as a journal-tailing READ REPLICA of the leader at "
         "URL (a kueue_tpu.server started with --journal): the leader's "
@@ -365,15 +432,26 @@ def main(argv=None) -> int:
         )
     if args.replica_of:
         # a replica never writes: it neither journals (single-writer
-        # log), contends for the lease, nor dispatches federation work
+        # log), contends for the lease, dispatches federation work,
+        # nor batches writes (it 307s them to the leader)
         for flag, val in (
             ("--journal", args.journal),
             ("--state", args.state),
             ("--leader-elect-lease", args.leader_elect_lease),
             ("--federation-worker", args.federation_worker),
+            ("--gateway", args.gateway if args.gateway == "on" else None),
         ):
             if val:
                 parser.error(f"--replica-of is incompatible with {flag}")
+    slo_targets = {}
+    for spec in args.slo_target or []:
+        cq, sep, seconds = spec.partition("=")
+        if not sep or not cq:
+            parser.error(f"--slo-target must be CQ=SECONDS, got {spec!r}")
+        try:
+            slo_targets[cq] = float(seconds)
+        except ValueError:
+            parser.error(f"--slo-target must be CQ=SECONDS, got {spec!r}")
 
     from kueue_tpu import serialization as ser
     from kueue_tpu.server import KueueServer
@@ -429,6 +507,7 @@ def main(argv=None) -> int:
             if args.policy != "first-fit":
                 rt.set_policy(args.policy, journal=False)
             _apply_trace_capacity(rt)
+            _apply_slo(rt)
             return rt
         from kueue_tpu.controllers import ClusterRuntime
 
@@ -441,6 +520,7 @@ def main(argv=None) -> int:
             policy=args.policy,
         )
         _apply_trace_capacity(rt)
+        _apply_slo(rt)
         return rt
 
     def _apply_trace_capacity(rt):
@@ -448,6 +528,19 @@ def main(argv=None) -> int:
             rt.tracer.enabled = False
         else:
             rt.tracer.max_traces = args.trace_capacity
+
+    def _apply_slo(rt):
+        slo = getattr(rt, "slo", None)
+        if slo is None:
+            return
+        slo.configure(
+            default_target_s=args.slo_target_p95,
+            targets=slo_targets,
+            objective=args.slo_objective,
+            burn_window_s=args.slo_burn_window,
+            burn_threshold=args.slo_burn_threshold,
+            sustain_s=args.slo_sustain,
+        )
 
     journal_opts = {
         "fsync_policy": args.journal_fsync,
@@ -594,6 +687,29 @@ def main(argv=None) -> int:
         tls = CertRotator(args.tls_cert_dir, dns_names=list(dict.fromkeys(sans)))
     elif args.tls_cert:
         tls = (args.tls_cert, args.tls_key)
+    gateway = None
+    if args.gateway == "on":
+        from kueue_tpu.gateway import TenantLimiter, WriteGateway
+
+        limiter = None
+        if args.gateway_tenant_rate > 0:
+            limiter = TenantLimiter(
+                args.gateway_tenant_rate,
+                burst=args.gateway_tenant_burst or None,
+            )
+        gateway = WriteGateway(
+            flush_interval_s=args.gateway_flush_interval,
+            max_batch=args.gateway_max_batch,
+            max_queue=args.gateway_queue_depth,
+            limiter=limiter,
+        )
+        print(
+            "gateway: coalescing writes "
+            f"(flush window {args.gateway_flush_interval * 1e3:.1f} ms, "
+            f"queue {args.gateway_queue_depth}, tenant rate "
+            f"{args.gateway_tenant_rate or 'unlimited'}/s)",
+            flush=True,
+        )
     srv = KueueServer(
         runtime=runtime,
         host=args.host,
@@ -603,6 +719,7 @@ def main(argv=None) -> int:
         auth_token=args.auth_token,
         tls=tls,
         replica=replica,
+        gateway=gateway,
     )
     port = srv.start()
     if replica is not None:

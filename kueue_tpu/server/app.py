@@ -82,11 +82,26 @@ from kueue_tpu.models.constants import (
 )
 
 
+class _ServingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a serving-tier accept backlog. The
+    stdlib default listen(5) RSTs concurrent connections the moment
+    more than a handful of writers arrive between accept() calls —
+    at gateway-scale ingest (dozens of concurrent POSTs) that
+    surfaces as ConnectionResetError on the client."""
+
+    request_queue_size = 256
+
+
 class ApiError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 retry_after_s: Optional[float] = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        # 429 load-shedding: surfaced as the Retry-After header so
+        # clients (KueueClient honors it with capped jittered backoff)
+        # pace themselves instead of hammering a saturated gateway
+        self.retry_after_s = retry_after_s
 
 
 class _Section:
@@ -258,6 +273,7 @@ class KueueServer:
         auth_token: Optional[str] = None,
         tls=None,  # utils.cert.CertRotator, or (cert_path, key_path)
         replica=None,  # replica.ReadReplica: journal-tailing follower
+        gateway=None,  # gateway.WriteGateway: coalescing write path
     ):
         if runtime is None:
             from kueue_tpu.controllers import ClusterRuntime
@@ -334,6 +350,15 @@ class KueueServer:
         self.replica_roster: Dict[str, dict] = {}
         if replica is not None:
             replica.attach(self)
+        # Write-path gateway (kueue_tpu/gateway): when attached, every
+        # workload POST / batch section drains through the bounded
+        # coalescing queue — one serving-lock critical section, one
+        # group-committed journal sync and one admission pass per flush
+        # window — with per-tenant token-bucket shedding (429 +
+        # Retry-After). Leader-side only (replicas redirect writes).
+        self.gateway = gateway
+        if gateway is not None:
+            gateway.attach(self)
 
     def require_leader(self) -> None:
         if self.elector is not None and not self.elector.is_leader:
@@ -461,25 +486,53 @@ class KueueServer:
                     }
             return obj
 
-    def apply_batch(self, body: dict) -> Dict[str, int]:
-        """Bulk upsert: {section: [objects]} in one request (the
-        MultiKueue batched-dispatch wire). Each object still passes the
-        webhook admission chain; reconcile runs once at the end."""
-        self.require_leader()
-        counts: Dict[str, int] = {}
+    @staticmethod
+    def validate_batch_body(body: dict) -> None:
+        """Shape check shared by the serial and gateway batch paths:
+        unknown sections and non-list values are the CALLER's malformed
+        request — refused whole, before anything applies."""
         unknown = [s for s in body if s not in _SECTIONS]
         if unknown:
             raise ApiError(404, f"unknown sections {unknown}")
         for section, objs in body.items():
             if not isinstance(objs, list):
                 raise ApiError(400, f"section {section!r} must be a list")
-            for obj in objs:
-                self.apply(section, obj, reconcile=False)
-                counts[section] = counts.get(section, 0) + 1
-        if self.auto_reconcile:
+
+    def apply_batch(self, body: dict) -> dict:
+        """Bulk upsert: {section: [objects]} in one request (the
+        MultiKueue batched-dispatch wire). Each object still passes the
+        webhook admission chain; reconcile runs once at the end.
+
+        Partial-failure semantics: one bad object rejects THAT object,
+        not the whole batch — the response carries per-section
+        applied/rejected counts plus the first error, so a mixed batch
+        lands its good workloads while the caller learns exactly what
+        bounced (HTTPTransport.create_workloads turns a non-empty
+        rejected map back into RemoteRejected for federation)."""
+        self.require_leader()
+        self.validate_batch_body(body)
+        applied: Dict[str, int] = {}
+        rejected: Dict[str, int] = {}
+        first_error: Optional[str] = None
+        any_applied = False
+        for section, objs in body.items():
+            for i, obj in enumerate(objs):
+                try:
+                    self.apply(section, obj, reconcile=False)
+                    applied[section] = applied.get(section, 0) + 1
+                    any_applied = True
+                except ApiError as e:
+                    rejected[section] = rejected.get(section, 0) + 1
+                    if first_error is None:
+                        first_error = f"{section}[{i}]: {e.message}"
+        if self.auto_reconcile and any_applied:
             with self.lock:
                 self.runtime.run_until_idle()
-        return counts
+        return {
+            "applied": applied,
+            "rejected": rejected,
+            "firstError": first_error,
+        }
 
     def list_section(self, section: str) -> dict:
         sec = _SECTIONS.get(section)
@@ -521,7 +574,7 @@ class KueueServer:
     def start(self, tls_rotation_period_s: float = 3600.0) -> int:
         self._stopping.clear()
         handler = _make_handler(self)
-        self._httpd = ThreadingHTTPServer((self._host, self._port), handler)
+        self._httpd = _ServingHTTPServer((self._host, self._port), handler)
         if self.tls is not None:
             import ssl
 
@@ -557,6 +610,8 @@ class KueueServer:
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+        if self.gateway is not None:
+            self.gateway.start()
         if self.elector is not None:
             self.elector.tick()  # contend immediately, then renew async
             self._election_stop.clear()
@@ -601,6 +656,11 @@ class KueueServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self.gateway is not None:
+            # after the HTTP drain: whatever the gateway still queues
+            # belongs to already-answered or dropped connections; the
+            # stop() flush applies it before the final checkpoint
+            self.gateway.stop()
         if before_release is not None:
             before_release()
         if self.elector is not None:
@@ -662,9 +722,10 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
         "check_state",
     ),
     # literal routes FIRST: the generic section pattern below would
-    # swallow "journal"/"replicas" as object listings
+    # swallow "journal"/"replicas"/"slo" as object listings
     ("GET", re.compile(r"^/apis/kueue/v1beta1/journal$"), "journal_tail"),
     ("GET", re.compile(r"^/apis/kueue/v1beta1/replicas$"), "replicas"),
+    ("GET", re.compile(r"^/apis/kueue/v1beta1/slo$"), "slo"),
     ("GET", re.compile(r"^/apis/kueue/v1beta1/([a-z]+)$"), "list"),
     (
         "GET",
@@ -756,7 +817,17 @@ def _make_handler(srv: KueueServer):
                         self._check_auth(name)
                         getattr(self, f"_h_{name}")(*match.groups(), **{"query": query})
                     except ApiError as e:
-                        self._send_json({"error": e.message}, status=e.status)
+                        headers = None
+                        if e.retry_after_s is not None:
+                            # shed writes tell the client when to come
+                            # back; KueueClient backs off on it
+                            headers = {
+                                "Retry-After": f"{e.retry_after_s:.3f}"
+                            }
+                        self._send_json(
+                            {"error": e.message}, status=e.status,
+                            headers=headers,
+                        )
                     except Exception as e:  # noqa: BLE001 — surface as 500
                         self._send_json({"error": repr(e)}, status=500)
                     return
@@ -799,11 +870,13 @@ def _make_handler(srv: KueueServer):
             except json.JSONDecodeError as e:
                 raise ApiError(400, f"invalid JSON body: {e}")
 
-        def _send_json(self, obj, status: int = 200) -> None:
+        def _send_json(self, obj, status: int = 200, headers=None) -> None:
             payload = json.dumps(obj).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(payload)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             if srv.replica is not None:
                 # every replica-served read is labeled with its role +
                 # staleness so clients (kueuectl) can tell the user the
@@ -891,6 +964,21 @@ def _make_handler(srv: KueueServer):
             policy = getattr(srv.runtime, "policy", None)
             if policy is not None:
                 body["policy"] = policy.name
+            # gateway serving tier (kueue_tpu/gateway): ingest posture
+            # — queue depth, coalescing stats, per-reason shed counts
+            if srv.gateway is not None:
+                body["gateway"] = srv.gateway.status()
+            # admission SLOs: attainment + burn per targeted CQ; a
+            # SUSTAINED error-budget burn flips "degraded" while the
+            # probe stays 200 (admission still runs — the operator
+            # pages on kueue_slo_degraded / this detail)
+            slo = getattr(srv.runtime, "slo", None)
+            if slo is not None and slo.enabled:
+                slo.maybe_refresh()
+                detail = slo.report()
+                body["slo"] = detail
+                if detail["degraded"]:
+                    body["status"] = "degraded"
             # federation detail (kueue_tpu/federation): same convention
             # — a lost or quarantined worker cluster flips "degraded"
             # while the probe stays 200 (the dispatcher keeps routing
@@ -931,9 +1019,23 @@ def _make_handler(srv: KueueServer):
             self._send_json(body)
 
         def _h_metrics(self, query):
+            slo = getattr(srv.runtime, "slo", None)
+            if slo is not None:
+                # scrape-time refresh so kueue_slo_* gauges are current
+                slo.maybe_refresh()
             with srv.lock:
                 text = srv.runtime.metrics.registry.expose()
             self._send_text(text, "text/plain; version=0.0.4")
+
+        def _h_slo(self, query):
+            """Admission-SLO standings (the `kueuectl slo` payload):
+            per-ClusterQueue target, attainment ratio and error-budget
+            burn rate over the configured window."""
+            slo = getattr(srv.runtime, "slo", None)
+            if slo is None:
+                raise ApiError(404, "slo tracking is not available")
+            slo.maybe_refresh()
+            self._send_json(slo.report())
 
         def _int_param(self, query, key, default):
             try:
@@ -1091,18 +1193,50 @@ def _make_handler(srv: KueueServer):
             labels = obj.setdefault("labels", {})
             labels.setdefault(TRACEPARENT_LABEL, header)
 
+        def _throttled(self, e) -> ApiError:
+            return ApiError(
+                429, f"write shed ({e.reason}): {e}",
+                retry_after_s=e.retry_after_s,
+            )
+
         def _h_apply(self, section, query):
             body = self._body()
             self._propagate_traceparent(section, body)
-            obj = srv.apply(section, body)
+            if srv.gateway is not None:
+                # coalescing write path: enqueue (shed with 429 +
+                # Retry-After when over budget) and wait for the flush
+                # window that applies it
+                from kueue_tpu.gateway import GatewayThrottled
+
+                srv.require_leader()
+                try:
+                    obj = srv.gateway.submit(section, body)
+                except GatewayThrottled as e:
+                    raise self._throttled(e)
+                except TimeoutError as e:
+                    raise ApiError(503, str(e))
+            else:
+                obj = srv.apply(section, body)
             self._send_json({"applied": obj})
 
         def _h_apply_batch(self, query):
             body = self._body()
             for obj in body.get("workloads", []) or []:
                 self._propagate_traceparent("workloads", obj)
-            counts = srv.apply_batch(body)
-            self._send_json({"applied": counts})
+            if srv.gateway is not None:
+                from kueue_tpu.gateway import GatewayThrottled
+
+                srv.require_leader()
+                srv.validate_batch_body(body)
+                try:
+                    out = srv.gateway.submit_batch(body)
+                except GatewayThrottled as e:
+                    raise self._throttled(e)
+                except TimeoutError as e:
+                    raise ApiError(503, str(e))
+            else:
+                out = srv.apply_batch(body)
+            self._send_json(out)
 
         def _h_delete_ns(self, section, ns, name, query):
             srv.delete(section, ns, name)
@@ -1265,9 +1399,14 @@ def _make_handler(srv: KueueServer):
                     # APPLIED position instead of journalSeq=0 — at
                     # quiescence this makes the replica's dump
                     # byte-identical to the leader's (the convergence
-                    # acceptance check)
+                    # acceptance check). The fence rides along so a
+                    # downstream tailer anchoring on THIS node's state
+                    # (fan-out trees) adopts the leader's token.
                     state["persistence"]["journalSeq"] = (
                         srv.replica.tailer.applied_seq
+                    )
+                    state["persistence"]["token"] = (
+                        srv.replica.tailer.max_token
                     )
             self._send_json(state)
 
@@ -1276,45 +1415,87 @@ def _make_handler(srv: KueueServer):
             past ``sinceSeq``, bundled with the event-recorder and
             audit-log deltas so one round trip per poll interval keeps
             every replica read surface current. Registers the polling
-            replica in the roster. The segment scan runs OUTSIDE
-            srv.lock — segments are append-only, the CRC framing makes
-            a concurrently half-written tail frame invisible, and
-            holding the serving lock for an O(delta) file scan would
-            put reads back on the admission hot path."""
-            journal = getattr(srv.runtime, "journal", None)
-            if journal is None:
-                raise ApiError(
-                    404,
-                    "no journal attached; replicas tail a leader "
-                    "started with --journal",
-                )
+            replica in the roster. On the LEADER the segment scan runs
+            OUTSIDE srv.lock — segments are append-only, the CRC
+            framing makes a concurrently half-written tail frame
+            invisible, and holding the serving lock for an O(delta)
+            file scan would put reads back on the admission hot path.
+            On a REPLICA the same contract is served from the tailer's
+            bounded in-memory feed log — replicas tail replicas
+            (``--replica-of`` pointed at another replica), so watch/SSE
+            load fans out in a tree instead of all replicas hammering
+            the leader; the response's ``hop``/``pathLag`` fields let
+            downstream nodes report their distance and per-hop
+            staleness."""
             since = self._int_param(query, "sinceSeq", 0)
             limit = max(1, min(self._int_param(query, "limit", 2048), 65536))
-            first_available = journal.first_available_seq()
-            body = {
-                "lastSeq": journal.last_seq,
-                "firstAvailableSeq": first_available,
-                "token": (
-                    journal.token_provider()
-                    if journal.token_provider is not None
-                    else None
-                ),
-                "leaderTime": srv.clock.now(),
-            }
-            if since + 1 < first_available and journal.last_seq > since:
-                # the requested prefix was compacted away: the replica
-                # must re-anchor on a checkpoint (GET /state) — sending
-                # records with a hole would corrupt its replay
-                body["compacted"] = True
-                body["records"] = []
+            if srv.replica is not None:
+                tailer = srv.replica.tailer
+                with srv.lock:
+                    applied = tailer.applied_seq
+                    feed = [
+                        rec for rec in tailer.feed_log if rec.seq > since
+                    ]
+                    first_available = (
+                        tailer.feed_log[0].seq
+                        if tailer.feed_log
+                        else applied + 1
+                    )
+                    token = tailer.max_token
+                body = {
+                    "lastSeq": applied,
+                    "firstAvailableSeq": first_available,
+                    "token": token,
+                    "leaderTime": srv.clock.now(),
+                    "hop": tailer.hop,
+                    "pathLag": tailer.path_lag(),
+                }
+                if since + 1 < first_available and applied > since:
+                    # trimmed feed log or post-resync anchor: the
+                    # downstream must re-anchor on OUR checkpoint
+                    # (GET /state stamps appliedSeq + fence) — the
+                    # leader-compaction contract, one hop down
+                    body["compacted"] = True
+                    body["records"] = []
+                else:
+                    body["compacted"] = False
+                    body["records"] = [r.to_dict() for r in feed[:limit]]
             else:
-                body["compacted"] = False
-                # offset-cursor tail: a caught-up replica's repeat poll
-                # reads O(delta) bytes, not the whole active segment
-                body["records"] = [
-                    rec.to_dict()
-                    for rec in journal.tail_records(since, limit=limit)
-                ]
+                journal = getattr(srv.runtime, "journal", None)
+                if journal is None:
+                    raise ApiError(
+                        404,
+                        "no journal attached; replicas tail a leader "
+                        "started with --journal (or another replica)",
+                    )
+                first_available = journal.first_available_seq()
+                body = {
+                    "lastSeq": journal.last_seq,
+                    "firstAvailableSeq": first_available,
+                    "token": (
+                        journal.token_provider()
+                        if journal.token_provider is not None
+                        else None
+                    ),
+                    "leaderTime": srv.clock.now(),
+                    "hop": 0,
+                    "pathLag": [],
+                }
+                if since + 1 < first_available and journal.last_seq > since:
+                    # the requested prefix was compacted away: the
+                    # replica must re-anchor on a checkpoint (GET
+                    # /state) — sending records with a hole would
+                    # corrupt its replay
+                    body["compacted"] = True
+                    body["records"] = []
+                else:
+                    body["compacted"] = False
+                    # offset-cursor tail: a caught-up replica's repeat
+                    # poll reads O(delta) bytes, not the whole segment
+                    body["records"] = [
+                        rec.to_dict()
+                        for rec in journal.tail_records(since, limit=limit)
+                    ]
             # event + audit deltas (rv/seq-addressed, recorder-locked)
             ev_rv = self._int_param(query, "sinceEventRv", 0)
             rec_events = srv.runtime.events
@@ -1340,25 +1521,20 @@ def _make_handler(srv: KueueServer):
                 try:
                     applied = int(query.get("appliedSeq", since))
                     lag = float(query.get("lagSeconds", 0.0))
+                    hop = int(query.get("hop", body["hop"] + 1))
                 except ValueError:
                     applied, lag = since, 0.0
+                    hop = body["hop"] + 1
                 srv.replica_roster[replica_id] = {
                     "id": replica_id,
                     "appliedSeq": applied,
                     "lagSeconds": lag,
+                    "hop": hop,
                     "lastSeen": body["leaderTime"],
                 }
             self._send_json(body)
 
-        def _h_replicas(self, query):
-            """Follower roster (leader) / own status (replica) — the
-            ``kueuectl replicas`` payload."""
-            if srv.replica is not None:
-                self._send_json(
-                    {"role": "replica", "items": [srv.replica.status()]}
-                )
-                return
-            journal = getattr(srv.runtime, "journal", None)
+        def _roster_items(self, head_seq: int) -> list:
             now = srv.clock.now()
             items = []
             for entry in sorted(
@@ -1366,17 +1542,34 @@ def _make_handler(srv: KueueServer):
             ):
                 item = dict(entry)
                 item["lastSeenAgoS"] = round(now - entry["lastSeen"], 3)
-                item["behind"] = (
-                    max(0, journal.last_seq - entry["appliedSeq"])
-                    if journal is not None
-                    else 0
-                )
+                item["behind"] = max(0, head_seq - entry["appliedSeq"])
                 items.append(item)
+            return items
+
+        def _h_replicas(self, query):
+            """Follower roster (leader) / own status + downstream
+            children (replica) — the ``kueuectl replicas`` payload.
+            In a fan-out tree every node serves this: the leader lists
+            its hop-1 followers, each mid-tier replica lists its own
+            tail status plus the hop-(n+1) nodes tailing IT."""
+            if srv.replica is not None:
+                out = {
+                    "role": "replica",
+                    "items": [srv.replica.status()],
+                }
+                if srv.replica_roster:
+                    out["children"] = self._roster_items(
+                        srv.replica.tailer.applied_seq
+                    )
+                self._send_json(out)
+                return
+            journal = getattr(srv.runtime, "journal", None)
+            head = journal.last_seq if journal is not None else 0
             self._send_json(
                 {
                     "role": "leader",
-                    "lastSeq": journal.last_seq if journal is not None else 0,
-                    "items": items,
+                    "lastSeq": head,
+                    "items": self._roster_items(head),
                 }
             )
 

@@ -7,16 +7,22 @@ the CLI's --server mode and by round-trip tests.
 from __future__ import annotations
 
 import json
+import random
+import time
 import urllib.error
 import urllib.request
 from typing import Optional
 
 
 class ClientError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 retry_after_s: Optional[float] = None):
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
         self.message = message
+        # gateway load-shedding (429): the server's Retry-After hint,
+        # surfaced after the client's own capped backoff gave up
+        self.retry_after_s = retry_after_s
 
 
 class KueueClient:
@@ -27,14 +33,38 @@ class KueueClient:
         token: Optional[str] = None,
         ca_cert: Optional[str] = None,
         insecure: bool = False,
+        max_429_retries: int = 4,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 5.0,
+        backoff_jitter: float = 0.1,
+        sleep_fn=time.sleep,
+        rng: Optional[random.Random] = None,
     ):
         """``ca_cert``: path to a CA bundle that must have signed the
         server's cert (the kubeconfig certificate-authority analog for
         an https:// base_url). ``insecure``: skip verification (the
-        kubeconfig insecure-skip-tls-verify analog, tests only)."""
+        kubeconfig insecure-skip-tls-verify analog, tests only).
+
+        429 handling: a shed write (the gateway's per-tenant
+        backpressure) is retried up to ``max_429_retries`` times,
+        honoring the server's Retry-After capped at ``backoff_cap_s``
+        (falling back to ``backoff_base_s * 2^(n-1)``), with the
+        RemoteClient's multiplicative jitter pattern — delay scaled by
+        [1, 1 + jitter) — so a fleet of shed writers does not re-slam
+        the gateway in lockstep. ``max_429_retries=0`` surfaces the 429
+        immediately."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
+        self.max_429_retries = max_429_retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.backoff_jitter = backoff_jitter
+        self._sleep = sleep_fn
+        self._rng = rng or random.Random()
+        # cumulative 429s observed (retried or surfaced) — bench load
+        # generators read this to report client-side shed pressure
+        self.throttled_total = 0
         # replica awareness, refreshed per request: read replicas label
         # every response with X-Kueue-Role/X-Kueue-Replica-Lag, and
         # mutating verbs they 307-redirect are re-issued at the leader
@@ -66,6 +96,23 @@ class KueueClient:
         self.last_redirected_to = None
         return self._request_url(f"{self.base_url}{path}", method, body)
 
+    def _retry_after_delay(self, header: Optional[str], attempt: int) -> float:
+        """Backoff for one shed (429) retry: the server's Retry-After
+        when present, else ``base * 2^(attempt)``; capped; jittered
+        multiplicatively (the RemoteClient pattern — [1, 1+j))."""
+        delay = None
+        if header:
+            try:
+                delay = float(header)
+            except ValueError:
+                delay = None
+        if delay is None:
+            delay = self.backoff_base_s * (2 ** attempt)
+        delay = min(self.backoff_cap_s, max(0.0, delay))
+        if self.backoff_jitter:
+            delay *= 1.0 + self.backoff_jitter * self._rng.random()
+        return delay
+
     def _request_url(self, url: str, method: str,
                      body: Optional[dict] = None, redirects: int = 1):
         data = json.dumps(body).encode() if body is not None else None
@@ -74,36 +121,59 @@ class KueueClient:
             headers["Authorization"] = f"Bearer {self.token}"
         if self.traceparent:
             headers["traceparent"] = self.traceparent
-        req = urllib.request.Request(
-            url, data=data, method=method, headers=headers
-        )
-        try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout, context=self._ssl_context
-            ) as resp:
-                raw = resp.read()
-                ctype = resp.headers.get("Content-Type", "")
-                self._note_replica_headers(resp.headers)
-        except urllib.error.HTTPError as e:
-            if e.code in (307, 308) and redirects > 0:
-                # a read replica redirecting a mutating verb to its
-                # leader: urllib never re-sends a body across a
-                # redirect, so follow it ourselves — same method, same
-                # body, once (the leader does not redirect again)
-                location = e.headers.get("Location")
-                if location:
-                    self.last_redirected_to = location
-                    return self._request_url(
-                        location, method, body, redirects=redirects - 1
-                    )
+        attempt_429 = 0
+        while True:
+            req = urllib.request.Request(
+                url, data=data, method=method, headers=headers
+            )
             try:
-                message = json.loads(e.read()).get("error", str(e))
-            except Exception:  # noqa: BLE001
-                message = str(e)
-            raise ClientError(e.code, message)
-        if ctype.startswith("application/json"):
-            return json.loads(raw)
-        return raw.decode()
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout, context=self._ssl_context
+                ) as resp:
+                    raw = resp.read()
+                    ctype = resp.headers.get("Content-Type", "")
+                    self._note_replica_headers(resp.headers)
+            except urllib.error.HTTPError as e:
+                if e.code in (307, 308) and redirects > 0:
+                    # a read replica redirecting a mutating verb to its
+                    # leader: urllib never re-sends a body across a
+                    # redirect, so follow it ourselves — same method,
+                    # same body, once (the leader does not redirect
+                    # again)
+                    location = e.headers.get("Location")
+                    if location:
+                        self.last_redirected_to = location
+                        return self._request_url(
+                            location, method, body, redirects=redirects - 1
+                        )
+                retry_after = e.headers.get("Retry-After")
+                if e.code == 429:
+                    # the gateway shed this write: back off (capped,
+                    # jittered) and retry — federation dispatch and
+                    # bench load generators must pace themselves
+                    # instead of hammering a saturated gateway
+                    self.throttled_total += 1
+                    if attempt_429 < self.max_429_retries:
+                        e.read()
+                        self._sleep(
+                            self._retry_after_delay(retry_after, attempt_429)
+                        )
+                        attempt_429 += 1
+                        continue
+                try:
+                    message = json.loads(e.read()).get("error", str(e))
+                except Exception:  # noqa: BLE001
+                    message = str(e)
+                retry_s = None
+                if retry_after:
+                    try:
+                        retry_s = float(retry_after)
+                    except ValueError:
+                        retry_s = None
+                raise ClientError(e.code, message, retry_after_s=retry_s)
+            if ctype.startswith("application/json"):
+                return json.loads(raw)
+            return raw.decode()
 
     def _note_replica_headers(self, headers) -> None:
         self.last_role = headers.get("X-Kueue-Role") or "leader"
@@ -310,6 +380,7 @@ class KueueClient:
         applied_seq: Optional[int] = None,
         lag_s: Optional[float] = None,
         since_span_seq: int = 0,
+        hop: Optional[int] = None,
     ) -> dict:
         """One replication-feed poll (the JournalTailer wire): journal
         records with seq > ``since_seq`` plus event/audit/span deltas,
@@ -331,15 +402,24 @@ class KueueClient:
                 params.append(f"appliedSeq={applied_seq}")
             if lag_s is not None:
                 params.append(f"lagSeconds={lag_s}")
+            if hop is not None:
+                params.append(f"hop={hop}")
         return self._request(
             "GET", "/apis/kueue/v1beta1/journal?" + "&".join(params)
         )
 
     def replicas(self) -> dict:
         """The follower roster (`kueuectl replicas` payload): on a
-        leader, every replica that polled the feed with its staleness;
-        on a replica, its own status."""
+        leader, every replica that polled the feed with its staleness
+        and hop count; on a replica, its own status (hop, per-hop lag)
+        plus any downstream nodes tailing it (fan-out trees)."""
         return self._request("GET", "/apis/kueue/v1beta1/replicas")
+
+    def slo(self) -> dict:
+        """Admission-SLO standings (the `kueuectl slo` payload):
+        per-ClusterQueue p95 target, attainment ratio and error-budget
+        burn rate over the configured window."""
+        return self._request("GET", "/apis/kueue/v1beta1/slo")
 
     # ---- federation ----
     def federation_clusters(self) -> dict:

@@ -176,6 +176,19 @@ def dashboard_payload(rt) -> dict:
     from kueue_tpu.replica import replication_section
 
     replication = replication_section(rt)
+    # gateway badge (kueue_tpu/gateway): write-path batching posture —
+    # queue depth, flush stats, shed counts; {"enabled": False} renders
+    # the "direct" badge on planes without a gateway
+    gw = getattr(rt, "gateway", None)
+    gateway = gw.status() if gw is not None else {"enabled": False}
+    # SLO badge + panel (kueue_tpu/gateway/slo.py): per-CQ attainment
+    # and error-budget burn against the configured p95 targets
+    slo_tracker = getattr(rt, "slo", None)
+    if slo_tracker is not None:
+        slo_tracker.maybe_refresh()
+        slo = slo_tracker.report()
+    else:
+        slo = {"enabled": False, "degraded": False, "clusterQueues": []}
     # trace waterfall (kueue_tpu/tracing): the most recent cycle's
     # span tree — on a replica these are the LEADER's spans, mirrored
     # off the journal feed
@@ -201,6 +214,8 @@ def dashboard_payload(rt) -> dict:
         "mesh": mesh,
         "policy": policy,
         "replication": replication,
+        "gateway": gateway,
+        "slo": slo,
         "clusterQueues": cqs,
         "localQueues": lqs,
         "workloads": workloads,
@@ -278,7 +293,9 @@ DASHBOARD_HTML = """<!doctype html>
  &middot; pipeline <span id="pipeline" class="badge">&hellip;</span>
  &middot; mesh <span id="mesh" class="badge">&hellip;</span>
  &middot; policy <span id="policy" class="badge">&hellip;</span>
- &middot; replication <span id="replication" class="badge">&hellip;</span></div>
+ &middot; replication <span id="replication" class="badge">&hellip;</span>
+ &middot; gateway <span id="gateway" class="badge">&hellip;</span>
+ &middot; slo <span id="slo" class="badge">&hellip;</span></div>
 <div class="tiles" id="tiles"></div>
 <h2>Last cycle</h2><div id="cycle"></div>
 <h2>Trace waterfall</h2><div id="waterfall" class="muted">no trace yet</div>
@@ -380,6 +397,31 @@ function render(d){
       `recordsApplied=${rp.recordsApplied||0} resyncs=${rp.resyncs||0}`+
       (rp.lastError ? ` lastError=${rp.lastError}` : '');
   }
+  const gw = d.gateway||{};
+  const gwEl = document.getElementById('gateway');
+  if (gw.enabled){
+    const shed = Object.values(gw.shed||{}).reduce((a,b)=>a+b,0);
+    gwEl.className = 'badge '+(shed>0 ? 'host' : 'device');
+    gwEl.textContent = `batching · q${gw.queueDepth||0} · shed ${shed}`;
+    gwEl.title = `flush=${(gw.flushIntervalS*1e3).toFixed(1)}ms `+
+      `batches=${gw.batches||0} applied=${gw.applied||0} `+
+      `lastBatch=${gw.lastBatch||0} shed=${JSON.stringify(gw.shed||{})}`;
+  } else { gwEl.className='badge'; gwEl.textContent='direct'; }
+  const so = d.slo||{};
+  const soEl = document.getElementById('slo');
+  if (so.enabled){
+    const worst = (so.clusterQueues||[]).reduce(
+      (w,e)=>Math.max(w, e.burnRate||0), 0);
+    soEl.className = 'badge '+(so.degraded ? 'quarantined'
+      : (worst > (so.burnThreshold||2) ? 'host' : 'device'));
+    soEl.textContent = so.degraded ? 'BURNING'
+      : `ok · worst burn ${worst.toFixed(2)}x`;
+    soEl.title = (so.clusterQueues||[]).map(
+      e=>`${e.clusterQueue}: target=${e.targetSeconds}s `+
+         `attainment=${((e.attainment||1)*100).toFixed(2)}% `+
+         `burn=${(e.burnRate||0).toFixed(2)}x`).join('\\n')
+      || 'no admissions observed yet';
+  } else { soEl.className='badge'; soEl.textContent='off'; }
   const st = d.workloadStates||{};
   document.getElementById('tiles').innerHTML =
     [['ClusterQueues',d.clusterQueues.length],['LocalQueues',d.localQueues.length],

@@ -326,6 +326,12 @@ class Journal:
         # feed polls run on request threads outside the server lock.
         self._tail_cursor: Optional[Tuple[str, int, int]] = None
         self._tail_lock = threading.Lock()
+        # group commit (kueue_tpu/gateway): while a group() is open,
+        # per-append fsyncs are deferred and the group exit issues ONE
+        # sync for the whole window. Toggled only by the single writer
+        # (under the serving lock), like every append-path field.
+        self._group_depth = 0
+        self._group_dirty = False
 
     # ---- lifecycle ----
     def open(self) -> "Journal":
@@ -469,9 +475,42 @@ class Journal:
             self.metrics.journal_append_errors_total.inc()
             self.metrics.journal_degraded.set(1)
 
+    def group(self):
+        """Group-commit context: appends inside the window skip their
+        per-append fsync; exit issues one sync covering them all (for
+        ``always``, unconditionally — the clients are acked only after
+        the flush completes, so the durability contract holds at the
+        batch boundary; for ``interval``, subject to the usual pacing).
+        A failing group sync degrades persistence exactly like a
+        failing per-append sync."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _group():
+            self._group_depth += 1
+            try:
+                yield self
+            finally:
+                self._group_depth -= 1
+                if self._group_depth == 0 and self._group_dirty:
+                    self._group_dirty = False
+                    try:
+                        if self.fsync_policy == "always":
+                            self.sync()
+                        else:
+                            self._maybe_fsync()
+                    except OSError as e:
+                        self._note_failure(e)
+
+        return _group()
+
     def _maybe_fsync(self) -> None:
         if self.fsync_policy == "never":
             return  # unbuffered writes are already with the OS
+        if self._group_depth > 0:
+            # group commit: the window's closing sync covers this append
+            self._group_dirty = True
+            return
         if self.fsync_policy == "interval":
             now = time.monotonic()
             if (

@@ -87,6 +87,12 @@ class TailBatch:
     spans: List[dict] = field(default_factory=list)
     spans_seq: int = 0
     leader_time: float = 0.0
+    # fan-out-tree topology (kueue_tpu/gateway PR): the serving node's
+    # distance from the leader (leader = 0) and its per-hop lag chain
+    # from the leader's first follower down to itself — a tailer of
+    # this node is at hop + 1 and appends its own lag to the chain
+    hop: int = 0
+    path_lag: List[float] = field(default_factory=list)
 
 
 class TailSourceError(Exception):
@@ -183,6 +189,7 @@ class HTTPTailSource:
                 replica=self.replica_id,
                 applied_seq=status.get("appliedSeq"),
                 lag_s=status.get("lagSeconds"),
+                hop=status.get("hop"),
             )
         except (ClientError, OSError) as e:
             raise TailSourceError(f"leader feed fetch failed: {e}")
@@ -204,6 +211,8 @@ class HTTPTailSource:
                 spans=out.get("spans", []),
                 spans_seq=int(out.get("spansSeq", 0)),
                 leader_time=float(out.get("leaderTime", 0.0)),
+                hop=int(out.get("hop", 0)),
+                path_lag=[float(x) for x in out.get("pathLag", [])],
             )
         except (KeyError, TypeError, ValueError) as e:
             raise TailSourceError(f"malformed feed response: {e!r}")
@@ -247,6 +256,7 @@ class JournalTailer:
         on_install: Optional[Callable[[object], None]] = None,
         now_fn: Callable[[], float] = time.time,
         metrics=None,
+        feed_log_max: int = 8192,
     ):
         if build_runtime is None:
             def build_runtime():
@@ -288,6 +298,23 @@ class JournalTailer:
         self.lag_s = 0.0  # guarded by: lock
         self.last_error = ""  # guarded by: lock
         self.last_poll_ts: Optional[float] = None  # guarded by: lock
+        # replica fan-out (kueue_tpu/gateway PR): every record this
+        # tailer walks past (applied AND stale-skipped — the feed must
+        # stay gapless so a downstream tailer skips the same strays)
+        # is retained in a bounded in-memory feed log; the owning
+        # server serves ITS replication feed from it, so replicas tail
+        # replicas and watch/SSE load spreads geometrically. Records
+        # below the log (trimmed, or pre-resync) force a downstream
+        # checkpoint re-anchor exactly like leader compaction.
+        from collections import deque
+
+        self.feed_log = deque()  # guarded by: lock
+        self.feed_log_max = feed_log_max
+        # topology: distance from the leader (a tailer of the leader is
+        # hop 1) and the upstream's per-hop lag chain, refreshed per
+        # poll from the feed's hop/pathLag fields
+        self.upstream_hop = 0  # guarded by: lock
+        self.upstream_path_lag: List[float] = []  # guarded by: lock
         # consecutive polls where the leader claimed a head PAST our
         # cursor yet shipped zero records and no compaction marker — a
         # self-inconsistent feed (e.g. the journal directory deleted
@@ -331,6 +358,39 @@ class JournalTailer:
             self.on_install(rt)
 
     # ---- sync ----
+    @property
+    def hop(self) -> int:
+        """Distance from the leader: 1 + the upstream's hop (a direct
+        follower of the leader is hop 1; a follower-of-a-follower 2)."""
+        with self.lock:
+            return self.upstream_hop + 1
+
+    def path_lag(self) -> List[float]:
+        """Per-hop lag chain from the leader's first follower down to
+        this node (seconds): the upstream's chain plus our own lag —
+        the roster's geometrically-spreading staleness attribution."""
+        with self.lock:
+            return [round(x, 3) for x in self.upstream_path_lag] + [
+                round(self.lag_s, 3)
+            ]
+
+    def _feed_append(self, rec: JournalRecord) -> None:  # kueuelint: holds=lock
+        self.feed_log.append(rec)
+        while len(self.feed_log) > self.feed_log_max:
+            self.feed_log.popleft()
+
+    def feed_first_available_seq(self) -> int:
+        """The lowest seq this node's OWN replication feed can serve
+        (downstream tailers below it must checkpoint-re-anchor, the
+        leader-compaction analog). Nothing at or below the cursor is
+        servable right after a resync, hence ``applied_seq + 1``."""
+        with self.lock:
+            return (
+                self.feed_log[0].seq
+                if self.feed_log
+                else self.applied_seq + 1
+            )
+
     def status(self) -> dict:
         behind = None
         with self.lock:
@@ -343,6 +403,10 @@ class JournalTailer:
             "appliedAuditSeq": self.audit_seq,
             "appliedSpanSeq": self.span_seq,
             "lagSeconds": round(self.lag_s, 3),
+            "hop": self.upstream_hop + 1,
+            "pathLagSeconds": [
+                round(x, 3) for x in self.upstream_path_lag
+            ] + [round(self.lag_s, 3)],
             "recordsApplied": self.records_applied,
             "skippedStaleRecords": self.skipped_stale,
             "resyncs": self.resyncs,
@@ -389,6 +453,10 @@ class JournalTailer:
             self.applied_seq = int(persistence.get("journalSeq", 0))
             if persistence.get("token") is not None:
                 self.max_token = int(persistence["token"])
+            # the anchor invalidates the retained feed: records below
+            # the checkpoint are gone from this node — downstream
+            # tailers re-anchor on OUR checkpoint, the compaction analog
+            self.feed_log.clear()
             self.resyncs += 1
         if self.metrics is not None:
             self.metrics.replica_resyncs_total.inc()
@@ -430,6 +498,7 @@ class JournalTailer:
             "status": {
                 "appliedSeq": self.applied_seq,
                 "lagSeconds": round(self.lag_s, 3),
+                "hop": self.hop,
             },
         }
         try:
@@ -447,6 +516,11 @@ class JournalTailer:
     def _poll(self, res: TailResult) -> TailResult:
         self.ensure_runtime()
         batch = self._fetch()
+        with self.lock:
+            # fan-out topology: adopt the upstream's distance-from-
+            # leader and per-hop lag chain as reported by this poll
+            self.upstream_hop = batch.hop
+            self.upstream_path_lag = list(batch.path_lag)
         if batch.compacted or batch.last_seq < self.applied_seq:
             # the leader cannot serve our resume point: compaction ate
             # it, or the head REGRESSED (fresh journal dir / restore
@@ -481,10 +555,14 @@ class JournalTailer:
             if rec.token is not None:
                 if self.max_token is not None and rec.token < self.max_token:
                     # a deposed leader's stray append: refuse it, but
-                    # advance past it — recovery replay does the same
+                    # advance past it — recovery replay does the same.
+                    # The stray STAYS in the feed log: a downstream
+                    # tailer must see a gapless seq stream and will
+                    # skip it by the same token rule.
                     with self.lock:
                         self.applied_seq = rec.seq
                         self.skipped_stale += 1
+                        self._feed_append(rec)
                     res.skipped_stale += 1
                     continue
                 if self.max_token is not None and rec.token > self.max_token:
@@ -494,6 +572,18 @@ class JournalTailer:
                     faults.fire("replica.tail_gap")
                     if self.resync():
                         res.resynced = True
+                        # adopt the fence we OBSERVED: an upstream
+                        # checkpoint without a token stamp (a replica's
+                        # own /state mid-chain, or an un-fenced leader
+                        # dump) must not leave max_token below the new
+                        # leader's — every later record would re-trip
+                        # this branch into a resync loop
+                        with self.lock:
+                            self.max_token = (
+                                rec.token
+                                if self.max_token is None
+                                else max(self.max_token, rec.token)
+                            )
                         break
                     # no checkpoint: adopt the new fence and keep
                     # tailing (journal-only topologies — recovery
@@ -510,6 +600,7 @@ class JournalTailer:
                     getattr(self.runtime, "resource_version", 0), rec.rv
                 )
                 self.records_applied += 1
+                self._feed_append(rec)
             res.applied += 1
             applied_ts = rec.ts
             if self.metrics is not None:

@@ -118,6 +118,15 @@ FAULT_POINTS: Dict[str, str] = {
         "winner's stale copy; the dispatcher must refuse the token and "
         "retract the copy instead of double-admitting"
     ),
+    # ---- gateway serving tier (kueue_tpu/gateway/batcher.py) ----
+    "gateway.flush_mid_batch": (
+        "inside the write-gateway's coalescing flush, between two "
+        "consecutive request applies of one batch — records for "
+        "earlier items are journaled (possibly not yet fsynced under "
+        "group commit), later items never applied, no client was "
+        "acked; PR-4 recovery plus client re-submit must converge to "
+        "the serial reference with no lost or duplicated workload"
+    ),
     # ---- journal-tailing read replicas (kueue_tpu/storage/tailer.py) ----
     "replica.tail_gap": (
         "the tailer just detected that the leader can no longer serve "

@@ -1,0 +1,806 @@
+"""Gateway serving tier (kueue_tpu/gateway): write-path coalescing
+(one serving-lock section + one group-committed journal sync + ONE
+EventRecorder wake per flush window, decisions/journal sequences
+bit-identical to the serial path), per-tenant token-bucket
+backpressure with fair 429 + Retry-After shedding, apply_batch
+partial-failure semantics, client 429 backoff, admission SLOs
+(attainment + error-budget burn over the queue-to-admission
+histogram), chaos at the new ``gateway.flush_mid_batch`` fault point,
+and replica fan-out trees (replicas tailing replicas with hop count +
+per-hop lag, converging byte-identically through compaction jumps and
+leader handovers).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kueue_tpu import serialization as ser
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.gateway import (
+    GatewayThrottled,
+    SLOTracker,
+    TenantLimiter,
+    TokenBucket,
+    WriteGateway,
+)
+from kueue_tpu.gateway.ratelimit import tenant_key
+from kueue_tpu.metrics import Metrics
+from kueue_tpu.server import KueueServer
+from kueue_tpu.server.client import ClientError, KueueClient
+from kueue_tpu.storage import Journal, recover
+from kueue_tpu.testing import faults
+from kueue_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def cq_dict(name, quota="8"):
+    return {
+        "name": name,
+        "namespaceSelector": {},
+        "resourceGroups": [
+            {
+                "coveredResources": ["cpu"],
+                "flavors": [
+                    {
+                        "name": "default",
+                        "resources": [{"name": "cpu", "nominalQuota": quota}],
+                    }
+                ],
+            }
+        ],
+    }
+
+
+def wl_wire(name, cpu="1000m", queue="lq-0", ns="ns"):
+    return {
+        "namespace": ns, "name": name, "queueName": queue,
+        "podSets": [{"name": "main", "count": 1,
+                     "requests": {"cpu": cpu}}],
+    }
+
+
+def fresh_rt(clock_start=0.0):
+    return ClusterRuntime(
+        clock=FakeClock(clock_start), use_solver=False,
+        bulk_drain_threshold=None,
+    )
+
+
+def seeded_server(tmp_path, name="journal", gateway=None, clock_start=0.0):
+    """A journaled leader KueueServer (HTTP not started — the gateway
+    and apply paths are driven directly) with one CQ/LQ configured."""
+    rt = fresh_rt(clock_start)
+    journal = Journal(str(tmp_path / name)).open()
+    rt.attach_journal(journal)
+    srv = KueueServer(runtime=rt, gateway=gateway)
+    srv.apply("resourceflavors", {"name": "default"}, reconcile=False)
+    srv.apply("clusterqueues", cq_dict("cq-0"), reconcile=False)
+    srv.apply(
+        "localqueues",
+        {"namespace": "ns", "name": "lq-0", "clusterQueue": "cq-0"},
+        reconcile=False,
+    )
+    rt.run_until_idle()
+    return srv, rt, journal
+
+
+def admitted_keys(rt):
+    return sorted(k for k, w in rt.workloads.items() if w.is_admitted)
+
+
+def journal_sequence(journal):
+    """(type, data) stream — the bit-identical comparison key (seq/rv
+    ride along implicitly: both runs start from the same base)."""
+    return [(r.seq, r.rv, r.type, r.data) for r in journal.records(0)]
+
+
+# ---- token buckets / tenant limiter ----
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        clock = FakeClock(0.0)
+        b = TokenBucket(rate_per_s=10.0, burst=2.0, clock=clock)
+        assert b.try_take() == 0.0
+        assert b.try_take() == 0.0
+        retry = b.try_take()
+        assert retry == pytest.approx(0.1)
+        clock.advance(0.1)  # one token refilled
+        assert b.try_take() == 0.0
+        assert b.try_take() > 0.0
+
+    def test_refill_caps_at_burst(self):
+        clock = FakeClock(0.0)
+        b = TokenBucket(rate_per_s=100.0, burst=3.0, clock=clock)
+        clock.advance(1000.0)
+        for _ in range(3):
+            assert b.try_take() == 0.0
+        assert b.try_take() > 0.0
+
+    def test_rejects_nonpositive_rate(self):
+        with pytest.raises(ValueError):
+            TokenBucket(rate_per_s=0.0, burst=1.0)
+
+
+class TestTenantLimiter:
+    def test_flooding_tenant_shed_others_unaffected(self):
+        clock = FakeClock(0.0)
+        lim = TenantLimiter(rate_per_s=1.0, burst=2.0, clock=clock)
+        assert lim.check("ns/flood") == 0.0
+        assert lim.check("ns/flood") == 0.0
+        assert lim.check("ns/flood") > 0.0  # budget spent
+        # fairness: an unrelated tenant's bucket is untouched
+        assert lim.check("ns/quiet") == 0.0
+
+    def test_lru_bound(self):
+        clock = FakeClock(0.0)
+        lim = TenantLimiter(rate_per_s=1.0, burst=1.0, clock=clock,
+                            max_tenants=2)
+        for t in ("a", "b", "c"):
+            lim.check(t)
+        assert lim.status()["tenants"] == 2
+
+    def test_tenant_key_mapping(self):
+        assert tenant_key("workloads", {"namespace": "ns", "queueName": "q"}) \
+            == "ns/q"
+        assert tenant_key("workloads", {"namespace": "ns"}) == "ns"
+        assert tenant_key("localqueues", {"namespace": "ns", "name": "q"}) \
+            == "ns"
+        assert tenant_key("clusterqueues", {"name": "cq"}) == "_config"
+
+
+# ---- coalescing correctness (the bit-identical oracle) ----
+class TestCoalescingDeterminism:
+    N = 6
+
+    def _workload_seq(self):
+        # mixed batch: config object first, then workloads that use it
+        return [("workloads", wl_wire(f"w-{i}")) for i in range(self.N)]
+
+    def test_flush_bit_identical_to_serial_path(self, tmp_path):
+        """The oracle: the SAME arrival window applied (a) through the
+        serial batched route (``apply_batch`` — per-item webhook chain
+        in arrival order, one reconcile at the end: exactly the
+        semantics one gateway flush coalesces N concurrent POSTs into)
+        and (b) through one gateway flush window produces bit-identical
+        journal record sequences and quiescent state dumps; and the
+        per-request serial path converges to the same admitted set and
+        workload states at quiescence."""
+        srv_a, rt_a, j_a = seeded_server(tmp_path, "ja")
+        srv_a.apply_batch(
+            {"workloads": [o for _, o in self._workload_seq()]}
+        )
+
+        gw = WriteGateway(flush_interval_s=0.001, max_batch=64)
+        srv_b, rt_b, j_b = seeded_server(tmp_path, "jb", gateway=gw)
+        reqs = [gw._enqueue(s, o) for s, o in self._workload_seq()]
+        assert gw.flush_once() == self.N
+        assert all(r.done.is_set() and r.error is None for r in reqs)
+
+        assert admitted_keys(rt_a) == admitted_keys(rt_b)
+        assert journal_sequence(j_a) == journal_sequence(j_b)
+        dump_a = json.dumps(ser.runtime_to_state(rt_a), sort_keys=True)
+        dump_b = json.dumps(ser.runtime_to_state(rt_b), sort_keys=True)
+        assert dump_a == dump_b
+        assert rt_b.check_invariants() == []
+
+        # the per-request serial path (one lock + reconcile per POST)
+        # journals admissions interleaved differently but converges to
+        # the same decisions and workload states at quiescence
+        srv_c, rt_c, _ = seeded_server(tmp_path, "jc")
+        for section, obj in self._workload_seq():
+            srv_c.apply(section, obj)
+        assert admitted_keys(rt_c) == admitted_keys(rt_b)
+        wls_b = ser.runtime_to_state(rt_b)["workloads"]
+        wls_c = ser.runtime_to_state(rt_c)["workloads"]
+        assert json.dumps(wls_b, sort_keys=True) == json.dumps(
+            wls_c, sort_keys=True
+        )
+
+    def test_concurrent_submits_coalesce_into_one_flush(self, tmp_path):
+        gw = WriteGateway(flush_interval_s=0.001, max_batch=64)
+        srv, rt, _ = seeded_server(tmp_path, gateway=gw)
+        results = {}
+
+        def post(i):
+            results[i] = gw.submit("workloads", wl_wire(f"c-{i}"))
+
+        threads = [
+            threading.Thread(target=post, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with gw._cv:
+                if len(gw._queue) == 4:
+                    break
+            time.sleep(0.002)
+        assert gw.flush_once() == 4
+        for t in threads:
+            t.join(timeout=5)
+        assert sorted(results) == [0, 1, 2, 3]
+        assert gw.batches == 1 and gw.last_batch == 4
+        assert len(admitted_keys(rt)) == 4
+
+    def test_one_recorder_wake_and_one_fsync_per_window(self, tmp_path):
+        """N coalesced appends produce exactly ONE EventRecorder
+        notify_all (the satellite's wake-latency contract) and, under
+        fsync=always group commit, ONE fsync for the whole window."""
+        gw = WriteGateway(flush_interval_s=0.001, max_batch=64)
+        rt = fresh_rt()
+        journal = Journal(str(tmp_path / "jw"), fsync_policy="always").open()
+        rt.attach_journal(journal)
+        srv = KueueServer(runtime=rt, gateway=gw)
+        srv.apply("resourceflavors", {"name": "default"}, reconcile=False)
+        srv.apply("clusterqueues", cq_dict("cq-0"), reconcile=False)
+        srv.apply(
+            "localqueues",
+            {"namespace": "ns", "name": "lq-0", "clusterQueue": "cq-0"},
+            reconcile=False,
+        )
+        rt.run_until_idle()
+
+        got = {}
+
+        def watcher():
+            # parked before the flush; must wake with the whole window
+            got["items"], got["rv"], _ = rt.events.wait(
+                rt.events.resource_version, timeout=10.0
+            )
+
+        t = threading.Thread(target=watcher)
+        t.start()
+        time.sleep(0.05)  # let the watcher park
+        wakes0 = rt.events.wakes
+        fsyncs0 = journal.stats().fsyncs
+        for i in range(5):
+            gw._enqueue("workloads", wl_wire(f"e-{i}"))
+        gw.flush_once()
+        t.join(timeout=10)
+        assert rt.events.wakes == wakes0 + 1, (
+            "a coalesced flush must wake watchers exactly once"
+        )
+        assert journal.stats().fsyncs == fsyncs0 + 1, (
+            "group commit must fsync once per flush window"
+        )
+        # the single wake delivered every event of the window
+        admitted = [
+            e for e in got["items"] if e["reason"] == "Admitted"
+        ]
+        assert len(admitted) == 5
+
+    def test_flush_rejects_bad_item_applies_rest(self, tmp_path):
+        gw = WriteGateway(flush_interval_s=0.001)
+        srv, rt, _ = seeded_server(tmp_path, gateway=gw)
+        good = gw._enqueue("workloads", wl_wire("ok-1"))
+        bad = gw._enqueue("workloads", wl_wire("Bad_Name"))
+        good2 = gw._enqueue("workloads", wl_wire("ok-2"))
+        gw.flush_once()
+        assert good.error is None and good2.error is None
+        assert bad.error is not None and bad.error.status == 422
+        assert len(admitted_keys(rt)) == 2
+
+
+# ---- backpressure / shedding ----
+class TestBackpressure:
+    def test_queue_full_shed(self, tmp_path):
+        gw = WriteGateway(flush_interval_s=0.01, max_queue=2,
+                          tenant_share_cap=1.0)
+        seeded_server(tmp_path, gateway=gw)
+        gw._enqueue("workloads", wl_wire("a"))
+        gw._enqueue("workloads", wl_wire("b"))
+        with pytest.raises(GatewayThrottled) as exc:
+            gw._enqueue("workloads", wl_wire("c"))
+        assert exc.value.reason == "queue_full"
+        assert exc.value.retry_after_s > 0
+        assert gw.status()["shed"]["queue_full"] == 1
+
+    def test_tenant_share_cap_is_fair(self, tmp_path):
+        gw = WriteGateway(flush_interval_s=0.01, max_queue=10,
+                          tenant_share_cap=0.2)  # 2 slots per tenant
+        seeded_server(tmp_path, gateway=gw)
+        gw._enqueue("workloads", wl_wire("a-0", queue="lq-a"))
+        gw._enqueue("workloads", wl_wire("a-1", queue="lq-a"))
+        with pytest.raises(GatewayThrottled) as exc:
+            gw._enqueue("workloads", wl_wire("a-2", queue="lq-a"))
+        assert exc.value.reason == "tenant_share"
+        # a different tenant still has room: the flood cannot starve it
+        gw._enqueue("workloads", wl_wire("b-0", queue="lq-b"))
+
+    def test_rate_limit_shed_and_429_over_http(self, tmp_path):
+        clock = FakeClock(0.0)
+        gw = WriteGateway(
+            flush_interval_s=0.001,
+            limiter=TenantLimiter(rate_per_s=1.0, burst=1.0, clock=clock),
+        )
+        srv, rt, _ = seeded_server(tmp_path, gateway=gw)
+        port = srv.start()
+        try:
+            url = f"http://127.0.0.1:{port}"
+            # no retries: the 429 + Retry-After must surface raw
+            raw = KueueClient(url, max_429_retries=0)
+            raw.apply("workloads", wl_wire("t-0"))
+            with pytest.raises(ClientError) as exc:
+                raw.apply("workloads", wl_wire("t-1"))
+            assert exc.value.status == 429
+            assert exc.value.retry_after_s and exc.value.retry_after_s > 0
+            assert raw.throttled_total == 1
+            # retries: capped jittered backoff waits out the bucket
+            # (the FakeClock refills when the client sleeps)
+            sleeps = []
+
+            def fake_sleep(s):
+                sleeps.append(s)
+                clock.advance(max(s, 1.1))
+
+            retrying = KueueClient(
+                url, max_429_retries=3, sleep_fn=fake_sleep,
+                backoff_base_s=0.01, backoff_cap_s=2.0,
+            )
+            retrying.apply("workloads", wl_wire("t-2"))
+            with gw._cv:
+                pass
+            assert retrying.throttled_total >= 1
+            assert sleeps, "the client must back off before retrying"
+            # Retry-After honored: first sleep ~= the advertised wait
+            # (1 token at 1/s), jitter-scaled into [1, 1.1)
+            assert 0.9 <= sleeps[0] <= 2.0
+            m = rt.metrics
+            assert m.gateway_shed_total.value(reason="tenant_rate") >= 2
+        finally:
+            srv.stop()
+
+    def test_retry_after_backoff_is_capped_and_jittered(self):
+        import random
+
+        client = KueueClient(
+            "http://127.0.0.1:1", backoff_cap_s=0.5, backoff_jitter=0.1,
+            rng=random.Random(7),
+        )
+        d = client._retry_after_delay("30.0", attempt=0)
+        assert 0.5 <= d <= 0.55  # capped then jittered
+        d2 = client._retry_after_delay(None, attempt=2)
+        assert d2 >= client.backoff_base_s * 4
+
+
+# ---- apply_batch partial failure (satellite) ----
+class TestApplyBatchPartialFailure:
+    def test_mixed_batch_lands_good_reports_bad(self, tmp_path):
+        srv, rt, _ = seeded_server(tmp_path)
+        out = srv.apply_batch(
+            {
+                "workloads": [
+                    wl_wire("good-0"),
+                    wl_wire("Bad_Name"),
+                    wl_wire("good-1"),
+                ]
+            }
+        )
+        assert out["applied"] == {"workloads": 2}
+        assert out["rejected"] == {"workloads": 1}
+        assert "workloads[1]" in out["firstError"]
+        assert sorted(admitted_keys(rt)) == ["ns/good-0", "ns/good-1"]
+
+    def test_gateway_batch_same_semantics(self, tmp_path):
+        gw = WriteGateway(flush_interval_s=0.001)
+        srv, rt, _ = seeded_server(tmp_path, gateway=gw)
+        body = {
+            "workloads": [wl_wire("g-0"), wl_wire("Bad_Name"),
+                          wl_wire("g-1")]
+        }
+        done = {}
+
+        def run():
+            done["out"] = gw.submit_batch(body)
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with gw._cv:
+                if len(gw._queue) == 3:
+                    break
+            time.sleep(0.002)
+        gw.flush_once()
+        t.join(timeout=5)
+        out = done["out"]
+        assert out["applied"] == {"workloads": 2}
+        assert out["rejected"] == {"workloads": 1}
+        assert "Bad_Name" not in json.dumps(sorted(rt.workloads))
+
+    def test_transport_surfaces_rejection_as_remote_rejected(self, tmp_path):
+        from kueue_tpu.admissionchecks.multikueue_transport import (
+            HTTPTransport,
+            RemoteRejected,
+        )
+        from kueue_tpu.models import Workload
+        from kueue_tpu.models.workload import PodSet
+
+        srv, rt, _ = seeded_server(tmp_path)
+        port = srv.start()
+        try:
+            tr = HTTPTransport(f"http://127.0.0.1:{port}")
+            good = Workload(
+                namespace="ns", name="f-good", queue_name="lq-0",
+                pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+            )
+            bad = Workload(
+                namespace="ns", name="F_BAD", queue_name="lq-0",
+                pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+            )
+            with pytest.raises(RemoteRejected):
+                tr.create_workloads([good, bad])
+            # partial semantics: the good copy still landed
+            assert "ns/f-good" in rt.workloads
+        finally:
+            srv.stop()
+
+
+# ---- chaos: crash inside the coalescing flush ----
+class TestGatewayChaos:
+    M = 5
+
+    def _serial_reference(self, tmp_path):
+        srv, rt, _ = seeded_server(tmp_path, "ref")
+        for i in range(self.M):
+            srv.apply("workloads", wl_wire(f"x-{i}"))
+        return admitted_keys(rt)
+
+    def test_crash_mid_flush_recovers_no_loss_no_dup(self, tmp_path):
+        """InjectedCrash between consecutive applies of one coalesced
+        flush, at EVERY occurrence: PR-4 journal recovery plus client
+        re-submit (at-least-once; records are idempotent upserts)
+        converges to the serial reference admitted set — no workload
+        lost, none duplicated, invariants clean."""
+        reference = self._serial_reference(tmp_path)
+        # the fault fires before applies 2..M of a batch
+        for occurrence in range(self.M - 1):
+            name = f"j-{occurrence}"
+            gw = WriteGateway(flush_interval_s=0.001, max_batch=64)
+            srv, rt, journal = seeded_server(tmp_path, name, gateway=gw)
+            for i in range(self.M):
+                gw._enqueue("workloads", wl_wire(f"x-{i}"))
+            faults.arm("gateway.flush_mid_batch", "crash", skip=occurrence)
+            with pytest.raises(faults.InjectedCrash):
+                gw.flush_once()
+            faults.reset()
+            journal.close()
+            # recover the journaled prefix into a fresh plane
+            res = recover(None, str(tmp_path / name), runtime=fresh_rt(),
+                          strict=True)
+            rec_rt = res.runtime
+            # the clients that never got an ack re-submit everything
+            # (idempotent upserts — already-applied copies are no-ops)
+            rec_srv = KueueServer(runtime=rec_rt)
+            for i in range(self.M):
+                rec_srv.apply("workloads", wl_wire(f"x-{i}"))
+            assert admitted_keys(rec_rt) == reference, (
+                f"occurrence {occurrence}: recovered admitted set "
+                "diverged from the serial reference"
+            )
+            assert len(rec_rt.workloads) == self.M  # no duplicates
+            assert rec_rt.check_invariants() == []
+            res.journal.close()
+
+    def test_fault_point_is_registered(self):
+        assert "gateway.flush_mid_batch" in faults.list_fault_points()
+
+
+# ---- admission SLOs ----
+class TestSLOTracker:
+    def _metrics_with(self, observations, cq="cq-0"):
+        m = Metrics()
+        for v in observations:
+            m.trace_queue_to_admission_seconds.observe(v, cluster_queue=cq)
+        return m
+
+    def test_attainment_from_histogram(self):
+        clock = FakeClock(0.0)
+        m = self._metrics_with([0.5] * 9 + [5.0])
+        slo = SLOTracker(m, clock=clock)
+        slo.set_target("cq-0", 1.0)
+        slo.refresh()
+        entry = slo.report()["clusterQueues"][0]
+        assert entry["attainment"] == pytest.approx(0.9)
+        assert entry["admitted"] == 10
+        assert entry["withinTarget"] == 9
+        assert m.slo_attainment_ratio.value(cluster_queue="cq-0") \
+            == pytest.approx(0.9)
+
+    def test_burn_rate_and_sustained_degraded(self):
+        clock = FakeClock(0.0)
+        m = self._metrics_with([0.1] * 20)
+        slo = SLOTracker(
+            m, clock=clock, objective=0.95, burn_window_s=100.0,
+            burn_threshold=2.0, sustain_s=10.0,
+        )
+        slo.set_target("cq-0", 1.0)
+        slo.refresh()  # baseline: all good, burn 0
+        assert not slo.degraded
+        # a bad stretch: 5 of 10 new admissions miss the target ->
+        # windowed bad fraction 0.5 -> burn 0.5/0.05 = 10x
+        clock.advance(5.0)
+        for v in [0.1] * 5 + [9.0] * 5:
+            m.trace_queue_to_admission_seconds.observe(
+                v, cluster_queue="cq-0"
+            )
+        slo.refresh()
+        entry = slo.report()["clusterQueues"][0]
+        assert entry["burnRate"] == pytest.approx(10.0)
+        assert not entry["degraded"]  # not sustained yet
+        clock.advance(11.0)
+        slo.refresh()  # still burning, past sustain_s
+        assert slo.degraded
+        assert m.slo_degraded.value() == 1
+        # recovery: a good stretch drops the burn, degraded clears
+        clock.advance(200.0)
+        for _ in range(50):
+            m.trace_queue_to_admission_seconds.observe(
+                0.1, cluster_queue="cq-0"
+            )
+        slo.refresh()
+        clock.advance(1.0)
+        slo.refresh()
+        assert not slo.degraded
+
+    def test_untargeted_cq_ignored_and_default_target(self):
+        clock = FakeClock(0.0)
+        m = self._metrics_with([0.5], cq="other")
+        slo = SLOTracker(m, clock=clock)
+        slo.refresh()
+        assert slo.report()["clusterQueues"] == []
+        assert not slo.enabled
+        slo.configure(default_target_s=1.0)
+        assert slo.enabled
+        slo.refresh()
+        assert [e["clusterQueue"] for e in slo.report()["clusterQueues"]] \
+            == ["other"]
+
+    def test_healthz_and_slo_route_degraded(self, tmp_path):
+        srv, rt, _ = seeded_server(tmp_path)
+        rt.slo.configure(
+            default_target_s=0.5, burn_threshold=0.5, sustain_s=0.0,
+            burn_window_s=1000.0,
+        )
+        port = srv.start()
+        try:
+            client = KueueClient(f"http://127.0.0.1:{port}")
+            # one good admission, then a baseline refresh — the burn
+            # window needs a pre-bad-stretch snapshot of the series
+            rt.metrics.trace_queue_to_admission_seconds.observe(
+                0.1, cluster_queue="cq-0"
+            )
+            client.healthz()
+            rt.clock.advance(5.0)
+            for _ in range(10):
+                rt.metrics.trace_queue_to_admission_seconds.observe(
+                    9.0, cluster_queue="cq-0"
+                )
+            rt.clock.advance(5.0)
+            out = client.slo()
+            assert out["enabled"]
+            assert out["degraded"]
+            health = client.healthz()
+            assert health["status"] == "degraded"
+            assert health["slo"]["degraded"]
+            assert "gateway" not in health  # no gateway attached
+            text = client.metrics_text()
+            assert "kueue_slo_degraded 1" in text
+        finally:
+            srv.stop()
+
+    def test_slo_families_exposed_at_zero(self):
+        text = Metrics().registry.expose()
+        for family in (
+            "kueue_gateway_requests_total",
+            "kueue_gateway_batches_total",
+            "kueue_gateway_shed_total",
+            "kueue_gateway_queue_depth",
+            "kueue_gateway_batch_size",
+            "kueue_gateway_flush_duration_seconds",
+            "kueue_slo_target_seconds",
+            "kueue_slo_attainment_ratio",
+            "kueue_slo_error_budget_burn_rate",
+            "kueue_slo_degraded",
+        ):
+            assert f"# TYPE {family}" in text, family
+
+    def test_zero_exposure_lint_rule(self, tmp_path):
+        from tests.test_analysis import run_fixture
+
+        bad = (
+            "class M:\n"
+            "    def __init__(self, r):\n"
+            "        self.x = r.counter('kueue_gateway_oops_total', 'h')\n"
+        )
+        good = bad + "        self.x.inc(0.0)\n"
+        findings = run_fixture(
+            tmp_path, {"metrics/m.py": bad}, rules=["metrics-families"],
+        )
+        assert any("materialized at zero" in f.message for f in findings)
+        findings = run_fixture(
+            tmp_path, {"metrics/m.py": good}, rules=["metrics-families"],
+        )
+        assert not findings
+
+
+# ---- replica fan-out trees ----
+class TestFanoutChain:
+    @pytest.fixture()
+    def chain(self, tmp_path):
+        """leader -> r1 -> r2, tails driven manually (deterministic)."""
+        from kueue_tpu.replica import ReadReplica
+
+        class Chain:
+            def __init__(self):
+                self.token = [1]
+                self.rt = fresh_rt()
+                self.journal = Journal(
+                    str(tmp_path / "journal"),
+                    segment_max_bytes=100 << 10,
+                ).open()
+                self.journal.token_provider = lambda: self.token[0]
+                self.rt.attach_journal(self.journal)
+                self.srv = KueueServer(runtime=self.rt)
+                port = self.srv.start()
+                self.leader_url = f"http://127.0.0.1:{port}"
+                self.leader = KueueClient(self.leader_url)
+                self.r1 = ReadReplica(
+                    self.leader_url, replica_id="rep-1",
+                    build_runtime=fresh_rt,
+                )
+                self.r1srv = KueueServer(replica=self.r1)
+                r1port = self.r1srv.start()
+                self.r1_url = f"http://127.0.0.1:{r1port}"
+                self.r2 = ReadReplica(
+                    self.r1_url, replica_id="rep-2",
+                    build_runtime=fresh_rt,
+                )
+                self.r2srv = KueueServer(replica=self.r2)
+                r2port = self.r2srv.start()
+                self.r2_url = f"http://127.0.0.1:{r2port}"
+                self.leader.apply("resourceflavors", {"name": "default"})
+                self.leader.apply("clusterqueues", cq_dict("cq-0"))
+                self.leader.apply(
+                    "localqueues",
+                    {"namespace": "ns", "name": "lq-0",
+                     "clusterQueue": "cq-0"},
+                )
+                self.r1.sync(resync=True)
+                self.r2.sync(resync=True)
+
+            def sync(self):
+                self.r1.sync()
+                self.r2.sync()
+
+            def states(self):
+                return [
+                    json.dumps(KueueClient(u).state(), sort_keys=True)
+                    for u in (self.leader_url, self.r1_url, self.r2_url)
+                ]
+
+            def close(self):
+                self.r2srv.stop()
+                self.r1srv.stop()
+                self.srv.stop()
+                self.journal.close()
+
+        c = Chain()
+        yield c
+        c.close()
+
+    def test_two_hop_chain_converges_with_hop_and_path_lag(self, chain):
+        for i in range(5):
+            chain.leader.apply("workloads", wl_wire(f"wl-{i}"))
+        chain.sync()
+        a, b, c = chain.states()
+        assert a == b == c, "2-hop chain must converge byte-identically"
+        # topology: r1 is hop 1 off the leader, r2 hop 2 off r1
+        assert chain.r1.tailer.hop == 1
+        assert chain.r2.tailer.hop == 2
+        assert len(chain.r2.tailer.path_lag()) == 2
+        # rosters: the leader sees rep-1 (hop 1); r1 sees rep-2 (hop 2)
+        leader_roster = chain.leader.replicas()
+        assert leader_roster["role"] == "leader"
+        ids = {r["id"]: r for r in leader_roster["items"]}
+        assert ids["rep-1"]["hop"] == 1
+        r1_roster = KueueClient(chain.r1_url).replicas()
+        assert r1_roster["role"] == "replica"
+        assert r1_roster["items"][0]["hop"] == 1
+        kids = {r["id"]: r for r in r1_roster.get("children", [])}
+        assert kids["rep-2"]["hop"] == 2
+        r2_status = KueueClient(chain.r2_url).replicas()["items"][0]
+        assert r2_status["hop"] == 2
+        assert len(r2_status["pathLagSeconds"]) == 2
+
+    def test_watch_served_from_hop_two(self, chain):
+        chain.leader.apply("workloads", wl_wire("wl-watch"))
+        chain.sync()
+        c2 = KueueClient(chain.r2_url)
+        out = c2.events()
+        assert any(
+            e["object"] == "ns/wl-watch" for e in out["items"]
+        ), "hop-2 replica must serve the mirrored event stream"
+        assert c2.served_by_replica
+
+    def test_compaction_jump_propagates_down_the_chain(self, chain):
+        for i in range(4):
+            chain.leader.apply("workloads", wl_wire(f"pre-{i}"))
+        chain.sync()
+        r1_resyncs = chain.r1.tailer.resyncs
+        r2_resyncs = chain.r2.tailer.resyncs
+        # more writes, then compact the leader's journal past both
+        # cursors BEFORE either replica polls again
+        for i in range(4):
+            chain.leader.apply("workloads", wl_wire(f"post-{i}"))
+        chain.journal.sync()
+        chain.journal.compact(chain.journal.last_seq)
+        chain.sync()
+        # r1 hit the compaction hole -> checkpoint re-anchor on the
+        # leader; its feed log reset forces r2 to re-anchor on r1
+        assert chain.r1.tailer.resyncs == r1_resyncs + 1
+        assert chain.r2.tailer.resyncs == r2_resyncs + 1
+        a, b, c = chain.states()
+        assert a == b == c
+        # and the chain keeps tailing incrementally afterwards
+        chain.leader.apply("workloads", wl_wire("after-jump"))
+        chain.sync()
+        a, b, c = chain.states()
+        assert a == b == c
+
+    def test_leader_handover_reanchors_the_whole_chain(self, chain):
+        for i in range(3):
+            chain.leader.apply("workloads", wl_wire(f"t1-{i}"))
+        chain.sync()
+        assert chain.r1.tailer.max_token == 1
+        assert chain.r2.tailer.max_token == 1
+        # handover: a new leader tenure bumps the fencing token
+        chain.token[0] = 2
+        for i in range(3):
+            chain.leader.apply("workloads", wl_wire(f"t2-{i}"))
+        chain.sync()
+        chain.sync()  # post-re-anchor incremental poll
+        assert chain.r1.tailer.max_token == 2
+        assert chain.r2.tailer.max_token == 2
+        a, b, c = chain.states()
+        assert a == b == c
+        # no resync loop: further appends tail incrementally
+        r1_resyncs = chain.r1.tailer.resyncs
+        chain.leader.apply("workloads", wl_wire("t2-post"))
+        chain.sync()
+        assert chain.r1.tailer.resyncs == r1_resyncs
+        a, b, c = chain.states()
+        assert a == b == c
+
+    def test_kueuectl_replicas_renders_hop_columns(self, chain, capsys):
+        from kueue_tpu.cli.__main__ import main as cli_main
+
+        chain.leader.apply("workloads", wl_wire("wl-cli"))
+        chain.sync()
+        cli_main(["replicas", "--server", chain.leader_url])
+        out = capsys.readouterr().out
+        assert "HOP" in out and "rep-1" in out
+        cli_main(["replicas", "--server", chain.r1_url])
+        out = capsys.readouterr().out
+        assert "rep-2" in out and "downstream replicas" in out
+        assert "PATH-LAG" in out
+
+    def test_kueuectl_slo_renders(self, chain, capsys):
+        from kueue_tpu.cli.__main__ import main as cli_main
+
+        chain.rt.slo.configure(default_target_s=1.0)
+        chain.rt.metrics.trace_queue_to_admission_seconds.observe(
+            0.2, cluster_queue="cq-0"
+        )
+        cli_main(["slo", "--server", chain.leader_url])
+        out = capsys.readouterr().out
+        assert "CLUSTERQUEUE" in out and "ATTAINMENT" in out
+        assert "cq-0" in out
