@@ -347,20 +347,10 @@ def contended_drain_bench(rng, mesh=None):
     )
 
 
-def pipelined_drain_bench(rng):
-    """Pipelined vs serial drain LOOP at the 50k north-star scale,
-    through the PRODUCTION path (ClusterRuntime.bulk_drain): chunked
-    rounds of 16 kernel cycles each, where the pipelined mode launches
-    round t+1's encode+solve against a speculative snapshot (the
-    kernel-reported final usage) while the host applies round t —
-    journal-less apply, audit + events + runtime mutation included —
-    and commits the prefetch only after the conflict check proves the
-    speculation exact (core/pipeline.py). The serial mode runs the
-    IDENTICAL rounds without prefetch, so the delta is pure overlap.
-    Admitted sets are asserted identical. Returns
-    (serial_s, pipelined_s, PipelineStats, n_admitted)."""
-    import time
-
+def _build_drain_loop_rt(mode, seed, chunk=16, megaloop="off"):
+    """The seeded 50k ClusterRuntime environment the pipeline and
+    megaloop stages share (identical objects per seed, so admitted
+    sets are comparable across modes by construction)."""
     from kueue_tpu.controllers import ClusterRuntime
     from kueue_tpu.core.scheduler import _LatencyEstimate
     from kueue_tpu.models import (
@@ -374,93 +364,114 @@ def pipelined_drain_bench(rng):
     from kueue_tpu.models.workload import PodSet
 
     class _OpenGate(_LatencyEstimate):
-        # pin the latency gate open: this stage measures the drain
+        # pin the latency gate open: these stages measure the drain
         # path itself, not the gate's host-vs-drain routing
         @property
         def value(self):
             return None
 
-    def build(mode, seed):
-        rng2 = np.random.default_rng(seed)
-        rt = ClusterRuntime(
-            bulk_drain_threshold=256,
-            drain_pipeline=mode,
-            pipeline_chunk_cycles=16,
-            drain_gate=_OpenGate(),
-        )
-        # measured A/B: no sampled divergence re-solves in the window
-        rt.guard.config.divergence_check_every = 0
-        flavors = [f"fl-{i}" for i in range(N_FLAVORS)]
-        for f in flavors:
-            rt.add_flavor(ResourceFlavor(name=f))
-        for i in range(N_CQ):
-            quotas = tuple(
-                FlavorQuotas.build(
-                    f,
-                    {
-                        "cpu": (
-                            str(int(rng2.integers(8, 64))),
-                            str(int(rng2.integers(8, 32))),
-                            None,
-                        ),
-                        "memory": (
-                            f"{int(rng2.integers(16, 128))}Gi",
-                            f"{int(rng2.integers(16, 64))}Gi",
-                            None,
-                        ),
-                    },
-                )
-                for f in flavors
-            )
-            rt.add_cluster_queue(
-                ClusterQueue(
-                    name=f"pcq-{i}",
-                    cohort=f"pcohort-{i % N_COHORT}",
-                    namespace_selector={},
-                    resource_groups=(ResourceGroup(("cpu", "memory"), quotas),),
-                )
-            )
-            rt.add_local_queue(
-                LocalQueue(
-                    namespace="ns", name=f"plq-{i}", cluster_queue=f"pcq-{i}"
-                )
-            )
-        n = N_CQ * WL_PER_CQ
-        prios = rng2.integers(0, 4, size=n) * 50
-        cpus = rng2.integers(1, 16, size=n)
-        mems = rng2.integers(1, 32, size=n)
-        counts = rng2.integers(1, 5, size=n)
-        for j in range(n):
-            rt.add_workload(
-                Workload(
-                    namespace="ns",
-                    name=f"pw{j}",
-                    queue_name=f"plq-{j % N_CQ}",
-                    priority=int(prios[j]),
-                    creation_time=float(j),
-                    pod_sets=(
-                        PodSet.build(
-                            "main",
-                            int(counts[j]),
-                            {"cpu": str(cpus[j]), "memory": f"{mems[j]}Gi"},
-                        ),
+    rng2 = np.random.default_rng(seed)
+    rt = ClusterRuntime(
+        bulk_drain_threshold=256,
+        drain_pipeline=mode,
+        pipeline_chunk_cycles=chunk,
+        drain_megaloop=megaloop,
+        drain_gate=_OpenGate(),
+    )
+    # measured A/B: no sampled divergence re-solves in the window
+    rt.guard.config.divergence_check_every = 0
+    flavors = [f"fl-{i}" for i in range(N_FLAVORS)]
+    for f in flavors:
+        rt.add_flavor(ResourceFlavor(name=f))
+    for i in range(N_CQ):
+        quotas = tuple(
+            FlavorQuotas.build(
+                f,
+                {
+                    "cpu": (
+                        str(int(rng2.integers(8, 64))),
+                        str(int(rng2.integers(8, 32))),
+                        None,
                     ),
-                )
+                    "memory": (
+                        f"{int(rng2.integers(16, 128))}Gi",
+                        f"{int(rng2.integers(16, 64))}Gi",
+                        None,
+                    ),
+                },
             )
-        rt.reconcile_once()
-        return rt
-
-    def drain(rt):
-        t0 = time.perf_counter()
-        res = rt.bulk_drain()
-        dt = time.perf_counter() - t0
-        assert res is not None, "bulk drain did not run"
-        return dt
-
-    def admitted_of(rt):
-        return frozenset(
-            k for k, wl in rt.workloads.items() if wl.has_quota_reservation
+            for f in flavors
         )
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name=f"pcq-{i}",
+                cohort=f"pcohort-{i % N_COHORT}",
+                namespace_selector={},
+                resource_groups=(ResourceGroup(("cpu", "memory"), quotas),),
+            )
+        )
+        rt.add_local_queue(
+            LocalQueue(
+                namespace="ns", name=f"plq-{i}", cluster_queue=f"pcq-{i}"
+            )
+        )
+    n = N_CQ * WL_PER_CQ
+    prios = rng2.integers(0, 4, size=n) * 50
+    cpus = rng2.integers(1, 16, size=n)
+    mems = rng2.integers(1, 32, size=n)
+    counts = rng2.integers(1, 5, size=n)
+    for j in range(n):
+        rt.add_workload(
+            Workload(
+                namespace="ns",
+                name=f"pw{j}",
+                queue_name=f"plq-{j % N_CQ}",
+                priority=int(prios[j]),
+                creation_time=float(j),
+                pod_sets=(
+                    PodSet.build(
+                        "main",
+                        int(counts[j]),
+                        {"cpu": str(cpus[j]), "memory": f"{mems[j]}Gi"},
+                    ),
+                ),
+            )
+        )
+    rt.reconcile_once()
+    return rt
+
+
+def _drain_once(rt):
+    import time
+
+    t0 = time.perf_counter()
+    res = rt.bulk_drain()
+    dt = time.perf_counter() - t0
+    assert res is not None, "bulk drain did not run"
+    return dt
+
+
+def _admitted_of(rt):
+    return frozenset(
+        k for k, wl in rt.workloads.items() if wl.has_quota_reservation
+    )
+
+
+def pipelined_drain_bench(rng):
+    """Pipelined vs serial drain LOOP at the 50k north-star scale,
+    through the PRODUCTION path (ClusterRuntime.bulk_drain): chunked
+    rounds of 16 kernel cycles each, where the pipelined mode launches
+    round t+1's encode+solve against a speculative snapshot (the
+    kernel-reported final usage) while the host applies round t —
+    journal-less apply, audit + events + runtime mutation included —
+    and commits the prefetch only after the conflict check proves the
+    speculation exact (core/pipeline.py). The serial mode runs the
+    IDENTICAL rounds without prefetch, so the delta is pure overlap.
+    Admitted sets are asserted identical. Returns
+    (serial_s, pipelined_s, PipelineStats, n_admitted)."""
+    build = _build_drain_loop_rt
+    drain = _drain_once
+    admitted_of = _admitted_of
 
     seed = int(rng.integers(1 << 30))
     _stage("pipeline: warmup (compile every chunk shape)")
@@ -485,6 +496,64 @@ def pipelined_drain_bench(rng):
         ],
     )
     return serial_s, pipe_s, stats, len(admitted_of(rt_p))
+
+
+def megaloop_drain_bench(rng):
+    """Serial vs pipelined vs MEGALOOP drain loop on the seeded 50k
+    backlog, through the production path (ClusterRuntime.bulk_drain).
+    Chunk 4 — finer-grained rounds are exactly where the per-round
+    dispatch floor dominates and where the fusion pays: the serial
+    loop dispatches once per round, the pipelined loop still
+    dispatches once per round (overlapped), the megaloop fuses up to
+    K rounds per dispatch (ops/megaloop_kernel) with the host
+    journal-less-applying the batched round-stamped log behind it.
+    Admitted sets asserted identical across ALL THREE modes. Returns
+    (serial_s, pipelined_s, megaloop_s, serial_dispatches,
+    megaloop_dispatches, MegaloopStats, n_admitted)."""
+    CHUNK = 4
+    build = _build_drain_loop_rt
+    drain = _drain_once
+    admitted_of = _admitted_of
+
+    seed = int(rng.integers(1 << 30))
+    _stage("megaloop: warmup (compile chunk + fused shapes)")
+    drain(build("serial", seed, chunk=CHUNK))
+    drain(build("on", seed, chunk=CHUNK, megaloop="16"))
+    _stage("megaloop: serial loop measured")
+    rt_s = build("serial", seed, chunk=CHUNK)
+    serial_s = drain(rt_s)
+    _stage("megaloop: pipelined loop measured")
+    rt_p = build("on", seed, chunk=CHUNK)
+    pipe_s = drain(rt_p)
+    _stage("megaloop: fused loop measured")
+    rt_m = build("on", seed, chunk=CHUNK, megaloop="16")
+    mega_s = drain(rt_m)
+    assert admitted_of(rt_s) == admitted_of(rt_p), (
+        "pipelined drain changed decisions"
+    )
+    assert admitted_of(rt_s) == admitted_of(rt_m), (
+        "megaloop drain changed decisions"
+    )
+    stats = rt_m.megaloop
+    # one dispatch per serial round vs one per fused launch
+    serial_dispatches = rt_s.pipeline.rounds
+    mega_dispatches = stats.launches
+    assert stats.rounds == rt_s.pipeline.rounds, (
+        stats.to_dict(), rt_s.pipeline.to_dict(),
+    )
+    assert mega_dispatches >= 1
+    _note_times(
+        "megaloop",
+        [
+            t.total_s
+            for t in rt_m.scheduler.last_traces
+            if t.resolution == "drain"
+        ],
+    )
+    return (
+        serial_s, pipe_s, mega_s, serial_dispatches, mega_dispatches,
+        stats, len(admitted_of(rt_m)),
+    )
 
 
 def fair_victim_search_bench(rng):
@@ -2559,6 +2628,39 @@ def _stage_pipeline() -> dict:
     }
 
 
+def _stage_megaloop() -> dict:
+    (serial_s, pipe_s, mega_s, serial_d, mega_d, stats, admitted) = (
+        megaloop_drain_bench(np.random.default_rng(17))
+    )
+    d = stats.to_dict()
+    return {
+        "megaloop_metric": (
+            f"megaloop_full_drain_wall_clock ({N_CQ * WL_PER_CQ // 1000}k "
+            f"pending x {N_CQ} CQs drained to quiescence through "
+            "ClusterRuntime bulk rounds of 4 kernel cycles: fused "
+            "K-rounds-per-dispatch megaloop [round-stamped decision "
+            "log applied by the host trailing the device, per-round "
+            "conflict checks] vs the pipelined and serial loops on "
+            f"identical inputs; {d['rounds']} rounds in "
+            f"{mega_d} dispatches vs {serial_d} serial dispatches, "
+            f"{admitted} admitted, admitted sets asserted identical "
+            "across all three modes; serial "
+            f"{round(serial_s, 2)} s, pipelined {round(pipe_s, 2)} s)"
+        ),
+        "megaloop_value": round(mega_s, 3),
+        "megaloop_unit": "s (full fused drain)",
+        "megaloop_serial_s": round(serial_s, 3),
+        "megaloop_pipelined_s": round(pipe_s, 3),
+        "megaloop_speedup_vs_serial": round(serial_s / max(mega_s, 1e-9), 2),
+        "megaloop_dispatches_per_drain": mega_d,
+        "megaloop_serial_dispatches": serial_d,
+        "megaloop_dispatch_reduction": round(serial_d / max(mega_d, 1), 2),
+        "megaloop_rounds_per_launch": d["roundsPerLaunch"],
+        "megaloop_truncations": d["truncations"],
+        "megaloop_round_spread_ms": _spread_of("megaloop"),
+    }
+
+
 def _stage_contended() -> dict:
     from kueue_tpu.core.drain import _PANEL_TUNER
 
@@ -2915,6 +3017,7 @@ def _stage_tas_drain() -> dict:
 STAGES = {
     "headline": _stage_headline,
     "pipeline": _stage_pipeline,
+    "megaloop": _stage_megaloop,
     "sharded": _stage_sharded,
     "contended": _stage_contended,
     "tas": _stage_tas,
@@ -2947,6 +3050,7 @@ HEADLINE_FALLBACK_STAGES = (
     "journal",
     "failover",
     "pipeline",
+    "megaloop",
     "federation",
     "sharded",
     "serve",
@@ -2961,6 +3065,8 @@ COMPACT_EXTRAS = (
     ("failover_divergence_overhead_pct", "divergence_overhead_pct"),
     ("federation_admissions_per_s", "admissions_per_s"),
     ("pipeline_speedup_vs_serial", "pipeline_speedup"),
+    ("megaloop_speedup_vs_serial", "megaloop_speedup"),
+    ("megaloop_dispatches_per_drain", "dispatches_per_drain"),
     ("sharded_n_devices", "n_devices"),
     ("sharded_speedup", "sharded_speedup"),
     ("serve_admissions_per_s", "admissions_per_s"),
@@ -2979,6 +3085,7 @@ SINGLE_STAGE_MODES = {
     "--journal": ["journal"],
     "--failover": ["failover"],
     "--pipeline": ["pipeline"],
+    "--megaloop": ["megaloop"],
     "--sharded": ["sharded"],
     "--federation": ["federation"],
     "--serve": ["serve"],

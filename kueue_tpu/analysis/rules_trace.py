@@ -59,6 +59,28 @@ _TRACING_CONTROL = {"while_loop", "scan", "fori_loop", "cond", "switch"}
 #: Python if over an expression containing one concretizes a tracer
 _TRACER_MODULES = {"jnp", "lax", "jax"}
 
+#: host-side EFFECT call leaves that must never be reachable from a
+#: traced body in the kernel packages: the fused drain loops
+#: (lax.while_loop bodies in ops/ and their host glue in
+#: core/drain.py) run many rounds per dispatch, so anything that
+#: journals, records events/audits or fires fault points from inside
+#: the trace would either burn in at compile time or smuggle a host
+#: effect into speculative rounds the commit check later discards —
+#: the megaloop's io_callback-free contract. Callback escapes
+#: (io_callback & friends) are listed too: the contract is "no host
+#: effects", not "no ACCIDENTAL host effects".
+_HOST_EFFECT_LEAVES = {
+    "fire", "record", "journal", "journal_hook", "record_event",
+    "io_callback", "pure_callback", "debug_callback",
+}
+
+
+def _in_effect_scope(rel: str) -> bool:
+    """The io-free contract applies to the kernel package and the
+    drain's host glue (where the fused loop bodies live)."""
+    r = "/" + rel
+    return "/ops/" in r or r.endswith("/core/drain.py")
+
 
 def _decorator_traces(dec: ast.AST) -> bool:
     dn = dotted_name(dec)
@@ -124,7 +146,9 @@ class TraceSafetyRule(Rule):
     name = "trace-safety"
     description = (
         "host calls (time/random/.item()/int() on tracers/Python if on "
-        "traced values) inside jitted or vmapped functions"
+        "traced values) inside jitted or vmapped functions; host-side "
+        "effects (journal/record/fire, callback escapes) reachable "
+        "inside traced loop bodies in ops/ + core/drain.py"
     )
 
     def check(self, src: SourceFile, ctx: AnalysisContext) -> List[Finding]:
@@ -175,6 +199,22 @@ class TraceSafetyRule(Rule):
         findings: List[Finding],
     ) -> None:
         canon = resolve_call_name(node, aliases)
+        if _in_effect_scope(src.rel):
+            dn = dotted_name(node.func)
+            leaf = (canon or dn or "").rsplit(".", 1)[-1]
+            if leaf in _HOST_EFFECT_LEAVES:
+                findings.append(
+                    Finding(
+                        self.name, src.rel, node.lineno,
+                        f"host-side effect call {dn or leaf}() inside "
+                        f"jitted `{fn.name}` — nothing inside a fused "
+                        "device loop may touch the journal, events, "
+                        "audit or fault points (the megaloop's "
+                        "io_callback-free contract); move the effect "
+                        "to the host side of the launch/fetch split",
+                    )
+                )
+                return
         if canon in _FROZEN_HOST_CALLS:
             findings.append(
                 Finding(

@@ -37,6 +37,34 @@ from kueue_tpu.utils.clock import Clock
 __all__ = ["ClusterRuntime", "Event"]
 
 
+def _parse_megaloop(spec) -> tuple:
+    """Normalize the megaloop knob (server ``--megaloop on|off|K``):
+    returns ``(mode, rounds)`` with mode "on"|"off" and rounds 0 for
+    online tuning, >0 for a pinned K."""
+    if spec is None:
+        return "off", 0
+    if isinstance(spec, bool):
+        return ("on", 0) if spec else ("off", 0)
+    if isinstance(spec, int):
+        if spec <= 0:
+            return "off", 0
+        return "on", int(spec)
+    text = str(spec).strip().lower()
+    if text in ("off", "", "0"):
+        return "off", 0
+    if text == "on":
+        return "on", 0
+    try:
+        rounds = int(text)
+    except ValueError:
+        raise ValueError(
+            f"drain_megaloop must be on|off|K, got {spec!r}"
+        ) from None
+    if rounds <= 0:
+        return "off", 0
+    return "on", rounds
+
+
 class ClusterRuntime:
     def __init__(
         self,
@@ -62,6 +90,16 @@ class ClusterRuntime:
         # pre-pipeline single-dispatch drain.
         drain_pipeline: str = "on",
         pipeline_chunk_cycles: int = 16,
+        # Device-resident admission megaloop (ops/megaloop_kernel): fuse
+        # up to K drain rounds of ``pipeline_chunk_cycles`` kernel
+        # cycles each into ONE dispatch, the host journaling/applying
+        # the batched round-stamped decision log trailing the device,
+        # each round validated by the pipeline's conflict-check
+        # contract. "off" (default) = per-round launches; "on" = K
+        # tuned online per backlog mix (guard.RoundsTuner); an int pins
+        # K. Composes with drain_pipeline ("on" also prefetches the
+        # NEXT fused launch speculatively) and with mesh.
+        drain_megaloop="off",
         # Multi-chip admission (kueue_tpu/parallel): a jax.sharding.Mesh
         # — or an operator spec ("auto" | "off" | a device count,
         # resolved via parallel.resolve_mesh) — shards every
@@ -273,6 +311,15 @@ class ClusterRuntime:
         self.pipeline_chunk_cycles = max(1, int(pipeline_chunk_cycles))
         self.pipeline = PipelineStats()
         self._pipeline_committed = 0  # committed prefetches (divergence sampling)
+        # Megaloop state (core/pipeline.MegaloopStats + the K knob):
+        # megaloop_rounds 0 = tune online (guard.RoundsTuner), >0 = pin
+        from kueue_tpu.core.pipeline import MegaloopStats
+
+        self.drain_megaloop, self.megaloop_rounds = _parse_megaloop(
+            drain_megaloop
+        )
+        self.megaloop = MegaloopStats()
+        self._megaloop_launches = 0  # divergence-sampling schedule
         # Multi-chip admission state: the resolved mesh, its metric
         # posture, and the resident drain encode (single-device
         # pipelined rounds keep quota/hierarchy buffers on device and
@@ -282,6 +329,12 @@ class ClusterRuntime:
         self._mesh_place_seen = 0.0
         self._drain_resident = None
         self.set_mesh(mesh)
+
+    def set_megaloop(self, spec) -> None:
+        """Configure the fused drain (server ``--megaloop on|off|K``):
+        "off" = per-round launches, "on" = K tuned online per backlog
+        mix, an int pins K."""
+        self.drain_megaloop, self.megaloop_rounds = _parse_megaloop(spec)
 
     # ---- admission policy (kueue_tpu/policy) ----
     def set_policy(self, policy, journal: bool = True) -> None:
@@ -1392,7 +1445,15 @@ class ClusterRuntime:
             # the double-buffered chunked loop (core/pipeline.py) —
             # plain scope only: speculation needs nothing beyond the
             # kernel-reported final usage, and the conflict check
-            # proves each commit; other scopes keep the one-shot path
+            # proves each commit; other scopes keep the one-shot path.
+            # With the megaloop enabled the same rounds FUSE into
+            # K-rounds-per-dispatch launches (ops/megaloop_kernel),
+            # validated round-by-round by the identical contract.
+            if self.drain_megaloop == "on":
+                return self._megaloop_bulk_drain(
+                    snapshot, pending, ts_fn, t_snapshot, t_classify,
+                    prefetch=self.drain_pipeline == "on",
+                )
             return self._pipelined_bulk_drain(
                 snapshot, pending, ts_fn, t_snapshot, t_classify,
                 prefetch=self.drain_pipeline == "on",
@@ -1483,6 +1544,407 @@ class ClusterRuntime:
         self._report_cycle_metrics(result, dt)
         sched.notify_cycle(result)
         return result
+
+    def _megaloop_bulk_drain(
+        self, snapshot, pending, ts_fn, t_snapshot, t_classify,
+        prefetch=True,
+    ):
+        """The fused drain loop (ops/megaloop_kernel): ONE dispatch
+        computes up to K drain rounds of ``pipeline_chunk_cycles``
+        kernel cycles each entirely on device — encode→solve→usage
+        carry across rounds with per-round head re-packs on device —
+        and the host journals/applies/audits the batched round-stamped
+        decision log trailing it. Every round past the first is
+        validated by the pipeline's conflict-check contract
+        (``drain_inputs_match`` + ``pending_matches`` against the REAL
+        post-apply state); any mismatch truncates the batch at that
+        round, discards the rest of the device log and re-solves from
+        the real state — so correctness never rests on the fused
+        continuation. With ``prefetch`` (drain_pipeline "on") the NEXT
+        fused launch dispatches speculatively from the final round's
+        kernel usage while the host is still applying the batch.
+
+        Guard coverage: the deadline spans the whole launch→fetch
+        window scaled by K; sampled divergence checks replay ONE
+        pseudo-randomly chosen round of every N-th launch against the
+        numpy drain mirror BEFORE applying it (surface
+        "drain-megaloop"); the online RoundsTuner picks K per backlog
+        mix unless ``--megaloop K`` pins it. Fault points:
+        ``cycle.megaloop_launched`` after every fused dispatch,
+        ``cycle.megaloop_commit_round`` after every passed per-round
+        conflict check (nothing speculative is journaled before it)."""
+        import time as _time
+
+        from kueue_tpu.core.drain import launch_drain_megaloop, run_drain
+        from kueue_tpu.core.pipeline import (
+            drain_inputs_match,
+            outcome_signature,
+            pending_matches,
+            speculative_snapshot,
+        )
+        from kueue_tpu.core.scheduler import CycleTrace
+        from kueue_tpu.core.snapshot import take_snapshot
+        from kueue_tpu.testing import faults
+
+        sched = self.scheduler
+        stats = self.megaloop
+        pstats = self.pipeline
+        chunk = self.pipeline_chunk_cycles
+        flavors = self.cache.flavors
+        last_result = None
+        mesh = self.mesh
+        if mesh is None and self._drain_resident is None:
+            from kueue_tpu.core.encode import ResidentEncoder
+
+            self._drain_resident = ResidentEncoder()
+        resident = self._drain_resident if mesh is None else None
+        # one policy clock for the whole fused drain (the sampled
+        # divergence replay must compile identical score tensors)
+        policy, pol_now = self.policy, self.clock.now()
+        tuner = sched.guard.rounds_tuner
+
+        def _k_for(n):
+            return (
+                self.megaloop_rounds
+                if self.megaloop_rounds
+                else tuner.k_for(n)
+            )
+
+        def _launch(snap, pend, k, label):
+            dl = sched.guard.device_launch(
+                lambda: launch_drain_megaloop(
+                    snap, pend, flavors, timestamp_fn=ts_fn,
+                    chunk_cycles=chunk, max_rounds=k, mesh=mesh,
+                    resident=resident, policy=policy, now=pol_now,
+                ),
+                label=label,
+                # the fused launch legitimately runs K rounds of
+                # device work: the deadline still covers the WHOLE
+                # launch→fetch window, scaled to the batch
+                deadline_s=sched.guard.config.device_deadline_s
+                * max(k, 1),
+            )
+            faults.fire("cycle.megaloop_launched")
+            return dl
+
+        def _set_inflight(v):
+            pstats.set_inflight(v)
+            self.metrics.pipeline_inflight.set(v)
+
+        k = _k_for(len(pending))
+        t1 = _time.perf_counter()
+        glaunch = _launch(snapshot, pending, k, "megaloop drain")
+        t_dispatch = _time.perf_counter() - t1
+        launches = 0
+        first_trace = True
+        while True:
+            t1 = _time.perf_counter()
+            out_g = sched.guard.device_join(glaunch, lambda h: h.fetch())
+            t_solve = t_dispatch + (_time.perf_counter() - t1)
+            pstats.note_solve(t_solve)
+            _set_inflight(0)
+            if out_g.result is None:
+                # contained launch/fetch failure or deadline breach:
+                # undecided heads stay in their heaps; the breaker
+                # decides whether the next iteration retries the device
+                return last_result
+            log = out_g.result
+            handle = glaunch.handle
+            launches += 1
+            self._megaloop_launches += 1
+            stats.note_launch(k, len(log.rounds))
+            self.metrics.megaloop_launches_total.inc()
+            sched.guard.phase_checkpoint("drain.solve", device_used=True)
+            faults.fire("cycle.post_solve_pre_apply")
+            self._drain_est.observe(t_solve / max(len(pending), 1))
+
+            # sampled divergence: every N-th launch replays ONE round
+            # of the batch against the numpy mirror before applying it
+            verify_round = -1
+            if sched.guard.should_sample_drain(self._megaloop_launches):
+                verify_round = sched.guard.pick_replay_round(
+                    len(log.rounds)
+                )
+
+            # ---- speculative prefetch of the NEXT fused launch ----
+            pf = pf_snap = pf_pending = None
+            pf_k = 0
+            t_prefetch = 0.0
+            if (
+                prefetch
+                and log.truncated
+                and log.rounds
+                and verify_round < 0
+                and sched.guard.allow_device()
+            ):
+                last_round = log.rounds[-1]
+                t1 = _time.perf_counter()
+                pf_snap = speculative_snapshot(
+                    snapshot, last_round.final_usage
+                )
+                pf_pending = list(last_round.undecided)
+                pf_k = _k_for(len(pf_pending))
+                pf = _launch(
+                    pf_snap, pf_pending, pf_k, "megaloop prefetch"
+                )
+                t_prefetch = _time.perf_counter() - t1
+                if pf.failed:
+                    pf = None
+                else:
+                    pstats.note_prefetch()
+                    _set_inflight(1)
+
+            # ---- apply the log round by round, trailing the device ----
+            committed = 0
+            truncated_batch = False
+            stalled = False
+            snapshot2 = pending2 = None
+            for r, outcome in enumerate(log.rounds):
+                t_commit = 0.0
+                adopt_host = False
+                if r > 0:
+                    t1 = _time.perf_counter()
+                    # the round's implied inputs (previous round's
+                    # kernel usage over its undecided backlog) must
+                    # equal the REAL post-apply state, or the rest of
+                    # the device log is stale and is discarded
+                    snapshot2 = take_snapshot(self.cache)
+                    pending2 = self.drain_backlog(snapshot2)
+                    prev = log.rounds[r - 1]
+                    spec = speculative_snapshot(
+                        snapshot, prev.final_usage
+                    )
+                    ok = (
+                        bool(pending2)
+                        and pending_matches(prev.undecided, pending2)
+                        and drain_inputs_match(spec, snapshot2)
+                    )
+                    t_commit = _time.perf_counter() - t1
+                    if not ok:
+                        truncated_batch = True
+                        stats.note_truncation()
+                        self.metrics.megaloop_truncations_total.inc()
+                        sched.tracer.add_cycle_span(
+                            "cycle.discard",
+                            attrs={
+                                "why": "megaloop batch truncated",
+                                "round": r,
+                            },
+                        )
+                        break
+                    faults.fire("cycle.megaloop_commit_round")
+                if r == verify_round:
+                    snap_v = (
+                        snapshot
+                        if r == 0
+                        else speculative_snapshot(
+                            snapshot, log.rounds[r - 1].final_usage
+                        )
+                    )
+                    pend_v = (
+                        list(pending)
+                        if r == 0
+                        else list(log.rounds[r - 1].undecided)
+                    )
+                    host = sched.guard.check_drain_divergence(
+                        outcome_signature(outcome),
+                        lambda: (
+                            lambda o: (o, outcome_signature(o))
+                        )(
+                            run_drain(
+                                snap_v, pend_v, flavors,
+                                timestamp_fn=ts_fn, max_cycles=chunk,
+                                use_device=False, policy=policy,
+                                now=pol_now,
+                            )
+                        ),
+                        heads=len(pend_v),
+                        surface="drain-megaloop",
+                    )
+                    if host is not None:
+                        # device path quarantined: apply the host
+                        # authority for THIS round and discard the
+                        # rest of the device log
+                        outcome = host
+                        adopt_host = True
+                        truncated_batch = True
+                decided = bool(outcome.admitted or outcome.parked)
+                if not decided:
+                    # the round decided NOTHING (unrepresentable or
+                    # stuck-frozen remainder): the cycle loop owns the
+                    # rest; returning the last applied round keeps
+                    # run_until_idle's fingerprint honest — a relaunch
+                    # over the same backlog would stall identically
+                    stalled = True
+                    break
+
+                sched.guard.begin_cycle()
+                t1 = _time.perf_counter()
+                sched.scheduling_cycle += 1
+                sched.tracer.next_cycle(sched.scheduling_cycle)
+                if committed == 0:
+                    # the per-launch span: its children are this
+                    # launch's per-round cycle traces, synthesized at
+                    # commit time from the batched log
+                    sched.tracer.add_cycle_span(
+                        "cycle.megaloop",
+                        t_solve,
+                        attrs={"k": k, "rounds": len(log.rounds)},
+                    )
+                try:
+                    result = self._apply_drain_outcome(outcome, snapshot)
+                except faults.InjectedCrash:
+                    raise  # simulated power loss: the chaos window
+                except Exception as exc:  # noqa: BLE001 — contained
+                    sched.guard.note_contained_cycle(exc)
+                    sched.tracer.discard_cycle()
+                    _set_inflight(0)
+                    return last_result
+                t_apply = _time.perf_counter() - t1
+                pstats.note_apply(t_apply, overlapped=pf is not None)
+                self.metrics.pipeline_overlap_ratio.set(
+                    pstats.overlap_ratio
+                )
+                sched.guard.phase_checkpoint(
+                    "drain.apply", device_used=True
+                )
+                committed += 1
+
+                spans = {
+                    "solve": t_solve if committed == 1 else 0.0,
+                    "apply": t_apply,
+                    "prefetch": t_prefetch if committed == 1 else 0.0,
+                    "commit": t_commit,
+                }
+                if first_trace:
+                    spans["snapshot"] = t_snapshot
+                    spans["classify"] = t_classify
+                    first_trace = False
+                self._note_mesh_metrics()
+                dt = sum(spans.values())
+                trace = CycleTrace(
+                    cycle=sched.scheduling_cycle,
+                    heads=len(outcome.admitted)
+                    + len(outcome.parked)
+                    + len(outcome.fallback),
+                    admitted=len(result.admitted),
+                    preempting=len(result.preempting),
+                    resolution="drain",
+                    total_s=dt,
+                    spans=spans,
+                    device_s=t_solve if committed == 1 else 0.0,
+                    host_s=dt - (t_solve if committed == 1 else 0.0),
+                    mesh=self._mesh_label,
+                )
+                sched.tracer.record_cycle(trace)
+                sched.last_traces.append(trace)
+                self._report_cycle_metrics(result, dt)
+                sched.notify_cycle(result)
+                last_result = result
+                if adopt_host:
+                    # the rest of the device log is quarantined work
+                    break
+
+            stats.note_committed(committed)
+            self.metrics.megaloop_rounds_per_launch.set(
+                stats.rounds_per_launch
+            )
+            exhausted_clean = (
+                not truncated_batch
+                and log.truncated
+                and committed == len(log.rounds)
+            )
+            if exhausted_clean:
+                stats.note_exhausted()
+            if not self.megaloop_rounds:
+                tuner.observe(len(pending), committed, truncated_batch)
+
+            if stalled:
+                if pf is not None:
+                    pstats.note_discard()
+                    self.metrics.pipeline_prefetch_discards_total.inc()
+                _set_inflight(0)
+                return last_result
+
+            if truncated_batch:
+                # rounds past the mismatch are stale: drop any
+                # speculative next launch and re-solve from the REAL
+                # state (the serial fallback the contract promises)
+                if pf is not None:
+                    pstats.note_discard()
+                    self.metrics.pipeline_prefetch_discards_total.inc()
+                    _set_inflight(0)
+                if snapshot2 is None:
+                    snapshot2 = take_snapshot(self.cache)
+                    pending2 = self.drain_backlog(snapshot2)
+                if not pending2 or not sched.guard.allow_device():
+                    return last_result
+                k = _k_for(len(pending2))
+                snapshot, pending = snapshot2, pending2
+                t1 = _time.perf_counter()
+                glaunch = _launch(snapshot, pending, k, "megaloop drain")
+                t_dispatch = _time.perf_counter() - t1
+                continue
+
+            # fully-committed batch: the kernel's final usage IS the
+            # post-apply state — the resident buffers adopt the device
+            # slice so the next launch ships zero usage rows
+            if (
+                resident is not None
+                and committed
+                and committed == len(log.rounds)
+            ):
+                final = log.rounds[-1]
+                resident.adopt(
+                    handle.usage_dev(len(log.rounds) - 1),
+                    final.final_usage,
+                )
+
+            if not log.truncated:
+                # quiesced within the batch: done
+                _set_inflight(0)
+                return last_result
+
+            # batch exhausted its K rounds with work left: validate
+            # the final state and either commit the prefetched next
+            # launch or dispatch a fresh one
+            snapshot2 = take_snapshot(self.cache)
+            pending2 = self.drain_backlog(snapshot2)
+            if not pending2:
+                if pf is not None:
+                    pstats.note_discard()
+                    self.metrics.pipeline_prefetch_discards_total.inc()
+                _set_inflight(0)
+                return last_result
+            last_round = log.rounds[-1]
+            commit_pf = (
+                pf is not None
+                and pf_snap is not None
+                and pending_matches(last_round.undecided, pending2)
+                and drain_inputs_match(pf_snap, snapshot2)
+            )
+            if commit_pf:
+                pstats.note_commit()
+                self._pipeline_committed += 1
+                faults.fire("cycle.megaloop_commit_round")
+                glaunch, t_dispatch, k = pf, 0.0, pf_k
+            else:
+                if pf is not None:
+                    pstats.note_discard()
+                    self.metrics.pipeline_prefetch_discards_total.inc()
+                _set_inflight(0)
+                if not sched.guard.allow_device():
+                    return last_result
+                k = _k_for(len(pending2))
+                t1 = _time.perf_counter()
+                glaunch = _launch(
+                    snapshot2, pending2, k, "megaloop drain"
+                )
+                t_dispatch = _time.perf_counter() - t1
+            snapshot, pending = snapshot2, pending2
+            if launches >= 100000:
+                _set_inflight(0)
+                return last_result
 
     def _pipelined_bulk_drain(
         self, snapshot, pending, ts_fn, t_snapshot, t_classify,
@@ -1610,6 +2072,7 @@ class ClusterRuntime:
                     lambda: launch_drain(
                         pf_snap, undecided, flavors, timestamp_fn=ts_fn,
                         max_cycles=chunk, mesh=mesh, resident=resident,
+                        policy=policy, now=pol_now,
                     ),
                     label="pipelined drain prefetch",
                 )

@@ -2123,6 +2123,16 @@ def launch_drain(
         plan.max_cycles = max_cycles
     queues_np = plan.queues_np
     if mesh is not None:
+        if resident is not None:
+            # documented single-device-only: reject loudly instead of
+            # silently ignoring the resident buffers (the mesh path
+            # re-places inputs with their shardings every round —
+            # device_put onto shards IS its transfer plan)
+            raise ValueError(
+                "launch_drain(resident=...) is single-device only: the "
+                "mesh path re-places inputs with their shardings every "
+                "round — pass resident=None under a mesh"
+            )
         from kueue_tpu.parallel import harness
         from kueue_tpu.parallel.sharded_solver import (
             pad_queue_arrays,
@@ -2169,6 +2179,362 @@ def launch_drain(
         usage_shape=tuple(snapshot.local_usage.shape),
         pending=list(pending),
         max_cycles=plan.max_cycles,
+    )
+
+
+def _cap_suffix_of(plan: DrainPlan) -> np.ndarray:
+    """int32[Q, L] suffix retry budgets: ``cap[q, p]`` is the
+    ``retry_cap`` a fresh ``plan_drain`` over the queue's entries at
+    positions >= p would compute (min(4096, max walk_states + 1)) —
+    what the megaloop gathers at each round boundary so its in-kernel
+    continuation budgets match a serial re-plan's. Column 0 equals
+    ``plan.queues_np['retry_cap']`` by construction."""
+    q = plan.queues_np["qlen"].shape[0]
+    l = plan.queues_np["cells"].shape[1]
+    cap = np.zeros((q, l), dtype=np.int32)
+    per_q: Dict[int, List[Tuple[int, int]]] = {}
+    for (qi, pos), i in plan.head_of.items():
+        per_q.setdefault(qi, []).append((pos, i))
+    ws = plan.lowered.walk_states
+    for qi, items in per_q.items():
+        items.sort()
+        vals = np.array([ws[i] for _, i in items], dtype=np.int64)
+        sfx = np.maximum.accumulate(vals[::-1])[::-1]
+        cap[qi, : len(items)] = np.minimum(4096, sfx + 1).astype(np.int32)
+    return cap
+
+
+@dataclass
+class MegaloopLog:
+    """The host-decoded round-stamped decision log of one fused launch:
+    one DrainOutcome per executed round, in round order — exactly the
+    sequence of outcomes K serial ``launch_drain(max_cycles=chunk)``
+    rounds would have fetched (asserted against the serial mirror in
+    tests/test_megaloop.py). ``truncated`` means the final round still
+    left entries undecided: the megaloop exhausted its round budget and
+    the caller relaunches from the real post-apply state."""
+
+    rounds: List[DrainOutcome]
+    n_rounds: int
+    cycles: int
+    truncated: bool
+
+
+@dataclass
+class MegaloopLaunch:
+    """An in-flight fused megaloop dispatch (the launch/fetch split of
+    ``launch_drain`` extended to K rounds): ONE dispatch, ONE fetch for
+    the whole batch. Nothing between construction and ``fetch`` touches
+    runtime state, so an unfetched launch is always safe to discard."""
+
+    plan: DrainPlan
+    queues_np: dict
+    flat_dev: object  # unfetched device array (the packed log)
+    usage_shape: Tuple[int, int]
+    start_usage: np.ndarray  # launch-time leaf usage (row-0 fallback)
+    pending: List[Tuple[Workload, str]]
+    chunk_cycles: int
+    max_rounds: int
+
+    def _usage_offset(self, r: int) -> int:
+        q, l, p = self.queues_np["cells"].shape[:3]
+        n, fr = self.usage_shape
+        return (
+            q * l * p + 2 * q * l + 2 * self.max_rounds * q
+            + self.max_rounds + r * n * fr
+        )
+
+    def usage_dev(self, r: int):
+        """Round r's final leaf usage as a DEVICE slice of the packed
+        log — the in-loop usage carry the ResidentEncoder adopts after
+        a fully-committed launch (no host round trip)."""
+        n, fr = self.usage_shape
+        off = self._usage_offset(r)
+        return self.flat_dev[off : off + n * fr].reshape((n, fr))
+
+    def fetch(self) -> MegaloopLog:
+        flat = np.asarray(self.flat_dev)  # the single fetch
+        q, l, p = self.queues_np["cells"].shape[:3]
+        n, fr = self.usage_shape
+        rr = self.max_rounds
+        qlp, ql = q * l * p, q * l
+        off = 0
+        adm_k = flat[off : off + qlp].reshape((q, l, p)); off += qlp
+        adm_cycle = flat[off : off + ql].reshape((q, l)); off += ql
+        adm_round = flat[off : off + ql].reshape((q, l)); off += ql
+        r_cursor = flat[off : off + rr * q].reshape((rr, q)); off += rr * q
+        r_stuck = (
+            flat[off : off + rr * q].reshape((rr, q)).astype(bool)
+        ); off += rr * q
+        r_cycles = flat[off : off + rr]; off += rr
+        r_usage = flat[off : off + rr * n * fr].reshape((rr, n, fr))
+        n_rounds = int(flat[-2])
+        cycles = int(flat[-1])
+        rounds = _map_megaloop_rounds(
+            self.plan, self.queues_np, adm_k, adm_cycle, adm_round,
+            r_cursor, r_stuck, r_cycles, r_usage, n_rounds,
+            self.start_usage,
+        )
+        return MegaloopLog(
+            rounds=rounds,
+            n_rounds=n_rounds,
+            cycles=cycles,
+            truncated=bool(rounds and rounds[-1].undecided),
+        )
+
+
+def _map_megaloop_rounds(
+    plan: DrainPlan,
+    queues_np: dict,
+    adm_k,
+    adm_cycle,
+    adm_round,
+    r_cursor,
+    r_stuck,
+    r_cycles,
+    r_usage,
+    n_rounds: int,
+    start_usage: np.ndarray,
+) -> List[DrainOutcome]:
+    """Slice the fused log into per-round DrainOutcomes — each
+    bit-for-bit what ``_map_drain_result`` would have produced for a
+    serial round launched over the previous round's undecided backlog:
+    round scope is the entries past the previous cursor in queues not
+    yet retired; unreached entries route to fallback (and to
+    ``undecided`` unless their queue went stuck); the structural
+    ``plan.fallback`` set belongs to round 0 only (later serial rounds
+    are planned over undecided entries, all representable)."""
+    lowered = plan.lowered
+    q = queues_np["qlen"].shape[0]
+    rounds: List[DrainOutcome] = []
+    prev_cursor = np.zeros(q, dtype=np.int64)
+    prev_dead = np.zeros(q, dtype=bool)
+    for r in range(max(int(n_rounds), 1)):
+        ran = r < int(n_rounds)
+        cursor_r = np.asarray(r_cursor[r] if ran else prev_cursor)
+        stuck_r = (
+            np.asarray(r_stuck[r]).astype(bool) if ran else prev_dead
+        )
+        cycles_r = int(r_cycles[r]) if ran else 0
+        usage_r = (
+            np.asarray(r_usage[r]) if ran else np.asarray(start_usage)
+        )
+        admitted: List[Tuple[Workload, str, Dict[str, str], int]] = []
+        parked: List[Tuple[Workload, str]] = []
+        fb_extra: List[Tuple[Workload, str]] = []
+        undecided: List[Tuple[Workload, str]] = []
+        for (qi, pos), i in plan.head_of.items():
+            if prev_dead[qi] or pos < prev_cursor[qi]:
+                continue  # decided (or retired) in an earlier round
+            wl = lowered.heads[i]
+            cq_name = lowered.cq_names[i]
+            if int(adm_round[qi, pos]) == r:
+                admitted.append(
+                    (wl, cq_name,
+                     _admitted_flavors(lowered, i, adm_k[qi, pos]),
+                     int(adm_cycle[qi, pos]))
+                )
+            elif pos >= int(cursor_r[qi]):
+                # never processed this round: no decision; stuck-frozen
+                # queues are terminal, the rest feed the next round
+                fb_extra.append((wl, cq_name))
+                if not stuck_r[qi]:
+                    undecided.append((wl, cq_name))
+            else:
+                parked.append((wl, cq_name))
+        admitted.sort(key=lambda t: t[3])
+        fb = (
+            [(lowered.heads[i], lowered.cq_names[i]) for i in plan.fallback]
+            if r == 0
+            else []
+        ) + fb_extra
+        rounds.append(
+            DrainOutcome(
+                admitted=admitted,
+                parked=parked,
+                fallback=fb,
+                cycles=cycles_r,
+                truncated=bool(undecided),
+                undecided=undecided,
+                final_usage=usage_r.astype(np.int64, copy=False),
+            )
+        )
+        prev_cursor = cursor_r.astype(np.int64).copy()
+        prev_dead = prev_dead | stuck_r
+    return rounds
+
+
+def launch_drain_megaloop(
+    snapshot: Snapshot,
+    pending: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    max_candidates: int = 8,
+    max_cells: int = 4,
+    timestamp_fn=None,
+    chunk_cycles: int = 16,
+    max_rounds: int = 8,
+    mesh=None,  # jax.sharding.Mesh: shard the Q axis across devices
+    resident=None,  # core.encode.ResidentEncoder (single-device only)
+    policy=None,  # kueue_tpu/policy AdmissionPolicy (scored admission)
+    now: float = 0.0,
+) -> MegaloopLaunch:
+    """Plan + DISPATCH the fused K-round megaloop without fetching —
+    ``launch_drain`` with the host round trip amortized over up to
+    ``max_rounds`` drain rounds of ``chunk_cycles`` kernel cycles each
+    (ops/megaloop_kernel.solve_drain_megaloop). The policy score
+    tensors flow through ``plan_drain`` unchanged, so the megaloop is
+    policy-complete, not a first-fit fast path.
+
+    With ``mesh`` the per-queue tensors (and the suffix retry budgets)
+    shard along ``wl`` exactly like ``launch_drain(mesh=...)``. With
+    ``resident`` (single-device only; a passed resident under a mesh
+    raises — see launch_drain) the quota tree + paths stay
+    device-resident between launches."""
+    import time as _time
+
+    from kueue_tpu._jax import jnp
+    from kueue_tpu.ops.drain_kernel import DrainQueues
+    from kueue_tpu.ops.megaloop_kernel import (
+        solve_drain_megaloop_packed_jit,
+    )
+
+    plan = plan_drain(
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn,
+        policy=policy, now=now,
+    )
+    cap_suffix = _cap_suffix_of(plan)
+    queues_np = plan.queues_np
+    if mesh is not None:
+        if resident is not None:
+            raise ValueError(
+                "launch_drain_megaloop(resident=...) is single-device "
+                "only: the mesh path re-places inputs with their "
+                "shardings every launch (device_put onto shards IS its "
+                "transfer plan) — pass resident=None under a mesh"
+            )
+        from kueue_tpu.parallel import harness
+        from kueue_tpu.parallel.sharded_solver import (
+            _sh,
+            pad_queue_arrays,
+            place_drain_inputs,
+        )
+
+        t0p = _time.perf_counter()
+        mult = mesh.shape["wl"]
+        queues_np = pad_queue_arrays(queues_np, mult)
+        q_pad = queues_np["qlen"].shape[0]
+        if cap_suffix.shape[0] < q_pad:
+            cap_suffix = np.concatenate(
+                [
+                    cap_suffix,
+                    np.zeros(
+                        (q_pad - cap_suffix.shape[0], cap_suffix.shape[1]),
+                        dtype=cap_suffix.dtype,
+                    ),
+                ]
+            )
+        tree, paths, _ = tree_arrays(snapshot)
+        tree, usage_in, queues, paths = place_drain_inputs(
+            mesh, tree, snapshot.local_usage, DrainQueues(**queues_np), paths
+        )
+        from kueue_tpu._jax import jax as _jax
+
+        cap_in = _jax.device_put(cap_suffix, _sh(mesh, "wl", None))
+        harness.note_place_seconds(_time.perf_counter() - t0p)
+        harness.note_bucket(
+            "megaloop_kernel",
+            (
+                queues_np["cells"].shape, plan.n_segments, plan.n_steps,
+                chunk_cycles, max_rounds,
+            ),
+            mesh,
+        )
+    else:
+        if resident is not None:
+            tree, paths, usage_in = resident.refresh(snapshot)
+        else:
+            tree, paths, _ = tree_arrays(snapshot)
+            usage_in = jnp.asarray(snapshot.local_usage)
+        queues = DrainQueues(
+            **{k: jnp.asarray(v) for k, v in queues_np.items()}
+        )
+        cap_in = jnp.asarray(cap_suffix)
+    flat_dev = solve_drain_megaloop_packed_jit(
+        tree,
+        usage_in,
+        queues,
+        paths,
+        cap_in,
+        n_segments=plan.n_segments,
+        n_steps=plan.n_steps,
+        chunk_cycles=int(chunk_cycles),
+        max_rounds=int(max_rounds),
+    )
+    return MegaloopLaunch(
+        plan=plan,
+        queues_np=queues_np,
+        flat_dev=flat_dev,
+        usage_shape=tuple(snapshot.local_usage.shape),
+        start_usage=np.asarray(snapshot.local_usage),
+        pending=list(pending),
+        chunk_cycles=int(chunk_cycles),
+        max_rounds=int(max_rounds),
+    )
+
+
+def run_drain_megaloop_host(
+    snapshot: Snapshot,
+    pending: Sequence[Tuple[Workload, str]],
+    flavors: Dict[str, ResourceFlavor],
+    max_candidates: int = 8,
+    max_cells: int = 4,
+    timestamp_fn=None,
+    chunk_cycles: int = 16,
+    max_rounds: int = 8,
+    policy=None,
+    now: float = 0.0,
+) -> MegaloopLog:
+    """The megaloop's numpy HOST AUTHORITY twin over the identical
+    plan tensors (ops/megaloop_np.solve_megaloop_np — which IS the
+    serial chunked loop over suffix-trimmed queues), decoded through
+    the same ``_map_megaloop_rounds``. Bit-for-bit the device log's
+    decisions, property-tested in tests/test_megaloop.py."""
+    from kueue_tpu.core.encode import encode_snapshot
+    from kueue_tpu.ops.assign_kernel import build_paths
+    from kueue_tpu.ops.megaloop_np import solve_megaloop_np
+
+    plan = plan_drain(
+        snapshot, pending, flavors, max_candidates, max_cells, timestamp_fn,
+        policy=policy, now=now,
+    )
+    cap_suffix = _cap_suffix_of(plan)
+    enc = encode_snapshot(snapshot)
+    paths_np = build_paths(enc.parent, enc.max_depth)
+    host = solve_megaloop_np(
+        enc.parent,
+        enc.level_mask,
+        enc.nominal.astype(np.int64, copy=False),
+        enc.lending_limit.astype(np.int64, copy=False),
+        enc.borrowing_limit.astype(np.int64, copy=False),
+        enc.local_usage.astype(np.int64, copy=False),
+        plan.queues_np,
+        paths_np,
+        enc.max_depth,
+        int(chunk_cycles),
+        int(max_rounds),
+        cap_suffix,
+    )
+    rounds = _map_megaloop_rounds(
+        plan, plan.queues_np, host.admitted_k, host.admitted_cycle,
+        host.admitted_round, host.round_cursor, host.round_stuck,
+        host.round_cycles, host.round_usage, host.rounds,
+        np.asarray(snapshot.local_usage),
+    )
+    return MegaloopLog(
+        rounds=rounds,
+        n_rounds=host.rounds,
+        cycles=host.cycles,
+        truncated=bool(rounds and rounds[-1].undecided),
     )
 
 

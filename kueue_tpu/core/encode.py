@@ -357,9 +357,18 @@ class ResidentEncoder:
     snapshot (asserted in tests/test_mesh_drain.py): the delta path
     only ever fires when the structure fingerprint — CQ row order,
     cohort edges, the quota triple — is unchanged, and ANY config
-    mutation falls back to a full re-encode. Single-device only: the
+    mutation falls back to a full re-encode. SINGLE-DEVICE ONLY: the
     mesh path re-places inputs with their shardings every round
-    (``device_put`` onto shards IS its transfer plan)."""
+    (``device_put`` onto shards IS its transfer plan) — passing a
+    resident together with a mesh raises in ``launch_drain`` /
+    ``launch_drain_megaloop`` rather than silently ignoring it.
+
+    The megaloop (ops/megaloop_kernel) extends the residency to the
+    usage itself: the kernel carries leaf usage across K fused rounds
+    on device, and after a fully-committed launch ``adopt`` takes the
+    kernel's final-usage device slice as the resident buffer — the
+    next ``refresh`` then diffs against exactly the post-apply state
+    and ships zero rows."""
 
     def __init__(self):
         self._names = None
@@ -373,6 +382,7 @@ class ResidentEncoder:
         self.full_encodes = 0
         self.delta_rounds = 0
         self.delta_rows = 0
+        self.adopts = 0
 
     def _structure_matches(self, enc: EncodedSnapshot) -> bool:
         if self._names != tuple(enc.cq_names) + tuple(enc.cohort_names):
@@ -432,9 +442,26 @@ class ResidentEncoder:
         self.delta_rounds += 1
         return self._tree, self._paths, self._usage
 
+    def adopt(self, usage_dev, usage_host: np.ndarray) -> None:
+        """In-loop usage carry (the megaloop's post-commit hand-off):
+        after every round of a fused launch committed, the kernel's
+        final leaf usage IS the post-apply state — the per-round
+        conflict checks proved it byte-for-byte — so the resident
+        buffer adopts the device slice directly and the next
+        ``refresh`` ships zero rows. A truncated batch must NOT adopt
+        (the real state diverged mid-batch); ``refresh`` re-diffs."""
+        if self._usage_host is None or (
+            self._usage_host.shape != usage_host.shape
+        ):
+            return  # no resident structure yet: next refresh rebuilds
+        self._usage = usage_dev
+        self._usage_host = np.asarray(usage_host, dtype=np.int64).copy()
+        self.adopts += 1
+
     def stats(self) -> dict:
         return {
             "fullEncodes": self.full_encodes,
             "deltaRounds": self.delta_rounds,
             "deltaRows": self.delta_rows,
+            "adopts": self.adopts,
         }

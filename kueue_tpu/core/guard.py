@@ -351,13 +351,90 @@ class DeviceLaunch:
     """One guarded ASYNC device dispatch (the pipelined drain's
     prefetch window): the unfetched handle plus the deadline clock's
     start. ``failed=True`` means the launch itself raised and was
-    contained — the matching join returns an empty GuardOutcome."""
+    contained — the matching join returns an empty GuardOutcome.
+    ``deadline_s`` overrides the config deadline for THIS launch (the
+    megaloop legitimately runs K rounds of device work in one
+    dispatch, so its budget scales with K — the deadline still covers
+    the whole launch→fetch window)."""
 
     handle: object = None
     t0: float = 0.0
     t0_wall: float = 0.0
     label: str = ""
     failed: bool = False
+    deadline_s: Optional[float] = None
+
+
+class RoundsTuner:
+    """Online rounds-per-launch (K) search for the megaloop — the
+    PanelTuner's sibling: per-workload-mix coordinate descent
+    (arXiv:2406.20037) reduced to the one live coordinate, the fused
+    round count.
+
+    The trade: a bigger K amortizes the fixed dispatch round trip over
+    more drain rounds, but every round past a conflict-check mismatch
+    (host interference, stuck queues, structural fallback re-entering
+    the backlog) is wasted device work — the host truncates the batch
+    there and re-solves from the real state. So per backlog-size
+    bucket the tuner walks the K ladder: a launch whose batch
+    truncated early shrinks K; ``grow_after`` consecutive launches
+    that committed every round and STILL had work left grow it. State
+    only ever changes how many rounds one launch fuses — the per-round
+    conflict-check contract makes every K equally correct."""
+
+    LADDER = (2, 4, 8, 16, 32, 64)
+
+    def __init__(self, default_k: int = 8, grow_after: int = 2):
+        self.default_k = default_k
+        self.grow_after = grow_after
+        self._k: Dict[int, int] = {}  # backlog bucket -> current K
+        self._clean: Dict[int, int] = {}  # consecutive exhausted-clean
+        self.launches = 0
+        self.truncations = 0
+
+    @staticmethod
+    def _bucket(backlog: int) -> int:
+        b = 256
+        while b < backlog:
+            b *= 4
+        return b
+
+    def k_for(self, backlog: int) -> int:
+        """The fused round count for a launch over ``backlog`` heads."""
+        return self._k.get(self._bucket(backlog), self.default_k)
+
+    def observe(self, backlog: int, committed: int, truncated: bool) -> None:
+        """One finished launch: ``committed`` rounds shipped, and
+        ``truncated`` when a conflict-check mismatch cut the batch
+        before the device's log ran out."""
+        self.launches += 1
+        b = self._bucket(backlog)
+        k = self._k.get(b, self.default_k)
+        if truncated:
+            self.truncations += 1
+            self._clean[b] = 0
+            # shrink: don't compute rounds the host will discard; keep
+            # at least the smallest rung (K=1 would be the pipeline)
+            down = [w for w in self.LADDER if w < k]
+            self._k[b] = max(down) if down else self.LADDER[0]
+        elif committed >= k:
+            # the whole batch shipped and work remained: a taller
+            # launch would have amortized more
+            n = self._clean.get(b, 0) + 1
+            self._clean[b] = n
+            up = [w for w in self.LADDER if w > k]
+            if n >= self.grow_after and up:
+                self._k[b] = min(up)
+                self._clean[b] = 0
+        else:
+            self._clean[b] = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "launches": self.launches,
+            "truncations": self.truncations,
+            "k": {str(b): k for b, k in sorted(self._k.items())},
+        }
 
 
 @dataclass
@@ -422,6 +499,10 @@ class SolverGuard:
         self._cycle_t0: Optional[float] = None
         self._cycle_breached = False
         self._mirror_of = solve_lowered_host
+        # online rounds-per-launch (K) tuner for the fused megaloop —
+        # owned here so its verdicts ride the same health/dump surface
+        # as the rest of the solver's self-tuning state
+        self.rounds_tuner = RoundsTuner()
         self._report_path()
 
     # ---- path selection ----
@@ -525,13 +606,21 @@ class SolverGuard:
         return GuardOutcome(result=out, via="device", device_dt=dt_wall)
 
     # ---- the guarded ASYNC device call (pipelined drain prefetch) ----
-    def device_launch(self, fn: Callable[[], object], label: str):
+    def device_launch(
+        self,
+        fn: Callable[[], object],
+        label: str,
+        deadline_s: Optional[float] = None,
+    ):
         """Async half of ``device_call``: run the dispatch (which
         returns an unfetched handle — JAX async dispatch) under
         exception containment and START the deadline clock. The
         matching ``device_join`` applies the deadline to the WHOLE
         launch→fetch window, so a prefetched solve lives under exactly
-        the wall-clock budget a synchronous one does."""
+        the wall-clock budget a synchronous one does. ``deadline_s``
+        overrides the config budget for this launch — the megaloop's
+        fused K-round dispatch scales it by K while the window still
+        covers the entire launch."""
         import time as _time
 
         from kueue_tpu.testing import faults
@@ -542,7 +631,8 @@ class SolverGuard:
             # debugging mode: no containment, faults still fire
             faults.fire("solver.device_raise")
             return DeviceLaunch(
-                handle=fn(), t0=t0, t0_wall=t0_wall, label=label
+                handle=fn(), t0=t0, t0_wall=t0_wall, label=label,
+                deadline_s=deadline_s,
             )
         try:
             faults.fire("solver.device_raise")
@@ -552,7 +642,10 @@ class SolverGuard:
         except Exception as exc:  # noqa: BLE001 — containment IS the point
             self._note_failure(f"{label} raised: {exc!r}", "raise")
             return DeviceLaunch(failed=True, label=label)
-        return DeviceLaunch(handle=handle, t0=t0, t0_wall=t0_wall, label=label)
+        return DeviceLaunch(
+            handle=handle, t0=t0, t0_wall=t0_wall, label=label,
+            deadline_s=deadline_s,
+        )
 
     def device_join(
         self, launch: "DeviceLaunch", fetch_fn: Callable[[object], object]
@@ -580,10 +673,15 @@ class SolverGuard:
             return GuardOutcome(result=None, via="device")
         dt_clock = self.clock.now() - launch.t0
         dt_wall = _time.perf_counter() - launch.t0_wall
-        if dt_clock > self.config.device_deadline_s:
+        deadline = (
+            launch.deadline_s
+            if launch.deadline_s is not None
+            else self.config.device_deadline_s
+        )
+        if dt_clock > deadline:
             self._note_failure(
                 f"{launch.label} exceeded device deadline "
-                f"({dt_clock:.3f}s > {self.config.device_deadline_s}s)",
+                f"({dt_clock:.3f}s > {deadline}s)",
                 "deadline",
             )
             return GuardOutcome(result=None, via="device", device_dt=None)
@@ -600,12 +698,27 @@ class SolverGuard:
         k = self.config.divergence_check_every
         return bool(k) and committed > 0 and committed % k == 0
 
+    def pick_replay_round(self, n_committed: int) -> int:
+        """Deterministic pseudo-random pick of WHICH committed megaloop
+        round a sampled divergence check replays — a Weyl sequence over
+        the check counter (no host RNG: chaos/property tests must
+        replay identically), uniform over the batch across launches."""
+        if n_committed <= 1:
+            return 0
+        return (self.divergence_checks * 2654435761) % n_committed
+
     def check_drain_divergence(
-        self, device_sig: dict, host_solve: Callable[[], tuple], heads: int
+        self,
+        device_sig: dict,
+        host_solve: Callable[[], tuple],
+        heads: int,
+        surface: str = "drain-prefetch",
     ):
-        """Compare a committed prefetched drain round's decision
-        signature against the host mirror's (ops/drain_np via
-        run_drain(use_device=False) — bit-for-bit by construction).
+        """Compare a committed drain round's decision signature against
+        the host mirror's (ops/drain_np via run_drain(use_device=False)
+        — bit-for-bit by construction). ``surface`` labels the guarded
+        producer: "drain-prefetch" for pipelined speculative rounds,
+        "drain-megaloop" for a replayed round of a fused launch.
         Returns the HOST outcome when they diverge (the caller must
         adopt it; the device path is quarantined), None on agreement."""
         import time as _time
@@ -620,7 +733,7 @@ class SolverGuard:
         if self.tracer is not None:
             self.tracer.add_cycle_span(
                 "cycle.divergence_check", dt,
-                attrs={"surface": "drain-prefetch",
+                attrs={"surface": surface,
                        "diverged": host_sig != device_sig},
             )
         if host_sig == device_sig:
@@ -632,7 +745,7 @@ class SolverGuard:
         self.breaker.quarantine(f"drain divergence in {bad}")
         verdict = {
             "fields": bad,
-            "surface": "drain-prefetch",
+            "surface": surface,
             "deviceSolves": self.device_solves,
             "heads": heads,
             "authority": "host",
@@ -642,7 +755,7 @@ class SolverGuard:
             self.metrics.solver_divergences_total.inc()
         self.record_event(
             "SolverDiverged",
-            f"prefetched drain solve diverged from the host mirror in "
+            f"{surface} solve diverged from the host mirror in "
             f"{bad}; device path quarantined, host mirror is now the "
             "decision authority",
         )

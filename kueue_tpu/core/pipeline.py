@@ -23,6 +23,12 @@ crash-consistency story intact — the fault points
 (testing/faults.py) mark the two new windows and the chaos suite in
 tests/test_pipeline.py proves a crash in either never ships a stale
 decision.
+
+The megaloop (ops/megaloop_kernel, ``--megaloop``) reuses this
+module's entire contract one level up: a fused launch computes up to K
+rounds per dispatch and the host validates each round of the batched
+log with the SAME ``drain_inputs_match`` + ``pending_matches`` check
+before applying it — ``MegaloopStats`` below is its accounting twin.
 """
 
 from __future__ import annotations
@@ -117,6 +123,74 @@ class PipelineStats:
                     self.overlapped_apply_s * 1e3, 3
                 ),
                 "solveMs": round(self.solve_s * 1e3, 3),
+            }
+
+
+@dataclass
+class MegaloopStats:
+    """Observable megaloop accounting (the ``kueue_megaloop_*`` metric
+    source, the dashboard badge and the SIGUSR2 section).
+
+    Same threading contract as PipelineStats: the drain thread mutates
+    mid-batch while request threads render ``to_dict`` — every write
+    goes through a ``note_*`` method under ``_lock`` and ``to_dict``
+    snapshots under the same lock (kueuelint lock-discipline)."""
+
+    launches: int = 0  # guarded by: _lock — fused dispatches
+    rounds: int = 0  # guarded by: _lock — rounds committed (applied)
+    device_rounds: int = 0  # guarded by: _lock — rounds the device computed
+    truncations: int = 0  # guarded by: _lock — batches cut by a conflict miss
+    exhausted: int = 0  # guarded by: _lock — full-K batches with work left
+    last_k: int = 0  # guarded by: _lock — rounds-per-launch of the last launch
+    last_rounds: int = 0  # guarded by: _lock — rounds the last launch shipped
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False,
+    )
+
+    # ---- mutation API (the drain thread) ----
+    def note_launch(self, k: int, device_rounds: int) -> None:
+        with self._lock:
+            self.launches += 1
+            self.last_k = k
+            self.device_rounds += device_rounds
+
+    def note_committed(self, rounds: int) -> None:
+        with self._lock:
+            self.rounds += rounds
+            self.last_rounds = rounds
+
+    def note_truncation(self) -> None:
+        with self._lock:
+            self.truncations += 1
+
+    def note_exhausted(self) -> None:
+        with self._lock:
+            self.exhausted += 1
+
+    # ---- read API (request threads) ----
+    def _rounds_per_launch_locked(self) -> float:
+        return self.rounds / self.launches if self.launches else 0.0
+
+    @property
+    def rounds_per_launch(self) -> float:
+        """Committed drain rounds amortized per fused dispatch — the
+        megaloop's whole point; 1.0 means the fusion buys nothing."""
+        with self._lock:
+            return self._rounds_per_launch_locked()
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "launches": self.launches,
+                "rounds": self.rounds,
+                "deviceRounds": self.device_rounds,
+                "truncations": self.truncations,
+                "exhausted": self.exhausted,
+                "lastK": self.last_k,
+                "lastRounds": self.last_rounds,
+                "roundsPerLaunch": round(
+                    self._rounds_per_launch_locked(), 4
+                ),
             }
 
 

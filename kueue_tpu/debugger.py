@@ -184,6 +184,29 @@ def dump(runtime) -> str:
             f"commits={d['commits']} discards={d['discards']} "
             f"inflight={d['inflight']} overlapRatio={d['overlapRatio']}"
         )
+    # fused megaloop posture (ops/megaloop_kernel): rounds-per-launch
+    # is the amortization the fusion buys; rising truncations mean the
+    # per-round conflict check keeps cutting batches
+    mloop = getattr(runtime, "megaloop", None)
+    if mloop is not None:
+        d = mloop.to_dict()
+        lines.append("-- megaloop --")
+        lines.append(
+            f"mode={getattr(runtime, 'drain_megaloop', 'off')} "
+            f"pinnedK={getattr(runtime, 'megaloop_rounds', 0) or 'auto'} "
+            f"launches={d['launches']} rounds={d['rounds']} "
+            f"deviceRounds={d['deviceRounds']} "
+            f"truncations={d['truncations']} exhausted={d['exhausted']} "
+            f"roundsPerLaunch={d['roundsPerLaunch']}"
+        )
+        guard = getattr(runtime, "guard", None)
+        tuner = getattr(guard, "rounds_tuner", None)
+        if tuner is not None:
+            t = tuner.to_dict()
+            lines.append(
+                f"  tuner: launches={t['launches']} "
+                f"truncations={t['truncations']} k={t['k']}"
+            )
     # multi-chip admission posture (kueue_tpu/parallel): active mesh
     # shape + the size-bucketed jit-cache hit accounting — a low hit
     # rate means the shape buckets are mistuned and every backlog

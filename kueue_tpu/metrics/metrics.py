@@ -279,6 +279,29 @@ class Metrics:
         self.pipeline_overlap_ratio.set(0.0)
         self.pipeline_prefetch_discards_total.inc(0.0)
         self.pipeline_inflight.set(0)
+        # device-resident megaloop (ops/megaloop_kernel +
+        # controllers._megaloop_bulk_drain): rounds_per_launch is the
+        # amortization the fusion buys (committed drain rounds per
+        # fused dispatch — 1.0 means it buys nothing); a rising
+        # truncation counter means the per-round conflict check keeps
+        # cutting batches (interference mid-drain, stuck queues or
+        # structural fallback re-entering the backlog — shrink K or
+        # check what mutates state under the drain)
+        self.megaloop_rounds_per_launch = r.gauge(
+            f"{NS}_megaloop_rounds_per_launch",
+            "Committed drain rounds amortized per fused megaloop dispatch",
+        )
+        self.megaloop_launches_total = r.counter(
+            f"{NS}_megaloop_launches_total",
+            "Total fused megaloop drain dispatches",
+        )
+        self.megaloop_truncations_total = r.counter(
+            f"{NS}_megaloop_truncations_total",
+            "Total megaloop batches truncated by a failed per-round conflict check",
+        )
+        self.megaloop_rounds_per_launch.set(0.0)
+        self.megaloop_launches_total.inc(0.0)
+        self.megaloop_truncations_total.inc(0.0)
         # multi-chip admission (kueue_tpu/parallel): mesh posture + the
         # host-side sharding overhead. mesh_devices is 0 while the
         # server runs single-device (--mesh off or < 2 devices);

@@ -37,6 +37,14 @@ KERNEL_MIRRORS = {
         "kueue_tpu.ops.drain_np:solve_drain_np",
         "tests/test_drain_parity.py",
     ),
+    "megaloop_kernel": (
+        # fused K-round drain megaloop: the mirror IS the serial
+        # chunked loop — one solve_drain_np per round over
+        # suffix-trimmed queue tensors — so parity directly proves
+        # serial==megaloop at the kernel level
+        "kueue_tpu.ops.megaloop_np:solve_megaloop_np",
+        "tests/test_megaloop.py",
+    ),
     "preempt_kernel": (
         # classic victim search: the host Preemptor ladder
         "kueue_tpu.core.preemption:Preemptor",

@@ -448,6 +448,150 @@ def _cursor_queue_motion(
     return cursor, g_start, retries, stuck, no_prog, adm_k, adm_cycle
 
 
+def _plain_cycle(
+    tree,
+    subtree,
+    guaranteed,
+    potential,
+    queues: DrainQueues,
+    paths,
+    n_segments: int,
+    n_steps: int,
+    state,
+    alive=None,
+):
+    """ONE plain drain cycle over the 9-tuple loop state — the body of
+    ``solve_drain``'s while_loop, extracted so the megaloop kernel
+    (ops/megaloop_kernel.py) can run the identical cycle inside its
+    fused multi-round loop. ``alive`` masks out queues a megaloop round
+    boundary retired (a serial re-plan would not include them). The
+    state's cycle slot doubles as the admission stamp, so the megaloop
+    passes its IN-ROUND cycle there — matching what a per-round serial
+    launch records — and keeps its own total-cycle counter outside."""
+    max_depth = tree.max_depth
+    q, l, pmax, k, c = queues.cells.shape
+    q_idx = jnp.arange(q)
+
+    avail_v = jax.vmap(
+        _avail_along_path, in_axes=(0, 0, None, None, None, None, None)
+    )
+
+    (local, cursor, g_start, retries, stuck, no_prog, adm_k,
+     adm_cycle, cycle) = state
+
+    active = cursor < queues.qlen  # [Q]
+    if alive is not None:
+        active = active & alive
+    cur = jnp.minimum(cursor, l - 1)
+    usage0 = usage_tree(tree, guaranteed, local)
+    (is_fit, is_pre, pend, head_borrow, rep_k, walk_next,
+     cells_eff, qty_eff, _mneed) = _nominate_multi(
+        tree, subtree, guaranteed, local, usage0, queues, q_idx, cur,
+        active, g_start, potential,
+    )
+    nofit = ~(is_fit | is_pre)
+
+    prio = queues.priority[q_idx, cur]
+    ts = queues.timestamp[q_idx, cur]
+    order = jnp.lexsort(
+        (
+            ts,
+            -prio,
+            head_borrow.astype(jnp.int64),
+            nofit.astype(jnp.int64),
+        )
+    )
+    seg = jnp.maximum(queues.seg_id, 0)[order]
+    valid_sorted = active[order] & (queues.seg_id[order] >= 0) & (~nofit[order])
+    rank = segmented_rank(seg, valid_sorted)
+    rank_scatter = jnp.where(valid_sorted, rank, n_steps)
+    mat = (
+        jnp.full((n_steps, n_segments), -1, dtype=jnp.int32)
+        .at[rank_scatter, seg]
+        .set(order.astype(jnp.int32), mode="drop")
+    )
+
+    cq = jnp.maximum(queues.cq_rows, 0)
+
+    def step(usage, s):
+        idx = mat[s]  # [G]
+        act = idx >= 0
+        hidx = jnp.maximum(idx, 0)
+        cqs = cq[hidx]
+        path = paths[cqs]
+        cells_ = cells_eff[hidx]
+        qty_ = qty_eff[hidx]
+        ccells = jnp.maximum(cells_, 0)
+        cell_valid = (cells_ >= 0) & (qty_ > 0) & act[:, None]
+
+        avail = avail_v(
+            path, cells_, usage, subtree, guaranteed,
+            tree.borrowing_limit, max_depth,
+        )
+        fits = jnp.all(jnp.where(cell_valid, avail >= qty_, True), axis=1)
+        admit = act & is_fit[hidx] & fits
+        reserve = act & is_pre[hidx] & queues.no_reclaim[hidx]
+        nominal_c = tree.nominal[cqs[:, None], ccells]
+        bl_c = tree.borrowing_limit[cqs[:, None], ccells]
+        leaf_usage_c = usage[cqs[:, None], ccells]
+        borrow_cap = jnp.where(
+            bl_c < NO_LIMIT,
+            jnp.minimum(qty_, nominal_c + bl_c - leaf_usage_c),
+            qty_,
+        )
+        nominal_cap = jnp.maximum(
+            0, jnp.minimum(qty_, nominal_c - leaf_usage_c)
+        )
+        reserve_qty = jnp.where(
+            head_borrow[hidx][:, None], borrow_cap, nominal_cap
+        )
+        delta = jnp.where(
+            cell_valid & admit[:, None],
+            qty_,
+            jnp.where(cell_valid & reserve[:, None], reserve_qty, 0),
+        )
+        for d in range(0, max_depth + 1):
+            node = jnp.maximum(path[:, d], 0)
+            node_valid = (path[:, d] >= 0)[:, None]
+            old = usage[node[:, None], ccells]
+            gg = guaranteed[node[:, None], ccells]
+            new = old + delta
+            usage = usage.at[node[:, None], ccells].add(
+                jnp.where(node_valid, delta, 0)
+            )
+            over_old = jnp.maximum(0, old - gg)
+            over_new = jnp.maximum(0, new - gg)
+            delta = jnp.where(node_valid, over_new - over_old, delta)
+        return usage, admit
+
+    _, admit_sn = lax.scan(step, usage0, jnp.arange(n_steps))
+
+    flat_idx = mat.reshape(-1)
+    safe_idx = jnp.where(flat_idx >= 0, flat_idx, q)
+    admitted = (
+        jnp.zeros(q, dtype=bool)
+        .at[safe_idx]
+        .set(admit_sn.reshape(-1), mode="drop")
+    )
+
+    # leaf usage adds for admissions only — the cycle's reservations
+    # die with the cycle (the reserving head parks), and rebuilding
+    # the interior rows from leaves next cycle makes that exact
+    cell_valid = (cells_eff >= 0) & (qty_eff > 0)
+    add = jnp.where(cell_valid & admitted[:, None], qty_eff, 0)
+    local = local.at[cq[:, None], jnp.maximum(cells_eff, 0)].add(add)
+
+    (cursor, g_start, retries, stuck, no_prog, adm_k, adm_cycle) = (
+        _cursor_queue_motion(
+            queues, q_idx, cur, active, is_fit, pend, admitted,
+            rep_k, walk_next, retries, stuck, no_prog, adm_k,
+            adm_cycle, g_start, cursor, cycle,
+        )
+    )
+    return (local, cursor, g_start, retries, stuck, no_prog, adm_k,
+            adm_cycle, cycle + 1)
+
+
 def solve_drain(
     tree: QuotaTree,
     local_usage: jnp.ndarray,  # int64[N, FR] starting leaf usage
@@ -457,138 +601,24 @@ def solve_drain(
     n_steps: int,
     max_cycles: int,
 ) -> DrainResult:
-    max_depth = tree.max_depth
     subtree, guaranteed = subtree_quota(tree)
     from kueue_tpu.ops.assign_kernel import potential_available_all
 
     potential = potential_available_all(tree, subtree, guaranteed)
 
     q, l, pmax, k, c = queues.cells.shape
-    q_idx = jnp.arange(q)
-
-    avail_v = jax.vmap(
-        _avail_along_path, in_axes=(0, 0, None, None, None, None, None)
-    )
+    g = queues.gidx.shape[-1]
 
     def cycle_body(state):
-        (local, cursor, g_start, retries, stuck, no_prog, adm_k,
-         adm_cycle, cycle) = state
-
-        active = cursor < queues.qlen  # [Q]
-        cur = jnp.minimum(cursor, l - 1)
-        usage0 = usage_tree(tree, guaranteed, local)
-        (is_fit, is_pre, pend, head_borrow, rep_k, walk_next,
-         cells_eff, qty_eff, _mneed) = _nominate_multi(
-            tree, subtree, guaranteed, local, usage0, queues, q_idx, cur,
-            active, g_start, potential,
+        return _plain_cycle(
+            tree, subtree, guaranteed, potential, queues, paths,
+            n_segments, n_steps, state,
         )
-        nofit = ~(is_fit | is_pre)
-
-        prio = queues.priority[q_idx, cur]
-        ts = queues.timestamp[q_idx, cur]
-        order = jnp.lexsort(
-            (
-                ts,
-                -prio,
-                head_borrow.astype(jnp.int64),
-                nofit.astype(jnp.int64),
-            )
-        )
-        seg = jnp.maximum(queues.seg_id, 0)[order]
-        valid_sorted = active[order] & (queues.seg_id[order] >= 0) & (~nofit[order])
-        rank = segmented_rank(seg, valid_sorted)
-        rank_scatter = jnp.where(valid_sorted, rank, n_steps)
-        mat = (
-            jnp.full((n_steps, n_segments), -1, dtype=jnp.int32)
-            .at[rank_scatter, seg]
-            .set(order.astype(jnp.int32), mode="drop")
-        )
-
-        cq = jnp.maximum(queues.cq_rows, 0)
-
-        def step(usage, s):
-            idx = mat[s]  # [G]
-            act = idx >= 0
-            hidx = jnp.maximum(idx, 0)
-            cqs = cq[hidx]
-            path = paths[cqs]
-            cells_ = cells_eff[hidx]
-            qty_ = qty_eff[hidx]
-            ccells = jnp.maximum(cells_, 0)
-            cell_valid = (cells_ >= 0) & (qty_ > 0) & act[:, None]
-
-            avail = avail_v(
-                path, cells_, usage, subtree, guaranteed,
-                tree.borrowing_limit, max_depth,
-            )
-            fits = jnp.all(jnp.where(cell_valid, avail >= qty_, True), axis=1)
-            admit = act & is_fit[hidx] & fits
-            reserve = act & is_pre[hidx] & queues.no_reclaim[hidx]
-            nominal_c = tree.nominal[cqs[:, None], ccells]
-            bl_c = tree.borrowing_limit[cqs[:, None], ccells]
-            leaf_usage_c = usage[cqs[:, None], ccells]
-            borrow_cap = jnp.where(
-                bl_c < NO_LIMIT,
-                jnp.minimum(qty_, nominal_c + bl_c - leaf_usage_c),
-                qty_,
-            )
-            nominal_cap = jnp.maximum(
-                0, jnp.minimum(qty_, nominal_c - leaf_usage_c)
-            )
-            reserve_qty = jnp.where(
-                head_borrow[hidx][:, None], borrow_cap, nominal_cap
-            )
-            delta = jnp.where(
-                cell_valid & admit[:, None],
-                qty_,
-                jnp.where(cell_valid & reserve[:, None], reserve_qty, 0),
-            )
-            for d in range(0, max_depth + 1):
-                node = jnp.maximum(path[:, d], 0)
-                node_valid = (path[:, d] >= 0)[:, None]
-                old = usage[node[:, None], ccells]
-                gg = guaranteed[node[:, None], ccells]
-                new = old + delta
-                usage = usage.at[node[:, None], ccells].add(
-                    jnp.where(node_valid, delta, 0)
-                )
-                over_old = jnp.maximum(0, old - gg)
-                over_new = jnp.maximum(0, new - gg)
-                delta = jnp.where(node_valid, over_new - over_old, delta)
-            return usage, admit
-
-        _, admit_sn = lax.scan(step, usage0, jnp.arange(n_steps))
-
-        flat_idx = mat.reshape(-1)
-        safe_idx = jnp.where(flat_idx >= 0, flat_idx, q)
-        admitted = (
-            jnp.zeros(q, dtype=bool)
-            .at[safe_idx]
-            .set(admit_sn.reshape(-1), mode="drop")
-        )
-
-        # leaf usage adds for admissions only — the cycle's reservations
-        # die with the cycle (the reserving head parks), and rebuilding
-        # the interior rows from leaves next cycle makes that exact
-        cell_valid = (cells_eff >= 0) & (qty_eff > 0)
-        add = jnp.where(cell_valid & admitted[:, None], qty_eff, 0)
-        local = local.at[cq[:, None], jnp.maximum(cells_eff, 0)].add(add)
-
-        (cursor, g_start, retries, stuck, no_prog, adm_k, adm_cycle) = (
-            _cursor_queue_motion(
-                queues, q_idx, cur, active, is_fit, pend, admitted,
-                rep_k, walk_next, retries, stuck, no_prog, adm_k,
-                adm_cycle, g_start, cursor, cycle,
-            )
-        )
-        return (local, cursor, g_start, retries, stuck, no_prog, adm_k,
-                adm_cycle, cycle + 1)
 
     def cond(state):
         _, cursor, _, _, stuck, _, _, _, cycle = state
         return jnp.any((cursor < queues.qlen) & ~stuck) & (cycle < max_cycles)
 
-    g = queues.gidx.shape[-1]
     init = (
         local_usage,
         jnp.zeros(q, dtype=jnp.int32),

@@ -226,6 +226,21 @@ def main(argv=None) -> int:
         "overlap sooner but pay more dispatch round trips",
     )
     parser.add_argument(
+        "--megaloop", default="off", metavar="on|off|K",
+        help="device-resident admission megaloop "
+        "(ops/megaloop_kernel): fuse up to K drain rounds of "
+        "--pipeline-chunk-cycles kernel cycles each into ONE device "
+        "dispatch — the host journals/applies the batched "
+        "round-stamped decision log trailing the device, each round "
+        "validated by the pipeline's conflict-check contract (any "
+        "mismatch truncates the batch and re-solves from the real "
+        "state). on = K tuned online per backlog mix, an integer "
+        "pins K, off = per-round launches (the default). Composes "
+        "with --pipeline (on also prefetches the next fused launch) "
+        "and --mesh; observable via kueue_megaloop_* metrics — see "
+        "deploy/README 'Megaloop'",
+    )
+    parser.add_argument(
         "--mesh", default="off", metavar="auto|N|off",
         help="multi-chip admission (kueue_tpu/parallel): shard every "
         "drain-family device launch over a (wl[, fr]) device mesh — "
@@ -503,6 +518,7 @@ def main(argv=None) -> int:
                 rt.guard.config.mode = args.solver_path
             rt.drain_pipeline = args.pipeline
             rt.pipeline_chunk_cycles = max(1, args.pipeline_chunk_cycles)
+            rt.set_megaloop(args.megaloop)
             rt.set_mesh(mesh)
             if args.policy != "first-fit":
                 rt.set_policy(args.policy, journal=False)
@@ -516,6 +532,7 @@ def main(argv=None) -> int:
             solver_path=args.solver_path,
             drain_pipeline=args.pipeline,
             pipeline_chunk_cycles=args.pipeline_chunk_cycles,
+            drain_megaloop=args.megaloop,
             mesh=mesh,
             policy=args.policy,
         )

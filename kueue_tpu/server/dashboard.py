@@ -158,6 +158,12 @@ def dashboard_payload(rt) -> dict:
     pipe_stats = getattr(rt, "pipeline", None)
     pipeline = pipe_stats.to_dict() if pipe_stats is not None else {}
     pipeline["mode"] = getattr(rt, "drain_pipeline", "off")
+    # megaloop badge (ops/megaloop_kernel): fused-drain mode + the
+    # rounds-per-launch amortization and truncation accounting
+    ml_stats = getattr(rt, "megaloop", None)
+    megaloop = ml_stats.to_dict() if ml_stats is not None else {}
+    megaloop["mode"] = getattr(rt, "drain_megaloop", "off")
+    megaloop["pinnedK"] = getattr(rt, "megaloop_rounds", 0)
     # mesh badge (kueue_tpu/parallel): multi-chip admission posture —
     # active mesh shape, device count, jit-bucket reuse
     mesh_status = getattr(rt, "mesh_status", None)
@@ -211,6 +217,7 @@ def dashboard_payload(rt) -> dict:
         "lastTrace": last_trace,
         "solver": solver,
         "pipeline": pipeline,
+        "megaloop": megaloop,
         "mesh": mesh,
         "policy": policy,
         "replication": replication,
@@ -291,6 +298,7 @@ DASHBOARD_HTML = """<!doctype html>
 <div class="muted">control-plane dashboard &middot; <span id="mode" class="poll">connecting&hellip;</span>
  &middot; solver <span id="solver" class="badge">&hellip;</span>
  &middot; pipeline <span id="pipeline" class="badge">&hellip;</span>
+ &middot; megaloop <span id="megaloop" class="badge">&hellip;</span>
  &middot; mesh <span id="mesh" class="badge">&hellip;</span>
  &middot; policy <span id="policy" class="badge">&hellip;</span>
  &middot; replication <span id="replication" class="badge">&hellip;</span>
@@ -368,6 +376,17 @@ function render(d){
     plEl.title = `rounds=${pl.rounds||0} prefetches=${pl.prefetches||0} `+
       `commits=${pl.commits||0} discards=${pl.discards||0} `+
       `inflight=${pl.inflight||0}`;
+  }
+  const ml = d.megaloop||{};
+  const mlEl = document.getElementById('megaloop');
+  if (mlEl){
+    mlEl.className = 'badge '+(ml.mode==='on' ? 'device' : 'host');
+    mlEl.textContent = (ml.mode==='on')
+      ? ('on'+(ml.launches ? ` · ${ml.roundsPerLaunch||0} rounds/launch` : ''))
+      : 'off';
+    mlEl.title = `launches=${ml.launches||0} rounds=${ml.rounds||0} `+
+      `truncations=${ml.truncations||0} exhausted=${ml.exhausted||0} `+
+      `K=${ml.pinnedK||'auto'}`;
   }
   const ms = d.mesh||{};
   const msEl = document.getElementById('mesh');

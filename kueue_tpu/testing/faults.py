@@ -67,6 +67,23 @@ FAULT_POINTS: Dict[str, str] = {
         "undecided; recovery + rerun must converge to the serial "
         "loop's admitted set"
     ),
+    "cycle.megaloop_launched": (
+        "megaloop drain: a fused K-round dispatch "
+        "(ops/megaloop_kernel) just launched, NOTHING of its batched "
+        "decision log applied or journaled yet "
+        "(controllers._megaloop_bulk_drain) — a crash here must "
+        "recover exactly like a crash before a serial round's apply; "
+        "the in-flight fused log is lost, never shipped"
+    ),
+    "cycle.megaloop_commit_round": (
+        "megaloop drain: the per-round conflict check just proved "
+        "round r's implied inputs (previous round's kernel usage over "
+        "its undecided backlog) equal the real post-apply state; "
+        "round r is NOT yet applied or journaled — a crash here "
+        "leaves rounds < r durable and the rest of the batch "
+        "undecided; recovery + rerun must converge to the serial "
+        "loop's admitted set"
+    ),
     "solver.device_raise": (
         "immediately before a device solver dispatch (cycle batch or "
         "bulk drain) — arm to make the launch raise; the guard must "

@@ -56,6 +56,7 @@ CYCLE_SPAN_NAMES = frozenset(
         "cycle.prefetch",
         "cycle.commit",
         "cycle.discard",
+        "cycle.megaloop",
         "cycle.mesh_place",
         "cycle.divergence_check",
         "cycle.guard_failover",

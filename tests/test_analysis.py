@@ -222,6 +222,108 @@ class TestTraceSafetyRule:
         assert not any("time.time()" in f.message for f in findings)
 
 
+# host-side effects reachable inside a lax.while_loop body in the
+# kernel package — the megaloop's io_callback-free contract: nothing
+# inside a fused device loop may journal, record or fire fault points
+TRACE_EFFECT_BAD = '''\
+import jax.numpy as jnp
+from jax import lax
+
+from kueue_tpu.testing import faults
+
+
+def solve_fused(tree, state):
+    def body(s):
+        faults.fire("cycle.inside_loop")
+        return s + jnp.int32(1)
+
+    def cond(s):
+        return s < 8
+
+    return lax.while_loop(cond, body, state)
+
+
+def solve_logged(journal, state):
+    def logging_body(s):
+        journal.record("round", {"s": 0})
+        return s + 1
+
+    return lax.while_loop(lambda s: s < 4, logging_body, state)
+'''
+
+TRACE_EFFECT_GOOD = '''\
+import jax.numpy as jnp
+from jax import lax
+
+from kueue_tpu.testing import faults
+
+
+def solve_fused(tree, state):
+    def body(s):
+        return s + jnp.int32(1)
+
+    return lax.while_loop(lambda s: s < 8, body, state)
+
+
+def launch_and_apply(journal, state):
+    # host glue OUTSIDE the trace journals freely: the effect sits on
+    # the host side of the launch/fetch split
+    out = solve_fused(None, state)
+    journal.record("round", {"s": 1})
+    faults.fire("cycle.post_solve_pre_apply")
+    return out
+'''
+
+
+class TestTraceSafetyHostEffects:
+    def test_flags_effects_in_while_loop_bodies(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            {"ops/fused.py": TRACE_EFFECT_BAD},
+            rules=["trace-safety"],
+        )
+        msgs = [f.message for f in findings]
+        assert any(
+            "faults.fire()" in m and "io_callback-free" in m for m in msgs
+        ), msgs
+        assert any("journal.record()" in m for m in msgs), msgs
+
+    def test_host_side_effects_outside_trace_pass(self, tmp_path):
+        assert run_fixture(
+            tmp_path,
+            {"ops/fused.py": TRACE_EFFECT_GOOD},
+            rules=["trace-safety"],
+        ) == []
+
+    def test_scope_is_kernel_package_and_drain_glue(self, tmp_path):
+        # the same body outside ops/ + core/drain.py is not in scope
+        # for the effect contract (server/event code fires freely)
+        assert run_fixture(
+            tmp_path,
+            {"controllers/loopy.py": TRACE_EFFECT_BAD},
+            rules=["trace-safety"],
+        ) == []
+        findings = run_fixture(
+            tmp_path,
+            {"core/drain.py": TRACE_EFFECT_BAD},
+            rules=["trace-safety"],
+        )
+        assert findings, "core/drain.py must be in the effect scope"
+
+    def test_real_tree_fused_loops_clean(self):
+        """The production contract: the real ops/ kernels (incl. the
+        megaloop while_loop) and core/drain.py carry no host effects
+        inside traced scope."""
+        from kueue_tpu.analysis import lint
+
+        findings = [
+            f
+            for f in lint(rules=["trace-safety"])
+            if "io_callback-free" in f.message
+        ]
+        assert findings == [], findings
+
+
 # ---- journal-symmetry ----
 SYM_PRODUCER = '''\
 UPSERT = "workload_upsert"

@@ -113,7 +113,9 @@ class TestPanelLadderExactness:
     provably exact, and an inconclusive truncated search escalates to
     the wide width instead of shipping the freeze."""
 
-    @pytest.mark.parametrize("seed", range(6))
+    # tier-1 runtime headroom (ISSUE 14): 3 deterministic seeds per
+    # schedule stay tier-1, the rest of the sweep rides @slow
+    @pytest.mark.parametrize("seed", range(3))
     @pytest.mark.parametrize("widths", [(1, 32), (2, 32), (8, 32)])
     def test_narrow_schedule_matches_wide(self, seed, widths):
         from tests.test_drain import device_preempt_drain_trace, preempt_spec
@@ -134,6 +136,13 @@ class TestPanelLadderExactness:
         assert [c for *_, c in wide[3].admitted] == [
             c for *_, c in narrow[3].admitted
         ], "admission cycle indices diverged"
+
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", range(3, 6))
+    @pytest.mark.parametrize("widths", [(1, 32), (2, 32), (8, 32)])
+    def test_narrow_schedule_matches_wide_sweep(self, seed, widths):
+        self.test_narrow_schedule_matches_wide(seed, widths)
 
     def test_escalation_fires_and_stays_exact(self):
         """A width-1 panel on a head that needs several victims MUST

@@ -91,8 +91,12 @@ class TestShardedDrainFamilyParity:
             sigs[label] = outcome_signature(out)
         assert sigs["plain"] == sigs["mesh"]
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("seed", [1])
     def test_preempt_drain_parity(self, mesh, seed):
+        # tier-1 runtime headroom: the preempt kernel's mesh coverage
+        # stays tier-1 via TestNarrowPanelMeshFence (same kernel, same
+        # mesh, parity-asserted); this seed joins the wide sweep budget
         spec = cohort_reclaim_spec(seed)
         sigs = {}
         for label, m in (("plain", None), ("mesh", mesh)):
@@ -116,7 +120,11 @@ class TestShardedDrainFamilyParity:
             sigs[label] = outcome_signature(out)
         assert sigs["plain"] == sigs["mesh"]
 
+    @pytest.mark.slow
     def test_fair_preempt_drain_parity(self, mesh):
+        # tier-1 runtime headroom: rides the @slow budget with the
+        # wide sweep (TestShardedParityWideSweep covers 4 more seeds);
+        # single-device fair-preempt parity stays tier-1 elsewhere
         spec = cohort_reclaim_spec(3)
         sigs = {}
         for label, m in (("plain", None), ("mesh", mesh)):
@@ -263,7 +271,11 @@ class TestPipelinedMeshRuntime:
     loop under the mesh makes the serial single-device decisions, and
     the chaos fault points still converge after crash+recovery."""
 
+    @pytest.mark.slow
     def test_pipelined_mesh_equals_serial_single_device(self, mesh):
+        # tier-1 runtime headroom: the mesh+pipeline composition stays
+        # tier-1 via the chaos tests below (same loop, same mesh, same
+        # admitted-set-equals-serial assertion, plus recovery)
         from tests.test_pipeline import admitted, build_rt, parked
 
         rt_s, _ = build_rt(11, "serial")
