@@ -2881,6 +2881,17 @@ def _stage_federation() -> dict:
     fanout_ms, admissions_per_s, mirrored, admitted = federation_bench(
         np.random.default_rng(12)
     )
+    # fan-out scaling capture: the REAL dispatcher + global rescore
+    # loop at N in-process workers (`bench.py --federation N`; default
+    # 50 — the ROADMAP's 50+ floor)
+    from kueue_tpu.perf.multikueue import run_federation_scale
+
+    n = int(os.environ.get("KUEUE_BENCH_FED_WORKERS", "50"))
+    _stage(f"federation: {n}-worker fan-out scale capture")
+    scale = run_federation_scale(n_workers=n)
+    assert scale.admitted == scale.total, (
+        f"scale run admitted {scale.admitted}/{scale.total}"
+    )
     return {
         "federation_metric": (
             "federation_dispatch_fanout_latency (3 in-process worker "
@@ -2893,6 +2904,21 @@ def _stage_federation() -> dict:
         "federation_value": round(fanout_ms, 3),
         "federation_unit": "ms (fan-out pass)",
         "federation_admissions_per_s": round(admissions_per_s, 1),
+        "federation_scale_detail": (
+            f"{scale.n_workers} workers x {scale.total} workloads "
+            f"through the real dispatcher (fanout 1, heterogeneous "
+            f"capacity): all admitted exactly once in {scale.passes} "
+            f"passes / {scale.wall_s:.1f}s wall; first full fan-out "
+            f"pass {scale.fanout_pass_ms:.0f} ms; {scale.rescore_passes} "
+            f"global rescores (scoring {scale.rescore_ms_per_cycle:.1f} "
+            f"ms/cycle, aggregation {scale.aggregate_ms_per_cycle:.0f} "
+            f"ms/cycle), {scale.rebalances} rebalances, "
+            f"{scale.retractions_acked} retractions acked"
+        ),
+        "federation_workers": scale.n_workers,
+        "federation_dispatches_per_s": round(scale.dispatches_per_s, 1),
+        "federation_rescore_ms": round(scale.rescore_ms_per_cycle, 2),
+        "federation_rebalances": scale.rebalances,
     }
 
 
@@ -3064,6 +3090,9 @@ COMPACT_EXTRAS = (
     ("journal_appends_per_s", "appends_per_s"),
     ("failover_divergence_overhead_pct", "divergence_overhead_pct"),
     ("federation_admissions_per_s", "admissions_per_s"),
+    ("federation_dispatches_per_s", "dispatches_per_s"),
+    ("federation_rescore_ms", "rescore_ms"),
+    ("federation_rebalances", "rebalances"),
     ("pipeline_speedup_vs_serial", "pipeline_speedup"),
     ("megaloop_speedup_vs_serial", "megaloop_speedup"),
     ("megaloop_dispatches_per_drain", "dispatches_per_drain"),
@@ -3338,6 +3367,15 @@ if __name__ == "__main__":
         # tests/test_bench_schema.py.
         for flag, stages in SINGLE_STAGE_MODES.items():
             if flag in sys.argv:
+                if flag == "--federation":
+                    # `--federation N` sizes the fan-out scale capture
+                    # (worker count); propagated to the payload
+                    # subprocess through the environment
+                    i = sys.argv.index(flag)
+                    if i + 1 < len(sys.argv) and sys.argv[i + 1].isdigit():
+                        os.environ["KUEUE_BENCH_FED_WORKERS"] = (
+                            sys.argv[i + 1]
+                        )
                 driver_main(stages)
                 break
         else:

@@ -43,6 +43,19 @@ NAKED_CLOCK_CALLS = {
     "datetime.date.today",
 }
 
+#: STRICT sub-scope: under these path prefixes, duration measurement
+#: and blocking sleeps are ALSO findings. Federation code (dispatch,
+#: rebalancing, retraction pumps) is driven end-to-end by FakeClock
+#: chaos suites — a ``time.perf_counter()`` that leaks into a decision,
+#: or a ``time.sleep()`` anywhere in a pass, silently breaks the
+#: deterministic convergence proofs. Pure telemetry durations stay
+#: allowed through a justified allowlist entry, same ledger as above.
+STRICT_CLOCK_PREFIXES = ("kueue_tpu/federation/",)
+STRICT_NAKED_CALLS = {
+    "time.perf_counter",
+    "time.sleep",
+}
+
 #: scope -> justification. Scope is a repo-relative path, optionally
 #: ``::Qualified.name`` to pin one class/function. Keep justifications
 #: honest — they are the documented contract for why injection does
@@ -92,6 +105,18 @@ CLOCK_ALLOWLIST: Dict[str, str] = {
         "to POST; no loop, no test seam — the server re-stamps "
         "authoritative times"
     ),
+    # federation STRICT scope (perf_counter/sleep also flagged there)
+    "kueue_tpu/federation/dispatcher.py::FederationDispatcher._call": (
+        "RTT duration measurement feeding "
+        "kueue_multikueue_remote_rtt_seconds: reported, never "
+        "scheduled on; every schedule-relevant time in the dispatcher "
+        "reads runtime.clock"
+    ),
+    "kueue_tpu/federation/global_scheduler.py::GlobalScheduler.rescore": (
+        "kernel wall-duration measurement feeding "
+        "kueue_global_rescore_seconds: reported, never scheduled on; "
+        "the rescore interval and hysteresis read runtime.clock"
+    ),
 }
 
 
@@ -106,7 +131,9 @@ class ClockDisciplineRule(Rule):
     name = "clock-discipline"
     description = (
         "time.time()/time.monotonic()/datetime.now() outside the "
-        "justified allowlist — inject a Clock instead"
+        "justified allowlist — inject a Clock instead; under "
+        "kueue_tpu/federation/ the scope is STRICT (perf_counter and "
+        "sleep flagged too — FakeClock chaos suites drive that code)"
     )
 
     def check(self, src: SourceFile, ctx: AnalysisContext) -> List[Finding]:
@@ -114,6 +141,10 @@ class ClockDisciplineRule(Rule):
         aliases = import_aliases(src.tree)
         findings: List[Finding] = []
         used_scopes = ctx.config.setdefault("_clock_used_scopes", set())
+        strict_prefixes = tuple(
+            ctx.config.get("clock_strict_prefixes", STRICT_CLOCK_PREFIXES)
+        )
+        strict = src.rel.startswith(strict_prefixes)
 
         # walk with an explicit qualname stack so findings (and the
         # allowlist) can address one method, not a whole file
@@ -127,7 +158,10 @@ class ClockDisciplineRule(Rule):
                     continue
                 if isinstance(child, ast.Call):
                     canon = resolve_call_name(child, aliases)
-                    if canon in NAKED_CLOCK_CALLS:
+                    naked = canon in NAKED_CLOCK_CALLS or (
+                        strict and canon in STRICT_NAKED_CALLS
+                    )
+                    if naked:
                         qual = ".".join(stack)
                         scope_file = src.rel
                         scope_fn = f"{src.rel}::{qual}" if qual else src.rel
@@ -136,6 +170,14 @@ class ClockDisciplineRule(Rule):
                         elif scope_fn in allowlist:
                             used_scopes.add(scope_fn)
                         else:
+                            extra = (
+                                " (federation strict scope: even "
+                                "durations/sleeps must be injected or "
+                                "allowlisted — the chaos suites drive "
+                                "this code on FakeClock)"
+                                if strict and canon in STRICT_NAKED_CALLS
+                                else ""
+                            )
                             findings.append(
                                 Finding(
                                     self.name,
@@ -144,7 +186,7 @@ class ClockDisciplineRule(Rule):
                                     f"naked {canon}() in "
                                     f"{qual or '<module>'} — inject a "
                                     "Clock (utils/clock) or add a "
-                                    "justified allowlist entry",
+                                    f"justified allowlist entry{extra}",
                                 )
                             )
                 visit(child, stack)

@@ -307,8 +307,9 @@ class MetricsFamiliesRule(Rule):
         "and unique, with non-empty HELP (static half of the "
         "exposition lint; the runtime grammar/histogram invariants "
         "stay in tests/test_observability.py); families under the "
-        "exposed-at-zero prefixes (kueue_gateway_*, kueue_slo_*) must "
-        "be materialized at zero in their defining module"
+        "exposed-at-zero prefixes (kueue_gateway_*, kueue_slo_*, "
+        "kueue_global_*) must be materialized at zero in their "
+        "defining module"
     )
 
     _FAMILY_GRAMMAR = re.compile(r"^[a-z][a-z0-9_]*$")
@@ -317,7 +318,7 @@ class MetricsFamiliesRule(Rule):
     # and burn-rate alerts must see the whole family at zero before the
     # first request/admission, so their defining module must call
     # inc/set/touch on each one (the materialize-at-zero idiom)
-    _ZERO_PREFIXES = ("kueue_gateway_", "kueue_slo_")
+    _ZERO_PREFIXES = ("kueue_gateway_", "kueue_slo_", "kueue_global_")
     _ZERO_CALLS = {"inc", "set", "touch"}
 
     def _resolve_name(

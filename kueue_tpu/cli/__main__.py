@@ -428,7 +428,78 @@ def cmd_version(state: State, args) -> None:
 
 
 # ---- pending-workloads (visibility) ----
+def _fmt_tta(v) -> str:
+    return "-" if v is None else f"{float(v):.1f}s"
+
+
 def cmd_pending_workloads(state: State, args) -> None:
+    if getattr(args, "global_view", False):
+        # federation-wide view: the global scheduler's read-only
+        # rescore — every pending workload's current placement, the
+        # forecast-best cluster, and whether the rebalancer would move
+        # it (gain past hysteresis)
+        if not getattr(args, "server", None):
+            raise SystemExit(
+                "error: `pending-workloads --global` reads a live "
+                "federation manager; pass --server http://<manager>"
+            )
+        from kueue_tpu.server.client import ClientError
+
+        client = _server_client(args)
+        try:
+            body = client.global_standings()
+        except ClientError as e:
+            if e.status == 404:
+                raise SystemExit(
+                    "error: the global scheduler is not enabled on "
+                    "this server (start it with --federation-worker "
+                    "NAME=URL --global-scheduler on)"
+                )
+            raise
+        _replica_note(client)
+        rows = []
+        for row in body.get("workloads", []):
+            tta = row.get("ttaByClusterS") or {}
+            cur = row.get("current")
+            best = row.get("best")
+            rows.append(
+                [
+                    row["workload"],
+                    cur or "-",
+                    _fmt_tta(tta.get(cur)) if cur else "-",
+                    best or "-",
+                    _fmt_tta(tta.get(best)) if best else "-",
+                    f"{float(row.get('gainS', 0.0)):.1f}s",
+                    "yes" if row.get("rebalance") else "",
+                ]
+            )
+        _print_table(
+            ["WORKLOAD", "CURRENT", "TTA(CUR)", "BEST", "TTA(BEST)",
+             "GAIN", "REBALANCE"],
+            rows,
+        )
+        workers = body.get("workers", {})
+        if workers:
+            print()
+            _print_table(
+                ["CLUSTER", "READABLE", "SOURCE", "PENDING", "ADMITTED"],
+                [
+                    [
+                        name,
+                        "yes" if v.get("reachable") else "no",
+                        v.get("source", ""),
+                        str(v.get("pending", 0)),
+                        str(v.get("admitted", 0)),
+                    ]
+                    for name, v in sorted(workers.items())
+                ],
+            )
+        return
+    if not args.clusterqueue:
+        raise SystemExit(
+            "error: pending-workloads needs a CLUSTERQUEUE (or "
+            "--global against a federation manager)"
+        )
     if getattr(args, "server", None):
         # live query against a running kueue_tpu.server (the reference's
         # kubectl plugin hitting the visibility apiserver)
@@ -1511,7 +1582,14 @@ def build_parser() -> argparse.ArgumentParser:
     qr.set_defaults(fn=cmd_quarantine)
 
     pw = sub.add_parser("pending-workloads")
-    pw.add_argument("clusterqueue")
+    pw.add_argument("clusterqueue", nargs="?", default=None)
+    pw.add_argument(
+        "--global", dest="global_view", action="store_true",
+        help="federation-wide view (needs --server pointing at a "
+        "manager running --global-scheduler on): every pending "
+        "workload's per-cluster forecast, current vs best placement, "
+        "and per-worker standings",
+    )
     _add_server_flags(pw, "query a running kueue_tpu.server instead of --state")
     pw.set_defaults(fn=cmd_pending_workloads)
 

@@ -21,10 +21,16 @@ and replayed by ``storage.recover``, so a dispatcher killed
 mid-dispatch converges to the same federated admitted set.
 """
 
+from kueue_tpu.federation.aggregate import (
+    GlobalSnapshot,
+    WorkerView,
+    collect_global_snapshot,
+)
 from kueue_tpu.federation.dispatcher import (
     DISPATCH_RECORD,
     FEDERATION_RECORD_TYPES,
     FENCE_LABEL,
+    GANG_LABEL,
     RETRACT_DONE_RECORD,
     RETRACT_ENQUEUE_RECORD,
     WINNER_LABEL,
@@ -34,16 +40,22 @@ from kueue_tpu.federation.dispatcher import (
     FederationDispatcher,
     Retraction,
 )
+from kueue_tpu.federation.global_scheduler import GlobalScheduler
 from kueue_tpu.federation.placement import planner_placement_score
 
 __all__ = [
     "FederationDispatcher",
+    "GlobalScheduler",
+    "GlobalSnapshot",
+    "WorkerView",
+    "collect_global_snapshot",
     "DispatchState",
     "Retraction",
     "ClusterHealth",
     "planner_placement_score",
     "FENCE_LABEL",
     "WINNER_LABEL",
+    "GANG_LABEL",
     "DISPATCH_RECORD",
     "WINNER_RECORD",
     "RETRACT_ENQUEUE_RECORD",

@@ -63,6 +63,11 @@ FENCE_LABEL = "kueue.x-k8s.io/multikueue-fence"
 #: set on the LOCAL workload once a winner is picked (kueuectl explain
 #: and `kueuectl clusters list` read it)
 WINNER_LABEL = "kueue.x-k8s.io/multikueue-winner"
+#: gang co-placement id (the JobSet/gang parent's key): members share
+#: a rotation offset (same starting cluster), are mirrored with the
+#: label intact over the wire, and a deposed winner's gang children
+#: are retracted atomically with the member that tripped the deposal
+GANG_LABEL = "kueue.x-k8s.io/multikueue-gang"
 
 # journal record vocabulary (replayed by storage.recovery into
 # runtime.federation_replay, consumed by FederationDispatcher.restore)
@@ -156,6 +161,7 @@ class FederationDispatcher:
         cluster_quarantine_ttl_s: float = 600.0,
         heartbeat_interval_s: float = 30.0,
         drive_inprocess: bool = False,
+        rank_cache: bool = True,
     ):
         from kueue_tpu.federation.placement import planner_placement_score
 
@@ -177,6 +183,16 @@ class FederationDispatcher:
         self.states: Dict[str, DispatchState] = {}
         self.retractions: Dict[Tuple[str, str, int], Retraction] = {}
         self.health: Dict[str, ClusterHealth] = {}
+        # the global scheduler (federation/global_scheduler.py) attaches
+        # itself here; every step() then runs its interval-gated rescore
+        self.global_scheduler = None
+        # per-step rank cache: (step_seq, health fingerprint, filtered
+        # names, placement-score memo). Invalidated when the step
+        # advances OR any cluster's connectivity/quarantine state flips
+        # (a heartbeat marking a worker lost mid-step must re-filter)
+        self.rank_cache = rank_cache
+        self._step_seq = 0
+        self._rank_memo: Optional[tuple] = None
         for cluster in (clusters or {}).values():
             self.add_cluster(cluster)
         # adopt journal records recovery replayed before we existed
@@ -236,7 +252,15 @@ class FederationDispatcher:
                 r = Retraction(
                     key=key, cluster=data["cluster"], fence=int(data["fence"])
                 )
-                self.retractions.setdefault(r.dedup, r)
+                existing = self.retractions.get(r.dedup)
+                if existing is None:
+                    self.retractions[r.dedup] = r
+                else:
+                    # an enqueue AFTER an ack re-opens the entry (the
+                    # copy was recreated under the same fence) — replay
+                    # must land on the same at-least-once obligation
+                    # the live dispatcher had
+                    existing.acked = False
             elif rtype == RETRACT_DONE_RECORD:
                 dedup = (key, data["cluster"], int(data["fence"]))
                 r = self.retractions.get(dedup)
@@ -285,23 +309,65 @@ class FederationDispatcher:
         return result
 
     # ---- placement ----
+    def _health_fingerprint(self, now: float) -> tuple:
+        """Connectivity + quarantine state of every configured cluster
+        — the rank cache's invalidation key. A heartbeat (or any wire
+        exchange) that flips a cluster's reachability changes this
+        fingerprint and drops the cached filtered list mid-step."""
+        return tuple(
+            (
+                n,
+                c.client.active if c.client is not None else True,
+                self.health[n].quarantined(now),
+            )
+            for n, c in self.clusters.items()
+        )
+
+    def _healthy_names(self, now: float) -> List[str]:
+        """The health-filtered cluster list, cached per federation step
+        (rank_clusters used to rebuild it per WORKLOAD per step). The
+        cache also scopes the per-(cluster, workload) placement-score
+        memo: an invalidation drops both."""
+        fp = self._health_fingerprint(now)
+        if (
+            not self.rank_cache
+            or self._rank_memo is None
+            or self._rank_memo[0] != self._step_seq
+            or self._rank_memo[1] != fp
+        ):
+            names = [n for n, _active, quarantined in fp if not quarantined]
+            self._rank_memo = (self._step_seq, fp, names, {})
+        return self._rank_memo[2]
+
+    def _placement_score(self, name: str, wl: Workload):
+        """``self.placement`` through the per-step memo: within one
+        step the same (cluster, workload) pair is forecast once even
+        when dispatch and a deposal both rank it."""
+        if not self.rank_cache or self._rank_memo is None:
+            return self.placement(self.clusters[name], wl)
+        memo = self._rank_memo[3]
+        mkey = (name, wl.key)
+        if mkey not in memo:
+            memo[mkey] = self.placement(self.clusters[name], wl)
+        return memo[mkey]
+
     def rank_clusters(self, wl: Workload) -> List[MultiKueueCluster]:
         """Healthy clusters, best placement first: planner-scored
         clusters ascending by forecast time-to-admission, then
         unscorable ones in a stable per-workload rotation (no
-        structural favorite, same as the MultiKueue cluster scan)."""
+        structural favorite, same as the MultiKueue cluster scan).
+        Gang members rotate on their shared gang id, so a gang's
+        unscored tie-break starts every member on the SAME cluster."""
         now = self.runtime.clock.now()
-        names = [
-            n for n in self.clusters
-            if not self.health[n].quarantined(now)
-        ]
+        names = list(self._healthy_names(now))
         if len(names) > 1:
-            off = zlib.crc32(wl.key.encode()) % len(names)
+            spin = (wl.labels or {}).get(GANG_LABEL) or wl.key
+            off = zlib.crc32(spin.encode()) % len(names)
             names = names[off:] + names[:off]
         scored: List[Tuple[float, int, str]] = []
         unscored: List[str] = []
         for i, name in enumerate(names):
-            s = self.placement(self.clusters[name], wl)
+            s = self._placement_score(name, wl)
             if s is None:
                 unscored.append(name)
             else:
@@ -315,6 +381,7 @@ class FederationDispatcher:
         """One federation pass (driven by ClusterRuntime.reconcile_once
         or the server's reconcile loop)."""
         faults.fire("multikueue.worker_crash")
+        self._step_seq += 1
         now = self.runtime.clock.now()
         self._sweep_cluster_quarantine(now)
         self._heartbeat(now)
@@ -322,10 +389,15 @@ class FederationDispatcher:
         for key in sorted(self.runtime.workloads):
             self._reconcile(self.runtime.workloads[key], now)
         # a locally deleted workload's remote copies must not outlive
-        # it: whatever the state still names gets a retraction
+        # it: whatever the state still names gets a retraction. Already-
+        # finished states are skipped — their retractions were enqueued
+        # once and re-enqueueing every pass would re-open acked entries
+        # (see _enqueue_retraction) and starve the finished-state GC
         for key in list(self.states):
             if key not in self.runtime.workloads:
                 st = self.states[key]
+                if st.finished:
+                    continue
                 for name in set(st.clusters) | st.mirrored:
                     self._enqueue_retraction(key, name, st.fence)
                 st.finished = True
@@ -339,6 +411,11 @@ class FederationDispatcher:
                     # only the wire is down — so this runs regardless of
                     # the connectivity state
                     rt.run_until_idle()
+        if self.global_scheduler is not None:
+            # the global rescore loop (federation/global_scheduler.py)
+            # rides the federation pass: interval-gated, so most passes
+            # pay one clock read
+            self.global_scheduler.maybe_step()
         self._update_gauges()
 
     def _heartbeat(self, now: float) -> None:
@@ -411,6 +488,13 @@ class FederationDispatcher:
 
     def _remote_copy(self, wl: Workload, fence: int) -> Workload:
         labels = {ORIGIN_LABEL: self.origin, FENCE_LABEL: str(fence)}
+        # gang/job sync adapter: the JobSet/gang parent id crosses the
+        # wire with the copy, so a worker (or an operator reading it)
+        # sees which mirrored workloads form one gang — and the
+        # dispatcher's own sync-back can group them after a restart
+        gang = (wl.labels or {}).get(GANG_LABEL)
+        if gang:
+            labels[GANG_LABEL] = gang
         # W3C trace-context propagation: the mirrored copy carries the
         # manager's lifecycle trace as a traceparent label, so the
         # winning worker's runtime JOINS that trace instead of minting
@@ -641,12 +725,19 @@ class FederationDispatcher:
     def _depose_winner(
         self, wl: Workload, st: DispatchState, now: float, why: str,
         strike: bool = True,
+        cascade: bool = True,
     ) -> None:
         """Fence bump: the current winner is no longer trusted. The old
         epoch's copy gets an at-least-once retraction (delivered when
         the partition heals — the healed deposed winner CANNOT keep the
         gang, its token is stale everywhere), the workload re-disperses
-        to the surviving clusters under the new fence."""
+        to the surviving clusters under the new fence.
+
+        Gang atomicity (the JobSet/gang sync adapter): when the deposed
+        workload carries a ``GANG_LABEL``, every sibling whose winner is
+        the SAME deposed cluster is deposed in the same pass — their
+        retractions enqueue together, so a partial gang can never stay
+        reserved on a cluster the rest of the gang just left."""
         old = st.winner
         st.winner = None
         st.fence += 1
@@ -673,6 +764,33 @@ class FederationDispatcher:
             "MultiKueueClusterLost", wl,
             f"{why}; re-dispatching under fence {st.fence}",
         )
+        if cascade and old is not None:
+            self._depose_gang_siblings(wl, st, old, now)
+
+    def _depose_gang_siblings(
+        self, wl: Workload, st: DispatchState, old: str, now: float
+    ) -> None:
+        """Retract a deposed winner's gang children atomically: every
+        non-finished sibling sharing the gang label and placed on the
+        same deposed cluster fence-bumps in this pass (no strike — the
+        cluster was already charged once)."""
+        gang = (wl.labels or {}).get(GANG_LABEL)
+        if not gang:
+            return
+        for key in sorted(self.states):
+            if key == st.key:
+                continue
+            sib_st = self.states[key]
+            if sib_st.finished or sib_st.winner != old:
+                continue
+            sib = self.runtime.workloads.get(key)
+            if sib is None or (sib.labels or {}).get(GANG_LABEL) != gang:
+                continue
+            self._depose_winner(
+                sib, sib_st, now,
+                f'gang "{gang}" member {st.key} deposed from "{old}"',
+                strike=False, cascade=False,
+            )
 
     def _set_pending(self, wl: Workload, message: str, now: float) -> None:
         qr = wl.conditions.get(WorkloadConditionType.QUOTA_RESERVED)
@@ -692,13 +810,26 @@ class FederationDispatcher:
 
     # ---- the retraction protocol ----
     def _enqueue_retraction(self, key: str, cluster: str, fence: int) -> None:
+        """Ensure a delete is delivered to ``cluster`` AFTER this
+        point. An in-flight (unacked) entry with the same dedup key
+        absorbs the request; an ACKED entry is RE-OPENED — a copy can
+        legitimately be recreated under the same fence after its first
+        retraction acked (crash-recovery re-mirrors, then a rebalance
+        moves the placement), and an old ack must not satisfy a new
+        delete. The local-delete sweep in step() skips finished states
+        so re-opening cannot live-lock the finished-state GC."""
         r = Retraction(key=key, cluster=cluster, fence=fence)
         m = getattr(self.runtime, "metrics", None)
-        if r.dedup in self.retractions:
+        existing = self.retractions.get(r.dedup)
+        if existing is not None and not existing.acked:
             if m is not None:
                 m.report_retraction("deduped")
             return
-        self.retractions[r.dedup] = r
+        if existing is not None:
+            existing.acked = False
+            r = existing
+        else:
+            self.retractions[r.dedup] = r
         self._journal(
             RETRACT_ENQUEUE_RECORD,
             {"key": key, "cluster": cluster, "fence": fence},

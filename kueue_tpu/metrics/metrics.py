@@ -356,6 +356,43 @@ class Metrics:
             f"{NS}_multikueue_clusters_active",
             "Worker clusters currently reachable and not quarantined",
         )
+        # global scheduler (kueue_tpu/federation/global_scheduler.py):
+        # federation-wide rescore loop + planner-driven rebalancing.
+        # A rising skipped_stale rate means rescores race deposals
+        # (shrink the rescore interval or grow hysteresis); reachable
+        # workers below the configured count means some worker serves
+        # no readable state (no in-process runtime and no feed reader).
+        self.global_rescore_total = r.counter(
+            f"{NS}_global_rescore_total",
+            "Total global rescore passes (aggregate + batched scoring + rebalance apply)",
+        )
+        self.global_rescore_total.inc(0.0)
+        self.global_rescore_seconds = r.histogram(
+            f"{NS}_global_rescore_seconds",
+            "Wall time of one batched (workload x cluster) rescore pass",
+            buckets=ATTEMPT_BUCKETS,
+        )
+        self.global_rescore_seconds.touch()
+        self.global_rebalances_total = r.counter(
+            f"{NS}_global_rebalances_total",
+            "Total rebalance decisions by outcome (applied|skipped_stale|skipped_gone|skipped_covered|skipped_cooldown)",
+            ("outcome",),
+        )
+        for outcome in (
+            "applied", "skipped_stale", "skipped_gone",
+            "skipped_covered", "skipped_cooldown",
+        ):
+            self.global_rebalances_total.inc(0.0, outcome=outcome)
+        self.global_pending_workloads = r.gauge(
+            f"{NS}_global_pending_workloads",
+            "Rebalanceable pending workloads scored in the last global rescore",
+        )
+        self.global_pending_workloads.set(0)
+        self.global_workers_reachable = r.gauge(
+            f"{NS}_global_workers_reachable",
+            "Worker clusters readable (in-process or feed) in the last global rescore",
+        )
+        self.global_workers_reachable.set(0)
         # durable-state subsystem (kueue_tpu/storage): journal health +
         # crash-recovery accounting. journal_degraded is the paging
         # signal — 1 means appends are failing (ENOSPC/EIO) and the

@@ -161,6 +161,10 @@ EVENT_REASONS = frozenset(
         "MultiKueueRetracted",
         "MultiKueueClusterQuarantined",
         "MultiKueueClusterRecovered",
+        # global scheduler (kueue_tpu/federation/global_scheduler.py):
+        # a placement moved because another cluster's forecast beat the
+        # current one past the hysteresis threshold
+        "MultiKueueRebalanced",
         # durable-state subsystem (kueue_tpu/storage): journal append
         # failure flips persistence to degraded; recovery flips it back
         "JournalDegraded",

@@ -60,6 +60,12 @@ KERNEL_MIRRORS = {
         "kueue_tpu.planner.engine:solve_scenario_host",
         "tests/test_planner.py",
     ),
+    "global_kernel": (
+        # federation-wide rescore: (pending workload x cluster) packed
+        # key argmin; the mirror repeats the identical int64 packing
+        "kueue_tpu.ops.global_np:rescore_np",
+        "tests/test_global_scheduler.py",
+    ),
     "tas_kernel": (
         # TAS placement: the host snapshot's exact placement replay
         # (run_drain_tas asserts leaf-usage reproduction in-line)

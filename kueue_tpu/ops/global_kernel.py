@@ -1,0 +1,190 @@
+"""Global rescore kernel — one batched pass over every
+(pending workload x worker cluster) pair in the federation.
+
+The federation dispatcher (PR 6) ranks clusters workload-at-a-time on
+the host: N python sorts per pass, and nothing ever revisits a
+placement. Gavel (arXiv:2008.09213) and Tesserae (arXiv:2508.04953)
+both reduce continuous cross-cluster placement to a score tensor —
+per-workload x per-cluster — argmaxed every rescore interval. That is
+exactly the shape the admission kernels already solve per-flavor, so
+the global scheduler reuses the same discipline: the host aggregates a
+``GlobalSnapshot`` (federation/aggregate.py) into dense int64 tensors,
+ONE jit launch scores every pair and picks the best cluster per
+workload, and a hysteresis threshold gates retract-and-redispatch so
+forecast noise cannot thrash placements.
+
+Inputs, shapes ``[W, C]`` (W pending workloads, C worker clusters):
+
+  tta_ms   int64 — forecast time-to-admission on that cluster, in
+                   milliseconds (``planner.forecast_time_to_admission``
+                   through the per-worker read runtimes); clamped to
+                   ``TTA_CAP_MS``.
+  score    int64 — admission-policy cluster score (kueue_tpu/policy
+                   ``candidate_score`` over the worker's flavors;
+                   all-zero under the default first-fit policy).
+  valid    bool  — the pair is scorable (worker reachable, forecast
+                   answered, workload representable there).
+  current  int32[W] — column of the workload's current winner, -1 when
+                   undispatched.
+  rotation int32[W] — per-workload stable tie-break offset (the
+                   dispatcher's crc32 rotation: no structural favorite
+                   among equal clusters).
+
+The per-pair sort key is ONE int64, lexicographic by construction —
+(tta asc, policy score desc, rotated cluster index asc) — so the
+device argmin and the numpy mirror (ops/global_np.py, registered in
+``KERNEL_MIRRORS``) agree bit-for-bit:
+
+  key = tta<<33 | (2^21-1 - (score+2^20))<<12 | rotated_index
+
+Budget: 30 bits tta (caps at ~12.4 days — past any forecast horizon),
+21 bits score (policy milli-scores clip at +-2^20), 12 bits index
+(4096 clusters), total 63 bits — no overflow, no float compare.
+
+Rebalance is decided on the TTA axis alone: a placement moves only
+when the best cluster's forecast beats the CURRENT cluster's by more
+than ``hysteresis_ms`` (Tesserae's churn guard); a better policy score
+at equal TTA never migrates a gang.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from kueue_tpu._jax import jax, jnp
+
+__all__ = [
+    "TTA_CAP_MS",
+    "SCORE_HALF",
+    "IDX_BITS",
+    "SCORE_BITS",
+    "MAX_CLUSTERS",
+    "INVALID_KEY",
+    "RescoreResult",
+    "solve_rescore",
+    "rescore_pairs",
+]
+
+#: tta clamp: 30 bits of milliseconds (~12.4 days). The planner's
+#: default horizon (1e6 s = 1e9 ms) fits under it.
+TTA_CAP_MS = (1 << 30) - 1
+#: policy scores clip to [-SCORE_HALF, SCORE_HALF - 1] (21 bits after
+#: the shift into non-negative space)
+SCORE_HALF = 1 << 20
+SCORE_BITS = 21
+#: rotated cluster index occupies the low bits
+IDX_BITS = 12
+MAX_CLUSTERS = 1 << IDX_BITS
+#: key for unscorable pairs: sorts after every real key
+INVALID_KEY = (1 << 63) - 1
+
+_IDX_SHIFT = 1 << IDX_BITS
+_TTA_SHIFT = 1 << (SCORE_BITS + IDX_BITS)
+
+
+class RescoreResult(NamedTuple):
+    """One rescore pass, decoded per workload.
+
+    best:      int32[W] — argmin column (best cluster), -1 when no
+               pair was scorable.
+    best_key:  int64[W] — the winning packed key (INVALID_KEY when
+               best == -1).
+    gain_ms:   int64[W] — current TTA minus best TTA (0 when the
+               current placement is unscorable or nothing is better).
+    rebalance: bool[W]  — move the workload: current is scorable, a
+               DIFFERENT cluster wins, and the gain clears hysteresis.
+    """
+
+    best: jnp.ndarray
+    best_key: jnp.ndarray
+    gain_ms: jnp.ndarray
+    rebalance: jnp.ndarray
+
+
+def _solve_rescore(tta_ms, score, valid, current, rotation, hysteresis_ms):
+    w, c = tta_ms.shape
+    cols = jnp.arange(c, dtype=jnp.int64)[None, :]
+    idx = (cols - rotation.astype(jnp.int64)[:, None]) % c
+    tta_c = jnp.clip(tta_ms, 0, TTA_CAP_MS)
+    score_c = jnp.clip(score, -SCORE_HALF, SCORE_HALF - 1) + SCORE_HALF
+    key = (
+        tta_c * _TTA_SHIFT
+        + ((1 << SCORE_BITS) - 1 - score_c) * _IDX_SHIFT
+        + idx
+    )
+    key = jnp.where(valid, key, INVALID_KEY)
+    best = jnp.argmin(key, axis=1).astype(jnp.int32)
+    best_key = jnp.min(key, axis=1)
+    has_best = best_key < INVALID_KEY
+    best = jnp.where(has_best, best, jnp.int32(-1))
+    cur_col = jnp.clip(current, 0, c - 1).astype(jnp.int32)
+    cur_valid = (current >= 0) & jnp.take_along_axis(
+        valid, cur_col[:, None].astype(jnp.int64), axis=1
+    )[:, 0]
+    cur_tta = jnp.take_along_axis(
+        tta_c, cur_col[:, None].astype(jnp.int64), axis=1
+    )[:, 0]
+    best_col = jnp.clip(best, 0, c - 1)
+    best_tta = jnp.take_along_axis(
+        tta_c, best_col[:, None].astype(jnp.int64), axis=1
+    )[:, 0]
+    movable = cur_valid & has_best
+    gain = jnp.where(movable, cur_tta - best_tta, jnp.int64(0))
+    rebalance = (
+        movable
+        & (best != current.astype(jnp.int32))
+        & (gain > hysteresis_ms)
+    )
+    return RescoreResult(best, best_key, gain, rebalance)
+
+
+solve_rescore = jax.jit(_solve_rescore)
+
+
+def rescore_pairs(
+    tta_ms, score, valid, current, rotation, hysteresis_ms: int
+):
+    """Host entry point: numpy in, numpy out, one device launch.
+
+    W is padded to the next power of two (padding rows all-invalid,
+    current=-1) so the jit cache holds O(log W) entries per cluster
+    count instead of one per backlog size.
+    """
+    import numpy as np
+
+    w, c = tta_ms.shape
+    if w == 0 or c == 0:
+        return RescoreResult(
+            np.full(w, -1, dtype=np.int32),
+            np.full(w, INVALID_KEY, dtype=np.int64),
+            np.zeros(w, dtype=np.int64),
+            np.zeros(w, dtype=bool),
+        )
+    if c > MAX_CLUSTERS:
+        raise ValueError(
+            f"{c} clusters exceeds the {MAX_CLUSTERS}-cluster key budget"
+        )
+    w_pad = 1
+    while w_pad < w:
+        w_pad <<= 1
+    if w_pad != w:
+        pad = w_pad - w
+        tta_ms = np.pad(tta_ms, ((0, pad), (0, 0)))
+        score = np.pad(score, ((0, pad), (0, 0)))
+        valid = np.pad(valid, ((0, pad), (0, 0)))
+        current = np.pad(current, (0, pad), constant_values=-1)
+        rotation = np.pad(rotation, (0, pad))
+    res = solve_rescore(
+        jnp.asarray(tta_ms, dtype=jnp.int64),
+        jnp.asarray(score, dtype=jnp.int64),
+        jnp.asarray(valid, dtype=bool),
+        jnp.asarray(current, dtype=jnp.int32),
+        jnp.asarray(rotation, dtype=jnp.int32),
+        jnp.int64(int(hysteresis_ms)),
+    )
+    return RescoreResult(
+        np.asarray(res.best)[:w],
+        np.asarray(res.best_key)[:w],
+        np.asarray(res.gain_ms)[:w],
+        np.asarray(res.rebalance)[:w],
+    )

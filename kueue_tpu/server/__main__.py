@@ -384,6 +384,28 @@ def main(argv=None) -> int:
         "workers (default: all configured workers)",
     )
     parser.add_argument(
+        "--global-scheduler", choices=["on", "off"], default="off",
+        help="run the federation-wide global scheduler on this manager "
+        "(requires --federation-worker): aggregate every worker's "
+        "pending positions / fair-share standings / capacities by "
+        "tailing the replica feed each worker already serves, rescore "
+        "all (pending workload x cluster) pairs in one batched kernel "
+        "pass every --global-rescore-interval, and retract-and-"
+        "redispatch placements another cluster beats by more than "
+        "--global-hysteresis (journaled, fenced — exactly-one "
+        "admission preserved). Served at GET /global/standings and "
+        "`kueuectl pending-workloads --global`",
+    )
+    parser.add_argument(
+        "--global-hysteresis", type=float, default=60.0,
+        help="seconds of forecast gain another cluster must offer "
+        "before a placement is rebalanced (churn guard)",
+    )
+    parser.add_argument(
+        "--global-rescore-interval", type=float, default=30.0,
+        help="seconds between global rescore passes",
+    )
+    parser.add_argument(
         "--leader-elect-lease",
         help="path to a shared lease file (on the state volume): "
         "enables leader election — the holder accepts writes and "
@@ -658,19 +680,21 @@ def main(argv=None) -> int:
         from kueue_tpu.federation import FederationDispatcher
 
         workers = {}
+        worker_urls = {}
         for spec in args.federation_worker:
             name, sep, url = spec.partition("=")
             if not sep or not name or not url:
                 parser.error(
                     f"--federation-worker must be NAME=URL, got {spec!r}"
                 )
+            worker_urls[name] = url
             workers[name] = MultiKueueCluster(
                 name=name,
                 transport=HTTPTransport(
                     url, token=args.federation_worker_token
                 ),
             )
-        FederationDispatcher(
+        dispatcher = FederationDispatcher(
             runtime,
             clusters=workers,
             worker_lost_timeout=args.federation_lost_timeout,
@@ -680,6 +704,29 @@ def main(argv=None) -> int:
             f"federation manager: dispatching to {sorted(workers)}",
             flush=True,
         )
+        if args.global_scheduler == "on":
+            from kueue_tpu.federation import GlobalScheduler
+
+            gs = GlobalScheduler(
+                dispatcher,
+                hysteresis_s=args.global_hysteresis,
+                rescore_interval_s=args.global_rescore_interval,
+            )
+            # read each worker through the replica feed it already
+            # serves (PR-9): one JournalTailer per wire-only worker
+            # keeps a live read-only twin the forecasts run against
+            for name, url in worker_urls.items():
+                gs.attach_feed_reader(
+                    name, url, token=args.federation_worker_token
+                )
+            print(
+                "global scheduler: rescoring every "
+                f"{args.global_rescore_interval:.0f}s, hysteresis "
+                f"{args.global_hysteresis:.0f}s",
+                flush=True,
+            )
+    elif args.global_scheduler == "on":
+        parser.error("--global-scheduler requires --federation-worker")
     replica = None
     if args.replica_of:
         import socket

@@ -762,6 +762,7 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
         re.compile(r"^/apis/federation/v1beta1/status$"),
         "federation_status",
     ),
+    ("GET", re.compile(r"^/global/standings$"), "global_standings"),
     ("POST", re.compile(r"^/reconcile$"), "reconcile"),
     ("GET", re.compile(r"^/events/stream$"), "events_stream"),
     ("GET", re.compile(r"^/debug/cycles$"), "debug_cycles"),
@@ -1277,6 +1278,23 @@ def _make_handler(srv: KueueServer):
             with srv.lock:
                 status = fed.status()
             self._send_json(status)
+
+        def _h_global_standings(self, query):
+            """Federation-wide visibility: the global scheduler's
+            read-only rescore — per-worker standings + every pending
+            workload's per-cluster forecast and best placement. 404
+            when this plane runs no global scheduler."""
+            fed = getattr(srv.runtime, "federation", None)
+            gs = (
+                getattr(fed, "global_scheduler", None)
+                if fed is not None
+                else None
+            )
+            if gs is None:
+                raise ApiError(404, "global scheduler is not enabled")
+            with srv.lock:
+                body = gs.standings()
+            self._send_json(body)
 
         def _h_reconcile(self, query):
             srv.require_leader()

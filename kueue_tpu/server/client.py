@@ -433,6 +433,14 @@ class KueueClient:
         dispatch state (winner + fence), pending retractions."""
         return self._request("GET", "/apis/federation/v1beta1/status")
 
+    def global_standings(self) -> dict:
+        """Federation-wide standings (the `kueuectl pending-workloads
+        --global` payload): per-worker pending counts, fair-share
+        standings and flavor capacities, plus every pending workload's
+        per-cluster forecast, current placement and best placement.
+        404 (ClientError) when no global scheduler runs."""
+        return self._request("GET", "/global/standings")
+
     # ---- control ----
     def quarantine_list(self) -> dict:
         """Sidelined poison workloads + the solver guard's health

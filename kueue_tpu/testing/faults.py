@@ -135,6 +135,28 @@ FAULT_POINTS: Dict[str, str] = {
         "winner's stale copy; the dispatcher must refuse the token and "
         "retract the copy instead of double-admitting"
     ),
+    # ---- global scheduler (kueue_tpu/federation/global_scheduler.py) ----
+    "global.partition": (
+        "once per worker read during global-snapshot aggregation — arm "
+        "with a TransportError-raising action to model the worker "
+        "partitioned away from the rescore loop (its columns degrade "
+        "to unscorable, the pass continues), or 'crash' to kill the "
+        "manager mid-aggregation"
+    ),
+    "global.stale_fence": (
+        "transform point over the fencing epoch a rebalance decision "
+        "was computed against — arm with a corrupting callable to "
+        "model the placement moving (deposal/heal/concurrent "
+        "rebalance) between aggregation and apply; the CAS must DROP "
+        "the move instead of retracting the wrong epoch"
+    ),
+    "global.rebalance_retract": (
+        "inside a rebalance apply, after the old winner's retraction "
+        "is journaled and before the new dispatch intent is — a crash "
+        "here replays to 'old winner still named, unacked retraction "
+        "queued'; the pump + deposal + re-dispatch must converge to "
+        "exactly one admission"
+    ),
     # ---- gateway serving tier (kueue_tpu/gateway/batcher.py) ----
     "gateway.flush_mid_batch": (
         "inside the write-gateway's coalescing flush, between two "

@@ -36,6 +36,10 @@ WORKLOAD_SPAN_NAMES = frozenset(
         "federation.winner",
         "federation.sync_back",
         "federation.retract",
+        # global scheduler (federation/global_scheduler.py): one span
+        # per APPLIED rebalance, joining the federation hop spans on
+        # the workload's lifecycle trace (from/to/fence/forecast gain)
+        "global.rescore",
     }
 )
 

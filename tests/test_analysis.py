@@ -475,6 +475,76 @@ class TestClockDisciplineRule:
             )
 
 
+class TestClockStrictFederationScope:
+    """The federation strict sub-scope (ISSUE 15 satellite): under
+    kueue_tpu/federation/, duration measurement and sleeps are ALSO
+    findings — the FakeClock chaos suites drive that code end to end,
+    so even telemetry timing must be allowlisted deliberately."""
+
+    BAD = (
+        "import time\n\n\n"
+        "def pump():\n"
+        "    t0 = time.perf_counter()\n"
+        "    time.sleep(0.1)\n"
+        "    return time.perf_counter() - t0\n"
+    )
+
+    def test_strict_scope_flags_perf_counter_and_sleep(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            {"kueue_tpu/federation/x.py": self.BAD},
+            rules=["clock-discipline"],
+            config={"clock_allowlist": {}},
+        )
+        assert len(findings) == 3
+        assert all("strict scope" in f.message for f in findings)
+
+    def test_outside_federation_perf_counter_stays_allowed(self, tmp_path):
+        assert run_fixture(
+            tmp_path,
+            {"kueue_tpu/core/x.py": self.BAD},
+            rules=["clock-discipline"],
+            config={"clock_allowlist": {}},
+        ) == []
+
+    def test_strict_scope_honors_allowlist(self, tmp_path):
+        allow = {
+            "kueue_tpu/federation/x.py::pump": (
+                "RTT measurement, reported never scheduled on"
+            )
+        }
+        assert run_fixture(
+            tmp_path,
+            {"kueue_tpu/federation/x.py": self.BAD},
+            rules=["clock-discipline"],
+            config={"clock_allowlist": allow},
+        ) == []
+
+    def test_strict_prefixes_configurable(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            {"kueue_tpu/core/x.py": self.BAD},
+            rules=["clock-discipline"],
+            config={
+                "clock_allowlist": {},
+                "clock_strict_prefixes": ("kueue_tpu/core/",),
+            },
+        )
+        assert len(findings) == 3
+
+    def test_real_federation_tree_is_strict_clean(self):
+        """The shipped federation package passes its own strict rule
+        (dispatcher RTT + rescore timing ride allowlist entries)."""
+        findings = [
+            f
+            for f in run_analysis(
+                repo_root(), rules=["clock-discipline"]
+            )
+            if f.file.startswith("kueue_tpu/federation/")
+        ]
+        assert findings == []
+
+
 # ---- lock-discipline ----
 LOCK_BAD = '''\
 import threading

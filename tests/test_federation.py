@@ -623,6 +623,268 @@ class TestChaosProperty:
         self._run_trace(tmp_path, arm, heal)
 
 
+class TestRankCache:
+    """ISSUE-15 satellite: rank_clusters used to re-filter and re-sort
+    the full cluster list per workload per step — the health-filtered
+    list is now cached per step (invalidated on any connectivity /
+    quarantine flip) and placement scores are memoized per
+    (cluster, workload) within the step. Dispatch order must be
+    IDENTICAL to the uncached implementation."""
+
+    def _dispatch_orders(self, rank_cache, n_workers=4, n_wl=6):
+        clock = FakeClock(0.0)
+        workers = {}
+        clusters = {}
+        for i in range(n_workers):
+            name = f"w{i + 1}"
+            rt, _ = build_worker(clock, cpu=str(4 + 3 * i))
+            workers[name] = rt
+            clusters[name] = MultiKueueCluster(name=name, runtime=rt)
+        mgr = ClusterRuntime(clock=clock)
+        disp = FederationDispatcher(
+            mgr, clusters=clusters, drive_inprocess=True,
+            rank_cache=rank_cache,
+        )
+        for i in range(n_wl):
+            mgr.add_workload(wl(f"ord-{i}", cpu=str(1 + i % 3)))
+        mgr.run_until_idle()
+        return {
+            key: list(disp.states[key].clusters) for key in disp.states
+        }, disp
+
+    def test_cached_order_identical_to_uncached(self):
+        cached, _ = self._dispatch_orders(rank_cache=True)
+        uncached, _ = self._dispatch_orders(rank_cache=False)
+        assert cached == uncached
+
+    def test_placement_scored_once_per_pair_per_step(self):
+        calls = []
+
+        def counting_placement(cluster, w):
+            calls.append((cluster.name, w.key))
+            return 1.0
+
+        clock = FakeClock(0.0)
+        rt, _ = build_worker(clock)
+        mgr = ClusterRuntime(clock=clock)
+        disp = FederationDispatcher(
+            mgr,
+            clusters={"w1": MultiKueueCluster(name="w1", runtime=rt)},
+            placement=counting_placement,
+        )
+        w = wl("memo")
+        mgr.add_workload(w)
+        disp._step_seq += 1  # one step scope
+        disp.rank_clusters(w)
+        disp.rank_clusters(w)  # deposal re-rank within the same step
+        assert calls.count(("w1", w.key)) == 1
+        disp._step_seq += 1  # next step: memo dropped
+        disp.rank_clusters(w)
+        assert calls.count(("w1", w.key)) == 2
+
+    def test_heartbeat_connectivity_flip_invalidates_mid_step(self):
+        clock = FakeClock(0.0)
+        workers = {}
+        clusters = {}
+        for name in ("w1", "w2"):
+            rt, _ = build_worker(clock)
+            workers[name] = rt
+            clusters[name] = MultiKueueCluster(name=name, runtime=rt)
+        mgr = ClusterRuntime(clock=clock)
+        disp = FederationDispatcher(
+            mgr, clusters=clusters, drive_inprocess=True
+        )
+        w = wl("flip")
+        mgr.add_workload(w)
+        mgr.run_until_idle()
+        disp._step_seq += 1
+        names_before = disp._healthy_names(clock.now())
+        assert set(names_before) == {"w1", "w2"}
+        # mid-step: a heartbeat marks w1 lost — the fingerprint changes
+        # and the cached list rebuilds (quarantine works the same way)
+        disp.health["w1"].quarantined_until = clock.now() + 100.0
+        names_after = disp._healthy_names(clock.now())
+        assert names_after == ["w2"]
+
+
+class TestGangSyncAdapters:
+    """ISSUE-15 satellite (PR-6 follow-up): gang/job sync over the
+    wire — the gang parent id label is mirrored onto remote copies,
+    and a deposed winner's gang children are retracted atomically
+    through _sync_winner's deposal path."""
+
+    def _gang_federation(self):
+        mgr, disp, workers, clock, _ = federation()
+        members = []
+        for i in range(2):
+            w = wl(f"gang-{i}")
+            w.labels["kueue.x-k8s.io/multikueue-gang"] = "ns/jobset-a"
+            mgr.add_workload(w)
+            members.append(w)
+        drive(mgr, clock, passes=3)
+        return mgr, disp, workers, clock, members
+
+    def test_gang_label_mirrored_on_remote_copies(self):
+        from kueue_tpu.federation import GANG_LABEL
+
+        mgr, disp, workers, clock, members = self._gang_federation()
+        for w in members:
+            winner = disp.states[w.key].winner
+            assert winner is not None
+            rwl = workers[winner].workloads[w.key]
+            assert rwl.labels[GANG_LABEL] == "ns/jobset-a"
+
+    def test_gang_members_share_a_winner(self):
+        mgr, disp, workers, clock, members = self._gang_federation()
+        winners = {disp.states[w.key].winner for w in members}
+        assert len(winners) == 1  # shared rotation: co-placed
+
+    def test_deposed_winner_retracts_gang_children_atomically(self):
+        mgr, disp, workers, clock, members = self._gang_federation()
+        winner = disp.states[members[0].key].winner
+        other = next(n for n in workers if n != winner)
+        # partition the winner past the lost timeout: ONE member's
+        # sync trips the deposal; the sibling must fence-bump in the
+        # SAME pass with its retraction enqueued (atomic gang retract)
+        disp.clusters[winner].mark_lost(clock.now())
+        clock.advance(21.0)
+        mgr.run_until_idle()
+        for w in members:
+            st = disp.states[w.key]
+            assert st.fence == 2, f"{w.key} not deposed with its gang"
+            assert st.winner != winner
+            pending = [
+                r for r in disp.retractions.values()
+                if r.key == w.key and r.cluster == winner and not r.acked
+            ]
+            assert pending, f"{w.key}: no retraction against {winner}"
+        # heal: stale copies retracted, exactly-one admission each
+        disp.clusters[winner].mark_connected()
+        drive(mgr, clock, passes=4)
+        assert_converged(mgr, workers, [w.key for w in members])
+        # both landed on the surviving cluster together
+        for w in members:
+            assert holders(workers, w.key) == [other]
+
+    def test_non_gang_workloads_do_not_cascade(self):
+        mgr, disp, workers, clock, _ = federation()
+        a, b = wl("solo-a"), wl("solo-b")
+        mgr.add_workload(a)
+        mgr.add_workload(b)
+        drive(mgr, clock, passes=3)
+        wa, wb = disp.states[a.key].winner, disp.states[b.key].winner
+        if wa != wb:
+            pytest.skip("different winners: cascade cannot apply")
+        disp.clusters[wa].mark_lost(clock.now())
+        clock.advance(21.0)
+        # depose ONLY a via the sync loop: b (no gang label) must keep
+        # its state until its own sync decides
+        st_a = disp.states[a.key]
+        disp._depose_winner(a, st_a, clock.now(), "test deposal")
+        assert disp.states[b.key].winner == wb
+        assert disp.states[b.key].fence == 1
+
+
+class TestRetractionDedupReplay:
+    """ISSUE-15 satellite: duplicate journal replay across restore ->
+    pump_retractions must not double-ack (at-least-once, exactly-one
+    delete per obligation), and an enqueue AFTER an ack re-opens the
+    obligation (the copy was recreated under the same fence)."""
+
+    def test_duplicated_records_restore_to_single_entries(self):
+        mgr, disp, workers, clock, _ = federation()
+        w = wl("dup-replay")
+        mgr.add_workload(w)
+        drive(mgr, clock, passes=3)
+        st = disp.states[w.key]
+        loser = next(n for n in workers if n != st.winner)
+        records = [
+            ("federation_retract_enqueue",
+             {"key": w.key, "cluster": loser, "fence": 1}),
+            ("federation_retract_done",
+             {"key": w.key, "cluster": loser, "fence": 1}),
+        ]
+        fresh = FederationDispatcher(
+            ClusterRuntime(clock=clock), clusters={},
+        )
+        # at-least-once journal delivery: the same records replayed
+        # TWICE (restore after restore) must converge, not duplicate
+        fresh.restore(records + records)
+        assert len(fresh.retractions) == 1
+        (r,) = fresh.retractions.values()
+        assert r.acked and r.cluster == loser and r.fence == 1
+
+    def test_replayed_ack_is_not_redelivered(self):
+        """An acked retraction survives replay as acked: the pump must
+        not re-send the delete (no double-ack, no spurious delete of a
+        recreated copy)."""
+        mgr, disp, workers, clock, _ = federation()
+        w = wl("no-redeliver")
+        mgr.add_workload(w)
+        drive(mgr, clock, passes=3)
+        st = disp.states[w.key]
+        loser = next(n for n in workers if n != st.winner)
+        acked_before = [
+            (d, r.attempts) for d, r in sorted(disp.retractions.items())
+            if r.acked
+        ]
+        deletes = []
+        for name, cluster in disp.clusters.items():
+            orig = cluster.transport.delete_workload
+
+            def spy(key, _orig=orig, _name=name):
+                deletes.append((_name, key))
+                return _orig(key)
+
+            cluster.transport.delete_workload = spy
+        # replay the SAME (enqueue, done) pair again, then pump
+        disp.restore([
+            ("federation_retract_enqueue",
+             {"key": w.key, "cluster": loser, "fence": 1}),
+            ("federation_retract_done",
+             {"key": w.key, "cluster": loser, "fence": 1}),
+        ])
+        disp.pump_retractions()
+        assert deletes == []
+        acked_after = [
+            (d, r.attempts) for d, r in sorted(disp.retractions.items())
+            if r.acked
+        ]
+        assert acked_after == acked_before
+
+    def test_enqueue_after_ack_reopens_the_obligation(self):
+        mgr, disp, workers, clock, _ = federation()
+        w = wl("reopen")
+        mgr.add_workload(w)
+        drive(mgr, clock, passes=3)
+        st = disp.states[w.key]
+        loser = next(n for n in workers if n != st.winner)
+        dedup = (w.key, loser, 1)
+        assert disp.retractions[dedup].acked
+        # the copy reappears under the same fence (crash-recovery
+        # re-mirror): a NEW enqueue must re-open, and the pump must
+        # deliver the delete again (404 == ack keeps it idempotent)
+        disp._enqueue_retraction(w.key, loser, 1)
+        assert not disp.retractions[dedup].acked
+        disp.pump_retractions()
+        assert disp.retractions[dedup].acked
+
+    def test_finished_state_sweep_does_not_reopen(self):
+        """The local-delete sweep skips finished states: GC must
+        eventually collect them instead of re-opening their acked
+        retractions every pass forever."""
+        mgr, disp, workers, clock, _ = federation()
+        w = wl("gc-me")
+        mgr.add_workload(w)
+        drive(mgr, clock, passes=3)
+        mgr.delete_workload(w)
+        drive(mgr, clock, passes=3)
+        assert w.key not in disp.states
+        assert not [
+            r for r in disp.retractions.values() if r.key == w.key
+        ]
+
+
 class TestFederationObservability:
     def test_metrics_and_health_report(self):
         mgr, disp, workers, clock, _ = federation()

@@ -1,0 +1,777 @@
+"""Global scheduler (kueue_tpu/federation/global_scheduler.py +
+federation/aggregate.py + ops/global_kernel.py): batched cross-cluster
+rescoring bit-for-bit against its numpy mirror, federation-wide
+aggregation through in-process runtimes and the replica feed,
+planner-driven rebalancing under hysteresis + fencing, and the chaos
+property — exactly-one admission across the ``global.*`` fault points
+(crash mid-retraction, stale fence, partitioned worker)."""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.admissionchecks.multikueue import MultiKueueCluster
+from kueue_tpu.admissionchecks.multikueue_transport import TransportError
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.federation import (
+    FederationDispatcher,
+    GlobalScheduler,
+    collect_global_snapshot,
+)
+from kueue_tpu.models import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import ResourceGroup
+from kueue_tpu.models.constants import WorkloadConditionType
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.ops.global_kernel import (
+    INVALID_KEY,
+    MAX_CLUSTERS,
+    rescore_pairs,
+)
+from kueue_tpu.ops.global_np import rescore_np
+from kueue_tpu.storage.journal import Journal
+from kueue_tpu.storage.recovery import recover
+from kueue_tpu.testing import faults
+from kueue_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---- kernel <-> mirror parity ----
+class TestRescoreKernelParity:
+    """Acceptance: the global rescore kernel is bit-for-bit its numpy
+    mirror over seeded heterogeneous fleets."""
+
+    def _random_fleet(self, rng):
+        w = int(rng.integers(0, 12))
+        c = int(rng.integers(1, 9))
+        # heterogeneous forecasts: a mix of instant fits, deep queues,
+        # horizon-overflow values, plus deliberate TTA ties so the
+        # score and rotation tie-breaks engage
+        tta = rng.choice(
+            [0, 1, 999, 60_000, 600_000, 10**9, 2**40],
+            size=(w, c),
+        ).astype(np.int64)
+        score = rng.integers(-(2**22), 2**22, size=(w, c))
+        valid = rng.random((w, c)) < 0.75
+        current = rng.integers(-1, c, size=max(w, 1))[:w].astype(np.int32)
+        rotation = (
+            rng.integers(0, 2**31, size=max(w, 1))[:w] % c
+        ).astype(np.int32)
+        hysteresis = int(rng.choice([0, 1, 30_000, 600_000]))
+        return tta, score, valid, current, rotation, hysteresis
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_kernel_matches_mirror(self, seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(12):
+            args = self._random_fleet(rng)
+            dev = rescore_pairs(*args)
+            host = rescore_np(*args)
+            for d, h, name in zip(dev, host, dev._fields):
+                assert np.array_equal(np.asarray(d), np.asarray(h)), (
+                    f"seed {seed}: field {name} diverged\n{args}"
+                )
+
+    def test_tta_wins_then_score_then_rotation(self):
+        tta = np.array([[100, 100, 50]], dtype=np.int64)
+        score = np.array([[5, 9, 0]], dtype=np.int64)
+        valid = np.ones((1, 3), dtype=bool)
+        cur = np.array([0], dtype=np.int32)
+        rot = np.array([0], dtype=np.int32)
+        res = rescore_np(tta, score, valid, cur, rot, 0)
+        assert res.best[0] == 2  # lowest tta wins outright
+        # tie on tta: higher score wins
+        tta = np.array([[100, 100, 100]], dtype=np.int64)
+        res = rescore_np(tta, score, valid, cur, rot, 0)
+        assert res.best[0] == 1
+        # full tie: the rotated index decides (rotation 2 makes
+        # column 2 position 0)
+        score = np.zeros((1, 3), dtype=np.int64)
+        res = rescore_np(
+            tta, score, valid, cur, np.array([2], dtype=np.int32), 0
+        )
+        assert res.best[0] == 2
+        dev = rescore_pairs(
+            tta, score, valid, cur, np.array([2], dtype=np.int32), 0
+        )
+        assert dev.best[0] == 2
+
+    def test_hysteresis_boundary(self):
+        # current cluster forecasts 100s, the other 0s: gain 100_000ms
+        tta = np.array([[100_000, 0]], dtype=np.int64)
+        score = np.zeros((1, 2), dtype=np.int64)
+        valid = np.ones((1, 2), dtype=bool)
+        cur = np.array([0], dtype=np.int32)
+        rot = np.array([0], dtype=np.int32)
+        at = rescore_np(tta, score, valid, cur, rot, 100_000)
+        assert not at.rebalance[0]  # gain == T: stay
+        above = rescore_np(tta, score, valid, cur, rot, 99_999)
+        assert above.rebalance[0] and above.gain_ms[0] == 100_000
+        dev = rescore_pairs(tta, score, valid, cur, rot, 99_999)
+        assert bool(dev.rebalance[0])
+
+    def test_invalid_and_degenerate_shapes(self):
+        for fn in (rescore_pairs, rescore_np):
+            res = fn(
+                np.zeros((0, 3), dtype=np.int64),
+                np.zeros((0, 3), dtype=np.int64),
+                np.zeros((0, 3), dtype=bool),
+                np.zeros(0, dtype=np.int32),
+                np.zeros(0, dtype=np.int32),
+                0,
+            )
+            assert res.best.shape == (0,)
+            # all-invalid row: best -1, INVALID_KEY, no rebalance
+            res = fn(
+                np.zeros((1, 2), dtype=np.int64),
+                np.zeros((1, 2), dtype=np.int64),
+                np.zeros((1, 2), dtype=bool),
+                np.array([0], dtype=np.int32),
+                np.array([0], dtype=np.int32),
+                0,
+            )
+            assert res.best[0] == -1
+            assert res.best_key[0] == INVALID_KEY
+            assert not res.rebalance[0]
+
+    def test_unscorable_current_never_rebalances(self):
+        # the current placement cannot be forecast (partitioned
+        # worker): conservative — no move on one-sided information
+        tta = np.array([[0, 0]], dtype=np.int64)
+        valid = np.array([[False, True]])
+        res = rescore_np(
+            tta, np.zeros((1, 2), dtype=np.int64), valid,
+            np.array([0], dtype=np.int32),
+            np.array([0], dtype=np.int32), 0,
+        )
+        assert res.best[0] == 1 and not res.rebalance[0]
+
+    def test_cluster_budget_is_enforced(self):
+        shape = (1, MAX_CLUSTERS + 1)
+        with pytest.raises(ValueError):
+            rescore_np(
+                np.zeros(shape, dtype=np.int64),
+                np.zeros(shape, dtype=np.int64),
+                np.ones(shape, dtype=bool),
+                np.array([0], dtype=np.int32),
+                np.array([0], dtype=np.int32),
+                0,
+            )
+
+
+# ---- federation builders ----
+def build_worker(clock, cpu="10", journal_path=None):
+    rt = ClusterRuntime(clock=clock)
+    journal = None
+    if journal_path is not None:
+        journal = Journal(str(journal_path), fsync_policy="never").open()
+        rt.attach_journal(journal)
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq",
+            namespace_selector={},
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu",), (FlavorQuotas.build("default", {"cpu": cpu}),)
+                ),
+            ),
+        )
+    )
+    rt.add_local_queue(
+        LocalQueue(namespace="ns", name="lq", cluster_queue="cq")
+    )
+    return rt, journal
+
+
+def wl(name, cpu="1", **kw):
+    return Workload(
+        namespace="ns", name=name, queue_name="lq",
+        pod_sets=(PodSet.build("main", 1, {"cpu": cpu}),), **kw,
+    )
+
+
+def finish(rt, key, clock):
+    w = rt.workloads[key]
+    w.set_condition(
+        WorkloadConditionType.FINISHED, True, "JobFinished", "done",
+        now=clock.now(),
+    )
+    rt.on_workload_finished(w)
+
+
+def congested_federation(
+    tmp_path=None,
+    n_workers=2,
+    fanout=1,
+    hysteresis_s=10.0,
+    n_wl=1,
+    **gs_kw,
+):
+    """Every worker saturated by a local hog, ``n_wl`` federated
+    workloads parked on their single-target placements — finishing one
+    hog is what makes a rescore move them."""
+    clock = FakeClock(0.0)
+    workers = {}
+    clusters = {}
+    for i in range(n_workers):
+        name = f"w{i + 1}"
+        rt, _ = build_worker(clock)
+        hog = wl(f"hog-{name}", cpu="10")
+        rt.add_workload(hog)
+        rt.run_until_idle()
+        assert hog.is_admitted
+        workers[name] = rt
+        clusters[name] = MultiKueueCluster(name=name, runtime=rt)
+    mgr = ClusterRuntime(clock=clock)
+    journal = None
+    if tmp_path is not None:
+        journal = Journal(
+            str(tmp_path / "mgr-journal"), fsync_policy="never"
+        ).open()
+        mgr.attach_journal(journal)
+    disp = FederationDispatcher(
+        mgr, clusters=clusters, drive_inprocess=True, fanout=fanout,
+        worker_lost_timeout=1e9, heartbeat_interval_s=1e9,
+    )
+    gs = GlobalScheduler(
+        disp, hysteresis_s=hysteresis_s, rescore_interval_s=0.0, **gs_kw
+    )
+    fed = []
+    for i in range(n_wl):
+        w = wl(f"fed-{i}", cpu="4")
+        mgr.add_workload(w)
+        fed.append(w)
+    mgr.run_until_idle()
+    for w in fed:
+        assert not w.is_admitted  # parked: every worker is full
+    return mgr, disp, gs, workers, clock, journal, fed
+
+
+def drive(mgr, clock, passes=6, advance=10.0):
+    for _ in range(passes):
+        mgr.run_until_idle()
+        clock.advance(advance)
+    mgr.run_until_idle()
+
+
+def assert_converged_once(mgr, workers, keys):
+    admitted = {k for k, w in mgr.workloads.items() if w.is_admitted}
+    assert admitted == set(keys)
+    for key in keys:
+        holders = sorted(
+            n for n, rt in workers.items() if key in rt.workloads
+        )
+        assert len(holders) == 1, f"{key}: copies on {holders}"
+        assert workers[holders[0]].workloads[key].has_quota_reservation
+    assert mgr.check_invariants() == []
+    for name, rt in workers.items():
+        assert rt.check_invariants() == [], f"worker {name}"
+
+
+# ---- aggregation ----
+class TestAggregation:
+    def test_snapshot_standings_capacities_and_forecasts(self):
+        mgr, disp, gs, workers, clock, _, fed = congested_federation()
+        key = fed[0].key
+        cur = disp.states[key].clusters[0]
+        other = next(n for n in workers if n != cur)
+        finish(workers[other], f"ns/hog-{other}", clock)
+        snap = collect_global_snapshot(disp)
+        assert snap.clusters == sorted(workers)
+        assert snap.keys == [key]
+        assert snap.fences[key] == 1
+        assert snap.current[key] == cur
+        j_cur = snap.clusters.index(cur)
+        j_other = snap.clusters.index(other)
+        assert snap.valid[0, j_cur] and snap.valid[0, j_other]
+        assert snap.tta_ms[0, j_other] == 0  # freed worker fits now
+        assert snap.tta_ms[0, j_cur] == 600_000  # runtime-hint release
+        view = snap.workers[cur]
+        assert view.reachable and view.source == "inprocess"
+        (q,) = view.queues
+        assert q["clusterQueue"] == "cq" and q["pending"] >= 1
+        assert q["dominantShareMilli"] >= 0 and q["weightMilli"] == 1000
+        (cap,) = [
+            c for c in view.capacities
+            if c["flavor"] == "default" and c["resource"] == "cpu"
+        ]
+        assert cap["nominal"] == 10_000 and cap["usage"] == 10_000
+        assert cap["available"] == 0
+
+    def test_admitted_workloads_are_not_rows(self):
+        clock = FakeClock(0.0)
+        w1, _ = build_worker(clock)
+        mgr = ClusterRuntime(clock=clock)
+        disp = FederationDispatcher(
+            mgr,
+            clusters={"w1": MultiKueueCluster(name="w1", runtime=w1)},
+            drive_inprocess=True,
+        )
+        GlobalScheduler(disp, rescore_interval_s=0.0)
+        w = wl("runs")
+        mgr.add_workload(w)
+        drive(mgr, clock, passes=2, advance=0.0)
+        assert w.is_admitted
+        assert collect_global_snapshot(disp).keys == []
+
+    def test_wire_only_worker_without_reader_is_unscorable(self):
+        from kueue_tpu.admissionchecks.multikueue_transport import (
+            HTTPTransport,
+        )
+
+        clock = FakeClock(0.0)
+        w1, _ = build_worker(clock)
+        mgr = ClusterRuntime(clock=clock)
+        disp = FederationDispatcher(
+            mgr,
+            clusters={
+                "w1": MultiKueueCluster(name="w1", runtime=w1),
+                "dark": MultiKueueCluster(
+                    name="dark",
+                    transport=HTTPTransport("http://127.0.0.1:1"),
+                ),
+            },
+            drive_inprocess=True,
+        )
+        GlobalScheduler(disp, rescore_interval_s=0.0)
+        mgr.add_workload(wl("probe", cpu="20"))  # unadmittable: stays
+        mgr.run_until_idle()
+        snap = collect_global_snapshot(disp)
+        dark = snap.workers["dark"]
+        assert not dark.reachable and dark.source == "none"
+        j = snap.clusters.index("dark")
+        assert not snap.valid[:, j].any()
+
+    def test_partitioned_worker_degrades_not_fails(self):
+        mgr, disp, gs, workers, clock, _, fed = congested_federation()
+
+        def _raise():
+            raise TransportError("aggregation partitioned")
+
+        faults.arm("global.partition", action=_raise)
+        snap = collect_global_snapshot(disp)
+        assert all(not v.reachable for v in snap.workers.values())
+        assert not snap.valid.any()
+        res = gs.rescore()
+        assert res["rebalanced"] == []
+
+
+# ---- rebalancing ----
+class TestRebalancing:
+    def _free_other(self, disp, workers, clock, key):
+        cur = disp.states[key].winner or disp.states[key].clusters[0]
+        other = next(n for n in workers if n != cur)
+        finish(workers[other], f"ns/hog-{other}", clock)
+        return cur, other
+
+    def test_rebalance_moves_parked_workload_and_converges(self):
+        mgr, disp, gs, workers, clock, _, fed = congested_federation()
+        key = fed[0].key
+        cur, other = self._free_other(disp, workers, clock, key)
+        report = gs.rescore()
+        assert report["rebalanced"] == [
+            {
+                "workload": key,
+                "from": cur,
+                "to": other,
+                "gainS": 600.0,
+            }
+        ]
+        st = disp.states[key]
+        assert st.fence == 2 and st.clusters == [other]
+        drive(mgr, clock, passes=4)
+        assert_converged_once(mgr, workers, [key])
+        assert fed[0].is_admitted
+        # the move is journaled + evented + counted
+        events = [
+            e for e in mgr.events if e.kind == "MultiKueueRebalanced"
+        ]
+        assert events and other in events[-1].message
+        assert gs.rebalances == 1
+        text = mgr.metrics.registry.expose()
+        assert (
+            'kueue_global_rebalances_total{outcome="applied"} 1' in text
+        )
+
+    def test_rebalance_span_joins_lifecycle_trace(self):
+        mgr, disp, gs, workers, clock, _, fed = congested_federation()
+        key = fed[0].key
+        self._free_other(disp, workers, clock, key)
+        gs.rescore()
+        tracer = getattr(mgr, "tracer", None)
+        if tracer is None:
+            pytest.skip("runtime has no tracer")
+        tid = tracer.workload_trace_id(key)
+        assert tid is not None
+        names = {s.name for s in tracer.trace(tid)}
+        assert "global.rescore" in names
+        assert "federation.dispatch" in names  # same joined trace
+
+    def test_hysteresis_blocks_small_gains(self):
+        mgr, disp, gs, workers, clock, _, fed = congested_federation(
+            hysteresis_s=10_000.0,  # > the 600s runtime-hint gain
+        )
+        key = fed[0].key
+        self._free_other(disp, workers, clock, key)
+        report = gs.rescore()
+        assert report["rebalanced"] == []
+        assert disp.states[key].fence == 1
+
+    def test_covered_target_is_skipped(self):
+        # fanout=2: both clusters are already targets of the race —
+        # a better forecast inside the target set is NOT a move
+        mgr, disp, gs, workers, clock, _, fed = congested_federation(
+            fanout=2,
+        )
+        key = fed[0].key
+        self._free_other(disp, workers, clock, key)
+        report = gs.rescore()
+        assert report["rebalanced"] == []
+        text = mgr.metrics.registry.expose()
+        assert (
+            'kueue_global_rebalances_total{outcome="skipped_covered"} 1'
+            in text
+        )
+
+    def test_stale_fence_cas_drops_the_move(self):
+        mgr, disp, gs, workers, clock, _, fed = congested_federation()
+        key = fed[0].key
+        self._free_other(disp, workers, clock, key)
+        faults.arm("global.stale_fence", action=lambda t: t + 1)
+        report = gs.rescore()
+        assert report["rebalanced"] == []
+        st = disp.states[key]
+        assert st.fence == 1  # untouched: no retraction, no re-dispatch
+        text = mgr.metrics.registry.expose()
+        assert (
+            'kueue_global_rebalances_total{outcome="skipped_stale"} 1'
+            in text
+        )
+        faults.reset()
+        gs.rescore()
+        drive(mgr, clock, passes=4)
+        assert_converged_once(mgr, workers, [key])
+
+    def test_max_rebalances_per_pass_caps_churn(self):
+        mgr, disp, gs, workers, clock, _, fed = congested_federation(
+            n_workers=3, n_wl=3, max_rebalances_per_pass=1,
+        )
+        # free every non-current worker: all three workloads see gains
+        for w in fed:
+            st = disp.states[w.key]
+            cur = st.winner or st.clusters[0]
+        for name in workers:
+            hog_key = f"ns/hog-{name}"
+            targets = {
+                (disp.states[w.key].winner or disp.states[w.key].clusters[0])
+                for w in fed
+            }
+            if name not in targets:
+                finish(workers[name], hog_key, clock)
+        report = gs.rescore()
+        assert len(report["rebalanced"]) <= 1
+
+    def test_interval_gating(self):
+        mgr, disp, gs, workers, clock, _, fed = congested_federation()
+        gs.rescore_interval_s = 30.0
+        gs.rescore()  # primes last_rescore_at
+        n = gs.rescores
+        mgr.run_until_idle()
+        assert gs.rescores == n  # within the interval: gated
+        clock.advance(31.0)
+        mgr.run_until_idle()
+        assert gs.rescores == n + 1
+
+    def test_standings_is_read_only(self):
+        from kueue_tpu import serialization as ser
+
+        mgr, disp, gs, workers, clock, _, fed = congested_federation()
+        key = fed[0].key
+        self._free_other(disp, workers, clock, key)
+        before = ser.runtime_to_state(mgr)
+        fence_before = disp.states[key].fence
+        body = gs.standings()
+        assert ser.runtime_to_state(mgr) == before
+        assert disp.states[key].fence == fence_before
+        (row,) = body["workloads"]
+        assert row["rebalance"] is True and row["best"] is not None
+
+    def test_host_mirror_path_decides_identically(self):
+        a = congested_federation(use_device=True)
+        b = congested_federation(use_device=False)
+        for mgr, disp, gs, workers, clock, _, fed in (a, b):
+            key = fed[0].key
+            cur = disp.states[key].clusters[0]
+            other = next(n for n in workers if n != cur)
+            finish(workers[other], f"ns/hog-{other}", clock)
+        ra = a[2].rescore()
+        rb = b[2].rescore()
+        assert ra["path"] == "device" and rb["path"] == "host"
+        strip = lambda r: [
+            {k: v for k, v in row.items()} for row in r["workloads"]
+        ]
+        assert strip(ra) == strip(rb)
+        assert [x["to"] for x in ra["rebalanced"]] == [
+            x["to"] for x in rb["rebalanced"]
+        ]
+
+
+# ---- chaos: exactly-one admission across the global.* fault points ----
+def recover_manager(journal, tmp_path, clusters, clock, **gs_kw):
+    journal.close()
+    mgr2 = ClusterRuntime(clock=clock)
+    res = recover(
+        None, str(tmp_path / "mgr-journal"), runtime=mgr2, strict=True
+    )
+    mgr2.attach_journal(res.journal)
+    disp2 = FederationDispatcher(
+        mgr2, clusters=clusters, drive_inprocess=True, fanout=1,
+        worker_lost_timeout=1e9, heartbeat_interval_s=1e9,
+    )
+    gs2 = GlobalScheduler(
+        disp2, hysteresis_s=10.0, rescore_interval_s=0.0, **gs_kw
+    )
+    return mgr2, disp2, gs2, res.journal
+
+
+class TestChaosProperty:
+    """Acceptance: crash/corrupt at every ``global.*`` point during
+    active rebalancing; after recovery the federation converges to
+    exactly one admission per workload with invariants clean."""
+
+    def _arm_and_run(self, tmp_path, point, action, occurrence=0):
+        mgr, disp, gs, workers, clock, journal, fed = (
+            congested_federation(tmp_path, n_workers=3, n_wl=3)
+        )
+        keys = [w.key for w in fed]
+        # free capacity the current placements don't hold: rebalances
+        # are genuinely in flight when the fault fires
+        targets = {
+            disp.states[k].winner or disp.states[k].clusters[0]
+            for k in keys
+        }
+        for name in workers:
+            if name not in targets:
+                finish(workers[name], f"ns/hog-{name}", clock)
+        faults.arm(point, action=action, skip=occurrence)
+        crashed = False
+        try:
+            drive(mgr, clock, passes=3)
+        except faults.InjectedCrash:
+            crashed = True
+        faults.reset()
+        if crashed:
+            mgr, disp, gs, journal = recover_manager(
+                journal, tmp_path, disp.clusters, clock
+            )
+        # release the remaining hogs so every workload can admit
+        for name in workers:
+            hog_key = f"ns/hog-{name}"
+            if (
+                hog_key in workers[name].workloads
+                and not workers[name].workloads[hog_key].is_finished
+            ):
+                finish(workers[name], hog_key, clock)
+        drive(mgr, clock, passes=8)
+        assert_converged_once(mgr, workers, keys)
+        journal.close()
+        return crashed
+
+    @pytest.mark.parametrize("occurrence", [0, 1, 2])
+    def test_crash_mid_retraction(self, tmp_path, occurrence):
+        crashed = self._arm_and_run(
+            tmp_path, "global.rebalance_retract", "crash", occurrence
+        )
+        assert crashed or occurrence > 0
+
+    @pytest.mark.parametrize("occurrence", [0, 2])
+    def test_crash_mid_aggregation_partition_point(
+        self, tmp_path, occurrence
+    ):
+        self._arm_and_run(
+            tmp_path, "global.partition", "crash", occurrence
+        )
+
+    def test_partitioned_worker_during_rebalancing(self, tmp_path):
+        def _raise():
+            raise TransportError("injected aggregation partition")
+
+        self._arm_and_run(tmp_path, "global.partition", _raise)
+
+    def test_stale_fence_everywhere(self, tmp_path):
+        self._arm_and_run(
+            tmp_path, "global.stale_fence", lambda t: t + 99
+        )
+
+    def test_crash_at_stale_fence_window(self, tmp_path):
+        self._arm_and_run(tmp_path, "global.stale_fence", "crash")
+
+    def test_recovered_rebalance_state_is_consistent(self, tmp_path):
+        """Crash exactly inside the rebalance window, then inspect the
+        replayed state: the old epoch's retraction survived the crash,
+        the fence did NOT advance (the new dispatch intent never hit
+        the journal), and the pump deletes the stale copy before any
+        re-mirror (the retraction barrier)."""
+        mgr, disp, gs, workers, clock, journal, fed = (
+            congested_federation(tmp_path)
+        )
+        key = fed[0].key
+        cur = disp.states[key].clusters[0]
+        other = next(n for n in workers if n != cur)
+        finish(workers[other], f"ns/hog-{other}", clock)
+        faults.arm("global.rebalance_retract", action="crash")
+        with pytest.raises(faults.InjectedCrash):
+            mgr.run_until_idle()
+        faults.reset()
+        mgr2, disp2, gs2, j2 = recover_manager(
+            journal, tmp_path, disp.clusters, clock
+        )
+        st = disp2.states[key]
+        assert st.fence == 1 and st.winner is None
+        pending = [
+            r for r in disp2.retractions.values() if not r.acked
+        ]
+        assert [(r.cluster, r.fence) for r in pending] == [(cur, 1)]
+        drive(mgr2, clock, passes=6)
+        assert_converged_once(mgr2, workers, [key])
+        j2.close()
+
+
+# ---- riding the replica feed (wire-only workers) ----
+class TestFeedReaders:
+    def test_http_worker_scored_through_replica_feed(self, tmp_path):
+        from kueue_tpu.admissionchecks.multikueue_transport import (
+            HTTPTransport,
+        )
+        from kueue_tpu.server import KueueServer
+
+        clock = FakeClock(0.0)
+        wrt, wjournal = build_worker(
+            clock, journal_path=tmp_path / "w-journal"
+        )
+        wsrv = KueueServer(runtime=wrt)
+        port = wsrv.start()
+        mgr = ClusterRuntime(clock=clock)
+        disp = FederationDispatcher(
+            mgr,
+            clusters={
+                "east": MultiKueueCluster(
+                    name="east",
+                    transport=HTTPTransport(f"http://127.0.0.1:{port}"),
+                ),
+            },
+            heartbeat_interval_s=0.0,
+        )
+        gs = GlobalScheduler(disp, rescore_interval_s=0.0)
+        gs.attach_feed_reader("east", f"http://127.0.0.1:{port}")
+        try:
+            # park a workload: the worker is saturated by a local hog
+            hog = wl("hog", cpu="10")
+            wrt.add_workload(hog)
+            wrt.run_until_idle()
+            w = wl("wire-fed", cpu="4")
+            mgr.add_workload(w)
+            mgr.run_until_idle()
+            wrt.run_until_idle()
+            snap = collect_global_snapshot(disp, readers=gs.readers)
+            east = snap.workers["east"]
+            assert east.reachable and east.source == "feed"
+            assert snap.keys == [w.key]
+            assert snap.valid[0, 0]
+            # the feed twin sees the hog: forecast = its release time
+            assert snap.tta_ms[0, 0] == 600_000
+        finally:
+            wsrv.stop()
+            wjournal.close()
+
+
+# ---- surfaces: route, client, CLI, metrics ----
+class TestSurfaces:
+    def test_route_404_without_global_scheduler(self):
+        from kueue_tpu.server import KueueClient, KueueServer
+        from kueue_tpu.server.client import ClientError
+
+        clock = FakeClock(0.0)
+        mgr = ClusterRuntime(clock=clock)
+        srv = KueueServer(runtime=mgr)
+        port = srv.start()
+        try:
+            with pytest.raises(ClientError) as e:
+                KueueClient(f"http://127.0.0.1:{port}").global_standings()
+            assert e.value.status == 404
+        finally:
+            srv.stop()
+
+    def test_standings_route_client_and_cli(self, capsys):
+        from kueue_tpu.cli.__main__ import main as cli_main
+        from kueue_tpu.server import KueueClient, KueueServer
+
+        mgr, disp, gs, workers, clock, _, fed = congested_federation()
+        key = fed[0].key
+        cur = disp.states[key].clusters[0]
+        other = next(n for n in workers if n != cur)
+        finish(workers[other], f"ns/hog-{other}", clock)
+        srv = KueueServer(runtime=mgr)
+        port = srv.start()
+        try:
+            body = KueueClient(
+                f"http://127.0.0.1:{port}"
+            ).global_standings()
+            assert body["clusters"] == sorted(workers)
+            (row,) = body["workloads"]
+            assert row["workload"] == key
+            assert row["best"] == other and row["rebalance"] is True
+            assert body["workers"][cur]["reachable"] is True
+            assert body["hysteresisS"] == gs.hysteresis_s
+            rc = cli_main(
+                ["pending-workloads", "--global", "--server",
+                 f"http://127.0.0.1:{port}"]
+            )
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "WORKLOAD" in out and "REBALANCE" in out
+            assert key in out and "yes" in out
+            assert "CLUSTER" in out  # worker standings table
+        finally:
+            srv.stop()
+
+    def test_cli_global_requires_server(self):
+        from kueue_tpu.cli.__main__ import main as cli_main
+
+        with pytest.raises(SystemExit):
+            cli_main(["pending-workloads", "--global"])
+
+    def test_cli_plain_still_needs_clusterqueue(self):
+        from kueue_tpu.cli.__main__ import main as cli_main
+
+        with pytest.raises(SystemExit):
+            cli_main(["pending-workloads"])
+
+    def test_metrics_exposed_at_zero(self):
+        clock = FakeClock(0.0)
+        mgr = ClusterRuntime(clock=clock)
+        text = mgr.metrics.registry.expose()
+        for family in (
+            "kueue_global_rescore_total",
+            "kueue_global_rescore_seconds",
+            "kueue_global_rebalances_total",
+            "kueue_global_pending_workloads",
+            "kueue_global_workers_reachable",
+        ):
+            assert family in text, family
+        for outcome in (
+            "applied", "skipped_stale", "skipped_gone",
+            "skipped_covered", "skipped_cooldown",
+        ):
+            assert f'outcome="{outcome}"' in text
