@@ -1742,6 +1742,162 @@ def federation_bench(rng, n_workers=3, n_wl=120, worker_cpu=200):
     )
 
 
+def federation_churn_bench(
+    rng, n_workers=3, n_wl=90, worker_cpu=40, churn_rounds=3
+):
+    """Membership-churn stage (the elastic capacity plane's federation
+    half): a live federation under a full backlog while workers JOIN at
+    runtime and loaded workers are DRAINED and REMOVED (drain-ahead
+    scale-down: deposed winners re-dispatch onto surviving capacity
+    under the fencing protocol). Measures per-deposed-placement
+    readmission latency — drain issued to admitted-again on a survivor.
+    Exactly-once admission and per-plane invariants asserted through
+    every round. Returns (joins, drains, readmit_p95_ms, n_readmitted,
+    admitted)."""
+    from kueue_tpu.admissionchecks.multikueue import MultiKueueCluster
+    from kueue_tpu.controllers import ClusterRuntime
+    from kueue_tpu.federation import FederationDispatcher
+    from kueue_tpu.models import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        ResourceFlavor,
+        Workload,
+    )
+    from kueue_tpu.models.cluster_queue import ResourceGroup
+    from kueue_tpu.models.workload import PodSet
+    from kueue_tpu.utils.clock import FakeClock
+
+    clock = FakeClock(0.0)
+
+    def build_worker():
+        rt = ClusterRuntime(clock=clock, use_solver=False)
+        rt.add_flavor(ResourceFlavor(name="default"))
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name="cq",
+                namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",),
+                        (
+                            FlavorQuotas.build(
+                                "default", {"cpu": str(worker_cpu)}
+                            ),
+                        ),
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(
+            LocalQueue(namespace="ns", name="lq", cluster_queue="cq")
+        )
+        return rt
+
+    # every drain is preceded by a join, so the backlog always fits
+    # the constant-size roster of survivors
+    assert n_wl <= n_workers * worker_cpu, "drain must fit survivors"
+    planes = {f"cw{i}": build_worker() for i in range(n_workers)}
+    manager = ClusterRuntime(clock=clock)
+    dispatcher = FederationDispatcher(
+        manager,
+        clusters={
+            name: MultiKueueCluster(name=name, runtime=rt)
+            for name, rt in planes.items()
+        },
+        drive_inprocess=True,
+    )
+    for i in range(n_wl):
+        manager.add_workload(
+            Workload(
+                namespace="ns",
+                name=f"churn-{i:04d}",
+                queue_name="lq",
+                priority=int(rng.integers(0, 5)),
+                pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+            )
+        )
+
+    def admitted_keys():
+        return {
+            key
+            for key, wl in manager.workloads.items()
+            if wl.is_admitted
+        }
+
+    def settle(want=n_wl):
+        for _ in range(80):
+            manager.run_until_idle()
+            clock.advance(1.0)
+            if len(admitted_keys()) == want:
+                return
+        raise AssertionError(
+            f"only {len(admitted_keys())}/{want} admitted after churn"
+        )
+
+    settle()
+    joins = drains = 0
+    next_id = n_workers
+    readmit_ms = []
+    victims = sorted(planes)[:churn_rounds]
+    for victim in victims:
+        # scale-up join first so the drain always has headroom to land on
+        name = f"cw{next_id}"
+        next_id += 1
+        rt = build_worker()
+        planes[name] = rt
+        dispatcher.add_worker(MultiKueueCluster(name=name, runtime=rt))
+        joins += 1
+        # drain-ahead scale-down of a loaded worker
+        deposed_keys = {
+            key
+            for key, st in dispatcher.states.items()
+            if st.winner == victim and not st.finished
+        }
+        t0 = time.perf_counter()
+        dispatcher.drain_worker(victim)
+        drains += 1
+        outstanding = set(deposed_keys)
+        for _ in range(80):
+            if not outstanding:
+                break
+            manager.run_until_idle()
+            clock.advance(1.0)
+            landed = {k for k in outstanding if manager.workloads[k].is_admitted}
+            if landed:
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                readmit_ms.extend(dt_ms for _ in landed)
+                outstanding -= landed
+        assert not outstanding, (
+            f"{len(outstanding)} placements never readmitted after "
+            f"draining {victim}"
+        )
+        assert dispatcher.remove_worker(victim)
+        removed = planes.pop(victim)
+        settle()
+        # the removed plane holds no live copy of anything readmitted
+        still_held = deposed_keys & set(removed.workloads)
+        live = {k for k in still_held if not removed.workloads[k].is_finished}
+        assert not live, f"{victim} still holds {sorted(live)[:5]}"
+        # exactly one surviving copy per placement
+        for key in admitted_keys():
+            holders = [
+                n for n, rt in planes.items() if key in rt.workloads
+            ]
+            assert len(holders) == 1, f"{key} held by {holders}"
+        for name, rt in planes.items():
+            violations = rt.check_invariants()
+            assert not violations, f"worker {name}: {violations}"
+    assert len(admitted_keys()) == n_wl
+    readmit_ms.sort()
+    p95 = (
+        readmit_ms[min(len(readmit_ms) - 1, int(0.95 * len(readmit_ms)))]
+        if readmit_ms
+        else 0.0
+    )
+    return joins, drains, p95, len(readmit_ms), len(admitted_keys())
+
+
 def trace_bench(rng):
     """Always-on tracing overhead at the 50k north-star scale: the
     IDENTICAL seeded backlog drained to quiescence through
@@ -2922,6 +3078,28 @@ def _stage_federation() -> dict:
     }
 
 
+def _stage_federation_churn() -> dict:
+    joins, drains, p95_ms, n_readmit, admitted = federation_churn_bench(
+        np.random.default_rng(18)
+    )
+    return {
+        "federation_churn_metric": (
+            "federation_membership_churn_readmit_latency (live "
+            "federation under a 90-deep backlog; per round one worker "
+            "joins at runtime and one loaded worker is drain-ahead "
+            f"removed: {joins} joins / {drains} drains, {n_readmit} "
+            f"deposed placements readmitted on survivors, {admitted} "
+            "admitted exactly once throughout, per-plane invariants "
+            "clean every round)"
+        ),
+        "federation_churn_value": round(p95_ms, 3),
+        "federation_churn_unit": "ms (drain-to-readmit p95)",
+        "federation_churn_joins": joins,
+        "federation_churn_drains": drains,
+        "federation_churn_readmit_p95_ms": round(p95_ms, 3),
+    }
+
+
 def sharded_drain_bench():
     """1-device vs mesh A/B on the 50k plain drain: the same backlog
     (headline seed) solved through ``run_drain`` single-device and
@@ -3056,6 +3234,7 @@ STAGES = {
     "journal": _stage_journal,
     "failover": _stage_failover,
     "federation": _stage_federation,
+    "federation_churn": _stage_federation_churn,
     "serve": _stage_serve,
     "trace": _stage_trace,
     "policy": _stage_policy,
@@ -3078,6 +3257,7 @@ HEADLINE_FALLBACK_STAGES = (
     "pipeline",
     "megaloop",
     "federation",
+    "federation_churn",
     "sharded",
     "serve",
     "trace",
@@ -3093,6 +3273,9 @@ COMPACT_EXTRAS = (
     ("federation_dispatches_per_s", "dispatches_per_s"),
     ("federation_rescore_ms", "rescore_ms"),
     ("federation_rebalances", "rebalances"),
+    ("federation_churn_joins", "joins"),
+    ("federation_churn_drains", "drains"),
+    ("federation_churn_readmit_p95_ms", "readmit_p95_ms"),
     ("pipeline_speedup_vs_serial", "pipeline_speedup"),
     ("megaloop_speedup_vs_serial", "megaloop_speedup"),
     ("megaloop_dispatches_per_drain", "dispatches_per_drain"),
@@ -3117,6 +3300,7 @@ SINGLE_STAGE_MODES = {
     "--megaloop": ["megaloop"],
     "--sharded": ["sharded"],
     "--federation": ["federation"],
+    "--churn": ["federation_churn"],
     "--serve": ["serve"],
     "--trace": ["trace"],
     "--policy": ["policy"],
@@ -3368,14 +3552,19 @@ if __name__ == "__main__":
         for flag, stages in SINGLE_STAGE_MODES.items():
             if flag in sys.argv:
                 if flag == "--federation":
-                    # `--federation N` sizes the fan-out scale capture
-                    # (worker count); propagated to the payload
-                    # subprocess through the environment
-                    i = sys.argv.index(flag)
-                    if i + 1 < len(sys.argv) and sys.argv[i + 1].isdigit():
-                        os.environ["KUEUE_BENCH_FED_WORKERS"] = (
-                            sys.argv[i + 1]
-                        )
+                    if "--churn" in sys.argv:
+                        # `--federation --churn`: run the membership-
+                        # churn stage instead of the steady-roster one
+                        stages = ["federation_churn"]
+                    else:
+                        # `--federation N` sizes the fan-out scale
+                        # capture (worker count); propagated to the
+                        # payload subprocess through the environment
+                        i = sys.argv.index(flag)
+                        if i + 1 < len(sys.argv) and sys.argv[i + 1].isdigit():
+                            os.environ["KUEUE_BENCH_FED_WORKERS"] = (
+                                sys.argv[i + 1]
+                            )
                 driver_main(stages)
                 break
         else:

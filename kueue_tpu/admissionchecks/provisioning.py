@@ -88,6 +88,12 @@ class ProvisioningController:
         # (workload, check) -> retry bookkeeping
         self._attempts: Dict[Tuple[str, str], int] = {}
         self._retry_after: Dict[Tuple[str, str], float] = {}
+        # when True (the elastic plane sets it), a check referencing a
+        # config name nobody registered resolves to a synthesized
+        # all-defaults config instead of silently never producing PRs —
+        # the server has no ProvisioningRequestConfig ingest surface, so
+        # without this `--elastic on` could never close the loop
+        self.default_configs = False
 
     def add_config(self, cfg: ProvisioningRequestConfig) -> None:
         self.configs[cfg.name] = cfg
@@ -105,7 +111,12 @@ class ProvisioningController:
         ac = self.runtime.cache.admission_checks.get(check_name)
         if ac is None:
             return None
-        return self.configs.get(ac.parameters or "")
+        name = ac.parameters or ""
+        cfg = self.configs.get(name)
+        if cfg is None and self.default_configs:
+            cfg = ProvisioningRequestConfig(name=name)
+            self.configs[name] = cfg
+        return cfg
 
     @staticmethod
     def pr_name(wl: Workload, check: str, attempt: int) -> str:
@@ -178,30 +189,63 @@ class ProvisioningController:
                 )
                 self.requests[pr_key] = pr
                 self.runtime.event("ProvisioningRequestCreated", wl, pr_key)
+                self.runtime.metrics.provisioning_requests_total.inc(
+                    state="created"
+                )
 
             self._sync_check_state(wl, state, pr, cfg, attempt, key, now)
 
     def _sync_check_state(self, wl, state: AdmissionCheckState, pr, cfg, attempt, key, now):
+        m = self.runtime.metrics
         retries_left = attempt <= cfg.retry_strategy.backoff_limit_count
         if pr.state == PR_FAILED or (
             pr.state == PR_BOOKING_EXPIRED and not wl.is_admitted
         ):
+            if pr.state == PR_BOOKING_EXPIRED:
+                m.provisioning_requests_total.inc(state="booking_expired")
             if retries_left:
+                backoff = cfg.retry_strategy.backoff(attempt)
                 state.state = AdmissionCheckStateType.PENDING
                 state.message = f"Retrying after failure: {pr.message}"
                 self._attempts[key] = attempt + 1
-                self._retry_after[key] = now + cfg.retry_strategy.backoff(attempt)
-            else:
+                self._retry_after[key] = now + backoff
+                m.provisioning_retries_total.inc()
+                m.provisioning_backoff_seconds.observe(backoff)
+                self.runtime.event(
+                    "ProvisioningFailed", wl,
+                    f"{pr.name}: {pr.message or pr.state}; retrying in "
+                    f"{backoff:g}s (attempt {attempt}/"
+                    f"{cfg.retry_strategy.backoff_limit_count})",
+                )
+            elif state.state != AdmissionCheckStateType.REJECTED:
                 state.state = AdmissionCheckStateType.REJECTED
                 state.message = pr.message or "provisioning failed"
+                m.provisioning_requests_total.inc(state="exhausted")
+                self.runtime.event(
+                    "ProvisioningFailed", wl,
+                    f"{pr.name}: retry budget exhausted "
+                    f"({cfg.retry_strategy.backoff_limit_count} retries)",
+                )
         elif pr.state == PR_CAPACITY_REVOKED:
             # capacity lost after provisioning: evict + requeue (Retry)
+            if state.state != AdmissionCheckStateType.RETRY:
+                self.runtime.event(
+                    "CapacityRevoked", wl,
+                    f"{pr.name}: {pr.message or 'Capacity was revoked'}",
+                )
             state.state = AdmissionCheckStateType.RETRY
             state.message = pr.message or "Capacity was revoked"
         elif pr.state == PR_PROVISIONED:
             if state.state != AdmissionCheckStateType.READY:
+                # the PR is Provisioned (and any elastic grant already
+                # durable) but the check flip below has not happened —
+                # the torn window the chaos suite sweeps
+                from kueue_tpu.testing import faults
+
+                faults.fire("provisioning.mid_flip")
                 state.state = AdmissionCheckStateType.READY
                 state.message = pr.message or "Provisioned"
+                self.runtime.event("Provisioned", wl, pr.name)
                 state.pod_set_updates = {
                     ps_name: {
                         "annotations": {

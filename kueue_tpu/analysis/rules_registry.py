@@ -308,8 +308,8 @@ class MetricsFamiliesRule(Rule):
         "exposition lint; the runtime grammar/histogram invariants "
         "stay in tests/test_observability.py); families under the "
         "exposed-at-zero prefixes (kueue_gateway_*, kueue_slo_*, "
-        "kueue_global_*) must be materialized at zero in their "
-        "defining module"
+        "kueue_global_*, kueue_provisioning_*, kueue_elastic_*) must "
+        "be materialized at zero in their defining module"
     )
 
     _FAMILY_GRAMMAR = re.compile(r"^[a-z][a-z0-9_]*$")
@@ -318,7 +318,13 @@ class MetricsFamiliesRule(Rule):
     # and burn-rate alerts must see the whole family at zero before the
     # first request/admission, so their defining module must call
     # inc/set/touch on each one (the materialize-at-zero idiom)
-    _ZERO_PREFIXES = ("kueue_gateway_", "kueue_slo_", "kueue_global_")
+    _ZERO_PREFIXES = (
+        "kueue_gateway_",
+        "kueue_slo_",
+        "kueue_global_",
+        "kueue_provisioning_",
+        "kueue_elastic_",
+    )
     _ZERO_CALLS = {"inc", "set", "touch"}
 
     def _resolve_name(

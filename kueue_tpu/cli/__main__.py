@@ -751,31 +751,73 @@ def cmd_trace(state: State, args) -> None:
 
 
 def cmd_clusters(state: State, args) -> None:
-    """`kueuectl clusters list` — the federation worker-cluster roster:
-    connectivity, quarantine state, dispatch/win counters. Reads a live
-    federation manager (--server)."""
+    """`kueuectl clusters list|add|cordon|uncordon|drain|remove` — the
+    federation worker-cluster roster and dynamic membership: list shows
+    connectivity/quarantine/cordon state, add joins a worker at
+    runtime, cordon stops new dispatches, drain moves every placement
+    off under the fencing protocol, remove drains then drops the
+    worker. Reads/mutates a live federation manager (--server)."""
     if not getattr(args, "server", None):
         raise SystemExit(
-            "error: `kueuectl clusters list` reads a live federation "
-            "manager; pass --server http://<manager>"
+            f"error: `kueuectl clusters {args.action}` needs a live "
+            "federation manager; pass --server http://<manager>"
         )
     from kueue_tpu.server.client import ClientError
 
     client = _server_client(args)
     try:
-        items = client.federation_clusters().get("items", [])
+        if args.action == "list":
+            items = client.federation_clusters().get("items", [])
+        else:
+            if not args.name:
+                raise SystemExit(
+                    f"error: `kueuectl clusters {args.action}` needs a "
+                    "worker cluster NAME"
+                )
+            if args.action == "add":
+                if not args.url:
+                    raise SystemExit(
+                        "error: `kueuectl clusters add NAME --url URL` "
+                        "— the worker control plane's URL is required"
+                    )
+                out = client.federation_add_worker(
+                    args.name, args.url, token=args.worker_token
+                )
+                print(f"joined worker cluster {out.get('joined', args.name)}")
+                return
+            if args.action == "cordon":
+                client.federation_cordon(args.name)
+                print(
+                    f"worker cluster {args.name} cordoned "
+                    "(no new dispatches; existing placements stay)"
+                )
+                return
+            if args.action == "uncordon":
+                client.federation_uncordon(args.name)
+                print(f"worker cluster {args.name} uncordoned")
+                return
+            if args.action == "drain":
+                out = client.federation_drain(args.name)
+                print(
+                    f"worker cluster {args.name} drained: "
+                    f"{out.get('deposed', 0)} placement(s) deposed and "
+                    "re-dispatching onto surviving capacity"
+                )
+                return
+            out = client.federation_remove_worker(args.name)
+            print(f"worker cluster {out.get('removed', args.name)} removed")
+            return
     except ClientError as e:
         if e.status == 404:
-            raise SystemExit(
-                "error: federation is not enabled on this server "
-                "(start it with --federation-worker NAME=URL)"
-            )
+            raise SystemExit(f"error: {e}")
         raise
     rows = []
     for c in items:
         status = "Active" if c.get("active") else "Lost"
         if c.get("quarantinedUntil") is not None:
             status = "Quarantined"
+        if c.get("cordoned"):
+            status += ",Cordoned"
         rows.append(
             [
                 c.get("name", ""),
@@ -794,6 +836,56 @@ def cmd_clusters(state: State, args) -> None:
         ["NAME", "STATUS", "WINS", "DISPATCHES", "STRIKES", "LOST-SINCE"],
         rows,
     )
+
+
+def cmd_capacity(state: State, args) -> None:
+    """`kueuectl capacity` — elastic capacity plane standings: what the
+    provider has granted per flavor/resource, the journaled grant
+    requests, in-flight asks, and the last chooser decision."""
+    if not getattr(args, "server", None):
+        raise SystemExit(
+            "error: `kueuectl capacity` reads a live control plane; "
+            "pass --server http://<leader>"
+        )
+    from kueue_tpu.server.client import ClientError
+
+    client = _server_client(args)
+    try:
+        out = client.capacity()
+    except ClientError as e:
+        if e.status == 404:
+            raise SystemExit(
+                "error: the elastic capacity plane is not enabled on "
+                "this server (start it with --elastic on)"
+            )
+        raise
+    granted = out.get("granted") or {}
+    rows = [
+        [flavor, resource, str(amount)]
+        for flavor in sorted(granted)
+        for resource, amount in sorted(granted[flavor].items())
+    ]
+    _print_table(["FLAVOR", "RESOURCE", "GRANTED"], rows or [["-", "-", "0"]])
+    print(
+        f"provider: {out.get('provider', '?')}  "
+        f"applied grants: {len(out.get('appliedRequests') or [])}  "
+        f"in-flight: {len(out.get('inFlight') or [])}  "
+        f"chooser launches: {out.get('chooserLaunches', 0)}"
+    )
+    last = out.get("lastChoice")
+    if last:
+        scores = ", ".join(
+            f"{name}={score}"
+            for name, score in sorted(
+                (last.get("scores") or {}).items()
+            )
+        )
+        print(
+            f"last chooser pass ({last.get('backend', '?')}, "
+            f"{last.get('launches', 0)} launch(es)): "
+            f"chose {last.get('chosen', '?')}"
+            + (f" [{scores}]" if scores else "")
+        )
 
 
 def cmd_replicas(state: State, args) -> None:
@@ -1624,11 +1716,37 @@ def build_parser() -> argparse.ArgumentParser:
     cl = sub.add_parser(
         "clusters",
         help="MultiKueue federation: worker-cluster roster "
-        "(connectivity, quarantine, dispatch/win counters)",
+        "(connectivity, quarantine, cordon state) and dynamic "
+        "membership (add / cordon / uncordon / drain / remove)",
     )
-    cl.add_argument("action", choices=["list"])
+    cl.add_argument(
+        "action",
+        choices=["list", "add", "cordon", "uncordon", "drain", "remove"],
+    )
+    cl.add_argument(
+        "name", nargs="?", default="",
+        help="worker cluster name (every action except list)",
+    )
+    cl.add_argument(
+        "--url", default="",
+        help="worker control plane URL (clusters add)",
+    )
+    cl.add_argument(
+        "--worker-token", default=None,
+        help="bearer token the manager presents to the new worker "
+        "(clusters add)",
+    )
     _add_server_flags(cl, "federation manager to query (required)")
     cl.set_defaults(fn=cmd_clusters)
+
+    cap = sub.add_parser(
+        "capacity",
+        help="elastic capacity plane: provider grants per "
+        "flavor/resource, journaled grant requests, in-flight asks "
+        "and the last chooser decision",
+    )
+    _add_server_flags(cap, "control plane to query (required)")
+    cap.set_defaults(fn=cmd_capacity)
 
     repl = sub.add_parser(
         "replicas",

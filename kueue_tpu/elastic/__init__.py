@@ -1,0 +1,35 @@
+"""Elastic capacity plane: provisioning-driven flavor scale-up.
+
+``CapacityProvider`` / ``SimulatedProvider`` (elastic/provider.py) are
+the autoscaler half of the ProvisioningRequest protocol;
+``ElasticCapacityPlane`` (elastic/plane.py) closes the loop — batched
+scale-up choice through the planner's vmapped scenario sweep, journaled
+``elastic_grant``/``elastic_revoke`` quota mutations, crash-safe grant
+adoption after recovery.
+"""
+
+from kueue_tpu.elastic.plane import (
+    ELASTIC_GRANT,
+    ELASTIC_REVOKE,
+    ElasticCapacityPlane,
+    ScaleCandidate,
+    apply_capacity_record,
+    attach_elastic_plane,
+)
+from kueue_tpu.elastic.provider import (
+    CapacityProvider,
+    ProviderEvent,
+    SimulatedProvider,
+)
+
+__all__ = [
+    "ELASTIC_GRANT",
+    "ELASTIC_REVOKE",
+    "CapacityProvider",
+    "ElasticCapacityPlane",
+    "ProviderEvent",
+    "ScaleCandidate",
+    "SimulatedProvider",
+    "apply_capacity_record",
+    "attach_elastic_plane",
+]

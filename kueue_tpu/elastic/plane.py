@@ -1,0 +1,471 @@
+"""Elastic capacity plane: closes the ProvisioningRequest loop.
+
+The two-phase admission bridge (admissionchecks/provisioning.py) stops
+at "a ProvisioningRequest exists"; this plane supplies the other half:
+
+1. **Choose** — pending PRs compete for the next scale-up. Each one
+   becomes a "scale flavor f by its ask" ``FlavorCapacityDelta``
+   scenario, and ONE batched ``plan_kernel`` launch (the PR-3/PR-12
+   vmapped sweep via ``Planner.plan``) scores every candidate by
+   blocked-work admitted; the argmax is submitted to the
+   ``CapacityProvider``.
+2. **Grant** — when the provider reports Provisioned, a journaled
+   ``elastic_grant`` mutates real flavor quota (post-state nominal
+   values, so crash replay converges) and the PR flips Provisioned,
+   which lets the check controller flip the check Ready.
+3. **Revoke** — BookingExpired before admission / CapacityRevoked emit
+   ``elastic_revoke`` and withdraw the quota.
+
+Both record kinds are replayed by storage/recovery.apply_record and by
+journal-tailing replicas through the same helper
+(``apply_capacity_record``), and grants already durable in the journal
+are ADOPTED on rebuild (``runtime.elastic_applied_requests``): a crash
+between the grant append and the check flip recovers to the grant
+applied exactly once, never re-asked from the provider.
+
+The plane registers as an admission-check controller hook: the
+per-workload call is a no-op, and ``flush()`` (invoked once per
+reconcile pass) advances choose/grant/revoke.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from kueue_tpu.admissionchecks.provisioning import (
+    PR_ACCEPTED,
+    PR_BOOKING_EXPIRED,
+    PR_CAPACITY_REVOKED,
+    PR_FAILED,
+    PR_PENDING,
+    PR_PROVISIONED,
+    ProvisioningController,
+)
+from kueue_tpu.elastic import provider as prov
+from kueue_tpu.elastic.provider import CapacityProvider, SimulatedProvider
+
+# journal record kinds (mirrored in storage/recovery.py) — post-state
+# flavor-quota mutations owned by this plane
+ELASTIC_GRANT = "elastic_grant"
+ELASTIC_REVOKE = "elastic_revoke"
+
+
+def apply_capacity_record(rt, rtype: str, data: dict) -> None:
+    """Apply one elastic_grant/elastic_revoke record to a runtime.
+
+    Shared by the live plane, crash recovery and tailing replicas: the
+    record carries POST-state nominal values per (flavor, resource), so
+    re-applying after a crash between append and apply converges. Also
+    maintains ``rt.elastic_applied_requests`` (request -> record data),
+    the durable-grant set a rebuilt plane adopts so recovery never
+    re-asks the provider for capacity it already holds.
+    """
+    applied = getattr(rt, "elastic_applied_requests", None)
+    if applied is None:
+        applied = {}
+        rt.elastic_applied_requests = applied
+    cq_name = data.get("clusterQueue", "")
+    cached = rt.cache.cluster_queues.get(cq_name)
+    if cached is not None:
+        model = cached.model
+        for flavor, spec in (data.get("grants") or {}).items():
+            post = spec.get("nominal") or {}
+            for rg in model.resource_groups:
+                for fq in rg.flavors:
+                    if fq.name != flavor:
+                        continue
+                    for resource, value in post.items():
+                        q = fq.resources.get(resource)
+                        if q is not None:
+                            q.nominal = max(0, int(value))
+        # in-place model upsert: generation bump invalidates encodings,
+        # usage/reservations survive untouched
+        rt.cache.add_or_update_cluster_queue(model)
+        # capacity changed: parked heads of this CQ get another look
+        rt.queues.queue_inadmissible_workloads({cq_name})
+    request = data.get("request", "")
+    if rtype == ELASTIC_GRANT:
+        applied[request] = dict(data)
+    else:
+        applied.pop(request, None)
+
+
+@dataclass
+class ScaleCandidate:
+    """One pending PR's ask, shaped as a planner scenario."""
+
+    request: str
+    workload_key: str
+    cluster_queue: str
+    # flavor -> resource -> canonical amount
+    asks: Dict[str, Dict[str, int]]
+    scenario: object  # PlanScenario
+
+
+class ElasticCapacityPlane:
+    """Provisioning-driven flavor scale-up + journaled capacity grants.
+
+    ``use_device``: chooser backend for the batched scenario sweep (the
+    host mirror is the bit-for-bit oracle the acceptance test compares
+    against).
+    """
+
+    def __init__(
+        self,
+        runtime,
+        controller: ProvisioningController,
+        provider: CapacityProvider,
+        use_device: bool = True,
+    ):
+        self.runtime = runtime
+        self.controller = controller
+        self.provider = provider
+        self.use_device = use_device
+        # requests handed to the provider and not yet resolved
+        self._submitted: set = set()
+        self.last_choice: Optional[dict] = None
+        self.chooser_launches = 0
+        # adopt grants already durable in the journal (recovery replay
+        # ran before the plane existed); share the dict so live applies
+        # keep it current
+        applied = getattr(runtime, "elastic_applied_requests", None)
+        if applied is None:
+            applied = {}
+            runtime.elastic_applied_requests = applied
+        self._applied: Dict[str, dict] = applied
+
+    # ---- admission-check controller protocol ----
+    def __call__(self, wl) -> None:
+        """Per-workload hook: nothing to do (the check controller owns
+        check states); the plane works at flush granularity."""
+
+    def flush(self) -> None:
+        self.step()
+
+    # ---- the reconcile step ----
+    def step(self) -> None:
+        now = self.runtime.clock.now()
+        self._adopt_recovered()
+        self._submit_next(now)
+        self._drain_provider(now)
+        self._reap_revocations()
+        self._update_gauges()
+
+    def _adopt_recovered(self) -> None:
+        """A PR whose grant is durable (journal replay) but whose
+        in-memory state was rebuilt Pending: flip it Provisioned
+        directly — the capacity is already applied, the provider must
+        not be asked again."""
+        for pr in self.controller.requests.values():
+            if pr.name in self._applied and pr.state not in (
+                PR_PROVISIONED, PR_BOOKING_EXPIRED, PR_CAPACITY_REVOKED,
+            ):
+                pr.state = PR_PROVISIONED
+                pr.message = "recovered durable elastic grant"
+
+    # ---- choose ----
+    def pending_candidates(self) -> List[ScaleCandidate]:
+        from kueue_tpu.planner.scenarios import (
+            FlavorCapacityDelta,
+            PlanScenario,
+        )
+
+        out: List[ScaleCandidate] = []
+        for name in sorted(self.controller.requests):
+            pr = self.controller.requests[name]
+            if pr.state != PR_PENDING:
+                continue
+            if pr.name in self._submitted or pr.name in self._applied:
+                continue
+            wl = self.runtime.workloads.get(pr.workload_key)
+            if wl is None or wl.admission is None:
+                continue
+            cq = wl.admission.cluster_queue
+            managed = {ps_name for ps_name, _count in pr.pod_sets}
+            asks: Dict[str, Dict[str, int]] = {}
+            for psa in wl.admission.pod_set_assignments:
+                if psa.name not in managed:
+                    continue
+                for resource, flavor in psa.flavors.items():
+                    amount = int(psa.resource_usage.get(resource, 0))
+                    if amount <= 0:
+                        continue
+                    slot = asks.setdefault(flavor, {})
+                    slot[resource] = slot.get(resource, 0) + amount
+            if not asks:
+                continue
+            deltas = tuple(
+                FlavorCapacityDelta.build(cq, flavor, dict(resources))
+                for flavor, resources in sorted(asks.items())
+            )
+            out.append(
+                ScaleCandidate(
+                    request=pr.name,
+                    workload_key=pr.workload_key,
+                    cluster_queue=cq,
+                    asks=asks,
+                    scenario=PlanScenario(name=pr.name, deltas=deltas),
+                )
+            )
+        return out
+
+    def choose(
+        self,
+        candidates: List[ScaleCandidate],
+        use_device: Optional[bool] = None,
+    ):
+        """Score every candidate scale-up in ONE batched plan launch
+        (blocked-work admitted, from the vmapped scenario sweep) and
+        return (winner, PlanReport). Deterministic tiebreak: score
+        desc, delta cost asc, request name asc — identical on the host
+        mirror, which is the acceptance oracle."""
+        from kueue_tpu.planner.engine import Planner
+
+        planner = Planner.for_runtime(self.runtime)
+        report = planner.plan(
+            scenarios=[c.scenario for c in candidates],
+            use_device=self.use_device if use_device is None else use_device,
+        )
+        scores = {
+            o.name: len(o.newly_admitted)
+            for o in report.scenarios
+            if not o.baseline
+        }
+        winner = min(
+            candidates,
+            key=lambda c: (
+                -scores.get(c.request, 0), c.scenario.cost(), c.request,
+            ),
+        )
+        self.chooser_launches += 1
+        m = self.runtime.metrics
+        m.elastic_chooser_launches_total.inc()
+        m.elastic_chooser_seconds.observe(report.duration_s)
+        self.last_choice = {
+            "chosen": winner.request,
+            "backend": report.backend,
+            "launches": report.launches,
+            "scores": {c.request: scores.get(c.request, 0) for c in candidates},
+        }
+        return winner, report
+
+    def _submit_next(self, now: float) -> None:
+        candidates = self.pending_candidates()
+        if not candidates:
+            return
+        if len(candidates) == 1:
+            # argmax over one candidate needs no launch
+            winner = candidates[0]
+        else:
+            winner, _report = self.choose(candidates)
+        self._submitted.add(winner.request)
+        self.provider.submit(winner.request, winner.asks, now=now)
+        self.runtime.metrics.provisioning_requests_total.inc(state="submitted")
+
+    # ---- grant / revoke ----
+    def _drain_provider(self, now: float) -> None:
+        m = self.runtime.metrics
+        for ev in self.provider.poll(now):
+            pr = self.controller.requests.get(ev.request)
+            if ev.state == prov.ACCEPTED:
+                if pr is not None and pr.state == PR_PENDING:
+                    pr.state = PR_ACCEPTED
+                    pr.message = ev.message
+            elif ev.state == prov.PROVISIONED:
+                self._grant(pr, ev, now)
+            elif ev.state == prov.FAILED:
+                self._submitted.discard(ev.request)
+                if pr is not None and pr.state != PR_PROVISIONED:
+                    pr.state = PR_FAILED
+                    pr.message = ev.message
+                    m.provisioning_requests_total.inc(state="failed")
+                    wl = self.runtime.workloads.get(pr.workload_key)
+                    if wl is not None:
+                        self.runtime.event(
+                            "ProvisioningFailed", wl,
+                            f"{ev.request}: {ev.message}",
+                        )
+            elif ev.state == prov.CAPACITY_REVOKED:
+                self._revoke(ev.request, ev.grant, ev.message)
+
+    def _grant(self, pr, ev, now: float) -> None:
+        from kueue_tpu.testing import faults
+
+        self._submitted.discard(ev.request)
+        if pr is None:
+            # the workload lost its reservation while the provider was
+            # standing capacity up: hand it straight back
+            self.provider.revoke(ev.request, "request no longer exists")
+            return
+        if pr.name in self._applied:
+            pr.state = PR_PROVISIONED  # replayed grant, already durable
+            return
+        rt = self.runtime
+        wl = rt.workloads.get(pr.workload_key)
+        if wl is None or wl.admission is None:
+            self.provider.revoke(ev.request, "workload no longer reserved")
+            return
+        cq_name = wl.admission.cluster_queue
+        grants: Dict[str, dict] = {}
+        for flavor, resources in sorted(ev.grant.items()):
+            post = {}
+            for resource, amount in sorted(resources.items()):
+                post[resource] = self._current_nominal(
+                    cq_name, flavor, resource
+                ) + int(amount)
+            grants[flavor] = {"granted": dict(resources), "nominal": post}
+        data = {
+            "clusterQueue": cq_name,
+            "request": pr.name,
+            "workload": pr.workload_key,
+            "grants": grants,
+        }
+        rt._journal_append(ELASTIC_GRANT, data)
+        # record durable, quota mutation + parked-head requeue not yet
+        # applied — the torn window the chaos suite sweeps
+        faults.fire("elastic.grant_mid_apply")
+        apply_capacity_record(rt, ELASTIC_GRANT, data)
+        pr.state = PR_PROVISIONED
+        pr.message = ev.message or "Provisioned"
+        m = rt.metrics
+        m.elastic_grants_total.inc()
+        m.provisioning_requests_total.inc(state="provisioned")
+        rt.event(
+            "ElasticCapacityGranted", wl,
+            f"{pr.name}: " + "; ".join(
+                f"{flavor} +" + ",".join(
+                    f"{r}:{a}" for r, a in sorted(spec["granted"].items())
+                )
+                for flavor, spec in sorted(grants.items())
+            ),
+        )
+
+    def _revoke(self, request: str, grant: Dict[str, Dict[str, int]],
+                message: str) -> None:
+        rt = self.runtime
+        self._submitted.discard(request)
+        applied = self._applied.get(request)
+        pr = self.controller.requests.get(request)
+        if applied is None:
+            # capacity never landed in quota; just surface the failure
+            if pr is not None and pr.state == PR_PROVISIONED:
+                pr.state = PR_CAPACITY_REVOKED
+                pr.message = message
+            return
+        cq_name = applied.get("clusterQueue", "")
+        grants: Dict[str, dict] = {}
+        for flavor, spec in sorted(applied.get("grants", {}).items()):
+            granted = spec.get("granted", {})
+            post = {}
+            for resource, amount in sorted(granted.items()):
+                post[resource] = max(
+                    0,
+                    self._current_nominal(cq_name, flavor, resource)
+                    - int(amount),
+                )
+            grants[flavor] = {"granted": dict(granted), "nominal": post}
+        data = {
+            "clusterQueue": cq_name,
+            "request": request,
+            "workload": applied.get("workload", ""),
+            "grants": grants,
+        }
+        rt._journal_append(ELASTIC_REVOKE, data)
+        apply_capacity_record(rt, ELASTIC_REVOKE, data)
+        if pr is not None and pr.state not in (
+            PR_BOOKING_EXPIRED, PR_CAPACITY_REVOKED,
+        ):
+            pr.state = PR_CAPACITY_REVOKED
+            pr.message = message or "Capacity was revoked"
+        m = rt.metrics
+        m.elastic_revokes_total.inc()
+        m.provisioning_requests_total.inc(state="capacity_revoked")
+        wl = rt.workloads.get(data["workload"])
+        if wl is not None:
+            rt.event("CapacityRevoked", wl, f"{request}: {message}")
+
+    def _reap_revocations(self) -> None:
+        """A PR the controller (or a test bridge) flipped to
+        BookingExpired/CapacityRevoked while its grant is applied:
+        withdraw the quota. Booking expiry AFTER admission keeps the
+        capacity — it has been consumed (controller.go:598-614)."""
+        for name in sorted(self._applied):
+            pr = self.controller.requests.get(name)
+            if pr is None:
+                continue
+            if pr.state == PR_CAPACITY_REVOKED or (
+                pr.state == PR_BOOKING_EXPIRED
+                and not self._workload_admitted(pr.workload_key)
+            ):
+                # free the provider-side booking too (idempotent); the
+                # quota withdrawal happens inline, not via the provider
+                # event, so a dead provider cannot wedge it
+                self.provider.revoke(name, pr.message or "booking expired")
+                self._revoke(
+                    name, {}, pr.message or "booking expired before admission"
+                )
+
+    def _workload_admitted(self, key: str) -> bool:
+        wl = self.runtime.workloads.get(key)
+        return bool(wl is not None and wl.is_admitted)
+
+    def _current_nominal(self, cq_name: str, flavor: str, resource: str) -> int:
+        cached = self.runtime.cache.cluster_queues.get(cq_name)
+        if cached is None:
+            return 0
+        for rg in cached.model.resource_groups:
+            for fq in rg.flavors:
+                if fq.name == flavor:
+                    q = fq.resources.get(resource)
+                    if q is not None:
+                        return int(q.nominal)
+        return 0
+
+    # ---- surfaces ----
+    def _update_gauges(self) -> None:
+        m = self.runtime.metrics
+        for flavor, resources in self.provider.granted_totals().items():
+            for resource, amount in resources.items():
+                m.elastic_granted_resources.set(
+                    amount, flavor=flavor, resource=resource
+                )
+
+    def status(self) -> dict:
+        return {
+            "enabled": True,
+            "provider": type(self.provider).__name__,
+            "granted": self.provider.granted_totals(),
+            "appliedRequests": sorted(self._applied),
+            "inFlight": sorted(self._submitted),
+            "chooserLaunches": self.chooser_launches,
+            "lastChoice": self.last_choice,
+        }
+
+
+def attach_elastic_plane(
+    rt,
+    provider: Optional[CapacityProvider] = None,
+    use_device: bool = True,
+) -> ElasticCapacityPlane:
+    """Wire the plane into a runtime: reuse (or create) the
+    provisioning check controller, register the plane's reconcile hook
+    and expose it as ``rt.elastic``."""
+    ctrl = None
+    for hook in rt.admission_check_controllers:
+        owner = getattr(hook, "__self__", hook)
+        if isinstance(owner, ProvisioningController):
+            ctrl = owner
+            break
+    if ctrl is None:
+        ctrl = ProvisioningController(rt)
+        rt.admission_check_controllers.append(ctrl.reconcile)
+    # the server has no ProvisioningRequestConfig ingest surface: let
+    # checks referencing unregistered config names resolve to defaults
+    ctrl.default_configs = True
+    if provider is None:
+        provider = SimulatedProvider(clock=rt.clock)
+    plane = ElasticCapacityPlane(rt, ctrl, provider, use_device=use_device)
+    rt.admission_check_controllers.append(plane)
+    rt.elastic = plane
+    return plane

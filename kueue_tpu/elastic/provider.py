@@ -1,0 +1,203 @@
+"""Capacity providers: the autoscaler half of the ProvisioningRequest
+loop.
+
+The check controller (admissionchecks/provisioning.py) faithfully
+reproduces the two-phase protocol but is open-loop — nothing ever flips
+a ProvisioningRequest to Provisioned. A ``CapacityProvider`` closes it:
+the elastic plane (elastic/plane.py) submits capacity asks for pending
+PRs and polls the provider for lifecycle events; on Provisioned the
+plane journals an ``elastic_grant`` that mutates real flavor quota.
+
+``SimulatedProvider`` is the clock-injected test/bench double: a fixed
+provisioning delay between Accepted and Provisioned, per-flavor
+capacity limits (asks beyond the remaining headroom Fail the way a
+cloud quota denial would), and failure injection (``fail_next``)
+driving the check controller's retry ladder. A real bridge would speak
+autoscaling.x-k8s.io instead; the interface is the contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# ProvisioningRequest state analogs the provider reports — mirrors
+# admissionchecks/provisioning.py PR_* (kept literal here so the
+# provider layer does not import the controller layer)
+ACCEPTED = "Accepted"
+PROVISIONED = "Provisioned"
+FAILED = "Failed"
+CAPACITY_REVOKED = "CapacityRevoked"
+
+
+@dataclass
+class ProviderEvent:
+    """One lifecycle transition reported by ``poll()``."""
+
+    request: str  # ProvisioningRequest name
+    state: str  # ACCEPTED | PROVISIONED | FAILED | CAPACITY_REVOKED
+    message: str = ""
+    # flavor -> resource -> canonical amount actually granted (set on
+    # PROVISIONED; the revoke event carries the amounts withdrawn)
+    grant: Dict[str, Dict[str, int]] = field(default_factory=dict)
+
+
+class CapacityProvider:
+    """Pluggable capacity backend. Implementations must be
+    deterministic under an injected clock — chaos suites replay the
+    same trace across crash points and expect identical grants."""
+
+    def submit(
+        self, request: str, asks: Dict[str, Dict[str, int]],
+        now: Optional[float] = None,
+    ) -> None:
+        """Ask for ``asks`` (flavor -> resource -> canonical amount)
+        on behalf of one ProvisioningRequest."""
+        raise NotImplementedError
+
+    def poll(self, now: Optional[float] = None) -> List[ProviderEvent]:
+        """Drain lifecycle events that occurred up to ``now``."""
+        raise NotImplementedError
+
+    def revoke(self, request: str, message: str = "") -> bool:
+        """Withdraw a grant (spot reclaim / booking expiry). Returns
+        False when the request holds no grant."""
+        raise NotImplementedError
+
+    def granted_totals(self) -> Dict[str, Dict[str, int]]:
+        """flavor -> resource -> total currently granted."""
+        raise NotImplementedError
+
+
+@dataclass
+class _Ask:
+    asks: Dict[str, Dict[str, int]]
+    ready_at: float
+
+
+class SimulatedProvider(CapacityProvider):
+    """Deterministic in-process provider.
+
+    ``clock``: injected clock (``.now()``); explicit ``now`` arguments
+    on submit/poll win, so callers without a clock can drive it too.
+    ``provision_delay_s``: Accepted -> Provisioned latency.
+    ``capacity_limits``: flavor -> resource -> max total grantable
+    (missing flavor/resource = unlimited). An ask beyond the remaining
+    headroom fails whole — no partial grants.
+    """
+
+    def __init__(
+        self,
+        clock=None,
+        provision_delay_s: float = 5.0,
+        capacity_limits: Optional[Dict[str, Dict[str, int]]] = None,
+    ):
+        self.clock = clock
+        self.provision_delay_s = float(provision_delay_s)
+        self.capacity_limits = capacity_limits or {}
+        self._pending: Dict[str, _Ask] = {}
+        self._granted: Dict[str, Dict[str, Dict[str, int]]] = {}
+        self._events: List[ProviderEvent] = []
+        self._fail_next = 0
+        self.submissions = 0
+
+    # ---- failure injection ----
+    def fail_next(self, n: int = 1) -> None:
+        """The next ``n`` submissions fail (provider-side outage)."""
+        self._fail_next += n
+
+    # ---- CapacityProvider ----
+    def _now(self, now: Optional[float]) -> float:
+        if now is not None:
+            return float(now)
+        if self.clock is not None:
+            return float(self.clock.now())
+        return 0.0
+
+    def _headroom_ok(self, asks: Dict[str, Dict[str, int]]) -> Optional[str]:
+        for flavor, resources in asks.items():
+            limits = self.capacity_limits.get(flavor)
+            if limits is None:
+                continue
+            for resource, amount in resources.items():
+                if resource not in limits:
+                    continue
+                in_use = sum(
+                    g.get(flavor, {}).get(resource, 0)
+                    for g in self._granted.values()
+                )
+                pend = sum(
+                    a.asks.get(flavor, {}).get(resource, 0)
+                    for a in self._pending.values()
+                )
+                if in_use + pend + amount > limits[resource]:
+                    return (
+                        f"capacity limit reached for {flavor}/{resource} "
+                        f"({in_use + pend}+{amount} > {limits[resource]})"
+                    )
+        return None
+
+    def submit(self, request, asks, now=None) -> None:
+        t = self._now(now)
+        self.submissions += 1
+        if request in self._pending or request in self._granted:
+            return  # idempotent resubmits (post-crash replays)
+        if self._fail_next > 0:
+            self._fail_next -= 1
+            self._events.append(
+                ProviderEvent(request, FAILED, "injected provider failure")
+            )
+            return
+        denial = self._headroom_ok(asks)
+        if denial is not None:
+            self._events.append(ProviderEvent(request, FAILED, denial))
+            return
+        self._pending[request] = _Ask(
+            asks={f: dict(r) for f, r in asks.items()},
+            ready_at=t + self.provision_delay_s,
+        )
+        self._events.append(
+            ProviderEvent(
+                request, ACCEPTED,
+                f"capacity ETA {self.provision_delay_s:g}s",
+            )
+        )
+
+    def poll(self, now=None) -> List[ProviderEvent]:
+        t = self._now(now)
+        for name in sorted(self._pending):
+            ask = self._pending[name]
+            if ask.ready_at <= t:
+                del self._pending[name]
+                self._granted[name] = ask.asks
+                self._events.append(
+                    ProviderEvent(
+                        name, PROVISIONED, "capacity stood up",
+                        grant={f: dict(r) for f, r in ask.asks.items()},
+                    )
+                )
+        out, self._events = self._events, []
+        return out
+
+    def revoke(self, request, message="") -> bool:
+        grant = self._granted.pop(request, None)
+        if grant is None:
+            self._pending.pop(request, None)
+            return False
+        self._events.append(
+            ProviderEvent(
+                request, CAPACITY_REVOKED,
+                message or "capacity reclaimed by the provider",
+                grant=grant,
+            )
+        )
+        return True
+
+    def granted_totals(self) -> Dict[str, Dict[str, int]]:
+        totals: Dict[str, Dict[str, int]] = {}
+        for grant in self._granted.values():
+            for flavor, resources in grant.items():
+                slot = totals.setdefault(flavor, {})
+                for resource, amount in resources.items():
+                    slot[resource] = slot.get(resource, 0) + amount
+        return totals

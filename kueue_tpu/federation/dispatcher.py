@@ -183,6 +183,10 @@ class FederationDispatcher:
         self.states: Dict[str, DispatchState] = {}
         self.retractions: Dict[Tuple[str, str, int], Retraction] = {}
         self.health: Dict[str, ClusterHealth] = {}
+        # dynamic membership (kueue_tpu/elastic): cordoned workers get
+        # no NEW dispatches; drain_worker additionally retracts their
+        # placements ahead of removal (scale-down drain-ahead)
+        self.cordoned: Set[str] = set()
         # the global scheduler (federation/global_scheduler.py) attaches
         # itself here; every step() then runs its interval-gated rescore
         self.global_scheduler = None
@@ -218,6 +222,93 @@ class FederationDispatcher:
             # pre-materialize this cluster's RTT series so the scrape
             # surface is complete before the first exchange
             m.multikueue_remote_rtt_seconds.touch(cluster=cluster.name)
+
+    # ---- dynamic membership (scale-up join / drain-ahead scale-down) ----
+    def _membership_metric(self, kind: str) -> None:
+        m = getattr(self.runtime, "metrics", None)
+        if m is not None:
+            m.elastic_membership_changes_total.inc(kind=kind)
+
+    def add_worker(self, cluster: MultiKueueCluster) -> None:
+        """Runtime join: the worker becomes dispatchable on the next
+        pass (rank-cache fingerprint changes with the cluster set)."""
+        self.add_cluster(cluster)
+        self.cordoned.discard(cluster.name)
+        self._membership_metric("join")
+
+    def cordon(self, name: str) -> bool:
+        """Stop NEW dispatches to ``name``; existing placements stay
+        (kubectl-cordon semantics — use drain_worker to move them)."""
+        if name not in self.clusters:
+            return False
+        if name not in self.cordoned:
+            self.cordoned.add(name)
+            self.runtime.events.record(
+                "ElasticWorkerCordoned", f"cluster/{name}",
+                f"worker cluster {name} cordoned: no new dispatches; "
+                "existing placements unaffected until drained",
+                regarding_kind="Cluster",
+            )
+            self._membership_metric("cordon")
+        return True
+
+    def uncordon(self, name: str) -> bool:
+        if name not in self.clusters:
+            return False
+        self.cordoned.discard(name)
+        self._membership_metric("uncordon")
+        return True
+
+    def drain_worker(self, name: str) -> int:
+        """Drain-ahead for scale-down: cordon ``name`` and move every
+        placement off it under the fencing protocol — winners are
+        deposed (fence bump + at-least-once retraction of the old
+        epoch's copy + re-dispatch onto surviving capacity), pending
+        mirrors are retracted and dropped from target sets. No strike:
+        the operator chose this, the worker did nothing wrong. Returns
+        how many placements were deposed."""
+        if name not in self.clusters:
+            return 0
+        self.cordon(name)
+        now = self.runtime.clock.now()
+        deposed = 0
+        for key in sorted(self.states):
+            st = self.states[key]
+            if st.finished:
+                continue
+            if st.winner == name:
+                wl = self.runtime.workloads.get(key)
+                if wl is None:
+                    continue
+                self._depose_winner(
+                    wl, st, now,
+                    f'worker cluster "{name}" draining for scale-down',
+                    strike=False, cascade=False,
+                )
+                deposed += 1
+            elif name in st.clusters or name in st.mirrored:
+                if name in st.clusters:
+                    st.clusters.remove(name)
+                st.mirrored.discard(name)
+                self._enqueue_retraction(key, name, st.fence)
+        self._membership_metric("drain")
+        return deposed
+
+    def remove_worker(self, name: str) -> bool:
+        """Scale-down: drain, flush retractions while the wire still
+        exists, then drop the worker. Retractions that could not be
+        delivered auto-ack on the next pump (the cluster left the
+        federation — nothing to retract)."""
+        if name not in self.clusters:
+            return False
+        self.drain_worker(name)
+        self.pump_retractions()
+        del self.clusters[name]
+        self.health.pop(name, None)
+        self.cordoned.discard(name)
+        self._last_contact.pop(name, None)
+        self._membership_metric("leave")
+        return True
 
     # ---- journal plumbing (rides the PR-4 WAL) ----
     def _journal(self, rtype: str, data: dict) -> None:
@@ -319,6 +410,7 @@ class FederationDispatcher:
                 n,
                 c.client.active if c.client is not None else True,
                 self.health[n].quarantined(now),
+                n in self.cordoned,
             )
             for n, c in self.clusters.items()
         )
@@ -335,7 +427,10 @@ class FederationDispatcher:
             or self._rank_memo[0] != self._step_seq
             or self._rank_memo[1] != fp
         ):
-            names = [n for n, _active, quarantined in fp if not quarantined]
+            names = [
+                n for n, _active, quarantined, cordoned in fp
+                if not quarantined and not cordoned
+            ]
             self._rank_memo = (self._step_seq, fp, names, {})
         return self._rank_memo[2]
 
@@ -749,7 +844,12 @@ class FederationDispatcher:
         order = [
             c.name for c in self.rank_clusters(wl) if c.name != old
         ]
-        if not order and old is not None and old in self.clusters:
+        if (
+            not order
+            and old is not None
+            and old in self.clusters
+            and old not in self.cordoned
+        ):
             order = [old]  # last cluster standing: keep trying it
         st.clusters = order[: self.fanout] if self.fanout else order
         st.mirrored = set()
@@ -966,6 +1066,9 @@ class FederationDispatcher:
             if c.client.active and not self.health[name].quarantined(now)
         )
         m.multikueue_clusters_active.set(active)
+        m.elastic_workers_cordoned.set(
+            len(self.cordoned & set(self.clusters))
+        )
 
     def health_report(self) -> dict:
         """The /healthz "federation" detail: degraded while any
@@ -977,6 +1080,7 @@ class FederationDispatcher:
         quarantined = sorted(
             name for name, h in self.health.items() if h.quarantined(now)
         )
+        cordoned = sorted(self.cordoned & set(self.clusters))
         pending_retractions = sum(
             1 for r in self.retractions.values() if not r.acked
         )
@@ -985,6 +1089,9 @@ class FederationDispatcher:
             "active": len(self.clusters) - len(lost),
             "lost": lost,
             "quarantined": quarantined,
+            # cordon is an operator intent, not a failure: visible here
+            # but never flips "degraded"
+            "cordoned": cordoned,
             "pendingRetractions": pending_retractions,
             "workloads": len(self.states),
             "degraded": bool(lost or quarantined),
@@ -1005,6 +1112,7 @@ class FederationDispatcher:
                     "quarantinedUntil": (
                         h.quarantined_until if h.quarantined(now) else None
                     ),
+                    "cordoned": name in self.cordoned,
                     "strikes": h.strikes,
                     "dispatches": h.dispatches,
                     "wins": h.wins,

@@ -264,6 +264,9 @@ class GlobalScheduler:
             or wl.is_finished
             or wl.is_admitted
             or target not in self.disp.clusters
+            # drain-ahead: a cordoned worker must not RECEIVE moves
+            # (its own placements are being drained off it)
+            or target in self.disp.cordoned
             or st.winner == target
         ):
             return skip("skipped_gone")

@@ -561,6 +561,86 @@ class Metrics:
         self.replica_lag_seconds.set(0.0)
         self.replica_records_applied_total.inc(0.0)
         self.replica_resyncs_total.inc(0.0)
+        # two-phase provisioning (admissionchecks/provisioning.py):
+        # ProvisioningRequest lifecycle volume per closed state label,
+        # the retry-ladder rate and its backoff distribution. A rising
+        # exhausted count is the "autoscaler cannot satisfy this class"
+        # signal; booking_expired without matching provisioned means
+        # capacity keeps arriving too late.
+        self.provisioning_requests_total = r.counter(
+            f"{NS}_provisioning_requests_total",
+            "ProvisioningRequest lifecycle transitions per state "
+            "(created|submitted|provisioned|failed|booking_expired"
+            "|capacity_revoked|exhausted)",
+            ("state",),
+        )
+        for state in (
+            "created", "submitted", "provisioned", "failed",
+            "booking_expired", "capacity_revoked", "exhausted",
+        ):
+            self.provisioning_requests_total.inc(0.0, state=state)
+        self.provisioning_retries_total = r.counter(
+            f"{NS}_provisioning_retries_total",
+            "Total provisioning retry attempts entered (b*2^(n-1) ladder)",
+        )
+        self.provisioning_retries_total.inc(0.0)
+        self.provisioning_backoff_seconds = r.histogram(
+            f"{NS}_provisioning_backoff_seconds",
+            "Backoff applied before each provisioning retry attempt",
+            buckets=(30, 60, 120, 240, 480, 960, 1800, 3600),
+        )
+        self.provisioning_backoff_seconds.touch()
+        # elastic capacity plane (kueue_tpu/elastic): journaled quota
+        # grants/revokes, currently granted capacity per (flavor,
+        # resource), the batched scale-up chooser, and drain-ahead
+        # membership. grants minus revokes tracks net elastic quota;
+        # workers_cordoned > 0 for long means a drain is stuck behind
+        # unretractable placements.
+        self.elastic_grants_total = r.counter(
+            f"{NS}_elastic_grants_total",
+            "Total journaled elastic_grant capacity mutations applied",
+        )
+        self.elastic_grants_total.inc(0.0)
+        self.elastic_revokes_total = r.counter(
+            f"{NS}_elastic_revokes_total",
+            "Total journaled elastic_revoke capacity withdrawals applied",
+        )
+        self.elastic_revokes_total.inc(0.0)
+        self.elastic_granted_resources = r.gauge(
+            f"{NS}_elastic_granted_resources",
+            "Capacity currently granted by the provider per flavor and "
+            "resource (canonical units)",
+            ("flavor", "resource"),
+        )
+        # flavor/resource are open-ended: materialize the empty-label
+        # series up front, the multikueue_remote_rtt_seconds pattern
+        self.elastic_granted_resources.set(0.0, flavor="", resource="")
+        self.elastic_chooser_launches_total = r.counter(
+            f"{NS}_elastic_chooser_launches_total",
+            "Total batched scale-up chooser launches (one vmapped "
+            "plan_kernel sweep scoring every candidate flavor delta)",
+        )
+        self.elastic_chooser_launches_total.inc(0.0)
+        self.elastic_chooser_seconds = r.histogram(
+            f"{NS}_elastic_chooser_seconds",
+            "Wall-clock latency of one batched scale-up chooser plan",
+            buckets=ATTEMPT_BUCKETS,
+        )
+        self.elastic_chooser_seconds.touch()
+        self.elastic_workers_cordoned = r.gauge(
+            f"{NS}_elastic_workers_cordoned",
+            "Federation workers currently cordoned (drain-ahead: no "
+            "new dispatches, placements being retracted)",
+        )
+        self.elastic_workers_cordoned.set(0)
+        self.elastic_membership_changes_total = r.counter(
+            f"{NS}_elastic_membership_changes_total",
+            "Dynamic federation membership operations per kind "
+            "(join|cordon|uncordon|drain|leave)",
+            ("kind",),
+        )
+        for kind in ("join", "cordon", "uncordon", "drain", "leave"):
+            self.elastic_membership_changes_total.inc(0.0, kind=kind)
         # LocalQueue variants (LocalQueueMetrics feature gate)
         self.local_queue_pending_workloads = r.gauge(
             f"{NS}_local_queue_pending_workloads",

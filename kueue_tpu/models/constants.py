@@ -152,6 +152,18 @@ EVENT_REASONS = frozenset(
         "Deactivated",
         "AdmissionChecksRejected",
         "ProvisioningRequestCreated",
+        # two-phase provisioning (admissionchecks/provisioning.py) +
+        # elastic capacity plane (kueue_tpu/elastic): the full
+        # ProvisioningRequest lifecycle — capacity stood up, attempt
+        # failed into the retry ladder, previously granted capacity
+        # withdrawn — and the capacity-plane side of the loop (a
+        # journaled flavor-quota grant, a worker cordoned ahead of
+        # scale-down)
+        "Provisioned",
+        "ProvisioningFailed",
+        "CapacityRevoked",
+        "ElasticCapacityGranted",
+        "ElasticWorkerCordoned",
         "MultiKueueClusterLost",
         "MultiKueueRejected",
         "MultiKueueReserved",

@@ -1168,7 +1168,15 @@ def plan_request(rt, body: dict) -> dict:
         forecast=forecast,
         verify_host=bool(options.get("verifyHost", False)),
     )
-    return report.to_dict()
+    out = report.to_dict()
+    plane = getattr(rt, "elastic", None)
+    if plane is not None:
+        # the elastic plane runs candidate scale-ups through this same
+        # planner — surface its standings next to the what-if report so
+        # `kueuectl plan` explains both what a config change would do
+        # AND what capacity the plane already chose to stand up
+        out["elastic"] = plane.status()
+    return out
 
 
 def forecast_time_to_admission(

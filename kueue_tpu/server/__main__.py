@@ -406,6 +406,35 @@ def main(argv=None) -> int:
         help="seconds between global rescore passes",
     )
     parser.add_argument(
+        "--elastic", choices=["on", "off"], default="off",
+        help="run the elastic capacity plane: pending "
+        "ProvisioningRequests are ranked by scoring each candidate "
+        "flavor scale-up through one batched planner launch, the "
+        "winner is submitted to --capacity-provider, and on "
+        "Provisioned a journaled elastic_grant raises the flavor's "
+        "nominal quota (BookingExpired/CapacityRevoked shrink it back "
+        "via elastic_revoke). Served at GET /capacity and `kueuectl "
+        "capacity`",
+    )
+    parser.add_argument(
+        "--capacity-provider", choices=["simulated"], default="simulated",
+        help="capacity provider backing --elastic (simulated: clock-"
+        "driven in-process autoscaler with --elastic-provision-delay)",
+    )
+    parser.add_argument(
+        "--elastic-provision-delay", type=float, default=5.0,
+        help="seconds the simulated capacity provider takes to "
+        "provision an accepted request",
+    )
+    parser.add_argument(
+        "--elastic-capacity-limit", action="append", default=None,
+        metavar="FLAVOR:RESOURCE=AMOUNT",
+        help="cap the simulated provider's total grantable capacity "
+        "for a (flavor, resource) pair (repeatable; default: "
+        "unlimited) — requests past the cap fail and walk the "
+        "b*2^(n-1) retry ladder",
+    )
+    parser.add_argument(
         "--leader-elect-lease",
         help="path to a shared lease file (on the state volume): "
         "enables leader election — the holder accepts writes and "
@@ -477,6 +506,7 @@ def main(argv=None) -> int:
             ("--leader-elect-lease", args.leader_elect_lease),
             ("--federation-worker", args.federation_worker),
             ("--gateway", args.gateway if args.gateway == "on" else None),
+            ("--elastic", args.elastic if args.elastic == "on" else None),
         ):
             if val:
                 parser.error(f"--replica-of is incompatible with {flag}")
@@ -668,6 +698,43 @@ def main(argv=None) -> int:
             (lambda: elector.lease.token) if elector is not None else None
         )
         runtime.attach_journal(journal)
+    if args.elastic == "on":
+        # elastic capacity plane: built AFTER journal attach/recovery
+        # so grants journal durably and the plane adopts any
+        # elastic_grant records replay already applied (it must never
+        # re-ask the provider for capacity it provably holds)
+        from kueue_tpu.elastic import SimulatedProvider, attach_elastic_plane
+
+        limits = {}
+        for spec in args.elastic_capacity_limit or []:
+            fr, sep, amount = spec.partition("=")
+            flavor, fsep, resource = fr.partition(":")
+            if not sep or not fsep or not flavor or not resource:
+                parser.error(
+                    "--elastic-capacity-limit must be "
+                    f"FLAVOR:RESOURCE=AMOUNT, got {spec!r}"
+                )
+            try:
+                limits.setdefault(flavor, {})[resource] = int(amount)
+            except ValueError:
+                parser.error(
+                    "--elastic-capacity-limit must be "
+                    f"FLAVOR:RESOURCE=AMOUNT, got {spec!r}"
+                )
+        provider = SimulatedProvider(
+            clock=runtime.clock,
+            provision_delay_s=args.elastic_provision_delay,
+            capacity_limits=limits or None,
+        )
+        attach_elastic_plane(runtime, provider=provider)
+        print(
+            "elastic capacity plane: provider "
+            f"{args.capacity_provider} (delay "
+            f"{args.elastic_provision_delay:g}s"
+            + (f", limits {sorted(limits)}" if limits else "")
+            + ")",
+            flush=True,
+        )
     if args.federation_worker:
         # federation manager mode: dispatch to remote worker control
         # planes over HTTP. Built AFTER journal attach so dispatch /
@@ -816,6 +883,31 @@ def main(argv=None) -> int:
             signal.SIGUSR2,
             lambda *_: sys.stderr.write(debugger.dump(srv.runtime) + "\n"),
         )
+
+    if args.elastic == "on" or args.federation_worker:
+        # the elastic capacity loop is TIME-driven (provider delays,
+        # retry backoffs) and drain-ahead scale-down re-dispatches
+        # deposed placements from federation.step(): both only make
+        # progress inside run_until_idle, which otherwise fires only on
+        # API traffic — an in-flight grant or a drained placement could
+        # sit forever on an idle server without this ticker
+        tick = 1.0
+        if args.elastic == "on":
+            tick = max(0.2, min(2.0, args.elastic_provision_delay / 2))
+
+        def _reconcile_loop():
+            while not stop.wait(tick):
+                try:
+                    if elector is not None and not elector.is_leader:
+                        continue
+                    with srv.lock:
+                        srv.runtime.run_until_idle()
+                except Exception as e:  # noqa: BLE001 — a provider or
+                    # worker hiccup must not kill the capacity loop for
+                    # the rest of the process lifetime
+                    print(f"background reconcile failed: {e!r}", flush=True)
+
+        threading.Thread(target=_reconcile_loop, daemon=True).start()
 
     ckpt_thread = None
     if args.state and args.state_checkpoint_period > 0:

@@ -683,6 +683,10 @@ _SECURED_ROUTES = frozenset(
         # trace stores expose workload identities + timing: same gate
         # as the decision audit surface
         "debug_traces", "debug_trace_get", "workload_trace",
+        # dynamic membership mutates the dispatch roster (drain moves
+        # real placements) — gate like every other write
+        "federation_add_worker", "federation_remove_worker",
+        "federation_membership",
     }
 )
 
@@ -762,6 +766,24 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
         re.compile(r"^/apis/federation/v1beta1/status$"),
         "federation_status",
     ),
+    (
+        "POST",
+        re.compile(r"^/apis/federation/v1beta1/clusters$"),
+        "federation_add_worker",
+    ),
+    (
+        "POST",
+        re.compile(
+            r"^/apis/federation/v1beta1/clusters/([^/]+)/(cordon|uncordon|drain)$"
+        ),
+        "federation_membership",
+    ),
+    (
+        "DELETE",
+        re.compile(r"^/apis/federation/v1beta1/clusters/([^/]+)$"),
+        "federation_remove_worker",
+    ),
+    ("GET", re.compile(r"^/apis/elastic/v1beta1/capacity$"), "capacity"),
     ("GET", re.compile(r"^/global/standings$"), "global_standings"),
     ("POST", re.compile(r"^/reconcile$"), "reconcile"),
     ("GET", re.compile(r"^/events/stream$"), "events_stream"),
@@ -1278,6 +1300,85 @@ def _make_handler(srv: KueueServer):
             with srv.lock:
                 status = fed.status()
             self._send_json(status)
+
+        def _h_federation_add_worker(self, query):
+            """Runtime scale-up join: add a worker cluster to the
+            dispatch roster without a restart. Body: {"name", "url",
+            "token"?}. The worker is dispatchable on the next pass."""
+            fed = getattr(srv.runtime, "federation", None)
+            if fed is None:
+                raise ApiError(404, "federation is not enabled")
+            srv.require_leader()
+            body = self._body()
+            name = body.get("name") or ""
+            url = body.get("url") or ""
+            if not name or not url:
+                raise ApiError(400, "body must carry name and url")
+            from kueue_tpu.admissionchecks.multikueue import (
+                MultiKueueCluster,
+            )
+            from kueue_tpu.admissionchecks.multikueue_transport import (
+                HTTPTransport,
+            )
+
+            with srv.lock:
+                fed.add_worker(
+                    MultiKueueCluster(
+                        name=name,
+                        transport=HTTPTransport(
+                            url, token=body.get("token") or None
+                        ),
+                    )
+                )
+            self._send_json({"joined": name})
+
+        def _h_federation_membership(self, name, action, query):
+            """cordon: stop new dispatches; uncordon: readmit; drain:
+            cordon + move every placement off the worker under the
+            fencing protocol (deposed winners re-dispatch elsewhere)."""
+            fed = getattr(srv.runtime, "federation", None)
+            if fed is None:
+                raise ApiError(404, "federation is not enabled")
+            srv.require_leader()
+            with srv.lock:
+                if action == "drain":
+                    if name not in fed.clusters:
+                        raise ApiError(404, f"unknown worker cluster {name!r}")
+                    deposed = fed.drain_worker(name)
+                    out = {"drained": name, "deposed": deposed}
+                else:
+                    ok = (
+                        fed.cordon(name)
+                        if action == "cordon"
+                        else fed.uncordon(name)
+                    )
+                    if not ok:
+                        raise ApiError(404, f"unknown worker cluster {name!r}")
+                    out = {action + "ed": name}
+            self._send_json(out)
+
+        def _h_federation_remove_worker(self, name, query):
+            """Scale-down leave: drain, flush retractions, drop the
+            worker from the roster."""
+            fed = getattr(srv.runtime, "federation", None)
+            if fed is None:
+                raise ApiError(404, "federation is not enabled")
+            srv.require_leader()
+            with srv.lock:
+                if not fed.remove_worker(name):
+                    raise ApiError(404, f"unknown worker cluster {name!r}")
+            self._send_json({"removed": name})
+
+        def _h_capacity(self, query):
+            """Elastic capacity plane status: provider grants, applied
+            (journaled) requests, in-flight asks, last chooser verdict.
+            404 when --elastic is off."""
+            plane = getattr(srv.runtime, "elastic", None)
+            if plane is None:
+                raise ApiError(404, "elastic capacity plane is not enabled")
+            with srv.lock:
+                body = plane.status()
+            self._send_json(body)
 
         def _h_global_standings(self, query):
             """Federation-wide visibility: the global scheduler's

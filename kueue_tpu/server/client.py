@@ -433,6 +433,50 @@ class KueueClient:
         dispatch state (winner + fence), pending retractions."""
         return self._request("GET", "/apis/federation/v1beta1/status")
 
+    def federation_add_worker(
+        self, name: str, url: str, token: Optional[str] = None
+    ) -> dict:
+        """Runtime scale-up: join a worker cluster to the dispatch
+        roster (POST /apis/federation/v1beta1/clusters)."""
+        body = {"name": name, "url": url}
+        if token:
+            body["token"] = token
+        return self._request(
+            "POST", "/apis/federation/v1beta1/clusters", body
+        )
+
+    def federation_cordon(self, name: str) -> dict:
+        """Stop new dispatches to a worker (existing placements stay)."""
+        return self._request(
+            "POST", f"/apis/federation/v1beta1/clusters/{name}/cordon"
+        )
+
+    def federation_uncordon(self, name: str) -> dict:
+        """Readmit a cordoned worker to dispatch."""
+        return self._request(
+            "POST", f"/apis/federation/v1beta1/clusters/{name}/uncordon"
+        )
+
+    def federation_drain(self, name: str) -> dict:
+        """Cordon + move every placement off the worker under the
+        fencing protocol: {"drained", "deposed"}."""
+        return self._request(
+            "POST", f"/apis/federation/v1beta1/clusters/{name}/drain"
+        )
+
+    def federation_remove_worker(self, name: str) -> dict:
+        """Scale-down leave: drain, flush retractions, drop the worker
+        (DELETE /apis/federation/v1beta1/clusters/NAME)."""
+        return self._request(
+            "DELETE", f"/apis/federation/v1beta1/clusters/{name}"
+        )
+
+    def capacity(self) -> dict:
+        """Elastic capacity plane status (GET /apis/elastic/v1beta1/
+        capacity): provider grants, applied requests, in-flight asks,
+        last chooser verdict. 404 (ClientError) when --elastic is off."""
+        return self._request("GET", "/apis/elastic/v1beta1/capacity")
+
     def global_standings(self) -> dict:
         """Federation-wide standings (the `kueuectl pending-workloads
         --global` payload): per-worker pending counts, fair-share

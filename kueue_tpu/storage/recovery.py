@@ -67,6 +67,13 @@ _FEDERATION_TYPES = (
     FEDERATION_RETRACT_ENQUEUE,
     FEDERATION_RETRACT_DONE,
 )
+# elastic capacity plane (kueue_tpu/elastic): journaled flavor-quota
+# mutations — post-state records (the granted nominal values, not the
+# delta), so re-applying after a crash between append and apply
+# converges instead of double-granting
+ELASTIC_GRANT = "elastic_grant"
+ELASTIC_REVOKE = "elastic_revoke"
+_ELASTIC_TYPES = (ELASTIC_GRANT, ELASTIC_REVOKE)
 
 
 class RecoveryError(Exception):
@@ -186,6 +193,15 @@ def apply_record(rt, rec: JournalRecord) -> None:
                 replay = []
                 rt.federation_replay = replay
             replay.append((rec.type, dict(rec.data)))
+    elif rec.type in _ELASTIC_TYPES:
+        # flavor-quota mutation owned by the elastic plane, but the
+        # record is post-state over cache-resident objects, so it can
+        # be applied without the plane existing (recovery, tailing
+        # replicas): the helper mutates the CQ's nominal cells and
+        # requeues parked heads
+        from kueue_tpu.elastic.plane import apply_capacity_record
+
+        apply_capacity_record(rt, rec.type, rec.data)
     elif rec.type == POLICY_CONFIG:
         set_policy = getattr(rt, "set_policy", None)
         if set_policy is not None:

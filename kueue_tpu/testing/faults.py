@@ -157,6 +157,22 @@ FAULT_POINTS: Dict[str, str] = {
         "queued'; the pump + deposal + re-dispatch must converge to "
         "exactly one admission"
     ),
+    # ---- elastic capacity plane (kueue_tpu/elastic) ----
+    "provisioning.mid_flip": (
+        "two-phase admission: the ProvisioningRequest just turned "
+        "Provisioned and the check is about to flip Ready "
+        "(admissionchecks/provisioning._sync_check_state) — the torn "
+        "window where the provider granted capacity but the check "
+        "state/pod_set_updates are not yet applied or journaled; a "
+        "crash here must recover to the no-crash admitted set"
+    ),
+    "elastic.grant_mid_apply": (
+        "elastic capacity grant: the elastic_grant record is durable "
+        "in the journal, the flavor-quota mutation + parked-head "
+        "requeue NOT yet applied (elastic/plane._apply_grant) — "
+        "recovery must re-apply the post-state record idempotently and "
+        "converge to the no-crash admitted set"
+    ),
     # ---- gateway serving tier (kueue_tpu/gateway/batcher.py) ----
     "gateway.flush_mid_batch": (
         "inside the write-gateway's coalescing flush, between two "

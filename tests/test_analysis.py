@@ -361,15 +361,50 @@ def poll(rt, recs):
         apply_record(rt, rec)
 '''
 
+# The elastic capacity plane journals its grants/revokes from its own
+# module (not the runtime funnel file) — the rule must still pair them
+# with the recovery handler's membership-tuple dispatch.
+SYM_ELASTIC_PRODUCER = '''\
+ELASTIC_GRANT = "elastic_grant"
+ELASTIC_REVOKE = "elastic_revoke"
+
+
+class Plane:
+    def _grant(self, data):
+        self.runtime._journal_append(ELASTIC_GRANT, data)
+
+    def _revoke(self, data):
+        self.runtime._journal_append(ELASTIC_REVOKE, data)
+'''
+
+SYM_ELASTIC_RECOVERY = '''\
+WORKLOAD_UPSERT = "workload_upsert"
+QUARANTINE_SET = "quarantine_set"
+ELASTIC_GRANT = "elastic_grant"
+ELASTIC_REVOKE = "elastic_revoke"
+_ELASTIC_TYPES = (ELASTIC_GRANT, ELASTIC_REVOKE)
+
+
+def apply_record(rt, rec):
+    if rec.type == WORKLOAD_UPSERT:
+        rt.add(rec.data)
+    elif rec.type in (QUARANTINE_SET,):
+        rt.q(rec.data)
+    elif rec.type in _ELASTIC_TYPES:
+        rt.capacity(rec.type, rec.data)
+'''
+
 
 class TestJournalSymmetryRule:
-    def _tree(self, recovery=SYM_RECOVERY, tailer=SYM_TAILER):
+    def _tree(self, recovery=SYM_RECOVERY, tailer=SYM_TAILER, extra=None):
         files = {
             "controllers/cluster.py": SYM_PRODUCER,
             "storage/recovery.py": recovery,
         }
         if tailer is not None:
             files["storage/tailer.py"] = tailer
+        if extra:
+            files.update(extra)
         return files
 
     def test_symmetric_tree_is_clean(self, tmp_path):
@@ -416,6 +451,46 @@ class TestJournalSymmetryRule:
         )
         assert len(findings) == 1
         assert "tailer" in findings[0].message
+
+    def test_elastic_kinds_symmetric_tree_is_clean(self, tmp_path):
+        """ISSUE-18: elastic_grant/elastic_revoke journaled from the
+        capacity plane's own module, replayed via the recovery
+        membership tuple — symmetric, no findings."""
+        assert run_fixture(
+            tmp_path,
+            self._tree(
+                recovery=SYM_ELASTIC_RECOVERY,
+                extra={"elastic/plane.py": SYM_ELASTIC_PRODUCER},
+            ),
+            rules=["journal-symmetry"],
+        ) == []
+
+    def test_elastic_handler_missing_fails_both_kinds(self, tmp_path):
+        """Producer present, recovery never taught the elastic kinds:
+        one finding per kind, each anchored at the plane's append
+        site (crash-recovery would silently drop granted capacity)."""
+        findings = run_fixture(
+            tmp_path,
+            self._tree(extra={"elastic/plane.py": SYM_ELASTIC_PRODUCER}),
+            rules=["journal-symmetry"],
+        )
+        assert len(findings) == 2
+        kinds = {("elastic_grant" in f.message, "elastic_revoke" in f.message)
+                 for f in findings}
+        assert kinds == {(True, False), (False, True)}
+        assert all(f.file == "elastic/plane.py" for f in findings)
+
+    def test_elastic_producer_deleted_is_dead_vocabulary(self, tmp_path):
+        """Recovery still dispatches the elastic kinds but nothing
+        journals them — dead vocabulary findings on the handler."""
+        findings = run_fixture(
+            tmp_path,
+            self._tree(recovery=SYM_ELASTIC_RECOVERY),
+            rules=["journal-symmetry"],
+        )
+        assert len(findings) == 2
+        assert all("dead vocabulary" in f.message for f in findings)
+        assert all(f.file == "storage/recovery.py" for f in findings)
 
 
 # ---- clock-discipline ----

@@ -1,0 +1,695 @@
+"""Elastic capacity plane tests (ISSUE-18).
+
+The acceptance criteria, as tests:
+
+- a seeded trace whose demand outruns static capacity parks its gangs
+  forever with elasticity OFF and admits everything exactly once with
+  elasticity ON;
+- the chooser scores every candidate flavor delta in ONE batched
+  plan_kernel launch and matches the host-side argmax oracle;
+- crash occurrence-sweeps at the two new fault points
+  (``provisioning.mid_flip``, ``elastic.grant_mid_apply``) recover to
+  the no-crash admitted set with clean invariants;
+- the BookingExpired retry ladder backs off b*2^(n-1) and exhaustion
+  lands on a canonical inadmissible reason;
+- dynamic federation membership (join / cordon-flap / drain / leave)
+  under load preserves exactly-one admission on every plane.
+"""
+
+import pytest
+
+from kueue_tpu.admissionchecks import (
+    PROVISIONING_CONTROLLER_NAME,
+    ProvisioningController,
+    ProvisioningRequestConfig,
+)
+from kueue_tpu.admissionchecks.provisioning import (
+    PR_BOOKING_EXPIRED,
+    PR_FAILED,
+    PR_PENDING,
+    PR_PROVISIONED,
+    RetryStrategy,
+)
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.controllers.jobs import BatchJob
+from kueue_tpu.elastic import (
+    ElasticCapacityPlane,
+    SimulatedProvider,
+    attach_elastic_plane,
+)
+from kueue_tpu.models import (
+    AdmissionCheck,
+    ClusterQueue,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+from kueue_tpu.models.constants import (
+    AdmissionCheckStateType,
+    InadmissibleReason,
+    WorkloadConditionType,
+    classify_inadmissible_message,
+)
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.testing import faults
+from kueue_tpu.utils.clock import FakeClock
+
+
+def elastic_config(rt, quota="4"):
+    """Flavor + checked CQ + LQ — identical on every boot, so crash
+    recovery replays onto the same static config (the server pattern:
+    config from flags/file, state from checkpoint + journal)."""
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq", namespace_selector={},
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu",),
+                    (FlavorQuotas.build("default", {"cpu": quota}),),
+                ),
+            ),
+        )
+    )
+    rt.add_local_queue(
+        LocalQueue(namespace="ns", name="lq", cluster_queue="cq")
+    )
+    rt.add_admission_check(
+        AdmissionCheck(
+            name="prov", controller_name=PROVISIONING_CONTROLLER_NAME,
+            parameters="prc",
+        )
+    )
+    rt.cache.cluster_queues["cq"].model.admission_checks = ("prov",)
+
+
+def wire_provisioning(rt, retry=None):
+    ctrl = ProvisioningController(rt)
+    ctrl.add_config(
+        ProvisioningRequestConfig(
+            name="prc", retry_strategy=retry or RetryStrategy(),
+        )
+    )
+    rt.admission_check_controllers.append(ctrl.reconcile)
+    return ctrl
+
+
+def make_elastic(quota="4", provider=None, use_device=False, retry=None):
+    clock = FakeClock(1000.0)
+    rt = ClusterRuntime(clock=clock, use_solver=False)
+    elastic_config(rt, quota=quota)
+    ctrl = wire_provisioning(rt, retry=retry)
+    provider = provider or SimulatedProvider(
+        clock=clock, provision_delay_s=5.0
+    )
+    plane = ElasticCapacityPlane(rt, ctrl, provider, use_device=use_device)
+    rt.admission_check_controllers.append(plane)
+    rt.elastic = plane
+    return rt, ctrl, plane, clock
+
+
+def gang(i, pods=3):
+    """One gang workload: ``pods`` x 1 cpu, all-or-nothing."""
+    return Workload(
+        namespace="ns", name=f"g{i}", queue_name="lq", priority=i,
+        pod_sets=(PodSet.build("main", pods, {"cpu": "1"}),),
+    )
+
+
+def admitted_keys(rt):
+    return {k for k, wl in rt.workloads.items() if wl.is_admitted}
+
+
+def drive(rt, rounds=40, step_s=6.0, want=None):
+    for _ in range(rounds):
+        rt.run_until_idle()
+        if want is not None and len(admitted_keys(rt)) == want:
+            return
+        rt.clock.advance(step_s)
+    rt.run_until_idle()
+
+
+# ---- the acceptance trace: demand outruns static capacity ----
+class TestDemandOutrunsCapacity:
+    N_GANGS = 4  # 4 gangs x 3 cpu against 4 cpu nominal
+
+    def test_parks_forever_without_elasticity(self):
+        """No capacity provider: the open-loop ProvisioningRequest
+        protocol never flips, so the first gang waits on its check and
+        the rest park on quota — forever."""
+        clock = FakeClock(1000.0)
+        rt = ClusterRuntime(clock=clock, use_solver=False)
+        elastic_config(rt)
+        wire_provisioning(rt)
+        for i in range(self.N_GANGS):
+            rt.add_workload(gang(i))
+        drive(rt, rounds=25)
+        assert admitted_keys(rt) == set()
+        assert rt.check_invariants() == []
+
+    def test_admits_everything_exactly_once_with_elasticity(self):
+        rt, ctrl, plane, clock = make_elastic()
+        for i in range(self.N_GANGS):
+            rt.add_workload(gang(i))
+        drive(rt, want=self.N_GANGS)
+        assert admitted_keys(rt) == {f"ns/g{i}" for i in range(self.N_GANGS)}
+        assert rt.check_invariants() == []
+        # each admission consumed exactly one grant, never re-applied
+        assert len(plane._applied) == self.N_GANGS
+        granted = plane.provider.granted_totals()
+        assert granted == {"default": {"cpu": 3000 * self.N_GANGS}}
+        # the quota the journal records is the POST nominal the cache
+        # now carries (replay convergence); amounts are milli-units
+        assert plane._current_nominal("cq", "default", "cpu") == (
+            4 + 3 * self.N_GANGS
+        ) * 1000
+        # evented + gauged
+        reasons = {e.kind for e in rt.events}
+        assert "ElasticCapacityGranted" in reasons
+        assert "Provisioned" in reasons
+
+    def test_revoke_withdraws_quota_and_requeues(self):
+        """Provider-side reclaim before admission: the journaled
+        elastic_revoke shrinks nominal back and the check controller
+        walks the workload onto the retry ladder."""
+        rt, ctrl, plane, clock = make_elastic()
+        rt.add_workload(gang(0))
+        drive(rt, rounds=4, want=1)
+        assert admitted_keys(rt) == {"ns/g0"}
+        request = next(iter(plane._applied))
+        # external reclaim (spot preemption)
+        assert plane.provider.revoke(request, "spot reclaim")
+        drive(rt, rounds=2, step_s=1.0)
+        # grant withdrawn: applied set empty, nominal back at base
+        assert request not in plane._applied
+        assert plane._current_nominal("cq", "default", "cpu") >= 4000
+        reasons = {e.kind for e in rt.events}
+        assert "CapacityRevoked" in reasons
+        assert rt.check_invariants() == []
+
+    def test_capacity_limited_provider_walks_retry_ladder(self):
+        """Asks beyond the provider's headroom FAIL like a cloud quota
+        denial — the check controller retries with backoff and the
+        workload stays pending, never wedged."""
+        provider = SimulatedProvider(
+            provision_delay_s=5.0,
+            capacity_limits={"default": {"cpu": 3000}},  # milli-units
+        )
+        rt, ctrl, plane, clock = make_elastic(provider=provider)
+        provider.clock = clock
+        # priority=i: g1 reserves quota first, its ask fits the cap;
+        # g0's ask is then denied forever (all-or-nothing headroom)
+        rt.add_workload(gang(0))
+        rt.add_workload(gang(1))
+        drive(rt, rounds=8)
+        assert "ns/g1" in admitted_keys(rt)
+        assert "ns/g0" not in admitted_keys(rt)
+        # the denial surfaced as ProvisioningFailed, not silence
+        reasons = {e.kind for e in rt.events}
+        assert "ProvisioningFailed" in reasons
+        assert rt.check_invariants() == []
+
+
+# ---- chooser: one batched launch, host-oracle equivalence ----
+class TestChooser:
+    def _contended(self):
+        """Two PRs pending at once with DIFFERENT asks: the small gang
+        asks +2, the big one +4; a parked 4-cpu workload is only
+        unblocked by the big scale-up, so the chooser must rank the
+        big PR first. priority=i, so g2 (4 pods) reserves first, g0
+        (2 pods) fills the remainder, g1 (4 pods) parks."""
+        clock = FakeClock(1000.0)
+        rt = ClusterRuntime(clock=clock, use_solver=False)
+        elastic_config(rt, quota="6")
+        ctrl = wire_provisioning(rt)
+        rt.add_workload(gang(0, pods=2))  # reserves 2
+        rt.add_workload(gang(1, pods=4))  # parked: 4 > 0 free
+        rt.add_workload(gang(2, pods=4))  # reserves 4 (top priority)
+        rt.run_until_idle()
+        plane = ElasticCapacityPlane(
+            rt, ctrl, SimulatedProvider(clock=clock), use_device=True
+        )
+        return rt, plane
+
+    def test_batched_launch_matches_host_oracle(self):
+        rt, plane = self._contended()
+        candidates = plane.pending_candidates()
+        assert len(candidates) == 2
+        dev_winner, dev_report = plane.choose(candidates, use_device=True)
+        dev_choice = dict(plane.last_choice)
+        host_winner, host_report = plane.choose(candidates, use_device=False)
+        host_choice = dict(plane.last_choice)
+        # ONE device launch scores every candidate
+        assert dev_report.launches == 1
+        assert host_report.launches == 0
+        # bit-for-bit the host argmax: same winner, same scores
+        assert dev_winner.request == host_winner.request
+        assert dev_choice["scores"] == host_choice["scores"]
+        # and the winner is the scale-up that unblocks parked work:
+        # g2's +4 grant frees room for the parked 4-cpu g1, g0's +2
+        # does not
+        assert dev_winner.request == "g2-prov-1"
+        assert dev_choice["scores"]["g2-prov-1"] > dev_choice["scores"][
+            "g0-prov-1"
+        ]
+
+    def test_deterministic_tiebreak_on_equal_scores(self):
+        """Identical asks score identically: the cheaper delta wins,
+        then the request name — stable across backends."""
+        clock = FakeClock(1000.0)
+        rt = ClusterRuntime(clock=clock, use_solver=False)
+        elastic_config(rt, quota="6")
+        ctrl = wire_provisioning(rt)
+        rt.add_workload(gang(0, pods=3))
+        rt.add_workload(gang(1, pods=3))
+        rt.run_until_idle()
+        plane = ElasticCapacityPlane(
+            rt, ctrl, SimulatedProvider(clock=clock), use_device=False
+        )
+        candidates = plane.pending_candidates()
+        assert len(candidates) == 2
+        winner, _ = plane.choose(candidates)
+        assert winner.request == "g0-prov-1"  # name tiebreak
+
+    def test_single_candidate_skips_the_launch(self):
+        """The end-to-end loop with one pending PR at a time performs
+        the argmax over one element without any launch."""
+        rt, ctrl, plane, clock = make_elastic()
+        rt.add_workload(gang(0))
+        drive(rt, rounds=4, want=1)
+        assert admitted_keys(rt) == {"ns/g0"}
+        assert plane.chooser_launches == 0
+
+    def test_loop_uses_batched_chooser_under_contention(self):
+        """Multiple simultaneous pending PRs force the batched path in
+        the live loop; everything still admits exactly once. The plane
+        attaches AFTER both PRs exist (the restart-into-backlog shape),
+        so its first submit pass genuinely sees >1 candidate."""
+        clock = FakeClock(1000.0)
+        rt = ClusterRuntime(clock=clock, use_solver=False)
+        elastic_config(rt, quota="6")
+        ctrl = wire_provisioning(rt)
+        rt.add_workload(gang(0, pods=2))
+        rt.add_workload(gang(1, pods=4))
+        rt.add_workload(gang(2, pods=4))
+        rt.run_until_idle()  # both reservations' PRs now pending
+        plane = ElasticCapacityPlane(
+            rt, ctrl, SimulatedProvider(clock=clock), use_device=False
+        )
+        rt.admission_check_controllers.append(plane)
+        rt.elastic = plane
+        drive(rt, want=3)
+        assert admitted_keys(rt) == {"ns/g0", "ns/g1", "ns/g2"}
+        assert plane.chooser_launches >= 1
+        assert plane.last_choice is not None
+        assert rt.check_invariants() == []
+
+
+# ---- retry ladder ----
+class TestRetryLadder:
+    def make(self, retry):
+        clock = FakeClock(1000.0)
+        rt = ClusterRuntime(clock=clock, use_solver=False)
+        elastic_config(rt, quota="10")
+        ctrl = wire_provisioning(rt, retry=retry)
+        return rt, ctrl, clock
+
+    def test_booking_expired_backoff_doubles(self):
+        retry = RetryStrategy(
+            backoff_limit_count=3, backoff_base_seconds=30.0,
+            backoff_max_seconds=1800.0,
+        )
+        rt, ctrl, clock = self.make(retry)
+        job = BatchJob.build("ns", "j", "lq", parallelism=2,
+                             requests={"cpu": "1"})
+        rt.add_job(job)
+        rt.run_until_idle()
+        wl = rt.workloads["ns/job-j"]
+        observed = []
+        for attempt in (1, 2, 3):
+            pr = ctrl.active_request_for(wl, "prov")
+            assert pr is not None and pr.attempt == attempt
+            pr.state = PR_BOOKING_EXPIRED
+            before = clock.now()
+            rt.run_until_idle()
+            observed.append(ctrl._retry_after[(wl.key, "prov")] - before)
+            # mid-ladder: the canonical PENDING-with-backoff state
+            st = wl.admission_check_states["prov"]
+            assert st.state == AdmissionCheckStateType.PENDING
+            clock.advance(observed[-1] + 1.0)
+            rt.run_until_idle()
+        # b*2^(n-1): 30, 60, 120
+        assert observed == [30.0, 60.0, 120.0]
+
+    def test_exhaustion_lands_on_canonical_inadmissible_reason(self):
+        retry = RetryStrategy(
+            backoff_limit_count=1, backoff_base_seconds=30.0,
+        )
+        rt, ctrl, clock = self.make(retry)
+        job = BatchJob.build("ns", "j", "lq", parallelism=2,
+                             requests={"cpu": "1"})
+        rt.add_job(job)
+        rt.run_until_idle()
+        wl = rt.workloads["ns/job-j"]
+        pr1 = ctrl.active_request_for(wl, "prov")
+        pr1.state = PR_BOOKING_EXPIRED
+        pr1.message = "booking window lapsed"
+        rt.run_until_idle()
+        clock.advance(31.0)
+        rt.run_until_idle()
+        pr2 = ctrl.active_request_for(wl, "prov")
+        assert pr2.attempt == 2
+        pr2.state = PR_FAILED
+        rt.run_until_idle()
+        # retry budget exhausted -> Rejected -> deactivated + suspended
+        st = wl.admission_check_states["prov"]
+        assert st.state == AdmissionCheckStateType.REJECTED
+        assert not wl.active
+        assert job.is_suspended()
+        # the terminal eviction carries the CANONICAL inadmissible
+        # message — classify maps it onto the enum, never UNKNOWN (the
+        # audit lint's contract)
+        evicted = wl.conditions[WorkloadConditionType.EVICTED]
+        reason = classify_inadmissible_message(evicted.message)
+        assert reason == InadmissibleReason.DEACTIVATED
+        assert reason != InadmissibleReason.UNKNOWN
+        # the exhaustion evented with the budget in the message
+        msgs = [
+            e.message for e in rt.events
+            if e.kind == "ProvisioningFailed"
+        ]
+        assert any("exhausted" in m for m in msgs)
+        # the deactivated workload is OUT of the queues: the scheduler
+        # never nominates it again
+        res = rt.scheduler.schedule()
+        assert wl.key not in {e.workload.key for e in res.requeued}
+        assert not wl.is_admitted
+
+
+# ---- crash sweeps at the two new fault points ----
+ELASTIC_CRASH_POINTS = ("provisioning.mid_flip", "elastic.grant_mid_apply")
+
+
+def boot_elastic(tmp_path, provider, clock_start):
+    """The server boot order: static config, recovery replay (grants
+    land on top of base quota), journal attach, then the plane — which
+    ADOPTS applied grants instead of re-asking the provider."""
+    from kueue_tpu.storage import recover
+
+    clock = FakeClock(clock_start)
+    rt = ClusterRuntime(clock=clock, use_solver=False)
+    elastic_config(rt)
+    res = recover(None, str(tmp_path / "journal"), runtime=rt, strict=True)
+    rt.attach_journal(res.journal)
+    provider.clock = clock  # the provider is EXTERNAL: it survives
+    ctrl = wire_provisioning(rt)
+    plane = ElasticCapacityPlane(rt, ctrl, provider, use_device=False)
+    rt.admission_check_controllers.append(plane)
+    rt.elastic = plane
+    return rt
+
+
+def run_elastic_trace(tmp_path, crash_point=None, skip=0, n_gangs=3):
+    provider = SimulatedProvider(provision_delay_s=5.0)
+    clock_now = [1000.0]
+    rt = boot_elastic(tmp_path, provider, clock_now[0])
+    for i in range(n_gangs):
+        rt.add_workload(gang(i))
+    if crash_point is not None:
+        faults.arm(crash_point, "crash", skip=skip)
+    crashed = False
+    rounds = 0
+    while rounds < 40:
+        try:
+            rt.run_until_idle()
+            rt.clock.advance(6.0)
+            clock_now[0] = rt.clock.now()
+            rounds += 1
+            if len(admitted_keys(rt)) == n_gangs:
+                break
+        except faults.InjectedCrash:
+            assert not crashed, "fault stayed armed after recovery"
+            crashed = True
+            faults.reset()
+            # process death: rebuild from the journal; the provider —
+            # an external autoscaler — keeps its state
+            rt = boot_elastic(tmp_path, provider, clock_now[0])
+    try:
+        rt.run_until_idle()
+    finally:
+        rt.journal.close()
+    return rt, crashed
+
+
+class TestElasticCrashSweep:
+    def _expected(self, tmp_path):
+        base = tmp_path / "base"
+        base.mkdir()
+        rt, crashed = run_elastic_trace(base)
+        assert not crashed
+        want = admitted_keys(rt)
+        assert len(want) == 3
+        return want
+
+    @pytest.mark.parametrize("point", ELASTIC_CRASH_POINTS)
+    @pytest.mark.parametrize("skip", [0, 1, 2])
+    def test_crash_recover_converges(self, tmp_path, point, skip):
+        """Crash at every occurrence of both torn windows: recovery
+        must converge to the no-crash admitted set with the grant
+        applied exactly once (quota equals base + one grant per gang —
+        a double-apply would overshoot, a drop would park a gang)."""
+        want = self._expected(tmp_path)
+        case = tmp_path / f"{point.replace('.', '-')}-{skip}"
+        case.mkdir()
+        rt, crashed = run_elastic_trace(case, crash_point=point, skip=skip)
+        assert admitted_keys(rt) == want
+        assert rt.check_invariants() == []
+        assert rt.elastic._current_nominal("cq", "default", "cpu") == (
+            4 + 3 * len(want)
+        ) * 1000
+        # recovery adopted the durable grants: the provider was asked
+        # for each gang's capacity AT MOST once per submission attempt,
+        # and holds exactly the granted total
+        assert rt.elastic.provider.granted_totals() == {
+            "default": {"cpu": 3000 * len(want)}
+        }
+
+    def test_mid_flip_crash_actually_fires(self, tmp_path):
+        """Guard against the sweep silently testing nothing."""
+        self._expected(tmp_path)
+        case = tmp_path / "fires"
+        case.mkdir()
+        _, crashed = run_elastic_trace(
+            case, crash_point="provisioning.mid_flip", skip=0
+        )
+        assert crashed
+
+    def test_grant_mid_apply_crash_actually_fires(self, tmp_path):
+        self._expected(tmp_path)
+        case = tmp_path / "fires2"
+        case.mkdir()
+        _, crashed = run_elastic_trace(
+            case, crash_point="elastic.grant_mid_apply", skip=0
+        )
+        assert crashed
+
+
+# ---- dynamic federation membership under load ----
+class TestMembershipChurn:
+    def _federation(self, n_workers=3, quota=10):
+        from kueue_tpu.admissionchecks.multikueue import MultiKueueCluster
+        from kueue_tpu.federation import FederationDispatcher
+
+        clock = FakeClock(0.0)
+
+        def worker():
+            rt = ClusterRuntime(clock=clock, use_solver=False)
+            rt.add_flavor(ResourceFlavor(name="default"))
+            rt.add_cluster_queue(
+                ClusterQueue(
+                    name="cq", namespace_selector={},
+                    resource_groups=(
+                        ResourceGroup(
+                            ("cpu",),
+                            (
+                                FlavorQuotas.build(
+                                    "default", {"cpu": str(quota)}
+                                ),
+                            ),
+                        ),
+                    ),
+                )
+            )
+            rt.add_local_queue(
+                LocalQueue(namespace="ns", name="lq", cluster_queue="cq")
+            )
+            return rt
+
+        planes = {f"w{i}": worker() for i in range(n_workers)}
+        manager = ClusterRuntime(clock=clock)
+        disp = FederationDispatcher(
+            manager,
+            clusters={
+                name: MultiKueueCluster(name=name, runtime=rt)
+                for name, rt in planes.items()
+            },
+            drive_inprocess=True,
+        )
+        return manager, disp, planes, clock, worker, MultiKueueCluster
+
+    def _settle(self, manager, clock, want):
+        for _ in range(60):
+            manager.run_until_idle()
+            clock.advance(1.0)
+            if len(admitted_keys(manager)) == want:
+                return
+        raise AssertionError(
+            f"{len(admitted_keys(manager))}/{want} admitted"
+        )
+
+    def _assert_exactly_once(self, manager, planes):
+        for key in admitted_keys(manager):
+            holders = [
+                n for n, rt in planes.items() if key in rt.workloads
+            ]
+            assert len(holders) == 1, f"{key} held by {holders}"
+        for name, rt in planes.items():
+            assert rt.check_invariants() == [], name
+        assert manager.check_invariants() == []
+
+    def test_cordoned_worker_receives_no_new_dispatches(self):
+        manager, disp, planes, clock, worker, MKC = self._federation()
+        assert disp.cordon("w0")
+        for i in range(6):
+            manager.add_workload(
+                Workload(
+                    namespace="ns", name=f"c{i}", queue_name="lq",
+                    priority=i,
+                    pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+                )
+            )
+        self._settle(manager, clock, 6)
+        assert len(planes["w0"].workloads) == 0
+        assert "w0" in disp.health_report()["cordoned"]
+        # cordon is operator intent, not degradation
+        assert disp.health_report()["degraded"] is False
+        self._assert_exactly_once(manager, planes)
+
+    def test_join_drain_flap_preserves_exactly_once(self):
+        """The membership-churn chaos suite: workers join at runtime,
+        loaded workers drain-ahead and leave, a survivor cordon-flaps —
+        every workload stays admitted exactly once on exactly one
+        plane, every plane's invariants clean."""
+        manager, disp, planes, clock, worker, MKC = self._federation()
+        n_wl = 18
+        for i in range(n_wl):
+            manager.add_workload(
+                Workload(
+                    namespace="ns", name=f"m{i}", queue_name="lq",
+                    priority=i,
+                    pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+                )
+            )
+        self._settle(manager, clock, n_wl)
+        # runtime JOIN
+        planes["w3"] = worker()
+        disp.add_worker(MKC(name="w3", runtime=planes["w3"]))
+        # cordon FLAP on a survivor
+        assert disp.cordon("w1")
+        assert disp.uncordon("w1")
+        # drain-ahead scale-down of a loaded worker, then leave
+        deposed = disp.drain_worker("w0")
+        assert deposed > 0 or len(planes["w0"].workloads) == 0
+        self._settle(manager, clock, n_wl)
+        assert disp.remove_worker("w0")
+        removed = planes.pop("w0")
+        self._settle(manager, clock, n_wl)
+        live = [
+            k for k, wl in removed.workloads.items()
+            if not wl.is_finished and wl.is_admitted
+        ]
+        assert live == [], f"removed worker still runs {live}"
+        self._assert_exactly_once(manager, planes)
+        # a second churn round against the reshaped roster
+        planes["w4"] = worker()
+        disp.add_worker(MKC(name="w4", runtime=planes["w4"]))
+        assert disp.remove_worker("w1")
+        planes.pop("w1")
+        self._settle(manager, clock, n_wl)
+        self._assert_exactly_once(manager, planes)
+
+    def test_drain_is_strikeless(self):
+        """Operator-initiated drain must not quarantine the worker:
+        rejoin is clean."""
+        manager, disp, planes, clock, worker, MKC = self._federation()
+        for i in range(6):
+            manager.add_workload(
+                Workload(
+                    namespace="ns", name=f"s{i}", queue_name="lq",
+                    priority=i,
+                    pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+                )
+            )
+        self._settle(manager, clock, 6)
+        disp.drain_worker("w0")
+        self._settle(manager, clock, 6)
+        assert disp.health[
+            "w0"
+        ].strikes == 0, "drain must not strike the worker"
+        # rejoin: uncordon readmits it to dispatch
+        assert disp.uncordon("w0")
+        assert "w0" not in disp.cordoned
+
+
+# ---- surfaces ----
+class TestSurfaces:
+    def test_plan_request_carries_elastic_section(self):
+        from kueue_tpu.planner.engine import plan_request
+
+        rt, ctrl, plane, clock = make_elastic()
+        rt.add_workload(gang(0))
+        rt.run_until_idle()
+        out = plan_request(rt, {"target": {"clusterQueue": "cq"}})
+        assert out["elastic"]["enabled"] is True
+        assert out["elastic"]["provider"] == "SimulatedProvider"
+
+    def test_status_reports_choice_and_grants(self):
+        clock = FakeClock(1000.0)
+        rt = ClusterRuntime(clock=clock, use_solver=False)
+        elastic_config(rt, quota="6")
+        ctrl = wire_provisioning(rt)
+        rt.add_workload(gang(0, pods=2))
+        rt.add_workload(gang(1, pods=4))
+        rt.add_workload(gang(2, pods=4))
+        rt.run_until_idle()  # two PRs pending before the plane attaches
+        plane = ElasticCapacityPlane(
+            rt, ctrl, SimulatedProvider(clock=clock), use_device=False
+        )
+        rt.admission_check_controllers.append(plane)
+        rt.elastic = plane
+        drive(rt, want=3)
+        st = plane.status()
+        assert st["enabled"] and st["provider"] == "SimulatedProvider"
+        assert st["granted"] == {"default": {"cpu": 10000}}
+        assert st["chooserLaunches"] >= 1
+        assert st["lastChoice"]["chosen"] in st["appliedRequests"]
+
+    def test_attach_reuses_existing_controller(self):
+        clock = FakeClock(1000.0)
+        rt = ClusterRuntime(clock=clock, use_solver=False)
+        elastic_config(rt)
+        ctrl = wire_provisioning(rt)
+        plane = attach_elastic_plane(rt, use_device=False)
+        assert plane.controller is ctrl
+        assert rt.elastic is plane
+
+    def test_metrics_families_materialized_and_move(self):
+        rt, ctrl, plane, clock = make_elastic()
+        text = rt.metrics.registry.expose()
+        assert "kueue_provisioning_requests_total" in text
+        assert "kueue_elastic_grants_total" in text
+        rt.add_workload(gang(0))
+        drive(rt, rounds=4, want=1)
+        text = rt.metrics.registry.expose()
+        assert 'state="provisioned"' in text
