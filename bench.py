@@ -1453,6 +1453,316 @@ def journal_bench(rng, n_cq=40, wl_per_cq=40, fsync_policy="interval"):
     return baseline_ms, journal_ms, appends, j_wall, len(j_admitted)
 
 
+def soak_bench(
+    rng,
+    wall_budget_s=20.0,
+    windows=4,
+    rate_per_s=300.0,
+    n_cq=8,
+    quota_cpu=128,
+    dt_s=0.1,
+    checkpoint_every_s=2.0,
+    anchor_every=8,
+    segment_max_bytes=256 * 1024,
+    scale_live=(10_000, 100_000),
+    scale_touch=64,
+):
+    """Sustained-operation soak (the million-workload state plane's
+    acceptance harness): Poisson arrival + completion churn through the
+    full durable stack — WriteGateway ingest, WAL journal, periodic
+    DELTA checkpoints (storage/checkpoint.DeltaCheckpointer) whose
+    commits compact the journal, and a journal-tailing replica runtime
+    (JournalTailer over LocalTailSource) — under a FakeClock so the
+    simulated timeline is deterministic while wall time bounds the run.
+
+    The run is sliced into ``windows`` equal wall-time windows and each
+    window captures the signals that must stay FLAT for indefinite
+    operation: process RSS, journal bytes/segments (checkpoint-driven
+    compaction must reclaim), delta-checkpoint duration (O(changed),
+    not O(live)), live object count, replica cursor lag, and the PR-13
+    SLOTracker's admission-attainment verdict.
+
+    A separate scale proof pins the delta-checkpoint complexity claim:
+    the SAME ``scale_touch``-object churn is delta-checkpointed against
+    ``scale_live[0]`` and ``scale_live[1]`` live workloads; the
+    duration ratio must track the churn (≈1x), not the 10x live ratio.
+
+    Returns a dict of soak + scale results; the leader and replica
+    workload keysets are asserted convergent at the end.
+    """
+    import shutil
+    import tempfile
+    import time
+
+    from kueue_tpu import serialization as ser
+    from kueue_tpu.controllers import ClusterRuntime
+    from kueue_tpu.gateway import WriteGateway
+    from kueue_tpu.models import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        ResourceFlavor,
+        Workload,
+    )
+    from kueue_tpu.models.cluster_queue import ResourceGroup
+    from kueue_tpu.models.workload import PodSet
+    from kueue_tpu.server import KueueServer
+    from kueue_tpu.storage import DeltaCheckpointer, Journal
+    from kueue_tpu.storage import JournalTailer, LocalTailSource
+    from kueue_tpu.utils.clock import FakeClock
+
+    def rss_mb():
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return int(line.split()[1]) / 1024.0
+        except OSError:
+            pass
+        return 0.0
+
+    def build_rt(tmp, clock):
+        rt = ClusterRuntime(
+            clock=clock, use_solver=False, bulk_drain_threshold=None
+        )
+        journal = Journal(
+            os.path.join(tmp, "journal"),
+            fsync_policy="interval",
+            segment_max_bytes=segment_max_bytes,
+        ).open()
+        rt.attach_journal(journal)
+        rt.add_flavor(ResourceFlavor(name="default"))
+        for i in range(n_cq):
+            name = f"scq-{i}"
+            rt.add_cluster_queue(
+                ClusterQueue(
+                    name=name,
+                    namespace_selector={},
+                    resource_groups=(
+                        ResourceGroup(
+                            ("cpu",),
+                            (FlavorQuotas.build(
+                                "default", {"cpu": str(quota_cpu)}),),
+                        ),
+                    ),
+                )
+            )
+            rt.add_local_queue(
+                LocalQueue(
+                    namespace="soak", name=f"lq-{name}", cluster_queue=name
+                )
+            )
+        return rt, journal
+
+    def wl_dict(k, now):
+        return ser.workload_to_dict(
+            Workload(
+                namespace="soak", name=f"swl-{k}",
+                queue_name=f"lq-scq-{k % n_cq}",
+                priority=int(rng.integers(0, 4)) * 10,
+                creation_time=float(now),
+                pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+            )
+        )
+
+    # ---- churn phase ----
+    tmp = tempfile.mkdtemp(prefix="kueue-soak-")
+    results: dict = {}
+    try:
+        clock = FakeClock(0.0)
+        rt, journal = build_rt(tmp, clock)
+        state_dir = os.path.join(tmp, "state")
+        os.makedirs(state_dir, exist_ok=True)
+        ckpt = DeltaCheckpointer(
+            state_dir, anchor_every=anchor_every
+        ).open()
+        rt.checkpointer = ckpt
+        rt.slo.configure(default_target_s=30.0)
+        gateway = WriteGateway(
+            max_batch=4096, max_queue=65536, clock=clock
+        )
+        srv = KueueServer(
+            runtime=rt, auto_reconcile=True, gateway=gateway
+        )
+        # shared-volume replica: tails the journal incrementally and —
+        # when a checkpoint's compaction trims past its cursor — re-
+        # anchors from the DELTA CHAIN directory (the production
+        # design: "leader compaction forces a checkpoint re-anchor")
+        tailer = JournalTailer(
+            LocalTailSource(
+                os.path.join(tmp, "journal"),
+                state_path=state_dir,
+                now_fn=clock.now,
+            ),
+            now_fn=clock.now,
+        )
+        tailer.ensure_runtime()
+
+        lam = rate_per_s * dt_s
+        window_wall = wall_budget_s / max(1, windows)
+        window_stats = []
+        delta_ms_all = []
+        arrived = completed = 0
+        seq = 0
+        last_ckpt_sim = 0.0
+        journal_mb_peak = 0.0
+        segments_peak = 0
+        t_start = time.perf_counter()
+        for w in range(windows):
+            w_deadline = t_start + (w + 1) * window_wall
+            delta_ms_win = []
+            while time.perf_counter() < w_deadline:
+                now = clock.now()
+                for _ in range(int(rng.poisson(lam))):
+                    try:
+                        gateway._enqueue("workloads", wl_dict(seq, now))
+                        seq += 1
+                        arrived += 1
+                    except Exception:  # noqa: BLE001 — shed under burst
+                        pass
+                # completion churn: finished workloads leave the system
+                # entirely (quota release + object delete, both WAL'd)
+                with srv.lock:
+                    admitted = [
+                        wl for wl in rt.workloads.values() if wl.is_admitted
+                    ]
+                    n_done = min(len(admitted), int(rng.poisson(lam)))
+                    for i in rng.permutation(len(admitted))[:n_done]:
+                        # delete releases the quota reservation and
+                        # WALs the tombstone — the full object
+                        # lifecycle the retention bounds must survive
+                        rt.delete_workload(admitted[int(i)])
+                        completed += 1
+                clock.advance(dt_s)
+                gateway.flush_once()
+                if clock.now() - last_ckpt_sim >= checkpoint_every_s:
+                    last_ckpt_sim = clock.now()
+                    with srv.lock:
+                        prep = ckpt.prepare(rt)
+                    if ckpt.commit(prep) and ckpt.last_kind == "delta":
+                        delta_ms_win.append(ckpt.last_duration_s * 1e3)
+                # the leader's interval fsync would land within one
+                # poll period of real time; the tick IS that period
+                journal.sync()
+                tailer.poll_once()
+                st = journal.stats()
+                journal_mb_peak = max(journal_mb_peak, st.bytes / 2**20)
+                segments_peak = max(segments_peak, st.segments)
+            st = journal.stats()
+            rt.slo.refresh()
+            slo_rep = rt.slo.report()
+            delta_ms_all.extend(delta_ms_win)
+            window_stats.append({
+                "rss_mb": round(rss_mb(), 1),
+                "journal_mb": round(st.bytes / 2**20, 3),
+                "journal_segments": st.segments,
+                "reclaimed_mb": round(st.reclaimed_bytes / 2**20, 3),
+                "live": len(rt.workloads),
+                "replica_lag_records": st.last_seq - tailer.applied_seq,
+                "replica_resyncs": tailer.resyncs,
+                "ckpt_delta_p95_ms": round(
+                    _p(delta_ms_win, 95), 3) if delta_ms_win else None,
+                "slo_attainment_min": min(
+                    (e["attainment"] for e in slo_rep["clusterQueues"]),
+                    default=1.0,
+                ),
+                "slo_degraded": slo_rep["degraded"],
+            })
+        # final convergence check: flush + checkpoint + catch the
+        # replica up, then the two runtimes must hold the same objects
+        gateway.flush_once()
+        ckpt.checkpoint(rt)
+        journal.sync()
+        for _ in range(64):
+            tailer.poll_once()
+            if tailer.applied_seq >= journal.last_seq:
+                break
+        leader_keys = set(rt.workloads)
+        with tailer.lock:
+            replica_keys = set(tailer.runtime.workloads)
+        assert leader_keys == replica_keys, (
+            f"replica diverged: {len(leader_keys ^ replica_keys)} keys"
+        )
+        journal.close()
+        results.update({
+            "windows": window_stats,
+            "arrived": arrived,
+            "completed": completed,
+            "rss_mb_first": window_stats[0]["rss_mb"],
+            "rss_mb_last": window_stats[-1]["rss_mb"],
+            "journal_mb_peak": round(journal_mb_peak, 3),
+            "journal_segments_peak": segments_peak,
+            "reclaimed_mb": window_stats[-1]["reclaimed_mb"],
+            "ckpt_delta_p95_ms": round(
+                _p(delta_ms_all, 95), 3) if delta_ms_all else None,
+            "replica_converged": True,
+            "chain_files": ckpt.status()["chainFiles"],
+        })
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # ---- scale proof: delta cost tracks churn, not live count ----
+    scale = []
+    for n_live in scale_live:
+        tmp = tempfile.mkdtemp(prefix="kueue-soak-scale-")
+        try:
+            clock = FakeClock(0.0)
+            rt, journal = build_rt(tmp, clock)
+            state_dir = os.path.join(tmp, "state")
+            os.makedirs(state_dir, exist_ok=True)
+            ckpt = DeltaCheckpointer(state_dir, anchor_every=1 << 30).open()
+            for k in range(n_live):
+                rt.add_workload(
+                    Workload(
+                        namespace="soak", name=f"lwl-{k}",
+                        queue_name=f"lq-scq-{k % n_cq}",
+                        priority=0, creation_time=float(k),
+                        pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+                    )
+                )
+            ckpt.checkpoint(rt)  # the anchor: O(live), once
+            anchor_s = ckpt.last_duration_s
+            # the same small churn at every scale
+            import dataclasses
+
+            for k in range(scale_touch):
+                wl = rt.workloads[f"soak/lwl-{k}"]
+                rt.add_workload(dataclasses.replace(wl, priority=50))
+            ckpt.checkpoint(rt)
+            assert ckpt.last_kind == "delta", ckpt.status()
+            scale.append({
+                "live": n_live,
+                "anchor_ms": round(anchor_s * 1e3, 3),
+                "delta_ms": round(ckpt.last_duration_s * 1e3, 3),
+                "delta_objects": ckpt.last_objects,
+            })
+            journal.close()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    small, large = scale[0], scale[-1]
+    results["scale"] = scale
+    results["scale_ratio_delta"] = round(
+        large["delta_ms"] / max(small["delta_ms"], 1e-6), 2
+    )
+    results["scale_ratio_anchor"] = round(
+        large["anchor_ms"] / max(small["anchor_ms"], 1e-6), 2
+    )
+    results["scale_ratio_live"] = round(
+        large["live"] / max(small["live"], 1), 2
+    )
+    return results
+
+
+def _p(values, q):
+    """Percentile without numpy dependence on call sites (values may
+    be a plain list)."""
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = min(len(vs) - 1, int(round((q / 100.0) * (len(vs) - 1))))
+    return float(vs[idx])
+
+
 def failover_bench(rng, n_cq=16, wl_per_phase=256, k_div=16):
     """Self-healing hot path (core/guard.py): steady-state cycle
     latency vs. cycle latency during an injected device outage
@@ -2989,6 +3299,54 @@ def _stage_journal() -> dict:
     }
 
 
+def _stage_soak() -> dict:
+    wall_s = float(os.environ.get("KUEUE_BENCH_SOAK_S", "20"))
+    live = tuple(
+        int(x)
+        for x in os.environ.get(
+            "KUEUE_BENCH_SOAK_LIVE", "10000,100000"
+        ).split(",")
+    )
+    r = soak_bench(
+        np.random.default_rng(19), wall_budget_s=wall_s, scale_live=live
+    )
+    w0, wN = r["windows"][0], r["windows"][-1]
+    return {
+        "soak_metric": (
+            "soak_delta_checkpoint_latency (Poisson arrival+completion "
+            f"churn through gateway+journal+replica for {wall_s:.0f}s "
+            f"wall across {len(r['windows'])} windows; "
+            f"{r['arrived']} arrived, {r['completed']} completed, "
+            "RSS/journal/checkpoint-duration flat, replica convergent; "
+            f"scale proof {live[0]} vs {live[-1]} live)"
+        ),
+        "soak_value": r["ckpt_delta_p95_ms"],
+        "soak_unit": "ms (delta checkpoint p95 under churn)",
+        "soak_windows": r["windows"],
+        "soak_rss_mb_first": r["rss_mb_first"],
+        "soak_rss_mb_last": r["rss_mb_last"],
+        "soak_journal_mb_peak": r["journal_mb_peak"],
+        "soak_journal_segments_peak": r["journal_segments_peak"],
+        "soak_reclaimed_mb": r["reclaimed_mb"],
+        "soak_ckpt_delta_p95_ms": r["ckpt_delta_p95_ms"],
+        "soak_live_last": wN["live"],
+        "soak_replica_lag_last": wN["replica_lag_records"],
+        "soak_slo_attainment_min": min(
+            w["slo_attainment_min"] for w in r["windows"]
+        ),
+        "soak_slo_degraded": any(
+            w["slo_degraded"] for w in r["windows"]
+        ),
+        "soak_rss_growth_pct": round(
+            (wN["rss_mb"] / w0["rss_mb"] - 1.0) * 100
+            if w0["rss_mb"] else 0.0, 1,
+        ),
+        "soak_scale": r["scale"],
+        "soak_ckpt_scale_ratio": r["scale_ratio_delta"],
+        "soak_scale_ratio_live": r["scale_ratio_live"],
+    }
+
+
 def _stage_trace() -> dict:
     off_s, on_s, overhead_pct, n_spans, admitted = trace_bench(
         np.random.default_rng(11)
@@ -3232,6 +3590,7 @@ STAGES = {
     "interactive": _stage_interactive,
     "planner": _stage_planner,
     "journal": _stage_journal,
+    "soak": _stage_soak,
     "failover": _stage_failover,
     "federation": _stage_federation,
     "federation_churn": _stage_federation_churn,
@@ -3253,6 +3612,7 @@ HEADLINE_FALLBACK_STAGES = (
     "policy",
     "planner",
     "journal",
+    "soak",
     "failover",
     "pipeline",
     "megaloop",
@@ -3268,6 +3628,10 @@ HEADLINE_FALLBACK_STAGES = (
 COMPACT_EXTRAS = (
     ("planner_scenarios_per_s", "scenarios_per_s"),
     ("journal_appends_per_s", "appends_per_s"),
+    ("soak_rss_mb_last", "rss_mb"),
+    ("soak_journal_mb_peak", "journal_mb"),
+    ("soak_ckpt_delta_p95_ms", "ckpt_p95_ms"),
+    ("soak_ckpt_scale_ratio", "ckpt_scale_ratio"),
     ("failover_divergence_overhead_pct", "divergence_overhead_pct"),
     ("federation_admissions_per_s", "admissions_per_s"),
     ("federation_dispatches_per_s", "dispatches_per_s"),
@@ -3295,6 +3659,7 @@ COMPACT_EXTRAS = (
 SINGLE_STAGE_MODES = {
     "--planner": ["planner"],
     "--journal": ["journal"],
+    "--soak": ["soak"],
     "--failover": ["failover"],
     "--pipeline": ["pipeline"],
     "--megaloop": ["megaloop"],
@@ -3565,6 +3930,14 @@ if __name__ == "__main__":
                             os.environ["KUEUE_BENCH_FED_WORKERS"] = (
                                 sys.argv[i + 1]
                             )
+                elif flag == "--soak":
+                    # `--soak N` sizes the churn wall budget (seconds);
+                    # propagated to the payload subprocess through the
+                    # environment (KUEUE_BENCH_SOAK_LIVE sizes the
+                    # scale proof's live counts)
+                    i = sys.argv.index(flag)
+                    if i + 1 < len(sys.argv) and sys.argv[i + 1].isdigit():
+                        os.environ["KUEUE_BENCH_SOAK_S"] = sys.argv[i + 1]
                 driver_main(stages)
                 break
         else:

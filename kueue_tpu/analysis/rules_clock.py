@@ -112,6 +112,16 @@ CLOCK_ALLOWLIST: Dict[str, str] = {
         "scheduled on; every schedule-relevant time in the dispatcher "
         "reads runtime.clock"
     ),
+    "kueue_tpu/storage/checkpoint.py::DeltaCheckpointer.prepare": (
+        "checkpoint wall-duration measurement feeding "
+        "kueue_checkpoint_duration_seconds: reported, never scheduled "
+        "on; the checkpoint cadence itself is the server loop's and "
+        "reads the injected clock"
+    ),
+    "kueue_tpu/storage/checkpoint.py::DeltaCheckpointer.commit": (
+        "second half of the prepare/commit duration measurement (see "
+        "DeltaCheckpointer.prepare) — reported, never scheduled on"
+    ),
     "kueue_tpu/federation/global_scheduler.py::GlobalScheduler.rescore": (
         "kernel wall-duration measurement feeding "
         "kueue_global_rescore_seconds: reported, never scheduled on; "

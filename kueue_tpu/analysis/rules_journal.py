@@ -11,11 +11,15 @@ contract into a registry diff that fails at lint time.
 Mechanics (all AST, cross-module):
 
 - **producers**: every ``*._journal_append(KIND, ...)`` /
-  ``*._journal(KIND, ...)`` call site, with KIND a string literal or a
-  module-level constant (``DISPATCH_RECORD = "federation_dispatch"``);
+  ``*._journal(KIND, ...)`` call site, with KIND a string literal, a
+  module-level constant (``DISPATCH_RECORD = "federation_dispatch"``),
+  or a constant imported from another scanned module (the delta
+  checkpointer appends ``CHECKPOINT_ANCHOR`` marks imported from the
+  recovery module — resolved through a cross-module constants map);
 - **handlers**: the record types ``apply_record`` dispatches on —
   ``rec.type == CONST`` comparisons and ``rec.type in TUPLE`` member-
-  ship tests, constants resolved within the defining module;
+  ship tests, constants resolved within the defining module first,
+  then against the cross-module map;
 - **tailer path**: some module other than the recovery module must
   call ``apply_record(...)`` (the tailer's ingest loop) — delete that
   wiring and replicas silently diverge from recovery.
@@ -66,9 +70,17 @@ def _resolve_kind(
 
 def _collect_producers(
     src: SourceFile,
+    global_consts: Optional[Dict[str, str]] = None,
 ) -> List[Tuple[str, int]]:
-    """(kind, line) for every journal-append call in ``src``."""
-    consts = module_str_constants(src.tree)
+    """(kind, line) for every journal-append call in ``src``.
+
+    ``global_consts`` is the union of module-level string constants
+    across every scanned module — the fallback that resolves kinds a
+    producer imports (``from ..recovery import CHECKPOINT_DELTA``)
+    rather than defines. Local definitions shadow it.
+    """
+    consts = dict(global_consts or {})
+    consts.update(module_str_constants(src.tree))
     out: List[Tuple[str, int]] = []
     for node in ast.walk(src.tree):
         if not isinstance(node, ast.Call):
@@ -91,6 +103,7 @@ def _collect_producers(
 
 def _collect_handlers(
     src: SourceFile,
+    global_consts: Optional[Dict[str, str]] = None,
 ) -> Optional[Dict[str, int]]:
     """kind -> dispatch line, from this module's ``apply_record`` (None
     when the module does not define one)."""
@@ -104,7 +117,8 @@ def _collect_handlers(
             break
     if apply_fn is None:
         return None
-    consts = module_str_constants(src.tree)
+    consts = dict(global_consts or {})
+    consts.update(module_str_constants(src.tree))
     tuples = module_str_tuples(src.tree)
     handled: Dict[str, int] = {}
     for node in ast.walk(apply_fn):
@@ -141,6 +155,13 @@ class JournalSymmetryRule(Rule):
     )
 
     def finalize(self, ctx: AnalysisContext) -> List[Finding]:
+        # cross-module constants map: a producer that imports its kind
+        # (checkpoint.py appending recovery.CHECKPOINT_ANCHOR marks)
+        # resolves through the defining module's literal
+        global_consts: Dict[str, str] = {}
+        for src in ctx.sources:
+            if src.tree is not None:
+                global_consts.update(module_str_constants(src.tree))
         producers: Dict[str, List[Tuple[str, int]]] = {}
         handlers: Dict[str, int] = {}
         handler_src: Optional[SourceFile] = None
@@ -148,9 +169,9 @@ class JournalSymmetryRule(Rule):
         for src in ctx.sources:
             if src.tree is None:
                 continue
-            for kind, line in _collect_producers(src):
+            for kind, line in _collect_producers(src, global_consts):
                 producers.setdefault(kind, []).append((src.rel, line))
-            h = _collect_handlers(src)
+            h = _collect_handlers(src, global_consts)
             if h is not None:
                 handlers.update(h)
                 handler_src = src

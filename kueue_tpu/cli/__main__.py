@@ -25,13 +25,30 @@ from kueue_tpu.resources import requests_from_spec
 class State:
     def __init__(self, path: str):
         self.path = path
-        if os.path.exists(path):
+        self.is_chain_dir = os.path.isdir(path)
+        if self.is_chain_dir:
+            # a delta-checkpoint chain directory (--state-dir leaders):
+            # readable as the merged anchor+deltas state
+            from kueue_tpu.storage.checkpoint import load_state_any
+
+            self.data = load_state_any(path) or ser.state_to_dict(
+                [], [], [], []
+            )
+        elif os.path.exists(path):
             with open(path) as f:
                 self.data = json.load(f)
         else:
             self.data = ser.state_to_dict([], [], [], [])
 
     def save(self) -> None:
+        if self.is_chain_dir:
+            # offline edits behind a delta chain would be silently
+            # overwritten by the next checkpoint — refuse
+            raise SystemExit(
+                "error: state path is a delta-checkpoint chain "
+                "directory (read-only from the CLI); apply changes "
+                "through the running server"
+            )
         with open(self.path, "w") as f:
             json.dump(self.data, f, indent=1, sort_keys=True)
 
@@ -1145,7 +1162,39 @@ def cmd_state_verify(state, args) -> None:
     failures: List[str] = []
     ckpt_data = None
     ckpt = args.state
-    if os.path.exists(ckpt):
+    if os.path.isdir(ckpt):
+        # delta-checkpoint chain directory (server --state-dir): walk
+        # the anchor + delta chain file-by-file, then load it the same
+        # way recovery would
+        from kueue_tpu.storage import load_checkpoint_chain, verify_checkpoint_chain
+        from kueue_tpu.storage.checkpoint import parse_chain_name
+
+        info = verify_checkpoint_chain(ckpt)
+        for name in info.files:
+            kind, base, js = parse_chain_name(name)
+            if kind == "full":
+                print(f"chain {name}: anchor, journalSeq={js}: OK")
+            else:
+                print(f"chain {name}: delta, baseSeq={base} "
+                      f"journalSeq={js}: OK")
+        for name in info.orphans:
+            print(f"chain {name}: ORPHAN (not linked from the newest "
+                  "anchor; stale or mid-GC)")
+        for err in info.errors:
+            print(f"chain: {err}")
+        failures.extend(info.errors)
+        if info.files:
+            ckpt_data, _ = load_checkpoint_chain(ckpt)
+            print(
+                f"checkpoint chain {ckpt}: "
+                f"{'OK' if info.ok else 'BROKEN'} "
+                f"({len(info.files)} files, "
+                f"journalSeq={info.journal_seq} "
+                f"resourceVersion={info.resource_version})"
+            )
+        else:
+            print(f"checkpoint chain {ckpt}: empty")
+    elif os.path.exists(ckpt):
         try:
             with open(ckpt) as f:
                 ckpt_data = json.load(f)

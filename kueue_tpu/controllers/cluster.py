@@ -454,6 +454,14 @@ class ClusterRuntime:
         journal.tracer = self.tracer  # fsync spans on the cycle tree
         journal.clock = self.clock  # record ts rides the replica feed
         self.journal = journal
+        # delta-checkpoint dirty-set (storage/checkpoint.py): every
+        # mutation funneling through _journal_append marks the object
+        # it touched. Fresh on every attach — mutations applied before
+        # this point (recovery replay) were never noted, and the
+        # tracker is born full-dirty for exactly that reason.
+        from kueue_tpu.storage.checkpoint import DeltaTracker
+
+        self.delta_dirty = DeltaTracker()
         self.metrics.journal_degraded.set(1 if journal.degraded else 0)
         self.metrics.journal_segments.set(journal.stats().segments)
 
@@ -463,6 +471,12 @@ class ClusterRuntime:
             return
         self.resource_version += 1
         rec = j.append(rtype, data, rv=self.resource_version)
+        tracker = getattr(self, "delta_dirty", None)
+        if tracker is not None:
+            # note UNCONDITIONALLY — even when the append was dropped
+            # (degraded journal): the in-memory mutation still happens,
+            # and checkpoint-only durability must cover it
+            tracker.note(rtype, data)
         if j.degraded != self._journal_degraded_seen:
             # flip (either direction) is an operator-visible transition:
             # event + gauge; /healthz reads the journal stats directly

@@ -85,6 +85,50 @@ def fenced_checkpoint(srv, state_path: str) -> bool:
             return _write_if_newest()
 
 
+def fenced_delta_checkpoint(srv) -> bool:
+    """``fenced_checkpoint`` for the delta-chain shape (--state-dir):
+    same two-phase choreography — serialize under the server lock
+    (``DeltaCheckpointer.prepare``: journal mark + O(changed) delta or
+    periodic full anchor), then the durable write + compaction + chain
+    GC (``commit``) inside the lease's critical section only while the
+    on-disk record still names us with the snapshot's token. The
+    process-local ``_ckpt_seq``/``_ckpt_written`` ordering holds too: a
+    stalled periodic prepare can never commit over a newer shutdown
+    one (its marks were never cleared, so nothing is lost by the
+    abandon)."""
+    ckpt = getattr(srv.runtime, "checkpointer", None)
+    if ckpt is None:
+        return False
+    with srv.lock:
+        snap_token = srv.elector.lease.token if srv.elector else None
+        prep = ckpt.prepare(srv.runtime, token=snap_token)
+        srv._ckpt_seq += 1
+        seq = srv._ckpt_seq
+
+    def _write_if_newest() -> bool:
+        if seq <= srv._ckpt_written:
+            ckpt.abandon(prep)
+            return False  # a newer snapshot already landed
+        ok = ckpt.commit(prep)
+        if ok:
+            srv._ckpt_written = seq
+        return ok
+
+    if srv.elector is None:
+        with srv._ckpt_write_lock:
+            return _write_if_newest()
+    lease = srv.elector.lease
+    with lease._locked():
+        if not lease.is_held() or lease.token != snap_token:
+            # deposed since the snapshot was taken: the snapshot is
+            # stale (and its dirty marks survive for the next leader
+            # tenure's checkpoint)
+            ckpt.abandon(prep)
+            return False
+        with srv._ckpt_write_lock:
+            return _write_if_newest()
+
+
 def promote_reload(srv, state_path: str, build_runtime,
                    run_reconcile: bool = True,
                    require_standby: bool = False,
@@ -124,11 +168,17 @@ def promote_reload(srv, state_path: str, build_runtime,
             journal.close()
             return False
     else:
+        from kueue_tpu.storage import load_state_any
+
         if not (state_path and os.path.exists(state_path)):
             return False
+        # load_state_any reads both checkpoint shapes: a full-dump FILE
+        # or a delta-chain DIRECTORY (--state-dir)
+        data = load_state_any(state_path)
+        if data is None:
+            return False
         fresh = build_runtime()
-        with open(state_path) as f:
-            ser.runtime_from_state(json.load(f), runtime=fresh)
+        ser.runtime_from_state(data, runtime=fresh)
     with srv.lock:
         if require_standby and srv.elector is not None and srv.elector.is_leader:
             return False
@@ -186,6 +236,28 @@ def main(argv=None) -> int:
         "--journal-segment-bytes", type=int, default=8 * 1024 * 1024,
         help="rotate journal segments at this size; checkpoints delete "
         "fully-covered segments (compaction)",
+    )
+    parser.add_argument(
+        "--state-dir",
+        help="directory for DELTA checkpoints (requires --journal, "
+        "replaces --state): periodic checkpoints record only objects "
+        "changed since the previous one, chained back to a full "
+        "anchor every --checkpoint-anchor-every checkpoints — "
+        "compaction cost is O(changed) instead of O(live workloads). "
+        "Recovery loads anchor + delta chain + journal suffix; "
+        "`kueuectl state verify` walks the chain — see deploy/README "
+        "'Sustained operation'",
+    )
+    parser.add_argument(
+        "--checkpoint-anchor-every", type=int, default=16,
+        help="write a full anchor checkpoint after this many deltas "
+        "(bounds chain length and recovery walk; --state-dir only)",
+    )
+    parser.add_argument(
+        "--checkpoint-retain", type=int, default=1,
+        help="checkpoint chains (anchor + its deltas) to keep on disk; "
+        "older chains are garbage-collected after each successful "
+        "checkpoint (--state-dir only)",
     )
     parser.add_argument(
         "--no-solver", action="store_true",
@@ -489,6 +561,11 @@ def main(argv=None) -> int:
         "--host + localhost + 127.0.0.1)",
     )
     args = parser.parse_args(argv)
+    if args.state_dir and not args.journal:
+        parser.error("--state-dir requires --journal (deltas chain over "
+                     "the journal's sequence numbers)")
+    if args.state_dir and args.state:
+        parser.error("--state-dir and --state are mutually exclusive")
     if bool(args.tls_cert) != bool(args.tls_key):
         parser.error("--tls-cert and --tls-key must be given together")
     if args.tls_cert_dir and args.tls_cert:
@@ -503,6 +580,7 @@ def main(argv=None) -> int:
         for flag, val in (
             ("--journal", args.journal),
             ("--state", args.state),
+            ("--state-dir", args.state_dir),
             ("--leader-elect-lease", args.leader_elect_lease),
             ("--federation-worker", args.federation_worker),
             ("--gateway", args.gateway if args.gateway == "on" else None),
@@ -616,8 +694,12 @@ def main(argv=None) -> int:
         "fsync_interval_s": args.journal_fsync_interval,
         "segment_max_bytes": args.journal_segment_bytes,
     }
+    # the durable-state anchor this process recovers from and
+    # checkpoints to: a delta-chain directory or the classic full dump
+    state_ref = args.state_dir or args.state
     runtime = build_runtime()
     journal = None
+    checkpointer = None
     if args.journal:
         from kueue_tpu.storage import recover
 
@@ -625,11 +707,19 @@ def main(argv=None) -> int:
         # (torn tail truncated, stale fencing tokens refused), then the
         # invariant check — a violating state must not serve
         res = recover(
-            args.state, args.journal, runtime=runtime, strict=True,
+            state_ref, args.journal, runtime=runtime, strict=True,
             **journal_opts,
         )
         journal = res.journal
         print(f"journal recovery: {res.summary()}", flush=True)
+        if args.state_dir:
+            from kueue_tpu.storage import DeltaCheckpointer
+
+            checkpointer = DeltaCheckpointer(
+                args.state_dir,
+                anchor_every=args.checkpoint_anchor_every,
+                retain_chains=args.checkpoint_retain,
+            ).open()
     elif args.state and os.path.exists(args.state):
         with open(args.state) as f:
             ser.runtime_from_state(json.load(f), runtime=runtime)
@@ -645,6 +735,8 @@ def main(argv=None) -> int:
     ha = {"last_token": None, "boot": True}
 
     def checkpoint() -> bool:
+        if args.state_dir:
+            return fenced_delta_checkpoint(srv)
         if not args.state:
             return True
         return fenced_checkpoint(srv, args.state)
@@ -661,10 +753,14 @@ def main(argv=None) -> int:
         # non-leader and the NEXT promotion attempt must not classify
         # itself as a resume and skip the reload — that would lead with
         # the stale pre-takeover runtime.
-        reloaded = (args.state or args.journal) and promote_reload(
-            srv, args.state, build_runtime,
+        reloaded = (state_ref or args.journal) and promote_reload(
+            srv, state_ref, build_runtime,
             journal_path=args.journal or "", journal_opts=journal_opts,
         )
+        if reloaded and checkpointer is not None:
+            # the fresh runtime journals into a fresh tracker; the
+            # chain head on disk is still ours to extend
+            srv.runtime.checkpointer = checkpointer
         ha["last_token"] = tok
         if reloaded:
             print(
@@ -698,6 +794,15 @@ def main(argv=None) -> int:
             (lambda: elector.lease.token) if elector is not None else None
         )
         runtime.attach_journal(journal)
+        if checkpointer is not None:
+            runtime.checkpointer = checkpointer
+            print(
+                "delta checkpoints: chain dir "
+                f"{args.state_dir} (anchor every "
+                f"{args.checkpoint_anchor_every} deltas, retaining "
+                f"{args.checkpoint_retain} chain(s))",
+                flush=True,
+            )
     if args.elastic == "on":
         # elastic capacity plane: built AFTER journal attach/recovery
         # so grants journal durably and the plane adopts any
@@ -910,7 +1015,7 @@ def main(argv=None) -> int:
         threading.Thread(target=_reconcile_loop, daemon=True).start()
 
     ckpt_thread = None
-    if args.state and args.state_checkpoint_period > 0:
+    if state_ref and args.state_checkpoint_period > 0:
         # Periodic leader checkpoints bound the data lost to a SIGKILL
         # (and are what a promoted standby reloads). Standbys never
         # checkpoint — on a shared state volume that would clobber the
@@ -920,8 +1025,10 @@ def main(argv=None) -> int:
         # boot-time state forever.
         # start from the checkpoint main() already loaded: the first
         # standby iteration must not rebuild identical state
+        # (a chain DIRECTORY's mtime moves when a checkpoint file lands
+        # or is GC'd, so the standby refresh check works for both)
         reloaded_mtime = [
-            os.path.getmtime(args.state) if os.path.exists(args.state) else 0.0
+            os.path.getmtime(state_ref) if os.path.exists(state_ref) else 0.0
         ]
 
         def _ckpt_loop():
@@ -929,10 +1036,10 @@ def main(argv=None) -> int:
                 try:
                     if elector is None or elector.is_leader:
                         checkpoint()
-                    elif os.path.exists(args.state):
-                        mtime = os.path.getmtime(args.state)
+                    elif os.path.exists(state_ref):
+                        mtime = os.path.getmtime(state_ref)
                         if mtime > reloaded_mtime[0]:
-                            promote_reload(srv, args.state, build_runtime,
+                            promote_reload(srv, state_ref, build_runtime,
                                            run_reconcile=False,
                                            require_standby=True)
                             reloaded_mtime[0] = mtime
@@ -963,9 +1070,9 @@ def main(argv=None) -> int:
     live_journal = getattr(srv.runtime, "journal", None)
     if live_journal is not None:
         live_journal.close()  # final fsync of any unsynced tail
-    if args.state and was_leader:
+    if state_ref and was_leader:
         if final["saved"]:
-            print(f"state saved to {args.state}", flush=True)
+            print(f"state saved to {state_ref}", flush=True)
         else:
             # the fence refused the write: the lease lapsed during
             # drain and another replica owns the state file now

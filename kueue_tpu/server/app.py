@@ -961,11 +961,25 @@ def _make_handler(srv: KueueServer):
                     "mode": "degraded" if st.degraded else "journaling",
                     "journalSegments": st.segments,
                     "journalBytes": st.bytes,
+                    "journalReclaimedBytes": st.reclaimed_bytes,
                     "lastSeq": st.last_seq,
                     "droppedAppends": st.dropped_appends,
                     "lastError": st.last_error,
                     "lastFsyncAgeS": st.last_fsync_age_s,
                 }
+            # delta-checkpoint chain posture (storage/checkpoint.py):
+            # same convention — a failing chain write (ENOSPC on the
+            # state volume) flips "degraded" while the probe stays 200
+            # (the previous chain is still valid; the operator pages
+            # on kueue_checkpoint_degraded / this detail), and the
+            # next successful checkpoint self-heals it
+            ckpt = getattr(srv.runtime, "checkpointer", None)
+            if ckpt is not None:
+                detail = ckpt.status()
+                body.setdefault("persistence", {})["checkpoint"] = detail
+                if detail["degraded"]:
+                    body["status"] = "degraded"
+                    body["persistence"]["mode"] = "degraded"
             # solver-path detail (core/guard.py): same journal-degraded
             # convention — an open/quarantined device circuit or any
             # quarantined workload flips "degraded" while the probe

@@ -18,7 +18,18 @@ from kueue_tpu.storage.journal import (  # noqa: F401
     SegmentReport,
     scan_segment,
 )
+from kueue_tpu.storage.checkpoint import (  # noqa: F401
+    ChainInfo,
+    DeltaCheckpointer,
+    DeltaTracker,
+    load_checkpoint_chain,
+    load_state_any,
+    merge_delta,
+    verify_checkpoint_chain,
+)
 from kueue_tpu.storage.recovery import (  # noqa: F401
+    CHECKPOINT_ANCHOR,
+    CHECKPOINT_DELTA,
     RecoveryError,
     RecoveryResult,
     recover,
@@ -38,6 +49,15 @@ __all__ = [
     "JournalRecord",
     "SegmentReport",
     "scan_segment",
+    "CHECKPOINT_ANCHOR",
+    "CHECKPOINT_DELTA",
+    "ChainInfo",
+    "DeltaCheckpointer",
+    "DeltaTracker",
+    "load_checkpoint_chain",
+    "load_state_any",
+    "merge_delta",
+    "verify_checkpoint_chain",
     "RecoveryError",
     "RecoveryResult",
     "recover",

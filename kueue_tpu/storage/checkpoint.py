@@ -1,0 +1,738 @@
+"""Delta checkpoints: O(changed) durable compaction for the journal.
+
+The PR-4 fenced checkpoint serializes EVERY live object on every
+period — a million-workload control plane would spend its whole
+checkpoint budget re-writing state that did not change. This module
+applies the ResidentEncoder delta-scatter idea to durable state:
+between periodic FULL anchors, each checkpoint records only the
+objects mutated since the previous one, chained by
+``(baseSeq, journalSeq)`` back to the anchor:
+
+  anchor-000000000042.ckpt          full runtime_to_state dump
+  delta-000000000042-000000000057.ckpt   changed/removed since seq 42
+  delta-000000000057-000000000071.ckpt   changed/removed since seq 57
+
+Recovery (``storage/recovery.recover`` with a DIRECTORY state path)
+loads the newest anchor, folds each delta in chain order, then replays
+the journal suffix — and must produce byte-identical state to a
+full-dump recovery. The merge preserves the leader's dict insertion
+order exactly because it mirrors dict semantics: tombstoned keys are
+removed first (a deleted-then-recreated object moves to the end, like
+``del d[k]; d[k] = v``), then each changed object replaces in place
+when present and appends when new.
+
+Failure model mirrors the journal's: a failed chain write (ENOSPC on
+the state volume) leaves the PREVIOUS chain valid and untouched —
+``atomic_write_text`` never renames a torn file — flips ``degraded``
+on the checkpointer, and self-heals on the next successful commit.
+The dirty-set is never lost to a failed write: marks are cleared only
+after the file durably lands (generation-bounded, so mutations racing
+a commit survive it).
+
+Each checkpoint also appends a ``checkpoint_anchor``/``checkpoint_delta``
+mark to the journal BEFORE serializing, so the mark's own seq is
+covered by the checkpoint that follows it: replicas and recovery see
+(and skip past) the mark instead of replaying forever behind it, and
+the kueuelint journal-symmetry registry covers the new vocabulary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu.storage.recovery import (
+    CHECKPOINT_ANCHOR,
+    CHECKPOINT_DELTA,
+)
+
+_ANCHOR_PREFIX = "anchor-"
+_DELTA_PREFIX = "delta-"
+_SUFFIX = ".ckpt"
+
+# journal object_upsert/object_delete sections (lowercase wire names)
+# -> the state-dump section key the object lives in. A journal section
+# this map does not know forces the next checkpoint to a full anchor —
+# a newer binary's vocabulary must degrade to correctness, not drop
+# changes from the delta.
+_JOURNAL_TO_STATE = {
+    "resourceflavors": "resourceFlavors",
+    "clusterqueues": "clusterQueues",
+    "localqueues": "localQueues",
+    "cohorts": "cohorts",
+    "admissionchecks": "admissionChecks",
+    "topologies": "topologies",
+    "workloadpriorityclasses": "workloadPriorityClasses",
+    "nodes": "nodes",
+    "limitranges": "limitRanges",
+    "runtimeclasses": "runtimeClasses",
+}
+
+# record kinds that never appear in the state dump (federation/solver
+# state is owned by the dispatcher's own records; checkpoint marks are
+# advisory) — a delta need not carry anything for them
+_NON_STATE_TYPES = frozenset({
+    "federation_dispatch", "federation_winner",
+    "federation_retract_enqueue", "federation_retract_done",
+    "solver_verdict",
+    CHECKPOINT_ANCHOR, CHECKPOINT_DELTA,
+})
+
+
+def _obj_key(obj: dict) -> str:
+    """Identity of a serialized object, matching the runtime's dict
+    keys: ``ns/name`` for namespaced kinds (workloads, localQueues,
+    limitRanges), bare ``name`` otherwise."""
+    ns = obj.get("namespace")
+    name = obj.get("name", "")
+    return f"{ns}/{name}" if ns is not None else name
+
+
+def anchor_name(journal_seq: int) -> str:
+    return f"{_ANCHOR_PREFIX}{journal_seq:012d}{_SUFFIX}"
+
+
+def delta_name(base_seq: int, journal_seq: int) -> str:
+    return f"{_DELTA_PREFIX}{base_seq:012d}-{journal_seq:012d}{_SUFFIX}"
+
+
+def parse_chain_name(name: str) -> Optional[Tuple[str, int, int]]:
+    """(kind, baseSeq, journalSeq) from a chain file name, or None for
+    foreign files (tmp files from in-flight writes, stray dotfiles)."""
+    if not name.endswith(_SUFFIX):
+        return None
+    stem = name[: -len(_SUFFIX)]
+    try:
+        if stem.startswith(_ANCHOR_PREFIX):
+            seq = int(stem[len(_ANCHOR_PREFIX):])
+            return ("full", seq, seq)
+        if stem.startswith(_DELTA_PREFIX):
+            base_s, _, js_s = stem[len(_DELTA_PREFIX):].partition("-")
+            return ("delta", int(base_s), int(js_s))
+    except ValueError:
+        return None
+    return None
+
+
+def _list_chain(path: str) -> List[Tuple[str, str, int, int]]:
+    """Sorted (kind, base, js, name) for every chain file on disk."""
+    try:
+        names = os.listdir(path)
+    except FileNotFoundError:
+        return []
+    out = []
+    for name in names:
+        parsed = parse_chain_name(name)
+        if parsed is not None:
+            kind, base, js = parsed
+            out.append((kind, base, js, name))
+    # commit order: strictly increasing journalSeq, anchors before the
+    # deltas that chain off them when seqs tie (degraded-journal edge)
+    out.sort(key=lambda e: (e[2], e[0] != "full", e[1]))
+    return out
+
+
+# ---- the dirty-set ----
+@dataclass
+class ChangeSet:
+    """One prepare()'s view of the tracker: everything dirtied up to
+    generation ``gen``. Cleared from the tracker only when the file
+    durably lands — marks re-noted after the snapshot carry a higher
+    generation and survive the clear."""
+
+    gen: int
+    need_full: bool
+    changed: Dict[str, List[str]] = field(default_factory=dict)
+    removed: Dict[str, List[str]] = field(default_factory=dict)
+    policy_dirty: bool = False
+    quarantine_dirty: bool = False
+
+    @property
+    def empty(self) -> bool:
+        return not (
+            self.changed or self.removed or self.policy_dirty
+            or self.quarantine_dirty or self.need_full
+        )
+
+
+class DeltaTracker:
+    """Accumulates which state-dump objects changed since the last
+    committed checkpoint. Fed by ``ClusterRuntime._journal_append`` for
+    EVERY mutation (including ones the journal dropped while degraded —
+    the in-memory mutation happened and checkpoint-only durability must
+    still cover it). Starts with ``full`` pending: mutations applied
+    before the tracker existed (recovery replay, pre-attach setup) were
+    never noted, so the first checkpoint must be an anchor."""
+
+    def __init__(self):
+        self.gen = 1
+        self._full_gen: Optional[int] = 0  # dirty from birth
+        self._changed: Dict[Tuple[str, str], int] = {}
+        self._removed: Dict[Tuple[str, str], int] = {}
+        self._policy_gen: Optional[int] = None
+        self._quarantine_gen: Optional[int] = None
+
+    def clean(self) -> bool:
+        return (
+            not self._changed and not self._removed
+            and self._full_gen is None
+            and self._policy_gen is None
+            and self._quarantine_gen is None
+        )
+
+    def note_full(self) -> None:
+        self._full_gen = self.gen
+
+    def _mark(self, section: str, key: str) -> None:
+        self._changed[(section, key)] = self.gen
+        # NOT clearing a tombstone here: the base checkpoint may still
+        # hold the old copy at its old position — the merge must remove
+        # it first so the re-added object lands at the end, exactly
+        # like dict delete + re-add
+
+    def _tombstone(self, section: str, key: str) -> None:
+        self._removed[(section, key)] = self.gen
+        self._changed.pop((section, key), None)
+
+    def note(self, rtype: str, data: dict) -> None:
+        """Record one journal append's state impact."""
+        if rtype == "workload_upsert":
+            self._mark("workloads", _obj_key(data))
+        elif rtype == "workload_delete":
+            self._tombstone("workloads", data.get("key", ""))
+        elif rtype == "object_upsert":
+            section = _JOURNAL_TO_STATE.get(data.get("section", ""))
+            if section is None:
+                self.note_full()
+            else:
+                self._mark(section, _obj_key(data.get("object", {})))
+        elif rtype == "object_delete":
+            section = _JOURNAL_TO_STATE.get(data.get("section", ""))
+            if section is None:
+                self.note_full()
+            else:
+                self._tombstone(section, data.get("key", ""))
+        elif rtype in ("quarantine_set", "quarantine_clear"):
+            self._quarantine_gen = self.gen
+        elif rtype == "policy_config":
+            self._policy_gen = self.gen
+        elif rtype in ("elastic_grant", "elastic_revoke"):
+            # post-state flavor-quota mutation on one ClusterQueue
+            cq = data.get("clusterQueue")
+            if cq:
+                self._mark("clusterQueues", cq)
+            else:
+                self.note_full()
+        elif rtype in _NON_STATE_TYPES:
+            pass  # not part of the state dump
+        else:
+            # unknown vocabulary: the safe answer is a full anchor
+            self.note_full()
+
+    def snapshot(self) -> ChangeSet:
+        """Everything dirty so far; later notes get a new generation."""
+        g = self.gen
+        self.gen += 1
+        cs = ChangeSet(gen=g, need_full=self._full_gen is not None)
+        for (section, key) in self._changed:
+            cs.changed.setdefault(section, []).append(key)
+        for (section, key) in self._removed:
+            cs.removed.setdefault(section, []).append(key)
+        cs.policy_dirty = self._policy_gen is not None
+        cs.quarantine_dirty = self._quarantine_gen is not None
+        return cs
+
+    def clear(self, cs: ChangeSet, full: bool) -> None:
+        """The checkpoint serialized from ``cs`` is durable: drop every
+        mark at or below its generation. Marks noted since keep their
+        higher generation and roll into the next delta."""
+        for d in (self._changed, self._removed):
+            for k in [k for k, g in d.items() if g <= cs.gen]:
+                del d[k]
+        if self._policy_gen is not None and self._policy_gen <= cs.gen:
+            self._policy_gen = None
+        if self._quarantine_gen is not None and self._quarantine_gen <= cs.gen:
+            self._quarantine_gen = None
+        if full and self._full_gen is not None and self._full_gen <= cs.gen:
+            self._full_gen = None
+
+
+# ---- serialization ----
+def _section_rows(runtime) -> Dict[str, Tuple[dict, object]]:
+    """state section -> (runtime dict in insertion order, serializer),
+    mirroring ``serialization.runtime_to_state`` section by section so
+    a delta can serialize ONLY the changed members of a section while
+    preserving the full dump's ordering contract."""
+    from kueue_tpu import serialization as ser
+
+    cache = runtime.cache
+    rows = {
+        "resourceFlavors": (cache.flavors, ser.flavor_to_dict),
+        "clusterQueues": (
+            cache.cluster_queues, lambda c: ser.cq_to_dict(c.model),
+        ),
+        "localQueues": (cache.local_queues, ser.lq_to_dict),
+        "workloads": (runtime.workloads, ser.workload_to_dict),
+        "cohorts": (cache.cohorts, ser.cohort_to_dict),
+        "admissionChecks": (cache.admission_checks, ser.check_to_dict),
+        "topologies": (cache.topologies, ser.topology_to_dict),
+        "workloadPriorityClasses": (
+            cache.priority_classes, ser.priority_class_to_dict,
+        ),
+        "limitRanges": (runtime.limit_ranges, ser.limit_range_to_dict),
+        "runtimeClasses": (runtime.runtime_classes, ser.runtime_class_to_dict),
+    }
+    tas = getattr(cache, "tas_cache", None)
+    if tas is not None:
+        rows["nodes"] = (tas.node_inventory, ser.node_to_dict)
+    return rows
+
+
+def serialize_delta(runtime, cs: ChangeSet, base_seq: int,
+                    journal_seq: int, token=None) -> Tuple[dict, int]:
+    """The delta document for ``cs`` against the live runtime, plus how
+    many objects it serialized (the O(changed) cost). Changed objects
+    are emitted in the runtime dict's CURRENT order so the merge
+    reproduces the leader's insertion order byte for byte."""
+    rows = _section_rows(runtime)
+    sections: Dict[str, dict] = {}
+    serialized = 0
+    touched = set(cs.changed) | set(cs.removed)
+    for section in touched:
+        entry: dict = {}
+        removed = cs.removed.get(section)
+        if removed:
+            entry["removed"] = sorted(removed)
+        objs: List[dict] = []
+        row = rows.get(section)
+        if row is not None:
+            # emit in the tracker's FIRST-MARK order: order only
+            # matters for keys the merge will APPEND (absent from the
+            # base), and those are exactly the keys first inserted in
+            # this delta's window — their first mark IS that insertion
+            # (dict-update never moves an existing mark; tombstone +
+            # re-mark moves to the end, same as dict delete + re-add).
+            # Keys the merge replaces in place are order-free. No store
+            # scan: the delta is O(changed), independent of live count
+            store, codec = row
+            for key in cs.changed.get(section, ()):
+                obj = store.get(key)
+                if obj is not None:
+                    objs.append(codec(obj))
+                    serialized += 1
+        entry["objects"] = objs
+        sections[section] = entry
+    doc = {
+        "kind": "delta",
+        "baseSeq": base_seq,
+        "sections": sections,
+        "persistence": {
+            "resourceVersion": getattr(runtime, "resource_version", 0),
+            "journalSeq": journal_seq,
+            "token": token,
+        },
+    }
+    if cs.quarantine_dirty:
+        quarantine = getattr(runtime, "quarantine", None)
+        doc["quarantine"] = (
+            [e.to_dict() for e in quarantine.items()]
+            if quarantine is not None else []
+        )
+    if cs.policy_dirty:
+        policy = getattr(runtime, "policy", None)
+        doc["policy"] = (
+            policy.name
+            if policy is not None and not policy.is_default else None
+        )
+    return doc, serialized
+
+
+def merge_delta(state: dict, delta: dict) -> dict:
+    """Fold one delta into a materialized state dict, in place.
+
+    Order contract (the byte-identity proof): removals first, then each
+    object replaces in place when its key is present and appends when
+    not — exactly dict upsert/delete/re-add semantics, so the merged
+    list order equals the leader's runtime dict iteration order."""
+    for section, patch in (delta.get("sections") or {}).items():
+        lst = state.get(section) or []
+        removed = set(patch.get("removed") or ())
+        if removed:
+            lst = [o for o in lst if _obj_key(o) not in removed]
+        index = {_obj_key(o): i for i, o in enumerate(lst)}
+        for obj in patch.get("objects") or ():
+            k = _obj_key(obj)
+            i = index.get(k)
+            if i is None:
+                index[k] = len(lst)
+                lst.append(obj)
+            else:
+                lst[i] = obj
+        state[section] = lst
+    if "quarantine" in delta:
+        if delta["quarantine"]:
+            state["quarantine"] = delta["quarantine"]
+        else:
+            state.pop("quarantine", None)
+    if "policy" in delta:
+        if delta["policy"]:
+            state["policy"] = delta["policy"]
+        else:
+            state.pop("policy", None)
+    state["persistence"] = dict(delta.get("persistence") or {})
+    # runtime_to_state emits "nodes" only when the inventory is
+    # non-empty: an all-nodes-deleted delta must drop the key too, or
+    # the re-dump would not be byte-identical
+    if "nodes" in state and not state["nodes"]:
+        del state["nodes"]
+    return state
+
+
+# ---- chain loading / verification ----
+@dataclass
+class ChainInfo:
+    """What a chain load walked: per-file verdicts + the head."""
+
+    files: List[str] = field(default_factory=list)  # applied, in order
+    orphans: List[str] = field(default_factory=list)  # superseded files
+    errors: List[str] = field(default_factory=list)
+    journal_seq: int = 0
+    resource_version: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors and bool(self.files)
+
+
+def load_checkpoint_chain(path: str) -> Tuple[Optional[dict], ChainInfo]:
+    """Materialize the newest valid chain under ``path``: newest
+    parseable anchor + every delta that links off it in commit order.
+    A broken link (missing/unparsable delta) stops the walk there — the
+    valid prefix is still a consistent checkpoint; the journal suffix
+    replay covers the rest."""
+    info = ChainInfo()
+    entries = _list_chain(path)
+    anchors = [e for e in entries if e[0] == "full"]
+    if not anchors:
+        if entries:
+            info.errors.append("chain has delta files but no anchor")
+        return None, info
+    state: Optional[dict] = None
+    anchor_js = 0
+    # newest anchor first; fall back to an older one if it fails to load
+    for kind, base, js, name in reversed(anchors):
+        try:
+            with open(os.path.join(path, name)) as f:
+                state = json.load(f)
+            anchor_js = js
+            info.files.append(name)
+            break
+        except (OSError, ValueError) as e:
+            info.errors.append(f"{name}: unreadable anchor ({e})")
+            state = None
+    if state is None:
+        return None, info
+    cur = anchor_js
+    for kind, base, js, name in entries:
+        if kind != "delta":
+            if name not in info.files and js < anchor_js:
+                info.orphans.append(name)
+            continue
+        if js < anchor_js or base < anchor_js:
+            info.orphans.append(name)  # an older, superseded chain
+            continue
+        if base != cur:
+            info.errors.append(
+                f"{name}: baseSeq {base} does not chain from head {cur}"
+            )
+            break
+        try:
+            with open(os.path.join(path, name)) as f:
+                delta = json.load(f)
+        except (OSError, ValueError) as e:
+            info.errors.append(f"{name}: unreadable delta ({e})")
+            break
+        if int(delta.get("baseSeq", -1)) != base:
+            info.errors.append(
+                f"{name}: content baseSeq {delta.get('baseSeq')} "
+                f"disagrees with its name ({base})"
+            )
+            break
+        merge_delta(state, delta)
+        info.files.append(name)
+        cur = js
+    info.journal_seq = cur
+    persistence = state.get("persistence") or {}
+    info.resource_version = int(persistence.get("resourceVersion", 0))
+    return state, info
+
+
+def verify_checkpoint_chain(path: str) -> ChainInfo:
+    """``kueuectl state verify`` for a chain directory: walk and parse
+    every link without mutating anything. Superseded orphans are noted,
+    not failed — commit GC deletes them lazily."""
+    _, info = load_checkpoint_chain(path)
+    return info
+
+
+def load_state_any(path: str) -> Optional[dict]:
+    """A state dict from either checkpoint shape: a chain DIRECTORY
+    (delta checkpoints) or a single JSON file (the classic full dump).
+    None when nothing loadable exists — shared by recovery, standby
+    refresh and the CLI."""
+    if os.path.isdir(path):
+        state, _ = load_checkpoint_chain(path)
+        return state
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return None
+
+
+# ---- the checkpointer ----
+@dataclass
+class _Prep:
+    """One prepared (serialized-under-lock) checkpoint awaiting its
+    durable commit. ``noop`` preps represent 'nothing changed since the
+    head' and commit trivially."""
+
+    noop: bool = False
+    full: bool = False
+    name: str = ""
+    text: str = ""
+    journal_seq: int = 0
+    base_seq: int = 0
+    objects: int = 0
+    changeset: Optional[ChangeSet] = None
+    tracker: Optional["DeltaTracker"] = None
+    journal: Optional[object] = None
+    prep_seconds: float = 0.0
+
+
+class _Head:
+    __slots__ = ("kind", "base_seq", "journal_seq", "name")
+
+    def __init__(self, kind, base_seq, journal_seq, name):
+        self.kind = kind
+        self.base_seq = base_seq
+        self.journal_seq = journal_seq
+        self.name = name
+
+
+class DeltaCheckpointer:
+    """Owns one chain directory. ``prepare()`` runs under the server
+    lock (serialize the snapshot); ``commit()`` runs outside it (the
+    durable write + journal compaction + chain GC), mirroring
+    ``fenced_checkpoint``'s two-phase choreography. A failed commit
+    leaves the previous chain valid, flips ``degraded`` and keeps the
+    dirty-set — the next checkpoint re-covers everything."""
+
+    def __init__(self, path: str, anchor_every: int = 16,
+                 retain_chains: int = 1):
+        self.path = path
+        self.anchor_every = max(1, anchor_every)
+        self.retain_chains = max(1, retain_chains)
+        self.degraded = False
+        self.last_error = ""
+        self.last_kind: Optional[str] = None
+        self.last_duration_s = 0.0
+        self.last_objects = 0
+        self.metrics = None
+        self._head: Optional[_Head] = None
+        self._deltas_since_anchor = 0
+
+    def open(self) -> "DeltaCheckpointer":
+        """Adopt the chain already on disk (restart): the head is the
+        newest linked file, so the first post-recovery checkpoint still
+        anchors (the tracker starts full-dirty) but GC and verify see
+        the prior chain."""
+        os.makedirs(self.path, exist_ok=True)
+        _, info = load_checkpoint_chain(self.path)
+        if info.files:
+            last = info.files[-1]
+            kind, base, js = parse_chain_name(last)
+            self._head = _Head(kind, base, js, last)
+            self._deltas_since_anchor = sum(
+                1 for n in info.files if n.startswith(_DELTA_PREFIX)
+            )
+        return self
+
+    # -- phase 1: under the server lock --
+    def prepare(self, runtime, token=None, force_full=False) -> _Prep:
+        t0 = time.monotonic()
+        journal = getattr(runtime, "journal", None)
+        tracker = getattr(runtime, "delta_dirty", None)
+        head = self._head
+        if (
+            not force_full
+            and head is not None
+            and tracker is not None and tracker.clean()
+            and journal is not None and journal.last_seq == head.journal_seq
+        ):
+            return _Prep(noop=True)
+        if tracker is None:
+            # nothing ever tracked mutations: only a full dump is safe
+            tracker = DeltaTracker()
+            tracker.note_full()
+        cs = tracker.snapshot()
+        full = (
+            force_full or head is None or cs.need_full
+            or self._deltas_since_anchor >= self.anchor_every
+            # no journal = no replayable suffix to chain deltas over:
+            # only a full dump is a consistent checkpoint
+            or journal is None
+        )
+        # durable mark FIRST: its seq is covered by this checkpoint, so
+        # recovery/replicas skip past it instead of trailing it forever
+        mark = {"baseSeq": None if full else head.journal_seq}
+        if hasattr(runtime, "_journal_append"):
+            if full:
+                runtime._journal_append(CHECKPOINT_ANCHOR, mark)
+            else:
+                runtime._journal_append(CHECKPOINT_DELTA, mark)
+        elif journal is not None:
+            journal.append(
+                CHECKPOINT_ANCHOR if full else CHECKPOINT_DELTA, mark
+            )
+        covered = journal.last_seq if journal is not None else 0
+        if full:
+            from kueue_tpu import serialization as ser
+
+            state = ser.runtime_to_state(runtime)
+            state["persistence"]["journalSeq"] = covered
+            state["persistence"]["token"] = token
+            text = json.dumps(state, indent=1)
+            prep = _Prep(
+                full=True, name=anchor_name(covered), text=text,
+                journal_seq=covered, base_seq=covered,
+                objects=sum(
+                    len(v) for v in state.values() if isinstance(v, list)
+                ),
+                changeset=cs, tracker=tracker, journal=journal,
+            )
+        else:
+            doc, nobjs = serialize_delta(
+                runtime, cs, base_seq=head.journal_seq,
+                journal_seq=covered, token=token,
+            )
+            prep = _Prep(
+                full=False, name=delta_name(head.journal_seq, covered),
+                text=json.dumps(doc, indent=1),
+                journal_seq=covered, base_seq=head.journal_seq,
+                objects=nobjs, changeset=cs, tracker=tracker,
+                journal=journal,
+            )
+        if self.metrics is None:
+            self.metrics = getattr(runtime, "metrics", None)
+        prep.prep_seconds = time.monotonic() - t0
+        return prep
+
+    # -- phase 2: outside the server lock --
+    def commit(self, prep: _Prep) -> bool:
+        if prep.noop:
+            return True
+        from kueue_tpu.utils.lease import atomic_write_text
+
+        t0 = time.monotonic()
+        journal = prep.journal
+        if journal is not None:
+            # records up to the covered seq must be durable BEFORE the
+            # checkpoint that compacts them away claims to cover them
+            try:
+                journal.sync()
+            except OSError:
+                pass  # degraded journal: the checkpoint still lands
+        try:
+            atomic_write_text(
+                os.path.join(self.path, prep.name), prep.text, ".ckpt-",
+                fault_point="checkpoint.delta_write",
+            )
+        except OSError as e:
+            # ENOSPC-style failure: the previous chain is untouched
+            # (tmp unlinked, no rename happened) and the dirty-set is
+            # still in the tracker — degrade, heal on the next success
+            self._note_failure(e)
+            return False
+        kind = "full" if prep.full else "delta"
+        self._head = _Head(kind, prep.base_seq, prep.journal_seq, prep.name)
+        if prep.full:
+            self._deltas_since_anchor = 0
+        else:
+            self._deltas_since_anchor += 1
+        if prep.changeset is not None and prep.tracker is not None:
+            # only now is the change durably covered: clear its marks
+            # (generation-bounded — mutations since prepare() survive)
+            prep.tracker.clear(prep.changeset, full=prep.full)
+        self._gc_chain()
+        if journal is not None:
+            journal.compact(prep.journal_seq)
+        duration = prep.prep_seconds + (time.monotonic() - t0)
+        self.last_duration_s = duration
+        self.last_kind = kind
+        self.last_objects = prep.objects
+        if self.degraded:
+            self.degraded = False
+            self.last_error = ""
+        m = self.metrics
+        if m is not None:
+            m.checkpoints_total.inc(kind=kind)
+            m.checkpoint_bytes_total.inc(len(prep.text), kind=kind)
+            m.checkpoint_duration_seconds.observe(duration, kind=kind)
+            m.checkpoint_degraded.set(0)
+            m.checkpoint_chain_files.set(len(_list_chain(self.path)))
+        return True
+
+    def abandon(self, prep: _Prep) -> None:
+        """Drop a prepared checkpoint that will never commit (deposed
+        leader, superseded snapshot). Nothing to restore: prepare never
+        removed marks from the tracker."""
+
+    def checkpoint(self, runtime, token=None, force_full=False) -> bool:
+        """prepare + commit in one call (single-threaded callers: the
+        soak harness, tests, shutdown paths)."""
+        prep = self.prepare(runtime, token=token, force_full=force_full)
+        return self.commit(prep)
+
+    def _note_failure(self, e: OSError) -> None:
+        self.degraded = True
+        self.last_error = repr(e)
+        m = self.metrics
+        if m is not None:
+            m.checkpoints_total.inc(kind="failed")
+            m.checkpoint_degraded.set(1)
+
+    def _gc_chain(self) -> None:
+        """Bounded retention: keep the newest ``retain_chains`` anchors
+        and everything chaining off them; everything older is covered
+        state and gets deleted (best-effort — a failing unlink on a
+        sick volume must not fail the checkpoint that just landed)."""
+        entries = _list_chain(self.path)
+        anchors = [e for e in entries if e[0] == "full"]
+        if len(anchors) <= self.retain_chains:
+            return
+        cutoff = anchors[-self.retain_chains][2]
+        for kind, base, js, name in entries:
+            if js < cutoff or (kind == "delta" and base < cutoff):
+                try:
+                    os.unlink(os.path.join(self.path, name))
+                except OSError:
+                    pass
+
+    def status(self) -> dict:
+        """/healthz detail (the journal-stats convention)."""
+        head = self._head
+        return {
+            "mode": "delta",
+            "degraded": self.degraded,
+            "lastError": self.last_error,
+            "lastKind": self.last_kind,
+            "lastDurationS": self.last_duration_s,
+            "lastObjects": self.last_objects,
+            "headJournalSeq": head.journal_seq if head is not None else 0,
+            "chainFiles": len(_list_chain(self.path)),
+            "deltasSinceAnchor": self._deltas_since_anchor,
+            "anchorEvery": self.anchor_every,
+        }

@@ -248,6 +248,7 @@ class JournalStats:
     last_fsync_age_s: Optional[float] = None
     torn_bytes_truncated: int = 0
     compactions: int = 0
+    reclaimed_bytes: int = 0  # segment bytes deleted by compaction GC
 
     def to_dict(self) -> dict:
         return {
@@ -263,6 +264,7 @@ class JournalStats:
             "lastFsyncAgeS": self.last_fsync_age_s,
             "tornBytesTruncated": self.torn_bytes_truncated,
             "compactions": self.compactions,
+            "reclaimedBytes": self.reclaimed_bytes,
         }
 
 
@@ -313,6 +315,7 @@ class Journal:
         self._dropped = 0
         self._fsyncs = 0
         self._compactions = 0
+        self._reclaimed_bytes = 0  # segment bytes deleted by compaction
         self._torn_truncated = 0
         self._fh = None  # active segment append handle
         self._active = None  # active segment file name
@@ -357,6 +360,13 @@ class Journal:
                     self.last_seq = recs[-1].seq
                     self.last_rv = recs[-1].rv
                     break
+            else:
+                # checkpoint-driven compaction can delete every
+                # record-bearing segment, leaving only the fresh
+                # rotated one — its NAME carries the next seq; resume
+                # from it so sequence numbers never regress below the
+                # delta-chain head or the replica cursors
+                self.last_seq = _segment_first_seq(segments[-1]) - 1
             self._active = segments[-1]
             self._active_size = os.path.getsize(last)
             self._fh = open(last, "ab", buffering=0)
@@ -376,6 +386,12 @@ class Journal:
         self._opened = False
 
     def _start_segment(self, first_seq: int) -> None:
+        # ENOSPC-style failures on the volume's metadata path (creating
+        # the next segment file) surface here: armed with an OSError
+        # action the rotation fails atomically BEFORE the old handle is
+        # disturbed, so the degraded path keeps appending to the
+        # oversized active segment and self-heals when space returns
+        faults.fire("journal.rotate")
         if self._fh is not None and not self._fh.closed:
             os.fsync(self._fh.fileno())
             self._fh.close()
@@ -617,8 +633,15 @@ class Journal:
             return 0
         if self.last_seq <= upto_seq and self._active_size > 0:
             # everything so far is covered: seal the active segment so
-            # it becomes deletable and appends continue in a fresh one
-            self._start_segment(self.last_seq + 1)
+            # it becomes deletable and appends continue in a fresh one.
+            # A failed rotation (ENOSPC creating the new file) degrades
+            # instead of raising — the checkpoint that triggered this
+            # compaction already landed and must not be failed for it
+            try:
+                self._start_segment(self.last_seq + 1)
+            except OSError as e:
+                self._note_failure(e)
+                return 0
         names = _list_segments(self.path)
         deleted = 0
         for i, name in enumerate(names):
@@ -632,11 +655,16 @@ class Journal:
             else:
                 boundary = self.last_seq
             if boundary <= upto_seq:
+                full = os.path.join(self.path, name)
                 try:
-                    os.unlink(os.path.join(self.path, name))
-                    deleted += 1
+                    size = os.path.getsize(full)
+                    os.unlink(full)
                 except OSError:
-                    pass
+                    continue
+                deleted += 1
+                self._reclaimed_bytes += size
+                if self.metrics is not None:
+                    self.metrics.journal_reclaimed_bytes_total.inc(size)
         if deleted:
             self._compactions += 1
         if self.metrics is not None:
@@ -669,4 +697,5 @@ class Journal:
             ),
             torn_bytes_truncated=self._torn_truncated,
             compactions=self._compactions,
+            reclaimed_bytes=self._reclaimed_bytes,
         )

@@ -74,6 +74,15 @@ _FEDERATION_TYPES = (
 ELASTIC_GRANT = "elastic_grant"
 ELASTIC_REVOKE = "elastic_revoke"
 _ELASTIC_TYPES = (ELASTIC_GRANT, ELASTIC_REVOKE)
+# delta checkpoints (kueue_tpu/storage/checkpoint.py): the leader
+# appends an advisory mark immediately BEFORE serializing each
+# anchor/delta, so the mark's own seq is covered by the checkpoint
+# that follows it. Replay surfaces the newest mark on
+# ``rt.last_checkpoint`` (operator visibility: which chain link the
+# journal believes is current); nothing mutates
+CHECKPOINT_ANCHOR = "checkpoint_anchor"
+CHECKPOINT_DELTA = "checkpoint_delta"
+_CHECKPOINT_TYPES = (CHECKPOINT_ANCHOR, CHECKPOINT_DELTA)
 
 
 class RecoveryError(Exception):
@@ -211,6 +220,11 @@ def apply_record(rt, rec: JournalRecord) -> None:
                 # a newer binary's policy vocabulary — keep the default
                 # rather than crash replay
                 pass
+    elif rec.type in _CHECKPOINT_TYPES:
+        # advisory checkpoint mark: the leader cut a chain link whose
+        # coverage includes this very record — surface it for /healthz
+        # and the debugger; no state mutates
+        rt.last_checkpoint = {"kind": rec.type, **dict(rec.data)}
     elif rec.type == SOLVER_VERDICT:
         # which solver path produced the admitted state on disk — a
         # recovered process must know the device path was quarantined
@@ -258,13 +272,21 @@ def recover(
 
     res = RecoveryResult(runtime=runtime, journal=None)
 
-    # 1. newest valid checkpoint
+    # 1. newest valid checkpoint — a FILE is the classic full dump, a
+    # DIRECTORY is a delta-checkpoint chain (newest anchor + deltas
+    # folded in commit order; see storage/checkpoint.py)
     ckpt_token: Optional[int] = None
-    if state_path and os.path.exists(state_path):
-        from kueue_tpu import serialization as ser
+    data = None
+    if state_path and os.path.isdir(state_path):
+        from kueue_tpu.storage.checkpoint import load_checkpoint_chain
 
+        data, _chain_info = load_checkpoint_chain(state_path)
+    elif state_path and os.path.exists(state_path):
         with open(state_path) as f:
             data = json.load(f)
+    if data is not None:
+        from kueue_tpu import serialization as ser
+
         ser.runtime_from_state(data, runtime=runtime)
         res.checkpoint_loaded = True
         persistence = data.get("persistence", {})

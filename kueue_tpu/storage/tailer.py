@@ -148,8 +148,12 @@ class LocalTailSource:
         if not (self.state_path and os.path.exists(self.state_path)):
             return None
         try:
-            with open(self.state_path) as f:
-                return json.load(f)
+            # a shared-volume leader may run delta checkpoints
+            # (--state-dir): load_state_any resolves a chain directory
+            # (anchor + deltas merged) or a flat state file alike
+            from kueue_tpu.storage.checkpoint import load_state_any
+
+            return load_state_any(self.state_path)
         except (OSError, ValueError) as e:
             raise TailSourceError(f"checkpoint unreadable: {e!r}")
 

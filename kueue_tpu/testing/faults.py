@@ -48,6 +48,24 @@ FAULT_POINTS: Dict[str, str] = {
         "checkpoint tmp file fully written + fsynced, os.replace not "
         "yet executed (utils/lease.atomic_write_text)"
     ),
+    "checkpoint.delta_write": (
+        "delta-checkpoint chain write: the anchor/delta tmp file is "
+        "durably written, os.replace not yet executed "
+        "(storage/checkpoint.DeltaCheckpointer.commit via "
+        "atomic_write_text) — arm with an OSError action to model "
+        "ENOSPC on the state volume (the PREVIOUS chain must stay "
+        "valid and the checkpointer flips degraded until the next "
+        "success), or 'crash' to kill the process mid-commit"
+    ),
+    "journal.rotate": (
+        "journal segment rotation: the next segment file is about to "
+        "be created (storage/journal._start_segment) — arm with an "
+        "OSError action to model ENOSPC on the volume's metadata "
+        "path; appends must degrade (record dropped, flag flipped) "
+        "and self-heal once the volume recovers, and a compaction-"
+        "driven rotation must degrade instead of failing the "
+        "checkpoint that triggered it"
+    ),
     "cycle.post_solve_pre_apply": (
         "scheduler nomination / drain solve complete, outcome not yet "
         "applied (core/scheduler.schedule, controllers.bulk_drain)"

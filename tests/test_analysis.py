@@ -395,6 +395,39 @@ def apply_record(rt, rec):
 '''
 
 
+# The delta checkpointer appends its chain marks with kinds IMPORTED
+# from the recovery module rather than defined locally — the rule must
+# resolve them through the cross-module constants map.
+SYM_CKPT_PRODUCER = '''\
+from storage.recovery import CHECKPOINT_ANCHOR, CHECKPOINT_DELTA
+
+
+class DeltaCheckpointer:
+    def prepare(self, runtime, full):
+        if full:
+            runtime._journal_append(CHECKPOINT_ANCHOR, {"name": "a"})
+        else:
+            runtime._journal_append(CHECKPOINT_DELTA, {"name": "d"})
+'''
+
+SYM_CKPT_RECOVERY = '''\
+WORKLOAD_UPSERT = "workload_upsert"
+QUARANTINE_SET = "quarantine_set"
+CHECKPOINT_ANCHOR = "checkpoint_anchor"
+CHECKPOINT_DELTA = "checkpoint_delta"
+_CHECKPOINT_TYPES = (CHECKPOINT_ANCHOR, CHECKPOINT_DELTA)
+
+
+def apply_record(rt, rec):
+    if rec.type == WORKLOAD_UPSERT:
+        rt.add(rec.data)
+    elif rec.type in (QUARANTINE_SET,):
+        rt.q(rec.data)
+    elif rec.type in _CHECKPOINT_TYPES:
+        rt.mark(rec.type, rec.data)
+'''
+
+
 class TestJournalSymmetryRule:
     def _tree(self, recovery=SYM_RECOVERY, tailer=SYM_TAILER, extra=None):
         files = {
@@ -491,6 +524,65 @@ class TestJournalSymmetryRule:
         assert len(findings) == 2
         assert all("dead vocabulary" in f.message for f in findings)
         assert all(f.file == "storage/recovery.py" for f in findings)
+
+    def test_checkpoint_kinds_imported_constants_clean(self, tmp_path):
+        """ISSUE-19: the checkpointer appends chain marks with kinds
+        imported from the recovery module (no local literal) — the
+        cross-module constants map pairs them with the recovery
+        membership tuple; symmetric, no findings."""
+        assert run_fixture(
+            tmp_path,
+            self._tree(
+                recovery=SYM_CKPT_RECOVERY,
+                extra={"storage/checkpoint.py": SYM_CKPT_PRODUCER},
+            ),
+            rules=["journal-symmetry"],
+        ) == []
+
+    def test_checkpoint_handler_deleted_fails_both_kinds(self, tmp_path):
+        """Delete the _CHECKPOINT_TYPES dispatch arm (constants stay):
+        one finding per mark kind, anchored at the checkpointer's
+        append sites — replay would drop the chain marks."""
+        broken = SYM_CKPT_RECOVERY.replace(
+            "    elif rec.type in _CHECKPOINT_TYPES:\n"
+            "        rt.mark(rec.type, rec.data)\n",
+            "",
+        )
+        findings = run_fixture(
+            tmp_path,
+            self._tree(
+                recovery=broken,
+                extra={"storage/checkpoint.py": SYM_CKPT_PRODUCER},
+            ),
+            rules=["journal-symmetry"],
+        )
+        assert len(findings) == 2
+        kinds = {("checkpoint_anchor" in f.message,
+                  "checkpoint_delta" in f.message)
+                 for f in findings}
+        assert kinds == {(True, False), (False, True)}
+        assert all(f.file == "storage/checkpoint.py" for f in findings)
+
+    def test_checkpoint_producer_deleted_is_dead_vocabulary(self, tmp_path):
+        """Recovery still dispatches the checkpoint mark kinds but the
+        checkpointer module is gone — dead vocabulary on the handler."""
+        findings = run_fixture(
+            tmp_path,
+            self._tree(recovery=SYM_CKPT_RECOVERY),
+            rules=["journal-symmetry"],
+        )
+        assert len(findings) == 2
+        assert all("dead vocabulary" in f.message for f in findings)
+        assert all(f.file == "storage/recovery.py" for f in findings)
+
+    def test_real_tree_checkpoint_kinds_paired(self):
+        """The production contract: the real storage/checkpoint.py
+        appends checkpoint_anchor/checkpoint_delta marks via imported
+        constants, and the real recovery module replays them — the
+        rule resolves the pairing across modules with no findings."""
+        from kueue_tpu.analysis import lint
+
+        assert [f for f in lint(rules=["journal-symmetry"])] == []
 
 
 # ---- clock-discipline ----

@@ -1,0 +1,273 @@
+"""Bounded-retention regression tests: every in-memory ring, cursor
+and on-disk structure the state plane grows under sustained
+arrival + completion churn must hold its configured bound — RSS and
+journal size flat at steady state is the million-workload operating
+contract (ISSUE: sustained operation, not just a burst).
+
+Plus the soak smoke: a short deterministic run of ``bench.py``'s
+``--soak`` stage (gateway ingest + delta checkpoints + journal
+compaction + shared-volume replica) asserting the same flatness the
+hours-long ``@slow`` variant checks at scale.
+"""
+
+import dataclasses
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from kueue_tpu.controllers import ClusterRuntime
+from kueue_tpu.models import (
+    ClusterQueue,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.models.cluster_queue import FlavorQuotas, ResourceGroup
+from kueue_tpu.models.workload import PodSet
+from kueue_tpu.storage import (
+    DeltaCheckpointer,
+    Journal,
+    JournalTailer,
+    LocalTailSource,
+)
+from kueue_tpu.testing import faults
+from kueue_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def churn_rt(tmp_path, segment_max_bytes=64 * 1024):
+    clock = FakeClock(0.0)
+    rt = ClusterRuntime(
+        clock=clock, use_solver=False, bulk_drain_threshold=None
+    )
+    journal = Journal(
+        str(tmp_path / "journal"),
+        fsync_policy="interval",
+        segment_max_bytes=segment_max_bytes,
+        clock=clock,
+    ).open()
+    rt.attach_journal(journal)
+    rt.add_flavor(ResourceFlavor(name="default"))
+    rt.add_cluster_queue(
+        ClusterQueue(
+            name="cq", namespace_selector={},
+            resource_groups=(
+                ResourceGroup(
+                    ("cpu",),
+                    (FlavorQuotas.build("default", {"cpu": "64"}),),
+                ),
+            ),
+        )
+    )
+    rt.add_local_queue(
+        LocalQueue(namespace="churn", name="lq-cq", cluster_queue="cq")
+    )
+    return rt, journal, clock
+
+
+def make_wl(k, t):
+    return Workload(
+        namespace="churn", name=f"wl-{k}", queue_name="lq-cq",
+        creation_time=t,
+        pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+    )
+
+
+CHURN_N = 10_000
+BATCH = 500
+
+
+class TestRingBoundsUnderChurn:
+    def test_rings_and_cursors_hold_bounds_at_10k_churn(self, tmp_path):
+        """10k workloads arrive, admit and complete; every ring must
+        end bounded and the live set empty — nothing retains
+        per-workload state for completed work."""
+        rt, journal, clock = churn_rt(tmp_path)
+        state_dir = str(tmp_path / "state")
+        os.makedirs(state_dir)
+        ckpt = DeltaCheckpointer(state_dir, anchor_every=8).open()
+        rt.checkpointer = ckpt
+        tailer = JournalTailer(
+            LocalTailSource(
+                str(tmp_path / "journal"), state_path=state_dir,
+                now_fn=clock.now,
+            ),
+            now_fn=clock.now,
+        )
+        tailer.ensure_runtime()
+
+        for start in range(0, CHURN_N, BATCH):
+            for k in range(start, start + BATCH):
+                rt.add_workload(make_wl(k, float(k)))
+            rt.run_until_idle()
+            for k in range(start, start + BATCH):
+                wl = rt.workloads.get(f"churn/wl-{k}")
+                if wl is not None:
+                    rt.delete_workload(wl)
+            rt.run_until_idle()
+            clock.advance(1.0)
+            ckpt.checkpoint(rt)
+            journal.sync()
+            tailer.poll_once()
+
+        assert not rt.workloads  # everything completed
+        # event ring: newest ring_size only
+        assert len(rt.events._ring) <= rt.events.ring_size
+        # audit: per-workload rings LRU-capped across workloads
+        assert len(rt.audit._records) <= rt.audit.max_workloads
+        for ring in rt.audit._records.values():
+            assert len(ring) <= rt.audit.per_workload
+        assert (
+            len(rt.audit._stamp_log) <= rt.audit._stamp_log.maxlen
+        )
+        # tracer: newest max_traces trace trees only
+        assert len(rt.tracer._traces) <= rt.tracer.max_traces
+        assert (
+            len(rt.tracer._stamp_log) <= rt.tracer._stamp_log.maxlen
+        )
+        # replica ingest log bounded
+        assert len(tailer.feed_log) <= tailer.feed_log_max
+        # replica cursor caught up (not pinned behind compaction)
+        assert tailer.applied_seq >= journal.last_seq
+        assert set(tailer.runtime.workloads) == set(rt.workloads)
+        journal.close()
+
+    def test_journal_segments_bounded_by_checkpoint_compaction(
+        self, tmp_path
+    ):
+        """Small segments + churn would grow the journal without
+        bound; checkpoint-driven compaction must hold the segment
+        count flat and account every reclaimed byte."""
+        rt, journal, clock = churn_rt(tmp_path, segment_max_bytes=16 * 1024)
+        state_dir = str(tmp_path / "state")
+        os.makedirs(state_dir)
+        ckpt = DeltaCheckpointer(state_dir, anchor_every=4).open()
+        rt.checkpointer = ckpt
+
+        peak_segments = 0
+        for start in range(0, 4_000, BATCH):
+            for k in range(start, start + BATCH):
+                rt.add_workload(make_wl(k, float(k)))
+            rt.run_until_idle()
+            for k in range(start, start + BATCH):
+                wl = rt.workloads.get(f"churn/wl-{k}")
+                if wl is not None:
+                    rt.delete_workload(wl)
+            rt.run_until_idle()
+            ckpt.checkpoint(rt)
+            peak_segments = max(peak_segments, journal.stats().segments)
+
+        st = journal.stats()
+        # each round rotates several 16 KiB segments; without
+        # compaction 4k add+delete rounds leave dozens on disk
+        assert peak_segments <= 4
+        assert st.segments <= 4
+        assert st.reclaimed_bytes > 0
+        assert rt.metrics.journal_reclaimed_bytes_total.value() == float(
+            st.reclaimed_bytes
+        )
+        # disk usage itself is bounded, not just the count
+        jdir = str(tmp_path / "journal")
+        on_disk = sum(
+            os.path.getsize(os.path.join(jdir, f))
+            for f in os.listdir(jdir)
+        )
+        assert on_disk <= 4 * 16 * 1024 + 64 * 1024
+        journal.close()
+
+    def test_gateway_shed_keeps_queue_bounded(self, tmp_path):
+        """A stalled flusher must not let the ingest queue grow
+        unboundedly — the gateway sheds at max_queue and the tenant
+        fair-share cap."""
+        from kueue_tpu.gateway import GatewayThrottled, WriteGateway
+
+        rt, journal, clock = churn_rt(tmp_path)
+        gw = WriteGateway(max_batch=64, max_queue=256, clock=clock)
+        shed = 0
+        for k in range(2_000):
+            try:
+                gw._enqueue(
+                    "workloads",
+                    {"namespace": "churn", "name": f"q-{k}",
+                     "queueName": "lq-cq"},
+                )
+            except GatewayThrottled:
+                shed += 1
+        assert shed > 0
+        assert gw.status()["queueDepth"] <= 256
+        journal.close()
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_module",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestSoakSmoke:
+    def test_soak_smoke_flat_and_converged(self):
+        """Deterministic short soak (same machinery as
+        ``bench.py --soak``): RSS and journal flat across windows,
+        replica converged, delta cost O(changed) across a 5x live-set
+        spread."""
+        bench = _load_bench()
+        r = bench.soak_bench(
+            np.random.default_rng(7),
+            wall_budget_s=4.0,
+            windows=2,
+            rate_per_s=150.0,
+            n_cq=4,
+            scale_live=(500, 2_500),
+            scale_touch=32,
+        )
+        assert len(r["windows"]) == 2
+        assert r["arrived"] > 0 and r["completed"] > 0
+        assert r["replica_converged"]
+        # flatness: RSS growth across the run stays in noise territory
+        assert r["rss_mb_last"] <= r["rss_mb_first"] * 1.25 + 32
+        # chain GC held the checkpoint dir bounded
+        assert r["chain_files"] <= 1 + 8
+        # O(changed): same touch count at 5x the live set must not
+        # scale the delta (generous 3x guard for CI noise)
+        assert r["scale_ratio_delta"] < 3.0
+        for s in r["scale"]:
+            assert s["delta_objects"] == 32
+        # SLO plane stayed live and green through the churn
+        for w in r["windows"]:
+            assert w["slo_attainment_min"] >= 0.0
+            assert not w["slo_degraded"]
+
+    @pytest.mark.slow
+    def test_soak_sustained_hours(self):
+        """The hours-long variant (opt-in: ``-m slow``), sized by
+        KUEUE_SOAK_S (default one hour of wall time). Same assertions,
+        tighter flatness: at steady state nothing may trend."""
+        bench = _load_bench()
+        wall_s = float(os.environ.get("KUEUE_SOAK_S", "3600"))
+        r = bench.soak_bench(
+            np.random.default_rng(7),
+            wall_budget_s=wall_s,
+            windows=max(4, int(wall_s / 300)),
+            rate_per_s=300.0,
+            n_cq=8,
+            scale_live=(10_000, 100_000),
+            scale_touch=64,
+        )
+        assert r["replica_converged"]
+        assert r["rss_mb_last"] <= r["rss_mb_first"] * 1.15 + 16
+        assert r["journal_mb_peak"] <= 64
+        assert r["scale_ratio_delta"] < 2.0
+        for w in r["windows"]:
+            assert not w["slo_degraded"]
