@@ -2208,6 +2208,181 @@ def federation_churn_bench(
     return joins, drains, p95, len(readmit_ms), len(admitted_keys())
 
 
+def grayfail_bench(rng, n_workers=12, n_wl=180, worker_cpu=200, fanout=2):
+    """Gray-failure A/B (PR 20): a 12-worker federation with ONE
+    limping worker — every exchange answers just under the CURRENT
+    per-call deadline (LatencyTransport deadline_fraction=0.99, the
+    adversarial gray worker a fixed timeout can never catch) — run
+    twice over the same seeded backlog:
+
+      A (fixed):    adaptive_deadlines=False, hedging=False, health
+                    plane neutralized (degrade_min_samples too high to
+                    ever trip) — the pre-PR-20 configuration; every
+                    exchange to the limper costs 9.9 simulated
+                    seconds, forever, and ranking keeps dispatching
+                    onto it.
+      B (adaptive): defaults — the latency health plane degrades the
+                    limper into probation (no NEW dispatches, existing
+                    placements keep syncing), adaptive deadlines clamp
+                    the per-call budget, hedged dispatch covers the
+                    detection window under the <=5% budget.
+
+    Reports fleet-wide dispatch p95 (RecordingTransport outside the
+    chaos wrapper — exactly what the dispatcher observed) and
+    admissions per simulated second for both phases, plus phase B's
+    hedge rate. Both phases and a healthy-fleet reference must admit
+    the IDENTICAL workload set exactly once — immunity must not cost
+    correctness."""
+    from kueue_tpu.admissionchecks.multikueue import MultiKueueCluster
+    from kueue_tpu.admissionchecks.multikueue_transport import (
+        InProcessTransport,
+    )
+    from kueue_tpu.controllers import ClusterRuntime
+    from kueue_tpu.federation import FederationDispatcher
+    from kueue_tpu.models import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        ResourceFlavor,
+        Workload,
+    )
+    from kueue_tpu.models.cluster_queue import ResourceGroup
+    from kueue_tpu.models.workload import PodSet
+    from kueue_tpu.testing import faults
+    from kueue_tpu.testing.chaos import LatencyTransport, RecordingTransport
+    from kueue_tpu.utils.clock import FakeClock
+
+    def build_worker(clock):
+        rt = ClusterRuntime(clock=clock, use_solver=False)
+        rt.add_flavor(ResourceFlavor(name="default"))
+        rt.add_cluster_queue(
+            ClusterQueue(
+                name="cq",
+                namespace_selector={},
+                resource_groups=(
+                    ResourceGroup(
+                        ("cpu",),
+                        (
+                            FlavorQuotas.build(
+                                "default", {"cpu": str(worker_cpu)}
+                            ),
+                        ),
+                    ),
+                ),
+            )
+        )
+        rt.add_local_queue(
+            LocalQueue(namespace="ns", name="lq", cluster_queue="cq")
+        )
+        return rt
+
+    priorities = [int(p) for p in rng.integers(0, 5, size=n_wl)]
+
+    def backlog():
+        return [
+            Workload(
+                namespace="ns",
+                name=f"gray-{i:04d}",
+                queue_name="lq",
+                priority=priorities[i],
+                pod_sets=(PodSet.build("main", 1, {"cpu": "1"}),),
+            )
+            for i in range(n_wl)
+        ]
+
+    def run(limping, adaptive):
+        faults.reset()
+        clock = FakeClock(0.0)
+        sink = []  # fleet-wide observed exchange latencies (sim s)
+        clusters = {}
+        for i in range(n_workers):
+            name = f"w{i:02d}"
+            inner = InProcessTransport(build_worker(clock))
+            if limping and i == 0:
+                inner = LatencyTransport(
+                    inner, clock, deadline_fraction=0.99
+                )
+            clusters[name] = MultiKueueCluster(
+                name=name,
+                transport=RecordingTransport(inner, clock, sink=sink),
+            )
+        manager = ClusterRuntime(clock=clock)
+        dispatcher = FederationDispatcher(
+            manager,
+            clusters=clusters,
+            fanout=fanout,
+            drive_inprocess=True,
+            adaptive_deadlines=adaptive,
+            hedging=adaptive,
+            # the baseline is pre-PR-20: no latency health plane at
+            # all — neutralize degradation so probation can't quietly
+            # route around the limper in the A phase
+            health_plane_kw=(
+                None if adaptive else {"degrade_min_samples": 10**9}
+            ),
+        )
+        for wl in backlog():
+            manager.add_workload(wl)
+        t0 = clock.now()
+        admitted = set()
+        for _ in range(80):
+            manager.run_until_idle()
+            admitted = {
+                key
+                for key, wl in manager.workloads.items()
+                if wl.is_admitted
+            }
+            if len(admitted) == n_wl:
+                break
+            clock.advance(5.0)  # let heartbeats / probation holds move
+        assert len(admitted) == n_wl, (
+            f"only {len(admitted)}/{n_wl} admitted "
+            f"(limping={limping} adaptive={adaptive})"
+        )
+        elapsed = max(clock.now() - t0, 1e-9)
+        # exactly one live copy per admitted workload across the fleet
+        for key in admitted:
+            holders = [
+                n
+                for n, c in clusters.items()
+                if key in c.runtime.workloads
+                and not c.runtime.workloads[key].is_finished
+            ]
+            assert len(holders) == 1, f"{key} held by {holders}"
+        sink.sort()
+        p95 = (
+            sink[min(len(sink) - 1, int(0.95 * len(sink)))]
+            if sink
+            else 0.0
+        )
+        return {
+            "admitted": admitted,
+            "dispatch_p95_ms": p95 * 1e3,
+            "admissions_per_s": n_wl / elapsed,
+            "hedge_rate": dispatcher.worker_health.hedge_rate(),
+            "exchanges": len(sink),
+        }
+
+    ref = run(limping=False, adaptive=True)
+    fixed = run(limping=True, adaptive=False)
+    adaptive = run(limping=True, adaptive=True)
+    assert fixed["admitted"] == ref["admitted"], (
+        "fixed-config admitted set diverged from the healthy reference"
+    )
+    assert adaptive["admitted"] == ref["admitted"], (
+        "adaptive-config admitted set diverged from the healthy "
+        "reference — gray-failure immunity must not cost correctness"
+    )
+    assert adaptive["dispatch_p95_ms"] <= fixed["dispatch_p95_ms"], (
+        f"adaptive dispatch p95 {adaptive['dispatch_p95_ms']:.0f}ms "
+        f"did not beat fixed {fixed['dispatch_p95_ms']:.0f}ms"
+    )
+    assert adaptive["hedge_rate"] <= 0.05 + 1e-9, (
+        f"hedge rate {adaptive['hedge_rate']:.4f} blew the 5% budget"
+    )
+    return fixed, adaptive, ref
+
+
 def trace_bench(rng):
     """Always-on tracing overhead at the 50k north-star scale: the
     IDENTICAL seeded backlog drained to quiescence through
@@ -3458,6 +3633,42 @@ def _stage_federation_churn() -> dict:
     }
 
 
+def _stage_grayfail() -> dict:
+    fixed, adaptive, ref = grayfail_bench(np.random.default_rng(20))
+    speedup = (
+        adaptive["admissions_per_s"] / fixed["admissions_per_s"]
+        if fixed["admissions_per_s"]
+        else 0.0
+    )
+    return {
+        "grayfail_metric": (
+            "grayfail_adaptive_dispatch_p95 (12-worker federation, one "
+            "limping worker answering at 0.99x the per-call deadline; "
+            "same seeded 180-deep backlog run fixed-timeout vs "
+            "adaptive+hedged: fleet-wide dispatch p95 "
+            f"{fixed['dispatch_p95_ms']:.0f}ms -> "
+            f"{adaptive['dispatch_p95_ms']:.0f}ms, admissions/sim-s "
+            f"{fixed['admissions_per_s']:.2f} -> "
+            f"{adaptive['admissions_per_s']:.2f} ({speedup:.1f}x), "
+            f"hedge rate {adaptive['hedge_rate']:.4f} <= 0.05 budget, "
+            "admitted sets bit-identical to the healthy-fleet "
+            "reference in both phases)"
+        ),
+        "grayfail_value": round(adaptive["dispatch_p95_ms"], 3),
+        "grayfail_unit": "ms (dispatch p95, adaptive+hedged)",
+        "grayfail_fixed_p95_ms": round(fixed["dispatch_p95_ms"], 3),
+        "grayfail_adaptive_p95_ms": round(adaptive["dispatch_p95_ms"], 3),
+        "grayfail_fixed_admissions_per_s": round(
+            fixed["admissions_per_s"], 3
+        ),
+        "grayfail_adaptive_admissions_per_s": round(
+            adaptive["admissions_per_s"], 3
+        ),
+        "grayfail_speedup": round(speedup, 2),
+        "grayfail_hedge_rate": round(adaptive["hedge_rate"], 4),
+    }
+
+
 def sharded_drain_bench():
     """1-device vs mesh A/B on the 50k plain drain: the same backlog
     (headline seed) solved through ``run_drain`` single-device and
@@ -3594,6 +3805,7 @@ STAGES = {
     "failover": _stage_failover,
     "federation": _stage_federation,
     "federation_churn": _stage_federation_churn,
+    "grayfail": _stage_grayfail,
     "serve": _stage_serve,
     "trace": _stage_trace,
     "policy": _stage_policy,
@@ -3618,6 +3830,7 @@ HEADLINE_FALLBACK_STAGES = (
     "megaloop",
     "federation",
     "federation_churn",
+    "grayfail",
     "sharded",
     "serve",
     "trace",
@@ -3640,6 +3853,9 @@ COMPACT_EXTRAS = (
     ("federation_churn_joins", "joins"),
     ("federation_churn_drains", "drains"),
     ("federation_churn_readmit_p95_ms", "readmit_p95_ms"),
+    ("grayfail_adaptive_p95_ms", "grayfail_p95_ms"),
+    ("grayfail_speedup", "grayfail_speedup"),
+    ("grayfail_hedge_rate", "hedge_rate"),
     ("pipeline_speedup_vs_serial", "pipeline_speedup"),
     ("megaloop_speedup_vs_serial", "megaloop_speedup"),
     ("megaloop_dispatches_per_drain", "dispatches_per_drain"),
@@ -3666,6 +3882,7 @@ SINGLE_STAGE_MODES = {
     "--sharded": ["sharded"],
     "--federation": ["federation"],
     "--churn": ["federation_churn"],
+    "--grayfail": ["grayfail"],
     "--serve": ["serve"],
     "--trace": ["trace"],
     "--policy": ["policy"],

@@ -94,8 +94,8 @@ class MultiKueueCluster:
         if self.client is not None:
             self.client._record_success()
 
-    def call(self, op: str, *args):
-        return self.client.call(op, *args)
+    def call(self, op: str, *args, deadline_s: Optional[float] = None):
+        return self.client.call(op, *args, deadline_s=deadline_s)
 
 
 @dataclass
@@ -159,6 +159,7 @@ class MultiKueueController:
         base_backoff_s: float = 1.0,
         max_backoff_s: float = 300.0,
         gc_interval_s: float = 60.0,  # config multiKueue.gcInterval
+        call_deadline_s: float = 10.0,
     ):
         self.runtime = runtime
         self.clusters = {}
@@ -174,6 +175,10 @@ class MultiKueueController:
         self.batch_dispatch = batch_dispatch
         self.base_backoff_s = base_backoff_s
         self.max_backoff_s = max_backoff_s
+        # explicit per-call transport deadline (deadline-discipline
+        # lint): every remote exchange below names its bound instead
+        # of riding whatever timeout the transport was built with
+        self.call_deadline_s = call_deadline_s
         # cluster -> workload key -> buffered copy (keyed so the dedup
         # check at buffering time and _unbuffer at winner pick are O(1)
         # — at 10k-workload dispatch waves a list scan per pick is
@@ -359,14 +364,19 @@ class MultiKueueController:
             if not cluster.client.reachable():
                 continue
             try:
-                rwl = cluster.call("get_workload", wl.key)
+                rwl = cluster.call(
+                    "get_workload", wl.key, deadline_s=self.call_deadline_s
+                )
                 if rwl is None:
                     copy = self._remote_copy(wl)
                     if self.batch_dispatch:
                         buf = self._create_buffer.setdefault(cluster.name, {})
                         buf.setdefault(copy.key, copy)
                     else:
-                        cluster.call("create_workload", copy)
+                        cluster.call(
+                            "create_workload", copy,
+                            deadline_s=self.call_deadline_s,
+                        )
                 self._dispatched.setdefault(wl.key, set()).add(cluster.name)
                 if rwl is not None and rwl.has_quota_reservation:
                     reserving.append(cluster)
@@ -426,7 +436,10 @@ class MultiKueueController:
             if not batch or not cluster.client.reachable():
                 continue
             try:
-                cluster.call("create_workloads", list(batch.values()))
+                cluster.call(
+                    "create_workloads", list(batch.values()),
+                    deadline_s=self.call_deadline_s,
+                )
                 self._create_buffer[name] = {}
             except ClusterUnreachable:
                 pass  # retried next pass; dispatch sets keep the intent
@@ -436,7 +449,10 @@ class MultiKueueController:
                 remaining = dict(batch)
                 for key, w in list(remaining.items()):
                     try:
-                        cluster.call("create_workload", w)
+                        cluster.call(
+                            "create_workload", w,
+                            deadline_s=self.call_deadline_s,
+                        )
                     except RemoteRejected:
                         pass  # refused: dropped (reconcile re-reports)
                     except ClusterUnreachable:
@@ -464,10 +480,16 @@ class MultiKueueController:
             if not cluster.client.reachable():
                 continue
             try:
-                keys = cluster.call("list_workload_keys", self.origin)
+                keys = cluster.call(
+                    "list_workload_keys", self.origin,
+                    deadline_s=self.call_deadline_s,
+                )
                 for key in keys:
                     if key not in self.runtime.workloads:
-                        cluster.call("delete_workload", key)
+                        cluster.call(
+                            "delete_workload", key,
+                            deadline_s=self.call_deadline_s,
+                        )
                         deleted += 1
                         self._dispatched.get(key, set()).discard(cluster.name)
             except ClusterUnreachable:
@@ -480,7 +502,9 @@ class MultiKueueController:
         )
 
         try:
-            rwl = cluster.call("get_workload", wl.key)
+            rwl = cluster.call(
+                "get_workload", wl.key, deadline_s=self.call_deadline_s
+            )
         except ClusterUnreachable:
             return  # worker-lost timer runs in reconcile
         if rwl is None:
@@ -528,7 +552,9 @@ class MultiKueueController:
                 and cluster.transport.runtime is not None
             ):
                 adapter.delete_remote_job(job, cluster.transport.runtime)
-            cluster.call("delete_workload", wl_key)
+            cluster.call(
+                "delete_workload", wl_key, deadline_s=self.call_deadline_s
+            )
         except ClusterUnreachable:
             return False
         self._dispatched.get(wl_key, set()).discard(cluster.name)

@@ -27,6 +27,7 @@ from copy import deepcopy
 from typing import Dict, List, Optional
 
 from kueue_tpu.models import Workload
+from kueue_tpu.testing import faults
 
 ORIGIN_LABEL = "kueue.x-k8s.io/multikueue-origin"
 
@@ -53,6 +54,14 @@ class RemoteTransport:
     #: in-process runtime when the transport wraps one (job adapters
     #: need it; None over the wire)
     runtime = None
+
+    #: per-call deadline threaded by RemoteClient.call immediately
+    #: before each exchange (None = the transport's constructor
+    #: default). An attribute rather than a parameter so the five
+    #: operation signatures stay wire-shaped; the dispatcher is
+    #: single-threaded per cluster, and chaos wrappers forward it
+    #: inward so the innermost HTTP hop still honors it.
+    deadline_s = None
 
     def get_workload(self, key: str) -> Optional[Workload]:
         raise NotImplementedError
@@ -127,6 +136,12 @@ class HTTPTransport(RemoteTransport):
 
         from kueue_tpu.server.client import ClientError
 
+        # per-call adaptive deadline: narrow the wire client's timeout
+        # for this one exchange (restored on every path — the
+        # dispatcher drives one call at a time per cluster)
+        saved_timeout = self.client.timeout
+        if self.deadline_s is not None:
+            self.client.timeout = self.deadline_s
         try:
             return fn(*args)
         except ClientError as e:
@@ -137,6 +152,8 @@ class HTTPTransport(RemoteTransport):
             raise RemoteRejected(str(e))
         except (urllib.error.URLError, OSError, TimeoutError) as e:
             raise TransportError(str(e))
+        finally:
+            self.client.timeout = saved_timeout
 
     def get_workload(self, key: str) -> Optional[Workload]:
         from kueue_tpu import serialization as ser
@@ -198,6 +215,14 @@ class FlakyTransport(RemoteTransport):
     def runtime(self):  # type: ignore[override]
         return self.inner.runtime
 
+    @property
+    def deadline_s(self):  # type: ignore[override]
+        return getattr(self.inner, "deadline_s", None)
+
+    @deadline_s.setter
+    def deadline_s(self, value):
+        self.inner.deadline_s = value
+
     def _fwd(self, name, *args):
         self.calls += 1
         if self.down:
@@ -236,7 +261,20 @@ class RemoteClient:
     [1, 1+jitter). While lost, at most ``max_inflight_probes``
     concurrent calls may act as the reconnect probe — every other
     caller is refused immediately, capping the in-flight retries a
-    slow half-open remote can accumulate."""
+    slow half-open remote can accumulate.
+
+    Gray-failure extensions: ``call`` accepts a per-exchange
+    ``deadline_s`` (threaded onto the transport for the duration of
+    the exchange) and an optional ``hedge_delay_s`` for idempotent
+    operations — the primary attempt is bounded by the hedge delay,
+    and when it misses, ONE backup attempt fires with the full
+    deadline ('first success wins' collapsed to its synchronous
+    equivalent: the primary that missed its hedge delay has already
+    lost). A primary that merely missed the hedge delay is NOT
+    charged to the connectivity machine; only the backup's verdict
+    counts. ``last_hedge`` exposes the outcome of the most recent
+    call (None / 'won' / 'lost') for the dispatcher's budget and
+    metrics accounting."""
 
     def __init__(
         self,
@@ -261,6 +299,10 @@ class RemoteClient:
         self.next_retry_at = 0.0
         self._mu = threading.Lock()
         self._inflight_probes = 0
+        #: outcome of the most recent call's hedge: None (no hedge
+        #: fired), "won" (backup succeeded / was answered) or "lost"
+        #: (backup failed too)
+        self.last_hedge: Optional[str] = None
 
     def _record_failure(self) -> None:
         now = self.clock.now()
@@ -287,7 +329,23 @@ class RemoteClient:
         would be the reconnect probe)."""
         return self.active or self.clock.now() >= self.next_retry_at
 
-    def call(self, op: str, *args):
+    def _invoke(self, op: str, args, deadline_s: Optional[float]):
+        """One exchange under one deadline (restored on every path)."""
+        prev = self.transport.deadline_s
+        self.transport.deadline_s = deadline_s
+        try:
+            return getattr(self.transport, op)(*args)
+        finally:
+            self.transport.deadline_s = prev
+
+    def call(
+        self,
+        op: str,
+        *args,
+        deadline_s: Optional[float] = None,
+        hedge_delay_s: Optional[float] = None,
+    ):
+        self.last_hedge = None
         probing = False
         with self._mu:
             if not self.active:
@@ -305,13 +363,33 @@ class RemoteClient:
                 self._inflight_probes += 1
                 probing = True
         try:
-            result = getattr(self.transport, op)(*args)
+            try:
+                first = (
+                    hedge_delay_s
+                    if hedge_delay_s is not None
+                    else deadline_s
+                )
+                result = self._invoke(op, args, first)
+            except TransportError:
+                if hedge_delay_s is None:
+                    raise
+                # primary missed the hedge delay — not charged to the
+                # connectivity machine; the backup gets the full
+                # deadline and its verdict is the call's verdict
+                self.last_hedge = "fired"
+                faults.fire("multikueue.hedge")
+                result = self._invoke(op, args, deadline_s)
+                self.last_hedge = "won"
         except TransportError as e:
+            if self.last_hedge == "fired":
+                self.last_hedge = "lost"
             self._record_failure()
             raise ClusterUnreachable(str(e))
         except RemoteRejected:
             # the wire works; the request was refused — connectivity
             # state recovers, the rejection propagates per-workload
+            if self.last_hedge == "fired":
+                self.last_hedge = "won"
             self._record_success()
             raise
         finally:

@@ -58,6 +58,7 @@ def lint(rules=None, root=None, respect_baseline=True):
 
 # importing the rule modules registers them with the rule registry
 from kueue_tpu.analysis import rules_clock  # noqa: F401  (registers)
+from kueue_tpu.analysis import rules_deadline  # noqa: F401
 from kueue_tpu.analysis import rules_dtype  # noqa: F401
 from kueue_tpu.analysis import rules_journal  # noqa: F401
 from kueue_tpu.analysis import rules_locks  # noqa: F401

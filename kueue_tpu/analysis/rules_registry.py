@@ -308,8 +308,9 @@ class MetricsFamiliesRule(Rule):
         "exposition lint; the runtime grammar/histogram invariants "
         "stay in tests/test_observability.py); families under the "
         "exposed-at-zero prefixes (kueue_gateway_*, kueue_slo_*, "
-        "kueue_global_*, kueue_provisioning_*, kueue_elastic_*) must "
-        "be materialized at zero in their defining module"
+        "kueue_global_*, kueue_provisioning_*, kueue_elastic_*, "
+        "kueue_worker_*, kueue_hedge*) must be materialized at zero "
+        "in their defining module"
     )
 
     _FAMILY_GRAMMAR = re.compile(r"^[a-z][a-z0-9_]*$")
@@ -324,6 +325,11 @@ class MetricsFamiliesRule(Rule):
         "kueue_global_",
         "kueue_provisioning_",
         "kueue_elastic_",
+        # gray-failure health plane: worker health/RTT gauges + hedge
+        # accounting (kueue_hedge covers kueue_hedges_total AND
+        # kueue_hedge_rate)
+        "kueue_worker_",
+        "kueue_hedge",
     )
     _ZERO_CALLS = {"inc", "set", "touch"}
 

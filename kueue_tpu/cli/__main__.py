@@ -835,10 +835,16 @@ def cmd_clusters(state: State, args) -> None:
             status = "Quarantined"
         if c.get("cordoned"):
             status += ",Cordoned"
+        rtt_p95 = c.get("rttP95") if c.get("rttSamples") else None
         rows.append(
             [
                 c.get("name", ""),
                 status,
+                # latency health (gray-failure plane): healthy worker
+                # vs limping worker vs lost wire, plus the windowed
+                # p95 RTT its adaptive deadlines derive from
+                c.get("health", "healthy"),
+                "-" if rtt_p95 is None else f"{rtt_p95 * 1000.0:.0f}ms",
                 str(c.get("wins", 0)),
                 str(c.get("dispatches", 0)),
                 str(c.get("strikes", 0)),
@@ -850,7 +856,10 @@ def cmd_clusters(state: State, args) -> None:
             ]
         )
     _print_table(
-        ["NAME", "STATUS", "WINS", "DISPATCHES", "STRIKES", "LOST-SINCE"],
+        [
+            "NAME", "STATUS", "HEALTH", "RTT-P95", "WINS", "DISPATCHES",
+            "STRIKES", "LOST-SINCE",
+        ],
         rows,
     )
 

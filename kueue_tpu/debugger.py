@@ -122,6 +122,28 @@ def dump(runtime) -> str:
     )
     if rep.get("lastError"):
         lines.append(f"lastError: {rep['lastError']}")
+    # federation worker latency health (kueue_tpu/federation/health):
+    # per-worker gray-failure posture — state, windowed RTT quantiles,
+    # adaptive deadline and hedge accounting — so a limping worker is
+    # triagable from a SIGUSR2 dump without the metrics endpoint
+    fed = getattr(runtime, "federation", None)
+    if fed is not None and getattr(fed, "worker_health", None) is not None:
+        wh = fed.worker_health
+        lines.append("-- health (federation worker latency plane) --")
+        for name in sorted(fed.clusters):
+            snap = wh.snapshot(name)
+            lines.append(
+                f"{name}: state={snap['state']} "
+                f"p95={snap['rttP95'] * 1000.0:.0f}ms "
+                f"p99={snap['rttP99'] * 1000.0:.0f}ms "
+                f"errorRate={snap['errorRate']:.2f} "
+                f"samples={snap['samples']} "
+                f"deadline={wh.deadline_s(name):.1f}s"
+            )
+        lines.append(
+            f"hedgeRate={wh.hedge_rate():.4f} "
+            f"probation={','.join(wh.probation()) or '-'}"
+        )
     # gateway posture (kueue_tpu/gateway): write-path batching queue +
     # shed accounting — a saturated ingest path is triagable from the
     # signal dump alone

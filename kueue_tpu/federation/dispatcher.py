@@ -53,9 +53,19 @@ from kueue_tpu.admissionchecks.multikueue_transport import (
     RemoteRejected,
     TransportError,
 )
+from kueue_tpu.federation.health import DEGRADED, HealthPlane
 from kueue_tpu.models import Workload
 from kueue_tpu.models.constants import WorkloadConditionType
 from kueue_tpu.testing import faults
+
+#: operations safe to hedge: reads and heartbeats are pure, copy-create
+#: is absorbed by name+fence dedup on the worker, and delete is already
+#: at-least-once with 404==ack — but delete rides the retraction pump's
+#: own retry loop, so hedging it buys nothing
+HEDGEABLE_OPS = frozenset(
+    {"get_workload", "list_workload_keys", "create_workload",
+     "create_workloads"}
+)
 
 #: fence epoch stamped into every mirrored copy's labels and echoed in
 #: every sync-back — the cross-cluster split-brain guard
@@ -162,6 +172,15 @@ class FederationDispatcher:
         heartbeat_interval_s: float = 30.0,
         drive_inprocess: bool = False,
         rank_cache: bool = True,
+        adaptive_deadlines: bool = True,
+        deadline_floor_s: float = 1.0,
+        deadline_cap_s: float = 10.0,
+        deadline_k: float = 3.0,
+        hedging: bool = True,
+        hedge_budget: float = 0.05,
+        probe_deadline_s: float = 2.0,
+        heartbeat_probe_budget: int = 1,
+        health_plane_kw: Optional[dict] = None,
     ):
         from kueue_tpu.federation.placement import planner_placement_score
 
@@ -176,6 +195,22 @@ class FederationDispatcher:
         self.cluster_quarantine_ttl_s = cluster_quarantine_ttl_s
         self.heartbeat_interval_s = heartbeat_interval_s
         self._last_contact: Dict[str, float] = {}
+        # gray-failure immunity: the latency-aware health plane owns
+        # per-worker RTT telemetry, the healthy→degraded→lost state
+        # machine (probation), adaptive deadlines and the hedge budget
+        self.adaptive_deadlines = adaptive_deadlines
+        self.hedging = hedging
+        self.probe_deadline_s = probe_deadline_s
+        self.heartbeat_probe_budget = heartbeat_probe_budget
+        self.worker_health = HealthPlane(
+            runtime.clock,
+            deadline_floor_s=deadline_floor_s,
+            deadline_cap_s=deadline_cap_s,
+            deadline_k=deadline_k,
+            hedge_budget=hedge_budget,
+            heartbeat_interval_s=heartbeat_interval_s,
+            **(health_plane_kw or {}),
+        )
         # in-process worker runtimes advance inside the manager's pass
         # (the analog of remote servers auto-reconciling on POST)
         self.drive_inprocess = drive_inprocess
@@ -305,6 +340,7 @@ class FederationDispatcher:
         self.pump_retractions()
         del self.clusters[name]
         self.health.pop(name, None)
+        self.worker_health.forget(name)
         self.cordoned.discard(name)
         self._last_contact.pop(name, None)
         self._membership_metric("leave")
@@ -364,53 +400,109 @@ class FederationDispatcher:
                 r.acked = True
 
     # ---- transport (timeout + backoff + fault surface) ----
+    def _deadline_for(self, name: str, cap_s: Optional[float] = None):
+        """Per-call adaptive deadline clamp(k*p99, floor, cap), or None
+        (transport constructor default) when adaptive deadlines are
+        off — the fixed-timeout baseline the grayfail bench A/Bs."""
+        if not self.adaptive_deadlines:
+            return None  # fixed-timeout baseline: constructor default
+        return self.worker_health.deadline_s(name, cap_s=cap_s)
+
+    def _hedge_for(self, name: str, op: str, deadline):
+        """p95 hedge delay for idempotent ops, gated on the fleet-wide
+        hedge budget; None disables hedging for this exchange."""
+        if not self.hedging or op not in HEDGEABLE_OPS:
+            return None
+        hd = self.worker_health.hedge_delay_s(name)
+        if hd is None or (deadline is not None and hd >= deadline):
+            return None
+        return hd
+
+    def _report_hedge(self, cluster: MultiKueueCluster, m) -> None:
+        outcome = cluster.client.last_hedge
+        if outcome not in ("won", "lost"):
+            return
+        self.worker_health.record_hedge()
+        if m is not None:
+            m.report_hedge(outcome)
+
     def _call(
         self, cluster: MultiKueueCluster, op: str, *args,
         fault_point: str = "multikueue.partition",
+        deadline_cap_s: Optional[float] = None,
     ):
         """One guarded wire exchange: the named fault point fires first
         (an armed TransportError models a partition on this wire and is
         charged to the cluster's reconnect state machine), then the
-        call flows through the RemoteClient backoff gate; every outcome
-        lands in the kueue_multikueue_* metrics."""
+        call flows through the RemoteClient backoff gate under the
+        adaptive per-call deadline (hedged for idempotent ops); every
+        outcome lands in the kueue_multikueue_* metrics AND the
+        latency-aware health plane.
+
+        RTT is the max of the wall duration (perf_counter — the
+        allowlisted telemetry timer) and the injected-clock delta: in
+        production the two agree, under FakeClock chaos the injected
+        latency only shows up on the clock — and the health plane must
+        see the limp the chaos layer injected."""
         m = getattr(self.runtime, "metrics", None)
+        deadline = self._deadline_for(cluster.name, deadline_cap_s)
+        hedge = self._hedge_for(cluster.name, op, deadline)
+        self.worker_health.record_call()
         t0 = _time.perf_counter()
+        c0 = self.runtime.clock.now()
         try:
             try:
                 faults.fire(fault_point)
             except TransportError as e:
                 cluster.client._record_failure()
                 raise ClusterUnreachable(str(e))
-            result = cluster.client.call(op, *args)
+            result = cluster.client.call(
+                op, *args, deadline_s=deadline, hedge_delay_s=hedge
+            )
         except ClusterUnreachable:
+            rtt = max(
+                _time.perf_counter() - t0, self.runtime.clock.now() - c0
+            )
             self._last_contact[cluster.name] = self.runtime.clock.now()
+            self.worker_health.observe_rtt(cluster.name, rtt, ok=False)
+            self._report_hedge(cluster, m)
             if m is not None:
                 m.report_dispatch(cluster.name, "unreachable")
             raise
         except RemoteRejected:
+            rtt = max(
+                _time.perf_counter() - t0, self.runtime.clock.now() - c0
+            )
             self._last_contact[cluster.name] = self.runtime.clock.now()
+            # the wire answered — a rejection is a healthy exchange as
+            # far as latency health is concerned
+            self.worker_health.observe_rtt(cluster.name, rtt, ok=True)
+            self._report_hedge(cluster, m)
             if m is not None:
-                m.report_dispatch(
-                    cluster.name, "rejected", _time.perf_counter() - t0
-                )
+                m.report_dispatch(cluster.name, "rejected", rtt)
             raise
+        rtt = max(_time.perf_counter() - t0, self.runtime.clock.now() - c0)
         self._last_contact[cluster.name] = self.runtime.clock.now()
+        self.worker_health.observe_rtt(cluster.name, rtt, ok=True)
+        self._report_hedge(cluster, m)
         if m is not None:
-            m.report_dispatch(cluster.name, "ok", _time.perf_counter() - t0)
+            m.report_dispatch(cluster.name, "ok", rtt)
         return result
 
     # ---- placement ----
     def _health_fingerprint(self, now: float) -> tuple:
-        """Connectivity + quarantine state of every configured cluster
-        — the rank cache's invalidation key. A heartbeat (or any wire
-        exchange) that flips a cluster's reachability changes this
-        fingerprint and drops the cached filtered list mid-step."""
+        """Connectivity + quarantine + latency-health state of every
+        configured cluster — the rank cache's invalidation key. A
+        heartbeat (or any wire exchange) that flips a cluster's
+        reachability OR its probation state changes this fingerprint
+        and drops the cached filtered list mid-step."""
         return tuple(
             (
                 n,
                 c.client.active if c.client is not None else True,
                 self.health[n].quarantined(now),
                 n in self.cordoned,
+                self.worker_health.state(n),
             )
             for n, c in self.clusters.items()
         )
@@ -419,7 +511,14 @@ class FederationDispatcher:
         """The health-filtered cluster list, cached per federation step
         (rank_clusters used to rebuild it per WORKLOAD per step). The
         cache also scopes the per-(cluster, workload) placement-score
-        memo: an invalidation drops both."""
+        memo: an invalidation drops both.
+
+        Probation (latency-health DEGRADED) removes a worker from NEW
+        dispatches the way quarantine does — but unlike quarantine it
+        is latency-driven and self-clearing, and it falls back: if
+        probation would leave NOTHING dispatchable, the degraded
+        workers stay in rotation (a slow federation beats a stalled
+        one)."""
         fp = self._health_fingerprint(now)
         if (
             not self.rank_cache
@@ -427,10 +526,15 @@ class FederationDispatcher:
             or self._rank_memo[0] != self._step_seq
             or self._rank_memo[1] != fp
         ):
-            names = [
-                n for n, _active, quarantined, cordoned in fp
+            eligible = [
+                n for n, _active, quarantined, cordoned, _hs in fp
                 if not quarantined and not cordoned
             ]
+            preferred = [
+                n for n, _active, quarantined, cordoned, hstate in fp
+                if not quarantined and not cordoned and hstate != DEGRADED
+            ]
+            names = preferred or eligible
             self._rank_memo = (self._step_seq, fp, names, {})
         return self._rank_memo[2]
 
@@ -517,17 +621,31 @@ class FederationDispatcher:
         """Probe clusters the dispatch traffic hasn't touched lately —
         an idle loser must still be detected as lost so /healthz and
         kueue_multikueue_clusters_active tell the truth about the
-        federation, not just about the wires the winners use."""
+        federation, not just about the wires the winners use.
+
+        Heartbeats must never stall the dispatch step: each probe is
+        bounded by ``probe_deadline_s`` (tighter than the full
+        adaptive cap — a heartbeat carries no payload worth waiting
+        for), and at most ``heartbeat_probe_budget`` probes per step
+        go to NOT-active clusters (reconnect probes into a black hole
+        each burn a full probe deadline; active-wire heartbeats are
+        effectively free and stay unbudgeted)."""
+        probes_left = self.heartbeat_probe_budget
         for name, cluster in self.clusters.items():
             last = self._last_contact.get(name, float("-inf"))
             if now - last < self.heartbeat_interval_s:
                 continue
             if not cluster.client.reachable():
                 continue
+            if not cluster.client.active:
+                if probes_left <= 0:
+                    continue
+                probes_left -= 1
             try:
                 self._call(
                     cluster, "list_workload_keys", self.origin,
                     fault_point="multikueue.partition",
+                    deadline_cap_s=self.probe_deadline_s,
                 )
             except (ClusterUnreachable, RemoteRejected):
                 continue
@@ -1069,10 +1187,14 @@ class FederationDispatcher:
         m.elastic_workers_cordoned.set(
             len(self.cordoned & set(self.clusters))
         )
+        for name in self.clusters:
+            m.report_worker_health(name, self.worker_health.snapshot(name))
+        m.hedge_rate.set(self.worker_health.hedge_rate())
 
     def health_report(self) -> dict:
         """The /healthz "federation" detail: degraded while any
-        configured worker is lost or quarantined."""
+        configured worker is lost, quarantined, or in latency
+        probation (gray — slow but alive)."""
         now = self.runtime.clock.now()
         lost = sorted(
             name for name, c in self.clusters.items() if not c.client.active
@@ -1081,6 +1203,10 @@ class FederationDispatcher:
             name for name, h in self.health.items() if h.quarantined(now)
         )
         cordoned = sorted(self.cordoned & set(self.clusters))
+        probation = sorted(
+            name for name in self.clusters
+            if self.worker_health.state(name) == DEGRADED
+        )
         pending_retractions = sum(
             1 for r in self.retractions.values() if not r.acked
         )
@@ -1092,9 +1218,13 @@ class FederationDispatcher:
             # cordon is an operator intent, not a failure: visible here
             # but never flips "degraded"
             "cordoned": cordoned,
+            # latency probation: slow-but-alive workers — no NEW
+            # dispatches, still syncing and retracting
+            "probation": probation,
+            "hedgeRate": round(self.worker_health.hedge_rate(), 4),
             "pendingRetractions": pending_retractions,
             "workloads": len(self.states),
-            "degraded": bool(lost or quarantined),
+            "degraded": bool(lost or quarantined or probation),
         }
 
     def cluster_report(self) -> List[dict]:
@@ -1104,6 +1234,7 @@ class FederationDispatcher:
         for name in sorted(self.clusters):
             c = self.clusters[name]
             h = self.health[name]
+            snap = self.worker_health.snapshot(name)
             out.append(
                 {
                     "name": name,
@@ -1117,6 +1248,11 @@ class FederationDispatcher:
                     "dispatches": h.dispatches,
                     "wins": h.wins,
                     "failedAttempts": c.client.failed_attempts,
+                    "health": snap["state"],
+                    "rttP95": snap["rttP95"],
+                    "rttP99": snap["rttP99"],
+                    "errorRate": snap["errorRate"],
+                    "rttSamples": snap["samples"],
                 }
             )
         return out

@@ -59,6 +59,7 @@ class GlobalScheduler:
         use_device: bool = True,
         max_rebalances_per_pass: int = 8,
         rebalance_cooldown_s: float = 60.0,
+        degraded_penalty_s: float = 120.0,
     ):
         self.disp = dispatcher
         self.runtime = dispatcher.runtime
@@ -66,6 +67,13 @@ class GlobalScheduler:
         self.rescore_interval_s = float(rescore_interval_s)
         self.use_device = use_device
         self.max_rebalances_per_pass = int(max_rebalances_per_pass)
+        # gray-failure coupling (PR 20): clusters the latency health
+        # plane holds in probation get this many seconds added to
+        # every forecast BEFORE the key pack — the kernel prefers
+        # moving OFF them and never rebalances ONTO them, while the
+        # penalty (not an invalid mask) keeps a fully-degraded
+        # federation schedulable
+        self.degraded_penalty_s = float(degraded_penalty_s)
         # per-workload churn guard: a workload that just moved is not
         # moved again until the cooldown lapses — forecast noise (or a
         # herd of movers chasing the same freed slot) must not bounce
@@ -94,15 +102,18 @@ class GlobalScheduler:
         self.readers[name] = reader
 
     def attach_feed_reader(
-        self, name: str, url: str, token: Optional[str] = None
+        self, name: str, url: str, token: Optional[str] = None,
+        poll_timeout_s: float = 30.0,
     ):
         """Tail a remote worker's replication feed — the PR-9 replica
         machinery pointed at the worker. The tailer keeps a live
-        read-only twin the aggregation forecasts against."""
+        read-only twin the aggregation forecasts against.
+        ``poll_timeout_s`` caps the source's adaptive per-poll
+        deadline."""
         from kueue_tpu.storage.tailer import HTTPTailSource, JournalTailer
 
         tailer = JournalTailer(
-            HTTPTailSource(url, token=token),
+            HTTPTailSource(url, token=token, timeout=poll_timeout_s),
             now_fn=self.runtime.clock.now,
         )
         self.attach_reader(name, tailer)
@@ -119,6 +130,20 @@ class GlobalScheduler:
                 # previous twin serving; the worker scores stale or
                 # unscorable, never breaks the pass
                 continue
+
+    def _degraded_mask(self, clusters):
+        """bool[C] probation mask aligned to the snapshot's cluster
+        order, from the dispatcher's latency health plane."""
+        import numpy as np
+
+        health = getattr(self.disp, "worker_health", None)
+        mask = np.zeros(len(clusters), dtype=bool)
+        if health is None:
+            return mask, []
+        probation = set(health.probation())
+        for i, name in enumerate(clusters):
+            mask[i] = name in probation
+        return mask, sorted(probation & set(clusters))
 
     # ---- the loop ----
     def maybe_step(self) -> Optional[dict]:
@@ -146,6 +171,8 @@ class GlobalScheduler:
         tta_ms, score, valid, current, rotation = snap.encode()
         aggregate_s = _time.perf_counter() - t_agg
         hysteresis_ms = int(round(self.hysteresis_s * 1000.0))
+        degraded, degraded_names = self._degraded_mask(snap.clusters)
+        penalty_ms = int(round(self.degraded_penalty_s * 1000.0))
         t0 = _time.perf_counter()
         path = "host"
         res = None
@@ -154,7 +181,8 @@ class GlobalScheduler:
 
             try:
                 res = rescore_pairs(
-                    tta_ms, score, valid, current, rotation, hysteresis_ms
+                    tta_ms, score, valid, current, rotation, hysteresis_ms,
+                    degraded=degraded, degraded_penalty_ms=penalty_ms,
                 )
                 path = "device"
             except Exception:  # noqa: BLE001 — the mirror is the
@@ -163,7 +191,8 @@ class GlobalScheduler:
                 res = None
         if res is None:
             res = rescore_np(
-                tta_ms, score, valid, current, rotation, hysteresis_ms
+                tta_ms, score, valid, current, rotation, hysteresis_ms,
+                degraded=degraded, degraded_penalty_ms=penalty_ms,
             )
         duration_s = _time.perf_counter() - t0
 
@@ -220,6 +249,7 @@ class GlobalScheduler:
             "aggregateMs": round(aggregate_s * 1e3, 3),
             "pending": len(snap.keys),
             "clusters": list(snap.clusters),
+            "degradedClusters": degraded_names,
             "reachableWorkers": reachable,
             "rebalanceCandidates": len(candidates),
             "rebalanced": applied,
@@ -239,6 +269,14 @@ class GlobalScheduler:
             self.aggregate_ms_total += aggregate_s * 1e3
             self.last_report = report
         return report
+
+    def _target_degraded(self, target: str) -> bool:
+        health = getattr(self.disp, "worker_health", None)
+        if health is None:
+            return False
+        from kueue_tpu.federation.health import DEGRADED
+
+        return health.state(target) == DEGRADED
 
     # ---- the move ----
     def _rebalance(
@@ -267,6 +305,11 @@ class GlobalScheduler:
             # drain-ahead: a cordoned worker must not RECEIVE moves
             # (its own placements are being drained off it)
             or target in self.disp.cordoned
+            # gray-failure probation: a worker the health plane holds
+            # DEGRADED (apply-time check — it may have slipped into
+            # probation since the snapshot scored) must not RECEIVE
+            # moves either; its existing placements keep syncing
+            or self._target_degraded(target)
             or st.winner == target
         ):
             return skip("skipped_gone")

@@ -356,6 +356,43 @@ class Metrics:
             f"{NS}_multikueue_clusters_active",
             "Worker clusters currently reachable and not quarantined",
         )
+        # gray-failure health plane (kueue_tpu/federation/health.py):
+        # per-worker latency state, RTT quantiles and hedge accounting.
+        # worker_health is one-hot per (cluster, state) — a worker in
+        # "degraded" is in latency probation (slow but alive: no NEW
+        # dispatches, still syncing/retracting); a sustained hedge rate
+        # near the budget means the fleet's tail latency is eating the
+        # hedge allowance (raise the budget or fix the gray worker).
+        self.worker_health = r.gauge(
+            f"{NS}_worker_health",
+            "1 for each worker cluster's current latency-health state (healthy|degraded|lost)",
+            ("cluster", "state"),
+        )
+        self.worker_rtt_quantile_seconds = r.gauge(
+            f"{NS}_worker_rtt_quantile_seconds",
+            "Windowed RTT quantiles per worker cluster (quantile in p50|p95|p99)",
+            ("cluster", "quantile"),
+        )
+        # `cluster` is open-ended: materialize the empty-label series
+        # so the scrape surface is complete before the first worker is
+        # configured; `state`/`quantile` are closed sets, exposed per
+        # value
+        for state in ("healthy", "degraded", "lost"):
+            self.worker_health.set(0.0, cluster="", state=state)
+        for q in ("p50", "p95", "p99"):
+            self.worker_rtt_quantile_seconds.set(0.0, cluster="", quantile=q)
+        self.hedges_total = r.counter(
+            f"{NS}_hedges_total",
+            "Total hedged federation exchanges by outcome (won = the backup answered, lost = it failed too)",
+            ("outcome",),
+        )
+        for outcome in ("won", "lost"):
+            self.hedges_total.inc(0.0, outcome=outcome)
+        self.hedge_rate = r.gauge(
+            f"{NS}_hedge_rate",
+            "Hedged fraction of all federation exchanges (budget-capped)",
+        )
+        self.hedge_rate.set(0.0)
         # global scheduler (kueue_tpu/federation/global_scheduler.py):
         # federation-wide rescore loop + planner-driven rebalancing.
         # A rising skipped_stale rate means rescores race deposals
@@ -740,6 +777,23 @@ class Metrics:
 
     def report_retraction(self, outcome: str) -> None:
         self.multikueue_retractions_total.inc(outcome=outcome)
+
+    def report_hedge(self, outcome: str) -> None:
+        self.hedges_total.inc(outcome=outcome)
+
+    def report_worker_health(self, cluster: str, snapshot: dict) -> None:
+        """Mirror one worker's health-plane snapshot into the scrape
+        surface: one-hot state + RTT quantile gauges."""
+        for state in ("healthy", "degraded", "lost"):
+            self.worker_health.set(
+                1.0 if snapshot["state"] == state else 0.0,
+                cluster=cluster, state=state,
+            )
+        for q, key in (("p50", "rttP50"), ("p95", "rttP95"),
+                       ("p99", "rttP99")):
+            self.worker_rtt_quantile_seconds.set(
+                snapshot[key], cluster=cluster, quantile=q
+            )
 
     def report_inadmissible_reason(self, cq: str, reason: str) -> None:
         self.inadmissible_reason_total.inc(cluster_queue=cq, reason=reason)

@@ -45,6 +45,17 @@ Rebalance is decided on the TTA axis alone: a placement moves only
 when the best cluster's forecast beats the CURRENT cluster's by more
 than ``hysteresis_ms`` (Tesserae's churn guard); a better policy score
 at equal TTA never migrates a gang.
+
+Gray-failure penalty (PR 20): the latency health plane
+(federation/health.py) marks limping workers DEGRADED. The key has no
+spare bits, so degradation enters as TTA inflation: an optional
+``degraded`` bool[C] column mask adds ``degraded_penalty_ms`` to every
+pair on a degraded cluster BEFORE packing, clipped back to
+``TTA_CAP_MS``. The inflation applies to the candidate AND the
+current-placement reads symmetrically, so a workload already on a
+degraded worker sees a genuine ``gain_ms`` toward any healthy cluster
+(the scheduler prefers moving OFF gray workers) while two degraded
+clusters still compare on their real forecasts.
 """
 
 from __future__ import annotations
@@ -101,11 +112,15 @@ class RescoreResult(NamedTuple):
     rebalance: jnp.ndarray
 
 
-def _solve_rescore(tta_ms, score, valid, current, rotation, hysteresis_ms):
+def _solve_rescore(
+    tta_ms, score, valid, current, rotation, hysteresis_ms,
+    degraded, degraded_penalty_ms,
+):
     w, c = tta_ms.shape
     cols = jnp.arange(c, dtype=jnp.int64)[None, :]
     idx = (cols - rotation.astype(jnp.int64)[:, None]) % c
-    tta_c = jnp.clip(tta_ms, 0, TTA_CAP_MS)
+    penalty = degraded.astype(jnp.int64)[None, :] * degraded_penalty_ms
+    tta_c = jnp.clip(jnp.clip(tta_ms, 0, TTA_CAP_MS) + penalty, 0, TTA_CAP_MS)
     score_c = jnp.clip(score, -SCORE_HALF, SCORE_HALF - 1) + SCORE_HALF
     key = (
         tta_c * _TTA_SHIFT
@@ -142,17 +157,24 @@ solve_rescore = jax.jit(_solve_rescore)
 
 
 def rescore_pairs(
-    tta_ms, score, valid, current, rotation, hysteresis_ms: int
+    tta_ms, score, valid, current, rotation, hysteresis_ms: int,
+    degraded=None, degraded_penalty_ms: int = 0,
 ):
     """Host entry point: numpy in, numpy out, one device launch.
 
     W is padded to the next power of two (padding rows all-invalid,
     current=-1) so the jit cache holds O(log W) entries per cluster
     count instead of one per backlog size.
+
+    ``degraded`` is an optional bool[C] mask (gray-failure probation);
+    each marked column's TTA is inflated by ``degraded_penalty_ms``
+    before packing. Omitting it is identical to an all-healthy fleet.
     """
     import numpy as np
 
     w, c = tta_ms.shape
+    if degraded is None:
+        degraded = np.zeros(c, dtype=bool)
     if w == 0 or c == 0:
         return RescoreResult(
             np.full(w, -1, dtype=np.int32),
@@ -181,6 +203,8 @@ def rescore_pairs(
         jnp.asarray(current, dtype=jnp.int32),
         jnp.asarray(rotation, dtype=jnp.int32),
         jnp.int64(int(hysteresis_ms)),
+        jnp.asarray(degraded, dtype=bool),
+        jnp.int64(int(degraded_penalty_ms)),
     )
     return RescoreResult(
         np.asarray(res.best)[:w],

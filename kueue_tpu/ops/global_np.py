@@ -29,17 +29,23 @@ _TTA_SHIFT = 1 << (SCORE_BITS + IDX_BITS)
 
 
 def rescore_np(
-    tta_ms, score, valid, current, rotation, hysteresis_ms: int
+    tta_ms, score, valid, current, rotation, hysteresis_ms: int,
+    degraded=None, degraded_penalty_ms: int = 0,
 ) -> RescoreResult:
     """The kernel's exact arithmetic in numpy: pack one int64 key per
     (workload, cluster) pair — (tta asc, score desc, rotated index
-    asc) — argmin per row, hysteresis-gate the move."""
+    asc) — argmin per row, hysteresis-gate the move. ``degraded``
+    columns get ``degraded_penalty_ms`` added to their (clipped) TTA
+    before packing, same as the device pass."""
     tta_ms = np.asarray(tta_ms, dtype=np.int64)
     score = np.asarray(score, dtype=np.int64)
     valid = np.asarray(valid, dtype=bool)
     current = np.asarray(current, dtype=np.int32)
     rotation = np.asarray(rotation, dtype=np.int32)
     w, c = tta_ms.shape
+    if degraded is None:
+        degraded = np.zeros(c, dtype=bool)
+    degraded = np.asarray(degraded, dtype=bool)
     if w == 0 or c == 0:
         return RescoreResult(
             np.full(w, -1, dtype=np.int32),
@@ -53,7 +59,10 @@ def rescore_np(
         )
     cols = np.arange(c, dtype=np.int64)[None, :]
     idx = (cols - rotation.astype(np.int64)[:, None]) % c
-    tta_c = np.clip(tta_ms, 0, TTA_CAP_MS)
+    penalty = degraded.astype(np.int64)[None, :] * np.int64(
+        int(degraded_penalty_ms)
+    )
+    tta_c = np.clip(np.clip(tta_ms, 0, TTA_CAP_MS) + penalty, 0, TTA_CAP_MS)
     score_c = np.clip(score, -SCORE_HALF, SCORE_HALF - 1) + SCORE_HALF
     key = (
         tta_c * _TTA_SHIFT

@@ -27,13 +27,16 @@ class ReadReplica:
         ca_cert: Optional[str] = None,
         insecure: bool = False,
         source=None,
+        poll_timeout_s: float = 30.0,
     ):
         self.leader_url = leader_url.rstrip("/")
         self.poll_interval_s = poll_interval_s
         if source is None:
+            # poll_timeout_s is the CAP: the source's adaptive deadline
+            # tightens each poll toward observed RTT below it
             source = HTTPTailSource(
                 leader_url, token=token, replica_id=replica_id,
-                ca_cert=ca_cert, insecure=insecure,
+                ca_cert=ca_cert, insecure=insecure, timeout=poll_timeout_s,
             )
         self.replica_id = getattr(source, "replica_id", replica_id or "replica")
         self.tailer = JournalTailer(

@@ -1017,10 +1017,11 @@ def _make_handler(srv: KueueServer):
                 if detail["degraded"]:
                     body["status"] = "degraded"
             # federation detail (kueue_tpu/federation): same convention
-            # — a lost or quarantined worker cluster flips "degraded"
-            # while the probe stays 200 (the dispatcher keeps routing
-            # around it; the operator pages on the detail /
-            # kueue_multikueue_clusters_active instead)
+            # — a lost, quarantined or gray (probation) worker cluster
+            # flips "degraded" while the probe stays 200 (the
+            # dispatcher keeps routing around it; the operator pages on
+            # the detail / kueue_multikueue_clusters_active /
+            # kueue_worker_health instead)
             fed = getattr(srv.runtime, "federation", None)
             if fed is not None:
                 detail = fed.health_report()

@@ -92,9 +92,12 @@ class KueueClient:
             else:
                 self._ssl_context = ssl.create_default_context(cafile=ca_cert)
 
-    def _request(self, method: str, path: str, body: Optional[dict] = None):
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 timeout_s: Optional[float] = None):
         self.last_redirected_to = None
-        return self._request_url(f"{self.base_url}{path}", method, body)
+        return self._request_url(
+            f"{self.base_url}{path}", method, body, timeout_s=timeout_s
+        )
 
     def _retry_after_delay(self, header: Optional[str], attempt: int) -> float:
         """Backoff for one shed (429) retry: the server's Retry-After
@@ -114,7 +117,13 @@ class KueueClient:
         return delay
 
     def _request_url(self, url: str, method: str,
-                     body: Optional[dict] = None, redirects: int = 1):
+                     body: Optional[dict] = None, redirects: int = 1,
+                     timeout_s: Optional[float] = None):
+        # per-call deadline override (gray-failure adaptive deadlines):
+        # callers that track the server's observed RTT — the replica
+        # tailer's poll loop — pass an explicit ``timeout_s`` instead
+        # of riding the constructor-wide default
+        effective_timeout = timeout_s if timeout_s is not None else self.timeout
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"} if data else {}
         if self.token is not None:
@@ -128,7 +137,7 @@ class KueueClient:
             )
             try:
                 with urllib.request.urlopen(
-                    req, timeout=self.timeout, context=self._ssl_context
+                    req, timeout=effective_timeout, context=self._ssl_context
                 ) as resp:
                     raw = resp.read()
                     ctype = resp.headers.get("Content-Type", "")
@@ -144,7 +153,8 @@ class KueueClient:
                     if location:
                         self.last_redirected_to = location
                         return self._request_url(
-                            location, method, body, redirects=redirects - 1
+                            location, method, body, redirects=redirects - 1,
+                            timeout_s=timeout_s,
                         )
                 retry_after = e.headers.get("Retry-After")
                 if e.code == 429:
@@ -381,12 +391,15 @@ class KueueClient:
         lag_s: Optional[float] = None,
         since_span_seq: int = 0,
         hop: Optional[int] = None,
+        timeout_s: Optional[float] = None,
     ) -> dict:
         """One replication-feed poll (the JournalTailer wire): journal
         records with seq > ``since_seq`` plus event/audit/span deltas,
         and the leader's head/compaction-floor/fencing posture.
         ``replica`` + ``applied_seq``/``lag_s`` register this follower
-        in the leader's roster."""
+        in the leader's roster. ``timeout_s`` overrides the client-wide
+        timeout for this one poll (the HTTPTailSource adaptive
+        deadline)."""
         params = [
             f"sinceSeq={since_seq}",
             f"sinceEventRv={since_event_rv}",
@@ -405,7 +418,8 @@ class KueueClient:
             if hop is not None:
                 params.append(f"hop={hop}")
         return self._request(
-            "GET", "/apis/kueue/v1beta1/journal?" + "&".join(params)
+            "GET", "/apis/kueue/v1beta1/journal?" + "&".join(params),
+            timeout_s=timeout_s,
         )
 
     def replicas(self) -> dict:

@@ -195,6 +195,23 @@ def dashboard_payload(rt) -> dict:
         slo = slo_tracker.report()
     else:
         slo = {"enabled": False, "degraded": False, "clusterQueues": []}
+    # federation health badge (kueue_tpu/federation/health): gray-
+    # failure posture — worker probation roster + hedge rate;
+    # {"enabled": False} renders the "off" badge on non-manager planes
+    fed = getattr(rt, "federation", None)
+    if fed is not None and getattr(fed, "worker_health", None) is not None:
+        federation = {
+            "enabled": True,
+            "workers": len(fed.clusters),
+            "probation": fed.worker_health.probation(),
+            "lost": sorted(
+                n for n in fed.clusters
+                if not fed.clusters[n].client.active
+            ),
+            "hedgeRate": round(fed.worker_health.hedge_rate(), 4),
+        }
+    else:
+        federation = {"enabled": False}
     # trace waterfall (kueue_tpu/tracing): the most recent cycle's
     # span tree — on a replica these are the LEADER's spans, mirrored
     # off the journal feed
@@ -223,6 +240,7 @@ def dashboard_payload(rt) -> dict:
         "replication": replication,
         "gateway": gateway,
         "slo": slo,
+        "federation": federation,
         "clusterQueues": cqs,
         "localQueues": lqs,
         "workloads": workloads,
@@ -303,7 +321,8 @@ DASHBOARD_HTML = """<!doctype html>
  &middot; policy <span id="policy" class="badge">&hellip;</span>
  &middot; replication <span id="replication" class="badge">&hellip;</span>
  &middot; gateway <span id="gateway" class="badge">&hellip;</span>
- &middot; slo <span id="slo" class="badge">&hellip;</span></div>
+ &middot; slo <span id="slo" class="badge">&hellip;</span>
+ &middot; federation <span id="federation" class="badge">&hellip;</span></div>
 <div class="tiles" id="tiles"></div>
 <h2>Last cycle</h2><div id="cycle"></div>
 <h2>Trace waterfall</h2><div id="waterfall" class="muted">no trace yet</div>
@@ -441,6 +460,17 @@ function render(d){
          `burn=${(e.burnRate||0).toFixed(2)}x`).join('\\n')
       || 'no admissions observed yet';
   } else { soEl.className='badge'; soEl.textContent='off'; }
+  const fd = d.federation||{};
+  const fdEl = document.getElementById('federation');
+  if (fd.enabled){
+    const gray = (fd.probation||[]).length, lost = (fd.lost||[]).length;
+    fdEl.className = 'badge '+(lost>0 ? 'quarantined'
+      : (gray>0 ? 'host' : 'device'));
+    fdEl.textContent = lost>0 ? `${lost} lost · ${gray} gray`
+      : (gray>0 ? `${gray} gray / ${fd.workers}` : `${fd.workers} healthy`);
+    fdEl.title = `probation=${(fd.probation||[]).join(',')||'-'} `+
+      `lost=${(fd.lost||[]).join(',')||'-'} hedgeRate=${fd.hedgeRate||0}`;
+  } else { fdEl.className='badge'; fdEl.textContent='off'; }
   const st = d.workloadStates||{};
   document.getElementById('tiles').innerHTML =
     [['ClusterQueues',d.clusterQueues.length],['LocalQueues',d.localQueues.length],

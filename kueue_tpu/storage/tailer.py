@@ -161,20 +161,48 @@ class LocalTailSource:
 class HTTPTailSource:
     """Tail a remote leader over its replication feed. Carries the
     replica's identity + staleness back to the leader on every poll so
-    ``kueuectl replicas`` on the leader lists live followers."""
+    ``kueuectl replicas`` on the leader lists live followers.
+
+    Adaptive poll deadline (gray-failure immunity): a replica behind a
+    limping leader used to wait the full constructor ``timeout`` (30 s)
+    per wedged poll. The source tracks an EWMA of observed fetch RTT
+    and bounds each poll at ``clamp(deadline_k * ewma_rtt,
+    deadline_floor_s, timeout)`` — a healthy feed answering in tens of
+    milliseconds fails over in ~``deadline_floor_s``, while the first
+    poll (no sample yet) and every poll after a failure fall back to
+    the full ``timeout`` so a too-tight estimate can never wedge the
+    loop shut."""
 
     def __init__(self, leader_url: str, token: Optional[str] = None,
                  replica_id: Optional[str] = None, timeout: float = 30.0,
                  ca_cert: Optional[str] = None, insecure: bool = False,
-                 limit: int = 4096):
+                 limit: int = 4096, adaptive_deadline: bool = True,
+                 deadline_k: float = 4.0, deadline_floor_s: float = 2.0,
+                 ewma_alpha: float = 0.3):
         from kueue_tpu.server.client import KueueClient
 
         self.leader_url = leader_url.rstrip("/")
         self.replica_id = replica_id or f"replica-{os.getpid()}"
         self.limit = limit
+        self.timeout = timeout
+        self.adaptive_deadline = adaptive_deadline
+        self.deadline_k = deadline_k
+        self.deadline_floor_s = deadline_floor_s
+        self.ewma_alpha = ewma_alpha
+        self.ewma_rtt_s: Optional[float] = None
         self.client = KueueClient(
             leader_url, timeout=timeout, token=token, ca_cert=ca_cert,
             insecure=insecure,
+        )
+
+    def poll_deadline_s(self) -> Optional[float]:
+        """The next poll's per-call deadline; None = constructor-wide
+        default (no RTT sample yet, or adaptation disabled)."""
+        if not self.adaptive_deadline or self.ewma_rtt_s is None:
+            return None
+        return min(
+            self.timeout,
+            max(self.deadline_floor_s, self.deadline_k * self.ewma_rtt_s),
         )
 
     def fetch(self, since_seq: int, since_event_rv: int = 0,
@@ -183,6 +211,7 @@ class HTTPTailSource:
         from kueue_tpu.server.client import ClientError
 
         status = status or {}
+        t0 = time.perf_counter()
         try:
             out = self.client.journal_tail(
                 since_seq=since_seq,
@@ -194,9 +223,20 @@ class HTTPTailSource:
                 applied_seq=status.get("appliedSeq"),
                 lag_s=status.get("lagSeconds"),
                 hop=status.get("hop"),
+                timeout_s=self.poll_deadline_s(),
             )
         except (ClientError, OSError) as e:
+            # drop the estimate: the next poll gets the full timeout
+            # (a tightened deadline that starts failing must widen
+            # itself back out, not spiral)
+            self.ewma_rtt_s = None
             raise TailSourceError(f"leader feed fetch failed: {e}")
+        rtt = time.perf_counter() - t0
+        self.ewma_rtt_s = (
+            rtt if self.ewma_rtt_s is None
+            else (1.0 - self.ewma_alpha) * self.ewma_rtt_s
+            + self.ewma_alpha * rtt
+        )
         try:
             return TailBatch(
                 records=[

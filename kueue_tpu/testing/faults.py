@@ -200,6 +200,34 @@ FAULT_POINTS: Dict[str, str] = {
         "acked; PR-4 recovery plus client re-submit must converge to "
         "the serial reference with no lost or duplicated workload"
     ),
+    # ---- gray-failure chaos layer (this module's transports) ----
+    "chaos.latency": (
+        "immediately before a latency-injected federation exchange "
+        "(LatencyTransport/SlowDripTransport) delays or times out the "
+        "wire — arm with 'crash' to kill the dispatcher while a gray "
+        "worker is mid-limp, or a callable to reshape the schedule"
+    ),
+    "chaos.drop_request": (
+        "asymmetric loss, request direction: the request is about to "
+        "be dropped BEFORE it reaches the worker (the mutation never "
+        "lands; the caller burns its full deadline) — arm with 'crash' "
+        "to kill the dispatcher inside the loss window"
+    ),
+    "chaos.drop_response": (
+        "asymmetric loss, response direction: the mutation has LANDED "
+        "on the worker and the response is about to be dropped (the "
+        "caller sees a timeout for an exchange that succeeded — the "
+        "window where duplicate-create dedup and 404==ack retraction "
+        "semantics are load-bearing) — arm with 'crash' to kill the "
+        "dispatcher between the landing and the ack"
+    ),
+    "multikueue.hedge": (
+        "hedged dispatch: the primary attempt missed its p95 hedge "
+        "delay and the backup attempt is about to fire "
+        "(multikueue_transport.RemoteClient.call) — a crash here must "
+        "still converge to exactly one admission (the primary may have "
+        "landed, the backup may land again)"
+    ),
     # ---- journal-tailing read replicas (kueue_tpu/storage/tailer.py) ----
     "replica.tail_gap": (
         "the tailer just detected that the leader can no longer serve "
@@ -349,3 +377,4 @@ def garble_tail(segment_path: str, nbytes: int = 4) -> None:
         tail = f.read(n)
         f.seek(size - n)
         f.write(bytes(b ^ 0xFF for b in tail))
+

@@ -933,6 +933,104 @@ class TestRegistryRules:
         assert any("baz_kernel" in m and "sharded" in m for m in msgs)
 
 
+# ---- deadline-discipline ----
+DEADLINE_BAD = '''\
+from kueue_tpu.server.client import KueueClient
+
+
+class Pump:
+    def __init__(self, url):
+        self.client = KueueClient(url)
+
+    def sync(self, cluster, key):
+        return cluster.call("get_workload", key)
+
+    def poll(self):
+        return self.client.journal_tail(since_seq=0)
+'''
+
+DEADLINE_GOOD = '''\
+from kueue_tpu.server.client import KueueClient
+
+
+class Pump:
+    def __init__(self, url):
+        self.client = KueueClient(url, timeout=10.0)
+
+    def sync(self, cluster, key, deadline):
+        return cluster.call("get_workload", key, deadline_s=deadline)
+
+    def forward(self, cluster, key, **kw):
+        return cluster.call("get_workload", key, **kw)
+
+    def poll(self, deadline):
+        return self.client.journal_tail(since_seq=0, timeout_s=deadline)
+'''
+
+
+class TestDeadlineDisciplineRule:
+    """The gray-failure habit fix (ISSUE 20 satellite): control-loop
+    call sites under federation/, replica/ and admissionchecks/ must
+    name their per-call deadline instead of riding whatever timeout
+    the transport constructor baked in."""
+
+    def test_flags_default_timeout_call_sites(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            {"kueue_tpu/federation/x.py": DEADLINE_BAD},
+            rules=["deadline-discipline"],
+        )
+        msgs = [f.message for f in findings]
+        assert len(findings) == 3
+        assert any("KueueClient" in m and "timeout=" in m for m in msgs)
+        assert any(".call(" in m and "deadline_s=" in m for m in msgs)
+        assert any(".journal_tail(" in m and "timeout_s=" in m for m in msgs)
+
+    def test_explicit_deadlines_and_splats_are_clean(self, tmp_path):
+        assert run_fixture(
+            tmp_path,
+            {"kueue_tpu/federation/x.py": DEADLINE_GOOD},
+            rules=["deadline-discipline"],
+        ) == []
+
+    def test_out_of_scope_files_are_ignored(self, tmp_path):
+        # the discipline binds control loops; CLI one-shots, bench
+        # scripts and the server glue stay out of scope
+        assert run_fixture(
+            tmp_path,
+            {"kueue_tpu/cli/x.py": DEADLINE_BAD},
+            rules=["deadline-discipline"],
+        ) == []
+
+    def test_allowlist_scopes_and_stale_entries(self, tmp_path):
+        allow = {
+            "kueue_tpu/federation/x.py::Pump.sync": "caller-bounded",
+            "kueue_tpu/federation/x.py::Pump.__init__": "script glue",
+            "kueue_tpu/federation/x.py::Pump.poll": "long-poll wire",
+        }
+        assert run_fixture(
+            tmp_path,
+            {"kueue_tpu/federation/x.py": DEADLINE_BAD},
+            rules=["deadline-discipline"],
+            config={"deadline_allowlist": dict(allow)},
+        ) == []
+        # a stale entry (scope now clean) is itself a finding
+        allow["kueue_tpu/federation/x.py::Pump.gone"] = "rotted"
+        findings = run_fixture(
+            tmp_path,
+            {"kueue_tpu/federation/x.py": DEADLINE_BAD},
+            rules=["deadline-discipline"],
+            config={"deadline_allowlist": allow},
+        )
+        assert len(findings) == 1 and "stale" in findings[0].message
+
+    def test_real_tree_is_deadline_clean(self):
+        """The production contract: every .call/journal_tail/transport
+        construction in the scoped control loops already names its
+        bound — no allowlist debt at introduction time."""
+        assert lint(rules=["deadline-discipline"]) == []
+
+
 # ---- engine units ----
 class TestEngine:
     def test_parse_error_is_a_finding_not_a_crash(self, tmp_path):
@@ -968,7 +1066,7 @@ class TestEngine:
                 "kernel-dtype", "trace-safety", "journal-symmetry",
                 "clock-discipline", "lock-discipline", "reason-enum",
                 "span-name", "fault-point", "metrics-families",
-                "kernel-mirrors", "policy-name",
+                "kernel-mirrors", "policy-name", "deadline-discipline",
             ]
         )
 
